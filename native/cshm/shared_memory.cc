@@ -1,0 +1,158 @@
+// POSIX shared-memory C shim backing triton_client_tpu.utils.shared_memory.
+//
+// Behavioral parity with the reference shim
+// (src/python/library/tritonclient/utils/shared_memory/shared_memory.cc):
+// shm_open/ftruncate/mmap on create, memcpy on set, munmap/shm_unlink on
+// destroy, with a handle struct carrying {name, base_addr, shm_key, shm_fd,
+// offset, byte_size}.  Written fresh for this framework; adds an open-existing
+// path and bounds checking on Set.
+
+#include "shared_memory.h"
+
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <new>
+#include <string>
+
+namespace {
+
+struct SharedMemoryHandle {
+  std::string triton_shm_name;
+  std::string shm_key;
+  char* base_addr = nullptr;
+  int shm_fd = -1;
+  size_t offset = 0;
+  size_t byte_size = 0;
+};
+
+int MapRegion(int shm_fd, size_t offset, size_t byte_size, char** addr) {
+  void* p = mmap(nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, shm_fd,
+                 static_cast<off_t>(offset));
+  if (p == MAP_FAILED) {
+    return CSHM_ERROR_SHM_MMAP;
+  }
+  *addr = static_cast<char*>(p);
+  return CSHM_SUCCESS;
+}
+
+}  // namespace
+
+extern "C" {
+
+int SharedMemoryRegionCreate(const char* triton_shm_name, const char* shm_key,
+                             size_t byte_size, CshmHandle* handle) {
+  int fd = shm_open(shm_key, O_RDWR | O_CREAT, S_IRUSR | S_IWUSR);
+  if (fd == -1) {
+    return CSHM_ERROR_SHM_OPEN;
+  }
+  if (ftruncate(fd, static_cast<off_t>(byte_size)) == -1) {
+    close(fd);
+    shm_unlink(shm_key);  // don't leak the object we just created
+    return CSHM_ERROR_SHM_TRUNCATE;
+  }
+  char* addr = nullptr;
+  int err = MapRegion(fd, 0, byte_size, &addr);
+  if (err != CSHM_SUCCESS) {
+    close(fd);
+    shm_unlink(shm_key);
+    return err;
+  }
+  auto* h = new (std::nothrow) SharedMemoryHandle();
+  if (h == nullptr) {
+    munmap(addr, byte_size);
+    close(fd);
+    shm_unlink(shm_key);
+    return CSHM_ERROR_UNKNOWN;
+  }
+  h->triton_shm_name = triton_shm_name;
+  h->shm_key = shm_key;
+  h->base_addr = addr;
+  h->shm_fd = fd;
+  h->offset = 0;
+  h->byte_size = byte_size;
+  *handle = h;
+  return CSHM_SUCCESS;
+}
+
+int SharedMemoryRegionOpen(const char* triton_shm_name, const char* shm_key,
+                           size_t byte_size, size_t offset, CshmHandle* handle) {
+  int fd = shm_open(shm_key, O_RDWR, S_IRUSR | S_IWUSR);
+  if (fd == -1) {
+    return CSHM_ERROR_SHM_OPEN;
+  }
+  char* addr = nullptr;
+  int err = MapRegion(fd, offset, byte_size, &addr);
+  if (err != CSHM_SUCCESS) {
+    close(fd);
+    return err;
+  }
+  auto* h = new (std::nothrow) SharedMemoryHandle();
+  if (h == nullptr) {
+    munmap(addr, byte_size);
+    close(fd);
+    return CSHM_ERROR_UNKNOWN;
+  }
+  h->triton_shm_name = triton_shm_name;
+  h->shm_key = shm_key;
+  h->base_addr = addr;
+  h->shm_fd = fd;
+  h->offset = offset;
+  h->byte_size = byte_size;
+  *handle = h;
+  return CSHM_SUCCESS;
+}
+
+int SharedMemoryRegionSet(CshmHandle handle, size_t offset, size_t byte_size,
+                          const void* data) {
+  auto* h = static_cast<SharedMemoryHandle*>(handle);
+  if (h == nullptr || h->base_addr == nullptr) {
+    return CSHM_ERROR_INVALID_HANDLE;
+  }
+  if (offset + byte_size > h->byte_size) {
+    return CSHM_ERROR_OUT_OF_BOUNDS;
+  }
+  memcpy(h->base_addr + offset, data, byte_size);
+  return CSHM_SUCCESS;
+}
+
+int GetSharedMemoryHandleInfo(CshmHandle handle, char** base_addr,
+                              const char** shm_key, int* shm_fd, size_t* offset,
+                              size_t* byte_size) {
+  auto* h = static_cast<SharedMemoryHandle*>(handle);
+  if (h == nullptr) {
+    return CSHM_ERROR_INVALID_HANDLE;
+  }
+  *base_addr = h->base_addr;
+  *shm_key = h->shm_key.c_str();
+  *shm_fd = h->shm_fd;
+  *offset = h->offset;
+  *byte_size = h->byte_size;
+  return CSHM_SUCCESS;
+}
+
+int SharedMemoryRegionDestroy(CshmHandle handle, int unlink) {
+  auto* h = static_cast<SharedMemoryHandle*>(handle);
+  if (h == nullptr) {
+    return CSHM_ERROR_INVALID_HANDLE;
+  }
+  int rc = CSHM_SUCCESS;
+  if (h->base_addr != nullptr && munmap(h->base_addr, h->byte_size) == -1) {
+    rc = CSHM_ERROR_SHM_UNMAP;
+  }
+  if (h->shm_fd != -1) {
+    close(h->shm_fd);
+  }
+  if (rc == CSHM_SUCCESS && unlink != 0 &&
+      shm_unlink(h->shm_key.c_str()) == -1) {
+    rc = CSHM_ERROR_SHM_UNLINK;
+  }
+  delete h;
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,59 @@
+// C ABI for POSIX system shared memory used by the Python wheel.
+//
+// Parity target: reference
+// src/python/library/tritonclient/utils/shared_memory/shared_memory.h:39-47
+// (SharedMemoryRegionCreate/Set/GetInfo/Destroy with negative error codes).
+// Re-designed (not translated): same contract, plus SharedMemoryRegionOpen for
+// attaching to a region created by another process (needed by the TPU serving
+// harness for cross-process zero-wire-copy staging).
+
+#pragma once
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// Error codes (match the reference's -1..-6 convention,
+// utils/shared_memory/__init__.py:314-340).
+typedef enum {
+  CSHM_SUCCESS = 0,
+  CSHM_ERROR_UNKNOWN = -1,
+  CSHM_ERROR_SHM_OPEN = -2,
+  CSHM_ERROR_SHM_TRUNCATE = -3,
+  CSHM_ERROR_SHM_MMAP = -4,
+  CSHM_ERROR_SHM_UNMAP = -5,
+  CSHM_ERROR_SHM_UNLINK = -6,
+  CSHM_ERROR_INVALID_HANDLE = -7,
+  CSHM_ERROR_OUT_OF_BOUNDS = -8,
+} CshmError;
+
+// Opaque region handle.
+typedef void* CshmHandle;
+
+// Create (shm_open O_CREAT + ftruncate + mmap) a shared memory region named
+// `shm_key` of `byte_size` bytes, mapped read/write.  `triton_shm_name` is the
+// logical name used on the wire for register/unregister RPCs.
+int SharedMemoryRegionCreate(const char* triton_shm_name, const char* shm_key,
+                             size_t byte_size, CshmHandle* handle);
+
+// Attach to an existing region (no O_CREAT, no ftruncate).
+int SharedMemoryRegionOpen(const char* triton_shm_name, const char* shm_key,
+                           size_t byte_size, size_t offset, CshmHandle* handle);
+
+// Copy `byte_size` bytes from `data` into the region at `offset`.
+int SharedMemoryRegionSet(CshmHandle handle, size_t offset, size_t byte_size,
+                          const void* data);
+
+// Introspection: fetch the fields of a handle.
+int GetSharedMemoryHandleInfo(CshmHandle handle, char** base_addr,
+                              const char** shm_key, int* shm_fd, size_t* offset,
+                              size_t* byte_size);
+
+// Unmap; when `unlink` != 0 also shm_unlink the backing object (creator side).
+int SharedMemoryRegionDestroy(CshmHandle handle, int unlink);
+
+#ifdef __cplusplus
+}
+#endif

@@ -1,0 +1,92 @@
+"""DLPack shim tests — numpy/torch as interop oracles (SURVEY.md §4.2)."""
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.utils._dlpack import (
+    DLDataType,
+    DLDataTypeCode,
+    DLDeviceType,
+    dlpack_to_triton_dtype,
+    get_dlpack_capsule,
+    get_managed_tensor,
+    get_dlpack_byte_size,
+    is_contiguous_data,
+    triton_to_dlpack_dtype,
+)
+from triton_client_tpu.utils._shared_memory_tensor import SharedMemoryTensor
+
+
+class TestDtypeMap:
+    def test_roundtrip(self):
+        for t in ["BOOL", "INT8", "INT32", "UINT64", "FP16", "FP32", "FP64", "BF16"]:
+            dl = triton_to_dlpack_dtype(t)
+            assert dlpack_to_triton_dtype(dl) == t
+
+    def test_bf16_is_kdlbfloat(self):
+        dl = triton_to_dlpack_dtype("BF16")
+        assert dl.type_code == DLDataTypeCode.kDLBfloat and dl.bits == 16
+
+    def test_bytes_rejected(self):
+        with pytest.raises(ValueError):
+            triton_to_dlpack_dtype("BYTES")
+
+
+class TestCapsule:
+    def test_numpy_consumes_capsule(self):
+        src = np.arange(12, dtype=np.float32).reshape(3, 4)
+        holder = np.ascontiguousarray(src)
+
+        class _Producer:
+            def __dlpack__(self, **kw):
+                return get_dlpack_capsule(
+                    holder.ctypes.data, holder.shape, "FP32", owner=holder
+                )
+
+            def __dlpack_device__(self):
+                return (DLDeviceType.kDLCPU, 0)
+
+        out = np.from_dlpack(_Producer())
+        np.testing.assert_array_equal(out, src)
+        # Zero-copy: mutating the source shows through the view.
+        holder[0, 0] = 99.0
+        assert out[0, 0] == 99.0
+
+    def test_torch_consumes_shared_memory_tensor(self):
+        import torch
+
+        buf = np.arange(8, dtype=np.int32)
+        t = SharedMemoryTensor(buf.ctypes.data, buf.nbytes, "INT32", (8,), owner=buf)
+        assert t.__dlpack_device__() == (DLDeviceType.kDLCPU, 0)
+        out = torch.from_dlpack(t)
+        assert out.tolist() == list(range(8))
+        buf[3] = -5
+        assert out[3].item() == -5
+
+    def test_managed_tensor_fields(self):
+        buf = np.zeros((2, 5), dtype=np.float64)
+        cap = get_dlpack_capsule(buf.ctypes.data, buf.shape, "FP64", owner=buf)
+        m = get_managed_tensor(cap)
+        assert m.dl_tensor.ndim == 2
+        assert [m.dl_tensor.shape[i] for i in range(2)] == [2, 5]
+        assert get_dlpack_byte_size(m.dl_tensor) == 80
+        assert is_contiguous_data(m.dl_tensor.ndim, m.dl_tensor.shape, m.dl_tensor.strides)
+
+    def test_capsule_gc_releases_owner(self):
+        import gc
+        import weakref
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        buf = np.zeros(4, dtype=np.float32)
+        owner.buf = buf
+        ref = weakref.ref(owner)
+        cap = get_dlpack_capsule(buf.ctypes.data, (4,), "FP32", owner=owner)
+        del owner
+        gc.collect()
+        assert ref() is not None  # capsule keeps owner alive
+        del cap
+        gc.collect()
+        assert ref() is None  # destructor released it

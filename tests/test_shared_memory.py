@@ -1,0 +1,147 @@
+"""System shared-memory tests.
+
+Modeled on reference tests/test_cuda_shared_memory.py's NumpyTest/DLPackTest
+tiers (SURVEY.md §4.2), applied to the host-shm module: numpy set/get
+round-trips, offsets, BYTES-in-shm, DLPack views, cross-process attach, and
+leak accounting via the process-global registry.
+"""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+import triton_client_tpu.utils.shared_memory as shm
+
+
+@pytest.fixture
+def region():
+    key = f"/tcshm_test_{os.getpid()}"
+    h = shm.create_shared_memory_region("test_region", key, 1024)
+    yield h
+    if not h._destroyed:
+        shm.destroy_shared_memory_region(h)
+
+
+class TestNumpyRoundTrip:
+    def test_int32(self, region):
+        arr = np.arange(16, dtype=np.int32)
+        shm.set_shared_memory_region(region, [arr])
+        out = shm.get_contents_as_numpy(region, np.int32, [16])
+        np.testing.assert_array_equal(out, arr)
+
+    def test_two_tensors_back_to_back(self, region):
+        a = np.arange(8, dtype=np.float32)
+        b = np.arange(8, dtype=np.float32) * 2
+        shm.set_shared_memory_region(region, [a, b])
+        np.testing.assert_array_equal(shm.get_contents_as_numpy(region, np.float32, [8]), a)
+        np.testing.assert_array_equal(
+            shm.get_contents_as_numpy(region, np.float32, [8], offset=32), b
+        )
+
+    def test_offset_write(self, region):
+        arr = np.full((4,), 7, dtype=np.int64)
+        shm.set_shared_memory_region(region, [arr], offset=64)
+        out = shm.get_contents_as_numpy(region, np.int64, [4], offset=64)
+        np.testing.assert_array_equal(out, arr)
+
+    def test_bytes_tensor(self, region):
+        arr = np.array([b"one", b"two", b"three"], dtype=np.object_)
+        shm.set_shared_memory_region(region, [arr])
+        out = shm.get_contents_as_numpy(region, np.object_, [3])
+        assert out.tolist() == [b"one", b"two", b"three"]
+
+    def test_bf16(self, region):
+        import ml_dtypes
+
+        arr = np.array([1.5, 2.5, -3.0], dtype=ml_dtypes.bfloat16)
+        shm.set_shared_memory_region(region, [arr])
+        out = shm.get_contents_as_numpy(region, ml_dtypes.bfloat16, [3])
+        np.testing.assert_array_equal(out, arr)
+
+    def test_out_of_bounds_raises(self, region):
+        big = np.zeros(2048, dtype=np.uint8)
+        with pytest.raises(shm.SharedMemoryException):
+            shm.set_shared_memory_region(region, [big])
+
+    def test_non_list_raises(self, region):
+        with pytest.raises(shm.SharedMemoryException):
+            shm.set_shared_memory_region(region, np.zeros(4))
+
+
+class TestDLPack:
+    def test_numpy_view_zero_copy(self, region):
+        arr = np.arange(10, dtype=np.float32)
+        shm.set_shared_memory_region(region, [arr])
+        t = shm.as_shared_memory_tensor(region, "FP32", [10])
+        view = np.from_dlpack(t)
+        np.testing.assert_array_equal(view, arr)
+        # Mutate through shm, observe through the view: proves zero-copy.
+        arr2 = np.full((10,), 5.0, dtype=np.float32)
+        shm.set_shared_memory_region(region, [arr2])
+        np.testing.assert_array_equal(view, arr2)
+
+    def test_torch_consumes(self, region):
+        import torch
+
+        arr = np.arange(6, dtype=np.int32)
+        shm.set_shared_memory_region(region, [arr])
+        t = torch.from_dlpack(shm.as_shared_memory_tensor(region, "INT32", [6]))
+        assert t.tolist() == list(range(6))
+
+    def test_jax_consumes(self, region):
+        import jax.numpy as jnp
+
+        arr = np.arange(6, dtype=np.float32)
+        shm.set_shared_memory_region(region, [arr])
+        t = shm.as_shared_memory_tensor(region, "FP32", [6])
+        out = jnp.from_dlpack(t, copy=True)
+        np.testing.assert_array_equal(np.asarray(out), arr)
+
+
+def _child_writes(key, byte_size):
+    h = shm.attach_shared_memory_region("peer", key, byte_size)
+    shm.set_shared_memory_region(h, [np.arange(4, dtype=np.int32) * 10])
+    shm.destroy_shared_memory_region(h)
+
+
+class TestCrossProcess:
+    def test_attach_from_other_process(self):
+        key = f"/tcshm_xproc_{os.getpid()}"
+        h = shm.create_shared_memory_region("xproc", key, 64)
+        try:
+            ctx = multiprocessing.get_context("spawn")
+            p = ctx.Process(target=_child_writes, args=(key, 64))
+            p.start()
+            p.join(30)
+            assert p.exitcode == 0
+            out = shm.get_contents_as_numpy(h, np.int32, [4])
+            np.testing.assert_array_equal(out, np.arange(4, dtype=np.int32) * 10)
+        finally:
+            shm.destroy_shared_memory_region(h)
+
+
+class TestRegistry:
+    def test_leak_accounting(self):
+        key = f"/tcshm_reg_{os.getpid()}"
+        before = shm.mapped_shared_memory_regions()
+        h = shm.create_shared_memory_region("reg", key, 32)
+        assert key in shm.mapped_shared_memory_regions()
+        shm.destroy_shared_memory_region(h)
+        assert shm.mapped_shared_memory_regions() == before
+
+    def test_create_only_conflict(self):
+        key = f"/tcshm_co_{os.getpid()}"
+        h = shm.create_shared_memory_region("co", key, 32)
+        try:
+            with pytest.raises(shm.SharedMemoryException):
+                shm.create_shared_memory_region("co2", key, 32, create_only=True)
+        finally:
+            shm.destroy_shared_memory_region(h)
+
+    def test_double_destroy_is_noop(self):
+        key = f"/tcshm_dd_{os.getpid()}"
+        h = shm.create_shared_memory_region("dd", key, 32)
+        shm.destroy_shared_memory_region(h)
+        shm.destroy_shared_memory_region(h)

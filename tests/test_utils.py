@@ -1,0 +1,152 @@
+"""Unit tests for the protocol core (dtype maps, BYTES/BF16 wire format).
+
+Modeled on the reference's pure-unit tier (SURVEY.md §4.1); wire-format
+vectors are asserted against hand-packed little-endian bytes so they pin the
+v2 protocol, not our own implementation.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_triton_dtype,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    serialized_byte_size,
+    triton_to_np_dtype,
+)
+
+import ml_dtypes
+
+
+class TestDtypeMaps:
+    @pytest.mark.parametrize(
+        "np_dtype,triton",
+        [
+            (np.bool_, "BOOL"),
+            (np.int8, "INT8"),
+            (np.int16, "INT16"),
+            (np.int32, "INT32"),
+            (np.int64, "INT64"),
+            (np.uint8, "UINT8"),
+            (np.uint16, "UINT16"),
+            (np.uint32, "UINT32"),
+            (np.uint64, "UINT64"),
+            (np.float16, "FP16"),
+            (np.float32, "FP32"),
+            (np.float64, "FP64"),
+            (np.object_, "BYTES"),
+            (ml_dtypes.bfloat16, "BF16"),
+        ],
+    )
+    def test_roundtrip(self, np_dtype, triton):
+        assert np_to_triton_dtype(np_dtype) == triton
+        back = triton_to_np_dtype(triton)
+        assert back == np.dtype(np_dtype)
+
+    def test_string_kinds_map_to_bytes(self):
+        assert np_to_triton_dtype(np.dtype("S8")) == "BYTES"
+        assert np_to_triton_dtype(np.dtype("U8")) == "BYTES"
+
+    def test_bf16_is_native_dtype(self):
+        # TPU-first: BF16 is a usable numpy dtype (ml_dtypes), unlike the
+        # reference which returns None and shims through float32.
+        assert triton_to_np_dtype("BF16") == np.dtype(ml_dtypes.bfloat16)
+
+
+class TestBytesTensor:
+    def test_wire_format_exact(self):
+        arr = np.array([b"ab", b"", b"xyz"], dtype=np.object_)
+        ser = serialize_byte_tensor(arr)
+        expected = b"\x02\x00\x00\x00ab" + b"\x00\x00\x00\x00" + b"\x03\x00\x00\x00xyz"
+        assert ser.tobytes() == expected
+
+    def test_roundtrip_bytes_and_str(self):
+        arr = np.array([b"hello", "world", b"\x00\xff"], dtype=np.object_)
+        out = deserialize_bytes_tensor(serialize_byte_tensor(arr).tobytes())
+        assert out.tolist() == [b"hello", b"world", b"\x00\xff"]
+
+    def test_row_major_flatten(self):
+        arr = np.array([[b"a", b"b"], [b"c", b"d"]], dtype=np.object_)
+        out = deserialize_bytes_tensor(serialize_byte_tensor(arr).tobytes())
+        assert out.tolist() == [b"a", b"b", b"c", b"d"]
+
+    def test_unicode(self):
+        arr = np.array(["héllo", "wörld"], dtype=np.object_)
+        out = deserialize_bytes_tensor(serialize_byte_tensor(arr).tobytes())
+        assert out.tolist() == ["héllo".encode("utf-8"), "wörld".encode("utf-8")]
+
+    def test_empty(self):
+        arr = np.array([], dtype=np.object_)
+        assert serialize_byte_tensor(arr).size == 0
+
+    def test_invalid_dtype_raises(self):
+        with pytest.raises(InferenceServerException):
+            serialize_byte_tensor(np.zeros((2,), dtype=np.float32))
+
+    def test_truncated_buffer_raises(self):
+        good = serialize_byte_tensor(np.array([b"abcdef"], dtype=np.object_)).tobytes()
+        with pytest.raises(InferenceServerException):
+            deserialize_bytes_tensor(good[:-1])
+
+    def test_serialized_byte_size(self):
+        arr = np.array([b"ab", b"cdef"], dtype=np.object_)
+        assert serialized_byte_size(arr) == 4 + 2 + 4 + 4
+        assert serialized_byte_size(np.zeros((3, 4), dtype=np.int32)) == 48
+
+
+class TestBF16Tensor:
+    def test_native_bf16_roundtrip(self):
+        arr = np.array([1.5, -2.25, 0.0, 3.0e38], dtype=ml_dtypes.bfloat16)
+        out = deserialize_bf16_tensor(serialize_bf16_tensor(arr).tobytes())
+        assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(out, arr)
+
+    def test_f32_input_accepted(self):
+        arr = np.array([1.0, 2.0, -0.5], dtype=np.float32)
+        out = deserialize_bf16_tensor(serialize_bf16_tensor(arr).tobytes())
+        np.testing.assert_array_equal(out.astype(np.float32), arr)
+
+    def test_wire_is_two_bytes_per_element(self):
+        arr = np.ones((4,), dtype=ml_dtypes.bfloat16)
+        assert serialize_bf16_tensor(arr).size == 8
+
+    def test_wire_format_exact(self):
+        # bf16(1.0) = 0x3F80, little-endian on the wire: 80 3F
+        arr = np.array([1.0], dtype=ml_dtypes.bfloat16)
+        assert serialize_bf16_tensor(arr).tobytes() == b"\x80\x3f"
+
+    def test_invalid_dtype_raises(self):
+        with pytest.raises(InferenceServerException):
+            serialize_bf16_tensor(np.zeros((2,), dtype=np.int32))
+
+
+class TestException:
+    def test_fields(self):
+        e = InferenceServerException("boom", status="StatusCode.INTERNAL", debug_details="d")
+        assert e.message() == "boom"
+        assert e.status() == "StatusCode.INTERNAL"
+        assert e.debug_details() == "d"
+        assert "[StatusCode.INTERNAL] boom" == str(e)
+
+
+class TestPluginBase:
+    def test_register_and_call(self):
+        from triton_client_tpu import BasicAuth, InferenceServerClientBase, Request
+
+        c = InferenceServerClientBase()
+        c.register_plugin(BasicAuth("user", "pass"))
+        req = Request({})
+        c._call_plugin(req)
+        assert req.headers["authorization"] == "Basic dXNlcjpwYXNz"
+        assert c.plugin() is not None
+        with pytest.raises(RuntimeError):
+            c.register_plugin(BasicAuth("a", "b"))
+        c.unregister_plugin()
+        with pytest.raises(RuntimeError):
+            c.unregister_plugin()

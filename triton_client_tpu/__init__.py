@@ -1,0 +1,36 @@
+"""triton_client_tpu — a TPU-native inference client framework.
+
+A brand-new implementation of the capabilities of the Triton Inference Server
+client libraries (reference: ksmooi/triton_client), designed TPU-first:
+
+* Python ``InferenceServerClient`` for HTTP/REST and gRPC speaking the
+  KServe/Triton **v2 inference protocol** (sync, async, asyncio, bidirectional
+  streaming with sequence support) — ``triton_client_tpu.http`` / ``.grpc``.
+* Full tensor request/response model (``InferInput`` /
+  ``InferRequestedOutput`` / ``InferResult``) with BYTES and native-BF16
+  handling — per-protocol modules.
+* System shared memory utilities (POSIX shm via a C shim) —
+  ``triton_client_tpu.utils.shared_memory``.
+* ``xla_shared_memory`` — the TPU replacement for the reference's CUDA-IPC
+  data path: regions are XLA/PjRt device buffers (``jax.Array``) exported via
+  DLPack, registered with a co-located TPU-backend server so tensor data never
+  crosses the wire — ``triton_client_tpu.utils.xla_shared_memory``.
+* A JAX/pjit serving harness + model zoo for hermetic end-to-end testing —
+  ``triton_client_tpu.server`` / ``.models``.
+* A perf_analyzer-equivalent load generator — ``triton_client_tpu.perf``.
+"""
+
+__version__ = "0.1.0"
+
+from ._auth import BasicAuth
+from ._client import InferenceServerClientBase
+from ._plugin import InferenceServerClientPlugin
+from ._request import Request
+
+__all__ = [
+    "BasicAuth",
+    "InferenceServerClientBase",
+    "InferenceServerClientPlugin",
+    "Request",
+    "__version__",
+]

@@ -1,0 +1,22 @@
+"""HTTP basic-auth plugin (reference ``tritonclient/_auth.py:33-46``)."""
+
+from __future__ import annotations
+
+import base64
+
+from ._plugin import InferenceServerClientPlugin
+from ._request import Request
+
+
+class BasicAuth(InferenceServerClientPlugin):
+    """Adds ``authorization: Basic <b64(user:pass)>`` to every request.
+
+    Works with both HTTP clients (literal header) and gRPC clients (header is
+    carried as call metadata)."""
+
+    def __init__(self, username: str, password: str):
+        encoded = base64.b64encode(f"{username}:{password}".encode("utf-8")).decode("ascii")
+        self._auth_header = f"Basic {encoded}"
+
+    def __call__(self, request: Request) -> None:
+        request.headers["authorization"] = self._auth_header

@@ -1,0 +1,41 @@
+"""Shared client base with plugin support.
+
+Reference: ``tritonclient/_client.py`` (:35-86) — a registered plugin is
+invoked (via ``_call_plugin``) before every request so it can mutate headers
+(e.g. inject auth).  Exactly one plugin may be registered at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ._plugin import InferenceServerClientPlugin
+from ._request import Request
+
+
+class InferenceServerClientBase:
+    def __init__(self):
+        self._plugin: Optional[InferenceServerClientPlugin] = None
+
+    def _call_plugin(self, request: Request) -> None:
+        if self._plugin is not None:
+            self._plugin(request)
+
+    def register_plugin(self, plugin: InferenceServerClientPlugin) -> None:
+        """Register ``plugin``; raises if one is already registered
+        (reference _client.py:42-66)."""
+        if self._plugin is not None:
+            raise RuntimeError("A plugin is already registered. Unregister it first.")
+        if not isinstance(plugin, InferenceServerClientPlugin):
+            raise ValueError("plugin must be an InferenceServerClientPlugin")
+        self._plugin = plugin
+
+    def plugin(self) -> Optional[InferenceServerClientPlugin]:
+        """Return the registered plugin, or None (reference _client.py:68-75)."""
+        return self._plugin
+
+    def unregister_plugin(self) -> None:
+        """Unregister the plugin; raises if none registered (reference :77-86)."""
+        if self._plugin is None:
+            raise RuntimeError("No plugin is registered.")
+        self._plugin = None

@@ -1,0 +1,63 @@
+"""Locate-or-build helper for the framework's native (C++) shared libraries.
+
+The wheel ships prebuilt ``.so``s next to their Python consumers (like the
+reference wheel bundles ``libcshm.so``, setup.py:78-80).  In a source checkout
+the library is built on first use with ``g++`` into ``native/build/`` so tests
+and examples are hermetic — no separate build step required.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LOCK = threading.Lock()
+
+
+def find_or_build(
+    lib_name: str,
+    sources: List[str],
+    extra_flags: Optional[List[str]] = None,
+) -> str:
+    """Return an absolute path to ``lib_name`` (e.g. ``libcshm.so``).
+
+    Search order: alongside this package (wheel layout), then
+    ``native/build/`` (source layout, compiled on demand).
+    """
+    packaged = os.path.join(os.path.dirname(os.path.abspath(__file__)), lib_name)
+    if os.path.exists(packaged):
+        return packaged
+
+    built = os.path.join(_BUILD_DIR, lib_name)
+    srcs = [os.path.join(_REPO_ROOT, s) for s in sources]
+    with _LOCK:
+        if _is_fresh(built, srcs):
+            return built
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = [
+            "g++",
+            "-std=c++17",
+            "-O2",
+            "-fPIC",
+            "-shared",
+            "-Wall",
+            "-Wextra",
+            *srcs,
+            "-o",
+            built,
+            "-lrt",
+            "-pthread",
+        ] + (extra_flags or [])
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return built
+
+
+def _is_fresh(lib_path: str, sources: List[str]) -> bool:
+    if not os.path.exists(lib_path):
+        return False
+    lib_mtime = os.path.getmtime(lib_path)
+    return all(os.path.getmtime(s) <= lib_mtime for s in sources if os.path.exists(s))

@@ -1,0 +1,17 @@
+"""Client plugin abstract base (reference ``tritonclient/_plugin.py:38-49``)."""
+
+from __future__ import annotations
+
+import abc
+
+from ._request import Request
+
+
+class InferenceServerClientPlugin(abc.ABC):
+    """Every plugin must implement ``__call__`` and mutate ``request.headers``
+    in place.  The plugin is invoked by the client right before every HTTP
+    request / gRPC call (headers become gRPC metadata)."""
+
+    @abc.abstractmethod
+    def __call__(self, request: Request) -> None:
+        ...

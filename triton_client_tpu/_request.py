@@ -1,0 +1,12 @@
+"""Request object passed to client plugins (reference ``tritonclient/_request.py:29-40``).
+
+Deliberately minimal: plugins see and mutate only the headers mapping."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class Request:
+    def __init__(self, headers: Dict[str, str]):
+        self.headers = headers

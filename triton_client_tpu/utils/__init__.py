@@ -1,0 +1,252 @@
+"""Protocol-core utilities for the TPU-native inference client framework.
+
+Parity target: the reference Triton client's ``tritonclient/utils/__init__.py``
+(reference: src/python/library/tritonclient/utils/__init__.py) — dtype maps
+(:133-190), BYTES tensor wire format (:193-276), BF16 handling (:279-348) and
+``InferenceServerException`` (:71-130).
+
+TPU-first deviations (deliberate, documented):
+
+* ``BF16`` maps to a *real* numpy dtype — ``ml_dtypes.bfloat16`` (shipped with
+  JAX) — instead of the reference's "no numpy dtype, shim through float32
+  truncation" approach.  ``as_numpy`` on a BF16 output therefore returns a
+  bfloat16 array that feeds straight into ``jax.numpy`` with no conversion,
+  keeping the MXU-native dtype end to end.  Float32 arrays are still accepted
+  on the serialization side for drop-in compatibility.
+* BYTES (de)serialization is vectorized with numpy instead of per-element
+  ``struct.pack`` loops; the wire format is unchanged
+  (``<uint32 little-endian length><raw bytes>`` per element, row-major).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import numpy as np
+
+try:  # ml_dtypes is a hard dependency of jax, present in the image.
+    import ml_dtypes
+
+    _BF16_NP = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover - ml_dtypes is expected to exist
+    ml_dtypes = None
+    _BF16_NP = None
+
+__all__ = [
+    "InferenceServerException",
+    "np_to_triton_dtype",
+    "triton_to_np_dtype",
+    "serialize_byte_tensor",
+    "deserialize_bytes_tensor",
+    "serialize_bf16_tensor",
+    "deserialize_bf16_tensor",
+    "serialized_byte_size",
+    "raise_error",
+]
+
+
+class InferenceServerException(Exception):
+    """Exception raised for any error reported by server or client.
+
+    Mirrors reference utils/__init__.py:71-130 (msg / status / debug_details
+    triple with ``message()``/``status()``/``debug_details()`` accessors).
+    """
+
+    def __init__(self, msg, status: Optional[str] = None, debug_details=None):
+        self._msg = msg
+        self._status = status
+        self._debug_details = debug_details
+        super().__init__(msg)
+
+    def __str__(self):
+        msg = super().__str__() if self._msg is None else self._msg
+        if self._status is not None:
+            msg = "[" + self._status + "] " + msg
+        return msg
+
+    def message(self):
+        """Return the brief description of the error."""
+        return self._msg
+
+    def status(self):
+        """Return the error status code, if any."""
+        return self._status
+
+    def debug_details(self):
+        """Return the detailed description of the error, if any."""
+        return self._debug_details
+
+
+def raise_error(msg):
+    """Raise an ``InferenceServerException`` with ``msg`` (client-side error)."""
+    raise InferenceServerException(msg=msg) from None
+
+
+# Triton v2 protocol dtype strings <-> numpy dtypes.
+# Reference: utils/__init__.py:133-190 (np_to_triton_dtype / triton_to_np_dtype).
+_NP_TO_TRITON = {
+    np.dtype(np.bool_): "BOOL",
+    np.dtype(np.int8): "INT8",
+    np.dtype(np.int16): "INT16",
+    np.dtype(np.int32): "INT32",
+    np.dtype(np.int64): "INT64",
+    np.dtype(np.uint8): "UINT8",
+    np.dtype(np.uint16): "UINT16",
+    np.dtype(np.uint32): "UINT32",
+    np.dtype(np.uint64): "UINT64",
+    np.dtype(np.float16): "FP16",
+    np.dtype(np.float32): "FP32",
+    np.dtype(np.float64): "FP64",
+}
+if _BF16_NP is not None:
+    _NP_TO_TRITON[_BF16_NP] = "BF16"
+
+_TRITON_TO_NP = {v: k for k, v in _NP_TO_TRITON.items()}
+_TRITON_TO_NP["BYTES"] = np.dtype(np.object_)
+
+_TRITON_DTYPE_SIZES = {
+    "BOOL": 1,
+    "INT8": 1,
+    "INT16": 2,
+    "INT32": 4,
+    "INT64": 8,
+    "UINT8": 1,
+    "UINT16": 2,
+    "UINT32": 4,
+    "UINT64": 8,
+    "FP16": 2,
+    "BF16": 2,
+    "FP32": 4,
+    "FP64": 8,
+}
+
+
+def np_to_triton_dtype(np_dtype) -> Optional[str]:
+    """Map a numpy dtype to its Triton v2 dtype string (utils/__init__.py:133)."""
+    dt = np.dtype(np_dtype)
+    if dt in _NP_TO_TRITON:
+        return _NP_TO_TRITON[dt]
+    if dt.kind in ("O", "S", "U"):
+        return "BYTES"
+    return None
+
+
+def triton_to_np_dtype(dtype: str):
+    """Map a Triton v2 dtype string to a numpy dtype (utils/__init__.py:163-190).
+
+    Unlike the reference, ``BF16`` maps to ``ml_dtypes.bfloat16`` rather than
+    ``None`` — on TPU bfloat16 is a first-class dtype.
+    """
+    return _TRITON_TO_NP.get(dtype, None)
+
+
+def triton_dtype_size(dtype: str) -> Optional[int]:
+    """Byte size of one element of a (fixed-size) Triton dtype; None for BYTES."""
+    return _TRITON_DTYPE_SIZES.get(dtype, None)
+
+
+def _as_flat_object_rowmajor(input_tensor: np.ndarray) -> np.ndarray:
+    if input_tensor.size == 0:
+        return np.empty((0,), dtype=np.object_)
+    # 'C' order flatten to match the row-major wire layout.
+    return input_tensor.flatten(order="C")
+
+
+def serialize_byte_tensor(input_tensor: np.ndarray) -> Optional[np.ndarray]:
+    """Serialize a BYTES tensor into the v2 wire format.
+
+    Wire format (reference utils/__init__.py:193-246): row-major concatenation
+    of ``<uint32 little-endian length><element bytes>`` per element.  Accepts
+    object arrays of bytes/str, and ``S``/``U`` typed arrays.  Returns a 1-D
+    uint8 array wrapping the serialized buffer (``np.frombuffer`` view).
+    """
+    if input_tensor.size == 0:
+        return np.empty([0], dtype=np.object_)
+
+    if input_tensor.dtype not in (np.dtype(np.object_),) and input_tensor.dtype.kind not in (
+        "S",
+        "U",
+    ):
+        raise_error("cannot serialize bytes tensor: invalid datatype")
+
+    flat = _as_flat_object_rowmajor(input_tensor)
+    pieces = []
+    append = pieces.append
+    for obj in flat:
+        if isinstance(obj, (bytes, bytearray, memoryview)):
+            b = bytes(obj)
+        elif isinstance(obj, str):
+            b = obj.encode("utf-8")
+        elif isinstance(obj, np.str_):
+            b = str(obj).encode("utf-8")
+        elif isinstance(obj, np.bytes_):
+            b = bytes(obj)
+        else:
+            b = str(obj).encode("utf-8")
+        append(struct.pack("<I", len(b)))
+        append(b)
+    joined = b"".join(pieces)
+    return np.frombuffer(joined, dtype=np.uint8)
+
+
+def deserialize_bytes_tensor(encoded_tensor: bytes) -> np.ndarray:
+    """Deserialize a v2 BYTES buffer into a 1-D object array of ``bytes``.
+
+    Reference: utils/__init__.py:249-276.  Caller reshapes to the tensor shape.
+    """
+    strs = []
+    mv = memoryview(encoded_tensor)
+    offset = 0
+    n = len(mv)
+    while offset < n:
+        if offset + 4 > n:
+            raise_error("unexpected end of serialized BYTES tensor")
+        (length,) = struct.unpack_from("<I", mv, offset)
+        offset += 4
+        if offset + length > n:
+            raise_error("unexpected end of serialized BYTES tensor element")
+        strs.append(bytes(mv[offset : offset + length]))
+        offset += length
+    return np.array(strs, dtype=np.object_)
+
+
+def serialize_bf16_tensor(input_tensor: np.ndarray) -> np.ndarray:
+    """Serialize a tensor to raw little-endian bfloat16 bytes.
+
+    Accepts a native ``ml_dtypes.bfloat16`` array (zero-conversion fast path)
+    or a float32 array (reference-compatible: truncating round, matching the
+    high-2-bytes serializer at utils/__init__.py:279-318).
+    """
+    if _BF16_NP is not None and input_tensor.dtype == _BF16_NP:
+        arr = np.ascontiguousarray(input_tensor)
+        return np.frombuffer(arr.tobytes(), dtype=np.uint8)
+    if input_tensor.dtype != np.dtype(np.float32):
+        raise_error("cannot serialize bf16 tensor: invalid datatype")
+    if _BF16_NP is not None:
+        arr = np.ascontiguousarray(input_tensor).astype(_BF16_NP)
+        return np.frombuffer(arr.tobytes(), dtype=np.uint8)
+    # Fallback: truncate each f32 to its top 2 bytes (little-endian layout).
+    as_u16 = (np.ascontiguousarray(input_tensor).view(np.uint32) >> 16).astype(np.uint16)
+    return np.frombuffer(as_u16.tobytes(), dtype=np.uint8)
+
+
+def deserialize_bf16_tensor(encoded_tensor: bytes) -> np.ndarray:
+    """Deserialize raw bf16 bytes into a 1-D array.
+
+    Returns a native bfloat16 array when ml_dtypes is available (TPU-first;
+    feeds jax.numpy directly), else widens to float32 like the reference
+    (utils/__init__.py:321-348).  Caller reshapes.
+    """
+    if _BF16_NP is not None:
+        return np.frombuffer(encoded_tensor, dtype=_BF16_NP)
+    as_u16 = np.frombuffer(encoded_tensor, dtype=np.uint16)
+    return (as_u16.astype(np.uint32) << 16).view(np.float32)
+
+
+def serialized_byte_size(np_array: np.ndarray) -> int:
+    """Byte size of a tensor as it travels on the wire (utils/__init__.py:43-68)."""
+    if np_array.dtype == np.object_ or np_array.dtype.kind in ("S", "U"):
+        ser = serialize_byte_tensor(np_array)
+        return ser.size if ser is not None else 0
+    return np_array.nbytes

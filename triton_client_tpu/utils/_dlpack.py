@@ -1,0 +1,246 @@
+"""ctypes re-implementation of the DLPack C ABI.
+
+Parity target: reference ``tritonclient/utils/_dlpack.py`` (structs :74-116,
+capsule management :131-167, dtype map :170-216, helpers :219-272).  Used to
+(a) export host shared-memory regions as DLPack capsules so numpy / torch /
+jax can view them zero-copy, and (b) ingest tensors from any framework that
+implements ``__dlpack__`` into shared-memory regions.
+
+Only ctypes + the CPython capsule API are used — no external dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Optional, Sequence, Tuple
+
+_c_str_dltensor = b"dltensor"
+_c_str_used_dltensor = b"used_dltensor"
+
+
+class DLDeviceType:
+    """DLPack device type codes (dlpack.h).  kDLCPU covers host shm regions."""
+
+    kDLCPU = 1
+    kDLCUDA = 2
+    kDLCUDAHost = 3
+    kDLOpenCL = 4
+    kDLVulkan = 7
+    kDLMetal = 8
+    kDLVPI = 9
+    kDLROCM = 10
+    kDLROCMHost = 11
+    kDLExtDev = 12
+    kDLCUDAManaged = 13
+    kDLOneAPI = 14
+
+
+class DLDataTypeCode:
+    kDLInt = 0
+    kDLUInt = 1
+    kDLFloat = 2
+    kDLOpaqueHandle = 3
+    kDLBfloat = 4
+    kDLComplex = 5
+    kDLBool = 6
+
+
+class DLDevice(ctypes.Structure):
+    _fields_ = [
+        ("device_type", ctypes.c_int),
+        ("device_id", ctypes.c_int),
+    ]
+
+
+class DLDataType(ctypes.Structure):
+    _fields_ = [
+        ("type_code", ctypes.c_uint8),
+        ("bits", ctypes.c_uint8),
+        ("lanes", ctypes.c_uint16),
+    ]
+
+
+class DLTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("device", DLDevice),
+        ("ndim", ctypes.c_int),
+        ("dtype", DLDataType),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("strides", ctypes.POINTER(ctypes.c_int64)),
+        ("byte_offset", ctypes.c_uint64),
+    ]
+
+
+class DLManagedTensor(ctypes.Structure):
+    pass
+
+
+DLManagedTensorDeleter = ctypes.CFUNCTYPE(None, ctypes.POINTER(DLManagedTensor))
+
+DLManagedTensor._fields_ = [
+    ("dl_tensor", DLTensor),
+    ("manager_ctx", ctypes.c_void_p),
+    ("deleter", DLManagedTensorDeleter),
+]
+
+
+# Triton v2 dtype string -> DLDataType (type_code, bits).
+# Reference: _dlpack.py:170-216 (incl. kDLBfloat for BF16).
+_TRITON_TO_DLPACK = {
+    "BOOL": (DLDataTypeCode.kDLBool, 8),
+    "INT8": (DLDataTypeCode.kDLInt, 8),
+    "INT16": (DLDataTypeCode.kDLInt, 16),
+    "INT32": (DLDataTypeCode.kDLInt, 32),
+    "INT64": (DLDataTypeCode.kDLInt, 64),
+    "UINT8": (DLDataTypeCode.kDLUInt, 8),
+    "UINT16": (DLDataTypeCode.kDLUInt, 16),
+    "UINT32": (DLDataTypeCode.kDLUInt, 32),
+    "UINT64": (DLDataTypeCode.kDLUInt, 64),
+    "FP16": (DLDataTypeCode.kDLFloat, 16),
+    "FP32": (DLDataTypeCode.kDLFloat, 32),
+    "FP64": (DLDataTypeCode.kDLFloat, 64),
+    "BF16": (DLDataTypeCode.kDLBfloat, 16),
+}
+
+_DLPACK_TO_TRITON = {v: k for k, v in _TRITON_TO_DLPACK.items()}
+
+
+def triton_to_dlpack_dtype(dtype: str) -> DLDataType:
+    try:
+        code, bits = _TRITON_TO_DLPACK[dtype]
+    except KeyError:
+        raise ValueError(f"DLPack does not support Triton dtype {dtype!r} (BYTES is host-only)")
+    return DLDataType(type_code=code, bits=bits, lanes=1)
+
+
+def dlpack_to_triton_dtype(dtype: DLDataType) -> Optional[str]:
+    if dtype.lanes != 1:
+        return None
+    return _DLPACK_TO_TRITON.get((dtype.type_code, dtype.bits), None)
+
+
+class _DataViewContext:
+    """Keeps the exporting object alive while a capsule (or a consumer that
+    stole the managed tensor) still references its memory.
+
+    Reference: ``DataViewContext`` at _dlpack.py:131-167 — same refcount
+    scheme: one hold per capsule, released from the capsule destructor or the
+    managed-tensor deleter, whichever fires.
+    """
+
+    def __init__(self, owner: Any, shape: Sequence[int]):
+        self._owner = owner
+        self._shape = (ctypes.c_int64 * len(shape))(*shape)
+
+    def hold(self) -> int:
+        ctypes.pythonapi.Py_IncRef(ctypes.py_object(self))
+        return id(self)
+
+    @staticmethod
+    def release(handle: int) -> None:
+        obj = ctypes.cast(ctypes.c_void_p(handle), ctypes.py_object)
+        ctypes.pythonapi.Py_DecRef(obj)
+
+
+ctypes.pythonapi.Py_IncRef.argtypes = [ctypes.py_object]
+ctypes.pythonapi.Py_DecRef.argtypes = [ctypes.py_object]
+ctypes.pythonapi.PyMem_RawMalloc.restype = ctypes.c_void_p
+ctypes.pythonapi.PyMem_RawMalloc.argtypes = [ctypes.c_size_t]
+ctypes.pythonapi.PyMem_RawFree.argtypes = [ctypes.c_void_p]
+ctypes.pythonapi.PyCapsule_New.restype = ctypes.py_object
+ctypes.pythonapi.PyCapsule_New.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p]
+ctypes.pythonapi.PyCapsule_GetPointer.restype = ctypes.c_void_p
+ctypes.pythonapi.PyCapsule_GetPointer.argtypes = [ctypes.py_object, ctypes.c_char_p]
+ctypes.pythonapi.PyCapsule_IsValid.restype = ctypes.c_int
+ctypes.pythonapi.PyCapsule_IsValid.argtypes = [ctypes.py_object, ctypes.c_char_p]
+ctypes.pythonapi.PyCapsule_SetName.restype = ctypes.c_int
+ctypes.pythonapi.PyCapsule_SetName.argtypes = [ctypes.py_object, ctypes.c_char_p]
+
+PyCapsuleDestructor = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+@ctypes.CFUNCTYPE(None, ctypes.POINTER(DLManagedTensor))
+def _managed_tensor_deleter(handle) -> None:
+    managed = handle.contents
+    _DataViewContext.release(managed.manager_ctx)
+    ctypes.pythonapi.PyMem_RawFree(ctypes.cast(handle, ctypes.c_void_p))
+
+
+@PyCapsuleDestructor
+def _capsule_destructor(capsule_ptr: ctypes.c_void_p) -> None:
+    # Only delete if the consumer never took ownership (name still "dltensor").
+    pycapsule = ctypes.cast(capsule_ptr, ctypes.py_object)
+    if ctypes.pythonapi.PyCapsule_IsValid(pycapsule, _c_str_dltensor):
+        managed_ptr = ctypes.pythonapi.PyCapsule_GetPointer(pycapsule, _c_str_dltensor)
+        managed = ctypes.cast(managed_ptr, ctypes.POINTER(DLManagedTensor))
+        managed.contents.deleter(managed)
+
+
+def get_dlpack_capsule(
+    data_ptr: int,
+    shape: Sequence[int],
+    triton_dtype: str,
+    owner: Any,
+    device_type: int = DLDeviceType.kDLCPU,
+    device_id: int = 0,
+):
+    """Produce a PyCapsule("dltensor") viewing ``data_ptr`` as a contiguous
+    tensor of ``shape`` / ``triton_dtype``, keeping ``owner`` alive.
+
+    Reference: ``get_dlpack_capsule`` _dlpack.py:245-262.
+    """
+    ctx = _DataViewContext(owner, shape)
+    size = ctypes.pythonapi.PyMem_RawMalloc(ctypes.sizeof(DLManagedTensor))
+    managed = ctypes.cast(size, ctypes.POINTER(DLManagedTensor))
+    m = managed.contents
+    m.dl_tensor.data = ctypes.c_void_p(data_ptr)
+    m.dl_tensor.device = DLDevice(device_type, device_id)
+    m.dl_tensor.ndim = len(ctx._shape)
+    m.dl_tensor.dtype = triton_to_dlpack_dtype(triton_dtype)
+    m.dl_tensor.shape = ctypes.cast(ctx._shape, ctypes.POINTER(ctypes.c_int64))
+    m.dl_tensor.strides = ctypes.POINTER(ctypes.c_int64)()  # NULL => C-contiguous
+    m.dl_tensor.byte_offset = 0
+    m.manager_ctx = ctx.hold()
+    m.deleter = _managed_tensor_deleter
+    return ctypes.pythonapi.PyCapsule_New(size, _c_str_dltensor, _capsule_destructor)
+
+
+def get_managed_tensor(dlpack_capsule) -> DLManagedTensor:
+    """Consumer side: extract the DLManagedTensor from a capsule
+    (reference _dlpack.py:265-272).  Does NOT mark the capsule consumed."""
+    ptr = ctypes.pythonapi.PyCapsule_GetPointer(dlpack_capsule, _c_str_dltensor)
+    return ctypes.cast(ptr, ctypes.POINTER(DLManagedTensor)).contents
+
+
+def mark_capsule_consumed(dlpack_capsule) -> None:
+    """Rename the capsule to "used_dltensor" — consumer took ownership of the
+    managed tensor and is responsible for calling its deleter."""
+    ctypes.pythonapi.PyCapsule_SetName(dlpack_capsule, _c_str_used_dltensor)
+
+
+def is_contiguous_data(
+    ndim: int, shape: "ctypes.POINTER(ctypes.c_int64)", strides: "ctypes.POINTER(ctypes.c_int64)"
+) -> bool:
+    """True when strides describe a C-contiguous layout (NULL strides => yes).
+    Reference: _dlpack.py:219-232."""
+    if not strides:
+        return True
+    expected = 1
+    for i in reversed(range(ndim)):
+        if shape[i] != 1 and strides[i] != expected:
+            return False
+        expected *= shape[i]
+    return True
+
+
+def get_dlpack_byte_size(tensor: DLTensor) -> int:
+    """Total bytes of a DLTensor (reference _dlpack.py:235-242)."""
+    n = 1
+    for i in range(tensor.ndim):
+        n *= tensor.shape[i]
+    return n * ((tensor.dtype.bits * tensor.dtype.lanes + 7) // 8)
+
+
+def get_dlpack_tensor_shape(tensor: DLTensor) -> Tuple[int, ...]:
+    return tuple(tensor.shape[i] for i in range(tensor.ndim))

@@ -1,0 +1,62 @@
+"""``SharedMemoryTensor`` — a ``__dlpack__`` view over a shared-memory region.
+
+Parity target: reference ``tritonclient/utils/_shared_memory_tensor.py``
+(:40-88): frameworks (numpy/torch/jax) consume a registered region zero-copy
+via the array-interchange protocol.  The reference maps ``device_id == -1`` to
+kDLCPU and otherwise kDLCUDA (:59-62); here host (system) shm is kDLCPU and
+TPU-resident regions are handled by ``xla_shared_memory`` which exports the
+underlying ``jax.Array``'s own ``__dlpack__`` instead of synthesizing one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from . import _dlpack
+
+
+class SharedMemoryTensor:
+    def __init__(
+        self,
+        data_ptr: int,
+        byte_size: int,
+        triton_dtype: str,
+        shape: Sequence[int],
+        owner: Any,
+        device_type: int = _dlpack.DLDeviceType.kDLCPU,
+        device_id: int = 0,
+    ):
+        self._data_ptr = data_ptr
+        self._byte_size = byte_size
+        self._triton_dtype = triton_dtype
+        self._shape = tuple(int(s) for s in shape)
+        self._owner = owner
+        self._device_type = device_type
+        self._device_id = device_id
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def triton_dtype(self):
+        return self._triton_dtype
+
+    @property
+    def byte_size(self):
+        return self._byte_size
+
+    def __dlpack__(self, *, stream=None, **kwargs):
+        # Host memory: any stream argument is irrelevant; accept and ignore
+        # (reference :64-78 validates stream None/-1/1 for CPU).
+        return _dlpack.get_dlpack_capsule(
+            self._data_ptr,
+            self._shape,
+            self._triton_dtype,
+            owner=self._owner,
+            device_type=self._device_type,
+            device_id=max(self._device_id, 0),
+        )
+
+    def __dlpack_device__(self):
+        return (self._device_type, max(self._device_id, 0))

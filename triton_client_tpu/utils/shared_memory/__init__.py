@@ -1,0 +1,292 @@
+"""System (POSIX) shared-memory utilities.
+
+Parity target: reference ``tritonclient/utils/shared_memory/__init__.py``
+(ctypes binding onto ``libcshm.so`` :48-52; create/set/get/destroy :93-311;
+process-global registry :74; error mapping :314-340).  The region data path
+lets a client and a co-located server exchange tensor contents without the
+bytes ever crossing the HTTP/gRPC wire.
+
+On a TPU VM this is host-RAM shm — the staging half of the TPU data path; the
+device half is ``triton_client_tpu.utils.xla_shared_memory``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+import numpy as np
+
+from .. import _dlpack, deserialize_bytes_tensor, serialize_byte_tensor, triton_to_np_dtype
+from .._shared_memory_tensor import SharedMemoryTensor
+from ..._native import find_or_build
+
+__all__ = [
+    "SharedMemoryException",
+    "create_shared_memory_region",
+    "set_shared_memory_region",
+    "get_contents_as_numpy",
+    "as_shared_memory_tensor",
+    "mapped_shared_memory_regions",
+    "destroy_shared_memory_region",
+]
+
+
+class SharedMemoryException(Exception):
+    """Exception indicating a non-Success status from the C shim
+    (reference :314-340 — same negative error-code convention)."""
+
+    ERROR_MESSAGES = {
+        -1: "unknown shared memory error",
+        -2: "unable to open/create shared memory object",
+        -3: "unable to set size of shared memory object",
+        -4: "unable to map shared memory object",
+        -5: "unable to unmap shared memory object",
+        -6: "unable to unlink shared memory object",
+        -7: "invalid shared memory handle",
+        -8: "write exceeds shared memory region bounds",
+    }
+
+    def __init__(self, err: int):
+        self.err = err
+        msg = self.ERROR_MESSAGES.get(err, "unknown error")
+        super().__init__(msg)
+
+
+_cshm = None
+
+
+def _lib():
+    global _cshm
+    if _cshm is None:
+        path = find_or_build("libcshm.so", ["native/cshm/shared_memory.cc"])
+        lib = ctypes.CDLL(path)
+        lib.SharedMemoryRegionCreate.restype = ctypes.c_int
+        lib.SharedMemoryRegionCreate.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.SharedMemoryRegionOpen.restype = ctypes.c_int
+        lib.SharedMemoryRegionOpen.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.SharedMemoryRegionSet.restype = ctypes.c_int
+        lib.SharedMemoryRegionSet.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+        ]
+        lib.GetSharedMemoryHandleInfo.restype = ctypes.c_int
+        lib.GetSharedMemoryHandleInfo.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.SharedMemoryRegionDestroy.restype = ctypes.c_int
+        lib.SharedMemoryRegionDestroy.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _cshm = lib
+    return _cshm
+
+
+class SharedMemoryRegionHandle:
+    """Opaque handle for a mapped region.  Carries the logical (wire) name,
+    the shm key, byte size and whether this process created (owns) it."""
+
+    def __init__(self, c_handle, triton_shm_name: str, shm_key: str, byte_size: int, owner: bool):
+        self._c_handle = c_handle
+        self.triton_shm_name = triton_shm_name
+        self.shm_key = shm_key
+        self.byte_size = byte_size
+        self.owner = owner
+        self._destroyed = False
+
+    def base_addr(self) -> int:
+        base = ctypes.c_void_p()
+        key = ctypes.c_char_p()
+        fd = ctypes.c_int()
+        offset = ctypes.c_size_t()
+        size = ctypes.c_size_t()
+        err = _lib().GetSharedMemoryHandleInfo(
+            self._c_handle,
+            ctypes.byref(base),
+            ctypes.byref(key),
+            ctypes.byref(fd),
+            ctypes.byref(offset),
+            ctypes.byref(size),
+        )
+        if err != 0:
+            raise SharedMemoryException(err)
+        return base.value
+
+
+# Process-global registry of mapped regions, keyed by shm key
+# (reference `mapped_shm_regions` list at :74).
+_mapped_shm_regions: List[str] = []
+
+
+def create_shared_memory_region(
+    triton_shm_name: str,
+    shm_key: str,
+    byte_size: int,
+    create_only: bool = False,
+) -> SharedMemoryRegionHandle:
+    """Create (or attach to) the POSIX shm region ``shm_key``.
+
+    Reference semantics (:93-127): creates the region if absent; when
+    ``create_only`` is True and the region already exists, raises.
+    """
+    lib = _lib()
+    if create_only and shm_key in _mapped_shm_regions:
+        raise SharedMemoryException(-2)
+    handle = ctypes.c_void_p()
+    err = lib.SharedMemoryRegionCreate(
+        triton_shm_name.encode(), shm_key.encode(), byte_size, ctypes.byref(handle)
+    )
+    if err != 0:
+        raise SharedMemoryException(err)
+    _mapped_shm_regions.append(shm_key)
+    return SharedMemoryRegionHandle(handle, triton_shm_name, shm_key, byte_size, owner=True)
+
+
+def attach_shared_memory_region(
+    triton_shm_name: str, shm_key: str, byte_size: int, offset: int = 0
+) -> SharedMemoryRegionHandle:
+    """Attach to an existing region created by another process (server side).
+
+    Framework extension (no reference equivalent in the Python wheel; the
+    server in the reference stack maps regions natively)."""
+    handle = ctypes.c_void_p()
+    err = _lib().SharedMemoryRegionOpen(
+        triton_shm_name.encode(), shm_key.encode(), byte_size, offset, ctypes.byref(handle)
+    )
+    if err != 0:
+        raise SharedMemoryException(err)
+    _mapped_shm_regions.append(shm_key)
+    return SharedMemoryRegionHandle(handle, triton_shm_name, shm_key, byte_size, owner=False)
+
+
+def set_shared_memory_region(
+    shm_handle: SharedMemoryRegionHandle, input_values, offset: int = 0
+) -> None:
+    """Copy each numpy array in ``input_values`` into the region back-to-back
+    starting at ``offset`` (reference :129-183, including BYTES serialization
+    into the region)."""
+    if not isinstance(input_values, (list, tuple)):
+        raise SharedMemoryException(-1)
+    lib = _lib()
+    cur = offset
+    for arr in input_values:
+        arr = np.asarray(arr)
+        if arr.dtype == np.object_ or arr.dtype.kind in ("S", "U"):
+            data = serialize_byte_tensor(arr)
+        else:
+            data = np.ascontiguousarray(arr)
+        nbytes = data.nbytes
+        err = lib.SharedMemoryRegionSet(
+            shm_handle._c_handle,
+            cur,
+            nbytes,
+            data.ctypes.data_as(ctypes.c_void_p),
+        )
+        if err != 0:
+            raise SharedMemoryException(err)
+        cur += nbytes
+
+
+def get_contents_as_numpy(
+    shm_handle: SharedMemoryRegionHandle,
+    datatype,
+    shape,
+    offset: int = 0,
+) -> np.ndarray:
+    """View the region contents as a numpy array of ``datatype``/``shape``
+    (reference :186-259; BYTES regions are deserialized element-wise).
+
+    .. warning:: For fixed-size dtypes the returned array is a **zero-copy
+       view into the mapped region** — it becomes invalid (and will SIGSEGV on
+       access) once ``destroy_shared_memory_region`` unmaps the region.  Call
+       ``.copy()`` if you need the data to outlive the region.  (Same
+       semantics as the reference; BYTES results are always copies.)"""
+    base = shm_handle.base_addr()
+    region_size = shm_handle.byte_size - offset
+    dt = np.dtype(datatype)
+    if dt == np.object_:
+        # Decode exactly prod(shape) elements; the region may be larger than
+        # the serialized payload (reference examples size regions exactly, but
+        # we don't require that).
+        raw = ctypes.string_at(base + offset, region_size)
+        n = int(np.prod(shape)) if len(shape) else 1
+        flat = _deserialize_first_n(raw, n)
+        return flat.reshape(shape)
+    count = int(np.prod(shape)) if len(shape) else 1
+    buf = (ctypes.c_uint8 * (count * dt.itemsize)).from_address(base + offset)
+    arr = np.frombuffer(buf, dtype=dt, count=count).reshape(shape)
+    return arr
+
+
+def _deserialize_first_n(raw: bytes, n: int) -> np.ndarray:
+    import struct
+
+    out = []
+    mv = memoryview(raw)
+    pos = 0
+    for _ in range(n):
+        if pos + 4 > len(mv):
+            raise SharedMemoryException(-8)
+        (length,) = struct.unpack_from("<I", mv, pos)
+        pos += 4
+        if pos + length > len(mv):
+            raise SharedMemoryException(-8)
+        out.append(bytes(mv[pos : pos + length]))
+        pos += length
+    return np.array(out, dtype=np.object_)
+
+
+def as_shared_memory_tensor(
+    shm_handle: SharedMemoryRegionHandle, datatype: str, shape, offset: int = 0
+) -> SharedMemoryTensor:
+    """Expose the region as a ``__dlpack__``-capable tensor so frameworks can
+    consume it zero-copy (framework extension mirroring the cuda module's
+    ``as_shared_memory_tensor``, cuda_shared_memory/__init__.py:391-399)."""
+    return SharedMemoryTensor(
+        shm_handle.base_addr() + offset,
+        shm_handle.byte_size - offset,
+        datatype,
+        shape,
+        owner=shm_handle,
+        device_type=_dlpack.DLDeviceType.kDLCPU,
+        device_id=0,
+    )
+
+
+def mapped_shared_memory_regions() -> List[str]:
+    """Return shm keys of regions currently mapped by this process
+    (reference :262-271)."""
+    return list(_mapped_shm_regions)
+
+
+def destroy_shared_memory_region(shm_handle: SharedMemoryRegionHandle) -> None:
+    """Unmap the region and, if this process created it, unlink the backing
+    object (reference :274-311)."""
+    if shm_handle._destroyed:
+        return
+    err = _lib().SharedMemoryRegionDestroy(
+        shm_handle._c_handle, 1 if shm_handle.owner else 0
+    )
+    shm_handle._destroyed = True
+    try:
+        _mapped_shm_regions.remove(shm_handle.shm_key)
+    except ValueError:
+        pass
+    if err != 0:
+        raise SharedMemoryException(err)
