@@ -24,19 +24,29 @@ namespace {
 struct SharedMemoryHandle {
   std::string triton_shm_name;
   std::string shm_key;
-  char* base_addr = nullptr;
+  char* base_addr = nullptr;  // == map_addr + (offset - aligned file offset)
+  char* map_addr = nullptr;   // actual mmap return, for munmap
+  size_t map_size = 0;
   int shm_fd = -1;
   size_t offset = 0;
   size_t byte_size = 0;
 };
 
-int MapRegion(int shm_fd, size_t offset, size_t byte_size, char** addr) {
-  void* p = mmap(nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, shm_fd,
-                 static_cast<off_t>(offset));
+// mmap requires a page-aligned file offset; map from the aligned floor and
+// return the interior pointer at the requested offset.
+int MapRegion(int shm_fd, size_t offset, size_t byte_size, char** addr,
+              char** map_addr, size_t* map_size) {
+  const size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+  const size_t aligned = offset - (offset % page);
+  const size_t delta = offset - aligned;
+  void* p = mmap(nullptr, byte_size + delta, PROT_READ | PROT_WRITE, MAP_SHARED,
+                 shm_fd, static_cast<off_t>(aligned));
   if (p == MAP_FAILED) {
     return CSHM_ERROR_SHM_MMAP;
   }
-  *addr = static_cast<char*>(p);
+  *map_addr = static_cast<char*>(p);
+  *map_size = byte_size + delta;
+  *addr = *map_addr + delta;
   return CSHM_SUCCESS;
 }
 
@@ -45,8 +55,13 @@ int MapRegion(int shm_fd, size_t offset, size_t byte_size, char** addr) {
 extern "C" {
 
 int SharedMemoryRegionCreate(const char* triton_shm_name, const char* shm_key,
-                             size_t byte_size, CshmHandle* handle) {
-  int fd = shm_open(shm_key, O_RDWR | O_CREAT, S_IRUSR | S_IWUSR);
+                             size_t byte_size, int exclusive,
+                             CshmHandle* handle) {
+  int flags = O_RDWR | O_CREAT;
+  if (exclusive != 0) {
+    flags |= O_EXCL;  // "create only": fail if the object already exists
+  }
+  int fd = shm_open(shm_key, flags, S_IRUSR | S_IWUSR);
   if (fd == -1) {
     return CSHM_ERROR_SHM_OPEN;
   }
@@ -56,7 +71,9 @@ int SharedMemoryRegionCreate(const char* triton_shm_name, const char* shm_key,
     return CSHM_ERROR_SHM_TRUNCATE;
   }
   char* addr = nullptr;
-  int err = MapRegion(fd, 0, byte_size, &addr);
+  char* map_addr = nullptr;
+  size_t map_size = 0;
+  int err = MapRegion(fd, 0, byte_size, &addr, &map_addr, &map_size);
   if (err != CSHM_SUCCESS) {
     close(fd);
     shm_unlink(shm_key);
@@ -64,7 +81,7 @@ int SharedMemoryRegionCreate(const char* triton_shm_name, const char* shm_key,
   }
   auto* h = new (std::nothrow) SharedMemoryHandle();
   if (h == nullptr) {
-    munmap(addr, byte_size);
+    munmap(map_addr, map_size);
     close(fd);
     shm_unlink(shm_key);
     return CSHM_ERROR_UNKNOWN;
@@ -72,6 +89,8 @@ int SharedMemoryRegionCreate(const char* triton_shm_name, const char* shm_key,
   h->triton_shm_name = triton_shm_name;
   h->shm_key = shm_key;
   h->base_addr = addr;
+  h->map_addr = map_addr;
+  h->map_size = map_size;
   h->shm_fd = fd;
   h->offset = 0;
   h->byte_size = byte_size;
@@ -86,20 +105,24 @@ int SharedMemoryRegionOpen(const char* triton_shm_name, const char* shm_key,
     return CSHM_ERROR_SHM_OPEN;
   }
   char* addr = nullptr;
-  int err = MapRegion(fd, offset, byte_size, &addr);
+  char* map_addr = nullptr;
+  size_t map_size = 0;
+  int err = MapRegion(fd, offset, byte_size, &addr, &map_addr, &map_size);
   if (err != CSHM_SUCCESS) {
     close(fd);
     return err;
   }
   auto* h = new (std::nothrow) SharedMemoryHandle();
   if (h == nullptr) {
-    munmap(addr, byte_size);
+    munmap(map_addr, map_size);
     close(fd);
     return CSHM_ERROR_UNKNOWN;
   }
   h->triton_shm_name = triton_shm_name;
   h->shm_key = shm_key;
   h->base_addr = addr;
+  h->map_addr = map_addr;
+  h->map_size = map_size;
   h->shm_fd = fd;
   h->offset = offset;
   h->byte_size = byte_size;
@@ -113,7 +136,8 @@ int SharedMemoryRegionSet(CshmHandle handle, size_t offset, size_t byte_size,
   if (h == nullptr || h->base_addr == nullptr) {
     return CSHM_ERROR_INVALID_HANDLE;
   }
-  if (offset + byte_size > h->byte_size) {
+  // Overflow-safe bounds check (offset + byte_size could wrap in size_t).
+  if (offset > h->byte_size || byte_size > h->byte_size - offset) {
     return CSHM_ERROR_OUT_OF_BOUNDS;
   }
   memcpy(h->base_addr + offset, data, byte_size);
@@ -141,7 +165,7 @@ int SharedMemoryRegionDestroy(CshmHandle handle, int unlink) {
     return CSHM_ERROR_INVALID_HANDLE;
   }
   int rc = CSHM_SUCCESS;
-  if (h->base_addr != nullptr && munmap(h->base_addr, h->byte_size) == -1) {
+  if (h->map_addr != nullptr && munmap(h->map_addr, h->map_size) == -1) {
     rc = CSHM_ERROR_SHM_UNMAP;
   }
   if (h->shm_fd != -1) {

@@ -34,9 +34,12 @@ typedef void* CshmHandle;
 
 // Create (shm_open O_CREAT + ftruncate + mmap) a shared memory region named
 // `shm_key` of `byte_size` bytes, mapped read/write.  `triton_shm_name` is the
-// logical name used on the wire for register/unregister RPCs.
+// logical name used on the wire for register/unregister RPCs.  When
+// `exclusive` is nonzero the call fails if the object already exists
+// (O_EXCL) instead of silently attaching to and resizing it.
 int SharedMemoryRegionCreate(const char* triton_shm_name, const char* shm_key,
-                             size_t byte_size, CshmHandle* handle);
+                             size_t byte_size, int exclusive,
+                             CshmHandle* handle);
 
 // Attach to an existing region (no O_CREAT, no ftruncate).
 int SharedMemoryRegionOpen(const char* triton_shm_name, const char* shm_key,

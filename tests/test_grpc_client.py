@@ -1,0 +1,339 @@
+"""End-to-end tests: our gRPC client against the serving harness.
+
+Scenarios mirror the reference's `simple_grpc_*` examples (SURVEY.md §2.7):
+unary infer, async futures + cancellation, sequence streaming over bidi,
+decoupled repeat model, shm flow, keepalive/channel args."""
+
+import os
+import queue
+import threading
+
+import numpy as np
+import pytest
+
+import triton_client_tpu.grpc as grpcclient
+import triton_client_tpu.utils.shared_memory as shm
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+from triton_client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+@pytest.fixture()
+def client(server):
+    with grpcclient.InferenceServerClient(server.grpc_url) as c:
+        yield c
+
+
+def _simple_inputs(a, b):
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(a)
+    inputs[1].set_data_from_numpy(b)
+    return inputs
+
+
+class TestHealthSurface:
+    def test_health(self, client):
+        assert client.is_server_live()
+        assert client.is_server_ready()
+        assert client.is_model_ready("simple")
+        assert not client.is_model_ready("nope")
+
+    def test_metadata_pb_and_json(self, client):
+        md = client.get_server_metadata()
+        assert md.name == "triton_client_tpu_harness"
+        md_json = client.get_server_metadata(as_json=True)
+        assert "xla_shared_memory" in md_json["extensions"]
+        mm = client.get_model_metadata("simple")
+        assert mm.inputs[0].name == "INPUT0" and mm.inputs[0].shape == [1, 16]
+
+    def test_model_config(self, client):
+        cfg = client.get_model_config("simple")
+        assert cfg.config.name == "simple"
+        assert cfg.config.input[0].data_type == grpcclient.model_config_pb2.TYPE_INT32
+
+    def test_repository_index(self, client):
+        index = client.get_model_repository_index()
+        assert any(m.name == "simple" for m in index.models)
+
+    def test_statistics(self, client):
+        stats = client.get_inference_statistics("simple")
+        assert stats.model_stats[0].name == "simple"
+
+    def test_unknown_model_raises_with_status(self, client):
+        with pytest.raises(InferenceServerException) as exc:
+            client.get_model_metadata("nope")
+        assert "StatusCode" in exc.value.status()
+
+    def test_load_unload(self, client):
+        client.unload_model("identity_fp32")
+        assert not client.is_model_ready("identity_fp32")
+        client.load_model("identity_fp32")
+        assert client.is_model_ready("identity_fp32")
+
+    def test_trace_log_settings(self, client):
+        ts = client.get_trace_settings(as_json=True)
+        assert "trace_level" in ts["settings"]
+        ls = client.update_log_settings({"log_verbose_level": 3}, as_json=True)
+        assert ls["settings"]["log_verbose_level"]["uint32_param"] == 3
+
+
+class TestInfer:
+    def test_simple(self, client):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.full((1, 16), 5, dtype=np.int32)
+        result = client.infer("simple", _simple_inputs(a, b))
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+
+    def test_requested_outputs_subset(self, client):
+        a = np.ones((1, 16), dtype=np.int32)
+        outputs = [grpcclient.InferRequestedOutput("OUTPUT1")]
+        result = client.infer("simple", _simple_inputs(a, a), outputs=outputs)
+        assert result.as_numpy("OUTPUT0") is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - a)
+
+    def test_bytes_roundtrip(self, client):
+        arr = np.array([[b"one", b"\x00two"]], dtype=np.object_)
+        inp = grpcclient.InferInput("INPUT0", [1, 2], "BYTES")
+        inp.set_data_from_numpy(arr)
+        result = client.infer("simple_identity", [inp])
+        assert result.as_numpy("OUTPUT0").tolist() == arr.tolist()
+
+    def test_bf16_roundtrip(self, client):
+        import ml_dtypes
+
+        arr = np.array([[0.5, 1.5, -2.0]], dtype=ml_dtypes.bfloat16)
+        inp = grpcclient.InferInput("INPUT0", [1, 3], "BF16")
+        inp.set_data_from_numpy(arr)
+        result = client.infer("identity_bf16", [inp])
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), arr)
+
+    def test_error_surfaces(self, client):
+        a = np.ones((1, 8), dtype=np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 8], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 8], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(a)
+        inputs[1].set_data_from_numpy(a)
+        with pytest.raises(InferenceServerException, match="unexpected shape"):
+            client.infer("simple", inputs)
+
+    def test_compression(self, client):
+        a = np.ones((1, 16), dtype=np.int32)
+        result = client.infer(
+            "simple", _simple_inputs(a, a), compression_algorithm="gzip"
+        )
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + a)
+
+    def test_custom_parameters(self, client):
+        a = np.ones((1, 16), dtype=np.int32)
+        result = client.infer(
+            "simple", _simple_inputs(a, a), parameters={"my_param": "42"}
+        )
+        assert result.as_numpy("OUTPUT0") is not None
+
+    def test_reserved_parameter_rejected(self, client):
+        a = np.ones((1, 16), dtype=np.int32)
+        with pytest.raises(InferenceServerException, match="reserved"):
+            client.infer("simple", _simple_inputs(a, a), parameters={"priority": 1})
+
+
+class TestAsyncInfer:
+    def test_future_style(self, client):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        handle = client.async_infer("simple", _simple_inputs(a, a))
+        result = handle.get_result(timeout=30)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + a)
+
+    def test_callback_style(self, client):
+        a = np.ones((1, 16), dtype=np.int32)
+        done = queue.Queue()
+
+        def callback(result, error):
+            done.put((result, error))
+
+        ctx = client.async_infer("simple", _simple_inputs(a, a), callback=callback)
+        assert ctx is not None
+        result, error = done.get(timeout=30)
+        assert error is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + a)
+
+    def test_callback_error(self, client):
+        a = np.ones((1, 16), dtype=np.int32)
+        inputs = [grpcclient.InferInput("INPUT0", [1, 16], "INT32")]
+        inputs[0].set_data_from_numpy(a)
+        done = queue.Queue()
+        client.async_infer("simple", inputs, callback=lambda result, error: done.put(error))
+        error = done.get(timeout=30)
+        assert isinstance(error, InferenceServerException)
+
+
+class TestStreaming:
+    def test_sequence_stream(self, client):
+        """Two interleaved sequences over one stream (reference
+        simple_grpc_sequence_stream_infer_client.py:58-79)."""
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        values = [11, 7, 5, 3, 2, 0, 1]
+        try:
+            for seq_id in (1001, 1002):
+                for i, v in enumerate(values):
+                    inp = grpcclient.InferInput("INPUT", [1], "INT32")
+                    val = v if seq_id == 1001 else -v
+                    inp.set_data_from_numpy(np.array([val], dtype=np.int32))
+                    client.async_stream_infer(
+                        "simple_sequence",
+                        [inp],
+                        sequence_id=seq_id,
+                        sequence_start=(i == 0),
+                        sequence_end=(i == len(values) - 1),
+                    )
+        finally:
+            client.stop_stream()
+        outs = []
+        while not results.empty():
+            result, error = results.get()
+            assert error is None
+            outs.append(int(result.as_numpy("OUTPUT")[0]))
+        # running accumulations for both sequences, responses in order per seq
+        acc = np.cumsum(values).tolist()
+        assert outs[: len(values)] == acc
+        assert outs[len(values) :] == [-a for a in acc]
+
+    def test_string_sequence_id(self, client):
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        try:
+            inp = grpcclient.InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(np.array([42], dtype=np.int32))
+            client.async_stream_infer(
+                "simple_sequence",
+                [inp],
+                sequence_id="seq-string-1",
+                sequence_start=True,
+                sequence_end=True,
+            )
+        finally:
+            client.stop_stream()
+        result, error = results.get(timeout=30)
+        assert error is None
+        assert int(result.as_numpy("OUTPUT")[0]) == 42
+
+    def test_decoupled_repeat(self, client):
+        """Decoupled model emits N responses per request (reference
+        simple_grpc_custom_repeat.py)."""
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        n = 4
+        try:
+            values = np.arange(n, dtype=np.int32)
+            delays = np.zeros(n, dtype=np.uint32)
+            wait = np.array([0], dtype=np.uint32)
+            inputs = [
+                grpcclient.InferInput("IN", [n], "INT32"),
+                grpcclient.InferInput("DELAY", [n], "UINT32"),
+                grpcclient.InferInput("WAIT", [1], "UINT32"),
+            ]
+            inputs[0].set_data_from_numpy(values)
+            inputs[1].set_data_from_numpy(delays)
+            inputs[2].set_data_from_numpy(wait)
+            client.async_stream_infer("repeat_int32", inputs, request_id="rep-1")
+        finally:
+            client.stop_stream()
+        got = []
+        while not results.empty():
+            result, error = results.get()
+            assert error is None
+            got.append(int(result.as_numpy("OUT")[0]))
+        assert got == list(range(n))
+
+    def test_decoupled_empty_final_response(self, client):
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        try:
+            inp = grpcclient.InferInput("IN", [1], "INT32")
+            inp.set_data_from_numpy(np.array([2], dtype=np.int32))
+            client.async_stream_infer(
+                "square_int32", [inp], enable_empty_final_response=True
+            )
+        finally:
+            client.stop_stream()
+        messages = []
+        while not results.empty():
+            messages.append(results.get())
+        assert len(messages) == 3  # 2 data + 1 empty final
+        final = messages[-1][0].get_response()
+        assert final.parameters["triton_final_response"].bool_param is True
+        assert len(final.outputs) == 0
+
+    def test_stream_error_in_band(self, client):
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        try:
+            inp = grpcclient.InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(np.array([1], dtype=np.int32))
+            # sequence model without sequence_id -> in-band error
+            client.async_stream_infer("simple_sequence", [inp])
+        finally:
+            client.stop_stream()
+        result, error = results.get(timeout=30)
+        assert error is not None
+        assert "correlation ID" in str(error)
+
+    def test_second_stream_rejected(self, client):
+        client.start_stream(callback=lambda result, error: None)
+        try:
+            with pytest.raises(InferenceServerException, match="single active stream"):
+                client.start_stream(callback=lambda result, error: None)
+        finally:
+            client.stop_stream()
+
+
+class TestSystemShm:
+    def test_shm_end_to_end(self, client):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.full((1, 16), 9, dtype=np.int32)
+        key = f"/tc_grpc_shm_{os.getpid()}"
+        ih = shm.create_shared_memory_region("grpc_in", key, a.nbytes * 2)
+        try:
+            shm.set_shared_memory_region(ih, [a, b])
+            client.register_system_shared_memory("grpc_in", key, a.nbytes * 2)
+            status = client.get_system_shared_memory_status()
+            assert "grpc_in" in status.regions
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_shared_memory("grpc_in", a.nbytes)
+            inputs[1].set_shared_memory("grpc_in", b.nbytes, offset=a.nbytes)
+            result = client.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+            client.unregister_system_shared_memory("grpc_in")
+            assert len(client.get_system_shared_memory_status().regions) == 0
+        finally:
+            client.unregister_system_shared_memory()
+            shm.destroy_shared_memory_region(ih)
+
+
+class TestChannelOptions:
+    def test_keepalive_and_channel_args(self, server):
+        c = grpcclient.InferenceServerClient(
+            server.grpc_url,
+            keepalive_options=grpcclient.KeepAliveOptions(keepalive_time_ms=10000),
+            channel_args=[("grpc.max_receive_message_length", 1 << 24)],
+        )
+        assert c.is_server_live()
+        c.close()

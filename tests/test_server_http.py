@@ -1,0 +1,273 @@
+"""Server harness tests driven with `requests` as an independent HTTP oracle
+(our own client gets its own test file; testing the server against a neutral
+library pins the wire protocol, not our client's interpretation of it)."""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+import requests
+
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+def _url(server, path):
+    return f"http://{server.http_url}{path}"
+
+
+class TestHealthMetadata:
+    def test_live_ready(self, server):
+        assert requests.get(_url(server, "/v2/health/live")).status_code == 200
+        assert requests.get(_url(server, "/v2/health/ready")).status_code == 200
+
+    def test_model_ready(self, server):
+        assert requests.get(_url(server, "/v2/models/simple/ready")).status_code == 200
+        assert requests.get(_url(server, "/v2/models/nope/ready")).status_code == 400
+
+    def test_server_metadata(self, server):
+        md = requests.get(_url(server, "/v2")).json()
+        assert md["name"] == "triton_client_tpu_harness"
+        assert "system_shared_memory" in md["extensions"]
+        assert "xla_shared_memory" in md["extensions"]
+
+    def test_model_metadata(self, server):
+        md = requests.get(_url(server, "/v2/models/simple")).json()
+        assert md["name"] == "simple"
+        assert md["inputs"][0] == {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16]}
+
+    def test_model_config(self, server):
+        cfg = requests.get(_url(server, "/v2/models/simple/config")).json()
+        assert cfg["name"] == "simple"
+        assert cfg["input"][0]["data_type"] == "TYPE_INT32"
+
+    def test_unknown_model_404ish(self, server):
+        r = requests.get(_url(server, "/v2/models/nope"))
+        assert r.status_code == 400
+        assert "error" in r.json()
+
+    def test_repository_index(self, server):
+        r = requests.post(_url(server, "/v2/repository/index"), json={})
+        names = {m["name"] for m in r.json()}
+        assert {"simple", "simple_identity", "repeat_int32"} <= names
+
+
+def _infer_binary(server, model, inputs, outputs=None, parameters=None):
+    """Hand-rolled v2 binary-protocol request (protocol oracle)."""
+    header = {"inputs": [], "outputs": outputs or []}
+    if parameters:
+        header["parameters"] = parameters
+    blobs = []
+    for name, arr in inputs:
+        from triton_client_tpu.utils import np_to_triton_dtype
+
+        blob = arr.tobytes()
+        header["inputs"].append(
+            {
+                "name": name,
+                "datatype": np_to_triton_dtype(arr.dtype),
+                "shape": list(arr.shape),
+                "parameters": {"binary_data_size": len(blob)},
+            }
+        )
+        blobs.append(blob)
+    jb = json.dumps(header).encode()
+    body = jb + b"".join(blobs)
+    r = requests.post(
+        _url(server, f"/v2/models/{model}/infer"),
+        data=body,
+        headers={"Inference-Header-Content-Length": str(len(jb))},
+    )
+    return r
+
+
+def _parse_binary_response(r):
+    hl = int(r.headers["Inference-Header-Content-Length"])
+    header = json.loads(r.content[:hl])
+    binary = r.content[hl:]
+    outs = {}
+    offset = 0
+    for o in header["outputs"]:
+        size = o.get("parameters", {}).get("binary_data_size")
+        if size is None:
+            outs[o["name"]] = (o, None)
+            continue
+        outs[o["name"]] = (o, binary[offset : offset + size])
+        offset += size
+    return header, outs
+
+
+class TestInfer:
+    def test_simple_binary(self, server):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        r = _infer_binary(server, "simple", [("INPUT0", a), ("INPUT1", b)])
+        assert r.status_code == 200, r.text
+        header, outs = _parse_binary_response(r)
+        assert header["model_name"] == "simple"
+        o0 = np.frombuffer(outs["OUTPUT0"][1], dtype=np.int32).reshape(1, 16)
+        o1 = np.frombuffer(outs["OUTPUT1"][1], dtype=np.int32).reshape(1, 16)
+        np.testing.assert_array_equal(o0, a + b)
+        np.testing.assert_array_equal(o1, a - b)
+
+    def test_simple_json(self, server):
+        body = {
+            "inputs": [
+                {
+                    "name": "INPUT0",
+                    "datatype": "INT32",
+                    "shape": [1, 4],
+                    "data": [[1, 2, 3, 4]],
+                },
+                {
+                    "name": "INPUT1",
+                    "datatype": "INT32",
+                    "shape": [1, 4],
+                    "data": [[10, 20, 30, 40]],
+                },
+            ]
+        }
+        # 'simple' is fixed [1,16]; use identity model with dynamic dims for JSON
+        body["inputs"] = body["inputs"][:1]
+        body["inputs"][0]["shape"] = [1, 4]
+        r = requests.post(
+            _url(server, "/v2/models/custom_identity_int32/infer"), json=body
+        )
+        assert r.status_code == 200, r.text
+        out = r.json()["outputs"][0]
+        assert out["data"] == [1, 2, 3, 4]
+        assert out["shape"] == [1, 4]
+
+    def test_bytes_model(self, server):
+        arr = np.array([[b"hello", b"world"]], dtype=np.object_)
+        from triton_client_tpu.utils import serialize_byte_tensor
+
+        blob = serialize_byte_tensor(arr).tobytes()
+        header = {
+            "inputs": [
+                {
+                    "name": "INPUT0",
+                    "datatype": "BYTES",
+                    "shape": [1, 2],
+                    "parameters": {"binary_data_size": len(blob)},
+                }
+            ],
+            "outputs": [{"name": "OUTPUT0", "parameters": {"binary_data": True}}],
+        }
+        jb = json.dumps(header).encode()
+        r = requests.post(
+            _url(server, "/v2/models/simple_identity/infer"),
+            data=jb + blob,
+            headers={"Inference-Header-Content-Length": str(len(jb))},
+        )
+        assert r.status_code == 200, r.text
+        _, outs = _parse_binary_response(r)
+        raw = outs["OUTPUT0"][1]
+        assert struct.unpack_from("<I", raw, 0)[0] == 5
+        assert raw[4:9] == b"hello"
+
+    def test_shape_mismatch_error(self, server):
+        a = np.zeros((1, 8), dtype=np.int32)
+        r = _infer_binary(server, "simple", [("INPUT0", a), ("INPUT1", a)])
+        assert r.status_code == 400
+        assert "unexpected shape" in r.json()["error"]
+
+    def test_dtype_mismatch_error(self, server):
+        a = np.zeros((1, 16), dtype=np.float32)
+        r = _infer_binary(server, "simple", [("INPUT0", a), ("INPUT1", a)])
+        assert r.status_code == 400
+        assert "data-type" in r.json()["error"]
+
+    def test_missing_input_error(self, server):
+        a = np.zeros((1, 16), dtype=np.int32)
+        r = _infer_binary(server, "simple", [("INPUT0", a)])
+        assert r.status_code == 400
+
+    def test_decoupled_rejected_on_http(self, server):
+        a = np.array([3], dtype=np.int32)
+        r = _infer_binary(server, "square_int32", [("IN", a)])
+        assert r.status_code == 400
+        assert "decoupled" in r.json()["error"]
+
+    def test_statistics_accumulate(self, server):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        before = requests.get(_url(server, "/v2/models/simple/stats")).json()
+        n0 = before["model_stats"][0]["inference_count"]
+        _infer_binary(server, "simple", [("INPUT0", a), ("INPUT1", a)])
+        after = requests.get(_url(server, "/v2/models/simple/stats")).json()
+        assert after["model_stats"][0]["inference_count"] == n0 + 1
+
+    def test_gzip_request(self, server):
+        import gzip as gz
+
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        blob = a.tobytes()
+        header = {
+            "inputs": [
+                {
+                    "name": "INPUT0",
+                    "datatype": "INT32",
+                    "shape": [1, 16],
+                    "parameters": {"binary_data_size": len(blob)},
+                },
+                {
+                    "name": "INPUT1",
+                    "datatype": "INT32",
+                    "shape": [1, 16],
+                    "parameters": {"binary_data_size": len(blob)},
+                },
+            ]
+        }
+        jb = json.dumps(header).encode()
+        body = gz.compress(jb + blob + blob)
+        r = requests.post(
+            _url(server, "/v2/models/simple/infer"),
+            data=body,
+            headers={
+                "Inference-Header-Content-Length": str(len(jb)),
+                "Content-Encoding": "gzip",
+            },
+        )
+        assert r.status_code == 200, r.text
+        _, outs = _parse_binary_response(r)
+        o0 = np.frombuffer(outs["OUTPUT0"][1], dtype=np.int32).reshape(1, 16)
+        np.testing.assert_array_equal(o0, a + a)
+
+
+class TestModelControl:
+    def test_load_unload_cycle(self, server):
+        url = _url(server, "/v2/repository/models/custom_identity_int32/unload")
+        assert requests.post(url, json={}).status_code == 200
+        assert (
+            requests.get(_url(server, "/v2/models/custom_identity_int32/ready")).status_code
+            == 400
+        )
+        url = _url(server, "/v2/repository/models/custom_identity_int32/load")
+        assert requests.post(url, json={}).status_code == 200
+        assert (
+            requests.get(_url(server, "/v2/models/custom_identity_int32/ready")).status_code
+            == 200
+        )
+
+    def test_trace_settings(self, server):
+        r = requests.get(_url(server, "/v2/trace/setting"))
+        assert r.json()["trace_level"] == ["OFF"]
+        r = requests.post(
+            _url(server, "/v2/trace/setting"), json={"trace_level": ["TIMESTAMPS"]}
+        )
+        assert r.json()["trace_level"] == ["TIMESTAMPS"]
+        requests.post(_url(server, "/v2/trace/setting"), json={"trace_level": ["OFF"]})
+
+    def test_log_settings(self, server):
+        r = requests.post(_url(server, "/v2/logging"), json={"log_verbose_level": 1})
+        assert r.json()["log_verbose_level"] == 1

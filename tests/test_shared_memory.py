@@ -145,3 +145,57 @@ class TestRegistry:
         h = shm.create_shared_memory_region("dd", key, 32)
         shm.destroy_shared_memory_region(h)
         shm.destroy_shared_memory_region(h)
+
+
+class TestBoundsHardening:
+    """Regression tests for review findings: overflow-safe bounds, O_EXCL
+    create_only, page-unaligned attach offsets, oversized reads."""
+
+    def test_negative_offset_write_raises(self, region):
+        with pytest.raises(shm.SharedMemoryException):
+            shm.set_shared_memory_region(region, [np.zeros(4, np.int32)], offset=-4)
+
+    def test_oversized_read_raises_not_segfaults(self, region):
+        with pytest.raises(shm.SharedMemoryException):
+            shm.get_contents_as_numpy(region, np.int32, [100000])
+
+    def test_negative_offset_read_raises(self, region):
+        with pytest.raises(shm.SharedMemoryException):
+            shm.get_contents_as_numpy(region, np.int32, [4], offset=-8)
+
+    def test_create_only_excl_cross_registry(self):
+        # O_EXCL must fail even though *this* process never mapped the key.
+        key = f"/tcshm_excl_{os.getpid()}"
+        h = shm.create_shared_memory_region("a", key, 64)
+        try:
+            shm._mapped_shm_regions.remove(key)  # simulate another process
+            with pytest.raises(shm.SharedMemoryException):
+                shm.create_shared_memory_region("b", key, 64, create_only=True)
+        finally:
+            shm._mapped_shm_regions.append(key)
+            shm.destroy_shared_memory_region(h)
+
+    def test_page_unaligned_attach_offset(self):
+        key = f"/tcshm_unalign_{os.getpid()}"
+        h = shm.create_shared_memory_region("u", key, 256)
+        try:
+            shm.set_shared_memory_region(h, [np.arange(8, dtype=np.int32)], offset=8)
+            peer = shm.attach_shared_memory_region("peer", key, 32, offset=8)
+            out = shm.get_contents_as_numpy(peer, np.int32, [8])
+            np.testing.assert_array_equal(out, np.arange(8, dtype=np.int32))
+            shm.destroy_shared_memory_region(peer)
+        finally:
+            shm.destroy_shared_memory_region(h)
+
+    def test_zero_byte_size_raises(self):
+        with pytest.raises(shm.SharedMemoryException):
+            shm.create_shared_memory_region("z", "/tcshm_zero", 0)
+
+
+class TestBF16Truncation:
+    def test_f32_truncates_for_wire_parity(self):
+        from triton_client_tpu.utils import serialize_bf16_tensor
+
+        # 0x3F808001 rounds to 0x3F81 but must TRUNCATE to 0x3F80.
+        arr = np.array([0x3F808001], dtype=np.uint32).view(np.float32)
+        assert serialize_bf16_tensor(arr).tobytes() == b"\x80\x3f"

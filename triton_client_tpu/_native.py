@@ -38,6 +38,10 @@ def find_or_build(
         if _is_fresh(built, srcs):
             return built
         os.makedirs(_BUILD_DIR, exist_ok=True)
+        # Compile to a process-unique temp name and rename into place so
+        # concurrent processes (e.g. parallel test workers) never dlopen a
+        # half-written .so.
+        tmp_out = f"{built}.{os.getpid()}.tmp"
         cmd = [
             "g++",
             "-std=c++17",
@@ -48,11 +52,12 @@ def find_or_build(
             "-Wextra",
             *srcs,
             "-o",
-            built,
+            tmp_out,
             "-lrt",
             "-pthread",
         ] + (extra_flags or [])
         subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp_out, built)
     return built
 
 

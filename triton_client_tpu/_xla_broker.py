@@ -1,0 +1,80 @@
+"""Process-local broker for XLA shared-memory regions.
+
+cudaIPC lets two processes map the *same* device allocation
+(cudaIpcGetMemHandle / cudaIpcOpenMemHandle — reference
+cuda_shared_memory/__init__.py:130-170).  PjRt has no cross-process buffer
+import, and jax.Arrays are immutable — so the TPU-native region is a **slot**:
+a mutable cell holding the current immutable device buffer.  "Writing" a
+region rebinds the slot; readers always see the latest buffer.
+
+* Co-located client+server (same process — the recommended TPU serving
+  topology and our hermetic-test path): both sides share the slot object via
+  this broker → tensor data stays in TPU HBM end to end, zero copies.
+* Cross-process: the slot is backed by a POSIX host-shm staging region; the
+  writer stages once and the reader does a single host↔device DMA (the
+  TPU-realistic analog of cudaIpcOpenMemHandle; SURVEY.md §7 hard parts (a)).
+
+This module is deliberately tiny and dependency-free: both
+``utils.xla_shared_memory`` (client half) and ``server.shm`` (server half)
+import it without pulling in each other.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class RegionSlot:
+    """Mutable cell for an immutable device buffer + its type metadata."""
+
+    def __init__(self, uuid: str, byte_size: int, device_id: int):
+        self.uuid = uuid
+        self.byte_size = byte_size
+        self.device_id = device_id
+        self.lock = threading.Lock()
+        # Current contents: a jax.Array (any dtype/shape, nbytes<=byte_size)
+        # plus the Triton dtype/shape it was last written as.
+        self.array = None
+        self.datatype: Optional[str] = None
+        self.shape: Optional[tuple] = None
+
+    def bind(self, array, datatype: Optional[str], shape: Optional[tuple]) -> None:
+        with self.lock:
+            self.array = array
+            self.datatype = datatype
+            self.shape = tuple(shape) if shape is not None else None
+
+    def get(self):
+        with self.lock:
+            return self.array, self.datatype, self.shape
+
+
+class XlaBroker:
+    def __init__(self):
+        self._slots: Dict[str, RegionSlot] = {}
+        self._lock = threading.Lock()
+        # Set by an in-process server at startup so clients default to the
+        # zero-copy slot path; cross-process clients fall back to staging.
+        self.server_present = False
+
+    def create(self, uuid: str, byte_size: int, device_id: int) -> RegionSlot:
+        with self._lock:
+            slot = RegionSlot(uuid, byte_size, device_id)
+            self._slots[uuid] = slot
+            return slot
+
+    def lookup(self, uuid: str) -> Optional[RegionSlot]:
+        with self._lock:
+            return self._slots.get(uuid)
+
+    def drop(self, uuid: str) -> None:
+        with self._lock:
+            self._slots.pop(uuid, None)
+
+
+_broker = XlaBroker()
+
+
+def broker() -> XlaBroker:
+    return _broker

@@ -1,0 +1,29 @@
+"""gRPC client for the v2 inference protocol.
+
+Mirrors the reference's ``tritonclient.grpc`` package surface, including the
+``service_pb2`` module aliases used by advanced callers."""
+
+from .._auth import BasicAuth  # noqa: F401 (re-export parity)
+from ..protocol import inference_pb2 as service_pb2
+from ..protocol import inference_pb2 as model_config_pb2
+from ._client import (
+    CallContext,
+    InferAsyncRequest,
+    InferenceServerClient,
+    KeepAliveOptions,
+)
+from ._infer_input import InferInput
+from ._infer_result import InferResult
+from ._requested_output import InferRequestedOutput
+
+__all__ = [
+    "InferenceServerClient",
+    "InferAsyncRequest",
+    "CallContext",
+    "KeepAliveOptions",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+    "service_pb2",
+    "model_config_pb2",
+]

@@ -1,0 +1,579 @@
+"""Synchronous gRPC ``InferenceServerClient``.
+
+Parity target: reference ``tritonclient/grpc/_client.py`` (1936 LoC) — same
+method surface: health/metadata/config, repository control, statistics,
+trace/log settings, system+cuda(xla) shm RPCs, ``infer``, future-based
+``async_infer`` with cancellation (CallContext :101-116), bidi streaming
+(``start_stream``/``async_stream_infer``/``stop_stream`` :1743-1935), channel
+options (unlimited message size :50-54, keepalive :57-98, custom channel args
+:162-213).  Headers travel as gRPC metadata via the plugin hook (:241-248).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import grpc
+
+from .._client import InferenceServerClientBase
+from .._request import Request
+from ..protocol import inference_pb2 as pb
+from ..protocol.service import GRPCInferenceServiceStub
+from ..utils import raise_error
+from ._infer_result import InferResult
+from ._infer_stream import _InferStream, _RequestIterator
+from ._utils import (
+    get_error_grpc,
+    get_grpc_compression,
+    get_inference_request,
+    raise_error_grpc,
+)
+
+INT32_MAX = 2**31 - 1
+MAX_GRPC_MESSAGE_SIZE = INT32_MAX
+
+
+class KeepAliveOptions:
+    """gRPC keepalive knobs (reference :57-98)."""
+
+    def __init__(
+        self,
+        keepalive_time_ms: int = INT32_MAX,
+        keepalive_timeout_ms: int = 20000,
+        keepalive_permit_without_calls: bool = False,
+        http2_max_pings_without_data: int = 2,
+    ):
+        self.keepalive_time_ms = keepalive_time_ms
+        self.keepalive_timeout_ms = keepalive_timeout_ms
+        self.keepalive_permit_without_calls = keepalive_permit_without_calls
+        self.http2_max_pings_without_data = http2_max_pings_without_data
+
+
+class CallContext:
+    """Cancellation handle for an in-flight async_infer (reference :101-116)."""
+
+    def __init__(self, call):
+        self._call = call
+
+    def cancel(self) -> bool:
+        return self._call.cancel()
+
+
+class InferAsyncRequest:
+    """Future-style handle returned by ``async_infer`` (framework addition
+    mirroring the HTTP client's handle; the reference gRPC client is
+    callback-only but its C++ sibling returns joinable state)."""
+
+    def __init__(self, call):
+        self._call = call
+
+    def get_result(self, block: bool = True, timeout: Optional[float] = None) -> InferResult:
+        try:
+            response = self._call.result(timeout=timeout)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+        except grpc.FutureTimeoutError:
+            raise_error("failed to obtain inference response")
+        return InferResult(response)
+
+    def cancel(self) -> bool:
+        return self._call.cancel()
+
+
+def _channel_options(keepalive_options, channel_args):
+    options: List[tuple] = [
+        ("grpc.max_send_message_length", MAX_GRPC_MESSAGE_SIZE),
+        ("grpc.max_receive_message_length", MAX_GRPC_MESSAGE_SIZE),
+    ]
+    if keepalive_options is None:
+        keepalive_options = KeepAliveOptions()
+    options.extend(
+        [
+            ("grpc.keepalive_time_ms", keepalive_options.keepalive_time_ms),
+            ("grpc.keepalive_timeout_ms", keepalive_options.keepalive_timeout_ms),
+            (
+                "grpc.keepalive_permit_without_calls",
+                int(keepalive_options.keepalive_permit_without_calls),
+            ),
+            (
+                "grpc.http2.max_pings_without_data",
+                keepalive_options.http2_max_pings_without_data,
+            ),
+        ]
+    )
+    if channel_args is not None:
+        user_keys = {k for k, _ in channel_args}
+        options = [(k, v) for k, v in options if k not in user_keys]
+        options.extend(channel_args)
+    return options
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Client for the v2 protocol over gRPC.
+
+    Thread-safe except for streaming: one stream per client at a time
+    (reference contract grpc/_client.py:119-124)."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional[grpc.ChannelCredentials] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args: Optional[List[tuple]] = None,
+    ):
+        super().__init__()
+        self._verbose = verbose
+        options = _channel_options(keepalive_options, channel_args)
+        if creds is not None:
+            self._channel = grpc.secure_channel(url, creds, options=options)
+        elif ssl:
+            def _read(path):
+                if path is None:
+                    return None
+                with open(path, "rb") as f:
+                    return f.read()
+
+            credentials = grpc.ssl_channel_credentials(
+                root_certificates=_read(root_certificates),
+                private_key=_read(private_key),
+                certificate_chain=_read(certificate_chain),
+            )
+            self._channel = grpc.secure_channel(url, credentials, options=options)
+        else:
+            self._channel = grpc.insecure_channel(url, options=options)
+        self._client_stub = GRPCInferenceServiceStub(self._channel)
+        self._stream: Optional[_InferStream] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self.stop_stream()
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _get_metadata(self, headers: Optional[dict]) -> tuple:
+        request = Request(dict(headers) if headers else {})
+        self._call_plugin(request)
+        return tuple(request.headers.items())
+
+    # -- health / metadata -------------------------------------------------
+    def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = self._client_stub.ServerLive(
+                pb.ServerLiveRequest(), metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            if self._verbose:
+                print(response)
+            return response.live
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = self._client_stub.ServerReady(
+                pb.ServerReadyRequest(), metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return response.ready
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def is_model_ready(self, model_name, model_version="", headers=None, client_timeout=None):
+        try:
+            response = self._client_stub.ModelReady(
+                pb.ModelReadyRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return response.ready
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = self._client_stub.ServerMetadata(
+                pb.ServerMetadataRequest(), metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            if self._verbose:
+                print(response)
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_model_metadata(
+        self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = self._client_stub.ModelMetadata(
+                pb.ModelMetadataRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_model_config(
+        self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = self._client_stub.ModelConfig(
+                pb.ModelConfigRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    # -- repository --------------------------------------------------------
+    def get_model_repository_index(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = self._client_stub.RepositoryIndex(
+                pb.RepositoryIndexRequest(), metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def load_model(
+        self, model_name, headers=None, config: Optional[str] = None,
+        files: Optional[Dict[str, bytes]] = None, client_timeout=None,
+    ):
+        request = pb.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = config
+        if files:
+            for path, content in files.items():
+                request.parameters[path].bytes_param = content
+        try:
+            self._client_stub.RepositoryModelLoad(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def unload_model(
+        self, model_name, headers=None, unload_dependents=False, client_timeout=None
+    ):
+        request = pb.RepositoryModelUnloadRequest(model_name=model_name)
+        request.parameters["unload_dependents"].bool_param = unload_dependents
+        try:
+            self._client_stub.RepositoryModelUnload(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    # -- statistics / trace / logging --------------------------------------
+    def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = self._client_stub.ModelStatistics(
+                pb.ModelStatisticsRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def update_trace_settings(
+        self, model_name=None, settings=None, headers=None, as_json=False, client_timeout=None
+    ):
+        request = pb.TraceSettingRequest(model_name=model_name or "")
+        for key, value in (settings or {}).items():
+            if value is not None:
+                vals = value if isinstance(value, list) else [str(value)]
+                request.settings[key].value.extend(vals)
+            else:
+                request.settings[key].SetInParent()
+        try:
+            response = self._client_stub.TraceSetting(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_trace_settings(self, model_name=None, headers=None, as_json=False, client_timeout=None):
+        return self.update_trace_settings(model_name, None, headers, as_json, client_timeout)
+
+    def update_log_settings(self, settings, headers=None, as_json=False, client_timeout=None):
+        request = pb.LogSettingsRequest()
+        for key, value in settings.items():
+            if isinstance(value, bool):
+                request.settings[key].bool_param = value
+            elif isinstance(value, int):
+                request.settings[key].uint32_param = value
+            else:
+                request.settings[key].string_param = str(value)
+        try:
+            response = self._client_stub.LogSettings(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_log_settings(self, headers=None, as_json=False, client_timeout=None):
+        return self.update_log_settings({}, headers, as_json, client_timeout)
+
+    # -- shared memory -----------------------------------------------------
+    def get_system_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = self._client_stub.SystemSharedMemoryStatus(
+                pb.SystemSharedMemoryStatusRequest(name=region_name),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, client_timeout=None
+    ):
+        try:
+            self._client_stub.SystemSharedMemoryRegister(
+                pb.SystemSharedMemoryRegisterRequest(
+                    name=name, key=key, offset=offset, byte_size=byte_size
+                ),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def unregister_system_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            self._client_stub.SystemSharedMemoryUnregister(
+                pb.SystemSharedMemoryUnregisterRequest(name=name),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = self._client_stub.CudaSharedMemoryStatus(
+                pb.CudaSharedMemoryStatusRequest(name=region_name),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def register_cuda_shared_memory(
+        self, name, raw_handle: bytes, device_id: int, byte_size: int,
+        headers=None, client_timeout=None,
+    ):
+        """Register a device-buffer region; ``raw_handle`` comes from
+        ``xla_shared_memory.get_raw_handle`` (v2 wire name kept for compat,
+        reference :1339-1388)."""
+        try:
+            self._client_stub.CudaSharedMemoryRegister(
+                pb.CudaSharedMemoryRegisterRequest(
+                    name=name, raw_handle=raw_handle, device_id=device_id,
+                    byte_size=byte_size,
+                ),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    register_xla_shared_memory = register_cuda_shared_memory
+    get_xla_shared_memory_status = get_cuda_shared_memory_status
+
+    def unregister_cuda_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            self._client_stub.CudaSharedMemoryUnregister(
+                pb.CudaSharedMemoryUnregisterRequest(name=name),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    unregister_xla_shared_memory = unregister_cuda_shared_memory
+
+    # -- inference ---------------------------------------------------------
+    def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+    ) -> InferResult:
+        """Synchronous inference (reference :1445-1572)."""
+        request = get_inference_request(
+            model_name, inputs, model_version, request_id, outputs,
+            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+        )
+        if self._verbose:
+            print(f"infer, metadata {self._get_metadata(headers)}\n{request}")
+        try:
+            response = self._client_stub.ModelInfer(
+                request,
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+                compression=get_grpc_compression(compression_algorithm),
+            )
+            if self._verbose:
+                print(response)
+            return InferResult(response)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def async_infer(
+        self,
+        model_name,
+        inputs,
+        callback=None,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+    ):
+        """Asynchronous inference via gRPC future (reference :1574-1741).
+
+        With ``callback``: invoked as ``callback(result, error)`` from a gRPC
+        thread; returns a ``CallContext`` for cancellation.  Without:
+        returns an ``InferAsyncRequest`` whose ``get_result()`` blocks."""
+        request = get_inference_request(
+            model_name, inputs, model_version, request_id, outputs,
+            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+        )
+        call = self._client_stub.ModelInfer.future(
+            request,
+            metadata=self._get_metadata(headers),
+            timeout=client_timeout,
+            compression=get_grpc_compression(compression_algorithm),
+        )
+        if callback is None:
+            return InferAsyncRequest(call)
+
+        def _done(c):
+            try:
+                response = c.result()
+                callback(result=InferResult(response), error=None)
+            except grpc.RpcError as rpc_error:
+                callback(result=None, error=get_error_grpc(rpc_error))
+            except grpc.FutureCancelledError:
+                from ..utils import InferenceServerException
+
+                callback(
+                    result=None,
+                    error=InferenceServerException(
+                        msg="Locally cancelled by application!",
+                        status="StatusCode.CANCELLED",
+                    ),
+                )
+
+        call.add_done_callback(_done)
+        return CallContext(call)
+
+    # -- streaming ---------------------------------------------------------
+    def start_stream(
+        self,
+        callback,
+        stream_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+    ) -> None:
+        """Open the bidi stream; ``callback(result, error)`` runs on a reader
+        thread for every stream message (reference :1743-1798)."""
+        if self._stream is not None:
+            raise_error(
+                "cannot start another stream with one already running. "
+                "'InferenceServerClient' supports only a single active stream "
+                "at a given time."
+            )
+        self._stream = _InferStream(callback, self._verbose)
+        try:
+            response_iterator = self._client_stub.ModelStreamInfer(
+                _RequestIterator(self._stream),
+                metadata=self._get_metadata(headers),
+                timeout=stream_timeout,
+                compression=get_grpc_compression(compression_algorithm),
+            )
+            self._stream._init_handler(response_iterator)
+        except grpc.RpcError as e:
+            self._stream = None
+            raise_error_grpc(e)
+
+    def async_stream_infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        enable_empty_final_response=False,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ) -> None:
+        """Enqueue a request on the active stream (reference :1815-1935)."""
+        if self._stream is None:
+            raise_error("stream not available, start_stream() must be called first.")
+        request = get_inference_request(
+            model_name, inputs, model_version, request_id, outputs,
+            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+        )
+        if enable_empty_final_response:
+            request.parameters["triton_enable_empty_final_response"].bool_param = True
+        if self._verbose:
+            print(f"async_stream_infer\n{request}")
+        self._stream._enqueue_request(request)
+
+    def stop_stream(self, cancel_requests: bool = False) -> None:
+        """Close the active stream (reference :1800-1813)."""
+        if self._stream is not None:
+            self._stream.close(cancel_requests)
+        self._stream = None
+
+
+def _maybe_json(message, as_json: bool):
+    if not as_json:
+        return message
+    from google.protobuf import json_format
+
+    return json_format.MessageToDict(message, preserving_proto_field_name=True)

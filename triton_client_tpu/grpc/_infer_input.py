@@ -1,0 +1,84 @@
+"""gRPC-protocol ``InferInput``.
+
+Parity target: reference ``tritonclient/grpc/_infer_input.py`` (219 LoC) —
+wraps ``ModelInferRequest.InferInputTensor``; raw bytes travel positionally
+in ``raw_input_contents`` (:160-174)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..protocol import inference_pb2 as pb
+from ..utils import (
+    np_to_triton_dtype,
+    raise_error,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+)
+
+
+class InferInput:
+    def __init__(self, name: str, shape: List[int], datatype: str):
+        self._input = pb.ModelInferRequest.InferInputTensor(name=name, datatype=datatype)
+        self._input.shape.extend(int(s) for s in shape)
+        self._raw_content: Optional[bytes] = None
+
+    def name(self) -> str:
+        return self._input.name
+
+    def datatype(self) -> str:
+        return self._input.datatype
+
+    def shape(self) -> List[int]:
+        return list(self._input.shape)
+
+    def set_shape(self, shape: List[int]) -> "InferInput":
+        self._input.ClearField("shape")
+        self._input.shape.extend(int(s) for s in shape)
+        return self
+
+    def set_data_from_numpy(self, input_tensor: np.ndarray) -> "InferInput":
+        """Attach tensor data (always the raw representation on gRPC,
+        reference :94-158)."""
+        if not isinstance(input_tensor, np.ndarray):
+            raise_error("input_tensor must be a numpy array")
+        dtype = np_to_triton_dtype(input_tensor.dtype)
+        expected = self._input.datatype
+        if expected != dtype and not (expected == "BF16" and dtype == "FP32"):
+            raise_error(
+                f"got unexpected datatype {dtype} from numpy array, expected {expected}"
+            )
+        if list(input_tensor.shape) != list(self._input.shape):
+            raise_error(
+                f"got unexpected numpy array shape [{str(input_tensor.shape)[1:-1]}], "
+                f"expected [{str(list(self._input.shape))[1:-1]}]"
+            )
+        self._input.parameters.pop("shared_memory_region", None)
+        self._input.parameters.pop("shared_memory_byte_size", None)
+        self._input.parameters.pop("shared_memory_offset", None)
+        if expected == "BYTES":
+            serialized = serialize_byte_tensor(input_tensor)
+            self._raw_content = serialized.tobytes() if serialized is not None else b""
+        elif expected == "BF16":
+            self._raw_content = serialize_bf16_tensor(input_tensor).tobytes()
+        else:
+            self._raw_content = input_tensor.tobytes()
+        return self
+
+    def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
+        """Reference data in a registered shm region (:176-207)."""
+        self._input.ClearField("contents")
+        self._raw_content = None
+        self._input.parameters["shared_memory_region"].string_param = region_name
+        self._input.parameters["shared_memory_byte_size"].int64_param = byte_size
+        if offset != 0:
+            self._input.parameters["shared_memory_offset"].int64_param = offset
+        return self
+
+    def _get_tensor_pb(self) -> pb.ModelInferRequest.InferInputTensor:
+        return self._input
+
+    def _get_raw_data(self) -> Optional[bytes]:
+        return self._raw_content

@@ -1,0 +1,61 @@
+"""gRPC-protocol ``InferResult``.
+
+Parity target: reference ``tritonclient/grpc/_infer_result.py`` (159 LoC):
+reads ``raw_output_contents[index]`` positionally (:63-97); ``as_numpy``
+deserializes BYTES/BF16."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..protocol import inference_pb2 as pb
+from ..utils import (
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    triton_to_np_dtype,
+)
+
+
+class InferResult:
+    def __init__(self, result: pb.ModelInferResponse):
+        self._result = result
+
+    def as_numpy(self, name: str) -> Optional[np.ndarray]:
+        for index, output in enumerate(self._result.outputs):
+            if output.name != name:
+                continue
+            shape = [int(s) for s in output.shape]
+            if index >= len(self._result.raw_output_contents):
+                return None
+            buf = self._result.raw_output_contents[index]
+            if not buf and "shared_memory_region" in output.parameters:
+                return None  # data lives in the region
+            if output.datatype == "BYTES":
+                return deserialize_bytes_tensor(buf).reshape(shape)
+            if output.datatype == "BF16":
+                return deserialize_bf16_tensor(buf).reshape(shape)
+            dt = triton_to_np_dtype(output.datatype)
+            if dt is None:
+                return None
+            return np.frombuffer(buf, dtype=dt).reshape(shape)
+        return None
+
+    def get_output(self, name: str, as_json: bool = False):
+        """The output pb (or its JSON dict) by name (reference :99-133)."""
+        for output in self._result.outputs:
+            if output.name == name:
+                if as_json:
+                    from google.protobuf import json_format
+
+                    return json_format.MessageToDict(output, preserving_proto_field_name=True)
+                return output
+        return None
+
+    def get_response(self, as_json: bool = False):
+        if as_json:
+            from google.protobuf import json_format
+
+            return json_format.MessageToDict(self._result, preserving_proto_field_name=True)
+        return self._result

@@ -1,0 +1,105 @@
+"""Bidirectional-stream plumbing for the gRPC client.
+
+Parity target: reference ``tritonclient/grpc/_infer_stream.py`` (192 LoC):
+``_InferStream`` = request ``queue.Queue`` + dedicated response-reader thread
+invoking the user callback (:57-167); ``_RequestIterator`` blocks on the
+queue with a ``None`` sentinel ending the stream (:170-191); cancellation
+surfaces ``StatusCode.CANCELLED`` per in-flight request (:157-167).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import grpc
+
+from ..utils import InferenceServerException, raise_error
+from ._infer_result import InferResult
+from ._utils import get_error_grpc
+
+
+class _InferStream:
+    def __init__(self, callback: Callable, verbose: bool = False):
+        self._callback = callback
+        self._verbose = verbose
+        self._request_queue: "queue.Queue" = queue.Queue()
+        self._handler: Optional[threading.Thread] = None
+        self._response_iterator = None
+        self._active = True
+
+    def __del__(self):
+        self.close(cancel_requests=False)
+
+    def close(self, cancel_requests: bool = False) -> None:
+        """End the stream: optionally cancel in-flight requests, else flush
+        the queue with the None sentinel and join the reader."""
+        if cancel_requests and self._response_iterator is not None:
+            self._response_iterator.cancel()
+            self._active = False
+        if self._handler is not None:
+            self._request_queue.put(None)
+            if self._handler.is_alive():
+                self._handler.join()
+            if self._verbose:
+                print("stream stopped...")
+            self._handler = None
+
+    def _init_handler(self, response_iterator) -> None:
+        self._response_iterator = response_iterator
+        if self._handler is not None:
+            raise_error("Attempted to initialize already initialized InferStream")
+        self._handler = threading.Thread(
+            target=self._process_response, name="tc-tpu-stream-reader"
+        )
+        self._handler.daemon = True
+        self._handler.start()
+
+    def _enqueue_request(self, request) -> None:
+        if not self._active:
+            raise_error("The stream is no longer in valid state, the error detail "
+                        "is reported through provided callback. A new stream should "
+                        "be started after stopping the current stream.")
+        self._request_queue.put(request)
+
+    def _get_request(self):
+        return self._request_queue.get()
+
+    def _process_response(self) -> None:
+        """Reader loop: each stream message is either an in-band error or an
+        infer response handed to the callback."""
+        try:
+            for response in self._response_iterator:
+                if self._verbose:
+                    print(response)
+                result = error = None
+                if response.error_message != "":
+                    error = InferenceServerException(msg=response.error_message)
+                else:
+                    result = InferResult(response.infer_response)
+                self._callback(result=result, error=error)
+        except grpc.RpcError as rpc_error:
+            # On cancellation only notify once with CANCELLED (reference
+            # :157-167); other errors deactivate the stream and surface.
+            if rpc_error.code() == grpc.StatusCode.CANCELLED:
+                self._callback(result=None, error=get_error_grpc(rpc_error))
+            else:
+                self._active = False
+                self._callback(result=None, error=get_error_grpc(rpc_error))
+
+
+class _RequestIterator:
+    """Iterator the gRPC sender thread pulls requests from."""
+
+    def __init__(self, stream: _InferStream):
+        self._stream = stream
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        request = self._stream._get_request()
+        if request is None:
+            raise StopIteration
+        return request

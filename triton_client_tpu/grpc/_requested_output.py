@@ -1,0 +1,32 @@
+"""gRPC-protocol ``InferRequestedOutput`` (reference
+``tritonclient/grpc/_requested_output.py``)."""
+
+from __future__ import annotations
+
+from ..protocol import inference_pb2 as pb
+
+
+class InferRequestedOutput:
+    def __init__(self, name: str, class_count: int = 0):
+        self._output = pb.ModelInferRequest.InferRequestedOutputTensor(name=name)
+        if class_count != 0:
+            self._output.parameters["classification"].int64_param = class_count
+
+    def name(self) -> str:
+        return self._output.name
+
+    def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
+        self._output.parameters["shared_memory_region"].string_param = region_name
+        self._output.parameters["shared_memory_byte_size"].int64_param = byte_size
+        if offset != 0:
+            self._output.parameters["shared_memory_offset"].int64_param = offset
+        return self
+
+    def unset_shared_memory(self):
+        self._output.parameters.pop("shared_memory_region", None)
+        self._output.parameters.pop("shared_memory_byte_size", None)
+        self._output.parameters.pop("shared_memory_offset", None)
+        return self
+
+    def _get_tensor_pb(self) -> pb.ModelInferRequest.InferRequestedOutputTensor:
+        return self._output

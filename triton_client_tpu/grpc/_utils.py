@@ -1,0 +1,103 @@
+"""gRPC client helpers (reference ``tritonclient/grpc/_utils.py``, 159 LoC)."""
+
+from __future__ import annotations
+
+import grpc
+
+from ..protocol import inference_pb2 as pb
+from ..utils import raise_error
+
+_RESERVED_PARAMS = (
+    "sequence_id",
+    "sequence_start",
+    "sequence_end",
+    "priority",
+    "binary_data_output",
+)
+
+
+def get_error_grpc(rpc_error: grpc.RpcError):
+    """Map an RpcError to InferenceServerException (reference :33-45)."""
+    from ..utils import InferenceServerException
+
+    return InferenceServerException(
+        msg=rpc_error.details(),
+        status=str(rpc_error.code()),
+        debug_details=rpc_error.debug_error_string()
+        if hasattr(rpc_error, "debug_error_string")
+        else None,
+    )
+
+
+def raise_error_grpc(rpc_error: grpc.RpcError):
+    raise get_error_grpc(rpc_error) from None
+
+
+def get_inference_request(
+    model_name,
+    inputs,
+    model_version,
+    request_id,
+    outputs,
+    sequence_id,
+    sequence_start,
+    sequence_end,
+    priority,
+    timeout,
+    parameters,
+) -> pb.ModelInferRequest:
+    """Build a ModelInferRequest pb (reference :80-143): tensors + positional
+    raw_input_contents; sequence_id may be int64 **or string** (string ids go
+    in ``sequence_id`` as string_param, reference :105-111)."""
+    request = pb.ModelInferRequest(model_name=model_name, model_version=model_version)
+    if request_id:
+        request.id = request_id
+    if sequence_id:
+        if isinstance(sequence_id, str):
+            request.parameters["sequence_id"].string_param = sequence_id
+        else:
+            request.parameters["sequence_id"].int64_param = sequence_id
+        request.parameters["sequence_start"].bool_param = sequence_start
+        request.parameters["sequence_end"].bool_param = sequence_end
+    if priority:
+        request.parameters["priority"].uint64_param = priority
+    if timeout is not None:
+        request.parameters["timeout"].int64_param = timeout
+
+    for input_tensor in inputs:
+        request.inputs.append(input_tensor._get_tensor_pb())
+        raw = input_tensor._get_raw_data()
+        if raw is not None:
+            request.raw_input_contents.append(raw)
+    if outputs is not None:
+        for output_tensor in outputs:
+            request.outputs.append(output_tensor._get_tensor_pb())
+
+    if parameters:
+        for key, value in parameters.items():
+            if key in _RESERVED_PARAMS:
+                raise_error(
+                    f"Parameter {key!r} is a reserved parameter and cannot be specified."
+                )
+            if isinstance(value, bool):
+                request.parameters[key].bool_param = value
+            elif isinstance(value, int):
+                request.parameters[key].int64_param = value
+            elif isinstance(value, float):
+                request.parameters[key].double_param = value
+            elif isinstance(value, str):
+                request.parameters[key].string_param = value
+            else:
+                raise_error(f"Unsupported parameter type for {key!r}")
+    return request
+
+
+# compression name -> grpc enum (reference :146-158)
+def get_grpc_compression(algorithm):
+    if algorithm is None or algorithm == "none":
+        return grpc.Compression.NoCompression
+    if algorithm == "deflate":
+        return grpc.Compression.Deflate
+    if algorithm == "gzip":
+        return grpc.Compression.Gzip
+    raise_error(f"unsupported compression algorithm {algorithm!r}")

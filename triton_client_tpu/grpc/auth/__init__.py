@@ -1,0 +1,5 @@
+"""Auth plugins for the gRPC client (reference ``tritonclient/grpc/auth``)."""
+
+from ..._auth import BasicAuth
+
+__all__ = ["BasicAuth"]

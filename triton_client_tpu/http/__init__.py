@@ -1,0 +1,17 @@
+"""HTTP/REST client for the v2 inference protocol.
+
+Mirrors the reference's ``tritonclient.http`` package surface."""
+
+from .._auth import BasicAuth  # noqa: F401 (re-export parity)
+from ._client import InferAsyncRequest, InferenceServerClient
+from ._infer_input import InferInput
+from ._infer_result import InferResult
+from ._requested_output import InferRequestedOutput
+
+__all__ = [
+    "InferenceServerClient",
+    "InferAsyncRequest",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+]

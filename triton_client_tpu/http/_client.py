@@ -1,0 +1,548 @@
+"""Synchronous HTTP/REST ``InferenceServerClient``.
+
+Parity target: reference ``tritonclient/http/_client.py`` (1659 LoC) — same
+~30-method surface and URI scheme (builders surveyed at :364-1474), same
+binary-over-HTTP framing (``Inference-Header-Content-Length``), same
+async_infer future semantics (:46-99, :1486-1659).
+
+Transport re-design (TPU-VM-idiomatic, not a port): the reference rides
+gevent greenlets + geventhttpclient; this client uses a ``urllib3``
+connection pool (``concurrency`` pooled connections) plus a thread pool for
+``async_infer`` — no monkey-patching, plays nicely with jax host threads.
+"""
+
+from __future__ import annotations
+
+import gzip
+import zlib
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+from urllib.parse import quote, urlencode
+
+import urllib3
+
+from .._client import InferenceServerClientBase
+from .._request import Request
+from ..utils import InferenceServerException, raise_error
+from ._infer_result import InferResult
+from ._utils import get_inference_request_body, raise_if_error
+
+
+class InferAsyncRequest:
+    """Handle for an in-flight async_infer (reference class :46-99)."""
+
+    def __init__(self, future: Future, verbose: bool = False):
+        self._future = future
+        self._verbose = verbose
+
+    def get_result(self, block: bool = True, timeout: Optional[float] = None) -> InferResult:
+        """Block (by default) until the response arrives and return the
+        InferResult; raises InferenceServerException on error or timeout."""
+        try:
+            return self._future.result(timeout=timeout if block else 0)
+        except InferenceServerException:
+            raise
+        except TimeoutError:
+            raise_error("failed to obtain inference response")
+        except Exception as e:
+            raise_error(f"failed to obtain inference response: {e}")
+
+    def cancel(self) -> bool:
+        return self._future.cancel()
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Client for the v2 protocol over HTTP/REST.
+
+    This client is **not thread-safe for concurrent calls on one instance's
+    sequence state**, but the underlying pool is; `async_infer` may be issued
+    concurrently up to ``concurrency`` in-flight requests (the reference's
+    contract: http/_client.py:103-108 single-stream; pooled connections
+    :182-191).
+    """
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        concurrency: int = 1,
+        connection_timeout: float = 60.0,
+        network_timeout: float = 60.0,
+        max_greenlets: Optional[int] = None,  # accepted for API compat
+        ssl: bool = False,
+        ssl_options: Optional[dict] = None,
+        ssl_context_factory=None,  # accepted for API compat
+        insecure: bool = False,
+    ):
+        super().__init__()
+        if url.startswith("http://") or url.startswith("https://"):
+            raise_error("url should not include the scheme")
+        scheme = "https://" if ssl else "http://"
+        self._parsed_url = scheme + url
+        self._base_uri = self._parsed_url.rstrip("/")
+        self._verbose = verbose
+        self._concurrency = concurrency
+        self._timeout = urllib3.Timeout(connect=connection_timeout, read=network_timeout)
+        pool_kwargs: Dict[str, Any] = dict(
+            num_pools=1,
+            maxsize=max(concurrency, 1),
+            block=False,
+            timeout=self._timeout,
+        )
+        if ssl:
+            if insecure:
+                pool_kwargs["cert_reqs"] = "CERT_NONE"
+                urllib3.disable_warnings()
+            if ssl_options:
+                for k in ("ca_certs", "cert_file", "key_file", "cert_reqs", "ssl_version"):
+                    if k in ssl_options:
+                        pool_kwargs[k] = ssl_options[k]
+        self._pool = urllib3.PoolManager(**pool_kwargs)
+        self._executor: Optional[ThreadPoolExecutor] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        """Close the client; blocks until in-flight async requests finish
+        (reference :257-266)."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+        self._pool.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- low-level ---------------------------------------------------------
+    def _build_headers(self, headers: Optional[dict]) -> dict:
+        request = Request(dict(headers) if headers else {})
+        self._call_plugin(request)
+        bad = [
+            k
+            for k in request.headers
+            if k.lower() in ("transfer-encoding",)
+        ]
+        if bad:
+            raise_error(
+                f"Unsupported headers {bad}; use a different client or remove them."
+            )
+        return request.headers
+
+    def _uri(self, path: str, query_params: Optional[dict]) -> str:
+        uri = f"{self._base_uri}/{path}"
+        if query_params:
+            uri += "?" + urlencode(query_params, doseq=True)
+        return uri
+
+    def _get(self, path: str, headers: Optional[dict], query_params: Optional[dict]):
+        uri = self._uri(path, query_params)
+        if self._verbose:
+            print(f"GET {uri}, headers {headers}")
+        response = self._pool.request("GET", uri, headers=self._build_headers(headers))
+        if self._verbose:
+            print(response.status)
+        return response
+
+    def _post(
+        self,
+        path: str,
+        body: bytes,
+        headers: Optional[dict],
+        query_params: Optional[dict],
+        extra_headers: Optional[dict] = None,
+    ):
+        uri = self._uri(path, query_params)
+        hdrs = self._build_headers(headers)
+        if extra_headers:
+            hdrs.update(extra_headers)
+        if self._verbose:
+            print(f"POST {uri}, headers {hdrs}\n{body[:256]!r}")
+        response = self._pool.request(
+            "POST", uri, body=body, headers=hdrs, preload_content=True
+        )
+        if self._verbose:
+            print(response.status)
+        return response
+
+    # -- health / metadata (reference :340-580) ----------------------------
+    def is_server_live(self, headers=None, query_params=None) -> bool:
+        response = self._get("v2/health/live", headers, query_params)
+        return response.status == 200
+
+    def is_server_ready(self, headers=None, query_params=None) -> bool:
+        response = self._get("v2/health/ready", headers, query_params)
+        return response.status == 200
+
+    def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        response = self._get(f"{path}/ready", headers, query_params)
+        return response.status == 200
+
+    def get_server_metadata(self, headers=None, query_params=None) -> dict:
+        response = self._get("v2", headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    def get_model_metadata(
+        self, model_name, model_version="", headers=None, query_params=None
+    ) -> dict:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        response = self._get(path, headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    def get_model_config(
+        self, model_name, model_version="", headers=None, query_params=None
+    ) -> dict:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        response = self._get(f"{path}/config", headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    # -- repository (reference :582-707) -----------------------------------
+    def get_model_repository_index(self, headers=None, query_params=None) -> list:
+        response = self._post("v2/repository/index", b"", headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    def load_model(
+        self,
+        model_name,
+        headers=None,
+        query_params=None,
+        config: Optional[str] = None,
+        files: Optional[Dict[str, bytes]] = None,
+    ) -> None:
+        """Request the server to load/reload a model; ``config`` is a JSON
+        config override, ``files`` maps "file:<path>" to raw bytes sent
+        base64'd (reference :620-671)."""
+        import base64
+        import json
+
+        load_request: Dict[str, Any] = {}
+        if config is not None or files:
+            load_request["parameters"] = {}
+        if config is not None:
+            load_request["parameters"]["config"] = config
+        if files:
+            for path, content in files.items():
+                load_request["parameters"][path] = base64.b64encode(content).decode()
+        response = self._post(
+            f"v2/repository/models/{quote(model_name)}/load",
+            json.dumps(load_request).encode() if load_request else b"",
+            headers,
+            query_params,
+        )
+        raise_if_error(response.status, response.data)
+
+    def unload_model(
+        self, model_name, headers=None, query_params=None, unload_dependents: bool = False
+    ) -> None:
+        import json
+
+        body = {"parameters": {"unload_dependents": unload_dependents}}
+        response = self._post(
+            f"v2/repository/models/{quote(model_name)}/unload",
+            json.dumps(body).encode(),
+            headers,
+            query_params,
+        )
+        raise_if_error(response.status, response.data)
+
+    # -- statistics / trace / logging (reference :709-943) -----------------
+    def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, query_params=None
+    ) -> dict:
+        if model_name:
+            path = f"v2/models/{quote(model_name)}"
+            if model_version:
+                path += f"/versions/{model_version}"
+            path += "/stats"
+        else:
+            path = "v2/models/stats"
+        response = self._get(path, headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    def update_trace_settings(
+        self, model_name=None, settings: Optional[dict] = None, headers=None, query_params=None
+    ) -> dict:
+        import json
+
+        path = (
+            f"v2/models/{quote(model_name)}/trace/setting" if model_name else "v2/trace/setting"
+        )
+        response = self._post(
+            path, json.dumps(settings or {}).encode(), headers, query_params
+        )
+        raise_if_error(response.status, response.data)
+        return json.loads(response.data)
+
+    def get_trace_settings(self, model_name=None, headers=None, query_params=None) -> dict:
+        path = (
+            f"v2/models/{quote(model_name)}/trace/setting" if model_name else "v2/trace/setting"
+        )
+        response = self._get(path, headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    def update_log_settings(self, settings: dict, headers=None, query_params=None) -> dict:
+        import json
+
+        response = self._post("v2/logging", json.dumps(settings).encode(), headers, query_params)
+        raise_if_error(response.status, response.data)
+        return json.loads(response.data)
+
+    def get_log_settings(self, headers=None, query_params=None) -> dict:
+        response = self._get("v2/logging", headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    # -- shared memory (reference :945-1203) -------------------------------
+    def get_system_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ) -> list:
+        path = "v2/systemsharedmemory"
+        if region_name:
+            path += f"/region/{quote(region_name)}"
+        response = self._get(f"{path}/status", headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, query_params=None
+    ) -> None:
+        import json
+
+        body = {"key": key, "offset": offset, "byte_size": byte_size}
+        response = self._post(
+            f"v2/systemsharedmemory/region/{quote(name)}/register",
+            json.dumps(body).encode(),
+            headers,
+            query_params,
+        )
+        raise_if_error(response.status, response.data)
+
+    def unregister_system_shared_memory(
+        self, name="", headers=None, query_params=None
+    ) -> None:
+        if name:
+            path = f"v2/systemsharedmemory/region/{quote(name)}/unregister"
+        else:
+            path = "v2/systemsharedmemory/unregister"
+        response = self._post(path, b"", headers, query_params)
+        raise_if_error(response.status, response.data)
+
+    def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ) -> list:
+        path = "v2/cudasharedmemory"
+        if region_name:
+            path += f"/region/{quote(region_name)}"
+        response = self._get(f"{path}/status", headers, query_params)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
+    def register_cuda_shared_memory(
+        self, name, raw_handle: bytes, device_id: int, byte_size: int,
+        headers=None, query_params=None
+    ) -> None:
+        """Register a device-buffer region.  ``raw_handle`` is the
+        base64-encodable handle from ``xla_shared_memory.get_raw_handle``
+        (reference cudashm flow: :1111-1165, handle b64 at :1153)."""
+        import base64
+        import json
+
+        body = {
+            "raw_handle": {"b64": base64.b64encode(raw_handle).decode()},
+            "device_id": device_id,
+            "byte_size": byte_size,
+        }
+        response = self._post(
+            f"v2/cudasharedmemory/region/{quote(name)}/register",
+            json.dumps(body).encode(),
+            headers,
+            query_params,
+        )
+        raise_if_error(response.status, response.data)
+
+    # TPU-native alias: same RPC, honest name.
+    register_xla_shared_memory = register_cuda_shared_memory
+
+    def unregister_cuda_shared_memory(self, name="", headers=None, query_params=None) -> None:
+        if name:
+            path = f"v2/cudasharedmemory/region/{quote(name)}/unregister"
+        else:
+            path = "v2/cudasharedmemory/unregister"
+        response = self._post(path, b"", headers, query_params)
+        raise_if_error(response.status, response.data)
+
+    unregister_xla_shared_memory = unregister_cuda_shared_memory
+    get_xla_shared_memory_status = get_cuda_shared_memory_status
+
+    # -- inference (reference :1205-1659) ----------------------------------
+    @staticmethod
+    def generate_request_body(
+        inputs,
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ):
+        """Build (body, json_size) for store-and-forward use (reference static
+        :1218-1298)."""
+        return get_inference_request_body(
+            inputs, request_id, outputs, sequence_id, sequence_start, sequence_end,
+            priority, timeout, parameters,
+        )
+
+    @staticmethod
+    def parse_response_body(
+        response_body, verbose=False, header_length=None, content_encoding=None
+    ) -> InferResult:
+        """Parse a stored response body (reference static :1300-1329)."""
+        return InferResult.from_response_body(
+            response_body, verbose, header_length, content_encoding
+        )
+
+    def _infer_request(
+        self,
+        model_name,
+        inputs,
+        model_version,
+        outputs,
+        request_id,
+        sequence_id,
+        sequence_start,
+        sequence_end,
+        priority,
+        timeout,
+        headers,
+        query_params,
+        request_compression_algorithm,
+        response_compression_algorithm,
+        parameters,
+    ):
+        body, json_size = get_inference_request_body(
+            inputs, request_id, outputs, sequence_id, sequence_start, sequence_end,
+            priority, timeout, parameters,
+        )
+        extra_headers = {}
+        if request_compression_algorithm == "gzip":
+            body = gzip.compress(body)
+            extra_headers["Content-Encoding"] = "gzip"
+        elif request_compression_algorithm == "deflate":
+            body = zlib.compress(body)
+            extra_headers["Content-Encoding"] = "deflate"
+        if response_compression_algorithm in ("gzip", "deflate"):
+            extra_headers["Accept-Encoding"] = response_compression_algorithm
+        if json_size is not None:
+            extra_headers["Inference-Header-Content-Length"] = str(json_size)
+
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        path += "/infer"
+        response = self._post(path, body, headers, query_params, extra_headers)
+        raise_if_error(response.status, response.data)
+        header_length = response.headers.get("Inference-Header-Content-Length")
+        # urllib3 decodes gzip/deflate transparently, so no content_encoding.
+        return InferResult(
+            response.data,
+            self._verbose,
+            int(header_length) if header_length is not None else None,
+            None,
+        )
+
+    def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ) -> InferResult:
+        """Run a synchronous inference (reference :1331-1484)."""
+        return self._infer_request(
+            model_name, inputs, model_version, outputs, request_id, sequence_id,
+            sequence_start, sequence_end, priority, timeout, headers, query_params,
+            request_compression_algorithm, response_compression_algorithm, parameters,
+        )
+
+    def async_infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ) -> InferAsyncRequest:
+        """Submit an inference to the client's worker pool and return a
+        handle (reference :1486-1659; greenlet pool → thread pool here)."""
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=self._concurrency, thread_name_prefix="tc-tpu-http"
+            )
+        future = self._executor.submit(
+            self._infer_request,
+            model_name, inputs, model_version, outputs, request_id, sequence_id,
+            sequence_start, sequence_end, priority, timeout, headers, query_params,
+            request_compression_algorithm, response_compression_algorithm, parameters,
+        )
+        return InferAsyncRequest(future, self._verbose)

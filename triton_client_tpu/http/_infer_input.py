@@ -1,0 +1,135 @@
+"""HTTP-protocol ``InferInput``.
+
+Parity target: reference ``tritonclient/http/_infer_input.py`` (272 LoC):
+JSON-or-binary encoding (binary default), UTF-8 validation on the JSON BYTES
+path (:166-196), shared-memory params mutually exclusive with data
+(:216-242).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import (
+    np_to_triton_dtype,
+    raise_error,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+)
+
+
+class InferInput:
+    """An input tensor for an inference request."""
+
+    def __init__(self, name: str, shape: List[int], datatype: str):
+        self._name = name
+        self._shape = list(shape)
+        self._datatype = datatype
+        self._parameters: dict = {}
+        self._data = None  # JSON path: flat python list
+        self._raw_data: Optional[bytes] = None  # binary path
+
+    def name(self) -> str:
+        return self._name
+
+    def datatype(self) -> str:
+        return self._datatype
+
+    def shape(self) -> List[int]:
+        return self._shape
+
+    def set_shape(self, shape: List[int]) -> "InferInput":
+        self._shape = list(shape)
+        return self
+
+    def set_data_from_numpy(self, input_tensor: np.ndarray, binary_data: bool = True):
+        """Attach tensor data, binary (default) or JSON.
+
+        Matches reference semantics (:94-214): shape is validated against the
+        tensor, BYTES handled per representation, BF16 requires binary (the
+        reference rejects JSON BF16 too — no portable JSON encoding).
+        """
+        if not isinstance(input_tensor, np.ndarray):
+            raise_error("input_tensor must be a numpy array")
+        dtype = np_to_triton_dtype(input_tensor.dtype)
+        if self._datatype != dtype:
+            if self._datatype == "BF16" and dtype == "FP32":
+                pass  # allow f32 staging for BF16 wire dtype (truncating)
+            else:
+                raise_error(
+                    f"got unexpected datatype {dtype} from numpy array, "
+                    f"expected {self._datatype}"
+                )
+        valid_shape = list(input_tensor.shape) == list(self._shape)
+        if not valid_shape:
+            raise_error(
+                f"got unexpected numpy array shape [{str(input_tensor.shape)[1:-1]}], "
+                f"expected [{str(self._shape)[1:-1]}]"
+            )
+
+        self._parameters.pop("shared_memory_region", None)
+        self._parameters.pop("shared_memory_byte_size", None)
+        self._parameters.pop("shared_memory_offset", None)
+
+        if not binary_data:
+            if self._datatype == "BF16":
+                raise_error("BF16 inputs must use binary_data=True")
+            self._parameters.pop("binary_data_size", None)
+            self._raw_data = None
+            if self._datatype == "BYTES":
+                try:
+                    if input_tensor.size > 0:
+                        self._data = [
+                            val.item().decode("utf-8") if isinstance(val.item(), bytes) else str(val.item())
+                            for val in np.nditer(input_tensor, flags=["refs_ok"], order="C")
+                        ]
+                    else:
+                        self._data = []
+                except UnicodeDecodeError:
+                    raise_error(
+                        f'Failed to encode "{self._name}" using UTF-8. Please use '
+                        "binary_data=True, if you want to pass a byte array."
+                    )
+            else:
+                self._data = [val.item() for val in input_tensor.flatten(order="C")]
+        else:
+            self._data = None
+            if self._datatype == "BYTES":
+                serialized = serialize_byte_tensor(input_tensor)
+                self._raw_data = serialized.tobytes() if serialized is not None else b""
+            elif self._datatype == "BF16":
+                self._raw_data = serialize_bf16_tensor(input_tensor).tobytes()
+            else:
+                self._raw_data = input_tensor.tobytes()
+            self._parameters["binary_data_size"] = len(self._raw_data)
+        return self
+
+    def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
+        """Reference the tensor data in a registered shm region (:216-242) —
+        clears any inline data."""
+        self._data = None
+        self._raw_data = None
+        self._parameters.pop("binary_data_size", None)
+        self._parameters["shared_memory_region"] = region_name
+        self._parameters["shared_memory_byte_size"] = byte_size
+        if offset != 0:
+            self._parameters["shared_memory_offset"] = offset
+        return self
+
+    # -- wire building (used by the client; reference :244-271) -----------
+    def _get_tensor(self) -> dict:
+        tensor = {
+            "name": self._name,
+            "shape": self._shape,
+            "datatype": self._datatype,
+        }
+        if self._parameters:
+            tensor["parameters"] = dict(self._parameters)
+        if self._data is not None:
+            tensor["data"] = self._data
+        return tensor
+
+    def _get_binary_data(self) -> Optional[bytes]:
+        return self._raw_data

@@ -1,0 +1,97 @@
+"""HTTP-protocol ``InferResult``.
+
+Parity target: reference ``tritonclient/http/_infer_result.py`` (242 LoC):
+decompress body (:71-76), parse header JSON, slice binary segments by
+cumulative ``binary_data_size`` (:95-106), ``as_numpy`` deserializing
+BYTES/BF16 (:157-210).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from ..utils import (
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    raise_error,
+    triton_to_np_dtype,
+)
+
+
+class InferResult:
+    def __init__(self, response_body: bytes, verbose: bool = False,
+                 header_length: Optional[int] = None,
+                 content_encoding: Optional[str] = None):
+        """Parse a v2 infer response body (optionally compressed)."""
+        if content_encoding == "gzip":
+            response_body = gzip.decompress(response_body)
+        elif content_encoding == "deflate":
+            response_body = zlib.decompress(response_body)
+
+        self._buffer_map = {}
+        if header_length is None:
+            content = response_body
+            self._result = json.loads(content)
+        else:
+            header = response_body[:header_length]
+            self._result = json.loads(header)
+            offset = header_length
+            for output in self._result.get("outputs", []):
+                params = output.get("parameters", {})
+                size = params.get("binary_data_size")
+                if size is not None:
+                    self._buffer_map[output["name"]] = response_body[offset : offset + size]
+                    offset += size
+        if verbose:
+            print(self._result)
+
+    @classmethod
+    def from_response_body(cls, response_body, verbose=False, header_length=None,
+                           content_encoding=None):
+        """Static constructor matching the reference's store-and-forward path
+        (parse_response_body, http/_client.py:1300-1329)."""
+        return cls(response_body, verbose, header_length, content_encoding)
+
+    def as_numpy(self, name: str) -> Optional[np.ndarray]:
+        """Decode the named output to numpy; None if absent.  BYTES → object
+        array of bytes; BF16 → native bfloat16 array (TPU-first; the
+        reference shims through float32)."""
+        for output in self._result.get("outputs", []):
+            if output["name"] != name:
+                continue
+            shape = [int(s) for s in output["shape"]]
+            datatype = output["datatype"]
+            if name in self._buffer_map:
+                buf = self._buffer_map[name]
+                if datatype == "BYTES":
+                    return deserialize_bytes_tensor(buf).reshape(shape)
+                if datatype == "BF16":
+                    return deserialize_bf16_tensor(buf).reshape(shape)
+                return np.frombuffer(buf, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+            if "data" not in output:
+                return None  # shm output: data lives in the region
+            data = output["data"]
+            if datatype == "BYTES":
+                flat = np.array(
+                    [x.encode("utf-8") if isinstance(x, str) else bytes(x) for x in data],
+                    dtype=np.object_,
+                )
+                return flat.reshape(shape)
+            return np.array(data, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+        return None
+
+    def get_output(self, name: str) -> Optional[dict]:
+        """The output's JSON dict, or None (reference :212-231)."""
+        for output in self._result.get("outputs", []):
+            if output["name"] == name:
+                return output
+        return None
+
+    def get_response(self) -> dict:
+        """The full response JSON dict (reference :233-241)."""
+        return self._result

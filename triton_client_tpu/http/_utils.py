@@ -1,0 +1,86 @@
+"""HTTP client helpers (reference ``tritonclient/http/_utils.py``, 151 LoC)."""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from ..utils import InferenceServerException, raise_error
+
+
+def raise_if_error(status: int, body: bytes) -> None:
+    """Raise InferenceServerException for non-2xx responses, extracting the
+    v2 ``{"error": msg}`` payload when present (reference _get_error/
+    _raise_if_error, _utils.py:33-75)."""
+    if 200 <= status < 300:
+        return
+    msg = None
+    try:
+        msg = json.loads(body).get("error")
+    except Exception:
+        msg = body.decode("utf-8", errors="replace") if body else None
+    raise InferenceServerException(
+        msg=msg or f"[{status}] inference request failed", status=str(status)
+    )
+
+
+def get_inference_request_body(
+    inputs,
+    request_id: str,
+    outputs,
+    sequence_id,
+    sequence_start: bool,
+    sequence_end: bool,
+    priority: int,
+    timeout: Optional[int],
+    custom_parameters: Optional[dict],
+) -> Tuple[bytes, Optional[int]]:
+    """Build the infer request body: JSON header + concatenated raw buffers.
+    Returns (body, json_size) where json_size is None for JSON-only bodies
+    (reference _get_inference_request, _utils.py:85-150)."""
+    infer_request = {}
+    parameters = {}
+    if request_id:
+        infer_request["id"] = request_id
+    if sequence_id:
+        parameters["sequence_id"] = sequence_id
+        parameters["sequence_start"] = sequence_start
+        parameters["sequence_end"] = sequence_end
+    if priority:
+        parameters["priority"] = priority
+    if timeout is not None:
+        parameters["timeout"] = timeout
+
+    infer_request["inputs"] = [i._get_tensor() for i in inputs]
+    if outputs:
+        infer_request["outputs"] = [o._get_tensor() for o in outputs]
+    else:
+        # No outputs requested => return all, binary by default
+        parameters["binary_data_output"] = True
+
+    if custom_parameters:
+        for key, value in custom_parameters.items():
+            if key in (
+                "sequence_id",
+                "sequence_start",
+                "sequence_end",
+                "priority",
+                "binary_data_output",
+            ):
+                raise_error(
+                    f"Parameter {key!r} is a reserved parameter and cannot be specified."
+                )
+            parameters[key] = value
+    if parameters:
+        infer_request["parameters"] = parameters
+
+    request_body = json.dumps(infer_request)
+    json_size = len(request_body)
+    binary_data = b""
+    for input_tensor in inputs:
+        raw = input_tensor._get_binary_data()
+        if raw is not None:
+            binary_data += raw
+    if binary_data:
+        return request_body.encode() + binary_data, json_size
+    return request_body.encode(), None

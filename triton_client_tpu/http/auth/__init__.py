@@ -1,0 +1,5 @@
+"""Auth plugins for the HTTP client (reference ``tritonclient/http/auth``)."""
+
+from ..._auth import BasicAuth
+
+__all__ = ["BasicAuth"]

@@ -1,0 +1,7 @@
+"""Model zoo for the serving harness.
+
+``zoo`` holds the reference test-fixture models (SURVEY.md §4: identity /
+sum-diff / sequence / repeat-decoupled — the models every reference example
+and test drives); ``vision``/``language`` hold the benchmark model families
+(ResNet-50, BERT, Llama-style) with pjit shardings.
+"""

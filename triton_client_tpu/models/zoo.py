@@ -1,0 +1,223 @@
+"""Test-fixture model zoo.
+
+Recreates the live reference models that the reference's examples and tests
+assume exist on the server (SURVEY.md §4 fixture summary: `simple`,
+`simple_identity` (BYTES), `simple_sequence`, `repeat_int32` decoupled,
+`custom_identity_int32`, ...), as trivial JAX functions — the TPU translation
+of the reference's ONNX/custom-backend fixtures.
+
+Behavioral specs come from the examples (SURVEY.md §2.7):
+
+* ``simple`` — 2×INT32[1,16] in → OUTPUT0=sum, OUTPUT1=diff
+  (simple_http_infer_client.py).
+* ``simple_identity`` — BYTES[−1] passthrough (string clients).
+* ``simple_dyna_sequence`` / ``simple_sequence`` — stateful accumulator keyed
+  by sequence id; control flags start/end
+  (simple_grpc_sequence_stream_infer_client.py:58-79).
+* ``repeat_int32`` — decoupled: N responses per request (custom_repeat).
+* ``square_int32`` — decoupled: value → value responses of that value.
+* ``custom_identity_int32`` — passthrough, used by timeout tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterator
+
+import numpy as np
+
+from ..server.model import JaxModel, Model, PyModel, make_config
+from ..server.registry import ModelRegistry
+
+
+def make_simple() -> JaxModel:
+    import jax.numpy as jnp
+
+    cfg = make_config(
+        "simple",
+        inputs=[("INPUT0", "INT32", [1, 16]), ("INPUT1", "INT32", [1, 16])],
+        outputs=[("OUTPUT0", "INT32", [1, 16]), ("OUTPUT1", "INT32", [1, 16])],
+    )
+
+    def fn(INPUT0, INPUT1):
+        return {"OUTPUT0": jnp.add(INPUT0, INPUT1), "OUTPUT1": jnp.subtract(INPUT0, INPUT1)}
+
+    return JaxModel(cfg, fn)
+
+
+def make_simple_identity() -> PyModel:
+    cfg = make_config(
+        "simple_identity",
+        inputs=[("INPUT0", "BYTES", [-1])],
+        outputs=[("OUTPUT0", "BYTES", [-1])],
+        max_batch_size=8,
+    )
+
+    def fn(inputs, params):
+        return {"OUTPUT0": inputs["INPUT0"]}
+
+    return PyModel(cfg, fn)
+
+
+def make_custom_identity_int32() -> JaxModel:
+    cfg = make_config(
+        "custom_identity_int32",
+        inputs=[("INPUT0", "INT32", [-1])],
+        outputs=[("OUTPUT0", "INT32", [-1])],
+        max_batch_size=8,
+    )
+
+    def fn(INPUT0):
+        return {"OUTPUT0": INPUT0}
+
+    return JaxModel(cfg, fn)
+
+
+def make_identity_fp32() -> JaxModel:
+    cfg = make_config(
+        "identity_fp32",
+        inputs=[("INPUT0", "FP32", [-1])],
+        outputs=[("OUTPUT0", "FP32", [-1])],
+        max_batch_size=64,
+    )
+
+    def fn(INPUT0):
+        return {"OUTPUT0": INPUT0}
+
+    return JaxModel(cfg, fn)
+
+
+def make_identity_bf16() -> JaxModel:
+    cfg = make_config(
+        "identity_bf16",
+        inputs=[("INPUT0", "BF16", [-1])],
+        outputs=[("OUTPUT0", "BF16", [-1])],
+        max_batch_size=64,
+    )
+
+    def fn(INPUT0):
+        return {"OUTPUT0": INPUT0}
+
+    return JaxModel(cfg, fn)
+
+
+class SequenceModel(Model):
+    """Stateful per-sequence accumulator.
+
+    Matches the reference `simple_sequence` behavior spec: each request
+    carries one INT32[1] value; OUTPUT is the running accumulation for that
+    sequence id; `sequence_start` resets state, `sequence_end` finalizes it.
+    Sequence ids may be int64 or string (reference FLAGS.dyna handling,
+    simple_grpc_sequence_stream_infer_client.py:132-153)."""
+
+    def __init__(self, name: str = "simple_sequence"):
+        cfg = make_config(
+            name,
+            inputs=[("INPUT", "INT32", [1])],
+            outputs=[("OUTPUT", "INT32", [1])],
+            sequence_batching=True,
+        )
+        super().__init__(cfg)
+        self._state: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def execute(self, inputs, parameters):
+        seq_id = parameters.get("sequence_id", 0)
+        start = bool(parameters.get("sequence_start", False))
+        end = bool(parameters.get("sequence_end", False))
+        if not seq_id:
+            from ..server.types import InferError
+
+            raise InferError(
+                f"inference request to model '{self.name}' must specify a "
+                "non-zero or non-empty correlation ID"
+            )
+        value = int(np.asarray(inputs["INPUT"]).reshape(-1)[0])
+        with self._lock:
+            if start or seq_id not in self._state:
+                self._state[seq_id] = 0
+            self._state[seq_id] += value
+            acc = self._state[seq_id]
+            if end:
+                del self._state[seq_id]
+        return {"OUTPUT": np.array([acc], dtype=np.int32).reshape(1)}
+
+
+class DynaSequenceModel(SequenceModel):
+    """`simple_dyna_sequence` twist: like the reference custom backend, adds
+    the (hash of the) correlation id on start so tests can distinguish
+    sequences (behavior spec from simple_grpc_sequence_stream_infer_client.py
+    expectations)."""
+
+    def __init__(self):
+        super().__init__("simple_dyna_sequence")
+
+    def execute(self, inputs, parameters):
+        seq_id = parameters.get("sequence_id", 0)
+        start = bool(parameters.get("sequence_start", False))
+        corr_add = 0
+        if start:
+            corr_add = (hash(str(seq_id)) % 1000) if isinstance(seq_id, str) else int(seq_id)
+        out = super().execute(inputs, parameters)
+        out["OUTPUT"] = (out["OUTPUT"] + np.int32(corr_add)).astype(np.int32)
+        return out
+
+
+def make_repeat_int32() -> PyModel:
+    """Decoupled: IN[n] values, DELAY[n] (us), WAIT scalar — emits one
+    response per value (reference repeat backend driven by
+    simple_grpc_custom_repeat.py)."""
+    cfg = make_config(
+        "repeat_int32",
+        inputs=[("IN", "INT32", [-1]), ("DELAY", "UINT32", [-1]), ("WAIT", "UINT32", [1])],
+        outputs=[("OUT", "INT32", [1]), ("IDX", "UINT32", [1])],
+        decoupled=True,
+    )
+
+    def gen(inputs, params) -> Iterator[Dict[str, np.ndarray]]:
+        import time
+
+        values = np.asarray(inputs["IN"]).reshape(-1)
+        delays = np.asarray(inputs.get("DELAY", np.zeros_like(values))).reshape(-1)
+        wait = int(np.asarray(inputs.get("WAIT", [0])).reshape(-1)[0])
+        for i, v in enumerate(values):
+            if i < len(delays):
+                time.sleep(int(delays[i]) / 1e6)
+            yield {
+                "OUT": np.array([v], dtype=np.int32),
+                "IDX": np.array([i], dtype=np.uint32),
+            }
+        if wait:
+            time.sleep(wait / 1e6)
+
+    return PyModel(cfg, fn=None, decoupled_fn=gen)
+
+
+def make_square_int32() -> PyModel:
+    """Decoupled: scalar IN → IN responses each carrying IN (reference
+    square backend / decoupled test model)."""
+    cfg = make_config(
+        "square_int32",
+        inputs=[("IN", "INT32", [1])],
+        outputs=[("OUT", "INT32", [1])],
+        decoupled=True,
+    )
+
+    def gen(inputs, params):
+        n = int(np.asarray(inputs["IN"]).reshape(-1)[0])
+        for _ in range(max(n, 0)):
+            yield {"OUT": np.array([n], dtype=np.int32)}
+
+    return PyModel(cfg, fn=None, decoupled_fn=gen)
+
+
+def register_all(registry: ModelRegistry) -> None:
+    registry.register_model(make_simple())
+    registry.register_model(make_simple_identity())
+    registry.register_model(make_custom_identity_int32())
+    registry.register_model(make_identity_fp32())
+    registry.register_model(make_identity_bf16())
+    registry.register_model(SequenceModel())
+    registry.register_model(DynaSequenceModel())
+    registry.register_model(make_repeat_int32())
+    registry.register_model(make_square_int32())

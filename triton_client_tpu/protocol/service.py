@@ -1,0 +1,125 @@
+"""gRPC stub + servicer glue for the v2 inference service.
+
+The image has no ``grpc_tools`` protoc plugin, so instead of generated
+``*_pb2_grpc.py`` this module builds the client stub and server handler from
+``grpc``'s public generic API.  The wire behavior is identical to a
+plugin-generated stub: same full method names
+(``/inference.GRPCInferenceService/<Method>``), same (de)serializers — any
+third-party v2 stub (reference src/grpc_generated/{go,javascript}) interops.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import inference_pb2 as pb
+
+SERVICE_NAME = "inference.GRPCInferenceService"
+
+# method name -> (arity, request type, response type)
+# arity: "uu" unary-unary, "ss" stream-stream
+METHODS = {
+    "ServerLive": ("uu", pb.ServerLiveRequest, pb.ServerLiveResponse),
+    "ServerReady": ("uu", pb.ServerReadyRequest, pb.ServerReadyResponse),
+    "ModelReady": ("uu", pb.ModelReadyRequest, pb.ModelReadyResponse),
+    "ServerMetadata": ("uu", pb.ServerMetadataRequest, pb.ServerMetadataResponse),
+    "ModelMetadata": ("uu", pb.ModelMetadataRequest, pb.ModelMetadataResponse),
+    "ModelInfer": ("uu", pb.ModelInferRequest, pb.ModelInferResponse),
+    "ModelStreamInfer": ("ss", pb.ModelInferRequest, pb.ModelStreamInferResponse),
+    "ModelConfig": ("uu", pb.ModelConfigRequest, pb.ModelConfigResponse),
+    "ModelStatistics": ("uu", pb.ModelStatisticsRequest, pb.ModelStatisticsResponse),
+    "RepositoryIndex": ("uu", pb.RepositoryIndexRequest, pb.RepositoryIndexResponse),
+    "RepositoryModelLoad": ("uu", pb.RepositoryModelLoadRequest, pb.RepositoryModelLoadResponse),
+    "RepositoryModelUnload": (
+        "uu",
+        pb.RepositoryModelUnloadRequest,
+        pb.RepositoryModelUnloadResponse,
+    ),
+    "SystemSharedMemoryStatus": (
+        "uu",
+        pb.SystemSharedMemoryStatusRequest,
+        pb.SystemSharedMemoryStatusResponse,
+    ),
+    "SystemSharedMemoryRegister": (
+        "uu",
+        pb.SystemSharedMemoryRegisterRequest,
+        pb.SystemSharedMemoryRegisterResponse,
+    ),
+    "SystemSharedMemoryUnregister": (
+        "uu",
+        pb.SystemSharedMemoryUnregisterRequest,
+        pb.SystemSharedMemoryUnregisterResponse,
+    ),
+    "CudaSharedMemoryStatus": (
+        "uu",
+        pb.CudaSharedMemoryStatusRequest,
+        pb.CudaSharedMemoryStatusResponse,
+    ),
+    "CudaSharedMemoryRegister": (
+        "uu",
+        pb.CudaSharedMemoryRegisterRequest,
+        pb.CudaSharedMemoryRegisterResponse,
+    ),
+    "CudaSharedMemoryUnregister": (
+        "uu",
+        pb.CudaSharedMemoryUnregisterRequest,
+        pb.CudaSharedMemoryUnregisterResponse,
+    ),
+    "TraceSetting": ("uu", pb.TraceSettingRequest, pb.TraceSettingResponse),
+    "LogSettings": ("uu", pb.LogSettingsRequest, pb.LogSettingsResponse),
+}
+
+
+class GRPCInferenceServiceStub:
+    """Client stub — one multi-callable attribute per RPC, like a generated
+    stub (supports both sync ``grpc.Channel`` and ``grpc.aio.Channel``)."""
+
+    def __init__(self, channel):
+        for name, (arity, req, resp) in METHODS.items():
+            path = f"/{SERVICE_NAME}/{name}"
+            if arity == "uu":
+                mc = channel.unary_unary(
+                    path,
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString,
+                )
+            else:
+                mc = channel.stream_stream(
+                    path,
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString,
+                )
+            setattr(self, name, mc)
+
+
+class GRPCInferenceServiceServicer:
+    """Server-side base class; override the methods you implement."""
+
+    def __getattr__(self, name):
+        if name in METHODS:
+            def _unimplemented(request, context):
+                context.abort(grpc.StatusCode.UNIMPLEMENTED, f"{name} not implemented")
+
+            return _unimplemented
+        raise AttributeError(name)
+
+
+def add_GRPCInferenceServiceServicer_to_server(servicer, server):
+    handlers = {}
+    for name, (arity, req, resp) in METHODS.items():
+        method = getattr(servicer, name)
+        if arity == "uu":
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                method,
+                request_deserializer=req.FromString,
+                response_serializer=resp.SerializeToString,
+            )
+        else:
+            handlers[name] = grpc.stream_stream_rpc_method_handler(
+                method,
+                request_deserializer=req.FromString,
+                response_serializer=resp.SerializeToString,
+            )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )

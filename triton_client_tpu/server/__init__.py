@@ -1,0 +1,27 @@
+"""JAX/TPU serving harness speaking the v2 inference protocol.
+
+The reference repo is client-only (its server lives elsewhere; SURVEY.md
+"critical absences"), so this framework ships a minimal TPU-native server:
+without it nothing end-to-end can run or be tested hermetically (SURVEY.md
+§7.2).  It is a real v2 server — HTTP + gRPC frontends, model repository,
+dynamic batching, sequences, decoupled streaming, system/xla shared memory,
+statistics — with JAX/XLA as the one and only compute backend.
+"""
+
+from .core import InferenceCore
+from .model import EnsembleModel, JaxModel, Model, PyModel, make_config
+from .registry import ModelRegistry
+from .types import InferError, InferRequest, InferResponse
+
+__all__ = [
+    "InferenceCore",
+    "ModelRegistry",
+    "Model",
+    "JaxModel",
+    "PyModel",
+    "EnsembleModel",
+    "make_config",
+    "InferError",
+    "InferRequest",
+    "InferResponse",
+]

@@ -1,0 +1,71 @@
+"""CLI: ``python -m triton_client_tpu.server`` — run the v2 serving harness.
+
+Examples::
+
+    # serve the built-in model zoo (simple, simple_identity, ...):
+    python -m triton_client_tpu.server --zoo
+
+    # serve a Triton-style model repository directory:
+    python -m triton_client_tpu.server --model-repository ./models
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from aiohttp import web
+
+from .core import InferenceCore
+from .grpc_server import build_grpc_server
+from .http_server import build_app
+from .registry import ModelRegistry
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="triton_client_tpu serving harness")
+    parser.add_argument("--model-repository", default=None, help="model repository dir")
+    parser.add_argument("--zoo", action="store_true", help="register the built-in model zoo")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument("--grpc-port", type=int, default=8001)
+    parser.add_argument("--verbose", "-v", action="store_true")
+    args = parser.parse_args()
+
+    registry = ModelRegistry(repository_path=args.model_repository)
+    if args.model_repository:
+        for entry in registry.index():
+            try:
+                registry.load(entry["name"])
+                print(f"loaded model '{entry['name']}'")
+            except Exception as e:
+                print(f"failed to load '{entry['name']}': {e}")
+    if args.zoo or not args.model_repository:
+        from ..models import zoo
+
+        zoo.register_all(registry)
+        print(f"registered model zoo: {[e['name'] for e in registry.index()]}")
+
+    core = InferenceCore(registry)
+
+    async def serve():
+        runner = web.AppRunner(build_app(core))
+        await runner.setup()
+        site = web.TCPSite(runner, args.host, args.http_port)
+        await site.start()
+        grpc_server = build_grpc_server(core, f"{args.host}:{args.grpc_port}")
+        await grpc_server.start()
+        print(
+            f"serving v2 protocol: http={args.host}:{args.http_port} "
+            f"grpc={args.host}:{args.grpc_port}"
+        )
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

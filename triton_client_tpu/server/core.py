@@ -1,0 +1,470 @@
+"""Inference core: the transport-neutral engine behind both frontends.
+
+Responsibilities (the server half of the call stacks in SURVEY.md §3):
+
+* request validation against the model config,
+* shared-memory input/output resolution (system + xla registries),
+* dynamic batching with pad-to-bucket (XLA-friendly: bounded shape set),
+* sequence routing (no cross-request batching for stateful models),
+* decoupled response streams with ``triton_final_response`` flagging,
+* ensemble DAG execution,
+* classification outputs (``class_count`` → "score:index[:label]" strings),
+* per-model statistics.
+
+Concurrency model: the core is asyncio-native; model compute runs in a
+thread-pool executor so the event loop keeps serving while XLA executes
+(jax dispatch is async, but host staging/conversion is not).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import np_to_triton_dtype, triton_to_np_dtype
+from .model import EnsembleModel, Model, pb_to_datatype
+from .registry import ModelRegistry
+from .shm import SystemShmRegistry, XlaShmRegistry
+from .types import (
+    InferError,
+    InferRequest,
+    InferResponse,
+    InputTensor,
+    OutputTensor,
+    RequestedOutput,
+)
+
+
+class _DynamicBatcher:
+    """Queue + pad-to-bucket batcher for one model.
+
+    Groups concurrent requests up to ``max_queue_delay_microseconds`` /
+    preferred batch sizes (reference behavior contract: BASELINE config #4
+    "dynamic batching"), concatenates along the batch axis, pads the batch
+    dim to the smallest configured bucket ≥ actual so XLA sees a bounded set
+    of shapes, executes once, splits results.
+    """
+
+    def __init__(self, core: "InferenceCore", model: Model):
+        self._core = core
+        self._model = model
+        dbcfg = model.config.dynamic_batching
+        self._max_delay_s = dbcfg.max_queue_delay_microseconds / 1e6
+        self._buckets = sorted(dbcfg.preferred_batch_size) or []
+        self._max_bs = model.config.max_batch_size
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def submit(self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]):
+        fut = asyncio.get_running_loop().create_future()
+        self.start()
+        await self._queue.put((inputs, parameters, fut, time.monotonic_ns()))
+        return await fut
+
+    async def _run(self) -> None:
+        while True:
+            first = await self._queue.get()
+            pending = [first]
+            total = _batch_count(first[0])
+            deadline = time.monotonic() + self._max_delay_s
+            while total < self._max_bs:
+                if self._buckets and total >= self._buckets[-1]:
+                    break
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(self._queue.get(), timeout)
+                except asyncio.TimeoutError:
+                    break
+                pending.append(item)
+                total += _batch_count(item[0])
+            await self._execute_batch(pending)
+
+    async def _execute_batch(self, pending) -> None:
+        counts = [_batch_count(p[0]) for p in pending]
+        total = sum(counts)
+        padded = total
+        for b in self._buckets:
+            if total <= b:
+                padded = b
+                break
+        names = list(pending[0][0].keys())
+        try:
+            merged = {}
+            for n in names:
+                parts = [p[0][n] for p in pending]
+                arr = np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+                if padded > total:
+                    pad_widths = [(0, padded - total)] + [(0, 0)] * (arr.ndim - 1)
+                    arr = np.pad(arr, pad_widths)
+                merged[n] = arr
+            queue_ns = time.monotonic_ns() - pending[0][3]
+            t0 = time.monotonic_ns()
+            outputs = await self._core._run_model(self._model, merged, pending[0][1])
+            compute_ns = time.monotonic_ns() - t0
+            self._model.stats.record(total, queue_ns, compute_ns, ok=True)
+            offset = 0
+            for (inputs, _params, fut, _ts), count in zip(pending, counts):
+                part = {
+                    n: np.asarray(v)[offset : offset + count] for n, v in outputs.items()
+                }
+                offset += count
+                if not fut.done():
+                    fut.set_result(part)
+        except Exception as e:
+            self._model.stats.record(total, 0, 0, ok=False)
+            for _inputs, _params, fut, _ts in pending:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def _batch_count(inputs: Dict[str, np.ndarray]) -> int:
+    for v in inputs.values():
+        return int(np.asarray(v).shape[0]) if np.asarray(v).ndim > 0 else 1
+    return 1
+
+
+class InferenceCore:
+    SERVER_NAME = "triton_client_tpu_harness"
+    SERVER_VERSION = "2.0.0-tpu"
+    EXTENSIONS = [
+        "classification",
+        "sequence",
+        "model_repository",
+        "model_repository(unload_dependents)",
+        "schedule_policy",
+        "model_configuration",
+        "system_shared_memory",
+        "cuda_shared_memory",
+        "xla_shared_memory",
+        "binary_tensor_data",
+        "statistics",
+        "trace",
+        "logging",
+    ]
+
+    def __init__(self, registry: ModelRegistry):
+        self.registry = registry
+        self.system_shm = SystemShmRegistry()
+        self.xla_shm = XlaShmRegistry()
+        self.trace_settings: Dict[str, List[str]] = {
+            "trace_file": ["trace.json"],
+            "trace_level": ["OFF"],
+            "trace_rate": ["1000"],
+            "trace_count": ["-1"],
+            "log_frequency": ["0"],
+        }
+        self.log_settings: Dict[str, Any] = {
+            "log_file": "",
+            "log_info": True,
+            "log_warning": True,
+            "log_error": True,
+            "log_verbose_level": 0,
+            "log_format": "default",
+        }
+        self._batchers: Dict[str, _DynamicBatcher] = {}
+        self.live = True
+
+    # ------------------------------------------------------------------
+    async def infer(self, request: InferRequest) -> InferResponse:
+        """Single request/response inference (HTTP infer, gRPC ModelInfer)."""
+        model = self.registry.get(request.model_name, request.model_version)
+        if model.decoupled:
+            raise InferError(
+                f"doesn't support models with decoupled transaction policy",
+                http_status=400,
+            )
+        return await self._infer_on(model, request)
+
+    async def _infer_on(self, model: Model, request: InferRequest) -> InferResponse:
+        inputs = self._resolve_inputs(model, request)
+        params = dict(request.parameters)
+        if isinstance(model, EnsembleModel):
+            outputs = await self._run_ensemble(model, inputs, params)
+            queue_ns = compute_ns = 0
+            model.stats.record(_batch_count(inputs) or 1, 0, 0, ok=True)
+        elif self._use_batcher(model, request):
+            outputs = await self._batcher(model).submit(inputs, params)
+        else:
+            t0 = time.monotonic_ns()
+            queue_ns = t0 - request.arrival_ns
+            try:
+                outputs = await self._run_model(model, inputs, params)
+            except InferError:
+                model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
+                raise
+            except Exception as e:
+                model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
+                raise InferError(f"inference failed: {e}", http_status=500)
+            compute_ns = time.monotonic_ns() - t0
+            model.stats.record(_batch_count(inputs) or 1, queue_ns, compute_ns, ok=True)
+        return self._build_response(model, request, outputs)
+
+    async def infer_stream(self, request: InferRequest) -> AsyncIterator[InferResponse]:
+        """Streaming inference: decoupled models yield 0..N responses then a
+        final-flagged empty response; non-decoupled models yield exactly one
+        (reference decoupled semantics: IsFinalResponse/IsNullResponse,
+        common.h:488-563 and enable_empty_final_response,
+        grpc/_client.py:1815-1929)."""
+        model = self.registry.get(request.model_name, request.model_version)
+        if not model.decoupled:
+            yield await self._infer_on(model, request)
+            return
+        inputs = self._resolve_inputs(model, request)
+        params = dict(request.parameters)
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+        _SENTINEL = object()
+
+        def _produce():
+            try:
+                for out in model.execute_decoupled(inputs, params):
+                    loop.call_soon_threadsafe(queue.put_nowait, out)
+            except Exception as e:  # pragma: no cover - surfaced to stream
+                loop.call_soon_threadsafe(queue.put_nowait, e)
+            finally:
+                loop.call_soon_threadsafe(queue.put_nowait, _SENTINEL)
+
+        t0 = time.monotonic_ns()
+        producer = loop.run_in_executor(None, _produce)
+        count = 0
+        while True:
+            item = await queue.get()
+            if item is _SENTINEL:
+                break
+            if isinstance(item, Exception):
+                model.stats.record(1, 0, time.monotonic_ns() - t0, ok=False)
+                raise item if isinstance(item, InferError) else InferError(str(item), 500)
+            count += 1
+            resp = self._build_response(model, request, item)
+            resp.parameters["triton_final_response"] = False
+            yield resp
+        await producer
+        model.stats.record(1, 0, time.monotonic_ns() - t0, ok=True)
+        final = InferResponse(
+            model_name=model.name, model_version="1", id=request.id
+        )
+        final.parameters["triton_final_response"] = True
+        yield final
+
+    # ------------------------------------------------------------------
+    def _use_batcher(self, model: Model, request: InferRequest) -> bool:
+        return (
+            model.max_batch_size > 0
+            and model.config.HasField("dynamic_batching")
+            and not model.is_sequence
+            and not request.sequence_id
+            and not any(i.shm is not None for i in request.inputs)
+            and not any(o.shm is not None for o in request.outputs)
+        )
+
+    def _batcher(self, model: Model) -> _DynamicBatcher:
+        b = self._batchers.get(model.name)
+        if b is None:
+            b = _DynamicBatcher(self, model)
+            self._batchers[model.name] = b
+        return b
+
+    async def _run_model(self, model: Model, inputs, params) -> Dict[str, Any]:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, lambda: model.execute(inputs, params))
+
+    async def _run_ensemble(self, model: EnsembleModel, inputs, params) -> Dict[str, Any]:
+        """Execute the ensemble DAG: tensors flow between steps through
+        input_map/output_map (reference ensemble behavior, §2.7)."""
+        pool: Dict[str, Any] = dict(inputs)
+        for step in model.config.ensemble_scheduling.step:
+            member = self.registry.get(step.model_name)
+            step_inputs = {}
+            for member_input, pool_name in step.input_map.items():
+                if pool_name not in pool:
+                    raise InferError(
+                        f"ensemble '{model.name}': tensor '{pool_name}' not produced "
+                        f"before step '{step.model_name}'"
+                    )
+                step_inputs[member_input] = pool[pool_name]
+            t0 = time.monotonic_ns()
+            outs = await self._run_model(member, step_inputs, params)
+            member.stats.record(
+                _batch_count(step_inputs) or 1, 0, time.monotonic_ns() - t0, ok=True
+            )
+            for member_output, pool_name in step.output_map.items():
+                if member_output not in outs:
+                    raise InferError(
+                        f"ensemble '{model.name}': step '{step.model_name}' did not "
+                        f"produce '{member_output}'"
+                    )
+                pool[pool_name] = outs[member_output]
+        return pool
+
+    # ------------------------------------------------------------------
+    def _resolve_inputs(self, model: Model, request: InferRequest) -> Dict[str, Any]:
+        cfg_inputs = {i.name: i for i in model.config.input}
+        batched = model.max_batch_size > 0
+        resolved: Dict[str, Any] = {}
+        for t in request.inputs:
+            cfg = cfg_inputs.get(t.name)
+            if cfg is None:
+                raise InferError(
+                    f"unexpected inference input '{t.name}' for model '{model.name}'"
+                )
+            expect_dt = pb_to_datatype(cfg.data_type)
+            if t.datatype != expect_dt:
+                raise InferError(
+                    f"inference input '{t.name}' data-type is '{t.datatype}', but "
+                    f"model '{model.name}' expects '{expect_dt}'"
+                )
+            self._check_shape(model, t, cfg, batched)
+            if t.shm is not None:
+                if t.shm.region_name in self.xla_shm.status(None):
+                    arr = self.xla_shm.read(t.shm, t.datatype, t.shape)
+                else:
+                    arr = self.system_shm.read(t.shm, t.datatype, t.shape)
+            else:
+                arr = t.data
+            resolved[t.name] = arr
+        missing = [
+            n
+            for n, cfg in cfg_inputs.items()
+            if n not in resolved and not cfg.optional
+        ]
+        if missing:
+            raise InferError(
+                f"expected {len(cfg_inputs)} inputs but got {len(resolved)} inputs "
+                f"for model '{model.name}' (missing: {', '.join(missing)})"
+            )
+        # Requested-output validation happens here too so both paths share it.
+        cfg_outputs = {o.name for o in model.config.output}
+        for o in request.outputs:
+            if o.name not in cfg_outputs:
+                raise InferError(
+                    f"unexpected inference output '{o.name}' for model '{model.name}'"
+                )
+        return resolved
+
+    def _check_shape(self, model, t: InputTensor, cfg, batched: bool) -> None:
+        dims = list(cfg.dims)
+        shape = list(t.shape)
+        check = shape[1:] if batched else shape
+        if len(check) != len(dims):
+            raise InferError(
+                f"unexpected shape for input '{t.name}' for model '{model.name}': "
+                f"expected rank {len(dims) + (1 if batched else 0)}, got {len(shape)}"
+            )
+        for got, want in zip(check, dims):
+            if want != -1 and got != want:
+                raise InferError(
+                    f"unexpected shape for input '{t.name}' for model '{model.name}': "
+                    f"expected {dims}, got {check}"
+                )
+        if batched and shape and shape[0] > model.max_batch_size:
+            raise InferError(
+                f"inference request batch-size must be <= {model.max_batch_size} "
+                f"for '{model.name}'"
+            )
+
+    # ------------------------------------------------------------------
+    def _build_response(
+        self, model: Model, request: InferRequest, outputs: Dict[str, Any]
+    ) -> InferResponse:
+        requested = {o.name: o for o in request.outputs}
+        resp = InferResponse(model_name=model.name, model_version="1", id=request.id)
+        cfg_outputs = [o.name for o in model.config.output]
+        names = list(requested) if requested else cfg_outputs
+        for name in names:
+            if name not in outputs:
+                raise InferError(
+                    f"model '{model.name}' did not produce output '{name}'"
+                )
+            value = outputs[name]
+            spec = requested.get(name)
+            if spec is not None and spec.class_count > 0:
+                host = np.asarray(value)
+                value = self._classify(model, name, host, spec.class_count)
+            out_shm = spec.shm if spec is not None else None
+            if out_shm is not None:
+                if out_shm.region_name in self.xla_shm.status(None):
+                    self.xla_shm.write(out_shm, value)
+                else:
+                    self.system_shm.write(out_shm, np.asarray(value))
+                host = np.asarray(value)
+                resp.outputs.append(
+                    OutputTensor(
+                        name=name,
+                        datatype=np_to_triton_dtype(host.dtype),
+                        shape=tuple(host.shape),
+                        data=host,
+                        shm=out_shm,
+                    )
+                )
+            else:
+                host = np.asarray(value)
+                resp.outputs.append(
+                    OutputTensor(
+                        name=name,
+                        datatype=np_to_triton_dtype(host.dtype),
+                        shape=tuple(host.shape),
+                        data=host,
+                    )
+                )
+        return resp
+
+    def _classify(self, model: Model, name: str, arr: np.ndarray, k: int) -> np.ndarray:
+        """Top-k classification strings "score:index[:label]" (reference
+        image_client postprocess contract, image_client.py:195-217)."""
+        labels = model.labels(name)
+        batched = arr.ndim > 1
+        rows = arr if batched else arr[None, :]
+        k = min(k, rows.shape[-1])
+        out = []
+        for row in rows.astype(np.float32):
+            idx = np.argsort(-row)[:k]
+            for i in idx:
+                s = f"{row[i]:f}:{i}"
+                if labels and i < len(labels):
+                    s += f":{labels[i]}"
+                out.append(s.encode("utf-8"))
+        shape = (rows.shape[0], k) if batched else (k,)
+        return np.array(out, dtype=np.object_).reshape(shape)
+
+    # ------------------------------------------------------------------
+    def server_metadata(self) -> dict:
+        return {
+            "name": self.SERVER_NAME,
+            "version": self.SERVER_VERSION,
+            "extensions": list(self.EXTENSIONS),
+        }
+
+    def statistics(self, name: Optional[str], version: str = "") -> List[dict]:
+        models = [self.registry.get(name, version)] if name else self.registry.ready_models()
+        out = []
+        for m in models:
+            s = m.stats
+            with s.lock:
+                out.append(
+                    {
+                        "name": m.name,
+                        "version": "1",
+                        "last_inference": s.last_inference_ms,
+                        "inference_count": s.inference_count,
+                        "execution_count": s.execution_count,
+                        "inference_stats": {
+                            "success": {"count": s.success_count, "ns": s.success_ns},
+                            "fail": {"count": s.fail_count, "ns": s.fail_ns},
+                            "queue": {"count": s.queue_count, "ns": s.queue_ns},
+                            "compute_input": {"count": s.infer_count, "ns": 0},
+                            "compute_infer": {"count": s.infer_count, "ns": s.infer_ns},
+                            "compute_output": {"count": s.infer_count, "ns": 0},
+                        },
+                        "batch_stats": [],
+                    }
+                )
+        return out

@@ -1,0 +1,397 @@
+"""gRPC v2 frontend (grpc.aio).
+
+Implements ``inference.GRPCInferenceService`` (this framework's own IDL,
+``protocol/inference.proto``) — the RPC surface the reference gRPC client
+drives (surveyed at grpc/_client.py).  Tensor data travels positionally in
+``raw_input_contents``/``raw_output_contents`` (reference
+grpc/_infer_input.py:160-174, _infer_result.py:63-97); typed
+``InferTensorContents`` decoding is also supported for third-party stubs that
+use it (e.g. the Go generated example).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import grpc
+import numpy as np
+
+from ..protocol import inference_pb2 as pb
+from ..protocol.service import add_GRPCInferenceServiceServicer_to_server
+from ..utils import (
+    deserialize_bytes_tensor,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+from .core import InferenceCore
+from .model import datatype_to_pb
+from .types import InferError, InferRequest, InputTensor, RequestedOutput, ShmRef
+
+
+def pb_param_to_py(p: pb.InferParameter):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def py_to_pb_param(value) -> pb.InferParameter:
+    p = pb.InferParameter()
+    if isinstance(value, bool):
+        p.bool_param = value
+    elif isinstance(value, int):
+        p.int64_param = value
+    elif isinstance(value, float):
+        p.double_param = value
+    else:
+        p.string_param = str(value)
+    return p
+
+
+def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
+    req = InferRequest(
+        model_name=request.model_name,
+        model_version=request.model_version,
+        id=request.id,
+        parameters={k: pb_param_to_py(v) for k, v in request.parameters.items()},
+    )
+    raw = list(request.raw_input_contents)
+    if raw and len(raw) != len(request.inputs):
+        raise InferError(
+            "raw_input_contents does not match the number of inputs"
+        )
+    for idx, t in enumerate(request.inputs):
+        shape = tuple(int(s) for s in t.shape)
+        params = {k: pb_param_to_py(v) for k, v in t.parameters.items()}
+        tensor = InputTensor(name=t.name, datatype=t.datatype, shape=shape, parameters=params)
+        shm_name = params.get("shared_memory_region")
+        if shm_name:
+            tensor.shm = ShmRef(
+                region_name=shm_name,
+                byte_size=int(params["shared_memory_byte_size"]),
+                offset=int(params.get("shared_memory_offset", 0)),
+            )
+        elif raw:
+            tensor.data = _raw_to_array(raw[idx], t.datatype, shape, t.name)
+        elif t.HasField("contents"):
+            tensor.data = _contents_to_array(t.contents, t.datatype, shape, t.name)
+        else:
+            raise InferError(f"input '{t.name}' has no data")
+        req.inputs.append(tensor)
+    for o in request.outputs:
+        params = {k: pb_param_to_py(v) for k, v in o.parameters.items()}
+        out = RequestedOutput(
+            name=o.name,
+            class_count=int(params.get("classification", 0)),
+            parameters=params,
+        )
+        shm_name = params.get("shared_memory_region")
+        if shm_name:
+            out.shm = ShmRef(
+                region_name=shm_name,
+                byte_size=int(params["shared_memory_byte_size"]),
+                offset=int(params.get("shared_memory_offset", 0)),
+            )
+        req.outputs.append(out)
+    return req
+
+
+def _raw_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray:
+    if datatype == "BYTES":
+        return deserialize_bytes_tensor(chunk).reshape(shape)
+    dt = triton_to_np_dtype(datatype)
+    if dt is None:
+        raise InferError(f"unsupported datatype '{datatype}' for input '{name}'")
+    count = int(np.prod(shape)) if len(shape) else 1
+    if len(chunk) != count * dt.itemsize:
+        raise InferError(
+            f"unexpected total byte size {len(chunk)} for input '{name}', "
+            f"expecting {count * dt.itemsize}"
+        )
+    return np.frombuffer(chunk, dtype=dt).reshape(shape)
+
+
+_CONTENTS_FIELD = {
+    "BOOL": "bool_contents",
+    "INT8": "int_contents",
+    "INT16": "int_contents",
+    "INT32": "int_contents",
+    "INT64": "int64_contents",
+    "UINT8": "uint_contents",
+    "UINT16": "uint_contents",
+    "UINT32": "uint_contents",
+    "UINT64": "uint64_contents",
+    "FP32": "fp32_contents",
+    "FP64": "fp64_contents",
+    "BYTES": "bytes_contents",
+}
+
+
+def _contents_to_array(contents, datatype: str, shape, name: str) -> np.ndarray:
+    field = _CONTENTS_FIELD.get(datatype)
+    if field is None:
+        raise InferError(
+            f"typed contents not supported for datatype '{datatype}' (input '{name}')"
+        )
+    values = list(getattr(contents, field))
+    if datatype == "BYTES":
+        return np.array(values, dtype=np.object_).reshape(shape)
+    return np.array(values, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+
+
+def _encode_pb_response(resp) -> pb.ModelInferResponse:
+    out = pb.ModelInferResponse(
+        model_name=resp.model_name,
+        model_version=resp.model_version or "1",
+        id=resp.id,
+    )
+    for k, v in resp.parameters.items():
+        out.parameters[k].CopyFrom(py_to_pb_param(v))
+    for t in resp.outputs:
+        pbt = out.outputs.add()
+        pbt.name = t.name
+        pbt.datatype = t.datatype
+        pbt.shape.extend(int(s) for s in t.shape)
+        if t.shm is not None:
+            pbt.parameters["shared_memory_region"].string_param = t.shm.region_name
+            pbt.parameters["shared_memory_byte_size"].int64_param = t.shm.byte_size
+            if t.shm.offset:
+                pbt.parameters["shared_memory_offset"].int64_param = t.shm.offset
+            out.raw_output_contents.append(b"")
+        else:
+            if t.datatype == "BYTES":
+                blob = serialize_byte_tensor(t.data).tobytes()
+            elif t.datatype == "BF16":
+                blob = serialize_bf16_tensor(t.data).tobytes()
+            else:
+                blob = np.ascontiguousarray(t.data).tobytes()
+            out.raw_output_contents.append(blob)
+    return out
+
+
+class InferenceServicer:
+    def __init__(self, core: InferenceCore):
+        self._core = core
+
+    # -- health / metadata -------------------------------------------------
+    async def ServerLive(self, request, context):
+        return pb.ServerLiveResponse(live=self._core.live)
+
+    async def ServerReady(self, request, context):
+        return pb.ServerReadyResponse(ready=True)
+
+    async def ModelReady(self, request, context):
+        return pb.ModelReadyResponse(
+            ready=self._core.registry.is_ready(request.name, request.version)
+        )
+
+    async def ServerMetadata(self, request, context):
+        md = self._core.server_metadata()
+        return pb.ServerMetadataResponse(
+            name=md["name"], version=md["version"], extensions=md["extensions"]
+        )
+
+    async def ModelMetadata(self, request, context):
+        try:
+            model = self._core.registry.get(request.name, request.version)
+        except InferError as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        md = model.metadata()
+        resp = pb.ModelMetadataResponse(
+            name=md["name"], versions=md["versions"], platform=md["platform"]
+        )
+        for io, dest in ((md["inputs"], resp.inputs), (md["outputs"], resp.outputs)):
+            for t in io:
+                dest.add(name=t["name"], datatype=t["datatype"], shape=t["shape"])
+        return resp
+
+    async def ModelConfig(self, request, context):
+        try:
+            model = self._core.registry.get(request.name, request.version)
+        except InferError as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return pb.ModelConfigResponse(config=model.config)
+
+    async def ModelStatistics(self, request, context):
+        try:
+            stats = self._core.statistics(request.name or None, request.version)
+        except InferError as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        resp = pb.ModelStatisticsResponse()
+        for s in stats:
+            ms = resp.model_stats.add()
+            ms.name = s["name"]
+            ms.version = s["version"]
+            ms.last_inference = s["last_inference"]
+            ms.inference_count = s["inference_count"]
+            ms.execution_count = s["execution_count"]
+            ist = s["inference_stats"]
+            for key in ("success", "fail", "queue", "compute_input", "compute_infer", "compute_output"):
+                getattr(ms.inference_stats, key).count = ist[key]["count"]
+                getattr(ms.inference_stats, key).ns = ist[key]["ns"]
+        return resp
+
+    # -- repository --------------------------------------------------------
+    async def RepositoryIndex(self, request, context):
+        resp = pb.RepositoryIndexResponse()
+        for entry in self._core.registry.index(ready_only=request.ready):
+            resp.models.add(
+                name=entry["name"],
+                version=entry.get("version", "1"),
+                state=entry["state"],
+                reason=entry.get("reason", ""),
+            )
+        return resp
+
+    async def RepositoryModelLoad(self, request, context):
+        params = request.parameters
+        config_override = None
+        files = {}
+        for k, v in params.items():
+            which = v.WhichOneof("parameter_choice")
+            if k == "config" and which == "string_param":
+                config_override = v.string_param
+            elif k.startswith("file:") and which == "bytes_param":
+                import base64
+
+                files[k] = base64.b64encode(v.bytes_param).decode()
+        try:
+            self._core.registry.load(
+                request.model_name, config_override=config_override, files=files or None
+            )
+        except InferError as e:
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return pb.RepositoryModelLoadResponse()
+
+    async def RepositoryModelUnload(self, request, context):
+        unload_dependents = False
+        p = request.parameters.get("unload_dependents")
+        if p is not None and p.WhichOneof("parameter_choice") == "bool_param":
+            unload_dependents = p.bool_param
+        try:
+            self._core.registry.unload(request.model_name, unload_dependents)
+        except InferError as e:
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return pb.RepositoryModelUnloadResponse()
+
+    # -- shared memory -----------------------------------------------------
+    async def SystemSharedMemoryStatus(self, request, context):
+        resp = pb.SystemSharedMemoryStatusResponse()
+        for name, r in self._core.system_shm.status(request.name or None).items():
+            resp.regions[name].name = r["name"]
+            resp.regions[name].key = r["key"]
+            resp.regions[name].offset = r["offset"]
+            resp.regions[name].byte_size = r["byte_size"]
+        return resp
+
+    async def SystemSharedMemoryRegister(self, request, context):
+        try:
+            self._core.system_shm.register(
+                request.name, request.key, request.offset, request.byte_size
+            )
+        except InferError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.SystemSharedMemoryRegisterResponse()
+
+    async def SystemSharedMemoryUnregister(self, request, context):
+        self._core.system_shm.unregister(request.name or None)
+        return pb.SystemSharedMemoryUnregisterResponse()
+
+    async def CudaSharedMemoryStatus(self, request, context):
+        resp = pb.CudaSharedMemoryStatusResponse()
+        for name, r in self._core.xla_shm.status(request.name or None).items():
+            resp.regions[name].name = r["name"]
+            resp.regions[name].device_id = r["device_id"]
+            resp.regions[name].byte_size = r["byte_size"]
+        return resp
+
+    async def CudaSharedMemoryRegister(self, request, context):
+        try:
+            self._core.xla_shm.register(
+                request.name, request.raw_handle, request.device_id, request.byte_size
+            )
+        except InferError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.CudaSharedMemoryRegisterResponse()
+
+    async def CudaSharedMemoryUnregister(self, request, context):
+        self._core.xla_shm.unregister(request.name or None)
+        return pb.CudaSharedMemoryUnregisterResponse()
+
+    # -- trace / logging ---------------------------------------------------
+    async def TraceSetting(self, request, context):
+        for k, v in request.settings.items():
+            if v.value:
+                self._core.trace_settings[k] = list(v.value)
+        resp = pb.TraceSettingResponse()
+        for k, vals in self._core.trace_settings.items():
+            resp.settings[k].value.extend(vals)
+        return resp
+
+    async def LogSettings(self, request, context):
+        for k, v in request.settings.items():
+            which = v.WhichOneof("parameter_choice")
+            if which:
+                self._core.log_settings[k] = getattr(v, which)
+        resp = pb.LogSettingsResponse()
+        for k, val in self._core.log_settings.items():
+            if isinstance(val, bool):
+                resp.settings[k].bool_param = val
+            elif isinstance(val, int):
+                resp.settings[k].uint32_param = val
+            else:
+                resp.settings[k].string_param = str(val)
+        return resp
+
+    # -- inference ---------------------------------------------------------
+    async def ModelInfer(self, request, context):
+        try:
+            req = _decode_pb_request(request)
+            resp = await self._core.infer(req)
+        except InferError as e:
+            await context.abort(_grpc_code(e), str(e))
+        return _encode_pb_response(resp)
+
+    async def ModelStreamInfer(self, request_iterator, context):
+        """Bidi stream: requests arrive as they're sent; each produces one or
+        more ``ModelStreamInferResponse``s (errors travel in-band in
+        ``error_message``, reference _infer_stream.py:142-167)."""
+        async for request in request_iterator:
+            try:
+                req = _decode_pb_request(request)
+                enable_empty_final = bool(
+                    req.parameters.get("triton_enable_empty_final_response", False)
+                )
+                async for resp in self._core.infer_stream(req):
+                    is_empty_final = (
+                        not resp.outputs
+                        and resp.parameters.get("triton_final_response") is True
+                    )
+                    if is_empty_final and not enable_empty_final:
+                        continue
+                    yield pb.ModelStreamInferResponse(
+                        infer_response=_encode_pb_response(resp)
+                    )
+            except InferError as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+            except Exception as e:  # pragma: no cover - defensive
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+
+
+def _grpc_code(e: InferError) -> grpc.StatusCode:
+    return {
+        400: grpc.StatusCode.INVALID_ARGUMENT,
+        404: grpc.StatusCode.NOT_FOUND,
+        500: grpc.StatusCode.INTERNAL,
+    }.get(e.http_status, grpc.StatusCode.UNKNOWN)
+
+
+def build_grpc_server(core: InferenceCore, address: str = "[::]:8001") -> "grpc.aio.Server":
+    server = grpc.aio.server(
+        options=[
+            ("grpc.max_send_message_length", -1),
+            ("grpc.max_receive_message_length", -1),
+        ]
+    )
+    add_GRPCInferenceServiceServicer_to_server(InferenceServicer(core), server)
+    server.add_insecure_port(address)
+    return server

@@ -1,0 +1,397 @@
+"""HTTP/REST v2 frontend (aiohttp).
+
+Endpoint surface mirrors what the reference HTTP client targets (URI builders
+surveyed at http/_client.py:364-1474), including the binary-tensor-data
+extension: request/response bodies are ``<json header><concatenated raw
+buffers>`` with the JSON length in the ``Inference-Header-Content-Length``
+header (reference framing: http/_utils.py:137-150).
+"""
+
+from __future__ import annotations
+
+import base64
+import gzip
+import json
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+from aiohttp import web
+
+from ..utils import (
+    deserialize_bytes_tensor,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+from .core import InferenceCore
+from .types import InferError, InferRequest, InputTensor, RequestedOutput, ShmRef
+
+_HEADER_LEN = "Inference-Header-Content-Length"
+
+
+def build_app(core: InferenceCore) -> web.Application:
+    app = web.Application(client_max_size=1 << 30)
+    r = app.router
+    r.add_get("/v2/health/live", _h(core, _health_live))
+    r.add_get("/v2/health/ready", _h(core, _health_ready))
+    r.add_get("/v2/models/{model}/ready", _h(core, _model_ready))
+    r.add_get("/v2/models/{model}/versions/{version}/ready", _h(core, _model_ready))
+    r.add_get("/v2", _h(core, _server_metadata))
+    r.add_get("/v2/models/{model}", _h(core, _model_metadata))
+    r.add_get("/v2/models/{model}/versions/{version}", _h(core, _model_metadata))
+    r.add_get("/v2/models/{model}/config", _h(core, _model_config))
+    r.add_get("/v2/models/{model}/versions/{version}/config", _h(core, _model_config))
+    r.add_get("/v2/models/stats", _h(core, _model_stats))
+    r.add_get("/v2/models/{model}/stats", _h(core, _model_stats))
+    r.add_get("/v2/models/{model}/versions/{version}/stats", _h(core, _model_stats))
+    r.add_post("/v2/repository/index", _h(core, _repo_index))
+    r.add_post("/v2/repository/models/{model}/load", _h(core, _repo_load))
+    r.add_post("/v2/repository/models/{model}/unload", _h(core, _repo_unload))
+    r.add_post("/v2/models/{model}/infer", _h(core, _infer))
+    r.add_post("/v2/models/{model}/versions/{version}/infer", _h(core, _infer))
+    r.add_get("/v2/trace/setting", _h(core, _get_trace))
+    r.add_post("/v2/trace/setting", _h(core, _set_trace))
+    r.add_get("/v2/models/{model}/trace/setting", _h(core, _get_trace))
+    r.add_post("/v2/models/{model}/trace/setting", _h(core, _set_trace))
+    r.add_get("/v2/logging", _h(core, _get_logging))
+    r.add_post("/v2/logging", _h(core, _set_logging))
+    for kind in ("systemsharedmemory", "cudasharedmemory"):
+        r.add_get(f"/v2/{kind}/status", _h(core, _shm_status))
+        r.add_get(f"/v2/{kind}/region/{{name}}/status", _h(core, _shm_status))
+        r.add_post(f"/v2/{kind}/region/{{name}}/register", _h(core, _shm_register))
+        r.add_post(f"/v2/{kind}/unregister", _h(core, _shm_unregister))
+        r.add_post(f"/v2/{kind}/region/{{name}}/unregister", _h(core, _shm_unregister))
+    return app
+
+
+def _h(core: InferenceCore, fn):
+    async def handler(request: web.Request) -> web.Response:
+        try:
+            return await fn(core, request)
+        except InferError as e:
+            return web.json_response({"error": str(e)}, status=e.http_status)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # pragma: no cover - defensive
+            return web.json_response({"error": str(e)}, status=500)
+
+    return handler
+
+
+# -- health / metadata -----------------------------------------------------
+
+
+async def _health_live(core, request):
+    return web.Response(status=200 if core.live else 400)
+
+
+async def _health_ready(core, request):
+    return web.Response(status=200)
+
+
+async def _model_ready(core, request):
+    ok = core.registry.is_ready(
+        request.match_info["model"], request.match_info.get("version", "")
+    )
+    return web.Response(status=200 if ok else 400)
+
+
+async def _server_metadata(core, request):
+    return web.json_response(core.server_metadata())
+
+
+async def _model_metadata(core, request):
+    model = core.registry.get(
+        request.match_info["model"], request.match_info.get("version", "")
+    )
+    return web.json_response(model.metadata())
+
+
+async def _model_config(core, request):
+    from google.protobuf import json_format
+
+    model = core.registry.get(
+        request.match_info["model"], request.match_info.get("version", "")
+    )
+    cfg = json_format.MessageToDict(model.config, preserving_proto_field_name=True)
+    cfg.setdefault("name", model.name)
+    return web.json_response(cfg)
+
+
+async def _model_stats(core, request):
+    stats = core.statistics(
+        request.match_info.get("model"), request.match_info.get("version", "")
+    )
+    return web.json_response({"model_stats": stats})
+
+
+# -- repository ------------------------------------------------------------
+
+
+async def _repo_index(core, request):
+    body = await request.json() if request.can_read_body else {}
+    ready = bool(body.get("ready", False))
+    return web.json_response(core.registry.index(ready_only=ready))
+
+
+async def _repo_load(core, request):
+    name = request.match_info["model"]
+    body = await request.json() if request.can_read_body else {}
+    params = body.get("parameters", {}) or {}
+    config_override = params.get("config")
+    files = {k: v for k, v in params.items() if k.startswith("file:")}
+    core.registry.load(name, config_override=config_override, files=files or None)
+    return web.Response(status=200)
+
+
+async def _repo_unload(core, request):
+    name = request.match_info["model"]
+    body = await request.json() if request.can_read_body else {}
+    params = body.get("parameters", {}) or {}
+    core.registry.unload(name, unload_dependents=bool(params.get("unload_dependents")))
+    return web.Response(status=200)
+
+
+# -- trace / logging -------------------------------------------------------
+
+
+async def _get_trace(core, request):
+    return web.json_response(core.trace_settings)
+
+
+async def _set_trace(core, request):
+    body = await request.json() if request.can_read_body else {}
+    for k, v in body.items():
+        if v is None:
+            # null clears to default (reference update_trace_settings contract)
+            continue
+        core.trace_settings[k] = v if isinstance(v, list) else [str(v)]
+    return web.json_response(core.trace_settings)
+
+
+async def _get_logging(core, request):
+    return web.json_response(core.log_settings)
+
+
+async def _set_logging(core, request):
+    body = await request.json() if request.can_read_body else {}
+    core.log_settings.update(body)
+    return web.json_response(core.log_settings)
+
+
+# -- shared memory ---------------------------------------------------------
+
+
+def _shm_registry(core: InferenceCore, request: web.Request):
+    return core.system_shm if "systemsharedmemory" in request.path else core.xla_shm
+
+
+async def _shm_status(core, request):
+    reg = _shm_registry(core, request)
+    status = reg.status(request.match_info.get("name"))
+    return web.json_response(list(status.values()))
+
+
+async def _shm_register(core, request):
+    reg = _shm_registry(core, request)
+    name = request.match_info["name"]
+    body = await request.json()
+    if reg is core.system_shm:
+        reg.register(
+            name, body["key"], int(body.get("offset", 0)), int(body["byte_size"])
+        )
+    else:
+        raw = base64.b64decode(body["raw_handle"]["b64"])
+        reg.register(name, raw, int(body.get("device_id", 0)), int(body["byte_size"]))
+    return web.Response(status=200)
+
+
+async def _shm_unregister(core, request):
+    reg = _shm_registry(core, request)
+    reg.unregister(request.match_info.get("name"))
+    return web.Response(status=200)
+
+
+# -- infer -----------------------------------------------------------------
+
+
+async def _infer(core, request: web.Request) -> web.Response:
+    # aiohttp inflates gzip/deflate request bodies transparently.
+    raw = await request.read()
+
+    header_len = request.headers.get(_HEADER_LEN)
+    if header_len is not None:
+        json_bytes, binary = raw[: int(header_len)], raw[int(header_len) :]
+    else:
+        json_bytes, binary = raw, b""
+    try:
+        body = json.loads(json_bytes)
+    except Exception:
+        raise InferError("failed to parse inference request JSON")
+
+    req = _decode_request(
+        request.match_info["model"], request.match_info.get("version", ""), body, binary
+    )
+    resp = await core.infer(req)
+    default_binary = bool(
+        body.get("parameters", {}).get("binary_data_output", header_len is not None)
+    )
+    payload, json_len = _encode_response(resp, req, default_binary)
+    headers = {_HEADER_LEN: str(json_len)}
+    accept = request.headers.get("Accept-Encoding", "")
+    if "gzip" in accept and len(payload) > 1024:
+        payload = gzip.compress(payload)
+        headers["Content-Encoding"] = "gzip"
+    return web.Response(
+        body=payload, headers=headers, content_type="application/octet-stream"
+    )
+
+
+def _decode_request(
+    model_name: str, version: str, body: dict, binary: bytes
+) -> InferRequest:
+    req = InferRequest(
+        model_name=model_name,
+        model_version=version,
+        id=body.get("id", ""),
+        parameters=body.get("parameters", {}) or {},
+    )
+    offset = 0
+    for t in body.get("inputs", []):
+        name, datatype = t["name"], t["datatype"]
+        shape = tuple(int(s) for s in t["shape"])
+        params = t.get("parameters", {}) or {}
+        tensor = InputTensor(name=name, datatype=datatype, shape=shape, parameters=params)
+        shm_name = params.get("shared_memory_region")
+        bin_size = params.get("binary_data_size")
+        if shm_name:
+            tensor.shm = ShmRef(
+                region_name=shm_name,
+                byte_size=int(params["shared_memory_byte_size"]),
+                offset=int(params.get("shared_memory_offset", 0)),
+            )
+        elif bin_size is not None:
+            chunk = binary[offset : offset + int(bin_size)]
+            if len(chunk) != int(bin_size):
+                raise InferError(
+                    f"unexpected end of binary data for input '{name}'"
+                )
+            offset += int(bin_size)
+            tensor.data = _bytes_to_array(chunk, datatype, shape, name)
+        elif "data" in t:
+            tensor.data = _json_to_array(t["data"], datatype, shape)
+        else:
+            raise InferError(f"input '{name}' has no data")
+        req.inputs.append(tensor)
+
+    for o in body.get("outputs", []) or []:
+        params = o.get("parameters", {}) or {}
+        out = RequestedOutput(
+            name=o["name"],
+            binary_data=bool(params.get("binary_data", False)),
+            class_count=int(params.get("classification", 0)),
+            parameters=params,
+        )
+        shm_name = params.get("shared_memory_region")
+        if shm_name:
+            out.shm = ShmRef(
+                region_name=shm_name,
+                byte_size=int(params["shared_memory_byte_size"]),
+                offset=int(params.get("shared_memory_offset", 0)),
+            )
+        req.outputs.append(out)
+    return req
+
+
+def _bytes_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray:
+    if datatype == "BYTES":
+        flat = deserialize_bytes_tensor(chunk)
+        return flat.reshape(shape)
+    dt = triton_to_np_dtype(datatype)
+    if dt is None:
+        raise InferError(f"unsupported datatype '{datatype}' for input '{name}'")
+    count = int(np.prod(shape)) if len(shape) else 1
+    expected = count * dt.itemsize
+    if len(chunk) != expected:
+        raise InferError(
+            f"unexpected total byte size {len(chunk)} for input '{name}', expecting {expected}"
+        )
+    return np.frombuffer(chunk, dtype=dt).reshape(shape)
+
+
+def _json_to_array(data, datatype: str, shape) -> np.ndarray:
+    if datatype == "BYTES":
+        flat = np.array(
+            [x.encode("utf-8") if isinstance(x, str) else bytes(x) for x in _flatten(data)],
+            dtype=np.object_,
+        )
+        return flat.reshape(shape)
+    dt = triton_to_np_dtype(datatype)
+    return np.array(data, dtype=dt).reshape(shape)
+
+
+def _flatten(x):
+    if isinstance(x, list):
+        for item in x:
+            yield from _flatten(item)
+    else:
+        yield x
+
+
+def _encode_response(resp, req: InferRequest, default_binary: bool) -> Tuple[bytes, int]:
+    requested = {o.name: o for o in req.outputs}
+    out_json: List[dict] = []
+    blobs: List[bytes] = []
+    for out in resp.outputs:
+        entry: Dict[str, Any] = {
+            "name": out.name,
+            "datatype": out.datatype,
+            "shape": list(out.shape),
+        }
+        spec = requested.get(out.name)
+        if out.shm is not None:
+            entry["parameters"] = {
+                "shared_memory_region": out.shm.region_name,
+                "shared_memory_byte_size": out.shm.byte_size,
+            }
+            if out.shm.offset:
+                entry["parameters"]["shared_memory_offset"] = out.shm.offset
+        else:
+            binary = spec.binary_data if spec is not None else default_binary
+            if binary:
+                blob = _array_to_bytes(out.data, out.datatype)
+                entry.setdefault("parameters", {})["binary_data_size"] = len(blob)
+                blobs.append(blob)
+            else:
+                entry["data"] = _array_to_json(out.data, out.datatype)
+        out_json.append(entry)
+    header: Dict[str, Any] = {
+        "model_name": resp.model_name,
+        "model_version": resp.model_version or "1",
+        "outputs": out_json,
+    }
+    if resp.id:
+        header["id"] = resp.id
+    if resp.parameters:
+        header["parameters"] = resp.parameters
+    json_bytes = json.dumps(header).encode("utf-8")
+    return json_bytes + b"".join(blobs), len(json_bytes)
+
+
+def _array_to_bytes(arr: np.ndarray, datatype: str) -> bytes:
+    if datatype == "BYTES":
+        return serialize_byte_tensor(arr).tobytes()
+    if datatype == "BF16":
+        return serialize_bf16_tensor(arr).tobytes()
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def _array_to_json(arr: np.ndarray, datatype: str):
+    if datatype == "BYTES":
+        flat = [
+            x.decode("utf-8") if isinstance(x, (bytes, bytearray)) else str(x)
+            for x in arr.flatten(order="C")
+        ]
+        return flat
+    return np.asarray(arr, dtype=np.float64 if datatype == "BF16" else None).flatten().tolist()

@@ -1,0 +1,273 @@
+"""Model abstraction for the serving harness.
+
+The reference's server is out of repo (SURVEY.md "critical absences"); this
+harness exists so the framework is testable hermetically (SURVEY.md §7.2) and
+so TPU serving has a first-class home.  Design is TPU-first rather than a
+Triton-backend port:
+
+* A model's compute is a **pure function** over arrays; ``JaxModel`` wraps it
+  in ``jax.jit`` once and relies on XLA caching per input-shape signature.
+* Batching pads to configured bucket sizes so XLA re-traces a bounded set of
+  shapes (static shapes — no dynamic-shape recompiles in steady state).
+* Outputs may be returned as live ``jax.Array``s; they stay on device until a
+  frontend (or an xla-shm region write) actually needs host bytes.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..protocol import inference_pb2 as pb
+from .types import InferError
+
+# Triton dtype string <-> pb.DataType enum.
+_DT_TO_PB = {
+    "BOOL": pb.TYPE_BOOL,
+    "UINT8": pb.TYPE_UINT8,
+    "UINT16": pb.TYPE_UINT16,
+    "UINT32": pb.TYPE_UINT32,
+    "UINT64": pb.TYPE_UINT64,
+    "INT8": pb.TYPE_INT8,
+    "INT16": pb.TYPE_INT16,
+    "INT32": pb.TYPE_INT32,
+    "INT64": pb.TYPE_INT64,
+    "FP16": pb.TYPE_FP16,
+    "FP32": pb.TYPE_FP32,
+    "FP64": pb.TYPE_FP64,
+    "BYTES": pb.TYPE_STRING,
+    "BF16": pb.TYPE_BF16,
+}
+_PB_TO_DT = {v: k for k, v in _DT_TO_PB.items()}
+
+
+def datatype_to_pb(dt: str) -> int:
+    return _DT_TO_PB[dt]
+
+
+def pb_to_datatype(v: int) -> str:
+    return _PB_TO_DT[v]
+
+
+def make_config(
+    name: str,
+    inputs: Sequence[Tuple[str, str, Sequence[int]]],
+    outputs: Sequence[Tuple[str, str, Sequence[int]]],
+    max_batch_size: int = 0,
+    platform: str = "jax",
+    backend: str = "jax",
+    decoupled: bool = False,
+    preferred_batch_sizes: Optional[Sequence[int]] = None,
+    max_queue_delay_us: int = 0,
+    sequence_batching: bool = False,
+    labels: Optional[Dict[str, List[str]]] = None,
+) -> pb.ModelConfig:
+    """Convenience builder for a ModelConfig proto.
+
+    ``inputs``/``outputs``: (tensor name, Triton dtype string, dims) — dims
+    exclude the batch dimension when ``max_batch_size > 0``, matching Triton
+    config semantics."""
+    cfg = pb.ModelConfig(
+        name=name, platform=platform, backend=backend, max_batch_size=max_batch_size
+    )
+    for n, dt, dims in inputs:
+        cfg.input.add(name=n, data_type=_DT_TO_PB[dt], dims=list(dims))
+    for n, dt, dims in outputs:
+        out = cfg.output.add(name=n, data_type=_DT_TO_PB[dt], dims=list(dims))
+        if labels and n in labels:
+            out.label_filename = f"{n}_labels.txt"
+    if decoupled:
+        cfg.model_transaction_policy.decoupled = True
+    if preferred_batch_sizes or max_queue_delay_us:
+        cfg.dynamic_batching.preferred_batch_size.extend(preferred_batch_sizes or [])
+        cfg.dynamic_batching.max_queue_delay_microseconds = max_queue_delay_us
+    if sequence_batching:
+        cfg.sequence_batching.max_sequence_idle_microseconds = 60_000_000
+    return cfg
+
+
+@dataclass
+class ModelStats:
+    """Per-model counters backing the statistics API (v2 `ModelStatistics`;
+    client surface at reference http/_client.py:709-765)."""
+
+    inference_count: int = 0
+    execution_count: int = 0
+    last_inference_ms: int = 0
+    success_count: int = 0
+    success_ns: int = 0
+    fail_count: int = 0
+    fail_ns: int = 0
+    queue_count: int = 0
+    queue_ns: int = 0
+    infer_count: int = 0
+    infer_ns: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def record(self, batch: int, queue_ns: int, compute_ns: int, ok: bool) -> None:
+        with self.lock:
+            if ok:
+                self.inference_count += batch
+                self.execution_count += 1
+                self.last_inference_ms = int(time.time() * 1000)
+                self.success_count += batch
+                self.success_ns += (queue_ns + compute_ns) * batch
+                self.queue_count += batch
+                self.queue_ns += queue_ns * batch
+                self.infer_count += batch
+                self.infer_ns += compute_ns * batch
+            else:
+                self.fail_count += batch
+                self.fail_ns += (queue_ns + compute_ns) * batch
+
+
+class Model(abc.ABC):
+    """Base model: subclasses implement ``execute`` (request-scoped).
+
+    ``execute`` receives a dict of input arrays (numpy for host models;
+    ``jax.Array`` for device-resident xla-shm inputs) plus request parameters
+    (including sequence controls) and returns a dict of output arrays.
+
+    Decoupled models (``transaction policy decoupled: true`` — reference
+    repeat/square examples, SURVEY.md §2.7) instead yield zero or more
+    response dicts from ``execute_decoupled``.
+    """
+
+    def __init__(self, config: pb.ModelConfig):
+        self.config = config
+        self.stats = ModelStats()
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+    @property
+    def versions(self) -> List[str]:
+        return ["1"]
+
+    @property
+    def decoupled(self) -> bool:
+        return self.config.model_transaction_policy.decoupled
+
+    @property
+    def is_sequence(self) -> bool:
+        return self.config.HasField("sequence_batching")
+
+    @property
+    def max_batch_size(self) -> int:
+        return self.config.max_batch_size
+
+    def metadata(self) -> dict:
+        """v2 model-metadata JSON (client surface: http/_client.py:470-515)."""
+        def tensor_md(io, batched):
+            dims = list(io.dims)
+            if batched:
+                dims = [-1] + dims
+            return {"name": io.name, "datatype": pb_to_datatype(io.data_type), "shape": dims}
+
+        batched = self.config.max_batch_size > 0
+        return {
+            "name": self.name,
+            "versions": self.versions,
+            "platform": self.config.platform,
+            "inputs": [tensor_md(i, batched) for i in self.config.input],
+            "outputs": [tensor_md(o, batched) for o in self.config.output],
+        }
+
+    # -- compute -----------------------------------------------------------
+    @abc.abstractmethod
+    def execute(self, inputs: Dict[str, Any], parameters: Dict[str, Any]) -> Dict[str, Any]:
+        ...
+
+    def execute_decoupled(
+        self, inputs: Dict[str, Any], parameters: Dict[str, Any]
+    ) -> Iterator[Dict[str, Any]]:
+        raise InferError(f"model '{self.name}' is not decoupled")
+
+    def labels(self, output_name: str) -> Optional[List[str]]:
+        """Classification labels for an output, if provided."""
+        return None
+
+    def unload(self) -> None:
+        """Hook for releasing device buffers on model unload."""
+
+
+class JaxModel(Model):
+    """A model whose compute is a jitted pure function over arrays.
+
+    ``fn(**inputs) -> dict[str, Array]`` is traced once per input-shape
+    signature; jax handles the compile cache.  Host-side pre/post hooks cover
+    non-arraylike work (e.g. BYTES handling, which stays host-side on TPU —
+    SURVEY.md §7 hard parts (c)).
+    """
+
+    def __init__(
+        self,
+        config: pb.ModelConfig,
+        fn: Callable[..., Dict[str, Any]],
+        jit: bool = True,
+        host_pre: Optional[Callable] = None,
+        host_post: Optional[Callable] = None,
+        donate_argnames: Optional[Sequence[str]] = None,
+        output_labels: Optional[Dict[str, List[str]]] = None,
+    ):
+        super().__init__(config)
+        if jit:
+            import jax
+
+            fn = jax.jit(fn, donate_argnames=donate_argnames)
+        self._fn = fn
+        self._host_pre = host_pre
+        self._host_post = host_post
+        self._output_labels = output_labels or {}
+
+    def execute(self, inputs: Dict[str, Any], parameters: Dict[str, Any]) -> Dict[str, Any]:
+        if self._host_pre is not None:
+            inputs = self._host_pre(inputs, parameters)
+        outputs = self._fn(**inputs)
+        if self._host_post is not None:
+            outputs = self._host_post(outputs, parameters)
+        return outputs
+
+    def labels(self, output_name: str) -> Optional[List[str]]:
+        return self._output_labels.get(output_name)
+
+
+class PyModel(Model):
+    """Host-side (non-jitted) model: arbitrary python over numpy arrays —
+    used for BYTES/string models and custom logic (the reference's "python
+    backend" analog)."""
+
+    def __init__(self, config: pb.ModelConfig, fn: Callable, decoupled_fn=None):
+        super().__init__(config)
+        self._fn = fn
+        self._decoupled_fn = decoupled_fn
+
+    def execute(self, inputs, parameters):
+        return self._fn(inputs, parameters)
+
+    def execute_decoupled(self, inputs, parameters):
+        if self._decoupled_fn is None:
+            return super().execute_decoupled(inputs, parameters)
+        return self._decoupled_fn(inputs, parameters)
+
+
+class EnsembleModel(Model):
+    """Ensemble scheduling: a DAG of steps mapping tensors between member
+    models (reference behavioral spec: ensemble_image_client.py, SURVEY.md
+    §2.7; config message at model_config ensemble_scheduling).  Executed by
+    the core, which resolves member models at infer time."""
+
+    def __init__(self, config: pb.ModelConfig):
+        super().__init__(config)
+        if not config.HasField("ensemble_scheduling"):
+            raise InferError(f"ensemble model '{config.name}' has no ensemble_scheduling")
+
+    def execute(self, inputs, parameters):  # pragma: no cover - core inlines
+        raise InferError("ensemble models are executed by the core")

@@ -1,0 +1,176 @@
+"""Model repository / registry.
+
+Covers the v2 repository API surface (client side surveyed at reference
+http/_client.py:582-707: index, load with config/file override, unload with
+dependents).  Two sources of models:
+
+* **Programmatic**: ``register_factory(name, factory)`` — used by the model
+  zoo and tests.
+* **Directory repository**: Triton-style layout ``<repo>/<model>/config.pbtxt``
+  (protobuf text format) + ``<repo>/<model>/1/model.py`` defining
+  ``get_model(config) -> Model``.  Load-time file overrides (base64 payloads
+  in load parameters) land in a temp dir, mirroring the reference's
+  in-request model directory (http/_client.py:620-671).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from google.protobuf import json_format, text_format
+
+from ..protocol import inference_pb2 as pb
+from .model import Model
+from .types import InferError
+
+
+class ModelRegistry:
+    def __init__(self, repository_path: Optional[str] = None):
+        self._factories: Dict[str, Callable[[], Model]] = {}
+        self._models: Dict[str, Model] = {}
+        self._states: Dict[str, tuple] = {}  # name -> (state, reason)
+        self._lock = threading.RLock()
+        self._repository_path = repository_path
+        if repository_path:
+            for entry in sorted(os.listdir(repository_path)):
+                if os.path.isdir(os.path.join(repository_path, entry)):
+                    self._states.setdefault(entry, ("UNAVAILABLE", "unloaded"))
+
+    # -- programmatic registration ----------------------------------------
+    def register_factory(
+        self, name: str, factory: Callable[[], Model], load_now: bool = True
+    ) -> None:
+        with self._lock:
+            self._factories[name] = factory
+            self._states[name] = ("UNAVAILABLE", "unloaded")
+            if load_now:
+                self.load(name)
+
+    def register_model(self, model: Model) -> None:
+        with self._lock:
+            self._factories[model.name] = lambda m=model: m
+            self._models[model.name] = model
+            self._states[model.name] = ("READY", "")
+
+    # -- v2 repository API --------------------------------------------------
+    def load(self, name: str, config_override: Optional[str] = None, files=None) -> None:
+        with self._lock:
+            try:
+                if name in self._factories and not files:
+                    model = self._factories[name]()
+                    if config_override:
+                        model.config = _parse_config_json(config_override, name)
+                elif self._repository_path or files:
+                    model = self._load_from_directory(name, config_override, files)
+                else:
+                    raise InferError(f"failed to load '{name}': model not found")
+            except InferError:
+                self._states[name] = ("UNAVAILABLE", "load failed")
+                raise
+            self._models[name] = model
+            self._states[name] = ("READY", "")
+
+    def unload(self, name: str, unload_dependents: bool = False) -> None:
+        with self._lock:
+            model = self._models.pop(name, None)
+            if model is None:
+                raise InferError(f"failed to unload '{name}': model is not loaded")
+            model.unload()
+            self._states[name] = ("UNAVAILABLE", "unloaded")
+            if unload_dependents and model.config.HasField("ensemble_scheduling"):
+                for step in model.config.ensemble_scheduling.step:
+                    if step.model_name in self._models:
+                        self.unload(step.model_name)
+
+    def index(self, ready_only: bool = False) -> List[dict]:
+        with self._lock:
+            out = []
+            for name in sorted(self._states):
+                state, reason = self._states[name]
+                if ready_only and state != "READY":
+                    continue
+                entry = {"name": name, "version": "1", "state": state}
+                if reason:
+                    entry["reason"] = reason
+                out.append(entry)
+            return out
+
+    def get(self, name: str, version: str = "") -> Model:
+        with self._lock:
+            model = self._models.get(name)
+        if model is None:
+            raise InferError(
+                f"Request for unknown model: '{name}' is not found", http_status=400
+            )
+        if version and version not in model.versions:
+            raise InferError(
+                f"Request for unknown model: '{name}' version {version} is not found",
+                http_status=400,
+            )
+        return model
+
+    def is_ready(self, name: str, version: str = "") -> bool:
+        with self._lock:
+            model = self._models.get(name)
+        return model is not None and (not version or version in model.versions)
+
+    def ready_models(self) -> List[Model]:
+        with self._lock:
+            return list(self._models.values())
+
+    # -- directory loading --------------------------------------------------
+    def _load_from_directory(self, name: str, config_override, files) -> Model:
+        import importlib.util
+        import tempfile
+
+        model_dir = None
+        if files:
+            # In-request model directory: files like "file:1/model.py" -> b64
+            # content (reference cc_client_test.cc:1202-1350 behavior).
+            tmp = tempfile.mkdtemp(prefix=f"tc_tpu_model_{name}_")
+            for fname, b64 in files.items():
+                rel = fname[len("file:"):] if fname.startswith("file:") else fname
+                dest = os.path.join(tmp, rel)
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                with open(dest, "wb") as f:
+                    f.write(base64.b64decode(b64))
+            model_dir = tmp
+        elif self._repository_path:
+            model_dir = os.path.join(self._repository_path, name)
+        if model_dir is None or not os.path.isdir(model_dir):
+            raise InferError(f"failed to load '{name}': not found in repository")
+
+        if config_override:
+            config = _parse_config_json(config_override, name)
+        else:
+            cfg_path = os.path.join(model_dir, "config.pbtxt")
+            if not os.path.exists(cfg_path):
+                raise InferError(f"failed to load '{name}': missing config.pbtxt")
+            config = pb.ModelConfig()
+            with open(cfg_path) as f:
+                text_format.Parse(f.read(), config)
+            if not config.name:
+                config.name = name
+
+        impl_path = os.path.join(model_dir, "1", "model.py")
+        if not os.path.exists(impl_path):
+            raise InferError(f"failed to load '{name}': missing 1/model.py")
+        spec = importlib.util.spec_from_file_location(f"tc_tpu_models.{name}", impl_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if not hasattr(mod, "get_model"):
+            raise InferError(f"failed to load '{name}': model.py lacks get_model(config)")
+        return mod.get_model(config)
+
+
+def _parse_config_json(config_json: str, name: str) -> pb.ModelConfig:
+    try:
+        cfg = json_format.Parse(config_json, pb.ModelConfig())
+        if not cfg.name:
+            cfg.name = name
+        return cfg
+    except Exception as e:
+        raise InferError(f"failed to parse config override for '{name}': {e}")

@@ -1,0 +1,259 @@
+"""Server-side shared-memory region registries.
+
+Mirrors the server half of the reference's shm RPCs (the client half is
+surveyed at http/_client.py:974-1203 and grpc/_client.py:1240-1443):
+
+* ``SystemShmRegistry`` — regions registered by (shm key, offset, byte_size);
+  the server attaches via ``shm_open``+``mmap`` (our C shim) and reads/writes
+  tensors directly in host RAM, so tensor bytes never cross the wire.
+* ``XlaShmRegistry`` — the TPU replacement for the CUDA-IPC registry
+  (wire-compatible with the v2 ``CudaSharedMemory*`` RPCs).  A registered
+  region resolves to a :class:`triton_client_tpu._xla_broker.RegionSlot`
+  holding the current device buffer: in-process registrations share the
+  client's slot (tensors stay in TPU HBM, zero copy); cross-process
+  registrations attach a host-shm staging region and pay exactly one
+  host↔device DMA per direction (see ``_xla_broker`` docstring for why —
+  PjRt has no cudaIpcOpenMemHandle equivalent).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from .._xla_broker import RegionSlot, broker
+from ..utils import shared_memory as sysshm
+from ..utils import triton_to_np_dtype
+from .types import InferError, ShmRef
+
+
+@dataclass
+class SystemShmRegion:
+    name: str
+    key: str
+    offset: int
+    byte_size: int
+    handle: object  # SharedMemoryRegionHandle attached by the server
+
+
+class SystemShmRegistry:
+    def __init__(self):
+        self._regions: Dict[str, SystemShmRegion] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, key: str, offset: int, byte_size: int) -> None:
+        with self._lock:
+            if name in self._regions:
+                raise InferError(
+                    f"shared memory region '{name}' already in manager", http_status=400
+                )
+            try:
+                handle = sysshm.attach_shared_memory_region(name, key, byte_size, offset)
+            except sysshm.SharedMemoryException as e:
+                raise InferError(f"failed to register shared memory region '{name}': {e}")
+            self._regions[name] = SystemShmRegion(name, key, offset, byte_size, handle)
+
+    def unregister(self, name: Optional[str]) -> None:
+        """Unregister one region, or all when name is falsy (reference
+        semantics: unregister-all endpoint passes no name)."""
+        with self._lock:
+            names = [name] if name else list(self._regions)
+            for n in names:
+                region = self._regions.pop(n, None)
+                if region is not None:
+                    sysshm.destroy_shared_memory_region(region.handle)
+
+    def status(self, name: Optional[str]) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                n: {
+                    "name": r.name,
+                    "key": r.key,
+                    "offset": r.offset,
+                    "byte_size": r.byte_size,
+                }
+                for n, r in self._regions.items()
+                if not name or n == name
+            }
+
+    def _get(self, ref: ShmRef) -> SystemShmRegion:
+        with self._lock:
+            region = self._regions.get(ref.region_name)
+        if region is None:
+            raise InferError(f"Unable to find shared memory region: '{ref.region_name}'")
+        return region
+
+    def read(self, ref: ShmRef, datatype: str, shape) -> np.ndarray:
+        region = self._get(ref)
+        if ref.offset + ref.byte_size > region.byte_size:
+            raise InferError(
+                f"Invalid offset + byte size for shared memory region: '{ref.region_name}'"
+            )
+        dt = triton_to_np_dtype(datatype)
+        if dt is None:
+            raise InferError(f"unsupported datatype {datatype}")
+        arr = sysshm.get_contents_as_numpy(region.handle, dt, list(shape), offset=ref.offset)
+        # Copy out: request processing must not alias a client-mutable region.
+        return np.array(arr, copy=True)
+
+    def write(self, ref: ShmRef, data: np.ndarray) -> int:
+        """Write an output tensor into the region; returns bytes written."""
+        region = self._get(ref)
+        if data.dtype == np.object_ or data.dtype.kind in ("S", "U"):
+            from ..utils import serialize_byte_tensor
+
+            payload = serialize_byte_tensor(data)
+        else:
+            payload = np.ascontiguousarray(data)
+        if payload.nbytes > ref.byte_size or ref.offset + payload.nbytes > region.byte_size:
+            raise InferError(
+                f"shared memory region '{ref.region_name}' too small for output", 400
+            )
+        sysshm.set_shared_memory_region(region.handle, [payload], offset=ref.offset)
+        return payload.nbytes
+
+
+@dataclass
+class XlaShmRegion:
+    name: str
+    device_id: int
+    byte_size: int
+    slot: Optional[RegionSlot] = None  # in-process zero-copy path
+    staging_handle: Optional[object] = None  # cross-process staging path
+
+
+class XlaShmRegistry:
+    def __init__(self):
+        self._regions: Dict[str, XlaShmRegion] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, raw_handle: bytes, device_id: int, byte_size: int) -> None:
+        try:
+            desc = json.loads(bytes(raw_handle).decode("utf-8"))
+        except Exception:
+            raise InferError(
+                f"failed to register CUDA/XLA shared memory region '{name}': "
+                "raw handle is not a valid descriptor"
+            )
+        with self._lock:
+            if name in self._regions:
+                raise InferError(f"shared memory region '{name}' already in manager")
+        region = XlaShmRegion(name=name, device_id=device_id, byte_size=byte_size)
+        uid = desc.get("uuid")
+        slot = broker().lookup(uid) if uid else None
+        if slot is not None:
+            region.slot = slot
+        elif desc.get("staging_key"):
+            try:
+                region.staging_handle = sysshm.attach_shared_memory_region(
+                    name, desc["staging_key"], byte_size
+                )
+            except sysshm.SharedMemoryException as e:
+                raise InferError(f"failed to map staging region for '{name}': {e}")
+        else:
+            raise InferError(
+                f"failed to register XLA shared memory region '{name}': handle "
+                "refers to neither an in-process slot nor a staging region"
+            )
+        with self._lock:
+            self._regions[name] = region
+
+    def unregister(self, name: Optional[str]) -> None:
+        with self._lock:
+            names = [name] if name else list(self._regions)
+            for n in names:
+                region = self._regions.pop(n, None)
+                if region is not None and region.staging_handle is not None:
+                    sysshm.destroy_shared_memory_region(region.staging_handle)
+
+    def status(self, name: Optional[str]) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                n: {"name": r.name, "device_id": r.device_id, "byte_size": r.byte_size}
+                for n, r in self._regions.items()
+                if not name or n == name
+            }
+
+    def _get(self, ref: ShmRef) -> XlaShmRegion:
+        with self._lock:
+            region = self._regions.get(ref.region_name)
+        if region is None:
+            raise InferError(f"Unable to find shared memory region: '{ref.region_name}'")
+        return region
+
+    def read(self, ref: ShmRef, datatype: str, shape):
+        """Materialize the region as a device array for model input.
+
+        In-process: the client's live jax.Array, consumed with no copy.
+        Cross-process: one ``jax.device_put`` from the host staging region."""
+        import jax
+
+        region = self._get(ref)
+        if region.slot is not None:
+            array, _, _ = region.slot.get()
+            if array is None:
+                raise InferError(
+                    f"shared memory region '{ref.region_name}' has no contents"
+                )
+            return _reinterpret_device(array, datatype, shape)
+        dt = triton_to_np_dtype(datatype)
+        if dt is None:
+            raise InferError(f"unsupported datatype {datatype}")
+        host = sysshm.get_contents_as_numpy(
+            region.staging_handle, dt, list(shape), offset=ref.offset
+        )
+        return jax.device_put(np.array(host, copy=True))
+
+    def write(self, ref: ShmRef, data) -> int:
+        """Write a model output into the region.
+
+        In-process: rebind the slot to the output buffer — device-to-device
+        handoff with no host hop.  Cross-process: one D2H into staging."""
+        from ..utils import np_to_triton_dtype
+
+        region = self._get(ref)
+        if region.slot is not None:
+            import jax
+
+            arr = data if hasattr(data, "sharding") else jax.device_put(np.asarray(data))
+            nbytes = arr.size * arr.dtype.itemsize
+            if nbytes > ref.byte_size:
+                raise InferError(
+                    f"shared memory region '{ref.region_name}' too small for output"
+                )
+            host_dt = np.dtype(arr.dtype)
+            region.slot.bind(arr, np_to_triton_dtype(host_dt), tuple(arr.shape))
+            return nbytes
+        host = np.asarray(data)
+        if host.nbytes > ref.byte_size:
+            raise InferError(
+                f"shared memory region '{ref.region_name}' too small for output"
+            )
+        sysshm.set_shared_memory_region(region.staging_handle, [host], offset=ref.offset)
+        return host.nbytes
+
+
+def _reinterpret_device(array, datatype: str, shape):
+    """Reinterpret a device buffer as ``datatype``/``shape`` without leaving
+    the device: bitcast u8 bytes -> target dtype when layouts differ."""
+    import jax.numpy as jnp
+
+    dt = triton_to_np_dtype(datatype)
+    if dt is None:
+        raise InferError(f"unsupported datatype {datatype}")
+    if array.dtype == dt and tuple(array.shape) == tuple(shape):
+        return array
+    if array.dtype == jnp.uint8:
+        import jax.lax as lax
+
+        itemsize = np.dtype(dt).itemsize
+        flat = array.reshape((-1, itemsize)) if itemsize > 1 else array.reshape((-1,))
+        cast = lax.bitcast_convert_type(flat, dt)
+        return cast.reshape(tuple(shape))
+    return array.reshape(tuple(shape)).astype(dt) if array.dtype != dt else array.reshape(
+        tuple(shape)
+    )

@@ -1,0 +1,98 @@
+"""In-process server harness for hermetic tests and co-located serving.
+
+``ServerHarness`` runs the HTTP and gRPC frontends on a background-thread
+event loop inside the current process.  This is both the test fixture
+(SURVEY.md §4: integration tests need a live server; the reference outsources
+that to external CI) and the production co-located topology for the xla
+shared-memory zero-copy path (client and server share the TPU process, see
+``_xla_broker``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+from typing import Optional
+
+from aiohttp import web
+
+from .._xla_broker import broker
+from .core import InferenceCore
+from .grpc_server import build_grpc_server
+from .http_server import build_app
+from .registry import ModelRegistry
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ServerHarness:
+    def __init__(
+        self,
+        registry: Optional[ModelRegistry] = None,
+        http_port: Optional[int] = None,
+        grpc_port: Optional[int] = None,
+        host: str = "127.0.0.1",
+    ):
+        self.registry = registry or ModelRegistry()
+        self.core = InferenceCore(self.registry)
+        self.host = host
+        self.http_port = http_port or free_port()
+        self.grpc_port = grpc_port or free_port()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._stop_event: Optional[asyncio.Event] = None
+
+    @property
+    def http_url(self) -> str:
+        return f"{self.host}:{self.http_port}"
+
+    @property
+    def grpc_url(self) -> str:
+        return f"{self.host}:{self.grpc_port}"
+
+    def start(self) -> "ServerHarness":
+        broker().server_present = True
+        self._thread = threading.Thread(target=self._run, daemon=True, name="tc-tpu-server")
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("server harness failed to start within 30s")
+        return self
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(self._serve())
+        loop.close()
+
+    async def _serve(self) -> None:
+        self._stop_event = asyncio.Event()
+        runner = web.AppRunner(build_app(self.core))
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.http_port)
+        await site.start()
+        grpc_server = build_grpc_server(self.core, f"{self.host}:{self.grpc_port}")
+        await grpc_server.start()
+        self._started.set()
+        await self._stop_event.wait()
+        await grpc_server.stop(grace=1.0)
+        await runner.cleanup()
+
+    def stop(self) -> None:
+        if self._loop is not None and self._stop_event is not None:
+            self._loop.call_soon_threadsafe(self._stop_event.set)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        broker().server_present = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,94 @@
+"""Transport-neutral request/response model for the serving harness.
+
+Both frontends (HTTP ``http_server.py`` and gRPC ``grpc_server.py``) decode
+into these structures; the core (``core.py``) only ever sees them.  This is
+the harness-side mirror of the client's L2 tensor layer (SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class InputTensor:
+    name: str
+    datatype: str
+    shape: Tuple[int, ...]
+    # Exactly one of `data` (decoded ndarray) / `shm` (region reference).
+    data: Optional[np.ndarray] = None
+    shm: Optional["ShmRef"] = None
+    parameters: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ShmRef:
+    region_name: str
+    byte_size: int
+    offset: int = 0
+
+
+@dataclass
+class RequestedOutput:
+    name: str
+    binary_data: bool = True  # HTTP only: whether to return binary or JSON
+    class_count: int = 0
+    shm: Optional[ShmRef] = None
+    parameters: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class InferRequest:
+    model_name: str
+    model_version: str = ""
+    id: str = ""
+    inputs: List[InputTensor] = field(default_factory=list)
+    outputs: List[RequestedOutput] = field(default_factory=list)
+    parameters: Dict[str, Any] = field(default_factory=dict)
+    # Filled by the core:
+    arrival_ns: int = field(default_factory=lambda: time.monotonic_ns())
+
+    @property
+    def sequence_id(self):
+        return self.parameters.get("sequence_id", 0)
+
+    @property
+    def sequence_start(self) -> bool:
+        return bool(self.parameters.get("sequence_start", False))
+
+    @property
+    def sequence_end(self) -> bool:
+        return bool(self.parameters.get("sequence_end", False))
+
+
+@dataclass
+class OutputTensor:
+    name: str
+    datatype: str
+    shape: Tuple[int, ...]
+    data: np.ndarray  # always host ndarray at the frontend boundary
+    # When the client asked for this output in shm, the core wrote it there and
+    # the frontend must emit only shm params, no data:
+    shm: Optional[ShmRef] = None
+    parameters: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class InferResponse:
+    model_name: str
+    model_version: str
+    id: str = ""
+    outputs: List[OutputTensor] = field(default_factory=list)
+    parameters: Dict[str, Any] = field(default_factory=dict)
+
+
+class InferError(Exception):
+    """Server-side inference error with an HTTP status / gRPC code mapping."""
+
+    def __init__(self, msg: str, http_status: int = 400):
+        super().__init__(msg)
+        self.http_status = http_status

@@ -13,8 +13,8 @@ TPU-first deviations (deliberate, documented):
   bfloat16 array that feeds straight into ``jax.numpy`` with no conversion,
   keeping the MXU-native dtype end to end.  Float32 arrays are still accepted
   on the serialization side for drop-in compatibility.
-* BYTES (de)serialization is vectorized with numpy instead of per-element
-  ``struct.pack`` loops; the wire format is unchanged
+* BYTES (de)serialization uses memoryview-based loops with a single join;
+  the wire format is unchanged
   (``<uint32 little-endian length><raw bytes>`` per element, row-major).
 """
 
@@ -190,16 +190,18 @@ def serialize_byte_tensor(input_tensor: np.ndarray) -> Optional[np.ndarray]:
     return np.frombuffer(joined, dtype=np.uint8)
 
 
-def deserialize_bytes_tensor(encoded_tensor: bytes) -> np.ndarray:
+def deserialize_bytes_tensor(encoded_tensor: bytes, count: Optional[int] = None) -> np.ndarray:
     """Deserialize a v2 BYTES buffer into a 1-D object array of ``bytes``.
 
-    Reference: utils/__init__.py:249-276.  Caller reshapes to the tensor shape.
+    Reference: utils/__init__.py:249-276.  Caller reshapes to the tensor
+    shape.  When ``count`` is given, decode exactly that many elements and
+    ignore trailing bytes (used when reading from an oversized shm region).
     """
     strs = []
     mv = memoryview(encoded_tensor)
     offset = 0
     n = len(mv)
-    while offset < n:
+    while offset < n if count is None else len(strs) < count:
         if offset + 4 > n:
             raise_error("unexpected end of serialized BYTES tensor")
         (length,) = struct.unpack_from("<I", mv, offset)
@@ -215,18 +217,17 @@ def serialize_bf16_tensor(input_tensor: np.ndarray) -> np.ndarray:
     """Serialize a tensor to raw little-endian bfloat16 bytes.
 
     Accepts a native ``ml_dtypes.bfloat16`` array (zero-conversion fast path)
-    or a float32 array (reference-compatible: truncating round, matching the
-    high-2-bytes serializer at utils/__init__.py:279-318).
+    or a float32 array, which is **truncated** (top 2 bytes kept) for
+    bit-exact wire parity with the reference's serializer
+    (utils/__init__.py:279-318).  Callers wanting round-to-nearest should
+    ``astype(ml_dtypes.bfloat16)`` themselves before serializing.
     """
     if _BF16_NP is not None and input_tensor.dtype == _BF16_NP:
         arr = np.ascontiguousarray(input_tensor)
         return np.frombuffer(arr.tobytes(), dtype=np.uint8)
     if input_tensor.dtype != np.dtype(np.float32):
         raise_error("cannot serialize bf16 tensor: invalid datatype")
-    if _BF16_NP is not None:
-        arr = np.ascontiguousarray(input_tensor).astype(_BF16_NP)
-        return np.frombuffer(arr.tobytes(), dtype=np.uint8)
-    # Fallback: truncate each f32 to its top 2 bytes (little-endian layout).
+    # Truncate each f32 to its top 2 bytes (little-endian layout).
     as_u16 = (np.ascontiguousarray(input_tensor).view(np.uint32) >> 16).astype(np.uint16)
     return np.frombuffer(as_u16.tobytes(), dtype=np.uint8)
 

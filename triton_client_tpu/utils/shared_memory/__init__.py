@@ -66,6 +66,7 @@ def _lib():
             ctypes.c_char_p,
             ctypes.c_char_p,
             ctypes.c_size_t,
+            ctypes.c_int,
             ctypes.POINTER(ctypes.c_void_p),
         ]
         lib.SharedMemoryRegionOpen.restype = ctypes.c_int
@@ -143,14 +144,19 @@ def create_shared_memory_region(
     """Create (or attach to) the POSIX shm region ``shm_key``.
 
     Reference semantics (:93-127): creates the region if absent; when
-    ``create_only`` is True and the region already exists, raises.
+    ``create_only`` is True and the region already exists (in any process),
+    raises — enforced with O_EXCL at shm_open, not a local registry check.
     """
     lib = _lib()
-    if create_only and shm_key in _mapped_shm_regions:
-        raise SharedMemoryException(-2)
+    if byte_size <= 0:
+        raise SharedMemoryException(-3)
     handle = ctypes.c_void_p()
     err = lib.SharedMemoryRegionCreate(
-        triton_shm_name.encode(), shm_key.encode(), byte_size, ctypes.byref(handle)
+        triton_shm_name.encode(),
+        shm_key.encode(),
+        byte_size,
+        1 if create_only else 0,
+        ctypes.byref(handle),
     )
     if err != 0:
         raise SharedMemoryException(err)
@@ -183,6 +189,8 @@ def set_shared_memory_region(
     into the region)."""
     if not isinstance(input_values, (list, tuple)):
         raise SharedMemoryException(-1)
+    if offset < 0:
+        raise SharedMemoryException(-8)
     lib = _lib()
     cur = offset
     for arr in input_values:
@@ -217,6 +225,8 @@ def get_contents_as_numpy(
        access) once ``destroy_shared_memory_region`` unmaps the region.  Call
        ``.copy()`` if you need the data to outlive the region.  (Same
        semantics as the reference; BYTES results are always copies.)"""
+    if offset < 0 or offset > shm_handle.byte_size:
+        raise SharedMemoryException(-8)
     base = shm_handle.base_addr()
     region_size = shm_handle.byte_size - offset
     dt = np.dtype(datatype)
@@ -226,30 +236,17 @@ def get_contents_as_numpy(
         # we don't require that).
         raw = ctypes.string_at(base + offset, region_size)
         n = int(np.prod(shape)) if len(shape) else 1
-        flat = _deserialize_first_n(raw, n)
+        try:
+            flat = deserialize_bytes_tensor(raw, count=n)
+        except Exception:
+            raise SharedMemoryException(-8)
         return flat.reshape(shape)
     count = int(np.prod(shape)) if len(shape) else 1
+    if count * dt.itemsize > region_size:
+        raise SharedMemoryException(-8)
     buf = (ctypes.c_uint8 * (count * dt.itemsize)).from_address(base + offset)
     arr = np.frombuffer(buf, dtype=dt, count=count).reshape(shape)
     return arr
-
-
-def _deserialize_first_n(raw: bytes, n: int) -> np.ndarray:
-    import struct
-
-    out = []
-    mv = memoryview(raw)
-    pos = 0
-    for _ in range(n):
-        if pos + 4 > len(mv):
-            raise SharedMemoryException(-8)
-        (length,) = struct.unpack_from("<I", mv, pos)
-        pos += 4
-        if pos + length > len(mv):
-            raise SharedMemoryException(-8)
-        out.append(bytes(mv[pos : pos + length]))
-        pos += length
-    return np.array(out, dtype=np.object_)
 
 
 def as_shared_memory_tensor(
