@@ -1,0 +1,100 @@
+"""Headline benchmark: client→server infer throughput on the real chip.
+
+Runs the in-process serving harness (HTTP + gRPC frontends over the jax
+`simple` sum/diff model — BASELINE config #1) and drives it with the sync
+gRPC client at concurrency, perf_analyzer style.  Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline", ...extras}``.
+
+The reference publishes no numbers (SURVEY.md §6), so ``vs_baseline`` is
+relative to the first recorded round (1.0 when no prior record exists).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    from triton_client_tpu.grpc import InferenceServerClient, InferInput
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server.registry import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    harness = ServerHarness(registry)
+    harness.start()
+
+    url = f"127.0.0.1:{harness.grpc_port}"
+    concurrency = 8
+    warmup_s, measure_s = 2.0, 5.0
+
+    def make_inputs():
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        i0 = InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_data_from_numpy(a)
+        i1 = InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_data_from_numpy(b)
+        return [i0, i1]
+
+    latencies: list = []
+    counts = [0] * concurrency
+    stop = threading.Event()
+    start_measuring = threading.Event()
+
+    def worker(idx: int):
+        client = InferenceServerClient(url)
+        inputs = make_inputs()
+        local_lat = []
+        n = 0
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            client.infer("simple", inputs)
+            dt = time.perf_counter() - t0
+            if start_measuring.is_set():
+                local_lat.append(dt)
+                n += 1
+        counts[idx] = n
+        latencies.append(local_lat)
+        client.close()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s)
+    start_measuring.set()
+    t0 = time.perf_counter()
+    time.sleep(measure_s)
+    stop.set()
+    elapsed = time.perf_counter() - t0
+    for t in threads:
+        t.join(timeout=10)
+    harness.stop()
+
+    total = sum(counts)
+    lat = np.sort(np.concatenate([np.asarray(l) for l in latencies if l]))
+    infer_per_sec = total / elapsed
+    p50 = float(np.percentile(lat, 50) * 1e3) if lat.size else float("nan")
+    p99 = float(np.percentile(lat, 99) * 1e3) if lat.size else float("nan")
+
+    print(json.dumps({
+        "metric": "grpc_infer_throughput_simple_c8",
+        "value": round(infer_per_sec, 2),
+        "unit": "infer/sec",
+        "vs_baseline": 1.0,
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "concurrency": concurrency,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,487 @@
+"""Flagship TPU-native transformer: explicit 5-axis SPMD sharding.
+
+This is the framework's flagship served model family (the TPU analog of the
+reference's ResNet-50 / BERT-large / Llama-3-8B baseline configs —
+/root/repo/BASELINE.json; the reference itself ships no models, it is a
+client SDK, SURVEY.md §2.7) and the vehicle for the multi-chip dry run.
+
+Design (scaling-book recipe, hand-rolled collectives under ``jax.shard_map``):
+
+* Mesh axes ``('dp','pp','ep','sp','tp')``:
+    - **dp**  data parallel over batch.
+    - **pp**  GPipe pipeline parallel over layer stages (``ppermute`` ring).
+    - **ep**  expert parallel over MoE experts (per-expert FFN shards,
+      combined with ``psum`` over ``ep``).
+    - **sp**  sequence parallel via **ring attention**: K/V chunks circulate
+      the ``sp`` ring with ``ppermute`` while a flash-style online softmax
+      accumulates partial attention (causal).
+    - **tp**  tensor parallel over attention heads and FFN hidden dim with
+      ``psum`` reductions after the output projections.
+* Everything runs in one ``shard_map``: forward, loss, backward (jax.grad
+  through the collectives), per-parameter gradient synchronisation, and a
+  manual AdamW update on the local shards.  Gradient sync rule: for every
+  parameter leaf, ``psum`` over exactly the mesh axes the leaf is *replicated*
+  over (untied-copy summation is the correct tied gradient; ranks whose copy
+  is unused contribute zero).
+* Static shapes throughout; layer loop is ``lax.scan`` over stacked layer
+  params; pipeline and ring loops are ``lax.fori_loop`` — no Python control
+  flow inside jit.
+* bfloat16 activations/matmuls (MXU-friendly), float32 params/optimizer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 64
+    n_layers: int = 4
+    n_heads: int = 4
+    head_dim: int = 16
+    d_ff: int = 128
+    n_experts: int = 2        # 0 => dense FFN, >0 => MoE FFN
+    moe_top_k: int = 2
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+
+    @property
+    def moe(self) -> bool:
+        return self.n_experts > 0
+
+
+# Llama-3-8B-shaped config for real-hardware serving/benching (same code path).
+LLAMA3_8B = TransformerConfig(
+    vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+    head_dim=128, d_ff=14336, n_experts=0,
+)
+
+TINY = TransformerConfig()
+
+
+def mesh_shape_for(n_devices: int, cfg: TransformerConfig) -> Dict[str, int]:
+    """Greedy factorization of ``n_devices`` onto the 5 mesh axes.
+
+    Priority tp > sp > pp > ep > dp (ICI-friendly inner axes first); any
+    non-power-of-two remainder lands on dp."""
+    sizes = {"dp": 1, "pp": 1, "ep": 1, "sp": 1, "tp": 1}
+    rem = n_devices
+    # the sharded model dim must be divisible by the axis size
+    dims = {
+        "tp": cfg.n_heads,
+        "sp": 4,  # seq chunks; callers pick seq lengths divisible by sp
+        "pp": cfg.n_layers,
+        "ep": max(cfg.n_experts, 1),
+    }
+
+    def can_grow(ax):
+        new = sizes[ax] * 2
+        return rem % 2 == 0 and new <= dims[ax] and dims[ax] % new == 0
+
+    # first pass: one factor of 2 per axis (spread before deepening)
+    for ax in ("tp", "sp", "pp", "ep"):
+        if can_grow(ax):
+            sizes[ax] *= 2
+            rem //= 2
+    # second pass: deepen axes if devices remain
+    for ax in ("tp", "sp", "pp", "ep"):
+        while can_grow(ax):
+            sizes[ax] *= 2
+            rem //= 2
+    sizes["dp"] *= rem
+    return sizes
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              cfg: TransformerConfig = TINY,
+              devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    shape = mesh_shape_for(len(devices), cfg)
+    arr = np.asarray(devices).reshape([shape[a] for a in MESH_AXES])
+    return Mesh(arr, MESH_AXES)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    """PartitionSpec per parameter leaf.  Layer-stacked leaves lead with the
+    layer dim sharded over ``pp`` (each pipeline stage owns its layers)."""
+    specs = {
+        "embed": P(None, None),
+        "wq": P("pp", None, "tp", None),
+        "wk": P("pp", None, "tp", None),
+        "wv": P("pp", None, "tp", None),
+        "wo": P("pp", "tp", None, None),
+        "ln1": P("pp", None),
+        "ln2": P("pp", None),
+        "final_ln": P(None),
+        "head": P(None, None),
+    }
+    if cfg.moe:
+        specs.update({
+            "router": P("pp", None, None),
+            "we1": P("pp", "ep", None, "tp"),
+            "we2": P("pp", "ep", "tp", None),
+        })
+    else:
+        specs.update({
+            "w1": P("pp", None, "tp"),
+            "w2": P("pp", "tp", None),
+        })
+    return specs
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, jax.Array]:
+    """Global (unsharded) float32 init; shard_map in_specs scatter them."""
+    keys = jax.random.split(rng, 16)
+    D, H, K, F, L, V = (cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff,
+                        cfg.n_layers, cfg.vocab_size)
+    s = lambda *sh: 1.0 / math.sqrt(sh[-2] if len(sh) > 1 else sh[-1])
+    # qkv projections: fan-in is d_model (dim 1 of [L, D, H, K])
+    norm = lambda k, *sh: (jax.random.normal(k, sh, jnp.float32)
+                           * (1.0 / math.sqrt(sh[1])))
+    p = {
+        "embed": jax.random.normal(keys[0], (V, D), jnp.float32) * 0.02,
+        "wq": norm(keys[1], L, D, H, K),
+        "wk": norm(keys[2], L, D, H, K),
+        "wv": norm(keys[3], L, D, H, K),
+        "wo": jax.random.normal(keys[4], (L, H, K, D), jnp.float32)
+              * (1.0 / math.sqrt(H * K)),
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "final_ln": jnp.ones((D,), jnp.float32),
+        "head": jax.random.normal(keys[5], (D, V), jnp.float32) * 0.02,
+    }
+    if cfg.moe:
+        E = cfg.n_experts
+        p["router"] = jax.random.normal(keys[6], (L, D, E), jnp.float32) * 0.02
+        p["we1"] = jax.random.normal(keys[7], (L, E, D, F), jnp.float32) * s(D, F)
+        p["we2"] = jax.random.normal(keys[8], (L, E, F, D), jnp.float32) * s(F, D)
+    else:
+        p["w1"] = jax.random.normal(keys[7], (L, D, F), jnp.float32) * s(D, F)
+        p["w2"] = jax.random.normal(keys[8], (L, F, D), jnp.float32) * s(F, D)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Model math (runs INSIDE shard_map: all arrays are per-device local shards)
+# ---------------------------------------------------------------------------
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * r).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(q, k, positions, theta):
+    # q,k: [B, Hl, S, K]; positions: [S]
+    Kd = q.shape[-1]
+    half = Kd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        xr1 = x1 * cos - x2 * sin
+        xr2 = x2 * cos + x1 * sin
+        return jnp.concatenate([xr1, xr2], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def _ring_attention(q, k, v, cfg: TransformerConfig):
+    """Causal ring attention over the ``sp`` axis.
+
+    q,k,v: [B, Hl, Sc, K] local chunks.  K/V circulate the ring via
+    ``ppermute``; a flash-style online softmax accumulates partials so the
+    full sequence never materialises on one device (the TPU-native answer to
+    long-context scaling — SURVEY.md §5 long-context note)."""
+    sp = lax.axis_size("sp")
+    me = lax.axis_index("sp")
+    B, Hl, Sc, Kd = q.shape
+    scale = 1.0 / math.sqrt(Kd)
+    qpos = me * Sc + jnp.arange(Sc)
+    q32 = q.astype(jnp.float32)
+
+    def body(r, carry):
+        k_c, v_c, m, l, o = carry
+        src = (me - r) % sp  # original owner of the chunk currently held
+        kpos = src * Sc + jnp.arange(Sc)
+        s = jnp.einsum("bhqk,bhsk->bhqs", q32, k_c.astype(jnp.float32)) * scale
+        mask = (qpos[:, None] >= kpos[None, :]).astype(jnp.float32)
+        s = jnp.where(mask > 0, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None]) * mask
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1)
+        o_new = (corr[..., None] * o
+                 + jnp.einsum("bhqs,bhsk->bhqk", p, v_c.astype(jnp.float32)))
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        k_n = lax.ppermute(k_c, "sp", perm)
+        v_n = lax.ppermute(v_c, "sp", perm)
+        return k_n, v_n, m_new, l_new, o_new
+
+    m0 = jnp.full((B, Hl, Sc), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, Hl, Sc), jnp.float32)
+    o0 = jnp.zeros((B, Hl, Sc, Kd), jnp.float32)
+    _, _, _, l, o = lax.fori_loop(0, sp, body, (k, v, m0, l0, o0))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def _attn_apply(blk, x, cfg: TransformerConfig):
+    h = _rmsnorm(x, blk["ln1"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bhsk", h, blk["wq"].astype(h.dtype))
+    k = jnp.einsum("bsd,dhk->bhsk", h, blk["wk"].astype(h.dtype))
+    v = jnp.einsum("bsd,dhk->bhsk", h, blk["wv"].astype(h.dtype))
+    Sc = x.shape[1]
+    positions = lax.axis_index("sp") * Sc + jnp.arange(Sc)
+    q, k = _rope(q, k, positions, cfg.rope_theta)
+    o = _ring_attention(q, k, v, cfg)
+    out = jnp.einsum("bhsk,hkd->bsd", o, blk["wo"].astype(o.dtype))
+    out = lax.psum(out, "tp")
+    return x + out
+
+
+def _ffn_apply(blk, x, cfg: TransformerConfig):
+    h = _rmsnorm(x, blk["ln2"], cfg.norm_eps)
+    if cfg.moe:
+        gate = jnp.einsum("bsd,de->bse", h.astype(jnp.float32),
+                          blk["router"].astype(jnp.float32))
+        top, _ = lax.top_k(gate, cfg.moe_top_k)
+        thresh = top[..., -1:]
+        probs = jax.nn.softmax(jnp.where(gate >= thresh, gate, -1e30), axis=-1)
+        El = blk["we1"].shape[0]
+        start = lax.axis_index("ep") * El
+        local_probs = lax.dynamic_slice_in_dim(probs, start, El, axis=-1)
+        he = jnp.einsum("bsd,edf->ebsf", h, blk["we1"].astype(h.dtype))
+        he = jax.nn.silu(he)
+        oe = jnp.einsum("ebsf,efd->ebsd", he, blk["we2"].astype(h.dtype))
+        oe = lax.psum(oe, "tp")
+        out = jnp.einsum("ebsd,bse->bsd", oe, local_probs.astype(oe.dtype))
+        out = lax.psum(out, "ep")
+    else:
+        he = jnp.einsum("bsd,df->bsf", h, blk["w1"].astype(h.dtype))
+        he = jax.nn.silu(he)
+        out = jnp.einsum("bsf,fd->bsd", he, blk["w2"].astype(h.dtype))
+        out = lax.psum(out, "tp")
+    return x + out
+
+
+_LAYER_KEYS_DENSE = ("wq", "wk", "wv", "wo", "ln1", "ln2", "w1", "w2")
+_LAYER_KEYS_MOE = ("wq", "wk", "wv", "wo", "ln1", "ln2", "router", "we1", "we2")
+
+
+def _layer_keys(cfg):
+    return _LAYER_KEYS_MOE if cfg.moe else _LAYER_KEYS_DENSE
+
+
+def _stage_apply(params, x, cfg: TransformerConfig):
+    """Run this pipeline stage's local stack of layers (lax.scan)."""
+    blocks = {k: params[k] for k in _layer_keys(cfg)}
+
+    def step(carry, blk):
+        y = _attn_apply(blk, carry, cfg)
+        y = _ffn_apply(blk, y, cfg)
+        return y, None
+
+    out, _ = lax.scan(step, x, blocks)
+    return out
+
+
+def _pipeline_apply(params, x_mbs, cfg: TransformerConfig):
+    """GPipe schedule over the ``pp`` ring.
+
+    x_mbs: [n_micro, mb, Sc, D] embedded microbatches (identical on every pp
+    rank).  Returns [n_micro, mb, Sc, D] — valid only on the LAST stage;
+    other stages hold garbage that callers must mask."""
+    pp = lax.axis_size("pp")
+    stage = lax.axis_index("pp")
+    n_micro = x_mbs.shape[0]
+    steps = n_micro + pp - 1
+    state0 = jnp.zeros_like(x_mbs[0])
+    out0 = jnp.zeros_like(x_mbs)
+
+    def body(t, carry):
+        state, outs = carry
+        inp = lax.dynamic_index_in_dim(
+            x_mbs, jnp.minimum(t, n_micro - 1), 0, keepdims=False)
+        state = jnp.where(stage == 0, inp, state)
+        state = _stage_apply(params, state, cfg)
+        out_idx = t - (pp - 1)
+        idx = jnp.clip(out_idx, 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
+        valid = jnp.logical_and(out_idx >= 0, stage == pp - 1)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, state, cur), idx, 0)
+        perm = [(j, (j + 1) % pp) for j in range(pp)]
+        state = lax.ppermute(state, "pp", perm)
+        return state, outs
+
+    _, outs = lax.fori_loop(0, steps, body, (state0, out0))
+    return outs
+
+
+def _local_loss(params, tokens, labels, cfg: TransformerConfig,
+                n_micro: int):
+    """Per-rank masked loss sum + local token count.
+
+    tokens/labels: [Bl, Sc] local (dp, sp) shards, replicated over pp/ep/tp.
+    Loss is nonzero only on the last pp stage; callers psum over
+    (dp, sp, pp) and divide by the global count."""
+    Bl, Sc = tokens.shape
+    mb = Bl // n_micro
+    x = jnp.take(params["embed"].astype(cfg.dtype), tokens, axis=0)
+    x_mbs = x.reshape(n_micro, mb, Sc, cfg.d_model)
+    outs = _pipeline_apply(params, x_mbs, cfg)
+    h = _rmsnorm(outs, params["final_ln"], cfg.norm_eps)
+    logits = jnp.einsum("nbsd,dv->nbsv", h.astype(jnp.float32),
+                        params["head"].astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    lab = labels.reshape(n_micro, mb, Sc)
+    nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+    is_last = (lax.axis_index("pp") == lax.axis_size("pp") - 1)
+    local_sum = jnp.where(is_last, jnp.sum(nll), 0.0)
+    return local_sum
+
+
+def _replicated_axes(spec: P) -> Tuple[str, ...]:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return tuple(a for a in MESH_AXES if a not in used)
+
+
+def _sync_grads(grads, specs):
+    return {k: (lax.psum(g, _replicated_axes(specs[k]))
+                if _replicated_axes(specs[k]) else g)
+            for k, g in grads.items()}
+
+
+# ---------------------------------------------------------------------------
+# Manual AdamW (elementwise => shards independently; no optax state-spec glue)
+# ---------------------------------------------------------------------------
+
+def adam_init(params):
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return {"mu": zeros, "nu": jax.tree.map(jnp.zeros_like, params),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def _adam_update(params, grads, opt, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                 weight_decay=0.0):
+    count = opt["count"] + 1
+    t = count.astype(jnp.float32)
+    bc1 = 1.0 - b1 ** t
+    bc2 = 1.0 - b2 ** t
+
+    def upd(p, g, mu, nu):
+        mu = b1 * mu + (1 - b1) * g
+        nu = b2 * nu + (1 - b2) * (g * g)
+        step = lr * (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+        return p - step - lr * weight_decay * p, mu, nu
+
+    new = {k: upd(params[k], grads[k], opt["mu"][k], opt["nu"][k])
+           for k in params}
+    params2 = {k: v[0] for k, v in new.items()}
+    mu2 = {k: v[1] for k, v in new.items()}
+    nu2 = {k: v[2] for k, v in new.items()}
+    return params2, {"mu": mu2, "nu": nu2, "count": count}
+
+
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+def opt_specs(cfg: TransformerConfig):
+    ps = param_specs(cfg)
+    return {"mu": ps, "nu": dict(ps), "count": P()}
+
+
+def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 2,
+                    lr: float = 1e-3):
+    """jit(shard_map(train step)): (params, opt, tokens, labels) ->
+    (params, opt, loss).  tokens/labels are global [B, S] int32."""
+    specs = param_specs(cfg)
+    ospecs = opt_specs(cfg)
+    total_axes = ("dp", "sp", "pp")
+
+    def local_step(params, opt, tokens, labels):
+        def loss_fn(p):
+            return _local_loss(p, tokens, labels, cfg, n_micro)
+
+        loss_local, grads = jax.value_and_grad(loss_fn)(params)
+        loss_sum = lax.psum(loss_local, total_axes)
+        count = lax.psum(jnp.float32(tokens.size), ("dp", "sp"))
+        loss = loss_sum / count
+        grads = _sync_grads(grads, specs)
+        grads = {k: g / count for k, g in grads.items()}
+        params, opt = _adam_update(params, grads, opt, lr=lr)
+        return params, opt, loss
+
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, ospecs, P("dp", "sp"), P("dp", "sp")),
+        out_specs=(specs, ospecs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def make_forward(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 1):
+    """jit(shard_map(forward)): (params, tokens[B,S]) -> logits [B,S,V]
+    (replicated over pp via psum broadcast of the last stage's output)."""
+    specs = param_specs(cfg)
+
+    def local_fwd(params, tokens):
+        Bl, Sc = tokens.shape
+        mb = Bl // n_micro
+        x = jnp.take(params["embed"].astype(cfg.dtype), tokens, axis=0)
+        x_mbs = x.reshape(n_micro, mb, Sc, cfg.d_model)
+        outs = _pipeline_apply(params, x_mbs, cfg)
+        is_last = (lax.axis_index("pp") == lax.axis_size("pp") - 1)
+        outs = jnp.where(is_last, outs, 0.0).astype(jnp.float32)
+        outs = lax.psum(outs.astype(jnp.float32), "pp").astype(cfg.dtype)
+        h = _rmsnorm(outs, params["final_ln"], cfg.norm_eps)
+        logits = jnp.einsum("nbsd,dv->nbsv", h.astype(jnp.float32),
+                            params["head"].astype(jnp.float32))
+        return logits.reshape(Bl, Sc, cfg.vocab_size)
+
+    sharded = jax.shard_map(
+        local_fwd, mesh=mesh,
+        in_specs=(specs, P("dp", "sp")),
+        out_specs=P("dp", "sp", None),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def place_params(params, mesh: Mesh, cfg: TransformerConfig):
+    specs = param_specs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}

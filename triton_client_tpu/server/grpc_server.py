@@ -55,11 +55,20 @@ def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
         parameters={k: pb_param_to_py(v) for k, v in request.parameters.items()},
     )
     raw = list(request.raw_input_contents)
-    if raw and len(raw) != len(request.inputs):
+    # raw_input_contents carries entries ONLY for non-shm inputs, in input
+    # order (reference wire semantics: grpc/_utils.py packs raw buffers in a
+    # parallel list, shm inputs contribute no entry).
+    n_raw_expected = sum(
+        1 for t in request.inputs
+        if "shared_memory_region" not in t.parameters
+    )
+    if raw and len(raw) != n_raw_expected:
         raise InferError(
-            "raw_input_contents does not match the number of inputs"
+            "raw_input_contents does not match the number of non-shared-"
+            f"memory inputs (got {len(raw)}, expected {n_raw_expected})"
         )
-    for idx, t in enumerate(request.inputs):
+    raw_idx = 0
+    for t in request.inputs:
         shape = tuple(int(s) for s in t.shape)
         params = {k: pb_param_to_py(v) for k, v in t.parameters.items()}
         tensor = InputTensor(name=t.name, datatype=t.datatype, shape=shape, parameters=params)
@@ -71,7 +80,8 @@ def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
                 offset=int(params.get("shared_memory_offset", 0)),
             )
         elif raw:
-            tensor.data = _raw_to_array(raw[idx], t.datatype, shape, t.name)
+            tensor.data = _raw_to_array(raw[raw_idx], t.datatype, shape, t.name)
+            raw_idx += 1
         elif t.HasField("contents"):
             tensor.data = _contents_to_array(t.contents, t.datatype, shape, t.name)
         else:

@@ -133,7 +133,12 @@ class ModelRegistry:
             tmp = tempfile.mkdtemp(prefix=f"tc_tpu_model_{name}_")
             for fname, b64 in files.items():
                 rel = fname[len("file:"):] if fname.startswith("file:") else fname
-                dest = os.path.join(tmp, rel)
+                dest = os.path.normpath(os.path.join(tmp, rel))
+                # request-controlled names must stay inside the temp dir
+                if not dest.startswith(tmp + os.sep):
+                    raise InferError(
+                        f"failed to load '{name}': invalid file path '{rel}'"
+                    )
                 os.makedirs(os.path.dirname(dest), exist_ok=True)
                 with open(dest, "wb") as f:
                     f.write(base64.b64decode(b64))

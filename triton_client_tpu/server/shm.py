@@ -142,24 +142,23 @@ class XlaShmRegistry:
         with self._lock:
             if name in self._regions:
                 raise InferError(f"shared memory region '{name}' already in manager")
-        region = XlaShmRegion(name=name, device_id=device_id, byte_size=byte_size)
-        uid = desc.get("uuid")
-        slot = broker().lookup(uid) if uid else None
-        if slot is not None:
-            region.slot = slot
-        elif desc.get("staging_key"):
-            try:
-                region.staging_handle = sysshm.attach_shared_memory_region(
-                    name, desc["staging_key"], byte_size
+            region = XlaShmRegion(name=name, device_id=device_id, byte_size=byte_size)
+            uid = desc.get("uuid")
+            slot = broker().lookup(uid) if uid else None
+            if slot is not None:
+                region.slot = slot
+            elif desc.get("staging_key"):
+                try:
+                    region.staging_handle = sysshm.attach_shared_memory_region(
+                        name, desc["staging_key"], byte_size
+                    )
+                except sysshm.SharedMemoryException as e:
+                    raise InferError(f"failed to map staging region for '{name}': {e}")
+            else:
+                raise InferError(
+                    f"failed to register XLA shared memory region '{name}': handle "
+                    "refers to neither an in-process slot nor a staging region"
                 )
-            except sysshm.SharedMemoryException as e:
-                raise InferError(f"failed to map staging region for '{name}': {e}")
-        else:
-            raise InferError(
-                f"failed to register XLA shared memory region '{name}': handle "
-                "refers to neither an in-process slot nor a staging region"
-            )
-        with self._lock:
             self._regions[name] = region
 
     def unregister(self, name: Optional[str]) -> None:
