@@ -48,21 +48,26 @@ def main() -> int:
     stop = threading.Event()
     start_measuring = threading.Event()
 
+    errors: list = []
+
     def worker(idx: int):
-        client = InferenceServerClient(url)
-        inputs = make_inputs()
-        local_lat = []
-        n = 0
-        while not stop.is_set():
-            t0 = time.perf_counter()
-            client.infer("simple", inputs)
-            dt = time.perf_counter() - t0
-            if start_measuring.is_set():
-                local_lat.append(dt)
-                n += 1
-        counts[idx] = n
-        latencies.append(local_lat)
-        client.close()
+        try:
+            client = InferenceServerClient(url)
+            inputs = make_inputs()
+            local_lat = []
+            n = 0
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                client.infer("simple", inputs)
+                dt = time.perf_counter() - t0
+                if start_measuring.is_set():
+                    local_lat.append(dt)
+                    n += 1
+            counts[idx] = n
+            latencies.append(local_lat)
+            client.close()
+        except Exception as e:  # surface worker failures in the output
+            errors.append(f"worker {idx}: {e}")
 
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(concurrency)]
@@ -79,12 +84,13 @@ def main() -> int:
     harness.stop()
 
     total = sum(counts)
-    lat = np.sort(np.concatenate([np.asarray(l) for l in latencies if l]))
+    chunks = [np.asarray(l) for l in latencies if l]
+    lat = np.sort(np.concatenate(chunks)) if chunks else np.empty((0,))
     infer_per_sec = total / elapsed
     p50 = float(np.percentile(lat, 50) * 1e3) if lat.size else float("nan")
     p99 = float(np.percentile(lat, 99) * 1e3) if lat.size else float("nan")
 
-    print(json.dumps({
+    out = {
         "metric": "grpc_infer_throughput_simple_c8",
         "value": round(infer_per_sec, 2),
         "unit": "infer/sec",
@@ -92,8 +98,11 @@ def main() -> int:
         "p50_ms": round(p50, 3),
         "p99_ms": round(p99, 3),
         "concurrency": concurrency,
-    }))
-    return 0
+    }
+    if errors:
+        out["errors"] = errors[:4]
+    print(json.dumps(out))
+    return 0 if total and not errors else 1
 
 
 if __name__ == "__main__":

@@ -59,6 +59,34 @@ def test_train_step_sharded_matches_single_device(moe):
             err_msg=f"param {k} diverged between 8-dev and 1-dev")
 
 
+def test_grads_sharded_match_single_device_all_axes():
+    """Raw-gradient equivalence on a mesh exercising dp AND ep (Adam is
+    invariant to per-leaf constant scaling, so the train-step test alone
+    cannot catch gradient scale errors — this can)."""
+    cfg = _cfg(n_experts=2)
+    tokens, labels = _data(cfg)
+    params = tr.init_params(jax.random.PRNGKey(3), cfg)
+
+    dev = np.asarray(jax.devices()[:8]).reshape(2, 1, 2, 1, 2)  # dp,pp,ep,sp,tp
+    mesh8 = jax.sharding.Mesh(dev, tr.MESH_AXES)
+    g8, loss8 = tr.make_grad_fn(mesh8, cfg, n_micro=2)(params, tokens, labels)
+    g1, loss1 = tr.make_grad_fn(_mesh1(cfg), cfg, n_micro=2)(params, tokens, labels)
+
+    np.testing.assert_allclose(float(loss8), float(loss1), rtol=1e-4)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(g8[k]), np.asarray(g1[k]), rtol=1e-3, atol=1e-6,
+            err_msg=f"grad {k} diverged on dp/ep mesh")
+
+    # and on the tp/sp/pp-heavy factorization
+    mesh_b = tr.make_mesh(8, cfg)
+    gb, _ = tr.make_grad_fn(mesh_b, cfg, n_micro=2)(params, tokens, labels)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(gb[k]), np.asarray(g1[k]), rtol=1e-3, atol=1e-6,
+            err_msg=f"grad {k} diverged on tp/sp/pp mesh")
+
+
 def test_forward_sharded_matches_single_device():
     cfg = _cfg(n_experts=2)
     tokens, _ = _data(cfg)

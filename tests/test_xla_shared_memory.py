@@ -1,0 +1,248 @@
+"""xla_shared_memory tests.
+
+Mirrors the reference's test strategy for the device data path
+(src/python/library/tests/test_cuda_shared_memory.py, SURVEY.md §4 tier 2):
+DLPack round-trips with a framework as the interop oracle, numpy set/get,
+serialized BYTES — plus the full cudashm-client end-to-end flow of
+simple_grpc_cudashm_client.py (SURVEY.md §3.5) against the in-process
+harness, where tensors stay device-resident (zero host copy on the infer
+path).
+"""
+
+import numpy as np
+import pytest
+
+import triton_client_tpu.utils.xla_shared_memory as xlashm
+from triton_client_tpu._xla_broker import broker
+from triton_client_tpu.utils import serialize_byte_tensor
+
+
+@pytest.fixture(autouse=True)
+def _leak_check():
+    yield
+    assert xlashm.allocated_shared_memory_regions() == []
+
+
+class TestDLPack:
+    def test_jax_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        src = jnp.arange(16, dtype=jnp.float32).reshape(4, 4)
+        h = xlashm.create_shared_memory_region("dlpack_jax", src.nbytes, 0)
+        try:
+            xlashm.set_shared_memory_region_from_dlpack(h, [src])
+            t = xlashm.as_shared_memory_tensor(h, "FP32", [4, 4])
+            back = jnp.from_dlpack(t)
+            np.testing.assert_array_equal(np.asarray(back), np.asarray(src))
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
+    def test_numpy_to_torch(self):
+        import torch
+
+        src = np.arange(12, dtype=np.int32).reshape(3, 4)
+        h = xlashm.create_shared_memory_region("dlpack_np", src.nbytes, 0)
+        try:
+            xlashm.set_shared_memory_region_from_dlpack(h, [src])
+            got = xlashm.get_contents_as_numpy(h, np.int32, [3, 4])
+            t = torch.from_numpy(np.ascontiguousarray(got))
+            np.testing.assert_array_equal(t.numpy(), src)
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
+    def test_noncontiguous_rejected(self):
+        src = np.arange(16, dtype=np.float32).reshape(4, 4).T
+        h = xlashm.create_shared_memory_region("dlpack_nc", 64, 0)
+        try:
+            with pytest.raises(xlashm.XlaSharedMemoryException):
+                xlashm.set_shared_memory_region_from_dlpack(h, [src])
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
+
+class TestNumpy:
+    def test_set_get(self):
+        src = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+        h = xlashm.create_shared_memory_region("np_region", src.nbytes, 0)
+        try:
+            xlashm.set_shared_memory_region(h, [src])
+            got = xlashm.get_contents_as_numpy(h, np.float32, [2, 8])
+            np.testing.assert_array_equal(got, src)
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
+    def test_too_small_raises(self):
+        src = np.zeros((100,), np.float64)
+        h = xlashm.create_shared_memory_region("small", 8, 0)
+        try:
+            with pytest.raises(xlashm.XlaSharedMemoryException):
+                xlashm.set_shared_memory_region(h, [src])
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
+    def test_bytes_tensor(self):
+        strings = np.array([b"hello", b"", b"tpu-shm"], dtype=np.object_)
+        payload = serialize_byte_tensor(strings)
+        h = xlashm.create_shared_memory_region("bytes_r", payload.nbytes, 0)
+        try:
+            xlashm.set_shared_memory_region(h, [strings])
+            got = xlashm.get_contents_as_numpy(h, np.object_, [3])
+            assert list(got) == [b"hello", b"", b"tpu-shm"]
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
+    def test_invalid_device(self):
+        with pytest.raises(xlashm.XlaSharedMemoryException):
+            xlashm.create_shared_memory_region("bad_dev", 64, 99)
+
+
+class TestStagingImport:
+    """Cross-process import path: the server-side registry must fall back to
+    the host staging region when the broker slot is not in its process."""
+
+    def test_registry_staging_read(self):
+        from triton_client_tpu.server.shm import XlaShmRegistry
+        from triton_client_tpu.server.types import ShmRef
+
+        src = np.arange(8, dtype=np.float32)
+        h = xlashm.create_shared_memory_region("staging_r", src.nbytes, 0)
+        try:
+            assert not broker().server_present
+            xlashm.set_shared_memory_region(h, [src])  # writes staging too
+            raw = xlashm.get_raw_handle(h)
+            # simulate another process: hide the broker slot
+            broker().drop(h._uuid)
+            reg = XlaShmRegistry()
+            reg.register("staging_r", raw, 0, src.nbytes)
+            arr = reg.read(ShmRef("staging_r", src.nbytes, 0), "FP32", (8,))
+            np.testing.assert_array_equal(np.asarray(arr), src)
+            reg.unregister("staging_r")
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
+
+class TestEndToEnd:
+    """simple_grpc_cudashm_client.py flow (SURVEY.md §3.5) over the live
+    harness: register → shm inputs → infer → shm outputs → unregister."""
+
+    @pytest.fixture()
+    def harness(self):
+        from triton_client_tpu.models import zoo
+        from triton_client_tpu.server.registry import ModelRegistry
+        from triton_client_tpu.server.testing import ServerHarness
+
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        h = ServerHarness(registry)
+        h.start()
+        yield h
+        h.stop()
+
+    @pytest.mark.parametrize("proto", ["grpc", "http"])
+    def test_cudashm_flow(self, harness, proto):
+        if proto == "grpc":
+            from triton_client_tpu.grpc import (
+                InferenceServerClient, InferInput, InferRequestedOutput)
+
+            client = InferenceServerClient(f"127.0.0.1:{harness.grpc_port}")
+        else:
+            from triton_client_tpu.http import (
+                InferenceServerClient, InferInput, InferRequestedOutput)
+
+            client = InferenceServerClient(f"127.0.0.1:{harness.http_port}")
+
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.full((1, 16), 3, dtype=np.int32)
+        nbytes = a.nbytes
+
+        handles = {}
+        try:
+            client.unregister_cuda_shared_memory()
+            for name in ("input0_data", "input1_data", "output0_data", "output1_data"):
+                handles[name] = xlashm.create_shared_memory_region(name, nbytes, 0)
+                client.register_cuda_shared_memory(
+                    name, xlashm.get_raw_handle(handles[name]), 0, nbytes)
+            xlashm.set_shared_memory_region(handles["input0_data"], [a])
+            xlashm.set_shared_memory_region(handles["input1_data"], [b])
+
+            i0 = InferInput("INPUT0", [1, 16], "INT32")
+            i0.set_shared_memory("input0_data", nbytes)
+            i1 = InferInput("INPUT1", [1, 16], "INT32")
+            i1.set_shared_memory("input1_data", nbytes)
+            o0 = InferRequestedOutput("OUTPUT0")
+            o0.set_shared_memory("output0_data", nbytes)
+            o1 = InferRequestedOutput("OUTPUT1")
+            o1.set_shared_memory("output1_data", nbytes)
+
+            result = client.infer("simple", [i0, i1], outputs=[o0, o1])
+            assert result.get_output("OUTPUT0") is not None
+
+            sum_out = xlashm.get_contents_as_numpy(
+                handles["output0_data"], np.int32, [1, 16])
+            diff_out = xlashm.get_contents_as_numpy(
+                handles["output1_data"], np.int32, [1, 16])
+            np.testing.assert_array_equal(sum_out, a + b)
+            np.testing.assert_array_equal(diff_out, a - b)
+
+            status = client.get_cuda_shared_memory_status()
+            names = _status_names(status)
+            assert "input0_data" in names
+
+            client.unregister_cuda_shared_memory()
+            status = client.get_cuda_shared_memory_status()
+            assert not _status_names(status)
+        finally:
+            for h in handles.values():
+                xlashm.destroy_shared_memory_region(h)
+            client.close()
+
+    def test_zero_copy_in_process(self, harness):
+        """Co-located topology: a jax.Array input stays device-resident —
+        the server consumes the exact buffer the client bound."""
+        import jax.numpy as jnp
+
+        from triton_client_tpu.grpc import (
+            InferenceServerClient, InferInput, InferRequestedOutput)
+
+        client = InferenceServerClient(f"127.0.0.1:{harness.grpc_port}")
+        src = jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
+        ones = jnp.ones((1, 16), jnp.int32)
+        nbytes = 16 * 4
+        h0 = xlashm.create_shared_memory_region("zc_in0", nbytes, 0)
+        h1 = xlashm.create_shared_memory_region("zc_in1", nbytes, 0)
+        try:
+            assert broker().server_present  # harness marks co-located mode
+            xlashm.set_shared_memory_region_from_dlpack(h0, [src])
+            xlashm.set_shared_memory_region_from_dlpack(h1, [ones])
+            # same PjRt buffer, not a copy
+            assert h0.array is src
+            client.register_cuda_shared_memory(
+                "zc_in0", xlashm.get_raw_handle(h0), 0, nbytes)
+            client.register_cuda_shared_memory(
+                "zc_in1", xlashm.get_raw_handle(h1), 0, nbytes)
+            i0 = InferInput("INPUT0", [1, 16], "INT32")
+            i0.set_shared_memory("zc_in0", nbytes)
+            i1 = InferInput("INPUT1", [1, 16], "INT32")
+            i1.set_shared_memory("zc_in1", nbytes)
+            result = client.infer("simple", [i0, i1])
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), np.asarray(src) + 1)
+            client.unregister_cuda_shared_memory()
+        finally:
+            xlashm.destroy_shared_memory_region(h0)
+            xlashm.destroy_shared_memory_region(h1)
+            client.close()
+
+
+def _status_names(status):
+    if isinstance(status, dict):  # http json
+        return {r["name"] for r in status.get("regions", [])} if "regions" in status \
+            else {r.get("name") for r in status.values()} if status else set()
+    if isinstance(status, list):
+        return {r["name"] for r in status}
+    # grpc pb CudaSharedMemoryStatusResponse
+    try:
+        return set(status.regions.keys())
+    except AttributeError:
+        return {r.name for r in status.regions}

@@ -423,24 +423,57 @@ def opt_specs(cfg: TransformerConfig):
     return {"mu": ps, "nu": dict(ps), "count": P()}
 
 
+def make_grad_fn(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 2):
+    """jit(shard_map): (params, tokens, labels) -> (synced mean grads, loss).
+
+    Exposed separately so tests can check raw gradients (Adam hides constant
+    per-leaf scale errors) and so external training loops can compose."""
+    specs = param_specs(cfg)
+    # tp/ep ranks each compute the *same* loss from their own param copies,
+    # and autodiff (collective transposes) already hands every copy the full
+    # tied gradient — so the psum over compute-replicated axes over-counts by
+    # the axis size.  dp/sp shard *data* and pp's loss is masked to the last
+    # stage, so those psums are true summation.  Static rescale corrects it
+    # (verified against single-device grads in test_transformer.py).
+    compute_scale = float(mesh.shape["tp"] * mesh.shape["ep"])
+
+    def local_grads(params, tokens, labels):
+        def loss_fn(p):
+            return _local_loss(p, tokens, labels, cfg, n_micro)
+
+        loss_local, grads = jax.value_and_grad(loss_fn)(params)
+        loss = lax.psum(loss_local, ("dp", "sp", "pp"))
+        count = lax.psum(jnp.float32(tokens.size), ("dp", "sp"))
+        grads = _sync_grads(grads, specs)
+        grads = {k: g / (count * compute_scale) for k, g in grads.items()}
+        return grads, loss / count
+
+    return jax.jit(jax.shard_map(
+        local_grads, mesh=mesh,
+        in_specs=(specs, P("dp", "sp"), P("dp", "sp")),
+        out_specs=(specs, P()),
+        check_vma=False,
+    ))
+
+
 def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 2,
                     lr: float = 1e-3):
     """jit(shard_map(train step)): (params, opt, tokens, labels) ->
     (params, opt, loss).  tokens/labels are global [B, S] int32."""
     specs = param_specs(cfg)
     ospecs = opt_specs(cfg)
-    total_axes = ("dp", "sp", "pp")
+    compute_scale = float(mesh.shape["tp"] * mesh.shape["ep"])
 
     def local_step(params, opt, tokens, labels):
         def loss_fn(p):
             return _local_loss(p, tokens, labels, cfg, n_micro)
 
         loss_local, grads = jax.value_and_grad(loss_fn)(params)
-        loss_sum = lax.psum(loss_local, total_axes)
+        loss_sum = lax.psum(loss_local, ("dp", "sp", "pp"))
         count = lax.psum(jnp.float32(tokens.size), ("dp", "sp"))
         loss = loss_sum / count
         grads = _sync_grads(grads, specs)
-        grads = {k: g / count for k, g in grads.items()}
+        grads = {k: g / (count * compute_scale) for k, g in grads.items()}
         params, opt = _adam_update(params, grads, opt, lr=lr)
         return params, opt, loss
 
@@ -466,7 +499,7 @@ def make_forward(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 1):
         outs = _pipeline_apply(params, x_mbs, cfg)
         is_last = (lax.axis_index("pp") == lax.axis_size("pp") - 1)
         outs = jnp.where(is_last, outs, 0.0).astype(jnp.float32)
-        outs = lax.psum(outs.astype(jnp.float32), "pp").astype(cfg.dtype)
+        outs = lax.psum(outs, "pp").astype(cfg.dtype)
         h = _rmsnorm(outs, params["final_ln"], cfg.norm_eps)
         logits = jnp.einsum("nbsd,dv->nbsv", h.astype(jnp.float32),
                             params["head"].astype(jnp.float32))

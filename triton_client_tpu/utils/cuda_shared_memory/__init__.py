@@ -1,0 +1,20 @@
+"""Drop-in alias: ``cuda_shared_memory`` → ``xla_shared_memory``.
+
+Lets reference-written cudashm clients (e.g. simple_grpc_cudashm_client.py,
+SURVEY.md §3.5) run on TPU with only their transport URL changed — the import
+keeps working, the device path is XLA/PjRt underneath (BASELINE.json north
+star: "the simple_*_cudashm_* examples gain TPU equivalents")."""
+
+from ..xla_shared_memory import *  # noqa: F401,F403
+from ..xla_shared_memory import (  # noqa: F401
+    CudaSharedMemoryException,
+    XlaSharedMemoryRegion as CudaSharedMemoryRegion,
+    allocated_shared_memory_regions,
+    as_shared_memory_tensor,
+    create_shared_memory_region,
+    destroy_shared_memory_region,
+    get_contents_as_numpy,
+    get_raw_handle,
+    set_shared_memory_region,
+    set_shared_memory_region_from_dlpack,
+)

@@ -1,0 +1,375 @@
+"""``xla_shared_memory`` — the TPU-native device-side data path.
+
+API-parity port target: reference ``tritonclient/utils/cuda_shared_memory``
+(`__init__.py:107-429`, `_utils.py:49-121`) — same function names and call
+shapes, so the reference's ``simple_*_cudashm_*`` examples run with an import
+swap (a ``cuda_shared_memory`` alias module is provided for exactly that).
+
+TPU translation of the cudaIPC design (BASELINE.json north star; SURVEY.md
+§3.5/§7 hard parts (a)):
+
+* cudaMalloc                → a **region slot** in the process-local broker
+  holding the current immutable ``jax.Array`` (PjRt buffer).  jax arrays are
+  immutable, so "writing" a region rebinds the slot.
+* cudaIpcGetMemHandle       → ``get_raw_handle``: a JSON descriptor carrying
+  the slot uuid (in-process zero-copy import) and a POSIX host-shm staging
+  key (cross-process import; PjRt has no cudaIpcOpenMemHandle equivalent, so
+  a cross-process reader pays exactly one host↔device DMA).
+* cudaMemcpyAsync + stream  → ``jax.device_put`` (async dispatch; PjRt
+  transfer engine) / DLPack zero-copy ingest for device-resident producers.
+* cudaIpc leak assertions   → ``allocated_shared_memory_regions()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid as _uuid
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..._xla_broker import broker
+from .. import np_to_triton_dtype, serialize_byte_tensor, triton_to_np_dtype
+from .. import shared_memory as _sysshm
+
+__all__ = [
+    "XlaSharedMemoryException",
+    "CudaSharedMemoryException",
+    "XlaSharedMemoryRegion",
+    "create_shared_memory_region",
+    "get_raw_handle",
+    "set_shared_memory_region",
+    "set_shared_memory_region_from_dlpack",
+    "get_contents_as_numpy",
+    "as_shared_memory_tensor",
+    "allocated_shared_memory_regions",
+    "destroy_shared_memory_region",
+]
+
+
+class XlaSharedMemoryException(Exception):
+    """Mirrors reference ``CudaSharedMemoryException`` (_utils.py:49-64)."""
+
+    def __init__(self, msg):
+        self._msg = str(msg)
+        super().__init__(self._msg)
+
+    def __str__(self):
+        return self._msg
+
+
+# drop-in alias for reference-written except clauses
+CudaSharedMemoryException = XlaSharedMemoryException
+
+_allocated: Dict[str, "XlaSharedMemoryRegion"] = {}
+_alloc_lock = threading.Lock()
+
+
+def _device(device_id: int):
+    import jax
+
+    devices = jax.devices()
+    if device_id < 0 or device_id >= len(devices):
+        raise XlaSharedMemoryException(
+            f"unable to create shared memory region on device {device_id}: "
+            f"only {len(devices)} XLA device(s) visible"
+        )
+    return devices[device_id]
+
+
+class XlaSharedMemoryRegion:
+    """Handle for one region (reference ``CudaSharedMemoryRegion``,
+    _utils.py:67-100 — RAII free in ``__del__``)."""
+
+    def __init__(self, triton_shm_name: str, byte_size: int, device_id: int):
+        self._triton_shm_name = triton_shm_name
+        self._byte_size = byte_size
+        self._device_id = device_id
+        self._uuid = _uuid.uuid4().hex
+        self._slot = broker().create(self._uuid, byte_size, device_id)
+        # Host-shm staging region so an out-of-process server can import the
+        # handle.  Created eagerly (mmap is cheap); written only when no
+        # in-process server shares the slot (see set_shared_memory_region).
+        self._staging_key = f"/xlashm_{self._uuid[:16]}"
+        self._staging = _sysshm.create_shared_memory_region(
+            self._triton_shm_name, self._staging_key, byte_size
+        )
+        self._closed = False
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def triton_shm_name(self) -> str:
+        return self._triton_shm_name
+
+    @property
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    @property
+    def device_id(self) -> int:
+        return self._device_id
+
+    @property
+    def array(self):
+        """Current device contents (jax.Array) or None."""
+        arr, _, _ = self._slot.get()
+        return arr
+
+    # -- lifecycle ---------------------------------------------------------
+    def _close(self):
+        if self._closed:
+            return
+        self._closed = True
+        broker().drop(self._uuid)
+        try:
+            _sysshm.destroy_shared_memory_region(self._staging)
+        except _sysshm.SharedMemoryException:
+            pass
+
+    def __del__(self):
+        try:
+            self._close()
+        except Exception:
+            pass
+
+
+def create_shared_memory_region(
+    triton_shm_name: str, byte_size: int, device_id: int
+) -> XlaSharedMemoryRegion:
+    """Allocate a device-backed region (reference __init__.py:107-150:
+    cudaSetDevice + cudaMalloc + cudaIpcGetMemHandle)."""
+    if byte_size <= 0:
+        raise XlaSharedMemoryException("byte_size must be positive")
+    _device(device_id)  # validate device exists before allocating
+    region = XlaSharedMemoryRegion(triton_shm_name, byte_size, device_id)
+    with _alloc_lock:
+        _allocated[region._uuid] = region
+    return region
+
+
+def get_raw_handle(xla_shm_handle: XlaSharedMemoryRegion) -> bytes:
+    """Serialized import descriptor (reference __init__.py:152-170 returns
+    base64(cudaIpcMemHandle.reserved); the transport re-encodes, so the raw
+    payload here is a JSON descriptor both registries understand)."""
+    import json
+
+    return json.dumps(
+        {
+            "uuid": xla_shm_handle._uuid,
+            "staging_key": xla_shm_handle._staging_key,
+            "byte_size": xla_shm_handle._byte_size,
+            "device_id": xla_shm_handle._device_id,
+        }
+    ).encode("utf-8")
+
+
+def _bind(handle: XlaSharedMemoryRegion, array, datatype: str, shape) -> None:
+    handle._slot.bind(array, datatype, tuple(shape))
+
+
+def _write_staging(handle: XlaSharedMemoryRegion, payloads, offset: int = 0):
+    _sysshm.set_shared_memory_region(handle._staging, payloads, offset=offset)
+
+
+def set_shared_memory_region(
+    xla_shm_handle: XlaSharedMemoryRegion,
+    input_values: Sequence[np.ndarray],
+    offset: int = 0,
+) -> None:
+    """Write numpy arrays into the region (reference __init__.py:173-239:
+    cudaMemcpyAsync per value + stream sync).
+
+    One H2D ``jax.device_put`` binds the device slot; when no in-process
+    server shares the slot, the host staging region is written too so a
+    cross-process server can import the contents."""
+    if not isinstance(input_values, (list, tuple)):
+        raise XlaSharedMemoryException("input_values must be a list of numpy arrays")
+    payloads = []
+    for v in input_values:
+        v = np.asarray(v)
+        if v.dtype == np.object_ or v.dtype.kind in ("S", "U"):
+            payloads.append(serialize_byte_tensor(v))
+        else:
+            payloads.append(np.ascontiguousarray(v))
+    total = sum(p.nbytes for p in payloads)
+    if offset + total > xla_shm_handle._byte_size:
+        raise XlaSharedMemoryException(
+            "unable to set shared memory region: byte_size "
+            f"{xla_shm_handle._byte_size} is too small for {offset + total} bytes"
+        )
+    import jax
+
+    dev = _device(xla_shm_handle._device_id)
+    if len(payloads) == 1 and offset == 0:
+        host = payloads[0]
+        datatype = np_to_triton_dtype(host.dtype) or "UINT8"
+        arr = jax.device_put(host, dev)
+        _bind(xla_shm_handle, arr, datatype, host.shape)
+    else:
+        # multiple values / offset: region becomes a flat byte buffer
+        flat = np.concatenate(
+            [p.reshape(-1).view(np.uint8) for p in payloads]
+        ) if payloads else np.zeros((0,), np.uint8)
+        cur, _, _ = xla_shm_handle._slot.get()
+        size = xla_shm_handle._byte_size
+        buf = np.zeros((size,), np.uint8)
+        if cur is not None and cur.dtype == np.uint8 and cur.size == size:
+            buf = np.asarray(cur).copy()
+        buf[offset : offset + flat.size] = flat
+        arr = jax.device_put(buf, dev)
+        _bind(xla_shm_handle, arr, "UINT8", (size,))
+    if not broker().server_present:
+        _write_staging(xla_shm_handle, payloads, offset=offset)
+
+
+def set_shared_memory_region_from_dlpack(
+    xla_shm_handle: XlaSharedMemoryRegion, input_values: Sequence
+) -> None:
+    """Zero-copy ingest of DLPack-capable tensors (reference
+    __init__.py:328-388 — device-pointer based, the model for this module).
+
+    jax arrays bind directly (no copy); other producers (torch CPU, numpy)
+    come in through ``jax.dlpack``/``device_put`` with one transfer."""
+    if not isinstance(input_values, (list, tuple)):
+        input_values = [input_values]
+    import jax
+
+    dev = _device(xla_shm_handle._device_id)
+    arrays = []
+    total = 0
+    for v in input_values:
+        if isinstance(v, jax.Array):
+            arr = v
+        elif hasattr(v, "__dlpack__"):
+            try:
+                arr = jax.dlpack.from_dlpack(v)
+            except Exception:
+                arr = jax.device_put(np.from_dlpack(v), dev)
+        else:
+            raise XlaSharedMemoryException(
+                f"tensor of type {type(v).__name__} does not support DLPack"
+            )
+        if not _contiguous_ok(v):
+            raise XlaSharedMemoryException(
+                "the tensor must be contiguous in memory"
+            )
+        arrays.append(arr)
+        total += arr.size * arr.dtype.itemsize
+    if total > xla_shm_handle._byte_size:
+        raise XlaSharedMemoryException(
+            "unable to set shared memory region: byte_size "
+            f"{xla_shm_handle._byte_size} is too small for {total} bytes"
+        )
+    if len(arrays) == 1:
+        arr = arrays[0]
+        datatype = np_to_triton_dtype(np.dtype(str(arr.dtype))) or "UINT8"
+        _bind(xla_shm_handle, arr, datatype, arr.shape)
+        if not broker().server_present:
+            _write_staging(xla_shm_handle, [np.ascontiguousarray(np.asarray(arr))])
+    else:
+        hosts = [np.ascontiguousarray(np.asarray(a)) for a in arrays]
+        set_shared_memory_region(xla_shm_handle, hosts)
+
+
+def _contiguous_ok(v) -> bool:
+    if isinstance(v, np.ndarray):
+        return v.flags["C_CONTIGUOUS"]
+    if hasattr(v, "is_contiguous"):
+        try:
+            return bool(v.is_contiguous())
+        except Exception:
+            return True
+    return True
+
+
+def get_contents_as_numpy(
+    xla_shm_handle: XlaSharedMemoryRegion,
+    datatype,
+    shape: Sequence[int],
+    offset: int = 0,
+) -> np.ndarray:
+    """Device → host read-back (reference __init__.py:242-325: D2H
+    cudaMemcpy then numpy reinterpret; BYTES deserialized host-side)."""
+    arr, bound_dt, _ = xla_shm_handle._slot.get()
+    if arr is None:
+        # region never written on-device (e.g. server in another process
+        # wrote the staging region): fall back to host staging contents
+        return _sysshm.get_contents_as_numpy(
+            xla_shm_handle._staging, datatype, list(shape), offset=offset
+        )
+    host = np.asarray(arr)  # single D2H transfer
+    flat = host.reshape(-1).view(np.uint8)
+    if offset:
+        flat = flat[offset:]
+    dt = np.dtype(datatype)
+    if dt == np.object_:
+        from .. import deserialize_bytes_tensor
+
+        out = deserialize_bytes_tensor(flat.tobytes())
+        return out.reshape(tuple(shape))
+    count = int(np.prod(shape)) if len(shape) else 1
+    nbytes = count * dt.itemsize
+    if nbytes > flat.size:
+        raise XlaSharedMemoryException(
+            f"unable to read {nbytes} bytes at offset {offset} from region "
+            f"'{xla_shm_handle._triton_shm_name}'"
+        )
+    return flat[:nbytes].view(dt).reshape(tuple(shape))
+
+
+def as_shared_memory_tensor(
+    xla_shm_handle: XlaSharedMemoryRegion, datatype: str, shape: Sequence[int]
+):
+    """DLPack-view export (reference __init__.py:391-399).
+
+    For a device-bound region the live ``jax.Array`` is itself the DLPack
+    producer — frameworks consume TPU HBM with no host hop."""
+    arr, _, _ = xla_shm_handle._slot.get()
+    if arr is None:
+        raise XlaSharedMemoryException(
+            f"shared memory region '{xla_shm_handle._triton_shm_name}' has no "
+            "contents to export"
+        )
+    dt = triton_to_np_dtype(datatype)
+    if dt is None:
+        raise XlaSharedMemoryException(f"unsupported datatype {datatype}")
+    import jax.numpy as jnp
+
+    host_dt = jnp.dtype(dt) if dt is not np.object_ else None
+    if host_dt is not None and (
+        arr.dtype != host_dt or tuple(arr.shape) != tuple(shape)
+    ):
+        flat = arr.reshape(-1)
+        if arr.dtype != host_dt:
+            import jax.lax as lax
+
+            if arr.dtype == jnp.uint8:
+                itemsize = np.dtype(dt).itemsize
+                flat = flat[: int(np.prod(shape)) * itemsize]
+                flat = (
+                    lax.bitcast_convert_type(flat.reshape(-1, itemsize), host_dt)
+                    if itemsize > 1
+                    else lax.bitcast_convert_type(flat, host_dt)
+                )
+            else:
+                raise XlaSharedMemoryException(
+                    f"region holds {arr.dtype}, cannot view as {datatype}"
+                )
+        arr = flat.reshape(tuple(shape))
+    return arr  # jax.Array implements __dlpack__ / __dlpack_device__
+
+
+def allocated_shared_memory_regions() -> List[str]:
+    """Names of live regions (reference __init__.py:402-411 — the leak
+    assertion hook used by the cudashm examples)."""
+    with _alloc_lock:
+        return [r._triton_shm_name for r in _allocated.values()]
+
+
+def destroy_shared_memory_region(xla_shm_handle: XlaSharedMemoryRegion) -> None:
+    """Free the region (reference __init__.py:414-429; cudaFree happens in
+    the handle's __del__ there — here the slot drop + staging unlink run
+    eagerly)."""
+    with _alloc_lock:
+        _allocated.pop(xla_shm_handle._uuid, None)
+    xla_shm_handle._close()
