@@ -32,9 +32,8 @@ def main() -> int:
 
     url = f"127.0.0.1:{harness.grpc_port}"
     concurrency = 8
-    warmup_s, measure_s = 2.0, 5.0
 
-    def make_inputs():
+    def simple_inputs():
         a = np.arange(16, dtype=np.int32).reshape(1, 16)
         b = np.ones((1, 16), dtype=np.int32)
         i0 = InferInput("INPUT0", [1, 16], "INT32")
@@ -43,66 +42,83 @@ def main() -> int:
         i1.set_data_from_numpy(b)
         return [i0, i1]
 
-    latencies: list = []
-    counts = [0] * concurrency
-    stop = threading.Event()
-    start_measuring = threading.Event()
+    def dense_inputs():
+        x = np.random.default_rng(0).normal(size=(1, 512)).astype(np.float32)
+        i = InferInput("INPUT", [1, 512], "FP32")
+        i.set_data_from_numpy(x)
+        return [i]
 
-    errors: list = []
+    def sweep(model_name, inputs_fn, warmup_s=2.0, measure_s=5.0):
+        """perf_analyzer-style fixed-concurrency closed-loop sweep."""
+        latencies: list = []
+        counts = [0] * concurrency
+        errors: list = []
+        stop = threading.Event()
+        start_measuring = threading.Event()
 
-    def worker(idx: int):
-        try:
-            client = InferenceServerClient(url)
-            inputs = make_inputs()
-            local_lat = []
-            n = 0
-            while not stop.is_set():
-                t0 = time.perf_counter()
-                client.infer("simple", inputs)
-                dt = time.perf_counter() - t0
-                if start_measuring.is_set():
-                    local_lat.append(dt)
-                    n += 1
-            counts[idx] = n
-            latencies.append(local_lat)
-            client.close()
-        except Exception as e:  # surface worker failures in the output
-            errors.append(f"worker {idx}: {e}")
+        def worker(idx: int):
+            try:
+                client = InferenceServerClient(url)
+                inputs = inputs_fn()
+                local_lat = []
+                n = 0
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    client.infer(model_name, inputs)
+                    dt = time.perf_counter() - t0
+                    if start_measuring.is_set():
+                        local_lat.append(dt)
+                        n += 1
+                counts[idx] = n
+                latencies.append(local_lat)
+                client.close()
+            except Exception as e:  # surface worker failures in the output
+                errors.append(f"worker {idx}: {e}")
 
-    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
-               for i in range(concurrency)]
-    for t in threads:
-        t.start()
-    time.sleep(warmup_s)
-    start_measuring.set()
-    t0 = time.perf_counter()
-    time.sleep(measure_s)
-    stop.set()
-    elapsed = time.perf_counter() - t0
-    for t in threads:
-        t.join(timeout=10)
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(concurrency)]
+        for t in threads:
+            t.start()
+        time.sleep(warmup_s)
+        start_measuring.set()
+        t0 = time.perf_counter()
+        time.sleep(measure_s)
+        stop.set()
+        elapsed = time.perf_counter() - t0
+        for t in threads:
+            t.join(timeout=10)
+        total = sum(counts)
+        chunks = [np.asarray(l) for l in latencies if l]
+        lat = np.sort(np.concatenate(chunks)) if chunks else np.empty((0,))
+        return {
+            "infer_per_sec": round(total / elapsed, 2),
+            "p50_ms": round(float(np.percentile(lat, 50) * 1e3), 3) if lat.size else None,
+            "p99_ms": round(float(np.percentile(lat, 99) * 1e3), 3) if lat.size else None,
+            "errors": errors,
+            "total": total,
+        }
+
+    simple_res = sweep("simple", simple_inputs)
+    dense_res = sweep("dense_tpu", dense_inputs, warmup_s=4.0)
     harness.stop()
 
-    total = sum(counts)
-    chunks = [np.asarray(l) for l in latencies if l]
-    lat = np.sort(np.concatenate(chunks)) if chunks else np.empty((0,))
-    infer_per_sec = total / elapsed
-    p50 = float(np.percentile(lat, 50) * 1e3) if lat.size else float("nan")
-    p99 = float(np.percentile(lat, 99) * 1e3) if lat.size else float("nan")
-
+    errors = simple_res["errors"] + dense_res["errors"]
     out = {
         "metric": "grpc_infer_throughput_simple_c8",
-        "value": round(infer_per_sec, 2),
+        "value": simple_res["infer_per_sec"],
         "unit": "infer/sec",
         "vs_baseline": 1.0,
-        "p50_ms": round(p50, 3),
-        "p99_ms": round(p99, 3),
+        "p50_ms": simple_res["p50_ms"],
+        "p99_ms": simple_res["p99_ms"],
+        "tpu_batched_infer_per_sec": dense_res["infer_per_sec"],
+        "tpu_batched_p50_ms": dense_res["p50_ms"],
+        "tpu_batched_p99_ms": dense_res["p99_ms"],
         "concurrency": concurrency,
     }
     if errors:
         out["errors"] = errors[:4]
     print(json.dumps(out))
-    return 0 if total and not errors else 1
+    return 0 if simple_res["total"] and dense_res["total"] and not errors else 1
 
 
 if __name__ == "__main__":

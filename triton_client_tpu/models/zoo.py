@@ -37,6 +37,10 @@ def make_simple() -> JaxModel:
         "simple",
         inputs=[("INPUT0", "INT32", [1, 16]), ("INPUT1", "INT32", [1, 16])],
         outputs=[("OUTPUT0", "INT32", [1, 16]), ("OUTPUT1", "INT32", [1, 16])],
+        # the reference `simple` is a CPU ONNX model; host placement keeps
+        # the protocol path off the per-request host<->device transfer.
+        # Committed device inputs (xla shm) still run on the accelerator.
+        instance_kind="KIND_CPU",
     )
 
     def fn(INPUT0, INPUT1):
@@ -65,6 +69,7 @@ def make_custom_identity_int32() -> JaxModel:
         inputs=[("INPUT0", "INT32", [-1])],
         outputs=[("OUTPUT0", "INT32", [-1])],
         max_batch_size=8,
+        instance_kind="KIND_CPU",
     )
 
     def fn(INPUT0):
@@ -79,6 +84,7 @@ def make_identity_fp32() -> JaxModel:
         inputs=[("INPUT0", "FP32", [-1])],
         outputs=[("OUTPUT0", "FP32", [-1])],
         max_batch_size=64,
+        instance_kind="KIND_CPU",
     )
 
     def fn(INPUT0):
@@ -93,6 +99,7 @@ def make_identity_bf16() -> JaxModel:
         inputs=[("INPUT0", "BF16", [-1])],
         outputs=[("OUTPUT0", "BF16", [-1])],
         max_batch_size=64,
+        instance_kind="KIND_CPU",
     )
 
     def fn(INPUT0):
@@ -211,6 +218,35 @@ def make_square_int32() -> PyModel:
     return PyModel(cfg, fn=None, decoupled_fn=gen)
 
 
+def make_dense_tpu() -> JaxModel:
+    """TPU-resident batched MLP for device-path benchmarking: bf16 matmuls
+    (MXU-shaped), dynamic batching so concurrent requests coalesce into one
+    device execute (BASELINE config #4 dynamic-batching contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    D = 512
+    cfg = make_config(
+        "dense_tpu",
+        inputs=[("INPUT", "FP32", [D])],
+        outputs=[("OUTPUT", "FP32", [D])],
+        max_batch_size=64,
+        preferred_batch_sizes=[8, 16, 32, 64],
+        max_queue_delay_us=2000,
+        instance_kind="KIND_TPU",
+    )
+    key = jax.random.PRNGKey(0)
+    w1 = jax.random.normal(key, (D, 2 * D), jnp.bfloat16) * 0.05
+    w2 = jax.random.normal(key, (2 * D, D), jnp.bfloat16) * 0.05
+
+    def fn(INPUT):
+        h = jnp.dot(INPUT.astype(jnp.bfloat16), w1)
+        h = jax.nn.relu(h)
+        return {"OUTPUT": jnp.dot(h, w2).astype(jnp.float32)}
+
+    return JaxModel(cfg, fn)
+
+
 def register_all(registry: ModelRegistry) -> None:
     registry.register_model(make_simple())
     registry.register_model(make_simple_identity())
@@ -221,3 +257,4 @@ def register_all(registry: ModelRegistry) -> None:
     registry.register_model(DynaSequenceModel())
     registry.register_model(make_repeat_int32())
     registry.register_model(make_square_int32())
+    registry.register_model(make_dense_tpu())

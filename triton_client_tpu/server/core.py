@@ -69,24 +69,33 @@ class _DynamicBatcher:
         return await fut
 
     async def _run(self) -> None:
-        while True:
-            first = await self._queue.get()
-            pending = [first]
-            total = _batch_count(first[0])
-            deadline = time.monotonic() + self._max_delay_s
-            while total < self._max_bs:
-                if self._buckets and total >= self._buckets[-1]:
-                    break
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    item = await asyncio.wait_for(self._queue.get(), timeout)
-                except asyncio.TimeoutError:
-                    break
-                pending.append(item)
-                total += _batch_count(item[0])
-            await self._execute_batch(pending)
+        pending: list = []
+        try:
+            while True:
+                first = await self._queue.get()
+                pending = [first]
+                total = _batch_count(first[0])
+                deadline = time.monotonic() + self._max_delay_s
+                while total < self._max_bs:
+                    if self._buckets and total >= self._buckets[-1]:
+                        break
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0:
+                        break
+                    try:
+                        item = await asyncio.wait_for(self._queue.get(), timeout)
+                    except asyncio.TimeoutError:
+                        break
+                    pending.append(item)
+                    total += _batch_count(item[0])
+                await self._execute_batch(pending)
+                pending = []
+        except asyncio.CancelledError:
+            # shutdown mid-batch: fail whatever we were holding
+            for _inputs, _params, fut, _ts in pending:
+                if not fut.done():
+                    fut.set_exception(InferError("server is shutting down", 503))
+            raise
 
     async def _execute_batch(self, pending) -> None:
         counts = [_batch_count(p[0]) for p in pending]
@@ -265,6 +274,23 @@ class InferenceCore:
             and not any(i.shm is not None for i in request.inputs)
             and not any(o.shm is not None for o in request.outputs)
         )
+
+    async def shutdown(self) -> None:
+        """Cancel background batcher tasks and fail any queued requests so
+        no handler is left awaiting a forever-pending future."""
+        for b in self._batchers.values():
+            if b._task is not None and not b._task.done():
+                b._task.cancel()
+                try:
+                    await b._task
+                except (asyncio.CancelledError, Exception):
+                    pass
+            # drain requests that never made it into a batch
+            while not b._queue.empty():
+                _inputs, _params, fut, _ts = b._queue.get_nowait()
+                if not fut.done():
+                    fut.set_exception(InferError("server is shutting down", 503))
+        self._batchers.clear()
 
     def _batcher(self, model: Model) -> _DynamicBatcher:
         b = self._batchers.get(model.name)

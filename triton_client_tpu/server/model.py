@@ -66,6 +66,7 @@ def make_config(
     max_queue_delay_us: int = 0,
     sequence_batching: bool = False,
     labels: Optional[Dict[str, List[str]]] = None,
+    instance_kind: Optional[str] = None,
 ) -> pb.ModelConfig:
     """Convenience builder for a ModelConfig proto.
 
@@ -88,7 +89,28 @@ def make_config(
         cfg.dynamic_batching.max_queue_delay_microseconds = max_queue_delay_us
     if sequence_batching:
         cfg.sequence_batching.max_sequence_idle_microseconds = 60_000_000
+    if instance_kind:
+        grp = cfg.instance_group.add()
+        grp.name = name
+        grp.kind = pb.ModelInstanceGroup.Kind.Value(instance_kind)
+        grp.count = 1
     return cfg
+
+
+def resolve_instance_device(config: pb.ModelConfig):
+    """Device placement from ``instance_group`` (Triton instance_group
+    semantics: KIND_CPU pins host; KIND_AUTO/KIND_MODEL/KIND_TPU prefer the
+    accelerator).  Small protocol-fixture models run KIND_CPU so the serving
+    path isn't bottlenecked by per-request host<->device transfers."""
+    import jax
+
+    kind = None
+    for grp in config.instance_group:
+        kind = pb.ModelInstanceGroup.Kind.Name(grp.kind)
+        break
+    if kind == "KIND_CPU":
+        return jax.devices("cpu")[0]
+    return jax.devices()[0]
 
 
 @dataclass
@@ -226,11 +248,17 @@ class JaxModel(Model):
         self._host_pre = host_pre
         self._host_post = host_post
         self._output_labels = output_labels or {}
+        self._device = None
 
     def execute(self, inputs: Dict[str, Any], parameters: Dict[str, Any]) -> Dict[str, Any]:
+        import jax
+
+        if self._device is None:
+            self._device = resolve_instance_device(self.config)
         if self._host_pre is not None:
             inputs = self._host_pre(inputs, parameters)
-        outputs = self._fn(**inputs)
+        with jax.default_device(self._device):
+            outputs = self._fn(**inputs)
         if self._host_post is not None:
             outputs = self._host_post(outputs, parameters)
         return outputs

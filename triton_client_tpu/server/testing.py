@@ -83,6 +83,7 @@ class ServerHarness:
         await self._stop_event.wait()
         await grpc_server.stop(grace=1.0)
         await runner.cleanup()
+        await self.core.shutdown()
 
     def stop(self) -> None:
         if self._loop is not None and self._stop_event is not None:
