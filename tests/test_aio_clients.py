@@ -1,0 +1,200 @@
+"""aio client tests: mirror the sync-client coverage over asyncio transports
+(reference aio examples: simple_http_aio_infer_client.py,
+simple_grpc_aio_infer_client.py, simple_grpc_aio_sequence_stream_infer
+— SURVEY.md §2.7)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server.registry import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+
+
+@pytest.fixture(scope="module")
+def harness():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    h = ServerHarness(registry)
+    h.start()
+    yield h
+    h.stop()
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _simple_inputs(mod):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    i0 = mod.InferInput("INPUT0", [1, 16], "INT32")
+    i0.set_data_from_numpy(a)
+    i1 = mod.InferInput("INPUT1", [1, 16], "INT32")
+    i1.set_data_from_numpy(b)
+    return a, b, [i0, i1]
+
+
+class TestHttpAio:
+    def test_health_metadata_infer(self, harness):
+        import triton_client_tpu.http as http_mod
+        from triton_client_tpu.http.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(f"127.0.0.1:{harness.http_port}") as c:
+                assert await c.is_server_live()
+                assert await c.is_server_ready()
+                assert await c.is_model_ready("simple")
+                meta = await c.get_server_metadata()
+                assert meta["name"]
+                md = await c.get_model_metadata("simple")
+                assert md["name"] == "simple"
+                cfg = await c.get_model_config("simple")
+                assert cfg["name"] == "simple"
+                idx = await c.get_model_repository_index()
+                assert any(m["name"] == "simple" for m in idx)
+                stats = await c.get_inference_statistics("simple")
+                assert "model_stats" in stats
+
+                a, b, inputs = _simple_inputs(http_mod)
+                result = await c.infer("simple", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+
+        _run(main())
+
+    def test_compression(self, harness):
+        import triton_client_tpu.http as http_mod
+        from triton_client_tpu.http.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(f"127.0.0.1:{harness.http_port}") as c:
+                a, b, inputs = _simple_inputs(http_mod)
+                result = await c.infer(
+                    "simple", inputs,
+                    request_compression_algorithm="gzip",
+                    response_compression_algorithm="gzip",
+                )
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+
+        _run(main())
+
+    def test_error_surface(self, harness):
+        from triton_client_tpu.http.aio import InferenceServerClient
+        from triton_client_tpu.utils import InferenceServerException
+
+        async def main():
+            async with InferenceServerClient(f"127.0.0.1:{harness.http_port}") as c:
+                with pytest.raises(InferenceServerException):
+                    await c.get_model_metadata("nope")
+
+        _run(main())
+
+
+class TestGrpcAio:
+    def test_health_metadata_infer(self, harness):
+        import triton_client_tpu.grpc as grpc_mod
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(f"127.0.0.1:{harness.grpc_port}") as c:
+                assert await c.is_server_live()
+                assert await c.is_server_ready()
+                assert await c.is_model_ready("simple")
+                meta = await c.get_server_metadata()
+                assert meta.name
+                md = await c.get_model_metadata("simple", as_json=True)
+                assert md["name"] == "simple"
+
+                a, b, inputs = _simple_inputs(grpc_mod)
+                result = await c.infer("simple", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+
+        _run(main())
+
+    def test_stream_infer_sequences(self, harness):
+        """Two interleaved sequences over one stream (the aio analog of
+        simple_grpc_aio_sequence_stream_infer_client.py)."""
+        import triton_client_tpu.grpc as grpc_mod
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+
+        values = [11, 7, 5]
+
+        async def main():
+            async with InferenceServerClient(f"127.0.0.1:{harness.grpc_port}") as c:
+                async def requests():
+                    for seq_id in (1001, 1002):
+                        for i, v in enumerate(values):
+                            arr = np.array([v if seq_id == 1001 else -v],
+                                           dtype=np.int32)
+                            inp = grpc_mod.InferInput("INPUT", [1], "INT32")
+                            inp.set_data_from_numpy(arr)
+                            yield {
+                                "model_name": "simple_sequence",
+                                "inputs": [inp],
+                                "sequence_id": seq_id,
+                                "sequence_start": i == 0,
+                                "sequence_end": i == len(values) - 1,
+                            }
+
+                results = []
+                it = c.stream_infer(requests())
+                async for result, error in it:
+                    assert error is None, error
+                    results.append(int(result.as_numpy("OUTPUT")[0]))
+                # running accumulations: 11, 18, 23 then -11, -18, -23
+                acc = np.cumsum(values)
+                assert results == list(acc) + list(-acc)
+
+        _run(main())
+
+    def test_stream_infer_decoupled(self, harness):
+        """Decoupled repeat model over the aio stream."""
+        import triton_client_tpu.grpc as grpc_mod
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(f"127.0.0.1:{harness.grpc_port}") as c:
+                async def requests():
+                    vals = np.array([4, 2, 0, 1], dtype=np.int32)
+                    delays = np.zeros(4, dtype=np.uint32)
+                    wait = np.array([0], dtype=np.uint32)
+                    i_in = grpc_mod.InferInput("IN", [4], "INT32")
+                    i_in.set_data_from_numpy(vals)
+                    i_d = grpc_mod.InferInput("DELAY", [4], "UINT32")
+                    i_d.set_data_from_numpy(delays)
+                    i_w = grpc_mod.InferInput("WAIT", [1], "UINT32")
+                    i_w.set_data_from_numpy(wait)
+                    yield {
+                        "model_name": "repeat_int32",
+                        "inputs": [i_in, i_d, i_w],
+                        "enable_empty_final_response": True,
+                    }
+
+                outs = []
+                finals = 0
+                async for result, error in c.stream_infer(requests()):
+                    assert error is None, error
+                    params = result.get_response().parameters
+                    if params["triton_final_response"].bool_param:
+                        finals += 1
+                        break
+                    outs.append(int(result.as_numpy("OUT")[0]))
+                assert outs == [4, 2, 0, 1]
+                assert finals == 1
+
+        _run(main())
+
+    def test_error_surface(self, harness):
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+        from triton_client_tpu.utils import InferenceServerException
+
+        async def main():
+            async with InferenceServerClient(f"127.0.0.1:{harness.grpc_port}") as c:
+                with pytest.raises(InferenceServerException):
+                    await c.get_model_metadata("nope")
+
+        _run(main())

@@ -1,0 +1,432 @@
+"""asyncio gRPC ``InferenceServerClient``.
+
+Parity target: reference ``tritonclient/grpc/aio/__init__.py`` (810 LoC) —
+the sync client's full method surface as ``async def`` over a
+``grpc.aio`` channel, plus ``stream_infer(inputs_iterator)`` converting an
+async iterator of request-kwarg dicts into the bidi stream and returning a
+cancellable response iterator yielding ``(InferResult, error)`` tuples
+(reference :688-810).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import grpc
+
+from ..._client import InferenceServerClientBase
+from ..._request import Request
+from ...protocol import inference_pb2 as pb
+from ...protocol.service import GRPCInferenceServiceStub
+from ...utils import raise_error
+from .._client import KeepAliveOptions, _channel_options, _maybe_json
+from .._infer_result import InferResult
+from .._utils import (
+    get_error_grpc,
+    get_grpc_compression,
+    get_inference_request,
+    raise_error_grpc,
+)
+
+__all__ = ["InferenceServerClient", "KeepAliveOptions"]
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """v2 protocol over grpc.aio (reference aio client :92)."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional[grpc.ChannelCredentials] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args=None,
+    ):
+        super().__init__()
+        self._verbose = verbose
+        options = _channel_options(keepalive_options, channel_args)
+        if creds is not None:
+            self._channel = grpc.aio.secure_channel(url, creds, options=options)
+        elif ssl:
+            def _read(path):
+                if path is None:
+                    return None
+                with open(path, "rb") as f:
+                    return f.read()
+
+            credentials = grpc.ssl_channel_credentials(
+                root_certificates=_read(root_certificates),
+                private_key=_read(private_key),
+                certificate_chain=_read(certificate_chain),
+            )
+            self._channel = grpc.aio.secure_channel(url, credentials, options=options)
+        else:
+            self._channel = grpc.aio.insecure_channel(url, options=options)
+        self._client_stub = GRPCInferenceServiceStub(self._channel)
+
+    # -- lifecycle ---------------------------------------------------------
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    def _get_metadata(self, headers: Optional[dict]) -> tuple:
+        request = Request(dict(headers) if headers else {})
+        self._call_plugin(request)
+        return tuple(request.headers.items())
+
+    # -- health / metadata -------------------------------------------------
+    async def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = await self._client_stub.ServerLive(
+                pb.ServerLiveRequest(), metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return response.live
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = await self._client_stub.ServerReady(
+                pb.ServerReadyRequest(), metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return response.ready
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def is_model_ready(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ) -> bool:
+        try:
+            response = await self._client_stub.ModelReady(
+                pb.ModelReadyRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return response.ready
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.ServerMetadata(
+                pb.ServerMetadataRequest(), metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def get_model_metadata(
+        self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = await self._client_stub.ModelMetadata(
+                pb.ModelMetadataRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def get_model_config(
+        self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = await self._client_stub.ModelConfig(
+                pb.ModelConfigRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    # -- repository --------------------------------------------------------
+    async def get_model_repository_index(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.RepositoryIndex(
+                pb.RepositoryIndexRequest(), metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def load_model(
+        self, model_name, headers=None, config: Optional[str] = None,
+        files: Optional[Dict[str, bytes]] = None, client_timeout=None,
+    ):
+        request = pb.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = config
+        if files:
+            for path, content in files.items():
+                request.parameters[path].bytes_param = content
+        try:
+            await self._client_stub.RepositoryModelLoad(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def unload_model(
+        self, model_name, headers=None, unload_dependents=False, client_timeout=None
+    ):
+        request = pb.RepositoryModelUnloadRequest(model_name=model_name)
+        request.parameters["unload_dependents"].bool_param = unload_dependents
+        try:
+            await self._client_stub.RepositoryModelUnload(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    # -- statistics / trace / logging --------------------------------------
+    async def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = await self._client_stub.ModelStatistics(
+                pb.ModelStatisticsRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def update_trace_settings(
+        self, model_name=None, settings=None, headers=None, as_json=False, client_timeout=None
+    ):
+        request = pb.TraceSettingRequest(model_name=model_name or "")
+        for key, value in (settings or {}).items():
+            if value is not None:
+                vals = value if isinstance(value, list) else [str(value)]
+                request.settings[key].value.extend(vals)
+            else:
+                request.settings[key].SetInParent()
+        try:
+            response = await self._client_stub.TraceSetting(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def get_trace_settings(
+        self, model_name=None, headers=None, as_json=False, client_timeout=None
+    ):
+        return await self.update_trace_settings(
+            model_name, None, headers, as_json, client_timeout
+        )
+
+    async def update_log_settings(self, settings, headers=None, as_json=False, client_timeout=None):
+        request = pb.LogSettingsRequest()
+        for key, value in settings.items():
+            if isinstance(value, bool):
+                request.settings[key].bool_param = value
+            elif isinstance(value, int):
+                request.settings[key].uint32_param = value
+            else:
+                request.settings[key].string_param = str(value)
+        try:
+            response = await self._client_stub.LogSettings(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def get_log_settings(self, headers=None, as_json=False, client_timeout=None):
+        return await self.update_log_settings({}, headers, as_json, client_timeout)
+
+    # -- shared memory -----------------------------------------------------
+    async def get_system_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = await self._client_stub.SystemSharedMemoryStatus(
+                pb.SystemSharedMemoryStatusRequest(name=region_name),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, client_timeout=None
+    ):
+        try:
+            await self._client_stub.SystemSharedMemoryRegister(
+                pb.SystemSharedMemoryRegisterRequest(
+                    name=name, key=key, offset=offset, byte_size=byte_size
+                ),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def unregister_system_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            await self._client_stub.SystemSharedMemoryUnregister(
+                pb.SystemSharedMemoryUnregisterRequest(name=name),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = await self._client_stub.CudaSharedMemoryStatus(
+                pb.CudaSharedMemoryStatusRequest(name=region_name),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    async def register_cuda_shared_memory(
+        self, name, raw_handle: bytes, device_id: int, byte_size: int,
+        headers=None, client_timeout=None,
+    ):
+        try:
+            await self._client_stub.CudaSharedMemoryRegister(
+                pb.CudaSharedMemoryRegisterRequest(
+                    name=name, raw_handle=raw_handle, device_id=device_id,
+                    byte_size=byte_size,
+                ),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    register_xla_shared_memory = register_cuda_shared_memory
+    get_xla_shared_memory_status = get_cuda_shared_memory_status
+
+    async def unregister_cuda_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            await self._client_stub.CudaSharedMemoryUnregister(
+                pb.CudaSharedMemoryUnregisterRequest(name=name),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    unregister_xla_shared_memory = unregister_cuda_shared_memory
+
+    # -- inference ---------------------------------------------------------
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+    ) -> InferResult:
+        """Async inference (reference aio :634)."""
+        request = get_inference_request(
+            model_name, inputs, model_version, request_id, outputs,
+            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+        )
+        try:
+            response = await self._client_stub.ModelInfer(
+                request,
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+                compression=get_grpc_compression(compression_algorithm),
+            )
+            return InferResult(response)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def stream_infer(
+        self,
+        inputs_iterator,
+        stream_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+    ):
+        """Bidi streaming: consume an async iterator of request-kwarg dicts,
+        return a cancellable async iterator of ``(InferResult, error)``
+        (reference aio :688-810)."""
+        metadata = self._get_metadata(headers)
+
+        async def _requests():
+            async for kwargs in inputs_iterator:
+                if not isinstance(kwargs, dict):
+                    raise_error("inputs_iterator is not yielding a dict")
+                if "model_name" not in kwargs or "inputs" not in kwargs:
+                    raise_error(
+                        "model_name and/or inputs is missing from "
+                        "inputs_iterator's yielded dict"
+                    )
+                enable_empty_final = kwargs.pop("enable_empty_final_response", False)
+                request = get_inference_request(
+                    kwargs["model_name"],
+                    kwargs["inputs"],
+                    kwargs.get("model_version", ""),
+                    kwargs.get("request_id", ""),
+                    kwargs.get("outputs"),
+                    kwargs.get("sequence_id", 0),
+                    kwargs.get("sequence_start", False),
+                    kwargs.get("sequence_end", False),
+                    kwargs.get("priority", 0),
+                    kwargs.get("timeout"),
+                    kwargs.get("parameters"),
+                )
+                if enable_empty_final:
+                    request.parameters[
+                        "triton_enable_empty_final_response"
+                    ].bool_param = True
+                yield request
+
+        call = self._client_stub.ModelStreamInfer(
+            _requests(),
+            metadata=metadata,
+            timeout=stream_timeout,
+            compression=get_grpc_compression(compression_algorithm),
+        )
+
+        class _ResponseIterator:
+            def __init__(self, grpc_call):
+                self._call = grpc_call
+
+            def __aiter__(self):
+                return self
+
+            async def __anext__(self):
+                try:
+                    response = await self._call.read()
+                except grpc.RpcError as e:
+                    raise StopAsyncIteration from e
+                if response == grpc.aio.EOF:
+                    raise StopAsyncIteration
+                if response.error_message:
+                    from ...utils import InferenceServerException
+
+                    return None, InferenceServerException(response.error_message)
+                return InferResult(response.infer_response), None
+
+            def cancel(self):
+                return self._call.cancel()
+
+        return _ResponseIterator(call)

@@ -1,0 +1,5 @@
+"""Auth plugins for the aio gRPC client (reference ``tritonclient/grpc/aio/auth``)."""
+
+from ...._auth import BasicAuth
+
+__all__ = ["BasicAuth"]

@@ -1,0 +1,351 @@
+"""asyncio HTTP ``InferenceServerClient``.
+
+Parity target: reference ``tritonclient/http/aio/__init__.py`` (775 LoC) —
+the sync HTTP surface as ``async def`` over an aiohttp ``ClientSession``
+with ``TCPConnector(limit=conn_limit)`` and ``auto_decompress=False``
+(reference :92-120); same URI scheme and binary-over-HTTP framing.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import zlib
+from typing import Any, Dict, Optional
+from urllib.parse import quote, urlencode
+
+import aiohttp
+
+from ..._client import InferenceServerClientBase
+from ..._request import Request
+from ...utils import raise_error
+from .._infer_result import InferResult
+from .._utils import get_inference_request_body, raise_if_error
+
+__all__ = ["InferenceServerClient"]
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """v2 protocol over aiohttp (reference aio client :92)."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        conn_limit: int = 100,
+        conn_timeout: float = 60.0,
+        ssl: bool = False,
+        ssl_context=None,
+    ):
+        super().__init__()
+        if url.startswith("http://") or url.startswith("https://"):
+            raise_error("url should not include the scheme")
+        scheme = "https://" if ssl else "http://"
+        self._base_uri = (scheme + url).rstrip("/")
+        self._verbose = verbose
+        connector = aiohttp.TCPConnector(limit=conn_limit, ssl=ssl_context if ssl else False)
+        self._session = aiohttp.ClientSession(
+            connector=connector,
+            timeout=aiohttp.ClientTimeout(total=conn_timeout),
+            auto_decompress=False,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    async def close(self) -> None:
+        await self._session.close()
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    # -- low-level ---------------------------------------------------------
+    def _build_headers(self, headers: Optional[dict]) -> dict:
+        request = Request(dict(headers) if headers else {})
+        self._call_plugin(request)
+        return request.headers
+
+    def _uri(self, path: str, query_params: Optional[dict]) -> str:
+        uri = f"{self._base_uri}/{path}"
+        if query_params:
+            uri += "?" + urlencode(query_params, doseq=True)
+        return uri
+
+    async def _get(self, path, headers, query_params) -> tuple:
+        uri = self._uri(path, query_params)
+        if self._verbose:
+            print(f"GET {uri}")
+        async with self._session.get(uri, headers=self._build_headers(headers)) as resp:
+            body = await resp.read()
+            return resp.status, dict(resp.headers), _decompress(resp.headers, body)
+
+    async def _post(self, path, body, headers, query_params, extra_headers=None) -> tuple:
+        uri = self._uri(path, query_params)
+        hdrs = self._build_headers(headers)
+        if extra_headers:
+            hdrs.update(extra_headers)
+        if self._verbose:
+            print(f"POST {uri}")
+        async with self._session.post(uri, data=body, headers=hdrs) as resp:
+            data = await resp.read()
+            return resp.status, dict(resp.headers), _decompress(resp.headers, data)
+
+    # -- health / metadata -------------------------------------------------
+    async def is_server_live(self, headers=None, query_params=None) -> bool:
+        status, _, _ = await self._get("v2/health/live", headers, query_params)
+        return status == 200
+
+    async def is_server_ready(self, headers=None, query_params=None) -> bool:
+        status, _, _ = await self._get("v2/health/ready", headers, query_params)
+        return status == 200
+
+    async def is_model_ready(
+        self, model_name, model_version="", headers=None, query_params=None
+    ) -> bool:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        status, _, _ = await self._get(f"{path}/ready", headers, query_params)
+        return status == 200
+
+    async def get_server_metadata(self, headers=None, query_params=None) -> dict:
+        status, _, body = await self._get("v2", headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def get_model_metadata(
+        self, model_name, model_version="", headers=None, query_params=None
+    ) -> dict:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        status, _, body = await self._get(path, headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def get_model_config(
+        self, model_name, model_version="", headers=None, query_params=None
+    ) -> dict:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        status, _, body = await self._get(f"{path}/config", headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    # -- repository --------------------------------------------------------
+    async def get_model_repository_index(self, headers=None, query_params=None) -> list:
+        status, _, body = await self._post("v2/repository/index", b"", headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def load_model(
+        self, model_name, headers=None, query_params=None,
+        config: Optional[str] = None, files: Optional[Dict[str, bytes]] = None,
+    ) -> None:
+        import base64
+
+        load_request: Dict[str, Any] = {}
+        if config is not None or files:
+            load_request["parameters"] = {}
+        if config is not None:
+            load_request["parameters"]["config"] = config
+        if files:
+            for path, content in files.items():
+                load_request["parameters"][path] = base64.b64encode(content).decode()
+        status, _, body = await self._post(
+            f"v2/repository/models/{quote(model_name)}/load",
+            json.dumps(load_request).encode() if load_request else b"",
+            headers, query_params,
+        )
+        raise_if_error(status, body)
+
+    async def unload_model(
+        self, model_name, headers=None, query_params=None, unload_dependents=False
+    ) -> None:
+        body = {"parameters": {"unload_dependents": unload_dependents}}
+        status, _, data = await self._post(
+            f"v2/repository/models/{quote(model_name)}/unload",
+            json.dumps(body).encode(), headers, query_params,
+        )
+        raise_if_error(status, data)
+
+    # -- statistics / trace / logging --------------------------------------
+    async def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, query_params=None
+    ) -> dict:
+        if model_name:
+            path = f"v2/models/{quote(model_name)}"
+            if model_version:
+                path += f"/versions/{model_version}"
+            path += "/stats"
+        else:
+            path = "v2/models/stats"
+        status, _, body = await self._get(path, headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def update_trace_settings(
+        self, model_name=None, settings=None, headers=None, query_params=None
+    ) -> dict:
+        path = (
+            f"v2/models/{quote(model_name)}/trace/setting" if model_name else "v2/trace/setting"
+        )
+        status, _, body = await self._post(
+            path, json.dumps(settings or {}).encode(), headers, query_params
+        )
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def get_trace_settings(self, model_name=None, headers=None, query_params=None) -> dict:
+        path = (
+            f"v2/models/{quote(model_name)}/trace/setting" if model_name else "v2/trace/setting"
+        )
+        status, _, body = await self._get(path, headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def update_log_settings(self, settings, headers=None, query_params=None) -> dict:
+        status, _, body = await self._post(
+            "v2/logging", json.dumps(settings).encode(), headers, query_params
+        )
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def get_log_settings(self, headers=None, query_params=None) -> dict:
+        status, _, body = await self._get("v2/logging", headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    # -- shared memory -----------------------------------------------------
+    async def get_system_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ) -> list:
+        path = "v2/systemsharedmemory"
+        if region_name:
+            path += f"/region/{quote(region_name)}"
+        status, _, body = await self._get(f"{path}/status", headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, query_params=None
+    ) -> None:
+        body = {"key": key, "offset": offset, "byte_size": byte_size}
+        status, _, data = await self._post(
+            f"v2/systemsharedmemory/region/{quote(name)}/register",
+            json.dumps(body).encode(), headers, query_params,
+        )
+        raise_if_error(status, data)
+
+    async def unregister_system_shared_memory(
+        self, name="", headers=None, query_params=None
+    ) -> None:
+        if name:
+            path = f"v2/systemsharedmemory/region/{quote(name)}/unregister"
+        else:
+            path = "v2/systemsharedmemory/unregister"
+        status, _, data = await self._post(path, b"", headers, query_params)
+        raise_if_error(status, data)
+
+    async def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ) -> list:
+        path = "v2/cudasharedmemory"
+        if region_name:
+            path += f"/region/{quote(region_name)}"
+        status, _, body = await self._get(f"{path}/status", headers, query_params)
+        raise_if_error(status, body)
+        return json.loads(body)
+
+    async def register_cuda_shared_memory(
+        self, name, raw_handle: bytes, device_id: int, byte_size: int,
+        headers=None, query_params=None,
+    ) -> None:
+        import base64
+
+        body = {
+            "raw_handle": {"b64": base64.b64encode(raw_handle).decode()},
+            "device_id": device_id,
+            "byte_size": byte_size,
+        }
+        status, _, data = await self._post(
+            f"v2/cudasharedmemory/region/{quote(name)}/register",
+            json.dumps(body).encode(), headers, query_params,
+        )
+        raise_if_error(status, data)
+
+    register_xla_shared_memory = register_cuda_shared_memory
+    get_xla_shared_memory_status = get_cuda_shared_memory_status
+
+    async def unregister_cuda_shared_memory(self, name="", headers=None, query_params=None) -> None:
+        if name:
+            path = f"v2/cudasharedmemory/region/{quote(name)}/unregister"
+        else:
+            path = "v2/cudasharedmemory/unregister"
+        status, _, data = await self._post(path, b"", headers, query_params)
+        raise_if_error(status, data)
+
+    unregister_xla_shared_memory = unregister_cuda_shared_memory
+
+    # -- inference ---------------------------------------------------------
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ) -> InferResult:
+        """Async inference (reference aio :694)."""
+        body, json_size = get_inference_request_body(
+            inputs, request_id, outputs, sequence_id, sequence_start, sequence_end,
+            priority, timeout, parameters,
+        )
+        extra_headers = {}
+        if request_compression_algorithm == "gzip":
+            body = gzip.compress(body)
+            extra_headers["Content-Encoding"] = "gzip"
+        elif request_compression_algorithm == "deflate":
+            body = zlib.compress(body)
+            extra_headers["Content-Encoding"] = "deflate"
+        if response_compression_algorithm in ("gzip", "deflate"):
+            extra_headers["Accept-Encoding"] = response_compression_algorithm
+        if json_size is not None:
+            extra_headers["Inference-Header-Content-Length"] = str(json_size)
+
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        path += "/infer"
+        status, resp_headers, data = await self._post(
+            path, body, headers, query_params, extra_headers
+        )
+        raise_if_error(status, data)
+        header_length = resp_headers.get("Inference-Header-Content-Length")
+        return InferResult(
+            data, self._verbose,
+            int(header_length) if header_length is not None else None, None,
+        )
+
+
+def _decompress(headers, body: bytes) -> bytes:
+    """The session runs with auto_decompress=False (reference :92-120), so
+    undo Content-Encoding here where the framing header is interpretable."""
+    encoding = headers.get("Content-Encoding", "")
+    if encoding == "gzip":
+        return gzip.decompress(body)
+    if encoding == "deflate":
+        return zlib.decompress(body)
+    return body

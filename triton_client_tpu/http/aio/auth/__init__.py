@@ -1,0 +1,5 @@
+"""Auth plugins for the aio HTTP client (reference ``tritonclient/http/aio/auth``)."""
+
+from ...._auth import BasicAuth
+
+__all__ = ["BasicAuth"]

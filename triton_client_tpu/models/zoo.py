@@ -222,9 +222,6 @@ def make_dense_tpu() -> JaxModel:
     """TPU-resident batched MLP for device-path benchmarking: bf16 matmuls
     (MXU-shaped), dynamic batching so concurrent requests coalesce into one
     device execute (BASELINE config #4 dynamic-batching contract)."""
-    import jax
-    import jax.numpy as jnp
-
     D = 512
     cfg = make_config(
         "dense_tpu",
@@ -235,16 +232,26 @@ def make_dense_tpu() -> JaxModel:
         max_queue_delay_us=2000,
         instance_kind="KIND_TPU",
     )
-    key = jax.random.PRNGKey(0)
-    w1 = jax.random.normal(key, (D, 2 * D), jnp.bfloat16) * 0.05
-    w2 = jax.random.normal(key, (2 * D, D), jnp.bfloat16) * 0.05
+    state = {}
 
     def fn(INPUT):
-        h = jnp.dot(INPUT.astype(jnp.bfloat16), w1)
-        h = jax.nn.relu(h)
-        return {"OUTPUT": jnp.dot(h, w2).astype(jnp.float32)}
+        import jax
+        import jax.numpy as jnp
 
-    return JaxModel(cfg, fn)
+        if "run" not in state:  # lazy: no device work until first request
+            k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+            w1 = jax.random.normal(k1, (D, 2 * D), jnp.bfloat16) * 0.05
+            w2 = jax.random.normal(k2, (2 * D, D), jnp.bfloat16) * 0.05
+
+            @jax.jit
+            def run(x):
+                h = jax.nn.relu(jnp.dot(x.astype(jnp.bfloat16), w1))
+                return jnp.dot(h, w2).astype(jnp.float32)
+
+            state["run"] = run
+        return {"OUTPUT": state["run"](INPUT)}
+
+    return JaxModel(cfg, fn, jit=False)
 
 
 def register_all(registry: ModelRegistry) -> None:

@@ -278,7 +278,8 @@ class InferenceCore:
     async def shutdown(self) -> None:
         """Cancel background batcher tasks and fail any queued requests so
         no handler is left awaiting a forever-pending future."""
-        for b in self._batchers.values():
+        while self._batchers:
+            _, b = self._batchers.popitem()
             if b._task is not None and not b._task.done():
                 b._task.cancel()
                 try:
@@ -290,7 +291,6 @@ class InferenceCore:
                 _inputs, _params, fut, _ts = b._queue.get_nowait()
                 if not fut.done():
                     fut.set_exception(InferError("server is shutting down", 503))
-        self._batchers.clear()
 
     def _batcher(self, model: Model) -> _DynamicBatcher:
         b = self._batchers.get(model.name)
