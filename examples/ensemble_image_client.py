@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Ensemble DAG inference (reference ensemble_image_client.py behavior:
+client sends raw tensors, the server executes the preprocess -> model
+pipeline via ensemble_scheduling)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url, verbose=args.verbose)
+    raw0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    raw1 = np.ones((1, 16), dtype=np.int32)
+    inputs = [
+        httpclient.InferInput("RAW0", [1, 16], "INT32"),
+        httpclient.InferInput("RAW1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(raw0)
+    inputs[1].set_data_from_numpy(raw1)
+    outputs = [
+        httpclient.InferRequestedOutput("SUM"),
+        httpclient.InferRequestedOutput("DIFF"),
+    ]
+    result = client.infer("ensemble_scale_sum", inputs, outputs=outputs)
+    if not np.array_equal(result.as_numpy("SUM"), raw0 * 2 + raw1):
+        print("ensemble sum mismatch")
+        sys.exit(1)
+    if not np.array_equal(result.as_numpy("DIFF"), raw0 * 2 - raw1):
+        print("ensemble diff mismatch")
+        sys.exit(1)
+    client.close()
+    print("PASS: ensemble")
+
+
+if __name__ == "__main__":
+    main()

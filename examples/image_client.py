@@ -1,0 +1,208 @@
+#!/usr/bin/env python3
+"""Image classification CLI (reference image_client.py, 550 LoC — the
+application-level behavioral spec, SURVEY.md §3.6):
+
+* fetches model metadata+config and validates a 1-input/1-output image model
+  (CHW/HWC layout, optional batch dim) — parse_model (:59-150),
+* preprocesses with PIL (resize + INCEPTION/VGG scaling) (:153-192),
+* batches, runs sync / async / streaming inference,
+* postprocesses classification strings "score:index[:label]" (:195-217).
+
+Without an image argument it classifies a synthetic image, so it doubles as
+an executable acceptance test (prints PASS)."""
+
+import argparse
+import queue
+import sys
+from functools import partial
+
+import numpy as np
+
+
+def parse_model(model_metadata, model_config):
+    """Validate 1-in/1-out image model; return (input name, output name,
+    c, h, w, layout, dtype, max_batch)."""
+    if len(model_metadata["inputs"]) != 1:
+        raise Exception(f"expecting 1 input, got {len(model_metadata['inputs'])}")
+    if len(model_metadata["outputs"]) != 1:
+        raise Exception(f"expecting 1 output, got {len(model_metadata['outputs'])}")
+    input_metadata = model_metadata["inputs"][0]
+    output_metadata = model_metadata["outputs"][0]
+    if "config" in model_config:  # gRPC ModelConfigResponse nests the config
+        model_config = model_config["config"]
+    max_batch_size = int(model_config.get("max_batch_size", 0))
+
+    # gRPC-JSON renders int64 dims as strings
+    shape = [int(s) for s in input_metadata["shape"]]
+    if max_batch_size > 0:
+        shape = shape[1:]  # strip dynamic batch dim
+    if len(shape) != 3:
+        raise Exception(f"expecting input rank 3, got {shape}")
+    # CHW vs HWC: channels are 1 or 3
+    if shape[0] in (1, 3):
+        layout, (c, h, w) = "CHW", shape
+    elif shape[2] in (1, 3):
+        layout, (h, w, c) = "HWC", shape
+    else:
+        raise Exception(f"cannot infer layout from shape {shape}")
+    return (
+        input_metadata["name"],
+        output_metadata["name"],
+        c, h, w, layout,
+        input_metadata["datatype"],
+        max_batch_size,
+    )
+
+
+def preprocess(img, layout, dtype, c, h, w, scaling):
+    """PIL image -> network-ready ndarray (reference :153-192)."""
+    if c == 1:
+        img = img.convert("L")
+    else:
+        img = img.convert("RGB")
+    img = img.resize((w, h), 2)  # PIL.Image.BILINEAR
+    arr = np.array(img).astype(np.float32)
+    if c == 1:
+        arr = arr[:, :, None]
+    if scaling == "INCEPTION":
+        arr = arr / 127.5 - 1.0
+    elif scaling == "VGG":
+        if c == 3:
+            arr -= np.array([123.0, 117.0, 104.0], dtype=np.float32)
+    if layout == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    from triton_client_tpu.utils import triton_to_np_dtype
+
+    return arr.astype(triton_to_np_dtype(dtype))
+
+
+def postprocess(results, output_name, batch_size, batching):
+    """Print classification strings (reference :195-217); returns them."""
+    output_array = results.as_numpy(output_name)
+    out = []
+    rows = output_array if batching else [output_array]
+    for row in rows:
+        for cls in np.asarray(row).reshape(-1):
+            s = cls.decode("utf-8") if isinstance(cls, bytes) else str(cls)
+            parts = s.split(":")
+            if len(parts) >= 3:
+                print(f"    {parts[0]} ({parts[1]}) = {parts[2]}")
+            else:
+                print(f"    {s}")
+            out.append(s)
+    return out
+
+
+def requestGenerator(batched_data, input_name, output_name, dtype, args, protocol_mod):
+    inp = protocol_mod.InferInput(input_name, list(batched_data.shape), dtype)
+    inp.set_data_from_numpy(batched_data)
+    output = protocol_mod.InferRequestedOutput(output_name, class_count=args.classes)
+    yield [inp], [output]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("image_filename", nargs="?", default=None)
+    parser.add_argument("-m", "--model-name", default="simple_cnn")
+    parser.add_argument("-x", "--model-version", default="")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("-c", "--classes", type=int, default=3)
+    parser.add_argument("-s", "--scaling", default="NONE",
+                        choices=["NONE", "INCEPTION", "VGG"])
+    parser.add_argument("-u", "--url", default=None)
+    parser.add_argument("-i", "--protocol", default="HTTP", choices=["HTTP", "GRPC"])
+    parser.add_argument("-a", "--async", dest="async_set", action="store_true")
+    parser.add_argument("--streaming", action="store_true")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    if args.streaming and args.protocol != "GRPC":
+        print("streaming requires GRPC protocol")
+        sys.exit(1)
+
+    if args.protocol == "HTTP":
+        import triton_client_tpu.http as protocol_mod
+
+        url = args.url or "localhost:8000"
+        client = protocol_mod.InferenceServerClient(
+            url, verbose=args.verbose, concurrency=args.batch_size or 1)
+        model_metadata = client.get_model_metadata(args.model_name, args.model_version)
+        model_config = client.get_model_config(args.model_name, args.model_version)
+    else:
+        import triton_client_tpu.grpc as protocol_mod
+
+        url = args.url or "localhost:8001"
+        client = protocol_mod.InferenceServerClient(url, verbose=args.verbose)
+        model_metadata = client.get_model_metadata(
+            args.model_name, args.model_version, as_json=True)
+        model_config = client.get_model_config(
+            args.model_name, args.model_version, as_json=True)
+
+    input_name, output_name, c, h, w, layout, dtype, max_batch = parse_model(
+        model_metadata, model_config)
+
+    if args.batch_size > max(max_batch, 1):
+        print(f"batch size {args.batch_size} exceeds model max {max_batch}")
+        sys.exit(1)
+
+    from PIL import Image
+
+    if args.image_filename:
+        img = Image.open(args.image_filename)
+    else:  # synthetic image so the example is self-contained
+        rng = np.random.default_rng(0)
+        img = Image.fromarray(
+            rng.integers(0, 255, (h, w, 3), dtype=np.uint8), mode="RGB")
+
+    image_data = preprocess(img, layout, dtype, c, h, w, args.scaling)
+    batched = np.stack([image_data] * args.batch_size, axis=0) \
+        if max_batch > 0 else image_data
+
+    responses = []
+    if args.streaming:
+        completed: queue.Queue = queue.Queue()
+        client.start_stream(partial(
+            lambda q, result, error: q.put(error if error else result), completed))
+        for inputs, outputs in requestGenerator(
+                batched, input_name, output_name, dtype, args, protocol_mod):
+            client.async_stream_infer(
+                model_name=args.model_name, inputs=inputs, outputs=outputs)
+        item = completed.get(timeout=60)
+        client.stop_stream()
+        if isinstance(item, Exception):
+            print(f"streaming failed: {item}")
+            sys.exit(1)
+        responses.append(item)
+    elif args.async_set:
+        handles = []
+        for inputs, outputs in requestGenerator(
+                batched, input_name, output_name, dtype, args, protocol_mod):
+            if args.protocol == "HTTP":
+                handles.append(client.async_infer(
+                    args.model_name, inputs, outputs=outputs))
+            else:
+                handles.append(client.async_infer(args.model_name, inputs,
+                                                  outputs=outputs))
+        responses = [hd.get_result() for hd in handles]
+    else:
+        for inputs, outputs in requestGenerator(
+                batched, input_name, output_name, dtype, args, protocol_mod):
+            responses.append(client.infer(
+                args.model_name, inputs, outputs=outputs,
+                model_version=args.model_version))
+
+    ok = True
+    for response in responses:
+        classes = postprocess(response, output_name, args.batch_size, max_batch > 0)
+        expect = args.classes * (args.batch_size if max_batch > 0 else 1)
+        if len(classes) != expect:
+            print(f"FAILED: expected {expect} classifications, got {len(classes)}")
+            ok = False
+    client.close()
+    if not ok:
+        sys.exit(1)
+    print("PASS: image client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Client memory-growth check: repeated infers must not grow RSS unboundedly
+(reference memory_growth_test.py behavior; C++ sibling memory_leak_test.cc)."""
+
+import argparse
+import gc
+import resource
+import sys
+
+import numpy as np
+
+import triton_client_tpu.http as httpclient
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-n", "--iterations", type=int, default=500)
+    parser.add_argument("--max-growth-mb", type=float, default=64.0)
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.ones((1, 16), dtype=np.int32)
+
+    def one():
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0)
+        inputs[1].set_data_from_numpy(input1)
+        result = client.infer("simple", inputs)
+        assert result.as_numpy("OUTPUT0") is not None
+
+    for _ in range(50):  # warmup: pools, caches
+        one()
+    gc.collect()
+    before = rss_mb()
+    for _ in range(args.iterations):
+        one()
+    gc.collect()
+    growth = rss_mb() - before
+    client.close()
+    if growth > args.max_growth_mb:
+        print(f"FAILED: RSS grew {growth:.1f} MiB over {args.iterations} infers")
+        sys.exit(1)
+    print(f"PASS: memory growth {growth:.1f} MiB over {args.iterations} infers")
+
+
+if __name__ == "__main__":
+    main()

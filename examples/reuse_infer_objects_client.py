@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Reuse InferInput/InferRequestedOutput objects across calls (reference
+reuse_infer_objects_client.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url, verbose=args.verbose)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    for round_num in range(3):
+        input0 = np.full((1, 16), round_num, dtype=np.int32)
+        input1 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        inputs[0].set_data_from_numpy(input0)
+        inputs[1].set_data_from_numpy(input1)
+        result = client.infer("simple", inputs, outputs=outputs)
+        if not np.array_equal(result.as_numpy("OUTPUT0"), input0 + input1):
+            print(f"sum mismatch in round {round_num}")
+            sys.exit(1)
+        if not np.array_equal(result.as_numpy("OUTPUT1"), input0 - input1):
+            print(f"diff mismatch in round {round_num}")
+            sys.exit(1)
+    client.close()
+    print("PASS: reuse infer objects")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""asyncio gRPC infer (reference simple_grpc_aio_infer_client.py)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+from triton_client_tpu.grpc.aio import InferenceServerClient
+
+
+async def run(url, verbose):
+    async with InferenceServerClient(url, verbose=verbose) as client:
+        input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1 = np.ones((1, 16), dtype=np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0)
+        inputs[1].set_data_from_numpy(input1)
+        result = await client.infer("simple", inputs)
+        if not np.array_equal(result.as_numpy("OUTPUT0"), input0 + input1):
+            print("sum mismatch")
+            sys.exit(1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+    asyncio.run(run(args.url, args.verbose))
+    print("PASS: aio infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""asyncio bidi-stream sequences (reference
+simple_grpc_aio_sequence_stream_infer_client.py)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+from triton_client_tpu.grpc.aio import InferenceServerClient
+
+
+async def run(url, verbose):
+    values = [11, 7, 5, 3]
+    async with InferenceServerClient(url, verbose=verbose) as client:
+        async def requests():
+            for i, v in enumerate(values):
+                inp = grpcclient.InferInput("INPUT", [1], "INT32")
+                inp.set_data_from_numpy(np.array([v], dtype=np.int32))
+                yield {
+                    "model_name": "simple_sequence",
+                    "inputs": [inp],
+                    "sequence_id": 4001,
+                    "sequence_start": i == 0,
+                    "sequence_end": i == len(values) - 1,
+                }
+
+        outs = []
+        async for result, error in client.stream_infer(requests()):
+            if error is not None:
+                print(f"stream error: {error}")
+                sys.exit(1)
+            outs.append(int(result.as_numpy("OUTPUT")[0]))
+        if outs != list(np.cumsum(values)):
+            print(f"sequence mismatch: {outs}")
+            sys.exit(1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+    asyncio.run(run(args.url, args.verbose))
+    print("PASS: aio sequence stream")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Decoupled model emitting N responses per request (reference
+simple_grpc_custom_repeat.py driving the repeat backend; exercises
+IsFinalResponse/empty-final semantics)."""
+
+import argparse
+import queue
+import sys
+from functools import partial
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+from triton_client_tpu.utils import InferenceServerException
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    completed: queue.Queue = queue.Queue()
+
+    def callback(result, error):
+        completed.put(error if error else result)
+
+    values = np.array([4, 2, 0, 1], dtype=np.int32)
+    delays = np.zeros(len(values), dtype=np.uint32)
+    wait = np.array([0], dtype=np.uint32)
+
+    client = grpcclient.InferenceServerClient(args.url, verbose=args.verbose)
+    client.start_stream(callback)
+    inputs = [
+        grpcclient.InferInput("IN", [len(values)], "INT32"),
+        grpcclient.InferInput("DELAY", [len(values)], "UINT32"),
+        grpcclient.InferInput("WAIT", [1], "UINT32"),
+    ]
+    inputs[0].set_data_from_numpy(values)
+    inputs[1].set_data_from_numpy(delays)
+    inputs[2].set_data_from_numpy(wait)
+    client.async_stream_infer(
+        model_name="repeat_int32", inputs=inputs,
+        enable_empty_final_response=True,
+    )
+
+    outs = []
+    while True:
+        item = completed.get(timeout=30)
+        if isinstance(item, InferenceServerException):
+            print(f"stream error: {item}")
+            sys.exit(1)
+        response = item.get_response()
+        if response.parameters["triton_final_response"].bool_param:
+            break
+        outs.append(int(item.as_numpy("OUT")[0]))
+    client.stop_stream()
+    if outs != list(values):
+        print(f"repeat mismatch: {outs}")
+        sys.exit(1)
+    client.close()
+    print("PASS: custom repeat (decoupled)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Sum/diff against the `simple` model over gRPC (reference
+simple_grpc_infer_client.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+from triton_client_tpu.utils import InferenceServerException
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(args.url, verbose=args.verbose)
+
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.ones((1, 16), dtype=np.int32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0)
+    inputs[1].set_data_from_numpy(input1)
+    outputs = [
+        grpcclient.InferRequestedOutput("OUTPUT0"),
+        grpcclient.InferRequestedOutput("OUTPUT1"),
+    ]
+
+    try:
+        result = client.infer("simple", inputs, outputs=outputs, request_id="1")
+    except InferenceServerException as e:
+        print(f"inference failed: {e}")
+        sys.exit(1)
+
+    output0 = result.as_numpy("OUTPUT0")
+    output1 = result.as_numpy("OUTPUT1")
+    if not np.array_equal(output0, input0 + input1):
+        print("sum mismatch")
+        sys.exit(1)
+    if not np.array_equal(output1, input0 - input1):
+        print("diff mismatch")
+        sys.exit(1)
+    client.close()
+    print("PASS: infer")
+
+
+if __name__ == "__main__":
+    main()

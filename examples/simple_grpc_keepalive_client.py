@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Custom keepalive channel options (reference
+simple_grpc_keepalive_client.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+from triton_client_tpu.grpc import KeepAliveOptions
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    options = KeepAliveOptions(
+        keepalive_time_ms=2**31 - 1,
+        keepalive_timeout_ms=20000,
+        keepalive_permit_without_calls=False,
+        http2_max_pings_without_data=2,
+    )
+    client = grpcclient.InferenceServerClient(
+        args.url, verbose=args.verbose, keepalive_options=options)
+
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.ones((1, 16), dtype=np.int32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0)
+    inputs[1].set_data_from_numpy(input1)
+    result = client.infer("simple", inputs)
+    if not np.array_equal(result.as_numpy("OUTPUT0"), input0 + input1):
+        print("sum mismatch")
+        sys.exit(1)
+    client.close()
+    print("PASS: keepalive")
+
+
+if __name__ == "__main__":
+    main()

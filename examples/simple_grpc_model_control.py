@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Model repository load/unload/index over gRPC (reference
+simple_grpc_model_control.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+from triton_client_tpu.utils import InferenceServerException
+
+MODEL_PY = b"""
+import numpy as np
+from triton_client_tpu.server.model import PyModel
+
+
+def get_model(config):
+    def fn(inputs, params):
+        return {"OUTPUT0": np.asarray(inputs["INPUT0"]) + 100}
+
+    return PyModel(config, fn)
+"""
+
+CONFIG = """
+{
+  "name": "loaded_plus100",
+  "backend": "python",
+  "input": [{"name": "INPUT0", "data_type": "TYPE_INT32", "dims": [-1]}],
+  "output": [{"name": "OUTPUT0", "data_type": "TYPE_INT32", "dims": [-1]}]
+}
+"""
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(args.url, verbose=args.verbose)
+    client.load_model(
+        "loaded_plus100", config=CONFIG, files={"file:1/model.py": MODEL_PY}
+    )
+    if not client.is_model_ready("loaded_plus100"):
+        print("FAILED: model not ready after load")
+        sys.exit(1)
+    index = client.get_model_repository_index(as_json=True)
+    names = {m["name"] for m in index.get("models", [])}
+    if "loaded_plus100" not in names:
+        print(f"FAILED: model missing from index: {names}")
+        sys.exit(1)
+
+    inp = grpcclient.InferInput("INPUT0", [4], "INT32")
+    inp.set_data_from_numpy(np.arange(4, dtype=np.int32))
+    result = client.infer("loaded_plus100", [inp])
+    if not np.array_equal(result.as_numpy("OUTPUT0"), np.arange(4) + 100):
+        print("FAILED: wrong loaded-model output")
+        sys.exit(1)
+
+    client.unload_model("loaded_plus100")
+    if client.is_model_ready("loaded_plus100"):
+        print("FAILED: model still ready after unload")
+        sys.exit(1)
+    try:
+        client.load_model("no_such_model_anywhere")
+        print("FAILED: expected load error")
+        sys.exit(1)
+    except InferenceServerException:
+        pass
+    client.close()
+    print("PASS: model control")
+
+
+if __name__ == "__main__":
+    main()

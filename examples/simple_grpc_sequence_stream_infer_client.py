@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Two concurrent sequences over one bidi stream (reference
+simple_grpc_sequence_stream_infer_client.py :58-79: per-sequence
+start/end control flags; --dyna exercises string-vs-int sequence ids
+:132-153)."""
+
+import argparse
+import queue
+import sys
+from functools import partial
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+from triton_client_tpu.utils import InferenceServerException
+
+
+class UserData:
+    def __init__(self):
+        self.completed = queue.Queue()
+
+
+def callback(user_data, result, error):
+    if error:
+        user_data.completed.put(error)
+    else:
+        user_data.completed.put(result)
+
+
+def async_stream_send(client, values, seq_id, model_name):
+    for i, value in enumerate(values):
+        inp = grpcclient.InferInput("INPUT", [1], "INT32")
+        inp.set_data_from_numpy(np.array([value], dtype=np.int32))
+        client.async_stream_infer(
+            model_name=model_name,
+            inputs=[inp],
+            request_id=f"{seq_id}_{i}",
+            sequence_id=seq_id,
+            sequence_start=(i == 0),
+            sequence_end=(i == len(values) - 1),
+        )
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-d", "--dyna", action="store_true",
+                        help="use string sequence ids (dyna sequence model)")
+    parser.add_argument("-t", "--stream-timeout", type=float, default=None)
+    args = parser.parse_args()
+
+    model_name = "simple_dyna_sequence" if args.dyna else "simple_sequence"
+    values = [11, 7, 5, 3, 2, 0, 1]
+    seq_ids = ("str_1001", "str_1002") if args.dyna else (1001, 1002)
+
+    user_data = UserData()
+    client = grpcclient.InferenceServerClient(args.url, verbose=args.verbose)
+    client.start_stream(partial(callback, user_data),
+                        stream_timeout=args.stream_timeout)
+    try:
+        async_stream_send(client, values, seq_ids[0], model_name)
+        async_stream_send(client, [-v for v in values], seq_ids[1], model_name)
+    finally:
+        client.stop_stream()
+
+    results = {sid: [] for sid in seq_ids}
+    for _ in range(2 * len(values)):
+        item = user_data.completed.get()
+        if isinstance(item, InferenceServerException):
+            print(f"stream error: {item}")
+            sys.exit(1)
+        rid = item.get_response().id
+        sid = rid.rsplit("_", 1)[0]
+        results[sid if args.dyna else int(sid)].append(
+            int(item.as_numpy("OUTPUT")[0]))
+
+    acc = list(np.cumsum(values))
+    exp0, exp1 = acc, [-a for a in acc]
+    if args.dyna:  # dyna adds a correlation-id-derived constant on start
+        got0, got1 = results[seq_ids[0]], results[seq_ids[1]]
+        d0, d1 = got0[0] - values[0], got1[0] + values[0]
+        exp0 = [a + d0 for a in acc]
+        exp1 = [-a + d1 for a in acc]
+    if results[seq_ids[0]] != exp0 or results[seq_ids[1]] != exp1:
+        print(f"sequence mismatch: {results}")
+        sys.exit(1)
+    client.close()
+    print("PASS: sequence stream")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""Two sequences via synchronous per-request infer (reference
+simple_grpc_sequence_sync_infer_client.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+
+
+def send(client, values, seq_id):
+    outs = []
+    for i, value in enumerate(values):
+        inp = grpcclient.InferInput("INPUT", [1], "INT32")
+        inp.set_data_from_numpy(np.array([value], dtype=np.int32))
+        result = client.infer(
+            "simple_sequence", [inp],
+            sequence_id=seq_id,
+            sequence_start=(i == 0),
+            sequence_end=(i == len(values) - 1),
+        )
+        outs.append(int(result.as_numpy("OUTPUT")[0]))
+    return outs
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(args.url, verbose=args.verbose)
+    values = [11, 7, 5, 3, 2, 0, 1]
+    out0 = send(client, values, 2001)
+    out1 = send(client, [-v for v in values], 2002)
+    acc = list(np.cumsum(values))
+    if out0 != acc or out1 != [-a for a in acc]:
+        print(f"sequence mismatch: {out0} {out1}")
+        sys.exit(1)
+    client.close()
+    print("PASS: sequence sync")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""System shared-memory flow over gRPC (reference simple_grpc_shm_client.py
+behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+import triton_client_tpu.utils.shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(args.url, verbose=args.verbose)
+    client.unregister_system_shared_memory()
+
+    input0 = np.arange(16, dtype=np.int32)
+    input1 = np.ones(16, dtype=np.int32)
+    nbytes = input0.nbytes
+
+    op_handle = shm.create_shared_memory_region("output_data", "/output_g", nbytes * 2)
+    client.register_system_shared_memory("output_data", "/output_g", nbytes * 2)
+    ip_handle = shm.create_shared_memory_region("input_data", "/input_g", nbytes * 2)
+    shm.set_shared_memory_region(ip_handle, [input0])
+    shm.set_shared_memory_region(ip_handle, [input1], offset=nbytes)
+    client.register_system_shared_memory("input_data", "/input_g", nbytes * 2)
+
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_shared_memory("input_data", nbytes)
+    inputs[1].set_shared_memory("input_data", nbytes, offset=nbytes)
+    outputs = [
+        grpcclient.InferRequestedOutput("OUTPUT0"),
+        grpcclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    outputs[0].set_shared_memory("output_data", nbytes)
+    outputs[1].set_shared_memory("output_data", nbytes, offset=nbytes)
+
+    client.infer("simple", inputs, outputs=outputs)
+
+    output0_data = shm.get_contents_as_numpy(op_handle, np.int32, [1, 16], offset=0)
+    output1_data = shm.get_contents_as_numpy(op_handle, np.int32, [1, 16], offset=nbytes)
+    if not np.array_equal(output0_data[0], input0 + input1):
+        print("sum mismatch")
+        sys.exit(1)
+    if not np.array_equal(output1_data[0], input0 - input1):
+        print("diff mismatch")
+        sys.exit(1)
+
+    status = client.get_system_shared_memory_status(as_json=True)
+    if len(status.get("regions", status)) < 1:
+        print(f"unexpected shm status: {status}")
+        sys.exit(1)
+    client.unregister_system_shared_memory()
+    shm.destroy_shared_memory_region(ip_handle)
+    shm.destroy_shared_memory_region(op_handle)
+    client.close()
+    print("PASS: system shared memory")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Serialized BYTES tensors through system shm over gRPC (reference
+simple_grpc_shm_string_client.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+import triton_client_tpu.utils.shared_memory as shm
+from triton_client_tpu.utils import serialize_byte_tensor, serialized_byte_size
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(args.url, verbose=args.verbose)
+    client.unregister_system_shared_memory()
+
+    strings = np.array([[b"first", b"second", b"", b"last"]], dtype=np.object_)
+    serialized = serialize_byte_tensor(strings)
+    in_size = serialized_byte_size(strings)
+    out_size = in_size + 64  # room for the echoed payload
+
+    ip = shm.create_shared_memory_region("input_str", "/input_str", in_size)
+    shm.set_shared_memory_region(ip, [serialized])
+    client.register_system_shared_memory("input_str", "/input_str", in_size)
+    op = shm.create_shared_memory_region("output_str", "/output_str", out_size)
+    client.register_system_shared_memory("output_str", "/output_str", out_size)
+
+    inp = grpcclient.InferInput("INPUT0", [1, 4], "BYTES")
+    inp.set_shared_memory("input_str", in_size)
+    out = grpcclient.InferRequestedOutput("OUTPUT0")
+    out.set_shared_memory("output_str", out_size)
+
+    client.infer("simple_identity", [inp], outputs=[out])
+
+    got = shm.get_contents_as_numpy(op, np.object_, [1, 4])
+    if [bytes(x) for x in got.reshape(-1)] != [bytes(x) for x in strings.reshape(-1)]:
+        print(f"string mismatch: {got}")
+        sys.exit(1)
+
+    client.unregister_system_shared_memory()
+    shm.destroy_shared_memory_region(ip)
+    shm.destroy_shared_memory_region(op)
+    client.close()
+    print("PASS: shm string")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+#!/usr/bin/env python3
+"""BYTES passthrough via `simple_identity` over gRPC (reference
+simple_grpc_string_infer_client.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(args.url, verbose=args.verbose)
+    strings = np.array([[b"one", b"two", b"three", b""]], dtype=np.object_)
+    inp = grpcclient.InferInput("INPUT0", [1, 4], "BYTES")
+    inp.set_data_from_numpy(strings)
+    result = client.infer("simple_identity", [inp])
+    out = result.as_numpy("OUTPUT0")
+    if [bytes(x) for x in out.reshape(-1)] != [bytes(x) for x in strings.reshape(-1)]:
+        print(f"string mismatch: {out}")
+        sys.exit(1)
+    client.close()
+    print("PASS: string infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Future-based async_infer over HTTP (reference
+simple_http_async_infer_client.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url, concurrency=4,
+                                              verbose=args.verbose)
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.ones((1, 16), dtype=np.int32)
+
+    requests = []
+    for _ in range(8):
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0)
+        inputs[1].set_data_from_numpy(input1)
+        requests.append(client.async_infer("simple", inputs))
+
+    for req in requests:
+        result = req.get_result()
+        if not np.array_equal(result.as_numpy("OUTPUT0"), input0 + input1):
+            print("sum mismatch")
+            sys.exit(1)
+    client.close()
+    print("PASS: async infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Device shared-memory flow over HTTP on the TPU-native xla path (reference
+simple_http_cudashm_client.py behavior)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.http as httpclient
+import triton_client_tpu.utils.xla_shared_memory as xlashm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url, verbose=args.verbose)
+    client.unregister_cuda_shared_memory()
+
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.ones((1, 16), dtype=np.int32)
+    nbytes = input0.nbytes
+
+    handles = {}
+    for name in ("input0_data", "input1_data", "output0_data", "output1_data"):
+        handles[name] = xlashm.create_shared_memory_region(name, nbytes, 0)
+        client.register_xla_shared_memory(
+            name, xlashm.get_raw_handle(handles[name]), 0, nbytes)
+
+    xlashm.set_shared_memory_region(handles["input0_data"], [input0])
+    xlashm.set_shared_memory_region(handles["input1_data"], [input1])
+
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_shared_memory("input0_data", nbytes)
+    inputs[1].set_shared_memory("input1_data", nbytes)
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    outputs[0].set_shared_memory("output0_data", nbytes)
+    outputs[1].set_shared_memory("output1_data", nbytes)
+
+    client.infer("simple", inputs, outputs=outputs)
+
+    sum_data = xlashm.get_contents_as_numpy(handles["output0_data"], np.int32, [1, 16])
+    diff_data = xlashm.get_contents_as_numpy(handles["output1_data"], np.int32, [1, 16])
+    if not np.array_equal(sum_data, input0 + input1):
+        print("sum mismatch")
+        sys.exit(1)
+    if not np.array_equal(diff_data, input0 - input1):
+        print("diff mismatch")
+        sys.exit(1)
+
+    client.unregister_xla_shared_memory()
+    for h in handles.values():
+        xlashm.destroy_shared_memory_region(h)
+    if xlashm.allocated_shared_memory_regions():
+        print("FAILED: leaked shared memory regions")
+        sys.exit(1)
+    client.close()
+    print("PASS: xla shared memory")
+
+
+if __name__ == "__main__":
+    main()

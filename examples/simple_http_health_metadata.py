@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Health + metadata walk over HTTP (reference
+simple_http_health_metadata.py behavior)."""
+
+import argparse
+import sys
+
+import triton_client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url, verbose=args.verbose)
+    if not client.is_server_live():
+        print("FAILED: server not live")
+        sys.exit(1)
+    if not client.is_server_ready():
+        print("FAILED: server not ready")
+        sys.exit(1)
+    if not client.is_model_ready("simple"):
+        print("FAILED: model not ready")
+        sys.exit(1)
+    metadata = client.get_server_metadata()
+    if "name" not in metadata:
+        print("FAILED: no server name")
+        sys.exit(1)
+    model_metadata = client.get_model_metadata("simple")
+    if model_metadata["name"] != "simple":
+        print("FAILED: wrong model metadata")
+        sys.exit(1)
+    model_config = client.get_model_config("simple")
+    if model_config["name"] != "simple":
+        print("FAILED: wrong model config")
+        sys.exit(1)
+    stats = client.get_inference_statistics("simple")
+    if "model_stats" not in stats:
+        print("FAILED: no statistics")
+        sys.exit(1)
+    client.close()
+    print("PASS: health metadata")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Sum/diff against the `simple` model over HTTP (reference
+simple_http_infer_client.py behavior: 2x INT32[1,16] in, sum+diff out,
+custom-parameter demo)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.http as httpclient
+from triton_client_tpu.utils import InferenceServerException
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    try:
+        client = httpclient.InferenceServerClient(args.url, verbose=args.verbose)
+    except Exception as e:
+        print(f"client creation failed: {e}")
+        sys.exit(1)
+
+    inputs = []
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.ones((1, 16), dtype=np.int32)
+    inputs.append(httpclient.InferInput("INPUT0", [1, 16], "INT32"))
+    inputs[0].set_data_from_numpy(input0)
+    inputs.append(httpclient.InferInput("INPUT1", [1, 16], "INT32"))
+    inputs[1].set_data_from_numpy(input1)
+
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+
+    try:
+        result = client.infer(
+            "simple", inputs, outputs=outputs, request_id="1",
+            parameters={"beta": 0.5, "pattern": "example"},
+        )
+    except InferenceServerException as e:
+        print(f"inference failed: {e}")
+        sys.exit(1)
+
+    output0 = result.as_numpy("OUTPUT0")
+    output1 = result.as_numpy("OUTPUT1")
+    for i in range(16):
+        if output0[0][i] != input0[0][i] + input1[0][i]:
+            print("sum mismatch")
+            sys.exit(1)
+        if output1[0][i] != input0[0][i] - input1[0][i]:
+            print("diff mismatch")
+            sys.exit(1)
+    client.close()
+    print("PASS: infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""System shared-memory flow over HTTP (reference simple_http_shm_client.py
+behavior :70-122): create -> register -> set inputs at offsets -> infer with
+set_shared_memory -> read outputs from the region -> unregister/destroy."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.http as httpclient
+import triton_client_tpu.utils.shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url, verbose=args.verbose)
+    client.unregister_system_shared_memory()
+
+    input0 = np.arange(16, dtype=np.int32)
+    input1 = np.ones(16, dtype=np.int32)
+    input_byte_size = input0.nbytes
+    output_byte_size = input_byte_size
+
+    # one region for both outputs, one for both inputs (offset layout)
+    shm_op_handle = shm.create_shared_memory_region(
+        "output_data", "/output_simple", output_byte_size * 2)
+    client.register_system_shared_memory(
+        "output_data", "/output_simple", output_byte_size * 2)
+    shm_ip_handle = shm.create_shared_memory_region(
+        "input_data", "/input_simple", input_byte_size * 2)
+    shm.set_shared_memory_region(shm_ip_handle, [input0])
+    shm.set_shared_memory_region(shm_ip_handle, [input1], offset=input_byte_size)
+    client.register_system_shared_memory(
+        "input_data", "/input_simple", input_byte_size * 2)
+
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_shared_memory("input_data", input_byte_size)
+    inputs[1].set_shared_memory("input_data", input_byte_size, offset=input_byte_size)
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    outputs[0].set_shared_memory("output_data", output_byte_size)
+    outputs[1].set_shared_memory("output_data", output_byte_size, offset=output_byte_size)
+
+    results = client.infer("simple", inputs, outputs=outputs)
+
+    output0 = results.get_output("OUTPUT0")
+    output0_data = shm.get_contents_as_numpy(
+        shm_op_handle, np.int32, [1, 16], offset=0)
+    output1_data = shm.get_contents_as_numpy(
+        shm_op_handle, np.int32, [1, 16], offset=output_byte_size)
+    if output0 is None or not np.array_equal(output0_data[0], input0 + input1):
+        print("sum mismatch")
+        sys.exit(1)
+    if not np.array_equal(output1_data[0], input0 - input1):
+        print("diff mismatch")
+        sys.exit(1)
+
+    status = client.get_system_shared_memory_status()
+    if len(status) != 2:
+        print(f"unexpected shm status: {status}")
+        sys.exit(1)
+    client.unregister_system_shared_memory()
+    shm.destroy_shared_memory_region(shm_ip_handle)
+    shm.destroy_shared_memory_region(shm_op_handle)
+    client.close()
+    print("PASS: system shared memory")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Examples as executable acceptance tests (reference convention: every
+simple_* prints 'PASS: ...' and exits nonzero on mismatch — SURVEY.md §4
+tier 4; upstream runs them in the server repo's L0_* CI jobs, here they run
+hermetically against the in-process harness)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server.registry import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+HTTP_EXAMPLES = [
+    "simple_http_infer_client.py",
+    "simple_http_string_infer_client.py",
+    "simple_http_health_metadata.py",
+    "simple_http_shm_client.py",
+    "simple_http_cudashm_client.py",
+    "simple_http_sequence_sync_infer_client.py",
+    "simple_http_async_infer_client.py",
+    "simple_http_aio_infer_client.py",
+    "simple_http_model_control.py",
+    "reuse_infer_objects_client.py",
+    "ensemble_image_client.py",
+    "image_client.py",
+]
+GRPC_EXAMPLES = [
+    "simple_grpc_infer_client.py",
+    "simple_grpc_string_infer_client.py",
+    "simple_grpc_health_metadata.py",
+    "simple_grpc_shm_client.py",
+    "simple_grpc_cudashm_client.py",
+    "simple_grpc_shm_string_client.py",
+    "simple_grpc_sequence_sync_infer_client.py",
+    "simple_grpc_sequence_stream_infer_client.py",
+    "simple_grpc_async_infer_client.py",
+    "simple_grpc_aio_infer_client.py",
+    "simple_grpc_aio_sequence_stream_infer_client.py",
+    "simple_grpc_custom_repeat.py",
+    "simple_grpc_keepalive_client.py",
+    "simple_grpc_custom_args_client.py",
+    "simple_grpc_model_control.py",
+]
+
+
+@pytest.fixture(scope="module")
+def harness():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    h = ServerHarness(registry)
+    h.start()
+    yield h
+    h.stop()
+
+
+def _run_example(script: str, url: str, extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), "-u", url, *extra],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "PASS" in proc.stdout, f"{script} did not print PASS:\n{proc.stdout}"
+
+
+@pytest.mark.parametrize("script", HTTP_EXAMPLES)
+def test_http_example(harness, script):
+    _run_example(script, f"127.0.0.1:{harness.http_port}")
+
+
+@pytest.mark.parametrize("script", GRPC_EXAMPLES)
+def test_grpc_example(harness, script):
+    _run_example(script, f"127.0.0.1:{harness.grpc_port}")
+
+
+def test_grpc_dyna_sequence(harness):
+    _run_example(
+        "simple_grpc_sequence_stream_infer_client.py",
+        f"127.0.0.1:{harness.grpc_port}", extra=["--dyna"],
+    )
+
+
+def test_image_client_grpc_async_batch(harness):
+    _run_example(
+        "image_client.py", f"127.0.0.1:{harness.grpc_port}",
+        extra=["-i", "GRPC", "-a", "-b", "2", "-c", "2", "-s", "INCEPTION"],
+    )

@@ -26,7 +26,7 @@ from typing import Any, Dict, Iterator
 
 import numpy as np
 
-from ..server.model import JaxModel, Model, PyModel, make_config
+from ..server.model import EnsembleModel, JaxModel, Model, PyModel, make_config
 from ..server.registry import ModelRegistry
 
 
@@ -162,12 +162,17 @@ class DynaSequenceModel(SequenceModel):
     def execute(self, inputs, parameters):
         seq_id = parameters.get("sequence_id", 0)
         start = bool(parameters.get("sequence_start", False))
-        corr_add = 0
-        if start:
-            corr_add = (hash(str(seq_id)) % 1000) if isinstance(seq_id, str) else int(seq_id)
-        out = super().execute(inputs, parameters)
-        out["OUTPUT"] = (out["OUTPUT"] + np.int32(corr_add)).astype(np.int32)
-        return out
+        if start and seq_id:
+            # seed the accumulator with a correlation-id-derived constant so
+            # every response in the sequence carries it (distinguishes
+            # interleaved sequences, as the reference backend does); wrap
+            # uint64 correlation ids into int32 range deliberately
+            corr = (hash(str(seq_id)) % 1000) if isinstance(seq_id, str) else int(seq_id)
+            with self._lock:
+                self._state[seq_id] = int(np.int64(corr).astype(np.int32))
+            parameters = dict(parameters)
+            parameters["sequence_start"] = False
+        return super().execute(inputs, parameters)
 
 
 def make_repeat_int32() -> PyModel:
@@ -254,6 +259,86 @@ def make_dense_tpu() -> JaxModel:
     return JaxModel(cfg, fn, jit=False)
 
 
+def make_simple_cnn() -> JaxModel:
+    """Tiny image classifier backing image_client.py (the behavioral stand-in
+    for the reference's inception/densenet ONNX models, SURVEY.md §2.7):
+    FP32 CHW [3,224,224] -> [1000] scores, with classification labels so
+    ``class_count`` outputs exercise the "score:index:label" path."""
+    labels = [f"class_{i}" for i in range(1000)]
+    cfg = make_config(
+        "simple_cnn",
+        inputs=[("INPUT", "FP32", [3, 224, 224])],
+        outputs=[("OUTPUT", "FP32", [1000])],
+        max_batch_size=8,
+        instance_kind="KIND_CPU",
+        labels={"OUTPUT": labels},
+    )
+    state: Dict[str, Any] = {}
+
+    def fn(INPUT):
+        import jax
+        import jax.numpy as jnp
+
+        if "run" not in state:
+            k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+            conv_w = jax.random.normal(k1, (8, 3, 4, 4), jnp.float32) * 0.1
+            dense_w = jax.random.normal(k2, (8 * 14 * 14, 1000), jnp.float32) * 0.02
+
+            @jax.jit
+            def run(x):
+                y = jax.lax.conv_general_dilated(
+                    x, conv_w, window_strides=(4, 4), padding="VALID")
+                y = jax.nn.relu(y)
+                y = jax.lax.reduce_window(
+                    y, -jnp.inf, jax.lax.max, (1, 1, 4, 4), (1, 1, 4, 4), "VALID")
+                y = y.reshape(y.shape[0], -1)
+                return jnp.dot(y, dense_w)
+
+            state["run"] = run
+        return {"OUTPUT": state["run"](INPUT)}
+
+    return JaxModel(cfg, fn, jit=False, output_labels={"OUTPUT": labels})
+
+
+def make_ensemble_scale_sum() -> Model:
+    """Ensemble DAG fixture (reference behavioral spec:
+    ensemble_image_client.py — preprocess -> model -> postprocess):
+    scale_by_two(INPUT0) -> simple(sum/diff with INPUT1) -> outputs."""
+    cfg = make_config(
+        "ensemble_scale_sum",
+        inputs=[("RAW0", "INT32", [1, 16]), ("RAW1", "INT32", [1, 16])],
+        outputs=[("SUM", "INT32", [1, 16]), ("DIFF", "INT32", [1, 16])],
+        platform="ensemble",
+        backend="",
+    )
+    step = cfg.ensemble_scheduling.step.add()
+    step.model_name = "scale_by_two"
+    step.input_map["INPUT"] = "RAW0"
+    step.output_map["OUTPUT"] = "scaled0"
+    step = cfg.ensemble_scheduling.step.add()
+    step.model_name = "simple"
+    step.input_map["INPUT0"] = "scaled0"
+    step.input_map["INPUT1"] = "RAW1"
+    step.output_map["OUTPUT0"] = "SUM"
+    step.output_map["OUTPUT1"] = "DIFF"
+    return EnsembleModel(cfg)
+
+
+def make_scale_by_two() -> JaxModel:
+    cfg = make_config(
+        "scale_by_two",
+        inputs=[("INPUT", "INT32", [1, 16])],
+        outputs=[("OUTPUT", "INT32", [1, 16])],
+        instance_kind="KIND_CPU",
+    )
+    import jax.numpy as jnp
+
+    def fn(INPUT):
+        return {"OUTPUT": jnp.multiply(INPUT, 2)}
+
+    return JaxModel(cfg, fn)
+
+
 def register_all(registry: ModelRegistry) -> None:
     registry.register_model(make_simple())
     registry.register_model(make_simple_identity())
@@ -265,3 +350,6 @@ def register_all(registry: ModelRegistry) -> None:
     registry.register_model(make_repeat_int32())
     registry.register_model(make_square_int32())
     registry.register_model(make_dense_tpu())
+    registry.register_model(make_simple_cnn())
+    registry.register_model(make_scale_by_two())
+    registry.register_model(make_ensemble_scale_sum())
