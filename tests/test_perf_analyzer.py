@@ -1,0 +1,86 @@
+"""perf_analyzer-equivalent tests: concurrency sweep against the live
+harness in every shared-memory mode (the measurement matrix driver for
+BASELINE configs #1/#4)."""
+
+import numpy as np
+import pytest
+
+from triton_client_tpu import perf_analyzer
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server.registry import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+
+
+@pytest.fixture(scope="module")
+def harness():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    h = ServerHarness(registry)
+    h.start()
+    yield h
+    h.stop()
+
+
+def test_parse_concurrency_range():
+    assert perf_analyzer._parse_concurrency_range("1") == [1]
+    assert perf_analyzer._parse_concurrency_range("1:4") == [1, 2, 3, 4]
+    assert perf_analyzer._parse_concurrency_range("2:8:2") == [2, 4, 6, 8]
+
+
+def test_parse_shapes():
+    assert perf_analyzer._parse_shapes(["INPUT0:3,224,224"]) == {
+        "INPUT0": [3, 224, 224]
+    }
+    with pytest.raises(ValueError):
+        perf_analyzer._parse_shapes(["8"])
+    with pytest.raises(ValueError):
+        perf_analyzer._parse_shapes(["INPUT0"])
+
+
+@pytest.mark.parametrize("protocol", ["http", "grpc"])
+@pytest.mark.parametrize("shm", ["none", "system", "xla"])
+def test_sweep_modes(harness, protocol, shm, capsys):
+    url = (f"127.0.0.1:{harness.grpc_port}" if protocol == "grpc"
+           else f"127.0.0.1:{harness.http_port}")
+    rc = perf_analyzer.main([
+        "-m", "simple", "-u", url, "-i", protocol,
+        "--concurrency-range", "2", "--measurement-interval", "500",
+        "--shared-memory", shm,
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "throughput" in out
+    # no shm leaks server-side
+    import triton_client_tpu.grpc as grpcclient
+
+    c = grpcclient.InferenceServerClient(f"127.0.0.1:{harness.grpc_port}")
+    sys_status = c.get_system_shared_memory_status(as_json=True)
+    cuda_status = c.get_cuda_shared_memory_status(as_json=True)
+    assert not sys_status.get("regions"), sys_status
+    assert not cuda_status.get("regions"), cuda_status
+    c.close()
+
+
+def test_batched_sweep_with_report(harness, tmp_path, capsys):
+    report = tmp_path / "latency.csv"
+    rc = perf_analyzer.main([
+        "-m", "identity_fp32", "-u", f"127.0.0.1:{harness.http_port}",
+        "-i", "http", "-b", "4", "--shape", "INPUT0:8",
+        "--concurrency-range", "1:3:2", "--measurement-interval", "400",
+        "--percentile", "99", "-f", str(report),
+    ])
+    assert rc == 0
+    lines = report.read_text().strip().splitlines()
+    assert lines[0].startswith("Concurrency,")
+    assert len(lines) == 3  # header + 2 levels
+    out = capsys.readouterr().out
+    assert out.count("Concurrency:") == 2
+
+
+def test_bytes_model_sweep(harness, capsys):
+    rc = perf_analyzer.main([
+        "-m", "simple_identity", "-u", f"127.0.0.1:{harness.http_port}",
+        "-i", "http", "-b", "2", "--shape", "INPUT0:2",
+        "--concurrency-range", "1", "--measurement-interval", "300",
+    ])
+    assert rc == 0, capsys.readouterr().out

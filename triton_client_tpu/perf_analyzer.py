@@ -1,0 +1,399 @@
+"""perf_analyzer-equivalent load generator.
+
+The reference repo ships only stub READMEs for perf_analyzer
+(src/c++/perf_analyzer/README.md:28-30 — source relocated), so this tool is
+designed from its CLI contract (SURVEY.md "critical absences"): closed-loop
+concurrency sweeps reporting infer/sec and latency percentiles, with
+``--shared-memory={none,system,cuda,xla}`` data-path modes (BASELINE north
+star: the ``cuda`` mode maps to TPU xla shared memory).
+
+Usage:
+    python -m triton_client_tpu.perf_analyzer -m simple -u localhost:8001 \
+        -i grpc --concurrency-range 1:8:2 --shared-memory system
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .utils import triton_to_np_dtype
+
+_SHM_MODES = ("none", "system", "cuda", "xla")
+
+
+@dataclass
+class _Stats:
+    latencies: List[float] = field(default_factory=list)
+    count: int = 0
+    errors: int = 0
+    first_error: Optional[str] = None
+
+
+def _parse_concurrency_range(spec: str):
+    parts = [int(p) for p in spec.split(":")]
+    start = parts[0]
+    end = parts[1] if len(parts) > 1 else start
+    step = parts[2] if len(parts) > 2 else 1
+    return list(range(start, end + 1, max(step, 1)))
+
+
+def _parse_shapes(shape_args: List[str]) -> Dict[str, List[int]]:
+    shapes = {}
+    for s in shape_args or []:
+        name, sep, dims = s.rpartition(":")
+        if not sep or not name or not dims:
+            raise ValueError(
+                f"invalid --shape '{s}': expected <input name>:<d1>[,<d2>...]"
+            )
+        shapes[name] = [int(d) for d in dims.split(",")]
+    return shapes
+
+
+def _resolve_model(client, protocol: str, model_name: str, model_version: str):
+    if protocol == "grpc":
+        md = client.get_model_metadata(model_name, model_version, as_json=True)
+        cfg = client.get_model_config(model_name, model_version, as_json=True)
+        if "config" in cfg:
+            cfg = cfg["config"]
+    else:
+        md = client.get_model_metadata(model_name, model_version)
+        cfg = client.get_model_config(model_name, model_version)
+    max_batch = int(cfg.get("max_batch_size", 0))
+    inputs = []
+    for i in md["inputs"]:
+        shape = [int(s) for s in i["shape"]]
+        inputs.append({"name": i["name"], "datatype": i["datatype"], "shape": shape})
+    outputs = [o["name"] for o in md["outputs"]]
+    return inputs, outputs, max_batch
+
+
+def _make_data(inputs, shapes, batch: int, max_batch: int, rng, string_length=16):
+    arrays = {}
+    for spec in inputs:
+        dims = list(shapes.get(spec["name"], []))
+        if not dims:
+            dims = list(spec["shape"])
+            if max_batch > 0:
+                dims = dims[1:]  # strip batch dim; re-added below
+            dims = [d if d > 0 else 1 for d in dims]
+        if max_batch > 0:
+            dims = [batch] + dims
+        dt = triton_to_np_dtype(spec["datatype"])
+        if spec["datatype"] == "BYTES":
+            arr = np.array(
+                [b"x" * string_length for _ in range(int(np.prod(dims)))],
+                dtype=np.object_,
+            ).reshape(dims)
+        elif np.issubdtype(dt, np.floating):
+            arr = rng.random(dims).astype(dt)
+        elif dt == np.bool_:
+            arr = rng.integers(0, 2, dims).astype(np.bool_)
+        else:
+            arr = rng.integers(0, 127, dims).astype(dt)
+        arrays[spec["name"]] = arr
+    return arrays
+
+
+class _ShmSetup:
+    """Per-worker shared-memory regions (registered under unique names)."""
+
+    def __init__(self, mode, protocol_mod, client, arrays, outputs, worker_id,
+                 output_byte_size):
+        self.mode = mode
+        self.handles = {}
+        self.client = client
+        self.names = []
+        self.output_byte_size = output_byte_size
+        if mode == "none":
+            return
+        if mode == "system":
+            from .utils import shared_memory as shm
+
+            self._shm = shm
+        else:
+            from .utils import xla_shared_memory as shm
+
+            self._shm = shm
+        try:
+            self._create_regions(arrays, outputs, worker_id, client)
+        except Exception:
+            self.cleanup()  # release whatever was created before the failure
+            raise
+
+    def _create_regions(self, arrays, outputs, worker_id, client):
+        for name, arr in arrays.items():
+            payload = _serialize(arr)
+            region = f"pa_in_{worker_id}_{name}"
+            if mode == "system":
+                h = self._shm.create_shared_memory_region(
+                    region, f"/{region}", payload.nbytes)
+                self._shm.set_shared_memory_region(h, [payload])
+                client.register_system_shared_memory(
+                    region, f"/{region}", payload.nbytes)
+            else:
+                h = self._shm.create_shared_memory_region(region, payload.nbytes, 0)
+                self._shm.set_shared_memory_region(h, [arr])
+                client.register_cuda_shared_memory(
+                    region, self._shm.get_raw_handle(h), 0, payload.nbytes)
+            self.handles[("in", name)] = (region, h, payload.nbytes)
+            self.names.append(region)
+        for name in outputs:
+            region = f"pa_out_{worker_id}_{name}"
+            if mode == "system":
+                h = self._shm.create_shared_memory_region(
+                    region, f"/{region}", output_byte_size)
+                client.register_system_shared_memory(
+                    region, f"/{region}", output_byte_size)
+            else:
+                h = self._shm.create_shared_memory_region(region, output_byte_size, 0)
+                client.register_cuda_shared_memory(
+                    region, self._shm.get_raw_handle(h), 0, output_byte_size)
+            self.handles[("out", name)] = (region, h, output_byte_size)
+            self.names.append(region)
+
+    def attach(self, infer_inputs, requested_outputs):
+        if self.mode == "none":
+            return
+        for inp in infer_inputs:
+            region, _h, nbytes = self.handles[("in", inp.name())]
+            inp.set_shared_memory(region, nbytes)
+        for out in requested_outputs:
+            region, _h, nbytes = self.handles[("out", out.name())]
+            out.set_shared_memory(region, nbytes)
+
+    def cleanup(self):
+        if self.mode == "none":
+            return
+        for (kind, _tname), (region, h, _n) in self.handles.items():
+            try:
+                if self.mode == "system":
+                    self.client.unregister_system_shared_memory(region)
+                else:
+                    self.client.unregister_cuda_shared_memory(region)
+            except Exception:
+                pass
+            try:
+                self._shm.destroy_shared_memory_region(h)
+            except Exception:
+                pass
+
+
+def _serialize(arr: np.ndarray) -> np.ndarray:
+    if arr.dtype == np.object_ or arr.dtype.kind in ("S", "U"):
+        from .utils import serialize_byte_tensor
+
+        return serialize_byte_tensor(arr)
+    return np.ascontiguousarray(arr)
+
+
+def _worker(protocol_mod, make_client, model_name, model_version, arrays,
+            outputs, shm_mode, output_byte_size, worker_id, stop, measuring,
+            stats: _Stats, lock):
+    client = make_client()
+    shm_setup = None
+    try:
+        infer_inputs = []
+        for name, arr in arrays.items():
+            from .utils import np_to_triton_dtype
+
+            dt = ("BYTES" if arr.dtype == np.object_
+                  else np_to_triton_dtype(arr.dtype))
+            inp = protocol_mod.InferInput(name, list(arr.shape), dt)
+            if shm_mode == "none":
+                inp.set_data_from_numpy(arr)
+            infer_inputs.append(inp)
+        requested = [protocol_mod.InferRequestedOutput(o) for o in outputs]
+        shm_setup = _ShmSetup(shm_mode, protocol_mod, client, arrays, outputs,
+                              worker_id, output_byte_size)
+        shm_setup.attach(infer_inputs, requested)
+        local: List[float] = []
+        n = 0
+        errs = 0
+        first_error = None
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            err = None
+            try:
+                client.infer(model_name, infer_inputs, outputs=requested,
+                             model_version=model_version)
+            except Exception as e:
+                err = e
+            dt_s = time.perf_counter() - t0
+            # `measuring` is cleared at the deadline, so completions landing
+            # after the window closes are not counted (would inflate infer/sec)
+            if measuring.is_set():
+                if err is None:
+                    local.append(dt_s)
+                    n += 1
+                else:
+                    errs += 1
+                    if first_error is None:
+                        first_error = f"{type(err).__name__}: {err}"
+        with lock:
+            stats.latencies.extend(local)
+            stats.count += n
+            stats.errors += errs
+            if stats.first_error is None and first_error is not None:
+                stats.first_error = first_error
+    finally:
+        if shm_setup is not None:
+            shm_setup.cleanup()
+        try:
+            client.close()
+        except Exception:
+            pass
+
+
+def run_level(protocol, url, model_name, model_version, concurrency, arrays,
+              outputs, shm_mode, output_byte_size, measure_s, warmup_s=1.0,
+              extra_percentile=None):
+    if protocol == "grpc":
+        import triton_client_tpu.grpc as protocol_mod
+
+        make_client = lambda: protocol_mod.InferenceServerClient(url)
+    else:
+        import triton_client_tpu.http as protocol_mod
+
+        make_client = lambda: protocol_mod.InferenceServerClient(
+            url, concurrency=concurrency)
+
+    stats = _Stats()
+    lock = threading.Lock()
+    stop = threading.Event()
+    measuring = threading.Event()
+    threads = [
+        threading.Thread(
+            target=_worker,
+            args=(protocol_mod, make_client, model_name, model_version, arrays,
+                  outputs, shm_mode, output_byte_size, w, stop, measuring,
+                  stats, lock),
+            daemon=True,
+        )
+        for w in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s)
+    measuring.set()
+    t0 = time.perf_counter()
+    time.sleep(measure_s)
+    measuring.clear()
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    lat = np.sort(np.asarray(stats.latencies)) * 1e6  # usec
+    res = {
+        "concurrency": concurrency,
+        "throughput": stats.count / elapsed,
+        "errors": stats.errors,
+        "first_error": stats.first_error,
+        "avg_us": float(lat.mean()) if lat.size else float("nan"),
+        "p50_us": float(np.percentile(lat, 50)) if lat.size else float("nan"),
+        "p90_us": float(np.percentile(lat, 90)) if lat.size else float("nan"),
+        "p95_us": float(np.percentile(lat, 95)) if lat.size else float("nan"),
+        "p99_us": float(np.percentile(lat, 99)) if lat.size else float("nan"),
+    }
+    if extra_percentile is not None:
+        key = f"p{extra_percentile}_us"
+        res[key] = (float(np.percentile(lat, extra_percentile))
+                    if lat.size else float("nan"))
+    return res
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="perf_analyzer",
+        description="Concurrency-sweep load generator (perf_analyzer CLI contract)")
+    parser.add_argument("-m", "--model-name", required=True)
+    parser.add_argument("-x", "--model-version", default="")
+    parser.add_argument("-u", "--url", default=None)
+    parser.add_argument("-i", "--protocol", default="http",
+                        type=str.lower, choices=["http", "grpc"])
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("--concurrency-range", default="1",
+                        help="start:end:step closed-loop concurrency sweep")
+    parser.add_argument("--measurement-interval", type=int, default=5000,
+                        help="measurement window per level (ms)")
+    parser.add_argument("--shared-memory", default="none", choices=_SHM_MODES)
+    parser.add_argument("--output-shared-memory-size", type=int, default=102400)
+    parser.add_argument("--shape", action="append", default=[],
+                        help="name:d1,d2,... override for dynamic dims")
+    parser.add_argument("--string-length", type=int, default=16)
+    parser.add_argument("--percentile", type=int, default=None,
+                        help="report this percentile as the headline latency")
+    parser.add_argument("-f", "--latency-report-file", default=None)
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    url = args.url or ("localhost:8001" if args.protocol == "grpc" else "localhost:8000")
+    if args.protocol == "grpc":
+        import triton_client_tpu.grpc as pm
+
+        meta_client = pm.InferenceServerClient(url)
+    else:
+        import triton_client_tpu.http as pm
+
+        meta_client = pm.InferenceServerClient(url)
+    inputs, outputs, max_batch = _resolve_model(
+        meta_client, args.protocol, args.model_name, args.model_version)
+    meta_client.close()
+    if args.batch_size > 1 and max_batch == 0:
+        print(f"error: model {args.model_name} does not support batching",
+              file=sys.stderr)
+        return 1
+
+    rng = np.random.default_rng(0)
+    try:
+        shapes = _parse_shapes(args.shape)
+    except ValueError as e:
+        parser.error(str(e))
+    arrays = _make_data(inputs, shapes, args.batch_size,
+                        max_batch, rng, args.string_length)
+
+    levels = _parse_concurrency_range(args.concurrency_range)
+    measure_s = args.measurement_interval / 1000.0
+    results = []
+    print(f"*** Measurement Settings ***\n"
+          f"  Batch size: {args.batch_size}\n"
+          f"  Measurement window: {args.measurement_interval} msec\n"
+          f"  Shared memory: {args.shared_memory}\n"
+          f"  Protocol: {args.protocol} @ {url}\n")
+    for level in levels:
+        res = run_level(
+            args.protocol, url, args.model_name, args.model_version, level,
+            arrays, outputs, args.shared_memory, args.output_shared_memory_size,
+            measure_s, extra_percentile=args.percentile)
+        results.append(res)
+        headline = (res[f"p{args.percentile}_us"]
+                    if args.percentile is not None else res["avg_us"])
+        print(f"Concurrency: {level}, throughput: {res['throughput']:.2f} "
+              f"infer/sec, latency {headline:.0f} usec"
+              + (f" ({res['errors']} errors)" if res["errors"] else ""))
+        if res["errors"] and res.get("first_error"):
+            print(f"  first error: {res['first_error']}")
+        if args.verbose:
+            print(f"  p50: {res['p50_us']:.0f} us, p90: {res['p90_us']:.0f} us, "
+                  f"p95: {res['p95_us']:.0f} us, p99: {res['p99_us']:.0f} us")
+
+    if args.latency_report_file:
+        with open(args.latency_report_file, "w") as f:
+            f.write("Concurrency,Inferences/Second,Avg latency,"
+                    "p50 latency,p90 latency,p95 latency,p99 latency\n")
+            for r in results:
+                f.write(f"{r['concurrency']},{r['throughput']:.2f},"
+                        f"{r['avg_us']:.0f},{r['p50_us']:.0f},{r['p90_us']:.0f},"
+                        f"{r['p95_us']:.0f},{r['p99_us']:.0f}\n")
+    failed = all(r["throughput"] == 0 for r in results)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
