@@ -131,7 +131,7 @@ class _ShmSetup:
         for name, arr in arrays.items():
             payload = _serialize(arr)
             region = f"pa_in_{worker_id}_{name}"
-            if mode == "system":
+            if self.mode == "system":
                 h = self._shm.create_shared_memory_region(
                     region, f"/{region}", payload.nbytes)
                 self._shm.set_shared_memory_region(h, [payload])
@@ -146,16 +146,16 @@ class _ShmSetup:
             self.names.append(region)
         for name in outputs:
             region = f"pa_out_{worker_id}_{name}"
-            if mode == "system":
+            if self.mode == "system":
                 h = self._shm.create_shared_memory_region(
-                    region, f"/{region}", output_byte_size)
+                    region, f"/{region}", self.output_byte_size)
                 client.register_system_shared_memory(
-                    region, f"/{region}", output_byte_size)
+                    region, f"/{region}", self.output_byte_size)
             else:
-                h = self._shm.create_shared_memory_region(region, output_byte_size, 0)
+                h = self._shm.create_shared_memory_region(region, self.output_byte_size, 0)
                 client.register_cuda_shared_memory(
-                    region, self._shm.get_raw_handle(h), 0, output_byte_size)
-            self.handles[("out", name)] = (region, h, output_byte_size)
+                    region, self._shm.get_raw_handle(h), 0, self.output_byte_size)
+            self.handles[("out", name)] = (region, h, self.output_byte_size)
             self.names.append(region)
 
     def attach(self, infer_inputs, requested_outputs):
