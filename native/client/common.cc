@@ -1,0 +1,211 @@
+#include "common.h"
+
+#include <ostream>
+
+namespace tc_tpu {
+namespace client {
+
+const Error Error::Success;
+
+std::ostream& operator<<(std::ostream& out, const Error& err) {
+  if (!err.IsOk()) out << "error: " << err.Message();
+  return out;
+}
+
+//==============================================================================
+Error InferInput::Create(
+    InferInput** infer_input, const std::string& name,
+    const std::vector<int64_t>& dims, const std::string& datatype) {
+  if (name.empty()) return Error("input name must not be empty");
+  *infer_input = new InferInput(name, dims, datatype);
+  return Error::Success;
+}
+
+InferInput::InferInput(
+    const std::string& name, const std::vector<int64_t>& dims,
+    const std::string& datatype)
+    : name_(name), shape_(dims), datatype_(datatype) {}
+
+Error InferInput::SetShape(const std::vector<int64_t>& dims) {
+  shape_ = dims;
+  return Error::Success;
+}
+
+Error InferInput::AppendRaw(const uint8_t* input, size_t input_byte_size) {
+  if (io_type_ == IOType::kSharedMemory) {
+    return Error(
+        "The input '" + name_ +
+        "' has already been set with SetSharedMemory(); Reset() first");
+  }
+  io_type_ = IOType::kRaw;
+  bufs_.emplace_back(input, input_byte_size);
+  total_byte_size_ += input_byte_size;
+  return Error::Success;
+}
+
+Error InferInput::AppendRaw(const std::vector<uint8_t>& input) {
+  return AppendRaw(input.data(), input.size());
+}
+
+Error InferInput::AppendFromString(const std::vector<std::string>& input) {
+  std::string serialized;
+  SerializeStringTensor(input, &serialized);
+  owned_.push_back(std::move(serialized));
+  const std::string& stored = owned_.back();
+  return AppendRaw(
+      reinterpret_cast<const uint8_t*>(stored.data()), stored.size());
+}
+
+Error InferInput::SetSharedMemory(
+    const std::string& region_name, size_t byte_size, size_t offset) {
+  if (io_type_ == IOType::kRaw) {
+    return Error(
+        "The input '" + name_ +
+        "' has already been set with AppendRaw(); Reset() first");
+  }
+  io_type_ = IOType::kSharedMemory;
+  shm_region_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success;
+}
+
+Error InferInput::Reset() {
+  io_type_ = IOType::kNone;
+  bufs_.clear();
+  owned_.clear();
+  total_byte_size_ = 0;
+  gather_index_ = 0;
+  gather_offset_ = 0;
+  shm_region_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success;
+}
+
+void InferInput::PrepareForRequest() const {
+  gather_index_ = 0;
+  gather_offset_ = 0;
+}
+
+Error InferInput::GetNext(
+    uint8_t* buf, size_t size, size_t* input_bytes, bool* end_of_input) const {
+  size_t copied = 0;
+  while (copied < size && gather_index_ < bufs_.size()) {
+    const auto& [ptr, len] = bufs_[gather_index_];
+    size_t remaining = len - gather_offset_;
+    size_t to_copy = std::min(remaining, size - copied);
+    std::memcpy(buf + copied, ptr + gather_offset_, to_copy);
+    copied += to_copy;
+    gather_offset_ += to_copy;
+    if (gather_offset_ == len) {
+      ++gather_index_;
+      gather_offset_ = 0;
+    }
+  }
+  *input_bytes = copied;
+  *end_of_input = (gather_index_ >= bufs_.size());
+  return Error::Success;
+}
+
+Error InferInput::GetNext(
+    const uint8_t** buf, size_t* input_bytes, bool* end_of_input) const {
+  if (gather_index_ < bufs_.size()) {
+    *buf = bufs_[gather_index_].first;
+    *input_bytes = bufs_[gather_index_].second;
+    ++gather_index_;
+  } else {
+    *buf = nullptr;
+    *input_bytes = 0;
+  }
+  *end_of_input = (gather_index_ >= bufs_.size());
+  return Error::Success;
+}
+
+//==============================================================================
+Error InferRequestedOutput::Create(
+    InferRequestedOutput** infer_output, const std::string& name,
+    size_t class_count) {
+  if (name.empty()) return Error("output name must not be empty");
+  *infer_output = new InferRequestedOutput(name, class_count);
+  return Error::Success;
+}
+
+Error InferRequestedOutput::SetSharedMemory(
+    const std::string& region_name, size_t byte_size, size_t offset) {
+  is_shm_ = true;
+  shm_region_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success;
+}
+
+Error InferRequestedOutput::UnsetSharedMemory() {
+  is_shm_ = false;
+  shm_region_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success;
+}
+
+//==============================================================================
+Error InferResult::StringData(
+    const std::string& output_name, std::vector<std::string>* string_result) const {
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  TC_RETURN_IF_ERROR(RawData(output_name, &buf, &byte_size));
+  return DeserializeStringTensor(buf, byte_size, string_result);
+}
+
+Error InferResult::IsFinalResponse(bool* is_final_response) const {
+  *is_final_response = true;
+  return Error::Success;
+}
+
+Error InferResult::IsNullResponse(bool* is_null_response) const {
+  *is_null_response = false;
+  return Error::Success;
+}
+
+//==============================================================================
+void InferenceServerClient::UpdateInferStat(const RequestTimers& timer) {
+  infer_stat_.completed_request_count++;
+  infer_stat_.cumulative_total_request_time_ns += timer.Duration(
+      RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);
+  infer_stat_.cumulative_send_time_ns += timer.Duration(
+      RequestTimers::Kind::SEND_START, RequestTimers::Kind::SEND_END);
+  infer_stat_.cumulative_receive_time_ns += timer.Duration(
+      RequestTimers::Kind::RECV_START, RequestTimers::Kind::RECV_END);
+}
+
+//==============================================================================
+void SerializeStringTensor(
+    const std::vector<std::string>& strings, std::string* out) {
+  for (const auto& s : strings) {
+    uint32_t len = static_cast<uint32_t>(s.size());
+    out->append(reinterpret_cast<const char*>(&len), sizeof(len));  // LE host
+    out->append(s);
+  }
+}
+
+Error DeserializeStringTensor(
+    const uint8_t* data, size_t size, std::vector<std::string>* out) {
+  size_t pos = 0;
+  while (pos < size) {
+    if (pos + sizeof(uint32_t) > size) {
+      return Error("string tensor is truncated: bad length prefix");
+    }
+    uint32_t len;
+    std::memcpy(&len, data + pos, sizeof(len));
+    pos += sizeof(len);
+    if (pos + len > size) {
+      return Error("string tensor is truncated: element exceeds buffer");
+    }
+    out->emplace_back(reinterpret_cast<const char*>(data + pos), len);
+    pos += len;
+  }
+  return Error::Success;
+}
+
+}  // namespace client
+}  // namespace tc_tpu

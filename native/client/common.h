@@ -1,0 +1,266 @@
+// C++ client common core.
+//
+// Parity target: reference src/c++/library/common.h (676 LoC) — same public
+// classes and semantics: Error value type (:61-83), InferOptions (:164-231),
+// InferInput with a scatter-gather buffer list (:282-369), BYTES
+// serialization <u32 len><chars> (common.cc:169-183), shm binding state
+// machine IOType{NONE,RAW,SHARED_MEMORY} (:388-392), InferRequestedOutput
+// (:400-482), abstract InferResult incl. decoupled final/null response
+// queries (:488-563), RequestTimers 6-point nanosecond timestamps
+// (:568-648), InferStat accounting (:93-114).
+//
+// Re-designed, not ported: no CUDA types — the device data path registers
+// XLA buffers by handle (see xla shm registries); transports are
+// socket-based (http_client.h) and gRPC-Web framed (grpc_client.h).
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace tc_tpu {
+namespace client {
+
+//==============================================================================
+class Error {
+ public:
+  Error() : has_error_(false) {}
+  explicit Error(const std::string& msg) : has_error_(true), msg_(msg) {}
+
+  static const Error Success;
+
+  bool IsOk() const { return !has_error_; }
+  const std::string& Message() const { return msg_; }
+
+  friend std::ostream& operator<<(std::ostream&, const Error&);
+
+ private:
+  bool has_error_;
+  std::string msg_;
+};
+
+#define TC_RETURN_IF_ERROR(expr)          \
+  do {                                    \
+    const tc_tpu::client::Error err__ = (expr); \
+    if (!err__.IsOk()) return err__;      \
+  } while (false)
+
+//==============================================================================
+// Request options (reference common.h:164-231).
+struct InferOptions {
+  explicit InferOptions(const std::string& model_name)
+      : model_name_(model_name) {}
+
+  std::string model_name_;
+  std::string model_version_;
+  std::string request_id_;
+  uint64_t sequence_id_ = 0;
+  std::string sequence_id_str_;  // string correlation id (dyna sequences)
+  bool sequence_start_ = false;
+  bool sequence_end_ = false;
+  uint64_t priority_ = 0;
+  uint64_t server_timeout_us_ = 0;  // request timeout forwarded to server
+  uint64_t client_timeout_us_ = 0;  // client-side deadline
+  bool triton_enable_empty_final_response_ = false;
+  std::map<std::string, std::string> request_parameters_;
+};
+
+//==============================================================================
+// Input tensor with scatter-gather data references (reference
+// common.h:282-369: AppendRaw keeps caller pointers; GetNext streams
+// chunks so transports copy at most once).
+class InferInput {
+ public:
+  enum class IOType { kNone, kRaw, kSharedMemory };
+
+  static Error Create(
+      InferInput** infer_input, const std::string& name,
+      const std::vector<int64_t>& dims, const std::string& datatype);
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  const std::vector<int64_t>& Shape() const { return shape_; }
+  Error SetShape(const std::vector<int64_t>& dims);
+
+  // Append a raw chunk; the caller keeps the buffer alive until the request
+  // completes (zero-copy into the transport).
+  Error AppendRaw(const uint8_t* input, size_t input_byte_size);
+  Error AppendRaw(const std::vector<uint8_t>& input);
+  // BYTES tensors: serialize <u32 len><bytes> per element.
+  Error AppendFromString(const std::vector<std::string>& input);
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0);
+  Error Reset();
+
+  // Scatter-gather iteration for transports.
+  size_t TotalByteSize() const { return total_byte_size_; }
+  void PrepareForRequest() const;
+  // Copy up to size bytes into buf; *input_bytes = copied, *end_of_input set
+  // when the gather list is exhausted (curl-style provider).
+  Error GetNext(uint8_t* buf, size_t size, size_t* input_bytes,
+                bool* end_of_input) const;
+  // Zero-copy chunk access (grpc-style).
+  Error GetNext(const uint8_t** buf, size_t* input_bytes,
+                bool* end_of_input) const;
+
+  IOType Type() const { return io_type_; }
+  const std::string& SharedMemoryRegion() const { return shm_region_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+ private:
+  InferInput(const std::string& name, const std::vector<int64_t>& dims,
+             const std::string& datatype);
+
+  std::string name_;
+  std::vector<int64_t> shape_;
+  std::string datatype_;
+  IOType io_type_ = IOType::kNone;
+
+  std::vector<std::pair<const uint8_t*, size_t>> bufs_;
+  std::vector<std::string> owned_;  // storage for serialized BYTES payloads
+  size_t total_byte_size_ = 0;
+  mutable size_t gather_index_ = 0;
+  mutable size_t gather_offset_ = 0;
+
+  std::string shm_region_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+//==============================================================================
+// Requested output (reference common.h:400-482).
+class InferRequestedOutput {
+ public:
+  static Error Create(
+      InferRequestedOutput** infer_output, const std::string& name,
+      size_t class_count = 0);
+
+  const std::string& Name() const { return name_; }
+  size_t ClassCount() const { return class_count_; }
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0);
+  Error UnsetSharedMemory();
+
+  bool IsSharedMemory() const { return is_shm_; }
+  const std::string& SharedMemoryRegion() const { return shm_region_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+ private:
+  InferRequestedOutput(const std::string& name, size_t class_count)
+      : name_(name), class_count_(class_count) {}
+
+  std::string name_;
+  size_t class_count_;
+  bool is_shm_ = false;
+  std::string shm_region_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+//==============================================================================
+// Abstract inference result (reference common.h:488-563).
+class InferResult {
+ public:
+  virtual ~InferResult() = default;
+
+  virtual Error ModelName(std::string* name) const = 0;
+  virtual Error ModelVersion(std::string* version) const = 0;
+  virtual Error Id(std::string* id) const = 0;
+  virtual Error Shape(
+      const std::string& output_name, std::vector<int64_t>* shape) const = 0;
+  virtual Error Datatype(
+      const std::string& output_name, std::string* datatype) const = 0;
+  virtual Error RawData(
+      const std::string& output_name, const uint8_t** buf,
+      size_t* byte_size) const = 0;
+  // BYTES output -> vector of strings (reference StringData).
+  virtual Error StringData(
+      const std::string& output_name,
+      std::vector<std::string>* string_result) const;
+  virtual Error IsFinalResponse(bool* is_final_response) const;
+  virtual Error IsNullResponse(bool* is_null_response) const;
+  virtual Error RequestStatus() const = 0;
+  virtual std::string DebugString() const = 0;
+};
+
+//==============================================================================
+// Six-point request timers (reference common.h:568-648).
+class RequestTimers {
+ public:
+  enum class Kind : int {
+    REQUEST_START = 0,
+    REQUEST_END = 1,
+    SEND_START = 2,
+    SEND_END = 3,
+    RECV_START = 4,
+    RECV_END = 5,
+    COUNT__ = 6,
+  };
+
+  RequestTimers() { Reset(); }
+  void Reset() {
+    for (auto& t : timestamps_) t = 0;
+  }
+  void CaptureTimestamp(Kind kind) {
+    timestamps_[static_cast<int>(kind)] =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count();
+  }
+  uint64_t Timestamp(Kind kind) const {
+    return timestamps_[static_cast<int>(kind)];
+  }
+  uint64_t Duration(Kind start, Kind end) const {
+    uint64_t s = Timestamp(start), e = Timestamp(end);
+    return (s == 0 || e == 0 || e < s) ? 0 : e - s;
+  }
+
+ private:
+  uint64_t timestamps_[static_cast<int>(Kind::COUNT__)];
+};
+
+//==============================================================================
+// Cumulative client-side statistics (reference common.h:93-114).
+struct InferStat {
+  size_t completed_request_count = 0;
+  uint64_t cumulative_total_request_time_ns = 0;
+  uint64_t cumulative_send_time_ns = 0;
+  uint64_t cumulative_receive_time_ns = 0;
+};
+
+//==============================================================================
+// Base client: stat accounting shared by both transports (reference
+// common.h:119-153; the worker thread lives in each transport).
+class InferenceServerClient {
+ public:
+  explicit InferenceServerClient(bool verbose) : verbose_(verbose) {}
+  virtual ~InferenceServerClient() = default;
+
+  Error ClientInferStat(InferStat* infer_stat) const {
+    *infer_stat = infer_stat_;
+    return Error::Success;
+  }
+
+ protected:
+  void UpdateInferStat(const RequestTimers& timer);
+
+  bool verbose_;
+  InferStat infer_stat_;
+};
+
+// BYTES wire helpers (reference common.cc:169-183 / utils __init__.py:193).
+void SerializeStringTensor(
+    const std::vector<std::string>& strings, std::string* out);
+Error DeserializeStringTensor(
+    const uint8_t* data, size_t size, std::vector<std::string>* out);
+
+}  // namespace client
+}  // namespace tc_tpu

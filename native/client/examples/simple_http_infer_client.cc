@@ -1,0 +1,69 @@
+// C++ HTTP example (reference src/c++/examples/simple_http_infer_client.cc
+// behavior: 2x INT32[1,16] -> sum/diff against `simple`).
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+  }
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  std::vector<int32_t> input0(16), input1(16);
+  for (int i = 0; i < 16; ++i) {
+    input0[i] = i;
+    input1[i] = 1;
+  }
+  tc::InferInput* in0;
+  tc::InferInput* in1;
+  tc::InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32");
+  tc::InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32");
+  in0->AppendRaw(reinterpret_cast<const uint8_t*>(input0.data()),
+                 input0.size() * sizeof(int32_t));
+  in1->AppendRaw(reinterpret_cast<const uint8_t*>(input1.data()),
+                 input1.size() * sizeof(int32_t));
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  err = client->Infer(&result, options, {in0, in1});
+  if (!err.IsOk()) {
+    fprintf(stderr, "inference failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  const uint8_t* buf;
+  size_t len;
+  result->RawData("OUTPUT0", &buf, &len);
+  const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (sum[i] != input0[i] + input1[i]) {
+      fprintf(stderr, "sum mismatch at %d\n", i);
+      return 1;
+    }
+  }
+  result->RawData("OUTPUT1", &buf, &len);
+  const int32_t* diff = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (diff[i] != input0[i] - input1[i]) {
+      fprintf(stderr, "diff mismatch at %d\n", i);
+      return 1;
+    }
+  }
+  delete result;
+  delete in0;
+  delete in1;
+  printf("PASS: infer\n");
+  return 0;
+}

@@ -1,0 +1,117 @@
+// C++ system-shm example (reference src/c++/examples/simple_http_shm_client.cc
+// behavior): create/map POSIX shm, register, infer with shm inputs+outputs,
+// read results from the region, unregister/unlink.
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+  }
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  client->UnregisterSystemSharedMemory();
+
+  const size_t nbytes = 16 * sizeof(int32_t);
+  const char* in_key = "/cc_input_shm";
+  const char* out_key = "/cc_output_shm";
+
+  // create + map the input region (both tensors at offsets)
+  shm_unlink(in_key);
+  shm_unlink(out_key);
+  int in_fd = shm_open(in_key, O_RDWR | O_CREAT, 0600);
+  int out_fd = shm_open(out_key, O_RDWR | O_CREAT, 0600);
+  if (in_fd < 0 || out_fd < 0 || ftruncate(in_fd, 2 * nbytes) != 0 ||
+      ftruncate(out_fd, 2 * nbytes) != 0) {
+    fprintf(stderr, "shm setup failed\n");
+    return 1;
+  }
+  int32_t* in_ptr = static_cast<int32_t*>(mmap(
+      nullptr, 2 * nbytes, PROT_READ | PROT_WRITE, MAP_SHARED, in_fd, 0));
+  int32_t* out_ptr = static_cast<int32_t*>(mmap(
+      nullptr, 2 * nbytes, PROT_READ | PROT_WRITE, MAP_SHARED, out_fd, 0));
+  for (int i = 0; i < 16; ++i) {
+    in_ptr[i] = i;       // INPUT0 at offset 0
+    in_ptr[16 + i] = 1;  // INPUT1 at offset nbytes
+  }
+
+  err = client->RegisterSystemSharedMemory("input_data", in_key, 2 * nbytes);
+  if (!err.IsOk()) {
+    fprintf(stderr, "register input failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  err = client->RegisterSystemSharedMemory("output_data", out_key, 2 * nbytes);
+  if (!err.IsOk()) {
+    fprintf(stderr, "register output failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  tc::InferInput* in0;
+  tc::InferInput* in1;
+  tc::InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32");
+  tc::InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32");
+  in0->SetSharedMemory("input_data", nbytes, 0);
+  in1->SetSharedMemory("input_data", nbytes, nbytes);
+  tc::InferRequestedOutput* out0;
+  tc::InferRequestedOutput* out1;
+  tc::InferRequestedOutput::Create(&out0, "OUTPUT0");
+  tc::InferRequestedOutput::Create(&out1, "OUTPUT1");
+  out0->SetSharedMemory("output_data", nbytes, 0);
+  out1->SetSharedMemory("output_data", nbytes, nbytes);
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  err = client->Infer(&result, options, {in0, in1}, {out0, out1});
+  if (!err.IsOk()) {
+    fprintf(stderr, "inference failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  for (int i = 0; i < 16; ++i) {
+    if (out_ptr[i] != in_ptr[i] + in_ptr[16 + i]) {
+      fprintf(stderr, "sum mismatch at %d\n", i);
+      return 1;
+    }
+    if (out_ptr[16 + i] != in_ptr[i] - in_ptr[16 + i]) {
+      fprintf(stderr, "diff mismatch at %d\n", i);
+      return 1;
+    }
+  }
+
+  std::string status;
+  client->SystemSharedMemoryStatus(&status);
+  if (status.find("input_data") == std::string::npos) {
+    fprintf(stderr, "region missing from status: %s\n", status.c_str());
+    return 1;
+  }
+  client->UnregisterSystemSharedMemory();
+
+  delete result;
+  delete in0;
+  delete in1;
+  delete out0;
+  delete out1;
+  munmap(in_ptr, 2 * nbytes);
+  munmap(out_ptr, 2 * nbytes);
+  close(in_fd);
+  close(out_fd);
+  shm_unlink(in_key);
+  shm_unlink(out_key);
+  printf("PASS: system shared memory\n");
+  return 0;
+}

@@ -1,0 +1,646 @@
+#include "grpc_client.h"
+
+#include <cstring>
+
+namespace tc_tpu {
+namespace client {
+
+namespace {
+
+constexpr char kServicePath[] = "inference.GRPCInferenceService";
+
+std::string Frame(const std::string& payload, uint8_t flags = 0) {
+  std::string out;
+  out.reserve(5 + payload.size());
+  out.push_back(static_cast<char>(flags));
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  out.push_back(static_cast<char>((len >> 24) & 0xFF));
+  out.push_back(static_cast<char>((len >> 16) & 0xFF));
+  out.push_back(static_cast<char>((len >> 8) & 0xFF));
+  out.push_back(static_cast<char>(len & 0xFF));
+  out.append(payload);
+  return out;
+}
+
+// Split a grpc-web body into data frames + trailer text.
+Error ParseFrames(
+    const std::string& body, std::vector<std::string>* data_frames,
+    std::string* trailers) {
+  size_t pos = 0;
+  while (pos + 5 <= body.size()) {
+    uint8_t flags = static_cast<uint8_t>(body[pos]);
+    uint32_t len = (static_cast<uint8_t>(body[pos + 1]) << 24) |
+                   (static_cast<uint8_t>(body[pos + 2]) << 16) |
+                   (static_cast<uint8_t>(body[pos + 3]) << 8) |
+                   static_cast<uint8_t>(body[pos + 4]);
+    pos += 5;
+    if (pos + len > body.size()) {
+      return Error("truncated grpc-web frame in response");
+    }
+    if (flags & 0x80) {
+      trailers->assign(body, pos, len);
+    } else {
+      data_frames->emplace_back(body.substr(pos, len));
+    }
+    pos += len;
+  }
+  return Error::Success;
+}
+
+std::string PercentDecode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      out.push_back(static_cast<char>(
+          strtol(s.substr(i + 1, 2).c_str(), nullptr, 16)));
+      i += 2;
+    } else {
+      out.push_back(s[i]);
+    }
+  }
+  return out;
+}
+
+Error StatusFromTrailers(const std::string& trailers) {
+  int status = 0;
+  std::string message;
+  size_t pos = 0;
+  while (pos < trailers.size()) {
+    size_t nl = trailers.find("\r\n", pos);
+    if (nl == std::string::npos) nl = trailers.size();
+    std::string line = trailers.substr(pos, nl - pos);
+    pos = nl + 2;
+    auto colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = line.substr(0, colon);
+    std::string value = line.substr(colon + 1);
+    if (key == "grpc-status") status = atoi(value.c_str());
+    if (key == "grpc-message") message = PercentDecode(value);
+  }
+  if (status == 0) return Error::Success;
+  return Error(message.empty() ? ("rpc failed with status " +
+                                  std::to_string(status))
+                               : message);
+}
+
+void SetParam(pb::ModelInferRequest* request, const std::string& key,
+              int64_t value) {
+  (*request->mutable_parameters())[key].set_int64_param(value);
+}
+
+// Result over a ModelInferResponse (reference InferResultGrpc,
+// grpc_client.cc).  raw_output_contents are indexed positionally across
+// non-shm outputs (reference _infer_result.py:63-97).
+class InferResultGrpcImpl : public InferResult {
+ public:
+  explicit InferResultGrpcImpl(pb::ModelInferResponse response)
+      : response_(std::move(response)) {
+    // raw_output_contents holds entries ONLY for non-shm outputs, in output
+    // order (reference positional indexing, _infer_result.py:63-97)
+    int raw_index = 0;
+    for (const auto& out : response_.outputs()) {
+      if (out.parameters().count("shared_memory_region")) continue;
+      if (raw_index < response_.raw_output_contents_size()) {
+        raw_index_[out.name()] = raw_index;
+        ++raw_index;
+      }
+    }
+  }
+
+  Error ModelName(std::string* name) const override {
+    *name = response_.model_name();
+    return Error::Success;
+  }
+  Error ModelVersion(std::string* version) const override {
+    *version = response_.model_version();
+    return Error::Success;
+  }
+  Error Id(std::string* id) const override {
+    *id = response_.id();
+    return Error::Success;
+  }
+
+  Error Shape(const std::string& output_name,
+              std::vector<int64_t>* shape) const override {
+    const auto* out = FindOutput(output_name);
+    if (!out) return Error("output '" + output_name + "' not found");
+    shape->assign(out->shape().begin(), out->shape().end());
+    return Error::Success;
+  }
+
+  Error Datatype(const std::string& output_name,
+                 std::string* datatype) const override {
+    const auto* out = FindOutput(output_name);
+    if (!out) return Error("output '" + output_name + "' not found");
+    *datatype = out->datatype();
+    return Error::Success;
+  }
+
+  Error RawData(const std::string& output_name, const uint8_t** buf,
+                size_t* byte_size) const override {
+    auto it = raw_index_.find(output_name);
+    if (it == raw_index_.end()) {
+      return Error("output '" + output_name + "' has no raw data");
+    }
+    const std::string& blob = response_.raw_output_contents(it->second);
+    *buf = reinterpret_cast<const uint8_t*>(blob.data());
+    *byte_size = blob.size();
+    return Error::Success;
+  }
+
+  Error IsFinalResponse(bool* is_final) const override {
+    auto it = response_.parameters().find("triton_final_response");
+    *is_final = it != response_.parameters().end() && it->second.bool_param();
+    return Error::Success;
+  }
+
+  Error IsNullResponse(bool* is_null) const override {
+    *is_null = response_.outputs_size() == 0;
+    return Error::Success;
+  }
+
+  Error RequestStatus() const override { return Error::Success; }
+  std::string DebugString() const override { return response_.DebugString(); }
+
+  const pb::ModelInferResponse& Response() const { return response_; }
+
+ private:
+  const pb::ModelInferResponse::InferOutputTensor* FindOutput(
+      const std::string& name) const {
+    for (const auto& out : response_.outputs()) {
+      if (out.name() == name) return &out;
+    }
+    return nullptr;
+  }
+
+  pb::ModelInferResponse response_;
+  std::map<std::string, int> raw_index_;
+};
+
+class ErrorResult : public InferResult {
+ public:
+  explicit ErrorResult(Error e) : err_(std::move(e)) {}
+  Error ModelName(std::string*) const override { return err_; }
+  Error ModelVersion(std::string*) const override { return err_; }
+  Error Id(std::string*) const override { return err_; }
+  Error Shape(const std::string&, std::vector<int64_t>*) const override {
+    return err_;
+  }
+  Error Datatype(const std::string&, std::string*) const override {
+    return err_;
+  }
+  Error RawData(const std::string&, const uint8_t**, size_t*) const override {
+    return err_;
+  }
+  Error RequestStatus() const override { return err_; }
+  std::string DebugString() const override { return err_.Message(); }
+
+ private:
+  Error err_;
+};
+
+}  // namespace
+
+//==============================================================================
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client,
+    const std::string& server_url, bool verbose) {
+  client->reset(new InferenceServerGrpcClient(server_url, verbose));
+  if ((*client)->transport_->port() <= 0) {
+    return Error("invalid server url '" + server_url + "'");
+  }
+  return Error::Success;
+}
+
+InferenceServerGrpcClient::InferenceServerGrpcClient(
+    const std::string& url, bool verbose)
+    : InferenceServerClient(verbose) {
+  std::string host = url;
+  int port = 8001;
+  auto colon = url.rfind(':');
+  if (colon != std::string::npos) {
+    host = url.substr(0, colon);
+    port = atoi(url.substr(colon + 1).c_str());
+  }
+  transport_.reset(new HttpTransport(host, port, 8));
+}
+
+InferenceServerGrpcClient::~InferenceServerGrpcClient() {
+  {
+    std::lock_guard<std::mutex> lk(job_mu_);
+    exiting_ = true;
+  }
+  job_cv_.notify_all();
+  for (auto& w : workers_) {
+    if (w.joinable()) w.join();
+  }
+}
+
+//==============================================================================
+Error InferenceServerGrpcClient::Call(
+    const std::string& method, const google::protobuf::Message& request,
+    google::protobuf::Message* response, const Headers& headers,
+    RequestTimers* timers) {
+  std::string body = Frame(request.SerializeAsString());
+  Headers h = headers;
+  h["Content-Type"] = "application/grpc-web+proto";
+  HttpTransport::Response resp;
+  TC_RETURN_IF_ERROR(transport_->Request(
+      "POST", std::string(kServicePath) + "/" + method, body, h, &resp,
+      timers));
+  if (resp.status != 200) {
+    return Error("grpc-web request failed with HTTP status " +
+                 std::to_string(resp.status));
+  }
+  std::vector<std::string> frames;
+  std::string trailers;
+  TC_RETURN_IF_ERROR(ParseFrames(resp.body, &frames, &trailers));
+  TC_RETURN_IF_ERROR(StatusFromTrailers(trailers));
+  if (frames.empty()) return Error("rpc returned no response message");
+  if (!response->ParseFromString(frames[0])) {
+    return Error("failed to parse " + method + " response");
+  }
+  if (verbose_) {
+    fprintf(stderr, "%s -> ok\n", method.c_str());
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::CallStreaming(
+    const std::string& method, const std::string& body,
+    std::vector<std::string>* response_frames, const Headers& headers) {
+  Headers h = headers;
+  h["Content-Type"] = "application/grpc-web+proto";
+  HttpTransport::Response resp;
+  TC_RETURN_IF_ERROR(transport_->Request(
+      "POST", std::string(kServicePath) + "/" + method, body, h, &resp));
+  if (resp.status != 200) {
+    return Error("grpc-web request failed with HTTP status " +
+                 std::to_string(resp.status));
+  }
+  std::string trailers;
+  TC_RETURN_IF_ERROR(ParseFrames(resp.body, response_frames, &trailers));
+  return StatusFromTrailers(trailers);
+}
+
+//==============================================================================
+Error InferenceServerGrpcClient::IsServerLive(bool* live, const Headers& headers) {
+  pb::ServerLiveResponse resp;
+  TC_RETURN_IF_ERROR(Call("ServerLive", pb::ServerLiveRequest(), &resp, headers));
+  *live = resp.live();
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::IsServerReady(bool* ready, const Headers& headers) {
+  pb::ServerReadyResponse resp;
+  TC_RETURN_IF_ERROR(
+      Call("ServerReady", pb::ServerReadyRequest(), &resp, headers));
+  *ready = resp.ready();
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  pb::ModelReadyRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  pb::ModelReadyResponse resp;
+  TC_RETURN_IF_ERROR(Call("ModelReady", req, &resp, headers));
+  *ready = resp.ready();
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::ServerMetadata(
+    pb::ServerMetadataResponse* server_metadata, const Headers& headers) {
+  return Call("ServerMetadata", pb::ServerMetadataRequest(), server_metadata,
+              headers);
+}
+
+Error InferenceServerGrpcClient::ModelMetadata(
+    pb::ModelMetadataResponse* model_metadata, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  pb::ModelMetadataRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelMetadata", req, model_metadata, headers);
+}
+
+Error InferenceServerGrpcClient::ModelConfig(
+    pb::ModelConfigResponse* model_config, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  pb::ModelConfigRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelConfig", req, model_config, headers);
+}
+
+Error InferenceServerGrpcClient::ModelRepositoryIndex(
+    pb::RepositoryIndexResponse* repository_index, const Headers& headers) {
+  return Call("RepositoryIndex", pb::RepositoryIndexRequest(),
+              repository_index, headers);
+}
+
+Error InferenceServerGrpcClient::LoadModel(
+    const std::string& model_name, const Headers& headers,
+    const std::string& config,
+    const std::map<std::string, std::vector<char>>& files) {
+  pb::RepositoryModelLoadRequest req;
+  req.set_model_name(model_name);
+  if (!config.empty()) {
+    (*req.mutable_parameters())["config"].set_string_param(config);
+  }
+  for (const auto& kv : files) {
+    (*req.mutable_parameters())[kv.first].set_bytes_param(
+        std::string(kv.second.begin(), kv.second.end()));
+  }
+  pb::RepositoryModelLoadResponse resp;
+  return Call("RepositoryModelLoad", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnloadModel(
+    const std::string& model_name, const Headers& headers) {
+  pb::RepositoryModelUnloadRequest req;
+  req.set_model_name(model_name);
+  pb::RepositoryModelUnloadResponse resp;
+  return Call("RepositoryModelUnload", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::ModelInferenceStatistics(
+    pb::ModelStatisticsResponse* infer_stat, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  pb::ModelStatisticsRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelStatistics", req, infer_stat, headers);
+}
+
+Error InferenceServerGrpcClient::SystemSharedMemoryStatus(
+    pb::SystemSharedMemoryStatusResponse* status,
+    const std::string& region_name, const Headers& headers) {
+  pb::SystemSharedMemoryStatusRequest req;
+  req.set_name(region_name);
+  return Call("SystemSharedMemoryStatus", req, status, headers);
+}
+
+Error InferenceServerGrpcClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset, const Headers& headers) {
+  pb::SystemSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_key(key);
+  req.set_offset(offset);
+  req.set_byte_size(byte_size);
+  pb::SystemSharedMemoryRegisterResponse resp;
+  return Call("SystemSharedMemoryRegister", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnregisterSystemSharedMemory(
+    const std::string& name, const Headers& headers) {
+  pb::SystemSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  pb::SystemSharedMemoryUnregisterResponse resp;
+  return Call("SystemSharedMemoryUnregister", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::CudaSharedMemoryStatus(
+    pb::CudaSharedMemoryStatusResponse* status,
+    const std::string& region_name, const Headers& headers) {
+  pb::CudaSharedMemoryStatusRequest req;
+  req.set_name(region_name);
+  return Call("CudaSharedMemoryStatus", req, status, headers);
+}
+
+Error InferenceServerGrpcClient::RegisterCudaSharedMemory(
+    const std::string& name, const std::vector<uint8_t>& raw_handle,
+    size_t device_id, size_t byte_size, const Headers& headers) {
+  pb::CudaSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_raw_handle(raw_handle.data(), raw_handle.size());
+  req.set_device_id(device_id);
+  req.set_byte_size(byte_size);
+  pb::CudaSharedMemoryRegisterResponse resp;
+  return Call("CudaSharedMemoryRegister", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnregisterCudaSharedMemory(
+    const std::string& name, const Headers& headers) {
+  pb::CudaSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  pb::CudaSharedMemoryUnregisterResponse resp;
+  return Call("CudaSharedMemoryUnregister", req, &resp, headers);
+}
+
+//==============================================================================
+Error InferenceServerGrpcClient::BuildInferRequest(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    pb::ModelInferRequest* request) {
+  request->set_model_name(options.model_name_);
+  request->set_model_version(options.model_version_);
+  request->set_id(options.request_id_);
+  if (!options.sequence_id_str_.empty()) {
+    (*request->mutable_parameters())["sequence_id"].set_string_param(
+        options.sequence_id_str_);
+  } else if (options.sequence_id_ != 0) {
+    SetParam(request, "sequence_id",
+             static_cast<int64_t>(options.sequence_id_));
+  }
+  if (options.sequence_id_ != 0 || !options.sequence_id_str_.empty()) {
+    (*request->mutable_parameters())["sequence_start"].set_bool_param(
+        options.sequence_start_);
+    (*request->mutable_parameters())["sequence_end"].set_bool_param(
+        options.sequence_end_);
+  }
+  if (options.priority_ != 0) {
+    SetParam(request, "priority", static_cast<int64_t>(options.priority_));
+  }
+  if (options.server_timeout_us_ != 0) {
+    SetParam(request, "timeout",
+             static_cast<int64_t>(options.server_timeout_us_));
+  }
+  if (options.triton_enable_empty_final_response_) {
+    (*request->mutable_parameters())["triton_enable_empty_final_response"]
+        .set_bool_param(true);
+  }
+  for (const auto& kv : options.request_parameters_) {
+    (*request->mutable_parameters())[kv.first].set_string_param(kv.second);
+  }
+
+  for (InferInput* input : inputs) {
+    auto* tensor = request->add_inputs();
+    tensor->set_name(input->Name());
+    tensor->set_datatype(input->Datatype());
+    for (int64_t d : input->Shape()) tensor->add_shape(d);
+    if (input->Type() == InferInput::IOType::kSharedMemory) {
+      auto* params = tensor->mutable_parameters();
+      (*params)["shared_memory_region"].set_string_param(
+          input->SharedMemoryRegion());
+      (*params)["shared_memory_byte_size"].set_int64_param(
+          static_cast<int64_t>(input->SharedMemoryByteSize()));
+      if (input->SharedMemoryOffset() != 0) {
+        (*params)["shared_memory_offset"].set_int64_param(
+            static_cast<int64_t>(input->SharedMemoryOffset()));
+      }
+    } else {
+      input->PrepareForRequest();
+      std::string* blob = request->add_raw_input_contents();
+      blob->reserve(input->TotalByteSize());
+      bool end = false;
+      while (!end) {
+        const uint8_t* ptr = nullptr;
+        size_t len = 0;
+        TC_RETURN_IF_ERROR(input->GetNext(&ptr, &len, &end));
+        if (ptr && len) blob->append(reinterpret_cast<const char*>(ptr), len);
+      }
+    }
+  }
+
+  for (const InferRequestedOutput* output : outputs) {
+    auto* tensor = request->add_outputs();
+    tensor->set_name(output->Name());
+    if (output->ClassCount() > 0) {
+      (*tensor->mutable_parameters())["classification"].set_int64_param(
+          static_cast<int64_t>(output->ClassCount()));
+    }
+    if (output->IsSharedMemory()) {
+      auto* params = tensor->mutable_parameters();
+      (*params)["shared_memory_region"].set_string_param(
+          output->SharedMemoryRegion());
+      (*params)["shared_memory_byte_size"].set_int64_param(
+          static_cast<int64_t>(output->SharedMemoryByteSize()));
+      if (output->SharedMemoryOffset() != 0) {
+        (*params)["shared_memory_offset"].set_int64_param(
+            static_cast<int64_t>(output->SharedMemoryOffset()));
+      }
+    }
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  RequestTimers timers;
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  pb::ModelInferRequest request;
+  TC_RETURN_IF_ERROR(BuildInferRequest(options, inputs, outputs, &request));
+  pb::ModelInferResponse response;
+  TC_RETURN_IF_ERROR(Call("ModelInfer", request, &response, headers, &timers));
+  *result = new InferResultGrpcImpl(std::move(response));
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+  UpdateInferStat(timers);
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInfer");
+  }
+  AsyncJob job;
+  job.callback = std::move(callback);
+  job.headers = headers;
+  TC_RETURN_IF_ERROR(
+      BuildInferRequest(options, inputs, outputs, &job.request));
+  {
+    std::lock_guard<std::mutex> lk(job_mu_);
+    if (workers_.empty()) {
+      for (int i = 0; i < 4; ++i) {
+        workers_.emplace_back(&InferenceServerGrpcClient::AsyncTransfer, this);
+      }
+    }
+    jobs_.push_back(std::move(job));
+  }
+  job_cv_.notify_one();
+  return Error::Success;
+}
+
+void InferenceServerGrpcClient::AsyncTransfer() {
+  while (true) {
+    AsyncJob job;
+    {
+      std::unique_lock<std::mutex> lk(job_mu_);
+      job_cv_.wait(lk, [this] { return exiting_ || !jobs_.empty(); });
+      if (exiting_ && jobs_.empty()) return;
+      job = std::move(jobs_.front());
+      jobs_.pop_front();
+    }
+    RequestTimers timers;
+    timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+    pb::ModelInferResponse response;
+    Error err = Call("ModelInfer", job.request, &response, job.headers, &timers);
+    InferResult* result = nullptr;
+    if (err.IsOk()) {
+      result = new InferResultGrpcImpl(std::move(response));
+      timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+      std::lock_guard<std::mutex> lk(job_mu_);
+      UpdateInferStat(timers);
+    } else {
+      result = new ErrorResult(err);
+    }
+    job.callback(result);
+  }
+}
+
+//==============================================================================
+Error InferenceServerGrpcClient::StartStream(
+    OnCompleteFn callback, const Headers& headers) {
+  if (stream_active_) {
+    return Error("cannot start another stream with one already running");
+  }
+  if (callback == nullptr) {
+    return Error("callback must not be null for StartStream");
+  }
+  stream_callback_ = std::move(callback);
+  stream_headers_ = headers;
+  stream_body_.clear();
+  stream_active_ = true;
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::AsyncStreamInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  if (!stream_active_) {
+    return Error("stream not available, StartStream() must be called first");
+  }
+  pb::ModelInferRequest request;
+  TC_RETURN_IF_ERROR(BuildInferRequest(options, inputs, outputs, &request));
+  stream_body_ += Frame(request.SerializeAsString());
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::FinishStream() {
+  if (!stream_active_) {
+    return Error("no active stream");
+  }
+  stream_active_ = false;
+  std::vector<std::string> frames;
+  Error err = CallStreaming(
+      "ModelStreamInfer", stream_body_, &frames, stream_headers_);
+  stream_body_.clear();
+  if (!err.IsOk()) return err;
+  for (const auto& frame : frames) {
+    pb::ModelStreamInferResponse stream_resp;
+    if (!stream_resp.ParseFromString(frame)) {
+      stream_callback_(
+          new ErrorResult(Error("failed to parse stream response")));
+      continue;
+    }
+    if (!stream_resp.error_message().empty()) {
+      stream_callback_(new ErrorResult(Error(stream_resp.error_message())));
+    } else {
+      stream_callback_(new InferResultGrpcImpl(stream_resp.infer_response()));
+    }
+  }
+  return Error::Success;
+}
+
+}  // namespace client
+}  // namespace tc_tpu

@@ -1,0 +1,698 @@
+#include "http_client.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+namespace tc_tpu {
+namespace client {
+
+Error InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client,
+    const std::string& server_url, bool verbose, size_t concurrency) {
+  if (server_url.rfind("http://", 0) == 0 ||
+      server_url.rfind("https://", 0) == 0) {
+    return Error("url should not include the scheme");
+  }
+  client->reset(
+      new InferenceServerHttpClient(server_url, verbose, concurrency));
+  if ((*client)->transport_->port() <= 0) {
+    return Error("invalid server url '" + server_url + "'");
+  }
+  return Error::Success;
+}
+
+InferenceServerHttpClient::InferenceServerHttpClient(
+    const std::string& url, bool verbose, size_t concurrency)
+    : InferenceServerClient(verbose), concurrency_(concurrency) {
+  std::string host = url;
+  int port = 8000;
+  auto colon = url.rfind(':');
+  if (colon != std::string::npos) {
+    host = url.substr(0, colon);
+    port = atoi(url.substr(colon + 1).c_str());
+  }
+  transport_.reset(new HttpTransport(host, port, concurrency));
+}
+
+InferenceServerHttpClient::~InferenceServerHttpClient() {
+  {
+    std::lock_guard<std::mutex> lk(job_mu_);
+    exiting_ = true;
+  }
+  job_cv_.notify_all();
+  for (auto& w : workers_) {
+    if (w.joinable()) w.join();
+  }
+}
+
+Error InferenceServerHttpClient::Get(
+    const std::string& path, const Headers& headers, Response* out) {
+  Error err = transport_->Request("GET", path, "", headers, out);
+  if (err.IsOk() && verbose_) {
+    fprintf(stderr, "GET /%s -> %d (%zu bytes)\n", path.c_str(), out->status,
+            out->body.size());
+  }
+  return err;
+}
+
+Error InferenceServerHttpClient::Post(
+    const std::string& path, const std::string& body, const Headers& headers,
+    Response* out, RequestTimers* timers) {
+  Error err = transport_->Request("POST", path, body, headers, out, timers);
+  if (err.IsOk() && verbose_) {
+    fprintf(stderr, "POST /%s -> %d (%zu bytes)\n", path.c_str(), out->status,
+            out->body.size());
+  }
+  return err;
+}
+
+Error InferenceServerHttpClient::CheckResponse(const Response& resp) {
+  if (resp.status >= 200 && resp.status < 300) return Error::Success;
+  json::Value doc;
+  std::string jerr;
+  if (json::Parse(resp.body, &doc, &jerr) && doc.Has("error")) {
+    return Error(doc.At("error").AsString());
+  }
+  return Error(
+      "request failed with status " + std::to_string(resp.status) +
+      (resp.body.empty() ? "" : (": " + resp.body)));
+}
+
+//==============================================================================
+// health / metadata / repository / statistics / settings
+
+Error InferenceServerHttpClient::IsServerLive(bool* live, const Headers& headers) {
+  Response resp;
+  TC_RETURN_IF_ERROR(Get("v2/health/live", headers, &resp));
+  *live = (resp.status == 200);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::IsServerReady(bool* ready, const Headers& headers) {
+  Response resp;
+  TC_RETURN_IF_ERROR(Get("v2/health/ready", headers, &resp));
+  *ready = (resp.status == 200);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  path += "/ready";
+  Response resp;
+  TC_RETURN_IF_ERROR(Get(path, headers, &resp));
+  *ready = (resp.status == 200);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::ServerMetadata(
+    std::string* server_metadata, const Headers& headers) {
+  Response resp;
+  TC_RETURN_IF_ERROR(Get("v2", headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *server_metadata = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::ModelMetadata(
+    std::string* model_metadata, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  Response resp;
+  TC_RETURN_IF_ERROR(Get(path, headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *model_metadata = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::ModelConfig(
+    std::string* model_config, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  path += "/config";
+  Response resp;
+  TC_RETURN_IF_ERROR(Get(path, headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *model_config = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::ModelRepositoryIndex(
+    std::string* repository_index, const Headers& headers) {
+  Response resp;
+  TC_RETURN_IF_ERROR(Post("v2/repository/index", "", headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *repository_index = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::LoadModel(
+    const std::string& model_name, const Headers& headers,
+    const std::string& config,
+    const std::map<std::string, std::vector<char>>& files) {
+  json::Object params;
+  if (!config.empty()) params.emplace("config", json::Value(config));
+  for (const auto& kv : files) {
+    params.emplace(
+        kv.first, json::Value(Base64Encode(
+                      reinterpret_cast<const uint8_t*>(kv.second.data()),
+                      kv.second.size())));
+  }
+  std::string body;
+  if (!params.empty()) {
+    json::Object root;
+    root.emplace("parameters", json::Value(std::move(params)));
+    body = json::Value(std::move(root)).Serialize();
+  }
+  Response resp;
+  Headers h = headers;
+  h["Content-Type"] = "application/json";
+  TC_RETURN_IF_ERROR(
+      Post("v2/repository/models/" + model_name + "/load", body, h, &resp));
+  return CheckResponse(resp);
+}
+
+Error InferenceServerHttpClient::UnloadModel(
+    const std::string& model_name, const Headers& headers) {
+  Response resp;
+  Headers h = headers;
+  h["Content-Type"] = "application/json";
+  TC_RETURN_IF_ERROR(
+      Post("v2/repository/models/" + model_name + "/unload", "{}", h, &resp));
+  return CheckResponse(resp);
+}
+
+Error InferenceServerHttpClient::ModelInferenceStatistics(
+    std::string* infer_stat, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  std::string path;
+  if (!model_name.empty()) {
+    path = "v2/models/" + model_name;
+    if (!model_version.empty()) path += "/versions/" + model_version;
+    path += "/stats";
+  } else {
+    path = "v2/models/stats";
+  }
+  Response resp;
+  TC_RETURN_IF_ERROR(Get(path, headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *infer_stat = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::UpdateTraceSettings(
+    std::string* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings,
+    const Headers& headers) {
+  json::Object obj;
+  for (const auto& kv : settings) {
+    json::Array arr;
+    for (const auto& v : kv.second) arr.emplace_back(v);
+    obj.emplace(kv.first, json::Value(std::move(arr)));
+  }
+  std::string path = model_name.empty()
+                         ? "v2/trace/setting"
+                         : ("v2/models/" + model_name + "/trace/setting");
+  Response resp;
+  Headers h = headers;
+  h["Content-Type"] = "application/json";
+  TC_RETURN_IF_ERROR(
+      Post(path, json::Value(std::move(obj)).Serialize(), h, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  if (response) *response = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::GetTraceSettings(
+    std::string* settings, const std::string& model_name,
+    const Headers& headers) {
+  std::string path = model_name.empty()
+                         ? "v2/trace/setting"
+                         : ("v2/models/" + model_name + "/trace/setting");
+  Response resp;
+  TC_RETURN_IF_ERROR(Get(path, headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *settings = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::UpdateLogSettings(
+    std::string* response, const std::map<std::string, std::string>& settings,
+    const Headers& headers) {
+  json::Object obj;
+  for (const auto& kv : settings) obj.emplace(kv.first, json::Value(kv.second));
+  Response resp;
+  Headers h = headers;
+  h["Content-Type"] = "application/json";
+  TC_RETURN_IF_ERROR(
+      Post("v2/logging", json::Value(std::move(obj)).Serialize(), h, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  if (response) *response = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::GetLogSettings(
+    std::string* settings, const Headers& headers) {
+  Response resp;
+  TC_RETURN_IF_ERROR(Get("v2/logging", headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *settings = resp.body;
+  return Error::Success;
+}
+
+//==============================================================================
+// shared memory management
+
+Error InferenceServerHttpClient::SystemSharedMemoryStatus(
+    std::string* status, const std::string& region_name,
+    const Headers& headers) {
+  std::string path = "v2/systemsharedmemory";
+  if (!region_name.empty()) path += "/region/" + region_name;
+  path += "/status";
+  Response resp;
+  TC_RETURN_IF_ERROR(Get(path, headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *status = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset, const Headers& headers) {
+  json::Object obj;
+  obj.emplace("key", json::Value(key));
+  obj.emplace("offset", json::Value(offset));
+  obj.emplace("byte_size", json::Value(byte_size));
+  Response resp;
+  Headers h = headers;
+  h["Content-Type"] = "application/json";
+  TC_RETURN_IF_ERROR(Post(
+      "v2/systemsharedmemory/region/" + name + "/register",
+      json::Value(std::move(obj)).Serialize(), h, &resp));
+  return CheckResponse(resp);
+}
+
+Error InferenceServerHttpClient::UnregisterSystemSharedMemory(
+    const std::string& name, const Headers& headers) {
+  std::string path = name.empty()
+                         ? "v2/systemsharedmemory/unregister"
+                         : ("v2/systemsharedmemory/region/" + name + "/unregister");
+  Response resp;
+  TC_RETURN_IF_ERROR(Post(path, "", headers, &resp));
+  return CheckResponse(resp);
+}
+
+Error InferenceServerHttpClient::CudaSharedMemoryStatus(
+    std::string* status, const std::string& region_name,
+    const Headers& headers) {
+  std::string path = "v2/cudasharedmemory";
+  if (!region_name.empty()) path += "/region/" + region_name;
+  path += "/status";
+  Response resp;
+  TC_RETURN_IF_ERROR(Get(path, headers, &resp));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+  *status = resp.body;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::RegisterCudaSharedMemory(
+    const std::string& name, const std::vector<uint8_t>& raw_handle,
+    size_t device_id, size_t byte_size, const Headers& headers) {
+  json::Object handle;
+  handle.emplace("b64", json::Value(Base64Encode(raw_handle.data(),
+                                                 raw_handle.size())));
+  json::Object obj;
+  obj.emplace("raw_handle", json::Value(std::move(handle)));
+  obj.emplace("device_id", json::Value(device_id));
+  obj.emplace("byte_size", json::Value(byte_size));
+  Response resp;
+  Headers h = headers;
+  h["Content-Type"] = "application/json";
+  TC_RETURN_IF_ERROR(Post(
+      "v2/cudasharedmemory/region/" + name + "/register",
+      json::Value(std::move(obj)).Serialize(), h, &resp));
+  return CheckResponse(resp);
+}
+
+Error InferenceServerHttpClient::UnregisterCudaSharedMemory(
+    const std::string& name, const Headers& headers) {
+  std::string path = name.empty()
+                         ? "v2/cudasharedmemory/unregister"
+                         : ("v2/cudasharedmemory/region/" + name + "/unregister");
+  Response resp;
+  TC_RETURN_IF_ERROR(Post(path, "", headers, &resp));
+  return CheckResponse(resp);
+}
+
+//==============================================================================
+// inference
+
+namespace {
+
+// Result over the binary-over-HTTP response framing (reference
+// InferResultHttp, http_client.cc:740-1283).
+class InferResultHttpImpl : public InferResult {
+ public:
+  static Error Create(
+      InferResult** result, std::string body, size_t header_length) {
+    auto* r = new InferResultHttpImpl(std::move(body));
+    Error err = r->Parse(header_length);
+    if (!err.IsOk()) {
+      delete r;
+      return err;
+    }
+    *result = r;
+    return Error::Success;
+  }
+
+  Error ModelName(std::string* name) const override {
+    *name = doc_.At("model_name").AsString();
+    return Error::Success;
+  }
+  Error ModelVersion(std::string* version) const override {
+    *version = doc_.At("model_version").AsString();
+    return Error::Success;
+  }
+  Error Id(std::string* id) const override {
+    *id = doc_.At("id").AsString();
+    return Error::Success;
+  }
+
+  Error Shape(const std::string& output_name,
+              std::vector<int64_t>* shape) const override {
+    const json::Value* out = FindOutput(output_name);
+    if (!out) return Error("output '" + output_name + "' not found");
+    shape->clear();
+    for (const auto& d : out->At("shape").AsArray()) shape->push_back(d.AsInt());
+    return Error::Success;
+  }
+
+  Error Datatype(const std::string& output_name,
+                 std::string* datatype) const override {
+    const json::Value* out = FindOutput(output_name);
+    if (!out) return Error("output '" + output_name + "' not found");
+    *datatype = out->At("datatype").AsString();
+    return Error::Success;
+  }
+
+  Error RawData(const std::string& output_name, const uint8_t** buf,
+                size_t* byte_size) const override {
+    auto it = segments_.find(output_name);
+    if (it == segments_.end()) {
+      return Error("output '" + output_name + "' has no binary data");
+    }
+    *buf = reinterpret_cast<const uint8_t*>(body_.data()) + it->second.first;
+    *byte_size = it->second.second;
+    return Error::Success;
+  }
+
+  Error RequestStatus() const override { return Error::Success; }
+  std::string DebugString() const override { return doc_.Serialize(); }
+
+ private:
+  explicit InferResultHttpImpl(std::string body) : body_(std::move(body)) {}
+
+  Error Parse(size_t header_length) {
+    size_t jlen = header_length ? header_length : body_.size();
+    std::string err;
+    if (!json::Parse(body_.data(), jlen, &doc_, &err)) {
+      return Error("failed to parse inference response JSON: " + err);
+    }
+    size_t offset = jlen;
+    for (const auto& out : doc_.At("outputs").AsArray()) {
+      const auto& params = out.At("parameters");
+      if (params.Has("binary_data_size")) {
+        size_t sz = static_cast<size_t>(params.At("binary_data_size").AsInt());
+        if (offset + sz > body_.size()) {
+          return Error("binary segment exceeds response body");
+        }
+        segments_[out.At("name").AsString()] = {offset, sz};
+        offset += sz;
+      }
+    }
+    return Error::Success;
+  }
+
+  const json::Value* FindOutput(const std::string& name) const {
+    for (const auto& out : doc_.At("outputs").AsArray()) {
+      if (out.At("name").AsString() == name) return &out;
+    }
+    return nullptr;
+  }
+
+  std::string body_;
+  json::Value doc_;
+  std::map<std::string, std::pair<size_t, size_t>> segments_;
+};
+
+}  // namespace
+
+Error InferenceServerHttpClient::BuildInferRequestBody(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    std::string* body, size_t* header_length) {
+  json::Object root;
+  if (!options.request_id_.empty()) {
+    root.emplace("id", json::Value(options.request_id_));
+  }
+  json::Object params;
+  if (!options.sequence_id_str_.empty()) {
+    params.emplace("sequence_id", json::Value(options.sequence_id_str_));
+  } else if (options.sequence_id_ != 0) {
+    params.emplace("sequence_id", json::Value(options.sequence_id_));
+  }
+  if (options.sequence_id_ != 0 || !options.sequence_id_str_.empty()) {
+    params.emplace("sequence_start", json::Value(options.sequence_start_));
+    params.emplace("sequence_end", json::Value(options.sequence_end_));
+  }
+  if (options.priority_ != 0) {
+    params.emplace("priority", json::Value(options.priority_));
+  }
+  if (options.server_timeout_us_ != 0) {
+    params.emplace("timeout", json::Value(options.server_timeout_us_));
+  }
+  for (const auto& kv : options.request_parameters_) {
+    params.emplace(kv.first, json::Value(kv.second));
+  }
+  if (!params.empty()) {
+    root.emplace("parameters", json::Value(std::move(params)));
+  }
+
+  size_t total_binary = 0;
+  json::Array jinputs;
+  for (InferInput* input : inputs) {
+    json::Object jin;
+    jin.emplace("name", json::Value(input->Name()));
+    jin.emplace("datatype", json::Value(input->Datatype()));
+    json::Array shape;
+    for (int64_t d : input->Shape()) shape.emplace_back(d);
+    jin.emplace("shape", json::Value(std::move(shape)));
+    json::Object iparams;
+    if (input->Type() == InferInput::IOType::kSharedMemory) {
+      iparams.emplace("shared_memory_region",
+                      json::Value(input->SharedMemoryRegion()));
+      iparams.emplace("shared_memory_byte_size",
+                      json::Value(input->SharedMemoryByteSize()));
+      if (input->SharedMemoryOffset() != 0) {
+        iparams.emplace("shared_memory_offset",
+                        json::Value(input->SharedMemoryOffset()));
+      }
+    } else {
+      iparams.emplace("binary_data_size", json::Value(input->TotalByteSize()));
+      total_binary += input->TotalByteSize();
+    }
+    jin.emplace("parameters", json::Value(std::move(iparams)));
+    jinputs.push_back(json::Value(std::move(jin)));
+  }
+  root.emplace("inputs", json::Value(std::move(jinputs)));
+
+  if (!outputs.empty()) {
+    json::Array jouts;
+    for (const InferRequestedOutput* output : outputs) {
+      json::Object jout;
+      jout.emplace("name", json::Value(output->Name()));
+      json::Object oparams;
+      oparams.emplace("binary_data", json::Value(!output->IsSharedMemory()));
+      if (output->ClassCount() > 0) {
+        oparams.emplace("classification", json::Value(output->ClassCount()));
+      }
+      if (output->IsSharedMemory()) {
+        oparams.emplace("shared_memory_region",
+                        json::Value(output->SharedMemoryRegion()));
+        oparams.emplace("shared_memory_byte_size",
+                        json::Value(output->SharedMemoryByteSize()));
+        if (output->SharedMemoryOffset() != 0) {
+          oparams.emplace("shared_memory_offset",
+                          json::Value(output->SharedMemoryOffset()));
+        }
+      }
+      jout.emplace("parameters", json::Value(std::move(oparams)));
+      jouts.push_back(json::Value(std::move(jout)));
+    }
+    root.emplace("outputs", json::Value(std::move(jouts)));
+  }
+
+  std::string json_part = json::Value(std::move(root)).Serialize();
+  *header_length = json_part.size();
+  body->clear();
+  body->reserve(json_part.size() + total_binary);
+  *body = std::move(json_part);
+  for (InferInput* input : inputs) {
+    if (input->Type() == InferInput::IOType::kSharedMemory) continue;
+    input->PrepareForRequest();
+    bool end = false;
+    while (!end) {
+      const uint8_t* ptr = nullptr;
+      size_t len = 0;
+      TC_RETURN_IF_ERROR(input->GetNext(&ptr, &len, &end));
+      if (ptr && len) body->append(reinterpret_cast<const char*>(ptr), len);
+    }
+  }
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  RequestTimers timers;
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+
+  std::string body;
+  size_t header_length = 0;
+  TC_RETURN_IF_ERROR(
+      BuildInferRequestBody(options, inputs, outputs, &body, &header_length));
+
+  std::string path = "v2/models/" + options.model_name_;
+  if (!options.model_version_.empty()) {
+    path += "/versions/" + options.model_version_;
+  }
+  path += "/infer";
+
+  Headers h = headers;
+  h["Inference-Header-Content-Length"] = std::to_string(header_length);
+  h["Content-Type"] = "application/octet-stream";
+
+  Response resp;
+  TC_RETURN_IF_ERROR(Post(path, body, h, &resp, &timers));
+  TC_RETURN_IF_ERROR(CheckResponse(resp));
+
+  size_t resp_header_len = 0;
+  auto it = resp.headers.find("inference-header-content-length");
+  if (it != resp.headers.end()) {
+    resp_header_len = strtoul(it->second.c_str(), nullptr, 10);
+  }
+  TC_RETURN_IF_ERROR(InferResultHttpImpl::Create(
+      result, std::move(resp.body), resp_header_len));
+
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+  UpdateInferStat(timers);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInfer");
+  }
+  std::string body;
+  size_t header_length = 0;
+  TC_RETURN_IF_ERROR(
+      BuildInferRequestBody(options, inputs, outputs, &body, &header_length));
+  std::string path = "v2/models/" + options.model_name_;
+  if (!options.model_version_.empty()) {
+    path += "/versions/" + options.model_version_;
+  }
+  path += "/infer";
+
+  {
+    std::lock_guard<std::mutex> lk(job_mu_);
+    if (workers_.empty()) {
+      for (size_t i = 0; i < std::max<size_t>(concurrency_, 1); ++i) {
+        workers_.emplace_back(&InferenceServerHttpClient::AsyncTransfer, this);
+      }
+    }
+    jobs_.push_back(
+        AsyncJob{std::move(callback), std::move(path), std::move(body),
+                 headers, header_length});
+  }
+  job_cv_.notify_one();
+  return Error::Success;
+}
+
+void InferenceServerHttpClient::AsyncTransfer() {
+  while (true) {
+    AsyncJob job;
+    {
+      std::unique_lock<std::mutex> lk(job_mu_);
+      job_cv_.wait(lk, [this] { return exiting_ || !jobs_.empty(); });
+      if (exiting_ && jobs_.empty()) return;
+      job = std::move(jobs_.front());
+      jobs_.pop_front();
+    }
+    RequestTimers timers;
+    timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+    Headers h = job.headers;
+    h["Inference-Header-Content-Length"] = std::to_string(job.header_length);
+    h["Content-Type"] = "application/octet-stream";
+    Response resp;
+    Error err = Post(job.path, job.body, h, &resp, &timers);
+    if (err.IsOk()) err = CheckResponse(resp);
+    InferResult* result = nullptr;
+    if (err.IsOk()) {
+      size_t resp_header_len = 0;
+      auto it = resp.headers.find("inference-header-content-length");
+      if (it != resp.headers.end()) {
+        resp_header_len = strtoul(it->second.c_str(), nullptr, 10);
+      }
+      err = InferResultHttpImpl::Create(
+          &result, std::move(resp.body), resp_header_len);
+    }
+    if (!err.IsOk()) {
+      // error result wrapper so the callback always receives an InferResult
+      class ErrorResult : public InferResult {
+       public:
+        explicit ErrorResult(Error e) : err_(std::move(e)) {}
+        Error ModelName(std::string*) const override { return err_; }
+        Error ModelVersion(std::string*) const override { return err_; }
+        Error Id(std::string*) const override { return err_; }
+        Error Shape(const std::string&, std::vector<int64_t>*) const override {
+          return err_;
+        }
+        Error Datatype(const std::string&, std::string*) const override {
+          return err_;
+        }
+        Error RawData(const std::string&, const uint8_t**, size_t*)
+            const override {
+          return err_;
+        }
+        Error RequestStatus() const override { return err_; }
+        std::string DebugString() const override { return err_.Message(); }
+
+       private:
+        Error err_;
+      };
+      result = new ErrorResult(err);
+    } else {
+      timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+      {
+        std::lock_guard<std::mutex> lk(job_mu_);
+        UpdateInferStat(timers);
+      }
+    }
+    job.callback(result);
+  }
+}
+
+}  // namespace client
+}  // namespace tc_tpu

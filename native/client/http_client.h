@@ -1,0 +1,161 @@
+// C++ HTTP/REST client.
+//
+// Parity target: reference src/c++/library/http_client.h (651 LoC) — same
+// public API: factory Create, health/metadata/config/repository/statistics/
+// trace/log/shm management methods, Infer + AsyncInfer, binary-over-HTTP
+// framing with Inference-Header-Content-Length (http_client.cc:2098-2246).
+//
+// Transport re-design: the image has no libcurl headers, so the transport is
+// a dependency-free HTTP/1.1 keep-alive connection pool over POSIX sockets.
+// AsyncInfer runs on a fixed worker pool draining a request queue (the
+// functional equivalent of the reference's curl-multi AsyncTransfer loop,
+// http_client.cc:2249-2348, without hand-scheduling one thread over N easy
+// handles — threads are cheap on a TPU VM host and the API is identical).
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "json.h"
+#include "transport.h"
+
+namespace tc_tpu {
+namespace client {
+
+using Parameters = std::map<std::string, std::string>;
+
+class InferResultHttp;
+
+class InferenceServerHttpClient : public InferenceServerClient {
+ public:
+  using OnCompleteFn = std::function<void(InferResult*)>;
+
+  static Error Create(
+      std::unique_ptr<InferenceServerHttpClient>* client,
+      const std::string& server_url, bool verbose = false,
+      size_t concurrency = 4);
+  ~InferenceServerHttpClient() override;
+
+  Error IsServerLive(bool* live, const Headers& headers = Headers());
+  Error IsServerReady(bool* ready, const Headers& headers = Headers());
+  Error IsModelReady(
+      bool* ready, const std::string& model_name,
+      const std::string& model_version = "",
+      const Headers& headers = Headers());
+
+  Error ServerMetadata(std::string* server_metadata,
+                       const Headers& headers = Headers());
+  Error ModelMetadata(
+      std::string* model_metadata, const std::string& model_name,
+      const std::string& model_version = "",
+      const Headers& headers = Headers());
+  Error ModelConfig(
+      std::string* model_config, const std::string& model_name,
+      const std::string& model_version = "",
+      const Headers& headers = Headers());
+
+  Error ModelRepositoryIndex(std::string* repository_index,
+                             const Headers& headers = Headers());
+  Error LoadModel(
+      const std::string& model_name, const Headers& headers = Headers(),
+      const std::string& config = "",
+      const std::map<std::string, std::vector<char>>& files = {});
+  Error UnloadModel(
+      const std::string& model_name, const Headers& headers = Headers());
+
+  Error ModelInferenceStatistics(
+      std::string* infer_stat, const std::string& model_name = "",
+      const std::string& model_version = "",
+      const Headers& headers = Headers());
+
+  Error UpdateTraceSettings(
+      std::string* response, const std::string& model_name = "",
+      const std::map<std::string, std::vector<std::string>>& settings = {},
+      const Headers& headers = Headers());
+  Error GetTraceSettings(
+      std::string* settings, const std::string& model_name = "",
+      const Headers& headers = Headers());
+  Error UpdateLogSettings(
+      std::string* response,
+      const std::map<std::string, std::string>& settings = {},
+      const Headers& headers = Headers());
+  Error GetLogSettings(
+      std::string* settings, const Headers& headers = Headers());
+
+  Error SystemSharedMemoryStatus(
+      std::string* status, const std::string& region_name = "",
+      const Headers& headers = Headers());
+  Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset = 0, const Headers& headers = Headers());
+  Error UnregisterSystemSharedMemory(
+      const std::string& name = "", const Headers& headers = Headers());
+  // "Cuda" wire name kept for v2 compatibility; the handle is an XLA
+  // device-buffer descriptor (xla_shared_memory.get_raw_handle).
+  Error CudaSharedMemoryStatus(
+      std::string* status, const std::string& region_name = "",
+      const Headers& headers = Headers());
+  Error RegisterCudaSharedMemory(
+      const std::string& name, const std::vector<uint8_t>& raw_handle,
+      size_t device_id, size_t byte_size, const Headers& headers = Headers());
+  Error UnregisterCudaSharedMemory(
+      const std::string& name = "", const Headers& headers = Headers());
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {},
+      const Headers& headers = Headers());
+
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {},
+      const Headers& headers = Headers());
+
+ private:
+  InferenceServerHttpClient(
+      const std::string& url, bool verbose, size_t concurrency);
+
+  using Response = HttpTransport::Response;
+
+  Error Get(const std::string& path, const Headers& headers, Response* out);
+  Error Post(
+      const std::string& path, const std::string& body,
+      const Headers& headers, Response* out, RequestTimers* timers = nullptr);
+  static Error CheckResponse(const Response& resp);
+
+  Error BuildInferRequestBody(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs,
+      std::string* body, size_t* header_length);
+
+  std::unique_ptr<HttpTransport> transport_;
+  size_t concurrency_;
+
+  // async worker pool
+  struct AsyncJob {
+    OnCompleteFn callback;
+    std::string path;
+    std::string body;
+    Headers headers;
+    size_t header_length;
+  };
+  void AsyncTransfer();
+  std::mutex job_mu_;
+  std::condition_variable job_cv_;
+  std::deque<AsyncJob> jobs_;
+  std::vector<std::thread> workers_;
+  bool exiting_ = false;
+};
+
+}  // namespace client
+}  // namespace tc_tpu

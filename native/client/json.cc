@@ -1,0 +1,336 @@
+#include "json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace tc_tpu {
+namespace json {
+
+namespace {
+const Value kNullValue;
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::string* err;
+
+  bool Fail(const std::string& msg) {
+    if (err) *err = msg;
+    return false;
+  }
+
+  void SkipWs() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+
+  bool ParseValue(Value* out, int depth) {
+    if (depth > 128) return Fail("nesting too deep");
+    SkipWs();
+    if (p >= end) return Fail("unexpected end of input");
+    switch (*p) {
+      case '{':
+        return ParseObject(out, depth);
+      case '[':
+        return ParseArray(out, depth);
+      case '"': {
+        std::string s;
+        if (!ParseString(&s)) return false;
+        *out = Value(std::move(s));
+        return true;
+      }
+      case 't':
+        if (end - p >= 4 && strncmp(p, "true", 4) == 0) {
+          p += 4;
+          *out = Value(true);
+          return true;
+        }
+        return Fail("invalid literal");
+      case 'f':
+        if (end - p >= 5 && strncmp(p, "false", 5) == 0) {
+          p += 5;
+          *out = Value(false);
+          return true;
+        }
+        return Fail("invalid literal");
+      case 'n':
+        if (end - p >= 4 && strncmp(p, "null", 4) == 0) {
+          p += 4;
+          *out = Value();
+          return true;
+        }
+        return Fail("invalid literal");
+      default:
+        return ParseNumber(out);
+    }
+  }
+
+  bool ParseObject(Value* out, int depth) {
+    ++p;  // '{'
+    Object obj;
+    SkipWs();
+    if (p < end && *p == '}') {
+      ++p;
+      *out = Value(std::move(obj));
+      return true;
+    }
+    while (true) {
+      SkipWs();
+      if (p >= end || *p != '"') return Fail("expected object key");
+      std::string key;
+      if (!ParseString(&key)) return false;
+      SkipWs();
+      if (p >= end || *p != ':') return Fail("expected ':'");
+      ++p;
+      Value v;
+      if (!ParseValue(&v, depth + 1)) return false;
+      obj.emplace(std::move(key), std::move(v));
+      SkipWs();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == '}') {
+        ++p;
+        *out = Value(std::move(obj));
+        return true;
+      }
+      return Fail("expected ',' or '}'");
+    }
+  }
+
+  bool ParseArray(Value* out, int depth) {
+    ++p;  // '['
+    Array arr;
+    SkipWs();
+    if (p < end && *p == ']') {
+      ++p;
+      *out = Value(std::move(arr));
+      return true;
+    }
+    while (true) {
+      Value v;
+      if (!ParseValue(&v, depth + 1)) return false;
+      arr.push_back(std::move(v));
+      SkipWs();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        ++p;
+        *out = Value(std::move(arr));
+        return true;
+      }
+      return Fail("expected ',' or ']'");
+    }
+  }
+
+  bool ParseString(std::string* out) {
+    ++p;  // opening quote
+    std::string s;
+    while (p < end) {
+      unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '"') {
+        ++p;
+        *out = std::move(s);
+        return true;
+      }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) return Fail("bad escape");
+        switch (*p) {
+          case '"': s += '"'; break;
+          case '\\': s += '\\'; break;
+          case '/': s += '/'; break;
+          case 'b': s += '\b'; break;
+          case 'f': s += '\f'; break;
+          case 'n': s += '\n'; break;
+          case 'r': s += '\r'; break;
+          case 't': s += '\t'; break;
+          case 'u': {
+            if (end - p < 5) return Fail("bad \\u escape");
+            unsigned int cp = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char h = p[i];
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp |= h - '0';
+              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+              else return Fail("bad \\u escape");
+            }
+            p += 4;
+            // encode UTF-8 (surrogate pairs for completeness)
+            if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 7 && p[1] == '\\' &&
+                p[2] == 'u') {
+              unsigned int lo = 0;
+              bool ok = true;
+              for (int i = 3; i <= 6; ++i) {
+                char h = p[i];
+                lo <<= 4;
+                if (h >= '0' && h <= '9') lo |= h - '0';
+                else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                else { ok = false; break; }
+              }
+              if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                p += 6;
+              }
+            }
+            if (cp < 0x80) {
+              s += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              s += static_cast<char>(0xC0 | (cp >> 6));
+              s += static_cast<char>(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              s += static_cast<char>(0xE0 | (cp >> 12));
+              s += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              s += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              s += static_cast<char>(0xF0 | (cp >> 18));
+              s += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+              s += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              s += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default:
+            return Fail("bad escape");
+        }
+        ++p;
+      } else {
+        s += static_cast<char>(c);
+        ++p;
+      }
+    }
+    return Fail("unterminated string");
+  }
+
+  bool ParseNumber(Value* out) {
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    bool is_double = false;
+    while (p < end && (isdigit(static_cast<unsigned char>(*p)) || *p == '.' ||
+                       *p == 'e' || *p == 'E' || *p == '-' || *p == '+')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') is_double = true;
+      ++p;
+    }
+    if (p == start) return Fail("invalid number");
+    std::string num(start, p - start);
+    if (is_double) {
+      *out = Value(strtod(num.c_str(), nullptr));
+    } else {
+      *out = Value(static_cast<int64_t>(strtoll(num.c_str(), nullptr, 10)));
+    }
+    return true;
+  }
+};
+
+void Escape(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void SerializeTo(const Value& v, std::string* out) {
+  switch (v.type()) {
+    case Value::Type::kNull: *out += "null"; break;
+    case Value::Type::kBool: *out += v.AsBool() ? "true" : "false"; break;
+    case Value::Type::kInt: {
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(v.AsInt()));
+      *out += buf;
+      break;
+    }
+    case Value::Type::kDouble: {
+      double d = v.AsDouble();
+      char buf[40];
+      if (std::isfinite(d)) {
+        snprintf(buf, sizeof(buf), "%.17g", d);
+        *out += buf;
+      } else {
+        *out += "null";  // JSON has no inf/nan
+      }
+      break;
+    }
+    case Value::Type::kString: Escape(v.AsString(), out); break;
+    case Value::Type::kArray: {
+      out->push_back('[');
+      bool first = true;
+      for (const auto& e : v.AsArray()) {
+        if (!first) out->push_back(',');
+        first = false;
+        SerializeTo(e, out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Value::Type::kObject: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& kv : v.AsObject()) {
+        if (!first) out->push_back(',');
+        first = false;
+        Escape(kv.first, out);
+        out->push_back(':');
+        SerializeTo(kv.second, out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+const Value& Value::At(const std::string& key) const {
+  if (type_ == Type::kObject) {
+    auto it = object_.find(key);
+    if (it != object_.end()) return it->second;
+  }
+  return kNullValue;
+}
+
+std::string Value::Serialize() const {
+  std::string out;
+  SerializeTo(*this, &out);
+  return out;
+}
+
+bool Parse(const char* data, size_t size, Value* out, std::string* err) {
+  Parser parser{data, data + size, err};
+  if (!parser.ParseValue(out, 0)) return false;
+  parser.SkipWs();
+  if (parser.p != parser.end) {
+    if (err) *err = "trailing characters after JSON document";
+    return false;
+  }
+  return true;
+}
+
+bool Parse(const std::string& s, Value* out, std::string* err) {
+  return Parse(s.data(), s.size(), out, err);
+}
+
+}  // namespace json
+}  // namespace tc_tpu

@@ -1,0 +1,82 @@
+// Minimal JSON value/parser/writer for the C++ client library.
+//
+// The image ships no rapidjson/nlohmann headers, so the client carries its
+// own ~300-line JSON layer (the reference wraps rapidjson via
+// src/c++/library/json_utils.h:37; same role here, zero dependencies).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace tc_tpu {
+namespace json {
+
+class Value;
+using Object = std::map<std::string, Value>;
+using Array = std::vector<Value>;
+
+class Value {
+ public:
+  enum class Type { kNull, kBool, kInt, kDouble, kString, kArray, kObject };
+
+  Value() : type_(Type::kNull) {}
+  Value(bool b) : type_(Type::kBool), bool_(b) {}
+  Value(int64_t i) : type_(Type::kInt), int_(i) {}
+  Value(int i) : type_(Type::kInt), int_(i) {}
+  Value(uint64_t u) : type_(Type::kInt), int_(static_cast<int64_t>(u)) {}
+  Value(double d) : type_(Type::kDouble), double_(d) {}
+  Value(const char* s) : type_(Type::kString), string_(s) {}
+  Value(std::string s) : type_(Type::kString), string_(std::move(s)) {}
+  Value(Array a) : type_(Type::kArray), array_(std::move(a)) {}
+  Value(Object o) : type_(Type::kObject), object_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool IsNull() const { return type_ == Type::kNull; }
+  bool IsBool() const { return type_ == Type::kBool; }
+  bool IsNumber() const { return type_ == Type::kInt || type_ == Type::kDouble; }
+  bool IsInt() const { return type_ == Type::kInt; }
+  bool IsString() const { return type_ == Type::kString; }
+  bool IsArray() const { return type_ == Type::kArray; }
+  bool IsObject() const { return type_ == Type::kObject; }
+
+  bool AsBool() const { return bool_; }
+  int64_t AsInt() const {
+    return type_ == Type::kDouble ? static_cast<int64_t>(double_) : int_;
+  }
+  double AsDouble() const {
+    return type_ == Type::kInt ? static_cast<double>(int_) : double_;
+  }
+  const std::string& AsString() const { return string_; }
+  const Array& AsArray() const { return array_; }
+  Array& AsArray() { return array_; }
+  const Object& AsObject() const { return object_; }
+  Object& AsObject() { return object_; }
+
+  // object helpers
+  bool Has(const std::string& key) const {
+    return type_ == Type::kObject && object_.count(key) > 0;
+  }
+  const Value& At(const std::string& key) const;  // null value if missing
+
+  std::string Serialize() const;
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string string_;
+  Array array_;
+  Object object_;
+};
+
+// Parse a JSON document from [data, data+size). Returns true on success;
+// on failure fills *err with a position-tagged message.
+bool Parse(const char* data, size_t size, Value* out, std::string* err);
+bool Parse(const std::string& s, Value* out, std::string* err);
+
+}  // namespace json
+}  // namespace tc_tpu

@@ -1,0 +1,319 @@
+// C++ client integration test (reference model: src/c++/tests/
+// cc_client_test.cc:38-44 — "must be run with a running server"; here the
+// python test harness spins the server and runs this binary, so the test is
+// hermetic).  assert-style checks, no gtest dependency in the image.
+//
+// Usage: cc_client_test <http_host:port>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <condition_variable>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+#include "json.h"
+
+namespace tc = tc_tpu::client;
+
+#define CHECK_OK(expr)                                                \
+  do {                                                                \
+    tc::Error err__ = (expr);                                         \
+    if (!err__.IsOk()) {                                              \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,       \
+              err__.Message().c_str());                               \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (false)
+
+#define CHECK_TRUE(cond)                                              \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (false)
+
+namespace {
+
+void PrepareSimpleInputs(
+    std::vector<int32_t>* input0, std::vector<int32_t>* input1,
+    std::vector<tc::InferInput*>* inputs) {
+  input0->resize(16);
+  input1->resize(16);
+  for (int i = 0; i < 16; ++i) {
+    (*input0)[i] = i;
+    (*input1)[i] = 1;
+  }
+  tc::InferInput* in0;
+  tc::InferInput* in1;
+  CHECK_OK(tc::InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32"));
+  CHECK_OK(tc::InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32"));
+  CHECK_OK(in0->AppendRaw(
+      reinterpret_cast<const uint8_t*>(input0->data()),
+      input0->size() * sizeof(int32_t)));
+  CHECK_OK(in1->AppendRaw(
+      reinterpret_cast<const uint8_t*>(input1->data()),
+      input1->size() * sizeof(int32_t)));
+  inputs->push_back(in0);
+  inputs->push_back(in1);
+}
+
+void CheckSimpleResult(
+    tc::InferResult* result, const std::vector<int32_t>& input0,
+    const std::vector<int32_t>& input1) {
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &byte_size));
+  CHECK_TRUE(byte_size == 16 * sizeof(int32_t));
+  const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK_TRUE(sum[i] == input0[i] + input1[i]);
+  CHECK_OK(result->RawData("OUTPUT1", &buf, &byte_size));
+  const int32_t* diff = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK_TRUE(diff[i] == input0[i] - input1[i]);
+  std::vector<int64_t> shape;
+  CHECK_OK(result->Shape("OUTPUT0", &shape));
+  CHECK_TRUE(shape.size() == 2 && shape[0] == 1 && shape[1] == 16);
+  std::string datatype;
+  CHECK_OK(result->Datatype("OUTPUT0", &datatype));
+  CHECK_TRUE(datatype == "INT32");
+}
+
+void TestHttp(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(&client, url));
+
+  bool live = false, ready = false;
+  CHECK_OK(client->IsServerLive(&live));
+  CHECK_TRUE(live);
+  CHECK_OK(client->IsServerReady(&ready));
+  CHECK_TRUE(ready);
+  CHECK_OK(client->IsModelReady(&ready, "simple"));
+  CHECK_TRUE(ready);
+
+  std::string metadata;
+  CHECK_OK(client->ServerMetadata(&metadata));
+  CHECK_TRUE(metadata.find("extensions") != std::string::npos);
+  CHECK_OK(client->ModelMetadata(&metadata, "simple"));
+  tc_tpu::json::Value doc;
+  std::string jerr;
+  CHECK_TRUE(tc_tpu::json::Parse(metadata, &doc, &jerr));
+  CHECK_TRUE(doc.At("name").AsString() == "simple");
+  CHECK_OK(client->ModelConfig(&metadata, "simple"));
+  CHECK_OK(client->ModelRepositoryIndex(&metadata));
+  CHECK_TRUE(metadata.find("simple") != std::string::npos);
+
+  // sync infer
+  std::vector<int32_t> input0, input1;
+  std::vector<tc::InferInput*> inputs;
+  PrepareSimpleInputs(&input0, &input1, &inputs);
+  tc::InferRequestedOutput* out0;
+  tc::InferRequestedOutput* out1;
+  CHECK_OK(tc::InferRequestedOutput::Create(&out0, "OUTPUT0"));
+  CHECK_OK(tc::InferRequestedOutput::Create(&out1, "OUTPUT1"));
+  std::vector<const tc::InferRequestedOutput*> outputs{out0, out1};
+
+  tc::InferOptions options("simple");
+  options.request_id_ = "42";
+  tc::InferResult* result = nullptr;
+  CHECK_OK(client->Infer(&result, options, inputs, outputs));
+  CheckSimpleResult(result, input0, input1);
+  std::string id;
+  CHECK_OK(result->Id(&id));
+  CHECK_TRUE(id == "42");
+  delete result;
+
+  // async infer
+  std::mutex mu;
+  std::condition_variable cv;
+  tc::InferResult* async_result = nullptr;
+  bool done = false;
+  CHECK_OK(client->AsyncInfer(
+      [&](tc::InferResult* r) {
+        std::lock_guard<std::mutex> lk(mu);
+        async_result = r;
+        done = true;
+        cv.notify_one();
+      },
+      options, inputs, outputs));
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+  CHECK_OK(async_result->RequestStatus());
+  CheckSimpleResult(async_result, input0, input1);
+  delete async_result;
+
+  // BYTES round trip via simple_identity
+  tc::InferInput* sin;
+  CHECK_OK(tc::InferInput::Create(&sin, "INPUT0", {1, 3}, "BYTES"));
+  CHECK_OK(sin->AppendFromString({"alpha", "", "gamma"}));
+  tc::InferResult* sresult = nullptr;
+  tc::InferOptions soptions("simple_identity");
+  CHECK_OK(client->Infer(&sresult, soptions, {sin}));
+  std::vector<std::string> strings;
+  CHECK_OK(sresult->StringData("OUTPUT0", &strings));
+  CHECK_TRUE(strings.size() == 3);
+  CHECK_TRUE(strings[0] == "alpha" && strings[1].empty() &&
+             strings[2] == "gamma");
+  delete sresult;
+  delete sin;
+
+  // error surface: unknown model
+  tc::InferResult* bad = nullptr;
+  tc::InferOptions bad_options("no_such_model");
+  tc::Error err = client->Infer(&bad, bad_options, inputs, outputs);
+  CHECK_TRUE(!err.IsOk());
+
+  // stats accounting
+  tc::InferStat stat;
+  CHECK_OK(client->ClientInferStat(&stat));
+  CHECK_TRUE(stat.completed_request_count >= 2);
+
+  for (auto* i : inputs) delete i;
+  delete out0;
+  delete out1;
+  printf("PASS: http client\n");
+}
+
+void TestGrpc(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+
+  bool live = false, ready = false;
+  CHECK_OK(client->IsServerLive(&live));
+  CHECK_TRUE(live);
+  CHECK_OK(client->IsServerReady(&ready));
+  CHECK_TRUE(ready);
+  CHECK_OK(client->IsModelReady(&ready, "simple"));
+  CHECK_TRUE(ready);
+
+  tc::pb::ServerMetadataResponse server_md;
+  CHECK_OK(client->ServerMetadata(&server_md));
+  CHECK_TRUE(!server_md.name().empty());
+  tc::pb::ModelMetadataResponse model_md;
+  CHECK_OK(client->ModelMetadata(&model_md, "simple"));
+  CHECK_TRUE(model_md.name() == "simple");
+  CHECK_TRUE(model_md.inputs_size() == 2);
+  tc::pb::ModelConfigResponse model_cfg;
+  CHECK_OK(client->ModelConfig(&model_cfg, "simple"));
+  CHECK_TRUE(model_cfg.config().name() == "simple");
+  tc::pb::RepositoryIndexResponse index;
+  CHECK_OK(client->ModelRepositoryIndex(&index));
+  CHECK_TRUE(index.models_size() > 0);
+
+  std::vector<int32_t> input0, input1;
+  std::vector<tc::InferInput*> inputs;
+  PrepareSimpleInputs(&input0, &input1, &inputs);
+  tc::InferRequestedOutput* out0;
+  tc::InferRequestedOutput* out1;
+  CHECK_OK(tc::InferRequestedOutput::Create(&out0, "OUTPUT0"));
+  CHECK_OK(tc::InferRequestedOutput::Create(&out1, "OUTPUT1"));
+  std::vector<const tc::InferRequestedOutput*> outputs{out0, out1};
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  CHECK_OK(client->Infer(&result, options, inputs, outputs));
+  CheckSimpleResult(result, input0, input1);
+  delete result;
+
+  // async
+  std::mutex mu;
+  std::condition_variable cv;
+  tc::InferResult* async_result = nullptr;
+  bool done = false;
+  CHECK_OK(client->AsyncInfer(
+      [&](tc::InferResult* r) {
+        std::lock_guard<std::mutex> lk(mu);
+        async_result = r;
+        done = true;
+        cv.notify_one();
+      },
+      options, inputs, outputs));
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+  CHECK_OK(async_result->RequestStatus());
+  CheckSimpleResult(async_result, input0, input1);
+  delete async_result;
+
+  // streaming: a 3-step sequence through the stream API
+  std::vector<int32_t> seq_outputs;
+  CHECK_OK(client->StartStream([&](tc::InferResult* r) {
+    tc::Error status = r->RequestStatus();
+    if (status.IsOk()) {
+      const uint8_t* buf;
+      size_t len;
+      if (r->RawData("OUTPUT", &buf, &len).IsOk() && len >= 4) {
+        int32_t v;
+        memcpy(&v, buf, 4);
+        seq_outputs.push_back(v);
+      }
+    }
+    delete r;
+  }));
+  std::vector<int32_t> values{11, 7, 5};
+  for (size_t i = 0; i < values.size(); ++i) {
+    tc::InferInput* sin;
+    CHECK_OK(tc::InferInput::Create(&sin, "INPUT", {1}, "INT32"));
+    CHECK_OK(sin->AppendRaw(
+        reinterpret_cast<const uint8_t*>(&values[i]), sizeof(int32_t)));
+    tc::InferOptions sopt("simple_sequence");
+    sopt.sequence_id_ = 777;
+    sopt.sequence_start_ = (i == 0);
+    sopt.sequence_end_ = (i == values.size() - 1);
+    CHECK_OK(client->AsyncStreamInfer(sopt, {sin}));
+    delete sin;
+  }
+  CHECK_OK(client->FinishStream());
+  CHECK_TRUE(seq_outputs.size() == 3);
+  CHECK_TRUE(seq_outputs[0] == 11 && seq_outputs[1] == 18 &&
+             seq_outputs[2] == 23);
+
+  // error surface
+  tc::InferResult* bad = nullptr;
+  tc::InferOptions bad_options("no_such_model");
+  tc::Error err = client->Infer(&bad, bad_options, inputs, outputs);
+  CHECK_TRUE(!err.IsOk());
+
+  for (auto* i : inputs) delete i;
+  delete out0;
+  delete out1;
+  printf("PASS: grpc client\n");
+}
+
+void TestJson() {
+  tc_tpu::json::Value doc;
+  std::string err;
+  CHECK_TRUE(tc_tpu::json::Parse(
+      R"({"a": [1, 2.5, "xé", true, null], "b": {"c": -3}})", &doc, &err));
+  CHECK_TRUE(doc.At("a").AsArray().size() == 5);
+  CHECK_TRUE(doc.At("a").AsArray()[0].AsInt() == 1);
+  CHECK_TRUE(doc.At("a").AsArray()[1].AsDouble() == 2.5);
+  CHECK_TRUE(doc.At("a").AsArray()[2].AsString() == "x\xc3\xa9");
+  CHECK_TRUE(doc.At("b").At("c").AsInt() == -3);
+  std::string round = doc.Serialize();
+  tc_tpu::json::Value doc2;
+  CHECK_TRUE(tc_tpu::json::Parse(round, &doc2, &err));
+  CHECK_TRUE(doc2.Serialize() == round);
+  CHECK_TRUE(!tc_tpu::json::Parse("{bad", &doc, &err));
+  printf("PASS: json\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <http_host:port>\n", argv[0]);
+    return 2;
+  }
+  TestJson();
+  TestHttp(argv[1]);
+  // gRPC-web rides the same HTTP port (server bridge)
+  TestGrpc(argv[1]);
+  printf("PASS: all\n");
+  return 0;
+}

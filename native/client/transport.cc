@@ -1,0 +1,269 @@
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+namespace tc_tpu {
+namespace client {
+
+namespace {
+
+std::string LowerCopy(const std::string& s) {
+  std::string out = s;
+  std::transform(out.begin(), out.end(), out.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return out;
+}
+
+bool ReadExact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteAll(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t w = ::send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    sent += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string Base64Encode(const uint8_t* data, size_t len) {
+  static const char tbl[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve(((len + 2) / 3) * 4);
+  for (size_t i = 0; i < len; i += 3) {
+    uint32_t n = data[i] << 16;
+    if (i + 1 < len) n |= data[i + 1] << 8;
+    if (i + 2 < len) n |= data[i + 2];
+    out += tbl[(n >> 18) & 63];
+    out += tbl[(n >> 12) & 63];
+    out += (i + 1 < len) ? tbl[(n >> 6) & 63] : '=';
+    out += (i + 2 < len) ? tbl[n & 63] : '=';
+  }
+  return out;
+}
+
+HttpTransport::HttpTransport(std::string host, int port, size_t max_idle_conns)
+    : host_(std::move(host)), port_(port), max_idle_(max_idle_conns) {}
+
+HttpTransport::~HttpTransport() {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (int fd : idle_) ::close(fd);
+  idle_.clear();
+}
+
+int HttpTransport::Connect(Error* err) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!idle_.empty()) {
+      int fd = idle_.back();
+      idle_.pop_back();
+      return fd;
+    }
+  }
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char port_str[16];
+  snprintf(port_str, sizeof(port_str), "%d", port_);
+  int rc = ::getaddrinfo(host_.c_str(), port_str, &hints, &res);
+  if (rc != 0) {
+    *err = Error(std::string("failed to resolve host: ") + gai_strerror(rc));
+    return -1;
+  }
+  int fd = -1;
+  for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) {
+    *err = Error("failed to connect to " + host_ + ":" + port_str);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void HttpTransport::Release(int fd, bool reusable) {
+  if (!reusable) {
+    ::close(fd);
+    return;
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  if (idle_.size() >= max_idle_) {
+    ::close(fd);
+  } else {
+    idle_.push_back(fd);
+  }
+}
+
+Error HttpTransport::Request(
+    const std::string& method, const std::string& path,
+    const std::string& body, const Headers& extra_headers, Response* out,
+    RequestTimers* timers) {
+  Error err;
+  int fd = Connect(&err);
+  if (fd < 0) return err;
+
+  std::ostringstream req;
+  req << method << " /" << path << " HTTP/1.1\r\n";
+  req << "Host: " << host_ << ":" << port_ << "\r\n";
+  req << "Connection: keep-alive\r\n";
+  req << "Content-Length: " << body.size() << "\r\n";
+  bool has_ct = false;
+  for (const auto& kv : extra_headers) {
+    if (LowerCopy(kv.first) == "content-type") has_ct = true;
+    req << kv.first << ": " << kv.second << "\r\n";
+  }
+  if (!has_ct && method == "POST") {
+    req << "Content-Type: application/octet-stream\r\n";
+  }
+  req << "\r\n";
+  std::string head = req.str();
+
+  if (timers) timers->CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  bool ok = WriteAll(fd, head.data(), head.size()) &&
+            (body.empty() || WriteAll(fd, body.data(), body.size()));
+  if (timers) timers->CaptureTimestamp(RequestTimers::Kind::SEND_END);
+  if (!ok) {
+    Release(fd, false);
+    return Error("failed to send request to " + host_);
+  }
+
+  if (timers) timers->CaptureTimestamp(RequestTimers::Kind::RECV_START);
+  std::string buf;
+  buf.reserve(8192);
+  char chunk[8192];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (r <= 0) {
+      Release(fd, false);
+      return Error("connection closed while reading response headers");
+    }
+    buf.append(chunk, static_cast<size_t>(r));
+    header_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 20)) {
+      Release(fd, false);
+      return Error("response headers too large");
+    }
+  }
+
+  std::string head_str = buf.substr(0, header_end);
+  std::string rest = buf.substr(header_end + 4);
+  std::istringstream hs(head_str);
+  std::string status_line;
+  std::getline(hs, status_line);
+  if (!status_line.empty() && status_line.back() == '\r') status_line.pop_back();
+  int status = 0;
+  {
+    auto sp = status_line.find(' ');
+    if (sp != std::string::npos) status = atoi(status_line.c_str() + sp + 1);
+  }
+  Headers resp_headers;
+  std::string line;
+  while (std::getline(hs, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    auto colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = LowerCopy(line.substr(0, colon));
+    size_t vstart = colon + 1;
+    while (vstart < line.size() && line[vstart] == ' ') ++vstart;
+    resp_headers[key] = line.substr(vstart);
+  }
+
+  std::string resp_body;
+  bool keep_alive = true;
+  auto te = resp_headers.find("transfer-encoding");
+  if (te != resp_headers.end() &&
+      LowerCopy(te->second).find("chunked") != std::string::npos) {
+    std::string stream = std::move(rest);
+    size_t pos = 0;
+    while (true) {
+      size_t nl = stream.find("\r\n", pos);
+      while (nl == std::string::npos) {
+        ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+        if (r <= 0) {
+          Release(fd, false);
+          return Error("connection closed mid-chunk");
+        }
+        stream.append(chunk, static_cast<size_t>(r));
+        nl = stream.find("\r\n", pos);
+      }
+      size_t chunk_len =
+          strtoul(stream.substr(pos, nl - pos).c_str(), nullptr, 16);
+      size_t data_start = nl + 2;
+      while (stream.size() < data_start + chunk_len + 2) {
+        ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+        if (r <= 0) {
+          Release(fd, false);
+          return Error("connection closed mid-chunk");
+        }
+        stream.append(chunk, static_cast<size_t>(r));
+      }
+      if (chunk_len == 0) break;
+      resp_body.append(stream, data_start, chunk_len);
+      pos = data_start + chunk_len + 2;
+    }
+  } else {
+    auto cl = resp_headers.find("content-length");
+    size_t want = cl != resp_headers.end()
+                      ? strtoul(cl->second.c_str(), nullptr, 10)
+                      : 0;
+    resp_body = std::move(rest);
+    if (resp_body.size() < want) {
+      size_t missing = want - resp_body.size();
+      size_t old = resp_body.size();
+      resp_body.resize(want);
+      if (!ReadExact(fd, &resp_body[old], missing)) {
+        Release(fd, false);
+        return Error("connection closed while reading response body");
+      }
+    } else if (resp_body.size() > want) {
+      resp_body.resize(want);
+    }
+    if (cl == resp_headers.end()) keep_alive = false;
+  }
+  if (timers) timers->CaptureTimestamp(RequestTimers::Kind::RECV_END);
+
+  auto conn_hdr = resp_headers.find("connection");
+  if (conn_hdr != resp_headers.end() &&
+      LowerCopy(conn_hdr->second) == "close") {
+    keep_alive = false;
+  }
+  Release(fd, keep_alive);
+
+  out->status = status;
+  out->headers = std::move(resp_headers);
+  out->body = std::move(resp_body);
+  return Error::Success;
+}
+
+}  // namespace client
+}  // namespace tc_tpu

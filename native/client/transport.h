@@ -1,0 +1,54 @@
+// Shared HTTP/1.1 socket transport used by both C++ clients (the HTTP
+// client directly; the gRPC client for gRPC-Web framed requests).
+// Dependency-free replacement for the reference's libcurl/grpc++ transports.
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace tc_tpu {
+namespace client {
+
+using Headers = std::map<std::string, std::string>;
+
+class HttpTransport {
+ public:
+  struct Response {
+    int status = 0;
+    Headers headers;  // lower-cased keys
+    std::string body;
+  };
+
+  HttpTransport(std::string host, int port, size_t max_idle_conns);
+  ~HttpTransport();
+
+  HttpTransport(const HttpTransport&) = delete;
+  HttpTransport& operator=(const HttpTransport&) = delete;
+
+  const std::string& host() const { return host_; }
+  int port() const { return port_; }
+
+  Error Request(
+      const std::string& method, const std::string& path,
+      const std::string& body, const Headers& extra_headers, Response* out,
+      RequestTimers* timers = nullptr);
+
+ private:
+  int Connect(Error* err);
+  void Release(int fd, bool reusable);
+
+  std::string host_;
+  int port_;
+  size_t max_idle_;
+  std::mutex mu_;
+  std::vector<int> idle_;
+};
+
+std::string Base64Encode(const uint8_t* data, size_t len);
+
+}  // namespace client
+}  // namespace tc_tpu

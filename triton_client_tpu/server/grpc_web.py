@@ -1,0 +1,139 @@
+"""gRPC-Web bridge: the v2 gRPC service over HTTP/1.1 framing.
+
+Why this exists: the C++ client library runs in environments without grpc++
+(this image included), so it speaks the standard gRPC-Web wire format —
+``POST /inference.GRPCInferenceService/<Method>`` with
+``application/grpc-web+proto`` bodies of ``<1B flags><4B BE length><pb>``
+frames; responses carry data frames plus a trailers frame (flags 0x80) with
+``grpc-status``/``grpc-message``.  Any stock gRPC-Web client interops too.
+
+Server-streaming RPCs (ModelStreamInfer) emit one data frame per response
+message.  Client-side streaming over gRPC-Web is half-duplex by protocol
+design: all request messages travel in the request body.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+from aiohttp import web
+
+from ..protocol.service import METHODS, SERVICE_NAME
+
+_CONTENT_TYPES = (
+    "application/grpc-web+proto",
+    "application/grpc-web",
+    "application/grpc",  # tolerated: same framing for our purposes
+)
+
+
+class _AbortError(Exception):
+    def __init__(self, code, details: str):
+        self.code = code
+        self.details = details
+        super().__init__(details)
+
+
+class _WebContext:
+    """Minimal grpc context stand-in for servicer calls."""
+
+    async def abort(self, code, details: str):
+        raise _AbortError(code, details)
+
+    def set_code(self, code):  # pragma: no cover - parity no-op
+        self._code = code
+
+    def set_details(self, details):  # pragma: no cover - parity no-op
+        self._details = details
+
+
+def _frame(payload: bytes, flags: int = 0) -> bytes:
+    return struct.pack(">BI", flags, len(payload)) + payload
+
+
+def _parse_frames(body: bytes) -> List[bytes]:
+    frames = []
+    pos = 0
+    while pos + 5 <= len(body):
+        flags, length = struct.unpack_from(">BI", body, pos)
+        pos += 5
+        if pos + length > len(body):
+            raise ValueError("truncated grpc-web frame")
+        if not flags & 0x80:  # ignore client trailers
+            frames.append(body[pos : pos + length])
+        pos += length
+    return frames
+
+
+def _trailers(status: int, message: str = "") -> bytes:
+    text = f"grpc-status:{status}\r\n"
+    if message:
+        text += f"grpc-message:{_percent_encode(message)}\r\n"
+    return _frame(text.encode("utf-8"), flags=0x80)
+
+
+def _percent_encode(msg: str) -> str:
+    # grpc-message is percent-encoded per the gRPC spec
+    out = []
+    for b in msg.encode("utf-8"):
+        if b in (0x25,) or b < 0x20 or b > 0x7E:
+            out.append(f"%{b:02X}")
+        else:
+            out.append(chr(b))
+    return "".join(out)
+
+
+def add_grpc_web_routes(app: web.Application, servicer) -> None:
+    for method, (arity, req_type, _resp_type) in METHODS.items():
+        path = f"/{SERVICE_NAME}/{method}"
+        app.router.add_post(
+            path, _make_handler(servicer, method, arity, req_type)
+        )
+
+
+def _make_handler(servicer, method: str, arity: str, req_type):
+    async def handler(request: web.Request) -> web.Response:
+        ct = request.content_type
+        if ct not in _CONTENT_TYPES:
+            return web.Response(status=415, text=f"unsupported content type {ct}")
+        body = await request.read()
+        out = b""
+        status, message = 0, ""
+        try:
+            frames = _parse_frames(body)
+            messages = []
+            for f in frames:
+                msg = req_type()
+                msg.ParseFromString(f)
+                messages.append(msg)
+            ctx = _WebContext()
+            fn = getattr(servicer, method)
+            if arity == "uu":
+                if not messages:
+                    raise ValueError("missing request message")
+                resp = await fn(messages[0], ctx)
+                out = _frame(resp.SerializeToString())
+            else:  # stream-stream: feed all client messages, stream responses
+
+                async def _req_iter():
+                    for m in messages:
+                        yield m
+
+                async for resp in fn(_req_iter(), ctx):
+                    out += _frame(resp.SerializeToString())
+        except _AbortError as e:
+            # grpc.StatusCode.X.value is an (int, str) tuple
+            code = getattr(e.code, "value", e.code)
+            status = code[0] if isinstance(code, tuple) else int(code)
+            message = e.details
+        except Exception as e:
+            status, message = 13, str(e)  # INTERNAL
+        out += _trailers(status, message)
+        return web.Response(
+            body=out,
+            content_type="application/grpc-web+proto",
+            headers={"grpc-status": str(status)},
+        )
+
+    return handler

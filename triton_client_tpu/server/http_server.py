@@ -62,6 +62,13 @@ def build_app(core: InferenceCore) -> web.Application:
         r.add_post(f"/v2/{kind}/region/{{name}}/register", _h(core, _shm_register))
         r.add_post(f"/v2/{kind}/unregister", _h(core, _shm_unregister))
         r.add_post(f"/v2/{kind}/region/{{name}}/unregister", _h(core, _shm_unregister))
+
+    # gRPC-Web bridge: the full v2 gRPC service over HTTP/1.1 framing (used
+    # by the C++ gRPC client; interops with stock gRPC-Web stubs).
+    from .grpc_server import InferenceServicer
+    from .grpc_web import add_grpc_web_routes
+
+    add_grpc_web_routes(app, InferenceServicer(core))
     return app
 
 
