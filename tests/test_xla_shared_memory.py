@@ -96,6 +96,24 @@ class TestNumpy:
         with pytest.raises(xlashm.XlaSharedMemoryException):
             xlashm.create_shared_memory_region("bad_dev", 64, 99)
 
+    def test_offset_write_preserves_prior_contents(self):
+        # Regression: an offset write after a typed single-value write must
+        # not wipe the earlier bytes (reference cudashm leaves the rest of
+        # the allocation intact on offset writes).
+        first = np.arange(8, dtype=np.int32)          # 32 bytes at offset 0
+        second = np.arange(100, 104, dtype=np.int32)  # 16 bytes at offset 32
+        h = xlashm.create_shared_memory_region("off_region", 64, 0)
+        try:
+            xlashm.set_shared_memory_region(h, [first])
+            xlashm.set_shared_memory_region(h, [second], offset=first.nbytes)
+            got_first = xlashm.get_contents_as_numpy(h, np.int32, [8])
+            got_second = xlashm.get_contents_as_numpy(
+                h, np.int32, [4], offset=first.nbytes)
+            np.testing.assert_array_equal(got_first, first)
+            np.testing.assert_array_equal(got_second, second)
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
 
 class TestStagingImport:
     """Cross-process import path: the server-side registry must fall back to

@@ -213,8 +213,13 @@ def set_shared_memory_region(
         cur, _, _ = xla_shm_handle._slot.get()
         size = xla_shm_handle._byte_size
         buf = np.zeros((size,), np.uint8)
-        if cur is not None and cur.dtype == np.uint8 and cur.size == size:
-            buf = np.asarray(cur).copy()
+        if cur is not None:
+            # Preserve whatever the region already holds (reference cudashm
+            # offset writes leave the rest of the allocation intact) — the
+            # current slot may be a typed array from a prior single-value
+            # write, not just a full-size uint8 buffer.
+            cur_bytes = np.ascontiguousarray(np.asarray(cur)).reshape(-1).view(np.uint8)
+            buf[: min(cur_bytes.size, size)] = cur_bytes[: min(cur_bytes.size, size)]
         buf[offset : offset + flat.size] = flat
         arr = jax.device_put(buf, dev)
         _bind(xla_shm_handle, arr, "UINT8", (size,))
