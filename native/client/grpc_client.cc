@@ -62,6 +62,11 @@ std::string PercentDecode(const std::string& s) {
 }
 
 Error StatusFromTrailers(const std::string& trailers) {
+  if (trailers.empty()) {
+    // A well-formed grpc-web response always ends in a trailers frame with
+    // grpc-status; a missing frame means the body was truncated.
+    return Error("response missing grpc-web trailers frame");
+  }
   int status = 0;
   std::string message;
   size_t pos = 0;

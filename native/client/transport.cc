@@ -233,22 +233,40 @@ Error HttpTransport::Request(
     }
   } else {
     auto cl = resp_headers.find("content-length");
-    size_t want = cl != resp_headers.end()
-                      ? strtoul(cl->second.c_str(), nullptr, 10)
-                      : 0;
     resp_body = std::move(rest);
-    if (resp_body.size() < want) {
-      size_t missing = want - resp_body.size();
-      size_t old = resp_body.size();
-      resp_body.resize(want);
-      if (!ReadExact(fd, &resp_body[old], missing)) {
-        Release(fd, false);
-        return Error("connection closed while reading response body");
+    if (cl != resp_headers.end()) {
+      size_t want = strtoul(cl->second.c_str(), nullptr, 10);
+      if (resp_body.size() < want) {
+        size_t missing = want - resp_body.size();
+        size_t old = resp_body.size();
+        resp_body.resize(want);
+        if (!ReadExact(fd, &resp_body[old], missing)) {
+          Release(fd, false);
+          return Error("connection closed while reading response body");
+        }
+      } else if (resp_body.size() > want) {
+        resp_body.resize(want);
       }
-    } else if (resp_body.size() > want) {
-      resp_body.resize(want);
+    } else if (status == 204 || status == 304 || status < 200) {
+      // These statuses never carry a body (HTTP/1.1 §3.3.3) — absent
+      // framing headers do not make them close-delimited.
+      resp_body.clear();
+    } else {
+      // Close-delimited body (HTTP/1.1 §3.3.3): no framing header means
+      // the body runs until the peer cleanly closes the connection.  Only
+      // an orderly FIN (r == 0) terminates the body; a socket error means
+      // the response was truncated.
+      for (;;) {
+        ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+        if (r == 0) break;
+        if (r < 0) {
+          Release(fd, false);
+          return Error("connection error while reading response body");
+        }
+        resp_body.append(chunk, static_cast<size_t>(r));
+      }
+      keep_alive = false;
     }
-    if (cl == resp_headers.end()) keep_alive = false;
   }
   if (timers) timers->CaptureTimestamp(RequestTimers::Kind::RECV_END);
 

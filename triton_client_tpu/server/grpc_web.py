@@ -92,48 +92,98 @@ def add_grpc_web_routes(app: web.Application, servicer) -> None:
         )
 
 
+def _status_of(exc: _AbortError):
+    # grpc.StatusCode.X.value is an (int, str) tuple
+    code = getattr(exc.code, "value", exc.code)
+    return code[0] if isinstance(code, tuple) else int(code)
+
+
+async def _read_messages(stream, req_type):
+    """Incrementally parse grpc-web frames off the (possibly still-open)
+    request body, yielding decoded messages as they arrive.  This is what
+    makes interleaved sequence streaming work: the servicer sees request N
+    while the client is still producing request N+1."""
+    buf = b""
+    while True:
+        while len(buf) < 5:
+            chunk = await stream.readany()
+            if not chunk:
+                if buf:
+                    raise ValueError("truncated grpc-web frame")
+                return
+            buf += chunk
+        flags, length = struct.unpack_from(">BI", buf, 0)
+        while len(buf) < 5 + length:
+            chunk = await stream.readany()
+            if not chunk:
+                raise ValueError("truncated grpc-web frame")
+            buf += chunk
+        payload = bytes(buf[5 : 5 + length])
+        buf = buf[5 + length :]
+        if not flags & 0x80:  # ignore client trailers
+            msg = req_type()
+            msg.ParseFromString(payload)
+            yield msg
+
+
 def _make_handler(servicer, method: str, arity: str, req_type):
-    async def handler(request: web.Request) -> web.Response:
-        ct = request.content_type
-        if ct not in _CONTENT_TYPES:
-            return web.Response(status=415, text=f"unsupported content type {ct}")
-        body = await request.read()
-        out = b""
-        status, message = 0, ""
-        try:
-            frames = _parse_frames(body)
-            messages = []
-            for f in frames:
-                msg = req_type()
-                msg.ParseFromString(f)
-                messages.append(msg)
-            ctx = _WebContext()
-            fn = getattr(servicer, method)
-            if arity == "uu":
-                if not messages:
+    if arity == "uu":
+
+        async def handler(request: web.Request) -> web.Response:
+            ct = request.content_type
+            if ct not in _CONTENT_TYPES:
+                return web.Response(
+                    status=415, text=f"unsupported content type {ct}")
+            body = await request.read()
+            out = b""
+            status, message = 0, ""
+            try:
+                frames = _parse_frames(body)
+                if not frames:
                     raise ValueError("missing request message")
-                resp = await fn(messages[0], ctx)
+                msg = req_type()
+                msg.ParseFromString(frames[0])
+                resp = await getattr(servicer, method)(msg, _WebContext())
                 out = _frame(resp.SerializeToString())
-            else:  # stream-stream: feed all client messages, stream responses
+            except _AbortError as e:
+                status, message = _status_of(e), e.details
+            except Exception as e:
+                status, message = 13, str(e)  # INTERNAL
+            out += _trailers(status, message)
+            return web.Response(
+                body=out,
+                content_type="application/grpc-web+proto",
+                headers={"grpc-status": str(status)},
+            )
 
-                async def _req_iter():
-                    for m in messages:
-                        yield m
+    else:  # stream-stream: incremental duplex over HTTP/1.1 chunked coding
 
-                async for resp in fn(_req_iter(), ctx):
-                    out += _frame(resp.SerializeToString())
-        except _AbortError as e:
-            # grpc.StatusCode.X.value is an (int, str) tuple
-            code = getattr(e.code, "value", e.code)
-            status = code[0] if isinstance(code, tuple) else int(code)
-            message = e.details
-        except Exception as e:
-            status, message = 13, str(e)  # INTERNAL
-        out += _trailers(status, message)
-        return web.Response(
-            body=out,
-            content_type="application/grpc-web+proto",
-            headers={"grpc-status": str(status)},
-        )
+        async def handler(request: web.Request) -> web.StreamResponse:
+            ct = request.content_type
+            if ct not in _CONTENT_TYPES:
+                return web.Response(
+                    status=415, text=f"unsupported content type {ct}")
+            resp = web.StreamResponse(status=200)
+            resp.content_type = "application/grpc-web+proto"
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            status, message = 0, ""
+            try:
+                fn = getattr(servicer, method)
+                req_iter = _read_messages(request.content, req_type)
+                async for r in fn(req_iter, _WebContext()):
+                    await resp.write(_frame(r.SerializeToString()))
+            except _AbortError as e:
+                status, message = _status_of(e), e.details
+            except ConnectionResetError:
+                return resp  # client went away mid-stream
+            except Exception as e:
+                status, message = 13, str(e)  # INTERNAL
+            try:
+                await resp.write(_trailers(status, message))
+                await resp.write_eof()
+            except ConnectionResetError:
+                pass
+            return resp
 
     return handler
