@@ -2,21 +2,75 @@
 
 Runs the in-process serving harness (HTTP + gRPC frontends over the jax
 `simple` sum/diff model — BASELINE config #1) and drives it with the sync
-gRPC client at concurrency, perf_analyzer style.  Prints ONE JSON line:
-``{"metric", "value", "unit", "vs_baseline", ...extras}``.
+gRPC client at concurrency, perf_analyzer style.  Also sweeps the TPU-resident
+``dense_tpu`` model (BASELINE config #4 dynamic-batching contract) at higher
+concurrency so batches coalesce.
 
-The reference publishes no numbers (SURVEY.md §6), so ``vs_baseline`` is
-relative to the first recorded round (1.0 when no prior record exists).
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline", ...}``.
+
+The reference publishes no numbers (SURVEY.md §6), so ``vs_baseline`` compares
+the headline metric against the earliest recorded round (``BENCH_r*.json``
+written by the driver; 1.0 when none exists).
+
+Interpreting the TPU numbers: on this bench host the single chip is reached
+through a tunnel whose device round trip is ~100 ms (reported here as
+``tpu_rtt_floor_ms``, measured as a blocking device_put+readback).  Per-request
+p50 on a synchronous closed loop is floored by that RTT no matter how fast the
+server is; the honest health signals are (a) p50 staying near the floor (server
+overhead ≈ p50 − floor) and (b) throughput scaling past 1/RTT via dynamic
+batching + pipelined dispatch.
 """
 
 from __future__ import annotations
 
+import glob
 import json
+import os
+import re
 import sys
 import threading
 import time
 
 import numpy as np
+
+
+def _previous_baseline() -> float | None:
+    """Headline value from the earliest recorded round (driver-written
+    BENCH_r{N}.json files at the repo root)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed") or {}
+        value = parsed.get("value")
+        if isinstance(value, (int, float)) and value > 0:
+            rounds.append((int(m.group(1)), float(value)))
+    if not rounds:
+        return None
+    return min(rounds)[1]
+
+
+def _measure_rtt_floor() -> float:
+    """Median blocking device round trip (H2D + sync + D2H) in ms — the
+    physical latency floor for any synchronous per-request device path."""
+    import jax
+
+    dev = jax.devices()[0]
+    x = np.ones((8, 512), np.float32)
+    np.asarray(jax.device_put(x, dev))  # warm the transfer path
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_put(x, dev))
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples) * 1e3)
 
 
 def main() -> int:
@@ -31,7 +85,6 @@ def main() -> int:
     harness.start()
 
     url = f"127.0.0.1:{harness.grpc_port}"
-    concurrency = 8
 
     def simple_inputs():
         a = np.arange(16, dtype=np.int32).reshape(1, 16)
@@ -48,7 +101,21 @@ def main() -> int:
         i.set_data_from_numpy(x)
         return [i]
 
-    def sweep(model_name, inputs_fn, warmup_s=2.0, measure_s=5.0):
+    # Blocking warm-up infer per model BEFORE any clock starts: the first
+    # request pays XLA compilation (tens of seconds on the real chip), which
+    # must never sit inside a measured latency.
+    warm = InferenceServerClient(url)
+    warm.infer("simple", simple_inputs())
+    # Warm every preferred batch bucket: the batcher pads to bucket shapes so
+    # XLA compiles a bounded set — each must be compiled before the clock runs.
+    for b in (1, 8, 16, 32, 64):
+        x = np.zeros((b, 512), np.float32)
+        i = InferInput("INPUT", [b, 512], "FP32")
+        i.set_data_from_numpy(x)
+        warm.infer("dense_tpu", [i])
+    warm.close()
+
+    def sweep(model_name, inputs_fn, concurrency, warmup_s=1.0, measure_s=5.0):
         """perf_analyzer-style fixed-concurrency closed-loop sweep."""
         latencies: list = []
         counts = [0] * concurrency
@@ -98,22 +165,29 @@ def main() -> int:
             "total": total,
         }
 
-    simple_res = sweep("simple", simple_inputs)
-    dense_res = sweep("dense_tpu", dense_inputs, warmup_s=4.0)
+    simple_res = sweep("simple", simple_inputs, concurrency=8)
+    # higher concurrency on the device path so the dynamic batcher coalesces
+    # full batches and multiple batches pipeline over the device link
+    dense_res = sweep("dense_tpu", dense_inputs, concurrency=64, warmup_s=2.0)
+    rtt_floor_ms = _measure_rtt_floor()
     harness.stop()
 
+    baseline = _previous_baseline()
+    value = simple_res["infer_per_sec"]
     errors = simple_res["errors"] + dense_res["errors"]
     out = {
         "metric": "grpc_infer_throughput_simple_c8",
-        "value": simple_res["infer_per_sec"],
+        "value": value,
         "unit": "infer/sec",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(value / baseline, 3) if baseline else 1.0,
         "p50_ms": simple_res["p50_ms"],
         "p99_ms": simple_res["p99_ms"],
         "tpu_batched_infer_per_sec": dense_res["infer_per_sec"],
         "tpu_batched_p50_ms": dense_res["p50_ms"],
         "tpu_batched_p99_ms": dense_res["p99_ms"],
-        "concurrency": concurrency,
+        "tpu_rtt_floor_ms": round(rtt_floor_ms, 3),
+        "concurrency": 8,
+        "tpu_concurrency": 64,
     }
     if errors:
         out["errors"] = errors[:4]

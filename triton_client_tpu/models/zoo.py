@@ -22,6 +22,7 @@ Behavioral specs come from the examples (SURVEY.md §2.7):
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Any, Dict, Iterator
 
 import numpy as np
@@ -126,7 +127,20 @@ class SequenceModel(Model):
         )
         super().__init__(cfg)
         self._state: Dict[Any, int] = {}
+        self._touched: Dict[Any, float] = {}
+        self._idle_s = (
+            cfg.sequence_batching.max_sequence_idle_microseconds / 1e6)
         self._lock = threading.Lock()
+
+    def _evict_idle_locked(self, now: float) -> None:
+        # Sequences whose client died mid-stream never send sequence_end;
+        # without eviction the state dict grows without bound (Triton's
+        # max_sequence_idle_microseconds semantics).
+        stale = [k for k, t in self._touched.items()
+                 if now - t > self._idle_s]
+        for k in stale:
+            self._state.pop(k, None)
+            self._touched.pop(k, None)
 
     def execute(self, inputs, parameters):
         seq_id = parameters.get("sequence_id", 0)
@@ -140,13 +154,18 @@ class SequenceModel(Model):
                 "non-zero or non-empty correlation ID"
             )
         value = int(np.asarray(inputs["INPUT"]).reshape(-1)[0])
+        now = _time.monotonic()
         with self._lock:
+            self._evict_idle_locked(now)
             if start or seq_id not in self._state:
                 self._state[seq_id] = 0
             self._state[seq_id] += value
             acc = self._state[seq_id]
             if end:
                 del self._state[seq_id]
+                self._touched.pop(seq_id, None)
+            else:
+                self._touched[seq_id] = now
         return {"OUTPUT": np.array([acc], dtype=np.int32).reshape(1)}
 
 
@@ -170,6 +189,7 @@ class DynaSequenceModel(SequenceModel):
             corr = (hash(str(seq_id)) % 1000) if isinstance(seq_id, str) else int(seq_id)
             with self._lock:
                 self._state[seq_id] = int(np.int64(corr).astype(np.int32))
+                self._touched[seq_id] = _time.monotonic()
             parameters = dict(parameters)
             parameters["sequence_start"] = False
         return super().execute(inputs, parameters)

@@ -48,6 +48,11 @@ class _DynamicBatcher:
     of shapes, executes once, splits results.
     """
 
+    # Batches in flight concurrently: device dispatch is async, so letting
+    # several padded batches ride the (possibly high-RTT) device link at once
+    # converts per-batch latency into pipeline throughput.
+    MAX_INFLIGHT = 4
+
     def __init__(self, core: "InferenceCore", model: Model):
         self._core = core
         self._model = model
@@ -57,6 +62,8 @@ class _DynamicBatcher:
         self._max_bs = model.config.max_batch_size
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        self._inflight = asyncio.Semaphore(self.MAX_INFLIGHT)
+        self._batch_tasks: set = set()
 
     def start(self) -> None:
         if self._task is None or self._task.done():
@@ -88,7 +95,16 @@ class _DynamicBatcher:
                         break
                     pending.append(item)
                     total += _batch_count(item[0])
-                await self._execute_batch(pending)
+                await self._inflight.acquire()
+                task = asyncio.get_running_loop().create_task(
+                    self._execute_batch(pending))
+                self._batch_tasks.add(task)
+
+                def _done(t, *, _self=self):
+                    _self._inflight.release()
+                    _self._batch_tasks.discard(t)
+
+                task.add_done_callback(_done)
                 pending = []
         except asyncio.CancelledError:
             # shutdown mid-batch: fail whatever we were holding
@@ -98,6 +114,17 @@ class _DynamicBatcher:
             raise
 
     async def _execute_batch(self, pending) -> None:
+        # Requests with different parameters must not share an execution —
+        # the model sees one parameters dict per execute (reference dynamic
+        # batching merges only parameter-compatible requests).
+        groups: Dict[tuple, list] = {}
+        for item in pending:
+            key = tuple(sorted((k, repr(v)) for k, v in item[1].items()))
+            groups.setdefault(key, []).append(item)
+        await asyncio.gather(
+            *(self._execute_group(g) for g in groups.values()))
+
+    async def _execute_group(self, pending) -> None:
         counts = [_batch_count(p[0]) for p in pending]
         total = sum(counts)
         padded = total
@@ -117,13 +144,17 @@ class _DynamicBatcher:
                 merged[n] = arr
             queue_ns = time.monotonic_ns() - pending[0][3]
             t0 = time.monotonic_ns()
-            outputs = await self._core._run_model(self._model, merged, pending[0][1])
+            # resolve_host: D2H happens on the executor thread, not the event
+            # loop — a blocking np.asarray here would stall every other
+            # request for the full device round trip.
+            outputs = await self._core._run_model(
+                self._model, merged, pending[0][1], resolve_host=True)
             compute_ns = time.monotonic_ns() - t0
             self._model.stats.record(total, queue_ns, compute_ns, ok=True)
             offset = 0
             for (inputs, _params, fut, _ts), count in zip(pending, counts):
                 part = {
-                    n: np.asarray(v)[offset : offset + count] for n, v in outputs.items()
+                    n: v[offset : offset + count] for n, v in outputs.items()
                 }
                 offset += count
                 if not fut.done():
@@ -197,16 +228,27 @@ class InferenceCore:
         inputs = self._resolve_inputs(model, request)
         params = dict(request.parameters)
         if isinstance(model, EnsembleModel):
-            outputs = await self._run_ensemble(model, inputs, params)
-            queue_ns = compute_ns = 0
-            model.stats.record(_batch_count(inputs) or 1, 0, 0, ok=True)
-        elif self._use_batcher(model, request):
-            outputs = await self._batcher(model).submit(inputs, params)
-        else:
             t0 = time.monotonic_ns()
             queue_ns = t0 - request.arrival_ns
             try:
-                outputs = await self._run_model(model, inputs, params)
+                outputs = await self._run_ensemble(model, inputs, params)
+            except Exception:
+                model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
+                raise
+            compute_ns = time.monotonic_ns() - t0
+            model.stats.record(
+                _batch_count(inputs) or 1, queue_ns, compute_ns, ok=True)
+        elif self._use_batcher(model, request):
+            outputs = await self._batcher(model).submit(inputs, params)
+        else:
+            # Keep outputs device-resident only when an xla-shm output wants
+            # them (zero-copy); otherwise resolve D2H off the event loop.
+            resolve_host = not any(o.shm is not None for o in request.outputs)
+            t0 = time.monotonic_ns()
+            queue_ns = t0 - request.arrival_ns
+            try:
+                outputs = await self._run_model(
+                    model, inputs, params, resolve_host=resolve_host)
             except InferError:
                 model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
                 raise
@@ -286,6 +328,10 @@ class InferenceCore:
                     await b._task
                 except (asyncio.CancelledError, Exception):
                     pass
+            # let in-flight batch executions finish resolving their futures
+            if b._batch_tasks:
+                await asyncio.gather(*list(b._batch_tasks),
+                                     return_exceptions=True)
             # drain requests that never made it into a batch
             while not b._queue.empty():
                 _inputs, _params, fut, _ts = b._queue.get_nowait()
@@ -299,37 +345,99 @@ class InferenceCore:
             self._batchers[model.name] = b
         return b
 
-    async def _run_model(self, model: Model, inputs, params) -> Dict[str, Any]:
+    async def _run_model(
+        self, model: Model, inputs, params, resolve_host: bool = False
+    ) -> Dict[str, Any]:
+        """Execute on a thread-pool worker so the event loop keeps serving.
+
+        With ``resolve_host`` the device→host transfer also happens on the
+        worker (``copy_to_host_async`` prefetches every output so transfers
+        overlap, then the blocking reads drain already-inflight copies).
+        Without it outputs may stay device-resident — the zero-copy path for
+        xla-shm outputs."""
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, lambda: model.execute(inputs, params))
+
+        def _exec():
+            outputs = model.execute(inputs, params)
+            if resolve_host:
+                for v in outputs.values():
+                    if hasattr(v, "copy_to_host_async"):
+                        v.copy_to_host_async()
+                outputs = {n: np.asarray(v) for n, v in outputs.items()}
+            return outputs
+
+        return await loop.run_in_executor(None, _exec)
 
     async def _run_ensemble(self, model: EnsembleModel, inputs, params) -> Dict[str, Any]:
         """Execute the ensemble DAG: tensors flow between steps through
-        input_map/output_map (reference ensemble behavior, §2.7)."""
+        input_map/output_map (reference ensemble behavior, §2.7).
+
+        Steps are scheduled by data dependency, not config order: every step
+        whose inputs are available runs concurrently with its siblings
+        (parallel DAG branches actually parallelize).  Intermediate tensors
+        stay device-resident between steps; only the ensemble's final outputs
+        pay a D2H, off the event loop."""
         pool: Dict[str, Any] = dict(inputs)
-        for step in model.config.ensemble_scheduling.step:
-            member = self.registry.get(step.model_name)
-            step_inputs = {}
-            for member_input, pool_name in step.input_map.items():
-                if pool_name not in pool:
-                    raise InferError(
-                        f"ensemble '{model.name}': tensor '{pool_name}' not produced "
-                        f"before step '{step.model_name}'"
-                    )
-                step_inputs[member_input] = pool[pool_name]
-            t0 = time.monotonic_ns()
+        remaining = list(model.config.ensemble_scheduling.step)
+        while remaining:
+            ready = [
+                s for s in remaining
+                if all(p in pool for p in s.input_map.values())
+            ]
+            if not ready:
+                missing = sorted(
+                    {p for s in remaining for p in s.input_map.values()}
+                    - set(pool))
+                raise InferError(
+                    f"ensemble '{model.name}': tensor(s) {', '.join(missing)} "
+                    "are never produced"
+                )
+            results = await asyncio.gather(
+                *(self._run_ensemble_step(model, s, pool, params) for s in ready))
+            for step, outs in zip(ready, results):
+                for member_output, pool_name in step.output_map.items():
+                    if member_output not in outs:
+                        raise InferError(
+                            f"ensemble '{model.name}': step '{step.model_name}' "
+                            f"did not produce '{member_output}'"
+                        )
+                    pool[pool_name] = outs[member_output]
+            ready_ids = {id(s) for s in ready}
+            remaining = [s for s in remaining if id(s) not in ready_ids]
+        final_names = [o.name for o in model.config.output if o.name in pool]
+        loop = asyncio.get_running_loop()
+
+        def _resolve_final():
+            for n in final_names:
+                v = pool[n]
+                if hasattr(v, "copy_to_host_async"):
+                    v.copy_to_host_async()
+            for n in final_names:
+                pool[n] = np.asarray(pool[n])
+            return pool
+
+        return await loop.run_in_executor(None, _resolve_final)
+
+    async def _run_ensemble_step(
+        self, model: EnsembleModel, step, pool: Dict[str, Any], params
+    ) -> Dict[str, Any]:
+        member = self.registry.get(step.model_name)
+        step_inputs = {
+            member_input: pool[pool_name]
+            for member_input, pool_name in step.input_map.items()
+        }
+        t0 = time.monotonic_ns()
+        try:
             outs = await self._run_model(member, step_inputs, params)
+        except Exception:
             member.stats.record(
-                _batch_count(step_inputs) or 1, 0, time.monotonic_ns() - t0, ok=True
-            )
-            for member_output, pool_name in step.output_map.items():
-                if member_output not in outs:
-                    raise InferError(
-                        f"ensemble '{model.name}': step '{step.model_name}' did not "
-                        f"produce '{member_output}'"
-                    )
-                pool[pool_name] = outs[member_output]
-        return pool
+                _batch_count(step_inputs) or 1, 0,
+                time.monotonic_ns() - t0, ok=False)
+            raise
+        member.stats.record(
+            _batch_count(step_inputs) or 1, 0, time.monotonic_ns() - t0, ok=True
+        )
+        return outs
 
     # ------------------------------------------------------------------
     def _resolve_inputs(self, model: Model, request: InferRequest) -> Dict[str, Any]:
