@@ -1,0 +1,35 @@
+"""Build hooks: compile the native shm shim into the wheel.
+
+The reference Linux wheel bundles ``libcshm.so`` next to the package
+(src/python/library/setup.py:78-80); here the shim is compiled from
+``native/cshm/shared_memory.cc`` at build time and placed inside
+``triton_client_tpu/`` where ``_native.find_or_build`` looks first.
+Metadata lives in pyproject.toml.
+"""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        src = os.path.join(HERE, "native", "cshm", "shared_memory.cc")
+        if not os.path.exists(src):  # sdist without native tree: skip
+            return
+        out_dir = os.path.join(self.build_lib, "triton_client_tpu")
+        os.makedirs(out_dir, exist_ok=True)
+        out = os.path.join(out_dir, "libcshm.so")
+        cmd = [
+            "g++", "-std=c++17", "-O2", "-fPIC", "-shared",
+            "-Wall", "-Wextra", src, "-o", out, "-lrt", "-pthread",
+        ]
+        subprocess.run(cmd, check=True)
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})
