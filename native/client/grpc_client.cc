@@ -231,6 +231,8 @@ InferenceServerGrpcClient::InferenceServerGrpcClient(
 }
 
 InferenceServerGrpcClient::~InferenceServerGrpcClient() {
+  FinishStream();  // closes an open stream; harmless error when none
+  if (stream_reader_.joinable()) stream_reader_.join();
   {
     std::lock_guard<std::mutex> lk(job_mu_);
     exiting_ = true;
@@ -269,23 +271,6 @@ Error InferenceServerGrpcClient::Call(
     fprintf(stderr, "%s -> ok\n", method.c_str());
   }
   return Error::Success;
-}
-
-Error InferenceServerGrpcClient::CallStreaming(
-    const std::string& method, const std::string& body,
-    std::vector<std::string>* response_frames, const Headers& headers) {
-  Headers h = headers;
-  h["Content-Type"] = "application/grpc-web+proto";
-  HttpTransport::Response resp;
-  TC_RETURN_IF_ERROR(transport_->Request(
-      "POST", std::string(kServicePath) + "/" + method, body, h, &resp));
-  if (resp.status != 200) {
-    return Error("grpc-web request failed with HTTP status " +
-                 std::to_string(resp.status));
-  }
-  std::string trailers;
-  TC_RETURN_IF_ERROR(ParseFrames(resp.body, response_frames, &trailers));
-  return StatusFromTrailers(trailers);
 }
 
 //==============================================================================
@@ -602,49 +587,116 @@ Error InferenceServerGrpcClient::StartStream(
   if (callback == nullptr) {
     return Error("callback must not be null for StartStream");
   }
+  auto conn = std::make_unique<DuplexConnection>();
+  TC_RETURN_IF_ERROR(conn->Open(
+      transport_->host(), transport_->port(),
+      std::string(kServicePath) + "/ModelStreamInfer", headers));
+  int status = 0;
+  Headers resp_headers;
+  TC_RETURN_IF_ERROR(conn->ReadResponseHeaders(&status, &resp_headers));
+  if (status != 200) {
+    return Error("stream request failed with HTTP status " +
+                 std::to_string(status));
+  }
   stream_callback_ = std::move(callback);
-  stream_headers_ = headers;
-  stream_body_.clear();
-  stream_active_ = true;
+  {
+    std::lock_guard<std::mutex> lk(stream_err_mu_);
+    stream_final_error_ = Error::Success;
+  }
+  {
+    std::lock_guard<std::mutex> lk(stream_write_mu_);
+    stream_conn_ = std::move(conn);
+    stream_active_ = true;
+  }
+  stream_reader_ =
+      std::thread(&InferenceServerGrpcClient::StreamReadLoop, this);
   return Error::Success;
+}
+
+// Reader thread (reference AsyncStreamTransfer, grpc_client.cc:1628-1673):
+// parses grpc-web frames incrementally off the open response body and fires
+// the user callback for every message the moment it arrives.
+void InferenceServerGrpcClient::StreamReadLoop() {
+  std::string buf;
+  bool done = false;
+  std::string trailers;
+  while (!done) {
+    std::string bytes;
+    Error err = stream_conn_->ReadSome(&bytes, &done);
+    if (!err.IsOk()) {
+      {
+        std::lock_guard<std::mutex> lk(stream_err_mu_);
+        stream_final_error_ = err;
+      }
+      // surface the broken stream to the user, not just to FinishStream
+      stream_callback_(new ErrorResult(err));
+      return;
+    }
+    buf += bytes;
+    // drain complete grpc-web frames
+    while (buf.size() >= 5) {
+      uint8_t flags = static_cast<uint8_t>(buf[0]);
+      uint32_t len = (static_cast<uint8_t>(buf[1]) << 24) |
+                     (static_cast<uint8_t>(buf[2]) << 16) |
+                     (static_cast<uint8_t>(buf[3]) << 8) |
+                     static_cast<uint8_t>(buf[4]);
+      if (buf.size() < 5u + len) break;
+      std::string payload = buf.substr(5, len);
+      buf.erase(0, 5u + len);
+      if (flags & 0x80) {
+        trailers = payload;
+        continue;
+      }
+      pb::ModelStreamInferResponse stream_resp;
+      if (!stream_resp.ParseFromString(payload)) {
+        stream_callback_(
+            new ErrorResult(Error("failed to parse stream response")));
+      } else if (!stream_resp.error_message().empty()) {
+        stream_callback_(new ErrorResult(Error(stream_resp.error_message())));
+      } else {
+        stream_callback_(new InferResultGrpcImpl(stream_resp.infer_response()));
+      }
+    }
+  }
+  std::lock_guard<std::mutex> lk(stream_err_mu_);
+  stream_final_error_ = StatusFromTrailers(trailers);
 }
 
 Error InferenceServerGrpcClient::AsyncStreamInfer(
     const InferOptions& options, const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs) {
+  pb::ModelInferRequest request;
+  TC_RETURN_IF_ERROR(BuildInferRequest(options, inputs, outputs, &request));
+  std::lock_guard<std::mutex> lk(stream_write_mu_);
   if (!stream_active_) {
     return Error("stream not available, StartStream() must be called first");
   }
-  pb::ModelInferRequest request;
-  TC_RETURN_IF_ERROR(BuildInferRequest(options, inputs, outputs, &request));
-  stream_body_ += Frame(request.SerializeAsString());
-  return Error::Success;
+  return stream_conn_->WriteChunk(Frame(request.SerializeAsString()));
 }
 
 Error InferenceServerGrpcClient::FinishStream() {
-  if (!stream_active_) {
-    return Error("no active stream");
-  }
-  stream_active_ = false;
-  std::vector<std::string> frames;
-  Error err = CallStreaming(
-      "ModelStreamInfer", stream_body_, &frames, stream_headers_);
-  stream_body_.clear();
-  if (!err.IsOk()) return err;
-  for (const auto& frame : frames) {
-    pb::ModelStreamInferResponse stream_resp;
-    if (!stream_resp.ParseFromString(frame)) {
-      stream_callback_(
-          new ErrorResult(Error("failed to parse stream response")));
-      continue;
+  Error write_err;
+  {
+    std::lock_guard<std::mutex> lk(stream_write_mu_);
+    if (!stream_active_) {
+      return Error("no active stream");
     }
-    if (!stream_resp.error_message().empty()) {
-      stream_callback_(new ErrorResult(Error(stream_resp.error_message())));
-    } else {
-      stream_callback_(new InferResultGrpcImpl(stream_resp.infer_response()));
-    }
+    stream_active_ = false;
+    write_err = stream_conn_->WriteEnd();
   }
-  return Error::Success;
+  if (stream_reader_.joinable()) stream_reader_.join();
+  {
+    std::lock_guard<std::mutex> lk(stream_write_mu_);
+    stream_conn_->Close();
+    stream_conn_.reset();
+  }
+  Error final_err;
+  {
+    std::lock_guard<std::mutex> lk(stream_err_mu_);
+    final_err = stream_final_error_;
+  }
+  if (!final_err.IsOk()) return final_err;
+  return write_err;
 }
 
 }  // namespace client

@@ -12,9 +12,9 @@
 // server exposes the identical ``/inference.GRPCInferenceService/<Method>``
 // paths through its grpc-web bridge, and the pb messages are generated from
 // the same inference.proto the Python stack uses, so wire semantics match
-// the reference's gRPC client.  StartStream/AsyncStreamInfer are half-duplex
-// (request messages are buffered then sent — a gRPC-Web protocol property);
-// responses stream back one frame per message.
+// the reference's gRPC client.  Streaming is live and bidirectional: request
+// messages go out immediately as chunked-transfer frames and responses are
+// delivered from a dedicated reader thread while the stream is open.
 #pragma once
 
 #include <condition_variable>
@@ -110,9 +110,14 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const std::vector<const InferRequestedOutput*>& outputs = {},
       const Headers& headers = Headers());
 
-  // Streaming (half-duplex over gRPC-Web): queue requests with
-  // AsyncStreamInfer, then FinishStream() sends them and delivers each
-  // response through the callback passed to StartStream.
+  // Live bidirectional streaming (reference grpc_client.cc:1377-1673
+  // ClientReaderWriter + AsyncStreamTransfer reader thread): StartStream
+  // opens a duplex gRPC-Web exchange and spawns a reader thread; every
+  // AsyncStreamInfer sends its request immediately as an HTTP chunk; each
+  // response is delivered through the callback AS IT ARRIVES, while the
+  // stream stays open — interleaved sequences and decoupled models work in
+  // real time.  FinishStream closes the request side, drains remaining
+  // responses, and returns the stream's final status.
   Error StartStream(OnCompleteFn callback, const Headers& headers = Headers());
   Error AsyncStreamInfer(
       const InferOptions& options, const std::vector<InferInput*>& inputs,
@@ -126,9 +131,6 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const std::string& method, const google::protobuf::Message& request,
       google::protobuf::Message* response, const Headers& headers,
       RequestTimers* timers = nullptr);
-  Error CallStreaming(
-      const std::string& method, const std::string& body,
-      std::vector<std::string>* response_frames, const Headers& headers);
   static Error BuildInferRequest(
       const InferOptions& options, const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs,
@@ -150,9 +152,13 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   bool exiting_ = false;
 
   // streaming state
+  void StreamReadLoop();
   OnCompleteFn stream_callback_;
-  Headers stream_headers_;
-  std::string stream_body_;
+  std::unique_ptr<DuplexConnection> stream_conn_;
+  std::thread stream_reader_;
+  std::mutex stream_write_mu_;
+  std::mutex stream_err_mu_;
+  Error stream_final_error_;  // trailers status / transport error
   bool stream_active_ = false;
 };
 

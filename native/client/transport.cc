@@ -44,6 +44,37 @@ bool WriteAll(int fd, const char* buf, size_t n) {
   return true;
 }
 
+// Resolve + connect + TCP_NODELAY; returns -1 with *err set on failure.
+int ConnectTcp(const std::string& host, int port, Error* err) {
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char port_str[16];
+  snprintf(port_str, sizeof(port_str), "%d", port);
+  int rc = ::getaddrinfo(host.c_str(), port_str, &hints, &res);
+  if (rc != 0) {
+    *err = Error(std::string("failed to resolve host: ") + gai_strerror(rc));
+    return -1;
+  }
+  int fd = -1;
+  for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) {
+    *err = Error("failed to connect to " + host + ":" + port_str);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
 }  // namespace
 
 std::string Base64Encode(const uint8_t* data, size_t len) {
@@ -81,33 +112,7 @@ int HttpTransport::Connect(Error* err) {
       return fd;
     }
   }
-  struct addrinfo hints = {};
-  hints.ai_family = AF_UNSPEC;
-  hints.ai_socktype = SOCK_STREAM;
-  struct addrinfo* res = nullptr;
-  char port_str[16];
-  snprintf(port_str, sizeof(port_str), "%d", port_);
-  int rc = ::getaddrinfo(host_.c_str(), port_str, &hints, &res);
-  if (rc != 0) {
-    *err = Error(std::string("failed to resolve host: ") + gai_strerror(rc));
-    return -1;
-  }
-  int fd = -1;
-  for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
-    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-    if (fd < 0) continue;
-    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
-    ::close(fd);
-    fd = -1;
-  }
-  ::freeaddrinfo(res);
-  if (fd < 0) {
-    *err = Error("failed to connect to " + host_ + ":" + port_str);
-    return -1;
-  }
-  int one = 1;
-  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  return fd;
+  return ConnectTcp(host_, port_, err);
 }
 
 void HttpTransport::Release(int fd, bool reusable) {
@@ -281,6 +286,196 @@ Error HttpTransport::Request(
   out->headers = std::move(resp_headers);
   out->body = std::move(resp_body);
   return Error::Success;
+}
+
+//==============================================================================
+DuplexConnection::~DuplexConnection() { Close(); }
+
+void DuplexConnection::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Error DuplexConnection::Open(
+    const std::string& host, int port, const std::string& path,
+    const Headers& extra_headers) {
+  Error err;
+  fd_ = ConnectTcp(host, port, &err);
+  if (fd_ < 0) return err;
+
+  std::ostringstream req;
+  req << "POST /" << path << " HTTP/1.1\r\n";
+  req << "Host: " << host << ":" << port << "\r\n";
+  req << "Connection: close\r\n";
+  req << "Transfer-Encoding: chunked\r\n";
+  req << "TE: trailers\r\n";
+  bool has_ct = false;
+  for (const auto& kv : extra_headers) {
+    if (LowerCopy(kv.first) == "content-type") has_ct = true;
+    req << kv.first << ": " << kv.second << "\r\n";
+  }
+  if (!has_ct) req << "Content-Type: application/grpc-web+proto\r\n";
+  req << "\r\n";
+  std::string head = req.str();
+  if (!WriteAll(fd_, head.data(), head.size())) {
+    Close();
+    return Error("failed to send stream request headers");
+  }
+  return Error::Success;
+}
+
+Error DuplexConnection::WriteChunk(const std::string& data) {
+  if (fd_ < 0) return Error("stream connection is closed");
+  if (data.empty()) return Error::Success;
+  char size_line[32];
+  int n = snprintf(size_line, sizeof(size_line), "%zx\r\n", data.size());
+  std::string wire;
+  wire.reserve(n + data.size() + 2);
+  wire.append(size_line, n);
+  wire.append(data);
+  wire.append("\r\n");
+  if (!WriteAll(fd_, wire.data(), wire.size())) {
+    return Error("failed to send stream request chunk");
+  }
+  return Error::Success;
+}
+
+Error DuplexConnection::WriteEnd() {
+  if (fd_ < 0) return Error("stream connection is closed");
+  static const char kEnd[] = "0\r\n\r\n";
+  if (!WriteAll(fd_, kEnd, sizeof(kEnd) - 1)) {
+    return Error("failed to finish stream request body");
+  }
+  return Error::Success;
+}
+
+Error DuplexConnection::Fill() {
+  char chunk[8192];
+  ssize_t r = ::recv(fd_, chunk, sizeof(chunk), 0);
+  if (r < 0) return Error("connection error while reading stream response");
+  if (r == 0) return Error("connection closed mid stream response");
+  rbuf_.append(chunk, static_cast<size_t>(r));
+  return Error::Success;
+}
+
+Error DuplexConnection::ReadResponseHeaders(int* status, Headers* headers) {
+  if (fd_ < 0) return Error("stream connection is closed");
+  size_t header_end;
+  while ((header_end = rbuf_.find("\r\n\r\n")) == std::string::npos) {
+    TC_RETURN_IF_ERROR(Fill());
+    if (rbuf_.size() > (1u << 20)) return Error("response headers too large");
+  }
+  std::istringstream hs(rbuf_.substr(0, header_end));
+  rbuf_.erase(0, header_end + 4);
+  std::string status_line;
+  std::getline(hs, status_line);
+  if (!status_line.empty() && status_line.back() == '\r') status_line.pop_back();
+  *status = 0;
+  {
+    auto sp = status_line.find(' ');
+    if (sp != std::string::npos) *status = atoi(status_line.c_str() + sp + 1);
+  }
+  std::string line;
+  while (std::getline(hs, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    auto colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = LowerCopy(line.substr(0, colon));
+    size_t vstart = colon + 1;
+    while (vstart < line.size() && line[vstart] == ' ') ++vstart;
+    (*headers)[key] = line.substr(vstart);
+  }
+  auto te = headers->find("transfer-encoding");
+  chunked_ = te != headers->end() &&
+             LowerCopy(te->second).find("chunked") != std::string::npos;
+  if (!chunked_) {
+    auto cl = headers->find("content-length");
+    remaining_ =
+        cl != headers->end() ? strtoll(cl->second.c_str(), nullptr, 10) : -1;
+    if (remaining_ == 0) body_done_ = true;
+  } else {
+    remaining_ = 0;  // at a chunk boundary
+  }
+  headers_read_ = true;
+  return Error::Success;
+}
+
+Error DuplexConnection::ReadSome(std::string* out, bool* done) {
+  *done = false;
+  if (!headers_read_) return Error("response headers not read yet");
+  if (body_done_) {
+    *done = true;
+    return Error::Success;
+  }
+  if (!chunked_) {
+    // content-length (remaining_ >= 0) or close-delimited (remaining_ < 0)
+    if (rbuf_.empty()) {
+      if (remaining_ < 0) {
+        char chunk[8192];
+        ssize_t r = ::recv(fd_, chunk, sizeof(chunk), 0);
+        if (r < 0) return Error("connection error while reading stream body");
+        if (r == 0) {
+          body_done_ = true;
+          *done = true;
+          return Error::Success;
+        }
+        rbuf_.append(chunk, static_cast<size_t>(r));
+      } else {
+        TC_RETURN_IF_ERROR(Fill());
+      }
+    }
+    size_t take = rbuf_.size();
+    if (remaining_ >= 0) {
+      take = std::min<long long>(take, remaining_);
+      remaining_ -= take;
+      if (remaining_ == 0) body_done_ = true;
+    }
+    out->append(rbuf_, 0, take);
+    rbuf_.erase(0, take);
+    *done = body_done_;
+    return Error::Success;
+  }
+  // chunked: decode whatever complete pieces are buffered; block only when
+  // nothing was produced yet
+  for (;;) {
+    bool produced = false;
+    for (;;) {
+      if (remaining_ > 0) {
+        size_t take = std::min<long long>(rbuf_.size(), remaining_);
+        if (take == 0) break;
+        out->append(rbuf_, 0, take);
+        rbuf_.erase(0, take);
+        remaining_ -= take;
+        produced = true;
+        if (remaining_ > 0) break;  // need more of this chunk
+        remaining_ = -2;            // expect CRLF after chunk data
+      }
+      if (remaining_ == -2) {
+        if (rbuf_.size() < 2) break;
+        rbuf_.erase(0, 2);
+        remaining_ = 0;
+      }
+      // at a chunk-size line
+      size_t nl = rbuf_.find("\r\n");
+      if (nl == std::string::npos) break;
+      long long len = strtoll(rbuf_.substr(0, nl).c_str(), nullptr, 16);
+      rbuf_.erase(0, nl + 2);
+      if (len == 0) {
+        // terminal chunk; consume optional trailers until blank line
+        body_done_ = true;
+        *done = true;
+        return Error::Success;
+      }
+      remaining_ = len;
+    }
+    if (produced) {
+      *done = body_done_;
+      return Error::Success;
+    }
+    TC_RETURN_IF_ERROR(Fill());
+  }
 }
 
 }  // namespace client

@@ -50,5 +50,47 @@ class HttpTransport {
 
 std::string Base64Encode(const uint8_t* data, size_t len);
 
+// One full-duplex HTTP/1.1 exchange on a dedicated connection: the request
+// body is sent incrementally as chunked transfer coding while the response
+// (headers + chunked body) is read concurrently.  This is what makes live
+// gRPC-Web streaming possible without grpc++ — the reference achieves the
+// same duplexing with a grpc::ClientReaderWriter
+// (/root/reference/src/c++/library/grpc_client.cc:1377-1673).
+class DuplexConnection {
+ public:
+  DuplexConnection() = default;
+  ~DuplexConnection();
+
+  DuplexConnection(const DuplexConnection&) = delete;
+  DuplexConnection& operator=(const DuplexConnection&) = delete;
+
+  // Connects and sends the request headers (Transfer-Encoding: chunked).
+  Error Open(
+      const std::string& host, int port, const std::string& path,
+      const Headers& extra_headers);
+  // Sends one chunk of request body (thread-safe w.r.t. reads, not writes).
+  Error WriteChunk(const std::string& data);
+  // Sends the terminal zero chunk: request body complete.
+  Error WriteEnd();
+
+  // Blocks until the response status line + headers arrive.
+  Error ReadResponseHeaders(int* status, Headers* headers);
+  // Appends the next available decoded body bytes to `out`; sets *done when
+  // the body is complete.  Blocks until data, end, or error.
+  Error ReadSome(std::string* out, bool* done);
+
+  void Close();
+
+ private:
+  int fd_ = -1;
+  // response framing state
+  bool headers_read_ = false;
+  bool chunked_ = false;
+  long long remaining_ = -1;  // bytes left in current chunk / content-length
+  bool body_done_ = false;
+  std::string rbuf_;  // raw bytes received, not yet decoded
+  Error Fill();       // recv more into rbuf_
+};
+
 }  // namespace client
 }  // namespace tc_tpu

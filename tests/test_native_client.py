@@ -1,0 +1,85 @@
+"""Native (C++) client library: build + live integration (SURVEY.md §4 tier
+3 — the reference runs cc_client_test.cc/examples against a live server; here
+the CMake tree is built once per session and every binary runs against the
+in-process harness)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server.registry import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native", "client")
+BUILD = os.path.join(NATIVE, "build")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("cmake") is None or shutil.which("ninja") is None,
+    reason="cmake/ninja not available",
+)
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    subprocess.run(
+        ["cmake", "-S", NATIVE, "-B", BUILD, "-G", "Ninja"],
+        check=True, capture_output=True, text=True)
+    subprocess.run(
+        ["ninja", "-C", BUILD], check=True, capture_output=True, text=True)
+    return BUILD
+
+
+@pytest.fixture(scope="module")
+def harness():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    h = ServerHarness(registry)
+    h.start()
+    yield h
+    h.stop()
+
+
+def _run(binary, url, timeout=180):
+    proc = subprocess.run(
+        [binary, "-u", url], capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"{os.path.basename(binary)} failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    return proc.stdout
+
+
+@pytest.mark.parametrize("example", [
+    "simple_http_infer_client",
+    "simple_http_shm_client",
+])
+def test_cpp_http_example(native_build, harness, example):
+    out = _run(os.path.join(native_build, example),
+               f"127.0.0.1:{harness.http_port}")
+    assert "PASS" in out
+
+
+@pytest.mark.parametrize("example", [
+    "simple_grpc_infer_client",
+    "simple_grpc_sequence_stream_infer_client",
+])
+def test_cpp_grpc_example(native_build, harness, example):
+    # the C++ gRPC client rides the grpc-web bridge on the HTTP port
+    out = _run(os.path.join(native_build, example),
+               f"127.0.0.1:{harness.http_port}")
+    assert "PASS" in out
+
+
+def test_cc_client_test(native_build, harness):
+    # takes the url positionally: `cc_client_test <http_host:port>`
+    proc = subprocess.run(
+        [os.path.join(native_build, "cc_client_test"),
+         f"127.0.0.1:{harness.http_port}"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, (
+        f"cc_client_test failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    assert "FAIL" not in proc.stdout
