@@ -1,0 +1,141 @@
+// C++ device-path example (reference
+// src/c++/examples/simple_grpc_cudashm_client.cc behavior spec, surveyed at
+// SURVEY.md §3.5): run `simple` with inputs AND outputs passing through
+// registered XLA shared-memory regions — tensor bytes never ride the infer
+// request/response.  Leak assertions via CudaSharedMemoryStatus mirror the
+// reference's allocated_shared_memory_regions checks.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+#include "xla_shm_utils.h"
+
+namespace tc = tc_tpu::client;
+
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tc::Error err__ = (x);                                          \
+    if (!err__.IsOk()) {                                            \
+      fprintf(stderr, "%s: %s\n", (msg), err__.Message().c_str());  \
+      return 1;                                                     \
+    }                                                               \
+  } while (0)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+  }
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url),
+              "client creation failed");
+
+  // start from a clean registry
+  FAIL_IF_ERR(client->UnregisterCudaSharedMemory(), "unregister-all failed");
+
+  constexpr size_t kCount = 16;
+  constexpr size_t kBytes = kCount * sizeof(int32_t);
+
+  // input regions, written before registration (reference flow writes via
+  // cudaMemcpy then registers the ipc handle)
+  int32_t input0[kCount], input1[kCount];
+  for (size_t i = 0; i < kCount; ++i) {
+    input0[i] = static_cast<int32_t>(i);
+    input1[i] = 1;
+  }
+  tc::XlaShmHandle in0_h, in1_h, out0_h, out1_h;
+  FAIL_IF_ERR(tc::CreateXlaSharedMemoryRegion(&in0_h, "input0_data", kBytes, 0),
+              "create input0 region failed");
+  FAIL_IF_ERR(tc::CreateXlaSharedMemoryRegion(&in1_h, "input1_data", kBytes, 0),
+              "create input1 region failed");
+  FAIL_IF_ERR(tc::SetXlaSharedMemoryRegion(in0_h, input0, kBytes),
+              "set input0 failed");
+  FAIL_IF_ERR(tc::SetXlaSharedMemoryRegion(in1_h, input1, kBytes),
+              "set input1 failed");
+  FAIL_IF_ERR(
+      tc::CreateXlaSharedMemoryRegion(&out0_h, "output0_data", kBytes, 0),
+      "create output0 region failed");
+  FAIL_IF_ERR(
+      tc::CreateXlaSharedMemoryRegion(&out1_h, "output1_data", kBytes, 0),
+      "create output1 region failed");
+
+  struct Reg {
+    const char* name;
+    tc::XlaShmHandle* h;
+  } regs[] = {{"input0_data", &in0_h},
+              {"input1_data", &in1_h},
+              {"output0_data", &out0_h},
+              {"output1_data", &out1_h}};
+  for (const auto& r : regs) {
+    std::vector<uint8_t> raw;
+    FAIL_IF_ERR(tc::GetXlaSharedMemoryRawHandle(*r.h, &raw),
+                "raw handle failed");
+    FAIL_IF_ERR(client->RegisterCudaSharedMemory(r.name, raw, 0, kBytes),
+                "register failed");
+  }
+
+  // all four regions must show in status (leak assertion, part 1)
+  inference::CudaSharedMemoryStatusResponse status;
+  FAIL_IF_ERR(client->CudaSharedMemoryStatus(&status), "status failed");
+  if (status.regions_size() != 4) {
+    fprintf(stderr, "FAIL: expected 4 registered regions, got %d\n",
+            status.regions_size());
+    return 1;
+  }
+
+  tc::InferInput *in0, *in1;
+  tc::InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32");
+  tc::InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32");
+  FAIL_IF_ERR(in0->SetSharedMemory("input0_data", kBytes),
+              "INPUT0 set shm failed");
+  FAIL_IF_ERR(in1->SetSharedMemory("input1_data", kBytes),
+              "INPUT1 set shm failed");
+  tc::InferRequestedOutput *out0, *out1;
+  tc::InferRequestedOutput::Create(&out0, "OUTPUT0");
+  tc::InferRequestedOutput::Create(&out1, "OUTPUT1");
+  FAIL_IF_ERR(out0->SetSharedMemory("output0_data", kBytes),
+              "OUTPUT0 set shm failed");
+  FAIL_IF_ERR(out1->SetSharedMemory("output1_data", kBytes),
+              "OUTPUT1 set shm failed");
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  FAIL_IF_ERR(client->Infer(&result, options, {in0, in1}, {out0, out1}),
+              "inference failed");
+  delete result;
+
+  // outputs land in the regions, not the response
+  int32_t sum[kCount], diff[kCount];
+  FAIL_IF_ERR(tc::GetXlaSharedMemoryContents(out0_h, sum, kBytes),
+              "read output0 failed");
+  FAIL_IF_ERR(tc::GetXlaSharedMemoryContents(out1_h, diff, kBytes),
+              "read output1 failed");
+  for (size_t i = 0; i < kCount; ++i) {
+    if (sum[i] != input0[i] + input1[i] || diff[i] != input0[i] - input1[i]) {
+      fprintf(stderr, "FAIL: wrong result at %zu: sum=%d diff=%d\n", i,
+              sum[i], diff[i]);
+      return 1;
+    }
+  }
+
+  FAIL_IF_ERR(client->UnregisterCudaSharedMemory(), "unregister failed");
+  FAIL_IF_ERR(client->CudaSharedMemoryStatus(&status), "status failed");
+  if (status.regions_size() != 0) {
+    fprintf(stderr, "FAIL: %d regions leaked after unregister\n",
+            status.regions_size());
+    return 1;
+  }
+  for (const auto& r : regs) {
+    FAIL_IF_ERR(tc::DestroyXlaSharedMemoryRegion(r.h), "destroy failed");
+  }
+  delete in0;
+  delete in1;
+  delete out0;
+  delete out1;
+
+  printf("PASS: xla shm (device-path regions, zero tensor bytes on the wire)\n");
+  return 0;
+}

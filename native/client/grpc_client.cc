@@ -581,9 +581,17 @@ void InferenceServerGrpcClient::AsyncTransfer() {
 //==============================================================================
 Error InferenceServerGrpcClient::StartStream(
     OnCompleteFn callback, const Headers& headers) {
-  if (stream_active_) {
-    return Error("cannot start another stream with one already running");
+  {
+    std::lock_guard<std::mutex> lk(stream_write_mu_);
+    if (stream_active_) {
+      return Error(
+          "cannot start another stream with one already running; call "
+          "FinishStream() first (it returns the previous stream's status)");
+    }
   }
+  // reap a reader left from a previous stream torn down via the destructor
+  // path; after a normal FinishStream the thread is already joined
+  if (stream_reader_.joinable()) stream_reader_.join();
   if (callback == nullptr) {
     return Error("callback must not be null for StartStream");
   }
@@ -675,6 +683,12 @@ Error InferenceServerGrpcClient::AsyncStreamInfer(
 }
 
 Error InferenceServerGrpcClient::FinishStream() {
+  if (stream_reader_.joinable() &&
+      std::this_thread::get_id() == stream_reader_.get_id()) {
+    // joining ourselves would throw resource_deadlock_would_occur
+    return Error(
+        "FinishStream must not be called from the stream callback");
+  }
   Error write_err;
   {
     std::lock_guard<std::mutex> lk(stream_write_mu_);

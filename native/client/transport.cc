@@ -351,11 +351,18 @@ Error DuplexConnection::WriteEnd() {
   return Error::Success;
 }
 
-Error DuplexConnection::Fill() {
+Error DuplexConnection::Fill(bool* eof) {
+  if (eof) *eof = false;
   char chunk[8192];
   ssize_t r = ::recv(fd_, chunk, sizeof(chunk), 0);
   if (r < 0) return Error("connection error while reading stream response");
-  if (r == 0) return Error("connection closed mid stream response");
+  if (r == 0) {
+    if (eof) {
+      *eof = true;
+      return Error::Success;
+    }
+    return Error("connection closed mid stream response");
+  }
   rbuf_.append(chunk, static_cast<size_t>(r));
   return Error::Success;
 }
@@ -413,15 +420,13 @@ Error DuplexConnection::ReadSome(std::string* out, bool* done) {
     // content-length (remaining_ >= 0) or close-delimited (remaining_ < 0)
     if (rbuf_.empty()) {
       if (remaining_ < 0) {
-        char chunk[8192];
-        ssize_t r = ::recv(fd_, chunk, sizeof(chunk), 0);
-        if (r < 0) return Error("connection error while reading stream body");
-        if (r == 0) {
+        bool eof = false;
+        TC_RETURN_IF_ERROR(Fill(&eof));
+        if (eof) {
           body_done_ = true;
           *done = true;
           return Error::Success;
         }
-        rbuf_.append(chunk, static_cast<size_t>(r));
       } else {
         TC_RETURN_IF_ERROR(Fill());
       }

@@ -89,7 +89,9 @@ class DuplexConnection {
   long long remaining_ = -1;  // bytes left in current chunk / content-length
   bool body_done_ = false;
   std::string rbuf_;  // raw bytes received, not yet decoded
-  Error Fill();       // recv more into rbuf_
+  // recv more into rbuf_.  With `eof` null, a peer close is an error; with
+  // `eof` non-null it is reported there (close-delimited bodies).
+  Error Fill(bool* eof = nullptr);
 };
 
 }  // namespace client

@@ -1,0 +1,138 @@
+#include "xla_shm_utils.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <random>
+
+namespace tc_tpu {
+namespace client {
+
+namespace {
+
+std::string RandomHex(size_t n_chars) {
+  static const char hex[] = "0123456789abcdef";
+  std::random_device rd;
+  std::mt19937_64 gen(rd());
+  std::uniform_int_distribution<int> dist(0, 15);
+  std::string out;
+  out.reserve(n_chars);
+  for (size_t i = 0; i < n_chars; ++i) out += hex[dist(gen)];
+  return out;
+}
+
+}  // namespace
+
+Error CreateXlaSharedMemoryRegion(
+    XlaShmHandle* handle, const std::string& triton_shm_name,
+    size_t byte_size, int device_id) {
+  if (byte_size == 0) {
+    return Error("byte_size must be positive");
+  }
+  handle->triton_shm_name = triton_shm_name;
+  handle->uuid = RandomHex(32);
+  handle->staging_key = "/xlashm_" + handle->uuid.substr(0, 16);
+  handle->byte_size = byte_size;
+  handle->device_id = device_id;
+
+  int fd = ::shm_open(
+      handle->staging_key.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    return Error(
+        "failed to create staging region '" + handle->staging_key + "': " +
+        strerror(errno));
+  }
+  if (::ftruncate(fd, static_cast<off_t>(byte_size)) != 0) {
+    Error err(
+        "failed to size staging region '" + handle->staging_key + "': " +
+        strerror(errno));
+    ::close(fd);
+    ::shm_unlink(handle->staging_key.c_str());
+    return err;
+  }
+  void* base = ::mmap(
+      nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    Error err(
+        "failed to map staging region '" + handle->staging_key + "': " +
+        strerror(errno));
+    ::close(fd);
+    ::shm_unlink(handle->staging_key.c_str());
+    return err;
+  }
+  handle->shm_fd = fd;
+  handle->base_addr = base;
+  return Error::Success;
+}
+
+Error GetXlaSharedMemoryRawHandle(
+    const XlaShmHandle& handle, std::vector<uint8_t>* raw_handle) {
+  if (handle.base_addr == nullptr) {
+    return Error("region '" + handle.triton_shm_name + "' is not allocated");
+  }
+  char buf[256];
+  int n = snprintf(
+      buf, sizeof(buf),
+      "{\"uuid\": \"%s\", \"staging_key\": \"%s\", \"byte_size\": %zu, "
+      "\"device_id\": %d}",
+      handle.uuid.c_str(), handle.staging_key.c_str(), handle.byte_size,
+      handle.device_id);
+  raw_handle->assign(buf, buf + n);
+  return Error::Success;
+}
+
+Error SetXlaSharedMemoryRegion(
+    const XlaShmHandle& handle, const void* data, size_t byte_size,
+    size_t offset) {
+  if (handle.base_addr == nullptr) {
+    return Error("region '" + handle.triton_shm_name + "' is not allocated");
+  }
+  // overflow-safe bounds check: offset + byte_size could wrap size_t
+  if (offset > handle.byte_size || byte_size > handle.byte_size - offset) {
+    return Error(
+        "write of " + std::to_string(byte_size) + " bytes at offset " +
+        std::to_string(offset) + " exceeds region size " +
+        std::to_string(handle.byte_size));
+  }
+  memcpy(static_cast<uint8_t*>(handle.base_addr) + offset, data, byte_size);
+  return Error::Success;
+}
+
+Error GetXlaSharedMemoryContents(
+    const XlaShmHandle& handle, void* out, size_t byte_size, size_t offset) {
+  if (handle.base_addr == nullptr) {
+    return Error("region '" + handle.triton_shm_name + "' is not allocated");
+  }
+  if (offset > handle.byte_size || byte_size > handle.byte_size - offset) {
+    return Error(
+        "read of " + std::to_string(byte_size) + " bytes at offset " +
+        std::to_string(offset) + " exceeds region size " +
+        std::to_string(handle.byte_size));
+  }
+  memcpy(out, static_cast<const uint8_t*>(handle.base_addr) + offset,
+         byte_size);
+  return Error::Success;
+}
+
+Error DestroyXlaSharedMemoryRegion(XlaShmHandle* handle) {
+  if (handle->base_addr != nullptr) {
+    ::munmap(handle->base_addr, handle->byte_size);
+    handle->base_addr = nullptr;
+  }
+  if (handle->shm_fd >= 0) {
+    ::close(handle->shm_fd);
+    handle->shm_fd = -1;
+  }
+  if (!handle->staging_key.empty()) {
+    ::shm_unlink(handle->staging_key.c_str());
+    handle->staging_key.clear();
+  }
+  return Error::Success;
+}
+
+}  // namespace client
+}  // namespace tc_tpu

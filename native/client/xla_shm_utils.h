@@ -1,0 +1,70 @@
+// C++ XLA shared-memory helpers — the TPU device-side data path for native
+// clients.
+//
+// Parity targets: reference ipc.h:28-32 (opaque cudaIpcMemHandle_t kept out
+// of the ABI when GPU is off) and the cudaIPC client flow in
+// http_client.cc:1708-1748 / examples/simple_grpc_cudashm_client.py (create
+// region -> register raw handle -> set inputs -> infer via region names ->
+// read outputs -> unregister/destroy).
+//
+// TPU translation (same design as the Python xla_shared_memory module,
+// triton_client_tpu/utils/xla_shared_memory/__init__.py): PjRt buffers are
+// not cross-process importable the way cudaIpcOpenMemHandle is, so the
+// portable raw handle is a JSON descriptor naming a POSIX host-shm *staging*
+// region; the server imports it and pays exactly one host<->device DMA per
+// direction.  In-process Python clients instead share a live device slot —
+// a C++ client is by definition out of process, so it always takes the
+// staging path.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace tc_tpu {
+namespace client {
+
+// Opaque region handle (ipc.h analog): owns the mmap'd staging region.
+struct XlaShmHandle {
+  std::string triton_shm_name;  // registration name
+  std::string staging_key;      // POSIX shm key ("/xlashm_...")
+  std::string uuid;             // slot id (never resolves cross-process)
+  size_t byte_size = 0;
+  int device_id = 0;
+  void* base_addr = nullptr;
+  int shm_fd = -1;
+};
+
+// Allocate the staging region + descriptor for a device-backed region
+// (reference cuda_shared_memory.create_shared_memory_region).
+Error CreateXlaSharedMemoryRegion(
+    XlaShmHandle* handle, const std::string& triton_shm_name,
+    size_t byte_size, int device_id);
+
+// Serialized import descriptor to pass to Register{Cuda,Xla}SharedMemory
+// (reference cuda_shared_memory.get_raw_handle: base64 of
+// cudaIpcMemHandle.reserved; here a JSON descriptor both registries parse).
+Error GetXlaSharedMemoryRawHandle(
+    const XlaShmHandle& handle, std::vector<uint8_t>* raw_handle);
+
+// Write bytes into the region (reference set_shared_memory_region:
+// cudaMemcpyAsync + sync; here a memcpy into staging — the server's
+// device_put is the H2D).
+Error SetXlaSharedMemoryRegion(
+    const XlaShmHandle& handle, const void* data, size_t byte_size,
+    size_t offset = 0);
+
+// Read bytes back (reference get_contents_as_numpy D2H path).
+Error GetXlaSharedMemoryContents(
+    const XlaShmHandle& handle, void* out, size_t byte_size,
+    size_t offset = 0);
+
+// Unmap + unlink the staging region (reference destroy_shared_memory_region
+// / cudaFree in CudaSharedMemoryRegion.__del__).
+Error DestroyXlaSharedMemoryRegion(XlaShmHandle* handle);
+
+}  // namespace client
+}  // namespace tc_tpu

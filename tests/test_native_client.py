@@ -65,6 +65,7 @@ def test_cpp_http_example(native_build, harness, example):
 @pytest.mark.parametrize("example", [
     "simple_grpc_infer_client",
     "simple_grpc_sequence_stream_infer_client",
+    "simple_grpc_cudashm_client",
 ])
 def test_cpp_grpc_example(native_build, harness, example):
     # the C++ gRPC client rides the grpc-web bridge on the HTTP port
