@@ -191,6 +191,30 @@ class InferResult {
   virtual std::string DebugString() const = 0;
 };
 
+// Result carrying only an error — delivered to async/stream callbacks when
+// the request itself failed, so callbacks always receive an InferResult.
+class ErrorResult : public InferResult {
+ public:
+  explicit ErrorResult(Error e) : err_(std::move(e)) {}
+  Error ModelName(std::string*) const override { return err_; }
+  Error ModelVersion(std::string*) const override { return err_; }
+  Error Id(std::string*) const override { return err_; }
+  Error Shape(const std::string&, std::vector<int64_t>*) const override {
+    return err_;
+  }
+  Error Datatype(const std::string&, std::string*) const override {
+    return err_;
+  }
+  Error RawData(const std::string&, const uint8_t**, size_t*) const override {
+    return err_;
+  }
+  Error RequestStatus() const override { return err_; }
+  std::string DebugString() const override { return err_.Message(); }
+
+ private:
+  Error err_;
+};
+
 //==============================================================================
 // Six-point request timers (reference common.h:568-648).
 class RequestTimers {

@@ -1,0 +1,118 @@
+// HTTP variant of the device-path example (reference
+// src/c++/examples/simple_http_cudashm_client.cc behavior): XLA shm regions
+// registered over the REST API, inputs and outputs passed by region name.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+#include "xla_shm_utils.h"
+
+namespace tc = tc_tpu::client;
+
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tc::Error err__ = (x);                                          \
+    if (!err__.IsOk()) {                                            \
+      fprintf(stderr, "%s: %s\n", (msg), err__.Message().c_str());  \
+      return 1;                                                     \
+    }                                                               \
+  } while (0)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+  }
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(tc::InferenceServerHttpClient::Create(&client, url),
+              "client creation failed");
+  FAIL_IF_ERR(client->UnregisterCudaSharedMemory(), "unregister-all failed");
+
+  constexpr size_t kCount = 16;
+  constexpr size_t kBytes = kCount * sizeof(int32_t);
+  int32_t input0[kCount], input1[kCount];
+  for (size_t i = 0; i < kCount; ++i) {
+    input0[i] = static_cast<int32_t>(i);
+    input1[i] = 3;
+  }
+
+  tc::XlaShmHandle in0_h, in1_h, out_h;
+  FAIL_IF_ERR(
+      tc::CreateXlaSharedMemoryRegion(&in0_h, "h_input0_data", kBytes, 0),
+      "create input0 region failed");
+  FAIL_IF_ERR(
+      tc::CreateXlaSharedMemoryRegion(&in1_h, "h_input1_data", kBytes, 0),
+      "create input1 region failed");
+  FAIL_IF_ERR(
+      tc::CreateXlaSharedMemoryRegion(&out_h, "h_output_data", kBytes, 0),
+      "create output region failed");
+  FAIL_IF_ERR(tc::SetXlaSharedMemoryRegion(in0_h, input0, kBytes),
+              "set input0 failed");
+  FAIL_IF_ERR(tc::SetXlaSharedMemoryRegion(in1_h, input1, kBytes),
+              "set input1 failed");
+
+  struct Reg {
+    const char* name;
+    tc::XlaShmHandle* h;
+  } regs[] = {{"h_input0_data", &in0_h},
+              {"h_input1_data", &in1_h},
+              {"h_output_data", &out_h}};
+  for (const auto& r : regs) {
+    std::vector<uint8_t> raw;
+    FAIL_IF_ERR(tc::GetXlaSharedMemoryRawHandle(*r.h, &raw),
+                "raw handle failed");
+    FAIL_IF_ERR(client->RegisterCudaSharedMemory(r.name, raw, 0, kBytes),
+                "register failed");
+  }
+
+  std::string status;
+  FAIL_IF_ERR(client->CudaSharedMemoryStatus(&status), "status failed");
+  for (const auto& r : regs) {
+    if (status.find(r.name) == std::string::npos) {
+      fprintf(stderr, "FAIL: region %s missing from status\n", r.name);
+      return 1;
+    }
+  }
+
+  tc::InferInput *in0, *in1;
+  tc::InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32");
+  tc::InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32");
+  FAIL_IF_ERR(in0->SetSharedMemory("h_input0_data", kBytes),
+              "INPUT0 set shm failed");
+  FAIL_IF_ERR(in1->SetSharedMemory("h_input1_data", kBytes),
+              "INPUT1 set shm failed");
+  tc::InferRequestedOutput* out0;
+  tc::InferRequestedOutput::Create(&out0, "OUTPUT0");
+  FAIL_IF_ERR(out0->SetSharedMemory("h_output_data", kBytes),
+              "OUTPUT0 set shm failed");
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  FAIL_IF_ERR(client->Infer(&result, options, {in0, in1}, {out0}),
+              "inference failed");
+  delete result;
+
+  int32_t sum[kCount];
+  FAIL_IF_ERR(tc::GetXlaSharedMemoryContents(out_h, sum, kBytes),
+              "read output failed");
+  for (size_t i = 0; i < kCount; ++i) {
+    if (sum[i] != input0[i] + input1[i]) {
+      fprintf(stderr, "FAIL: wrong sum at %zu: %d\n", i, sum[i]);
+      return 1;
+    }
+  }
+
+  FAIL_IF_ERR(client->UnregisterCudaSharedMemory(), "unregister failed");
+  for (const auto& r : regs) {
+    FAIL_IF_ERR(tc::DestroyXlaSharedMemoryRegion(r.h), "destroy failed");
+  }
+  delete in0;
+  delete in1;
+  delete out0;
+
+  printf("PASS: http xla shm\n");
+  return 0;
+}

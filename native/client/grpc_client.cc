@@ -2,6 +2,8 @@
 
 #include <cstring>
 
+#include "infer_multi.h"
+
 namespace tc_tpu {
 namespace client {
 
@@ -182,28 +184,6 @@ class InferResultGrpcImpl : public InferResult {
   std::map<std::string, int> raw_index_;
 };
 
-class ErrorResult : public InferResult {
- public:
-  explicit ErrorResult(Error e) : err_(std::move(e)) {}
-  Error ModelName(std::string*) const override { return err_; }
-  Error ModelVersion(std::string*) const override { return err_; }
-  Error Id(std::string*) const override { return err_; }
-  Error Shape(const std::string&, std::vector<int64_t>*) const override {
-    return err_;
-  }
-  Error Datatype(const std::string&, std::string*) const override {
-    return err_;
-  }
-  Error RawData(const std::string&, const uint8_t**, size_t*) const override {
-    return err_;
-  }
-  Error RequestStatus() const override { return err_; }
-  std::string DebugString() const override { return err_.Message(); }
-
- private:
-  Error err_;
-};
-
 }  // namespace
 
 //==============================================================================
@@ -247,14 +227,14 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
 Error InferenceServerGrpcClient::Call(
     const std::string& method, const google::protobuf::Message& request,
     google::protobuf::Message* response, const Headers& headers,
-    RequestTimers* timers) {
+    RequestTimers* timers, uint64_t timeout_us) {
   std::string body = Frame(request.SerializeAsString());
   Headers h = headers;
   h["Content-Type"] = "application/grpc-web+proto";
   HttpTransport::Response resp;
   TC_RETURN_IF_ERROR(transport_->Request(
       "POST", std::string(kServicePath) + "/" + method, body, h, &resp,
-      timers));
+      timers, timeout_us));
   if (resp.status != 200) {
     return Error("grpc-web request failed with HTTP status " +
                  std::to_string(resp.status));
@@ -363,6 +343,53 @@ Error InferenceServerGrpcClient::ModelInferenceStatistics(
   req.set_name(model_name);
   req.set_version(model_version);
   return Call("ModelStatistics", req, infer_stat, headers);
+}
+
+Error InferenceServerGrpcClient::UpdateTraceSettings(
+    pb::TraceSettingResponse* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings,
+    const Headers& headers) {
+  pb::TraceSettingRequest req;
+  req.set_model_name(model_name);
+  for (const auto& kv : settings) {
+    auto& value = (*req.mutable_settings())[kv.first];
+    for (const auto& v : kv.second) value.add_value(v);
+  }
+  return Call("TraceSetting", req, response, headers);
+}
+
+Error InferenceServerGrpcClient::GetTraceSettings(
+    pb::TraceSettingResponse* settings, const std::string& model_name,
+    const Headers& headers) {
+  pb::TraceSettingRequest req;
+  req.set_model_name(model_name);
+  return Call("TraceSetting", req, settings, headers);
+}
+
+Error InferenceServerGrpcClient::UpdateLogSettings(
+    pb::LogSettingsResponse* response,
+    const std::map<std::string, std::string>& settings,
+    const Headers& headers) {
+  pb::LogSettingsRequest req;
+  for (const auto& kv : settings) {
+    auto& value = (*req.mutable_settings())[kv.first];
+    if (kv.second == "true" || kv.second == "false") {
+      value.set_bool_param(kv.second == "true");
+    } else if (!kv.second.empty() &&
+               kv.second.find_first_not_of("0123456789") == std::string::npos) {
+      value.set_uint32_param(
+          static_cast<uint32_t>(strtoul(kv.second.c_str(), nullptr, 10)));
+    } else {
+      value.set_string_param(kv.second);
+    }
+  }
+  return Call("LogSettings", req, response, headers);
+}
+
+Error InferenceServerGrpcClient::GetLogSettings(
+    pb::LogSettingsResponse* settings, const Headers& headers) {
+  pb::LogSettingsRequest req;
+  return Call("LogSettings", req, settings, headers);
 }
 
 Error InferenceServerGrpcClient::SystemSharedMemoryStatus(
@@ -518,7 +545,9 @@ Error InferenceServerGrpcClient::Infer(
   pb::ModelInferRequest request;
   TC_RETURN_IF_ERROR(BuildInferRequest(options, inputs, outputs, &request));
   pb::ModelInferResponse response;
-  TC_RETURN_IF_ERROR(Call("ModelInfer", request, &response, headers, &timers));
+  TC_RETURN_IF_ERROR(Call(
+      "ModelInfer", request, &response, headers, &timers,
+      options.client_timeout_us_));
   *result = new InferResultGrpcImpl(std::move(response));
   timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
   UpdateInferStat(timers);
@@ -536,6 +565,7 @@ Error InferenceServerGrpcClient::AsyncInfer(
   AsyncJob job;
   job.callback = std::move(callback);
   job.headers = headers;
+  job.timeout_us = options.client_timeout_us_;
   TC_RETURN_IF_ERROR(
       BuildInferRequest(options, inputs, outputs, &job.request));
   {
@@ -564,7 +594,9 @@ void InferenceServerGrpcClient::AsyncTransfer() {
     RequestTimers timers;
     timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
     pb::ModelInferResponse response;
-    Error err = Call("ModelInfer", job.request, &response, job.headers, &timers);
+    Error err = Call(
+        "ModelInfer", job.request, &response, job.headers, &timers,
+        job.timeout_us);
     InferResult* result = nullptr;
     if (err.IsOk()) {
       result = new InferResultGrpcImpl(std::move(response));
@@ -576,6 +608,34 @@ void InferenceServerGrpcClient::AsyncTransfer() {
     }
     job.callback(result);
   }
+}
+
+//==============================================================================
+Error InferenceServerGrpcClient::InferMulti(
+    std::vector<InferResult*>* results,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  return multi_detail::InferMultiImpl(
+      results, options, inputs, outputs,
+      [&](InferResult** result, const InferOptions& opt, const auto& ins,
+          const auto& outs) {
+        return Infer(result, opt, ins, outs, headers);
+      });
+}
+
+Error InferenceServerGrpcClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  return multi_detail::AsyncInferMultiImpl(
+      std::move(callback), options, inputs, outputs,
+      [&](OnCompleteFn cb, const InferOptions& opt, const auto& ins,
+          const auto& outs) {
+        return AsyncInfer(std::move(cb), opt, ins, outs, headers);
+      });
 }
 
 //==============================================================================

@@ -20,6 +20,7 @@
 #include <condition_variable>
 #include <deque>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -81,6 +82,23 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const std::string& model_version = "",
       const Headers& headers = Headers());
 
+  // Server trace/log management (reference grpc client trace RPCs,
+  // grpc/_client.py:832-979 — the client configures server tracing).
+  Error UpdateTraceSettings(
+      pb::TraceSettingResponse* response, const std::string& model_name = "",
+      const std::map<std::string, std::vector<std::string>>& settings = {},
+      const Headers& headers = Headers());
+  Error GetTraceSettings(
+      pb::TraceSettingResponse* settings, const std::string& model_name = "",
+      const Headers& headers = Headers());
+  Error UpdateLogSettings(
+      pb::LogSettingsResponse* response,
+      const std::map<std::string, std::string>& settings = {},
+      const Headers& headers = Headers());
+  Error GetLogSettings(
+      pb::LogSettingsResponse* settings,
+      const Headers& headers = Headers());
+
   Error SystemSharedMemoryStatus(
       pb::SystemSharedMemoryStatusResponse* status,
       const std::string& region_name = "", const Headers& headers = Headers());
@@ -110,6 +128,23 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const std::vector<const InferRequestedOutput*>& outputs = {},
       const Headers& headers = Headers());
 
+  // Fan-out over multiple requests (reference InferMulti/AsyncInferMulti;
+  // options/outputs broadcast when single-element, else one per request).
+  using OnMultiCompleteFn = std::function<void(std::vector<InferResult*>)>;
+  Error InferMulti(
+      std::vector<InferResult*>* results,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {},
+      const Headers& headers = Headers());
+  Error AsyncInferMulti(
+      OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {},
+      const Headers& headers = Headers());
+
   // Live bidirectional streaming (reference grpc_client.cc:1377-1673
   // ClientReaderWriter + AsyncStreamTransfer reader thread): StartStream
   // opens a duplex gRPC-Web exchange and spawns a reader thread; every
@@ -130,7 +165,7 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   Error Call(
       const std::string& method, const google::protobuf::Message& request,
       google::protobuf::Message* response, const Headers& headers,
-      RequestTimers* timers = nullptr);
+      RequestTimers* timers = nullptr, uint64_t timeout_us = 0);
   static Error BuildInferRequest(
       const InferOptions& options, const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs,
@@ -144,6 +179,7 @@ class InferenceServerGrpcClient : public InferenceServerClient {
     OnCompleteFn callback;
     pb::ModelInferRequest request;
     Headers headers;
+    uint64_t timeout_us = 0;
   };
   std::mutex job_mu_;
   std::condition_variable job_cv_;

@@ -1,16 +1,97 @@
 #include "http_client.h"
 
+#include <zlib.h>
+
 #include <algorithm>
 #include <cstdio>
 #include <cstring>
 #include <sstream>
 
+#include "infer_multi.h"
+
 namespace tc_tpu {
 namespace client {
 
+namespace {
+
+// zlib body compression (reference CompressInput, http_client.cc:720):
+// DEFLATE = raw zlib stream, GZIP = zlib with gzip wrapper.
+Error ZCompress(
+    const std::string& in,
+    InferenceServerHttpClient::CompressionType type, std::string* out) {
+  int window_bits =
+      type == InferenceServerHttpClient::CompressionType::GZIP ? 15 + 16 : 15;
+  z_stream zs = {};
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, window_bits, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("failed to initialize compression stream");
+  }
+  out->resize(deflateBound(&zs, in.size()));
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  zs.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
+  zs.avail_out = static_cast<uInt>(out->size());
+  int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("failed to compress request body");
+  }
+  out->resize(zs.total_out);
+  return Error::Success;
+}
+
+Error ZDecompress(const std::string& in, const std::string& encoding,
+                  std::string* out) {
+  // 15+32: auto-detect zlib or gzip wrapper
+  z_stream zs = {};
+  if (inflateInit2(&zs, 15 + 32) != Z_OK) {
+    return Error("failed to initialize decompression stream");
+  }
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  out->clear();
+  char buf[16384];
+  int rc = Z_OK;
+  do {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = sizeof(buf);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return Error("failed to decompress '" + encoding + "' response body");
+    }
+    out->append(buf, sizeof(buf) - zs.avail_out);
+  } while (rc != Z_STREAM_END);
+  inflateEnd(&zs);
+  return Error::Success;
+}
+
+const char* EncodingName(InferenceServerHttpClient::CompressionType t) {
+  switch (t) {
+    case InferenceServerHttpClient::CompressionType::DEFLATE:
+      return "deflate";
+    case InferenceServerHttpClient::CompressionType::GZIP:
+      return "gzip";
+    default:
+      return "";
+  }
+}
+
+}  // namespace
+
 Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client,
-    const std::string& server_url, bool verbose, size_t concurrency) {
+    const std::string& server_url, bool verbose, size_t concurrency,
+    bool use_ssl, const HttpSslOptions& ssl_options) {
+  (void)ssl_options;
+  if (use_ssl) {
+    // The reference gets TLS from libcurl (HttpSslOptions,
+    // http_client.h:45-86); this build has no TLS library, so fail loudly
+    // rather than silently speaking plaintext.
+    return Error(
+        "client was built without SSL support; use a TLS-terminating proxy "
+        "or the Python client");
+  }
   if (server_url.rfind("http://", 0) == 0 ||
       server_url.rfind("https://", 0) == 0) {
     return Error("url should not include the scheme");
@@ -59,8 +140,9 @@ Error InferenceServerHttpClient::Get(
 
 Error InferenceServerHttpClient::Post(
     const std::string& path, const std::string& body, const Headers& headers,
-    Response* out, RequestTimers* timers) {
-  Error err = transport_->Request("POST", path, body, headers, out, timers);
+    Response* out, RequestTimers* timers, uint64_t timeout_us) {
+  Error err = transport_->Request(
+      "POST", path, body, headers, out, timers, timeout_us);
   if (err.IsOk() && verbose_) {
     fprintf(stderr, "POST /%s -> %d (%zu bytes)\n", path.c_str(), out->status,
             out->body.size());
@@ -557,31 +639,33 @@ Error InferenceServerHttpClient::BuildInferRequestBody(
   return Error::Success;
 }
 
-Error InferenceServerHttpClient::Infer(
-    InferResult** result, const InferOptions& options,
-    const std::vector<InferInput*>& inputs,
-    const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers) {
-  RequestTimers timers;
-  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
-
-  std::string body;
-  size_t header_length = 0;
-  TC_RETURN_IF_ERROR(
-      BuildInferRequestBody(options, inputs, outputs, &body, &header_length));
-
-  std::string path = "v2/models/" + options.model_name_;
-  if (!options.model_version_.empty()) {
-    path += "/versions/" + options.model_version_;
-  }
-  path += "/infer";
-
+Error InferenceServerHttpClient::DoInfer(
+    InferResult** result, const std::string& path, std::string body,
+    size_t header_length, const Headers& headers, uint64_t timeout_us,
+    CompressionType request_compression, CompressionType response_compression,
+    RequestTimers* timers) {
   Headers h = headers;
   h["Inference-Header-Content-Length"] = std::to_string(header_length);
   h["Content-Type"] = "application/octet-stream";
+  if (request_compression != CompressionType::NONE) {
+    std::string compressed;
+    TC_RETURN_IF_ERROR(ZCompress(body, request_compression, &compressed));
+    body = std::move(compressed);
+    h["Content-Encoding"] = EncodingName(request_compression);
+  }
+  if (response_compression != CompressionType::NONE) {
+    h["Accept-Encoding"] = EncodingName(response_compression);
+  }
 
   Response resp;
-  TC_RETURN_IF_ERROR(Post(path, body, h, &resp, &timers));
+  TC_RETURN_IF_ERROR(Post(path, body, h, &resp, timers, timeout_us));
+  auto enc = resp.headers.find("content-encoding");
+  if (enc != resp.headers.end() && !enc->second.empty() &&
+      enc->second != "identity") {
+    std::string plain;
+    TC_RETURN_IF_ERROR(ZDecompress(resp.body, enc->second, &plain));
+    resp.body = std::move(plain);
+  }
   TC_RETURN_IF_ERROR(CheckResponse(resp));
 
   size_t resp_header_len = 0;
@@ -589,8 +673,39 @@ Error InferenceServerHttpClient::Infer(
   if (it != resp.headers.end()) {
     resp_header_len = strtoul(it->second.c_str(), nullptr, 10);
   }
-  TC_RETURN_IF_ERROR(InferResultHttpImpl::Create(
-      result, std::move(resp.body), resp_header_len));
+  return InferResultHttpImpl::Create(
+      result, std::move(resp.body), resp_header_len);
+}
+
+namespace {
+
+std::string InferPath(const InferOptions& options) {
+  std::string path = "v2/models/" + options.model_name_;
+  if (!options.model_version_.empty()) {
+    path += "/versions/" + options.model_version_;
+  }
+  return path + "/infer";
+}
+
+}  // namespace
+
+Error InferenceServerHttpClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers, CompressionType request_compression_algorithm,
+    CompressionType response_compression_algorithm) {
+  RequestTimers timers;
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+
+  std::string body;
+  size_t header_length = 0;
+  TC_RETURN_IF_ERROR(
+      BuildInferRequestBody(options, inputs, outputs, &body, &header_length));
+  TC_RETURN_IF_ERROR(DoInfer(
+      result, InferPath(options), std::move(body), header_length, headers,
+      options.client_timeout_us_, request_compression_algorithm,
+      response_compression_algorithm, &timers));
 
   timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
   UpdateInferStat(timers);
@@ -601,7 +716,8 @@ Error InferenceServerHttpClient::AsyncInfer(
     OnCompleteFn callback, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers) {
+    const Headers& headers, CompressionType request_compression_algorithm,
+    CompressionType response_compression_algorithm) {
   if (callback == nullptr) {
     return Error("callback must not be null for AsyncInfer");
   }
@@ -609,11 +725,6 @@ Error InferenceServerHttpClient::AsyncInfer(
   size_t header_length = 0;
   TC_RETURN_IF_ERROR(
       BuildInferRequestBody(options, inputs, outputs, &body, &header_length));
-  std::string path = "v2/models/" + options.model_name_;
-  if (!options.model_version_.empty()) {
-    path += "/versions/" + options.model_version_;
-  }
-  path += "/infer";
 
   {
     std::lock_guard<std::mutex> lk(job_mu_);
@@ -622,9 +733,10 @@ Error InferenceServerHttpClient::AsyncInfer(
         workers_.emplace_back(&InferenceServerHttpClient::AsyncTransfer, this);
       }
     }
-    jobs_.push_back(
-        AsyncJob{std::move(callback), std::move(path), std::move(body),
-                 headers, header_length});
+    jobs_.push_back(AsyncJob{
+        std::move(callback), InferPath(options), std::move(body), headers,
+        header_length, options.client_timeout_us_,
+        request_compression_algorithm, response_compression_algorithm});
   }
   job_cv_.notify_one();
   return Error::Success;
@@ -642,46 +754,12 @@ void InferenceServerHttpClient::AsyncTransfer() {
     }
     RequestTimers timers;
     timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
-    Headers h = job.headers;
-    h["Inference-Header-Content-Length"] = std::to_string(job.header_length);
-    h["Content-Type"] = "application/octet-stream";
-    Response resp;
-    Error err = Post(job.path, job.body, h, &resp, &timers);
-    if (err.IsOk()) err = CheckResponse(resp);
     InferResult* result = nullptr;
-    if (err.IsOk()) {
-      size_t resp_header_len = 0;
-      auto it = resp.headers.find("inference-header-content-length");
-      if (it != resp.headers.end()) {
-        resp_header_len = strtoul(it->second.c_str(), nullptr, 10);
-      }
-      err = InferResultHttpImpl::Create(
-          &result, std::move(resp.body), resp_header_len);
-    }
+    Error err = DoInfer(
+        &result, job.path, std::move(job.body), job.header_length,
+        job.headers, job.timeout_us, job.request_compression,
+        job.response_compression, &timers);
     if (!err.IsOk()) {
-      // error result wrapper so the callback always receives an InferResult
-      class ErrorResult : public InferResult {
-       public:
-        explicit ErrorResult(Error e) : err_(std::move(e)) {}
-        Error ModelName(std::string*) const override { return err_; }
-        Error ModelVersion(std::string*) const override { return err_; }
-        Error Id(std::string*) const override { return err_; }
-        Error Shape(const std::string&, std::vector<int64_t>*) const override {
-          return err_;
-        }
-        Error Datatype(const std::string&, std::string*) const override {
-          return err_;
-        }
-        Error RawData(const std::string&, const uint8_t**, size_t*)
-            const override {
-          return err_;
-        }
-        Error RequestStatus() const override { return err_; }
-        std::string DebugString() const override { return err_.Message(); }
-
-       private:
-        Error err_;
-      };
       result = new ErrorResult(err);
     } else {
       timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
@@ -692,6 +770,33 @@ void InferenceServerHttpClient::AsyncTransfer() {
     }
     job.callback(result);
   }
+}
+
+//==============================================================================
+Error InferenceServerHttpClient::InferMulti(
+    std::vector<InferResult*>* results, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  return multi_detail::InferMultiImpl(
+      results, options, inputs, outputs,
+      [&](InferResult** result, const InferOptions& opt, const auto& ins,
+          const auto& outs) {
+        return Infer(result, opt, ins, outs, headers);
+      });
+}
+
+Error InferenceServerHttpClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  return multi_detail::AsyncInferMultiImpl(
+      std::move(callback), options, inputs, outputs,
+      [&](OnCompleteFn cb, const InferOptions& opt, const auto& ins,
+          const auto& outs) {
+        return AsyncInfer(std::move(cb), opt, ins, outs, headers);
+      });
 }
 
 }  // namespace client

@@ -32,16 +32,38 @@ namespace client {
 
 using Parameters = std::map<std::string, std::string>;
 
+// SSL options (API parity with reference http_client.h:45-86).  This build
+// has no TLS library (the reference delegates TLS to libcurl; this image
+// ships neither libcurl nor OpenSSL headers), so Create() with
+// `use_ssl=true` returns an explicit error rather than silently running
+// plaintext.  The struct is kept so calling code is source-compatible.
+struct HttpSslOptions {
+  enum class CERTTYPE { CERT_PEM, CERT_DER };
+  enum class KEYTYPE { KEY_PEM, KEY_DER };
+  bool verify_peer = true;
+  bool verify_host = true;
+  std::string ca_info;
+  CERTTYPE cert_type = CERTTYPE::CERT_PEM;
+  std::string cert;
+  KEYTYPE key_type = KEYTYPE::KEY_PEM;
+  std::string key;
+};
+
 class InferResultHttp;
 
 class InferenceServerHttpClient : public InferenceServerClient {
  public:
   using OnCompleteFn = std::function<void(InferResult*)>;
+  using OnMultiCompleteFn = std::function<void(std::vector<InferResult*>)>;
+
+  // Body compression (reference http_client.h CompressionType; zlib-backed).
+  enum class CompressionType { NONE, DEFLATE, GZIP };
 
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
       const std::string& server_url, bool verbose = false,
-      size_t concurrency = 4);
+      size_t concurrency = 4, bool use_ssl = false,
+      const HttpSslOptions& ssl_options = HttpSslOptions());
   ~InferenceServerHttpClient() override;
 
   Error IsServerLive(bool* live, const Headers& headers = Headers());
@@ -113,12 +135,34 @@ class InferenceServerHttpClient : public InferenceServerClient {
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
-      const Headers& headers = Headers());
+      const Headers& headers = Headers(),
+      CompressionType request_compression_algorithm = CompressionType::NONE,
+      CompressionType response_compression_algorithm = CompressionType::NONE);
 
   Error AsyncInfer(
       OnCompleteFn callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
+      const Headers& headers = Headers(),
+      CompressionType request_compression_algorithm = CompressionType::NONE,
+      CompressionType response_compression_algorithm = CompressionType::NONE);
+
+  // Fan-out over multiple requests in one call (reference
+  // http_client.cc:1911-2021).  `options`/`outputs` may hold one element
+  // (broadcast to every request) or exactly `inputs.size()`; the single
+  // `headers` map applies to every request.
+  Error InferMulti(
+      std::vector<InferResult*>* results,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {},
+      const Headers& headers = Headers());
+  Error AsyncInferMulti(
+      OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {},
       const Headers& headers = Headers());
 
  private:
@@ -130,8 +174,15 @@ class InferenceServerHttpClient : public InferenceServerClient {
   Error Get(const std::string& path, const Headers& headers, Response* out);
   Error Post(
       const std::string& path, const std::string& body,
-      const Headers& headers, Response* out, RequestTimers* timers = nullptr);
+      const Headers& headers, Response* out, RequestTimers* timers = nullptr,
+      uint64_t timeout_us = 0);
   static Error CheckResponse(const Response& resp);
+  // One infer exchange: build headers, compress, post, decompress, parse.
+  Error DoInfer(
+      InferResult** result, const std::string& path, std::string body,
+      size_t header_length, const Headers& headers, uint64_t timeout_us,
+      CompressionType request_compression,
+      CompressionType response_compression, RequestTimers* timers);
 
   Error BuildInferRequestBody(
       const InferOptions& options, const std::vector<InferInput*>& inputs,
@@ -147,7 +198,10 @@ class InferenceServerHttpClient : public InferenceServerClient {
     std::string path;
     std::string body;
     Headers headers;
-    size_t header_length;
+    size_t header_length = 0;
+    uint64_t timeout_us = 0;
+    CompressionType request_compression = CompressionType::NONE;
+    CompressionType response_compression = CompressionType::NONE;
   };
   void AsyncTransfer();
   std::mutex job_mu_;

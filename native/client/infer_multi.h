@@ -1,0 +1,117 @@
+// Shared multi-request fan-out (reference InferMulti/AsyncInferMulti,
+// http_client.cc:1911-2021): the broadcast-arity rules, the error-cleanup
+// loop, and the atomic countdown join are identical for the HTTP and gRPC
+// clients, so they live once here and each client instantiates them with
+// its own Infer/AsyncInfer callable.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace tc_tpu {
+namespace client {
+namespace multi_detail {
+
+template <typename T>
+inline Error CheckMultiArity(
+    const std::vector<T>& v, size_t n, const char* what) {
+  if (v.size() == 1 || v.size() == n) return Error::Success;
+  return Error(
+      std::string("expected 1 or ") + std::to_string(n) + " " + what +
+      ", got " + std::to_string(v.size()));
+}
+
+// infer_fn(result_out, options, inputs, outputs) -> Error
+template <typename InferFn>
+Error InferMultiImpl(
+    std::vector<InferResult*>* results, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    InferFn&& infer_fn) {
+  const size_t n = inputs.size();
+  if (n == 0) return Error("no inference requests provided");
+  TC_RETURN_IF_ERROR(CheckMultiArity(options, n, "options"));
+  if (!outputs.empty()) {
+    TC_RETURN_IF_ERROR(CheckMultiArity(outputs, n, "outputs"));
+  }
+  results->clear();
+  results->reserve(n);
+  static const std::vector<const InferRequestedOutput*> kNoOutputs;
+  for (size_t i = 0; i < n; ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs = outputs.empty()
+                           ? kNoOutputs
+                           : (outputs.size() == 1 ? outputs[0] : outputs[i]);
+    InferResult* result = nullptr;
+    Error err = infer_fn(&result, opt, inputs[i], outs);
+    if (!err.IsOk()) {
+      for (InferResult* r : *results) delete r;
+      results->clear();
+      return err;
+    }
+    results->push_back(result);
+  }
+  return Error::Success;
+}
+
+// async_fn(per_request_callback, options, inputs, outputs) -> Error.
+// The user callback fires once, with results in request order, after the
+// last request completes (atomic countdown join).
+template <typename AsyncFn>
+Error AsyncInferMultiImpl(
+    std::function<void(std::vector<InferResult*>)> callback,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    AsyncFn&& async_fn) {
+  const size_t n = inputs.size();
+  if (n == 0) return Error("no inference requests provided");
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInferMulti");
+  }
+  TC_RETURN_IF_ERROR(CheckMultiArity(options, n, "options"));
+  if (!outputs.empty()) {
+    TC_RETURN_IF_ERROR(CheckMultiArity(outputs, n, "outputs"));
+  }
+  struct MultiState {
+    std::function<void(std::vector<InferResult*>)> callback;
+    std::vector<InferResult*> results;
+    std::atomic<size_t> remaining;
+  };
+  auto state = std::make_shared<MultiState>();
+  state->callback = std::move(callback);
+  state->results.resize(n, nullptr);
+  state->remaining = n;
+  static const std::vector<const InferRequestedOutput*> kNoOutputs;
+  for (size_t i = 0; i < n; ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs = outputs.empty()
+                           ? kNoOutputs
+                           : (outputs.size() == 1 ? outputs[0] : outputs[i]);
+    Error err = async_fn(
+        [state, i](InferResult* result) {
+          state->results[i] = result;
+          if (state->remaining.fetch_sub(1) == 1) {
+            state->callback(std::move(state->results));
+          }
+        },
+        opt, inputs[i], outs);
+    if (!err.IsOk()) {
+      // deliver the submit failure through the slot so the join still fires
+      state->results[i] = new ErrorResult(err);
+      if (state->remaining.fetch_sub(1) == 1) {
+        state->callback(std::move(state->results));
+      }
+    }
+  }
+  return Error::Success;
+}
+
+}  // namespace multi_detail
+}  // namespace client
+}  // namespace tc_tpu

@@ -161,11 +161,115 @@ void TestHttp(const std::string& url) {
   delete sresult;
   delete sin;
 
-  // error surface: unknown model
-  tc::InferResult* bad = nullptr;
-  tc::InferOptions bad_options("no_such_model");
-  tc::Error err = client->Infer(&bad, bad_options, inputs, outputs);
-  CHECK_TRUE(!err.IsOk());
+  // body compression round trips (zlib: gzip + deflate request coding;
+  // gzip response negotiated via Accept-Encoding)
+  using CT = tc::InferenceServerHttpClient::CompressionType;
+  for (CT req_comp : {CT::GZIP, CT::DEFLATE}) {
+    tc::InferResult* cresult = nullptr;
+    CHECK_OK(client->Infer(
+        &cresult, options, inputs, outputs, tc::Headers(), req_comp,
+        CT::GZIP));
+    CheckSimpleResult(cresult, input0, input1);
+    delete cresult;
+  }
+
+  // InferMulti: broadcast options over 3 requests
+  {
+    std::vector<std::vector<tc::InferInput*>> multi_inputs(3, inputs);
+    std::vector<tc::InferResult*> results;
+    CHECK_OK(client->InferMulti(&results, {options}, multi_inputs));
+    CHECK_TRUE(results.size() == 3);
+    for (auto* r : results) {
+      CheckSimpleResult(r, input0, input1);
+      delete r;
+    }
+    // arity mismatch must be rejected (2 options vs 3 requests)
+    tc::Error multi_err = client->InferMulti(
+        &results, {options, options}, multi_inputs);
+    CHECK_TRUE(!multi_err.IsOk());
+
+    // AsyncInferMulti: one callback with results in request order
+    std::mutex mmu;
+    std::condition_variable mcv;
+    bool mdone = false;
+    std::vector<tc::InferResult*> mresults;
+    CHECK_OK(client->AsyncInferMulti(
+        [&](std::vector<tc::InferResult*> rs) {
+          std::lock_guard<std::mutex> lk(mmu);
+          mresults = std::move(rs);
+          mdone = true;
+          mcv.notify_one();
+        },
+        {options}, multi_inputs));
+    {
+      std::unique_lock<std::mutex> lk(mmu);
+      mcv.wait(lk, [&] { return mdone; });
+    }
+    CHECK_TRUE(mresults.size() == 3);
+    for (auto* r : mresults) {
+      CHECK_OK(r->RequestStatus());
+      CheckSimpleResult(r, input0, input1);
+      delete r;
+    }
+  }
+
+  // SSL is an explicit descope in this build: loud error, not silent http
+  {
+    std::unique_ptr<tc::InferenceServerHttpClient> ssl_client;
+    tc::Error ssl_err = tc::InferenceServerHttpClient::Create(
+        &ssl_client, url, false, 4, true);
+    CHECK_TRUE(!ssl_err.IsOk());
+    CHECK_TRUE(ssl_err.Message().find("SSL") != std::string::npos);
+  }
+
+  // trace/log settings management
+  {
+    std::string settings;
+    CHECK_OK(client->GetTraceSettings(&settings));
+    CHECK_TRUE(settings.find("trace_level") != std::string::npos);
+    CHECK_OK(client->UpdateTraceSettings(
+        &settings, "", {{"trace_level", {"TIMESTAMPS"}}}));
+    CHECK_TRUE(settings.find("TIMESTAMPS") != std::string::npos);
+    CHECK_OK(client->UpdateTraceSettings(
+        &settings, "", {{"trace_level", {"OFF"}}}));
+    CHECK_OK(client->GetLogSettings(&settings));
+    CHECK_TRUE(settings.find("log_verbose_level") != std::string::npos);
+  }
+
+  // error matrix: unknown model / unknown input / shape mismatch / missing
+  {
+    tc::InferResult* bad = nullptr;
+    tc::InferOptions bad_options("no_such_model");
+    tc::Error err = client->Infer(&bad, bad_options, inputs, outputs);
+    CHECK_TRUE(!err.IsOk());
+
+    tc::InferInput* wrong_name;
+    CHECK_OK(tc::InferInput::Create(&wrong_name, "NOPE", {1, 16}, "INT32"));
+    CHECK_OK(wrong_name->AppendRaw(
+        reinterpret_cast<const uint8_t*>(input0.data()),
+        input0.size() * sizeof(int32_t)));
+    err = client->Infer(&bad, options, {wrong_name, inputs[1]}, outputs);
+    CHECK_TRUE(!err.IsOk());
+    CHECK_TRUE(err.Message().find("NOPE") != std::string::npos);
+    delete wrong_name;
+
+    tc::InferInput* wrong_shape;
+    CHECK_OK(tc::InferInput::Create(&wrong_shape, "INPUT0", {1, 8}, "INT32"));
+    CHECK_OK(wrong_shape->AppendRaw(
+        reinterpret_cast<const uint8_t*>(input0.data()), 8 * sizeof(int32_t)));
+    err = client->Infer(&bad, options, {wrong_shape, inputs[1]}, outputs);
+    CHECK_TRUE(!err.IsOk());
+    delete wrong_shape;
+
+    err = client->Infer(&bad, options, {inputs[0]}, outputs);  // missing in1
+    CHECK_TRUE(!err.IsOk());
+
+    tc::InferRequestedOutput* bad_out;
+    CHECK_OK(tc::InferRequestedOutput::Create(&bad_out, "NO_SUCH_OUTPUT"));
+    err = client->Infer(&bad, options, inputs, {bad_out});
+    CHECK_TRUE(!err.IsOk());
+    delete bad_out;
+  }
 
   // stats accounting
   tc::InferStat stat;
@@ -273,11 +377,121 @@ void TestGrpc(const std::string& url) {
   CHECK_TRUE(seq_outputs[0] == 11 && seq_outputs[1] == 18 &&
              seq_outputs[2] == 23);
 
-  // error surface
-  tc::InferResult* bad = nullptr;
-  tc::InferOptions bad_options("no_such_model");
-  tc::Error err = client->Infer(&bad, bad_options, inputs, outputs);
-  CHECK_TRUE(!err.IsOk());
+  // string (dyna) correlation ids over a second stream
+  {
+    std::vector<int32_t> dyna_outputs;
+    CHECK_OK(client->StartStream([&](tc::InferResult* r) {
+      const uint8_t* buf;
+      size_t len;
+      if (r->RequestStatus().IsOk() &&
+          r->RawData("OUTPUT", &buf, &len).IsOk() && len >= 4) {
+        int32_t v;
+        memcpy(&v, buf, 4);
+        dyna_outputs.push_back(v);
+      }
+      delete r;
+    }));
+    for (int i = 0; i < 2; ++i) {
+      int32_t value = 3;
+      tc::InferInput* sin;
+      CHECK_OK(tc::InferInput::Create(&sin, "INPUT", {1}, "INT32"));
+      CHECK_OK(sin->AppendRaw(
+          reinterpret_cast<const uint8_t*>(&value), sizeof(int32_t)));
+      tc::InferOptions sopt("simple_dyna_sequence");
+      sopt.sequence_id_str_ = "seq-string-id";
+      sopt.sequence_start_ = (i == 0);
+      sopt.sequence_end_ = (i == 1);
+      CHECK_OK(client->AsyncStreamInfer(sopt, {sin}));
+      delete sin;
+    }
+    CHECK_OK(client->FinishStream());
+    CHECK_TRUE(dyna_outputs.size() == 2);
+    // accumulator seeded with hash(corr id) % 1000, then +3 each step
+    CHECK_TRUE(dyna_outputs[1] - dyna_outputs[0] == 3);
+  }
+
+  // InferMulti / AsyncInferMulti fan-out
+  {
+    std::vector<std::vector<tc::InferInput*>> multi_inputs(3, inputs);
+    std::vector<tc::InferResult*> results;
+    CHECK_OK(client->InferMulti(&results, {options}, multi_inputs));
+    CHECK_TRUE(results.size() == 3);
+    for (auto* r : results) {
+      CheckSimpleResult(r, input0, input1);
+      delete r;
+    }
+    tc::Error multi_err =
+        client->InferMulti(&results, {options, options}, multi_inputs);
+    CHECK_TRUE(!multi_err.IsOk());
+
+    std::mutex mmu;
+    std::condition_variable mcv;
+    bool mdone = false;
+    std::vector<tc::InferResult*> mresults;
+    CHECK_OK(client->AsyncInferMulti(
+        [&](std::vector<tc::InferResult*> rs) {
+          std::lock_guard<std::mutex> lk(mmu);
+          mresults = std::move(rs);
+          mdone = true;
+          mcv.notify_one();
+        },
+        {options}, multi_inputs));
+    {
+      std::unique_lock<std::mutex> lk(mmu);
+      mcv.wait(lk, [&] { return mdone; });
+    }
+    CHECK_TRUE(mresults.size() == 3);
+    for (auto* r : mresults) {
+      CHECK_OK(r->RequestStatus());
+      CheckSimpleResult(r, input0, input1);
+      delete r;
+    }
+  }
+
+  // trace/log settings over gRPC
+  {
+    tc::pb::TraceSettingResponse trace;
+    CHECK_OK(client->GetTraceSettings(&trace));
+    CHECK_TRUE(trace.settings().count("trace_level") == 1);
+    CHECK_OK(client->UpdateTraceSettings(
+        &trace, "", {{"trace_level", {"TIMESTAMPS"}}}));
+    CHECK_TRUE(trace.settings().at("trace_level").value(0) == "TIMESTAMPS");
+    CHECK_OK(client->UpdateTraceSettings(
+        &trace, "", {{"trace_level", {"OFF"}}}));
+    tc::pb::LogSettingsResponse log;
+    CHECK_OK(client->GetLogSettings(&log));
+    CHECK_TRUE(log.settings().count("log_verbose_level") == 1);
+    CHECK_OK(client->UpdateLogSettings(&log, {{"log_verbose_level", "1"}}));
+    CHECK_OK(client->UpdateLogSettings(&log, {{"log_verbose_level", "0"}}));
+  }
+
+  // statistics reflect the traffic this test generated
+  {
+    tc::pb::ModelStatisticsResponse stats;
+    CHECK_OK(client->ModelInferenceStatistics(&stats, "simple"));
+    CHECK_TRUE(stats.model_stats_size() == 1);
+    CHECK_TRUE(stats.model_stats(0).inference_count() > 0);
+  }
+
+  // error matrix
+  {
+    tc::InferResult* bad = nullptr;
+    tc::InferOptions bad_options("no_such_model");
+    tc::Error err = client->Infer(&bad, bad_options, inputs, outputs);
+    CHECK_TRUE(!err.IsOk());
+
+    tc::InferInput* wrong_dtype;
+    CHECK_OK(tc::InferInput::Create(&wrong_dtype, "INPUT0", {1, 16}, "FP32"));
+    CHECK_OK(wrong_dtype->AppendRaw(
+        reinterpret_cast<const uint8_t*>(input0.data()),
+        input0.size() * sizeof(int32_t)));
+    err = client->Infer(&bad, options, {wrong_dtype, inputs[1]}, outputs);
+    CHECK_TRUE(!err.IsOk());
+    delete wrong_dtype;
+
+    err = client->Infer(&bad, options, {inputs[0]}, outputs);
+    CHECK_TRUE(!err.IsOk());
+  }
 
   for (auto* i : inputs) delete i;
   delete out0;

@@ -1,0 +1,135 @@
+// Client-timeout matrix (reference model: src/c++/tests/
+// client_timeout_test.cc:63-90+ — drive every API with a short deadline
+// against custom_identity_int32 and require Deadline Exceeded errors; then
+// prove the same calls succeed without the deadline).  The delay comes from
+// the model's `execute_delay_ms` request parameter.
+//
+// Usage: client_timeout_test <http_host:port>
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+#define CHECK_OK(expr)                                                \
+  do {                                                                \
+    tc::Error err__ = (expr);                                         \
+    if (!err__.IsOk()) {                                              \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,       \
+              err__.Message().c_str());                               \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (false)
+
+#define CHECK_TRUE(cond)                                                \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (false)
+
+namespace {
+
+constexpr uint64_t kShortTimeoutUs = 100 * 1000;  // 100ms deadline...
+constexpr const char* kDelayMs = "1500";          // ...vs 1.5s execution
+
+bool IsDeadlineExceeded(const tc::Error& err) {
+  return !err.IsOk() &&
+         err.Message().find("Deadline Exceeded") != std::string::npos;
+}
+
+tc::InferInput* MakeInput(int32_t value) {
+  static int32_t storage[8];
+  storage[0] = value;
+  tc::InferInput* input;
+  CHECK_OK(tc::InferInput::Create(&input, "INPUT0", {1, 1}, "INT32"));
+  CHECK_OK(input->AppendRaw(
+      reinterpret_cast<const uint8_t*>(storage), sizeof(int32_t)));
+  return input;
+}
+
+tc::InferOptions DelayedOptions(uint64_t client_timeout_us) {
+  tc::InferOptions options("custom_identity_int32");
+  options.client_timeout_us_ = client_timeout_us;
+  options.request_parameters_["execute_delay_ms"] = kDelayMs;
+  return options;
+}
+
+template <typename ClientT>
+void TestSyncTimeout(ClientT* client) {
+  tc::InferInput* input = MakeInput(7);
+  tc::InferResult* result = nullptr;
+  tc::Error err =
+      client->Infer(&result, DelayedOptions(kShortTimeoutUs), {input});
+  CHECK_TRUE(IsDeadlineExceeded(err));
+
+  // no deadline -> the same slow call completes
+  tc::InferOptions patient = DelayedOptions(0);
+  patient.request_parameters_["execute_delay_ms"] = "0";
+  CHECK_OK(client->Infer(&result, patient, {input}));
+  const uint8_t* buf;
+  size_t len;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &len));
+  CHECK_TRUE(*reinterpret_cast<const int32_t*>(buf) == 7);
+  delete result;
+  delete input;
+}
+
+template <typename ClientT>
+void TestAsyncTimeout(ClientT* client) {
+  tc::InferInput* input = MakeInput(9);
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  tc::Error async_err;
+  CHECK_OK(client->AsyncInfer(
+      [&](tc::InferResult* r) {
+        std::lock_guard<std::mutex> lk(mu);
+        async_err = r->RequestStatus();
+        done = true;
+        delete r;
+        cv.notify_one();
+      },
+      DelayedOptions(kShortTimeoutUs), {input}));
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+  CHECK_TRUE(IsDeadlineExceeded(async_err));
+  delete input;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <http_host:port>\n", argv[0]);
+    return 2;
+  }
+  const std::string url = argv[1];
+
+  {
+    std::unique_ptr<tc::InferenceServerHttpClient> client;
+    CHECK_OK(tc::InferenceServerHttpClient::Create(&client, url));
+    TestSyncTimeout(client.get());
+    TestAsyncTimeout(client.get());
+    printf("PASS: http timeouts\n");
+  }
+  {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+    TestSyncTimeout(client.get());
+    TestAsyncTimeout(client.get());
+    printf("PASS: grpc timeouts\n");
+  }
+  printf("PASS: all\n");
+  return 0;
+}

@@ -1,0 +1,115 @@
+// Memory-growth test (reference model: src/c++/tests/memory_leak_test.cc —
+// loop sync/async infers and fail on unbounded growth).  RSS is sampled from
+// /proc/self/status after a warm-up phase so allocator steady-state, pools,
+// and lazily-started worker threads do not count as leaks.
+//
+// Usage: memory_leak_test <http_host:port> [iterations]
+
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+#define CHECK_OK(expr)                                                \
+  do {                                                                \
+    tc::Error err__ = (expr);                                         \
+    if (!err__.IsOk()) {                                              \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,       \
+              err__.Message().c_str());                               \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (false)
+
+namespace {
+
+long RssKb() {
+  FILE* f = fopen("/proc/self/status", "r");
+  if (!f) return -1;
+  char line[256];
+  long kb = -1;
+  while (fgets(line, sizeof(line), f)) {
+    if (strncmp(line, "VmRSS:", 6) == 0) {
+      kb = atol(line + 6);
+      break;
+    }
+  }
+  fclose(f);
+  return kb;
+}
+
+template <typename ClientT>
+void RunIterations(ClientT* client, int n) {
+  for (int it = 0; it < n; ++it) {
+    std::vector<int32_t> input0(16), input1(16);
+    for (int i = 0; i < 16; ++i) {
+      input0[i] = i + it;
+      input1[i] = 2;
+    }
+    tc::InferInput *in0, *in1;
+    CHECK_OK(tc::InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32"));
+    CHECK_OK(tc::InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32"));
+    CHECK_OK(in0->AppendRaw(
+        reinterpret_cast<const uint8_t*>(input0.data()),
+        input0.size() * sizeof(int32_t)));
+    CHECK_OK(in1->AppendRaw(
+        reinterpret_cast<const uint8_t*>(input1.data()),
+        input1.size() * sizeof(int32_t)));
+    tc::InferOptions options("simple");
+    tc::InferResult* result = nullptr;
+    CHECK_OK(client->Infer(&result, options, {in0, in1}));
+    const uint8_t* buf;
+    size_t len;
+    CHECK_OK(result->RawData("OUTPUT0", &buf, &len));
+    if (*reinterpret_cast<const int32_t*>(buf) != input0[0] + 2) {
+      fprintf(stderr, "FAILED: wrong result at iteration %d\n", it);
+      exit(1);
+    }
+    delete result;
+    delete in0;
+    delete in1;
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <http_host:port> [iterations]\n", argv[0]);
+    return 2;
+  }
+  const std::string url = argv[1];
+  const int iterations = argc > 2 ? atoi(argv[2]) : 500;
+
+  std::unique_ptr<tc::InferenceServerHttpClient> http_client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(&http_client, url));
+  std::unique_ptr<tc::InferenceServerGrpcClient> grpc_client;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&grpc_client, url));
+
+  // warm up: connection pools, lazily-spawned worker threads, allocator
+  RunIterations(http_client.get(), 50);
+  RunIterations(grpc_client.get(), 50);
+
+  long before_kb = RssKb();
+  RunIterations(http_client.get(), iterations);
+  RunIterations(grpc_client.get(), iterations);
+  long after_kb = RssKb();
+
+  long growth_kb = after_kb - before_kb;
+  printf("rss before=%ldkB after=%ldkB growth=%ldkB over %d iterations\n",
+         before_kb, after_kb, growth_kb, 2 * iterations);
+  // steady-state request loops must not accumulate memory; allow modest
+  // allocator noise
+  if (growth_kb > 8 * 1024) {
+    fprintf(stderr, "FAILED: rss grew %ldkB (> 8MB)\n", growth_kb);
+    return 1;
+  }
+  printf("PASS: memory leak test\n");
+  return 0;
+}

@@ -1,13 +1,18 @@
 #include "transport.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
+
 #include <algorithm>
+#include <cerrno>
 #include <cstdio>
 #include <cstring>
 #include <sstream>
@@ -24,28 +29,94 @@ std::string LowerCopy(const std::string& s) {
   return out;
 }
 
-bool ReadExact(int fd, char* buf, size_t n) {
+// Total-transfer deadline (reference CURLOPT_TIMEOUT_MS semantics: one
+// clock covers connect + send + receive).  DNS resolution is the one step
+// not covered (getaddrinfo has no timeout hook); clients talk to
+// localhost/IPs in practice.
+struct Deadline {
+  bool enabled = false;
+  std::chrono::steady_clock::time_point at{};
+
+  static Deadline In(uint64_t us) {
+    Deadline d;
+    if (us > 0) {
+      d.enabled = true;
+      d.at = std::chrono::steady_clock::now() + std::chrono::microseconds(us);
+    }
+    return d;
+  }
+
+  long long RemainingUs() const {
+    if (!enabled) return -1;
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               at - std::chrono::steady_clock::now())
+        .count();
+  }
+};
+
+void SetSocketTimeout(int fd, int option, long long timeout_us) {
+  struct timeval tv;
+  tv.tv_sec = static_cast<time_t>(timeout_us / 1000000);
+  tv.tv_usec = static_cast<suseconds_t>(timeout_us % 1000000);
+  if (timeout_us > 0 && tv.tv_sec == 0 && tv.tv_usec == 0) tv.tv_usec = 1;
+  ::setsockopt(fd, SOL_SOCKET, option, &tv, sizeof(tv));
+}
+
+// recv against the deadline: >0 bytes, 0 EOF, -1 socket error, -2 expired.
+ssize_t RecvDl(int fd, char* buf, size_t n, const Deadline& dl) {
+  if (dl.enabled) {
+    long long rem = dl.RemainingUs();
+    if (rem <= 0) return -2;
+    SetSocketTimeout(fd, SO_RCVTIMEO, rem);
+  }
+  ssize_t r = ::recv(fd, buf, n, 0);
+  if (r < 0 && dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+    return -2;
+  }
+  return r;
+}
+
+// 0 ok, -1 error/EOF, -2 deadline expired.
+int ReadExactDl(int fd, char* buf, size_t n, const Deadline& dl) {
   size_t got = 0;
   while (got < n) {
-    ssize_t r = ::recv(fd, buf + got, n - got, 0);
-    if (r <= 0) return false;
+    ssize_t r = RecvDl(fd, buf + got, n - got, dl);
+    if (r == -2) return -2;
+    if (r <= 0) return -1;
     got += static_cast<size_t>(r);
   }
-  return true;
+  return 0;
+}
+
+int WriteAllDl(int fd, const char* buf, size_t n, const Deadline& dl) {
+  size_t sent = 0;
+  while (sent < n) {
+    if (dl.enabled) {
+      long long rem = dl.RemainingUs();
+      if (rem <= 0) return -2;
+      SetSocketTimeout(fd, SO_SNDTIMEO, rem);
+    }
+    ssize_t w = ::send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return -2;
+      }
+      return -1;
+    }
+    sent += static_cast<size_t>(w);
+  }
+  return 0;
 }
 
 bool WriteAll(int fd, const char* buf, size_t n) {
-  size_t sent = 0;
-  while (sent < n) {
-    ssize_t w = ::send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
-    if (w <= 0) return false;
-    sent += static_cast<size_t>(w);
-  }
-  return true;
+  return WriteAllDl(fd, buf, n, Deadline()) == 0;
 }
 
-// Resolve + connect + TCP_NODELAY; returns -1 with *err set on failure.
-int ConnectTcp(const std::string& host, int port, Error* err) {
+// Resolve + connect (poll-based so the deadline covers it) + TCP_NODELAY;
+// returns -1 with *err set on failure.
+int ConnectTcp(
+    const std::string& host, int port, Error* err,
+    const Deadline& dl = Deadline()) {
   struct addrinfo hints = {};
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;
@@ -58,16 +129,50 @@ int ConnectTcp(const std::string& host, int port, Error* err) {
     return -1;
   }
   int fd = -1;
+  bool timed_out = false;
   for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
-    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    fd = ::socket(ai->ai_family,
+                  ai->ai_socktype | SOCK_NONBLOCK, ai->ai_protocol);
     if (fd < 0) continue;
-    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    int crc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (crc != 0 && errno == EINPROGRESS) {
+      long long rem = dl.enabled ? dl.RemainingUs() : -1;
+      if (dl.enabled && rem <= 0) {
+        timed_out = true;
+        ::close(fd);
+        fd = -1;
+        break;
+      }
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      int prc = ::poll(&pfd, 1, dl.enabled ? static_cast<int>(rem / 1000 + 1)
+                                           : -1);
+      int so_err = 0;
+      socklen_t len = sizeof(so_err);
+      if (prc > 0 &&
+          ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &so_err, &len) == 0 &&
+          so_err == 0) {
+        crc = 0;
+      } else {
+        if (prc == 0) timed_out = true;
+        crc = -1;
+      }
+    }
+    if (crc == 0) {
+      // restore blocking mode for the request I/O
+      int flags = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+      break;
+    }
     ::close(fd);
     fd = -1;
+    if (timed_out) break;
   }
   ::freeaddrinfo(res);
   if (fd < 0) {
-    *err = Error("failed to connect to " + host + ":" + port_str);
+    *err = Error(
+        timed_out ? "Deadline Exceeded: timed out connecting to " + host +
+                        ":" + port_str
+                  : "failed to connect to " + host + ":" + port_str);
     return -1;
   }
   int one = 1;
@@ -103,18 +208,6 @@ HttpTransport::~HttpTransport() {
   idle_.clear();
 }
 
-int HttpTransport::Connect(Error* err) {
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    if (!idle_.empty()) {
-      int fd = idle_.back();
-      idle_.pop_back();
-      return fd;
-    }
-  }
-  return ConnectTcp(host_, port_, err);
-}
-
 void HttpTransport::Release(int fd, bool reusable) {
   if (!reusable) {
     ::close(fd);
@@ -131,10 +224,21 @@ void HttpTransport::Release(int fd, bool reusable) {
 Error HttpTransport::Request(
     const std::string& method, const std::string& path,
     const std::string& body, const Headers& extra_headers, Response* out,
-    RequestTimers* timers) {
+    RequestTimers* timers, uint64_t timeout_us) {
+  Deadline dl = Deadline::In(timeout_us);
   Error err;
-  int fd = Connect(&err);
-  if (fd < 0) return err;
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!idle_.empty()) {
+      fd = idle_.back();
+      idle_.pop_back();
+    }
+  }
+  if (fd < 0) {
+    fd = ConnectTcp(host_, port_, &err, dl);
+    if (fd < 0) return err;
+  }
 
   std::ostringstream req;
   req << method << " /" << path << " HTTP/1.1\r\n";
@@ -153,12 +257,16 @@ Error HttpTransport::Request(
   std::string head = req.str();
 
   if (timers) timers->CaptureTimestamp(RequestTimers::Kind::SEND_START);
-  bool ok = WriteAll(fd, head.data(), head.size()) &&
-            (body.empty() || WriteAll(fd, body.data(), body.size()));
+  int wrc = WriteAllDl(fd, head.data(), head.size(), dl);
+  if (wrc == 0 && !body.empty()) {
+    wrc = WriteAllDl(fd, body.data(), body.size(), dl);
+  }
   if (timers) timers->CaptureTimestamp(RequestTimers::Kind::SEND_END);
-  if (!ok) {
+  if (wrc != 0) {
     Release(fd, false);
-    return Error("failed to send request to " + host_);
+    return Error(
+        wrc == -2 ? "Deadline Exceeded: timed out sending request to " + host_
+                  : "failed to send request to " + host_);
   }
 
   if (timers) timers->CaptureTimestamp(RequestTimers::Kind::RECV_START);
@@ -167,10 +275,12 @@ Error HttpTransport::Request(
   char chunk[8192];
   size_t header_end = std::string::npos;
   while (header_end == std::string::npos) {
-    ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+    ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
     if (r <= 0) {
       Release(fd, false);
-      return Error("connection closed while reading response headers");
+      return Error(
+          r == -2 ? "Deadline Exceeded: timed out awaiting response"
+                  : "connection closed while reading response headers");
     }
     buf.append(chunk, static_cast<size_t>(r));
     header_end = buf.find("\r\n\r\n");
@@ -213,10 +323,11 @@ Error HttpTransport::Request(
     while (true) {
       size_t nl = stream.find("\r\n", pos);
       while (nl == std::string::npos) {
-        ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+        ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
         if (r <= 0) {
           Release(fd, false);
-          return Error("connection closed mid-chunk");
+          return Error(r == -2 ? "Deadline Exceeded: timed out mid-chunk"
+                               : "connection closed mid-chunk");
         }
         stream.append(chunk, static_cast<size_t>(r));
         nl = stream.find("\r\n", pos);
@@ -225,10 +336,11 @@ Error HttpTransport::Request(
           strtoul(stream.substr(pos, nl - pos).c_str(), nullptr, 16);
       size_t data_start = nl + 2;
       while (stream.size() < data_start + chunk_len + 2) {
-        ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+        ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
         if (r <= 0) {
           Release(fd, false);
-          return Error("connection closed mid-chunk");
+          return Error(r == -2 ? "Deadline Exceeded: timed out mid-chunk"
+                               : "connection closed mid-chunk");
         }
         stream.append(chunk, static_cast<size_t>(r));
       }
@@ -245,9 +357,12 @@ Error HttpTransport::Request(
         size_t missing = want - resp_body.size();
         size_t old = resp_body.size();
         resp_body.resize(want);
-        if (!ReadExact(fd, &resp_body[old], missing)) {
+        int rrc = ReadExactDl(fd, &resp_body[old], missing, dl);
+        if (rrc != 0) {
           Release(fd, false);
-          return Error("connection closed while reading response body");
+          return Error(
+              rrc == -2 ? "Deadline Exceeded: timed out reading response body"
+                        : "connection closed while reading response body");
         }
       } else if (resp_body.size() > want) {
         resp_body.resize(want);
@@ -262,11 +377,13 @@ Error HttpTransport::Request(
       // an orderly FIN (r == 0) terminates the body; a socket error means
       // the response was truncated.
       for (;;) {
-        ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+        ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
         if (r == 0) break;
         if (r < 0) {
           Release(fd, false);
-          return Error("connection error while reading response body");
+          return Error(
+              r == -2 ? "Deadline Exceeded: timed out reading response body"
+                      : "connection error while reading response body");
         }
         resp_body.append(chunk, static_cast<size_t>(r));
       }
@@ -279,6 +396,11 @@ Error HttpTransport::Request(
   if (conn_hdr != resp_headers.end() &&
       LowerCopy(conn_hdr->second) == "close") {
     keep_alive = false;
+  }
+  if (dl.enabled && keep_alive) {
+    // pooled fds must not inherit this request's deadline
+    SetSocketTimeout(fd, SO_RCVTIMEO, 0);
+    SetSocketTimeout(fd, SO_SNDTIMEO, 0);
   }
   Release(fd, keep_alive);
 

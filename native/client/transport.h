@@ -32,13 +32,15 @@ class HttpTransport {
   const std::string& host() const { return host_; }
   int port() const { return port_; }
 
+  // timeout_us > 0 applies a client-side deadline to the socket I/O for
+  // this request (reference CURLOPT_TIMEOUT_MS, http_client.cc:2163-2166);
+  // an expired deadline returns an Error mentioning "Deadline Exceeded".
   Error Request(
       const std::string& method, const std::string& path,
       const std::string& body, const Headers& extra_headers, Response* out,
-      RequestTimers* timers = nullptr);
+      RequestTimers* timers = nullptr, uint64_t timeout_us = 0);
 
  private:
-  int Connect(Error* err);
   void Release(int fd, bool reusable);
 
   std::string host_;

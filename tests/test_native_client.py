@@ -55,6 +55,7 @@ def _run(binary, url, timeout=180):
 @pytest.mark.parametrize("example", [
     "simple_http_infer_client",
     "simple_http_shm_client",
+    "simple_http_cudashm_client",
 ])
 def test_cpp_http_example(native_build, harness, example):
     out = _run(os.path.join(native_build, example),
@@ -74,13 +75,17 @@ def test_cpp_grpc_example(native_build, harness, example):
     assert "PASS" in out
 
 
-def test_cc_client_test(native_build, harness):
-    # takes the url positionally: `cc_client_test <http_host:port>`
+@pytest.mark.parametrize("binary", [
+    "cc_client_test",
+    "client_timeout_test",
+    "memory_leak_test",
+])
+def test_native_test_binary(native_build, harness, binary):
+    # each takes the url positionally: `<binary> <http_host:port>`
     proc = subprocess.run(
-        [os.path.join(native_build, "cc_client_test"),
+        [os.path.join(native_build, binary),
          f"127.0.0.1:{harness.http_port}"],
-        capture_output=True, text=True, timeout=180)
+        capture_output=True, text=True, timeout=240)
     assert proc.returncode == 0, (
-        f"cc_client_test failed\nstdout:\n{proc.stdout}\n"
-        f"stderr:\n{proc.stderr}")
-    assert "FAIL" not in proc.stdout
+        f"{binary} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "FAILED" not in proc.stdout

@@ -64,19 +64,29 @@ def make_simple_identity() -> PyModel:
     return PyModel(cfg, fn)
 
 
-def make_custom_identity_int32() -> JaxModel:
+def make_custom_identity_int32() -> PyModel:
+    """Passthrough with an optional request-controlled execution delay —
+    the reference's client_timeout_test.cc drives every API against
+    custom_identity_int32 with a server-side delay; here the delay comes in
+    as the ``execute_delay_ms`` request parameter."""
     cfg = make_config(
         "custom_identity_int32",
         inputs=[("INPUT0", "INT32", [-1])],
         outputs=[("OUTPUT0", "INT32", [-1])],
         max_batch_size=8,
-        instance_kind="KIND_CPU",
     )
 
-    def fn(INPUT0):
-        return {"OUTPUT0": INPUT0}
+    def fn(inputs, params):
+        delay = params.get("execute_delay_ms", 0)
+        try:
+            delay_s = float(delay) / 1e3
+        except (TypeError, ValueError):
+            delay_s = 0.0
+        if delay_s > 0:
+            _time.sleep(min(delay_s, 30.0))
+        return {"OUTPUT0": inputs["INPUT0"]}
 
-    return JaxModel(cfg, fn)
+    return PyModel(cfg, fn)
 
 
 def make_identity_fp32() -> JaxModel:
