@@ -1,0 +1,58 @@
+"""Wire conformance: a third-party protoc-generated stub vs the live server.
+
+The reference proves its protocol is language-neutral with generated-stub
+clients (src/grpc_generated/go/grpc_simple_client.go:66-201,
+javascript/client.js:42-69).  Go/Node toolchains are not in this image, so
+the conformance client (`examples/grpc_generated_stub_client.py`) runs the
+stock ``protoc`` on our IDL at startup, imports only the freshly generated
+module + grpcio, and talks to the server through generic channel methods —
+exactly what any generated stub compiles down to.  It never imports
+``triton_client_tpu``.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server.registry import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def harness():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    h = ServerHarness(registry)
+    h.start()
+    yield h
+    h.stop()
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None, reason="protoc not installed")
+def test_generated_stub_interop(harness):
+    env = dict(os.environ)
+    # No PYTHONPATH injection: the client must run without the framework.
+    env.pop("PYTHONPATH", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "grpc_generated_stub_client.py"),
+            "-u",
+            harness.grpc_url,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"conformance client failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "PASS: wire conformance" in proc.stdout
