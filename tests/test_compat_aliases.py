@@ -1,0 +1,122 @@
+"""Compatibility namespaces: reference user code runs unchanged.
+
+``tritonclient.*`` is the drop-in surface (reference package name), and the
+four deprecated flat-layout aliases (tritonhttpclient/tritongrpcclient/
+tritonclientutils/tritonshmutils) mirror the reference's own alias-package
+pattern (reference src/python/library/tritonhttpclient/__init__.py etc.).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server.registry import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+
+
+@pytest.fixture(scope="module")
+def harness():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    h = ServerHarness(registry)
+    h.start()
+    yield h
+    h.stop()
+
+
+def test_tritonclient_module_identity():
+    import tritonclient.http
+    import tritonclient.utils
+
+    import triton_client_tpu.http
+    import triton_client_tpu.utils
+
+    assert tritonclient.http is triton_client_tpu.http
+    assert tritonclient.utils is triton_client_tpu.utils
+    assert tritonclient.utils.np_to_triton_dtype(np.int32) == "INT32"
+
+
+def test_tritonclient_deep_submodules():
+    import tritonclient.http.aio
+    import tritonclient.utils.shared_memory
+    import tritonclient.utils.cuda_shared_memory
+    import tritonclient.utils.xla_shared_memory
+
+    import triton_client_tpu.utils.shared_memory
+
+    assert tritonclient.utils.shared_memory is triton_client_tpu.utils.shared_memory
+    assert hasattr(tritonclient.utils.cuda_shared_memory, "create_shared_memory_region")
+
+
+def test_reference_example_code_runs_unchanged(harness):
+    # Verbatim shape of reference simple_http_infer_client.py usage.
+    import tritonclient.http as httpclient
+    from tritonclient.utils import InferenceServerException  # noqa: F401
+
+    with httpclient.InferenceServerClient(url=harness.http_url) as client:
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        inputs[0].set_data_from_numpy(a)
+        inputs[1].set_data_from_numpy(b)
+        result = client.infer("simple", inputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+
+
+def test_tritonclient_grpc_runs(harness):
+    import tritonclient.grpc as grpcclient
+
+    with grpcclient.InferenceServerClient(harness.grpc_url) as client:
+        assert client.is_server_live()
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["tritonhttpclient", "tritongrpcclient", "tritonclientutils", "tritonshmutils"],
+)
+def test_deprecated_aliases_warn_and_export(name):
+    import importlib
+    import sys
+
+    sys.modules.pop(name, None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        mod = importlib.import_module(name)
+    assert any(issubclass(w.category, DeprecationWarning) for w in caught), name
+    if name == "tritonhttpclient":
+        assert hasattr(mod, "InferenceServerClient")
+        assert hasattr(mod, "np_to_triton_dtype")
+    elif name == "tritongrpcclient":
+        assert hasattr(mod, "InferenceServerClient")
+    elif name == "tritonclientutils":
+        assert hasattr(mod, "triton_to_np_dtype")
+    else:
+        import tritonshmutils.shared_memory as s  # noqa: F401
+        import tritonshmutils.xla_shared_memory as x  # noqa: F401
+
+        assert hasattr(mod.cuda_shared_memory, "create_shared_memory_region")
+
+
+def test_tritonclient_imports_in_clean_interpreter():
+    """Run in a fresh interpreter: catches imports masked by pytest's own
+    pre-imported modules (e.g. importlib.util)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import tritonclient.utils as u; import tritonclient.http; "
+         "import numpy as np; assert u.np_to_triton_dtype(np.int8)=='INT8'; "
+         "print('ok')"],
+        capture_output=True, text=True, timeout=60, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout

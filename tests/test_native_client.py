@@ -15,7 +15,10 @@ from triton_client_tpu.server.testing import ServerHarness
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE = os.path.join(REPO, "native", "client")
-BUILD = os.path.join(NATIVE, "build")
+# TRITON_TPU_NATIVE_SANITIZE=thread reruns the whole live-integration tier
+# under TSAN in a separate build tree (CI job native-tsan).
+SANITIZE = os.environ.get("TRITON_TPU_NATIVE_SANITIZE", "")
+BUILD = os.path.join(NATIVE, "build" + (f"-{SANITIZE}" if SANITIZE else ""))
 
 pytestmark = pytest.mark.skipif(
     shutil.which("cmake") is None or shutil.which("ninja") is None,
@@ -25,9 +28,10 @@ pytestmark = pytest.mark.skipif(
 
 @pytest.fixture(scope="module")
 def native_build():
-    subprocess.run(
-        ["cmake", "-S", NATIVE, "-B", BUILD, "-G", "Ninja"],
-        check=True, capture_output=True, text=True)
+    cfg = ["cmake", "-S", NATIVE, "-B", BUILD, "-G", "Ninja"]
+    if SANITIZE:
+        cfg.append(f"-DSANITIZE={SANITIZE}")
+    subprocess.run(cfg, check=True, capture_output=True, text=True)
     subprocess.run(
         ["ninja", "-C", BUILD], check=True, capture_output=True, text=True)
     return BUILD
@@ -89,3 +93,40 @@ def test_native_test_binary(native_build, harness, binary):
     assert proc.returncode == 0, (
         f"{binary} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
     assert "FAILED" not in proc.stdout
+
+
+@pytest.mark.parametrize("lib,allowed", [
+    ("libhttpclient.so", ("tc_tpu::client",)),
+    ("libgrpcclient.so", ("tc_tpu::client", "inference::")),
+])
+def test_shared_library_symbol_hygiene(native_build, lib, allowed):
+    """Version-script parity (reference lib*.ldscript): the shared clients
+    export only the public namespace — no transport/zlib/std internals."""
+    if shutil.which("nm") is None:
+        pytest.skip("nm not available")
+    path = os.path.join(native_build, lib)
+    if not os.path.exists(path):
+        subprocess.run(["ninja", "-C", native_build, lib],
+                       check=True, capture_output=True, text=True)
+    out = subprocess.run(["nm", "-CD", "--defined-only", path],
+                         check=True, capture_output=True, text=True).stdout
+    linker_noise = ("_edata", "_end", "__bss_start")
+    leaked = []
+    exported = 0
+    for line in out.splitlines():
+        parts = line.split(None, 2)
+        if len(parts) < 3:
+            continue
+        sym = parts[2]
+        for prefix in ("typeinfo for ", "typeinfo name for ", "vtable for ",
+                       "VTT for "):
+            if sym.startswith(prefix):
+                sym = sym[len(prefix):]
+                break
+        if sym in linker_noise:
+            continue
+        exported += 1
+        if not any(sym.startswith(ns) for ns in allowed):
+            leaked.append(line)
+    assert exported > 0, f"{lib} exports nothing — version script too strict"
+    assert not leaked, f"{lib} leaks symbols:\n" + "\n".join(leaked[:40])

@@ -1,0 +1,77 @@
+"""Drop-in compatibility namespace: ``tritonclient`` → ``triton_client_tpu``.
+
+Reference users write ``import tritonclient.http as httpclient`` /
+``import tritonclient.grpc`` / ``from tritonclient.utils import *``
+(reference src/python/examples/simple_http_infer_client.py and the whole
+example corpus).  This package lets that code run unchanged against the
+TPU-native framework: a meta-path finder redirects every
+``tritonclient.<sub>`` import to the corresponding
+``triton_client_tpu.<sub>`` module, lazily, so optional transport deps
+(aiohttp, grpcio) are only pulled in when the matching subpackage is
+imported — same behavior as the real layout.
+
+This is the analog of the reference's own alias-package pattern
+(tritonhttpclient/tritongrpcclient/... re-export the new layout with a
+DeprecationWarning); here the alias is not deprecated — it is the
+compatibility surface.
+"""
+
+import importlib
+import importlib.abc
+import importlib.machinery
+import importlib.util
+import sys
+
+_TARGET = "triton_client_tpu"
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, alias, target):
+        self._alias = alias
+        self._target = target
+        self._real_attrs = None
+
+    def create_module(self, spec):
+        mod = importlib.import_module(self._target)
+        # The import machinery will stamp the alias spec onto the module we
+        # return; remember the canonical attributes so exec_module can
+        # restore them (the module must keep identifying as its real name).
+        self._real_attrs = {
+            k: getattr(mod, k, None)
+            for k in ("__spec__", "__loader__", "__package__", "__name__")
+        }
+        # Register under the alias name too, so submodule imports and
+        # pickling see one canonical module object.
+        sys.modules.setdefault(self._alias, mod)
+        return mod
+
+    def exec_module(self, module):
+        # Already executed under its real name — just undo the alias-spec
+        # stamping done by _init_module_attrs.
+        for k, v in (self._real_attrs or {}).items():
+            if v is not None:
+                setattr(module, k, v)
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname == "tritonclient" or not fullname.startswith("tritonclient."):
+            return None
+        real = _TARGET + fullname[len("tritonclient"):]
+        try:
+            real_spec = importlib.util.find_spec(real)
+        except ModuleNotFoundError:
+            return None
+        if real_spec is None:
+            return None
+        spec = importlib.machinery.ModuleSpec(
+            fullname, _AliasLoader(fullname, real), is_package=real_spec.submodule_search_locations is not None
+        )
+        return spec
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.append(_AliasFinder())
+
+# Top-level conveniences the reference exposes on `tritonclient` itself.
+from triton_client_tpu import __version__  # noqa: E402,F401

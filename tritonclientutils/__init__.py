@@ -1,0 +1,13 @@
+"""Deprecated flat-layout alias (reference parity: tritonclientutils/
+re-exports the packaged layout with a DeprecationWarning)."""
+
+import warnings
+
+warnings.warn(
+    "tritonclientutils is deprecated; use tritonclient.utils or "
+    "triton_client_tpu.utils",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from triton_client_tpu.utils import *  # noqa: E402,F401,F403

@@ -1,0 +1,15 @@
+"""Deprecated flat-layout alias (reference parity: tritongrpcclient/
+re-exports the packaged layout with a DeprecationWarning)."""
+
+import warnings
+
+warnings.warn(
+    "tritongrpcclient is deprecated; use tritonclient.grpc or "
+    "triton_client_tpu.grpc",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from triton_client_tpu.grpc import *  # noqa: E402,F401,F403
+from triton_client_tpu.grpc import InferenceServerClient, InferInput, InferRequestedOutput  # noqa: E402,F401
+from triton_client_tpu.utils import *  # noqa: E402,F401,F403

@@ -1,0 +1,15 @@
+"""Deprecated flat-layout alias (reference parity: tritonhttpclient/
+re-exports the packaged layout with a DeprecationWarning)."""
+
+import warnings
+
+warnings.warn(
+    "tritonhttpclient is deprecated; use tritonclient.http or "
+    "triton_client_tpu.http",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from triton_client_tpu.http import *  # noqa: E402,F401,F403
+from triton_client_tpu.http import InferenceServerClient, InferInput, InferRequestedOutput  # noqa: E402,F401
+from triton_client_tpu.utils import *  # noqa: E402,F401,F403

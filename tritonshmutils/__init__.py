@@ -1,0 +1,20 @@
+"""Deprecated flat-layout alias (reference parity: tritonshmutils/ exposes
+shared_memory and cuda_shared_memory subpackages with a DeprecationWarning)."""
+
+import sys
+import warnings
+
+warnings.warn(
+    "tritonshmutils is deprecated; use tritonclient.utils.shared_memory / "
+    "tritonclient.utils.xla_shared_memory",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+import triton_client_tpu.utils.shared_memory as shared_memory  # noqa: E402
+import triton_client_tpu.utils.cuda_shared_memory as cuda_shared_memory  # noqa: E402
+import triton_client_tpu.utils.xla_shared_memory as xla_shared_memory  # noqa: E402
+
+sys.modules[__name__ + ".shared_memory"] = shared_memory
+sys.modules[__name__ + ".cuda_shared_memory"] = cuda_shared_memory
+sys.modules[__name__ + ".xla_shared_memory"] = xla_shared_memory
