@@ -1,0 +1,220 @@
+"""Language-model zoo entries for BASELINE rows 4 and 5.
+
+* ``bert_large`` — the BERT-large shape (24 layers, d_model 1024, 16 heads,
+  d_ff 4096, ~340M params) served through the shared sharded-transformer
+  stack (models/transformer.py) with a SQuAD-style [S,2] span head; dynamic
+  batching per the reference's BERT perf config (BASELINE.md row 4; the
+  reference drives this with perf_analyzer over async streaming gRPC +
+  cudashm — here streaming gRPC + xla shm).
+* ``llama_preprocess`` / ``llama_tpu`` / ``llama_postprocess`` +
+  ``ensemble_llama`` — the Llama-architecture ensemble of BASELINE row 5
+  (reference pattern: ensemble_image_client.py preprocess→model→postprocess,
+  sequence/stream driven).  ``llama_tpu`` size is preset-selectable because
+  the bench host has one v5e chip (Llama-3-8B bf16 weights alone are ~16GB
+  = the whole HBM): ``TRITON_TPU_LLAMA_PRESET`` = ``tiny`` (CPU tests),
+  ``1b`` (real-chip bench default), ``8b`` (full Llama-3-8B shape for
+  multi-chip meshes — the 8-device dryrun path in __graft_entry__).
+
+Tokenization is byte-level (every preset's vocab covers 0..255), so the
+ensemble needs no external tokenizer assets.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from ..server.model import EnsembleModel, JaxModel, PyModel, make_config
+from . import transformer as tr
+
+BERT_LARGE = tr.TransformerConfig(
+    vocab_size=30522, d_model=1024, n_layers=24, n_heads=16,
+    head_dim=64, d_ff=4096, n_experts=0,
+)
+
+# Llama-architecture presets (RMSNorm + RoPE + SiLU FFN — what the shared
+# stack implements). "1b" fits one v5e chip with headroom; "8b" is the
+# real Llama-3-8B shape (tr.LLAMA3_8B) for sharded meshes.
+_LLAMA_PRESETS = {
+    "tiny": tr.TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, head_dim=16,
+        d_ff=128, n_experts=0),
+    "1b": tr.TransformerConfig(
+        vocab_size=128256, d_model=2048, n_layers=16, n_heads=16,
+        head_dim=128, d_ff=8192, n_experts=0),
+    "8b": tr.LLAMA3_8B,
+}
+
+BERT_SEQ_LEN = 384   # classic BERT-large SQuAD serving length
+LLAMA_SEQ_LEN = 128  # fixed context window for the generation ensemble
+
+
+def n_params(cfg: tr.TransformerConfig) -> int:
+    """Parameter count (dense FFN presets)."""
+    per_layer = (
+        4 * cfg.d_model * cfg.n_heads * cfg.head_dim  # wq wk wv wo
+        + 2 * cfg.d_model                              # ln1 ln2
+        + 2 * cfg.d_model * cfg.d_ff                   # w1 w2
+    )
+    embed = cfg.vocab_size * cfg.d_model
+    head = cfg.d_model * cfg.vocab_size
+    return cfg.n_layers * per_layer + embed + head + cfg.d_model
+
+
+def forward_flops_per_token(cfg: tr.TransformerConfig, seq_len: int) -> float:
+    """≈2·params matmul FLOPs per token + attention score/value terms."""
+    matmul = 2.0 * (n_params(cfg) - cfg.vocab_size * cfg.d_model)  # embed lookup is free
+    attn = 4.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len  # QK^T + PV (causal ≈ /2, keep upper bound)
+    return matmul + attn
+
+
+class _LazyTransformer:
+    """Shared lazy init: mesh + params + jitted forward on first call."""
+
+    def __init__(self, cfg: tr.TransformerConfig, seed: int):
+        self.cfg = cfg
+        self._seed = seed
+        self._fwd = None
+        self._params = None
+
+    def __call__(self, tokens):
+        import jax
+
+        if self._fwd is None:
+            device = jax.devices()[0]
+            mesh = tr.make_mesh(devices=[device], cfg=self.cfg)
+            params = tr.init_params(jax.random.PRNGKey(self._seed), self.cfg)
+            self._params = tr.place_params(params, mesh, self.cfg)
+            self._fwd = tr.make_forward(mesh, self.cfg)
+        return self._fwd(self._params, tokens)
+
+
+def make_bert_large() -> JaxModel:
+    """BASELINE row 4 model: INT32 input_ids [384] → FP32 span logits
+    [384,2] (start/end), BERT-large-shaped stack, dynamic batching."""
+    cfg = make_config(
+        "bert_large",
+        inputs=[("INPUT_IDS", "INT32", [BERT_SEQ_LEN])],
+        outputs=[("LOGITS", "FP32", [BERT_SEQ_LEN, 2])],
+        max_batch_size=8,
+        preferred_batch_sizes=[1, 2, 4, 8],
+        max_queue_delay_us=2000,
+        instance_kind="KIND_TPU",
+    )
+    run = _LazyTransformer(BERT_LARGE, seed=24)
+
+    def fn(INPUT_IDS):
+        import jax.numpy as jnp
+
+        tokens = jnp.clip(INPUT_IDS, 0, BERT_LARGE.vocab_size - 1)
+        logits = run(tokens)  # [B, S, vocab]
+        return {"LOGITS": logits[:, :, :2].astype(jnp.float32)}
+
+    return JaxModel(cfg, fn, jit=False)
+
+
+def _llama_cfg() -> tr.TransformerConfig:
+    preset = os.environ.get("TRITON_TPU_LLAMA_PRESET")
+    if preset is None:
+        import jax
+
+        preset = "1b" if jax.default_backend() not in ("cpu",) else "tiny"
+    return _LLAMA_PRESETS[preset]
+
+
+def make_llama_preprocess() -> PyModel:
+    """BYTES TEXT [1] → INT32 TOKENS [128]: byte-level tokens, left-padded
+    with 0 (works for every preset vocab)."""
+    cfg = make_config(
+        "llama_preprocess",
+        inputs=[("TEXT", "BYTES", [1])],
+        outputs=[("TOKENS", "INT32", [LLAMA_SEQ_LEN])],
+        max_batch_size=8,
+    )
+
+    def fn(inputs, params):
+        texts = np.asarray(inputs["TEXT"]).reshape(-1)
+        out = np.zeros((len(texts), LLAMA_SEQ_LEN), np.int32)
+        for i, t in enumerate(texts):
+            raw = t if isinstance(t, (bytes, bytearray)) else str(t).encode()
+            b = np.frombuffer(bytes(raw[-LLAMA_SEQ_LEN:]), np.uint8)
+            out[i, LLAMA_SEQ_LEN - len(b):] = b
+        return {"TOKENS": out.reshape(len(texts), LLAMA_SEQ_LEN)}
+
+    return PyModel(cfg, fn)
+
+
+def make_llama_tpu() -> JaxModel:
+    """Llama-architecture next-token model: INT32 TOKENS [128] →
+    INT32 NEXT_TOKEN [1] (+ FP32 NEXT_LOGIT [1]); greedy head, device-side
+    argmax so only 8 bytes cross D2H per request."""
+    cfg = make_config(
+        "llama_tpu",
+        inputs=[("TOKENS", "INT32", [LLAMA_SEQ_LEN])],
+        outputs=[("NEXT_TOKEN", "INT32", [1]), ("NEXT_LOGIT", "FP32", [1])],
+        max_batch_size=8,
+        preferred_batch_sizes=[1, 2, 4, 8],
+        max_queue_delay_us=2000,
+        instance_kind="KIND_TPU",
+    )
+    state: Dict[str, Any] = {}
+
+    def fn(TOKENS):
+        import jax.numpy as jnp
+
+        if "run" not in state:
+            state["run"] = _LazyTransformer(_llama_cfg(), seed=3)
+        run = state["run"]
+        tokens = jnp.clip(TOKENS, 0, run.cfg.vocab_size - 1)
+        logits = run(tokens)[:, -1, :]  # [B, vocab]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        best = jnp.max(logits, axis=-1).astype(jnp.float32)
+        return {"NEXT_TOKEN": nxt[:, None], "NEXT_LOGIT": best[:, None]}
+
+    return JaxModel(cfg, fn, jit=False)
+
+
+def make_llama_postprocess() -> PyModel:
+    """INT32 NEXT_TOKEN [1] → BYTES OUT_TEXT [1] (byte detokenizer)."""
+    cfg = make_config(
+        "llama_postprocess",
+        inputs=[("NEXT_TOKEN", "INT32", [1])],
+        outputs=[("OUT_TEXT", "BYTES", [1])],
+        max_batch_size=8,
+    )
+
+    def fn(inputs, params):
+        toks = np.asarray(inputs["NEXT_TOKEN"]).reshape(-1)
+        texts = np.array([bytes([int(t) % 256]) for t in toks], dtype=object)
+        return {"OUT_TEXT": texts.reshape(len(toks), 1)}
+
+    return PyModel(cfg, fn)
+
+
+def make_ensemble_llama() -> EnsembleModel:
+    """BASELINE row 5 ensemble: TEXT → preprocess → llama_tpu → postprocess
+    → OUT_TEXT (+ NEXT_TOKEN surfaced for generation loops)."""
+    cfg = make_config(
+        "ensemble_llama",
+        inputs=[("TEXT", "BYTES", [1])],
+        outputs=[("OUT_TEXT", "BYTES", [1]), ("NEXT_TOKEN", "INT32", [1])],
+        max_batch_size=8,
+        platform="ensemble",
+        backend="",
+    )
+    step = cfg.ensemble_scheduling.step.add()
+    step.model_name = "llama_preprocess"
+    step.input_map["TEXT"] = "TEXT"
+    step.output_map["TOKENS"] = "_tokens"
+    step = cfg.ensemble_scheduling.step.add()
+    step.model_name = "llama_tpu"
+    step.input_map["TOKENS"] = "_tokens"
+    step.output_map["NEXT_TOKEN"] = "NEXT_TOKEN"
+    step.output_map["NEXT_LOGIT"] = "_logit"
+    step = cfg.ensemble_scheduling.step.add()
+    step.model_name = "llama_postprocess"
+    step.input_map["NEXT_TOKEN"] = "NEXT_TOKEN"
+    step.output_map["OUT_TEXT"] = "OUT_TEXT"
+    return EnsembleModel(cfg)

@@ -1,0 +1,146 @@
+"""ResNet-50 for the BASELINE image-classification config.
+
+The reference benches `image_client.py` against ResNet-50 ONNX over async
+gRPC (BASELINE.md row 2; reference examples: image_client.py:59-150
+parse_model, densenet/inception fixtures).  Here the network is the real
+ResNet-50 v1.5 architecture (bottleneck [3,4,6,3], 25.6M params) written
+TPU-first in plain JAX:
+
+* NHWC layout internally (TPU conv layout); the wire input stays CHW
+  [3,224,224] for reference config parity and is transposed inside the jit
+  (a free relayout for XLA).
+* bf16 compute on the MXU, fp32 logits out.
+* inference-mode batch norm folded to per-channel scale/bias.
+* dynamic batching (preferred 1/4/8/16/32) so concurrent clients coalesce
+  into one device execute.
+
+Weights are random (the measurement is throughput/latency, not accuracy —
+the reference's perf runs are weight-agnostic too).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..server.model import JaxModel, make_config
+
+# bottleneck stage plan: (blocks, mid_channels); expansion ×4
+_STAGES = ((3, 64), (4, 128), (6, 256), (3, 512))
+_EXPANSION = 4
+
+
+def _init_params(key, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    def conv(key, h, w, cin, cout):
+        fan_in = h * w * cin
+        return (jax.random.normal(key, (h, w, cin, cout), jnp.float32)
+                * jnp.sqrt(2.0 / fan_in)).astype(dtype)
+
+    params: Dict[str, Any] = {}
+    n_keys = 2 + sum(b for b, _ in _STAGES) * 4 + len(_STAGES)
+    keys = iter(jax.random.split(key, n_keys))
+
+    params["stem"] = conv(next(keys), 7, 7, 3, 64)
+    params["stem_scale"] = jnp.ones((64,), dtype)
+    params["stem_bias"] = jnp.zeros((64,), dtype)
+
+    cin = 64
+    for si, (blocks, mid) in enumerate(_STAGES):
+        cout = mid * _EXPANSION
+        for bi in range(blocks):
+            pfx = f"s{si}b{bi}"
+            params[f"{pfx}_c1"] = conv(next(keys), 1, 1, cin, mid)
+            params[f"{pfx}_c2"] = conv(next(keys), 3, 3, mid, mid)
+            params[f"{pfx}_c3"] = conv(next(keys), 1, 1, mid, cout)
+            for j in (1, 2, 3):
+                c = {1: mid, 2: mid, 3: cout}[j]
+                params[f"{pfx}_s{j}"] = jnp.ones((c,), dtype)
+                params[f"{pfx}_b{j}"] = jnp.zeros((c,), dtype)
+            if bi == 0:
+                params[f"{pfx}_proj"] = conv(next(keys), 1, 1, cin, cout)
+                params[f"{pfx}_proj_s"] = jnp.ones((cout,), dtype)
+                params[f"{pfx}_proj_b"] = jnp.zeros((cout,), dtype)
+            cin = cout
+    params["fc"] = (jax.random.normal(next(keys), (cin, 1000), jnp.float32)
+                    * 0.01).astype(dtype)
+    params["fc_bias"] = jnp.zeros((1000,), jnp.float32)
+    return params
+
+
+def _forward(params, x_chw):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dn = ("NHWC", "HWIO", "NHWC")
+
+    def conv(x, w, stride, padding):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(stride, stride), padding=padding,
+            dimension_numbers=dn)
+
+    def bn_relu(x, scale, bias, relu=True):
+        y = x * scale + bias
+        return jax.nn.relu(y) if relu else y
+
+    x = jnp.transpose(x_chw, (0, 2, 3, 1)).astype(params["stem"].dtype)
+
+    # stem: 7x7/2 + 3x3/2 maxpool (v1.5)
+    x = conv(x, params["stem"], 2, [(3, 3), (3, 3)])
+    x = bn_relu(x, params["stem_scale"], params["stem_bias"])
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          [(0, 0), (1, 1), (1, 1), (0, 0)])
+
+    for si, (blocks, _mid) in enumerate(_STAGES):
+        for bi in range(blocks):
+            pfx = f"s{si}b{bi}"
+            # v1.5: the stride lives on the 3x3 conv of the first block
+            stride = 2 if (bi == 0 and si > 0) else 1
+            sc = x
+            if bi == 0:
+                sc = conv(x, params[f"{pfx}_proj"], stride, "VALID")
+                sc = bn_relu(sc, params[f"{pfx}_proj_s"],
+                             params[f"{pfx}_proj_b"], relu=False)
+            y = conv(x, params[f"{pfx}_c1"], 1, "VALID")
+            y = bn_relu(y, params[f"{pfx}_s1"], params[f"{pfx}_b1"])
+            y = conv(y, params[f"{pfx}_c2"], stride, [(1, 1), (1, 1)])
+            y = bn_relu(y, params[f"{pfx}_s2"], params[f"{pfx}_b2"])
+            y = conv(y, params[f"{pfx}_c3"], 1, "VALID")
+            y = bn_relu(y, params[f"{pfx}_s3"], params[f"{pfx}_b3"], relu=False)
+            x = jax.nn.relu(y + sc)
+
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    logits = (jnp.dot(x.astype(jnp.float32), params["fc"].astype(jnp.float32))
+              + params["fc_bias"])
+    return logits
+
+
+def make_resnet50() -> JaxModel:
+    """ResNet-50 zoo model (BASELINE config #2): CHW FP32 [3,224,224] →
+    FP32 [1000] scores, classification labels for image_client
+    ``class_count`` outputs."""
+    labels = [f"class_{i}" for i in range(1000)]
+    cfg = make_config(
+        "resnet50",
+        inputs=[("INPUT", "FP32", [3, 224, 224])],
+        outputs=[("OUTPUT", "FP32", [1000])],
+        max_batch_size=32,
+        preferred_batch_sizes=[1, 4, 8, 16, 32],
+        max_queue_delay_us=2000,
+        instance_kind="KIND_TPU",
+        labels={"OUTPUT": labels},
+    )
+    state: Dict[str, Any] = {}
+
+    def fn(INPUT):
+        import jax
+        import jax.numpy as jnp
+
+        if "run" not in state:  # lazy: no device work until first request
+            params = _init_params(jax.random.PRNGKey(50), jnp.bfloat16)
+            state["run"] = jax.jit(lambda x: {"OUTPUT": _forward(params, x)})
+        return state["run"](INPUT)
+
+    return JaxModel(cfg, fn, jit=False, output_labels={"OUTPUT": labels})

@@ -370,7 +370,15 @@ def make_scale_by_two() -> JaxModel:
 
 
 def register_all(registry: ModelRegistry) -> None:
+    from . import language, vision
+
     registry.register_model(make_simple())
+    registry.register_model(vision.make_resnet50())
+    registry.register_model(language.make_bert_large())
+    registry.register_model(language.make_llama_preprocess())
+    registry.register_model(language.make_llama_tpu())
+    registry.register_model(language.make_llama_postprocess())
+    registry.register_model(language.make_ensemble_llama())
     registry.register_model(make_simple_identity())
     registry.register_model(make_custom_identity_int32())
     registry.register_model(make_identity_fp32())

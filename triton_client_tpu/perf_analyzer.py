@@ -193,26 +193,84 @@ def _serialize(arr: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(arr)
 
 
+def _build_inputs(protocol_mod, arrays, shm_mode):
+    from .utils import np_to_triton_dtype
+
+    infer_inputs = []
+    for name, arr in arrays.items():
+        dt = ("BYTES" if arr.dtype == np.object_
+              else np_to_triton_dtype(arr.dtype))
+        inp = protocol_mod.InferInput(name, list(arr.shape), dt)
+        if shm_mode == "none":
+            inp.set_data_from_numpy(arr)
+        infer_inputs.append(inp)
+    return infer_inputs
+
+
 def _worker(protocol_mod, make_client, model_name, model_version, arrays,
             outputs, shm_mode, output_byte_size, worker_id, stop, measuring,
-            stats: _Stats, lock):
+            stats: _Stats, lock, streaming=False):
+    try:
+        _worker_impl(protocol_mod, make_client, model_name, model_version,
+                     arrays, outputs, shm_mode, output_byte_size, worker_id,
+                     stop, measuring, stats, lock, streaming)
+    except Exception as e:
+        # Setup failures (bad model, shm registration, stream open) must be
+        # visible in the report, not a silently dead worker thread.
+        with lock:
+            stats.errors += 1
+            if stats.first_error is None:
+                stats.first_error = f"worker setup: {type(e).__name__}: {e}"
+
+
+def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
+                 outputs, shm_mode, output_byte_size, worker_id, stop,
+                 measuring, stats: _Stats, lock, streaming=False):
     client = make_client()
     shm_setup = None
+    stream_open = False
     try:
-        infer_inputs = []
-        for name, arr in arrays.items():
-            from .utils import np_to_triton_dtype
-
-            dt = ("BYTES" if arr.dtype == np.object_
-                  else np_to_triton_dtype(arr.dtype))
-            inp = protocol_mod.InferInput(name, list(arr.shape), dt)
-            if shm_mode == "none":
-                inp.set_data_from_numpy(arr)
-            infer_inputs.append(inp)
+        infer_inputs = _build_inputs(protocol_mod, arrays, shm_mode)
         requested = [protocol_mod.InferRequestedOutput(o) for o in outputs]
         shm_setup = _ShmSetup(shm_mode, protocol_mod, client, arrays, outputs,
                               worker_id, output_byte_size)
         shm_setup.attach(infer_inputs, requested)
+
+        if streaming:
+            # Async streaming mode (reference perf_analyzer --streaming):
+            # requests ride one bidi gRPC stream per worker; completion is
+            # the callback on the stream reader thread.
+            import queue as _queue
+
+            done: "_queue.Queue" = _queue.Queue()
+            client.start_stream(callback=lambda result, error: done.put(error))
+            stream_open = True
+            # completions owed from timed-out requests: they must be
+            # discarded when they eventually land, or every later request
+            # would be paired with its predecessor's completion
+            stale = [0]
+
+            def one_infer():
+                client.async_stream_infer(
+                    model_name, infer_inputs, outputs=requested,
+                    model_version=model_version)
+                try:
+                    while True:
+                        err = done.get(timeout=120)
+                        if stale[0] > 0:
+                            stale[0] -= 1
+                            continue
+                        if err is not None:
+                            raise err
+                        return
+                except _queue.Empty:
+                    stale[0] += 1
+                    raise TimeoutError("stream completion timed out")
+        else:
+            def one_infer():
+                client.infer(model_name, infer_inputs, outputs=requested,
+                             model_version=model_version)
+
         local: List[float] = []
         n = 0
         errs = 0
@@ -221,8 +279,7 @@ def _worker(protocol_mod, make_client, model_name, model_version, arrays,
             t0 = time.perf_counter()
             err = None
             try:
-                client.infer(model_name, infer_inputs, outputs=requested,
-                             model_version=model_version)
+                one_infer()
             except Exception as e:
                 err = e
             dt_s = time.perf_counter() - t0
@@ -243,6 +300,11 @@ def _worker(protocol_mod, make_client, model_name, model_version, arrays,
             if stats.first_error is None and first_error is not None:
                 stats.first_error = first_error
     finally:
+        if stream_open:
+            try:
+                client.stop_stream()
+            except Exception:
+                pass
         if shm_setup is not None:
             shm_setup.cleanup()
         try:
@@ -253,7 +315,7 @@ def _worker(protocol_mod, make_client, model_name, model_version, arrays,
 
 def run_level(protocol, url, model_name, model_version, concurrency, arrays,
               outputs, shm_mode, output_byte_size, measure_s, warmup_s=1.0,
-              extra_percentile=None):
+              extra_percentile=None, streaming=False):
     if protocol == "grpc":
         import triton_client_tpu.grpc as protocol_mod
 
@@ -273,7 +335,7 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
             target=_worker,
             args=(protocol_mod, make_client, model_name, model_version, arrays,
                   outputs, shm_mode, output_byte_size, w, stop, measuring,
-                  stats, lock),
+                  stats, lock, streaming),
             daemon=True,
         )
         for w in range(concurrency)
@@ -327,11 +389,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--shape", action="append", default=[],
                         help="name:d1,d2,... override for dynamic dims")
     parser.add_argument("--string-length", type=int, default=16)
+    parser.add_argument("--streaming", action="store_true",
+                        help="drive infers over the bidi gRPC stream "
+                             "(gRPC only; reference perf_analyzer flag)")
     parser.add_argument("--percentile", type=int, default=None,
                         help="report this percentile as the headline latency")
     parser.add_argument("-f", "--latency-report-file", default=None)
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
+    if args.streaming and args.protocol != "grpc":
+        parser.error("--streaming requires -i grpc")
 
     url = args.url or ("localhost:8001" if args.protocol == "grpc" else "localhost:8000")
     if args.protocol == "grpc":
@@ -370,7 +437,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         res = run_level(
             args.protocol, url, args.model_name, args.model_version, level,
             arrays, outputs, args.shared_memory, args.output_shared_memory_size,
-            measure_s, extra_percentile=args.percentile)
+            measure_s, extra_percentile=args.percentile,
+            streaming=args.streaming)
         results.append(res)
         headline = (res[f"p{args.percentile}_us"]
                     if args.percentile is not None else res["avg_us"])

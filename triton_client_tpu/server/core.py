@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+from typing import Any, AsyncIterator, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -144,11 +144,11 @@ class _DynamicBatcher:
                 merged[n] = arr
             queue_ns = time.monotonic_ns() - pending[0][3]
             t0 = time.monotonic_ns()
-            # resolve_host: D2H happens on the executor thread, not the event
-            # loop — a blocking np.asarray here would stall every other
-            # request for the full device round trip.
+            # keep_device=set(): every output resolves D2H on the executor
+            # thread, not the event loop — a blocking np.asarray here would
+            # stall every other request for the full device round trip.
             outputs = await self._core._run_model(
-                self._model, merged, pending[0][1], resolve_host=True)
+                self._model, merged, pending[0][1], keep_device=set())
             compute_ns = time.monotonic_ns() - t0
             self._model.stats.record(total, queue_ns, compute_ns, ok=True)
             offset = 0
@@ -241,14 +241,20 @@ class InferenceCore:
         elif self._use_batcher(model, request):
             outputs = await self._batcher(model).submit(inputs, params)
         else:
-            # Keep outputs device-resident only when an xla-shm output wants
-            # them (zero-copy); otherwise resolve D2H off the event loop.
-            resolve_host = not any(o.shm is not None for o in request.outputs)
+            # Outputs bound to slot-backed (in-process) xla-shm regions stay
+            # device-resident — zero-copy handoff into the region.  Staging
+            # (cross-process) regions and wire outputs resolve D2H on the
+            # worker so _build_response never touches the device.
+            keep_device = {
+                o.name for o in request.outputs
+                if o.shm is not None
+                and self.xla_shm.is_slot_backed(o.shm.region_name)
+            }
             t0 = time.monotonic_ns()
             queue_ns = t0 - request.arrival_ns
             try:
                 outputs = await self._run_model(
-                    model, inputs, params, resolve_host=resolve_host)
+                    model, inputs, params, keep_device=keep_device)
             except InferError:
                 model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
                 raise
@@ -346,25 +352,30 @@ class InferenceCore:
         return b
 
     async def _run_model(
-        self, model: Model, inputs, params, resolve_host: bool = False
+        self, model: Model, inputs, params,
+        keep_device: Optional[Set[str]] = None,
     ) -> Dict[str, Any]:
         """Execute on a thread-pool worker so the event loop keeps serving.
 
-        With ``resolve_host`` the device→host transfer also happens on the
-        worker (``copy_to_host_async`` prefetches every output so transfers
-        overlap, then the blocking reads drain already-inflight copies).
-        Without it outputs may stay device-resident — the zero-copy path for
-        xla-shm outputs."""
+        ``keep_device`` names the outputs left device-resident (the zero-copy
+        path for xla-shm-bound outputs; ``None`` keeps everything on device —
+        ensemble intermediates).  All other outputs resolve D2H on the worker
+        thread: ``copy_to_host_async`` prefetches every transfer so they
+        overlap, then the blocking reads drain already-inflight copies.
+        Nothing here may block the event loop on a device sync — on a
+        tunneled chip one blocking read is a full RTT that would serialize
+        every concurrent request behind it."""
         loop = asyncio.get_running_loop()
 
         def _exec():
             outputs = model.execute(inputs, params)
-            if resolve_host:
-                for v in outputs.values():
-                    if hasattr(v, "copy_to_host_async"):
-                        v.copy_to_host_async()
-                outputs = {n: np.asarray(v) for n, v in outputs.items()}
-            return outputs
+            if keep_device is None:
+                return outputs
+            for n, v in outputs.items():
+                if n not in keep_device and hasattr(v, "copy_to_host_async"):
+                    v.copy_to_host_async()
+            return {n: (v if n in keep_device else np.asarray(v))
+                    for n, v in outputs.items()}
 
         return await loop.run_in_executor(None, _exec)
 
@@ -525,17 +536,24 @@ class InferenceCore:
                 value = self._classify(model, name, host, spec.class_count)
             out_shm = spec.shm if spec is not None else None
             if out_shm is not None:
+                # The frontend emits only shm params for these outputs — no
+                # wire data, so never materialize host bytes here (for a
+                # device-resident value that would be a blocking D2H on the
+                # event loop, serializing every concurrent request).
                 if out_shm.region_name in self.xla_shm.status(None):
                     self.xla_shm.write(out_shm, value)
                 else:
                     self.system_shm.write(out_shm, np.asarray(value))
-                host = np.asarray(value)
+                dt = getattr(value, "dtype", None)
+                if dt is None:
+                    value = np.asarray(value)
+                    dt = value.dtype
                 resp.outputs.append(
                     OutputTensor(
                         name=name,
-                        datatype=np_to_triton_dtype(host.dtype),
-                        shape=tuple(host.shape),
-                        data=host,
+                        datatype=np_to_triton_dtype(np.dtype(dt)),
+                        shape=tuple(value.shape),
+                        data=None,
                         shm=out_shm,
                     )
                 )

@@ -177,6 +177,13 @@ class XlaShmRegistry:
                 if not name or n == name
             }
 
+    def is_slot_backed(self, name: str) -> bool:
+        """True for in-process (broker-slot) regions — the zero-copy device
+        handoff path.  Staging-backed regions need a host copy on write."""
+        with self._lock:
+            region = self._regions.get(name)
+        return region is not None and region.slot is not None
+
     def _get(self, ref: ShmRef) -> XlaShmRegion:
         with self._lock:
             region = self._regions.get(ref.region_name)

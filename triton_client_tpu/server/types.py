@@ -70,9 +70,10 @@ class OutputTensor:
     name: str
     datatype: str
     shape: Tuple[int, ...]
-    data: np.ndarray  # always host ndarray at the frontend boundary
-    # When the client asked for this output in shm, the core wrote it there and
-    # the frontend must emit only shm params, no data:
+    # Host ndarray at the frontend boundary; None when the output was
+    # delivered through a shared-memory region (the core wrote it there and
+    # the frontend must emit only shm params, no data):
+    data: Optional[np.ndarray]
     shm: Optional[ShmRef] = None
     parameters: Dict[str, Any] = field(default_factory=dict)
 
