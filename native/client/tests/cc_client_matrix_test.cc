@@ -1,0 +1,467 @@
+// Option/broadcast/data-path matrix against the live harness — the second
+// half of the reference's cc_client_test.cc coverage (option and output
+// broadcasting for InferMulti, model load with config override, compression
+// round trips, decoupled streams, shm data paths, stat accounting;
+// reference cc_client_test.cc:300-1350).  Usage: cc_client_matrix_test
+// <http_host:port> (gRPC-web rides the same port through the bridge).
+
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+#include "xla_shm_utils.h"
+
+namespace tc = tc_tpu::client;
+
+namespace {
+
+#define CHECK_OK(expr)                                                   \
+  do {                                                                   \
+    const tc::Error err__ = (expr);                                      \
+    if (!err__.IsOk()) {                                                 \
+      fprintf(stderr, "FAILED %s:%d: %s -> %s\n", __FILE__, __LINE__,    \
+              #expr, err__.Message().c_str());                           \
+      exit(1);                                                           \
+    }                                                                    \
+  } while (false)
+
+#define CHECK_TRUE(expr)                                                 \
+  do {                                                                   \
+    if (!(expr)) {                                                       \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__, #expr);  \
+      exit(1);                                                           \
+    }                                                                    \
+  } while (false)
+
+#define CHECK_ERR(expr)                                                  \
+  do {                                                                   \
+    const tc::Error err__ = (expr);                                      \
+    if (err__.IsOk()) {                                                  \
+      fprintf(stderr, "FAILED %s:%d: expected error from %s\n",          \
+              __FILE__, __LINE__, #expr);                                \
+      exit(1);                                                           \
+    }                                                                    \
+  } while (false)
+
+std::vector<int32_t> Iota16() {
+  std::vector<int32_t> v(16);
+  for (int i = 0; i < 16; ++i) v[i] = i;
+  return v;
+}
+
+void MakeSimpleInputs(
+    const std::vector<int32_t>& in0, const std::vector<int32_t>& in1,
+    std::vector<tc::InferInput*>* inputs) {
+  tc::InferInput *i0, *i1;
+  CHECK_OK(tc::InferInput::Create(&i0, "INPUT0", {1, 16}, "INT32"));
+  CHECK_OK(tc::InferInput::Create(&i1, "INPUT1", {1, 16}, "INT32"));
+  CHECK_OK(i0->AppendRaw(reinterpret_cast<const uint8_t*>(in0.data()),
+                         in0.size() * sizeof(int32_t)));
+  CHECK_OK(i1->AppendRaw(reinterpret_cast<const uint8_t*>(in1.data()),
+                         in1.size() * sizeof(int32_t)));
+  inputs->assign({i0, i1});
+}
+
+void CheckSum(tc::InferResult* r, const std::vector<int32_t>& in0,
+              const std::vector<int32_t>& in1) {
+  const uint8_t* buf;
+  size_t len;
+  CHECK_OK(r->RawData("OUTPUT0", &buf, &len));
+  CHECK_TRUE(len == 16 * sizeof(int32_t));
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK_TRUE(sums[i] == in0[i] + in1[i]);
+}
+
+// -- compression round trips (reference http_client.cc CompressInput) -----
+void TestHttpCompression(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(&client, url));
+  auto in0 = Iota16();
+  std::vector<int32_t> in1(16, 2);
+  std::vector<tc::InferInput*> inputs;
+  MakeSimpleInputs(in0, in1, &inputs);
+  tc::InferOptions options("simple");
+  using CT = tc::InferenceServerHttpClient::CompressionType;
+  for (CT req : {CT::NONE, CT::DEFLATE, CT::GZIP}) {
+    for (CT resp : {CT::NONE, CT::DEFLATE, CT::GZIP}) {
+      tc::InferResult* result;
+      CHECK_OK(client->Infer(&result, options, inputs, {}, {}, req, resp));
+      CheckSum(result, in0, in1);
+      delete result;
+    }
+  }
+  for (auto* i : inputs) delete i;
+  printf("PASS: http compression matrix\n");
+}
+
+// -- object reuse (reference reuse_infer_objects_client) ------------------
+void TestReuseInferObjects(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerHttpClient> hc;
+  std::unique_ptr<tc::InferenceServerGrpcClient> gc;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(&hc, url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&gc, url));
+  auto in0 = Iota16();
+  std::vector<int32_t> in1(16, 5);
+  std::vector<tc::InferInput*> inputs;
+  MakeSimpleInputs(in0, in1, &inputs);
+  tc::InferOptions options("simple");
+  options.request_id_ = "reused";
+  for (int round = 0; round < 3; ++round) {
+    tc::InferResult* r;
+    CHECK_OK(hc->Infer(&r, options, inputs));
+    CheckSum(r, in0, in1);
+    std::string id;
+    CHECK_OK(r->Id(&id));
+    CHECK_TRUE(id == "reused");
+    delete r;
+    CHECK_OK(gc->Infer(&r, options, inputs));
+    CheckSum(r, in0, in1);
+    delete r;
+    // rebind fresh data through the same InferInput objects
+    CHECK_OK(inputs[0]->Reset());
+    for (auto& v : in0) v += 1;
+    CHECK_OK(inputs[0]->AppendRaw(
+        reinterpret_cast<const uint8_t*>(in0.data()),
+        in0.size() * sizeof(int32_t)));
+  }
+  for (auto* i : inputs) delete i;
+  printf("PASS: infer object reuse\n");
+}
+
+// -- model control with config override (reference cc_client_test:1202) ---
+void TestModelControl(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+  bool ready = false;
+  CHECK_OK(client->IsModelReady(&ready, "identity_fp32"));
+  CHECK_TRUE(ready);
+  CHECK_OK(client->UnloadModel("identity_fp32"));
+  CHECK_OK(client->IsModelReady(&ready, "identity_fp32"));
+  CHECK_TRUE(!ready);
+  CHECK_OK(client->LoadModel("identity_fp32"));
+  CHECK_OK(client->IsModelReady(&ready, "identity_fp32"));
+  CHECK_TRUE(ready);
+  // load with a config override and verify the served config changed
+  const char* cfg =
+      "{\"name\": \"identity_fp32\", \"max_batch_size\": 4, \"backend\": "
+      "\"jax\"}";
+  CHECK_OK(client->LoadModel("identity_fp32", tc::Headers(), cfg));
+  tc::pb::ModelConfigResponse mc;
+  CHECK_OK(client->ModelConfig(&mc, "identity_fp32"));
+  CHECK_TRUE(mc.config().max_batch_size() == 4);
+  // restore the original registration for other tests
+  CHECK_OK(client->LoadModel("identity_fp32"));
+  CHECK_ERR(client->LoadModel("no_such_model_anywhere"));
+  printf("PASS: model control with config override\n");
+}
+
+// -- BYTES strings through system shm (reference shm string client) -------
+void TestStringShm(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(&client, url));
+  std::string payload;
+  tc::SerializeStringTensor({"ab", "", "xyz"}, &payload);
+  const char* key = "/cc_matrix_str_shm";
+  shm_unlink(key);
+  int fd = shm_open(key, O_RDWR | O_CREAT, 0600);
+  CHECK_TRUE(fd >= 0);
+  CHECK_TRUE(ftruncate(fd, payload.size()) == 0);
+  void* base = mmap(nullptr, payload.size(), PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  CHECK_TRUE(base != MAP_FAILED);
+  memcpy(base, payload.data(), payload.size());
+  CHECK_OK(client->RegisterSystemSharedMemory("str_region", key,
+                                              payload.size()));
+  tc::InferInput* input;
+  CHECK_OK(tc::InferInput::Create(&input, "INPUT0", {1, 3}, "BYTES"));
+  CHECK_OK(input->SetSharedMemory("str_region", payload.size()));
+  tc::InferOptions options("simple_identity");
+  tc::InferResult* result;
+  CHECK_OK(client->Infer(&result, options, {input}));
+  std::vector<std::string> strings;
+  CHECK_OK(result->StringData("OUTPUT0", &strings));
+  CHECK_TRUE(strings.size() == 3);
+  CHECK_TRUE(strings[0] == "ab" && strings[1] == "" && strings[2] == "xyz");
+  delete result;
+  delete input;
+  CHECK_OK(client->UnregisterSystemSharedMemory("str_region"));
+  munmap(base, payload.size());
+  close(fd);
+  shm_unlink(key);
+  printf("PASS: BYTES via system shm\n");
+}
+
+// -- xla-shm offset/status matrix (reference cudashm tests) ---------------
+void TestXlaShmMatrix(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+  const size_t bytes = 64 * sizeof(float);
+  tc::XlaShmHandle in_h, out_h;
+  CHECK_OK(tc::CreateXlaSharedMemoryRegion(&in_h, "mx_in", bytes, 0));
+  CHECK_OK(tc::CreateXlaSharedMemoryRegion(&out_h, "mx_out", bytes, 0));
+  std::vector<uint8_t> raw;
+  CHECK_OK(tc::GetXlaSharedMemoryRawHandle(in_h, &raw));
+  CHECK_OK(client->RegisterCudaSharedMemory("mx_in", raw, 0, bytes));
+  CHECK_OK(tc::GetXlaSharedMemoryRawHandle(out_h, &raw));
+  CHECK_OK(client->RegisterCudaSharedMemory("mx_out", raw, 0, bytes));
+
+  // registering the same name again must fail
+  CHECK_ERR(client->RegisterCudaSharedMemory("mx_in", raw, 0, bytes));
+
+  // offset write: fill halves with two writes, then infer on the region
+  std::vector<float> lo(32, 1.5f), hi(32, -2.5f);
+  CHECK_OK(tc::SetXlaSharedMemoryRegion(in_h, lo.data(), bytes / 2, 0));
+  CHECK_OK(tc::SetXlaSharedMemoryRegion(in_h, hi.data(), bytes / 2,
+                                        bytes / 2));
+  tc::InferInput* input;
+  CHECK_OK(tc::InferInput::Create(&input, "INPUT0", {1, 64}, "FP32"));
+  CHECK_OK(input->SetSharedMemory("mx_in", bytes));
+  tc::InferRequestedOutput* out;
+  CHECK_OK(tc::InferRequestedOutput::Create(&out, "OUTPUT0"));
+  CHECK_OK(out->SetSharedMemory("mx_out", bytes));
+  tc::InferOptions options("identity_fp32");
+  tc::InferResult* result;
+  CHECK_OK(client->Infer(&result, options, {input}, {out}));
+  delete result;
+  std::vector<float> got(64);
+  CHECK_OK(tc::GetXlaSharedMemoryContents(out_h, got.data(), bytes));
+  for (int i = 0; i < 32; ++i) CHECK_TRUE(got[i] == 1.5f);
+  for (int i = 32; i < 64; ++i) CHECK_TRUE(got[i] == -2.5f);
+
+  // status lists both regions; unregister-one removes exactly one
+  tc::pb::CudaSharedMemoryStatusResponse status;
+  CHECK_OK(client->CudaSharedMemoryStatus(&status));
+  CHECK_TRUE(status.regions().count("mx_in") == 1);
+  CHECK_TRUE(status.regions().count("mx_out") == 1);
+  CHECK_TRUE(status.regions().at("mx_in").byte_size() == bytes);
+  CHECK_OK(client->UnregisterCudaSharedMemory("mx_in"));
+  CHECK_OK(client->CudaSharedMemoryStatus(&status));
+  CHECK_TRUE(status.regions().count("mx_in") == 0);
+  CHECK_TRUE(status.regions().count("mx_out") == 1);
+  CHECK_OK(client->UnregisterCudaSharedMemory("mx_out"));
+
+  // inferring against an unregistered region must fail
+  tc::InferResult* bad = nullptr;
+  CHECK_ERR(client->Infer(&bad, options, {input}, {out}));
+
+  delete input;
+  delete out;
+  CHECK_OK(tc::DestroyXlaSharedMemoryRegion(&in_h));
+  CHECK_OK(tc::DestroyXlaSharedMemoryRegion(&out_h));
+  printf("PASS: xla shm offset/status matrix\n");
+}
+
+// -- decoupled stream: N responses per request (reference repeat) ---------
+void TestDecoupledRepeat(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> outs;
+  std::vector<uint32_t> idxs;
+  int finals = 0;
+  CHECK_OK(client->StartStream([&](tc::InferResult* r) {
+    std::lock_guard<std::mutex> lk(mu);
+    bool is_final = false, is_null = false;
+    r->IsFinalResponse(&is_final);
+    r->IsNullResponse(&is_null);
+    if (is_final) ++finals;
+    const uint8_t* buf;
+    size_t len;
+    if (!is_null && r->RequestStatus().IsOk() &&
+        r->RawData("OUT", &buf, &len).IsOk() && len >= 4) {
+      int32_t v;
+      memcpy(&v, buf, 4);
+      outs.push_back(v);
+      if (r->RawData("IDX", &buf, &len).IsOk() && len >= 4) {
+        uint32_t ix;
+        memcpy(&ix, buf, 4);
+        idxs.push_back(ix);
+      }
+    }
+    cv.notify_all();
+    delete r;
+  }));
+  std::vector<int32_t> values{4, 7, 9};
+  std::vector<uint32_t> delays{1000, 1000, 1000};
+  uint32_t wait = 0;
+  tc::InferInput *vin, *din, *win;
+  CHECK_OK(tc::InferInput::Create(&vin, "IN", {3}, "INT32"));
+  CHECK_OK(vin->AppendRaw(reinterpret_cast<const uint8_t*>(values.data()),
+                          values.size() * sizeof(int32_t)));
+  CHECK_OK(tc::InferInput::Create(&din, "DELAY", {3}, "UINT32"));
+  CHECK_OK(din->AppendRaw(reinterpret_cast<const uint8_t*>(delays.data()),
+                          delays.size() * sizeof(uint32_t)));
+  CHECK_OK(tc::InferInput::Create(&win, "WAIT", {1}, "UINT32"));
+  CHECK_OK(win->AppendRaw(reinterpret_cast<const uint8_t*>(&wait),
+                          sizeof(uint32_t)));
+  tc::InferOptions options("repeat_int32");
+  options.triton_enable_empty_final_response_ = true;
+  CHECK_OK(client->AsyncStreamInfer(options, {vin, din, win}));
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    CHECK_TRUE(cv.wait_for(lk, std::chrono::seconds(60), [&] {
+      return outs.size() == 3 && finals >= 1;
+    }));
+  }
+  CHECK_OK(client->FinishStream());
+  CHECK_TRUE(outs[0] == 4 && outs[1] == 7 && outs[2] == 9);
+  CHECK_TRUE(idxs.size() == 3 && idxs[0] == 0 && idxs[2] == 2);
+  delete vin;
+  delete din;
+  delete win;
+  printf("PASS: decoupled repeat stream (finals=%d)\n", finals);
+}
+
+// -- InferMulti output/option broadcast arity matrix ----------------------
+void TestMultiBroadcast(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(&client, url));
+  auto in0 = Iota16();
+  std::vector<int32_t> in1(16, 3);
+  std::vector<tc::InferInput*> inputs;
+  MakeSimpleInputs(in0, in1, &inputs);
+  tc::InferRequestedOutput *o0, *o1;
+  CHECK_OK(tc::InferRequestedOutput::Create(&o0, "OUTPUT0"));
+  CHECK_OK(tc::InferRequestedOutput::Create(&o1, "OUTPUT1"));
+  std::vector<std::vector<tc::InferInput*>> multi_inputs(4, inputs);
+  tc::InferOptions options("simple");
+
+  // one options + one outputs-set broadcast across all four requests
+  {
+    std::vector<tc::InferResult*> results;
+    CHECK_OK(client->InferMulti(&results, {options}, multi_inputs, {{o0, o1}}));
+    CHECK_TRUE(results.size() == 4);
+    for (auto* r : results) {
+      CheckSum(r, in0, in1);
+      delete r;
+    }
+  }
+  // per-request options vector of matching arity
+  {
+    std::vector<tc::InferOptions> opts(4, options);
+    for (size_t i = 0; i < opts.size(); ++i)
+      opts[i].request_id_ = "multi-" + std::to_string(i);
+    std::vector<tc::InferResult*> results;
+    CHECK_OK(client->InferMulti(&results, opts, multi_inputs));
+    CHECK_TRUE(results.size() == 4);
+    for (size_t i = 0; i < results.size(); ++i) {
+      std::string id;
+      CHECK_OK(results[i]->Id(&id));
+      CHECK_TRUE(id == "multi-" + std::to_string(i));
+      delete results[i];
+    }
+  }
+  // arity mismatches must be rejected: 2 options / 3 outputs for 4 requests
+  {
+    std::vector<tc::InferResult*> results;
+    CHECK_ERR(client->InferMulti(&results, {options, options}, multi_inputs));
+    CHECK_ERR(client->InferMulti(&results, {options}, multi_inputs,
+                                 {{o0}, {o1}, {o0, o1}}));
+    std::vector<std::vector<tc::InferInput*>> empty_inputs;
+    CHECK_ERR(client->InferMulti(&results, {options}, empty_inputs));
+  }
+  for (auto* i : inputs) delete i;
+  delete o0;
+  delete o1;
+  printf("PASS: InferMulti broadcast arity matrix\n");
+}
+
+// -- sequence over HTTP unary (reference sequence_sync clients) -----------
+void TestSequenceHttpSync(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(&client, url));
+  std::vector<int32_t> acc;
+  std::vector<int32_t> values{2, 4, 6};
+  for (size_t i = 0; i < values.size(); ++i) {
+    tc::InferInput* in;
+    CHECK_OK(tc::InferInput::Create(&in, "INPUT", {1}, "INT32"));
+    CHECK_OK(in->AppendRaw(reinterpret_cast<const uint8_t*>(&values[i]),
+                           sizeof(int32_t)));
+    tc::InferOptions options("simple_sequence");
+    options.sequence_id_ = 4242;
+    options.sequence_start_ = (i == 0);
+    options.sequence_end_ = (i == values.size() - 1);
+    tc::InferResult* r;
+    CHECK_OK(client->Infer(&r, options, {in}));
+    const uint8_t* buf;
+    size_t len;
+    CHECK_OK(r->RawData("OUTPUT", &buf, &len));
+    int32_t v;
+    memcpy(&v, buf, 4);
+    acc.push_back(v);
+    delete r;
+    delete in;
+  }
+  CHECK_TRUE(acc[0] == 2 && acc[1] == 6 && acc[2] == 12);
+  // a sequence request without a correlation id must be rejected
+  tc::InferInput* in;
+  int32_t one = 1;
+  CHECK_OK(tc::InferInput::Create(&in, "INPUT", {1}, "INT32"));
+  CHECK_OK(in->AppendRaw(reinterpret_cast<const uint8_t*>(&one), 4));
+  tc::InferOptions bad("simple_sequence");
+  tc::InferResult* r = nullptr;
+  CHECK_ERR(client->Infer(&r, bad, {in}));
+  delete in;
+  printf("PASS: sequence over http unary\n");
+}
+
+// -- client stat accounting (reference InferStat/UpdateInferStat) ---------
+void TestInferStatAccounting(const std::string& url) {
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+  tc::InferStat before, after;
+  CHECK_OK(client->ClientInferStat(&before));
+  auto in0 = Iota16();
+  std::vector<int32_t> in1(16, 1);
+  std::vector<tc::InferInput*> inputs;
+  MakeSimpleInputs(in0, in1, &inputs);
+  tc::InferOptions options("simple");
+  const int kN = 5;
+  for (int i = 0; i < kN; ++i) {
+    tc::InferResult* r;
+    CHECK_OK(client->Infer(&r, options, inputs));
+    delete r;
+  }
+  CHECK_OK(client->ClientInferStat(&after));
+  CHECK_TRUE(after.completed_request_count ==
+             before.completed_request_count + kN);
+  CHECK_TRUE(after.cumulative_total_request_time_ns >
+             before.cumulative_total_request_time_ns);
+  CHECK_TRUE(after.cumulative_send_time_ns >= before.cumulative_send_time_ns);
+  for (auto* i : inputs) delete i;
+  printf("PASS: client InferStat accounting\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <http_host:port>\n", argv[0]);
+    return 2;
+  }
+  const std::string url = argv[1];
+  TestHttpCompression(url);
+  TestReuseInferObjects(url);
+  TestModelControl(url);
+  TestStringShm(url);
+  TestXlaShmMatrix(url);
+  TestDecoupledRepeat(url);
+  TestMultiBroadcast(url);
+  TestSequenceHttpSync(url);
+  TestInferStatAccounting(url);
+  printf("PASS: all\n");
+  return 0;
+}

@@ -107,6 +107,103 @@ void TestAsyncTimeout(ClientT* client) {
   delete input;
 }
 
+template <typename ClientT>
+void TestGenerousDeadlineSucceeds(ClientT* client) {
+  // A deadline comfortably above the delay must NOT fire (guards against a
+  // deadline clock that starts too early or double-counts pooling time).
+  tc::InferInput* input = MakeInput(3);
+  tc::InferOptions options("custom_identity_int32");
+  options.client_timeout_us_ = 30 * 1000 * 1000;  // 30s
+  options.request_parameters_["execute_delay_ms"] = "100";
+  tc::InferResult* result = nullptr;
+  CHECK_OK(client->Infer(&result, options, {input}));
+  const uint8_t* buf;
+  size_t len;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &len));
+  CHECK_TRUE(*reinterpret_cast<const int32_t*>(buf) == 3);
+  delete result;
+  delete input;
+}
+
+template <typename ClientT>
+void TestPoolShedsDeadline(ClientT* client) {
+  // After a deadline fires, the SAME client must serve a normal request:
+  // a pooled socket must not inherit the expired deadline (regression for
+  // stale SO_RCVTIMEO on reused connections).
+  for (int round = 0; round < 3; ++round) {
+    tc::InferInput* input = MakeInput(11);
+    tc::InferResult* result = nullptr;
+    tc::Error err =
+        client->Infer(&result, DelayedOptions(kShortTimeoutUs), {input});
+    CHECK_TRUE(IsDeadlineExceeded(err));
+    tc::InferOptions ok_options("custom_identity_int32");
+    CHECK_OK(client->Infer(&result, ok_options, {input}));
+    const uint8_t* buf;
+    size_t len;
+    CHECK_OK(result->RawData("OUTPUT0", &buf, &len));
+    CHECK_TRUE(*reinterpret_cast<const int32_t*>(buf) == 11);
+    delete result;
+    delete input;
+  }
+}
+
+void TestHttpMultiTimeout(tc::InferenceServerHttpClient* client) {
+  // InferMulti: a per-request deadline inside the fan-out must surface as a
+  // failed fan-out, not a hang or a partial success silently dropped.
+  tc::InferInput* input = MakeInput(5);
+  std::vector<std::vector<tc::InferInput*>> multi_inputs(
+      2, std::vector<tc::InferInput*>{input});
+  std::vector<tc::InferResult*> results;
+  tc::Error err = client->InferMulti(
+      &results, {DelayedOptions(kShortTimeoutUs)}, multi_inputs);
+  if (err.IsOk()) {
+    // per-request errors may be delivered on the results instead
+    bool any_deadline = false;
+    for (auto* r : results) {
+      if (IsDeadlineExceeded(r->RequestStatus())) any_deadline = true;
+      delete r;
+    }
+    CHECK_TRUE(any_deadline);
+  } else {
+    CHECK_TRUE(IsDeadlineExceeded(err));
+  }
+  delete input;
+}
+
+void TestConnectionRefusedSurfacesError() {
+  // Nothing listens on this port: the client must return an error quickly
+  // (not crash, not hang), under both transports.
+  const std::string dead_url = "127.0.0.1:1";
+  {
+    std::unique_ptr<tc::InferenceServerHttpClient> client;
+    CHECK_OK(tc::InferenceServerHttpClient::Create(&client, dead_url));
+    bool live = true;
+    tc::Error err = client->IsServerLive(&live);
+    CHECK_TRUE(!err.IsOk() || !live);
+  }
+  {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, dead_url));
+    bool live = true;
+    tc::Error err = client->IsServerLive(&live);
+    CHECK_TRUE(!err.IsOk() || !live);
+  }
+}
+
+template <typename ClientT>
+void TestZeroTimeoutMeansNoDeadline(ClientT* client) {
+  // client_timeout_us == 0 is "no deadline" (reference semantics), even on
+  // a slow request.
+  tc::InferInput* input = MakeInput(13);
+  tc::InferOptions options("custom_identity_int32");
+  options.client_timeout_us_ = 0;
+  options.request_parameters_["execute_delay_ms"] = "700";
+  tc::InferResult* result = nullptr;
+  CHECK_OK(client->Infer(&result, options, {input}));
+  delete result;
+  delete input;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -121,6 +218,10 @@ int main(int argc, char** argv) {
     CHECK_OK(tc::InferenceServerHttpClient::Create(&client, url));
     TestSyncTimeout(client.get());
     TestAsyncTimeout(client.get());
+    TestGenerousDeadlineSucceeds(client.get());
+    TestPoolShedsDeadline(client.get());
+    TestHttpMultiTimeout(client.get());
+    TestZeroTimeoutMeansNoDeadline(client.get());
     printf("PASS: http timeouts\n");
   }
   {
@@ -128,8 +229,13 @@ int main(int argc, char** argv) {
     CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
     TestSyncTimeout(client.get());
     TestAsyncTimeout(client.get());
+    TestGenerousDeadlineSucceeds(client.get());
+    TestPoolShedsDeadline(client.get());
+    TestZeroTimeoutMeansNoDeadline(client.get());
     printf("PASS: grpc timeouts\n");
   }
+  TestConnectionRefusedSurfacesError();
+  printf("PASS: connection-refused error surface\n");
   printf("PASS: all\n");
   return 0;
 }

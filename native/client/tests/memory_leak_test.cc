@@ -5,6 +5,10 @@
 //
 // Usage: memory_leak_test <http_host:port> [iterations]
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
 #include <cstdio>
 #include <cstring>
 #include <condition_variable>
@@ -77,6 +81,80 @@ void RunIterations(ClientT* client, int n) {
   }
 }
 
+// BYTES round trips churn the serialize/deserialize buffers (reference
+// memory_leak_test loops string models too).
+template <typename ClientT>
+void RunStringIterations(ClientT* client, int n) {
+  for (int it = 0; it < n; ++it) {
+    tc::InferInput* in;
+    CHECK_OK(tc::InferInput::Create(&in, "INPUT0", {1, 3}, "BYTES"));
+    CHECK_OK(in->AppendFromString(
+        {"looped", std::string(64, 'x'), std::to_string(it)}));
+    tc::InferOptions options("simple_identity");
+    tc::InferResult* result = nullptr;
+    CHECK_OK(client->Infer(&result, options, {in}));
+    std::vector<std::string> strings;
+    CHECK_OK(result->StringData("OUTPUT0", &strings));
+    if (strings.size() != 3 || strings[0] != "looped") {
+      fprintf(stderr, "FAILED: wrong string result at iteration %d\n", it);
+      exit(1);
+    }
+    delete result;
+    delete in;
+  }
+}
+
+// Stream open/close cycles: reader threads and stream state must be
+// reclaimed every cycle.
+void RunStreamCycles(const std::string& url, int n) {
+  for (int it = 0; it < n; ++it) {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+    std::mutex mu;
+    std::condition_variable cv;
+    int got = 0;
+    CHECK_OK(client->StartStream([&](tc::InferResult* r) {
+      std::lock_guard<std::mutex> lk(mu);
+      ++got;
+      delete r;
+      cv.notify_one();
+    }));
+    int32_t value = it;
+    tc::InferInput* in;
+    CHECK_OK(tc::InferInput::Create(&in, "INPUT", {1}, "INT32"));
+    CHECK_OK(in->AppendRaw(reinterpret_cast<const uint8_t*>(&value), 4));
+    tc::InferOptions options("simple_sequence");
+    options.sequence_id_ = 100000 + it;
+    options.sequence_start_ = true;
+    options.sequence_end_ = true;
+    CHECK_OK(client->AsyncStreamInfer(options, {in}));
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait(lk, [&] { return got >= 1; });
+    }
+    CHECK_OK(client->FinishStream());
+    delete in;
+  }
+}
+
+// Register/unregister churn on the shm registries.
+void RunShmRegisterCycles(tc::InferenceServerHttpClient* client, int n) {
+  const char* key = "/leak_region_key";
+  shm_unlink(key);
+  int fd = shm_open(key, O_RDWR | O_CREAT, 0600);
+  if (fd < 0 || ftruncate(fd, 4096) != 0) {
+    fprintf(stderr, "FAILED: shm setup\n");
+    exit(1);
+  }
+  for (int it = 0; it < n; ++it) {
+    // reuse one key per cycle; server-side registry must not accumulate
+    CHECK_OK(client->RegisterSystemSharedMemory("leak_region", key, 4096));
+    CHECK_OK(client->UnregisterSystemSharedMemory("leak_region"));
+  }
+  close(fd);
+  shm_unlink(key);
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -95,10 +173,17 @@ int main(int argc, char** argv) {
   // warm up: connection pools, lazily-spawned worker threads, allocator
   RunIterations(http_client.get(), 50);
   RunIterations(grpc_client.get(), 50);
+  RunStringIterations(http_client.get(), 20);
+  RunStreamCycles(url, 5);
+  RunShmRegisterCycles(http_client.get(), 20);
 
   long before_kb = RssKb();
   RunIterations(http_client.get(), iterations);
   RunIterations(grpc_client.get(), iterations);
+  RunStringIterations(http_client.get(), iterations / 5);
+  RunStringIterations(grpc_client.get(), iterations / 5);
+  RunStreamCycles(url, iterations / 25);
+  RunShmRegisterCycles(http_client.get(), iterations / 5);
   long after_kb = RssKb();
 
   long growth_kb = after_kb - before_kb;

@@ -265,6 +265,24 @@ class TestModelControl:
         client.load_model("identity_fp32")
         assert client.is_model_ready("identity_fp32")
 
+    def test_load_config_override_then_plain_reload_restores(self, client):
+        # Triton semantics: load(config=...) overrides; a later plain load
+        # re-reads the registered config (regression: the override used to
+        # stick because the zoo factory returns a shared instance).
+        import json
+
+        original = client.get_model_config("identity_fp32")
+        client.load_model(
+            "identity_fp32",
+            config=json.dumps({"name": "identity_fp32", "max_batch_size": 4,
+                               "backend": "jax"}),
+        )
+        assert client.get_model_config("identity_fp32")["max_batch_size"] == 4
+        client.load_model("identity_fp32")
+        restored = client.get_model_config("identity_fp32")
+        assert restored["max_batch_size"] == original["max_batch_size"]
+        assert [i["name"] for i in restored["input"]] == ["INPUT0"]
+
     def test_trace_and_log_settings(self, client):
         settings = client.get_trace_settings()
         assert "trace_level" in settings

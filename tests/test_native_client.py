@@ -81,6 +81,7 @@ def test_cpp_grpc_example(native_build, harness, example):
 
 @pytest.mark.parametrize("binary", [
     "cc_client_test",
+    "cc_client_matrix_test",
     "client_timeout_test",
     "memory_leak_test",
 ])

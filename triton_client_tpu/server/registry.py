@@ -30,6 +30,7 @@ from .types import InferError
 class ModelRegistry:
     def __init__(self, repository_path: Optional[str] = None):
         self._factories: Dict[str, Callable[[], Model]] = {}
+        self._original_configs: Dict[str, bytes] = {}
         self._models: Dict[str, Model] = {}
         self._states: Dict[str, tuple] = {}  # name -> (state, reason)
         self._lock = threading.RLock()
@@ -52,6 +53,10 @@ class ModelRegistry:
     def register_model(self, model: Model) -> None:
         with self._lock:
             self._factories[model.name] = lambda m=model: m
+            # The factory returns this same instance, so a load-time config
+            # override mutates it; snapshot the registered config so a plain
+            # reload restores it (Triton semantics: load re-reads the repo).
+            self._original_configs[model.name] = model.config.SerializeToString()
             self._models[model.name] = model
             self._states[model.name] = ("READY", "")
 
@@ -63,6 +68,12 @@ class ModelRegistry:
                     model = self._factories[name]()
                     if config_override:
                         model.config = _parse_config_json(config_override, name)
+                    elif name in self._original_configs:
+                        orig = self._original_configs[name]
+                        if model.config.SerializeToString() != orig:
+                            cfg = pb.ModelConfig()
+                            cfg.ParseFromString(orig)
+                            model.config = cfg
                 elif self._repository_path or files:
                     model = self._load_from_directory(name, config_override, files)
                 else:
