@@ -1,0 +1,96 @@
+// Decoupled model over the bidi stream: one request, N responses
+// (reference src/c++/examples/simple_grpc_custom_repeat.cc behavior against
+// the repeat backend).
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  int repeat = 5;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+    if (strcmp(argv[i], "-r") == 0) repeat = atoi(argv[i + 1]);
+  }
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  tc::Error err = tc::InferenceServerGrpcClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> received;
+  bool got_final = false;
+  err = client->StartStream([&](tc::InferResult* r) {
+    std::lock_guard<std::mutex> lk(mu);
+    bool is_final = false, is_null = false;
+    r->IsFinalResponse(&is_final);
+    r->IsNullResponse(&is_null);
+    if (is_final) got_final = true;
+    const uint8_t* buf;
+    size_t len;
+    if (!is_null && r->RequestStatus().IsOk() &&
+        r->RawData("OUT", &buf, &len).IsOk() && len >= 4) {
+      int32_t v;
+      memcpy(&v, buf, 4);
+      received.push_back(v);
+    }
+    cv.notify_all();
+    delete r;
+  });
+  if (!err.IsOk()) {
+    fprintf(stderr, "stream start failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::vector<int32_t> values(repeat);
+  std::vector<uint32_t> delays(repeat, 500);
+  for (int i = 0; i < repeat; ++i) values[i] = 10 * (i + 1);
+  uint32_t wait = 0;
+  tc::InferInput *vin, *din, *win;
+  tc::InferInput::Create(&vin, "IN", {repeat}, "INT32");
+  vin->AppendRaw(reinterpret_cast<const uint8_t*>(values.data()),
+                 values.size() * sizeof(int32_t));
+  tc::InferInput::Create(&din, "DELAY", {repeat}, "UINT32");
+  din->AppendRaw(reinterpret_cast<const uint8_t*>(delays.data()),
+                 delays.size() * sizeof(uint32_t));
+  tc::InferInput::Create(&win, "WAIT", {1}, "UINT32");
+  win->AppendRaw(reinterpret_cast<const uint8_t*>(&wait), sizeof(uint32_t));
+  tc::InferOptions options("repeat_int32");
+  options.triton_enable_empty_final_response_ = true;
+  err = client->AsyncStreamInfer(options, {vin, din, win});
+  if (!err.IsOk()) {
+    fprintf(stderr, "stream infer failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    if (!cv.wait_for(lk, std::chrono::seconds(60), [&] {
+          return static_cast<int>(received.size()) == repeat && got_final;
+        })) {
+      fprintf(stderr, "timed out: %zu/%d responses\n", received.size(),
+              repeat);
+      return 1;
+    }
+  }
+  client->FinishStream();
+  for (int i = 0; i < repeat; ++i) {
+    if (received[i] != values[i]) {
+      fprintf(stderr, "mismatch at %d\n", i);
+      return 1;
+    }
+  }
+  delete vin;
+  delete din;
+  delete win;
+  printf("PASS: grpc custom repeat (%d responses + final)\n", repeat);
+  return 0;
+}

@@ -1,0 +1,56 @@
+// Health + metadata surface over gRPC (reference
+// src/c++/examples/simple_grpc_health_metadata.cc behavior).
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "grpc_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i)
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  tc::Error err = tc::InferenceServerGrpcClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  bool live = false, ready = false, model_ready = false;
+  if (!client->IsServerLive(&live).IsOk() || !live) {
+    fprintf(stderr, "server not live\n");
+    return 1;
+  }
+  if (!client->IsServerReady(&ready).IsOk() || !ready) {
+    fprintf(stderr, "server not ready\n");
+    return 1;
+  }
+  if (!client->IsModelReady(&model_ready, "simple").IsOk() || !model_ready) {
+    fprintf(stderr, "model not ready\n");
+    return 1;
+  }
+  tc::pb::ServerMetadataResponse server_md;
+  if (!client->ServerMetadata(&server_md).IsOk() || server_md.name().empty()) {
+    fprintf(stderr, "server metadata failed\n");
+    return 1;
+  }
+  tc::pb::ModelMetadataResponse model_md;
+  if (!client->ModelMetadata(&model_md, "simple").IsOk() ||
+      model_md.inputs_size() != 2) {
+    fprintf(stderr, "model metadata failed\n");
+    return 1;
+  }
+  tc::pb::ModelConfigResponse config;
+  if (!client->ModelConfig(&config, "simple").IsOk() ||
+      config.config().name() != "simple") {
+    fprintf(stderr, "model config failed\n");
+    return 1;
+  }
+  printf("PASS: grpc health metadata (server=%s)\n",
+         server_md.name().c_str());
+  return 0;
+}

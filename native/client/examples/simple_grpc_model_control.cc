@@ -1,0 +1,65 @@
+// Model repository control over gRPC (reference
+// src/c++/examples/simple_grpc_model_control.cc behavior): unload, verify
+// not-ready, reload, verify ready, inspect the index.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "grpc_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i)
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  tc::Error err = tc::InferenceServerGrpcClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  const std::string model = "identity_fp32";
+  bool ready = false;
+  if (!client->UnloadModel(model).IsOk()) {
+    fprintf(stderr, "unload failed\n");
+    return 1;
+  }
+  if (!client->IsModelReady(&ready, model).IsOk()) {
+    fprintf(stderr, "IsModelReady RPC failed\n");
+    return 1;
+  }
+  if (ready) {
+    fprintf(stderr, "model still ready after unload\n");
+    return 1;
+  }
+  if (!client->LoadModel(model).IsOk()) {
+    fprintf(stderr, "load failed\n");
+    return 1;
+  }
+  if (!client->IsModelReady(&ready, model).IsOk()) {
+    fprintf(stderr, "IsModelReady RPC failed\n");
+    return 1;
+  }
+  if (!ready) {
+    fprintf(stderr, "model not ready after load\n");
+    return 1;
+  }
+  tc::pb::RepositoryIndexResponse index;
+  if (!client->ModelRepositoryIndex(&index).IsOk() ||
+      index.models_size() == 0) {
+    fprintf(stderr, "repository index failed\n");
+    return 1;
+  }
+  bool found = false;
+  for (const auto& m : index.models())
+    if (m.name() == model && m.state() == "READY") found = true;
+  if (!found) {
+    fprintf(stderr, "model missing from index\n");
+    return 1;
+  }
+  printf("PASS: grpc model control\n");
+  return 0;
+}

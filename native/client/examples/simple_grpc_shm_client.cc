@@ -1,0 +1,100 @@
+// System shared-memory data path over gRPC (reference
+// src/c++/examples/simple_grpc_shm_client.cc behavior): create/map POSIX
+// shm, register, infer with shm inputs+outputs, read results from the
+// region, unregister/unlink.
+
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i)
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  tc::Error err = tc::InferenceServerGrpcClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  const char* in_key = "/cc_grpc_input_shm";
+  const char* out_key = "/cc_grpc_output_shm";
+  const size_t in_bytes = 2 * 16 * sizeof(int32_t);
+  const size_t out_bytes = 2 * 16 * sizeof(int32_t);
+  shm_unlink(in_key);
+  shm_unlink(out_key);
+  int in_fd = shm_open(in_key, O_RDWR | O_CREAT, 0600);
+  int out_fd = shm_open(out_key, O_RDWR | O_CREAT, 0600);
+  if (in_fd < 0 || out_fd < 0 || ftruncate(in_fd, in_bytes) != 0 ||
+      ftruncate(out_fd, out_bytes) != 0) {
+    fprintf(stderr, "shm setup failed\n");
+    return 1;
+  }
+  int32_t* in_base = static_cast<int32_t*>(mmap(
+      nullptr, in_bytes, PROT_READ | PROT_WRITE, MAP_SHARED, in_fd, 0));
+  int32_t* out_base = static_cast<int32_t*>(mmap(
+      nullptr, out_bytes, PROT_READ | PROT_WRITE, MAP_SHARED, out_fd, 0));
+  if (in_base == MAP_FAILED || out_base == MAP_FAILED) {
+    fprintf(stderr, "mmap failed\n");
+    return 1;
+  }
+  for (int i = 0; i < 16; ++i) {
+    in_base[i] = i;       // INPUT0 at offset 0
+    in_base[16 + i] = 1;  // INPUT1 at offset 64
+  }
+  if (!client->RegisterSystemSharedMemory("grpc_in", in_key, in_bytes)
+           .IsOk() ||
+      !client->RegisterSystemSharedMemory("grpc_out", out_key, out_bytes)
+           .IsOk()) {
+    fprintf(stderr, "register failed\n");
+    return 1;
+  }
+  tc::InferInput *in0, *in1;
+  tc::InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32");
+  tc::InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32");
+  in0->SetSharedMemory("grpc_in", 16 * sizeof(int32_t), 0);
+  in1->SetSharedMemory("grpc_in", 16 * sizeof(int32_t), 16 * sizeof(int32_t));
+  tc::InferRequestedOutput *o0, *o1;
+  tc::InferRequestedOutput::Create(&o0, "OUTPUT0");
+  tc::InferRequestedOutput::Create(&o1, "OUTPUT1");
+  o0->SetSharedMemory("grpc_out", 16 * sizeof(int32_t), 0);
+  o1->SetSharedMemory("grpc_out", 16 * sizeof(int32_t), 16 * sizeof(int32_t));
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  err = client->Infer(&result, options, {in0, in1}, {o0, o1});
+  if (!err.IsOk()) {
+    fprintf(stderr, "infer failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  for (int i = 0; i < 16; ++i) {
+    if (out_base[i] != i + 1 || out_base[16 + i] != i - 1) {
+      fprintf(stderr, "shm output mismatch at %d\n", i);
+      return 1;
+    }
+  }
+  delete result;
+  delete in0;
+  delete in1;
+  delete o0;
+  delete o1;
+  client->UnregisterSystemSharedMemory("grpc_in");
+  client->UnregisterSystemSharedMemory("grpc_out");
+  munmap(in_base, in_bytes);
+  munmap(out_base, out_bytes);
+  close(in_fd);
+  close(out_fd);
+  shm_unlink(in_key);
+  shm_unlink(out_key);
+  printf("PASS: grpc system shm\n");
+  return 0;
+}

@@ -1,0 +1,56 @@
+// BYTES tensor round trip over gRPC (reference
+// src/c++/examples/simple_grpc_string_infer_client.cc behavior, against the
+// harness's BYTES echo model).
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i)
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  tc::Error err = tc::InferenceServerGrpcClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::vector<std::string> values{"hello", "", "wörld", std::string(300, 'x')};
+  tc::InferInput* input;
+  tc::InferInput::Create(&input, "INPUT0", {1, 4}, "BYTES");
+  err = input->AppendFromString(values);
+  if (!err.IsOk()) {
+    fprintf(stderr, "append failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  tc::InferOptions options("simple_identity");
+  tc::InferResult* result = nullptr;
+  err = client->Infer(&result, options, {input});
+  if (!err.IsOk()) {
+    fprintf(stderr, "inference failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::vector<std::string> echoed;
+  err = result->StringData("OUTPUT0", &echoed);
+  if (!err.IsOk() || echoed.size() != values.size()) {
+    fprintf(stderr, "string decode failed\n");
+    return 1;
+  }
+  for (size_t i = 0; i < values.size(); ++i) {
+    if (echoed[i] != values[i]) {
+      fprintf(stderr, "mismatch at %zu\n", i);
+      return 1;
+    }
+  }
+  delete result;
+  delete input;
+  printf("PASS: grpc string infer\n");
+  return 0;
+}

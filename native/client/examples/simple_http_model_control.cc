@@ -1,0 +1,50 @@
+// Model repository control over HTTP (reference
+// src/c++/examples/simple_http_model_control.cc behavior).
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i)
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  const std::string model = "identity_fp32";
+  bool ready = false;
+  if (!client->UnloadModel(model).IsOk()) return 1;
+  if (!client->IsModelReady(&ready, model).IsOk()) {
+    fprintf(stderr, "IsModelReady RPC failed\n");
+    return 1;
+  }
+  if (ready) {
+    fprintf(stderr, "model still ready after unload\n");
+    return 1;
+  }
+  if (!client->LoadModel(model).IsOk()) return 1;
+  if (!client->IsModelReady(&ready, model).IsOk()) {
+    fprintf(stderr, "IsModelReady RPC failed\n");
+    return 1;
+  }
+  if (!ready) {
+    fprintf(stderr, "model not ready after load\n");
+    return 1;
+  }
+  // loading an unknown model must fail
+  if (client->LoadModel("wrong_model_name").IsOk()) {
+    fprintf(stderr, "expected error loading unknown model\n");
+    return 1;
+  }
+  printf("PASS: http model control\n");
+  return 0;
+}

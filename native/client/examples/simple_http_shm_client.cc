@@ -46,6 +46,10 @@ int main(int argc, char** argv) {
       nullptr, 2 * nbytes, PROT_READ | PROT_WRITE, MAP_SHARED, in_fd, 0));
   int32_t* out_ptr = static_cast<int32_t*>(mmap(
       nullptr, 2 * nbytes, PROT_READ | PROT_WRITE, MAP_SHARED, out_fd, 0));
+  if (in_ptr == MAP_FAILED || out_ptr == MAP_FAILED) {
+    fprintf(stderr, "mmap failed\n");
+    return 1;
+  }
   for (int i = 0; i < 16; ++i) {
     in_ptr[i] = i;       // INPUT0 at offset 0
     in_ptr[16 + i] = 1;  // INPUT1 at offset nbytes

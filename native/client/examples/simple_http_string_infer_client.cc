@@ -1,0 +1,45 @@
+// BYTES tensor round trip over HTTP (reference
+// src/c++/examples/simple_http_string_infer_client.cc behavior).
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i)
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::vector<std::string> values{"alpha", "βeta", "", "delta"};
+  tc::InferInput* input;
+  tc::InferInput::Create(&input, "INPUT0", {1, 4}, "BYTES");
+  input->AppendFromString(values);
+  tc::InferOptions options("simple_identity");
+  tc::InferResult* result = nullptr;
+  err = client->Infer(&result, options, {input});
+  if (!err.IsOk()) {
+    fprintf(stderr, "inference failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::vector<std::string> echoed;
+  err = result->StringData("OUTPUT0", &echoed);
+  if (!err.IsOk() || echoed != values) {
+    fprintf(stderr, "string round trip mismatch\n");
+    return 1;
+  }
+  delete result;
+  delete input;
+  printf("PASS: http string infer\n");
+  return 0;
+}

@@ -58,8 +58,13 @@ def _run(binary, url, timeout=180):
 
 @pytest.mark.parametrize("example", [
     "simple_http_infer_client",
+    "simple_http_string_infer_client",
+    "simple_http_health_metadata",
+    "simple_http_model_control",
+    "simple_http_async_infer_client",
     "simple_http_shm_client",
     "simple_http_cudashm_client",
+    "reuse_infer_objects_client",
 ])
 def test_cpp_http_example(native_build, harness, example):
     out = _run(os.path.join(native_build, example),
@@ -69,7 +74,14 @@ def test_cpp_http_example(native_build, harness, example):
 
 @pytest.mark.parametrize("example", [
     "simple_grpc_infer_client",
+    "simple_grpc_string_infer_client",
+    "simple_grpc_health_metadata",
+    "simple_grpc_model_control",
+    "simple_grpc_async_infer_client",
     "simple_grpc_sequence_stream_infer_client",
+    "simple_grpc_sequence_sync_infer_client",
+    "simple_grpc_custom_repeat",
+    "simple_grpc_shm_client",
     "simple_grpc_cudashm_client",
 ])
 def test_cpp_grpc_example(native_build, harness, example):
