@@ -42,6 +42,8 @@ int main(int argc, char** argv) {
   bool ok = true;
   tc::InferOptions options("simple");
   const int kRequests = 4;
+  int submitted = 0;
+  tc::Error submit_err;
   for (int r = 0; r < kRequests; ++r) {
     err = client->AsyncInfer(
         [&](tc::InferResult* result) {
@@ -60,13 +62,20 @@ int main(int argc, char** argv) {
         },
         options, {in0, in1});
     if (!err.IsOk()) {
-      fprintf(stderr, "async submit failed: %s\n", err.Message().c_str());
-      return 1;
+      submit_err = err;
+      break;
     }
+    ++submitted;
   }
+  // Drain every accepted request before returning — the callbacks capture
+  // locals that are destroyed before the client joins its workers.
   {
     std::unique_lock<std::mutex> lk(mu);
-    cv.wait(lk, [&] { return done == kRequests; });
+    cv.wait(lk, [&] { return done == submitted; });
+  }
+  if (!submit_err.IsOk()) {
+    fprintf(stderr, "async submit failed: %s\n", submit_err.Message().c_str());
+    return 1;
   }
   delete in0;
   delete in1;

@@ -69,19 +69,24 @@ int main(int argc, char** argv) {
   err = client->AsyncStreamInfer(options, {vin, din, win});
   if (!err.IsOk()) {
     fprintf(stderr, "stream infer failed: %s\n", err.Message().c_str());
+    client->FinishStream();  // join the reader before locals go away
     return 1;
   }
+  bool timed_out = false;
   {
     std::unique_lock<std::mutex> lk(mu);
-    if (!cv.wait_for(lk, std::chrono::seconds(60), [&] {
-          return static_cast<int>(received.size()) == repeat && got_final;
-        })) {
-      fprintf(stderr, "timed out: %zu/%d responses\n", received.size(),
-              repeat);
-      return 1;
-    }
+    timed_out = !cv.wait_for(lk, std::chrono::seconds(60), [&] {
+      return static_cast<int>(received.size()) == repeat && got_final;
+    });
   }
+  // Always close the stream (joins the reader thread) BEFORE any return:
+  // the callback captures locals declared after `client`, which would be
+  // destroyed first on an early return.
   client->FinishStream();
+  if (timed_out) {
+    fprintf(stderr, "timed out: %zu/%d responses\n", received.size(), repeat);
+    return 1;
+  }
   for (int i = 0; i < repeat; ++i) {
     if (received[i] != values[i]) {
       fprintf(stderr, "mismatch at %d\n", i);

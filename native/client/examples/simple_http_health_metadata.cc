@@ -22,10 +22,18 @@ int main(int argc, char** argv) {
     return 1;
   }
   bool live = false, ready = false, model_ready = false;
-  if (!client->IsServerLive(&live).IsOk() || !live) return 1;
-  if (!client->IsServerReady(&ready).IsOk() || !ready) return 1;
-  if (!client->IsModelReady(&model_ready, "simple").IsOk() || !model_ready)
+  if (!client->IsServerLive(&live).IsOk() || !live) {
+    fprintf(stderr, "server not live\n");
     return 1;
+  }
+  if (!client->IsServerReady(&ready).IsOk() || !ready) {
+    fprintf(stderr, "server not ready\n");
+    return 1;
+  }
+  if (!client->IsModelReady(&model_ready, "simple").IsOk() || !model_ready) {
+    fprintf(stderr, "model not ready\n");
+    return 1;
+  }
   std::string server_md, model_md, config, index;
   if (!client->ServerMetadata(&server_md).IsOk() ||
       server_md.find("extensions") == std::string::npos) {

@@ -22,7 +22,10 @@ int main(int argc, char** argv) {
   }
   const std::string model = "identity_fp32";
   bool ready = false;
-  if (!client->UnloadModel(model).IsOk()) return 1;
+  if (!client->UnloadModel(model).IsOk()) {
+    fprintf(stderr, "unload failed\n");
+    return 1;
+  }
   if (!client->IsModelReady(&ready, model).IsOk()) {
     fprintf(stderr, "IsModelReady RPC failed\n");
     return 1;
@@ -31,7 +34,10 @@ int main(int argc, char** argv) {
     fprintf(stderr, "model still ready after unload\n");
     return 1;
   }
-  if (!client->LoadModel(model).IsOk()) return 1;
+  if (!client->LoadModel(model).IsOk()) {
+    fprintf(stderr, "load failed\n");
+    return 1;
+  }
   if (!client->IsModelReady(&ready, model).IsOk()) {
     fprintf(stderr, "IsModelReady RPC failed\n");
     return 1;
