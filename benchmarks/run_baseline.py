@@ -146,27 +146,40 @@ def main():
     warm_client.infer("ensemble_llama", [inp])
     print(f"  warm ensemble_llama: {time.time() - t0:.1f}s", flush=True)
 
+    def gen_loop(seq_id, steps, prompt):
+        """Closed-loop stream generation: one request per token, 128-byte
+        window, OUT_TEXT appended — the single definition of the protocol
+        shared by the serial and concurrent row-5 measurements.  Returns
+        (generation wall seconds, per-token latencies); the timed window
+        spans first request → last response, excluding client/stream
+        setup and teardown (the historical measurement methodology)."""
+        done_q: "queue.Queue" = queue.Queue()
+        text = prompt
+        lats = []
+        with grpcclient.InferenceServerClient(grpc_url) as c:
+            c.start_stream(
+                callback=lambda result, error: done_q.put((result, error)))
+            t_gen = time.time()
+            for step in range(steps):
+                ginp = grpcclient.InferInput("TEXT", [1, 1], "BYTES")
+                ginp.set_data_from_numpy(np.array([[text[-128:]]], dtype=object))
+                t0 = time.time()
+                c.async_stream_infer("ensemble_llama", [ginp],
+                                     sequence_id=seq_id,
+                                     sequence_start=(step == 0),
+                                     sequence_end=(step == steps - 1))
+                res, err = done_q.get(timeout=300)
+                if err is not None:
+                    raise RuntimeError(err)
+                lats.append(time.time() - t0)
+                text += bytes(
+                    np.asarray(res.as_numpy("OUT_TEXT")).reshape(-1)[0])
+            wall_s = time.time() - t_gen
+            c.stop_stream()
+        return wall_s, lats
+
     gen_steps = 8 if args.smoke else 64
-    done: "queue.Queue" = queue.Queue()
-    lat = []
-    with grpcclient.InferenceServerClient(grpc_url) as c:
-        c.start_stream(callback=lambda result, error: done.put((result, error)))
-        text = b"In a hole in the ground there lived"
-        t_gen = time.time()
-        for step in range(gen_steps):
-            ginp = grpcclient.InferInput("TEXT", [1, 1], "BYTES")
-            ginp.set_data_from_numpy(np.array([[text[-128:]]], dtype=object))
-            t0 = time.time()
-            c.async_stream_infer("ensemble_llama", [ginp], sequence_id=1,
-                                 sequence_start=(step == 0),
-                                 sequence_end=(step == gen_steps - 1))
-            res, err = done.get(timeout=300)
-            lat.append(time.time() - t0)
-            if err is not None:
-                raise RuntimeError(err)
-            text += bytes(np.asarray(res.as_numpy("OUT_TEXT")).reshape(-1)[0])
-        wall = time.time() - t_gen
-        c.stop_stream()
+    wall, lat = gen_loop(1, gen_steps, b"In a hole in the ground there lived")
     cfg = language._llama_cfg()
     flops_tok = language.forward_flops_per_token(cfg, language.LLAMA_SEQ_LEN)
     # each generated token re-runs the full 128-token window forward
@@ -183,6 +196,50 @@ def main():
     print(f"  llama({r5['preset_params']/1e9:.2f}B params): "
           f"{r5['tokens_per_sec']:.2f} tok/s p50={r5['stream_p50_ms']:.0f}ms "
           f"MFU={r5['mfu']*100:.1f}%", flush=True)
+
+    # concurrent generation: N independent streams; the ensemble's member
+    # executions coalesce through llama_tpu's dynamic batcher, so aggregate
+    # tokens/sec scales far past the serial per-token RTT floor
+    _warm(warm_client, httpclient, "llama_tpu", "TOKENS",
+          (language.LLAMA_SEQ_LEN,), np.int32,
+          [1, 2, 4, 8] if not args.smoke else [1, 2])
+    import threading
+
+    n_streams = 2 if args.smoke else 8
+    conc_steps = 4 if args.smoke else 32
+    worker_errors = []
+    t_conc = time.time()
+
+    def guarded_worker(widx):
+        try:
+            gen_loop(2000 + widx, conc_steps,
+                     f"stream {widx}: in the beginning".encode())
+        except Exception as exc:  # noqa: BLE001 — surfaced after join
+            worker_errors.append((widx, exc))
+
+    threads = [threading.Thread(target=guarded_worker, args=(w,), daemon=True)
+               for w in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    if worker_errors:
+        raise RuntimeError(f"concurrent-stream workers failed: {worker_errors}")
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError("concurrent-stream worker hung past 600s join")
+    conc_wall = time.time() - t_conc
+    # every worker completed exactly conc_steps tokens (guards above raise
+    # on any failure or hang)
+    total_toks = n_streams * conc_steps
+    results["row5_llama_concurrent"] = {
+        "streams": n_streams,
+        "gen_tokens": total_toks,
+        "tokens_per_sec": total_toks / conc_wall,
+        "mfu": (total_toks / conc_wall) * window_flops / V5E_PEAK_FLOPS,
+    }
+    r5c = results["row5_llama_concurrent"]
+    print(f"  llama concurrent x{n_streams}: {r5c['tokens_per_sec']:.2f} "
+          f"tok/s aggregate MFU={r5c['mfu']*100:.1f}%", flush=True)
 
     warm_client.close()
     harness.stop()
@@ -209,7 +266,9 @@ def main():
         print(f"| 4 | bert_large, streaming gRPC + xla shm | {fmt(r4)}, "
               f"{r4['tokens_per_sec']:.0f} tok/s, MFU {r4['mfu']*100:.1f}% |")
     print(f"| 5 | ensemble_llama stream gen | {r5['tokens_per_sec']:.2f} tok/s, "
-          f"stream p50 {r5['stream_p50_ms']:.0f} ms, MFU {r5['mfu']*100:.1f}% |")
+          f"stream p50 {r5['stream_p50_ms']:.0f} ms, MFU {r5['mfu']*100:.1f}%; "
+          f"x{r5c['streams']} streams: {r5c['tokens_per_sec']:.2f} tok/s, "
+          f"MFU {r5c['mfu']*100:.1f}% |")
     return 0
 
 

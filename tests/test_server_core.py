@@ -90,6 +90,41 @@ class TestBatcherParamGrouping:
             float(r.outputs[0].data.reshape(-1)[0]) == 5.0 for r in resps)
         assert len(executions) < 4  # concurrent identical requests coalesced
 
+    def test_merge_never_exceeds_max_batch_size(self):
+        # Multi-row requests whose counts don't divide max_batch_size: the
+        # merge loop must carry the overflowing request into the next batch,
+        # never execute a shape larger than the model's contract.
+        cfg = make_config(
+            "capped",
+            inputs=[("INPUT", "FP32", [4])],
+            outputs=[("OUTPUT", "FP32", [4])],
+            max_batch_size=8,
+            max_queue_delay_us=50_000,
+        )
+        execute_batches = []
+
+        def fn(inputs, params):
+            execute_batches.append(inputs["INPUT"].shape[0])
+            return {"OUTPUT": inputs["INPUT"] * 2.0}
+
+        registry = ModelRegistry()
+        registry.register_model(PyModel(cfg, fn))
+        core = InferenceCore(registry)
+
+        async def drive():
+            reqs = [_request("capped", np.full((5, 4), float(i)))
+                    for i in range(4)]
+            resps = await asyncio.gather(*(core.infer(r) for r in reqs))
+            await core.shutdown()
+            return resps
+
+        resps = _run(drive())
+        for i, r in enumerate(resps):
+            np.testing.assert_array_equal(
+                r.outputs[0].data, np.full((5, 4), 2.0 * i, np.float32))
+        assert sum(execute_batches) == 20
+        assert max(execute_batches) <= 8, execute_batches
+
 
 class TestEnsembleDag:
     def _core(self, sleep_s=0.15):
@@ -160,6 +195,62 @@ class TestEnsembleDag:
         assert stats.infer_ns > 0  # compute time recorded, not fabricated 0
         member = registry.get("branch_a").stats
         assert member.infer_ns > 0
+
+    def test_member_steps_coalesce_through_dynamic_batcher(self):
+        # Concurrent ensemble requests must batch their member executions
+        # (Triton semantics: a step is an ordinary request to the member) —
+        # even when each ensemble request carries a distinct sequence id
+        # from a generation stream, since the member itself is stateless.
+        registry = ModelRegistry()
+        execute_batches = []
+        cfg = make_config(
+            "batched_member",
+            inputs=[("INPUT", "FP32", [4])],
+            outputs=[("OUTPUT", "FP32", [4])],
+            max_batch_size=8,
+            max_queue_delay_us=50_000,
+        )
+
+        def fn(inputs, params):
+            x = np.asarray(inputs["INPUT"])
+            execute_batches.append(x.shape[0])
+            return {"OUTPUT": (x * 2).astype(np.float32)}
+
+        registry.register_model(PyModel(cfg, fn))
+        ens_cfg = make_config(
+            "member_ens",
+            inputs=[("INPUT", "FP32", [4])],
+            outputs=[("OUTPUT", "FP32", [4])],
+            max_batch_size=8,
+            platform="ensemble",
+            backend="",
+        )
+        s = ens_cfg.ensemble_scheduling.step.add()
+        s.model_name = "batched_member"
+        s.input_map["INPUT"] = "INPUT"
+        s.output_map["OUTPUT"] = "OUTPUT"
+        registry.register_model(EnsembleModel(ens_cfg))
+        core = InferenceCore(registry)
+
+        async def drive():
+            reqs = []
+            for i in range(8):
+                arr = np.full((1, 4), float(i), np.float32)
+                req = InferRequest(
+                    model_name="member_ens",
+                    inputs=[InputTensor("INPUT", "FP32", arr.shape, data=arr)],
+                    parameters={"sequence_id": 1000 + i},
+                )
+                reqs.append(core.infer(req))
+            return await asyncio.gather(*reqs)
+
+        responses = _run(drive())
+        for i, resp in enumerate(responses):
+            np.testing.assert_array_equal(
+                resp.outputs[0].data, np.full((1, 4), 2.0 * i, np.float32))
+        # all 8 member executions coalesced into far fewer batches
+        assert sum(execute_batches) == 8
+        assert len(execute_batches) <= 2, execute_batches
 
     def test_unproducible_tensor_raises(self):
         registry = ModelRegistry()

@@ -77,9 +77,13 @@ class _DynamicBatcher:
 
     async def _run(self) -> None:
         pending: list = []
+        carry = None  # request pulled from the queue that overflowed a batch
         try:
             while True:
-                first = await self._queue.get()
+                if carry is not None:
+                    first, carry = carry, None
+                else:
+                    first = await self._queue.get()
                 pending = [first]
                 total = _batch_count(first[0])
                 deadline = time.monotonic() + self._max_delay_s
@@ -93,8 +97,15 @@ class _DynamicBatcher:
                         item = await asyncio.wait_for(self._queue.get(), timeout)
                     except asyncio.TimeoutError:
                         break
+                    count = _batch_count(item[0])
+                    if total + count > self._max_bs:
+                        # merging would break the max_batch_size contract
+                        # (an untested shape the model was never warmed for);
+                        # the request seeds the next batch instead
+                        carry = item
+                        break
                     pending.append(item)
-                    total += _batch_count(item[0])
+                    total += count
                 await self._inflight.acquire()
                 task = asyncio.get_running_loop().create_task(
                     self._execute_batch(pending))
@@ -108,6 +119,8 @@ class _DynamicBatcher:
                 pending = []
         except asyncio.CancelledError:
             # shutdown mid-batch: fail whatever we were holding
+            if carry is not None:
+                pending.append(carry)
             for _inputs, _params, fut, _ts in pending:
                 if not fut.done():
                     fut.set_exception(InferError("server is shutting down", 503))
@@ -313,11 +326,17 @@ class InferenceCore:
         yield final
 
     # ------------------------------------------------------------------
-    def _use_batcher(self, model: Model, request: InferRequest) -> bool:
+    @staticmethod
+    def _model_batchable(model: Model) -> bool:
         return (
             model.max_batch_size > 0
             and model.config.HasField("dynamic_batching")
             and not model.is_sequence
+        )
+
+    def _use_batcher(self, model: Model, request: InferRequest) -> bool:
+        return (
+            self._model_batchable(model)
             and not request.sequence_id
             and not any(i.shm is not None for i in request.inputs)
             and not any(o.shm is not None for o in request.outputs)
@@ -386,8 +405,11 @@ class InferenceCore:
         Steps are scheduled by data dependency, not config order: every step
         whose inputs are available runs concurrently with its siblings
         (parallel DAG branches actually parallelize).  Intermediate tensors
-        stay device-resident between steps; only the ensemble's final outputs
-        pay a D2H, off the event loop."""
+        stay device-resident between steps — except through dynamically
+        batched members, whose merged batch resolves to host so concurrent
+        requests can coalesce (cross-request batching on the device model
+        outweighs the per-step host round trip under load); the ensemble's
+        final outputs pay their D2H off the event loop."""
         pool: Dict[str, Any] = dict(inputs)
         remaining = list(model.config.ensemble_scheduling.step)
         while remaining:
@@ -437,6 +459,25 @@ class InferenceCore:
             member_input: pool[pool_name]
             for member_input, pool_name in step.input_map.items()
         }
+        # Member executions from CONCURRENT ensemble requests coalesce
+        # through the member's dynamic batcher (Triton semantics: ensemble
+        # steps are ordinary requests to the member). Only host-resident
+        # inputs qualify — the batcher merges with np.concatenate, which
+        # would silently force a D2H sync on device-resident intermediates.
+        use_batcher = self._model_batchable(member) and all(
+            isinstance(v, np.ndarray) for v in step_inputs.values())
+        if use_batcher:
+            # Sequence-control params correlate the ENSEMBLE request on its
+            # stream; a stateless member ignores them, and leaving them in
+            # would put every sequence in its own param group, defeating
+            # coalescing across concurrent streams. Strip exactly the three
+            # reserved keys — user params (e.g. "sequence_length") must stay,
+            # both for the member fn and for param-group isolation.
+            member_params = {k: v for k, v in params.items()
+                             if k not in ("sequence_id", "sequence_start",
+                                          "sequence_end")}
+            # the batcher records the member's stats for the merged batch
+            return await self._batcher(member).submit(step_inputs, member_params)
         t0 = time.monotonic_ns()
         try:
             outs = await self._run_model(member, step_inputs, params)
