@@ -1,0 +1,50 @@
+"""Shared plumbing for the raw generated-stub examples.
+
+The reference's ``grpc_client.py`` / ``grpc_explicit_*_content_client.py`` /
+``grpc_image_client.py`` import pre-generated ``service_pb2`` stubs from the
+client wheel. This framework's equivalents generate their stubs at startup by
+invoking the stock ``protoc`` on ``triton_client_tpu/protocol/inference.proto``
+— the same flow a third-party user follows (reference
+src/grpc_generated/go/README.md) — and call the server through grpc *generic*
+channel methods, which is what every generated stub compiles down to.
+"""
+
+import importlib.util
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def generate_stubs():
+    """protoc-compile the framework IDL and import the resulting module."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proto_dir = os.path.join(repo_root, "triton_client_tpu", "protocol")
+    protoc = shutil.which("protoc")
+    if protoc is None:
+        print("SKIP: protoc not found", file=sys.stderr)
+        sys.exit(2)
+    with tempfile.TemporaryDirectory(prefix="raw_stub_") as tmp:
+        subprocess.run(
+            [protoc, f"--proto_path={proto_dir}", f"--python_out={tmp}",
+             "inference.proto"],
+            check=True,
+        )
+        spec = importlib.util.spec_from_file_location(
+            "raw_stub_inference_pb2", os.path.join(tmp, "inference_pb2.py"))
+        mod = importlib.util.module_from_spec(spec)
+        # exec fully materializes the descriptors; the source dir can go
+        spec.loader.exec_module(mod)
+    return mod
+
+
+def rpc(channel, method, pb_req, resp_cls, timeout=30):
+    call = channel.unary_unary(
+        f"/{SERVICE}/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
+    )
+    return call(pb_req, timeout=timeout)

@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Raw generated-stub client against ``simple`` (reference grpc_client.py:
+health + metadata + ModelInfer on bare service_pb2 stubs, no client library).
+
+Packs INT32 tensors into ``raw_input_contents`` little-endian and unpacks
+``raw_output_contents`` positionally — the wire layout every generated stub
+sees. Prints PASS on sum/diff verification.
+"""
+
+import argparse
+import struct
+import sys
+
+import grpc
+
+from _raw_stub import generate_stubs, rpc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-u", "--url", default="localhost:8001")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    pb = generate_stubs()
+    channel = grpc.insecure_channel(args.url)
+
+    live = rpc(channel, "ServerLive", pb.ServerLiveRequest(),
+               pb.ServerLiveResponse)
+    assert live.live, "server not live"
+    ready = rpc(channel, "ServerReady", pb.ServerReadyRequest(),
+                pb.ServerReadyResponse)
+    assert ready.ready, "server not ready"
+    meta = rpc(channel, "ModelMetadata", pb.ModelMetadataRequest(name="simple"),
+               pb.ModelMetadataResponse)
+    if args.verbose:
+        print(meta)
+
+    in0 = list(range(16))
+    in1 = [1] * 16
+    req = pb.ModelInferRequest(model_name="simple")
+    for name, vals in (("INPUT0", in0), ("INPUT1", in1)):
+        t = req.inputs.add()
+        t.name = name
+        t.datatype = "INT32"
+        t.shape.extend([1, 16])
+        req.raw_input_contents.append(struct.pack("<16i", *vals))
+    for out_name in ("OUTPUT0", "OUTPUT1"):
+        req.outputs.add().name = out_name
+
+    resp = rpc(channel, "ModelInfer", req, pb.ModelInferResponse)
+    outs = {}
+    for i, out in enumerate(resp.outputs):
+        outs[out.name] = struct.unpack("<16i", resp.raw_output_contents[i])
+
+    for i in range(16):
+        print(f"{in0[i]} + {in1[i]} = {outs['OUTPUT0'][i]}")
+        print(f"{in0[i]} - {in1[i]} = {outs['OUTPUT1'][i]}")
+        if outs["OUTPUT0"][i] != in0[i] + in1[i]:
+            sys.exit("error: incorrect sum")
+        if outs["OUTPUT1"][i] != in0[i] - in1[i]:
+            sys.exit("error: incorrect difference")
+    print("PASS: grpc_client")
+
+
+if __name__ == "__main__":
+    main()

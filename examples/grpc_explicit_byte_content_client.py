@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Explicit typed-content infer with BYTES: each string element travels as
+its own entry in ``contents.bytes_contents`` — no length-prefixed
+serialization on the request (reference
+grpc_explicit_byte_content_client.py:77-87) — against the ``simple_string``
+sum/diff-over-decimal-strings model. The raw response IS length-prefixed, so
+outputs go through the client library's BYTES deserializer.
+"""
+
+import argparse
+import sys
+
+import grpc
+
+from _raw_stub import generate_stubs, rpc
+from triton_client_tpu.utils import deserialize_bytes_tensor
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-u", "--url", default="localhost:8001")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    pb = generate_stubs()
+    channel = grpc.insecure_channel(args.url)
+
+    in0 = list(range(16))
+    in1 = [1] * 16
+    req = pb.ModelInferRequest(model_name="simple_string")
+    for name, vals in (("INPUT0", in0), ("INPUT1", in1)):
+        t = req.inputs.add()
+        t.name = name
+        t.datatype = "BYTES"
+        t.shape.extend([1, 16])
+        for v in vals:
+            t.contents.bytes_contents.append(str(v).encode("utf-8"))
+    for out_name in ("OUTPUT0", "OUTPUT1"):
+        req.outputs.add().name = out_name
+
+    resp = rpc(channel, "ModelInfer", req, pb.ModelInferResponse)
+    outs = {}
+    for i, out in enumerate(resp.outputs):
+        assert out.datatype == "BYTES", out
+        outs[out.name] = deserialize_bytes_tensor(
+            resp.raw_output_contents[i]).reshape(-1)
+
+    for i in range(16):
+        got_sum = int(outs["OUTPUT0"][i].decode())
+        got_diff = int(outs["OUTPUT1"][i].decode())
+        print(f"{in0[i]} + {in1[i]} = {got_sum}")
+        print(f"{in0[i]} - {in1[i]} = {got_diff}")
+        if got_sum != in0[i] + in1[i]:
+            sys.exit("error: incorrect sum")
+        if got_diff != in0[i] - in1[i]:
+            sys.exit("error: incorrect difference")
+    print("PASS: explicit byte content")
+
+
+if __name__ == "__main__":
+    main()

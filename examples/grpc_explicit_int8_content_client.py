@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Explicit typed-content infer with a sub-word dtype: INT8 values travel in
+``contents.int_contents`` (one proto int32 per INT8 element — the v2
+protocol's rule for narrow integer types; reference
+grpc_explicit_int8_content_client.py:75-90) against the ``simple_int8``
+sum/diff model. Outputs are read back as raw np.int8.
+"""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+from _raw_stub import generate_stubs, rpc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-u", "--url", default="localhost:8001")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    pb = generate_stubs()
+    channel = grpc.insecure_channel(args.url)
+
+    in0 = [i for i in range(16)]
+    in1 = [1 for _ in range(16)]
+    req = pb.ModelInferRequest(model_name="simple_int8")
+    for name, vals in (("INPUT0", in0), ("INPUT1", in1)):
+        t = req.inputs.add()
+        t.name = name
+        t.datatype = "INT8"
+        t.shape.extend([1, 16])
+        t.contents.int_contents[:] = vals
+    for out_name in ("OUTPUT0", "OUTPUT1"):
+        req.outputs.add().name = out_name
+
+    resp = rpc(channel, "ModelInfer", req, pb.ModelInferResponse)
+    outs = {}
+    for i, out in enumerate(resp.outputs):
+        assert out.datatype == "INT8", out
+        arr = np.frombuffer(resp.raw_output_contents[i], dtype=np.int8)
+        # reshape (not np.resize): a wrong-size payload must fail loudly
+        outs[out.name] = arr.reshape([int(d) for d in out.shape]).reshape(-1)
+
+    for i in range(16):
+        print(f"{in0[i]} + {in1[i]} = {outs['OUTPUT0'][i]}")
+        print(f"{in0[i]} - {in1[i]} = {outs['OUTPUT1'][i]}")
+        if outs["OUTPUT0"][i] != in0[i] + in1[i]:
+            sys.exit("error: incorrect sum")
+        if outs["OUTPUT1"][i] != in0[i] - in1[i]:
+            sys.exit("error: incorrect difference")
+    print("PASS: explicit int8 content")
+
+
+if __name__ == "__main__":
+    main()

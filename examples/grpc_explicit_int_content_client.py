@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Explicit typed-content infer: INT32 values travel in the request's
+``contents.int_contents`` repeated field instead of ``raw_input_contents``
+(reference grpc_explicit_int_content_client.py:75-95). The server replies
+raw; outputs are unpacked positionally from ``raw_output_contents``.
+"""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+from _raw_stub import generate_stubs, rpc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-u", "--url", default="localhost:8001")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    pb = generate_stubs()
+    channel = grpc.insecure_channel(args.url)
+
+    in0 = [i for i in range(16)]
+    in1 = [1 for _ in range(16)]
+    req = pb.ModelInferRequest(model_name="simple")
+    for name, vals in (("INPUT0", in0), ("INPUT1", in1)):
+        t = req.inputs.add()
+        t.name = name
+        t.datatype = "INT32"
+        t.shape.extend([1, 16])
+        t.contents.int_contents[:] = vals
+    for out_name in ("OUTPUT0", "OUTPUT1"):
+        req.outputs.add().name = out_name
+
+    resp = rpc(channel, "ModelInfer", req, pb.ModelInferResponse)
+    outs = {}
+    for i, out in enumerate(resp.outputs):
+        arr = np.frombuffer(resp.raw_output_contents[i], dtype=np.int32)
+        # reshape (not np.resize): a wrong-size payload must fail loudly
+        outs[out.name] = arr.reshape([int(d) for d in out.shape]).reshape(-1)
+
+    for i in range(16):
+        print(f"{in0[i]} + {in1[i]} = {outs['OUTPUT0'][i]}")
+        print(f"{in0[i]} - {in1[i]} = {outs['OUTPUT1'][i]}")
+        if outs["OUTPUT0"][i] != in0[i] + in1[i]:
+            sys.exit("error: incorrect sum")
+        if outs["OUTPUT1"][i] != in0[i] - in1[i]:
+            sys.exit("error: incorrect difference")
+    print("PASS: explicit int content")
+
+
+if __name__ == "__main__":
+    main()

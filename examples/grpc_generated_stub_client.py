@@ -26,44 +26,14 @@ Exit code 0 + "PASS: wire conformance" on success.
 """
 
 import argparse
-import importlib.util
-import os
-import shutil
 import struct
-import subprocess
 import sys
-import tempfile
 
 import grpc
 
-SERVICE = "inference.GRPCInferenceService"
-
-
-def generate_stubs(proto_dir, out_dir):
-    """Run stock protoc exactly as a third-party user would."""
-    protoc = shutil.which("protoc")
-    if protoc is None:
-        print("SKIP: protoc not found", file=sys.stderr)
-        sys.exit(2)
-    subprocess.run(
-        [protoc, f"--proto_path={proto_dir}", f"--python_out={out_dir}", "inference.proto"],
-        check=True,
-    )
-    spec = importlib.util.spec_from_file_location(
-        "conformance_inference_pb2", os.path.join(out_dir, "inference_pb2.py")
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def rpc(channel, method, pb_req, resp_cls, timeout=30):
-    call = channel.unary_unary(
-        f"/{SERVICE}/{method}",
-        request_serializer=lambda m: m.SerializeToString(),
-        response_deserializer=resp_cls.FromString,
-    )
-    return call(pb_req, timeout=timeout)
+# stdlib-only shared protoc plumbing — keeps the "imports nothing from
+# triton_client_tpu" constraint intact
+from _raw_stub import SERVICE, generate_stubs, rpc
 
 
 def main():
@@ -71,98 +41,94 @@ def main():
     ap.add_argument("-u", "--url", default="localhost:8001")
     args = ap.parse_args()
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    proto_dir = os.path.join(repo_root, "triton_client_tpu", "protocol")
+    pb = generate_stubs()
+    channel = grpc.insecure_channel(args.url)
 
-    with tempfile.TemporaryDirectory() as tmp:
-        pb = generate_stubs(proto_dir, tmp)
-        channel = grpc.insecure_channel(args.url)
+    # -- health, as grpc_simple_client.go ServerLiveRequest/ServerReadyRequest
+    live = rpc(channel, "ServerLive", pb.ServerLiveRequest(), pb.ServerLiveResponse)
+    assert live.live, "server not live"
+    ready = rpc(channel, "ServerReady", pb.ServerReadyRequest(), pb.ServerReadyResponse)
+    assert ready.ready, "server not ready"
 
-        # -- health, as grpc_simple_client.go ServerLiveRequest/ServerReadyRequest
-        live = rpc(channel, "ServerLive", pb.ServerLiveRequest(), pb.ServerLiveResponse)
-        assert live.live, "server not live"
-        ready = rpc(channel, "ServerReady", pb.ServerReadyRequest(), pb.ServerReadyResponse)
-        assert ready.ready, "server not ready"
+    # -- metadata, as grpc_simple_client.go ModelMetadataRequest
+    meta = rpc(
+        channel,
+        "ModelMetadata",
+        pb.ModelMetadataRequest(name="simple"),
+        pb.ModelMetadataResponse,
+    )
+    assert meta.name == "simple", meta
+    assert [t.name for t in meta.inputs] == ["INPUT0", "INPUT1"], meta
+    assert [t.name for t in meta.outputs] == ["OUTPUT0", "OUTPUT1"], meta
 
-        # -- metadata, as grpc_simple_client.go ModelMetadataRequest
-        meta = rpc(
-            channel,
-            "ModelMetadata",
-            pb.ModelMetadataRequest(name="simple"),
-            pb.ModelMetadataResponse,
-        )
-        assert meta.name == "simple", meta
-        assert [t.name for t in meta.inputs] == ["INPUT0", "INPUT1"], meta
-        assert [t.name for t in meta.outputs] == ["OUTPUT0", "OUTPUT1"], meta
+    # -- infer with hand-packed little-endian int32 payloads
+    #    (grpc_simple_client.go:120-160 packs via binary.Write LE)
+    in0 = list(range(16))
+    in1 = [1] * 16
+    req = pb.ModelInferRequest(model_name="simple", id="conformance-1")
+    for name in ("INPUT0", "INPUT1"):
+        t = req.inputs.add()
+        t.name = name
+        t.datatype = "INT32"
+        t.shape.extend([1, 16])
+    for out_name in ("OUTPUT0", "OUTPUT1"):
+        req.outputs.add().name = out_name
+    req.raw_input_contents.append(struct.pack("<16i", *in0))
+    req.raw_input_contents.append(struct.pack("<16i", *in1))
 
-        # -- infer with hand-packed little-endian int32 payloads
-        #    (grpc_simple_client.go:120-160 packs via binary.Write LE)
-        in0 = list(range(16))
-        in1 = [1] * 16
-        req = pb.ModelInferRequest(model_name="simple", id="conformance-1")
-        for name in ("INPUT0", "INPUT1"):
-            t = req.inputs.add()
-            t.name = name
-            t.datatype = "INT32"
-            t.shape.extend([1, 16])
-        for out_name in ("OUTPUT0", "OUTPUT1"):
-            req.outputs.add().name = out_name
-        req.raw_input_contents.append(struct.pack("<16i", *in0))
-        req.raw_input_contents.append(struct.pack("<16i", *in1))
+    resp = rpc(channel, "ModelInfer", req, pb.ModelInferResponse)
+    assert resp.model_name == "simple", resp
+    assert resp.id == "conformance-1", resp
+    by_name = {o.name: i for i, o in enumerate(resp.outputs)}
+    # client.js BufferToInt32Array-style unpack of raw_output_contents
+    sums = struct.unpack("<16i", resp.raw_output_contents[by_name["OUTPUT0"]])
+    diffs = struct.unpack("<16i", resp.raw_output_contents[by_name["OUTPUT1"]])
+    for a, b, s, d in zip(in0, in1, sums, diffs):
+        assert s == a + b, f"sum mismatch {a}+{b} != {s}"
+        assert d == a - b, f"diff mismatch {a}-{b} != {d}"
 
-        resp = rpc(channel, "ModelInfer", req, pb.ModelInferResponse)
-        assert resp.model_name == "simple", resp
-        assert resp.id == "conformance-1", resp
-        by_name = {o.name: i for i, o in enumerate(resp.outputs)}
-        # client.js BufferToInt32Array-style unpack of raw_output_contents
-        sums = struct.unpack("<16i", resp.raw_output_contents[by_name["OUTPUT0"]])
-        diffs = struct.unpack("<16i", resp.raw_output_contents[by_name["OUTPUT1"]])
-        for a, b, s, d in zip(in0, in1, sums, diffs):
-            assert s == a + b, f"sum mismatch {a}+{b} != {s}"
-            assert d == a - b, f"diff mismatch {a}-{b} != {d}"
+    # -- bidi stream through the generic stream_stream method: two
+    #    interleaved sequences (simple_grpc_sequence_stream semantics),
+    #    still zero framework-client code.
+    stream = channel.stream_stream(
+        f"/{SERVICE}/ModelStreamInfer",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.ModelStreamInferResponse.FromString,
+    )
 
-        # -- bidi stream through the generic stream_stream method: two
-        #    interleaved sequences (simple_grpc_sequence_stream semantics),
-        #    still zero framework-client code.
-        stream = channel.stream_stream(
-            f"/{SERVICE}/ModelStreamInfer",
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb.ModelStreamInferResponse.FromString,
-        )
+    def seq_req(seq_id, value, start, end):
+        r = pb.ModelInferRequest(model_name="simple_sequence")
+        r.parameters["sequence_id"].int64_param = seq_id
+        r.parameters["sequence_start"].bool_param = start
+        r.parameters["sequence_end"].bool_param = end
+        t = r.inputs.add()
+        t.name = "INPUT"
+        t.datatype = "INT32"
+        t.shape.extend([1])
+        r.raw_input_contents.append(struct.pack("<i", value))
+        return r
 
-        def seq_req(seq_id, value, start, end):
-            r = pb.ModelInferRequest(model_name="simple_sequence")
-            r.parameters["sequence_id"].int64_param = seq_id
-            r.parameters["sequence_start"].bool_param = start
-            r.parameters["sequence_end"].bool_param = end
-            t = r.inputs.add()
-            t.name = "INPUT"
-            t.datatype = "INT32"
-            t.shape.extend([1])
-            r.raw_input_contents.append(struct.pack("<i", value))
-            return r
+    values = [11, 7, 5, 3, 2, 0, 1]
+    reqs = []
+    for i, v in enumerate(values):
+        start, end = i == 0, i == len(values) - 1
+        reqs.append(seq_req(1001, v, start, end))
+        reqs.append(seq_req(1002, -v, start, end))
+    acc1 = acc2 = 0
+    n_resp = 0
+    for out in stream(iter(reqs), timeout=60):
+        assert not out.error_message, out.error_message
+        (got,) = struct.unpack("<i", out.infer_response.raw_output_contents[0])
+        if got >= 0:
+            acc1 = got
+        else:
+            acc2 = got
+        n_resp += 1
+    assert n_resp == len(reqs), f"expected {len(reqs)} responses, got {n_resp}"
+    assert acc1 == sum(values), f"seq accumulator {acc1} != {sum(values)}"
+    assert acc2 == -sum(values), f"seq accumulator {acc2} != {-sum(values)}"
 
-        values = [11, 7, 5, 3, 2, 0, 1]
-        reqs = []
-        for i, v in enumerate(values):
-            start, end = i == 0, i == len(values) - 1
-            reqs.append(seq_req(1001, v, start, end))
-            reqs.append(seq_req(1002, -v, start, end))
-        acc1 = acc2 = 0
-        n_resp = 0
-        for out in stream(iter(reqs), timeout=60):
-            assert not out.error_message, out.error_message
-            (got,) = struct.unpack("<i", out.infer_response.raw_output_contents[0])
-            if got >= 0:
-                acc1 = got
-            else:
-                acc2 = got
-            n_resp += 1
-        assert n_resp == len(reqs), f"expected {len(reqs)} responses, got {n_resp}"
-        assert acc1 == sum(values), f"seq accumulator {acc1} != {sum(values)}"
-        assert acc2 == -sum(values), f"seq accumulator {acc2} != {-sum(values)}"
-
-        channel.close()
+    channel.close()
 
     print("PASS: wire conformance")
     return 0

@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Image classification on RAW generated stubs (reference
+grpc_image_client.py, 501 LoC — same app as image_client.py but built
+directly on service_pb2 messages, no client library):
+
+* fetches ModelMetadata/ModelConfig pb and validates a 1-in/1-out image
+  model (parse_model, reference :81-168),
+* preprocesses a PIL or synthetic image (reference :171-210),
+* packs the tensor into ``raw_input_contents`` and requests top-k
+  classification via the ``classification`` output parameter
+  (reference :278),
+* unpacks "score:index[:label]" BYTES strings from ``raw_output_contents``
+  (reference postprocess :213-243).
+
+Without an image argument it classifies a synthetic image and prints PASS.
+"""
+
+import argparse
+import struct
+import sys
+
+import grpc
+import numpy as np
+
+from _raw_stub import generate_stubs, rpc
+from triton_client_tpu.utils import (
+    deserialize_bytes_tensor,
+    triton_to_np_dtype,
+)
+
+
+def parse_model(meta, config):
+    """Validate 1-in/1-out image model from pb metadata+config (reference
+    grpc_image_client.py:81-168); returns (input, output, c, h, w, layout,
+    dtype, max_batch)."""
+    if len(meta.inputs) != 1:
+        raise Exception(f"expecting 1 input, got {len(meta.inputs)}")
+    if len(meta.outputs) != 1:
+        raise Exception(f"expecting 1 output, got {len(meta.outputs)}")
+    input_meta = meta.inputs[0]
+    output_meta = meta.outputs[0]
+    max_batch_size = config.config.max_batch_size
+
+    shape = list(input_meta.shape)
+    if max_batch_size > 0:
+        shape = shape[1:]
+    if len(shape) != 3:
+        raise Exception(f"expecting input rank 3, got {shape}")
+    if shape[0] in (1, 3):
+        layout, (c, h, w) = "CHW", shape
+    elif shape[2] in (1, 3):
+        layout, (h, w, c) = "HWC", shape
+    else:
+        raise Exception(f"cannot infer layout from shape {shape}")
+    return (input_meta.name, output_meta.name, c, h, w, layout,
+            input_meta.datatype, max_batch_size)
+
+
+def preprocess(img, layout, dtype, c, h, w, scaling):
+    """PIL image -> network-ready ndarray (reference :171-210)."""
+    if c == 1:
+        img = img.convert("L")
+    else:
+        img = img.convert("RGB")
+    img = img.resize((w, h))
+    npdtype = triton_to_np_dtype(dtype)
+    typed = np.array(img).astype(npdtype)
+    if c == 1:
+        typed = typed[:, :, np.newaxis]
+    if scaling == "INCEPTION":
+        scaled = (typed / 127.5) - 1
+    elif scaling == "VGG":
+        if c == 1:
+            scaled = typed - 128
+        else:
+            scaled = typed - np.asarray((123, 117, 104), dtype=npdtype)
+    else:
+        scaled = typed
+    if layout == "CHW":
+        scaled = np.transpose(scaled, (2, 0, 1))
+    return scaled.astype(npdtype)
+
+
+def synthetic_batch(c, h, w, layout, dtype, batch):
+    npdtype = triton_to_np_dtype(dtype)
+    rng = np.random.default_rng(20240101)
+    shape = (c, h, w) if layout == "CHW" else (h, w, c)
+    return [rng.standard_normal(shape).astype(npdtype) for _ in range(batch)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("image_filename", nargs="?", default=None)
+    ap.add_argument("-m", "--model-name", default="simple_cnn")
+    ap.add_argument("-x", "--model-version", default="")
+    ap.add_argument("-b", "--batch-size", type=int, default=1)
+    ap.add_argument("-c", "--classes", type=int, default=3)
+    ap.add_argument("-s", "--scaling", default="NONE",
+                    choices=["NONE", "INCEPTION", "VGG"])
+    ap.add_argument("-u", "--url", default="localhost:8001")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    pb = generate_stubs()
+    channel = grpc.insecure_channel(args.url)
+
+    meta = rpc(channel, "ModelMetadata",
+               pb.ModelMetadataRequest(name=args.model_name,
+                                       version=args.model_version),
+               pb.ModelMetadataResponse)
+    config = rpc(channel, "ModelConfig",
+                 pb.ModelConfigRequest(name=args.model_name,
+                                       version=args.model_version),
+                 pb.ModelConfigResponse)
+    (input_name, output_name, c, h, w, layout, dtype,
+     max_batch) = parse_model(meta, config)
+
+    if args.image_filename:
+        from PIL import Image
+        img = Image.open(args.image_filename)
+        images = [preprocess(img, layout, dtype, c, h, w, args.scaling)
+                  for _ in range(args.batch_size)]
+    else:
+        images = synthetic_batch(c, h, w, layout, dtype, args.batch_size)
+
+    batched = np.stack(images, axis=0)
+    if max_batch == 0:
+        batched = batched[0]
+
+    req = pb.ModelInferRequest(model_name=args.model_name,
+                               model_version=args.model_version)
+    t = req.inputs.add()
+    t.name = input_name
+    t.datatype = dtype
+    t.shape.extend(list(batched.shape))
+    req.raw_input_contents.append(batched.tobytes())
+    out = req.outputs.add()
+    out.name = output_name
+    out.parameters["classification"].int64_param = args.classes
+
+    resp = rpc(channel, "ModelInfer", req, pb.ModelInferResponse)
+    if len(resp.raw_output_contents) != 1:
+        sys.exit(f"expected 1 output, got {len(resp.raw_output_contents)}")
+    results = deserialize_bytes_tensor(resp.raw_output_contents[0])
+    results = results.reshape(-1, args.classes) if max_batch > 0 else \
+        results.reshape(1, args.classes)
+
+    for b, row in enumerate(results):
+        print(f"Image {b}:")
+        for cls in row:
+            s = cls.decode()
+            print(f"    {s}")
+            score = float(s.split(":")[0])
+            if not np.isfinite(score):
+                sys.exit("error: non-finite classification score")
+    if results.shape[0] != (args.batch_size if max_batch > 0 else 1):
+        sys.exit("error: wrong result count")
+    print("PASS: grpc_image_client")
+
+
+if __name__ == "__main__":
+    main()

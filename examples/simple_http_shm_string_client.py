@@ -1,0 +1,96 @@
+#!/usr/bin/env python3
+"""Serialized BYTES tensors through system shm over HTTP against the
+``simple_string`` sum/diff model (reference simple_http_shm_string_client.py:
+both inputs AND both outputs live in shm regions :107-160; numeric strings are
+length-prefix serialized into the input regions, results are deserialized out
+of the output regions, and the example asserts no regions leak)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import triton_client_tpu.http as httpclient
+import triton_client_tpu.utils.shared_memory as shm
+from triton_client_tpu.utils import serialize_byte_tensor, serialized_byte_size
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url, verbose=args.verbose)
+    # start from a clean slate so stale registrations can't mask failures
+    client.unregister_system_shared_memory()
+
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.ones(16, dtype=np.int32)
+    in0_str = np.array(
+        [str(x).encode() for x in in0], dtype=object).reshape(1, 16)
+    in1_str = np.array(
+        [str(x).encode() for x in in1], dtype=object).reshape(1, 16)
+    expect_sum = [str(x) for x in in0 + in1]
+    expect_diff = [str(x) for x in in0 - in1]
+
+    in0_ser = serialize_byte_tensor(in0_str)
+    in1_ser = serialize_byte_tensor(in1_str)
+    in0_size = serialized_byte_size(in0_str)
+    in1_size = serialized_byte_size(in1_str)
+    out_size = max(in0_size, in1_size) + 64  # room for sum/diff digits
+
+    handles = {}
+    try:
+        for name, size in (("input0_data", in0_size), ("input1_data", in1_size),
+                           ("output0_data", out_size), ("output1_data", out_size)):
+            handles[name] = shm.create_shared_memory_region(
+                name, f"/{name}", size)
+            client.register_system_shared_memory(name, f"/{name}", size)
+        shm.set_shared_memory_region(handles["input0_data"], [in0_ser])
+        shm.set_shared_memory_region(handles["input1_data"], [in1_ser])
+
+        inputs = []
+        for name, region, size in (("INPUT0", "input0_data", in0_size),
+                                   ("INPUT1", "input1_data", in1_size)):
+            t = httpclient.InferInput(name, [1, 16], "BYTES")
+            t.set_shared_memory(region, size)
+            inputs.append(t)
+        outputs = []
+        for name, region in (("OUTPUT0", "output0_data"),
+                             ("OUTPUT1", "output1_data")):
+            o = httpclient.InferRequestedOutput(name)
+            o.set_shared_memory(region, out_size)
+            outputs.append(o)
+
+        results = client.infer("simple_string", inputs, outputs=outputs)
+
+        for oname, region, expect in (("OUTPUT0", "output0_data", expect_sum),
+                                      ("OUTPUT1", "output1_data", expect_diff)):
+            out = results.get_output(oname)
+            if out is None:
+                sys.exit(f"error: {oname} missing from response")
+            got = shm.get_contents_as_numpy(
+                handles[region], np.object_, [1, 16])
+            got_strs = [bytes(x).decode() for x in got.reshape(-1)]
+            for i, (g, e) in enumerate(zip(got_strs, expect)):
+                if g != e:
+                    sys.exit(f"error: {oname}[{i}] = {g}, expected {e}")
+
+        # leak check: exactly our four regions registered, then zero
+        status = client.get_system_shared_memory_status()
+        if len(status) != 4:
+            sys.exit(f"error: expected 4 registered regions, got {status}")
+        client.unregister_system_shared_memory()
+        status = client.get_system_shared_memory_status()
+        if len(status) != 0:
+            sys.exit(f"error: regions leaked after unregister: {status}")
+    finally:
+        for h in handles.values():
+            shm.destroy_shared_memory_region(h)
+        client.close()
+    print("PASS: system shared memory string")
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,55 @@ def make_simple() -> JaxModel:
     return JaxModel(cfg, fn)
 
 
+def make_simple_string() -> PyModel:
+    """Element-wise sum/diff over decimal-string tensors (the reference's
+    ``simple_string`` fixture, driven by grpc_explicit_byte_content_client.py:61-87
+    and simple_http_shm_string_client.py:78-104): BYTES in, BYTES out,
+    arithmetic on the parsed integers."""
+    cfg = make_config(
+        "simple_string",
+        inputs=[("INPUT0", "BYTES", [1, 16]), ("INPUT1", "BYTES", [1, 16])],
+        outputs=[("OUTPUT0", "BYTES", [1, 16]), ("OUTPUT1", "BYTES", [1, 16])],
+    )
+
+    def _ints(arr):
+        flat = np.asarray(arr, dtype=object).reshape(-1)
+        return np.array(
+            [int(v.decode() if isinstance(v, bytes) else v) for v in flat])
+
+    def fn(inputs, params):
+        shape = np.asarray(inputs["INPUT0"], dtype=object).shape
+
+        def enc(vals):
+            return np.array(
+                [str(int(v)).encode() for v in vals], dtype=object
+            ).reshape(shape)
+
+        a, b = _ints(inputs["INPUT0"]), _ints(inputs["INPUT1"])
+        return {"OUTPUT0": enc(a + b), "OUTPUT1": enc(a - b)}
+
+    return PyModel(cfg, fn)
+
+
+def make_simple_int8() -> JaxModel:
+    """INT8 sum/diff (the reference's ``simple_int8`` fixture, driven by
+    grpc_explicit_int8_content_client.py:59-87)."""
+    import jax.numpy as jnp
+
+    cfg = make_config(
+        "simple_int8",
+        inputs=[("INPUT0", "INT8", [1, 16]), ("INPUT1", "INT8", [1, 16])],
+        outputs=[("OUTPUT0", "INT8", [1, 16]), ("OUTPUT1", "INT8", [1, 16])],
+        instance_kind="KIND_CPU",
+    )
+
+    def fn(INPUT0, INPUT1):
+        return {"OUTPUT0": jnp.add(INPUT0, INPUT1),
+                "OUTPUT1": jnp.subtract(INPUT0, INPUT1)}
+
+    return JaxModel(cfg, fn)
+
+
 def make_simple_identity() -> PyModel:
     cfg = make_config(
         "simple_identity",
@@ -379,6 +428,8 @@ def register_all(registry: ModelRegistry) -> None:
     registry.register_model(language.make_llama_tpu())
     registry.register_model(language.make_llama_postprocess())
     registry.register_model(language.make_ensemble_llama())
+    registry.register_model(make_simple_string())
+    registry.register_model(make_simple_int8())
     registry.register_model(make_simple_identity())
     registry.register_model(make_custom_identity_int32())
     registry.register_model(make_identity_fp32())
