@@ -1,0 +1,81 @@
+// Ensemble DAG inference from C++ (reference
+// src/c++/examples/ensemble_image_client.cc behavior: the client sends raw
+// tensors and the server executes the multi-step pipeline declared in
+// ensemble_scheduling; here the zoo's scale->sum/diff ensemble).
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i)
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  std::vector<int32_t> raw0(16), raw1(16);
+  for (int i = 0; i < 16; ++i) {
+    raw0[i] = i;
+    raw1[i] = 1;
+  }
+  tc::InferInput *i0, *i1;
+  tc::InferInput::Create(&i0, "RAW0", {1, 16}, "INT32");
+  tc::InferInput::Create(&i1, "RAW1", {1, 16}, "INT32");
+  i0->AppendRaw(reinterpret_cast<const uint8_t*>(raw0.data()), 16 * 4);
+  i1->AppendRaw(reinterpret_cast<const uint8_t*>(raw1.data()), 16 * 4);
+  tc::InferRequestedOutput *sum_out, *diff_out;
+  tc::InferRequestedOutput::Create(&sum_out, "SUM");
+  tc::InferRequestedOutput::Create(&diff_out, "DIFF");
+
+  tc::InferOptions options("ensemble_scale_sum");
+  tc::InferResult* result = nullptr;
+  err = client->Infer(&result, options, {i0, i1}, {sum_out, diff_out});
+  if (!err.IsOk()) {
+    fprintf(stderr, "infer failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  // the ensemble scales RAW0 by two before the sum/diff member
+  const uint8_t* buf;
+  size_t len;
+  if (!result->RawData("SUM", &buf, &len).IsOk() || len != 64) {
+    fprintf(stderr, "bad SUM\n");
+    return 1;
+  }
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (sums[i] != raw0[i] * 2 + raw1[i]) {
+      fprintf(stderr, "ensemble sum mismatch at %d: %d\n", i, sums[i]);
+      return 1;
+    }
+  }
+  if (!result->RawData("DIFF", &buf, &len).IsOk() || len != 64) {
+    fprintf(stderr, "bad DIFF\n");
+    return 1;
+  }
+  const int32_t* diffs = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (diffs[i] != raw0[i] * 2 - raw1[i]) {
+      fprintf(stderr, "ensemble diff mismatch at %d: %d\n", i, diffs[i]);
+      return 1;
+    }
+  }
+  delete result;
+  delete sum_out;
+  delete diff_out;
+  delete i0;
+  delete i1;
+  printf("PASS: ensemble image\n");
+  return 0;
+}

@@ -1,0 +1,182 @@
+// Image classification CLI (reference src/c++/examples/image_client.cc —
+// the application-level behavioral spec, SURVEY.md §3.6, compacted):
+//
+// * fetches model metadata + config JSON and validates a 1-in/1-out image
+//   model (CHW/HWC layout, optional batch dim),
+// * builds a deterministic synthetic image batch (no image file needed, so
+//   this doubles as an executable acceptance test),
+// * requests top-k classification ("score:index[:label]" strings) via
+//   InferRequestedOutput's class_count,
+// * decodes the length-prefixed BYTES classification tensor.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+#include "json.h"
+
+namespace tc = tc_tpu::client;
+namespace js = tc_tpu::json;
+
+struct ModelInfo {
+  std::string input_name, output_name, dtype, layout;
+  int64_t c = 0, h = 0, w = 0;
+  int max_batch = 0;
+};
+
+static bool ParseModel(
+    const std::string& meta_json, const std::string& config_json,
+    ModelInfo* info, std::string* why) {
+  js::Value meta, config;
+  std::string err;
+  if (!js::Parse(meta_json, &meta, &err) ||
+      !js::Parse(config_json, &config, &err)) {
+    *why = "bad JSON: " + err;
+    return false;
+  }
+  const auto& inputs = meta.At("inputs").AsArray();
+  const auto& outputs = meta.At("outputs").AsArray();
+  if (inputs.size() != 1 || outputs.size() != 1) {
+    *why = "expecting 1 input / 1 output";
+    return false;
+  }
+  info->input_name = inputs[0].At("name").AsString();
+  info->output_name = outputs[0].At("name").AsString();
+  info->dtype = inputs[0].At("datatype").AsString();
+  info->max_batch =
+      static_cast<int>(config.At("max_batch_size").AsInt());
+  std::vector<int64_t> shape;
+  for (const auto& d : inputs[0].At("shape").AsArray())
+    shape.push_back(d.AsInt());
+  if (info->max_batch > 0) shape.erase(shape.begin());
+  if (shape.size() != 3) {
+    *why = "expecting input rank 3";
+    return false;
+  }
+  if (shape[0] == 1 || shape[0] == 3) {
+    info->layout = "CHW";
+    info->c = shape[0];
+    info->h = shape[1];
+    info->w = shape[2];
+  } else if (shape[2] == 1 || shape[2] == 3) {
+    info->layout = "HWC";
+    info->h = shape[0];
+    info->w = shape[1];
+    info->c = shape[2];
+  } else {
+    *why = "cannot infer CHW/HWC layout";
+    return false;
+  }
+  if (info->dtype != "FP32") {
+    *why = "expecting FP32 input, got " + info->dtype;
+    return false;
+  }
+  return true;
+}
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  std::string model_name = "simple_cnn";
+  int batch = 1, classes = 3;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+    if (strcmp(argv[i], "-m") == 0) model_name = argv[i + 1];
+    if (strcmp(argv[i], "-b") == 0) batch = atoi(argv[i + 1]);
+    if (strcmp(argv[i], "-c") == 0) classes = atoi(argv[i + 1]);
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  std::string meta_json, config_json;
+  err = client->ModelMetadata(&meta_json, model_name);
+  if (err.IsOk()) err = client->ModelConfig(&config_json, model_name);
+  if (!err.IsOk()) {
+    fprintf(stderr, "metadata/config failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  ModelInfo info;
+  std::string why;
+  if (!ParseModel(meta_json, config_json, &info, &why)) {
+    fprintf(stderr, "model validation failed: %s\n", why.c_str());
+    return 1;
+  }
+  if (info.max_batch == 0) batch = 1;
+
+  // deterministic synthetic image batch
+  const size_t pixels = static_cast<size_t>(info.c * info.h * info.w);
+  std::vector<float> data(static_cast<size_t>(batch) * pixels);
+  for (size_t i = 0; i < data.size(); ++i)
+    data[i] = static_cast<float>((i * 2654435761u) % 255) / 127.5f - 1.0f;
+
+  std::vector<int64_t> shape;
+  if (info.max_batch > 0) shape.push_back(batch);
+  if (info.layout == "CHW") {
+    shape.insert(shape.end(), {info.c, info.h, info.w});
+  } else {
+    shape.insert(shape.end(), {info.h, info.w, info.c});
+  }
+  tc::InferInput* in;
+  tc::InferInput::Create(&in, info.input_name, shape, "FP32");
+  in->AppendRaw(reinterpret_cast<const uint8_t*>(data.data()),
+                data.size() * sizeof(float));
+  tc::InferRequestedOutput* out;
+  tc::InferRequestedOutput::Create(&out, info.output_name,
+                                   static_cast<size_t>(classes));
+
+  tc::InferOptions options(model_name);
+  tc::InferResult* result = nullptr;
+  err = client->Infer(&result, options, {in}, {out});
+  if (!err.IsOk()) {
+    fprintf(stderr, "infer failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  // classification tensor: length-prefixed "score:index[:label]" strings
+  const uint8_t* buf;
+  size_t len;
+  err = result->RawData(info.output_name, &buf, &len);
+  if (!err.IsOk()) {
+    fprintf(stderr, "bad classification output: %s\n", err.Message().c_str());
+    return 1;
+  }
+  size_t off = 0;
+  int n_strings = 0;
+  int expect = batch * classes;
+  while (off + 4 <= len && n_strings < expect) {
+    uint32_t slen;
+    memcpy(&slen, buf + off, 4);
+    off += 4;
+    if (off + slen > len) {
+      fprintf(stderr, "truncated classification string\n");
+      return 1;
+    }
+    std::string s(reinterpret_cast<const char*>(buf + off), slen);
+    off += slen;
+    if (n_strings % classes == 0)
+      printf("Image %d:\n", n_strings / classes);
+    printf("    %s\n", s.c_str());
+    // sanity: leading float score then ':'
+    if (s.find(':') == std::string::npos) {
+      fprintf(stderr, "malformed classification '%s'\n", s.c_str());
+      return 1;
+    }
+    ++n_strings;
+  }
+  if (n_strings != expect) {
+    fprintf(stderr, "expected %d classification strings, got %d\n", expect,
+            n_strings);
+    return 1;
+  }
+  delete result;
+  delete out;
+  delete in;
+  printf("PASS: image client\n");
+  return 0;
+}

@@ -1,0 +1,66 @@
+// Stateful sequence over unary HTTP calls (reference
+// src/c++/examples/simple_http_sequence_sync_infer_client.cc behavior):
+// correlation id + start/end flags on ordinary Infer requests, two
+// interleaved sequences verified by their accumulators.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i)
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::vector<int32_t> values{11, 7, 5, 3, 2, 0, 1};
+  int32_t acc_pos = 0, acc_neg = 0;
+  for (size_t i = 0; i < values.size(); ++i) {
+    for (int sign : {+1, -1}) {
+      int32_t v = sign * values[i];
+      tc::InferInput* in;
+      tc::InferInput::Create(&in, "INPUT", {1}, "INT32");
+      in->AppendRaw(reinterpret_cast<const uint8_t*>(&v), sizeof(int32_t));
+      tc::InferOptions options("simple_sequence");
+      options.sequence_id_ = sign > 0 ? 61 : 62;
+      options.sequence_start_ = (i == 0);
+      options.sequence_end_ = (i == values.size() - 1);
+      tc::InferResult* result = nullptr;
+      err = client->Infer(&result, options, {in});
+      if (!err.IsOk()) {
+        fprintf(stderr, "infer failed: %s\n", err.Message().c_str());
+        return 1;
+      }
+      const uint8_t* buf;
+      size_t len;
+      err = result->RawData("OUTPUT", &buf, &len);
+      if (!err.IsOk() || len < 4) {
+        fprintf(stderr, "bad OUTPUT: %s\n", err.Message().c_str());
+        return 1;
+      }
+      int32_t out;
+      memcpy(&out, buf, 4);
+      (sign > 0 ? acc_pos : acc_neg) = out;
+      delete result;
+      delete in;
+    }
+  }
+  int32_t expected = 0;
+  for (int32_t v : values) expected += v;
+  if (acc_pos != expected || acc_neg != -expected) {
+    fprintf(stderr, "accumulators %d/%d != ±%d\n", acc_pos, acc_neg, expected);
+    return 1;
+  }
+  printf("PASS: http sequence sync (acc=%d/%d)\n", acc_pos, acc_neg);
+  return 0;
+}

@@ -197,6 +197,55 @@ Error InferenceServerGrpcClient::Create(
   return Error::Success;
 }
 
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client,
+    const std::string& server_url, bool verbose,
+    const KeepAliveOptions& keepalive_options) {
+  TC_RETURN_IF_ERROR(Create(client, server_url, verbose));
+  // INT_MAX means "disabled", matching gRPC's default
+  if (keepalive_options.keepalive_time_ms > 0 &&
+      keepalive_options.keepalive_time_ms != 0x7fffffff) {
+    int idle_s = keepalive_options.keepalive_time_ms / 1000;
+    int intvl_s = keepalive_options.keepalive_timeout_ms / 1000;
+    (*client)->transport_->SetTcpKeepAlive(
+        idle_s > 0 ? idle_s : 1, intvl_s > 0 ? intvl_s : 1);
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client,
+    const std::string& server_url, const ChannelArguments& channel_args,
+    bool verbose) {
+  KeepAliveOptions ka;
+  for (const auto& kv : channel_args.args()) {
+    if (kv.first == "grpc.keepalive_time_ms") {
+      ka.keepalive_time_ms = atoi(kv.second.c_str());
+    } else if (kv.first == "grpc.keepalive_timeout_ms") {
+      ka.keepalive_timeout_ms = atoi(kv.second.c_str());
+    }
+  }
+  // delegates so the ms→s keepalive translation lives in ONE place
+  TC_RETURN_IF_ERROR(Create(client, server_url, verbose, ka));
+  for (const auto& kv : channel_args.args()) {
+    if (kv.first == "grpc.max_receive_message_length") {
+      long cap = atol(kv.second.c_str());
+      if (cap > 0)
+        (*client)->transport_->SetMaxResponseBytes(static_cast<size_t>(cap));
+    } else if (kv.first == "grpc.max_send_message_length") {
+      long cap = atol(kv.second.c_str());
+      if (cap > 0)
+        (*client)->transport_->SetMaxRequestBytes(static_cast<size_t>(cap));
+    } else if (
+        kv.first != "grpc.keepalive_time_ms" &&
+        kv.first != "grpc.keepalive_timeout_ms" && verbose) {
+      fprintf(stderr, "channel arg ignored by socket transport: %s=%s\n",
+              kv.first.c_str(), kv.second.c_str());
+    }
+  }
+  return Error::Success;
+}
+
 InferenceServerGrpcClient::InferenceServerGrpcClient(
     const std::string& url, bool verbose)
     : InferenceServerClient(verbose) {
@@ -658,7 +707,8 @@ Error InferenceServerGrpcClient::StartStream(
   auto conn = std::make_unique<DuplexConnection>();
   TC_RETURN_IF_ERROR(conn->Open(
       transport_->host(), transport_->port(),
-      std::string(kServicePath) + "/ModelStreamInfer", headers));
+      std::string(kServicePath) + "/ModelStreamInfer", headers,
+      transport_->keepalive_idle_s(), transport_->keepalive_intvl_s()));
   int status = 0;
   Headers resp_headers;
   TC_RETURN_IF_ERROR(conn->ReadResponseHeaders(&status, &resp_headers));

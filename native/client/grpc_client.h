@@ -38,6 +38,45 @@ namespace pb = ::inference;
 
 class InferResultGrpc;
 
+// gRPC keepalive knobs (reference grpc_client.h:62-86). On this gRPC-Web
+// socket transport, HTTP/2 keepalive pings translate to TCP keepalive
+// probes: keepalive_time_ms → TCP_KEEPIDLE, keepalive_timeout_ms →
+// TCP_KEEPINTVL. keepalive_permit_without_calls keeps pooled idle
+// connections probed too (always true for a TCP-level probe);
+// http2_max_pings_without_data has no HTTP/1.1 equivalent and is accepted
+// for API compatibility.
+struct KeepAliveOptions {
+  int keepalive_time_ms = 0x7fffffff;
+  int keepalive_timeout_ms = 20000;
+  bool keepalive_permit_without_calls = false;
+  int http2_max_pings_without_data = 2;
+};
+
+// Generic channel-argument list (reference grpc::ChannelArguments used by
+// simple_grpc_custom_args_client.cc:105-116). Recognized keys map onto the
+// socket transport; unrecognized keys are kept (visible via args()) and
+// ignored, matching gRPC's pass-through semantics for unknown args.
+class ChannelArguments {
+ public:
+  void SetInt(const std::string& key, int value) {
+    args_[key] = std::to_string(value);
+  }
+  void SetString(const std::string& key, const std::string& value) {
+    args_[key] = value;
+  }
+  // named for parity with grpc::ChannelArguments
+  void SetMaxSendMessageSize(int bytes) {
+    SetInt("grpc.max_send_message_length", bytes);
+  }
+  void SetMaxReceiveMessageSize(int bytes) {
+    SetInt("grpc.max_receive_message_length", bytes);
+  }
+  const std::map<std::string, std::string>& args() const { return args_; }
+
+ private:
+  std::map<std::string, std::string> args_;
+};
+
 class InferenceServerGrpcClient : public InferenceServerClient {
  public:
   using OnCompleteFn = std::function<void(InferResult*)>;
@@ -45,6 +84,18 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
       const std::string& server_url, bool verbose = false);
+  // keepalive-configured channel (reference grpc_client.cc Create overload
+  // with KeepAliveOptions)
+  static Error Create(
+      std::unique_ptr<InferenceServerGrpcClient>* client,
+      const std::string& server_url, bool verbose,
+      const KeepAliveOptions& keepalive_options);
+  // custom channel arguments (reference Create overload taking
+  // grpc::ChannelArguments)
+  static Error Create(
+      std::unique_ptr<InferenceServerGrpcClient>* client,
+      const std::string& server_url, const ChannelArguments& channel_args,
+      bool verbose = false);
   ~InferenceServerGrpcClient() override;
 
   Error IsServerLive(bool* live, const Headers& headers = Headers());

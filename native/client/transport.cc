@@ -202,6 +202,19 @@ std::string Base64Encode(const uint8_t* data, size_t len) {
 HttpTransport::HttpTransport(std::string host, int port, size_t max_idle_conns)
     : host_(std::move(host)), port_(port), max_idle_(max_idle_conns) {}
 
+void HttpTransport::SetTcpKeepAlive(int idle_s, int intvl_s) {
+  keepalive_idle_s_ = idle_s > 0 ? idle_s : 0;
+  keepalive_intvl_s_ = intvl_s > 0 ? intvl_s : 0;
+}
+
+void HttpTransport::SetMaxResponseBytes(size_t max_bytes) {
+  max_response_bytes_ = max_bytes;
+}
+
+void HttpTransport::SetMaxRequestBytes(size_t max_bytes) {
+  max_request_bytes_ = max_bytes;
+}
+
 HttpTransport::~HttpTransport() {
   std::lock_guard<std::mutex> lk(mu_);
   for (int fd : idle_) ::close(fd);
@@ -225,6 +238,11 @@ Error HttpTransport::Request(
     const std::string& method, const std::string& path,
     const std::string& body, const Headers& extra_headers, Response* out,
     RequestTimers* timers, uint64_t timeout_us) {
+  if (max_request_bytes_ > 0 && body.size() > max_request_bytes_) {
+    return Error(
+        "request exceeds maximum send message size of " +
+        std::to_string(max_request_bytes_) + " bytes");
+  }
   Deadline dl = Deadline::In(timeout_us);
   Error err;
   int fd = -1;
@@ -238,6 +256,15 @@ Error HttpTransport::Request(
   if (fd < 0) {
     fd = ConnectTcp(host_, port_, &err, dl);
     if (fd < 0) return err;
+    if (keepalive_idle_s_ > 0) {
+      int one = 1;
+      ::setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+      ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &keepalive_idle_s_,
+                   sizeof(keepalive_idle_s_));
+      if (keepalive_intvl_s_ > 0)
+        ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &keepalive_intvl_s_,
+                     sizeof(keepalive_intvl_s_));
+    }
   }
 
   std::ostringstream req;
@@ -315,6 +342,15 @@ Error HttpTransport::Request(
 
   std::string resp_body;
   bool keep_alive = true;
+  auto over_cap = [this](size_t sz) {
+    return max_response_bytes_ > 0 && sz > max_response_bytes_;
+  };
+  auto cap_error = [this, &fd]() {
+    Release(fd, false);
+    return Error(
+        "response exceeds maximum receive message size of " +
+        std::to_string(max_response_bytes_) + " bytes");
+  };
   auto te = resp_headers.find("transfer-encoding");
   if (te != resp_headers.end() &&
       LowerCopy(te->second).find("chunked") != std::string::npos) {
@@ -334,6 +370,9 @@ Error HttpTransport::Request(
       }
       size_t chunk_len =
           strtoul(stream.substr(pos, nl - pos).c_str(), nullptr, 16);
+      // enforce the cap on the DECLARED size before buffering the chunk —
+      // one huge chunk must not be allocated just to be rejected
+      if (over_cap(resp_body.size() + chunk_len)) return cap_error();
       size_t data_start = nl + 2;
       while (stream.size() < data_start + chunk_len + 2) {
         ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
@@ -346,6 +385,7 @@ Error HttpTransport::Request(
       }
       if (chunk_len == 0) break;
       resp_body.append(stream, data_start, chunk_len);
+      if (over_cap(resp_body.size())) return cap_error();
       pos = data_start + chunk_len + 2;
     }
   } else {
@@ -353,6 +393,7 @@ Error HttpTransport::Request(
     resp_body = std::move(rest);
     if (cl != resp_headers.end()) {
       size_t want = strtoul(cl->second.c_str(), nullptr, 10);
+      if (over_cap(want)) return cap_error();
       if (resp_body.size() < want) {
         size_t missing = want - resp_body.size();
         size_t old = resp_body.size();
@@ -376,6 +417,7 @@ Error HttpTransport::Request(
       // the body runs until the peer cleanly closes the connection.  Only
       // an orderly FIN (r == 0) terminates the body; a socket error means
       // the response was truncated.
+      if (over_cap(resp_body.size())) return cap_error();
       for (;;) {
         ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
         if (r == 0) break;
@@ -386,6 +428,7 @@ Error HttpTransport::Request(
                       : "connection error while reading response body");
         }
         resp_body.append(chunk, static_cast<size_t>(r));
+        if (over_cap(resp_body.size())) return cap_error();
       }
       keep_alive = false;
     }
@@ -422,10 +465,20 @@ void DuplexConnection::Close() {
 
 Error DuplexConnection::Open(
     const std::string& host, int port, const std::string& path,
-    const Headers& extra_headers) {
+    const Headers& extra_headers, int keepalive_idle_s,
+    int keepalive_intvl_s) {
   Error err;
   fd_ = ConnectTcp(host, port, &err);
   if (fd_ < 0) return err;
+  if (keepalive_idle_s > 0) {
+    int one = 1;
+    ::setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_KEEPIDLE, &keepalive_idle_s,
+                 sizeof(keepalive_idle_s));
+    if (keepalive_intvl_s > 0)
+      ::setsockopt(fd_, IPPROTO_TCP, TCP_KEEPINTVL, &keepalive_intvl_s,
+                   sizeof(keepalive_intvl_s));
+  }
 
   std::ostringstream req;
   req << "POST /" << path << " HTTP/1.1\r\n";

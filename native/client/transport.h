@@ -26,6 +26,26 @@ class HttpTransport {
   HttpTransport(std::string host, int port, size_t max_idle_conns);
   ~HttpTransport();
 
+  // Enable TCP-level keepalive probes on every unary connection this
+  // transport opens (streaming DuplexConnections read the settings via the
+  // accessors below and apply them at Open). This is the socket-transport
+  // translation of gRPC's HTTP/2 keepalive pings (reference
+  // KeepAliveOptions, grpc_client.h:62-86): idle_s before the first probe,
+  // intvl_s between probes.
+  void SetTcpKeepAlive(int idle_s, int intvl_s);
+  int keepalive_idle_s() const { return keepalive_idle_s_; }
+  int keepalive_intvl_s() const { return keepalive_intvl_s_; }
+
+  // Cap the accepted response body size in bytes (reference
+  // GRPC_ARG_MAX_RECEIVE_MESSAGE_LENGTH); 0 = unlimited.
+  void SetMaxResponseBytes(size_t max_bytes);
+  size_t max_response_bytes() const { return max_response_bytes_; }
+
+  // Cap the request body size in bytes (reference
+  // GRPC_ARG_MAX_SEND_MESSAGE_LENGTH); 0 = unlimited.
+  void SetMaxRequestBytes(size_t max_bytes);
+  size_t max_request_bytes() const { return max_request_bytes_; }
+
   HttpTransport(const HttpTransport&) = delete;
   HttpTransport& operator=(const HttpTransport&) = delete;
 
@@ -46,6 +66,10 @@ class HttpTransport {
   std::string host_;
   int port_;
   size_t max_idle_;
+  int keepalive_idle_s_ = 0;   // 0 = TCP keepalive disabled
+  int keepalive_intvl_s_ = 0;
+  size_t max_response_bytes_ = 0;
+  size_t max_request_bytes_ = 0;
   std::mutex mu_;
   std::vector<int> idle_;
 };
@@ -67,9 +91,12 @@ class DuplexConnection {
   DuplexConnection& operator=(const DuplexConnection&) = delete;
 
   // Connects and sends the request headers (Transfer-Encoding: chunked).
+  // keepalive_idle_s > 0 enables TCP keepalive probes on the (long-lived)
+  // stream socket — the connection keepalive matters most for.
   Error Open(
       const std::string& host, int port, const std::string& path,
-      const Headers& extra_headers);
+      const Headers& extra_headers, int keepalive_idle_s = 0,
+      int keepalive_intvl_s = 0);
   // Sends one chunk of request body (thread-safe w.r.t. reads, not writes).
   Error WriteChunk(const std::string& data);
   // Sends the terminal zero chunk: request body complete.

@@ -64,6 +64,9 @@ def _run(binary, url, timeout=180):
     "simple_http_async_infer_client",
     "simple_http_shm_client",
     "simple_http_cudashm_client",
+    "simple_http_sequence_sync_infer_client",
+    "image_client",
+    "ensemble_image_client",
     "reuse_infer_objects_client",
 ])
 def test_cpp_http_example(native_build, harness, example):
@@ -83,6 +86,8 @@ def test_cpp_http_example(native_build, harness, example):
     "simple_grpc_custom_repeat",
     "simple_grpc_shm_client",
     "simple_grpc_cudashm_client",
+    "simple_grpc_keepalive_client",
+    "simple_grpc_custom_args_client",
 ])
 def test_cpp_grpc_example(native_build, harness, example):
     # the C++ gRPC client rides the grpc-web bridge on the HTTP port
