@@ -73,6 +73,61 @@ def _measure_rtt_floor() -> float:
     return float(np.median(samples) * 1e3)
 
 
+def _measure_flash_attention() -> dict:
+    """Amortized pallas-vs-XLA causal attention at the long-context shape
+    (B4 H32 S2048 D128). Returns {} off-TPU; the remote-dispatch floor makes
+    single calls unmeasurable, so N kernel applications run inside one jit."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from triton_client_tpu.ops import (
+        flash_attention,
+        flash_attention_reference,
+    )
+
+    B, H, S, D, N = 4, 32, 2048, 128, 20
+    rng = np.random.default_rng(0)
+    base = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+
+    def loop(fn):
+        @jax.jit
+        def run(q, k, v):
+            def body(i, acc):
+                o = fn(q + (acc * 1e-6).astype(jnp.bfloat16), k, v)
+                return acc + jnp.sum(o.astype(jnp.float32)) * 1e-9
+            return lax.fori_loop(0, N, body, jnp.float32(0.0))
+        return run
+
+    out = {}
+    try:
+        for name, f in (
+            ("xla", lambda q, k, v: flash_attention_reference(
+                q, k, v, causal=True)),
+            ("pallas", lambda q, k, v: flash_attention(q, k, v, causal=True)),
+        ):
+            fn = loop(f)
+            float(fn(base, base, base))  # compile + warm
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(fn(base, base, base))
+                ts.append(time.perf_counter() - t0)
+            out[name] = float(np.median(ts)) / N * 1e3
+    except Exception as e:  # noqa: BLE001 — bench keeps going without it
+        err = {"flash_attn_error": str(e)[:120]}
+        if "xla" in out:  # keep the baseline leg that did complete
+            err["flash_attn_xla_s2048_ms"] = round(out["xla"], 3)
+        return err
+    return {
+        "flash_attn_s2048_ms": round(out["pallas"], 3),
+        "flash_attn_xla_s2048_ms": round(out["xla"], 3),
+        "flash_attn_speedup": round(out["xla"] / out["pallas"], 2),
+    }
+
+
 def main() -> int:
     from triton_client_tpu.grpc import InferenceServerClient, InferInput
     from triton_client_tpu.models import zoo
@@ -223,6 +278,7 @@ def main() -> int:
         "concurrency": 8,
         "tpu_concurrency": 256,
     }
+    out.update(_measure_flash_attention())
     if errors:
         out["errors"] = errors[:4]
     print(json.dumps(out))

@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -249,6 +250,10 @@ def _ring_attention(q, k, v, cfg: TransformerConfig):
     return out.astype(q.dtype)
 
 
+def _flash_enabled() -> bool:
+    return os.environ.get("TRITON_TPU_FLASH", "1") != "0"
+
+
 def _attn_apply(blk, x, cfg: TransformerConfig):
     h = _rmsnorm(x, blk["ln1"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bhsk", h, blk["wq"].astype(h.dtype))
@@ -257,7 +262,15 @@ def _attn_apply(blk, x, cfg: TransformerConfig):
     Sc = x.shape[1]
     positions = lax.axis_index("sp") * Sc + jnp.arange(Sc)
     q, k = _rope(q, k, positions, cfg.rope_theta)
-    o = _ring_attention(q, k, v, cfg)
+    if lax.axis_size("sp") == 1 and _flash_enabled():
+        # full sequence on-device: the pallas flash kernel (ops/) replaces
+        # the cross-device ring — identical online-softmax math, VMEM-tiled
+        # (the TPU serving path for bert_large / llama_tpu)
+        from ..ops import flash_attention
+
+        o = flash_attention(q, k, v, causal=True)
+    else:
+        o = _ring_attention(q, k, v, cfg)
     out = jnp.einsum("bhsk,hkd->bsd", o, blk["wo"].astype(o.dtype))
     out = lax.psum(out, "tp")
     return x + out
