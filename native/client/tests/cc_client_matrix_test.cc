@@ -447,12 +447,128 @@ void TestInferStatAccounting(const std::string& url) {
 
 }  // namespace
 
+// -- channel options: keepalive + message-size caps (reference
+// KeepAliveOptions grpc_client.h:62-86, grpc::ChannelArguments usage in
+// simple_grpc_custom_args_client.cc) --------------------------------------
+void TestChannelOptions(const std::string& url) {
+  // keepalive-configured client behaves identically for unary RPCs
+  {
+    tc::KeepAliveOptions ka;
+    ka.keepalive_time_ms = 5000;
+    ka.keepalive_timeout_ms = 1000;
+    ka.keepalive_permit_without_calls = true;
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url, false, ka));
+    auto in0 = Iota16();
+    std::vector<int32_t> in1(16, 1);
+    std::vector<tc::InferInput*> inputs;
+    MakeSimpleInputs(in0, in1, &inputs);
+    tc::InferOptions options("simple");
+    tc::InferResult* result = nullptr;
+    CHECK_OK(client->Infer(&result, options, inputs));
+    CheckSum(result, in0, in1);
+    delete result;
+    for (auto* in : inputs) delete in;
+  }
+  // a generous receive cap passes; a tiny one rejects with a clear error
+  for (int cap : {1 << 20, 64}) {
+    tc::ChannelArguments args;
+    args.SetMaxReceiveMessageSize(cap);
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url, args));
+    auto in0 = Iota16();
+    std::vector<int32_t> in1(16, 1);
+    std::vector<tc::InferInput*> inputs;
+    MakeSimpleInputs(in0, in1, &inputs);
+    tc::InferOptions options("simple");
+    tc::InferResult* result = nullptr;
+    tc::Error err = client->Infer(&result, options, inputs);
+    if (cap >= (1 << 20)) {
+      CHECK_OK(err);
+      CheckSum(result, in0, in1);
+      delete result;
+    } else {
+      CHECK_ERR(err);
+      CHECK_TRUE(err.Message().find("maximum receive message size") !=
+                 std::string::npos);
+    }
+    for (auto* in : inputs) delete in;
+  }
+  // the send cap rejects oversized request bodies client-side
+  {
+    tc::ChannelArguments args;
+    args.SetMaxSendMessageSize(16);
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url, args));
+    auto in0 = Iota16();
+    std::vector<int32_t> in1(16, 1);
+    std::vector<tc::InferInput*> inputs;
+    MakeSimpleInputs(in0, in1, &inputs);
+    tc::InferOptions options("simple");
+    tc::InferResult* result = nullptr;
+    tc::Error err = client->Infer(&result, options, inputs);
+    CHECK_ERR(err);
+    CHECK_TRUE(err.Message().find("maximum send message size") !=
+               std::string::npos);
+    for (auto* in : inputs) delete in;
+  }
+  // keepalive settings survive onto the duplex stream path: a streaming
+  // sequence still works with keepalive probes armed
+  {
+    tc::KeepAliveOptions ka;
+    ka.keepalive_time_ms = 5000;
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url, false, ka));
+    std::mutex mu;
+    std::condition_variable cv;
+    std::vector<int32_t> got;
+    CHECK_OK(client->StartStream([&](tc::InferResult* r) {
+      const uint8_t* buf;
+      size_t len;
+      if (r->RequestStatus().IsOk() && r->RawData("OUTPUT", &buf, &len).IsOk()
+          && len >= 4) {
+        int32_t v;
+        memcpy(&v, buf, 4);
+        std::lock_guard<std::mutex> lk(mu);
+        got.push_back(v);
+        cv.notify_all();
+      } else {
+        // surface the server's error immediately instead of burning the
+        // 30s wait and failing with only the count mismatch
+        fprintf(stderr, "stream result error: %s\n",
+                r->RequestStatus().Message().c_str());
+      }
+      delete r;
+    }));
+    for (int step = 0; step < 3; ++step) {
+      tc::InferInput* in;
+      int32_t v = step + 1;
+      CHECK_OK(tc::InferInput::Create(&in, "INPUT", {1}, "INT32"));
+      CHECK_OK(in->AppendRaw(reinterpret_cast<const uint8_t*>(&v), 4));
+      tc::InferOptions options("simple_sequence");
+      options.sequence_id_ = 4242;
+      options.sequence_start_ = (step == 0);
+      options.sequence_end_ = (step == 2);
+      CHECK_OK(client->AsyncStreamInfer(options, {in}));
+      delete in;
+    }
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      CHECK_TRUE(cv.wait_for(lk, std::chrono::seconds(30),
+                             [&] { return got.size() >= 3; }));
+    }
+    CHECK_OK(client->FinishStream());
+    CHECK_TRUE(got.back() == 1 + 2 + 3);  // accumulator semantics
+  }
+}
+
 int main(int argc, char** argv) {
   if (argc < 2) {
     fprintf(stderr, "usage: %s <http_host:port>\n", argv[0]);
     return 2;
   }
   const std::string url = argv[1];
+  TestChannelOptions(url);
   TestHttpCompression(url);
   TestReuseInferObjects(url);
   TestModelControl(url);
