@@ -134,6 +134,51 @@ class TestLlamaEnsemble:
         # (weights are fixed by seed)
 
 
+class TestLongContext:
+    def test_scores_through_serving_stack(self, harness):
+        # long-context proof shape: TOKENS [S] -> per-position next-token
+        # LOGPROBS [S] in one forward (tiny preset / S=512 on CPU; the TPU
+        # "base" preset serves S=4096 through the pallas flash kernel).
+        import triton_client_tpu.http as httpclient
+
+        S = language.longctx_seq_len()
+        rng = np.random.default_rng(5)
+        tokens = rng.integers(0, 256, (1, S)).astype(np.int32)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            inp = httpclient.InferInput("TOKENS", [1, S], "INT32")
+            inp.set_data_from_numpy(tokens)
+            r = c.infer("longctx_tpu", [inp])
+            lp = np.asarray(r.as_numpy("LOGPROBS"))
+        assert lp.shape == (1, S)
+        assert np.isfinite(lp).all()
+        assert (lp[:, :-1] <= 0.0).all()  # logprobs
+        assert lp[0, -1] == 0.0           # no next token at the last slot
+
+    def test_scores_depend_on_context(self, harness):
+        # causal scoring: perturbing an EARLY token changes later scores,
+        # while scores before the perturbation stay identical
+        import triton_client_tpu.http as httpclient
+
+        S = language.longctx_seq_len()
+        rng = np.random.default_rng(6)
+        base = rng.integers(0, 256, (1, S)).astype(np.int32)
+        edit = base.copy()
+        cut = S // 4
+        edit[0, cut] = (edit[0, cut] + 7) % 256
+
+        def score(arr):
+            with httpclient.InferenceServerClient(harness.http_url) as c:
+                inp = httpclient.InferInput("TOKENS", [1, S], "INT32")
+                inp.set_data_from_numpy(arr)
+                return np.asarray(c.infer("longctx_tpu", [inp])
+                                  .as_numpy("LOGPROBS"))
+
+        a, b = score(base), score(edit)
+        np.testing.assert_allclose(a[0, :cut - 1], b[0, :cut - 1],
+                                   rtol=1e-4, atol=1e-4)
+        assert not np.allclose(a[0, cut:], b[0, cut:])
+
+
 class TestPerfAnalyzerStreaming:
     def test_streaming_sweep(self, harness):
         from triton_client_tpu import perf_analyzer

@@ -50,6 +50,58 @@ _LLAMA_PRESETS = {
 BERT_SEQ_LEN = 384   # classic BERT-large SQuAD serving length
 LLAMA_SEQ_LEN = 128  # fixed context window for the generation ensemble
 
+# Long-context scorer: attention dominates at this window, so serving runs
+# through the pallas flash kernel (ops/flash_attention.py); the naive [S,S]
+# fp32 score path would burn 64MB/head-batch of HBM per layer at 4096.
+# Each preset carries its serving window so config and S can't drift.
+_LONGCTX_PRESETS = {
+    "tiny": (tr.TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, head_dim=16,
+        d_ff=128, n_experts=0), 512),
+    "base": (tr.TransformerConfig(
+        vocab_size=256, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
+        d_ff=4096, n_experts=0), 4096),
+}
+
+
+def _env_preset(var: str, presets, tpu_default: str, cpu_default: str) -> str:
+    """Resolve a TRITON_TPU_*_PRESET env override, else pick by platform.
+
+    Prefers the ``jax_platforms`` config value (set by the server CLI and
+    tests/conftest) — reading it does NOT initialize a backend — and only
+    falls back to ``jax.default_backend()`` (which does) when nothing pinned
+    the platform. Unknown names fail loudly with the env var spelled out."""
+    name = os.environ.get(var)
+    if name is None:
+        import jax
+
+        platforms = jax.config.jax_platforms
+        if platforms:
+            # ordered priority list (e.g. "axon,cpu"): the FIRST entry wins
+            first = platforms.split(",")[0].strip()
+            name = cpu_default if first == "cpu" else tpu_default
+        else:
+            name = (tpu_default if jax.default_backend() not in ("cpu",)
+                    else cpu_default)
+    if name not in presets:
+        raise ValueError(
+            f"{var}={name!r} is not a valid preset; choose one of "
+            f"{sorted(presets)}")
+    return name
+
+
+def _longctx_preset() -> str:
+    return _env_preset("TRITON_TPU_LONGCTX_PRESET", _LONGCTX_PRESETS,
+                       tpu_default="base", cpu_default="tiny")
+
+
+def longctx_cfg() -> tr.TransformerConfig:
+    return _LONGCTX_PRESETS[_longctx_preset()][0]
+
+
+def longctx_seq_len() -> int:
+    return _LONGCTX_PRESETS[_longctx_preset()][1]
+
 
 def n_params(cfg: tr.TransformerConfig) -> int:
     """Parameter count (dense FFN presets)."""
@@ -115,13 +167,46 @@ def make_bert_large() -> JaxModel:
     return JaxModel(cfg, fn, jit=False)
 
 
-def _llama_cfg() -> tr.TransformerConfig:
-    preset = os.environ.get("TRITON_TPU_LLAMA_PRESET")
-    if preset is None:
-        import jax
+def make_longctx_tpu() -> JaxModel:
+    """Long-context document scorer: INT32 TOKENS [S] → FP32 LOGPROBS [S]
+    (per-position logprob of the next provided token; last position 0).
 
-        preset = "1b" if jax.default_backend() not in ("cpu",) else "tiny"
-    return _LLAMA_PRESETS[preset]
+    S is 4096 on TPU backends ("base" preset) — the long-context serving
+    proof: attention dominates at this window and runs through the pallas
+    flash kernel. Scoring (not generation) keeps it one forward per
+    request, so it batches like bert_large rather than paying the
+    per-token stream RTT of ensemble_llama."""
+    S = longctx_seq_len()
+    cfg = make_config(
+        "longctx_tpu",
+        inputs=[("TOKENS", "INT32", [S])],
+        outputs=[("LOGPROBS", "FP32", [S])],
+        max_batch_size=4,
+        preferred_batch_sizes=[1, 2, 4],
+        max_queue_delay_us=2000,
+        instance_kind="KIND_TPU",
+    )
+    run = _LazyTransformer(longctx_cfg(), seed=11)
+
+    def fn(TOKENS):
+        import jax
+        import jax.numpy as jnp
+
+        tokens = jnp.clip(TOKENS, 0, run.cfg.vocab_size - 1)
+        logits = run(tokens)  # [B, S, vocab]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nxt = tokens[:, 1:]
+        scores = jnp.take_along_axis(
+            logp[:, :-1, :], nxt[..., None], axis=-1)[..., 0]
+        return {"LOGPROBS": jnp.pad(scores, ((0, 0), (0, 1)))}
+
+    return JaxModel(cfg, fn, jit=False)
+
+
+def _llama_cfg() -> tr.TransformerConfig:
+    return _LLAMA_PRESETS[_env_preset(
+        "TRITON_TPU_LLAMA_PRESET", _LLAMA_PRESETS,
+        tpu_default="1b", cpu_default="tiny")]
 
 
 def make_llama_preprocess() -> PyModel:

@@ -13,6 +13,17 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
+
+# The container's sitecustomize imports jax at interpreter startup, BEFORE
+# user env vars are consulted — so ``JAX_PLATFORMS=cpu python -m ...`` is
+# silently ignored and the server grabs the TPU. Re-apply the requested
+# platform through jax.config, which still works until a backend
+# initializes.
+if "JAX_PLATFORMS" in os.environ:
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from aiohttp import web
 
