@@ -203,6 +203,59 @@ def make_longctx_tpu() -> JaxModel:
     return JaxModel(cfg, fn, jit=False)
 
 
+# Mixture-of-experts scorer: serves the flagship stack's MoE FFN path
+# (router top-k + per-expert FFN + psum combine over ep) — expert parallel
+# in SERVING, not just the equivalence-tested training path.
+_MOE_PRESETS = {
+    "tiny": (tr.TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, head_dim=16,
+        d_ff=128, n_experts=4, moe_top_k=2), 128),
+    "base": (tr.TransformerConfig(
+        vocab_size=256, d_model=512, n_layers=4, n_heads=8, head_dim=64,
+        d_ff=2048, n_experts=8, moe_top_k=2), 256),
+}
+
+
+def _moe_preset() -> str:
+    return _env_preset("TRITON_TPU_MOE_PRESET", _MOE_PRESETS,
+                       tpu_default="base", cpu_default="tiny")
+
+
+def moe_cfg() -> tr.TransformerConfig:
+    return _MOE_PRESETS[_moe_preset()][0]
+
+
+def moe_seq_len() -> int:
+    return _MOE_PRESETS[_moe_preset()][1]
+
+
+def make_moe_tpu() -> JaxModel:
+    """MoE next-token model: INT32 TOKENS [S] → INT32 NEXT_TOKEN [1] +
+    FP32 NEXT_LOGIT [1], through the shared stack's expert-parallel FFN."""
+    S = moe_seq_len()
+    cfg = make_config(
+        "moe_tpu",
+        inputs=[("TOKENS", "INT32", [S])],
+        outputs=[("NEXT_TOKEN", "INT32", [1]), ("NEXT_LOGIT", "FP32", [1])],
+        max_batch_size=8,
+        preferred_batch_sizes=[1, 2, 4, 8],
+        max_queue_delay_us=2000,
+        instance_kind="KIND_TPU",
+    )
+    run = _LazyTransformer(moe_cfg(), seed=17)
+
+    def fn(TOKENS):
+        import jax.numpy as jnp
+
+        tokens = jnp.clip(TOKENS, 0, run.cfg.vocab_size - 1)
+        logits = run(tokens)[:, -1, :]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        best = jnp.max(logits, axis=-1).astype(jnp.float32)
+        return {"NEXT_TOKEN": nxt[:, None], "NEXT_LOGIT": best[:, None]}
+
+    return JaxModel(cfg, fn, jit=False)
+
+
 def _llama_cfg() -> tr.TransformerConfig:
     return _LLAMA_PRESETS[_env_preset(
         "TRITON_TPU_LLAMA_PRESET", _LLAMA_PRESETS,

@@ -429,6 +429,7 @@ def register_all(registry: ModelRegistry) -> None:
     registry.register_model(language.make_llama_postprocess())
     registry.register_model(language.make_ensemble_llama())
     registry.register_model(language.make_longctx_tpu())
+    registry.register_model(language.make_moe_tpu())
     registry.register_model(make_simple_string())
     registry.register_model(make_simple_int8())
     registry.register_model(make_simple_identity())
