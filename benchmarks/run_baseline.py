@@ -25,6 +25,13 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# sitecustomize pre-imports jax, so the env var alone is ignored (see
+# triton_client_tpu/server/__main__.py) — re-apply it
+if "JAX_PLATFORMS" in os.environ:
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 # v5e peak bf16 matmul throughput, per chip (public spec: 394 TFLOP/s).
 V5E_PEAK_FLOPS = 394e12
 

@@ -1,0 +1,188 @@
+"""KV-cache decode path (models/decode.py) vs the full-forward oracle."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from triton_client_tpu.models import decode, transformer as tr  # noqa: E402
+
+CFG = tr.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+    d_ff=64, n_experts=0)
+S_MAX = 24
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tr.init_params(jax.random.PRNGKey(7), CFG)
+
+
+def test_prefill_matches_full_forward(params):
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (2, 8)), jnp.int32)
+    prefill = decode.make_prefill(CFG, S_MAX)
+    logits, cache = prefill(params, toks)
+    want = decode.reference_forward(params, toks, CFG)[:, -1]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    assert int(cache["pos"]) == 8
+    assert cache["k"].shape == (CFG.n_layers, 2, CFG.n_heads, S_MAX,
+                                CFG.head_dim)
+
+
+def test_decode_steps_match_growing_forward(params):
+    """logits after prefill(P) + t decode steps == full forward over the
+    first P+t+1 tokens — the KV cache is exact, not an approximation."""
+    rng = np.random.default_rng(1)
+    all_toks = jnp.asarray(rng.integers(0, 64, (1, 14)), jnp.int32)
+    P = 6
+    prefill = decode.make_prefill(CFG, S_MAX)
+    step = decode.make_decode_step(CFG)
+
+    logits, cache = prefill(params, all_toks[:, :P])
+    for t in range(P, 14):
+        want = decode.reference_forward(params, all_toks[:, :t], CFG)[:, -1]
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(want), rtol=2e-4, atol=2e-4,
+            err_msg=f"mismatch at position {t}")
+        logits, cache = step(params, cache, all_toks[:, t:t + 1])
+    assert int(cache["pos"]) == 14
+
+
+def test_greedy_generation_consistency(params):
+    """Greedy continuation via the cache equals greedy continuation via
+    full recompute of the accumulated sequence."""
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, 64, (1, 5)), jnp.int32)
+    prefill = decode.make_prefill(CFG, S_MAX)
+    step = decode.make_decode_step(CFG)
+
+    # cached path
+    logits, cache = prefill(params, prompt)
+    cached_out = []
+    for _ in range(6):
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        cached_out.append(int(nxt[0]))
+        logits, cache = step(params, cache, nxt[:, None])
+
+    # recompute path over the growing absolute-position sequence
+    seq = prompt
+    recomp_out = []
+    for _ in range(6):
+        lg = decode.reference_forward(params, seq, CFG)[:, -1]
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        recomp_out.append(int(nxt[0]))
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+
+    assert cached_out == recomp_out
+
+
+class TestLlamaDecodeServing:
+    @pytest.fixture(scope="class")
+    def harness(self):
+        from triton_client_tpu.models import zoo
+        from triton_client_tpu.server.registry import ModelRegistry
+        from triton_client_tpu.server.testing import ServerHarness
+
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        h = ServerHarness(registry)
+        h.start()
+        yield h
+        h.stop()
+
+    def _window(self, text: bytes):
+        from triton_client_tpu.models import language
+
+        S = language.LLAMA_SEQ_LEN
+        out = np.zeros(S, np.int32)
+        b = np.frombuffer(text[-S:], np.uint8)
+        out[S - len(b):] = b
+        return out
+
+    def test_first_token_matches_window_model(self, harness):
+        """prefill(window) must greedy-pick the same token as llama_tpu's
+        full-window forward — same weights (seed 3), same absolute
+        positions, so token 1 is identical; only later steps diverge (KV
+        continuation vs sliding window)."""
+        import triton_client_tpu.grpc as grpcclient
+        import triton_client_tpu.http as httpclient
+
+        window = self._window(b"the quick brown fox")
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            inp = httpclient.InferInput("TOKENS", [1, len(window)], "INT32")
+            inp.set_data_from_numpy(window[None, :])
+            want = int(np.asarray(c.infer("llama_tpu", [inp])
+                                  .as_numpy("NEXT_TOKEN")).reshape(-1)[0])
+
+        import queue
+
+        results: "queue.Queue" = queue.Queue()
+        with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+            c.start_stream(
+                callback=lambda result, error: results.put((result, error)))
+            inp = grpcclient.InferInput("TOKENS", [len(window)], "INT32")
+            inp.set_data_from_numpy(window)
+            c.async_stream_infer("llama_decode", [inp], sequence_id=901,
+                                 sequence_start=True, sequence_end=True)
+            res, err = results.get(timeout=120)
+            c.stop_stream()
+        assert err is None, err
+        got = int(np.asarray(res.as_numpy("NEXT_TOKEN")).reshape(-1)[0])
+        assert got == want
+
+    def test_closed_loop_generation(self, harness):
+        """Multi-token generation: prompt prefill, then each produced token
+        feeds back as a single-token decode step."""
+        import queue
+
+        import triton_client_tpu.grpc as grpcclient
+
+        results: "queue.Queue" = queue.Queue()
+        produced = []
+        with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+            c.start_stream(
+                callback=lambda result, error: results.put((result, error)))
+            window = self._window(b"in a hole in the ground")
+            inp = grpcclient.InferInput("TOKENS", [len(window)], "INT32")
+            inp.set_data_from_numpy(window)
+            c.async_stream_infer("llama_decode", [inp], sequence_id=902,
+                                 sequence_start=True)
+            for step in range(4):
+                res, err = results.get(timeout=120)
+                assert err is None, err
+                tok = np.asarray(res.as_numpy("NEXT_TOKEN")).astype(np.int32)
+                produced.append(int(tok.reshape(-1)[0]))
+                inp = grpcclient.InferInput("TOKENS", [1], "INT32")
+                inp.set_data_from_numpy(tok.reshape(1))
+                c.async_stream_infer("llama_decode", [inp], sequence_id=902,
+                                     sequence_end=(step == 3))
+            res, err = results.get(timeout=120)
+            assert err is None, err
+            c.stop_stream()
+        assert len(produced) == 4
+        assert all(0 <= t < 256 for t in produced)
+
+    def test_requires_correlation_id(self, harness):
+        import triton_client_tpu.http as httpclient
+        from triton_client_tpu.utils import InferenceServerException
+
+        window = self._window(b"x")
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            inp = httpclient.InferInput("TOKENS", [len(window)], "INT32")
+            inp.set_data_from_numpy(window)
+            with pytest.raises(InferenceServerException,
+                               match="correlation ID"):
+                c.infer("llama_decode", [inp])
+
+
+def test_moe_preset_rejected():
+    moe_cfg = tr.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, head_dim=16,
+        d_ff=64, n_experts=2)
+    with pytest.raises(NotImplementedError):
+        decode.make_prefill(moe_cfg, 8)
+    with pytest.raises(NotImplementedError):
+        decode.make_decode_step(moe_cfg)

@@ -430,6 +430,9 @@ def register_all(registry: ModelRegistry) -> None:
     registry.register_model(language.make_ensemble_llama())
     registry.register_model(language.make_longctx_tpu())
     registry.register_model(language.make_moe_tpu())
+    from .decode import make_llama_decode
+
+    registry.register_model(make_llama_decode())
     registry.register_model(make_simple_string())
     registry.register_model(make_simple_int8())
     registry.register_model(make_simple_identity())
