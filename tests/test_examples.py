@@ -54,6 +54,8 @@ GRPC_EXAMPLES = [
     "grpc_explicit_int8_content_client.py",
     "grpc_explicit_byte_content_client.py",
     "grpc_image_client.py",
+    # framework extension: KV-cache incremental decode
+    "simple_grpc_decode_client.py",
 ]
 
 
