@@ -79,6 +79,81 @@ def test_greedy_generation_consistency(params):
     assert cached_out == recomp_out
 
 
+class TestSlotKernels:
+    """Slot-batched step == per-sequence single steps, including the
+    inactive-slot contract (stale writes overwritten by real tokens)."""
+
+    N_SLOTS = 3
+    S_MAX = 24
+    P = 6
+
+    def _slot_cache(self):
+        shape = (CFG.n_layers, self.N_SLOTS, CFG.n_heads, self.S_MAX,
+                 CFG.head_dim)
+        return jnp.zeros(shape, CFG.dtype), jnp.zeros(shape, CFG.dtype)
+
+    def test_slot_prefill_matches_single(self, params):
+        rng = np.random.default_rng(3)
+        sprefill = decode.make_slot_prefill(CFG, self.S_MAX)
+        prefill = decode.make_prefill(CFG, self.S_MAX)
+        k, v = self._slot_cache()
+        for slot in range(2):
+            toks = jnp.asarray(rng.integers(0, 64, (1, self.P)), jnp.int32)
+            nxt, best, k, v = sprefill(params, k, v, toks, slot)
+            want_logits, want_cache = prefill(params, toks)
+            assert int(nxt) == int(jnp.argmax(want_logits, axis=-1)[0])
+            np.testing.assert_allclose(
+                np.asarray(k[:, slot, :, :self.P]),
+                np.asarray(want_cache["k"][:, 0, :, :self.P]),
+                rtol=2e-4, atol=2e-4)
+
+    def test_slot_steps_with_idle_slot_match_serial(self, params):
+        """Slot 1 skips a tick while slot 0 advances; slot 1's stream must
+        equal an uninterrupted single-sequence run."""
+        rng = np.random.default_rng(4)
+        win_a = jnp.asarray(rng.integers(0, 64, (1, self.P)), jnp.int32)
+        win_b = jnp.asarray(rng.integers(0, 64, (1, self.P)), jnp.int32)
+
+        # oracle: independent single-sequence decode for each stream
+        prefill = decode.make_prefill(CFG, self.S_MAX)
+        step1 = decode.make_decode_step(CFG)
+
+        def serial(win, n):
+            logits, cache = prefill(params, win)
+            out = []
+            for _ in range(n):
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                out.append(int(nxt[0]))
+                logits, cache = step1(params, cache, nxt[:, None])
+            return out
+
+        want_a, want_b = serial(win_a, 4), serial(win_b, 3)
+
+        # slot path: A active every tick; B idle on tick 2
+        sprefill = decode.make_slot_prefill(CFG, self.S_MAX)
+        sstep = decode.make_slot_step(CFG)
+        k, v = self._slot_cache()
+        ta, _, k, v = sprefill(params, k, v, win_a, 0)
+        tb, _, k, v = sprefill(params, k, v, win_b, 1)
+        got_a, got_b = [int(ta)], [int(tb)]
+        pos = np.array([self.P, self.P, 0], np.int32)
+        for tick in range(3):
+            b_active = tick != 1
+            tokens = np.zeros(self.N_SLOTS, np.int32)
+            tokens[0] = got_a[-1]
+            if b_active:
+                tokens[1] = got_b[-1]
+            nxt, best, k, v = sstep(params, k, v, jnp.asarray(tokens),
+                                    jnp.asarray(pos))
+            got_a.append(int(nxt[0]))
+            pos[0] += 1
+            if b_active:
+                got_b.append(int(nxt[1]))
+                pos[1] += 1
+        assert got_a == want_a
+        assert got_b == want_b
+
+
 class TestLlamaDecodeServing:
     @pytest.fixture(scope="class")
     def harness(self):
@@ -165,6 +240,65 @@ class TestLlamaDecodeServing:
         assert len(produced) == 4
         assert all(0 <= t < 256 for t in produced)
 
+    def test_concurrent_streams_match_serial(self, harness):
+        """Generation through the slot batcher under concurrency must be
+        token-identical to the same sequences run serially."""
+        import queue as q_mod
+        import threading
+
+        import triton_client_tpu.grpc as grpcclient
+
+        def generate(widx, seq_id):
+            out = []
+            done: "q_mod.Queue" = q_mod.Queue()
+            with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+                c.start_stream(
+                    callback=lambda result, error: done.put((result, error)))
+                win = self._window(f"worker {widx} prompt".encode())
+                inp = grpcclient.InferInput("TOKENS", [len(win)], "INT32")
+                inp.set_data_from_numpy(win)
+                c.async_stream_infer("llama_decode", [inp], sequence_id=seq_id,
+                                     sequence_start=True)
+                res, err = done.get(timeout=120)
+                assert err is None, err
+                for i in range(4):
+                    tok = np.asarray(res.as_numpy("NEXT_TOKEN")).astype(
+                        np.int32).reshape(1)
+                    out.append(int(tok[0]))
+                    ninp = grpcclient.InferInput("TOKENS", [1], "INT32")
+                    ninp.set_data_from_numpy(tok)
+                    c.async_stream_infer("llama_decode", [ninp],
+                                         sequence_id=seq_id,
+                                         sequence_end=(i == 3))
+                    res, err = done.get(timeout=120)
+                    assert err is None, err
+                out.append(int(np.asarray(
+                    res.as_numpy("NEXT_TOKEN")).reshape(-1)[0]))
+                c.stop_stream()
+            return out
+
+        # serial oracle runs
+        want = {w: generate(w, 2100 + w) for w in range(3)}
+
+        # same prompts, concurrent
+        got = {}
+        errors = []
+
+        def worker(w):
+            try:
+                got[w] = generate(w, 2200 + w)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((w, exc))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        assert got == want
+
     def test_requires_correlation_id(self, harness):
         import triton_client_tpu.http as httpclient
         from triton_client_tpu.utils import InferenceServerException
@@ -186,3 +320,92 @@ def test_moe_preset_rejected():
         decode.make_prefill(moe_cfg, 8)
     with pytest.raises(NotImplementedError):
         decode.make_decode_step(moe_cfg)
+
+
+class TestBatchedMode:
+    """Slot-batched continuous decoding (TRITON_TPU_DECODE_MODE=batched):
+    driven at the model level so the default-mode harness is untouched."""
+
+    @pytest.fixture()
+    def model(self, monkeypatch):
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        from triton_client_tpu.models.decode import DecodeModel
+
+        m = DecodeModel(name="llama_decode_batched_test")
+        yield m
+        m._shutdown()
+
+    def _window(self, text: bytes):
+        from triton_client_tpu.models import language
+
+        S = language.LLAMA_SEQ_LEN
+        out = np.zeros((S,), np.int32)
+        b = np.frombuffer(text[-S:], np.uint8)
+        out[S - len(b):] = b
+        return out
+
+    def _generate(self, m, seq_id, prompt, n):
+        out = []
+        res = m._execute({"TOKENS": self._window(prompt)},
+                         {"sequence_id": seq_id, "sequence_start": True})
+        for i in range(n):
+            tok = res["NEXT_TOKEN"]
+            out.append(int(tok[0]))
+            res = m._execute({"TOKENS": tok},
+                             {"sequence_id": seq_id,
+                              "sequence_end": i == n - 1})
+        out.append(int(res["NEXT_TOKEN"][0]))
+        return out
+
+    def test_concurrent_matches_serial(self, model):
+        import threading
+
+        prompts = {w: f"batched worker {w}".encode() for w in range(3)}
+        want = {w: self._generate(model, 3100 + w, p, 3)
+                for w, p in prompts.items()}
+        got, errors = {}, []
+
+        def worker(w):
+            try:
+                got[w] = self._generate(model, 3200 + w, prompts[w], 3)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((w, exc))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        assert got == want
+
+    def test_slot_exhaustion_rejected_and_recoverable(self, model):
+        from triton_client_tpu.server.types import InferError
+
+        win = self._window(b"slot filler")
+        for i in range(4):
+            model._execute({"TOKENS": win},
+                           {"sequence_id": 3300 + i, "sequence_start": True})
+        with pytest.raises(InferError, match="slots are busy"):
+            model._execute({"TOKENS": win},
+                           {"sequence_id": 3399, "sequence_start": True})
+        # the rejected start must not leak its per-sequence lock entry
+        assert 3399 not in model._seq_locks
+        # ending one frees its slot for a new sequence
+        model._execute({"TOKENS": np.array([1], np.int32)},
+                       {"sequence_id": 3300, "sequence_end": True})
+        model._execute({"TOKENS": win},
+                       {"sequence_id": 3398, "sequence_start": True})
+
+    def test_unload_rejects_new_requests(self, model):
+        from triton_client_tpu.server.types import InferError
+
+        win = self._window(b"to be unloaded")
+        model._execute({"TOKENS": win},
+                       {"sequence_id": 3500, "sequence_start": True})
+        model._shutdown()
+        with pytest.raises(InferError, match="unloading"):
+            model._execute({"TOKENS": np.array([1], np.int32)},
+                           {"sequence_id": 3500})

@@ -144,24 +144,143 @@ def make_decode_step(cfg: tr.TransformerConfig):
     return step
 
 
+# ---------------------------------------------------------------------------
+# Slot-batched continuous decoding: one preallocated cache of N slots, every
+# concurrent sequence's next-token step merged into ONE batched device step
+# (and one fused readback) per tick — the aggregate-throughput path.
+# ---------------------------------------------------------------------------
+
+
+def _rope_at(x, pos, theta):
+    """RoPE for single-position queries/keys with PER-SLOT positions.
+
+    x: [B, H, 1, K]; pos: [B] int32 (each slot at its own absolute
+    position). Mirrors tr._rope's rotate-halves layout exactly."""
+    Kd = x.shape[-1]
+    half = Kd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]      # [B, half]
+    cos = jnp.cos(ang)[:, None, None, :]                          # [B,1,1,half]
+    sin = jnp.sin(ang)[:, None, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _slot_decode_layer(blk, x, kc, vc, pos, cfg: tr.TransformerConfig):
+    """One token per slot, each at its own position.
+
+    x: [B, 1, D]; kc/vc: [B, H, S_max, K]; pos: [B]."""
+    q, k, v = _project_qkv(blk, x, cfg)
+    q = _rope_at(q, pos, cfg.rope_theta)
+    k = _rope_at(k, pos, cfg.rope_theta)
+
+    def write(cache_row, new_row, p):
+        return lax.dynamic_update_slice_in_dim(
+            cache_row, new_row, p, axis=1)  # [H, S, K] <- [H, 1, K] at p
+
+    kc = jax.vmap(write)(kc, k.astype(kc.dtype), pos)
+    vc = jax.vmap(write)(vc, v.astype(vc.dtype), pos)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    s = jnp.einsum("bhqk,bhsk->bhqs", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * scale
+    valid = jnp.arange(kc.shape[2])[None, :] <= pos[:, None]      # [B, S]
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqs,bhsk->bhqk", p, vc.astype(jnp.float32)).astype(x.dtype)
+    x = _attn_out(blk, x, o)
+    return _dense_ffn(blk, x, cfg), kc, vc
+
+
+def make_slot_step(cfg: tr.TransformerConfig):
+    """jitted (params, k [L,B,H,S,K], v, tokens [B], pos [B]) ->
+    (greedy tokens [B] int32, best logits [B] f32, k', v').
+
+    Every slot advances one position — callers ignore outputs and do not
+    advance the host-side pos for slots with no pending request (their
+    stale-position cache write is overwritten by the next real token)."""
+    if cfg.moe:
+        raise NotImplementedError("decode cache supports dense FFN presets")
+
+    @jax.jit
+    def step(params, k, v, tokens, pos):
+        x = jnp.take(params["embed"].astype(cfg.dtype),
+                     tokens[:, None], axis=0)                     # [B,1,D]
+        blocks = {key: params[key] for key in tr._layer_keys(cfg)}
+
+        def layer(x, xs):
+            blk, kc, vc = xs
+            x, kc, vc = _slot_decode_layer(blk, x, kc, vc, pos, cfg)
+            return x, (kc, vc)
+
+        x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
+        logits = _head(params, x, cfg)[:, -1]                     # [B, V]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        best = jnp.max(logits, axis=-1).astype(jnp.float32)
+        return nxt, best, ks, vs
+
+    return step
+
+
+def make_slot_prefill(cfg: tr.TransformerConfig, s_max: int):
+    """jitted (params, k, v, tokens [1,S], slot) -> (next tok, best logit,
+    k', v') — prefills ONE slot of the shared cache in a single forward."""
+    if cfg.moe:
+        raise NotImplementedError("decode cache supports dense FFN presets")
+
+    @jax.jit
+    def prefill(params, k, v, tokens, slot):
+        B, S = tokens.shape
+        x = jnp.take(params["embed"].astype(cfg.dtype), tokens, axis=0)
+        blocks = {key: params[key] for key in tr._layer_keys(cfg)}
+
+        def layer(x, blk):
+            x, kl, vl = _prefill_layer(blk, x, cfg)
+            return x, (kl, vl)
+
+        x, (ks, vs) = lax.scan(layer, x, blocks)                  # [L,1,H,S,K]
+        pad = s_max - S
+        ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+        vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+        k = lax.dynamic_update_slice(k, ks.astype(k.dtype),
+                                     (0, slot, 0, 0, 0))
+        v = lax.dynamic_update_slice(v, vs.astype(v.dtype),
+                                     (0, slot, 0, 0, 0))
+        logits = _head(params, x, cfg)[:, -1]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+        best = jnp.max(logits, axis=-1).astype(jnp.float32)[0]
+        return nxt, best, k, v
+
+    return prefill
+
+
 class DecodeModel:
-    """``llama_decode``: sequence-stateful greedy decoding with a
-    device-resident KV cache per correlation id.
+    """``llama_decode``: sequence-stateful greedy decoding over a shared
+    SLOT cache with continuous batching.
 
     Protocol (sequence semantics, same wire as ``simple_sequence``):
 
     * ``sequence_start`` request carries TOKENS ``[1, prompt_len]`` — the
-      prompt is PREFILLED in one forward (cache positions 0..P-1) and the
-      first greedy token returns.
+      prompt is PREFILLED in one forward into a free slot of the shared
+      cache and the first greedy token returns.
     * every following request carries TOKENS ``[1, 1]`` — usually the token
-      the server just returned (closed-loop generation) — and pays ONE
-      single-token decode step: no window recompute, 8 bytes H2D.
-    * ``sequence_end`` frees the cache; idle sequences evict on TTL.
+      the server just returned (closed-loop generation) — and pays one
+      single-token decode step.
+    * ``sequence_end`` frees the slot; idle sequences evict on TTL.
+
+    Continuous batching: a single worker thread owns the cache; while one
+    batched step's readback is in flight, newly arriving steps queue, and
+    the next tick merges them — one device step and ONE fused D2H per tick
+    regardless of how many sequences advanced (the per-stream serial rate
+    stays RTT-bound, but aggregate throughput scales with concurrency
+    instead of serializing per token).
 
     Shares the ``llama_tpu`` preset/seed, so it decodes the same weights the
     window-recompute ensemble serves."""
 
-    def __init__(self, name="llama_decode", prompt_len=None, s_max=None):
+    def __init__(self, name="llama_decode", prompt_len=None, s_max=None,
+                 n_slots=None):
+        import os
         import threading
 
         from ..server.model import Model, make_config
@@ -170,6 +289,22 @@ class DecodeModel:
         self._language = language
         self._prompt_len = prompt_len or language.LLAMA_SEQ_LEN
         self._s_max = s_max or 2 * self._prompt_len
+        if n_slots is None:
+            n_slots = int(os.environ.get("TRITON_TPU_DECODE_SLOTS", "8"))
+        self._n_slots = n_slots
+        # "independent": each sequence owns its cache; steps run (and their
+        # readbacks overlap) on the server's executor threads. Wins when
+        # device readback latency is high (e.g. the bench host's remote
+        # tunnel, ~90 ms blocking D2H) because concurrent round trips
+        # pipeline. "batched": shared slot cache + continuous batching —
+        # one device step and one readback per tick regardless of how many
+        # sequences advanced; wins on co-located TPUs where the readback is
+        # sub-millisecond and per-step dispatch dominates.
+        self._mode = os.environ.get("TRITON_TPU_DECODE_MODE", "independent")
+        if self._mode not in ("independent", "batched"):
+            raise ValueError(
+                f"TRITON_TPU_DECODE_MODE={self._mode!r}: expected "
+                "'independent' or 'batched'")
         cfg = make_config(
             name,
             inputs=[("TOKENS", "INT32", [-1])],
@@ -178,14 +313,18 @@ class DecodeModel:
             sequence_batching=True,
             instance_kind="KIND_TPU",
         )
-        base = Model
+        outer = self
 
-        class _Impl(base):  # noqa: N801 — adapter onto the abstract Model
+        class _Impl(Model):  # noqa: N801 — adapter onto the abstract Model
             def execute(inner, inputs, parameters):
-                return self._execute(inputs, parameters)
+                return outer._execute(inputs, parameters)
+
+            def unload(inner):
+                outer._shutdown()
 
         self._model = _Impl(cfg)
-        self._state: Dict[Any, Any] = {}
+        self._state: Dict[Any, int] = {}      # seq_id -> slot
+        self._free = set(range(n_slots))
         self._touched: Dict[Any, float] = {}
         self._seq_locks: Dict[Any, Any] = {}
         self._idle_s = (
@@ -194,10 +333,29 @@ class DecodeModel:
         self._init_lock = threading.Lock()
         self._threading = threading
         self._fns = None
+        self._fns_ind = None
+        self._params = None
+        self._jobs = None
+        self._worker = None
+        self._closed = False
+        # per-slot generation: bumped on every release/evict so jobs from a
+        # dead sequence can never touch the slot's next occupant
+        self._slot_gen = [0] * n_slots
+        # worker-owned (single writer): slot cache + per-slot position
+        self._k = self._v = None
+        self._pos = None
 
     @property
     def model(self):
         return self._model
+
+    # -- lazy init ---------------------------------------------------------
+    def _ensure_params(self):
+        """Shared weight init (same seed/config for both modes)."""
+        if self._params is None:
+            cfg = self._language._llama_cfg()
+            self._params = (tr.init_params(jax.random.PRNGKey(3), cfg), cfg)
+        return self._params
 
     def _ensure_fns(self):
         # double-checked: concurrent cold-start sequences must not each
@@ -205,25 +363,229 @@ class DecodeModel:
         if self._fns is None:
             with self._init_lock:
                 if self._fns is None:
-                    cfg = self._language._llama_cfg()
-                    params = tr.init_params(jax.random.PRNGKey(3), cfg)
-                    self._fns = (
-                        make_prefill(cfg, self._s_max),
-                        make_decode_step(cfg),
-                        params,
-                        cfg,
-                    )
+                    import queue as _queue
+
+                    import numpy as np
+
+                    params, cfg = self._ensure_params()
+                    shape = (cfg.n_layers, self._n_slots, cfg.n_heads,
+                             self._s_max, cfg.head_dim)
+                    self._k = jnp.zeros(shape, cfg.dtype)
+                    self._v = jnp.zeros(shape, cfg.dtype)
+                    self._pos = np.zeros(self._n_slots, np.int32)
+                    self._jobs = _queue.Queue()
+                    import concurrent.futures as _cf
+
+                    self._readers = _cf.ThreadPoolExecutor(
+                        max_workers=4,
+                        thread_name_prefix=f"{self._model.name}-readback")
+                    self._worker = self._threading.Thread(
+                        target=self._worker_loop, daemon=True,
+                        name=f"{self._model.name}-decode-worker")
+                    fns = (make_slot_prefill(cfg, self._s_max),
+                           make_slot_step(cfg), params, cfg)
+                    self._fns = fns
+                    self._worker.start()
         return self._fns
 
+    def _shutdown(self):
+        with self._lock:
+            self._closed = True
+        if self._jobs is not None:
+            self._jobs.put(None)
+
+    def _ensure_fns_independent(self):
+        if self._fns_ind is None:
+            with self._init_lock:
+                if self._fns_ind is None:
+                    params, cfg = self._ensure_params()
+                    self._fns_ind = (make_prefill(cfg, self._s_max),
+                                     make_decode_step(cfg), params, cfg)
+        return self._fns_ind
+
+    # -- slot bookkeeping (under self._lock) -------------------------------
     def _evict_idle_locked(self, now: float) -> None:
         stale = [k for k, t in self._touched.items()
                  if now - t > self._idle_s]
-        for k in stale:
-            self._state.pop(k, None)
-            self._touched.pop(k, None)
-            self._seq_locks.pop(k, None)
+        for key in stale:
+            self._release_entry_locked(key)
 
+    def _release_locked(self, seq_id) -> None:
+        self._release_entry_locked(seq_id)
+
+    def _release_entry_locked(self, seq_id) -> None:
+        slot = self._state.pop(seq_id, None)
+        if isinstance(slot, int):  # independent mode stores caches, not slots
+            self._free.add(slot)
+            # invalidate any job still queued for this slot: the worker
+            # checks the generation and fails stale steps instead of
+            # writing a dead sequence's K/V into the slot's next occupant
+            self._slot_gen[slot] += 1
+        self._touched.pop(seq_id, None)
+        self._seq_locks.pop(seq_id, None)
+
+    # -- worker: single owner of the cache ---------------------------------
+    # accumulation window per tick; small vs a ~100 ms batched step but
+    # enough for a whole response cohort's next requests to arrive
+    TICK_ACCUMULATE_S = 0.004
+
+    def _worker_loop(self):
+        import queue as _queue
+        import time
+
+        import numpy as np
+
+        prefill, step, params, cfg = self._fns
+
+        def fail_stale(fut):
+            from ..server.types import InferError
+
+            fut.set_exception(InferError(
+                f"model '{self._model.name}': sequence was evicted or "
+                "ended before this request executed"))
+
+        def drain_and_fail():
+            from ..server.types import InferError
+
+            while True:
+                try:
+                    j = self._jobs.get_nowait()
+                except _queue.Empty:
+                    return
+                if j is None:
+                    continue
+                j[2].set_exception(InferError(
+                    f"model '{self._model.name}' is unloading", 503))
+
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                drain_and_fail()
+                return
+            kind, payload, fut = job
+            if kind == "prefill":
+                slot, gen, win = payload
+                if gen != self._slot_gen[slot]:
+                    fail_stale(fut)
+                    continue
+                try:
+                    nxt, best, self._k, self._v = prefill(
+                        params, self._k, self._v, jnp.asarray(win), slot)
+                    self._pos[slot] = win.shape[1]
+                    pair = jnp.stack([nxt.astype(jnp.float32), best])
+                    # pipelined like step readbacks: the blocking D2H must
+                    # not stall the tick loop for a device round trip
+                    self._readers.submit(self._resolve_prefill, pair, fut)
+                except Exception as e:  # noqa: BLE001 — surfaced via future
+                    fut.set_exception(e)
+                continue
+            # Merge steps into this tick. A short accumulation window is
+            # load-bearing: the previous tick resolves every stream's
+            # future at once, and their next requests all land a couple of
+            # milliseconds later — grabbing only what is instantly queued
+            # would start a near-empty (but full-price) tick and make the
+            # cohort wait a whole extra one. Non-step jobs defer one tick.
+            batch = []
+            seen = set()
+            deferred = []
+            closing = False
+
+            def admit(p, f):
+                slot, gen, tok = p
+                if gen != self._slot_gen[slot]:
+                    fail_stale(f)
+                    return
+                batch.append(((slot, tok), f))
+                seen.add(slot)
+
+            admit(payload, fut)
+            deadline = time.monotonic() + self.TICK_ACCUMULATE_S
+            while len(seen) < self._n_slots and not closing:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt_job = self._jobs.get(timeout=timeout)
+                except _queue.Empty:
+                    break
+                if nxt_job is None:
+                    deferred.append(None)
+                    closing = True
+                    break
+                k2, p2, f2 = nxt_job
+                if k2 == "step" and p2[0] not in seen:
+                    admit(p2, f2)
+                else:
+                    deferred.append(nxt_job)
+            for d in deferred:
+                self._jobs.put(d)
+            if not batch:
+                continue
+            tokens = np.zeros(self._n_slots, np.int32)
+            for (slot, tok), _ in batch:
+                tokens[slot] = tok
+            try:
+                nxt, best, self._k, self._v = step(
+                    params, self._k, self._v, jnp.asarray(tokens),
+                    jnp.asarray(self._pos))
+                pair = jnp.stack([nxt.astype(jnp.float32), best])
+                for (slot, tok), _ in batch:
+                    self._pos[slot] += 1
+            except Exception as e:  # noqa: BLE001 — surfaced via futures
+                for _, f in batch:
+                    f.set_exception(e)
+                continue
+            # PIPELINE the readback: over a remote device the blocking D2H
+            # costs a full round trip; resolving it on a reader thread lets
+            # the next tick's compute dispatch immediately, so round trips
+            # overlap instead of gating the tick rate. Safe because a
+            # sequence never has two steps in flight (closed loop + per-seq
+            # lock): tick N+1 only carries other sequences' tokens.
+            self._readers.submit(self._resolve_tick, pair, batch)
+
+    @staticmethod
+    def _resolve_prefill(pair, fut):
+        import numpy as np
+
+        try:
+            vals = np.asarray(pair)
+            fut.set_result((int(vals[0]), float(vals[1])))
+        except Exception as e:  # noqa: BLE001 — surfaced via future
+            fut.set_exception(e)
+
+    @staticmethod
+    def _resolve_tick(pair, batch):
+        import numpy as np
+
+        try:
+            vals = np.asarray(pair)  # one fused D2H for the whole tick
+            for (slot, _tok), f in batch:
+                f.set_result((int(vals[0, slot]), float(vals[1, slot])))
+        except Exception as e:  # noqa: BLE001 — surfaced via futures
+            for _, f in batch:
+                f.set_exception(e)
+
+    def _submit(self, kind, payload):
+        import concurrent.futures
+
+        from ..server.types import InferError
+
+        if self._closed:
+            raise InferError(
+                f"model '{self._model.name}' is unloading", 503)
+        fut = concurrent.futures.Future()
+        self._jobs.put((kind, payload, fut))
+        return fut
+
+    # -- request path ------------------------------------------------------
     def _execute(self, inputs, parameters):
+        if self._mode == "independent":
+            return self._execute_independent(inputs, parameters)
+        return self._execute_batched(inputs, parameters)
+
+    def _execute_independent(self, inputs, parameters):
+        """Per-sequence caches; step + readback on the calling executor
+        thread so concurrent sequences' device round trips overlap."""
         import time
 
         import numpy as np
@@ -237,14 +599,12 @@ class DecodeModel:
             raise InferError(
                 f"inference request to model '{self._model.name}' must "
                 "specify a non-zero or non-empty correlation ID")
-        prefill, step, params, cfg = self._ensure_fns()
+        prefill, step, params, cfg = self._ensure_fns_independent()
         toks = np.asarray(inputs["TOKENS"]).reshape(1, -1).astype(np.int32)
         toks = np.clip(toks, 0, cfg.vocab_size - 1)
         now = time.monotonic()
         with self._lock:
             self._evict_idle_locked(now)
-            # per-sequence lock: steps within one correlation id serialize
-            # (Triton sequence semantics); different sequences overlap
             seq_lock = self._seq_locks.setdefault(
                 seq_id, self._threading.Lock())
         with seq_lock:
@@ -253,9 +613,7 @@ class DecodeModel:
 
             def drop():
                 with self._lock:
-                    self._state.pop(seq_id, None)
-                    self._touched.pop(seq_id, None)
-                    self._seq_locks.pop(seq_id, None)
+                    self._release_locked(seq_id)
 
             if start or entry is None:
                 if toks.shape[1] != self._prompt_len:
@@ -293,9 +651,7 @@ class DecodeModel:
             nxt, best = int(pair[0]), float(pair[1])
             with self._lock:
                 if end:
-                    self._state.pop(seq_id, None)
-                    self._touched.pop(seq_id, None)
-                    self._seq_locks.pop(seq_id, None)
+                    self._release_locked(seq_id)
                 else:
                     self._state[seq_id] = (cache, host_pos)
                     self._touched[seq_id] = time.monotonic()
@@ -303,6 +659,90 @@ class DecodeModel:
             "NEXT_TOKEN": np.array([nxt], np.int32).reshape(1),
             "NEXT_LOGIT": np.array([best], np.float32).reshape(1),
         }
+
+    def _execute_batched(self, inputs, parameters):
+        import time
+
+        import numpy as np
+
+        from ..server.types import InferError
+
+        seq_id = parameters.get("sequence_id", 0)
+        start = bool(parameters.get("sequence_start", False))
+        end = bool(parameters.get("sequence_end", False))
+        if not seq_id:
+            raise InferError(
+                f"inference request to model '{self._model.name}' must "
+                "specify a non-zero or non-empty correlation ID")
+        prefill, step, params, cfg = self._ensure_fns()
+        toks = np.asarray(inputs["TOKENS"]).reshape(1, -1).astype(np.int32)
+        toks = np.clip(toks, 0, cfg.vocab_size - 1)
+        now = time.monotonic()
+        with self._lock:
+            self._evict_idle_locked(now)
+            # per-sequence lock: steps within one correlation id serialize
+            # (Triton sequence semantics); different sequences overlap
+            seq_lock = self._seq_locks.setdefault(
+                seq_id, self._threading.Lock())
+        with seq_lock:
+            with self._lock:
+                slot = self._state.get(seq_id)
+            if start or slot is None:
+                if toks.shape[1] != self._prompt_len:
+                    with self._lock:
+                        self._release_locked(seq_id)
+                    raise InferError(
+                        f"model '{self._model.name}': sequence_start "
+                        f"expects a [1,{self._prompt_len}] prompt, got "
+                        f"{list(toks.shape)}")
+                with self._lock:
+                    if slot is None:
+                        if not self._free:
+                            self._evict_idle_locked(time.monotonic())
+                        if not self._free:
+                            # drop the lock entry setdefault created, or
+                            # retried starts leak one per correlation id
+                            self._seq_locks.pop(seq_id, None)
+                            raise InferError(
+                                f"model '{self._model.name}': all "
+                                f"{self._n_slots} decode slots are busy; "
+                                "end or abandon a sequence first", 429)
+                        slot = self._free.pop()
+                        self._state[seq_id] = slot
+                    gen = self._slot_gen[slot]
+                fut = self._submit("prefill", (slot, gen, toks))
+            else:
+                # self._pos is worker-owned, but this slot's previous step
+                # completed before its future resolved (per-seq lock), so
+                # the read is stable
+                with self._lock:
+                    gen = self._slot_gen[slot]
+                if int(self._pos[slot]) >= self._s_max:
+                    # free the slot even on the failure path: the client
+                    # was told to send sequence_end and must not find the
+                    # id poisoned
+                    if end:
+                        with self._lock:
+                            self._release_locked(seq_id)
+                    raise InferError(
+                        f"model '{self._model.name}': sequence exceeded "
+                        f"the {self._s_max}-token cache; send sequence_end")
+                if toks.shape[1] != 1:
+                    raise InferError(
+                        f"model '{self._model.name}': decode steps expect "
+                        f"TOKENS [1,1], got {list(toks.shape)}")
+                fut = self._submit("step", (slot, gen, int(toks[0, 0])))
+            nxt, best = fut.result(timeout=3600)
+            with self._lock:
+                if end:
+                    self._release_locked(seq_id)
+                else:
+                    self._touched[seq_id] = time.monotonic()
+        return {
+            "NEXT_TOKEN": np.array([nxt], np.int32).reshape(1),
+            "NEXT_LOGIT": np.array([best], np.float32).reshape(1),
+        }
+
 
 
 def make_llama_decode():
