@@ -531,3 +531,15 @@ def place_params(params, mesh: Mesh, cfg: TransformerConfig):
     specs = param_specs(cfg)
     return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
             for k, v in params.items()}
+
+
+def place_opt(opt, mesh: Mesh, cfg: TransformerConfig):
+    """Commit optimizer state to its mesh shardings (opt_specs). Needed when
+    state round-trips through storage: a restored array is committed to
+    whatever sharding it was saved with, so checkpoint templates must carry
+    the mesh placement (utils/checkpoint.py)."""
+    return {
+        "mu": place_params(opt["mu"], mesh, cfg),
+        "nu": place_params(opt["nu"], mesh, cfg),
+        "count": jax.device_put(opt["count"], NamedSharding(mesh, P())),
+    }

@@ -1,0 +1,70 @@
+"""Sharded training checkpoint/resume for the flagship transformer (orbax).
+
+SURVEY §5's checkpoint/resume aux subsystem: the serving side is covered by
+the model-repository load/unload APIs; this is the TRAINING-side
+counterpart — persist the pjit-sharded parameters + optimizer state + step
+counter and restore them bit-exactly onto a mesh of the same config (orbax
+writes per-shard and re-shards on load, so save on an 8-device mesh /
+restore on the same topology round-trips without gathering to one host).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+
+def make_manager(directory: str, max_to_keep: int = 3):
+    """CheckpointManager over ``directory`` (keeps the newest N steps)."""
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+    )
+
+def save(manager, step: int, params: Dict[str, Any], opt: Dict[str, Any]
+         ) -> None:
+    """Persist one training state; blocks until the write is durable.
+
+    Raises if the manager declines the save (e.g. a step not newer than the
+    latest recorded one) — a skipped write must never masquerade as a
+    durable checkpoint."""
+    import orbax.checkpoint as ocp
+
+    saved = manager.save(
+        step,
+        args=ocp.args.StandardSave({"params": params, "opt": opt}),
+    )
+    if not saved:
+        raise ValueError(
+            f"checkpoint manager declined to save step {step} "
+            f"(latest recorded step: {manager.latest_step()})")
+    manager.wait_until_finished()
+
+
+def latest_step(manager) -> Optional[int]:
+    return manager.latest_step()
+
+
+def restore(manager, params_like, opt_like, step: Optional[int] = None):
+    """Restore (params, opt, step). ``*_like`` provide the pytree structure
+    AND target shardings — pass the live (placed) state; arrays come back
+    with identical shardings, ready for the jitted train step."""
+    import orbax.checkpoint as ocp
+
+    if step is None:
+        step = manager.latest_step()
+    if step is None:
+        raise FileNotFoundError("no checkpoint recorded in this directory")
+    template = {
+        "params": jax.tree.map(_abstract, params_like),
+        "opt": jax.tree.map(_abstract, opt_like),
+    }
+    state = manager.restore(step, args=ocp.args.StandardRestore(template))
+    return state["params"], state["opt"], step
+
+
+def _abstract(x):
+    return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
