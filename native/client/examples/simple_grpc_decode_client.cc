@@ -1,0 +1,133 @@
+// KV-cache incremental generation from C++ over the live duplex stream
+// (framework extension mirrored from examples/simple_grpc_decode_client.py):
+// send the 128-token prompt window ONCE with sequence_start, then feed each
+// returned NEXT_TOKEN back as a single-token step — no window recompute.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = tc_tpu::client;
+
+static constexpr int kWindow = 128;  // llama_decode prompt window
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  int n_tokens = 5;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+    if (strcmp(argv[i], "-n") == 0) n_tokens = atoi(argv[i + 1]);
+  }
+
+  // declared BEFORE the client: the stream callback captures these, and the
+  // client's destructor joins its reader thread — reverse destruction order
+  // must tear the client down first
+  std::mutex mu;
+  std::condition_variable cv;
+  std::queue<int32_t> tokens_q;
+  bool stream_error = false;
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  tc::Error err = tc::InferenceServerGrpcClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  err = client->StartStream([&](tc::InferResult* r) {
+    const uint8_t* buf;
+    size_t len;
+    if (r->RequestStatus().IsOk() &&
+        r->RawData("NEXT_TOKEN", &buf, &len).IsOk() && len >= 4) {
+      int32_t tok;
+      memcpy(&tok, buf, 4);
+      std::lock_guard<std::mutex> lk(mu);
+      tokens_q.push(tok);
+      cv.notify_all();
+    } else {
+      fprintf(stderr, "stream result error: %s\n",
+              r->RequestStatus().IsOk()
+                  ? "response missing a valid NEXT_TOKEN tensor"
+                  : r->RequestStatus().Message().c_str());
+      std::lock_guard<std::mutex> lk(mu);
+      stream_error = true;
+      cv.notify_all();
+    }
+    delete r;
+  });
+  if (!err.IsOk()) {
+    fprintf(stderr, "start stream failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  // left-padded byte-level prompt window, as llama_preprocess builds it
+  const std::string prompt = "In a hole in the ground";
+  std::vector<int32_t> window(kWindow, 0);
+  for (size_t i = 0; i < prompt.size(); ++i)
+    window[kWindow - prompt.size() + i] =
+        static_cast<int32_t>(static_cast<unsigned char>(prompt[i]));
+
+  auto send = [&](const std::vector<int32_t>& vals, bool start, bool end) {
+    tc::InferInput* in;
+    tc::InferInput::Create(&in, "TOKENS",
+                           {static_cast<int64_t>(vals.size())}, "INT32");
+    in->AppendRaw(reinterpret_cast<const uint8_t*>(vals.data()),
+                  vals.size() * sizeof(int32_t));
+    tc::InferOptions options("llama_decode");
+    options.sequence_id_ = 8101;
+    options.sequence_start_ = start;
+    options.sequence_end_ = end;
+    tc::Error e = client->AsyncStreamInfer(options, {in});
+    delete in;
+    return e;
+  };
+
+  auto next_token = [&](int32_t* tok) {
+    std::unique_lock<std::mutex> lk(mu);
+    if (!cv.wait_for(lk, std::chrono::seconds(600), [&] {
+          return !tokens_q.empty() || stream_error;
+        }))
+      return false;
+    if (stream_error || tokens_q.empty()) return false;
+    *tok = tokens_q.front();
+    tokens_q.pop();
+    return true;
+  };
+
+  err = send(window, /*start=*/true, /*end=*/false);
+  if (!err.IsOk()) {
+    fprintf(stderr, "prefill failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::vector<int32_t> produced;
+  int32_t tok = 0;
+  for (int step = 0; step < n_tokens; ++step) {
+    if (!next_token(&tok)) {
+      fprintf(stderr, "no token for step %d\n", step);
+      return 1;
+    }
+    produced.push_back(tok);
+    err = send({tok}, /*start=*/false, /*end=*/step == n_tokens - 1);
+    if (!err.IsOk()) {
+      fprintf(stderr, "step failed: %s\n", err.Message().c_str());
+      return 1;
+    }
+  }
+  if (!next_token(&tok)) {
+    fprintf(stderr, "missing final token\n");
+    return 1;
+  }
+  client->FinishStream();
+
+  printf("generated:");
+  for (int32_t t : produced) printf(" %d", t);
+  printf("\nPASS: grpc decode (kv cache)\n");
+  return 0;
+}
