@@ -25,12 +25,10 @@ if "JAX_PLATFORMS" in os.environ:
 
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-from aiohttp import web
-
 from .core import InferenceCore
-from .grpc_server import build_grpc_server
-from .http_server import build_app
+from .frontends import start_frontends
 from .registry import ModelRegistry
+from .tls import maybe_tls
 
 
 def main() -> None:
@@ -41,7 +39,15 @@ def main() -> None:
     parser.add_argument("--http-port", type=int, default=8000)
     parser.add_argument("--grpc-port", type=int, default=8001)
     parser.add_argument("--verbose", "-v", action="store_true")
+    parser.add_argument("--ssl-certfile", default=None,
+                        help="serve HTTPS/secure-gRPC with this PEM cert chain")
+    parser.add_argument("--ssl-keyfile", default=None,
+                        help="PEM private key matching --ssl-certfile")
     args = parser.parse_args()
+    try:
+        tls = maybe_tls(args.ssl_certfile, args.ssl_keyfile)
+    except ValueError as e:
+        parser.error(str(e))
 
     registry = ModelRegistry(repository_path=args.model_repository)
     if args.model_repository:
@@ -60,15 +66,14 @@ def main() -> None:
     core = InferenceCore(registry)
 
     async def serve():
-        runner = web.AppRunner(build_app(core))
-        await runner.setup()
-        site = web.TCPSite(runner, args.host, args.http_port)
-        await site.start()
-        grpc_server = build_grpc_server(core, f"{args.host}:{args.grpc_port}")
-        await grpc_server.start()
+        # hold the returned handles: a dropped grpc.aio.Server is torn down
+        # by its finalizer, silently closing the port
+        frontends = await start_frontends(
+            core, args.host, args.http_port, args.grpc_port, tls=tls)
+        scheme = "https" if tls else "http"
         print(
-            f"serving v2 protocol: http={args.host}:{args.http_port} "
-            f"grpc={args.host}:{args.grpc_port}"
+            f"serving v2 protocol: {scheme}={args.host}:{args.http_port} "
+            f"grpc{'s' if tls else ''}={args.host}:{args.grpc_port}"
         )
         await asyncio.Event().wait()
 

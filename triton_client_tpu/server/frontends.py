@@ -1,0 +1,45 @@
+"""Shared frontend bootstrap for the serving harness.
+
+Both the CLI (``__main__.py``) and the in-process harness (``testing.py``)
+bring up the same pair of frontends — aiohttp HTTP site + grpc.aio server,
+optionally behind TLS — so the wiring lives here once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from aiohttp import web
+
+from .core import InferenceCore
+from .grpc_server import build_grpc_server
+from .http_server import build_app
+from .tls import TLSConfig
+
+
+async def start_frontends(
+    core: InferenceCore,
+    host: str,
+    http_port: int,
+    grpc_port: int,
+    tls: Optional[TLSConfig] = None,
+) -> Tuple[web.AppRunner, "object"]:
+    """Start the HTTP and gRPC frontends; returns (http_runner, grpc_server)."""
+    runner = web.AppRunner(build_app(core))
+    await runner.setup()
+    site = web.TCPSite(
+        runner, host, http_port,
+        ssl_context=tls.ssl_context() if tls else None)
+    await site.start()
+    try:
+        grpc_server = build_grpc_server(core, f"{host}:{grpc_port}", tls=tls)
+        await grpc_server.start()
+    except BaseException:
+        await runner.cleanup()
+        raise
+    return runner, grpc_server
+
+
+async def stop_frontends(runner: web.AppRunner, grpc_server) -> None:
+    await grpc_server.stop(grace=1.0)
+    await runner.cleanup()

@@ -395,7 +395,9 @@ def _grpc_code(e: InferError) -> grpc.StatusCode:
     }.get(e.http_status, grpc.StatusCode.UNKNOWN)
 
 
-def build_grpc_server(core: InferenceCore, address: str = "[::]:8001") -> "grpc.aio.Server":
+def build_grpc_server(
+    core: InferenceCore, address: str = "[::]:8001", tls=None
+) -> "grpc.aio.Server":
     server = grpc.aio.server(
         options=[
             ("grpc.max_send_message_length", -1),
@@ -403,5 +405,8 @@ def build_grpc_server(core: InferenceCore, address: str = "[::]:8001") -> "grpc.
         ]
     )
     add_GRPCInferenceServiceServicer_to_server(InferenceServicer(core), server)
-    server.add_insecure_port(address)
+    if tls is not None:
+        server.add_secure_port(address, tls.grpc_credentials())
+    else:
+        server.add_insecure_port(address)
     return server

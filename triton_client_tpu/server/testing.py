@@ -15,12 +15,9 @@ import socket
 import threading
 from typing import Optional
 
-from aiohttp import web
-
 from .._xla_broker import broker
 from .core import InferenceCore
-from .grpc_server import build_grpc_server
-from .http_server import build_app
+from .frontends import start_frontends, stop_frontends
 from .registry import ModelRegistry
 
 
@@ -37,10 +34,12 @@ class ServerHarness:
         http_port: Optional[int] = None,
         grpc_port: Optional[int] = None,
         host: str = "127.0.0.1",
+        tls=None,
     ):
         self.registry = registry or ModelRegistry()
         self.core = InferenceCore(self.registry)
         self.host = host
+        self.tls = tls
         self.http_port = http_port or free_port()
         self.grpc_port = grpc_port or free_port()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -73,16 +72,11 @@ class ServerHarness:
 
     async def _serve(self) -> None:
         self._stop_event = asyncio.Event()
-        runner = web.AppRunner(build_app(self.core))
-        await runner.setup()
-        site = web.TCPSite(runner, self.host, self.http_port)
-        await site.start()
-        grpc_server = build_grpc_server(self.core, f"{self.host}:{self.grpc_port}")
-        await grpc_server.start()
+        runner, grpc_server = await start_frontends(
+            self.core, self.host, self.http_port, self.grpc_port, tls=self.tls)
         self._started.set()
         await self._stop_event.wait()
-        await grpc_server.stop(grace=1.0)
-        await runner.cleanup()
+        await stop_frontends(runner, grpc_server)
         await self.core.shutdown()
 
     def stop(self) -> None:

@@ -1,0 +1,97 @@
+"""TLS support for the serving harness.
+
+The reference clients all take SSL options (Python HTTP ``ssl/ssl_options``
+mirroring /root/reference/src/python/library/tritonclient/http/_client.py:110-181,
+gRPC ``ssl + root_certificates/private_key/certificate_chain`` mirroring
+grpc/_client.py:215-235, C++ ``HttpSslOptions`` http_client.h:45-86) but the
+reference repo ships no server to test them against.  This harness-side TLS
+config closes that loop so the client SSL paths are exercised hermetically.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class TLSConfig:
+    """Server-side TLS material (PEM file paths)."""
+
+    certfile: str
+    keyfile: str
+
+    def ssl_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.certfile, self.keyfile)
+        return ctx
+
+    def grpc_credentials(self):
+        import grpc
+
+        with open(self.keyfile, "rb") as f:
+            key = f.read()
+        with open(self.certfile, "rb") as f:
+            chain = f.read()
+        return grpc.ssl_server_credentials([(key, chain)])
+
+
+def generate_self_signed(
+    directory: str, common_name: str = "localhost", days: int = 7
+) -> TLSConfig:
+    """Write a throwaway self-signed cert+key pair under ``directory``.
+
+    SANs cover ``common_name``, ``localhost`` and ``127.0.0.1`` so the same
+    cert validates for hostname and loopback-IP connections.
+    """
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    san_names: list[x509.GeneralName] = [x509.DNSName("localhost")]
+    if common_name != "localhost":
+        san_names.insert(0, x509.DNSName(common_name))
+    san_names.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.SubjectAlternativeName(san_names), critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    certfile = os.path.join(directory, "server.crt")
+    keyfile = os.path.join(directory, "server.key")
+    with open(certfile, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(keyfile, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+    return TLSConfig(certfile=certfile, keyfile=keyfile)
+
+
+def maybe_tls(certfile: Optional[str], keyfile: Optional[str]) -> Optional[TLSConfig]:
+    if certfile is None and keyfile is None:
+        return None
+    if not (certfile and keyfile):
+        raise ValueError("--ssl-certfile and --ssl-keyfile must be given together")
+    for path in (certfile, keyfile):
+        if not os.path.isfile(path):
+            raise ValueError(f"TLS file not found: {path}")
+    return TLSConfig(certfile=certfile, keyfile=keyfile)
