@@ -1,0 +1,58 @@
+"""genai-perf-equivalent profiler against the live decode model."""
+
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from triton_client_tpu import genai_perf  # noqa: E402
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.server import ModelRegistry  # noqa: E402
+from triton_client_tpu.server.testing import ServerHarness  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+def test_profile_reports_llm_metrics(server):
+    report = genai_perf.profile(
+        server.grpc_url, "llama_decode", concurrency=2, output_tokens=3,
+        num_requests=4, stream_timeout=120.0)
+    assert report["errors"] == 0, report.get("first_error")
+    assert report["requests_completed"] == 4
+    # each request: 3 decode steps + the final sequence_end token
+    assert report["output_tokens_per_request"] == 4
+    for metric in ("time_to_first_token_ms", "inter_token_latency_ms",
+                   "request_latency_ms"):
+        p = report[metric]
+        assert p["p50"] > 0
+        assert p["min"] <= p["p50"] <= p["max"]
+    assert set(report["time_to_first_token_ms"]) == {
+        "avg", "min", "max", "p50", "p90", "p99"}
+    assert report["output_token_throughput_per_sec"] > 0
+    assert report["request_throughput_per_sec"] > 0
+
+
+def test_cli_export(server, tmp_path):
+    out = tmp_path / "profile.json"
+    rc = genai_perf.main([
+        "-m", "llama_decode", "-u", server.grpc_url,
+        "--concurrency", "1", "--output-tokens", "2",
+        "--num-requests", "2", "--profile-export-file", str(out),
+    ])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["model"] == "llama_decode"
+    assert report["errors"] == 0
+
+
+def test_rejects_non_decode_model(server):
+    with pytest.raises(RuntimeError, match="decode-contract"):
+        genai_perf.profile(server.grpc_url, "identity_fp32", concurrency=1,
+                           output_tokens=1, num_requests=1)

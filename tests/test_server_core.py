@@ -306,3 +306,125 @@ class TestSequenceEviction:
             inp, {"sequence_id": 7, "sequence_start": True})
         model.execute(inp, {"sequence_id": 7, "sequence_end": True})
         assert model._state == {} and model._touched == {}
+
+
+class TestInlineFastPath:
+    """Adaptive inline execution for sub-ms host models (core._InlineProfile)."""
+
+    def test_first_signature_sample_excluded_from_ema(self):
+        from triton_client_tpu.server.core import _InlineProfile
+
+        prof = _InlineProfile()
+        sig = (("INPUT0", (1, 16), "int32"),)
+        prof.observe(sig, 1.5)  # first execution: may include XLA compile
+        assert prof.ema is None and not prof.allows(sig)
+        prof.observe(sig, 0.0002)
+        assert prof.allows(sig)
+
+    def test_slow_model_demoted(self):
+        from triton_client_tpu.server.core import _InlineProfile
+
+        prof = _InlineProfile()
+        sig = ("s",)
+        prof.observe(sig, 0.0001)
+        prof.observe(sig, 0.0001)
+        assert prof.allows(sig)
+        for _ in range(8):
+            prof.observe(sig, 0.05)  # sustained slowness
+        assert not prof.allows(sig)
+
+    def test_unseen_signature_never_inline(self):
+        from triton_client_tpu.server.core import _InlineProfile
+
+        prof = _InlineProfile()
+        prof.observe(("a",), 0.0001)
+        prof.observe(("a",), 0.0001)
+        assert prof.allows(("a",)) and not prof.allows(("b",))
+
+    def test_live_path_warms_to_inline(self):
+        import triton_client_tpu.http as httpclient
+        from triton_client_tpu.server.testing import ServerHarness
+        from triton_client_tpu.server import ModelRegistry
+        from triton_client_tpu.models import zoo as z
+
+        registry = ModelRegistry()
+        z.register_all(registry)
+        with ServerHarness(registry) as h:
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                a = np.ones((1, 16), np.int32)
+                i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+                i0.set_data_from_numpy(a)
+                i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+                i1.set_data_from_numpy(a)
+                for _ in range(4):
+                    res = client.infer("simple", [i0, i1])
+                np.testing.assert_array_equal(res.as_numpy("OUTPUT0"), a + a)
+            prof = h.core._inline_profiles.get("simple")
+            assert prof is not None and prof.ema is not None
+            # host-placed sub-ms model must have earned the inline path
+            assert prof.allows(tuple(sorted(
+                ("INPUT%d" % i, (1, 16), "int32") for i in range(2))))
+
+
+class TestReloadInvalidation:
+    """Per-model caches must not survive a model reload (registry
+    generation counter)."""
+
+    def test_generation_bumps_on_load_unload(self):
+        from triton_client_tpu.server import ModelRegistry
+
+        registry = ModelRegistry()
+        registry.register_model(zoo.make_simple())
+        g0 = registry.generation("simple")
+        registry.unload("simple")
+        g1 = registry.generation("simple")
+        registry.load("simple")
+        g2 = registry.generation("simple")
+        assert g0 < g1 < g2
+
+    def test_inline_profile_dropped_on_reload(self):
+        import triton_client_tpu.http as httpclient
+        from triton_client_tpu.server import ModelRegistry
+        from triton_client_tpu.server.testing import ServerHarness
+
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        with ServerHarness(registry) as h:
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                a = np.ones((1, 16), np.int32)
+                i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+                i0.set_data_from_numpy(a)
+                i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+                i1.set_data_from_numpy(a)
+                for _ in range(3):
+                    client.infer("simple", [i0, i1])
+                warm = h.core._inline_profiles["simple"]
+                assert warm.ema is not None
+                client.unload_model("simple")
+                client.load_model("simple")
+                res = client.infer("simple", [i0, i1])
+                np.testing.assert_array_equal(res.as_numpy("OUTPUT0"), a + a)
+                fresh = h.core._inline_profiles["simple"]
+                # reloaded instance: old EMA forgotten, first exec off-loop
+                assert fresh is not warm
+
+    def test_batcher_retired_on_reload(self):
+        import triton_client_tpu.http as httpclient
+        from triton_client_tpu.server import ModelRegistry
+        from triton_client_tpu.server.testing import ServerHarness
+
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        with ServerHarness(registry) as h:
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                x = np.ones((1, 512), np.float32)
+                inp = httpclient.InferInput("INPUT", [1, 512], "FP32")
+                inp.set_data_from_numpy(x)
+                client.infer("dense_tpu", [inp])
+                old = h.core._batchers.get("dense_tpu")
+                assert old is not None
+                client.unload_model("dense_tpu")
+                client.load_model("dense_tpu")
+                res = client.infer("dense_tpu", [inp])
+                assert res.as_numpy("OUTPUT").shape == (1, 512)
+                assert h.core._batchers.get("dense_tpu") is not old

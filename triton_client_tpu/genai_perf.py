@@ -1,0 +1,313 @@
+"""genai-perf-equivalent: LLM generation profiler over the sequence-stream protocol.
+
+The reference repo carries the genai-perf tool only as a relocated-docs stub
+(/root/reference/src/c++/perf_analyzer/genai-perf/README.md), so — like
+``perf_analyzer.py`` — this is designed from the public CLI contract rather
+than ported: profile a generation model at fixed concurrency and report the
+LLM-serving metric set:
+
+- **TTFT** (time to first token): prefill request → first token callback
+- **ITL** (inter-token latency): gap between consecutive token callbacks
+- **request latency**: prefill sent → last token received
+- **output token throughput**: aggregate generated tokens/sec
+- **request throughput**: completed generations/sec
+
+Targets models speaking this framework's KV-cache decode contract
+(``llama_decode``: TOKENS prompt window with ``sequence_start``, then one
+fed-back token per step over a gRPC bidi stream — see
+``examples/simple_grpc_decode_client.py``), which is the TPU-native analog
+of the decoupled-LLM endpoints genai-perf drives.
+
+Usage:
+    python -m triton_client_tpu.genai_perf -m llama_decode -u localhost:8001 \
+        --concurrency 4 --output-tokens 32 --num-requests 16 \
+        --profile-export-file profile.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class _GenStats:
+    """Per-request generation timings (all seconds)."""
+
+    ttft: List[float] = field(default_factory=list)
+    itl: List[float] = field(default_factory=list)
+    request_latency: List[float] = field(default_factory=list)
+    tokens_out: int = 0
+    requests: int = 0
+    errors: int = 0
+    first_error: Optional[str] = None
+
+    def merge(self, other: "_GenStats") -> None:
+        self.ttft.extend(other.ttft)
+        self.itl.extend(other.itl)
+        self.request_latency.extend(other.request_latency)
+        self.tokens_out += other.tokens_out
+        self.requests += other.requests
+        self.errors += other.errors
+        if self.first_error is None:
+            self.first_error = other.first_error
+
+
+def _percentiles(values: List[float]) -> Dict[str, float]:
+    if not values:
+        return {}
+    arr = np.asarray(values) * 1e3  # → ms
+    return {
+        "avg": float(arr.mean()),
+        "min": float(arr.min()),
+        "max": float(arr.max()),
+        "p50": float(np.percentile(arr, 50)),
+        "p90": float(np.percentile(arr, 90)),
+        "p99": float(np.percentile(arr, 99)),
+    }
+
+
+def _prompt_window(prompt_len: int, rng: np.random.Generator) -> np.ndarray:
+    # printable-byte tokens, right-aligned in the window like the
+    # llama_preprocess tokenizer
+    window = np.zeros(prompt_len, np.int32)
+    n = max(1, prompt_len // 2)
+    window[prompt_len - n:] = rng.integers(32, 127, n, dtype=np.int32)
+    return window
+
+
+def _resolve_decode_contract(client, model_name: str, model_version: str,
+                             prompt_tokens: Optional[int] = None):
+    md = client.get_model_metadata(model_name, model_version, as_json=True)
+    cfg = client.get_model_config(model_name, model_version, as_json=True)
+    if "config" in cfg:
+        cfg = cfg["config"]
+    inp = md["inputs"][0]
+    token_output = None
+    for o in md["outputs"]:
+        if o["datatype"] == "INT32":
+            token_output = o["name"]
+            break
+    if token_output is None:
+        raise RuntimeError(
+            f"model '{model_name}' has no INT32 output to feed back as the "
+            "next token — not a decode-contract model")
+    # Window size: explicit flag > advertised config parameter > fixed
+    # metadata dims (dynamic -1 dims excluded).
+    if prompt_tokens is None:
+        advertised = (cfg.get("parameters") or {}).get("prompt_tokens", {})
+        if advertised.get("string_value"):
+            prompt_tokens = int(advertised["string_value"])
+    if prompt_tokens is None:
+        fixed = [int(s) for s in inp["shape"] if int(s) > 0]
+        if not fixed:
+            raise RuntimeError(
+                f"model '{model_name}' has a fully dynamic prompt input and "
+                "advertises no 'prompt_tokens' parameter — pass "
+                "--prompt-tokens")
+        prompt_tokens = int(np.prod(fixed))
+    return inp["name"], inp["datatype"], prompt_tokens, token_output
+
+
+def _worker(url, model_name, input_name, prompt_len, token_output,
+            output_tokens, n_requests, worker_id, stats: _GenStats,
+            barrier: threading.Barrier, stream_timeout: float) -> None:
+    import triton_client_tpu.grpc as grpcclient
+
+    rng = np.random.default_rng(worker_id)
+    local = _GenStats()
+    try:
+        with grpcclient.InferenceServerClient(url) as client:
+            results: "queue.Queue" = queue.Queue()
+            client.start_stream(
+                callback=lambda result, error: results.put((result, error)))
+            barrier.wait(timeout=60)
+            for req in range(n_requests):
+                seq_id = worker_id * 1_000_000 + req + 1
+                window = _prompt_window(prompt_len, rng)
+                inp = grpcclient.InferInput(
+                    input_name, [prompt_len], "INT32")
+                inp.set_data_from_numpy(window)
+                t_start = time.perf_counter()
+                client.async_stream_infer(
+                    model_name, [inp], sequence_id=seq_id,
+                    sequence_start=True)
+                t_prev = None
+                ok = True
+                for step in range(output_tokens):
+                    res, err = results.get(timeout=stream_timeout)
+                    t_now = time.perf_counter()
+                    if err is not None:
+                        local.errors += 1
+                        if local.first_error is None:
+                            local.first_error = str(err)
+                        ok = False
+                        break
+                    if step == 0:
+                        local.ttft.append(t_now - t_start)
+                    else:
+                        local.itl.append(t_now - t_prev)
+                    t_prev = t_now
+                    local.tokens_out += 1
+                    tok = np.asarray(res.as_numpy(token_output)).astype(
+                        np.int32).reshape(1)
+                    nxt = grpcclient.InferInput(input_name, [1], "INT32")
+                    nxt.set_data_from_numpy(tok)
+                    client.async_stream_infer(
+                        model_name, [nxt], sequence_id=seq_id,
+                        sequence_end=(step == output_tokens - 1))
+                if ok:
+                    # the sequence_end step still returns one final token
+                    res, err = results.get(timeout=stream_timeout)
+                    t_now = time.perf_counter()
+                    if err is None:
+                        local.itl.append(t_now - t_prev)
+                        local.tokens_out += 1
+                        local.request_latency.append(t_now - t_start)
+                        local.requests += 1
+                    else:
+                        local.errors += 1
+                        if local.first_error is None:
+                            local.first_error = str(err)
+            client.stop_stream()
+    except Exception as e:  # noqa: BLE001 — worker reports, run continues
+        local.errors += 1
+        if local.first_error is None:
+            local.first_error = str(e)
+    with _MERGE_LOCK:
+        stats.merge(local)
+
+
+_MERGE_LOCK = threading.Lock()
+
+
+def profile(url: str, model_name: str, model_version: str = "",
+            concurrency: int = 1, output_tokens: int = 16,
+            num_requests: int = 8, stream_timeout: float = 600.0,
+            prompt_tokens: Optional[int] = None) -> dict:
+    """Run one profiling pass; returns the genai-perf-style metrics dict."""
+    import triton_client_tpu.grpc as grpcclient
+
+    with grpcclient.InferenceServerClient(url) as client:
+        input_name, dtype, prompt_len, token_output = \
+            _resolve_decode_contract(client, model_name, model_version,
+                                     prompt_tokens)
+        if dtype != "INT32":
+            raise RuntimeError(
+                f"decode contract requires an INT32 token input, got {dtype}")
+
+    per_worker = max(1, num_requests // concurrency)
+    stats = _GenStats()
+    barrier = threading.Barrier(concurrency)
+    threads = []
+    t0 = time.perf_counter()
+    for w in range(concurrency):
+        t = threading.Thread(
+            target=_worker,
+            args=(url, model_name, input_name, prompt_len, token_output,
+                  output_tokens, per_worker, w + 1, stats, barrier,
+                  stream_timeout),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    report = {
+        "model": model_name,
+        "concurrency": concurrency,
+        "output_tokens_per_request": output_tokens + 1,
+        "requests_completed": stats.requests,
+        "errors": stats.errors,
+        "wall_s": round(wall, 3),
+        "time_to_first_token_ms": _percentiles(stats.ttft),
+        "inter_token_latency_ms": _percentiles(stats.itl),
+        "request_latency_ms": _percentiles(stats.request_latency),
+        "output_token_throughput_per_sec":
+            round(stats.tokens_out / wall, 2) if wall > 0 else 0.0,
+        "request_throughput_per_sec":
+            round(stats.requests / wall, 2) if wall > 0 else 0.0,
+    }
+    if stats.first_error:
+        report["first_error"] = stats.first_error
+    return report
+
+
+def _print_table(report: dict) -> None:
+    print(f"\nModel: {report['model']}  concurrency={report['concurrency']}  "
+          f"requests={report['requests_completed']}  "
+          f"errors={report['errors']}")
+    rows = [
+        ("Time to first token (ms)", report["time_to_first_token_ms"]),
+        ("Inter token latency (ms)", report["inter_token_latency_ms"]),
+        ("Request latency (ms)", report["request_latency_ms"]),
+    ]
+    hdr = f"{'Metric':<28}{'avg':>9}{'min':>9}{'max':>9}{'p50':>9}{'p90':>9}{'p99':>9}"
+    print(hdr)
+    print("-" * len(hdr))
+    for name, p in rows:
+        if not p:
+            continue
+        print(f"{name:<28}" + "".join(
+            f"{p[k]:>9.2f}" for k in ("avg", "min", "max", "p50", "p90", "p99")))
+    print(f"Output token throughput (per sec): "
+          f"{report['output_token_throughput_per_sec']}")
+    print(f"Request throughput (per sec): "
+          f"{report['request_throughput_per_sec']}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu-genai-perf",
+        description="LLM generation profiler (genai-perf CLI contract)")
+    parser.add_argument("-m", "--model", required=True)
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("--model-version", default="")
+    parser.add_argument("--concurrency", type=int, default=1)
+    parser.add_argument("--output-tokens", type=int, default=16,
+                        help="decode steps per request (one extra final "
+                        "token arrives on sequence_end)")
+    parser.add_argument("--num-requests", type=int, default=8,
+                        help="total generations across all workers")
+    parser.add_argument("--prompt-tokens", type=int, default=None,
+                        help="prefill window size (default: the model's "
+                        "advertised 'prompt_tokens' config parameter)")
+    parser.add_argument("--stream-timeout", type=float, default=600.0)
+    parser.add_argument("--profile-export-file", default=None,
+                        help="write the full metrics dict as JSON")
+    args = parser.parse_args(argv)
+
+    try:
+        report = profile(
+            args.url, args.model, args.model_version,
+            concurrency=args.concurrency, output_tokens=args.output_tokens,
+            num_requests=args.num_requests,
+            stream_timeout=args.stream_timeout,
+            prompt_tokens=args.prompt_tokens)
+    except Exception as e:  # noqa: BLE001 — CLI surface
+        print(f"genai-perf failed: {e}", file=sys.stderr)
+        return 1
+
+    _print_table(report)
+    if args.profile_export_file:
+        with open(args.profile_export_file, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"exported: {args.profile_export_file}")
+    if report["errors"] and not report["requests_completed"]:
+        print(f"all requests failed: {report.get('first_error')}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -312,6 +312,9 @@ class DecodeModel:
                      ("NEXT_LOGIT", "FP32", [1])],
             sequence_batching=True,
             instance_kind="KIND_TPU",
+            # advertised so load tools (genai_perf) can size the prefill
+            # window without out-of-band knowledge
+            parameters={"prompt_tokens": str(self._prompt_len)},
         )
         outer = self
 

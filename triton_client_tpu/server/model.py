@@ -67,6 +67,7 @@ def make_config(
     sequence_batching: bool = False,
     labels: Optional[Dict[str, List[str]]] = None,
     instance_kind: Optional[str] = None,
+    parameters: Optional[Dict[str, str]] = None,
 ) -> pb.ModelConfig:
     """Convenience builder for a ModelConfig proto.
 
@@ -94,6 +95,8 @@ def make_config(
         grp.name = name
         grp.kind = pb.ModelInstanceGroup.Kind.Value(instance_kind)
         grp.count = 1
+    for key, value in (parameters or {}).items():
+        cfg.parameters[key].string_value = str(value)
     return cfg
 
 

@@ -33,6 +33,9 @@ class ModelRegistry:
         self._original_configs: Dict[str, bytes] = {}
         self._models: Dict[str, Model] = {}
         self._states: Dict[str, tuple] = {}  # name -> (state, reason)
+        # bumped on every load/unload so per-model caches keyed on the name
+        # (batchers, inline-execution profiles) can detect a swapped instance
+        self._generations: Dict[str, int] = {}
         self._lock = threading.RLock()
         self._repository_path = repository_path
         if repository_path:
@@ -59,6 +62,7 @@ class ModelRegistry:
             self._original_configs[model.name] = model.config.SerializeToString()
             self._models[model.name] = model
             self._states[model.name] = ("READY", "")
+            self._generations[model.name] = self._generations.get(model.name, 0) + 1
 
     # -- v2 repository API --------------------------------------------------
     def load(self, name: str, config_override: Optional[str] = None, files=None) -> None:
@@ -83,6 +87,7 @@ class ModelRegistry:
                 raise
             self._models[name] = model
             self._states[name] = ("READY", "")
+            self._generations[name] = self._generations.get(name, 0) + 1
 
     def unload(self, name: str, unload_dependents: bool = False) -> None:
         with self._lock:
@@ -91,6 +96,7 @@ class ModelRegistry:
                 raise InferError(f"failed to unload '{name}': model is not loaded")
             model.unload()
             self._states[name] = ("UNAVAILABLE", "unloaded")
+            self._generations[name] = self._generations.get(name, 0) + 1
             if unload_dependents and model.config.HasField("ensemble_scheduling"):
                 for step in model.config.ensemble_scheduling.step:
                     if step.model_name in self._models:
@@ -122,6 +128,12 @@ class ModelRegistry:
                 http_status=400,
             )
         return model
+
+    def generation(self, name: str) -> int:
+        """Monotonic per-name counter; changes whenever the served instance
+        behind ``name`` is swapped (load/reload/unload)."""
+        with self._lock:
+            return self._generations.get(name, 0)
 
     def is_ready(self, name: str, version: str = "") -> bool:
         with self._lock:
