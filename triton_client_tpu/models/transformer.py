@@ -39,9 +39,10 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import parallel
 
 MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
 
@@ -79,32 +80,19 @@ def mesh_shape_for(n_devices: int, cfg: TransformerConfig) -> Dict[str, int]:
 
     Priority tp > sp > pp > ep > dp (ICI-friendly inner axes first); any
     non-power-of-two remainder lands on dp."""
-    sizes = {"dp": 1, "pp": 1, "ep": 1, "sp": 1, "tp": 1}
-    rem = n_devices
-    # the sharded model dim must be divisible by the axis size
-    dims = {
-        "tp": cfg.n_heads,
-        "sp": 4,  # seq chunks; callers pick seq lengths divisible by sp
-        "pp": cfg.n_layers,
-        "ep": max(cfg.n_experts, 1),
-    }
-
-    def can_grow(ax):
-        new = sizes[ax] * 2
-        return rem % 2 == 0 and new <= dims[ax] and dims[ax] % new == 0
-
-    # first pass: one factor of 2 per axis (spread before deepening)
-    for ax in ("tp", "sp", "pp", "ep"):
-        if can_grow(ax):
-            sizes[ax] *= 2
-            rem //= 2
-    # second pass: deepen axes if devices remain
-    for ax in ("tp", "sp", "pp", "ep"):
-        while can_grow(ax):
-            sizes[ax] *= 2
-            rem //= 2
-    sizes["dp"] *= rem
-    return sizes
+    return parallel.factorize_mesh(
+        n_devices,
+        # the sharded model dim must be divisible by the axis size
+        limits={
+            "tp": cfg.n_heads,
+            "sp": 4,  # seq chunks; callers pick seq lengths divisible by sp
+            "pp": cfg.n_layers,
+            "ep": max(cfg.n_experts, 1),
+        },
+        axes=MESH_AXES,
+        priority=("tp", "sp", "pp", "ep"),
+        remainder_axis="dp",
+    )
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -114,9 +102,8 @@ def make_mesh(n_devices: Optional[int] = None,
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
-    shape = mesh_shape_for(len(devices), cfg)
-    arr = np.asarray(devices).reshape([shape[a] for a in MESH_AXES])
-    return Mesh(arr, MESH_AXES)
+    return parallel.build_mesh(
+        mesh_shape_for(len(devices), cfg), MESH_AXES, devices)
 
 
 # ---------------------------------------------------------------------------
@@ -211,43 +198,9 @@ def _rope(q, k, positions, theta):
 
 
 def _ring_attention(q, k, v, cfg: TransformerConfig):
-    """Causal ring attention over the ``sp`` axis.
-
-    q,k,v: [B, Hl, Sc, K] local chunks.  K/V circulate the ring via
-    ``ppermute``; a flash-style online softmax accumulates partials so the
-    full sequence never materialises on one device (the TPU-native answer to
-    long-context scaling — SURVEY.md §5 long-context note)."""
-    sp = lax.axis_size("sp")
-    me = lax.axis_index("sp")
-    B, Hl, Sc, Kd = q.shape
-    scale = 1.0 / math.sqrt(Kd)
-    qpos = me * Sc + jnp.arange(Sc)
-    q32 = q.astype(jnp.float32)
-
-    def body(r, carry):
-        k_c, v_c, m, l, o = carry
-        src = (me - r) % sp  # original owner of the chunk currently held
-        kpos = src * Sc + jnp.arange(Sc)
-        s = jnp.einsum("bhqk,bhsk->bhqs", q32, k_c.astype(jnp.float32)) * scale
-        mask = (qpos[:, None] >= kpos[None, :]).astype(jnp.float32)
-        s = jnp.where(mask > 0, s, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None]) * mask
-        corr = jnp.exp(m - m_new)
-        l_new = corr * l + jnp.sum(p, axis=-1)
-        o_new = (corr[..., None] * o
-                 + jnp.einsum("bhqs,bhsk->bhqk", p, v_c.astype(jnp.float32)))
-        perm = [(j, (j + 1) % sp) for j in range(sp)]
-        k_n = lax.ppermute(k_c, "sp", perm)
-        v_n = lax.ppermute(v_c, "sp", perm)
-        return k_n, v_n, m_new, l_new, o_new
-
-    m0 = jnp.full((B, Hl, Sc), -1e30, jnp.float32)
-    l0 = jnp.zeros((B, Hl, Sc), jnp.float32)
-    o0 = jnp.zeros((B, Hl, Sc, Kd), jnp.float32)
-    _, _, _, l, o = lax.fori_loop(0, sp, body, (k, v, m0, l0, o0))
-    out = o / jnp.maximum(l, 1e-30)[..., None]
-    return out.astype(q.dtype)
+    """Causal ring attention over the ``sp`` axis
+    (parallel.collectives.ring_attention)."""
+    return parallel.ring_attention(q, k, v, "sp", causal=True)
 
 
 def _flash_enabled() -> bool:
@@ -379,21 +332,11 @@ def _local_loss(params, tokens, labels, cfg: TransformerConfig,
 
 
 def _replicated_axes(spec: P) -> Tuple[str, ...]:
-    used = set()
-    for entry in spec:
-        if entry is None:
-            continue
-        if isinstance(entry, (tuple, list)):
-            used.update(entry)
-        else:
-            used.add(entry)
-    return tuple(a for a in MESH_AXES if a not in used)
+    return parallel.replicated_axes(spec, MESH_AXES)
 
 
 def _sync_grads(grads, specs):
-    return {k: (lax.psum(g, _replicated_axes(specs[k]))
-                if _replicated_axes(specs[k]) else g)
-            for k, g in grads.items()}
+    return parallel.sync_replicated_grads(grads, specs, MESH_AXES)
 
 
 # ---------------------------------------------------------------------------

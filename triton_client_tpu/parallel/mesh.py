@@ -1,0 +1,71 @@
+"""Named-axis device mesh construction.
+
+The scaling-book recipe: pick a mesh whose inner axes carry the
+bandwidth-hungry collectives (tensor/sequence parallel over ICI), annotate
+shardings, let XLA insert the collectives.  ``factorize_mesh`` does the
+"pick a mesh" step automatically under per-axis divisibility limits.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def factorize_mesh(
+    n_devices: int,
+    limits: Dict[str, int],
+    axes: Sequence[str],
+    priority: Optional[Sequence[str]] = None,
+    remainder_axis: Optional[str] = None,
+) -> Dict[str, int]:
+    """Greedy power-of-two factorization of ``n_devices`` onto named axes.
+
+    ``limits[ax]`` is the model dimension the axis shards — the axis size
+    must divide it.  ``priority`` orders growth (ICI-friendly inner axes
+    first); each listed axis gets one factor of 2 before any axis deepens
+    (spread before deepening).  Any remainder (including non-power-of-two
+    factors) lands on ``remainder_axis`` (default: the first axis not in
+    ``priority``, e.g. data parallel, which has no divisibility constraint).
+    """
+    if priority is None:
+        priority = [a for a in axes if a in limits]
+    if remainder_axis is None:
+        spare = [a for a in axes if a not in priority]
+        remainder_axis = spare[0] if spare else axes[0]
+    sizes = {a: 1 for a in axes}
+    rem = n_devices
+
+    def can_grow(ax: str) -> bool:
+        new = sizes[ax] * 2
+        lim = limits.get(ax, 1)
+        return rem % 2 == 0 and new <= lim and lim % new == 0
+
+    for ax in priority:
+        if can_grow(ax):
+            sizes[ax] *= 2
+            rem //= 2
+    for ax in priority:
+        while can_grow(ax):
+            sizes[ax] *= 2
+            rem //= 2
+    sizes[remainder_axis] *= rem
+    return sizes
+
+
+def build_mesh(shape: Dict[str, int], axes: Sequence[str], devices=None):
+    """A ``jax.sharding.Mesh`` over ``devices`` with ``shape[a]`` extent per
+    axis (axis order = ``axes``)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod([shape[a] for a in axes]))
+    if len(devices) != n:
+        raise ValueError(
+            f"mesh shape {shape} needs exactly {n} devices, got "
+            f"{len(devices)} — slice the device list to match")
+    arr = np.asarray(devices).reshape([shape[a] for a in axes])
+    return Mesh(arr, tuple(axes))

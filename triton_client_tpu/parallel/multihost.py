@@ -1,0 +1,61 @@
+"""Multi-host (DCN) bootstrap for the serving harness.
+
+On a TPU pod slice every host runs the same program; ``jax.distributed``
+connects them so ``jax.devices()`` spans the slice and XLA collectives ride
+ICI within a host / DCN across hosts.  The serving harness exposes this via
+``python -m triton_client_tpu.server --coordinator-address host:port
+--num-processes N --process-id I`` (every host serves its own frontends;
+requests on any host execute the globally-sharded computation).
+
+The reference client has no distributed backend of its own (SURVEY.md §2.4
+— NCCL/MPI live in its server); this is the TPU-native equivalent surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize ``jax.distributed`` if multi-host args/env are present.
+
+    Arguments fall back to the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``);
+    on TPU pod slices jax can also auto-detect all three.  Returns True when
+    distributed mode was (or already is) active.  Must run before the first
+    backend use.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None:
+        return False
+
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # another path (pod launcher, user code) initialized it first —
+        # distributed mode is active either way
+        if "already" not in str(e).lower():
+            raise
+    _initialized = True
+    return True

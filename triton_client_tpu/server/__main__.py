@@ -43,7 +43,26 @@ def main() -> None:
                         help="serve HTTPS/secure-gRPC with this PEM cert chain")
     parser.add_argument("--ssl-keyfile", default=None,
                         help="PEM private key matching --ssl-certfile")
+    parser.add_argument("--coordinator-address", default=None,
+                        help="host:port of process 0 — enables multi-host "
+                        "(jax.distributed over DCN); every host runs this "
+                        "server and shares the global device mesh")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
     args = parser.parse_args()
+    from ..parallel import initialize_multihost
+
+    if (args.num_processes is not None or args.process_id is not None) \
+            and not (args.coordinator_address
+                     or os.environ.get("JAX_COORDINATOR_ADDRESS")):
+        parser.error("--num-processes/--process-id require "
+                     "--coordinator-address (or JAX_COORDINATOR_ADDRESS)")
+    if initialize_multihost(args.coordinator_address, args.num_processes,
+                            args.process_id):
+        import jax
+
+        print(f"multi-host: process {jax.process_index()}/"
+              f"{jax.process_count()}, {len(jax.devices())} global devices")
     try:
         tls = maybe_tls(args.ssl_certfile, args.ssl_keyfile)
     except ValueError as e:
