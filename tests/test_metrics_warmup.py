@@ -1,0 +1,199 @@
+"""Prometheus /metrics endpoint + model warmup."""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import triton_client_tpu.http as httpclient  # noqa: E402
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.server import (  # noqa: E402
+    JaxModel,
+    ModelRegistry,
+    make_config,
+)
+from triton_client_tpu.server.testing import ServerHarness, free_port  # noqa: E402
+
+
+def _warm_model(name="warmed"):
+    calls = []
+
+    cfg = make_config(
+        name,
+        inputs=[("X", "FP32", [1, 8])],
+        outputs=[("Y", "FP32", [1, 8])],
+        instance_kind="KIND_CPU",
+        warmup=[{
+            "name": "zeros", "count": 2,
+            "inputs": {"X": ("FP32", [1, 8], "zero")},
+        }, {
+            "name": "randoms", "count": 1,
+            "inputs": {"X": ("FP32", [1, 8], "random")},
+        }],
+    )
+
+    def fn(X):
+        calls.append(1)
+        return {"Y": jnp.asarray(X) * 2.0}
+
+    return JaxModel(cfg, fn, jit=False), calls
+
+
+class TestWarmup:
+    def test_samples_run_before_serving_and_skip_stats(self):
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        model, calls = _warm_model()
+        registry.register_model(model)
+        with ServerHarness(registry) as h:
+            assert len(calls) == 3  # zeros x2 + randoms x1, before ready
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                stats = client.get_inference_statistics("warmed")
+                s = stats["model_stats"][0]["inference_stats"]
+                assert s["success"]["count"] == 0  # warmup not in stats
+                x = np.ones((1, 8), np.float32)
+                inp = httpclient.InferInput("X", [1, 8], "FP32")
+                inp.set_data_from_numpy(x)
+                res = client.infer("warmed", [inp])
+                np.testing.assert_array_equal(res.as_numpy("Y"), x * 2)
+
+    def test_warmup_config_survives_wire(self):
+        model, _ = _warm_model("warmed2")
+        registry = ModelRegistry()
+        registry.register_model(model)
+        with ServerHarness(registry) as h:
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                cfg = client.get_model_config("warmed2")
+                assert len(cfg["model_warmup"]) == 2
+                assert cfg["model_warmup"][0]["inputs"]["X"]["zero_data"]
+
+
+class TestMetricsEndpoint:
+    @pytest.fixture(scope="class")
+    def server(self):
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        with ServerHarness(registry, metrics_port=free_port()) as h:
+            yield h
+
+    def _scrape(self, url):
+        with urllib.request.urlopen(f"http://{url}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            return r.read().decode()
+
+    def test_counters_present_and_increment(self, server):
+        with httpclient.InferenceServerClient(server.http_url) as client:
+            a = np.ones((1, 16), np.int32)
+            i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+            i0.set_data_from_numpy(a)
+            i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+            i1.set_data_from_numpy(a)
+            for _ in range(3):
+                client.infer("simple", [i0, i1])
+        body = self._scrape(server.http_url)
+        assert "# TYPE nv_inference_request_success counter" in body
+        line = next(l for l in body.splitlines()
+                    if l.startswith("nv_inference_request_success")
+                    and 'model="simple"' in l)
+        assert float(line.rsplit(" ", 1)[1]) >= 3
+        assert "nv_inference_queue_duration_us" in body
+        assert "nv_inference_compute_infer_duration_us" in body
+
+    def test_dedicated_metrics_port(self, server):
+        body = self._scrape(f"{server.host}:{server.metrics_port}")
+        assert "nv_inference_count" in body
+        # the dedicated port serves ONLY metrics
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://{server.host}:{server.metrics_port}/v2", timeout=10)
+
+
+class TestWarmupOnLoad:
+    def test_repository_load_reruns_warmup(self):
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        model, calls = _warm_model("rewarm")
+        registry.register_model(model)
+        with ServerHarness(registry) as h:
+            assert len(calls) == 3  # startup warmup
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                client.unload_model("rewarm")
+                client.load_model("rewarm")
+                # register_model's factory returns the same instance, so the
+                # repository load re-ran its warmup samples
+                assert len(calls) == 6
+
+    def test_failing_warmup_fails_load_but_not_server(self, tmp_path):
+        import textwrap
+
+        mdir = tmp_path / "badwarm" / "1"
+        mdir.mkdir(parents=True)
+        (tmp_path / "badwarm" / "config.pbtxt").write_text(textwrap.dedent("""
+            name: "badwarm"
+            platform: "jax"
+            backend: "jax"
+            input [ { name: "X" data_type: TYPE_FP32 dims: [ 1, 4 ] } ]
+            output [ { name: "Y" data_type: TYPE_FP32 dims: [ 1, 4 ] } ]
+            model_warmup [
+              { name: "missing"
+                inputs { key: "X" value: { data_type: TYPE_FP32 dims: [ 1, 4 ]
+                                           input_data_file: "nope.bin" } } }
+            ]
+        """))
+        (mdir / "model.py").write_text(textwrap.dedent("""
+            import jax.numpy as jnp
+            from triton_client_tpu.server.model import JaxModel
+
+            def get_model(config):
+                return JaxModel(config, lambda X: {"Y": jnp.asarray(X)})
+        """))
+        registry = ModelRegistry(repository_path=str(tmp_path))
+        zoo.register_all(registry)
+        with ServerHarness(registry) as h:
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                from triton_client_tpu.utils import InferenceServerException
+
+                with pytest.raises(InferenceServerException,
+                                   match="warmup failed"):
+                    client.load_model("badwarm")
+                # the failed load leaves the server and other models serving
+                assert client.is_server_live()
+                assert not client.is_model_ready("badwarm")
+                assert client.is_model_ready("simple")
+
+    def test_input_data_file_resolves_in_model_dir(self, tmp_path):
+        import textwrap
+
+        mdir = tmp_path / "filewarm"
+        (mdir / "1").mkdir(parents=True)
+        (mdir / "warmup").mkdir()
+        np.arange(4, dtype=np.float32).tofile(mdir / "warmup" / "x.bin")
+        (mdir / "config.pbtxt").write_text(textwrap.dedent("""
+            name: "filewarm"
+            platform: "jax"
+            backend: "jax"
+            input [ { name: "X" data_type: TYPE_FP32 dims: [ 1, 4 ] } ]
+            output [ { name: "Y" data_type: TYPE_FP32 dims: [ 1, 4 ] } ]
+            model_warmup [
+              { name: "fromfile"
+                inputs { key: "X" value: { data_type: TYPE_FP32 dims: [ 1, 4 ]
+                                           input_data_file: "x.bin" } } }
+            ]
+        """))
+        (mdir / "1" / "model.py").write_text(textwrap.dedent("""
+            import jax.numpy as jnp
+            from triton_client_tpu.server.model import JaxModel
+
+            def get_model(config):
+                return JaxModel(config, lambda X: {"Y": jnp.asarray(X)})
+        """))
+        registry = ModelRegistry(repository_path=str(tmp_path))
+        registry.load("filewarm")
+        with ServerHarness(registry) as h:
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                assert client.is_model_ready("filewarm")

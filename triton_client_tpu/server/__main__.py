@@ -43,6 +43,10 @@ def main() -> None:
                         help="serve HTTPS/secure-gRPC with this PEM cert chain")
     parser.add_argument("--ssl-keyfile", default=None,
                         help="PEM private key matching --ssl-certfile")
+    parser.add_argument("--metrics-port", type=int, default=8002,
+                        help="dedicated Prometheus /metrics port (Triton "
+                        "convention; 0 disables — /metrics stays on the "
+                        "main HTTP port either way)")
     parser.add_argument("--coordinator-address", default=None,
                         help="host:port of process 0 — enables multi-host "
                         "(jax.distributed over DCN); every host runs this "
@@ -85,14 +89,20 @@ def main() -> None:
     core = InferenceCore(registry)
 
     async def serve():
+        warmed = await core.warmup_models()
+        if warmed:
+            print(f"warmed up: {warmed}")
         # hold the returned handles: a dropped grpc.aio.Server is torn down
         # by its finalizer, silently closing the port
         frontends = await start_frontends(
-            core, args.host, args.http_port, args.grpc_port, tls=tls)
+            core, args.host, args.http_port, args.grpc_port, tls=tls,
+            metrics_port=args.metrics_port or None)
         scheme = "https" if tls else "http"
+        metrics = (f" metrics={args.host}:{args.metrics_port}"
+                   if args.metrics_port else "")
         print(
             f"serving v2 protocol: {scheme}={args.host}:{args.http_port} "
-            f"grpc{'s' if tls else ''}={args.host}:{args.grpc_port}"
+            f"grpc{'s' if tls else ''}={args.host}:{args.grpc_port}{metrics}"
         )
         await asyncio.Event().wait()
 

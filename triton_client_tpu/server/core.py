@@ -381,6 +381,67 @@ class InferenceCore:
             and not any(o.shm is not None for o in request.outputs)
         )
 
+    async def _warmup_one(self, model: Model) -> int:
+        """Run one model's configured warmup samples through the real
+        execute path (off the event loop).  Warmup executions do not count
+        toward inference statistics, but they do warm the XLA compile cache
+        and the inline-execution profiles."""
+        from .warmup import warmup_samples
+
+        if isinstance(model, EnsembleModel):
+            # ensembles are executed by the core; their members warm
+            # individually
+            return 0
+        n = 0
+        for _name, count, inputs in warmup_samples(model):
+            for _ in range(count):
+                await self._run_model(model, dict(inputs), {},
+                                      keep_device=set())
+                n += 1
+        return n
+
+    async def warmup_models(self) -> Dict[str, int]:
+        """Warm every ready model that declares ``model_warmup`` samples.
+
+        A failing warmup unloads THAT model (Triton semantics: bad warmup
+        fails the model, not the server) and reports it under
+        ``"<name>:error"``; serving proceeds for everything else."""
+        ran: Dict[str, Any] = {}
+        for model in self.registry.ready_models():
+            if not model.config.model_warmup:
+                continue
+            try:
+                ran[model.name] = await self._warmup_one(model)
+            except Exception as e:  # noqa: BLE001 — isolate per-model
+                ran[f"{model.name}:error"] = str(e)
+                try:
+                    self.registry.unload(model.name)
+                except InferError:
+                    pass
+        return ran
+
+    async def load_model(self, name: str, config_override=None,
+                         files=None) -> None:
+        """Repository-API load: registry swap off the event loop, then the
+        fresh instance's warmup samples (Triton runs warmup at every load,
+        not just server start).  A failing warmup fails the load."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, lambda: self.registry.load(
+                name, config_override=config_override, files=files))
+        model = self.registry.get(name)
+        if model.config.model_warmup:
+            try:
+                await self._warmup_one(model)
+            except Exception as e:  # noqa: BLE001 — surface as load failure
+                try:
+                    self.registry.unload(name)
+                except InferError:
+                    pass
+                raise InferError(
+                    f"failed to load '{name}': warmup failed: {e}",
+                    http_status=400)
+
     async def shutdown(self) -> None:
         """Cancel background batcher tasks and fail any queued requests so
         no handler is left awaiting a forever-pending future."""

@@ -23,23 +23,42 @@ async def start_frontends(
     http_port: int,
     grpc_port: int,
     tls: Optional[TLSConfig] = None,
-) -> Tuple[web.AppRunner, "object"]:
-    """Start the HTTP and gRPC frontends; returns (http_runner, grpc_server)."""
+    metrics_port: Optional[int] = None,
+) -> Tuple[web.AppRunner, "object", Optional[web.AppRunner]]:
+    """Start the HTTP and gRPC frontends (plus an optional dedicated
+    Prometheus port, Triton-style :8002); returns
+    (http_runner, grpc_server, metrics_runner)."""
     runner = web.AppRunner(build_app(core))
     await runner.setup()
     site = web.TCPSite(
         runner, host, http_port,
         ssl_context=tls.ssl_context() if tls else None)
     await site.start()
+    metrics_runner = None
     try:
+        if metrics_port is not None:
+            from .http_server import build_metrics_app
+
+            metrics_runner = web.AppRunner(build_metrics_app(core))
+            await metrics_runner.setup()
+            await web.TCPSite(
+                metrics_runner, host, metrics_port,
+                ssl_context=tls.ssl_context() if tls else None).start()
         grpc_server = build_grpc_server(core, f"{host}:{grpc_port}", tls=tls)
         await grpc_server.start()
     except BaseException:
+        if metrics_runner is not None:
+            await metrics_runner.cleanup()
         await runner.cleanup()
         raise
-    return runner, grpc_server
+    return runner, grpc_server, metrics_runner
 
 
-async def stop_frontends(runner: web.AppRunner, grpc_server) -> None:
+async def stop_frontends(
+    runner: web.AppRunner, grpc_server,
+    metrics_runner: Optional[web.AppRunner] = None,
+) -> None:
     await grpc_server.stop(grace=1.0)
+    if metrics_runner is not None:
+        await metrics_runner.cleanup()
     await runner.cleanup()

@@ -265,8 +265,9 @@ class InferenceServicer:
 
                 files[k] = base64.b64encode(v.bytes_param).decode()
         try:
-            self._core.registry.load(
-                request.model_name, config_override=config_override, files=files or None
+            await self._core.load_model(
+                request.model_name, config_override=config_override,
+                files=files or None
             )
         except InferError as e:
             await context.abort(grpc.StatusCode.INTERNAL, str(e))

@@ -56,6 +56,7 @@ def build_app(core: InferenceCore) -> web.Application:
     r.add_post("/v2/models/{model}/trace/setting", _h(core, _set_trace))
     r.add_get("/v2/logging", _h(core, _get_logging))
     r.add_post("/v2/logging", _h(core, _set_logging))
+    r.add_get("/metrics", _h(core, _metrics))
     for kind in ("systemsharedmemory", "cudasharedmemory"):
         r.add_get(f"/v2/{kind}/status", _h(core, _shm_status))
         r.add_get(f"/v2/{kind}/region/{{name}}/status", _h(core, _shm_status))
@@ -69,6 +70,14 @@ def build_app(core: InferenceCore) -> web.Application:
     from .grpc_web import add_grpc_web_routes
 
     add_grpc_web_routes(app, InferenceServicer(core))
+    return app
+
+
+def build_metrics_app(core: InferenceCore) -> web.Application:
+    """Minimal app exposing only ``/metrics`` — for the dedicated
+    Prometheus port (Triton convention: :8002)."""
+    app = web.Application()
+    app.router.add_get("/metrics", _h(core, _metrics))
     return app
 
 
@@ -148,7 +157,8 @@ async def _repo_load(core, request):
     params = body.get("parameters", {}) or {}
     config_override = params.get("config")
     files = {k: v for k, v in params.items() if k.startswith("file:")}
-    core.registry.load(name, config_override=config_override, files=files or None)
+    await core.load_model(name, config_override=config_override,
+                          files=files or None)
     return web.Response(status=200)
 
 
@@ -175,6 +185,16 @@ async def _set_trace(core, request):
             continue
         core.trace_settings[k] = v if isinstance(v, list) else [str(v)]
     return web.json_response(core.trace_settings)
+
+
+async def _metrics(core, request):
+    from .metrics import render_prometheus
+
+    return web.Response(
+        text=render_prometheus(core),
+        content_type="text/plain",
+        charset="utf-8",
+    )
 
 
 async def _get_logging(core, request):

@@ -1,0 +1,61 @@
+"""Prometheus metrics exposition for the serving harness.
+
+The reference *client* has no metrics endpoint (SURVEY.md §5: "No
+Prometheus-style client metrics"), but the server it targets famously
+exposes one; a reference user switching here expects ``GET /metrics``.
+Metric names follow Triton's server conventions (``nv_inference_*``) so
+existing dashboards and scrapers keep working unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .core import InferenceCore
+
+_METRICS: List[Tuple[str, str, str]] = [
+    # (metric name, help text, ModelStats-derived key)
+    ("nv_inference_request_success",
+     "Number of successful inference requests, all batch sizes", "success"),
+    ("nv_inference_request_failure",
+     "Number of failed inference requests, all batch sizes", "fail"),
+    ("nv_inference_count",
+     "Number of inferences performed (batched requests count once per "
+     "batch element)", "count"),
+    ("nv_inference_exec_count",
+     "Number of model executions performed", "exec"),
+    ("nv_inference_request_duration_us",
+     "Cumulative inference request duration in microseconds", "request_us"),
+    ("nv_inference_queue_duration_us",
+     "Cumulative inference queuing duration in microseconds", "queue_us"),
+    ("nv_inference_compute_infer_duration_us",
+     "Cumulative compute inference duration in microseconds", "infer_us"),
+]
+
+
+def render_prometheus(core: InferenceCore) -> str:
+    """All per-model counters in the Prometheus text exposition format."""
+    rows = {key: [] for _, _, key in _METRICS}
+    for m in core.registry.ready_models():
+        s = m.stats
+        with s.lock:
+            values = {
+                "success": s.success_count,
+                "fail": s.fail_count,
+                "count": s.inference_count,
+                "exec": s.execution_count,
+                "request_us": s.success_ns // 1000,
+                "queue_us": s.queue_ns // 1000,
+                "infer_us": s.infer_ns // 1000,
+            }
+        labels = f'model="{m.name}",version="1"'
+        for key, value in values.items():
+            rows[key].append(f"{{{labels}}} {value}")
+
+    lines: List[str] = []
+    for name, help_text, key in _METRICS:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} counter")
+        for row in rows[key]:
+            lines.append(f"{name}{row}")
+    return "\n".join(lines) + "\n"

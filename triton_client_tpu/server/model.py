@@ -68,6 +68,7 @@ def make_config(
     labels: Optional[Dict[str, List[str]]] = None,
     instance_kind: Optional[str] = None,
     parameters: Optional[Dict[str, str]] = None,
+    warmup: Optional[Sequence[dict]] = None,
 ) -> pb.ModelConfig:
     """Convenience builder for a ModelConfig proto.
 
@@ -97,6 +98,21 @@ def make_config(
         grp.count = 1
     for key, value in (parameters or {}).items():
         cfg.parameters[key].string_value = str(value)
+    # warmup: [{"name": ..., "batch_size": N, "count": N,
+    #           "inputs": {tensor: (dtype str, dims, "zero"|"random")}}]
+    for w in warmup or []:
+        sample = cfg.model_warmup.add(
+            name=w.get("name", "sample"),
+            batch_size=w.get("batch_size", 0),
+            count=w.get("count", 1))
+        for tensor, (dt, dims, mode) in w["inputs"].items():
+            spec = sample.inputs[tensor]
+            spec.data_type = _DT_TO_PB[dt]
+            spec.dims.extend(dims)
+            if mode == "random":
+                spec.random_data = True
+            else:
+                spec.zero_data = True
     return cfg
 
 

@@ -191,7 +191,10 @@ class ModelRegistry:
         spec.loader.exec_module(mod)
         if not hasattr(mod, "get_model"):
             raise InferError(f"failed to load '{name}': model.py lacks get_model(config)")
-        return mod.get_model(config)
+        model = mod.get_model(config)
+        # warmup input_data_file samples resolve against <model_dir>/warmup/
+        model.model_dir = model_dir
+        return model
 
 
 def _parse_config_json(config_json: str, name: str) -> pb.ModelConfig:

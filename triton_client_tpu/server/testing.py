@@ -35,11 +35,13 @@ class ServerHarness:
         grpc_port: Optional[int] = None,
         host: str = "127.0.0.1",
         tls=None,
+        metrics_port: Optional[int] = None,
     ):
         self.registry = registry or ModelRegistry()
         self.core = InferenceCore(self.registry)
         self.host = host
         self.tls = tls
+        self.metrics_port = metrics_port
         self.http_port = http_port or free_port()
         self.grpc_port = grpc_port or free_port()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -72,11 +74,15 @@ class ServerHarness:
 
     async def _serve(self) -> None:
         self._stop_event = asyncio.Event()
-        runner, grpc_server = await start_frontends(
-            self.core, self.host, self.http_port, self.grpc_port, tls=self.tls)
+        # warm before serving: first requests must not pay XLA compilation
+        # for models that declare warmup samples (Triton model_warmup)
+        await self.core.warmup_models()
+        runner, grpc_server, metrics_runner = await start_frontends(
+            self.core, self.host, self.http_port, self.grpc_port,
+            tls=self.tls, metrics_port=self.metrics_port)
         self._started.set()
         await self._stop_event.wait()
-        await stop_frontends(runner, grpc_server)
+        await stop_frontends(runner, grpc_server, metrics_runner)
         await self.core.shutdown()
 
     def stop(self) -> None:

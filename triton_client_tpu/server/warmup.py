@@ -1,0 +1,67 @@
+"""Model warmup: execute configured samples at load time.
+
+``ModelConfig.model_warmup`` (field shape mirroring Triton's
+model_config.proto) lists synthetic requests run through the model's real
+execute path before it serves traffic, so first user requests never pay XLA
+compilation (tens of seconds on a TPU).  Pairs with the serving core's
+inline-execution profile: warmup also registers the shape signatures that
+later earn the inline fast path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..utils import triton_to_np_dtype
+from .model import Model, pb_to_datatype
+from .types import InferError
+
+
+def build_warmup_inputs(model: Model, sample, model_dir: str = "") -> Dict[str, Any]:
+    """Synthesize the input dict for one ModelWarmup sample."""
+    rng = np.random.default_rng(0)
+    inputs: Dict[str, Any] = {}
+    for name, spec in sample.inputs.items():
+        dtype_str = pb_to_datatype(spec.data_type)
+        dims = [int(d) for d in spec.dims]
+        if sample.batch_size > 0 and model.max_batch_size > 0:
+            dims = [int(sample.batch_size)] + dims
+        kind = spec.WhichOneof("input_data_type")
+        if dtype_str == "BYTES":
+            arr = np.full(dims, b"", dtype=object)
+        elif kind == "random_data":
+            np_dtype = triton_to_np_dtype(dtype_str)
+            if np.issubdtype(np.dtype(np_dtype) if not hasattr(np_dtype, "dtype")
+                             else np_dtype, np.integer):
+                arr = rng.integers(0, 127, dims).astype(np_dtype)
+            else:
+                arr = rng.standard_normal(dims).astype(np_dtype)
+        elif kind == "input_data_file":
+            path = os.path.join(model_dir, "warmup", spec.input_data_file) \
+                if model_dir else spec.input_data_file
+            if not os.path.isfile(path):
+                raise InferError(
+                    f"warmup '{sample.name}': data file not found: {path}")
+            arr = np.fromfile(path, dtype=triton_to_np_dtype(dtype_str))
+            arr = arr.reshape(dims)
+        else:  # zero_data (also the default when no oneof member is set)
+            arr = np.zeros(dims, dtype=triton_to_np_dtype(dtype_str))
+        inputs[name] = arr
+    return inputs
+
+
+def warmup_samples(model: Model) -> List[Tuple[str, int, Dict[str, Any]]]:
+    """(name, repeat count, inputs) for each configured warmup sample.
+
+    ``input_data_file`` samples resolve against ``<model_dir>/warmup/`` for
+    repository-loaded models (Triton layout)."""
+    model_dir = getattr(model, "model_dir", "") or ""
+    out = []
+    for sample in model.config.model_warmup:
+        count = max(int(sample.count), 1)
+        out.append((sample.name or "warmup", count,
+                    build_warmup_inputs(model, sample, model_dir)))
+    return out
