@@ -220,11 +220,11 @@ def main() -> int:
             "total": total,
         }
 
-    # best-of-2 measurement windows: host-side run-to-run variance on this
+    # best-of-3 measurement windows: host-side run-to-run variance on this
     # shared bench machine is ~±20%, so a single 5s window under-reports.
-    # Errors from BOTH runs are kept — a flaky losing run must still fail.
+    # Errors from ALL runs are kept — a flaky losing run must still fail.
     simple_runs = [sweep("simple", simple_inputs, concurrency=8)
-                   for _ in range(2)]
+                   for _ in range(3)]
     simple_res = max(simple_runs, key=lambda r: r["infer_per_sec"])
     simple_errors = [e for r in simple_runs for e in r["errors"]]
     # Device path, wire data: concurrency = 4x max batch so the dynamic

@@ -33,6 +33,8 @@ def main() -> None:
     parser.add_argument("--output-tokens", type=int, default=16)
     parser.add_argument("--num-requests", type=int, default=8)
     parser.add_argument("--model", default="llama_decode")
+    parser.add_argument("--generate-model", default="llama_generate",
+                        help="model for the generate_stream (SSE) sweep")
     args = parser.parse_args()
 
     registry = ModelRegistry()
@@ -44,6 +46,20 @@ def main() -> None:
         for level in [int(c) for c in args.concurrency.split(",")]:
             report = genai_perf.profile(
                 h.grpc_url, args.model, concurrency=level,
+                output_tokens=args.output_tokens,
+                num_requests=max(args.num_requests, level))
+            print(json.dumps(report))
+        # server-side loop over the generate extension (SSE): ITL here is
+        # on-device step time, not a client round trip per token.  Its own
+        # warm pass: the generate path compiles the independent prefill/step
+        # pair, which the decode warm-up above only covers in independent
+        # decode mode.
+        genai_perf.profile_generate(
+            h.http_url, args.generate_model, concurrency=1,
+            output_tokens=1, num_requests=1)
+        for level in [int(c) for c in args.concurrency.split(",")]:
+            report = genai_perf.profile_generate(
+                h.http_url, args.generate_model, concurrency=level,
                 output_tokens=args.output_tokens,
                 num_requests=max(args.num_requests, level))
             print(json.dumps(report))

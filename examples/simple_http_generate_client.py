@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Zero-SDK LLM generation over the Triton generate extension.
+
+Framework extension beyond the reference example surface: drives
+``POST /v2/models/llama_generate/generate_stream`` with plain urllib —
+no client SDK — and prints each SSE token frame as it arrives.  The
+equivalent curl:
+
+    curl -N -d '{"text_input": "hello", "max_tokens": 4}' \\
+        localhost:8000/v2/models/llama_generate/generate_stream
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-p", "--prompt", default="In a hole in the ground")
+    parser.add_argument("-n", "--tokens", type=int, default=6)
+    args = parser.parse_args()
+
+    body = json.dumps(
+        {"text_input": args.prompt, "max_tokens": args.tokens}).encode()
+
+    # one-shot generate: exactly one response for non-streaming models is an
+    # error for decoupled llama_generate — prove the stream path instead
+    req = urllib.request.Request(
+        f"http://{args.url}/v2/models/llama_generate/generate_stream",
+        data=body, headers={"Content-Type": "application/json"})
+    chunks = []
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        if not ctype.startswith("text/event-stream"):
+            sys.exit(f"error: expected SSE, got {ctype!r}")
+        for line in resp:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            frame = json.loads(line[len("data: "):])
+            if "error" in frame:
+                sys.exit(f"error: {frame['error']}")
+            chunks.append(frame["text_output"])
+
+    if len(chunks) != args.tokens:
+        sys.exit(f"error: expected {args.tokens} frames, got {len(chunks)}")
+    print(f"prompt: {args.prompt!r}")
+    print(f"generated: {''.join(chunks)!r}")
+    print("PASS: generate_stream")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,7 @@ HTTP_EXAMPLES = [
     "simple_http_aio_infer_client.py",
     "simple_http_model_control.py",
     "simple_http_shm_string_client.py",
+    "simple_http_generate_client.py",
     "reuse_infer_objects_client.py",
     "ensemble_image_client.py",
     "image_client.py",

@@ -56,3 +56,24 @@ def test_rejects_non_decode_model(server):
     with pytest.raises(RuntimeError, match="decode-contract"):
         genai_perf.profile(server.grpc_url, "identity_fp32", concurrency=1,
                            output_tokens=1, num_requests=1)
+
+
+def test_profile_generate_endpoint(server):
+    report = genai_perf.profile_generate(
+        server.http_url, "llama_generate", concurrency=2, output_tokens=3,
+        num_requests=4, stream_timeout=120.0)
+    assert report["errors"] == 0, report.get("first_error")
+    assert report["requests_completed"] == 4
+    assert report["endpoint"] == "generate_stream"
+    assert report["time_to_first_token_ms"]["p50"] > 0
+    # 3 tokens per request -> 2 ITL samples per request
+    assert report["output_token_throughput_per_sec"] > 0
+
+
+def test_cli_generate_endpoint(server):
+    rc = genai_perf.main([
+        "-m", "llama_generate", "-u", server.http_url,
+        "--endpoint", "generate", "--concurrency", "1",
+        "--output-tokens", "2", "--num-requests", "1",
+    ])
+    assert rc == 0

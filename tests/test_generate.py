@@ -1,0 +1,180 @@
+"""Triton generate extension: JSON-first /generate + SSE /generate_stream."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.server import ModelRegistry  # noqa: E402
+from triton_client_tpu.server.testing import ServerHarness  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+def _post(url, path, body, stream=False):
+    req = urllib.request.Request(
+        f"http://{url}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def _sse_frames(resp):
+    frames = []
+    for line in resp:
+        line = line.decode().strip()
+        if line.startswith("data: "):
+            frames.append(json.loads(line[len("data: "):]))
+    return frames
+
+
+class TestGenerate:
+    def test_generate_bytes_model(self, server):
+        a = [str(i) for i in range(16)]
+        b = ["1"] * 16
+        with _post(server.http_url, "/v2/models/simple_string/generate",
+                   {"INPUT0": a, "INPUT1": b}) as resp:
+            out = json.loads(resp.read())
+        assert out["model_name"] == "simple_string"
+        assert out["OUTPUT0"] == [str(i + 1) for i in range(16)]
+        assert out["OUTPUT1"] == [str(i - 1) for i in range(16)]
+
+    def test_generate_numeric_lists_and_parameters(self, server):
+        body = {"INPUT0": list(range(16)), "custom_tag": "x"}
+        with _post(server.http_url,
+                   "/v2/models/custom_identity_int32/generate", body) as resp:
+            out = json.loads(resp.read())
+        assert out["OUTPUT0"] == list(range(16))
+
+    def test_generate_missing_input_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url, "/v2/models/simple_string/generate",
+                  {"INPUT0": [str(i) for i in range(16)]})
+        assert e.value.code == 400
+        assert "missing input" in e.value.read().decode()
+
+    def test_generate_on_decoupled_model_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url, "/v2/models/llama_generate/generate",
+                  {"text_input": "hi", "max_tokens": 3})
+        assert e.value.code == 400
+        assert "generate_stream" in e.value.read().decode()
+
+    def test_malformed_json_is_400(self, server):
+        req = urllib.request.Request(
+            f"http://{server.http_url}/v2/models/simple_string/generate",
+            data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=60)
+        assert e.value.code == 400
+
+    def test_stream_request_error_is_http_status(self, server):
+        """Pre-stream failures surface as HTTP errors, not 200+SSE frames."""
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url,
+                  "/v2/models/simple_string/generate_stream",
+                  {"INPUT0": ["1"], "INPUT1": ["2"]})  # wrong element count
+        assert e.value.code == 400
+
+
+class TestGenerateStream:
+    def test_stream_tokens(self, server):
+        with _post(server.http_url,
+                   "/v2/models/llama_generate/generate_stream",
+                   {"text_input": "In a hole", "max_tokens": 4}) as resp:
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            frames = _sse_frames(resp)
+        assert len(frames) == 4
+        assert all(isinstance(f["text_output"], str) for f in frames)
+
+    def test_stream_matches_decode_oracle(self, server):
+        """llama_generate greedy tokens == llama_decode's closed-loop tokens
+        (same weights, same prefill/step fns)."""
+        import queue
+
+        import triton_client_tpu.grpc as grpcclient
+        from triton_client_tpu.models import language
+
+        prompt, n = "It was the best of times", 3
+        with _post(server.http_url,
+                   "/v2/models/llama_generate/generate_stream",
+                   {"text_input": prompt, "max_tokens": n}) as resp:
+            frames = _sse_frames(resp)
+        # token_id is the lossless channel; text_output is its mod-256 char
+        gen = [f["token_id"] for f in frames]
+        assert [ord(f["text_output"][0]) % 256 for f in frames] == \
+            [t % 256 for t in gen]
+
+        S = language.LLAMA_SEQ_LEN
+        window = np.zeros(S, np.int32)
+        raw = prompt.encode()[-S:]
+        window[S - len(raw):] = np.frombuffer(raw, np.uint8)
+        results: "queue.Queue" = queue.Queue()
+        oracle = []
+        with grpcclient.InferenceServerClient(server.grpc_url) as client:
+            client.start_stream(
+                callback=lambda result, error: results.put((result, error)))
+            inp = grpcclient.InferInput("TOKENS", [S], "INT32")
+            inp.set_data_from_numpy(window)
+            client.async_stream_infer("llama_decode", [inp],
+                                      sequence_id=9001, sequence_start=True)
+            for i in range(n - 1):
+                r, e = results.get(timeout=120)
+                assert e is None, e
+                tok = np.asarray(r.as_numpy("NEXT_TOKEN")).reshape(1)
+                oracle.append(int(tok[0]))
+                nxt = grpcclient.InferInput("TOKENS", [1], "INT32")
+                nxt.set_data_from_numpy(tok.astype(np.int32))
+                client.async_stream_infer(
+                    "llama_decode", [nxt], sequence_id=9001,
+                    sequence_end=(i == n - 2))
+            r, e = results.get(timeout=120)
+            assert e is None, e
+            oracle.append(
+                int(np.asarray(r.as_numpy("NEXT_TOKEN")).reshape(1)[0]))
+            client.stop_stream()
+        assert gen == oracle
+
+    def test_stream_grpc_decoupled_path(self, server):
+        """The same decoupled model over the gRPC stream (not just SSE)."""
+        import queue
+
+        import triton_client_tpu.grpc as grpcclient
+        from triton_client_tpu.utils import serialize_byte_tensor
+
+        results: "queue.Queue" = queue.Queue()
+        with grpcclient.InferenceServerClient(server.grpc_url) as client:
+            client.start_stream(
+                callback=lambda result, error: results.put((result, error)))
+            inp = grpcclient.InferInput("text_input", [1], "BYTES")
+            inp.set_data_from_numpy(np.asarray([b"hello"], dtype=object))
+            client.async_stream_infer(
+                "llama_generate", [inp],
+                parameters={"max_tokens": 3},
+                enable_empty_final_response=True)
+            toks = []
+            while True:
+                r, e = results.get(timeout=120)
+                assert e is None, e
+                final = (r.get_response(as_json=True)
+                          .get("parameters", {})
+                          .get("triton_final_response", {})
+                          .get("bool_param", False))
+                out = r.as_numpy("text_output")
+                if out is not None and len(out):
+                    toks.append(out[0])
+                if final:
+                    break
+            client.stop_stream()
+        assert len(toks) == 3

@@ -189,6 +189,103 @@ def _worker(url, model_name, input_name, prompt_len, token_output,
 _MERGE_LOCK = threading.Lock()
 
 
+def _generate_worker(http_url, model_name, prompt_text, output_tokens,
+                     n_requests, worker_id, stats: _GenStats,
+                     barrier: threading.Barrier,
+                     stream_timeout: float) -> None:
+    """SSE worker over POST /v2/models/{m}/generate_stream — the server runs
+    the whole decode loop, so ITL is on-device step time, not a client
+    round trip per token."""
+    import json as _json
+    import urllib.request
+
+    local = _GenStats()
+    try:
+        barrier.wait(timeout=60)
+    except threading.BrokenBarrierError:
+        pass
+    for req_i in range(n_requests):
+        # per-request isolation: a transient failure counts one error and
+        # the worker moves on to its remaining requests
+        try:
+            body = _json.dumps({
+                "text_input": f"{prompt_text} [w{worker_id} r{req_i}]",
+                "max_tokens": output_tokens,
+            }).encode()
+            req = urllib.request.Request(
+                f"http://{http_url}/v2/models/{model_name}/generate_stream",
+                data=body, headers={"Content-Type": "application/json"})
+            t_start = time.perf_counter()
+            t_prev = None
+            n_frames = 0
+            with urllib.request.urlopen(req, timeout=stream_timeout) as resp:
+                for line in resp:
+                    if not line.startswith(b"data: "):
+                        continue
+                    frame = _json.loads(line[len(b"data: "):])
+                    t_now = time.perf_counter()
+                    if "error" in frame:
+                        raise RuntimeError(frame["error"])
+                    if n_frames == 0:
+                        local.ttft.append(t_now - t_start)
+                    else:
+                        local.itl.append(t_now - t_prev)
+                    t_prev = t_now
+                    n_frames += 1
+                    local.tokens_out += 1
+            local.request_latency.append(time.perf_counter() - t_start)
+            local.requests += 1
+        except Exception as e:  # noqa: BLE001 — worker reports, run continues
+            local.errors += 1
+            if local.first_error is None:
+                local.first_error = str(e)
+    with _MERGE_LOCK:
+        stats.merge(local)
+
+
+def profile_generate(http_url: str, model_name: str, concurrency: int = 1,
+                     output_tokens: int = 16, num_requests: int = 8,
+                     prompt_text: str = "In a hole in the ground",
+                     stream_timeout: float = 600.0) -> dict:
+    """Profile the generate_stream (SSE) endpoint; same metric set as
+    ``profile``."""
+    per_worker = max(1, num_requests // concurrency)
+    stats = _GenStats()
+    barrier = threading.Barrier(concurrency)
+    threads = []
+    t0 = time.perf_counter()
+    for w in range(concurrency):
+        t = threading.Thread(
+            target=_generate_worker,
+            args=(http_url, model_name, prompt_text, output_tokens,
+                  per_worker, w + 1, stats, barrier, stream_timeout),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    report = {
+        "model": model_name,
+        "endpoint": "generate_stream",
+        "concurrency": concurrency,
+        "output_tokens_per_request": output_tokens,
+        "requests_completed": stats.requests,
+        "errors": stats.errors,
+        "wall_s": round(wall, 3),
+        "time_to_first_token_ms": _percentiles(stats.ttft),
+        "inter_token_latency_ms": _percentiles(stats.itl),
+        "request_latency_ms": _percentiles(stats.request_latency),
+        "output_token_throughput_per_sec":
+            round(stats.tokens_out / wall, 2) if wall > 0 else 0.0,
+        "request_throughput_per_sec":
+            round(stats.requests / wall, 2) if wall > 0 else 0.0,
+    }
+    if stats.first_error:
+        report["first_error"] = stats.first_error
+    return report
+
+
 def profile(url: str, model_name: str, model_version: str = "",
             concurrency: int = 1, output_tokens: int = 16,
             num_requests: int = 8, stream_timeout: float = 600.0,
@@ -270,7 +367,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="tpu-genai-perf",
         description="LLM generation profiler (genai-perf CLI contract)")
     parser.add_argument("-m", "--model", required=True)
-    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-u", "--url", default="localhost:8001",
+                        help="gRPC url for --endpoint stream; HTTP url for "
+                        "--endpoint generate")
+    parser.add_argument("--endpoint", choices=("stream", "generate"),
+                        default="stream",
+                        help="'stream': client closed loop over the gRPC "
+                        "decode stream; 'generate': server-side loop via "
+                        "POST .../generate_stream (SSE)")
     parser.add_argument("--model-version", default="")
     parser.add_argument("--concurrency", type=int, default=1)
     parser.add_argument("--output-tokens", type=int, default=16,
@@ -287,12 +391,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     try:
-        report = profile(
-            args.url, args.model, args.model_version,
-            concurrency=args.concurrency, output_tokens=args.output_tokens,
-            num_requests=args.num_requests,
-            stream_timeout=args.stream_timeout,
-            prompt_tokens=args.prompt_tokens)
+        if args.endpoint == "generate":
+            report = profile_generate(
+                args.url, args.model, concurrency=args.concurrency,
+                output_tokens=args.output_tokens,
+                num_requests=args.num_requests,
+                stream_timeout=args.stream_timeout)
+        else:
+            report = profile(
+                args.url, args.model, args.model_version,
+                concurrency=args.concurrency,
+                output_tokens=args.output_tokens,
+                num_requests=args.num_requests,
+                stream_timeout=args.stream_timeout,
+                prompt_tokens=args.prompt_tokens)
     except Exception as e:  # noqa: BLE001 — CLI surface
         print(f"genai-perf failed: {e}", file=sys.stderr)
         return 1

@@ -748,8 +748,88 @@ class DecodeModel:
 
 
 
-def make_llama_decode():
-    return DecodeModel().model
+class GenerateModel:
+    """``llama_generate``: decoupled server-side text generation.
+
+    The JSON-first face of the decode stack (Triton generate-extension
+    surface): ``text_input`` BYTES [1] in, one ``text_output`` chunk per
+    generated token out — served over ``POST .../generate_stream`` (SSE) or
+    the decoupled gRPC stream.  ``max_tokens`` arrives as a request
+    parameter.  Unlike ``llama_decode`` (client-side closed loop: one
+    round trip per token), the generation loop runs server-side, so the
+    client pays one request for the whole stream.
+
+    Shares weights and compiled prefill/step functions with the passed
+    ``DecodeModel`` — registering both costs one parameter set."""
+
+    def __init__(self, decode: DecodeModel, name: str = "llama_generate",
+                 default_tokens: int = 16):
+        import numpy as np
+
+        from ..server.model import Model, make_config
+
+        self._decode = decode
+        self._default_tokens = default_tokens
+        self._np = np
+        cfg = make_config(
+            name,
+            inputs=[("text_input", "BYTES", [1])],
+            outputs=[("text_output", "BYTES", [1]),
+                     ("token_id", "INT32", [1])],
+            decoupled=True,
+            instance_kind="KIND_TPU",
+            parameters={"prompt_tokens": str(decode._prompt_len)},
+        )
+        outer = self
+
+        class _Impl(Model):  # noqa: N801 — adapter onto the abstract Model
+            def execute(inner, inputs, parameters):
+                from ..server.types import InferError
+
+                raise InferError(
+                    f"model '{inner.name}' is decoupled: use "
+                    "generate_stream or a gRPC stream")
+
+            def execute_decoupled(inner, inputs, parameters):
+                return outer._generate(inputs, parameters)
+
+        self.model = _Impl(cfg)
+
+    def _generate(self, inputs, parameters):
+        np = self._np
+        dec = self._decode
+        prefill, step, params, cfg = dec._ensure_fns_independent()
+        raw = np.asarray(inputs["text_input"]).reshape(-1)
+        prompt = raw[0] if len(raw) else b""
+        if isinstance(prompt, str):
+            prompt = prompt.encode()
+        n_tokens = int(parameters.get("max_tokens", self._default_tokens))
+        n_tokens = max(1, min(n_tokens, dec._s_max - dec._prompt_len))
+
+        window = np.zeros((1, dec._prompt_len), np.int32)
+        b = np.frombuffer(bytes(prompt[-dec._prompt_len:]), np.uint8)
+        if b.size:
+            window[0, dec._prompt_len - b.size:] = b
+        window = np.clip(window, 0, cfg.vocab_size - 1)
+
+        logits, cache = prefill(params, jnp.asarray(window))
+        for i in range(n_tokens):
+            tok = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+            # text_output: chr(token mod 256) as UTF-8 (JSON-safe; the byte
+            # "detokenizer" aliases ids >= 256 at large vocab sizes, same as
+            # llama_postprocess) — token_id carries the exact id losslessly
+            yield {
+                "text_output": np.asarray(
+                    [chr(tok % 256).encode("utf-8")], dtype=object),
+                "token_id": np.asarray([tok], np.int32),
+            }
+            if i < n_tokens - 1:
+                logits, cache = step(
+                    params, cache, jnp.asarray([[tok]], jnp.int32))
+
+
+def make_llama_generate(decode: DecodeModel):
+    return GenerateModel(decode).model
 
 
 def reference_forward(params, tokens, cfg: tr.TransformerConfig):

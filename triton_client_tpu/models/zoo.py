@@ -430,9 +430,11 @@ def register_all(registry: ModelRegistry) -> None:
     registry.register_model(language.make_ensemble_llama())
     registry.register_model(language.make_longctx_tpu())
     registry.register_model(language.make_moe_tpu())
-    from .decode import make_llama_decode
+    from .decode import DecodeModel, make_llama_generate
 
-    registry.register_model(make_llama_decode())
+    decode = DecodeModel()
+    registry.register_model(decode.model)
+    registry.register_model(make_llama_generate(decode))
     registry.register_model(make_simple_string())
     registry.register_model(make_simple_int8())
     registry.register_model(make_simple_identity())

@@ -1,0 +1,115 @@
+"""Triton ``generate`` HTTP extension: JSON-first LLM inference.
+
+``POST /v2/models/{model}/generate`` and ``.../generate_stream`` accept a
+flat JSON object (tensor names → scalar/list values; unknown keys become
+request parameters), run the model, and return each response as a flat JSON
+object — ``generate_stream`` as Server-Sent Events, one ``data:`` frame per
+decoupled response.  This mirrors Triton's generate extension surface (the
+endpoint genai-perf drives), giving curl/browser LLM clients a zero-SDK
+path next to the full v2 infer API.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+from ..utils import triton_to_np_dtype
+from .model import Model, pb_to_datatype
+from .types import InferError, InferRequest, InputTensor, RequestedOutput
+
+
+def _fit_shape(name: str, size: int, dims, batched: bool):
+    """Fit a flat JSON value of ``size`` elements onto the model's declared
+    dims (batch-of-1 prepended for batching models; one -1 wildcard absorbs
+    the free extent)."""
+    shape = ([1] if batched else []) + [int(d) for d in dims]
+    wild = [i for i, d in enumerate(shape) if d < 0]
+    for i in wild[1:]:  # extra wildcards pin to 1; the first absorbs size
+        shape[i] = 1
+    fixed = 1
+    for d in shape:
+        if d > 0:
+            fixed *= d
+    if wild:
+        if size % fixed:
+            raise InferError(
+                f"generate input '{name}': {size} values do not fit dims "
+                f"{list(dims)}", 400)
+        shape[wild[0]] = size // fixed
+        return shape
+    if fixed != size:
+        raise InferError(
+            f"generate input '{name}': expected {fixed} values for dims "
+            f"{list(dims)}, got {size}", 400)
+    return shape
+
+
+def build_generate_request(
+    model: Model, model_name: str, model_version: str, body: Dict[str, Any]
+) -> InferRequest:
+    """Map a flat generate JSON body onto an InferRequest.
+
+    Keys matching model input names become tensors (scalars get shape [1],
+    lists keep their length; dtype from the model config); all other keys
+    become request parameters (Triton generate semantics)."""
+    if not isinstance(body, dict):
+        raise InferError("generate request body must be a JSON object", 400)
+    input_specs = {i.name: (pb_to_datatype(i.data_type), list(i.dims))
+                   for i in model.config.input}
+    batched = model.config.max_batch_size > 0
+    inputs = []
+    parameters: Dict[str, Any] = {}
+    for key, value in body.items():
+        if key not in input_specs:
+            if isinstance(value, (dict, list)):
+                raise InferError(
+                    f"generate parameter '{key}' must be a scalar", 400)
+            parameters[key] = value
+            continue
+        dtype, dims = input_specs[key]
+        scalar = not isinstance(value, list)
+        items = [value] if scalar else value
+        if dtype == "BYTES":
+            arr = np.asarray(
+                [v.encode() if isinstance(v, str) else bytes(v)
+                 for v in items], dtype=object)
+        else:
+            arr = np.asarray(items, dtype=triton_to_np_dtype(dtype))
+        arr = arr.reshape(_fit_shape(key, arr.size, dims, batched))
+        inputs.append(InputTensor(
+            name=key, datatype=dtype, shape=tuple(arr.shape), data=arr))
+    missing = set(input_specs) - {i.name for i in inputs}
+    if missing:
+        raise InferError(
+            f"generate request missing input(s): {', '.join(sorted(missing))}",
+            400)
+    outputs = [RequestedOutput(name=o.name, binary_data=False)
+               for o in model.config.output]
+    return InferRequest(
+        model_name=model_name, model_version=model_version,
+        inputs=inputs, outputs=outputs, parameters=parameters)
+
+
+def response_to_json(model_name: str, model_version: str, response) -> str:
+    """Flatten an InferResponse into the generate JSON shape."""
+    out: Dict[str, Any] = {
+        "model_name": model_name,
+        "model_version": model_version or "1",
+    }
+    for t in response.outputs:
+        arr = t.data
+        if arr is None:
+            continue
+        if arr.dtype == object or arr.dtype.kind in ("S", "U"):
+            vals = [v.decode("utf-8", "replace") if isinstance(v, bytes)
+                    else str(v) for v in arr.reshape(-1)]
+        else:
+            vals = np.asarray(arr).reshape(-1).tolist()
+        out[t.name] = vals[0] if len(vals) == 1 else vals
+    return json.dumps(out)
+
+
+__all__ = ["build_generate_request", "response_to_json"]

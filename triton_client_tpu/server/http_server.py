@@ -9,6 +9,7 @@ header (reference framing: http/_utils.py:137-150).
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import gzip
 import json
@@ -50,6 +51,12 @@ def build_app(core: InferenceCore) -> web.Application:
     r.add_post("/v2/repository/models/{model}/unload", _h(core, _repo_unload))
     r.add_post("/v2/models/{model}/infer", _h(core, _infer))
     r.add_post("/v2/models/{model}/versions/{version}/infer", _h(core, _infer))
+    r.add_post("/v2/models/{model}/generate", _h(core, _generate))
+    r.add_post("/v2/models/{model}/versions/{version}/generate",
+               _h(core, _generate))
+    r.add_post("/v2/models/{model}/generate_stream", _h(core, _generate_stream))
+    r.add_post("/v2/models/{model}/versions/{version}/generate_stream",
+               _h(core, _generate_stream))
     r.add_get("/v2/trace/setting", _h(core, _get_trace))
     r.add_post("/v2/trace/setting", _h(core, _set_trace))
     r.add_get("/v2/models/{model}/trace/setting", _h(core, _get_trace))
@@ -185,6 +192,71 @@ async def _set_trace(core, request):
             continue
         core.trace_settings[k] = v if isinstance(v, list) else [str(v)]
     return web.json_response(core.trace_settings)
+
+
+async def _build_generate(core, request):
+    """Shared generate prologue: (name, version, model, InferRequest)."""
+    from .generate import build_generate_request
+
+    name = request.match_info["model"]
+    version = request.match_info.get("version", "")
+    model = core.registry.get(name, version)
+    try:
+        body = await request.json()
+    except Exception:
+        raise InferError("failed to parse generate request JSON", 400)
+    return name, version, model, build_generate_request(
+        model, name, version, body)
+
+
+async def _generate(core, request):
+    from .generate import response_to_json
+
+    name, version, model, req = await _build_generate(core, request)
+    if model.decoupled:
+        raise InferError(
+            f"model '{name}' is decoupled: use generate_stream", 400)
+    response = await core.infer(req)
+    return web.Response(
+        text=response_to_json(name, version, response),
+        content_type="application/json")
+
+
+async def _generate_stream(core, request):
+    from .generate import response_to_json
+
+    name, version, model, req = await _build_generate(core, request)
+    agen = core.infer_stream(req)
+    # pull the first response BEFORE committing the 200/SSE headers, so
+    # request/model errors surface as proper HTTP error statuses
+    # (__anext__ not the anext() builtin: requires-python floor is 3.9)
+    try:
+        first = await agen.__anext__()
+    except StopAsyncIteration:
+        first = None
+    stream = web.StreamResponse()
+    stream.headers["Content-Type"] = "text/event-stream"
+    stream.headers["Cache-Control"] = "no-cache"
+    await stream.prepare(request)
+    try:
+        if first is not None and first.outputs:
+            payload = response_to_json(name, version, first)
+            await stream.write(f"data: {payload}\n\n".encode())
+        async for resp in agen:
+            if not resp.outputs:
+                continue  # final-flagged empty frame ends decoupled streams
+            payload = response_to_json(name, version, resp)
+            await stream.write(f"data: {payload}\n\n".encode())
+    except InferError as e:
+        # mid-stream failure: headers are committed, deliver in-band
+        err = json.dumps({"error": str(e)})
+        await stream.write(f"data: {err}\n\n".encode())
+    except (ConnectionError, OSError, asyncio.CancelledError):
+        # client went away mid-stream — close quietly; re-raising would make
+        # _h answer a second response on a transport the StreamResponse owns
+        return stream
+    await stream.write_eof()
+    return stream
 
 
 async def _metrics(core, request):
