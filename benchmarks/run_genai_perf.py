@@ -54,9 +54,11 @@ def main() -> None:
         # warm pass: the generate path compiles the independent prefill/step
         # pair, which the decode warm-up above only covers in independent
         # decode mode.
+        # output_tokens=2 so the decode `step` compiles too (a 1-token
+        # generation is prefill-only)
         genai_perf.profile_generate(
             h.http_url, args.generate_model, concurrency=1,
-            output_tokens=1, num_requests=1)
+            output_tokens=2, num_requests=1)
         for level in [int(c) for c in args.concurrency.split(",")]:
             report = genai_perf.profile_generate(
                 h.http_url, args.generate_model, concurrency=level,

@@ -1,0 +1,100 @@
+"""Per-model response cache (Triton response_cache.enable)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import triton_client_tpu.http as httpclient  # noqa: E402
+from triton_client_tpu.server import (  # noqa: E402
+    JaxModel,
+    ModelRegistry,
+    make_config,
+)
+from triton_client_tpu.server.testing import ServerHarness  # noqa: E402
+
+
+def _counting_model(name="cached", cache=True):
+    calls = []
+    cfg = make_config(
+        name,
+        inputs=[("X", "FP32", [1, 4])],
+        outputs=[("Y", "FP32", [1, 4])],
+        instance_kind="KIND_CPU",
+        response_cache=cache,
+    )
+
+    def fn(X):
+        calls.append(1)
+        return {"Y": jnp.asarray(X) + 1.0}
+
+    return JaxModel(cfg, fn, jit=False), calls
+
+
+@pytest.fixture()
+def harness():
+    registry = ModelRegistry()
+    model, calls = _counting_model()
+    registry.register_model(model)
+    uncached, ucalls = _counting_model("uncached", cache=False)
+    registry.register_model(uncached)
+    with ServerHarness(registry) as h:
+        h.calls = calls
+        h.ucalls = ucalls
+        yield h
+
+
+def _infer(client, model, x):
+    inp = httpclient.InferInput("X", [1, 4], "FP32")
+    inp.set_data_from_numpy(x)
+    return client.infer(model, [inp])
+
+
+class TestResponseCache:
+    def test_identical_requests_hit(self, harness):
+        with httpclient.InferenceServerClient(harness.http_url) as client:
+            x = np.ones((1, 4), np.float32)
+            for _ in range(3):
+                res = _infer(client, "cached", x)
+                np.testing.assert_array_equal(res.as_numpy("Y"), x + 1)
+        assert len(harness.calls) == 1  # 1 execution, 2 cache hits
+        assert harness.core.response_cache.hits == 2
+        # cache hits remain visible to statistics (Triton behavior)
+        with httpclient.InferenceServerClient(harness.http_url) as client:
+            stats = client.get_inference_statistics("cached")
+            s = stats["model_stats"][0]["inference_stats"]
+            assert s["success"]["count"] == 3
+
+    def test_different_inputs_miss(self, harness):
+        with httpclient.InferenceServerClient(harness.http_url) as client:
+            _infer(client, "cached", np.ones((1, 4), np.float32))
+            _infer(client, "cached", np.zeros((1, 4), np.float32))
+        assert len(harness.calls) == 2
+
+    def test_different_parameters_miss(self, harness):
+        with httpclient.InferenceServerClient(harness.http_url) as client:
+            x = np.ones((1, 4), np.float32)
+            inp = httpclient.InferInput("X", [1, 4], "FP32")
+            inp.set_data_from_numpy(x)
+            client.infer("cached", [inp])
+            client.infer("cached", [inp], parameters={"variant": "b"})
+        assert len(harness.calls) == 2
+
+    def test_disabled_model_never_caches(self, harness):
+        with httpclient.InferenceServerClient(harness.http_url) as client:
+            x = np.ones((1, 4), np.float32)
+            _infer(client, "uncached", x)
+            _infer(client, "uncached", x)
+        assert len(harness.ucalls) == 2
+
+    def test_reload_invalidates(self, harness):
+        with httpclient.InferenceServerClient(harness.http_url) as client:
+            x = np.ones((1, 4), np.float32)
+            _infer(client, "cached", x)
+            client.unload_model("cached")
+            client.load_model("cached")
+            _infer(client, "cached", x)
+        # same instance via register_model factory, but a new generation:
+        # the old entry must not answer for the reloaded model
+        assert len(harness.calls) == 2

@@ -812,9 +812,24 @@ class GenerateModel:
             window[0, dec._prompt_len - b.size:] = b
         window = np.clip(window, 0, cfg.vocab_size - 1)
 
+        # Enqueue the WHOLE decode chain with the greedy token fed back as a
+        # device array — no host readback inside the loop (jax async
+        # dispatch).  On a tunneled chip a per-token blocking argmax
+        # readback costs a full RTT (~100 ms) per token; device-resident
+        # feedback makes inter-token latency the on-device step time, with
+        # readbacks prefetched so they overlap the remaining steps.
         logits, cache = prefill(params, jnp.asarray(window))
+        tok_devs = []
         for i in range(n_tokens):
-            tok = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+            tok_dev = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
+            if hasattr(tok_dev, "copy_to_host_async"):
+                tok_dev.copy_to_host_async()
+            tok_devs.append(tok_dev)
+            if i < n_tokens - 1:
+                logits, cache = step(
+                    params, cache, tok_dev.reshape(1, 1))
+        for tok_dev in tok_devs:
+            tok = int(np.asarray(tok_dev)[0])
             # text_output: chr(token mod 256) as UTF-8 (JSON-safe; the byte
             # "detokenizer" aliases ids >= 256 at large vocab sizes, same as
             # llama_postprocess) — token_id carries the exact id losslessly
@@ -823,9 +838,6 @@ class GenerateModel:
                     [chr(tok % 256).encode("utf-8")], dtype=object),
                 "token_id": np.asarray([tok], np.int32),
             }
-            if i < n_tokens - 1:
-                logits, cache = step(
-                    params, cache, jnp.asarray([[tok]], jnp.int32))
 
 
 def make_llama_generate(decode: DecodeModel):

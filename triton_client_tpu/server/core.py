@@ -73,6 +73,84 @@ class _InlineProfile:
                 and self.ema < self.MAX_INLINE_S)
 
 
+class _ResponseCache:
+    """LRU answering identical requests without executing the model
+    (Triton ``response_cache.enable``).
+
+    Keyed on (model, registry generation, input bytes, request parameters,
+    requested outputs).  Only stateless wire requests cache: sequence,
+    shared-memory, decoupled, and ensemble requests bypass it."""
+
+    MAX_ENTRIES = 64
+    MAX_ITEM_BYTES = 8 << 20
+    # inputs above this size are not worth hashing on the event loop (the
+    # key is computed inline; SHA-256 of 1 MiB is ~0.5 ms — larger requests
+    # bypass the cache entirely)
+    MAX_KEY_BYTES = 1 << 20
+
+    def __init__(self) -> None:
+        from collections import OrderedDict
+
+        self._entries: "OrderedDict[tuple, Dict[str, np.ndarray]]" = \
+            OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(model: Model, generation: int, request: InferRequest,
+            inputs: Dict[str, Any]) -> Optional[tuple]:
+        import hashlib
+
+        total = 0
+        for v in inputs.values():
+            if not isinstance(v, np.ndarray):
+                return None  # device-resident input — not cacheable
+            total += _ResponseCache._nbytes(v)
+        if total > _ResponseCache.MAX_KEY_BYTES:
+            return None
+        h = hashlib.sha256()
+        for name in sorted(inputs):
+            v = inputs[name]
+            h.update(name.encode())
+            h.update(str(v.dtype).encode())
+            h.update(str(v.shape).encode())
+            h.update(v.tobytes() if v.dtype != object
+                     else repr(v.tolist()).encode())
+        h.update(repr(sorted(request.parameters.items())).encode())
+        h.update(repr(sorted(
+            (o.name, o.class_count) for o in request.outputs)).encode())
+        return (model.name, generation, request.model_version, h.hexdigest())
+
+    def get(self, key: tuple) -> Optional[Dict[str, np.ndarray]]:
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return entry
+
+    @staticmethod
+    def _nbytes(v: np.ndarray) -> int:
+        if v.dtype != object:
+            return v.nbytes
+        return sum(len(x) if isinstance(x, (bytes, str)) else 64
+                   for x in v.reshape(-1))
+
+    def put(self, key: tuple, outputs: Dict[str, Any]) -> None:
+        total = 0
+        for v in outputs.values():
+            if not isinstance(v, np.ndarray):
+                return
+            total += self._nbytes(v)
+        if total > self.MAX_ITEM_BYTES:
+            return
+        self._entries[key] = outputs
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.MAX_ENTRIES:
+            self._entries.popitem(last=False)
+
+
 class _DynamicBatcher:
     """Queue + pad-to-bucket batcher for one model.
 
@@ -263,6 +341,7 @@ class InferenceCore:
         }
         self._batchers: Dict[str, _DynamicBatcher] = {}
         self._inline_profiles: Dict[str, _InlineProfile] = {}
+        self.response_cache = _ResponseCache()
         self.live = True
 
     # ------------------------------------------------------------------
@@ -279,6 +358,24 @@ class InferenceCore:
     async def _infer_on(self, model: Model, request: InferRequest) -> InferResponse:
         inputs = self._resolve_inputs(model, request)
         params = dict(request.parameters)
+        cache_key = None
+        if (model.config.HasField("response_cache")
+                and model.config.response_cache.enable
+                and not isinstance(model, EnsembleModel)
+                and not request.sequence_id
+                and not any(i.shm is not None for i in request.inputs)
+                and not any(o.shm is not None for o in request.outputs)):
+            cache_key = _ResponseCache.key(
+                model, self.registry.generation(model.name), request, inputs)
+            if cache_key is not None:
+                cached = self.response_cache.get(cache_key)
+                if cached is not None:
+                    # cache hits still count in statistics/metrics (Triton
+                    # behavior) — zero compute, real queue time
+                    model.stats.record(
+                        _batch_count(cached) or 1,
+                        time.monotonic_ns() - request.arrival_ns, 0, ok=True)
+                    return self._build_response(model, request, dict(cached))
         if isinstance(model, EnsembleModel):
             t0 = time.monotonic_ns()
             queue_ns = t0 - request.arrival_ns
@@ -315,6 +412,8 @@ class InferenceCore:
                 raise InferError(f"inference failed: {e}", http_status=500)
             compute_ns = time.monotonic_ns() - t0
             model.stats.record(_batch_count(inputs) or 1, queue_ns, compute_ns, ok=True)
+        if cache_key is not None:
+            self.response_cache.put(cache_key, dict(outputs))
         return self._build_response(model, request, outputs)
 
     async def infer_stream(self, request: InferRequest) -> AsyncIterator[InferResponse]:

@@ -69,6 +69,7 @@ def make_config(
     instance_kind: Optional[str] = None,
     parameters: Optional[Dict[str, str]] = None,
     warmup: Optional[Sequence[dict]] = None,
+    response_cache: bool = False,
 ) -> pb.ModelConfig:
     """Convenience builder for a ModelConfig proto.
 
@@ -98,6 +99,8 @@ def make_config(
         grp.count = 1
     for key, value in (parameters or {}).items():
         cfg.parameters[key].string_value = str(value)
+    if response_cache:
+        cfg.response_cache.enable = True
     # warmup: [{"name": ..., "batch_size": N, "count": N,
     #           "inputs": {tensor: (dtype str, dims, "zero"|"random")}}]
     for w in warmup or []:
