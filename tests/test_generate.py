@@ -178,3 +178,60 @@ class TestGenerateStream:
                     break
             client.stop_stream()
         assert len(toks) == 3
+
+
+class TestSampling:
+    def _stream(self, server, body):
+        with _post(server.http_url,
+                   "/v2/models/llama_generate/generate_stream", body) as resp:
+            return [f["token_id"] for f in _sse_frames(resp)]
+
+    def test_temperature_zero_is_greedy(self, server):
+        base = {"text_input": "sample me", "max_tokens": 4}
+        greedy = self._stream(server, base)
+        explicit = self._stream(server, {**base, "temperature": 0})
+        assert greedy == explicit
+
+    def test_seed_reproduces_and_varies(self, server):
+        base = {"text_input": "sample me", "max_tokens": 8,
+                "temperature": 2.0}
+        a = self._stream(server, {**base, "seed": 7})
+        b = self._stream(server, {**base, "seed": 7})
+        c = self._stream(server, {**base, "seed": 8})
+        assert a == b
+        assert a != c  # 8 tokens at temperature 2: collision ~impossible
+
+    def test_top_k_one_is_greedy_at_any_temperature(self, server):
+        base = {"text_input": "sample me", "max_tokens": 4}
+        greedy = self._stream(server, base)
+        forced = self._stream(server, {**base, "temperature": 5.0,
+                                       "top_k": 1})
+        assert greedy == forced
+
+    def test_invalid_top_k_rejected(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url,
+                  "/v2/models/llama_generate/generate_stream",
+                  {"text_input": "x", "top_k": -2, "temperature": 1.0})
+        assert e.value.code == 400
+
+    def test_unseeded_sampling_varies_across_requests(self, server):
+        base = {"text_input": "vary me", "max_tokens": 8,
+                "temperature": 2.0}
+        a = self._stream(server, base)
+        b = self._stream(server, base)
+        assert a != b  # fresh seed per unseeded request
+
+    def test_non_numeric_sampling_param_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url,
+                  "/v2/models/llama_generate/generate_stream",
+                  {"text_input": "x", "temperature": "hot"})
+        assert e.value.code == 400
+
+    def test_negative_temperature_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url,
+                  "/v2/models/llama_generate/generate_stream",
+                  {"text_input": "x", "temperature": -1})
+        assert e.value.code == 400

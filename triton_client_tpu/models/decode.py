@@ -19,6 +19,7 @@ sharded training/forward path stays in ``transformer.py``.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any, Dict
 
@@ -795,6 +796,25 @@ class GenerateModel:
 
         self.model = _Impl(cfg)
 
+    @staticmethod
+    @functools.lru_cache(maxsize=16)
+    def _sampler(top_k: int):
+        """Jitted device-side token chooser — temperature scaling, optional
+        static top-k truncation, categorical sample.  One compile per
+        distinct top_k (bounded by the lru cache)."""
+
+        def choose(logits, key, temperature):
+            l32 = logits.astype(jnp.float32)
+            if top_k > 0:
+                top_vals, _ = lax.top_k(l32, top_k)
+                thresh = top_vals[..., -1:]
+                l32 = jnp.where(l32 >= thresh, l32, -jnp.inf)
+            return jax.random.categorical(
+                key, l32 / jnp.maximum(temperature, 1e-6),
+                axis=-1).astype(jnp.int32)
+
+        return jax.jit(choose)
+
     def _generate(self, inputs, parameters):
         np = self._np
         dec = self._decode
@@ -805,6 +825,26 @@ class GenerateModel:
             prompt = prompt.encode()
         n_tokens = int(parameters.get("max_tokens", self._default_tokens))
         n_tokens = max(1, min(n_tokens, dec._s_max - dec._prompt_len))
+        from ..server.types import InferError
+
+        try:
+            temperature = float(parameters.get("temperature", 0.0))
+            top_k = int(parameters.get("top_k", 0))
+            seed = parameters.get("seed")
+            seed = None if seed is None else int(seed)
+        except (TypeError, ValueError) as e:
+            raise InferError(f"invalid sampling parameter: {e}")
+        if not (temperature >= 0 and math.isfinite(temperature)):
+            raise InferError(
+                f"temperature must be finite and >= 0, got {temperature}")
+        if top_k < 0 or top_k > cfg.vocab_size:
+            raise InferError(
+                f"top_k must be in [0, {cfg.vocab_size}], got {top_k}")
+        if seed is None:
+            # unseeded sampling must vary across requests
+            import os as _os
+
+            seed = int.from_bytes(_os.urandom(4), "little")
 
         window = np.zeros((1, dec._prompt_len), np.int32)
         b = np.frombuffer(bytes(prompt[-dec._prompt_len:]), np.uint8)
@@ -812,16 +852,28 @@ class GenerateModel:
             window[0, dec._prompt_len - b.size:] = b
         window = np.clip(window, 0, cfg.vocab_size - 1)
 
-        # Enqueue the WHOLE decode chain with the greedy token fed back as a
+        # Enqueue the WHOLE decode chain with the chosen token (greedy or
+        # sampled) fed back as a
         # device array — no host readback inside the loop (jax async
         # dispatch).  On a tunneled chip a per-token blocking argmax
         # readback costs a full RTT (~100 ms) per token; device-resident
         # feedback makes inter-token latency the on-device step time, with
         # readbacks prefetched so they overlap the remaining steps.
+        if temperature > 0:
+            sampler = self._sampler(top_k)
+            base_key = jax.random.PRNGKey(seed)
+
+            def choose(logits, i):
+                return sampler(logits, jax.random.fold_in(base_key, i),
+                               jnp.float32(temperature))
+        else:
+            def choose(logits, i):
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
         logits, cache = prefill(params, jnp.asarray(window))
         tok_devs = []
         for i in range(n_tokens):
-            tok_dev = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
+            tok_dev = choose(logits, i)  # [1], stays on device
             if hasattr(tok_dev, "copy_to_host_async"):
                 tok_dev.copy_to_host_async()
             tok_devs.append(tok_dev)
