@@ -409,3 +409,45 @@ class TestBatchedMode:
         with pytest.raises(InferError, match="unloading"):
             model._execute({"TOKENS": np.array([1], np.int32)},
                            {"sequence_id": 3500})
+
+
+class TestInt8Quantization:
+    """Weight-only int8 (quantize_layer_weights + _w dequant in the scan)."""
+
+    def test_quantized_logits_close_to_fp(self, params):
+        toks = jnp.asarray(
+            np.random.default_rng(5).integers(0, 64, (1, 10)), jnp.int32)
+        want = np.asarray(decode.reference_forward(params, toks, CFG))
+        qparams = decode.quantize_layer_weights(params, CFG)
+        got = np.asarray(decode.reference_forward(qparams, toks, CFG))
+        # int8 weight error is bounded; logits track closely in cosine terms
+        cos = float(np.sum(want * got) /
+                    (np.linalg.norm(want) * np.linalg.norm(got)))
+        assert cos > 0.999, cos
+        # and greedy decisions at the last position agree
+        assert int(np.argmax(want[:, -1])) == int(np.argmax(got[:, -1]))
+
+    def test_quantized_prefill_decode_consistent(self, params):
+        """prefill+step on quantized weights == full quantized forward —
+        the KV cache stays exact under quantization."""
+        qparams = decode.quantize_layer_weights(params, CFG)
+        rng = np.random.default_rng(6)
+        all_toks = jnp.asarray(rng.integers(0, 64, (1, 12)), jnp.int32)
+        P = 6
+        prefill = decode.make_prefill(CFG, S_MAX)
+        step = decode.make_decode_step(CFG)
+        logits, cache = prefill(qparams, all_toks[:, :P])
+        for t in range(P, 12):
+            want = decode.reference_forward(
+                qparams, all_toks[:, :t], CFG)[:, -1]
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(want), rtol=2e-4, atol=2e-4)
+            logits, cache = step(qparams, cache, all_toks[:, t:t + 1])
+
+    def test_int8_storage_and_scales(self, params):
+        q = decode.quantize_layer_weights(params, CFG)
+        for k in ("wq", "wk", "wv", "wo", "w1", "w2"):
+            assert q[k].dtype == jnp.int8
+            assert (k + "_scale") in q
+            assert q[k + "_scale"].shape[0] == CFG.n_layers
+        assert q["embed"].dtype != jnp.int8  # embedding stays fp
