@@ -1,0 +1,122 @@
+// Server-side generation from C++ over the decoupled duplex stream
+// (framework extension mirrored from examples/simple_http_generate_client.py):
+// ONE request carrying the prompt (BYTES) + max_tokens parameter; the server
+// runs the whole KV-cache decode loop and streams a token per response.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = tc_tpu::client;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  std::string prompt = "In a hole in the ground";
+  int n_tokens = 4;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+    if (strcmp(argv[i], "-p") == 0) prompt = argv[i + 1];
+    if (strcmp(argv[i], "-n") == 0) n_tokens = atoi(argv[i + 1]);
+  }
+
+  // declared BEFORE the client: the stream callback captures these, and
+  // the client's destructor joins its reader thread — reverse destruction
+  // order must tear the client down first
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> token_ids;
+  std::string text;
+  size_t text_frames = 0;
+  bool got_final = false, stream_error = false;
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  tc::Error err = tc::InferenceServerGrpcClient::Create(&client, url);
+  if (!err.IsOk()) {
+    fprintf(stderr, "client creation failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  err = client->StartStream([&](tc::InferResult* r) {
+    std::lock_guard<std::mutex> lk(mu);
+    bool is_final = false, is_null = false;
+    r->IsFinalResponse(&is_final);
+    r->IsNullResponse(&is_null);
+    if (is_final) got_final = true;
+    if (!is_null) {
+      if (!r->RequestStatus().IsOk()) {
+        fprintf(stderr, "stream error: %s\n",
+                r->RequestStatus().Message().c_str());
+        stream_error = true;
+      } else {
+        const uint8_t* buf;
+        size_t len;
+        if (r->RawData("token_id", &buf, &len).IsOk() && len >= 4) {
+          int32_t tok;
+          memcpy(&tok, buf, 4);
+          token_ids.push_back(tok);
+        }
+        // BYTES wire format: <u32 length><utf-8 chars>
+        if (r->RawData("text_output", &buf, &len).IsOk() && len >= 4) {
+          uint32_t slen;
+          memcpy(&slen, buf, 4);
+          if (slen <= len - 4) {
+            // one frame per token; a char may be 1-2 UTF-8 bytes
+            text.append(reinterpret_cast<const char*>(buf + 4), slen);
+            ++text_frames;
+          }
+        }
+      }
+    }
+    cv.notify_all();
+    delete r;
+  });
+  if (!err.IsOk()) {
+    fprintf(stderr, "stream start failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  tc::InferInput* tin;
+  tc::InferInput::Create(&tin, "text_input", {1}, "BYTES");
+  tin->AppendFromString({prompt});
+  tc::InferOptions options("llama_generate");
+  options.triton_enable_empty_final_response_ = true;
+  options.request_parameters_["max_tokens"] = std::to_string(n_tokens);
+  err = client->AsyncStreamInfer(options, {tin});
+  if (!err.IsOk()) {
+    fprintf(stderr, "stream infer failed: %s\n", err.Message().c_str());
+    client->FinishStream();
+    return 1;
+  }
+
+  bool timed_out = false;
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    // the server clamps max_tokens to its window capacity, so wait for
+    // the final flag and validate the count afterwards
+    timed_out = !cv.wait_for(lk, std::chrono::seconds(120), [&] {
+      return stream_error || got_final;
+    });
+  }
+  client->FinishStream();  // joins the reader thread before locals die
+  delete tin;
+  if (stream_error) return 1;
+  if (timed_out) {
+    fprintf(stderr, "timed out: %zu/%d tokens\n", token_ids.size(), n_tokens);
+    return 1;
+  }
+  if (token_ids.empty() || token_ids.size() != text_frames) {
+    fprintf(stderr, "inconsistent stream: %zu ids, %zu text frames\n",
+            token_ids.size(), text_frames);
+    return 1;
+  }
+  printf("prompt: \"%s\"\n", prompt.c_str());
+  printf("generated %zu tokens, text bytes: %zu\n", token_ids.size(),
+         text.size());
+  printf("PASS: generate stream\n");
+  return 0;
+}

@@ -89,6 +89,7 @@ def test_cpp_http_example(native_build, harness, example):
     "simple_grpc_keepalive_client",
     "simple_grpc_custom_args_client",
     "simple_grpc_decode_client",
+    "simple_grpc_generate_client",
 ])
 def test_cpp_grpc_example(native_build, harness, example):
     # the C++ gRPC client rides the grpc-web bridge on the HTTP port
