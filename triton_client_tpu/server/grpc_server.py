@@ -26,7 +26,8 @@ from ..utils import (
 )
 from .core import InferenceCore
 from .model import datatype_to_pb
-from .types import InferError, InferRequest, InputTensor, RequestedOutput, ShmRef
+from .types import (InferError, InferRequest, InputTensor,
+                    RequestedOutput, ShmRef, reshape_input)
 
 
 def pb_param_to_py(p: pb.InferParameter):
@@ -74,11 +75,16 @@ def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
         tensor = InputTensor(name=t.name, datatype=t.datatype, shape=shape, parameters=params)
         shm_name = params.get("shared_memory_region")
         if shm_name:
-            tensor.shm = ShmRef(
-                region_name=shm_name,
-                byte_size=int(params["shared_memory_byte_size"]),
-                offset=int(params.get("shared_memory_offset", 0)),
-            )
+            try:
+                tensor.shm = ShmRef(
+                    region_name=shm_name,
+                    byte_size=int(params["shared_memory_byte_size"]),
+                    offset=int(params.get("shared_memory_offset", 0)),
+                )
+            except (KeyError, TypeError, ValueError) as e:
+                raise InferError(
+                    f"malformed shared-memory parameters for input "
+                    f"'{t.name}': {e}")
         elif raw:
             tensor.data = _raw_to_array(raw[raw_idx], t.datatype, shape, t.name)
             raw_idx += 1
@@ -96,18 +102,23 @@ def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
         )
         shm_name = params.get("shared_memory_region")
         if shm_name:
-            out.shm = ShmRef(
-                region_name=shm_name,
-                byte_size=int(params["shared_memory_byte_size"]),
-                offset=int(params.get("shared_memory_offset", 0)),
-            )
+            try:
+                out.shm = ShmRef(
+                    region_name=shm_name,
+                    byte_size=int(params["shared_memory_byte_size"]),
+                    offset=int(params.get("shared_memory_offset", 0)),
+                )
+            except (KeyError, TypeError, ValueError) as e:
+                raise InferError(
+                    f"malformed shared-memory parameters for output "
+                    f"'{o.name}': {e}")
         req.outputs.append(out)
     return req
 
 
 def _raw_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray:
     if datatype == "BYTES":
-        return deserialize_bytes_tensor(chunk).reshape(shape)
+        return reshape_input(deserialize_bytes_tensor(chunk), shape, name)
     dt = triton_to_np_dtype(datatype)
     if dt is None:
         raise InferError(f"unsupported datatype '{datatype}' for input '{name}'")
@@ -117,7 +128,7 @@ def _raw_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray:
             f"unexpected total byte size {len(chunk)} for input '{name}', "
             f"expecting {count * dt.itemsize}"
         )
-    return np.frombuffer(chunk, dtype=dt).reshape(shape)
+    return reshape_input(np.frombuffer(chunk, dtype=dt), shape, name)
 
 
 _CONTENTS_FIELD = {
@@ -144,8 +155,10 @@ def _contents_to_array(contents, datatype: str, shape, name: str) -> np.ndarray:
         )
     values = list(getattr(contents, field))
     if datatype == "BYTES":
-        return np.array(values, dtype=np.object_).reshape(shape)
-    return np.array(values, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+        return reshape_input(
+            np.array(values, dtype=np.object_), shape, name)
+    return reshape_input(
+        np.array(values, dtype=triton_to_np_dtype(datatype)), shape, name)
 
 
 def _encode_pb_response(resp) -> pb.ModelInferResponse:

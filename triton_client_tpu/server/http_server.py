@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import binascii
 import gzip
 import json
 import zlib
@@ -26,7 +27,8 @@ from ..utils import (
     triton_to_np_dtype,
 )
 from .core import InferenceCore
-from .types import InferError, InferRequest, InputTensor, RequestedOutput, ShmRef
+from .types import (InferError, InferRequest, InputTensor,
+                    RequestedOutput, ShmRef, reshape_input)
 
 _HEADER_LEN = "Inference-Header-Content-Length"
 
@@ -78,6 +80,20 @@ def build_app(core: InferenceCore) -> web.Application:
 
     add_grpc_web_routes(app, InferenceServicer(core))
     return app
+
+
+async def _read_json(request: web.Request, default=None, expect_object=True):
+    """Parse a JSON body as a client error (400) — a malformed body (or a
+    valid one of the wrong top-level type) must never surface as a 500."""
+    if default is not None and not request.can_read_body:
+        return default
+    try:
+        body = await request.json()
+    except Exception:
+        raise InferError("failed to parse request JSON")
+    if expect_object and not isinstance(body, dict):
+        raise InferError("request body must be a JSON object")
+    return body
 
 
 def build_metrics_app(core: InferenceCore) -> web.Application:
@@ -153,14 +169,14 @@ async def _model_stats(core, request):
 
 
 async def _repo_index(core, request):
-    body = await request.json() if request.can_read_body else {}
+    body = await _read_json(request, default={})
     ready = bool(body.get("ready", False))
     return web.json_response(core.registry.index(ready_only=ready))
 
 
 async def _repo_load(core, request):
     name = request.match_info["model"]
-    body = await request.json() if request.can_read_body else {}
+    body = await _read_json(request, default={})
     params = body.get("parameters", {}) or {}
     config_override = params.get("config")
     files = {k: v for k, v in params.items() if k.startswith("file:")}
@@ -171,7 +187,7 @@ async def _repo_load(core, request):
 
 async def _repo_unload(core, request):
     name = request.match_info["model"]
-    body = await request.json() if request.can_read_body else {}
+    body = await _read_json(request, default={})
     params = body.get("parameters", {}) or {}
     core.registry.unload(name, unload_dependents=bool(params.get("unload_dependents")))
     return web.Response(status=200)
@@ -185,7 +201,7 @@ async def _get_trace(core, request):
 
 
 async def _set_trace(core, request):
-    body = await request.json() if request.can_read_body else {}
+    body = await _read_json(request, default={})
     for k, v in body.items():
         if v is None:
             # null clears to default (reference update_trace_settings contract)
@@ -274,7 +290,7 @@ async def _get_logging(core, request):
 
 
 async def _set_logging(core, request):
-    body = await request.json() if request.can_read_body else {}
+    body = await _read_json(request, default={})
     core.log_settings.update(body)
     return web.json_response(core.log_settings)
 
@@ -295,14 +311,30 @@ async def _shm_status(core, request):
 async def _shm_register(core, request):
     reg = _shm_registry(core, request)
     name = request.match_info["name"]
-    body = await request.json()
-    if reg is core.system_shm:
-        reg.register(
-            name, body["key"], int(body.get("offset", 0)), int(body["byte_size"])
-        )
-    else:
-        raw = base64.b64decode(body["raw_handle"]["b64"])
-        reg.register(name, raw, int(body.get("device_id", 0)), int(body["byte_size"]))
+    body = await _read_json(request)
+    needed = (("key", "byte_size") if reg is core.system_shm
+              else ("raw_handle", "byte_size"))
+    missing = [k for k in needed if k not in body]
+    if missing:
+        raise InferError(
+            f"shared memory registration missing field(s): {missing}")
+    try:
+        if reg is core.system_shm:
+            reg.register(
+                name, body["key"], int(body.get("offset", 0)),
+                int(body["byte_size"]))
+        else:
+            handle = body["raw_handle"]
+            if not isinstance(handle, dict) or "b64" not in handle:
+                raise InferError(
+                    "raw_handle must be an object with a 'b64' field")
+            raw = base64.b64decode(handle["b64"], validate=True)
+            reg.register(name, raw, int(body.get("device_id", 0)),
+                         int(body["byte_size"]))
+    except InferError:
+        raise
+    except (TypeError, ValueError, binascii.Error) as e:
+        raise InferError(f"invalid shared memory registration: {e}")
     return web.Response(status=200)
 
 
@@ -321,7 +353,11 @@ async def _infer(core, request: web.Request) -> web.Response:
 
     header_len = request.headers.get(_HEADER_LEN)
     if header_len is not None:
-        json_bytes, binary = raw[: int(header_len)], raw[int(header_len) :]
+        try:
+            hlen = int(header_len)
+        except ValueError:
+            raise InferError(f"invalid {_HEADER_LEN} header: {header_len!r}")
+        json_bytes, binary = raw[:hlen], raw[hlen:]
     else:
         json_bytes, binary = raw, b""
     try:
@@ -334,7 +370,7 @@ async def _infer(core, request: web.Request) -> web.Response:
     )
     resp = await core.infer(req)
     default_binary = bool(
-        body.get("parameters", {}).get("binary_data_output", header_len is not None)
+        req.parameters.get("binary_data_output", header_len is not None)
     )
     payload, json_len = _encode_response(resp, req, default_binary)
     headers = {_HEADER_LEN: str(json_len)}
@@ -350,6 +386,15 @@ async def _infer(core, request: web.Request) -> web.Response:
 def _decode_request(
     model_name: str, version: str, body: dict, binary: bytes
 ) -> InferRequest:
+    # structural validation first: every client-controlled field that the
+    # loop below indexes must 400 (not 500) when it has the wrong type
+    if not isinstance(body, dict):
+        raise InferError("inference request body must be a JSON object")
+    if not isinstance(body.get("inputs", []), list) \
+            or not isinstance(body.get("outputs", []), list):
+        raise InferError("'inputs'/'outputs' must be arrays")
+    if not isinstance(body.get("parameters", {}) or {}, dict):
+        raise InferError("'parameters' must be an object")
     req = InferRequest(
         model_name=model_name,
         model_version=version,
@@ -358,47 +403,60 @@ def _decode_request(
     )
     offset = 0
     for t in body.get("inputs", []):
-        name, datatype = t["name"], t["datatype"]
-        shape = tuple(int(s) for s in t["shape"])
+        try:
+            name, datatype = t["name"], t["datatype"]
+            shape = tuple(int(s) for s in t["shape"])
+        except (TypeError, KeyError, ValueError, AttributeError) as e:
+            raise InferError(f"malformed input specification: {e}")
         params = t.get("parameters", {}) or {}
+        if not isinstance(params, dict):
+            raise InferError(f"input '{name}' parameters must be an object")
         tensor = InputTensor(name=name, datatype=datatype, shape=shape, parameters=params)
         shm_name = params.get("shared_memory_region")
         bin_size = params.get("binary_data_size")
-        if shm_name:
-            tensor.shm = ShmRef(
-                region_name=shm_name,
-                byte_size=int(params["shared_memory_byte_size"]),
-                offset=int(params.get("shared_memory_offset", 0)),
-            )
-        elif bin_size is not None:
-            chunk = binary[offset : offset + int(bin_size)]
-            if len(chunk) != int(bin_size):
-                raise InferError(
-                    f"unexpected end of binary data for input '{name}'"
+        try:
+            if shm_name:
+                tensor.shm = ShmRef(
+                    region_name=shm_name,
+                    byte_size=int(params["shared_memory_byte_size"]),
+                    offset=int(params.get("shared_memory_offset", 0)),
                 )
-            offset += int(bin_size)
-            tensor.data = _bytes_to_array(chunk, datatype, shape, name)
-        elif "data" in t:
-            tensor.data = _json_to_array(t["data"], datatype, shape)
-        else:
-            raise InferError(f"input '{name}' has no data")
+            elif bin_size is not None:
+                chunk = binary[offset: offset + int(bin_size)]
+                if len(chunk) != int(bin_size):
+                    raise InferError(
+                        f"unexpected end of binary data for input '{name}'"
+                    )
+                offset += int(bin_size)
+                tensor.data = _bytes_to_array(chunk, datatype, shape, name)
+            elif "data" in t:
+                tensor.data = _json_to_array(t["data"], datatype, shape, name)
+            else:
+                raise InferError(f"input '{name}' has no data")
+        except (TypeError, KeyError, ValueError, AttributeError) as e:
+            raise InferError(f"malformed input '{name}': {e}")
         req.inputs.append(tensor)
 
     for o in body.get("outputs", []) or []:
-        params = o.get("parameters", {}) or {}
-        out = RequestedOutput(
-            name=o["name"],
-            binary_data=bool(params.get("binary_data", False)),
-            class_count=int(params.get("classification", 0)),
-            parameters=params,
-        )
-        shm_name = params.get("shared_memory_region")
-        if shm_name:
-            out.shm = ShmRef(
-                region_name=shm_name,
-                byte_size=int(params["shared_memory_byte_size"]),
-                offset=int(params.get("shared_memory_offset", 0)),
+        try:
+            params = o.get("parameters", {}) or {}
+            if not isinstance(params, dict):
+                raise InferError("output parameters must be an object")
+            out = RequestedOutput(
+                name=o["name"],
+                binary_data=bool(params.get("binary_data", False)),
+                class_count=int(params.get("classification", 0)),
+                parameters=params,
             )
+            shm_name = params.get("shared_memory_region")
+            if shm_name:
+                out.shm = ShmRef(
+                    region_name=shm_name,
+                    byte_size=int(params["shared_memory_byte_size"]),
+                    offset=int(params.get("shared_memory_offset", 0)),
+                )
+        except (TypeError, KeyError, ValueError, AttributeError) as e:
+            raise InferError(f"malformed output specification: {e}")
         req.outputs.append(out)
     return req
 
@@ -406,7 +464,7 @@ def _decode_request(
 def _bytes_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray:
     if datatype == "BYTES":
         flat = deserialize_bytes_tensor(chunk)
-        return flat.reshape(shape)
+        return reshape_input(flat, shape, name)
     dt = triton_to_np_dtype(datatype)
     if dt is None:
         raise InferError(f"unsupported datatype '{datatype}' for input '{name}'")
@@ -416,18 +474,32 @@ def _bytes_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray
         raise InferError(
             f"unexpected total byte size {len(chunk)} for input '{name}', expecting {expected}"
         )
-    return np.frombuffer(chunk, dtype=dt).reshape(shape)
+    return reshape_input(np.frombuffer(chunk, dtype=dt), shape, name)
 
 
-def _json_to_array(data, datatype: str, shape) -> np.ndarray:
+def _json_to_array(data, datatype: str, shape, name: str = "") -> np.ndarray:
     if datatype == "BYTES":
+        def coerce(x):
+            if isinstance(x, str):
+                return x.encode("utf-8")
+            if isinstance(x, (bytes, bytearray, list)):
+                return bytes(x)
+            # bytes(int) would ALLOCATE that many zero bytes — a client-
+            # controlled memory bomb, not a serialization
+            raise InferError(
+                f"BYTES input '{name}' elements must be strings or byte "
+                f"arrays, got {type(x).__name__}")
         flat = np.array(
-            [x.encode("utf-8") if isinstance(x, str) else bytes(x) for x in _flatten(data)],
-            dtype=np.object_,
-        )
-        return flat.reshape(shape)
+            [coerce(x) for x in _flatten(data)], dtype=np.object_)
+        return reshape_input(flat, shape, name)
     dt = triton_to_np_dtype(datatype)
-    return np.array(data, dtype=dt).reshape(shape)
+    if dt is None:
+        raise InferError(f"unsupported datatype '{datatype}' for input '{name}'")
+    try:
+        arr = np.array(data, dtype=dt)
+    except (ValueError, TypeError) as e:
+        raise InferError(f"invalid data for input '{name}': {e}")
+    return reshape_input(arr, shape, name)
 
 
 def _flatten(x):

@@ -93,3 +93,13 @@ class InferError(Exception):
     def __init__(self, msg: str, http_status: int = 400):
         super().__init__(msg)
         self.http_status = http_status
+
+
+def reshape_input(arr: np.ndarray, shape, name: str) -> np.ndarray:
+    """Reshape client-provided tensor data, failing as a client error (HTTP
+    400 / gRPC InvalidArgument) instead of an escaped ValueError."""
+    try:
+        return arr.reshape(shape)
+    except (ValueError, TypeError) as e:
+        raise InferError(
+            f"invalid shape {list(shape)} for input '{name}': {e}")
