@@ -57,6 +57,38 @@ def _previous_baseline() -> float | None:
     return min(rounds)[1]
 
 
+def _measure_generation(harness) -> dict:
+    """LLM serving leg: server-side generation over the generate extension
+    with weight-only int8 (BASELINE row 10).  TPU-only — the point is the
+    on-device decode rate, meaningless on CPU.  The quant env is set before
+    the llama weights first initialize (no earlier leg touches them)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from triton_client_tpu.genai_perf import profile_generate
+
+    os.environ["TRITON_TPU_QUANT"] = "int8"
+    http_url = f"127.0.0.1:{harness.http_port}"
+    try:
+        # warm pass compiles prefill AND the decode step (2-token run)
+        profile_generate(http_url, "llama_generate", concurrency=1,
+                         output_tokens=2, num_requests=1,
+                         stream_timeout=1200.0)
+        rep = profile_generate(http_url, "llama_generate", concurrency=8,
+                               output_tokens=24, num_requests=8,
+                               stream_timeout=1200.0)
+    except Exception as e:  # noqa: BLE001 — bench keeps going without it
+        return {"gen_error": str(e)[:120]}
+    if rep["errors"]:
+        return {"gen_error": str(rep.get("first_error"))[:120]}
+    return {
+        "gen_int8_tok_per_sec_c8": rep["output_token_throughput_per_sec"],
+        "gen_int8_ttft_p50_ms": round(
+            rep["time_to_first_token_ms"].get("p50", 0.0), 1),
+    }
+
+
 def _measure_rtt_floor() -> float:
     """Median blocking device round trip (H2D + sync + D2H) in ms — the
     physical latency floor for any synchronous per-request device path."""
@@ -268,6 +300,8 @@ def main() -> int:
     shm_res = run_level("grpc", url, "dense_tpu", "", 8, pa_arrays,
                         pa_outputs, "xla", 1 << 20, 4.0, warmup_s=3.0)
 
+    gen_metrics = _measure_generation(harness)
+
     rtt_floor_ms = _measure_rtt_floor()
     harness.stop()
 
@@ -299,6 +333,7 @@ def main() -> int:
         "concurrency": 8,
         "tpu_concurrency": 256,
     }
+    out.update(gen_metrics)
     out.update(_measure_flash_attention())
     if errors:
         out["errors"] = errors[:4]

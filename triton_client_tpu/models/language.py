@@ -61,6 +61,12 @@ _LONGCTX_PRESETS = {
     "base": (tr.TransformerConfig(
         vocab_size=256, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
         d_ff=4096, n_experts=0), 4096),
+    # same model, doubled context: the naive [S,S] f32 score matrix would be
+    # 256 MB per head-batch here — the flash kernel's tiling is what makes
+    # the shape servable at all
+    "xl": (tr.TransformerConfig(
+        vocab_size=256, d_model=1024, n_layers=8, n_heads=16, head_dim=64,
+        d_ff=4096, n_experts=0), 8192),
 }
 
 
