@@ -419,6 +419,20 @@ class DecodeModel:
             elif quant:  # unknown names fail loudly, not silently-fp
                 raise ValueError(
                     f"TRITON_TPU_QUANT={quant!r}: expected 'int8' or unset")
+            else:
+                # serving-grade storage: init_params returns f32 master
+                # weights (training-grade), but decode is weight-bandwidth-
+                # bound — storing the compute dtype (bf16) halves the bytes
+                # every step pulls from HBM.  Every kept leaf is already
+                # cast to cfg.dtype at compute time, so values are
+                # unchanged; 'head' stays f32 because _head's matmul runs
+                # in f32 (preserves first-token bit-identity with the
+                # llama_tpu window model — tests/test_decode.py).
+                params = {k: (v.astype(cfg.dtype)
+                              if k != "head"
+                              and getattr(v, "dtype", None) == jnp.float32
+                              else v)
+                          for k, v in params.items()}
             self._params = (params, cfg)
         return self._params
 
