@@ -1,0 +1,151 @@
+"""OpenAI-compatible frontend (/v1/*) over the generation stack."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.server import ModelRegistry  # noqa: E402
+from triton_client_tpu.server.testing import ServerHarness  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+def _post(url, path, body):
+    req = urllib.request.Request(
+        f"http://{url}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=120)
+
+
+class TestModels:
+    def test_lists_generate_capable_models(self, server):
+        with urllib.request.urlopen(
+                f"http://{server.http_url}/v1/models", timeout=30) as r:
+            out = json.loads(r.read())
+        ids = [m["id"] for m in out["data"]]
+        assert "llama_generate" in ids
+        assert "simple" not in ids  # not a generation model
+
+
+class TestCompletions:
+    def test_non_streaming_completion(self, server):
+        with _post(server.http_url, "/v1/completions", {
+            "model": "llama_generate", "prompt": "In a hole",
+            "max_tokens": 4,
+        }) as r:
+            out = json.loads(r.read())
+        assert out["object"] == "text_completion"
+        choice = out["choices"][0]
+        assert choice["finish_reason"] == "length"
+        assert len(choice["text"]) >= 4  # one char per token, maybe multibyte
+        assert out["usage"]["completion_tokens"] == 4
+
+    def test_chat_completion(self, server):
+        with _post(server.http_url, "/v1/chat/completions", {
+            "model": "llama_generate",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3,
+        }) as r:
+            out = json.loads(r.read())
+        assert out["object"] == "chat.completion"
+        msg = out["choices"][0]["message"]
+        assert msg["role"] == "assistant" and len(msg["content"]) >= 3
+
+    def test_chat_streaming(self, server):
+        with _post(server.http_url, "/v1/chat/completions", {
+            "model": "llama_generate",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "stream": True, "temperature": 1.0, "seed": 4,
+        }) as r:
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            frames = []
+            done = False
+            for line in r:
+                line = line.decode().strip()
+                if line == "data: [DONE]":
+                    done = True
+                    break
+                if line.startswith("data: "):
+                    frames.append(json.loads(line[len("data: "):]))
+        assert done
+        deltas = [f["choices"][0]["delta"].get("content") for f in frames]
+        assert sum(1 for d in deltas if d) == 3
+        assert frames[-1]["choices"][0]["finish_reason"] == "length"
+        assert frames[0]["object"] == "chat.completion.chunk"
+
+    def test_deterministic_with_seed(self, server):
+        def run():
+            with _post(server.http_url, "/v1/completions", {
+                "model": "llama_generate", "prompt": "x",
+                "max_tokens": 6, "temperature": 2.0, "seed": 11,
+            }) as r:
+                return json.loads(r.read())["choices"][0]["text"]
+        assert run() == run()
+
+    def test_errors_are_openai_shaped_400s(self, server):
+        for body in (
+            {"prompt": "x"},  # missing model
+            {"model": "nope", "prompt": "x"},
+            {"model": "simple", "prompt": "x"},  # not generate-capable
+            {"model": "llama_generate", "messages": "hi"},
+        ):
+            path = ("/v1/chat/completions" if "messages" in body
+                    else "/v1/completions")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.http_url, path, body)
+            assert e.value.code == 400, body
+
+
+class TestCompatEdges:
+    def test_openai_error_shape(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url, "/v1/completions",
+                  {"model": "nope", "prompt": "x"})
+        err = json.loads(e.value.read())["error"]
+        assert "message" in err and err["type"] == "invalid_request_error"
+
+    def test_bad_sampling_values_are_400(self, server):
+        for extra in ({"max_tokens": "abc"}, {"temperature": "hot"},
+                      {"seed": [1]}, {"top_k": {}}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.http_url, "/v1/completions",
+                      {"model": "llama_generate", "prompt": "x", **extra})
+            assert e.value.code == 400, extra
+
+    def test_unsupported_params_rejected_loudly(self, server):
+        for extra in ({"n": 2}, {"top_p": 0.5}, {"stop": ["\n"]},
+                      {"stream_options": {"include_usage": True}}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.http_url, "/v1/completions",
+                      {"model": "llama_generate", "prompt": "x", **extra})
+            assert e.value.code == 400, extra
+
+    def test_content_parts_array(self, server):
+        with _post(server.http_url, "/v1/chat/completions", {
+            "model": "llama_generate",
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "hel"},
+                {"type": "text", "text": "lo"}]}],
+            "max_tokens": 2,
+        }) as r:
+            out = json.loads(r.read())
+        assert len(out["choices"][0]["message"]["content"]) >= 2
+        # non-text parts are a clean 400, not repr-injected garbage
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url, "/v1/chat/completions", {
+                "model": "llama_generate",
+                "messages": [{"role": "user", "content": [
+                    {"type": "image_url", "image_url": {"url": "x"}}]}],
+            })
+        assert e.value.code == 400

@@ -73,6 +73,12 @@ def build_app(core: InferenceCore) -> web.Application:
         r.add_post(f"/v2/{kind}/unregister", _h(core, _shm_unregister))
         r.add_post(f"/v2/{kind}/region/{{name}}/unregister", _h(core, _shm_unregister))
 
+    # OpenAI-compatible surface over the generation stack (/v1/models,
+    # /v1/completions, /v1/chat/completions)
+    from .openai_api import add_openai_routes
+
+    add_openai_routes(app, core)
+
     # gRPC-Web bridge: the full v2 gRPC service over HTTP/1.1 framing (used
     # by the C++ gRPC client; interops with stock gRPC-Web stubs).
     from .grpc_server import InferenceServicer
@@ -238,14 +244,16 @@ async def _generate(core, request):
         content_type="application/json")
 
 
-async def _generate_stream(core, request):
-    from .generate import response_to_json
+async def sse_stream(request, agen, write_frame, on_error, epilogue=None):
+    """Shared SSE lifecycle for streaming endpoints (generate_stream, the
+    OpenAI frontend).
 
-    name, version, model, req = await _build_generate(core, request)
-    agen = core.infer_stream(req)
-    # pull the first response BEFORE committing the 200/SSE headers, so
-    # request/model errors surface as proper HTTP error statuses
-    # (__anext__ not the anext() builtin: requires-python floor is 3.9)
+    The first response is pulled BEFORE committing the 200/SSE headers so
+    request/model errors surface as proper HTTP statuses (__anext__, not the
+    anext() builtin: requires-python floor is 3.9).  ``write_frame(stream,
+    resp)`` serializes each response; ``on_error(e) -> bytes`` formats a
+    mid-stream InferError as an in-band frame; ``epilogue(stream)`` runs
+    after a clean drain (e.g. OpenAI's [DONE] terminator)."""
     try:
         first = await agen.__anext__()
     except StopAsyncIteration:
@@ -255,24 +263,38 @@ async def _generate_stream(core, request):
     stream.headers["Cache-Control"] = "no-cache"
     await stream.prepare(request)
     try:
-        if first is not None and first.outputs:
-            payload = response_to_json(name, version, first)
-            await stream.write(f"data: {payload}\n\n".encode())
+        if first is not None:
+            await write_frame(stream, first)
         async for resp in agen:
-            if not resp.outputs:
-                continue  # final-flagged empty frame ends decoupled streams
-            payload = response_to_json(name, version, resp)
-            await stream.write(f"data: {payload}\n\n".encode())
+            await write_frame(stream, resp)
+        if epilogue is not None:
+            await epilogue(stream)
     except InferError as e:
         # mid-stream failure: headers are committed, deliver in-band
-        err = json.dumps({"error": str(e)})
-        await stream.write(f"data: {err}\n\n".encode())
+        await stream.write(on_error(e))
     except (ConnectionError, OSError, asyncio.CancelledError):
         # client went away mid-stream — close quietly; re-raising would make
-        # _h answer a second response on a transport the StreamResponse owns
+        # the handler wrapper answer a second response on a transport the
+        # StreamResponse owns
         return stream
     await stream.write_eof()
     return stream
+
+
+async def _generate_stream(core, request):
+    from .generate import response_to_json
+
+    name, version, model, req = await _build_generate(core, request)
+
+    async def write_frame(stream, resp):
+        if not resp.outputs:
+            return  # final-flagged empty frame ends decoupled streams
+        payload = response_to_json(name, version, resp)
+        await stream.write(f"data: {payload}\n\n".encode())
+
+    return await sse_stream(
+        request, core.infer_stream(req), write_frame,
+        on_error=lambda e: f"data: {json.dumps({'error': str(e)})}\n\n".encode())
 
 
 async def _metrics(core, request):

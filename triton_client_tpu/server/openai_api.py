@@ -1,0 +1,234 @@
+"""OpenAI-compatible frontend over the generation stack.
+
+``POST /v1/completions``, ``POST /v1/chat/completions`` (streaming and
+non-streaming) and ``GET /v1/models`` adapt the OpenAI wire surface onto any
+model speaking this framework's generate contract (``text_input`` BYTES in,
+per-token decoupled responses out — ``llama_generate``).  This mirrors the
+Triton ecosystem's OpenAI frontend: users point stock OpenAI SDKs or plain
+curl at the serving harness with zero custom code:
+
+    curl localhost:8000/v1/chat/completions -d '{
+        "model": "llama_generate",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8, "stream": true}'
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from aiohttp import web
+
+from .core import InferenceCore
+from .types import InferError, InferRequest, InputTensor, RequestedOutput
+
+_COUNTER = iter(range(1, 1 << 62))
+
+
+def add_openai_routes(app: web.Application, core: InferenceCore) -> None:
+    r = app.router
+    r.add_get("/v1/models", _oai_h(core, _models))
+    r.add_post("/v1/completions", _oai_h(core, _completions))
+    r.add_post("/v1/chat/completions", _oai_h(core, _chat_completions))
+
+
+def _oai_h(core: InferenceCore, fn):
+    """Handler wrapper emitting OpenAI-shaped errors
+    ({"error": {"message", "type"}}), unlike the v2 endpoints' flat shape."""
+    async def handler(request: web.Request) -> web.Response:
+        try:
+            return await fn(core, request)
+        except InferError as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status=e.http_status)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # pragma: no cover - defensive
+            return web.json_response(
+                {"error": {"message": str(e), "type": "internal_error"}},
+                status=500)
+
+    return handler
+
+
+def _generate_capable(model) -> bool:
+    inputs = {i.name for i in model.config.input}
+    return model.decoupled and "text_input" in inputs
+
+
+async def _models(core, request):
+    data = [
+        {"id": m.name, "object": "model", "owned_by": "triton_client_tpu"}
+        for m in core.registry.ready_models() if _generate_capable(m)
+    ]
+    return web.json_response({"object": "list", "data": data})
+
+
+def _content_text(content) -> str:
+    """A message's text: plain string or the OpenAI content-parts array
+    (text parts concatenated); anything else is a client error."""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        parts = []
+        for p in content:
+            if not isinstance(p, dict) or p.get("type") != "text" \
+                    or not isinstance(p.get("text"), str):
+                raise InferError(
+                    "only text content parts are supported")
+            parts.append(p["text"])
+        return "".join(parts)
+    raise InferError(
+        "message 'content' must be a string or an array of text parts")
+
+
+def _prompt_from_messages(messages: List[Dict[str, Any]]) -> str:
+    """Minimal chat template: 'role: content' lines + assistant cue (the
+    byte-level zoo models have no chat template of their own)."""
+    if not isinstance(messages, list) or not messages:
+        raise InferError("'messages' must be a non-empty array")
+    lines = []
+    for m in messages:
+        if not isinstance(m, dict) or "content" not in m:
+            raise InferError("each message needs 'role' and 'content'")
+        lines.append(f"{m.get('role', 'user')}: {_content_text(m['content'])}")
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
+    model_name = body.get("model")
+    if not model_name:
+        raise InferError("'model' is required")
+    model = core.registry.get(model_name)
+    if not _generate_capable(model):
+        raise InferError(
+            f"model '{model_name}' does not speak the generate contract "
+            "(decoupled, text_input)")
+    # honored params are cast under a 400 guard; recognized-but-unsupported
+    # params are rejected loudly — silently ignoring n/top_p/stop would
+    # return 200s that look honored but are not
+    if body.get("n") not in (None, 1):
+        raise InferError("'n' > 1 is not supported")
+    if body.get("top_p") not in (None, 1, 1.0):
+        raise InferError("'top_p' is not supported; use 'top_k'")
+    if body.get("stop"):
+        raise InferError("'stop' sequences are not supported")
+    if body.get("stream_options"):
+        raise InferError("'stream_options' is not supported")
+    parameters: Dict[str, Any] = {}
+    try:
+        if body.get("max_tokens") is not None:
+            parameters["max_tokens"] = int(body["max_tokens"])
+        if body.get("temperature") is not None:
+            parameters["temperature"] = float(body["temperature"])
+        if body.get("seed") is not None:
+            parameters["seed"] = int(body["seed"])
+        if body.get("top_k") is not None:  # extension; OpenAI has top_p
+            parameters["top_k"] = int(body["top_k"])
+    except (TypeError, ValueError) as e:
+        raise InferError(f"invalid sampling parameter: {e}")
+    req = InferRequest(
+        model_name=model_name,
+        inputs=[InputTensor(
+            name="text_input", datatype="BYTES", shape=(1,),
+            data=np.asarray([prompt.encode()], dtype=object))],
+        outputs=[RequestedOutput(name="text_output", binary_data=False)],
+        parameters=parameters,
+    )
+    return model_name, req
+
+
+def _chunk(rid: str, created: int, model: str, kind: str,
+           delta_or_text: Optional[str], finish: Optional[str],
+           chat: bool) -> dict:
+    if chat:
+        entry: Dict[str, Any] = {"index": 0, "finish_reason": finish}
+        entry["delta" if kind == "chunk" else "message"] = (
+            {} if delta_or_text is None
+            else ({"content": delta_or_text} if kind == "chunk"
+                  else {"role": "assistant", "content": delta_or_text}))
+        obj = ("chat.completion.chunk" if kind == "chunk"
+               else "chat.completion")
+    else:
+        entry = {"index": 0, "text": delta_or_text or "",
+                 "finish_reason": finish}
+        obj = "text_completion"
+    return {"id": rid, "object": obj, "created": created, "model": model,
+            "choices": [entry]}
+
+
+async def _run(core, request, chat: bool):
+    from .http_server import _read_json
+
+    body = await _read_json(request)
+    if chat:
+        prompt = _prompt_from_messages(body.get("messages"))
+    else:
+        prompt = body.get("prompt", "")
+        if not isinstance(prompt, str):
+            raise InferError("'prompt' must be a string")
+    model_name, req = _build_request(core, body, prompt)
+    rid = f"cmpl-{next(_COUNTER)}"
+    created = int(time.time())
+
+    if not body.get("stream", False):
+        pieces: List[str] = []
+        async for resp in core.infer_stream(req):
+            for t in resp.outputs:
+                if t.name == "text_output" and t.data is not None:
+                    pieces.extend(
+                        v.decode("utf-8", "replace") if isinstance(v, bytes)
+                        else str(v) for v in t.data.reshape(-1))
+        text = "".join(pieces)
+        out = _chunk(rid, created, model_name, "full", text, "length", chat)
+        out["usage"] = {
+            "prompt_tokens": len(prompt.encode()),
+            "completion_tokens": len(pieces),
+            "total_tokens": len(prompt.encode()) + len(pieces),
+        }
+        return web.json_response(out)
+
+    # streaming: one SSE chunk per token, then [DONE] (OpenAI framing),
+    # over the shared SSE lifecycle (same first-frame-before-headers and
+    # disconnect semantics as /generate_stream)
+    from .http_server import sse_stream
+
+    async def write_frame(stream, resp):
+        for t in resp.outputs:
+            if t.name != "text_output" or t.data is None:
+                continue
+            for v in t.data.reshape(-1):
+                delta = (v.decode("utf-8", "replace")
+                         if isinstance(v, bytes) else str(v))
+                frame = _chunk(rid, created, model_name, "chunk", delta,
+                               None, chat)
+                await stream.write(
+                    f"data: {json.dumps(frame)}\n\n".encode())
+
+    async def epilogue(stream):
+        final = _chunk(rid, created, model_name, "chunk", None, "length",
+                       chat)
+        await stream.write(f"data: {json.dumps(final)}\n\n".encode())
+        await stream.write(b"data: [DONE]\n\n")
+
+    def on_error(e):
+        err = json.dumps({"error": {"message": str(e),
+                                    "type": "invalid_request_error"}})
+        return f"data: {err}\n\n".encode()
+
+    return await sse_stream(request, core.infer_stream(req), write_frame,
+                            on_error, epilogue=epilogue)
+
+
+async def _completions(core, request):
+    return await _run(core, request, chat=False)
+
+
+async def _chat_completions(core, request):
+    return await _run(core, request, chat=True)
