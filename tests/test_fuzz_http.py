@@ -220,3 +220,70 @@ class TestHardenedEdges:
                 json.dumps(body).encode())
             assert status == 400, (body, status)
         assert _alive(server)
+
+
+class TestGrpcMalformed:
+    """Raw-pb malformed gRPC requests must be INVALID_ARGUMENT, not UNKNOWN
+    (mirror of the HTTP 400-not-500 invariant)."""
+
+    def _stub(self, server):
+        import grpc as grpc_mod
+
+        from triton_client_tpu.protocol import GRPCInferenceServiceStub
+
+        channel = grpc_mod.insecure_channel(server.grpc_url)
+        return grpc_mod, channel, GRPCInferenceServiceStub(channel)
+
+    def test_shape_data_mismatch(self, server):
+        from triton_client_tpu.protocol import inference_pb2 as pb
+
+        grpc_mod, channel, stub = self._stub(server)
+        try:
+            req = pb.ModelInferRequest(model_name="simple")
+            for name in ("INPUT0", "INPUT1"):
+                t = req.inputs.add(name=name, datatype="INT32")
+                t.shape.extend([2, -2])
+                req.raw_input_contents.append(b"\x01\x00\x00\x00")
+            with pytest.raises(grpc_mod.RpcError) as e:
+                stub.ModelInfer(req, timeout=30)
+            assert e.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT, \
+                e.value.details()
+        finally:
+            channel.close()
+        assert _alive(server)
+
+    def test_bad_shm_params(self, server):
+        from triton_client_tpu.protocol import inference_pb2 as pb
+
+        grpc_mod, channel, stub = self._stub(server)
+        try:
+            req = pb.ModelInferRequest(model_name="simple")
+            t = req.inputs.add(name="INPUT0", datatype="INT32")
+            t.shape.extend([1, 16])
+            t.parameters["shared_memory_region"].string_param = "r"
+            # shared_memory_byte_size missing entirely
+            with pytest.raises(grpc_mod.RpcError) as e:
+                stub.ModelInfer(req, timeout=30)
+            assert e.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT, \
+                e.value.details()
+        finally:
+            channel.close()
+        assert _alive(server)
+
+    def test_wrong_raw_byte_count(self, server):
+        from triton_client_tpu.protocol import inference_pb2 as pb
+
+        grpc_mod, channel, stub = self._stub(server)
+        try:
+            req = pb.ModelInferRequest(model_name="simple")
+            for name in ("INPUT0", "INPUT1"):
+                t = req.inputs.add(name=name, datatype="INT32")
+                t.shape.extend([1, 16])
+                req.raw_input_contents.append(b"\x00" * 7)  # not 64
+            with pytest.raises(grpc_mod.RpcError) as e:
+                stub.ModelInfer(req, timeout=30)
+            assert e.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT, \
+                e.value.details()
+        finally:
+            channel.close()
+        assert _alive(server)
