@@ -229,6 +229,22 @@ class TestSampling:
                   {"text_input": "x", "temperature": "hot"})
         assert e.value.code == 400
 
+    def test_huge_max_tokens_clamped_to_cache_capacity(self, server):
+        # the decode cache is statically sized; max_tokens beyond
+        # s_max - prompt_len must clamp, not loop unbounded
+        toks = self._stream(
+            server, {"text_input": "x", "max_tokens": 10**9})
+        assert 1 <= len(toks) <= 4096
+
+    def test_non_numeric_max_tokens_is_400(self, server):
+        # advisor finding r2: max_tokens parsed outside the InferError guard
+        # surfaced as a 500
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url,
+                  "/v2/models/llama_generate/generate_stream",
+                  {"text_input": "x", "max_tokens": "abc"})
+        assert e.value.code == 400
+
     def test_negative_temperature_is_400(self, server):
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(server.http_url,

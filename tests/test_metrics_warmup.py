@@ -102,6 +102,15 @@ class TestMetricsEndpoint:
         assert "nv_inference_queue_duration_us" in body
         assert "nv_inference_compute_infer_duration_us" in body
 
+    def test_label_values_escaped(self):
+        # advisor finding r2: model names are user-controlled directory
+        # names; quotes/backslashes/newlines must be escaped per the
+        # Prometheus text format
+        from triton_client_tpu.server.metrics import _escape_label
+
+        assert _escape_label('we"ird\\name\n') == 'we\\"ird\\\\name\\n'
+        assert _escape_label("plain") == "plain"
+
     def test_dedicated_metrics_port(self, server):
         body = self._scrape(f"{server.host}:{server.metrics_port}")
         assert "nv_inference_count" in body

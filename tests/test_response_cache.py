@@ -88,6 +88,18 @@ class TestResponseCache:
             _infer(client, "uncached", x)
         assert len(harness.ucalls) == 2
 
+    def test_cached_entries_are_immutable(self):
+        # advisor finding r2: entries were stored by reference; in-place
+        # mutation would silently corrupt later cache hits — must raise
+        from triton_client_tpu.server.core import _ResponseCache
+
+        cache = _ResponseCache()
+        arr = np.ones((2, 2), np.float32)
+        cache.put(("m", 0, "", "k"), {"Y": arr})
+        hit = cache.get(("m", 0, "", "k"))
+        with pytest.raises(ValueError):
+            hit["Y"][0, 0] = 99.0
+
     def test_reload_invalidates(self, harness):
         with httpclient.InferenceServerClient(harness.http_url) as client:
             x = np.ones((1, 4), np.float32)

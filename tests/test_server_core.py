@@ -317,7 +317,7 @@ class TestInlineFastPath:
         prof = _InlineProfile()
         sig = (("INPUT0", (1, 16), "int32"),)
         prof.observe(sig, 1.5)  # first execution: may include XLA compile
-        assert prof.ema is None and not prof.allows(sig)
+        assert not prof.ema and not prof.allows(sig)
         prof.observe(sig, 0.0002)
         assert prof.allows(sig)
 
@@ -341,12 +341,33 @@ class TestInlineFastPath:
         prof.observe(("a",), 0.0001)
         assert prof.allows(("a",)) and not prof.allows(("b",))
 
-    def test_live_path_warms_to_inline(self):
+    def test_per_signature_gating(self):
+        # advisor scenario: a fast signature's EMA must not admit a new,
+        # possibly slower signature inline
+        from triton_client_tpu.server.core import _InlineProfile
+
+        prof = _InlineProfile()
+        fast = (("INPUT0", (1, 16), "int32"),)
+        big = (("INPUT0", (512, 4096), "float32"),)
+        prof.observe(fast, 0.0001)
+        prof.observe(fast, 0.0001)
+        assert prof.allows(fast) and not prof.allows(big)
+        prof.observe(big, 0.5)   # first sample (compile) excluded
+        prof.observe(big, 0.02)  # genuinely slow signature
+        assert not prof.allows(big) and prof.allows(fast)
+
+    def test_live_path_warms_to_inline(self, monkeypatch):
         import triton_client_tpu.http as httpclient
+        from triton_client_tpu.server.core import _InlineProfile
         from triton_client_tpu.server.testing import ServerHarness
         from triton_client_tpu.server import ModelRegistry
         from triton_client_tpu.models import zoo as z
 
+        # the mechanism (warm-after-repeat, off-loop first exec) is what this
+        # test proves; the 1 ms budget itself is unit-tested above.  Under
+        # full-suite CPU load a sub-ms model can exceed 1 ms wall time, so
+        # widen the budget to keep the live assertion deterministic.
+        monkeypatch.setattr(_InlineProfile, "MAX_INLINE_S", 0.5)
         registry = ModelRegistry()
         z.register_all(registry)
         with ServerHarness(registry) as h:
@@ -360,7 +381,7 @@ class TestInlineFastPath:
                     res = client.infer("simple", [i0, i1])
                 np.testing.assert_array_equal(res.as_numpy("OUTPUT0"), a + a)
             prof = h.core._inline_profiles.get("simple")
-            assert prof is not None and prof.ema is not None
+            assert prof is not None and prof.ema
             # host-placed sub-ms model must have earned the inline path
             assert prof.allows(tuple(sorted(
                 ("INPUT%d" % i, (1, 16), "int32") for i in range(2))))
@@ -399,7 +420,7 @@ class TestReloadInvalidation:
                 for _ in range(3):
                     client.infer("simple", [i0, i1])
                 warm = h.core._inline_profiles["simple"]
-                assert warm.ema is not None
+                assert warm.ema
                 client.unload_model("simple")
                 client.load_model("simple")
                 res = client.infer("simple", [i0, i1])

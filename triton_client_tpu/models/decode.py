@@ -898,17 +898,17 @@ class GenerateModel:
         prompt = raw[0] if len(raw) else b""
         if isinstance(prompt, str):
             prompt = prompt.encode()
-        n_tokens = int(parameters.get("max_tokens", self._default_tokens))
-        n_tokens = max(1, min(n_tokens, dec._s_max - dec._prompt_len))
         from ..server.types import InferError
 
         try:
+            n_tokens = int(parameters.get("max_tokens", self._default_tokens))
             temperature = float(parameters.get("temperature", 0.0))
             top_k = int(parameters.get("top_k", 0))
             seed = parameters.get("seed")
             seed = None if seed is None else int(seed)
         except (TypeError, ValueError) as e:
             raise InferError(f"invalid sampling parameter: {e}")
+        n_tokens = max(1, min(n_tokens, dec._s_max - dec._prompt_len))
         if not (temperature >= 0 and math.isfinite(temperature)):
             raise InferError(
                 f"temperature must be finite and >= 0, got {temperature}")

@@ -56,7 +56,7 @@ class _InlineProfile:
 
     def __init__(self, generation: int = 0) -> None:
         self.seen: set = set()
-        self.ema: Optional[float] = None
+        self.ema: Dict[tuple, float] = {}
         self.generation = generation
 
     def observe(self, sig: tuple, dt: float) -> None:
@@ -65,12 +65,15 @@ class _InlineProfile:
             # record the signature but keep the sample out of the EMA
             self.seen.add(sig)
             return
-        self.ema = dt if self.ema is None else (
-            self.ALPHA * dt + (1 - self.ALPHA) * self.ema)
+        prev = self.ema.get(sig)
+        self.ema[sig] = dt if prev is None else (
+            self.ALPHA * dt + (1 - self.ALPHA) * prev)
 
     def allows(self, sig: tuple) -> bool:
-        return (sig in self.seen and self.ema is not None
-                and self.ema < self.MAX_INLINE_S)
+        # per-signature gating: a new (larger/slower) signature must earn its
+        # own off-loop EMA before it may run inline
+        ema = self.ema.get(sig)
+        return ema is not None and ema < self.MAX_INLINE_S
 
 
 class _ResponseCache:
@@ -145,7 +148,15 @@ class _ResponseCache:
             total += self._nbytes(v)
         if total > self.MAX_ITEM_BYTES:
             return
-        self._entries[key] = outputs
+        # freeze private copies: the cache must not mutate the caller's live
+        # arrays (a model may retain/reuse its output buffer), and mutation
+        # of a cached entry must raise rather than corrupt later hits
+        frozen = {}
+        for n, v in outputs.items():
+            v = v.copy()
+            v.flags.writeable = False
+            frozen[n] = v
+        self._entries[key] = frozen
         self._entries.move_to_end(key)
         while len(self._entries) > self.MAX_ENTRIES:
             self._entries.popitem(last=False)

@@ -33,6 +33,14 @@ _METRICS: List[Tuple[str, str, str]] = [
 ]
 
 
+def _escape_label(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format
+    (backslash, double-quote, and newline must be escaped; model names come
+    from user-controlled repository directory names)."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def render_prometheus(core: InferenceCore) -> str:
     """All per-model counters in the Prometheus text exposition format."""
     rows = {key: [] for _, _, key in _METRICS}
@@ -48,7 +56,7 @@ def render_prometheus(core: InferenceCore) -> str:
                 "queue_us": s.queue_ns // 1000,
                 "infer_us": s.infer_ns // 1000,
             }
-        labels = f'model="{m.name}",version="1"'
+        labels = f'model="{_escape_label(m.name)}",version="1"'
         for key, value in values.items():
             rows[key].append(f"{{{labels}}} {value}")
 
