@@ -13,8 +13,12 @@ whole accumulated sequence (proven by ``tests/test_decode.py``); the
 window-recompute path instead re-bases positions every step. The first
 generated token is bit-identical between the two.
 
-Single-device math (the serving placement): no mesh collectives — the
-sharded training/forward path stays in ``transformer.py``.
+Sharded serving: the decode math is written single-device and partitioned
+by **GSPMD** — params and the KV cache are committed to ``NamedSharding``s
+over the serve mesh (``TRITON_TPU_SERVE_MESH``: tensor parallel over heads,
+data parallel over slots) and XLA inserts the collectives under ``jit``.
+No hand-rolled psums here; the explicitly-collective training/forward path
+stays in ``transformer.py``.
 """
 
 from __future__ import annotations
@@ -44,7 +48,11 @@ def quantize_layer_weights(params, cfg: tr.TransformerConfig):
     # wq/wk/wv [L, D, H, K] the outputs are (head, k) pairs, so only the
     # d_model axis reduces
     contract_axes = {"wq": (1,), "wk": (1,), "wv": (1,),
-                     "wo": (1, 2), "w1": (1,), "w2": (1,)}
+                     "wo": (1, 2), "w1": (1,), "w2": (1,),
+                     # MoE experts: [L, E, D, F] / [L, E, F, D] contract the
+                     # middle dim per expert; the router stays fp (it picks
+                     # experts — quantization noise there changes routing)
+                     "we1": (2,), "we2": (2,)}
     out = dict(params)
     for k, axes in contract_axes.items():
         if k not in params:
@@ -55,6 +63,71 @@ def quantize_layer_weights(params, cfg: tr.TransformerConfig):
         out[k] = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
         out[k + "_scale"] = scale.astype(jnp.float32)
     return out
+
+
+def decode_mesh(cfg: tr.TransformerConfig, n_slots: int = 1):
+    """Serve mesh for the decode stack, from ``TRITON_TPU_SERVE_MESH``.
+
+    Decode shards over **tp** (attention heads / FFN hidden) and **dp**
+    (cache slots, batched mode); the pipeline/expert/sequence axes don't
+    apply to a single-token step, so greedy specs ("all", an integer) put
+    their devices on tp then dp, and explicit shape specs must keep
+    pp=ep=sp=1.  Returns a full 5-axis mesh (trivial extra axes) so
+    ``tr.param_specs`` placements apply unchanged."""
+    import os
+
+    from .. import parallel
+
+    spec = os.environ.get("TRITON_TPU_SERVE_MESH", "1").strip().lower()
+    devices = jax.devices()
+    explicit = tr.parse_serve_shape(spec)
+    if explicit is not None:
+        bad = [a for a in ("pp", "ep", "sp") if explicit[a] > 1]
+        if bad:
+            raise ValueError(
+                f"TRITON_TPU_SERVE_MESH={spec!r}: decode serving shards "
+                f"over tp/dp only; {','.join(bad)} must be 1")
+        # config-time divisibility so a bad spec is a readable error, not
+        # a jax.device_put crash at the first request
+        if explicit["tp"] > 1 and cfg.n_heads % explicit["tp"] != 0:
+            raise ValueError(
+                f"TRITON_TPU_SERVE_MESH={spec!r}: tp={explicit['tp']} "
+                f"must divide n_heads={cfg.n_heads}")
+        if explicit["dp"] > 1 and n_slots % explicit["dp"] != 0:
+            raise ValueError(
+                f"TRITON_TPU_SERVE_MESH={spec!r}: dp={explicit['dp']} "
+                f"must divide the {n_slots} decode slots "
+                "(TRITON_TPU_DECODE_SLOTS)")
+        n = math.prod(explicit.values())
+        if n > len(devices):
+            raise ValueError(
+                f"TRITON_TPU_SERVE_MESH={spec!r} needs {n} devices, "
+                f"have {len(devices)}")
+        return parallel.build_mesh(explicit, tr.MESH_AXES, devices[:n])
+    n = tr.resolve_serve_count(spec, len(devices))
+    # largest power-of-two head split, then slots onto dp
+    tp = 1
+    while tp * 2 <= n and cfg.n_heads % (tp * 2) == 0:
+        tp *= 2
+    dp = 1
+    while dp * 2 <= n // tp and n_slots % (dp * 2) == 0:
+        dp *= 2
+    shape = {a: 1 for a in tr.MESH_AXES}
+    shape["tp"], shape["dp"] = tp, dp
+    return parallel.build_mesh(shape, tr.MESH_AXES, devices[:tp * dp])
+
+
+def place_decode_params(params, mesh, cfg: tr.TransformerConfig):
+    """Commit decode weights to the serve mesh: standard leaves follow
+    ``tr.param_specs`` (tp over heads / FFN hidden; pp trivially 1 here),
+    int8 ``*_scale`` siblings replicate (tiny, and their singleton reduced
+    dims can't shard)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    specs = tr.param_specs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, P())))
+            for k, v in params.items()}
 
 
 def _layer_blocks(params, cfg: tr.TransformerConfig):
@@ -86,12 +159,47 @@ def _project_qkv(blk, x, cfg: tr.TransformerConfig):
     return q, k, v
 
 
-def _dense_ffn(blk, x, cfg: tr.TransformerConfig):
-    # _ffn_apply minus the tp psum (single shard) and MoE branch
+def _ffn(blk, x, cfg: tr.TransformerConfig):
+    """FFN for the decode stack: ``tr._ffn_apply``'s math minus the mesh
+    psums (single shard; GSPMD re-inserts collectives when the serve mesh
+    shards the hidden/expert dims). Dense SiLU or routed MoE top-k."""
     h = tr._rmsnorm(x, blk["ln2"], cfg.norm_eps)
-    he = jnp.einsum("bsd,df->bsf", h, _w(blk, "w1", h.dtype))
-    he = jax.nn.silu(he)
-    out = jnp.einsum("bsf,fd->bsd", he, _w(blk, "w2", h.dtype))
+    if cfg.moe:
+        gate = jnp.einsum("bsd,de->bse", h.astype(jnp.float32),
+                          _w(blk, "router", jnp.float32))
+        top, _ = lax.top_k(gate, cfg.moe_top_k)
+        thresh = top[..., -1:]
+        probs = jax.nn.softmax(
+            jnp.where(gate >= thresh, gate, -1e30), axis=-1)
+        if h.shape[0] == 1 and h.shape[1] == 1:
+            # single-token decode step: gather the ROUTED experts before
+            # dequant/compute, so HBM weight reads scale with top_k, not
+            # n_experts (decode is weight-bandwidth-bound; the dense path
+            # below would pull every expert's stack each step)
+            _, idx = lax.top_k(gate[0, 0], cfg.moe_top_k)      # [k]
+
+            def take_w(name):
+                w = jnp.take(blk[name], idx, axis=0)
+                s = blk.get(name + "_scale")
+                if s is not None:
+                    return (w.astype(h.dtype)
+                            * jnp.take(s, idx, axis=0).astype(h.dtype))
+                return w.astype(h.dtype)
+
+            he = jnp.einsum("bsd,edf->ebsf", h, take_w("we1"))
+            he = jax.nn.silu(he)
+            oe = jnp.einsum("ebsf,efd->ebsd", he, take_w("we2"))
+            p_sel = jnp.take(probs[0, 0], idx)[None, None, :]   # [1,1,k]
+            out = jnp.einsum("ebsd,bse->bsd", oe, p_sel.astype(oe.dtype))
+        else:
+            he = jnp.einsum("bsd,edf->ebsf", h, _w(blk, "we1", h.dtype))
+            he = jax.nn.silu(he)
+            oe = jnp.einsum("ebsf,efd->ebsd", he, _w(blk, "we2", h.dtype))
+            out = jnp.einsum("ebsd,bse->bsd", oe, probs.astype(oe.dtype))
+    else:
+        he = jnp.einsum("bsd,df->bsf", h, _w(blk, "w1", h.dtype))
+        he = jax.nn.silu(he)
+        out = jnp.einsum("bsf,fd->bsd", he, _w(blk, "w2", h.dtype))
     return x + out
 
 
@@ -114,7 +222,7 @@ def _prefill_layer(blk, x, cfg: tr.TransformerConfig):
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqs,bhsk->bhqk", p, v.astype(jnp.float32)).astype(x.dtype)
     x = _attn_out(blk, x, o)
-    return _dense_ffn(blk, x, cfg), k, v
+    return _ffn(blk, x, cfg), k, v
 
 
 def _decode_layer(blk, x, kc, vc, pos, cfg: tr.TransformerConfig):
@@ -134,7 +242,7 @@ def _decode_layer(blk, x, kc, vc, pos, cfg: tr.TransformerConfig):
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqs,bhsk->bhqk", p, vc.astype(jnp.float32)).astype(x.dtype)
     x = _attn_out(blk, x, o)
-    return _dense_ffn(blk, x, cfg), kc, vc
+    return _ffn(blk, x, cfg), kc, vc
 
 
 def _head(params, x, cfg: tr.TransformerConfig):
@@ -145,8 +253,6 @@ def _head(params, x, cfg: tr.TransformerConfig):
 
 def make_prefill(cfg: tr.TransformerConfig, s_max: int):
     """jitted (params, tokens [B,S]) -> (last-position logits [B,V], cache)."""
-    if cfg.moe:
-        raise NotImplementedError("decode cache supports dense FFN presets")
 
     @jax.jit
     def prefill(params, tokens):
@@ -172,8 +278,6 @@ def make_prefill(cfg: tr.TransformerConfig, s_max: int):
 
 def make_decode_step(cfg: tr.TransformerConfig):
     """jitted (params, cache, tokens [B,1]) -> (logits [B,V], cache')."""
-    if cfg.moe:
-        raise NotImplementedError("decode cache supports dense FFN presets")
 
     @jax.jit
     def step(params, cache, tokens):
@@ -238,7 +342,7 @@ def _slot_decode_layer(blk, x, kc, vc, pos, cfg: tr.TransformerConfig):
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqs,bhsk->bhqk", p, vc.astype(jnp.float32)).astype(x.dtype)
     x = _attn_out(blk, x, o)
-    return _dense_ffn(blk, x, cfg), kc, vc
+    return _ffn(blk, x, cfg), kc, vc
 
 
 def make_slot_step(cfg: tr.TransformerConfig):
@@ -248,8 +352,6 @@ def make_slot_step(cfg: tr.TransformerConfig):
     Every slot advances one position — callers ignore outputs and do not
     advance the host-side pos for slots with no pending request (their
     stale-position cache write is overwritten by the next real token)."""
-    if cfg.moe:
-        raise NotImplementedError("decode cache supports dense FFN presets")
 
     @jax.jit
     def step(params, k, v, tokens, pos):
@@ -274,8 +376,6 @@ def make_slot_step(cfg: tr.TransformerConfig):
 def make_slot_prefill(cfg: tr.TransformerConfig, s_max: int):
     """jitted (params, k, v, tokens [1,S], slot) -> (next tok, best logit,
     k', v') — prefills ONE slot of the shared cache in a single forward."""
-    if cfg.moe:
-        raise NotImplementedError("decode cache supports dense FFN presets")
 
     @jax.jit
     def prefill(params, k, v, tokens, slot):
@@ -387,6 +487,7 @@ class DecodeModel:
         self._fns = None
         self._fns_ind = None
         self._params = None
+        self._mesh = None
         self._jobs = None
         self._worker = None
         self._closed = False
@@ -433,6 +534,12 @@ class DecodeModel:
                               and getattr(v, "dtype", None) == jnp.float32
                               else v)
                           for k, v in params.items()}
+            # commit to the serve mesh: GSPMD partitions the jitted
+            # prefill/step from these shardings (tp over heads; one-device
+            # mesh when TRITON_TPU_SERVE_MESH is unset)
+            mesh = decode_mesh(cfg, n_slots=self._n_slots)
+            params = place_decode_params(params, mesh, cfg)
+            self._mesh = mesh
             self._params = (params, cfg)
         return self._params
 
@@ -449,8 +556,18 @@ class DecodeModel:
                     params, cfg = self._ensure_params()
                     shape = (cfg.n_layers, self._n_slots, cfg.n_heads,
                              self._s_max, cfg.head_dim)
-                    self._k = jnp.zeros(shape, cfg.dtype)
-                    self._v = jnp.zeros(shape, cfg.dtype)
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+
+                    # slot cache on the serve mesh: slots over dp, heads
+                    # over tp (mirrors the K/V the tp-sharded wk/wv produce
+                    # so the cache write needs no resharding)
+                    cache_sharding = NamedSharding(
+                        self._mesh, P(None, "dp", "tp", None, None))
+                    self._k = jax.device_put(
+                        jnp.zeros(shape, cfg.dtype), cache_sharding)
+                    self._v = jax.device_put(
+                        jnp.zeros(shape, cfg.dtype), cache_sharding)
                     self._pos = np.zeros(self._n_slots, np.int32)
                     self._jobs = _queue.Queue()
                     import concurrent.futures as _cf

@@ -41,6 +41,11 @@ _LLAMA_PRESETS = {
     "tiny": tr.TransformerConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, head_dim=16,
         d_ff=128, n_experts=0),
+    # MoE variant: the decode/generate stacks serve mixture-of-experts
+    # weights through the same KV cache (routed FFN in every step)
+    "tiny-moe": tr.TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, head_dim=16,
+        d_ff=128, n_experts=4, moe_top_k=2),
     "1b": tr.TransformerConfig(
         vocab_size=128256, d_model=2048, n_layers=16, n_heads=16,
         head_dim=128, d_ff=8192, n_experts=0),
@@ -129,24 +134,50 @@ def forward_flops_per_token(cfg: tr.TransformerConfig, seq_len: int) -> float:
 
 
 class _LazyTransformer:
-    """Shared lazy init: mesh + params + jitted forward on first call."""
+    """Shared lazy init: mesh + params + jitted forward on first call.
+
+    The mesh comes from ``TRITON_TPU_SERVE_MESH`` (tr.serve_mesh) — serving
+    runs pjit-sharded over however many devices the deployment names, not
+    pinned to one chip.  Batches are padded up to a multiple of the mesh's
+    ``dp`` extent (the shard_map in_spec shards batch over dp) and sliced
+    back after the forward; the dynamic batcher's preferred sizes keep the
+    padded-shape set bounded so XLA compiles a handful of shapes."""
 
     def __init__(self, cfg: tr.TransformerConfig, seed: int):
         self.cfg = cfg
         self._seed = seed
         self._fwd = None
         self._params = None
+        self._mesh = None
+        self._dp = 1
 
-    def __call__(self, tokens):
+    @property
+    def mesh(self):
+        self._ensure()
+        return self._mesh
+
+    def _ensure(self):
         import jax
 
         if self._fwd is None:
-            device = jax.devices()[0]
-            mesh = tr.make_mesh(devices=[device], cfg=self.cfg)
+            self._mesh = tr.serve_mesh(self.cfg)
             params = tr.init_params(jax.random.PRNGKey(self._seed), self.cfg)
-            self._params = tr.place_params(params, mesh, self.cfg)
-            self._fwd = tr.make_forward(mesh, self.cfg)
-        return self._fwd(self._params, tokens)
+            self._params = tr.place_params(params, self._mesh, self.cfg)
+            self._fwd = tr.make_forward(self._mesh, self.cfg)
+            self._dp = int(self._mesh.shape["dp"])
+
+    def __call__(self, tokens):
+        import jax.numpy as jnp
+
+        self._ensure()
+        b = tokens.shape[0]
+        pad = -b % self._dp
+        if pad:
+            tokens = jnp.concatenate(
+                [tokens, jnp.zeros((pad,) + tokens.shape[1:],
+                                   tokens.dtype)], axis=0)
+        out = self._fwd(self._params, tokens)
+        return out[:b] if pad else out
 
 
 def make_bert_large() -> JaxModel:

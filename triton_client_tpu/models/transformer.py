@@ -106,6 +106,95 @@ def make_mesh(n_devices: Optional[int] = None,
         mesh_shape_for(len(devices), cfg), MESH_AXES, devices)
 
 
+def serve_mesh(cfg: TransformerConfig, spec: Optional[str] = None) -> Mesh:
+    """The mesh SERVED models place params/forward over, from
+    ``TRITON_TPU_SERVE_MESH`` (or an explicit ``spec``).
+
+    This is the server-side analog of the reference's per-model
+    ``instance_group`` placement (its client has no device placement; the
+    Triton server it targets does — SURVEY.md §2.4 "server side uses
+    pjit-sharded model").  Accepted values:
+
+    - ``"1"`` / unset — one device (``jax.devices()[0]``), the single-chip
+      bench-host default.
+    - ``"all"`` — every visible device, greedy 5-axis factorization
+      (``mesh_shape_for``).
+    - an integer ``N`` — the first N devices, greedy factorization.
+    - an explicit shape ``"dp=1,pp=2,ep=2,sp=1,tp=2"`` — exact axis sizes
+      (unlisted axes default to 1); lets deployments pin e.g. expert
+      parallelism where the greedy split would not pick it.
+    """
+    if spec is None:
+        spec = os.environ.get("TRITON_TPU_SERVE_MESH", "1")
+    spec = spec.strip().lower()
+    devices = jax.devices()
+    shape = parse_serve_shape(spec)
+    if shape is not None:
+        _check_axis_divisibility(shape, cfg, spec)
+        n = math.prod(shape.values())
+        if n > len(devices):
+            raise ValueError(
+                f"TRITON_TPU_SERVE_MESH={spec!r} needs {n} devices, "
+                f"have {len(devices)}")
+        return parallel.build_mesh(shape, MESH_AXES, devices[:n])
+    return make_mesh(resolve_serve_count(spec, len(devices)), cfg)
+
+
+def parse_serve_shape(spec: str) -> Optional[Dict[str, int]]:
+    """Parse an explicit ``"dp=1,tp=2"`` mesh-shape spec into a full 5-axis
+    shape dict (unlisted axes 1); returns None for count-style specs
+    ("all" / an integer).  Axis sizes must be positive; axis names must be
+    mesh axes — violations raise config-time ValueErrors rather than
+    surfacing as opaque sharding errors at first request."""
+    if "=" not in spec:
+        return None
+    shape = {}
+    for part in spec.split(","):
+        ax, _, v = part.partition("=")
+        ax = ax.strip()
+        if ax not in MESH_AXES:
+            raise ValueError(
+                f"TRITON_TPU_SERVE_MESH: unknown mesh axis {ax!r}; "
+                f"valid axes are {MESH_AXES}")
+        size = int(v)
+        if size < 1:
+            raise ValueError(
+                f"TRITON_TPU_SERVE_MESH: axis {ax}={size} must be >= 1")
+        shape[ax] = size
+    for ax in MESH_AXES:
+        shape.setdefault(ax, 1)
+    return shape
+
+
+def resolve_serve_count(spec: str, n_avail: int) -> int:
+    """Resolve a count-style spec ("all" / integer) to a device count."""
+    try:
+        n = n_avail if spec == "all" else int(spec)
+    except ValueError:
+        raise ValueError(
+            f"TRITON_TPU_SERVE_MESH={spec!r}: expected '1', 'all', a "
+            "device count, or an explicit 'dp=..,tp=..' shape")
+    if not 1 <= n <= n_avail:
+        raise ValueError(
+            f"TRITON_TPU_SERVE_MESH={spec!r}: need 1..{n_avail} devices")
+    return n
+
+
+def _check_axis_divisibility(shape: Dict[str, int], cfg: TransformerConfig,
+                             spec: str) -> None:
+    """Model-dimension divisibility for an explicit spec, checked at parse
+    time so misconfiguration is a readable error, not a jit crash."""
+    checks = [("tp", cfg.n_heads, "n_heads"), ("pp", cfg.n_layers,
+                                               "n_layers")]
+    if cfg.moe:
+        checks.append(("ep", cfg.n_experts, "n_experts"))
+    for ax, dim, dim_name in checks:
+        if shape[ax] > 1 and dim % shape[ax] != 0:
+            raise ValueError(
+                f"TRITON_TPU_SERVE_MESH={spec!r}: {ax}={shape[ax]} must "
+                f"divide {dim_name}={dim}")
+
+
 # ---------------------------------------------------------------------------
 # Parameters
 # ---------------------------------------------------------------------------

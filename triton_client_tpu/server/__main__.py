@@ -53,7 +53,14 @@ def main() -> None:
                         "server and shares the global device mesh")
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--serve-mesh", default=None, metavar="SPEC",
+                        help="device mesh served models shard over: '1' "
+                        "(default, one chip), 'all', an integer N, or an "
+                        "explicit shape like 'dp=1,pp=2,ep=2,sp=1,tp=2' "
+                        "(sets TRITON_TPU_SERVE_MESH)")
     args = parser.parse_args()
+    if args.serve_mesh is not None:
+        os.environ["TRITON_TPU_SERVE_MESH"] = args.serve_mesh
     from ..parallel import initialize_multihost
 
     if (args.num_processes is not None or args.process_id is not None) \
