@@ -273,7 +273,121 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
 }
 
 //==============================================================================
+// Transport-mode selection: real gRPC over h2c when the endpoint speaks
+// HTTP/2 (the stock gRPC port — reference grpc++ wire,
+// /root/reference/src/c++/library/grpc_client.cc:1093-1150), gRPC-Web over
+// the HTTP/1.1 bridge otherwise.  TC_TPU_GRPC_TRANSPORT=h2|web pins it.
+Error InferenceServerGrpcClient::EnsureMode(uint64_t timeout_us) {
+  std::lock_guard<std::mutex> lk(mode_mu_);
+  if (mode_ != Mode::kUndecided) return Error::Success;
+  const char* force = getenv("TC_TPU_GRPC_TRANSPORT");
+  if (force != nullptr && std::string(force) == "web") {
+    mode_ = Mode::kWeb;
+    return Error::Success;
+  }
+  if (!H2Available()) {
+    if (force != nullptr && std::string(force) == "h2") {
+      return Error(
+          "TC_TPU_GRPC_TRANSPORT=h2 but libnghttp2 (HPACK) is unavailable");
+    }
+    mode_ = Mode::kWeb;
+    return Error::Success;
+  }
+  auto conn = std::make_unique<H2GrpcConnection>();
+  bool not_http2 = false;
+  Error err = conn->Connect(
+      transport_->host(), transport_->port(), &not_http2,
+      transport_->keepalive_idle_s(), transport_->keepalive_intvl_s(),
+      timeout_us);
+  if (err.IsOk()) {
+    mode_ = Mode::kH2;
+    h2_idle_.emplace_back(std::move(conn));
+    if (verbose_) fprintf(stderr, "grpc transport: h2c\n");
+    return Error::Success;
+  }
+  if (force != nullptr && std::string(force) == "h2") return err;
+  if (not_http2) {
+    mode_ = Mode::kWeb;
+    if (verbose_) fprintf(stderr, "grpc transport: grpc-web bridge\n");
+    return Error::Success;
+  }
+  // connection-level failure (server down?): don't pin a mode — surface
+  // the error and re-probe on the next call
+  return err;
+}
+
+Error InferenceServerGrpcClient::AcquireH2(
+    std::unique_ptr<H2GrpcConnection>* conn, uint64_t timeout_us) {
+  {
+    std::lock_guard<std::mutex> lk(mode_mu_);
+    if (!h2_idle_.empty()) {
+      *conn = std::move(h2_idle_.back());
+      h2_idle_.pop_back();
+      return Error::Success;
+    }
+  }
+  *conn = std::make_unique<H2GrpcConnection>();
+  bool not_http2 = false;
+  return (*conn)->Connect(
+      transport_->host(), transport_->port(), &not_http2,
+      transport_->keepalive_idle_s(), transport_->keepalive_intvl_s(),
+      timeout_us);
+}
+
+void InferenceServerGrpcClient::ReleaseH2(
+    std::unique_ptr<H2GrpcConnection> conn, bool reusable) {
+  if (!reusable || !conn->connected()) return;
+  std::lock_guard<std::mutex> lk(mode_mu_);
+  if (h2_idle_.size() < 8) h2_idle_.emplace_back(std::move(conn));
+}
+
 Error InferenceServerGrpcClient::Call(
+    const std::string& method, const google::protobuf::Message& request,
+    google::protobuf::Message* response, const Headers& headers,
+    RequestTimers* timers, uint64_t timeout_us) {
+  TC_RETURN_IF_ERROR(EnsureMode(timeout_us));
+  bool h2;
+  {
+    std::lock_guard<std::mutex> lk(mode_mu_);
+    h2 = (mode_ == Mode::kH2);
+  }
+  if (h2) return CallH2(method, request, response, headers, timers, timeout_us);
+  return CallWeb(method, request, response, headers, timers, timeout_us);
+}
+
+Error InferenceServerGrpcClient::CallH2(
+    const std::string& method, const google::protobuf::Message& request,
+    google::protobuf::Message* response, const Headers& headers,
+    RequestTimers* timers, uint64_t timeout_us) {
+  std::string body = request.SerializeAsString();
+  if (transport_->max_request_bytes() > 0 &&
+      body.size() > transport_->max_request_bytes()) {
+    return Error(
+        "request exceeds maximum send message size of " +
+        std::to_string(transport_->max_request_bytes()) + " bytes");
+  }
+  std::unique_ptr<H2GrpcConnection> conn;
+  TC_RETURN_IF_ERROR(AcquireH2(&conn, timeout_us));
+  conn->SetMaxResponseBytes(transport_->max_response_bytes());
+  std::string resp;
+  Error err = conn->UnaryCall(
+      std::string("/") + kServicePath + "/" + method, body, headers, &resp,
+      timeout_us, timers);
+  // a clean grpc-status error leaves the connection reusable; transport
+  // and protocol failures Close() it inside UnaryCall, and ReleaseH2 drops
+  // disconnected handles
+  ReleaseH2(std::move(conn), true);
+  TC_RETURN_IF_ERROR(err);
+  if (!response->ParseFromString(resp)) {
+    return Error("failed to parse " + method + " response");
+  }
+  if (verbose_) {
+    fprintf(stderr, "%s -> ok\n", method.c_str());
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::CallWeb(
     const std::string& method, const google::protobuf::Message& request,
     google::protobuf::Message* response, const Headers& headers,
     RequestTimers* timers, uint64_t timeout_us) {
@@ -704,6 +818,36 @@ Error InferenceServerGrpcClient::StartStream(
   if (callback == nullptr) {
     return Error("callback must not be null for StartStream");
   }
+  TC_RETURN_IF_ERROR(EnsureMode(0));
+  bool h2;
+  {
+    std::lock_guard<std::mutex> lk(mode_mu_);
+    h2 = (mode_ == Mode::kH2);
+  }
+  if (h2) {
+    // real gRPC bidi stream on a dedicated h2c connection (reference
+    // ClientReaderWriter, grpc_client.cc:1377-1416)
+    auto hconn = std::make_unique<H2GrpcConnection>();
+    bool not_http2 = false;
+    TC_RETURN_IF_ERROR(hconn->Connect(
+        transport_->host(), transport_->port(), &not_http2,
+        transport_->keepalive_idle_s(), transport_->keepalive_intvl_s()));
+    TC_RETURN_IF_ERROR(hconn->StartStream(
+        std::string("/") + kServicePath + "/ModelStreamInfer", headers));
+    stream_callback_ = std::move(callback);
+    {
+      std::lock_guard<std::mutex> lk(stream_err_mu_);
+      stream_final_error_ = Error::Success;
+    }
+    {
+      std::lock_guard<std::mutex> lk(stream_write_mu_);
+      h2_stream_conn_ = std::move(hconn);
+      stream_active_ = true;
+    }
+    stream_reader_ =
+        std::thread(&InferenceServerGrpcClient::StreamReadLoopH2, this);
+    return Error::Success;
+  }
   auto conn = std::make_unique<DuplexConnection>();
   TC_RETURN_IF_ERROR(conn->Open(
       transport_->host(), transport_->port(),
@@ -780,6 +924,37 @@ void InferenceServerGrpcClient::StreamReadLoop() {
   stream_final_error_ = StatusFromTrailers(trailers);
 }
 
+// Reader thread for the h2c stream: gRPC messages straight off the HTTP/2
+// DATA frames (reference AsyncStreamTransfer, grpc_client.cc:1628-1673).
+void InferenceServerGrpcClient::StreamReadLoopH2() {
+  for (;;) {
+    std::string msg;
+    bool done = false;
+    Error err = h2_stream_conn_->StreamRead(&msg, &done);
+    if (done) {
+      {
+        std::lock_guard<std::mutex> lk(stream_err_mu_);
+        stream_final_error_ = err;
+      }
+      if (!err.IsOk()) {
+        // surface the broken stream to the user, not just to FinishStream
+        // (same contract as the web-path reader loop)
+        stream_callback_(new ErrorResult(err));
+      }
+      return;
+    }
+    pb::ModelStreamInferResponse stream_resp;
+    if (!stream_resp.ParseFromString(msg)) {
+      stream_callback_(
+          new ErrorResult(Error("failed to parse stream response")));
+    } else if (!stream_resp.error_message().empty()) {
+      stream_callback_(new ErrorResult(Error(stream_resp.error_message())));
+    } else {
+      stream_callback_(new InferResultGrpcImpl(stream_resp.infer_response()));
+    }
+  }
+}
+
 Error InferenceServerGrpcClient::AsyncStreamInfer(
     const InferOptions& options, const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs) {
@@ -788,6 +963,9 @@ Error InferenceServerGrpcClient::AsyncStreamInfer(
   std::lock_guard<std::mutex> lk(stream_write_mu_);
   if (!stream_active_) {
     return Error("stream not available, StartStream() must be called first");
+  }
+  if (h2_stream_conn_ != nullptr) {
+    return h2_stream_conn_->StreamWrite(request.SerializeAsString());
   }
   return stream_conn_->WriteChunk(Frame(request.SerializeAsString()));
 }
@@ -800,19 +978,27 @@ Error InferenceServerGrpcClient::FinishStream() {
         "FinishStream must not be called from the stream callback");
   }
   Error write_err;
+  bool h2 = false;
   {
     std::lock_guard<std::mutex> lk(stream_write_mu_);
     if (!stream_active_) {
       return Error("no active stream");
     }
     stream_active_ = false;
-    write_err = stream_conn_->WriteEnd();
+    h2 = (h2_stream_conn_ != nullptr);
+    write_err = h2 ? h2_stream_conn_->StreamWritesDone()
+                   : stream_conn_->WriteEnd();
   }
   if (stream_reader_.joinable()) stream_reader_.join();
   {
     std::lock_guard<std::mutex> lk(stream_write_mu_);
-    stream_conn_->Close();
-    stream_conn_.reset();
+    if (h2) {
+      h2_stream_conn_->Close();
+      h2_stream_conn_.reset();
+    } else {
+      stream_conn_->Close();
+      stream_conn_.reset();
+    }
   }
   Error final_err;
   {

@@ -1,0 +1,763 @@
+#include "h2.h"
+
+#include <dlfcn.h>
+
+#include <algorithm>
+#include <cstring>
+
+#include "sockio.h"
+
+namespace tc_tpu {
+namespace client {
+
+namespace {
+
+// ---- HTTP/2 constants (RFC 7540) ----
+constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr uint8_t kFrameData = 0x0;
+constexpr uint8_t kFrameHeaders = 0x1;
+constexpr uint8_t kFrameRstStream = 0x3;
+constexpr uint8_t kFrameSettings = 0x4;
+constexpr uint8_t kFramePing = 0x6;
+constexpr uint8_t kFrameGoaway = 0x7;
+constexpr uint8_t kFrameWindowUpdate = 0x8;
+constexpr uint8_t kFrameContinuation = 0x9;
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+constexpr uint16_t kSettingsInitialWindowSize = 0x4;
+constexpr uint16_t kSettingsMaxFrameSize = 0x5;
+constexpr uint16_t kSettingsEnablePush = 0x2;
+// our receive windows: large so responses stream without per-frame updates
+constexpr long long kRecvWindow = 1 << 28;  // 256 MiB
+constexpr long long kRecvReplenishAt = kRecvWindow / 2;
+
+// ---- libnghttp2 HPACK inflater (stable C ABI, loaded at runtime) ----
+struct NvABI {
+  uint8_t* name;
+  uint8_t* value;
+  size_t namelen;
+  size_t valuelen;
+  uint8_t flags;
+};
+constexpr int kInflateFinal = 0x01;
+constexpr int kInflateEmit = 0x02;
+
+struct Hpack {
+  int (*inflate_new)(void**) = nullptr;
+  void (*inflate_del)(void*) = nullptr;
+  long (*inflate_hd2)(void*, NvABI*, int*, const uint8_t*, size_t, int) =
+      nullptr;
+  int (*inflate_end_headers)(void*) = nullptr;
+  bool ok = false;
+
+  static const Hpack& Get() {
+    static Hpack h = [] {
+      Hpack out;
+      void* lib = dlopen("libnghttp2.so.14", RTLD_NOW | RTLD_GLOBAL);
+      if (lib == nullptr) lib = dlopen("libnghttp2.so", RTLD_NOW | RTLD_GLOBAL);
+      if (lib == nullptr) return out;
+      out.inflate_new = reinterpret_cast<int (*)(void**)>(
+          dlsym(lib, "nghttp2_hd_inflate_new"));
+      out.inflate_del = reinterpret_cast<void (*)(void*)>(
+          dlsym(lib, "nghttp2_hd_inflate_del"));
+      out.inflate_hd2 =
+          reinterpret_cast<long (*)(void*, NvABI*, int*, const uint8_t*,
+                                    size_t, int)>(
+              dlsym(lib, "nghttp2_hd_inflate_hd2"));
+      out.inflate_end_headers = reinterpret_cast<int (*)(void*)>(
+          dlsym(lib, "nghttp2_hd_inflate_end_headers"));
+      out.ok = out.inflate_new && out.inflate_del && out.inflate_hd2 &&
+               out.inflate_end_headers;
+      return out;
+    }();
+    return h;
+  }
+};
+
+void PutU32(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>((v >> 24) & 0xFF));
+  out->push_back(static_cast<char>((v >> 16) & 0xFF));
+  out->push_back(static_cast<char>((v >> 8) & 0xFF));
+  out->push_back(static_cast<char>(v & 0xFF));
+}
+
+// HPACK literal-header-field-without-indexing encoder (RFC 7541 §6.2.2,
+// no Huffman).  The decoder side needs full HPACK (the server compresses);
+// the encoder side is allowed to never compress — same choice grpc-web
+// made for its text framing.
+void EncodeLiteral(std::string* out, const std::string& name,
+                   const std::string& value) {
+  auto put_len = [out](size_t n) {
+    if (n < 0x7F) {
+      out->push_back(static_cast<char>(n));
+    } else {
+      out->push_back(0x7F);
+      size_t rem = n - 0x7F;
+      while (rem >= 0x80) {
+        out->push_back(static_cast<char>((rem & 0x7F) | 0x80));
+        rem >>= 7;
+      }
+      out->push_back(static_cast<char>(rem));
+    }
+  };
+  out->push_back(0x00);  // literal w/o indexing, new name
+  put_len(name.size());
+  out->append(name);
+  put_len(value.size());
+  out->append(value);
+}
+
+std::string LowerCopy(const std::string& s) {
+  std::string out = s;
+  std::transform(out.begin(), out.end(), out.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return out;
+}
+
+std::string PercentDecode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      out.push_back(static_cast<char>(
+          strtol(s.substr(i + 1, 2).c_str(), nullptr, 16)));
+      i += 2;
+    } else {
+      out.push_back(s[i]);
+    }
+  }
+  return out;
+}
+
+// grpc-timeout header value: at most 8 digits (gRPC PROTOCOL-HTTP2 spec).
+// Coarser units round UP so the server-side deadline is never shorter than
+// the client's.
+std::string GrpcTimeoutValue(uint64_t timeout_us) {
+  constexpr uint64_t kMaxDigitsValue = 99999999;  // 8 digits
+  if (timeout_us <= kMaxDigitsValue) return std::to_string(timeout_us) + "u";
+  uint64_t ms = (timeout_us + 999) / 1000;
+  if (ms <= kMaxDigitsValue) return std::to_string(ms) + "m";
+  uint64_t s = (timeout_us + 999999) / 1000000;
+  return std::to_string(std::min(s, kMaxDigitsValue)) + "S";
+}
+
+Error IoError(int rc, const char* what) {
+  if (rc == -2) {
+    return Error(std::string("Deadline Exceeded: timed out ") + what);
+  }
+  return Error(std::string("connection failure while ") + what);
+}
+
+}  // namespace
+
+bool H2Available() { return Hpack::Get().ok; }
+
+H2GrpcConnection::~H2GrpcConnection() { Close(); }
+
+void H2GrpcConnection::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+  if (inflater_ != nullptr) {
+    Hpack::Get().inflate_del(inflater_);
+    inflater_ = nullptr;
+  }
+  stream_active_ = false;
+}
+
+Error H2GrpcConnection::Connect(
+    const std::string& host, int port, bool* not_http2,
+    int keepalive_idle_s, int keepalive_intvl_s, uint64_t timeout_us) {
+  *not_http2 = false;
+  if (!H2Available()) {
+    return Error("HTTP/2 unavailable: libnghttp2 (HPACK decoder) not found");
+  }
+  Error err;
+  auto dl = sockio::Deadline::In(timeout_us);
+  fd_ = sockio::ConnectTcp(host, port, &err, dl);
+  if (fd_ < 0) return err;
+  sockio::EnableTcpKeepAlive(fd_, keepalive_idle_s, keepalive_intvl_s);
+
+  // client preface + SETTINGS + connection WINDOW_UPDATE in one write
+  std::string bytes(kPreface, sizeof(kPreface) - 1);
+  std::string settings;
+  auto put_setting = [&settings](uint16_t id, uint32_t v) {
+    settings.push_back(static_cast<char>((id >> 8) & 0xFF));
+    settings.push_back(static_cast<char>(id & 0xFF));
+    PutU32(&settings, v);
+  };
+  put_setting(kSettingsEnablePush, 0);
+  put_setting(kSettingsInitialWindowSize, kRecvWindow);
+  bytes.push_back(0);  // frame: len(3) type flags sid(4)
+  bytes.push_back(static_cast<char>((settings.size() >> 8) & 0xFF));
+  bytes.push_back(static_cast<char>(settings.size() & 0xFF));
+  bytes.push_back(static_cast<char>(kFrameSettings));
+  bytes.push_back(0);
+  PutU32(&bytes, 0);
+  bytes.append(settings);
+  // grow the connection-level recv window (it starts at 65535 regardless
+  // of SETTINGS_INITIAL_WINDOW_SIZE)
+  bytes.push_back(0);
+  bytes.push_back(0);
+  bytes.push_back(4);
+  bytes.push_back(static_cast<char>(kFrameWindowUpdate));
+  bytes.push_back(0);
+  PutU32(&bytes, 0);
+  PutU32(&bytes, static_cast<uint32_t>(kRecvWindow - 65535));
+  int rc = sockio::WriteAllDl(fd_, bytes.data(), bytes.size(), dl);
+  if (rc != 0) {
+    Close();
+    return IoError(rc, "sending HTTP/2 preface");
+  }
+
+  // first bytes back decide the protocol: an HTTP/1.1 server answers the
+  // preface with "HTTP/1.1 4xx" text, a real h2c server with a SETTINGS
+  // frame (type byte at offset 3)
+  char probe[9];
+  rc = sockio::ReadExactDl(fd_, probe, sizeof(probe), dl);
+  if (rc != 0) {
+    Close();
+    return IoError(rc, "reading HTTP/2 settings");
+  }
+  if (std::memcmp(probe, "HTT", 3) == 0) {
+    Close();
+    *not_http2 = true;
+    return Error("server is not HTTP/2");
+  }
+  if (probe[3] != static_cast<char>(kFrameSettings)) {
+    Close();
+    return Error("HTTP/2 handshake failed: first frame is not SETTINGS");
+  }
+  uint32_t len = (static_cast<uint8_t>(probe[0]) << 16) |
+                 (static_cast<uint8_t>(probe[1]) << 8) |
+                 static_cast<uint8_t>(probe[2]);
+  std::string payload(len, '\0');
+  if (len > 0) {
+    rc = sockio::ReadExactDl(fd_, payload.data(), len, dl);
+    if (rc != 0) {
+      Close();
+      return IoError(rc, "reading HTTP/2 settings");
+    }
+  }
+  for (size_t off = 0; off + 6 <= payload.size(); off += 6) {
+    uint16_t id = (static_cast<uint8_t>(payload[off]) << 8) |
+                  static_cast<uint8_t>(payload[off + 1]);
+    uint32_t v = (static_cast<uint8_t>(payload[off + 2]) << 24) |
+                 (static_cast<uint8_t>(payload[off + 3]) << 16) |
+                 (static_cast<uint8_t>(payload[off + 4]) << 8) |
+                 static_cast<uint8_t>(payload[off + 5]);
+    if (id == kSettingsInitialWindowSize) peer_initial_window_ = v;
+    if (id == kSettingsMaxFrameSize) peer_max_frame_ = v;
+  }
+  TC_RETURN_IF_ERROR(SendFrame(kFrameSettings, kFlagAck, 0, ""));
+
+  int irc = Hpack::Get().inflate_new(&inflater_);
+  if (irc != 0) {
+    Close();
+    return Error("failed to create HPACK inflater");
+  }
+  return Error::Success;
+}
+
+Error H2GrpcConnection::SendFrame(
+    uint8_t type, uint8_t flags, uint32_t stream_id,
+    const std::string& payload) {
+  std::string hdr;
+  hdr.reserve(9 + payload.size());
+  hdr.push_back(static_cast<char>((payload.size() >> 16) & 0xFF));
+  hdr.push_back(static_cast<char>((payload.size() >> 8) & 0xFF));
+  hdr.push_back(static_cast<char>(payload.size() & 0xFF));
+  hdr.push_back(static_cast<char>(type));
+  hdr.push_back(static_cast<char>(flags));
+  PutU32(&hdr, stream_id & 0x7FFFFFFF);
+  hdr.append(payload);
+  std::lock_guard<std::mutex> lk(write_mu_);
+  if (fd_ < 0) return Error("connection closed");
+  if (!sockio::WriteAll(fd_, hdr.data(), hdr.size())) {
+    return Error("connection failure while sending HTTP/2 frame");
+  }
+  return Error::Success;
+}
+
+Error H2GrpcConnection::ReadFrameHdr(FrameHdr* hdr,
+                                     const sockio::Deadline& dl) {
+  char raw[9];
+  int rc = sockio::ReadExactDl(fd_, raw, sizeof(raw), dl);
+  if (rc != 0) return IoError(rc, "reading HTTP/2 frame");
+  hdr->len = (static_cast<uint8_t>(raw[0]) << 16) |
+             (static_cast<uint8_t>(raw[1]) << 8) |
+             static_cast<uint8_t>(raw[2]);
+  hdr->type = static_cast<uint8_t>(raw[3]);
+  hdr->flags = static_cast<uint8_t>(raw[4]);
+  hdr->stream_id = ((static_cast<uint8_t>(raw[5]) & 0x7F) << 24) |
+                   (static_cast<uint8_t>(raw[6]) << 16) |
+                   (static_cast<uint8_t>(raw[7]) << 8) |
+                   static_cast<uint8_t>(raw[8]);
+  return Error::Success;
+}
+
+Error H2GrpcConnection::InflateHeaderBlock(const std::string& block,
+                                           Headers* out) {
+  const Hpack& hp = Hpack::Get();
+  const uint8_t* in = reinterpret_cast<const uint8_t*>(block.data());
+  size_t inlen = block.size();
+  // nghttp2 contract: keep calling (even with no input left) until the
+  // FINAL flag; EMIT may arrive on calls that consume zero bytes
+  for (;;) {
+    NvABI nv;
+    int flags = 0;
+    long rv = hp.inflate_hd2(inflater_, &nv, &flags, in, inlen, 1);
+    if (rv < 0) {
+      return Error("HPACK decoding failed (error " + std::to_string(rv) +
+                   ")");
+    }
+    in += rv;
+    inlen -= static_cast<size_t>(rv);
+    if (flags & kInflateEmit) {
+      std::string name(reinterpret_cast<char*>(nv.name), nv.namelen);
+      std::string value(reinterpret_cast<char*>(nv.value), nv.valuelen);
+      // repeated headers (rare here) keep the last value — fine for our use
+      (*out)[LowerCopy(name)] = value;
+    }
+    if (flags & kInflateFinal) {
+      hp.inflate_end_headers(inflater_);
+      return Error::Success;
+    }
+    if (inlen == 0 && !(flags & kInflateEmit)) {
+      // no progress possible: the block ended mid-entry
+      return Error("HPACK decoding failed: truncated header block");
+    }
+  }
+}
+
+Error H2GrpcConnection::ReplenishRecvWindow(uint32_t stream_id,
+                                            size_t consumed) {
+  conn_recv_consumed_ += static_cast<long long>(consumed);
+  if (conn_recv_consumed_ < kRecvReplenishAt) return Error::Success;
+  std::string upd;
+  PutU32(&upd, static_cast<uint32_t>(conn_recv_consumed_));
+  Error err = SendFrame(kFrameWindowUpdate, 0, 0, upd);
+  if (err.IsOk() && stream_id != 0) {
+    err = SendFrame(kFrameWindowUpdate, 0, stream_id, upd);
+  }
+  conn_recv_consumed_ = 0;
+  return err;
+}
+
+// Read + dispatch exactly one frame.  `call` is the RPC whose stream this
+// connection currently runs (unary or bidi) — frames for its stream land
+// in it; connection-level frames update windows/settings.
+Error H2GrpcConnection::ProcessOneFrame(CallState* call,
+                                        const sockio::Deadline& dl) {
+  FrameHdr hdr;
+  TC_RETURN_IF_ERROR(ReadFrameHdr(&hdr, dl));
+  std::string payload(hdr.len, '\0');
+  if (hdr.len > 0) {
+    int rc = sockio::ReadExactDl(fd_, payload.data(), hdr.len, dl);
+    if (rc != 0) return IoError(rc, "reading HTTP/2 frame payload");
+  }
+  switch (hdr.type) {
+    case kFrameData: {
+      size_t off = 0, len = payload.size();
+      if (hdr.flags & kFlagPadded) {
+        if (payload.empty()) return Error("malformed padded DATA frame");
+        uint8_t pad = static_cast<uint8_t>(payload[0]);
+        if (1u + pad > payload.size()) {
+          return Error("malformed padded DATA frame");
+        }
+        off = 1;
+        len = payload.size() - 1 - pad;
+      }
+      if (hdr.stream_id == call->stream_id) {
+        call->data.append(payload, off, len);
+        if (max_response_bytes_ > 0 &&
+            call->data.size() > max_response_bytes_ + 5) {
+          // enforced mid-read: the cap must bound memory, not just be
+          // checked after the whole body buffered
+          return Error(
+              "response exceeds maximum receive message size of " +
+              std::to_string(max_response_bytes_) + " bytes");
+        }
+        if (hdr.flags & kFlagEndStream) call->end_stream = true;
+      }
+      // count the whole frame against our recv window (padding included)
+      TC_RETURN_IF_ERROR(ReplenishRecvWindow(call->stream_id,
+                                             payload.size()));
+      break;
+    }
+    case kFrameHeaders: {
+      size_t off = 0, len = payload.size();
+      if (hdr.flags & kFlagPadded) {
+        if (payload.empty()) return Error("malformed padded HEADERS frame");
+        uint8_t pad = static_cast<uint8_t>(payload[0]);
+        off = 1;
+        if (1u + pad > payload.size()) {
+          return Error("malformed padded HEADERS frame");
+        }
+        len = payload.size() - 1 - pad;
+      }
+      if (hdr.flags & kFlagPriority) {
+        if (len < 5) return Error("malformed HEADERS frame");
+        off += 5;
+        len -= 5;
+      }
+      if (hdr.stream_id == call->stream_id) {
+        call->header_block.append(payload, off, len);
+        if (hdr.flags & kFlagEndStream) call->end_stream = true;
+        if (hdr.flags & kFlagEndHeaders) {
+          TC_RETURN_IF_ERROR(
+              InflateHeaderBlock(call->header_block, &call->headers));
+          call->header_block.clear();
+          call->headers_done = true;
+        }
+      } else {
+        // a header block we are not tracking still goes through the
+        // inflater (HPACK state is connection-wide)
+        Headers ignored;
+        TC_RETURN_IF_ERROR(InflateHeaderBlock(
+            payload.substr(off, len), &ignored));
+      }
+      break;
+    }
+    case kFrameContinuation: {
+      if (hdr.stream_id == call->stream_id) {
+        call->header_block.append(payload);
+        if (hdr.flags & kFlagEndHeaders) {
+          TC_RETURN_IF_ERROR(
+              InflateHeaderBlock(call->header_block, &call->headers));
+          call->header_block.clear();
+          call->headers_done = true;
+        }
+      }
+      break;
+    }
+    case kFrameSettings: {
+      if (hdr.flags & kFlagAck) break;
+      for (size_t off = 0; off + 6 <= payload.size(); off += 6) {
+        uint16_t id = (static_cast<uint8_t>(payload[off]) << 8) |
+                      static_cast<uint8_t>(payload[off + 1]);
+        uint32_t v = (static_cast<uint8_t>(payload[off + 2]) << 24) |
+                     (static_cast<uint8_t>(payload[off + 3]) << 16) |
+                     (static_cast<uint8_t>(payload[off + 4]) << 8) |
+                     static_cast<uint8_t>(payload[off + 5]);
+        std::lock_guard<std::mutex> lk(state_mu_);
+        if (id == kSettingsInitialWindowSize) {
+          // adjust the active stream's window by the delta (RFC 7540 §6.9.2)
+          stream_send_window_ +=
+              static_cast<long long>(v) - peer_initial_window_;
+          peer_initial_window_ = v;
+        }
+        if (id == kSettingsMaxFrameSize) peer_max_frame_ = v;
+      }
+      window_cv_.notify_all();
+      TC_RETURN_IF_ERROR(SendFrame(kFrameSettings, kFlagAck, 0, ""));
+      break;
+    }
+    case kFramePing: {
+      if (!(hdr.flags & kFlagAck)) {
+        TC_RETURN_IF_ERROR(SendFrame(kFramePing, kFlagAck, 0, payload));
+      }
+      break;
+    }
+    case kFrameWindowUpdate: {
+      if (payload.size() < 4) return Error("malformed WINDOW_UPDATE");
+      uint32_t inc = ((static_cast<uint8_t>(payload[0]) & 0x7F) << 24) |
+                     (static_cast<uint8_t>(payload[1]) << 16) |
+                     (static_cast<uint8_t>(payload[2]) << 8) |
+                     static_cast<uint8_t>(payload[3]);
+      {
+        std::lock_guard<std::mutex> lk(state_mu_);
+        if (hdr.stream_id == 0) {
+          conn_send_window_ += inc;
+        } else if (hdr.stream_id == call->stream_id) {
+          stream_send_window_ += inc;
+        }
+      }
+      window_cv_.notify_all();
+      break;
+    }
+    case kFrameRstStream: {
+      if (hdr.stream_id == call->stream_id) {
+        call->reset = true;
+        call->end_stream = true;
+        if (payload.size() >= 4) {
+          call->reset_code = (static_cast<uint8_t>(payload[0]) << 24) |
+                             (static_cast<uint8_t>(payload[1]) << 16) |
+                             (static_cast<uint8_t>(payload[2]) << 8) |
+                             static_cast<uint8_t>(payload[3]);
+        }
+      }
+      break;
+    }
+    case kFrameGoaway: {
+      uint32_t code = 0;
+      if (payload.size() >= 8) {
+        code = (static_cast<uint8_t>(payload[4]) << 24) |
+               (static_cast<uint8_t>(payload[5]) << 16) |
+               (static_cast<uint8_t>(payload[6]) << 8) |
+               static_cast<uint8_t>(payload[7]);
+      }
+      return Error("server sent GOAWAY (error code " + std::to_string(code) +
+                   ")");
+    }
+    default:
+      break;  // PRIORITY / PUSH_PROMISE(disabled) / unknown: ignore
+  }
+  return Error::Success;
+}
+
+Error H2GrpcConnection::SendHeaders(
+    const std::string& path, const Headers& metadata, uint32_t stream_id,
+    uint64_t timeout_us, bool end_stream) {
+  std::string block;
+  EncodeLiteral(&block, ":method", "POST");
+  EncodeLiteral(&block, ":scheme", "http");
+  EncodeLiteral(&block, ":path", path);
+  EncodeLiteral(&block, ":authority", "localhost");
+  EncodeLiteral(&block, "te", "trailers");
+  EncodeLiteral(&block, "content-type", "application/grpc");
+  if (timeout_us > 0) {
+    EncodeLiteral(&block, "grpc-timeout", GrpcTimeoutValue(timeout_us));
+  }
+  for (const auto& kv : metadata) {
+    std::string name = LowerCopy(kv.first);
+    if (name == "content-type" || name == "te" || name[0] == ':') continue;
+    EncodeLiteral(&block, name, kv.second);
+  }
+  uint8_t flags = kFlagEndHeaders;
+  if (end_stream) flags |= kFlagEndStream;
+  return SendFrame(kFrameHeaders, flags, stream_id, block);
+}
+
+// gRPC message framing + DATA flow control: chunk to the peer's max frame
+// size and block on the send windows.  `call` is the REAL call state —
+// frames consumed while blocked (unary path) land in it, so an early
+// server response (RST / trailers-only rejection before the full body) is
+// never lost.  On the bidi stream the reader thread consumes frames; the
+// writer waits on the window condvar and also wakes when the stream dies.
+Error H2GrpcConnection::SendGrpcMessage(
+    const std::string& message, CallState* call, bool end_stream,
+    const sockio::Deadline& dl) {
+  std::string framed;
+  framed.reserve(5 + message.size());
+  framed.push_back(0);  // uncompressed
+  PutU32(&framed, static_cast<uint32_t>(message.size()));
+  framed.append(message);
+
+  size_t off = 0;
+  while (off < framed.size()) {
+    long long budget;
+    bool reader_active;
+    {
+      std::unique_lock<std::mutex> lk(state_mu_);
+      budget = std::min(conn_send_window_, stream_send_window_);
+      reader_active = stream_active_;
+      if (budget <= 0 && reader_active) {
+        // the stream reader thread consumes WINDOW_UPDATEs; wait here —
+        // and also wake when the stream ends, or we deadlock forever on
+        // a window that will never be replenished
+        auto woke = [this] {
+          return std::min(conn_send_window_, stream_send_window_) > 0 ||
+                 !stream_active_;
+        };
+        bool ok = true;
+        if (dl.enabled) {
+          long long rem = dl.RemainingUs();
+          if (rem <= 0) return Error("Deadline Exceeded: send window");
+          ok = window_cv_.wait_for(lk, std::chrono::microseconds(rem),
+                                   woke);
+        } else {
+          window_cv_.wait(lk, woke);
+        }
+        if (!ok) return Error("Deadline Exceeded: send window");
+        if (!stream_active_) {
+          return Error("stream closed while awaiting send window");
+        }
+        continue;
+      }
+    }
+    if (!reader_active && (call->end_stream || call->reset)) {
+      // unary path (single-threaded, no race on `call`): the server
+      // already closed the stream — e.g. rejected the request mid-upload
+      // — so stop sending and let the caller read the status
+      return Error::Success;
+    }
+    if (budget <= 0) {
+      // unary path: nobody else reads — consume frames (into the real
+      // call state) until the peer replenishes a window
+      TC_RETURN_IF_ERROR(ProcessOneFrame(call, dl));
+      continue;
+    }
+    size_t chunk = std::min(
+        {framed.size() - off, static_cast<size_t>(budget),
+         static_cast<size_t>(peer_max_frame_)});
+    bool last = (off + chunk == framed.size());
+    TC_RETURN_IF_ERROR(SendFrame(
+        kFrameData, (last && end_stream) ? kFlagEndStream : 0,
+        call->stream_id, framed.substr(off, chunk)));
+    {
+      std::lock_guard<std::mutex> lk(state_mu_);
+      conn_send_window_ -= static_cast<long long>(chunk);
+      stream_send_window_ -= static_cast<long long>(chunk);
+    }
+    off += chunk;
+  }
+  return Error::Success;
+}
+
+Error H2GrpcConnection::GrpcStatusToError(const Headers& h) {
+  auto st = h.find("grpc-status");
+  if (st == h.end()) {
+    auto status = h.find(":status");
+    if (status != h.end() && status->second != "200") {
+      return Error("rpc failed with HTTP status " + status->second);
+    }
+    return Error("response missing grpc-status");
+  }
+  int code = atoi(st->second.c_str());
+  if (code == 0) return Error::Success;
+  auto msg = h.find("grpc-message");
+  std::string text =
+      msg != h.end() ? PercentDecode(msg->second) : std::string();
+  if (code == 4 && text.empty()) text = "Deadline Exceeded";
+  return Error(text.empty()
+                   ? "rpc failed with status " + std::to_string(code)
+                   : text);
+}
+
+Error H2GrpcConnection::UnaryCall(
+    const std::string& path, const std::string& request,
+    const Headers& metadata, std::string* response, uint64_t timeout_us,
+    RequestTimers* timers) {
+  if (fd_ < 0) return Error("connection closed");
+  if (stream_active_) {
+    return Error("connection is running a stream");
+  }
+  auto dl = sockio::Deadline::In(timeout_us);
+  CallState call;
+  call.stream_id = next_stream_id_;
+  next_stream_id_ += 2;
+  {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    stream_send_window_ = peer_initial_window_;
+  }
+  if (timers != nullptr) {
+    timers->CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  }
+  Error err = SendHeaders(path, metadata, call.stream_id, timeout_us, false);
+  if (err.IsOk()) err = SendGrpcMessage(request, &call, true, dl);
+  if (timers != nullptr) {
+    timers->CaptureTimestamp(RequestTimers::Kind::SEND_END);
+    timers->CaptureTimestamp(RequestTimers::Kind::RECV_START);
+  }
+  while (err.IsOk() && !call.end_stream) {
+    err = ProcessOneFrame(&call, dl);
+  }
+  if (timers != nullptr) {
+    timers->CaptureTimestamp(RequestTimers::Kind::RECV_END);
+  }
+  if (!err.IsOk()) {
+    // the connection state (HPACK tables, half-open stream) is now
+    // indeterminate — this connection must not be reused
+    Close();
+    return err;
+  }
+  if (call.reset) {
+    Close();
+    return Error("rpc aborted: RST_STREAM (error code " +
+                 std::to_string(call.reset_code) + ")");
+  }
+  TC_RETURN_IF_ERROR(GrpcStatusToError(call.headers));
+  if (call.data.size() < 5) {
+    return Error("rpc returned no response message");
+  }
+  uint32_t len = (static_cast<uint8_t>(call.data[1]) << 24) |
+                 (static_cast<uint8_t>(call.data[2]) << 16) |
+                 (static_cast<uint8_t>(call.data[3]) << 8) |
+                 static_cast<uint8_t>(call.data[4]);
+  if (call.data.size() < 5u + len) {
+    return Error("truncated gRPC response message");
+  }
+  response->assign(call.data, 5, len);
+  return Error::Success;
+}
+
+Error H2GrpcConnection::StartStream(const std::string& path,
+                                    const Headers& metadata) {
+  if (fd_ < 0) return Error("connection closed");
+  if (stream_active_) return Error("stream already running");
+  stream_call_ = CallState();
+  stream_call_.stream_id = next_stream_id_;
+  next_stream_id_ += 2;
+  stream_read_pos_ = 0;
+  {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    stream_send_window_ = peer_initial_window_;
+    stream_active_ = true;
+  }
+  return SendHeaders(path, metadata, stream_call_.stream_id, 0, false);
+}
+
+Error H2GrpcConnection::StreamWrite(const std::string& message) {
+  if (!stream_active_) return Error("no active stream");
+  return SendGrpcMessage(message, &stream_call_, false, sockio::Deadline());
+}
+
+Error H2GrpcConnection::StreamWritesDone() {
+  if (!stream_active_) return Error("no active stream");
+  return SendFrame(kFrameData, kFlagEndStream, stream_call_.stream_id, "");
+}
+
+Error H2GrpcConnection::StreamRead(std::string* message, bool* done) {
+  *done = false;
+  sockio::Deadline dl;  // streams live until closed
+  for (;;) {
+    // a complete message already buffered?
+    if (stream_call_.data.size() >= stream_read_pos_ + 5) {
+      const std::string& d = stream_call_.data;
+      size_t p = stream_read_pos_;
+      uint32_t len = (static_cast<uint8_t>(d[p + 1]) << 24) |
+                     (static_cast<uint8_t>(d[p + 2]) << 16) |
+                     (static_cast<uint8_t>(d[p + 3]) << 8) |
+                     static_cast<uint8_t>(d[p + 4]);
+      if (d.size() >= p + 5u + len) {
+        message->assign(d, p + 5, len);
+        stream_read_pos_ = p + 5 + len;
+        if (stream_read_pos_ == d.size()) {
+          stream_call_.data.clear();
+          stream_read_pos_ = 0;
+        }
+        return Error::Success;
+      }
+    }
+    if (stream_call_.end_stream) {
+      *done = true;
+      {
+        std::lock_guard<std::mutex> lk(state_mu_);
+        stream_active_ = false;
+      }
+      window_cv_.notify_all();
+      if (stream_call_.reset) {
+        return Error("stream aborted: RST_STREAM (error code " +
+                     std::to_string(stream_call_.reset_code) + ")");
+      }
+      return GrpcStatusToError(stream_call_.headers);
+    }
+    Error err = ProcessOneFrame(&stream_call_, dl);
+    if (!err.IsOk()) {
+      *done = true;
+      {
+        std::lock_guard<std::mutex> lk(state_mu_);
+        stream_active_ = false;
+      }
+      window_cv_.notify_all();
+      return err;
+    }
+  }
+}
+
+}  // namespace client
+}  // namespace tc_tpu

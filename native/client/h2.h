@@ -1,0 +1,138 @@
+// gRPC over cleartext HTTP/2 (h2c, prior knowledge) — the wire the stock
+// gRPC port speaks.
+//
+// Parity target: the reference C++ client is grpc++ over HTTP/2
+// (/root/reference/src/c++/library/grpc_client.cc:1093-1150 sync RPC,
+// :1628-1673 bidi streams).  The image ships no grpc++ headers, so this
+// implements the protocol directly: own HTTP/2 framing (RFC 7540 — frame
+// layer, SETTINGS/PING/WINDOW_UPDATE handling, flow-control windows both
+// directions) plus HPACK (RFC 7541) with a literal-without-indexing encoder
+// and the system libnghttp2's inflater (dlopen'd; handles Huffman + the
+// server's dynamic table) for decoding.
+//
+// Concurrency model: ONE in-flight RPC per connection (the client pools
+// connections for concurrent unary calls, mirroring its HTTP transport
+// pool; grpc++ multiplexes instead — same observable semantics).  The bidi
+// stream runs reads and writes concurrently on its dedicated connection.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace tc_tpu {
+namespace client {
+
+namespace sockio {
+struct Deadline;  // sockio.h (header-only)
+}
+
+using Headers = std::map<std::string, std::string>;
+
+// True when the HPACK decoder (libnghttp2) is loadable — h2c mode needs it.
+bool H2Available();
+
+class H2GrpcConnection {
+ public:
+  H2GrpcConnection() = default;
+  ~H2GrpcConnection();
+
+  H2GrpcConnection(const H2GrpcConnection&) = delete;
+  H2GrpcConnection& operator=(const H2GrpcConnection&) = delete;
+
+  // TCP connect + HTTP/2 preface/SETTINGS exchange.  Fails fast with
+  // `not_http2` set (and no Error) when the peer answered the preface with
+  // HTTP/1.1 text — the caller falls back to the gRPC-Web bridge.
+  Error Connect(
+      const std::string& host, int port, bool* not_http2,
+      int keepalive_idle_s = 0, int keepalive_intvl_s = 0,
+      uint64_t timeout_us = 0);
+  bool connected() const { return fd_ >= 0; }
+
+  // Abort DATA accumulation past this many bytes (reference
+  // GRPC_ARG_MAX_RECEIVE_MESSAGE_LENGTH — enforced mid-read so the cap
+  // actually bounds memory); 0 = unlimited.
+  void SetMaxResponseBytes(size_t max_bytes) {
+    max_response_bytes_ = max_bytes;
+  }
+
+  // One unary RPC: serialized request pb in, serialized response pb out.
+  // A non-zero grpc-status comes back as an Error carrying the server's
+  // grpc-message.  `timeout_us` is both the socket deadline and the
+  // `grpc-timeout` header (server-side deadline propagation).  `timers`
+  // (optional) gets SEND_START/SEND_END/RECV_START/RECV_END stamps.
+  Error UnaryCall(
+      const std::string& path, const std::string& request,
+      const Headers& metadata, std::string* response,
+      uint64_t timeout_us = 0, RequestTimers* timers = nullptr);
+
+  // ---- bidi stream (single stream per connection) ----
+  Error StartStream(const std::string& path, const Headers& metadata);
+  // Send one gRPC message (length-prefixed DATA). Thread-safe vs reads.
+  Error StreamWrite(const std::string& message);
+  // Half-close (END_STREAM on an empty DATA frame).
+  Error StreamWritesDone();
+  // Next response message; *done=true once the server closed the stream
+  // (the returned Error is then the final grpc-status).  Call from a single
+  // reader thread.
+  Error StreamRead(std::string* message, bool* done);
+
+  void Close();
+
+ private:
+  struct FrameHdr {
+    uint32_t len = 0;
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t stream_id = 0;
+  };
+  struct CallState {
+    uint32_t stream_id = 0;
+    Headers headers;          // response headers + trailers, merged
+    std::string data;         // raw DATA bytes (gRPC-framed messages)
+    std::string header_block; // accumulating HEADERS/CONTINUATION fragments
+    bool headers_done = false;
+    bool end_stream = false;
+    bool reset = false;
+    uint32_t reset_code = 0;
+  };
+
+  Error SendFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                  const std::string& payload);
+  Error ReadFrameHdr(FrameHdr* hdr, const sockio::Deadline& dl);
+  Error ProcessOneFrame(CallState* call, const sockio::Deadline& dl);
+  Error SendHeaders(const std::string& path, const Headers& metadata,
+                    uint32_t stream_id, uint64_t timeout_us, bool end_stream);
+  Error SendGrpcMessage(const std::string& message, CallState* call,
+                        bool end_stream, const sockio::Deadline& dl);
+  Error InflateHeaderBlock(const std::string& block, Headers* out);
+  static Error GrpcStatusToError(const Headers& h);
+  Error ReplenishRecvWindow(uint32_t stream_id, size_t consumed);
+
+  int fd_ = -1;
+  std::mutex write_mu_;  // interleaved frame writes (stream reader ACKs)
+  void* inflater_ = nullptr;
+  uint32_t next_stream_id_ = 1;
+  // flow control (RFC 7540 §6.9): our send budget, replenished by the peer
+  long long conn_send_window_ = 65535;
+  long long stream_send_window_ = 65535;   // current stream's budget
+  uint32_t peer_initial_window_ = 65535;
+  uint32_t peer_max_frame_ = 16384;
+  size_t max_response_bytes_ = 0;
+  // our receive budget: advertised big, replenished as data is consumed
+  long long conn_recv_consumed_ = 0;
+  std::mutex state_mu_;
+  std::condition_variable window_cv_;
+  // active bidi stream
+  CallState stream_call_;
+  bool stream_active_ = false;
+  size_t stream_read_pos_ = 0;
+};
+
+}  // namespace client
+}  // namespace tc_tpu

@@ -1,0 +1,191 @@
+// Shared low-level socket I/O helpers (deadline-aware connect/read/write),
+// used by the HTTP/1.1 transport (transport.cc) and the HTTP/2 gRPC layer
+// (h2.cc).  Header-only; everything lives in tc_tpu::client::sockio.
+#pragma once
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <string>
+
+#include "common.h"
+
+namespace tc_tpu {
+namespace client {
+namespace sockio {
+
+// Total-transfer deadline (reference CURLOPT_TIMEOUT_MS semantics: one
+// clock covers connect + send + receive).  DNS resolution is the one step
+// not covered (getaddrinfo has no timeout hook); clients talk to
+// localhost/IPs in practice.
+struct Deadline {
+  bool enabled = false;
+  std::chrono::steady_clock::time_point at{};
+
+  static Deadline In(uint64_t us) {
+    Deadline d;
+    if (us > 0) {
+      d.enabled = true;
+      d.at = std::chrono::steady_clock::now() + std::chrono::microseconds(us);
+    }
+    return d;
+  }
+
+  long long RemainingUs() const {
+    if (!enabled) return -1;
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               at - std::chrono::steady_clock::now())
+        .count();
+  }
+};
+
+inline void SetSocketTimeout(int fd, int option, long long timeout_us) {
+  struct timeval tv;
+  tv.tv_sec = static_cast<time_t>(timeout_us / 1000000);
+  tv.tv_usec = static_cast<suseconds_t>(timeout_us % 1000000);
+  if (timeout_us > 0 && tv.tv_sec == 0 && tv.tv_usec == 0) tv.tv_usec = 1;
+  ::setsockopt(fd, SOL_SOCKET, option, &tv, sizeof(tv));
+}
+
+// recv against the deadline: >0 bytes, 0 EOF, -1 socket error, -2 expired.
+inline ssize_t RecvDl(int fd, char* buf, size_t n, const Deadline& dl) {
+  if (dl.enabled) {
+    long long rem = dl.RemainingUs();
+    if (rem <= 0) return -2;
+    SetSocketTimeout(fd, SO_RCVTIMEO, rem);
+  }
+  ssize_t r = ::recv(fd, buf, n, 0);
+  if (r < 0 && dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+    return -2;
+  }
+  return r;
+}
+
+// 0 ok, -1 error/EOF, -2 deadline expired.
+inline int ReadExactDl(int fd, char* buf, size_t n, const Deadline& dl) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = RecvDl(fd, buf + got, n - got, dl);
+    if (r == -2) return -2;
+    if (r <= 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+inline int WriteAllDl(int fd, const char* buf, size_t n, const Deadline& dl) {
+  size_t sent = 0;
+  while (sent < n) {
+    if (dl.enabled) {
+      long long rem = dl.RemainingUs();
+      if (rem <= 0) return -2;
+      SetSocketTimeout(fd, SO_SNDTIMEO, rem);
+    }
+    ssize_t w = ::send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return -2;
+      }
+      return -1;
+    }
+    sent += static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+inline bool WriteAll(int fd, const char* buf, size_t n) {
+  return WriteAllDl(fd, buf, n, Deadline()) == 0;
+}
+
+// Resolve + connect (poll-based so the deadline covers it) + TCP_NODELAY;
+// returns -1 with *err set on failure.
+inline int ConnectTcp(
+    const std::string& host, int port, Error* err,
+    const Deadline& dl = Deadline()) {
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char port_str[16];
+  snprintf(port_str, sizeof(port_str), "%d", port);
+  int rc = ::getaddrinfo(host.c_str(), port_str, &hints, &res);
+  if (rc != 0) {
+    *err = Error(std::string("failed to resolve host: ") + gai_strerror(rc));
+    return -1;
+  }
+  int fd = -1;
+  bool timed_out = false;
+  for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family,
+                  ai->ai_socktype | SOCK_NONBLOCK, ai->ai_protocol);
+    if (fd < 0) continue;
+    int crc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (crc != 0 && errno == EINPROGRESS) {
+      long long rem = dl.enabled ? dl.RemainingUs() : -1;
+      if (dl.enabled && rem <= 0) {
+        timed_out = true;
+        ::close(fd);
+        fd = -1;
+        break;
+      }
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      int prc = ::poll(&pfd, 1, dl.enabled ? static_cast<int>(rem / 1000 + 1)
+                                           : -1);
+      int so_err = 0;
+      socklen_t len = sizeof(so_err);
+      if (prc > 0 &&
+          ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &so_err, &len) == 0 &&
+          so_err == 0) {
+        crc = 0;
+      } else {
+        if (prc == 0) timed_out = true;
+        crc = -1;
+      }
+    }
+    if (crc == 0) {
+      // restore blocking mode for the request I/O
+      int flags = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+      break;
+    }
+    ::close(fd);
+    fd = -1;
+    if (timed_out) break;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) {
+    *err = Error(
+        timed_out ? "Deadline Exceeded: timed out connecting to " + host +
+                        ":" + port_str
+                  : "failed to connect to " + host + ":" + port_str);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// TCP keepalive probes (gRPC keepalive-ping translation; see
+// HttpTransport::SetTcpKeepAlive).
+inline void EnableTcpKeepAlive(int fd, int idle_s, int intvl_s) {
+  if (idle_s <= 0) return;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle_s, sizeof(idle_s));
+  if (intvl_s > 0) {
+    ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl_s, sizeof(intvl_s));
+  }
+}
+
+}  // namespace sockio
+}  // namespace client
+}  // namespace tc_tpu

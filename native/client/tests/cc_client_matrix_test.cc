@@ -85,6 +85,10 @@ void CheckSum(tc::InferResult* r, const std::vector<int32_t>& in0,
 }
 
 // -- compression round trips (reference http_client.cc CompressInput) -----
+// gRPC endpoint for gRPC clients (real h2c port when given; the
+// grpc-web bridge on the HTTP port otherwise)
+std::string g_grpc_url;
+
 void TestHttpCompression(const std::string& url) {
   std::unique_ptr<tc::InferenceServerHttpClient> client;
   CHECK_OK(tc::InferenceServerHttpClient::Create(&client, url));
@@ -111,7 +115,7 @@ void TestReuseInferObjects(const std::string& url) {
   std::unique_ptr<tc::InferenceServerHttpClient> hc;
   std::unique_ptr<tc::InferenceServerGrpcClient> gc;
   CHECK_OK(tc::InferenceServerHttpClient::Create(&hc, url));
-  CHECK_OK(tc::InferenceServerGrpcClient::Create(&gc, url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&gc, g_grpc_url));
   auto in0 = Iota16();
   std::vector<int32_t> in1(16, 5);
   std::vector<tc::InferInput*> inputs;
@@ -141,9 +145,9 @@ void TestReuseInferObjects(const std::string& url) {
 }
 
 // -- model control with config override (reference cc_client_test:1202) ---
-void TestModelControl(const std::string& url) {
+void TestModelControl() {
   std::unique_ptr<tc::InferenceServerGrpcClient> client;
-  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, g_grpc_url));
   bool ready = false;
   CHECK_OK(client->IsModelReady(&ready, "identity_fp32"));
   CHECK_TRUE(ready);
@@ -204,9 +208,9 @@ void TestStringShm(const std::string& url) {
 }
 
 // -- xla-shm offset/status matrix (reference cudashm tests) ---------------
-void TestXlaShmMatrix(const std::string& url) {
+void TestXlaShmMatrix() {
   std::unique_ptr<tc::InferenceServerGrpcClient> client;
-  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, g_grpc_url));
   const size_t bytes = 64 * sizeof(float);
   tc::XlaShmHandle in_h, out_h;
   CHECK_OK(tc::CreateXlaSharedMemoryRegion(&in_h, "mx_in", bytes, 0));
@@ -264,9 +268,9 @@ void TestXlaShmMatrix(const std::string& url) {
 }
 
 // -- decoupled stream: N responses per request (reference repeat) ---------
-void TestDecoupledRepeat(const std::string& url) {
+void TestDecoupledRepeat() {
   std::unique_ptr<tc::InferenceServerGrpcClient> client;
-  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, g_grpc_url));
   std::mutex mu;
   std::condition_variable cv;
   std::vector<int32_t> outs;
@@ -419,9 +423,9 @@ void TestSequenceHttpSync(const std::string& url) {
 }
 
 // -- client stat accounting (reference InferStat/UpdateInferStat) ---------
-void TestInferStatAccounting(const std::string& url) {
+void TestInferStatAccounting() {
   std::unique_ptr<tc::InferenceServerGrpcClient> client;
-  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, g_grpc_url));
   tc::InferStat before, after;
   CHECK_OK(client->ClientInferStat(&before));
   auto in0 = Iota16();
@@ -450,7 +454,7 @@ void TestInferStatAccounting(const std::string& url) {
 // -- channel options: keepalive + message-size caps (reference
 // KeepAliveOptions grpc_client.h:62-86, grpc::ChannelArguments usage in
 // simple_grpc_custom_args_client.cc) --------------------------------------
-void TestChannelOptions(const std::string& url) {
+void TestChannelOptions() {
   // keepalive-configured client behaves identically for unary RPCs
   {
     tc::KeepAliveOptions ka;
@@ -458,7 +462,7 @@ void TestChannelOptions(const std::string& url) {
     ka.keepalive_timeout_ms = 1000;
     ka.keepalive_permit_without_calls = true;
     std::unique_ptr<tc::InferenceServerGrpcClient> client;
-    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url, false, ka));
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, g_grpc_url, false, ka));
     auto in0 = Iota16();
     std::vector<int32_t> in1(16, 1);
     std::vector<tc::InferInput*> inputs;
@@ -475,7 +479,7 @@ void TestChannelOptions(const std::string& url) {
     tc::ChannelArguments args;
     args.SetMaxReceiveMessageSize(cap);
     std::unique_ptr<tc::InferenceServerGrpcClient> client;
-    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url, args));
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, g_grpc_url, args));
     auto in0 = Iota16();
     std::vector<int32_t> in1(16, 1);
     std::vector<tc::InferInput*> inputs;
@@ -499,7 +503,7 @@ void TestChannelOptions(const std::string& url) {
     tc::ChannelArguments args;
     args.SetMaxSendMessageSize(16);
     std::unique_ptr<tc::InferenceServerGrpcClient> client;
-    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url, args));
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, g_grpc_url, args));
     auto in0 = Iota16();
     std::vector<int32_t> in1(16, 1);
     std::vector<tc::InferInput*> inputs;
@@ -518,7 +522,7 @@ void TestChannelOptions(const std::string& url) {
     tc::KeepAliveOptions ka;
     ka.keepalive_time_ms = 5000;
     std::unique_ptr<tc::InferenceServerGrpcClient> client;
-    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url, false, ka));
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, g_grpc_url, false, ka));
     std::mutex mu;
     std::condition_variable cv;
     std::vector<int32_t> got;
@@ -564,20 +568,22 @@ void TestChannelOptions(const std::string& url) {
 
 int main(int argc, char** argv) {
   if (argc < 2) {
-    fprintf(stderr, "usage: %s <http_host:port>\n", argv[0]);
+    fprintf(stderr, "usage: %s <http_host:port> [grpc_host:port]\n",
+            argv[0]);
     return 2;
   }
   const std::string url = argv[1];
-  TestChannelOptions(url);
+  g_grpc_url = argc > 2 ? argv[2] : argv[1];
+  TestChannelOptions();
   TestHttpCompression(url);
   TestReuseInferObjects(url);
-  TestModelControl(url);
+  TestModelControl();
   TestStringShm(url);
-  TestXlaShmMatrix(url);
-  TestDecoupledRepeat(url);
+  TestXlaShmMatrix();
+  TestDecoupledRepeat();
   TestMultiBroadcast(url);
   TestSequenceHttpSync(url);
-  TestInferStatAccounting(url);
+  TestInferStatAccounting();
   printf("PASS: all\n");
   return 0;
 }

@@ -521,13 +521,15 @@ void TestJson() {
 
 int main(int argc, char** argv) {
   if (argc < 2) {
-    fprintf(stderr, "usage: %s <http_host:port>\n", argv[0]);
+    fprintf(stderr, "usage: %s <http_host:port> [grpc_host:port]\n",
+            argv[0]);
     return 2;
   }
   TestJson();
   TestHttp(argv[1]);
-  // gRPC-web rides the same HTTP port (server bridge)
-  TestGrpc(argv[1]);
+  // real gRPC (h2c) when a gRPC port is given; the grpc-web bridge rides
+  // the HTTP port otherwise (the client auto-detects either way)
+  TestGrpc(argc > 2 ? argv[2] : argv[1]);
   printf("PASS: all\n");
   return 0;
 }

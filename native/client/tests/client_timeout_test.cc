@@ -212,6 +212,7 @@ int main(int argc, char** argv) {
     return 2;
   }
   const std::string url = argv[1];
+  const std::string grpc_url = argc > 2 ? argv[2] : argv[1];
 
   {
     std::unique_ptr<tc::InferenceServerHttpClient> client;
@@ -226,7 +227,7 @@ int main(int argc, char** argv) {
   }
   {
     std::unique_ptr<tc::InferenceServerGrpcClient> client;
-    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, url));
+    CHECK_OK(tc::InferenceServerGrpcClient::Create(&client, grpc_url));
     TestSyncTimeout(client.get());
     TestAsyncTimeout(client.get());
     TestGenerousDeadlineSucceeds(client.get());

@@ -164,11 +164,12 @@ int main(int argc, char** argv) {
   }
   const std::string url = argv[1];
   const int iterations = argc > 2 ? atoi(argv[2]) : 500;
+  const std::string grpc_url = argc > 3 ? argv[3] : url;
 
   std::unique_ptr<tc::InferenceServerHttpClient> http_client;
   CHECK_OK(tc::InferenceServerHttpClient::Create(&http_client, url));
   std::unique_ptr<tc::InferenceServerGrpcClient> grpc_client;
-  CHECK_OK(tc::InferenceServerGrpcClient::Create(&grpc_client, url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&grpc_client, grpc_url));
 
   // warm up: connection pools, lazily-spawned worker threads, allocator
   RunIterations(http_client.get(), 50);

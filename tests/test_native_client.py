@@ -92,8 +92,17 @@ def test_cpp_http_example(native_build, harness, example):
     "simple_grpc_generate_client",
 ])
 def test_cpp_grpc_example(native_build, harness, example):
-    # the C++ gRPC client rides the grpc-web bridge on the HTTP port
+    # the stock gRPC port: the client's h2c prior-knowledge probe speaks
+    # real HTTP/2 gRPC here (no bridge involved)
     out = _run(os.path.join(native_build, example),
+               f"127.0.0.1:{harness.grpc_port}")
+    assert "PASS" in out
+
+
+def test_cpp_grpc_example_web_bridge_fallback(native_build, harness):
+    # pointing the same client at the HTTP port auto-falls back to
+    # gRPC-Web framing through the bridge
+    out = _run(os.path.join(native_build, "simple_grpc_infer_client"),
                f"127.0.0.1:{harness.http_port}")
     assert "PASS" in out
 
@@ -105,11 +114,14 @@ def test_cpp_grpc_example(native_build, harness, example):
     "memory_leak_test",
 ])
 def test_native_test_binary(native_build, harness, binary):
-    # each takes the url positionally: `<binary> <http_host:port>`
-    proc = subprocess.run(
-        [os.path.join(native_build, binary),
-         f"127.0.0.1:{harness.http_port}"],
-        capture_output=True, text=True, timeout=240)
+    # `<binary> <http_host:port> [...] [grpc_host:port]` — gRPC clients in
+    # the binaries hit the real h2c port, HTTP clients the HTTP port
+    args = [os.path.join(native_build, binary),
+            f"127.0.0.1:{harness.http_port}"]
+    if binary == "memory_leak_test":
+        args.append("500")
+    args.append(f"127.0.0.1:{harness.grpc_port}")
+    proc = subprocess.run(args, capture_output=True, text=True, timeout=240)
     assert proc.returncode == 0, (
         f"{binary} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
     assert "FAILED" not in proc.stdout
