@@ -132,9 +132,11 @@ def main():
     print("row 4: bert_large (streaming gRPC + xla shm)", flush=True)
     if not args.smoke:
         _warm(warm_client, httpclient, "bert_large", "INPUT_IDS",
-              (language.BERT_SEQ_LEN,), np.int32, [1, 2, 4, 8])
+              (language.BERT_SEQ_LEN,), np.int32, [1, 2, 4, 8, 16, 32])
+        # concurrency must reach max_batch_size (32) for the dynamic
+        # batcher to build MFU-deep batches
         results["row4_bert_stream_xlashm"] = sweep(
-            "bert_large", [1, 4, 8], shm="xla", streaming=True)
+            "bert_large", [8, 16, 32], shm="xla", streaming=True)
         best = results["row4_bert_stream_xlashm"]["best"]
         flops = language.forward_flops_per_token(
             language.BERT_LARGE, language.BERT_SEQ_LEN)

@@ -61,14 +61,12 @@ def gen_loop(grpc_url, grpcclient, S, seq_id, prompt, steps):
     return lats
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--smoke", action="store_true",
-                    help="tiny preset + short loops (CPU CI)")
-    args = ap.parse_args()
-    if args.smoke:
-        os.environ.setdefault("TRITON_TPU_LLAMA_PRESET", "tiny")
-
+def measure_mode(mode, args, slots, chunk):
+    """One harness per decode mode (DecodeModel reads the env at init)."""
+    os.environ["TRITON_TPU_DECODE_MODE"] = mode
+    os.environ["TRITON_TPU_DECODE_SLOTS"] = str(slots)
+    os.environ["TRITON_TPU_PREFILL_CHUNK"] = str(chunk if mode == "batched"
+                                                 else 0)
     from triton_client_tpu.models import language, zoo
     from triton_client_tpu.server.registry import ModelRegistry
     from triton_client_tpu.server.testing import ServerHarness
@@ -79,57 +77,91 @@ def main():
     h = ServerHarness(registry)
     h.start()
     S = language.LLAMA_SEQ_LEN
-    results = {}
+    out = {"mode": mode, "slots": slots,
+           "prefill_chunk": chunk if mode == "batched" else 0}
     try:
         # serial (first sequence pays prefill+step compiles; timing uses
         # per-step latencies, not the compile)
         steps = 4 if args.smoke else 24
-        lats = gen_loop(h.grpc_url, grpcclient, S, 700,
-                        b"In a hole in the ground there lived", steps)
+        gen_loop(h.grpc_url, grpcclient, S, 700,
+                 b"In a hole in the ground there lived", steps)
         lats = gen_loop(h.grpc_url, grpcclient, S, 701,
                         b"It was the best of times", steps)  # warm pass
-        results["serial"] = {
+        out["serial"] = {
             "tokens_per_sec": 1.0 / float(np.mean(lats)),
             "ms_per_token_p50": float(np.percentile(lats, 50) * 1e3),
         }
-        print(f"serial: {results['serial']['tokens_per_sec']:.2f} tok/s, "
-              f"p50 {results['serial']['ms_per_token_p50']:.0f} ms/token",
+        print(f"[{mode}] serial: "
+              f"{out['serial']['tokens_per_sec']:.2f} tok/s, p50 "
+              f"{out['serial']['ms_per_token_p50']:.0f} ms/token",
               flush=True)
 
-        n_streams = 2 if args.smoke else 8
         conc_steps = 4 if args.smoke else 16
-        errors = []
+        out["concurrent"] = []
+        for n_streams in args.streams:
+            if n_streams > slots and mode == "batched":
+                # starts beyond the slot pool are rejected; skip
+                continue
+            errors = []
 
-        def worker(w):
-            try:
-                gen_loop(h.grpc_url, grpcclient, S, 800 + w,
-                         f"stream {w}: in the beginning".encode(), conc_steps)
-            except Exception as exc:  # noqa: BLE001 — surfaced after join
-                errors.append((w, exc))
+            def worker(w):
+                try:
+                    gen_loop(h.grpc_url, grpcclient, S, 800 + w,
+                             f"stream {w}: in the beginning".encode(),
+                             conc_steps)
+                except Exception as exc:  # noqa: BLE001 — after join
+                    errors.append((w, exc))
 
-        t0 = time.time()
-        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
-                   for w in range(n_streams)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=2400)
-        if errors:
-            raise RuntimeError(f"decode workers failed: {errors}")
-        if any(t.is_alive() for t in threads):
-            raise RuntimeError("decode worker hung")
-        wall = time.time() - t0
-        total = n_streams * (conc_steps + 1)  # +1 = prefill's first token
-        results["concurrent"] = {
-            "streams": n_streams,
-            "tokens_per_sec": total / wall,
-        }
-        print(f"x{n_streams} streams: {total / wall:.1f} tok/s aggregate",
-              flush=True)
+            t0 = time.time()
+            threads = [threading.Thread(target=worker, args=(w,),
+                                        daemon=True)
+                       for w in range(n_streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=2400)
+            if errors:
+                raise RuntimeError(f"decode workers failed: {errors}")
+            if any(t.is_alive() for t in threads):
+                raise RuntimeError("decode worker hung")
+            wall = time.time() - t0
+            total = n_streams * (conc_steps + 1)  # +1 = prefill's token
+            out["concurrent"].append(
+                {"streams": n_streams, "tokens_per_sec": total / wall})
+            print(f"[{mode}] x{n_streams} streams: {total / wall:.1f} "
+                  f"tok/s aggregate", flush=True)
     finally:
         h.stop()
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "DECODE_RESULTS.json")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny preset + short loops (CPU CI)")
+    ap.add_argument("--modes", nargs="+",
+                    default=["independent", "batched"],
+                    choices=["independent", "batched"])
+    ap.add_argument("--streams", nargs="+", type=int, default=None,
+                    help="concurrency sweep (default 8 16 32; smoke: 2)")
+    ap.add_argument("--slots", type=int, default=32,
+                    help="decode slots for batched mode")
+    ap.add_argument("--chunk", type=int, default=32,
+                    help="prefill chunk tokens for batched mode (0=off)")
+    args = ap.parse_args()
+    if args.smoke:
+        os.environ.setdefault("TRITON_TPU_LLAMA_PRESET", "tiny")
+        if args.streams is None:
+            args.streams = [2]
+        args.slots = min(args.slots, 4)
+    elif args.streams is None:
+        args.streams = [8, 16, 32]
+
+    results = {"sweep": [measure_mode(m, args, args.slots, args.chunk)
+                         for m in args.modes]}
+    # smoke output must never clobber a real TPU measurement
+    name = "DECODE_RESULTS_SMOKE.json" if args.smoke else "DECODE_RESULTS.json"
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
     with open(out, "w") as f:
         json.dump(results, f, indent=1)
     print(f"wrote {out}")

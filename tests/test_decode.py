@@ -140,11 +140,14 @@ class TestSlotKernels:
         for tick in range(3):
             b_active = tick != 1
             tokens = np.zeros(self.N_SLOTS, np.int32)
+            active = np.zeros(self.N_SLOTS, bool)
             tokens[0] = got_a[-1]
+            active[0] = True
             if b_active:
                 tokens[1] = got_b[-1]
+                active[1] = True
             nxt, best, k, v = sstep(params, k, v, jnp.asarray(tokens),
-                                    jnp.asarray(pos))
+                                    jnp.asarray(pos), jnp.asarray(active))
             got_a.append(int(nxt[0]))
             pos[0] += 1
             if b_active:
@@ -373,10 +376,12 @@ class TestMoeDecode:
         got = [int(nxt)]
         pos = np.array([6, 0], np.int32)
         toks = np.zeros(n_slots, np.int32)
+        act = np.array([True, False])
         for _ in range(3):
             toks[0] = got[-1]
             nxts, bests, k, v = slot_step(
-                moe_params, k, v, jnp.asarray(toks), jnp.asarray(pos))
+                moe_params, k, v, jnp.asarray(toks), jnp.asarray(pos),
+                jnp.asarray(act))
             got.append(int(nxts[0]))
             pos[0] += 1
         assert got == want
@@ -398,10 +403,12 @@ class TestBatchedMode:
     """Slot-batched continuous decoding (TRITON_TPU_DECODE_MODE=batched):
     driven at the model level so the default-mode harness is untouched."""
 
-    @pytest.fixture()
-    def model(self, monkeypatch):
+    @pytest.fixture(params=["0", "32"], ids=["fullprefill", "chunk32"])
+    def model(self, monkeypatch, request):
         monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
         monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        # chunked prefill must be behaviorally identical to full prefill
+        monkeypatch.setenv("TRITON_TPU_PREFILL_CHUNK", request.param)
         from triton_client_tpu.models.decode import DecodeModel
 
         m = DecodeModel(name="llama_decode_batched_test")
@@ -481,6 +488,99 @@ class TestBatchedMode:
         with pytest.raises(InferError, match="unloading"):
             model._execute({"TOKENS": np.array([1], np.int32)},
                            {"sequence_id": 3500})
+
+
+class TestChunkedPrefill:
+    """make_slot_chunk_prefill: chunked == full-prompt slot prefill."""
+
+    @pytest.mark.parametrize("chunk", [1, 4, 8, 16])
+    def test_chunks_match_full_prefill(self, params, chunk):
+        rng = np.random.default_rng(11)
+        prompt = jnp.asarray(rng.integers(0, 64, (1, 16)), jnp.int32)
+        n_slots, slot = 3, 1
+        shape = (CFG.n_layers, n_slots, CFG.n_heads, S_MAX, CFG.head_dim)
+
+        full = decode.make_slot_prefill(CFG, S_MAX)
+        k0 = jnp.zeros(shape, CFG.dtype)
+        v0 = jnp.zeros(shape, CFG.dtype)
+        want_tok, want_best, want_k, want_v = full(params, k0, v0, prompt,
+                                                  slot)
+
+        cp = decode.make_slot_chunk_prefill(CFG, S_MAX)
+        k = jnp.zeros(shape, CFG.dtype)
+        v = jnp.zeros(shape, CFG.dtype)
+        for pos0 in range(0, 16, chunk):
+            tok, best, k, v = cp(params, k, v,
+                                 prompt[:, pos0:pos0 + chunk], slot, pos0)
+        assert int(tok) == int(want_tok)
+        np.testing.assert_allclose(float(best), float(want_best),
+                                   rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(
+            np.asarray(k[:, slot], np.float32),
+            np.asarray(want_k[:, slot], np.float32), rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(
+            np.asarray(v[:, slot], np.float32),
+            np.asarray(want_v[:, slot], np.float32), rtol=2e-2, atol=2e-2)
+
+    def test_interleaved_tick_does_not_corrupt_prefilling_slot(self,
+                                                               params):
+        """A decode tick between two prefill chunks must leave the
+        prefilling slot's cache intact (inactive slots don't write — the
+        stale-pos write used to clobber the entry chunk 0 wrote)."""
+        rng = np.random.default_rng(13)
+        win_a = jnp.asarray(rng.integers(0, 64, (1, 8)), jnp.int32)
+        win_b = jnp.asarray(rng.integers(0, 64, (1, 8)), jnp.int32)
+
+        prefill = decode.make_prefill(CFG, S_MAX)
+        step1 = decode.make_decode_step(CFG)
+        logits, cache = prefill(params, win_b)
+        want_b = [int(jnp.argmax(logits[0]))]
+        for _ in range(2):
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits, cache = step1(params, cache, nxt[:, None])
+            want_b.append(int(jnp.argmax(logits[0])))
+
+        n_slots = 2
+        shape = (CFG.n_layers, n_slots, CFG.n_heads, S_MAX, CFG.head_dim)
+        sprefill = decode.make_slot_prefill(CFG, S_MAX)
+        sstep = decode.make_slot_step(CFG)
+        cp = decode.make_slot_chunk_prefill(CFG, S_MAX)
+        k = jnp.zeros(shape, CFG.dtype)
+        v = jnp.zeros(shape, CFG.dtype)
+        ta, _, k, v = sprefill(params, k, v, win_a, 0)
+        pos = np.array([8, 0], np.int32)
+        # chunk 0 of B's prefill into slot 1...
+        _, _, k, v = cp(params, k, v, win_b[:, :4], 1, 0)
+        # ...then A ticks while B is mid-prefill (B inactive, pos[1]=0)
+        nxt, _, k, v = sstep(params, k, v,
+                             jnp.asarray(np.array([int(ta), 0], np.int32)),
+                             jnp.asarray(pos),
+                             jnp.asarray(np.array([True, False])))
+        pos[0] += 1
+        # B's final chunk, then B decodes
+        tb, _, k, v = cp(params, k, v, win_b[:, 4:], 1, 4)
+        got_b = [int(tb)]
+        pos[1] = 8
+        for _ in range(2):
+            toks = np.array([int(nxt[0]), got_b[-1]], np.int32)
+            nxt, _, k, v = sstep(params, k, v, jnp.asarray(toks),
+                                 jnp.asarray(pos),
+                                 jnp.asarray(np.array([True, True])))
+            got_b.append(int(nxt[1]))
+            pos += 1
+        assert got_b == want_b
+
+    def test_other_slots_untouched(self, params):
+        rng = np.random.default_rng(12)
+        prompt = jnp.asarray(rng.integers(0, 64, (1, 8)), jnp.int32)
+        n_slots = 2
+        shape = (CFG.n_layers, n_slots, CFG.n_heads, S_MAX, CFG.head_dim)
+        cp = decode.make_slot_chunk_prefill(CFG, S_MAX)
+        k = jnp.ones(shape, CFG.dtype)
+        v = jnp.ones(shape, CFG.dtype)
+        _, _, k, v = cp(params, k, v, prompt, 1, 0)
+        np.testing.assert_array_equal(np.asarray(k[:, 0], np.float32), 1.0)
+        np.testing.assert_array_equal(np.asarray(v[:, 0], np.float32), 1.0)
 
 
 class TestMoePresetServing:

@@ -320,20 +320,29 @@ def _rope_at(x, pos, theta):
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _slot_decode_layer(blk, x, kc, vc, pos, cfg: tr.TransformerConfig):
+def _slot_decode_layer(blk, x, kc, vc, pos, active,
+                       cfg: tr.TransformerConfig):
     """One token per slot, each at its own position.
 
-    x: [B, 1, D]; kc/vc: [B, H, S_max, K]; pos: [B]."""
+    x: [B, 1, D]; kc/vc: [B, H, S_max, K]; pos: [B]; active: [B] bool.
+    Only ACTIVE slots write their K/V — an inactive slot (no pending
+    request this tick, or mid-chunked-prefill) must not clobber cache
+    entries at its stale position (a chunked prefill interleaves decode
+    ticks between chunks; a stale write at pos 0 would corrupt the entry
+    chunk 0 wrote)."""
     q, k, v = _project_qkv(blk, x, cfg)
     q = _rope_at(q, pos, cfg.rope_theta)
     k = _rope_at(k, pos, cfg.rope_theta)
 
-    def write(cache_row, new_row, p):
-        return lax.dynamic_update_slice_in_dim(
-            cache_row, new_row, p, axis=1)  # [H, S, K] <- [H, 1, K] at p
+    def write(cache_row, new_row, p, a):
+        cur = lax.dynamic_slice(
+            cache_row, (0, p, 0), (cache_row.shape[0], 1,
+                                   cache_row.shape[2]))
+        val = jnp.where(a, new_row, cur)  # inactive: write back current
+        return lax.dynamic_update_slice(cache_row, val, (0, p, 0))
 
-    kc = jax.vmap(write)(kc, k.astype(kc.dtype), pos)
-    vc = jax.vmap(write)(vc, v.astype(vc.dtype), pos)
+    kc = jax.vmap(write)(kc, k.astype(kc.dtype), pos, active)
+    vc = jax.vmap(write)(vc, v.astype(vc.dtype), pos, active)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     s = jnp.einsum("bhqk,bhsk->bhqs", q.astype(jnp.float32),
                    kc.astype(jnp.float32)) * scale
@@ -346,22 +355,25 @@ def _slot_decode_layer(blk, x, kc, vc, pos, cfg: tr.TransformerConfig):
 
 
 def make_slot_step(cfg: tr.TransformerConfig):
-    """jitted (params, k [L,B,H,S,K], v, tokens [B], pos [B]) ->
-    (greedy tokens [B] int32, best logits [B] f32, k', v').
+    """jitted (params, k [L,B,H,S,K], v, tokens [B], pos [B],
+    active [B] bool) -> (greedy tokens [B] int32, best logits [B] f32,
+    k', v').
 
-    Every slot advances one position — callers ignore outputs and do not
-    advance the host-side pos for slots with no pending request (their
-    stale-position cache write is overwritten by the next real token)."""
+    Every slot computes, but only ACTIVE slots write K/V — inactive slots
+    (no pending request this tick, or mid-chunked-prefill) leave the cache
+    untouched; callers ignore their outputs and do not advance their
+    host-side pos."""
 
     @jax.jit
-    def step(params, k, v, tokens, pos):
+    def step(params, k, v, tokens, pos, active):
         x = jnp.take(params["embed"].astype(cfg.dtype),
                      tokens[:, None], axis=0)                     # [B,1,D]
         blocks = _layer_blocks(params, cfg)
 
         def layer(x, xs):
             blk, kc, vc = xs
-            x, kc, vc = _slot_decode_layer(blk, x, kc, vc, pos, cfg)
+            x, kc, vc = _slot_decode_layer(blk, x, kc, vc, pos, active,
+                                           cfg)
             return x, (kc, vc)
 
         x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
@@ -401,6 +413,58 @@ def make_slot_prefill(cfg: tr.TransformerConfig, s_max: int):
         return nxt, best, k, v
 
     return prefill
+
+
+def make_slot_chunk_prefill(cfg: tr.TransformerConfig, s_max: int):
+    """jitted (params, k, v, chunk [1,C], slot, pos0) -> (next tok, best
+    logit, k', v') — prefills ONE CHUNK of a slot's prompt.
+
+    Chunked prefill is what lets new prompts interleave with decode ticks
+    instead of stalling the whole cohort for a full-prompt forward (the
+    genai-perf c=8 contention BASELINE row 8 measured): each chunk attends
+    to the cache prefix written by earlier chunks (positions < pos0) plus
+    causally within itself, exactly reproducing full-prompt prefill.  The
+    returned token/logit are meaningful on the FINAL chunk only."""
+
+    @jax.jit
+    def chunk_prefill(params, k, v, chunk, slot, pos0):
+        B, C = chunk.shape
+        S = k.shape[3]
+        x = jnp.take(params["embed"].astype(cfg.dtype), chunk, axis=0)
+        blocks = _layer_blocks(params, cfg)
+        positions = pos0 + jnp.arange(C)
+        # [C, S] mask: chunk position i sees cache entries j <= pos0 + i
+        valid = jnp.arange(S)[None, :] <= positions[:, None]
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+
+        def layer(x, xs):
+            blk, kc, vc = xs              # [n_slots, H, S, K]
+            q, kk, vv = _project_qkv(blk, x, cfg)
+            q, kk = tr._rope(q, kk, positions, cfg.rope_theta)
+            kc = lax.dynamic_update_slice(
+                kc, kk.astype(kc.dtype), (slot, 0, pos0, 0))
+            vc = lax.dynamic_update_slice(
+                vc, vv.astype(vc.dtype), (slot, 0, pos0, 0))
+            kcs = lax.dynamic_slice(
+                kc, (slot, 0, 0, 0), (1,) + kc.shape[1:])
+            vcs = lax.dynamic_slice(
+                vc, (slot, 0, 0, 0), (1,) + vc.shape[1:])
+            s = jnp.einsum("bhqk,bhsk->bhqs", q.astype(jnp.float32),
+                           kcs.astype(jnp.float32)) * scale
+            s = jnp.where(valid[None, None, :, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqs,bhsk->bhqk", p,
+                           vcs.astype(jnp.float32)).astype(x.dtype)
+            x = _attn_out(blk, x, o)
+            return _ffn(blk, x, cfg), (kc, vc)
+
+        x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
+        logits = _head(params, x, cfg)[:, -1]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+        best = jnp.max(logits, axis=-1).astype(jnp.float32)[0]
+        return nxt, best, ks, vs
+
+    return chunk_prefill
 
 
 class DecodeModel:
@@ -488,6 +552,8 @@ class DecodeModel:
         self._fns_ind = None
         self._params = None
         self._mesh = None
+        self._prefill_chunk = 0
+        self._chunk_fn = None
         self._jobs = None
         self._worker = None
         self._closed = False
@@ -549,6 +615,7 @@ class DecodeModel:
         if self._fns is None:
             with self._init_lock:
                 if self._fns is None:
+                    import os
                     import queue as _queue
 
                     import numpy as np
@@ -578,6 +645,20 @@ class DecodeModel:
                     self._worker = self._threading.Thread(
                         target=self._worker_loop, daemon=True,
                         name=f"{self._model.name}-decode-worker")
+                    # chunked prefill (TRITON_TPU_PREFILL_CHUNK tokens per
+                    # tick; 0 = whole-prompt): lets a new prompt interleave
+                    # with decode ticks instead of stalling the cohort
+                    chunk = int(os.environ.get("TRITON_TPU_PREFILL_CHUNK",
+                                               "0"))
+                    if chunk < 0 or (chunk and self._prompt_len % chunk):
+                        raise ValueError(
+                            f"TRITON_TPU_PREFILL_CHUNK={chunk} must be 0 "
+                            f"or a divisor of prompt_len="
+                            f"{self._prompt_len}")
+                    self._prefill_chunk = chunk
+                    self._chunk_fn = (
+                        make_slot_chunk_prefill(cfg, self._s_max)
+                        if chunk else None)
                     fns = (make_slot_prefill(cfg, self._s_max),
                            make_slot_step(cfg), params, cfg)
                     self._fns = fns
@@ -664,6 +745,21 @@ class DecodeModel:
                 if gen != self._slot_gen[slot]:
                     fail_stale(fut)
                     continue
+                C = self._prefill_chunk
+                if C and win.shape[1] > C:
+                    # chunked: run the first chunk now, re-enqueue the
+                    # continuation at the queue tail so pending decode
+                    # steps tick in between (no cohort-wide prefill stall)
+                    try:
+                        _, _, self._k, self._v = self._chunk_fn(
+                            params, self._k, self._v,
+                            jnp.asarray(win[:, :C]), slot, 0)
+                    except Exception as e:  # noqa: BLE001 — via future
+                        fut.set_exception(e)
+                        continue
+                    self._jobs.put(
+                        ("prefill_cont", (slot, gen, win, C), fut))
+                    continue
                 try:
                     nxt, best, self._k, self._v = prefill(
                         params, self._k, self._v, jnp.asarray(win), slot)
@@ -674,6 +770,27 @@ class DecodeModel:
                     self._readers.submit(self._resolve_prefill, pair, fut)
                 except Exception as e:  # noqa: BLE001 — surfaced via future
                     fut.set_exception(e)
+                continue
+            if kind == "prefill_cont":
+                slot, gen, win, pos0 = payload
+                if gen != self._slot_gen[slot]:
+                    fail_stale(fut)
+                    continue
+                C = self._prefill_chunk
+                try:
+                    nxt, best, self._k, self._v = self._chunk_fn(
+                        params, self._k, self._v,
+                        jnp.asarray(win[:, pos0:pos0 + C]), slot, pos0)
+                except Exception as e:  # noqa: BLE001 — via future
+                    fut.set_exception(e)
+                    continue
+                if pos0 + C < win.shape[1]:
+                    self._jobs.put(
+                        ("prefill_cont", (slot, gen, win, pos0 + C), fut))
+                    continue
+                self._pos[slot] = win.shape[1]
+                pair = jnp.stack([nxt.astype(jnp.float32), best])
+                self._readers.submit(self._resolve_prefill, pair, fut)
                 continue
             # Merge steps into this tick. A short accumulation window is
             # load-bearing: the previous tick resolves every stream's
@@ -718,12 +835,14 @@ class DecodeModel:
             if not batch:
                 continue
             tokens = np.zeros(self._n_slots, np.int32)
+            active = np.zeros(self._n_slots, bool)
             for (slot, tok), _ in batch:
                 tokens[slot] = tok
+                active[slot] = True
             try:
                 nxt, best, self._k, self._v = step(
                     params, self._k, self._v, jnp.asarray(tokens),
-                    jnp.asarray(self._pos))
+                    jnp.asarray(self._pos), jnp.asarray(active))
                 pair = jnp.stack([nxt.astype(jnp.float32), best])
                 for (slot, tok), _ in batch:
                     self._pos[slot] += 1

@@ -60,6 +60,10 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16  # activation/compute dtype
+    # attention direction: decoder stacks (llama/longctx) are causal;
+    # encoder stacks (bert_large) attend bidirectionally — both route
+    # through the same flash kernel / ring attention, which take `causal`
+    causal: bool = True
 
     @property
     def moe(self) -> bool:
@@ -287,9 +291,9 @@ def _rope(q, k, positions, theta):
 
 
 def _ring_attention(q, k, v, cfg: TransformerConfig):
-    """Causal ring attention over the ``sp`` axis
+    """Ring attention over the ``sp`` axis
     (parallel.collectives.ring_attention)."""
-    return parallel.ring_attention(q, k, v, "sp", causal=True)
+    return parallel.ring_attention(q, k, v, "sp", causal=cfg.causal)
 
 
 def _flash_enabled() -> bool:
@@ -310,7 +314,7 @@ def _attn_apply(blk, x, cfg: TransformerConfig):
         # (the TPU serving path for bert_large / llama_tpu)
         from ..ops import flash_attention
 
-        o = flash_attention(q, k, v, causal=True)
+        o = flash_attention(q, k, v, causal=cfg.causal)
     else:
         o = _ring_attention(q, k, v, cfg)
     out = jnp.einsum("bhsk,hkd->bsd", o, blk["wo"].astype(o.dtype))
