@@ -5,6 +5,8 @@
 // request/response.  Leak assertions via CudaSharedMemoryStatus mirror the
 // reference's allocated_shared_memory_regions checks.
 
+#include <algorithm>
+#include <chrono>
 #include <cstdio>
 #include <cstring>
 #include <string>
@@ -26,8 +28,11 @@ namespace tc = tc_tpu::client;
 
 int main(int argc, char** argv) {
   std::string url = "localhost:8000";
+  int bench_iters = 0;  // -n N: timed loop, prints p50/p99 (BASELINE row:
+                        // C++ xla-shm p50 parity with the Python path)
   for (int i = 1; i < argc - 1; ++i) {
     if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+    if (strcmp(argv[i], "-n") == 0) bench_iters = atoi(argv[i + 1]);
   }
   std::unique_ptr<tc::InferenceServerGrpcClient> client;
   FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url),
@@ -39,22 +44,27 @@ int main(int argc, char** argv) {
   constexpr size_t kCount = 16;
   constexpr size_t kBytes = kCount * sizeof(int32_t);
 
-  // input regions, written before registration (reference flow writes via
-  // cudaMemcpy then registers the ipc handle)
+  // input regions: tensors are built IN PLACE in the mapped region (no
+  // client-side memcpy) and published with Commit — the reference flow's
+  // cudaMemcpy+ipc-handle becomes write-in-place + generation bump, and
+  // the server caches its device import while the generation is unchanged
   int32_t input0[kCount], input1[kCount];
-  for (size_t i = 0; i < kCount; ++i) {
-    input0[i] = static_cast<int32_t>(i);
-    input1[i] = 1;
-  }
   tc::XlaShmHandle in0_h, in1_h, out0_h, out1_h;
   FAIL_IF_ERR(tc::CreateXlaSharedMemoryRegion(&in0_h, "input0_data", kBytes, 0),
               "create input0 region failed");
   FAIL_IF_ERR(tc::CreateXlaSharedMemoryRegion(&in1_h, "input1_data", kBytes, 0),
               "create input1 region failed");
-  FAIL_IF_ERR(tc::SetXlaSharedMemoryRegion(in0_h, input0, kBytes),
-              "set input0 failed");
-  FAIL_IF_ERR(tc::SetXlaSharedMemoryRegion(in1_h, input1, kBytes),
-              "set input1 failed");
+  void *in0_p, *in1_p;
+  FAIL_IF_ERR(tc::XlaSharedMemoryData(in0_h, &in0_p), "input0 data ptr");
+  FAIL_IF_ERR(tc::XlaSharedMemoryData(in1_h, &in1_p), "input1 data ptr");
+  for (size_t i = 0; i < kCount; ++i) {
+    input0[i] = static_cast<int32_t>(i);
+    input1[i] = 1;
+    static_cast<int32_t*>(in0_p)[i] = input0[i];
+    static_cast<int32_t*>(in1_p)[i] = input1[i];
+  }
+  FAIL_IF_ERR(tc::CommitXlaSharedMemoryRegion(in0_h), "commit input0");
+  FAIL_IF_ERR(tc::CommitXlaSharedMemoryRegion(in1_h), "commit input1");
   FAIL_IF_ERR(
       tc::CreateXlaSharedMemoryRegion(&out0_h, "output0_data", kBytes, 0),
       "create output0 region failed");
@@ -102,10 +112,15 @@ int main(int argc, char** argv) {
               "OUTPUT1 set shm failed");
 
   tc::InferOptions options("simple");
-  tc::InferResult* result = nullptr;
-  FAIL_IF_ERR(client->Infer(&result, options, {in0, in1}, {out0, out1}),
-              "inference failed");
-  delete result;
+  // two infers over the unchanged regions: the second is served from the
+  // server's cached device import (no host copy, no DMA — asserted by the
+  // harness-side stats in tests/test_native_client.py)
+  for (int rep = 0; rep < 2; ++rep) {
+    tc::InferResult* result = nullptr;
+    FAIL_IF_ERR(client->Infer(&result, options, {in0, in1}, {out0, out1}),
+                "inference failed");
+    delete result;
+  }
 
   // outputs land in the regions, not the response
   int32_t sum[kCount], diff[kCount];
@@ -119,6 +134,29 @@ int main(int argc, char** argv) {
               sum[i], diff[i]);
       return 1;
     }
+  }
+
+  if (bench_iters > 0) {
+    // timed closed loop over the unchanged regions: after the first
+    // import the server serves inputs from its cached device array, so
+    // per-iteration cost is request handling + execute + output D2H
+    std::vector<double> lat_ms;
+    lat_ms.reserve(bench_iters);
+    for (int it = 0; it < bench_iters; ++it) {
+      auto t0 = std::chrono::steady_clock::now();
+      tc::InferResult* r = nullptr;
+      FAIL_IF_ERR(client->Infer(&r, options, {in0, in1}, {out0, out1}),
+                  "bench inference failed");
+      delete r;
+      lat_ms.push_back(std::chrono::duration<double, std::milli>(
+                           std::chrono::steady_clock::now() - t0)
+                           .count());
+    }
+    std::sort(lat_ms.begin(), lat_ms.end());
+    printf("bench: %d iters, p50 %.3f ms, p99 %.3f ms\n", bench_iters,
+           lat_ms[lat_ms.size() / 2],
+           lat_ms[std::min(lat_ms.size() - 1,
+                           static_cast<size_t>(lat_ms.size() * 99 / 100))]);
   }
 
   FAIL_IF_ERR(client->UnregisterCudaSharedMemory(), "unregister failed");

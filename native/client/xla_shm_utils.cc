@@ -66,6 +66,35 @@ Error CreateXlaSharedMemoryRegion(
   }
   handle->shm_fd = fd;
   handle->base_addr = base;
+
+  // generation counter (8 bytes): bumped on every write/commit so the
+  // server's import cache knows when to re-read the staging bytes
+  handle->seq_key = handle->staging_key + "_seq";
+  int sfd = ::shm_open(
+      handle->seq_key.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (sfd < 0 || ::ftruncate(sfd, 8) != 0) {
+    Error err(
+        "failed to create seq region '" + handle->seq_key + "': " +
+        strerror(errno));
+    if (sfd >= 0) ::close(sfd);
+    ::shm_unlink(handle->seq_key.c_str());
+    DestroyXlaSharedMemoryRegion(handle);
+    return err;
+  }
+  void* sbase = ::mmap(nullptr, 8, PROT_READ | PROT_WRITE, MAP_SHARED,
+                       sfd, 0);
+  if (sbase == MAP_FAILED) {
+    Error err(
+        "failed to map seq region '" + handle->seq_key + "': " +
+        strerror(errno));
+    ::close(sfd);
+    ::shm_unlink(handle->seq_key.c_str());
+    DestroyXlaSharedMemoryRegion(handle);
+    return err;
+  }
+  handle->seq_fd = sfd;
+  handle->seq_addr = sbase;
+  *static_cast<uint64_t*>(sbase) = 0;
   return Error::Success;
 }
 
@@ -74,13 +103,13 @@ Error GetXlaSharedMemoryRawHandle(
   if (handle.base_addr == nullptr) {
     return Error("region '" + handle.triton_shm_name + "' is not allocated");
   }
-  char buf[256];
+  char buf[384];
   int n = snprintf(
       buf, sizeof(buf),
-      "{\"uuid\": \"%s\", \"staging_key\": \"%s\", \"byte_size\": %zu, "
-      "\"device_id\": %d}",
-      handle.uuid.c_str(), handle.staging_key.c_str(), handle.byte_size,
-      handle.device_id);
+      "{\"uuid\": \"%s\", \"staging_key\": \"%s\", \"seq_key\": \"%s\", "
+      "\"byte_size\": %zu, \"device_id\": %d}",
+      handle.uuid.c_str(), handle.staging_key.c_str(),
+      handle.seq_key.c_str(), handle.byte_size, handle.device_id);
   raw_handle->assign(buf, buf + n);
   return Error::Success;
 }
@@ -99,6 +128,29 @@ Error SetXlaSharedMemoryRegion(
         std::to_string(handle.byte_size));
   }
   memcpy(static_cast<uint8_t*>(handle.base_addr) + offset, data, byte_size);
+  return CommitXlaSharedMemoryRegion(handle);
+}
+
+Error XlaSharedMemoryData(
+    const XlaShmHandle& handle, void** data, size_t offset) {
+  if (handle.base_addr == nullptr) {
+    return Error("region '" + handle.triton_shm_name + "' is not allocated");
+  }
+  if (offset >= handle.byte_size) {
+    return Error(
+        "offset " + std::to_string(offset) + " exceeds region size " +
+        std::to_string(handle.byte_size));
+  }
+  *data = static_cast<uint8_t*>(handle.base_addr) + offset;
+  return Error::Success;
+}
+
+Error CommitXlaSharedMemoryRegion(const XlaShmHandle& handle) {
+  if (handle.seq_addr == nullptr) {
+    return Error("region '" + handle.triton_shm_name + "' is not allocated");
+  }
+  __atomic_fetch_add(static_cast<uint64_t*>(handle.seq_addr), 1,
+                     __ATOMIC_SEQ_CST);
   return Error::Success;
 }
 
@@ -130,6 +182,18 @@ Error DestroyXlaSharedMemoryRegion(XlaShmHandle* handle) {
   if (!handle->staging_key.empty()) {
     ::shm_unlink(handle->staging_key.c_str());
     handle->staging_key.clear();
+  }
+  if (handle->seq_addr != nullptr) {
+    ::munmap(handle->seq_addr, 8);
+    handle->seq_addr = nullptr;
+  }
+  if (handle->seq_fd >= 0) {
+    ::close(handle->seq_fd);
+    handle->seq_fd = -1;
+  }
+  if (!handle->seq_key.empty()) {
+    ::shm_unlink(handle->seq_key.c_str());
+    handle->seq_key.clear();
   }
   return Error::Success;
 }

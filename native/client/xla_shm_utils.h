@@ -27,15 +27,21 @@
 namespace tc_tpu {
 namespace client {
 
-// Opaque region handle (ipc.h analog): owns the mmap'd staging region.
+// Opaque region handle (ipc.h analog): owns the mmap'd staging region plus
+// an 8-byte generation counter the server uses to cache its device import
+// (unchanged region -> the server skips the host copy AND the DMA on every
+// subsequent infer — the TPU analog of cudaIPC's map-once read path).
 struct XlaShmHandle {
   std::string triton_shm_name;  // registration name
   std::string staging_key;      // POSIX shm key ("/xlashm_...")
+  std::string seq_key;          // generation-counter shm key
   std::string uuid;             // slot id (never resolves cross-process)
   size_t byte_size = 0;
   int device_id = 0;
   void* base_addr = nullptr;
+  void* seq_addr = nullptr;
   int shm_fd = -1;
+  int seq_fd = -1;
 };
 
 // Allocate the staging region + descriptor for a device-backed region
@@ -61,6 +67,14 @@ Error SetXlaSharedMemoryRegion(
 Error GetXlaSharedMemoryContents(
     const XlaShmHandle& handle, void* out, size_t byte_size,
     size_t offset = 0);
+
+// Zero-copy write path: build tensor data DIRECTLY in the mapped region
+// (no client-side memcpy), then Commit to publish — bumps the generation
+// counter so the server re-imports exactly once and serves every further
+// infer from its cached device array.
+Error XlaSharedMemoryData(
+    const XlaShmHandle& handle, void** data, size_t offset = 0);
+Error CommitXlaSharedMemoryRegion(const XlaShmHandle& handle);
 
 // Unmap + unlink the staging region (reference destroy_shared_memory_region
 // / cudaFree in CudaSharedMemoryRegion.__del__).

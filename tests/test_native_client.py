@@ -99,6 +99,21 @@ def test_cpp_grpc_example(native_build, harness, example):
     assert "PASS" in out
 
 
+def test_cpp_cudashm_zero_copy_cache(native_build, harness):
+    """The C++ xla-shm example writes tensors in place and commits; its
+    second infer over the unchanged regions must be served from the
+    server's cached device import — no host copy, no DMA (the cudaIPC
+    map-once parity claim, asserted via the registry's import stats)."""
+    stats = harness.core.xla_shm.stats
+    before = dict(stats)
+    out = _run(os.path.join(native_build, "simple_grpc_cudashm_client"),
+               f"127.0.0.1:{harness.grpc_port}")
+    assert "PASS" in out
+    # 2 input regions: first infer imports both, second hits the cache
+    assert stats["staging_imports"] - before["staging_imports"] == 2
+    assert stats["cache_hits"] - before["cache_hits"] == 2
+
+
 def test_cpp_grpc_example_web_bridge_fallback(native_build, harness):
     # pointing the same client at the HTTP port auto-falls back to
     # gRPC-Web framing through the bridge

@@ -139,6 +139,40 @@ class TestStagingImport:
         finally:
             xlashm.destroy_shared_memory_region(h)
 
+    def test_unchanged_region_served_from_import_cache(self):
+        """Generation-stamped cache: a second read of an unchanged region
+        must not re-import (no host copy, no DMA); a client rewrite bumps
+        the generation and forces exactly one re-import."""
+        from triton_client_tpu.server.shm import XlaShmRegistry
+        from triton_client_tpu.server.types import ShmRef
+
+        src = np.arange(8, dtype=np.float32)
+        h = xlashm.create_shared_memory_region("cache_r", src.nbytes, 0)
+        try:
+            xlashm.set_shared_memory_region(h, [src])
+            raw = xlashm.get_raw_handle(h)
+            broker().drop(h._uuid)  # simulate another process
+            reg = XlaShmRegistry()
+            reg.register("cache_r", raw, 0, src.nbytes)
+            ref = ShmRef("cache_r", src.nbytes, 0)
+            a1 = reg.read(ref, "FP32", (8,))
+            assert reg.stats["staging_imports"] == 1
+            a2 = reg.read(ref, "FP32", (8,))
+            assert reg.stats["cache_hits"] == 1
+            assert a2 is a1  # the very same device array
+            # rewrite -> generation bump -> one re-import with new contents
+            src2 = src + 100
+            xlashm.set_shared_memory_region(h, [src2])
+            a3 = reg.read(ref, "FP32", (8,))
+            assert reg.stats["staging_imports"] == 2
+            np.testing.assert_array_equal(np.asarray(a3), src2)
+            # different shape/dtype view of same generation: re-imports
+            reg.read(ref, "FP32", (2, 4))
+            assert reg.stats["staging_imports"] == 3
+            reg.unregister("cache_r")
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
 
 class TestEndToEnd:
     """simple_grpc_cudashm_client.py flow (SURVEY.md §3.5) over the live

@@ -124,12 +124,22 @@ class XlaShmRegion:
     byte_size: int
     slot: Optional[RegionSlot] = None  # in-process zero-copy path
     staging_handle: Optional[object] = None  # cross-process staging path
+    # generation-stamped import cache: the client bumps an 8-byte counter
+    # beside the staging bytes on every write, so repeated infers over an
+    # unchanged region reuse the imported device array — no host copy, no
+    # DMA (the TPU analog of cudaIPC's map-once read path)
+    seq_handle: Optional[object] = None
+    cache: Optional[tuple] = None  # (key, device array), stored atomically
 
 
 class XlaShmRegistry:
     def __init__(self):
         self._regions: Dict[str, XlaShmRegion] = {}
         self._lock = threading.Lock()
+        # import-path accounting, asserted by the zero-copy tests (not on
+        # the wire: the v2 shm status schema is fixed)
+        self.stats = {"staging_imports": 0, "cache_hits": 0,
+                      "slot_reads": 0}
 
     def register(self, name: str, raw_handle: bytes, device_id: int, byte_size: int) -> None:
         try:
@@ -154,6 +164,13 @@ class XlaShmRegistry:
                     )
                 except sysshm.SharedMemoryException as e:
                     raise InferError(f"failed to map staging region for '{name}': {e}")
+                if desc.get("seq_key"):
+                    try:
+                        region.seq_handle = sysshm.attach_shared_memory_region(
+                            name + "_seq", desc["seq_key"], 8
+                        )
+                    except sysshm.SharedMemoryException:
+                        region.seq_handle = None  # older client: no caching
             else:
                 raise InferError(
                     f"failed to register XLA shared memory region '{name}': handle "
@@ -166,8 +183,11 @@ class XlaShmRegistry:
             names = [name] if name else list(self._regions)
             for n in names:
                 region = self._regions.pop(n, None)
-                if region is not None and region.staging_handle is not None:
-                    sysshm.destroy_shared_memory_region(region.staging_handle)
+                if region is None:
+                    continue
+                for h in (region.staging_handle, region.seq_handle):
+                    if h is not None:
+                        sysshm.destroy_shared_memory_region(h)
 
     def status(self, name: Optional[str]) -> Dict[str, dict]:
         with self._lock:
@@ -200,6 +220,7 @@ class XlaShmRegistry:
 
         region = self._get(ref)
         if region.slot is not None:
+            self.stats["slot_reads"] += 1
             array, _, _ = region.slot.get()
             if array is None:
                 raise InferError(
@@ -209,10 +230,25 @@ class XlaShmRegistry:
         dt = triton_to_np_dtype(datatype)
         if dt is None:
             raise InferError(f"unsupported datatype {datatype}")
+        key = None
+        if region.seq_handle is not None:
+            seq = int(sysshm.get_contents_as_numpy(
+                region.seq_handle, np.uint64, [1])[0])
+            key = (seq, datatype, tuple(shape), ref.offset)
+            cached = region.cache  # single-field read: never a torn pair
+            if cached is not None and cached[0] == key:
+                # unchanged since the last import: serve the cached device
+                # array — no host copy, no DMA
+                self.stats["cache_hits"] += 1
+                return cached[1]
         host = sysshm.get_contents_as_numpy(
             region.staging_handle, dt, list(shape), offset=ref.offset
         )
-        return jax.device_put(np.array(host, copy=True))
+        arr = jax.device_put(np.array(host, copy=True))
+        self.stats["staging_imports"] += 1
+        if key is not None:
+            region.cache = (key, arr)
+        return arr
 
     def write(self, ref: ShmRef, data) -> int:
         """Write a model output into the region.
@@ -240,6 +276,13 @@ class XlaShmRegistry:
                 f"shared memory region '{ref.region_name}' too small for output"
             )
         sysshm.set_shared_memory_region(region.staging_handle, [host], offset=ref.offset)
+        # the region's contents changed under the server's pen: drop OUR
+        # import cache so the next read re-imports.  The generation counter
+        # is deliberately CLIENT-owned (the C++ side bumps it atomically) —
+        # a server-side read-modify-write could lose a concurrent client
+        # Commit and make a stale cached generation look current; local
+        # invalidation needs no counter write and can never serve stale data
+        region.cache = None
         return host.nbytes
 
 

@@ -86,6 +86,11 @@ class XlaSharedMemoryRegion:
         self._byte_size = byte_size
         self._device_id = device_id
         self._uuid = _uuid.uuid4().hex
+        # cleanup state FIRST: if any allocation below raises (/dev/shm
+        # full), __del__ -> _close() must still release what was created
+        self._closed = False
+        self._staging = None
+        self._seq = None
         self._slot = broker().create(self._uuid, byte_size, device_id)
         # Host-shm staging region so an out-of-process server can import the
         # handle.  Created eagerly (mmap is cheap); written only when no
@@ -94,7 +99,18 @@ class XlaSharedMemoryRegion:
         self._staging = _sysshm.create_shared_memory_region(
             self._triton_shm_name, self._staging_key, byte_size
         )
-        self._closed = False
+        # 8-byte generation counter beside the staging bytes: every write
+        # bumps it, so a cross-process server can CACHE its device import
+        # and skip the host copy + DMA when the region hasn't changed
+        # (the closest TPU analog of cudaIPC's map-once semantics)
+        self._seq_key = self._staging_key + "_seq"
+        try:
+            self._seq = _sysshm.create_shared_memory_region(
+                self._triton_shm_name + "_seq", self._seq_key, 8
+            )
+        except _sysshm.SharedMemoryException:
+            self._close()
+            raise
 
     # -- introspection ----------------------------------------------------
     @property
@@ -121,10 +137,13 @@ class XlaSharedMemoryRegion:
             return
         self._closed = True
         broker().drop(self._uuid)
-        try:
-            _sysshm.destroy_shared_memory_region(self._staging)
-        except _sysshm.SharedMemoryException:
-            pass
+        for h in (self._staging, self._seq):
+            if h is None:
+                continue
+            try:
+                _sysshm.destroy_shared_memory_region(h)
+            except _sysshm.SharedMemoryException:
+                pass
 
     def __del__(self):
         try:
@@ -157,6 +176,7 @@ def get_raw_handle(xla_shm_handle: XlaSharedMemoryRegion) -> bytes:
         {
             "uuid": xla_shm_handle._uuid,
             "staging_key": xla_shm_handle._staging_key,
+            "seq_key": xla_shm_handle._seq_key,
             "byte_size": xla_shm_handle._byte_size,
             "device_id": xla_shm_handle._device_id,
         }
@@ -169,6 +189,10 @@ def _bind(handle: XlaSharedMemoryRegion, array, datatype: str, shape) -> None:
 
 def _write_staging(handle: XlaSharedMemoryRegion, payloads, offset: int = 0):
     _sysshm.set_shared_memory_region(handle._staging, payloads, offset=offset)
+    seq = _sysshm.get_contents_as_numpy(handle._seq, np.uint64, [1])
+    _sysshm.set_shared_memory_region(
+        handle._seq, [np.array([int(seq[0]) + 1], np.uint64)]
+    )
 
 
 def set_shared_memory_region(
