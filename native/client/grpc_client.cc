@@ -199,6 +199,25 @@ Error InferenceServerGrpcClient::Create(
 
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client,
+    const std::string& server_url, bool verbose, bool use_ssl,
+    const GrpcSslOptions& ssl_options) {
+  TC_RETURN_IF_ERROR(Create(client, server_url, verbose));
+  if (use_ssl) {
+    HttpSslOptionsView view;
+    view.ca_info = ssl_options.root_certificates;
+    view.cert = ssl_options.certificate_chain;
+    view.key = ssl_options.private_key;
+    TC_RETURN_IF_ERROR((*client)->transport_->EnableTls(view));
+    // the h2c path is cleartext prior-knowledge; secure gRPC rides
+    // gRPC-Web over TLS, so pin the transport mode up front
+    std::lock_guard<std::mutex> lk((*client)->mode_mu_);
+    (*client)->mode_ = Mode::kWeb;
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client,
     const std::string& server_url, bool verbose,
     const KeepAliveOptions& keepalive_options) {
   TC_RETURN_IF_ERROR(Create(client, server_url, verbose));
@@ -852,7 +871,8 @@ Error InferenceServerGrpcClient::StartStream(
   TC_RETURN_IF_ERROR(conn->Open(
       transport_->host(), transport_->port(),
       std::string(kServicePath) + "/ModelStreamInfer", headers,
-      transport_->keepalive_idle_s(), transport_->keepalive_intvl_s()));
+      transport_->keepalive_idle_s(), transport_->keepalive_intvl_s(),
+      transport_->tls_context()));
   int status = 0;
   Headers resp_headers;
   TC_RETURN_IF_ERROR(conn->ReadResponseHeaders(&status, &resp_headers));

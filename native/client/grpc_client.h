@@ -101,6 +101,20 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       std::unique_ptr<InferenceServerGrpcClient>* client,
       const std::string& server_url, const ChannelArguments& channel_args,
       bool verbose = false);
+  // Secure channel (reference Create overload taking use_ssl + SslOptions,
+  // grpc_client.h).  Divergence: the reference's SslOptions carry PEM
+  // *contents*; these carry file *paths* (the TLS layer loads them).  The
+  // secure wire is gRPC-Web over TLS against the harness's HTTPS port —
+  // h2c is cleartext-only, so use_ssl pins the web transport mode.
+  struct GrpcSslOptions {
+    std::string root_certificates;   // CA bundle path ("" = system default)
+    std::string private_key;         // client key path (mTLS)
+    std::string certificate_chain;   // client cert path (mTLS)
+  };
+  static Error Create(
+      std::unique_ptr<InferenceServerGrpcClient>* client,
+      const std::string& server_url, bool verbose, bool use_ssl,
+      const GrpcSslOptions& ssl_options = GrpcSslOptions());
   ~InferenceServerGrpcClient() override;
 
   Error IsServerLive(bool* live, const Headers& headers = Headers());

@@ -83,15 +83,6 @@ Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client,
     const std::string& server_url, bool verbose, size_t concurrency,
     bool use_ssl, const HttpSslOptions& ssl_options) {
-  (void)ssl_options;
-  if (use_ssl) {
-    // The reference gets TLS from libcurl (HttpSslOptions,
-    // http_client.h:45-86); this build has no TLS library, so fail loudly
-    // rather than silently speaking plaintext.
-    return Error(
-        "client was built without SSL support; use a TLS-terminating proxy "
-        "or the Python client");
-  }
   if (server_url.rfind("http://", 0) == 0 ||
       server_url.rfind("https://", 0) == 0) {
     return Error("url should not include the scheme");
@@ -100,6 +91,20 @@ Error InferenceServerHttpClient::Create(
       new InferenceServerHttpClient(server_url, verbose, concurrency));
   if ((*client)->transport_->port() <= 0) {
     return Error("invalid server url '" + server_url + "'");
+  }
+  if (use_ssl) {
+    // HTTPS via the system libssl (reference HttpSslOptions / libcurl
+    // CURLOPT_SSL_*, http_client.h:45-86)
+    HttpSslOptionsView view;
+    view.verify_peer = ssl_options.verify_peer;
+    view.verify_host = ssl_options.verify_host;
+    view.ca_info = ssl_options.ca_info;
+    view.cert = ssl_options.cert;
+    view.cert_pem =
+        ssl_options.cert_type == HttpSslOptions::CERTTYPE::CERT_PEM;
+    view.key = ssl_options.key;
+    view.key_pem = ssl_options.key_type == HttpSslOptions::KEYTYPE::KEY_PEM;
+    TC_RETURN_IF_ERROR((*client)->transport_->EnableTls(view));
   }
   return Error::Success;
 }

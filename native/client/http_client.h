@@ -32,11 +32,12 @@ namespace client {
 
 using Parameters = std::map<std::string, std::string>;
 
-// SSL options (API parity with reference http_client.h:45-86).  This build
-// has no TLS library (the reference delegates TLS to libcurl; this image
-// ships neither libcurl nor OpenSSL headers), so Create() with
-// `use_ssl=true` returns an explicit error rather than silently running
-// plaintext.  The struct is kept so calling code is source-compatible.
+// SSL options (API parity with reference http_client.h:45-86).  TLS is
+// backed by the system libssl.so.3 resolved at runtime (tls.{h,cc}; the
+// image ships no OpenSSL headers, so the needed ABI subset is declared
+// locally).  `cert`/`key`/`ca_info` are file paths, as in the reference's
+// libcurl-based options.  When libssl is absent, Create() with
+// `use_ssl=true` fails loudly rather than silently speaking plaintext.
 struct HttpSslOptions {
   enum class CERTTYPE { CERT_PEM, CERT_DER };
   enum class KEYTYPE { KEY_PEM, KEY_DER };

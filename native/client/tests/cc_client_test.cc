@@ -213,13 +213,22 @@ void TestHttp(const std::string& url) {
     }
   }
 
-  // SSL is an explicit descope in this build: loud error, not silent http
+  // TLS (tls_client_test covers the full round trip): with libssl present,
+  // a use_ssl client against a PLAINTEXT port must fail the handshake —
+  // never silently downgrade to http; without libssl, Create must fail
+  // loudly instead
   {
     std::unique_ptr<tc::InferenceServerHttpClient> ssl_client;
-    tc::Error ssl_err = tc::InferenceServerHttpClient::Create(
+    tc::Error create_err = tc::InferenceServerHttpClient::Create(
         &ssl_client, url, false, 4, true);
-    CHECK_TRUE(!ssl_err.IsOk());
-    CHECK_TRUE(ssl_err.Message().find("SSL") != std::string::npos);
+    if (create_err.IsOk()) {
+      bool live = false;
+      tc::Error ssl_err = ssl_client->IsServerLive(&live);
+      CHECK_TRUE(!ssl_err.IsOk());
+    } else {
+      CHECK_TRUE(create_err.Message().find("TLS unavailable") !=
+                 std::string::npos);
+    }
   }
 
   // trace/log settings management

@@ -1,0 +1,172 @@
+// Secure-transport round trip for the native clients: HTTPS unary infer
+// (HTTP client, CA-pinned + hostname verification) and secure gRPC-Web
+// (gRPC client over TLS, unary + duplex stream) against the harness's TLS
+// frontends.  Also proves verification is real: an untrusted CA must be
+// rejected.
+//
+// usage: tls_client_test <https_host:port> <ca_pem_path> [cert] [key]
+
+#include <cstdio>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+namespace tc = tc_tpu::client;
+
+#define CHECK_OK(x)                                                   \
+  do {                                                                \
+    tc::Error err__ = (x);                                            \
+    if (!err__.IsOk()) {                                              \
+      fprintf(stderr, "FAILED %s:%d: %s -> %s\n", __FILE__, __LINE__, \
+              #x, err__.Message().c_str());                           \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+#define CHECK_TRUE(x)                                                  \
+  do {                                                                 \
+    if (!(x)) {                                                        \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__, #x);   \
+      exit(1);                                                         \
+    }                                                                  \
+  } while (0)
+
+namespace {
+
+void MakeSimpleInputs(
+    std::vector<int32_t>& in0, std::vector<int32_t>& in1,
+    std::vector<tc::InferInput*>* inputs) {
+  tc::InferInput *i0, *i1;
+  CHECK_OK(tc::InferInput::Create(&i0, "INPUT0", {1, 16}, "INT32"));
+  CHECK_OK(tc::InferInput::Create(&i1, "INPUT1", {1, 16}, "INT32"));
+  CHECK_OK(i0->AppendRaw(reinterpret_cast<uint8_t*>(in0.data()),
+                         in0.size() * sizeof(int32_t)));
+  CHECK_OK(i1->AppendRaw(reinterpret_cast<uint8_t*>(in1.data()),
+                         in1.size() * sizeof(int32_t)));
+  inputs->assign({i0, i1});
+}
+
+void CheckSum(tc::InferResult* result, const std::vector<int32_t>& in0,
+              const std::vector<int32_t>& in1) {
+  const uint8_t* buf;
+  size_t len;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &len));
+  CHECK_TRUE(len == in0.size() * sizeof(int32_t));
+  const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+  for (size_t i = 0; i < in0.size(); ++i) {
+    CHECK_TRUE(sum[i] == in0[i] + in1[i]);
+  }
+}
+
+void TestHttpsInfer(const std::string& url, const std::string& ca) {
+  tc::HttpSslOptions ssl;
+  ssl.ca_info = ca;
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(
+      &client, url, false, 4, /*use_ssl=*/true, ssl));
+  bool live = false;
+  CHECK_OK(client->IsServerLive(&live));
+  CHECK_TRUE(live);
+  std::vector<int32_t> in0(16), in1(16, 3);
+  for (int i = 0; i < 16; ++i) in0[i] = i;
+  std::vector<tc::InferInput*> inputs;
+  MakeSimpleInputs(in0, in1, &inputs);
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  CHECK_OK(client->Infer(&result, options, inputs));
+  CheckSum(result, in0, in1);
+  delete result;
+  for (auto* in : inputs) delete in;
+  printf("PASS: https unary infer (CA-pinned)\n");
+}
+
+void TestHttpsRejectsUntrustedCa(const std::string& url) {
+  // default trust store does not contain the harness's self-signed cert
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(
+      &client, url, false, 4, /*use_ssl=*/true, tc::HttpSslOptions()));
+  bool live = false;
+  tc::Error err = client->IsServerLive(&live);
+  CHECK_TRUE(!err.IsOk());
+  CHECK_TRUE(err.Message().find("TLS handshake") != std::string::npos);
+  printf("PASS: https rejects untrusted CA\n");
+}
+
+void TestClientCertPlumbing(const std::string& url, const std::string& ca,
+                            const std::string& cert,
+                            const std::string& key) {
+  // exercises the client cert/key file-loading paths (SSL_CTX_use_*).  The
+  // harness doesn't REQUEST a client certificate, so this proves loading +
+  // handshake compatibility, not server-side mTLS verification.
+  tc::HttpSslOptions ssl;
+  ssl.ca_info = ca;
+  ssl.cert = cert;
+  ssl.key = key;
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  CHECK_OK(tc::InferenceServerHttpClient::Create(
+      &client, url, false, 4, /*use_ssl=*/true, ssl));
+  bool live = false;
+  CHECK_OK(client->IsServerLive(&live));
+  CHECK_TRUE(live);
+  // a bad key path must fail at Create (context build), not first request
+  tc::HttpSslOptions bad = ssl;
+  bad.key = "/nonexistent/key.pem";
+  std::unique_ptr<tc::InferenceServerHttpClient> bad_client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(
+      &bad_client, url, false, 4, /*use_ssl=*/true, bad);
+  CHECK_TRUE(!err.IsOk());
+  CHECK_TRUE(err.Message().find("client key") != std::string::npos);
+  printf("PASS: client cert/key loading\n");
+}
+
+void TestSecureGrpc(const std::string& url, const std::string& ca) {
+  tc::InferenceServerGrpcClient::GrpcSslOptions ssl;
+  ssl.root_certificates = ca;
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(
+      &client, url, false, /*use_ssl=*/true, ssl));
+  bool ready = false;
+  CHECK_OK(client->IsServerReady(&ready));
+  CHECK_TRUE(ready);
+  std::vector<int32_t> in0(16, 5), in1(16, 2);
+  std::vector<tc::InferInput*> inputs;
+  MakeSimpleInputs(in0, in1, &inputs);
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  CHECK_OK(client->Infer(&result, options, inputs));
+  CheckSum(result, in0, in1);
+  delete result;
+
+  // duplex stream over TLS
+  std::queue<tc::InferResult*> results;
+  CHECK_OK(client->StartStream(
+      [&results](tc::InferResult* r) { results.push(r); }));
+  CHECK_OK(client->AsyncStreamInfer(options, inputs));
+  CHECK_OK(client->FinishStream());
+  CHECK_TRUE(results.size() == 1);
+  CheckSum(results.front(), in0, in1);
+  delete results.front();
+  for (auto* in : inputs) delete in;
+  printf("PASS: secure grpc unary + stream\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <https_host:port> <ca_pem_path>\n", argv[0]);
+    return 2;
+  }
+  const std::string url = argv[1];
+  const std::string ca = argv[2];
+  TestHttpsInfer(url, ca);
+  TestHttpsRejectsUntrustedCa(url);
+  if (argc >= 5) TestClientCertPlumbing(url, ca, argv[3], argv[4]);
+  TestSecureGrpc(url, ca);
+  printf("PASS: all\n");
+  return 0;
+}

@@ -1,0 +1,221 @@
+#include "tls.h"
+
+#include <dlfcn.h>
+
+#include <cerrno>
+#include <cstring>
+
+namespace tc_tpu {
+namespace client {
+
+namespace {
+
+// OpenSSL 3 constants (stable ABI values)
+constexpr int kSslFiletypePem = 1;
+constexpr int kSslFiletypeAsn1 = 2;
+constexpr int kSslVerifyNone = 0;
+constexpr int kSslVerifyPeer = 1;
+constexpr long kSslCtrlSetTlsextHostname = 55;
+constexpr long kTlsextNametypeHostName = 0;
+constexpr int kSslErrorZeroReturn = 6;
+constexpr int kSslErrorSyscall = 5;
+
+struct OpenSsl {
+  void* (*tls_client_method)() = nullptr;
+  void* (*ctx_new)(void*) = nullptr;
+  void (*ctx_free)(void*) = nullptr;
+  void* (*ssl_new)(void*) = nullptr;
+  void (*ssl_free)(void*) = nullptr;
+  int (*set_fd)(void*, int) = nullptr;
+  int (*connect)(void*) = nullptr;
+  int (*read)(void*, void*, int) = nullptr;
+  int (*write)(void*, const void*, int) = nullptr;
+  int (*shutdown)(void*) = nullptr;
+  int (*get_error)(const void*, int) = nullptr;
+  void (*set_verify)(void*, int, void*) = nullptr;
+  int (*load_verify)(void*, const char*, const char*) = nullptr;
+  int (*default_verify_paths)(void*) = nullptr;
+  long (*ssl_ctrl)(void*, int, long, void*) = nullptr;
+  int (*set1_host)(void*, const char*) = nullptr;
+  int (*use_cert_file)(void*, const char*, int) = nullptr;
+  int (*use_key_file)(void*, const char*, int) = nullptr;
+  bool ok = false;
+
+  static const OpenSsl& Get() {
+    static OpenSsl s = [] {
+      OpenSsl out;
+      void* lib = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+      if (lib == nullptr) lib = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+      if (lib == nullptr) return out;
+      auto sym = [lib](const char* n) { return dlsym(lib, n); };
+      out.tls_client_method =
+          reinterpret_cast<void* (*)()>(sym("TLS_client_method"));
+      out.ctx_new = reinterpret_cast<void* (*)(void*)>(sym("SSL_CTX_new"));
+      out.ctx_free = reinterpret_cast<void (*)(void*)>(sym("SSL_CTX_free"));
+      out.ssl_new = reinterpret_cast<void* (*)(void*)>(sym("SSL_new"));
+      out.ssl_free = reinterpret_cast<void (*)(void*)>(sym("SSL_free"));
+      out.set_fd = reinterpret_cast<int (*)(void*, int)>(sym("SSL_set_fd"));
+      out.connect = reinterpret_cast<int (*)(void*)>(sym("SSL_connect"));
+      out.read = reinterpret_cast<int (*)(void*, void*, int)>(sym("SSL_read"));
+      out.write = reinterpret_cast<int (*)(void*, const void*, int)>(
+          sym("SSL_write"));
+      out.shutdown = reinterpret_cast<int (*)(void*)>(sym("SSL_shutdown"));
+      out.get_error =
+          reinterpret_cast<int (*)(const void*, int)>(sym("SSL_get_error"));
+      out.set_verify = reinterpret_cast<void (*)(void*, int, void*)>(
+          sym("SSL_CTX_set_verify"));
+      out.load_verify = reinterpret_cast<int (*)(void*, const char*,
+                                                 const char*)>(
+          sym("SSL_CTX_load_verify_locations"));
+      out.default_verify_paths = reinterpret_cast<int (*)(void*)>(
+          sym("SSL_CTX_set_default_verify_paths"));
+      out.ssl_ctrl = reinterpret_cast<long (*)(void*, int, long, void*)>(
+          sym("SSL_ctrl"));
+      out.set1_host =
+          reinterpret_cast<int (*)(void*, const char*)>(sym("SSL_set1_host"));
+      out.use_cert_file = reinterpret_cast<int (*)(void*, const char*, int)>(
+          sym("SSL_CTX_use_certificate_file"));
+      out.use_key_file = reinterpret_cast<int (*)(void*, const char*, int)>(
+          sym("SSL_CTX_use_PrivateKey_file"));
+      out.ok = out.tls_client_method && out.ctx_new && out.ctx_free &&
+               out.ssl_new && out.ssl_free && out.set_fd && out.connect &&
+               out.read && out.write && out.shutdown && out.get_error &&
+               out.set_verify && out.load_verify &&
+               out.default_verify_paths && out.ssl_ctrl && out.set1_host &&
+               out.use_cert_file && out.use_key_file;
+      return out;
+    }();
+    return s;
+  }
+};
+
+}  // namespace
+
+bool TlsSession::Available() { return OpenSsl::Get().ok; }
+
+TlsContext::~TlsContext() {
+  if (ctx_ != nullptr) {
+    OpenSsl::Get().ctx_free(ctx_);
+    ctx_ = nullptr;
+  }
+}
+
+Error TlsContext::Init(const HttpSslOptionsView& opts) {
+  if (!TlsSession::Available()) {
+    return Error("TLS unavailable: libssl.so.3 not found");
+  }
+  const OpenSsl& o = OpenSsl::Get();
+  ctx_ = o.ctx_new(o.tls_client_method());
+  if (ctx_ == nullptr) return Error("SSL_CTX_new failed");
+  verify_peer_ = opts.verify_peer;
+  verify_host_ = opts.verify_host;
+  if (opts.verify_peer) {
+    o.set_verify(ctx_, kSslVerifyPeer, nullptr);
+    int rc = opts.ca_info.empty()
+                 ? o.default_verify_paths(ctx_)
+                 : o.load_verify(ctx_, opts.ca_info.c_str(), nullptr);
+    if (rc != 1) {
+      return Error("failed to load CA certificates" +
+                   (opts.ca_info.empty() ? std::string()
+                                         : " from " + opts.ca_info));
+    }
+  } else {
+    o.set_verify(ctx_, kSslVerifyNone, nullptr);
+  }
+  if (!opts.cert.empty()) {
+    int type = opts.cert_pem ? kSslFiletypePem : kSslFiletypeAsn1;
+    if (o.use_cert_file(ctx_, opts.cert.c_str(), type) != 1) {
+      return Error("failed to load client certificate " + opts.cert);
+    }
+  }
+  if (!opts.key.empty()) {
+    int type = opts.key_pem ? kSslFiletypePem : kSslFiletypeAsn1;
+    if (o.use_key_file(ctx_, opts.key.c_str(), type) != 1) {
+      return Error("failed to load client key " + opts.key);
+    }
+  }
+  return Error::Success;
+}
+
+TlsSession::~TlsSession() { Close(); }
+
+void TlsSession::Close() {
+  const OpenSsl& o = OpenSsl::Get();
+  std::lock_guard<std::mutex> lk(mu_);
+  if (ssl_ != nullptr) {
+    o.shutdown(ssl_);  // best-effort close_notify
+    o.ssl_free(ssl_);
+    ssl_ = nullptr;
+  }
+}
+
+Error TlsSession::Handshake(
+    int fd, const TlsContext& ctx, const std::string& host) {
+  if (!Available()) {
+    return Error("TLS unavailable: libssl.so.3 not found");
+  }
+  if (!ctx.initialized()) {
+    return Error("TLS context not initialized");
+  }
+  const OpenSsl& o = OpenSsl::Get();
+  std::lock_guard<std::mutex> lk(mu_);
+  ssl_ = o.ssl_new(ctx.ctx_);
+  if (ssl_ == nullptr) {
+    return Error("SSL_new failed");
+  }
+  // SNI + hostname verification
+  o.ssl_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+             const_cast<char*>(host.c_str()));
+  if (ctx.verify_peer_ && ctx.verify_host_) {
+    o.set1_host(ssl_, host.c_str());
+  }
+  if (o.set_fd(ssl_, fd) != 1) {
+    o.ssl_free(ssl_);
+    ssl_ = nullptr;
+    return Error("SSL_set_fd failed");
+  }
+  int rc = o.connect(ssl_);
+  if (rc != 1) {
+    int err = o.get_error(ssl_, rc);
+    o.ssl_free(ssl_);
+    ssl_ = nullptr;
+    return Error(
+        "TLS handshake with " + host + " failed (ssl error " +
+        std::to_string(err) +
+        (err == 1 ? ": certificate verification failed or protocol error"
+                  : "") +
+        ")");
+  }
+  return Error::Success;
+}
+
+long TlsSession::Recv(char* buf, size_t n) {
+  const OpenSsl& o = OpenSsl::Get();
+  std::lock_guard<std::mutex> lk(mu_);
+  if (ssl_ == nullptr) {
+    errno = EBADF;
+    return -1;
+  }
+  int rc = o.read(ssl_, buf, static_cast<int>(n));
+  if (rc > 0) return rc;
+  int err = o.get_error(ssl_, rc);
+  if (err == kSslErrorZeroReturn) return 0;  // clean TLS close
+  if (err == kSslErrorSyscall && rc == 0) return 0;  // peer FIN
+  // errno (EAGAIN on SO_RCVTIMEO expiry) is preserved for the caller
+  return -1;
+}
+
+long TlsSession::Send(const char* buf, size_t n) {
+  const OpenSsl& o = OpenSsl::Get();
+  std::lock_guard<std::mutex> lk(mu_);
+  if (ssl_ == nullptr) {
+    errno = EBADF;
+    return -1;
+  }
+  int rc = o.write(ssl_, buf, static_cast<int>(n));
+  if (rc > 0) return rc;
+  return -1;
+}
+
+}  // namespace client
+}  // namespace tc_tpu

@@ -1,0 +1,84 @@
+// TLS client stream over an already-connected socket.
+//
+// Parity target: the reference C++ clients' HttpSslOptions /
+// libcurl CURLOPT_SSL_* handling (/root/reference/src/c++/library/
+// http_client.cc SetSSLCurlOptions) and grpc SslCredentials.  The image
+// ships no OpenSSL/GnuTLS headers, so the needed OpenSSL 3 API subset
+// (opaque pointers + stable C ABI) is declared here and resolved from the
+// system libssl.so.3 at runtime via dlopen — when the library is missing,
+// Available() is false and secure clients fail with a clear error.
+#pragma once
+
+#include <cstddef>
+#include <mutex>
+#include <string>
+
+#include "common.h"
+
+namespace tc_tpu {
+namespace client {
+
+struct HttpSslOptionsView {
+  bool verify_peer = true;
+  bool verify_host = true;
+  std::string ca_info;      // PEM CA bundle path ("" = system default)
+  std::string cert;         // client cert path (PEM/DER per cert_pem)
+  bool cert_pem = true;
+  std::string key;          // client key path
+  bool key_pem = true;
+};
+
+// Shared per-transport TLS configuration: one SSL_CTX built (and the CA
+// bundle / client cert files validated) ONCE at EnableTls time, not per
+// connection.
+class TlsContext {
+ public:
+  TlsContext() = default;
+  ~TlsContext();
+  TlsContext(const TlsContext&) = delete;
+  TlsContext& operator=(const TlsContext&) = delete;
+
+  Error Init(const HttpSslOptionsView& opts);
+  bool initialized() const { return ctx_ != nullptr; }
+
+ private:
+  friend class TlsSession;
+  void* ctx_ = nullptr;   // SSL_CTX*
+  bool verify_host_ = true;
+  bool verify_peer_ = true;
+};
+
+class TlsSession {
+ public:
+  // True when libssl.so.3 (or libssl.so) is loadable.
+  static bool Available();
+
+  TlsSession() = default;
+  ~TlsSession();
+  TlsSession(const TlsSession&) = delete;
+  TlsSession& operator=(const TlsSession&) = delete;
+
+  // TLS handshake over `fd` (blocking; honors SO_RCVTIMEO/SO_SNDTIMEO the
+  // caller may have set).  `host` is used for SNI and (when the context
+  // verifies hosts) hostname verification.
+  Error Handshake(int fd, const TlsContext& ctx, const std::string& host);
+
+  // Like ::recv/::send on the cleartext stream: >0 bytes, 0 orderly close,
+  // -1 error (errno EAGAIN/EWOULDBLOCK preserved for deadline handling).
+  // Internally serialized: OpenSSL SSL objects are not thread-safe even
+  // for concurrent read-vs-write (the duplex stream's reader thread and
+  // writer thread share one session), so both calls take the session
+  // mutex — duplex readers use a short SO_RCVTIMEO so a blocked Recv
+  // releases the lock periodically for writers.
+  long Recv(char* buf, size_t n);
+  long Send(const char* buf, size_t n);
+
+  void Close();  // best-effort SSL_shutdown + free
+
+ private:
+  void* ssl_ = nullptr;   // SSL*
+  std::mutex mu_;
+};
+
+}  // namespace client
+}  // namespace tc_tpu

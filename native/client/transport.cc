@@ -39,6 +39,64 @@ using sockio::SetSocketTimeout;
 using sockio::WriteAll;
 using sockio::WriteAllDl;
 
+// TLS-aware IO over a transport connection: dispatch to the TLS session
+// when present, otherwise the plain sockio helpers.  Deadline semantics
+// match sockio (-2 = expired).
+struct ConnRef {
+  int fd;
+  TlsSession* tls;
+};
+
+ssize_t CRecvDl(const ConnRef& c, char* buf, size_t n, const Deadline& dl) {
+  if (c.tls == nullptr) return RecvDl(c.fd, buf, n, dl);
+  if (dl.enabled) {
+    long long rem = dl.RemainingUs();
+    if (rem <= 0) return -2;
+    SetSocketTimeout(c.fd, SO_RCVTIMEO, rem);
+  }
+  long r = c.tls->Recv(buf, n);
+  if (r < 0 && dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+    return -2;
+  }
+  return r;
+}
+
+int CReadExactDl(const ConnRef& c, char* buf, size_t n, const Deadline& dl) {
+  if (c.tls == nullptr) return ReadExactDl(c.fd, buf, n, dl);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = CRecvDl(c, buf + got, n - got, dl);
+    if (r == -2) return -2;
+    if (r <= 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+int CWriteAllDl(const ConnRef& c, const char* buf, size_t n,
+                const Deadline& dl) {
+  if (c.tls == nullptr) return WriteAllDl(c.fd, buf, n, dl);
+  size_t sent = 0;
+  while (sent < n) {
+    if (dl.enabled) {
+      long long rem = dl.RemainingUs();
+      if (rem <= 0) return -2;
+      SetSocketTimeout(c.fd, SO_SNDTIMEO, rem);
+    }
+    long w = c.tls->Send(buf + sent, n - sent);
+    if (w <= 0) {
+      if (dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) return -2;
+      return -1;
+    }
+    sent += static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+bool CWriteAll(const ConnRef& c, const char* buf, size_t n) {
+  return CWriteAllDl(c, buf, n, Deadline()) == 0;
+}
+
 }  // namespace
 
 std::string Base64Encode(const uint8_t* data, size_t len) {
@@ -70,27 +128,40 @@ void HttpTransport::SetMaxResponseBytes(size_t max_bytes) {
   max_response_bytes_ = max_bytes;
 }
 
+Error HttpTransport::EnableTls(const HttpSslOptionsView& opts) {
+  if (!TlsSession::Available()) {
+    return Error(
+        "TLS unavailable: system libssl.so.3 not found (required for "
+        "use_ssl)");
+  }
+  TC_RETURN_IF_ERROR(tls_ctx_.Init(opts));
+  use_tls_ = true;
+  return Error::Success;
+}
+
 void HttpTransport::SetMaxRequestBytes(size_t max_bytes) {
   max_request_bytes_ = max_bytes;
 }
 
 HttpTransport::~HttpTransport() {
   std::lock_guard<std::mutex> lk(mu_);
-  for (int fd : idle_) ::close(fd);
+  for (auto& c : idle_) {
+    delete c.tls;
+    ::close(c.fd);
+  }
   idle_.clear();
 }
 
-void HttpTransport::Release(int fd, bool reusable) {
-  if (!reusable) {
-    ::close(fd);
-    return;
+void HttpTransport::Release(Conn conn, bool reusable) {
+  if (reusable) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (idle_.size() < max_idle_) {
+      idle_.push_back(conn);
+      return;
+    }
   }
-  std::lock_guard<std::mutex> lk(mu_);
-  if (idle_.size() >= max_idle_) {
-    ::close(fd);
-  } else {
-    idle_.push_back(fd);
-  }
+  delete conn.tls;  // TlsSession dtor sends close_notify
+  if (conn.fd >= 0) ::close(conn.fd);
 }
 
 Error HttpTransport::Request(
@@ -104,27 +175,46 @@ Error HttpTransport::Request(
   }
   Deadline dl = Deadline::In(timeout_us);
   Error err;
-  int fd = -1;
+  Conn pooled{-1, nullptr};
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (!idle_.empty()) {
-      fd = idle_.back();
+      pooled = idle_.back();
       idle_.pop_back();
     }
   }
-  if (fd < 0) {
-    fd = ConnectTcp(host_, port_, &err, dl);
-    if (fd < 0) return err;
+  if (pooled.fd < 0) {
+    pooled.fd = ConnectTcp(host_, port_, &err, dl);
+    if (pooled.fd < 0) return err;
     if (keepalive_idle_s_ > 0) {
       int one = 1;
-      ::setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
-      ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &keepalive_idle_s_,
+      ::setsockopt(pooled.fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+      ::setsockopt(pooled.fd, IPPROTO_TCP, TCP_KEEPIDLE, &keepalive_idle_s_,
                    sizeof(keepalive_idle_s_));
       if (keepalive_intvl_s_ > 0)
-        ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &keepalive_intvl_s_,
-                     sizeof(keepalive_intvl_s_));
+        ::setsockopt(pooled.fd, IPPROTO_TCP, TCP_KEEPINTVL,
+                     &keepalive_intvl_s_, sizeof(keepalive_intvl_s_));
+    }
+    if (use_tls_) {
+      if (dl.enabled) {
+        long long rem = dl.RemainingUs();
+        if (rem <= 0) {
+          ::close(pooled.fd);
+          return Error("Deadline Exceeded: timed out before TLS handshake");
+        }
+        SetSocketTimeout(pooled.fd, SO_RCVTIMEO, rem);
+        SetSocketTimeout(pooled.fd, SO_SNDTIMEO, rem);
+      }
+      pooled.tls = new TlsSession();
+      Error terr = pooled.tls->Handshake(pooled.fd, tls_ctx_, host_);
+      if (!terr.IsOk()) {
+        delete pooled.tls;
+        ::close(pooled.fd);
+        return terr;
+      }
     }
   }
+  const ConnRef conn{pooled.fd, pooled.tls};
 
   std::ostringstream req;
   req << method << " /" << path << " HTTP/1.1\r\n";
@@ -143,13 +233,13 @@ Error HttpTransport::Request(
   std::string head = req.str();
 
   if (timers) timers->CaptureTimestamp(RequestTimers::Kind::SEND_START);
-  int wrc = WriteAllDl(fd, head.data(), head.size(), dl);
+  int wrc = CWriteAllDl(conn, head.data(), head.size(), dl);
   if (wrc == 0 && !body.empty()) {
-    wrc = WriteAllDl(fd, body.data(), body.size(), dl);
+    wrc = CWriteAllDl(conn, body.data(), body.size(), dl);
   }
   if (timers) timers->CaptureTimestamp(RequestTimers::Kind::SEND_END);
   if (wrc != 0) {
-    Release(fd, false);
+    Release(pooled, false);
     return Error(
         wrc == -2 ? "Deadline Exceeded: timed out sending request to " + host_
                   : "failed to send request to " + host_);
@@ -161,9 +251,9 @@ Error HttpTransport::Request(
   char chunk[8192];
   size_t header_end = std::string::npos;
   while (header_end == std::string::npos) {
-    ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
+    ssize_t r = CRecvDl(conn, chunk, sizeof(chunk), dl);
     if (r <= 0) {
-      Release(fd, false);
+      Release(pooled, false);
       return Error(
           r == -2 ? "Deadline Exceeded: timed out awaiting response"
                   : "connection closed while reading response headers");
@@ -171,7 +261,7 @@ Error HttpTransport::Request(
     buf.append(chunk, static_cast<size_t>(r));
     header_end = buf.find("\r\n\r\n");
     if (buf.size() > (1u << 20)) {
-      Release(fd, false);
+      Release(pooled, false);
       return Error("response headers too large");
     }
   }
@@ -204,8 +294,8 @@ Error HttpTransport::Request(
   auto over_cap = [this](size_t sz) {
     return max_response_bytes_ > 0 && sz > max_response_bytes_;
   };
-  auto cap_error = [this, &fd]() {
-    Release(fd, false);
+  auto cap_error = [this, &pooled]() {
+    Release(pooled, false);
     return Error(
         "response exceeds maximum receive message size of " +
         std::to_string(max_response_bytes_) + " bytes");
@@ -218,9 +308,9 @@ Error HttpTransport::Request(
     while (true) {
       size_t nl = stream.find("\r\n", pos);
       while (nl == std::string::npos) {
-        ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
+        ssize_t r = CRecvDl(conn, chunk, sizeof(chunk), dl);
         if (r <= 0) {
-          Release(fd, false);
+          Release(pooled, false);
           return Error(r == -2 ? "Deadline Exceeded: timed out mid-chunk"
                                : "connection closed mid-chunk");
         }
@@ -234,9 +324,9 @@ Error HttpTransport::Request(
       if (over_cap(resp_body.size() + chunk_len)) return cap_error();
       size_t data_start = nl + 2;
       while (stream.size() < data_start + chunk_len + 2) {
-        ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
+        ssize_t r = CRecvDl(conn, chunk, sizeof(chunk), dl);
         if (r <= 0) {
-          Release(fd, false);
+          Release(pooled, false);
           return Error(r == -2 ? "Deadline Exceeded: timed out mid-chunk"
                                : "connection closed mid-chunk");
         }
@@ -257,9 +347,9 @@ Error HttpTransport::Request(
         size_t missing = want - resp_body.size();
         size_t old = resp_body.size();
         resp_body.resize(want);
-        int rrc = ReadExactDl(fd, &resp_body[old], missing, dl);
+        int rrc = CReadExactDl(conn, &resp_body[old], missing, dl);
         if (rrc != 0) {
-          Release(fd, false);
+          Release(pooled, false);
           return Error(
               rrc == -2 ? "Deadline Exceeded: timed out reading response body"
                         : "connection closed while reading response body");
@@ -278,10 +368,10 @@ Error HttpTransport::Request(
       // the response was truncated.
       if (over_cap(resp_body.size())) return cap_error();
       for (;;) {
-        ssize_t r = RecvDl(fd, chunk, sizeof(chunk), dl);
+        ssize_t r = CRecvDl(conn, chunk, sizeof(chunk), dl);
         if (r == 0) break;
         if (r < 0) {
-          Release(fd, false);
+          Release(pooled, false);
           return Error(
               r == -2 ? "Deadline Exceeded: timed out reading response body"
                       : "connection error while reading response body");
@@ -301,10 +391,10 @@ Error HttpTransport::Request(
   }
   if (dl.enabled && keep_alive) {
     // pooled fds must not inherit this request's deadline
-    SetSocketTimeout(fd, SO_RCVTIMEO, 0);
-    SetSocketTimeout(fd, SO_SNDTIMEO, 0);
+    SetSocketTimeout(pooled.fd, SO_RCVTIMEO, 0);
+    SetSocketTimeout(pooled.fd, SO_SNDTIMEO, 0);
   }
-  Release(fd, keep_alive);
+  Release(pooled, keep_alive);
 
   out->status = status;
   out->headers = std::move(resp_headers);
@@ -316,6 +406,10 @@ Error HttpTransport::Request(
 DuplexConnection::~DuplexConnection() { Close(); }
 
 void DuplexConnection::Close() {
+  if (tls_ != nullptr) {
+    delete tls_;  // dtor sends close_notify
+    tls_ = nullptr;
+  }
   if (fd_ >= 0) {
     ::close(fd_);
     fd_ = -1;
@@ -325,10 +419,22 @@ void DuplexConnection::Close() {
 Error DuplexConnection::Open(
     const std::string& host, int port, const std::string& path,
     const Headers& extra_headers, int keepalive_idle_s,
-    int keepalive_intvl_s) {
+    int keepalive_intvl_s, const TlsContext* tls_ctx) {
   Error err;
   fd_ = ConnectTcp(host, port, &err);
   if (fd_ < 0) return err;
+  if (tls_ctx != nullptr) {
+    tls_ = new TlsSession();
+    Error terr = tls_->Handshake(fd_, *tls_ctx, host);
+    if (!terr.IsOk()) {
+      Close();
+      return terr;
+    }
+    // short receive timeout: the stream reader must release the SSL
+    // session mutex periodically so concurrent writers (one SSL object is
+    // never safe for simultaneous read+write) get their turn
+    SetSocketTimeout(fd_, SO_RCVTIMEO, 50000);
+  }
   if (keepalive_idle_s > 0) {
     int one = 1;
     ::setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
@@ -353,7 +459,7 @@ Error DuplexConnection::Open(
   if (!has_ct) req << "Content-Type: application/grpc-web+proto\r\n";
   req << "\r\n";
   std::string head = req.str();
-  if (!WriteAll(fd_, head.data(), head.size())) {
+  if (!CWriteAll(ConnRef{fd_, tls_}, head.data(), head.size())) {
     Close();
     return Error("failed to send stream request headers");
   }
@@ -370,7 +476,7 @@ Error DuplexConnection::WriteChunk(const std::string& data) {
   wire.append(size_line, n);
   wire.append(data);
   wire.append("\r\n");
-  if (!WriteAll(fd_, wire.data(), wire.size())) {
+  if (!CWriteAll(ConnRef{fd_, tls_}, wire.data(), wire.size())) {
     return Error("failed to send stream request chunk");
   }
   return Error::Success;
@@ -379,7 +485,7 @@ Error DuplexConnection::WriteChunk(const std::string& data) {
 Error DuplexConnection::WriteEnd() {
   if (fd_ < 0) return Error("stream connection is closed");
   static const char kEnd[] = "0\r\n\r\n";
-  if (!WriteAll(fd_, kEnd, sizeof(kEnd) - 1)) {
+  if (!CWriteAll(ConnRef{fd_, tls_}, kEnd, sizeof(kEnd) - 1)) {
     return Error("failed to finish stream request body");
   }
   return Error::Success;
@@ -388,7 +494,17 @@ Error DuplexConnection::WriteEnd() {
 Error DuplexConnection::Fill(bool* eof) {
   if (eof) *eof = false;
   char chunk[8192];
-  ssize_t r = ::recv(fd_, chunk, sizeof(chunk), 0);
+  ssize_t r;
+  for (;;) {
+    r = tls_ != nullptr
+            ? static_cast<ssize_t>(tls_->Recv(chunk, sizeof(chunk)))
+            : ::recv(fd_, chunk, sizeof(chunk), 0);
+    if (r < 0 && tls_ != nullptr &&
+        (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      continue;  // SO_RCVTIMEO tick: lock released for writers; retry
+    }
+    break;
+  }
   if (r < 0) return Error("connection error while reading stream response");
   if (r == 0) {
     if (eof) {

@@ -9,6 +9,7 @@
 #include <vector>
 
 #include "common.h"
+#include "tls.h"
 
 namespace tc_tpu {
 namespace client {
@@ -46,6 +47,16 @@ class HttpTransport {
   void SetMaxRequestBytes(size_t max_bytes);
   size_t max_request_bytes() const { return max_request_bytes_; }
 
+  // Speak TLS on every connection (reference HttpSslOptions / libcurl
+  // CURLOPT_SSL_*; backed by the system libssl via tls.{h,cc}).  Builds
+  // the shared SSL_CTX once — bad CA/cert/key paths fail HERE, not on the
+  // first request.
+  Error EnableTls(const HttpSslOptionsView& opts);
+  bool tls_enabled() const { return use_tls_; }
+  const TlsContext* tls_context() const {
+    return use_tls_ ? &tls_ctx_ : nullptr;
+  }
+
   HttpTransport(const HttpTransport&) = delete;
   HttpTransport& operator=(const HttpTransport&) = delete;
 
@@ -61,7 +72,12 @@ class HttpTransport {
       RequestTimers* timers = nullptr, uint64_t timeout_us = 0);
 
  private:
-  void Release(int fd, bool reusable);
+  // one pooled connection: the socket plus its TLS session (null = plain)
+  struct Conn {
+    int fd = -1;
+    TlsSession* tls = nullptr;
+  };
+  void Release(Conn conn, bool reusable);
 
   std::string host_;
   int port_;
@@ -70,8 +86,10 @@ class HttpTransport {
   int keepalive_intvl_s_ = 0;
   size_t max_response_bytes_ = 0;
   size_t max_request_bytes_ = 0;
+  bool use_tls_ = false;
+  TlsContext tls_ctx_;
   std::mutex mu_;
-  std::vector<int> idle_;
+  std::vector<Conn> idle_;
 };
 
 std::string Base64Encode(const uint8_t* data, size_t len);
@@ -93,10 +111,11 @@ class DuplexConnection {
   // Connects and sends the request headers (Transfer-Encoding: chunked).
   // keepalive_idle_s > 0 enables TCP keepalive probes on the (long-lived)
   // stream socket — the connection keepalive matters most for.
+  // tls_ctx non-null wraps the stream in TLS before the HTTP exchange.
   Error Open(
       const std::string& host, int port, const std::string& path,
       const Headers& extra_headers, int keepalive_idle_s = 0,
-      int keepalive_intvl_s = 0);
+      int keepalive_intvl_s = 0, const TlsContext* tls_ctx = nullptr);
   // Sends one chunk of request body (thread-safe w.r.t. reads, not writes).
   Error WriteChunk(const std::string& data);
   // Sends the terminal zero chunk: request body complete.
@@ -112,6 +131,7 @@ class DuplexConnection {
 
  private:
   int fd_ = -1;
+  TlsSession* tls_ = nullptr;
   // response framing state
   bool headers_read_ = false;
   bool chunked_ = false;

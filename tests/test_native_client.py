@@ -142,6 +142,30 @@ def test_native_test_binary(native_build, harness, binary):
     assert "FAILED" not in proc.stdout
 
 
+def test_cpp_tls_round_trip(native_build, tmp_path):
+    """Secure C++ transport end-to-end: HTTPS unary infer with CA pinning,
+    rejection of an untrusted CA, and secure gRPC (web framing over TLS)
+    unary + duplex stream against the TLS harness."""
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+    from triton_client_tpu.server.tls import generate_self_signed
+
+    material = generate_self_signed(str(tmp_path))
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry, host="localhost", tls=material) as h:
+        proc = subprocess.run(
+            [os.path.join(native_build, "tls_client_test"),
+             f"localhost:{h.http_port}", material.certfile,
+             material.certfile, material.keyfile],
+            capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, (
+        f"tls_client_test failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    assert "PASS: all" in proc.stdout
+
+
 @pytest.mark.parametrize("lib,allowed", [
     ("libhttpclient.so", ("tc_tpu::client",)),
     ("libgrpcclient.so", ("tc_tpu::client", "inference::")),
