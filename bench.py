@@ -34,6 +34,12 @@ import time
 import numpy as np
 
 
+def _timed_infer(client, model, inputs) -> float:
+    t0 = time.perf_counter()
+    client.infer(model, inputs)
+    return time.perf_counter() - t0
+
+
 def _previous_baseline() -> float | None:
     """Headline value from the earliest recorded round (driver-written
     BENCH_r{N}.json files at the repo root)."""
@@ -263,25 +269,31 @@ def main() -> int:
     # batcher forms full 64-batches AND up to 4 of them pipeline over the
     # device link (at 64 the closed loop admits exactly one batch in flight,
     # serializing on the device round trip).
+    # Solo-latency reference BEFORE the heavy leg: the quiesce barrier
+    # below must compare against an uncongested floor — comparing only
+    # within its own samples mistakes "uniformly congested" for "drained"
+    # (r3: the 256-concurrency backlog outlasted the barrier and starved
+    # the xla-shm sweep to 0 completions).
+    solo_probe = InferenceServerClient(url)
+    qi = dense_inputs()
+    solo = min(_timed_infer(solo_probe, "dense_tpu", qi) for _ in range(3))
+    solo_probe.close()
+
     dense_res = sweep("dense_tpu", dense_inputs, concurrency=256, warmup_s=2.0)
 
     # Quiesce before the next device leg: the 256-concurrency closed loop
     # leaves pipelined batches draining through the tunnel after its window
     # closes, which previously inflated the xla-shm sweep's tail latencies
-    # by 10-100x.  A single request running at near its solo latency means
-    # the link is clear again.
+    # by 10-100x.  Drained = two consecutive probes near the PRE-congestion
+    # solo latency (tunnel RTT drift tolerated via the 2x headroom).
     quiesce = InferenceServerClient(url)
-    qi = dense_inputs()
     time.sleep(1.0)
-    samples: list = []
-    for _ in range(30):
-        t0 = time.perf_counter()
-        quiesce.infer("dense_tpu", qi)
-        samples.append(time.perf_counter() - t0)
-        best = min(samples)
-        # two consecutive probes near the best-seen latency => drained
-        if len(samples) >= 3 and samples[-1] < 1.5 * best \
-                and samples[-2] < 1.5 * best:
+    deadline = time.time() + 120.0
+    last_two: list = []
+    while time.time() < deadline:
+        last_two.append(_timed_infer(quiesce, "dense_tpu", qi))
+        last_two = last_two[-2:]
+        if len(last_two) == 2 and max(last_two) < 2.0 * solo:
             break
         time.sleep(0.5)
     quiesce.close()

@@ -184,15 +184,80 @@ class InferResultGrpcImpl : public InferResult {
   std::map<std::string, int> raw_index_;
 };
 
+// Process-global transport cache (reference channel cache,
+// grpc_client.cc:47-152): up to TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT
+// (default 6) clients of the same url share one transport — its pooled
+// sockets — before a fresh one is created.  (h2 connection pools stay
+// per-client; the shared resource is the transport's socket pool, the
+// closest analog of grpc channel sharing.)
+struct TransportCache {
+  struct Entry {
+    std::shared_ptr<HttpTransport> transport;
+    int share_count = 0;
+  };
+  std::mutex mu;
+  std::map<std::string, std::vector<Entry>> by_url;
+
+  static TransportCache& Get() {
+    static TransportCache* cache = new TransportCache();
+    return *cache;
+  }
+
+  static int MaxShare() {
+    const char* env = getenv("TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT");
+    int n = env != nullptr ? atoi(env) : 6;
+    return n > 0 ? n : 6;
+  }
+
+  std::shared_ptr<HttpTransport> Acquire(
+      const std::string& url, const std::string& host, int port) {
+    int max_share = MaxShare();
+    std::lock_guard<std::mutex> lk(mu);
+    auto& entries = by_url[url];
+    for (auto& e : entries) {
+      if (e.share_count < max_share) {
+        ++e.share_count;
+        return e.transport;
+      }
+    }
+    entries.push_back({std::make_shared<HttpTransport>(host, port, 8), 1});
+    return entries.back().transport;
+  }
+
+  void Release(const std::string& url,
+               const std::shared_ptr<HttpTransport>& transport) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = by_url.find(url);
+    if (it == by_url.end()) return;
+    auto& entries = it->second;
+    for (size_t i = 0; i < entries.size(); ++i) {
+      if (entries[i].transport == transport) {
+        if (--entries[i].share_count <= 0) {
+          entries.erase(entries.begin() + i);
+        }
+        break;
+      }
+    }
+    if (entries.empty()) by_url.erase(it);
+  }
+};
+
 }  // namespace
 
 //==============================================================================
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client,
-    const std::string& server_url, bool verbose) {
+    const std::string& server_url, bool verbose, bool use_cached_channel) {
   client->reset(new InferenceServerGrpcClient(server_url, verbose));
   if ((*client)->transport_->port() <= 0) {
     return Error("invalid server url '" + server_url + "'");
+  }
+  if (use_cached_channel) {
+    auto shared = TransportCache::Get().Acquire(
+        server_url, (*client)->transport_->host(),
+        (*client)->transport_->port());
+    (*client)->transport_ = shared;
+    (*client)->cached_url_ = server_url;
   }
   return Error::Success;
 }
@@ -201,7 +266,8 @@ Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client,
     const std::string& server_url, bool verbose, bool use_ssl,
     const GrpcSslOptions& ssl_options) {
-  TC_RETURN_IF_ERROR(Create(client, server_url, verbose));
+  TC_RETURN_IF_ERROR(Create(client, server_url, verbose,
+                            /*use_cached_channel=*/!use_ssl));
   if (use_ssl) {
     HttpSslOptionsView view;
     view.ca_info = ssl_options.root_certificates;
@@ -220,7 +286,9 @@ Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client,
     const std::string& server_url, bool verbose,
     const KeepAliveOptions& keepalive_options) {
-  TC_RETURN_IF_ERROR(Create(client, server_url, verbose));
+  // keepalive mutates transport state: never share a cached transport
+  TC_RETURN_IF_ERROR(Create(client, server_url, verbose,
+                            /*use_cached_channel=*/false));
   // INT_MAX means "disabled", matching gRPC's default
   if (keepalive_options.keepalive_time_ms > 0 &&
       keepalive_options.keepalive_time_ms != 0x7fffffff) {
@@ -280,6 +348,9 @@ InferenceServerGrpcClient::InferenceServerGrpcClient(
 
 InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   FinishStream();  // closes an open stream; harmless error when none
+  if (!cached_url_.empty()) {
+    TransportCache::Get().Release(cached_url_, transport_);
+  }
   if (stream_reader_.joinable()) stream_reader_.join();
   {
     std::lock_guard<std::mutex> lk(job_mu_);

@@ -86,9 +86,16 @@ class InferenceServerGrpcClient : public InferenceServerClient {
  public:
   using OnCompleteFn = std::function<void(InferResult*)>;
 
+  // `use_cached_channel` shares one transport (socket + h2 connection
+  // pool) among up to TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT clients
+  // of the same url (reference channel cache, grpc_client.cc:47-152,
+  // default 6); false forces a private transport.  Clients created with
+  // keepalive/channel-args/ssl customization always get private
+  // transports (their options mutate transport state).
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
-      const std::string& server_url, bool verbose = false);
+      const std::string& server_url, bool verbose = false,
+      bool use_cached_channel = true);
   // keepalive-configured channel (reference grpc_client.cc Create overload
   // with KeepAliveOptions)
   static Error Create(
@@ -111,10 +118,12 @@ class InferenceServerGrpcClient : public InferenceServerClient {
     std::string private_key;         // client key path (mTLS)
     std::string certificate_chain;   // client cert path (mTLS)
   };
+  // (no default on ssl_options: a 4-arg bool,bool call must bind to the
+  // use_cached_channel overload above, not silently enable TLS)
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
       const std::string& server_url, bool verbose, bool use_ssl,
-      const GrpcSslOptions& ssl_options = GrpcSslOptions());
+      const GrpcSslOptions& ssl_options);
   ~InferenceServerGrpcClient() override;
 
   Error IsServerLive(bool* live, const Headers& headers = Headers());
@@ -249,7 +258,15 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const std::vector<const InferRequestedOutput*>& outputs,
       pb::ModelInferRequest* request);
 
-  std::unique_ptr<HttpTransport> transport_;
+  std::shared_ptr<HttpTransport> transport_;
+  std::string cached_url_;  // non-empty: release the cache ref at dtor
+
+ public:
+  // introspection for tests: how many owners share this client's transport
+  // (cache entry + clients); 1 means a private transport
+  long TransportUseCount() const { return transport_.use_count(); }
+
+ private:
 
   // ---- transport mode: real gRPC (h2c) vs the gRPC-Web bridge ----
   // kUndecided probes on the first RPC: an h2c prior-knowledge handshake

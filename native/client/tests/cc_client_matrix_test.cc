@@ -454,6 +454,46 @@ void TestInferStatAccounting() {
 // -- channel options: keepalive + message-size caps (reference
 // KeepAliveOptions grpc_client.h:62-86, grpc::ChannelArguments usage in
 // simple_grpc_custom_args_client.cc) --------------------------------------
+void TestChannelSharing() {
+  // reference channel cache (grpc_client.cc:47-152,
+  // TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT default 6): clients of the
+  // same url share one transport; customized clients get private ones
+  std::unique_ptr<tc::InferenceServerGrpcClient> a, b, c;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&a, g_grpc_url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&b, g_grpc_url));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&c, g_grpc_url));
+  // 3 clients + the cache entry own the shared transport
+  CHECK_TRUE(a->TransportUseCount() >= 4);
+  CHECK_TRUE(b->TransportUseCount() >= 4);
+  // shared transport serves all of them
+  for (auto* cl : {a.get(), b.get(), c.get()}) {
+    bool live = false;
+    CHECK_OK(cl->IsServerLive(&live));
+    CHECK_TRUE(live);
+  }
+  // opt-out gets a private transport
+  std::unique_ptr<tc::InferenceServerGrpcClient> priv;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(
+      &priv, g_grpc_url, false, /*use_cached_channel=*/false));
+  CHECK_TRUE(priv->TransportUseCount() == 1);
+  // keepalive-customized clients never share (options mutate transports)
+  tc::KeepAliveOptions ka;
+  ka.keepalive_time_ms = 5000;
+  std::unique_ptr<tc::InferenceServerGrpcClient> kac;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&kac, g_grpc_url, false,
+                                                 ka));
+  CHECK_TRUE(kac->TransportUseCount() == 1);
+  // releasing all shared clients empties the cache entry; the next client
+  // builds a fresh shared transport (count = client + cache)
+  a.reset();
+  b.reset();
+  c.reset();
+  std::unique_ptr<tc::InferenceServerGrpcClient> d;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(&d, g_grpc_url));
+  CHECK_TRUE(d->TransportUseCount() == 2);
+  printf("PASS: channel sharing cache\n");
+}
+
 void TestChannelOptions() {
   // keepalive-configured client behaves identically for unary RPCs
   {
@@ -574,6 +614,7 @@ int main(int argc, char** argv) {
   }
   const std::string url = argv[1];
   g_grpc_url = argc > 2 ? argv[2] : argv[1];
+  TestChannelSharing();
   TestChannelOptions();
   TestHttpCompression(url);
   TestReuseInferObjects(url);
