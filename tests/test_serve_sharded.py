@@ -200,6 +200,28 @@ class TestServeMeshSpec:
         with pytest.raises(ValueError, match="decode slots"):
             decode.decode_mesh(language._llama_cfg(), n_slots=8)
 
+    def test_per_model_override_wins(self, monkeypatch):
+        # instance_group analog: TRITON_TPU_SERVE_MESH_<NAME> beats the
+        # global spec for that model only
+        monkeypatch.setenv("TRITON_TPU_SERVE_MESH", "all")
+        monkeypatch.setenv("TRITON_TPU_SERVE_MESH_BERT_LARGE", "tp=2")
+        mesh = tr.serve_mesh(tr.TINY, model_name="bert_large")
+        assert mesh.devices.size == 2 and mesh.shape["tp"] == 2
+        other = tr.serve_mesh(tr.TINY, model_name="llama_tpu")
+        assert other.devices.size == len(jax.devices())
+
+    def test_per_model_override_serves(self, monkeypatch, tokens):
+        # llama_tpu pinned to tp=2 per-model while global stays default
+        base_tok, _ = _serve_llama(monkeypatch, None, tokens)
+        monkeypatch.delenv("TRITON_TPU_SERVE_MESH", raising=False)
+        monkeypatch.setenv("TRITON_TPU_SERVE_MESH_LLAMA_TPU", "tp=2")
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        with ServerHarness(registry) as h:
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                got, _ = _infer_llama(client, httpclient, tokens)
+        np.testing.assert_array_equal(got, base_tok)
+
     def test_default_is_single_device(self):
         mesh = tr.serve_mesh(tr.TINY, spec="1")
         assert mesh.devices.size == 1

@@ -65,7 +65,8 @@ def quantize_layer_weights(params, cfg: tr.TransformerConfig):
     return out
 
 
-def decode_mesh(cfg: tr.TransformerConfig, n_slots: int = 1):
+def decode_mesh(cfg: tr.TransformerConfig, n_slots: int = 1,
+                model_name=None):
     """Serve mesh for the decode stack, from ``TRITON_TPU_SERVE_MESH``.
 
     Decode shards over **tp** (attention heads / FFN hidden) and **dp**
@@ -74,11 +75,9 @@ def decode_mesh(cfg: tr.TransformerConfig, n_slots: int = 1):
     their devices on tp then dp, and explicit shape specs must keep
     pp=ep=sp=1.  Returns a full 5-axis mesh (trivial extra axes) so
     ``tr.param_specs`` placements apply unchanged."""
-    import os
-
     from .. import parallel
 
-    spec = os.environ.get("TRITON_TPU_SERVE_MESH", "1").strip().lower()
+    spec = tr.serve_mesh_spec(model_name).strip().lower()
     devices = jax.devices()
     explicit = tr.parse_serve_shape(spec)
     if explicit is not None:
@@ -603,7 +602,8 @@ class DecodeModel:
             # commit to the serve mesh: GSPMD partitions the jitted
             # prefill/step from these shardings (tp over heads; one-device
             # mesh when TRITON_TPU_SERVE_MESH is unset)
-            mesh = decode_mesh(cfg, n_slots=self._n_slots)
+            mesh = decode_mesh(cfg, n_slots=self._n_slots,
+                               model_name=self._model.name)
             params = place_decode_params(params, mesh, cfg)
             self._mesh = mesh
             self._params = (params, cfg)

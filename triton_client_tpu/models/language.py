@@ -146,9 +146,11 @@ class _LazyTransformer:
     back after the forward; the dynamic batcher's preferred sizes keep the
     padded-shape set bounded so XLA compiles a handful of shapes."""
 
-    def __init__(self, cfg: tr.TransformerConfig, seed: int):
+    def __init__(self, cfg: tr.TransformerConfig, seed: int,
+                 model_name: str = None):
         self.cfg = cfg
         self._seed = seed
+        self._model_name = model_name
         self._fwd = None
         self._params = None
         self._mesh = None
@@ -163,7 +165,8 @@ class _LazyTransformer:
         import jax
 
         if self._fwd is None:
-            self._mesh = tr.serve_mesh(self.cfg)
+            self._mesh = tr.serve_mesh(self.cfg,
+                                       model_name=self._model_name)
             params = tr.init_params(jax.random.PRNGKey(self._seed), self.cfg)
             self._params = tr.place_params(params, self._mesh, self.cfg)
             self._fwd = tr.make_forward(self._mesh, self.cfg)
@@ -198,7 +201,7 @@ def make_bert_large() -> JaxModel:
         max_queue_delay_us=3000,
         instance_kind="KIND_TPU",
     )
-    run = _LazyTransformer(BERT_LARGE, seed=24)
+    run = _LazyTransformer(BERT_LARGE, seed=24, model_name="bert_large")
 
     def fn(INPUT_IDS):
         import jax.numpy as jnp
@@ -229,7 +232,7 @@ def make_longctx_tpu() -> JaxModel:
         max_queue_delay_us=2000,
         instance_kind="KIND_TPU",
     )
-    run = _LazyTransformer(longctx_cfg(), seed=11)
+    run = _LazyTransformer(longctx_cfg(), seed=11, model_name="longctx_tpu")
 
     def fn(TOKENS):
         import jax
@@ -285,7 +288,7 @@ def make_moe_tpu() -> JaxModel:
         max_queue_delay_us=2000,
         instance_kind="KIND_TPU",
     )
-    run = _LazyTransformer(moe_cfg(), seed=17)
+    run = _LazyTransformer(moe_cfg(), seed=17, model_name="moe_tpu")
 
     def fn(TOKENS):
         import jax.numpy as jnp
@@ -346,7 +349,7 @@ def make_llama_tpu() -> JaxModel:
         import jax.numpy as jnp
 
         if "run" not in state:
-            state["run"] = _LazyTransformer(_llama_cfg(), seed=3)
+            state["run"] = _LazyTransformer(_llama_cfg(), seed=3, model_name="llama_tpu")
         run = state["run"]
         tokens = jnp.clip(TOKENS, 0, run.cfg.vocab_size - 1)
         logits = run(tokens)[:, -1, :]  # [B, vocab]

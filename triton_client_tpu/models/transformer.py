@@ -110,7 +110,8 @@ def make_mesh(n_devices: Optional[int] = None,
         mesh_shape_for(len(devices), cfg), MESH_AXES, devices)
 
 
-def serve_mesh(cfg: TransformerConfig, spec: Optional[str] = None) -> Mesh:
+def serve_mesh(cfg: TransformerConfig, spec: Optional[str] = None,
+               model_name: Optional[str] = None) -> Mesh:
     """The mesh SERVED models place params/forward over, from
     ``TRITON_TPU_SERVE_MESH`` (or an explicit ``spec``).
 
@@ -127,9 +128,14 @@ def serve_mesh(cfg: TransformerConfig, spec: Optional[str] = None) -> Mesh:
     - an explicit shape ``"dp=1,pp=2,ep=2,sp=1,tp=2"`` — exact axis sizes
       (unlisted axes default to 1); lets deployments pin e.g. expert
       parallelism where the greedy split would not pick it.
+
+    Per-model override (instance_group analog): when ``model_name`` is
+    given, ``TRITON_TPU_SERVE_MESH_<MODEL_NAME>`` (upper-cased, non-
+    alphanumerics as ``_``) wins over the global var — heterogeneous
+    placement like bert on 4 chips while llama takes all 8.
     """
     if spec is None:
-        spec = os.environ.get("TRITON_TPU_SERVE_MESH", "1")
+        spec = serve_mesh_spec(model_name)
     spec = spec.strip().lower()
     devices = jax.devices()
     shape = parse_serve_shape(spec)
@@ -142,6 +148,18 @@ def serve_mesh(cfg: TransformerConfig, spec: Optional[str] = None) -> Mesh:
                 f"have {len(devices)}")
         return parallel.build_mesh(shape, MESH_AXES, devices[:n])
     return make_mesh(resolve_serve_count(spec, len(devices)), cfg)
+
+
+def serve_mesh_spec(model_name: Optional[str] = None) -> str:
+    """Resolve the serve-mesh spec string: per-model env override first
+    (``TRITON_TPU_SERVE_MESH_<NAME>``), then the global, then ``"1"``."""
+    if model_name:
+        key = "TRITON_TPU_SERVE_MESH_" + "".join(
+            c if c.isalnum() else "_" for c in model_name.upper())
+        per_model = os.environ.get(key)
+        if per_model is not None:
+            return per_model
+    return os.environ.get("TRITON_TPU_SERVE_MESH", "1")
 
 
 def parse_serve_shape(spec: str) -> Optional[Dict[str, int]]:
