@@ -77,33 +77,34 @@ def decode_mesh(cfg: tr.TransformerConfig, n_slots: int = 1,
     ``tr.param_specs`` placements apply unchanged."""
     from .. import parallel
 
-    spec = tr.serve_mesh_spec(model_name).strip().lower()
+    spec, var = tr.resolve_serve_spec(model_name)
+    spec = spec.strip().lower()
     devices = jax.devices()
-    explicit = tr.parse_serve_shape(spec)
+    explicit = tr.parse_serve_shape(spec, var)
     if explicit is not None:
         bad = [a for a in ("pp", "ep", "sp") if explicit[a] > 1]
         if bad:
             raise ValueError(
-                f"TRITON_TPU_SERVE_MESH={spec!r}: decode serving shards "
+                f"{var}={spec!r}: decode serving shards "
                 f"over tp/dp only; {','.join(bad)} must be 1")
         # config-time divisibility so a bad spec is a readable error, not
         # a jax.device_put crash at the first request
         if explicit["tp"] > 1 and cfg.n_heads % explicit["tp"] != 0:
             raise ValueError(
-                f"TRITON_TPU_SERVE_MESH={spec!r}: tp={explicit['tp']} "
+                f"{var}={spec!r}: tp={explicit['tp']} "
                 f"must divide n_heads={cfg.n_heads}")
         if explicit["dp"] > 1 and n_slots % explicit["dp"] != 0:
             raise ValueError(
-                f"TRITON_TPU_SERVE_MESH={spec!r}: dp={explicit['dp']} "
+                f"{var}={spec!r}: dp={explicit['dp']} "
                 f"must divide the {n_slots} decode slots "
                 "(TRITON_TPU_DECODE_SLOTS)")
         n = math.prod(explicit.values())
         if n > len(devices):
             raise ValueError(
-                f"TRITON_TPU_SERVE_MESH={spec!r} needs {n} devices, "
+                f"{var}={spec!r} needs {n} devices, "
                 f"have {len(devices)}")
         return parallel.build_mesh(explicit, tr.MESH_AXES, devices[:n])
-    n = tr.resolve_serve_count(spec, len(devices))
+    n = tr.resolve_serve_count(spec, len(devices), var)
     # largest power-of-two head split, then slots onto dp
     tp = 1
     while tp * 2 <= n and cfg.n_heads % (tp * 2) == 0:
@@ -1204,6 +1205,19 @@ class GenerateModel:
 
 
 def make_llama_generate(decode: DecodeModel):
+    # llama_generate SHARES the DecodeModel's weights and mesh (one weight
+    # set by design), so its placement follows the decode model's override
+    # — a generate-name mesh var would be a silent no-op; warn instead
+    import os
+    import warnings
+
+    key = tr.serve_mesh_env_key("llama_generate")
+    if os.environ.get(key) is not None:
+        warnings.warn(
+            f"{key} is ignored: llama_generate shares llama_decode's "
+            f"weights and mesh — set "
+            f"{tr.serve_mesh_env_key(decode.model.name)} instead",
+            stacklevel=2)
     return GenerateModel(decode).model
 
 

@@ -134,35 +134,50 @@ def serve_mesh(cfg: TransformerConfig, spec: Optional[str] = None,
     alphanumerics as ``_``) wins over the global var — heterogeneous
     placement like bert on 4 chips while llama takes all 8.
     """
+    var = "TRITON_TPU_SERVE_MESH"
     if spec is None:
-        spec = serve_mesh_spec(model_name)
+        spec, var = resolve_serve_spec(model_name)
     spec = spec.strip().lower()
     devices = jax.devices()
-    shape = parse_serve_shape(spec)
+    shape = parse_serve_shape(spec, var)
     if shape is not None:
-        _check_axis_divisibility(shape, cfg, spec)
+        _check_axis_divisibility(shape, cfg, spec, var)
         n = math.prod(shape.values())
         if n > len(devices):
             raise ValueError(
-                f"TRITON_TPU_SERVE_MESH={spec!r} needs {n} devices, "
+                f"{var}={spec!r} needs {n} devices, "
                 f"have {len(devices)}")
         return parallel.build_mesh(shape, MESH_AXES, devices[:n])
-    return make_mesh(resolve_serve_count(spec, len(devices)), cfg)
+    return make_mesh(resolve_serve_count(spec, len(devices), var), cfg)
 
 
 def serve_mesh_spec(model_name: Optional[str] = None) -> str:
     """Resolve the serve-mesh spec string: per-model env override first
     (``TRITON_TPU_SERVE_MESH_<NAME>``), then the global, then ``"1"``."""
+    return resolve_serve_spec(model_name)[0]
+
+
+def serve_mesh_env_key(model_name: str) -> str:
+    return "TRITON_TPU_SERVE_MESH_" + "".join(
+        c if c.isalnum() else "_" for c in model_name.upper())
+
+
+def resolve_serve_spec(
+        model_name: Optional[str] = None) -> Tuple[str, str]:
+    """(spec, env var that supplied it) — errors must blame the variable
+    the operator actually set, not always the global."""
     if model_name:
-        key = "TRITON_TPU_SERVE_MESH_" + "".join(
-            c if c.isalnum() else "_" for c in model_name.upper())
+        key = serve_mesh_env_key(model_name)
         per_model = os.environ.get(key)
         if per_model is not None:
-            return per_model
-    return os.environ.get("TRITON_TPU_SERVE_MESH", "1")
+            return per_model, key
+    return os.environ.get("TRITON_TPU_SERVE_MESH", "1"), \
+        "TRITON_TPU_SERVE_MESH"
 
 
-def parse_serve_shape(spec: str) -> Optional[Dict[str, int]]:
+def parse_serve_shape(
+        spec: str,
+        var: str = "TRITON_TPU_SERVE_MESH") -> Optional[Dict[str, int]]:
     """Parse an explicit ``"dp=1,tp=2"`` mesh-shape spec into a full 5-axis
     shape dict (unlisted axes 1); returns None for count-style specs
     ("all" / an integer).  Axis sizes must be positive; axis names must be
@@ -176,34 +191,36 @@ def parse_serve_shape(spec: str) -> Optional[Dict[str, int]]:
         ax = ax.strip()
         if ax not in MESH_AXES:
             raise ValueError(
-                f"TRITON_TPU_SERVE_MESH: unknown mesh axis {ax!r}; "
+                f"{var}: unknown mesh axis {ax!r}; "
                 f"valid axes are {MESH_AXES}")
         size = int(v)
         if size < 1:
             raise ValueError(
-                f"TRITON_TPU_SERVE_MESH: axis {ax}={size} must be >= 1")
+                f"{var}: axis {ax}={size} must be >= 1")
         shape[ax] = size
     for ax in MESH_AXES:
         shape.setdefault(ax, 1)
     return shape
 
 
-def resolve_serve_count(spec: str, n_avail: int) -> int:
+def resolve_serve_count(spec: str, n_avail: int,
+                        var: str = "TRITON_TPU_SERVE_MESH") -> int:
     """Resolve a count-style spec ("all" / integer) to a device count."""
     try:
         n = n_avail if spec == "all" else int(spec)
     except ValueError:
         raise ValueError(
-            f"TRITON_TPU_SERVE_MESH={spec!r}: expected '1', 'all', a "
+            f"{var}={spec!r}: expected '1', 'all', a "
             "device count, or an explicit 'dp=..,tp=..' shape")
     if not 1 <= n <= n_avail:
         raise ValueError(
-            f"TRITON_TPU_SERVE_MESH={spec!r}: need 1..{n_avail} devices")
+            f"{var}={spec!r}: need 1..{n_avail} devices")
     return n
 
 
 def _check_axis_divisibility(shape: Dict[str, int], cfg: TransformerConfig,
-                             spec: str) -> None:
+                             spec: str,
+                             var: str = "TRITON_TPU_SERVE_MESH") -> None:
     """Model-dimension divisibility for an explicit spec, checked at parse
     time so misconfiguration is a readable error, not a jit crash."""
     checks = [("tp", cfg.n_heads, "n_heads"), ("pp", cfg.n_layers,
@@ -213,7 +230,7 @@ def _check_axis_divisibility(shape: Dict[str, int], cfg: TransformerConfig,
     for ax, dim, dim_name in checks:
         if shape[ax] > 1 and dim % shape[ax] != 0:
             raise ValueError(
-                f"TRITON_TPU_SERVE_MESH={spec!r}: {ax}={shape[ax]} must "
+                f"{var}={spec!r}: {ax}={shape[ax]} must "
                 f"divide {dim_name}={dim}")
 
 
