@@ -146,8 +146,10 @@ class TestSlotKernels:
             if b_active:
                 tokens[1] = got_b[-1]
                 active[1] = True
-            nxt, best, k, v = sstep(params, k, v, jnp.asarray(tokens),
-                                    jnp.asarray(pos), jnp.asarray(active))
+            prev = jnp.zeros(self.N_SLOTS, jnp.int32)
+            nxt, best, k, v = sstep(params, k, v, jnp.asarray(tokens), prev,
+                                    jnp.asarray(pos), jnp.asarray(active),
+                                    jnp.zeros(self.N_SLOTS, bool))
             got_a.append(int(nxt[0]))
             pos[0] += 1
             if b_active:
@@ -380,8 +382,9 @@ class TestMoeDecode:
         for _ in range(3):
             toks[0] = got[-1]
             nxts, bests, k, v = slot_step(
-                moe_params, k, v, jnp.asarray(toks), jnp.asarray(pos),
-                jnp.asarray(act))
+                moe_params, k, v, jnp.asarray(toks),
+                jnp.zeros(n_slots, jnp.int32), jnp.asarray(pos),
+                jnp.asarray(act), jnp.zeros(n_slots, bool))
             got.append(int(nxts[0]))
             pos[0] += 1
         assert got == want
@@ -554,8 +557,9 @@ class TestChunkedPrefill:
         # ...then A ticks while B is mid-prefill (B inactive, pos[1]=0)
         nxt, _, k, v = sstep(params, k, v,
                              jnp.asarray(np.array([int(ta), 0], np.int32)),
-                             jnp.asarray(pos),
-                             jnp.asarray(np.array([True, False])))
+                             jnp.zeros(2, jnp.int32), jnp.asarray(pos),
+                             jnp.asarray(np.array([True, False])),
+                             jnp.zeros(2, bool))
         pos[0] += 1
         # B's final chunk, then B decodes
         tb, _, k, v = cp(params, k, v, win_b[:, 4:], 1, 4)
@@ -564,8 +568,9 @@ class TestChunkedPrefill:
         for _ in range(2):
             toks = np.array([int(nxt[0]), got_b[-1]], np.int32)
             nxt, _, k, v = sstep(params, k, v, jnp.asarray(toks),
-                                 jnp.asarray(pos),
-                                 jnp.asarray(np.array([True, True])))
+                                 jnp.zeros(2, jnp.int32), jnp.asarray(pos),
+                                 jnp.asarray(np.array([True, True])),
+                                 jnp.zeros(2, bool))
             got_b.append(int(nxt[1]))
             pos += 1
         assert got_b == want_b
@@ -581,6 +586,117 @@ class TestChunkedPrefill:
         _, _, k, v = cp(params, k, v, prompt, 1, 0)
         np.testing.assert_array_equal(np.asarray(k[:, 0], np.float32), 1.0)
         np.testing.assert_array_equal(np.asarray(v[:, 0], np.float32), 1.0)
+
+
+class TestBatchedGeneration:
+    """Continuous batching for SERVER-SIDE generation: concurrent greedy
+    /generate requests share one batched device step per tick, with the
+    feedback token never leaving the device."""
+
+    @pytest.fixture()
+    def gen_pair(self, monkeypatch):
+        from triton_client_tpu.models.decode import (DecodeModel,
+                                                     GenerateModel)
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        monkeypatch.setenv("TRITON_TPU_PREFILL_CHUNK", "32")
+        batched = DecodeModel(name="llama_decode_genb")
+        gen_batched = GenerateModel(batched, name="llama_generate_genb")
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "independent")
+        independent = DecodeModel(name="llama_decode_geni")
+        gen_ind = GenerateModel(independent, name="llama_generate_geni")
+        yield gen_batched, gen_ind
+        batched._shutdown()
+        independent._shutdown()
+
+    @staticmethod
+    def _tokens(gen_model, prompt, n):
+        out = [f["token_id"][0] for f in gen_model._generate(
+            {"text_input": np.array([prompt], object)},
+            {"max_tokens": n})]
+        return [int(t) for t in out]
+
+    def test_batched_matches_independent_chain(self, gen_pair):
+        gen_batched, gen_ind = gen_pair
+        want = self._tokens(gen_ind, b"generate me please", 6)
+        got = self._tokens(gen_batched, b"generate me please", 6)
+        assert got == want and len(got) == 6
+
+    def test_concurrent_generations_match_serial(self, gen_pair):
+        import threading
+
+        gen_batched, _ = gen_pair
+        prompts = {w: f"concurrent gen {w}".encode() for w in range(3)}
+        want = {w: self._tokens(gen_batched, p, 5)
+                for w, p in prompts.items()}
+        got, errors = {}, []
+
+        def worker(w):
+            try:
+                got[w] = self._tokens(gen_batched, prompts[w], 5)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((w, exc))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        assert got == want
+
+    def test_generation_interleaves_with_decode_sequences(self, gen_pair):
+        import threading
+
+        gen_batched, _ = gen_pair
+        dec = gen_batched._decode
+        win = np.zeros((128,), np.int32)
+        win[-4:] = [10, 20, 30, 40]
+
+        seq_tokens = []
+
+        def seq_worker():
+            res = dec._execute({"TOKENS": win},
+                               {"sequence_id": 7100,
+                                "sequence_start": True})
+            for i in range(4):
+                tok = res["NEXT_TOKEN"]
+                seq_tokens.append(int(tok[0]))
+                res = dec._execute({"TOKENS": tok},
+                                   {"sequence_id": 7100,
+                                    "sequence_end": i == 3})
+
+        t = threading.Thread(target=seq_worker, daemon=True)
+        t.start()
+        gen = self._tokens(gen_batched, b"interleaved stream", 6)
+        t.join(timeout=300)
+        assert not t.is_alive()
+        assert len(gen) == 6 and len(seq_tokens) == 4
+        # the interleaved run must equal an uncontended serial rerun
+        assert gen == self._tokens(gen_batched, b"interleaved stream", 6)
+
+    def test_slot_exhaustion_is_429(self, gen_pair):
+        from triton_client_tpu.server.types import InferError
+
+        gen_batched, _ = gen_pair
+        dec = gen_batched._decode
+        win = np.zeros((1, 128), np.int32)
+        sinks = [dec.submit_generation(win, 3) for _ in range(4)]
+        with pytest.raises(InferError) as e:
+            dec.submit_generation(win, 3)
+        assert e.value.http_status == 429
+        for s in sinks:  # drain so slots free cleanly
+            while s.get(timeout=300) is not None:
+                pass
+
+    def test_sampled_requests_fall_back_to_chain(self, gen_pair):
+        gen_batched, _ = gen_pair
+        toks = [f["token_id"][0] for f in gen_batched._generate(
+            {"text_input": np.array([b"sample me"], object)},
+            {"max_tokens": 5, "temperature": 1.5, "seed": 3})]
+        assert len(toks) == 5
 
 
 class TestMoePresetServing:

@@ -65,6 +65,14 @@ def quantize_layer_weights(params, cfg: tr.TransformerConfig):
     return out
 
 
+def _stale_error(model_name: str):
+    from ..server.types import InferError
+
+    return InferError(
+        f"model '{model_name}': generation slot was reclaimed before it "
+        "executed")
+
+
 def decode_mesh(cfg: tr.TransformerConfig, n_slots: int = 1,
                 model_name=None):
     """Serve mesh for the decode stack, from ``TRITON_TPU_SERVE_MESH``.
@@ -355,17 +363,23 @@ def _slot_decode_layer(blk, x, kc, vc, pos, active,
 
 
 def make_slot_step(cfg: tr.TransformerConfig):
-    """jitted (params, k [L,B,H,S,K], v, tokens [B], pos [B],
-    active [B] bool) -> (greedy tokens [B] int32, best logits [B] f32,
-    k', v').
+    """jitted (params, k [L,B,H,S,K], v, tokens [B], prev [B], pos [B],
+    active [B] bool, auto [B] bool) -> (greedy tokens [B] int32, best
+    logits [B] f32, k', v').
 
     Every slot computes, but only ACTIVE slots write K/V — inactive slots
     (no pending request this tick, or mid-chunked-prefill) leave the cache
     untouched; callers ignore their outputs and do not advance their
-    host-side pos."""
+    host-side pos.
+
+    AUTO slots take their input token from ``prev`` — the previous tick's
+    device-resident output — instead of the host ``tokens`` array: the
+    server-side continuous-batching generation path, where the greedy
+    feedback loop never leaves the device (no host round trip per token)."""
 
     @jax.jit
-    def step(params, k, v, tokens, pos, active):
+    def step(params, k, v, tokens, prev, pos, active, auto):
+        tokens = jnp.where(auto, prev, tokens)
         x = jnp.take(params["embed"].astype(cfg.dtype),
                      tokens[:, None], axis=0)                     # [B,1,D]
         blocks = _layer_blocks(params, cfg)
@@ -636,6 +650,16 @@ class DecodeModel:
                         jnp.zeros(shape, cfg.dtype), cache_sharding)
                     self._v = jax.device_put(
                         jnp.zeros(shape, cfg.dtype), cache_sharding)
+                    # device-resident previous-tick outputs: the feedback
+                    # for self-feeding (server-side generation) slots
+                    self._prev_nxt = jnp.zeros(self._n_slots, jnp.int32)
+                    # worker-owned self-feeding slot registry
+                    self._auto_slots = {}
+                    # (slot, gen) pairs whose sink resolution failed; the
+                    # worker reaps them (lock-guarded: resolvers write)
+                    self._dead_gens = set()
+                    # bound device dispatch ahead of readbacks
+                    self._tick_budget = self._threading.Semaphore(4)
                     self._pos = np.zeros(self._n_slots, np.int32)
                     self._jobs = _queue.Queue()
                     import concurrent.futures as _cf
@@ -643,6 +667,12 @@ class DecodeModel:
                     self._readers = _cf.ThreadPoolExecutor(
                         max_workers=4,
                         thread_name_prefix=f"{self._model.name}-readback")
+                    # generation sinks REQUIRE per-slot ordering (a token
+                    # landing after the end sentinel would be dropped), so
+                    # their resolutions serialize on one dedicated thread
+                    self._gen_reader = _cf.ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix=f"{self._model.name}-gen")
                     self._worker = self._threading.Thread(
                         target=self._worker_loop, daemon=True,
                         name=f"{self._model.name}-decode-worker")
@@ -722,76 +752,147 @@ class DecodeModel:
                 f"model '{self._model.name}': sequence was evicted or "
                 "ended before this request executed"))
 
+        def deliver_error(completion, err):
+            """Route a failure to a prefill completion: futures directly,
+            generation sinks through the ordered gen reader (an error put
+            racing ahead of an already-queued token would truncate the
+            stream)."""
+            if completion[0] == "fut":
+                completion[1].set_exception(err)
+            else:
+                self._gen_reader.submit(completion[2].put, err)
+
         def drain_and_fail():
             from ..server.types import InferError
 
+            err = InferError(
+                f"model '{self._model.name}' is unloading", 503)
             while True:
                 try:
                     j = self._jobs.get_nowait()
                 except _queue.Empty:
-                    return
+                    break
                 if j is None:
                     continue
-                j[2].set_exception(InferError(
-                    f"model '{self._model.name}' is unloading", 503))
+                if j[0] in ("prefill", "prefill_cont"):
+                    deliver_error(j[1][-1], err)
+                elif j[0] == "step":
+                    j[2].set_exception(err)
+            for slot, info in self._auto_slots.items():
+                self._gen_reader.submit(info["sink"].put, err)
+            self._auto_slots.clear()
+
+        def finish_prefill(slot, gen, win_len, nxt_dev, best_dev,
+                           completion):
+            """Prefill finished: deliver the first token.  Sequence path
+            resolves the client future; generation path streams the token,
+            seeds the device-side feedback for tick 1, and registers the
+            slot as self-feeding."""
+            self._pos[slot] = win_len
+            if completion[0] == "fut":
+                pair = jnp.stack([nxt_dev.astype(jnp.float32), best_dev])
+                if hasattr(pair, "copy_to_host_async"):
+                    pair.copy_to_host_async()
+                # pipelined like step readbacks: the blocking D2H must not
+                # stall the tick loop for a device round trip
+                self._readers.submit(self._resolve_prefill, pair,
+                                     completion[1])
+                return
+            _tag, n_tokens, sink = completion
+            self._prev_nxt = self._prev_nxt.at[slot].set(nxt_dev)
+            if hasattr(nxt_dev, "copy_to_host_async"):
+                nxt_dev.copy_to_host_async()
+            self._gen_reader.submit(self._resolve_gen_token, nxt_dev,
+                                    sink, n_tokens == 1, slot, gen)
+            if n_tokens > 1:
+                self._auto_slots[slot] = {
+                    "remaining": n_tokens - 1, "sink": sink, "gen": gen}
+            else:
+                self._release_gen_slot(slot)
+
+        def reap_dead_gens():
+            """Drop self-feeding slots whose sink resolution failed — the
+            consumer already got the error; without this the worker would
+            tick a dead generation to completion while new submissions 429
+            against its slot."""
+            with self._lock:
+                dead = list(self._dead_gens)
+                self._dead_gens.clear()
+            for slot, gen in dead:
+                info = self._auto_slots.get(slot)
+                if info is not None and info["gen"] == gen:
+                    self._auto_slots.pop(slot)
+                    self._release_gen_slot(slot)
 
         while True:
-            job = self._jobs.get()
+            if self._dead_gens:
+                reap_dead_gens()
+            if self._auto_slots:
+                # self-feeding generations in flight: never block — tick
+                # them even when no client job is queued
+                try:
+                    job = self._jobs.get_nowait()
+                except _queue.Empty:
+                    job = ("tick", None, None)
+            else:
+                job = self._jobs.get()
             if job is None:
                 drain_and_fail()
                 return
             kind, payload, fut = job
+            # One prefill flow serves both completions: ("fut", future) for
+            # the sequence protocol, ("gen", n_tokens, sink) for the
+            # self-feeding generation path.
             if kind == "prefill":
-                slot, gen, win = payload
+                slot, gen, win, completion = payload
                 if gen != self._slot_gen[slot]:
-                    fail_stale(fut)
+                    deliver_error(completion,
+                                  _stale_error(self._model.name))
                     continue
                 C = self._prefill_chunk
-                if C and win.shape[1] > C:
-                    # chunked: run the first chunk now, re-enqueue the
-                    # continuation at the queue tail so pending decode
-                    # steps tick in between (no cohort-wide prefill stall)
-                    try:
+                try:
+                    if C and win.shape[1] > C:
+                        # chunked: run the first chunk now, re-enqueue the
+                        # continuation at the queue tail so pending decode
+                        # steps tick in between (no cohort-wide stall)
                         _, _, self._k, self._v = self._chunk_fn(
                             params, self._k, self._v,
                             jnp.asarray(win[:, :C]), slot, 0)
-                    except Exception as e:  # noqa: BLE001 — via future
-                        fut.set_exception(e)
+                        self._jobs.put(("prefill_cont",
+                                        (slot, gen, win, C, completion),
+                                        None))
                         continue
-                    self._jobs.put(
-                        ("prefill_cont", (slot, gen, win, C), fut))
-                    continue
-                try:
                     nxt, best, self._k, self._v = prefill(
                         params, self._k, self._v, jnp.asarray(win), slot)
-                    self._pos[slot] = win.shape[1]
-                    pair = jnp.stack([nxt.astype(jnp.float32), best])
-                    # pipelined like step readbacks: the blocking D2H must
-                    # not stall the tick loop for a device round trip
-                    self._readers.submit(self._resolve_prefill, pair, fut)
-                except Exception as e:  # noqa: BLE001 — surfaced via future
-                    fut.set_exception(e)
+                    finish_prefill(slot, gen, win.shape[1], nxt, best,
+                                   completion)
+                except Exception as e:  # noqa: BLE001 — via completion
+                    deliver_error(completion, e)
+                    if completion[0] == "gen":
+                        self._release_gen_slot(slot)
                 continue
             if kind == "prefill_cont":
-                slot, gen, win, pos0 = payload
+                slot, gen, win, pos0, completion = payload
                 if gen != self._slot_gen[slot]:
-                    fail_stale(fut)
+                    deliver_error(completion,
+                                  _stale_error(self._model.name))
                     continue
                 C = self._prefill_chunk
                 try:
                     nxt, best, self._k, self._v = self._chunk_fn(
                         params, self._k, self._v,
                         jnp.asarray(win[:, pos0:pos0 + C]), slot, pos0)
-                except Exception as e:  # noqa: BLE001 — via future
-                    fut.set_exception(e)
-                    continue
-                if pos0 + C < win.shape[1]:
-                    self._jobs.put(
-                        ("prefill_cont", (slot, gen, win, pos0 + C), fut))
-                    continue
-                self._pos[slot] = win.shape[1]
-                pair = jnp.stack([nxt.astype(jnp.float32), best])
-                self._readers.submit(self._resolve_prefill, pair, fut)
+                    if pos0 + C < win.shape[1]:
+                        self._jobs.put(("prefill_cont",
+                                        (slot, gen, win, pos0 + C,
+                                         completion), None))
+                        continue
+                    finish_prefill(slot, gen, win.shape[1], nxt, best,
+                                   completion)
+                except Exception as e:  # noqa: BLE001 — via completion
+                    deliver_error(completion, e)
+                    if completion[0] == "gen":
+                        self._release_gen_slot(slot)
                 continue
             # Merge steps into this tick. A short accumulation window is
             # load-bearing: the previous tick resolves every stream's
@@ -812,52 +913,91 @@ class DecodeModel:
                 batch.append(((slot, tok), f))
                 seen.add(slot)
 
-            admit(payload, fut)
-            deadline = time.monotonic() + self.TICK_ACCUMULATE_S
-            while len(seen) < self._n_slots and not closing:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    nxt_job = self._jobs.get(timeout=timeout)
-                except _queue.Empty:
-                    break
-                if nxt_job is None:
-                    deferred.append(None)
-                    closing = True
-                    break
-                k2, p2, f2 = nxt_job
-                if k2 == "step" and p2[0] not in seen:
-                    admit(p2, f2)
-                else:
-                    deferred.append(nxt_job)
-            for d in deferred:
-                self._jobs.put(d)
-            if not batch:
+            if kind == "step":
+                admit(payload, fut)
+                deadline = time.monotonic() + self.TICK_ACCUMULATE_S
+                while len(seen) < self._n_slots and not closing:
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0:
+                        break
+                    try:
+                        nxt_job = self._jobs.get(timeout=timeout)
+                    except _queue.Empty:
+                        break
+                    if nxt_job is None:
+                        deferred.append(None)
+                        closing = True
+                        break
+                    k2, p2, f2 = nxt_job
+                    if k2 == "step" and p2[0] not in seen:
+                        admit(p2, f2)
+                    else:
+                        deferred.append(nxt_job)
+                for d in deferred:
+                    self._jobs.put(d)
+            if not batch and not self._auto_slots:
                 continue
             tokens = np.zeros(self._n_slots, np.int32)
             active = np.zeros(self._n_slots, bool)
+            auto = np.zeros(self._n_slots, bool)
             for (slot, tok), _ in batch:
                 tokens[slot] = tok
                 active[slot] = True
+            gen_slots = list(self._auto_slots)
+            for slot in gen_slots:
+                active[slot] = True
+                auto[slot] = True
+            # bound how far device dispatch runs ahead of readbacks: a
+            # pure-auto loop would otherwise enqueue ticks unboundedly
+            self._tick_budget.acquire()
             try:
                 nxt, best, self._k, self._v = step(
                     params, self._k, self._v, jnp.asarray(tokens),
-                    jnp.asarray(self._pos), jnp.asarray(active))
+                    self._prev_nxt, jnp.asarray(self._pos),
+                    jnp.asarray(active), jnp.asarray(auto))
+                self._prev_nxt = nxt
                 pair = jnp.stack([nxt.astype(jnp.float32), best])
+                if hasattr(pair, "copy_to_host_async"):
+                    # prefetch the D2H NOW: the resolver threads then find
+                    # the transfer already in flight, so readbacks overlap
+                    # later ticks instead of costing one RTT each (the
+                    # same trick the per-request generation chain uses)
+                    pair.copy_to_host_async()
                 for (slot, tok), _ in batch:
                     self._pos[slot] += 1
+                for slot in gen_slots:
+                    self._pos[slot] += 1
             except Exception as e:  # noqa: BLE001 — surfaced via futures
+                self._tick_budget.release()
                 for _, f in batch:
                     f.set_exception(e)
+                for slot in gen_slots:
+                    info = self._auto_slots.pop(slot)
+                    self._gen_reader.submit(info["sink"].put, e)
+                    self._release_gen_slot(slot)
                 continue
+            # which generations end on this tick (token streamed, then the
+            # slot frees; the readback snapshot keeps its values valid even
+            # if the slot is reused by a later tick)
+            gen_batch = []
+            for slot in gen_slots:
+                info = self._auto_slots[slot]
+                info["remaining"] -= 1
+                done = info["remaining"] <= 0
+                if done or self._pos[slot] >= self._s_max:
+                    done = True
+                    self._auto_slots.pop(slot)
+                    self._release_gen_slot(slot)
+                gen_batch.append((slot, info["sink"], done, info["gen"]))
             # PIPELINE the readback: over a remote device the blocking D2H
             # costs a full round trip; resolving it on a reader thread lets
             # the next tick's compute dispatch immediately, so round trips
             # overlap instead of gating the tick rate. Safe because a
             # sequence never has two steps in flight (closed loop + per-seq
             # lock): tick N+1 only carries other sequences' tokens.
-            self._readers.submit(self._resolve_tick, pair, batch)
+            pool = self._gen_reader if gen_batch else self._readers
+            pool.submit(self._resolve_tick, pair, batch, gen_batch,
+                        self._tick_budget)
 
     @staticmethod
     def _resolve_prefill(pair, fut):
@@ -869,17 +1009,78 @@ class DecodeModel:
         except Exception as e:  # noqa: BLE001 — surfaced via future
             fut.set_exception(e)
 
-    @staticmethod
-    def _resolve_tick(pair, batch):
+    def _resolve_gen_token(self, tok_dev, sink, done, slot, gen):
+        import numpy as np
+
+        try:
+            sink.put(int(np.asarray(tok_dev)))
+            if done:
+                sink.put(None)
+        except Exception as e:  # noqa: BLE001 — surfaced via sink
+            sink.put(e)
+            with self._lock:
+                self._dead_gens.add((slot, gen))
+
+    def _resolve_tick(self, pair, batch, gen_batch=(), budget=None):
         import numpy as np
 
         try:
             vals = np.asarray(pair)  # one fused D2H for the whole tick
-            for (slot, _tok), f in batch:
-                f.set_result((int(vals[0, slot]), float(vals[1, slot])))
-        except Exception as e:  # noqa: BLE001 — surfaced via futures
+        except Exception as e:  # noqa: BLE001 — surfaced via futures/sinks
+            if budget is not None:
+                budget.release()
             for _, f in batch:
                 f.set_exception(e)
+            for slot, sink, _done, gen in gen_batch:
+                sink.put(e)
+                with self._lock:
+                    self._dead_gens.add((slot, gen))
+            return
+        if budget is not None:
+            budget.release()
+        for (slot, _tok), f in batch:
+            f.set_result((int(vals[0, slot]), float(vals[1, slot])))
+        for slot, sink, done, _gen in gen_batch:
+            sink.put(int(vals[0, slot]))
+            if done:
+                sink.put(None)
+
+    def _release_gen_slot(self, slot):
+        """Worker-side: return a generation slot to the pool (no seq id to
+        clean up; the generation bump invalidates any stale job)."""
+        with self._lock:
+            self._free.add(slot)
+            self._slot_gen[slot] += 1
+
+    def submit_generation(self, window, n_tokens: int):
+        """Queue a server-side greedy generation (batched mode): the prompt
+        prefills into a free slot and the slot self-feeds — every active
+        generation shares one batched device step per tick.  Returns a
+        Queue yielding int token ids, then None (or an Exception)."""
+        import queue as _queue
+        import time
+
+        from ..server.types import InferError
+
+        self._ensure_fns()
+        if self._closed:
+            raise InferError(
+                f"model '{self._model.name}' is unloading", 503)
+        with self._lock:
+            if not self._free:
+                self._evict_idle_locked(time.monotonic())
+            if not self._free:
+                raise InferError(
+                    f"model '{self._model.name}': all {self._n_slots} "
+                    "decode slots are busy; retry when a generation or "
+                    "sequence completes", 429)
+            slot = self._free.pop()
+            gen = self._slot_gen[slot]
+        sink: "_queue.Queue" = _queue.Queue()
+        self._jobs.put(("prefill",
+                        (slot, gen, window, ("gen", n_tokens, sink)),
+                        None))
+        return sink
 
     def _submit(self, kind, payload):
         import concurrent.futures
@@ -890,6 +1091,8 @@ class DecodeModel:
             raise InferError(
                 f"model '{self._model.name}' is unloading", 503)
         fut = concurrent.futures.Future()
+        if kind == "prefill":
+            payload = payload + (("fut", fut),)
         self._jobs.put((kind, payload, fut))
         return fut
 
@@ -1127,10 +1330,30 @@ class GenerateModel:
 
         return jax.jit(choose)
 
+    def _generate_batched(self, window, n_tokens):
+        np = self._np
+        from ..server.types import InferError
+
+        sink = self._decode.submit_generation(window, n_tokens)
+        while True:
+            item = sink.get(timeout=3600)
+            if item is None:
+                return
+            if isinstance(item, Exception):
+                if isinstance(item, InferError):
+                    raise item
+                raise InferError(f"generation failed: {item}", 500)
+            tok = int(item)
+            yield {
+                "text_output": np.asarray(
+                    [chr(tok % 256).encode("utf-8")], dtype=object),
+                "token_id": np.asarray([tok], np.int32),
+            }
+
     def _generate(self, inputs, parameters):
         np = self._np
         dec = self._decode
-        prefill, step, params, cfg = dec._ensure_fns_independent()
+        params, cfg = dec._ensure_params()
         raw = np.asarray(inputs["text_input"]).reshape(-1)
         prompt = raw[0] if len(raw) else b""
         if isinstance(prompt, str):
@@ -1164,6 +1387,17 @@ class GenerateModel:
             window[0, dec._prompt_len - b.size:] = b
         window = np.clip(window, 0, cfg.vocab_size - 1)
 
+        if dec._mode == "batched" and temperature == 0:
+            # continuous batching for server-side generation: the request
+            # joins the decode worker's shared tick — N concurrent greedy
+            # generations cost ONE batched device step per token position,
+            # with the feedback token never leaving the device.  (Sampled
+            # requests keep the per-request device chain below: sampling
+            # state is per-request.)
+            yield from self._generate_batched(window, n_tokens)
+            return
+
+        prefill, step, params, cfg = dec._ensure_fns_independent()
         # Enqueue the WHOLE decode chain with the chosen token (greedy or
         # sampled) fed back as a
         # device array — no host readback inside the loop (jax async
