@@ -95,6 +95,59 @@ def _measure_generation(harness) -> dict:
     }
 
 
+def _measure_batched_generation() -> dict:
+    """Continuous-batching generation leg (BASELINE row 15): concurrent
+    greedy /generate_stream requests share one batched device step per tick
+    (self-feeding slots).  Runs its OWN harness AFTER the main one stopped
+    — the decode worker's mode is fixed at registration (fresh registry
+    with the env set before the model constructs), the main harness's
+    weights/caches must be off the chip first, and ServerHarness.stop()
+    clobbers the global broker flag, so harnesses must never nest."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from triton_client_tpu.genai_perf import profile_generate
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server.registry import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+
+    saved = {k: os.environ.get(k) for k in
+             ("TRITON_TPU_DECODE_MODE", "TRITON_TPU_DECODE_SLOTS",
+              "TRITON_TPU_PREFILL_CHUNK", "TRITON_TPU_QUANT")}
+    os.environ["TRITON_TPU_DECODE_MODE"] = "batched"
+    os.environ["TRITON_TPU_DECODE_SLOTS"] = "32"
+    os.environ["TRITON_TPU_PREFILL_CHUNK"] = "32"
+    os.environ.pop("TRITON_TPU_QUANT", None)  # bf16 default for this leg
+    try:
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        with ServerHarness(registry) as h:
+            url = f"127.0.0.1:{h.http_port}"
+            profile_generate(url, "llama_generate", concurrency=1,
+                             output_tokens=2, num_requests=1,
+                             stream_timeout=1200.0)  # compile warm
+            rep = profile_generate(url, "llama_generate", concurrency=8,
+                                   output_tokens=24, num_requests=16,
+                                   stream_timeout=1200.0)
+        if rep["errors"]:
+            return {"gen_batched_error": str(rep.get("first_error"))[:120]}
+        return {
+            "gen_batched_tok_per_sec_c8":
+                rep["output_token_throughput_per_sec"],
+            "gen_batched_itl_p50_ms": round(
+                rep["inter_token_latency_ms"].get("p50", 0.0), 1),
+        }
+    except Exception as e:  # noqa: BLE001 — bench keeps going without it
+        return {"gen_batched_error": str(e)[:120]}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _measure_rtt_floor() -> float:
     """Median blocking device round trip (H2D + sync + D2H) in ms — the
     physical latency floor for any synchronous per-request device path."""
@@ -316,6 +369,9 @@ def main() -> int:
 
     rtt_floor_ms = _measure_rtt_floor()
     harness.stop()
+    # independent of the int8 leg's outcome, and after the main harness
+    # released its device memory
+    gen_metrics.update(_measure_batched_generation())
 
     baseline = _previous_baseline()
     value = simple_res["infer_per_sec"]
