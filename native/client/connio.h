@@ -1,0 +1,76 @@
+// TLS-aware connection IO: dispatch to a TlsSession when present, else the
+// plain sockio helpers.  Shared by the HTTP/1.1 transport (transport.cc)
+// and the HTTP/2 gRPC layer (h2.cc).  Deadline semantics match sockio
+// (-2 = expired).
+#pragma once
+
+#include <cerrno>
+#include <cstddef>
+
+#include "sockio.h"
+#include "tls.h"
+
+namespace tc_tpu {
+namespace client {
+namespace connio {
+
+struct ConnRef {
+  int fd;
+  TlsSession* tls;
+};
+
+inline ssize_t CRecvDl(const ConnRef& c, char* buf, size_t n,
+                       const sockio::Deadline& dl) {
+  if (c.tls == nullptr) return sockio::RecvDl(c.fd, buf, n, dl);
+  if (dl.enabled) {
+    long long rem = dl.RemainingUs();
+    if (rem <= 0) return -2;
+    sockio::SetSocketTimeout(c.fd, SO_RCVTIMEO, rem);
+  }
+  long r = c.tls->Recv(buf, n);
+  if (r < 0 && dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+    return -2;
+  }
+  return r;
+}
+
+inline int CReadExactDl(const ConnRef& c, char* buf, size_t n,
+                        const sockio::Deadline& dl) {
+  if (c.tls == nullptr) return sockio::ReadExactDl(c.fd, buf, n, dl);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = CRecvDl(c, buf + got, n - got, dl);
+    if (r == -2) return -2;
+    if (r <= 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+inline int CWriteAllDl(const ConnRef& c, const char* buf, size_t n,
+                       const sockio::Deadline& dl) {
+  if (c.tls == nullptr) return sockio::WriteAllDl(c.fd, buf, n, dl);
+  size_t sent = 0;
+  while (sent < n) {
+    if (dl.enabled) {
+      long long rem = dl.RemainingUs();
+      if (rem <= 0) return -2;
+      sockio::SetSocketTimeout(c.fd, SO_SNDTIMEO, rem);
+    }
+    long w = c.tls->Send(buf + sent, n - sent);
+    if (w <= 0) {
+      if (dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) return -2;
+      return -1;
+    }
+    sent += static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+inline bool CWriteAll(const ConnRef& c, const char* buf, size_t n) {
+  return CWriteAllDl(c, buf, n, sockio::Deadline()) == 0;
+}
+
+}  // namespace connio
+}  // namespace client
+}  // namespace tc_tpu

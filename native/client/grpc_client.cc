@@ -274,10 +274,10 @@ Error InferenceServerGrpcClient::Create(
     view.cert = ssl_options.certificate_chain;
     view.key = ssl_options.private_key;
     TC_RETURN_IF_ERROR((*client)->transport_->EnableTls(view));
-    // the h2c path is cleartext prior-knowledge; secure gRPC rides
-    // gRPC-Web over TLS, so pin the transport mode up front
-    std::lock_guard<std::mutex> lk((*client)->mode_mu_);
-    (*client)->mode_ = Mode::kWeb;
+    // mode probe stays automatic: the first RPC offers TLS+ALPN "h2" —
+    // a stock secure gRPC port negotiates h2 (real grpcs); the HTTPS web
+    // bridge negotiates http/1.1 and the client falls back to gRPC-Web
+    // over TLS
   }
   return Error::Success;
 }
@@ -388,11 +388,14 @@ Error InferenceServerGrpcClient::EnsureMode(uint64_t timeout_us) {
   Error err = conn->Connect(
       transport_->host(), transport_->port(), &not_http2,
       transport_->keepalive_idle_s(), transport_->keepalive_intvl_s(),
-      timeout_us);
+      timeout_us, transport_->tls_context());
   if (err.IsOk()) {
     mode_ = Mode::kH2;
     h2_idle_.emplace_back(std::move(conn));
-    if (verbose_) fprintf(stderr, "grpc transport: h2c\n");
+    if (verbose_) {
+      fprintf(stderr, "grpc transport: %s\n",
+              transport_->tls_enabled() ? "grpcs (h2 over TLS)" : "h2c");
+    }
     return Error::Success;
   }
   if (force != nullptr && std::string(force) == "h2") return err;
@@ -421,7 +424,7 @@ Error InferenceServerGrpcClient::AcquireH2(
   return (*conn)->Connect(
       transport_->host(), transport_->port(), &not_http2,
       transport_->keepalive_idle_s(), transport_->keepalive_intvl_s(),
-      timeout_us);
+      timeout_us, transport_->tls_context());
 }
 
 void InferenceServerGrpcClient::ReleaseH2(
@@ -921,7 +924,8 @@ Error InferenceServerGrpcClient::StartStream(
     bool not_http2 = false;
     TC_RETURN_IF_ERROR(hconn->Connect(
         transport_->host(), transport_->port(), &not_http2,
-        transport_->keepalive_idle_s(), transport_->keepalive_intvl_s()));
+        transport_->keepalive_idle_s(), transport_->keepalive_intvl_s(),
+        0, transport_->tls_context()));
     TC_RETURN_IF_ERROR(hconn->StartStream(
         std::string("/") + kServicePath + "/ModelStreamInfer", headers));
     stream_callback_ = std::move(callback);

@@ -6,9 +6,10 @@
 // and streaming inference.
 //
 // Transport re-design: the image ships no grpc++ headers, so the protocol
-// is implemented directly.  Default wire: **real gRPC over cleartext
-// HTTP/2** (h2c prior knowledge — own RFC 7540 framing + HPACK, h2.{h,cc})
-// against the stock gRPC port, wire-compatible with any v2 gRPC endpoint.
+// is implemented directly.  Default wire: **real gRPC over HTTP/2**
+// (own RFC 7540 framing + HPACK, h2.{h,cc}) against the stock gRPC port —
+// h2c prior knowledge in the clear, TLS + ALPN "h2" with use_ssl (real
+// grpcs) — wire-compatible with any v2 gRPC endpoint.
 // The first RPC probes the endpoint; an HTTP/1.1 server (this repo's
 // grpc-web bridge) answers the h2c preface with HTTP text and the client
 // transparently falls back to standard **gRPC-Web** framing
@@ -111,8 +112,9 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   // Secure channel (reference Create overload taking use_ssl + SslOptions,
   // grpc_client.h).  Divergence: the reference's SslOptions carry PEM
   // *contents*; these carry file *paths* (the TLS layer loads them).  The
-  // secure wire is gRPC-Web over TLS against the harness's HTTPS port —
-  // h2c is cleartext-only, so use_ssl pins the web transport mode.
+  // secure wire is REAL gRPC over TLS (ALPN "h2") against the stock
+  // secure gRPC port; an HTTPS endpoint that negotiates http/1.1 (the web
+  // bridge) transparently falls back to gRPC-Web over TLS.
   struct GrpcSslOptions {
     std::string root_certificates;   // CA bundle path ("" = system default)
     std::string private_key;         // client key path (mTLS)

@@ -5,6 +5,7 @@
 #include <algorithm>
 #include <cstring>
 
+#include "connio.h"
 #include "sockio.h"
 
 namespace tc_tpu {
@@ -143,6 +144,25 @@ std::string GrpcTimeoutValue(uint64_t timeout_us) {
   return std::to_string(std::min(s, kMaxDigitsValue)) + "S";
 }
 
+int ReadExactRetry(const connio::ConnRef& c, char* buf, size_t n,
+                   const sockio::Deadline& dl) {
+  // the EAGAIN retry must RESUME at the partial offset — restarting the
+  // exact-read would overwrite bytes already consumed from the TLS stream
+  // and desync the frame parser
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = connio::CRecvDl(c, buf + got, n - got, dl);
+    if (r == -2) return -2;
+    if (r < 0 && !dl.enabled &&
+        (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      continue;  // SO_RCVTIMEO tick on a TLS stream: yield, retry
+    }
+    if (r <= 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
 Error IoError(int rc, const char* what) {
   if (rc == -2) {
     return Error(std::string("Deadline Exceeded: timed out ") + what);
@@ -157,6 +177,10 @@ bool H2Available() { return Hpack::Get().ok; }
 H2GrpcConnection::~H2GrpcConnection() { Close(); }
 
 void H2GrpcConnection::Close() {
+  if (tls_sess_ != nullptr) {
+    delete tls_sess_;
+    tls_sess_ = nullptr;
+  }
   if (fd_ >= 0) {
     ::close(fd_);
     fd_ = -1;
@@ -170,7 +194,8 @@ void H2GrpcConnection::Close() {
 
 Error H2GrpcConnection::Connect(
     const std::string& host, int port, bool* not_http2,
-    int keepalive_idle_s, int keepalive_intvl_s, uint64_t timeout_us) {
+    int keepalive_idle_s, int keepalive_intvl_s, uint64_t timeout_us,
+    const TlsContext* tls) {
   *not_http2 = false;
   if (!H2Available()) {
     return Error("HTTP/2 unavailable: libnghttp2 (HPACK decoder) not found");
@@ -180,6 +205,38 @@ Error H2GrpcConnection::Connect(
   fd_ = sockio::ConnectTcp(host, port, &err, dl);
   if (fd_ < 0) return err;
   sockio::EnableTcpKeepAlive(fd_, keepalive_idle_s, keepalive_intvl_s);
+  if (tls != nullptr) {
+    // real grpcs: TLS with ALPN "h2" — a peer negotiating anything else
+    // (the HTTPS web bridge speaks http/1.1) is not an HTTP/2 endpoint
+    tls_sess_ = new TlsSession();
+    if (dl.enabled) {
+      // the handshake must honor the connect deadline too (a peer that
+      // accepts TCP then stalls in TLS would otherwise hang SSL_connect)
+      long long rem = dl.RemainingUs();
+      if (rem <= 0) {
+        Close();
+        return Error("Deadline Exceeded: timed out before TLS handshake");
+      }
+      sockio::SetSocketTimeout(fd_, SO_RCVTIMEO, rem);
+      sockio::SetSocketTimeout(fd_, SO_SNDTIMEO, rem);
+    }
+    std::string selected;
+    Error terr = tls_sess_->Handshake(fd_, *tls, host, "h2", &selected);
+    if (dl.enabled) {
+      // fresh connections may be pooled; don't leak this deadline
+      sockio::SetSocketTimeout(fd_, SO_RCVTIMEO, 0);
+      sockio::SetSocketTimeout(fd_, SO_SNDTIMEO, 0);
+    }
+    if (!terr.IsOk()) {
+      Close();
+      return terr;
+    }
+    if (selected != "h2") {
+      Close();
+      *not_http2 = true;
+      return Error("server did not negotiate ALPN h2");
+    }
+  }
 
   // client preface + SETTINGS + connection WINDOW_UPDATE in one write
   std::string bytes(kPreface, sizeof(kPreface) - 1);
@@ -207,7 +264,8 @@ Error H2GrpcConnection::Connect(
   bytes.push_back(0);
   PutU32(&bytes, 0);
   PutU32(&bytes, static_cast<uint32_t>(kRecvWindow - 65535));
-  int rc = sockio::WriteAllDl(fd_, bytes.data(), bytes.size(), dl);
+  const connio::ConnRef conn{fd_, tls_sess_};
+  int rc = connio::CWriteAllDl(conn, bytes.data(), bytes.size(), dl);
   if (rc != 0) {
     Close();
     return IoError(rc, "sending HTTP/2 preface");
@@ -217,7 +275,7 @@ Error H2GrpcConnection::Connect(
   // preface with "HTTP/1.1 4xx" text, a real h2c server with a SETTINGS
   // frame (type byte at offset 3)
   char probe[9];
-  rc = sockio::ReadExactDl(fd_, probe, sizeof(probe), dl);
+  rc = ReadExactRetry(conn, probe, sizeof(probe), dl);
   if (rc != 0) {
     Close();
     return IoError(rc, "reading HTTP/2 settings");
@@ -236,7 +294,7 @@ Error H2GrpcConnection::Connect(
                  static_cast<uint8_t>(probe[2]);
   std::string payload(len, '\0');
   if (len > 0) {
-    rc = sockio::ReadExactDl(fd_, payload.data(), len, dl);
+    rc = ReadExactRetry(conn, payload.data(), len, dl);
     if (rc != 0) {
       Close();
       return IoError(rc, "reading HTTP/2 settings");
@@ -276,7 +334,8 @@ Error H2GrpcConnection::SendFrame(
   hdr.append(payload);
   std::lock_guard<std::mutex> lk(write_mu_);
   if (fd_ < 0) return Error("connection closed");
-  if (!sockio::WriteAll(fd_, hdr.data(), hdr.size())) {
+  if (!connio::CWriteAll(connio::ConnRef{fd_, tls_sess_}, hdr.data(),
+                         hdr.size())) {
     return Error("connection failure while sending HTTP/2 frame");
   }
   return Error::Success;
@@ -285,7 +344,8 @@ Error H2GrpcConnection::SendFrame(
 Error H2GrpcConnection::ReadFrameHdr(FrameHdr* hdr,
                                      const sockio::Deadline& dl) {
   char raw[9];
-  int rc = sockio::ReadExactDl(fd_, raw, sizeof(raw), dl);
+  int rc = ReadExactRetry(connio::ConnRef{fd_, tls_sess_}, raw,
+                          sizeof(raw), dl);
   if (rc != 0) return IoError(rc, "reading HTTP/2 frame");
   hdr->len = (static_cast<uint8_t>(raw[0]) << 16) |
              (static_cast<uint8_t>(raw[1]) << 8) |
@@ -356,7 +416,8 @@ Error H2GrpcConnection::ProcessOneFrame(CallState* call,
   TC_RETURN_IF_ERROR(ReadFrameHdr(&hdr, dl));
   std::string payload(hdr.len, '\0');
   if (hdr.len > 0) {
-    int rc = sockio::ReadExactDl(fd_, payload.data(), hdr.len, dl);
+    int rc = ReadExactRetry(connio::ConnRef{fd_, tls_sess_},
+                            payload.data(), hdr.len, dl);
     if (rc != 0) return IoError(rc, "reading HTTP/2 frame payload");
   }
   switch (hdr.type) {
@@ -514,7 +575,8 @@ Error H2GrpcConnection::SendHeaders(
     uint64_t timeout_us, bool end_stream) {
   std::string block;
   EncodeLiteral(&block, ":method", "POST");
-  EncodeLiteral(&block, ":scheme", "http");
+  EncodeLiteral(&block, ":scheme",
+                tls_sess_ != nullptr ? "https" : "http");
   EncodeLiteral(&block, ":path", path);
   EncodeLiteral(&block, ":authority", "localhost");
   EncodeLiteral(&block, "te", "trailers");
@@ -689,6 +751,13 @@ Error H2GrpcConnection::StartStream(const std::string& path,
                                     const Headers& metadata) {
   if (fd_ < 0) return Error("connection closed");
   if (stream_active_) return Error("stream already running");
+  if (tls_sess_ != nullptr) {
+    // reader thread and writer share one TLS session (internally mutexed);
+    // a short receive timeout makes the blocked reader release the session
+    // periodically so writes get through (same pattern as the TLS duplex
+    // web stream in transport.cc)
+    sockio::SetSocketTimeout(fd_, SO_RCVTIMEO, 50000);
+  }
   stream_call_ = CallState();
   stream_call_.stream_id = next_stream_id_;
   next_stream_id_ += 2;

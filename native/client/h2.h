@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "common.h"
+#include "tls.h"
 
 namespace tc_tpu {
 namespace client {
@@ -48,10 +49,13 @@ class H2GrpcConnection {
   // TCP connect + HTTP/2 preface/SETTINGS exchange.  Fails fast with
   // `not_http2` set (and no Error) when the peer answered the preface with
   // HTTP/1.1 text — the caller falls back to the gRPC-Web bridge.
+  // `tls` non-null wraps the connection in TLS with ALPN "h2" (real grpcs);
+  // a peer that negotiates anything else sets `not_http2` so the caller
+  // falls back to gRPC-Web over TLS.
   Error Connect(
       const std::string& host, int port, bool* not_http2,
       int keepalive_idle_s = 0, int keepalive_intvl_s = 0,
-      uint64_t timeout_us = 0);
+      uint64_t timeout_us = 0, const TlsContext* tls = nullptr);
   bool connected() const { return fd_ >= 0; }
 
   // Abort DATA accumulation past this many bytes (reference
@@ -115,6 +119,7 @@ class H2GrpcConnection {
   Error ReplenishRecvWindow(uint32_t stream_id, size_t consumed);
 
   int fd_ = -1;
+  TlsSession* tls_sess_ = nullptr;  // non-null: all IO rides TLS (grpcs)
   std::mutex write_mu_;  // interleaved frame writes (stream reader ACKs)
   void* inflater_ = nullptr;
   uint32_t next_stream_id_ = 1;

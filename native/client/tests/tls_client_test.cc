@@ -5,6 +5,9 @@
 // rejected.
 //
 // usage: tls_client_test <https_host:port> <ca_pem_path> [cert] [key]
+//        [grpcs_host:port]   (the stock secure gRPC port: real grpcs via
+//                             TLS+ALPN h2; the https port exercises the
+//                             gRPC-Web-over-TLS fallback)
 
 #include <cstdio>
 #include <cstring>
@@ -123,7 +126,8 @@ void TestClientCertPlumbing(const std::string& url, const std::string& ca,
   printf("PASS: client cert/key loading\n");
 }
 
-void TestSecureGrpc(const std::string& url, const std::string& ca) {
+void TestSecureGrpc(const std::string& url, const std::string& ca,
+                    const char* label) {
   tc::InferenceServerGrpcClient::GrpcSslOptions ssl;
   ssl.root_certificates = ca;
   std::unique_ptr<tc::InferenceServerGrpcClient> client;
@@ -151,7 +155,7 @@ void TestSecureGrpc(const std::string& url, const std::string& ca) {
   CheckSum(results.front(), in0, in1);
   delete results.front();
   for (auto* in : inputs) delete in;
-  printf("PASS: secure grpc unary + stream\n");
+  printf("PASS: secure grpc unary + stream (%s)\n", label);
 }
 
 }  // namespace
@@ -166,7 +170,8 @@ int main(int argc, char** argv) {
   TestHttpsInfer(url, ca);
   TestHttpsRejectsUntrustedCa(url);
   if (argc >= 5) TestClientCertPlumbing(url, ca, argv[3], argv[4]);
-  TestSecureGrpc(url, ca);
+  TestSecureGrpc(url, ca, "web-over-TLS fallback via https port");
+  if (argc >= 6) TestSecureGrpc(argv[5], ca, "real grpcs: TLS + ALPN h2");
   printf("PASS: all\n");
   return 0;
 }

@@ -39,6 +39,8 @@ struct OpenSsl {
   int (*set1_host)(void*, const char*) = nullptr;
   int (*use_cert_file)(void*, const char*, int) = nullptr;
   int (*use_key_file)(void*, const char*, int) = nullptr;
+  int (*set_alpn)(void*, const unsigned char*, unsigned) = nullptr;
+  void (*get_alpn)(const void*, const unsigned char**, unsigned*) = nullptr;
   bool ok = false;
 
   static const OpenSsl& Get() {
@@ -77,12 +79,20 @@ struct OpenSsl {
           sym("SSL_CTX_use_certificate_file"));
       out.use_key_file = reinterpret_cast<int (*)(void*, const char*, int)>(
           sym("SSL_CTX_use_PrivateKey_file"));
+      out.set_alpn =
+          reinterpret_cast<int (*)(void*, const unsigned char*, unsigned)>(
+              sym("SSL_set_alpn_protos"));
+      out.get_alpn = reinterpret_cast<void (*)(const void*,
+                                               const unsigned char**,
+                                               unsigned*)>(
+          sym("SSL_get0_alpn_selected"));
       out.ok = out.tls_client_method && out.ctx_new && out.ctx_free &&
                out.ssl_new && out.ssl_free && out.set_fd && out.connect &&
                out.read && out.write && out.shutdown && out.get_error &&
                out.set_verify && out.load_verify &&
                out.default_verify_paths && out.ssl_ctrl && out.set1_host &&
-               out.use_cert_file && out.use_key_file;
+               out.use_cert_file && out.use_key_file && out.set_alpn &&
+               out.get_alpn;
       return out;
     }();
     return s;
@@ -150,7 +160,8 @@ void TlsSession::Close() {
 }
 
 Error TlsSession::Handshake(
-    int fd, const TlsContext& ctx, const std::string& host) {
+    int fd, const TlsContext& ctx, const std::string& host,
+    const char* alpn, std::string* alpn_selected) {
   if (!Available()) {
     return Error("TLS unavailable: libssl.so.3 not found");
   }
@@ -166,6 +177,14 @@ Error TlsSession::Handshake(
   // SNI + hostname verification
   o.ssl_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
              const_cast<char*>(host.c_str()));
+  if (alpn != nullptr) {
+    // wire format: length-prefixed protocol list
+    std::string wire;
+    wire.push_back(static_cast<char>(strlen(alpn)));
+    wire.append(alpn);
+    o.set_alpn(ssl_, reinterpret_cast<const unsigned char*>(wire.data()),
+               static_cast<unsigned>(wire.size()));
+  }
   if (ctx.verify_peer_ && ctx.verify_host_) {
     o.set1_host(ssl_, host.c_str());
   }
@@ -185,6 +204,16 @@ Error TlsSession::Handshake(
         (err == 1 ? ": certificate verification failed or protocol error"
                   : "") +
         ")");
+  }
+  if (alpn_selected != nullptr) {
+    const unsigned char* sel = nullptr;
+    unsigned sel_len = 0;
+    o.get_alpn(ssl_, &sel, &sel_len);
+    if (sel != nullptr && sel_len > 0) {
+      alpn_selected->assign(reinterpret_cast<const char*>(sel), sel_len);
+    } else {
+      alpn_selected->clear();
+    }
   }
   return Error::Success;
 }

@@ -60,8 +60,12 @@ class TlsSession {
 
   // TLS handshake over `fd` (blocking; honors SO_RCVTIMEO/SO_SNDTIMEO the
   // caller may have set).  `host` is used for SNI and (when the context
-  // verifies hosts) hostname verification.
-  Error Handshake(int fd, const TlsContext& ctx, const std::string& host);
+  // verifies hosts) hostname verification.  `alpn` (e.g. "h2") offers that
+  // protocol; `alpn_selected` receives what the server negotiated ("" when
+  // the server picked nothing — callers decide whether to proceed).
+  Error Handshake(int fd, const TlsContext& ctx, const std::string& host,
+                  const char* alpn = nullptr,
+                  std::string* alpn_selected = nullptr);
 
   // Like ::recv/::send on the cleartext stream: >0 bytes, 0 orderly close,
   // -1 error (errno EAGAIN/EWOULDBLOCK preserved for deadline handling).

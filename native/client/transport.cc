@@ -1,5 +1,6 @@
 #include "transport.h"
 
+#include "connio.h"
 #include "sockio.h"
 
 #include <arpa/inet.h>
@@ -39,63 +40,11 @@ using sockio::SetSocketTimeout;
 using sockio::WriteAll;
 using sockio::WriteAllDl;
 
-// TLS-aware IO over a transport connection: dispatch to the TLS session
-// when present, otherwise the plain sockio helpers.  Deadline semantics
-// match sockio (-2 = expired).
-struct ConnRef {
-  int fd;
-  TlsSession* tls;
-};
-
-ssize_t CRecvDl(const ConnRef& c, char* buf, size_t n, const Deadline& dl) {
-  if (c.tls == nullptr) return RecvDl(c.fd, buf, n, dl);
-  if (dl.enabled) {
-    long long rem = dl.RemainingUs();
-    if (rem <= 0) return -2;
-    SetSocketTimeout(c.fd, SO_RCVTIMEO, rem);
-  }
-  long r = c.tls->Recv(buf, n);
-  if (r < 0 && dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) {
-    return -2;
-  }
-  return r;
-}
-
-int CReadExactDl(const ConnRef& c, char* buf, size_t n, const Deadline& dl) {
-  if (c.tls == nullptr) return ReadExactDl(c.fd, buf, n, dl);
-  size_t got = 0;
-  while (got < n) {
-    ssize_t r = CRecvDl(c, buf + got, n - got, dl);
-    if (r == -2) return -2;
-    if (r <= 0) return -1;
-    got += static_cast<size_t>(r);
-  }
-  return 0;
-}
-
-int CWriteAllDl(const ConnRef& c, const char* buf, size_t n,
-                const Deadline& dl) {
-  if (c.tls == nullptr) return WriteAllDl(c.fd, buf, n, dl);
-  size_t sent = 0;
-  while (sent < n) {
-    if (dl.enabled) {
-      long long rem = dl.RemainingUs();
-      if (rem <= 0) return -2;
-      SetSocketTimeout(c.fd, SO_SNDTIMEO, rem);
-    }
-    long w = c.tls->Send(buf + sent, n - sent);
-    if (w <= 0) {
-      if (dl.enabled && (errno == EAGAIN || errno == EWOULDBLOCK)) return -2;
-      return -1;
-    }
-    sent += static_cast<size_t>(w);
-  }
-  return 0;
-}
-
-bool CWriteAll(const ConnRef& c, const char* buf, size_t n) {
-  return CWriteAllDl(c, buf, n, Deadline()) == 0;
-}
+using connio::CReadExactDl;
+using connio::CRecvDl;
+using connio::CWriteAll;
+using connio::CWriteAllDl;
+using connio::ConnRef;
 
 }  // namespace
 

@@ -144,8 +144,9 @@ def test_native_test_binary(native_build, harness, binary):
 
 def test_cpp_tls_round_trip(native_build, tmp_path):
     """Secure C++ transport end-to-end: HTTPS unary infer with CA pinning,
-    rejection of an untrusted CA, and secure gRPC (web framing over TLS)
-    unary + duplex stream against the TLS harness."""
+    rejection of an untrusted CA, REAL grpcs (TLS + ALPN h2) against the
+    secure gRPC port, and the gRPC-Web-over-TLS fallback via the HTTPS
+    bridge — unary + duplex stream in both modes."""
     from triton_client_tpu.models import zoo
     from triton_client_tpu.server import ModelRegistry
     from triton_client_tpu.server.testing import ServerHarness
@@ -158,7 +159,8 @@ def test_cpp_tls_round_trip(native_build, tmp_path):
         proc = subprocess.run(
             [os.path.join(native_build, "tls_client_test"),
              f"localhost:{h.http_port}", material.certfile,
-             material.certfile, material.keyfile],
+             material.certfile, material.keyfile,
+             f"localhost:{h.grpc_port}"],
             capture_output=True, text=True, timeout=240)
     assert proc.returncode == 0, (
         f"tls_client_test failed\nstdout:\n{proc.stdout}\n"
