@@ -1,0 +1,226 @@
+"""Active tracing behind the trace-settings API.
+
+The reference client configures a server that actually traces (reference
+http/_client.py:767-865, grpc/_client.py:832-979); these tests prove ours
+does too: settings registered through either protocol client make the server
+emit per-request timestamp timelines to ``trace_file`` (SURVEY §5 tracing
+row).  Round-trip of the settings dict is covered elsewhere
+(test_server_http/test_grpc_client); this file asserts the *effect*.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import triton_client_tpu.grpc as grpcclient
+import triton_client_tpu.http as httpclient
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+from triton_client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+@pytest.fixture()
+def client(server):
+    with httpclient.InferenceServerClient(server.http_url, concurrency=2) as c:
+        yield c
+
+
+@pytest.fixture(autouse=True)
+def _trace_off_after(client):
+    yield
+    client.update_trace_settings(settings={"trace_level": ["OFF"]})
+
+
+def _simple_inputs():
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(a)
+    inputs[1].set_data_from_numpy(a)
+    return inputs
+
+
+def _read_traces(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class TestTimestampTracing:
+    def test_traces_written_and_well_formed(self, client, tmp_path):
+        tf = tmp_path / "trace.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+        })
+        for _ in range(3):
+            client.infer("simple", _simple_inputs())
+        traces = _read_traces(tf)
+        assert len(traces) == 3
+        for t in traces:
+            assert t["model_name"] == "simple"
+            names = [ts["name"] for ts in t["timestamps"]]
+            assert names[0] == "REQUEST_START"
+            assert "COMPUTE_START" in names and "COMPUTE_END" in names
+            assert names[-1] == "REQUEST_END"
+            ns = [ts["ns"] for ts in t["timestamps"]]
+            assert ns == sorted(ns)  # monotone timeline
+            # COMPUTE is inside the REQUEST envelope
+            d = dict(zip(names, ns))
+            assert d["REQUEST_START"] <= d["COMPUTE_START"] <= d["COMPUTE_END"] <= d["REQUEST_END"]
+        # ids are distinct and increasing
+        ids = [t["id"] for t in traces]
+        assert ids == sorted(set(ids))
+
+    def test_trace_rate_samples(self, client, tmp_path):
+        tf = tmp_path / "rate.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["2"],
+        })
+        for _ in range(4):
+            client.infer("simple", _simple_inputs())
+        assert len(_read_traces(tf)) == 2  # every 2nd request
+
+    def test_trace_count_budget(self, client, tmp_path):
+        tf = tmp_path / "count.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+            "trace_count": ["1"],
+        })
+        for _ in range(3):
+            client.infer("simple", _simple_inputs())
+        assert len(_read_traces(tf)) == 1
+
+    def test_read_does_not_reset_budget_or_ids(self, client, tmp_path):
+        """get_trace_settings is a read: it must not refresh the trace_count
+        budget or re-phase trace_rate; ids stay file-unique across updates."""
+        tf = tmp_path / "budget.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+            "trace_count": ["1"],
+        })
+        client.infer("simple", _simple_inputs())
+        client.get_trace_settings()  # read — budget must stay exhausted
+        client.infer("simple", _simple_inputs())
+        assert len(_read_traces(tf)) == 1
+        # a real update refreshes the budget, but ids keep increasing
+        client.update_trace_settings(settings={"trace_count": ["1"]})
+        client.infer("simple", _simple_inputs())
+        traces = _read_traces(tf)
+        ids = [t["id"] for t in traces]
+        assert len(traces) == 2 and len(set(ids)) == 2 and ids == sorted(ids)
+
+    def test_off_means_no_file(self, client, tmp_path):
+        tf = tmp_path / "off.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["OFF"],
+        })
+        client.infer("simple", _simple_inputs())
+        assert not tf.exists()
+
+    def test_grpc_settings_drive_tracing_too(self, server, tmp_path):
+        tf = tmp_path / "grpc.jsonl"
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            gc.update_trace_settings(settings={
+                "trace_file": [str(tf)],
+                "trace_level": ["TIMESTAMPS"],
+                "trace_rate": ["1"],
+            })
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(a)
+            inputs[1].set_data_from_numpy(a)
+            gc.infer("simple", inputs)
+            gc.update_trace_settings(settings={"trace_level": ["OFF"]})
+        traces = _read_traces(tf)
+        assert len(traces) == 1
+        assert traces[0]["model_name"] == "simple"
+
+
+class TestProfileLevel:
+    def test_profile_toggles_jax_profiler(self, client, tmp_path):
+        """PROFILE runs jax.profiler into <trace_file>.profile (SURVEY §5:
+        trace settings map to JAX profiler / XLA dump toggles)."""
+        tf = tmp_path / "prof.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS", "PROFILE"],
+            "trace_rate": ["1"],
+        })
+        client.infer("simple", _simple_inputs())
+        client.update_trace_settings(settings={"trace_level": ["OFF"]})
+        prof_dir = tmp_path / "prof.jsonl.profile"
+        assert prof_dir.is_dir() and any(prof_dir.rglob("*"))
+        assert len(_read_traces(tf)) == 1  # timestamps still emitted
+
+
+class TestLoudRefusals:
+    def test_tensors_501_http(self, client):
+        with pytest.raises(InferenceServerException) as ei:
+            client.update_trace_settings(settings={"trace_level": ["TENSORS"]})
+        assert "TENSORS" in str(ei.value)
+        # refused update must not have been applied
+        assert client.get_trace_settings()["trace_level"] == ["OFF"]
+
+    def test_tensors_unimplemented_grpc(self, server):
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            with pytest.raises(InferenceServerException) as ei:
+                gc.update_trace_settings(settings={"trace_level": ["TENSORS"]})
+            assert "TENSORS" in str(ei.value)
+
+    def test_unknown_level_400(self, client):
+        with pytest.raises(InferenceServerException):
+            client.update_trace_settings(settings={"trace_level": ["VERBOSE9"]})
+
+    def test_non_integer_rate_400(self, client):
+        with pytest.raises(InferenceServerException):
+            client.update_trace_settings(settings={"trace_rate": ["fast"]})
+
+    def test_non_string_junk_rate_400(self, client):
+        with pytest.raises(InferenceServerException):
+            client.update_trace_settings(settings={"trace_rate": [None]})
+
+    def test_zero_rate_400(self, client):
+        with pytest.raises(InferenceServerException):
+            client.update_trace_settings(settings={"trace_rate": ["0"]})
+
+    def test_unknown_key_400(self, client):
+        with pytest.raises(InferenceServerException):
+            client.update_trace_settings(settings={"trace_cnt": ["5"]})
+
+
+class TestClearToDefault:
+    def test_null_clears_http(self, client):
+        client.update_trace_settings(settings={"trace_rate": ["7"]})
+        assert client.get_trace_settings()["trace_rate"] == ["7"]
+        out = client.update_trace_settings(settings={"trace_rate": None})
+        assert out["trace_rate"] == ["1000"]
+
+    def test_none_clears_grpc(self, server):
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            gc.update_trace_settings(settings={"trace_rate": ["9"]})
+            gc.update_trace_settings(settings={"trace_rate": None}, as_json=True)
+            out = gc.get_trace_settings(as_json=True)
+            assert out["settings"]["trace_rate"]["value"] == ["1000"]

@@ -29,6 +29,7 @@ from ..utils import np_to_triton_dtype, triton_to_np_dtype
 from .model import EnsembleModel, Model, pb_to_datatype
 from .registry import ModelRegistry
 from .shm import SystemShmRegistry, XlaShmRegistry
+from .trace import RequestTracer, TRACE_DEFAULTS
 from .types import (
     InferError,
     InferRequest,
@@ -336,11 +337,7 @@ class InferenceCore:
         self.system_shm = SystemShmRegistry()
         self.xla_shm = XlaShmRegistry()
         self.trace_settings: Dict[str, List[str]] = {
-            "trace_file": ["trace.json"],
-            "trace_level": ["OFF"],
-            "trace_rate": ["1000"],
-            "trace_count": ["-1"],
-            "log_frequency": ["0"],
+            k: list(v) for k, v in TRACE_DEFAULTS.items()
         }
         self.log_settings: Dict[str, Any] = {
             "log_file": "",
@@ -350,6 +347,7 @@ class InferenceCore:
             "log_verbose_level": 0,
             "log_format": "default",
         }
+        self.tracer = RequestTracer(self.trace_settings)
         self._batchers: Dict[str, _DynamicBatcher] = {}
         self._inline_profiles: Dict[str, _InlineProfile] = {}
         self.response_cache = _ResponseCache()
@@ -367,6 +365,21 @@ class InferenceCore:
         return await self._infer_on(model, request)
 
     async def _infer_on(self, model: Model, request: InferRequest) -> InferResponse:
+        trace = self.tracer.maybe_start(model.name, request.model_version or "1")
+        if trace is None:
+            return await self._infer_traced(model, request, None)
+        trace.ts("REQUEST_START", request.arrival_ns)
+        trace.ts("QUEUE_START", request.arrival_ns)
+        try:
+            return await self._infer_traced(model, request, trace)
+        finally:
+            trace.ts("REQUEST_END")
+            # file append runs off-loop: only the traced request pays for it
+            await asyncio.get_running_loop().run_in_executor(None, trace.emit)
+
+    async def _infer_traced(
+        self, model: Model, request: InferRequest, trace
+    ) -> InferResponse:
         inputs = self._resolve_inputs(model, request)
         params = dict(request.parameters)
         cache_key = None
@@ -386,19 +399,27 @@ class InferenceCore:
                     model.stats.record(
                         _batch_count(cached) or 1,
                         time.monotonic_ns() - request.arrival_ns, 0, ok=True)
+                    if trace is not None:
+                        trace.ts("CACHE_HIT")
                     return self._build_response(model, request, dict(cached))
         if isinstance(model, EnsembleModel):
             t0 = time.monotonic_ns()
             queue_ns = t0 - request.arrival_ns
+            if trace is not None:
+                trace.ts("COMPUTE_START", t0)
             try:
                 outputs = await self._run_ensemble(model, inputs, params)
             except Exception:
                 model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
                 raise
             compute_ns = time.monotonic_ns() - t0
+            if trace is not None:
+                trace.ts("COMPUTE_END", t0 + compute_ns)
             model.stats.record(
                 _batch_count(inputs) or 1, queue_ns, compute_ns, ok=True)
         elif self._use_batcher(model, request):
+            # Batched execution: COMPUTE spans belong to the shared batch, not
+            # this request — the trace carries the request-level envelope only.
             outputs = await self._batcher(model).submit(inputs, params)
         else:
             # Outputs bound to slot-backed (in-process) xla-shm regions stay
@@ -412,6 +433,8 @@ class InferenceCore:
             }
             t0 = time.monotonic_ns()
             queue_ns = t0 - request.arrival_ns
+            if trace is not None:
+                trace.ts("COMPUTE_START", t0)
             try:
                 outputs = await self._run_model(
                     model, inputs, params, keep_device=keep_device)
@@ -422,6 +445,8 @@ class InferenceCore:
                 model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
                 raise InferError(f"inference failed: {e}", http_status=500)
             compute_ns = time.monotonic_ns() - t0
+            if trace is not None:
+                trace.ts("COMPUTE_END", t0 + compute_ns)
             model.stats.record(_batch_count(inputs) or 1, queue_ns, compute_ns, ok=True)
         if cache_key is not None:
             self.response_cache.put(cache_key, dict(outputs))
@@ -555,6 +580,7 @@ class InferenceCore:
     async def shutdown(self) -> None:
         """Cancel background batcher tasks and fail any queued requests so
         no handler is left awaiting a forever-pending future."""
+        self.tracer.shutdown()
         while self._batchers:
             _, b = self._batchers.popitem()
             await self._retire_batcher(b, reason="server is shutting down")

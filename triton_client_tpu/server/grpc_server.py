@@ -343,9 +343,25 @@ class InferenceServicer:
 
     # -- trace / logging ---------------------------------------------------
     async def TraceSetting(self, request, context):
-        for k, v in request.settings.items():
-            if v.value:
-                self._core.trace_settings[k] = list(v.value)
+        from .trace import TRACE_DEFAULTS, validate_trace_update
+
+        # an empty value list (SetInParent with no values) clears the key back
+        # to its default — reference update_trace_settings(None) contract
+        update = {
+            k: (list(v.value) if v.value else list(TRACE_DEFAULTS.get(k, [])))
+            for k, v in request.settings.items()
+            if v.value or k in TRACE_DEFAULTS
+        }
+        try:
+            validate_trace_update(update)
+        except InferError as e:
+            code = (grpc.StatusCode.UNIMPLEMENTED if e.http_status == 501
+                    else grpc.StatusCode.INVALID_ARGUMENT)
+            await context.abort(code, str(e))
+        if update:  # get_trace_settings sends an empty map — a read, not an
+            # update; it must not reset the sampling counters or count budget
+            self._core.trace_settings.update(update)
+            self._core.tracer.settings_updated()
         resp = pb.TraceSettingResponse()
         for k, vals in self._core.trace_settings.items():
             resp.settings[k].value.extend(vals)

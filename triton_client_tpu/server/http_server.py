@@ -207,12 +207,21 @@ async def _get_trace(core, request):
 
 
 async def _set_trace(core, request):
+    from .trace import TRACE_DEFAULTS, validate_trace_update
+
     body = await _read_json(request, default={})
+    update = {}
     for k, v in body.items():
         if v is None:
             # null clears to default (reference update_trace_settings contract)
-            continue
-        core.trace_settings[k] = v if isinstance(v, list) else [str(v)]
+            if k in TRACE_DEFAULTS:
+                update[k] = list(TRACE_DEFAULTS[k])
+        else:
+            update[k] = v if isinstance(v, list) else [str(v)]
+    validate_trace_update(update)  # 501 for TENSORS, 400 for junk — pre-apply
+    if update:  # an empty body is a read, not an update — counters keep phase
+        core.trace_settings.update(update)
+        core.tracer.settings_updated()
     return web.json_response(core.trace_settings)
 
 

@@ -1,0 +1,250 @@
+"""Server-side request tracing behind the ``/v2/trace/setting`` API.
+
+The reference client manages trace settings on a server that actually traces:
+``update_trace_settings``/``get_trace_settings`` configure ``trace_file``,
+``trace_level``, ``trace_rate``, ``trace_count`` (reference
+src/python/library/tritonclient/http/_client.py:767-865 and
+grpc/_client.py:832-979), and the Triton server then emits per-request
+timestamp timelines to ``trace_file``.  This module is the server half for the
+TPU harness: ``RequestTracer`` samples requests at ``trace_rate``, collects a
+REQUEST/QUEUE/COMPUTE timeline, and appends one JSON object per traced request
+to ``trace_file``.
+
+File format: JSON Lines — each line is one complete object,
+
+    {"id": 7, "model_name": "simple", "model_version": "1",
+     "timestamps": [{"name": "REQUEST_START", "ns": ...}, ...]}
+
+mirroring the timestamp-list shape of Triton's trace summary input.  An
+append-per-request stream (rather than one rewritten JSON array) keeps the
+file well-formed at every instant and safe under concurrent writers.
+
+``trace_level`` semantics:
+
+* ``OFF`` — tracing disabled (default).
+* ``TIMESTAMPS`` — emit per-request timelines to ``trace_file``.
+* ``TENSORS`` — refused loudly at update time (HTTP 501 / gRPC UNIMPLEMENTED):
+  tensor-payload capture would force a host copy of every traced tensor on the
+  TPU path, and silently accepting-then-ignoring the level is worse than
+  refusing it.
+* ``PROFILE`` — TPU extension (SURVEY §5 maps trace settings onto "JAX
+  profiler / XLA dump toggles"): while set, ``jax.profiler`` trace collection
+  runs into ``<trace_file>.profile/`` for TensorBoard/Perfetto.
+
+Timestamps use ``time.monotonic_ns()`` — the same clock as request
+``arrival_ns`` and the statistics subsystem, so trace entries line up with
+``/v2/models/*/stats`` durations.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional
+
+import time
+
+from .types import InferError
+
+_KNOWN_LEVELS = {"OFF", "TIMESTAMPS", "TENSORS", "PROFILE"}
+
+#: Server defaults — a ``null``/empty update value clears a key back to these
+#: (reference update_trace_settings contract).
+TRACE_DEFAULTS: Dict[str, List[str]] = {
+    "trace_file": ["trace.json"],
+    "trace_level": ["OFF"],
+    "trace_rate": ["1000"],
+    "trace_count": ["-1"],
+    "log_frequency": ["0"],
+}
+
+
+def validate_trace_update(settings: Dict[str, List[str]]) -> None:
+    """Reject unsupported trace settings *before* they are applied.
+
+    Raises ``InferError`` with http_status 501 for ``trace_level=TENSORS``
+    (both frontends map this to their loud-unimplemented status) and 400 for
+    unknown levels or non-numeric rate/count.
+    """
+    for key, vals in settings.items():
+        if key not in TRACE_DEFAULTS:
+            raise InferError(f"unknown trace setting '{key}'", http_status=400)
+        if not isinstance(vals, list) or not all(isinstance(v, str) for v in vals):
+            raise InferError(
+                f"trace setting '{key}' expects a list of strings",
+                http_status=400,
+            )
+    levels = settings.get("trace_level")
+    if levels is not None:
+        for lvl in levels:
+            if lvl not in _KNOWN_LEVELS:
+                raise InferError(f"unknown trace_level '{lvl}'", http_status=400)
+        if "TENSORS" in levels:
+            raise InferError(
+                "trace_level TENSORS is not implemented on the TPU path "
+                "(tensor capture would force a per-request device->host copy); "
+                "use TIMESTAMPS and/or PROFILE",
+                http_status=501,
+            )
+    for key in ("trace_rate", "trace_count", "log_frequency"):
+        vals = settings.get(key)
+        if vals is not None:
+            try:
+                ival = int(vals[0])
+            except (TypeError, ValueError, IndexError):
+                raise InferError(
+                    f"trace setting '{key}' expects an integer", http_status=400
+                )
+            if key == "trace_rate" and ival <= 0:
+                # clamping 0 to "trace everything" would invert the intent
+                raise InferError("trace_rate must be positive", http_status=400)
+
+
+class TraceContext:
+    """One traced request: collects (name, ns) timestamps, emitted on finish."""
+
+    __slots__ = ("_tracer", "id", "model_name", "model_version", "timestamps")
+
+    def __init__(self, tracer: "RequestTracer", trace_id: int,
+                 model_name: str, model_version: str) -> None:
+        self._tracer = tracer
+        self.id = trace_id
+        self.model_name = model_name
+        self.model_version = model_version
+        self.timestamps: List[Dict[str, int]] = []
+
+    def ts(self, name: str, ns: Optional[int] = None) -> None:
+        self.timestamps.append(
+            {"name": name, "ns": int(ns if ns is not None else time.monotonic_ns())}
+        )
+
+    def emit(self) -> None:
+        self._tracer._emit(self)
+
+
+class RequestTracer:
+    """Samples requests per the live settings dict and writes the trace file.
+
+    Holds a *reference* to ``InferenceCore.trace_settings`` so client updates
+    take effect on the next request without re-plumbing.  Counters (the
+    ``trace_rate`` sampling position and the ``trace_count`` budget) reset on
+    ``settings_updated()`` — a fresh update starts a fresh sampling window,
+    matching the reference server's per-update trace_count semantics.
+    """
+
+    def __init__(self, settings: Dict[str, List[str]]) -> None:
+        self._settings = settings
+        self._lock = threading.Lock()      # sampling counters only
+        self._io_lock = threading.Lock()   # trace-file appends — kept separate
+        # so a slow disk never serializes the sampling decision of untraced
+        # requests behind a write
+        self._seq = 0          # requests seen since last settings update
+        self._emitted = 0      # traces emitted since last settings update
+        self._next_id = 0      # file-unique trace id — never reset
+        self._file = None      # cached append handle (reopened on path change)
+        self._file_path = None
+        self._profiling = False
+
+    # -- settings lifecycle ------------------------------------------------
+    def settings_updated(self) -> None:
+        """Called by both frontends after applying a settings update."""
+        with self._lock:
+            self._seq = 0
+            self._emitted = 0
+        self._sync_profiler()
+
+    def _sync_profiler(self) -> None:
+        want = "PROFILE" in (self._settings.get("trace_level") or [])
+        if want and not self._profiling:
+            try:
+                import jax
+
+                jax.profiler.start_trace(self._profile_dir())
+                self._profiling = True
+            except Exception:
+                # Profiler unavailable (or already active elsewhere): tracing
+                # of timestamps must keep working regardless.
+                self._profiling = False
+        elif not want and self._profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
+
+    def _profile_dir(self) -> str:
+        return self._trace_file() + ".profile"
+
+    def shutdown(self) -> None:
+        with self._io_lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+                self._file_path = None
+        if self._profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
+
+    # -- per-request sampling ----------------------------------------------
+    def _trace_file(self) -> str:
+        vals = self._settings.get("trace_file") or ["trace.json"]
+        return vals[0] if vals and vals[0] else "trace.json"
+
+    def _int_setting(self, key: str, default: int) -> int:
+        vals = self._settings.get(key)
+        try:
+            return int(vals[0])
+        except (TypeError, ValueError, IndexError):
+            return default
+
+    def maybe_start(self, model_name: str, model_version: str) -> Optional[TraceContext]:
+        levels = self._settings.get("trace_level") or ["OFF"]
+        if "TIMESTAMPS" not in levels:
+            return None
+        rate = max(1, self._int_setting("trace_rate", 1000))
+        count = self._int_setting("trace_count", -1)
+        with self._lock:
+            self._seq += 1
+            if (self._seq - 1) % rate != 0:
+                return None
+            if count >= 0 and self._emitted >= count:
+                return None
+            self._emitted += 1
+            self._next_id += 1
+            trace_id = self._next_id
+        return TraceContext(self, trace_id, model_name, model_version)
+
+    def _emit(self, ctx: TraceContext) -> None:
+        line = json.dumps(
+            {
+                "id": ctx.id,
+                "model_name": ctx.model_name,
+                "model_version": ctx.model_version,
+                "timestamps": ctx.timestamps,
+            }
+        )
+        path = self._trace_file()
+        with self._io_lock:
+            try:
+                if self._file is None or self._file_path != path:
+                    if self._file is not None:
+                        self._file.close()
+                    self._file = open(path, "a")
+                    self._file_path = path
+                self._file.write(line + "\n")
+                self._file.flush()
+            except OSError:
+                # An unwritable trace_file must never fail the inference that
+                # happened to be sampled.
+                self._file = None
+                self._file_path = None
