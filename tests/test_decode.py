@@ -691,6 +691,41 @@ class TestBatchedGeneration:
             while s.get(timeout=300) is not None:
                 pass
 
+    def test_cancelled_generation_frees_slot(self, gen_pair):
+        """Closing the consumer mid-stream (disconnect / stop sequence)
+        flags the sink; the worker reaps the slot instead of ticking an
+        unread generation to completion — new submissions stop 429ing."""
+        import time as _time
+
+        from triton_client_tpu.server.types import InferError
+
+        gen_batched, _ = gen_pair
+        dec = gen_batched._decode
+        win = np.zeros((1, 128), np.int32)
+        long_n = 64
+        # occupy all 4 slots with long generations, read one token each
+        gens = [gen_batched._generate(
+            {"text_input": np.array([b"cancel me"], object)},
+            {"max_tokens": long_n}) for _ in range(4)]
+        for g in gens:
+            next(g)
+        with pytest.raises(InferError) as e:
+            dec.submit_generation(win, 3)
+        assert e.value.http_status == 429
+        for g in gens:
+            g.close()  # GeneratorExit -> sink.cancelled -> worker reaps
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            try:
+                sink = dec.submit_generation(win, 2)
+                break
+            except InferError:
+                _time.sleep(0.05)
+        else:
+            pytest.fail("slots never freed after cancellation")
+        while sink.get(timeout=300) is not None:
+            pass
+
     def test_sampled_requests_fall_back_to_chain(self, gen_pair):
         gen_batched, _ = gen_pair
         toks = [f["token_id"][0] for f in gen_batched._generate(

@@ -107,6 +107,127 @@ class TestCompletions:
             assert e.value.code == 400, body
 
 
+def _greedy_text(server, max_tokens=8):
+    """Baseline greedy output for the stop tests: deterministic, so a
+    substring of it is a stop sequence guaranteed to occur mid-stream."""
+    with _post(server.http_url, "/v1/completions", {
+        "model": "llama_generate", "prompt": "In a hole",
+        "max_tokens": max_tokens,
+    }) as r:
+        return json.loads(r.read())["choices"][0]["text"]
+
+
+class TestStopSequences:
+    def test_stop_truncates_non_streaming(self, server):
+        base = _greedy_text(server)
+        stop = base[3:5]
+        with _post(server.http_url, "/v1/completions", {
+            "model": "llama_generate", "prompt": "In a hole",
+            "max_tokens": 8, "stop": stop,
+        }) as r:
+            out = json.loads(r.read())
+        choice = out["choices"][0]
+        assert choice["finish_reason"] == "stop"
+        # stop text is swallowed; output is everything before the match
+        assert choice["text"] == base[:base.find(stop)]
+        assert stop not in choice["text"]
+        # usage counts tokens actually consumed (incl. the stop sequence),
+        # not tokens emitted — and never more than max_tokens
+        assert out["usage"]["completion_tokens"] <= 8
+
+    def test_stop_mid_generation_streaming(self, server):
+        base = _greedy_text(server)
+        stop = base[3:5]
+        with _post(server.http_url, "/v1/completions", {
+            "model": "llama_generate", "prompt": "In a hole",
+            "max_tokens": 8, "stop": stop, "stream": True,
+        }) as r:
+            frames = []
+            for line in r:
+                line = line.decode().strip()
+                if line == "data: [DONE]":
+                    break
+                if line.startswith("data: "):
+                    frames.append(json.loads(line[len("data: "):]))
+        text = "".join(
+            f["choices"][0].get("text") or "" for f in frames
+            if f["choices"][0]["finish_reason"] is None)
+        assert text == base[:base.find(stop)]
+        assert frames[-1]["choices"][0]["finish_reason"] == "stop"
+
+    def test_unmatched_stop_finishes_length(self, server):
+        base = _greedy_text(server, max_tokens=4)
+        with _post(server.http_url, "/v1/completions", {
+            "model": "llama_generate", "prompt": "In a hole",
+            "max_tokens": 4, "stop": "\x00\x01never\x02",
+        }) as r:
+            out = json.loads(r.read())
+        choice = out["choices"][0]
+        # held-back tail is flushed: unmatched stop loses no output
+        assert choice["text"] == base
+        assert choice["finish_reason"] == "length"
+
+    def test_chat_stop(self, server):
+        with _post(server.http_url, "/v1/chat/completions", {
+            "model": "llama_generate",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6, "stop": ["X", "Y", "Z", "W"],
+        }) as r:
+            out = json.loads(r.read())
+        assert out["choices"][0]["finish_reason"] in ("stop", "length")
+        content = out["choices"][0]["message"]["content"]
+        for s in ("X", "Y", "Z", "W"):
+            assert s not in content
+
+
+class TestNChoices:
+    def test_n2_non_streaming(self, server):
+        with _post(server.http_url, "/v1/completions", {
+            "model": "llama_generate", "prompt": "x",
+            "max_tokens": 4, "n": 2, "temperature": 1.5, "seed": 7,
+        }) as r:
+            out = json.loads(r.read())
+        assert [c["index"] for c in out["choices"]] == [0, 1]
+        assert all(len(c["text"]) >= 1 for c in out["choices"])
+        assert out["usage"]["completion_tokens"] == 8  # summed over choices
+
+    def test_n2_seeded_is_reproducible(self, server):
+        def run():
+            with _post(server.http_url, "/v1/completions", {
+                "model": "llama_generate", "prompt": "x",
+                "max_tokens": 4, "n": 2, "temperature": 1.5, "seed": 7,
+            }) as r:
+                return [c["text"] for c in json.loads(r.read())["choices"]]
+        assert run() == run()
+
+    def test_n2_streaming_interleaves_indices(self, server):
+        with _post(server.http_url, "/v1/chat/completions", {
+            "model": "llama_generate",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "n": 2, "stream": True,
+        }) as r:
+            frames = []
+            done = False
+            for line in r:
+                line = line.decode().strip()
+                if line == "data: [DONE]":
+                    done = True
+                    break
+                if line.startswith("data: "):
+                    frames.append(json.loads(line[len("data: "):]))
+        assert done
+        by_index = {0: [], 1: []}
+        finishes = {}
+        for f in frames:
+            c = f["choices"][0]
+            if c["finish_reason"] is not None:
+                finishes[c["index"]] = c["finish_reason"]
+            elif c["delta"].get("content"):
+                by_index[c["index"]].append(c["delta"]["content"])
+        assert finishes == {0: "length", 1: "length"}
+        assert all(len("".join(v)) >= 3 for v in by_index.values())
+
+
 class TestCompatEdges:
     def test_openai_error_shape(self, server):
         with pytest.raises(urllib.error.HTTPError) as e:
@@ -124,8 +245,16 @@ class TestCompatEdges:
             assert e.value.code == 400, extra
 
     def test_unsupported_params_rejected_loudly(self, server):
-        for extra in ({"n": 2}, {"top_p": 0.5}, {"stop": ["\n"]},
+        for extra in ({"top_p": 0.5},
                       {"stream_options": {"include_usage": True}}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.http_url, "/v1/completions",
+                      {"model": "llama_generate", "prompt": "x", **extra})
+            assert e.value.code == 400, extra
+
+    def test_invalid_stop_and_n_are_400(self, server):
+        for extra in ({"n": 0}, {"n": 99}, {"n": "two"}, {"stop": ""},
+                      {"stop": ["a", "b", "c", "d", "e"]}, {"stop": [7]}):
             with pytest.raises(urllib.error.HTTPError) as e:
                 _post(server.http_url, "/v1/completions",
                       {"model": "llama_generate", "prompt": "x", **extra})

@@ -824,9 +824,38 @@ class DecodeModel:
                     self._auto_slots.pop(slot)
                     self._release_gen_slot(slot)
 
+        def retire_cancelled(slot, sink):
+            """One place for cancelled-generation bookkeeping: free the slot
+            and end the (departed) consumer's sink stream."""
+            self._release_gen_slot(slot)
+            self._gen_reader.submit(sink.put, None)
+
+        def gen_was_cancelled(slot, completion) -> bool:
+            """A queued prefill whose consumer already left: retire it
+            before spending device time."""
+            if (completion[0] == "gen"
+                    and getattr(completion[2], "cancelled", False)):
+                retire_cancelled(slot, completion[2])
+                return True
+            return False
+
+        def reap_cancelled_gens():
+            """Free self-feeding slots whose consumer went away (client
+            disconnect, stop-sequence hit): the sink carries a ``cancelled``
+            flag set by the generate layer; ticking such a slot to
+            completion would burn device steps nobody reads while new
+            submissions 429 against it."""
+            for slot in list(self._auto_slots):
+                info = self._auto_slots[slot]
+                if getattr(info["sink"], "cancelled", False):
+                    self._auto_slots.pop(slot)
+                    retire_cancelled(slot, info["sink"])
+
         while True:
             if self._dead_gens:
                 reap_dead_gens()
+            if self._auto_slots:
+                reap_cancelled_gens()
             if self._auto_slots:
                 # self-feeding generations in flight: never block — tick
                 # them even when no client job is queued
@@ -848,6 +877,8 @@ class DecodeModel:
                 if gen != self._slot_gen[slot]:
                     deliver_error(completion,
                                   _stale_error(self._model.name))
+                    continue
+                if gen_was_cancelled(slot, completion):
                     continue
                 C = self._prefill_chunk
                 try:
@@ -876,6 +907,8 @@ class DecodeModel:
                 if gen != self._slot_gen[slot]:
                     deliver_error(completion,
                                   _stale_error(self._model.name))
+                    continue
+                if gen_was_cancelled(slot, completion):
                     continue
                 C = self._prefill_chunk
                 try:
@@ -1335,20 +1368,27 @@ class GenerateModel:
         from ..server.types import InferError
 
         sink = self._decode.submit_generation(window, n_tokens)
-        while True:
-            item = sink.get(timeout=3600)
-            if item is None:
-                return
-            if isinstance(item, Exception):
-                if isinstance(item, InferError):
-                    raise item
-                raise InferError(f"generation failed: {item}", 500)
-            tok = int(item)
-            yield {
-                "text_output": np.asarray(
-                    [chr(tok % 256).encode("utf-8")], dtype=object),
-                "token_id": np.asarray([tok], np.int32),
-            }
+        try:
+            while True:
+                item = sink.get(timeout=3600)
+                if item is None:
+                    return
+                if isinstance(item, Exception):
+                    if isinstance(item, InferError):
+                        raise item
+                    raise InferError(f"generation failed: {item}", 500)
+                tok = int(item)
+                yield {
+                    "text_output": np.asarray(
+                        [chr(tok % 256).encode("utf-8")], dtype=object),
+                    "token_id": np.asarray([tok], np.int32),
+                }
+        except GeneratorExit:
+            # consumer closed mid-stream (disconnect / stop sequence): flag
+            # the sink so the decode worker frees the slot instead of
+            # ticking an unread generation to completion
+            sink.cancelled = True
+            raise
 
     def _generate(self, inputs, parameters):
         np = self._np

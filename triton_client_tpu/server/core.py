@@ -19,6 +19,7 @@ thread-pool executor so the event loop keeps serving while XLA executes
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Set, Tuple
 
@@ -467,11 +468,21 @@ class InferenceCore:
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
         _SENTINEL = object()
+        consumer_gone = threading.Event()
+        sync_gen = model.execute_decoupled(inputs, params)
 
         def _produce():
             try:
-                for out in model.execute_decoupled(inputs, params):
-                    loop.call_soon_threadsafe(queue.put_nowait, out)
+                try:
+                    for out in sync_gen:
+                        loop.call_soon_threadsafe(queue.put_nowait, out)
+                        if consumer_gone.is_set():
+                            break
+                finally:
+                    # close() raises GeneratorExit inside the model's
+                    # generator so it can cancel device work (e.g. free a
+                    # self-feeding decode slot) on consumer disconnect
+                    sync_gen.close()
             except Exception as e:  # pragma: no cover - surfaced to stream
                 loop.call_soon_threadsafe(queue.put_nowait, e)
             finally:
@@ -480,17 +491,27 @@ class InferenceCore:
         t0 = time.monotonic_ns()
         producer = loop.run_in_executor(None, _produce)
         count = 0
-        while True:
-            item = await queue.get()
-            if item is _SENTINEL:
-                break
-            if isinstance(item, Exception):
-                model.stats.record(1, 0, time.monotonic_ns() - t0, ok=False)
-                raise item if isinstance(item, InferError) else InferError(str(item), 500)
-            count += 1
-            resp = self._build_response(model, request, item)
-            resp.parameters["triton_final_response"] = False
-            yield resp
+        try:
+            while True:
+                item = await queue.get()
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, Exception):
+                    model.stats.record(1, 0, time.monotonic_ns() - t0, ok=False)
+                    raise item if isinstance(item, InferError) else InferError(str(item), 500)
+                count += 1
+                resp = self._build_response(model, request, item)
+                resp.parameters["triton_final_response"] = False
+                yield resp
+        except GeneratorExit:
+            # consumer closed the stream early (stop sequence, disconnect):
+            # the request was served — it must not vanish from statistics
+            model.stats.record(1, 0, time.monotonic_ns() - t0, ok=True)
+            raise
+        finally:
+            # reached on aclose()/GeneratorExit too: tell the producer the
+            # consumer is gone so the model generator stops at its next token
+            consumer_gone.set()
         await producer
         model.stats.record(1, 0, time.monotonic_ns() - t0, ok=True)
         final = InferResponse(

@@ -15,6 +15,7 @@ curl at the serving harness with zero custom code:
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import Any, Dict, List, Optional
@@ -26,6 +27,71 @@ from .core import InferenceCore
 from .types import InferError, InferRequest, InputTensor, RequestedOutput
 
 _COUNTER = iter(range(1, 1 << 62))
+_MAX_N = 16        # choices per request — each holds a decode slot
+_MAX_STOPS = 4     # OpenAI contract: up to 4 stop sequences
+
+
+def _parse_stop(stop) -> List[str]:
+    """OpenAI ``stop``: a string or an array of up to 4 non-empty strings."""
+    if stop is None or stop == []:  # empty array = no stop (OpenAI accepts)
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    if (not isinstance(stop, list) or not stop
+            or not all(isinstance(s, str) and s for s in stop)):
+        raise InferError(
+            "'stop' must be a non-empty string or an array of non-empty "
+            "strings")
+    if len(stop) > _MAX_STOPS:
+        raise InferError(f"'stop' supports at most {_MAX_STOPS} sequences")
+    return stop
+
+
+class _StopScanner:
+    """Streams text through stop-sequence matching.
+
+    ``feed(piece)`` returns the text that is now safe to emit: the scanner
+    holds back the last ``max(len(stop)) - 1`` characters so a streamed delta
+    can never contain (a prefix of) a stop sequence that a later token
+    completes — once emitted, a delta cannot be retracted.  When a stop
+    sequence matches, the text before the match is released, the stop text
+    itself is swallowed (OpenAI contract), and ``stopped`` latches.
+    ``tokens`` counts every model token consumed, including those inside the
+    stop sequence — that is what the generation actually cost, so it is what
+    ``usage.completion_tokens`` reports.
+    """
+
+    def __init__(self, stops: List[str]) -> None:
+        self._stops = stops
+        self._hold = max((len(s) for s in stops), default=1) - 1
+        self._buf = ""
+        self.stopped = False
+        self.tokens = 0
+
+    def feed(self, piece: str) -> str:
+        self.tokens += 1
+        if not self._stops:
+            return piece
+        self._buf += piece
+        first = -1
+        for s in self._stops:
+            i = self._buf.find(s)
+            if i >= 0 and (first < 0 or i < first):
+                first = i
+        if first >= 0:
+            out, self._buf = self._buf[:first], ""
+            self.stopped = True
+            return out
+        if len(self._buf) > self._hold:
+            cut = len(self._buf) - self._hold
+            out, self._buf = self._buf[:cut], self._buf[cut:]
+            return out
+        return ""
+
+    def flush(self) -> str:
+        """Natural end of generation: the held-back tail is real output."""
+        out, self._buf = self._buf, ""
+        return out
 
 
 def add_openai_routes(app: web.Application, core: InferenceCore) -> None:
@@ -111,16 +177,18 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
             f"model '{model_name}' does not speak the generate contract "
             "(decoupled, text_input)")
     # honored params are cast under a 400 guard; recognized-but-unsupported
-    # params are rejected loudly — silently ignoring n/top_p/stop would
-    # return 200s that look honored but are not
-    if body.get("n") not in (None, 1):
-        raise InferError("'n' > 1 is not supported")
+    # params are rejected loudly — silently ignoring top_p would return
+    # 200s that look honored but are not
     if body.get("top_p") not in (None, 1, 1.0):
         raise InferError("'top_p' is not supported; use 'top_k'")
-    if body.get("stop"):
-        raise InferError("'stop' sequences are not supported")
     if body.get("stream_options"):
         raise InferError("'stream_options' is not supported")
+    n = body.get("n")
+    if n is None:
+        n = 1
+    if not isinstance(n, int) or isinstance(n, bool) or not 1 <= n <= _MAX_N:
+        raise InferError(f"'n' must be an integer in [1, {_MAX_N}]")
+    stops = _parse_stop(body.get("stop"))
     parameters: Dict[str, Any] = {}
     try:
         if body.get("max_tokens") is not None:
@@ -133,34 +201,74 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
             parameters["top_k"] = int(body["top_k"])
     except (TypeError, ValueError) as e:
         raise InferError(f"invalid sampling parameter: {e}")
-    req = InferRequest(
-        model_name=model_name,
-        inputs=[InputTensor(
-            name="text_input", datatype="BYTES", shape=(1,),
-            data=np.asarray([prompt.encode()], dtype=object))],
-        outputs=[RequestedOutput(name="text_output", binary_data=False)],
-        parameters=parameters,
-    )
-    return model_name, req
+    reqs = []
+    for i in range(n):
+        p = dict(parameters)
+        if "seed" in p and n > 1:
+            # a fixed seed must still give n distinct samples — per-choice
+            # offset keeps the whole response reproducible
+            p["seed"] = p["seed"] + i
+        reqs.append(InferRequest(
+            model_name=model_name,
+            inputs=[InputTensor(
+                name="text_input", datatype="BYTES", shape=(1,),
+                data=np.asarray([prompt.encode()], dtype=object))],
+            outputs=[RequestedOutput(name="text_output", binary_data=False)],
+            parameters=p,
+        ))
+    return model_name, reqs, stops
 
 
-def _chunk(rid: str, created: int, model: str, kind: str,
-           delta_or_text: Optional[str], finish: Optional[str],
-           chat: bool) -> dict:
+def _choice(index: int, kind: str, delta_or_text: Optional[str],
+            finish: Optional[str], chat: bool) -> dict:
     if chat:
-        entry: Dict[str, Any] = {"index": 0, "finish_reason": finish}
+        entry: Dict[str, Any] = {"index": index, "finish_reason": finish}
         entry["delta" if kind == "chunk" else "message"] = (
             {} if delta_or_text is None
             else ({"content": delta_or_text} if kind == "chunk"
                   else {"role": "assistant", "content": delta_or_text}))
-        obj = ("chat.completion.chunk" if kind == "chunk"
-               else "chat.completion")
     else:
-        entry = {"index": 0, "text": delta_or_text or "",
+        entry = {"index": index, "text": delta_or_text or "",
                  "finish_reason": finish}
+    return entry
+
+
+def _envelope(rid: str, created: int, model: str, kind: str, chat: bool,
+              choices: List[dict]) -> dict:
+    if chat:
+        obj = "chat.completion.chunk" if kind == "chunk" else "chat.completion"
+    else:
         obj = "text_completion"
     return {"id": rid, "object": obj, "created": created, "model": model,
-            "choices": [entry]}
+            "choices": choices}
+
+
+async def _consume(core, req, scanner: _StopScanner, emit) -> str:
+    """Drive one generation stream through the stop scanner, calling
+    ``await emit(text)`` for each releasable span.  Returns the finish
+    reason.  Closing the stream early (stop hit) propagates through
+    ``infer_stream`` to the model generator, which frees its decode slot
+    instead of generating unread tokens."""
+    agen = core.infer_stream(req)
+    try:
+        async for resp in agen:
+            for t in resp.outputs:
+                if t.name != "text_output" or t.data is None:
+                    continue
+                for v in t.data.reshape(-1):
+                    piece = (v.decode("utf-8", "replace")
+                             if isinstance(v, bytes) else str(v))
+                    out = scanner.feed(piece)
+                    if out:
+                        await emit(out)
+                    if scanner.stopped:
+                        return "stop"
+        tail = scanner.flush()
+        if tail:
+            await emit(tail)
+        return "length"
+    finally:
+        await agen.aclose()
 
 
 async def _run(core, request, chat: bool):
@@ -173,48 +281,91 @@ async def _run(core, request, chat: bool):
         prompt = body.get("prompt", "")
         if not isinstance(prompt, str):
             raise InferError("'prompt' must be a string")
-    model_name, req = _build_request(core, body, prompt)
+    model_name, reqs, stops = _build_request(core, body, prompt)
     rid = f"cmpl-{next(_COUNTER)}"
     created = int(time.time())
 
     if not body.get("stream", False):
-        pieces: List[str] = []
-        async for resp in core.infer_stream(req):
-            for t in resp.outputs:
-                if t.name == "text_output" and t.data is not None:
-                    pieces.extend(
-                        v.decode("utf-8", "replace") if isinstance(v, bytes)
-                        else str(v) for v in t.data.reshape(-1))
-        text = "".join(pieces)
-        out = _chunk(rid, created, model_name, "full", text, "length", chat)
+        async def run_choice(req):
+            scanner = _StopScanner(stops)
+            pieces: List[str] = []
+
+            async def emit(text):
+                pieces.append(text)
+
+            finish = await _consume(core, req, scanner, emit)
+            return "".join(pieces), scanner.tokens, finish
+
+        # fail fast: the first failing choice (e.g. 429 slot exhaustion)
+        # cancels its siblings instead of letting them generate to
+        # completion for a response that will be discarded
+        tasks = [asyncio.create_task(run_choice(r)) for r in reqs]
+        try:
+            results = await asyncio.gather(*tasks)
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        choices = [
+            _choice(i, "full", text, finish, chat)
+            for i, (text, _tokens, finish) in enumerate(results)
+        ]
+        completion_tokens = sum(t for _, t, _f in results)
+        out = _envelope(rid, created, model_name, "full", chat, choices)
         out["usage"] = {
             "prompt_tokens": len(prompt.encode()),
-            "completion_tokens": len(pieces),
-            "total_tokens": len(prompt.encode()) + len(pieces),
+            "completion_tokens": completion_tokens,
+            "total_tokens": len(prompt.encode()) + completion_tokens,
         }
         return web.json_response(out)
 
-    # streaming: one SSE chunk per token, then [DONE] (OpenAI framing),
-    # over the shared SSE lifecycle (same first-frame-before-headers and
-    # disconnect semantics as /generate_stream)
+    # streaming: choices run concurrently; their deltas interleave as SSE
+    # chunks tagged with the choice index, each choice closes with its own
+    # finish_reason chunk, then [DONE] (OpenAI framing) — over the shared
+    # SSE lifecycle (same first-frame-before-headers and disconnect
+    # semantics as /generate_stream)
     from .http_server import sse_stream
 
-    async def write_frame(stream, resp):
-        for t in resp.outputs:
-            if t.name != "text_output" or t.data is None:
-                continue
-            for v in t.data.reshape(-1):
-                delta = (v.decode("utf-8", "replace")
-                         if isinstance(v, bytes) else str(v))
-                frame = _chunk(rid, created, model_name, "chunk", delta,
-                               None, chat)
-                await stream.write(
-                    f"data: {json.dumps(frame)}\n\n".encode())
+    async def merged():
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def run_choice(i, req):
+            scanner = _StopScanner(stops)
+            try:
+                finish = await _consume(
+                    core, req, scanner,
+                    lambda text: q.put((i, "delta", text)))
+                await q.put((i, "finish", finish))
+            except Exception as e:  # noqa: BLE001 — re-raised by the reader
+                await q.put((i, "error", e))
+
+        tasks = [asyncio.create_task(run_choice(i, r))
+                 for i, r in enumerate(reqs)]
+        try:
+            open_choices = len(reqs)
+            while open_choices:
+                i, kind, payload = await q.get()
+                if kind == "error":
+                    raise payload if isinstance(payload, InferError) \
+                        else InferError(str(payload), 500)
+                if kind == "finish":
+                    open_choices -= 1
+                yield i, kind, payload
+        finally:
+            for t in tasks:
+                t.cancel()
+
+    async def write_frame(stream, item):
+        i, kind, payload = item
+        if kind == "delta":
+            entry = _choice(i, "chunk", payload, None, chat)
+        else:
+            entry = _choice(i, "chunk", None, payload, chat)
+        frame = _envelope(rid, created, model_name, "chunk", chat, [entry])
+        await stream.write(f"data: {json.dumps(frame)}\n\n".encode())
 
     async def epilogue(stream):
-        final = _chunk(rid, created, model_name, "chunk", None, "length",
-                       chat)
-        await stream.write(f"data: {json.dumps(final)}\n\n".encode())
         await stream.write(b"data: [DONE]\n\n")
 
     def on_error(e):
@@ -222,7 +373,7 @@ async def _run(core, request, chat: bool):
                                     "type": "invalid_request_error"}})
         return f"data: {err}\n\n".encode()
 
-    return await sse_stream(request, core.infer_stream(req), write_frame,
+    return await sse_stream(request, merged(), write_frame,
                             on_error, epilogue=epilogue)
 
 
