@@ -734,6 +734,144 @@ class TestBatchedGeneration:
         assert len(toks) == 5
 
 
+class TestBucketedCache:
+    """Slab-size buckets (TRITON_TPU_DECODE_BUCKETS): short generations
+    take a short slab so the same HBM budget holds more concurrent
+    generations; outputs stay token-identical to the fixed layout."""
+
+    @pytest.fixture()
+    def bucketed(self, monkeypatch):
+        from triton_client_tpu.models.decode import (DecodeModel,
+                                                     GenerateModel)
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        # prompt window is 128 under tests: 3 slabs of 160 (<=32 generated
+        # tokens) + 1 of 256
+        monkeypatch.setenv("TRITON_TPU_DECODE_BUCKETS", "3x160,1x256")
+        dec = DecodeModel(name="llama_decode_buck")
+        gen = GenerateModel(dec, name="llama_generate_buck")
+        yield dec, gen
+        dec._shutdown()
+
+    @pytest.fixture()
+    def flat(self, monkeypatch):
+        from triton_client_tpu.models.decode import (DecodeModel,
+                                                     GenerateModel)
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        # the bucketed fixture's env must not leak in: this model IS the
+        # fixed layout the identity test compares against
+        monkeypatch.delenv("TRITON_TPU_DECODE_BUCKETS", raising=False)
+        dec = DecodeModel(name="llama_decode_flat")
+        gen = GenerateModel(dec, name="llama_generate_flat")
+        yield dec, gen
+        dec._shutdown()
+
+    @staticmethod
+    def _tokens(gen_model, prompt, n):
+        return [int(f["token_id"][0]) for f in gen_model._generate(
+            {"text_input": np.array([prompt], object)},
+            {"max_tokens": n})]
+
+    def test_token_identity_vs_flat_layout(self, bucketed, flat):
+        """A short generation lands in a 160-token slab; its tokens must
+        equal the fixed 256-slab layout's (attention is masked by pos, so
+        slab length is invisible to the math)."""
+        _, gen_b = bucketed
+        _, gen_f = flat
+        want = self._tokens(gen_f, b"bucket identity", 6)
+        got = self._tokens(gen_b, b"bucket identity", 6)
+        assert got == want and len(got) == 6
+
+    def test_short_generations_fill_then_spill_up(self, bucketed):
+        from triton_client_tpu.server.types import InferError
+
+        dec, _ = bucketed
+        win = np.zeros((1, 128), np.int32)
+        # four short gens fit: 3 small slabs + spill-up into the large
+        sinks = [dec.submit_generation(win, 16) for _ in range(4)]
+        with pytest.raises(InferError) as e:
+            dec.submit_generation(win, 16)
+        assert e.value.http_status == 429
+        for s in sinks:
+            while s.get(timeout=300) is not None:
+                pass
+
+    def test_long_generation_requires_large_slab(self, bucketed):
+        from triton_client_tpu.server.types import InferError
+
+        dec, _ = bucketed
+        win = np.zeros((1, 128), np.int32)
+        long_sink = dec.submit_generation(win, 100)  # needs 228 > 160
+        # the one large slab is taken: a second long gen 429s even though
+        # all three small slabs are free...
+        with pytest.raises(InferError) as e:
+            dec.submit_generation(win, 100)
+        assert e.value.http_status == 429
+        assert "228" in str(e.value)
+        # ...while short generations still run
+        short = dec.submit_generation(win, 8)
+        for s in (long_sink, short):
+            while s.get(timeout=300) is not None:
+                pass
+
+    def test_sequences_prefer_the_large_slab(self, bucketed):
+        dec, _ = bucketed
+        win = np.zeros((128,), np.int32)
+        dec._execute({"TOKENS": win},
+                     {"sequence_id": 9100, "sequence_start": True})
+        # the sequence took the large slab (global slot 3: offset of the
+        # 256 bucket), keeping headroom before its cap
+        assert dec._state[9100] == 3
+        dec._execute({"TOKENS": np.array([1], np.int32)},
+                     {"sequence_id": 9100, "sequence_end": True})
+
+    def test_sequence_cap_is_the_slabs_cap(self, bucketed):
+        from triton_client_tpu.server.types import InferError
+
+        dec, _ = bucketed
+        win = np.zeros((128,), np.int32)
+        # large slab taken by a long generation -> the sequence falls back
+        # to a 160-token slab and hits ITS cap, reported as such
+        long_sink = dec.submit_generation(np.zeros((1, 128), np.int32), 100)
+        res = dec._execute({"TOKENS": win},
+                           {"sequence_id": 9200, "sequence_start": True})
+        assert dec._state[9200] < 3  # small-bucket slot
+        for _ in range(160 - 128):
+            res = dec._execute({"TOKENS": res["NEXT_TOKEN"]},
+                               {"sequence_id": 9200})
+        with pytest.raises(InferError, match="160-token cache"):
+            dec._execute({"TOKENS": res["NEXT_TOKEN"]},
+                         {"sequence_id": 9200})
+        # sequence_end past the cap frees the slot (and still errors, by
+        # design: "free the slot even on the failure path")
+        with pytest.raises(InferError, match="160-token cache"):
+            dec._execute({"TOKENS": np.array([1], np.int32)},
+                         {"sequence_id": 9200, "sequence_end": True})
+        assert 9200 not in dec._state
+        while long_sink.get(timeout=300) is not None:
+            pass
+
+    def test_bad_bucket_specs_fail_loudly(self, monkeypatch):
+        from triton_client_tpu.models.decode import DecodeModel
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        for spec, msg in [("nonsense", "expected <count>x<tokens>"),
+                          ("0x160", "must be positive"),
+                          ("2x64", "must exceed"),       # cap < prompt 128
+                          ("2x160,2x160", "duplicate cap")]:
+            monkeypatch.setenv("TRITON_TPU_DECODE_BUCKETS", spec)
+            with pytest.raises(ValueError, match=msg):
+                DecodeModel(name="llama_decode_badbuck")
+        # buckets without batched mode fail loudly instead of silently
+        # reshaping the independent-mode cache
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "independent")
+        monkeypatch.setenv("TRITON_TPU_DECODE_BUCKETS", "3x160,1x256")
+        with pytest.raises(ValueError, match="requires.*batched"):
+            DecodeModel(name="llama_decode_badbuck")
+
+
 class TestMoePresetServing:
     """llama_decode / llama_generate serve an MoE preset end-to-end
     (TRITON_TPU_LLAMA_PRESET=tiny-moe)."""

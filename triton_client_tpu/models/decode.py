@@ -74,7 +74,7 @@ def _stale_error(model_name: str):
 
 
 def decode_mesh(cfg: tr.TransformerConfig, n_slots: int = 1,
-                model_name=None):
+                model_name=None, slots_desc=None):
     """Serve mesh for the decode stack, from ``TRITON_TPU_SERVE_MESH``.
 
     Decode shards over **tp** (attention heads / FFN hidden) and **dp**
@@ -103,9 +103,9 @@ def decode_mesh(cfg: tr.TransformerConfig, n_slots: int = 1,
                 f"must divide n_heads={cfg.n_heads}")
         if explicit["dp"] > 1 and n_slots % explicit["dp"] != 0:
             raise ValueError(
-                f"{var}={spec!r}: dp={explicit['dp']} "
-                f"must divide the {n_slots} decode slots "
-                "(TRITON_TPU_DECODE_SLOTS)")
+                f"{var}={spec!r}: dp={explicit['dp']} must divide "
+                + (slots_desc or f"the {n_slots} decode slots "
+                                 "(TRITON_TPU_DECODE_SLOTS)"))
         n = math.prod(explicit.values())
         if n > len(devices):
             raise ValueError(
@@ -328,6 +328,52 @@ def _rope_at(x, pos, theta):
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
+def parse_cache_buckets(spec, n_slots: int, s_max: int, prompt_len: int):
+    """Slab-size buckets for the batched slot cache.
+
+    ``TRITON_TPU_DECODE_BUCKETS="48x640,16x1280"`` = 48 slots capped at 640
+    tokens each plus 16 at 1280.  Capacity scaling the TPU-native way: where
+    CUDA serving stacks reach for block-table paging (dynamic gathers XLA
+    can't tile well without a custom kernel), a small static set of slab
+    sizes keeps every shape compile-time constant — short generations stop
+    paying a full-length HBM slab, so the same cache budget holds several
+    times more concurrent generations, and the per-tick attention over a
+    small bucket reads proportionally fewer bytes.
+
+    Unset → one bucket ``[(n_slots, s_max)]``: exactly the previous fixed
+    layout.  Returns ``[(count, cap), ...]`` ascending by cap; every cap
+    must exceed the prefill window (a slab must at least hold the prompt
+    plus one generated token).
+    """
+    if not spec:
+        return [(n_slots, s_max)]
+    out = []
+    for part in spec.split(","):
+        try:
+            cnt_s, cap_s = part.strip().lower().split("x")
+            cnt, cap = int(cnt_s), int(cap_s)
+        except ValueError:
+            raise ValueError(
+                f"TRITON_TPU_DECODE_BUCKETS part {part.strip()!r}: expected "
+                "<count>x<tokens> (e.g. '48x640')")
+        if cnt <= 0:
+            raise ValueError(
+                f"TRITON_TPU_DECODE_BUCKETS: count must be positive in "
+                f"{part.strip()!r}")
+        if cap <= prompt_len:
+            raise ValueError(
+                f"TRITON_TPU_DECODE_BUCKETS: cap {cap} must exceed the "
+                f"{prompt_len}-token prefill window (prompt + >=1 token)")
+        out.append((cnt, cap))
+    out.sort(key=lambda t: t[1])
+    caps = [c for _, c in out]
+    if len(set(caps)) != len(caps):
+        raise ValueError(
+            f"TRITON_TPU_DECODE_BUCKETS: duplicate cap in {spec!r}; merge "
+            "the counts instead")
+    return out
+
+
 def _slot_decode_layer(blk, x, kc, vc, pos, active,
                        cfg: tr.TransformerConfig):
     """One token per slot, each at its own position.
@@ -399,9 +445,13 @@ def make_slot_step(cfg: tr.TransformerConfig):
     return step
 
 
-def make_slot_prefill(cfg: tr.TransformerConfig, s_max: int):
+def make_slot_prefill(cfg: tr.TransformerConfig, s_max: int = 0):
     """jitted (params, k, v, tokens [1,S], slot) -> (next tok, best logit,
-    k', v') — prefills ONE slot of the shared cache in a single forward."""
+    k', v') — prefills ONE slot of the shared cache in a single forward.
+
+    The cache length comes from ``k.shape[3]`` (``s_max`` is accepted for
+    back-compat and ignored), so one returned function serves every slab
+    bucket — jit retraces per distinct cache shape."""
 
     @jax.jit
     def prefill(params, k, v, tokens, slot):
@@ -414,7 +464,7 @@ def make_slot_prefill(cfg: tr.TransformerConfig, s_max: int):
             return x, (kl, vl)
 
         x, (ks, vs) = lax.scan(layer, x, blocks)                  # [L,1,H,S,K]
-        pad = s_max - S
+        pad = k.shape[3] - S
         ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
         vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
         k = lax.dynamic_update_slice(k, ks.astype(k.dtype),
@@ -532,6 +582,25 @@ class DecodeModel:
             raise ValueError(
                 f"TRITON_TPU_DECODE_MODE={self._mode!r}: expected "
                 "'independent' or 'batched'")
+        # slab-size buckets (batched mode): short generations take a short
+        # slab, so the same HBM budget holds more concurrent generations
+        bucket_spec = os.environ.get("TRITON_TPU_DECODE_BUCKETS")
+        if bucket_spec and self._mode != "batched":
+            # fail loudly, not silently-reshape the independent-mode cache
+            raise ValueError(
+                "TRITON_TPU_DECODE_BUCKETS requires "
+                "TRITON_TPU_DECODE_MODE=batched (independent mode has no "
+                "shared slot cache to bucket)")
+        self._buckets = parse_cache_buckets(
+            bucket_spec, n_slots, self._s_max, self._prompt_len)
+        n_slots = sum(c for c, _ in self._buckets)
+        self._n_slots = n_slots
+        self._s_max = max(cap for _, cap in self._buckets)
+        off = 0
+        self._bucket_off = []
+        for cnt, _cap in self._buckets:
+            self._bucket_off.append(off)
+            off += cnt
         cfg = make_config(
             name,
             inputs=[("TOKENS", "INT32", [-1])],
@@ -617,8 +686,20 @@ class DecodeModel:
             # commit to the serve mesh: GSPMD partitions the jitted
             # prefill/step from these shardings (tp over heads; one-device
             # mesh when TRITON_TPU_SERVE_MESH is unset)
-            mesh = decode_mesh(cfg, n_slots=self._n_slots,
-                               model_name=self._model.name)
+            # dp shards the slot axis of EVERY bucket's cache array, so the
+            # divisibility constraint is the gcd of the bucket counts (=
+            # n_slots when unbucketed)
+            div = 0
+            for cnt, _cap in self._buckets:
+                div = math.gcd(div, cnt)
+            desc = None
+            if len(self._buckets) > 1:
+                desc = ("every cache bucket's slot count "
+                        f"(gcd {div} of {self._n_slots} slots; "
+                        "TRITON_TPU_DECODE_BUCKETS)")
+            mesh = decode_mesh(cfg, n_slots=div,
+                               model_name=self._model.name,
+                               slots_desc=desc)
             params = place_decode_params(params, mesh, cfg)
             self._mesh = mesh
             self._params = (params, cfg)
@@ -636,23 +717,33 @@ class DecodeModel:
                     import numpy as np
 
                     params, cfg = self._ensure_params()
-                    shape = (cfg.n_layers, self._n_slots, cfg.n_heads,
-                             self._s_max, cfg.head_dim)
                     from jax.sharding import NamedSharding
                     from jax.sharding import PartitionSpec as P
 
                     # slot cache on the serve mesh: slots over dp, heads
                     # over tp (mirrors the K/V the tp-sharded wk/wv produce
-                    # so the cache write needs no resharding)
+                    # so the cache write needs no resharding); one array
+                    # per slab bucket — every shape stays static
                     cache_sharding = NamedSharding(
                         self._mesh, P(None, "dp", "tp", None, None))
-                    self._k = jax.device_put(
-                        jnp.zeros(shape, cfg.dtype), cache_sharding)
-                    self._v = jax.device_put(
-                        jnp.zeros(shape, cfg.dtype), cache_sharding)
-                    # device-resident previous-tick outputs: the feedback
-                    # for self-feeding (server-side generation) slots
-                    self._prev_nxt = jnp.zeros(self._n_slots, jnp.int32)
+                    dp = self._mesh.shape["dp"]
+                    self._k, self._v, self._prev_nxt = [], [], []
+                    for cnt, cap in self._buckets:
+                        if dp > 1 and cnt % dp:
+                            raise ValueError(
+                                f"serve mesh dp={dp} must divide every "
+                                f"cache bucket's slot count; bucket "
+                                f"{cnt}x{cap} does not "
+                                "(TRITON_TPU_DECODE_BUCKETS)")
+                        shape = (cfg.n_layers, cnt, cfg.n_heads,
+                                 cap, cfg.head_dim)
+                        self._k.append(jax.device_put(
+                            jnp.zeros(shape, cfg.dtype), cache_sharding))
+                        self._v.append(jax.device_put(
+                            jnp.zeros(shape, cfg.dtype), cache_sharding))
+                        # device-resident previous-tick outputs: the
+                        # feedback for self-feeding (generation) slots
+                        self._prev_nxt.append(jnp.zeros(cnt, jnp.int32))
                     # worker-owned self-feeding slot registry
                     self._auto_slots = {}
                     # (slot, gen) pairs whose sink resolution failed; the
@@ -712,6 +803,38 @@ class DecodeModel:
         return self._fns_ind
 
     # -- slot bookkeeping (under self._lock) -------------------------------
+    def _slot_bucket(self, slot: int):
+        """Global slot id -> (bucket index, bucket-local index)."""
+        for b in range(len(self._buckets) - 1, -1, -1):
+            off = self._bucket_off[b]
+            if slot >= off:
+                return b, slot - off
+        raise ValueError(f"slot {slot} out of range")
+
+    def _slot_cap(self, slot: int) -> int:
+        return self._buckets[self._slot_bucket(slot)[0]][1]
+
+    def _alloc_slot_locked(self, need_s: int, prefer_large: bool = False):
+        """Pop a free slot whose slab holds ``need_s`` tokens, or None.
+
+        Generations (known length) fill smallest-fitting-first so short
+        requests never burn a long slab; sequences (open-ended length)
+        prefer the largest bucket so they keep maximum headroom before the
+        cap error asks for sequence_end."""
+        order = range(len(self._buckets))
+        if prefer_large:
+            order = reversed(order)
+        for b in order:
+            cnt, cap = self._buckets[b]
+            if cap < need_s:
+                continue
+            off = self._bucket_off[b]
+            for slot in range(off, off + cnt):
+                if slot in self._free:
+                    self._free.discard(slot)
+                    return slot
+        return None
+
     def _evict_idle_locked(self, now: float) -> None:
         stale = [k for k, t in self._touched.items()
                  if now - t > self._idle_s]
@@ -799,7 +922,8 @@ class DecodeModel:
                                      completion[1])
                 return
             _tag, n_tokens, sink = completion
-            self._prev_nxt = self._prev_nxt.at[slot].set(nxt_dev)
+            b, li = self._slot_bucket(slot)
+            self._prev_nxt[b] = self._prev_nxt[b].at[li].set(nxt_dev)
             if hasattr(nxt_dev, "copy_to_host_async"):
                 nxt_dev.copy_to_host_async()
             self._gen_reader.submit(self._resolve_gen_token, nxt_dev,
@@ -881,20 +1005,21 @@ class DecodeModel:
                 if gen_was_cancelled(slot, completion):
                     continue
                 C = self._prefill_chunk
+                b, li = self._slot_bucket(slot)
                 try:
                     if C and win.shape[1] > C:
                         # chunked: run the first chunk now, re-enqueue the
                         # continuation at the queue tail so pending decode
                         # steps tick in between (no cohort-wide stall)
-                        _, _, self._k, self._v = self._chunk_fn(
-                            params, self._k, self._v,
-                            jnp.asarray(win[:, :C]), slot, 0)
+                        _, _, self._k[b], self._v[b] = self._chunk_fn(
+                            params, self._k[b], self._v[b],
+                            jnp.asarray(win[:, :C]), li, 0)
                         self._jobs.put(("prefill_cont",
                                         (slot, gen, win, C, completion),
                                         None))
                         continue
-                    nxt, best, self._k, self._v = prefill(
-                        params, self._k, self._v, jnp.asarray(win), slot)
+                    nxt, best, self._k[b], self._v[b] = prefill(
+                        params, self._k[b], self._v[b], jnp.asarray(win), li)
                     finish_prefill(slot, gen, win.shape[1], nxt, best,
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
@@ -911,10 +1036,11 @@ class DecodeModel:
                 if gen_was_cancelled(slot, completion):
                     continue
                 C = self._prefill_chunk
+                b, li = self._slot_bucket(slot)
                 try:
-                    nxt, best, self._k, self._v = self._chunk_fn(
-                        params, self._k, self._v,
-                        jnp.asarray(win[:, pos0:pos0 + C]), slot, pos0)
+                    nxt, best, self._k[b], self._v[b] = self._chunk_fn(
+                        params, self._k[b], self._v[b],
+                        jnp.asarray(win[:, pos0:pos0 + C]), li, pos0)
                     if pos0 + C < win.shape[1]:
                         self._jobs.put(("prefill_cont",
                                         (slot, gen, win, pos0 + C,
@@ -970,67 +1096,91 @@ class DecodeModel:
                     self._jobs.put(d)
             if not batch and not self._auto_slots:
                 continue
-            tokens = np.zeros(self._n_slots, np.int32)
-            active = np.zeros(self._n_slots, bool)
-            auto = np.zeros(self._n_slots, bool)
-            for (slot, tok), _ in batch:
-                tokens[slot] = tok
-                active[slot] = True
-            gen_slots = list(self._auto_slots)
-            for slot in gen_slots:
-                active[slot] = True
-                auto[slot] = True
-            # bound how far device dispatch runs ahead of readbacks: a
-            # pure-auto loop would otherwise enqueue ticks unboundedly
-            self._tick_budget.acquire()
-            try:
-                nxt, best, self._k, self._v = step(
-                    params, self._k, self._v, jnp.asarray(tokens),
-                    self._prev_nxt, jnp.asarray(self._pos),
-                    jnp.asarray(active), jnp.asarray(auto))
-                self._prev_nxt = nxt
-                pair = jnp.stack([nxt.astype(jnp.float32), best])
-                if hasattr(pair, "copy_to_host_async"):
-                    # prefetch the D2H NOW: the resolver threads then find
-                    # the transfer already in flight, so readbacks overlap
-                    # later ticks instead of costing one RTT each (the
-                    # same trick the per-request generation chain uses)
-                    pair.copy_to_host_async()
-                for (slot, tok), _ in batch:
-                    self._pos[slot] += 1
-                for slot in gen_slots:
-                    self._pos[slot] += 1
-            except Exception as e:  # noqa: BLE001 — surfaced via futures
-                self._tick_budget.release()
-                for _, f in batch:
-                    f.set_exception(e)
-                for slot in gen_slots:
-                    info = self._auto_slots.pop(slot)
-                    self._gen_reader.submit(info["sink"].put, e)
-                    self._release_gen_slot(slot)
-                continue
-            # which generations end on this tick (token streamed, then the
-            # slot frees; the readback snapshot keeps its values valid even
-            # if the slot is reused by a later tick)
-            gen_batch = []
-            for slot in gen_slots:
-                info = self._auto_slots[slot]
-                info["remaining"] -= 1
-                done = info["remaining"] <= 0
-                if done or self._pos[slot] >= self._s_max:
-                    done = True
-                    self._auto_slots.pop(slot)
-                    self._release_gen_slot(slot)
-                gen_batch.append((slot, info["sink"], done, info["gen"]))
-            # PIPELINE the readback: over a remote device the blocking D2H
-            # costs a full round trip; resolving it on a reader thread lets
-            # the next tick's compute dispatch immediately, so round trips
-            # overlap instead of gating the tick rate. Safe because a
-            # sequence never has two steps in flight (closed loop + per-seq
-            # lock): tick N+1 only carries other sequences' tokens.
-            pool = self._gen_reader if gen_batch else self._readers
-            pool.submit(self._resolve_tick, pair, batch, gen_batch,
-                        self._tick_budget)
+            # group this tick's work by slab bucket — each bucket is its
+            # own static-shape device step (one step total when unbucketed)
+            work = [None] * len(self._buckets)
+
+            def bucket_work(b):
+                if work[b] is None:
+                    cnt = self._buckets[b][0]
+                    work[b] = {"tokens": np.zeros(cnt, np.int32),
+                               "active": np.zeros(cnt, bool),
+                               "auto": np.zeros(cnt, bool),
+                               "batch": [], "gens": []}
+                return work[b]
+
+            for (slot, tok), f in batch:
+                b, li = self._slot_bucket(slot)
+                w = bucket_work(b)
+                w["tokens"][li] = tok
+                w["active"][li] = True
+                w["batch"].append((li, f))
+            for slot in list(self._auto_slots):
+                b, li = self._slot_bucket(slot)
+                w = bucket_work(b)
+                w["active"][li] = True
+                w["auto"][li] = True
+                w["gens"].append((slot, li))
+            for b, w in enumerate(work):
+                if w is None:
+                    continue
+                cnt, cap = self._buckets[b]
+                off = self._bucket_off[b]
+                # bound how far device dispatch runs ahead of readbacks: a
+                # pure-auto loop would otherwise enqueue ticks unboundedly
+                self._tick_budget.acquire()
+                try:
+                    nxt, best, self._k[b], self._v[b] = step(
+                        params, self._k[b], self._v[b],
+                        jnp.asarray(w["tokens"]), self._prev_nxt[b],
+                        jnp.asarray(self._pos[off:off + cnt]),
+                        jnp.asarray(w["active"]), jnp.asarray(w["auto"]))
+                    self._prev_nxt[b] = nxt
+                    pair = jnp.stack([nxt.astype(jnp.float32), best])
+                    if hasattr(pair, "copy_to_host_async"):
+                        # prefetch the D2H NOW: the resolver threads then
+                        # find the transfer already in flight, so readbacks
+                        # overlap later ticks instead of costing one RTT
+                        # each (the same trick the per-request generation
+                        # chain uses)
+                        pair.copy_to_host_async()
+                    for li, _f in w["batch"]:
+                        self._pos[off + li] += 1
+                    for slot, _li in w["gens"]:
+                        self._pos[slot] += 1
+                except Exception as e:  # noqa: BLE001 — via futures
+                    self._tick_budget.release()
+                    for _li, f in w["batch"]:
+                        f.set_exception(e)
+                    for slot, _li in w["gens"]:
+                        info = self._auto_slots.pop(slot)
+                        self._gen_reader.submit(info["sink"].put, e)
+                        self._release_gen_slot(slot)
+                    continue
+                # which generations end on this tick (token streamed, then
+                # the slot frees; the readback snapshot keeps its values
+                # valid even if the slot is reused by a later tick)
+                gen_batch = []
+                for slot, li in w["gens"]:
+                    info = self._auto_slots[slot]
+                    info["remaining"] -= 1
+                    done = info["remaining"] <= 0
+                    if done or self._pos[slot] >= cap:
+                        done = True
+                        self._auto_slots.pop(slot)
+                        self._release_gen_slot(slot)
+                    gen_batch.append((li, slot, info["sink"], done,
+                                      info["gen"]))
+                # PIPELINE the readback: over a remote device the blocking
+                # D2H costs a full round trip; resolving it on a reader
+                # thread lets the next tick's compute dispatch immediately,
+                # so round trips overlap instead of gating the tick rate.
+                # Safe because a sequence never has two steps in flight
+                # (closed loop + per-seq lock): tick N+1 only carries other
+                # sequences' tokens.
+                pool = self._gen_reader if gen_batch else self._readers
+                pool.submit(self._resolve_tick, pair, w["batch"], gen_batch,
+                            self._tick_budget)
 
     @staticmethod
     def _resolve_prefill(pair, fut):
@@ -1055,6 +1205,9 @@ class DecodeModel:
                 self._dead_gens.add((slot, gen))
 
     def _resolve_tick(self, pair, batch, gen_batch=(), budget=None):
+        """batch: [(idx, fut)]; gen_batch: [(idx, slot, sink, done, gen)]
+        — idx is bucket-local (``pair`` holds that bucket's step output),
+        slot stays global for dead-generation bookkeeping."""
         import numpy as np
 
         try:
@@ -1062,19 +1215,19 @@ class DecodeModel:
         except Exception as e:  # noqa: BLE001 — surfaced via futures/sinks
             if budget is not None:
                 budget.release()
-            for _, f in batch:
+            for _idx, f in batch:
                 f.set_exception(e)
-            for slot, sink, _done, gen in gen_batch:
+            for _idx, slot, sink, _done, gen in gen_batch:
                 sink.put(e)
                 with self._lock:
                     self._dead_gens.add((slot, gen))
             return
         if budget is not None:
             budget.release()
-        for (slot, _tok), f in batch:
-            f.set_result((int(vals[0, slot]), float(vals[1, slot])))
-        for slot, sink, done, _gen in gen_batch:
-            sink.put(int(vals[0, slot]))
+        for idx, f in batch:
+            f.set_result((int(vals[0, idx]), float(vals[1, idx])))
+        for idx, _slot, sink, done, _gen in gen_batch:
+            sink.put(int(vals[0, idx]))
             if done:
                 sink.put(None)
 
@@ -1099,15 +1252,17 @@ class DecodeModel:
         if self._closed:
             raise InferError(
                 f"model '{self._model.name}' is unloading", 503)
+        need_s = int(window.shape[1]) + int(n_tokens)
         with self._lock:
-            if not self._free:
+            slot = self._alloc_slot_locked(need_s)
+            if slot is None:
                 self._evict_idle_locked(time.monotonic())
-            if not self._free:
+                slot = self._alloc_slot_locked(need_s)
+            if slot is None:
                 raise InferError(
-                    f"model '{self._model.name}': all {self._n_slots} "
-                    "decode slots are busy; retry when a generation or "
-                    "sequence completes", 429)
-            slot = self._free.pop()
+                    f"model '{self._model.name}': no free decode slot "
+                    f"holds {need_s} tokens ({self._n_slots} total); retry "
+                    "when a generation or sequence completes", 429)
             gen = self._slot_gen[slot]
         sink: "_queue.Queue" = _queue.Queue()
         self._jobs.put(("prefill",
@@ -1249,9 +1404,16 @@ class DecodeModel:
                         f"{list(toks.shape)}")
                 with self._lock:
                     if slot is None:
-                        if not self._free:
+                        # open-ended length: prefer the largest slab so the
+                        # sequence keeps maximum headroom before its cap
+                        need = self._prompt_len + 1
+                        slot = self._alloc_slot_locked(need,
+                                                       prefer_large=True)
+                        if slot is None:
                             self._evict_idle_locked(time.monotonic())
-                        if not self._free:
+                            slot = self._alloc_slot_locked(
+                                need, prefer_large=True)
+                        if slot is None:
                             # drop the lock entry setdefault created, or
                             # retried starts leak one per correlation id
                             self._seq_locks.pop(seq_id, None)
@@ -1259,7 +1421,6 @@ class DecodeModel:
                                 f"model '{self._model.name}': all "
                                 f"{self._n_slots} decode slots are busy; "
                                 "end or abandon a sequence first", 429)
-                        slot = self._free.pop()
                         self._state[seq_id] = slot
                     gen = self._slot_gen[slot]
                 fut = self._submit("prefill", (slot, gen, toks))
@@ -1269,7 +1430,8 @@ class DecodeModel:
                 # the read is stable
                 with self._lock:
                     gen = self._slot_gen[slot]
-                if int(self._pos[slot]) >= self._s_max:
+                cap = self._slot_cap(slot)
+                if int(self._pos[slot]) >= cap:
                     # free the slot even on the failure path: the client
                     # was told to send sequence_end and must not find the
                     # id poisoned
@@ -1278,7 +1440,7 @@ class DecodeModel:
                             self._release_locked(seq_id)
                     raise InferError(
                         f"model '{self._model.name}': sequence exceeded "
-                        f"the {self._s_max}-token cache; send sequence_end")
+                        f"the {cap}-token cache; send sequence_end")
                 if toks.shape[1] != 1:
                     raise InferError(
                         f"model '{self._model.name}': decode steps expect "
