@@ -140,8 +140,15 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="tiny preset + short loops (CPU CI)")
     ap.add_argument("--modes", nargs="+",
-                    default=["independent", "batched"],
-                    choices=["independent", "batched"])
+                    default=["independent"],
+                    choices=["independent", "batched"],
+                    help="decode modes to sweep. Default sweeps only "
+                    "'independent': with a client RTT inside the closed "
+                    "loop, a batched tick is a per-cohort sync point and "
+                    "measures 10-20%% behind (BASELINE row 7) — batched is "
+                    "the server-side-generation architecture (row 15) and "
+                    "the prefill-contended genai-perf workload's winner "
+                    "(row 8); pass --modes independent batched to compare")
     ap.add_argument("--streams", nargs="+", type=int, default=None,
                     help="concurrency sweep (default 8 16 32; smoke: 2)")
     ap.add_argument("--slots", type=int, default=32,

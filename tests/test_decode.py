@@ -94,7 +94,7 @@ class TestSlotKernels:
 
     def test_slot_prefill_matches_single(self, params):
         rng = np.random.default_rng(3)
-        sprefill = decode.make_slot_prefill(CFG, self.S_MAX)
+        sprefill = decode.make_slot_prefill(CFG)
         prefill = decode.make_prefill(CFG, self.S_MAX)
         k, v = self._slot_cache()
         for slot in range(2):
@@ -130,7 +130,7 @@ class TestSlotKernels:
         want_a, want_b = serial(win_a, 4), serial(win_b, 3)
 
         # slot path: A active every tick; B idle on tick 2
-        sprefill = decode.make_slot_prefill(CFG, self.S_MAX)
+        sprefill = decode.make_slot_prefill(CFG)
         sstep = decode.make_slot_step(CFG)
         k, v = self._slot_cache()
         ta, _, k, v = sprefill(params, k, v, win_a, 0)
@@ -358,7 +358,7 @@ class TestMoeDecode:
         rng = np.random.default_rng(8)
         prompt = jnp.asarray(rng.integers(0, 64, (1, 6)), jnp.int32)
         prefill = decode.make_prefill(MOE_CFG, S_MAX)
-        slot_prefill = decode.make_slot_prefill(MOE_CFG, S_MAX)
+        slot_prefill = decode.make_slot_prefill(MOE_CFG)
         slot_step = decode.make_slot_step(MOE_CFG)
 
         logits, cache = prefill(moe_params, prompt)
@@ -503,7 +503,7 @@ class TestChunkedPrefill:
         n_slots, slot = 3, 1
         shape = (CFG.n_layers, n_slots, CFG.n_heads, S_MAX, CFG.head_dim)
 
-        full = decode.make_slot_prefill(CFG, S_MAX)
+        full = decode.make_slot_prefill(CFG)
         k0 = jnp.zeros(shape, CFG.dtype)
         v0 = jnp.zeros(shape, CFG.dtype)
         want_tok, want_best, want_k, want_v = full(params, k0, v0, prompt,
@@ -545,7 +545,7 @@ class TestChunkedPrefill:
 
         n_slots = 2
         shape = (CFG.n_layers, n_slots, CFG.n_heads, S_MAX, CFG.head_dim)
-        sprefill = decode.make_slot_prefill(CFG, S_MAX)
+        sprefill = decode.make_slot_prefill(CFG)
         sstep = decode.make_slot_step(CFG)
         cp = decode.make_slot_chunk_prefill(CFG, S_MAX)
         k = jnp.zeros(shape, CFG.dtype)

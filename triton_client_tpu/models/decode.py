@@ -445,13 +445,12 @@ def make_slot_step(cfg: tr.TransformerConfig):
     return step
 
 
-def make_slot_prefill(cfg: tr.TransformerConfig, s_max: int = 0):
+def make_slot_prefill(cfg: tr.TransformerConfig):
     """jitted (params, k, v, tokens [1,S], slot) -> (next tok, best logit,
     k', v') — prefills ONE slot of the shared cache in a single forward.
 
-    The cache length comes from ``k.shape[3]`` (``s_max`` is accepted for
-    back-compat and ignored), so one returned function serves every slab
-    bucket — jit retraces per distinct cache shape."""
+    The cache length comes from ``k.shape[3]``, so one returned function
+    serves every slab bucket — jit retraces per distinct cache shape."""
 
     @jax.jit
     def prefill(params, k, v, tokens, slot):
@@ -726,15 +725,10 @@ class DecodeModel:
                     # per slab bucket — every shape stays static
                     cache_sharding = NamedSharding(
                         self._mesh, P(None, "dp", "tp", None, None))
-                    dp = self._mesh.shape["dp"]
+                    # dp divides every bucket count by construction:
+                    # decode_mesh was built against the gcd of the counts
                     self._k, self._v, self._prev_nxt = [], [], []
                     for cnt, cap in self._buckets:
-                        if dp > 1 and cnt % dp:
-                            raise ValueError(
-                                f"serve mesh dp={dp} must divide every "
-                                f"cache bucket's slot count; bucket "
-                                f"{cnt}x{cap} does not "
-                                "(TRITON_TPU_DECODE_BUCKETS)")
                         shape = (cfg.n_layers, cnt, cfg.n_heads,
                                  cap, cfg.head_dim)
                         self._k.append(jax.device_put(
@@ -781,7 +775,7 @@ class DecodeModel:
                     self._chunk_fn = (
                         make_slot_chunk_prefill(cfg, self._s_max)
                         if chunk else None)
-                    fns = (make_slot_prefill(cfg, self._s_max),
+                    fns = (make_slot_prefill(cfg),
                            make_slot_step(cfg), params, cfg)
                     self._fns = fns
                     self._worker.start()
