@@ -169,6 +169,7 @@ Error InferResult::IsNullResponse(bool* is_null_response) const {
 
 //==============================================================================
 void InferenceServerClient::UpdateInferStat(const RequestTimers& timer) {
+  std::lock_guard<std::mutex> lk(stat_mu_);
   infer_stat_.completed_request_count++;
   infer_stat_.cumulative_total_request_time_ns += timer.Duration(
       RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);

@@ -19,6 +19,7 @@
 #include <cstring>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -269,14 +270,18 @@ class InferenceServerClient {
   virtual ~InferenceServerClient() = default;
 
   Error ClientInferStat(InferStat* infer_stat) const {
+    std::lock_guard<std::mutex> lk(stat_mu_);
     *infer_stat = infer_stat_;
     return Error::Success;
   }
 
  protected:
+  // Thread-safe: concurrent Infer() callers (async workers, multiplexed
+  // unary calls) all account into one InferStat.
   void UpdateInferStat(const RequestTimers& timer);
 
   bool verbose_;
+  mutable std::mutex stat_mu_;
   InferStat infer_stat_;
 };
 

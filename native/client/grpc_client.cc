@@ -448,6 +448,46 @@ Error InferenceServerGrpcClient::Call(
   return CallWeb(method, request, response, headers, timers, timeout_us);
 }
 
+Error InferenceServerGrpcClient::AcquireMux(
+    std::shared_ptr<H2GrpcConnection>* conn, uint64_t timeout_us) {
+  std::shared_ptr<H2GrpcConnection> fresh;
+  {
+    std::lock_guard<std::mutex> lk(mode_mu_);
+    if (h2_mux_ != nullptr && h2_mux_->MuxHealthy()) {
+      *conn = h2_mux_;
+      return Error::Success;
+    }
+    if (!h2_idle_.empty()) {
+      // promote the EnsureMode probe (or a pooled idle conn): the client
+      // then runs ONE socket total
+      fresh = std::shared_ptr<H2GrpcConnection>(h2_idle_.back().release());
+      h2_idle_.pop_back();
+    }
+  }
+  if (fresh == nullptr) {
+    // connect OUTSIDE mode_mu_: a reconnect to an unreachable server must
+    // stall only mux callers, not every pooled call behind the lock
+    fresh = std::make_shared<H2GrpcConnection>();
+    bool not_http2 = false;
+    TC_RETURN_IF_ERROR(fresh->Connect(
+        transport_->host(), transport_->port(), &not_http2,
+        transport_->keepalive_idle_s(), transport_->keepalive_intvl_s(),
+        timeout_us, transport_->tls_context()));
+  }
+  // set once before the channel is shared — per-call sets would race
+  fresh->SetMaxResponseBytes(transport_->max_response_bytes());
+  TC_RETURN_IF_ERROR(fresh->StartMux());
+  std::lock_guard<std::mutex> lk(mode_mu_);
+  if (h2_mux_ != nullptr && h2_mux_->MuxHealthy()) {
+    // another caller won the rebuild race; theirs is the channel
+    *conn = h2_mux_;
+    return Error::Success;
+  }
+  h2_mux_ = fresh;
+  *conn = fresh;
+  return Error::Success;
+}
+
 Error InferenceServerGrpcClient::CallH2(
     const std::string& method, const google::protobuf::Message& request,
     google::protobuf::Message* response, const Headers& headers,
@@ -458,6 +498,41 @@ Error InferenceServerGrpcClient::CallH2(
     return Error(
         "request exceeds maximum send message size of " +
         std::to_string(transport_->max_request_bytes()) + " bytes");
+  }
+  const std::string path = std::string("/") + kServicePath + "/" + method;
+  // Default: grpc++-style multiplexing — every concurrent unary call on
+  // this client shares ONE socket (reference grpc_client.cc:47-152).
+  // TC_TPU_GRPC_UNARY_MUX=0 pins the one-call-per-pooled-connection
+  // fallback; a mux channel that dies mid-call also falls back for that
+  // call while the next AcquireMux builds a replacement.
+  const char* mux_env = getenv("TC_TPU_GRPC_UNARY_MUX");
+  if (mux_env == nullptr || std::string(mux_env) != "0") {
+    std::shared_ptr<H2GrpcConnection> mux;
+    Error merr = AcquireMux(&mux, timeout_us);
+    if (merr.IsOk()) {
+      std::string resp;
+      Error err = mux->MuxUnaryCall(path, body, headers, &resp, timeout_us,
+                                    timers);
+      if (err.IsOk()) {
+        if (!response->ParseFromString(resp)) {
+          return Error("failed to parse " + method + " response");
+        }
+        if (verbose_) fprintf(stderr, "%s -> ok\n", method.c_str());
+        return Error::Success;
+      }
+      if (!mux->MuxHealthy()) {
+        // channel died under this call: drop it so the next call builds a
+        // fresh one.  Do NOT transparently re-send this call — the server
+        // may already have executed it (gRPC only retries requests that
+        // never reached the server; a silent replay would double-step
+        // sequence models)
+        std::lock_guard<std::mutex> lk(mode_mu_);
+        if (h2_mux_ == mux) h2_mux_.reset();
+      }
+      return err;
+    }
+    // mux channel could not be built (nothing was sent): the pooled path
+    // below serves this call
   }
   std::unique_ptr<H2GrpcConnection> conn;
   TC_RETURN_IF_ERROR(AcquireH2(&conn, timeout_us));

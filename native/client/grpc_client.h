@@ -280,10 +280,17 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   Error AcquireH2(std::unique_ptr<H2GrpcConnection>* conn,
                   uint64_t timeout_us);
   void ReleaseH2(std::unique_ptr<H2GrpcConnection> conn, bool reusable);
+  // The multiplexed unary channel: concurrent unary RPCs share ONE socket
+  // (grpc++ parity); replaced transparently when it dies.  Returns a
+  // shared handle so a replacement never pulls the connection out from
+  // under an in-flight call.
+  Error AcquireMux(std::shared_ptr<H2GrpcConnection>* conn,
+                   uint64_t timeout_us);
 
   std::mutex mode_mu_;
   Mode mode_ = Mode::kUndecided;
   std::vector<std::unique_ptr<H2GrpcConnection>> h2_idle_;
+  std::shared_ptr<H2GrpcConnection> h2_mux_;
 
   // async worker
   void AsyncTransfer();

@@ -1,6 +1,9 @@
 #include "h2.h"
 
 #include <dlfcn.h>
+#include <pthread.h>
+#include <signal.h>
+#include <sys/socket.h>
 
 #include <algorithm>
 #include <cstring>
@@ -177,6 +180,9 @@ bool H2Available() { return Hpack::Get().ok; }
 H2GrpcConnection::~H2GrpcConnection() { Close(); }
 
 void H2GrpcConnection::Close() {
+  // the mux reader (if any) must exit before the TLS session it reads
+  // from is freed: shutdown() wakes its blocked read, then join
+  StopMux();
   if (tls_sess_ != nullptr) {
     delete tls_sess_;
     tls_sess_ = nullptr;
@@ -190,6 +196,21 @@ void H2GrpcConnection::Close() {
     inflater_ = nullptr;
   }
   stream_active_ = false;
+}
+
+void H2GrpcConnection::StopMux() {
+  {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    if (!mux_thread_.joinable()) return;
+    if (!mux_dead_) {
+      mux_dead_ = true;
+      mux_err_ = Error("connection closed");
+    }
+  }
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+  mux_cv_.notify_all();
+  window_cv_.notify_all();
+  mux_thread_.join();
 }
 
 Error H2GrpcConnection::Connect(
@@ -336,7 +357,23 @@ Error H2GrpcConnection::SendFrame(
   if (fd_ < 0) return Error("connection closed");
   if (!connio::CWriteAll(connio::ConnRef{fd_, tls_sess_}, hdr.data(),
                          hdr.size())) {
-    return Error("connection failure while sending HTTP/2 frame");
+    Error err("connection failure while sending HTTP/2 frame");
+    {
+      // a failed (possibly partial) write leaves the byte stream mid-frame
+      // — in mux mode every other caller shares it, so the channel must
+      // die NOW, not when the reader eventually notices
+      std::lock_guard<std::mutex> slk(state_mu_);
+      if (mux_on_ && !mux_dead_) {
+        mux_dead_ = true;
+        mux_err_ = err;
+      }
+    }
+    if (mux_on_) {
+      if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);  // wake the reader
+      mux_cv_.notify_all();
+      window_cv_.notify_all();
+    }
+    return err;
   }
   return Error::Success;
 }
@@ -407,9 +444,24 @@ Error H2GrpcConnection::ReplenishRecvWindow(uint32_t stream_id,
   return err;
 }
 
-// Read + dispatch exactly one frame.  `call` is the RPC whose stream this
-// connection currently runs (unary or bidi) — frames for its stream land
-// in it; connection-level frames update windows/settings.
+// Which call a frame for `id` belongs to: the caller-driven call (`cur`,
+// unary/bidi) or a registered mux call.  `*pin` keeps a mux call alive
+// while this frame mutates it, even if its caller unregisters (deadline)
+// concurrently.
+H2GrpcConnection::CallState* H2GrpcConnection::TargetFor(
+    uint32_t id, CallState* cur, std::shared_ptr<CallState>* pin) {
+  if (cur != nullptr && id == cur->stream_id) return cur;
+  std::lock_guard<std::mutex> lk(state_mu_);
+  auto it = mux_calls_.find(id);
+  if (it == mux_calls_.end()) return nullptr;
+  *pin = it->second;
+  return pin->get();
+}
+
+// Read + dispatch exactly one frame.  `call` is the caller-driven RPC when
+// one runs this connection (pooled unary, bidi stream); nullptr in mux mode
+// where the reader thread dispatches per stream id.  Connection-level
+// frames update windows/settings either way.
 Error H2GrpcConnection::ProcessOneFrame(CallState* call,
                                         const sockio::Deadline& dl) {
   FrameHdr hdr;
@@ -420,6 +472,7 @@ Error H2GrpcConnection::ProcessOneFrame(CallState* call,
                             payload.data(), hdr.len, dl);
     if (rc != 0) return IoError(rc, "reading HTTP/2 frame payload");
   }
+  std::shared_ptr<CallState> pin;
   switch (hdr.type) {
     case kFrameData: {
       size_t off = 0, len = payload.size();
@@ -432,21 +485,29 @@ Error H2GrpcConnection::ProcessOneFrame(CallState* call,
         off = 1;
         len = payload.size() - 1 - pad;
       }
-      if (hdr.stream_id == call->stream_id) {
-        call->data.append(payload, off, len);
+      CallState* t = TargetFor(hdr.stream_id, call, &pin);
+      if (t != nullptr) {
+        t->data.append(payload, off, len);
         if (max_response_bytes_ > 0 &&
-            call->data.size() > max_response_bytes_ + 5) {
+            t->data.size() > max_response_bytes_ + 5) {
           // enforced mid-read: the cap must bound memory, not just be
-          // checked after the whole body buffered
+          // checked after the whole body buffered (connection-fatal: the
+          // peer is mid-stream and the HPACK/frame state can't be resynced)
           return Error(
               "response exceeds maximum receive message size of " +
               std::to_string(max_response_bytes_) + " bytes");
         }
-        if (hdr.flags & kFlagEndStream) call->end_stream = true;
+        if (hdr.flags & kFlagEndStream) {
+          std::lock_guard<std::mutex> lk(state_mu_);
+          t->end_stream = true;
+        }
       }
-      // count the whole frame against our recv window (padding included)
-      TC_RETURN_IF_ERROR(ReplenishRecvWindow(call->stream_id,
-                                             payload.size()));
+      // count the whole frame against our recv window (padding included);
+      // no stream-level update for a stream that just ended or one we no
+      // longer track (RFC 7540 §5.1 closed-state)
+      bool stream_open = t != nullptr && !(hdr.flags & kFlagEndStream);
+      TC_RETURN_IF_ERROR(ReplenishRecvWindow(
+          stream_open ? hdr.stream_id : 0, payload.size()));
       break;
     }
     case kFrameHeaders: {
@@ -465,14 +526,19 @@ Error H2GrpcConnection::ProcessOneFrame(CallState* call,
         off += 5;
         len -= 5;
       }
-      if (hdr.stream_id == call->stream_id) {
-        call->header_block.append(payload, off, len);
-        if (hdr.flags & kFlagEndStream) call->end_stream = true;
+      CallState* t = TargetFor(hdr.stream_id, call, &pin);
+      if (t != nullptr) {
+        t->header_block.append(payload, off, len);
+        if (hdr.flags & kFlagEndStream) t->end_after_headers = true;
         if (hdr.flags & kFlagEndHeaders) {
           TC_RETURN_IF_ERROR(
-              InflateHeaderBlock(call->header_block, &call->headers));
-          call->header_block.clear();
-          call->headers_done = true;
+              InflateHeaderBlock(t->header_block, &t->headers));
+          t->header_block.clear();
+          t->headers_done = true;
+          if (t->end_after_headers) {
+            std::lock_guard<std::mutex> lk(state_mu_);
+            t->end_stream = true;
+          }
         }
       } else {
         // a header block we are not tracking still goes through the
@@ -484,13 +550,18 @@ Error H2GrpcConnection::ProcessOneFrame(CallState* call,
       break;
     }
     case kFrameContinuation: {
-      if (hdr.stream_id == call->stream_id) {
-        call->header_block.append(payload);
+      CallState* t = TargetFor(hdr.stream_id, call, &pin);
+      if (t != nullptr) {
+        t->header_block.append(payload);
         if (hdr.flags & kFlagEndHeaders) {
           TC_RETURN_IF_ERROR(
-              InflateHeaderBlock(call->header_block, &call->headers));
-          call->header_block.clear();
-          call->headers_done = true;
+              InflateHeaderBlock(t->header_block, &t->headers));
+          t->header_block.clear();
+          t->headers_done = true;
+          if (t->end_after_headers) {
+            std::lock_guard<std::mutex> lk(state_mu_);
+            t->end_stream = true;
+          }
         }
       }
       break;
@@ -506,9 +577,16 @@ Error H2GrpcConnection::ProcessOneFrame(CallState* call,
                      static_cast<uint8_t>(payload[off + 5]);
         std::lock_guard<std::mutex> lk(state_mu_);
         if (id == kSettingsInitialWindowSize) {
-          // adjust the active stream's window by the delta (RFC 7540 §6.9.2)
-          stream_send_window_ +=
+          // adjust every open stream's window by the delta (RFC 7540
+          // §6.9.2): the caller-driven call, the bidi stream, and all
+          // registered mux calls
+          long long delta =
               static_cast<long long>(v) - peer_initial_window_;
+          if (call != nullptr) call->send_window += delta;
+          if (stream_active_ && call != &stream_call_) {
+            stream_call_.send_window += delta;
+          }
+          for (auto& kv : mux_calls_) kv.second->send_window += delta;
           peer_initial_window_ = v;
         }
         if (id == kSettingsMaxFrameSize) peer_max_frame_ = v;
@@ -529,27 +607,31 @@ Error H2GrpcConnection::ProcessOneFrame(CallState* call,
                      (static_cast<uint8_t>(payload[1]) << 16) |
                      (static_cast<uint8_t>(payload[2]) << 8) |
                      static_cast<uint8_t>(payload[3]);
-      {
+      if (hdr.stream_id == 0) {
         std::lock_guard<std::mutex> lk(state_mu_);
-        if (hdr.stream_id == 0) {
-          conn_send_window_ += inc;
-        } else if (hdr.stream_id == call->stream_id) {
-          stream_send_window_ += inc;
+        conn_send_window_ += inc;
+      } else {
+        CallState* t = TargetFor(hdr.stream_id, call, &pin);
+        if (t != nullptr) {
+          std::lock_guard<std::mutex> lk(state_mu_);
+          t->send_window += inc;
         }
       }
       window_cv_.notify_all();
       break;
     }
     case kFrameRstStream: {
-      if (hdr.stream_id == call->stream_id) {
-        call->reset = true;
-        call->end_stream = true;
+      CallState* t = TargetFor(hdr.stream_id, call, &pin);
+      if (t != nullptr) {
         if (payload.size() >= 4) {
-          call->reset_code = (static_cast<uint8_t>(payload[0]) << 24) |
-                             (static_cast<uint8_t>(payload[1]) << 16) |
-                             (static_cast<uint8_t>(payload[2]) << 8) |
-                             static_cast<uint8_t>(payload[3]);
+          t->reset_code = (static_cast<uint8_t>(payload[0]) << 24) |
+                          (static_cast<uint8_t>(payload[1]) << 16) |
+                          (static_cast<uint8_t>(payload[2]) << 8) |
+                          static_cast<uint8_t>(payload[3]);
         }
+        std::lock_guard<std::mutex> lk(state_mu_);
+        t->reset = true;
+        t->end_stream = true;
       }
       break;
     }
@@ -613,17 +695,31 @@ Error H2GrpcConnection::SendGrpcMessage(
   while (off < framed.size()) {
     long long budget;
     bool reader_active;
+    size_t chunk = 0;
     {
       std::unique_lock<std::mutex> lk(state_mu_);
-      budget = std::min(conn_send_window_, stream_send_window_);
-      reader_active = stream_active_;
+      budget = std::min(conn_send_window_, call->send_window);
+      // a background thread (bidi reader or mux reader) consumes frames —
+      // writers park on the window condvar instead of self-reading
+      reader_active = stream_active_ || mux_on_;
+      if (budget > 0) {
+        // RESERVE the chunk under the lock: concurrent mux writers that
+        // each read the budget and debit after sending would jointly
+        // overshoot the connection window (FLOW_CONTROL_ERROR -> GOAWAY)
+        chunk = std::min(
+            {framed.size() - off, static_cast<size_t>(budget),
+             static_cast<size_t>(peer_max_frame_)});
+        conn_send_window_ -= static_cast<long long>(chunk);
+        call->send_window -= static_cast<long long>(chunk);
+      }
       if (budget <= 0 && reader_active) {
-        // the stream reader thread consumes WINDOW_UPDATEs; wait here —
-        // and also wake when the stream ends, or we deadlock forever on
-        // a window that will never be replenished
-        auto woke = [this] {
-          return std::min(conn_send_window_, stream_send_window_) > 0 ||
-                 !stream_active_;
+        // the reader thread consumes WINDOW_UPDATEs; wait here — and also
+        // wake when the call/connection dies, or we deadlock forever on a
+        // window that will never be replenished
+        auto woke = [this, call] {
+          return std::min(conn_send_window_, call->send_window) > 0 ||
+                 call->end_stream || call->reset || mux_dead_ ||
+                 (!stream_active_ && !mux_on_);
         };
         bool ok = true;
         if (dl.enabled) {
@@ -635,36 +731,41 @@ Error H2GrpcConnection::SendGrpcMessage(
           window_cv_.wait(lk, woke);
         }
         if (!ok) return Error("Deadline Exceeded: send window");
-        if (!stream_active_) {
+        if (mux_dead_) return mux_err_;
+        if (call->end_stream || call->reset) {
+          // server closed the stream early (e.g. rejected mid-upload):
+          // stop sending, let the caller read the status
+          return Error::Success;
+        }
+        if (!stream_active_ && !mux_on_) {
           return Error("stream closed while awaiting send window");
         }
         continue;
       }
     }
     if (!reader_active && (call->end_stream || call->reset)) {
-      // unary path (single-threaded, no race on `call`): the server
-      // already closed the stream — e.g. rejected the request mid-upload
-      // — so stop sending and let the caller read the status
+      // pooled unary path (single-threaded, no race on `call`): the
+      // server already closed the stream — e.g. rejected the request
+      // mid-upload — so stop sending and let the caller read the status
+      if (chunk > 0) {
+        // refund the reserved-but-unsent budget: this connection may be
+        // pooled and reused, and a phantom debit never gets replenished
+        std::lock_guard<std::mutex> lk(state_mu_);
+        conn_send_window_ += static_cast<long long>(chunk);
+        call->send_window += static_cast<long long>(chunk);
+      }
       return Error::Success;
     }
     if (budget <= 0) {
-      // unary path: nobody else reads — consume frames (into the real
-      // call state) until the peer replenishes a window
+      // pooled unary path: nobody else reads — consume frames (into the
+      // real call state) until the peer replenishes a window
       TC_RETURN_IF_ERROR(ProcessOneFrame(call, dl));
       continue;
     }
-    size_t chunk = std::min(
-        {framed.size() - off, static_cast<size_t>(budget),
-         static_cast<size_t>(peer_max_frame_)});
     bool last = (off + chunk == framed.size());
     TC_RETURN_IF_ERROR(SendFrame(
         kFrameData, (last && end_stream) ? kFlagEndStream : 0,
         call->stream_id, framed.substr(off, chunk)));
-    {
-      std::lock_guard<std::mutex> lk(state_mu_);
-      conn_send_window_ -= static_cast<long long>(chunk);
-      stream_send_window_ -= static_cast<long long>(chunk);
-    }
     off += chunk;
   }
   return Error::Success;
@@ -698,13 +799,16 @@ Error H2GrpcConnection::UnaryCall(
   if (stream_active_) {
     return Error("connection is running a stream");
   }
+  if (mux_on_) {
+    return Error("connection is multiplexed; use MuxUnaryCall");
+  }
   auto dl = sockio::Deadline::In(timeout_us);
   CallState call;
   call.stream_id = next_stream_id_;
   next_stream_id_ += 2;
   {
     std::lock_guard<std::mutex> lk(state_mu_);
-    stream_send_window_ = peer_initial_window_;
+    call.send_window = peer_initial_window_;
   }
   if (timers != nullptr) {
     timers->CaptureTimestamp(RequestTimers::Kind::SEND_START);
@@ -751,6 +855,7 @@ Error H2GrpcConnection::StartStream(const std::string& path,
                                     const Headers& metadata) {
   if (fd_ < 0) return Error("connection closed");
   if (stream_active_) return Error("stream already running");
+  if (mux_on_) return Error("connection is multiplexed");
   if (tls_sess_ != nullptr) {
     // reader thread and writer share one TLS session (internally mutexed);
     // a short receive timeout makes the blocked reader release the session
@@ -764,7 +869,7 @@ Error H2GrpcConnection::StartStream(const std::string& path,
   stream_read_pos_ = 0;
   {
     std::lock_guard<std::mutex> lk(state_mu_);
-    stream_send_window_ = peer_initial_window_;
+    stream_call_.send_window = peer_initial_window_;
     stream_active_ = true;
   }
   return SendHeaders(path, metadata, stream_call_.stream_id, 0, false);
@@ -826,6 +931,152 @@ Error H2GrpcConnection::StreamRead(std::string* message, bool* done) {
       return err;
     }
   }
+}
+
+// ---- multiplexed unary mode ------------------------------------------
+
+Error H2GrpcConnection::StartMux() {
+  if (fd_ < 0) return Error("connection closed");
+  if (stream_active_) return Error("connection is running a stream");
+  {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    if (mux_on_) return Error::Success;
+    mux_on_ = true;
+  }
+  if (tls_sess_ != nullptr) {
+    // reader thread and N writers share one TLS session (internally
+    // mutexed); a short receive timeout makes the blocked reader release
+    // the session periodically so writes get through (same pattern as the
+    // bidi stream)
+    sockio::SetSocketTimeout(fd_, SO_RCVTIMEO, 50000);
+  }
+  mux_thread_ = std::thread([this] { MuxReaderLoop(); });
+  return Error::Success;
+}
+
+bool H2GrpcConnection::MuxHealthy() {
+  std::lock_guard<std::mutex> lk(state_mu_);
+  return mux_on_ && !mux_dead_ && fd_ >= 0;
+}
+
+void H2GrpcConnection::MuxReaderLoop() {
+  // block SIGPIPE for this thread's lifetime: the per-operation TLS guard
+  // then short-circuits (mask already blocked), so the hot per-frame read
+  // path doesn't pay mask-juggling syscalls
+  sigset_t pipe_set;
+  sigemptyset(&pipe_set);
+  sigaddset(&pipe_set, SIGPIPE);
+  pthread_sigmask(SIG_BLOCK, &pipe_set, nullptr);
+  for (;;) {
+    Error err = ProcessOneFrame(nullptr, sockio::Deadline());
+    {
+      // the lock release below publishes this frame's CallState writes to
+      // callers woken by the notify (they re-check under state_mu_)
+      std::lock_guard<std::mutex> lk(state_mu_);
+      if (!err.IsOk()) {
+        if (!mux_dead_) {
+          mux_dead_ = true;
+          mux_err_ = err;
+        }
+      } else if (mux_dead_) {
+        err = mux_err_;  // StopMux raced in: exit
+      }
+    }
+    mux_cv_.notify_all();
+    window_cv_.notify_all();
+    if (!err.IsOk()) return;
+  }
+}
+
+Error H2GrpcConnection::MuxUnaryCall(
+    const std::string& path, const std::string& request,
+    const Headers& metadata, std::string* response, uint64_t timeout_us,
+    RequestTimers* timers) {
+  auto dl = sockio::Deadline::In(timeout_us);
+  auto call = std::make_shared<CallState>();
+  {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    if (!mux_on_) return Error("connection is not multiplexed");
+    if (mux_dead_) return mux_err_;
+    call->send_window = peer_initial_window_;
+  }
+  if (timers != nullptr) {
+    timers->CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  }
+  Error err;
+  {
+    // stream ids must hit the wire in allocation order (RFC 7540 §5.1.1:
+    // HEADERS for id N implicitly closes idle streams below N), so the id
+    // grab and the HEADERS frame go out under one lock
+    std::lock_guard<std::mutex> open(open_mu_);
+    {
+      std::lock_guard<std::mutex> lk(state_mu_);
+      call->stream_id = next_stream_id_;
+      next_stream_id_ += 2;
+      mux_calls_[call->stream_id] = call;
+    }
+    err = SendHeaders(path, metadata, call->stream_id, timeout_us, false);
+  }
+  if (err.IsOk()) err = SendGrpcMessage(request, call.get(), true, dl);
+  if (timers != nullptr) {
+    timers->CaptureTimestamp(RequestTimers::Kind::SEND_END);
+    timers->CaptureTimestamp(RequestTimers::Kind::RECV_START);
+  }
+  if (err.IsOk()) {
+    std::unique_lock<std::mutex> lk(state_mu_);
+    auto done = [this, &call] {
+      return call->end_stream || call->reset || mux_dead_;
+    };
+    if (dl.enabled) {
+      long long rem = dl.RemainingUs();
+      if (rem <= 0 ||
+          !mux_cv_.wait_for(lk, std::chrono::microseconds(rem), done)) {
+        err = Error("Deadline Exceeded");
+      }
+    } else {
+      mux_cv_.wait(lk, done);
+    }
+    if (err.IsOk() && mux_dead_ && !call->end_stream && !call->reset) {
+      err = mux_err_;
+    }
+  }
+  bool conn_alive, call_done;
+  {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    mux_calls_.erase(call->stream_id);
+    conn_alive = !mux_dead_ && fd_ >= 0;
+    call_done = call->end_stream || call->reset;
+  }
+  if (timers != nullptr) {
+    timers->CaptureTimestamp(RequestTimers::Kind::RECV_END);
+  }
+  if (!err.IsOk()) {
+    if (conn_alive && !call_done) {
+      // deadline expired with the stream still open: cancel it so the
+      // server stops and the connection stays clean for other calls
+      std::string code;
+      PutU32(&code, 8);  // CANCEL
+      SendFrame(kFrameRstStream, 0, call->stream_id, code);
+    }
+    return err;
+  }
+  if (call->reset) {
+    return Error("rpc aborted: RST_STREAM (error code " +
+                 std::to_string(call->reset_code) + ")");
+  }
+  TC_RETURN_IF_ERROR(GrpcStatusToError(call->headers));
+  if (call->data.size() < 5) {
+    return Error("rpc returned no response message");
+  }
+  uint32_t len = (static_cast<uint8_t>(call->data[1]) << 24) |
+                 (static_cast<uint8_t>(call->data[2]) << 16) |
+                 (static_cast<uint8_t>(call->data[3]) << 8) |
+                 static_cast<uint8_t>(call->data[4]);
+  if (call->data.size() < 5u + len) {
+    return Error("truncated gRPC response message");
+  }
+  response->assign(call->data, 5, len);
+  return Error::Success;
 }
 
 }  // namespace client

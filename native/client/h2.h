@@ -10,17 +10,24 @@
 // and the system libnghttp2's inflater (dlopen'd; handles Huffman + the
 // server's dynamic table) for decoding.
 //
-// Concurrency model: ONE in-flight RPC per connection (the client pools
-// connections for concurrent unary calls, mirroring its HTTP transport
-// pool; grpc++ multiplexes instead — same observable semantics).  The bidi
-// stream runs reads and writes concurrently on its dedicated connection.
+// Concurrency model: two modes.
+//  * Pooled (default fallback): ONE in-flight RPC per connection; the
+//    client pools connections for concurrent unary calls.
+//  * Multiplexed (StartMux): a dedicated reader thread dispatches frames
+//    to concurrent unary calls by stream id, so N callers share ONE
+//    socket — grpc++-style channel multiplexing (reference
+//    grpc_client.cc:47-152).
+// The bidi stream runs reads and writes concurrently on its dedicated
+// connection in either mode.
 #pragma once
 
 #include <condition_variable>
 #include <cstdint>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "common.h"
@@ -75,6 +82,20 @@ class H2GrpcConnection {
       const Headers& metadata, std::string* response,
       uint64_t timeout_us = 0, RequestTimers* timers = nullptr);
 
+  // ---- multiplexed unary mode ----
+  // Spawn the reader thread: afterwards MuxUnaryCall may be invoked from
+  // any number of threads concurrently; frames are dispatched to calls by
+  // stream id.  Mutually exclusive with UnaryCall/StartStream on this
+  // connection.
+  Error StartMux();
+  // False once the connection died (reader exited); pending calls fail
+  // with the fatal error and the owner should replace the channel.
+  bool MuxHealthy();
+  Error MuxUnaryCall(
+      const std::string& path, const std::string& request,
+      const Headers& metadata, std::string* response,
+      uint64_t timeout_us = 0, RequestTimers* timers = nullptr);
+
   // ---- bidi stream (single stream per connection) ----
   Error StartStream(const std::string& path, const Headers& metadata);
   // Send one gRPC message (length-prefixed DATA). Thread-safe vs reads.
@@ -101,15 +122,31 @@ class H2GrpcConnection {
     std::string data;         // raw DATA bytes (gRPC-framed messages)
     std::string header_block; // accumulating HEADERS/CONTINUATION fragments
     bool headers_done = false;
+    // END_STREAM seen on a HEADERS frame whose block is still awaiting
+    // CONTINUATION — completion is only signalled once the block inflates,
+    // so a mux caller never wakes to half-parsed trailers
+    bool end_after_headers = false;
+    // completion flags: written under state_mu_ (the mux reader sets them,
+    // waiting callers read them under the same mutex via mux_cv_)
     bool end_stream = false;
     bool reset = false;
     uint32_t reset_code = 0;
+    // per-stream send budget (RFC 7540 §6.9); replenished by the peer's
+    // WINDOW_UPDATEs for this stream — guarded by state_mu_
+    long long send_window = 65535;
   };
 
   Error SendFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
                   const std::string& payload);
   Error ReadFrameHdr(FrameHdr* hdr, const sockio::Deadline& dl);
   Error ProcessOneFrame(CallState* call, const sockio::Deadline& dl);
+  // Which call does a frame for `id` belong to: `cur` (the caller-driven
+  // unary/bidi call) or a registered mux call (then `*pin` keeps it alive
+  // past concurrent unregistration).
+  CallState* TargetFor(uint32_t id, CallState* cur,
+                       std::shared_ptr<CallState>* pin);
+  void MuxReaderLoop();
+  void StopMux();
   Error SendHeaders(const std::string& path, const Headers& metadata,
                     uint32_t stream_id, uint64_t timeout_us, bool end_stream);
   Error SendGrpcMessage(const std::string& message, CallState* call,
@@ -124,8 +161,8 @@ class H2GrpcConnection {
   void* inflater_ = nullptr;
   uint32_t next_stream_id_ = 1;
   // flow control (RFC 7540 §6.9): our send budget, replenished by the peer
+  // (per-stream budgets live on each CallState)
   long long conn_send_window_ = 65535;
-  long long stream_send_window_ = 65535;   // current stream's budget
   uint32_t peer_initial_window_ = 65535;
   uint32_t peer_max_frame_ = 16384;
   size_t max_response_bytes_ = 0;
@@ -137,6 +174,14 @@ class H2GrpcConnection {
   CallState stream_call_;
   bool stream_active_ = false;
   size_t stream_read_pos_ = 0;
+  // multiplexed unary mode (guarded by state_mu_ unless noted)
+  std::map<uint32_t, std::shared_ptr<CallState>> mux_calls_;
+  std::mutex open_mu_;  // stream ids must hit the wire in open order
+  std::thread mux_thread_;
+  bool mux_on_ = false;
+  bool mux_dead_ = false;
+  Error mux_err_;
+  std::condition_variable mux_cv_;
 };
 
 }  // namespace client

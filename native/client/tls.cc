@@ -1,6 +1,8 @@
 #include "tls.h"
 
 #include <dlfcn.h>
+#include <pthread.h>
+#include <signal.h>
 
 #include <cerrno>
 #include <cstring>
@@ -149,10 +151,38 @@ Error TlsContext::Init(const HttpSslOptionsView& opts) {
 
 TlsSession::~TlsSession() { Close(); }
 
+// SSL_write/SSL_shutdown hit write(2) without MSG_NOSIGNAL, so a peer that
+// already closed raises SIGPIPE and kills the process (a long-lived
+// multiplexed channel makes post-close writes routine, not exotic).  The
+// classic library-safe guard: block SIGPIPE on THIS thread around the
+// write, consume any pending instance, restore the caller's mask.
+class ScopedSigpipeGuard {
+ public:
+  ScopedSigpipeGuard() {
+    sigemptyset(&pipe_set_);
+    sigaddset(&pipe_set_, SIGPIPE);
+    pthread_sigmask(SIG_BLOCK, &pipe_set_, &old_);
+    was_blocked_ = sigismember(&old_, SIGPIPE) == 1;
+  }
+  ~ScopedSigpipeGuard() {
+    if (!was_blocked_) {
+      // eat a SIGPIPE our write may have queued, then restore
+      struct timespec zero = {0, 0};
+      sigtimedwait(&pipe_set_, nullptr, &zero);
+      pthread_sigmask(SIG_SETMASK, &old_, nullptr);
+    }
+  }
+
+ private:
+  sigset_t pipe_set_, old_;
+  bool was_blocked_ = false;
+};
+
 void TlsSession::Close() {
   const OpenSsl& o = OpenSsl::Get();
   std::lock_guard<std::mutex> lk(mu_);
   if (ssl_ != nullptr) {
+    ScopedSigpipeGuard guard;
     o.shutdown(ssl_);  // best-effort close_notify
     o.ssl_free(ssl_);
     ssl_ = nullptr;
@@ -193,6 +223,7 @@ Error TlsSession::Handshake(
     ssl_ = nullptr;
     return Error("SSL_set_fd failed");
   }
+  ScopedSigpipeGuard guard;
   int rc = o.connect(ssl_);
   if (rc != 1) {
     int err = o.get_error(ssl_, rc);
@@ -225,6 +256,9 @@ long TlsSession::Recv(char* buf, size_t n) {
     errno = EBADF;
     return -1;
   }
+  // SSL_read can itself WRITE (close_notify reply, key update) — same
+  // SIGPIPE exposure as Send when the peer is already gone
+  ScopedSigpipeGuard guard;
   int rc = o.read(ssl_, buf, static_cast<int>(n));
   if (rc > 0) return rc;
   int err = o.get_error(ssl_, rc);
@@ -241,6 +275,7 @@ long TlsSession::Send(const char* buf, size_t n) {
     errno = EBADF;
     return -1;
   }
+  ScopedSigpipeGuard guard;
   int rc = o.write(ssl_, buf, static_cast<int>(n));
   if (rc > 0) return rc;
   return -1;
