@@ -95,57 +95,175 @@ def _measure_generation(harness) -> dict:
     }
 
 
-def _measure_batched_generation() -> dict:
-    """Continuous-batching generation leg (BASELINE row 15): concurrent
-    greedy /generate_stream requests share one batched device step per tick
-    (self-feeding slots).  Runs its OWN harness AFTER the main one stopped
-    — the decode worker's mode is fixed at registration (fresh registry
-    with the env set before the model constructs), the main harness's
-    weights/caches must be off the chip first, and ServerHarness.stop()
-    clobbers the global broker flag, so harnesses must never nest."""
+def _measure_null_rpc(url: str, concurrency: int = 8,
+                      measure_s: float = 2.0) -> float:
+    """Drift control: closed-loop no-compute RPC rate (is_server_live) at
+    the headline concurrency.  The headline simple-c8 number is host-CPU
+    bound, so round-over-round 'regressions' are often host drift — this
+    floor, measured in the SAME session, lets `vs_baseline` be read against
+    a null-RPC normalization instead of re-arguing the A/B by hand."""
+    from triton_client_tpu.grpc import InferenceServerClient
+
+    counts = [0] * concurrency
+    stop = threading.Event()
+
+    def worker(idx):
+        n = 0
+        try:
+            with InferenceServerClient(url) as c:
+                while not stop.is_set():
+                    c.is_server_live()
+                    n += 1
+        except Exception:  # noqa: BLE001 — control leg must not fail bench
+            pass
+        finally:
+            counts[idx] = n  # a mid-loop error must not deflate the floor
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(measure_s)
+    stop.set()
+    elapsed = time.perf_counter() - t0
+    for t in threads:
+        t.join(timeout=10)
+    return round(sum(counts) / elapsed, 1)
+
+
+def _measure_bert_mfu(harness) -> dict:
+    """BERT-large serving efficiency (BASELINE row 4): streaming gRPC +
+    xla-shm at batcher-deep concurrency, reported as MFU so the flagship
+    efficiency number is driver-captured, not builder-run-only."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    import triton_client_tpu.http as httpclient
+    from triton_client_tpu.models import language
+    from triton_client_tpu.perf_analyzer import (_make_data, _resolve_model,
+                                                 run_level)
+
+    grpc_url = f"127.0.0.1:{harness.grpc_port}"
+    try:
+        # warm every batch bucket first: an XLA compile (tens of seconds)
+        # inside a measured window would sink the sweep
+        with httpclient.InferenceServerClient(
+                f"127.0.0.1:{harness.http_port}",
+                network_timeout=600.0) as warm:
+            for b in (1, 2, 4, 8, 16, 32):
+                x = np.zeros((b, language.BERT_SEQ_LEN), np.int32)
+                inp = httpclient.InferInput(
+                    "INPUT_IDS", list(x.shape), "INT32")
+                inp.set_data_from_numpy(x)
+                warm.infer("bert_large", [inp])
+        from triton_client_tpu.grpc import InferenceServerClient
+
+        meta = InferenceServerClient(grpc_url)
+        inputs, outputs, max_batch = _resolve_model(
+            meta, "grpc", "bert_large", "")
+        meta.close()
+        arrays = _make_data(inputs, {}, 1, max_batch,
+                            np.random.default_rng(0))
+        best = None
+        for level in (16, 32):
+            res = run_level("grpc", grpc_url, "bert_large", "", level,
+                            arrays, outputs, "xla", 1 << 22, 4.0,
+                            warmup_s=3.0, streaming=True)
+            if res["errors"]:
+                return {"bert_error": str(res.get("first_error"))[:120]}
+            if best is None or res["throughput"] > best["throughput"]:
+                best = res
+                best_level = level
+        mfu = language.serving_mfu(
+            best["throughput"], language.BERT_LARGE, language.BERT_SEQ_LEN)
+        return {
+            "bert_infer_per_sec": round(best["throughput"], 1),
+            "bert_mfu_pct": round(100.0 * mfu, 1),
+            "bert_best_concurrency": best_level,
+        }
+    except Exception as e:  # noqa: BLE001 — bench keeps going without it
+        return {"bert_error": str(e)[:120]}
+
+
+def _measure_generation_ab() -> dict:
+    """Same-precision batched-vs-independent generation A/B in ONE session
+    (both bf16, c=8 and c=16), plus the bucketed c=64 capacity point —
+    settles whether continuous batching wins without cross-session RTT
+    caveats.  Each mode runs its own harness AFTER the previous stopped
+    (decode mode is fixed at registration; harnesses must never nest)."""
     import jax
 
     if jax.default_backend() != "tpu":
         return {}
     from triton_client_tpu.genai_perf import profile_generate
-    from triton_client_tpu.models import zoo
+    from triton_client_tpu.models import language, zoo
     from triton_client_tpu.server.registry import ModelRegistry
     from triton_client_tpu.server.testing import ServerHarness
 
-    saved = {k: os.environ.get(k) for k in
-             ("TRITON_TPU_DECODE_MODE", "TRITON_TPU_DECODE_SLOTS",
-              "TRITON_TPU_PREFILL_CHUNK", "TRITON_TPU_QUANT")}
-    os.environ["TRITON_TPU_DECODE_MODE"] = "batched"
-    os.environ["TRITON_TPU_DECODE_SLOTS"] = "32"
-    os.environ["TRITON_TPU_PREFILL_CHUNK"] = "32"
-    os.environ.pop("TRITON_TPU_QUANT", None)  # bf16 default for this leg
+    keys = ("TRITON_TPU_DECODE_MODE", "TRITON_TPU_DECODE_SLOTS",
+            "TRITON_TPU_PREFILL_CHUNK", "TRITON_TPU_DECODE_BUCKETS",
+            "TRITON_TPU_QUANT")
+    saved = {k: os.environ.get(k) for k in keys}
+    out: dict = {}
+
+    def run_mode(mode, tag, env, levels):
+        for k in keys:
+            os.environ.pop(k, None)
+        os.environ["TRITON_TPU_DECODE_MODE"] = mode
+        os.environ.update(env)
+        try:
+            registry = ModelRegistry()
+            zoo.register_all(registry)
+            with ServerHarness(registry) as h:
+                url = f"127.0.0.1:{h.http_port}"
+                profile_generate(url, "llama_generate", concurrency=1,
+                                 output_tokens=2, num_requests=1,
+                                 stream_timeout=1800.0)  # compile warm
+                for conc, n_req in levels:
+                    rep = profile_generate(
+                        url, "llama_generate", concurrency=conc,
+                        output_tokens=24, num_requests=n_req,
+                        stream_timeout=1800.0)
+                    key = f"gen_ab_{tag}_c{conc}"
+                    if rep["errors"]:
+                        out[key + "_error"] = str(
+                            rep.get("first_error"))[:120]
+                    else:
+                        out[key] = round(
+                            rep["output_token_throughput_per_sec"], 1)
+        except Exception as e:  # noqa: BLE001
+            out[f"gen_ab_{tag}_error"] = str(e)[:120]
+
     try:
-        registry = ModelRegistry()
-        zoo.register_all(registry)
-        with ServerHarness(registry) as h:
-            url = f"127.0.0.1:{h.http_port}"
-            profile_generate(url, "llama_generate", concurrency=1,
-                             output_tokens=2, num_requests=1,
-                             stream_timeout=1200.0)  # compile warm
-            rep = profile_generate(url, "llama_generate", concurrency=8,
-                                   output_tokens=24, num_requests=16,
-                                   stream_timeout=1200.0)
-        if rep["errors"]:
-            return {"gen_batched_error": str(rep.get("first_error"))[:120]}
-        return {
-            "gen_batched_tok_per_sec_c8":
-                rep["output_token_throughput_per_sec"],
-            "gen_batched_itl_p50_ms": round(
-                rep["inter_token_latency_ms"].get("p50", 0.0), 1),
-        }
-    except Exception as e:  # noqa: BLE001 — bench keeps going without it
-        return {"gen_batched_error": str(e)[:120]}
+        run_mode("independent", "independent", {},
+                 [(8, 16), (16, 32), (64, 64)])
+        # flat 32-slot config for the like-for-like c8/c16 comparison (a
+        # 64-wide step would tick 64 slots for 8 active ones)
+        run_mode("batched", "batched", {
+            "TRITON_TPU_PREFILL_CHUNK": "32",
+            "TRITON_TPU_DECODE_SLOTS": "32",
+        }, [(8, 16), (16, 32)])
+        P = language.LLAMA_SEQ_LEN
+        # bucketed capacity point: 64 slabs of prompt+32 tokens hold the
+        # c=64 sweep in ~the same HBM as the flat 32 x 2P layout
+        # (64(P+32) vs 64P: +2.4% at P=128), proving generation
+        # concurrency scales past the old 32-slot cap
+        run_mode("batched", "bucketed", {
+            "TRITON_TPU_PREFILL_CHUNK": "32",
+            "TRITON_TPU_DECODE_BUCKETS": f"64x{P + 32}",
+        }, [(64, 64)])
     finally:
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+    # continuity with r3's field name: the batched c8 point
+    if "gen_ab_batched_c8" in out:
+        out["gen_batched_tok_per_sec_c8"] = out["gen_ab_batched_c8"]
+    return out
 
 
 def _measure_rtt_floor() -> float:
@@ -318,6 +436,8 @@ def main() -> int:
                    for _ in range(3)]
     simple_res = max(simple_runs, key=lambda r: r["infer_per_sec"])
     simple_errors = [e for r in simple_runs for e in r["errors"]]
+    # drift control, same session: no-compute RPC rate at the same c=8
+    null_rpc = _measure_null_rpc(url)
     # Device path, wire data: concurrency = 4x max batch so the dynamic
     # batcher forms full 64-batches AND up to 4 of them pipeline over the
     # device link (at 64 the closed loop admits exactly one batch in flight,
@@ -365,13 +485,16 @@ def main() -> int:
     shm_res = run_level("grpc", url, "dense_tpu", "", 8, pa_arrays,
                         pa_outputs, "xla", 1 << 20, 4.0, warmup_s=3.0)
 
+    bert_metrics = _measure_bert_mfu(harness)
+
     gen_metrics = _measure_generation(harness)
 
     rtt_floor_ms = _measure_rtt_floor()
     harness.stop()
     # independent of the int8 leg's outcome, and after the main harness
-    # released its device memory
-    gen_metrics.update(_measure_batched_generation())
+    # released its device memory: same-precision batched-vs-independent
+    # generation A/B + the bucketed c=64 capacity point
+    gen_metrics.update(_measure_generation_ab())
 
     baseline = _previous_baseline()
     value = simple_res["infer_per_sec"]
@@ -400,7 +523,13 @@ def main() -> int:
         "tpu_rtt_floor_ms": round(rtt_floor_ms, 3),
         "concurrency": 8,
         "tpu_concurrency": 256,
+        # drift control: headline normalized by the same-session null-RPC
+        # floor — read vs_baseline against this when the raw number moves
+        "null_rpc_per_sec_c8": null_rpc,
+        "value_per_null_rpc": (round(value / null_rpc, 4)
+                               if null_rpc else None),
     }
+    out.update(bert_metrics)
     out.update(gen_metrics)
     out.update(_measure_flash_attention())
     if errors:

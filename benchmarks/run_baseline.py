@@ -33,7 +33,6 @@ if "JAX_PLATFORMS" in os.environ:
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 # v5e peak bf16 matmul throughput, per chip (public spec: 394 TFLOP/s).
-V5E_PEAK_FLOPS = 394e12
 
 
 def _warm(client, httpclient, model, name, shape, dtype, buckets):
@@ -197,11 +196,11 @@ def main():
             results["row4_bert_stream_xlashm"] = sweep(
                 "bert_large", [8, 16, 32], shm="xla", streaming=True)
             best = results["row4_bert_stream_xlashm"]["best"]
-            flops = language.forward_flops_per_token(
-                language.BERT_LARGE, language.BERT_SEQ_LEN)
-            toks = best["throughput"] * language.BERT_SEQ_LEN
-            results["row4_bert_stream_xlashm"]["mfu"] = toks * flops / V5E_PEAK_FLOPS
-            results["row4_bert_stream_xlashm"]["tokens_per_sec"] = toks
+            results["row4_bert_stream_xlashm"]["mfu"] = language.serving_mfu(
+                best["throughput"], language.BERT_LARGE,
+                language.BERT_SEQ_LEN)
+            results["row4_bert_stream_xlashm"]["tokens_per_sec"] = (
+                best["throughput"] * language.BERT_SEQ_LEN)
 
     # ---- row 5: llama ensemble generation over the stream ----------------
     if row_on(5):
@@ -259,7 +258,7 @@ def main():
             "tokens_per_sec": gen_steps / wall,
             "stream_p50_ms": float(np.percentile(lat, 50) * 1e3),
             "stream_p99_ms": float(np.percentile(lat, 99) * 1e3),
-            "mfu": (gen_steps / wall) * window_flops / V5E_PEAK_FLOPS,
+            "mfu": (gen_steps / wall) * window_flops / language.V5E_PEAK_FLOPS,
         }
         r5 = results["row5_llama_ensemble"]
         print(f"  llama({r5['preset_params']/1e9:.2f}B params): "
@@ -304,7 +303,7 @@ def main():
             "streams": n_streams,
             "gen_tokens": total_toks,
             "tokens_per_sec": total_toks / conc_wall,
-            "mfu": (total_toks / conc_wall) * window_flops / V5E_PEAK_FLOPS,
+            "mfu": (total_toks / conc_wall) * window_flops / language.V5E_PEAK_FLOPS,
         }
         r5c = results["row5_llama_concurrent"]
         print(f"  llama concurrent x{n_streams}: {r5c['tokens_per_sec']:.2f} "
@@ -326,7 +325,11 @@ def main():
     results["wall_s"] = time.time() - t_start
     results["backend"] = os.environ.get("JAX_PLATFORMS", "default")
 
-    out = os.path.join(REPO, "benchmarks", "BASELINE_RESULTS.json")
+    # smoke output must never clobber a real TPU measurement (same
+    # convention as run_decode_bench.py)
+    name = ("BASELINE_RESULTS_SMOKE.json" if args.smoke
+            else "BASELINE_RESULTS.json")
+    out = os.path.join(REPO, "benchmarks", name)
     if args.rows is not None and os.path.exists(out):
         # partial run: merge over the existing matrix, don't clobber rows
         # that weren't measured

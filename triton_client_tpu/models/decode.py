@@ -421,9 +421,15 @@ def make_slot_step(cfg: tr.TransformerConfig):
     AUTO slots take their input token from ``prev`` — the previous tick's
     device-resident output — instead of the host ``tokens`` array: the
     server-side continuous-batching generation path, where the greedy
-    feedback loop never leaves the device (no host round trip per token)."""
+    feedback loop never leaves the device (no host round trip per token).
 
-    @jax.jit
+    k/v are DONATED: without donation XLA cannot alias the cache output to
+    its input buffer and every tick pays a full cache copy (hundreds of MB
+    at serving presets) on top of the one-position update.  The worker is
+    the single owner and reassigns the returned arrays; a failed call
+    rebuilds the bucket's cache (see _rebuild_bucket_cache)."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def step(params, k, v, tokens, prev, pos, active, auto):
         tokens = jnp.where(auto, prev, tokens)
         x = jnp.take(params["embed"].astype(cfg.dtype),
@@ -450,9 +456,10 @@ def make_slot_prefill(cfg: tr.TransformerConfig):
     k', v') — prefills ONE slot of the shared cache in a single forward.
 
     The cache length comes from ``k.shape[3]``, so one returned function
-    serves every slab bucket — jit retraces per distinct cache shape."""
+    serves every slab bucket — jit retraces per distinct cache shape.
+    k/v donated (see make_slot_step)."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def prefill(params, k, v, tokens, slot):
         B, S = tokens.shape
         x = jnp.take(params["embed"].astype(cfg.dtype), tokens, axis=0)
@@ -487,9 +494,10 @@ def make_slot_chunk_prefill(cfg: tr.TransformerConfig, s_max: int):
     genai-perf c=8 contention BASELINE row 8 measured): each chunk attends
     to the cache prefix written by earlier chunks (positions < pos0) plus
     causally within itself, exactly reproducing full-prompt prefill.  The
-    returned token/logit are meaningful on the FINAL chunk only."""
+    returned token/logit are meaningful on the FINAL chunk only.  k/v
+    donated (see make_slot_step)."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def chunk_prefill(params, k, v, chunk, slot, pos0):
         B, C = chunk.shape
         S = k.shape[3]
@@ -1020,6 +1028,7 @@ class DecodeModel:
                     deliver_error(completion, e)
                     if completion[0] == "gen":
                         self._release_gen_slot(slot)
+                    self._rebuild_bucket_cache(b)
                 continue
             if kind == "prefill_cont":
                 slot, gen, win, pos0, completion = payload
@@ -1046,6 +1055,7 @@ class DecodeModel:
                     deliver_error(completion, e)
                     if completion[0] == "gen":
                         self._release_gen_slot(slot)
+                    self._rebuild_bucket_cache(b)
                 continue
             # Merge steps into this tick. A short accumulation window is
             # load-bearing: the previous tick resolves every stream's
@@ -1110,6 +1120,12 @@ class DecodeModel:
                 w["active"][li] = True
                 w["batch"].append((li, f))
             for slot in list(self._auto_slots):
+                info = self._auto_slots[slot]
+                if info["gen"] != self._slot_gen[slot]:
+                    # slot invalidated (cache rebuild) while self-feeding:
+                    # whoever bumped the gen already errored the sink
+                    self._auto_slots.pop(slot)
+                    continue
                 b, li = self._slot_bucket(slot)
                 w = bucket_work(b)
                 w["active"][li] = True
@@ -1150,6 +1166,7 @@ class DecodeModel:
                         info = self._auto_slots.pop(slot)
                         self._gen_reader.submit(info["sink"].put, e)
                         self._release_gen_slot(slot)
+                    self._rebuild_bucket_cache(b)
                     continue
                 # which generations end on this tick (token streamed, then
                 # the slot frees; the readback snapshot keeps its values
@@ -1224,6 +1241,50 @@ class DecodeModel:
             sink.put(int(vals[0, idx]))
             if done:
                 sink.put(None)
+
+    def _rebuild_bucket_cache(self, b: int) -> None:
+        """Worker-side, after a failed donated step/prefill: the call may
+        have consumed the bucket's cache buffers (donation invalidates the
+        inputs even when the computation errors), so rebuild them zeroed
+        and invalidate every slot in the bucket — queued jobs then fail
+        stale instead of touching garbage, and live self-feeding
+        generations in the bucket are aborted (they would otherwise keep
+        streaming tokens decoded from zeros)."""
+        from ..server.types import InferError
+
+        cnt, cap = self._buckets[b]
+        off = self._bucket_off[b]
+        err = InferError(
+            f"model '{self._model.name}': decode cache was rebuilt after "
+            "a device error; generation aborted", 500)
+        for slot in range(off, off + cnt):
+            info = self._auto_slots.pop(slot, None)
+            if info is not None:
+                self._gen_reader.submit(info["sink"].put, err)
+                self._release_gen_slot(slot)
+        with self._lock:
+            for slot in range(off, off + cnt):
+                self._slot_gen[slot] += 1
+        try:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            params, cfg = self._params
+            sharding = NamedSharding(self._mesh,
+                                     P(None, "dp", "tp", None, None))
+            shape = (cfg.n_layers, cnt, cfg.n_heads, cap, cfg.head_dim)
+            self._k[b] = jax.device_put(jnp.zeros(shape, cfg.dtype),
+                                        sharding)
+            self._v[b] = jax.device_put(jnp.zeros(shape, cfg.dtype),
+                                        sharding)
+            self._prev_nxt[b] = jnp.zeros(cnt, jnp.int32)
+        except Exception:  # noqa: BLE001 — e.g. the same OOM that failed
+            # the step: a sane cache cannot be restored, so fail pending
+            # work cleanly (503 via the drain path) instead of letting the
+            # worker die and leave futures hanging forever
+            with self._lock:
+                self._closed = True
+            self._jobs.put(None)
 
     def _release_gen_slot(self, slot):
         """Worker-side: return a generation slot to the pool (no seq id to

@@ -136,6 +136,21 @@ def forward_flops_per_token(cfg: tr.TransformerConfig, seq_len: int) -> float:
     return matmul + attn
 
 
+#: v5e bf16 peak (one chip) — the denominator for every MFU number this
+#: repo reports; change it HERE, not in individual benchmark drivers.
+V5E_PEAK_FLOPS = 394e12
+
+
+def serving_mfu(infer_per_sec: float, cfg: tr.TransformerConfig,
+                seq_len: int) -> float:
+    """Model FLOPs utilization of a serving sweep: measured requests/sec ×
+    seq_len tokens each × analytic forward FLOPs/token over chip peak.
+    Shared by bench.py and benchmarks/run_baseline.py so the formula and
+    peak constant cannot drift apart."""
+    toks = infer_per_sec * seq_len
+    return toks * forward_flops_per_token(cfg, seq_len) / V5E_PEAK_FLOPS
+
+
 class _LazyTransformer:
     """Shared lazy init: mesh + params + jitted forward on first call.
 
