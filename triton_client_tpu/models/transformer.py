@@ -335,6 +335,15 @@ def _flash_enabled() -> bool:
     return os.environ.get("TRITON_TPU_FLASH", "1") != "0"
 
 
+def _flash_min_s() -> int:
+    """Sequence-length gate for the pallas flash kernel.  Measured on-chip
+    (benchmarks/BERT_PROFILE.md): at S=384 the kernel is ~25% SLOWER than
+    XLA's fused attention (block overheads dominate short rows), while at
+    S=2048 it is ~2-4x faster and at S=8192 it is the only thing that
+    compiles.  Default crossover 1024; override TRITON_TPU_FLASH_MIN_S."""
+    return int(os.environ.get("TRITON_TPU_FLASH_MIN_S", "1024"))
+
+
 def _attn_apply(blk, x, cfg: TransformerConfig):
     h = _rmsnorm(x, blk["ln1"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bhsk", h, blk["wq"].astype(h.dtype))
@@ -343,10 +352,12 @@ def _attn_apply(blk, x, cfg: TransformerConfig):
     Sc = x.shape[1]
     positions = lax.axis_index("sp") * Sc + jnp.arange(Sc)
     q, k = _rope(q, k, positions, cfg.rope_theta)
-    if lax.axis_size("sp") == 1 and _flash_enabled():
-        # full sequence on-device: the pallas flash kernel (ops/) replaces
-        # the cross-device ring — identical online-softmax math, VMEM-tiled
-        # (the TPU serving path for bert_large / llama_tpu)
+    if (lax.axis_size("sp") == 1 and _flash_enabled()
+            and q.shape[2] >= _flash_min_s()):
+        # full LONG sequence on-device: the pallas flash kernel (ops/)
+        # replaces the cross-device ring — identical online-softmax math,
+        # VMEM-tiled (the TPU serving path for longctx_tpu); short
+        # sequences stay on XLA's fused attention (see _flash_min_s)
         from ..ops import flash_attention
 
         o = flash_attention(q, k, v, causal=cfg.causal)
