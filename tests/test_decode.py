@@ -872,6 +872,119 @@ class TestBucketedCache:
             DecodeModel(name="llama_decode_badbuck")
 
 
+class TestInt8KvCache:
+    """TRITON_TPU_KV_QUANT=int8: the shared slot cache stores int8 K/V
+    with per-vector scales — half the HBM, so the same budget holds twice
+    the slots; greedy decode quality must track the bf16 cache."""
+
+    @pytest.fixture()
+    def quantized(self, monkeypatch):
+        from triton_client_tpu.models.decode import (DecodeModel,
+                                                     GenerateModel)
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        monkeypatch.setenv("TRITON_TPU_KV_QUANT", "int8")
+        dec = DecodeModel(name="llama_decode_kvq")
+        gen = GenerateModel(dec, name="llama_generate_kvq")
+        yield dec, gen
+        dec._shutdown()
+
+    @pytest.fixture()
+    def fp(self, monkeypatch):
+        from triton_client_tpu.models.decode import (DecodeModel,
+                                                     GenerateModel)
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        monkeypatch.delenv("TRITON_TPU_KV_QUANT", raising=False)
+        dec = DecodeModel(name="llama_decode_kvfp")
+        gen = GenerateModel(dec, name="llama_generate_kvfp")
+        yield dec, gen
+        dec._shutdown()
+
+    @staticmethod
+    def _tokens(gen_model, prompt, n):
+        return [int(f["token_id"][0]) for f in gen_model._generate(
+            {"text_input": np.array([prompt], object)},
+            {"max_tokens": n})]
+
+    def test_cache_is_int8_with_scales(self, quantized):
+        dec, gen = quantized
+        self._tokens(gen, b"warm", 2)  # force cache build
+        k0 = dec._k[0]
+        assert isinstance(k0, dict)
+        assert k0["q"].dtype == jnp.int8
+        assert k0["s"].dtype == jnp.float32
+        assert k0["q"].shape[:-1] == k0["s"].shape
+
+    def test_greedy_tokens_track_bf16(self, quantized, fp):
+        """Per-vector absmax int8 is near-lossless for greedy decode on
+        the tiny preset: the streams must agree (verified exact here; if
+        a future preset makes them diverge at some depth, shorten or
+        loosen deliberately, don't delete)."""
+        _, gen_q = quantized
+        _, gen_f = fp
+        want = self._tokens(gen_f, b"kv quant check", 8)
+        got = self._tokens(gen_q, b"kv quant check", 8)
+        assert got == want
+
+    def test_logits_close_to_bf16(self, quantized, fp):
+        dec_q, _ = quantized
+        dec_f, _ = fp
+        win = np.zeros((128,), np.int32)
+        win[-5:] = [7, 11, 13, 17, 19]
+        rq = dec_q._execute({"TOKENS": win},
+                            {"sequence_id": 9301, "sequence_start": True,
+                             "sequence_end": True})
+        rf = dec_f._execute({"TOKENS": win},
+                            {"sequence_id": 9302, "sequence_start": True,
+                             "sequence_end": True})
+        assert rq["NEXT_TOKEN"][0] == rf["NEXT_TOKEN"][0]
+        np.testing.assert_allclose(rq["NEXT_LOGIT"], rf["NEXT_LOGIT"],
+                                   rtol=0.05, atol=0.05)
+
+    def test_chunked_prefill_matches_full_under_int8(self, quantized,
+                                                     monkeypatch):
+        """Chunked prefill attends over the int8-quantized keys earlier
+        chunks wrote (full prefill sees full-precision in-forward keys),
+        so the bf16 bit-identity weakens to near-lossless under int8 —
+        pin that the tiny preset still agrees so a real divergence shows
+        up here, not in production."""
+        from triton_client_tpu.models.decode import (DecodeModel,
+                                                     GenerateModel)
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        monkeypatch.setenv("TRITON_TPU_KV_QUANT", "int8")
+        monkeypatch.setenv("TRITON_TPU_PREFILL_CHUNK", "32")
+        dec_c = DecodeModel(name="llama_decode_kvq_chunk")
+        gen_c = GenerateModel(dec_c, name="llama_generate_kvq_chunk")
+        try:
+            _, gen_q = quantized  # unchunked int8
+            want = self._tokens(gen_q, b"chunked int8 parity", 6)
+            got = self._tokens(gen_c, b"chunked int8 parity", 6)
+            assert got == want
+        finally:
+            dec_c._shutdown()
+
+    def test_requires_batched_mode(self, monkeypatch):
+        from triton_client_tpu.models.decode import DecodeModel
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "independent")
+        monkeypatch.setenv("TRITON_TPU_KV_QUANT", "int8")
+        with pytest.raises(ValueError, match="requires.*batched"):
+            DecodeModel(name="llama_decode_kvbad")
+
+    def test_unknown_value_fails_loudly(self, monkeypatch):
+        from triton_client_tpu.models.decode import DecodeModel
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_KV_QUANT", "fp4")
+        with pytest.raises(ValueError, match="int8"):
+            DecodeModel(name="llama_decode_kvbad2")
+
+
 class TestMoePresetServing:
     """llama_decode / llama_generate serve an MoE preset end-to-end
     (TRITON_TPU_LLAMA_PRESET=tiny-moe)."""

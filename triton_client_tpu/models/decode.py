@@ -374,11 +374,100 @@ def parse_cache_buckets(spec, n_slots: int, s_max: int, prompt_len: int):
     return out
 
 
+def kv_quant_enabled() -> bool:
+    """``TRITON_TPU_KV_QUANT=int8`` stores the shared slot cache as int8
+    with per-(head, position) vector scales — cache HBM roughly halves, so
+    the same budget holds ~2x decode slots/longer slabs.  Unknown values
+    fail loudly (same convention as TRITON_TPU_QUANT)."""
+    import os
+
+    v = os.environ.get("TRITON_TPU_KV_QUANT", "")
+    if v in ("", "none"):
+        return False
+    if v == "int8":
+        return True
+    raise ValueError(
+        f"TRITON_TPU_KV_QUANT={v!r}: expected 'int8' or unset")
+
+
+def _kv_quantize(x):
+    """[..., K] f-point -> (int8 [..., K], f32 scale [...]): symmetric
+    per-vector absmax over the head dim."""
+    a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(a > 0, a / 127.0, 1.0)
+    q = jnp.round(
+        x.astype(jnp.float32) / scale[..., None]).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _cache_read_f32(c):
+    """Cache leaf -> f32 values.  ``c`` is either a plain array (bf16
+    cache) or the int8 dict {"q": int8 [..., S, K], "s": f32 [..., S]};
+    the structure is static under jit, so this branch traces away.  The
+    dequant is a cheap elementwise producer XLA fuses into the consuming
+    attention einsum — HBM reads stay int8."""
+    if isinstance(c, dict):
+        return c["q"].astype(jnp.float32) * c["s"][..., None]
+    return c.astype(jnp.float32)
+
+
+def _cache_row_write(cache_row, new_row, p, a):
+    """Write ``new_row`` [H, 1, K] at position ``p`` of one slot's cache
+    row [H, S, K] (plain or int8-dict), keeping the current entry when the
+    slot is inactive."""
+    if isinstance(cache_row, dict):
+        q_new, s_new = _kv_quantize(new_row)
+        cur_q = lax.dynamic_slice(
+            cache_row["q"], (0, p, 0),
+            (cache_row["q"].shape[0], 1, cache_row["q"].shape[2]))
+        cur_s = lax.dynamic_slice(
+            cache_row["s"], (0, p), (cache_row["s"].shape[0], 1))
+        return {
+            "q": lax.dynamic_update_slice(
+                cache_row["q"], jnp.where(a, q_new, cur_q), (0, p, 0)),
+            "s": lax.dynamic_update_slice(
+                cache_row["s"], jnp.where(a, s_new, cur_s), (0, p)),
+        }
+    cur = lax.dynamic_slice(
+        cache_row, (0, p, 0), (cache_row.shape[0], 1, cache_row.shape[2]))
+    val = jnp.where(a, new_row.astype(cache_row.dtype), cur)
+    return lax.dynamic_update_slice(cache_row, val, (0, p, 0))
+
+
+def _cache_block_write(cache, values, idx4, idx5):
+    """Write a [L, 1, H, S', K] block of values into the cache at the
+    5-dim index (full-slot or chunked prefill)."""
+    if isinstance(cache, dict):
+        q, s = _kv_quantize(values)
+        return {
+            "q": lax.dynamic_update_slice(cache["q"], q, idx5),
+            "s": lax.dynamic_update_slice(cache["s"], s, idx4),
+        }
+    return lax.dynamic_update_slice(cache, values.astype(cache.dtype), idx5)
+
+
+def _cache_slot_slice(cache, slot):
+    """One slot's [1, H, S, K]-shaped view of a [B, H, S, K] cache."""
+    if isinstance(cache, dict):
+        return {
+            "q": lax.dynamic_slice(cache["q"], (slot, 0, 0, 0),
+                                   (1,) + cache["q"].shape[1:]),
+            "s": lax.dynamic_slice(cache["s"], (slot, 0, 0),
+                                   (1,) + cache["s"].shape[1:]),
+        }
+    return lax.dynamic_slice(cache, (slot, 0, 0, 0), (1,) + cache.shape[1:])
+
+
+def _cache_seq_len(c) -> int:
+    return (c["q"] if isinstance(c, dict) else c).shape[-2]
+
+
 def _slot_decode_layer(blk, x, kc, vc, pos, active,
                        cfg: tr.TransformerConfig):
     """One token per slot, each at its own position.
 
-    x: [B, 1, D]; kc/vc: [B, H, S_max, K]; pos: [B]; active: [B] bool.
+    x: [B, 1, D]; kc/vc: [B, H, S_max, K] (plain bf16 or int8 dict —
+    see kv_quant_enabled); pos: [B]; active: [B] bool.
     Only ACTIVE slots write their K/V — an inactive slot (no pending
     request this tick, or mid-chunked-prefill) must not clobber cache
     entries at its stale position (a chunked prefill interleaves decode
@@ -388,22 +477,15 @@ def _slot_decode_layer(blk, x, kc, vc, pos, active,
     q = _rope_at(q, pos, cfg.rope_theta)
     k = _rope_at(k, pos, cfg.rope_theta)
 
-    def write(cache_row, new_row, p, a):
-        cur = lax.dynamic_slice(
-            cache_row, (0, p, 0), (cache_row.shape[0], 1,
-                                   cache_row.shape[2]))
-        val = jnp.where(a, new_row, cur)  # inactive: write back current
-        return lax.dynamic_update_slice(cache_row, val, (0, p, 0))
-
-    kc = jax.vmap(write)(kc, k.astype(kc.dtype), pos, active)
-    vc = jax.vmap(write)(vc, v.astype(vc.dtype), pos, active)
+    kc = jax.vmap(_cache_row_write)(kc, k, pos, active)
+    vc = jax.vmap(_cache_row_write)(vc, v, pos, active)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     s = jnp.einsum("bhqk,bhsk->bhqs", q.astype(jnp.float32),
-                   kc.astype(jnp.float32)) * scale
-    valid = jnp.arange(kc.shape[2])[None, :] <= pos[:, None]      # [B, S]
+                   _cache_read_f32(kc)) * scale
+    valid = jnp.arange(_cache_seq_len(kc))[None, :] <= pos[:, None]  # [B, S]
     s = jnp.where(valid[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqs,bhsk->bhqk", p, vc.astype(jnp.float32)).astype(x.dtype)
+    o = jnp.einsum("bhqs,bhsk->bhqk", p, _cache_read_f32(vc)).astype(x.dtype)
     x = _attn_out(blk, x, o)
     return _ffn(blk, x, cfg), kc, vc
 
@@ -455,9 +537,10 @@ def make_slot_prefill(cfg: tr.TransformerConfig):
     """jitted (params, k, v, tokens [1,S], slot) -> (next tok, best logit,
     k', v') — prefills ONE slot of the shared cache in a single forward.
 
-    The cache length comes from ``k.shape[3]``, so one returned function
-    serves every slab bucket — jit retraces per distinct cache shape.
-    k/v donated (see make_slot_step)."""
+    The cache length comes from the cache leaf itself (``_cache_seq_len`` —
+    ``k`` is a plain array or an int8 {q, s} dict), so one returned
+    function serves every slab bucket — jit retraces per distinct cache
+    shape.  k/v donated (see make_slot_step)."""
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def prefill(params, k, v, tokens, slot):
@@ -470,13 +553,11 @@ def make_slot_prefill(cfg: tr.TransformerConfig):
             return x, (kl, vl)
 
         x, (ks, vs) = lax.scan(layer, x, blocks)                  # [L,1,H,S,K]
-        pad = k.shape[3] - S
+        pad = _cache_seq_len(k) - S
         ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
         vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
-        k = lax.dynamic_update_slice(k, ks.astype(k.dtype),
-                                     (0, slot, 0, 0, 0))
-        v = lax.dynamic_update_slice(v, vs.astype(v.dtype),
-                                     (0, slot, 0, 0, 0))
+        k = _cache_block_write(k, ks, (0, slot, 0, 0), (0, slot, 0, 0, 0))
+        v = _cache_block_write(v, vs, (0, slot, 0, 0), (0, slot, 0, 0, 0))
         logits = _head(params, x, cfg)[:, -1]
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
         best = jnp.max(logits, axis=-1).astype(jnp.float32)[0]
@@ -500,7 +581,7 @@ def make_slot_chunk_prefill(cfg: tr.TransformerConfig, s_max: int):
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def chunk_prefill(params, k, v, chunk, slot, pos0):
         B, C = chunk.shape
-        S = k.shape[3]
+        S = _cache_seq_len(k)
         x = jnp.take(params["embed"].astype(cfg.dtype), chunk, axis=0)
         blocks = _layer_blocks(params, cfg)
         positions = pos0 + jnp.arange(C)
@@ -512,20 +593,18 @@ def make_slot_chunk_prefill(cfg: tr.TransformerConfig, s_max: int):
             blk, kc, vc = xs              # [n_slots, H, S, K]
             q, kk, vv = _project_qkv(blk, x, cfg)
             q, kk = tr._rope(q, kk, positions, cfg.rope_theta)
-            kc = lax.dynamic_update_slice(
-                kc, kk.astype(kc.dtype), (slot, 0, pos0, 0))
-            vc = lax.dynamic_update_slice(
-                vc, vv.astype(vc.dtype), (slot, 0, pos0, 0))
-            kcs = lax.dynamic_slice(
-                kc, (slot, 0, 0, 0), (1,) + kc.shape[1:])
-            vcs = lax.dynamic_slice(
-                vc, (slot, 0, 0, 0), (1,) + vc.shape[1:])
+            kc = _cache_block_write(kc, kk, (slot, 0, pos0),
+                                    (slot, 0, pos0, 0))
+            vc = _cache_block_write(vc, vv, (slot, 0, pos0),
+                                    (slot, 0, pos0, 0))
+            kcs = _cache_slot_slice(kc, slot)
+            vcs = _cache_slot_slice(vc, slot)
             s = jnp.einsum("bhqk,bhsk->bhqs", q.astype(jnp.float32),
-                           kcs.astype(jnp.float32)) * scale
+                           _cache_read_f32(kcs)) * scale
             s = jnp.where(valid[None, None, :, :], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhqs,bhsk->bhqk", p,
-                           vcs.astype(jnp.float32)).astype(x.dtype)
+                           _cache_read_f32(vcs)).astype(x.dtype)
             x = _attn_out(blk, x, o)
             return _ffn(blk, x, cfg), (kc, vc)
 
@@ -600,6 +679,13 @@ class DecodeModel:
                 "shared slot cache to bucket)")
         self._buckets = parse_cache_buckets(
             bucket_spec, n_slots, self._s_max, self._prompt_len)
+        # int8 KV storage for the shared slot cache (kv_quant_enabled
+        # validates the value; batched-only, like the buckets)
+        self._kv_quant = kv_quant_enabled()
+        if self._kv_quant and self._mode != "batched":
+            raise ValueError(
+                "TRITON_TPU_KV_QUANT requires TRITON_TPU_DECODE_MODE="
+                "batched (independent mode has no shared slot cache)")
         n_slots = sum(c for c, _ in self._buckets)
         self._n_slots = n_slots
         self._s_max = max(cap for _, cap in self._buckets)
@@ -724,25 +810,17 @@ class DecodeModel:
                     import numpy as np
 
                     params, cfg = self._ensure_params()
-                    from jax.sharding import NamedSharding
-                    from jax.sharding import PartitionSpec as P
-
                     # slot cache on the serve mesh: slots over dp, heads
                     # over tp (mirrors the K/V the tp-sharded wk/wv produce
                     # so the cache write needs no resharding); one array
-                    # per slab bucket — every shape stays static
-                    cache_sharding = NamedSharding(
-                        self._mesh, P(None, "dp", "tp", None, None))
-                    # dp divides every bucket count by construction:
-                    # decode_mesh was built against the gcd of the counts
+                    # (or int8 {q,s} pair) per slab bucket — every shape
+                    # stays static.  dp divides every bucket count by
+                    # construction: decode_mesh was built against the gcd
                     self._k, self._v, self._prev_nxt = [], [], []
                     for cnt, cap in self._buckets:
-                        shape = (cfg.n_layers, cnt, cfg.n_heads,
-                                 cap, cfg.head_dim)
-                        self._k.append(jax.device_put(
-                            jnp.zeros(shape, cfg.dtype), cache_sharding))
-                        self._v.append(jax.device_put(
-                            jnp.zeros(shape, cfg.dtype), cache_sharding))
+                        kb, vb = self._new_cache_arrays(cnt, cap, cfg)
+                        self._k.append(kb)
+                        self._v.append(vb)
                         # device-resident previous-tick outputs: the
                         # feedback for self-feeding (generation) slots
                         self._prev_nxt.append(jnp.zeros(cnt, jnp.int32))
@@ -1242,6 +1320,31 @@ class DecodeModel:
             if done:
                 sink.put(None)
 
+    def _new_cache_arrays(self, cnt: int, cap: int, cfg):
+        """Fresh zeroed k/v cache pair for one bucket, committed to the
+        serve mesh.  Plain cfg.dtype arrays, or int8 {"q", "s"} pairs when
+        TRITON_TPU_KV_QUANT=int8 (scales init to 1 so zero entries decode
+        to zero)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        shape = (cfg.n_layers, cnt, cfg.n_heads, cap, cfg.head_dim)
+        sh_q = NamedSharding(self._mesh, P(None, "dp", "tp", None, None))
+        if self._kv_quant:
+            sh_s = NamedSharding(self._mesh, P(None, "dp", "tp", None))
+
+            def one():
+                return {
+                    "q": jax.device_put(jnp.zeros(shape, jnp.int8), sh_q),
+                    "s": jax.device_put(
+                        jnp.ones(shape[:-1], jnp.float32), sh_s),
+                }
+        else:
+            def one():
+                return jax.device_put(jnp.zeros(shape, cfg.dtype), sh_q)
+
+        return one(), one()
+
     def _rebuild_bucket_cache(self, b: int) -> None:
         """Worker-side, after a failed donated step/prefill: the call may
         have consumed the bucket's cache buffers (donation invalidates the
@@ -1266,17 +1369,8 @@ class DecodeModel:
             for slot in range(off, off + cnt):
                 self._slot_gen[slot] += 1
         try:
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
-
             params, cfg = self._params
-            sharding = NamedSharding(self._mesh,
-                                     P(None, "dp", "tp", None, None))
-            shape = (cfg.n_layers, cnt, cfg.n_heads, cap, cfg.head_dim)
-            self._k[b] = jax.device_put(jnp.zeros(shape, cfg.dtype),
-                                        sharding)
-            self._v[b] = jax.device_put(jnp.zeros(shape, cfg.dtype),
-                                        sharding)
+            self._k[b], self._v[b] = self._new_cache_arrays(cnt, cap, cfg)
             self._prev_nxt[b] = jnp.zeros(cnt, jnp.int32)
         except Exception:  # noqa: BLE001 — e.g. the same OOM that failed
             # the step: a sane cache cannot be restored, so fail pending
