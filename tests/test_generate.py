@@ -208,6 +208,61 @@ class TestSampling:
                                        "top_k": 1})
         assert greedy == forced
 
+    def test_tiny_top_p_is_greedy_at_any_temperature(self, server):
+        """Nucleus with top_p→0 keeps only the argmax token (the first
+        sorted token always survives), so the stream collapses to greedy
+        regardless of temperature — the cleanest top_p correctness
+        invariant that needs no distribution assumptions."""
+        base = {"text_input": "sample me", "max_tokens": 4}
+        greedy = self._stream(server, base)
+        forced = self._stream(server, {**base, "temperature": 5.0,
+                                       "top_p": 1e-6})
+        assert greedy == forced
+
+    def test_top_p_seeded_reproduces(self, server):
+        base = {"text_input": "sample me", "max_tokens": 8,
+                "temperature": 2.0, "top_p": 0.9, "seed": 11}
+        assert self._stream(server, base) == self._stream(server, base)
+
+    def test_top_p_nucleus_masks_exactly(self):
+        """Sampler-level oracle on controlled logits (the served model's
+        distribution is too preset-dependent for HTTP-level set
+        assertions): a 0.05 nucleus over well-separated logits admits ONLY
+        the argmax; top_p=1.0 leaves the full support reachable; a 0.5
+        nucleus admits exactly the descending-probability prefix whose
+        mass reaches 0.5."""
+        import jax
+        import jax.numpy as jnp
+
+        from triton_client_tpu.models.decode import GenerateModel
+
+        sampler = GenerateModel._sampler(0, True)
+        logits = jnp.asarray(np.linspace(0.0, 3.0, 16)[None, :],
+                             jnp.float32)
+
+        def support(top_p, temp, n=300):
+            return {int(sampler(logits, jax.random.PRNGKey(i),
+                                jnp.float32(temp), jnp.float32(top_p))[0])
+                    for i in range(n)}
+
+        assert support(0.05, 3.0) == {15}
+        assert support(1.0, 3.0) == set(range(16))
+        # analytic nucleus at temperature 1: descending softmax cumsum
+        probs = np.exp(np.linspace(0.0, 3.0, 16))
+        probs /= probs.sum()
+        desc = np.sort(probs)[::-1]
+        n_kept = int(np.searchsorted(np.cumsum(desc), 0.5)) + 1
+        expect = set(range(16 - n_kept, 16))  # top n_kept of ascending ids
+        assert support(0.5, 1.0) == expect
+
+    def test_invalid_top_p_rejected(self, server):
+        for bad in (0, -0.5, 1.5, "wide"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.http_url,
+                      "/v2/models/llama_generate/generate_stream",
+                      {"text_input": "x", "top_p": bad, "temperature": 1.0})
+            assert e.value.code == 400, bad
+
     def test_invalid_top_k_rejected(self, server):
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(server.http_url,

@@ -245,12 +245,37 @@ class TestCompatEdges:
             assert e.value.code == 400, extra
 
     def test_unsupported_params_rejected_loudly(self, server):
-        for extra in ({"top_p": 0.5},
-                      {"stream_options": {"include_usage": True}}):
+        for extra in ({"stream_options": {"include_usage": True}},):
             with pytest.raises(urllib.error.HTTPError) as e:
                 _post(server.http_url, "/v1/completions",
                       {"model": "llama_generate", "prompt": "x", **extra})
             assert e.value.code == 400, extra
+
+    def test_top_p_without_temperature_samples(self, server):
+        """OpenAI defaults temperature to 1: top_p alone must SAMPLE, not
+        silently no-op against the generate contract's greedy default."""
+        outs = set()
+        for seed in range(6):
+            with _post(server.http_url, "/v1/completions", {
+                "model": "llama_generate", "prompt": "x", "max_tokens": 4,
+                "top_p": 0.95, "seed": seed,
+            }) as r:
+                outs.add(json.loads(r.read())["choices"][0]["text"])
+        assert len(outs) > 1  # greedy no-op would give one identical text
+
+    def test_top_p_sampling(self, server):
+        # seeded nucleus sampling is reproducible; invalid values 400
+        def run():
+            with _post(server.http_url, "/v1/completions", {
+                "model": "llama_generate", "prompt": "x", "max_tokens": 6,
+                "temperature": 1.5, "top_p": 0.9, "seed": 5,
+            }) as r:
+                return json.loads(r.read())["choices"][0]["text"]
+        assert run() == run()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.http_url, "/v1/completions",
+                  {"model": "llama_generate", "prompt": "x", "top_p": 1.5})
+        assert e.value.code == 400
 
     def test_invalid_stop_and_n_are_400(self, server):
         for extra in ({"n": 0}, {"n": 99}, {"n": "two"}, {"stop": ""},

@@ -1657,20 +1657,41 @@ class GenerateModel:
 
     @staticmethod
     @functools.lru_cache(maxsize=16)
-    def _sampler(top_k: int):
+    def _sampler(top_k: int, use_top_p: bool = False):
         """Jitted device-side token chooser — temperature scaling, optional
-        static top-k truncation, categorical sample.  One compile per
-        distinct top_k (bounded by the lru cache)."""
+        static top-k truncation, optional nucleus (top-p) truncation,
+        categorical sample.  One compile per distinct (top_k, top_p-on)
+        pair (bounded by the lru cache); the top_p VALUE is a traced
+        argument so sweeping it costs no recompiles."""
 
-        def choose(logits, key, temperature):
+        def choose(logits, key, temperature, top_p):
             l32 = logits.astype(jnp.float32)
+            top_vals = None
             if top_k > 0:
                 top_vals, _ = lax.top_k(l32, top_k)
                 thresh = top_vals[..., -1:]
                 l32 = jnp.where(l32 >= thresh, l32, -jnp.inf)
+            inv_t = 1.0 / jnp.maximum(temperature, 1e-6)
+            if use_top_p:
+                # nucleus: keep the smallest descending-probability prefix
+                # whose mass reaches top_p (OpenAI semantics: temperature
+                # applies before the nucleus cut; the first token always
+                # survives).  top_k already produced the descending
+                # survivors — masked entries contribute 0 mass, so the
+                # length-k softmax equals the masked-vocab one and the
+                # full-vocab re-sort is skipped.
+                desc = (top_vals if top_vals is not None
+                        else jnp.sort(l32, axis=-1)[..., ::-1])
+                probs = jax.nn.softmax(desc * inv_t, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = jnp.concatenate(
+                    [jnp.ones_like(cum[..., :1], bool),
+                     cum[..., :-1] < top_p], axis=-1)
+                kept_min = jnp.min(
+                    jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+                l32 = jnp.where(l32 >= kept_min, l32, -jnp.inf)
             return jax.random.categorical(
-                key, l32 / jnp.maximum(temperature, 1e-6),
-                axis=-1).astype(jnp.int32)
+                key, l32 * inv_t, axis=-1).astype(jnp.int32)
 
         return jax.jit(choose)
 
@@ -1715,6 +1736,7 @@ class GenerateModel:
             n_tokens = int(parameters.get("max_tokens", self._default_tokens))
             temperature = float(parameters.get("temperature", 0.0))
             top_k = int(parameters.get("top_k", 0))
+            top_p = float(parameters.get("top_p", 1.0))
             seed = parameters.get("seed")
             seed = None if seed is None else int(seed)
         except (TypeError, ValueError) as e:
@@ -1726,6 +1748,8 @@ class GenerateModel:
         if top_k < 0 or top_k > cfg.vocab_size:
             raise InferError(
                 f"top_k must be in [0, {cfg.vocab_size}], got {top_k}")
+        if not (0.0 < top_p <= 1.0):
+            raise InferError(f"top_p must be in (0, 1], got {top_p}")
         if seed is None:
             # unseeded sampling must vary across requests
             import os as _os
@@ -1757,12 +1781,13 @@ class GenerateModel:
         # feedback makes inter-token latency the on-device step time, with
         # readbacks prefetched so they overlap the remaining steps.
         if temperature > 0:
-            sampler = self._sampler(top_k)
+            sampler = self._sampler(top_k, top_p < 1.0)
             base_key = jax.random.PRNGKey(seed)
 
             def choose(logits, i):
                 return sampler(logits, jax.random.fold_in(base_key, i),
-                               jnp.float32(temperature))
+                               jnp.float32(temperature),
+                               jnp.float32(top_p))
         else:
             def choose(logits, i):
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)

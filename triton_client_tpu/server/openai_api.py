@@ -177,10 +177,8 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
             f"model '{model_name}' does not speak the generate contract "
             "(decoupled, text_input)")
     # honored params are cast under a 400 guard; recognized-but-unsupported
-    # params are rejected loudly — silently ignoring top_p would return
+    # params are rejected loudly — a silently ignored knob would return
     # 200s that look honored but are not
-    if body.get("top_p") not in (None, 1, 1.0):
-        raise InferError("'top_p' is not supported; use 'top_k'")
     if body.get("stream_options"):
         raise InferError("'stream_options' is not supported")
     n = body.get("n")
@@ -197,7 +195,15 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
             parameters["temperature"] = float(body["temperature"])
         if body.get("seed") is not None:
             parameters["seed"] = int(body["seed"])
-        if body.get("top_k") is not None:  # extension; OpenAI has top_p
+        if body.get("top_p") is not None:
+            parameters["top_p"] = float(body["top_p"])
+            if body.get("temperature") is None:
+                # OpenAI samples at temperature 1 by default; the generate
+                # contract's greedy default would silently no-op the
+                # nucleus ("alter top_p or temperature" implies top_p
+                # alone still samples)
+                parameters["temperature"] = 1.0
+        if body.get("top_k") is not None:  # extension beyond OpenAI
             parameters["top_k"] = int(body["top_k"])
     except (TypeError, ValueError) as e:
         raise InferError(f"invalid sampling parameter: {e}")
