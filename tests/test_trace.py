@@ -159,6 +159,116 @@ class TestTimestampTracing:
         assert traces[0]["model_name"] == "simple"
 
 
+class TestPerModelSettings:
+    """A model's trace overlay overrides the global scope for that model
+    only, with its own file and sampling budget; null clears the override
+    back to inheriting global (reference per-model trace contract)."""
+
+    def test_model_override_traces_only_that_model(self, client, tmp_path):
+        tf = tmp_path / "simple_only.jsonl"
+        client.update_trace_settings("simple", settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+        })
+        client.infer("simple", _simple_inputs())
+        # another model still follows the global scope (OFF)
+        ident = np.zeros((1, 16), np.float32)
+        inp = httpclient.InferInput("INPUT0", [1, 16], "FP32")
+        inp.set_data_from_numpy(ident)
+        client.infer("identity_fp32", [inp])
+        traces = _read_traces(tf)
+        assert [t["model_name"] for t in traces] == ["simple"]
+        # per-model GET returns the merged view; global stays untouched
+        eff = client.get_trace_settings("simple")
+        assert eff["trace_level"] == ["TIMESTAMPS"]
+        assert client.get_trace_settings()["trace_level"] == ["OFF"]
+        # null clears the override: the model inherits global (OFF) again
+        client.update_trace_settings("simple", settings={
+            "trace_file": None, "trace_level": None, "trace_rate": None})
+        client.infer("simple", _simple_inputs())
+        assert len(_read_traces(tf)) == 1
+        assert client.get_trace_settings("simple")["trace_level"] == ["OFF"]
+
+    def test_model_scope_has_its_own_budget(self, client, tmp_path):
+        tf = tmp_path / "budget_model.jsonl"
+        client.update_trace_settings("simple", settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+            "trace_count": ["1"],
+        })
+        for _ in range(3):
+            client.infer("simple", _simple_inputs())
+        assert len(_read_traces(tf)) == 1
+        client.update_trace_settings("simple", settings={
+            "trace_file": None, "trace_level": None,
+            "trace_rate": None, "trace_count": None})
+
+    def test_unknown_model_400(self, client):
+        with pytest.raises(InferenceServerException):
+            client.update_trace_settings(
+                "nope", settings={"trace_level": ["TIMESTAMPS"]})
+
+    def test_profile_is_global_only(self, server, client):
+        # a per-model PROFILE toggle would be accepted-but-inert (the jax
+        # profiler is process-global) — both frontends refuse it loudly
+        with pytest.raises(InferenceServerException) as ei:
+            client.update_trace_settings(
+                "simple", settings={"trace_level": ["PROFILE"]})
+        assert "global" in str(ei.value)
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            with pytest.raises(InferenceServerException):
+                gc.update_trace_settings(
+                    "simple", settings={"trace_level": ["PROFILE"]})
+            # a typo'd per-model clear fails on gRPC too (HTTP parity)
+            with pytest.raises(InferenceServerException):
+                gc.update_trace_settings("simple",
+                                         settings={"trace_levl": None})
+
+    def test_global_refresh_resets_model_budgets(self, client, tmp_path):
+        tf = tmp_path / "refresh.jsonl"
+        client.update_trace_settings("simple", settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+            "trace_count": ["1"],
+        })
+        client.infer("simple", _simple_inputs())
+        client.infer("simple", _simple_inputs())
+        assert len(_read_traces(tf)) == 1  # model budget exhausted
+        # a GLOBAL settings refresh opens a fresh window for overrides too
+        client.update_trace_settings(settings={"log_frequency": ["0"]})
+        client.infer("simple", _simple_inputs())
+        assert len(_read_traces(tf)) == 2
+        client.update_trace_settings("simple", settings={
+            "trace_file": None, "trace_level": None,
+            "trace_rate": None, "trace_count": None})
+
+    def test_grpc_model_scope(self, server, tmp_path):
+        tf = tmp_path / "grpc_model.jsonl"
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            gc.update_trace_settings("simple", settings={
+                "trace_file": [str(tf)],
+                "trace_level": ["TIMESTAMPS"],
+                "trace_rate": ["1"],
+            })
+            out = gc.get_trace_settings("simple", as_json=True)
+            assert out["settings"]["trace_level"]["value"] == ["TIMESTAMPS"]
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(a)
+            inputs[1].set_data_from_numpy(a)
+            gc.infer("simple", inputs)
+            gc.update_trace_settings("simple", settings={
+                "trace_file": None, "trace_level": None,
+                "trace_rate": None})
+        assert len(_read_traces(tf)) == 1
+
+
 class TestProfileLevel:
     def test_profile_toggles_jax_profiler(self, client, tmp_path):
         """PROFILE runs jax.profiler into <trace_file>.profile (SURVEY §5:

@@ -345,6 +345,37 @@ class InferenceServicer:
     async def TraceSetting(self, request, context):
         from .trace import TRACE_DEFAULTS, validate_trace_update
 
+        model = request.model_name or ""
+        if model:
+            try:
+                self._core.registry.get(model)
+                # empty value in model scope clears the override (back to
+                # inheriting global); explicit values override
+                update = {k: list(v.value)
+                          for k, v in request.settings.items() if v.value}
+                cleared = []
+                for k, v in request.settings.items():
+                    if v.value:
+                        continue
+                    if k not in TRACE_DEFAULTS:
+                        # same contract as HTTP: a typo'd clear must not
+                        # silently succeed
+                        raise InferError(
+                            f"unknown trace setting '{k}'", 400)
+                    cleared.append(k)
+                validate_trace_update(update, model_scope=True)
+            except InferError as e:
+                code = (grpc.StatusCode.UNIMPLEMENTED
+                        if e.http_status == 501
+                        else grpc.StatusCode.INVALID_ARGUMENT)
+                await context.abort(code, str(e))
+            if update or cleared:
+                self._core.tracer.update_model(model, update, cleared)
+            resp = pb.TraceSettingResponse()
+            for k, vals in self._core.tracer.effective_settings(
+                    model).items():
+                resp.settings[k].value.extend(vals)
+            return resp
         # an empty value list (SetInParent with no values) clears the key back
         # to its default — reference update_trace_settings(None) contract
         update = {

@@ -203,13 +203,34 @@ async def _repo_unload(core, request):
 
 
 async def _get_trace(core, request):
+    model = request.match_info.get("model")
+    if model:
+        core.registry.get(model)  # unknown model -> 400
+        return web.json_response(core.tracer.effective_settings(model))
     return web.json_response(core.trace_settings)
 
 
 async def _set_trace(core, request):
     from .trace import TRACE_DEFAULTS, validate_trace_update
 
+    model = request.match_info.get("model")
     body = await _read_json(request, default={})
+    if model:
+        core.registry.get(model)  # unknown model -> 400
+        update, cleared = {}, []
+        for k, v in body.items():
+            if v is None:
+                # null in model scope clears the OVERRIDE — the model goes
+                # back to inheriting the global value (reference contract)
+                if k not in TRACE_DEFAULTS:
+                    raise InferError(f"unknown trace setting '{k}'", 400)
+                cleared.append(k)
+            else:
+                update[k] = v if isinstance(v, list) else [str(v)]
+        validate_trace_update(update, model_scope=True)
+        if update or cleared:
+            core.tracer.update_model(model, update, cleared)
+        return web.json_response(core.tracer.effective_settings(model))
     update = {}
     for k, v in body.items():
         if v is None:

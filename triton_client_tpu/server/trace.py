@@ -59,12 +59,16 @@ TRACE_DEFAULTS: Dict[str, List[str]] = {
 }
 
 
-def validate_trace_update(settings: Dict[str, List[str]]) -> None:
+def validate_trace_update(settings: Dict[str, List[str]],
+                          model_scope: bool = False) -> None:
     """Reject unsupported trace settings *before* they are applied.
 
     Raises ``InferError`` with http_status 501 for ``trace_level=TENSORS``
     (both frontends map this to their loud-unimplemented status) and 400 for
-    unknown levels or non-numeric rate/count.
+    unknown levels or non-numeric rate/count.  ``model_scope`` additionally
+    refuses PROFILE: the jax profiler is process-global, so a per-model
+    toggle would be accepted-but-inert — the failure mode this module
+    exists to avoid.
     """
     for key, vals in settings.items():
         if key not in TRACE_DEFAULTS:
@@ -86,6 +90,12 @@ def validate_trace_update(settings: Dict[str, List[str]]) -> None:
                 "use TIMESTAMPS and/or PROFILE",
                 http_status=501,
             )
+        if model_scope and "PROFILE" in levels:
+            raise InferError(
+                "trace_level PROFILE is process-global (jax profiler); set "
+                "it on the global trace settings, not per model",
+                http_status=400,
+            )
     for key in ("trace_rate", "trace_count", "log_frequency"):
         vals = settings.get(key)
         if vals is not None:
@@ -101,17 +111,21 @@ def validate_trace_update(settings: Dict[str, List[str]]) -> None:
 
 
 class TraceContext:
-    """One traced request: collects (name, ns) timestamps, emitted on finish."""
+    """One traced request: collects (name, ns) timestamps, emitted on finish.
+    ``path`` is the trace_file of the scope that sampled this request (a
+    per-model override may point somewhere else than the global file)."""
 
-    __slots__ = ("_tracer", "id", "model_name", "model_version", "timestamps")
+    __slots__ = ("_tracer", "id", "model_name", "model_version",
+                 "timestamps", "path")
 
     def __init__(self, tracer: "RequestTracer", trace_id: int,
-                 model_name: str, model_version: str) -> None:
+                 model_name: str, model_version: str, path: str) -> None:
         self._tracer = tracer
         self.id = trace_id
         self.model_name = model_name
         self.model_version = model_version
         self.timestamps: List[Dict[str, int]] = []
+        self.path = path
 
     def ts(self, name: str, ns: Optional[int] = None) -> None:
         self.timestamps.append(
@@ -144,14 +158,49 @@ class RequestTracer:
         self._file = None      # cached append handle (reopened on path change)
         self._file_path = None
         self._profiling = False
+        # per-model overlays (reference per-model trace settings: a model
+        # may override any key; unset keys inherit the global value); each
+        # override scope samples with its own counters
+        self._model_overrides: Dict[str, Dict[str, List[str]]] = {}
+        self._model_counters: Dict[str, Dict[str, int]] = {}
 
     # -- settings lifecycle ------------------------------------------------
     def settings_updated(self) -> None:
-        """Called by both frontends after applying a settings update."""
+        """Called by both frontends after applying a GLOBAL settings
+        update: a fresh sampling window for the global scope AND for every
+        override scope — a model inheriting the global budget must not
+        keep an exhausted counter across the refresh."""
         with self._lock:
             self._seq = 0
             self._emitted = 0
+            for c in self._model_counters.values():
+                c["seq"] = 0
+                c["emitted"] = 0
         self._sync_profiler()
+
+    def update_model(self, model_name: str,
+                     update: Dict[str, List[str]],
+                     cleared: Optional[List[str]] = None) -> None:
+        """Apply a per-model settings update (already validated): explicit
+        values override the global scope; ``cleared`` keys fall back to
+        inheriting it (reference null-in-model-scope contract)."""
+        with self._lock:
+            ov = self._model_overrides.setdefault(model_name, {})
+            for k in cleared or []:
+                ov.pop(k, None)
+            ov.update(update)
+            if not ov:
+                self._model_overrides.pop(model_name, None)
+            self._model_counters[model_name] = {"seq": 0, "emitted": 0}
+
+    def effective_settings(self, model_name: Optional[str]) -> Dict[str, List[str]]:
+        """The settings scope a model actually traces under (global merged
+        with its overlay) — what per-model GET returns."""
+        with self._lock:
+            eff = {k: list(v) for k, v in self._settings.items()}
+            for k, v in self._model_overrides.get(model_name, {}).items():
+                eff[k] = list(v)
+        return eff
 
     def _sync_profiler(self) -> None:
         want = "PROFILE" in (self._settings.get("trace_level") or [])
@@ -196,33 +245,50 @@ class RequestTracer:
             self._profiling = False
 
     # -- per-request sampling ----------------------------------------------
-    def _trace_file(self) -> str:
-        vals = self._settings.get("trace_file") or ["trace.json"]
+    def _trace_file(self, eff: Optional[Dict[str, List[str]]] = None) -> str:
+        vals = (eff if eff is not None
+                else self._settings).get("trace_file") or ["trace.json"]
         return vals[0] if vals and vals[0] else "trace.json"
 
-    def _int_setting(self, key: str, default: int) -> int:
-        vals = self._settings.get(key)
+    @staticmethod
+    def _eff_int(eff, key, default):
+        vals = eff.get(key)
         try:
             return int(vals[0])
         except (TypeError, ValueError, IndexError):
             return default
 
     def maybe_start(self, model_name: str, model_version: str) -> Optional[TraceContext]:
-        levels = self._settings.get("trace_level") or ["OFF"]
-        if "TIMESTAMPS" not in levels:
-            return None
-        rate = max(1, self._int_setting("trace_rate", 1000))
-        count = self._int_setting("trace_count", -1)
         with self._lock:
-            self._seq += 1
-            if (self._seq - 1) % rate != 0:
+            ov = self._model_overrides.get(model_name)
+            eff = self._settings if ov is None else {**self._settings, **ov}
+            levels = eff.get("trace_level") or ["OFF"]
+            if "TIMESTAMPS" not in levels:
                 return None
-            if count >= 0 and self._emitted >= count:
+            rate = max(1, self._eff_int(eff, "trace_rate", 1000))
+            count = self._eff_int(eff, "trace_count", -1)
+            if ov is None:
+                self._seq += 1
+                seq, emitted = self._seq, self._emitted
+            else:
+                # an override scope samples with its own counters — its
+                # rate/count budget must not be consumed by other models
+                c = self._model_counters.setdefault(
+                    model_name, {"seq": 0, "emitted": 0})
+                c["seq"] += 1
+                seq, emitted = c["seq"], c["emitted"]
+            if (seq - 1) % rate != 0:
                 return None
-            self._emitted += 1
+            if count >= 0 and emitted >= count:
+                return None
+            if ov is None:
+                self._emitted += 1
+            else:
+                c["emitted"] += 1
             self._next_id += 1
             trace_id = self._next_id
-        return TraceContext(self, trace_id, model_name, model_version)
+            path = self._trace_file(eff)
+        return TraceContext(self, trace_id, model_name, model_version, path)
 
     def _emit(self, ctx: TraceContext) -> None:
         line = json.dumps(
@@ -233,7 +299,7 @@ class RequestTracer:
                 "timestamps": ctx.timestamps,
             }
         )
-        path = self._trace_file()
+        path = ctx.path  # the sampling scope's file, not necessarily global
         with self._io_lock:
             try:
                 if self._file is None or self._file_path != path:
