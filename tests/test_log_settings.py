@@ -1,0 +1,138 @@
+"""Active server logging behind the log-settings API.
+
+Settings registered through the client make the server actually emit log
+lines (before r4 the dict was store-and-return-only, the same
+accepted-but-inert pattern the trace API had).  Round-trip of the settings
+dict is covered in the protocol suites; this file asserts the effect.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import triton_client_tpu.http as httpclient
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+from triton_client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+@pytest.fixture()
+def client(server):
+    with httpclient.InferenceServerClient(server.http_url, concurrency=2) as c:
+        yield c
+
+
+@pytest.fixture(autouse=True)
+def _defaults_after(client):
+    yield
+    client.update_log_settings({
+        "log_file": "", "log_info": True, "log_warning": True,
+        "log_error": True, "log_verbose_level": 0, "log_format": "default"})
+
+
+def _simple_inputs():
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(a)
+    inputs[1].set_data_from_numpy(a)
+    return inputs
+
+
+class TestServerLog:
+    def test_load_unload_logged_default_format(self, client, tmp_path):
+        lf = tmp_path / "server.log"
+        client.update_log_settings({"log_file": str(lf)})
+        client.unload_model("identity_fp32")
+        client.load_model("identity_fp32")
+        text = lf.read_text()
+        assert "successfully unloaded model 'identity_fp32'" in text
+        assert "successfully loaded model 'identity_fp32'" in text
+        # default format: level letter + MMDD + wall clock with microseconds
+        assert re.search(r"^I\d{4} \d{2}:\d{2}:\d{2}\.\d{6} ", text, re.M)
+
+    def test_iso8601_format(self, client, tmp_path):
+        lf = tmp_path / "iso.log"
+        client.update_log_settings({"log_file": str(lf),
+                                    "log_format": "ISO8601"})
+        client.unload_model("identity_fp32")
+        client.load_model("identity_fp32")
+        assert re.search(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z I ",
+                         lf.read_text(), re.M)
+
+    def test_log_info_gate_suppresses(self, client, tmp_path):
+        lf = tmp_path / "gated.log"
+        client.update_log_settings({"log_file": str(lf), "log_info": False})
+        client.unload_model("identity_fp32")
+        client.load_model("identity_fp32")
+        assert not lf.exists() or "successfully" not in lf.read_text()
+
+    def test_grpc_requests_logged_too(self, server, client, tmp_path):
+        """Log-settings-driven lines exist on BOTH protocols — an operator
+        tailing the log must see gRPC traffic, not just HTTP."""
+        import time
+
+        import triton_client_tpu.grpc as grpcclient
+
+        lf = tmp_path / "grpc.log"
+        client.update_log_settings({"log_file": str(lf),
+                                    "log_verbose_level": 1})
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            inputs[0].set_data_from_numpy(a)
+            inputs[1].set_data_from_numpy(a)
+            gc.infer("simple", inputs)
+            with pytest.raises(InferenceServerException):
+                gc.infer("nope", inputs)
+        deadline = time.time() + 10  # lines land via the executor
+        while time.time() < deadline:
+            text = lf.read_text() if lf.exists() else ""
+            if ("grpc ModelInfer 'simple' -> OK" in text
+                    and "grpc ModelInfer 'nope' -> 400" in text):
+                break
+            time.sleep(0.05)
+        assert "grpc ModelInfer 'simple' -> OK" in text
+        assert "grpc ModelInfer 'nope' -> 400" in text
+
+    def test_verbose_level_logs_requests(self, client, tmp_path):
+        import time
+
+        lf = tmp_path / "verbose.log"
+        client.update_log_settings({"log_file": str(lf),
+                                    "log_verbose_level": 1})
+        client.infer("simple", _simple_inputs())
+        with pytest.raises(InferenceServerException):
+            client.get_model_metadata("nope")  # 400: verbose line, not error
+        deadline = time.time() + 10  # lines land via the executor
+        while time.time() < deadline:
+            text = lf.read_text() if lf.exists() else ""
+            if ("POST /v2/models/simple/infer -> 200" in text
+                    and "GET /v2/models/nope -> 400" in text):
+                break
+            time.sleep(0.05)
+        assert re.search(r"POST /v2/models/simple/infer -> 200", text)
+        assert re.search(r"GET /v2/models/nope -> 400", text)
+        # verbosity off: requests stop appearing (both prior lines already
+        # confirmed flushed above, so the count is race-free)
+        client.update_log_settings({"log_verbose_level": 0})
+        client.infer("simple", _simple_inputs())
+        time.sleep(0.3)
+        lines = [l for l in lf.read_text().splitlines()
+                 if "/infer -> 200" in l]
+        assert len(lines) == 1

@@ -30,6 +30,7 @@ from ..utils import np_to_triton_dtype, triton_to_np_dtype
 from .model import EnsembleModel, Model, pb_to_datatype
 from .registry import ModelRegistry
 from .shm import SystemShmRegistry, XlaShmRegistry
+from .log import ServerLog
 from .trace import RequestTracer, TRACE_DEFAULTS
 from .types import (
     InferError,
@@ -349,6 +350,7 @@ class InferenceCore:
             "log_format": "default",
         }
         self.tracer = RequestTracer(self.trace_settings)
+        self.log = ServerLog(self.log_settings)
         self._batchers: Dict[str, _DynamicBatcher] = {}
         self._inline_profiles: Dict[str, _InlineProfile] = {}
         self.response_cache = _ResponseCache()
@@ -570,6 +572,10 @@ class InferenceCore:
                 ran[model.name] = await self._warmup_one(model)
             except Exception as e:  # noqa: BLE001 — isolate per-model
                 ran[f"{model.name}:error"] = str(e)
+                # the startup path is where a tailing operator most needs
+                # the reason a model came up absent
+                self.log.error(
+                    f"model '{model.name}' unloaded: warmup failed: {e}")
                 try:
                     self.registry.unload(model.name)
                 except InferError:
@@ -594,14 +600,18 @@ class InferenceCore:
                     self.registry.unload(name)
                 except InferError:
                     pass
+                self.log.error(f"failed to load model '{name}': warmup "
+                               f"failed: {e}")
                 raise InferError(
                     f"failed to load '{name}': warmup failed: {e}",
                     http_status=400)
+        self.log.info(f"successfully loaded model '{name}'")
 
     async def shutdown(self) -> None:
         """Cancel background batcher tasks and fail any queued requests so
         no handler is left awaiting a forever-pending future."""
         self.tracer.shutdown()
+        self.log.shutdown()
         while self._batchers:
             _, b = self._batchers.popitem()
             await self._retire_batcher(b, reason="server is shutting down")

@@ -11,6 +11,7 @@ use it (e.g. the Go generated example).
 
 from __future__ import annotations
 
+import asyncio
 from typing import Any, Dict, Optional
 
 import grpc
@@ -295,6 +296,8 @@ class InferenceServicer:
             self._core.registry.unload(request.model_name, unload_dependents)
         except InferError as e:
             await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        self._core.log.info(
+            f"successfully unloaded model '{request.model_name}'")
         return pb.RepositoryModelUnloadResponse()
 
     # -- shared memory -----------------------------------------------------
@@ -414,12 +417,30 @@ class InferenceServicer:
         return resp
 
     # -- inference ---------------------------------------------------------
+    def _log_off_loop(self, method, *args):
+        # same move as the HTTP frontend: log-settings-driven lines exist
+        # on BOTH protocols, and file appends never block the event loop
+        asyncio.get_running_loop().run_in_executor(None, method, *args)
+
     async def ModelInfer(self, request, context):
         try:
             req = _decode_pb_request(request)
             resp = await self._core.infer(req)
         except InferError as e:
+            if e.http_status >= 500:
+                self._log_off_loop(
+                    self._core.log.error,
+                    f"grpc ModelInfer '{request.model_name}' failed: {e}")
+            elif self._core.log.verbose_enabled():
+                self._log_off_loop(
+                    self._core.log.verbose, 1,
+                    f"grpc ModelInfer '{request.model_name}' -> "
+                    f"{e.http_status}: {e}")
             await context.abort(_grpc_code(e), str(e))
+        if self._core.log.verbose_enabled():
+            self._log_off_loop(
+                self._core.log.verbose, 1,
+                f"grpc ModelInfer '{request.model_name}' -> OK")
         return _encode_pb_response(resp)
 
     async def ModelStreamInfer(self, request_iterator, context):

@@ -111,14 +111,40 @@ def build_metrics_app(core: InferenceCore) -> web.Application:
 
 
 def _h(core: InferenceCore, fn):
+    def _log_off_loop(method, *args):
+        # file appends must not block the event loop (the tracer makes the
+        # same move): only the logging itself rides the executor, the
+        # response does not wait for it
+        asyncio.get_running_loop().run_in_executor(None, method, *args)
+
     async def handler(request: web.Request) -> web.Response:
         try:
-            return await fn(core, request)
+            resp = await fn(core, request)
+            if core.log.verbose_enabled():
+                _log_off_loop(
+                    core.log.verbose, 1,
+                    f"{request.method} {request.path} -> {resp.status}")
+            return resp
         except InferError as e:
+            # 5xx are server-side failures (log_error); 4xx are client
+            # mistakes — verbose only, or every fuzz/validation request
+            # would spam the log
+            if e.http_status >= 500:
+                _log_off_loop(
+                    core.log.error,
+                    f"{request.method} {request.path} failed: {e}")
+            elif core.log.verbose_enabled():
+                _log_off_loop(
+                    core.log.verbose, 1,
+                    f"{request.method} {request.path} -> "
+                    f"{e.http_status}: {e}")
             return web.json_response({"error": str(e)}, status=e.http_status)
         except web.HTTPException:
             raise
         except Exception as e:  # pragma: no cover - defensive
+            _log_off_loop(
+                core.log.error,
+                f"{request.method} {request.path} crashed: {e}")
             return web.json_response({"error": str(e)}, status=500)
 
     return handler
@@ -196,6 +222,7 @@ async def _repo_unload(core, request):
     body = await _read_json(request, default={})
     params = body.get("parameters", {}) or {}
     core.registry.unload(name, unload_dependents=bool(params.get("unload_dependents")))
+    core.log.info(f"successfully unloaded model '{name}'")
     return web.Response(status=200)
 
 

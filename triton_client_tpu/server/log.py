@@ -1,0 +1,127 @@
+"""Server logging behind the log-settings API.
+
+The reference client manages log settings on a server that actually logs:
+``update_log_settings``/``get_log_settings`` configure ``log_file``,
+``log_info``/``log_warning``/``log_error`` gates, ``log_verbose_level`` and
+``log_format`` (reference http/_client.py:867-965), and the Triton server
+emits its log through them.  This module is the server half for the TPU
+harness — before it, the settings dict was store-and-return-only (the same
+accepted-but-inert failure mode the trace API had before r4).
+
+Line shapes follow the reference server:
+
+* ``default``:  ``I0731 12:34:56.789012 model 'simple' loaded``
+  (level letter, MMDD, wall clock with microseconds)
+* ``ISO8601``:  ``2026-07-31T12:34:56Z I model 'simple' loaded``
+
+``log_file`` empty (the default) writes to stderr; a path appends, with
+the handle cached and reopened on change (same pattern as the tracer).
+Verbose lines (``verbose(level, ...)``) emit as info when
+``log_verbose_level`` >= level — the per-request serving path guards on a
+plain int compare, so verbosity off costs one dict lookup.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Dict
+
+_LEVELS = ("info", "warning", "error")
+
+
+class AppendFile:
+    """Cached append handle, reopened when the configured path changes —
+    shared by the server log and the request tracer so the
+    open-on-change/close-on-shutdown/failure-drop state machine exists
+    once.  A failing write must never raise (the request that happened to
+    log/trace must not fail) and must CLOSE the handle before dropping it
+    (dropping without close leaks one fd per attempt against a full disk
+    until accept() dies with EMFILE)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._file = None
+        self._path = None
+
+    def append(self, path: str, data: str) -> None:
+        with self._lock:
+            try:
+                if self._file is None or self._path != path:
+                    self._close_locked()
+                    self._file = open(path, "a")
+                    self._path = path
+                self._file.write(data)
+                self._file.flush()
+            except OSError:
+                self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+            self._path = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
+class ServerLog:
+    """Emits through a live reference to ``InferenceCore.log_settings`` —
+    client updates take effect on the next line without re-plumbing."""
+
+    def __init__(self, settings: Dict[str, Any]) -> None:
+        self._settings = settings
+        self._out = AppendFile()
+
+    # -- public levels -----------------------------------------------------
+    def info(self, msg: str) -> None:
+        self._emit("info", msg)
+
+    def warning(self, msg: str) -> None:
+        self._emit("warning", msg)
+
+    def error(self, msg: str) -> None:
+        self._emit("error", msg)
+
+    def verbose(self, level: int, msg: str) -> None:
+        try:
+            if int(self._settings.get("log_verbose_level", 0)) >= level:
+                self._emit("info", msg)
+        except (TypeError, ValueError):
+            pass
+
+    def verbose_enabled(self, level: int = 1) -> bool:
+        """Cheap hot-path guard so callers skip building the message."""
+        try:
+            return int(self._settings.get("log_verbose_level", 0)) >= level
+        except (TypeError, ValueError):
+            return False
+
+    # -- plumbing ----------------------------------------------------------
+    def _emit(self, level: str, msg: str) -> None:
+        if not bool(self._settings.get(f"log_{level}", True)):
+            return
+        now = time.time()
+        if str(self._settings.get("log_format", "default")) == "ISO8601":
+            stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
+            line = f"{stamp} {level[0].upper()} {msg}\n"
+        else:
+            t = time.localtime(now)
+            us = int((now % 1) * 1e6)
+            line = (f"{level[0].upper()}{t.tm_mon:02d}{t.tm_mday:02d} "
+                    f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}"
+                    f".{us:06d} {msg}\n")
+        path = str(self._settings.get("log_file") or "")
+        if not path:
+            sys.stderr.write(line)
+            return
+        self._out.append(path, line)
+
+    def shutdown(self) -> None:
+        self._out.close()

@@ -147,16 +147,17 @@ class RequestTracer:
     """
 
     def __init__(self, settings: Dict[str, List[str]]) -> None:
+        from .log import AppendFile
+
         self._settings = settings
         self._lock = threading.Lock()      # sampling counters only
-        self._io_lock = threading.Lock()   # trace-file appends — kept separate
-        # so a slow disk never serializes the sampling decision of untraced
+        # trace-file appends use their own lock (inside AppendFile) so a
+        # slow disk never serializes the sampling decision of untraced
         # requests behind a write
+        self._out = AppendFile()
         self._seq = 0          # requests seen since last settings update
         self._emitted = 0      # traces emitted since last settings update
         self._next_id = 0      # file-unique trace id — never reset
-        self._file = None      # cached append handle (reopened on path change)
-        self._file_path = None
         self._profiling = False
         # per-model overlays (reference per-model trace settings: a model
         # may override any key; unset keys inherit the global value); each
@@ -227,14 +228,7 @@ class RequestTracer:
         return self._trace_file() + ".profile"
 
     def shutdown(self) -> None:
-        with self._io_lock:
-            if self._file is not None:
-                try:
-                    self._file.close()
-                except OSError:
-                    pass
-                self._file = None
-                self._file_path = None
+        self._out.close()
         if self._profiling:
             try:
                 import jax
@@ -299,18 +293,7 @@ class RequestTracer:
                 "timestamps": ctx.timestamps,
             }
         )
-        path = ctx.path  # the sampling scope's file, not necessarily global
-        with self._io_lock:
-            try:
-                if self._file is None or self._file_path != path:
-                    if self._file is not None:
-                        self._file.close()
-                    self._file = open(path, "a")
-                    self._file_path = path
-                self._file.write(line + "\n")
-                self._file.flush()
-            except OSError:
-                # An unwritable trace_file must never fail the inference that
-                # happened to be sampled.
-                self._file = None
-                self._file_path = None
+        # ctx.path is the sampling scope's file, not necessarily global;
+        # an unwritable trace_file must never fail the inference that
+        # happened to be sampled (AppendFile swallows OSError)
+        self._out.append(ctx.path, line + "\n")
