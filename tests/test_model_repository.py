@@ -153,3 +153,123 @@ def test_malicious_file_path_rejected(client):
 def test_unknown_model_load_fails(client):
     with pytest.raises(Exception):
         client.load_model("not_in_repo")
+
+
+# -- multi-version serving (ModelVersionPolicy) ----------------------------
+
+ADDER_PY = textwrap.dedent(
+    """
+    import numpy as np
+    from triton_client_tpu.server.model import PyModel
+
+    DELTA = {delta}
+
+
+    def get_model(config):
+        def fn(inputs, params):
+            x = np.asarray(inputs["X"])
+            return {{"Y": (x + DELTA).astype(np.int32)}}
+
+        return PyModel(config, fn)
+    """
+)
+
+ADDER_CONFIG = textwrap.dedent(
+    """
+    name: "adder"
+    backend: "python"
+    input [{ name: "X" data_type: TYPE_INT32 dims: [ 4 ] }]
+    output [{ name: "Y" data_type: TYPE_INT32 dims: [ 4 ] }]
+    """
+)
+
+
+@pytest.fixture()
+def adder_repo(tmp_path):
+    """adder with version dirs 1 (+1) and 3 (+3)."""
+    mdir = tmp_path / "adder"
+    for v in (1, 3):
+        (mdir / str(v)).mkdir(parents=True)
+        (mdir / str(v) / "model.py").write_text(
+            ADDER_PY.format(delta=v))
+    (mdir / "config.pbtxt").write_text(ADDER_CONFIG)
+    return tmp_path, mdir
+
+
+def _adder_harness(repo):
+    registry = ModelRegistry(repository_path=str(repo))
+    return ServerHarness(registry)
+
+
+def _infer_adder(client, version=""):
+    inp = httpclient.InferInput("X", [4], "INT32")
+    inp.set_data_from_numpy(np.asarray([10, 20, 30, 40], np.int32))
+    return client.infer("adder", [inp], model_version=version)
+
+
+class TestVersionPolicy:
+    def test_default_latest_one(self, adder_repo):
+        repo, _ = adder_repo
+        with _adder_harness(repo) as h, \
+                httpclient.InferenceServerClient(h.http_url) as c:
+            c.load_model("adder")
+            # unversioned -> latest (3); version 3 explicit works
+            np.testing.assert_array_equal(
+                _infer_adder(c).as_numpy("Y"), [13, 23, 33, 43])
+            np.testing.assert_array_equal(
+                _infer_adder(c, "3").as_numpy("Y"), [13, 23, 33, 43])
+            # version 1 exists on disk but the default policy (latest 1)
+            # does not serve it
+            with pytest.raises(Exception):
+                _infer_adder(c, "1")
+            assert c.is_model_ready("adder", "3")
+            assert not c.is_model_ready("adder", "1")
+
+    def test_policy_all_serves_both(self, adder_repo):
+        repo, mdir = adder_repo
+        (mdir / "config.pbtxt").write_text(
+            ADDER_CONFIG + '\nversion_policy { all {} }\n')
+        with _adder_harness(repo) as h, \
+                httpclient.InferenceServerClient(h.http_url) as c:
+            c.load_model("adder")
+            np.testing.assert_array_equal(
+                _infer_adder(c, "1").as_numpy("Y"), [11, 21, 31, 41])
+            np.testing.assert_array_equal(
+                _infer_adder(c, "3").as_numpy("Y"), [13, 23, 33, 43])
+            # unversioned routes to the latest
+            np.testing.assert_array_equal(
+                _infer_adder(c).as_numpy("Y"), [13, 23, 33, 43])
+            client_md = c.get_model_metadata("adder")
+            assert client_md["versions"] == ["1", "3"]
+            index = [m for m in c.get_model_repository_index()
+                     if m["name"] == "adder"]
+            assert sorted(m["version"] for m in index) == ["1", "3"]
+            # per-version statistics report under their own version, and
+            # the unversioned name-scoped query returns EVERY version
+            stats = c.get_inference_statistics("adder", "1")
+            assert stats["model_stats"][0]["version"] == "1"
+            both = c.get_inference_statistics("adder")
+            assert sorted(m["version"] for m in both["model_stats"]) \
+                == ["1", "3"]
+
+    def test_policy_specific(self, adder_repo):
+        repo, mdir = adder_repo
+        (mdir / "config.pbtxt").write_text(
+            ADDER_CONFIG + '\nversion_policy { specific { versions: [1] } }\n')
+        with _adder_harness(repo) as h, \
+                httpclient.InferenceServerClient(h.http_url) as c:
+            c.load_model("adder")
+            # only version 1 serves, and unversioned resolves to it
+            np.testing.assert_array_equal(
+                _infer_adder(c).as_numpy("Y"), [11, 21, 31, 41])
+            with pytest.raises(Exception):
+                _infer_adder(c, "3")
+
+    def test_policy_specific_missing_version_fails_load(self, adder_repo):
+        repo, mdir = adder_repo
+        (mdir / "config.pbtxt").write_text(
+            ADDER_CONFIG + '\nversion_policy { specific { versions: [7] } }\n')
+        with _adder_harness(repo) as h, \
+                httpclient.InferenceServerClient(h.http_url) as c:
+            with pytest.raises(Exception, match="7"):
+                c.load_model("adder")

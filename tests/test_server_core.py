@@ -380,7 +380,7 @@ class TestInlineFastPath:
                 for _ in range(4):
                     res = client.infer("simple", [i0, i1])
                 np.testing.assert_array_equal(res.as_numpy("OUTPUT0"), a + a)
-            prof = h.core._inline_profiles.get("simple")
+            prof = h.core._inline_profiles.get("simple@1")
             assert prof is not None and prof.ema
             # host-placed sub-ms model must have earned the inline path
             assert prof.allows(tuple(sorted(
@@ -419,13 +419,13 @@ class TestReloadInvalidation:
                 i1.set_data_from_numpy(a)
                 for _ in range(3):
                     client.infer("simple", [i0, i1])
-                warm = h.core._inline_profiles["simple"]
+                warm = h.core._inline_profiles["simple@1"]
                 assert warm.ema
                 client.unload_model("simple")
                 client.load_model("simple")
                 res = client.infer("simple", [i0, i1])
                 np.testing.assert_array_equal(res.as_numpy("OUTPUT0"), a + a)
-                fresh = h.core._inline_profiles["simple"]
+                fresh = h.core._inline_profiles["simple@1"]
                 # reloaded instance: old EMA forgotten, first exec off-loop
                 assert fresh is not warm
 
@@ -442,10 +442,10 @@ class TestReloadInvalidation:
                 inp = httpclient.InferInput("INPUT", [1, 512], "FP32")
                 inp.set_data_from_numpy(x)
                 client.infer("dense_tpu", [inp])
-                old = h.core._batchers.get("dense_tpu")
+                old = h.core._batchers.get("dense_tpu@1")
                 assert old is not None
                 client.unload_model("dense_tpu")
                 client.load_model("dense_tpu")
                 res = client.infer("dense_tpu", [inp])
                 assert res.as_numpy("OUTPUT").shape == (1, 512)
-                assert h.core._batchers.get("dense_tpu") is not old
+                assert h.core._batchers.get("dense_tpu@1") is not old

@@ -517,7 +517,7 @@ class InferenceCore:
         await producer
         model.stats.record(1, 0, time.monotonic_ns() - t0, ok=True)
         final = InferResponse(
-            model_name=model.name, model_version="1", id=request.id
+            model_name=model.name, model_version=model.served_version, id=request.id
         )
         final.parameters["triton_final_response"] = True
         yield final
@@ -565,13 +565,17 @@ class InferenceCore:
         fails the model, not the server) and reports it under
         ``"<name>:error"``; serving proceeds for everything else."""
         ran: Dict[str, Any] = {}
-        for model in self.registry.ready_models():
+        for model in self.registry.all_version_models():
             if not model.config.model_warmup:
                 continue
+            if not self.registry.is_ready(model.name, model.served_version):
+                continue  # a sibling version's failure unloaded the name
+            key = (model.name if model.versions == ["1"]
+                   else f"{model.name}/{model.served_version}")
             try:
-                ran[model.name] = await self._warmup_one(model)
+                ran[key] = await self._warmup_one(model)
             except Exception as e:  # noqa: BLE001 — isolate per-model
-                ran[f"{model.name}:error"] = str(e)
+                ran[f"{key}:error"] = str(e)
                 # the startup path is where a tailing operator most needs
                 # the reason a model came up absent
                 self.log.error(
@@ -584,15 +588,17 @@ class InferenceCore:
 
     async def load_model(self, name: str, config_override=None,
                          files=None) -> None:
-        """Repository-API load: registry swap off the event loop, then the
-        fresh instance's warmup samples (Triton runs warmup at every load,
-        not just server start).  A failing warmup fails the load."""
+        """Repository-API load: registry swap off the event loop, then
+        every fresh version's warmup samples (Triton runs warmup at every
+        load, not just server start).  A failing warmup fails the load."""
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(
             None, lambda: self.registry.load(
                 name, config_override=config_override, files=files))
-        model = self.registry.get(name)
-        if model.config.model_warmup:
+        self.retire_name_caches(name)
+        for model in self.registry.version_models(name):
+            if not model.config.model_warmup:
+                continue
             try:
                 await self._warmup_one(model)
             except Exception as e:  # noqa: BLE001 — surface as load failure
@@ -607,6 +613,25 @@ class InferenceCore:
                     http_status=400)
         self.log.info(f"successfully loaded model '{name}'")
 
+    def retire_name_caches(self, name: str) -> None:
+        """Drop stale per-version batchers/inline-profiles for ``name``.
+
+        The generation check in ``_batcher`` only runs when a key is
+        re-accessed; a version dropped by a policy change on reload (or an
+        unload) would otherwise keep its pump task and retired Model alive
+        for the server's lifetime."""
+        gen = self.registry.generation(name)
+        prefix = f"{name}@"
+        for key in [k for k in self._batchers if k.startswith(prefix)]:
+            b = self._batchers[key]
+            if b.generation != gen:
+                self._batchers.pop(key)
+                asyncio.ensure_future(self._retire_batcher(b))
+        for key in [k for k in self._inline_profiles
+                    if k.startswith(prefix)]:
+            if self._inline_profiles[key].generation != gen:
+                self._inline_profiles.pop(key)
+
     async def shutdown(self) -> None:
         """Cancel background batcher tasks and fail any queued requests so
         no handler is left awaiting a forever-pending future."""
@@ -618,19 +643,20 @@ class InferenceCore:
 
     def _batcher(self, model: Model) -> _DynamicBatcher:
         gen = self.registry.generation(model.name)
-        b = self._batchers.get(model.name)
+        key = f"{model.name}@{model.served_version}"  # versions never share
+        b = self._batchers.get(key)
         if b is not None and b.generation != gen:
             # the model instance behind this name was swapped (reload /
             # config override): retire the old batcher — its queue drains
             # through the shutdown path so no request hangs — and build a
             # fresh one bound to the current instance
-            self._batchers.pop(model.name)
+            self._batchers.pop(key)
             asyncio.ensure_future(self._retire_batcher(b))
             b = None
         if b is None:
             b = _DynamicBatcher(self, model)
             b.generation = gen
-            self._batchers[model.name] = b
+            self._batchers[key] = b
         return b
 
     async def _retire_batcher(
@@ -693,12 +719,13 @@ class InferenceCore:
         if keep_device is not None and not keep_device \
                 and self._host_placed(model):
             gen = self.registry.generation(model.name)
-            prof = self._inline_profiles.get(model.name)
+            prof_key = f"{model.name}@{model.served_version}"
+            prof = self._inline_profiles.get(prof_key)
             if prof is None or prof.generation != gen:
                 # reloaded instance: forget the old record so its first
                 # execution (a potential XLA compile) never runs inline
                 prof = _InlineProfile(generation=gen)
-                self._inline_profiles[model.name] = prof
+                self._inline_profiles[prof_key] = prof
             sig = tuple(sorted(
                 (n, getattr(v, "shape", None), str(getattr(v, "dtype", "")))
                 for n, v in inputs.items()))
@@ -887,7 +914,7 @@ class InferenceCore:
         self, model: Model, request: InferRequest, outputs: Dict[str, Any]
     ) -> InferResponse:
         requested = {o.name: o for o in request.outputs}
-        resp = InferResponse(model_name=model.name, model_version="1", id=request.id)
+        resp = InferResponse(model_name=model.name, model_version=model.served_version, id=request.id)
         cfg_outputs = [o.name for o in model.config.output]
         names = list(requested) if requested else cfg_outputs
         for name in names:
@@ -962,7 +989,15 @@ class InferenceCore:
         }
 
     def statistics(self, name: Optional[str], version: str = "") -> List[dict]:
-        models = [self.registry.get(name, version)] if name else self.registry.ready_models()
+        if name and version:
+            models = [self.registry.get(name, version)]
+        elif name:
+            # unversioned name-scoped query reports EVERY served version
+            # (Triton semantics) — not just the latest
+            self.registry.get(name)  # unknown name -> 400
+            models = self.registry.version_models(name)
+        else:
+            models = self.registry.all_version_models()
         out = []
         for m in models:
             s = m.stats
@@ -970,7 +1005,7 @@ class InferenceCore:
                 out.append(
                     {
                         "name": m.name,
-                        "version": "1",
+                        "version": m.served_version,
                         "last_inference": s.last_inference_ms,
                         "inference_count": s.inference_count,
                         "execution_count": s.execution_count,

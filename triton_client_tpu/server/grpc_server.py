@@ -296,6 +296,7 @@ class InferenceServicer:
             self._core.registry.unload(request.model_name, unload_dependents)
         except InferError as e:
             await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        self._core.retire_name_caches(request.model_name)
         self._core.log.info(
             f"successfully unloaded model '{request.model_name}'")
         return pb.RepositoryModelUnloadResponse()

@@ -222,6 +222,7 @@ async def _repo_unload(core, request):
     body = await _read_json(request, default={})
     params = body.get("parameters", {}) or {}
     core.registry.unload(name, unload_dependents=bool(params.get("unload_dependents")))
+    core.retire_name_caches(name)
     core.log.info(f"successfully unloaded model '{name}'")
     return web.Response(status=200)
 

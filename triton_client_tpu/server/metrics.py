@@ -44,7 +44,7 @@ def _escape_label(value: str) -> str:
 def render_prometheus(core: InferenceCore) -> str:
     """All per-model counters in the Prometheus text exposition format."""
     rows = {key: [] for _, _, key in _METRICS}
-    for m in core.registry.ready_models():
+    for m in core.registry.all_version_models():
         s = m.stats
         with s.lock:
             values = {
@@ -56,7 +56,8 @@ def render_prometheus(core: InferenceCore) -> str:
                 "queue_us": s.queue_ns // 1000,
                 "infer_us": s.infer_ns // 1000,
             }
-        labels = f'model="{_escape_label(m.name)}",version="1"'
+        labels = (f'model="{_escape_label(m.name)}",'
+                  f'version="{_escape_label(m.served_version)}"')
         for key, value in values.items():
             rows[key].append(f"{{{labels}}} {value}")
 

@@ -191,9 +191,16 @@ class Model(abc.ABC):
     def name(self) -> str:
         return self.config.name
 
+    #: version number this instance serves (the registry stamps it when a
+    #: repository model declares numbered version directories)
+    served_version: str = "1"
+
     @property
     def versions(self) -> List[str]:
-        return ["1"]
+        """Every version served under this model's name (the registry
+        stamps the list on each loaded instance; programmatic models serve
+        a single '1')."""
+        return list(getattr(self, "_version_list", ("1",)))
 
     @property
     def decoupled(self) -> bool:

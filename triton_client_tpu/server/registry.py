@@ -31,7 +31,9 @@ class ModelRegistry:
     def __init__(self, repository_path: Optional[str] = None):
         self._factories: Dict[str, Callable[[], Model]] = {}
         self._original_configs: Dict[str, bytes] = {}
-        self._models: Dict[str, Model] = {}
+        self._models: Dict[str, Model] = {}  # name -> DEFAULT (latest) version
+        # name -> {version string -> Model}; programmatic models serve {"1"}
+        self._version_sets: Dict[str, Dict[str, Model]] = {}
         self._states: Dict[str, tuple] = {}  # name -> (state, reason)
         # bumped on every load/unload so per-model caches keyed on the name
         # (batchers, inline-execution profiles) can detect a swapped instance
@@ -61,6 +63,7 @@ class ModelRegistry:
             # reload restores it (Triton semantics: load re-reads the repo).
             self._original_configs[model.name] = model.config.SerializeToString()
             self._models[model.name] = model
+            self._version_sets[model.name] = {"1": model}
             self._states[model.name] = ("READY", "")
             self._generations[model.name] = self._generations.get(model.name, 0) + 1
 
@@ -78,14 +81,21 @@ class ModelRegistry:
                             cfg = pb.ModelConfig()
                             cfg.ParseFromString(orig)
                             model.config = cfg
+                    vset = {"1": model}
                 elif self._repository_path or files:
-                    model = self._load_from_directory(name, config_override, files)
+                    model, vset = self._load_from_directory(
+                        name, config_override, files)
                 else:
                     raise InferError(f"failed to load '{name}': model not found")
             except InferError:
                 self._states[name] = ("UNAVAILABLE", "load failed")
                 raise
+            version_list = sorted(vset, key=int)
+            for v, m in vset.items():
+                m.served_version = v
+                m._version_list = version_list
             self._models[name] = model
+            self._version_sets[name] = vset
             self._states[name] = ("READY", "")
             self._generations[name] = self._generations.get(name, 0) + 1
 
@@ -94,7 +104,8 @@ class ModelRegistry:
             model = self._models.pop(name, None)
             if model is None:
                 raise InferError(f"failed to unload '{name}': model is not loaded")
-            model.unload()
+            for m in self._version_sets.pop(name, {"_": model}).values():
+                m.unload()
             self._states[name] = ("UNAVAILABLE", "unloaded")
             self._generations[name] = self._generations.get(name, 0) + 1
             if unload_dependents and model.config.HasField("ensemble_scheduling"):
@@ -109,25 +120,32 @@ class ModelRegistry:
                 state, reason = self._states[name]
                 if ready_only and state != "READY":
                     continue
-                entry = {"name": name, "version": "1", "state": state}
-                if reason:
-                    entry["reason"] = reason
-                out.append(entry)
+                versions = sorted(self._version_sets.get(name, {"1": None}),
+                                  key=int)
+                for v in versions:  # one index row per served version
+                    entry = {"name": name, "version": v, "state": state}
+                    if reason:
+                        entry["reason"] = reason
+                    out.append(entry)
             return out
 
     def get(self, name: str, version: str = "") -> Model:
         with self._lock:
             model = self._models.get(name)
+            vset = self._version_sets.get(name)
         if model is None:
             raise InferError(
                 f"Request for unknown model: '{name}' is not found", http_status=400
             )
-        if version and version not in model.versions:
-            raise InferError(
-                f"Request for unknown model: '{name}' version {version} is not found",
-                http_status=400,
-            )
-        return model
+        if version:
+            m = (vset or {}).get(version)
+            if m is None:
+                raise InferError(
+                    f"Request for unknown model: '{name}' version {version} is not found",
+                    http_status=400,
+                )
+            return m
+        return model  # unversioned -> the policy's latest
 
     def generation(self, name: str) -> int:
         """Monotonic per-name counter; changes whenever the served instance
@@ -138,11 +156,29 @@ class ModelRegistry:
     def is_ready(self, name: str, version: str = "") -> bool:
         with self._lock:
             model = self._models.get(name)
-        return model is not None and (not version or version in model.versions)
+            vset = self._version_sets.get(name) or {}
+        return model is not None and (not version or version in vset)
 
     def ready_models(self) -> List[Model]:
+        """One (default/latest) instance per ready name."""
         with self._lock:
             return list(self._models.values())
+
+    def all_version_models(self) -> List[Model]:
+        """Every served version instance (warmup, statistics, metrics —
+        surfaces that report or touch each version separately)."""
+        with self._lock:
+            return [m for vs in self._version_sets.values()
+                    for m in vs.values()]
+
+    def version_models(self, name: str) -> List[Model]:
+        """Every served version of one name, ascending."""
+        with self._lock:
+            vset = self._version_sets.get(name)
+            if vset:
+                return [vset[v] for v in sorted(vset, key=int)]
+            m = self._models.get(name)
+            return [m] if m is not None else []
 
     # -- directory loading --------------------------------------------------
     def _load_from_directory(self, name: str, config_override, files) -> Model:
@@ -183,18 +219,70 @@ class ModelRegistry:
             if not config.name:
                 config.name = name
 
-        impl_path = os.path.join(model_dir, "1", "model.py")
-        if not os.path.exists(impl_path):
+        # numbered version directories (Triton layout: <model>/<N>/model.py)
+        available = sorted(
+            int(d) for d in os.listdir(model_dir)
+            if d.isdigit() and os.path.exists(
+                os.path.join(model_dir, d, "model.py")))
+        if not available:
             raise InferError(f"failed to load '{name}': missing 1/model.py")
-        spec = importlib.util.spec_from_file_location(f"tc_tpu_models.{name}", impl_path)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        if not hasattr(mod, "get_model"):
-            raise InferError(f"failed to load '{name}': model.py lacks get_model(config)")
-        model = mod.get_model(config)
-        # warmup input_data_file samples resolve against <model_dir>/warmup/
-        model.model_dir = model_dir
-        return model
+        chosen = _apply_version_policy(name, config, available)
+
+        def load_version(v: int) -> Model:
+            impl_path = os.path.join(model_dir, str(v), "model.py")
+            spec = importlib.util.spec_from_file_location(
+                f"tc_tpu_models.{name}.v{v}", impl_path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            if not hasattr(mod, "get_model"):
+                raise InferError(
+                    f"failed to load '{name}' version {v}: model.py lacks "
+                    "get_model(config)")
+            cfg_v = pb.ModelConfig()
+            cfg_v.CopyFrom(config)  # get_model may mutate its config
+            model = mod.get_model(cfg_v)
+            # warmup input_data_file samples resolve against <model_dir>/warmup/
+            model.model_dir = model_dir
+            return model
+
+        vset: Dict[str, Model] = {}
+        try:
+            for v in chosen:
+                vset[str(v)] = load_version(v)
+        except Exception:
+            # a later version failing must not leak the instances (and any
+            # device memory) earlier versions already constructed
+            for m in vset.values():
+                try:
+                    m.unload()
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            raise
+        return vset[str(max(chosen))], vset
+
+
+def _apply_version_policy(name: str, config: pb.ModelConfig,
+                          available: List[int]) -> List[int]:
+    """Which of the repository's numbered versions get served
+    (``ModelVersionPolicy``: latest{n} default 1 / all / specific{..})."""
+    which = config.version_policy.WhichOneof("policy_choice")
+    if which == "all":
+        return available
+    if which == "specific":
+        wanted = sorted(int(v) for v in config.version_policy.specific.versions)
+        missing = [v for v in wanted if v not in available]
+        if missing:
+            raise InferError(
+                f"failed to load '{name}': version_policy requests "
+                f"version(s) {missing} not present in the repository")
+        if not wanted:
+            raise InferError(
+                f"failed to load '{name}': version_policy specific lists "
+                "no versions")
+        return wanted
+    n = (config.version_policy.latest.num_versions
+         if which == "latest" else 0) or 1
+    return available[-n:]
 
 
 def _parse_config_json(config_json: str, name: str) -> pb.ModelConfig:
