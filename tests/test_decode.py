@@ -99,7 +99,7 @@ class TestSlotKernels:
         k, v = self._slot_cache()
         for slot in range(2):
             toks = jnp.asarray(rng.integers(0, 64, (1, self.P)), jnp.int32)
-            nxt, best, k, v = sprefill(params, k, v, toks, slot)
+            nxt, best, _lp, k, v = sprefill(params, k, v, toks, slot)
             want_logits, want_cache = prefill(params, toks)
             assert int(nxt) == int(jnp.argmax(want_logits, axis=-1)[0])
             np.testing.assert_allclose(
@@ -133,8 +133,8 @@ class TestSlotKernels:
         sprefill = decode.make_slot_prefill(CFG)
         sstep = decode.make_slot_step(CFG)
         k, v = self._slot_cache()
-        ta, _, k, v = sprefill(params, k, v, win_a, 0)
-        tb, _, k, v = sprefill(params, k, v, win_b, 1)
+        ta, _, _, k, v = sprefill(params, k, v, win_a, 0)
+        tb, _, _, k, v = sprefill(params, k, v, win_b, 1)
         got_a, got_b = [int(ta)], [int(tb)]
         pos = np.array([self.P, self.P, 0], np.int32)
         for tick in range(3):
@@ -147,7 +147,7 @@ class TestSlotKernels:
                 tokens[1] = got_b[-1]
                 active[1] = True
             prev = jnp.zeros(self.N_SLOTS, jnp.int32)
-            nxt, best, k, v = sstep(params, k, v, jnp.asarray(tokens), prev,
+            nxt, best, _lp, k, v = sstep(params, k, v, jnp.asarray(tokens), prev,
                                     jnp.asarray(pos), jnp.asarray(active),
                                     jnp.zeros(self.N_SLOTS, bool))
             got_a.append(int(nxt[0]))
@@ -374,14 +374,14 @@ class TestMoeDecode:
                  MOE_CFG.head_dim)
         k = jnp.zeros(shape, MOE_CFG.dtype)
         v = jnp.zeros(shape, MOE_CFG.dtype)
-        nxt, best, k, v = slot_prefill(moe_params, k, v, prompt, 0)
+        nxt, best, _lp, k, v = slot_prefill(moe_params, k, v, prompt, 0)
         got = [int(nxt)]
         pos = np.array([6, 0], np.int32)
         toks = np.zeros(n_slots, np.int32)
         act = np.array([True, False])
         for _ in range(3):
             toks[0] = got[-1]
-            nxts, bests, k, v = slot_step(
+            nxts, bests, _lps, k, v = slot_step(
                 moe_params, k, v, jnp.asarray(toks),
                 jnp.zeros(n_slots, jnp.int32), jnp.asarray(pos),
                 jnp.asarray(act), jnp.zeros(n_slots, bool))
@@ -506,14 +506,14 @@ class TestChunkedPrefill:
         full = decode.make_slot_prefill(CFG)
         k0 = jnp.zeros(shape, CFG.dtype)
         v0 = jnp.zeros(shape, CFG.dtype)
-        want_tok, want_best, want_k, want_v = full(params, k0, v0, prompt,
+        want_tok, want_best, _want_lp, want_k, want_v = full(params, k0, v0, prompt,
                                                   slot)
 
         cp = decode.make_slot_chunk_prefill(CFG, S_MAX)
         k = jnp.zeros(shape, CFG.dtype)
         v = jnp.zeros(shape, CFG.dtype)
         for pos0 in range(0, 16, chunk):
-            tok, best, k, v = cp(params, k, v,
+            tok, best, _lp, k, v = cp(params, k, v,
                                  prompt[:, pos0:pos0 + chunk], slot, pos0)
         assert int(tok) == int(want_tok)
         np.testing.assert_allclose(float(best), float(want_best),
@@ -550,24 +550,24 @@ class TestChunkedPrefill:
         cp = decode.make_slot_chunk_prefill(CFG, S_MAX)
         k = jnp.zeros(shape, CFG.dtype)
         v = jnp.zeros(shape, CFG.dtype)
-        ta, _, k, v = sprefill(params, k, v, win_a, 0)
+        ta, _, _, k, v = sprefill(params, k, v, win_a, 0)
         pos = np.array([8, 0], np.int32)
         # chunk 0 of B's prefill into slot 1...
-        _, _, k, v = cp(params, k, v, win_b[:, :4], 1, 0)
+        _, _, _, k, v = cp(params, k, v, win_b[:, :4], 1, 0)
         # ...then A ticks while B is mid-prefill (B inactive, pos[1]=0)
-        nxt, _, k, v = sstep(params, k, v,
+        nxt, _, _, k, v = sstep(params, k, v,
                              jnp.asarray(np.array([int(ta), 0], np.int32)),
                              jnp.zeros(2, jnp.int32), jnp.asarray(pos),
                              jnp.asarray(np.array([True, False])),
                              jnp.zeros(2, bool))
         pos[0] += 1
         # B's final chunk, then B decodes
-        tb, _, k, v = cp(params, k, v, win_b[:, 4:], 1, 4)
+        tb, _, _, k, v = cp(params, k, v, win_b[:, 4:], 1, 4)
         got_b = [int(tb)]
         pos[1] = 8
         for _ in range(2):
             toks = np.array([int(nxt[0]), got_b[-1]], np.int32)
-            nxt, _, k, v = sstep(params, k, v, jnp.asarray(toks),
+            nxt, _, _, k, v = sstep(params, k, v, jnp.asarray(toks),
                                  jnp.zeros(2, jnp.int32), jnp.asarray(pos),
                                  jnp.asarray(np.array([True, True])),
                                  jnp.zeros(2, bool))
@@ -583,7 +583,7 @@ class TestChunkedPrefill:
         cp = decode.make_slot_chunk_prefill(CFG, S_MAX)
         k = jnp.ones(shape, CFG.dtype)
         v = jnp.ones(shape, CFG.dtype)
-        _, _, k, v = cp(params, k, v, prompt, 1, 0)
+        _, _, _, k, v = cp(params, k, v, prompt, 1, 0)
         np.testing.assert_array_equal(np.asarray(k[:, 0], np.float32), 1.0)
         np.testing.assert_array_equal(np.asarray(v[:, 0], np.float32), 1.0)
 

@@ -180,6 +180,25 @@ class TestGenerateStream:
         assert len(toks) == 3
 
 
+class TestLogprobs:
+    def test_frames_carry_chosen_token_logprob(self, server):
+        """Every generate frame reports the chosen token's logprob under
+        the raw-logit softmax; greedy logprob is the distribution's max,
+        so it must be finite, <= 0, and the same when replayed."""
+        with _post(server.http_url,
+                   "/v2/models/llama_generate/generate_stream",
+                   {"text_input": "logprob me", "max_tokens": 4}) as resp:
+            frames = _sse_frames(resp)
+        lps = [f["logprob"] for f in frames]
+        assert len(lps) == 4
+        assert all(np.isfinite(lp) and lp <= 0.0 for lp in lps)
+        with _post(server.http_url,
+                   "/v2/models/llama_generate/generate_stream",
+                   {"text_input": "logprob me", "max_tokens": 4}) as resp:
+            again = [f["logprob"] for f in _sse_frames(resp)]
+        np.testing.assert_allclose(lps, again, rtol=1e-6)
+
+
 class TestSampling:
     def _stream(self, server, body):
         with _post(server.http_url,

@@ -263,6 +263,41 @@ class TestCompatEdges:
                 outs.add(json.loads(r.read())["choices"][0]["text"])
         assert len(outs) > 1  # greedy no-op would give one identical text
 
+    def test_logprobs_non_streaming(self, server):
+        with _post(server.http_url, "/v1/completions", {
+            "model": "llama_generate", "prompt": "lp", "max_tokens": 4,
+            "logprobs": True,
+        }) as r:
+            out = json.loads(r.read())
+        lp = out["choices"][0]["logprobs"]
+        text = out["choices"][0]["text"]
+        assert lp["tokens"] == list(text)
+        assert len(lp["token_logprobs"]) == len(text)
+        assert all(v <= 0.0 for v in lp["token_logprobs"])
+        assert lp["text_offset"][0] == 0
+        # chat shape
+        with _post(server.http_url, "/v1/chat/completions", {
+            "model": "llama_generate",
+            "messages": [{"role": "user", "content": "lp"}],
+            "max_tokens": 3, "logprobs": True,
+        }) as r:
+            out = json.loads(r.read())
+        content = out["choices"][0]["logprobs"]["content"]
+        assert len(content) == len(out["choices"][0]["message"]["content"])
+        # strict SDK parsers require bytes + top_logprobs on every entry
+        assert all("logprob" in e and "token" in e and "bytes" in e
+                   and e["top_logprobs"] == [] for e in content)
+
+    def test_logprobs_rejections(self, server):
+        for extra in ({"logprobs": True, "stream": True},
+                      {"logprobs": 5},  # alternatives unsupported, loudly
+                      {"logprobs": "yes"},
+                      {"top_logprobs": 3}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.http_url, "/v1/completions",
+                      {"model": "llama_generate", "prompt": "x", **extra})
+            assert e.value.code == 400, extra
+
     def test_top_p_sampling(self, server):
         # seeded nucleus sampling is reproducible; invalid values 400
         def run():

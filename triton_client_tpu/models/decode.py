@@ -462,6 +462,17 @@ def _cache_seq_len(c) -> int:
     return (c["q"] if isinstance(c, dict) else c).shape[-2]
 
 
+def _greedy_head(logits):
+    """Greedy head shared by the slot kernels: f32 cast, argmax token, max
+    logit, and the token's log-probability under the raw-logit softmax
+    (one definition so step/prefill/chunk can never drift apart)."""
+    l32 = logits.astype(jnp.float32)
+    nxt = jnp.argmax(l32, axis=-1).astype(jnp.int32)
+    best = jnp.max(l32, axis=-1).astype(jnp.float32)
+    lp = best - jax.nn.logsumexp(l32, axis=-1)
+    return nxt, best, lp
+
+
 def _slot_decode_layer(blk, x, kc, vc, pos, active,
                        cfg: tr.TransformerConfig):
     """One token per slot, each at its own position.
@@ -526,9 +537,8 @@ def make_slot_step(cfg: tr.TransformerConfig):
 
         x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
         logits = _head(params, x, cfg)[:, -1]                     # [B, V]
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        best = jnp.max(logits, axis=-1).astype(jnp.float32)
-        return nxt, best, ks, vs
+        nxt, best, lp = _greedy_head(logits)
+        return nxt, best, lp, ks, vs
 
     return step
 
@@ -559,9 +569,8 @@ def make_slot_prefill(cfg: tr.TransformerConfig):
         k = _cache_block_write(k, ks, (0, slot, 0, 0), (0, slot, 0, 0, 0))
         v = _cache_block_write(v, vs, (0, slot, 0, 0), (0, slot, 0, 0, 0))
         logits = _head(params, x, cfg)[:, -1]
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
-        best = jnp.max(logits, axis=-1).astype(jnp.float32)[0]
-        return nxt, best, k, v
+        nxt, best, lp = _greedy_head(logits)
+        return nxt[0], best[0], lp[0], k, v
 
     return prefill
 
@@ -610,9 +619,8 @@ def make_slot_chunk_prefill(cfg: tr.TransformerConfig, s_max: int):
 
         x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
         logits = _head(params, x, cfg)[:, -1]
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
-        best = jnp.max(logits, axis=-1).astype(jnp.float32)[0]
-        return nxt, best, ks, vs
+        nxt, best, lp = _greedy_head(logits)
+        return nxt[0], best[0], lp[0], ks, vs
 
     return chunk_prefill
 
@@ -985,12 +993,12 @@ class DecodeModel:
                 self._gen_reader.submit(info["sink"].put, err)
             self._auto_slots.clear()
 
-        def finish_prefill(slot, gen, win_len, nxt_dev, best_dev,
+        def finish_prefill(slot, gen, win_len, nxt_dev, best_dev, lp_dev,
                            completion):
             """Prefill finished: deliver the first token.  Sequence path
-            resolves the client future; generation path streams the token,
-            seeds the device-side feedback for tick 1, and registers the
-            slot as self-feeding."""
+            resolves the client future; generation path streams the token
+            (with its logprob), seeds the device-side feedback for tick 1,
+            and registers the slot as self-feeding."""
             self._pos[slot] = win_len
             if completion[0] == "fut":
                 pair = jnp.stack([nxt_dev.astype(jnp.float32), best_dev])
@@ -1004,9 +1012,10 @@ class DecodeModel:
             _tag, n_tokens, sink = completion
             b, li = self._slot_bucket(slot)
             self._prev_nxt[b] = self._prev_nxt[b].at[li].set(nxt_dev)
-            if hasattr(nxt_dev, "copy_to_host_async"):
-                nxt_dev.copy_to_host_async()
-            self._gen_reader.submit(self._resolve_gen_token, nxt_dev,
+            pair = jnp.stack([nxt_dev.astype(jnp.float32), lp_dev])
+            if hasattr(pair, "copy_to_host_async"):
+                pair.copy_to_host_async()
+            self._gen_reader.submit(self._resolve_gen_token, pair,
                                     sink, n_tokens == 1, slot, gen)
             if n_tokens > 1:
                 self._auto_slots[slot] = {
@@ -1091,16 +1100,16 @@ class DecodeModel:
                         # chunked: run the first chunk now, re-enqueue the
                         # continuation at the queue tail so pending decode
                         # steps tick in between (no cohort-wide stall)
-                        _, _, self._k[b], self._v[b] = self._chunk_fn(
+                        _, _, _, self._k[b], self._v[b] = self._chunk_fn(
                             params, self._k[b], self._v[b],
                             jnp.asarray(win[:, :C]), li, 0)
                         self._jobs.put(("prefill_cont",
                                         (slot, gen, win, C, completion),
                                         None))
                         continue
-                    nxt, best, self._k[b], self._v[b] = prefill(
+                    nxt, best, lp, self._k[b], self._v[b] = prefill(
                         params, self._k[b], self._v[b], jnp.asarray(win), li)
-                    finish_prefill(slot, gen, win.shape[1], nxt, best,
+                    finish_prefill(slot, gen, win.shape[1], nxt, best, lp,
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
                     deliver_error(completion, e)
@@ -1119,7 +1128,7 @@ class DecodeModel:
                 C = self._prefill_chunk
                 b, li = self._slot_bucket(slot)
                 try:
-                    nxt, best, self._k[b], self._v[b] = self._chunk_fn(
+                    nxt, best, lp, self._k[b], self._v[b] = self._chunk_fn(
                         params, self._k[b], self._v[b],
                         jnp.asarray(win[:, pos0:pos0 + C]), li, pos0)
                     if pos0 + C < win.shape[1]:
@@ -1127,7 +1136,7 @@ class DecodeModel:
                                         (slot, gen, win, pos0 + C,
                                          completion), None))
                         continue
-                    finish_prefill(slot, gen, win.shape[1], nxt, best,
+                    finish_prefill(slot, gen, win.shape[1], nxt, best, lp,
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
                     deliver_error(completion, e)
@@ -1218,13 +1227,13 @@ class DecodeModel:
                 # pure-auto loop would otherwise enqueue ticks unboundedly
                 self._tick_budget.acquire()
                 try:
-                    nxt, best, self._k[b], self._v[b] = step(
+                    nxt, best, lp, self._k[b], self._v[b] = step(
                         params, self._k[b], self._v[b],
                         jnp.asarray(w["tokens"]), self._prev_nxt[b],
                         jnp.asarray(self._pos[off:off + cnt]),
                         jnp.asarray(w["active"]), jnp.asarray(w["auto"]))
                     self._prev_nxt[b] = nxt
-                    pair = jnp.stack([nxt.astype(jnp.float32), best])
+                    pair = jnp.stack([nxt.astype(jnp.float32), best, lp])
                     if hasattr(pair, "copy_to_host_async"):
                         # prefetch the D2H NOW: the resolver threads then
                         # find the transfer already in flight, so readbacks
@@ -1281,11 +1290,12 @@ class DecodeModel:
         except Exception as e:  # noqa: BLE001 — surfaced via future
             fut.set_exception(e)
 
-    def _resolve_gen_token(self, tok_dev, sink, done, slot, gen):
+    def _resolve_gen_token(self, pair_dev, sink, done, slot, gen):
         import numpy as np
 
         try:
-            sink.put(int(np.asarray(tok_dev)))
+            vals = np.asarray(pair_dev)
+            sink.put((int(vals[0]), float(vals[1])))
             if done:
                 sink.put(None)
         except Exception as e:  # noqa: BLE001 — surfaced via sink
@@ -1316,7 +1326,7 @@ class DecodeModel:
         for idx, f in batch:
             f.set_result((int(vals[0, idx]), float(vals[1, idx])))
         for idx, _slot, sink, done, _gen in gen_batch:
-            sink.put(int(vals[0, idx]))
+            sink.put((int(vals[0, idx]), float(vals[2, idx])))
             if done:
                 sink.put(None)
 
@@ -1391,7 +1401,8 @@ class DecodeModel:
         """Queue a server-side greedy generation (batched mode): the prompt
         prefills into a free slot and the slot self-feeds — every active
         generation shares one batched device step per tick.  Returns a
-        Queue yielding int token ids, then None (or an Exception)."""
+        Queue yielding (token id, logprob) pairs, then None (or an
+        Exception)."""
         import queue as _queue
         import time
 
@@ -1635,7 +1646,8 @@ class GenerateModel:
             name,
             inputs=[("text_input", "BYTES", [1])],
             outputs=[("text_output", "BYTES", [1]),
-                     ("token_id", "INT32", [1])],
+                     ("token_id", "INT32", [1]),
+                     ("logprob", "FP32", [1])],
             decoupled=True,
             instance_kind="KIND_TPU",
             parameters={"prompt_tokens": str(decode._prompt_len)},
@@ -1654,6 +1666,22 @@ class GenerateModel:
                 return outer._generate(inputs, parameters)
 
         self.model = _Impl(cfg)
+
+    @staticmethod
+    @functools.lru_cache(maxsize=1)
+    def _logprob_fn():
+        """jitted (logits [1, V], token [1]) -> [1] log-probability of the
+        token under the raw-logit softmax (OpenAI logprobs semantics:
+        reported against the unmodified distribution, whatever the
+        sampling knobs did)."""
+
+        @jax.jit
+        def lp(logits, tok):
+            l32 = logits.astype(jnp.float32)
+            chosen = jnp.take_along_axis(l32, tok[:, None], axis=-1)[:, 0]
+            return chosen - jax.nn.logsumexp(l32, axis=-1)
+
+        return lp
 
     @staticmethod
     @functools.lru_cache(maxsize=16)
@@ -1709,11 +1737,12 @@ class GenerateModel:
                     if isinstance(item, InferError):
                         raise item
                     raise InferError(f"generation failed: {item}", 500)
-                tok = int(item)
+                tok, lp = item
                 yield {
                     "text_output": np.asarray(
-                        [chr(tok % 256).encode("utf-8")], dtype=object),
+                        [chr(int(tok) % 256).encode("utf-8")], dtype=object),
                     "token_id": np.asarray([tok], np.int32),
+                    "logprob": np.asarray([lp], np.float32),
                 }
         except GeneratorExit:
             # consumer closed mid-stream (disconnect / stop sequence): flag
@@ -1792,18 +1821,25 @@ class GenerateModel:
             def choose(logits, i):
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+        lp_of = self._logprob_fn()
         logits, cache = prefill(params, jnp.asarray(window))
-        tok_devs = []
+        pair_devs = []
         for i in range(n_tokens):
             tok_dev = choose(logits, i)  # [1], stays on device
-            if hasattr(tok_dev, "copy_to_host_async"):
-                tok_dev.copy_to_host_async()
-            tok_devs.append(tok_dev)
+            # chosen token's log-probability under the raw-logit softmax,
+            # stacked with the token so the prefetched readback stays ONE
+            # fused D2H per step
+            pair = jnp.stack([tok_dev.astype(jnp.float32),
+                              lp_of(logits, tok_dev)])
+            if hasattr(pair, "copy_to_host_async"):
+                pair.copy_to_host_async()
+            pair_devs.append(pair)
             if i < n_tokens - 1:
                 logits, cache = step(
                     params, cache, tok_dev.reshape(1, 1))
-        for tok_dev in tok_devs:
-            tok = int(np.asarray(tok_dev)[0])
+        for pair_dev in pair_devs:
+            vals = np.asarray(pair_dev)
+            tok = int(vals[0, 0])
             # text_output: chr(token mod 256) as UTF-8 (JSON-safe; the byte
             # "detokenizer" aliases ids >= 256 at large vocab sizes, same as
             # llama_postprocess) — token_id carries the exact id losslessly
@@ -1811,6 +1847,7 @@ class GenerateModel:
                 "text_output": np.asarray(
                     [chr(tok % 256).encode("utf-8")], dtype=object),
                 "token_id": np.asarray([tok], np.int32),
+                "logprob": np.asarray([vals[1, 0]], np.float32),
             }
 
 

@@ -187,6 +187,26 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
     if not isinstance(n, int) or isinstance(n, bool) or not 1 <= n <= _MAX_N:
         raise InferError(f"'n' must be an integer in [1, {_MAX_N}]")
     stops = _parse_stop(body.get("stop"))
+    # chosen-token logprobs: non-streaming only (streamed deltas are
+    # stop-scanner spans, not 1:1 with tokens); alternatives are rejected
+    # loudly in BOTH spellings (completions logprobs>=1, chat
+    # top_logprobs) rather than silently degraded
+    raw_lp = body.get("logprobs")
+    if raw_lp is None or raw_lp is False:
+        want_logprobs = False
+    elif raw_lp is True or raw_lp == 0:
+        want_logprobs = True  # completions logprobs:0 = chosen token only
+    elif isinstance(raw_lp, int):
+        raise InferError(
+            "'logprobs' alternatives (logprobs >= 1) are not supported; "
+            "use logprobs: true (or 0) for chosen-token logprobs")
+    else:
+        raise InferError("'logprobs' must be a boolean or integer")
+    if body.get("top_logprobs"):
+        raise InferError("'top_logprobs' is not supported; 'logprobs' "
+                         "returns the chosen token's logprob")
+    if want_logprobs and body.get("stream"):
+        raise InferError("'logprobs' with 'stream' is not supported")
     parameters: Dict[str, Any] = {}
     try:
         if body.get("max_tokens") is not None:
@@ -214,15 +234,18 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
             # a fixed seed must still give n distinct samples — per-choice
             # offset keeps the whole response reproducible
             p["seed"] = p["seed"] + i
+        outputs = [RequestedOutput(name="text_output", binary_data=False)]
+        if want_logprobs:
+            outputs.append(RequestedOutput(name="logprob", binary_data=False))
         reqs.append(InferRequest(
             model_name=model_name,
             inputs=[InputTensor(
                 name="text_input", datatype="BYTES", shape=(1,),
                 data=np.asarray([prompt.encode()], dtype=object))],
-            outputs=[RequestedOutput(name="text_output", binary_data=False)],
+            outputs=outputs,
             parameters=p,
         ))
-    return model_name, reqs, stops
+    return model_name, reqs, stops, want_logprobs
 
 
 def _choice(index: int, kind: str, delta_or_text: Optional[str],
@@ -249,26 +272,38 @@ def _envelope(rid: str, created: int, model: str, kind: str, chat: bool,
             "choices": choices}
 
 
-async def _consume(core, req, scanner: _StopScanner, emit) -> str:
+async def _consume(core, req, scanner: _StopScanner, emit,
+                   lp_out: Optional[list] = None) -> str:
     """Drive one generation stream through the stop scanner, calling
-    ``await emit(text)`` for each releasable span.  Returns the finish
-    reason.  Closing the stream early (stop hit) propagates through
+    ``await emit(text)`` for each releasable span; ``lp_out`` (when given)
+    collects the chosen-token logprob per CONSUMED token, aligned with the
+    byte model's 1-char-per-token text.  Returns the finish reason.
+    Closing the stream early (stop hit) propagates through
     ``infer_stream`` to the model generator, which frees its decode slot
     instead of generating unread tokens."""
     agen = core.infer_stream(req)
     try:
         async for resp in agen:
+            texts = lps = None
             for t in resp.outputs:
-                if t.name != "text_output" or t.data is None:
+                if t.data is None:
                     continue
-                for v in t.data.reshape(-1):
-                    piece = (v.decode("utf-8", "replace")
-                             if isinstance(v, bytes) else str(v))
-                    out = scanner.feed(piece)
-                    if out:
-                        await emit(out)
-                    if scanner.stopped:
-                        return "stop"
+                if t.name == "text_output":
+                    texts = t.data.reshape(-1)
+                elif t.name == "logprob":
+                    lps = t.data.reshape(-1)
+            if texts is None:
+                continue
+            for j, v in enumerate(texts):
+                piece = (v.decode("utf-8", "replace")
+                         if isinstance(v, bytes) else str(v))
+                if lp_out is not None and lps is not None and j < len(lps):
+                    lp_out.append(float(lps[j]))
+                out = scanner.feed(piece)
+                if out:
+                    await emit(out)
+                if scanner.stopped:
+                    return "stop"
         tail = scanner.flush()
         if tail:
             await emit(tail)
@@ -287,7 +322,8 @@ async def _run(core, request, chat: bool):
         prompt = body.get("prompt", "")
         if not isinstance(prompt, str):
             raise InferError("'prompt' must be a string")
-    model_name, reqs, stops = _build_request(core, body, prompt)
+    model_name, reqs, stops, want_logprobs = _build_request(
+        core, body, prompt)
     rid = f"cmpl-{next(_COUNTER)}"
     created = int(time.time())
 
@@ -295,12 +331,14 @@ async def _run(core, request, chat: bool):
         async def run_choice(req):
             scanner = _StopScanner(stops)
             pieces: List[str] = []
+            lps: List[float] = []
 
             async def emit(text):
                 pieces.append(text)
 
-            finish = await _consume(core, req, scanner, emit)
-            return "".join(pieces), scanner.tokens, finish
+            finish = await _consume(core, req, scanner, emit,
+                                    lps if want_logprobs else None)
+            return "".join(pieces), scanner.tokens, finish, lps
 
         # fail fast: the first failing choice (e.g. 429 slot exhaustion)
         # cancels its siblings instead of letting them generate to
@@ -313,11 +351,31 @@ async def _run(core, request, chat: bool):
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             raise
-        choices = [
-            _choice(i, "full", text, finish, chat)
-            for i, (text, _tokens, finish) in enumerate(results)
-        ]
-        completion_tokens = sum(t for _, t, _f in results)
+        choices = []
+        for i, (text, _tokens, finish, lps) in enumerate(results):
+            entry = _choice(i, "full", text, finish, chat)
+            if want_logprobs:
+                # the stop scanner may have swallowed consumed tokens:
+                # report logprobs for the EMITTED text only (1 token per
+                # char under the byte model)
+                lps = lps[:len(text)]
+                if chat:
+                    # full ChatCompletionTokenLogprob shape (bytes +
+                    # empty top_logprobs) so strict SDK parsers validate
+                    entry["logprobs"] = {"content": [
+                        {"token": ch, "logprob": lp,
+                         "bytes": list(ch.encode()), "top_logprobs": []}
+                        for ch, lp in zip(text, lps)]}
+                else:
+                    entry["logprobs"] = {
+                        "tokens": list(text),
+                        "token_logprobs": lps,
+                        "top_logprobs": None,
+                        # 1 char per token under the byte model
+                        "text_offset": list(range(len(text))),
+                    }
+            choices.append(entry)
+        completion_tokens = sum(t for _, t, _f, _l in results)
         out = _envelope(rid, created, model_name, "full", chat, choices)
         out["usage"] = {
             "prompt_tokens": len(prompt.encode()),
