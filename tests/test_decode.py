@@ -481,6 +481,36 @@ class TestBatchedMode:
         model._execute({"TOKENS": win},
                        {"sequence_id": 3398, "sequence_start": True})
 
+    def test_cache_rebuild_aborts_live_sequences_loudly(self, model):
+        """After a failed donated step rebuilds the bucket zeroed, live
+        sequences must NOT keep stepping (they would silently decode
+        against zeros): their mapping is released so the next step fails
+        loudly, and every slot returns to the pool with its generation
+        bumped (mapped slots may bump twice — stale checks compare by
+        !=, so only change matters, not the count)."""
+        from triton_client_tpu.server.types import InferError
+
+        win = self._window(b"rebuild victim")
+        model._execute({"TOKENS": win},
+                       {"sequence_id": 3600, "sequence_start": True})
+        with model._lock:
+            slot = model._state[3600]
+            gen0 = model._slot_gen[slot]
+        # simulate the worker's post-device-error recovery path
+        model._rebuild_bucket_cache(0)
+        with model._lock:
+            assert 3600 not in model._state
+            assert slot in model._free
+            assert model._slot_gen[slot] > gen0
+        with pytest.raises(InferError):
+            model._execute({"TOKENS": np.array([1], np.int32)},
+                           {"sequence_id": 3600})
+        # the freed slot is immediately usable by a fresh sequence
+        model._execute({"TOKENS": win},
+                       {"sequence_id": 3601, "sequence_start": True})
+        model._execute({"TOKENS": np.array([1], np.int32)},
+                       {"sequence_id": 3601, "sequence_end": True})
+
     def test_unload_rejects_new_requests(self, model):
         from triton_client_tpu.server.types import InferError
 

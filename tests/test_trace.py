@@ -334,3 +334,14 @@ class TestClearToDefault:
             gc.update_trace_settings(settings={"trace_rate": None}, as_json=True)
             out = gc.get_trace_settings(as_json=True)
             assert out["settings"]["trace_rate"]["value"] == ["1000"]
+
+    def test_global_null_clear_of_unknown_key_400_http(self, client):
+        # a typo'd clear must fail loudly in GLOBAL scope too, matching the
+        # model-scope contract — not appear to succeed
+        with pytest.raises(InferenceServerException):
+            client.update_trace_settings(settings={"trace_levl": None})
+
+    def test_global_null_clear_of_unknown_key_400_grpc(self, server):
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            with pytest.raises(InferenceServerException):
+                gc.update_trace_settings(settings={"trace_levl": None})

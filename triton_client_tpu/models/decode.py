@@ -1113,8 +1113,8 @@ class DecodeModel:
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
                     deliver_error(completion, e)
-                    if completion[0] == "gen":
-                        self._release_gen_slot(slot)
+                    # rebuild frees + bumps every slot in the bucket (incl.
+                    # this gen slot) atomically; no separate release here
                     self._rebuild_bucket_cache(b)
                 continue
             if kind == "prefill_cont":
@@ -1140,8 +1140,6 @@ class DecodeModel:
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
                     deliver_error(completion, e)
-                    if completion[0] == "gen":
-                        self._release_gen_slot(slot)
                     self._rebuild_bucket_cache(b)
                 continue
             # Merge steps into this tick. A short accumulation window is
@@ -1252,7 +1250,6 @@ class DecodeModel:
                     for slot, _li in w["gens"]:
                         info = self._auto_slots.pop(slot)
                         self._gen_reader.submit(info["sink"].put, e)
-                        self._release_gen_slot(slot)
                     self._rebuild_bucket_cache(b)
                     continue
                 # which generations end on this tick (token streamed, then
@@ -1374,9 +1371,21 @@ class DecodeModel:
             info = self._auto_slots.pop(slot, None)
             if info is not None:
                 self._gen_reader.submit(info["sink"].put, err)
-                self._release_gen_slot(slot)
         with self._lock:
+            # One atomic section: release the bucket's sequence mappings,
+            # return every slot to the pool, and bump the generations.
+            # The seq-id release is load-bearing — a live sequence whose
+            # mapping survived would read the post-bump gen at submit
+            # time, pass the worker's stale check, and silently decode
+            # against the zeroed cache; with the mapping gone its next
+            # step finds no slot and fails loudly.  Holding _lock for the
+            # whole section keeps a concurrent submit from claiming a
+            # freed slot mid-rebuild and reading an intermediate gen.
+            for key in [k for k, s in self._state.items()
+                        if isinstance(s, int) and off <= s < off + cnt]:
+                self._release_entry_locked(key)
             for slot in range(off, off + cnt):
+                self._free.add(slot)
                 self._slot_gen[slot] += 1
         try:
             params, cfg = self._params
@@ -1552,8 +1561,15 @@ class DecodeModel:
             seq_lock = self._seq_locks.setdefault(
                 seq_id, self._threading.Lock())
         with seq_lock:
+            # slot AND its generation are read in ONE locked section: a
+            # cache rebuild landing between separate reads would release
+            # the mapping and bump the gen, and a gen read afterwards would
+            # pass the worker's stale check — silently decoding against
+            # the zeroed cache.  Read atomically, any later rebuild makes
+            # the submitted gen stale and the step fails loudly.
             with self._lock:
                 slot = self._state.get(seq_id)
+                gen = self._slot_gen[slot] if slot is not None else None
             if start or slot is None:
                 if toks.shape[1] != self._prompt_len:
                     with self._lock:
@@ -1563,6 +1579,9 @@ class DecodeModel:
                         f"expects a [1,{self._prompt_len}] prompt, got "
                         f"{list(toks.shape)}")
                 with self._lock:
+                    # re-read under this lock: a concurrent rebuild may
+                    # have released the mapping since the peek above
+                    slot = self._state.get(seq_id)
                     if slot is None:
                         # open-ended length: prefer the largest slab so the
                         # sequence keeps maximum headroom before its cap
@@ -1588,8 +1607,6 @@ class DecodeModel:
                 # self._pos is worker-owned, but this slot's previous step
                 # completed before its future resolved (per-seq lock), so
                 # the read is stable
-                with self._lock:
-                    gen = self._slot_gen[slot]
                 cap = self._slot_cap(slot)
                 if int(self._pos[slot]) >= cap:
                     # free the slot even on the failure path: the client

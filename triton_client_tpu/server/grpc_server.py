@@ -382,12 +382,16 @@ class InferenceServicer:
             return resp
         # an empty value list (SetInParent with no values) clears the key back
         # to its default — reference update_trace_settings(None) contract
-        update = {
-            k: (list(v.value) if v.value else list(TRACE_DEFAULTS.get(k, [])))
-            for k, v in request.settings.items()
-            if v.value or k in TRACE_DEFAULTS
-        }
+        update = {}
         try:
+            for k, v in request.settings.items():
+                if v.value:
+                    update[k] = list(v.value)
+                else:
+                    # empty clears to default; a typo'd clear flows into
+                    # the shared validator, which rejects unknown keys —
+                    # same contract as model scope
+                    update[k] = list(TRACE_DEFAULTS.get(k, []))
             validate_trace_update(update)
         except InferError as e:
             code = (grpc.StatusCode.UNIMPLEMENTED if e.http_status == 501

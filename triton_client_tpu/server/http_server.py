@@ -262,9 +262,10 @@ async def _set_trace(core, request):
     update = {}
     for k, v in body.items():
         if v is None:
-            # null clears to default (reference update_trace_settings contract)
-            if k in TRACE_DEFAULTS:
-                update[k] = list(TRACE_DEFAULTS[k])
+            # null clears to default (reference update_trace_settings
+            # contract); a typo'd clear flows into the shared validator,
+            # which 400s unknown keys — same contract as model scope
+            update[k] = list(TRACE_DEFAULTS.get(k, []))
         else:
             update[k] = v if isinstance(v, list) else [str(v)]
     validate_trace_update(update)  # 501 for TENSORS, 400 for junk — pre-apply
