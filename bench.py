@@ -74,10 +74,12 @@ def _measure_generation(harness) -> dict:
         return {}
     from triton_client_tpu.genai_perf import profile_generate
 
+    saved_quant = os.environ.get("TRITON_TPU_QUANT")
     os.environ["TRITON_TPU_QUANT"] = "int8"
     http_url = f"127.0.0.1:{harness.http_port}"
     try:
-        # warm pass compiles prefill AND the decode step (2-token run)
+        # warm pass compiles prefill AND the decode step (2-token run);
+        # the decode stack reads the quant env here (first generate call)
         profile_generate(http_url, "llama_generate", concurrency=1,
                          output_tokens=2, num_requests=1,
                          stream_timeout=1200.0)
@@ -86,6 +88,14 @@ def _measure_generation(harness) -> dict:
                                stream_timeout=1200.0)
     except Exception as e:  # noqa: BLE001 — bench keeps going without it
         return {"gen_error": str(e)[:120]}
+    finally:
+        # restore: every _LazyTransformer honors the global quant env now,
+        # so leaking int8 would silently quantize any later-initialized
+        # model while its leg reports a bf16 label
+        if saved_quant is None:
+            os.environ.pop("TRITON_TPU_QUANT", None)
+        else:
+            os.environ["TRITON_TPU_QUANT"] = saved_quant
     if rep["errors"]:
         return {"gen_error": str(rep.get("first_error"))[:120]}
     return {
@@ -188,7 +198,8 @@ def _measure_bert_mfu(harness) -> dict:
                 best = res
                 best_level = level
         mfu = language.serving_mfu(
-            best["throughput"], language.BERT_LARGE, language.BERT_SEQ_LEN)
+            best["throughput"], language.BERT_LARGE, language.BERT_SEQ_LEN,
+            head_cols=language.BERT_HEAD_COLS)
         return {
             "bert_infer_per_sec": round(best["throughput"], 1),
             "bert_mfu_pct": round(100.0 * mfu, 1),
@@ -282,6 +293,39 @@ def _measure_generation_ab() -> dict:
     if "gen_ab_batched_c8" in out:
         out["gen_batched_tok_per_sec_c8"] = out["gen_ab_batched_c8"]
     return out
+
+
+def _measure_bert_int8() -> dict:
+    """int8 BERT serving leg (r5): same sweep as _measure_bert_mfu but with
+    TRITON_TPU_QUANT_BERT_LARGE=int8 in a FRESH harness (quantization is
+    resolved at the model's first inference, so the A/B needs its own
+    session).  Runs after the main harness stopped — serialized device use,
+    per the contention rules in benchmarks/BERT_PROFILE.md."""
+    import gc
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server.registry import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+
+    gc.collect()  # free the stopped main harness's device arrays first
+    os.environ["TRITON_TPU_QUANT_BERT_LARGE"] = "int8"
+    try:
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        harness = ServerHarness(registry).start()
+        try:
+            m = _measure_bert_mfu(harness)
+        finally:
+            harness.stop()
+        return {k.replace("bert_", "bert_int8_"): v for k, v in m.items()}
+    except Exception as e:  # noqa: BLE001 — bench keeps going without it
+        return {"bert_int8_error": str(e)[:120]}
+    finally:
+        os.environ.pop("TRITON_TPU_QUANT_BERT_LARGE", None)
 
 
 def _measure_rtt_floor() -> float:
@@ -522,10 +566,17 @@ def main() -> int:
 
     rtt_floor_ms = _measure_rtt_floor()
     harness.stop()
+    # drop the ONLY references to the stopped harness's registry so the
+    # follow-on legs' gc.collect() can actually free its device arrays —
+    # stop() alone keeps self.registry (and every placed param) alive
+    harness = None
+    registry = None
     # independent of the int8 leg's outcome, and after the main harness
     # released its device memory: same-precision batched-vs-independent
     # generation A/B + the bucketed c=64 capacity point
     gen_metrics.update(_measure_generation_ab())
+    # int8 BERT serving (r5): own harness, env-resolved at first inference
+    bert_metrics.update(_measure_bert_int8())
 
     baseline = _previous_baseline()
     value = simple_res["infer_per_sec"]

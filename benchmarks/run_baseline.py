@@ -207,7 +207,7 @@ def main():
             best = results["row4_bert_stream"]["best"]
             results["row4_bert_stream"]["mfu"] = language.serving_mfu(
                 best["throughput"], language.BERT_LARGE,
-                language.BERT_SEQ_LEN)
+                language.BERT_SEQ_LEN, head_cols=language.BERT_HEAD_COLS)
             results["row4_bert_stream"]["tokens_per_sec"] = (
                 best["throughput"] * language.BERT_SEQ_LEN)
             # zero-copy response path: NOT an MFU number — demonstrates the

@@ -74,6 +74,38 @@ class TestBertLarge:
         f = language.forward_flops_per_token(cfg, 384)
         assert f > 2 * 24 * (4 * 1024 * 1024 + 2 * 1024 * 4096)
 
+    def test_span_head_flops_exclude_unexecuted_vocab(self):
+        # bert_large projects a 2-column span head; the MFU numerator must
+        # not count the 30522-column vocab head the forward never runs
+        cfg = language.BERT_LARGE
+        full = language.forward_flops_per_token(cfg, 384)
+        span = language.forward_flops_per_token(
+            cfg, 384, head_cols=language.BERT_HEAD_COLS)
+        assert span < full
+        got_delta = full - span
+        want_delta = 2.0 * cfg.d_model * (cfg.vocab_size
+                                          - language.BERT_HEAD_COLS)
+        assert abs(got_delta - want_delta) < 1e-3
+
+    def test_span_head_matches_full_head_slice(self):
+        # the dedicated span projection is exactly the first 2 columns of
+        # the full-vocab head's output — numerics unchanged, FLOPs honest
+        import jax
+        import jax.numpy as jnp
+
+        from triton_client_tpu.models import transformer as tr
+
+        cfg = language._LLAMA_PRESETS["tiny"]
+        mesh = tr.make_mesh(1, cfg)
+        params = tr.place_params(
+            tr.init_params(jax.random.PRNGKey(7), cfg), mesh, cfg)
+        toks = jnp.zeros((2, 8), jnp.int32)
+        full = tr.make_forward(mesh, cfg)(params, toks)
+        span = tr.make_forward(mesh, cfg, head_cols=2)(params, toks)
+        assert span.shape == (2, 8, 2)
+        np.testing.assert_allclose(np.asarray(span),
+                                   np.asarray(full)[:, :, :2], rtol=1e-6)
+
 
 class TestLlamaEnsemble:
     def test_preprocess_tokenizes_bytes(self):

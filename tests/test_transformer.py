@@ -121,3 +121,85 @@ def test_mesh_shape_factorization():
     s8 = tr.mesh_shape_for(8, cfg)
     nontrivial = [a for a, v in s8.items() if v > 1]
     assert len(nontrivial) >= 3, s8
+
+
+class TestInt8EncoderServing:
+    """Weight-only int8 storage + dynamic activation quantization for the
+    encoder serving forward (TRITON_TPU_QUANT=int8): the layer matmuls run
+    int8×int8 with int32 accumulation — the MXU's 2× path on v5e — while
+    norms/embed/head stay full precision.  Closeness bar mirrors the decode
+    stack's TestInt8Quantization."""
+
+    def _cos(self, a, b):
+        a, b = np.asarray(a).ravel(), np.asarray(b).ravel()
+        return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+
+    def test_quantized_logits_close_to_fp(self):
+        cfg = _cfg(n_experts=0, causal=False)
+        tokens, _ = _data(cfg)
+        params = tr.init_params(jax.random.PRNGKey(5), cfg)
+        mesh = _mesh1(cfg)
+        fp = tr.make_forward(mesh, cfg)(
+            tr.place_params(params, mesh, cfg), tokens)
+        qp = tr.quantize_layer_weights(params, cfg)
+        q = tr.make_forward(mesh, cfg, quantized=True)(
+            tr.place_params(qp, mesh, cfg), tokens)
+        assert self._cos(fp, q) > 0.99
+
+    def test_quantized_sharded_matches_single_device(self):
+        # the int8 path under tp/sp/pp collectives must agree with the
+        # 1-device quantized forward (per-rank activation scales rescale
+        # partial products BEFORE the psum — this is what that proves)
+        cfg = _cfg(n_experts=0, causal=False)
+        tokens, _ = _data(cfg)
+        params = tr.quantize_layer_weights(
+            tr.init_params(jax.random.PRNGKey(5), cfg), cfg)
+        mesh1 = _mesh1(cfg)
+        l1 = tr.make_forward(mesh1, cfg, quantized=True)(
+            tr.place_params(params, mesh1, cfg), tokens)
+        mesh8 = tr.make_mesh(8, cfg)
+        l8 = tr.make_forward(mesh8, cfg, quantized=True)(
+            tr.place_params(params, mesh8, cfg), tokens)
+        np.testing.assert_allclose(np.asarray(l8), np.asarray(l1),
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_moe_quantized_close_to_fp(self):
+        # MoE goes weight-only (dequant-on-the-fly): routing decisions keep
+        # the dense int8 path out of reach, but storage stays int8
+        cfg = _cfg(n_experts=2)
+        tokens, _ = _data(cfg)
+        params = tr.init_params(jax.random.PRNGKey(6), cfg)
+        mesh = _mesh1(cfg)
+        fp = tr.make_forward(mesh, cfg)(
+            tr.place_params(params, mesh, cfg), tokens)
+        qp = tr.quantize_layer_weights(params, cfg)
+        q = tr.make_forward(mesh, cfg, quantized=True)(
+            tr.place_params(qp, mesh, cfg), tokens)
+        assert self._cos(fp, q) > 0.99
+
+    def test_env_resolution(self, monkeypatch):
+        monkeypatch.delenv("TRITON_TPU_QUANT", raising=False)
+        assert tr.resolve_quant("bert_large") == ""
+        monkeypatch.setenv("TRITON_TPU_QUANT", "int8")
+        assert tr.resolve_quant("bert_large") == "int8"
+        # per-model override beats the global, unknown values fail loudly
+        monkeypatch.setenv("TRITON_TPU_QUANT_BERT_LARGE", "bf16")
+        assert tr.resolve_quant("bert_large") == ""
+        assert tr.resolve_quant("other") == "int8"
+        monkeypatch.setenv("TRITON_TPU_QUANT", "fp4")
+        with pytest.raises(ValueError, match="TRITON_TPU_QUANT"):
+            tr.resolve_quant("other")
+
+    def test_bert_serving_forward_under_int8(self, monkeypatch):
+        # end-to-end through the zoo entry: the model registry path the
+        # server uses (cites BASELINE row 4's serving config)
+        monkeypatch.setenv("TRITON_TPU_QUANT", "int8")
+        from triton_client_tpu.models import language
+
+        run = language._LazyTransformer(
+            _cfg(n_experts=0, causal=False), seed=24, model_name="q_test")
+        toks = jnp.zeros((2, 16), jnp.int32)
+        out = run(toks)
+        assert out.shape == (2, 16, run.cfg.vocab_size)
+        assert any(k.endswith("_scale") for k in run._params)
+        assert run._params["w1"].dtype == jnp.int8

@@ -35,34 +35,10 @@ from . import transformer as tr
 
 
 
-def quantize_layer_weights(params, cfg: tr.TransformerConfig):
-    """Weight-only int8 quantization of the stacked layer matmul weights.
-
-    Symmetric per-output-channel scales (last axis), stored as
-    ``<name>_scale`` siblings; norms/embedding/head stay full precision.
-    Decode is weight-bandwidth-bound at batch 1, so halving the bytes the
-    MXU pulls per step is the direct lever on step latency (``_w``
-    dequantizes per layer inside the scan — HBM reads stay int8)."""
-    # reduce over each weight's CONTRACTION axes (after the stacked layer
-    # axis 0) so every true output channel keeps its own scale — for
-    # wq/wk/wv [L, D, H, K] the outputs are (head, k) pairs, so only the
-    # d_model axis reduces
-    contract_axes = {"wq": (1,), "wk": (1,), "wv": (1,),
-                     "wo": (1, 2), "w1": (1,), "w2": (1,),
-                     # MoE experts: [L, E, D, F] / [L, E, F, D] contract the
-                     # middle dim per expert; the router stays fp (it picks
-                     # experts — quantization noise there changes routing)
-                     "we1": (2,), "we2": (2,)}
-    out = dict(params)
-    for k, axes in contract_axes.items():
-        if k not in params:
-            continue
-        w = jnp.asarray(params[k], jnp.float32)
-        amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
-        scale = jnp.maximum(amax, 1e-12) / 127.0
-        out[k] = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
-        out[k + "_scale"] = scale.astype(jnp.float32)
-    return out
+# shared with the encoder serving path (transformer.py owns it now: the
+# decode stack dequantizes on the fly via _w, the encoder forward runs the
+# int8 MXU path on the same quantized params)
+quantize_layer_weights = tr.quantize_layer_weights
 
 
 def _stale_error(model_name: str):
@@ -760,16 +736,13 @@ class DecodeModel:
         the layer matmul weights (see quantize_layer_weights) — both the
         decode and generate paths then serve the quantized model."""
         if self._params is None:
-            import os
-
             cfg = self._language._llama_cfg()
             params = tr.init_params(jax.random.PRNGKey(3), cfg)
-            quant = os.environ.get("TRITON_TPU_QUANT", "")
+            # resolve_quant: per-model TRITON_TPU_QUANT_<MODEL> override,
+            # unknown names fail loudly, not silently-fp
+            quant = tr.resolve_quant(self._model.name)
             if quant == "int8":
                 params = quantize_layer_weights(params, cfg)
-            elif quant:  # unknown names fail loudly, not silently-fp
-                raise ValueError(
-                    f"TRITON_TPU_QUANT={quant!r}: expected 'int8' or unset")
             else:
                 # serving-grade storage: init_params returns f32 master
                 # weights (training-grade), but decode is weight-bandwidth-

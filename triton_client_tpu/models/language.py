@@ -56,6 +56,7 @@ _LLAMA_PRESETS = {
 }
 
 BERT_SEQ_LEN = 384   # classic BERT-large SQuAD serving length
+BERT_HEAD_COLS = 2   # span head (start/end logits) — see make_bert_large
 LLAMA_SEQ_LEN = 128  # fixed context window for the generation ensemble
 
 # Long-context scorer: attention dominates at this window, so serving runs
@@ -129,9 +130,18 @@ def n_params(cfg: tr.TransformerConfig) -> int:
     return cfg.n_layers * per_layer + embed + head + cfg.d_model
 
 
-def forward_flops_per_token(cfg: tr.TransformerConfig, seq_len: int) -> float:
-    """≈2·params matmul FLOPs per token + attention score/value terms."""
+def forward_flops_per_token(cfg: tr.TransformerConfig, seq_len: int,
+                            head_cols: int = None) -> float:
+    """≈2·params matmul FLOPs per token + attention score/value terms.
+
+    ``head_cols`` must match the forward's (tr.make_forward): a model that
+    projects only N head columns (bert_large's span head: 2, not 30522)
+    must not count the full-vocab head it never executes — MFU numbers
+    count executed FLOPs only."""
     matmul = 2.0 * (n_params(cfg) - cfg.vocab_size * cfg.d_model)  # embed lookup is free
+    if head_cols is not None:
+        # replace the full-vocab head term with the executed columns
+        matmul += 2.0 * cfg.d_model * (head_cols - cfg.vocab_size)
     attn = 4.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len  # QK^T + PV (causal ≈ /2, keep upper bound)
     return matmul + attn
 
@@ -142,13 +152,15 @@ V5E_PEAK_FLOPS = 394e12
 
 
 def serving_mfu(infer_per_sec: float, cfg: tr.TransformerConfig,
-                seq_len: int) -> float:
+                seq_len: int, head_cols: int = None) -> float:
     """Model FLOPs utilization of a serving sweep: measured requests/sec ×
     seq_len tokens each × analytic forward FLOPs/token over chip peak.
     Shared by bench.py and benchmarks/run_baseline.py so the formula and
-    peak constant cannot drift apart."""
+    peak constant cannot drift apart.  ``head_cols`` follows the served
+    forward (bert_large: 2 — the span head)."""
     toks = infer_per_sec * seq_len
-    return toks * forward_flops_per_token(cfg, seq_len) / V5E_PEAK_FLOPS
+    return (toks * forward_flops_per_token(cfg, seq_len, head_cols)
+            / V5E_PEAK_FLOPS)
 
 
 class _LazyTransformer:
@@ -162,10 +174,11 @@ class _LazyTransformer:
     padded-shape set bounded so XLA compiles a handful of shapes."""
 
     def __init__(self, cfg: tr.TransformerConfig, seed: int,
-                 model_name: str = None):
+                 model_name: str = None, head_cols: int = None):
         self.cfg = cfg
         self._seed = seed
         self._model_name = model_name
+        self._head_cols = head_cols
         self._fwd = None
         self._params = None
         self._mesh = None
@@ -183,8 +196,17 @@ class _LazyTransformer:
             self._mesh = tr.serve_mesh(self.cfg,
                                        model_name=self._model_name)
             params = tr.init_params(jax.random.PRNGKey(self._seed), self.cfg)
+            # TRITON_TPU_QUANT[_<MODEL>]=int8: weight-only int8 storage +
+            # dynamic activation quantization → the layer matmuls run on
+            # the MXU's int8 path (2× bf16 peak on v5e); norms/embed/head
+            # stay full precision (closeness proven in test_transformer.py)
+            quant = tr.resolve_quant(self._model_name)
+            if quant == "int8":
+                params = tr.quantize_layer_weights(params, self.cfg)
             self._params = tr.place_params(params, self._mesh, self.cfg)
-            self._fwd = tr.make_forward(self._mesh, self.cfg)
+            self._fwd = tr.make_forward(self._mesh, self.cfg,
+                                        quantized=(quant == "int8"),
+                                        head_cols=self._head_cols)
             self._dp = int(self._mesh.shape["dp"])
 
     def __call__(self, tokens):
@@ -216,14 +238,19 @@ def make_bert_large() -> JaxModel:
         max_queue_delay_us=3000,
         instance_kind="KIND_TPU",
     )
-    run = _LazyTransformer(BERT_LARGE, seed=24, model_name="bert_large")
+    # span head: the forward projects ONLY the 2 start/end columns — a real
+    # BERT-SQuAD head, not a sliced vocab projection.  BERT_HEAD_COLS feeds
+    # the same value into the MFU accounting (serving_mfu) so the reported
+    # efficiency counts executed FLOPs only.
+    run = _LazyTransformer(BERT_LARGE, seed=24, model_name="bert_large",
+                           head_cols=BERT_HEAD_COLS)
 
     def fn(INPUT_IDS):
         import jax.numpy as jnp
 
         tokens = jnp.clip(INPUT_IDS, 0, BERT_LARGE.vocab_size - 1)
-        logits = run(tokens)  # [B, S, vocab]
-        return {"LOGITS": logits[:, :, :2].astype(jnp.float32)}
+        logits = run(tokens)  # [B, S, 2]
+        return {"LOGITS": logits.astype(jnp.float32)}
 
     return JaxModel(cfg, fn, jit=False)
 

@@ -238,9 +238,26 @@ def _check_axis_divisibility(shape: Dict[str, int], cfg: TransformerConfig,
 # Parameters
 # ---------------------------------------------------------------------------
 
-def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
+#: Shardings for the int8 ``*_scale`` siblings quantize_layer_weights
+#: produces: the reduced (contraction) axes are singletons, the surviving
+#: output-channel axes shard exactly like the weight's.
+_SCALE_SPECS = {
+    "wq_scale": P("pp", None, "tp", None),
+    "wk_scale": P("pp", None, "tp", None),
+    "wv_scale": P("pp", None, "tp", None),
+    "wo_scale": P("pp", None, None, None),
+    "w1_scale": P("pp", None, "tp"),
+    "w2_scale": P("pp", None, None),
+    "we1_scale": P("pp", "ep", None, "tp"),
+    "we2_scale": P("pp", "ep", None, None),
+}
+
+
+def param_specs(cfg: TransformerConfig,
+                quantized: bool = False) -> Dict[str, P]:
     """PartitionSpec per parameter leaf.  Layer-stacked leaves lead with the
-    layer dim sharded over ``pp`` (each pipeline stage owns its layers)."""
+    layer dim sharded over ``pp`` (each pipeline stage owns its layers).
+    ``quantized`` adds the int8 ``*_scale`` sibling specs."""
     specs = {
         "embed": P(None, None),
         "wq": P("pp", None, "tp", None),
@@ -263,6 +280,9 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
             "w1": P("pp", None, "tp"),
             "w2": P("pp", "tp", None),
         })
+    if quantized:
+        specs.update({k: v for k, v in _SCALE_SPECS.items()
+                      if k[:-len("_scale")] in specs})
     return specs
 
 
@@ -298,9 +318,78 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, jax.Array]:
     return p
 
 
+def quantize_layer_weights(params, cfg: TransformerConfig):
+    """Weight-only int8 quantization of the stacked layer matmul weights.
+
+    Symmetric per-output-channel scales (over the contraction axes), stored
+    as ``<name>_scale`` siblings; norms/embedding/head stay full precision.
+    Serves two consumers: the KV-decode stack dequantizes on the fly
+    (weight-bandwidth lever, models/decode.py ``_w``), and the encoder
+    serving forward runs true int8×int8 MXU matmuls with dynamically
+    quantized activations (compute lever, ``_int8_dot`` below)."""
+    # reduce over each weight's CONTRACTION axes (after the stacked layer
+    # axis 0) so every true output channel keeps its own scale — for
+    # wq/wk/wv [L, D, H, K] the outputs are (head, k) pairs, so only the
+    # d_model axis reduces
+    contract_axes = {"wq": (1,), "wk": (1,), "wv": (1,),
+                     "wo": (1, 2), "w1": (1,), "w2": (1,),
+                     # MoE experts: [L, E, D, F] / [L, E, F, D] contract the
+                     # middle dim per expert; the router stays fp (it picks
+                     # experts — quantization noise there changes routing)
+                     "we1": (2,), "we2": (2,)}
+    out = dict(params)
+    for k, axes in contract_axes.items():
+        if k not in params:
+            continue
+        w = jnp.asarray(params[k], jnp.float32)
+        amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+        scale = jnp.maximum(amax, 1e-12) / 127.0
+        out[k] = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        out[k + "_scale"] = scale.astype(jnp.float32)
+    return out
+
+
+def quant_env_key(model_name: str) -> str:
+    return "TRITON_TPU_QUANT_" + "".join(
+        c if c.isalnum() else "_" for c in model_name.upper())
+
+
+def resolve_quant(model_name: Optional[str] = None) -> str:
+    """Serving quantization mode: '' (bf16) or 'int8'.
+
+    ``TRITON_TPU_QUANT_<MODEL>`` overrides the global ``TRITON_TPU_QUANT``
+    (same per-model convention as the serve-mesh spec); unknown values fail
+    loudly at config time with the variable that was set."""
+    var = "TRITON_TPU_QUANT"
+    val = os.environ.get(var, "")
+    if model_name:
+        key = quant_env_key(model_name)
+        per_model = os.environ.get(key)
+        if per_model is not None:
+            var, val = key, per_model
+    val = val.strip().lower()
+    if val in ("", "none", "bf16"):
+        return ""
+    if val == "int8":
+        return "int8"
+    raise ValueError(f"{var}={val!r}: expected 'int8' or unset")
+
+
 # ---------------------------------------------------------------------------
 # Model math (runs INSIDE shard_map: all arrays are per-device local shards)
 # ---------------------------------------------------------------------------
+
+def _int8_quant(h, axes):
+    """Dynamic symmetric int8 quantization of an activation over its
+    contraction ``axes``: [...] -> (int8 values, f32 scale with the reduced
+    axes kept as singletons).  Per-token scales (everything but the
+    contraction dims survives) keep outliers local to their row."""
+    amax = jnp.max(jnp.abs(h.astype(jnp.float32)), axis=axes, keepdims=True)
+    s = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(h.astype(jnp.float32) / s),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
 
 def _rmsnorm(x, scale, eps):
     x32 = x.astype(jnp.float32)
@@ -346,9 +435,25 @@ def _flash_min_s() -> int:
 
 def _attn_apply(blk, x, cfg: TransformerConfig):
     h = _rmsnorm(x, blk["ln1"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bhsk", h, blk["wq"].astype(h.dtype))
-    k = jnp.einsum("bsd,dhk->bhsk", h, blk["wk"].astype(h.dtype))
-    v = jnp.einsum("bsd,dhk->bhsk", h, blk["wv"].astype(h.dtype))
+    if "wq_scale" in blk:
+        # int8 MXU path: activations quantized per token, weights already
+        # int8 per output channel; the einsum runs int8×int8 with int32
+        # accumulation (2× bf16 MXU peak on v5e) and the rescale is a
+        # cheap elementwise epilogue XLA fuses into the consumer
+        hq, hs = _int8_quant(h, (-1,))          # [B,S,D] i8, [B,S,1] f32
+
+        def proj(name):
+            out = jnp.einsum("bsd,dhk->bhsk", hq, blk[name],
+                             preferred_element_type=jnp.int32)
+            ws = blk[name + "_scale"]           # [1,H,K]
+            return (out.astype(jnp.float32)
+                    * hs[:, None, :, :] * ws[:, :, None, :]).astype(h.dtype)
+
+        q, k, v = proj("wq"), proj("wk"), proj("wv")
+    else:
+        q = jnp.einsum("bsd,dhk->bhsk", h, blk["wq"].astype(h.dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", h, blk["wk"].astype(h.dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", h, blk["wv"].astype(h.dtype))
     Sc = x.shape[1]
     positions = lax.axis_index("sp") * Sc + jnp.arange(Sc)
     q, k = _rope(q, k, positions, cfg.rope_theta)
@@ -363,7 +468,16 @@ def _attn_apply(blk, x, cfg: TransformerConfig):
         o = flash_attention(q, k, v, causal=cfg.causal)
     else:
         o = _ring_attention(q, k, v, cfg)
-    out = jnp.einsum("bhsk,hkd->bsd", o, blk["wo"].astype(o.dtype))
+    if "wo_scale" in blk:
+        # contraction is (h, k): quantize per (b, s) over the local heads —
+        # each tp rank rescales its own partial product BEFORE the psum
+        oq, osc = _int8_quant(o, (1, 3))        # [B,H,S,K] i8, [B,1,S,1]
+        out = jnp.einsum("bhsk,hkd->bsd", oq, blk["wo"],
+                         preferred_element_type=jnp.int32)
+        out = (out.astype(jnp.float32)
+               * osc[:, 0, :, :] * blk["wo_scale"]).astype(o.dtype)
+    else:
+        out = jnp.einsum("bhsk,hkd->bsd", o, blk["wo"].astype(o.dtype))
     out = lax.psum(out, "tp")
     return x + out
 
@@ -379,12 +493,32 @@ def _ffn_apply(blk, x, cfg: TransformerConfig):
         El = blk["we1"].shape[0]
         start = lax.axis_index("ep") * El
         local_probs = lax.dynamic_slice_in_dim(probs, start, El, axis=-1)
-        he = jnp.einsum("bsd,edf->ebsf", h, blk["we1"].astype(h.dtype))
+
+        def _mw(name):
+            # expert weights dequantized on the fly when int8 (weight-only
+            # for MoE: routing keeps the dense int8-MXU path out of reach)
+            w = blk[name].astype(h.dtype)
+            s = blk.get(name + "_scale")
+            return w * s.astype(h.dtype) if s is not None else w
+
+        he = jnp.einsum("bsd,edf->ebsf", h, _mw("we1"))
         he = jax.nn.silu(he)
-        oe = jnp.einsum("ebsf,efd->ebsd", he, blk["we2"].astype(h.dtype))
+        oe = jnp.einsum("ebsf,efd->ebsd", he, _mw("we2"))
         oe = lax.psum(oe, "tp")
         out = jnp.einsum("ebsd,bse->bsd", oe, local_probs.astype(oe.dtype))
         out = lax.psum(out, "ep")
+    elif "w1_scale" in blk:
+        # dense FFN on the int8 MXU path (see _attn_apply)
+        hq, hs = _int8_quant(h, (-1,))
+        he = jnp.einsum("bsd,df->bsf", hq, blk["w1"],
+                        preferred_element_type=jnp.int32)
+        he = (he.astype(jnp.float32) * hs * blk["w1_scale"]).astype(h.dtype)
+        he = jax.nn.silu(he)
+        gq, gs = _int8_quant(he, (-1,))
+        out = jnp.einsum("bsf,fd->bsd", gq, blk["w2"],
+                         preferred_element_type=jnp.int32)
+        out = (out.astype(jnp.float32) * gs * blk["w2_scale"]).astype(h.dtype)
+        out = lax.psum(out, "tp")
     else:
         he = jnp.einsum("bsd,df->bsf", h, blk["w1"].astype(h.dtype))
         he = jax.nn.silu(he)
@@ -403,7 +537,11 @@ def _layer_keys(cfg):
 
 def _stage_apply(params, x, cfg: TransformerConfig):
     """Run this pipeline stage's local stack of layers (lax.scan)."""
-    blocks = {k: params[k] for k in _layer_keys(cfg)}
+    blocks = {}
+    for k in _layer_keys(cfg):
+        blocks[k] = params[k]
+        if k + "_scale" in params:
+            blocks[k + "_scale"] = params[k + "_scale"]
 
     def step(carry, blk):
         y = _attn_apply(blk, carry, cfg)
@@ -581,10 +719,17 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 2,
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
-def make_forward(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 1):
+def make_forward(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 1,
+                 quantized: bool = False, head_cols: Optional[int] = None):
     """jit(shard_map(forward)): (params, tokens[B,S]) -> logits [B,S,V]
-    (replicated over pp via psum broadcast of the last stage's output)."""
-    specs = param_specs(cfg)
+    (replicated over pp via psum broadcast of the last stage's output).
+    ``quantized=True`` expects quantize_layer_weights params and runs the
+    layer matmuls on the int8 MXU path.  ``head_cols=N`` projects only the
+    first N head columns (e.g. a BERT-SQuAD span head needs 2, not the
+    vocab_size the shared param carries) — the FLOPs accounting in
+    language.forward_flops_per_token takes the same value so MFU stays
+    honest about what actually executed."""
+    specs = param_specs(cfg, quantized=quantized)
 
     def local_fwd(params, tokens):
         Bl, Sc = tokens.shape
@@ -596,9 +741,12 @@ def make_forward(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 1):
         outs = jnp.where(is_last, outs, 0.0).astype(jnp.float32)
         outs = lax.psum(outs, "pp").astype(cfg.dtype)
         h = _rmsnorm(outs, params["final_ln"], cfg.norm_eps)
+        head = params["head"]
+        if head_cols is not None:
+            head = head[:, :head_cols]
         logits = jnp.einsum("nbsd,dv->nbsv", h.astype(jnp.float32),
-                            params["head"].astype(jnp.float32))
-        return logits.reshape(Bl, Sc, cfg.vocab_size)
+                            head.astype(jnp.float32))
+        return logits.reshape(Bl, Sc, head.shape[-1])
 
     sharded = jax.shard_map(
         local_fwd, mesh=mesh,
@@ -610,7 +758,8 @@ def make_forward(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 1):
 
 
 def place_params(params, mesh: Mesh, cfg: TransformerConfig):
-    specs = param_specs(cfg)
+    specs = param_specs(
+        cfg, quantized=any(k.endswith("_scale") for k in params))
     return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
             for k, v in params.items()}
 
