@@ -84,3 +84,90 @@ def test_bytes_model_sweep(harness, capsys):
         "--concurrency-range", "1", "--measurement-interval", "300",
     ])
     assert rc == 0, capsys.readouterr().out
+
+
+def test_parse_rate_range():
+    assert perf_analyzer._parse_rate_range("5") == [5.0]
+    assert perf_analyzer._parse_rate_range("10:30:10") == [10.0, 20.0, 30.0]
+    assert perf_analyzer._parse_rate_range("2:4") == [2.0, 3.0, 4.0]
+    # zero/negative rates or step must be a loud config error, not an
+    # infinite level list / ZeroDivisionError later
+    with pytest.raises(ValueError):
+        perf_analyzer._parse_rate_range("10:30:0")
+    with pytest.raises(ValueError):
+        perf_analyzer._parse_rate_range("0")
+
+
+class TestOpenLoop:
+    """--request-rate-range: coordinated-omission-free load generation.
+    Latency counts from the SCHEDULED send time; a server that can't keep
+    pace shows up as send lag / unsent slots, not silent throttling."""
+
+    @pytest.mark.parametrize("dist", ["constant", "poisson"])
+    def test_rate_mode_cli(self, harness, dist, capsys):
+        rc = perf_analyzer.main([
+            "-m", "simple", "-u", f"127.0.0.1:{harness.http_port}",
+            "--request-rate-range", "40", "--request-distribution", dist,
+            "--measurement-interval", "800", "-v",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "open-loop" in out
+        assert "from scheduled send" in out
+
+    def test_rate_is_held_and_reported(self, harness):
+        res = perf_analyzer.run_rate_level(
+            "http", f"127.0.0.1:{harness.http_port}", "simple", "",
+            50.0, _simple_arrays(harness), ["OUTPUT0", "OUTPUT1"],
+            "none", 1 << 20, 1.0, warmup_s=0.3)
+        assert res["errors"] == 0, res
+        # the generator held ~the offered rate (scheduled slots all sent)
+        assert res["unsent"] == 0, res
+        assert res["throughput"] == pytest.approx(50.0, rel=0.25)
+        assert np.isfinite(res["p99_us"])
+        assert np.isfinite(res["send_lag_p99_ms"])
+
+    def test_overload_reports_lag_not_flattery(self, harness):
+        # 2000 req/s from 4 senders against a ~ms-latency model cannot be
+        # held: an honest open-loop report shows backlog (lag/unsent) and
+        # p99 >> closed-loop service latency, instead of quietly sending
+        # slower like the closed loop would
+        res = perf_analyzer.run_rate_level(
+            "http", f"127.0.0.1:{harness.http_port}", "simple", "",
+            2000.0, _simple_arrays(harness), ["OUTPUT0", "OUTPUT1"],
+            "none", 1 << 20, 1.0, warmup_s=0.2, max_threads=4)
+        assert res["unsent"] > 0 or res["send_lag_p99_ms"] > 50.0, res
+        # latency-from-schedule must dominate the pure service time
+        assert res["p99_us"] > 10_000, res
+
+    def test_mutually_exclusive_with_concurrency(self, harness):
+        with pytest.raises(SystemExit):
+            perf_analyzer.main([
+                "-m", "simple", "-u", f"127.0.0.1:{harness.http_port}",
+                "--concurrency-range", "2",
+                "--request-rate-range", "10",
+            ])
+
+    def test_report_file(self, harness, tmp_path):
+        rep = tmp_path / "rate.csv"
+        rc = perf_analyzer.main([
+            "-m", "simple", "-u", f"127.0.0.1:{harness.http_port}",
+            "--request-rate-range", "30",
+            "--measurement-interval", "500",
+            "-f", str(rep),
+        ])
+        assert rc == 0
+        lines = rep.read_text().strip().splitlines()
+        assert lines[0].startswith("Request Rate,")
+        assert len(lines) == 2
+
+
+def _simple_arrays(harness):
+    import triton_client_tpu.http as httpclient
+
+    c = httpclient.InferenceServerClient(f"127.0.0.1:{harness.http_port}")
+    inputs, outputs, max_batch = perf_analyzer._resolve_model(
+        c, "http", "simple", "")
+    c.close()
+    return perf_analyzer._make_data(inputs, {}, 1, max_batch,
+                                    np.random.default_rng(0))

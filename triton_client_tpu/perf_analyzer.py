@@ -3,13 +3,23 @@
 The reference repo ships only stub READMEs for perf_analyzer
 (src/c++/perf_analyzer/README.md:28-30 — source relocated), so this tool is
 designed from its CLI contract (SURVEY.md "critical absences"): closed-loop
-concurrency sweeps reporting infer/sec and latency percentiles, with
+concurrency sweeps AND open-loop request-rate sweeps
+(``--request-rate-range`` with constant/Poisson arrivals) reporting
+infer/sec and latency percentiles, with
 ``--shared-memory={none,system,cuda,xla}`` data-path modes (BASELINE north
 star: the ``cuda`` mode maps to TPU xla shared memory).
+
+Open-loop latency is measured from each request's SCHEDULED send time, so
+server queue buildup counts against the percentiles instead of throttling
+the generator — closed-loop numbers are subject to coordinated omission
+(the sweep only sends as fast as the server answers) and BASELINE.md labels
+which rows are which.
 
 Usage:
     python -m triton_client_tpu.perf_analyzer -m simple -u localhost:8001 \
         -i grpc --concurrency-range 1:8:2 --shared-memory system
+    python -m triton_client_tpu.perf_analyzer -m simple -u localhost:8000 \
+        --request-rate-range 100:400:100 --request-distribution poisson
 """
 
 from __future__ import annotations
@@ -223,54 +233,90 @@ def _worker(protocol_mod, make_client, model_name, model_version, arrays,
                 stats.first_error = f"worker setup: {type(e).__name__}: {e}"
 
 
+class _InferSession:
+    """One worker's client + inputs + shm regions + infer callable — shared
+    by the closed-loop (concurrency) and open-loop (request-rate) drivers."""
+
+    def __init__(self, protocol_mod, make_client, model_name, model_version,
+                 arrays, outputs, shm_mode, output_byte_size, worker_id,
+                 streaming):
+        self._client = make_client()
+        self._shm_setup = None
+        self._stream_open = False
+        try:
+            infer_inputs = _build_inputs(protocol_mod, arrays, shm_mode)
+            requested = [protocol_mod.InferRequestedOutput(o) for o in outputs]
+            self._shm_setup = _ShmSetup(shm_mode, protocol_mod, self._client,
+                                        arrays, outputs, worker_id,
+                                        output_byte_size)
+            self._shm_setup.attach(infer_inputs, requested)
+
+            if streaming:
+                # Async streaming mode (reference perf_analyzer --streaming):
+                # requests ride one bidi gRPC stream per worker; completion
+                # is the callback on the stream reader thread.
+                import queue as _queue
+
+                done: "_queue.Queue" = _queue.Queue()
+                self._client.start_stream(
+                    callback=lambda result, error: done.put(error))
+                self._stream_open = True
+                # completions owed from timed-out requests: they must be
+                # discarded when they eventually land, or every later
+                # request would be paired with its predecessor's completion
+                stale = [0]
+                client = self._client
+
+                def one_infer():
+                    client.async_stream_infer(
+                        model_name, infer_inputs, outputs=requested,
+                        model_version=model_version)
+                    try:
+                        while True:
+                            err = done.get(timeout=120)
+                            if stale[0] > 0:
+                                stale[0] -= 1
+                                continue
+                            if err is not None:
+                                raise err
+                            return
+                    except _queue.Empty:
+                        stale[0] += 1
+                        raise TimeoutError("stream completion timed out")
+            else:
+                client = self._client
+
+                def one_infer():
+                    client.infer(model_name, infer_inputs, outputs=requested,
+                                 model_version=model_version)
+
+            self.infer = one_infer
+        except Exception:
+            self.close()
+            raise
+
+    def close(self):
+        if self._stream_open:
+            try:
+                self._client.stop_stream()
+            except Exception:
+                pass
+        if self._shm_setup is not None:
+            self._shm_setup.cleanup()
+        try:
+            self._client.close()
+        except Exception:
+            pass
+
+
 def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
                  outputs, shm_mode, output_byte_size, worker_id, stop,
                  measuring, stats: _Stats, lock, streaming=False):
-    client = make_client()
-    shm_setup = None
-    stream_open = False
+    session = _InferSession(protocol_mod, make_client, model_name,
+                            model_version, arrays, outputs, shm_mode,
+                            output_byte_size, worker_id, streaming)
+    one_infer = session.infer
     try:
-        infer_inputs = _build_inputs(protocol_mod, arrays, shm_mode)
-        requested = [protocol_mod.InferRequestedOutput(o) for o in outputs]
-        shm_setup = _ShmSetup(shm_mode, protocol_mod, client, arrays, outputs,
-                              worker_id, output_byte_size)
-        shm_setup.attach(infer_inputs, requested)
-
-        if streaming:
-            # Async streaming mode (reference perf_analyzer --streaming):
-            # requests ride one bidi gRPC stream per worker; completion is
-            # the callback on the stream reader thread.
-            import queue as _queue
-
-            done: "_queue.Queue" = _queue.Queue()
-            client.start_stream(callback=lambda result, error: done.put(error))
-            stream_open = True
-            # completions owed from timed-out requests: they must be
-            # discarded when they eventually land, or every later request
-            # would be paired with its predecessor's completion
-            stale = [0]
-
-            def one_infer():
-                client.async_stream_infer(
-                    model_name, infer_inputs, outputs=requested,
-                    model_version=model_version)
-                try:
-                    while True:
-                        err = done.get(timeout=120)
-                        if stale[0] > 0:
-                            stale[0] -= 1
-                            continue
-                        if err is not None:
-                            raise err
-                        return
-                except _queue.Empty:
-                    stale[0] += 1
-                    raise TimeoutError("stream completion timed out")
-        else:
-            def one_infer():
-                client.infer(model_name, infer_inputs, outputs=requested,
-                             model_version=model_version)
-
         local: List[float] = []
         n = 0
         errs = 0
@@ -300,17 +346,7 @@ def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
             if stats.first_error is None and first_error is not None:
                 stats.first_error = first_error
     finally:
-        if stream_open:
-            try:
-                client.stop_stream()
-            except Exception:
-                pass
-        if shm_setup is not None:
-            shm_setup.cleanup()
-        try:
-            client.close()
-        except Exception:
-            pass
+        session.close()
 
 
 def run_level(protocol, url, model_name, model_version, concurrency, arrays,
@@ -351,22 +387,177 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
     stop.set()
     for t in threads:
         t.join(timeout=30)
-    lat = np.sort(np.asarray(stats.latencies)) * 1e6  # usec
     res = {
         "concurrency": concurrency,
         "throughput": stats.count / elapsed,
         "errors": stats.errors,
         "first_error": stats.first_error,
-        "avg_us": float(lat.mean()) if lat.size else float("nan"),
-        "p50_us": float(np.percentile(lat, 50)) if lat.size else float("nan"),
-        "p90_us": float(np.percentile(lat, 90)) if lat.size else float("nan"),
-        "p95_us": float(np.percentile(lat, 95)) if lat.size else float("nan"),
-        "p99_us": float(np.percentile(lat, 99)) if lat.size else float("nan"),
     }
-    if extra_percentile is not None:
-        key = f"p{extra_percentile}_us"
-        res[key] = (float(np.percentile(lat, extra_percentile))
-                    if lat.size else float("nan"))
+    res.update(_latency_stats(stats.latencies, extra_percentile))
+    return res
+
+
+def _latency_stats(latencies_s, extra_percentile=None) -> dict:
+    """avg/p50/p90/p95/p99 (+ optional extra percentile) in usec, NaN when
+    no samples — shared by the closed- and open-loop drivers."""
+    lat = np.sort(np.asarray(latencies_s)) * 1e6
+    out = {"avg_us": float(lat.mean()) if lat.size else float("nan")}
+    pcts = [50, 90, 95, 99]
+    if extra_percentile is not None and extra_percentile not in pcts:
+        pcts.append(extra_percentile)
+    for p in pcts:
+        out[f"p{p}_us"] = (float(np.percentile(lat, p))
+                           if lat.size else float("nan"))
+    return out
+
+
+def _parse_rate_range(spec: str) -> List[float]:
+    parts = [float(p) for p in spec.split(":")]
+    start = parts[0]
+    end = parts[1] if len(parts) > 1 else start
+    step = parts[2] if len(parts) > 2 else 1.0
+    if start <= 0 or step <= 0:
+        raise ValueError(
+            f"invalid --request-rate-range '{spec}': rates and step must "
+            "be positive")
+    out, r = [], start
+    while r <= end + 1e-9:
+        out.append(r)
+        r += step
+    return out
+
+
+def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
+                   outputs, shm_mode, output_byte_size, measure_s,
+                   warmup_s=1.0, distribution="constant", max_threads=64,
+                   extra_percentile=None, streaming=False):
+    """OPEN-loop load at ``rate`` requests/s (reference perf_analyzer
+    --request-rate-range): send times are scheduled up front (constant or
+    Poisson inter-arrivals) and latency is measured from the SCHEDULED send
+    time, so server queue buildup counts against latency instead of
+    throttling the generator — the closed-loop sweep's coordinated-omission
+    flattering cannot happen here.  When the server can't keep pace the
+    report says so: ``send_lag_*`` (how far actual sends fell behind
+    schedule) and ``unsent`` (slots still owed when the window closed)."""
+    if protocol == "grpc":
+        import triton_client_tpu.grpc as protocol_mod
+
+        make_client = lambda: protocol_mod.InferenceServerClient(url)
+    else:
+        import triton_client_tpu.http as protocol_mod
+
+        make_client = lambda: protocol_mod.InferenceServerClient(
+            url, concurrency=max_threads)
+
+    # absolute schedule for warmup + window (+1s grace so the last in-window
+    # slot exists); fixed seed => the Poisson schedule is reproducible
+    horizon = warmup_s + measure_s + 1.0
+    n_slots = int(rate * horizon) + 1
+    srng = np.random.default_rng(1234)
+    if distribution == "poisson":
+        gaps = srng.exponential(1.0 / rate, n_slots)
+    else:
+        gaps = np.full(n_slots, 1.0 / rate)
+    sched = np.cumsum(gaps)
+
+    if rate <= 0:
+        raise ValueError(f"request rate must be positive, got {rate}")
+    lock = threading.Lock()
+    stop = threading.Event()
+    next_slot = [0]
+    sent = []     # (scheduled_rel, send_lag_s)
+    done = []     # (scheduled_rel, latency_from_scheduled_s, err or None)
+    setup_errors = []  # outside the window classification: always reported
+    t0_box = [None]
+    ready = [0]
+    go = threading.Event()
+
+    def worker(worker_id):
+        try:
+            session = _InferSession(protocol_mod, make_client, model_name,
+                                    model_version, arrays, outputs, shm_mode,
+                                    output_byte_size, worker_id, streaming)
+        except Exception as e:  # noqa: BLE001 — setup must be visible
+            with lock:
+                ready[0] += 1
+                setup_errors.append(
+                    f"worker setup: {type(e).__name__}: {e}")
+            return
+        # the schedule's t0 is armed only after the sender pool is
+        # connected: otherwise pool setup (N clients dialing at once) eats
+        # the front of the schedule and a low-rate window reports itself
+        # entirely unsent
+        with lock:
+            ready[0] += 1
+        go.wait(timeout=120)
+        try:
+            while not stop.is_set():
+                with lock:
+                    k = next_slot[0]
+                    if k >= n_slots:
+                        return
+                    next_slot[0] += 1
+                target = t0_box[0] + sched[k]
+                # sleep in slices so stop() interrupts a long idle gap
+                while True:
+                    now = time.perf_counter()
+                    if now >= target or stop.is_set():
+                        break
+                    time.sleep(min(target - now, 0.05))
+                if stop.is_set():
+                    return  # claimed slot never sent -> counted in `unsent`
+                lag = time.perf_counter() - target
+                err = None
+                try:
+                    session.infer()
+                except Exception as e:  # noqa: BLE001 — recorded per slot
+                    err = f"{type(e).__name__}: {e}"
+                lat = time.perf_counter() - target
+                with lock:
+                    sent.append((sched[k], lag))
+                    done.append((sched[k], lat, err))
+        finally:
+            session.close()
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(max_threads)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 30.0
+    while ready[0] < max_threads and time.monotonic() < deadline:
+        time.sleep(0.005)
+    t0_box[0] = time.perf_counter()
+    go.set()
+    # classify by SCHEDULED time: the window owns every slot scheduled
+    # inside it, including ones the server never got to (that's the point)
+    time.sleep(warmup_s + measure_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    win_lo, win_hi = warmup_s, warmup_s + measure_s
+    owed = int(np.sum((sched >= win_lo) & (sched < win_hi)))
+    in_win = [(s, lat, err) for s, lat, err in done
+              if win_lo <= s < win_hi]
+    ok = [lat for s, lat, err in in_win if err is None]
+    errs = [err for s, lat, err in in_win if err is not None]
+    lags = np.asarray([lag for s, lag in sent if win_lo <= s < win_hi])
+    res = {
+        "request_rate": rate,
+        "distribution": distribution,
+        "throughput": len(ok) / measure_s,
+        "owed": owed,
+        "unsent": max(owed - len(in_win), 0),
+        # setup failures happen before any slot is scheduled, so they are
+        # reported unconditionally — not filtered by the window
+        "errors": len(errs) + len(setup_errors),
+        "first_error": (setup_errors[0] if setup_errors
+                        else errs[0] if errs else None),
+        "send_lag_p50_ms": (float(np.percentile(lags, 50) * 1e3)
+                            if lags.size else float("nan")),
+        "send_lag_p99_ms": (float(np.percentile(lags, 99) * 1e3)
+                            if lags.size else float("nan")),
+    }
+    res.update(_latency_stats(ok, extra_percentile))
     return res
 
 
@@ -380,8 +571,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("-i", "--protocol", default="http",
                         type=str.lower, choices=["http", "grpc"])
     parser.add_argument("-b", "--batch-size", type=int, default=1)
-    parser.add_argument("--concurrency-range", default="1",
+    parser.add_argument("--concurrency-range", default=None,
                         help="start:end:step closed-loop concurrency sweep")
+    parser.add_argument("--request-rate-range", default=None,
+                        help="start:end:step OPEN-loop request rates "
+                             "(req/s); latency measured from the scheduled "
+                             "send time (coordinated-omission-free)")
+    parser.add_argument("--request-distribution", default="constant",
+                        type=str.lower, choices=["constant", "poisson"],
+                        help="inter-arrival schedule for --request-rate-range")
+    parser.add_argument("--max-threads", type=int, default=64,
+                        help="sender pool bound for the open-loop mode")
     parser.add_argument("--measurement-interval", type=int, default=5000,
                         help="measurement window per level (ms)")
     parser.add_argument("--shared-memory", default="none", choices=_SHM_MODES)
@@ -399,6 +599,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     if args.streaming and args.protocol != "grpc":
         parser.error("--streaming requires -i grpc")
+    if args.concurrency_range and args.request_rate_range:
+        parser.error("--concurrency-range and --request-rate-range are "
+                     "mutually exclusive (closed- vs open-loop)")
+    if args.concurrency_range is None and args.request_rate_range is None:
+        args.concurrency_range = "1"
 
     url = args.url or ("localhost:8001" if args.protocol == "grpc" else "localhost:8000")
     if args.protocol == "grpc":
@@ -425,40 +630,82 @@ def main(argv: Optional[List[str]] = None) -> int:
     arrays = _make_data(inputs, shapes, args.batch_size,
                         max_batch, rng, args.string_length)
 
-    levels = _parse_concurrency_range(args.concurrency_range)
     measure_s = args.measurement_interval / 1000.0
+    open_loop = args.request_rate_range is not None
     results = []
     print(f"*** Measurement Settings ***\n"
           f"  Batch size: {args.batch_size}\n"
           f"  Measurement window: {args.measurement_interval} msec\n"
           f"  Shared memory: {args.shared_memory}\n"
+          f"  Load mode: "
+          + (f"open-loop ({args.request_distribution} arrivals)"
+             if open_loop else "closed-loop (concurrency)") + "\n"
           f"  Protocol: {args.protocol} @ {url}\n")
-    for level in levels:
-        res = run_level(
-            args.protocol, url, args.model_name, args.model_version, level,
-            arrays, outputs, args.shared_memory, args.output_shared_memory_size,
-            measure_s, extra_percentile=args.percentile,
-            streaming=args.streaming)
+
+    def report(res, lead):
         results.append(res)
         headline = (res[f"p{args.percentile}_us"]
                     if args.percentile is not None else res["avg_us"])
-        print(f"Concurrency: {level}, throughput: {res['throughput']:.2f} "
-              f"infer/sec, latency {headline:.0f} usec"
-              + (f" ({res['errors']} errors)" if res["errors"] else ""))
+        tail = ""
+        if res.get("unsent"):
+            tail += f", {res['unsent']} unsent"
+        if res["errors"]:
+            tail += f" ({res['errors']} errors)"
+        print(f"{lead}{res['throughput']:.2f} infer/sec, "
+              f"latency {headline:.0f} usec" + tail)
         if res["errors"] and res.get("first_error"):
             print(f"  first error: {res['first_error']}")
         if args.verbose:
-            print(f"  p50: {res['p50_us']:.0f} us, p90: {res['p90_us']:.0f} us, "
-                  f"p95: {res['p95_us']:.0f} us, p99: {res['p99_us']:.0f} us")
+            line = (f"  p50: {res['p50_us']:.0f} us, "
+                    f"p90: {res['p90_us']:.0f} us, "
+                    f"p95: {res['p95_us']:.0f} us, "
+                    f"p99: {res['p99_us']:.0f} us")
+            if "send_lag_p99_ms" in res:
+                line += f", send lag p99 {res['send_lag_p99_ms']:.1f} ms"
+            print(line)
+
+    if open_loop:
+        try:
+            rates = _parse_rate_range(args.request_rate_range)
+        except ValueError as e:
+            parser.error(str(e))
+        for rate in rates:
+            res = run_rate_level(
+                args.protocol, url, args.model_name, args.model_version,
+                rate, arrays, outputs, args.shared_memory,
+                args.output_shared_memory_size, measure_s,
+                distribution=args.request_distribution,
+                max_threads=args.max_threads,
+                extra_percentile=args.percentile, streaming=args.streaming)
+            report(res, f"Request rate: {rate:g}/s, completed "
+                        "(latency from scheduled send): ")
+    else:
+        for level in _parse_concurrency_range(args.concurrency_range):
+            res = run_level(
+                args.protocol, url, args.model_name, args.model_version,
+                level, arrays, outputs, args.shared_memory,
+                args.output_shared_memory_size, measure_s,
+                extra_percentile=args.percentile, streaming=args.streaming)
+            report(res, f"Concurrency: {level}, throughput: ")
 
     if args.latency_report_file:
         with open(args.latency_report_file, "w") as f:
-            f.write("Concurrency,Inferences/Second,Avg latency,"
-                    "p50 latency,p90 latency,p95 latency,p99 latency\n")
-            for r in results:
-                f.write(f"{r['concurrency']},{r['throughput']:.2f},"
-                        f"{r['avg_us']:.0f},{r['p50_us']:.0f},{r['p90_us']:.0f},"
-                        f"{r['p95_us']:.0f},{r['p99_us']:.0f}\n")
+            if open_loop:
+                f.write("Request Rate,Inferences/Second,Avg latency,"
+                        "p50 latency,p90 latency,p95 latency,p99 latency,"
+                        "Unsent\n")
+                for r in results:
+                    f.write(f"{r['request_rate']:g},{r['throughput']:.2f},"
+                            f"{r['avg_us']:.0f},{r['p50_us']:.0f},"
+                            f"{r['p90_us']:.0f},{r['p95_us']:.0f},"
+                            f"{r['p99_us']:.0f},{r['unsent']}\n")
+            else:
+                f.write("Concurrency,Inferences/Second,Avg latency,"
+                        "p50 latency,p90 latency,p95 latency,p99 latency\n")
+                for r in results:
+                    f.write(f"{r['concurrency']},{r['throughput']:.2f},"
+                            f"{r['avg_us']:.0f},{r['p50_us']:.0f},{r['p90_us']:.0f},"
+                            f"{r['p95_us']:.0f},{r['p99_us']:.0f}\n")
     failed = all(r["throughput"] == 0 for r in results)
     return 1 if failed else 0
 
