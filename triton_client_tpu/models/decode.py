@@ -1674,6 +1674,28 @@ class GenerateModel:
         return lp
 
     @staticmethod
+    @functools.lru_cache(maxsize=1)
+    def _penalty_fns():
+        """jitted pair for OpenAI frequency/presence penalties:
+        ``pen(logits [1,V], counts [1,V], fp, pp)`` subtracts
+        ``fp*count + pp*(count>0)`` per token (both scalars traced — no
+        recompiles across values), and ``upd(counts, tok [1])`` bumps the
+        chosen token's count for the next step.  Counts live on device for
+        the whole chain — no host round trip per token."""
+
+        @jax.jit
+        def pen(logits, counts, fp, pp):
+            c = counts.astype(jnp.float32)
+            return (logits.astype(jnp.float32)
+                    - fp * c - pp * (c > 0).astype(jnp.float32))
+
+        @jax.jit
+        def upd(counts, tok):
+            return counts.at[0, tok[0]].add(1)
+
+        return pen, upd
+
+    @staticmethod
     @functools.lru_cache(maxsize=16)
     def _sampler(top_k: int, use_top_p: bool = False):
         """Jitted device-side token chooser — temperature scaling, optional
@@ -1756,6 +1778,8 @@ class GenerateModel:
             temperature = float(parameters.get("temperature", 0.0))
             top_k = int(parameters.get("top_k", 0))
             top_p = float(parameters.get("top_p", 1.0))
+            freq_pen = float(parameters.get("frequency_penalty", 0.0))
+            pres_pen = float(parameters.get("presence_penalty", 0.0))
             seed = parameters.get("seed")
             seed = None if seed is None else int(seed)
         except (TypeError, ValueError) as e:
@@ -1769,6 +1793,12 @@ class GenerateModel:
                 f"top_k must be in [0, {cfg.vocab_size}], got {top_k}")
         if not (0.0 < top_p <= 1.0):
             raise InferError(f"top_p must be in (0, 1], got {top_p}")
+        for name, v in (("frequency_penalty", freq_pen),
+                        ("presence_penalty", pres_pen)):
+            if not (-2.0 <= v <= 2.0):
+                raise InferError(
+                    f"{name} must be in [-2, 2], got {v}")
+        use_pen = freq_pen != 0.0 or pres_pen != 0.0
         if seed is None:
             # unseeded sampling must vary across requests
             import os as _os
@@ -1781,13 +1811,13 @@ class GenerateModel:
             window[0, dec._prompt_len - b.size:] = b
         window = np.clip(window, 0, cfg.vocab_size - 1)
 
-        if dec._mode == "batched" and temperature == 0:
+        if dec._mode == "batched" and temperature == 0 and not use_pen:
             # continuous batching for server-side generation: the request
             # joins the decode worker's shared tick — N concurrent greedy
             # generations cost ONE batched device step per token position,
             # with the feedback token never leaving the device.  (Sampled
-            # requests keep the per-request device chain below: sampling
-            # state is per-request.)
+            # and penalized requests keep the per-request device chain
+            # below: sampling/penalty state is per-request.)
             yield from self._generate_batched(window, n_tokens)
             return
 
@@ -1812,13 +1842,27 @@ class GenerateModel:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         lp_of = self._logprob_fn()
+        if use_pen:
+            # OpenAI penalties count "text so far" including the prompt:
+            # seed the device-resident count vector from the REAL prompt
+            # bytes (not the window's zero padding)
+            pen, upd = self._penalty_fns()
+            counts = jnp.asarray(np.bincount(
+                window[0, dec._prompt_len - b.size:] if b.size
+                else np.zeros(0, np.int32),
+                minlength=cfg.vocab_size).astype(np.int32).reshape(1, -1))
+            fp_t, pp_t = jnp.float32(freq_pen), jnp.float32(pres_pen)
         logits, cache = prefill(params, jnp.asarray(window))
         pair_devs = []
         for i in range(n_tokens):
-            tok_dev = choose(logits, i)  # [1], stays on device
-            # chosen token's log-probability under the raw-logit softmax,
-            # stacked with the token so the prefetched readback stays ONE
-            # fused D2H per step
+            cur = pen(logits, counts, fp_t, pp_t) if use_pen else logits
+            tok_dev = choose(cur, i)  # [1], stays on device
+            if use_pen:
+                counts = upd(counts, tok_dev)
+            # chosen token's log-probability under the raw-logit softmax
+            # (OpenAI semantics: logprobs report the unmodified
+            # distribution, whatever sampling/penalties did), stacked with
+            # the token so the prefetched readback stays ONE fused D2H
             pair = jnp.stack([tok_dev.astype(jnp.float32),
                               lp_of(logits, tok_dev)])
             if hasattr(pair, "copy_to_host_async"):

@@ -18,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, NamedTuple, Optional
 
 import numpy as np
 from aiohttp import web
@@ -50,14 +50,18 @@ def _parse_stop(stop) -> List[str]:
 class _StopScanner:
     """Streams text through stop-sequence matching.
 
-    ``feed(piece)`` returns the text that is now safe to emit: the scanner
-    holds back the last ``max(len(stop)) - 1`` characters so a streamed delta
-    can never contain (a prefix of) a stop sequence that a later token
-    completes — once emitted, a delta cannot be retracted.  When a stop
-    sequence matches, the text before the match is released, the stop text
-    itself is swallowed (OpenAI contract), and ``stopped`` latches.
-    ``tokens`` counts every model token consumed, including those inside the
-    stop sequence — that is what the generation actually cost, so it is what
+    ``feed(piece, lp)`` returns ``(text, lps)`` — the text that is now safe
+    to emit plus the per-character logprob records riding with it: the
+    scanner holds back the last ``max(len(stop)) - 1`` characters so a
+    streamed delta can never contain (a prefix of) a stop sequence that a
+    later token completes — once emitted, a delta cannot be retracted.
+    Logprobs travel WITH their characters (the byte models emit one char
+    per token, so released spans align 1:1 with token logprob records —
+    this is what makes streaming logprobs exact).  When a stop sequence
+    matches, the text before the match is released, the stop text itself
+    is swallowed (OpenAI contract), and ``stopped`` latches.  ``tokens``
+    counts every model token consumed, including those inside the stop
+    sequence — that is what the generation actually cost, so it is what
     ``usage.completion_tokens`` reports.
     """
 
@@ -65,33 +69,41 @@ class _StopScanner:
         self._stops = stops
         self._hold = max((len(s) for s in stops), default=1) - 1
         self._buf = ""
+        self._lps: List[Optional[float]] = []  # per char of _buf
         self.stopped = False
         self.tokens = 0
 
-    def feed(self, piece: str) -> str:
+    def feed(self, piece: str, lp: Optional[float] = None):
         self.tokens += 1
+        # a multi-char piece carries ONE token's logprob: it rides on the
+        # first char (byte models emit 1 char per token, so this is exact)
+        piece_lps = ([lp] + [None] * (len(piece) - 1)) if piece else []
         if not self._stops:
-            return piece
+            return piece, piece_lps
         self._buf += piece
+        self._lps += piece_lps
         first = -1
         for s in self._stops:
             i = self._buf.find(s)
             if i >= 0 and (first < 0 or i < first):
                 first = i
         if first >= 0:
-            out, self._buf = self._buf[:first], ""
+            out, lps = self._buf[:first], self._lps[:first]
+            self._buf, self._lps = "", []
             self.stopped = True
-            return out
+            return out, lps
         if len(self._buf) > self._hold:
             cut = len(self._buf) - self._hold
-            out, self._buf = self._buf[:cut], self._buf[cut:]
-            return out
-        return ""
+            out, lps = self._buf[:cut], self._lps[:cut]
+            self._buf, self._lps = self._buf[cut:], self._lps[cut:]
+            return out, lps
+        return "", []
 
-    def flush(self) -> str:
+    def flush(self):
         """Natural end of generation: the held-back tail is real output."""
-        out, self._buf = self._buf, ""
-        return out
+        out, lps = self._buf, self._lps
+        self._buf, self._lps = "", []
+        return out, lps
 
 
 def add_openai_routes(app: web.Application, core: InferenceCore) -> None:
@@ -167,7 +179,42 @@ def _prompt_from_messages(messages: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
-def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
+#: Recognized-but-unsupported parameters, rejected loudly per endpoint — a
+#: silently ignored knob would return 200s that look honored but are not.
+#: Everything NOT here and not honored in _build_request is outside the
+#: documented OpenAI surface (unknown keys are ignored, OpenAI-style).
+_REJECT_ALWAYS = {
+    "stream_options": "'stream_options' is not supported",
+    "logit_bias": "'logit_bias' is not supported",
+}
+_REJECT_COMPLETIONS = {
+    "suffix": "'suffix' (insertion mode) is not supported",
+}
+_REJECT_CHAT = {
+    "top_logprobs": "'top_logprobs' is not supported; 'logprobs' returns "
+                    "the chosen token's logprob",
+    "response_format": "'response_format' is not supported",
+    "tools": "'tools' is not supported",
+    "tool_choice": "'tool_choice' is not supported",
+    "functions": "'functions' is not supported",
+    "function_call": "'function_call' is not supported",
+    "parallel_tool_calls": "'parallel_tool_calls' is not supported",
+    "store": "'store' is not supported (completions are not persisted)",
+    "metadata": "'metadata' is not supported (nothing is stored to attach "
+                "it to)",
+    "service_tier": "'service_tier' is not supported",
+    "prediction": "'prediction' (predicted outputs) is not supported",
+    "audio": "'audio' output is not supported",
+    "modalities": "'modalities' is not supported (text only)",
+    "reasoning_effort": "'reasoning_effort' is not supported",
+    "best_of": "'best_of' is a completions parameter, not chat",
+    "echo": "'echo' is a completions parameter, not chat",
+    "suffix": "'suffix' is a completions parameter, not chat",
+}
+
+
+def _build_request(core, body: Dict[str, Any], prompt: str,
+                   chat: bool) -> "_ParsedRequest":
     model_name = body.get("model")
     if not model_name:
         raise InferError("'model' is required")
@@ -177,20 +224,23 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
             f"model '{model_name}' does not speak the generate contract "
             "(decoupled, text_input)")
     # honored params are cast under a 400 guard; recognized-but-unsupported
-    # params are rejected loudly — a silently ignored knob would return
-    # 200s that look honored but are not
-    if body.get("stream_options"):
-        raise InferError("'stream_options' is not supported")
+    # params are rejected loudly (tests enumerate the documented surface:
+    # every parameter is honored-with-effect or 400s)
+    rejects = dict(_REJECT_ALWAYS)
+    rejects.update(_REJECT_CHAT if chat else _REJECT_COMPLETIONS)
+    for key, msg in rejects.items():
+        if body.get(key):
+            raise InferError(msg)
     n = body.get("n")
     if n is None:
         n = 1
     if not isinstance(n, int) or isinstance(n, bool) or not 1 <= n <= _MAX_N:
         raise InferError(f"'n' must be an integer in [1, {_MAX_N}]")
     stops = _parse_stop(body.get("stop"))
-    # chosen-token logprobs: non-streaming only (streamed deltas are
-    # stop-scanner spans, not 1:1 with tokens); alternatives are rejected
-    # loudly in BOTH spellings (completions logprobs>=1, chat
-    # top_logprobs) rather than silently degraded
+    # chosen-token logprobs, streaming AND non-streaming (chunks carry the
+    # records aligned with their released text — see _StopScanner);
+    # alternatives are rejected loudly in BOTH spellings rather than
+    # silently degraded
     raw_lp = body.get("logprobs")
     if raw_lp is None or raw_lp is False:
         want_logprobs = False
@@ -202,15 +252,31 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
             "use logprobs: true (or 0) for chosen-token logprobs")
     else:
         raise InferError("'logprobs' must be a boolean or integer")
-    if body.get("top_logprobs"):
-        raise InferError("'top_logprobs' is not supported; 'logprobs' "
-                         "returns the chosen token's logprob")
-    if want_logprobs and body.get("stream"):
-        raise InferError("'logprobs' with 'stream' is not supported")
+    # completions-only extensions: best_of candidate ranking and echo
+    best_of = body.get("best_of")
+    if best_of is None:
+        best_of = n
+    if (not isinstance(best_of, int) or isinstance(best_of, bool)
+            or not n <= best_of <= _MAX_N):
+        raise InferError(
+            f"'best_of' must be an integer in [n, {_MAX_N}] (got "
+            f"{best_of!r}, n={n})")
+    if best_of > n and body.get("stream"):
+        raise InferError("'best_of' > n cannot be streamed (candidates "
+                         "must complete before ranking)")
+    echo = bool(body.get("echo", False))
+    if echo and want_logprobs:
+        raise InferError(
+            "'echo' with 'logprobs' is not supported (prompt-token "
+            "logprobs are not computed)")
     parameters: Dict[str, Any] = {}
     try:
-        if body.get("max_tokens") is not None:
-            parameters["max_tokens"] = int(body["max_tokens"])
+        max_tokens = body.get("max_tokens")
+        if max_tokens is None and chat:
+            # chat-only spelling of the same knob (newer OpenAI API)
+            max_tokens = body.get("max_completion_tokens")
+        if max_tokens is not None:
+            parameters["max_tokens"] = int(max_tokens)
         if body.get("temperature") is not None:
             parameters["temperature"] = float(body["temperature"])
         if body.get("seed") is not None:
@@ -225,17 +291,24 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
                 parameters["temperature"] = 1.0
         if body.get("top_k") is not None:  # extension beyond OpenAI
             parameters["top_k"] = int(body["top_k"])
+        for pen in ("frequency_penalty", "presence_penalty"):
+            if body.get(pen) is not None:
+                parameters[pen] = float(body[pen])
+                if not -2.0 <= parameters[pen] <= 2.0:
+                    raise ValueError(f"'{pen}' must be in [-2, 2]")
     except (TypeError, ValueError) as e:
         raise InferError(f"invalid sampling parameter: {e}")
     reqs = []
-    for i in range(n):
+    for i in range(best_of):
         p = dict(parameters)
-        if "seed" in p and n > 1:
-            # a fixed seed must still give n distinct samples — per-choice
+        if "seed" in p and best_of > 1:
+            # a fixed seed must still give distinct candidates — per-choice
             # offset keeps the whole response reproducible
             p["seed"] = p["seed"] + i
         outputs = [RequestedOutput(name="text_output", binary_data=False)]
-        if want_logprobs:
+        if want_logprobs or best_of > n:
+            # best_of ranks candidates by mean token logprob, so the
+            # stream must carry them even when the client didn't ask
             outputs.append(RequestedOutput(name="logprob", binary_data=False))
         reqs.append(InferRequest(
             model_name=model_name,
@@ -245,7 +318,18 @@ def _build_request(core, body: Dict[str, Any], prompt: str) -> tuple:
             outputs=outputs,
             parameters=p,
         ))
-    return model_name, reqs, stops, want_logprobs
+    return _ParsedRequest(model_name, reqs, stops, want_logprobs,
+                          n, best_of, echo)
+
+
+class _ParsedRequest(NamedTuple):
+    model_name: str
+    reqs: List[InferRequest]
+    stops: List[str]
+    want_logprobs: bool
+    n: int
+    best_of: int
+    echo: bool
 
 
 def _choice(index: int, kind: str, delta_or_text: Optional[str],
@@ -272,15 +356,14 @@ def _envelope(rid: str, created: int, model: str, kind: str, chat: bool,
             "choices": choices}
 
 
-async def _consume(core, req, scanner: _StopScanner, emit,
-                   lp_out: Optional[list] = None) -> str:
+async def _consume(core, req, scanner: _StopScanner, emit) -> str:
     """Drive one generation stream through the stop scanner, calling
-    ``await emit(text)`` for each releasable span; ``lp_out`` (when given)
-    collects the chosen-token logprob per CONSUMED token, aligned with the
-    byte model's 1-char-per-token text.  Returns the finish reason.
-    Closing the stream early (stop hit) propagates through
-    ``infer_stream`` to the model generator, which frees its decode slot
-    instead of generating unread tokens."""
+    ``await emit(text, lps)`` for each releasable span — ``lps`` is the
+    span's per-character logprob records (None entries for chars beyond a
+    multi-char token's first; exact 1:1 under the byte models).  Returns
+    the finish reason.  Closing the stream early (stop hit) propagates
+    through ``infer_stream`` to the model generator, which frees its
+    decode slot instead of generating unread tokens."""
     agen = core.infer_stream(req)
     try:
         async for resp in agen:
@@ -297,19 +380,36 @@ async def _consume(core, req, scanner: _StopScanner, emit,
             for j, v in enumerate(texts):
                 piece = (v.decode("utf-8", "replace")
                          if isinstance(v, bytes) else str(v))
-                if lp_out is not None and lps is not None and j < len(lps):
-                    lp_out.append(float(lps[j]))
-                out = scanner.feed(piece)
+                lp = (float(lps[j])
+                      if lps is not None and j < len(lps) else None)
+                out, out_lps = scanner.feed(piece, lp)
                 if out:
-                    await emit(out)
+                    await emit(out, out_lps)
                 if scanner.stopped:
                     return "stop"
-        tail = scanner.flush()
+        tail, tail_lps = scanner.flush()
         if tail:
-            await emit(tail)
+            await emit(tail, tail_lps)
         return "length"
     finally:
         await agen.aclose()
+
+
+def _lp_payload(records, chat: bool):
+    """OpenAI logprobs structure from [(char, lp, text_offset)] records."""
+    if chat:
+        # full ChatCompletionTokenLogprob shape (bytes + empty
+        # top_logprobs) so strict SDK parsers validate
+        return {"content": [
+            {"token": ch, "logprob": lp,
+             "bytes": list(ch.encode()), "top_logprobs": []}
+            for ch, lp, _off in records]}
+    return {
+        "tokens": [ch for ch, _lp, _off in records],
+        "token_logprobs": [lp for _ch, lp, _off in records],
+        "top_logprobs": None,
+        "text_offset": [off for _ch, _lp, off in records],
+    }
 
 
 async def _run(core, request, chat: bool):
@@ -322,8 +422,9 @@ async def _run(core, request, chat: bool):
         prompt = body.get("prompt", "")
         if not isinstance(prompt, str):
             raise InferError("'prompt' must be a string")
-    model_name, reqs, stops, want_logprobs = _build_request(
-        core, body, prompt)
+    pr = _build_request(core, body, prompt, chat)
+    model_name, reqs, stops = pr.model_name, pr.reqs, pr.stops
+    want_logprobs = pr.want_logprobs
     rid = f"cmpl-{next(_COUNTER)}"
     created = int(time.time())
 
@@ -331,14 +432,20 @@ async def _run(core, request, chat: bool):
         async def run_choice(req):
             scanner = _StopScanner(stops)
             pieces: List[str] = []
-            lps: List[float] = []
+            records: List[tuple] = []  # (char, lp, text_offset)
+            sent = [0]
 
-            async def emit(text):
+            async def emit(text, lps):
+                base = sent[0]
                 pieces.append(text)
+                sent[0] += len(text)
+                records.extend(
+                    (ch, lp, base + k)
+                    for k, (ch, lp) in enumerate(zip(text, lps))
+                    if lp is not None)
 
-            finish = await _consume(core, req, scanner, emit,
-                                    lps if want_logprobs else None)
-            return "".join(pieces), scanner.tokens, finish, lps
+            finish = await _consume(core, req, scanner, emit)
+            return "".join(pieces), scanner.tokens, finish, records
 
         # fail fast: the first failing choice (e.g. 429 slot exhaustion)
         # cancels its siblings instead of letting them generate to
@@ -351,31 +458,25 @@ async def _run(core, request, chat: bool):
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             raise
+        completion_tokens = sum(t for _, t, _f, _l in results)
+        if pr.best_of > pr.n:
+            # rank candidates by mean chosen-token logprob (OpenAI: "the
+            # one with the highest log probability per token") and return
+            # the n best; usage still counts every candidate generated
+            def mean_lp(res):
+                recs = res[3]
+                return (sum(lp for _c, lp, _o in recs) / len(recs)
+                        if recs else float("-inf"))
+
+            results = sorted(results, key=mean_lp, reverse=True)[:pr.n]
         choices = []
-        for i, (text, _tokens, finish, lps) in enumerate(results):
+        for i, (text, _tokens, finish, records) in enumerate(results):
+            if pr.echo:
+                text = prompt + text
             entry = _choice(i, "full", text, finish, chat)
             if want_logprobs:
-                # the stop scanner may have swallowed consumed tokens:
-                # report logprobs for the EMITTED text only (1 token per
-                # char under the byte model)
-                lps = lps[:len(text)]
-                if chat:
-                    # full ChatCompletionTokenLogprob shape (bytes +
-                    # empty top_logprobs) so strict SDK parsers validate
-                    entry["logprobs"] = {"content": [
-                        {"token": ch, "logprob": lp,
-                         "bytes": list(ch.encode()), "top_logprobs": []}
-                        for ch, lp in zip(text, lps)]}
-                else:
-                    entry["logprobs"] = {
-                        "tokens": list(text),
-                        "token_logprobs": lps,
-                        "top_logprobs": None,
-                        # 1 char per token under the byte model
-                        "text_offset": list(range(len(text))),
-                    }
+                entry["logprobs"] = _lp_payload(records, chat)
             choices.append(entry)
-        completion_tokens = sum(t for _, t, _f, _l in results)
         out = _envelope(rid, created, model_name, "full", chat, choices)
         out["usage"] = {
             "prompt_tokens": len(prompt.encode()),
@@ -396,10 +497,32 @@ async def _run(core, request, chat: bool):
 
         async def run_choice(i, req):
             scanner = _StopScanner(stops)
+            sent = [len(prompt) if pr.echo else 0]
+            # echo's prompt frame leads the stream (OpenAI contract), but
+            # it must NOT be queued before generation starts: sse_stream
+            # pulls the first frame before committing headers so
+            # pre-generation failures (429 slot exhaustion) stay real HTTP
+            # statuses — an early prompt frame would demote them to 200 +
+            # in-band error
+            pending_echo = [pr.echo]
+
+            async def put_echo():
+                if pending_echo[0]:
+                    pending_echo[0] = False
+                    await q.put((i, "delta", (prompt, [])))
+
+            async def emit(text, lps):
+                await put_echo()
+                base = sent[0]
+                sent[0] += len(text)
+                records = [(ch, lp, base + k)
+                           for k, (ch, lp) in enumerate(zip(text, lps))
+                           if lp is not None]
+                await q.put((i, "delta", (text, records)))
+
             try:
-                finish = await _consume(
-                    core, req, scanner,
-                    lambda text: q.put((i, "delta", text)))
+                finish = await _consume(core, req, scanner, emit)
+                await put_echo()  # zero-delta generations still echo
                 await q.put((i, "finish", finish))
             except Exception as e:  # noqa: BLE001 — re-raised by the reader
                 await q.put((i, "error", e))
@@ -423,7 +546,10 @@ async def _run(core, request, chat: bool):
     async def write_frame(stream, item):
         i, kind, payload = item
         if kind == "delta":
-            entry = _choice(i, "chunk", payload, None, chat)
+            text, records = payload
+            entry = _choice(i, "chunk", text, None, chat)
+            if want_logprobs:
+                entry["logprobs"] = _lp_payload(records, chat)
         else:
             entry = _choice(i, "chunk", None, payload, chat)
         frame = _envelope(rid, created, model_name, "chunk", chat, [entry])
