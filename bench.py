@@ -226,7 +226,7 @@ def _measure_generation_ab() -> dict:
 
     keys = ("TRITON_TPU_DECODE_MODE", "TRITON_TPU_DECODE_SLOTS",
             "TRITON_TPU_PREFILL_CHUNK", "TRITON_TPU_DECODE_BUCKETS",
-            "TRITON_TPU_QUANT")
+            "TRITON_TPU_QUANT", "TRITON_TPU_KV_QUANT")
     saved = {k: os.environ.get(k) for k in keys}
     out: dict = {}
 
@@ -275,14 +275,18 @@ def _measure_generation_ab() -> dict:
             "TRITON_TPU_DECODE_SLOTS": "32",
         }, [(8, 16), (16, 32)])
         P = language.LLAMA_SEQ_LEN
-        # bucketed capacity point: 64 slabs of prompt+32 tokens hold the
-        # c=64 sweep in ~the same HBM as the flat 32 x 2P layout
-        # (64(P+32) vs 64P: +2.4% at P=128), proving generation
-        # concurrency scales past the old 32-slot cap
+        # bucketed capacity points (r5: same-cap POOLS — 8 independent
+        # 32-slot buckets, so a tick only steps pools holding active work
+        # and the step width stays 32 at any concurrency — plus int8 KV):
+        # c=64 for the like-for-like row and c=256 for the capacity proof
+        # (benchmarks/GEN_CAPACITY.json has the full pool-shape sweep:
+        # one 256-wide bucket collapses to 26 tok/s, 8x32 pools hold
+        # ~100-122 tok/s flat from c=64 through c=256)
         run_mode("batched", "bucketed", {
             "TRITON_TPU_PREFILL_CHUNK": "32",
-            "TRITON_TPU_DECODE_BUCKETS": f"64x{P + 32}",
-        }, [(64, 64)])
+            "TRITON_TPU_DECODE_BUCKETS": ",".join([f"32x{P + 32}"] * 8),
+            "TRITON_TPU_KV_QUANT": "int8",
+        }, [(64, 64), (256, 256)])
     finally:
         for k, v in saved.items():
             if v is None:

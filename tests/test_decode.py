@@ -814,6 +814,43 @@ class TestBucketedCache:
         got = self._tokens(gen_b, b"bucket identity", 6)
         assert got == want and len(got) == 6
 
+    def test_same_cap_pools_are_independent_and_identical(self, monkeypatch,
+                                                          flat):
+        """Repeated caps = separate pools: capacity spreads across buckets
+        (tick width stays at the pool size — the c=256 scaling lever,
+        benchmarks/GEN_CAPACITY.json) with tokens identical to the flat
+        layout."""
+        from triton_client_tpu.models.decode import (DecodeModel,
+                                                     GenerateModel)
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_BUCKETS", "2x160,2x160")
+        dec = DecodeModel(name="llama_decode_twin")
+        gen = GenerateModel(dec, name="llama_generate_twin")
+        try:
+            assert dec._buckets == [(2, 160), (2, 160)]
+            _, gen_f = flat
+            want = self._tokens(gen_f, b"twin pools", 6)
+            # four concurrent generations: allocation packs pool 0 first,
+            # then spills into pool 1 — all four token-identical to flat
+            win = np.zeros((1, 128), np.int32)
+            win[0, -len(b"twin pools"):] = np.frombuffer(b"twin pools",
+                                                         np.uint8)
+            sinks = [dec.submit_generation(win, 6) for _ in range(4)]
+            outs = []
+            for s in sinks:
+                toks = []
+                while True:
+                    item = s.get(timeout=300)
+                    if item is None:
+                        break
+                    assert not isinstance(item, Exception), item
+                    toks.append(int(item[0]))
+                outs.append(toks)
+            assert all(o == want for o in outs), (outs, want)
+        finally:
+            dec._shutdown()
+
     def test_short_generations_fill_then_spill_up(self, bucketed):
         from triton_client_tpu.server.types import InferError
 
@@ -889,8 +926,7 @@ class TestBucketedCache:
         monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
         for spec, msg in [("nonsense", "expected <count>x<tokens>"),
                           ("0x160", "must be positive"),
-                          ("2x64", "must exceed"),       # cap < prompt 128
-                          ("2x160,2x160", "duplicate cap")]:
+                          ("2x64", "must exceed")]:      # cap < prompt 128
             monkeypatch.setenv("TRITON_TPU_DECODE_BUCKETS", spec)
             with pytest.raises(ValueError, match=msg):
                 DecodeModel(name="llama_decode_badbuck")

@@ -320,6 +320,16 @@ def parse_cache_buckets(spec, n_slots: int, s_max: int, prompt_len: int):
     layout.  Returns ``[(count, cap), ...]`` ascending by cap; every cap
     must exceed the prefill window (a slab must at least hold the prompt
     plus one generated token).
+
+    REPEATED caps are kept as SEPARATE pools (``"64x160,64x160"`` = two
+    independent 64-slot buckets): each bucket is its own static-shape
+    device step, and a tick only steps buckets holding active work — so
+    splitting a large same-size pool bounds the per-tick batch width and
+    cache read at the pool size.  One 256-wide bucket pays a 256-wide
+    step (and reads the whole 256-slab cache) even with 64 live slots;
+    4×64 at the same capacity ticks one bucket.  Allocation fills pools
+    in spec order, keeping live slots packed in the fewest buckets
+    (measured: benchmarks/GEN_CAPACITY.json).
     """
     if not spec:
         return [(n_slots, s_max)]
@@ -341,12 +351,7 @@ def parse_cache_buckets(spec, n_slots: int, s_max: int, prompt_len: int):
                 f"TRITON_TPU_DECODE_BUCKETS: cap {cap} must exceed the "
                 f"{prompt_len}-token prefill window (prompt + >=1 token)")
         out.append((cnt, cap))
-    out.sort(key=lambda t: t[1])
-    caps = [c for _, c in out]
-    if len(set(caps)) != len(caps):
-        raise ValueError(
-            f"TRITON_TPU_DECODE_BUCKETS: duplicate cap in {spec!r}; merge "
-            "the counts instead")
+    out.sort(key=lambda t: t[1])  # stable: same-cap pools keep spec order
     return out
 
 
@@ -880,11 +885,15 @@ class DecodeModel:
 
         Generations (known length) fill smallest-fitting-first so short
         requests never burn a long slab; sequences (open-ended length)
-        prefer the largest bucket so they keep maximum headroom before the
-        cap error asks for sequence_end."""
+        prefer the largest CAP so they keep maximum headroom before the
+        cap error asks for sequence_end — but among same-cap pools both
+        break ties toward the FIRST pool, keeping live slots packed in
+        the fewest buckets (each active bucket is its own device step
+        per tick; see parse_cache_buckets)."""
         order = range(len(self._buckets))
         if prefer_large:
-            order = reversed(order)
+            order = sorted(order,
+                           key=lambda i: (-self._buckets[i][1], i))
         for b in order:
             cnt, cap = self._buckets[b]
             if cap < need_s:
