@@ -383,8 +383,11 @@ class TestInlineFastPath:
             prof = h.core._inline_profiles.get("simple@1")
             assert prof is not None and prof.ema
             # host-placed sub-ms model must have earned the inline path
-            assert prof.allows(tuple(sorted(
-                ("INPUT%d" % i, (1, 16), "int32") for i in range(2))))
+            # signatures carry the dtype OBJECT (str(dtype) per request was
+            # a measured hot-path cost; benchmarks/HOTPATH_PROFILE.md)
+            assert prof.allows(tuple(
+                ("INPUT%d" % i, (1, 16), np.dtype(np.int32))
+                for i in range(2)))
 
 
 class TestReloadInvalidation:

@@ -45,9 +45,22 @@ def make_simple() -> JaxModel:
     )
 
     def fn(INPUT0, INPUT1):
-        return {"OUTPUT0": jnp.add(INPUT0, INPUT1), "OUTPUT1": jnp.subtract(INPUT0, INPUT1)}
+        # wire-path requests arrive as plain numpy: int32 add/sub in numpy
+        # is ~2 us where the jitted-jax dispatch costs ~100 us under the
+        # serving loop's GIL contention (benchmarks/HOTPATH_PROFILE.md) —
+        # this model IS the headline protocol benchmark, so the protocol
+        # path must not pay accelerator-dispatch overhead for host math.
+        # Device-resident inputs (zero-copy xla-shm) keep the jax path and
+        # its device semantics.
+        if type(INPUT0) is np.ndarray and type(INPUT1) is np.ndarray:
+            return {"OUTPUT0": INPUT0 + INPUT1, "OUTPUT1": INPUT0 - INPUT1}
+        return {"OUTPUT0": jnp.add(INPUT0, INPUT1),
+                "OUTPUT1": jnp.subtract(INPUT0, INPUT1)}
 
-    return JaxModel(cfg, fn)
+    # jit=False: the numpy/jax branch is a host-side type dispatch (a jit
+    # trace would bake the jax branch in), and two eager element-wise ops
+    # need no fusion
+    return JaxModel(cfg, fn, jit=False)
 
 
 def make_simple_string() -> PyModel:

@@ -726,9 +726,13 @@ class InferenceCore:
                 # execution (a potential XLA compile) never runs inline
                 prof = _InlineProfile(generation=gen)
                 self._inline_profiles[prof_key] = prof
+            # dtype objects are hashable/comparable by equality — building
+            # str(dtype) here cost ~100 us/request of pure overhead on the
+            # profiled hot path (benchmarks/HOTPATH_PROFILE.md); sort by
+            # name only (the other elements never tie-break)
             sig = tuple(sorted(
-                (n, getattr(v, "shape", None), str(getattr(v, "dtype", "")))
-                for n, v in inputs.items()))
+                ((n, getattr(v, "shape", None), getattr(v, "dtype", None))
+                 for n, v in inputs.items()), key=lambda t: t[0]))
             if prof.allows(sig):
                 t0 = time.perf_counter()
                 try:

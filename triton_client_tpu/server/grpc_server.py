@@ -12,6 +12,7 @@ use it (e.g. the Go generated example).
 from __future__ import annotations
 
 import asyncio
+import math
 from typing import Any, Dict, Optional
 
 import grpc
@@ -123,7 +124,9 @@ def _raw_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray:
     dt = triton_to_np_dtype(datatype)
     if dt is None:
         raise InferError(f"unsupported datatype '{datatype}' for input '{name}'")
-    count = int(np.prod(shape)) if len(shape) else 1
+    # math.prod over python ints (empty shape -> 1): np.prod pays a
+    # ufunc-reduction dispatch per request on this per-tensor hot path
+    count = math.prod(shape)
     if len(chunk) != count * dt.itemsize:
         raise InferError(
             f"unexpected total byte size {len(chunk)} for input '{name}', "
