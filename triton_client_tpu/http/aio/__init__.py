@@ -64,6 +64,18 @@ class InferenceServerClient(InferenceServerClientBase):
     def _build_headers(self, headers: Optional[dict]) -> dict:
         request = Request(dict(headers) if headers else {})
         self._call_plugin(request)
+        # reference aio client :122-134: hop-by-hop framing headers would
+        # corrupt the binary-over-HTTP body; reject rather than forward
+        bad = [
+            k
+            for k in request.headers
+            if k.lower() in ("transfer-encoding",)
+        ]
+        if bad:
+            raise_error(
+                f"Unsupported headers {bad}; use a different client or "
+                "remove them."
+            )
         return request.headers
 
     def _uri(self, path: str, query_params: Optional[dict]) -> str:
@@ -290,6 +302,14 @@ class InferenceServerClient(InferenceServerClientBase):
     unregister_xla_shared_memory = unregister_cuda_shared_memory
 
     # -- inference ---------------------------------------------------------
+    # store-and-forward statics (reference aio :661-689): same contract as
+    # the sync client's — aliased so the two cannot drift
+    from .._client import InferenceServerClient as _Sync
+
+    generate_request_body = staticmethod(_Sync.generate_request_body)
+    parse_response_body = staticmethod(_Sync.parse_response_body)
+    del _Sync
+
     async def infer(
         self,
         model_name,

@@ -14,6 +14,7 @@ import base64
 import binascii
 import gzip
 import json
+import math
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -549,7 +550,9 @@ def _bytes_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray
     dt = triton_to_np_dtype(datatype)
     if dt is None:
         raise InferError(f"unsupported datatype '{datatype}' for input '{name}'")
-    count = int(np.prod(shape)) if len(shape) else 1
+    # math.prod over python ints (empty shape -> 1): same hot-path fix as
+    # the gRPC decoder (benchmarks/HOTPATH_PROFILE.md)
+    count = math.prod(shape)
     expected = count * dt.itemsize
     if len(chunk) != expected:
         raise InferError(
