@@ -77,3 +77,23 @@ def test_cli_generate_endpoint(server):
         "--output-tokens", "2", "--num-requests", "1",
     ])
     assert rc == 0
+
+
+def test_itl_steady_is_burst_insensitive(server):
+    """itl_steady (per-request (last-first)/(n-1)) must be reported and be
+    self-consistent with the per-stream token cadence — the raw-gap p50
+    under-reads when prefetched readbacks land in bursts (BASELINE row 10's
+    old disclaimer; benchmarks/HOTPATH_PROFILE.md companion fix)."""
+    from triton_client_tpu.genai_perf import profile_generate
+
+    rep = profile_generate(f"127.0.0.1:{server.http_port}",
+                           "llama_generate", concurrency=1,
+                           output_tokens=8, num_requests=2,
+                           stream_timeout=600.0)
+    assert rep["errors"] == 0, rep
+    steady = rep["itl_steady_ms"]
+    assert steady and steady["p50"] > 0
+    # by construction: steady ~= (request_latency - ttft) / (n - 1)
+    want = (rep["request_latency_ms"]["avg"]
+            - rep["time_to_first_token_ms"]["avg"]) / (8 - 1)
+    assert steady["avg"] == pytest.approx(want, rel=0.35)
