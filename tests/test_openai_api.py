@@ -542,12 +542,10 @@ class TestParameterSurfaceComplete:
         "stream", "temperature", "top_p", "user",
     }
     REJECTED_COMPLETIONS = {
-        "stream_options": {"include_usage": True},
         "logit_bias": {"50256": -100},
         "suffix": " and done",
     }
     REJECTED_CHAT = {
-        "stream_options": {"include_usage": True},
         "logit_bias": {"50256": -100},
         "top_logprobs": 2,
         "response_format": {"type": "json_object"},
@@ -598,3 +596,49 @@ class TestParameterSurfaceComplete:
         }) as r:
             out = json.loads(r.read())
         assert out["usage"]["completion_tokens"] == 3
+
+
+class TestStreamOptions:
+    """stream_options.include_usage is HONORED: data chunks carry
+    usage: null, a final usage chunk with empty choices precedes [DONE];
+    unknown stream_options keys and non-stream use are loud 400s."""
+
+    def test_include_usage_final_chunk(self, server):
+        with _post(server.http_url, "/v1/chat/completions", {
+            "model": "llama_generate",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "stream": True, "n": 2,
+            "stream_options": {"include_usage": True},
+        }) as r:
+            frames, done = _sse_frames(r)
+        assert done
+        # every data chunk carries usage: null
+        for f in frames[:-1]:
+            assert "usage" in f and f["usage"] is None, f
+        final = frames[-1]
+        assert final["choices"] == []
+        assert final["usage"]["completion_tokens"] == 6  # 2 choices x 3
+        assert final["usage"]["total_tokens"] == (
+            final["usage"]["prompt_tokens"] + 6)
+
+    def test_without_option_no_usage_fields(self, server):
+        with _post(server.http_url, "/v1/completions", {
+            "model": "llama_generate", "prompt": "x", "max_tokens": 2,
+            "stream": True,
+        }) as r:
+            frames, done = _sse_frames(r)
+        assert done
+        assert all("usage" not in f for f in frames)
+
+    def test_bad_stream_options_400(self, server):
+        for body_extra in (
+                {"stream_options": {"include_usage": True}},  # no stream
+                {"stream": True, "stream_options": {"weird": 1}},
+                {"stream": True, "stream_options": "yes"},
+                {"stream": True,
+                 "stream_options": {"include_usage": "yes"}}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.http_url, "/v1/completions",
+                      {"model": "llama_generate", "prompt": "x",
+                       **body_extra})
+            assert e.value.code == 400, body_extra

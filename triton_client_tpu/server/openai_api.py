@@ -184,7 +184,6 @@ def _prompt_from_messages(messages: List[Dict[str, Any]]) -> str:
 #: Everything NOT here and not honored in _build_request is outside the
 #: documented OpenAI surface (unknown keys are ignored, OpenAI-style).
 _REJECT_ALWAYS = {
-    "stream_options": "'stream_options' is not supported",
     "logit_bias": "'logit_bias' is not supported",
 }
 _REJECT_COMPLETIONS = {
@@ -211,6 +210,27 @@ _REJECT_CHAT = {
     "echo": "'echo' is a completions parameter, not chat",
     "suffix": "'suffix' is a completions parameter, not chat",
 }
+
+
+def _parse_stream_options(body: Dict[str, Any]) -> bool:
+    """``stream_options``: {"include_usage": bool} is honored (a final
+    usage chunk with empty choices before [DONE], usage: null on data
+    chunks — OpenAI contract); anything else in it is rejected loudly."""
+    opts = body.get("stream_options")
+    if opts is None:
+        return False
+    if not isinstance(opts, dict):
+        raise InferError("'stream_options' must be an object")
+    if not body.get("stream"):
+        raise InferError("'stream_options' requires 'stream': true")
+    unknown = set(opts) - {"include_usage"}
+    if unknown:
+        raise InferError(
+            f"unsupported stream_options key(s): {sorted(unknown)}")
+    include = opts.get("include_usage", False)
+    if not isinstance(include, bool):
+        raise InferError("'stream_options.include_usage' must be a boolean")
+    return include
 
 
 def _build_request(core, body: Dict[str, Any], prompt: str,
@@ -319,7 +339,7 @@ def _build_request(core, body: Dict[str, Any], prompt: str,
             parameters=p,
         ))
     return _ParsedRequest(model_name, reqs, stops, want_logprobs,
-                          n, best_of, echo)
+                          n, best_of, echo, _parse_stream_options(body))
 
 
 class _ParsedRequest(NamedTuple):
@@ -330,6 +350,7 @@ class _ParsedRequest(NamedTuple):
     n: int
     best_of: int
     echo: bool
+    include_usage: bool
 
 
 def _choice(index: int, kind: str, delta_or_text: Optional[str],
@@ -492,6 +513,8 @@ async def _run(core, request, chat: bool):
     # semantics as /generate_stream)
     from .http_server import sse_stream
 
+    completion_total = [0]
+
     async def merged():
         q: asyncio.Queue = asyncio.Queue()
 
@@ -523,7 +546,7 @@ async def _run(core, request, chat: bool):
             try:
                 finish = await _consume(core, req, scanner, emit)
                 await put_echo()  # zero-delta generations still echo
-                await q.put((i, "finish", finish))
+                await q.put((i, "finish", (finish, scanner.tokens)))
             except Exception as e:  # noqa: BLE001 — re-raised by the reader
                 await q.put((i, "error", e))
 
@@ -538,6 +561,7 @@ async def _run(core, request, chat: bool):
                         else InferError(str(payload), 500)
                 if kind == "finish":
                     open_choices -= 1
+                    completion_total[0] += payload[1]
                 yield i, kind, payload
         finally:
             for t in tasks:
@@ -551,11 +575,24 @@ async def _run(core, request, chat: bool):
             if want_logprobs:
                 entry["logprobs"] = _lp_payload(records, chat)
         else:
-            entry = _choice(i, "chunk", None, payload, chat)
+            entry = _choice(i, "chunk", None, payload[0], chat)
         frame = _envelope(rid, created, model_name, "chunk", chat, [entry])
+        if pr.include_usage:
+            # OpenAI stream_options.include_usage: data chunks carry
+            # usage: null; the final usage chunk below carries the totals
+            frame["usage"] = None
         await stream.write(f"data: {json.dumps(frame)}\n\n".encode())
 
     async def epilogue(stream):
+        if pr.include_usage:
+            p_toks = len(prompt.encode())
+            frame = _envelope(rid, created, model_name, "chunk", chat, [])
+            frame["usage"] = {
+                "prompt_tokens": p_toks,
+                "completion_tokens": completion_total[0],
+                "total_tokens": p_toks + completion_total[0],
+            }
+            await stream.write(f"data: {json.dumps(frame)}\n\n".encode())
         await stream.write(b"data: [DONE]\n\n")
 
     def on_error(e):
