@@ -1120,3 +1120,78 @@ class TestInt8Quantization:
             assert (k + "_scale") in q
             assert q[k + "_scale"].shape[0] == CFG.n_layers
         assert q["embed"].dtype != jnp.int8  # embedding stays fp
+
+
+class TestBatchedPenalties:
+    """OpenAI frequency/presence penalties INSIDE the shared batched tick
+    (make_slot_step_pen): penalized greedy generations keep continuous-
+    batching capacity, token-identical to the per-request penalized chain."""
+
+    @pytest.fixture()
+    def pair(self, monkeypatch):
+        from triton_client_tpu.models.decode import (DecodeModel,
+                                                     GenerateModel)
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "independent")
+        di = DecodeModel(name="llama_decode_pen_ind")
+        gi = GenerateModel(di, name="llama_generate_pen_ind")
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        db = DecodeModel(name="llama_decode_pen_bat")
+        gb = GenerateModel(db, name="llama_generate_pen_bat")
+        yield gi, gb, db
+        db._shutdown()
+
+    @staticmethod
+    def _toks(gen_model, prompt, n, **params):
+        return [int(f["token_id"][0]) for f in gen_model._generate(
+            {"text_input": np.array([prompt], object)},
+            {"max_tokens": n, **params})]
+
+    def test_penalized_batched_matches_independent_chain(self, pair):
+        gi, gb, _db = pair
+        for params in ({"frequency_penalty": 1.5},
+                       {"presence_penalty": 2.0},
+                       {"frequency_penalty": -1.0, "presence_penalty": 0.5}):
+            want = self._toks(gi, b"pen pen pen", 8, **params)
+            got = self._toks(gb, b"pen pen pen", 8, **params)
+            assert got == want, (params, got, want)
+
+    def test_penalty_changes_batched_output(self, pair):
+        _gi, gb, _db = pair
+        base = self._toks(gb, b"aaaa", 8)
+        pen = self._toks(gb, b"aaaa", 8, frequency_penalty=2.0)
+        assert base != pen
+
+    def test_concurrent_penalized_and_plain_are_isolated(self, pair):
+        import threading
+
+        _gi, gb, _db = pair
+        want_plain = self._toks(gb, b"isolate", 6)
+        want_pen = self._toks(gb, b"isolate", 6, frequency_penalty=2.0)
+        got = {}
+
+        def run(key, params):
+            got[key] = self._toks(gb, b"isolate", 6, **params)
+
+        ts = [threading.Thread(target=run, args=("plain", {})),
+              threading.Thread(target=run,
+                               args=("pen", {"frequency_penalty": 2.0}))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        # zero fp/pp rows degenerate to the plain head: the penalized
+        # neighbor must not perturb the plain stream (and vice versa)
+        assert got["plain"] == want_plain
+        assert got["pen"] == want_pen
+
+    def test_pen_state_clears_after_generation(self, pair):
+        _gi, gb, db = pair
+        self._toks(gb, b"cleanup", 4, presence_penalty=1.0)
+        assert sum(db._pen_n) == 0
+        assert not db._slot_pen_seed
+        # subsequent plain generation still token-identical to fresh state
+        a = self._toks(gb, b"after", 4)
+        b2 = self._toks(gb, b"after", 4)
+        assert a == b2

@@ -454,6 +454,22 @@ def _greedy_head(logits):
     return nxt, best, lp
 
 
+def _pen_head(logits, counts, fp, pp):
+    """Penalized greedy head: the token is argmax of the penalized logits
+    (OpenAI frequency/presence semantics — ``fp*count + pp*(count>0)``
+    subtracted per token), while ``best``/``lp`` report the CHOSEN token
+    under the RAW distribution, matching the per-request chain
+    (logprobs describe the model's distribution, not the sampler's).
+    logits [B, V]; counts [B, V] int32; fp/pp [B] f32 (0 ⇒ identity)."""
+    l32 = logits.astype(jnp.float32)
+    c = counts.astype(jnp.float32)
+    pen = l32 - fp[:, None] * c - pp[:, None] * (c > 0)
+    nxt = jnp.argmax(pen, axis=-1).astype(jnp.int32)
+    best = jnp.take_along_axis(l32, nxt[:, None], axis=-1)[:, 0]
+    lp = best - jax.nn.logsumexp(l32, axis=-1)
+    return nxt, best, lp
+
+
 def _slot_decode_layer(blk, x, kc, vc, pos, active,
                        cfg: tr.TransformerConfig):
     """One token per slot, each at its own position.
@@ -524,6 +540,49 @@ def make_slot_step(cfg: tr.TransformerConfig):
     return step
 
 
+def make_slot_step_pen(cfg: tr.TransformerConfig):
+    """Penalized variant of make_slot_step: identical tick, plus per-slot
+    OpenAI frequency/presence penalties applied at the greedy head and a
+    donated per-slot token-count matrix updated from the chosen token.
+
+    counts [B, V] int32; fp/pp [B] f32, zero for unpenalized slots (the
+    math degenerates to the plain head).  Only active AUTO slots add
+    their chosen token to counts — client-driven sequence steps consume
+    the CLIENT's token, and penalties are a generation-path feature.
+    Compiled only when a bucket actually holds a penalized generation
+    (the worker keeps the legacy kernel on the fast path otherwise).
+
+    counts is deliberately NOT donated: the penalty head READS the buffer
+    the scatter update would write in place, and with donation the CPU
+    backend was observed starting the in-place write before the read
+    finished (flaky last-token corruption, 6-8/40 runs; an explicit
+    lax.optimization_barrier did not close it).  The copy this costs is
+    one [B, V] int32 per tick — noise against the tick's matmuls."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, k, v, tokens, prev, pos, active, auto,
+             counts, fp, pp):
+        tokens = jnp.where(auto, prev, tokens)
+        x = jnp.take(params["embed"].astype(cfg.dtype),
+                     tokens[:, None], axis=0)                     # [B,1,D]
+        blocks = _layer_blocks(params, cfg)
+
+        def layer(x, xs):
+            blk, kc, vc = xs
+            x, kc, vc = _slot_decode_layer(blk, x, kc, vc, pos, active,
+                                           cfg)
+            return x, (kc, vc)
+
+        x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
+        logits = _head(params, x, cfg)[:, -1]                     # [B, V]
+        nxt, best, lp = _pen_head(logits, counts, fp, pp)
+        take = (active & auto).astype(jnp.int32)
+        counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(take)
+        return nxt, best, lp, ks, vs, counts
+
+    return step
+
+
 def make_slot_prefill(cfg: tr.TransformerConfig):
     """jitted (params, k, v, tokens [1,S], slot) -> (next tok, best logit,
     k', v') — prefills ONE slot of the shared cache in a single forward.
@@ -552,6 +611,38 @@ def make_slot_prefill(cfg: tr.TransformerConfig):
         logits = _head(params, x, cfg)[:, -1]
         nxt, best, lp = _greedy_head(logits)
         return nxt[0], best[0], lp[0], k, v
+
+    return prefill
+
+
+def make_slot_prefill_pen(cfg: tr.TransformerConfig):
+    """Penalized variant of make_slot_prefill: the FIRST token must
+    already respect the prompt's token counts (the per-request chain
+    does), so the head takes the slot's seeded count row and fp/pp
+    scalars; the chosen token is added to the row for tick 1.  Returns
+    the updated [V] count row alongside the cache."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def prefill(params, k, v, tokens, slot, counts_row, fp, pp):
+        B, S = tokens.shape
+        x = jnp.take(params["embed"].astype(cfg.dtype), tokens, axis=0)
+        blocks = _layer_blocks(params, cfg)
+
+        def layer(x, blk):
+            x, kl, vl = _prefill_layer(blk, x, cfg)
+            return x, (kl, vl)
+
+        x, (ks, vs) = lax.scan(layer, x, blocks)                  # [L,1,H,S,K]
+        pad = _cache_seq_len(k) - S
+        ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+        vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+        k = _cache_block_write(k, ks, (0, slot, 0, 0), (0, slot, 0, 0, 0))
+        v = _cache_block_write(v, vs, (0, slot, 0, 0), (0, slot, 0, 0, 0))
+        logits = _head(params, x, cfg)[:, -1]
+        nxt, best, lp = _pen_head(logits, counts_row[None, :],
+                                  fp[None], pp[None])
+        counts_row = counts_row.at[nxt[0]].add(1)
+        return nxt[0], best[0], lp[0], k, v, counts_row
 
     return prefill
 
@@ -847,6 +938,18 @@ class DecodeModel:
                     self._chunk_fn = (
                         make_slot_chunk_prefill(cfg, self._s_max)
                         if chunk else None)
+                    # penalty state (lazy: allocated when a penalized
+                    # generation is first admitted; unpenalized buckets
+                    # keep the legacy kernels and pay nothing)
+                    self._pen_counts = [None] * len(self._buckets)
+                    self._pen_fp = [np.zeros(c, np.float32)
+                                    for c, _ in self._buckets]
+                    self._pen_pp = [np.zeros(c, np.float32)
+                                    for c, _ in self._buckets]
+                    self._pen_n = [0] * len(self._buckets)
+                    self._slot_pen_seed = {}  # slot -> (fp, pp, row np)
+                    self._step_pen_fn = make_slot_step_pen(cfg)
+                    self._prefill_pen_fn = make_slot_prefill_pen(cfg)
                     fns = (make_slot_prefill(cfg),
                            make_slot_step(cfg), params, cfg)
                     self._fns = fns
@@ -1077,7 +1180,30 @@ class DecodeModel:
                     continue
                 C = self._prefill_chunk
                 b, li = self._slot_bucket(slot)
+                with self._lock:
+                    seed = self._slot_pen_seed.pop(slot, None)
                 try:
+                    if seed is not None:
+                        # penalized generation: first token must respect
+                        # the prompt counts (full prefill — chunking would
+                        # need a penalized final-chunk head; capacity, not
+                        # contention, is what penalties ride the tick for)
+                        fp, pp, row = seed
+                        self._ensure_pen_bucket(b)
+                        (nxt, best, lp, self._k[b], self._v[b],
+                         new_row) = self._prefill_pen_fn(
+                            params, self._k[b], self._v[b],
+                            jnp.asarray(win), li, jnp.asarray(row),
+                            jnp.float32(fp), jnp.float32(pp))
+                        self._pen_counts[b] = \
+                            self._pen_counts[b].at[li].set(new_row)
+                        with self._lock:
+                            self._pen_fp[b][li] = fp
+                            self._pen_pp[b][li] = pp
+                            self._pen_n[b] += 1
+                        finish_prefill(slot, gen, win.shape[1], nxt, best,
+                                       lp, completion)
+                        continue
                     if C and win.shape[1] > C:
                         # chunked: run the first chunk now, re-enqueue the
                         # continuation at the queue tail so pending decode
@@ -1206,12 +1332,37 @@ class DecodeModel:
                 # bound how far device dispatch runs ahead of readbacks: a
                 # pure-auto loop would otherwise enqueue ticks unboundedly
                 self._tick_budget.acquire()
+                # EXPLICIT np.array COPIES of host state the worker mutates
+                # after dispatch (pos += 1 below; fp/pp zeroed on release):
+                # under async dispatch the backend may capture an aligned
+                # numpy buffer zero-copy, and a mutation landing before the
+                # lagging execution reads it corrupts that tick (observed:
+                # flaky wrong last tokens at pipeline depth, 8/40 runs —
+                # the penalized kernel's longer executions widened a window
+                # the legacy tick also had)
+                pos_snap = jnp.asarray(np.array(self._pos[off:off + cnt]))
                 try:
-                    nxt, best, lp, self._k[b], self._v[b] = step(
-                        params, self._k[b], self._v[b],
-                        jnp.asarray(w["tokens"]), self._prev_nxt[b],
-                        jnp.asarray(self._pos[off:off + cnt]),
-                        jnp.asarray(w["active"]), jnp.asarray(w["auto"]))
+                    if self._pen_n[b] > 0:
+                        # >=1 penalized generation in this bucket: the
+                        # penalized tick (per-slot counts + fp/pp, zero
+                        # rows degenerate to the plain head for everyone
+                        # else); buckets without penalties never pay this
+                        (nxt, best, lp, self._k[b], self._v[b],
+                         self._pen_counts[b]) = self._step_pen_fn(
+                            params, self._k[b], self._v[b],
+                            jnp.asarray(w["tokens"]), self._prev_nxt[b],
+                            pos_snap,
+                            jnp.asarray(w["active"]),
+                            jnp.asarray(w["auto"]),
+                            self._pen_counts[b],
+                            jnp.asarray(np.array(self._pen_fp[b])),
+                            jnp.asarray(np.array(self._pen_pp[b])))
+                    else:
+                        nxt, best, lp, self._k[b], self._v[b] = step(
+                            params, self._k[b], self._v[b],
+                            jnp.asarray(w["tokens"]), self._prev_nxt[b],
+                            pos_snap,
+                            jnp.asarray(w["active"]), jnp.asarray(w["auto"]))
                     self._prev_nxt[b] = nxt
                     pair = jnp.stack([nxt.astype(jnp.float32), best, lp])
                     if hasattr(pair, "copy_to_host_async"):
@@ -1369,8 +1520,13 @@ class DecodeModel:
             for slot in range(off, off + cnt):
                 self._free.add(slot)
                 self._slot_gen[slot] += 1
+                self._clear_pen_locked(slot)
         try:
             params, cfg = self._params
+            # drop the count matrix with the bucket's other state — pen_n
+            # is 0 after the clear loop, and the next penalized admission
+            # reallocates via _ensure_pen_bucket
+            self._pen_counts[b] = None
             self._k[b], self._v[b] = self._new_cache_arrays(cnt, cap, cfg)
             self._prev_nxt[b] = jnp.zeros(cnt, jnp.int32)
         except Exception:  # noqa: BLE001 — e.g. the same OOM that failed
@@ -1381,21 +1537,55 @@ class DecodeModel:
                 self._closed = True
             self._jobs.put(None)
 
+    def _ensure_pen_bucket(self, b: int) -> None:
+        """Worker-side: allocate the bucket's [cnt, V] count matrix on
+        first penalized admission (unpenalized buckets never pay the HBM
+        or the penalized-kernel compile)."""
+        if self._pen_counts[b] is None:
+            _, cfg = self._params
+            cnt = self._buckets[b][0]
+            self._pen_counts[b] = jnp.zeros((cnt, cfg.vocab_size),
+                                            jnp.int32)
+
+    def _clear_pen_locked(self, slot) -> None:
+        """Under self._lock: forget a slot's penalty state on release.
+        Count rows go stale harmlessly (fp/pp are zero, and admission
+        reseeds the row before use)."""
+        if self._fns is None:  # pen state lives in the lazy-init block
+            return
+        self._slot_pen_seed.pop(slot, None)
+        b, li = self._slot_bucket(slot)
+        if self._pen_fp[b][li] != 0.0 or self._pen_pp[b][li] != 0.0:
+            self._pen_fp[b][li] = 0.0
+            self._pen_pp[b][li] = 0.0
+            self._pen_n[b] -= 1
+
     def _release_gen_slot(self, slot):
         """Worker-side: return a generation slot to the pool (no seq id to
         clean up; the generation bump invalidates any stale job)."""
         with self._lock:
             self._free.add(slot)
             self._slot_gen[slot] += 1
+            self._clear_pen_locked(slot)
 
-    def submit_generation(self, window, n_tokens: int):
+    def submit_generation(self, window, n_tokens: int,
+                          freq_pen: float = 0.0, pres_pen: float = 0.0,
+                          prompt_len: int = None):
         """Queue a server-side greedy generation (batched mode): the prompt
         prefills into a free slot and the slot self-feeds — every active
         generation shares one batched device step per tick.  Returns a
         Queue yielding (token id, logprob) pairs, then None (or an
-        Exception)."""
+        Exception).
+
+        ``freq_pen``/``pres_pen``: OpenAI penalties, honored INSIDE the
+        shared tick (per-slot count vector seeded from the prompt, fed by
+        the chosen token; applied at the greedy head) — penalized greedy
+        generations keep continuous-batching capacity instead of falling
+        back to per-request chains."""
         import queue as _queue
         import time
+
+        import numpy as np
 
         from ..server.types import InferError
 
@@ -1404,6 +1594,7 @@ class DecodeModel:
             raise InferError(
                 f"model '{self._model.name}' is unloading", 503)
         need_s = int(window.shape[1]) + int(n_tokens)
+        use_pen = freq_pen != 0.0 or pres_pen != 0.0
         with self._lock:
             slot = self._alloc_slot_locked(need_s)
             if slot is None:
@@ -1415,6 +1606,23 @@ class DecodeModel:
                     f"holds {need_s} tokens ({self._n_slots} total); retry "
                     "when a generation or sequence completes", 429)
             gen = self._slot_gen[slot]
+            if use_pen:
+                # counts include the REAL prompt tokens (not the window's
+                # zero padding) — same seeding as the per-request chain,
+                # which needs the true prompt length (a nonzero filter
+                # would drop legitimate token-id-0 prompt bytes)
+                if prompt_len is None:
+                    raise InferError(
+                        "penalized generation requires prompt_len (the "
+                        "count seed cannot be recovered from the padded "
+                        "window)")
+                _, cfg = self._params
+                real = (window[0, window.shape[1] - prompt_len:]
+                        if prompt_len else np.zeros(0, np.int32))
+                row = np.bincount(
+                    real, minlength=cfg.vocab_size).astype(np.int32)
+                self._slot_pen_seed[slot] = (
+                    float(freq_pen), float(pres_pen), row)
         sink: "_queue.Queue" = _queue.Queue()
         self._jobs.put(("prefill",
                         (slot, gen, window, ("gen", n_tokens, sink)),
@@ -1744,11 +1952,14 @@ class GenerateModel:
 
         return jax.jit(choose)
 
-    def _generate_batched(self, window, n_tokens):
+    def _generate_batched(self, window, n_tokens, freq_pen=0.0,
+                          pres_pen=0.0, prompt_len=None):
         np = self._np
         from ..server.types import InferError
 
-        sink = self._decode.submit_generation(window, n_tokens)
+        sink = self._decode.submit_generation(
+            window, n_tokens, freq_pen=freq_pen, pres_pen=pres_pen,
+            prompt_len=prompt_len)
         try:
             while True:
                 item = sink.get(timeout=3600)
@@ -1820,14 +2031,18 @@ class GenerateModel:
             window[0, dec._prompt_len - b.size:] = b
         window = np.clip(window, 0, cfg.vocab_size - 1)
 
-        if dec._mode == "batched" and temperature == 0 and not use_pen:
+        if dec._mode == "batched" and temperature == 0:
             # continuous batching for server-side generation: the request
             # joins the decode worker's shared tick — N concurrent greedy
             # generations cost ONE batched device step per token position,
-            # with the feedback token never leaving the device.  (Sampled
-            # and penalized requests keep the per-request device chain
-            # below: sampling/penalty state is per-request.)
-            yield from self._generate_batched(window, n_tokens)
+            # with the feedback token never leaving the device.  Penalties
+            # ride the tick too (per-slot count vectors; see
+            # make_slot_step_pen), so penalized greedy keeps batched
+            # capacity.  Sampled requests keep the per-request chain
+            # below: RNG state is per-request.
+            yield from self._generate_batched(
+                window, n_tokens, freq_pen=freq_pen, pres_pen=pres_pen,
+                prompt_len=int(b.size))
             return
 
         prefill, step, params, cfg = dec._ensure_fns_independent()
