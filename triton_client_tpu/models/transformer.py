@@ -424,6 +424,22 @@ def _flash_enabled() -> bool:
     return os.environ.get("TRITON_TPU_FLASH", "1") != "0"
 
 
+def _int8_fused_mode() -> frozenset:
+    """Which int8 FFN matmuls take the fused quantize+matmul pallas kernel
+    (ops/int8_matmul.py): '0' (none), 'w1', 'w2' (the measured default),
+    or '1'/'all' for both.  benchmarks/BERT_PROFILE.md §6: at the
+    bert_large serving shape only the FFN-down matmul wins (58.4 vs
+    59.8 ms/forward, weight-resident schedule); fusing w1 LOSES — XLA
+    folds the quantize chain into the adjacent rmsnorm/silu passes, which
+    the standalone-GEMM comparison couldn't see."""
+    val = os.environ.get("TRITON_TPU_INT8_FUSED", "w2")
+    if val in ("", "0"):
+        return frozenset()
+    if val in ("1", "all"):
+        return frozenset(("w1", "w2"))
+    return frozenset(v.strip() for v in val.split(",") if v.strip())
+
+
 def _flash_min_s() -> int:
     """Sequence-length gate for the pallas flash kernel.  Measured on-chip
     (benchmarks/BERT_PROFILE.md): at S=384 the kernel is ~25% SLOWER than
@@ -508,16 +524,31 @@ def _ffn_apply(blk, x, cfg: TransformerConfig):
         out = jnp.einsum("ebsd,bse->bsd", oe, local_probs.astype(oe.dtype))
         out = lax.psum(out, "ep")
     elif "w1_scale" in blk:
-        # dense FFN on the int8 MXU path (see _attn_apply)
-        hq, hs = _int8_quant(h, (-1,))
-        he = jnp.einsum("bsd,df->bsf", hq, blk["w1"],
-                        preferred_element_type=jnp.int32)
-        he = (he.astype(jnp.float32) * hs * blk["w1_scale"]).astype(h.dtype)
+        # dense FFN on the int8 MXU path (see _attn_apply); both matmuls
+        # are 2D row-quantized GEMMs with no layout change around them,
+        # so they take the fused quantize+matmul pallas kernel — the
+        # int8 activation copy never round-trips HBM
+        fused = _int8_fused_mode()
+        if fused:
+            from ..ops import int8_matmul
+
+        if "w1" in fused:
+            he = int8_matmul(h, blk["w1"], blk["w1_scale"])
+        else:
+            hq, hs = _int8_quant(h, (-1,))
+            he = jnp.einsum("bsd,df->bsf", hq, blk["w1"],
+                            preferred_element_type=jnp.int32)
+            he = (he.astype(jnp.float32) * hs
+                  * blk["w1_scale"]).astype(h.dtype)
         he = jax.nn.silu(he)
-        gq, gs = _int8_quant(he, (-1,))
-        out = jnp.einsum("bsf,fd->bsd", gq, blk["w2"],
-                         preferred_element_type=jnp.int32)
-        out = (out.astype(jnp.float32) * gs * blk["w2_scale"]).astype(h.dtype)
+        if "w2" in fused:
+            out = int8_matmul(he, blk["w2"], blk["w2_scale"])
+        else:
+            gq, gs = _int8_quant(he, (-1,))
+            out = jnp.einsum("bsf,fd->bsd", gq, blk["w2"],
+                             preferred_element_type=jnp.int32)
+            out = (out.astype(jnp.float32) * gs
+                   * blk["w2_scale"]).astype(h.dtype)
         out = lax.psum(out, "tp")
     else:
         he = jnp.einsum("bsd,df->bsf", h, blk["w1"].astype(h.dtype))

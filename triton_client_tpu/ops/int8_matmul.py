@@ -1,0 +1,142 @@
+"""Fused dynamic-quantize + int8 matmul as a pallas TPU kernel.
+
+The int8 MXU serving path (``models/transformer.py``) dynamically
+quantizes activations per token, runs s8xs8->s32 einsums, and rescales.
+Under XLA that is three HBM passes per matmul: an amax reduce over the
+activation, a quantize pass that writes the int8 copy, and the GEMM that
+reads it back.  This kernel folds all three into the GEMM's own pipeline:
+each activation tile is loaded once (bf16), amax-reduced and quantized in
+VMEM, fed to the MXU int8 datapath, and the s32->bf16 scale epilogue is
+applied before the tile is written — the quantized activation never
+touches HBM.  benchmarks/BERT_PROFILE.md §5 named this fusion as the
+remaining layout-level lever on the int8 encoder; §6 records what it
+measured.
+
+Grid: (M/bm, N/bn) with the full contraction K resident per program —
+the serving shapes (K = d_model 1024 or d_ff 4096) fit VMEM comfortably,
+which buys exact per-row amax (identical numerics to the XLA path: same
+scale, same round/clip) without a cross-block reduction.  x blocks depend
+only on the row index, so pallas keeps them resident across the inner N
+sweep.
+
+Like the flash kernel (``ops/flash_attention.py``) this falls back to the
+plain-jnp reference off-TPU; ``interpret=True`` runs the kernel itself on
+CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# VMEM ceiling per program is ~16 MB; beyond this K the full-row design
+# would not fit and the caller gets the XLA path instead.
+_MAX_RESIDENT_K = 8192
+
+
+def int8_matmul_reference(x, w_q, w_scale):
+    """Plain-jnp dynamic-quantized matmul (the XLA serving path).
+
+    x: [..., K] float; w_q: [K, N] int8; w_scale: [N] or [1, N] f32
+    (per-output-channel).  Returns [..., N] in x.dtype.
+    """
+    xs = jnp.maximum(
+        jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True),
+        1e-12) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / xs),
+                 -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        q, w_q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    ws = w_scale.reshape((1,) * (x.ndim - 1) + (-1,)).astype(jnp.float32)
+    return (acc.astype(jnp.float32) * xs * ws).astype(x.dtype)
+
+
+def _kernel(x_ref, w_ref, ws_ref, o_ref):
+    """One (m-block, n-block) program: quantize the row block in VMEM,
+    int8 MXU dot, fused dequant epilogue."""
+    x = x_ref[:].astype(jnp.float32)                      # [bm, K]
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)     # [bm, 1]
+    xs = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x * (1.0 / xs)), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        q, w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)                 # [bm, bn] s32
+    o_ref[:] = (acc.astype(jnp.float32) * xs * ws_ref[:]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))
+def _call(x2d, w_q, ws_row, block_m, block_n, interpret):
+    from jax.experimental import pallas as pl
+
+    M, K = x2d.shape
+    N = w_q.shape[1]
+    pad_m = -M % block_m
+    if pad_m:
+        x2d = jnp.pad(x2d, ((0, pad_m), (0, 0)))
+    Mp = M + pad_m
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((Mp, N), x2d.dtype),
+        grid=(Mp // block_m, N // block_n),
+        in_specs=[
+            pl.BlockSpec((block_m, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(x2d, w_q, ws_row)
+    return out[:M] if pad_m else out
+
+
+def int8_matmul(x, w_q, w_scale, *, block_m: int = 0, block_n: int = 0,
+                interpret: bool = False, force: bool = False):
+    """Dynamically-quantized int8 matmul: [..., K] @ [K, N] -> [..., N].
+
+    On TPU backends runs the fused pallas kernel; elsewhere falls back to
+    :func:`int8_matmul_reference` unless ``interpret`` (pallas interpreter,
+    for tests) or ``force``.  Also falls back when the shape doesn't fit
+    the kernel's full-K-resident design (K > 8192 or K/N not lane-aligned).
+
+    ``block_m``/``block_n`` of 0 pick measured defaults (512x512 — best on
+    the d=1024/f=4096 serving shapes, benchmarks/BERT_PROFILE.md §6).
+    """
+    K = x.shape[-1]
+    N = w_q.shape[1]
+    on_tpu = interpret or force or jax.default_backend() == "tpu"
+    if not on_tpu or K > _MAX_RESIDENT_K or K % 128 or N % 128:
+        return int8_matmul_reference(x, w_q, w_scale)
+    import os
+    blocks_env = os.environ.get("TRITON_TPU_INT8_BLOCKS", "")
+    if blocks_env and block_m == 0 and block_n == 0:
+        # experimentation knob (benchmarks): "bm:bn", bn may equal N for a
+        # weight-resident 1-D grid
+        bm_s, bn_s = blocks_env.split(":")
+        block_m, block_n = int(bm_s), int(bn_s)
+    if block_m == 0 and block_n == 0 and K >= 2048 and K * N <= 4 * 2**20:
+        # weight-resident schedule: the whole [K, N] int8 weight stays in
+        # VMEM across the 1-D row grid, so it streams from HBM once per
+        # matmul instead of once per row block — the config that beats
+        # XLA's unfused path on the FFN-down shape (K=4096, N=1024:
+        # 58.4 vs 59.8 ms/forward in-model, benchmarks/BERT_PROFILE.md §6)
+        block_m, block_n = 256, N
+    if block_m == 0:
+        # VMEM budget: the program holds the x row block in bf16 + an f32
+        # working copy + the int8 quantized tiles (~7 bytes/elem) plus the
+        # w block and s32 accumulator inside the ~16 MB scoped limit —
+        # 512 rows fits K<=2048; K=4096 needs 256
+        block_m = 512 if K <= 2048 else 256
+    if block_n == 0:
+        block_n = min(512, N)
+    lead = x.shape[:-1]
+    M = 1
+    for d in lead:
+        M *= d
+    x2d = x.reshape(M, K)
+    block_m = min(block_m, max(8, M))
+    ws_row = w_scale.reshape(1, N).astype(jnp.float32)
+    out = _call(x2d, w_q, ws_row, block_m, block_n, interpret)
+    return out.reshape(*lead, N)
