@@ -144,3 +144,27 @@ class TestFusedModeSelection:
         np.testing.assert_allclose(
             np.asarray(got, np.float32), np.asarray(want, np.float32),
             rtol=1e-2, atol=1e-3)
+
+    def test_unknown_selector_rejected(self, monkeypatch):
+        # typos must fail loudly, not silently disable the kernel
+        with pytest.raises(ValueError, match="unknown selector"):
+            self._mode(monkeypatch, "ffn_down")
+        # case-insensitive: W2 means w2
+        assert self._mode(monkeypatch, "W2") == frozenset(("w2",))
+
+    def test_auto_block_n_divides_n(self):
+        # N=640 passes the N%128 gate but 640 % 512 != 0 — auto selection
+        # drops to the largest dividing block (128) and the kernel runs
+        x, w, ws = _mk(16, 128, 640, seed=9)
+        got = int8_matmul(x, w, ws, interpret=True)
+        want = int8_matmul_reference(x, w, ws)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=1e-6)
+
+    def test_explicit_non_dividing_block_n_raises(self):
+        # an explicitly-requested block that can't cover N must fail
+        # loudly, not silently measure the XLA path
+        x, w, ws = _mk(16, 128, 1024, seed=10)
+        with pytest.raises(ValueError, match="does not divide"):
+            int8_matmul(x, w, ws, block_n=384, interpret=True)

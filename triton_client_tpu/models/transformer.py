@@ -432,12 +432,21 @@ def _int8_fused_mode() -> frozenset:
     59.8 ms/forward, weight-resident schedule); fusing w1 LOSES — XLA
     folds the quantize chain into the adjacent rmsnorm/silu passes, which
     the standalone-GEMM comparison couldn't see."""
-    val = os.environ.get("TRITON_TPU_INT8_FUSED", "w2")
+    val = os.environ.get("TRITON_TPU_INT8_FUSED", "w2").strip().lower()
     if val in ("", "0"):
         return frozenset()
     if val in ("1", "all"):
         return frozenset(("w1", "w2"))
-    return frozenset(v.strip() for v in val.split(",") if v.strip())
+    mode = frozenset(v.strip() for v in val.split(",") if v.strip())
+    unknown = mode - frozenset(("w1", "w2"))
+    if unknown:
+        # a typo'd knob must not silently fall back to the XLA path —
+        # same loud-rejection policy as resolve_quant above
+        raise ValueError(
+            f"TRITON_TPU_INT8_FUSED={val!r}: unknown selector(s) "
+            f"{sorted(unknown)}; expected '0', '1'/'all', 'w1', 'w2', "
+            "or a comma list of w1/w2")
+    return mode
 
 
 def _flash_min_s() -> int:

@@ -60,7 +60,9 @@ def _kernel(x_ref, w_ref, ws_ref, o_ref):
     x = x_ref[:].astype(jnp.float32)                      # [bm, K]
     amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)     # [bm, 1]
     xs = jnp.maximum(amax, 1e-12) / 127.0
-    q = jnp.clip(jnp.round(x * (1.0 / xs)), -127, 127).astype(jnp.int8)
+    # true divide, not reciprocal-multiply: bit-identical codes to the
+    # XLA path (_int8_quant) even on round-to-nearest ties
+    q = jnp.clip(jnp.round(x / xs), -127, 127).astype(jnp.int8)
     acc = jax.lax.dot_general(
         q, w_ref[:], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)                 # [bm, bn] s32
@@ -116,6 +118,14 @@ def int8_matmul(x, w_q, w_scale, *, block_m: int = 0, block_n: int = 0,
         # weight-resident 1-D grid
         bm_s, bn_s = blocks_env.split(":")
         block_m, block_n = int(bm_s), int(bn_s)
+    if block_n and N % block_n:
+        # the grid floors N/block_n — a non-dividing explicit block would
+        # leave trailing output columns unwritten.  Explicitly-requested
+        # configs fail loudly (a silent XLA fallback would mis-attribute
+        # benchmark numbers to the kernel); auto selection below always
+        # picks a divisor.
+        raise ValueError(
+            f"int8_matmul: block_n={block_n} does not divide N={N}")
     if block_m == 0 and block_n == 0 and K >= 2048 and K * N <= 4 * 2**20:
         # weight-resident schedule: the whole [K, N] int8 weight stays in
         # VMEM across the 1-D row grid, so it streams from HBM once per
@@ -130,7 +140,9 @@ def int8_matmul(x, w_q, w_scale, *, block_m: int = 0, block_n: int = 0,
         # 512 rows fits K<=2048; K=4096 needs 256
         block_m = 512 if K <= 2048 else 256
     if block_n == 0:
-        block_n = min(512, N)
+        # largest lane-aligned divisor of N up to 512 (N % 128 == 0 was
+        # gated above, so 128 always qualifies)
+        block_n = next(bn for bn in (512, 384, 256, 128) if N % bn == 0)
     lead = x.shape[:-1]
     M = 1
     for d in lead:
