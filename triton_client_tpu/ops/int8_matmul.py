@@ -103,8 +103,10 @@ def int8_matmul(x, w_q, w_scale, *, block_m: int = 0, block_n: int = 0,
     for tests) or ``force``.  Also falls back when the shape doesn't fit
     the kernel's full-K-resident design (K > 8192 or K/N not lane-aligned).
 
-    ``block_m``/``block_n`` of 0 pick measured defaults (512x512 — best on
-    the d=1024/f=4096 serving shapes, benchmarks/BERT_PROFILE.md §6).
+    ``block_m``/``block_n`` of 0 pick measured defaults: the
+    weight-resident schedule (bm=256, bn=N) when the whole weight fits
+    VMEM at K>=2048, else output tiles sized to the VMEM budget
+    (benchmarks/BERT_PROFILE.md §6 has the measured matrix).
     """
     K = x.shape[-1]
     N = w_q.shape[1]
