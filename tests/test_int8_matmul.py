@@ -168,3 +168,31 @@ class TestFusedModeSelection:
         x, w, ws = _mk(16, 128, 1024, seed=10)
         with pytest.raises(ValueError, match="does not divide"):
             int8_matmul(x, w, ws, block_n=384, interpret=True)
+
+
+class TestMInnerSchedule:
+    def test_m_inner_matches_reference(self):
+        # weight-resident grid order: output tiles land in the same
+        # places, numerics identical to the default schedule
+        x, w, ws = _mk(48, 128, 256, seed=11)
+        got = int8_matmul(x, w, ws, block_m=16, block_n=128,
+                          m_inner=True, interpret=True)
+        want = int8_matmul_reference(x, w, ws)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=1e-6)
+
+    def test_sched_env_typo_rejected(self, monkeypatch):
+        monkeypatch.setenv("TRITON_TPU_INT8_SCHED", "minner")
+        x, w, ws = _mk(16, 128, 128, seed=12)
+        with pytest.raises(ValueError, match="TRITON_TPU_INT8_SCHED"):
+            int8_matmul(x, w, ws, interpret=True)
+
+    def test_sched_env_selects_m_inner(self, monkeypatch):
+        monkeypatch.setenv("TRITON_TPU_INT8_SCHED", "m_inner")
+        x, w, ws = _mk(32, 128, 256, seed=13)
+        got = int8_matmul(x, w, ws, block_m=16, block_n=128, interpret=True)
+        want = int8_matmul_reference(x, w, ws)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=1e-6)

@@ -12,12 +12,17 @@ touches HBM.  benchmarks/BERT_PROFILE.md §5 named this fusion as the
 remaining layout-level lever on the int8 encoder; §6 records what it
 measured.
 
-Grid: (M/bm, N/bn) with the full contraction K resident per program —
-the serving shapes (K = d_model 1024 or d_ff 4096) fit VMEM comfortably,
-which buys exact per-row amax (identical numerics to the XLA path: same
-scale, same round/clip) without a cross-block reduction.  x blocks depend
-only on the row index, so pallas keeps them resident across the inner N
-sweep.
+Grid: 2-D over (row blocks, col blocks) with the full contraction K
+resident per program — the serving shapes (K = d_model 1024 or d_ff
+4096) fit VMEM comfortably, which buys exact per-row amax (identical
+numerics to the XLA path: same scale, same round/clip) without a
+cross-block reduction.  Two schedules, selected by which operand should
+stay VMEM-resident across the inner sweep: the default iterates N
+innermost (activation block resident, weights stream; degenerates to a
+weight-resident 1-D grid when block_n == N), and ``m_inner`` iterates M
+innermost (weight block resident, activations stream and re-quantize per
+visit — measured a loss on the BERT shapes, kept for other geometries;
+benchmarks/BERT_PROFILE.md §6).
 
 Like the flash kernel (``ops/flash_attention.py``) this falls back to the
 plain-jnp reference off-TPU; ``interpret=True`` runs the kernel itself on
@@ -69,8 +74,9 @@ def _kernel(x_ref, w_ref, ws_ref, o_ref):
     o_ref[:] = (acc.astype(jnp.float32) * xs * ws_ref[:]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))
-def _call(x2d, w_q, ws_row, block_m, block_n, interpret):
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n",
+                                              "m_inner", "interpret"))
+def _call(x2d, w_q, ws_row, block_m, block_n, m_inner, interpret):
     from jax.experimental import pallas as pl
 
     M, K = x2d.shape
@@ -79,23 +85,38 @@ def _call(x2d, w_q, ws_row, block_m, block_n, interpret):
     if pad_m:
         x2d = jnp.pad(x2d, ((0, pad_m), (0, 0)))
     Mp = M + pad_m
+    if m_inner:
+        # grid (n, m): the row index varies innermost, so each WEIGHT
+        # block stays VMEM-resident across the full row sweep and the
+        # activation streams N/bn times — the right trade when the weight
+        # is the bigger stream (x re-reads cost less than w re-reads)
+        grid = (N // block_n, Mp // block_m)
+        x_map = lambda j, i: (i, 0)
+        w_map = lambda j, i: (0, j)
+        o_map = lambda j, i: (i, j)
+    else:
+        grid = (Mp // block_m, N // block_n)
+        x_map = lambda i, j: (i, 0)
+        w_map = lambda i, j: (0, j)
+        o_map = lambda i, j: (i, j)
     out = pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct((Mp, N), x2d.dtype),
-        grid=(Mp // block_m, N // block_n),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((block_m, K), lambda i, j: (i, 0)),
-            pl.BlockSpec((K, block_n), lambda i, j: (0, j)),
-            pl.BlockSpec((1, block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((block_m, K), x_map),
+            pl.BlockSpec((K, block_n), w_map),
+            pl.BlockSpec((1, block_n), w_map),
         ],
-        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((block_m, block_n), o_map),
         interpret=interpret,
     )(x2d, w_q, ws_row)
     return out[:M] if pad_m else out
 
 
 def int8_matmul(x, w_q, w_scale, *, block_m: int = 0, block_n: int = 0,
-                interpret: bool = False, force: bool = False):
+                m_inner: bool = False, interpret: bool = False,
+                force: bool = False):
     """Dynamically-quantized int8 matmul: [..., K] @ [K, N] -> [..., N].
 
     On TPU backends runs the fused pallas kernel; elsewhere falls back to
@@ -120,6 +141,15 @@ def int8_matmul(x, w_q, w_scale, *, block_m: int = 0, block_n: int = 0,
         # weight-resident 1-D grid
         bm_s, bn_s = blocks_env.split(":")
         block_m, block_n = int(bm_s), int(bn_s)
+    sched_env = os.environ.get("TRITON_TPU_INT8_SCHED", "")
+    if sched_env == "m_inner":
+        m_inner = True
+    elif sched_env:
+        # same loud-rejection policy as TRITON_TPU_INT8_FUSED: a typo'd
+        # schedule must not silently measure the default one
+        raise ValueError(
+            f"TRITON_TPU_INT8_SCHED={sched_env!r}: expected 'm_inner' "
+            "or unset")
     if block_n and N % block_n:
         # the grid floors N/block_n — a non-dividing explicit block would
         # leave trailing output columns unwritten.  Explicitly-requested
@@ -152,5 +182,5 @@ def int8_matmul(x, w_q, w_scale, *, block_m: int = 0, block_n: int = 0,
     x2d = x.reshape(M, K)
     block_m = min(block_m, max(8, M))
     ws_row = w_scale.reshape(1, N).astype(jnp.float32)
-    out = _call(x2d, w_q, ws_row, block_m, block_n, interpret)
+    out = _call(x2d, w_q, ws_row, block_m, block_n, m_inner, interpret)
     return out.reshape(*lead, N)
