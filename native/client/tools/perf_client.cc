@@ -1,0 +1,552 @@
+// Native load generator: the C++ core of the perf_analyzer contract
+// (reference src/c++/perf_analyzer/README.md:28-30 — infer/sec and latency
+// percentiles over concurrency sweeps; upstream's tool is native C++, so
+// this framework's native client gets one too, alongside the full-featured
+// Python tpu-perf-analyzer).
+//
+// Modes:
+//   closed loop  --concurrency-range start:end[:step]
+//       N threads, each its own client over the shared channel cache,
+//       back-to-back Infer() for the measurement window.
+//   open loop    --request-rate-range start:end[:step]
+//       requests fire on a precomputed constant or Poisson schedule and
+//       LATENCY IS MEASURED FROM THE SCHEDULED SEND TIME, so queue buildup
+//       counts against the server (coordinated-omission-free, same
+//       contract as the Python tool); slots the thread pool never reached
+//       are reported as unsent.
+//
+// Inputs are synthesized from the model's metadata (shape -1 -> batch in
+// dim 0 else 1), like perf_analyzer: numeric dtypes get deterministic
+// small-int fills, BYTES gets fixed-width strings.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+#include "json.h"
+
+namespace tc = tc_tpu::client;
+using Clock = std::chrono::steady_clock;
+
+namespace {
+
+struct TensorSpec {
+  std::string name;
+  std::string datatype;
+  std::vector<int64_t> dims;
+};
+
+struct Options {
+  std::string url = "localhost:8001";
+  std::string protocol = "grpc";  // grpc | http
+  std::string model;
+  int batch = 1;
+  int window_ms = 3000;
+  int warmup_ms = 500;
+  bool json_out = false;
+  // closed loop
+  int conc_start = 1, conc_end = 4, conc_step = 1;
+  bool have_conc = false;
+  // open loop
+  double rate_start = 0, rate_end = 0, rate_step = 0;
+  bool have_rate = false;
+  std::string distribution = "constant";  // constant | poisson
+  int max_threads = 32;
+};
+
+bool
+ParseRange(const char* s, double* a, double* b, double* c)
+{
+  double x = 0, y = 0, z = 0;
+  int n = sscanf(s, "%lf:%lf:%lf", &x, &y, &z);
+  if (n < 2) return false;
+  *a = x;
+  *b = y;
+  *c = (n == 3) ? z : 1;
+  return *c > 0 && y >= x;
+}
+
+size_t
+DtypeSize(const std::string& dt)
+{
+  if (dt == "BOOL" || dt == "INT8" || dt == "UINT8") return 1;
+  if (dt == "INT16" || dt == "UINT16" || dt == "FP16" || dt == "BF16")
+    return 2;
+  if (dt == "INT32" || dt == "UINT32" || dt == "FP32") return 4;
+  if (dt == "INT64" || dt == "UINT64" || dt == "FP64") return 8;
+  return 0;  // BYTES handled separately
+}
+
+// Deterministic small-value fill: valid for id/index inputs (vocab ids,
+// pixel bytes) and harmless for float features.
+void
+FillTensor(const std::string& dt, size_t n_elems, std::vector<uint8_t>* buf)
+{
+  size_t esz = DtypeSize(dt);
+  buf->resize(n_elems * esz);
+  for (size_t i = 0; i < n_elems; ++i) {
+    long v = static_cast<long>(i % 10);
+    uint8_t* p = buf->data() + i * esz;
+    if (dt == "FP32") {
+      float f = static_cast<float>(v);
+      memcpy(p, &f, 4);
+    } else if (dt == "FP64") {
+      double d = static_cast<double>(v);
+      memcpy(p, &d, 8);
+    } else if (dt == "FP16" || dt == "BF16") {
+      // zeros are valid halfs; keep it simple
+      memset(p, 0, 2);
+    } else {
+      // integer family, little-endian
+      long long vv = v;
+      memcpy(p, &vv, esz);
+    }
+  }
+}
+
+class Workload {
+ public:
+  Workload(const Options& opt, std::vector<TensorSpec> specs)
+      : opt_(opt), specs_(std::move(specs))
+  {
+    for (const auto& s : specs_) {
+      std::vector<int64_t> shape = s.dims;
+      for (size_t i = 0; i < shape.size(); ++i) {
+        if (shape[i] < 0) shape[i] = (i == 0) ? opt_.batch : 1;
+      }
+      size_t n = 1;
+      for (auto d : shape) n *= static_cast<size_t>(d);
+      shapes_.push_back(shape);
+      std::vector<uint8_t> buf;
+      if (s.datatype != "BYTES") FillTensor(s.datatype, n, &buf);
+      fills_.push_back(std::move(buf));
+      counts_.push_back(n);
+    }
+  }
+
+  // One client + one reusable input set per worker thread.
+  struct Ctx {
+    std::unique_ptr<tc::InferenceServerGrpcClient> grpc;
+    std::unique_ptr<tc::InferenceServerHttpClient> http;
+    std::vector<tc::InferInput*> inputs;
+    ~Ctx()
+    {
+      for (auto* in : inputs) delete in;
+    }
+  };
+
+  bool MakeCtx(Ctx* ctx, std::string* err)
+  {
+    tc::Error e;
+    if (opt_.protocol == "grpc") {
+      e = tc::InferenceServerGrpcClient::Create(&ctx->grpc, opt_.url);
+    } else {
+      e = tc::InferenceServerHttpClient::Create(&ctx->http, opt_.url);
+    }
+    if (!e.IsOk()) {
+      *err = e.Message();
+      return false;
+    }
+    for (size_t i = 0; i < specs_.size(); ++i) {
+      tc::InferInput* in = nullptr;
+      e = tc::InferInput::Create(&in, specs_[i].name, shapes_[i],
+                                 specs_[i].datatype);
+      if (!e.IsOk()) {
+        *err = e.Message();
+        return false;
+      }
+      if (specs_[i].datatype == "BYTES") {
+        // numeric strings: valid for string-identity AND
+        // string-arithmetic models (reference simple_string contract)
+        std::vector<std::string> strs(counts_[i], "1");
+        in->AppendFromString(strs);
+      } else {
+        in->AppendRaw(fills_[i].data(), fills_[i].size());
+      }
+      ctx->inputs.push_back(in);
+    }
+    return true;
+  }
+
+  bool InferOnce(Ctx* ctx, std::string* err)
+  {
+    tc::InferOptions options(opt_.model);
+    tc::InferResult* result = nullptr;
+    tc::Error e = (ctx->grpc != nullptr)
+                      ? ctx->grpc->Infer(&result, options, ctx->inputs)
+                      : ctx->http->Infer(&result, options, ctx->inputs);
+    if (!e.IsOk()) {
+      *err = e.Message();
+      return false;
+    }
+    bool ok = result->RequestStatus().IsOk();
+    if (!ok) *err = result->RequestStatus().Message();
+    delete result;
+    return ok;
+  }
+
+ private:
+  const Options& opt_;
+  std::vector<TensorSpec> specs_;
+  std::vector<std::vector<int64_t>> shapes_;
+  std::vector<std::vector<uint8_t>> fills_;
+  std::vector<size_t> counts_;
+};
+
+// `v` must be sorted ascending (callers sort once per report).
+double
+Percentile(const std::vector<double>& v, double q)
+{
+  if (v.empty()) return 0;
+  size_t idx = static_cast<size_t>(q * (v.size() - 1) + 0.5);
+  return v[std::min(idx, v.size() - 1)];
+}
+
+void
+Report(const Options& opt, const char* mode, double level, size_t completed,
+       double window_s, std::vector<double>* lat_us, size_t unsent,
+       double send_lag_p99_us)
+{
+  double thr = completed / window_s;
+  std::sort(lat_us->begin(), lat_us->end());
+  double p50 = Percentile(*lat_us, 0.50);
+  double p90 = Percentile(*lat_us, 0.90);
+  double p99 = Percentile(*lat_us, 0.99);
+  if (opt.json_out) {
+    printf(
+        "{\"mode\": \"%s\", \"level\": %g, \"throughput_infer_per_sec\": "
+        "%.1f, \"latency_p50_us\": %.0f, \"latency_p90_us\": %.0f, "
+        "\"latency_p99_us\": %.0f, \"completed\": %zu, \"unsent\": %zu, "
+        "\"send_lag_p99_us\": %.0f}\n",
+        mode, level, thr, p50, p90, p99, completed, unsent, send_lag_p99_us);
+  } else if (strcmp(mode, "concurrency") == 0) {
+    printf(
+        "Concurrency: %g, throughput: %.1f infer/sec, latency p50: %.0f "
+        "usec, p90: %.0f usec, p99: %.0f usec\n",
+        level, thr, p50, p90, p99);
+  } else {
+    printf(
+        "Request rate: %g, throughput: %.1f infer/sec, latency p50: %.0f "
+        "usec, p99: %.0f usec, send-lag p99: %.0f usec, unsent: %zu\n",
+        level, thr, p50, p99, send_lag_p99_us, unsent);
+  }
+  fflush(stdout);
+}
+
+int
+RunClosedLoop(const Options& opt, Workload* wl)
+{
+  for (int c = opt.conc_start; c <= opt.conc_end; c += opt.conc_step) {
+    std::vector<std::unique_ptr<Workload::Ctx>> ctxs;
+    for (int t = 0; t < c; ++t) {
+      auto ctx = std::make_unique<Workload::Ctx>();
+      std::string err;
+      if (!wl->MakeCtx(ctx.get(), &err)) {
+        fprintf(stderr, "FAILED: client setup: %s\n", err.c_str());
+        return 1;
+      }
+      ctxs.push_back(std::move(ctx));
+    }
+    std::atomic<bool> stop{false};
+    std::atomic<bool> failed{false};
+    std::atomic<size_t> completed{0};
+    std::vector<std::vector<double>> lat(c);
+    auto warm_end =
+        Clock::now() + std::chrono::milliseconds(opt.warmup_ms);
+    auto start = warm_end;
+    auto deadline = start + std::chrono::milliseconds(opt.window_ms);
+    std::vector<std::thread> threads;
+    for (int t = 0; t < c; ++t) {
+      threads.emplace_back([&, t]() {
+        std::string err;
+        while (!stop.load(std::memory_order_relaxed)) {
+          auto t0 = Clock::now();
+          if (t0 >= deadline) break;
+          if (!wl->InferOnce(ctxs[t].get(), &err)) {
+            fprintf(stderr, "FAILED: infer: %s\n", err.c_str());
+            failed.store(true);
+            stop.store(true);
+            break;
+          }
+          auto t1 = Clock::now();
+          if (t0 >= start && t1 <= deadline) {
+            lat[t].push_back(
+                std::chrono::duration<double, std::micro>(t1 - t0).count());
+            completed.fetch_add(1, std::memory_order_relaxed);
+          }
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+    if (failed.load()) return 1;
+    std::vector<double> all;
+    for (auto& v : lat) all.insert(all.end(), v.begin(), v.end());
+    Report(opt, "concurrency", c, completed.load(),
+           opt.window_ms / 1000.0, &all, 0, 0);
+  }
+  return 0;
+}
+
+int
+RunOpenLoop(const Options& opt, Workload* wl)
+{
+  for (double r = opt.rate_start; r <= opt.rate_end + 1e-9;
+       r += opt.rate_step) {
+    // precomputed schedule over the window (seeded => reproducible)
+    std::vector<double> sched_s;
+    {
+      std::mt19937_64 rng(12345);
+      std::exponential_distribution<double> exp_gap(r);
+      double t = 0, horizon = opt.window_ms / 1000.0;
+      while (true) {
+        t += (opt.distribution == "poisson") ? exp_gap(rng) : (1.0 / r);
+        if (t >= horizon) break;
+        sched_s.push_back(t);
+      }
+    }
+    int n_threads = std::min<int>(opt.max_threads,
+                                  std::max(1, static_cast<int>(r / 4) + 1));
+    std::vector<std::unique_ptr<Workload::Ctx>> ctxs;
+    for (int t = 0; t < n_threads; ++t) {
+      auto ctx = std::make_unique<Workload::Ctx>();
+      std::string err;
+      if (!wl->MakeCtx(ctx.get(), &err)) {
+        fprintf(stderr, "FAILED: client setup: %s\n", err.c_str());
+        return 1;
+      }
+      ctxs.push_back(std::move(ctx));
+    }
+    // one warmup request per client
+    for (auto& ctx : ctxs) {
+      std::string err;
+      if (!wl->InferOnce(ctx.get(), &err)) {
+        fprintf(stderr, "FAILED: warmup infer: %s\n", err.c_str());
+        return 1;
+      }
+    }
+    std::atomic<size_t> next{0};
+    std::atomic<bool> failed{false};
+    std::vector<std::vector<double>> lat(n_threads), lag(n_threads);
+    auto start = Clock::now();
+    auto deadline = start + std::chrono::milliseconds(opt.window_ms);
+    std::vector<std::thread> threads;
+    for (int t = 0; t < n_threads; ++t) {
+      threads.emplace_back([&, t]() {
+        std::string err;
+        while (!failed.load(std::memory_order_relaxed)) {
+          size_t slot = next.fetch_add(1, std::memory_order_relaxed);
+          if (slot >= sched_s.size()) break;
+          auto sched = start + std::chrono::duration_cast<Clock::duration>(
+                                   std::chrono::duration<double>(
+                                       sched_s[slot]));
+          std::this_thread::sleep_until(sched);
+          auto t0 = Clock::now();
+          if (t0 >= deadline) break;  // counts as unsent (no latency)
+          if (!wl->InferOnce(ctxs[t].get(), &err)) {
+            fprintf(stderr, "FAILED: infer: %s\n", err.c_str());
+            failed.store(true);
+            break;
+          }
+          auto t1 = Clock::now();
+          // latency from the SCHEDULED send time: queueing counts
+          lat[t].push_back(
+              std::chrono::duration<double, std::micro>(t1 - sched).count());
+          lag[t].push_back(
+              std::chrono::duration<double, std::micro>(t0 - sched).count());
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+    if (failed.load()) return 1;
+    std::vector<double> all, lags;
+    for (auto& v : lat) all.insert(all.end(), v.begin(), v.end());
+    for (auto& v : lag) lags.insert(lags.end(), v.begin(), v.end());
+    size_t sent = all.size();
+    size_t unsent = sched_s.size() - std::min(sched_s.size(), sent);
+    double wall = std::chrono::duration<double>(
+                      Clock::now() - start).count();
+    std::sort(lags.begin(), lags.end());
+    Report(opt, "request_rate", r, sent, std::max(wall, 1e-9), &all, unsent,
+           Percentile(lags, 0.99));
+  }
+  return 0;
+}
+
+bool
+FetchSpecs(const Options& opt, std::vector<TensorSpec>* specs,
+           std::string* err)
+{
+  if (opt.protocol == "grpc") {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    tc::Error e = tc::InferenceServerGrpcClient::Create(&client, opt.url);
+    if (!e.IsOk()) {
+      *err = e.Message();
+      return false;
+    }
+    inference::ModelMetadataResponse meta;
+    e = client->ModelMetadata(&meta, opt.model);
+    if (!e.IsOk()) {
+      *err = e.Message();
+      return false;
+    }
+    for (const auto& in : meta.inputs()) {
+      TensorSpec s;
+      s.name = in.name();
+      s.datatype = in.datatype();
+      for (auto d : in.shape()) s.dims.push_back(d);
+      specs->push_back(std::move(s));
+    }
+    return true;
+  }
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error e = tc::InferenceServerHttpClient::Create(&client, opt.url);
+  if (!e.IsOk()) {
+    *err = e.Message();
+    return false;
+  }
+  std::string body;
+  e = client->ModelMetadata(&body, opt.model);
+  if (!e.IsOk()) {
+    *err = e.Message();
+    return false;
+  }
+  tc_tpu::json::Value doc;
+  if (!tc_tpu::json::Parse(body, &doc, err)) return false;
+  if (!doc.Has("inputs") || !doc.At("inputs").IsArray()) {
+    *err = "model metadata carries no inputs array";
+    return false;
+  }
+  for (const auto& in : doc.At("inputs").AsArray()) {
+    TensorSpec s;
+    s.name = in.At("name").AsString();
+    s.datatype = in.At("datatype").AsString();
+    for (const auto& d : in.At("shape").AsArray())
+      s.dims.push_back(d.AsInt());
+    specs->push_back(std::move(s));
+  }
+  return true;
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  Options opt;
+  for (int i = 1; i < argc; ++i) {
+    auto next = [&](const char* flag) -> const char* {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "FAILED: %s needs a value\n", flag);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (!strcmp(argv[i], "-u")) {
+      opt.url = next("-u");
+    } else if (!strcmp(argv[i], "-i")) {
+      opt.protocol = next("-i");
+    } else if (!strcmp(argv[i], "-m")) {
+      opt.model = next("-m");
+    } else if (!strcmp(argv[i], "-b")) {
+      opt.batch = atoi(next("-b"));
+    } else if (!strcmp(argv[i], "-p")) {
+      opt.window_ms = atoi(next("-p"));
+    } else if (!strcmp(argv[i], "--warmup-ms")) {
+      opt.warmup_ms = atoi(next("--warmup-ms"));
+    } else if (!strcmp(argv[i], "--json")) {
+      opt.json_out = true;
+    } else if (!strcmp(argv[i], "--concurrency-range")) {
+      double a, b, c;
+      if (!ParseRange(next("--concurrency-range"), &a, &b, &c)) {
+        fprintf(stderr, "FAILED: bad --concurrency-range\n");
+        return 2;
+      }
+      opt.conc_start = static_cast<int>(a);
+      opt.conc_end = static_cast<int>(b);
+      opt.conc_step = static_cast<int>(c);
+      if (opt.conc_start < 1 || opt.conc_step < 1 ||
+          a != opt.conc_start || b != opt.conc_end || c != opt.conc_step) {
+        // fractional or non-positive values truncate to a stuck or
+        // zero-thread sweep — reject instead
+        fprintf(stderr, "FAILED: --concurrency-range needs positive "
+                        "integers\n");
+        return 2;
+      }
+      opt.have_conc = true;
+    } else if (!strcmp(argv[i], "--request-rate-range")) {
+      if (!ParseRange(next("--request-rate-range"), &opt.rate_start,
+                      &opt.rate_end, &opt.rate_step)) {
+        fprintf(stderr, "FAILED: bad --request-rate-range\n");
+        return 2;
+      }
+      opt.have_rate = true;
+    } else if (!strcmp(argv[i], "--request-distribution")) {
+      opt.distribution = next("--request-distribution");
+      if (opt.distribution != "constant" && opt.distribution != "poisson") {
+        fprintf(stderr, "FAILED: bad --request-distribution\n");
+        return 2;
+      }
+    } else if (!strcmp(argv[i], "--max-threads")) {
+      opt.max_threads = atoi(next("--max-threads"));
+    } else {
+      fprintf(stderr,
+              "usage: %s -m MODEL [-u URL] [-i grpc|http] [-b BATCH] "
+              "[-p WINDOW_MS] [--warmup-ms MS] [--json] "
+              "[--concurrency-range S:E[:STEP]] "
+              "[--request-rate-range S:E[:STEP] "
+              "[--request-distribution constant|poisson]] "
+              "[--max-threads N]\n",
+              argv[0]);
+      return 2;
+    }
+  }
+  if (opt.model.empty()) {
+    fprintf(stderr, "FAILED: -m MODEL is required\n");
+    return 2;
+  }
+  if (opt.protocol != "grpc" && opt.protocol != "http") {
+    fprintf(stderr, "FAILED: -i must be grpc or http\n");
+    return 2;
+  }
+  if (opt.have_rate && opt.rate_start <= 0) {
+    fprintf(stderr, "FAILED: request rate must be > 0\n");
+    return 2;
+  }
+  if (!opt.have_conc && !opt.have_rate) opt.have_conc = true;
+
+  std::vector<TensorSpec> specs;
+  std::string err;
+  if (!FetchSpecs(opt, &specs, &err)) {
+    fprintf(stderr, "FAILED: model metadata: %s\n", err.c_str());
+    return 1;
+  }
+  if (specs.empty()) {
+    fprintf(stderr, "FAILED: model has no inputs\n");
+    return 1;
+  }
+  for (const auto& s : specs) {
+    if (s.datatype != "BYTES" && DtypeSize(s.datatype) == 0) {
+      fprintf(stderr, "FAILED: unsupported input datatype %s\n",
+              s.datatype.c_str());
+      return 1;
+    }
+  }
+  Workload wl(opt, std::move(specs));
+  int rc = 0;
+  if (opt.have_conc) rc = RunClosedLoop(opt, &wl);
+  if (rc == 0 && opt.have_rate) rc = RunOpenLoop(opt, &wl);
+  if (rc == 0) printf("PASS: perf_client\n");
+  return rc;
+}

@@ -203,3 +203,72 @@ def test_shared_library_symbol_hygiene(native_build, lib, allowed):
             leaked.append(line)
     assert exported > 0, f"{lib} exports nothing — version script too strict"
     assert not leaked, f"{lib} leaks symbols:\n" + "\n".join(leaked[:40])
+
+
+class TestNativePerfClient:
+    """tpu_perf_client — the perf_analyzer C++ core (tools/perf_client.cc):
+    metadata-driven input synthesis, closed-loop concurrency sweeps, and
+    coordinated-omission-free open-loop rate sweeps over the native
+    clients (SURVEY.md §2.3 item 8: upstream's perf_analyzer is native;
+    so is this one)."""
+
+    def _run(self, native_build, args):
+        proc = subprocess.run(
+            [os.path.join(native_build, "tpu_perf_client")] + args,
+            capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, (
+            f"tpu_perf_client failed\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr}")
+        assert "PASS: perf_client" in proc.stdout
+        import json as _json
+        return [_json.loads(line) for line in proc.stdout.splitlines()
+                if line.startswith("{")]
+
+    def test_closed_loop_grpc_sweep(self, native_build, harness):
+        rows = self._run(native_build, [
+            "-i", "grpc", "-u", f"127.0.0.1:{harness.grpc_port}",
+            "-m", "simple", "--concurrency-range", "1:2", "-p", "1200",
+            "--warmup-ms", "200", "--json"])
+        assert [r["level"] for r in rows] == [1, 2]
+        for r in rows:
+            assert r["mode"] == "concurrency"
+            assert r["throughput_infer_per_sec"] > 0
+            assert 0 < r["latency_p50_us"] <= r["latency_p99_us"]
+            assert r["completed"] > 0
+
+    def test_closed_loop_http(self, native_build, harness):
+        rows = self._run(native_build, [
+            "-i", "http", "-u", f"127.0.0.1:{harness.http_port}",
+            "-m", "simple", "--concurrency-range", "2:2", "-p", "1000",
+            "--warmup-ms", "200", "--json"])
+        assert rows[0]["level"] == 2 and rows[0]["completed"] > 0
+
+    def test_open_loop_poisson_from_scheduled_send(self, native_build,
+                                                   harness):
+        rows = self._run(native_build, [
+            "-i", "grpc", "-u", f"127.0.0.1:{harness.grpc_port}",
+            "-m", "simple", "--request-rate-range", "40:80:40",
+            "--request-distribution", "poisson", "-p", "1500", "--json"])
+        assert [r["level"] for r in rows] == [40, 80]
+        for r in rows:
+            assert r["mode"] == "request_rate"
+            # held rate: sent ~= scheduled (generous bound — CI hosts lag)
+            assert r["completed"] >= 0.5 * r["level"] * 1.5
+            assert r["latency_p50_us"] > 0
+            assert "send_lag_p99_us" in r and "unsent" in r
+
+    def test_bytes_model_synthesis(self, native_build, harness):
+        rows = self._run(native_build, [
+            "-i", "grpc", "-u", f"127.0.0.1:{harness.grpc_port}",
+            "-m", "simple_string", "--concurrency-range", "1:1",
+            "-p", "800", "--json"])
+        assert rows[0]["completed"] > 0
+
+    def test_unknown_model_fails_loudly(self, native_build, harness):
+        proc = subprocess.run(
+            [os.path.join(native_build, "tpu_perf_client"), "-i", "grpc",
+             "-u", f"127.0.0.1:{harness.grpc_port}", "-m", "no_such_model",
+             "--concurrency-range", "1:1", "-p", "500"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode != 0
+        assert "FAILED" in proc.stderr
