@@ -409,6 +409,38 @@ def _measure_flash_attention() -> dict:
     }
 
 
+def _measure_native_client(url: str) -> dict:
+    """Headline config through the native C++ client (tpu_perf_client):
+    same server, same model, same c=8 closed loop.  Skipped (empty dict)
+    when the CMake tree isn't built — the driver bench must not spend its
+    window compiling C++."""
+    import subprocess
+
+    binary = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "native", "client", "build", "tpu_perf_client")
+    if not os.path.exists(binary):
+        return {}
+    try:
+        proc = subprocess.run(
+            [binary, "-i", "grpc", "-u", url, "-m", "simple",
+             "--concurrency-range", "8:8", "-p", "5000",
+             "--warmup-ms", "1000", "--json"],
+            capture_output=True, text=True, timeout=60)
+        if proc.returncode != 0:
+            return {"native_client_error":
+                    f"rc={proc.returncode}: {proc.stderr.strip()[:100]}"}
+        row = next(json.loads(l) for l in proc.stdout.splitlines()
+                   if l.startswith("{"))
+        return {
+            "native_client_infer_per_sec": round(
+                row["throughput_infer_per_sec"], 2),
+            "native_client_p50_ms": round(row["latency_p50_us"] / 1e3, 3),
+            "native_client_p99_ms": round(row["latency_p99_us"] / 1e3, 3),
+        }
+    except Exception as e:  # noqa: BLE001 — optional leg never kills bench
+        return {"native_client_error": str(e)[:120]}
+
+
 def main() -> int:
     from triton_client_tpu.grpc import InferenceServerClient, InferInput
     from triton_client_tpu.models import zoo
@@ -510,6 +542,10 @@ def main() -> int:
     simple_errors = [e for r in simple_runs for e in r["errors"]]
     # drift control, same session: no-compute RPC rate at the same c=8
     null_rpc = _measure_null_rpc(url)
+    # same config through the NATIVE C++ client (tools/perf_client.cc) when
+    # its binary is built — a cross-language drift control on the headline:
+    # same server, same model, same c=8 closed loop, no client-side GIL
+    native_metrics = _measure_native_client(url)
     # Device path, wire data: concurrency = 4x max batch so the dynamic
     # batcher forms full 64-batches AND up to 4 of them pipeline over the
     # device link (at 64 the closed loop admits exactly one batch in flight,
@@ -615,6 +651,7 @@ def main() -> int:
         "value_per_null_rpc": (round(value / null_rpc, 4)
                                if null_rpc else None),
     }
+    out.update(native_metrics)
     out.update(bert_metrics)
     out.update(gen_metrics)
     out.update(_measure_flash_attention())
