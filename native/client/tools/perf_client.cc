@@ -33,9 +33,14 @@
 #include <thread>
 #include <vector>
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
 #include "grpc_client.h"
 #include "http_client.h"
 #include "json.h"
+#include "xla_shm_utils.h"
 
 namespace tc = tc_tpu::client;
 using Clock = std::chrono::steady_clock;
@@ -64,6 +69,10 @@ struct Options {
   bool have_rate = false;
   std::string distribution = "constant";  // constant | poisson
   int max_threads = 32;
+  // data path: none (wire tensors) | system (POSIX shm) | xla (device
+  // staging regions — the cudashm analog)
+  std::string shared_memory = "none";
+  size_t output_shm_size = 1 << 20;  // reference --output-shared-memory-size
 };
 
 bool
@@ -118,8 +127,10 @@ FillTensor(const std::string& dt, size_t n_elems, std::vector<uint8_t>* buf)
 
 class Workload {
  public:
-  Workload(const Options& opt, std::vector<TensorSpec> specs)
-      : opt_(opt), specs_(std::move(specs))
+  Workload(const Options& opt, std::vector<TensorSpec> specs,
+           std::vector<std::string> output_names)
+      : opt_(opt), specs_(std::move(specs)),
+        output_names_(std::move(output_names))
   {
     for (const auto& s : specs_) {
       std::vector<int64_t> shape = s.dims;
@@ -131,8 +142,12 @@ class Workload {
       shapes_.push_back(shape);
       std::vector<uint8_t> buf;
       if (s.datatype != "BYTES") FillTensor(s.datatype, n, &buf);
+      const size_t nbytes = buf.size();
       fills_.push_back(std::move(buf));
       counts_.push_back(n);
+      // 64-byte-aligned packing for the single shared input region
+      offsets_.push_back(in_region_bytes_);
+      in_region_bytes_ += (nbytes + 63) & ~size_t(63);
     }
   }
 
@@ -141,9 +156,57 @@ class Workload {
     std::unique_ptr<tc::InferenceServerGrpcClient> grpc;
     std::unique_ptr<tc::InferenceServerHttpClient> http;
     std::vector<tc::InferInput*> inputs;
+    std::vector<const tc::InferRequestedOutput*> outputs;
+    // system-shm regions (inputs packed in one, outputs strided in one)
+    struct SysRegion {
+      std::string name, key;
+      int fd = -1;
+      void* base = nullptr;
+      size_t size = 0;
+    };
+    SysRegion sys_in, sys_out;
+    // xla staging regions (cudashm analog)
+    tc::XlaShmHandle xla_in, xla_out;
+    bool have_xla_in = false, have_xla_out = false;
+
+    void UnregisterSys(const std::string& name)
+    {
+      if (grpc != nullptr) {
+        grpc->UnregisterSystemSharedMemory(name);
+      } else if (http != nullptr) {
+        http->UnregisterSystemSharedMemory(name);
+      }
+    }
+    void UnregisterXla(const std::string& name)
+    {
+      if (grpc != nullptr) {
+        grpc->UnregisterCudaSharedMemory(name);
+      } else if (http != nullptr) {
+        http->UnregisterCudaSharedMemory(name);
+      }
+    }
     ~Ctx()
     {
       for (auto* in : inputs) delete in;
+      for (const auto* out : outputs) delete out;
+      for (auto* r : {&sys_in, &sys_out}) {
+        if (r->base != nullptr) {
+          UnregisterSys(r->name);
+          munmap(r->base, r->size);
+        }
+        if (r->fd >= 0) close(r->fd);
+        // unlink whenever shm_open ran — a failed mmap must not leak the
+        // region file in /dev/shm
+        if (!r->key.empty()) shm_unlink(r->key.c_str());
+      }
+      if (have_xla_in) {
+        UnregisterXla(xla_in.triton_shm_name);
+        tc::DestroyXlaSharedMemoryRegion(&xla_in);
+      }
+      if (have_xla_out) {
+        UnregisterXla(xla_out.triton_shm_name);
+        tc::DestroyXlaSharedMemoryRegion(&xla_out);
+      }
     }
   };
 
@@ -159,6 +222,7 @@ class Workload {
       *err = e.Message();
       return false;
     }
+    if (opt_.shared_memory != "none") return SetupShm(ctx, err);
     for (size_t i = 0; i < specs_.size(); ++i) {
       tc::InferInput* in = nullptr;
       e = tc::InferInput::Create(&in, specs_[i].name, shapes_[i],
@@ -180,13 +244,133 @@ class Workload {
     return true;
   }
 
+  // Shared-memory data path: inputs packed into one region written once
+  // before the clock starts; outputs strided through a second region of
+  // --output-shared-memory-size bytes each (reference perf_analyzer
+  // --shared-memory=system|cuda contract; xla is the cudashm analog).
+  bool SetupShm(Ctx* ctx, std::string* err)
+  {
+    static std::atomic<uint64_t> uniq{0};
+    const uint64_t id = uniq.fetch_add(1);
+    char tag[64];
+    snprintf(tag, sizeof(tag), "%d_%llu", static_cast<int>(getpid()),
+             static_cast<unsigned long long>(id));
+    const size_t out_bytes = output_names_.size() * opt_.output_shm_size;
+    // a model with no declared outputs has nothing to bind a region to:
+    // inputs still ride shm, outputs stay on the wire (out_bytes == 0
+    // would otherwise surface as an obscure mmap EINVAL)
+    const bool want_out = !output_names_.empty();
+    tc::Error e;
+    std::string in_name, out_name;
+    if (opt_.shared_memory == "system") {
+      std::vector<Ctx::SysRegion*> regions{&ctx->sys_in};
+      if (want_out) regions.push_back(&ctx->sys_out);
+      for (auto* spec : regions) {
+        bool is_in = (spec == &ctx->sys_in);
+        spec->name = std::string(is_in ? "perf_in_" : "perf_out_") + tag;
+        spec->key = "/" + spec->name;
+        spec->size = is_in ? in_region_bytes_ : out_bytes;
+        shm_unlink(spec->key.c_str());
+        spec->fd = shm_open(spec->key.c_str(), O_RDWR | O_CREAT, 0600);
+        if (spec->fd < 0 ||
+            ftruncate(spec->fd, static_cast<off_t>(spec->size)) != 0) {
+          *err = "shm_open/ftruncate failed for " + spec->key;
+          return false;
+        }
+        spec->base = mmap(nullptr, spec->size, PROT_READ | PROT_WRITE,
+                          MAP_SHARED, spec->fd, 0);
+        if (spec->base == MAP_FAILED) {
+          spec->base = nullptr;
+          *err = "mmap failed for " + spec->key;
+          return false;
+        }
+      }
+      for (size_t i = 0; i < specs_.size(); ++i) {
+        memcpy(static_cast<uint8_t*>(ctx->sys_in.base) + offsets_[i],
+               fills_[i].data(), fills_[i].size());
+      }
+      auto reg = [&](const Ctx::SysRegion& r) {
+        return (ctx->grpc != nullptr)
+                   ? ctx->grpc->RegisterSystemSharedMemory(r.name, r.key,
+                                                           r.size)
+                   : ctx->http->RegisterSystemSharedMemory(r.name, r.key,
+                                                           r.size);
+      };
+      e = reg(ctx->sys_in);
+      if (e.IsOk() && want_out) e = reg(ctx->sys_out);
+      if (!e.IsOk()) {
+        *err = e.Message();
+        return false;
+      }
+      in_name = ctx->sys_in.name;
+      out_name = ctx->sys_out.name;
+    } else {  // xla
+      e = tc::CreateXlaSharedMemoryRegion(
+          &ctx->xla_in, std::string("perf_xin_") + tag, in_region_bytes_, 0);
+      if (e.IsOk()) ctx->have_xla_in = true;
+      if (e.IsOk() && want_out) {
+        e = tc::CreateXlaSharedMemoryRegion(
+            &ctx->xla_out, std::string("perf_xout_") + tag, out_bytes, 0);
+        if (e.IsOk()) ctx->have_xla_out = true;
+      }
+      for (size_t i = 0; e.IsOk() && i < specs_.size(); ++i) {
+        e = tc::SetXlaSharedMemoryRegion(ctx->xla_in, fills_[i].data(),
+                                         fills_[i].size(), offsets_[i]);
+      }
+      auto reg = [&](const tc::XlaShmHandle& h, size_t size) {
+        std::vector<uint8_t> raw;
+        tc::Error er = tc::GetXlaSharedMemoryRawHandle(h, &raw);
+        if (!er.IsOk()) return er;
+        return (ctx->grpc != nullptr)
+                   ? ctx->grpc->RegisterCudaSharedMemory(h.triton_shm_name,
+                                                         raw, 0, size)
+                   : ctx->http->RegisterCudaSharedMemory(h.triton_shm_name,
+                                                         raw, 0, size);
+      };
+      if (e.IsOk()) e = reg(ctx->xla_in, in_region_bytes_);
+      if (e.IsOk() && want_out) e = reg(ctx->xla_out, out_bytes);
+      if (!e.IsOk()) {
+        *err = e.Message();
+        return false;
+      }
+      in_name = ctx->xla_in.triton_shm_name;
+      out_name = ctx->xla_out.triton_shm_name;
+    }
+    for (size_t i = 0; i < specs_.size(); ++i) {
+      tc::InferInput* in = nullptr;
+      e = tc::InferInput::Create(&in, specs_[i].name, shapes_[i],
+                                 specs_[i].datatype);
+      if (e.IsOk()) e = in->SetSharedMemory(in_name, fills_[i].size(),
+                                            offsets_[i]);
+      if (!e.IsOk()) {
+        *err = e.Message();
+        return false;
+      }
+      ctx->inputs.push_back(in);
+    }
+    for (size_t i = 0; i < output_names_.size(); ++i) {
+      tc::InferRequestedOutput* out = nullptr;
+      e = tc::InferRequestedOutput::Create(&out, output_names_[i]);
+      if (e.IsOk()) e = out->SetSharedMemory(out_name, opt_.output_shm_size,
+                                             i * opt_.output_shm_size);
+      if (!e.IsOk()) {
+        *err = e.Message();
+        return false;
+      }
+      ctx->outputs.push_back(out);
+    }
+    return true;
+  }
+
   bool InferOnce(Ctx* ctx, std::string* err)
   {
     tc::InferOptions options(opt_.model);
     tc::InferResult* result = nullptr;
     tc::Error e = (ctx->grpc != nullptr)
-                      ? ctx->grpc->Infer(&result, options, ctx->inputs)
-                      : ctx->http->Infer(&result, options, ctx->inputs);
+                      ? ctx->grpc->Infer(&result, options, ctx->inputs,
+                                         ctx->outputs)
+                      : ctx->http->Infer(&result, options, ctx->inputs,
+                                         ctx->outputs);
     if (!e.IsOk()) {
       *err = e.Message();
       return false;
@@ -200,9 +384,12 @@ class Workload {
  private:
   const Options& opt_;
   std::vector<TensorSpec> specs_;
+  std::vector<std::string> output_names_;
   std::vector<std::vector<int64_t>> shapes_;
   std::vector<std::vector<uint8_t>> fills_;
   std::vector<size_t> counts_;
+  std::vector<size_t> offsets_;
+  size_t in_region_bytes_ = 0;
 };
 
 // `v` must be sorted ascending (callers sort once per report).
@@ -386,7 +573,7 @@ RunOpenLoop(const Options& opt, Workload* wl)
 
 bool
 FetchSpecs(const Options& opt, std::vector<TensorSpec>* specs,
-           std::string* err)
+           std::vector<std::string>* output_names, std::string* err)
 {
   if (opt.protocol == "grpc") {
     std::unique_ptr<tc::InferenceServerGrpcClient> client;
@@ -408,6 +595,7 @@ FetchSpecs(const Options& opt, std::vector<TensorSpec>* specs,
       for (auto d : in.shape()) s.dims.push_back(d);
       specs->push_back(std::move(s));
     }
+    for (const auto& out : meta.outputs()) output_names->push_back(out.name());
     return true;
   }
   std::unique_ptr<tc::InferenceServerHttpClient> client;
@@ -435,6 +623,10 @@ FetchSpecs(const Options& opt, std::vector<TensorSpec>* specs,
     for (const auto& d : in.At("shape").AsArray())
       s.dims.push_back(d.AsInt());
     specs->push_back(std::move(s));
+  }
+  if (doc.Has("outputs") && doc.At("outputs").IsArray()) {
+    for (const auto& out : doc.At("outputs").AsArray())
+      output_names->push_back(out.At("name").AsString());
   }
   return true;
 }
@@ -500,6 +692,20 @@ main(int argc, char** argv)
       }
     } else if (!strcmp(argv[i], "--max-threads")) {
       opt.max_threads = atoi(next("--max-threads"));
+    } else if (!strcmp(argv[i], "--shared-memory")) {
+      opt.shared_memory = next("--shared-memory");
+      if (opt.shared_memory != "none" && opt.shared_memory != "system" &&
+          opt.shared_memory != "xla") {
+        fprintf(stderr, "FAILED: --shared-memory must be none|system|xla\n");
+        return 2;
+      }
+    } else if (!strcmp(argv[i], "--output-shared-memory-size")) {
+      long v = atol(next("--output-shared-memory-size"));
+      if (v <= 0) {
+        fprintf(stderr, "FAILED: bad --output-shared-memory-size\n");
+        return 2;
+      }
+      opt.output_shm_size = static_cast<size_t>(v);
     } else {
       fprintf(stderr,
               "usage: %s -m MODEL [-u URL] [-i grpc|http] [-b BATCH] "
@@ -507,7 +713,8 @@ main(int argc, char** argv)
               "[--concurrency-range S:E[:STEP]] "
               "[--request-rate-range S:E[:STEP] "
               "[--request-distribution constant|poisson]] "
-              "[--max-threads N]\n",
+              "[--max-threads N] [--shared-memory none|system|xla] "
+              "[--output-shared-memory-size BYTES]\n",
               argv[0]);
       return 2;
     }
@@ -527,8 +734,9 @@ main(int argc, char** argv)
   if (!opt.have_conc && !opt.have_rate) opt.have_conc = true;
 
   std::vector<TensorSpec> specs;
+  std::vector<std::string> output_names;
   std::string err;
-  if (!FetchSpecs(opt, &specs, &err)) {
+  if (!FetchSpecs(opt, &specs, &output_names, &err)) {
     fprintf(stderr, "FAILED: model metadata: %s\n", err.c_str());
     return 1;
   }
@@ -542,8 +750,13 @@ main(int argc, char** argv)
               s.datatype.c_str());
       return 1;
     }
+    if (s.datatype == "BYTES" && opt.shared_memory != "none") {
+      fprintf(stderr,
+              "FAILED: BYTES inputs cannot ride --shared-memory\n");
+      return 1;
+    }
   }
-  Workload wl(opt, std::move(specs));
+  Workload wl(opt, std::move(specs), std::move(output_names));
   int rc = 0;
   if (opt.have_conc) rc = RunClosedLoop(opt, &wl);
   if (rc == 0 && opt.have_rate) rc = RunOpenLoop(opt, &wl);

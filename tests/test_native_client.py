@@ -272,3 +272,30 @@ class TestNativePerfClient:
             capture_output=True, text=True, timeout=60)
         assert proc.returncode != 0
         assert "FAILED" in proc.stderr
+
+    @pytest.mark.parametrize("mode", ["system", "xla"])
+    def test_shared_memory_modes(self, native_build, harness, mode):
+        # reference perf_analyzer --shared-memory=system|cuda contract;
+        # xla is this framework's cudashm analog. Inputs ride one packed
+        # region, outputs stride through --output-shared-memory-size slots.
+        before = set(os.listdir("/dev/shm"))
+        rows = self._run(native_build, [
+            "-i", "grpc", "-u", f"127.0.0.1:{harness.grpc_port}",
+            "-m", "simple", "--concurrency-range", "2:2", "-p", "1000",
+            "--shared-memory", mode,
+            "--output-shared-memory-size", "4096", "--json"])
+        # regions are unregistered and unlinked on exit: no NEW /dev/shm
+        # entries survive (delta-based so concurrent hosts can't trip it)
+        leaked = set(os.listdir("/dev/shm")) - before
+        assert leaked == set()
+        assert rows[0]["completed"] > 0
+
+    def test_bytes_plus_shm_rejected(self, native_build, harness):
+        proc = subprocess.run(
+            [os.path.join(native_build, "tpu_perf_client"), "-i", "grpc",
+             "-u", f"127.0.0.1:{harness.grpc_port}", "-m", "simple_string",
+             "--concurrency-range", "1:1", "-p", "500",
+             "--shared-memory", "system"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode != 0
+        assert "BYTES" in proc.stderr
