@@ -147,3 +147,51 @@ class TestMultihost:
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "MULTIHOST-OK" in proc.stdout
+
+    def test_two_process_cross_host_collective(self, tmp_path):
+        """REAL multi-process jax.distributed: two OS processes, 4 CPU
+        devices each, one 8-device global mesh; a sharded sum must
+        all-reduce across the process boundary (the DCN path the server's
+        pod bootstrap rides) and agree on both ranks."""
+        script = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from triton_client_tpu.parallel import initialize_multihost
+
+coord, pid = sys.argv[1], int(sys.argv[2])
+assert initialize_multihost(coord, 2, pid)
+assert jax.process_count() == 2 and jax.process_index() == pid
+assert jax.device_count() == 8 and len(jax.local_devices()) == 4
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+sharding = NamedSharding(mesh, P("x"))
+local = np.arange(8, dtype=np.float32)[pid * 4:(pid + 1) * 4]
+ga = jax.make_array_from_process_local_data(sharding, local)
+total = jax.jit(lambda a: jax.numpy.sum(a),
+                out_shardings=NamedSharding(mesh, P()))(ga)
+assert float(total) == 28.0, float(total)
+print(f"RANK{pid}-OK", flush=True)
+"""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        coord = f"localhost:{port}"
+        sfile = tmp_path / "two_proc.py"
+        sfile.write_text(script)
+        procs = [subprocess.Popen(
+                     [sys.executable, str(sfile), coord, str(i)],
+                     stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                     text=True, cwd="/root/repo")
+                 for i in range(2)]
+        outs = [p.communicate(timeout=180) for p in procs]
+        for i, (p, (out, err)) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {i}:\n{err[-2000:]}"
+            assert f"RANK{i}-OK" in out
