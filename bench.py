@@ -40,6 +40,25 @@ def _timed_infer(client, model, inputs) -> float:
     return time.perf_counter() - t0
 
 
+def _client_telemetry_summary() -> list:
+    """Compact snapshot of the process-wide client telemetry registry:
+    one row per (protocol, method, model) with counts and quantiles."""
+    from triton_client_tpu._telemetry import telemetry
+
+    rows = []
+    for s in telemetry().snapshot()["requests"]:
+        rows.append({
+            "key": f"{s['protocol']}/{s['method']}/{s['model']}",
+            "success": s["success"],
+            "failure": s["failure"],
+            "p50_us": (round(s["p50_us"], 1)
+                       if s["p50_us"] is not None else None),
+            "p99_us": (round(s["p99_us"], 1)
+                       if s["p99_us"] is not None else None),
+        })
+    return rows
+
+
 def _previous_baseline() -> float | None:
     """Headline value from the earliest recorded round (driver-written
     BENCH_r{N}.json files at the repo root)."""
@@ -655,6 +674,10 @@ def main() -> int:
     out.update(bert_metrics)
     out.update(gen_metrics)
     out.update(_measure_flash_attention())
+    # client-side telemetry (the instrumented clients recorded every leg):
+    # a compact per-(protocol, method, model) view so the bench record
+    # carries client-observed p50/p99 next to the server-derived numbers
+    out["client_telemetry"] = _client_telemetry_summary()
     if errors:
         out["errors"] = errors[:4]
     print(json.dumps(out))

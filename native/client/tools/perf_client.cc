@@ -106,7 +106,9 @@ FillTensor(const std::string& dt, size_t n_elems, std::vector<uint8_t>* buf)
   size_t esz = DtypeSize(dt);
   buf->resize(n_elems * esz);
   for (size_t i = 0; i < n_elems; ++i) {
-    long v = static_cast<long>(i % 10);
+    // BOOL payloads must stay canonical 0/1: bytes 2..9 are not valid
+    // booleans and a validating decoder may reject them
+    long v = static_cast<long>(dt == "BOOL" ? i % 2 : i % 10);
     uint8_t* p = buf->data() + i * esz;
     if (dt == "FP32") {
       float f = static_cast<float>(v);

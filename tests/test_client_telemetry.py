@@ -1,0 +1,302 @@
+"""Client telemetry layer: histogram accuracy, per-client counters, and
+end-to-end trace correlation.
+
+The tentpole contract (ISSUE 1): an inference through ANY of the four client
+entrypoints yields a client-side histogram observation visible in the client
+Prometheus rendering, and — with tracing enabled — a server trace record
+carrying the client's request id, which is also echoed in the response
+headers (HTTP) / response parameters (both protocols).
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+import triton_client_tpu.grpc as grpcclient
+import triton_client_tpu.grpc.aio as grpcaio
+import triton_client_tpu.http as httpclient
+import triton_client_tpu.http.aio as httpaio
+from triton_client_tpu._telemetry import (
+    LatencyHistogram,
+    new_trace_context,
+    telemetry,
+)
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+from triton_client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry().reset()
+    yield
+    telemetry().reset()
+    telemetry().set_request_hook(None)
+
+
+def _simple_inputs(cls):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    inputs = [cls("INPUT0", [1, 16], "INT32"), cls("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_data_from_numpy(a)
+    inputs[1].set_data_from_numpy(a)
+    return inputs
+
+
+class TestLatencyHistogram:
+    # log-bucket growth is 5% → quantile error bound is sqrt(1.05)-1 ≈ 2.5%
+    # plus discrete-rank effects; 6% is a safe assertion ceiling
+    TOL = 0.06
+
+    @pytest.mark.parametrize("dist", ["uniform", "lognormal", "bimodal"])
+    def test_quantiles_match_numpy(self, dist):
+        rng = np.random.default_rng(42)
+        n = 20000
+        if dist == "uniform":
+            samples = rng.uniform(1e-3, 1e-2, n)
+        elif dist == "lognormal":
+            samples = np.exp(rng.normal(np.log(5e-3), 0.5, n))
+        else:
+            # 40/60 split keeps p50/p90/p99 inside the upper mode — at an
+            # exact mode boundary nearest-rank and linear interpolation
+            # legitimately diverge by the whole inter-mode gap
+            samples = np.concatenate([
+                rng.normal(2e-3, 1e-4, int(n * 0.4)),
+                rng.normal(50e-3, 2e-3, n - int(n * 0.4)),
+            ]).clip(min=1e-5)
+        h = LatencyHistogram()
+        for v in samples:
+            h.observe(float(v))
+        assert h.count == n
+        assert h.sum_s == pytest.approx(samples.sum(), rel=1e-9)
+        for p in (50, 90, 99):
+            want = float(np.percentile(samples, p))
+            got = h.percentile(p)
+            assert got == pytest.approx(want, rel=self.TOL), (dist, p)
+
+    def test_empty_and_extremes(self):
+        h = LatencyHistogram()
+        assert np.isnan(h.quantile(0.5))
+        h.observe(0.0)        # underflow bucket
+        h.observe(1e9)        # overflow bucket
+        assert h.count == 2
+        assert h.quantile(0.0) < 1e-6
+        assert h.quantile(1.0) > 100.0
+
+    def test_merge(self):
+        a, b = LatencyHistogram(), LatencyHistogram()
+        for v in (1e-3, 2e-3):
+            a.observe(v)
+        for v in (4e-3, 8e-3):
+            b.observe(v)
+        a.merge(b)
+        assert a.count == 4
+        assert a.sum_s == pytest.approx(15e-3)
+
+
+class TestTraceContext:
+    def test_user_request_id_is_kept(self):
+        ctx = new_trace_context("my-id")
+        assert ctx["triton-request-id"] == "my-id"
+
+    def test_header_unsafe_request_id_stays_body_only(self):
+        # the wire `id` field accepts any string, but header/metadata values
+        # do not: a non-ASCII or control-character id must not become a
+        # client-side send failure — a minted id carries the correlation
+        for bad in ("café-1", "id\nwith\nnewlines", "tab\tid", ""):
+            ctx = new_trace_context(bad)
+            assert len(ctx["triton-request-id"]) == 16
+            assert ctx["triton-request-id"] != bad
+
+    def test_generated_context_shape(self):
+        ctx = new_trace_context()
+        assert len(ctx["triton-request-id"]) == 16
+        version, trace_id, span_id, flags = ctx["traceparent"].split("-")
+        assert (version, flags) == ("00", "01")
+        assert len(trace_id) == 32 and len(span_id) == 16
+        # two contexts never collide
+        assert ctx != new_trace_context()
+
+
+class TestCountersAcrossClients:
+    """Every client variant records success/failure + latency + bytes."""
+
+    def test_http_sync(self, server):
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            c.infer("simple", _simple_inputs(httpclient.InferInput))
+            c.async_infer(
+                "simple", _simple_inputs(httpclient.InferInput)).get_result()
+        snap = {(s["protocol"], s["method"]): s
+                for s in telemetry().snapshot()["requests"]}
+        for method in ("infer", "async_infer"):
+            s = snap[("http", method)]
+            assert s["model"] == "simple"
+            assert s["success"] == 1 and s["failure"] == 0
+            assert s["request_bytes"] > 0 and s["response_bytes"] > 0
+            assert s["count"] == 1 and s["p50_us"] > 0
+
+    def test_grpc_sync(self, server):
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            c.infer("simple", _simple_inputs(grpcclient.InferInput))
+            c.async_infer(
+                "simple", _simple_inputs(grpcclient.InferInput)).get_result()
+        snap = {(s["protocol"], s["method"]): s
+                for s in telemetry().snapshot()["requests"]}
+        for method in ("infer", "async_infer"):
+            s = snap[("grpc", method)]
+            assert s["success"] == 1 and s["failure"] == 0
+            assert s["request_bytes"] > 0 and s["response_bytes"] > 0
+            assert s["count"] == 1
+
+    def test_aio_clients(self, server):
+        async def run():
+            async with httpaio.InferenceServerClient(server.http_url) as hc:
+                await hc.infer("simple", _simple_inputs(httpclient.InferInput))
+            async with grpcaio.InferenceServerClient(server.grpc_url) as gc:
+                await gc.infer("simple", _simple_inputs(grpcclient.InferInput))
+
+        asyncio.run(run())
+        snap = {(s["protocol"], s["method"]): s
+                for s in telemetry().snapshot()["requests"]}
+        assert snap[("http_aio", "infer")]["success"] == 1
+        assert snap[("grpc_aio", "infer")]["success"] == 1
+
+    def test_failures_are_counted(self, server):
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            with pytest.raises(InferenceServerException):
+                c.infer("no_such_model", _simple_inputs(httpclient.InferInput))
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            with pytest.raises(InferenceServerException):
+                c.infer("no_such_model", _simple_inputs(grpcclient.InferInput))
+        snap = {(s["protocol"], s["model"]): s
+                for s in telemetry().snapshot()["requests"]}
+        assert snap[("http", "no_such_model")]["failure"] == 1
+        assert snap[("grpc", "no_such_model")]["failure"] == 1
+
+    def test_prometheus_rendering_has_observations(self, server):
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            c.infer("simple", _simple_inputs(httpclient.InferInput))
+        text = telemetry().render_prometheus()
+        assert ('nv_client_inference_request_success{model="simple",'
+                'protocol="http",method="infer"} 1') in text
+        assert 'quantile="0.99"' in text
+        assert "nv_client_inference_request_duration_us_count" in text
+
+    def test_request_hook(self, server):
+        events = []
+        telemetry().set_request_hook(events.append)
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            c.infer("simple", _simple_inputs(httpclient.InferInput),
+                    request_id="hooked")
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["model"] == "simple" and ev["protocol"] == "http"
+        assert ev["ok"] is True and ev["latency_s"] > 0
+        assert ev["request_id"] == "hooked"
+
+    def test_broken_hook_does_not_fail_requests(self, server):
+        telemetry().set_request_hook(
+            lambda ev: (_ for _ in ()).throw(RuntimeError("boom")))
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            res = c.infer("simple", _simple_inputs(httpclient.InferInput))
+        assert res.as_numpy("OUTPUT0") is not None
+
+
+class TestEndToEndTraceCorrelation:
+    """Acceptance: the client-generated request id appears in the server
+    trace file AND in the response headers/metadata, over both protocols."""
+
+    @pytest.fixture()
+    def traced(self, server, tmp_path):
+        tf = tmp_path / "trace.jsonl"
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            c.update_trace_settings(settings={
+                "trace_file": [str(tf)],
+                "trace_level": ["TIMESTAMPS"],
+                "trace_rate": ["1"],
+            })
+        yield tf
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            c.update_trace_settings(settings={"trace_level": ["OFF"]})
+
+    def _trace_ids(self, tf):
+        with open(tf) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def test_http_propagation(self, server, traced):
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            res = c.infer("simple", _simple_inputs(httpclient.InferInput),
+                          request_id="corr-http-1")
+        # echoed back on the response, both surfaces
+        assert res.get_headers()["triton-request-id"] == "corr-http-1"
+        assert res.get_response()["parameters"]["triton_request_id"] == \
+            "corr-http-1"
+        records = self._trace_ids(traced)
+        rec = next(r for r in records
+                   if r.get("triton_request_id") == "corr-http-1")
+        assert rec["model_name"] == "simple"
+        assert rec["traceparent"].startswith("00-")
+        names = [ts["name"] for ts in rec["timestamps"]]
+        assert "COMPUTE_START" in names
+
+    def test_grpc_propagation(self, server, traced):
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            res = c.infer("simple", _simple_inputs(grpcclient.InferInput),
+                          request_id="corr-grpc-1")
+        params = res.get_response().parameters
+        assert params["triton_request_id"].string_param == "corr-grpc-1"
+        records = self._trace_ids(traced)
+        assert any(r.get("triton_request_id") == "corr-grpc-1"
+                   for r in records)
+
+    def test_generated_id_joins_client_and_server(self, server, traced):
+        """No explicit request_id: the client mints one; it must still match
+        between the response echo and the trace record."""
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            res = c.infer("simple", _simple_inputs(httpclient.InferInput))
+        echoed = res.get_headers()["triton-request-id"]
+        assert len(echoed) == 16
+        records = self._trace_ids(traced)
+        assert any(r.get("triton_request_id") == echoed for r in records)
+
+
+class TestShmRegisterCounters:
+    def test_xla_register_counts_and_bytes(self, server):
+        xlashm = pytest.importorskip(
+            "triton_client_tpu.utils.xla_shared_memory")
+        h = xlashm.create_shared_memory_region("tele_region", 64, 0)
+        try:
+            with grpcclient.InferenceServerClient(server.grpc_url) as c:
+                c.register_xla_shared_memory(
+                    "tele_region", xlashm.get_raw_handle(h), 0, 64)
+                reg = telemetry().snapshot()["shared_memory"]["register"]
+                row = next(r for r in reg
+                           if (r["protocol"], r["kind"]) == ("grpc", "cuda"))
+                assert row["registrations"] == 1 and row["bytes"] == 64
+                c.unregister_xla_shared_memory("tele_region")
+        finally:
+            xlashm.destroy_shared_memory_region(h)
+
+    def test_transfer_bytes_recorded(self):
+        xlashm = pytest.importorskip(
+            "triton_client_tpu.utils.xla_shared_memory")
+        h = xlashm.create_shared_memory_region("tele_tx", 64, 0)
+        try:
+            xlashm.set_shared_memory_region(
+                h, [np.zeros(16, np.float32)])
+            tx = telemetry().snapshot()["shared_memory"]["transfer"]
+            row = next(t for t in tx
+                       if (t["kind"], t["direction"]) == ("xla", "write"))
+            assert row["bytes"] == 64
+        finally:
+            xlashm.destroy_shared_memory_region(h)

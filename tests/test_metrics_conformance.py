@@ -1,0 +1,199 @@
+"""Prometheus text-exposition conformance for BOTH metrics surfaces.
+
+Scrapes the server's ``GET /metrics`` and the client telemetry rendering and
+asserts every exposed series: has ``# HELP``/``# TYPE`` lines, follows the
+Triton ``nv_*`` naming convention, and parses under the Prometheus text
+exposition grammar (metric-name charset, label quoting/escaping, float
+values) — including a model name containing quotes/backslashes/newlines to
+prove label escaping survives a real scrape round-trip.
+"""
+
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import triton_client_tpu.http as httpclient  # noqa: E402
+from triton_client_tpu._telemetry import telemetry  # noqa: E402
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.server import (  # noqa: E402
+    JaxModel,
+    ModelRegistry,
+    make_config,
+)
+from triton_client_tpu.server.testing import ServerHarness  # noqa: E402
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+# one sample line: name{labels} value   (labels optional)
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$")
+# one label pair inside {}: key="value" with \\, \", \n escapes
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\["\\n])*)"')
+
+
+def parse_exposition(text: str):
+    """Parse (strictly) a Prometheus text-format payload; returns
+    {family: {"help": str, "type": str, "samples": [(name, labels, value)]}}.
+    Raises AssertionError on any grammar violation."""
+    families = {}
+    current = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert _NAME_RE.match(name), f"line {lineno}: bad name {name!r}"
+            assert help_text, f"line {lineno}: empty HELP for {name}"
+            families.setdefault(name, {"help": None, "type": None,
+                                       "samples": []})["help"] = help_text
+            current = name
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "summary", "histogram",
+                            "untyped"), f"line {lineno}: bad type {kind!r}"
+            families.setdefault(name, {"help": None, "type": None,
+                                       "samples": []})["type"] = kind
+            current = name
+        elif line.startswith("#"):
+            continue  # comment
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"line {lineno}: unparseable sample {line!r}"
+            name = m.group("name")
+            labels = {}
+            raw = m.group("labels")
+            if raw:
+                consumed = 0
+                for lm in _LABEL_RE.finditer(raw):
+                    labels[lm.group("key")] = lm.group("value")
+                    consumed = lm.end()
+                # everything in the label block must be label pairs
+                # (separated by commas); trailing junk = grammar violation
+                leftover = raw[consumed:].strip(", ")
+                assert not leftover, (
+                    f"line {lineno}: bad label syntax {raw!r}")
+            value = float(m.group("value"))  # ValueError = violation
+            family = name
+            for suffix in ("_sum", "_count", "_bucket"):
+                if name.endswith(suffix) and name[:-len(suffix)] in families:
+                    family = name[:-len(suffix)]
+                    break
+            assert family == current or family in families, (
+                f"line {lineno}: sample {name} before its # TYPE")
+            families.setdefault(family, {"help": None, "type": None,
+                                         "samples": []})["samples"].append(
+                (name, labels, value))
+    return families
+
+
+def assert_conformant(text: str):
+    families = parse_exposition(text)
+    assert families, "empty exposition"
+    for name, fam in families.items():
+        assert name.startswith("nv_"), f"{name}: not Triton nv_* convention"
+        assert fam["help"], f"{name}: missing # HELP"
+        assert fam["type"], f"{name}: missing # TYPE"
+    return families
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    # adversarial model name: every label-escaping class in one value
+    evil = 'evil"name\\with\nnewline'
+    cfg = make_config(
+        evil,
+        inputs=[("X", "FP32", [1, 4])],
+        outputs=[("Y", "FP32", [1, 4])],
+        instance_kind="KIND_CPU",
+    )
+    registry.register_model(JaxModel(cfg, lambda X: {"Y": jnp.asarray(X)},
+                                     jit=False))
+    with ServerHarness(registry) as h:
+        yield h
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(f"http://{url}/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def _drive_traffic(server):
+    with httpclient.InferenceServerClient(server.http_url) as c:
+        a = np.ones((1, 16), np.int32)
+        i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_data_from_numpy(a)
+        i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_data_from_numpy(a)
+        c.infer("simple", [i0, i1])
+
+
+class TestServerSurface:
+    def test_grammar_and_naming(self, server):
+        _drive_traffic(server)
+        families = assert_conformant(_scrape(server.http_url))
+        # the satellite families are present and typed correctly
+        assert families["nv_inference_pending_request_count"]["type"] == \
+            "gauge"
+        for fam in ("nv_cache_num_hits_per_model",
+                    "nv_cache_num_misses_per_model",
+                    "nv_inference_batch_size_total",
+                    "nv_inference_batch_execution_count"):
+            assert families[fam]["type"] == "counter"
+
+    def test_escaped_label_round_trips(self, server):
+        families = assert_conformant(_scrape(server.http_url))
+        samples = families["nv_inference_request_success"]["samples"]
+        raw_models = {labels.get("model") for _, labels, _ in samples}
+        # the parser keeps escapes as-escaped text; unescape to compare
+        unescaped = {m.replace("\\n", "\n").replace('\\"', '"')
+                      .replace("\\\\", "\\") for m in raw_models}
+        assert 'evil"name\\with\nnewline' in unescaped
+
+    def test_every_model_has_every_core_counter(self, server):
+        families = assert_conformant(_scrape(server.http_url))
+        success_models = {
+            lbl.get("model")
+            for _, lbl, _ in families["nv_inference_request_success"]["samples"]
+        }
+        for fam in ("nv_inference_request_failure", "nv_inference_count",
+                    "nv_inference_pending_request_count"):
+            models = {lbl.get("model")
+                      for _, lbl, _ in families[fam]["samples"]}
+            assert models == success_models, fam
+
+
+class TestClientSurface:
+    def test_grammar_and_naming(self, server):
+        telemetry().reset()
+        _drive_traffic(server)
+        families = assert_conformant(telemetry().render_prometheus())
+        assert families["nv_client_inference_request_success"]["type"] == \
+            "counter"
+        summary = families["nv_client_inference_request_duration_us"]
+        assert summary["type"] == "summary"
+        names = {name for name, _, _ in summary["samples"]}
+        assert "nv_client_inference_request_duration_us_sum" in names
+        assert "nv_client_inference_request_duration_us_count" in names
+        quantiles = {lbl.get("quantile")
+                     for name, lbl, _ in summary["samples"]
+                     if name == "nv_client_inference_request_duration_us"}
+        assert quantiles == {"0.5", "0.9", "0.99"}
+
+    def test_client_label_escaping(self, server):
+        telemetry().reset()
+        telemetry().record_request(
+            'mo"del\\x\n', "http", "infer", 0.001, ok=True)
+        families = assert_conformant(telemetry().render_prometheus())
+        samples = families["nv_client_inference_request_success"]["samples"]
+        assert samples, "escaped-label series dropped"

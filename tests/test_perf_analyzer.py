@@ -137,8 +137,13 @@ class TestOpenLoop:
             2000.0, _simple_arrays(harness), ["OUTPUT0", "OUTPUT1"],
             "none", 1 << 20, 1.0, warmup_s=0.2, max_threads=4)
         assert res["unsent"] > 0 or res["send_lag_p99_ms"] > 50.0, res
-        # latency-from-schedule must dominate the pure service time
-        assert res["p99_us"] > 10_000, res
+        # latency-from-schedule must dominate the pure service time — when
+        # any in-window slot completed at all; on a throttled 2-core host
+        # the senders may not even reach the window's first slot before it
+        # closes (every slot unsent, p99 NaN), which IS the honest overload
+        # report this test exists to demand
+        if np.isfinite(res["p99_us"]):
+            assert res["p99_us"] > 10_000, res
 
     def test_mutually_exclusive_with_concurrency(self, harness):
         with pytest.raises(SystemExit):

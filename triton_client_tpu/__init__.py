@@ -26,11 +26,15 @@ from ._auth import BasicAuth
 from ._client import InferenceServerClientBase
 from ._plugin import InferenceServerClientPlugin
 from ._request import Request
+from ._telemetry import ClientTelemetry, LatencyHistogram, telemetry
 
 __all__ = [
     "BasicAuth",
+    "ClientTelemetry",
     "InferenceServerClientBase",
     "InferenceServerClientPlugin",
+    "LatencyHistogram",
     "Request",
+    "telemetry",
     "__version__",
 ]

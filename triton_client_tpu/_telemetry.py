@@ -1,0 +1,441 @@
+"""Client-side telemetry: process-wide metrics registry + trace correlation.
+
+The reference client records nothing (SURVEY.md §5: "No Prometheus-style
+client metrics"); every observability surface lives server-side.  This module
+is the client half of the observability subsystem:
+
+* ``LatencyHistogram`` — log-bucketed latency histogram.  Buckets grow
+  geometrically (5% per bucket) from 1 µs to ~100 s, so p50/p90/p99 are
+  recoverable to <2.5% relative error without retaining raw samples, at a
+  fixed ~3 KB per histogram.  ``observe`` is one lock + two integer adds —
+  cheap enough for the perf_analyzer hot loop.
+* ``ClientTelemetry`` — a process-wide registry of per-(model, protocol,
+  method) request series (success/failure counters, request/response byte
+  counters, a latency histogram) plus shared-memory register/transfer
+  counters.  All four client entrypoints (``http``/``http.aio``/``grpc``/
+  ``grpc.aio``) record into the singleton returned by :func:`telemetry`.
+* A pluggable on-request hook (:meth:`ClientTelemetry.set_request_hook`) —
+  each completed request invokes it with the event record, so applications
+  can bridge into their own metrics pipeline without patching the clients.
+* :meth:`ClientTelemetry.render_prometheus` — the client metrics in the
+  Prometheus text exposition format (Triton-convention ``nv_*`` names with
+  a ``nv_client_`` prefix) for client-side scraping, and
+  :meth:`ClientTelemetry.snapshot` for JSON export (perf_analyzer
+  ``--export-metrics``, ``bench.py``).
+* :func:`new_trace_context` — W3C ``traceparent`` + ``triton-request-id``
+  header pairs the clients stamp on every inference; the server's
+  ``RequestTracer`` records the propagated id in its trace JSON and echoes
+  it back, so client and server traces join on one id (see
+  ``server/trace.py``).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "ClientTelemetry",
+    "LatencyHistogram",
+    "escape_label",
+    "merge_trace_headers",
+    "new_trace_context",
+    "telemetry",
+    "REQUEST_ID_HEADER",
+    "TRACEPARENT_HEADER",
+]
+
+#: Header / gRPC-metadata key carrying the client-generated request id the
+#: server echoes back and records in trace JSON (lowercase: gRPC metadata
+#: keys must be lowercase, HTTP headers are case-insensitive).
+REQUEST_ID_HEADER = "triton-request-id"
+#: W3C Trace Context header stamped alongside (00-<trace16B>-<span8B>-01).
+TRACEPARENT_HEADER = "traceparent"
+
+
+# header/metadata-safe id: visible ASCII without DEL — the wire `id` field
+# accepts any string, but HTTP header values and gRPC non-bin metadata
+# values do not; an unsafe user id must not turn into a client-side send
+# failure, so it stays body-only and a minted id carries the correlation
+_HEADER_SAFE = re.compile(r"[\x20-\x7e]+\Z")
+
+
+def new_trace_context(request_id: str = "") -> Dict[str, str]:
+    """Fresh propagation headers for one inference.  ``request_id`` (the wire
+    ``id`` field, when the caller set one) doubles as the correlation id so a
+    user-chosen id is greppable across client and server; otherwise — or when
+    the id is not header-safe — a random 16-hex id is minted."""
+    if not request_id or not _HEADER_SAFE.match(request_id):
+        request_id = os.urandom(8).hex()
+    return {
+        REQUEST_ID_HEADER: request_id,
+        TRACEPARENT_HEADER:
+            f"00-{os.urandom(16).hex()}-{os.urandom(8).hex()}-01",
+    }
+
+
+def merge_trace_headers(
+    headers: Optional[Dict[str, str]], request_id: str = ""
+) -> Tuple[Dict[str, str], str]:
+    """Trace headers to add to one HTTP inference: a fresh context minus any
+    key the caller already supplies (user headers win).  Returns
+    (headers_to_add, correlation id actually in flight).  The gRPC clients
+    use the metadata-tuple sibling ``grpc._client._with_trace_metadata``."""
+    ctx = new_trace_context(request_id)
+    user = ({k.lower(): v for k, v in headers.items()} if headers else {})
+    extra = {k: v for k, v in ctx.items() if k not in user}
+    return extra, user.get(REQUEST_ID_HEADER, ctx[REQUEST_ID_HEADER])
+
+
+def escape_label(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format
+    (backslash, double-quote, newline).  Shared with the server renderer —
+    model names are user-controlled on both sides."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram (seconds in, quantiles out).
+
+    Bucket ``i >= 1`` covers ``[MIN * G**(i-1), MIN * G**i)`` with
+    ``MIN = 1 µs`` and growth ``G = 1.05``; bucket 0 is the underflow bucket
+    and the last bucket absorbs overflow.  Quantiles report the geometric
+    midpoint of the selected bucket, bounding relative error by
+    ``sqrt(G) - 1`` (~2.5%) inside the covered range.  The exact sum is kept
+    alongside, so ``mean`` is not quantized.
+    """
+
+    MIN_S = 1e-6
+    GROWTH = 1.05
+    # covers MIN_S .. ~130 s: ceil(log(1.3e8)/log(1.05)) interior buckets
+    NUM_BUCKETS = 2 + int(math.ceil(math.log(1.3e8) / math.log(1.05)))
+
+    __slots__ = ("_counts", "_count", "_sum_s", "_lock", "_log_growth")
+
+    def __init__(self) -> None:
+        self._counts = [0] * self.NUM_BUCKETS
+        self._count = 0
+        self._sum_s = 0.0
+        self._lock = threading.Lock()
+        self._log_growth = math.log(self.GROWTH)
+
+    def _index(self, seconds: float) -> int:
+        if seconds < self.MIN_S:
+            return 0
+        i = 1 + int(math.log(seconds / self.MIN_S) / self._log_growth)
+        return i if i < self.NUM_BUCKETS else self.NUM_BUCKETS - 1
+
+    def observe(self, seconds: float) -> None:
+        i = self._index(seconds)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum_s += seconds
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum_s(self) -> float:
+        return self._sum_s
+
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum_s / self._count if self._count else float("nan")
+
+    def _bucket_value(self, i: int) -> float:
+        if i == 0:
+            return self.MIN_S / 2.0
+        # geometric midpoint of [MIN*G**(i-1), MIN*G**i)
+        return self.MIN_S * self.GROWTH ** (i - 0.5)
+
+    def quantile(self, q: float) -> float:
+        """The ``q``-quantile (0..1) in seconds; NaN when empty."""
+        with self._lock:
+            total = self._count
+            if not total:
+                return float("nan")
+            # nearest-rank on the cumulative counts
+            rank = max(1, math.ceil(q * total))
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= rank:
+                    return self._bucket_value(i)
+        return self._bucket_value(self.NUM_BUCKETS - 1)
+
+    def percentile(self, p: float) -> float:
+        return self.quantile(p / 100.0)
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        with other._lock:
+            counts = list(other._counts)
+            count, sum_s = other._count, other._sum_s
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._count += count
+            self._sum_s += sum_s
+
+    def snapshot_us(self) -> Dict[str, Any]:
+        """count/avg/p50/p90/p99 in microseconds.  None (JSON null, not
+        NaN — snapshots must stay strict JSON) when empty."""
+        if not self.count:
+            return {"count": 0, "avg_us": None, "p50_us": None,
+                    "p90_us": None, "p99_us": None}
+        return {
+            "count": self.count,
+            "avg_us": self.mean() * 1e6,
+            "p50_us": self.quantile(0.50) * 1e6,
+            "p90_us": self.quantile(0.90) * 1e6,
+            "p99_us": self.quantile(0.99) * 1e6,
+        }
+
+
+class _RequestSeries:
+    __slots__ = ("success", "failure", "request_bytes", "response_bytes",
+                 "latency")
+
+    def __init__(self) -> None:
+        self.success = 0
+        self.failure = 0
+        self.request_bytes = 0
+        self.response_bytes = 0
+        self.latency = LatencyHistogram()
+
+
+class ClientTelemetry:
+    """Process-wide client metrics registry.
+
+    Series are keyed (model, protocol, method): ``protocol`` is one of
+    ``http``/``http_aio``/``grpc``/``grpc_aio`` and ``method`` one of
+    ``infer``/``async_infer``/``stream_infer``.  For ``stream_infer`` the
+    success counter counts *submitted* stream requests (completion arrives
+    on the stream callback, decoupled from the send) and no latency is
+    observed.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._requests: Dict[Tuple[str, str, str], _RequestSeries] = {}
+        # (protocol, kind) -> [registrations, bytes]; kind: system | cuda
+        self._shm_register: Dict[Tuple[str, str], List[int]] = {}
+        # (kind, direction) -> [transfers, bytes]; direction: write | read
+        self._shm_transfer: Dict[Tuple[str, str], List[int]] = {}
+        self._hook: Optional[Callable[[Dict[str, Any]], None]] = None
+
+    # -- recording ---------------------------------------------------------
+    def _series(self, key: Tuple[str, str, str]) -> _RequestSeries:
+        s = self._requests.get(key)
+        if s is None:
+            with self._lock:
+                s = self._requests.setdefault(key, _RequestSeries())
+        return s
+
+    def record_request(
+        self,
+        model: str,
+        protocol: str,
+        method: str,
+        latency_s: Optional[float],
+        ok: bool,
+        request_bytes: int = 0,
+        response_bytes: int = 0,
+        request_id: str = "",
+    ) -> None:
+        """Record one completed (or failed) request.  ``latency_s=None``
+        counts without a histogram observation (streaming submits)."""
+        s = self._series((model, protocol, method))
+        h = s.latency
+        bucket = None if latency_s is None else h._index(latency_s)
+        # counters + histogram under ONE lock round-trip per request
+        with h._lock:
+            if ok:
+                s.success += 1
+            else:
+                s.failure += 1
+            s.request_bytes += request_bytes
+            s.response_bytes += response_bytes
+            if bucket is not None:
+                h._counts[bucket] += 1
+                h._count += 1
+                h._sum_s += latency_s
+        hook = self._hook
+        if hook is not None:
+            try:
+                hook({
+                    "model": model, "protocol": protocol, "method": method,
+                    "ok": ok, "latency_s": latency_s,
+                    "request_bytes": request_bytes,
+                    "response_bytes": response_bytes,
+                    "request_id": request_id,
+                    "ts": time.time(),
+                })
+            except Exception:
+                pass  # a broken hook must never fail the request path
+
+    def record_shm_register(self, protocol: str, kind: str,
+                            byte_size: int) -> None:
+        with self._lock:
+            c = self._shm_register.setdefault((protocol, kind), [0, 0])
+            c[0] += 1
+            c[1] += int(byte_size)
+
+    def record_shm_transfer(self, kind: str, direction: str,
+                            nbytes: int) -> None:
+        with self._lock:
+            c = self._shm_transfer.setdefault((kind, direction), [0, 0])
+            c[0] += 1
+            c[1] += int(nbytes)
+
+    # -- hook --------------------------------------------------------------
+    def set_request_hook(
+        self, hook: Optional[Callable[[Dict[str, Any]], None]]
+    ) -> None:
+        """Install (or clear, with None) the on-request hook.  Called after
+        each recorded request with the event dict; exceptions are swallowed."""
+        self._hook = hook
+
+    # -- export ------------------------------------------------------------
+    def reset(self) -> None:
+        with self._lock:
+            self._requests.clear()
+            self._shm_register.clear()
+            self._shm_transfer.clear()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able snapshot of every series (perf_analyzer
+        ``--export-metrics`` / bench.py)."""
+        with self._lock:
+            # retain the series OBJECTS under the lock: a concurrent reset()
+            # clears the dict, and a post-release dict lookup would KeyError
+            series = sorted(self._requests.items())
+            shm_reg = {k: list(v) for k, v in self._shm_register.items()}
+            shm_tx = {k: list(v) for k, v in self._shm_transfer.items()}
+        requests = []
+        for key, s in series:
+            entry = {
+                "model": key[0], "protocol": key[1], "method": key[2],
+                "success": s.success, "failure": s.failure,
+                "request_bytes": s.request_bytes,
+                "response_bytes": s.response_bytes,
+            }
+            entry.update(s.latency.snapshot_us())
+            requests.append(entry)
+        return {
+            "requests": requests,
+            "shared_memory": {
+                "register": [
+                    {"protocol": p, "kind": k,
+                     "registrations": c[0], "bytes": c[1]}
+                    for (p, k), c in sorted(shm_reg.items())
+                ],
+                "transfer": [
+                    {"kind": k, "direction": d,
+                     "transfers": c[0], "bytes": c[1]}
+                    for (k, d), c in sorted(shm_tx.items())
+                ],
+            },
+        }
+
+    def render_prometheus(self) -> str:
+        """All client series in the Prometheus text exposition format."""
+        with self._lock:
+            # same reset()-race discipline as snapshot(): hold the series
+            # objects, not just their keys
+            series = dict(sorted(self._requests.items()))
+            shm_reg = {k: list(v) for k, v in self._shm_register.items()}
+            shm_tx = {k: list(v) for k, v in self._shm_transfer.items()}
+        req_keys = list(series)
+
+        def labels(key: Tuple[str, str, str]) -> str:
+            return (f'model="{escape_label(key[0])}",'
+                    f'protocol="{escape_label(key[1])}",'
+                    f'method="{escape_label(key[2])}"')
+
+        lines: List[str] = []
+
+        def family(name: str, help_text: str, kind: str, rows: List[str]):
+            if not rows:
+                return
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(rows)
+
+        family(
+            "nv_client_inference_request_success",
+            "Number of successful client inference requests",
+            "counter",
+            [f"nv_client_inference_request_success{{{labels(k)}}} "
+             f"{series[k].success}" for k in req_keys])
+        family(
+            "nv_client_inference_request_failure",
+            "Number of failed client inference requests",
+            "counter",
+            [f"nv_client_inference_request_failure{{{labels(k)}}} "
+             f"{series[k].failure}" for k in req_keys])
+        family(
+            "nv_client_request_bytes_total",
+            "Cumulative serialized request payload bytes sent",
+            "counter",
+            [f"nv_client_request_bytes_total{{{labels(k)}}} "
+             f"{series[k].request_bytes}" for k in req_keys])
+        family(
+            "nv_client_response_bytes_total",
+            "Cumulative serialized response payload bytes received",
+            "counter",
+            [f"nv_client_response_bytes_total{{{labels(k)}}} "
+             f"{series[k].response_bytes}" for k in req_keys])
+
+        summary_rows: List[str] = []
+        name = "nv_client_inference_request_duration_us"
+        for k in req_keys:
+            h = series[k].latency
+            if not h.count:
+                continue
+            lbl = labels(k)
+            for q in ("0.5", "0.9", "0.99"):
+                v = h.quantile(float(q)) * 1e6
+                summary_rows.append(
+                    f'{name}{{{lbl},quantile="{q}"}} {v:.1f}')
+            summary_rows.append(f"{name}_sum{{{lbl}}} {h.sum_s * 1e6:.1f}")
+            summary_rows.append(f"{name}_count{{{lbl}}} {h.count}")
+        family(name, "Client-observed inference request duration in "
+                     "microseconds", "summary", summary_rows)
+
+        family(
+            "nv_client_shared_memory_register_total",
+            "Number of shared-memory regions registered by this client "
+            "process", "counter",
+            [f'nv_client_shared_memory_register_total{{'
+             f'protocol="{escape_label(p)}",kind="{escape_label(k)}"}} {c[0]}'
+             for (p, k), c in sorted(shm_reg.items())])
+        family(
+            "nv_client_shared_memory_register_bytes_total",
+            "Cumulative byte size of shared-memory regions registered",
+            "counter",
+            [f'nv_client_shared_memory_register_bytes_total{{'
+             f'protocol="{escape_label(p)}",kind="{escape_label(k)}"}} {c[1]}'
+             for (p, k), c in sorted(shm_reg.items())])
+        family(
+            "nv_client_shared_memory_transfer_bytes_total",
+            "Cumulative bytes copied into/out of shared-memory regions",
+            "counter",
+            [f'nv_client_shared_memory_transfer_bytes_total{{'
+             f'kind="{escape_label(k)}",direction="{escape_label(d)}"}} '
+             f"{c[1]}" for (k, d), c in sorted(shm_tx.items())])
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_TELEMETRY = ClientTelemetry()
+
+
+def telemetry() -> ClientTelemetry:
+    """The process-wide client telemetry registry."""
+    return _TELEMETRY

@@ -11,12 +11,14 @@ options (unlimited message size :50-54, keepalive :57-98, custom channel args
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import grpc
 
 from .._client import InferenceServerClientBase
 from .._request import Request
+from .._telemetry import new_trace_context, telemetry
 from ..protocol import inference_pb2 as pb
 from ..protocol.service import GRPCInferenceServiceStub
 from ..utils import raise_error
@@ -106,6 +108,18 @@ def _channel_options(keepalive_options, channel_args):
         options = [(k, v) for k, v in options if k not in user_keys]
         options.extend(channel_args)
     return options
+
+
+def _with_trace_metadata(metadata: tuple, request_id: str = ""):
+    """Append trace-propagation metadata (``triton-request-id`` +
+    ``traceparent``) unless the caller already supplied them; returns
+    (metadata, request_id actually stamped)."""
+    present = {k.lower() for k, _ in metadata}
+    ctx = new_trace_context(request_id)
+    extra = tuple((k, v) for k, v in ctx.items() if k not in present)
+    rid = next((v for k, v in metadata if k.lower() == "triton-request-id"),
+               ctx["triton-request-id"])
+    return metadata + extra, rid
 
 
 class InferenceServerClient(InferenceServerClientBase):
@@ -357,6 +371,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 ),
                 metadata=self._get_metadata(headers), timeout=client_timeout,
             )
+            telemetry().record_shm_register("grpc", "system", byte_size)
         except grpc.RpcError as e:
             raise_error_grpc(e)
 
@@ -396,6 +411,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 ),
                 metadata=self._get_metadata(headers), timeout=client_timeout,
             )
+            telemetry().record_shm_register("grpc", "cuda", byte_size)
         except grpc.RpcError as e:
             raise_error_grpc(e)
 
@@ -436,19 +452,30 @@ class InferenceServerClient(InferenceServerClientBase):
             model_name, inputs, model_version, request_id, outputs,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
+        metadata, rid = _with_trace_metadata(
+            self._get_metadata(headers), request_id)
         if self._verbose:
-            print(f"infer, metadata {self._get_metadata(headers)}\n{request}")
+            print(f"infer, metadata {metadata}\n{request}")
+        req_bytes = request.ByteSize()
+        t0 = time.perf_counter()
         try:
             response = self._client_stub.ModelInfer(
                 request,
-                metadata=self._get_metadata(headers),
+                metadata=metadata,
                 timeout=client_timeout,
                 compression=get_grpc_compression(compression_algorithm),
             )
             if self._verbose:
                 print(response)
+            telemetry().record_request(
+                model_name, "grpc", "infer", time.perf_counter() - t0,
+                ok=True, request_bytes=req_bytes,
+                response_bytes=response.ByteSize(), request_id=rid)
             return InferResult(response)
         except grpc.RpcError as e:
+            telemetry().record_request(
+                model_name, "grpc", "infer", time.perf_counter() - t0,
+                ok=False, request_bytes=req_bytes, request_id=rid)
             raise_error_grpc(e)
 
     def async_infer(
@@ -478,12 +505,32 @@ class InferenceServerClient(InferenceServerClientBase):
             model_name, inputs, model_version, request_id, outputs,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
+        metadata, rid = _with_trace_metadata(
+            self._get_metadata(headers), request_id)
+        req_bytes = request.ByteSize()
+        t0 = time.perf_counter()
         call = self._client_stub.ModelInfer.future(
             request,
-            metadata=self._get_metadata(headers),
+            metadata=metadata,
             timeout=client_timeout,
             compression=get_grpc_compression(compression_algorithm),
         )
+
+        def _record(c):
+            try:
+                response = c.result()
+                telemetry().record_request(
+                    model_name, "grpc", "async_infer",
+                    time.perf_counter() - t0, ok=True,
+                    request_bytes=req_bytes,
+                    response_bytes=response.ByteSize(), request_id=rid)
+            except Exception:
+                telemetry().record_request(
+                    model_name, "grpc", "async_infer",
+                    time.perf_counter() - t0, ok=False,
+                    request_bytes=req_bytes, request_id=rid)
+
+        call.add_done_callback(_record)
         if callback is None:
             return InferAsyncRequest(call)
 
@@ -524,10 +571,12 @@ class InferenceServerClient(InferenceServerClientBase):
                 "at a given time."
             )
         self._stream = _InferStream(callback, self._verbose)
+        # one trace context per stream: every request on the stream shares it
+        metadata, _rid = _with_trace_metadata(self._get_metadata(headers))
         try:
             response_iterator = self._client_stub.ModelStreamInfer(
                 _RequestIterator(self._stream),
-                metadata=self._get_metadata(headers),
+                metadata=metadata,
                 timeout=stream_timeout,
                 compression=get_grpc_compression(compression_algorithm),
             )
@@ -563,6 +612,11 @@ class InferenceServerClient(InferenceServerClientBase):
         if self._verbose:
             print(f"async_stream_infer\n{request}")
         self._stream._enqueue_request(request)
+        # stream submits count without a latency observation: completion
+        # arrives on the stream callback, decoupled from this send
+        telemetry().record_request(
+            model_name, "grpc", "stream_infer", None, ok=True,
+            request_bytes=request.ByteSize(), request_id=request_id)
 
     def stop_stream(self, cancel_requests: bool = False) -> None:
         """Close the active stream (reference :1800-1813)."""

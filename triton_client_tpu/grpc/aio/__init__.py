@@ -10,16 +10,19 @@ cancellable response iterator yielding ``(InferResult, error)`` tuples
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import grpc
 
 from ..._client import InferenceServerClientBase
 from ..._request import Request
+from ..._telemetry import telemetry
 from ...protocol import inference_pb2 as pb
 from ...protocol.service import GRPCInferenceServiceStub
 from ...utils import raise_error
-from .._client import KeepAliveOptions, _channel_options, _maybe_json
+from .._client import (KeepAliveOptions, _channel_options, _maybe_json,
+                       _with_trace_metadata)
 from .._infer_result import InferResult
 from .._utils import (
     get_error_grpc,
@@ -271,6 +274,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 ),
                 metadata=self._get_metadata(headers), timeout=client_timeout,
             )
+            telemetry().record_shm_register("grpc_aio", "system", byte_size)
         except grpc.RpcError as e:
             raise_error_grpc(e)
 
@@ -307,6 +311,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 ),
                 metadata=self._get_metadata(headers), timeout=client_timeout,
             )
+            telemetry().record_shm_register("grpc_aio", "cuda", byte_size)
         except grpc.RpcError as e:
             raise_error_grpc(e)
 
@@ -347,15 +352,26 @@ class InferenceServerClient(InferenceServerClientBase):
             model_name, inputs, model_version, request_id, outputs,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
+        metadata, rid = _with_trace_metadata(
+            self._get_metadata(headers), request_id)
+        req_bytes = request.ByteSize()
+        t0 = time.perf_counter()
         try:
             response = await self._client_stub.ModelInfer(
                 request,
-                metadata=self._get_metadata(headers),
+                metadata=metadata,
                 timeout=client_timeout,
                 compression=get_grpc_compression(compression_algorithm),
             )
+            telemetry().record_request(
+                model_name, "grpc_aio", "infer", time.perf_counter() - t0,
+                ok=True, request_bytes=req_bytes,
+                response_bytes=response.ByteSize(), request_id=rid)
             return InferResult(response)
         except grpc.RpcError as e:
+            telemetry().record_request(
+                model_name, "grpc_aio", "infer", time.perf_counter() - t0,
+                ok=False, request_bytes=req_bytes, request_id=rid)
             raise_error_grpc(e)
 
     def stream_infer(
@@ -368,7 +384,8 @@ class InferenceServerClient(InferenceServerClientBase):
         """Bidi streaming: consume an async iterator of request-kwarg dicts,
         return a cancellable async iterator of ``(InferResult, error)``
         (reference aio :688-810)."""
-        metadata = self._get_metadata(headers)
+        # one trace context per stream: every request on the stream shares it
+        metadata, _rid = _with_trace_metadata(self._get_metadata(headers))
 
         async def _requests():
             async for kwargs in inputs_iterator:
@@ -397,6 +414,10 @@ class InferenceServerClient(InferenceServerClientBase):
                     request.parameters[
                         "triton_enable_empty_final_response"
                     ].bool_param = True
+                telemetry().record_request(
+                    kwargs["model_name"], "grpc_aio", "stream_infer", None,
+                    ok=True, request_bytes=request.ByteSize(),
+                    request_id=kwargs.get("request_id", ""))
                 yield request
 
         call = self._client_stub.ModelStreamInfer(

@@ -14,6 +14,7 @@ connection pool (``concurrency`` pooled connections) plus a thread pool for
 from __future__ import annotations
 
 import gzip
+import time
 import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
@@ -23,6 +24,7 @@ import urllib3
 
 from .._client import InferenceServerClientBase
 from .._request import Request
+from .._telemetry import merge_trace_headers, telemetry
 from ..utils import InferenceServerException, raise_error
 from ._infer_result import InferResult
 from ._utils import get_inference_request_body, raise_if_error
@@ -352,6 +354,7 @@ class InferenceServerClient(InferenceServerClientBase):
             query_params,
         )
         raise_if_error(response.status, response.data)
+        telemetry().record_shm_register("http", "system", byte_size)
 
     def unregister_system_shared_memory(
         self, name="", headers=None, query_params=None
@@ -397,6 +400,7 @@ class InferenceServerClient(InferenceServerClientBase):
             query_params,
         )
         raise_if_error(response.status, response.data)
+        telemetry().record_shm_register("http", "cuda", byte_size)
 
     # TPU-native alias: same RPC, honest name.
     register_xla_shared_memory = register_cuda_shared_memory
@@ -458,6 +462,7 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm,
         response_compression_algorithm,
         parameters,
+        _method="infer",
     ):
         body, json_size = get_inference_request_body(
             inputs, request_id, outputs, sequence_id, sequence_start, sequence_end,
@@ -474,13 +479,31 @@ class InferenceServerClient(InferenceServerClientBase):
             extra_headers["Accept-Encoding"] = response_compression_algorithm
         if json_size is not None:
             extra_headers["Inference-Header-Content-Length"] = str(json_size)
+        # trace propagation: every inference carries a correlation id the
+        # server records in trace JSON and echoes back (user-supplied
+        # headers of the same name win)
+        trace_headers, rid = merge_trace_headers(headers, request_id)
+        extra_headers.update(trace_headers)
 
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
         path += "/infer"
-        response = self._post(path, body, headers, query_params, extra_headers)
-        raise_if_error(response.status, response.data)
+        t0 = time.perf_counter()
+        try:
+            response = self._post(path, body, headers, query_params, extra_headers)
+            raise_if_error(response.status, response.data)
+        except Exception:
+            telemetry().record_request(
+                model_name, "http", _method, time.perf_counter() - t0,
+                ok=False, request_bytes=len(body),
+                request_id=rid)
+            raise
+        telemetry().record_request(
+            model_name, "http", _method, time.perf_counter() - t0,
+            ok=True, request_bytes=len(body),
+            response_bytes=len(response.data),
+            request_id=rid)
         header_length = response.headers.get("Inference-Header-Content-Length")
         # urllib3 decodes gzip/deflate transparently, so no content_encoding.
         return InferResult(
@@ -488,6 +511,7 @@ class InferenceServerClient(InferenceServerClientBase):
             self._verbose,
             int(header_length) if header_length is not None else None,
             None,
+            headers=response.headers,
         )
 
     def infer(
@@ -544,5 +568,6 @@ class InferenceServerClient(InferenceServerClientBase):
             model_name, inputs, model_version, outputs, request_id, sequence_id,
             sequence_start, sequence_end, priority, timeout, headers, query_params,
             request_compression_algorithm, response_compression_algorithm, parameters,
+            _method="async_infer",
         )
         return InferAsyncRequest(future, self._verbose)

@@ -26,8 +26,13 @@ from ..utils import (
 class InferResult:
     def __init__(self, response_body: bytes, verbose: bool = False,
                  header_length: Optional[int] = None,
-                 content_encoding: Optional[str] = None):
-        """Parse a v2 infer response body (optionally compressed)."""
+                 content_encoding: Optional[str] = None,
+                 headers=None):
+        """Parse a v2 infer response body (optionally compressed).
+        ``headers`` carries the HTTP response headers (trace-correlation:
+        the server echoes ``triton-request-id`` there)."""
+        self._headers = ({k.lower(): v for k, v in dict(headers).items()}
+                         if headers else {})
         if content_encoding == "gzip":
             response_body = gzip.decompress(response_body)
         elif content_encoding == "deflate":
@@ -95,3 +100,9 @@ class InferResult:
     def get_response(self) -> dict:
         """The full response JSON dict (reference :233-241)."""
         return self._result
+
+    def get_headers(self) -> dict:
+        """HTTP response headers (lowercased keys); empty for results parsed
+        from a stored body.  ``triton-request-id`` holds the echoed
+        trace-correlation id."""
+        return self._headers

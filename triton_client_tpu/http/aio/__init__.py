@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import gzip
 import json
+import time
 import zlib
 from typing import Any, Dict, Optional
 from urllib.parse import quote, urlencode
@@ -18,6 +19,7 @@ import aiohttp
 
 from ..._client import InferenceServerClientBase
 from ..._request import Request
+from ..._telemetry import merge_trace_headers, telemetry
 from ...utils import raise_error
 from .._infer_result import InferResult
 from .._utils import get_inference_request_body, raise_if_error
@@ -250,6 +252,7 @@ class InferenceServerClient(InferenceServerClientBase):
             json.dumps(body).encode(), headers, query_params,
         )
         raise_if_error(status, data)
+        telemetry().record_shm_register("http_aio", "system", byte_size)
 
     async def unregister_system_shared_memory(
         self, name="", headers=None, query_params=None
@@ -287,6 +290,7 @@ class InferenceServerClient(InferenceServerClientBase):
             json.dumps(body).encode(), headers, query_params,
         )
         raise_if_error(status, data)
+        telemetry().record_shm_register("http_aio", "cuda", byte_size)
 
     register_xla_shared_memory = register_cuda_shared_memory
     get_xla_shared_memory_status = get_cuda_shared_memory_status
@@ -344,19 +348,36 @@ class InferenceServerClient(InferenceServerClientBase):
             extra_headers["Accept-Encoding"] = response_compression_algorithm
         if json_size is not None:
             extra_headers["Inference-Header-Content-Length"] = str(json_size)
+        # trace propagation: same contract as the sync client (server
+        # records the id in trace JSON and echoes it back)
+        trace_headers, rid = merge_trace_headers(headers, request_id)
+        extra_headers.update(trace_headers)
 
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
         path += "/infer"
-        status, resp_headers, data = await self._post(
-            path, body, headers, query_params, extra_headers
-        )
-        raise_if_error(status, data)
+        t0 = time.perf_counter()
+        try:
+            status, resp_headers, data = await self._post(
+                path, body, headers, query_params, extra_headers
+            )
+            raise_if_error(status, data)
+        except Exception:
+            telemetry().record_request(
+                model_name, "http_aio", "infer", time.perf_counter() - t0,
+                ok=False, request_bytes=len(body),
+                request_id=rid)
+            raise
+        telemetry().record_request(
+            model_name, "http_aio", "infer", time.perf_counter() - t0,
+            ok=True, request_bytes=len(body), response_bytes=len(data),
+            request_id=rid)
         header_length = resp_headers.get("Inference-Header-Content-Length")
         return InferResult(
             data, self._verbose,
             int(header_length) if header_length is not None else None, None,
+            headers=resp_headers,
         )
 
 

@@ -180,7 +180,11 @@ def int8_matmul(x, w_q, w_scale, *, block_m: int = 0, block_n: int = 0,
     for d in lead:
         M *= d
     x2d = x.reshape(M, K)
+    # clamp to M, then round up to a sublane multiple: a small unaligned M
+    # (e.g. 50) must not produce a Mosaic block like (50, K) — _call's
+    # pad_m already covers M < block_m, so rounding up is always safe
     block_m = min(block_m, max(8, M))
+    block_m = -(-block_m // 8) * 8
     ws_row = w_scale.reshape(1, N).astype(jnp.float32)
     out = _call(x2d, w_q, ws_row, block_m, block_n, m_inner, interpret)
     return out.reshape(*lead, N)

@@ -25,14 +25,16 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 import numpy as np
 
+from ._telemetry import LatencyHistogram, telemetry
 from .utils import triton_to_np_dtype
 
 _SHM_MODES = ("none", "system", "cuda", "xla")
@@ -40,7 +42,10 @@ _SHM_MODES = ("none", "system", "cuda", "xla")
 
 @dataclass
 class _Stats:
-    latencies: List[float] = field(default_factory=list)
+    # log-bucketed shared histogram (telemetry layer) instead of a raw
+    # sample list: constant memory at any request count, thread-safe
+    # observe, same quantile math as the client metrics
+    latency: LatencyHistogram = field(default_factory=LatencyHistogram)
     count: int = 0
     errors: int = 0
     first_error: Optional[str] = None
@@ -317,7 +322,6 @@ def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
                             output_byte_size, worker_id, streaming)
     one_infer = session.infer
     try:
-        local: List[float] = []
         n = 0
         errs = 0
         first_error = None
@@ -333,14 +337,13 @@ def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
             # after the window closes are not counted (would inflate infer/sec)
             if measuring.is_set():
                 if err is None:
-                    local.append(dt_s)
+                    stats.latency.observe(dt_s)  # thread-safe, lock-cheap
                     n += 1
                 else:
                     errs += 1
                     if first_error is None:
                         first_error = f"{type(err).__name__}: {err}"
         with lock:
-            stats.latencies.extend(local)
             stats.count += n
             stats.errors += errs
             if stats.first_error is None and first_error is not None:
@@ -393,21 +396,31 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
         "errors": stats.errors,
         "first_error": stats.first_error,
     }
-    res.update(_latency_stats(stats.latencies, extra_percentile))
+    res.update(_latency_stats(stats.latency, extra_percentile))
     return res
 
 
-def _latency_stats(latencies_s, extra_percentile=None) -> dict:
+def _latency_stats(
+    latencies: Union[LatencyHistogram, list], extra_percentile=None
+) -> dict:
     """avg/p50/p90/p95/p99 (+ optional extra percentile) in usec, NaN when
-    no samples — shared by the closed- and open-loop drivers."""
-    lat = np.sort(np.asarray(latencies_s)) * 1e6
-    out = {"avg_us": float(lat.mean()) if lat.size else float("nan")}
+    no samples — shared by the closed- and open-loop drivers.  Accepts a
+    telemetry ``LatencyHistogram`` (closed loop records straight into one)
+    or a list of seconds (open loop, which must window-filter samples by
+    scheduled time before aggregating)."""
+    if not isinstance(latencies, LatencyHistogram):
+        h = LatencyHistogram()
+        for v in latencies:
+            h.observe(float(v))
+        latencies = h
+    out = {"avg_us": latencies.mean() * 1e6 if latencies.count
+           else float("nan")}
     pcts = [50, 90, 95, 99]
     if extra_percentile is not None and extra_percentile not in pcts:
         pcts.append(extra_percentile)
     for p in pcts:
-        out[f"p{p}_us"] = (float(np.percentile(lat, p))
-                           if lat.size else float("nan"))
+        out[f"p{p}_us"] = (latencies.percentile(p) * 1e6
+                           if latencies.count else float("nan"))
     return out
 
 
@@ -594,6 +607,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(gRPC only; reference perf_analyzer flag)")
     parser.add_argument("--percentile", type=int, default=None,
                         help="report this percentile as the headline latency")
+    parser.add_argument("--export-metrics", default=None, metavar="PATH",
+                        help="write the sweep results plus the client "
+                             "telemetry snapshot (per-model/protocol/method "
+                             "counters and latency quantiles) as JSON")
     parser.add_argument("-f", "--latency-report-file", default=None)
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -687,6 +704,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 args.output_shared_memory_size, measure_s,
                 extra_percentile=args.percentile, streaming=args.streaming)
             report(res, f"Concurrency: {level}, throughput: ")
+
+    if args.export_metrics:
+        snapshot = {
+            "model": args.model_name,
+            "protocol": args.protocol,
+            "shared_memory": args.shared_memory,
+            "load_mode": "open_loop" if open_loop else "closed_loop",
+            "results": [
+                {k: (None if isinstance(v, float) and not np.isfinite(v)
+                     else v) for k, v in r.items()}
+                for r in results
+            ],
+            "client_telemetry": telemetry().snapshot(),
+        }
+        with open(args.export_metrics, "w") as f:
+            json.dump(snapshot, f, indent=2)
 
     if args.latency_report_file:
         with open(args.latency_report_file, "w") as f:

@@ -101,6 +101,10 @@ class _ResponseCache:
             OrderedDict()
         self.hits = 0
         self.misses = 0
+        # per-model lookup outcomes (key[0] is the model name) backing the
+        # nv_cache_num_{hits,misses}_per_model metrics
+        self.hits_by_model: Dict[str, int] = {}
+        self.misses_by_model: Dict[str, int] = {}
 
     @staticmethod
     def key(model: Model, generation: int, request: InferRequest,
@@ -131,9 +135,12 @@ class _ResponseCache:
         entry = self._entries.get(key)
         if entry is None:
             self.misses += 1
+            self.misses_by_model[key[0]] = \
+                self.misses_by_model.get(key[0], 0) + 1
             return None
         self._entries.move_to_end(key)
         self.hits += 1
+        self.hits_by_model[key[0]] = self.hits_by_model.get(key[0], 0) + 1
         return entry
 
     @staticmethod
@@ -294,6 +301,7 @@ class _DynamicBatcher:
                 self._model, merged, pending[0][1], keep_device=set())
             compute_ns = time.monotonic_ns() - t0
             self._model.stats.record(total, queue_ns, compute_ns, ok=True)
+            self._model.stats.record_batch(total)
             offset = 0
             for (inputs, _params, fut, _ts), count in zip(pending, counts):
                 part = {
@@ -368,7 +376,25 @@ class InferenceCore:
         return await self._infer_on(model, request)
 
     async def _infer_on(self, model: Model, request: InferRequest) -> InferResponse:
-        trace = self.tracer.maybe_start(model.name, request.model_version or "1")
+        model.stats.inc_pending()
+        try:
+            resp = await self._infer_traced_entry(model, request)
+        finally:
+            model.stats.dec_pending()
+        if request.client_request_id:
+            # echo the propagated correlation id so the client can join its
+            # telemetry with the server trace (HTTP also echoes the header)
+            resp.parameters.setdefault(
+                "triton_request_id", request.client_request_id)
+        return resp
+
+    async def _infer_traced_entry(
+        self, model: Model, request: InferRequest
+    ) -> InferResponse:
+        trace = self.tracer.maybe_start(
+            model.name, request.model_version or "1",
+            client_request_id=request.client_request_id,
+            traceparent=request.traceparent)
         if trace is None:
             return await self._infer_traced(model, request, None)
         trace.ts("REQUEST_START", request.arrival_ns)

@@ -50,6 +50,21 @@ def py_to_pb_param(value) -> pb.InferParameter:
     return p
 
 
+def _read_trace_metadata(req: InferRequest, context) -> None:
+    """Fill the request's trace-propagation fields from invocation metadata
+    (`triton-request-id` / `traceparent`, stamped by the instrumented
+    clients)."""
+    try:
+        md = context.invocation_metadata() or ()
+        for key, value in md:
+            if key == "triton-request-id":
+                req.client_request_id = value
+            elif key == "traceparent":
+                req.traceparent = value
+    except Exception:
+        pass  # metadata unavailable (e.g. gRPC-Web bridge test doubles)
+
+
 def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
     req = InferRequest(
         model_name=request.model_name,
@@ -433,6 +448,7 @@ class InferenceServicer:
     async def ModelInfer(self, request, context):
         try:
             req = _decode_pb_request(request)
+            _read_trace_metadata(req, context)
             resp = await self._core.infer(req)
         except InferError as e:
             if e.http_status >= 500:
@@ -449,6 +465,14 @@ class InferenceServicer:
             self._log_off_loop(
                 self._core.log.verbose, 1,
                 f"grpc ModelInfer '{request.model_name}' -> OK")
+        if req.client_request_id:
+            # echo the correlation id in trailing metadata (the response
+            # parameters carry it too, for clients that never see metadata)
+            try:
+                context.set_trailing_metadata(
+                    (("triton-request-id", req.client_request_id),))
+            except Exception:
+                pass  # metadata already sent / transport gone
         return _encode_pb_response(resp)
 
     async def ModelStreamInfer(self, request_iterator, context):
@@ -458,6 +482,7 @@ class InferenceServicer:
         async for request in request_iterator:
             try:
                 req = _decode_pb_request(request)
+                _read_trace_metadata(req, context)
                 enable_empty_final = bool(
                     req.parameters.get("triton_enable_empty_final_response", False)
                 )

@@ -32,6 +32,8 @@ from .types import (InferError, InferRequest, InputTensor,
                     RequestedOutput, ShmRef, reshape_input)
 
 _HEADER_LEN = "Inference-Header-Content-Length"
+_REQUEST_ID_HDR = "triton-request-id"
+_TRACEPARENT_HDR = "traceparent"
 
 
 def build_app(core: InferenceCore) -> web.Application:
@@ -450,12 +452,18 @@ async def _infer(core, request: web.Request) -> web.Response:
     req = _decode_request(
         request.match_info["model"], request.match_info.get("version", ""), body, binary
     )
+    # trace propagation: record the client's correlation id (headers are
+    # case-insensitive in aiohttp) so the tracer can join client and server
+    req.client_request_id = request.headers.get(_REQUEST_ID_HDR, "")
+    req.traceparent = request.headers.get(_TRACEPARENT_HDR, "")
     resp = await core.infer(req)
     default_binary = bool(
         req.parameters.get("binary_data_output", header_len is not None)
     )
     payload, json_len = _encode_response(resp, req, default_binary)
     headers = {_HEADER_LEN: str(json_len)}
+    if req.client_request_id:
+        headers[_REQUEST_ID_HDR] = req.client_request_id
     accept = request.headers.get("Accept-Encoding", "")
     if "gzip" in accept and len(payload) > 1024:
         payload = gzip.compress(payload)

@@ -3,17 +3,27 @@
 The reference *client* has no metrics endpoint (SURVEY.md §5: "No
 Prometheus-style client metrics"), but the server it targets famously
 exposes one; a reference user switching here expects ``GET /metrics``.
-Metric names follow Triton's server conventions (``nv_inference_*``) so
-existing dashboards and scrapers keep working unchanged.
+Metric names follow Triton's server conventions (``nv_inference_*``,
+``nv_cache_*``) so existing dashboards and scrapers keep working unchanged.
+
+Families: the per-model inference counters, the
+``nv_inference_pending_request_count`` gauge (requests inside the core's
+infer path right now), response-cache hit/miss counters per model (the
+``_ResponseCache`` in ``core.py``), and the dynamic batcher's cumulative
+batch-size counter (``nv_inference_batch_size_total / nv_inference_batch
+_execution_count`` = average formed batch).  The *client* half of the
+observability subsystem renders separately — see
+``triton_client_tpu._telemetry.ClientTelemetry.render_prometheus``.
 """
 
 from __future__ import annotations
 
 from typing import List, Tuple
 
+from .._telemetry import escape_label as _escape_label
 from .core import InferenceCore
 
-_METRICS: List[Tuple[str, str, str]] = [
+_COUNTERS: List[Tuple[str, str, str]] = [
     # (metric name, help text, ModelStats-derived key)
     ("nv_inference_request_success",
      "Number of successful inference requests, all batch sizes", "success"),
@@ -30,20 +40,24 @@ _METRICS: List[Tuple[str, str, str]] = [
      "Cumulative inference queuing duration in microseconds", "queue_us"),
     ("nv_inference_compute_infer_duration_us",
      "Cumulative compute inference duration in microseconds", "infer_us"),
+    ("nv_inference_batch_size_total",
+     "Cumulative batch size of dynamic-batcher executions "
+     "(unpadded elements)", "batch_size"),
+    ("nv_inference_batch_execution_count",
+     "Number of dynamic-batcher executions", "batch_exec"),
+]
+
+_GAUGES: List[Tuple[str, str, str]] = [
+    ("nv_inference_pending_request_count",
+     "Number of inference requests currently executing or awaiting "
+     "execution", "pending"),
 ]
 
 
-def _escape_label(value: str) -> str:
-    """Escape a label value per the Prometheus text exposition format
-    (backslash, double-quote, and newline must be escaped; model names come
-    from user-controlled repository directory names)."""
-    return (value.replace("\\", "\\\\").replace('"', '\\"')
-            .replace("\n", "\\n"))
-
-
 def render_prometheus(core: InferenceCore) -> str:
-    """All per-model counters in the Prometheus text exposition format."""
-    rows = {key: [] for _, _, key in _METRICS}
+    """All per-model series in the Prometheus text exposition format."""
+    keys = [key for _, _, key in _COUNTERS] + [key for _, _, key in _GAUGES]
+    rows = {key: [] for key in keys}
     for m in core.registry.all_version_models():
         s = m.stats
         with s.lock:
@@ -55,6 +69,9 @@ def render_prometheus(core: InferenceCore) -> str:
                 "request_us": s.success_ns // 1000,
                 "queue_us": s.queue_ns // 1000,
                 "infer_us": s.infer_ns // 1000,
+                "batch_size": s.batch_size_total,
+                "batch_exec": s.batch_execution_count,
+                "pending": s.pending_count,
             }
         labels = (f'model="{_escape_label(m.name)}",'
                   f'version="{_escape_label(m.served_version)}"')
@@ -62,9 +79,29 @@ def render_prometheus(core: InferenceCore) -> str:
             rows[key].append(f"{{{labels}}} {value}")
 
     lines: List[str] = []
-    for name, help_text, key in _METRICS:
+    for name, help_text, key in _COUNTERS:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} counter")
         for row in rows[key]:
             lines.append(f"{name}{row}")
+    for name, help_text, key in _GAUGES:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} gauge")
+        for row in rows[key]:
+            lines.append(f"{name}{row}")
+
+    # response-cache outcomes: tracked per model NAME by the core's LRU
+    # (cache keys carry the name; version resolution happens later), so
+    # these two families label {model} only
+    cache = core.response_cache
+    for name, help_text, counts in (
+        ("nv_cache_num_hits_per_model",
+         "Number of response cache hits per model", cache.hits_by_model),
+        ("nv_cache_num_misses_per_model",
+         "Number of response cache misses per model", cache.misses_by_model),
+    ):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} counter")
+        for model, value in sorted(counts.items()):
+            lines.append(f'{name}{{model="{_escape_label(model)}"}} {value}')
     return "\n".join(lines) + "\n"

@@ -151,7 +151,26 @@ class ModelStats:
     queue_ns: int = 0
     infer_count: int = 0
     infer_ns: int = 0
+    # gauge: requests currently inside the core's infer path
+    pending_count: int = 0
+    # dynamic batcher: cumulative (unpadded) batch size and executions, so
+    # avg formed batch = batch_size_total / batch_execution_count
+    batch_size_total: int = 0
+    batch_execution_count: int = 0
     lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def inc_pending(self) -> None:
+        with self.lock:
+            self.pending_count += 1
+
+    def dec_pending(self) -> None:
+        with self.lock:
+            self.pending_count -= 1
+
+    def record_batch(self, batch: int) -> None:
+        with self.lock:
+            self.batch_size_total += batch
+            self.batch_execution_count += 1
 
     def record(self, batch: int, queue_ns: int, compute_ns: int, ok: bool) -> None:
         with self.lock:

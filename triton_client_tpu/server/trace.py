@@ -113,19 +113,25 @@ def validate_trace_update(settings: Dict[str, List[str]],
 class TraceContext:
     """One traced request: collects (name, ns) timestamps, emitted on finish.
     ``path`` is the trace_file of the scope that sampled this request (a
-    per-model override may point somewhere else than the global file)."""
+    per-model override may point somewhere else than the global file).
+    ``client_request_id``/``traceparent`` carry the client-propagated trace
+    context (``triton-request-id`` header / gRPC metadata) so the emitted
+    record joins with client-side telemetry on one id."""
 
     __slots__ = ("_tracer", "id", "model_name", "model_version",
-                 "timestamps", "path")
+                 "timestamps", "path", "client_request_id", "traceparent")
 
     def __init__(self, tracer: "RequestTracer", trace_id: int,
-                 model_name: str, model_version: str, path: str) -> None:
+                 model_name: str, model_version: str, path: str,
+                 client_request_id: str = "", traceparent: str = "") -> None:
         self._tracer = tracer
         self.id = trace_id
         self.model_name = model_name
         self.model_version = model_version
         self.timestamps: List[Dict[str, int]] = []
         self.path = path
+        self.client_request_id = client_request_id
+        self.traceparent = traceparent
 
     def ts(self, name: str, ns: Optional[int] = None) -> None:
         self.timestamps.append(
@@ -252,7 +258,9 @@ class RequestTracer:
         except (TypeError, ValueError, IndexError):
             return default
 
-    def maybe_start(self, model_name: str, model_version: str) -> Optional[TraceContext]:
+    def maybe_start(self, model_name: str, model_version: str,
+                    client_request_id: str = "",
+                    traceparent: str = "") -> Optional[TraceContext]:
         with self._lock:
             ov = self._model_overrides.get(model_name)
             eff = self._settings if ov is None else {**self._settings, **ov}
@@ -282,17 +290,23 @@ class RequestTracer:
             self._next_id += 1
             trace_id = self._next_id
             path = self._trace_file(eff)
-        return TraceContext(self, trace_id, model_name, model_version, path)
+        return TraceContext(self, trace_id, model_name, model_version, path,
+                            client_request_id, traceparent)
 
     def _emit(self, ctx: TraceContext) -> None:
-        line = json.dumps(
-            {
-                "id": ctx.id,
-                "model_name": ctx.model_name,
-                "model_version": ctx.model_version,
-                "timestamps": ctx.timestamps,
-            }
-        )
+        record = {
+            "id": ctx.id,
+            "model_name": ctx.model_name,
+            "model_version": ctx.model_version,
+            "timestamps": ctx.timestamps,
+        }
+        # propagated client trace context: the join key between this record
+        # and the client's telemetry (absent keys = request was not stamped)
+        if ctx.client_request_id:
+            record["triton_request_id"] = ctx.client_request_id
+        if ctx.traceparent:
+            record["traceparent"] = ctx.traceparent
+        line = json.dumps(record)
         # ctx.path is the sampling scope's file, not necessarily global;
         # an unwritable trace_file must never fail the inference that
         # happened to be sampled (AppendFile swallows OSError)

@@ -49,6 +49,11 @@ class InferRequest:
     inputs: List[InputTensor] = field(default_factory=list)
     outputs: List[RequestedOutput] = field(default_factory=list)
     parameters: Dict[str, Any] = field(default_factory=dict)
+    # Trace propagation (client telemetry layer): the frontend fills these
+    # from the `triton-request-id` / `traceparent` header (gRPC metadata);
+    # the tracer records them and the response echoes the id back.
+    client_request_id: str = ""
+    traceparent: str = ""
     # Filled by the core:
     arrival_ns: int = field(default_factory=lambda: time.monotonic_ns())
 

@@ -209,6 +209,9 @@ def set_shared_memory_region(
         if err != 0:
             raise SharedMemoryException(err)
         cur += nbytes
+    from ..._telemetry import telemetry
+
+    telemetry().record_shm_transfer("system", "write", cur - offset)
 
 
 def get_contents_as_numpy(

@@ -249,6 +249,9 @@ def set_shared_memory_region(
         _bind(xla_shm_handle, arr, "UINT8", (size,))
     if not broker().server_present:
         _write_staging(xla_shm_handle, payloads, offset=offset)
+    from ..._telemetry import telemetry
+
+    telemetry().record_shm_transfer("xla", "write", total)
 
 
 def set_shared_memory_region_from_dlpack(
