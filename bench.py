@@ -351,6 +351,55 @@ def _measure_bert_int8() -> dict:
         os.environ.pop("TRITON_TPU_QUANT_BERT_LARGE", None)
 
 
+def _measure_trace_breakdown(url: str, sweep, inputs_fn) -> dict:
+    """Short traced closed loop: enable server span tracing, run ~2s at c=4,
+    and fold the trace_summary per-stage breakdown (count/p50/p99 + share of
+    request time) into the bench record next to the telemetry snapshot."""
+    import tempfile
+
+    from triton_client_tpu.grpc import InferenceServerClient
+    from triton_client_tpu.tools.trace_summary import (load_trace_file,
+                                                       summarize)
+
+    tf = os.path.join(tempfile.mkdtemp(prefix="bench_trace_"), "trace.json")
+    ctl = InferenceServerClient(url)
+    try:
+        ctl.update_trace_settings(settings={
+            "trace_file": [tf],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["10"],
+        })
+        sweep("simple", inputs_fn, concurrency=4, warmup_s=0.5, measure_s=2.0)
+    except Exception as e:  # noqa: BLE001 — observability leg never kills bench
+        return {"trace_error": str(e)[:120]}
+    finally:
+        try:
+            ctl.update_trace_settings(settings={"trace_level": ["OFF"]})
+        except Exception:
+            pass
+        ctl.close()
+    try:
+        summary = summarize(load_trace_file(tf))
+        entry = summary["models"].get("simple")
+        if entry is None:
+            return {"trace_error": "no simple traces recorded"}
+        stages = {}
+        for name, st in entry["stages"].items():
+            stages[name] = {
+                "count": st["count"],
+                "p50_us": (round(st["p50_us"], 1)
+                           if st["p50_us"] is not None else None),
+                "p99_us": (round(st["p99_us"], 1)
+                           if st["p99_us"] is not None else None),
+                "share_pct": (round(st["share_pct"], 2)
+                              if st["share_pct"] is not None else None),
+            }
+        return {"trace_stage_breakdown": {
+            "requests": entry["count"], "stages": stages}}
+    except (OSError, ValueError) as e:
+        return {"trace_error": str(e)[:120]}
+
+
 def _measure_rtt_floor() -> float:
     """Median blocking device round trip (H2D + sync + D2H) in ms — the
     physical latency floor for any synchronous per-request device path."""
@@ -561,6 +610,10 @@ def main() -> int:
     simple_errors = [e for r in simple_runs for e in r["errors"]]
     # drift control, same session: no-compute RPC rate at the same c=8
     null_rpc = _measure_null_rpc(url)
+    # traced window, SEPARATE from the headline (awaited trace-file appends
+    # would perturb it): the per-stage breakdown rides the bench record so
+    # queue/compute/serialize share is visible round over round
+    trace_breakdown = _measure_trace_breakdown(url, sweep, simple_inputs)
     # same config through the NATIVE C++ client (tools/perf_client.cc) when
     # its binary is built — a cross-language drift control on the headline:
     # same server, same model, same c=8 closed loop, no client-side GIL
@@ -674,6 +727,9 @@ def main() -> int:
     out.update(bert_metrics)
     out.update(gen_metrics)
     out.update(_measure_flash_attention())
+    # server-side per-stage breakdown from the traced window (span tracing):
+    # queue vs compute vs serialize share next to the client-observed numbers
+    out.update(trace_breakdown)
     # client-side telemetry (the instrumented clients recorded every leg):
     # a compact per-(protocol, method, model) view so the bench record
     # carries client-observed p50/p99 next to the server-derived numbers

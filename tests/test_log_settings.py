@@ -72,6 +72,41 @@ class TestServerLog:
         assert re.search(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z I ",
                          lf.read_text(), re.M)
 
+    def test_json_format_one_object_per_line(self, client, tmp_path):
+        """log_format=json: every line is one JSON object with level/ts/msg,
+        and per-request lines carry the propagated triton-request-id — so
+        structured logs join trace files on the same key."""
+        import json
+        import time
+
+        lf = tmp_path / "json.log"
+        client.update_log_settings({"log_file": str(lf),
+                                    "log_format": "json",
+                                    "log_verbose_level": 1})
+        client.infer("simple", _simple_inputs())
+        client.unload_model("identity_fp32")
+        client.load_model("identity_fp32")
+        deadline = time.time() + 10  # lines land via the executor
+        while time.time() < deadline:
+            text = lf.read_text() if lf.exists() else ""
+            if "/infer -> 200" in text and "successfully loaded" in text:
+                break
+            time.sleep(0.05)
+        records = [json.loads(l) for l in text.splitlines() if l.strip()]
+        assert records, "no JSON log lines written"
+        for rec in records:
+            assert {"level", "ts", "msg"} <= set(rec)
+            assert rec["level"] in ("info", "warning", "error")
+            assert isinstance(rec["ts"], float)
+        infer_recs = [r for r in records if "/infer -> 200" in r["msg"]]
+        assert infer_recs
+        # the client stamps triton-request-id on every inference; the
+        # frontend threads it onto the request's log lines
+        assert infer_recs[0].get("request_id")
+        # lifecycle lines outside any request carry no request_id
+        load_recs = [r for r in records if "successfully loaded" in r["msg"]]
+        assert load_recs and "request_id" not in load_recs[0]
+
     def test_log_info_gate_suppresses(self, client, tmp_path):
         lf = tmp_path / "gated.log"
         client.update_log_settings({"log_file": str(lf), "log_info": False})

@@ -38,7 +38,11 @@ def client(server):
 @pytest.fixture(autouse=True)
 def _trace_off_after(client):
     yield
-    client.update_trace_settings(settings={"trace_level": ["OFF"]})
+    # restore every global knob a test may have narrowed (a leaked
+    # trace_count budget or log_frequency would silently shape later tests)
+    client.update_trace_settings(settings={"trace_level": ["OFF"],
+                                           "trace_count": ["-1"],
+                                           "log_frequency": ["0"]})
 
 
 def _simple_inputs():
@@ -157,6 +161,196 @@ class TestTimestampTracing:
         traces = _read_traces(tf)
         assert len(traces) == 1
         assert traces[0]["model_name"] == "simple"
+
+
+class TestSpans:
+    """Span-structured traces: every sampled request emits a span tree
+    ("spans" key) ALONGSIDE the legacy flat timestamp list — existing
+    consumers keep reading "timestamps" unchanged, new consumers
+    (tools/trace_summary) get the per-stage breakdown."""
+
+    def _trace_one(self, client, tf):
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+        })
+        client.infer("simple", _simple_inputs())
+        traces = _read_traces(tf)
+        assert len(traces) == 1
+        return traces[0]
+
+    def test_spans_alongside_legacy_timestamps(self, client, tmp_path):
+        t = self._trace_one(client, tmp_path / "spans.jsonl")
+        # legacy shape intact
+        names = [ts["name"] for ts in t["timestamps"]]
+        assert names[0] == "REQUEST_START" and names[-1] == "REQUEST_END"
+        # span tree present, with the full request-path taxonomy for a
+        # non-batched wire request through the HTTP frontend
+        spans = {s["name"]: s for s in t["spans"]}
+        for name in ("REQUEST", "DECODE", "QUEUE", "COMPUTE",
+                     "SERIALIZE", "NETWORK_WRITE"):
+            assert name in spans, f"missing span {name}: {list(spans)}"
+        assert spans["REQUEST"]["parent"] is None
+        assert spans["COMPUTE"]["parent"] == "REQUEST"
+
+    def test_span_tree_invariants(self, client, tmp_path):
+        t = self._trace_one(client, tmp_path / "invariants.jsonl")
+        spans = t["spans"]
+        root = next(s for s in spans if s["name"] == "REQUEST")
+        for s in spans:
+            assert s["start_ns"] <= s["end_ns"], s
+            if s["name"] != "REQUEST":
+                # children nest inside the parent envelope
+                assert s["start_ns"] >= root["start_ns"], s
+                assert s["end_ns"] <= root["end_ns"], s
+        # the request envelope in span form contains the legacy stamps
+        # (the root opens at wire receive, at or before the legacy
+        # REQUEST_START which is stamped at request construction)
+        d = {ts["name"]: ts["ns"] for ts in t["timestamps"]}
+        assert root["start_ns"] <= d["REQUEST_START"]
+        assert root["end_ns"] >= d["COMPUTE_END"]
+
+    def test_batched_request_records_batch_spans(self, client, tmp_path):
+        """A request through the dynamic batcher carries QUEUE /
+        BATCH_ASSEMBLY / COMPUTE spans for its shared batch."""
+        tf = tmp_path / "batched.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+        })
+        x = np.zeros((1, 512), np.float32)
+        inp = httpclient.InferInput("INPUT", [1, 512], "FP32")
+        inp.set_data_from_numpy(x)
+        client.infer("dense_tpu", [inp])
+        traces = _read_traces(tf)
+        assert len(traces) == 1
+        spans = {s["name"]: s for s in traces[0]["spans"]}
+        for name in ("REQUEST", "QUEUE", "BATCH_ASSEMBLY", "COMPUTE",
+                     "D2H_TRANSFER"):
+            assert name in spans, f"missing span {name}: {list(spans)}"
+        # assembly happens after the queue wait, compute after assembly
+        assert spans["QUEUE"]["end_ns"] <= spans["BATCH_ASSEMBLY"]["start_ns"]
+        assert spans["BATCH_ASSEMBLY"]["end_ns"] <= \
+            spans["COMPUTE"]["start_ns"]
+
+    def test_grpc_requests_get_spans_too(self, server, tmp_path):
+        tf = tmp_path / "grpc_spans.jsonl"
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            gc.update_trace_settings(settings={
+                "trace_file": [str(tf)],
+                "trace_level": ["TIMESTAMPS"],
+                "trace_rate": ["1"],
+            })
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(a)
+            inputs[1].set_data_from_numpy(a)
+            gc.infer("simple", inputs)
+            gc.update_trace_settings(settings={"trace_level": ["OFF"]})
+        spans = {s["name"] for s in _read_traces(tf)[0]["spans"]}
+        assert {"REQUEST", "DECODE", "QUEUE", "COMPUTE",
+                "SERIALIZE", "NETWORK_WRITE"} <= spans
+
+
+class TestClientServerJoin:
+    def test_join_on_request_id(self, client, tmp_path):
+        """A client trace file (telemetry().enable_tracing) and the server
+        trace file join on the propagated triton-request-id."""
+        from triton_client_tpu._telemetry import telemetry
+
+        sf = tmp_path / "server.jsonl"
+        cf = tmp_path / "client.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(sf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+        })
+        telemetry().enable_tracing(str(cf))
+        try:
+            for _ in range(3):
+                client.infer("simple", _simple_inputs())
+        finally:
+            telemetry().disable_tracing()
+        server_recs = _read_traces(sf)
+        client_recs = _read_traces(cf)
+        assert len(server_recs) == 3
+        client_ids = {r["request_id"] for r in client_recs}
+        for rec in server_recs:
+            assert rec["triton_request_id"] in client_ids
+        # client records carry the client-side stage spans
+        for rec in client_recs:
+            names = {s["name"] for s in rec["spans"]}
+            assert {"REQUEST", "SERIALIZE", "NETWORK", "DESERIALIZE"} <= names
+
+    def test_joined_summary_reports_network_overhead(self, client, tmp_path):
+        from triton_client_tpu._telemetry import telemetry
+        from triton_client_tpu.tools.trace_summary import (load_trace_file,
+                                                           summarize)
+
+        sf = tmp_path / "server2.jsonl"
+        cf = tmp_path / "client2.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(sf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+        })
+        telemetry().enable_tracing(str(cf))
+        try:
+            client.infer("simple", _simple_inputs())
+        finally:
+            telemetry().disable_tracing()
+        summary = summarize(load_trace_file(str(sf)),
+                            load_trace_file(str(cf)))
+        join = summary["join"]
+        assert join["joined"] == 1
+        # the client-observed request necessarily outlasts the server's
+        # handling of it — overhead is strictly positive
+        assert join["network_overhead_us"]["count"] == 1
+        assert join["network_overhead_us"]["p50_us"] > 0
+        stages = summary["models"]["simple"]["stages"]
+        assert stages["QUEUE"]["p99_us"] is not None
+        assert stages["COMPUTE"]["p99_us"] is not None
+
+
+class TestLogFrequencyRotation:
+    def test_log_frequency_rotates_files(self, client, tmp_path):
+        """log_frequency=N splits the stream into <trace_file>.0, .1, …
+        with N traces per file (reference server rotation contract)."""
+        tf = tmp_path / "rot.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+            "log_frequency": ["2"],
+        })
+        for _ in range(5):
+            client.infer("simple", _simple_inputs())
+        assert not tf.exists()  # rotation writes only indexed files
+        assert len(_read_traces(tmp_path / "rot.jsonl.0")) == 2
+        assert len(_read_traces(tmp_path / "rot.jsonl.1")) == 2
+        assert len(_read_traces(tmp_path / "rot.jsonl.2")) == 1
+        # ids stay file-unique and increasing across the rotated set
+        ids = [t["id"] for i in range(3)
+               for t in _read_traces(tmp_path / f"rot.jsonl.{i}")]
+        assert ids == sorted(ids) and len(set(ids)) == 5
+
+    def test_zero_log_frequency_keeps_single_file(self, client, tmp_path):
+        tf = tmp_path / "single.jsonl"
+        client.update_trace_settings(settings={
+            "trace_file": [str(tf)],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+            "log_frequency": ["0"],
+        })
+        for _ in range(3):
+            client.infer("simple", _simple_inputs())
+        assert len(_read_traces(tf)) == 3
+        assert not (tmp_path / "single.jsonl.0").exists()
 
 
 class TestPerModelSettings:

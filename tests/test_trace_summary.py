@@ -1,0 +1,250 @@
+"""The trace_summary CLI — the canonical trace-file consumer (reference
+``src/python/examples/trace_summary.py`` analog).
+
+Synthetic fixtures with hand-picked nanosecond values make the expected
+output exactly computable: the golden test pins the text renderer, the
+chrome test pins the Perfetto-loadable trace-event schema, and the legacy
+test proves timestamps-only records (pre-span emitters) still summarize.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from triton_client_tpu.tools.trace_summary import (
+    chrome_trace,
+    format_text,
+    load_trace_file,
+    main,
+    record_spans,
+    summarize,
+)
+
+US = 1000  # ns per us
+
+
+def _server_rec(trace_id, rid, model="simple", base=0, total_us=1000,
+                queue_us=100, compute_us=700):
+    return {
+        "id": trace_id,
+        "model_name": model,
+        "model_version": "1",
+        "triton_request_id": rid,
+        "timestamps": [
+            {"name": "REQUEST_START", "ns": base},
+            {"name": "QUEUE_START", "ns": base},
+            {"name": "COMPUTE_START", "ns": base + queue_us * US},
+            {"name": "COMPUTE_END", "ns": base + (queue_us + compute_us) * US},
+            {"name": "REQUEST_END", "ns": base + total_us * US},
+        ],
+        "spans": [
+            {"name": "REQUEST", "start_ns": base,
+             "end_ns": base + total_us * US, "parent": None},
+            {"name": "QUEUE", "start_ns": base,
+             "end_ns": base + queue_us * US, "parent": "REQUEST"},
+            {"name": "COMPUTE", "start_ns": base + queue_us * US,
+             "end_ns": base + (queue_us + compute_us) * US,
+             "parent": "REQUEST"},
+            {"name": "SERIALIZE",
+             "start_ns": base + (queue_us + compute_us) * US,
+             "end_ns": base + (queue_us + compute_us + 50) * US,
+             "parent": "REQUEST"},
+        ],
+    }
+
+
+def _client_rec(rid, model="simple", base=0, total_us=1500):
+    return {
+        "request_id": rid,
+        "model": model,
+        "protocol": "http",
+        "method": "infer",
+        "ok": True,
+        "spans": [
+            {"name": "REQUEST", "start_ns": base,
+             "end_ns": base + total_us * US},
+            {"name": "SERIALIZE", "start_ns": base,
+             "end_ns": base + 30 * US},
+            {"name": "NETWORK", "start_ns": base + 30 * US,
+             "end_ns": base + (total_us - 20) * US},
+            {"name": "DESERIALIZE", "start_ns": base + (total_us - 20) * US,
+             "end_ns": base + total_us * US},
+        ],
+    }
+
+
+@pytest.fixture()
+def server_file(tmp_path):
+    path = tmp_path / "server.json"
+    recs = [
+        _server_rec(1, "aaaa0001", total_us=1000, queue_us=100,
+                    compute_us=700),
+        _server_rec(2, "aaaa0002", base=10_000 * US, total_us=2000,
+                    queue_us=300, compute_us=1500),
+        _server_rec(3, "aaaa0003", base=20_000 * US, total_us=3000,
+                    queue_us=500, compute_us=2300),
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return path
+
+
+@pytest.fixture()
+def client_file(tmp_path):
+    path = tmp_path / "client.json"
+    recs = [
+        _client_rec("aaaa0001", total_us=1500),
+        _client_rec("aaaa0002", base=10_000 * US, total_us=2600),
+        _client_rec("aaaa0003", base=20_000 * US, total_us=3900),
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return path
+
+
+class TestSummarize:
+    def test_per_stage_percentiles(self, server_file):
+        s = summarize(load_trace_file(str(server_file)))
+        assert s["requests"] == 3
+        m = s["models"]["simple"]
+        assert m["count"] == 3
+        # REQUEST durations 1000/2000/3000us: nearest-rank p50=2000, p99=3000
+        assert m["request"]["p50_us"] == pytest.approx(2000.0)
+        assert m["request"]["p90_us"] == pytest.approx(3000.0)
+        assert m["request"]["p99_us"] == pytest.approx(3000.0)
+        assert m["stages"]["QUEUE"]["p50_us"] == pytest.approx(300.0)
+        assert m["stages"]["QUEUE"]["p99_us"] == pytest.approx(500.0)
+        assert m["stages"]["COMPUTE"]["p50_us"] == pytest.approx(1500.0)
+        assert m["stages"]["COMPUTE"]["p99_us"] == pytest.approx(2300.0)
+        # queue share: 900us of 6000us total request time
+        assert m["queue_share_pct"] == pytest.approx(15.0)
+        # stages render in taxonomy order
+        assert list(m["stages"]) == ["QUEUE", "COMPUTE", "SERIALIZE"]
+
+    def test_join_network_overhead(self, server_file, client_file):
+        s = summarize(load_trace_file(str(server_file)),
+                      load_trace_file(str(client_file)))
+        join = s["join"]
+        assert join["client_requests"] == 3
+        assert join["joined"] == 3
+        # overheads: 500/600/900us → p50 = 600, mean = 666.67
+        ov = join["network_overhead_us"]
+        assert ov["count"] == 3
+        assert ov["p50_us"] == pytest.approx(600.0)
+        assert ov["mean_us"] == pytest.approx(2000.0 / 3.0)
+        assert set(join["client_stages"]) == {"SERIALIZE", "NETWORK",
+                                              "DESERIALIZE"}
+
+    def test_legacy_timestamp_records_summarize(self, tmp_path):
+        """Records written before the span upgrade (timestamps only) still
+        produce REQUEST/QUEUE/COMPUTE rows."""
+        rec = _server_rec(1, "aaaa0001", total_us=1000, queue_us=100,
+                          compute_us=700)
+        del rec["spans"]
+        path = tmp_path / "legacy.json"
+        path.write_text(json.dumps(rec) + "\n")
+        derived = record_spans(load_trace_file(str(path))[0])
+        assert ("REQUEST", 0, 1000 * US) in derived
+        assert ("QUEUE", 0, 100 * US) in derived
+        assert ("COMPUTE", 100 * US, 800 * US) in derived
+        s = summarize(load_trace_file(str(path)))
+        assert s["models"]["simple"]["stages"]["COMPUTE"]["p50_us"] == \
+            pytest.approx(700.0)
+
+    def test_malformed_line_fails_with_line_number(self, tmp_path):
+        path = tmp_path / "bad.json"
+        path.write_text('{"id": 1}\nnot json\n')
+        with pytest.raises(ValueError, match="bad.json:2"):
+            load_trace_file(str(path))
+
+
+class TestGoldenOutput:
+    def test_text_output_golden(self, server_file, client_file, capsys):
+        assert main([str(server_file), "--client", str(client_file)]) == 0
+        out = capsys.readouterr().out
+        expected = """\
+== server trace: 3 request(s), 1 model(s) ==
+
+model=simple  requests=3
+  REQUEST               3      2000.0      2000.0      3000.0      3000.0
+  stage             count     mean_us      p50_us      p90_us      p99_us   share%
+  QUEUE                 3       300.0       300.0       500.0       500.0     15.0
+  COMPUTE               3      1500.0      1500.0      2300.0      2300.0     75.0
+  SERIALIZE             3        50.0        50.0        50.0        50.0      2.5
+  queue share: 15.0% of request time
+
+== client join: 3/3 server trace(s) joined on request id ==
+  network overhead (client REQUEST - server REQUEST): count 3  mean_us 666.7  p50_us 600.0  p99_us 900.0
+  stage             count     mean_us      p50_us      p90_us      p99_us
+  SERIALIZE             3        30.0        30.0        30.0        30.0
+  NETWORK               3      2616.7      2550.0      3850.0      3850.0
+  DESERIALIZE           3        20.0        20.0        20.0        20.0
+"""
+        assert out == expected
+
+    def test_output_file(self, server_file, tmp_path):
+        dest = tmp_path / "out.txt"
+        assert main([str(server_file), "-o", str(dest)]) == 0
+        assert "model=simple" in dest.read_text()
+
+    def test_json_format_is_strict_json(self, server_file, client_file,
+                                        capsys):
+        assert main([str(server_file), "--client", str(client_file),
+                     "--format", "json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["models"]["simple"]["stages"]["QUEUE"]["count"] == 3
+
+
+class TestChromeExport:
+    def test_chrome_trace_event_schema(self, server_file, client_file,
+                                       capsys):
+        """--format chrome emits valid Chrome trace-event JSON (the object
+        form Perfetto and chrome://tracing load)."""
+        assert main([str(server_file), "--client", str(client_file),
+                     "--format", "chrome"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert isinstance(doc["traceEvents"], list)
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        # 4 spans per server record + 4 per client record
+        assert len(spans) == 24
+        assert {m["args"]["name"] for m in metas} == {"server", "client"}
+        for e in spans:
+            assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid",
+                              "cat", "args"}
+            assert e["ts"] >= 0 and e["dur"] >= 0
+            assert e["pid"] in (1, 2)
+        # timestamps are rebased: each source starts at 0
+        assert min(e["ts"] for e in spans if e["pid"] == 1) == 0
+        assert min(e["ts"] for e in spans if e["pid"] == 2) == 0
+        # server and client halves of one request share the request id
+        rids = {e["args"]["request_id"] for e in spans}
+        assert {"aaaa0001", "aaaa0002", "aaaa0003"} <= rids
+
+    def test_chrome_dur_matches_span(self, server_file):
+        doc = chrome_trace(load_trace_file(str(server_file)))
+        req = [e for e in doc["traceEvents"]
+               if e.get("ph") == "X" and e["name"] == "REQUEST"]
+        assert sorted(e["dur"] for e in req) == [1000.0, 2000.0, 3000.0]
+
+
+class TestCli:
+    def test_module_help_exits_zero(self):
+        """`python -m triton_client_tpu.tools.trace_summary --help` must
+        work in a bare environment (stdlib-only import chain)."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "triton_client_tpu.tools.trace_summary",
+             "--help"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0
+        assert "trace" in proc.stdout.lower()
+
+    def test_missing_file_is_error_not_traceback(self, capsys):
+        assert main(["/nonexistent/trace.json"]) == 1
+        err = capsys.readouterr().err
+        assert "error:" in err
+
+    def test_format_text_deterministic(self, server_file):
+        s1 = format_text(summarize(load_trace_file(str(server_file))))
+        s2 = format_text(summarize(load_trace_file(str(server_file))))
+        assert s1 == s2

@@ -31,6 +31,7 @@ is the client half of the observability subsystem:
 
 from __future__ import annotations
 
+import json
 import math
 import os
 import re
@@ -39,6 +40,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 __all__ = [
+    "AppendFile",
     "ClientTelemetry",
     "LatencyHistogram",
     "escape_label",
@@ -48,6 +50,50 @@ __all__ = [
     "REQUEST_ID_HEADER",
     "TRACEPARENT_HEADER",
 ]
+
+
+class AppendFile:
+    """Cached append handle, reopened when the configured path changes —
+    shared by the client trace recorder, the server log, and the request
+    tracer so the open-on-change/close-on-shutdown/failure-drop state
+    machine exists once.  A failing write must never raise (the request
+    that happened to log/trace must not fail) and must CLOSE the handle
+    before dropping it (dropping without close leaks one fd per attempt
+    against a full disk until accept() dies with EMFILE).
+
+    Lives here rather than in ``server/log.py`` (which re-exports it)
+    because this module is importable with zero optional deps — the server
+    package pulls in the whole serving stack."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._file = None
+        self._path = None
+
+    def append(self, path: str, data: str) -> None:
+        with self._lock:
+            try:
+                if self._file is None or self._path != path:
+                    self._close_locked()
+                    self._file = open(path, "a")
+                    self._path = path
+                self._file.write(data)
+                self._file.flush()
+            except OSError:
+                self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+            self._path = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
 
 #: Header / gRPC-metadata key carrying the client-generated request id the
 #: server echoes back and records in trace JSON (lowercase: gRPC metadata
@@ -89,6 +135,25 @@ def merge_trace_headers(
     user = ({k.lower(): v for k, v in headers.items()} if headers else {})
     extra = {k: v for k, v in ctx.items() if k not in user}
     return extra, user.get(REQUEST_ID_HEADER, ctx[REQUEST_ID_HEADER])
+
+
+def traceparent_on_wire(user_headers: Optional[Dict[str, str]],
+                        minted_headers: Dict[str, str]) -> str:
+    """The traceparent actually sent on one HTTP inference: a user-supplied
+    header wins over the minted one (the merge_trace_headers contract), so
+    client trace records keep external correlation ids."""
+    if user_headers:
+        for k, v in user_headers.items():
+            if k.lower() == TRACEPARENT_HEADER:
+                return v
+    return minted_headers.get(TRACEPARENT_HEADER, "")
+
+
+def traceparent_from_metadata(metadata) -> str:
+    """The traceparent in a merged gRPC metadata tuple (user-supplied or
+    minted — _with_trace_metadata already applied the precedence)."""
+    return next((v for k, v in metadata
+                 if k.lower() == TRACEPARENT_HEADER), "")
 
 
 def escape_label(value: str) -> str:
@@ -229,6 +294,15 @@ class ClientTelemetry:
         # (kind, direction) -> [transfers, bytes]; direction: write | read
         self._shm_transfer: Dict[Tuple[str, str], List[int]] = {}
         self._hook: Optional[Callable[[Dict[str, Any]], None]] = None
+        # client-side span tracing: when a path is set, every instrumented
+        # inference appends one JSON line (request id + SERIALIZE/NETWORK/
+        # DESERIALIZE spans) — the client half of the trace join.  The
+        # handle is cached via AppendFile (open-per-record syscalls would
+        # serialize concurrent client threads during a perf sweep — the
+        # very workload client tracing exists to measure).
+        self._trace_path: Optional[str] = None
+        self._trace_lock = threading.Lock()
+        self._trace_out = AppendFile()
 
     # -- recording ---------------------------------------------------------
     def _series(self, key: Tuple[str, str, str]) -> _RequestSeries:
@@ -293,6 +367,90 @@ class ClientTelemetry:
             c = self._shm_transfer.setdefault((kind, direction), [0, 0])
             c[0] += 1
             c[1] += int(nbytes)
+
+    # -- client-side span tracing ------------------------------------------
+    def enable_tracing(self, path: str) -> None:
+        """Start recording per-request client span sets to ``path`` (JSON
+        Lines, one object per completed inference).  Each record carries the
+        ``triton-request-id`` this process stamped on the wire, so it joins
+        with the server's trace file on that key
+        (``triton_client_tpu.tools.trace_summary --client``)."""
+        with self._trace_lock:
+            self._trace_path = path
+
+    def disable_tracing(self) -> None:
+        with self._trace_lock:
+            self._trace_path = None
+            self._trace_out.close()
+
+    @property
+    def tracing_enabled(self) -> bool:
+        return self._trace_path is not None
+
+    def record_infer_spans(
+        self,
+        request_id: str,
+        model: str,
+        protocol: str,
+        method: str,
+        start_ns: int,
+        serialize_end_ns: int,
+        network_end_ns: int,
+        traceparent: str = "",
+    ) -> None:
+        """The one span taxonomy every instrumented client records — a
+        REQUEST root closing now, with SERIALIZE (request build +
+        compression), NETWORK (wire round trip), and DESERIALIZE (result
+        construction) children.  One definition so the four clients cannot
+        drift per protocol."""
+        t_end = time.monotonic_ns()
+        self.record_client_trace(
+            request_id, model, protocol, method,
+            spans=[("REQUEST", start_ns, t_end),
+                   ("SERIALIZE", start_ns, serialize_end_ns),
+                   ("NETWORK", serialize_end_ns, network_end_ns),
+                   ("DESERIALIZE", network_end_ns, t_end)],
+            traceparent=traceparent)
+
+    def record_client_trace(
+        self,
+        request_id: str,
+        model: str,
+        protocol: str,
+        method: str,
+        spans,
+        ok: bool = True,
+        traceparent: str = "",
+    ) -> None:
+        """Append one client trace record.  ``spans`` is an iterable of
+        ``(name, start_ns, end_ns)`` tuples (monotonic clock of THIS
+        process: durations are meaningful, absolute values do not align
+        with the server's clock — the join compares durations only)."""
+        path = self._trace_path
+        if path is None:
+            return
+        record: Dict[str, Any] = {
+            "request_id": request_id,
+            "model": model,
+            "protocol": protocol,
+            "method": method,
+            "ok": ok,
+            "spans": [
+                {"name": n, "start_ns": int(s), "end_ns": int(e)}
+                for n, s, e in spans
+            ],
+        }
+        if traceparent:
+            record["traceparent"] = traceparent
+        line = json.dumps(record)
+        with self._trace_lock:
+            # re-checked under the lock: a concurrent disable_tracing()
+            # closed the handle, and a stale in-flight record must not
+            # reopen the file after it (leaking the fd and writing past
+            # the disable).  AppendFile swallows OSError itself.
+            if self._trace_path != path:
+                return
+            self._trace_out.append(path, line + "\n")
 
     # -- hook --------------------------------------------------------------
     def set_request_hook(

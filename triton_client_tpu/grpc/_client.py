@@ -18,7 +18,8 @@ import grpc
 
 from .._client import InferenceServerClientBase
 from .._request import Request
-from .._telemetry import new_trace_context, telemetry
+from .._telemetry import (new_trace_context, telemetry,
+                          traceparent_from_metadata)
 from ..protocol import inference_pb2 as pb
 from ..protocol.service import GRPCInferenceServiceStub
 from ..utils import raise_error
@@ -448,12 +449,15 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
     ) -> InferResult:
         """Synchronous inference (reference :1445-1572)."""
+        tel = telemetry()
+        t_ser0 = time.monotonic_ns()
         request = get_inference_request(
             model_name, inputs, model_version, request_id, outputs,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
         metadata, rid = _with_trace_metadata(
             self._get_metadata(headers), request_id)
+        t_ser1 = time.monotonic_ns()
         if self._verbose:
             print(f"infer, metadata {metadata}\n{request}")
         req_bytes = request.ByteSize()
@@ -465,15 +469,22 @@ class InferenceServerClient(InferenceServerClientBase):
                 timeout=client_timeout,
                 compression=get_grpc_compression(compression_algorithm),
             )
+            t_net1 = time.monotonic_ns()
             if self._verbose:
                 print(response)
-            telemetry().record_request(
+            tel.record_request(
                 model_name, "grpc", "infer", time.perf_counter() - t0,
                 ok=True, request_bytes=req_bytes,
                 response_bytes=response.ByteSize(), request_id=rid)
-            return InferResult(response)
+            result = InferResult(response)
+            if tel.tracing_enabled:
+                tel.record_infer_spans(
+                    rid, model_name, "grpc", "infer",
+                    t_ser0, t_ser1, t_net1,
+                    traceparent=traceparent_from_metadata(metadata))
+            return result
         except grpc.RpcError as e:
-            telemetry().record_request(
+            tel.record_request(
                 model_name, "grpc", "infer", time.perf_counter() - t0,
                 ok=False, request_bytes=req_bytes, request_id=rid)
             raise_error_grpc(e)

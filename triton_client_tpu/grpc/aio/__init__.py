@@ -17,7 +17,7 @@ import grpc
 
 from ..._client import InferenceServerClientBase
 from ..._request import Request
-from ..._telemetry import telemetry
+from ..._telemetry import telemetry, traceparent_from_metadata
 from ...protocol import inference_pb2 as pb
 from ...protocol.service import GRPCInferenceServiceStub
 from ...utils import raise_error
@@ -348,12 +348,15 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
     ) -> InferResult:
         """Async inference (reference aio :634)."""
+        tel = telemetry()
+        t_ser0 = time.monotonic_ns()
         request = get_inference_request(
             model_name, inputs, model_version, request_id, outputs,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
         metadata, rid = _with_trace_metadata(
             self._get_metadata(headers), request_id)
+        t_ser1 = time.monotonic_ns()
         req_bytes = request.ByteSize()
         t0 = time.perf_counter()
         try:
@@ -363,13 +366,20 @@ class InferenceServerClient(InferenceServerClientBase):
                 timeout=client_timeout,
                 compression=get_grpc_compression(compression_algorithm),
             )
-            telemetry().record_request(
+            t_net1 = time.monotonic_ns()
+            tel.record_request(
                 model_name, "grpc_aio", "infer", time.perf_counter() - t0,
                 ok=True, request_bytes=req_bytes,
                 response_bytes=response.ByteSize(), request_id=rid)
-            return InferResult(response)
+            result = InferResult(response)
+            if tel.tracing_enabled:
+                tel.record_infer_spans(
+                    rid, model_name, "grpc_aio", "infer",
+                    t_ser0, t_ser1, t_net1,
+                    traceparent=traceparent_from_metadata(metadata))
+            return result
         except grpc.RpcError as e:
-            telemetry().record_request(
+            tel.record_request(
                 model_name, "grpc_aio", "infer", time.perf_counter() - t0,
                 ok=False, request_bytes=req_bytes, request_id=rid)
             raise_error_grpc(e)

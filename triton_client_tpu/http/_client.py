@@ -24,7 +24,7 @@ import urllib3
 
 from .._client import InferenceServerClientBase
 from .._request import Request
-from .._telemetry import merge_trace_headers, telemetry
+from .._telemetry import merge_trace_headers, telemetry, traceparent_on_wire
 from ..utils import InferenceServerException, raise_error
 from ._infer_result import InferResult
 from ._utils import get_inference_request_body, raise_if_error
@@ -464,6 +464,8 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters,
         _method="infer",
     ):
+        tel = telemetry()
+        t_ser0 = time.monotonic_ns()
         body, json_size = get_inference_request_body(
             inputs, request_id, outputs, sequence_id, sequence_start, sequence_end,
             priority, timeout, parameters,
@@ -484,6 +486,7 @@ class InferenceServerClient(InferenceServerClientBase):
         # headers of the same name win)
         trace_headers, rid = merge_trace_headers(headers, request_id)
         extra_headers.update(trace_headers)
+        t_ser1 = time.monotonic_ns()  # body built + compressed = SERIALIZE
 
         path = f"v2/models/{quote(model_name)}"
         if model_version:
@@ -494,25 +497,31 @@ class InferenceServerClient(InferenceServerClientBase):
             response = self._post(path, body, headers, query_params, extra_headers)
             raise_if_error(response.status, response.data)
         except Exception:
-            telemetry().record_request(
+            tel.record_request(
                 model_name, "http", _method, time.perf_counter() - t0,
                 ok=False, request_bytes=len(body),
                 request_id=rid)
             raise
-        telemetry().record_request(
+        t_net1 = time.monotonic_ns()
+        tel.record_request(
             model_name, "http", _method, time.perf_counter() - t0,
             ok=True, request_bytes=len(body),
             response_bytes=len(response.data),
             request_id=rid)
         header_length = response.headers.get("Inference-Header-Content-Length")
         # urllib3 decodes gzip/deflate transparently, so no content_encoding.
-        return InferResult(
+        result = InferResult(
             response.data,
             self._verbose,
             int(header_length) if header_length is not None else None,
             None,
             headers=response.headers,
         )
+        if tel.tracing_enabled:
+            tel.record_infer_spans(
+                rid, model_name, "http", _method, t_ser0, t_ser1, t_net1,
+                traceparent=traceparent_on_wire(headers, trace_headers))
+        return result
 
     def infer(
         self,

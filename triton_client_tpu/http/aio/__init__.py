@@ -19,7 +19,8 @@ import aiohttp
 
 from ..._client import InferenceServerClientBase
 from ..._request import Request
-from ..._telemetry import merge_trace_headers, telemetry
+from ..._telemetry import (merge_trace_headers, telemetry,
+                           traceparent_on_wire)
 from ...utils import raise_error
 from .._infer_result import InferResult
 from .._utils import get_inference_request_body, raise_if_error
@@ -333,6 +334,8 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
     ) -> InferResult:
         """Async inference (reference aio :694)."""
+        tel = telemetry()
+        t_ser0 = time.monotonic_ns()
         body, json_size = get_inference_request_body(
             inputs, request_id, outputs, sequence_id, sequence_start, sequence_end,
             priority, timeout, parameters,
@@ -352,6 +355,7 @@ class InferenceServerClient(InferenceServerClientBase):
         # records the id in trace JSON and echoes it back)
         trace_headers, rid = merge_trace_headers(headers, request_id)
         extra_headers.update(trace_headers)
+        t_ser1 = time.monotonic_ns()
 
         path = f"v2/models/{quote(model_name)}"
         if model_version:
@@ -364,21 +368,27 @@ class InferenceServerClient(InferenceServerClientBase):
             )
             raise_if_error(status, data)
         except Exception:
-            telemetry().record_request(
+            tel.record_request(
                 model_name, "http_aio", "infer", time.perf_counter() - t0,
                 ok=False, request_bytes=len(body),
                 request_id=rid)
             raise
-        telemetry().record_request(
+        t_net1 = time.monotonic_ns()
+        tel.record_request(
             model_name, "http_aio", "infer", time.perf_counter() - t0,
             ok=True, request_bytes=len(body), response_bytes=len(data),
             request_id=rid)
         header_length = resp_headers.get("Inference-Header-Content-Length")
-        return InferResult(
+        result = InferResult(
             data, self._verbose,
             int(header_length) if header_length is not None else None, None,
             headers=resp_headers,
         )
+        if tel.tracing_enabled:
+            tel.record_infer_spans(
+                rid, model_name, "http_aio", "infer", t_ser0, t_ser1, t_net1,
+                traceparent=traceparent_on_wire(headers, trace_headers))
+        return result
 
 
 def _decompress(headers, body: bytes) -> bytes:

@@ -611,6 +611,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="write the sweep results plus the client "
                              "telemetry snapshot (per-model/protocol/method "
                              "counters and latency quantiles) as JSON")
+    parser.add_argument("--trace-file", default=None, metavar="PATH",
+                        help="enable server-side tracing for the sweep "
+                             "(trace_level=TIMESTAMPS into PATH, sampled at "
+                             "--trace-rate) and report the per-stage "
+                             "breakdown after; PATH must be a path the "
+                             "SERVER can write")
+    parser.add_argument("--trace-rate", type=int, default=100,
+                        help="server sampling rate while --trace-file is on "
+                             "(trace every Nth request; default 100)")
     parser.add_argument("-f", "--latency-report-file", default=None)
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -681,29 +690,75 @@ def main(argv: Optional[List[str]] = None) -> int:
                 line += f", send lag p99 {res['send_lag_p99_ms']:.1f} ms"
             print(line)
 
-    if open_loop:
+    if args.trace_file:
+        # server-side tracing for the whole sweep: the stage breakdown
+        # (queue vs batch assembly vs compute vs serialize) is reported
+        # next to the client-observed percentiles afterwards.  Enabled
+        # HERE — after every argument-validation exit above — so no
+        # early `return`/parser.error can leave server-wide tracing on,
+        # and the finally below always reaches the matching OFF.
+        trace_ctl = pm.InferenceServerClient(url)
+        trace_ctl.update_trace_settings(settings={
+            "trace_file": [args.trace_file],
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": [str(max(1, args.trace_rate))],
+        })
+        trace_ctl.close()
+
+    try:
+        if open_loop:
+            try:
+                rates = _parse_rate_range(args.request_rate_range)
+            except ValueError as e:
+                parser.error(str(e))
+            for rate in rates:
+                res = run_rate_level(
+                    args.protocol, url, args.model_name, args.model_version,
+                    rate, arrays, outputs, args.shared_memory,
+                    args.output_shared_memory_size, measure_s,
+                    distribution=args.request_distribution,
+                    max_threads=args.max_threads,
+                    extra_percentile=args.percentile, streaming=args.streaming)
+                report(res, f"Request rate: {rate:g}/s, completed "
+                            "(latency from scheduled send): ")
+        else:
+            for level in _parse_concurrency_range(args.concurrency_range):
+                res = run_level(
+                    args.protocol, url, args.model_name, args.model_version,
+                    level, arrays, outputs, args.shared_memory,
+                    args.output_shared_memory_size, measure_s,
+                    extra_percentile=args.percentile, streaming=args.streaming)
+                report(res, f"Concurrency: {level}, throughput: ")
+    finally:
+        if args.trace_file:
+            # the sweep turned on SERVER-WIDE tracing — a failed or
+            # interrupted sweep must not leave the server sampling every
+            # later request into the file forever
+            try:
+                off_client = pm.InferenceServerClient(url)
+                off_client.update_trace_settings(
+                    settings={"trace_level": ["OFF"]})
+                off_client.close()
+            except Exception as e:  # noqa: BLE001 — best effort on teardown
+                print(f"warning: could not disable server tracing: {e}",
+                      file=sys.stderr)
+
+    trace_summary = None
+    if args.trace_file:
+        from .tools.trace_summary import (format_text, load_trace_file,
+                                          summarize)
+
         try:
-            rates = _parse_rate_range(args.request_rate_range)
-        except ValueError as e:
-            parser.error(str(e))
-        for rate in rates:
-            res = run_rate_level(
-                args.protocol, url, args.model_name, args.model_version,
-                rate, arrays, outputs, args.shared_memory,
-                args.output_shared_memory_size, measure_s,
-                distribution=args.request_distribution,
-                max_threads=args.max_threads,
-                extra_percentile=args.percentile, streaming=args.streaming)
-            report(res, f"Request rate: {rate:g}/s, completed "
-                        "(latency from scheduled send): ")
-    else:
-        for level in _parse_concurrency_range(args.concurrency_range):
-            res = run_level(
-                args.protocol, url, args.model_name, args.model_version,
-                level, arrays, outputs, args.shared_memory,
-                args.output_shared_memory_size, measure_s,
-                extra_percentile=args.percentile, streaming=args.streaming)
-            report(res, f"Concurrency: {level}, throughput: ")
+            trace_summary = summarize(load_trace_file(args.trace_file))
+            print("\n*** Server trace breakdown "
+                  f"({args.trace_file}, every {max(1, args.trace_rate)}th "
+                  "request) ***")
+            print(format_text(trace_summary), end="")
+        except (OSError, ValueError) as e:
+            # a trace_file the server could not write (or an unreadable one
+            # here) must not fail the sweep that already printed its numbers
+            print(f"warning: could not summarize {args.trace_file}: {e}",
+                  file=sys.stderr)
 
     if args.export_metrics:
         snapshot = {
@@ -718,6 +773,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             ],
             "client_telemetry": telemetry().snapshot(),
         }
+        if trace_summary is not None:
+            snapshot["server_trace_summary"] = trace_summary
         with open(args.export_metrics, "w") as f:
             json.dump(snapshot, f, indent=2)
 

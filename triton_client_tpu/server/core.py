@@ -206,10 +206,12 @@ class _DynamicBatcher:
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(self._run())
 
-    async def submit(self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]):
+    async def submit(self, inputs: Dict[str, np.ndarray],
+                     parameters: Dict[str, Any], trace=None):
         fut = asyncio.get_running_loop().create_future()
         self.start()
-        await self._queue.put((inputs, parameters, fut, time.monotonic_ns()))
+        await self._queue.put(
+            (inputs, parameters, fut, time.monotonic_ns(), trace))
         return await fut
 
     async def _run(self) -> None:
@@ -258,7 +260,7 @@ class _DynamicBatcher:
             # shutdown mid-batch: fail whatever we were holding
             if carry is not None:
                 pending.append(carry)
-            for _inputs, _params, fut, _ts in pending:
+            for _inputs, _params, fut, _ts, _trace in pending:
                 if not fut.done():
                     fut.set_exception(InferError("server is shutting down", 503))
             raise
@@ -283,6 +285,12 @@ class _DynamicBatcher:
                 padded = b
                 break
         names = list(pending[0][0].keys())
+        traces = [p[4] for p in pending if p[4] is not None]
+        t_asm0 = time.monotonic_ns()
+        for _inputs, _params, _fut, ts, trace in pending:
+            if trace is not None:
+                # this request's wait from enqueue until its batch formed
+                trace.add_span("QUEUE", ts, t_asm0)
         try:
             merged = {}
             for n in names:
@@ -294,16 +302,20 @@ class _DynamicBatcher:
                 merged[n] = arr
             queue_ns = time.monotonic_ns() - pending[0][3]
             t0 = time.monotonic_ns()
+            for trace in traces:
+                # concat + pad-to-bucket: the cost of riding a shared batch
+                trace.add_span("BATCH_ASSEMBLY", t_asm0, t0)
             # keep_device=set(): every output resolves D2H on the executor
             # thread, not the event loop — a blocking np.asarray here would
             # stall every other request for the full device round trip.
             outputs = await self._core._run_model(
-                self._model, merged, pending[0][1], keep_device=set())
+                self._model, merged, pending[0][1], keep_device=set(),
+                traces=traces)
             compute_ns = time.monotonic_ns() - t0
             self._model.stats.record(total, queue_ns, compute_ns, ok=True)
             self._model.stats.record_batch(total)
             offset = 0
-            for (inputs, _params, fut, _ts), count in zip(pending, counts):
+            for (inputs, _params, fut, _ts, _trace), count in zip(pending, counts):
                 part = {
                     n: v[offset : offset + count] for n, v in outputs.items()
                 }
@@ -312,7 +324,7 @@ class _DynamicBatcher:
                     fut.set_result(part)
         except Exception as e:
             self._model.stats.record(total, 0, 0, ok=False)
-            for _inputs, _params, fut, _ts in pending:
+            for _inputs, _params, fut, _ts, _trace in pending:
                 if not fut.done():
                     fut.set_exception(e)
 
@@ -391,6 +403,8 @@ class InferenceCore:
     async def _infer_traced_entry(
         self, model: Model, request: InferRequest
     ) -> InferResponse:
+        from .trace import reset_current_trace, set_current_trace
+
         trace = self.tracer.maybe_start(
             model.name, request.model_version or "1",
             client_request_id=request.client_request_id,
@@ -399,12 +413,37 @@ class InferenceCore:
             return await self._infer_traced(model, request, None)
         trace.ts("REQUEST_START", request.arrival_ns)
         trace.ts("QUEUE_START", request.arrival_ns)
+        # the root opens at the frontend's wire-receive time when stamped
+        # (arrival_ns is construction time, mid-decode — the DECODE child
+        # must nest inside the root envelope)
+        root_start = request.arrival_ns
+        if request.decode_start_ns:
+            root_start = min(root_start, request.decode_start_ns)
+        trace.begin_root(root_start)
+        if request.decode_end_ns:
+            trace.add_span("DECODE", request.decode_start_ns,
+                           request.decode_end_ns)
+        # visible to synchronous helpers deep in this task (shm staging
+        # transfers, request-scoped log lines) without threading a parameter
+        token = set_current_trace(trace)
         try:
-            return await self._infer_traced(model, request, trace)
+            resp = await self._infer_traced(model, request, trace)
+        except BaseException:
+            # errors close and emit here — no response carries the handoff
+            trace.finish()
+            await asyncio.get_running_loop().run_in_executor(None, trace.emit)
+            raise
         finally:
-            trace.ts("REQUEST_END")
+            reset_current_trace(token)
+        if request.trace_handoff:
+            # the frontend owns finalization: it records SERIALIZE /
+            # NETWORK_WRITE spans, then closes the envelope and emits
+            resp.trace = trace
+        else:
+            trace.finish()
             # file append runs off-loop: only the traced request pays for it
             await asyncio.get_running_loop().run_in_executor(None, trace.emit)
+        return resp
 
     async def _infer_traced(
         self, model: Model, request: InferRequest, trace
@@ -429,13 +468,16 @@ class InferenceCore:
                         _batch_count(cached) or 1,
                         time.monotonic_ns() - request.arrival_ns, 0, ok=True)
                     if trace is not None:
-                        trace.ts("CACHE_HIT")
+                        now = time.monotonic_ns()
+                        trace.ts("CACHE_HIT", now)
+                        trace.add_span("QUEUE", request.arrival_ns, now)
                     return self._build_response(model, request, dict(cached))
         if isinstance(model, EnsembleModel):
             t0 = time.monotonic_ns()
             queue_ns = t0 - request.arrival_ns
             if trace is not None:
                 trace.ts("COMPUTE_START", t0)
+                trace.add_span("QUEUE", request.arrival_ns, t0)
             try:
                 outputs = await self._run_ensemble(model, inputs, params)
             except Exception:
@@ -444,12 +486,15 @@ class InferenceCore:
             compute_ns = time.monotonic_ns() - t0
             if trace is not None:
                 trace.ts("COMPUTE_END", t0 + compute_ns)
+                trace.add_span("COMPUTE", t0, t0 + compute_ns)
             model.stats.record(
                 _batch_count(inputs) or 1, queue_ns, compute_ns, ok=True)
         elif self._use_batcher(model, request):
-            # Batched execution: COMPUTE spans belong to the shared batch, not
-            # this request — the trace carries the request-level envelope only.
-            outputs = await self._batcher(model).submit(inputs, params)
+            # Batched execution: the batcher records this request's QUEUE /
+            # BATCH_ASSEMBLY spans and the shared batch's COMPUTE window
+            # (every traced member of a batch carries the same COMPUTE span).
+            outputs = await self._batcher(model).submit(inputs, params,
+                                                        trace=trace)
         else:
             # Outputs bound to slot-backed (in-process) xla-shm regions stay
             # device-resident — zero-copy handoff into the region.  Staging
@@ -464,9 +509,11 @@ class InferenceCore:
             queue_ns = t0 - request.arrival_ns
             if trace is not None:
                 trace.ts("COMPUTE_START", t0)
+                trace.add_span("QUEUE", request.arrival_ns, t0)
             try:
                 outputs = await self._run_model(
-                    model, inputs, params, keep_device=keep_device)
+                    model, inputs, params, keep_device=keep_device,
+                    traces=(trace,) if trace is not None else ())
             except InferError:
                 model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
                 raise
@@ -701,7 +748,7 @@ class InferenceCore:
             await asyncio.gather(*list(b._batch_tasks),
                                  return_exceptions=True)
         while not b._queue.empty():
-            _inputs, _params, fut, _ts = b._queue.get_nowait()
+            _inputs, _params, fut, _ts, _trace = b._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(InferError(reason, 503))
 
@@ -714,6 +761,7 @@ class InferenceCore:
     async def _run_model(
         self, model: Model, inputs, params,
         keep_device: Optional[Set[str]] = None,
+        traces=(),
     ) -> Dict[str, Any]:
         """Execute on a thread-pool worker so the event loop keeps serving.
 
@@ -728,18 +776,33 @@ class InferenceCore:
 
         Exception: sub-millisecond host-placed models with pure wire IO run
         INLINE once their shape signature is warm (see ``_InlineProfile``) —
-        for those the executor round trip dominates the compute."""
+        for those the executor round trip dominates the compute.
+
+        ``traces``: TraceContexts of sampled requests riding this execution
+        (one for the direct path, every traced member for a batch) — each
+        gets a COMPUTE span for the execute window and, when host
+        resolution happens, a D2H_TRANSFER span for the readback drain."""
         loop = asyncio.get_running_loop()
 
         def _exec():
+            t_c0 = time.monotonic_ns() if traces else 0
             outputs = model.execute(inputs, params)
+            if traces:
+                t_c1 = time.monotonic_ns()
+                for t in traces:
+                    t.add_span("COMPUTE", t_c0, t_c1)
             if keep_device is None:
                 return outputs
             for n, v in outputs.items():
                 if n not in keep_device and hasattr(v, "copy_to_host_async"):
                     v.copy_to_host_async()
-            return {n: (v if n in keep_device else np.asarray(v))
-                    for n, v in outputs.items()}
+            resolved = {n: (v if n in keep_device else np.asarray(v))
+                        for n, v in outputs.items()}
+            if traces:
+                t_d1 = time.monotonic_ns()
+                for t in traces:
+                    t.add_span("D2H_TRANSFER", t_c1, t_d1)
+            return resolved
 
         prof = None
         if keep_device is not None and not keep_device \

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import math
+import time
 from typing import Any, Dict, Optional
 
 import grpc
@@ -447,24 +448,36 @@ class InferenceServicer:
 
     async def ModelInfer(self, request, context):
         try:
+            t_recv = time.monotonic_ns()
             req = _decode_pb_request(request)
             _read_trace_metadata(req, context)
+            # span tracing: proto decode is the DECODE child span
+            # (arrival_ns stays at construction — queue statistics must not
+            # absorb proto-decode time); this frontend finalizes so
+            # SERIALIZE/NETWORK_WRITE land in the trace
+            req.decode_start_ns = t_recv
+            req.decode_end_ns = time.monotonic_ns()
+            req.trace_handoff = True
             resp = await self._core.infer(req)
         except InferError as e:
+            rid = getattr(req, "client_request_id", "") \
+                if "req" in locals() else ""
             if e.http_status >= 500:
                 self._log_off_loop(
                     self._core.log.error,
-                    f"grpc ModelInfer '{request.model_name}' failed: {e}")
+                    f"grpc ModelInfer '{request.model_name}' failed: {e}",
+                    rid)
             elif self._core.log.verbose_enabled():
                 self._log_off_loop(
                     self._core.log.verbose, 1,
                     f"grpc ModelInfer '{request.model_name}' -> "
-                    f"{e.http_status}: {e}")
+                    f"{e.http_status}: {e}", rid)
             await context.abort(_grpc_code(e), str(e))
         if self._core.log.verbose_enabled():
             self._log_off_loop(
                 self._core.log.verbose, 1,
-                f"grpc ModelInfer '{request.model_name}' -> OK")
+                f"grpc ModelInfer '{request.model_name}' -> OK",
+                req.client_request_id)
         if req.client_request_id:
             # echo the correlation id in trailing metadata (the response
             # parameters carry it too, for clients that never see metadata)
@@ -473,7 +486,24 @@ class InferenceServicer:
                     (("triton-request-id", req.client_request_id),))
             except Exception:
                 pass  # metadata already sent / transport gone
-        return _encode_pb_response(resp)
+        trace = resp.trace
+        try:
+            t_ser0 = time.monotonic_ns() if trace is not None else 0
+            pb_resp = _encode_pb_response(resp)
+            if trace is not None:
+                t_ser1 = time.monotonic_ns()
+                trace.add_span("SERIALIZE", t_ser0, t_ser1)
+                # grpc.aio serializes+writes after the handler returns; this
+                # span covers the handoff work still visible from here
+                trace.add_span("NETWORK_WRITE", t_ser1, time.monotonic_ns())
+        finally:
+            if trace is not None:
+                trace.finish()
+                # awaited: the trace file is readable the moment the client
+                # gets its response (same contract as the HTTP frontend)
+                await asyncio.get_running_loop().run_in_executor(
+                    None, trace.emit)
+        return pb_resp
 
     async def ModelStreamInfer(self, request_iterator, context):
         """Bidi stream: requests arrive as they're sent; each produces one or

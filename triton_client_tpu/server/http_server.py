@@ -15,6 +15,7 @@ import binascii
 import gzip
 import json
 import math
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -121,12 +122,16 @@ def _h(core: InferenceCore, fn):
         asyncio.get_running_loop().run_in_executor(None, method, *args)
 
     async def handler(request: web.Request) -> web.Response:
+        # propagated correlation id rides every log line for this request
+        # (passed explicitly: the executor hop would lose a contextvar)
+        rid = request.headers.get(_REQUEST_ID_HDR, "")
         try:
             resp = await fn(core, request)
             if core.log.verbose_enabled():
                 _log_off_loop(
                     core.log.verbose, 1,
-                    f"{request.method} {request.path} -> {resp.status}")
+                    f"{request.method} {request.path} -> {resp.status}",
+                    rid)
             return resp
         except InferError as e:
             # 5xx are server-side failures (log_error); 4xx are client
@@ -135,19 +140,19 @@ def _h(core: InferenceCore, fn):
             if e.http_status >= 500:
                 _log_off_loop(
                     core.log.error,
-                    f"{request.method} {request.path} failed: {e}")
+                    f"{request.method} {request.path} failed: {e}", rid)
             elif core.log.verbose_enabled():
                 _log_off_loop(
                     core.log.verbose, 1,
                     f"{request.method} {request.path} -> "
-                    f"{e.http_status}: {e}")
+                    f"{e.http_status}: {e}", rid)
             return web.json_response({"error": str(e)}, status=e.http_status)
         except web.HTTPException:
             raise
         except Exception as e:  # pragma: no cover - defensive
             _log_off_loop(
                 core.log.error,
-                f"{request.method} {request.path} crashed: {e}")
+                f"{request.method} {request.path} crashed: {e}", rid)
             return web.json_response({"error": str(e)}, status=500)
 
     return handler
@@ -432,6 +437,7 @@ async def _shm_unregister(core, request):
 
 
 async def _infer(core, request: web.Request) -> web.Response:
+    t_recv = time.monotonic_ns()
     # aiohttp inflates gzip/deflate request bodies transparently.
     raw = await request.read()
 
@@ -456,21 +462,46 @@ async def _infer(core, request: web.Request) -> web.Response:
     # case-insensitive in aiohttp) so the tracer can join client and server
     req.client_request_id = request.headers.get(_REQUEST_ID_HDR, "")
     req.traceparent = request.headers.get(_TRACEPARENT_HDR, "")
+    # span tracing: the read+parse window becomes the DECODE child span
+    # (arrival_ns is left at construction time — queue statistics must not
+    # absorb a slow client's body upload), and this frontend finalizes the
+    # trace so SERIALIZE/NETWORK_WRITE land in it
+    req.decode_start_ns = t_recv
+    req.decode_end_ns = time.monotonic_ns()
+    req.trace_handoff = True
     resp = await core.infer(req)
-    default_binary = bool(
-        req.parameters.get("binary_data_output", header_len is not None)
-    )
-    payload, json_len = _encode_response(resp, req, default_binary)
-    headers = {_HEADER_LEN: str(json_len)}
-    if req.client_request_id:
-        headers[_REQUEST_ID_HDR] = req.client_request_id
-    accept = request.headers.get("Accept-Encoding", "")
-    if "gzip" in accept and len(payload) > 1024:
-        payload = gzip.compress(payload)
-        headers["Content-Encoding"] = "gzip"
-    return web.Response(
-        body=payload, headers=headers, content_type="application/octet-stream"
-    )
+    trace = resp.trace
+    try:
+        t_ser0 = time.monotonic_ns() if trace is not None else 0
+        default_binary = bool(
+            req.parameters.get("binary_data_output", header_len is not None)
+        )
+        payload, json_len = _encode_response(resp, req, default_binary)
+        if trace is not None:
+            t_ser1 = time.monotonic_ns()
+            trace.add_span("SERIALIZE", t_ser0, t_ser1)
+        headers = {_HEADER_LEN: str(json_len)}
+        if req.client_request_id:
+            headers[_REQUEST_ID_HDR] = req.client_request_id
+        accept = request.headers.get("Accept-Encoding", "")
+        if "gzip" in accept and len(payload) > 1024:
+            payload = gzip.compress(payload)
+            headers["Content-Encoding"] = "gzip"
+        response = web.Response(
+            body=payload, headers=headers,
+            content_type="application/octet-stream"
+        )
+        if trace is not None:
+            # compression + response assembly up to the transport handoff
+            # (aiohttp writes the socket after the handler returns)
+            trace.add_span("NETWORK_WRITE", t_ser1, time.monotonic_ns())
+    finally:
+        if trace is not None:
+            trace.finish()
+            # awaited so the record is on disk before the client sees the
+            # response — trace files stay read-after-infer deterministic
+            await asyncio.get_running_loop().run_in_executor(None, trace.emit)
+    return response
 
 
 def _decode_request(

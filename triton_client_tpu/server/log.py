@@ -13,6 +13,12 @@ Line shapes follow the reference server:
 * ``default``:  ``I0731 12:34:56.789012 model 'simple' loaded``
   (level letter, MMDD, wall clock with microseconds)
 * ``ISO8601``:  ``2026-07-31T12:34:56Z I model 'simple' loaded``
+* ``json``:     one object per line — ``{"level": "info", "ts": <epoch
+  seconds>, "msg": "...", "request_id": "..."}`` — with ``request_id``
+  present when the line was emitted inside a traced request (explicitly
+  passed by the frontends, or picked up from the request's live
+  ``TraceContext``), so structured logs join trace files on the same
+  ``triton-request-id`` key.
 
 ``log_file`` empty (the default) writes to stderr; a path appends, with
 the handle cached and reopened on change (same pattern as the tracer).
@@ -23,52 +29,17 @@ plain int compare, so verbosity off costs one dict lookup.
 
 from __future__ import annotations
 
+import json
 import sys
-import threading
 import time
 from typing import Any, Dict
 
+# one cached-append-handle state machine for the whole codebase: defined in
+# the (dependency-light) telemetry module, re-exported here for the server
+# side's existing importers (trace.py does `from .log import AppendFile`)
+from .._telemetry import AppendFile  # noqa: F401 — re-export
+
 _LEVELS = ("info", "warning", "error")
-
-
-class AppendFile:
-    """Cached append handle, reopened when the configured path changes —
-    shared by the server log and the request tracer so the
-    open-on-change/close-on-shutdown/failure-drop state machine exists
-    once.  A failing write must never raise (the request that happened to
-    log/trace must not fail) and must CLOSE the handle before dropping it
-    (dropping without close leaks one fd per attempt against a full disk
-    until accept() dies with EMFILE)."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._file = None
-        self._path = None
-
-    def append(self, path: str, data: str) -> None:
-        with self._lock:
-            try:
-                if self._file is None or self._path != path:
-                    self._close_locked()
-                    self._file = open(path, "a")
-                    self._path = path
-                self._file.write(data)
-                self._file.flush()
-            except OSError:
-                self._close_locked()
-
-    def _close_locked(self) -> None:
-        if self._file is not None:
-            try:
-                self._file.close()
-            except OSError:
-                pass
-            self._file = None
-            self._path = None
-
-    def close(self) -> None:
-        with self._lock:
-            self._close_locked()
 
 
 class ServerLog:
@@ -80,19 +51,19 @@ class ServerLog:
         self._out = AppendFile()
 
     # -- public levels -----------------------------------------------------
-    def info(self, msg: str) -> None:
-        self._emit("info", msg)
+    def info(self, msg: str, request_id: str = "") -> None:
+        self._emit("info", msg, request_id)
 
-    def warning(self, msg: str) -> None:
-        self._emit("warning", msg)
+    def warning(self, msg: str, request_id: str = "") -> None:
+        self._emit("warning", msg, request_id)
 
-    def error(self, msg: str) -> None:
-        self._emit("error", msg)
+    def error(self, msg: str, request_id: str = "") -> None:
+        self._emit("error", msg, request_id)
 
-    def verbose(self, level: int, msg: str) -> None:
+    def verbose(self, level: int, msg: str, request_id: str = "") -> None:
         try:
             if int(self._settings.get("log_verbose_level", 0)) >= level:
-                self._emit("info", msg)
+                self._emit("info", msg, request_id)
         except (TypeError, ValueError):
             pass
 
@@ -104,11 +75,34 @@ class ServerLog:
             return False
 
     # -- plumbing ----------------------------------------------------------
-    def _emit(self, level: str, msg: str) -> None:
+    @staticmethod
+    def _request_id_fallback() -> str:
+        """The correlation id of the request being served in this context,
+        when a traced request is live (log lines emitted synchronously
+        inside the serving task join the trace without the caller passing
+        the id)."""
+        try:
+            from .trace import current_trace
+
+            trace = current_trace()
+            if trace is not None:
+                return trace.client_request_id or str(trace.id)
+        except Exception:
+            pass
+        return ""
+
+    def _emit(self, level: str, msg: str, request_id: str = "") -> None:
         if not bool(self._settings.get(f"log_{level}", True)):
             return
         now = time.time()
-        if str(self._settings.get("log_format", "default")) == "ISO8601":
+        fmt = str(self._settings.get("log_format", "default"))
+        if fmt == "json":
+            record: Dict[str, Any] = {"level": level, "ts": now, "msg": msg}
+            rid = request_id or self._request_id_fallback()
+            if rid:
+                record["request_id"] = rid
+            line = json.dumps(record) + "\n"
+        elif fmt == "ISO8601":
             stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
             line = f"{stamp} {level[0].upper()} {msg}\n"
         else:

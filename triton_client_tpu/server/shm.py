@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -241,10 +242,18 @@ class XlaShmRegistry:
                 # array — no host copy, no DMA
                 self.stats["cache_hits"] += 1
                 return cached[1]
+        from .trace import current_trace
+
         host = sysshm.get_contents_as_numpy(
             region.staging_handle, dt, list(shape), offset=ref.offset
         )
+        trace = current_trace()
+        t0 = time.monotonic_ns() if trace is not None else 0
         arr = jax.device_put(np.array(host, copy=True))
+        if trace is not None:
+            # the one host->device DMA a cross-process region costs per
+            # import — the span the zero-copy slot path never records
+            trace.add_span("H2D_TRANSFER", t0, time.monotonic_ns())
         self.stats["staging_imports"] += 1
         if key is not None:
             region.cache = (key, arr)
@@ -270,7 +279,15 @@ class XlaShmRegistry:
             host_dt = np.dtype(arr.dtype)
             region.slot.bind(arr, np_to_triton_dtype(host_dt), tuple(arr.shape))
             return nbytes
+        from .trace import current_trace
+
+        trace = current_trace()
+        t0 = time.monotonic_ns() if trace is not None else 0
         host = np.asarray(data)
+        if trace is not None and not isinstance(data, np.ndarray):
+            # device-resident output resolving into a staging region: the
+            # np.asarray above was a blocking device->host readback
+            trace.add_span("D2H_TRANSFER", t0, time.monotonic_ns())
         if host.nbytes > ref.byte_size:
             raise InferError(
                 f"shared memory region '{ref.region_name}' too small for output"

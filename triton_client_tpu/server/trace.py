@@ -13,11 +13,24 @@ to ``trace_file``.
 File format: JSON Lines — each line is one complete object,
 
     {"id": 7, "model_name": "simple", "model_version": "1",
-     "timestamps": [{"name": "REQUEST_START", "ns": ...}, ...]}
+     "timestamps": [{"name": "REQUEST_START", "ns": ...}, ...],
+     "spans": [{"name": "REQUEST", "start_ns": ..., "end_ns": ...,
+                "parent": null},
+               {"name": "COMPUTE", "start_ns": ..., "end_ns": ...,
+                "parent": "REQUEST"}, ...]}
 
-mirroring the timestamp-list shape of Triton's trace summary input.  An
+``timestamps`` mirrors the flat timestamp-list shape of Triton's trace
+summary input and is kept for existing consumers; ``spans`` is the
+Dapper/OpenTelemetry-style span tree recorded by the instrumentation points
+in the core, the dynamic batcher, the shm staging paths, and both frontends
+(root span ``REQUEST``; children among DECODE, QUEUE, BATCH_ASSEMBLY,
+H2D_TRANSFER, COMPUTE, D2H_TRANSFER, SERIALIZE, NETWORK_WRITE).  The
+``triton_client_tpu.tools.trace_summary`` CLI consumes either shape.  An
 append-per-request stream (rather than one rewritten JSON array) keeps the
 file well-formed at every instant and safe under concurrent writers.
+``log_frequency`` > 0 rotates the stream into ``<trace_file>.0``,
+``<trace_file>.1``, … with that many traces per file (reference server
+contract); 0 (the default) appends to the single configured file forever.
 
 ``trace_level`` semantics:
 
@@ -38,6 +51,7 @@ Timestamps use ``time.monotonic_ns()`` — the same clock as request
 
 from __future__ import annotations
 
+import contextvars
 import json
 import threading
 from typing import Dict, List, Optional
@@ -47,6 +61,28 @@ import time
 from .types import InferError
 
 _KNOWN_LEVELS = {"OFF", "TIMESTAMPS", "TENSORS", "PROFILE"}
+
+#: The trace context of the request currently being served on this task (or
+#: thread, for synchronous helpers called from it).  Set by the core around a
+#: traced request so deep layers that never see the request object — the shm
+#: staging paths, model code calling the server log — can attach spans /
+#: correlate log lines without plumbing a parameter through every signature.
+_CURRENT_TRACE: "contextvars.ContextVar[Optional[TraceContext]]" = \
+    contextvars.ContextVar("triton_tpu_current_trace", default=None)
+
+
+def current_trace() -> Optional["TraceContext"]:
+    """The TraceContext of the request being served in this context, if the
+    request was sampled for tracing (None otherwise)."""
+    return _CURRENT_TRACE.get()
+
+
+def set_current_trace(ctx: Optional["TraceContext"]):
+    return _CURRENT_TRACE.set(ctx)
+
+
+def reset_current_trace(token) -> None:
+    _CURRENT_TRACE.reset(token)
 
 #: Server defaults — a ``null``/empty update value clears a key back to these
 #: (reference update_trace_settings contract).
@@ -110,20 +146,41 @@ def validate_trace_update(settings: Dict[str, List[str]],
                 raise InferError("trace_rate must be positive", http_status=400)
 
 
+class Span:
+    """One interval in a traced request's span tree.  ``end()`` may run on a
+    different thread than the creator (the executor resolves D2H there);
+    attribute stores are GIL-atomic, so no lock is needed."""
+
+    __slots__ = ("name", "start_ns", "end_ns", "parent")
+
+    def __init__(self, name: str, start_ns: int,
+                 parent: Optional[str] = "REQUEST") -> None:
+        self.name = name
+        self.start_ns = int(start_ns)
+        self.end_ns: Optional[int] = None
+        self.parent = parent
+
+    def end(self, ns: Optional[int] = None) -> None:
+        self.end_ns = int(ns if ns is not None else time.monotonic_ns())
+
+
 class TraceContext:
-    """One traced request: collects (name, ns) timestamps, emitted on finish.
-    ``path`` is the trace_file of the scope that sampled this request (a
-    per-model override may point somewhere else than the global file).
-    ``client_request_id``/``traceparent`` carry the client-propagated trace
-    context (``triton-request-id`` header / gRPC metadata) so the emitted
-    record joins with client-side telemetry on one id."""
+    """One traced request: collects (name, ns) timestamps plus a span tree,
+    emitted on finish.  ``path`` is the trace_file of the scope that sampled
+    this request (a per-model override may point somewhere else than the
+    global file).  ``client_request_id``/``traceparent`` carry the
+    client-propagated trace context (``triton-request-id`` header / gRPC
+    metadata) so the emitted record joins with client-side telemetry on one
+    id."""
 
     __slots__ = ("_tracer", "id", "model_name", "model_version",
-                 "timestamps", "path", "client_request_id", "traceparent")
+                 "timestamps", "path", "client_request_id", "traceparent",
+                 "spans", "log_frequency", "_root", "_done")
 
     def __init__(self, tracer: "RequestTracer", trace_id: int,
                  model_name: str, model_version: str, path: str,
-                 client_request_id: str = "", traceparent: str = "") -> None:
+                 client_request_id: str = "", traceparent: str = "",
+                 log_frequency: int = 0) -> None:
         self._tracer = tracer
         self.id = trace_id
         self.model_name = model_name
@@ -132,13 +189,52 @@ class TraceContext:
         self.path = path
         self.client_request_id = client_request_id
         self.traceparent = traceparent
+        self.spans: List[Span] = []
+        self.log_frequency = log_frequency
+        self._root: Optional[Span] = None
+        self._done = False
 
     def ts(self, name: str, ns: Optional[int] = None) -> None:
         self.timestamps.append(
             {"name": name, "ns": int(ns if ns is not None else time.monotonic_ns())}
         )
 
+    # -- span tree ---------------------------------------------------------
+    def begin_root(self, start_ns: int) -> Span:
+        """Open the REQUEST root span; every later span nests inside it."""
+        self._root = Span("REQUEST", start_ns, parent=None)
+        self.spans.append(self._root)
+        return self._root
+
+    def begin_span(self, name: str, start_ns: Optional[int] = None,
+                   parent: Optional[str] = "REQUEST") -> Span:
+        span = Span(name,
+                    start_ns if start_ns is not None else time.monotonic_ns(),
+                    parent)
+        self.spans.append(span)
+        return span
+
+    def add_span(self, name: str, start_ns: int, end_ns: int,
+                 parent: Optional[str] = "REQUEST") -> Span:
+        span = Span(name, start_ns, parent)
+        span.end(end_ns)
+        self.spans.append(span)
+        return span
+
+    def finish(self) -> None:
+        """Close the REQUEST envelope (timestamp + root span).  Idempotent:
+        the core closes on the error path, a finalizing frontend closes on
+        success — whichever runs first wins."""
+        if self._done:
+            return
+        self._done = True
+        now = time.monotonic_ns()
+        self.ts("REQUEST_END", now)
+        if self._root is not None and self._root.end_ns is None:
+            self._root.end(now)
+
     def emit(self) -> None:
+        self.finish()
         self._tracer._emit(self)
 
 
@@ -164,6 +260,12 @@ class RequestTracer:
         self._seq = 0          # requests seen since last settings update
         self._emitted = 0      # traces emitted since last settings update
         self._next_id = 0      # file-unique trace id — never reset
+        # log_frequency rotation state per base path: {"count": traces in
+        # the current indexed file, "index": current file suffix}.  The
+        # index is monotonic for the tracer's lifetime — a settings refresh
+        # must never rewind it and overwrite an already-written .0 file.
+        self._rot_lock = threading.Lock()
+        self._rotation: Dict[str, Dict[str, int]] = {}
         self._profiling = False
         # per-model overlays (reference per-model trace settings: a model
         # may override any key; unset keys inherit the global value); each
@@ -290,8 +392,10 @@ class RequestTracer:
             self._next_id += 1
             trace_id = self._next_id
             path = self._trace_file(eff)
+            log_frequency = max(0, self._eff_int(eff, "log_frequency", 0))
         return TraceContext(self, trace_id, model_name, model_version, path,
-                            client_request_id, traceparent)
+                            client_request_id, traceparent,
+                            log_frequency=log_frequency)
 
     def _emit(self, ctx: TraceContext) -> None:
         record = {
@@ -300,6 +404,17 @@ class RequestTracer:
             "model_version": ctx.model_version,
             "timestamps": ctx.timestamps,
         }
+        if ctx.spans:
+            # span tree alongside — never instead of — the legacy shape:
+            # existing consumers keep reading "timestamps" unchanged
+            record["spans"] = [
+                {"name": s.name, "start_ns": s.start_ns,
+                 # an unclosed span (instrumentation raced shutdown) emits
+                 # as a point rather than poisoning the record
+                 "end_ns": s.end_ns if s.end_ns is not None else s.start_ns,
+                 "parent": s.parent}
+                for s in ctx.spans
+            ]
         # propagated client trace context: the join key between this record
         # and the client's telemetry (absent keys = request was not stamped)
         if ctx.client_request_id:
@@ -310,4 +425,19 @@ class RequestTracer:
         # ctx.path is the sampling scope's file, not necessarily global;
         # an unwritable trace_file must never fail the inference that
         # happened to be sampled (AppendFile swallows OSError)
-        self._out.append(ctx.path, line + "\n")
+        self._out.append(self._rotated_path(ctx), line + "\n")
+
+    def _rotated_path(self, ctx: TraceContext) -> str:
+        """The file this trace lands in: the configured path itself when
+        ``log_frequency`` is 0, else ``<path>.<index>`` with the index
+        advancing every ``log_frequency`` emitted traces (reference server
+        rotation contract)."""
+        if ctx.log_frequency <= 0:
+            return ctx.path
+        with self._rot_lock:
+            st = self._rotation.setdefault(ctx.path, {"count": 0, "index": 0})
+            if st["count"] >= ctx.log_frequency:
+                st["index"] += 1
+                st["count"] = 0
+            st["count"] += 1
+            return f"{ctx.path}.{st['index']}"

@@ -54,6 +54,17 @@ class InferRequest:
     # the tracer records them and the response echoes the id back.
     client_request_id: str = ""
     traceparent: str = ""
+    # Wire-decode window (span tracing): the frontend stamps when it began
+    # and finished decoding the wire request so a sampled trace gets a
+    # DECODE child span.  0 = frontend did not instrument decode.
+    decode_start_ns: int = 0
+    decode_end_ns: int = 0
+    # A frontend that sets this owns trace finalization: the core hands the
+    # sampled TraceContext back on the response (InferResponse.trace) so
+    # SERIALIZE/NETWORK_WRITE spans land inside the emitted record.  Paths
+    # that never finalize (generate, OpenAI, streaming) leave it False and
+    # the core emits at the end of its own envelope, as before.
+    trace_handoff: bool = False
     # Filled by the core:
     arrival_ns: int = field(default_factory=lambda: time.monotonic_ns())
 
@@ -90,6 +101,9 @@ class InferResponse:
     id: str = ""
     outputs: List[OutputTensor] = field(default_factory=list)
     parameters: Dict[str, Any] = field(default_factory=dict)
+    # Sampled TraceContext handed to a finalizing frontend (see
+    # InferRequest.trace_handoff); never serialized onto the wire.
+    trace: Any = None
 
 
 class InferError(Exception):

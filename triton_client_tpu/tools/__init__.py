@@ -1,0 +1,7 @@
+"""Operator-facing CLI tools riding the library (no server required).
+
+``trace_summary`` is the canonical consumer of the server's trace files
+(the reference repo's ``src/python/examples/trace_summary.py`` analog):
+per-model/per-stage latency breakdowns, client/server trace joins, and
+Chrome trace-event export for Perfetto.
+"""

@@ -1,0 +1,375 @@
+"""Trace-file analyzer (``triton-trace-summary``).
+
+The reference client repo ships ``src/python/examples/trace_summary.py`` as
+the canonical consumer of Triton's trace files; this is its analog for the
+TPU harness, upgraded for the span-structured records ``RequestTracer``
+emits (and still able to digest the legacy flat-timestamp shape).
+
+    python -m triton_client_tpu.tools.trace_summary server.json
+    python -m triton_client_tpu.tools.trace_summary server.json \
+        --client client.json            # join on triton-request-id
+    python -m triton_client_tpu.tools.trace_summary server.json \
+        --format chrome -o trace.chrome.json   # load in Perfetto / chrome://tracing
+
+Inputs are JSON Lines:
+
+* **server file** — one object per traced request, written by the server's
+  ``RequestTracer`` (``trace_level=TIMESTAMPS`` via the trace-settings API).
+  Span-structured records carry ``"spans": [{"name", "start_ns", "end_ns",
+  "parent"}, ...]`` with a ``REQUEST`` root; legacy records carry only
+  ``"timestamps"`` and get REQUEST/QUEUE/COMPUTE derived from the pairs.
+* **client file** — one object per inference, written by
+  ``telemetry().enable_tracing(path)`` in any of the four Python clients:
+  ``{"request_id", "model", "protocol", "spans": [SERIALIZE, NETWORK,
+  DESERIALIZE, ...]}``.
+
+The two files join on the propagated ``triton-request-id`` (the server
+record's ``triton_request_id`` key).  The clocks are different processes'
+monotonic clocks, so the join compares **durations** only: network overhead
+= client REQUEST duration − server REQUEST duration (wire + client stack
+time that never shows up server-side).
+
+stdlib-only on purpose: the tool must run (and ``--help`` must exit 0) in an
+environment with none of the optional client deps installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: Server-side stages in reporting order (the span taxonomy emitted by the
+#: instrumentation points; see docs/ARCHITECTURE.md "Tracing").
+SERVER_STAGES = (
+    "DECODE",
+    "QUEUE",
+    "BATCH_ASSEMBLY",
+    "H2D_TRANSFER",
+    "COMPUTE",
+    "D2H_TRANSFER",
+    "SERIALIZE",
+    "NETWORK_WRITE",
+)
+#: Client-side stages recorded by the instrumented clients.
+CLIENT_STAGES = ("SERIALIZE", "NETWORK", "DESERIALIZE")
+
+
+def load_trace_file(path: str) -> List[dict]:
+    """Parse a JSON-Lines trace file; blank lines are skipped, a malformed
+    line fails loudly with its line number (a silently-dropped record would
+    skew every percentile below)."""
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{lineno}: not valid JSON: {e}")
+            if not isinstance(rec, dict):
+                raise ValueError(f"{path}:{lineno}: trace record must be an "
+                                 "object")
+            records.append(rec)
+    return records
+
+
+def record_spans(rec: dict) -> List[Tuple[str, int, int]]:
+    """(name, start_ns, end_ns) intervals of one record.  Span-structured
+    records are used as-is; legacy records derive REQUEST and COMPUTE from
+    their ``*_START``/``*_END`` timestamp pairs and QUEUE from
+    QUEUE_START→COMPUTE_START (the legacy shape never wrote a QUEUE_END)."""
+    spans = rec.get("spans")
+    if spans:
+        return [(s["name"], int(s["start_ns"]), int(s["end_ns"]))
+                for s in spans]
+    ts: Dict[str, int] = {}
+    for t in rec.get("timestamps", []):
+        ts.setdefault(str(t["name"]), int(t["ns"]))
+    out: List[Tuple[str, int, int]] = []
+    for name in {n[: -len("_START")] for n in ts if n.endswith("_START")}:
+        start = ts.get(name + "_START")
+        end = ts.get(name + "_END")
+        if end is None and name == "QUEUE":
+            end = ts.get("COMPUTE_START")
+        if start is not None and end is not None:
+            out.append((name, start, end))
+    out.sort(key=lambda s: (s[1], s[0]))
+    return out
+
+
+def percentile(sorted_vals: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile over an already-sorted sequence."""
+    if not sorted_vals:
+        return float("nan")
+    rank = max(1, math.ceil(p / 100.0 * len(sorted_vals)))
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+
+
+def _stage_stats(durations_ns: List[int]) -> Dict[str, Any]:
+    vals = sorted(durations_ns)
+    n = len(vals)
+    if not n:
+        # None, not NaN: summaries embed into strict-JSON exports
+        # (perf_analyzer --export-metrics, bench.py)
+        return {"count": 0, "mean_us": None, "p50_us": None,
+                "p90_us": None, "p99_us": None}
+    return {
+        "count": n,
+        "mean_us": (sum(vals) / n) / 1e3,
+        "p50_us": percentile(vals, 50) / 1e3,
+        "p90_us": percentile(vals, 90) / 1e3,
+        "p99_us": percentile(vals, 99) / 1e3,
+    }
+
+
+def summarize(server_records: List[dict],
+              client_records: Optional[List[dict]] = None) -> Dict[str, Any]:
+    """Aggregate trace records into the summary structure the text renderer
+    (and ``--format json``) prints: per-model stage stats, queue share, and
+    — when a client file is joined — network-overhead stats."""
+    models: Dict[str, Dict[str, Any]] = {}
+    per_model_stage: Dict[str, Dict[str, List[int]]] = {}
+    per_model_request: Dict[str, List[int]] = {}
+    for rec in server_records:
+        model = str(rec.get("model_name", "?"))
+        stages = per_model_stage.setdefault(model, {})
+        for name, start, end in record_spans(rec):
+            dur = max(0, end - start)
+            if name == "REQUEST":
+                per_model_request.setdefault(model, []).append(dur)
+            else:
+                stages.setdefault(name, []).append(dur)
+    for model, stages in per_model_stage.items():
+        requests = per_model_request.get(model, [])
+        total_request_ns = sum(requests)
+        stage_out: Dict[str, Any] = {}
+        order = [s for s in SERVER_STAGES if s in stages] + sorted(
+            s for s in stages if s not in SERVER_STAGES)
+        for name in order:
+            st = _stage_stats(stages[name])
+            st["share_pct"] = (100.0 * sum(stages[name]) / total_request_ns
+                               if total_request_ns else None)
+            stage_out[name] = st
+        entry: Dict[str, Any] = {
+            "count": len(requests) or max(
+                (len(v) for v in stages.values()), default=0),
+            "request": _stage_stats(requests),
+            "stages": stage_out,
+        }
+        if "QUEUE" in stage_out:
+            entry["queue_share_pct"] = stage_out["QUEUE"]["share_pct"]
+        models[model] = entry
+    summary: Dict[str, Any] = {
+        "requests": len(server_records),
+        "models": {m: models[m] for m in sorted(models)},
+    }
+    if client_records is not None:
+        summary["join"] = _join(server_records, client_records)
+    return summary
+
+
+def _join(server_records: List[dict],
+          client_records: List[dict]) -> Dict[str, Any]:
+    def request_dur(spans):
+        for name, start, end in spans:
+            if name == "REQUEST":
+                return max(0, end - start)
+        return None
+
+    client_by_id: Dict[str, dict] = {}
+    for rec in client_records:
+        rid = str(rec.get("request_id", ""))
+        if rid:
+            client_by_id.setdefault(rid, rec)
+    overhead_ns: List[int] = []
+    joined = 0
+    for rec in server_records:
+        rid = str(rec.get("triton_request_id", ""))
+        crec = client_by_id.get(rid)
+        if crec is None:
+            continue
+        joined += 1
+        sdur = request_dur(record_spans(rec))
+        cdur = request_dur(
+            [(s["name"], int(s["start_ns"]), int(s["end_ns"]))
+             for s in crec.get("spans", [])])
+        if sdur is not None and cdur is not None:
+            overhead_ns.append(cdur - sdur)
+    client_stages: Dict[str, List[int]] = {}
+    for rec in client_records:
+        for s in rec.get("spans", []):
+            name = str(s["name"])
+            if name == "REQUEST":
+                continue
+            client_stages.setdefault(name, []).append(
+                max(0, int(s["end_ns"]) - int(s["start_ns"])))
+    order = [s for s in CLIENT_STAGES if s in client_stages] + sorted(
+        s for s in client_stages if s not in CLIENT_STAGES)
+    return {
+        "client_requests": len(client_records),
+        "joined": joined,
+        # wire + client-stack time invisible to the server: the honest
+        # "how much latency is NOT the server" number
+        "network_overhead_us": _stage_stats(overhead_ns),
+        "client_stages": {name: _stage_stats(client_stages[name])
+                          for name in order},
+    }
+
+
+# -- text rendering ---------------------------------------------------------
+
+def _fmt_val(v) -> str:
+    return "-" if v is None or v != v else f"{v:.1f}"  # None/NaN-safe
+
+
+def _stage_table(rows: List[Tuple[str, Dict[str, float]]],
+                 share: bool) -> List[str]:
+    head = (f"  {'stage':<16}{'count':>7}{'mean_us':>12}{'p50_us':>12}"
+            f"{'p90_us':>12}{'p99_us':>12}")
+    if share:
+        head += f"{'share%':>9}"
+    lines = [head]
+    for name, st in rows:
+        line = (f"  {name:<16}{st['count']:>7}{_fmt_val(st['mean_us']):>12}"
+                f"{_fmt_val(st['p50_us']):>12}{_fmt_val(st['p90_us']):>12}"
+                f"{_fmt_val(st['p99_us']):>12}")
+        if share:
+            line += f"{_fmt_val(st.get('share_pct', float('nan'))):>9}"
+        lines.append(line)
+    return lines
+
+
+def format_text(summary: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    n_models = len(summary["models"])
+    lines.append(f"== server trace: {summary['requests']} request(s), "
+                 f"{n_models} model(s) ==")
+    for model, entry in summary["models"].items():
+        lines.append("")
+        lines.append(f"model={model}  requests={entry['count']}")
+        req = entry["request"]
+        lines.append(
+            f"  {'REQUEST':<16}{req['count']:>7}"
+            f"{_fmt_val(req['mean_us']):>12}{_fmt_val(req['p50_us']):>12}"
+            f"{_fmt_val(req['p90_us']):>12}{_fmt_val(req['p99_us']):>12}")
+        lines.extend(_stage_table(list(entry["stages"].items()), share=True))
+        if "queue_share_pct" in entry:
+            lines.append(
+                f"  queue share: "
+                f"{_fmt_val(entry['queue_share_pct'])}% of request time")
+    join = summary.get("join")
+    if join is not None:
+        lines.append("")
+        lines.append(
+            f"== client join: {join['joined']}/{summary['requests']} server "
+            f"trace(s) joined on request id ==")
+        ov = join["network_overhead_us"]
+        lines.append(
+            "  network overhead (client REQUEST - server REQUEST): "
+            f"count {ov['count']}  mean_us {_fmt_val(ov['mean_us'])}  "
+            f"p50_us {_fmt_val(ov['p50_us'])}  "
+            f"p99_us {_fmt_val(ov['p99_us'])}")
+        lines.extend(
+            _stage_table(list(join["client_stages"].items()), share=False))
+    return "\n".join(lines) + "\n"
+
+
+# -- Chrome trace-event export ----------------------------------------------
+
+def chrome_trace(server_records: List[dict],
+                 client_records: Optional[List[dict]] = None) -> Dict[str, Any]:
+    """Chrome trace-event JSON (the object form: ``{"traceEvents": [...]}``)
+    loadable in Perfetto / chrome://tracing.  Server and client records get
+    separate pids (their monotonic clocks do not align); timestamps are
+    rebased per source so the view starts at t=0."""
+    events: List[dict] = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "server"}},
+    ]
+
+    def emit(records, pid, tid_of, args_of):
+        starts = [s for rec in records for _, s, _ in record_spans(rec)]
+        base = min(starts) if starts else 0
+        for rec in records:
+            for name, start, end in record_spans(rec):
+                events.append({
+                    "name": name,
+                    "ph": "X",
+                    "ts": (start - base) / 1e3,       # microseconds
+                    "dur": max(0, end - start) / 1e3,
+                    "pid": pid,
+                    "tid": tid_of(rec),
+                    "cat": "server" if pid == 1 else "client",
+                    "args": args_of(rec),
+                })
+
+    emit(server_records, 1,
+         lambda rec: int(rec.get("id", 0)),
+         lambda rec: {"model": rec.get("model_name", ""),
+                      "request_id": rec.get("triton_request_id", "")})
+    if client_records is not None:
+        events.insert(1, {"ph": "M", "name": "process_name", "pid": 2,
+                          "args": {"name": "client"}})
+        tids: Dict[str, int] = {}
+
+        def client_tid(rec):
+            rid = str(rec.get("request_id", ""))
+            return tids.setdefault(rid, len(tids) + 1)
+
+        emit(client_records, 2, client_tid,
+             lambda rec: {"model": rec.get("model", ""),
+                          "request_id": rec.get("request_id", "")})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- CLI --------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="trace_summary",
+        description="Summarize server trace files (per-model/per-stage "
+                    "latency breakdown), join client trace files on "
+                    "triton-request-id, export Chrome trace-event JSON.")
+    parser.add_argument("server", help="server trace file (JSON Lines, "
+                        "written via trace_level=TIMESTAMPS)")
+    parser.add_argument("--client", default=None, metavar="PATH",
+                        help="client trace file (telemetry().enable_tracing) "
+                             "joined on triton-request-id")
+    parser.add_argument("--format", default="text",
+                        choices=["text", "json", "chrome"],
+                        help="text table (default), summary JSON, or Chrome "
+                             "trace-event JSON for Perfetto")
+    parser.add_argument("-o", "--output", default=None, metavar="PATH",
+                        help="write to a file instead of stdout")
+    args = parser.parse_args(argv)
+
+    try:
+        server_records = load_trace_file(args.server)
+        client_records = (load_trace_file(args.client)
+                          if args.client else None)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if args.format == "chrome":
+        out = json.dumps(chrome_trace(server_records, client_records),
+                         indent=2)
+    elif args.format == "json":
+        out = json.dumps(summarize(server_records, client_records), indent=2)
+    else:
+        out = format_text(summarize(server_records, client_records))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out if out.endswith("\n") else out + "\n")
+    else:
+        sys.stdout.write(out if out.endswith("\n") else out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
