@@ -400,6 +400,39 @@ def _measure_trace_breakdown(url: str, sweep, inputs_fn) -> dict:
         return {"trace_error": str(e)[:120]}
 
 
+def _measure_recorder_overhead(core, sweep, inputs_fn) -> dict:
+    """Flight-recorder fast-path cost: the same closed-loop window with the
+    always-on recorder recording (default) vs disabled, recorded next to
+    the trace/telemetry snapshots.  Single 2s windows on a shared host
+    carry ±20% noise — read overhead_pct as a bound (negative = noise),
+    and read it against the <2% acceptance target over rounds."""
+    try:
+        on = sweep("simple", inputs_fn, concurrency=8,
+                   warmup_s=0.5, measure_s=2.0)
+        core.flight_recorder.enabled = False
+        try:
+            off = sweep("simple", inputs_fn, concurrency=8,
+                        warmup_s=0.5, measure_s=2.0)
+        finally:
+            core.flight_recorder.enabled = True
+    except Exception as e:  # noqa: BLE001 — observability leg never kills bench
+        core.flight_recorder.enabled = True
+        return {"flight_recorder_error": str(e)[:120]}
+    result = {
+        "recorded_infer_per_sec": on["infer_per_sec"],
+        "disabled_infer_per_sec": off["infer_per_sec"],
+        "recorded_p99_ms": on["p99_ms"],
+        "disabled_p99_ms": off["p99_ms"],
+    }
+    if off["infer_per_sec"]:
+        result["overhead_pct"] = round(
+            100.0 * (1.0 - on["infer_per_sec"] / off["infer_per_sec"]), 2)
+    errors = on["errors"] + off["errors"]
+    if errors:
+        result["errors"] = errors[:2]
+    return {"flight_recorder_overhead": result}
+
+
 def _measure_rtt_floor() -> float:
     """Median blocking device round trip (H2D + sync + D2H) in ms — the
     physical latency floor for any synchronous per-request device path."""
@@ -614,6 +647,10 @@ def main() -> int:
     # would perturb it): the per-stage breakdown rides the bench record so
     # queue/compute/serialize share is visible round over round
     trace_breakdown = _measure_trace_breakdown(url, sweep, simple_inputs)
+    # flight-recorder A/B, also separate from the headline: recorded vs
+    # recorder-disabled windows bound the always-on layer's fast-path cost
+    recorder_overhead = _measure_recorder_overhead(
+        harness.core, sweep, simple_inputs)
     # same config through the NATIVE C++ client (tools/perf_client.cc) when
     # its binary is built — a cross-language drift control on the headline:
     # same server, same model, same c=8 closed loop, no client-side GIL
@@ -730,6 +767,8 @@ def main() -> int:
     # server-side per-stage breakdown from the traced window (span tracing):
     # queue vs compute vs serialize share next to the client-observed numbers
     out.update(trace_breakdown)
+    # always-on flight recorder: recorded-vs-disabled window delta
+    out.update(recorder_overhead)
     # client-side telemetry (the instrumented clients recorded every leg):
     # a compact per-(protocol, method, model) view so the bench record
     # carries client-observed p50/p99 next to the server-derived numbers

@@ -1,0 +1,563 @@
+"""Always-on flight recorder: ring buffer, slow-request watchdog, debug
+surfaces, readiness gating, and the `triton-top` console.
+
+Watchdog determinism: quantile-threshold behavior is exercised with
+synthetic span trees (fabricated monotonic intervals — no sleeps against a
+live quantile); the end-to-end promotion tests use an *absolute*
+millisecond threshold against a model that sleeps well past it, so the
+verdict never depends on wall-clock noise.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import triton_client_tpu.grpc as grpcclient
+import triton_client_tpu.http as httpclient
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import (
+    InferenceCore,
+    InferError,
+    InferRequest,
+    ModelRegistry,
+    PyModel,
+    make_config,
+)
+from triton_client_tpu.server.flight_recorder import (
+    FlightRecorder,
+    parse_capture_threshold,
+)
+from triton_client_tpu.server.testing import ServerHarness
+from triton_client_tpu.server.trace import TRACE_DEFAULTS, RequestTracer
+
+
+# -- unit level: recorder + watchdog (no server, no sleeps) -----------------
+
+def _completed(recorder, model="m", total_us=1000.0, outcome="ok",
+               queue_us=100.0, compute_us=500.0):
+    """Feed one synthetic request through the recorder: a shadow trace
+    context with a fabricated span tree whose durations we fully control."""
+    tracer = RequestTracer({k: list(v) for k, v in TRACE_DEFAULTS.items()})
+    trace = tracer.start_shadow(model, "1")
+    rec = recorder.start(model, "1", InferRequest(model_name=model))
+    t0 = time.monotonic_ns()
+    t_q = t0 + int(queue_us * 1e3)
+    t_c = t_q + int(compute_us * 1e3)
+    t_end = t0 + int(total_us * 1e3)
+    trace.begin_root(t0)
+    trace.add_span("QUEUE", t0, t_q)
+    trace.add_span("COMPUTE", t_q, t_c)
+    trace._root.end(t_end)
+    rec.outcome = outcome
+    recorder.complete(rec, trace)
+    return rec
+
+
+class TestRingBuffer:
+    def test_fifo_eviction_at_capacity(self):
+        recorder = FlightRecorder(capacity=4, capture_slower_than="p99")
+        for i in range(10):
+            _completed(recorder, total_us=100.0 + i)
+        snap = recorder.snapshot()
+        assert snap["recorded_total"] == 10
+        recent = snap["recent"]
+        assert len(recent) == 4  # bounded
+        # FIFO: the four newest survive, oldest-to-newest order
+        assert [r["seq"] for r in recent] == [7, 8, 9, 10]
+
+    def test_every_request_recorded_regardless_of_outcome(self):
+        recorder = FlightRecorder(capacity=16)
+        _completed(recorder, outcome="ok")
+        _completed(recorder, outcome="something broke")
+        snap = recorder.snapshot()
+        assert [r["outcome"] for r in snap["recent"]] == \
+            ["ok", "something broke"]
+
+    def test_durations_derived_from_span_tree(self):
+        recorder = FlightRecorder(capacity=4)
+        _completed(recorder, total_us=5000.0, queue_us=700.0,
+                   compute_us=3000.0)
+        r = recorder.snapshot()["recent"][0]
+        assert r["total_us"] == pytest.approx(5000.0, rel=0.01)
+        assert r["queue_us"] == pytest.approx(700.0, rel=0.01)
+        assert r["compute_us"] == pytest.approx(3000.0, rel=0.01)
+
+    def test_configure_preserves_counters_and_resize_keeps_newest(self):
+        recorder = FlightRecorder(capacity=8, capture_slower_than="1")
+        for _ in range(4):
+            _completed(recorder, total_us=5000.0)  # all beyond 1 ms
+        recorder.configure(capacity=2, enabled=True)  # runtime resize
+        snap = recorder.snapshot()
+        # cumulative counters back Prometheus `counter` families — a
+        # runtime toggle must never rewind them
+        assert snap["recorded_total"] == 4
+        assert recorder.slow_by_model == {"m": 4}
+        assert [r["seq"] for r in snap["recent"]] == [3, 4]  # newest kept
+        recorder.reset()
+        assert recorder.snapshot()["recorded_total"] == 0
+        assert recorder.snapshot()["recent"] == []
+
+    def test_batch_taken_from_shape_only_when_model_batches(self):
+        from triton_client_tpu.server.types import InputTensor
+
+        req = InferRequest(model_name="m", inputs=[
+            InputTensor("IN", "FP32", (8,), data=np.zeros(8, np.float32))])
+        recorder = FlightRecorder()
+        # a rank-1 input to a NON-batching model serves batch 1, not 8
+        assert recorder.start("m", "1", req, batched=False).batch == 1
+        assert recorder.start("m", "1", req, batched=True).batch == 8
+
+    def test_model_and_limit_filters(self):
+        recorder = FlightRecorder(capacity=32)
+        for _ in range(3):
+            _completed(recorder, model="a")
+        for _ in range(5):
+            _completed(recorder, model="b")
+        snap = recorder.snapshot(model="b", limit=2)
+        assert [r["model"] for r in snap["recent"]] == ["b", "b"]
+        assert list(snap["models"]) == ["b"]
+
+
+class TestWatchdog:
+    def test_quantile_threshold_promotes_tail_outlier(self):
+        recorder = FlightRecorder(capacity=512, capture_slower_than="p99")
+        # a tight distribution, enough samples to arm the p99 threshold
+        for _ in range(recorder.MIN_SAMPLES + 10):
+            _completed(recorder, total_us=1000.0)
+        assert recorder.snapshot()["outliers"] == []
+        rec = _completed(recorder, total_us=50_000.0)  # 50x the p99
+        assert rec.capture_reason == "slow"
+        outliers = recorder.snapshot()["outliers"]
+        assert len(outliers) == 1 and outliers[0]["seq"] == rec.seq
+
+    def test_quantile_threshold_disarmed_below_min_samples(self):
+        recorder = FlightRecorder(capture_slower_than="p99")
+        for _ in range(5):
+            _completed(recorder, total_us=1000.0)
+        rec = _completed(recorder, total_us=500_000.0)
+        # 6 samples cannot define a p99 worth alerting on
+        assert rec.capture_reason is None
+        assert recorder.snapshot()["outliers"] == []
+
+    def test_absolute_threshold(self):
+        recorder = FlightRecorder(capture_slower_than="5")  # 5 ms
+        fast = _completed(recorder, total_us=1000.0)
+        slow = _completed(recorder, total_us=10_000.0)
+        assert fast.capture_reason is None
+        assert slow.capture_reason == "slow"
+        assert recorder.threshold_us("m") == pytest.approx(5000.0)
+
+    def test_failure_always_captured_with_spans(self):
+        recorder = FlightRecorder(capture_slower_than="p99")
+        rec = _completed(recorder, total_us=100.0, outcome="model exploded")
+        assert rec.capture_reason == "failed"
+        out = recorder.snapshot()["outliers"][0]
+        assert out["outcome"] == "model exploded"
+        names = {s["name"] for s in out["spans"]}
+        assert {"REQUEST", "QUEUE", "COMPUTE"} <= names
+
+    def test_outlier_buffer_bounded_fifo(self):
+        recorder = FlightRecorder(outlier_capacity=2,
+                                  capture_slower_than="1")  # 1 ms: all slow
+        seqs = [_completed(recorder, total_us=5000.0).seq for _ in range(5)]
+        outliers = recorder.snapshot()["outliers"]
+        assert [o["seq"] for o in outliers] == seqs[-2:]
+
+    def test_slow_counter_and_histogram_semantics(self):
+        recorder = FlightRecorder(capture_slower_than="1")
+        _completed(recorder, total_us=5000.0)                     # slow ok
+        _completed(recorder, total_us=100.0, outcome="boom")      # fast fail
+        _completed(recorder, total_us=9000.0, outcome="timeout")  # SLOW fail
+        # every threshold-exceeder counts slow — a timeout storm must not
+        # read as zero on nv_inference_slow_request_total
+        assert recorder.slow_by_model == {"m": 2}
+        assert recorder.captured_by_model == {"m": 3}
+        # failures never feed the latency distribution (only the 1 success)
+        assert recorder.snapshot()["models"]["m"]["count"] == 1
+
+    def test_failures_do_not_drag_down_quantile_threshold(self):
+        recorder = FlightRecorder(capture_slower_than="p99")
+        # a burst of fast-failing requests (validation errors) must not
+        # arm the p99 threshold at failure latency
+        for _ in range(recorder.MIN_SAMPLES + 10):
+            _completed(recorder, total_us=300.0, outcome="invalid request")
+        assert recorder.threshold_us("m") is None
+        rec = _completed(recorder, total_us=20_000.0)
+        assert rec.capture_reason is None  # distribution never armed
+
+    def test_threshold_spec_validation(self):
+        assert parse_capture_threshold("p99") == (0.99, None)
+        assert parse_capture_threshold("250") == (None, 250.0)
+        assert parse_capture_threshold("1.5") == (None, 1.5)
+        with pytest.raises(InferError):
+            parse_capture_threshold("fastish")
+        with pytest.raises(InferError):
+            parse_capture_threshold("-3")
+        # 'nan'/'inf' parse as floats but would silently disarm the
+        # watchdog — they must fail as loudly as junk text
+        with pytest.raises(InferError):
+            parse_capture_threshold("nan")
+        with pytest.raises(InferError):
+            parse_capture_threshold("inf")
+
+
+# -- end to end: server harness ---------------------------------------------
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    snail_cfg = make_config(
+        "snail",
+        inputs=[("IN", "FP32", [-1])],
+        outputs=[("OUT", "FP32", [-1])],
+        instance_kind="KIND_CPU",
+    )
+
+    def snail_fn(inputs, params):
+        time.sleep(0.08)  # far beyond the absolute 25 ms test threshold
+        return {"OUT": inputs["IN"]}
+
+    registry.register_model(PyModel(snail_cfg, snail_fn))
+    kaboom_cfg = make_config(
+        "kaboom",
+        inputs=[("IN", "FP32", [-1])],
+        outputs=[("OUT", "FP32", [-1])],
+        instance_kind="KIND_CPU",
+    )
+
+    def kaboom_fn(inputs, params):
+        raise RuntimeError("kaboom exploded")
+
+    registry.register_model(PyModel(kaboom_cfg, kaboom_fn))
+    with ServerHarness(registry) as h:
+        yield h
+
+
+@pytest.fixture()
+def recorder(server):
+    """A freshly-reset recorder with a deterministic absolute threshold
+    (25 ms): 'snail' (80 ms sleep) always trips it, warmed zoo models
+    never should."""
+    server.core.flight_recorder.configure(
+        capacity=256, outlier_capacity=16, capture_slower_than="25",
+        enabled=True)
+    server.core.flight_recorder.reset()
+    return server.core.flight_recorder
+
+
+def _url(server, path):
+    return f"http://{server.http_url}{path}"
+
+
+def _infer(server, model, arr):
+    client = httpclient.InferenceServerClient(server.http_url)
+    try:
+        inp = httpclient.InferInput("IN", list(arr.shape), "FP32")
+        inp.set_data_from_numpy(arr)
+        return client.infer(model, [inp])
+    finally:
+        client.close()
+
+
+def _infer_simple(server):
+    client = httpclient.InferenceServerClient(server.http_url)
+    try:
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        inputs = [httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+                  httpclient.InferInput("INPUT1", [1, 16], "INT32")]
+        inputs[0].set_data_from_numpy(a)
+        inputs[1].set_data_from_numpy(a)
+        return client.infer("simple", inputs)
+    finally:
+        client.close()
+
+
+_RECORD_KEYS = {"seq", "request_id", "model", "version", "protocol",
+                "batch", "bytes_in", "bytes_out", "ts", "queue_us",
+                "compute_us", "total_us", "outcome", "captured",
+                "capture_reason"}
+_TOP_LEVEL_KEYS = {"enabled", "capture_slower_than", "ring_capacity",
+                   "outlier_capacity", "recorded_total", "models",
+                   "recent", "outliers"}
+
+
+class TestDebugEndpoint:
+    def test_json_shape_is_stable(self, server, recorder):
+        _infer_simple(server)
+        snap = requests.get(_url(server, "/v2/debug/flight_recorder")).json()
+        assert set(snap) == _TOP_LEVEL_KEYS
+        assert snap["enabled"] is True
+        assert snap["recorded_total"] >= 1
+        rec = next(r for r in snap["recent"] if r["model"] == "simple")
+        assert set(rec) == _RECORD_KEYS
+        assert rec["protocol"] == "http"
+        assert rec["outcome"] == "ok"
+        assert rec["batch"] == 1
+        assert rec["bytes_in"] == 2 * 16 * 4  # two [1,16] int32 tensors
+        assert rec["bytes_out"] == 2 * 16 * 4
+        assert rec["total_us"] > 0
+        mstats = snap["models"]["simple"]
+        assert {"count", "mean_ms", "p50_ms", "p90_ms", "p99_ms",
+                "threshold_ms", "slow_total", "captured_total"} == set(mstats)
+        assert mstats["threshold_ms"] == 25.0  # fixture's absolute spec
+
+    def test_recorded_without_any_trace_sampling(self, server, recorder):
+        # trace_level is OFF for this harness: the ring still records —
+        # that is the whole point of the always-on layer
+        for _ in range(3):
+            _infer_simple(server)
+        snap = requests.get(_url(server, "/v2/debug/flight_recorder"),
+                            params={"model": "simple"}).json()
+        assert len(snap["recent"]) >= 3
+        assert all(r["model"] == "simple" for r in snap["recent"])
+
+    def test_limit_query_param(self, server, recorder):
+        for _ in range(4):
+            _infer_simple(server)
+        snap = requests.get(_url(server, "/v2/debug/flight_recorder"),
+                            params={"limit": 2}).json()
+        assert len(snap["recent"]) == 2
+        r = requests.get(_url(server, "/v2/debug/flight_recorder"),
+                         params={"limit": "junk"})
+        assert r.status_code == 400
+
+    def test_grpc_surface_matches_http(self, server, recorder):
+        _infer_simple(server)
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            snap = gc.get_flight_recorder(model_name="simple", limit=1)
+        assert set(snap) == _TOP_LEVEL_KEYS
+        assert len(snap["recent"]) == 1
+        assert snap["recent"][0]["model"] == "simple"
+
+    def test_http_client_accessor(self, server, recorder):
+        _infer_simple(server)
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            snap = c.get_flight_recorder(model_name="simple")
+        assert all(r["model"] == "simple" for r in snap["recent"])
+
+    def test_grpc_web_bridge_serves_flight_recorder(self, server, recorder):
+        """The FlightRecorder RPC rides the gRPC-Web bridge like every
+        other METHODS entry — one framed POST against the HTTP port."""
+        import struct
+
+        from triton_client_tpu.protocol import debug_pb2 as pb_debug
+
+        _infer_simple(server)
+        msg = pb_debug.FlightRecorderRequest(limit=1).SerializeToString()
+        r = requests.post(
+            _url(server, "/inference.GRPCInferenceService/FlightRecorder"),
+            data=struct.pack(">BI", 0, len(msg)) + msg,
+            headers={"Content-Type": "application/grpc-web+proto"})
+        assert r.status_code == 200
+        assert r.headers["grpc-status"] == "0"
+        _, length = struct.unpack_from(">BI", r.content, 0)
+        resp = pb_debug.FlightRecorderResponse.FromString(
+            r.content[5:5 + length])
+        snap = json.loads(resp.payload_json)
+        assert set(snap) == _TOP_LEVEL_KEYS
+        assert len(snap["recent"]) == 1
+
+    def test_aio_client_accessors(self, server, recorder):
+        from triton_client_tpu.grpc.aio import \
+            InferenceServerClient as GrpcAio
+        from triton_client_tpu.http.aio import \
+            InferenceServerClient as HttpAio
+
+        _infer_simple(server)
+
+        async def main():
+            async with HttpAio(server.http_url) as hc:
+                hsnap = await hc.get_flight_recorder(limit=1)
+            gc = GrpcAio(server.grpc_url)
+            try:
+                gsnap = await gc.get_flight_recorder(limit=1)
+            finally:
+                await gc.close()
+            return hsnap, gsnap
+
+        hsnap, gsnap = asyncio.run(main())
+        assert set(hsnap) == _TOP_LEVEL_KEYS
+        assert set(gsnap) == _TOP_LEVEL_KEYS
+        assert len(hsnap["recent"]) == 1 and len(gsnap["recent"]) == 1
+
+
+class TestPromotion:
+    def test_slow_request_pinned_with_full_span_tree(self, server, recorder):
+        _infer(server, "snail", np.ones(8, np.float32))
+        snap = requests.get(_url(server, "/v2/debug/flight_recorder"),
+                            params={"model": "snail"}).json()
+        outliers = snap["outliers"]
+        assert len(outliers) == 1
+        o = outliers[0]
+        assert o["capture_reason"] == "slow"
+        assert o["outcome"] == "ok"
+        assert o["total_us"] > 25_000  # beyond the 25 ms threshold
+        assert o["age_s"] >= 0  # server-clock age, skew-safe for top
+        spans = {s["name"]: s for s in o["spans"]}
+        # the retroactively-attached tree is the full request path
+        for name in ("REQUEST", "DECODE", "QUEUE", "COMPUTE",
+                     "SERIALIZE", "NETWORK_WRITE"):
+            assert name in spans, f"missing span {name}: {list(spans)}"
+        assert spans["REQUEST"]["parent"] is None
+        root = spans["REQUEST"]
+        for s in o["spans"]:
+            assert s["start_ns"] >= root["start_ns"]
+            assert s["end_ns"] <= root["end_ns"]
+        assert snap["models"]["snail"]["slow_total"] == 1
+
+    def test_failed_request_pinned_with_error(self, server, recorder):
+        r = requests.post(
+            _url(server, "/v2/models/kaboom/infer"),
+            json={"inputs": [{"name": "IN", "datatype": "FP32",
+                              "shape": [4], "data": [1, 2, 3, 4]}]})
+        assert r.status_code == 500
+        snap = requests.get(_url(server, "/v2/debug/flight_recorder"),
+                            params={"model": "kaboom"}).json()
+        o = snap["outliers"][-1]
+        assert o["capture_reason"] == "failed"
+        assert "kaboom exploded" in o["outcome"]
+        assert {s["name"] for s in o["spans"]} >= {"REQUEST", "QUEUE"}
+
+    def test_fast_request_not_pinned(self, server, recorder):
+        _infer_simple(server)  # warmed long ago, ~sub-ms on CPU
+        snap = requests.get(_url(server, "/v2/debug/flight_recorder"),
+                            params={"model": "simple"}).json()
+        assert snap["outliers"] == []
+        assert all(r["captured"] is False for r in snap["recent"])
+
+    def test_grpc_requests_recorded_too(self, server, recorder):
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            inputs = [grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                      grpcclient.InferInput("INPUT1", [1, 16], "INT32")]
+            inputs[0].set_data_from_numpy(a)
+            inputs[1].set_data_from_numpy(a)
+            gc.infer("simple", inputs)
+            snap = gc.get_flight_recorder(model_name="simple")
+        assert snap["recent"][-1]["protocol"] == "grpc"
+
+    def test_disabled_recorder_records_nothing(self, server, recorder):
+        recorder.configure(enabled=False)
+        try:
+            _infer_simple(server)
+            snap = requests.get(
+                _url(server, "/v2/debug/flight_recorder")).json()
+            assert snap["enabled"] is False
+            assert snap["recorded_total"] == 0
+            assert snap["recent"] == []
+        finally:
+            recorder.configure(enabled=True)
+
+
+class TestMetricsCounters:
+    def test_watchdog_counters_exposed(self, server, recorder):
+        _infer(server, "snail", np.ones(8, np.float32))
+        text = requests.get(_url(server, "/metrics")).text
+        assert 'nv_inference_slow_request_total{model="snail"} 1' in text
+        assert 'nv_flight_recorder_captured_total{model="snail"} 1' in text
+
+
+class TestReadiness:
+    def test_not_ready_while_model_loading(self, server):
+        registry = server.core.registry
+        assert requests.get(
+            _url(server, "/v2/health/ready")).status_code == 200
+        registry.set_state("snail", "LOADING", "warming up")
+        try:
+            # server-level readiness gates on ANY loading model...
+            assert requests.get(
+                _url(server, "/v2/health/ready")).status_code == 400
+            with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+                assert gc.is_server_ready() is False
+            # ...and the model itself reports not-ready while warming
+            assert requests.get(
+                _url(server, "/v2/models/snail/ready")).status_code == 400
+        finally:
+            registry.set_state("snail", "READY", "")
+        assert requests.get(
+            _url(server, "/v2/health/ready")).status_code == 200
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            assert gc.is_server_ready() is True
+
+    def test_core_not_ready_before_startup_warmup(self):
+        core = InferenceCore(ModelRegistry())
+        assert core.ready() is False  # frontends up != ready to serve
+        asyncio.run(core.warmup_models())
+        assert core.ready() is True
+
+    def test_repository_load_leaves_model_ready(self, server):
+        # the LOADING window closes: a completed load/reload reports READY
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            c.load_model("snail")
+        assert requests.get(
+            _url(server, "/v2/models/snail/ready")).status_code == 200
+        assert requests.get(
+            _url(server, "/v2/health/ready")).status_code == 200
+
+
+class TestSamplingInterplay:
+    def test_sampled_traces_still_written_and_recorded(self, server,
+                                                       recorder, tmp_path):
+        """TIMESTAMPS sampling and the recorder coexist: the sampled
+        request reaches both the trace file and the ring."""
+        tf = tmp_path / "trace.jsonl"
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            c.update_trace_settings(settings={
+                "trace_file": [str(tf)],
+                "trace_level": ["TIMESTAMPS"],
+                "trace_rate": ["1"],
+            })
+            try:
+                _infer_simple(server)
+            finally:
+                c.update_trace_settings(settings={"trace_level": ["OFF"]})
+        lines = [json.loads(l) for l in tf.read_text().splitlines() if l]
+        assert len(lines) == 1
+        snap = requests.get(_url(server, "/v2/debug/flight_recorder"),
+                            params={"model": "simple"}).json()
+        assert len(snap["recent"]) >= 1
+
+
+class TestTritonTop:
+    def test_once_json_parses_debug_surface(self, server, recorder,
+                                            capsys):
+        from triton_client_tpu.tools import top
+
+        _infer(server, "snail", np.ones(8, np.float32))
+        _infer_simple(server)
+        rc = top.main(["--url", server.http_url, "--once", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert set(out) == {"url", "ts", "models", "recorder"}
+        row = out["models"]["simple"]
+        assert {"qps", "p50_ms", "p99_ms", "queue_share_pct", "batch_avg",
+                "pending", "error_pct", "slow_total", "captured_total",
+                "threshold_ms", "last_outlier"} == set(row)
+        assert row["qps"] is None  # one sample: no rate
+        assert row["p50_ms"] is not None
+        snail = out["models"]["snail"]
+        assert snail["captured_total"] >= 1
+        assert snail["last_outlier"]["reason"] == "slow"
+        assert out["recorder"]["recorded_total"] >= 2
+
+    def test_once_table_renders(self, server, recorder, capsys):
+        from triton_client_tpu.tools import top
+
+        _infer_simple(server)
+        rc = top.main(["--url", server.http_url, "--once"])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "MODEL" in text and "P99ms" in text
+        assert "simple" in text
+
+    def test_unreachable_server_exits_nonzero(self, capsys):
+        from triton_client_tpu.tools import top
+
+        rc = top.main(["--url", "127.0.0.1:1", "--once", "--timeout", "0.2"])
+        assert rc == 1
+        assert "cannot poll" in capsys.readouterr().err

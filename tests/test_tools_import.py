@@ -1,0 +1,70 @@
+"""Console-script wiring smoke test.
+
+Every module under ``triton_client_tpu.tools`` must import cleanly (the
+tools are stdlib-only by contract — an accidental heavy import would break
+them on dep-free boxes), and every console script registered in
+``pyproject.toml`` must resolve to a real ``module:function`` target — a
+broken entry point fails tier-1 instead of the first operator who runs it.
+"""
+
+import importlib
+import os
+import pkgutil
+import re
+
+import pytest
+
+import triton_client_tpu.tools as tools_pkg
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_TOOL_MODULES = sorted(
+    m.name for m in pkgutil.iter_modules(tools_pkg.__path__))
+
+
+def _console_scripts():
+    """``[project.scripts]`` entries parsed from pyproject.toml (no
+    tomllib on the 3.9 floor, so a line parse of the simple table)."""
+    text = open(os.path.join(_REPO_ROOT, "pyproject.toml")).read()
+    section = re.search(r"\[project\.scripts\](.*?)(?:\n\[|\Z)", text,
+                        re.DOTALL)
+    assert section, "pyproject.toml has no [project.scripts] table"
+    scripts = {}
+    for line in section.group(1).splitlines():
+        m = re.match(r'^\s*([\w.-]+)\s*=\s*"([\w.]+):(\w+)"\s*$', line)
+        if m:
+            scripts[m.group(1)] = (m.group(2), m.group(3))
+    return scripts
+
+
+def test_tools_package_is_not_empty():
+    assert "trace_summary" in _TOOL_MODULES
+    assert "top" in _TOOL_MODULES
+
+
+@pytest.mark.parametrize("name", _TOOL_MODULES)
+def test_tool_module_imports_and_has_main(name):
+    mod = importlib.import_module(f"triton_client_tpu.tools.{name}")
+    assert callable(getattr(mod, "main", None)), \
+        f"tools.{name} lacks a main() entry point"
+
+
+def test_console_scripts_resolve():
+    scripts = _console_scripts()
+    # the operator tools are registered
+    assert scripts["triton-trace-summary"] == \
+        ("triton_client_tpu.tools.trace_summary", "main")
+    assert scripts["triton-top"] == ("triton_client_tpu.tools.top", "main")
+    # and EVERY registered script points at an importable callable
+    for script, (module, func) in scripts.items():
+        mod = importlib.import_module(module)
+        assert callable(getattr(mod, func, None)), \
+            f"console script {script} -> {module}:{func} does not resolve"
+
+
+@pytest.mark.parametrize("name", ("trace_summary", "top"))
+def test_stdlib_tools_help_exits_zero(name):
+    mod = importlib.import_module(f"triton_client_tpu.tools.{name}")
+    with pytest.raises(SystemExit) as ei:
+        mod.main(["--help"])
+    assert ei.value.code == 0

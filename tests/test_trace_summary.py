@@ -243,6 +243,40 @@ class TestCli:
         assert main(["/nonexistent/trace.json"]) == 1
         err = capsys.readouterr().err
         assert "error:" in err
+        # one line, not a traceback
+        assert err.count("\n") == 1 and "Traceback" not in err
+
+    def test_empty_file_is_error_not_traceback(self, tmp_path, capsys):
+        empty = tmp_path / "empty.json"
+        empty.write_text("")
+        assert main([str(empty)]) == 1
+        err = capsys.readouterr().err
+        assert "empty trace file" in err
+        assert err.count("\n") == 1 and "Traceback" not in err
+
+    def test_blank_lines_only_is_empty(self, tmp_path, capsys):
+        blank = tmp_path / "blank.json"
+        blank.write_text("\n\n  \n")
+        assert main([str(blank)]) == 1
+        assert "empty trace file" in capsys.readouterr().err
+
+    def test_quiet_suppresses_all_output(self, server_file, tmp_path,
+                                         capsys):
+        # success: exit 0, nothing printed
+        assert main([str(server_file), "--quiet"]) == 0
+        cap = capsys.readouterr()
+        assert cap.out == "" and cap.err == ""
+        # failure: exit 1, still nothing printed (scripted use reads rc)
+        empty = tmp_path / "empty.json"
+        empty.write_text("")
+        assert main([str(empty), "-q"]) == 1
+        cap = capsys.readouterr()
+        assert cap.out == "" and cap.err == ""
+
+    def test_quiet_still_writes_output_file(self, server_file, tmp_path):
+        dest = tmp_path / "out.txt"
+        assert main([str(server_file), "--quiet", "-o", str(dest)]) == 0
+        assert "model=simple" in dest.read_text()
 
     def test_format_text_deterministic(self, server_file):
         s1 = format_text(summarize(load_trace_file(str(server_file))))

@@ -349,6 +349,25 @@ class InferenceServerClient(InferenceServerClientBase):
     def get_log_settings(self, headers=None, as_json=False, client_timeout=None):
         return self.update_log_settings({}, headers, as_json, client_timeout)
 
+    def get_flight_recorder(self, model_name=None, limit=0, headers=None,
+                            client_timeout=None) -> dict:
+        """The server's flight-recorder debug snapshot (always-on recent
+        ring + pinned tail-latency/failure outliers with span trees) —
+        same JSON shape as HTTP's GET /v2/debug/flight_recorder."""
+        import json
+
+        from ..protocol import debug_pb2 as pb_debug
+
+        try:
+            response = self._client_stub.FlightRecorder(
+                pb_debug.FlightRecorderRequest(
+                    model_name=model_name or "", limit=int(limit or 0)),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return json.loads(response.payload_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
     # -- shared memory -----------------------------------------------------
     def get_system_shared_memory_status(
         self, region_name="", headers=None, as_json=False, client_timeout=None

@@ -328,6 +328,22 @@ class InferenceServerClient(InferenceServerClientBase):
 
         return json.loads(response.data)
 
+    def get_flight_recorder(self, model_name=None, limit=0, headers=None,
+                            query_params=None) -> dict:
+        """The server's flight-recorder debug snapshot (always-on recent
+        ring + pinned tail-latency/failure outliers with span trees)."""
+        params = dict(query_params or {})
+        if model_name:
+            params["model"] = model_name
+        if limit:
+            params["limit"] = limit
+        response = self._get(
+            "v2/debug/flight_recorder", headers, params or None)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
     # -- shared memory (reference :945-1203) -------------------------------
     def get_system_shared_memory_status(
         self, region_name="", headers=None, query_params=None

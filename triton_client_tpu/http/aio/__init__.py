@@ -233,6 +233,20 @@ class InferenceServerClient(InferenceServerClientBase):
         raise_if_error(status, body)
         return json.loads(body)
 
+    async def get_flight_recorder(self, model_name=None, limit=0,
+                                  headers=None, query_params=None) -> dict:
+        """The server's flight-recorder debug snapshot (always-on recent
+        ring + pinned tail-latency/failure outliers with span trees)."""
+        params = dict(query_params or {})
+        if model_name:
+            params["model"] = model_name
+        if limit:
+            params["limit"] = limit
+        status, _, body = await self._get(
+            "v2/debug/flight_recorder", headers, params or None)
+        raise_if_error(status, body)
+        return json.loads(body)
+
     # -- shared memory -----------------------------------------------------
     async def get_system_shared_memory_status(
         self, region_name="", headers=None, query_params=None

@@ -1,0 +1,80 @@
+"""Debug-surface protobuf messages, built at runtime.
+
+The image has no protoc / ``grpc_tools`` (see ``service.py``: stubs and
+handlers are built from grpc's generic API for the same reason), so
+messages added after the committed ``inference_pb2.py`` snapshot are
+declared here as a ``FileDescriptorProto`` and registered with the default
+descriptor pool — wire-identical to what protoc would generate for::
+
+    syntax = "proto3";
+    package inference;
+
+    message FlightRecorderRequest {
+      string model_name = 1;   // filter to one model ("" = all)
+      uint32 limit = 2;        // cap the recent-ring slice (0 = all)
+    }
+    message FlightRecorderResponse {
+      string payload_json = 1; // the /v2/debug/flight_recorder JSON
+    }
+
+The response carries the debug snapshot as JSON-in-proto deliberately: the
+flight-recorder shape is a diagnostics surface shared verbatim with the
+HTTP endpoint and the ``triton-top`` console, and freezing it into
+repeated-message form would make every recorder field addition a wire
+change on three surfaces instead of none.
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2, descriptor_pool
+
+_FILE_NAME = "flight_recorder.proto"
+
+_STRING = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+_UINT32 = descriptor_pb2.FieldDescriptorProto.TYPE_UINT32
+_OPTIONAL = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+
+def _build_file() -> descriptor_pb2.FileDescriptorProto:
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = _FILE_NAME
+    fdp.package = "inference"
+    fdp.syntax = "proto3"
+    req = fdp.message_type.add()
+    req.name = "FlightRecorderRequest"
+    for fname, number, ftype in (("model_name", 1, _STRING),
+                                 ("limit", 2, _UINT32)):
+        f = req.field.add()
+        f.name, f.number, f.type, f.label = fname, number, ftype, _OPTIONAL
+    resp = fdp.message_type.add()
+    resp.name = "FlightRecorderResponse"
+    f = resp.field.add()
+    f.name, f.number, f.type, f.label = "payload_json", 1, _STRING, _OPTIONAL
+    return fdp
+
+
+_pool = descriptor_pool.Default()
+try:
+    _pool.Add(_build_file())
+except Exception:  # already registered (module re-exec in the same process)
+    pass
+# resolve by name, NOT Add()'s return value: the pure-Python protobuf
+# backend's Add() returns None, which would crash every importer of
+# protocol.service at startup
+_fd = _pool.FindFileByName(_FILE_NAME)
+
+
+def _message_class(name: str):
+    desc = _fd.message_types_by_name[name]
+    try:
+        from google.protobuf import message_factory
+
+        return message_factory.GetMessageClass(desc)  # protobuf >= 4.22
+    except (ImportError, AttributeError):  # older runtimes
+        from google.protobuf import message_factory
+
+        return message_factory.MessageFactory(_pool).GetPrototype(desc)
+
+
+FlightRecorderRequest = _message_class("FlightRecorderRequest")
+FlightRecorderResponse = _message_class("FlightRecorderResponse")

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import grpc
 
+from . import debug_pb2 as pb_debug
 from . import inference_pb2 as pb
 
 SERVICE_NAME = "inference.GRPCInferenceService"
@@ -67,6 +68,13 @@ METHODS = {
     ),
     "TraceSetting": ("uu", pb.TraceSettingRequest, pb.TraceSettingResponse),
     "LogSettings": ("uu", pb.LogSettingsRequest, pb.LogSettingsResponse),
+    # debug surface (runtime-built messages, debug_pb2): the flight
+    # recorder's recent ring + pinned outliers as JSON
+    "FlightRecorder": (
+        "uu",
+        pb_debug.FlightRecorderRequest,
+        pb_debug.FlightRecorderResponse,
+    ),
 }
 
 

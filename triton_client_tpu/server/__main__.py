@@ -43,6 +43,23 @@ def main() -> None:
                         help="serve HTTPS/secure-gRPC with this PEM cert chain")
     parser.add_argument("--ssl-keyfile", default=None,
                         help="PEM private key matching --ssl-certfile")
+    parser.add_argument("--capture-slower-than", default="p99",
+                        metavar="P|MS",
+                        help="flight-recorder watchdog threshold: a live "
+                        "per-model quantile (p50/p90/p95/p99/p999, default "
+                        "p99) or an absolute milliseconds value — requests "
+                        "beyond it (and every failure) are pinned with a "
+                        "full span tree")
+    parser.add_argument("--flight-recorder-size", type=int, default=1024,
+                        help="ring-buffer capacity of the always-on "
+                        "flight recorder (recent-request summaries)")
+    parser.add_argument("--flight-recorder-outliers", type=int, default=32,
+                        help="pinned-outlier buffer capacity (slow/failed "
+                        "requests with full span trees)")
+    parser.add_argument("--no-flight-recorder", action="store_true",
+                        help="disable per-request flight recording "
+                        "entirely (the /v2/debug/flight_recorder surface "
+                        "stays up but records nothing)")
     parser.add_argument("--metrics-port", type=int, default=8002,
                         help="dedicated Prometheus /metrics port (Triton "
                         "convention; 0 disables — /metrics stays on the "
@@ -94,6 +111,14 @@ def main() -> None:
         print(f"registered model zoo: {[e['name'] for e in registry.index()]}")
 
     core = InferenceCore(registry)
+    try:
+        core.flight_recorder.configure(
+            capacity=args.flight_recorder_size,
+            outlier_capacity=args.flight_recorder_outliers,
+            capture_slower_than=args.capture_slower_than,
+            enabled=not args.no_flight_recorder)
+    except Exception as e:  # invalid threshold spec — fail at startup
+        parser.error(str(e))
 
     async def serve():
         warmed = await core.warmup_models()

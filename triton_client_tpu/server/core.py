@@ -30,6 +30,7 @@ from ..utils import np_to_triton_dtype, triton_to_np_dtype
 from .model import EnsembleModel, Model, pb_to_datatype
 from .registry import ModelRegistry
 from .shm import SystemShmRegistry, XlaShmRegistry
+from .flight_recorder import FlightRecorder
 from .log import ServerLog
 from .trace import RequestTracer, TRACE_DEFAULTS
 from .types import (
@@ -374,7 +375,21 @@ class InferenceCore:
         self._batchers: Dict[str, _DynamicBatcher] = {}
         self._inline_profiles: Dict[str, _InlineProfile] = {}
         self.response_cache = _ResponseCache()
+        # always-on per-request recording + tail-latency auto-capture;
+        # the tracer hands every armed context's completion to it
+        self.flight_recorder = FlightRecorder()
+        self.tracer.flight_recorder = self.flight_recorder
         self.live = True
+        # readiness gate: /v2/health/ready (and gRPC ServerReady) report
+        # not-ready until startup warmup finished and no model is mid-load
+        self.startup_complete = False
+
+    def ready(self) -> bool:
+        """Server-level readiness: up, past startup warmup, and no model
+        currently loading/warming (Triton semantics: ready means "will
+        serve an inference now", not "the frontends answered")."""
+        return (self.live and self.startup_complete
+                and not self.registry.any_loading())
 
     # ------------------------------------------------------------------
     async def infer(self, request: InferRequest) -> InferResponse:
@@ -409,8 +424,21 @@ class InferenceCore:
             model.name, request.model_version or "1",
             client_request_id=request.client_request_id,
             traceparent=request.traceparent)
+        recorder = self.flight_recorder
         if trace is None:
-            return await self._infer_traced(model, request, None)
+            if not recorder.enabled:
+                return await self._infer_traced(model, request, None)
+            # flight recorder arming: the sampler skipped this request, but
+            # the watchdog needs its span tree in case it lands slow — run
+            # the full instrumentation into a discard-on-fast-path context
+            trace = self.tracer.start_shadow(
+                model.name, request.model_version or "1",
+                client_request_id=request.client_request_id,
+                traceparent=request.traceparent)
+        if recorder.enabled:
+            trace.flight = recorder.start(
+                model.name, model.served_version, request,
+                batched=model.max_batch_size > 0)
         trace.ts("REQUEST_START", request.arrival_ns)
         trace.ts("QUEUE_START", request.arrival_ns)
         # the root opens at the frontend's wire-receive time when stamped
@@ -428,21 +456,22 @@ class InferenceCore:
         token = set_current_trace(trace)
         try:
             resp = await self._infer_traced(model, request, trace)
-        except BaseException:
+        except BaseException as e:
             # errors close and emit here — no response carries the handoff
-            trace.finish()
-            await asyncio.get_running_loop().run_in_executor(None, trace.emit)
+            trace.mark_failed(e)
+            await trace.emit_async()
             raise
         finally:
             reset_current_trace(token)
+        if trace.flight is not None:
+            trace.flight.bytes_out = sum(
+                o.data.nbytes for o in resp.outputs if o.data is not None)
         if request.trace_handoff:
             # the frontend owns finalization: it records SERIALIZE /
             # NETWORK_WRITE spans, then closes the envelope and emits
             resp.trace = trace
         else:
-            trace.finish()
-            # file append runs off-loop: only the traced request pays for it
-            await asyncio.get_running_loop().run_in_executor(None, trace.emit)
+            await trace.emit_async()
         return resp
 
     async def _infer_traced(
@@ -657,6 +686,10 @@ class InferenceCore:
                     self.registry.unload(model.name)
                 except InferError:
                     pass
+        # readiness flips only after every declared warmup ran: a probe
+        # hitting /v2/health/ready during startup must not route traffic
+        # at a server still paying XLA compilation
+        self.startup_complete = True
         return ran
 
     async def load_model(self, name: str, config_override=None,
@@ -669,11 +702,16 @@ class InferenceCore:
             None, lambda: self.registry.load(
                 name, config_override=config_override, files=files))
         self.retire_name_caches(name)
-        for model in self.registry.version_models(name):
-            if not model.config.model_warmup:
-                continue
+        warm = [m for m in self.registry.version_models(name)
+                if m.config.model_warmup]
+        if warm:
+            # the name (and server readiness) reports LOADING for the
+            # whole warmup window — a load is not done until the model
+            # would serve its first request without compiling
+            self.registry.set_state(name, "LOADING", "warming up")
             try:
-                await self._warmup_one(model)
+                for model in warm:
+                    await self._warmup_one(model)
             except Exception as e:  # noqa: BLE001 — surface as load failure
                 try:
                     self.registry.unload(name)
@@ -684,6 +722,15 @@ class InferenceCore:
                 raise InferError(
                     f"failed to load '{name}': warmup failed: {e}",
                     http_status=400)
+            finally:
+                # NO exit path may strand the name in LOADING (a cancelled
+                # handler, or the unload above racing a concurrent unload):
+                # a stuck LOADING would hold the whole server not-ready
+                # until restart.  The failure path's unload already moved
+                # the state off LOADING; anything still LOADING here is a
+                # loaded, serving-capable instance.
+                if self.registry.get_state(name)[0] == "LOADING":
+                    self.registry.set_state(name, "READY", "")
         self.log.info(f"successfully loaded model '{name}'")
 
     def retire_name_caches(self, name: str) -> None:

@@ -220,7 +220,9 @@ class InferenceServicer:
         return pb.ServerLiveResponse(live=self._core.live)
 
     async def ServerReady(self, request, context):
-        return pb.ServerReadyResponse(ready=True)
+        # mirrors HTTP /v2/health/ready: not-ready during startup warmup
+        # or while any model is mid-load (see InferenceCore.ready)
+        return pb.ServerReadyResponse(ready=self._core.ready())
 
     async def ModelReady(self, request, context):
         return pb.ModelReadyResponse(
@@ -425,6 +427,24 @@ class InferenceServicer:
             resp.settings[k].value.extend(vals)
         return resp
 
+    async def FlightRecorder(self, request, context):
+        """Debug surface: the flight recorder's recent ring + pinned
+        outliers, as the same JSON the HTTP endpoint serves (see
+        protocol/debug_pb2.py for why JSON-in-proto).  Snapshot +
+        serialization run off-loop — a large ring must not stall
+        in-flight inference (same contract as the HTTP endpoint)."""
+        import json as _json
+
+        from ..protocol import debug_pb2 as pb_debug
+
+        model = request.model_name or None
+        limit = int(request.limit or 0)
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: _json.dumps(
+                self._core.flight_recorder.snapshot(
+                    model=model, limit=limit)))
+        return pb_debug.FlightRecorderResponse(payload_json=body)
+
     async def LogSettings(self, request, context):
         for k, v in request.settings.items():
             which = v.WhichOneof("parameter_choice")
@@ -458,6 +478,7 @@ class InferenceServicer:
             req.decode_start_ns = t_recv
             req.decode_end_ns = time.monotonic_ns()
             req.trace_handoff = True
+            req.protocol = "grpc"
             resp = await self._core.infer(req)
         except InferError as e:
             rid = getattr(req, "client_request_id", "") \
@@ -496,13 +517,16 @@ class InferenceServicer:
                 # grpc.aio serializes+writes after the handler returns; this
                 # span covers the handoff work still visible from here
                 trace.add_span("NETWORK_WRITE", t_ser1, time.monotonic_ns())
+        except BaseException as e:
+            # encode failures after the core reported success must still
+            # land in the flight record as failures (same contract as the
+            # HTTP frontend)
+            if trace is not None:
+                trace.mark_failed(e)
+            raise
         finally:
             if trace is not None:
-                trace.finish()
-                # awaited: the trace file is readable the moment the client
-                # gets its response (same contract as the HTTP frontend)
-                await asyncio.get_running_loop().run_in_executor(
-                    None, trace.emit)
+                await trace.emit_async()
         return pb_resp
 
     async def ModelStreamInfer(self, request_iterator, context):
@@ -513,6 +537,7 @@ class InferenceServicer:
             try:
                 req = _decode_pb_request(request)
                 _read_trace_metadata(req, context)
+                req.protocol = "grpc"
                 enable_empty_final = bool(
                     req.parameters.get("triton_enable_empty_final_response", False)
                 )

@@ -69,6 +69,7 @@ def build_app(core: InferenceCore) -> web.Application:
     r.add_post("/v2/models/{model}/trace/setting", _h(core, _set_trace))
     r.add_get("/v2/logging", _h(core, _get_logging))
     r.add_post("/v2/logging", _h(core, _set_logging))
+    r.add_get("/v2/debug/flight_recorder", _h(core, _flight_recorder))
     r.add_get("/metrics", _h(core, _metrics))
     for kind in ("systemsharedmemory", "cudasharedmemory"):
         r.add_get(f"/v2/{kind}/status", _h(core, _shm_status))
@@ -166,7 +167,10 @@ async def _health_live(core, request):
 
 
 async def _health_ready(core, request):
-    return web.Response(status=200)
+    # not-ready while startup warmup runs or any model is mid-load: a
+    # load balancer must not route at a server that would compile on its
+    # first request (Triton semantics: ready = "will serve now")
+    return web.Response(status=200 if core.ready() else 400)
 
 
 async def _model_ready(core, request):
@@ -294,8 +298,9 @@ async def _build_generate(core, request):
         body = await request.json()
     except Exception:
         raise InferError("failed to parse generate request JSON", 400)
-    return name, version, model, build_generate_request(
-        model, name, version, body)
+    req = build_generate_request(model, name, version, body)
+    req.protocol = "http"
+    return name, version, model, req
 
 
 async def _generate(core, request):
@@ -362,6 +367,21 @@ async def _generate_stream(core, request):
     return await sse_stream(
         request, core.infer_stream(req), write_frame,
         on_error=lambda e: f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+
+
+async def _flight_recorder(core, request):
+    model = request.query.get("model") or None
+    try:
+        limit = int(request.query.get("limit", "0"))
+    except ValueError:
+        raise InferError("flight_recorder 'limit' must be an integer")
+    # snapshot + serialize off-loop: at operator-sized rings (10^4-10^5
+    # records) this is a multi-MB json.dumps — done inline it would stall
+    # every in-flight inference for the duration of a debug poll
+    body = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: json.dumps(
+            core.flight_recorder.snapshot(model=model, limit=limit)))
+    return web.Response(text=body, content_type="application/json")
 
 
 async def _metrics(core, request):
@@ -469,6 +489,7 @@ async def _infer(core, request: web.Request) -> web.Response:
     req.decode_start_ns = t_recv
     req.decode_end_ns = time.monotonic_ns()
     req.trace_handoff = True
+    req.protocol = "http"
     resp = await core.infer(req)
     trace = resp.trace
     try:
@@ -495,12 +516,16 @@ async def _infer(core, request: web.Request) -> web.Response:
             # compression + response assembly up to the transport handoff
             # (aiohttp writes the socket after the handler returns)
             trace.add_span("NETWORK_WRITE", t_ser1, time.monotonic_ns())
+    except BaseException as e:
+        # a serialize/compress failure happens after the core reported
+        # success — the flight record must still land as a failure
+        # ("failures are always captured"), not as outcome="ok"
+        if trace is not None:
+            trace.mark_failed(e)
+        raise
     finally:
         if trace is not None:
-            trace.finish()
-            # awaited so the record is on disk before the client sees the
-            # response — trace files stay read-after-infer deterministic
-            await asyncio.get_running_loop().run_in_executor(None, trace.emit)
+            await trace.emit_async()
     return response
 
 

@@ -90,15 +90,28 @@ def render_prometheus(core: InferenceCore) -> str:
         for row in rows[key]:
             lines.append(f"{name}{row}")
 
-    # response-cache outcomes: tracked per model NAME by the core's LRU
-    # (cache keys carry the name; version resolution happens later), so
-    # these two families label {model} only
+    # model-name-only counter families: response-cache outcomes (tracked
+    # per NAME by the core's LRU — cache keys carry the name, version
+    # resolution happens later) and the flight-recorder watchdog's
+    # outcomes (slow = beyond the capture threshold, captured = pinned
+    # into the outlier buffer with a full span tree, slow OR failed).
+    # Watchdog counters are copied under the recorder lock — executor
+    # threads insert a model's first capture while a scrape iterates.
     cache = core.response_cache
+    slow_by_model, captured_by_model = \
+        core.flight_recorder.watchdog_counters()
     for name, help_text, counts in (
         ("nv_cache_num_hits_per_model",
          "Number of response cache hits per model", cache.hits_by_model),
         ("nv_cache_num_misses_per_model",
          "Number of response cache misses per model", cache.misses_by_model),
+        ("nv_inference_slow_request_total",
+         "Number of requests that exceeded the flight recorder's "
+         "slow-request threshold", slow_by_model),
+        ("nv_flight_recorder_captured_total",
+         "Number of requests pinned into the flight recorder's outlier "
+         "buffer (slow or failed) with a full span tree",
+         captured_by_model),
     ):
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} counter")

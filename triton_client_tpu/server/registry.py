@@ -153,8 +153,28 @@ class ModelRegistry:
         with self._lock:
             return self._generations.get(name, 0)
 
+    def set_state(self, name: str, state: str, reason: str = "") -> None:
+        """Transition a name's repository state (READY / LOADING /
+        UNAVAILABLE).  The core holds a name in LOADING while its warmup
+        samples run — readiness probes must not route traffic at a model
+        that would pay XLA compilation on its first request."""
+        with self._lock:
+            self._states[name] = (state, reason)
+
+    def get_state(self, name: str):
+        """Current (state, reason) of a name ("" state when unknown)."""
+        with self._lock:
+            return self._states.get(name, ("", ""))
+
+    def any_loading(self) -> bool:
+        """True while any model is mid-load/warmup (server readiness gate)."""
+        with self._lock:
+            return any(s == "LOADING" for s, _ in self._states.values())
+
     def is_ready(self, name: str, version: str = "") -> bool:
         with self._lock:
+            if self._states.get(name, ("", ""))[0] != "READY":
+                return False
             model = self._models.get(name)
             vset = self._version_sets.get(name) or {}
         return model is not None and (not version or version in vset)

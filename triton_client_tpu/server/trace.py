@@ -175,7 +175,8 @@ class TraceContext:
 
     __slots__ = ("_tracer", "id", "model_name", "model_version",
                  "timestamps", "path", "client_request_id", "traceparent",
-                 "spans", "log_frequency", "_root", "_done")
+                 "spans", "log_frequency", "_root", "_done", "sampled",
+                 "flight")
 
     def __init__(self, tracer: "RequestTracer", trace_id: int,
                  model_name: str, model_version: str, path: str,
@@ -193,8 +194,19 @@ class TraceContext:
         self.log_frequency = log_frequency
         self._root: Optional[Span] = None
         self._done = False
+        # False for a shadow context (flight-recorder arming): spans are
+        # collected but never written to the trace file
+        self.sampled = True
+        # FlightRecord of this request when the flight recorder is on
+        # (completed — and possibly pinned — when the context emits)
+        self.flight = None
 
     def ts(self, name: str, ns: Optional[int] = None) -> None:
+        if not self.sampled:
+            # shadow contexts exist only to feed spans to the flight
+            # recorder — the legacy timestamp list never leaves the
+            # process, so skip its per-request dict allocations
+            return
         self.timestamps.append(
             {"name": name, "ns": int(ns if ns is not None else time.monotonic_ns())}
         )
@@ -233,9 +245,39 @@ class TraceContext:
         if self._root is not None and self._root.end_ns is None:
             self._root.end(now)
 
+    def mark_failed(self, exc: BaseException) -> None:
+        """Stamp the flight record's outcome from an exception.  First
+        failure wins — a frontend error after a core error must not
+        overwrite the root cause."""
+        rec = self.flight
+        if rec is not None and rec.outcome == "ok":
+            rec.outcome = str(exc) or type(exc).__name__
+
+    async def emit_async(self) -> None:
+        """Finalize from a coroutine: a sampled context pays the executor
+        hop for its file append (awaited, so trace files stay
+        read-after-response deterministic); a shadow context completes
+        inline — no IO, and the hop would be pure per-request overhead."""
+        if self.sampled:
+            import asyncio
+
+            await asyncio.get_running_loop().run_in_executor(None, self.emit)
+        else:
+            self.emit()
+
     def emit(self) -> None:
+        """Finalize the context: close the envelope, append to the trace
+        file (sampled contexts only — a shadow context's spans never touch
+        disk), and hand the completed request to the flight recorder.  The
+        no-file path is cheap enough to run inline on the event loop."""
         self.finish()
-        self._tracer._emit(self)
+        if self.sampled:
+            self._tracer._emit(self)
+        rec, self.flight = self.flight, None
+        if rec is not None:
+            recorder = self._tracer.flight_recorder
+            if recorder is not None:
+                recorder.complete(rec, self)
 
 
 class RequestTracer:
@@ -272,6 +314,9 @@ class RequestTracer:
         # override scope samples with its own counters
         self._model_overrides: Dict[str, Dict[str, List[str]]] = {}
         self._model_counters: Dict[str, Dict[str, int]] = {}
+        # the core's FlightRecorder (set by InferenceCore): emit() hands
+        # every armed context's completed record to it
+        self.flight_recorder = None
 
     # -- settings lifecycle ------------------------------------------------
     def settings_updated(self) -> None:
@@ -396,6 +441,19 @@ class RequestTracer:
         return TraceContext(self, trace_id, model_name, model_version, path,
                             client_request_id, traceparent,
                             log_frequency=log_frequency)
+
+    def start_shadow(self, model_name: str, model_version: str,
+                     client_request_id: str = "",
+                     traceparent: str = "") -> TraceContext:
+        """An armed-but-unsampled context for the flight recorder: the full
+        span instrumentation runs so a tail-latency outlier can be captured
+        retroactively, but nothing reaches the trace file and neither the
+        sampling counters nor the file-unique id sequence move.  No lock:
+        this runs on every request when the recorder is on."""
+        ctx = TraceContext(self, 0, model_name, model_version, "",
+                           client_request_id, traceparent)
+        ctx.sampled = False
+        return ctx
 
     def _emit(self, ctx: TraceContext) -> None:
         record = {

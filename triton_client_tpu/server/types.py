@@ -65,6 +65,9 @@ class InferRequest:
     # that never finalize (generate, OpenAI, streaming) leave it False and
     # the core emits at the end of its own envelope, as before.
     trace_handoff: bool = False
+    # Which wire the request arrived on ("http" / "grpc"; "" for in-process
+    # callers) — recorded per request by the flight recorder.
+    protocol: str = ""
     # Filled by the core:
     arrival_ns: int = field(default_factory=lambda: time.monotonic_ns())
 

@@ -1,7 +1,15 @@
-"""Operator-facing CLI tools riding the library (no server required).
+"""Operator-facing CLI tools riding the library (stdlib-only by contract:
+every module here must import — and its ``--help`` must exit 0 — with none
+of the optional client deps installed; ``tests/test_tools_import.py``
+enforces it for each registered console script).
 
 ``trace_summary`` is the canonical consumer of the server's trace files
 (the reference repo's ``src/python/examples/trace_summary.py`` analog):
 per-model/per-stage latency breakdowns, client/server trace joins, and
 Chrome trace-event export for Perfetto.
+
+``top`` (``triton-top``) is the live console: it polls a running server's
+``/metrics`` + ``/v2/debug/flight_recorder`` and renders a refreshing
+per-model table (QPS, p50/p99, queue share, batch occupancy, error rate,
+most recent tail-latency outlier), with ``--once --json`` for scripting.
 """

@@ -1,0 +1,302 @@
+"""``triton-top``: a top(1)-style live console for a running server.
+
+Polls two HTTP surfaces — ``GET /metrics`` (the Triton-convention
+``nv_inference_*`` counters) and ``GET /v2/debug/flight_recorder`` (the
+always-on flight recorder's live per-model quantiles + pinned outliers) —
+and renders one refreshing per-model table: QPS, p50/p99, queue share,
+realized batch, in-flight requests, error rate, watchdog counters, and the
+most recent pinned outlier.  "What is the server doing right now" becomes
+one command::
+
+    triton-top --url localhost:8000            # live, refresh every 2s
+    triton-top --url localhost:8000 --once --json   # one snapshot, JSON
+
+stdlib-only on purpose (same contract as ``trace_summary``): the console
+must run — and ``--help`` must exit 0 — on a box with none of the optional
+client deps installed.
+
+Rates (QPS, error %, queue share, batch) are deltas between consecutive
+polls; ``--once`` takes a single sample, so rate columns fall back to the
+cumulative counters (and QPS is null in ``--json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+#: nv_* families the table consumes (summed across versions per model).
+_METRICS = (
+    "nv_inference_request_success",
+    "nv_inference_request_failure",
+    "nv_inference_request_duration_us",
+    "nv_inference_queue_duration_us",
+    "nv_inference_batch_size_total",
+    "nv_inference_batch_execution_count",
+    "nv_inference_pending_request_count",
+)
+
+_SERIES_RE = re.compile(r'^(\w+)\{([^}]*)\}\s+([0-9.eE+-]+)\s*$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _fetch(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def parse_metrics(text: str) -> Dict[str, Dict[str, float]]:
+    """Prometheus exposition -> ``{metric: {model: value}}`` for the
+    families the table uses, versions summed per model."""
+    out: Dict[str, Dict[str, float]] = {m: {} for m in _METRICS}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value = m.groups()
+        if name not in out:
+            continue
+        labels = dict(_LABEL_RE.findall(labels_raw))
+        model = labels.get("model", "")
+        if not model:
+            continue
+        out[name][model] = out[name].get(model, 0.0) + float(value)
+    return out
+
+
+def sample(base_url: str, timeout: float, limit: int = 0) -> Dict[str, Any]:
+    """One poll of both surfaces, monotonic-stamped for rate deltas."""
+    recorder_url = f"{base_url}/v2/debug/flight_recorder"
+    if limit:
+        recorder_url += f"?limit={int(limit)}"
+    return {
+        "t": time.monotonic(),
+        "metrics": parse_metrics(_fetch(f"{base_url}/metrics", timeout)),
+        "recorder": json.loads(_fetch(recorder_url, timeout)),
+    }
+
+
+def _delta(cur: Dict[str, Dict[str, float]],
+           prev: Optional[Dict[str, Dict[str, float]]],
+           metric: str, model: str) -> float:
+    now = cur.get(metric, {}).get(model, 0.0)
+    if prev is None:
+        return now  # cumulative fallback for the first/only sample
+    d = now - prev.get(metric, {}).get(model, 0.0)
+    # a negative delta means the server restarted between polls (its
+    # cumulative counters reset): the post-restart cumulative value is
+    # the honest frame, not a negative QPS
+    return now if d < 0 else d
+
+
+def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
+               include_idle: bool = False) -> Dict[str, Dict[str, Any]]:
+    """Fold one (or two, for rates) samples into per-model table rows.
+    Models that have never served a request are dropped unless
+    ``include_idle`` — a zoo registers dozens of models and the operator
+    is looking at the ones taking traffic."""
+    metrics = cur["metrics"]
+    pmetrics = prev["metrics"] if prev else None
+    recorder = cur["recorder"]
+    dt = (cur["t"] - prev["t"]) if prev else None
+    names = set(recorder.get("models", {}))
+    for per_model in metrics.values():
+        names.update(m for m, v in per_model.items()
+                     if include_idle or v > 0)
+    last_outlier: Dict[str, dict] = {}
+    for o in recorder.get("outliers", []):
+        seen = last_outlier.get(o["model"])
+        if seen is None or o["seq"] > seen["seq"]:
+            last_outlier[o["model"]] = o
+    rows: Dict[str, Dict[str, Any]] = {}
+    for model in sorted(names):
+        succ = _delta(metrics, pmetrics, "nv_inference_request_success", model)
+        fail = _delta(metrics, pmetrics, "nv_inference_request_failure", model)
+        req_us = _delta(metrics, pmetrics,
+                        "nv_inference_request_duration_us", model)
+        queue_us = _delta(metrics, pmetrics,
+                          "nv_inference_queue_duration_us", model)
+        batch_total = _delta(metrics, pmetrics,
+                             "nv_inference_batch_size_total", model)
+        batch_exec = _delta(metrics, pmetrics,
+                            "nv_inference_batch_execution_count", model)
+        total = succ + fail
+        rec = recorder.get("models", {}).get(model, {})
+        rows[model] = {
+            "qps": round(total / dt, 1) if dt else None,
+            "p50_ms": rec.get("p50_ms"),
+            "p99_ms": rec.get("p99_ms"),
+            "queue_share_pct": (round(100.0 * queue_us / req_us, 1)
+                                if req_us > 0 else None),
+            "batch_avg": (round(batch_total / batch_exec, 1)
+                          if batch_exec > 0 else None),
+            "pending": int(metrics.get(
+                "nv_inference_pending_request_count", {}).get(model, 0)),
+            "error_pct": round(100.0 * fail / total, 2) if total > 0 else None,
+            "slow_total": rec.get("slow_total", 0),
+            "captured_total": rec.get("captured_total", 0),
+            "threshold_ms": rec.get("threshold_ms"),
+            "last_outlier": _outlier_brief(last_outlier.get(model)),
+        }
+    return rows
+
+
+def _outlier_brief(o: Optional[dict]) -> Optional[Dict[str, Any]]:
+    if o is None:
+        return None
+    # age_s is computed by the SERVER at snapshot time (its clock) —
+    # differencing o["ts"] against this host's clock would be wrong under
+    # skew; fall back to it only for pre-age_s servers
+    age = o.get("age_s")
+    if age is None:
+        age = round(max(0.0, time.time() - o["ts"]), 1)
+    return {
+        "seq": o["seq"],
+        "age_s": age,
+        "total_ms": round(o["total_us"] / 1e3, 2),
+        "reason": o.get("capture_reason"),
+        "outcome": o.get("outcome"),
+        "request_id": o.get("request_id", ""),
+    }
+
+
+# -- rendering ---------------------------------------------------------------
+
+def _fmt(v, nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render(url: str, cur: Dict[str, Any],
+           rows: Dict[str, Dict[str, Any]], interval: float) -> str:
+    recorder = cur["recorder"]
+    lines = [
+        f"triton-top — {url} — {time.strftime('%H:%M:%S')}  "
+        f"refresh={interval:g}s  recorder="
+        f"{'on' if recorder.get('enabled') else 'OFF'} "
+        f"({recorder.get('capture_slower_than')}, "
+        f"{recorder.get('recorded_total', 0)} recorded, "
+        f"{len(recorder.get('outliers', []))} outlier(s) pinned)",
+        "",
+        f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
+        f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'SLOW':>6}{'CAPT':>6}"
+        f"  LAST OUTLIER",
+    ]
+    for model, r in rows.items():
+        o = r["last_outlier"]
+        brief = ""
+        if o is not None:
+            brief = (f"{o['age_s']:g}s ago {o['total_ms']:g}ms "
+                     f"{o['reason'] or ''}")
+            if o["outcome"] != "ok":
+                brief += f" ({o['outcome'][:40]})"
+        lines.append(
+            f"  {model:<24}{_fmt(r['qps']):>8}{_fmt(r['p50_ms']):>9}"
+            f"{_fmt(r['p99_ms']):>9}{_fmt(r['queue_share_pct']):>8}"
+            f"{_fmt(r['batch_avg']):>7}{r['pending']:>6}"
+            f"{_fmt(r['error_pct'], 2):>7}{r['slow_total']:>6}"
+            f"{r['captured_total']:>6}  {brief}")
+    if not rows:
+        lines.append("  (no recorded requests yet)")
+    return "\n".join(lines) + "\n"
+
+
+# -- CLI --------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="triton-top",
+        description="Live per-model console for a running server: polls "
+                    "/metrics and /v2/debug/flight_recorder, renders QPS, "
+                    "p50/p99, queue share, batch occupancy, error rate, "
+                    "and the most recent tail-latency outlier.")
+    parser.add_argument("--url", default="localhost:8000",
+                        help="server host:port (default localhost:8000)")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh interval in seconds (default 2.0)")
+    parser.add_argument("--once", action="store_true",
+                        help="take one snapshot and exit (rate columns "
+                             "fall back to cumulative counters)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit machine-readable JSON instead of the "
+                             "table (for scripting; pairs with --once)")
+    parser.add_argument("--all", action="store_true", dest="include_idle",
+                        help="show every registered model, including ones "
+                             "that have never served a request")
+    parser.add_argument("--limit", type=int, default=None,
+                        help="recent-ring entries fetched per poll "
+                             "(default: 0 = whole ring with --once, 1 in "
+                             "live mode — the table reads only the "
+                             "per-model stats and outliers, so pulling a "
+                             "large ring every refresh would be waste)")
+    parser.add_argument("--timeout", type=float, default=5.0,
+                        help="per-poll HTTP timeout in seconds")
+    args = parser.parse_args(argv)
+
+    base = args.url if "://" in args.url else f"http://{args.url}"
+    base = base.rstrip("/")
+    limit = args.limit if args.limit is not None else (0 if args.once else 1)
+
+    def one_sample():
+        try:
+            return sample(base, args.timeout, limit=limit)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"error: cannot poll {base}: {e}", file=sys.stderr)
+            return None
+
+    cur = one_sample()
+    if cur is None:
+        return 1
+    if args.once:
+        rows = model_rows(cur, None, include_idle=args.include_idle)
+        if args.as_json:
+            out = {
+                "url": base,
+                "ts": time.time(),
+                "models": rows,
+                "recorder": cur["recorder"],
+            }
+            print(json.dumps(out, indent=2))
+        else:
+            sys.stdout.write(render(base, cur, rows, args.interval))
+        return 0
+
+    prev = cur
+    try:
+        while True:
+            time.sleep(max(0.05, args.interval))
+            cur = one_sample()
+            if cur is None:
+                # transient blip (deploy, overloaded scrape): keep the
+                # console alive and retry — monitoring must not die at
+                # exactly the moment the server gets interesting
+                continue
+            rows = model_rows(cur, prev, include_idle=args.include_idle)
+            if args.as_json:
+                print(json.dumps({"ts": time.time(), "models": rows}))
+            else:
+                # clear screen + home, top(1)-style
+                sys.stdout.write("\x1b[H\x1b[2J")
+                sys.stdout.write(render(base, cur, rows, args.interval))
+                sys.stdout.flush()
+            prev = cur
+    except KeyboardInterrupt:
+        return 0
+    except BrokenPipeError:
+        # downstream consumer closed (e.g. `triton-top --json | head`)
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -346,15 +346,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "trace-event JSON for Perfetto")
     parser.add_argument("-o", "--output", default=None, metavar="PATH",
                         help="write to a file instead of stdout")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="no output, exit status only (scripted use; "
+                             "-o files are still written)")
     args = parser.parse_args(argv)
+
+    def fail(msg: str) -> int:
+        # one line on stderr, nonzero exit — never an unhandled traceback
+        # (a missing/empty trace file is an operator mistake, not a crash)
+        if not args.quiet:
+            print(f"error: {msg}", file=sys.stderr)
+        return 1
 
     try:
         server_records = load_trace_file(args.server)
         client_records = (load_trace_file(args.client)
                           if args.client else None)
     except (OSError, ValueError) as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
+        return fail(str(e))
+    if not server_records:
+        return fail(f"{args.server}: empty trace file (no records — was "
+                    "trace_level=TIMESTAMPS set while traffic ran?)")
 
     if args.format == "chrome":
         out = json.dumps(chrome_trace(server_records, client_records),
@@ -366,7 +378,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.output:
         with open(args.output, "w") as f:
             f.write(out if out.endswith("\n") else out + "\n")
-    else:
+    elif not args.quiet:
         sys.stdout.write(out if out.endswith("\n") else out + "\n")
     return 0
 
