@@ -433,6 +433,38 @@ def _measure_recorder_overhead(core, sweep, inputs_fn) -> dict:
     return {"flight_recorder_overhead": result}
 
 
+def _measure_resilience_overhead(sweep, inputs_fn) -> dict:
+    """Happy-path cost of the client resilience layer: the same closed-loop
+    window with every infer running under RetryPolicy(max_attempts=3) vs
+    the plain call path.  No faults are injected, so the delta is pure
+    wrapper overhead (one closure + deadline arithmetic per request) —
+    read overhead_pct against the <1% acceptance target, with the usual
+    ±20% single-window noise caveat (negative = noise)."""
+    from triton_client_tpu._resilience import RetryPolicy
+
+    policy = RetryPolicy(max_attempts=3, retry_infer=True)
+    try:
+        on = sweep("simple", inputs_fn, concurrency=8,
+                   warmup_s=0.5, measure_s=2.0, retry_policy=policy)
+        off = sweep("simple", inputs_fn, concurrency=8,
+                    warmup_s=0.5, measure_s=2.0)
+    except Exception as e:  # noqa: BLE001 — resilience leg never kills bench
+        return {"resilience_error": str(e)[:120]}
+    result = {
+        "enabled_infer_per_sec": on["infer_per_sec"],
+        "disabled_infer_per_sec": off["infer_per_sec"],
+        "enabled_p99_ms": on["p99_ms"],
+        "disabled_p99_ms": off["p99_ms"],
+    }
+    if off["infer_per_sec"]:
+        result["overhead_pct"] = round(
+            100.0 * (1.0 - on["infer_per_sec"] / off["infer_per_sec"]), 2)
+    errors = on["errors"] + off["errors"]
+    if errors:
+        result["errors"] = errors[:2]
+    return {"resilience_overhead": result}
+
+
 def _measure_rtt_floor() -> float:
     """Median blocking device round trip (H2D + sync + D2H) in ms — the
     physical latency floor for any synchronous per-request device path."""
@@ -584,7 +616,8 @@ def main() -> int:
         warm.infer("dense_tpu", [i])
     warm.close()
 
-    def sweep(model_name, inputs_fn, concurrency, warmup_s=1.0, measure_s=5.0):
+    def sweep(model_name, inputs_fn, concurrency, warmup_s=1.0, measure_s=5.0,
+              retry_policy=None):
         """perf_analyzer-style fixed-concurrency closed-loop sweep."""
         latencies: list = []
         counts = [0] * concurrency
@@ -600,7 +633,8 @@ def main() -> int:
                 n = 0
                 while not stop.is_set():
                     t0 = time.perf_counter()
-                    client.infer(model_name, inputs)
+                    client.infer(model_name, inputs,
+                                 retry_policy=retry_policy)
                     dt = time.perf_counter() - t0
                     if start_measuring.is_set():
                         local_lat.append(dt)
@@ -651,6 +685,9 @@ def main() -> int:
     # recorder-disabled windows bound the always-on layer's fast-path cost
     recorder_overhead = _measure_recorder_overhead(
         harness.core, sweep, simple_inputs)
+    # resilience-layer A/B: RetryPolicy-wrapped vs plain infer on the
+    # happy path (target <1% overhead; no faults injected here)
+    resilience_overhead = _measure_resilience_overhead(sweep, simple_inputs)
     # same config through the NATIVE C++ client (tools/perf_client.cc) when
     # its binary is built — a cross-language drift control on the headline:
     # same server, same model, same c=8 closed loop, no client-side GIL
@@ -769,6 +806,8 @@ def main() -> int:
     out.update(trace_breakdown)
     # always-on flight recorder: recorded-vs-disabled window delta
     out.update(recorder_overhead)
+    # client resilience layer: retry-wrapped vs plain happy-path delta
+    out.update(resilience_overhead)
     # client-side telemetry (the instrumented clients recorded every leg):
     # a compact per-(protocol, method, model) view so the bench record
     # carries client-observed p50/p99 next to the server-derived numbers

@@ -280,7 +280,7 @@ def _infer_simple(server):
 _RECORD_KEYS = {"seq", "request_id", "model", "version", "protocol",
                 "batch", "bytes_in", "bytes_out", "ts", "queue_us",
                 "compute_us", "total_us", "outcome", "captured",
-                "capture_reason"}
+                "capture_reason", "chaos"}
 _TOP_LEVEL_KEYS = {"enabled", "capture_slower_than", "ring_capacity",
                    "outlier_capacity", "recorded_total", "models",
                    "recent", "outliers"}
@@ -536,7 +536,8 @@ class TestTritonTop:
         assert set(out) == {"url", "ts", "models", "recorder"}
         row = out["models"]["simple"]
         assert {"qps", "p50_ms", "p99_ms", "queue_share_pct", "batch_avg",
-                "pending", "error_pct", "slow_total", "captured_total",
+                "pending", "error_pct", "rejected_per_s",
+                "deadline_exceeded_per_s", "slow_total", "captured_total",
                 "threshold_ms", "last_outlier"} == set(row)
         assert row["qps"] is None  # one sample: no rate
         assert row["p50_ms"] is not None

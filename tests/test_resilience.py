@@ -1,0 +1,793 @@
+"""End-to-end resilience layer: client retry/backoff + deadline budgets,
+server admission control + deadline enforcement + graceful drain, and the
+fault-injection harness that makes all of it testable.
+
+The timeout matrix drives ``custom_identity_int32`` (the zoo model reserved
+for timeout tests — its ``execute_delay_ms`` parameter is the server-side
+delay knob) through client-timeout, server-deadline, queue-shed, and
+retry-success-after-one-fault cases on all four clients.
+
+Determinism: chaos uses ``max_faults`` / seeded RNGs (no probabilistic
+assertions outside the soak test), queue-shed polls the model's live
+pending gauge instead of sleeping against a race, and the server-deadline
+cases use an already-expired 1 µs budget rather than a timing window.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import triton_client_tpu.grpc as grpcclient
+import triton_client_tpu.http as httpclient
+from triton_client_tpu._resilience import (RetryPolicy, call_with_retry,
+                                           deadline_exceeded_error,
+                                           is_connection_error, min_timeout,
+                                           normalized_status)
+from triton_client_tpu._telemetry import telemetry
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import (InferenceCore, InferError, InferRequest,
+                                      ModelRegistry)
+from triton_client_tpu.server.chaos import ChaosAbort, ChaosInjector, \
+    build_injector
+from triton_client_tpu.server.testing import ServerHarness
+from triton_client_tpu.server.types import InputTensor, apply_request_deadline
+from triton_client_tpu.utils import InferenceServerException
+
+MODEL = "custom_identity_int32"
+
+
+@pytest.fixture(scope="module")
+def harness():
+    registry = ModelRegistry()
+    registry.register_model(zoo.make_custom_identity_int32())
+    registry.register_model(zoo.make_simple())
+    h = ServerHarness(registry)
+    h.start()
+    yield h
+    h.stop()
+
+
+def _wait_idle(harness, timeout_s=10.0):
+    """Wait for the timeout model to be fully idle — a prior test's
+    abandoned slow request (client timed out, server still executing)
+    must not leak pending-count into this test's admission checks."""
+    stats = harness.core.registry.get(MODEL).stats
+    deadline = time.monotonic() + timeout_s
+    while stats.pending_count > 0:
+        if time.monotonic() > deadline:
+            raise RuntimeError("model never went idle between tests")
+        time.sleep(0.01)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state(harness):
+    _wait_idle(harness)
+    yield
+    harness.core.chaos = None
+    harness.core.queue_limits.clear()
+    harness.core.default_max_queue_size = 0
+
+
+def _x(n=4):
+    return np.arange(n, dtype=np.int32).reshape(1, n)
+
+
+def _http_inputs(x):
+    i = httpclient.InferInput("INPUT0", list(x.shape), "INT32")
+    i.set_data_from_numpy(x)
+    return [i]
+
+
+def _grpc_inputs(x):
+    i = grpcclient.InferInput("INPUT0", list(x.shape), "INT32")
+    i.set_data_from_numpy(x)
+    return [i]
+
+
+def _retries_for(model, protocol):
+    return sum(s["retries"] for s in telemetry().snapshot()["requests"]
+               if s["model"] == model and s["protocol"] == protocol)
+
+
+# -- unit: RetryPolicy ------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_status_gating(self):
+        p = RetryPolicy(max_attempts=3)
+        for status in ("429", "503", "StatusCode.UNAVAILABLE",
+                       "StatusCode.RESOURCE_EXHAUSTED"):
+            e = InferenceServerException("x", status=status)
+            assert p.should_retry(e, method="health", attempt=1), status
+        for status in ("400", "404", "500",
+                       "StatusCode.DEADLINE_EXCEEDED",
+                       "StatusCode.INVALID_ARGUMENT"):
+            e = InferenceServerException("x", status=status)
+            assert not p.should_retry(e, method="health", attempt=1), status
+
+    def test_idempotency_default_blocks_infer(self):
+        e = InferenceServerException("x", status="503")
+        assert not RetryPolicy().should_retry(e, method="infer", attempt=1)
+        assert RetryPolicy(retry_infer=True).should_retry(
+            e, method="infer", attempt=1)
+        # health/metadata are always retryable under the policy
+        assert RetryPolicy().should_retry(e, method="metadata", attempt=1)
+
+    def test_attempt_budget(self):
+        p = RetryPolicy(max_attempts=2)
+        e = InferenceServerException("x", status="503")
+        assert p.should_retry(e, method="health", attempt=1)
+        assert not p.should_retry(e, method="health", attempt=2)
+
+    def test_connection_errors_always_retryable_class(self):
+        assert is_connection_error(ConnectionResetError())
+        try:
+            import urllib3
+
+            assert is_connection_error(
+                urllib3.exceptions.ProtocolError("aborted"))
+        except ImportError:
+            pass
+        assert not is_connection_error(ValueError("nope"))
+
+    def test_full_jitter_backoff_bounds_and_determinism(self):
+        a = RetryPolicy(initial_backoff_s=0.1, backoff_multiplier=2.0,
+                        max_backoff_s=0.5, seed=42)
+        b = RetryPolicy(initial_backoff_s=0.1, backoff_multiplier=2.0,
+                        max_backoff_s=0.5, seed=42)
+        seq_a = [a.backoff_s(n) for n in range(1, 6)]
+        seq_b = [b.backoff_s(n) for n in range(1, 6)]
+        assert seq_a == seq_b  # seeded: reproducible
+        for n, d in enumerate(seq_a, 1):
+            assert 0.0 <= d <= min(0.5, 0.1 * 2.0 ** (n - 1))
+
+    def test_server_pushback_overrides_backoff(self):
+        p = RetryPolicy(initial_backoff_s=10.0, seed=0)
+        assert p.backoff_s(1, retry_after_s=0.125) == 0.125
+
+    def test_normalized_status(self):
+        assert normalized_status(
+            InferenceServerException("x", status="StatusCode.UNAVAILABLE")) \
+            == "UNAVAILABLE"
+        assert normalized_status(
+            InferenceServerException("x", status="429")) == "429"
+        assert normalized_status(ValueError()) is None
+
+    def test_min_timeout(self):
+        assert min_timeout(None, None) is None
+        assert min_timeout(5.0, None) == 5.0
+        assert min_timeout(None, 2.0) == 2.0
+        assert min_timeout(5.0, 2.0) == 2.0
+
+    def test_call_with_retry_recovers_then_succeeds(self):
+        p = RetryPolicy(max_attempts=3, retry_infer=True,
+                        initial_backoff_s=0.001, seed=0)
+        attempts = []
+
+        def fn(remaining, attempt):
+            attempts.append(attempt)
+            if attempt < 3:
+                raise InferenceServerException("overloaded", status="503")
+            return "ok"
+
+        assert call_with_retry(p, fn) == "ok"
+        assert attempts == [1, 2, 3]
+
+    def test_call_with_retry_deadline_cap(self):
+        p = RetryPolicy(max_attempts=50, retry_infer=True,
+                        initial_backoff_s=0.02, seed=0)
+
+        def always_503(remaining, attempt):
+            raise InferenceServerException("overloaded", status="503")
+
+        t0 = time.monotonic()
+        with pytest.raises(InferenceServerException):
+            call_with_retry(p, always_503, deadline_s=0.15)
+        # the budget bounds total time across every attempt + backoff
+        assert time.monotonic() - t0 < 1.0
+
+    def test_deadline_error_is_typed(self):
+        e = deadline_exceeded_error()
+        assert e.status() == "StatusCode.DEADLINE_EXCEEDED"
+
+    def test_abandoned_retry_not_counted(self):
+        # a retry the budget can't cover is abandoned BEFORE it is
+        # recorded — nv_client_retries_total counts committed retries only
+        p = RetryPolicy(max_attempts=3, retry_infer=True, seed=0)
+
+        def fn(remaining, attempt):
+            e = InferenceServerException("overloaded", status="503")
+            e.retry_after_s = 10.0  # pushback far beyond the budget
+            raise e
+
+        with pytest.raises(InferenceServerException):
+            call_with_retry(p, fn, method="infer", deadline_s=0.05,
+                            retry_meta=("abandon-m", "http", "infer", ""))
+        assert _retries_for("abandon-m", "http") == 0
+
+
+# -- unit: chaos injector ---------------------------------------------------
+
+class TestChaosInjector:
+    def test_same_seed_same_fault_sequence(self):
+        a = ChaosInjector(rate=0.3, kinds=["error", "latency"], seed=7)
+        b = ChaosInjector(rate=0.3, kinds=["error", "latency"], seed=7)
+        va = [getattr(a.decide("m"), "kind", None) for _ in range(50)]
+        vb = [getattr(b.decide("m"), "kind", None) for _ in range(50)]
+        assert va == vb
+        assert any(v is not None for v in va)
+
+    def test_rate_zero_and_model_filter(self):
+        assert ChaosInjector(rate=0.0).decide("m") is None
+        inj = ChaosInjector(rate=1.0, models=["a"])
+        assert inj.decide("b") is None
+        assert inj.decide("a") is not None
+
+    def test_max_faults_cap(self):
+        inj = ChaosInjector(rate=1.0, max_faults=2)
+        verdicts = [inj.decide("m") for _ in range(5)]
+        assert sum(v is not None for v in verdicts) == 2
+        assert inj.injected_by_model == {"m": 2}
+
+    def test_transient_window_suppresses_consecutive_faults(self):
+        inj = ChaosInjector(rate=1.0, transient_s=60.0)
+        assert inj.decide("m") is not None
+        # inside the recovery window every later draw is clean — the
+        # property that makes retries against transient faults a theorem
+        assert all(inj.decide("m") is None for _ in range(20))
+
+    def test_build_injector_validates(self):
+        with pytest.raises(ValueError):
+            build_injector(1.5)
+        with pytest.raises(ValueError):
+            build_injector(0.5, kinds_csv="explode")
+        inj = build_injector(0.5, kinds_csv="latency, error", seed=3)
+        assert inj.kinds == ("latency", "error")
+
+
+# -- unit: deadline wire decode --------------------------------------------
+
+class TestDeadlineDecode:
+    def test_timeout_parameter_consumed_into_deadline(self):
+        req = InferRequest(model_name="m",
+                           parameters={"timeout": 50_000, "keep": 1})
+        apply_request_deadline(req)
+        assert req.deadline_ns > 0
+        assert "timeout" not in req.parameters  # must not split batch groups
+        assert req.parameters["keep"] == 1
+        assert not req.expired(req.deadline_ns - 1)
+        assert req.expired(req.deadline_ns)
+
+    def test_header_wins_over_parameter(self):
+        req = InferRequest(model_name="m", parameters={"timeout": 10})
+        apply_request_deadline(req, header_us="60000000")
+        assert req.deadline_ns > time.monotonic_ns() + int(30e9 // 1000)
+
+    def test_junk_timeout_is_client_error(self):
+        req = InferRequest(model_name="m", parameters={"timeout": "soon"})
+        with pytest.raises(InferError):
+            apply_request_deadline(req)
+
+
+# -- matrix: client timeout -------------------------------------------------
+
+class TestClientTimeout:
+    """A server that answers too slowly surfaces as a *typed* deadline
+    failure on every client API."""
+
+    DELAY = {"execute_delay_ms": 1500}
+
+    def test_grpc_sync_client_timeout(self, harness):
+        with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer(MODEL, _grpc_inputs(_x()), parameters=self.DELAY,
+                        client_timeout=0.2)
+            assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+
+    def test_grpc_async_get_result_timeout_is_typed(self, harness):
+        with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+            handle = c.async_infer(MODEL, _grpc_inputs(_x()),
+                                   parameters=self.DELAY)
+            with pytest.raises(InferenceServerException) as ei:
+                handle.get_result(timeout=0.2)
+            assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+            handle.cancel()
+
+    def test_grpc_get_result_nonblocking(self, harness):
+        with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+            handle = c.async_infer(MODEL, _grpc_inputs(_x()),
+                                   parameters=self.DELAY)
+            # block=False polls: no response yet must raise immediately,
+            # not hang on the in-flight call
+            t0 = time.monotonic()
+            with pytest.raises(InferenceServerException) as ei:
+                handle.get_result(block=False)
+            assert time.monotonic() - t0 < 1.0
+            assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+            handle.cancel()
+
+    def test_health_retries_counted_and_capped(self):
+        # connection-refused health probe under a policy: retried (and
+        # each committed retry observable) before the failure surfaces
+        policy = RetryPolicy(max_attempts=2, initial_backoff_s=0.001,
+                             seed=0)
+        before = sum(
+            s["retries"] for s in telemetry().snapshot()["requests"]
+            if s["protocol"] == "grpc" and s["method"] == "health")
+        with grpcclient.InferenceServerClient(
+                "127.0.0.1:9", retry_policy=policy) as c:
+            with pytest.raises(InferenceServerException):
+                c.is_server_live(client_timeout=1.0)
+        after = sum(
+            s["retries"] for s in telemetry().snapshot()["requests"]
+            if s["protocol"] == "grpc" and s["method"] == "health")
+        assert after == before + 1  # max_attempts=2 -> exactly one retry
+
+    def test_grpc_aio_client_timeout(self, harness):
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(harness.grpc_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    await c.infer(MODEL, _grpc_inputs(_x()),
+                                  parameters=self.DELAY, client_timeout=0.2)
+                assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+
+        asyncio.run(main())
+
+    def test_http_sync_deadline_budget(self, harness):
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer(MODEL, _http_inputs(_x()), parameters=self.DELAY,
+                        deadline_s=0.25)
+            assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+
+    def test_http_async_get_result_timeout_is_typed(self, harness):
+        with httpclient.InferenceServerClient(harness.http_url,
+                                              concurrency=2) as c:
+            handle = c.async_infer(MODEL, _http_inputs(_x()),
+                                   parameters=self.DELAY)
+            with pytest.raises(InferenceServerException) as ei:
+                handle.get_result(timeout=0.2)
+            assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+
+    def test_http_aio_deadline_budget(self, harness):
+        from triton_client_tpu.http.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(harness.http_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    await c.infer(MODEL, _http_inputs(_x()),
+                                  parameters=self.DELAY, deadline_s=0.25)
+                assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+
+        asyncio.run(main())
+
+
+# -- matrix: server-side deadline ------------------------------------------
+
+class TestServerDeadline:
+    """An expired deadline is rejected at dequeue with zero compute: the
+    v2 timeout parameter (1 µs — already blown by the time the core sees
+    it) produces 504/DEADLINE_EXCEEDED, increments
+    nv_inference_deadline_exceeded_total, and the pinned flight record's
+    span tree has no COMPUTE child."""
+
+    def _count(self, harness):
+        return harness.core.deadline_exceeded_by_model.get(MODEL, 0)
+
+    def test_http_sync(self, harness):
+        before = self._count(harness)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer(MODEL, _http_inputs(_x()), timeout=1)
+            assert ei.value.status() == "504"
+        assert self._count(harness) == before + 1
+
+    def test_grpc_sync(self, harness):
+        before = self._count(harness)
+        with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer(MODEL, _grpc_inputs(_x()), timeout=1)
+            assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+        assert self._count(harness) == before + 1
+
+    def test_http_aio(self, harness):
+        from triton_client_tpu.http.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(harness.http_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    await c.infer(MODEL, _http_inputs(_x()), timeout=1)
+                assert ei.value.status() == "504"
+
+        before = self._count(harness)
+        asyncio.run(main())
+        assert self._count(harness) == before + 1
+
+    def test_grpc_aio(self, harness):
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(harness.grpc_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    await c.infer(MODEL, _grpc_inputs(_x()), timeout=1)
+                assert ei.value.status() == "StatusCode.DEADLINE_EXCEEDED"
+
+        before = self._count(harness)
+        asyncio.run(main())
+        assert self._count(harness) == before + 1
+
+    def test_decoupled_stream_deadline_enforced(self):
+        registry = ModelRegistry()
+        registry.register_model(zoo.make_square_int32())
+        core = InferenceCore(registry)
+
+        async def main():
+            req = InferRequest(
+                model_name="square_int32",
+                inputs=[InputTensor("IN", "INT32", (1,),
+                                    data=np.array([3], np.int32))],
+                deadline_ns=time.monotonic_ns() - 1)  # already expired
+            with pytest.raises(InferError) as ei:
+                async for _ in core.infer_stream(req):
+                    pass
+            assert ei.value.http_status == 504
+            # the producer never ran: zero compute for an expired stream
+            assert core.deadline_exceeded_by_model == {"square_int32": 1}
+            await core.shutdown(drain_s=0.1)
+
+        asyncio.run(main())
+
+    def test_no_compute_span_and_metrics_family(self, harness):
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            with pytest.raises(InferenceServerException):
+                c.infer(MODEL, _http_inputs(_x()), timeout=1)
+        snap = harness.core.flight_recorder.snapshot(model=MODEL)
+        expired = [o for o in snap["outliers"]
+                   if "deadline" in (o["outcome"] or "")]
+        assert expired, "expired request must be pinned as a failure"
+        span_names = {s["name"] for s in expired[-1]["spans"]}
+        assert "COMPUTE" not in span_names  # rejected before any compute
+        text = requests.get(
+            f"http://{harness.http_url}/metrics", timeout=10).text
+        assert ("nv_inference_deadline_exceeded_total"
+                f'{{model="{MODEL}"}}') in text
+
+
+# -- matrix: queue shed (admission control) --------------------------------
+
+class _Occupier:
+    """Holds the model busy with one slow in-flight request, entered once
+    the server's pending gauge actually shows it (no sleep races)."""
+
+    def __init__(self, harness, delay_ms=1200):
+        self._harness = harness
+        self._delay = delay_ms
+        self._thread = None
+
+    def __enter__(self):
+        def _run():
+            try:
+                with httpclient.InferenceServerClient(
+                        self._harness.http_url) as c:
+                    c.infer(MODEL, _http_inputs(_x()),
+                            parameters={"execute_delay_ms": self._delay})
+            except Exception:
+                pass  # teardown races are fine; occupancy is what matters
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        stats = self._harness.core.registry.get(MODEL).stats
+        deadline = time.monotonic() + 10.0
+        while stats.pending_count < 1:
+            if time.monotonic() > deadline:
+                raise RuntimeError("occupier request never became pending")
+            time.sleep(0.005)
+        return self
+
+    def __exit__(self, *exc):
+        self._thread.join(timeout=30)
+
+
+class TestQueueShed:
+    def test_http_sync_shed_with_retry_after(self, harness):
+        harness.core.queue_limits[MODEL] = 1
+        before = harness.core.rejected_by_model.get(MODEL, 0)
+        with _Occupier(harness):
+            with httpclient.InferenceServerClient(harness.http_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    c.infer(MODEL, _http_inputs(_x()))
+        assert ei.value.status() == "429"
+        assert ei.value.retry_after_s == pytest.approx(
+            harness.core.shed_retry_after_s)
+        assert harness.core.rejected_by_model[MODEL] == before + 1
+        text = requests.get(
+            f"http://{harness.http_url}/metrics", timeout=10).text
+        assert f'nv_inference_rejected_total{{model="{MODEL}"}}' in text
+
+    def test_grpc_sync_shed_resource_exhausted_with_pushback(self, harness):
+        harness.core.queue_limits[MODEL] = 1
+        with _Occupier(harness):
+            with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    c.infer(MODEL, _grpc_inputs(_x()))
+        assert ei.value.status() == "StatusCode.RESOURCE_EXHAUSTED"
+        # pushback travels as retry-after-ms trailing metadata
+        assert ei.value.retry_after_s == pytest.approx(
+            harness.core.shed_retry_after_s)
+
+    def test_http_aio_shed(self, harness):
+        from triton_client_tpu.http.aio import InferenceServerClient
+
+        harness.core.queue_limits[MODEL] = 1
+
+        async def main():
+            async with InferenceServerClient(harness.http_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    await c.infer(MODEL, _http_inputs(_x()))
+                return ei.value
+
+        with _Occupier(harness):
+            err = asyncio.run(main())
+        assert err.status() == "429"
+
+    def test_grpc_aio_shed(self, harness):
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+
+        harness.core.queue_limits[MODEL] = 1
+
+        async def main():
+            async with InferenceServerClient(harness.grpc_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    await c.infer(MODEL, _grpc_inputs(_x()))
+                return ei.value
+
+        with _Occupier(harness):
+            err = asyncio.run(main())
+        assert err.status() == "StatusCode.RESOURCE_EXHAUSTED"
+
+    def test_grpc_stream_shed_carries_status(self, harness):
+        # the bidi wire has no per-message grpc code: shed/deadline errors
+        # ride in-band with a "[NNN] " prefix the client maps back to the
+        # unary status spelling, so streams stay classifiable
+        import queue as q
+
+        harness.core.queue_limits[MODEL] = 1
+        done = q.Queue()
+        with _Occupier(harness):
+            c = grpcclient.InferenceServerClient(harness.grpc_url)
+            try:
+                c.start_stream(callback=lambda result, error: done.put(error))
+                c.async_stream_infer(MODEL, _grpc_inputs(_x()))
+                err = done.get(timeout=20)
+            finally:
+                c.stop_stream()
+                c.close()
+        assert err is not None
+        assert err.status() == "StatusCode.RESOURCE_EXHAUSTED"
+        assert "full" in str(err)
+
+    def test_config_parameter_sets_default_bound(self, harness):
+        # per-model bound from the model config's max_queue_size parameter
+        from triton_client_tpu.server.model import make_config
+
+        cfg = make_config("q", inputs=[("I", "INT32", [-1])],
+                          outputs=[("O", "INT32", [-1])],
+                          parameters={"max_queue_size": "7"})
+
+        class _M:
+            config = cfg
+            name = "q"
+
+        assert harness.core.max_queue_size(_M()) == 7
+
+
+# -- matrix: retry succeeds after one injected fault ------------------------
+
+class TestRetryAfterFault:
+    POLICY = dict(max_attempts=3, retry_infer=True, initial_backoff_s=0.01)
+
+    def test_http_sync(self, harness):
+        harness.core.chaos = ChaosInjector(rate=1.0, kinds=["error"],
+                                           max_faults=1, seed=1)
+        before = _retries_for(MODEL, "http")
+        x = _x()
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            r = c.infer(MODEL, _http_inputs(x),
+                        retry_policy=RetryPolicy(**self.POLICY))
+        np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        assert _retries_for(MODEL, "http") == before + 1
+
+    def test_grpc_sync(self, harness):
+        harness.core.chaos = ChaosInjector(rate=1.0, kinds=["error"],
+                                           max_faults=1, seed=2)
+        before = _retries_for(MODEL, "grpc")
+        x = _x()
+        with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+            r = c.infer(MODEL, _grpc_inputs(x),
+                        retry_policy=RetryPolicy(**self.POLICY))
+        np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        assert _retries_for(MODEL, "grpc") == before + 1
+
+    def test_http_aio(self, harness):
+        from triton_client_tpu.http.aio import InferenceServerClient
+
+        harness.core.chaos = ChaosInjector(rate=1.0, kinds=["error"],
+                                           max_faults=1, seed=3)
+        before = _retries_for(MODEL, "http_aio")
+        x = _x()
+
+        async def main():
+            async with InferenceServerClient(harness.http_url) as c:
+                return await c.infer(MODEL, _http_inputs(x),
+                                     retry_policy=RetryPolicy(**self.POLICY))
+
+        r = asyncio.run(main())
+        np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        assert _retries_for(MODEL, "http_aio") == before + 1
+
+    def test_grpc_aio(self, harness):
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+
+        harness.core.chaos = ChaosInjector(rate=1.0, kinds=["error"],
+                                           max_faults=1, seed=4)
+        before = _retries_for(MODEL, "grpc_aio")
+        x = _x()
+
+        async def main():
+            async with InferenceServerClient(harness.grpc_url) as c:
+                return await c.infer(MODEL, _grpc_inputs(x),
+                                     retry_policy=RetryPolicy(**self.POLICY))
+
+        r = asyncio.run(main())
+        np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        assert _retries_for(MODEL, "grpc_aio") == before + 1
+
+    def test_http_async_infer_honors_policy(self, harness):
+        harness.core.chaos = ChaosInjector(rate=1.0, kinds=["error"],
+                                           max_faults=1, seed=8)
+        x = _x()
+        with httpclient.InferenceServerClient(harness.http_url,
+                                              concurrency=2) as c:
+            handle = c.async_infer(MODEL, _http_inputs(x),
+                                   retry_policy=RetryPolicy(**self.POLICY))
+            r = handle.get_result(timeout=30)
+        np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+
+    def test_http_connection_abort_retried(self, harness):
+        # chaos "abort" tears the transport mid-response: the client sees a
+        # connection-class failure, which the policy retries for opted-in
+        # infer — the e2e proof that the abort path and the connection
+        # classifier line up
+        harness.core.chaos = ChaosInjector(rate=1.0, kinds=["abort"],
+                                           max_faults=1, seed=5)
+        x = _x()
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            r = c.infer(MODEL, _http_inputs(x),
+                        retry_policy=RetryPolicy(**self.POLICY))
+        np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+
+    def test_injected_fault_pinned_with_chaos_marker(self, harness):
+        harness.core.chaos = ChaosInjector(rate=1.0, kinds=["error"],
+                                           max_faults=1, seed=6)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            with pytest.raises(InferenceServerException):
+                c.infer(MODEL, _http_inputs(_x()))  # no retry policy
+        snap = harness.core.flight_recorder.snapshot(model=MODEL)
+        chaotic = [o for o in snap["outliers"] if o["chaos"] == "error"]
+        assert chaotic
+        assert chaotic[-1]["capture_reason"] == "failed"
+
+    def test_client_retry_counter_rendered_in_prometheus(self, harness):
+        harness.core.chaos = ChaosInjector(rate=1.0, kinds=["error"],
+                                           max_faults=1, seed=7)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            c.infer(MODEL, _http_inputs(_x()),
+                    retry_policy=RetryPolicy(**self.POLICY))
+        text = telemetry().render_prometheus()
+        assert "nv_client_retries_total" in text
+
+
+# -- acceptance: chaos run at concurrency 8 --------------------------------
+
+def _chaos_run(harness, n_requests, concurrency, rate, seed,
+               kinds=("error",)):
+    """Closed-loop run against injected TRANSIENT faults: every caller
+    uses RetryPolicy(max_attempts=3); returns caller-visible errors.
+
+    ``transient_s=1.0`` is what makes "zero caller-visible errors" a
+    theorem instead of a coin flip: a retry (backoff ≤ ~60 ms total)
+    always lands inside the fault's recovery window.  With independent
+    per-attempt draws, ~rate**3 of requests would exhaust the policy no
+    matter what — that's a correctness property of retries against
+    *transient* faults, not a test convenience."""
+    harness.core.chaos = ChaosInjector(
+        rate=rate, kinds=list(kinds), seed=seed, transient_s=1.0)
+    policy_kwargs = dict(max_attempts=3, retry_infer=True,
+                         initial_backoff_s=0.01, seed=seed)
+    errors = []
+    done = [0]
+    lock = threading.Lock()
+    x = _x()
+
+    def worker():
+        try:
+            with httpclient.InferenceServerClient(harness.http_url) as c:
+                policy = RetryPolicy(**policy_kwargs)
+                while True:
+                    with lock:
+                        if done[0] >= n_requests:
+                            return
+                        done[0] += 1
+                    r = c.infer(MODEL, _http_inputs(x), retry_policy=policy)
+                    np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    return errors
+
+
+def test_chaos_run_zero_caller_visible_errors(harness):
+    """Acceptance: 10% transient faults at concurrency 8 complete with
+    zero caller-visible errors under RetryPolicy(max_attempts=3)."""
+    errors = _chaos_run(harness, n_requests=80, concurrency=8,
+                        rate=0.10, seed=11)
+    assert errors == []
+    assert harness.core.chaos.injected_total > 0  # faults actually fired
+
+
+@pytest.mark.slow
+def test_chaos_soak(harness):
+    """Soak sibling of the acceptance run: an order of magnitude more
+    requests, mixed fault kinds (errors + connection aborts)."""
+    errors = _chaos_run(harness, n_requests=800, concurrency=8,
+                        rate=0.10, seed=23, kinds=("error", "abort"))
+    assert errors == []
+
+
+# -- graceful drain ---------------------------------------------------------
+
+class TestGracefulDrain:
+    def test_drain_finishes_in_flight_and_refuses_new(self):
+        registry = ModelRegistry()
+        registry.register_model(zoo.make_custom_identity_int32())
+        core = InferenceCore(registry)
+
+        def _req(delay_ms=0):
+            params = {"execute_delay_ms": delay_ms} if delay_ms else {}
+            return InferRequest(
+                model_name=MODEL, parameters=params,
+                inputs=[InputTensor("INPUT0", "INT32", (1, 4), data=_x())])
+
+        async def main():
+            in_flight = asyncio.create_task(core.infer(_req(delay_ms=250)))
+            await asyncio.sleep(0.05)
+            shutdown = asyncio.create_task(core.shutdown(drain_s=5.0))
+            await asyncio.sleep(0.01)
+            # new requests are refused while draining
+            with pytest.raises(InferError) as ei:
+                await core.infer(_req())
+            assert ei.value.http_status == 503
+            # ...but the in-flight one runs to completion
+            resp = await in_flight
+            assert resp.outputs[0].data is not None
+            await shutdown
+
+        asyncio.run(main())
+        assert not core.accepting
+        assert not core.ready()
+
+    def test_chaos_abort_is_503_infer_error(self):
+        e = ChaosAbort()
+        assert isinstance(e, InferError)
+        assert e.http_status == 503

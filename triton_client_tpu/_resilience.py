@@ -1,0 +1,332 @@
+"""Client-side resilience: retry policy, backoff, and deadline budgets.
+
+The reference client exposes a full timeout surface (client_timeout on every
+API; the gRPC path serializes a per-request ``timeout`` int64 parameter) but
+recovers from nothing: one flaky connection or one overloaded model surfaces
+straight to every caller.  This module is the client half of the resilience
+layer, shared by all four clients (``http``, ``http.aio``, ``grpc``,
+``grpc.aio``):
+
+* :class:`RetryPolicy` — max attempts, exponential backoff with **full
+  jitter** (Dean & Barroso, "The Tail at Scale": synchronized retries are
+  how one hiccup becomes an outage), gated on *retryable* failures only:
+  connection errors, HTTP 429/503, gRPC UNAVAILABLE/RESOURCE_EXHAUSTED.
+  Server pushback (HTTP ``Retry-After`` / gRPC ``retry-after-ms`` trailing
+  metadata) overrides the computed backoff, per the gRPC A6 retry design.
+* **Idempotency-aware defaults** — health/metadata calls are always safe to
+  retry; ``infer`` is retried only when the caller opts in
+  (``retry_infer=True``), because a request that timed out may still have
+  executed.
+* A per-request **deadline budget** (``deadline_s``): one wall-clock budget
+  capping the *total* time across every attempt (not per attempt), the
+  remainder of which is propagated to the server — as the v2 ``timeout``
+  parameter (microseconds) on gRPC and the ``triton-timeout-us`` header on
+  HTTP — so the server can drop a request whose client already gave up
+  instead of burning compute on it.
+
+Every retry is observable: ``nv_client_retries_total`` in the client
+telemetry registry and a ``RETRY`` span (covering the failed attempt) in the
+client trace file when tracing is enabled.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Optional
+
+from ._telemetry import telemetry
+from .utils import InferenceServerException
+
+__all__ = [
+    "RetryPolicy",
+    "call_with_retry",
+    "call_with_retry_async",
+    "deadline_exceeded_error",
+    "is_connection_error",
+    "normalized_status",
+]
+
+#: Statuses a policy retries by default: HTTP overload/unavailable and their
+#: gRPC siblings.  DEADLINE_EXCEEDED is deliberately absent — retrying a
+#: blown deadline only blows it further.
+DEFAULT_RETRYABLE_STATUSES = frozenset(
+    {"429", "503", "UNAVAILABLE", "RESOURCE_EXHAUSTED"})
+
+#: Exception class names (anywhere in the MRO) classified as connection-level
+#: failures — retryable without a status code.  Name-based so this module
+#: needs neither urllib3 nor aiohttp nor grpc imported.
+_CONNECTION_EXC_NAMES = frozenset({
+    "ConnectionError", "ConnectionResetError", "ConnectionRefusedError",
+    "ConnectionAbortedError", "BrokenPipeError",
+    # urllib3
+    "ProtocolError", "NewConnectionError", "MaxRetryError",
+    "NameResolutionError",
+    # aiohttp
+    "ClientConnectionError", "ClientConnectorError", "ClientOSError",
+    "ServerDisconnectedError",
+})
+
+
+#: Exception class names classified as transport timeouts.  A deadline-
+#: budgeted attempt whose transport timed out surfaces as the typed
+#: deadline error, not a protocol-specific timeout class.
+_TIMEOUT_EXC_NAMES = frozenset({
+    "TimeoutError",             # builtin, socket.timeout, asyncio (3.11+),
+                                # concurrent.futures (distinct pre-3.11)
+    "ReadTimeoutError", "ConnectTimeoutError",   # urllib3
+    "ServerTimeoutError",                        # aiohttp
+})
+
+
+def is_connection_error(exc: BaseException) -> bool:
+    """True when ``exc`` is a transport/connection-level failure (the server
+    may never have seen the request)."""
+    if isinstance(exc, (ConnectionError, BrokenPipeError)):
+        return True
+    return any(k.__name__ in _CONNECTION_EXC_NAMES
+               for k in type(exc).__mro__)
+
+
+def is_timeout_error(exc: BaseException) -> bool:
+    """True when ``exc`` is a transport-timeout failure."""
+    if isinstance(exc, TimeoutError):
+        return True
+    return any(k.__name__ in _TIMEOUT_EXC_NAMES
+               for k in type(exc).__mro__)
+
+
+def normalized_status(exc: BaseException) -> Optional[str]:
+    """The status carried by a client exception, normalized across
+    protocols: ``"429"``/``"503"`` (HTTP) or the bare gRPC code name
+    (``"UNAVAILABLE"``, stripped of the ``StatusCode.`` prefix)."""
+    status = getattr(exc, "_status", None)
+    if status is None:
+        return None
+    status = str(status)
+    if status.startswith("StatusCode."):
+        status = status[len("StatusCode."):]
+    return status
+
+
+def deadline_exceeded_error(msg: str = "deadline exceeded before the "
+                            "request completed") -> InferenceServerException:
+    """The typed client-side deadline failure (same status spelling as the
+    gRPC mapping so callers match one string on either protocol)."""
+    return InferenceServerException(
+        msg=msg, status="StatusCode.DEADLINE_EXCEEDED")
+
+
+class RetryPolicy:
+    """Retry/backoff policy shared by all four clients.
+
+    Parameters
+    ----------
+    max_attempts:
+        Total attempts including the first (1 = no retries).
+    initial_backoff_s / max_backoff_s / backoff_multiplier:
+        Exponential backoff envelope.  The actual delay before attempt
+        ``n+1`` is drawn uniformly from ``[0, min(max, initial * mult**n)]``
+        (full jitter).
+    retry_infer:
+        Whether ``infer`` calls may retry.  Off by default: an inference
+        that timed out may have executed, and re-running it is only safe
+        when the caller knows the model is idempotent.  Health/metadata
+        calls are always retryable.
+    retryable_statuses:
+        Normalized statuses (see :func:`normalized_status`) that gate a
+        retry.  Connection-level failures are always retryable.
+    deadline_s:
+        Default per-request deadline (seconds, total across attempts)
+        applied when the call site doesn't pass its own.
+    seed:
+        Seeds the jitter RNG — deterministic backoff sequences for tests.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        initial_backoff_s: float = 0.05,
+        max_backoff_s: float = 2.0,
+        backoff_multiplier: float = 2.0,
+        retry_infer: bool = False,
+        retryable_statuses=DEFAULT_RETRYABLE_STATUSES,
+        deadline_s: Optional[float] = None,
+        seed: Optional[int] = None,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.initial_backoff_s = float(initial_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.retry_infer = bool(retry_infer)
+        self.retryable_statuses = frozenset(retryable_statuses)
+        self.deadline_s = deadline_s
+        self._rng = random.Random(seed)
+
+    # -- decisions ---------------------------------------------------------
+    def should_retry(self, exc: BaseException, method: str,
+                     attempt: int) -> bool:
+        """Whether a failed ``attempt`` (1-based) of a ``method``-class call
+        ("infer" / "health" / "metadata") may be retried."""
+        if attempt >= self.max_attempts:
+            return False
+        if method == "infer" and not self.retry_infer:
+            return False
+        if is_connection_error(exc) or is_timeout_error(exc):
+            # a per-attempt transport timeout with budget left is as
+            # transient as a connection drop — retryable (a timeout whose
+            # DEADLINE budget is spent never reaches this: the retry loop
+            # converts it to the terminal typed deadline failure first)
+            return True
+        status = normalized_status(exc)
+        return status is not None and status in self.retryable_statuses
+
+    def backoff_s(self, attempt: int,
+                  retry_after_s: Optional[float] = None) -> float:
+        """Delay before the next attempt.  Server pushback (``Retry-After``
+        / gRPC ``retry-after-ms``) overrides the computed backoff outright
+        (gRPC A6 semantics: the server knows its own recovery horizon)."""
+        if retry_after_s is not None and retry_after_s >= 0:
+            return float(retry_after_s)
+        cap = min(self.max_backoff_s,
+                  self.initial_backoff_s
+                  * self.backoff_multiplier ** (attempt - 1))
+        return self._rng.uniform(0.0, cap)
+
+
+def _record_retry(model: str, protocol: str, method_name: str,
+                  request_id: str, attempt_start_ns: int) -> None:
+    """One retry's observability: the ``nv_client_retries_total`` counter
+    plus (when client tracing is on) a ``RETRY`` span covering the failed
+    attempt — so a trace join shows *why* a request's client latency
+    dwarfs its server latency."""
+    tel = telemetry()
+    tel.record_retry(model, protocol, method_name)
+    if tel.tracing_enabled:
+        tel.record_client_trace(
+            request_id, model, protocol, method_name,
+            spans=[("RETRY", attempt_start_ns, time.monotonic_ns())],
+            ok=False)
+
+
+def call_with_retry(
+    policy: Optional[RetryPolicy],
+    attempt_fn: Callable[[Optional[float], int], Any],
+    method: str = "infer",
+    deadline_s: Optional[float] = None,
+    retry_meta=None,
+) -> Any:
+    """Run ``attempt_fn(remaining_s, attempt)`` under ``policy``.
+
+    ``remaining_s`` is what's left of the deadline budget (None when no
+    deadline) — the call site folds it into its transport timeout and
+    propagates it to the server.  ``retry_meta`` is ``(model, protocol,
+    method_name, request_id)`` for retry telemetry, or None to skip it.
+    With ``policy=None`` this is a single attempt under the deadline.
+    """
+    if deadline_s is None and policy is not None:
+        deadline_s = policy.deadline_s
+    deadline = (time.monotonic() + deadline_s
+                if deadline_s is not None else None)
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise deadline_exceeded_error()
+        t0_ns = time.monotonic_ns()
+        try:
+            return attempt_fn(remaining, attempt)
+        except BaseException as e:
+            if deadline is not None and is_timeout_error(e) \
+                    and time.monotonic() >= deadline - 1e-3:
+                # the deadline budget (not a shorter per-attempt
+                # client/pool timeout) drove this transport timeout —
+                # surface the typed deadline failure, uniform across all
+                # four transports, instead of the raw urllib3/aiohttp/
+                # futures timeout class.  A timeout with budget left
+                # falls through to normal retry classification.
+                raise deadline_exceeded_error() from e
+            if policy is None or not policy.should_retry(e, method, attempt):
+                raise
+            delay = policy.backoff_s(
+                attempt, retry_after_s=getattr(e, "retry_after_s", None))
+            if deadline is not None \
+                    and time.monotonic() + delay >= deadline:
+                raise  # the budget can't cover another attempt
+            # recorded only once the retry is actually committed — an
+            # abandoned retry must not inflate nv_client_retries_total
+            if retry_meta is not None:
+                _record_retry(*retry_meta, t0_ns)
+            time.sleep(delay)
+
+
+async def call_with_retry_async(
+    policy: Optional[RetryPolicy],
+    attempt_fn,
+    method: str = "infer",
+    deadline_s: Optional[float] = None,
+    retry_meta=None,
+) -> Any:
+    """Async sibling of :func:`call_with_retry` — ``attempt_fn`` is an
+    async callable; backoff awaits instead of blocking the loop."""
+    import asyncio
+
+    if deadline_s is None and policy is not None:
+        deadline_s = policy.deadline_s
+    deadline = (time.monotonic() + deadline_s
+                if deadline_s is not None else None)
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise deadline_exceeded_error()
+        t0_ns = time.monotonic_ns()
+        try:
+            return await attempt_fn(remaining, attempt)
+        except BaseException as e:
+            if deadline is not None and (
+                    is_timeout_error(e)
+                    or isinstance(e, asyncio.TimeoutError)) \
+                    and time.monotonic() >= deadline - 1e-3:
+                # same budget-spent typed-deadline normalization as the
+                # sync loop (asyncio.TimeoutError is distinct pre-3.11)
+                raise deadline_exceeded_error() from e
+            if policy is None or not policy.should_retry(e, method, attempt):
+                raise
+            delay = policy.backoff_s(
+                attempt, retry_after_s=getattr(e, "retry_after_s", None))
+            if deadline is not None \
+                    and time.monotonic() + delay >= deadline:
+                raise
+            # committed-retries only, as in the sync loop
+            if retry_meta is not None:
+                _record_retry(*retry_meta, t0_ns)
+            await asyncio.sleep(delay)
+
+
+def min_timeout(client_timeout: Optional[float],
+                remaining_s: Optional[float]) -> Optional[float]:
+    """The effective per-attempt transport timeout: the caller's
+    client_timeout capped by what's left of the deadline budget."""
+    if remaining_s is None:
+        return client_timeout
+    if client_timeout is None:
+        return remaining_s
+    return min(client_timeout, remaining_s)
+
+
+def remaining_us(remaining_s: float) -> int:
+    """The remaining deadline budget in the v2 wire unit (microseconds,
+    floor 1 so an about-to-expire budget still propagates as expired-on-
+    arrival rather than vanishing).  One definition for all four clients —
+    the gRPC ``timeout`` parameter and the HTTP ``triton-timeout-us``
+    header must never drift apart on unit or clamp."""
+    return max(1, int(remaining_s * 1e6))

@@ -264,12 +264,13 @@ class LatencyHistogram:
 
 
 class _RequestSeries:
-    __slots__ = ("success", "failure", "request_bytes", "response_bytes",
-                 "latency")
+    __slots__ = ("success", "failure", "retries", "request_bytes",
+                 "response_bytes", "latency")
 
     def __init__(self) -> None:
         self.success = 0
         self.failure = 0
+        self.retries = 0
         self.request_bytes = 0
         self.response_bytes = 0
         self.latency = LatencyHistogram()
@@ -353,6 +354,15 @@ class ClientTelemetry:
                 })
             except Exception:
                 pass  # a broken hook must never fail the request path
+
+    def record_retry(self, model: str, protocol: str, method: str) -> None:
+        """Count one retried attempt (the resilience layer calls this per
+        backoff, BEFORE the retry runs — a retry that then succeeds still
+        counted, which is the point: nv_client_retries_total measures how
+        hard the client is working, not how often it loses)."""
+        s = self._series((model, protocol, method))
+        with s.latency._lock:
+            s.retries += 1
 
     def record_shm_register(self, protocol: str, kind: str,
                             byte_size: int) -> None:
@@ -481,6 +491,7 @@ class ClientTelemetry:
             entry = {
                 "model": key[0], "protocol": key[1], "method": key[2],
                 "success": s.success, "failure": s.failure,
+                "retries": s.retries,
                 "request_bytes": s.request_bytes,
                 "response_bytes": s.response_bytes,
             }
@@ -538,6 +549,12 @@ class ClientTelemetry:
             "counter",
             [f"nv_client_inference_request_failure{{{labels(k)}}} "
              f"{series[k].failure}" for k in req_keys])
+        family(
+            "nv_client_retries_total",
+            "Number of retried client request attempts (resilience layer)",
+            "counter",
+            [f"nv_client_retries_total{{{labels(k)}}} "
+             f"{series[k].retries}" for k in req_keys])
         family(
             "nv_client_request_bytes_total",
             "Cumulative serialized request payload bytes sent",

@@ -18,6 +18,8 @@ import grpc
 
 from .._client import InferenceServerClientBase
 from .._request import Request
+from .._resilience import (RetryPolicy, call_with_retry, min_timeout,
+                           remaining_us)
 from .._telemetry import (new_trace_context, telemetry,
                           traceparent_from_metadata)
 from ..protocol import inference_pb2 as pb
@@ -72,11 +74,19 @@ class InferAsyncRequest:
 
     def get_result(self, block: bool = True, timeout: Optional[float] = None) -> InferResult:
         try:
-            response = self._call.result(timeout=timeout)
+            # block=False polls: a zero timeout raises immediately when
+            # the response hasn't arrived (HTTP-sibling semantics)
+            response = self._call.result(timeout=timeout if block else 0)
+        except grpc.FutureTimeoutError:
+            # typed deadline failure, not the raw gRPC error: callers match
+            # the same status string a server-side DEADLINE_EXCEEDED maps to
+            from ..utils import InferenceServerException
+
+            raise InferenceServerException(
+                msg="timed out waiting for inference response",
+                status="StatusCode.DEADLINE_EXCEEDED") from None
         except grpc.RpcError as rpc_error:
             raise_error_grpc(rpc_error)
-        except grpc.FutureTimeoutError:
-            raise_error("failed to obtain inference response")
         return InferResult(response)
 
     def cancel(self) -> bool:
@@ -140,8 +150,13 @@ class InferenceServerClient(InferenceServerClientBase):
         creds: Optional[grpc.ChannelCredentials] = None,
         keepalive_options: Optional[KeepAliveOptions] = None,
         channel_args: Optional[List[tuple]] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         super().__init__()
+        # client-level resilience default: health/metadata calls retry
+        # under it unconditionally; infer honors it per its retry_infer
+        # opt-in (a per-call retry_policy= overrides)
+        self._retry_policy = retry_policy
         self._verbose = verbose
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -186,74 +201,114 @@ class InferenceServerClient(InferenceServerClientBase):
         self._call_plugin(request)
         return tuple(request.headers.items())
 
+    def _with_retry(self, method_kind: str, fn):
+        """Run an idempotent (health/metadata) call under the client-level
+        retry policy, if one is configured.  ``fn(timeout)`` receives the
+        per-attempt transport timeout (client_timeout capped by what's
+        left of the policy's deadline budget, when it has one)."""
+        if self._retry_policy is None:
+            return fn(None)
+        return call_with_retry(
+            self._retry_policy,
+            lambda remaining, _attempt: fn(remaining),
+            method=method_kind,
+            retry_meta=("", "grpc", method_kind, ""))
+
     # -- health / metadata -------------------------------------------------
     def is_server_live(self, headers=None, client_timeout=None) -> bool:
-        try:
-            response = self._client_stub.ServerLive(
-                pb.ServerLiveRequest(), metadata=self._get_metadata(headers),
-                timeout=client_timeout,
-            )
-            if self._verbose:
-                print(response)
-            return response.live
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        def _call(remaining):
+            try:
+                response = self._client_stub.ServerLive(
+                    pb.ServerLiveRequest(),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                if self._verbose:
+                    print(response)
+                return response.live
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return self._with_retry("health", _call)
 
     def is_server_ready(self, headers=None, client_timeout=None) -> bool:
-        try:
-            response = self._client_stub.ServerReady(
-                pb.ServerReadyRequest(), metadata=self._get_metadata(headers),
-                timeout=client_timeout,
-            )
-            return response.ready
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        def _call(remaining):
+            try:
+                response = self._client_stub.ServerReady(
+                    pb.ServerReadyRequest(),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return response.ready
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return self._with_retry("health", _call)
 
     def is_model_ready(self, model_name, model_version="", headers=None, client_timeout=None):
-        try:
-            response = self._client_stub.ModelReady(
-                pb.ModelReadyRequest(name=model_name, version=model_version),
-                metadata=self._get_metadata(headers), timeout=client_timeout,
-            )
-            return response.ready
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        def _call(remaining):
+            try:
+                response = self._client_stub.ModelReady(
+                    pb.ModelReadyRequest(name=model_name,
+                                         version=model_version),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return response.ready
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return self._with_retry("health", _call)
 
     def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
-        try:
-            response = self._client_stub.ServerMetadata(
-                pb.ServerMetadataRequest(), metadata=self._get_metadata(headers),
-                timeout=client_timeout,
-            )
-            if self._verbose:
-                print(response)
-            return _maybe_json(response, as_json)
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        def _call(remaining):
+            try:
+                response = self._client_stub.ServerMetadata(
+                    pb.ServerMetadataRequest(),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                if self._verbose:
+                    print(response)
+                return _maybe_json(response, as_json)
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return self._with_retry("metadata", _call)
 
     def get_model_metadata(
         self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
     ):
-        try:
-            response = self._client_stub.ModelMetadata(
-                pb.ModelMetadataRequest(name=model_name, version=model_version),
-                metadata=self._get_metadata(headers), timeout=client_timeout,
-            )
-            return _maybe_json(response, as_json)
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        def _call(remaining):
+            try:
+                response = self._client_stub.ModelMetadata(
+                    pb.ModelMetadataRequest(name=model_name,
+                                            version=model_version),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return _maybe_json(response, as_json)
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return self._with_retry("metadata", _call)
 
     def get_model_config(
         self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
     ):
-        try:
-            response = self._client_stub.ModelConfig(
-                pb.ModelConfigRequest(name=model_name, version=model_version),
-                metadata=self._get_metadata(headers), timeout=client_timeout,
-            )
-            return _maybe_json(response, as_json)
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        def _call(remaining):
+            try:
+                response = self._client_stub.ModelConfig(
+                    pb.ModelConfigRequest(name=model_name,
+                                          version=model_version),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return _maybe_json(response, as_json)
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return self._with_retry("metadata", _call)
 
     # -- repository --------------------------------------------------------
     def get_model_repository_index(self, headers=None, as_json=False, client_timeout=None):
@@ -466,10 +521,57 @@ class InferenceServerClient(InferenceServerClientBase):
         headers=None,
         compression_algorithm=None,
         parameters=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
     ) -> InferResult:
-        """Synchronous inference (reference :1445-1572)."""
+        """Synchronous inference (reference :1445-1572).
+
+        ``retry_policy`` (or the client-level one) retries retryable
+        failures when ``retry_infer`` is opted in; ``deadline_s`` caps
+        total wall-clock across attempts and propagates the remaining
+        budget to the server via the v2 ``timeout`` parameter (µs)."""
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        if policy is None and deadline_s is None:
+            return self._infer_once(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout,
+                client_timeout, headers, compression_algorithm, parameters)
+        return call_with_retry(
+            policy,
+            lambda remaining, _attempt: self._infer_once(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout,
+                client_timeout, headers, compression_algorithm, parameters,
+                _remaining_s=remaining),
+            method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, "grpc", "infer", request_id))
+
+    def _infer_once(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+        _remaining_s=None,
+    ) -> InferResult:
         tel = telemetry()
         t_ser0 = time.monotonic_ns()
+        if timeout is None and _remaining_s is not None:
+            # remaining deadline budget as the v2 timeout parameter (µs),
+            # restamped per attempt: the server drops the request once it
+            # expires instead of burning compute for a caller that gave up
+            timeout = remaining_us(_remaining_s)
         request = get_inference_request(
             model_name, inputs, model_version, request_id, outputs,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
@@ -485,7 +587,7 @@ class InferenceServerClient(InferenceServerClientBase):
             response = self._client_stub.ModelInfer(
                 request,
                 metadata=metadata,
-                timeout=client_timeout,
+                timeout=min_timeout(client_timeout, _remaining_s),
                 compression=get_grpc_compression(compression_algorithm),
             )
             t_net1 = time.monotonic_ns()
@@ -530,7 +632,13 @@ class InferenceServerClient(InferenceServerClientBase):
 
         With ``callback``: invoked as ``callback(result, error)`` from a gRPC
         thread; returns a ``CallContext`` for cancellation.  Without:
-        returns an ``InferAsyncRequest`` whose ``get_result()`` blocks."""
+        returns an ``InferAsyncRequest`` whose ``get_result()`` blocks.
+
+        The client-level retry policy does NOT apply here: the call is a
+        single gRPC future whose cancellation handle the caller owns, and
+        re-issuing it behind that handle would detach cancel() from the
+        in-flight attempt.  Use ``infer`` (or the HTTP client's
+        ``async_infer``) for retried inference."""
         request = get_inference_request(
             model_name, inputs, model_version, request_id, outputs,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,

@@ -17,7 +17,7 @@ import grpc
 
 from ..utils import InferenceServerException, raise_error
 from ._infer_result import InferResult
-from ._utils import get_error_grpc
+from ._utils import get_error_grpc, stream_error_to_exception
 
 
 class _InferStream:
@@ -75,7 +75,10 @@ class _InferStream:
                     print(response)
                 result = error = None
                 if response.error_message != "":
-                    error = InferenceServerException(msg=response.error_message)
+                    # "[NNN] "-prefixed messages carry the server status
+                    # in-band — mapped back so stream failures classify
+                    # like unary ones (shed/deadline gating)
+                    error = stream_error_to_exception(response.error_message)
                 else:
                     result = InferResult(response.infer_response)
                 self._callback(result=result, error=error)

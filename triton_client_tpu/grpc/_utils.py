@@ -17,20 +17,59 @@ _RESERVED_PARAMS = (
 
 
 def get_error_grpc(rpc_error: grpc.RpcError):
-    """Map an RpcError to InferenceServerException (reference :33-45)."""
+    """Map an RpcError to InferenceServerException (reference :33-45).
+
+    Server pushback in ``retry-after-ms`` trailing metadata (sent with shed
+    load / drain refusals) lands on ``retry_after_s`` so the resilience
+    layer's backoff can honor it."""
     from ..utils import InferenceServerException
 
-    return InferenceServerException(
+    exc = InferenceServerException(
         msg=rpc_error.details(),
         status=str(rpc_error.code()),
         debug_details=rpc_error.debug_error_string()
         if hasattr(rpc_error, "debug_error_string")
         else None,
     )
+    try:
+        for key, value in (rpc_error.trailing_metadata() or ()):
+            if key == "retry-after-ms":
+                exc.retry_after_s = float(value) / 1e3
+    except Exception:
+        pass  # no trailing metadata on this error shape
+    return exc
 
 
 def raise_error_grpc(rpc_error: grpc.RpcError):
     raise get_error_grpc(rpc_error) from None
+
+
+#: In-band stream-error "[NNN] " prefix -> the unary status spelling, so
+#: stream failures classify identically (retry gating, perf_analyzer's
+#: rejected counting, DEADLINE matching).
+_STREAM_STATUS = {
+    "400": "StatusCode.INVALID_ARGUMENT",
+    "404": "StatusCode.NOT_FOUND",
+    "429": "StatusCode.RESOURCE_EXHAUSTED",
+    "500": "StatusCode.INTERNAL",
+    "503": "StatusCode.UNAVAILABLE",
+    "504": "StatusCode.DEADLINE_EXCEEDED",
+}
+
+
+def stream_error_to_exception(message: str):
+    """Typed exception for one in-band ``ModelStreamInferResponse``
+    error.  The server prefixes InferError messages with their HTTP
+    status (``"[429] ..."``) because the bidi wire carries no per-message
+    grpc code; unprefixed messages (defensive/model-raised strings) stay
+    status-less."""
+    import re
+
+    from ..utils import InferenceServerException
+
+    m = re.match(r"\[(\d{3})\] ", message)
+    status = _STREAM_STATUS.get(m.group(1)) if m else None
+    return InferenceServerException(msg=message, status=status)
 
 
 def get_inference_request(

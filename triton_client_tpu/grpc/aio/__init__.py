@@ -17,6 +17,8 @@ import grpc
 
 from ..._client import InferenceServerClientBase
 from ..._request import Request
+from ..._resilience import (RetryPolicy, call_with_retry_async, min_timeout,
+                            remaining_us)
 from ..._telemetry import telemetry, traceparent_from_metadata
 from ...protocol import inference_pb2 as pb
 from ...protocol.service import GRPCInferenceServiceStub
@@ -48,8 +50,12 @@ class InferenceServerClient(InferenceServerClientBase):
         creds: Optional[grpc.ChannelCredentials] = None,
         keepalive_options: Optional[KeepAliveOptions] = None,
         channel_args=None,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         super().__init__()
+        # client-level resilience default (see the sync client): health/
+        # metadata retry unconditionally, infer per its retry_infer opt-in
+        self._retry_policy = retry_policy
         self._verbose = verbose
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -86,72 +92,113 @@ class InferenceServerClient(InferenceServerClientBase):
         self._call_plugin(request)
         return tuple(request.headers.items())
 
+    async def _with_retry(self, method_kind: str, fn):
+        """Run an idempotent (health/metadata) call under the client-level
+        retry policy, if one is configured.  ``fn(timeout)`` receives the
+        per-attempt transport timeout."""
+        if self._retry_policy is None:
+            return await fn(None)
+
+        async def _attempt(remaining, _att):
+            return await fn(remaining)
+
+        return await call_with_retry_async(
+            self._retry_policy, _attempt, method=method_kind,
+            retry_meta=("", "grpc_aio", method_kind, ""))
+
     # -- health / metadata -------------------------------------------------
     async def is_server_live(self, headers=None, client_timeout=None) -> bool:
-        try:
-            response = await self._client_stub.ServerLive(
-                pb.ServerLiveRequest(), metadata=self._get_metadata(headers),
-                timeout=client_timeout,
-            )
-            return response.live
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        async def _call(remaining):
+            try:
+                response = await self._client_stub.ServerLive(
+                    pb.ServerLiveRequest(),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return response.live
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return await self._with_retry("health", _call)
 
     async def is_server_ready(self, headers=None, client_timeout=None) -> bool:
-        try:
-            response = await self._client_stub.ServerReady(
-                pb.ServerReadyRequest(), metadata=self._get_metadata(headers),
-                timeout=client_timeout,
-            )
-            return response.ready
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        async def _call(remaining):
+            try:
+                response = await self._client_stub.ServerReady(
+                    pb.ServerReadyRequest(),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return response.ready
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return await self._with_retry("health", _call)
 
     async def is_model_ready(
         self, model_name, model_version="", headers=None, client_timeout=None
     ) -> bool:
-        try:
-            response = await self._client_stub.ModelReady(
-                pb.ModelReadyRequest(name=model_name, version=model_version),
-                metadata=self._get_metadata(headers), timeout=client_timeout,
-            )
-            return response.ready
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        async def _call(remaining):
+            try:
+                response = await self._client_stub.ModelReady(
+                    pb.ModelReadyRequest(name=model_name,
+                                         version=model_version),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return response.ready
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return await self._with_retry("health", _call)
 
     async def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
-        try:
-            response = await self._client_stub.ServerMetadata(
-                pb.ServerMetadataRequest(), metadata=self._get_metadata(headers),
-                timeout=client_timeout,
-            )
-            return _maybe_json(response, as_json)
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        async def _call(remaining):
+            try:
+                response = await self._client_stub.ServerMetadata(
+                    pb.ServerMetadataRequest(),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return _maybe_json(response, as_json)
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return await self._with_retry("metadata", _call)
 
     async def get_model_metadata(
         self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
     ):
-        try:
-            response = await self._client_stub.ModelMetadata(
-                pb.ModelMetadataRequest(name=model_name, version=model_version),
-                metadata=self._get_metadata(headers), timeout=client_timeout,
-            )
-            return _maybe_json(response, as_json)
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        async def _call(remaining):
+            try:
+                response = await self._client_stub.ModelMetadata(
+                    pb.ModelMetadataRequest(name=model_name,
+                                            version=model_version),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return _maybe_json(response, as_json)
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return await self._with_retry("metadata", _call)
 
     async def get_model_config(
         self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
     ):
-        try:
-            response = await self._client_stub.ModelConfig(
-                pb.ModelConfigRequest(name=model_name, version=model_version),
-                metadata=self._get_metadata(headers), timeout=client_timeout,
-            )
-            return _maybe_json(response, as_json)
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        async def _call(remaining):
+            try:
+                response = await self._client_stub.ModelConfig(
+                    pb.ModelConfigRequest(name=model_name,
+                                          version=model_version),
+                    metadata=self._get_metadata(headers),
+                    timeout=min_timeout(client_timeout, remaining),
+                )
+                return _maybe_json(response, as_json)
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return await self._with_retry("metadata", _call)
 
     # -- repository --------------------------------------------------------
     async def get_model_repository_index(self, headers=None, as_json=False, client_timeout=None):
@@ -365,10 +412,52 @@ class InferenceServerClient(InferenceServerClientBase):
         headers=None,
         compression_algorithm=None,
         parameters=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
     ) -> InferResult:
-        """Async inference (reference aio :634)."""
+        """Async inference (reference aio :634).  ``retry_policy`` /
+        ``deadline_s``: same resilience contract as the sync client."""
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        if policy is None and deadline_s is None:
+            return await self._infer_once(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout,
+                client_timeout, headers, compression_algorithm, parameters)
+        return await call_with_retry_async(
+            policy,
+            lambda remaining, _attempt: self._infer_once(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout,
+                client_timeout, headers, compression_algorithm, parameters,
+                _remaining_s=remaining),
+            method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, "grpc_aio", "infer", request_id))
+
+    async def _infer_once(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+        _remaining_s=None,
+    ) -> InferResult:
         tel = telemetry()
         t_ser0 = time.monotonic_ns()
+        if timeout is None and _remaining_s is not None:
+            # remaining deadline budget as the v2 timeout parameter (µs),
+            # restamped per attempt (see the sync client)
+            timeout = remaining_us(_remaining_s)
         request = get_inference_request(
             model_name, inputs, model_version, request_id, outputs,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
@@ -382,7 +471,7 @@ class InferenceServerClient(InferenceServerClientBase):
             response = await self._client_stub.ModelInfer(
                 request,
                 metadata=metadata,
-                timeout=client_timeout,
+                timeout=min_timeout(client_timeout, _remaining_s),
                 compression=get_grpc_compression(compression_algorithm),
             )
             t_net1 = time.monotonic_ns()
@@ -471,9 +560,11 @@ class InferenceServerClient(InferenceServerClientBase):
                 if response == grpc.aio.EOF:
                     raise StopAsyncIteration
                 if response.error_message:
-                    from ...utils import InferenceServerException
+                    from .._utils import stream_error_to_exception
 
-                    return None, InferenceServerException(response.error_message)
+                    # same in-band status mapping as the sync stream
+                    return None, stream_error_to_exception(
+                        response.error_message)
                 return InferResult(response.infer_response), None
 
             def cancel(self):

@@ -17,6 +17,7 @@ import gzip
 import time
 import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, List, Optional
 from urllib.parse import quote, urlencode
 
@@ -24,6 +25,8 @@ import urllib3
 
 from .._client import InferenceServerClientBase
 from .._request import Request
+from .._resilience import (RetryPolicy, call_with_retry, min_timeout,
+                           normalized_status, remaining_us)
 from .._telemetry import merge_trace_headers, telemetry, traceparent_on_wire
 from ..utils import InferenceServerException, raise_error
 from ._infer_result import InferResult
@@ -39,13 +42,17 @@ class InferAsyncRequest:
 
     def get_result(self, block: bool = True, timeout: Optional[float] = None) -> InferResult:
         """Block (by default) until the response arrives and return the
-        InferResult; raises InferenceServerException on error or timeout."""
+        InferResult; raises InferenceServerException on error, with a
+        "deadline exceeded" status on timeout."""
         try:
             return self._future.result(timeout=timeout if block else 0)
         except InferenceServerException:
             raise
-        except TimeoutError:
-            raise_error("failed to obtain inference response")
+        except (TimeoutError, FuturesTimeoutError):
+            # concurrent.futures.TimeoutError is a distinct class pre-3.11
+            raise InferenceServerException(
+                msg="timed out waiting for inference response",
+                status="StatusCode.DEADLINE_EXCEEDED") from None
         except Exception as e:
             raise_error(f"failed to obtain inference response: {e}")
 
@@ -75,8 +82,13 @@ class InferenceServerClient(InferenceServerClientBase):
         ssl_options: Optional[dict] = None,
         ssl_context_factory=None,  # accepted for API compat
         insecure: bool = False,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         super().__init__()
+        # client-level resilience default: health/metadata calls retry
+        # under it unconditionally; infer honors it per its retry_infer
+        # opt-in (a per-call retry_policy= overrides)
+        self._retry_policy = retry_policy
         if url.startswith("http://") or url.startswith("https://"):
             raise_error("url should not include the scheme")
         scheme = "https://" if ssl else "http://"
@@ -144,11 +156,29 @@ class InferenceServerClient(InferenceServerClientBase):
             uri += "?" + urlencode(query_params, doseq=True)
         return uri
 
-    def _get(self, path: str, headers: Optional[dict], query_params: Optional[dict]):
+    def _attempt_timeout(self, timeout_s: Optional[float]) -> dict:
+        """Request kwargs for one deadline-budgeted attempt: the budget
+        CAPS the pool's configured connect/read timeouts (a deliberately
+        short network_timeout keeps guarding each attempt) and also sets
+        urllib3's ``total`` so connect + every socket read share ONE
+        budget — per-read timeouts alone would let a trickling response
+        stretch an attempt far past deadline_s."""
+        if timeout_s is None:
+            return {}
+        return {"timeout": urllib3.Timeout(
+            total=timeout_s,
+            connect=min_timeout(self._timeout.connect_timeout, timeout_s),
+            read=min_timeout(self._timeout.read_timeout, timeout_s))}
+
+    def _get(self, path: str, headers: Optional[dict],
+             query_params: Optional[dict],
+             timeout_s: Optional[float] = None):
         uri = self._uri(path, query_params)
         if self._verbose:
             print(f"GET {uri}, headers {headers}")
-        response = self._pool.request("GET", uri, headers=self._build_headers(headers))
+        response = self._pool.request(
+            "GET", uri, headers=self._build_headers(headers),
+            **self._attempt_timeout(timeout_s))
         if self._verbose:
             print(response.status)
         return response
@@ -160,6 +190,7 @@ class InferenceServerClient(InferenceServerClientBase):
         headers: Optional[dict],
         query_params: Optional[dict],
         extra_headers: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
     ):
         uri = self._uri(path, query_params)
         hdrs = self._build_headers(headers)
@@ -168,34 +199,71 @@ class InferenceServerClient(InferenceServerClientBase):
         if self._verbose:
             print(f"POST {uri}, headers {hdrs}\n{body[:256]!r}")
         response = self._pool.request(
-            "POST", uri, body=body, headers=hdrs, preload_content=True
+            "POST", uri, body=body, headers=hdrs, preload_content=True,
+            **self._attempt_timeout(timeout_s),
         )
         if self._verbose:
             print(response.status)
         return response
 
-    # -- health / metadata (reference :340-580) ----------------------------
-    def is_server_live(self, headers=None, query_params=None) -> bool:
-        response = self._get("v2/health/live", headers, query_params)
+    def _with_retry(self, method_kind: str, fn):
+        """Run an idempotent (health/metadata) call under the client-level
+        retry policy, if one is configured.  ``fn(timeout_s)`` receives the
+        remaining deadline budget (None without one) so each attempt's
+        transport time is capped like the gRPC clients'."""
+        if self._retry_policy is None:
+            return fn(None)
+        return call_with_retry(
+            self._retry_policy, lambda remaining, _attempt: fn(remaining),
+            method=method_kind,
+            retry_meta=("", "http", method_kind, ""))
+
+    def _health_get(self, path: str, headers, query_params) -> bool:
+        """One health probe under the client-level policy.  Health GETs
+        normally never raise on status, which would make the 429/503
+        retry gate unreachable — so under a policy those statuses are
+        raised for the retry loop, and when every retry is exhausted the
+        verdict degrades back to the API's no-raise boolean (False)."""
+        def _call(remaining):
+            response = self._get(path, headers, query_params,
+                                 timeout_s=remaining)
+            if self._retry_policy is not None \
+                    and response.status in (429, 503):
+                raise_if_error(response.status, response.data,
+                               response.headers)
+            return response
+
+        try:
+            response = self._with_retry("health", _call)
+        except InferenceServerException as e:
+            if normalized_status(e) in ("429", "503"):
+                return False  # still overloaded after every retry
+            raise
         return response.status == 200
 
+    # -- health / metadata (reference :340-580) ----------------------------
+    def is_server_live(self, headers=None, query_params=None) -> bool:
+        return self._health_get("v2/health/live", headers, query_params)
+
     def is_server_ready(self, headers=None, query_params=None) -> bool:
-        response = self._get("v2/health/ready", headers, query_params)
-        return response.status == 200
+        return self._health_get("v2/health/ready", headers, query_params)
 
     def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
-        response = self._get(f"{path}/ready", headers, query_params)
-        return response.status == 200
+        return self._health_get(f"{path}/ready", headers, query_params)
 
     def get_server_metadata(self, headers=None, query_params=None) -> dict:
-        response = self._get("v2", headers, query_params)
-        raise_if_error(response.status, response.data)
+        def _call(remaining):
+            response = self._get("v2", headers, query_params,
+                                 timeout_s=remaining)
+            raise_if_error(response.status, response.data, response.headers)
+            return response
+
         import json
 
-        return json.loads(response.data)
+        return json.loads(self._with_retry("metadata", _call).data)
 
     def get_model_metadata(
         self, model_name, model_version="", headers=None, query_params=None
@@ -203,11 +271,16 @@ class InferenceServerClient(InferenceServerClientBase):
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
-        response = self._get(path, headers, query_params)
-        raise_if_error(response.status, response.data)
+
+        def _call(remaining):
+            response = self._get(path, headers, query_params,
+                                 timeout_s=remaining)
+            raise_if_error(response.status, response.data, response.headers)
+            return response
+
         import json
 
-        return json.loads(response.data)
+        return json.loads(self._with_retry("metadata", _call).data)
 
     def get_model_config(
         self, model_name, model_version="", headers=None, query_params=None
@@ -215,11 +288,16 @@ class InferenceServerClient(InferenceServerClientBase):
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
-        response = self._get(f"{path}/config", headers, query_params)
-        raise_if_error(response.status, response.data)
+
+        def _call(remaining):
+            response = self._get(f"{path}/config", headers, query_params,
+                                 timeout_s=remaining)
+            raise_if_error(response.status, response.data, response.headers)
+            return response
+
         import json
 
-        return json.loads(response.data)
+        return json.loads(self._with_retry("metadata", _call).data)
 
     # -- repository (reference :582-707) -----------------------------------
     def get_model_repository_index(self, headers=None, query_params=None) -> list:
@@ -479,6 +557,7 @@ class InferenceServerClient(InferenceServerClientBase):
         response_compression_algorithm,
         parameters,
         _method="infer",
+        _remaining_s=None,
     ):
         tel = telemetry()
         t_ser0 = time.monotonic_ns()
@@ -502,6 +581,11 @@ class InferenceServerClient(InferenceServerClientBase):
         # headers of the same name win)
         trace_headers, rid = merge_trace_headers(headers, request_id)
         extra_headers.update(trace_headers)
+        if _remaining_s is not None:
+            # remaining deadline budget, restamped per attempt: the server
+            # drops the request (zero compute) once this expires
+            extra_headers["triton-timeout-us"] = str(
+                remaining_us(_remaining_s))
         t_ser1 = time.monotonic_ns()  # body built + compressed = SERIALIZE
 
         path = f"v2/models/{quote(model_name)}"
@@ -510,8 +594,9 @@ class InferenceServerClient(InferenceServerClientBase):
         path += "/infer"
         t0 = time.perf_counter()
         try:
-            response = self._post(path, body, headers, query_params, extra_headers)
-            raise_if_error(response.status, response.data)
+            response = self._post(path, body, headers, query_params,
+                                  extra_headers, timeout_s=_remaining_s)
+            raise_if_error(response.status, response.data, response.headers)
         except Exception:
             tel.record_request(
                 model_name, "http", _method, time.perf_counter() - t0,
@@ -556,13 +641,35 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm=None,
         response_compression_algorithm=None,
         parameters=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
     ) -> InferResult:
-        """Run a synchronous inference (reference :1331-1484)."""
-        return self._infer_request(
-            model_name, inputs, model_version, outputs, request_id, sequence_id,
-            sequence_start, sequence_end, priority, timeout, headers, query_params,
-            request_compression_algorithm, response_compression_algorithm, parameters,
-        )
+        """Run a synchronous inference (reference :1331-1484).
+
+        ``retry_policy`` (or the client-level one) retries retryable
+        failures when ``retry_infer`` is opted in; ``deadline_s`` caps
+        total wall-clock across attempts and propagates the remaining
+        budget to the server via the ``triton-timeout-us`` header."""
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        if policy is None and deadline_s is None:
+            return self._infer_request(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout,
+                headers, query_params, request_compression_algorithm,
+                response_compression_algorithm, parameters,
+            )
+        return call_with_retry(
+            policy,
+            lambda remaining, _attempt: self._infer_request(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout,
+                headers, query_params, request_compression_algorithm,
+                response_compression_algorithm, parameters,
+                _remaining_s=remaining,
+            ),
+            method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, "http", "infer", request_id))
 
     def async_infer(
         self,
@@ -581,18 +688,42 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm=None,
         response_compression_algorithm=None,
         parameters=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
     ) -> InferAsyncRequest:
         """Submit an inference to the client's worker pool and return a
-        handle (reference :1486-1659; greenlet pool → thread pool here)."""
+        handle (reference :1486-1659; greenlet pool → thread pool here).
+        The resilience contract matches ``infer`` — retries/deadline run
+        on the worker thread, invisible to the returned handle."""
         if self._executor is None:
             self._executor = ThreadPoolExecutor(
                 max_workers=self._concurrency, thread_name_prefix="tc-tpu-http"
             )
-        future = self._executor.submit(
-            self._infer_request,
-            model_name, inputs, model_version, outputs, request_id, sequence_id,
-            sequence_start, sequence_end, priority, timeout, headers, query_params,
-            request_compression_algorithm, response_compression_algorithm, parameters,
-            _method="async_infer",
-        )
+
+        def _task():
+            policy = retry_policy if retry_policy is not None \
+                else self._retry_policy
+            if policy is None and deadline_s is None:
+                return self._infer_request(
+                    model_name, inputs, model_version, outputs, request_id,
+                    sequence_id, sequence_start, sequence_end, priority,
+                    timeout, headers, query_params,
+                    request_compression_algorithm,
+                    response_compression_algorithm, parameters,
+                    _method="async_infer",
+                )
+            return call_with_retry(
+                policy,
+                lambda remaining, _attempt: self._infer_request(
+                    model_name, inputs, model_version, outputs, request_id,
+                    sequence_id, sequence_start, sequence_end, priority,
+                    timeout, headers, query_params,
+                    request_compression_algorithm,
+                    response_compression_algorithm, parameters,
+                    _method="async_infer", _remaining_s=remaining,
+                ),
+                method="infer", deadline_s=deadline_s,
+                retry_meta=(model_name, "http", "async_infer", request_id))
+
+        future = self._executor.submit(_task)
         return InferAsyncRequest(future, self._verbose)

@@ -8,10 +8,12 @@ from typing import List, Optional, Tuple
 from ..utils import InferenceServerException, raise_error
 
 
-def raise_if_error(status: int, body: bytes) -> None:
+def raise_if_error(status: int, body: bytes, headers=None) -> None:
     """Raise InferenceServerException for non-2xx responses, extracting the
     v2 ``{"error": msg}`` payload when present (reference _get_error/
-    _raise_if_error, _utils.py:33-75)."""
+    _raise_if_error, _utils.py:33-75).  ``headers`` (when the call site has
+    them) supplies the ``Retry-After`` pushback a shed 429/503 carries —
+    the resilience layer's backoff honors it over its own jitter."""
     if 200 <= status < 300:
         return
     msg = None
@@ -19,9 +21,22 @@ def raise_if_error(status: int, body: bytes) -> None:
         msg = json.loads(body).get("error")
     except Exception:
         msg = body.decode("utf-8", errors="replace") if body else None
-    raise InferenceServerException(
+    exc = InferenceServerException(
         msg=msg or f"[{status}] inference request failed", status=str(status)
     )
+    if headers is not None:
+        # the precise sub-second horizon wins over the RFC 7231 integer
+        # Retry-After it rides alongside
+        for key, scale in (("triton-retry-after-ms", 1e-3),
+                           ("Triton-Retry-After-Ms", 1e-3),
+                           ("Retry-After", 1.0), ("retry-after", 1.0)):
+            if key in headers:
+                try:
+                    exc.retry_after_s = float(headers[key]) * scale
+                except (TypeError, ValueError):
+                    continue  # HTTP-date form: backoff jitter covers it
+                break
+    raise exc
 
 
 def get_inference_request_body(

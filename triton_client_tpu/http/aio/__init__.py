@@ -19,9 +19,11 @@ import aiohttp
 
 from ..._client import InferenceServerClientBase
 from ..._request import Request
+from ..._resilience import (RetryPolicy, call_with_retry_async, min_timeout,
+                            normalized_status, remaining_us)
 from ..._telemetry import (merge_trace_headers, telemetry,
                            traceparent_on_wire)
-from ...utils import raise_error
+from ...utils import InferenceServerException, raise_error
 from .._infer_result import InferResult
 from .._utils import get_inference_request_body, raise_if_error
 
@@ -39,14 +41,19 @@ class InferenceServerClient(InferenceServerClientBase):
         conn_timeout: float = 60.0,
         ssl: bool = False,
         ssl_context=None,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         super().__init__()
+        # client-level resilience default (see the sync client): health/
+        # metadata retry unconditionally, infer per its retry_infer opt-in
+        self._retry_policy = retry_policy
         if url.startswith("http://") or url.startswith("https://"):
             raise_error("url should not include the scheme")
         scheme = "https://" if ssl else "http://"
         self._base_uri = (scheme + url).rstrip("/")
         self._verbose = verbose
         connector = aiohttp.TCPConnector(limit=conn_limit, ssl=ssl_context if ssl else False)
+        self._conn_timeout = conn_timeout
         self._session = aiohttp.ClientSession(
             connector=connector,
             timeout=aiohttp.ClientTimeout(total=conn_timeout),
@@ -87,33 +94,83 @@ class InferenceServerClient(InferenceServerClientBase):
             uri += "?" + urlencode(query_params, doseq=True)
         return uri
 
-    async def _get(self, path, headers, query_params) -> tuple:
+    async def _get(self, path, headers, query_params,
+                   timeout_s=None) -> tuple:
         uri = self._uri(path, query_params)
         if self._verbose:
             print(f"GET {uri}")
-        async with self._session.get(uri, headers=self._build_headers(headers)) as resp:
+        kwargs = {}
+        if timeout_s is not None:
+            # deadline budget caps (never replaces) the session timeout
+            kwargs["timeout"] = aiohttp.ClientTimeout(
+                total=min_timeout(self._conn_timeout, timeout_s))
+        async with self._session.get(
+                uri, headers=self._build_headers(headers),
+                **kwargs) as resp:
             body = await resp.read()
             return resp.status, dict(resp.headers), _decompress(resp.headers, body)
 
-    async def _post(self, path, body, headers, query_params, extra_headers=None) -> tuple:
+    async def _post(self, path, body, headers, query_params,
+                    extra_headers=None, timeout_s=None) -> tuple:
         uri = self._uri(path, query_params)
         hdrs = self._build_headers(headers)
         if extra_headers:
             hdrs.update(extra_headers)
         if self._verbose:
             print(f"POST {uri}")
-        async with self._session.post(uri, data=body, headers=hdrs) as resp:
+        kwargs = {}
+        if timeout_s is not None:
+            # the deadline budget CAPS the configured session timeout —
+            # a deliberately short conn_timeout keeps guarding each
+            # attempt even under a generous budget
+            kwargs["timeout"] = aiohttp.ClientTimeout(
+                total=min_timeout(self._conn_timeout, timeout_s))
+        async with self._session.post(
+                uri, data=body, headers=hdrs, **kwargs) as resp:
             data = await resp.read()
             return resp.status, dict(resp.headers), _decompress(resp.headers, data)
 
-    # -- health / metadata -------------------------------------------------
-    async def is_server_live(self, headers=None, query_params=None) -> bool:
-        status, _, _ = await self._get("v2/health/live", headers, query_params)
+    async def _with_retry(self, method_kind: str, fn):
+        """Run an idempotent (health/metadata) call under the client-level
+        retry policy, if one is configured.  ``fn(timeout_s)`` receives
+        the remaining deadline budget so each attempt is capped."""
+        if self._retry_policy is None:
+            return await fn(None)
+
+        async def _attempt(remaining, _att):
+            return await fn(remaining)
+
+        return await call_with_retry_async(
+            self._retry_policy, _attempt, method=method_kind,
+            retry_meta=("", "http_aio", method_kind, ""))
+
+    async def _health_get(self, path, headers, query_params) -> bool:
+        """Health probe with 429/503 retry under a policy, degrading to
+        the no-raise boolean once retries are exhausted (see the sync
+        client)."""
+        async def _call(remaining):
+            status, hdrs, body = await self._get(
+                path, headers, query_params, timeout_s=remaining)
+            if self._retry_policy is not None and status in (429, 503):
+                raise_if_error(status, body, hdrs)
+            return status
+
+        try:
+            status = await self._with_retry("health", _call)
+        except InferenceServerException as e:
+            if normalized_status(e) in ("429", "503"):
+                return False  # still overloaded after every retry
+            raise
         return status == 200
 
+    # -- health / metadata -------------------------------------------------
+    async def is_server_live(self, headers=None, query_params=None) -> bool:
+        return await self._health_get("v2/health/live", headers,
+                                      query_params)
+
     async def is_server_ready(self, headers=None, query_params=None) -> bool:
-        status, _, _ = await self._get("v2/health/ready", headers, query_params)
-        return status == 200
+        return await self._health_get("v2/health/ready", headers,
+                                      query_params)
 
     async def is_model_ready(
         self, model_name, model_version="", headers=None, query_params=None
@@ -121,13 +178,17 @@ class InferenceServerClient(InferenceServerClientBase):
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
-        status, _, _ = await self._get(f"{path}/ready", headers, query_params)
-        return status == 200
+        return await self._health_get(f"{path}/ready", headers,
+                                      query_params)
 
     async def get_server_metadata(self, headers=None, query_params=None) -> dict:
-        status, _, body = await self._get("v2", headers, query_params)
-        raise_if_error(status, body)
-        return json.loads(body)
+        async def _call(remaining):
+            status, hdrs, body = await self._get(
+                "v2", headers, query_params, timeout_s=remaining)
+            raise_if_error(status, body, hdrs)
+            return body
+
+        return json.loads(await self._with_retry("metadata", _call))
 
     async def get_model_metadata(
         self, model_name, model_version="", headers=None, query_params=None
@@ -135,9 +196,14 @@ class InferenceServerClient(InferenceServerClientBase):
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
-        status, _, body = await self._get(path, headers, query_params)
-        raise_if_error(status, body)
-        return json.loads(body)
+
+        async def _call(remaining):
+            status, hdrs, body = await self._get(
+                path, headers, query_params, timeout_s=remaining)
+            raise_if_error(status, body, hdrs)
+            return body
+
+        return json.loads(await self._with_retry("metadata", _call))
 
     async def get_model_config(
         self, model_name, model_version="", headers=None, query_params=None
@@ -145,9 +211,15 @@ class InferenceServerClient(InferenceServerClientBase):
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
-        status, _, body = await self._get(f"{path}/config", headers, query_params)
-        raise_if_error(status, body)
-        return json.loads(body)
+
+        async def _call(remaining):
+            status, hdrs, body = await self._get(
+                f"{path}/config", headers, query_params,
+                timeout_s=remaining)
+            raise_if_error(status, body, hdrs)
+            return body
+
+        return json.loads(await self._with_retry("metadata", _call))
 
     # -- repository --------------------------------------------------------
     async def get_model_repository_index(self, headers=None, query_params=None) -> list:
@@ -346,8 +418,49 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm=None,
         response_compression_algorithm=None,
         parameters=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
     ) -> InferResult:
-        """Async inference (reference aio :694)."""
+        """Async inference (reference aio :694).  ``retry_policy`` /
+        ``deadline_s``: same resilience contract as the sync client."""
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        if policy is None and deadline_s is None:
+            return await self._infer_once(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout,
+                headers, query_params, request_compression_algorithm,
+                response_compression_algorithm, parameters)
+        return await call_with_retry_async(
+            policy,
+            lambda remaining, _attempt: self._infer_once(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout,
+                headers, query_params, request_compression_algorithm,
+                response_compression_algorithm, parameters,
+                _remaining_s=remaining),
+            method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, "http_aio", "infer", request_id))
+
+    async def _infer_once(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+        _remaining_s=None,
+    ) -> InferResult:
         tel = telemetry()
         t_ser0 = time.monotonic_ns()
         body, json_size = get_inference_request_body(
@@ -369,6 +482,10 @@ class InferenceServerClient(InferenceServerClientBase):
         # records the id in trace JSON and echoes it back)
         trace_headers, rid = merge_trace_headers(headers, request_id)
         extra_headers.update(trace_headers)
+        if _remaining_s is not None:
+            # remaining deadline budget, restamped per attempt
+            extra_headers["triton-timeout-us"] = str(
+                remaining_us(_remaining_s))
         t_ser1 = time.monotonic_ns()
 
         path = f"v2/models/{quote(model_name)}"
@@ -378,9 +495,10 @@ class InferenceServerClient(InferenceServerClientBase):
         t0 = time.perf_counter()
         try:
             status, resp_headers, data = await self._post(
-                path, body, headers, query_params, extra_headers
+                path, body, headers, query_params, extra_headers,
+                timeout_s=_remaining_s
             )
-            raise_if_error(status, data)
+            raise_if_error(status, data, resp_headers)
         except Exception:
             tel.record_request(
                 model_name, "http_aio", "infer", time.perf_counter() - t0,

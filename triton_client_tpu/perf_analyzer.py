@@ -48,7 +48,25 @@ class _Stats:
     latency: LatencyHistogram = field(default_factory=LatencyHistogram)
     count: int = 0
     errors: int = 0
+    # requests shed by server admission control (HTTP 429 / gRPC
+    # RESOURCE_EXHAUSTED) — a subset of errors, reported separately so a
+    # sweep shows WHERE a concurrency level starts overrunning the server
+    rejected: int = 0
     first_error: Optional[str] = None
+
+
+def _is_rejected(err: Exception) -> bool:
+    from ._resilience import normalized_status
+
+    return normalized_status(err) in ("429", "RESOURCE_EXHAUSTED")
+
+
+def _retries_recorded(model_name: str) -> int:
+    """Cumulative client-layer retries for ``model_name`` from the
+    process-wide telemetry registry (delta'd around each sweep level)."""
+    return sum(r.get("retries", 0)
+               for r in telemetry().snapshot()["requests"]
+               if r["model"] == model_name)
 
 
 def _parse_concurrency_range(spec: str):
@@ -224,11 +242,11 @@ def _build_inputs(protocol_mod, arrays, shm_mode):
 
 def _worker(protocol_mod, make_client, model_name, model_version, arrays,
             outputs, shm_mode, output_byte_size, worker_id, stop, measuring,
-            stats: _Stats, lock, streaming=False):
+            stats: _Stats, lock, streaming=False, retry_policy=None):
     try:
         _worker_impl(protocol_mod, make_client, model_name, model_version,
                      arrays, outputs, shm_mode, output_byte_size, worker_id,
-                     stop, measuring, stats, lock, streaming)
+                     stop, measuring, stats, lock, streaming, retry_policy)
     except Exception as e:
         # Setup failures (bad model, shm registration, stream open) must be
         # visible in the report, not a silently dead worker thread.
@@ -244,7 +262,7 @@ class _InferSession:
 
     def __init__(self, protocol_mod, make_client, model_name, model_version,
                  arrays, outputs, shm_mode, output_byte_size, worker_id,
-                 streaming):
+                 streaming, retry_policy=None):
         self._client = make_client()
         self._shm_setup = None
         self._stream_open = False
@@ -292,8 +310,11 @@ class _InferSession:
                 client = self._client
 
                 def one_infer():
+                    # retry_policy=None is the no-resilience default; with
+                    # --retries the sweep measures the retry layer under load
                     client.infer(model_name, infer_inputs, outputs=requested,
-                                 model_version=model_version)
+                                 model_version=model_version,
+                                 retry_policy=retry_policy)
 
             self.infer = one_infer
         except Exception:
@@ -316,14 +337,17 @@ class _InferSession:
 
 def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
                  outputs, shm_mode, output_byte_size, worker_id, stop,
-                 measuring, stats: _Stats, lock, streaming=False):
+                 measuring, stats: _Stats, lock, streaming=False,
+                 retry_policy=None):
     session = _InferSession(protocol_mod, make_client, model_name,
                             model_version, arrays, outputs, shm_mode,
-                            output_byte_size, worker_id, streaming)
+                            output_byte_size, worker_id, streaming,
+                            retry_policy)
     one_infer = session.infer
     try:
         n = 0
         errs = 0
+        rejected = 0
         first_error = None
         while not stop.is_set():
             t0 = time.perf_counter()
@@ -341,11 +365,14 @@ def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
                     n += 1
                 else:
                     errs += 1
+                    if _is_rejected(err):
+                        rejected += 1
                     if first_error is None:
                         first_error = f"{type(err).__name__}: {err}"
         with lock:
             stats.count += n
             stats.errors += errs
+            stats.rejected += rejected
             if stats.first_error is None and first_error is not None:
                 stats.first_error = first_error
     finally:
@@ -354,7 +381,7 @@ def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
 
 def run_level(protocol, url, model_name, model_version, concurrency, arrays,
               outputs, shm_mode, output_byte_size, measure_s, warmup_s=1.0,
-              extra_percentile=None, streaming=False):
+              extra_percentile=None, streaming=False, retry_policy=None):
     if protocol == "grpc":
         import triton_client_tpu.grpc as protocol_mod
 
@@ -374,7 +401,7 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
             target=_worker,
             args=(protocol_mod, make_client, model_name, model_version, arrays,
                   outputs, shm_mode, output_byte_size, w, stop, measuring,
-                  stats, lock, streaming),
+                  stats, lock, streaming, retry_policy),
             daemon=True,
         )
         for w in range(concurrency)
@@ -382,6 +409,9 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
     for t in threads:
         t.start()
     time.sleep(warmup_s)
+    # retry delta scoped to the measure window, like count/errors —
+    # warmup-window retries must not inflate the reported level
+    retries_before = _retries_recorded(model_name)
     measuring.set()
     t0 = time.perf_counter()
     time.sleep(measure_s)
@@ -394,6 +424,11 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
         "concurrency": concurrency,
         "throughput": stats.count / elapsed,
         "errors": stats.errors,
+        # resilience visibility per level: where the server starts shedding
+        # and how hard the client retry layer is working to cover it
+        "rejected": stats.rejected,
+        "rejected_per_sec": stats.rejected / elapsed,
+        "retries": _retries_recorded(model_name) - retries_before,
         "first_error": stats.first_error,
     }
     res.update(_latency_stats(stats.latency, extra_percentile))
@@ -443,7 +478,7 @@ def _parse_rate_range(spec: str) -> List[float]:
 def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
                    outputs, shm_mode, output_byte_size, measure_s,
                    warmup_s=1.0, distribution="constant", max_threads=64,
-                   extra_percentile=None, streaming=False):
+                   extra_percentile=None, streaming=False, retry_policy=None):
     """OPEN-loop load at ``rate`` requests/s (reference perf_analyzer
     --request-rate-range): send times are scheduled up front (constant or
     Poisson inter-arrivals) and latency is measured from the SCHEDULED send
@@ -479,7 +514,8 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
     stop = threading.Event()
     next_slot = [0]
     sent = []     # (scheduled_rel, send_lag_s)
-    done = []     # (scheduled_rel, latency_from_scheduled_s, err or None)
+    done = []     # (scheduled_rel, latency_from_scheduled_s, err or None,
+    #               rejected: bool)
     setup_errors = []  # outside the window classification: always reported
     t0_box = [None]
     ready = [0]
@@ -489,7 +525,8 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
         try:
             session = _InferSession(protocol_mod, make_client, model_name,
                                     model_version, arrays, outputs, shm_mode,
-                                    output_byte_size, worker_id, streaming)
+                                    output_byte_size, worker_id, streaming,
+                                    retry_policy)
         except Exception as e:  # noqa: BLE001 — setup must be visible
             with lock:
                 ready[0] += 1
@@ -521,14 +558,16 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
                     return  # claimed slot never sent -> counted in `unsent`
                 lag = time.perf_counter() - target
                 err = None
+                rejected = False
                 try:
                     session.infer()
                 except Exception as e:  # noqa: BLE001 — recorded per slot
                     err = f"{type(e).__name__}: {e}"
+                    rejected = _is_rejected(e)
                 lat = time.perf_counter() - target
                 with lock:
                     sent.append((sched[k], lag))
-                    done.append((sched[k], lat, err))
+                    done.append((sched[k], lat, err, rejected))
         finally:
             session.close()
 
@@ -543,16 +582,22 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
     go.set()
     # classify by SCHEDULED time: the window owns every slot scheduled
     # inside it, including ones the server never got to (that's the point)
-    time.sleep(warmup_s + measure_s)
+    time.sleep(warmup_s)
+    # retry delta over the measure window only (same scoping as the
+    # closed loop; slots already in flight at the boundary blur it by at
+    # most one request's retries)
+    retries_before = _retries_recorded(model_name)
+    time.sleep(measure_s)
     stop.set()
     for t in threads:
         t.join(timeout=60)
     win_lo, win_hi = warmup_s, warmup_s + measure_s
     owed = int(np.sum((sched >= win_lo) & (sched < win_hi)))
-    in_win = [(s, lat, err) for s, lat, err in done
+    in_win = [(s, lat, err, rej) for s, lat, err, rej in done
               if win_lo <= s < win_hi]
-    ok = [lat for s, lat, err in in_win if err is None]
-    errs = [err for s, lat, err in in_win if err is not None]
+    ok = [lat for s, lat, err, rej in in_win if err is None]
+    errs = [err for s, lat, err, rej in in_win if err is not None]
+    n_rejected = sum(1 for s, lat, err, rej in in_win if rej)
     lags = np.asarray([lag for s, lag in sent if win_lo <= s < win_hi])
     res = {
         "request_rate": rate,
@@ -563,6 +608,9 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
         # setup failures happen before any slot is scheduled, so they are
         # reported unconditionally — not filtered by the window
         "errors": len(errs) + len(setup_errors),
+        "rejected": n_rejected,
+        "rejected_per_sec": n_rejected / measure_s,
+        "retries": _retries_recorded(model_name) - retries_before,
         "first_error": (setup_errors[0] if setup_errors
                         else errs[0] if errs else None),
         "send_lag_p50_ms": (float(np.percentile(lags, 50) * 1e3)
@@ -605,6 +653,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--streaming", action="store_true",
                         help="drive infers over the bidi gRPC stream "
                              "(gRPC only; reference perf_analyzer flag)")
+    parser.add_argument("--retries", type=int, default=0,
+                        help="enable the client resilience layer with this "
+                             "many max attempts per request (0 = off); the "
+                             "table and --export-metrics report retry "
+                             "counts and rejected-request rates per level")
     parser.add_argument("--percentile", type=int, default=None,
                         help="report this percentile as the headline latency")
     parser.add_argument("--export-metrics", default=None, metavar="PATH",
@@ -625,6 +678,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     if args.streaming and args.protocol != "grpc":
         parser.error("--streaming requires -i grpc")
+    if args.streaming and args.retries:
+        # stream submits are fire-and-forget: completion arrives on the
+        # stream callback, so per-request retry cannot apply — fail loudly
+        # rather than print retry columns that were never measured
+        parser.error("--retries is not supported with --streaming")
     if args.concurrency_range and args.request_rate_range:
         parser.error("--concurrency-range and --request-rate-range are "
                      "mutually exclusive (closed- vs open-loop)")
@@ -668,6 +726,13 @@ def main(argv: Optional[List[str]] = None) -> int:
              if open_loop else "closed-loop (concurrency)") + "\n"
           f"  Protocol: {args.protocol} @ {url}\n")
 
+    retry_policy = None
+    if args.retries > 0:
+        from ._resilience import RetryPolicy
+
+        retry_policy = RetryPolicy(max_attempts=max(1, args.retries),
+                                   retry_infer=True)
+
     def report(res, lead):
         results.append(res)
         headline = (res[f"p{args.percentile}_us"]
@@ -675,6 +740,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         tail = ""
         if res.get("unsent"):
             tail += f", {res['unsent']} unsent"
+        if res.get("retries"):
+            tail += f", {res['retries']} retries"
+        if res.get("rejected"):
+            tail += f", rejected {res['rejected_per_sec']:.1f}/s"
         if res["errors"]:
             tail += f" ({res['errors']} errors)"
         print(f"{lead}{res['throughput']:.2f} infer/sec, "
@@ -718,7 +787,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     args.output_shared_memory_size, measure_s,
                     distribution=args.request_distribution,
                     max_threads=args.max_threads,
-                    extra_percentile=args.percentile, streaming=args.streaming)
+                    extra_percentile=args.percentile, streaming=args.streaming,
+                    retry_policy=retry_policy)
                 report(res, f"Request rate: {rate:g}/s, completed "
                             "(latency from scheduled send): ")
         else:
@@ -727,7 +797,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     args.protocol, url, args.model_name, args.model_version,
                     level, arrays, outputs, args.shared_memory,
                     args.output_shared_memory_size, measure_s,
-                    extra_percentile=args.percentile, streaming=args.streaming)
+                    extra_percentile=args.percentile, streaming=args.streaming,
+                    retry_policy=retry_policy)
                 report(res, f"Concurrency: {level}, throughput: ")
     finally:
         if args.trace_file:

@@ -60,6 +60,46 @@ def main() -> None:
                         help="disable per-request flight recording "
                         "entirely (the /v2/debug/flight_recorder surface "
                         "stays up but records nothing)")
+    parser.add_argument("--drain-timeout", type=float, default=10.0,
+                        metavar="S",
+                        help="graceful-drain budget on SIGINT/SIGTERM: "
+                        "stop accepting (new requests get 503 + "
+                        "Retry-After, readiness goes false), wait this "
+                        "long for in-flight requests, then tear down")
+    parser.add_argument("--max-queue-size", type=int, default=0,
+                        help="default per-model admission bound: requests "
+                        "beyond this many pending per model are shed with "
+                        "HTTP 429 / gRPC RESOURCE_EXHAUSTED + Retry-After "
+                        "(0 = unbounded; a model config's max_queue_size "
+                        "parameter overrides per model)")
+    parser.add_argument("--shed-retry-after", type=float, default=0.25,
+                        metavar="S",
+                        help="pushback horizon (seconds) sent with shed "
+                        "responses (Retry-After / retry-after-ms)")
+    parser.add_argument("--chaos", type=float, default=0.0, metavar="RATE",
+                        help="fault-injection rate in [0,1]: each request "
+                        "draws from a seeded RNG and at RATE gets a fault "
+                        "from --chaos-kinds (testing the retry/shed/"
+                        "deadline paths end to end; injected faults are "
+                        "pinned by the flight recorder)")
+    parser.add_argument("--chaos-kinds", default="error",
+                        help="comma list of latency,error,abort "
+                        "(default: error)")
+    parser.add_argument("--chaos-seed", type=int, default=0,
+                        help="RNG seed — a fixed seed reproduces the "
+                        "exact fault sequence")
+    parser.add_argument("--chaos-latency-ms", type=float, default=50.0,
+                        help="added delay for latency faults")
+    parser.add_argument("--chaos-model", action="append", default=None,
+                        metavar="NAME",
+                        help="restrict injection to this model "
+                        "(repeatable; default: all models)")
+    parser.add_argument("--chaos-transient", type=float, default=0.0,
+                        metavar="S",
+                        help="recovery window after each injected fault "
+                        "(seconds): models time-correlated transient "
+                        "faults, so prompt retries land clean "
+                        "(0 = independent per-request draws)")
     parser.add_argument("--metrics-port", type=int, default=8002,
                         help="dedicated Prometheus /metrics port (Triton "
                         "convention; 0 disables — /metrics stays on the "
@@ -111,6 +151,21 @@ def main() -> None:
         print(f"registered model zoo: {[e['name'] for e in registry.index()]}")
 
     core = InferenceCore(registry)
+    core.default_max_queue_size = max(0, args.max_queue_size)
+    core.shed_retry_after_s = max(0.0, args.shed_retry_after)
+    if args.chaos > 0.0:
+        from .chaos import build_injector
+
+        try:
+            core.chaos = build_injector(
+                args.chaos, kinds_csv=args.chaos_kinds,
+                seed=args.chaos_seed, latency_ms=args.chaos_latency_ms,
+                models=args.chaos_model,
+                transient_s=max(0.0, args.chaos_transient))
+        except ValueError as e:
+            parser.error(str(e))
+        print(f"chaos injection ON: rate={args.chaos} "
+              f"kinds={core.chaos.kinds} seed={args.chaos_seed}")
     try:
         core.flight_recorder.configure(
             capacity=args.flight_recorder_size,
@@ -121,6 +176,10 @@ def main() -> None:
         parser.error(str(e))
 
     async def serve():
+        import signal
+
+        from .frontends import stop_frontends
+
         warmed = await core.warmup_models()
         if warmed:
             print(f"warmed up: {warmed}")
@@ -136,12 +195,27 @@ def main() -> None:
             f"serving v2 protocol: {scheme}={args.host}:{args.http_port} "
             f"grpc{'s' if tls else ''}={args.host}:{args.grpc_port}{metrics}"
         )
-        await asyncio.Event().wait()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-unix event loops
+                pass
+        await stop.wait()
+        # graceful drain BEFORE the listeners close: new requests get a
+        # proper 503 + Retry-After (and readiness flips false so a load
+        # balancer stops routing) while in-flight ones run to completion —
+        # killing the sockets first would sever them with connection resets
+        print("shutting down: draining in-flight requests "
+              f"(up to {args.drain_timeout:g}s)")
+        await core.shutdown(drain_s=max(0.0, args.drain_timeout))
+        await stop_frontends(*frontends)
 
     try:
         asyncio.run(serve())
     except KeyboardInterrupt:
-        pass
+        pass  # second ^C mid-drain, or non-unix loop without handlers
 
 
 if __name__ == "__main__":

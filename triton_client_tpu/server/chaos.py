@@ -1,0 +1,164 @@
+"""Deterministic fault injection for the serving harness.
+
+A resilience layer nobody can exercise is a resilience layer that doesn't
+work; this module makes the retry/shed/deadline paths *testable end to end*
+by injecting faults at a configured per-model rate, from a seeded RNG so a
+given seed reproduces the exact same fault sequence (same arrival order in,
+same faults out — CI can assert on it).
+
+Fault kinds:
+
+* ``latency`` — add a fixed delay before execution (drives client timeouts
+  and the flight-recorder watchdog without touching the model),
+* ``error``  — fail the request with a retryable status (HTTP 503 /
+  gRPC UNAVAILABLE) before any compute,
+* ``abort``  — tear the connection down mid-response (HTTP: the transport
+  is closed so the client sees a protocol error; gRPC: the call aborts
+  UNAVAILABLE) — the connection-class failure the retry layer must absorb.
+
+Every injected fault stamps the request's flight record (``chaos=<kind>``),
+which the flight recorder pins into its outlier buffer and ``triton-top``
+labels — an operator staring at a latency spike can tell injected weather
+from real weather at a glance.
+
+Enable from the CLI::
+
+    python -m triton_client_tpu.server --zoo --chaos 0.1 \
+        --chaos-kinds error,latency --chaos-seed 42 --chaos-latency-ms 50
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, Iterable, Optional, Sequence
+
+from .types import InferError
+
+_KINDS = ("latency", "error", "abort")
+
+
+class ChaosAbort(InferError):
+    """Injected connection abort: the HTTP frontend closes the transport
+    mid-response instead of answering; the gRPC frontend aborts the call.
+    Subclasses InferError (503) so any path that doesn't special-case it
+    still fails loudly rather than hanging."""
+
+    def __init__(self, msg: str = "chaos: injected connection abort"):
+        super().__init__(msg, http_status=503)
+
+
+class ChaosFault:
+    """One injection decision."""
+
+    __slots__ = ("kind", "latency_s", "status")
+
+    def __init__(self, kind: str, latency_s: float = 0.0,
+                 status: int = 503):
+        self.kind = kind
+        self.latency_s = latency_s
+        self.status = status
+
+
+class ChaosInjector:
+    """Seeded per-request fault source.
+
+    ``decide(model)`` is called once per inference request (in arrival
+    order on the event loop); whether it fires is a draw from the seeded
+    RNG, so a fixed seed yields a reproducible fault sequence.  ``models``
+    restricts injection to the named models (None = all); ``max_faults``
+    caps total injections — ``ChaosInjector(rate=1.0, max_faults=1)`` is
+    the deterministic "fail exactly the first request" fixture the
+    retry-success tests are built on.
+
+    ``transient_s`` models *transient* faults: after an injection the
+    injector stays healthy for that long, so a prompt retry is guaranteed
+    to land clean.  This is the time-correlation real transient failures
+    have (a connection blip doesn't independently re-fail the retry — the
+    assumption the whole retry design rests on); without it, i.i.d.
+    per-attempt faults at rate ``r`` doom ~``r**attempts`` of requests no
+    matter the policy.  0 (the default) keeps draws independent.  Note a
+    nonzero ``transient_s`` makes the fault sequence timing-dependent, so
+    seed-reproducibility holds only for the rate-gated draws outside
+    cooldown windows.
+    """
+
+    def __init__(
+        self,
+        rate: float,
+        kinds: Sequence[str] = ("error",),
+        seed: int = 0,
+        latency_ms: float = 50.0,
+        error_status: int = 503,
+        models: Optional[Iterable[str]] = None,
+        max_faults: Optional[int] = None,
+        transient_s: float = 0.0,
+    ):
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"chaos rate must be in [0, 1], got {rate}")
+        kinds = tuple(kinds)
+        bad = [k for k in kinds if k not in _KINDS]
+        if bad or not kinds:
+            raise ValueError(
+                f"chaos kinds must be drawn from {_KINDS}, got {kinds}")
+        self.rate = float(rate)
+        self.kinds = kinds
+        self.seed = int(seed)
+        self.latency_s = float(latency_ms) / 1e3
+        self.error_status = int(error_status)
+        self.models = set(models) if models else None
+        self.max_faults = max_faults
+        self.transient_s = float(transient_s)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._healthy_until = 0.0
+        self.injected_total = 0
+        self.injected_by_model: Dict[str, int] = {}
+
+    def decide(self, model_name: str) -> Optional[ChaosFault]:
+        """The injection verdict for one request (None = leave it alone)."""
+        if self.rate <= 0.0:
+            return None
+        if self.models is not None and model_name not in self.models:
+            return None
+        with self._lock:
+            if (self.max_faults is not None
+                    and self.injected_total >= self.max_faults):
+                return None
+            if self.transient_s > 0.0 \
+                    and time.monotonic() < self._healthy_until:
+                return None  # inside a transient's recovery window
+            if self._rng.random() >= self.rate:
+                return None
+            kind = (self.kinds[0] if len(self.kinds) == 1
+                    else self.kinds[self._rng.randrange(len(self.kinds))])
+            if self.transient_s > 0.0:
+                self._healthy_until = time.monotonic() + self.transient_s
+            self.injected_total += 1
+            self.injected_by_model[model_name] = \
+                self.injected_by_model.get(model_name, 0) + 1
+        if kind == "latency":
+            return ChaosFault("latency", latency_s=self.latency_s)
+        if kind == "abort":
+            return ChaosFault("abort")
+        return ChaosFault("error", status=self.error_status)
+
+    def counters(self) -> Dict[str, int]:
+        """Per-model injected-fault counts, copied under the lock (backs
+        ``nv_chaos_injected_total`` in /metrics)."""
+        with self._lock:
+            return dict(self.injected_by_model)
+
+
+def build_injector(rate: float, kinds_csv: str = "error", seed: int = 0,
+                   latency_ms: float = 50.0,
+                   models: Optional[Iterable[str]] = None,
+                   transient_s: float = 0.0) -> ChaosInjector:
+    """CLI-flag assembly (``--chaos``/``--chaos-kinds``/...) — raises
+    ``ValueError`` on junk so a typo'd flag fails at startup, not at the
+    first unlucky request."""
+    kinds = [k.strip() for k in kinds_csv.split(",") if k.strip()]
+    return ChaosInjector(rate=rate, kinds=kinds, seed=seed,
+                         latency_ms=latency_ms, models=models,
+                         transient_s=transient_s)

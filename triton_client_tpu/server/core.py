@@ -181,6 +181,11 @@ class _DynamicBatcher:
     "dynamic batching"), concatenates along the batch axis, pads the batch
     dim to the smallest configured bucket ≥ actual so XLA sees a bounded set
     of shapes, executes once, splits results.
+
+    Queue items are ``(inputs, params, fut, enqueue_ns, trace,
+    deadline_ns)``; an item whose deadline already passed is dropped at
+    dequeue and again at batch assembly — zero compute for a request whose
+    client gave up while it queued.
     """
 
     # Batches in flight concurrently: device dispatch is async, so letting
@@ -208,12 +213,28 @@ class _DynamicBatcher:
             self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def submit(self, inputs: Dict[str, np.ndarray],
-                     parameters: Dict[str, Any], trace=None):
+                     parameters: Dict[str, Any], trace=None,
+                     deadline_ns: int = 0):
         fut = asyncio.get_running_loop().create_future()
         self.start()
         await self._queue.put(
-            (inputs, parameters, fut, time.monotonic_ns(), trace))
+            (inputs, parameters, fut, time.monotonic_ns(), trace,
+             deadline_ns))
         return await fut
+
+    def _drop_if_expired(self, item) -> bool:
+        """Fail an item whose deadline passed while it queued (the v2
+        "deadline exceeded" error, before any concat/pad/compute work)."""
+        deadline_ns = item[5]
+        if not deadline_ns or time.monotonic_ns() < deadline_ns:
+            return False
+        self._core.count_deadline_exceeded(self._model.name)
+        fut = item[2]
+        if not fut.done():
+            fut.set_exception(InferError(
+                f"request to model '{self._model.name}' exceeded its "
+                "deadline while queued", http_status=504))
+        return True
 
     async def _run(self) -> None:
         pending: list = []
@@ -224,6 +245,8 @@ class _DynamicBatcher:
                     first, carry = carry, None
                 else:
                     first = await self._queue.get()
+                if self._drop_if_expired(first):
+                    continue  # expired at dequeue: zero compute
                 pending = [first]
                 total = _batch_count(first[0])
                 deadline = time.monotonic() + self._max_delay_s
@@ -237,6 +260,8 @@ class _DynamicBatcher:
                         item = await asyncio.wait_for(self._queue.get(), timeout)
                     except asyncio.TimeoutError:
                         break
+                    if self._drop_if_expired(item):
+                        continue
                     count = _batch_count(item[0])
                     if total + count > self._max_bs:
                         # merging would break the max_batch_size contract
@@ -261,7 +286,7 @@ class _DynamicBatcher:
             # shutdown mid-batch: fail whatever we were holding
             if carry is not None:
                 pending.append(carry)
-            for _inputs, _params, fut, _ts, _trace in pending:
+            for _inputs, _params, fut, _ts, _trace, _dl in pending:
                 if not fut.done():
                     fut.set_exception(InferError("server is shutting down", 503))
             raise
@@ -278,6 +303,11 @@ class _DynamicBatcher:
             *(self._execute_group(g) for g in groups.values()))
 
     async def _execute_group(self, pending) -> None:
+        # last deadline gate before compute: a member that expired between
+        # dequeue and its batch forming must not ride the execution
+        pending = [p for p in pending if not self._drop_if_expired(p)]
+        if not pending:
+            return
         counts = [_batch_count(p[0]) for p in pending]
         total = sum(counts)
         padded = total
@@ -288,7 +318,7 @@ class _DynamicBatcher:
         names = list(pending[0][0].keys())
         traces = [p[4] for p in pending if p[4] is not None]
         t_asm0 = time.monotonic_ns()
-        for _inputs, _params, _fut, ts, trace in pending:
+        for _inputs, _params, _fut, ts, trace, _dl in pending:
             if trace is not None:
                 # this request's wait from enqueue until its batch formed
                 trace.add_span("QUEUE", ts, t_asm0)
@@ -316,7 +346,7 @@ class _DynamicBatcher:
             self._model.stats.record(total, queue_ns, compute_ns, ok=True)
             self._model.stats.record_batch(total)
             offset = 0
-            for (inputs, _params, fut, _ts, _trace), count in zip(pending, counts):
+            for (inputs, _params, fut, _ts, _trace, _dl), count in zip(pending, counts):
                 part = {
                     n: v[offset : offset + count] for n, v in outputs.items()
                 }
@@ -325,7 +355,7 @@ class _DynamicBatcher:
                     fut.set_result(part)
         except Exception as e:
             self._model.stats.record(total, 0, 0, ok=False)
-            for _inputs, _params, fut, _ts, _trace in pending:
+            for _inputs, _params, fut, _ts, _trace, _dl in pending:
                 if not fut.done():
                     fut.set_exception(e)
 
@@ -383,13 +413,98 @@ class InferenceCore:
         # readiness gate: /v2/health/ready (and gRPC ServerReady) report
         # not-ready until startup warmup finished and no model is mid-load
         self.startup_complete = False
+        # -- resilience layer ------------------------------------------
+        # admission control: False once a graceful drain began — new
+        # requests are refused (503/UNAVAILABLE) while in-flight ones run
+        # to completion
+        self.accepting = True
+        # per-model bounded queue: a model's pending requests beyond its
+        # limit are shed with 429/RESOURCE_EXHAUSTED + Retry-After instead
+        # of queueing unboundedly.  Resolution order: the runtime override
+        # in ``queue_limits``, the model config's ``max_queue_size``
+        # parameter, then this default (0 = unbounded).
+        self.default_max_queue_size = 0
+        self.queue_limits: Dict[str, int] = {}
+        # pushback horizon handed to shed clients (Retry-After header /
+        # retry-after-ms gRPC trailing metadata)
+        self.shed_retry_after_s = 0.25
+        # optional fault injector (server/chaos.py; --chaos CLI flags)
+        self.chaos = None
+        # counters backing nv_inference_rejected_total /
+        # nv_inference_deadline_exceeded_total (bumped on the event loop /
+        # under the GIL, same discipline as the response-cache counters)
+        self.rejected_by_model: Dict[str, int] = {}
+        self.deadline_exceeded_by_model: Dict[str, int] = {}
 
     def ready(self) -> bool:
         """Server-level readiness: up, past startup warmup, and no model
         currently loading/warming (Triton semantics: ready means "will
         serve an inference now", not "the frontends answered")."""
-        return (self.live and self.startup_complete
+        return (self.live and self.accepting and self.startup_complete
                 and not self.registry.any_loading())
+
+    # -- resilience ----------------------------------------------------
+    def count_deadline_exceeded(self, model_name: str) -> None:
+        self.deadline_exceeded_by_model[model_name] = \
+            self.deadline_exceeded_by_model.get(model_name, 0) + 1
+
+    def max_queue_size(self, model: Model) -> int:
+        """The model's admission bound (0 = unbounded)."""
+        limit = self.queue_limits.get(model.name)
+        if limit is not None:
+            return int(limit)
+        if "max_queue_size" in model.config.parameters:
+            try:
+                return int(model.config.parameters[
+                    "max_queue_size"].string_value)
+            except ValueError:
+                pass
+        return self.default_max_queue_size
+
+    def _admit(self, model: Model) -> None:
+        """Admission control at request entry: refuse during drain, shed
+        when the model's pending queue is at its bound — load the server
+        cannot serve in time is cheaper to reject now than to time out
+        later (Tail at Scale: load shedding keeps p99.9 bounded)."""
+        if not self.accepting:
+            raise InferError("server is shutting down", http_status=503,
+                             retry_after_s=self.shed_retry_after_s)
+        limit = self.max_queue_size(model)
+        if limit > 0 and model.stats.pending_count >= limit:
+            self.rejected_by_model[model.name] = \
+                self.rejected_by_model.get(model.name, 0) + 1
+            raise InferError(
+                f"request queue for model '{model.name}' is full "
+                f"({limit} pending); retry later",
+                http_status=429, retry_after_s=self.shed_retry_after_s)
+
+    def _check_deadline(self, model: Model, request: InferRequest) -> None:
+        """Drop an already-expired request before any compute (proper v2
+        "deadline exceeded" error; the span tree shows no COMPUTE child)."""
+        if request.expired():
+            self.count_deadline_exceeded(model.name)
+            raise InferError(
+                f"request to model '{model.name}' exceeded its deadline "
+                "before execution", http_status=504)
+
+    async def _apply_chaos(self, model: Model, trace) -> None:
+        """Run the fault injector's verdict for this request.  The flight
+        record carries the chaos marker so the recorder pins injected
+        faults as outliers and triton-top labels them."""
+        fault = self.chaos.decide(model.name)
+        if fault is None:
+            return
+        if trace is not None and trace.flight is not None:
+            trace.flight.chaos = fault.kind
+        if fault.kind == "latency":
+            await asyncio.sleep(fault.latency_s)
+            return
+        if fault.kind == "abort":
+            from .chaos import ChaosAbort
+
+            raise ChaosAbort()
+        raise InferError(f"chaos: injected {fault.status} error",
+                         http_status=fault.status)
 
     # ------------------------------------------------------------------
     async def infer(self, request: InferRequest) -> InferResponse:
@@ -400,6 +515,7 @@ class InferenceCore:
                 f"doesn't support models with decoupled transaction policy",
                 http_status=400,
             )
+        self._admit(model)
         return await self._infer_on(model, request)
 
     async def _infer_on(self, model: Model, request: InferRequest) -> InferResponse:
@@ -477,6 +593,16 @@ class InferenceCore:
     async def _infer_traced(
         self, model: Model, request: InferRequest, trace
     ) -> InferResponse:
+        # deadline gate at dequeue: an expired request is rejected with
+        # zero compute (no COMPUTE span ever opens); chaos runs inside the
+        # traced envelope so injected faults land in the flight record
+        self._check_deadline(model, request)
+        if self.chaos is not None:
+            await self._apply_chaos(model, trace)
+            # an injected latency fault may have outlived the deadline —
+            # re-gate so the no-COMPUTE invariant survives chaos too (the
+            # batched path re-checks on its own via _drop_if_expired)
+            self._check_deadline(model, request)
         inputs = self._resolve_inputs(model, request)
         params = dict(request.parameters)
         cache_key = None
@@ -522,8 +648,9 @@ class InferenceCore:
             # Batched execution: the batcher records this request's QUEUE /
             # BATCH_ASSEMBLY spans and the shared batch's COMPUTE window
             # (every traced member of a batch carries the same COMPUTE span).
-            outputs = await self._batcher(model).submit(inputs, params,
-                                                        trace=trace)
+            outputs = await self._batcher(model).submit(
+                inputs, params, trace=trace,
+                deadline_ns=request.deadline_ns)
         else:
             # Outputs bound to slot-backed (in-process) xla-shm regions stay
             # device-resident — zero-copy handoff into the region.  Staging
@@ -564,9 +691,38 @@ class InferenceCore:
         common.h:488-563 and enable_empty_final_response,
         grpc/_client.py:1815-1929)."""
         model = self.registry.get(request.model_name, request.model_version)
+        # admission gates EVERY stream entry (decoupled or not): the gRPC
+        # bidi path reaches the core only through here, and a saturated or
+        # draining server must refuse streamed requests like unary ones
+        self._admit(model)
         if not model.decoupled:
             yield await self._infer_on(model, request)
             return
+        # the resilience gates apply to decoupled streams too: an expired
+        # deadline is dropped before the producer ever starts, and chaos
+        # exercises the stream error path (no unary trace context here —
+        # decoupled requests are not flight-recorded)
+        self._check_deadline(model, request)
+        if self.chaos is not None:
+            await self._apply_chaos(model, None)
+            self._check_deadline(model, request)
+        # pending gauge covers in-flight streams too, so graceful drain
+        # waits for them and admission sees their occupancy
+        model.stats.inc_pending()
+        agen = self._infer_stream_decoupled(model, request)
+        try:
+            async for resp in agen:
+                yield resp
+        finally:
+            # explicit aclose: the inner generator's GeneratorExit handler
+            # (consumer-disconnect accounting, producer stop) must run
+            # deterministically, not at GC time
+            await agen.aclose()
+            model.stats.dec_pending()
+
+    async def _infer_stream_decoupled(
+        self, model: Model, request: InferRequest
+    ) -> AsyncIterator[InferResponse]:
         inputs = self._resolve_inputs(model, request)
         params = dict(request.parameters)
         loop = asyncio.get_running_loop()
@@ -752,9 +908,20 @@ class InferenceCore:
             if self._inline_profiles[key].generation != gen:
                 self._inline_profiles.pop(key)
 
-    async def shutdown(self) -> None:
-        """Cancel background batcher tasks and fail any queued requests so
-        no handler is left awaiting a forever-pending future."""
+    async def shutdown(self, drain_s: float = 5.0) -> None:
+        """Graceful drain, then teardown: stop accepting (new requests get
+        503/UNAVAILABLE), wait up to ``drain_s`` for in-flight requests to
+        finish, then cancel background batcher tasks and fail anything
+        still queued so no handler is left awaiting a forever-pending
+        future."""
+        self.accepting = False
+        deadline = time.monotonic() + max(0.0, drain_s)
+        while time.monotonic() < deadline:
+            in_flight = sum(m.stats.pending_count
+                            for m in self.registry.all_version_models())
+            if not in_flight:
+                break
+            await asyncio.sleep(0.02)
         self.tracer.shutdown()
         self.log.shutdown()
         while self._batchers:
@@ -795,7 +962,7 @@ class InferenceCore:
             await asyncio.gather(*list(b._batch_tasks),
                                  return_exceptions=True)
         while not b._queue.empty():
-            _inputs, _params, fut, _ts, _trace = b._queue.get_nowait()
+            _inputs, _params, fut, _ts, _trace, _dl = b._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(InferError(reason, 503))
 

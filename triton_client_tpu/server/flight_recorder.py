@@ -91,7 +91,7 @@ class FlightRecord:
     __slots__ = ("seq", "request_id", "model", "version", "protocol",
                  "batch", "bytes_in", "bytes_out", "arrival_ns", "ts",
                  "queue_us", "compute_us", "total_us", "outcome",
-                 "capture_reason", "spans")
+                 "capture_reason", "spans", "chaos")
 
     def __init__(self, seq: int, model: str, version: str,
                  request_id: str = "", protocol: str = "",
@@ -112,6 +112,10 @@ class FlightRecord:
         self.outcome = "ok"
         self.capture_reason: Optional[str] = None
         self.spans: Optional[List[dict]] = None
+        # fault-injection marker (server/chaos.py): the injected kind
+        # ("latency"/"error"/"abort") — injected requests are always
+        # pinned as outliers so chaos weather is tellable from real
+        self.chaos: Optional[str] = None
 
     def to_dict(self, include_spans: bool = False) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -130,6 +134,7 @@ class FlightRecord:
             "outcome": self.outcome,
             "captured": self.capture_reason is not None,
             "capture_reason": self.capture_reason,
+            "chaos": self.chaos,
         }
         if include_spans:
             out["spans"] = self.spans or []
@@ -273,6 +278,10 @@ class FlightRecorder:
             record.capture_reason = "failed"
         elif is_slow:
             record.capture_reason = "slow"
+        elif record.chaos is not None:
+            # injected faults are always pinned, even when the request
+            # survived them (e.g. a latency fault under the threshold)
+            record.capture_reason = f"chaos:{record.chaos}"
         if record.capture_reason is not None:
             # the retroactive promotion: snapshot the full span tree the
             # shadow context carried all along (built before the lock —

@@ -30,7 +30,8 @@ from ..utils import (
 from .core import InferenceCore
 from .model import datatype_to_pb
 from .types import (InferError, InferRequest, InputTensor,
-                    RequestedOutput, ShmRef, reshape_input)
+                    RequestedOutput, ShmRef, apply_request_deadline,
+                    reshape_input)
 
 
 def pb_param_to_py(p: pb.InferParameter):
@@ -73,6 +74,9 @@ def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
         id=request.id,
         parameters={k: pb_param_to_py(v) for k, v in request.parameters.items()},
     )
+    # the v2 `timeout` parameter (µs) becomes the request's absolute
+    # deadline; expired requests are dropped at dequeue with zero compute
+    apply_request_deadline(req)
     raw = list(request.raw_input_contents)
     # raw_input_contents carries entries ONLY for non-shm inputs, in input
     # order (reference wire semantics: grpc/_utils.py packs raw buffers in a
@@ -493,6 +497,16 @@ class InferenceServicer:
                     self._core.log.verbose, 1,
                     f"grpc ModelInfer '{request.model_name}' -> "
                     f"{e.http_status}: {e}", rid)
+            ra = getattr(e, "retry_after_s", None)
+            if ra is not None:
+                # server pushback (gRPC A6): the resilience layer reads
+                # this trailing metadata and backs off for exactly this
+                # horizon instead of its computed jitter
+                try:
+                    context.set_trailing_metadata(
+                        (("retry-after-ms", str(int(ra * 1000))),))
+                except Exception:
+                    pass  # metadata already sent / bridge test double
             await context.abort(_grpc_code(e), str(e))
         if self._core.log.verbose_enabled():
             self._log_off_loop(
@@ -552,7 +566,13 @@ class InferenceServicer:
                         infer_response=_encode_pb_response(resp)
                     )
             except InferError as e:
-                yield pb.ModelStreamInferResponse(error_message=str(e))
+                # the bidi wire has no per-message grpc code, so the
+                # status rides in-band as a "[NNN] " prefix — streaming
+                # clients (grpc/_utils.stream_error_to_exception) map it
+                # back to a typed status so shed/deadline failures stay
+                # classifiable on streams too
+                yield pb.ModelStreamInferResponse(
+                    error_message=f"[{e.http_status}] {e}")
             except Exception as e:  # pragma: no cover - defensive
                 yield pb.ModelStreamInferResponse(error_message=str(e))
 
@@ -561,6 +581,12 @@ def _grpc_code(e: InferError) -> grpc.StatusCode:
     return {
         400: grpc.StatusCode.INVALID_ARGUMENT,
         404: grpc.StatusCode.NOT_FOUND,
+        # resilience layer: shed load / drain / blown deadline map to the
+        # codes the client retry policy gates on (RESOURCE_EXHAUSTED and
+        # UNAVAILABLE retryable; DEADLINE_EXCEEDED deliberately not)
+        429: grpc.StatusCode.RESOURCE_EXHAUSTED,
+        503: grpc.StatusCode.UNAVAILABLE,
+        504: grpc.StatusCode.DEADLINE_EXCEEDED,
         500: grpc.StatusCode.INTERNAL,
     }.get(e.http_status, grpc.StatusCode.UNKNOWN)
 

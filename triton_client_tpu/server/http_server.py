@@ -30,11 +30,16 @@ from ..utils import (
 )
 from .core import InferenceCore
 from .types import (InferError, InferRequest, InputTensor,
-                    RequestedOutput, ShmRef, reshape_input)
+                    RequestedOutput, ShmRef, apply_request_deadline,
+                    reshape_input)
 
 _HEADER_LEN = "Inference-Header-Content-Length"
 _REQUEST_ID_HDR = "triton-request-id"
 _TRACEPARENT_HDR = "traceparent"
+# remaining client deadline budget in microseconds (the HTTP wire form of
+# the v2 `timeout` parameter; restamped per retry attempt by the client
+# resilience layer)
+_TIMEOUT_HDR = "triton-timeout-us"
 
 
 def build_app(core: InferenceCore) -> web.Application:
@@ -135,6 +140,16 @@ def _h(core: InferenceCore, fn):
                     rid)
             return resp
         except InferError as e:
+            from .chaos import ChaosAbort
+
+            if isinstance(e, ChaosAbort):
+                # injected mid-response connection abort: kill the
+                # transport so the client sees a protocol error, not a
+                # well-formed 5xx — the connection-class failure the
+                # retry layer must absorb
+                if request.transport is not None:
+                    request.transport.close()
+                return web.Response(status=503)
             # 5xx are server-side failures (log_error); 4xx are client
             # mistakes — verbose only, or every fuzz/validation request
             # would spam the log
@@ -147,7 +162,22 @@ def _h(core: InferenceCore, fn):
                     core.log.verbose, 1,
                     f"{request.method} {request.path} -> "
                     f"{e.http_status}: {e}", rid)
-            return web.json_response({"error": str(e)}, status=e.http_status)
+            headers = None
+            if e.retry_after_s is not None:
+                # shed load carries the server's pushback horizon; the
+                # client retry policy honors it over its own backoff.
+                # Retry-After must be integer delta-seconds (RFC 7231) —
+                # the precise sub-second horizon travels alongside in
+                # triton-retry-after-ms (this framework's clients prefer
+                # it; standards-only intermediaries still parse the RFC
+                # form)
+                headers = {
+                    "Retry-After": str(max(1, math.ceil(e.retry_after_s))),
+                    "triton-retry-after-ms":
+                        str(int(e.retry_after_s * 1000)),
+                }
+            return web.json_response({"error": str(e)},
+                                     status=e.http_status, headers=headers)
         except web.HTTPException:
             raise
         except Exception as e:  # pragma: no cover - defensive
@@ -490,6 +520,9 @@ async def _infer(core, request: web.Request) -> web.Response:
     req.decode_end_ns = time.monotonic_ns()
     req.trace_handoff = True
     req.protocol = "http"
+    # deadline propagation: the triton-timeout-us header (the restamped
+    # remaining budget) wins over the body's `timeout` parameter
+    apply_request_deadline(req, header_us=request.headers.get(_TIMEOUT_HDR))
     resp = await core.infer(req)
     trace = resp.trace
     try:

@@ -100,7 +100,7 @@ def render_prometheus(core: InferenceCore) -> str:
     cache = core.response_cache
     slow_by_model, captured_by_model = \
         core.flight_recorder.watchdog_counters()
-    for name, help_text, counts in (
+    families = [
         ("nv_cache_num_hits_per_model",
          "Number of response cache hits per model", cache.hits_by_model),
         ("nv_cache_num_misses_per_model",
@@ -112,7 +112,22 @@ def render_prometheus(core: InferenceCore) -> str:
          "Number of requests pinned into the flight recorder's outlier "
          "buffer (slow or failed) with a full span tree",
          captured_by_model),
-    ):
+        # resilience layer: admission-control sheds and deadline drops
+        # (dict copies — the core bumps these on the event loop while a
+        # scrape iterates here)
+        ("nv_inference_rejected_total",
+         "Number of inference requests shed by admission control "
+         "(model queue at max_queue_size)", dict(core.rejected_by_model)),
+        ("nv_inference_deadline_exceeded_total",
+         "Number of inference requests dropped because their deadline "
+         "expired before execution", dict(core.deadline_exceeded_by_model)),
+    ]
+    if core.chaos is not None:
+        families.append(
+            ("nv_chaos_injected_total",
+             "Number of faults injected by the chaos harness",
+             core.chaos.counters()))
+    for name, help_text, counts in families:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} counter")
         for model, value in sorted(counts.items()):

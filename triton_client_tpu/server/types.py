@@ -68,8 +68,22 @@ class InferRequest:
     # Which wire the request arrived on ("http" / "grpc"; "" for in-process
     # callers) — recorded per request by the flight recorder.
     protocol: str = ""
+    # Absolute deadline on the server's monotonic clock (0 = none).  The
+    # frontends derive it from the v2 `timeout` request parameter
+    # (microseconds; both protocols) or the `triton-timeout-us` HTTP
+    # header — the wire forms the client resilience layer propagates its
+    # remaining deadline budget through.  An expired request is dropped at
+    # dequeue / batch assembly without entering COMPUTE.
+    deadline_ns: int = 0
     # Filled by the core:
     arrival_ns: int = field(default_factory=lambda: time.monotonic_ns())
+
+    def expired(self, now_ns: Optional[int] = None) -> bool:
+        """Whether this request's deadline has already passed."""
+        if not self.deadline_ns:
+            return False
+        return (now_ns if now_ns is not None
+                else time.monotonic_ns()) >= self.deadline_ns
 
     @property
     def sequence_id(self):
@@ -110,11 +124,43 @@ class InferResponse:
 
 
 class InferError(Exception):
-    """Server-side inference error with an HTTP status / gRPC code mapping."""
+    """Server-side inference error with an HTTP status / gRPC code mapping.
 
-    def __init__(self, msg: str, http_status: int = 400):
+    ``retry_after_s`` carries server pushback for shed load (HTTP 429 →
+    ``Retry-After`` header; gRPC RESOURCE_EXHAUSTED → ``retry-after-ms``
+    trailing metadata) so a well-behaved client backs off for exactly the
+    horizon the server asked for."""
+
+    def __init__(self, msg: str, http_status: int = 400,
+                 retry_after_s: Optional[float] = None):
         super().__init__(msg)
         self.http_status = http_status
+        self.retry_after_s = retry_after_s
+
+
+def apply_request_deadline(req: InferRequest,
+                           header_us: Optional[str] = None) -> None:
+    """Resolve a request's server-side deadline from its wire forms.
+
+    The v2 ``timeout`` request parameter (microseconds, both protocols) is
+    *consumed* here — it describes the transport contract, not the model,
+    and leaving it in ``parameters`` would split dynamic-batch parameter
+    groups per-deadline.  ``header_us`` is the HTTP ``triton-timeout-us``
+    header, which wins over the body parameter when both are present (the
+    header is restamped per retry attempt with the shrunken budget)."""
+    raw = req.parameters.pop("timeout", None)
+    if header_us is not None:
+        raw = header_us
+    if raw is None:
+        return
+    try:
+        us = int(raw)
+    except (TypeError, ValueError):
+        raise InferError(
+            f"invalid request timeout {raw!r}: expected an integer "
+            "microseconds value")
+    if us > 0:
+        req.deadline_ns = time.monotonic_ns() + us * 1000
 
 
 def reshape_input(arr: np.ndarray, shape, name: str) -> np.ndarray:

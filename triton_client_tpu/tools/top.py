@@ -40,6 +40,8 @@ _METRICS = (
     "nv_inference_batch_size_total",
     "nv_inference_batch_execution_count",
     "nv_inference_pending_request_count",
+    "nv_inference_rejected_total",
+    "nv_inference_deadline_exceeded_total",
 )
 
 _SERIES_RE = re.compile(r'^(\w+)\{([^}]*)\}\s+([0-9.eE+-]+)\s*$')
@@ -128,6 +130,10 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
                              "nv_inference_batch_size_total", model)
         batch_exec = _delta(metrics, pmetrics,
                             "nv_inference_batch_execution_count", model)
+        rejected = _delta(metrics, pmetrics,
+                          "nv_inference_rejected_total", model)
+        deadline_x = _delta(metrics, pmetrics,
+                            "nv_inference_deadline_exceeded_total", model)
         total = succ + fail
         rec = recorder.get("models", {}).get(model, {})
         rows[model] = {
@@ -141,6 +147,11 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "pending": int(metrics.get(
                 "nv_inference_pending_request_count", {}).get(model, 0)),
             "error_pct": round(100.0 * fail / total, 2) if total > 0 else None,
+            # resilience layer: shed + deadline-dropped rates (cumulative
+            # counters on the first/only sample, like qps)
+            "rejected_per_s": round(rejected / dt, 1) if dt else None,
+            "deadline_exceeded_per_s": (round(deadline_x / dt, 1)
+                                        if dt else None),
             "slow_total": rec.get("slow_total", 0),
             "captured_total": rec.get("captured_total", 0),
             "threshold_ms": rec.get("threshold_ms"),
@@ -164,6 +175,7 @@ def _outlier_brief(o: Optional[dict]) -> Optional[Dict[str, Any]]:
         "total_ms": round(o["total_us"] / 1e3, 2),
         "reason": o.get("capture_reason"),
         "outcome": o.get("outcome"),
+        "chaos": o.get("chaos"),
         "request_id": o.get("request_id", ""),
     }
 
@@ -190,7 +202,8 @@ def render(url: str, cur: Dict[str, Any],
         f"{len(recorder.get('outliers', []))} outlier(s) pinned)",
         "",
         f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
-        f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'SLOW':>6}{'CAPT':>6}"
+        f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'REJ/s':>7}{'DLX/s':>7}"
+        f"{'SLOW':>6}{'CAPT':>6}"
         f"  LAST OUTLIER",
     ]
     for model, r in rows.items():
@@ -199,13 +212,18 @@ def render(url: str, cur: Dict[str, Any],
         if o is not None:
             brief = (f"{o['age_s']:g}s ago {o['total_ms']:g}ms "
                      f"{o['reason'] or ''}")
+            if o.get("chaos"):
+                # injected weather, labeled so an operator staring at a
+                # spike can tell the chaos harness from the real world
+                brief += f" [chaos:{o['chaos']}]"
             if o["outcome"] != "ok":
                 brief += f" ({o['outcome'][:40]})"
         lines.append(
             f"  {model:<24}{_fmt(r['qps']):>8}{_fmt(r['p50_ms']):>9}"
             f"{_fmt(r['p99_ms']):>9}{_fmt(r['queue_share_pct']):>8}"
             f"{_fmt(r['batch_avg']):>7}{r['pending']:>6}"
-            f"{_fmt(r['error_pct'], 2):>7}{r['slow_total']:>6}"
+            f"{_fmt(r['error_pct'], 2):>7}{_fmt(r['rejected_per_s']):>7}"
+            f"{_fmt(r['deadline_exceeded_per_s']):>7}{r['slow_total']:>6}"
             f"{r['captured_total']:>6}  {brief}")
     if not rows:
         lines.append("  (no recorded requests yet)")

@@ -57,6 +57,9 @@ class InferenceServerException(Exception):
         self._msg = msg
         self._status = status
         self._debug_details = debug_details
+        # Server pushback (HTTP Retry-After / gRPC retry-after-ms trailing
+        # metadata) in seconds; the resilience layer's backoff honors it.
+        self.retry_after_s: Optional[float] = None
         super().__init__(msg)
 
     def __str__(self):
