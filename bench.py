@@ -465,6 +465,131 @@ def _measure_resilience_overhead(sweep, inputs_fn) -> dict:
     return {"resilience_overhead": result}
 
 
+def _measure_cluster() -> dict:
+    """Cluster-client A/Bs on a 3-replica in-process fleet (own harnesses,
+    run after the main harness stopped):
+
+    * ``cluster_routing`` — least-outstanding ``ClusterClient`` over 3
+      replicas vs a single-endpoint client at the same fixed concurrency.
+      All replicas share this process's CPU, so ``speedup`` here mostly
+      bounds the routing layer's overhead (±noise); on a real multi-host
+      fleet the same A/B measures the capacity win.
+    * ``hedging_tail`` — p99 with vs without hedged requests while one
+      replica is a chaos-latency straggler (every request to it +80 ms);
+      round-robin on both sides so the straggler is hit deterministically.
+      The acceptance bar is hedged p99 strictly below unhedged p99.
+    """
+    import gc
+
+    from triton_client_tpu._resilience import RetryPolicy
+    from triton_client_tpu.grpc import InferenceServerClient, InferInput
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server.chaos import ChaosInjector
+    from triton_client_tpu.server.registry import ModelRegistry
+    from triton_client_tpu.server.testing import ClusterHarness
+
+    gc.collect()  # free the stopped main harness's device arrays first
+
+    def factory():
+        r = ModelRegistry()
+        r.register_model(zoo.make_simple())
+        return r
+
+    def make_inputs():
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        i0 = InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_data_from_numpy(a)
+        i1 = InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_data_from_numpy(b)
+        return [i0, i1]
+
+    # the sweeps ride perf_analyzer.run_level: one SHARED ClusterClient
+    # per level (a per-worker client would degrade least_outstanding to
+    # random choice — its pool would never see another worker's
+    # in-flight requests) and per-endpoint/hedge counters for free
+    from triton_client_tpu.perf_analyzer import (_make_data,
+                                                 _resolve_model, run_level)
+
+    def p99_ms(res):
+        return (round(res["p99_us"] / 1e3, 3)
+                if np.isfinite(res["p99_us"]) else None)
+
+    out: dict = {}
+    try:
+        with ClusterHarness(factory, n=3) as ch:
+            urls = ch.grpc_urls
+            # warm every replica before any clock (first request compiles)
+            for u in urls:
+                with InferenceServerClient(u) as warm:
+                    warm.infer("simple", make_inputs())
+            meta = InferenceServerClient(urls[0])
+            pa_inputs, pa_outputs, pa_max_batch = _resolve_model(
+                meta, "grpc", "simple", "")
+            meta.close()
+            arrays = _make_data(pa_inputs, {}, 1, pa_max_batch,
+                                np.random.default_rng(0))
+            single = run_level("grpc", urls[0], "simple", "", 8, arrays,
+                               pa_outputs, "none", 1 << 20, 2.0,
+                               warmup_s=0.5)
+            cluster = run_level("grpc", urls, "simple", "", 8, arrays,
+                                pa_outputs, "none", 1 << 20, 2.0,
+                                warmup_s=0.5,
+                                balancing="least_outstanding")
+            routing = {
+                "cluster_infer_per_sec": round(cluster["throughput"], 2),
+                "single_infer_per_sec": round(single["throughput"], 2),
+                "cluster_p99_ms": p99_ms(cluster),
+                "single_p99_ms": p99_ms(single),
+                "endpoints": cluster.get("endpoints"),
+            }
+            if single["throughput"]:
+                routing["speedup"] = round(
+                    cluster["throughput"] / single["throughput"], 2)
+            errors = single["errors"] + cluster["errors"]
+            if errors:
+                routing["errors"] = [single.get("first_error"),
+                                     cluster.get("first_error")]
+            out["cluster_routing"] = routing
+
+            # hedging A/B: replica 0 becomes a deterministic straggler.
+            # The straggler delay (400 ms) must dwarf the hedge delay
+            # (100 ms), which in turn must exceed the loaded normal p99 —
+            # all three replicas share this process's CPU, so "normal"
+            # latency here is far above a real fleet's, and a hedge delay
+            # below it makes every request hedge (doubling load and
+            # inverting the A/B)
+            ch.chaos(0, ChaosInjector(rate=1.0, kinds=["latency"],
+                                      latency_ms=400.0, seed=7))
+            unhedged = run_level("grpc", urls, "simple", "", 4, arrays,
+                                 pa_outputs, "none", 1 << 20, 2.0,
+                                 warmup_s=0.5, balancing="round_robin")
+            # max_attempts=1 + retry_infer arms the hedge idempotency
+            # gate without enabling retries (the perf_analyzer contract)
+            hedged = run_level("grpc", urls, "simple", "", 4, arrays,
+                               pa_outputs, "none", 1 << 20, 2.0,
+                               warmup_s=0.5, balancing="round_robin",
+                               hedge_ms=100.0,
+                               retry_policy=RetryPolicy(
+                                   max_attempts=1, retry_infer=True))
+            tail = {
+                "hedged_p99_ms": p99_ms(hedged),
+                "unhedged_p99_ms": p99_ms(unhedged),
+                "hedged_infer_per_sec": round(hedged["throughput"], 2),
+                "unhedged_infer_per_sec": round(unhedged["throughput"], 2),
+                "hedges": hedged.get("hedges", 0),
+                "hedge_wins": hedged.get("hedge_wins", 0),
+            }
+            errors = unhedged["errors"] + hedged["errors"]
+            if errors:
+                tail["errors"] = [unhedged.get("first_error"),
+                                  hedged.get("first_error")]
+            out["hedging_tail"] = tail
+    except Exception as e:  # noqa: BLE001 — cluster leg never kills bench
+        return {"cluster_error": str(e)[:120]}
+    return out
+
+
 def _measure_rtt_floor() -> float:
     """Median blocking device round trip (H2D + sync + D2H) in ms — the
     physical latency floor for any synchronous per-request device path."""
@@ -763,6 +888,8 @@ def main() -> int:
     gen_metrics.update(_measure_generation_ab())
     # int8 BERT serving (r5): own harness, env-resolved at first inference
     bert_metrics.update(_measure_bert_int8())
+    # cluster client: routing + hedged-tail A/Bs on a 3-replica fleet
+    cluster_metrics = _measure_cluster()
 
     baseline = _previous_baseline()
     value = simple_res["infer_per_sec"]
@@ -808,6 +935,8 @@ def main() -> int:
     out.update(recorder_overhead)
     # client resilience layer: retry-wrapped vs plain happy-path delta
     out.update(resilience_overhead)
+    # cluster routing + hedging tail: the client-side fleet layer's numbers
+    out.update(cluster_metrics)
     # client-side telemetry (the instrumented clients recorded every leg):
     # a compact per-(protocol, method, model) view so the bench record
     # carries client-observed p50/p99 next to the server-derived numbers

@@ -1,0 +1,618 @@
+"""Cluster client subsystem: routing, breakers, stickiness, hedging.
+
+Units cover the policy/breaker/pool state machines with no server; the
+e2e half drives a real 3-replica ``ClusterHarness`` through the scenarios
+the subsystem exists for — a replica killed mid-run at concurrency 8 with
+zero caller-visible errors, sequences pinned across another endpoint's
+outage, the breaker's closed→open→half_open→closed cycle asserted from
+telemetry snapshots, and hedged requests cutting a chaos-latency
+straggler's tail.  Soak variants are ``slow``-marked.
+
+Determinism notes: breaker tests use explicit reset timeouts and
+condition-polling (no bare sleeps against races); the hedging test gives
+the straggler a 400 ms injected delay against a 50 ms hedge, so the
+assertion margin is ~8x, not a coin flip.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import triton_client_tpu.http as httpclient
+from triton_client_tpu._resilience import RetryPolicy
+from triton_client_tpu._telemetry import telemetry
+from triton_client_tpu.cluster import (CircuitBreaker, ClusterClient,
+                                       EndpointPool, HedgePolicy,
+                                       LeastOutstanding, RoundRobin,
+                                       make_policy, rendezvous_rank)
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import ModelRegistry
+from triton_client_tpu.server.chaos import ChaosInjector
+from triton_client_tpu.server.testing import ClusterHarness
+from triton_client_tpu.utils import InferenceServerException
+
+MODEL = "custom_identity_int32"
+
+
+def _registry_factory():
+    r = ModelRegistry()
+    r.register_model(zoo.make_custom_identity_int32())
+    return r
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ch = ClusterHarness(_registry_factory, n=3)
+    ch.start()
+    yield ch
+    ch.stop()
+
+
+@pytest.fixture(autouse=True)
+def _all_replicas_up(cluster):
+    """Tests kill/restart replicas; every test starts with a full fleet."""
+    for i, h in enumerate(cluster.harnesses):
+        if h is None:
+            cluster.restart(i)
+        else:
+            h.core.chaos = None
+    yield
+
+
+def _x(n=4):
+    return np.arange(n, dtype=np.int32).reshape(1, n)
+
+
+def _inputs(x):
+    i = httpclient.InferInput("INPUT0", list(x.shape), "INT32")
+    i.set_data_from_numpy(x)
+    return [i]
+
+
+def _policy(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("retry_infer", True)
+    kw.setdefault("initial_backoff_s", 0.01)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+def _wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _endpoint_totals():
+    return {e["endpoint"]: e["success"] + e["failure"]
+            for e in telemetry().snapshot()["endpoints"]}
+
+
+def _endpoint_state(url):
+    for e in telemetry().snapshot()["endpoints"]:
+        if e["endpoint"] == url:
+            return e["state"]
+    return None
+
+
+# -- unit: balancing policies ------------------------------------------------
+
+class TestPolicies:
+    def test_round_robin_cycles(self):
+        pool = EndpointPool(["a:1", "b:1", "c:1"], policy="round_robin")
+        picks = [pool.pick().url for _ in range(6)]
+        assert picks == ["a:1", "b:1", "c:1"] * 2
+
+    def test_least_outstanding_prefers_idle(self):
+        pool = EndpointPool(["a:1", "b:1"], policy=LeastOutstanding(seed=0))
+        busy = pool.endpoint("a:1")
+        for _ in range(5):
+            busy.acquire()
+        # power-of-two over two endpoints always samples both
+        assert all(pool.pick().url == "b:1" for _ in range(20))
+
+    def test_make_policy(self):
+        assert isinstance(make_policy("round_robin"), RoundRobin)
+        rr = RoundRobin()
+        assert make_policy(rr) is rr
+        with pytest.raises(ValueError):
+            make_policy("fastest_guess")
+
+    def test_duplicate_urls_rejected(self):
+        with pytest.raises(ValueError):
+            EndpointPool(["a:1", "a:1"])
+
+    def test_comma_separated_urls(self):
+        pool = EndpointPool("a:1, b:1,c:1")
+        assert pool.urls == ["a:1", "b:1", "c:1"]
+
+
+# -- unit: sticky sequence routing -------------------------------------------
+
+class TestStickyRouting:
+    URLS = ["h1:8000", "h2:8000", "h3:8000"]
+
+    def test_deterministic_and_distributed(self):
+        pins = {s: rendezvous_rank(s, self.URLS)[0] for s in range(64)}
+        assert pins == {s: rendezvous_rank(s, self.URLS)[0]
+                        for s in range(64)}
+        # 64 sequences spread across all three endpoints
+        assert set(pins.values()) == set(self.URLS)
+
+    def test_membership_change_only_moves_affected_sequences(self):
+        # THE sticky invariant: dropping endpoint B never remaps a
+        # sequence pinned to A (rendezvous/HRW property)
+        for seq in range(32):
+            full = rendezvous_rank(seq, self.URLS)
+            victim = [u for u in self.URLS if u != full[0]][0]
+            reduced = rendezvous_rank(
+                seq, [u for u in self.URLS if u != victim])
+            assert reduced[0] == full[0]
+
+    def test_pinned_sequence_not_displaced_by_busy_half_open_trial(self):
+        # the pin recovers (half_open) and a regular request claims the
+        # single trial slot; a pinned-sequence request must STILL route
+        # to the pin — stickiness outranks trial throttling, because a
+        # remap sends stateful traffic to a replica with no state
+        pool = EndpointPool(self.URLS, policy="round_robin",
+                            failure_threshold=1, reset_timeout_s=0.0)
+        pin = pool.sticky_rank(7)[0]
+        br = pool.endpoint(pin).breaker
+        br.record(ok=False)          # trip
+        assert br.try_admit()        # a regular request takes the trial
+        assert br.state == "half_open"
+        assert pool.pick(sequence_id=7).url == pin
+
+    def test_pool_pick_honors_pin_and_fails_over_in_rank_order(self):
+        pool = EndpointPool(self.URLS, policy="round_robin")
+        ranked = pool.sticky_rank(42)
+        assert pool.pick(sequence_id=42).url == ranked[0]
+        # pinned endpoint evicted -> deterministic failover to rank 1
+        br = pool.endpoint(ranked[0]).breaker
+        for _ in range(br.failure_threshold):
+            br.record(ok=False)
+        assert pool.pick(sequence_id=42).url == ranked[1]
+        # excluded rank-1 too -> rank 2
+        assert pool.pick(sequence_id=42,
+                         exclude=[ranked[1]]).url == ranked[2]
+
+
+# -- unit: circuit breaker ---------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_full_cycle(self):
+        br = CircuitBreaker("e:1", failure_threshold=3, reset_timeout_s=0.1)
+        assert br.state == "closed" and br.would_allow()
+        br.record(False)
+        br.record(False)
+        assert br.state == "closed"  # below threshold
+        br.record(False)
+        assert br.state == "open"
+        assert not br.would_allow() and not br.try_admit()
+        time.sleep(0.12)
+        assert br.would_allow()
+        assert br.try_admit()  # claims the half-open trial
+        assert br.state == "half_open"
+        assert not br.try_admit()  # single trial at a time
+        br.record(True)
+        assert br.state == "closed"
+        assert br.history == ["closed", "open", "half_open", "closed"]
+
+    def test_half_open_failure_reopens(self):
+        br = CircuitBreaker("e:1", failure_threshold=2, reset_timeout_s=0.05)
+        br.record(False)
+        br.record(False)
+        time.sleep(0.06)
+        assert br.try_admit()
+        br.record(False)  # trial failed
+        assert br.state == "open"
+        assert not br.try_admit()  # cooldown restarted
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker("e:1", failure_threshold=3)
+        br.record(False)
+        br.record(False)
+        br.record(True)
+        br.record(False)
+        br.record(False)
+        assert br.state == "closed"  # never 3 consecutive
+
+    def test_stale_success_does_not_close_an_open_breaker(self):
+        # a success that was in flight before the trip must not snap the
+        # breaker closed and flood traffic back — OPEN closes only
+        # through the half-open trial
+        br = CircuitBreaker("e:1", failure_threshold=2,
+                            reset_timeout_s=0.05)
+        br.record(False)
+        br.record(False)
+        assert br.state == "open"
+        br.record(True)  # stale in-flight success lands now
+        assert br.state == "open"
+        time.sleep(0.06)
+        assert br.try_admit()
+        br.record(True)
+        assert br.state == "closed"
+
+    def test_would_allow_never_mutates(self):
+        br = CircuitBreaker("e:1", failure_threshold=1, reset_timeout_s=0.0)
+        br.record(False)
+        assert br.state == "open"
+        for _ in range(5):
+            assert br.would_allow()
+        assert br.state == "open"  # listing candidates consumed nothing
+
+
+# -- unit: pool eviction / exclusion ----------------------------------------
+
+class TestPoolRouting:
+    def test_open_breaker_is_skipped(self):
+        pool = EndpointPool(["a:1", "b:1"], policy="round_robin")
+        bad = pool.endpoint("a:1")
+        for _ in range(bad.breaker.failure_threshold):
+            pool.record(bad, ok=False)
+        assert all(pool.pick().url == "b:1" for _ in range(5))
+
+    def test_exclusion_prefers_other_endpoint(self):
+        pool = EndpointPool(["a:1", "b:1"], policy="round_robin")
+        assert all(pool.pick(exclude=["a:1"]).url == "b:1"
+                   for _ in range(5))
+
+    def test_exclusion_ignored_when_it_empties_the_pool(self):
+        pool = EndpointPool(["a:1"], policy="round_robin")
+        assert pool.pick(exclude=["a:1"]).url == "a:1"
+
+    def test_total_outage_still_routes(self):
+        pool = EndpointPool(["a:1", "b:1"], reset_timeout_s=60.0)
+        for url in pool.urls:
+            ep = pool.endpoint(url)
+            for _ in range(ep.breaker.failure_threshold):
+                pool.record(ep, ok=False)
+        assert pool.pick().url in ("a:1", "b:1")
+
+
+# -- unit: hedge policy ------------------------------------------------------
+
+class TestHedgePolicy:
+    def test_default_until_warm_then_quantile(self):
+        pool = EndpointPool(["a:1"])
+        ep = pool.endpoint("a:1")
+        h = HedgePolicy(quantile=0.95, default_delay_s=0.5, min_samples=8)
+        assert h.delay_s(ep, "m") == 0.5
+        for _ in range(100):
+            ep.observe("m", 0.010)
+        # warmed: the observed p95 (~10 ms, log-bucket quantized)
+        assert 0.008 < h.delay_s(ep, "m") < 0.013
+
+    def test_validates_quantile(self):
+        with pytest.raises(ValueError):
+            HedgePolicy(quantile=1.5)
+
+
+# -- e2e: routing and delegation --------------------------------------------
+
+class TestClusterE2E:
+    def test_round_robin_spreads_traffic(self, cluster):
+        before = _endpoint_totals()
+        with ClusterClient(cluster.http_urls, protocol="http",
+                           policy="round_robin") as c:
+            x = _x()
+            for _ in range(6):
+                r = c.infer(MODEL, _inputs(x))
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        after = _endpoint_totals()
+        for url in cluster.http_urls:
+            assert after.get(url, 0) - before.get(url, 0) == 2, url
+
+    def test_health_and_metadata_delegation(self, cluster):
+        with ClusterClient(cluster.http_urls, protocol="http") as c:
+            assert c.is_server_ready() is True
+            md = c.get_model_metadata(MODEL)
+            assert md["name"] == MODEL
+
+    def test_plugin_fans_out_to_endpoint_clients(self, cluster):
+        from triton_client_tpu import BasicAuth
+
+        plugin = BasicAuth("user", "pass")
+        with ClusterClient(cluster.http_urls, protocol="http",
+                           policy="round_robin") as c:
+            x = _x()
+            c.infer(MODEL, _inputs(x))  # one client exists pre-register
+            c.register_plugin(plugin)
+            for _ in range(3):
+                c.infer(MODEL, _inputs(x))
+            # every per-endpoint client (pre-existing and lazily built
+            # after registration) carries the plugin — auth headers must
+            # reach the wire on every replica
+            assert len(c._clients) == 3
+            assert all(cl.plugin() is plugin
+                       for cl in c._clients.values())
+            c.unregister_plugin()
+            assert all(cl.plugin() is None for cl in c._clients.values())
+
+    def test_streaming_is_rejected(self, cluster):
+        with ClusterClient(cluster.http_urls, protocol="http") as c:
+            with pytest.raises(InferenceServerException):
+                c.start_stream(callback=lambda *a: None)
+
+    def test_grpc_cluster_round_trip(self, cluster):
+        import triton_client_tpu.grpc as grpcclient
+
+        x = _x()
+        i = grpcclient.InferInput("INPUT0", [1, 4], "INT32")
+        i.set_data_from_numpy(x)
+        with ClusterClient(cluster.grpc_urls, protocol="grpc") as c:
+            r = c.infer(MODEL, [i])
+            np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+
+    def test_aio_cluster_round_trip(self, cluster):
+        from triton_client_tpu.cluster.aio import ClusterClient as AioCluster
+
+        async def main():
+            routes = []
+            async with AioCluster(
+                    cluster.http_urls, protocol="http",
+                    policy="round_robin",
+                    on_route=lambda u, m, s: routes.append(u)) as c:
+                assert await c.is_server_ready() is True
+                x = _x()
+                for _ in range(3):
+                    r = await c.infer(MODEL, _inputs(x))
+                    np.testing.assert_array_equal(
+                        r.as_numpy("OUTPUT0"), x)
+            return routes
+
+        routes = asyncio.run(main())
+        assert set(routes) == set(cluster.http_urls)
+
+
+# -- e2e: failover -----------------------------------------------------------
+
+def _concurrent_run(client, n_requests, concurrency, mid_action=None,
+                    mid_after=None):
+    """Closed-loop run at ``concurrency``; fires ``mid_action`` once
+    ``mid_after`` requests have been claimed.  Returns caller-visible
+    errors (the assertion target)."""
+    errors = []
+    claimed = [0]
+    lock = threading.Lock()
+    fired = threading.Event()
+    x = _x()
+
+    def worker():
+        try:
+            while True:
+                with lock:
+                    if claimed[0] >= n_requests:
+                        return
+                    claimed[0] += 1
+                    k = claimed[0]
+                if mid_action is not None and k == mid_after \
+                        and not fired.is_set():
+                    fired.set()
+                    mid_action()
+                r = client.infer(MODEL, _inputs(x))
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    return errors
+
+
+class TestFailover:
+    def test_kill_one_replica_zero_caller_visible_errors(self, cluster):
+        """Acceptance: 3 servers, one killed (and one chaos-degraded with
+        injected latency) mid-run at concurrency 8 — zero caller-visible
+        errors under RetryPolicy(3), traffic rebalanced to the survivors,
+        dead endpoint's breaker open in the telemetry snapshot."""
+        urls = cluster.http_urls
+        victim = urls[1]
+        # one replica degraded (not killed): latency chaos on replica 2
+        cluster.chaos(2, ChaosInjector(rate=0.3, kinds=["latency"],
+                                       latency_ms=30.0, seed=5))
+        before = _endpoint_totals()
+        with ClusterClient(urls, protocol="http", policy="round_robin",
+                           retry_policy=_policy()) as c:
+            errors = _concurrent_run(
+                c, n_requests=96, concurrency=8,
+                mid_action=lambda: cluster.kill(1), mid_after=24)
+            states = c.pool.states()
+        assert errors == []
+        assert states[victim] == "open"
+        assert _endpoint_state(victim) == "open"
+        after = _endpoint_totals()
+        # every survivor took strictly more traffic than the dead replica
+        # took failures — the rebalance is visible per endpoint
+        survivors = [u for u in urls if u != victim]
+        dead_delta = after.get(victim, 0) - before.get(victim, 0)
+        for u in survivors:
+            assert after.get(u, 0) - before.get(u, 0) > dead_delta / 2, u
+        # the fleet absorbed all 96 requests despite the outage
+        total_delta = sum(after.get(u, 0) - before.get(u, 0)
+                          for u in survivors)
+        assert total_delta >= 96 - dead_delta
+
+    def test_sequences_stay_pinned_across_other_endpoint_outage(
+            self, cluster):
+        urls = cluster.http_urls
+        routes = []
+        with ClusterClient(urls, protocol="http",
+                           retry_policy=_policy(),
+                           on_route=lambda u, m, s: routes.append((s, u))
+                           ) as c:
+            # 10 sequences: the odds every one pins to a single endpoint
+            # (which would starve the tracked/moved selection below) are
+            # (1/3)^9 — ports are random per run, so margin matters
+            pins = {s: c.pool.sticky_rank(s)[0] for s in range(1, 11)}
+            # a victim that pins at least one sequence, and a tracked
+            # sequence pinned elsewhere
+            victim = pins[1]
+            tracked = next(s for s, p in pins.items() if p != victim)
+            moved = next(s for s, p in pins.items() if p == victim)
+            x = _x()
+            for s in (tracked, moved):
+                c.infer(MODEL, _inputs(x), sequence_id=s,
+                        sequence_start=True)
+            kill_idx = urls.index(victim)
+            cluster.kill(kill_idx)
+            for _ in range(4):
+                for s in (tracked, moved):
+                    c.infer(MODEL, _inputs(x), sequence_id=s)
+            for s in (tracked, moved):
+                c.infer(MODEL, _inputs(x), sequence_id=s,
+                        sequence_end=True)
+            # the tracked sequence never left its pin — the outage of a
+            # DIFFERENT endpoint must not remap it
+            assert {u for s, u in routes if s == tracked} == \
+                {pins[tracked]}
+            # the displaced sequence fails over to its rank-1 endpoint
+            # (deterministic), never to an arbitrary one
+            rank1 = c.pool.sticky_rank(moved)[1]
+            moved_routes = [u for s, u in routes if s == moved]
+            assert set(moved_routes) <= {victim, rank1}
+            assert moved_routes[-1] == rank1
+
+    def test_breaker_cycle_closed_open_half_open_closed(self, cluster):
+        urls = cluster.http_urls
+        victim_idx, victim = 2, cluster.http_urls[2]
+        with ClusterClient(urls, protocol="http", policy="round_robin",
+                           retry_policy=_policy(),
+                           reset_timeout_s=1.0) as c:
+            x = _x()
+            for _ in range(6):
+                c.infer(MODEL, _inputs(x))
+            assert _endpoint_state(victim) == "closed"
+            cluster.kill(victim_idx)
+            # round-robin keeps offering the dead replica until three
+            # consecutive failures trip its breaker
+            for _ in range(12):
+                c.infer(MODEL, _inputs(x))
+            assert c.pool.states()[victim] == "open"
+            assert _endpoint_state(victim) == "open"  # telemetry snapshot
+            cluster.restart(victim_idx)
+            time.sleep(1.1)  # past the breaker's reset timeout
+            for _ in range(12):
+                c.infer(MODEL, _inputs(x))
+            assert c.pool.states()[victim] == "closed"
+            assert _endpoint_state(victim) == "closed"
+            history = c.pool.endpoint(victim).breaker.history
+            # the full cycle, in order (subsequence: traffic may lap the
+            # recovery window and add extra half_open/open rounds)
+            it = iter(history)
+            assert all(s in it for s in
+                       ["closed", "open", "half_open", "closed"]), history
+
+    def test_active_probing_evicts_and_readmits(self, cluster):
+        urls = cluster.http_urls
+        victim_idx, victim = 0, cluster.http_urls[0]
+        with ClusterClient(urls, protocol="http",
+                           reset_timeout_s=0.5,
+                           health_interval_s=0.15) as c:
+            cluster.kill(victim_idx)
+            # no user traffic at all: probes alone must evict...
+            _wait_for(lambda: c.pool.states()[victim] == "open",
+                      timeout=15.0, msg="probe eviction")
+            cluster.restart(victim_idx)
+            # ...and readmit through the half-open trial
+            _wait_for(lambda: c.pool.states()[victim] == "closed",
+                      timeout=15.0, msg="probe recovery")
+
+
+# -- e2e: hedged requests ----------------------------------------------------
+
+class TestHedging:
+    def test_hedge_cuts_straggler_tail(self, cluster):
+        """One replica gets +400 ms injected latency on every request;
+        hedging at 50 ms must keep every request far below the straggler
+        delay and record hedges + wins."""
+        urls = cluster.http_urls
+        cluster.chaos(0, ChaosInjector(rate=1.0, kinds=["latency"],
+                                       latency_ms=400.0, seed=3))
+        snap = telemetry().snapshot()["hedges"]
+        h_before = sum(h["hedges"] for h in snap)
+        w_before = sum(h["wins"] for h in snap)
+        x = _x()
+        with ClusterClient(
+                urls, protocol="http", policy="round_robin",
+                hedge=HedgePolicy(default_delay_s=0.05,
+                                  min_samples=1 << 30)) as c:
+            t0 = time.perf_counter()
+            for _ in range(9):
+                r = c.infer(MODEL, _inputs(x), hedge=True)
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+            elapsed = time.perf_counter() - t0
+        # 3 of 9 requests hit the straggler; unhedged they alone would
+        # cost 1.2 s — hedged, each resolves ~50 ms after issue
+        assert elapsed < 1.2, elapsed
+        snap = telemetry().snapshot()["hedges"]
+        assert sum(h["hedges"] for h in snap) - h_before >= 3
+        assert sum(h["wins"] for h in snap) - w_before >= 3
+
+    def test_hedge_gated_on_idempotency(self, cluster):
+        urls = cluster.http_urls
+        routes = []
+        with ClusterClient(urls, protocol="http", policy="round_robin",
+                           hedge=HedgePolicy(default_delay_s=0.0),
+                           on_route=lambda u, m, s: routes.append(u)) as c:
+            x = _x()
+            # no retry policy, no per-call override: hedging must stay
+            # off even with a zero delay (idempotency not asserted)
+            snap = telemetry().snapshot()["hedges"]
+            before = sum(h["hedges"] for h in snap)
+            c.infer(MODEL, _inputs(x))
+            snap = telemetry().snapshot()["hedges"]
+            assert sum(h["hedges"] for h in snap) == before
+
+    def test_sequences_never_hedge(self, cluster):
+        with ClusterClient(
+                cluster.http_urls, protocol="http",
+                hedge=HedgePolicy(default_delay_s=0.0),
+                retry_policy=_policy()) as c:
+            x = _x()
+            snap = telemetry().snapshot()["hedges"]
+            before = sum(h["hedges"] for h in snap)
+            c.infer(MODEL, _inputs(x), sequence_id=9,
+                    sequence_start=True, sequence_end=True)
+            snap = telemetry().snapshot()["hedges"]
+            assert sum(h["hedges"] for h in snap) == before
+
+
+# -- soak --------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_failover_soak(cluster):
+    """Order-of-magnitude bigger failover run: kill one replica AND
+    chaos-degrade another mid-run; still zero caller-visible errors."""
+    cluster.chaos(2, ChaosInjector(rate=0.2, kinds=["latency"],
+                                   latency_ms=40.0, seed=17))
+    with ClusterClient(cluster.http_urls, protocol="http",
+                       retry_policy=_policy()) as c:
+        errors = _concurrent_run(
+            c, n_requests=800, concurrency=8,
+            mid_action=lambda: cluster.kill(1), mid_after=200)
+    assert errors == []
+
+
+@pytest.mark.slow
+def test_hedging_soak(cluster):
+    cluster.chaos(0, ChaosInjector(rate=0.5, kinds=["latency"],
+                                   latency_ms=300.0, seed=29))
+    with ClusterClient(
+            cluster.http_urls, protocol="http",
+            policy="least_outstanding",
+            hedge=HedgePolicy(default_delay_s=0.05,
+                              min_samples=1 << 30),
+            retry_policy=_policy()) as c:
+        errors = _concurrent_run(c, n_requests=200, concurrency=8)
+    assert errors == []

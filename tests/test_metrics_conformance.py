@@ -197,3 +197,57 @@ class TestClientSurface:
         families = assert_conformant(telemetry().render_prometheus())
         samples = families["nv_client_inference_request_success"]["samples"]
         assert samples, "escaped-label series dropped"
+
+    def test_cluster_series_round_trip(self, server):
+        """Every nv_client_endpoint_* / nv_client_hedge* series renders
+        conformantly AND round-trips through the JSON snapshot with
+        stable names and labels."""
+        telemetry().reset()
+        telemetry().record_endpoint_request("h1:8000", ok=True)
+        telemetry().record_endpoint_request("h1:8000", ok=True)
+        telemetry().record_endpoint_request("h1:8000", ok=False)
+        telemetry().record_endpoint_request("h2:8000", ok=True)
+        telemetry().set_endpoint_state("h1:8000", "half_open")
+        telemetry().set_endpoint_state("h2:8000", "open")
+        telemetry().record_hedge("m", "http")
+        telemetry().record_hedge("m", "http")
+        telemetry().record_hedge("m", "http", won=True)
+        families = assert_conformant(telemetry().render_prometheus())
+        assert families["nv_client_endpoint_requests_total"]["type"] == \
+            "counter"
+        req = {(l["endpoint"], l["outcome"]): v for _, l, v in
+               families["nv_client_endpoint_requests_total"]["samples"]}
+        assert req == {("h1:8000", "success"): 2.0,
+                       ("h1:8000", "failure"): 1.0,
+                       ("h2:8000", "success"): 1.0}
+        assert families["nv_client_endpoint_state"]["type"] == "gauge"
+        state = {l["endpoint"]: v for _, l, v in
+                 families["nv_client_endpoint_state"]["samples"]}
+        assert state == {"h1:8000": 2.0, "h2:8000": 1.0}  # numeric code
+        hedges = {(l["model"], l["protocol"]): v for _, l, v in
+                  families["nv_client_hedges_total"]["samples"]}
+        assert hedges == {("m", "http"): 2.0}
+        wins = {(l["model"], l["protocol"]): v for _, l, v in
+                families["nv_client_hedge_wins_total"]["samples"]}
+        assert wins == {("m", "http"): 1.0}
+        # JSON snapshot carries the same series (state as the string)
+        snap = telemetry().snapshot()
+        assert snap["endpoints"] == [
+            {"endpoint": "h1:8000", "success": 2, "failure": 1,
+             "state": "half_open"},
+            {"endpoint": "h2:8000", "success": 1, "failure": 0,
+             "state": "open"},
+        ]
+        assert snap["hedges"] == [
+            {"model": "m", "protocol": "http", "hedges": 2, "wins": 1}]
+
+    def test_cluster_endpoint_label_escaping(self, server):
+        telemetry().reset()
+        evil = 'h"ost\\1\n:8000'
+        telemetry().record_endpoint_request(evil, ok=True)
+        telemetry().set_endpoint_state(evil, "closed")
+        telemetry().record_hedge(evil, "http")
+        families = assert_conformant(telemetry().render_prometheus())
+        for fam in ("nv_client_endpoint_requests_total",
+                    "nv_client_endpoint_state", "nv_client_hedges_total"):
+            assert families[fam]["samples"], f"{fam}: escaped series dropped"

@@ -217,6 +217,7 @@ def call_with_retry(
     method: str = "infer",
     deadline_s: Optional[float] = None,
     retry_meta=None,
+    on_failure: Optional[Callable[[BaseException, int], None]] = None,
 ) -> Any:
     """Run ``attempt_fn(remaining_s, attempt)`` under ``policy``.
 
@@ -225,6 +226,10 @@ def call_with_retry(
     propagates it to the server.  ``retry_meta`` is ``(model, protocol,
     method_name, request_id)`` for retry telemetry, or None to skip it.
     With ``policy=None`` this is a single attempt under the deadline.
+    ``on_failure(exc, attempt)`` fires for EVERY failed attempt (terminal
+    ones included, before the failure classification) — the cluster layer
+    hangs its endpoint-exclusion set off this hook so a retry lands on a
+    *different* replica than the attempt that just failed.
     """
     if deadline_s is None and policy is not None:
         deadline_s = policy.deadline_s
@@ -242,6 +247,8 @@ def call_with_retry(
         try:
             return attempt_fn(remaining, attempt)
         except BaseException as e:
+            if on_failure is not None:
+                on_failure(e, attempt)
             if deadline is not None and is_timeout_error(e) \
                     and time.monotonic() >= deadline - 1e-3:
                 # the deadline budget (not a shorter per-attempt
@@ -271,9 +278,11 @@ async def call_with_retry_async(
     method: str = "infer",
     deadline_s: Optional[float] = None,
     retry_meta=None,
+    on_failure: Optional[Callable[[BaseException, int], None]] = None,
 ) -> Any:
     """Async sibling of :func:`call_with_retry` — ``attempt_fn`` is an
-    async callable; backoff awaits instead of blocking the loop."""
+    async callable; backoff awaits instead of blocking the loop.
+    ``on_failure`` is a plain (non-async) callback, as in the sync loop."""
     import asyncio
 
     if deadline_s is None and policy is not None:
@@ -292,6 +301,8 @@ async def call_with_retry_async(
         try:
             return await attempt_fn(remaining, attempt)
         except BaseException as e:
+            if on_failure is not None:
+                on_failure(e, attempt)
             if deadline is not None and (
                     is_timeout_error(e)
                     or isinstance(e, asyncio.TimeoutError)) \

@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 __all__ = [
     "AppendFile",
     "ClientTelemetry",
+    "ENDPOINT_STATE_CODES",
     "LatencyHistogram",
     "escape_label",
     "merge_trace_headers",
@@ -94,6 +95,11 @@ class AppendFile:
     def close(self) -> None:
         with self._lock:
             self._close_locked()
+
+#: Numeric encoding of ``nv_client_endpoint_state`` (Prometheus gauges are
+#: numbers; the JSON snapshot carries the string): 0 = closed (healthy),
+#: 1 = open (evicted), 2 = half_open (probing recovery).
+ENDPOINT_STATE_CODES = {"closed": 0, "open": 1, "half_open": 2}
 
 #: Header / gRPC-metadata key carrying the client-generated request id the
 #: server echoes back and records in trace JSON (lowercase: gRPC metadata
@@ -294,6 +300,16 @@ class ClientTelemetry:
         self._shm_register: Dict[Tuple[str, str], List[int]] = {}
         # (kind, direction) -> [transfers, bytes]; direction: write | read
         self._shm_transfer: Dict[Tuple[str, str], List[int]] = {}
+        # cluster layer: per-endpoint routing counters.  Keyed by endpoint
+        # URL (not by model) — the question these answer is "where did the
+        # traffic go", which the per-(model, protocol, method) series above
+        # cannot: a ClusterClient fans one model across N endpoints.
+        # (endpoint, outcome) -> count; outcome: success | failure
+        self._endpoint_requests: Dict[Tuple[str, str], int] = {}
+        # endpoint -> breaker/health state name (closed | open | half_open)
+        self._endpoint_state: Dict[str, str] = {}
+        # (model, protocol) -> [hedges issued, hedges won by the hedge]
+        self._hedges: Dict[Tuple[str, str], List[int]] = {}
         self._hook: Optional[Callable[[Dict[str, Any]], None]] = None
         # client-side span tracing: when a path is set, every instrumented
         # inference appends one JSON line (request id + SERIALIZE/NETWORK/
@@ -363,6 +379,35 @@ class ClientTelemetry:
         s = self._series((model, protocol, method))
         with s.latency._lock:
             s.retries += 1
+
+    # -- cluster routing ---------------------------------------------------
+    def record_endpoint_request(self, endpoint: str, ok: bool) -> None:
+        """Count one request routed to ``endpoint`` by the cluster layer
+        (``nv_client_endpoint_requests_total``) — per-endpoint traffic
+        distribution is what proves rebalancing after a failover."""
+        key = (endpoint, "success" if ok else "failure")
+        with self._lock:
+            self._endpoint_requests[key] = \
+                self._endpoint_requests.get(key, 0) + 1
+
+    def set_endpoint_state(self, endpoint: str, state: str) -> None:
+        """Record an endpoint's breaker/health state (``closed`` /
+        ``open`` / ``half_open``) — rendered numerically as
+        ``nv_client_endpoint_state`` (0/1/2)."""
+        with self._lock:
+            self._endpoint_state[endpoint] = state
+
+    def record_hedge(self, model: str, protocol: str,
+                     won: bool = False) -> None:
+        """Count one hedged request (``won=False`` at issue time); call
+        again with ``won=True`` when the hedge beat the primary —
+        ``nv_client_hedges_total`` / ``nv_client_hedge_wins_total``."""
+        with self._lock:
+            c = self._hedges.setdefault((model, protocol), [0, 0])
+            if won:
+                c[1] += 1
+            else:
+                c[0] += 1
 
     def record_shm_register(self, protocol: str, kind: str,
                             byte_size: int) -> None:
@@ -476,6 +521,9 @@ class ClientTelemetry:
             self._requests.clear()
             self._shm_register.clear()
             self._shm_transfer.clear()
+            self._endpoint_requests.clear()
+            self._endpoint_state.clear()
+            self._hedges.clear()
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able snapshot of every series (perf_analyzer
@@ -486,6 +534,9 @@ class ClientTelemetry:
             series = sorted(self._requests.items())
             shm_reg = {k: list(v) for k, v in self._shm_register.items()}
             shm_tx = {k: list(v) for k, v in self._shm_transfer.items()}
+            ep_req = dict(self._endpoint_requests)
+            ep_state = dict(self._endpoint_state)
+            hedges = {k: list(v) for k, v in self._hedges.items()}
         requests = []
         for key, s in series:
             entry = {
@@ -497,8 +548,20 @@ class ClientTelemetry:
             }
             entry.update(s.latency.snapshot_us())
             requests.append(entry)
+        endpoint_urls = sorted({e for e, _ in ep_req} | set(ep_state))
         return {
             "requests": requests,
+            "endpoints": [
+                {"endpoint": e,
+                 "success": ep_req.get((e, "success"), 0),
+                 "failure": ep_req.get((e, "failure"), 0),
+                 "state": ep_state.get(e)}
+                for e in endpoint_urls
+            ],
+            "hedges": [
+                {"model": m, "protocol": p, "hedges": c[0], "wins": c[1]}
+                for (m, p), c in sorted(hedges.items())
+            ],
             "shared_memory": {
                 "register": [
                     {"protocol": p, "kind": k,
@@ -521,6 +584,10 @@ class ClientTelemetry:
             series = dict(sorted(self._requests.items()))
             shm_reg = {k: list(v) for k, v in self._shm_register.items()}
             shm_tx = {k: list(v) for k, v in self._shm_transfer.items()}
+            ep_req = dict(sorted(self._endpoint_requests.items()))
+            ep_state = dict(sorted(self._endpoint_state.items()))
+            hedges = {k: list(v)
+                      for k, v in sorted(self._hedges.items())}
         req_keys = list(series)
 
         def labels(key: Tuple[str, str, str]) -> str:
@@ -584,6 +651,34 @@ class ClientTelemetry:
         family(name, "Client-observed inference request duration in "
                      "microseconds", "summary", summary_rows)
 
+        family(
+            "nv_client_endpoint_requests_total",
+            "Number of client requests routed to each cluster endpoint",
+            "counter",
+            [f'nv_client_endpoint_requests_total{{'
+             f'endpoint="{escape_label(e)}",outcome="{escape_label(o)}"}} '
+             f"{n}" for (e, o), n in ep_req.items()])
+        family(
+            "nv_client_endpoint_state",
+            "Cluster endpoint breaker state (0=closed, 1=open, 2=half_open)",
+            "gauge",
+            [f'nv_client_endpoint_state{{endpoint="{escape_label(e)}"}} '
+             f"{ENDPOINT_STATE_CODES.get(s, -1)}"
+             for e, s in ep_state.items()])
+        family(
+            "nv_client_hedges_total",
+            "Number of hedged requests issued by the cluster client",
+            "counter",
+            [f'nv_client_hedges_total{{model="{escape_label(m)}",'
+             f'protocol="{escape_label(p)}"}} {c[0]}'
+             for (m, p), c in hedges.items()])
+        family(
+            "nv_client_hedge_wins_total",
+            "Number of hedged requests where the hedge beat the primary",
+            "counter",
+            [f'nv_client_hedge_wins_total{{model="{escape_label(m)}",'
+             f'protocol="{escape_label(p)}"}} {c[1]}'
+             for (m, p), c in hedges.items()])
         family(
             "nv_client_shared_memory_register_total",
             "Number of shared-memory regions registered by this client "

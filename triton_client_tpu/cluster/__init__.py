@@ -1,0 +1,43 @@
+"""Client-side cluster routing: one client surface over a server fleet.
+
+The reproduction's clients each speak to one ``url``; the production
+topology is N replicas behind every client.  This package is the layer in
+between — pure client-side (no load balancer appliance, no service mesh):
+
+* :class:`EndpointPool` — N endpoints with per-endpoint circuit breakers
+  (consecutive-failure trip, half-open probe recovery) and pluggable
+  balancing (:class:`RoundRobin`, :class:`LeastOutstanding`
+  power-of-two-choices) plus mandatory sticky sequence routing
+  (rendezvous-hashed ``sequence_id`` → endpoint, stable under membership
+  change — stateful models break if a sequence migrates mid-stream).
+* :class:`ClusterClient` (sync) / :class:`cluster.aio.ClusterClient`
+  (asyncio) — the ``InferenceServerClient`` surface over http/grpc ×
+  sync/aio, composing with :class:`~triton_client_tpu._resilience.RetryPolicy`
+  so retries prefer a *different* replica, with active health probing,
+  and with :class:`HedgePolicy` hedged requests (Dean & Barroso, "The
+  Tail at Scale"): after the observed per-(model, endpoint) p95, issue a
+  backup request to a second replica, first response wins.
+
+Everything is observable from the client: ``nv_client_endpoint_requests_total``,
+``nv_client_endpoint_state``, ``nv_client_hedges_total`` /
+``nv_client_hedge_wins_total`` in the telemetry registry's Prometheus
+rendering and JSON snapshot.
+"""
+
+from ._client import ClusterClient
+from ._policy import (BalancingPolicy, HedgePolicy, LeastOutstanding,
+                      RoundRobin, make_policy, rendezvous_rank)
+from ._pool import CircuitBreaker, Endpoint, EndpointPool
+
+__all__ = [
+    "BalancingPolicy",
+    "CircuitBreaker",
+    "ClusterClient",
+    "Endpoint",
+    "EndpointPool",
+    "HedgePolicy",
+    "LeastOutstanding",
+    "RoundRobin",
+    "make_policy",
+    "rendezvous_rank",
+]

@@ -1,0 +1,539 @@
+"""Synchronous ``ClusterClient``: one client surface over N endpoints.
+
+Wraps one per-endpoint ``InferenceServerClient`` (http or grpc) behind the
+same method surface and adds the routing layer on top:
+
+* every ``infer`` picks an endpoint through the :class:`EndpointPool`
+  (balancing policy / sticky sequence routing / breaker eviction),
+* the :class:`RetryPolicy` composes with routing: each failed attempt
+  appends its endpoint to an exclusion set, so the retry lands on a
+  *different* replica whenever one is available,
+* **hedged requests**: after a per-(model, endpoint) delay derived from
+  the observed latency quantiles (see :class:`HedgePolicy`), the request
+  is issued to a second endpoint; first response wins, the loser is
+  cancelled best-effort (a blocking transport call that already started
+  runs to completion in its worker thread — its result is discarded, its
+  outcome still feeds the breaker).  Gated on idempotency exactly like
+  ``retry_infer``.
+* active health probing (``health_interval_s``): a daemon thread polls
+  every endpoint's readiness (the same ``/v2/health/ready`` / gRPC
+  ``ServerReady`` gate the servers expose) and feeds verdicts into the
+  breakers, so a dead replica is evicted — and a recovered one readmitted
+  — without sacrificing user requests.
+
+Health/metadata getters route to one available endpoint (retried across
+endpoints under the client-level policy); control-plane calls
+(``load_model``, shm registration, trace/log settings) **broadcast** to
+every endpoint — a fleet where only one replica loaded the model is not a
+fleet.  Streaming APIs are per-connection by nature and not exposed here.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import wait as _fut_wait
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+from .._client import InferenceServerClientBase
+from .._resilience import RetryPolicy, call_with_retry
+from .._telemetry import telemetry
+from ..utils import raise_error
+from ._policy import HedgePolicy
+from ._pool import Endpoint, EndpointPool
+
+__all__ = ["ClusterClient"]
+
+#: Read-only probe methods, retried across endpoints under the policy.
+_HEALTH_METHODS = frozenset({
+    "is_server_live", "is_server_ready", "is_model_ready",
+})
+#: Read-only metadata/statistics methods, routed to one endpoint.
+_METADATA_METHODS = frozenset({
+    "get_server_metadata", "get_model_metadata", "get_model_config",
+    "get_model_repository_index", "get_inference_statistics",
+    "get_trace_settings", "get_log_settings", "get_flight_recorder",
+    "get_system_shared_memory_status", "get_cuda_shared_memory_status",
+    "get_xla_shared_memory_status",
+})
+#: Control-plane methods applied to EVERY endpoint (first result returned).
+_BROADCAST_METHODS = frozenset({
+    "load_model", "unload_model",
+    "update_trace_settings", "update_log_settings",
+    "register_system_shared_memory", "unregister_system_shared_memory",
+    "register_cuda_shared_memory", "unregister_cuda_shared_memory",
+    "register_xla_shared_memory", "unregister_xla_shared_memory",
+})
+_STREAMING_METHODS = frozenset({
+    "start_stream", "async_stream_infer", "stop_stream", "stream_infer",
+})
+
+
+class ClusterClient(InferenceServerClientBase):
+    """v2 client over a fleet of endpoints (sync; http or grpc).
+
+    Parameters
+    ----------
+    urls:
+        Endpoint list (``["h1:8000", "h2:8000"]``) or one comma-separated
+        string.
+    protocol:
+        ``"http"`` or ``"grpc"`` — which per-endpoint client to build.
+    policy:
+        Balancing policy name (``round_robin`` / ``least_outstanding``)
+        or a ``BalancingPolicy`` instance.  Nonzero ``sequence_id``
+        requests bypass it: sticky rendezvous routing is mandatory for
+        stateful models.
+    retry_policy:
+        Client-level :class:`RetryPolicy`; retries prefer a different
+        endpoint than the failed attempt.
+    hedge:
+        A :class:`HedgePolicy` to enable hedged inference, or None.
+    health_interval_s:
+        Probe every endpoint's readiness at this cadence (None = passive
+        health only, i.e. breakers fed by request outcomes).
+    client_kwargs:
+        Extra kwargs for each per-endpoint client constructor.
+    client_factory:
+        ``factory(url) -> client`` override (tests, custom transports).
+    on_route:
+        ``callback(endpoint_url, model_name, sequence_id)`` fired per
+        routed inference attempt — routing introspection for tests and
+        debugging.
+    """
+
+    def __init__(
+        self,
+        urls: Union[str, Iterable[str]],
+        protocol: str = "http",
+        policy: Union[str, object] = "least_outstanding",
+        retry_policy: Optional[RetryPolicy] = None,
+        hedge: Optional[HedgePolicy] = None,
+        health_interval_s: Optional[float] = None,
+        failure_threshold: int = 3,
+        reset_timeout_s: float = 5.0,
+        client_kwargs: Optional[Dict[str, Any]] = None,
+        client_factory: Optional[Callable[[str], Any]] = None,
+        hedge_workers: int = 32,
+        on_route: Optional[Callable[[str, str, int], None]] = None,
+    ):
+        super().__init__()
+        protocol = protocol.lower()
+        if protocol not in ("http", "grpc"):
+            raise_error(f"protocol must be 'http' or 'grpc', got {protocol}")
+        self._protocol = protocol
+        self._pool = EndpointPool(urls, policy=policy,
+                                  failure_threshold=failure_threshold,
+                                  reset_timeout_s=reset_timeout_s)
+        self._retry_policy = retry_policy
+        self._hedge = hedge
+        self._hedge_workers = int(hedge_workers)
+        self._on_route = on_route
+        self._client_kwargs = dict(client_kwargs or {})
+        self._client_factory = client_factory
+        self._clients: Dict[str, Any] = {}
+        self._probe_clients: Dict[str, Any] = {}
+        self._clients_lock = threading.Lock()
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._probe_executor: Optional[ThreadPoolExecutor] = None
+        self._probe_stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        if health_interval_s is not None:
+            self.start_probing(health_interval_s)
+
+    # -- wiring ------------------------------------------------------------
+    @property
+    def pool(self) -> EndpointPool:
+        return self._pool
+
+    @property
+    def urls(self) -> List[str]:
+        return self._pool.urls
+
+    def _make_client(self, url: str):
+        if self._client_factory is not None:
+            return self._client_factory(url)
+        if self._protocol == "grpc":
+            from .. import grpc as mod
+        else:
+            from .. import http as mod
+        return mod.InferenceServerClient(url, **self._client_kwargs)
+
+    def _client_for(self, ep: Endpoint):
+        client = self._clients.get(ep.url)
+        if client is None:
+            with self._clients_lock:
+                client = self._clients.get(ep.url)
+                if client is None:
+                    client = self._make_client(ep.url)
+                    if self._plugin is not None:
+                        client.register_plugin(self._plugin)
+                    self._clients[ep.url] = client
+        return client
+
+    # -- plugin fan-out ----------------------------------------------------
+    # a plugin registered on the cluster client (auth header injection is
+    # the canonical case) must reach every wire request, and the requests
+    # go out through the per-endpoint clients — so registration fans out
+    # to existing clients and _client_for applies it to future ones
+    def register_plugin(self, plugin) -> None:
+        super().register_plugin(plugin)
+        with self._clients_lock:
+            clients = (list(self._clients.values())
+                       + list(self._probe_clients.values()))
+        for c in clients:
+            c.register_plugin(plugin)
+
+    def unregister_plugin(self) -> None:
+        super().unregister_plugin()
+        with self._clients_lock:
+            clients = (list(self._clients.values())
+                       + list(self._probe_clients.values()))
+        for c in clients:
+            if c.plugin() is not None:
+                c.unregister_plugin()
+
+    def close(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=10)
+            self._probe_thread = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+        if self._probe_executor is not None:
+            self._probe_executor.shutdown(wait=True)
+            self._probe_executor = None
+        with self._clients_lock:
+            clients = (list(self._clients.values())
+                       + list(self._probe_clients.values()))
+            self._clients = {}
+            self._probe_clients = {}
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- active health probing ---------------------------------------------
+    def _probe_client_for(self, ep: Endpoint, timeout_s: float):
+        """The client one health probe goes through.  gRPC takes a
+        per-call timeout, so the regular client serves; the HTTP client's
+        probe timeout is fixed at construction, so probes get a dedicated
+        short-timeout client — a blackholed replica must cost one probe
+        ``timeout_s``, not the regular client's 60 s transport default
+        (which would stall the whole serial probe sweep)."""
+        if self._protocol == "grpc" or self._client_factory is not None:
+            return self._client_for(ep)
+        client = self._probe_clients.get(ep.url)
+        if client is None:
+            with self._clients_lock:
+                client = self._probe_clients.get(ep.url)
+                if client is None:
+                    from .. import http as mod
+
+                    kw = dict(self._client_kwargs)
+                    kw["connection_timeout"] = timeout_s
+                    kw["network_timeout"] = timeout_s
+                    client = mod.InferenceServerClient(ep.url, **kw)
+                    if self._plugin is not None:
+                        client.register_plugin(self._plugin)
+                    self._probe_clients[ep.url] = client
+        return client
+
+    def probe_all(self, timeout_s: float = 2.0) -> Dict[str, bool]:
+        """One readiness sweep over every endpoint — probed concurrently,
+        so a sweep costs ~one ``timeout_s`` no matter how many replicas
+        are blackholed (serial probing would delay eviction/readmission
+        linearly with dead-replica count).  Verdicts feed the breakers.
+        Returns ``{url: ready}``."""
+        verdicts: Dict[str, bool] = {}
+        lock = threading.Lock()
+
+        def probe_one(ep: Endpoint) -> None:
+            try:
+                client = self._probe_client_for(ep, timeout_s)
+                if self._protocol == "grpc":
+                    ok = bool(client.is_server_ready(
+                        client_timeout=timeout_s))
+                else:
+                    ok = bool(client.is_server_ready())
+            except Exception:
+                ok = False
+            with lock:
+                verdicts[ep.url] = ok
+            self._pool.probe_ok(ep.url, ok)
+
+        endpoints = self._pool.endpoints
+        if len(endpoints) == 1:
+            probe_one(endpoints[0])
+            return verdicts
+        if self._probe_executor is None:
+            with self._clients_lock:
+                if self._probe_executor is None:
+                    # persistent: a sweep every health_interval_s must
+                    # not create and tear down N threads each time
+                    self._probe_executor = ThreadPoolExecutor(
+                        max_workers=len(endpoints),
+                        thread_name_prefix="tc-tpu-probe")
+        futures = [self._probe_executor.submit(probe_one, ep)
+                   for ep in endpoints]
+        _fut_wait(futures, timeout=timeout_s + 5.0)
+        return verdicts
+
+    def start_probing(self, interval_s: float) -> None:
+        if self._probe_thread is not None:
+            return
+
+        def _loop():
+            while not self._probe_stop.wait(interval_s):
+                try:
+                    self.probe_all()
+                except Exception:
+                    pass  # a probe sweep must never kill the thread
+
+        self._probe_stop.clear()
+        self._probe_thread = threading.Thread(
+            target=_loop, daemon=True, name="tc-tpu-cluster-probe")
+        self._probe_thread.start()
+
+    # -- routed single calls (health / metadata) ---------------------------
+    def _routed(self, kind: str, name: str, *args, **kwargs):
+        policy = self._retry_policy
+        excluded: List[str] = []
+        last: List[Optional[Endpoint]] = [None]
+
+        def attempt(_remaining, _n):
+            ep = self._pool.pick(exclude=excluded)
+            last[0] = ep
+            client = self._client_for(ep)
+            ep.acquire()
+            try:
+                result = getattr(client, name)(*args, **kwargs)
+            except Exception:
+                self._pool.record(ep, ok=False)
+                raise
+            finally:
+                ep.release()
+            self._pool.record(ep, ok=True)
+            return result
+
+        if policy is None:
+            return attempt(None, 1)
+
+        def on_failure(_exc, _n):
+            if last[0] is not None:
+                excluded.append(last[0].url)
+
+        return call_with_retry(
+            policy, attempt, method=kind,
+            retry_meta=("", self._protocol, kind, ""),
+            on_failure=on_failure)
+
+    def _broadcast(self, name: str, *args, **kwargs):
+        """Apply a control-plane call to EVERY endpoint.  All endpoints
+        are attempted; the first failure (if any) is re-raised after, so
+        one dead replica doesn't leave the rest unconfigured silently."""
+        first_result = _UNSET = object()
+        first_error: Optional[BaseException] = None
+        for ep in self._pool.endpoints:
+            try:
+                result = getattr(self._client_for(ep), name)(*args, **kwargs)
+                if first_result is _UNSET:
+                    first_result = result
+            except Exception as e:  # noqa: BLE001 — collected, re-raised
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+        return None if first_result is _UNSET else first_result
+
+    def __getattr__(self, name: str):
+        # only reached when normal lookup fails; underscore lookups must
+        # fail fast (copy/pickle/hasattr probing during __init__)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in _HEALTH_METHODS:
+            return partial(self._routed, "health", name)
+        if name in _METADATA_METHODS:
+            return partial(self._routed, "metadata", name)
+        if name in _BROADCAST_METHODS:
+            return partial(self._broadcast, name)
+        if name in _STREAMING_METHODS:
+            raise_error(
+                f"{name} is per-connection and not supported on "
+                "ClusterClient; open a stream on a single-endpoint client")
+        raise AttributeError(
+            f"{type(self).__name__!s} has no attribute {name!r}")
+
+    # -- inference ---------------------------------------------------------
+    def infer(
+        self,
+        model_name: str,
+        inputs,
+        model_version: str = "",
+        outputs=None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout=None,
+        headers=None,
+        parameters=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
+        hedge: Optional[bool] = None,
+        **kwargs,
+    ):
+        """Routed inference.  ``hedge`` overrides the idempotency gate per
+        call (True asserts the model is safe to re-execute; False
+        disables hedging for this request); protocol-specific kwargs
+        (``query_params``, ``client_timeout``, compression, ...) pass
+        through to the per-endpoint client."""
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        call = dict(
+            inputs=inputs, model_version=model_version, outputs=outputs,
+            request_id=request_id, sequence_id=sequence_id,
+            sequence_start=sequence_start, sequence_end=sequence_end,
+            priority=priority, timeout=timeout, headers=headers,
+            parameters=parameters, **kwargs)
+        hedging = self._hedge_armed(policy, hedge, sequence_id)
+        excluded: List[str] = []
+        last: List[Optional[Endpoint]] = [None]
+
+        def attempt(remaining, _n):
+            ep = self._pool.pick(sequence_id=sequence_id, exclude=excluded)
+            last[0] = ep
+            if self._on_route is not None:
+                self._on_route(ep.url, model_name, sequence_id)
+            if hedging:
+                return self._hedged_infer(
+                    ep, remaining, excluded, model_name, request_id, call)
+            return self._infer_on(ep, remaining, model_name, call)
+
+        if policy is None and deadline_s is None:
+            return attempt(None, 1)
+
+        def on_failure(_exc, _n):
+            if last[0] is not None:
+                excluded.append(last[0].url)
+
+        return call_with_retry(
+            policy, attempt, method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, self._protocol, "infer", request_id),
+            on_failure=on_failure)
+
+    def _hedge_armed(self, policy: Optional[RetryPolicy],
+                     hedge_override: Optional[bool],
+                     sequence_id: int) -> bool:
+        if self._hedge is None or len(self._pool.endpoints) < 2:
+            return False
+        if sequence_id:
+            return False  # stateful: pinned to one replica by definition
+        if hedge_override is not None:
+            return hedge_override
+        # the retry_infer opt-in is THE idempotency signal — hedging
+        # re-executes exactly like a retry does
+        return policy is not None and policy.retry_infer
+
+    def _infer_on(self, ep: Endpoint, remaining_s: Optional[float],
+                  model_name: str, call: Dict[str, Any]):
+        """One attempt on one endpoint: deadline propagation via the
+        underlying client (single attempt — the cluster owns retries),
+        outcome into the breaker + per-endpoint counters + latency."""
+        client = self._client_for(ep)
+        ep.acquire()
+        t0 = time.perf_counter()
+        try:
+            result = client.infer(model_name, retry_policy=None,
+                                  deadline_s=remaining_s, **call)
+        except Exception:
+            self._pool.record(ep, ok=False)
+            raise
+        finally:
+            ep.release()
+        ep.observe(model_name, time.perf_counter() - t0)
+        self._pool.record(ep, ok=True)
+        return result
+
+    def _hedged_infer(self, primary: Endpoint,
+                      remaining_s: Optional[float], excluded: List[str],
+                      model_name: str, request_id: str,
+                      call: Dict[str, Any]):
+        """Dean-&-Barroso hedged attempt: primary now, backup to a
+        different endpoint after the hedge delay, first response wins."""
+        tel = telemetry()
+        delay = self._hedge.delay_s(primary, model_name)
+        if remaining_s is not None:
+            # never spend more than half the budget waiting to hedge
+            delay = min(delay, max(remaining_s * 0.5, 0.0))
+        ex = self._hedge_executor()
+        t0 = time.monotonic()
+        t0_ns = time.monotonic_ns()
+        f_primary = ex.submit(self._infer_on, primary, remaining_s,
+                              model_name, call)
+        done, _ = _fut_wait([f_primary], timeout=delay)
+        if f_primary in done:
+            return f_primary.result()  # fast path: no hedge needed
+        backup_ep = self._pool.pick(
+            exclude=list(excluded) + [primary.url])
+        if backup_ep.url == primary.url:
+            return f_primary.result()  # no distinct replica to hedge to
+        tel.record_hedge(model_name, self._protocol)
+        if self._on_route is not None:
+            self._on_route(backup_ep.url, model_name, 0)
+        rem2 = remaining_s
+        if rem2 is not None:
+            rem2 = max(rem2 - (time.monotonic() - t0), 1e-3)
+        f_backup = ex.submit(self._infer_on, backup_ep, rem2,
+                             model_name, call)
+        pending = {f_primary, f_backup}
+        primary_error: Optional[BaseException] = None
+        while pending:
+            done, pending = _fut_wait(pending, return_when=FIRST_COMPLETED)
+            for f in done:
+                err = f.exception()
+                if err is None:
+                    if f is f_backup:
+                        tel.record_hedge(model_name, self._protocol,
+                                         won=True)
+                    for loser in pending:
+                        # best-effort: unstarted work is cancelled; an
+                        # in-flight transport call completes in its worker
+                        # and is discarded (still feeds the breaker)
+                        loser.cancel()
+                    if tel.tracing_enabled:
+                        tel.record_client_trace(
+                            request_id, model_name, self._protocol,
+                            "hedge",
+                            spans=[("HEDGE", t0_ns, time.monotonic_ns())])
+                    return f.result()
+                if f is f_primary:
+                    primary_error = err
+                else:
+                    # the backup's endpoint failed too: exclude it from
+                    # the retry loop's next pick alongside the primary
+                    excluded.append(backup_ep.url)
+        raise primary_error if primary_error is not None \
+            else f_backup.exception()
+
+    def _hedge_executor(self) -> ThreadPoolExecutor:
+        if self._executor is None:
+            with self._clients_lock:
+                # double-checked: two threads' first hedges must not
+                # each build (and one leak) a 32-thread pool
+                if self._executor is None:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=self._hedge_workers,
+                        thread_name_prefix="tc-tpu-hedge")
+        return self._executor

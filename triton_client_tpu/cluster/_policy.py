@@ -1,0 +1,150 @@
+"""Pluggable balancing policies for the cluster client.
+
+A policy answers one question — ``choose(endpoints)`` over the currently
+*available* (probe-healthy, breaker-closed-or-trialing, not-excluded)
+endpoints — and nothing else: health, exclusion, and sequence pinning are
+the pool's job, so every policy stays a few lines and new ones are cheap.
+
+Shipped policies:
+
+* ``round_robin`` — strict rotation.  Predictable, ignores load; the
+  baseline every balancing benchmark compares against.
+* ``least_outstanding`` — power-of-two-choices (Mitzenmacher '01): sample
+  two endpoints at random, take the one with fewer in-flight requests.
+  Near-optimal load spread at O(1) cost, and — unlike a full argmin —
+  avoids herd behavior when many clients share the same view of "least
+  loaded".
+* **Sticky sequence routing** is NOT a policy here: a ``sequence_id`` maps
+  to an endpoint by rendezvous (highest-random-weight) hashing *before*
+  the policy runs (see ``EndpointPool.pick``), because stateful sequences
+  must land on one endpoint regardless of load.  Rendezvous hashing gives
+  the invariant the failover test asserts: removing endpoint B never
+  remaps a sequence pinned to endpoint A.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from typing import List, Optional, Sequence
+
+__all__ = [
+    "BalancingPolicy",
+    "HedgePolicy",
+    "LeastOutstanding",
+    "RoundRobin",
+    "make_policy",
+    "rendezvous_rank",
+]
+
+
+class HedgePolicy:
+    """When (and whether) to issue a backup request to a second endpoint.
+
+    Dean & Barroso's hedged-request recipe ("The Tail at Scale", CACM
+    2013): after a delay tied to the request's *expected* latency — here
+    the chosen endpoint's observed per-model quantile from the client
+    ``LatencyHistogram`` (default p95: hedge the slowest ~5%, bounding
+    extra load at ~5%) — send the same request to a different replica and
+    take whichever answers first.  Until ``min_samples`` observations
+    exist for the (model, endpoint) the fixed ``default_delay_s`` is used.
+
+    Hedging re-executes the request, so it is gated on idempotency
+    exactly like ``retry_infer``: the cluster client hedges only when the
+    retry policy opted inference into re-execution (or the caller forces
+    ``hedge=True`` per call).  Sequence requests never hedge — a stateful
+    sequence is pinned to one replica by definition.
+    """
+
+    def __init__(self, quantile: float = 0.95,
+                 default_delay_s: float = 0.05,
+                 min_samples: int = 16) -> None:
+        if not 0.0 < quantile < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {quantile}")
+        self.quantile = float(quantile)
+        self.default_delay_s = float(default_delay_s)
+        self.min_samples = int(min_samples)
+
+    def delay_s(self, endpoint, model: str) -> float:
+        """The hedge delay for one request to ``model`` on ``endpoint``."""
+        h = endpoint.latency(model)
+        if h is not None and h.count >= self.min_samples:
+            return h.quantile(self.quantile)
+        return self.default_delay_s
+
+
+class BalancingPolicy:
+    """Interface: pick one endpoint from a non-empty available set."""
+
+    name = "abstract"
+
+    def choose(self, endpoints: Sequence):
+        raise NotImplementedError
+
+
+class RoundRobin(BalancingPolicy):
+    name = "round_robin"
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def choose(self, endpoints: Sequence):
+        with self._lock:
+            i = self._n
+            self._n += 1
+        return endpoints[i % len(endpoints)]
+
+
+class LeastOutstanding(BalancingPolicy):
+    """Power-of-two-choices over in-flight request counts."""
+
+    name = "least_outstanding"
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def choose(self, endpoints: Sequence):
+        if len(endpoints) == 1:
+            return endpoints[0]
+        with self._lock:
+            a, b = self._rng.sample(range(len(endpoints)), 2)
+        ea, eb = endpoints[a], endpoints[b]
+        return ea if ea.outstanding <= eb.outstanding else eb
+
+
+def rendezvous_rank(sequence_id: int, urls: Sequence[str]) -> List[str]:
+    """Endpoint URLs ranked by rendezvous (HRW) weight for one sequence.
+
+    Deterministic across processes (md5, not ``hash()``, which is
+    per-process salted) and stable under membership change: dropping any
+    URL leaves the relative order of the others untouched, so a sequence
+    pinned to its rank-0 endpoint only moves when *that* endpoint dies —
+    and then deterministically to rank 1.
+    """
+    def weight(url: str) -> int:
+        return int.from_bytes(
+            hashlib.md5(f"{sequence_id}|{url}".encode()).digest()[:8],
+            "big")
+
+    return sorted(urls, key=weight, reverse=True)
+
+
+_POLICIES = {
+    "round_robin": RoundRobin,
+    "least_outstanding": LeastOutstanding,
+}
+
+
+def make_policy(spec) -> BalancingPolicy:
+    """A policy instance from a name or a ready-made instance."""
+    if isinstance(spec, BalancingPolicy):
+        return spec
+    try:
+        return _POLICIES[spec]()
+    except KeyError:
+        raise ValueError(
+            f"unknown balancing policy {spec!r}; "
+            f"expected one of {sorted(_POLICIES)} or a BalancingPolicy")

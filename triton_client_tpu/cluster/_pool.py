@@ -1,0 +1,295 @@
+"""Endpoint pool: health, circuit breaking, and routing state.
+
+The transport-agnostic half of the cluster client.  An :class:`Endpoint`
+carries one replica's routing state — in-flight count, per-model latency
+histograms (they drive the hedge delay), and a :class:`CircuitBreaker`.
+The :class:`EndpointPool` owns N of them plus the balancing policy and
+implements ``pick()``: sticky sequence routing first (rendezvous hash —
+mandatory for stateful models), then the policy over available endpoints,
+honoring a per-request exclusion set so a retry prefers a replica other
+than the one that just failed.
+
+Breaker state machine (classic three-state):
+
+    closed --[N consecutive failures]--> open
+    open   --[reset_timeout_s elapsed]--> half_open (ONE trial admitted)
+    half_open --[trial ok]--> closed     half_open --[trial fails]--> open
+
+``would_allow()`` is the *non-mutating* candidate filter; ``try_admit()``
+is the mutating gate called only on the endpoint actually chosen — the
+split matters because admitting the half-open trial consumes a slot, and
+listing candidates must never consume anything.
+
+Every transition lands in the client telemetry registry
+(``nv_client_endpoint_state``), so a fleet's health is scrapeable from the
+client side without touching any server.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Union
+
+from .._telemetry import LatencyHistogram, telemetry
+from ._policy import make_policy, rendezvous_rank
+
+__all__ = ["CircuitBreaker", "Endpoint", "EndpointPool"]
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probe recovery.
+
+    ``record(ok)`` resolves each routed attempt (and each health probe).
+    ``history`` keeps the transition chain (bounded) so tests can assert
+    closed→open→half_open→closed literally.
+    """
+
+    def __init__(self, endpoint: str, failure_threshold: int = 3,
+                 reset_timeout_s: float = 5.0) -> None:
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.endpoint = endpoint
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._trial_in_flight = False
+        self.history: List[str] = ["closed"]
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def _transition(self, state: str) -> None:
+        # lock held by caller
+        if state == self._state:
+            return
+        self._state = state
+        self.history.append(state)
+        del self.history[:-64]  # bounded: a flapping endpoint must not leak
+        telemetry().set_endpoint_state(self.endpoint, state)
+
+    def would_allow(self, now: Optional[float] = None) -> bool:
+        """Non-mutating: could a request be admitted right now?"""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                return now - self._opened_at >= self.reset_timeout_s
+            return not self._trial_in_flight  # half_open
+
+    def try_admit(self, now: Optional[float] = None) -> bool:
+        """Mutating admission gate for the CHOSEN endpoint.  In the open
+        state (cooldown elapsed) this performs the open→half_open
+        transition and claims the single trial slot; a claimed slot is
+        released by the next ``record()``."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if now - self._opened_at < self.reset_timeout_s:
+                    return False
+                self._transition("half_open")
+                self._trial_in_flight = True
+                return True
+            # half_open: one trial at a time — a thundering herd against a
+            # barely-recovered replica would re-kill it
+            if self._trial_in_flight:
+                return False
+            self._trial_in_flight = True
+            return True
+
+    def record(self, ok: bool, now: Optional[float] = None) -> None:
+        """Resolve one attempt's outcome against the breaker."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._trial_in_flight = False
+            if ok:
+                self._consecutive_failures = 0
+                if self._state != "open":
+                    # an OPEN breaker closes only through the half-open
+                    # trial: a success landing now was in flight before
+                    # the trip (or rode the total-outage fallback), and
+                    # one stale success must not flood traffic back onto
+                    # a replica that just failed N times in a row
+                    self._transition("closed")
+                return
+            self._consecutive_failures += 1
+            if self._state == "half_open" \
+                    or self._consecutive_failures >= self.failure_threshold:
+                self._opened_at = now
+                self._transition("open")
+
+
+class Endpoint:
+    """One replica's routing state (URL + breaker + load + latency)."""
+
+    def __init__(self, url: str, failure_threshold: int = 3,
+                 reset_timeout_s: float = 5.0) -> None:
+        self.url = url
+        self.breaker = CircuitBreaker(url, failure_threshold,
+                                      reset_timeout_s)
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        # per-model client-observed latency — feeds the hedge delay
+        # (hedge at this endpoint's observed p95 for the model)
+        self._latency: Dict[str, LatencyHistogram] = {}
+        telemetry().set_endpoint_state(url, "closed")
+
+    @property
+    def outstanding(self) -> int:
+        return self._outstanding
+
+    def acquire(self) -> None:
+        with self._lock:
+            self._outstanding += 1
+
+    def release(self) -> None:
+        with self._lock:
+            self._outstanding -= 1
+
+    def observe(self, model: str, latency_s: float) -> None:
+        h = self._latency.get(model)
+        if h is None:
+            with self._lock:
+                h = self._latency.setdefault(model, LatencyHistogram())
+        h.observe(latency_s)
+
+    def latency(self, model: str) -> Optional[LatencyHistogram]:
+        return self._latency.get(model)
+
+    def __repr__(self) -> str:  # diagnostics only
+        return (f"Endpoint({self.url!r}, state={self.breaker.state}, "
+                f"outstanding={self._outstanding})")
+
+
+class EndpointPool:
+    """N endpoints + a balancing policy + sticky sequence routing.
+
+    ``probe_ok(url, ok)`` is how active health probing feeds back (the
+    transport-owning client runs the probes; the pool is transport-free).
+    A probe failure counts as a breaker failure, so a dead endpoint is
+    evicted even when no user traffic is hitting it; a probe success on a
+    recovering endpoint claims the half-open trial, so recovery does not
+    require sacrificing a user request.
+    """
+
+    def __init__(
+        self,
+        urls: Union[str, Iterable[str]],
+        policy: Union[str, object] = "least_outstanding",
+        failure_threshold: int = 3,
+        reset_timeout_s: float = 5.0,
+    ) -> None:
+        if isinstance(urls, str):
+            urls = [u.strip() for u in urls.split(",") if u.strip()]
+        urls = list(urls)
+        if not urls:
+            raise ValueError("EndpointPool needs at least one endpoint URL")
+        if len(set(urls)) != len(urls):
+            raise ValueError(f"duplicate endpoint URLs: {urls}")
+        self.endpoints: List[Endpoint] = [
+            Endpoint(u, failure_threshold, reset_timeout_s) for u in urls]
+        self._by_url = {e.url: e for e in self.endpoints}
+        self.policy = make_policy(policy)
+
+    @property
+    def urls(self) -> List[str]:
+        return [e.url for e in self.endpoints]
+
+    def endpoint(self, url: str) -> Endpoint:
+        return self._by_url[url]
+
+    def sticky_rank(self, sequence_id: int) -> List[str]:
+        """The rendezvous-ranked endpoint order for one sequence (rank 0
+        is the pin; later ranks are the deterministic failover order)."""
+        return rendezvous_rank(sequence_id, self.urls)
+
+    def _admit_from(self, candidates: Sequence[Endpoint]) -> \
+            Optional[Endpoint]:
+        """Choose with the policy, then claim admission on the choice;
+        on a lost half-open race, retry among the remainder."""
+        remaining = list(candidates)
+        while remaining:
+            chosen = (self.policy.choose(remaining) if len(remaining) > 1
+                      else remaining[0])
+            if chosen.breaker.try_admit():
+                return chosen
+            remaining.remove(chosen)
+        return None
+
+    def pick(self, sequence_id: int = 0,
+             exclude: Sequence[str] = ()) -> Endpoint:
+        """The endpoint for one attempt.
+
+        Sticky first: a nonzero ``sequence_id`` routes by rendezvous rank
+        (skipping evicted/excluded endpoints in rank order, so the pin
+        only moves when the pinned replica itself is out).  Otherwise the
+        balancing policy chooses among admittable endpoints.  Exclusion
+        is best-effort: when it would empty the candidate set it is
+        ignored (retrying the same replica beats failing outright), and a
+        pool with every breaker open falls back to all endpoints — the
+        retry path, not the router, is the last line of defense.
+        """
+        if sequence_id:
+            ranked = self.sticky_rank(sequence_id)
+            for pass_exclude in (exclude, ()):
+                for url in ranked:
+                    e = self._by_url[url]
+                    if url in pass_exclude:
+                        continue
+                    if e.breaker.try_admit():
+                        return e
+                    if e.breaker.state == "half_open":
+                        # the single trial slot is busy, but the replica is
+                        # reachable enough to be on trial — a pinned
+                        # sequence routes to it anyway rather than being
+                        # remapped: the stickiness invariant ("a sequence
+                        # moves only when ITS replica is out") outranks
+                        # the trial-throttling heuristic for stateful
+                        # traffic
+                        return e
+            return self._by_url[ranked[0]]
+        for pass_exclude in (exclude, ()):
+            candidates = [e for e in self.endpoints
+                          if e.url not in pass_exclude
+                          and e.breaker.would_allow()]
+            chosen = self._admit_from(candidates)
+            if chosen is not None:
+                return chosen
+        # total outage: route anyway and let the retry layer decide
+        return (self.policy.choose(self.endpoints)
+                if len(self.endpoints) > 1 else self.endpoints[0])
+
+    def record(self, endpoint: Endpoint, ok: bool) -> None:
+        """One routed attempt's outcome: breaker + per-endpoint counter."""
+        endpoint.breaker.record(ok)
+        telemetry().record_endpoint_request(endpoint.url, ok)
+
+    def probe_ok(self, url: str, ok: bool) -> None:
+        """Feed one active health-probe verdict back into the breaker.
+
+        Probe *successes* only matter for recovery: on a CLOSED breaker
+        they are dropped, because zeroing the consecutive-failure count
+        every probe interval would keep a ready-but-failing replica (its
+        health endpoint answers, its infers don't) closed forever at any
+        failure rate below ~threshold/interval.  Probe failures always
+        count — they are what evict a dead replica taking no traffic.
+        """
+        br = self._by_url[url].breaker
+        if ok:
+            if br.state == "closed":
+                return
+            if not br.try_admit():
+                # open and still cooling down (or a trial is already in
+                # flight): leave recovery to the state machine's clock
+                return
+        br.record(ok)
+
+    def states(self) -> Dict[str, str]:
+        return {e.url: e.breaker.state for e in self.endpoints}

@@ -157,6 +157,7 @@ class InferenceServerClient(InferenceServerClientBase):
         # under it unconditionally; infer honors it per its retry_infer
         # opt-in (a per-call retry_policy= overrides)
         self._retry_policy = retry_policy
+        self._url = url
         self._verbose = verbose
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -178,6 +179,12 @@ class InferenceServerClient(InferenceServerClientBase):
             self._channel = grpc.insecure_channel(url, options=options)
         self._client_stub = GRPCInferenceServiceStub(self._channel)
         self._stream: Optional[_InferStream] = None
+
+    @property
+    def url(self) -> str:
+        """The ``host:port`` this client talks to — the endpoint label
+        the cluster layer keys its routing counters by."""
+        return self._url
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:

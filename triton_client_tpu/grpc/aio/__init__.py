@@ -56,6 +56,7 @@ class InferenceServerClient(InferenceServerClientBase):
         # client-level resilience default (see the sync client): health/
         # metadata retry unconditionally, infer per its retry_infer opt-in
         self._retry_policy = retry_policy
+        self._url = url
         self._verbose = verbose
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -76,6 +77,12 @@ class InferenceServerClient(InferenceServerClientBase):
         else:
             self._channel = grpc.aio.insecure_channel(url, options=options)
         self._client_stub = GRPCInferenceServiceStub(self._channel)
+
+    @property
+    def url(self) -> str:
+        """The ``host:port`` this client talks to — the endpoint label
+        the cluster layer keys its routing counters by."""
+        return self._url
 
     # -- lifecycle ---------------------------------------------------------
     async def close(self) -> None:

@@ -91,6 +91,7 @@ class InferenceServerClient(InferenceServerClientBase):
         self._retry_policy = retry_policy
         if url.startswith("http://") or url.startswith("https://"):
             raise_error("url should not include the scheme")
+        self._url = url
         scheme = "https://" if ssl else "http://"
         self._parsed_url = scheme + url
         self._base_uri = self._parsed_url.rstrip("/")
@@ -113,6 +114,12 @@ class InferenceServerClient(InferenceServerClientBase):
                         pool_kwargs[k] = ssl_options[k]
         self._pool = urllib3.PoolManager(**pool_kwargs)
         self._executor: Optional[ThreadPoolExecutor] = None
+
+    @property
+    def url(self) -> str:
+        """The scheme-less ``host:port`` this client talks to — the
+        endpoint label the cluster layer keys its routing counters by."""
+        return self._url
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:

@@ -49,6 +49,7 @@ class InferenceServerClient(InferenceServerClientBase):
         self._retry_policy = retry_policy
         if url.startswith("http://") or url.startswith("https://"):
             raise_error("url should not include the scheme")
+        self._url = url
         scheme = "https://" if ssl else "http://"
         self._base_uri = (scheme + url).rstrip("/")
         self._verbose = verbose
@@ -59,6 +60,12 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=aiohttp.ClientTimeout(total=conn_timeout),
             auto_decompress=False,
         )
+
+    @property
+    def url(self) -> str:
+        """The scheme-less ``host:port`` this client talks to — the
+        endpoint label the cluster layer keys its routing counters by."""
+        return self._url
 
     # -- lifecycle ---------------------------------------------------------
     async def close(self) -> None:

@@ -69,6 +69,61 @@ def _retries_recorded(model_name: str) -> int:
                if r["model"] == model_name)
 
 
+def _cluster_recorded():
+    """Cumulative cluster-layer routing counters: per-endpoint request
+    totals plus hedge issue/win counts (delta'd around each level, like
+    retries)."""
+    snap = telemetry().snapshot()
+    dist = {e["endpoint"]: e["success"] + e["failure"]
+            for e in snap["endpoints"]}
+    hedges = sum(h["hedges"] for h in snap["hedges"])
+    wins = sum(h["wins"] for h in snap["hedges"])
+    return dist, hedges, wins
+
+
+def _make_client_factory(protocol, url, concurrency,
+                         balancing="least_outstanding", hedge_ms=0.0):
+    """(protocol module, client factory, shared cluster client) for one
+    sweep level.  ``url`` may be a single endpoint or a list — two or
+    more endpoints switch the sweep onto ONE ``ClusterClient`` shared by
+    every worker (health-checked balancing, per-endpoint counters,
+    optional hedging at ``hedge_ms``).  Shared, not per-worker: the
+    least-outstanding policy and the breakers route on pool state, and a
+    private pool per worker only ever sees that worker's single in-flight
+    request — which would silently degrade the policy to random choice.
+    The caller owns (and closes) the shared client; per-worker sessions
+    must not."""
+    urls = list(url) if isinstance(url, (list, tuple)) else [url]
+    if protocol == "grpc":
+        import triton_client_tpu.grpc as protocol_mod
+
+        client_kwargs = {}
+    else:
+        import triton_client_tpu.http as protocol_mod
+
+        client_kwargs = {"concurrency": concurrency}
+    if len(urls) > 1:
+        from .cluster import ClusterClient, HedgePolicy
+
+        # min_samples pinned high: --hedge-ms promises a FIXED delay, and
+        # HedgePolicy would otherwise switch to the observed p95 as soon
+        # as 16 samples accumulate (i.e. during warmup)
+        hedge = (HedgePolicy(default_delay_s=hedge_ms / 1e3,
+                             min_samples=1 << 30)
+                 if hedge_ms > 0 else None)
+        shared = ClusterClient(
+            urls, protocol=protocol, policy=balancing, hedge=hedge,
+            client_kwargs=client_kwargs,
+            # hedged attempts run on the client's executor: it must cover
+            # concurrency primaries + their backups, or levels above the
+            # default pool size would measure the executor, not the fleet
+            hedge_workers=max(32, 2 * concurrency))
+        return protocol_mod, (lambda: shared), shared
+    make_client = lambda: protocol_mod.InferenceServerClient(
+        urls[0], **client_kwargs)
+    return protocol_mod, make_client, None
+
+
 def _parse_concurrency_range(spec: str):
     parts = [int(p) for p in spec.split(":")]
     start = parts[0]
@@ -242,11 +297,13 @@ def _build_inputs(protocol_mod, arrays, shm_mode):
 
 def _worker(protocol_mod, make_client, model_name, model_version, arrays,
             outputs, shm_mode, output_byte_size, worker_id, stop, measuring,
-            stats: _Stats, lock, streaming=False, retry_policy=None):
+            stats: _Stats, lock, streaming=False, retry_policy=None,
+            owns_client=True):
     try:
         _worker_impl(protocol_mod, make_client, model_name, model_version,
                      arrays, outputs, shm_mode, output_byte_size, worker_id,
-                     stop, measuring, stats, lock, streaming, retry_policy)
+                     stop, measuring, stats, lock, streaming, retry_policy,
+                     owns_client)
     except Exception as e:
         # Setup failures (bad model, shm registration, stream open) must be
         # visible in the report, not a silently dead worker thread.
@@ -262,8 +319,11 @@ class _InferSession:
 
     def __init__(self, protocol_mod, make_client, model_name, model_version,
                  arrays, outputs, shm_mode, output_byte_size, worker_id,
-                 streaming, retry_policy=None):
+                 streaming, retry_policy=None, owns_client=True):
         self._client = make_client()
+        # False when make_client hands out a SHARED client (cluster
+        # sweeps): the level owns its lifetime, not this worker
+        self._owns_client = owns_client
         self._shm_setup = None
         self._stream_open = False
         try:
@@ -329,20 +389,21 @@ class _InferSession:
                 pass
         if self._shm_setup is not None:
             self._shm_setup.cleanup()
-        try:
-            self._client.close()
-        except Exception:
-            pass
+        if self._owns_client:
+            try:
+                self._client.close()
+            except Exception:
+                pass
 
 
 def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
                  outputs, shm_mode, output_byte_size, worker_id, stop,
                  measuring, stats: _Stats, lock, streaming=False,
-                 retry_policy=None):
+                 retry_policy=None, owns_client=True):
     session = _InferSession(protocol_mod, make_client, model_name,
                             model_version, arrays, outputs, shm_mode,
                             output_byte_size, worker_id, streaming,
-                            retry_policy)
+                            retry_policy, owns_client)
     one_infer = session.infer
     try:
         n = 0
@@ -381,16 +442,11 @@ def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
 
 def run_level(protocol, url, model_name, model_version, concurrency, arrays,
               outputs, shm_mode, output_byte_size, measure_s, warmup_s=1.0,
-              extra_percentile=None, streaming=False, retry_policy=None):
-    if protocol == "grpc":
-        import triton_client_tpu.grpc as protocol_mod
-
-        make_client = lambda: protocol_mod.InferenceServerClient(url)
-    else:
-        import triton_client_tpu.http as protocol_mod
-
-        make_client = lambda: protocol_mod.InferenceServerClient(
-            url, concurrency=concurrency)
+              extra_percentile=None, streaming=False, retry_policy=None,
+              balancing="least_outstanding", hedge_ms=0.0):
+    protocol_mod, make_client, shared_client = _make_client_factory(
+        protocol, url, concurrency, balancing, hedge_ms)
+    cluster_mode = isinstance(url, (list, tuple)) and len(url) > 1
 
     stats = _Stats()
     lock = threading.Lock()
@@ -401,7 +457,8 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
             target=_worker,
             args=(protocol_mod, make_client, model_name, model_version, arrays,
                   outputs, shm_mode, output_byte_size, w, stop, measuring,
-                  stats, lock, streaming, retry_policy),
+                  stats, lock, streaming, retry_policy,
+                  shared_client is None),
             daemon=True,
         )
         for w in range(concurrency)
@@ -412,6 +469,8 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
     # retry delta scoped to the measure window, like count/errors —
     # warmup-window retries must not inflate the reported level
     retries_before = _retries_recorded(model_name)
+    if cluster_mode:
+        dist_before, hedges_before, wins_before = _cluster_recorded()
     measuring.set()
     t0 = time.perf_counter()
     time.sleep(measure_s)
@@ -420,6 +479,8 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
     stop.set()
     for t in threads:
         t.join(timeout=30)
+    if shared_client is not None:
+        shared_client.close()
     res = {
         "concurrency": concurrency,
         "throughput": stats.count / elapsed,
@@ -431,6 +492,13 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
         "retries": _retries_recorded(model_name) - retries_before,
         "first_error": stats.first_error,
     }
+    if cluster_mode:
+        dist_after, hedges_after, wins_after = _cluster_recorded()
+        res["endpoints"] = {
+            e: dist_after.get(e, 0) - dist_before.get(e, 0)
+            for e in sorted(set(dist_before) | set(dist_after))}
+        res["hedges"] = hedges_after - hedges_before
+        res["hedge_wins"] = wins_after - wins_before
     res.update(_latency_stats(stats.latency, extra_percentile))
     return res
 
@@ -478,7 +546,8 @@ def _parse_rate_range(spec: str) -> List[float]:
 def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
                    outputs, shm_mode, output_byte_size, measure_s,
                    warmup_s=1.0, distribution="constant", max_threads=64,
-                   extra_percentile=None, streaming=False, retry_policy=None):
+                   extra_percentile=None, streaming=False, retry_policy=None,
+                   balancing="least_outstanding", hedge_ms=0.0):
     """OPEN-loop load at ``rate`` requests/s (reference perf_analyzer
     --request-rate-range): send times are scheduled up front (constant or
     Poisson inter-arrivals) and latency is measured from the SCHEDULED send
@@ -487,15 +556,9 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
     flattering cannot happen here.  When the server can't keep pace the
     report says so: ``send_lag_*`` (how far actual sends fell behind
     schedule) and ``unsent`` (slots still owed when the window closed)."""
-    if protocol == "grpc":
-        import triton_client_tpu.grpc as protocol_mod
-
-        make_client = lambda: protocol_mod.InferenceServerClient(url)
-    else:
-        import triton_client_tpu.http as protocol_mod
-
-        make_client = lambda: protocol_mod.InferenceServerClient(
-            url, concurrency=max_threads)
+    protocol_mod, make_client, shared_client = _make_client_factory(
+        protocol, url, max_threads, balancing, hedge_ms)
+    cluster_mode = isinstance(url, (list, tuple)) and len(url) > 1
 
     # absolute schedule for warmup + window (+1s grace so the last in-window
     # slot exists); fixed seed => the Poisson schedule is reproducible
@@ -526,7 +589,8 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
             session = _InferSession(protocol_mod, make_client, model_name,
                                     model_version, arrays, outputs, shm_mode,
                                     output_byte_size, worker_id, streaming,
-                                    retry_policy)
+                                    retry_policy,
+                                    owns_client=shared_client is None)
         except Exception as e:  # noqa: BLE001 — setup must be visible
             with lock:
                 ready[0] += 1
@@ -587,10 +651,14 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
     # closed loop; slots already in flight at the boundary blur it by at
     # most one request's retries)
     retries_before = _retries_recorded(model_name)
+    if cluster_mode:
+        dist_before, hedges_before, wins_before = _cluster_recorded()
     time.sleep(measure_s)
     stop.set()
     for t in threads:
         t.join(timeout=60)
+    if shared_client is not None:
+        shared_client.close()
     win_lo, win_hi = warmup_s, warmup_s + measure_s
     owed = int(np.sum((sched >= win_lo) & (sched < win_hi)))
     in_win = [(s, lat, err, rej) for s, lat, err, rej in done
@@ -618,6 +686,13 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
         "send_lag_p99_ms": (float(np.percentile(lags, 99) * 1e3)
                             if lags.size else float("nan")),
     }
+    if cluster_mode:
+        dist_after, hedges_after, wins_after = _cluster_recorded()
+        res["endpoints"] = {
+            e: dist_after.get(e, 0) - dist_before.get(e, 0)
+            for e in sorted(set(dist_before) | set(dist_after))}
+        res["hedges"] = hedges_after - hedges_before
+        res["hedge_wins"] = wins_after - wins_before
     res.update(_latency_stats(ok, extra_percentile))
     return res
 
@@ -628,7 +703,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Concurrency-sweep load generator (perf_analyzer CLI contract)")
     parser.add_argument("-m", "--model-name", required=True)
     parser.add_argument("-x", "--model-version", default="")
-    parser.add_argument("-u", "--url", default=None)
+    parser.add_argument("-u", "--url", action="append", default=None,
+                        help="server endpoint; repeat (or comma-separate) "
+                             "for a fleet — 2+ endpoints drive the "
+                             "ClusterClient and report per-endpoint "
+                             "request distribution and hedge counts")
     parser.add_argument("-i", "--protocol", default="http",
                         type=str.lower, choices=["http", "grpc"])
     parser.add_argument("-b", "--batch-size", type=int, default=1)
@@ -653,6 +732,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--streaming", action="store_true",
                         help="drive infers over the bidi gRPC stream "
                              "(gRPC only; reference perf_analyzer flag)")
+    parser.add_argument("--balancing", default="least_outstanding",
+                        type=str.lower,
+                        choices=["round_robin", "least_outstanding"],
+                        help="balancing policy when multiple -u endpoints "
+                             "are given (default least_outstanding)")
+    parser.add_argument("--hedge-ms", type=float, default=0.0,
+                        help="hedged requests: issue a backup request to a "
+                             "second endpoint after this many ms (0 = off; "
+                             "requires multiple -u endpoints)")
     parser.add_argument("--retries", type=int, default=0,
                         help="enable the client resilience layer with this "
                              "many max attempts per request (0 = off); the "
@@ -689,18 +777,57 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.concurrency_range is None and args.request_rate_range is None:
         args.concurrency_range = "1"
 
-    url = args.url or ("localhost:8001" if args.protocol == "grpc" else "localhost:8000")
+    urls: List[str] = []
+    for u in (args.url or []):
+        urls.extend(p.strip() for p in u.split(",") if p.strip())
+    if not urls:
+        urls = ["localhost:8001" if args.protocol == "grpc"
+                else "localhost:8000"]
+    if len(set(urls)) != len(urls):
+        parser.error(f"duplicate -u endpoints: {urls}")
+    cluster_mode = len(urls) > 1
+    if cluster_mode and args.streaming:
+        parser.error("--streaming drives one bidi stream per worker and "
+                     "is not supported with multiple -u endpoints")
+    if cluster_mode and args.shared_memory != "none":
+        # a shm region registered on one replica is dangling on the others
+        parser.error("--shared-memory requires a single -u endpoint")
+    if args.hedge_ms < 0:
+        parser.error("--hedge-ms must be >= 0")
+    if args.hedge_ms and not cluster_mode:
+        parser.error("--hedge-ms needs at least two -u endpoints to hedge "
+                     "across")
+    if cluster_mode and args.trace_file:
+        # the trace control plane reaches ONE server; a breakdown
+        # covering ~1/N of a fleet sweep with no warning would be a lie
+        parser.error("--trace-file requires a single -u endpoint (server "
+                     "tracing is per-server; trace each replica directly)")
     if args.protocol == "grpc":
         import triton_client_tpu.grpc as pm
-
-        meta_client = pm.InferenceServerClient(url)
     else:
         import triton_client_tpu.http as pm
 
-        meta_client = pm.InferenceServerClient(url)
-    inputs, outputs, max_batch = _resolve_model(
-        meta_client, args.protocol, args.model_name, args.model_version)
-    meta_client.close()
+    # metadata resolution + trace control plane: first endpoint that
+    # answers — a dead first -u must not kill a sweep the cluster client
+    # would have routed around
+    resolved = None
+    for candidate in urls:
+        meta_client = pm.InferenceServerClient(candidate)
+        try:
+            resolved = _resolve_model(
+                meta_client, args.protocol, args.model_name,
+                args.model_version)
+            url = candidate
+            break
+        except Exception as e:  # noqa: BLE001 — next replica may answer
+            if candidate == urls[-1]:
+                raise
+            print(f"warning: {candidate} unreachable for metadata "
+                  f"({type(e).__name__}); trying the next endpoint",
+                  file=sys.stderr)
+        finally:
+            meta_client.close()
+    inputs, outputs, max_batch = resolved
     if args.batch_size > 1 and max_batch == 0:
         print(f"error: model {args.model_name} does not support batching",
               file=sys.stderr)
@@ -724,7 +851,10 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"  Load mode: "
           + (f"open-loop ({args.request_distribution} arrivals)"
              if open_loop else "closed-loop (concurrency)") + "\n"
-          f"  Protocol: {args.protocol} @ {url}\n")
+          f"  Protocol: {args.protocol} @ {', '.join(urls)}"
+          + (f" [{args.balancing}"
+             + (f", hedge {args.hedge_ms:g}ms" if args.hedge_ms else "")
+             + "]" if cluster_mode else "") + "\n")
 
     retry_policy = None
     if args.retries > 0:
@@ -732,6 +862,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         retry_policy = RetryPolicy(max_attempts=max(1, args.retries),
                                    retry_infer=True)
+    elif args.hedge_ms > 0:
+        # hedging re-executes the request, so it is gated on idempotency
+        # exactly like retry_infer — a 1-attempt policy arms the gate
+        # without enabling retries
+        from ._resilience import RetryPolicy
+
+        retry_policy = RetryPolicy(max_attempts=1, retry_infer=True)
 
     def report(res, lead):
         results.append(res)
@@ -744,12 +881,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             tail += f", {res['retries']} retries"
         if res.get("rejected"):
             tail += f", rejected {res['rejected_per_sec']:.1f}/s"
+        if res.get("hedges"):
+            tail += (f", {res['hedges']} hedges"
+                     f" ({res.get('hedge_wins', 0)} won)")
         if res["errors"]:
             tail += f" ({res['errors']} errors)"
         print(f"{lead}{res['throughput']:.2f} infer/sec, "
               f"latency {headline:.0f} usec" + tail)
         if res["errors"] and res.get("first_error"):
             print(f"  first error: {res['first_error']}")
+        if "endpoints" in res:
+            total = sum(res["endpoints"].values()) or 1
+            dist = ", ".join(
+                f"{e}: {n} ({100.0 * n / total:.0f}%)"
+                for e, n in res["endpoints"].items())
+            print(f"  endpoint distribution: {dist}")
         if args.verbose:
             line = (f"  p50: {res['p50_us']:.0f} us, "
                     f"p90: {res['p90_us']:.0f} us, "
@@ -782,23 +928,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                 parser.error(str(e))
             for rate in rates:
                 res = run_rate_level(
-                    args.protocol, url, args.model_name, args.model_version,
+                    args.protocol, urls if cluster_mode else url,
+                    args.model_name, args.model_version,
                     rate, arrays, outputs, args.shared_memory,
                     args.output_shared_memory_size, measure_s,
                     distribution=args.request_distribution,
                     max_threads=args.max_threads,
                     extra_percentile=args.percentile, streaming=args.streaming,
-                    retry_policy=retry_policy)
+                    retry_policy=retry_policy, balancing=args.balancing,
+                    hedge_ms=args.hedge_ms)
                 report(res, f"Request rate: {rate:g}/s, completed "
                             "(latency from scheduled send): ")
         else:
             for level in _parse_concurrency_range(args.concurrency_range):
                 res = run_level(
-                    args.protocol, url, args.model_name, args.model_version,
+                    args.protocol, urls if cluster_mode else url,
+                    args.model_name, args.model_version,
                     level, arrays, outputs, args.shared_memory,
                     args.output_shared_memory_size, measure_s,
                     extra_percentile=args.percentile, streaming=args.streaming,
-                    retry_policy=retry_policy)
+                    retry_policy=retry_policy, balancing=args.balancing,
+                    hedge_ms=args.hedge_ms)
                 report(res, f"Concurrency: {level}, throughput: ")
     finally:
         if args.trace_file:
@@ -835,6 +985,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         snapshot = {
             "model": args.model_name,
             "protocol": args.protocol,
+            "urls": urls,
             "shared_memory": args.shared_memory,
             "load_mode": "open_loop" if open_loop else "closed_loop",
             "results": [

@@ -6,6 +6,11 @@ event loop inside the current process.  This is both the test fixture
 that to external CI) and the production co-located topology for the xla
 shared-memory zero-copy path (client and server share the TPU process, see
 ``_xla_broker``).
+
+``ClusterHarness`` stacks N of them — each with its OWN registry and core,
+so per-server state (pending counts, chaos injectors, flight recorders)
+stays per-server — and adds ``kill``/``restart`` so failover tests can
+take a replica down mid-run and bring it back on the same ports.
 """
 
 from __future__ import annotations
@@ -13,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import socket
 import threading
-from typing import Optional
+from typing import Callable, List, Optional
 
 from .._xla_broker import broker
 from .core import InferenceCore
@@ -25,6 +30,22 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+# broker().server_present is a process-global flag (it switches the xla
+# shared-memory clients between zero-copy co-located writes and staging
+# writes), but ClusterHarness runs N harnesses in ONE process — so the
+# flag must be refcounted: killing replica 0 while replicas 1..N-1 still
+# serve must not flip it off for unrelated co-located traffic.
+_PRESENT_LOCK = threading.Lock()
+_PRESENT_COUNT = 0
+
+
+def _server_present(delta: int) -> None:
+    global _PRESENT_COUNT
+    with _PRESENT_LOCK:
+        _PRESENT_COUNT = max(0, _PRESENT_COUNT + delta)
+        broker().server_present = _PRESENT_COUNT > 0
 
 
 class ServerHarness:
@@ -58,7 +79,8 @@ class ServerHarness:
         return f"{self.host}:{self.grpc_port}"
 
     def start(self) -> "ServerHarness":
-        broker().server_present = True
+        self._present = True
+        _server_present(+1)
         self._thread = threading.Thread(target=self._run, daemon=True, name="tc-tpu-server")
         self._thread.start()
         if not self._started.wait(timeout=30):
@@ -90,7 +112,87 @@ class ServerHarness:
             self._loop.call_soon_threadsafe(self._stop_event.set)
         if self._thread is not None:
             self._thread.join(timeout=10)
-        broker().server_present = False
+        # idempotent: a double stop() must decrement the refcount once
+        if getattr(self, "_present", False):
+            self._present = False
+            _server_present(-1)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ClusterHarness:
+    """N in-process servers behind one fixture — the test bed for the
+    client-side cluster layer (``triton_client_tpu.cluster``).
+
+    ``registry_factory`` is called once per server: every replica gets a
+    fresh ``ModelRegistry`` + ``InferenceCore``, exactly like N separate
+    processes would (shared registries would alias pending gauges and
+    model state across "replicas" and fake out every failover assertion).
+
+    ``kill(i)`` stops replica *i* (its ports go connection-refused);
+    ``restart(i)`` brings a replica back **on the same ports** so breaker
+    half-open recovery is testable.  ``chaos(i, injector)`` degrades one
+    replica — the straggler in hedging benchmarks.
+    """
+
+    def __init__(self, registry_factory: Callable[[], "ModelRegistry"],
+                 n: int = 3, host: str = "127.0.0.1"):
+        if n < 1:
+            raise ValueError("ClusterHarness needs at least one server")
+        self._registry_factory = registry_factory
+        self.host = host
+        self.harnesses: List[Optional[ServerHarness]] = [
+            ServerHarness(registry_factory(), host=host) for _ in range(n)]
+        # ports are pinned at construction so restart(i) can rebind them
+        self._http_ports = [h.http_port for h in self.harnesses]
+        self._grpc_ports = [h.grpc_port for h in self.harnesses]
+
+    @property
+    def http_urls(self) -> List[str]:
+        return [f"{self.host}:{p}" for p in self._http_ports]
+
+    @property
+    def grpc_urls(self) -> List[str]:
+        return [f"{self.host}:{p}" for p in self._grpc_ports]
+
+    def start(self) -> "ClusterHarness":
+        for h in self.harnesses:
+            h.start()
+        return self
+
+    def stop(self) -> None:
+        for i, h in enumerate(self.harnesses):
+            if h is not None:
+                h.stop()
+                self.harnesses[i] = None
+
+    def kill(self, i: int) -> None:
+        """Take replica ``i`` down (graceful drain, then ports closed —
+        the client sees 503s during the drain and connection-refused
+        after, both retryable)."""
+        h = self.harnesses[i]
+        if h is not None:
+            h.stop()
+            self.harnesses[i] = None
+
+    def restart(self, i: int) -> None:
+        """Bring replica ``i`` back on its original ports (fresh registry
+        and core, like a real process restart)."""
+        if self.harnesses[i] is not None:
+            raise RuntimeError(f"server {i} is already running")
+        h = ServerHarness(self._registry_factory(),
+                          http_port=self._http_ports[i],
+                          grpc_port=self._grpc_ports[i], host=self.host)
+        h.start()
+        self.harnesses[i] = h
+
+    def chaos(self, i: int, injector) -> None:
+        """Install a chaos injector on replica ``i`` (None clears it)."""
+        self.harnesses[i].core.chaos = injector
 
     def __enter__(self):
         return self.start()

@@ -18,6 +18,13 @@ client deps installed.
 Rates (QPS, error %, queue share, batch) are deltas between consecutive
 polls; ``--once`` takes a single sample, so rate columns fall back to the
 cumulative counters (and QPS is null in ``--json``).
+
+``--url`` is repeatable: with a fleet, every server is polled each cycle
+and the table shows one aggregated row per model (QPS/pending/shed summed
+across replicas, latency tails as the WORST replica — the fleet's honest
+tail) with a per-server breakdown row under it; an unreachable replica is
+shown as down instead of killing the console.  ``--once --json`` carries
+the per-endpoint samples next to the aggregate.
 """
 
 from __future__ import annotations
@@ -26,6 +33,7 @@ import argparse
 import json
 import re
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -180,6 +188,53 @@ def _outlier_brief(o: Optional[dict]) -> Optional[Dict[str, Any]]:
     }
 
 
+def aggregate_rows(per_url_rows: Dict[str, Dict[str, Dict[str, Any]]]
+                   ) -> Dict[str, Dict[str, Any]]:
+    """Fold per-server model rows into one fleet row per model.
+
+    Additive columns (QPS, pending, shed/deadline rates, watchdog counts)
+    sum; latency/queue/batch/error columns take the WORST replica — an
+    operator triaging a fleet needs the tail that users actually see, and
+    averaging replicas hides exactly the straggler they're looking for.
+    The newest outlier across replicas (smallest server-computed age)
+    represents the fleet.
+    """
+    models: set = set()
+    for rows in per_url_rows.values():
+        models.update(rows)
+    agg: Dict[str, Dict[str, Any]] = {}
+    for model in sorted(models):
+        rows = [r[model] for r in per_url_rows.values() if model in r]
+
+        def _sum(key, nd=1):
+            vals = [r[key] for r in rows if r.get(key) is not None]
+            return round(sum(vals), nd) if vals else None
+
+        def _worst(key):
+            vals = [r[key] for r in rows if r.get(key) is not None]
+            return max(vals) if vals else None
+
+        outliers = [r["last_outlier"] for r in rows
+                    if r.get("last_outlier") is not None]
+        agg[model] = {
+            "qps": _sum("qps"),
+            "p50_ms": _worst("p50_ms"),
+            "p99_ms": _worst("p99_ms"),
+            "queue_share_pct": _worst("queue_share_pct"),
+            "batch_avg": _worst("batch_avg"),
+            "pending": sum(r["pending"] for r in rows),
+            "error_pct": _worst("error_pct"),
+            "rejected_per_s": _sum("rejected_per_s"),
+            "deadline_exceeded_per_s": _sum("deadline_exceeded_per_s"),
+            "slow_total": sum(r["slow_total"] for r in rows),
+            "captured_total": sum(r["captured_total"] for r in rows),
+            "threshold_ms": _worst("threshold_ms"),
+            "last_outlier": (min(outliers, key=lambda o: o["age_s"])
+                            if outliers else None),
+        }
+    return agg
+
+
 # -- rendering ---------------------------------------------------------------
 
 def _fmt(v, nd: int = 1) -> str:
@@ -188,6 +243,33 @@ def _fmt(v, nd: int = 1) -> str:
     if isinstance(v, float):
         return f"{v:.{nd}f}"
     return str(v)
+
+
+_COLUMNS = (f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
+            f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'REJ/s':>7}{'DLX/s':>7}"
+            f"{'SLOW':>6}{'CAPT':>6}"
+            f"  LAST OUTLIER")
+
+
+def _row_line(label: str, r: Dict[str, Any]) -> str:
+    o = r["last_outlier"]
+    brief = ""
+    if o is not None:
+        brief = (f"{o['age_s']:g}s ago {o['total_ms']:g}ms "
+                 f"{o['reason'] or ''}")
+        if o.get("chaos"):
+            # injected weather, labeled so an operator staring at a
+            # spike can tell the chaos harness from the real world
+            brief += f" [chaos:{o['chaos']}]"
+        if o["outcome"] != "ok":
+            brief += f" ({o['outcome'][:40]})"
+    return (
+        f"  {label:<24}{_fmt(r['qps']):>8}{_fmt(r['p50_ms']):>9}"
+        f"{_fmt(r['p99_ms']):>9}{_fmt(r['queue_share_pct']):>8}"
+        f"{_fmt(r['batch_avg']):>7}{r['pending']:>6}"
+        f"{_fmt(r['error_pct'], 2):>7}{_fmt(r['rejected_per_s']):>7}"
+        f"{_fmt(r['deadline_exceeded_per_s']):>7}{r['slow_total']:>6}"
+        f"{r['captured_total']:>6}  {brief}")
 
 
 def render(url: str, cur: Dict[str, Any],
@@ -201,31 +283,34 @@ def render(url: str, cur: Dict[str, Any],
         f"{recorder.get('recorded_total', 0)} recorded, "
         f"{len(recorder.get('outliers', []))} outlier(s) pinned)",
         "",
-        f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
-        f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'REJ/s':>7}{'DLX/s':>7}"
-        f"{'SLOW':>6}{'CAPT':>6}"
-        f"  LAST OUTLIER",
+        _COLUMNS,
     ]
     for model, r in rows.items():
-        o = r["last_outlier"]
-        brief = ""
-        if o is not None:
-            brief = (f"{o['age_s']:g}s ago {o['total_ms']:g}ms "
-                     f"{o['reason'] or ''}")
-            if o.get("chaos"):
-                # injected weather, labeled so an operator staring at a
-                # spike can tell the chaos harness from the real world
-                brief += f" [chaos:{o['chaos']}]"
-            if o["outcome"] != "ok":
-                brief += f" ({o['outcome'][:40]})"
-        lines.append(
-            f"  {model:<24}{_fmt(r['qps']):>8}{_fmt(r['p50_ms']):>9}"
-            f"{_fmt(r['p99_ms']):>9}{_fmt(r['queue_share_pct']):>8}"
-            f"{_fmt(r['batch_avg']):>7}{r['pending']:>6}"
-            f"{_fmt(r['error_pct'], 2):>7}{_fmt(r['rejected_per_s']):>7}"
-            f"{_fmt(r['deadline_exceeded_per_s']):>7}{r['slow_total']:>6}"
-            f"{r['captured_total']:>6}  {brief}")
+        lines.append(_row_line(model, r))
     if not rows:
+        lines.append("  (no recorded requests yet)")
+    return "\n".join(lines) + "\n"
+
+
+def render_fleet(urls: List[str],
+                 per_url_rows: Dict[str, Dict[str, Dict[str, Any]]],
+                 agg: Dict[str, Dict[str, Any]], interval: float) -> str:
+    """Fleet view: one aggregated row per model (sums + worst-replica
+    tails) with a per-server breakdown row for every polled endpoint."""
+    down = [u for u in urls if u not in per_url_rows]
+    header = (f"triton-top — fleet of {len(urls)} "
+              f"({len(urls) - len(down)} up) — {time.strftime('%H:%M:%S')}  "
+              f"refresh={interval:g}s")
+    if down:
+        header += "  DOWN: " + ", ".join(down)
+    lines = [header, "", _COLUMNS]
+    for model, row in agg.items():
+        lines.append(_row_line(model, row))
+        for u in urls:
+            rows = per_url_rows.get(u)
+            if rows is not None and model in rows:
+                lines.append(_row_line(f" └ {u}", rows[model]))
+    if not agg:
         lines.append("  (no recorded requests yet)")
     return "\n".join(lines) + "\n"
 
@@ -239,8 +324,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "/metrics and /v2/debug/flight_recorder, renders QPS, "
                     "p50/p99, queue share, batch occupancy, error rate, "
                     "and the most recent tail-latency outlier.")
-    parser.add_argument("--url", default="localhost:8000",
-                        help="server host:port (default localhost:8000)")
+    parser.add_argument("--url", action="append", default=None,
+                        help="server host:port (default localhost:8000); "
+                             "repeat for a fleet — every server is polled "
+                             "and the table aggregates per model with a "
+                             "per-server breakdown row")
     parser.add_argument("--interval", type=float, default=2.0,
                         help="refresh interval in seconds (default 2.0)")
     parser.add_argument("--once", action="store_true",
@@ -262,53 +350,127 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="per-poll HTTP timeout in seconds")
     args = parser.parse_args(argv)
 
-    base = args.url if "://" in args.url else f"http://{args.url}"
-    base = base.rstrip("/")
+    bases = []
+    for u in (args.url or ["localhost:8000"]):
+        base = u if "://" in u else f"http://{u}"
+        bases.append(base.rstrip("/"))
+    fleet = len(bases) > 1
     limit = args.limit if args.limit is not None else (0 if args.once else 1)
 
-    def one_sample():
-        try:
-            return sample(base, args.timeout, limit=limit)
-        except (urllib.error.URLError, OSError, ValueError) as e:
-            print(f"error: cannot poll {base}: {e}", file=sys.stderr)
-            return None
+    def sample_all(quiet=False):
+        """One poll of every server, in parallel — a blackholed replica
+        must cost the fleet one --timeout, not one per dead replica per
+        refresh.  An unreachable server maps to None — the fleet view
+        must survive (and show) a dead replica."""
+        # pre-filled: a poll thread that outlives its join timeout must
+        # leave its server marked down, not missing from the dict
+        out = {base: None for base in bases}
+        lock = threading.Lock()
 
-    cur = one_sample()
-    if cur is None:
+        def poll_one(base):
+            try:
+                s = sample(base, args.timeout, limit=limit)
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                s = None
+                if not quiet:
+                    print(f"error: cannot poll {base}: {e}",
+                          file=sys.stderr)
+            with lock:
+                out[base] = s
+
+        if len(bases) == 1:
+            poll_one(bases[0])
+            return out
+        threads = [threading.Thread(target=poll_one, args=(b,),
+                                    daemon=True) for b in bases]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=args.timeout + 5.0)
+        return out
+
+    def fold(cur, prev):
+        """Per-server rows + the fleet aggregate from one (or two) polls."""
+        per_url = {}
+        for base, s in cur.items():
+            if s is None:
+                continue
+            p = prev.get(base) if prev else None
+            per_url[base] = model_rows(s, p,
+                                       include_idle=args.include_idle)
+        return per_url, aggregate_rows(per_url)
+
+    cur = sample_all()
+    if all(s is None for s in cur.values()):
         return 1
     if args.once:
-        rows = model_rows(cur, None, include_idle=args.include_idle)
+        per_url, agg = fold(cur, None)
         if args.as_json:
-            out = {
-                "url": base,
-                "ts": time.time(),
-                "models": rows,
-                "recorder": cur["recorder"],
-            }
+            if fleet:
+                out = {
+                    "urls": bases,
+                    "ts": time.time(),
+                    "models": agg,
+                    # per-endpoint samples: each server's rows + recorder
+                    "endpoints": {
+                        base: (None if cur[base] is None else {
+                            "models": per_url.get(base, {}),
+                            "recorder": cur[base]["recorder"],
+                        }) for base in bases
+                    },
+                }
+            else:
+                # single-url shape unchanged (scripting compat)
+                out = {
+                    "url": bases[0],
+                    "ts": time.time(),
+                    "models": per_url.get(bases[0], {}),
+                    "recorder": cur[bases[0]]["recorder"],
+                }
             print(json.dumps(out, indent=2))
+        elif fleet:
+            sys.stdout.write(render_fleet(bases, per_url, agg,
+                                          args.interval))
         else:
-            sys.stdout.write(render(base, cur, rows, args.interval))
+            sys.stdout.write(render(bases[0], cur[bases[0]],
+                                    per_url.get(bases[0], {}),
+                                    args.interval))
         return 0
 
     prev = cur
     try:
         while True:
             time.sleep(max(0.05, args.interval))
-            cur = one_sample()
-            if cur is None:
+            cur = sample_all(quiet=True)
+            if all(s is None for s in cur.values()):
                 # transient blip (deploy, overloaded scrape): keep the
                 # console alive and retry — monitoring must not die at
                 # exactly the moment the server gets interesting
                 continue
-            rows = model_rows(cur, prev, include_idle=args.include_idle)
+            per_url, agg = fold(cur, prev)
             if args.as_json:
-                print(json.dumps({"ts": time.time(), "models": rows}))
+                print(json.dumps({
+                    "ts": time.time(),
+                    "models": agg if fleet else
+                              next(iter(per_url.values()), {}),
+                    **({"endpoints": {b: per_url.get(b)
+                                      for b in bases}} if fleet else {}),
+                }))
             else:
                 # clear screen + home, top(1)-style
                 sys.stdout.write("\x1b[H\x1b[2J")
-                sys.stdout.write(render(base, cur, rows, args.interval))
+                if fleet:
+                    sys.stdout.write(render_fleet(bases, per_url, agg,
+                                                  args.interval))
+                else:
+                    sys.stdout.write(render(bases[0], cur[bases[0]],
+                                            per_url.get(bases[0], {}),
+                                            args.interval))
                 sys.stdout.flush()
-            prev = cur
+            # a server that missed THIS poll keeps its previous sample as
+            # the delta base, so its next successful poll shows a sane rate
+            prev = {b: (cur[b] if cur[b] is not None else prev.get(b))
+                    for b in bases}
     except KeyboardInterrupt:
         return 0
     except BrokenPipeError:
