@@ -590,6 +590,90 @@ def _measure_cluster() -> dict:
     return out
 
 
+def _measure_qos_overload() -> dict:
+    """QoS A/B: tier-0 p99 with vs without priority tiers under ~2x
+    sustained overload.  A delay-model harness with a bounded queue takes
+    a best-effort closed-loop flood plus a serial tier-0 probe stream;
+    with QoS the flood rides priority 3 (shed first at half the queue
+    bound, tier 0 keeps headroom), without it everything is priority 0
+    and the probe competes FIFO.  Host-only (the delay model sleeps), so
+    this leg runs on every backend and never kills the bench."""
+    import gc
+
+    import triton_client_tpu.http as httpclient
+    from triton_client_tpu._resilience import RetryPolicy
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server.registry import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+
+    gc.collect()
+    model = "custom_identity_int32"
+    delay = {"execute_delay_ms": 15}
+    queue_limit = 6
+    flood_threads = 8  # ~2x what the queue bound admits
+
+    def make_inputs():
+        x = np.arange(4, dtype=np.int32).reshape(1, 4)
+        i = httpclient.InferInput("INPUT0", [1, 4], "INT32")
+        i.set_data_from_numpy(x)
+        return [i]
+
+    def window(qos_on: bool):
+        registry = ModelRegistry()
+        registry.register_model(zoo.make_custom_identity_int32())
+        with ServerHarness(registry) as h:
+            h.core.queue_limits[model] = queue_limit
+            stop = threading.Event()
+
+            def flood():
+                with httpclient.InferenceServerClient(h.http_url) as c:
+                    inputs = make_inputs()
+                    while not stop.is_set():
+                        try:
+                            c.infer(model, inputs, parameters=delay,
+                                    priority=3 if qos_on else 0,
+                                    tenant="batch")
+                        except Exception:
+                            time.sleep(0.002)  # shed: brief local backoff
+
+            threads = [threading.Thread(target=flood, daemon=True)
+                       for _ in range(flood_threads)]
+            for t in threads:
+                t.start()
+            time.sleep(0.4)  # flood reaches steady state
+            lat = []
+            policy = RetryPolicy(max_attempts=3, retry_infer=True,
+                                 initial_backoff_s=0.01)
+            with httpclient.InferenceServerClient(h.http_url) as c:
+                inputs = make_inputs()
+                for _ in range(50):
+                    t0 = time.perf_counter()
+                    c.infer(model, inputs, parameters=delay, priority=0,
+                            tenant="gold", retry_policy=policy)
+                    lat.append(time.perf_counter() - t0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            shed = sum(h.core.qos.rejected_counts().values())
+            p99 = float(np.percentile(np.asarray(lat), 99) * 1e3)
+            return round(p99, 2), shed
+
+    try:
+        p99_on, shed_on = window(qos_on=True)
+        p99_off, shed_off = window(qos_on=False)
+    except Exception as e:  # noqa: BLE001 — QoS leg never kills bench
+        return {"qos_error": str(e)[:120]}
+    result = {
+        "tier0_p99_ms_with_qos": p99_on,
+        "tier0_p99_ms_without_qos": p99_off,
+        "shed_with_qos": shed_on,
+        "shed_without_qos": shed_off,
+    }
+    if p99_on:
+        result["tier0_p99_ratio"] = round(p99_off / p99_on, 2)
+    return {"qos_overload": result}
+
+
 def _measure_rtt_floor() -> float:
     """Median blocking device round trip (H2D + sync + D2H) in ms — the
     physical latency floor for any synchronous per-request device path."""
@@ -890,6 +974,8 @@ def main() -> int:
     bert_metrics.update(_measure_bert_int8())
     # cluster client: routing + hedged-tail A/Bs on a 3-replica fleet
     cluster_metrics = _measure_cluster()
+    # QoS A/B: tier-0 p99 with vs without priority tiers at 2x overload
+    qos_metrics = _measure_qos_overload()
 
     baseline = _previous_baseline()
     value = simple_res["infer_per_sec"]
@@ -937,6 +1023,8 @@ def main() -> int:
     out.update(resilience_overhead)
     # cluster routing + hedging tail: the client-side fleet layer's numbers
     out.update(cluster_metrics)
+    # multi-tenant QoS: the graceful-degradation A/B under overload
+    out.update(qos_metrics)
     # client-side telemetry (the instrumented clients recorded every leg):
     # a compact per-(protocol, method, model) view so the bench record
     # carries client-observed p50/p99 next to the server-derived numbers

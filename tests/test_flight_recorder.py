@@ -280,7 +280,7 @@ def _infer_simple(server):
 _RECORD_KEYS = {"seq", "request_id", "model", "version", "protocol",
                 "batch", "bytes_in", "bytes_out", "ts", "queue_us",
                 "compute_us", "total_us", "outcome", "captured",
-                "capture_reason", "chaos"}
+                "capture_reason", "chaos", "tenant", "tier"}
 _TOP_LEVEL_KEYS = {"enabled", "capture_slower_than", "ring_capacity",
                    "outlier_capacity", "recorded_total", "models",
                    "recent", "outliers"}
@@ -533,7 +533,7 @@ class TestTritonTop:
         rc = top.main(["--url", server.http_url, "--once", "--json"])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        assert set(out) == {"url", "ts", "models", "recorder"}
+        assert set(out) == {"url", "ts", "models", "tenants", "recorder"}
         row = out["models"]["simple"]
         assert {"qps", "p50_ms", "p99_ms", "queue_share_pct", "batch_avg",
                 "pending", "error_pct", "rejected_per_s",

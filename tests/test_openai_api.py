@@ -642,3 +642,38 @@ class TestStreamOptions:
                       {"model": "llama_generate", "prompt": "x",
                        **body_extra})
             assert e.value.code == 400, body_extra
+
+
+class TestQosIdentity:
+    """The OpenAI surface resolves the same QoS identity the native v2
+    endpoints do: tenant from the triton-tenant header (basic-auth
+    fallback), priority via the body extension (0 = highest)."""
+
+    def test_tenant_header_reaches_qos_counters(self, server):
+        req = urllib.request.Request(
+            f"http://{server.http_url}/v1/completions",
+            data=json.dumps({"model": "llama_generate", "prompt": "x",
+                             "max_tokens": 2, "priority": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "triton-tenant": "oai-tenant"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert json.loads(r.read())["choices"]
+        counts = server.core.qos.tenant_request_counts()
+        tier = server.core.qos.tier_of(2)
+        assert counts.get(("oai-tenant", tier), 0) >= 1
+
+    def test_anonymous_default(self, server):
+        with _post(server.http_url, "/v1/completions", {
+            "model": "llama_generate", "prompt": "x", "max_tokens": 2,
+        }) as r:
+            assert json.loads(r.read())["choices"]
+        counts = server.core.qos.tenant_request_counts()
+        assert counts.get(("anonymous", 0), 0) >= 1
+
+    def test_bad_priority_400(self, server):
+        for bad in (-1, "high", True, 1.5):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.http_url, "/v1/completions",
+                      {"model": "llama_generate", "prompt": "x",
+                       "max_tokens": 2, "priority": bad})
+            assert e.value.code == 400, bad

@@ -110,3 +110,85 @@ class TestResponseCache:
         # same instance via register_model factory, but a new generation:
         # the old entry must not answer for the reloaded model
         assert len(harness.calls) == 2
+
+
+class TestTtlAndBudget:
+    """Per-model TTL (config response_cache.ttl_s) + byte-budget LRU
+    eviction (--cache-budget-bytes), with eviction counters."""
+
+    def _ttl_model(self, name="ttl_model", ttl="0.15"):
+        calls = []
+        cfg = make_config(
+            name,
+            inputs=[("X", "FP32", [1, 4])],
+            outputs=[("Y", "FP32", [1, 4])],
+            instance_kind="KIND_CPU",
+            response_cache=True,
+            parameters={"response_cache.ttl_s": ttl},
+        )
+
+        def fn(X):
+            calls.append(1)
+            return {"Y": jnp.asarray(X) + 1.0}
+
+        return JaxModel(cfg, fn, jit=False), calls
+
+    def test_entry_expires_after_model_ttl(self):
+        import time
+
+        registry = ModelRegistry()
+        model, calls = self._ttl_model()
+        registry.register_model(model)
+        with ServerHarness(registry) as h:
+            with httpclient.InferenceServerClient(h.http_url) as client:
+                x = np.ones((1, 4), np.float32)
+                _infer(client, "ttl_model", x)
+                _infer(client, "ttl_model", x)   # inside TTL: hit
+                assert len(calls) == 1
+                time.sleep(0.2)                  # past the 0.15s TTL
+                _infer(client, "ttl_model", x)   # expired: re-executes
+            assert len(calls) == 2
+            # the expiry surfaced as an eviction, visible in /metrics
+            assert h.core.response_cache.evictions_by_model == \
+                {"ttl_model": 1}
+            import urllib.request
+
+            text = urllib.request.urlopen(
+                f"http://{h.http_url}/metrics", timeout=10).read().decode()
+            assert ('nv_cache_num_evictions_per_model'
+                    '{model="ttl_model"} 1') in text
+
+    def test_byte_budget_evicts_lru(self):
+        from triton_client_tpu.server.core import _ResponseCache
+
+        cache = _ResponseCache(budget_bytes=1024)
+        a = np.zeros(100, np.float32)  # 400 bytes each
+        cache.put(("m", 0, "", "k1"), {"Y": a})
+        cache.put(("m", 0, "", "k2"), {"Y": a})
+        assert cache.total_bytes == 800
+        cache.put(("m", 0, "", "k3"), {"Y": a})  # 1200 > budget
+        assert cache.total_bytes == 800          # oldest evicted
+        assert cache.get(("m", 0, "", "k1")) is None   # LRU victim
+        assert cache.get(("m", 0, "", "k2")) is not None
+        assert cache.get(("m", 0, "", "k3")) is not None
+        assert cache.evictions_by_model == {"m": 1}
+
+    def test_oversized_entry_never_cached(self):
+        from triton_client_tpu.server.core import _ResponseCache
+
+        cache = _ResponseCache(budget_bytes=100)
+        cache.put(("m", 0, "", "big"), {"Y": np.zeros(100, np.float32)})
+        assert cache.total_bytes == 0
+        assert cache.get(("m", 0, "", "big")) is None
+
+    def test_replacement_is_not_an_eviction(self):
+        from triton_client_tpu.server.core import _ResponseCache
+
+        cache = _ResponseCache()
+        a = np.zeros(10, np.float32)
+        cache.put(("m", 0, "", "k"), {"Y": a})
+        cache.put(("m", 0, "", "k"), {"Y": a + 1})
+        assert cache.evictions_by_model == {}
+        assert cache.total_bytes == a.nbytes
+        np.testing.assert_array_equal(
+            cache.get(("m", 0, "", "k"))["Y"], a + 1)

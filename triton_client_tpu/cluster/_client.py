@@ -392,11 +392,14 @@ class ClusterClient(InferenceServerClientBase):
         retry_policy: Optional[RetryPolicy] = None,
         deadline_s: Optional[float] = None,
         hedge: Optional[bool] = None,
+        tenant: Optional[str] = None,
         **kwargs,
     ):
         """Routed inference.  ``hedge`` overrides the idempotency gate per
         call (True asserts the model is safe to re-execute; False
-        disables hedging for this request); protocol-specific kwargs
+        disables hedging for this request); ``priority``/``tenant`` are
+        the QoS identity, carried in the per-attempt call dict so retries
+        AND hedged backups re-stamp them; protocol-specific kwargs
         (``query_params``, ``client_timeout``, compression, ...) pass
         through to the per-endpoint client."""
         policy = retry_policy if retry_policy is not None \
@@ -406,7 +409,7 @@ class ClusterClient(InferenceServerClientBase):
             request_id=request_id, sequence_id=sequence_id,
             sequence_start=sequence_start, sequence_end=sequence_end,
             priority=priority, timeout=timeout, headers=headers,
-            parameters=parameters, **kwargs)
+            parameters=parameters, tenant=tenant, **kwargs)
         hedging = self._hedge_armed(policy, hedge, sequence_id)
         excluded: List[str] = []
         last: List[Optional[Endpoint]] = [None]

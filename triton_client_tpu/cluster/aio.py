@@ -258,9 +258,12 @@ class ClusterClient(InferenceServerClientBase):
         retry_policy: Optional[RetryPolicy] = None,
         deadline_s: Optional[float] = None,
         hedge: Optional[bool] = None,
+        tenant: Optional[str] = None,
         **kwargs,
     ):
-        """Routed inference — same contract as the sync cluster client."""
+        """Routed inference — same contract as the sync cluster client
+        (``priority``/``tenant`` ride the per-attempt call dict, so
+        retries and hedged backups re-stamp the QoS identity)."""
         self._maybe_start_probing()
         policy = retry_policy if retry_policy is not None \
             else self._retry_policy
@@ -269,7 +272,7 @@ class ClusterClient(InferenceServerClientBase):
             request_id=request_id, sequence_id=sequence_id,
             sequence_start=sequence_start, sequence_end=sequence_end,
             priority=priority, timeout=timeout, headers=headers,
-            parameters=parameters, **kwargs)
+            parameters=parameters, tenant=tenant, **kwargs)
         hedging = self._hedge_armed(policy, hedge, sequence_id)
         excluded: List[str] = []
         last: List[Optional[Endpoint]] = [None]

@@ -530,27 +530,31 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         retry_policy: Optional[RetryPolicy] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> InferResult:
         """Synchronous inference (reference :1445-1572).
 
         ``retry_policy`` (or the client-level one) retries retryable
         failures when ``retry_infer`` is opted in; ``deadline_s`` caps
         total wall-clock across attempts and propagates the remaining
-        budget to the server via the v2 ``timeout`` parameter (µs)."""
+        budget to the server via the v2 ``timeout`` parameter (µs).
+        ``priority`` (0 = highest) and ``tenant`` (``triton-tenant``
+        metadata) are the QoS identity — re-stamped per attempt."""
         policy = retry_policy if retry_policy is not None \
             else self._retry_policy
         if policy is None and deadline_s is None:
             return self._infer_once(
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout,
-                client_timeout, headers, compression_algorithm, parameters)
+                client_timeout, headers, compression_algorithm, parameters,
+                tenant=tenant)
         return call_with_retry(
             policy,
             lambda remaining, _attempt: self._infer_once(
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout,
                 client_timeout, headers, compression_algorithm, parameters,
-                _remaining_s=remaining),
+                tenant=tenant, _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(model_name, "grpc", "infer", request_id))
 
@@ -570,6 +574,7 @@ class InferenceServerClient(InferenceServerClientBase):
         headers=None,
         compression_algorithm=None,
         parameters=None,
+        tenant=None,
         _remaining_s=None,
     ) -> InferResult:
         tel = telemetry()
@@ -585,6 +590,10 @@ class InferenceServerClient(InferenceServerClientBase):
         )
         metadata, rid = _with_trace_metadata(
             self._get_metadata(headers), request_id)
+        if tenant:
+            # QoS identity: appended LAST so the explicit kwarg wins over
+            # a header-supplied value (the server reads the final entry)
+            metadata = metadata + (("triton-tenant", str(tenant)),)
         t_ser1 = time.monotonic_ns()
         if self._verbose:
             print(f"infer, metadata {metadata}\n{request}")
@@ -634,6 +643,7 @@ class InferenceServerClient(InferenceServerClientBase):
         headers=None,
         compression_algorithm=None,
         parameters=None,
+        tenant=None,
     ):
         """Asynchronous inference via gRPC future (reference :1574-1741).
 
@@ -652,6 +662,8 @@ class InferenceServerClient(InferenceServerClientBase):
         )
         metadata, rid = _with_trace_metadata(
             self._get_metadata(headers), request_id)
+        if tenant:
+            metadata = metadata + (("triton-tenant", str(tenant)),)
         req_bytes = request.ByteSize()
         t0 = time.perf_counter()
         call = self._client_stub.ModelInfer.future(

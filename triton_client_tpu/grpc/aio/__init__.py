@@ -421,23 +421,27 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         retry_policy: Optional[RetryPolicy] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> InferResult:
         """Async inference (reference aio :634).  ``retry_policy`` /
-        ``deadline_s``: same resilience contract as the sync client."""
+        ``deadline_s``: same resilience contract as the sync client;
+        ``priority``/``tenant``: the QoS identity, re-stamped per
+        attempt so retries carry it."""
         policy = retry_policy if retry_policy is not None \
             else self._retry_policy
         if policy is None and deadline_s is None:
             return await self._infer_once(
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout,
-                client_timeout, headers, compression_algorithm, parameters)
+                client_timeout, headers, compression_algorithm, parameters,
+                tenant=tenant)
         return await call_with_retry_async(
             policy,
             lambda remaining, _attempt: self._infer_once(
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout,
                 client_timeout, headers, compression_algorithm, parameters,
-                _remaining_s=remaining),
+                tenant=tenant, _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(model_name, "grpc_aio", "infer", request_id))
 
@@ -457,6 +461,7 @@ class InferenceServerClient(InferenceServerClientBase):
         headers=None,
         compression_algorithm=None,
         parameters=None,
+        tenant=None,
         _remaining_s=None,
     ) -> InferResult:
         tel = telemetry()
@@ -471,6 +476,9 @@ class InferenceServerClient(InferenceServerClientBase):
         )
         metadata, rid = _with_trace_metadata(
             self._get_metadata(headers), request_id)
+        if tenant:
+            # QoS identity: appended last so the explicit kwarg wins
+            metadata = metadata + (("triton-tenant", str(tenant)),)
         t_ser1 = time.monotonic_ns()
         req_bytes = request.ByteSize()
         t0 = time.perf_counter()

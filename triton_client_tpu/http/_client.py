@@ -563,6 +563,7 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm,
         response_compression_algorithm,
         parameters,
+        tenant=None,
         _method="infer",
         _remaining_s=None,
     ):
@@ -573,6 +574,10 @@ class InferenceServerClient(InferenceServerClientBase):
             priority, timeout, parameters,
         )
         extra_headers = {}
+        if tenant:
+            # QoS identity: the server's per-tenant token bucket and the
+            # tenant-labeled metrics key off this header
+            extra_headers["triton-tenant"] = str(tenant)
         if request_compression_algorithm == "gzip":
             body = gzip.compress(body)
             extra_headers["Content-Encoding"] = "gzip"
@@ -650,13 +655,16 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         retry_policy: Optional[RetryPolicy] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> InferResult:
         """Run a synchronous inference (reference :1331-1484).
 
         ``retry_policy`` (or the client-level one) retries retryable
         failures when ``retry_infer`` is opted in; ``deadline_s`` caps
         total wall-clock across attempts and propagates the remaining
-        budget to the server via the ``triton-timeout-us`` header."""
+        budget to the server via the ``triton-timeout-us`` header.
+        ``priority`` (0 = highest) and ``tenant`` are the QoS identity —
+        stamped per attempt, so retries re-carry them."""
         policy = retry_policy if retry_policy is not None \
             else self._retry_policy
         if policy is None and deadline_s is None:
@@ -664,7 +672,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout,
                 headers, query_params, request_compression_algorithm,
-                response_compression_algorithm, parameters,
+                response_compression_algorithm, parameters, tenant,
             )
         return call_with_retry(
             policy,
@@ -672,7 +680,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout,
                 headers, query_params, request_compression_algorithm,
-                response_compression_algorithm, parameters,
+                response_compression_algorithm, parameters, tenant,
                 _remaining_s=remaining,
             ),
             method="infer", deadline_s=deadline_s,
@@ -697,6 +705,7 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         retry_policy: Optional[RetryPolicy] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> InferAsyncRequest:
         """Submit an inference to the client's worker pool and return a
         handle (reference :1486-1659; greenlet pool → thread pool here).
@@ -716,7 +725,7 @@ class InferenceServerClient(InferenceServerClientBase):
                     sequence_id, sequence_start, sequence_end, priority,
                     timeout, headers, query_params,
                     request_compression_algorithm,
-                    response_compression_algorithm, parameters,
+                    response_compression_algorithm, parameters, tenant,
                     _method="async_infer",
                 )
             return call_with_retry(
@@ -726,7 +735,7 @@ class InferenceServerClient(InferenceServerClientBase):
                     sequence_id, sequence_start, sequence_end, priority,
                     timeout, headers, query_params,
                     request_compression_algorithm,
-                    response_compression_algorithm, parameters,
+                    response_compression_algorithm, parameters, tenant,
                     _method="async_infer", _remaining_s=remaining,
                 ),
                 method="infer", deadline_s=deadline_s,

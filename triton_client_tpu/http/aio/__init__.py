@@ -427,9 +427,12 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         retry_policy: Optional[RetryPolicy] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> InferResult:
         """Async inference (reference aio :694).  ``retry_policy`` /
-        ``deadline_s``: same resilience contract as the sync client."""
+        ``deadline_s``: same resilience contract as the sync client;
+        ``priority``/``tenant``: the QoS identity, re-stamped per
+        attempt so retries carry it."""
         policy = retry_policy if retry_policy is not None \
             else self._retry_policy
         if policy is None and deadline_s is None:
@@ -437,14 +440,14 @@ class InferenceServerClient(InferenceServerClientBase):
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout,
                 headers, query_params, request_compression_algorithm,
-                response_compression_algorithm, parameters)
+                response_compression_algorithm, parameters, tenant)
         return await call_with_retry_async(
             policy,
             lambda remaining, _attempt: self._infer_once(
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout,
                 headers, query_params, request_compression_algorithm,
-                response_compression_algorithm, parameters,
+                response_compression_algorithm, parameters, tenant,
                 _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(model_name, "http_aio", "infer", request_id))
@@ -466,6 +469,7 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm=None,
         response_compression_algorithm=None,
         parameters=None,
+        tenant=None,
         _remaining_s=None,
     ) -> InferResult:
         tel = telemetry()
@@ -475,6 +479,9 @@ class InferenceServerClient(InferenceServerClientBase):
             priority, timeout, parameters,
         )
         extra_headers = {}
+        if tenant:
+            # QoS identity: same header contract as the sync client
+            extra_headers["triton-tenant"] = str(tenant)
         if request_compression_algorithm == "gzip":
             body = gzip.compress(body)
             extra_headers["Content-Encoding"] = "gzip"

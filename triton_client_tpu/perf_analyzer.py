@@ -298,12 +298,12 @@ def _build_inputs(protocol_mod, arrays, shm_mode):
 def _worker(protocol_mod, make_client, model_name, model_version, arrays,
             outputs, shm_mode, output_byte_size, worker_id, stop, measuring,
             stats: _Stats, lock, streaming=False, retry_policy=None,
-            owns_client=True):
+            owns_client=True, qos_class=None):
     try:
         _worker_impl(protocol_mod, make_client, model_name, model_version,
                      arrays, outputs, shm_mode, output_byte_size, worker_id,
                      stop, measuring, stats, lock, streaming, retry_policy,
-                     owns_client)
+                     owns_client, qos_class)
     except Exception as e:
         # Setup failures (bad model, shm registration, stream open) must be
         # visible in the report, not a silently dead worker thread.
@@ -315,11 +315,16 @@ def _worker(protocol_mod, make_client, model_name, model_version, arrays,
 
 class _InferSession:
     """One worker's client + inputs + shm regions + infer callable — shared
-    by the closed-loop (concurrency) and open-loop (request-rate) drivers."""
+    by the closed-loop (concurrency) and open-loop (request-rate) drivers.
+
+    ``qos_class`` is an optional ``(priority, tenant)`` pair stamped on
+    every request this session sends (mixed-tier sweeps assign one class
+    per worker)."""
 
     def __init__(self, protocol_mod, make_client, model_name, model_version,
                  arrays, outputs, shm_mode, output_byte_size, worker_id,
-                 streaming, retry_policy=None, owns_client=True):
+                 streaming, retry_policy=None, owns_client=True,
+                 qos_class=None):
         self._client = make_client()
         # False when make_client hands out a SHARED client (cluster
         # sweeps): the level owns its lifetime, not this worker
@@ -334,6 +339,7 @@ class _InferSession:
                                         output_byte_size)
             self._shm_setup.attach(infer_inputs, requested)
 
+            priority, tenant = qos_class if qos_class else (0, None)
             if streaming:
                 # Async streaming mode (reference perf_analyzer --streaming):
                 # requests ride one bidi gRPC stream per worker; completion
@@ -353,7 +359,7 @@ class _InferSession:
                 def one_infer():
                     client.async_stream_infer(
                         model_name, infer_inputs, outputs=requested,
-                        model_version=model_version)
+                        model_version=model_version, priority=priority)
                     try:
                         while True:
                             err = done.get(timeout=120)
@@ -374,7 +380,8 @@ class _InferSession:
                     # --retries the sweep measures the retry layer under load
                     client.infer(model_name, infer_inputs, outputs=requested,
                                  model_version=model_version,
-                                 retry_policy=retry_policy)
+                                 retry_policy=retry_policy,
+                                 priority=priority, tenant=tenant)
 
             self.infer = one_infer
         except Exception:
@@ -399,11 +406,11 @@ class _InferSession:
 def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
                  outputs, shm_mode, output_byte_size, worker_id, stop,
                  measuring, stats: _Stats, lock, streaming=False,
-                 retry_policy=None, owns_client=True):
+                 retry_policy=None, owns_client=True, qos_class=None):
     session = _InferSession(protocol_mod, make_client, model_name,
                             model_version, arrays, outputs, shm_mode,
                             output_byte_size, worker_id, streaming,
-                            retry_policy, owns_client)
+                            retry_policy, owns_client, qos_class)
     one_infer = session.infer
     try:
         n = 0
@@ -443,12 +450,19 @@ def _worker_impl(protocol_mod, make_client, model_name, model_version, arrays,
 def run_level(protocol, url, model_name, model_version, concurrency, arrays,
               outputs, shm_mode, output_byte_size, measure_s, warmup_s=1.0,
               extra_percentile=None, streaming=False, retry_policy=None,
-              balancing="least_outstanding", hedge_ms=0.0):
+              balancing="least_outstanding", hedge_ms=0.0,
+              qos_classes=None):
+    """One closed-loop level.  ``qos_classes`` is an optional list of
+    ``(priority, tenant)`` pairs for mixed-tier sweeps: worker ``w`` sends
+    as class ``w % len(classes)``, stats are kept per class, and the
+    result gains a per-class ``classes`` breakdown next to the merged
+    totals."""
     protocol_mod, make_client, shared_client = _make_client_factory(
         protocol, url, concurrency, balancing, hedge_ms)
     cluster_mode = isinstance(url, (list, tuple)) and len(url) > 1
 
-    stats = _Stats()
+    classes = list(qos_classes) if qos_classes else [(0, None)]
+    class_stats = [_Stats() for _ in classes]
     lock = threading.Lock()
     stop = threading.Event()
     measuring = threading.Event()
@@ -457,8 +471,9 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
             target=_worker,
             args=(protocol_mod, make_client, model_name, model_version, arrays,
                   outputs, shm_mode, output_byte_size, w, stop, measuring,
-                  stats, lock, streaming, retry_policy,
-                  shared_client is None),
+                  class_stats[w % len(classes)], lock, streaming,
+                  retry_policy, shared_client is None,
+                  classes[w % len(classes)]),
             daemon=True,
         )
         for w in range(concurrency)
@@ -481,6 +496,16 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
         t.join(timeout=30)
     if shared_client is not None:
         shared_client.close()
+    # merge per-class stats into the level totals (single-class sweeps
+    # merge exactly one, i.e. the old behavior)
+    stats = _Stats()
+    for s in class_stats:
+        stats.latency.merge(s.latency)
+        stats.count += s.count
+        stats.errors += s.errors
+        stats.rejected += s.rejected
+        if stats.first_error is None:
+            stats.first_error = s.first_error
     res = {
         "concurrency": concurrency,
         "throughput": stats.count / elapsed,
@@ -492,6 +517,16 @@ def run_level(protocol, url, model_name, model_version, concurrency, arrays,
         "retries": _retries_recorded(model_name) - retries_before,
         "first_error": stats.first_error,
     }
+    if len(classes) > 1:
+        res["classes"] = [
+            dict(priority=cls[0], tenant=cls[1] or "",
+                 workers=sum(1 for w in range(concurrency)
+                             if w % len(classes) == i),
+                 throughput=s.count / elapsed,
+                 rejected=s.rejected,
+                 rejected_per_sec=s.rejected / elapsed,
+                 **_latency_stats(s.latency, extra_percentile))
+            for i, (cls, s) in enumerate(zip(classes, class_stats))]
     if cluster_mode:
         dist_after, hedges_after, wins_after = _cluster_recorded()
         res["endpoints"] = {
@@ -547,7 +582,8 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
                    outputs, shm_mode, output_byte_size, measure_s,
                    warmup_s=1.0, distribution="constant", max_threads=64,
                    extra_percentile=None, streaming=False, retry_policy=None,
-                   balancing="least_outstanding", hedge_ms=0.0):
+                   balancing="least_outstanding", hedge_ms=0.0,
+                   qos_classes=None):
     """OPEN-loop load at ``rate`` requests/s (reference perf_analyzer
     --request-rate-range): send times are scheduled up front (constant or
     Poisson inter-arrivals) and latency is measured from the SCHEDULED send
@@ -584,13 +620,18 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
     ready = [0]
     go = threading.Event()
 
+    classes = list(qos_classes) if qos_classes else None
+
     def worker(worker_id):
+        ci = worker_id % len(classes) if classes else 0
         try:
             session = _InferSession(protocol_mod, make_client, model_name,
                                     model_version, arrays, outputs, shm_mode,
                                     output_byte_size, worker_id, streaming,
                                     retry_policy,
-                                    owns_client=shared_client is None)
+                                    owns_client=shared_client is None,
+                                    qos_class=(classes[ci]
+                                               if classes else None))
         except Exception as e:  # noqa: BLE001 — setup must be visible
             with lock:
                 ready[0] += 1
@@ -631,7 +672,7 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
                 lat = time.perf_counter() - target
                 with lock:
                     sent.append((sched[k], lag))
-                    done.append((sched[k], lat, err, rejected))
+                    done.append((sched[k], lat, err, rejected, ci))
         finally:
             session.close()
 
@@ -661,11 +702,10 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
         shared_client.close()
     win_lo, win_hi = warmup_s, warmup_s + measure_s
     owed = int(np.sum((sched >= win_lo) & (sched < win_hi)))
-    in_win = [(s, lat, err, rej) for s, lat, err, rej in done
-              if win_lo <= s < win_hi]
-    ok = [lat for s, lat, err, rej in in_win if err is None]
-    errs = [err for s, lat, err, rej in in_win if err is not None]
-    n_rejected = sum(1 for s, lat, err, rej in in_win if rej)
+    in_win = [row for row in done if win_lo <= row[0] < win_hi]
+    ok = [lat for _s, lat, err, _rej, _ci in in_win if err is None]
+    errs = [err for _s, _lat, err, _rej, _ci in in_win if err is not None]
+    n_rejected = sum(1 for _s, _lat, _err, rej, _ci in in_win if rej)
     lags = np.asarray([lag for s, lag in sent if win_lo <= s < win_hi])
     res = {
         "request_rate": rate,
@@ -693,8 +733,37 @@ def run_rate_level(protocol, url, model_name, model_version, rate, arrays,
             for e in sorted(set(dist_before) | set(dist_after))}
         res["hedges"] = hedges_after - hedges_before
         res["hedge_wins"] = wins_after - wins_before
+    if classes and len(classes) > 1:
+        # per-class breakdown, same shape as the closed loop's (workers
+        # are pinned to classes, so slot ownership follows the worker)
+        res["classes"] = []
+        for i, cls in enumerate(classes):
+            c_ok = [lat for _s, lat, err, _rej, ci in in_win
+                    if ci == i and err is None]
+            c_rej = sum(1 for _s, _lat, _err, rej, ci in in_win
+                        if ci == i and rej)
+            res["classes"].append(dict(
+                priority=cls[0], tenant=cls[1] or "",
+                workers=sum(1 for w in range(max_threads)
+                            if w % len(classes) == i),
+                throughput=len(c_ok) / measure_s,
+                rejected=c_rej,
+                rejected_per_sec=c_rej / measure_s,
+                **_latency_stats(c_ok, extra_percentile)))
     res.update(_latency_stats(ok, extra_percentile))
     return res
+
+
+def _json_sanitize(v):
+    """NaN/inf -> None recursively (per-class breakdowns nest dicts in the
+    results rows; --export-metrics must stay strict JSON)."""
+    if isinstance(v, float) and not np.isfinite(v):
+        return None
+    if isinstance(v, dict):
+        return {k: _json_sanitize(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_json_sanitize(x) for x in v]
+    return v
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -741,6 +810,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="hedged requests: issue a backup request to a "
                              "second endpoint after this many ms (0 = off; "
                              "requires multiple -u endpoints)")
+    parser.add_argument("--priority", action="append", type=int,
+                        default=None, metavar="N",
+                        help="v2 request priority (0 = highest); repeat "
+                             "together with --tenant for mixed-tier "
+                             "sweeps — workers round-robin over the "
+                             "(priority, tenant) classes and the table "
+                             "reports per-class throughput/p99/shed")
+    parser.add_argument("--tenant", action="append", default=None,
+                        metavar="NAME",
+                        help="QoS tenant id stamped on every request "
+                             "(triton-tenant header/metadata); repeatable, "
+                             "zipped with --priority into classes")
     parser.add_argument("--retries", type=int, default=0,
                         help="enable the client resilience layer with this "
                              "many max attempts per request (0 = off); the "
@@ -774,6 +855,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.concurrency_range and args.request_rate_range:
         parser.error("--concurrency-range and --request-rate-range are "
                      "mutually exclusive (closed- vs open-loop)")
+    # QoS classes: zip the repeated --priority/--tenant flags; a shorter
+    # list repeats its last value so `--priority 0 --priority 3 --tenant x`
+    # means (0, x) and (3, x)
+    priorities = args.priority or []
+    tenants = args.tenant or []
+    if args.streaming and tenants:
+        # stream metadata is fixed at start_stream; per-request tenant
+        # stamping is a unary-path contract
+        parser.error("--tenant is not supported with --streaming")
+    n_classes = max(len(priorities), len(tenants), 1)
+    qos_classes = None
+    if priorities or tenants:
+        qos_classes = [
+            (priorities[min(i, len(priorities) - 1)] if priorities else 0,
+             tenants[min(i, len(tenants) - 1)] if tenants else None)
+            for i in range(n_classes)]
     if args.concurrency_range is None and args.request_rate_range is None:
         args.concurrency_range = "1"
 
@@ -904,6 +1001,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             if "send_lag_p99_ms" in res:
                 line += f", send lag p99 {res['send_lag_p99_ms']:.1f} ms"
             print(line)
+        for cls in res.get("classes", []):
+            label = f"p={cls['priority']}"
+            if cls["tenant"]:
+                label += f" tenant={cls['tenant']}"
+            p99 = cls["p99_us"]
+            p99_s = f"{p99:.0f}" if np.isfinite(p99) else "-"
+            print(f"    tier {label}: {cls['throughput']:.2f} infer/sec, "
+                  f"p99 {p99_s} usec, shed "
+                  f"{cls['rejected_per_sec']:.1f}/s "
+                  f"({cls['rejected']} total)")
 
     if args.trace_file:
         # server-side tracing for the whole sweep: the stage breakdown
@@ -936,7 +1043,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     max_threads=args.max_threads,
                     extra_percentile=args.percentile, streaming=args.streaming,
                     retry_policy=retry_policy, balancing=args.balancing,
-                    hedge_ms=args.hedge_ms)
+                    hedge_ms=args.hedge_ms, qos_classes=qos_classes)
                 report(res, f"Request rate: {rate:g}/s, completed "
                             "(latency from scheduled send): ")
         else:
@@ -948,7 +1055,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     args.output_shared_memory_size, measure_s,
                     extra_percentile=args.percentile, streaming=args.streaming,
                     retry_policy=retry_policy, balancing=args.balancing,
-                    hedge_ms=args.hedge_ms)
+                    hedge_ms=args.hedge_ms, qos_classes=qos_classes)
                 report(res, f"Concurrency: {level}, throughput: ")
     finally:
         if args.trace_file:
@@ -988,11 +1095,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "urls": urls,
             "shared_memory": args.shared_memory,
             "load_mode": "open_loop" if open_loop else "closed_loop",
-            "results": [
-                {k: (None if isinstance(v, float) and not np.isfinite(v)
-                     else v) for k, v in r.items()}
-                for r in results
-            ],
+            "results": [_json_sanitize(r) for r in results],
             "client_telemetry": telemetry().snapshot(),
         }
         if trace_summary is not None:

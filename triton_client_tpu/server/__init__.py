@@ -10,6 +10,7 @@ statistics — with JAX/XLA as the one and only compute backend.
 
 from .core import InferenceCore
 from .model import EnsembleModel, JaxModel, Model, PyModel, make_config
+from .qos import QosManager, TieredQueue, TokenBucket
 from .registry import ModelRegistry
 from .types import InferError, InferRequest, InferResponse
 
@@ -24,4 +25,7 @@ __all__ = [
     "InferError",
     "InferRequest",
     "InferResponse",
+    "QosManager",
+    "TieredQueue",
+    "TokenBucket",
 ]

@@ -74,8 +74,43 @@ def main() -> None:
                         "parameter overrides per model)")
     parser.add_argument("--shed-retry-after", type=float, default=0.25,
                         metavar="S",
-                        help="pushback horizon (seconds) sent with shed "
-                        "responses (Retry-After / retry-after-ms)")
+                        help="BASE pushback horizon (seconds) sent with "
+                        "shed responses (Retry-After / retry-after-ms); "
+                        "the actual horizon scales with the shed tier's "
+                        "queue depth")
+    parser.add_argument("--qos-tiers", type=int, default=4,
+                        help="number of QoS priority tiers; the v2 request "
+                        "priority parameter (0 = highest) maps to tier "
+                        "min(priority, tiers-1) and the last tier is the "
+                        "preemptible best-effort lane (default 4)")
+    parser.add_argument("--qos-weights", default=None, metavar="W0,W1,...",
+                        help="weighted-fair dequeue weights, one per tier "
+                        "(e.g. '8,4,2,1'); default: strict priority")
+    parser.add_argument("--qos-tenant-rate", type=float, default=0.0,
+                        metavar="RPS",
+                        help="default per-tenant token-bucket rate in "
+                        "requests/s (0 = no tenant rate limiting); the "
+                        "tenant comes from the triton-tenant header or "
+                        "basic-auth username, else 'anonymous'")
+    parser.add_argument("--qos-tenant-burst", type=float, default=None,
+                        help="token-bucket burst allowance (default: "
+                        "max(1, rate))")
+    parser.add_argument("--qos-tenant-limit", action="append", default=None,
+                        metavar="NAME=RATE[:BURST]",
+                        help="per-tenant rate override (repeatable); "
+                        "RATE 0 exempts the tenant from rate limiting")
+    parser.add_argument("--qos-best-effort-fraction", type=float,
+                        default=0.5, metavar="F",
+                        help="fraction of a model's max_queue_size the "
+                        "best-effort tier may fill before it is shed "
+                        "(tier 0 always gets 100%%; intermediate tiers "
+                        "interpolate; default 0.5)")
+    parser.add_argument("--cache-budget-bytes", type=int, default=0,
+                        help="byte budget across all response-cache "
+                        "entries; inserts evict LRU entries to fit "
+                        "(0 = entry-count bound only).  Per-model TTL "
+                        "comes from the model config's "
+                        "response_cache.ttl_s parameter")
     parser.add_argument("--chaos", type=float, default=0.0, metavar="RATE",
                         help="fault-injection rate in [0,1]: each request "
                         "draws from a seeded RNG and at RATE gets a fault "
@@ -153,6 +188,26 @@ def main() -> None:
     core = InferenceCore(registry)
     core.default_max_queue_size = max(0, args.max_queue_size)
     core.shed_retry_after_s = max(0.0, args.shed_retry_after)
+    from .qos import QosManager, parse_tenant_limit
+
+    try:
+        weights = ([int(w) for w in args.qos_weights.split(",")]
+                   if args.qos_weights else None)
+        tenant_rates = {}
+        for spec in (args.qos_tenant_limit or []):
+            name, rate, burst = parse_tenant_limit(spec)
+            tenant_rates[name] = (rate, burst)
+        core.qos = QosManager(
+            tiers=args.qos_tiers,
+            tenant_rate=max(0.0, args.qos_tenant_rate),
+            tenant_burst=args.qos_tenant_burst,
+            tenant_rates=tenant_rates,
+            best_effort_fraction=args.qos_best_effort_fraction,
+            weights=weights)
+    except ValueError as e:
+        parser.error(str(e))
+    if args.cache_budget_bytes > 0:
+        core.response_cache.budget_bytes = args.cache_budget_bytes
     if args.chaos > 0.0:
         from .chaos import build_injector
 

@@ -32,6 +32,7 @@ from .registry import ModelRegistry
 from .shm import SystemShmRegistry, XlaShmRegistry
 from .flight_recorder import FlightRecorder
 from .log import ServerLog
+from .qos import DEFAULT_TENANT, QosManager, TieredQueue
 from .trace import RequestTracer, TRACE_DEFAULTS
 from .types import (
     InferError,
@@ -81,12 +82,23 @@ class _InlineProfile:
 
 
 class _ResponseCache:
-    """LRU answering identical requests without executing the model
-    (Triton ``response_cache.enable``).
+    """TTL + byte-budget LRU answering identical requests without
+    executing the model (Triton ``response_cache.enable``).
 
     Keyed on (model, registry generation, input bytes, request parameters,
     requested outputs).  Only stateless wire requests cache: sequence,
-    shared-memory, decoupled, and ensemble requests bypass it."""
+    shared-memory, decoupled, and ensemble requests bypass it.
+
+    Two eviction levers on top of the entry-count LRU:
+
+    * **per-model TTL** — the model config's ``response_cache.ttl_s``
+      parameter; an entry past its TTL answers as a miss and is evicted,
+    * **byte budget** — ``budget_bytes`` (CLI ``--cache-budget-bytes``)
+      caps the summed entry payload across models; inserts evict LRU
+      entries until the total fits.
+
+    Every eviction (LRU, budget, or TTL expiry) lands in
+    ``evictions_by_model`` -> ``nv_cache_num_evictions_per_model``."""
 
     MAX_ENTRIES = 64
     MAX_ITEM_BYTES = 8 << 20
@@ -95,17 +107,20 @@ class _ResponseCache:
     # bypass the cache entirely)
     MAX_KEY_BYTES = 1 << 20
 
-    def __init__(self) -> None:
+    def __init__(self, budget_bytes: Optional[int] = None) -> None:
         from collections import OrderedDict
 
-        self._entries: "OrderedDict[tuple, Dict[str, np.ndarray]]" = \
-            OrderedDict()
+        # key -> (frozen outputs, expires_at monotonic or None, nbytes)
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._total_bytes = 0
+        self.budget_bytes = budget_bytes  # None/0 = no byte budget
         self.hits = 0
         self.misses = 0
         # per-model lookup outcomes (key[0] is the model name) backing the
-        # nv_cache_num_{hits,misses}_per_model metrics
+        # nv_cache_num_{hits,misses,evictions}_per_model metrics
         self.hits_by_model: Dict[str, int] = {}
         self.misses_by_model: Dict[str, int] = {}
+        self.evictions_by_model: Dict[str, int] = {}
 
     @staticmethod
     def key(model: Model, generation: int, request: InferRequest,
@@ -132,8 +147,20 @@ class _ResponseCache:
             (o.name, o.class_count) for o in request.outputs)).encode())
         return (model.name, generation, request.model_version, h.hexdigest())
 
+    def _evict(self, key: tuple, entry: tuple) -> None:
+        self._total_bytes -= entry[2]
+        self.evictions_by_model[key[0]] = \
+            self.evictions_by_model.get(key[0], 0) + 1
+
     def get(self, key: tuple) -> Optional[Dict[str, np.ndarray]]:
         entry = self._entries.get(key)
+        if entry is not None and entry[1] is not None \
+                and time.monotonic() >= entry[1]:
+            # past its model's TTL: evicted here (lazily, on lookup) and
+            # answered as a miss so the fresh execution re-populates
+            del self._entries[key]
+            self._evict(key, entry)
+            entry = None
         if entry is None:
             self.misses += 1
             self.misses_by_model[key[0]] = \
@@ -142,7 +169,7 @@ class _ResponseCache:
         self._entries.move_to_end(key)
         self.hits += 1
         self.hits_by_model[key[0]] = self.hits_by_model.get(key[0], 0) + 1
-        return entry
+        return entry[0]
 
     @staticmethod
     def _nbytes(v: np.ndarray) -> int:
@@ -151,7 +178,8 @@ class _ResponseCache:
         return sum(len(x) if isinstance(x, (bytes, str)) else 64
                    for x in v.reshape(-1))
 
-    def put(self, key: tuple, outputs: Dict[str, Any]) -> None:
+    def put(self, key: tuple, outputs: Dict[str, Any],
+            ttl_s: Optional[float] = None) -> None:
         total = 0
         for v in outputs.values():
             if not isinstance(v, np.ndarray):
@@ -159,6 +187,8 @@ class _ResponseCache:
             total += self._nbytes(v)
         if total > self.MAX_ITEM_BYTES:
             return
+        if self.budget_bytes and total > self.budget_bytes:
+            return  # larger than the whole budget: caching it is churn
         # freeze private copies: the cache must not mutate the caller's live
         # arrays (a model may retain/reuse its output buffer), and mutation
         # of a cached entry must raise rather than corrupt later hits
@@ -167,10 +197,22 @@ class _ResponseCache:
             v = v.copy()
             v.flags.writeable = False
             frozen[n] = v
-        self._entries[key] = frozen
-        self._entries.move_to_end(key)
-        while len(self._entries) > self.MAX_ENTRIES:
-            self._entries.popitem(last=False)
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._total_bytes -= old[2]  # replacement, not an eviction
+        expires = (time.monotonic() + ttl_s
+                   if ttl_s is not None and ttl_s > 0 else None)
+        self._entries[key] = (frozen, expires, total)
+        self._total_bytes += total
+        while len(self._entries) > self.MAX_ENTRIES or (
+                self.budget_bytes
+                and self._total_bytes > self.budget_bytes):
+            k, entry = self._entries.popitem(last=False)
+            self._evict(k, entry)
+
+    @property
+    def total_bytes(self) -> int:
+        return self._total_bytes
 
 
 class _DynamicBatcher:
@@ -183,9 +225,14 @@ class _DynamicBatcher:
     of shapes, executes once, splits results.
 
     Queue items are ``(inputs, params, fut, enqueue_ns, trace,
-    deadline_ns)``; an item whose deadline already passed is dropped at
-    dequeue and again at batch assembly — zero compute for a request whose
-    client gave up while it queued.
+    deadline_ns, (tenant, tier))``; an item whose deadline already passed
+    is dropped at dequeue and again at batch assembly — zero compute for a
+    request whose client gave up while it queued.
+
+    The queue is the QoS layer's :class:`TieredQueue`: strict-priority (or
+    weighted-fair) dequeue across tiers, FIFO within one, with the
+    best-effort lane preemptible under admission pressure (see
+    ``InferenceCore._admit``).
     """
 
     # Batches in flight concurrently: device dispatch is async, so letting
@@ -200,7 +247,8 @@ class _DynamicBatcher:
         self._max_delay_s = dbcfg.max_queue_delay_microseconds / 1e6
         self._buckets = sorted(dbcfg.preferred_batch_size) or []
         self._max_bs = model.config.max_batch_size
-        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queue: TieredQueue = TieredQueue(
+            core.qos.tiers, weights=core.qos.weights)
         self._task: Optional[asyncio.Task] = None
         self._inflight = asyncio.Semaphore(self.MAX_INFLIGHT)
         self._batch_tasks: set = set()
@@ -214,12 +262,13 @@ class _DynamicBatcher:
 
     async def submit(self, inputs: Dict[str, np.ndarray],
                      parameters: Dict[str, Any], trace=None,
-                     deadline_ns: int = 0):
+                     deadline_ns: int = 0, tenant: str = "",
+                     tier: int = 0):
         fut = asyncio.get_running_loop().create_future()
         self.start()
         await self._queue.put(
             (inputs, parameters, fut, time.monotonic_ns(), trace,
-             deadline_ns))
+             deadline_ns, (tenant, tier)), tier=tier)
         return await fut
 
     def _drop_if_expired(self, item) -> bool:
@@ -286,7 +335,8 @@ class _DynamicBatcher:
             # shutdown mid-batch: fail whatever we were holding
             if carry is not None:
                 pending.append(carry)
-            for _inputs, _params, fut, _ts, _trace, _dl in pending:
+            for item in pending:
+                fut = item[2]
                 if not fut.done():
                     fut.set_exception(InferError("server is shutting down", 503))
             raise
@@ -318,7 +368,8 @@ class _DynamicBatcher:
         names = list(pending[0][0].keys())
         traces = [p[4] for p in pending if p[4] is not None]
         t_asm0 = time.monotonic_ns()
-        for _inputs, _params, _fut, ts, trace, _dl in pending:
+        for item in pending:
+            ts, trace = item[3], item[4]
             if trace is not None:
                 # this request's wait from enqueue until its batch formed
                 trace.add_span("QUEUE", ts, t_asm0)
@@ -346,7 +397,8 @@ class _DynamicBatcher:
             self._model.stats.record(total, queue_ns, compute_ns, ok=True)
             self._model.stats.record_batch(total)
             offset = 0
-            for (inputs, _params, fut, _ts, _trace, _dl), count in zip(pending, counts):
+            for item, count in zip(pending, counts):
+                fut = item[2]
                 part = {
                     n: v[offset : offset + count] for n, v in outputs.items()
                 }
@@ -355,9 +407,23 @@ class _DynamicBatcher:
                     fut.set_result(part)
         except Exception as e:
             self._model.stats.record(total, 0, 0, ok=False)
-            for _inputs, _params, fut, _ts, _trace, _dl in pending:
+            for item in pending:
+                fut = item[2]
                 if not fut.done():
                     fut.set_exception(e)
+
+
+def _model_cache_ttl(model: Model) -> Optional[float]:
+    """Per-model response-cache TTL from the config's
+    ``response_cache.ttl_s`` parameter (None = entries never expire)."""
+    if "response_cache.ttl_s" not in model.config.parameters:
+        return None
+    try:
+        ttl = float(model.config.parameters[
+            "response_cache.ttl_s"].string_value)
+    except ValueError:
+        return None
+    return ttl if ttl > 0 else None
 
 
 def _batch_count(inputs: Dict[str, np.ndarray]) -> int:
@@ -425,9 +491,15 @@ class InferenceCore:
         # parameter, then this default (0 = unbounded).
         self.default_max_queue_size = 0
         self.queue_limits: Dict[str, int] = {}
-        # pushback horizon handed to shed clients (Retry-After header /
-        # retry-after-ms gRPC trailing metadata)
+        # base pushback horizon handed to shed clients (Retry-After header
+        # / retry-after-ms gRPC trailing metadata); the actual horizon is
+        # depth-proportional — QosManager.pushback_s scales it with the
+        # shed tier's queue depth
         self.shed_retry_after_s = 0.25
+        # multi-tenant QoS policy: priority tiers, per-tenant token
+        # buckets, preemptible best-effort lane (server/qos.py).  The
+        # default config is inert for priority-0 anonymous traffic.
+        self.qos = QosManager()
         # optional fault injector (server/chaos.py; --chaos CLI flags)
         self.chaos = None
         # counters backing nv_inference_rejected_total /
@@ -461,22 +533,88 @@ class InferenceCore:
                 pass
         return self.default_max_queue_size
 
-    def _admit(self, model: Model) -> None:
-        """Admission control at request entry: refuse during drain, shed
-        when the model's pending queue is at its bound — load the server
-        cannot serve in time is cheaper to reject now than to time out
-        later (Tail at Scale: load shedding keeps p99.9 bounded)."""
+    def _count_shed(self, model: Model, tenant: str, tier: int) -> None:
+        self.rejected_by_model[model.name] = \
+            self.rejected_by_model.get(model.name, 0) + 1
+        self.qos.count_rejected(model.name, tenant, tier)
+
+    def _tier_depth(self, model: Model, tier: int) -> int:
+        """The shed tier's backlog for pushback scaling: its batcher lane
+        depth when the model batches, else the model's pending gauge."""
+        b = self._batchers.get(f"{model.name}@{model.served_version}")
+        if b is not None and b._queue.qsize():
+            return b._queue.depth(tier)
+        return model.stats.pending_count
+
+    def _admit(self, model: Model, request: InferRequest) -> None:
+        """Admission control at request entry: refuse during drain, rate-
+        limit per tenant, and shed by QoS tier when the model's pending
+        queue is at that tier's bound — load the server cannot serve in
+        time is cheaper to reject now than to time out later (Tail at
+        Scale), and under overload the best-effort lane absorbs the
+        shedding so tier 0 keeps its latency.
+
+        Tier resolution happens here (priority -> tier, tenant default)
+        so every downstream consumer — batcher lanes, flight records,
+        metrics labels — sees the same classification."""
         if not self.accepting:
             raise InferError("server is shutting down", http_status=503,
                              retry_after_s=self.shed_retry_after_s)
-        limit = self.max_queue_size(model)
-        if limit > 0 and model.stats.pending_count >= limit:
-            self.rejected_by_model[model.name] = \
-                self.rejected_by_model.get(model.name, 0) + 1
+        qos = self.qos
+        request.tier = qos.tier_of(request.priority)
+        if not request.tenant:
+            request.tenant = DEFAULT_TENANT
+        qos.count_request(request.tenant, request.tier)
+        retry_in = qos.admit_tenant(request.tenant)
+        if retry_in is not None:
+            self._count_shed(model, request.tenant, request.tier)
+            # the bucket's own horizon (1-tokens)/rate IS the pushback —
+            # it says exactly when a token frees up; flooring it at the
+            # queue-shed base would make fast-refilling tenants wait
+            # longer than the limiter requires
             raise InferError(
-                f"request queue for model '{model.name}' is full "
-                f"({limit} pending); retry later",
-                http_status=429, retry_after_s=self.shed_retry_after_s)
+                f"tenant '{request.tenant}' is over its rate limit for "
+                f"model '{model.name}'; retry later",
+                http_status=429, retry_after_s=retry_in)
+        limit = self.max_queue_size(model)
+        if limit <= 0:
+            return
+        if model.stats.pending_count < qos.tier_limit(request.tier, limit):
+            return
+        # over this tier's threshold.  A non-best-effort arrival at a FULL
+        # queue (not merely its own threshold — while free slots remain,
+        # shedding the arrival is cheaper than evicting admitted work) may
+        # still enter by preempting the newest queued item from the LOWEST
+        # lane strictly below it (best effort drains first); the victim
+        # gets the same 429 + pushback a front-door shed produces, and the
+        # slot transfers.
+        if (request.tier < qos.best_effort_tier
+                and model.stats.pending_count >= limit):
+            b = self._batchers.get(f"{model.name}@{model.served_version}")
+            victim = (b._queue.preempt_lower(request.tier)
+                      if b is not None else None)
+            if victim is not None:
+                v_tenant, v_tier = victim[6]
+                self._count_shed(model, v_tenant or DEFAULT_TENANT, v_tier)
+                fut = victim[2]
+                if not fut.done():
+                    fut.set_exception(InferError(
+                        f"request to model '{model.name}' preempted by "
+                        f"higher-priority traffic (tier {v_tier}); retry "
+                        "later", http_status=429,
+                        retry_after_s=qos.pushback_s(
+                            self.shed_retry_after_s,
+                            self._tier_depth(model, v_tier), limit)))
+                return
+        self._count_shed(model, request.tenant, request.tier)
+        raise InferError(
+            f"request queue for model '{model.name}' is full for tier "
+            f"{request.tier} ({model.stats.pending_count} pending, tier "
+            f"limit {qos.tier_limit(request.tier, limit)}); retry later",
+            http_status=429,
+            retry_after_s=qos.pushback_s(
+                self.shed_retry_after_s,
+                self._tier_depth(model, request.tier), limit))
 
     def _check_deadline(self, model: Model, request: InferRequest) -> None:
         """Drop an already-expired request before any compute (proper v2
@@ -515,7 +653,7 @@ class InferenceCore:
                 f"doesn't support models with decoupled transaction policy",
                 http_status=400,
             )
-        self._admit(model)
+        self._admit(model, request)
         return await self._infer_on(model, request)
 
     async def _infer_on(self, model: Model, request: InferRequest) -> InferResponse:
@@ -634,7 +772,9 @@ class InferenceCore:
                 trace.ts("COMPUTE_START", t0)
                 trace.add_span("QUEUE", request.arrival_ns, t0)
             try:
-                outputs = await self._run_ensemble(model, inputs, params)
+                outputs = await self._run_ensemble(
+                    model, inputs, params,
+                    tenant=request.tenant, tier=request.tier)
             except Exception:
                 model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
                 raise
@@ -650,7 +790,8 @@ class InferenceCore:
             # (every traced member of a batch carries the same COMPUTE span).
             outputs = await self._batcher(model).submit(
                 inputs, params, trace=trace,
-                deadline_ns=request.deadline_ns)
+                deadline_ns=request.deadline_ns,
+                tenant=request.tenant, tier=request.tier)
         else:
             # Outputs bound to slot-backed (in-process) xla-shm regions stay
             # device-resident — zero-copy handoff into the region.  Staging
@@ -681,7 +822,8 @@ class InferenceCore:
                 trace.ts("COMPUTE_END", t0 + compute_ns)
             model.stats.record(_batch_count(inputs) or 1, queue_ns, compute_ns, ok=True)
         if cache_key is not None:
-            self.response_cache.put(cache_key, dict(outputs))
+            self.response_cache.put(cache_key, dict(outputs),
+                                    ttl_s=_model_cache_ttl(model))
         return self._build_response(model, request, outputs)
 
     async def infer_stream(self, request: InferRequest) -> AsyncIterator[InferResponse]:
@@ -694,7 +836,7 @@ class InferenceCore:
         # admission gates EVERY stream entry (decoupled or not): the gRPC
         # bidi path reaches the core only through here, and a saturated or
         # draining server must refuse streamed requests like unary ones
-        self._admit(model)
+        self._admit(model, request)
         if not model.decoupled:
             yield await self._infer_on(model, request)
             return
@@ -962,7 +1104,7 @@ class InferenceCore:
             await asyncio.gather(*list(b._batch_tasks),
                                  return_exceptions=True)
         while not b._queue.empty():
-            _inputs, _params, fut, _ts, _trace, _dl = b._queue.get_nowait()
+            fut = b._queue.get_nowait()[2]
             if not fut.done():
                 fut.set_exception(InferError(reason, 503))
 
@@ -1057,7 +1199,8 @@ class InferenceCore:
 
         return await loop.run_in_executor(None, _exec_timed)
 
-    async def _run_ensemble(self, model: EnsembleModel, inputs, params) -> Dict[str, Any]:
+    async def _run_ensemble(self, model: EnsembleModel, inputs, params,
+                            tenant: str = "", tier: int = 0) -> Dict[str, Any]:
         """Execute the ensemble DAG: tensors flow between steps through
         input_map/output_map (reference ensemble behavior, §2.7).
 
@@ -1085,7 +1228,9 @@ class InferenceCore:
                     "are never produced"
                 )
             results = await asyncio.gather(
-                *(self._run_ensemble_step(model, s, pool, params) for s in ready))
+                *(self._run_ensemble_step(model, s, pool, params,
+                                          tenant=tenant, tier=tier)
+                  for s in ready))
             for step, outs in zip(ready, results):
                 for member_output, pool_name in step.output_map.items():
                     if member_output not in outs:
@@ -1111,7 +1256,8 @@ class InferenceCore:
         return await loop.run_in_executor(None, _resolve_final)
 
     async def _run_ensemble_step(
-        self, model: EnsembleModel, step, pool: Dict[str, Any], params
+        self, model: EnsembleModel, step, pool: Dict[str, Any], params,
+        tenant: str = "", tier: int = 0
     ) -> Dict[str, Any]:
         member = self.registry.get(step.model_name)
         step_inputs = {
@@ -1135,8 +1281,12 @@ class InferenceCore:
             member_params = {k: v for k, v in params.items()
                              if k not in ("sequence_id", "sequence_start",
                                           "sequence_end")}
-            # the batcher records the member's stats for the merged batch
-            return await self._batcher(member).submit(step_inputs, member_params)
+            # the batcher records the member's stats for the merged batch;
+            # the ensemble request's QoS identity rides along so member
+            # work queues in the SAME tier lane the front door classified
+            # (a best-effort ensemble must not jump the member's queue)
+            return await self._batcher(member).submit(
+                step_inputs, member_params, tenant=tenant, tier=tier)
         t0 = time.monotonic_ns()
         try:
             outs = await self._run_model(member, step_inputs, params)
@@ -1286,6 +1436,17 @@ class InferenceCore:
                 out.append(s.encode("utf-8"))
         shape = (rows.shape[0], k) if batched else (k,)
         return np.array(out, dtype=np.object_).reshape(shape)
+
+    def qos_queue_depths(self) -> Dict[Tuple[str, int], int]:
+        """Live batcher lane depths keyed ``(model, tier)`` — the
+        ``nv_qos_queue_depth`` gauge.  Versions of one name sum (metrics
+        are per model name, like the cache counters)."""
+        out: Dict[Tuple[str, int], int] = {}
+        for key, b in list(self._batchers.items()):
+            name = key.rsplit("@", 1)[0]
+            for tier, depth in enumerate(b._queue.depths()):
+                out[(name, tier)] = out.get((name, tier), 0) + depth
+        return out
 
     # ------------------------------------------------------------------
     def server_metadata(self) -> dict:

@@ -91,11 +91,12 @@ class FlightRecord:
     __slots__ = ("seq", "request_id", "model", "version", "protocol",
                  "batch", "bytes_in", "bytes_out", "arrival_ns", "ts",
                  "queue_us", "compute_us", "total_us", "outcome",
-                 "capture_reason", "spans", "chaos")
+                 "capture_reason", "spans", "chaos", "tenant", "tier")
 
     def __init__(self, seq: int, model: str, version: str,
                  request_id: str = "", protocol: str = "",
-                 batch: int = 1, bytes_in: int = 0) -> None:
+                 batch: int = 1, bytes_in: int = 0,
+                 tenant: str = "", tier: int = 0) -> None:
         self.seq = seq
         self.request_id = request_id
         self.model = model
@@ -116,6 +117,10 @@ class FlightRecord:
         # ("latency"/"error"/"abort") — injected requests are always
         # pinned as outliers so chaos weather is tellable from real
         self.chaos: Optional[str] = None
+        # QoS identity (server/qos.py): which tenant sent it, which
+        # priority tier it rode — triton-top's per-tenant view reads these
+        self.tenant = tenant
+        self.tier = tier
 
     def to_dict(self, include_spans: bool = False) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -135,6 +140,8 @@ class FlightRecord:
             "captured": self.capture_reason is not None,
             "capture_reason": self.capture_reason,
             "chaos": self.chaos,
+            "tenant": self.tenant,
+            "tier": self.tier,
         }
         if include_spans:
             out["spans"] = self.spans or []
@@ -230,7 +237,9 @@ class FlightRecorder:
         return FlightRecord(
             next(self._seq), model_name, version,
             request_id=request.client_request_id or request.id,
-            protocol=request.protocol, batch=batch, bytes_in=bytes_in)
+            protocol=request.protocol, batch=batch, bytes_in=bytes_in,
+            tenant=getattr(request, "tenant", ""),
+            tier=getattr(request, "tier", 0))
 
     def complete(self, record: FlightRecord, trace) -> None:
         """Close a record from its finished span tree: fill durations,

@@ -29,9 +29,10 @@ from ..utils import (
 )
 from .core import InferenceCore
 from .model import datatype_to_pb
+from .qos import tenant_from_headers
 from .types import (InferError, InferRequest, InputTensor,
                     RequestedOutput, ShmRef, apply_request_deadline,
-                    reshape_input)
+                    apply_request_priority, reshape_input)
 
 
 def pb_param_to_py(p: pb.InferParameter):
@@ -53,9 +54,11 @@ def py_to_pb_param(value) -> pb.InferParameter:
 
 
 def _read_trace_metadata(req: InferRequest, context) -> None:
-    """Fill the request's trace-propagation fields from invocation metadata
-    (`triton-request-id` / `traceparent`, stamped by the instrumented
-    clients)."""
+    """Fill the request's trace-propagation and QoS-identity fields from
+    invocation metadata (`triton-request-id` / `traceparent` stamped by
+    the instrumented clients; `triton-tenant` / `authorization` resolving
+    the tenant, same precedence as the HTTP frontend)."""
+    tenant_hdr = auth_hdr = None
     try:
         md = context.invocation_metadata() or ()
         for key, value in md:
@@ -63,8 +66,13 @@ def _read_trace_metadata(req: InferRequest, context) -> None:
                 req.client_request_id = value
             elif key == "traceparent":
                 req.traceparent = value
+            elif key == "triton-tenant":
+                tenant_hdr = value
+            elif key == "authorization":
+                auth_hdr = value
     except Exception:
         pass  # metadata unavailable (e.g. gRPC-Web bridge test doubles)
+    req.tenant = tenant_from_headers(tenant_hdr, auth_hdr)
 
 
 def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
@@ -75,8 +83,10 @@ def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
         parameters={k: pb_param_to_py(v) for k, v in request.parameters.items()},
     )
     # the v2 `timeout` parameter (µs) becomes the request's absolute
-    # deadline; expired requests are dropped at dequeue with zero compute
+    # deadline; expired requests are dropped at dequeue with zero compute.
+    # `priority` (0 = highest) is consumed into the QoS tier the same way
     apply_request_deadline(req)
+    apply_request_priority(req)
     raw = list(request.raw_input_contents)
     # raw_input_contents carries entries ONLY for non-shm inputs, in input
     # order (reference wire semantics: grpc/_utils.py packs raw buffers in a

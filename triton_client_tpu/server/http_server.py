@@ -29,9 +29,10 @@ from ..utils import (
     triton_to_np_dtype,
 )
 from .core import InferenceCore
+from .qos import tenant_from_headers
 from .types import (InferError, InferRequest, InputTensor,
                     RequestedOutput, ShmRef, apply_request_deadline,
-                    reshape_input)
+                    apply_request_priority, reshape_input)
 
 _HEADER_LEN = "Inference-Header-Content-Length"
 _REQUEST_ID_HDR = "triton-request-id"
@@ -40,6 +41,18 @@ _TRACEPARENT_HDR = "traceparent"
 # the v2 `timeout` parameter; restamped per retry attempt by the client
 # resilience layer)
 _TIMEOUT_HDR = "triton-timeout-us"
+# QoS tenant id (falls back to the basic-auth username, then "anonymous")
+_TENANT_HDR = "triton-tenant"
+
+
+def _stamp_qos(req: InferRequest, request: web.Request) -> None:
+    """Resolve the request's QoS identity: tenant from the triton-tenant
+    header / basic-auth username, priority consumed out of the v2
+    ``priority`` parameter (0 = highest)."""
+    req.tenant = tenant_from_headers(
+        request.headers.get(_TENANT_HDR),
+        request.headers.get("Authorization"))
+    apply_request_priority(req)
 
 
 def build_app(core: InferenceCore) -> web.Application:
@@ -330,6 +343,7 @@ async def _build_generate(core, request):
         raise InferError("failed to parse generate request JSON", 400)
     req = build_generate_request(model, name, version, body)
     req.protocol = "http"
+    _stamp_qos(req, request)
     return name, version, model, req
 
 
@@ -523,6 +537,7 @@ async def _infer(core, request: web.Request) -> web.Response:
     # deadline propagation: the triton-timeout-us header (the restamped
     # remaining budget) wins over the body's `timeout` parameter
     apply_request_deadline(req, header_us=request.headers.get(_TIMEOUT_HDR))
+    _stamp_qos(req, request)
     resp = await core.infer(req)
     trace = resp.trace
     try:

@@ -105,6 +105,9 @@ def render_prometheus(core: InferenceCore) -> str:
          "Number of response cache hits per model", cache.hits_by_model),
         ("nv_cache_num_misses_per_model",
          "Number of response cache misses per model", cache.misses_by_model),
+        ("nv_cache_num_evictions_per_model",
+         "Number of response cache entries evicted per model (LRU, byte "
+         "budget, or TTL expiry)", cache.evictions_by_model),
         ("nv_inference_slow_request_total",
          "Number of requests that exceeded the flight recorder's "
          "slow-request threshold", slow_by_model),
@@ -112,12 +115,8 @@ def render_prometheus(core: InferenceCore) -> str:
          "Number of requests pinned into the flight recorder's outlier "
          "buffer (slow or failed) with a full span tree",
          captured_by_model),
-        # resilience layer: admission-control sheds and deadline drops
-        # (dict copies — the core bumps these on the event loop while a
-        # scrape iterates here)
-        ("nv_inference_rejected_total",
-         "Number of inference requests shed by admission control "
-         "(model queue at max_queue_size)", dict(core.rejected_by_model)),
+        # resilience layer: deadline drops (dict copy — the core bumps
+        # these on the event loop while a scrape iterates here)
         ("nv_inference_deadline_exceeded_total",
          "Number of inference requests dropped because their deadline "
          "expired before execution", dict(core.deadline_exceeded_by_model)),
@@ -132,4 +131,32 @@ def render_prometheus(core: InferenceCore) -> str:
         lines.append(f"# TYPE {name} counter")
         for model, value in sorted(counts.items()):
             lines.append(f'{name}{{model="{_escape_label(model)}"}} {value}')
+
+    # -- QoS families (server/qos.py) -------------------------------------
+    # sheds carry the full (model, tenant, tier) classification so a
+    # dashboard can answer "who is being shed, at what priority, where"
+    lines.append("# HELP nv_inference_rejected_total Number of inference "
+                 "requests shed by admission control (tenant rate limit, "
+                 "tier queue threshold, or lower-tier preemption)")
+    lines.append("# TYPE nv_inference_rejected_total counter")
+    for (model, tenant, tier), value in sorted(
+            core.qos.rejected_counts().items()):
+        lines.append(
+            f'nv_inference_rejected_total{{model="{_escape_label(model)}",'
+            f'tenant="{_escape_label(tenant)}",tier="{tier}"}} {value}')
+    lines.append("# HELP nv_qos_tenant_requests_total Number of inference "
+                 "requests per tenant and QoS tier (admitted or shed)")
+    lines.append("# TYPE nv_qos_tenant_requests_total counter")
+    for (tenant, tier), value in sorted(
+            core.qos.tenant_request_counts().items()):
+        lines.append(
+            f'nv_qos_tenant_requests_total{{tenant="{_escape_label(tenant)}"'
+            f',tier="{tier}"}} {value}')
+    lines.append("# HELP nv_qos_queue_depth Requests currently queued in "
+                 "the dynamic batcher per model and QoS tier")
+    lines.append("# TYPE nv_qos_queue_depth gauge")
+    for (model, tier), value in sorted(core.qos_queue_depths().items()):
+        lines.append(
+            f'nv_qos_queue_depth{{model="{_escape_label(model)}",'
+            f'tier="{tier}"}} {value}')
     return "\n".join(lines) + "\n"

@@ -24,6 +24,7 @@ import numpy as np
 from aiohttp import web
 
 from .core import InferenceCore
+from .qos import tenant_from_headers
 from .types import InferError, InferRequest, InputTensor, RequestedOutput
 
 _COUNTER = iter(range(1, 1 << 62))
@@ -289,6 +290,12 @@ def _build_request(core, body: Dict[str, Any], prompt: str,
         raise InferError(
             "'echo' with 'logprobs' is not supported (prompt-token "
             "logprobs are not computed)")
+    # QoS priority (extension beyond OpenAI, like top_k): v2 semantics,
+    # 0 = highest, large values ride the preemptible best-effort lane
+    priority = body.get("priority", 0)
+    if (not isinstance(priority, int) or isinstance(priority, bool)
+            or priority < 0):
+        raise InferError("'priority' must be a non-negative integer")
     parameters: Dict[str, Any] = {}
     try:
         max_tokens = body.get("max_tokens")
@@ -337,6 +344,7 @@ def _build_request(core, body: Dict[str, Any], prompt: str,
                 data=np.asarray([prompt.encode()], dtype=object))],
             outputs=outputs,
             parameters=p,
+            priority=priority,
         ))
     return _ParsedRequest(model_name, reqs, stops, want_logprobs,
                           n, best_of, echo, _parse_stream_options(body))
@@ -444,6 +452,13 @@ async def _run(core, request, chat: bool):
         if not isinstance(prompt, str):
             raise InferError("'prompt' must be a string")
     pr = _build_request(core, body, prompt, chat)
+    # QoS identity: same resolution as the native HTTP endpoints, so an
+    # OpenAI caller's tenant bucket / tier classification matches what the
+    # v2 surface would give the same credentials
+    tenant = tenant_from_headers(request.headers.get("triton-tenant"),
+                                 request.headers.get("Authorization"))
+    for req in pr.reqs:
+        req.tenant = tenant
     model_name, reqs, stops = pr.model_name, pr.reqs, pr.stops
     want_logprobs = pr.want_logprobs
     rid = f"cmpl-{next(_COUNTER)}"

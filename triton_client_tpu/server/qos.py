@@ -1,0 +1,414 @@
+"""Multi-tenant QoS: priority tiers, per-tenant token buckets, and the
+tiered queue behind the dynamic batcher.
+
+The v2 protocol defines a per-request ``priority`` parameter and the
+reference server honors it with per-model queue policies; until this module
+the reproduction accepted the parameter and ignored it — under overload a
+single abusive tenant starved everyone equally.  This is the server half of
+the QoS layer (ROADMAP open item 4):
+
+* **Priority tiers.** The request ``priority`` (0 = highest, Triton's v2
+  numbering per this framework's contract) maps onto ``tiers`` classes;
+  the last tier is the **preemptible best-effort lane**.  Mapping is
+  ``tier = min(priority, tiers - 1)``.
+* **Per-tenant token buckets.** The tenant id comes from the
+  ``triton-tenant`` header (both frontends) or the basic-auth username,
+  falling back to ``"anonymous"``.  A configured rate (requests/s, with a
+  burst allowance) sheds a tenant's excess with 429 + ``Retry-After``
+  *before* it can occupy queue slots another tenant paid for.
+* **Tier-aware admission.** Each tier may only fill a fraction of the
+  model's ``max_queue_size``: tier 0 up to 100%, best-effort up to
+  ``best_effort_fraction`` (default 50%), intermediate tiers on the line
+  between.  Under sustained overload the best-effort lane is therefore
+  shed *first* and tier 0 keeps headroom — graceful degradation instead of
+  FIFO fairness-in-failure.
+* **Preemption.** When a high-tier request arrives at a *full* queue, the
+  newest queued request from the lowest lane strictly below it is evicted
+  (its caller gets the same 429 + pushback a front-door shed produces)
+  and the high-tier request takes the slot — best effort drains first,
+  then intermediate tiers, so tier 0 always wins a contested slot.
+* **Depth-proportional pushback.** ``Retry-After`` scales with the shed
+  tier's queue depth — a client bounced off a barely-full queue retries
+  soon; one bounced off a deep backlog backs off proportionally longer.
+
+Dequeue order inside the batcher is strict priority by default (tier 0
+drains first; FIFO within a tier) or weighted-fair when ``weights`` are
+configured — weights give every tier a guaranteed share so a saturated
+tier 0 cannot starve tier 1 forever.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["TokenBucket", "TieredQueue", "QosManager", "DEFAULT_TENANT"]
+
+DEFAULT_TENANT = "anonymous"
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill, ``burst`` capacity.
+
+    ``acquire()`` returns ``None`` when a token was taken, else the
+    seconds until one becomes available (the pushback horizon).  Thread-
+    safe: the HTTP frontend calls it from the event loop, tests from
+    anywhere."""
+
+    __slots__ = ("rate", "burst", "_tokens", "_stamp", "_lock")
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        if rate <= 0:
+            raise ValueError(f"token bucket rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        # burst floors at one token: acquire() needs a full token, so a
+        # sub-1.0 capacity would deny every request forever instead of
+        # rate-limiting — clamp rather than reject so a CLI like
+        # `gold=100:0.5` degrades to burst 1, not total denial
+        self.burst = max(1.0, float(burst)) if burst is not None else max(
+            1.0, self.rate)
+        self._tokens = self.burst
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self, now: Optional[float] = None) -> Optional[float]:
+        with self._lock:
+            if now is None:
+                now = time.monotonic()
+            elapsed = max(0.0, now - self._stamp)
+            self._stamp = now
+            self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return None
+            return (1.0 - self._tokens) / self.rate
+
+
+class TieredQueue:
+    """Multi-lane asyncio queue with strict-priority or weighted-fair
+    dequeue, plus preemption of queued low-tier items.
+
+    API mirrors the slice of ``asyncio.Queue`` the dynamic batcher uses
+    (``put``/``get``/``get_nowait``/``empty``/``qsize``) so it drops in as
+    the batcher's queue; items additionally carry a tier.  Single event
+    loop only (the batcher's pump task is the lone consumer)."""
+
+    def __init__(self, tiers: int, weights: Optional[List[int]] = None):
+        self._tiers = max(1, int(tiers))
+        self._lanes: List[deque] = [deque() for _ in range(self._tiers)]
+        self._getters: deque = deque()
+        if weights is not None:
+            if len(weights) != self._tiers:
+                raise ValueError(
+                    f"need {self._tiers} weights, got {len(weights)}")
+            if any(w <= 0 for w in weights):
+                raise ValueError("tier weights must be positive")
+        self._weights = list(weights) if weights is not None else None
+        # weighted-fair state: the lane currently holding the floor and
+        # how many consecutive pops it has left before yielding
+        self._wf_lane = 0
+        self._wf_credit = self._weights[0] if self._weights else 0
+
+    # -- queue surface -----------------------------------------------------
+    def empty(self) -> bool:
+        return all(not lane for lane in self._lanes)
+
+    def qsize(self) -> int:
+        return sum(len(lane) for lane in self._lanes)
+
+    def depth(self, tier: int) -> int:
+        """Queued items in one tier's lane."""
+        return len(self._lanes[self._clamp(tier)])
+
+    def depths(self) -> List[int]:
+        return [len(lane) for lane in self._lanes]
+
+    def _clamp(self, tier: int) -> int:
+        return min(max(int(tier), 0), self._tiers - 1)
+
+    def put_nowait(self, item, tier: int = 0) -> None:
+        self._lanes[self._clamp(tier)].append(item)
+        self._wakeup_next()
+
+    async def put(self, item, tier: int = 0) -> None:
+        # unbounded, like the batcher's previous asyncio.Queue — admission
+        # control bounds depth before anything reaches here
+        self.put_nowait(item, tier)
+
+    def _wakeup_next(self) -> None:
+        while self._getters:
+            getter = self._getters.popleft()
+            if not getter.done():
+                getter.set_result(None)
+                break
+
+    async def get(self):
+        """Pop the next item per the dequeue policy; awaits when empty.
+        Cancellation-safe under ``asyncio.wait_for`` (same discipline as
+        ``asyncio.Queue.get``: a wakeup consumed by a cancelled getter is
+        re-handed to the next waiter)."""
+        while self.empty():
+            getter = asyncio.get_running_loop().create_future()
+            self._getters.append(getter)
+            try:
+                await getter
+            except BaseException:
+                getter.cancel()
+                try:
+                    self._getters.remove(getter)
+                except ValueError:
+                    pass
+                if not self.empty() and not getter.cancelled():
+                    self._wakeup_next()
+                raise
+        return self._pop()
+
+    def get_nowait(self):
+        if self.empty():
+            raise asyncio.QueueEmpty()
+        return self._pop()
+
+    def _pop(self):
+        if self._weights is None:
+            for lane in self._lanes:
+                if lane:
+                    return lane.popleft()
+            raise asyncio.QueueEmpty()
+        # deficit-style weighted fair: the floor-holding lane pops up to
+        # its weight in a row while nonempty, then the floor rotates —
+        # every tier with queued work gets weight[i]/sum(weights) of
+        # pops.  tiers+1 iterations: the worst case (the only nonempty
+        # lane holds the floor with spent credit) rotates the full ring
+        # before landing back on it with fresh credit.
+        for _ in range(self._tiers + 1):
+            lane = self._lanes[self._wf_lane]
+            if lane and self._wf_credit > 0:
+                self._wf_credit -= 1
+                return lane.popleft()
+            self._wf_lane = (self._wf_lane + 1) % self._tiers
+            self._wf_credit = self._weights[self._wf_lane]
+        raise asyncio.QueueEmpty()  # pragma: no cover - emptiness guarded
+
+    # -- preemption --------------------------------------------------------
+    def preempt_lower(self, tier: int):
+        """Evict the NEWEST queued item from the LOWEST nonempty lane
+        strictly below ``tier``, on behalf of an arrival at ``tier``.
+        Returns the evicted item or None when nothing outranked is
+        queued.  The best-effort lane therefore drains first; queued
+        intermediate-tier work is only ever evicted for a strictly
+        higher class once best effort is empty — and tier 0 can always
+        claim a full queue's slot while ANY lower-priority work is
+        queued.  Newest-first within the victim lane: the request that
+        waited least loses least."""
+        floor = self._clamp(tier)
+        for lane_idx in range(self._tiers - 1, floor, -1):
+            lane = self._lanes[lane_idx]
+            if lane:
+                return lane.pop()
+        return None
+
+
+class QosManager:
+    """Per-core QoS policy + counters.
+
+    Defaults are fully backwards-compatible: no tenant rate configured
+    means no tenant is ever rate-limited, and with every request at
+    priority 0 the tier machinery reduces to the previous FIFO behavior
+    (single active lane, tier-0 threshold == ``max_queue_size``).
+
+    Counters (bumped on the event loop / under the GIL, read by the
+    metrics renderer):
+
+    * ``tenant_requests[(tenant, tier)]`` — every admitted-or-not request
+      (``nv_qos_tenant_requests_total``),
+    * ``rejected[(model, tenant, tier)]`` — QoS sheds: tenant-bucket,
+      tier-threshold, and preemption evictions
+      (``nv_inference_rejected_total`` labels).
+
+    Tenant cardinality is client-controlled (the header is arbitrary), so
+    at most ``MAX_TRACKED_TENANTS`` distinct tenants are tracked; beyond
+    that, new identities fold into the ``"~overflow"`` pseudo-tenant for
+    counters AND rate buckets — a rotating-tenant flood cannot grow the
+    metric surface (or dodge rate limiting) without bound.
+    """
+
+    #: Distinct tenant identities tracked before folding into ~overflow.
+    MAX_TRACKED_TENANTS = 1024
+    OVERFLOW_TENANT = "~overflow"
+
+    def __init__(
+        self,
+        tiers: int = 4,
+        tenant_rate: float = 0.0,
+        tenant_burst: Optional[float] = None,
+        tenant_rates: Optional[Dict[str, Tuple[float, Optional[float]]]] = None,
+        best_effort_fraction: float = 0.5,
+        weights: Optional[List[int]] = None,
+    ):
+        if tiers < 1:
+            raise ValueError("need at least one QoS tier")
+        if not 0.0 < best_effort_fraction <= 1.0:
+            raise ValueError(
+                "best_effort_fraction must be in (0, 1], got "
+                f"{best_effort_fraction}")
+        self.tiers = int(tiers)
+        self.tenant_rate = float(tenant_rate)      # 0 = unlimited
+        self.tenant_burst = tenant_burst
+        # per-tenant overrides: tenant -> (rate, burst); rate 0 = unlimited
+        self.tenant_rates: Dict[str, Tuple[float, Optional[float]]] = \
+            dict(tenant_rates or {})
+        self.best_effort_fraction = float(best_effort_fraction)
+        if weights is not None:
+            # validated HERE, not first-batcher-construction: a bad
+            # --qos-weights must fail at startup, not 500 the first
+            # request to a dynamic-batching model
+            if len(weights) != self.tiers:
+                raise ValueError(
+                    f"need {self.tiers} QoS weights, got {len(weights)}")
+            if any(w <= 0 for w in weights):
+                raise ValueError("QoS tier weights must be positive")
+        self.weights = list(weights) if weights is not None else None
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._known_tenants: set = set()
+        self.tenant_requests: Dict[Tuple[str, int], int] = {}
+        self.rejected: Dict[Tuple[str, str, int], int] = {}
+
+    def track_tenant(self, tenant: str) -> str:
+        """The identity counters/buckets are keyed by: the tenant itself
+        while the tracked set has room (explicitly configured tenants are
+        always tracked), ``~overflow`` once the cardinality cap hits."""
+        if tenant in self._known_tenants or tenant in self.tenant_rates:
+            return tenant
+        if len(self._known_tenants) < self.MAX_TRACKED_TENANTS:
+            self._known_tenants.add(tenant)
+            return tenant
+        return self.OVERFLOW_TENANT
+
+    # -- tiers -------------------------------------------------------------
+    @property
+    def best_effort_tier(self) -> int:
+        return self.tiers - 1
+
+    def tier_of(self, priority: int) -> int:
+        """v2 priority -> tier: 0 is the highest class; anything at or
+        beyond the last tier rides the preemptible best-effort lane."""
+        try:
+            p = int(priority)
+        except (TypeError, ValueError):
+            p = 0
+        return min(max(p, 0), self.tiers - 1)
+
+    def tier_limit(self, tier: int, max_queue_size: int) -> int:
+        """The admission threshold for ``tier`` against a model's queue
+        bound: tier 0 may fill the whole queue; the best-effort lane only
+        ``best_effort_fraction`` of it; intermediate tiers interpolate.
+        Always >= 1 so a positive bound never silently zeroes a tier."""
+        if max_queue_size <= 0:
+            return 0  # unbounded model: no threshold
+        if self.tiers == 1 or tier <= 0:
+            return max_queue_size
+        frac = 1.0 - (tier / (self.tiers - 1)) * (
+            1.0 - self.best_effort_fraction)
+        return max(1, int(max_queue_size * frac))
+
+    # -- tenants -----------------------------------------------------------
+    def count_request(self, tenant: str, tier: int) -> None:
+        key = (self.track_tenant(tenant), tier)
+        self.tenant_requests[key] = self.tenant_requests.get(key, 0) + 1
+
+    def count_rejected(self, model: str, tenant: str, tier: int) -> None:
+        key = (model, self.track_tenant(tenant), tier)
+        self.rejected[key] = self.rejected.get(key, 0) + 1
+
+    def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        # cardinality-capped: overflow tenants SHARE one bucket, so a
+        # rotating-identity flood is throttled as one tenant instead of
+        # minting a fresh burst allowance per request
+        tenant = self.track_tenant(tenant)
+        bucket = self._buckets.get(tenant)
+        if bucket is not None:
+            return bucket
+        rate, burst = self.tenant_rates.get(
+            tenant, (self.tenant_rate, self.tenant_burst))
+        if rate <= 0:
+            return None  # unlimited tenant
+        bucket = TokenBucket(rate, burst)
+        self._buckets[tenant] = bucket
+        return bucket
+
+    def admit_tenant(self, tenant: str) -> Optional[float]:
+        """Token-bucket verdict: None = admitted, else the pushback
+        horizon (seconds) for a 429."""
+        bucket = self._bucket_for(tenant)
+        if bucket is None:
+            return None
+        return bucket.acquire()
+
+    def set_tenant_rate(self, tenant: str, rate: float,
+                        burst: Optional[float] = None) -> None:
+        """Runtime override (CLI ``--qos-tenant-limit`` lands here).  The
+        cached bucket is dropped so the new rate applies immediately."""
+        self.tenant_rates[tenant] = (float(rate), burst)
+        self._buckets.pop(tenant, None)
+
+    # -- pushback ----------------------------------------------------------
+    @staticmethod
+    def pushback_s(base_s: float, depth: int, limit: int) -> float:
+        """Depth-proportional ``Retry-After``: the base horizon scaled by
+        how deep the shed tier's backlog already is relative to the
+        model's bound — an empty-but-throttled queue says "soon", a full
+        one says "proportionally later"."""
+        if base_s <= 0:
+            return 0.0
+        if limit <= 0:
+            return base_s
+        return base_s * (1.0 + max(0, depth) / float(limit))
+
+    # -- snapshots (metrics renderer; copies, the dicts mutate live) -------
+    def tenant_request_counts(self) -> Dict[Tuple[str, int], int]:
+        return dict(self.tenant_requests)
+
+    def rejected_counts(self) -> Dict[Tuple[str, str, int], int]:
+        return dict(self.rejected)
+
+
+def parse_tenant_limit(spec: str) -> Tuple[str, float, Optional[float]]:
+    """CLI ``--qos-tenant-limit NAME=RATE[:BURST]`` -> (name, rate, burst);
+    raises ValueError on junk so a typo'd flag fails at startup."""
+    name, sep, rest = spec.partition("=")
+    if not sep or not name or not rest:
+        raise ValueError(
+            f"invalid tenant limit '{spec}': expected NAME=RATE[:BURST]")
+    rate_s, _, burst_s = rest.partition(":")
+    rate = float(rate_s)
+    burst = float(burst_s) if burst_s else None
+    if rate < 0 or (burst is not None and burst <= 0):
+        raise ValueError(
+            f"invalid tenant limit '{spec}': rate must be >= 0 and "
+            "burst > 0")
+    return name, rate, burst
+
+
+def tenant_from_headers(tenant_header: Optional[str],
+                        authorization: Optional[str]) -> str:
+    """Resolve the tenant id for one request: the explicit
+    ``triton-tenant`` header wins, then the basic-auth username the
+    client's ``BasicAuth`` plugin stamps, then ``anonymous``."""
+    if tenant_header:
+        return tenant_header
+    if authorization and authorization.lower().startswith("basic "):
+        import base64
+
+        try:
+            decoded = base64.b64decode(
+                authorization.split(None, 1)[1], validate=True).decode(
+                "utf-8", errors="replace")
+            user = decoded.partition(":")[0]
+            if user:
+                return user
+        except Exception:
+            pass  # malformed auth is the auth layer's problem, not QoS's
+    return DEFAULT_TENANT

@@ -75,6 +75,16 @@ class InferRequest:
     # remaining deadline budget through.  An expired request is dropped at
     # dequeue / batch assembly without entering COMPUTE.
     deadline_ns: int = 0
+    # -- QoS (server/qos.py) ----------------------------------------------
+    # Tenant id resolved by the frontend (triton-tenant header, then the
+    # basic-auth username, then "anonymous" — filled by the core if the
+    # frontend left it empty).
+    tenant: str = ""
+    # v2 request priority (0 = highest), consumed out of `parameters` by
+    # the frontend so priority never splits dynamic-batch parameter
+    # groups; `tier` is the admission-resolved QoS class.
+    priority: int = 0
+    tier: int = 0
     # Filled by the core:
     arrival_ns: int = field(default_factory=lambda: time.monotonic_ns())
 
@@ -161,6 +171,29 @@ def apply_request_deadline(req: InferRequest,
             "microseconds value")
     if us > 0:
         req.deadline_ns = time.monotonic_ns() + us * 1000
+
+
+def apply_request_priority(req: InferRequest) -> None:
+    """Consume the v2 ``priority`` request parameter (0 = highest) into
+    ``req.priority``.  Consumed, like ``timeout``: priority steers dequeue
+    order, not model semantics, and leaving it in ``parameters`` would
+    split dynamic-batch parameter groups per priority class."""
+    raw = req.parameters.pop("priority", None)
+    if raw is None:
+        return
+    try:
+        priority = int(raw)
+    except (TypeError, ValueError):
+        priority = -1  # fall through to the one rejection path below
+    if priority < 0:
+        # rejected, not clamped: a negative priority silently promoted to
+        # tier 0 would grant preemption rights to malformed input (and
+        # gRPC's uint64 param already rejects it client-side — both
+        # protocols must agree)
+        raise InferError(
+            f"invalid request priority {raw!r}: expected a non-negative "
+            "integer")
+    req.priority = priority
 
 
 def reshape_input(arr: np.ndarray, shape, name: str) -> np.ndarray:

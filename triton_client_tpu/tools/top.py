@@ -52,7 +52,9 @@ _METRICS = (
     "nv_inference_deadline_exceeded_total",
 )
 
-_SERIES_RE = re.compile(r'^(\w+)\{([^}]*)\}\s+([0-9.eE+-]+)\s*$')
+# greedy label block up to the LAST `}` before the value: a label value
+# may contain a literal `}` (tenant ids are client-supplied octets)
+_SERIES_RE = re.compile(r'^(\w+)\{(.*)\}\s+([0-9.eE+-]+)\s*$')
 _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 
 
@@ -82,14 +84,43 @@ def parse_metrics(text: str) -> Dict[str, Dict[str, float]]:
     return out
 
 
+def parse_qos(text: str) -> Dict[str, Dict[tuple, float]]:
+    """Tenant/tier-labeled QoS series -> ``{"requests": {(tenant, tier):
+    v}, "shed": {(tenant, tier): v}}`` (shed summed over models).  Servers
+    predating the QoS layer simply produce empty maps."""
+    out: Dict[str, Dict[tuple, float]] = {"requests": {}, "shed": {}}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value = m.groups()
+        if name == "nv_qos_tenant_requests_total":
+            bucket = out["requests"]
+        elif name == "nv_inference_rejected_total":
+            bucket = out["shed"]
+        else:
+            continue
+        labels = dict(_LABEL_RE.findall(labels_raw))
+        tenant = labels.get("tenant")
+        if tenant is None:
+            continue  # pre-QoS model-only series
+        key = (tenant, labels.get("tier", "0"))
+        bucket[key] = bucket.get(key, 0.0) + float(value)
+    return out
+
+
 def sample(base_url: str, timeout: float, limit: int = 0) -> Dict[str, Any]:
     """One poll of both surfaces, monotonic-stamped for rate deltas."""
     recorder_url = f"{base_url}/v2/debug/flight_recorder"
     if limit:
         recorder_url += f"?limit={int(limit)}"
+    metrics_text = _fetch(f"{base_url}/metrics", timeout)
     return {
         "t": time.monotonic(),
-        "metrics": parse_metrics(_fetch(f"{base_url}/metrics", timeout)),
+        "metrics": parse_metrics(metrics_text),
+        "qos": parse_qos(metrics_text),
         "recorder": json.loads(_fetch(recorder_url, timeout)),
     }
 
@@ -166,6 +197,82 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "last_outlier": _outlier_brief(last_outlier.get(model)),
         }
     return rows
+
+
+def tenant_rows(cur: Dict[str, Any],
+                prev: Optional[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Per-tenant QoS rows: request rate plus SHED/s broken down by tier
+    (cumulative counters on the first/only sample, like the model rows).
+    Empty when the server exposes no tenant-labeled series."""
+    qos = cur.get("qos") or {}
+    pqos = (prev.get("qos") or {}) if prev else None
+    dt = (cur["t"] - prev["t"]) if prev else None
+
+    def delta(kind: str, key: tuple) -> float:
+        now = qos.get(kind, {}).get(key, 0.0)
+        if pqos is None:
+            return now
+        d = now - pqos.get(kind, {}).get(key, 0.0)
+        return now if d < 0 else d  # counter reset = server restart
+
+    rows: Dict[str, Dict[str, Any]] = {}
+    keys = set(qos.get("requests", {})) | set(qos.get("shed", {}))
+    for tenant, tier in sorted(keys):
+        row = rows.setdefault(tenant, {"req": 0.0, "shed_by_tier": {}})
+        row["req"] += delta("requests", (tenant, tier))
+        shed = delta("shed", (tenant, tier))
+        if shed or (tenant, tier) in qos.get("shed", {}):
+            row["shed_by_tier"][tier] = \
+                row["shed_by_tier"].get(tier, 0.0) + shed
+    for row in rows.values():
+        row["req_per_s"] = round(row["req"] / dt, 1) if dt else None
+        row["shed_per_s_by_tier"] = {
+            t: (round(v / dt, 1) if dt else None)
+            for t, v in sorted(row["shed_by_tier"].items())}
+    return rows
+
+
+def aggregate_tenants(per_url: Dict[str, Dict[str, Dict[str, Any]]]
+                      ) -> Dict[str, Dict[str, Any]]:
+    """Sum per-server tenant rows into fleet rows (all columns additive;
+    rate columns sum over the replicas that have a delta base and stay
+    None until at least one does — same partial-sum convention as the
+    per-model fleet rows)."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for rows in per_url.values():
+        for tenant, r in rows.items():
+            a = agg.setdefault(tenant, {
+                "req": 0.0, "shed_by_tier": {},
+                "req_per_s": None, "shed_per_s_by_tier": {}})
+            a["req"] += r["req"]
+            for t, v in r["shed_by_tier"].items():
+                a["shed_by_tier"][t] = a["shed_by_tier"].get(t, 0.0) + v
+            if r.get("req_per_s") is not None:
+                a["req_per_s"] = round(
+                    (a["req_per_s"] or 0.0) + r["req_per_s"], 1)
+            for t, v in (r.get("shed_per_s_by_tier") or {}).items():
+                if v is not None:
+                    cur = a["shed_per_s_by_tier"].get(t)
+                    a["shed_per_s_by_tier"][t] = round(
+                        (cur or 0.0) + v, 1)
+    return agg
+
+
+def _tenant_lines(rows: Dict[str, Dict[str, Any]]) -> List[str]:
+    if not rows:
+        return []
+    rated = any(r.get("req_per_s") is not None for r in rows.values())
+    unit = "/s" if rated else " total"
+    lines = ["", f"  {'TENANT':<24}{'REQ' + unit:>12}  SHED{unit} by tier"]
+    for tenant in sorted(rows):
+        r = rows[tenant]
+        req = r["req_per_s"] if rated else r["req"]
+        shed = (r.get("shed_per_s_by_tier") if rated
+                else r["shed_by_tier"]) or {}
+        shed_s = "  ".join(
+            f"t{t}={_fmt(v)}" for t, v in sorted(shed.items())) or "-"
+        lines.append(f"  {tenant:<24}{_fmt(req):>12}  {shed_s}")
+    return lines
 
 
 def _outlier_brief(o: Optional[dict]) -> Optional[Dict[str, Any]]:
@@ -273,7 +380,8 @@ def _row_line(label: str, r: Dict[str, Any]) -> str:
 
 
 def render(url: str, cur: Dict[str, Any],
-           rows: Dict[str, Dict[str, Any]], interval: float) -> str:
+           rows: Dict[str, Dict[str, Any]], interval: float,
+           tenants: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
     recorder = cur["recorder"]
     lines = [
         f"triton-top — {url} — {time.strftime('%H:%M:%S')}  "
@@ -289,12 +397,15 @@ def render(url: str, cur: Dict[str, Any],
         lines.append(_row_line(model, r))
     if not rows:
         lines.append("  (no recorded requests yet)")
+    lines.extend(_tenant_lines(tenants or {}))
     return "\n".join(lines) + "\n"
 
 
 def render_fleet(urls: List[str],
                  per_url_rows: Dict[str, Dict[str, Dict[str, Any]]],
-                 agg: Dict[str, Dict[str, Any]], interval: float) -> str:
+                 agg: Dict[str, Dict[str, Any]], interval: float,
+                 tenants: Optional[Dict[str, Dict[str, Any]]] = None
+                 ) -> str:
     """Fleet view: one aggregated row per model (sums + worst-replica
     tails) with a per-server breakdown row for every polled endpoint."""
     down = [u for u in urls if u not in per_url_rows]
@@ -312,6 +423,7 @@ def render_fleet(urls: List[str],
                 lines.append(_row_line(f" └ {u}", rows[model]))
     if not agg:
         lines.append("  (no recorded requests yet)")
+    lines.extend(_tenant_lines(tenants or {}))
     return "\n".join(lines) + "\n"
 
 
@@ -390,27 +502,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         return out
 
     def fold(cur, prev):
-        """Per-server rows + the fleet aggregate from one (or two) polls."""
+        """Per-server rows + the fleet aggregates from one (or two)
+        polls; the third return is the per-tenant QoS aggregate."""
         per_url = {}
+        per_url_tenants = {}
         for base, s in cur.items():
             if s is None:
                 continue
             p = prev.get(base) if prev else None
             per_url[base] = model_rows(s, p,
                                        include_idle=args.include_idle)
-        return per_url, aggregate_rows(per_url)
+            per_url_tenants[base] = tenant_rows(s, p)
+        return (per_url, aggregate_rows(per_url),
+                aggregate_tenants(per_url_tenants))
 
     cur = sample_all()
     if all(s is None for s in cur.values()):
         return 1
     if args.once:
-        per_url, agg = fold(cur, None)
+        per_url, agg, tenants = fold(cur, None)
         if args.as_json:
             if fleet:
                 out = {
                     "urls": bases,
                     "ts": time.time(),
                     "models": agg,
+                    "tenants": tenants,
                     # per-endpoint samples: each server's rows + recorder
                     "endpoints": {
                         base: (None if cur[base] is None else {
@@ -425,16 +542,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "url": bases[0],
                     "ts": time.time(),
                     "models": per_url.get(bases[0], {}),
+                    "tenants": tenants,
                     "recorder": cur[bases[0]]["recorder"],
                 }
             print(json.dumps(out, indent=2))
         elif fleet:
             sys.stdout.write(render_fleet(bases, per_url, agg,
-                                          args.interval))
+                                          args.interval, tenants=tenants))
         else:
             sys.stdout.write(render(bases[0], cur[bases[0]],
                                     per_url.get(bases[0], {}),
-                                    args.interval))
+                                    args.interval, tenants=tenants))
         return 0
 
     prev = cur
@@ -447,12 +565,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # console alive and retry — monitoring must not die at
                 # exactly the moment the server gets interesting
                 continue
-            per_url, agg = fold(cur, prev)
+            per_url, agg, tenants = fold(cur, prev)
             if args.as_json:
                 print(json.dumps({
                     "ts": time.time(),
                     "models": agg if fleet else
                               next(iter(per_url.values()), {}),
+                    "tenants": tenants,
                     **({"endpoints": {b: per_url.get(b)
                                       for b in bases}} if fleet else {}),
                 }))
@@ -461,11 +580,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 sys.stdout.write("\x1b[H\x1b[2J")
                 if fleet:
                     sys.stdout.write(render_fleet(bases, per_url, agg,
-                                                  args.interval))
+                                                  args.interval,
+                                                  tenants=tenants))
                 else:
                     sys.stdout.write(render(bases[0], cur[bases[0]],
                                             per_url.get(bases[0], {}),
-                                            args.interval))
+                                            args.interval,
+                                            tenants=tenants))
                 sys.stdout.flush()
             # a server that missed THIS poll keeps its previous sample as
             # the delta base, so its next successful poll shows a sane rate
