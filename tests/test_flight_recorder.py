@@ -280,7 +280,7 @@ def _infer_simple(server):
 _RECORD_KEYS = {"seq", "request_id", "model", "version", "protocol",
                 "batch", "bytes_in", "bytes_out", "ts", "queue_us",
                 "compute_us", "total_us", "outcome", "captured",
-                "capture_reason", "chaos", "tenant", "tier"}
+                "capture_reason", "chaos", "tenant", "tier", "tick"}
 _TOP_LEVEL_KEYS = {"enabled", "capture_slower_than", "ring_capacity",
                    "outlier_capacity", "recorded_total", "models",
                    "recent", "outliers"}
@@ -533,12 +533,14 @@ class TestTritonTop:
         rc = top.main(["--url", server.http_url, "--once", "--json"])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        assert set(out) == {"url", "ts", "models", "tenants", "recorder"}
+        assert set(out) == {"url", "ts", "models", "tenants", "buckets",
+                            "recorder"}
         row = out["models"]["simple"]
         assert {"qps", "p50_ms", "p99_ms", "queue_share_pct", "batch_avg",
                 "pending", "error_pct", "rejected_per_s",
                 "deadline_exceeded_per_s", "slow_total", "captured_total",
-                "threshold_ms", "last_outlier"} == set(row)
+                "threshold_ms", "duty_pct", "mfu_pct", "burn_5m",
+                "burn_1h", "slo_breach", "last_outlier"} == set(row)
         assert row["qps"] is None  # one sample: no rate
         assert row["p50_ms"] is not None
         snail = out["models"]["snail"]

@@ -248,6 +248,95 @@ class TestQosSurface:
             server.core.qos = saved
 
 
+class TestDeviceSloSurface:
+    """The nv_tpu_* / nv_slo_* families parse under the exposition
+    grammar, are typed, survive adversarial label values, and round-trip
+    through the server's JSON metrics snapshot."""
+
+    EVIL = 'evil"dev\\ice\nmodel'
+
+    def _drive_device(self, server):
+        ds = server.core.device_stats
+        ds.declare_model(self.EVIL, 1e6)
+        ds.record_execute(self.EVIL, 2, 1_000_000,
+                          signature=(("X", (2, 4), "f32"),))
+        ds.record_execute(self.EVIL, 2, 1_000_000,
+                          signature=(("X", (2, 4), "f32"),))
+        ds.record_tick(self.EVIL, bucket=8, batch=2, padded=8,
+                       queue_depth=1, assembly_ns=5_000, syncs=1)
+        ds.record_transfer("h2d", 256)
+        from triton_client_tpu.server.device_stats import SloObjective
+
+        server.core.slo.set_objective(
+            self.EVIL, SloObjective(p99_ms=10.0, availability=0.99))
+        server.core.slo.observe(self.EVIL, 500.0, True)
+
+    def test_families_typed_and_escaped(self, server):
+        self._drive_device(server)
+        families = assert_conformant(_scrape(server.http_url))
+        # HELP/TYPE present (assert_conformant) and correctly typed
+        for fam, kind in (("nv_tpu_duty_cycle", "gauge"),
+                          ("nv_tpu_live_mfu", "gauge"),
+                          ("nv_tpu_compile_total", "counter"),
+                          ("nv_tpu_compile_duration_us", "counter"),
+                          ("nv_tpu_jit_cache_hit_total", "counter"),
+                          ("nv_tpu_jit_cache_miss_total", "counter"),
+                          ("nv_tpu_transfer_total", "counter"),
+                          ("nv_tpu_transfer_bytes_total", "counter"),
+                          ("nv_tpu_tick_total", "counter"),
+                          ("nv_tpu_tick_batch_total", "counter"),
+                          ("nv_tpu_tick_padded_total", "counter"),
+                          ("nv_tpu_tick_assembly_duration_us", "counter"),
+                          ("nv_tpu_tick_queue_depth_total", "counter"),
+                          ("nv_tpu_tick_sync_total", "counter"),
+                          ("nv_tpu_pad_waste_ratio", "gauge"),
+                          ("nv_tpu_memory_used_bytes", "gauge"),
+                          ("nv_slo_burn_rate", "gauge"),
+                          ("nv_slo_budget_remaining", "gauge"),
+                          ("nv_slo_burn_threshold", "gauge"),
+                          ("nv_slo_breach_total", "counter")):
+            assert families[fam]["type"] == kind, fam
+        # the evil model's series survived label escaping on every family
+        # that carries a model label
+
+        def unescape(v):
+            return (v.replace("\\n", "\n").replace('\\"', '"')
+                    .replace("\\\\", "\\"))
+
+        for fam in ("nv_tpu_duty_cycle", "nv_tpu_tick_total",
+                    "nv_tpu_pad_waste_ratio", "nv_slo_burn_rate"):
+            models = {unescape(l.get("model", ""))
+                      for _, l, _ in families[fam]["samples"]}
+            assert self.EVIL in models, fam
+        # bucket + window labels parse
+        buckets = {(unescape(l["model"]), l["bucket"])
+                   for _, l, _ in families["nv_tpu_tick_total"]["samples"]}
+        assert (self.EVIL, "8") in buckets
+        windows = {l["window"]
+                   for _, l, _ in families["nv_slo_burn_rate"]["samples"]}
+        assert windows == {"5m", "1h"}
+
+    def test_json_snapshot_round_trip(self, server):
+        from triton_client_tpu.server.metrics import snapshot
+
+        self._drive_device(server)
+        families = assert_conformant(_scrape(server.http_url))
+        snap = snapshot(server.core)
+        # every scraped family exists in the JSON snapshot with the same
+        # type; devices/slo sample values match exactly
+        for name, fam in families.items():
+            assert name in snap, name
+            assert snap[name]["type"] == fam["type"], name
+        tick_samples = {
+            (s["labels"]["model"], s["labels"]["bucket"]): s["value"]
+            for s in snap["nv_tpu_tick_total"]["samples"]}
+        scraped = {
+            (l["model"].replace("\\n", "\n").replace('\\"', '"')
+             .replace("\\\\", "\\"), l["bucket"]): v
+            for _, l, v in families["nv_tpu_tick_total"]["samples"]}
+        assert tick_samples == scraped
+
+
 class TestClientSurface:
     def test_grammar_and_naming(self, server):
         telemetry().reset()

@@ -55,6 +55,7 @@ _METADATA_METHODS = frozenset({
     "get_server_metadata", "get_model_metadata", "get_model_config",
     "get_model_repository_index", "get_inference_statistics",
     "get_trace_settings", "get_log_settings", "get_flight_recorder",
+    "get_device_stats",
     "get_system_shared_memory_status", "get_cuda_shared_memory_status",
     "get_xla_shared_memory_status",
 })

@@ -324,6 +324,24 @@ class InferenceServerClient(InferenceServerClientBase):
         except grpc.RpcError as e:
             raise_error_grpc(e)
 
+    async def get_device_stats(self, model_name=None, headers=None,
+                               client_timeout=None) -> dict:
+        """The server's device/scheduler observability snapshot (duty
+        cycle / live MFU / compiles / ticks / transfers / HBM + SLO
+        state) — same JSON shape as HTTP's GET /v2/debug/device_stats."""
+        import json
+
+        from ...protocol import debug_pb2 as pb_debug
+
+        try:
+            response = await self._client_stub.DeviceStats(
+                pb_debug.DeviceStatsRequest(model_name=model_name or ""),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return json.loads(response.payload_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
     # -- shared memory -----------------------------------------------------
     async def get_system_shared_memory_status(
         self, region_name="", headers=None, as_json=False, client_timeout=None

@@ -429,6 +429,22 @@ class InferenceServerClient(InferenceServerClientBase):
 
         return json.loads(response.data)
 
+    def get_device_stats(self, model_name=None, headers=None,
+                         query_params=None) -> dict:
+        """The server's device/scheduler observability snapshot: per-model
+        duty cycle / live MFU / compile events, batcher tick aggregates,
+        host<->device transfers, HBM, and SLO burn-rate state (under
+        ``"slo"``)."""
+        params = dict(query_params or {})
+        if model_name:
+            params["model"] = model_name
+        response = self._get(
+            "v2/debug/device_stats", headers, params or None)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
     # -- shared memory (reference :945-1203) -------------------------------
     def get_system_shared_memory_status(
         self, region_name="", headers=None, query_params=None

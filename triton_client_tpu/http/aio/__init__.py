@@ -326,6 +326,19 @@ class InferenceServerClient(InferenceServerClientBase):
         raise_if_error(status, body)
         return json.loads(body)
 
+    async def get_device_stats(self, model_name=None, headers=None,
+                               query_params=None) -> dict:
+        """The server's device/scheduler observability snapshot (duty
+        cycle / live MFU / compiles / ticks / transfers / HBM + SLO
+        state) — same JSON as GET /v2/debug/device_stats."""
+        params = dict(query_params or {})
+        if model_name:
+            params["model"] = model_name
+        status, _, body = await self._get(
+            "v2/debug/device_stats", headers, params or None)
+        raise_if_error(status, body)
+        return json.loads(body)
+
     # -- shared memory -----------------------------------------------------
     async def get_system_shared_memory_status(
         self, region_name="", headers=None, query_params=None

@@ -26,6 +26,7 @@ from typing import Any, Dict
 
 import numpy as np
 
+from ..server.device_stats import DEFAULT_PEAK_FLOPS, peak_flops
 from ..server.model import EnsembleModel, JaxModel, PyModel, make_config
 from . import transformer as tr
 
@@ -146,9 +147,11 @@ def forward_flops_per_token(cfg: tr.TransformerConfig, seq_len: int,
     return matmul + attn
 
 
-#: v5e bf16 peak (one chip) — the denominator for every MFU number this
-#: repo reports; change it HERE, not in individual benchmark drivers.
-V5E_PEAK_FLOPS = 394e12
+#: v5e bf16 peak (one chip) — the default denominator for every MFU
+#: number this repo reports.  Owned by ``server.device_stats`` (the live
+#: ``nv_tpu_live_mfu`` gauge uses the same value via ``peak_flops()``);
+#: re-exported here for the offline benchmark drivers.
+V5E_PEAK_FLOPS = DEFAULT_PEAK_FLOPS
 
 
 def serving_mfu(infer_per_sec: float, cfg: tr.TransformerConfig,
@@ -156,11 +159,13 @@ def serving_mfu(infer_per_sec: float, cfg: tr.TransformerConfig,
     """Model FLOPs utilization of a serving sweep: measured requests/sec ×
     seq_len tokens each × analytic forward FLOPs/token over chip peak.
     Shared by bench.py and benchmarks/run_baseline.py so the formula and
-    peak constant cannot drift apart.  ``head_cols`` follows the served
-    forward (bert_large: 2 — the span head)."""
+    peak constant cannot drift apart (``peak_flops()`` — the same
+    ``TRITON_TPU_PEAK_FLOPS``-overridable resolution the live gauge
+    uses).  ``head_cols`` follows the served forward (bert_large: 2 — the
+    span head)."""
     toks = infer_per_sec * seq_len
     return (toks * forward_flops_per_token(cfg, seq_len, head_cols)
-            / V5E_PEAK_FLOPS)
+            / peak_flops())
 
 
 class _LazyTransformer:
@@ -237,6 +242,9 @@ def make_bert_large() -> JaxModel:
         preferred_batch_sizes=[1, 2, 4, 8, 16, 32],
         max_queue_delay_us=3000,
         instance_kind="KIND_TPU",
+        parameters={"flops_per_inference": str(
+            BERT_SEQ_LEN * forward_flops_per_token(
+                BERT_LARGE, BERT_SEQ_LEN, head_cols=BERT_HEAD_COLS))},
     )
     # span head: the forward projects ONLY the 2 start/end columns — a real
     # BERT-SQuAD head, not a sliced vocab projection.  BERT_HEAD_COLS feeds
@@ -273,6 +281,8 @@ def make_longctx_tpu() -> JaxModel:
         preferred_batch_sizes=[1, 2, 4],
         max_queue_delay_us=2000,
         instance_kind="KIND_TPU",
+        parameters={"flops_per_inference": str(
+            S * forward_flops_per_token(longctx_cfg(), S))},
     )
     run = _LazyTransformer(longctx_cfg(), seed=11, model_name="longctx_tpu")
 
@@ -384,6 +394,9 @@ def make_llama_tpu() -> JaxModel:
         preferred_batch_sizes=[1, 2, 4, 8],
         max_queue_delay_us=2000,
         instance_kind="KIND_TPU",
+        parameters={"flops_per_inference": str(
+            LLAMA_SEQ_LEN * forward_flops_per_token(
+                _llama_cfg(), LLAMA_SEQ_LEN))},
     )
     state: Dict[str, Any] = {}
 

@@ -328,6 +328,9 @@ def make_dense_tpu() -> JaxModel:
         preferred_batch_sizes=[8, 16, 32, 64],
         max_queue_delay_us=2000,
         instance_kind="KIND_TPU",
+        # two matmuls (D->2D->D): 2*D*2D + 2*2D*D = 8*D^2 FLOPs/element —
+        # the nv_tpu_live_mfu numerator
+        parameters={"flops_per_inference": str(8 * D * D)},
     )
     state = {}
 

@@ -16,6 +16,12 @@ descriptor pool — wire-identical to what protoc would generate for::
     message FlightRecorderResponse {
       string payload_json = 1; // the /v2/debug/flight_recorder JSON
     }
+    message DeviceStatsRequest {
+      string model_name = 1;   // filter to one model ("" = all)
+    }
+    message DeviceStatsResponse {
+      string payload_json = 1; // the /v2/debug/device_stats JSON
+    }
 
 The response carries the debug snapshot as JSON-in-proto deliberately: the
 flight-recorder shape is a diagnostics surface shared verbatim with the
@@ -50,6 +56,14 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
     resp.name = "FlightRecorderResponse"
     f = resp.field.add()
     f.name, f.number, f.type, f.label = "payload_json", 1, _STRING, _OPTIONAL
+    ds_req = fdp.message_type.add()
+    ds_req.name = "DeviceStatsRequest"
+    f = ds_req.field.add()
+    f.name, f.number, f.type, f.label = "model_name", 1, _STRING, _OPTIONAL
+    ds_resp = fdp.message_type.add()
+    ds_resp.name = "DeviceStatsResponse"
+    f = ds_resp.field.add()
+    f.name, f.number, f.type, f.label = "payload_json", 1, _STRING, _OPTIONAL
     return fdp
 
 
@@ -78,3 +92,5 @@ def _message_class(name: str):
 
 FlightRecorderRequest = _message_class("FlightRecorderRequest")
 FlightRecorderResponse = _message_class("FlightRecorderResponse")
+DeviceStatsRequest = _message_class("DeviceStatsRequest")
+DeviceStatsResponse = _message_class("DeviceStatsResponse")

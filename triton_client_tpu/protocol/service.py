@@ -69,11 +69,17 @@ METHODS = {
     "TraceSetting": ("uu", pb.TraceSettingRequest, pb.TraceSettingResponse),
     "LogSettings": ("uu", pb.LogSettingsRequest, pb.LogSettingsResponse),
     # debug surface (runtime-built messages, debug_pb2): the flight
-    # recorder's recent ring + pinned outliers as JSON
+    # recorder's recent ring + pinned outliers, and the device/scheduler
+    # observability snapshot (device_stats + SLO state), as JSON
     "FlightRecorder": (
         "uu",
         pb_debug.FlightRecorderRequest,
         pb_debug.FlightRecorderResponse,
+    ),
+    "DeviceStats": (
+        "uu",
+        pb_debug.DeviceStatsRequest,
+        pb_debug.DeviceStatsResponse,
     ),
 }
 

@@ -60,6 +60,29 @@ def main() -> None:
                         help="disable per-request flight recording "
                         "entirely (the /v2/debug/flight_recorder surface "
                         "stays up but records nothing)")
+    parser.add_argument("--slo", action="append", default=None,
+                        metavar="MODEL=P99_MS[:AVAILABILITY]",
+                        help="per-model SLO (repeatable): p99 latency "
+                        "target in ms plus an availability objective "
+                        "(default 0.999).  Drives the nv_slo_burn_rate / "
+                        "nv_slo_budget_remaining gauges (5m/1h "
+                        "multi-window burn rates over 1-availability "
+                        "error budget) and burn-rate-triggered flight-"
+                        "recorder pinning; model configs can declare the "
+                        "same via slo.p99_ms / slo.availability "
+                        "parameters")
+    parser.add_argument("--slo-burn-threshold", type=float, default=None,
+                        metavar="X",
+                        help="multi-window breach threshold: a model is "
+                        "breaching (and SLO-bad requests are pinned) when "
+                        "BOTH the 5m and 1h burn rates exceed this "
+                        "(default 14.4, the canonical fast-burn page "
+                        "threshold)")
+    parser.add_argument("--no-device-stats", action="store_true",
+                        help="disable the device/scheduler stats "
+                        "collector (nv_tpu_* metrics, batcher tick "
+                        "profiling) — the A/B lever bench.py uses to "
+                        "bound its fast-path cost")
     parser.add_argument("--drain-timeout", type=float, default=10.0,
                         metavar="S",
                         help="graceful-drain budget on SIGINT/SIGTERM: "
@@ -229,6 +252,22 @@ def main() -> None:
             enabled=not args.no_flight_recorder)
     except Exception as e:  # invalid threshold spec — fail at startup
         parser.error(str(e))
+    from .device_stats import parse_slo_spec
+
+    if args.no_device_stats:
+        core.device_stats.enabled = False
+    if args.slo_burn_threshold is not None:
+        if args.slo_burn_threshold <= 0:
+            parser.error("--slo-burn-threshold must be positive")
+        core.slo.burn_threshold = args.slo_burn_threshold
+    for spec in (args.slo or []):
+        try:
+            name, objective = parse_slo_spec(spec)
+        except ValueError as e:  # typo'd SLO — fail at startup, loudly
+            parser.error(str(e))
+        core.slo.set_objective(name, objective)
+        print(f"SLO: {name} p99<={objective.p99_ms:g}ms "
+              f"availability={objective.availability:g}")
 
     async def serve():
         import signal

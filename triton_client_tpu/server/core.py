@@ -27,9 +27,10 @@ import numpy as np
 
 from ..protocol import inference_pb2 as pb
 from ..utils import np_to_triton_dtype, triton_to_np_dtype
-from .model import EnsembleModel, Model, pb_to_datatype
+from .model import EnsembleModel, JaxModel, Model, pb_to_datatype
 from .registry import ModelRegistry
 from .shm import SystemShmRegistry, XlaShmRegistry
+from .device_stats import DeviceStatsCollector, SloEngine, SloObjective
 from .flight_recorder import FlightRecorder
 from .log import ServerLog
 from .qos import DEFAULT_TENANT, QosManager, TieredQueue
@@ -368,6 +369,11 @@ class _DynamicBatcher:
         names = list(pending[0][0].keys())
         traces = [p[4] for p in pending if p[4] is not None]
         t_asm0 = time.monotonic_ns()
+        # tick profile: queue depth at assembly (requests left waiting
+        # while this tick forms — the backlog the chosen bucket geometry
+        # produces) sampled before any concat/pad work
+        queue_depth = self._queue.qsize()
+        exec_stats: Dict[str, Any] = {}
         for item in pending:
             ts, trace = item[3], item[4]
             if trace is not None:
@@ -392,10 +398,40 @@ class _DynamicBatcher:
             # stall every other request for the full device round trip.
             outputs = await self._core._run_model(
                 self._model, merged, pending[0][1], keep_device=set(),
-                traces=traces)
+                real_batch=total,
+                traces=traces, exec_stats=exec_stats)
             compute_ns = time.monotonic_ns() - t0
             self._model.stats.record(total, queue_ns, compute_ns, ok=True)
             self._model.stats.record_batch(total)
+            ds = self._core.device_stats
+            if ds.enabled:
+                # one tick record per batched execution: the bucket view
+                # (nv_tpu_tick_* / pad-waste series, triton-top buckets)
+                # is aggregated from exactly these
+                ds.record_tick(
+                    self._model.name, bucket=padded, batch=total,
+                    padded=padded, queue_depth=queue_depth,
+                    assembly_ns=t0 - t_asm0,
+                    compute_ns=exec_stats.get("compute_ns", compute_ns),
+                    requests=len(pending),
+                    syncs=exec_stats.get("d2h_syncs", 0))
+                tick = {
+                    "bucket": padded, "batch": total,
+                    "pad_fraction": (round((padded - total) / padded, 4)
+                                     if padded else 0.0),
+                    "queue_depth": queue_depth,
+                    "assembly_us": round((t0 - t_asm0) / 1e3, 1),
+                    "requests": len(pending),
+                }
+                for item in pending:
+                    tr = item[4]
+                    if tr is not None:
+                        # the tick shape rides the trace record and the
+                        # flight record, so a pinned outlier shows which
+                        # bucket/occupancy it paid for
+                        tr.tick = tick
+                        if tr.flight is not None:
+                            tr.flight.tick = tick
             offset = 0
             for item, count in zip(pending, counts):
                 fut = item[2]
@@ -475,6 +511,18 @@ class InferenceCore:
         # the tracer hands every armed context's completion to it
         self.flight_recorder = FlightRecorder()
         self.tracer.flight_recorder = self.flight_recorder
+        # device/scheduler observability (server/device_stats.py): compute
+        # windows (duty cycle / live MFU), XLA compile events, host<->device
+        # transfers, and batcher tick profiles — the nv_tpu_* family
+        self.device_stats = DeviceStatsCollector()
+        # the xla-shm staging paths record their H2D/D2H DMAs into it
+        self.xla_shm.device_stats = self.device_stats
+        # SLO burn-rate engine: objectives from --slo / model-config
+        # parameters (slo.p99_ms, slo.availability); the flight recorder
+        # feeds every completed request and pins SLO-bad ones on breach
+        self.slo = SloEngine()
+        self.slo.resolver = self._slo_from_config
+        self.flight_recorder.slo_engine = self.slo
         self.live = True
         # readiness gate: /v2/health/ready (and gRPC ServerReady) report
         # not-ready until startup warmup finished and no model is mid-load
@@ -507,6 +555,34 @@ class InferenceCore:
         # under the GIL, same discipline as the response-cache counters)
         self.rejected_by_model: Dict[str, int] = {}
         self.deadline_exceeded_by_model: Dict[str, int] = {}
+
+    def _slo_from_config(self, name: str) -> Optional[SloObjective]:
+        """Resolve a model's SLO from its config parameters (``slo.p99_ms``
+        required, ``slo.availability`` optional, default 0.999).  None —
+        no SLO, the engine ignores the model — on absence or junk; the
+        ``--slo`` CLI sets explicit objectives that win over this."""
+        try:
+            model = self.registry.get(name)
+        except InferError:
+            return None
+        params = model.config.parameters
+        if "slo.p99_ms" not in params:
+            return None
+        try:
+            p99_ms = float(params["slo.p99_ms"].string_value)
+        except ValueError:
+            return None
+        if p99_ms <= 0:
+            return None
+        availability = 0.999
+        if "slo.availability" in params:
+            try:
+                a = float(params["slo.availability"].string_value)
+                if 0.0 < a < 1.0:
+                    availability = a
+            except ValueError:
+                pass
+        return SloObjective(p99_ms=p99_ms, availability=availability)
 
     def ready(self) -> bool:
         """Server-level readiness: up, past startup warmup, and no model
@@ -679,8 +755,16 @@ class InferenceCore:
             client_request_id=request.client_request_id,
             traceparent=request.traceparent)
         recorder = self.flight_recorder
+        # SLO observation rides the flight-record pipeline: a model with an
+        # objective keeps records flowing even when the recorder itself is
+        # disabled (complete() then skips the ring/watchdog but still feeds
+        # the burn-rate windows and pins breaches) — --no-flight-recorder
+        # must not silently kill --slo
+        slo_watch = (recorder.slo_engine is not None
+                     and recorder.slo_engine.objective_for(model.name)
+                     is not None)
         if trace is None:
-            if not recorder.enabled:
+            if not (recorder.enabled or slo_watch):
                 return await self._infer_traced(model, request, None)
             # flight recorder arming: the sampler skipped this request, but
             # the watchdog needs its span tree in case it lands slow — run
@@ -689,7 +773,7 @@ class InferenceCore:
                 model.name, request.model_version or "1",
                 client_request_id=request.client_request_id,
                 traceparent=request.traceparent)
-        if recorder.enabled:
+        if recorder.enabled or slo_watch:
             trace.flight = recorder.start(
                 model.name, model.served_version, request,
                 batched=model.max_batch_size > 0)
@@ -1049,6 +1133,11 @@ class InferenceCore:
                     if k.startswith(prefix)]:
             if self._inline_profiles[key].generation != gen:
                 self._inline_profiles.pop(key)
+        # a reloaded instance may declare different SLO parameters or
+        # FLOPs; cumulative device-stat counters stay (Prometheus counters
+        # must not go backwards on a reload)
+        self.slo.invalidate(name)
+        self.device_stats.forget_model(name)
 
     async def shutdown(self, drain_s: float = 5.0) -> None:
         """Graceful drain, then teardown: stop accepting (new requests get
@@ -1118,6 +1207,8 @@ class InferenceCore:
         self, model: Model, inputs, params,
         keep_device: Optional[Set[str]] = None,
         traces=(),
+        exec_stats: Optional[Dict[str, Any]] = None,
+        real_batch: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Execute on a thread-pool worker so the event loop keeps serving.
 
@@ -1137,27 +1228,68 @@ class InferenceCore:
         ``traces``: TraceContexts of sampled requests riding this execution
         (one for the direct path, every traced member for a batch) — each
         gets a COMPUTE span for the execute window and, when host
-        resolution happens, a D2H_TRANSFER span for the readback drain."""
+        resolution happens, a D2H_TRANSFER span for the readback drain.
+
+        ``exec_stats``: optional dict the execution fills with
+        ``compute_ns`` / ``d2h_syncs`` — the batcher passes one so its
+        tick records carry per-tick sync counts without re-deriving them.
+
+        ``real_batch``: the REAL element count when ``inputs`` has been
+        padded to a bucket (the dynamic batcher passes its pre-pad total)
+        — pad slots are waste (``nv_tpu_pad_waste_ratio``), so they must
+        not count as inferences or MFU FLOPs."""
         loop = asyncio.get_running_loop()
+        ds = self.device_stats
 
         def _exec():
-            t_c0 = time.monotonic_ns() if traces else 0
+            want_ds = ds.enabled
+            t_c0 = time.monotonic_ns() if (traces or want_ds) else 0
             outputs = model.execute(inputs, params)
+            t_c1 = time.monotonic_ns() if (traces or want_ds) else 0
             if traces:
-                t_c1 = time.monotonic_ns()
                 for t in traces:
                     t.add_span("COMPUTE", t_c0, t_c1)
+            if want_ds:
+                # signature-analytic compile tracking: jax.jit compiles
+                # once per input-shape signature (the invariant JaxModel
+                # builds on), so a signature's first execution is the
+                # jit-cache miss whose wall time paid XLA compilation.
+                # Only XLA-backed models earn signatures — a python-backend
+                # model never compiles, and fabricating misses would both
+                # invent nv_tpu_compile events and drop its real compute
+                # from the duty/MFU window
+                sig = None
+                if isinstance(model, JaxModel):
+                    sig = tuple(sorted(
+                        ((n, getattr(v, "shape", None),
+                          getattr(v, "dtype", None))
+                         for n, v in inputs.items()), key=lambda s: s[0]))
+                ds.declare_model(model.name, model.flops_per_element())
+                ds.record_execute(model.name,
+                                  real_batch or _batch_count(inputs) or 1,
+                                  t_c1 - t_c0, signature=sig)
+                if exec_stats is not None:
+                    exec_stats["compute_ns"] = t_c1 - t_c0
             if keep_device is None:
                 return outputs
-            for n, v in outputs.items():
-                if n not in keep_device and hasattr(v, "copy_to_host_async"):
-                    v.copy_to_host_async()
+            drained = [n for n, v in outputs.items()
+                       if n not in keep_device
+                       and hasattr(v, "copy_to_host_async")]
+            for n in drained:
+                outputs[n].copy_to_host_async()
             resolved = {n: (v if n in keep_device else np.asarray(v))
                         for n, v in outputs.items()}
             if traces:
                 t_d1 = time.monotonic_ns()
                 for t in traces:
                     t.add_span("D2H_TRANSFER", t_c1, t_d1)
+            if drained:
+                if want_ds:
+                    ds.record_transfer(
+                        "d2h", sum(resolved[n].nbytes for n in drained),
+                        count=len(drained))
+                if exec_stats is not None:
+                    exec_stats["d2h_syncs"] = len(drained)
             return resolved
 
         prof = None

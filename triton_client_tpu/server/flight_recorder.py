@@ -80,6 +80,23 @@ def parse_capture_threshold(spec: str):
     return None, ms
 
 
+def parse_snapshot_limit(value) -> int:
+    """Validate a debug-surface ``limit`` parameter: a non-negative
+    integer, as a CLIENT error (400 / INVALID_ARGUMENT) on junk.  Shared
+    by the HTTP ``?limit=`` query parameter and the gRPC ``FlightRecorder``
+    / ``DeviceStats`` RPCs so both wire surfaces reject identically —
+    a malformed debug poll must never surface as a 500."""
+    try:
+        limit = int(value)
+    except (TypeError, ValueError):
+        raise InferError(
+            f"invalid limit {value!r}: must be a non-negative integer")
+    if limit < 0:
+        raise InferError(
+            f"invalid limit {limit}: must be a non-negative integer")
+    return limit
+
+
 class FlightRecord:
     """Compact summary of one request — what the ring buffer holds.
 
@@ -91,7 +108,8 @@ class FlightRecord:
     __slots__ = ("seq", "request_id", "model", "version", "protocol",
                  "batch", "bytes_in", "bytes_out", "arrival_ns", "ts",
                  "queue_us", "compute_us", "total_us", "outcome",
-                 "capture_reason", "spans", "chaos", "tenant", "tier")
+                 "capture_reason", "spans", "chaos", "tenant", "tier",
+                 "tick")
 
     def __init__(self, seq: int, model: str, version: str,
                  request_id: str = "", protocol: str = "",
@@ -121,6 +139,10 @@ class FlightRecord:
         # priority tier it rode — triton-top's per-tenant view reads these
         self.tenant = tenant
         self.tier = tier
+        # batcher tick record (server/device_stats.py): which bucket this
+        # request's execution rode, at what occupancy/pad waste — stamped
+        # by the dynamic batcher so an outlier shows its tick shape
+        self.tick: Optional[Dict[str, Any]] = None
 
     def to_dict(self, include_spans: bool = False) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -142,6 +164,7 @@ class FlightRecord:
             "chaos": self.chaos,
             "tenant": self.tenant,
             "tier": self.tier,
+            "tick": self.tick,
         }
         if include_spans:
             out["spans"] = self.spans or []
@@ -179,6 +202,12 @@ class FlightRecorder:
         self.recorded_total = 0
         self.slow_by_model: Dict[str, int] = {}
         self.captured_by_model: Dict[str, int] = {}
+        # SLO burn-rate engine (server/device_stats.py), set by the core:
+        # every completed request feeds its windows, and while a model is
+        # breaching its multi-window burn threshold, SLO-bad requests are
+        # pinned with full span trees — the p99 watchdog's retroactive
+        # capture, triggered by budget math instead of a quantile
+        self.slo_engine = None
 
     def configure(self, capacity: Optional[int] = None,
                   outlier_capacity: Optional[int] = None,
@@ -270,23 +299,42 @@ class FlightRecorder:
         # sample joins it (a request must not raise the bar it is judged
         # against); only SUCCESSES feed the histogram — a burst of
         # fast-failing requests must not drag the p99 threshold down to
-        # failure-validation latency (failures are always captured anyway)
-        hist = self._hists.get(record.model)
-        if hist is None:
-            with self._lock:
-                hist = self._hists.setdefault(
-                    record.model, LatencyHistogram())
-        threshold_us = self._threshold_us(hist)
-        if record.outcome == "ok":
-            hist.observe(total_ns / 1e9)
+        # failure-validation latency (failures are always captured anyway).
+        # With the recorder disabled (records flow only because the model
+        # has an SLO objective) the watchdog is off: no histogram feed, no
+        # slow-threshold — only the SLO windows below see the request.
+        threshold_us = None
+        if self.enabled:
+            hist = self._hists.get(record.model)
+            if hist is None:
+                with self._lock:
+                    hist = self._hists.setdefault(
+                        record.model, LatencyHistogram())
+            threshold_us = self._threshold_us(hist)
+            if record.outcome == "ok":
+                hist.observe(total_ns / 1e9)
+
+        # SLO windows see EVERY completed request (good ones must dilute
+        # the bad fraction); the verdict — SLO-bad while the model burns
+        # over threshold on both windows — is one more capture trigger
+        slo_pin = False
+        if self.slo_engine is not None:
+            slo_pin = self.slo_engine.observe(
+                record.model, record.total_us, record.outcome == "ok")
 
         # a slow FAILURE (the canonical timeout) is both: counted slow
         # below, captured as "failed"
         is_slow = threshold_us is not None and record.total_us > threshold_us
-        if record.outcome != "ok":
+        if not self.enabled:
+            # recorder off: breach pinning is the SLO engine's feature and
+            # survives; every other capture class belongs to the recorder
+            record.capture_reason = "slo_breach" if slo_pin else None
+        elif record.outcome != "ok":
             record.capture_reason = "failed"
         elif is_slow:
             record.capture_reason = "slow"
+        elif slo_pin:
+            record.capture_reason = "slo_breach"
         elif record.chaos is not None:
             # injected faults are always pinned, even when the request
             # survived them (e.g. a latency fault under the threshold)
@@ -305,11 +353,12 @@ class FlightRecorder:
         # executor threads while snapshot()/metrics iterate on the event
         # loop, and an unlocked deque append mid-iteration raises
         with self._lock:
-            self._ring.append(record)
-            self.recorded_total += 1
-            if is_slow:
-                self.slow_by_model[record.model] = \
-                    self.slow_by_model.get(record.model, 0) + 1
+            if self.enabled:
+                self._ring.append(record)
+                self.recorded_total += 1
+                if is_slow:
+                    self.slow_by_model[record.model] = \
+                        self.slow_by_model.get(record.model, 0) + 1
             if record.capture_reason is not None:
                 self.captured_by_model[record.model] = \
                     self.captured_by_model.get(record.model, 0) + 1

@@ -450,14 +450,40 @@ class InferenceServicer:
         import json as _json
 
         from ..protocol import debug_pb2 as pb_debug
+        from .flight_recorder import parse_snapshot_limit
 
         model = request.model_name or None
-        limit = int(request.limit or 0)
+        try:
+            # proto uint32 cannot carry a negative or non-integer, but the
+            # validation mirrors HTTP's ?limit= contract anyway so both
+            # wire surfaces stay byte-for-byte identical in behavior (and
+            # a future int-typed field cannot silently regress it)
+            limit = parse_snapshot_limit(request.limit or 0)
+        except InferError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         body = await asyncio.get_running_loop().run_in_executor(
             None, lambda: _json.dumps(
                 self._core.flight_recorder.snapshot(
                     model=model, limit=limit)))
         return pb_debug.FlightRecorderResponse(payload_json=body)
+
+    async def DeviceStats(self, request, context):
+        """Debug surface: the device/scheduler observability snapshot
+        (device_stats + SLO state) — same JSON as HTTP's
+        ``GET /v2/debug/device_stats``, same off-loop serialization."""
+        import json as _json
+
+        from ..protocol import debug_pb2 as pb_debug
+
+        model = request.model_name or None
+
+        def _snap():
+            out = self._core.device_stats.snapshot(model=model)
+            out["slo"] = self._core.slo.snapshot(model=model)
+            return _json.dumps(out)
+
+        body = await asyncio.get_running_loop().run_in_executor(None, _snap)
+        return pb_debug.DeviceStatsResponse(payload_json=body)
 
     async def LogSettings(self, request, context):
         for k, v in request.settings.items():

@@ -88,6 +88,7 @@ def build_app(core: InferenceCore) -> web.Application:
     r.add_get("/v2/logging", _h(core, _get_logging))
     r.add_post("/v2/logging", _h(core, _set_logging))
     r.add_get("/v2/debug/flight_recorder", _h(core, _flight_recorder))
+    r.add_get("/v2/debug/device_stats", _h(core, _device_stats))
     r.add_get("/metrics", _h(core, _metrics))
     for kind in ("systemsharedmemory", "cudasharedmemory"):
         r.add_get(f"/v2/{kind}/status", _h(core, _shm_status))
@@ -414,11 +415,13 @@ async def _generate_stream(core, request):
 
 
 async def _flight_recorder(core, request):
+    from .flight_recorder import parse_snapshot_limit
+
     model = request.query.get("model") or None
-    try:
-        limit = int(request.query.get("limit", "0"))
-    except ValueError:
-        raise InferError("flight_recorder 'limit' must be an integer")
+    # shared validator (also used by the gRPC FlightRecorder RPC): junk or
+    # negative ?limit= is a client mistake — 400 with a JSON error body,
+    # never an unhandled 500
+    limit = parse_snapshot_limit(request.query.get("limit", "0"))
     # snapshot + serialize off-loop: at operator-sized rings (10^4-10^5
     # records) this is a multi-MB json.dumps — done inline it would stall
     # every in-flight inference for the duration of a debug poll
@@ -428,11 +431,31 @@ async def _flight_recorder(core, request):
     return web.Response(text=body, content_type="application/json")
 
 
+async def _device_stats(core, request):
+    """Debug surface for the device/scheduler observability layer: the
+    DeviceStatsCollector snapshot (compute/compile/tick/transfer/HBM)
+    with the SLO engine's per-model state alongside under ``"slo"``.
+    ``?model=`` filters the per-model sections."""
+    model = request.query.get("model") or None
+
+    def _snap():
+        out = core.device_stats.snapshot(model=model)
+        out["slo"] = core.slo.snapshot(model=model)
+        return json.dumps(out)
+
+    body = await asyncio.get_running_loop().run_in_executor(None, _snap)
+    return web.Response(text=body, content_type="application/json")
+
+
 async def _metrics(core, request):
     from .metrics import render_prometheus
 
+    # off-loop like /v2/debug/*: the device-stats rows sum O(window-events)
+    # under the collector lock — a scrape must not stall in-flight requests
+    text = await asyncio.get_running_loop().run_in_executor(
+        None, render_prometheus, core)
     return web.Response(
-        text=render_prometheus(core),
+        text=text,
         content_type="text/plain",
         charset="utf-8",
     )

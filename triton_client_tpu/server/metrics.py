@@ -4,24 +4,37 @@ The reference *client* has no metrics endpoint (SURVEY.md §5: "No
 Prometheus-style client metrics"), but the server it targets famously
 exposes one; a reference user switching here expects ``GET /metrics``.
 Metric names follow Triton's server conventions (``nv_inference_*``,
-``nv_cache_*``) so existing dashboards and scrapers keep working unchanged.
+``nv_cache_*``; the device family ``nv_tpu_*`` mirrors the reference
+server's ``nv_gpu_*``) so existing dashboards and scrapers keep working
+unchanged.
+
+Every family is declared exactly once, in :func:`collect_families` —
+``(name, help, type, sample rows)`` — and both export surfaces render
+from that one registry: :func:`render_prometheus` (the text exposition)
+and :func:`snapshot` (the JSON shape bench.py and the registry-lint test
+consume).  A family added to one surface therefore cannot silently drift
+from the other — ``tests/test_tools_import.py`` asserts the parity.
 
 Families: the per-model inference counters, the
-``nv_inference_pending_request_count`` gauge (requests inside the core's
-infer path right now), response-cache hit/miss counters per model (the
-``_ResponseCache`` in ``core.py``), and the dynamic batcher's cumulative
-batch-size counter (``nv_inference_batch_size_total / nv_inference_batch
-_execution_count`` = average formed batch).  The *client* half of the
+``nv_inference_pending_request_count`` gauge, response-cache outcomes,
+dynamic-batcher batch accounting, flight-recorder watchdog counters,
+resilience/QoS series, the device & scheduler observability layer
+(``nv_tpu_*``: duty cycle, live MFU, XLA compile events, host<->device
+transfers, HBM, per-bucket tick/pad-waste series — ``device_stats.py``),
+and the SLO burn-rate engine (``nv_slo_*``).  The *client* half of the
 observability subsystem renders separately — see
 ``triton_client_tpu._telemetry.ClientTelemetry.render_prometheus``.
 """
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import Any, Dict, List, Tuple
 
 from .._telemetry import escape_label as _escape_label
 from .core import InferenceCore
+
+#: One declared family: (name, help text, type, [(labels, value), ...]).
+Family = Tuple[str, str, str, List[Tuple[Dict[str, str], Any]]]
 
 _COUNTERS: List[Tuple[str, str, str]] = [
     # (metric name, help text, ModelStats-derived key)
@@ -53,11 +66,84 @@ _GAUGES: List[Tuple[str, str, str]] = [
      "execution", "pending"),
 ]
 
+#: ``nv_tpu_*`` family declarations, keyed by the short row name
+#: ``DeviceStatsCollector.metric_rows`` emits.
+_DEVICE_FAMILIES: List[Tuple[str, str, str, str]] = [
+    # (row key, metric name, type, help)
+    ("duty_cycle", "nv_tpu_duty_cycle", "gauge",
+     "Fraction of the sliding window spent inside COMPUTE windows per "
+     "model (pipelined overlap clamps at 1.0)"),
+    ("live_mfu", "nv_tpu_live_mfu", "gauge",
+     "Windowed model FLOPs utilization: analytic FLOPs per executed "
+     "batch over elapsed compute time over chip peak"),
+    ("compile_total", "nv_tpu_compile_total", "counter",
+     "Number of XLA compilations (first execution of a new input-shape "
+     "signature) per model"),
+    ("compile_us", "nv_tpu_compile_duration_us", "counter",
+     "Cumulative wall time of compile-paying executions in microseconds"),
+    ("jit_hit", "nv_tpu_jit_cache_hit_total", "counter",
+     "Number of executions served from the jit compile cache (signature "
+     "already compiled)"),
+    ("jit_miss", "nv_tpu_jit_cache_miss_total", "counter",
+     "Number of executions that missed the jit compile cache (paid XLA "
+     "compilation)"),
+    ("transfer_total", "nv_tpu_transfer_total", "counter",
+     "Number of host<->device transfers (xla-shm staging DMAs and "
+     "executor D2H readback drains) by direction"),
+    ("transfer_bytes", "nv_tpu_transfer_bytes_total", "counter",
+     "Cumulative host<->device transfer bytes by direction"),
+    ("tick_total", "nv_tpu_tick_total", "counter",
+     "Number of dynamic-batcher ticks (batched executions) per model and "
+     "bucket"),
+    ("tick_batch", "nv_tpu_tick_batch_total", "counter",
+     "Cumulative real (unpadded) batch elements executed per model and "
+     "bucket"),
+    ("tick_padded", "nv_tpu_tick_padded_total", "counter",
+     "Cumulative padded batch elements executed per model and bucket"),
+    ("tick_assembly_us", "nv_tpu_tick_assembly_duration_us", "counter",
+     "Cumulative tick assembly (concat + pad-to-bucket) time in "
+     "microseconds per model and bucket"),
+    ("tick_queue_depth", "nv_tpu_tick_queue_depth_total", "counter",
+     "Cumulative queue depth observed at tick assembly per model and "
+     "bucket (divide by nv_tpu_tick_total for the average)"),
+    ("tick_syncs", "nv_tpu_tick_sync_total", "counter",
+     "Cumulative host<->device synchronization points paid by batcher "
+     "ticks per model and bucket"),
+    ("pad_waste", "nv_tpu_pad_waste_ratio", "gauge",
+     "Cumulative padded-but-unused fraction of executed batch slots per "
+     "model and bucket"),
+    ("mem_used", "nv_tpu_memory_used_bytes", "gauge",
+     "Device HBM bytes currently in use"),
+    ("mem_peak", "nv_tpu_memory_peak_bytes", "gauge",
+     "Peak device HBM bytes in use since process start"),
+    ("mem_limit", "nv_tpu_memory_limit_bytes", "gauge",
+     "Device HBM capacity available to this process"),
+]
 
-def render_prometheus(core: InferenceCore) -> str:
-    """All per-model series in the Prometheus text exposition format."""
+#: ``nv_slo_*`` family declarations, keyed by ``SloEngine.metric_rows``.
+_SLO_FAMILIES: List[Tuple[str, str, str, str]] = [
+    ("burn_rate", "nv_slo_burn_rate", "gauge",
+     "SLO error-budget burn rate (observed bad fraction over error "
+     "budget) per model and window; 1.0 consumes the budget exactly at "
+     "the sustainable rate"),
+    ("budget_remaining", "nv_slo_budget_remaining", "gauge",
+     "SLO error-budget fraction remaining over the long window per model "
+     "(negative = overdrawn)"),
+    ("breach_pins", "nv_slo_breach_total", "counter",
+     "Number of SLO-bad requests pinned into the flight recorder while "
+     "their model was breaching its multi-window burn threshold"),
+    ("burn_threshold", "nv_slo_burn_threshold", "gauge",
+     "Configured multi-window breach threshold: a model breaches when "
+     "both the 5m and 1h burn rates exceed this"),
+]
+
+
+def collect_families(core: InferenceCore) -> List[Family]:
+    """Every server metric family, declared once: the single source both
+    the Prometheus text renderer and the JSON snapshot derive from."""
     keys = [key for _, _, key in _COUNTERS] + [key for _, _, key in _GAUGES]
-    rows = {key: [] for key in keys}
+    rows: Dict[str, List[Tuple[Dict[str, str], Any]]] = \
+        {key: [] for key in keys}
     for m in core.registry.all_version_models():
         s = m.stats
         with s.lock:
@@ -73,22 +159,15 @@ def render_prometheus(core: InferenceCore) -> str:
                 "batch_exec": s.batch_execution_count,
                 "pending": s.pending_count,
             }
-        labels = (f'model="{_escape_label(m.name)}",'
-                  f'version="{_escape_label(m.served_version)}"')
+        labels = {"model": m.name, "version": m.served_version}
         for key, value in values.items():
-            rows[key].append(f"{{{labels}}} {value}")
+            rows[key].append((labels, value))
 
-    lines: List[str] = []
+    families: List[Family] = []
     for name, help_text, key in _COUNTERS:
-        lines.append(f"# HELP {name} {help_text}")
-        lines.append(f"# TYPE {name} counter")
-        for row in rows[key]:
-            lines.append(f"{name}{row}")
+        families.append((name, help_text, "counter", rows[key]))
     for name, help_text, key in _GAUGES:
-        lines.append(f"# HELP {name} {help_text}")
-        lines.append(f"# TYPE {name} gauge")
-        for row in rows[key]:
-            lines.append(f"{name}{row}")
+        families.append((name, help_text, "gauge", rows[key]))
 
     # model-name-only counter families: response-cache outcomes (tracked
     # per NAME by the core's LRU — cache keys carry the name, version
@@ -100,7 +179,7 @@ def render_prometheus(core: InferenceCore) -> str:
     cache = core.response_cache
     slow_by_model, captured_by_model = \
         core.flight_recorder.watchdog_counters()
-    families = [
+    by_model = [
         ("nv_cache_num_hits_per_model",
          "Number of response cache hits per model", cache.hits_by_model),
         ("nv_cache_num_misses_per_model",
@@ -122,41 +201,81 @@ def render_prometheus(core: InferenceCore) -> str:
          "expired before execution", dict(core.deadline_exceeded_by_model)),
     ]
     if core.chaos is not None:
-        families.append(
+        by_model.append(
             ("nv_chaos_injected_total",
              "Number of faults injected by the chaos harness",
              core.chaos.counters()))
-    for name, help_text, counts in families:
-        lines.append(f"# HELP {name} {help_text}")
-        lines.append(f"# TYPE {name} counter")
-        for model, value in sorted(counts.items()):
-            lines.append(f'{name}{{model="{_escape_label(model)}"}} {value}')
+    for name, help_text, counts in by_model:
+        families.append((name, help_text, "counter",
+                         [({"model": model}, value)
+                          for model, value in sorted(counts.items())]))
 
     # -- QoS families (server/qos.py) -------------------------------------
     # sheds carry the full (model, tenant, tier) classification so a
     # dashboard can answer "who is being shed, at what priority, where"
-    lines.append("# HELP nv_inference_rejected_total Number of inference "
-                 "requests shed by admission control (tenant rate limit, "
-                 "tier queue threshold, or lower-tier preemption)")
-    lines.append("# TYPE nv_inference_rejected_total counter")
-    for (model, tenant, tier), value in sorted(
-            core.qos.rejected_counts().items()):
-        lines.append(
-            f'nv_inference_rejected_total{{model="{_escape_label(model)}",'
-            f'tenant="{_escape_label(tenant)}",tier="{tier}"}} {value}')
-    lines.append("# HELP nv_qos_tenant_requests_total Number of inference "
-                 "requests per tenant and QoS tier (admitted or shed)")
-    lines.append("# TYPE nv_qos_tenant_requests_total counter")
-    for (tenant, tier), value in sorted(
-            core.qos.tenant_request_counts().items()):
-        lines.append(
-            f'nv_qos_tenant_requests_total{{tenant="{_escape_label(tenant)}"'
-            f',tier="{tier}"}} {value}')
-    lines.append("# HELP nv_qos_queue_depth Requests currently queued in "
-                 "the dynamic batcher per model and QoS tier")
-    lines.append("# TYPE nv_qos_queue_depth gauge")
-    for (model, tier), value in sorted(core.qos_queue_depths().items()):
-        lines.append(
-            f'nv_qos_queue_depth{{model="{_escape_label(model)}",'
-            f'tier="{tier}"}} {value}')
+    families.append((
+        "nv_inference_rejected_total",
+        "Number of inference requests shed by admission control (tenant "
+        "rate limit, tier queue threshold, or lower-tier preemption)",
+        "counter",
+        [({"model": model, "tenant": tenant, "tier": str(tier)}, value)
+         for (model, tenant, tier), value in sorted(
+             core.qos.rejected_counts().items())]))
+    families.append((
+        "nv_qos_tenant_requests_total",
+        "Number of inference requests per tenant and QoS tier (admitted "
+        "or shed)", "counter",
+        [({"tenant": tenant, "tier": str(tier)}, value)
+         for (tenant, tier), value in sorted(
+             core.qos.tenant_request_counts().items())]))
+    families.append((
+        "nv_qos_queue_depth",
+        "Requests currently queued in the dynamic batcher per model and "
+        "QoS tier", "gauge",
+        [({"model": model, "tier": str(tier)}, value)
+         for (model, tier), value in sorted(
+             core.qos_queue_depths().items())]))
+
+    # -- device & scheduler observability (server/device_stats.py) --------
+    device_rows = core.device_stats.metric_rows()
+    for key, name, kind, help_text in _DEVICE_FAMILIES:
+        families.append((name, help_text, kind, device_rows.get(key, [])))
+    slo_rows = core.slo.metric_rows()
+    for key, name, kind, help_text in _SLO_FAMILIES:
+        families.append((name, help_text, kind, slo_rows.get(key, [])))
+    return families
+
+
+def _render_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def render_prometheus(core: InferenceCore) -> str:
+    """All per-model series in the Prometheus text exposition format."""
+    lines: List[str] = []
+    for name, help_text, kind, rows in collect_families(core):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, value in rows:
+            lines.append(f"{name}{_render_labels(labels)} {value}")
     return "\n".join(lines) + "\n"
+
+
+def snapshot(core: InferenceCore) -> Dict[str, Any]:
+    """The same families as JSON: ``{family: {"help", "type", "samples":
+    [{"labels": {...}, "value": v}]}}`` — the machine-readable sibling of
+    ``/metrics`` (bench.py records from it; the registry-lint test
+    asserts it never drifts from the text surface)."""
+    return {
+        name: {
+            "help": help_text,
+            "type": kind,
+            "samples": [{"labels": dict(labels), "value": value}
+                        for labels, value in rows],
+        }
+        for name, help_text, kind, rows in collect_families(core)
+    }

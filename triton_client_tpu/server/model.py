@@ -264,6 +264,28 @@ class Model(abc.ABC):
         """Classification labels for an output, if provided."""
         return None
 
+    def flops_per_element(self) -> Optional[float]:
+        """Analytic forward FLOPs per batch element — the live-MFU
+        numerator (``nv_tpu_live_mfu``).  Resolution: the model config's
+        ``flops_per_inference`` parameter (a float string), else None (no
+        MFU series for this model — unknown must read as absent, not 0%).
+        Memoized: the config never changes under a live instance."""
+        cached = getattr(self, "_flops_pe_cache", False)
+        if cached is not False:
+            return cached
+        value: Optional[float] = None
+        if "flops_per_inference" in self.config.parameters:
+            try:
+                parsed = float(
+                    self.config.parameters["flops_per_inference"]
+                    .string_value)
+                if parsed > 0:
+                    value = parsed
+            except ValueError:
+                pass
+        self._flops_pe_cache = value
+        return value
+
     def unload(self) -> None:
         """Hook for releasing device buffers on model unload."""
 

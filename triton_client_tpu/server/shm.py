@@ -141,6 +141,10 @@ class XlaShmRegistry:
         # the wire: the v2 shm status schema is fixed)
         self.stats = {"staging_imports": 0, "cache_hits": 0,
                       "slot_reads": 0}
+        # the core's DeviceStatsCollector (set by InferenceCore): staging
+        # H2D imports / D2H write-backs land in nv_tpu_transfer_* so the
+        # one DMA each cross-process shm request costs is a visible series
+        self.device_stats = None
 
     def register(self, name: str, raw_handle: bytes, device_id: int, byte_size: int) -> None:
         try:
@@ -254,6 +258,8 @@ class XlaShmRegistry:
             # the one host->device DMA a cross-process region costs per
             # import — the span the zero-copy slot path never records
             trace.add_span("H2D_TRANSFER", t0, time.monotonic_ns())
+        if self.device_stats is not None:
+            self.device_stats.record_transfer("h2d", host.nbytes)
         self.stats["staging_imports"] += 1
         if key is not None:
             region.cache = (key, arr)
@@ -284,10 +290,13 @@ class XlaShmRegistry:
         trace = current_trace()
         t0 = time.monotonic_ns() if trace is not None else 0
         host = np.asarray(data)
-        if trace is not None and not isinstance(data, np.ndarray):
+        if not isinstance(data, np.ndarray):
             # device-resident output resolving into a staging region: the
             # np.asarray above was a blocking device->host readback
-            trace.add_span("D2H_TRANSFER", t0, time.monotonic_ns())
+            if trace is not None:
+                trace.add_span("D2H_TRANSFER", t0, time.monotonic_ns())
+            if self.device_stats is not None:
+                self.device_stats.record_transfer("d2h", host.nbytes)
         if host.nbytes > ref.byte_size:
             raise InferError(
                 f"shared memory region '{ref.region_name}' too small for output"

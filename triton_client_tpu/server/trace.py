@@ -176,7 +176,7 @@ class TraceContext:
     __slots__ = ("_tracer", "id", "model_name", "model_version",
                  "timestamps", "path", "client_request_id", "traceparent",
                  "spans", "log_frequency", "_root", "_done", "sampled",
-                 "flight")
+                 "flight", "tick")
 
     def __init__(self, tracer: "RequestTracer", trace_id: int,
                  model_name: str, model_version: str, path: str,
@@ -200,6 +200,10 @@ class TraceContext:
         # FlightRecord of this request when the flight recorder is on
         # (completed — and possibly pinned — when the context emits)
         self.flight = None
+        # batcher tick record (device_stats): which bucket/occupancy this
+        # request's batched execution rode — emitted with the trace so
+        # trace_summary's buckets view can fold sampled traces by tick
+        self.tick = None
 
     def ts(self, name: str, ns: Optional[int] = None) -> None:
         if not self.sampled:
@@ -473,6 +477,10 @@ class RequestTracer:
                  "parent": s.parent}
                 for s in ctx.spans
             ]
+        if ctx.tick is not None:
+            # the batcher tick this request rode (bucket, occupancy, pad
+            # waste, queue depth) — trace_summary folds these per bucket
+            record["tick"] = ctx.tick
         # propagated client trace context: the join key between this record
         # and the client's telemetry (absent keys = request was not stamped)
         if ctx.client_request_id:

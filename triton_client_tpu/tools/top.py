@@ -4,9 +4,13 @@ Polls two HTTP surfaces — ``GET /metrics`` (the Triton-convention
 ``nv_inference_*`` counters) and ``GET /v2/debug/flight_recorder`` (the
 always-on flight recorder's live per-model quantiles + pinned outliers) —
 and renders one refreshing per-model table: QPS, p50/p99, queue share,
-realized batch, in-flight requests, error rate, watchdog counters, and the
-most recent pinned outlier.  "What is the server doing right now" becomes
-one command::
+realized batch, in-flight requests, error rate, watchdog counters, device
+duty cycle, the SLO burn rate (with a ``!`` breach marker when both the
+5m and 1h windows burn over the fast-burn threshold), and the most recent
+pinned outlier — plus a **buckets** view (one line per model/bucket with
+tick rate, realized occupancy, pad-waste %, assembly cost, and queue
+depth) whenever the server exports ``nv_tpu_tick_*`` series.  "What is
+the server doing right now" becomes one command::
 
     triton-top --url localhost:8000            # live, refresh every 2s
     triton-top --url localhost:8000 --once --json   # one snapshot, JSON
@@ -53,8 +57,10 @@ _METRICS = (
 )
 
 # greedy label block up to the LAST `}` before the value: a label value
-# may contain a literal `}` (tenant ids are client-supplied octets)
-_SERIES_RE = re.compile(r'^(\w+)\{(.*)\}\s+([0-9.eE+-]+)\s*$')
+# may contain a literal `}` (tenant ids are client-supplied octets); the
+# block is optional — unlabeled gauges (nv_slo_burn_threshold) match with
+# a None label group
+_SERIES_RE = re.compile(r'^(\w+)(?:\{(.*)\})?\s+([0-9.eE+-]+)\s*$')
 _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 
 
@@ -76,11 +82,63 @@ def parse_metrics(text: str) -> Dict[str, Dict[str, float]]:
         name, labels_raw, value = m.groups()
         if name not in out:
             continue
-        labels = dict(_LABEL_RE.findall(labels_raw))
+        labels = dict(_LABEL_RE.findall(labels_raw or ""))
         model = labels.get("model", "")
         if not model:
             continue
         out[name][model] = out[name].get(model, 0.0) + float(value)
+    return out
+
+
+#: nv_tpu_tick_* families folded into the buckets view, keyed by the
+#: short field name the rows use.
+_BUCKET_METRICS = {
+    "nv_tpu_tick_total": "ticks",
+    "nv_tpu_tick_batch_total": "batch",
+    "nv_tpu_tick_padded_total": "padded",
+    "nv_tpu_tick_assembly_duration_us": "assembly_us",
+    "nv_tpu_tick_queue_depth_total": "queue_depth",
+    "nv_tpu_tick_sync_total": "syncs",
+}
+
+
+def parse_device(text: str) -> Dict[str, Any]:
+    """Device/SLO series -> ``{"duty": {model: v}, "mfu": {model: v},
+    "burn": {(model, window): v}, "burn_threshold": v, "buckets":
+    {(model, bucket): {field: v}}}``.  Servers predating the device-stats
+    layer simply produce empty maps (and the default threshold)."""
+    out: Dict[str, Any] = {"duty": {}, "mfu": {}, "burn": {}, "buckets": {},
+                           "burn_threshold": 14.4}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value = m.groups()
+        if name == "nv_slo_burn_threshold":
+            # the server's configured page condition — the "!" breach
+            # marker must agree with a non-default --slo-burn-threshold
+            out["burn_threshold"] = float(value)
+            continue
+        if name not in ("nv_tpu_duty_cycle", "nv_tpu_live_mfu",
+                        "nv_slo_burn_rate") and name not in _BUCKET_METRICS:
+            continue
+        labels = dict(_LABEL_RE.findall(labels_raw or ""))
+        model = labels.get("model", "")
+        if not model:
+            continue
+        if name == "nv_tpu_duty_cycle":
+            out["duty"][model] = float(value)
+        elif name == "nv_tpu_live_mfu":
+            out["mfu"][model] = float(value)
+        elif name == "nv_slo_burn_rate":
+            out["burn"][(model, labels.get("window", ""))] = float(value)
+        else:
+            bucket = labels.get("bucket", "")
+            entry = out["buckets"].setdefault((model, bucket), {})
+            entry[_BUCKET_METRICS[name]] = entry.get(
+                _BUCKET_METRICS[name], 0.0) + float(value)
     return out
 
 
@@ -102,7 +160,7 @@ def parse_qos(text: str) -> Dict[str, Dict[tuple, float]]:
             bucket = out["shed"]
         else:
             continue
-        labels = dict(_LABEL_RE.findall(labels_raw))
+        labels = dict(_LABEL_RE.findall(labels_raw or ""))
         tenant = labels.get("tenant")
         if tenant is None:
             continue  # pre-QoS model-only series
@@ -121,6 +179,7 @@ def sample(base_url: str, timeout: float, limit: int = 0) -> Dict[str, Any]:
         "t": time.monotonic(),
         "metrics": parse_metrics(metrics_text),
         "qos": parse_qos(metrics_text),
+        "device": parse_device(metrics_text),
         "recorder": json.loads(_fetch(recorder_url, timeout)),
     }
 
@@ -175,6 +234,11 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
                             "nv_inference_deadline_exceeded_total", model)
         total = succ + fail
         rec = recorder.get("models", {}).get(model, {})
+        device = cur.get("device") or {}
+        duty = device.get("duty", {}).get(model)
+        mfu = device.get("mfu", {}).get(model)
+        burn5 = device.get("burn", {}).get((model, "5m"))
+        burn1h = device.get("burn", {}).get((model, "1h"))
         rows[model] = {
             "qps": round(total / dt, 1) if dt else None,
             "p50_ms": rec.get("p50_ms"),
@@ -194,9 +258,90 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "slow_total": rec.get("slow_total", 0),
             "captured_total": rec.get("captured_total", 0),
             "threshold_ms": rec.get("threshold_ms"),
+            # device/SLO layer (absent on servers predating it)
+            "duty_pct": (round(100.0 * duty, 1)
+                         if duty is not None else None),
+            "mfu_pct": round(100.0 * mfu, 1) if mfu is not None else None,
+            "burn_5m": round(burn5, 1) if burn5 is not None else None,
+            "burn_1h": round(burn1h, 1) if burn1h is not None else None,
+            # multi-window breach at the server's exported threshold
+            # (nv_slo_burn_threshold): both windows burning — the page
+            # condition, matching what the server itself pins on
+            "slo_breach": (burn5 is not None and burn1h is not None
+                           and burn5 >= device.get("burn_threshold", 14.4)
+                           and burn1h >= device.get("burn_threshold", 14.4)),
             "last_outlier": _outlier_brief(last_outlier.get(model)),
         }
     return rows
+
+
+def bucket_rows(cur: Dict[str, Any],
+                prev: Optional[Dict[str, Any]]) -> Dict[tuple, Dict[str, Any]]:
+    """Per-(model, bucket) tick rows — the buckets view (ROADMAP item 2's
+    bucket-geometry tuning surface).  Rates are deltas between polls;
+    occupancy/pad-waste/assembly columns are averaged over the delta
+    window (cumulative on the first/only sample)."""
+    device = cur.get("device") or {}
+    pdevice = (prev.get("device") or {}) if prev else {}
+    dt = (cur["t"] - prev["t"]) if prev else None
+    rows: Dict[tuple, Dict[str, Any]] = {}
+    for key, cum in sorted(device.get("buckets", {}).items()):
+        pcum = pdevice.get("buckets", {}).get(key)
+
+        def delta(field: str) -> float:
+            now = cum.get(field, 0.0)
+            if pcum is None:
+                return now
+            d = now - pcum.get(field, 0.0)
+            return now if d < 0 else d  # counter reset = server restart
+
+        ticks = delta("ticks")
+        batch, padded = delta("batch"), delta("padded")
+        rows[key] = {
+            "ticks_per_s": round(ticks / dt, 1) if dt else None,
+            "ticks": cum.get("ticks", 0.0),
+            "avg_batch": round(batch / ticks, 1) if ticks else None,
+            "pad_pct": (round(100.0 * (1.0 - batch / padded), 1)
+                        if padded else None),
+            "avg_assembly_us": (round(delta("assembly_us") / ticks, 1)
+                                if ticks else None),
+            "avg_queue_depth": (round(delta("queue_depth") / ticks, 1)
+                                if ticks else None),
+            "syncs_per_tick": (round(delta("syncs") / ticks, 2)
+                               if ticks else None),
+        }
+    return rows
+
+
+def aggregate_buckets(per_url: Dict[str, Dict[tuple, Dict[str, Any]]]
+                      ) -> Dict[tuple, Dict[str, Any]]:
+    """Fleet buckets view: tick rates sum; occupancy/pad/assembly columns
+    take the worst replica (the straggler bucket is the tuning target)."""
+    agg: Dict[tuple, Dict[str, Any]] = {}
+    keys: set = set()
+    for rows in per_url.values():
+        keys.update(rows)
+    for key in sorted(keys):
+        rows = [r[key] for r in per_url.values() if key in r]
+
+        def _sum(field, nd=1):
+            vals = [r[field] for r in rows if r.get(field) is not None]
+            return round(sum(vals), nd) if vals else None
+
+        def _worst(field):
+            vals = [r[field] for r in rows if r.get(field) is not None]
+            return max(vals) if vals else None
+
+        agg[key] = {
+            "ticks_per_s": _sum("ticks_per_s"),
+            "ticks": sum(r.get("ticks", 0.0) for r in rows),
+            "avg_batch": _worst("avg_batch"),
+            "pad_pct": _worst("pad_pct"),
+            "avg_assembly_us": _worst("avg_assembly_us"),
+            "avg_queue_depth": _worst("avg_queue_depth"),
+            "syncs_per_tick": _worst("syncs_per_tick"),
+        }
+    return agg
 
 
 def tenant_rows(cur: Dict[str, Any],
@@ -336,6 +481,13 @@ def aggregate_rows(per_url_rows: Dict[str, Dict[str, Dict[str, Any]]]
             "slow_total": sum(r["slow_total"] for r in rows),
             "captured_total": sum(r["captured_total"] for r in rows),
             "threshold_ms": _worst("threshold_ms"),
+            # device/SLO columns: worst replica (the fleet pages on its
+            # hottest/most-burning member, not the average)
+            "duty_pct": _worst("duty_pct"),
+            "mfu_pct": _worst("mfu_pct"),
+            "burn_5m": _worst("burn_5m"),
+            "burn_1h": _worst("burn_1h"),
+            "slo_breach": any(r.get("slo_breach") for r in rows),
             "last_outlier": (min(outliers, key=lambda o: o["age_s"])
                             if outliers else None),
         }
@@ -354,7 +506,7 @@ def _fmt(v, nd: int = 1) -> str:
 
 _COLUMNS = (f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
             f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'REJ/s':>7}{'DLX/s':>7}"
-            f"{'SLOW':>6}{'CAPT':>6}"
+            f"{'SLOW':>6}{'CAPT':>6}{'DUTY%':>7}{'BURN':>7}"
             f"  LAST OUTLIER")
 
 
@@ -370,18 +522,55 @@ def _row_line(label: str, r: Dict[str, Any]) -> str:
             brief += f" [chaos:{o['chaos']}]"
         if o["outcome"] != "ok":
             brief += f" ({o['outcome'][:40]})"
+    # the breach marker rides the burn column: "23.1!" = both windows
+    # over the fast-burn threshold (the page condition)
+    burn = _fmt(r.get("burn_5m"))
+    if r.get("slo_breach"):
+        burn += "!"
     return (
         f"  {label:<24}{_fmt(r['qps']):>8}{_fmt(r['p50_ms']):>9}"
         f"{_fmt(r['p99_ms']):>9}{_fmt(r['queue_share_pct']):>8}"
         f"{_fmt(r['batch_avg']):>7}{r['pending']:>6}"
         f"{_fmt(r['error_pct'], 2):>7}{_fmt(r['rejected_per_s']):>7}"
         f"{_fmt(r['deadline_exceeded_per_s']):>7}{r['slow_total']:>6}"
-        f"{r['captured_total']:>6}  {brief}")
+        f"{r['captured_total']:>6}{_fmt(r.get('duty_pct')):>7}"
+        f"{burn:>7}  {brief}")
+
+
+def _bucket_rank(bucket: Any) -> tuple:
+    """Numeric-first sort key for bucket labels (Prometheus hands them
+    back as strings: "8" must come before "16", not after "128")."""
+    try:
+        return (0, int(bucket))
+    except (TypeError, ValueError):
+        return (1, str(bucket))
+
+
+def _bucket_lines(rows: Dict[tuple, Dict[str, Any]]) -> List[str]:
+    """The buckets view: one line per (model, bucket) with tick rate,
+    realized occupancy, pad waste, assembly cost, and queue depth — the
+    read-the-dashboard surface for bucket-geometry tuning."""
+    if not rows:
+        return []
+    rated = any(r.get("ticks_per_s") is not None for r in rows.values())
+    tick_hdr = "TICK/s" if rated else "TICKS"
+    lines = ["", f"  {'MODEL/BUCKET':<24}{tick_hdr:>8}{'AVGBATCH':>10}"
+                 f"{'PAD%':>7}{'ASM us':>9}{'QDEPTH':>8}{'SYNC/T':>8}"]
+    for (model, bucket), r in sorted(
+            rows.items(), key=lambda kv: (kv[0][0], _bucket_rank(kv[0][1]))):
+        ticks = r["ticks_per_s"] if rated else r.get("ticks")
+        lines.append(
+            f"  {model + '@' + str(bucket):<24}{_fmt(ticks):>8}"
+            f"{_fmt(r['avg_batch']):>10}{_fmt(r['pad_pct']):>7}"
+            f"{_fmt(r['avg_assembly_us']):>9}{_fmt(r['avg_queue_depth']):>8}"
+            f"{_fmt(r['syncs_per_tick'], 2):>8}")
+    return lines
 
 
 def render(url: str, cur: Dict[str, Any],
            rows: Dict[str, Dict[str, Any]], interval: float,
-           tenants: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
+           tenants: Optional[Dict[str, Dict[str, Any]]] = None,
+           buckets: Optional[Dict[tuple, Dict[str, Any]]] = None) -> str:
     recorder = cur["recorder"]
     lines = [
         f"triton-top — {url} — {time.strftime('%H:%M:%S')}  "
@@ -397,6 +586,7 @@ def render(url: str, cur: Dict[str, Any],
         lines.append(_row_line(model, r))
     if not rows:
         lines.append("  (no recorded requests yet)")
+    lines.extend(_bucket_lines(buckets or {}))
     lines.extend(_tenant_lines(tenants or {}))
     return "\n".join(lines) + "\n"
 
@@ -404,7 +594,8 @@ def render(url: str, cur: Dict[str, Any],
 def render_fleet(urls: List[str],
                  per_url_rows: Dict[str, Dict[str, Dict[str, Any]]],
                  agg: Dict[str, Dict[str, Any]], interval: float,
-                 tenants: Optional[Dict[str, Dict[str, Any]]] = None
+                 tenants: Optional[Dict[str, Dict[str, Any]]] = None,
+                 buckets: Optional[Dict[tuple, Dict[str, Any]]] = None
                  ) -> str:
     """Fleet view: one aggregated row per model (sums + worst-replica
     tails) with a per-server breakdown row for every polled endpoint."""
@@ -423,8 +614,18 @@ def render_fleet(urls: List[str],
                 lines.append(_row_line(f" └ {u}", rows[model]))
     if not agg:
         lines.append("  (no recorded requests yet)")
+    lines.extend(_bucket_lines(buckets or {}))
     lines.extend(_tenant_lines(tenants or {}))
     return "\n".join(lines) + "\n"
+
+
+def _buckets_json(rows: Dict[tuple, Dict[str, Any]]) -> Dict[str, Any]:
+    """Tuple-keyed bucket rows -> ``{model: {bucket: row}}`` for JSON."""
+    out: Dict[str, Any] = {}
+    for (model, bucket), r in sorted(
+            rows.items(), key=lambda kv: (kv[0][0], _bucket_rank(kv[0][1]))):
+        out.setdefault(model, {})[str(bucket)] = r
+    return out
 
 
 # -- CLI --------------------------------------------------------------------
@@ -503,9 +704,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     def fold(cur, prev):
         """Per-server rows + the fleet aggregates from one (or two)
-        polls; the third return is the per-tenant QoS aggregate."""
+        polls; also returns the per-tenant QoS aggregate and the
+        (model, bucket) tick aggregate."""
         per_url = {}
         per_url_tenants = {}
+        per_url_buckets = {}
         for base, s in cur.items():
             if s is None:
                 continue
@@ -513,14 +716,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             per_url[base] = model_rows(s, p,
                                        include_idle=args.include_idle)
             per_url_tenants[base] = tenant_rows(s, p)
+            per_url_buckets[base] = bucket_rows(s, p)
         return (per_url, aggregate_rows(per_url),
-                aggregate_tenants(per_url_tenants))
+                aggregate_tenants(per_url_tenants),
+                aggregate_buckets(per_url_buckets))
 
     cur = sample_all()
     if all(s is None for s in cur.values()):
         return 1
     if args.once:
-        per_url, agg, tenants = fold(cur, None)
+        per_url, agg, tenants, buckets = fold(cur, None)
         if args.as_json:
             if fleet:
                 out = {
@@ -528,6 +733,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "ts": time.time(),
                     "models": agg,
                     "tenants": tenants,
+                    "buckets": _buckets_json(buckets),
                     # per-endpoint samples: each server's rows + recorder
                     "endpoints": {
                         base: (None if cur[base] is None else {
@@ -537,22 +743,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                     },
                 }
             else:
-                # single-url shape unchanged (scripting compat)
+                # single-url shape unchanged (scripting compat); buckets
+                # are additive — a new key, never a reshaped one
                 out = {
                     "url": bases[0],
                     "ts": time.time(),
                     "models": per_url.get(bases[0], {}),
                     "tenants": tenants,
+                    "buckets": _buckets_json(buckets),
                     "recorder": cur[bases[0]]["recorder"],
                 }
             print(json.dumps(out, indent=2))
         elif fleet:
             sys.stdout.write(render_fleet(bases, per_url, agg,
-                                          args.interval, tenants=tenants))
+                                          args.interval, tenants=tenants,
+                                          buckets=buckets))
         else:
             sys.stdout.write(render(bases[0], cur[bases[0]],
                                     per_url.get(bases[0], {}),
-                                    args.interval, tenants=tenants))
+                                    args.interval, tenants=tenants,
+                                    buckets=buckets))
         return 0
 
     prev = cur
@@ -565,13 +775,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # console alive and retry — monitoring must not die at
                 # exactly the moment the server gets interesting
                 continue
-            per_url, agg, tenants = fold(cur, prev)
+            per_url, agg, tenants, buckets = fold(cur, prev)
             if args.as_json:
                 print(json.dumps({
                     "ts": time.time(),
                     "models": agg if fleet else
                               next(iter(per_url.values()), {}),
                     "tenants": tenants,
+                    "buckets": _buckets_json(buckets),
                     **({"endpoints": {b: per_url.get(b)
                                       for b in bases}} if fleet else {}),
                 }))
@@ -581,12 +792,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 if fleet:
                     sys.stdout.write(render_fleet(bases, per_url, agg,
                                                   args.interval,
-                                                  tenants=tenants))
+                                                  tenants=tenants,
+                                                  buckets=buckets))
                 else:
                     sys.stdout.write(render(bases[0], cur[bases[0]],
                                             per_url.get(bases[0], {}),
                                             args.interval,
-                                            tenants=tenants))
+                                            tenants=tenants,
+                                            buckets=buckets))
                 sys.stdout.flush()
             # a server that missed THIS poll keeps its previous sample as
             # the delta base, so its next successful poll shows a sane rate

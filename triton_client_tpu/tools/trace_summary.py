@@ -135,6 +135,10 @@ def summarize(server_records: List[dict],
     models: Dict[str, Dict[str, Any]] = {}
     per_model_stage: Dict[str, Dict[str, List[int]]] = {}
     per_model_request: Dict[str, List[int]] = {}
+    # (model, bucket) -> accumulated tick fields (records that rode the
+    # dynamic batcher carry a "tick" object: bucket chosen, occupancy,
+    # pad waste, queue depth, assembly cost)
+    per_bucket: Dict[Tuple[str, int], Dict[str, Any]] = {}
     for rec in server_records:
         model = str(rec.get("model_name", "?"))
         stages = per_model_stage.setdefault(model, {})
@@ -144,6 +148,17 @@ def summarize(server_records: List[dict],
                 per_model_request.setdefault(model, []).append(dur)
             else:
                 stages.setdefault(name, []).append(dur)
+        tick = rec.get("tick")
+        if isinstance(tick, dict) and "bucket" in tick:
+            agg = per_bucket.setdefault((model, int(tick["bucket"])), {
+                "records": 0, "batch": [], "pad": [], "depth": [],
+                "assembly_us": []})
+            agg["records"] += 1
+            for field, key in (("batch", "batch"), ("pad", "pad_fraction"),
+                               ("depth", "queue_depth"),
+                               ("assembly_us", "assembly_us")):
+                if key in tick:
+                    agg[field].append(float(tick[key]))
     for model, stages in per_model_stage.items():
         requests = per_model_request.get(model, [])
         total_request_ns = sum(requests)
@@ -164,6 +179,22 @@ def summarize(server_records: List[dict],
         if "QUEUE" in stage_out:
             entry["queue_share_pct"] = stage_out["QUEUE"]["share_pct"]
         models[model] = entry
+    for (model, bucket), agg in sorted(per_bucket.items()):
+        entry = models.setdefault(model, {"count": 0, "request":
+                                          _stage_stats([]), "stages": {}})
+        n = agg["records"]
+
+        def _avg(vals):
+            return round(sum(vals) / len(vals), 2) if vals else None
+
+        entry.setdefault("buckets", {})[str(bucket)] = {
+            "records": n,
+            "avg_batch": _avg(agg["batch"]),
+            "pad_waste_pct": (round(100.0 * sum(agg["pad"]) / len(agg["pad"]),
+                                    1) if agg["pad"] else None),
+            "avg_queue_depth": _avg(agg["depth"]),
+            "avg_assembly_us": _avg(agg["assembly_us"]),
+        }
     summary: Dict[str, Any] = {
         "requests": len(server_records),
         "models": {m: models[m] for m in sorted(models)},
@@ -262,6 +293,21 @@ def format_text(summary: Dict[str, Any]) -> str:
             lines.append(
                 f"  queue share: "
                 f"{_fmt_val(entry['queue_share_pct'])}% of request time")
+        buckets = entry.get("buckets")
+        if buckets:
+            # the buckets view: which tick shapes the sampled requests
+            # rode, at what occupancy/pad waste — bucket-geometry tuning
+            # reads straight off this table
+            lines.append(f"  {'bucket':<10}{'records':>9}{'avg_batch':>11}"
+                         f"{'pad%':>7}{'qdepth':>8}{'asm_us':>9}")
+            for bucket, b in sorted(buckets.items(), key=lambda kv:
+                                    int(kv[0])):
+                lines.append(
+                    f"  {bucket:<10}{b['records']:>9}"
+                    f"{_fmt_val(b['avg_batch']):>11}"
+                    f"{_fmt_val(b['pad_waste_pct']):>7}"
+                    f"{_fmt_val(b['avg_queue_depth']):>8}"
+                    f"{_fmt_val(b['avg_assembly_us']):>9}")
     join = summary.get("join")
     if join is not None:
         lines.append("")
