@@ -276,6 +276,58 @@ class TestPoolRouting:
         assert pool.pick().url in ("a:1", "b:1")
 
 
+# -- unit: close() vs lazy executor creation ---------------------------------
+
+class TestClientClose:
+    def test_lazy_executor_after_close_raises_not_leaks(self):
+        """A hedge/probe racing close() must not build a fresh thread
+        pool after close detached the old one — the locked creation
+        path checks the closed flag and raises instead of leaking."""
+        c = ClusterClient(["a:1", "b:1"], protocol="http")
+        c.close()
+        with pytest.raises(InferenceServerException, match="closed"):
+            c._hedge_executor()
+        assert c._executor is None  # nothing leaked post-close
+
+    def test_lazy_client_after_close_raises_not_leaks(self):
+        """Same contract for the transport clients: a call racing
+        close() must not build a socket/channel into a dict nobody
+        will ever close again."""
+        c = ClusterClient(["a:1", "b:1"], protocol="http")
+        c.close()
+        ep = c.pool.endpoint("a:1")
+        with pytest.raises(InferenceServerException, match="closed"):
+            c._client_for(ep)
+        with pytest.raises(InferenceServerException, match="closed"):
+            c._probe_client_for(ep, timeout_s=1.0)
+        assert c._clients == {} and c._probe_clients == {}
+
+    def test_aio_lazy_client_after_close_raises_not_leaks(self):
+        """The aio client honors the same contract — a task resuming
+        after close() gets the typed error, not a fresh session/channel
+        leaked into an already-snapshotted dict."""
+        from triton_client_tpu.cluster.aio import ClusterClient as AioCC
+
+        async def scenario():
+            c = AioCC(["a:1", "b:1"], protocol="http")
+            await c.close()
+            ep = c.pool.endpoint("a:1")
+            with pytest.raises(InferenceServerException, match="closed"):
+                c._client_for(ep)
+            assert c._clients == {}
+
+        asyncio.run(scenario())
+
+    def test_close_shuts_down_created_executor(self):
+        c = ClusterClient(["a:1", "b:1"], protocol="http")
+        ex = c._hedge_executor()
+        assert c._hedge_executor() is ex  # memoized, not rebuilt
+        c.close()
+        assert c._executor is None
+        with pytest.raises(RuntimeError):  # pool really shut down
+            ex.submit(lambda: None)
+
+
 # -- unit: hedge policy ------------------------------------------------------
 
 class TestHedgePolicy:

@@ -565,6 +565,50 @@ class TestMetricsSnapshotParity:
         assert any(s["labels"] == {"model": "batchy", "bucket": "4"}
                    and s["value"] >= 1 for s in ticks)
 
+    def test_rendered_output_well_formed_and_sample_parity(self, server):
+        """The runtime half the static METRICS-DECL rule cannot see: the
+        *rendered* text declares every family exactly once (one HELP, one
+        TYPE), every sample line parses and belongs to a declared family,
+        and the JSON snapshot agrees type-for-type with matching per-family
+        series counts.  (The static rule checks the declaration literals;
+        this checks what render_prometheus actually emits.)"""
+        import re
+
+        from triton_client_tpu.server.metrics import (render_prometheus,
+                                                      snapshot)
+
+        _infer_batchy(server)
+        text = render_prometheus(server.core)
+        helps, types, samples, kinds = {}, {}, {}, {}
+        sample_re = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{.*\})? (.+)$")
+        for line in text.splitlines():
+            if line.startswith("# HELP "):
+                name = line.split(" ", 3)[2]
+                helps[name] = helps.get(name, 0) + 1
+            elif line.startswith("# TYPE "):
+                _, _, name, kind = line.split(" ", 3)
+                types[name] = types.get(name, 0) + 1
+                kinds[name] = kind
+            elif line.strip():
+                m = sample_re.match(line)
+                assert m, f"unparseable sample line: {line!r}"
+                samples[m.group(1)] = samples.get(m.group(1), 0) + 1
+        assert helps, "renderer emitted no families"
+        for name, n in helps.items():
+            assert n == 1, f"{name}: HELP declared {n} times"
+        for name, n in types.items():
+            assert n == 1, f"{name}: TYPE declared {n} times"
+        assert set(helps) == set(types), "HELP/TYPE sets differ"
+        orphans = set(samples) - set(helps)
+        assert not orphans, f"series without declarations: {orphans}"
+        snap = snapshot(server.core)
+        assert set(snap) == set(helps)
+        for name, entry in snap.items():
+            assert entry["type"] == kinds[name], name
+            # same number of series per family on both surfaces
+            assert len(entry["samples"]) == samples.get(name, 0), name
+
 
 # -- review regressions ------------------------------------------------------
 

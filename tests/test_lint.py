@@ -1,0 +1,1098 @@
+"""triton-lint: framework behavior, per-rule fixtures, and the tier-1 gate.
+
+Layout:
+
+* ``TestEngine`` — pragmas, baseline round-trip, JSON reporter shape (the
+  machine surface is pinned: scripts depend on every key), CLI contract.
+* one ``Test<Rule>`` class per rule with at least one positive (fires)
+  and one negative (passes) fixture — no vacuous checkers.
+* ``TestRepoGate`` — the tier-1 zero-finding gate: the full rule suite
+  over the repo at HEAD reports nothing non-baselined.  This is the test
+  that makes every invariant in ARCHITECTURE.md "Static analysis" a
+  commit-time contract instead of a review habit.
+
+Fixture family names and pragma text are built by concatenation where a
+literal would itself trip the repo-wide scans.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from triton_client_tpu.tools.lint import (Finding, build_project, main,
+                                          rule_names, run_rules)
+from triton_client_tpu.tools.lint._engine import (apply_baseline,
+                                                  load_baseline,
+                                                  render_json,
+                                                  write_baseline)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_dir(tmp_path, rule=None):
+    project = build_project([str(tmp_path)])
+    return run_rules(project, rules=[rule] if rule else None)
+
+
+def write(tmp_path, relpath, src):
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+# -- framework ---------------------------------------------------------------
+
+class TestEngine:
+    def test_rules_registered(self):
+        assert set(rule_names()) == {
+            "ASYNC-BLOCK", "LOCK-ORDER", "EXC-CONTRACT", "SPAN-PAIR",
+            "METRICS-DECL", "TEST-DETERMINISM",
+            # engine pseudo-rules, selectable like any other
+            "PARSE", "PRAGMA"}
+
+    def test_pragma_suppresses_with_reason(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import time
+            async def f():
+                time.sleep(1)  # tpu-lint: disable=ASYNC-BLOCK test fixture
+            """)
+        assert lint_dir(tmp_path, "ASYNC-BLOCK") == []
+
+    def test_pragma_on_line_above_suppresses(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import time
+            async def f():
+                # tpu-lint: disable=ASYNC-BLOCK covered by fixture
+                time.sleep(1)
+            """)
+        assert lint_dir(tmp_path, "ASYNC-BLOCK") == []
+
+    def test_pragma_wrong_rule_does_not_suppress(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import time
+            async def f():
+                time.sleep(1)  # tpu-lint: disable=LOCK-ORDER wrong rule
+            """)
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        assert len(found) == 1 and found[0].rule == "ASYNC-BLOCK"
+
+    def test_pragma_without_reason_is_a_finding(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import time
+            async def f():
+                time.sleep(1)  # tpu-lint: disable=ASYNC-BLOCK
+            """)
+        found = lint_dir(tmp_path)  # default set includes PRAGMA
+        assert [fd.rule for fd in found] == ["PRAGMA"]
+
+    def test_single_rule_run_skips_pseudo_rules(self, tmp_path):
+        """``--rule METRICS-DECL`` style runs must not fail on unrelated
+        reasonless pragmas or syntax errors elsewhere in the tree."""
+        write(tmp_path, "m.py", """
+            import time
+            async def f():
+                time.sleep(1)  # tpu-lint: disable=ASYNC-BLOCK
+            """)
+        write(tmp_path, "bad.py", "def broken(:\n")
+        assert lint_dir(tmp_path, "METRICS-DECL") == []
+        # but the pseudo-rules are individually selectable
+        project = build_project([str(tmp_path)])
+        assert [fd.rule for fd in run_rules(project, rules=["PRAGMA"])] \
+            == ["PRAGMA"]
+        assert [fd.rule for fd in run_rules(project, rules=["PARSE"])] \
+            == ["PARSE"]
+
+    def test_pragma_inside_string_not_honored(self, tmp_path):
+        write(tmp_path, "m.py", '''
+            import time
+            async def f():
+                s = "# tpu-lint: disable=ASYNC-BLOCK sneaky"
+                time.sleep(1)
+            ''')
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        assert len(found) == 1
+
+    def test_syntax_error_reports_parse_finding(self, tmp_path):
+        write(tmp_path, "bad.py", "def broken(:\n")
+        found = lint_dir(tmp_path)
+        assert [fd.rule for fd in found] == ["PARSE"]
+
+    def test_indentation_error_reports_parse_not_crash(self, tmp_path):
+        """tokenize raises IndentationError (a SyntaxError subclass, not
+        TokenError) on unindent mismatches — the pragma scan must swallow
+        it and let the PARSE finding report the file, not traceback the
+        whole run."""
+        (tmp_path / "bad.py").write_text("if 1:\n  x = 1\n y = 2\n")
+        found = lint_dir(tmp_path)
+        assert [fd.rule for fd in found] == ["PARSE"]
+
+    def test_nonexistent_path_exits_2(self, tmp_path, capsys):
+        """A renamed file in a CI invocation must fail loudly, never
+        report an empty-but-green run."""
+        assert main([str(tmp_path / "gone.py")]) == 2
+        assert "no such file" in capsys.readouterr().err
+
+    def test_hidden_and_venv_dirs_skipped(self, tmp_path):
+        """An in-repo virtualenv must not leak third-party code into the
+        zero-finding gate."""
+        write(tmp_path, "ok.py", "x = 1\n")
+        write(tmp_path, ".venv/lib/site-packages/dep/test_dep.py", """
+            import numpy as np
+            def test_x():
+                return np.random.rand()
+            """)
+        write(tmp_path, "venv/bad.py", """
+            import time
+            async def f():
+                time.sleep(1)
+            """)
+        assert lint_dir(tmp_path) == []
+
+    def test_unknown_rule_raises(self, tmp_path):
+        write(tmp_path, "m.py", "x = 1\n")
+        project = build_project([str(tmp_path)])
+        with pytest.raises(ValueError):
+            run_rules(project, rules=["NOPE"])
+
+    # -- baseline ----------------------------------------------------------
+    def test_baseline_round_trip(self, tmp_path, capsys):
+        src = write(tmp_path, "m.py", """
+            import time
+            async def f():
+                time.sleep(1)
+            """)
+        bl = tmp_path / "bl.json"
+        # 1) finding -> exit 1
+        assert main(["--rule", "ASYNC-BLOCK", "--no-baseline",
+                     str(tmp_path)]) == 1
+        # 2) grandfather it
+        assert main(["--rule", "ASYNC-BLOCK", "--write-baseline",
+                     "--baseline", str(bl), str(tmp_path)]) == 0
+        entries = load_baseline(str(bl))
+        assert len(entries) == 1 and entries[0]["rule"] == "ASYNC-BLOCK"
+        # 3) baselined -> exit 0, reported as baselined not fresh
+        capsys.readouterr()  # drain output of the runs above
+        assert main(["--rule", "ASYNC-BLOCK", "--baseline", str(bl),
+                     "--format", "json", str(tmp_path)]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["counts"]["fresh"] == 0
+        assert payload["counts"]["baselined"] == 1
+        # 4) fix the code -> the stale baseline entry fails the gate
+        #    (the baseline only ever shrinks)
+        src.write_text("async def f():\n    pass\n")
+        assert main(["--rule", "ASYNC-BLOCK", "--baseline", str(bl),
+                     str(tmp_path)]) == 1
+        assert "stale baseline" in capsys.readouterr().out
+
+    def test_partial_write_baseline_preserves_other_rules(self, tmp_path,
+                                                          capsys):
+        """--write-baseline with --rule merges: entries for rules NOT in
+        the run survive instead of being silently dropped."""
+        write(tmp_path, "m.py", """
+            import threading, time
+            LOCK_A = threading.Lock()
+            async def f():
+                time.sleep(1)
+            def g():
+                with LOCK_A:
+                    with LOCK_A:
+                        pass
+            """)
+        bl = tmp_path / "bl.json"
+        # full write: both rules' findings land
+        assert main(["--write-baseline", "--baseline", str(bl),
+                     str(tmp_path)]) == 0
+        rules = sorted(e["rule"] for e in load_baseline(str(bl)))
+        assert rules == ["ASYNC-BLOCK", "LOCK-ORDER"]
+        # single-rule refresh keeps the other rule's entry
+        assert main(["--rule", "ASYNC-BLOCK", "--write-baseline",
+                     "--baseline", str(bl), str(tmp_path)]) == 0
+        rules = sorted(e["rule"] for e in load_baseline(str(bl)))
+        assert rules == ["ASYNC-BLOCK", "LOCK-ORDER"]
+
+    def test_single_rule_check_ignores_other_rules_baseline(self, tmp_path,
+                                                            capsys):
+        """A --rule check run judges staleness only against that rule's
+        baseline entries: another rule's grandfathered entry is out of
+        scope, not stale — a clean full run must not turn into a failing
+        single-rule run."""
+        write(tmp_path, "m.py", """
+            import threading, time
+            LOCK_A = threading.Lock()
+            async def f():
+                time.sleep(1)
+            def g():
+                with LOCK_A:
+                    with LOCK_A:
+                        pass
+            """)
+        bl = tmp_path / "bl.json"
+        assert main(["--write-baseline", "--baseline", str(bl),
+                     str(tmp_path)]) == 0
+        # full run: everything baselined, clean
+        assert main(["--baseline", str(bl), str(tmp_path)]) == 0
+        capsys.readouterr()
+        # single-rule run: the LOCK-ORDER entry must not read as stale
+        assert main(["--rule", "ASYNC-BLOCK", "--baseline", str(bl),
+                     "--format", "json", str(tmp_path)]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["stale_baseline"] == []
+        assert payload["counts"]["baselined"] == 1
+
+    def test_baseline_survives_line_churn(self, tmp_path):
+        src = write(tmp_path, "m.py", """
+            import time
+            async def f():
+                time.sleep(1)
+            """)
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), found)
+        # unrelated lines above move the finding; the fingerprint holds
+        src.write_text("import time\n\n\n\n\nasync def f():\n"
+                       "    time.sleep(1)\n")
+        found2 = lint_dir(tmp_path, "ASYNC-BLOCK")
+        stale = apply_baseline(found2, load_baseline(str(bl)))
+        assert stale == [] and all(fd.baselined for fd in found2)
+
+    def test_baseline_survives_churn_in_line_citing_messages(self, tmp_path):
+        """Some messages cite line numbers for humans ("first at line N");
+        the fingerprint normalizes those away, so churn above a
+        grandfathered finding neither un-baselines it nor strands its
+        entry as stale."""
+        fam = "nv_" + "churn_family"
+        body = ("def collect_families(core):\n"
+                f"    return [(\"{fam}\", \"h\", \"counter\", []),\n"
+                f"            (\"{fam}\", \"h\", \"counter\", [])]\n")
+        src = write(tmp_path, "metrics.py", body)
+        found = lint_dir(tmp_path, "METRICS-DECL")
+        assert found and "at line" in found[0].message  # cites a line
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), found)
+        src.write_text("import os\nimport sys\n\n" + body)
+        found2 = lint_dir(tmp_path, "METRICS-DECL")
+        assert found2[0].message != found[0].message  # the line moved
+        stale = apply_baseline(found2, load_baseline(str(bl)))
+        assert stale == [] and all(fd.baselined for fd in found2)
+
+    def test_path_scoped_run_matches_repo_root_baseline(self, tmp_path,
+                                                        capsys):
+        """Findings fingerprint against the enclosing repo root (pyproject
+        walk-up), so `triton-lint <subdir>` resolves the repo-root
+        baseline AND its relpaths match the full-run entries — a
+        grandfathered finding stays grandfathered under path scoping."""
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        write(tmp_path, "pkg/server/m.py", """
+            import time
+            async def f():
+                time.sleep(1)
+            """)
+        # full-repo run grandfathers the finding at the repo root
+        assert main(["--write-baseline", str(tmp_path)]) == 0
+        capsys.readouterr()
+        # path-scoped run from the same repo: baselined, not fresh/stale
+        assert main(["--format", "json", str(tmp_path / "pkg")]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["stale_baseline"] == []
+        assert payload["counts"]["fresh"] == 0
+        assert payload["counts"]["baselined"] == 1
+        assert payload["findings"][0]["path"] == "pkg/server/m.py"
+
+    def test_path_scoped_run_spares_out_of_scope_baseline(self, tmp_path,
+                                                          capsys):
+        """Out-of-scope baseline entries are neither stale on a scoped
+        check nor dropped by a scoped --write-baseline — a clean full run
+        stays a clean scoped run, and scoped refreshes merge."""
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        body = "import time\nasync def f():\n    time.sleep(1)\n"
+        write(tmp_path, "pkg/a.py", body)
+        write(tmp_path, "other/b.py", body)
+        assert main(["--write-baseline", str(tmp_path)]) == 0
+        capsys.readouterr()
+        # scoped check: other/b.py's entry is out of scope, not stale
+        assert main(["--format", "json", str(tmp_path / "pkg")]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["stale_baseline"] == []
+        # scoped refresh: other/b.py's entry survives the rewrite
+        assert main(["--write-baseline", str(tmp_path / "pkg")]) == 0
+        bl = load_baseline(str(tmp_path / ".tpu-lint-baseline.json"))
+        assert sorted(e["path"] for e in bl) == ["other/b.py", "pkg/a.py"]
+
+    def test_scoped_run_never_judges_stale(self, tmp_path, capsys):
+        """Staleness is a full-tree property: after fixing other/b.py, a
+        run scoped to pkg/ must NOT flag b.py's baseline entry stale (a
+        cross-file finding may need files the scope excludes to
+        reproduce) — only the full-root run shrinks the baseline."""
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        body = "import time\nasync def f():\n    time.sleep(1)\n"
+        write(tmp_path, "pkg/a.py", body)
+        b = write(tmp_path, "other/b.py", body)
+        assert main(["--write-baseline", str(tmp_path)]) == 0
+        b.write_text("async def f():\n    pass\n")  # fixed
+        capsys.readouterr()
+        # scoped: b.py's now-unreproducible entry is not judged
+        assert main([str(tmp_path / "pkg")]) == 0
+        # scoped refresh: fingerprint union keeps it too
+        assert main(["--write-baseline", str(tmp_path / "pkg")]) == 0
+        bl = load_baseline(str(tmp_path / ".tpu-lint-baseline.json"))
+        assert sorted(e["path"] for e in bl) == ["other/b.py", "pkg/a.py"]
+        # full-root run: NOW it reads stale (the baseline only shrinks
+        # via full runs)
+        assert main([str(tmp_path)]) == 1
+        assert "stale baseline" in capsys.readouterr().out
+
+    def test_malformed_baseline_entry_exits_2(self, tmp_path, capsys):
+        """A hand-edited baseline with a non-object entry is a usage
+        error (exit 2), not an AttributeError traceback."""
+        write(tmp_path, "m.py", "x = 1\n")
+        bl = tmp_path / "bl.json"
+        bl.write_text('{"version": 1, "findings": ["oops"]}')
+        assert main(["--baseline", str(bl), str(tmp_path)]) == 2
+        assert "bad baseline" in capsys.readouterr().err
+
+    def test_module_execution_entrypoint(self):
+        """``python -m triton_client_tpu.tools.lint`` works — parity with
+        the other stdlib operator tools when the console script isn't on
+        PATH."""
+        import subprocess
+        import sys as _sys
+
+        res = subprocess.run(
+            [_sys.executable, "-m", "triton_client_tpu.tools.lint",
+             "--help"],
+            capture_output=True, text=True, cwd=_REPO_ROOT)
+        assert res.returncode == 0 and "triton-lint" in res.stdout
+
+    # -- reporters ---------------------------------------------------------
+    def test_json_shape_is_pinned(self, tmp_path, capsys):
+        """The machine shape scripts depend on: version, files_scanned,
+        findings[{rule,path,line,symbol,message,baselined}], counts
+        {total,fresh,baselined,by_rule}, stale_baseline."""
+        write(tmp_path, "m.py", """
+            import time
+            async def f():
+                time.sleep(1)
+            """)
+        rc = main(["--rule", "ASYNC-BLOCK", "--no-baseline",
+                   "--format", "json", str(tmp_path)])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert set(payload) == {"version", "files_scanned", "findings",
+                                "counts", "stale_baseline"}
+        assert payload["version"] == 1
+        assert payload["files_scanned"] == 1
+        (fd,) = payload["findings"]
+        assert set(fd) == {"rule", "path", "line", "symbol", "message",
+                           "baselined"}
+        assert fd["rule"] == "ASYNC-BLOCK" and fd["path"] == "m.py"
+        assert fd["symbol"] == "f" and fd["baselined"] is False
+        assert payload["counts"] == {
+            "total": 1, "fresh": 1, "baselined": 0,
+            "by_rule": {"ASYNC-BLOCK": 1}}
+        assert payload["stale_baseline"] == []
+
+    def test_render_json_is_valid_and_sorted(self):
+        out = render_json([Finding("X", "a.py", 3, "msg", symbol="f")],
+                          files_scanned=1)
+        payload = json.loads(out)
+        assert payload["findings"][0]["line"] == 3
+
+    def test_list_rules_cli(self, capsys):
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in rule_names():
+            assert rule in out
+
+    def test_unknown_rule_cli_exits_2(self, tmp_path):
+        write(tmp_path, "m.py", "x = 1\n")
+        assert main(["--rule", "NOPE", str(tmp_path)]) == 2
+
+
+# -- ASYNC-BLOCK -------------------------------------------------------------
+
+class TestAsyncBlock:
+    def test_time_sleep_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import time
+            async def handler():
+                time.sleep(0.1)
+            """)
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        assert len(found) == 1 and "time.sleep" in found[0].message
+
+    def test_dotted_import_sync_http_fires(self, tmp_path):
+        """``import urllib.request`` binds ``urllib`` — the resolver must
+        not double the submodule (urllib.request.request.urlopen) and
+        silently miss the documented sync-HTTP case."""
+        write(tmp_path, "m.py", """
+            import urllib.request
+            async def f(url):
+                return urllib.request.urlopen(url)
+            """)
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        assert len(found) == 1 and "sync HTTP" in found[0].message
+
+    def test_from_import_submodule_sync_http_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            from urllib import request
+            async def f(url):
+                return request.urlopen(url)
+            """)
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        assert len(found) == 1 and "sync HTTP" in found[0].message
+
+    def test_aliased_import_still_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            from time import sleep
+            async def handler():
+                sleep(0.1)
+            """)
+        assert len(lint_dir(tmp_path, "ASYNC-BLOCK")) == 1
+
+    def test_open_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def handler():
+                with open("/tmp/x") as fh:
+                    return fh.read()
+            """)
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        assert len(found) == 1 and "open" in found[0].message
+
+    def test_server_log_emit_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def handler(core):
+                core.log.info("hello")
+            """)
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        assert len(found) == 1 and "ServerLog" in found[0].message
+
+    def test_indefinite_lock_acquire_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def handler(self):
+                self._lock.acquire()
+            """)
+        found = lint_dir(tmp_path, "ASYNC-BLOCK")
+        assert len(found) == 1 and "acquire" in found[0].message
+
+    def test_bounded_acquire_passes(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def handler(self):
+                self._lock.acquire(timeout=0.1)
+                self._lock.acquire(blocking=False)
+                self._lock.acquire(False)
+                self._lock.acquire(True, 0.5)
+            """)
+        assert lint_dir(tmp_path, "ASYNC-BLOCK") == []
+
+    def test_sync_def_passes(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import time
+            def handler():
+                time.sleep(0.1)
+            """)
+        assert lint_dir(tmp_path, "ASYNC-BLOCK") == []
+
+    def test_executor_hop_recognized(self, tmp_path):
+        """Blocking work inside a nested def (the run_in_executor idiom)
+        and a bound log method passed as an ARGUMENT are both clean."""
+        write(tmp_path, "m.py", """
+            import asyncio, time
+            async def handler(core):
+                def _work():
+                    time.sleep(0.1)
+                    with open("/tmp/x") as fh:
+                        return fh.read()
+                loop = asyncio.get_running_loop()
+                log_off_loop(core.log.info, "msg")
+                return await loop.run_in_executor(None, _work)
+            """)
+        assert lint_dir(tmp_path, "ASYNC-BLOCK") == []
+
+    def test_asyncio_sleep_passes(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import asyncio
+            async def handler():
+                await asyncio.sleep(0.1)
+            """)
+        assert lint_dir(tmp_path, "ASYNC-BLOCK") == []
+
+
+# -- LOCK-ORDER --------------------------------------------------------------
+
+class TestLockOrder:
+    def test_nested_same_lock_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """)
+        found = lint_dir(tmp_path, "LOCK-ORDER")
+        assert len(found) == 1 and "deadlock" in found[0].message
+
+    def test_rlock_nesting_passes(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                def f(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """)
+        assert lint_dir(tmp_path, "LOCK-ORDER") == []
+
+    def test_self_call_reacquire_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+                def inner(self):
+                    with self._lock:
+                        pass
+            """)
+        found = lint_dir(tmp_path, "LOCK-ORDER")
+        assert len(found) == 1 and "re-acquires" in found[0].message
+
+    def test_lock_order_cycle_fires(self, tmp_path):
+        write(tmp_path, "a.py", """
+            import threading
+            A_LOCK = threading.Lock()
+            B_LOCK = threading.Lock()
+            def f():
+                with A_LOCK:
+                    with B_LOCK:
+                        pass
+            def g():
+                with B_LOCK:
+                    with A_LOCK:
+                        pass
+            """)
+        found = lint_dir(tmp_path, "LOCK-ORDER")
+        assert len(found) == 1 and "cycle" in found[0].message
+
+    def test_same_named_locks_in_different_files_do_not_cycle(self,
+                                                              tmp_path):
+        """Lock identity is file-qualified: two unrelated classes that
+        happen to share a name (this repo has four
+        InferenceServerClients) nesting same-named locks in opposite
+        orders are NOT a cycle — they can never be held together."""
+        body_ab = """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state_lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        with self._state_lock:
+                            pass
+            """
+        body_ba = body_ab.replace(
+            "with self._lock:\n                        "
+            "with self._state_lock:",
+            "with self._state_lock:\n                        "
+            "with self._lock:")
+        write(tmp_path, "a.py", body_ab)
+        write(tmp_path, "b.py", body_ba)
+        assert lint_dir(tmp_path, "LOCK-ORDER") == []
+
+    def test_explicit_non_py_file_is_linted(self, tmp_path):
+        """A FILE the operator names is linted regardless of extension —
+        silently skipping it would be an empty-but-green run."""
+        script = tmp_path / "runme"
+        script.write_text("import time\nasync def f():\n"
+                          "    time.sleep(1)\n")
+        project = build_project([str(script)])
+        found = run_rules(project, rules=["ASYNC-BLOCK"])
+        assert len(found) == 1
+
+    def test_consistent_order_passes(self, tmp_path):
+        write(tmp_path, "a.py", """
+            import threading
+            A_LOCK = threading.Lock()
+            B_LOCK = threading.Lock()
+            def f():
+                with A_LOCK:
+                    with B_LOCK:
+                        pass
+            def g():
+                with A_LOCK:
+                    with B_LOCK:
+                        pass
+            """)
+        assert lint_dir(tmp_path, "LOCK-ORDER") == []
+
+    def test_unguarded_write_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+                def reset(self):
+                    self.count = 0
+            """)
+        found = lint_dir(tmp_path, "LOCK-ORDER")
+        assert len(found) == 1 and "outside any lock" in found[0].message
+
+    def test_unguarded_tuple_unpack_write_fires(self, tmp_path):
+        """Tuple-unpacking writes are writes: `self.count, self.total =
+        0, 0` outside the lock races locked readers just like the
+        single-target form."""
+        write(tmp_path, "m.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self.total = 0
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+                def reset(self):
+                    self.count, self.total = 0, 0
+            """)
+        found = lint_dir(tmp_path, "LOCK-ORDER")
+        assert len(found) == 1 and "self.count" in found[0].message
+
+    def test_module_rlock_nested_in_method_passes(self, tmp_path):
+        """Module-level RLock reentrancy is honored inside class methods
+        too — nesting it is legal, not an 'instant deadlock'."""
+        write(tmp_path, "m.py", """
+            import threading
+            MODULE_RLOCK = threading.RLock()
+            class C:
+                def f(self):
+                    with MODULE_RLOCK:
+                        with MODULE_RLOCK:
+                            pass
+            """)
+        assert lint_dir(tmp_path, "LOCK-ORDER") == []
+
+    def test_module_plain_lock_nested_in_method_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            import threading
+            MODULE_LOCK = threading.Lock()
+            class C:
+                def f(self):
+                    with MODULE_LOCK:
+                        with MODULE_LOCK:
+                            pass
+            """)
+        found = lint_dir(tmp_path, "LOCK-ORDER")
+        assert len(found) == 1 and "instant deadlock" in found[0].message
+
+    def test_locked_suffix_convention_passes(self, tmp_path):
+        """*_locked methods are called with the lock held — the codebase
+        convention (_prune_locked, _close_locked)."""
+        write(tmp_path, "m.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+                def _reset_locked(self):
+                    self.count = 0
+            """)
+        assert lint_dir(tmp_path, "LOCK-ORDER") == []
+
+
+# -- EXC-CONTRACT ------------------------------------------------------------
+
+class TestExcContract:
+    def test_unwrapped_stub_call_fires(self, tmp_path):
+        write(tmp_path, "grpc/_client.py", """
+            class InferenceServerClient:
+                def get_thing(self, name):
+                    return self._client_stub.GetThing(name)
+            """)
+        found = lint_dir(tmp_path, "EXC-CONTRACT")
+        assert len(found) == 1 and "RpcError" in found[0].message
+
+    def test_wrapped_stub_call_passes(self, tmp_path):
+        write(tmp_path, "grpc/_client.py", """
+            import grpc
+            from x import raise_error_grpc
+            class InferenceServerClient:
+                def get_thing(self, name):
+                    try:
+                        return self._client_stub.GetThing(name)
+                    except grpc.RpcError as e:
+                        raise_error_grpc(e)
+            """)
+        assert lint_dir(tmp_path, "EXC-CONTRACT") == []
+
+    def test_enclosing_try_does_not_cover_nested_def(self, tmp_path):
+        """A callback's body runs in its own frame — the lexical try
+        around the registration does not catch for it."""
+        write(tmp_path, "grpc/_client.py", """
+            import grpc
+            from x import raise_error_grpc
+            class InferenceServerClient:
+                def get_thing(self, name):
+                    try:
+                        def cb():
+                            return self._client_stub.GetThing(name)
+                        return cb
+                    except grpc.RpcError as e:
+                        raise_error_grpc(e)
+            """)
+        found = lint_dir(tmp_path, "EXC-CONTRACT")
+        assert len(found) == 1
+
+    def test_result_without_timeout_guard_fires(self, tmp_path):
+        """The PR 4 leak: get_result re-raising raw FutureTimeoutError."""
+        write(tmp_path, "grpc/_client.py", """
+            class InferAsyncRequest:
+                def get_result(self, timeout=None):
+                    return self._call.result(timeout=timeout)
+            """)
+        found = lint_dir(tmp_path, "EXC-CONTRACT")
+        assert len(found) == 1 and "timeout" in found[0].message.lower()
+
+    def test_result_with_guard_passes(self, tmp_path):
+        write(tmp_path, "grpc/_client.py", """
+            import grpc
+            from x import raise_error_grpc, deadline_exceeded_error
+            class InferAsyncRequest:
+                def get_result(self, timeout=None):
+                    try:
+                        return self._call.result(timeout=timeout)
+                    except grpc.FutureTimeoutError:
+                        raise deadline_exceeded_error()
+                    except grpc.RpcError as e:
+                        raise_error_grpc(e)
+            """)
+        assert lint_dir(tmp_path, "EXC-CONTRACT") == []
+
+    def test_result_guard_that_bare_reraises_fires(self, tmp_path):
+        """Naming FutureTimeoutError in the handler is not enough — a
+        bare re-raise hands the raw transport exception to the caller,
+        which IS the PR 4 leak."""
+        write(tmp_path, "grpc/_client.py", """
+            import grpc
+            class InferAsyncRequest:
+                def get_result(self, timeout=None):
+                    try:
+                        return self._call.result(timeout=timeout)
+                    except grpc.FutureTimeoutError:
+                        self._cleanup()
+                        raise
+            """)
+        found = lint_dir(tmp_path, "EXC-CONTRACT")
+        assert len(found) == 1 and "leaks raw" in found[0].message
+
+    def test_http_public_method_without_raise_if_error_fires(self, tmp_path):
+        write(tmp_path, "http/_client.py", """
+            import json
+            class InferenceServerClient:
+                def get_thing(self):
+                    response = self._get("v2/thing", None, None)
+                    return json.loads(response.data)
+            """)
+        found = lint_dir(tmp_path, "EXC-CONTRACT")
+        assert len(found) == 1 and "raise_if_error" in found[0].message
+
+    def test_http_public_method_with_raise_if_error_passes(self, tmp_path):
+        write(tmp_path, "http/_client.py", """
+            import json
+            from ._utils import raise_if_error
+            class InferenceServerClient:
+                def get_thing(self):
+                    response = self._get("v2/thing", None, None)
+                    raise_if_error(response.status, response.data)
+                    return json.loads(response.data)
+            """)
+        assert lint_dir(tmp_path, "EXC-CONTRACT") == []
+
+    def test_private_delegation_hole_fires(self, tmp_path):
+        """A public method whose private helper hits the transport
+        without converting anywhere is the PR-4 leak through one level
+        of indirection — attributed to the public caller."""
+        write(tmp_path, "http/_client.py", """
+            class InferenceServerClient:
+                def get_thing(self):
+                    return self._do_request("v2/thing")
+                def _do_request(self, path):
+                    return self._pool.request("GET", path)
+            """)
+        found = lint_dir(tmp_path, "EXC-CONTRACT")
+        assert len(found) == 1 and "get_thing" in found[0].message
+
+    def test_private_delegation_with_convert_passes(self, tmp_path):
+        write(tmp_path, "http/_client.py", """
+            from ._utils import raise_if_error
+            class InferenceServerClient:
+                def get_thing(self):
+                    return self._do_request("v2/thing")
+                def _do_request(self, path):
+                    response = self._pool.request("GET", path)
+                    raise_if_error(response.status, response.data)
+                    return response
+            """)
+        assert lint_dir(tmp_path, "EXC-CONTRACT") == []
+
+    def test_rule_scoped_to_client_cores(self, tmp_path):
+        """The same shapes anywhere else are out of contract scope."""
+        write(tmp_path, "other.py", """
+            class Anything:
+                def get_thing(self, name):
+                    return self._client_stub.GetThing(name)
+            """)
+        assert lint_dir(tmp_path, "EXC-CONTRACT") == []
+
+
+# -- SPAN-PAIR ---------------------------------------------------------------
+
+class TestSpanPair:
+    def test_started_context_without_emit_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def serve(self, model, request):
+                trace = self.tracer.maybe_start(model.name, "1")
+                trace.add_span("COMPUTE", 0, 1)
+                return 42
+            """)
+        found = lint_dir(tmp_path, "SPAN-PAIR")
+        assert len(found) == 1 and "emit" in found[0].message
+
+    def test_emitted_context_passes(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def serve(self, model, request):
+                trace = self.tracer.maybe_start(model.name, "1")
+                try:
+                    return 42
+                finally:
+                    await trace.emit_async()
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
+    def test_handoff_counts_as_completion(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def serve(self, model, request, resp):
+                trace = self.tracer.maybe_start(model.name, "1")
+                resp.trace = trace
+                return resp
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
+    def test_escape_via_return_trusted(self, tmp_path):
+        write(tmp_path, "m.py", """
+            def start(self, model):
+                trace = self.tracer.start_shadow(model.name, "1")
+                return trace
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
+    def test_begin_span_without_end_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            def record(ctx):
+                span = ctx.begin_span("H2D_TRANSFER")
+                do_work()
+            """)
+        found = lint_dir(tmp_path, "SPAN-PAIR")
+        assert len(found) == 1 and "never closes" in found[0].message
+
+    def test_begin_span_with_end_passes(self, tmp_path):
+        write(tmp_path, "m.py", """
+            def record(ctx):
+                span = ctx.begin_span("H2D_TRANSFER")
+                try:
+                    do_work()
+                finally:
+                    span.end()
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
+
+# -- METRICS-DECL ------------------------------------------------------------
+
+class TestMetricsDecl:
+    # the duplicate-declaration and undeclared-reference bites live in
+    # tests/test_tools_import.py (the migrated registry lint); here: label
+    # drift and the clean fixture.
+    def test_label_drift_fires(self, tmp_path):
+        fam = "nv_" + "labeled_family"
+        write(tmp_path, "metrics.py", f"""
+            def collect_families(core):
+                families = []
+                families.append(("{fam}", "h", "counter",
+                                 [({{"model": "m", "tier": "0"}}, 1),
+                                  ({{"model": "m"}}, 2)]))
+                return families
+            """)
+        found = lint_dir(tmp_path, "METRICS-DECL")
+        assert len(found) == 1 and "label" in found[0].message
+
+    def test_clean_registry_passes(self, tmp_path):
+        fam_a = "nv_" + "fam_a"
+        fam_b = "nv_" + "fam_b"
+        write(tmp_path, "metrics.py", f"""
+            def collect_families(core):
+                families = []
+                families.append(("{fam_a}", "h", "counter",
+                                 [({{"model": "m"}}, 1)]))
+                families.append(("{fam_b}", "h", "gauge", []))
+                return families
+            """)
+        write(tmp_path, "consumer.py", f"NAME = \"{fam_a}\"\n")
+        assert lint_dir(tmp_path, "METRICS-DECL") == []
+
+    def test_docstring_mentions_do_not_declare(self, tmp_path):
+        fam = "nv_" + "real_family"
+        ghost = "nv_" + "doc_only_family"
+        write(tmp_path, "metrics.py", f'''
+            def collect_families(core):
+                """Help prose mentioning {ghost} must not declare it."""
+                return [("{fam}", "h", "counter", [])]
+            ''')
+        write(tmp_path, "consumer.py", f"NAME = \"{ghost}\"\n")
+        found = lint_dir(tmp_path, "METRICS-DECL")
+        assert len(found) == 1 and ghost in found[0].message
+
+
+# -- TEST-DETERMINISM --------------------------------------------------------
+
+class TestTestDeterminism:
+    def test_unseeded_global_rng_fires(self, tmp_path):
+        write(tmp_path, "tests/test_x.py", """
+            import random
+            def test_thing():
+                return random.randint(0, 10)
+            """)
+        found = lint_dir(tmp_path, "TEST-DETERMINISM")
+        assert len(found) == 1 and "unseeded" in found[0].message
+
+    def test_unseeded_np_global_rng_fires(self, tmp_path):
+        write(tmp_path, "tests/test_x.py", """
+            import numpy as np
+            def test_thing():
+                return np.random.normal(size=(2, 2))
+            """)
+        found = lint_dir(tmp_path, "TEST-DETERMINISM")
+        assert len(found) == 1 and "np.random" in found[0].message
+
+    def test_seeded_rng_passes(self, tmp_path):
+        write(tmp_path, "tests/test_x.py", """
+            import random
+            import numpy as np
+            def test_thing():
+                rng = random.Random(1234)
+                arr = np.random.default_rng(0).normal(size=(2, 2))
+                return rng.randint(0, 10), arr
+            """)
+        assert lint_dir(tmp_path, "TEST-DETERMINISM") == []
+
+    def test_sleep_racing_quantile_fires(self, tmp_path):
+        write(tmp_path, "tests/test_x.py", """
+            import time
+            def test_watchdog(hist):
+                time.sleep(0.2)
+                assert hist.quantile(0.99) > 0.1
+            """)
+        found = lint_dir(tmp_path, "TEST-DETERMINISM")
+        assert len(found) == 1 and "quantile" in found[0].message
+
+    def test_slow_marked_soak_passes(self, tmp_path):
+        write(tmp_path, "tests/test_x.py", """
+            import time
+            import pytest
+            @pytest.mark.slow
+            def test_soak(hist):
+                time.sleep(0.2)
+                assert hist.quantile(0.99) > 0.1
+            """)
+        assert lint_dir(tmp_path, "TEST-DETERMINISM") == []
+
+    def test_sleep_without_quantile_context_passes(self, tmp_path):
+        """Fixed sleeps against absolute thresholds are fine — the flake
+        class is sleeping against a moving estimator."""
+        write(tmp_path, "tests/test_x.py", """
+            import time
+            def test_ttl(cache):
+                time.sleep(0.2)
+                assert cache.get("k") is None
+            """)
+        assert lint_dir(tmp_path, "TEST-DETERMINISM") == []
+
+    def test_wall_clock_vs_quantile_fires(self, tmp_path):
+        write(tmp_path, "tests/test_x.py", """
+            import time
+            def test_thing(hist):
+                t0 = time.time()
+                assert time.time() - t0 < hist.quantile(0.5)
+            """)
+        found = lint_dir(tmp_path, "TEST-DETERMINISM")
+        assert len(found) == 2  # both argless time.time() calls
+
+    def test_module_level_unseeded_rng_fires(self, tmp_path):
+        """Fixture data baked at import time couples every test in the
+        file to collection order."""
+        write(tmp_path, "tests/test_x.py", """
+            import numpy as np
+            DATA = np.random.normal(size=(4, 4))
+            def test_thing():
+                assert DATA.shape == (4, 4)
+            """)
+        found = lint_dir(tmp_path, "TEST-DETERMINISM")
+        assert len(found) == 1 and found[0].symbol == "<module>"
+
+    def test_rule_scoped_to_tests(self, tmp_path):
+        write(tmp_path, "pkg/mod.py", """
+            import random
+            def helper():
+                return random.randint(0, 10)
+            """)
+        assert lint_dir(tmp_path, "TEST-DETERMINISM") == []
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+class TestRepoGate:
+    def test_repo_is_clean_under_the_full_suite(self, capsys):
+        """The zero-finding gate: every rule over the whole repo, against
+        the checked-in baseline.  A new violation of any encoded invariant
+        fails tier-1 here — fix it or carry a reasoned pragma; do not grow
+        the baseline."""
+        rc = main([_REPO_ROOT, "--format", "json"])
+        payload = json.loads(capsys.readouterr().out)
+        fresh = [fd for fd in payload["findings"] if not fd["baselined"]]
+        assert rc == 0, f"triton-lint found new issues: {fresh}"
+        assert payload["stale_baseline"] == [], (
+            "baseline entries no longer occur — prune them: "
+            f"{payload['stale_baseline']}")
+
+    def test_async_block_and_determinism_baselines_are_empty(self):
+        """ISSUE 8 acceptance: ASYNC-BLOCK and TEST-DETERMINISM land with
+        EMPTY baselines — their historical findings were fixed, not
+        grandfathered."""
+        from triton_client_tpu.tools.lint._engine import load_baseline
+        path = os.path.join(_REPO_ROOT, ".tpu-lint-baseline.json")
+        rules = {e["rule"] for e in load_baseline(path)}
+        assert "ASYNC-BLOCK" not in rules
+        assert "TEST-DETERMINISM" not in rules
+
+    def test_console_script_registered(self):
+        import re
+        text = open(os.path.join(_REPO_ROOT, "pyproject.toml")).read()
+        assert re.search(
+            r'^triton-lint = "triton_client_tpu\.tools\.lint:main"$',
+            text, re.M)

@@ -40,6 +40,23 @@ def _defaults_after(client):
         "log_error": True, "log_verbose_level": 0, "log_format": "default"})
 
 
+def _poll_log(path, *needles, timeout_s=10.0):
+    """Wait for every needle to appear in the log file and return its
+    text.  Lifecycle lines (load/unload) ride the executor off the event
+    loop — the ASYNC-BLOCK invariant — so they land *after* the control
+    response; read-after-response must poll, not assume."""
+    import time
+
+    deadline = time.time() + timeout_s
+    text = ""
+    while time.time() < deadline:
+        text = path.read_text() if path.exists() else ""
+        if all(n in text for n in needles):
+            return text
+        time.sleep(0.02)
+    return text
+
+
 def _simple_inputs():
     a = np.arange(16, dtype=np.int32).reshape(1, 16)
     inputs = [
@@ -57,9 +74,15 @@ class TestServerLog:
         client.update_log_settings({"log_file": str(lf)})
         client.unload_model("identity_fp32")
         client.load_model("identity_fp32")
-        text = lf.read_text()
+        text = _poll_log(lf,
+                         "successfully unloaded model 'identity_fp32'",
+                         "successfully loaded model 'identity_fp32'")
         assert "successfully unloaded model 'identity_fp32'" in text
         assert "successfully loaded model 'identity_fp32'" in text
+        # off-loop emits drain FIFO (single-worker log executor): the
+        # unload line lands before the load line, same as the sync days
+        assert (text.index("successfully unloaded model 'identity_fp32'")
+                < text.index("successfully loaded model 'identity_fp32'"))
         # default format: level letter + MMDD + wall clock with microseconds
         assert re.search(r"^I\d{4} \d{2}:\d{2}:\d{2}\.\d{6} ", text, re.M)
 
@@ -69,8 +92,9 @@ class TestServerLog:
                                     "log_format": "ISO8601"})
         client.unload_model("identity_fp32")
         client.load_model("identity_fp32")
+        text = _poll_log(lf, "successfully loaded model 'identity_fp32'")
         assert re.search(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z I ",
-                         lf.read_text(), re.M)
+                         text, re.M)
 
     def test_json_format_one_object_per_line(self, client, tmp_path):
         """log_format=json: every line is one JSON object with level/ts/msg,
@@ -86,12 +110,7 @@ class TestServerLog:
         client.infer("simple", _simple_inputs())
         client.unload_model("identity_fp32")
         client.load_model("identity_fp32")
-        deadline = time.time() + 10  # lines land via the executor
-        while time.time() < deadline:
-            text = lf.read_text() if lf.exists() else ""
-            if "/infer -> 200" in text and "successfully loaded" in text:
-                break
-            time.sleep(0.05)
+        text = _poll_log(lf, "/infer -> 200", "successfully loaded")
         records = [json.loads(l) for l in text.splitlines() if l.strip()]
         assert records, "no JSON log lines written"
         for rec in records:
@@ -112,7 +131,10 @@ class TestServerLog:
         client.update_log_settings({"log_file": str(lf), "log_info": False})
         client.unload_model("identity_fp32")
         client.load_model("identity_fp32")
-        assert not lf.exists() or "successfully" not in lf.read_text()
+        # negative assertion with a grace window: lifecycle lines land via
+        # the executor, so "nothing right now" alone would pass vacuously
+        text = _poll_log(lf, "successfully", timeout_s=0.5)
+        assert "successfully" not in text
 
     def test_grpc_requests_logged_too(self, server, client, tmp_path):
         """Log-settings-driven lines exist on BOTH protocols — an operator
@@ -135,15 +157,28 @@ class TestServerLog:
             gc.infer("simple", inputs)
             with pytest.raises(InferenceServerException):
                 gc.infer("nope", inputs)
-        deadline = time.time() + 10  # lines land via the executor
-        while time.time() < deadline:
-            text = lf.read_text() if lf.exists() else ""
-            if ("grpc ModelInfer 'simple' -> OK" in text
-                    and "grpc ModelInfer 'nope' -> 400" in text):
-                break
-            time.sleep(0.05)
+        text = _poll_log(lf, "grpc ModelInfer 'simple' -> OK",
+                         "grpc ModelInfer 'nope' -> 400")
         assert "grpc ModelInfer 'simple' -> OK" in text
         assert "grpc ModelInfer 'nope' -> 400" in text
+
+    def test_grpc_unload_load_logged_off_loop(self, server, client,
+                                              tmp_path):
+        """Lifecycle lines from the gRPC control plane land too — via the
+        executor (the ASYNC-BLOCK fix: RepositoryModelUnload used to
+        append to the log file directly on the event loop)."""
+        import triton_client_tpu.grpc as grpcclient
+
+        lf = tmp_path / "grpc_lifecycle.log"
+        client.update_log_settings({"log_file": str(lf)})
+        with grpcclient.InferenceServerClient(server.grpc_url) as gc:
+            gc.unload_model("identity_fp32")
+            gc.load_model("identity_fp32")
+        text = _poll_log(lf,
+                         "successfully unloaded model 'identity_fp32'",
+                         "successfully loaded model 'identity_fp32'")
+        assert "successfully unloaded model 'identity_fp32'" in text
+        assert "successfully loaded model 'identity_fp32'" in text
 
     def test_verbose_level_logs_requests(self, client, tmp_path):
         import time
@@ -154,13 +189,8 @@ class TestServerLog:
         client.infer("simple", _simple_inputs())
         with pytest.raises(InferenceServerException):
             client.get_model_metadata("nope")  # 400: verbose line, not error
-        deadline = time.time() + 10  # lines land via the executor
-        while time.time() < deadline:
-            text = lf.read_text() if lf.exists() else ""
-            if ("POST /v2/models/simple/infer -> 200" in text
-                    and "GET /v2/models/nope -> 400" in text):
-                break
-            time.sleep(0.05)
+        text = _poll_log(lf, "POST /v2/models/simple/infer -> 200",
+                         "GET /v2/models/nope -> 400")
         assert re.search(r"POST /v2/models/simple/infer -> 200", text)
         assert re.search(r"GET /v2/models/nope -> 400", text)
         # verbosity off: requests stop appearing (both prior lines already

@@ -6,11 +6,10 @@ them on dep-free boxes), and every console script registered in
 ``pyproject.toml`` must resolve to a real ``module:function`` target — a
 broken entry point fails tier-1 instead of the first operator who runs it.
 
-The metrics-registry lint holds the server's two export surfaces
-together: every series the Prometheus renderer emits must come from a
-family declared exactly once (one HELP, one TYPE) and must appear in the
-JSON snapshot with the same type — a family added to one surface but not
-the other fails here instead of drifting silently.
+The metrics-registry lint lives in triton-lint's METRICS-DECL rule now
+(static — it guards code paths no unit-test process imports); this file
+keeps the thin wrapper asserting the repo passes it, plus the bite test
+proving the rule still fires on a deliberately drifted registry.
 """
 
 import importlib
@@ -68,7 +67,7 @@ def test_console_scripts_resolve():
             f"console script {script} -> {module}:{func} does not resolve"
 
 
-@pytest.mark.parametrize("name", ("trace_summary", "top"))
+@pytest.mark.parametrize("name", ("trace_summary", "top", "lint"))
 def test_stdlib_tools_help_exits_zero(name):
     mod = importlib.import_module(f"triton_client_tpu.tools.{name}")
     with pytest.raises(SystemExit) as ei:
@@ -77,97 +76,38 @@ def test_stdlib_tools_help_exits_zero(name):
 
 
 # -- metrics-registry lint ---------------------------------------------------
+# Migrated into triton-lint's METRICS-DECL rule (static: no jax import, no
+# live core).  This file keeps (a) the thin wrapper proving the repo passes
+# the rule and (b) the bite test proving the rule still fires on drift.
+# Runtime renderer/snapshot parity lives in
+# tests/test_device_stats.py::TestMetricsSnapshotParity.
 
-def _lint_core():
-    """A real core over the zoo, with enough synthetic device/SLO state
-    that every family has at least declaration-level presence."""
-    pytest.importorskip("jax")
-    from triton_client_tpu.models import zoo
-    from triton_client_tpu.server import ModelRegistry
-    from triton_client_tpu.server.core import InferenceCore
-    from triton_client_tpu.server.device_stats import SloObjective
+def test_metrics_registry_lint_passes():
+    """Thin wrapper: ``triton-lint --rule METRICS-DECL`` over the repo is
+    clean — every nv_* family declared exactly once, every reference
+    resolves, literal label sets agree."""
+    from triton_client_tpu.tools.lint import main
 
-    registry = ModelRegistry()
-    zoo.register_all(registry)
-    core = InferenceCore(registry)
-    ds = core.device_stats
-    ds.declare_model("simple", 1e6)
-    ds.record_execute("simple", 1, 1_000_000,
-                      signature=(("X", (1, 4), "f32"),))
-    ds.record_tick("simple", bucket=4, batch=1, padded=4, queue_depth=0,
-                   assembly_ns=1_000, syncs=1)
-    ds.record_transfer("d2h", 64)
-    core.slo.set_objective("simple", SloObjective(p99_ms=100.0))
-    core.slo.observe("simple", 500.0, True)
-    return core
+    assert main(["--rule", "METRICS-DECL", "--no-baseline",
+                 _REPO_ROOT]) == 0
 
 
-def test_metrics_registry_renderer_and_snapshot_agree():
-    """Every rendered series belongs to a family declared EXACTLY once
-    (one HELP line, one TYPE line, declared before its samples), and the
-    set of families on the text surface equals the set in the JSON
-    snapshot, type for type."""
-    core = _lint_core()
-    from triton_client_tpu.server.metrics import render_prometheus, snapshot
+def test_metrics_registry_catches_new_family_drift(tmp_path, capsys):
+    """The lint actually bites (guards the guard): a family declared twice
+    and a reference to an undeclared family are both findings."""
+    from triton_client_tpu.tools.lint import main
 
-    text = render_prometheus(core)
-    helps, types = {}, {}
-    declared_order = []
-    samples = {}
-    sample_re = re.compile(
-        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{.*\})? (.+)$")
-    for line in text.splitlines():
-        if line.startswith("# HELP "):
-            name = line.split(" ", 3)[2]
-            helps[name] = helps.get(name, 0) + 1
-            declared_order.append(name)
-        elif line.startswith("# TYPE "):
-            name = line.split(" ", 3)[2]
-            types[name] = types.get(name, 0) + 1
-        elif line.strip():
-            m = sample_re.match(line)
-            assert m, f"unparseable sample line: {line!r}"
-            samples.setdefault(m.group(1), 0)
-            samples[m.group(1)] += 1
-    # exactly-once declaration
-    assert helps, "renderer emitted no families"
-    for name, n in helps.items():
-        assert n == 1, f"{name}: HELP declared {n} times"
-    for name, n in types.items():
-        assert n == 1, f"{name}: TYPE declared {n} times"
-    assert set(helps) == set(types), "HELP/TYPE sets differ"
-    # every sample belongs to a declared family
-    orphans = set(samples) - set(helps)
-    assert not orphans, f"series without HELP/TYPE declarations: {orphans}"
-    # the JSON snapshot carries the same registry, same types
-    snap = snapshot(core)
-    assert set(snap) == set(helps), (
-        "Prometheus and JSON surfaces disagree on the family set: "
-        f"{set(snap) ^ set(helps)}")
-    kinds = {}
-    for line in text.splitlines():
-        if line.startswith("# TYPE "):
-            _, _, name, kind = line.split(" ", 3)
-            kinds[name] = kind
-    for name, entry in snap.items():
-        assert entry["type"] == kinds[name], name
-        # sample-level parity: same number of series per family
-        assert len(entry["samples"]) == samples.get(name, 0), name
-
-
-def test_metrics_registry_catches_new_family_drift():
-    """The lint actually bites: a family present in only one surface is a
-    detectable difference (guards the guard)."""
-    core = _lint_core()
-    from triton_client_tpu.server import metrics as m
-
-    families = m.collect_families(core)
-    names = [f[0] for f in families]
-    assert len(names) == len(set(names)), "duplicate family declaration"
-    # snapshot and renderer both derive from collect_families — simulate
-    # drift by asserting the derivation really covers every entry
-    text_families = {l.split(" ", 3)[2]
-                     for l in m.render_prometheus(core).splitlines()
-                     if l.startswith("# TYPE ")}
-    assert text_families == set(names)
-    assert set(m.snapshot(core)) == set(names)
+    dup = "nv_" + "dup_family"          # concatenated so the repo-wide
+    ghost = "nv_" + "ghost_family"      # reference scan never sees these
+    (tmp_path / "metrics.py").write_text(
+        "def collect_families(core):\n"
+        f"    families = [(\"{dup}\", \"h\", \"counter\", []),\n"
+        f"                (\"{dup}\", \"h\", \"counter\", [])]\n"
+        "    return families\n")
+    (tmp_path / "top.py").write_text(
+        f"FAMILY = \"{ghost}\"\n")
+    rc = main(["--rule", "METRICS-DECL", "--no-baseline", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert f"family {dup} declared 2 times" in out
+    assert f"undeclared metric family {ghost}" in out

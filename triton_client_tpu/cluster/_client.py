@@ -139,6 +139,7 @@ class ClusterClient(InferenceServerClientBase):
         self._clients_lock = threading.Lock()
         self._executor: Optional[ThreadPoolExecutor] = None
         self._probe_executor: Optional[ThreadPoolExecutor] = None
+        self._closed = False
         self._probe_stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         if health_interval_s is not None:
@@ -166,6 +167,10 @@ class ClusterClient(InferenceServerClientBase):
         client = self._clients.get(ep.url)
         if client is None:
             with self._clients_lock:
+                if self._closed:
+                    # a call racing close() must not build a transport
+                    # client into a dict nobody will ever close again
+                    raise_error("client is closed")
                 client = self._clients.get(ep.url)
                 if client is None:
                     client = self._make_client(ep.url)
@@ -201,12 +206,20 @@ class ClusterClient(InferenceServerClientBase):
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=10)
             self._probe_thread = None
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
-            self._executor = None
-        if self._probe_executor is not None:
-            self._probe_executor.shutdown(wait=True)
-            self._probe_executor = None
+        # detach the executor handles UNDER the lock (they are lazily
+        # created under it — an unlocked None store here races that
+        # double-checked creation), but shut them down OUTSIDE it: their
+        # in-flight tasks take this same lock via _client_for, so a
+        # locked shutdown(wait=True) would deadlock against its own work
+        with self._clients_lock:
+            self._closed = True
+            executor, self._executor = self._executor, None
+            probe_executor, self._probe_executor = \
+                self._probe_executor, None
+        if executor is not None:
+            executor.shutdown(wait=True)
+        if probe_executor is not None:
+            probe_executor.shutdown(wait=True)
         with self._clients_lock:
             clients = (list(self._clients.values())
                        + list(self._probe_clients.values()))
@@ -237,6 +250,8 @@ class ClusterClient(InferenceServerClientBase):
         client = self._probe_clients.get(ep.url)
         if client is None:
             with self._clients_lock:
+                if self._closed:
+                    raise_error("client is closed")
                 client = self._probe_clients.get(ep.url)
                 if client is None:
                     from .. import http as mod
@@ -277,16 +292,25 @@ class ClusterClient(InferenceServerClientBase):
         if len(endpoints) == 1:
             probe_one(endpoints[0])
             return verdicts
-        if self._probe_executor is None:
+        executor = self._probe_executor
+        if executor is None:
             with self._clients_lock:
+                if self._closed:
+                    raise_error("client is closed")
                 if self._probe_executor is None:
                     # persistent: a sweep every health_interval_s must
                     # not create and tear down N threads each time
                     self._probe_executor = ThreadPoolExecutor(
                         max_workers=len(endpoints),
                         thread_name_prefix="tc-tpu-probe")
-        futures = [self._probe_executor.submit(probe_one, ep)
-                   for ep in endpoints]
+                executor = self._probe_executor
+        try:
+            futures = [executor.submit(probe_one, ep)
+                       for ep in endpoints]
+        except RuntimeError:
+            # close() shut the pool down between our executor read and
+            # the submit — typed error, like the hedge path
+            raise_error("client is closed")
         _fut_wait(futures, timeout=timeout_s + 5.0)
         return verdicts
 
@@ -484,8 +508,8 @@ class ClusterClient(InferenceServerClientBase):
         ex = self._hedge_executor()
         t0 = time.monotonic()
         t0_ns = time.monotonic_ns()
-        f_primary = ex.submit(self._infer_on, primary, remaining_s,
-                              model_name, call)
+        f_primary = self._hedge_submit(ex, primary, remaining_s,
+                                       model_name, call)
         done, _ = _fut_wait([f_primary], timeout=delay)
         if f_primary in done:
             return f_primary.result()  # fast path: no hedge needed
@@ -499,8 +523,8 @@ class ClusterClient(InferenceServerClientBase):
         rem2 = remaining_s
         if rem2 is not None:
             rem2 = max(rem2 - (time.monotonic() - t0), 1e-3)
-        f_backup = ex.submit(self._infer_on, backup_ep, rem2,
-                             model_name, call)
+        f_backup = self._hedge_submit(ex, backup_ep, rem2,
+                                      model_name, call)
         pending = {f_primary, f_backup}
         primary_error: Optional[BaseException] = None
         while pending:
@@ -531,13 +555,27 @@ class ClusterClient(InferenceServerClientBase):
         raise primary_error if primary_error is not None \
             else f_backup.exception()
 
+    def _hedge_submit(self, ex: ThreadPoolExecutor, *args):
+        try:
+            return ex.submit(self._infer_on, *args)
+        except RuntimeError:
+            # close() shut the pool down between our executor read and
+            # this submit — surface the typed closed error, not the raw
+            # "cannot schedule new futures after shutdown"
+            raise_error("client is closed")
+
     def _hedge_executor(self) -> ThreadPoolExecutor:
-        if self._executor is None:
+        executor = self._executor
+        if executor is None:
             with self._clients_lock:
                 # double-checked: two threads' first hedges must not
-                # each build (and one leak) a 32-thread pool
+                # each build (and one leak) a 32-thread pool — and a
+                # create racing close() must not leak a pool post-close
+                if self._closed:
+                    raise_error("client is closed")
                 if self._executor is None:
                     self._executor = ThreadPoolExecutor(
                         max_workers=self._hedge_workers,
                         thread_name_prefix="tc-tpu-hedge")
-        return self._executor
+                executor = self._executor
+        return executor

@@ -64,6 +64,7 @@ class ClusterClient(InferenceServerClientBase):
         self._client_kwargs = dict(client_kwargs or {})
         self._client_factory = client_factory
         self._clients: Dict[str, Any] = {}
+        self._closed = False
         self._probe_task: Optional[asyncio.Task] = None
         # deferred: the constructor may run outside any event loop, so the
         # probe task starts lazily on the first routed call instead
@@ -90,6 +91,11 @@ class ClusterClient(InferenceServerClientBase):
     def _client_for(self, ep: Endpoint):
         client = self._clients.get(ep.url)
         if client is None:
+            if self._closed:
+                # a task resuming after close() must not rebuild a
+                # session/channel into a dict nobody will ever close
+                # (same contract as the sync client)
+                raise_error("client is closed")
             client = self._make_client(ep.url)
             if self._plugin is not None:
                 client.register_plugin(self._plugin)
@@ -110,6 +116,7 @@ class ClusterClient(InferenceServerClientBase):
                 c.unregister_plugin()
 
     async def close(self) -> None:
+        self._closed = True
         if self._probe_task is not None:
             self._probe_task.cancel()
             try:

@@ -32,7 +32,7 @@ from .registry import ModelRegistry
 from .shm import SystemShmRegistry, XlaShmRegistry
 from .device_stats import DeviceStatsCollector, SloEngine, SloObjective
 from .flight_recorder import FlightRecorder
-from .log import ServerLog
+from .log import ServerLog, log_off_loop
 from .qos import DEFAULT_TENANT, QosManager, TieredQueue
 from .trace import RequestTracer, TRACE_DEFAULTS
 from .types import (
@@ -1061,8 +1061,10 @@ class InferenceCore:
             except Exception as e:  # noqa: BLE001 — isolate per-model
                 ran[f"{key}:error"] = str(e)
                 # the startup path is where a tailing operator most needs
-                # the reason a model came up absent
-                self.log.error(
+                # the reason a model came up absent; the append rides the
+                # executor — a slow log disk must not stall the loop
+                log_off_loop(
+                    self.log.error,
                     f"model '{model.name}' unloaded: warmup failed: {e}")
                 try:
                     self.registry.unload(model.name)
@@ -1099,8 +1101,9 @@ class InferenceCore:
                     self.registry.unload(name)
                 except InferError:
                     pass
-                self.log.error(f"failed to load model '{name}': warmup "
-                               f"failed: {e}")
+                log_off_loop(self.log.error,
+                             f"failed to load model '{name}': warmup "
+                             f"failed: {e}")
                 raise InferError(
                     f"failed to load '{name}': warmup failed: {e}",
                     http_status=400)
@@ -1113,7 +1116,7 @@ class InferenceCore:
                 # loaded, serving-capable instance.
                 if self.registry.get_state(name)[0] == "LOADING":
                     self.registry.set_state(name, "READY", "")
-        self.log.info(f"successfully loaded model '{name}'")
+        log_off_loop(self.log.info, f"successfully loaded model '{name}'")
 
     def retire_name_caches(self, name: str) -> None:
         """Drop stale per-version batchers/inline-profiles for ``name``.

@@ -28,6 +28,7 @@ from ..utils import (
     triton_to_np_dtype,
 )
 from .core import InferenceCore
+from .log import log_off_loop
 from .model import datatype_to_pb
 from .qos import tenant_from_headers
 from .types import (InferError, InferRequest, InputTensor,
@@ -332,7 +333,8 @@ class InferenceServicer:
         except InferError as e:
             await context.abort(grpc.StatusCode.INTERNAL, str(e))
         self._core.retire_name_caches(request.model_name)
-        self._core.log.info(
+        log_off_loop(
+            self._core.log.info,
             f"successfully unloaded model '{request.model_name}'")
         return pb.RepositoryModelUnloadResponse()
 
@@ -501,11 +503,6 @@ class InferenceServicer:
         return resp
 
     # -- inference ---------------------------------------------------------
-    def _log_off_loop(self, method, *args):
-        # same move as the HTTP frontend: log-settings-driven lines exist
-        # on BOTH protocols, and file appends never block the event loop
-        asyncio.get_running_loop().run_in_executor(None, method, *args)
-
     async def ModelInfer(self, request, context):
         try:
             t_recv = time.monotonic_ns()
@@ -524,12 +521,12 @@ class InferenceServicer:
             rid = getattr(req, "client_request_id", "") \
                 if "req" in locals() else ""
             if e.http_status >= 500:
-                self._log_off_loop(
+                log_off_loop(
                     self._core.log.error,
                     f"grpc ModelInfer '{request.model_name}' failed: {e}",
                     rid)
             elif self._core.log.verbose_enabled():
-                self._log_off_loop(
+                log_off_loop(
                     self._core.log.verbose, 1,
                     f"grpc ModelInfer '{request.model_name}' -> "
                     f"{e.http_status}: {e}", rid)
@@ -545,7 +542,7 @@ class InferenceServicer:
                     pass  # metadata already sent / bridge test double
             await context.abort(_grpc_code(e), str(e))
         if self._core.log.verbose_enabled():
-            self._log_off_loop(
+            log_off_loop(
                 self._core.log.verbose, 1,
                 f"grpc ModelInfer '{request.model_name}' -> OK",
                 req.client_request_id)

@@ -29,6 +29,7 @@ from ..utils import (
     triton_to_np_dtype,
 )
 from .core import InferenceCore
+from .log import log_off_loop
 from .qos import tenant_from_headers
 from .types import (InferError, InferRequest, InputTensor,
                     RequestedOutput, ShmRef, apply_request_deadline,
@@ -135,12 +136,6 @@ def build_metrics_app(core: InferenceCore) -> web.Application:
 
 
 def _h(core: InferenceCore, fn):
-    def _log_off_loop(method, *args):
-        # file appends must not block the event loop (the tracer makes the
-        # same move): only the logging itself rides the executor, the
-        # response does not wait for it
-        asyncio.get_running_loop().run_in_executor(None, method, *args)
-
     async def handler(request: web.Request) -> web.Response:
         # propagated correlation id rides every log line for this request
         # (passed explicitly: the executor hop would lose a contextvar)
@@ -148,7 +143,7 @@ def _h(core: InferenceCore, fn):
         try:
             resp = await fn(core, request)
             if core.log.verbose_enabled():
-                _log_off_loop(
+                log_off_loop(
                     core.log.verbose, 1,
                     f"{request.method} {request.path} -> {resp.status}",
                     rid)
@@ -168,11 +163,11 @@ def _h(core: InferenceCore, fn):
             # mistakes — verbose only, or every fuzz/validation request
             # would spam the log
             if e.http_status >= 500:
-                _log_off_loop(
+                log_off_loop(
                     core.log.error,
                     f"{request.method} {request.path} failed: {e}", rid)
             elif core.log.verbose_enabled():
-                _log_off_loop(
+                log_off_loop(
                     core.log.verbose, 1,
                     f"{request.method} {request.path} -> "
                     f"{e.http_status}: {e}", rid)
@@ -195,7 +190,7 @@ def _h(core: InferenceCore, fn):
         except web.HTTPException:
             raise
         except Exception as e:  # pragma: no cover - defensive
-            _log_off_loop(
+            log_off_loop(
                 core.log.error,
                 f"{request.method} {request.path} crashed: {e}", rid)
             return web.json_response({"error": str(e)}, status=500)
@@ -279,7 +274,7 @@ async def _repo_unload(core, request):
     params = body.get("parameters", {}) or {}
     core.registry.unload(name, unload_dependents=bool(params.get("unload_dependents")))
     core.retire_name_caches(name)
-    core.log.info(f"successfully unloaded model '{name}'")
+    log_off_loop(core.log.info, f"successfully unloaded model '{name}'")
     return web.Response(status=200)
 
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict
 
 # one cached-append-handle state machine for the whole codebase: defined in
@@ -40,6 +41,26 @@ from typing import Any, Dict
 from .._telemetry import AppendFile  # noqa: F401 — re-export
 
 _LEVELS = ("info", "warning", "error")
+
+
+# One worker, module-level: emits submitted from the event loop drain
+# FIFO, so "unloaded" still lands before "loaded" even though neither
+# blocks the loop.  (The default multi-worker executor would let two
+# lifecycle lines race each other onto disk.)  Pending lines flush at
+# interpreter exit via the executor's atexit join.
+_LOG_EXECUTOR = ThreadPoolExecutor(max_workers=1,
+                                   thread_name_prefix="tc-tpu-log")
+
+
+def log_off_loop(method, *args) -> None:
+    """Run a :class:`ServerLog` emit on the logging executor — file/stderr
+    appends must never block the event loop (the ASYNC-BLOCK invariant;
+    both frontends and the async control-plane paths route through this).
+    Fire-and-forget: the response never waits for the log line, but
+    submit order is emit order.  Settings are read live at emit time (the
+    documented ServerLog contract): a settings update can apply to a line
+    whose response already returned."""
+    _LOG_EXECUTOR.submit(method, *args)
 
 
 class ServerLog:

@@ -1,0 +1,43 @@
+"""``triton-lint``: project-native static analysis for the TPU serving stack.
+
+A stdlib-``ast`` framework (no dependencies — tools contract) whose rules
+encode the semantic invariants this codebase has repeatedly violated and
+hand-caught in review:
+
+======================  =====================================================
+ASYNC-BLOCK             no blocking calls (sleep / sync IO / sync clients /
+                        indefinite Lock.acquire) inside ``async def`` bodies;
+                        executor hops recognized
+LOCK-ORDER              lock-acquisition cycles, nested non-reentrant
+                        acquisition, unlocked writes to lock-guarded fields
+EXC-CONTRACT            the four client cores raise only
+                        InferenceServerException from public methods
+SPAN-PAIR               every TraceContext/Span start reaches an
+                        emit/end/handoff
+METRICS-DECL            every nv_* family declared exactly once, references
+                        resolve, label sets consistent
+TEST-DETERMINISM        no unseeded global RNG or wall-clock-vs-quantile
+                        races in tests
+======================  =====================================================
+
+Suppress one finding with ``# tpu-lint: disable=RULE <reason>`` on (or one
+line above) the offending line; grandfather legacy findings in the
+checked-in ``.tpu-lint-baseline.json``.  The tier-1 gate
+(``tests/test_lint.py``) runs the full suite over the repo and fails on
+any non-baselined finding.  See ARCHITECTURE.md "Static analysis".
+"""
+
+from ._cli import main
+from ._engine import (Finding, Project, SourceFile, build_project,
+                      rule_help, rule_names, run_rules)
+
+__all__ = [
+    "main",
+    "Finding",
+    "Project",
+    "SourceFile",
+    "build_project",
+    "rule_names",
+    "rule_help",
+    "run_rules",
+]

@@ -1,0 +1,8 @@
+"""``python -m triton_client_tpu.tools.lint`` — parity with the other
+stdlib operator tools on boxes where the console script isn't on PATH."""
+
+import sys
+
+from ._cli import main
+
+sys.exit(main())

@@ -1,0 +1,136 @@
+"""Shared ast helpers for the triton-lint rules (stdlib only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+__all__ = [
+    "dotted_name",
+    "module_aliases",
+    "resolve_call_name",
+    "iter_body_nodes",
+    "awaited_ids",
+    "iter_functions",
+    "decorator_names",
+    "is_test_file",
+]
+
+
+def is_test_file(relpath: str) -> bool:
+    """Shared test-file predicate — rules that scope to (or exempt)
+    tests must agree on what a test file is."""
+    rp = relpath.replace("\\", "/")
+    base = rp.rsplit("/", 1)[-1]
+    return "/tests/" in f"/{rp}" or base.startswith("test_")
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a pure Name/Attribute chain; None when any link is a
+    call/subscript (so ``np.random.default_rng(0).normal`` is NOT the
+    module path ``np.random.normal``)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def module_aliases(tree: ast.AST) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """(module alias map, from-import map): ``import time as t`` ->
+    ``{"t": "time"}``; ``from time import sleep as zz`` ->
+    ``{"zz": "time.sleep"}``."""
+    mods: Dict[str, str] = {}
+    names: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    mods[a.asname] = a.name
+                else:
+                    # ``import urllib.request`` binds the name ``urllib``
+                    # — to itself, NOT to ``urllib.request`` (that would
+                    # double the submodule in resolved dotted chains)
+                    head = a.name.split(".")[0]
+                    mods[head] = head
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name != "*":
+                    names[a.asname or a.name] = f"{node.module}.{a.name}"
+    return mods, names
+
+
+def resolve_call_name(call: ast.Call, mods: Dict[str, str],
+                      names: Dict[str, str]) -> Optional[str]:
+    """The fully-qualified name of a call target when statically known:
+    import aliases resolved (``t.sleep`` -> ``time.sleep``; bare ``sleep``
+    imported from time -> ``time.sleep``).  None for dynamic targets."""
+    d = dotted_name(call.func)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    if not rest:
+        return names.get(d, d)
+    if head in mods:
+        return f"{mods[head]}.{rest}"
+    if head in names:
+        # ``from urllib import request`` then ``request.urlopen(...)``
+        return f"{names[head]}.{rest}"
+    return d
+
+
+def iter_body_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Every node lexically in ``fn``'s own body, NOT descending into
+    nested function/lambda definitions — the executor-hop recognition:
+    code inside a nested ``def`` handed to ``run_in_executor`` runs off
+    the calling context."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # nested definition: its body runs elsewhere
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def awaited_ids(fn: ast.AST) -> Set[int]:
+    """ids() of Call nodes that are directly awaited in ``fn``'s body —
+    ``await q.get()`` is the asyncio call, not a blocking one."""
+    out: Set[int] = set()
+    for node in iter_body_nodes(fn):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            out.add(id(node.value))
+    return out
+
+
+def iter_functions(tree: ast.AST) -> Iterator[Tuple[Optional[ast.ClassDef],
+                                                    ast.AST]]:
+    """Yield ``(enclosing class or None, function node)`` for every
+    function/async function in the module, at any nesting depth."""
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child
+                yield from walk(child, cls)
+            else:
+                yield from walk(child, cls)
+
+    yield from walk(tree, None)
+
+
+def decorator_names(fn: ast.AST) -> List[str]:
+    out = []
+    for dec in getattr(fn, "decorator_list", []):
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        d = dotted_name(node)
+        if d:
+            out.append(d)
+    return out

@@ -1,0 +1,165 @@
+"""The ``triton-lint`` command line (stdlib-only, like every operator tool).
+
+Usage:
+
+    triton-lint [PATHS...]                # lint (default: the repo root)
+    triton-lint --rule METRICS-DECL       # one rule
+    triton-lint --format json             # stable machine shape
+    triton-lint --write-baseline          # grandfather current findings
+    triton-lint --list-rules
+
+Exit codes: 0 = clean (baselined findings alone don't fail), 1 = fresh
+findings (or stale baseline entries — the baseline only ever shrinks),
+2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import rules as _rules  # noqa: F401 — registration side effect
+from ._engine import (DEFAULT_BASELINE_NAME, apply_baseline, baseline_entry,
+                      build_project, collect_files, common_root,
+                      entry_fingerprint, load_baseline, render_json,
+                      render_text, rule_help, run_rules,
+                      write_baseline_entries)
+
+
+def _walk_up_for_root(start: str) -> Optional[str]:
+    """Nearest ancestor (inclusive) holding a pyproject.toml or a
+    baseline file — the repo root."""
+    d = start
+    while True:
+        if os.path.exists(os.path.join(d, "pyproject.toml")) or \
+                os.path.exists(os.path.join(d, DEFAULT_BASELINE_NAME)):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def _default_paths() -> List[str]:
+    """Walk up from cwd for the repo root; lint that.  Falls back to cwd
+    — ``triton-lint`` with no arguments just works from anywhere in the
+    repo."""
+    return [_walk_up_for_root(os.getcwd()) or os.getcwd()]
+
+
+def _anchor_root(paths: List[str]) -> str:
+    """The root findings fingerprint against and the default baseline
+    resolves from: the enclosing repo root when the input paths live in
+    one, else their common root.  A path-scoped run
+    (``triton-lint triton_client_tpu/server``) must fingerprint findings
+    identically to a full-repo run, or the repo-root baseline can never
+    match them."""
+    common = common_root(paths)
+    return _walk_up_for_root(common) or common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="triton-lint",
+        description="project-native static analysis: the semantic "
+                    "invariants this codebase has repeatedly violated, "
+                    "as checkers")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "enclosing repo root)")
+    p.add_argument("--rule", action="append", dest="rules", metavar="RULE",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", metavar="PATH",
+                   help="baseline file (default: <root>/"
+                        f"{DEFAULT_BASELINE_NAME} when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for name, help_text in sorted(rule_help().items()):
+            print(f"{name}: {help_text}")
+        return 0
+    paths = args.paths or _default_paths()
+    root = _anchor_root(paths)
+    try:
+        pairs = collect_files(paths, root=root)
+    except FileNotFoundError as e:
+        print(f"triton-lint: {e}", file=sys.stderr)
+        return 2
+    if not pairs:
+        print("triton-lint: no python files found", file=sys.stderr)
+        return 2
+    project = build_project(paths, pairs=pairs)
+    try:
+        findings = run_rules(project, rules=args.rules)
+    except ValueError as e:
+        print(f"triton-lint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root,
+                                                  DEFAULT_BASELINE_NAME)
+    # Staleness ("the baseline only ever shrinks") is a FULL-TREE
+    # property: a path-scoped run cannot tell whether a finding outside
+    # its scan still reproduces — cross-file rules (METRICS-DECL,
+    # LOCK-ORDER cycles) need files the scope excludes.  So scoped runs
+    # never judge stale and a scoped --write-baseline merges by
+    # fingerprint union; only a full-root run shrinks the file.  Rule
+    # scoping is different: the full tree is scanned, so staleness
+    # within the selected rules is sound.
+    scoped = any(os.path.relpath(os.path.abspath(p), root) not in (".", "")
+                 for p in paths)
+    selected = {r.upper() for r in args.rules} if args.rules else None
+
+    def rule_in_scope(e) -> bool:
+        return selected is None \
+            or str(e.get("rule", "")).upper() in selected
+
+    if args.write_baseline:
+        entries = [baseline_entry(fd) for fd in findings]
+        if (selected or scoped) and os.path.exists(baseline_path):
+            try:
+                old = load_baseline(baseline_path)
+            except (ValueError, OSError) as e:
+                print(f"triton-lint: bad baseline: {e}", file=sys.stderr)
+                return 2
+            if scoped:
+                have = {entry_fingerprint(e) for e in entries}
+                entries += [e for e in old
+                            if entry_fingerprint(e) not in have]
+            else:
+                entries += [e for e in old if not rule_in_scope(e)]
+        write_baseline_entries(baseline_path, entries)
+        print(f"wrote {len(entries)} finding(s) to {baseline_path}")
+        return 0
+    stale = []
+    if not args.no_baseline and os.path.exists(baseline_path):
+        try:
+            entries = load_baseline(baseline_path)
+        except (ValueError, OSError) as e:
+            print(f"triton-lint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        stale = apply_baseline(findings,
+                               [e for e in entries if rule_in_scope(e)])
+        if scoped:
+            stale = []
+
+    render = render_json if args.format == "json" else render_text
+    print(render(findings, stale_baseline=stale,
+                 files_scanned=len(project.files)))
+    fresh = [fd for fd in findings if not fd.baselined]
+    return 1 if (fresh or stale) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

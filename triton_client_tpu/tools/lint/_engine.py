@@ -1,0 +1,481 @@
+"""The ``triton-lint`` engine: file model, rule registry, pragmas, baseline.
+
+This is a *project-native* static-analysis framework (stdlib ``ast`` only —
+the tools package is dependency-free by contract).  Generic linters catch
+style; the rules registered here encode semantic invariants this codebase
+has repeatedly violated and hand-fixed in review — blocking calls on the
+event loop, lock discipline in the stats collectors, the typed exception
+contract of the four client cores, span lifecycle, metrics-registry drift,
+and test determinism.  Each rule module documents the historical bug it
+encodes (see ARCHITECTURE.md "Static analysis").
+
+Framework pieces:
+
+* :class:`Finding` — one diagnostic: ``(rule, path, line, message)`` plus a
+  ``symbol`` (the enclosing ``Class.function`` scope) used for stable
+  baseline fingerprints (line numbers churn; symbols rarely do).
+* :class:`SourceFile` — one parsed file: source, ast, and the suppression
+  pragmas scanned from its comments.
+* :class:`Project` — the whole lint run's file set.  Rules receive the
+  project, so cross-file rules (lock graphs, the metrics registry) see
+  everything in one pass.
+* **pragmas** — ``# tpu-lint: disable=RULE[,RULE2] <reason>`` on the
+  finding's line (or the line above) suppresses it.  A pragma without a
+  reason is itself reported (rule ``PRAGMA``): an unexplained suppression
+  is exactly the review debt this tool exists to prevent.
+* **baseline** — a checked-in JSON file of grandfathered findings, matched
+  by ``(rule, path, symbol, message)`` fingerprint.  New findings fail the
+  gate; baselined ones report separately.  ``--write-baseline`` refreshes
+  it; stale entries (baselined but no longer found) are reported so the
+  baseline only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from io import StringIO
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "Project",
+    "register_rule",
+    "rule_names",
+    "rule_help",
+    "run_rules",
+    "load_baseline",
+    "baseline_entry",
+    "entry_fingerprint",
+    "apply_baseline",
+    "render_text",
+    "render_json",
+    "collect_files",
+    "build_project",
+    "DEFAULT_BASELINE_NAME",
+]
+
+DEFAULT_BASELINE_NAME = ".tpu-lint-baseline.json"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,-]+)\s*(.*)$")
+
+
+class Finding:
+    """One diagnostic.  ``symbol`` is the enclosing scope (``Class.fn`` /
+    ``fn`` / ``<module>``) — with ``rule``, ``path`` and ``message`` it
+    forms the baseline fingerprint, deliberately excluding the line number
+    so unrelated edits above a grandfathered finding don't un-baseline it."""
+
+    __slots__ = ("rule", "path", "line", "message", "symbol", "baselined")
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 symbol: str = "<module>") -> None:
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+        self.symbol = symbol
+        self.baselined = False
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol,
+                _normalize_message(self.message))
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "baselined": self.baselined,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Finding({self.rule}, {self.path}:{self.line}, {self.message!r})"
+
+
+class SourceFile:
+    """One parsed source file plus its suppression pragmas."""
+
+    def __init__(self, path: str, relpath: str, source: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        # lineno -> (set of rule names, reason text)
+        self.pragmas: Dict[int, Tuple[set, str]] = {}
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        self._scan_pragmas()
+
+    # -- pragmas -----------------------------------------------------------
+    def _scan_pragmas(self) -> None:
+        """Comment scan via tokenize so pragmas inside string literals are
+        never honored (a string containing the pragma text must not
+        suppress anything)."""
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _PRAGMA_RE.search(tok.string)
+                if m:
+                    rules = {r.strip().upper()
+                             for r in m.group(1).split(",") if r.strip()}
+                    self.pragmas[tok.start[0]] = (rules, m.group(2).strip())
+        except (tokenize.TokenError, SyntaxError):
+            # unparseable tail or tokenize-level IndentationError (a
+            # SyntaxError subclass ast.parse may not raise first); the
+            # PARSE finding already reports the file
+            pass
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A finding is suppressed by a pragma on its own line or the line
+        directly above (the decorator/comment-line idiom)."""
+        for ln in (line, line - 1):
+            entry = self.pragmas.get(ln)
+            if entry and rule.upper() in entry[0]:
+                return True
+        return False
+
+    # -- scope lookup ------------------------------------------------------
+    def symbol_at(self, line: int) -> str:
+        """The ``Class.function`` scope enclosing ``line`` (for baseline
+        fingerprints)."""
+        if self.tree is None:
+            return "<module>"
+        best: List[str] = []
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    end = getattr(child, "end_lineno", child.lineno)
+                    if child.lineno <= line <= (end or child.lineno):
+                        new = stack + [child.name]
+                        if len(new) > len(best):
+                            best[:] = new
+                        walk(child, new)
+                else:
+                    walk(child, stack)
+
+        walk(self.tree, [])
+        return ".".join(best) if best else "<module>"
+
+
+class Project:
+    """The lint run's file set, in scan order.  Rules receive the whole
+    project so cross-file rules (lock graphs, the metrics registry) see
+    everything in one pass."""
+
+    def __init__(self, files: Sequence[SourceFile]) -> None:
+        self.files = list(files)
+
+
+# -- rule registry ----------------------------------------------------------
+
+#: name -> (check callable, one-line help).  A check takes the Project and
+#: yields Findings; suppression/baseline filtering happen in the runner.
+_RULES: Dict[str, Tuple[Callable[[Project], Iterable[Finding]], str]] = {}
+
+#: Engine-level pseudo-rules: selectable and in the default set like any
+#: registered rule, but produced by the runner itself.
+_ENGINE_RULES: Dict[str, str] = {
+    "PARSE": "a file the linter was asked to check does not parse",
+    "PRAGMA": "a suppression pragma must carry a reason "
+              "(# tpu-lint: disable=RULE <why>)",
+}
+
+
+def register_rule(name: str, help_text: str):
+    """Decorator registering ``fn(project) -> Iterable[Finding]`` under
+    ``name`` (upper-case by convention, e.g. ``ASYNC-BLOCK``)."""
+
+    def deco(fn):
+        _RULES[name] = (fn, help_text)
+        return fn
+
+    return deco
+
+
+def rule_names() -> List[str]:
+    return sorted(set(_RULES) | set(_ENGINE_RULES))
+
+
+def rule_help() -> Dict[str, str]:
+    out = {name: help_text for name, (_fn, help_text) in _RULES.items()}
+    out.update(_ENGINE_RULES)
+    return out
+
+
+def run_rules(project: Project,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected rules (default: all) over the project.  ``PARSE``
+    (syntax errors) and ``PRAGMA`` (reasonless suppressions) are
+    engine-level pseudo-rules — in the default set, and selectable/
+    excludable exactly like registered rules, so a single-rule run never
+    fails on an unrelated file."""
+    # dedupe while preserving order: a repeated --rule flag must not run
+    # the rule twice and double every finding
+    selected = list(dict.fromkeys(r.upper() for r in rules)) if rules \
+        else rule_names()
+    unknown = [r for r in selected
+               if r not in _RULES and r not in _ENGINE_RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s): {', '.join(unknown)} "
+            f"(known: {', '.join(rule_names())})")
+    findings: List[Finding] = []
+    for f in project.files:
+        if f.parse_error is not None and "PARSE" in selected:
+            findings.append(Finding(
+                "PARSE", f.relpath, 1, f"syntax error: {f.parse_error}"))
+        if "PRAGMA" in selected:
+            for line, (rules_set, reason) in sorted(f.pragmas.items()):
+                if not reason:
+                    findings.append(Finding(
+                        "PRAGMA", f.relpath, line,
+                        "suppression pragma without a reason "
+                        "(# tpu-lint: disable=RULE <why>)",
+                        symbol=f.symbol_at(line)))
+    for name in selected:
+        if name in _ENGINE_RULES:
+            continue
+        fn, _help = _RULES[name]
+        for finding in fn(project):
+            findings.append(finding)
+    out = []
+    by_path = {f.relpath: f for f in project.files}
+    for fd in findings:
+        src = by_path.get(fd.path)
+        if src is not None and fd.rule != "PRAGMA" \
+                and src.suppressed(fd.rule, fd.line):
+            continue
+        out.append(fd)
+    out.sort(key=lambda fd: (fd.path, fd.line, fd.rule, fd.message))
+    return out
+
+
+# -- baseline ---------------------------------------------------------------
+
+_MSG_LINE_REFS = (re.compile(r"\bline \d+"), re.compile(r"(\.py):\d+"))
+
+
+def _normalize_message(msg: str) -> str:
+    """Messages may cite line numbers for humans ("first at line 12",
+    "core.py:88"); the baseline fingerprint must not — line churn above a
+    grandfathered finding would otherwise un-baseline it AND strand its
+    entry as stale.  Stored entries keep the raw message; matching
+    normalizes both sides."""
+    msg = _MSG_LINE_REFS[0].sub("line <n>", msg)
+    return _MSG_LINE_REFS[1].sub(r"\1:<n>", msg)
+
+
+def baseline_entry(fd: Finding) -> Dict[str, str]:
+    return {"rule": fd.rule, "path": fd.path, "symbol": fd.symbol,
+            "message": fd.message}
+
+
+def entry_fingerprint(e: Dict[str, str]) -> Tuple[str, str, str, str]:
+    """A stored entry's fingerprint, normalized the same way
+    :meth:`Finding.fingerprint` is."""
+    return (str(e.get("rule", "")), str(e.get("path", "")),
+            str(e.get("symbol", "")),
+            _normalize_message(str(e.get("message", ""))))
+
+
+def load_baseline(path: str) -> List[Dict[str, str]]:
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "findings" not in data \
+            or not isinstance(data["findings"], list) \
+            or not all(isinstance(e, dict) for e in data["findings"]):
+        raise ValueError(
+            f"baseline {path} must be an object with a 'findings' list "
+            "of objects")
+    return data["findings"]
+
+
+def apply_baseline(findings: List[Finding],
+                   entries: List[Dict[str, str]]) -> List[Dict[str, str]]:
+    """Mark baselined findings in place; return the STALE baseline entries
+    (grandfathered findings that no longer occur — prune them, the
+    baseline only ever shrinks).  Each entry absorbs one finding."""
+    budget: Dict[Tuple[str, str, str, str], int] = {}
+    raw_by_key: Dict[Tuple[str, str, str, str], List[Dict[str, str]]] = {}
+    for e in entries:
+        key = entry_fingerprint(e)
+        budget[key] = budget.get(key, 0) + 1
+        raw_by_key.setdefault(key, []).append(e)
+    for fd in findings:
+        key = fd.fingerprint()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            fd.baselined = True
+    stale = []
+    for key, left in sorted(budget.items()):
+        # report the raw stored entries (readable messages), newest last
+        for e in raw_by_key[key][len(raw_by_key[key]) - left:]:
+            stale.append({"rule": str(e.get("rule", "")),
+                          "path": str(e.get("path", "")),
+                          "symbol": str(e.get("symbol", "")),
+                          "message": str(e.get("message", ""))})
+    return stale
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    write_baseline_entries(path, [baseline_entry(fd) for fd in findings])
+
+
+def write_baseline_entries(path: str,
+                           entries: List[Dict[str, str]]) -> None:
+    data = {
+        "version": 1,
+        "comment": "grandfathered triton-lint findings; do not add entries "
+                   "— fix the code or carry a reasoned pragma instead",
+        "findings": sorted(
+            entries, key=lambda e: (e.get("rule", ""), e.get("path", ""),
+                                    e.get("symbol", ""),
+                                    e.get("message", ""))),
+    }
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+# -- reporters --------------------------------------------------------------
+
+def render_text(findings: List[Finding],
+                stale_baseline: Optional[List[Dict[str, str]]] = None,
+                files_scanned: int = 0) -> str:
+    lines = []
+    fresh = [fd for fd in findings if not fd.baselined]
+    base = [fd for fd in findings if fd.baselined]
+    for fd in fresh:
+        lines.append(f"{fd.path}:{fd.line}: {fd.rule} [{fd.symbol}] "
+                     f"{fd.message}")
+    for fd in base:
+        lines.append(f"{fd.path}:{fd.line}: {fd.rule} [baselined] "
+                     f"{fd.message}")
+    for e in (stale_baseline or []):
+        lines.append(f"stale baseline entry: {e['rule']} {e['path']} "
+                     f"[{e['symbol']}] {e['message']}")
+    lines.append(
+        f"{len(fresh)} finding(s), {len(base)} baselined, "
+        f"{len(stale_baseline or [])} stale baseline entr(ies), "
+        f"{files_scanned} file(s) scanned")
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding],
+                stale_baseline: Optional[List[Dict[str, str]]] = None,
+                files_scanned: int = 0) -> str:
+    """The stable machine shape (pinned by tests/test_lint.py — scripts may
+    depend on every key here)."""
+    fresh = [fd for fd in findings if not fd.baselined]
+    payload = {
+        "version": 1,
+        "files_scanned": files_scanned,
+        "findings": [fd.as_dict() for fd in findings],
+        "counts": {
+            "total": len(findings),
+            "fresh": len(fresh),
+            "baselined": len(findings) - len(fresh),
+            "by_rule": _count_by_rule(fresh),
+        },
+        "stale_baseline": list(stale_baseline or []),
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+def _count_by_rule(findings: List[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for fd in findings:
+        out[fd.rule] = out.get(fd.rule, 0) + 1
+    return out
+
+
+# -- file collection --------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".eggs", "build", "dist", "node_modules",
+              "venv", "site-packages"}
+
+
+def _skip_dir(name: str) -> bool:
+    # hidden directories cover .git/.venv/.tox/.claude/...; an in-repo
+    # virtualenv must never leak third-party code into the zero-finding
+    # gate (or the walk time)
+    return name.startswith(".") or name in _SKIP_DIRS
+
+
+def collect_files(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[Tuple[str, str]]:
+    """Expand the CLI path arguments into ``(abspath, relpath)`` pairs.
+    Directories walk recursively for ``*.py``; relpaths are relative to
+    ``root`` when given (the CLI passes the enclosing repo root so a
+    path-scoped run fingerprints findings identically to a full run and
+    matches the repo-root baseline), else to the common root of the
+    *input* paths.  A path that does not exist raises
+    ``FileNotFoundError`` — a renamed file in a CI invocation must fail
+    loudly, never report an empty-but-green run."""
+    abspaths: List[str] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if not os.path.exists(ap):
+            raise FileNotFoundError(f"no such file or directory: {p}")
+        if os.path.isdir(ap):
+            for walk_dir, dirs, files in os.walk(ap):
+                dirs[:] = sorted(d for d in dirs if not _skip_dir(d))
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        abspaths.append(os.path.join(walk_dir, fn))
+        else:
+            # an explicitly-passed FILE is always linted, extension or
+            # not (extensionless scripts are python too) — silently
+            # skipping a path the operator named would be an
+            # empty-but-green run for that file
+            abspaths.append(ap)
+    seen = set()
+    uniq = []
+    for ap in abspaths:
+        if ap not in seen:
+            seen.add(ap)
+            uniq.append(ap)
+    if not uniq:
+        return []
+    root = root or common_root(paths)
+    return [(ap, os.path.relpath(ap, root)) for ap in uniq]
+
+
+def common_root(paths: Sequence[str]) -> str:
+    """The shared root of the INPUT paths (files contribute their
+    directory)."""
+    dirs = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        dirs.append(ap if os.path.isdir(ap) else os.path.dirname(ap))
+    return os.path.commonpath(dirs) if dirs else os.getcwd()
+
+
+def build_project(paths: Sequence[str],
+                  pairs: Optional[List[Tuple[str, str]]] = None) -> Project:
+    """Build the project from ``paths``; pass ``pairs`` (a prior
+    ``collect_files`` result) to avoid walking the tree twice."""
+    files = []
+    for ap, rel in (pairs if pairs is not None else collect_files(paths)):
+        try:
+            with open(ap, encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        files.append(SourceFile(ap, rel, source))
+    return Project(files)

@@ -1,0 +1,5 @@
+"""Rule registration: importing this package registers every built-in
+checker with the engine's registry."""
+
+from . import (async_block, exc_contract, lock_order, metrics_decl,  # noqa: F401
+               span_pair, test_determinism)

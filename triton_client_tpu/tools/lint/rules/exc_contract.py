@@ -1,0 +1,248 @@
+"""EXC-CONTRACT: the four client cores raise only InferenceServerException.
+
+Historical bug class: PR 4 found ``InferAsyncRequest.get_result`` leaking
+raw ``grpc.FutureTimeoutError`` (and the HTTP sibling leaking the
+concurrent.futures timeout) instead of the typed
+``InferenceServerException(status="StatusCode.DEADLINE_EXCEEDED")`` every
+caller matches on.  A naked transport exception breaks retry
+classification, the cluster layer's failure accounting, and every caller
+that catches the documented type.
+
+Scope: the four client cores (``http/_client.py``,
+``http/aio/__init__.py``, ``grpc/_client.py``, ``grpc/aio/__init__.py``)
+plus ``grpc/_infer_stream.py``.  Connection-class errors deliberately
+propagate raw — the resilience layer classifies them by type name
+(``_resilience._CONNECTION_EXC_NAMES``) — so the rule targets the
+*status-carrying* transport surfaces:
+
+* every ``self._client_stub.<RPC>(...)`` call must sit inside a ``try``
+  whose handlers include ``grpc.RpcError`` and convert it (the handler
+  body references ``raise_error_grpc`` / ``get_error_grpc`` /
+  ``InferenceServerException``).  ``.future(...)`` handles are exempt
+  (errors surface through the future's ``result()``), as are un-awaited
+  aio calls (stream-call construction does not raise transport errors).
+* every ``<future>.result(...)`` call in the gRPC cores must sit inside a
+  ``try`` handling ``FutureTimeoutError`` (or a converting RpcError
+  handler alongside) — the exact PR 4 leak.
+* every *public* method of an HTTP client class that touches the wire
+  directly (``self._get`` / ``self._post`` / ``self._pool.request`` /
+  ``self._session.*``) must call ``raise_if_error`` somewhere in its body
+  (nested ``_call`` closures count).  Delegation through one level of
+  ``self._helper()`` is resolved: a private helper's wire-touching (and
+  its conversion, if any) is attributed to the public caller, so a
+  public method whose helper hits the transport without converting
+  still fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from .._ast_util import dotted_name, iter_functions
+from .._engine import Finding, Project, register_rule
+
+_CLIENT_CORE_SUFFIXES = (
+    "http/_client.py",
+    "http/aio/__init__.py",
+    "grpc/_client.py",
+    "grpc/aio/__init__.py",
+    "grpc/_infer_stream.py",
+)
+
+_CONVERTERS = {"raise_error_grpc", "get_error_grpc",
+               "InferenceServerException", "raise_error"}
+
+_HTTP_TRANSPORT_HEADS = ("self._get", "self._post", "self._pool.request",
+                         "self._session.get", "self._session.post",
+                         "self._session.request")
+
+
+def _is_client_core(relpath: str) -> bool:
+    rp = relpath.replace("\\", "/")
+    return any(rp.endswith(s) for s in _CLIENT_CORE_SUFFIXES)
+
+
+def _handler_names(handler: ast.ExceptHandler) -> Set[str]:
+    out: Set[str] = set()
+    t = handler.type
+    if t is None:
+        out.add("<bare>")
+        return out
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in nodes:
+        d = dotted_name(n)
+        if d:
+            out.add(d.rsplit(".", 1)[-1])
+    return out
+
+
+def _handler_converts(handler: ast.ExceptHandler) -> bool:
+    """A handler satisfies the contract when it converts (calls a
+    converter / raises the typed exception) or absorbs (never bare
+    re-``raise``s the transport exception — swallowing into telemetry is
+    not a leak).  Only a bare ``raise`` hands the naked transport
+    exception to the caller."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d and d.rsplit(".", 1)[-1] in _CONVERTERS:
+                return True
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return False
+        if isinstance(node, ast.Raise) and isinstance(node.exc, ast.Name) \
+                and node.exc.id == handler.name:
+            return False  # `raise e` — same leak as a bare re-raise
+    return True
+
+
+class _TryStack(ast.NodeVisitor):
+    """Visit calls with the stack of enclosing Try handlers available.
+    Nested function/lambda bodies are skipped: they run in their own
+    frames (callbacks, closures) where the lexical Try does not catch —
+    ``iter_functions`` visits them as functions in their own right."""
+
+    def __init__(self):
+        self.stack: List[ast.Try] = []
+        self.hits: List[Tuple[ast.Call, List[ast.Try]]] = []
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Try(self, node: ast.Try):
+        self.stack.append(node)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.stack.pop()
+        for h in node.handlers:
+            self.visit(h)
+        for stmt in node.orelse + node.finalbody:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call):
+        self.hits.append((node, list(self.stack)))
+        self.generic_visit(node)
+
+
+def _covering_handlers(tries: List[ast.Try], wanted: Set[str]) -> bool:
+    for t in tries:
+        for h in t.handlers:
+            names = _handler_names(h)
+            if names & wanted or "<bare>" in names or "Exception" in names:
+                # naming the right exception is not enough: a handler
+                # that catches FutureTimeoutError and bare re-raises it
+                # is exactly the PR 4 leak
+                if _handler_converts(h):
+                    return True
+    return False
+
+
+def _grpc_checks(f, tree):
+    is_aio = "aio" in f.relpath.replace("\\", "/").split("/")
+    for _cls, fn in iter_functions(tree):
+        visitor = _TryStack()
+        for stmt in fn.body:
+            visitor.visit(stmt)
+        awaited = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Await) and isinstance(node.value,
+                                                          ast.Call):
+                awaited.add(id(node.value))
+        for call, tries in visitor.hits:
+            d = dotted_name(call.func) or ""
+            if "_client_stub." in d:
+                if d.endswith(".future"):
+                    continue  # errors surface through the future handle
+                if is_aio and id(call) not in awaited:
+                    # aio call-object construction raises nothing; errors
+                    # surface at await/read() — which IS checked
+                    continue
+                if not _covering_handlers(tries, {"RpcError"}):
+                    yield Finding(
+                        "EXC-CONTRACT", f.relpath, call.lineno,
+                        f"{d}(...) not wrapped in a grpc.RpcError handler "
+                        "that converts to InferenceServerException",
+                        symbol=f.symbol_at(call.lineno))
+            elif d.endswith(".result") and call.func and \
+                    isinstance(call.func, ast.Attribute):
+                # futures: the PR 4 leak — result() without a
+                # FutureTimeoutError guard re-raises the raw timeout class
+                if not _covering_handlers(
+                        tries, {"RpcError", "FutureTimeoutError",
+                                "TimeoutError", "FutureCancelledError"}):
+                    yield Finding(
+                        "EXC-CONTRACT", f.relpath, call.lineno,
+                        f"{d}(...) without a FutureTimeoutError/RpcError "
+                        "guard — a transport timeout leaks raw instead of "
+                        "the typed deadline exception",
+                        symbol=f.symbol_at(call.lineno))
+
+
+def _http_checks(f, tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        # first pass: per-method wire/convert facts + private self-calls,
+        # so delegation through one level of self._helper() is attributed
+        # to the public caller instead of silently passing
+        info = {}
+        for fn in node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            touches_wire = False
+            converts = False
+            self_calls = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    d = dotted_name(sub.func) or ""
+                    if any(d == h or d.startswith(h + ".")
+                           for h in _HTTP_TRANSPORT_HEADS):
+                        touches_wire = True
+                    if d.rsplit(".", 1)[-1] in ("raise_if_error",
+                                                "raise_error"):
+                        converts = True
+                    if d.startswith("self._") and d.count(".") == 1:
+                        self_calls.add(d.split(".", 1)[1])
+            info[fn.name] = (fn, touches_wire, converts, self_calls)
+        for name, (fn, touches_wire, converts, self_calls) in info.items():
+            if name.startswith("_"):
+                continue  # private helpers flagged via their public callers
+            for callee in self_calls:
+                entry = info.get(callee)
+                if entry is not None and entry[1]:
+                    # the private helper touches the wire on this public
+                    # method's behalf: its conversion (or lack of it)
+                    # is this method's
+                    touches_wire = True
+                    converts = converts or entry[2]
+            if touches_wire and not converts:
+                yield Finding(
+                    "EXC-CONTRACT", f.relpath, fn.lineno,
+                    f"public method {fn.name}() touches the HTTP transport "
+                    "(directly or via a private helper) but never calls "
+                    "raise_if_error — error statuses leak as raw "
+                    "bodies/exceptions",
+                    symbol=f.symbol_at(fn.lineno))
+
+
+@register_rule(
+    "EXC-CONTRACT",
+    "client cores raise only InferenceServerException from public methods "
+    "(gRPC stub calls wrapped, future results timeout-guarded, HTTP "
+    "statuses funneled through raise_if_error)")
+def check(project: Project):
+    for f in project.files:
+        if f.tree is None or not _is_client_core(f.relpath):
+            continue
+        rp = f.relpath.replace("\\", "/")
+        if "grpc/" in rp:
+            yield from _grpc_checks(f, f.tree)
+        else:
+            yield from _http_checks(f, f.tree)

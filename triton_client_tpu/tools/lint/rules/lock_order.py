@@ -1,0 +1,289 @@
+"""LOCK-ORDER: lock discipline across the server's shared-state classes.
+
+Historical bug class: PR 7 review caught ``/metrics`` triple-summing a
+deque under the device-stats lock while executor threads appended to it,
+and the SLO engine resolving model objectives (which takes registry locks)
+*inside* its own lock — the comment at ``device_stats.py`` "resolve
+OUTSIDE the lock" is the hand-enforced version of this rule.  The batcher
+thread × event loop × scrape path all share these structures; a
+lock-order inversion deadlocks the data plane, and an unlocked write to a
+lock-guarded field is a torn read on the scrape path.
+
+Two checks:
+
+* **acquisition graph** — ``with lockB`` lexically nested inside ``with
+  lockA`` (plus one level of same-class ``self.method()`` resolution)
+  builds edges ``A -> B``.  A self-edge on a non-reentrant lock is an
+  instant deadlock; a cycle between distinct locks is an ordering
+  inversion waiting for the right interleaving.
+* **guard consistency** — within a class owning a lock, an attribute
+  written under ``with <lock>`` in one method and written outside any
+  lock block in another (``__init__`` excluded: construction happens
+  before sharing) is flagged — the unguarded write races every locked
+  reader.
+
+Lock identity is file-qualified ``path:ClassName.attr`` for
+``self.<attr>`` context managers whose name contains "lock", and
+``path:<expression text>`` otherwise — module-level locks participate in
+the graph, and same-named locks in different files stay distinct nodes
+(see ``_lock_id``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .._ast_util import dotted_name
+from .._engine import Finding, Project, register_rule
+
+
+def _lock_exprs(with_node: ast.With) -> List[str]:
+    out = []
+    for item in with_node.items:
+        d = dotted_name(item.context_expr)
+        if d is not None and "lock" in d.lower():
+            out.append(d)
+    return out
+
+
+def _lock_id(cls_name: Optional[str], expr: str,
+             relpath: str = "") -> str:
+    """File-qualified lock identity: four classes in this codebase share
+    the name ``InferenceServerClient`` — without the path qualifier,
+    unrelated same-named locks in different files would merge into one
+    graph node and fabricate lock-order cycles.  (The flip side, a lock
+    object genuinely shared across files under different spellings, was
+    never resolvable lexically — documented limit.)"""
+    if cls_name and expr.startswith("self."):
+        return f"{relpath}:{cls_name}.{expr[len('self.'):]}"
+    return f"{relpath}:{expr}" if relpath else expr
+
+
+class _ClassInfo:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        # lock attr -> reentrant? (self._lock = threading.Lock()/RLock())
+        self.locks: Dict[str, bool] = {}
+        # method name -> list of (lock expr, held set at acquisition, node)
+        self.acquisitions: Dict[str, List[Tuple[str, Tuple[str, ...], int]]] = {}
+        # method name -> set of lock exprs acquired at its top level
+        self.method_locks: Dict[str, Set[str]] = {}
+        # method name -> [(self-call name, held locks, lineno)]
+        self.calls_while_held: Dict[str, List[Tuple[str, Tuple[str, ...],
+                                                    int]]] = {}
+        # attr -> True if ever written under a lock; writes outside
+        self.guarded_attrs: Set[str] = set()
+        self.unguarded_writes: List[Tuple[str, str, int]] = []
+
+
+def _scan_class(cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(cls.name)
+    for fn in cls.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        acquisitions: List[Tuple[str, Tuple[str, ...], int]] = []
+        toplevel: Set[str] = set()
+        calls: List[Tuple[str, Tuple[str, ...], int]] = []
+        writes: List[Tuple[str, bool, int]] = []
+
+        def walk(node, held: Tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                new_held = held
+                if isinstance(child, ast.With):
+                    names = _lock_exprs(child)
+                    for n in names:
+                        acquisitions.append((n, held, child.lineno))
+                        if not held:
+                            toplevel.add(n)
+                    new_held = held + tuple(names)
+                if isinstance(child, ast.Call):
+                    d = dotted_name(child.func)
+                    if d and d.startswith("self.") and "." not in d[5:] \
+                            and held:
+                        calls.append((d[5:], held, child.lineno))
+                if isinstance(child, (ast.Assign, ast.AugAssign)):
+                    targets = (child.targets if isinstance(child, ast.Assign)
+                               else [child.target])
+                    for t in targets:
+                        # descend one level into tuple/list unpacking:
+                        # `a, self.x = ..., None` writes self.x too
+                        elts = (list(t.elts)
+                                if isinstance(t, (ast.Tuple, ast.List))
+                                else [t])
+                        for tt in elts:
+                            if isinstance(tt, ast.Attribute) \
+                                    and isinstance(tt.value, ast.Name) \
+                                    and tt.value.id == "self":
+                                writes.append((tt.attr, bool(held),
+                                               child.lineno))
+                    # lock construction: self.X = threading.Lock()/RLock()
+                    if isinstance(child, ast.Assign) \
+                            and isinstance(child.value, ast.Call):
+                        vd = dotted_name(child.value.func) or ""
+                        if vd.endswith("RLock") or vd.endswith("Lock"):
+                            for t in child.targets:
+                                if isinstance(t, ast.Attribute) \
+                                        and isinstance(t.value, ast.Name) \
+                                        and t.value.id == "self":
+                                    info.locks[t.attr] = vd.endswith("RLock")
+                walk(child, new_held)
+
+        walk(fn, ())
+        info.acquisitions[fn.name] = acquisitions
+        info.method_locks[fn.name] = toplevel
+        info.calls_while_held[fn.name] = calls
+        for attr, under_lock, lineno in writes:
+            if under_lock:
+                info.guarded_attrs.add(attr)
+        # methods named *_locked are called with the lock already held —
+        # the codebase's own convention (_prune_locked, _close_locked);
+        # __init__ writes happen before the object is shared
+        if fn.name != "__init__" and not fn.name.endswith("_locked"):
+            for attr, under_lock, lineno in writes:
+                if not under_lock:
+                    info.unguarded_writes.append((fn.name, attr, lineno))
+    return info
+
+
+def _module_lock_kinds(tree: ast.AST) -> Dict[str, bool]:
+    """Module-level ``X = threading.Lock()/RLock()`` -> reentrancy."""
+    out: Dict[str, bool] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            vd = dotted_name(node.value.func) or ""
+            if vd.endswith("Lock"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = vd.endswith("RLock")
+    return out
+
+
+def _module_function_edges(tree: ast.AST):
+    """Lexical with-lock nesting in functions OUTSIDE classes:
+    yields (holder, acquired, lineno) plus same-lock re-acquisitions."""
+    class_funcs = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    class_funcs.add(id(sub))
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or id(node) in class_funcs:
+            continue
+
+        def walk(n, held):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                new_held = held
+                if isinstance(child, ast.With):
+                    names = _lock_exprs(child)
+                    for nm in names:
+                        for h in held:
+                            yield (h, nm, child.lineno)
+                    new_held = held + tuple(names)
+                yield from walk(child, new_held)
+
+        yield from walk(node, ())
+
+
+@register_rule(
+    "LOCK-ORDER",
+    "lock-acquisition cycles / nested non-reentrant acquisition / writes "
+    "to lock-guarded fields outside the lock")
+def check(project: Project):
+    # edges: (holder lock id, acquired lock id) -> first (path, line)
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for f in project.files:
+        if f.tree is None:
+            continue
+        mod_locks = _module_lock_kinds(f.tree)
+        for holder, acquired, lineno in _module_function_edges(f.tree):
+            if holder == acquired:
+                if not mod_locks.get(acquired, False):
+                    yield Finding(
+                        "LOCK-ORDER", f.relpath, lineno,
+                        f"nested acquisition of non-reentrant lock "
+                        f"{acquired} (already held) — instant deadlock",
+                        symbol=f.symbol_at(lineno))
+            else:
+                edges.setdefault((_lock_id(None, holder, f.relpath),
+                                  _lock_id(None, acquired, f.relpath)),
+                                 (f.relpath, lineno))
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _scan_class(node)
+            reentrant = {attr for attr, re_ in info.locks.items() if re_}
+            def _is_reentrant(expr: str) -> bool:
+                # self attrs consult the class's lock constructions;
+                # module-level names consult the module's
+                if expr.startswith("self."):
+                    return expr[len("self."):] in reentrant
+                return mod_locks.get(expr, False)
+
+            for method, acqs in info.acquisitions.items():
+                for expr, held, lineno in acqs:
+                    lid = _lock_id(info.name, expr, f.relpath)
+                    for h in held:
+                        hid = _lock_id(info.name, h, f.relpath)
+                        if hid == lid:
+                            if not _is_reentrant(expr):
+                                yield Finding(
+                                    "LOCK-ORDER", f.relpath, lineno,
+                                    f"nested acquisition of non-reentrant "
+                                    f"lock {lid} (already held) — instant "
+                                    "deadlock",
+                                    symbol=f.symbol_at(lineno))
+                        else:
+                            edges.setdefault((hid, lid),
+                                             (f.relpath, lineno))
+            # one level of intra-class call resolution: holding L, calling
+            # self.m() where m acquires M at its top level => edge L -> M
+            for method, calls in info.calls_while_held.items():
+                for callee, held, lineno in calls:
+                    for acquired in info.method_locks.get(callee, ()):
+                        lid = _lock_id(info.name, acquired, f.relpath)
+                        for h in held:
+                            hid = _lock_id(info.name, h, f.relpath)
+                            if hid == lid:
+                                if not _is_reentrant(acquired):
+                                    yield Finding(
+                                        "LOCK-ORDER", f.relpath, lineno,
+                                        f"self.{callee}() re-acquires "
+                                        f"non-reentrant lock {lid} already "
+                                        f"held here — instant deadlock",
+                                        symbol=f.symbol_at(lineno))
+                            else:
+                                edges.setdefault((hid, lid),
+                                                 (f.relpath, lineno))
+            # guard consistency
+            if info.locks:
+                for method, attr, lineno in info.unguarded_writes:
+                    if attr in info.guarded_attrs \
+                            and attr not in info.locks:
+                        yield Finding(
+                            "LOCK-ORDER", f.relpath, lineno,
+                            f"write to self.{attr} outside any lock block "
+                            f"({info.name}.{method}); the same field is "
+                            "written under a lock elsewhere — torn "
+                            "read for locked readers",
+                            symbol=f.symbol_at(lineno))
+    # cycles in the cross-file lock graph (A->B with B->A anywhere)
+    seen = set()
+    for (a, b), (path, lineno) in sorted(edges.items()):
+        if (b, a) in edges and (b, a) not in seen:
+            seen.add((a, b))
+            other_path, other_line = edges[(b, a)]
+            yield Finding(
+                "LOCK-ORDER", path, lineno,
+                f"lock-order cycle: {a} -> {b} here but {b} -> {a} at "
+                f"{other_path}:{other_line} — deadlock under the right "
+                "interleaving",
+                symbol="<graph>")

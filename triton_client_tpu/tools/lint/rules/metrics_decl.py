@@ -1,0 +1,163 @@
+"""METRICS-DECL: every metric family declared exactly once, referenced
+families exist, label sets are consistent.
+
+Historical bug class: before PR 7's metrics refactor, families were
+declared ad hoc at multiple render sites and the text/JSON surfaces
+drifted (a family added to one but not the other); the metrics-registry
+lint bolted into ``tests/test_tools_import.py`` froze the invariant
+dynamically.  This rule is that lint generalized and made static — it
+runs without importing the server (no jax), so it also guards code paths
+a unit test process never loads.
+
+Model:
+
+* the **server registry** is the file named ``server/metrics.py`` (any
+  file whose basename is ``metrics.py`` defining ``collect_families``):
+  every string constant that *is exactly* an ``nv_*`` family name
+  (whole-string match — mentions inside help prose don't count) is a
+  declaration and must be unique.
+* the **client registry** is ``_telemetry.py``: same treatment for the
+  ``nv_client_*`` families it renders.
+* every other scanned file that references a whole-string ``nv_*``
+  constant must reference a declared family — a renamed or typo'd family
+  in ``triton-top``, ``bench`` glue, or a frontend fails here instead of
+  silently scraping nothing.
+* label-set consistency: inside the server registry, sample-label dicts
+  written literally in the same ``families.append((<name>, ...))`` call
+  must agree on their key set per family.
+
+Test files are excluded from the reference scan (fixtures legitimately
+invent family names), and docstrings never count.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .._ast_util import is_test_file
+from .._engine import Finding, Project, register_rule
+
+_FAMILY_RE = re.compile(r"^nv_[a-z0-9_]+$")
+
+
+def _docstring_ids(tree: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _family_constants(tree: ast.AST) -> List[Tuple[str, int]]:
+    """(name, lineno) for every whole-string nv_* constant outside
+    docstrings."""
+    docs = _docstring_ids(tree)
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and id(node) not in docs and _FAMILY_RE.match(node.value):
+            out.append((node.value, node.lineno))
+    return out
+
+
+def _defines_collect_families(tree: ast.AST) -> bool:
+    return any(isinstance(n, ast.FunctionDef)
+               and n.name == "collect_families" for n in ast.walk(tree))
+
+
+def _label_sets(tree: ast.AST) -> Dict[str, List[Tuple[Set[str], int]]]:
+    """family -> [(label key set, lineno)] from ``families.append((name,
+    ...))`` calls whose label dicts are literal with constant keys."""
+    out: Dict[str, List[Tuple[Set[str], int]]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append" and node.args):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Tuple) and arg.elts):
+            continue
+        first = arg.elts[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and _FAMILY_RE.match(first.value)):
+            continue
+        family = first.value
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Dict) and sub.keys and all(
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    for k in sub.keys):
+                out.setdefault(family, []).append(
+                    ({k.value for k in sub.keys}, sub.lineno))
+    return out
+
+
+@register_rule(
+    "METRICS-DECL",
+    "every nv_* family declared exactly once in its registry "
+    "(metrics.collect_families / _telemetry), all references declared, "
+    "literal label sets consistent per family")
+def check(project: Project):
+    server_reg = None
+    client_reg = None
+    for f in project.files:
+        if f.tree is None:
+            continue
+        base = f.relpath.replace("\\", "/").rsplit("/", 1)[-1]
+        if base == "metrics.py" and _defines_collect_families(f.tree):
+            server_reg = f
+        elif base == "_telemetry.py":
+            client_reg = f
+
+    declared: Set[str] = set()
+    for reg, label in ((server_reg, "server"), (client_reg, "client")):
+        if reg is None:
+            continue
+        counts: Dict[str, List[int]] = {}
+        for name, lineno in _family_constants(reg.tree):
+            counts.setdefault(name, []).append(lineno)
+        for name, linenos in sorted(counts.items()):
+            declared.add(name)
+            if len(linenos) > 1:
+                yield Finding(
+                    "METRICS-DECL", reg.relpath, linenos[1],
+                    f"family {name} declared {len(linenos)} times in the "
+                    f"{label} registry (first at line {linenos[0]}) — one "
+                    "declaration, one HELP, one TYPE",
+                    symbol=reg.symbol_at(linenos[1]))
+        if reg is server_reg:
+            for family, sets in sorted(_label_sets(reg.tree).items()):
+                base_keys = sets[0][0]
+                for keys, lineno in sets[1:]:
+                    if keys != base_keys:
+                        yield Finding(
+                            "METRICS-DECL", reg.relpath, lineno,
+                            f"family {family} emits label set "
+                            f"{sorted(keys)} here but {sorted(base_keys)} "
+                            f"at line {sets[0][1]} — label drift splits "
+                            "the family",
+                            symbol=reg.symbol_at(lineno))
+
+    if not declared:
+        return  # no registry in this run: nothing to check references against
+
+    for f in project.files:
+        if f.tree is None or f is server_reg or f is client_reg:
+            continue
+        if is_test_file(f.relpath):
+            continue
+        for name, lineno in _family_constants(f.tree):
+            if name not in declared:
+                yield Finding(
+                    "METRICS-DECL", f.relpath, lineno,
+                    f"reference to undeclared metric family {name} — not "
+                    "in metrics.collect_families or the client telemetry "
+                    "registry (renamed? typo?)",
+                    symbol=f.symbol_at(lineno))

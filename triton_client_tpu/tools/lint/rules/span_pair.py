@@ -1,0 +1,128 @@
+"""SPAN-PAIR: every span/trace start reaches a completion.
+
+Historical bug class: the span-structured tracing of PR 2/3 lives and
+dies by pairing — a ``TraceContext`` that is started but never emitted
+loses the request from the trace file AND from the flight recorder's
+completion pipeline (``emit`` is what hands the record to
+``FlightRecorder.complete``, which feeds the SLO burn windows).  An
+unclosed ``Span`` object emits as a zero-length point, silently
+corrupting queue-share math in ``trace_summary``.
+
+Intra-procedural checks (documented limitation: a context handed to
+another function is trusted — the rule targets the start-and-forget
+shape, not whole-program escape analysis):
+
+* a call to ``.begin_span(...)`` or ``.begin_root(...)`` requires
+  completion evidence in the same function: a ``.end(...)`` /
+  ``.finish()`` / ``.emit()`` / ``.emit_async()`` call, or handoff
+  (``<resp>.trace = <ctx>`` / reading ``.trace_handoff``).
+* a ``TraceContext`` obtained from ``maybe_start(...)`` /
+  ``start_shadow(...)`` and *assigned to a name* requires the same
+  completion evidence in the function — or the variable escaping as a
+  call argument / return value (handoff to the completing layer).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .._ast_util import dotted_name, iter_body_nodes, iter_functions
+from .._engine import Finding, Project, register_rule
+
+_STARTERS_SPAN = {"begin_span", "begin_root"}
+_STARTERS_CTX = {"maybe_start", "start_shadow"}
+_CLOSERS = {"end", "finish", "emit", "emit_async"}
+
+
+def _completion_evidence(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if node.func.attr in _CLOSERS:
+                return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "trace":
+                    return True
+        if isinstance(node, ast.Attribute) and node.attr == "trace_handoff":
+            return True
+    return False
+
+
+def _escapes(fn: ast.AST, name: str) -> bool:
+    """The context variable leaves the function: returned, yielded, or
+    passed as an argument — the completing layer owns it now."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Return, ast.Yield)) \
+                and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+        if isinstance(node, ast.Call):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return True
+    return False
+
+
+@register_rule(
+    "SPAN-PAIR",
+    "every TraceContext/Span start reaches an emit/end/handoff in its "
+    "function (start-and-forget loses the request from trace + flight "
+    "recorder + SLO pipelines)")
+def check(project: Project):
+    for f in project.files:
+        if f.tree is None:
+            continue
+        rp = f.relpath.replace("\\", "/")
+        if rp.endswith("server/trace.py"):
+            continue  # the implementation itself defines these methods
+        for _cls, fn in iter_functions(f.tree):
+            has_completion = None  # computed lazily per function
+            # own-body only: a starter inside a nested def is that
+            # function's responsibility (iter_functions visits it too)
+            for node in iter_body_nodes(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                attr = node.func.attr
+                if attr in _STARTERS_SPAN:
+                    if has_completion is None:
+                        has_completion = _completion_evidence(fn)
+                    if not has_completion:
+                        yield Finding(
+                            "SPAN-PAIR", f.relpath, node.lineno,
+                            f".{attr}(...) with no end/finish/emit/handoff "
+                            f"in {fn.name}() — the span never closes",
+                            symbol=f.symbol_at(node.lineno))
+                elif attr in _STARTERS_CTX:
+                    d = dotted_name(node.func) or ""
+                    if not (d.endswith("tracer." + attr)
+                            or d.startswith("self.tracer.")
+                            or "tracer" in d):
+                        continue  # e.g. cluster's _maybe_start_probing
+                    # find the assigned name, if any
+                    target = _assigned_name(fn, node)
+                    if has_completion is None:
+                        has_completion = _completion_evidence(fn)
+                    if has_completion:
+                        continue
+                    if target is not None and _escapes(fn, target):
+                        continue
+                    yield Finding(
+                        "SPAN-PAIR", f.relpath, node.lineno,
+                        f"TraceContext from {attr}(...) never reaches "
+                        f"emit/finish/handoff in {fn.name}() — the request "
+                        "vanishes from trace, flight recorder, and SLO "
+                        "pipelines",
+                        symbol=f.symbol_at(node.lineno))
+
+
+def _assigned_name(fn: ast.AST, call: ast.Call):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    return t.id
+    return None

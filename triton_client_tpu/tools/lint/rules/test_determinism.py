@@ -1,0 +1,153 @@
+"""TEST-DETERMINISM: tests must not depend on wall-clock luck or global RNG.
+
+Historical bug class: PR 3's flight-recorder watchdog tests originally
+slept real time to push a request past a *streaming-quantile* threshold —
+a loaded CI host oversleeps, the quantile moves, the test flakes; they
+were rewritten onto synthetic spans ("no wall-clock sleeps against
+quantiles").  PR 2 fixed trace-count test-order coupling from shared
+global state.  This rule pins those lessons:
+
+* **unseeded global RNG** — module-level ``random.*`` / ``np.random.*``
+  calls (``random.Random(seed)``, ``np.random.default_rng(seed)`` and
+  ``jax.random.PRNGKey`` chains are fine: the receiver must be the bare
+  module for the finding to fire).  Global RNG state couples tests to
+  execution order.
+* **wall-clock vs quantiles** — an argless ``time.time()`` call in a test
+  function that also queries a streaming quantile (``.quantile(...)``):
+  comparing wall-clock arithmetic against an estimator fed by real
+  latencies is the PR 3 flake shape.
+* **sleeps racing quantiles** — ``time.sleep(...)`` in a test function
+  that also queries ``.quantile(...)`` or configures
+  ``capture_slower_than`` thresholds, unless the test is ``slow``-marked
+  (soaks excepted).  Fixed-duration service sleeps against *absolute*
+  thresholds are fine — the flake is sleeping against a moving estimate.
+
+Scope: files under ``tests/`` (or named ``test_*.py``) only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from .._ast_util import (decorator_names, is_test_file, iter_body_nodes,
+                         iter_functions, module_aliases, resolve_call_name)
+from .._engine import Finding, Project, register_rule
+
+_SEEDED_RANDOM_ATTRS = {"Random", "SystemRandom", "seed", "getstate",
+                        "setstate"}
+_SEEDED_NP_ATTRS = {"default_rng", "RandomState", "seed", "Generator",
+                    "PRNGKey"}
+_QUANTILE_MARKERS = {"quantile"}
+
+
+def _slow_marked(fn: ast.AST) -> bool:
+    return any("slow" in d for d in decorator_names(fn))
+
+
+def _fn_markers(fn: ast.AST) -> Set[str]:
+    """Which hazard context the function body carries: streaming-quantile
+    queries / watchdog threshold configuration."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if node.func.attr in _QUANTILE_MARKERS:
+                out.add("quantile")
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and "capture_slower_than" in node.value:
+            out.add("watchdog")
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = node.attr if isinstance(node, ast.Attribute) else node.id
+            if name == "capture_slower_than":
+                out.add("watchdog")
+    return out
+
+
+def _rng_findings(f, node: ast.Call, qual: str):
+    """Unseeded *global* RNG: the receiver must be the bare module path —
+    a call chain through default_rng(0)/Random(seed)/PRNGKey(...) has no
+    static dotted name, so seeded generators never fire."""
+    if qual.startswith("random.") and qual.count(".") == 1:
+        attr = qual.split(".", 1)[1]
+        if attr not in _SEEDED_RANDOM_ATTRS:
+            yield Finding(
+                "TEST-DETERMINISM", f.relpath, node.lineno,
+                f"unseeded global RNG {qual}(...) — use "
+                "random.Random(seed) / np.random.default_rng(seed) so "
+                "tests don't couple through shared RNG state",
+                symbol=f.symbol_at(node.lineno))
+    elif qual.startswith(("numpy.random.", "np.random.")):
+        attr = qual.rsplit(".", 1)[1]
+        if attr not in _SEEDED_NP_ATTRS:
+            yield Finding(
+                "TEST-DETERMINISM", f.relpath, node.lineno,
+                f"unseeded global RNG {qual}(...) — use "
+                "np.random.default_rng(seed)",
+                symbol=f.symbol_at(node.lineno))
+
+
+@register_rule(
+    "TEST-DETERMINISM",
+    "tests: no unseeded global RNG, no wall-clock time.time()/time.sleep "
+    "racing streaming quantiles outside slow-marked soaks")
+def check(project: Project):
+    for f in project.files:
+        if f.tree is None or not is_test_file(f.relpath):
+            continue
+        mods, names = module_aliases(f.tree)
+        # module/class-level RNG (shared fixture data baked at import
+        # time couples every test in the file to collection order)
+        in_function = set()
+        for _cls, fn in iter_functions(f.tree):
+            for node in ast.walk(fn):
+                in_function.add(id(node))
+        for node in ast.walk(f.tree):
+            if id(node) in in_function or not isinstance(node, ast.Call):
+                continue
+            qual = resolve_call_name(node, mods, names)
+            if qual is None:
+                continue
+            yield from _rng_findings(f, node, qual)
+        for _cls, fn in iter_functions(f.tree):
+            markers = None
+            slow = None
+            # own-body only: calls inside nested defs are attributed to
+            # the nested function (iter_functions visits it too)
+            for node in iter_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                qual = resolve_call_name(node, mods, names)
+                if qual is None:
+                    continue
+                # -- unseeded global RNG --------------------------------
+                if qual.startswith(("random.", "numpy.random.",
+                                    "np.random.")):
+                    yield from _rng_findings(f, node, qual)
+                    continue
+                # -- wall clock vs streaming quantiles ------------------
+                if qual in ("time.time", "time.sleep"):
+                    if markers is None:
+                        markers = _fn_markers(fn)
+                    if not markers:
+                        continue
+                    if slow is None:
+                        slow = _slow_marked(fn)
+                    if slow:
+                        continue
+                    if qual == "time.time" and not node.args \
+                            and "quantile" in markers:
+                        yield Finding(
+                            "TEST-DETERMINISM", f.relpath, node.lineno,
+                            "argless time.time() compared in a function "
+                            "that queries streaming quantiles — inject a "
+                            "synthetic clock (`now=`) instead",
+                            symbol=f.symbol_at(node.lineno))
+                    elif qual == "time.sleep":
+                        yield Finding(
+                            "TEST-DETERMINISM", f.relpath, node.lineno,
+                            "time.sleep racing a streaming-quantile "
+                            "threshold — drive the estimator with "
+                            "synthetic spans/time instead (PR 3 flake "
+                            "class), or mark the test slow",
+                            symbol=f.symbol_at(node.lineno))
