@@ -49,7 +49,7 @@ class TestEngine:
     def test_rules_registered(self):
         assert set(rule_names()) == {
             "ASYNC-BLOCK", "LOCK-ORDER", "EXC-CONTRACT", "SPAN-PAIR",
-            "METRICS-DECL", "TEST-DETERMINISM",
+            "METRICS-DECL", "TEST-DETERMINISM", "WIRE-COPY",
             # engine pseudo-rules, selectable like any other
             "PARSE", "PRAGMA"}
 
@@ -1066,6 +1066,66 @@ class TestTestDeterminism:
 
 # -- the tier-1 gate ---------------------------------------------------------
 
+class TestWireCopy:
+    """WIRE-COPY: payload copies on the client cores' serialize paths."""
+
+    def test_tobytes_in_core_serialize_path_fires(self, tmp_path):
+        write(tmp_path, "http/_infer_input.py", """
+            class InferInput:
+                def set_data_from_numpy(self, t):
+                    self._raw = t.tobytes()
+            """)
+        found = lint_dir(tmp_path, "WIRE-COPY")
+        assert len(found) == 1 and found[0].rule == "WIRE-COPY"
+        assert ".tobytes()" in found[0].message
+
+    def test_bytes_call_and_chunk_join_fire(self, tmp_path):
+        write(tmp_path, "grpc/_utils.py", """
+            def get_inference_request(raws):
+                a = bytes(raws[0])
+                return b"".join(raws)
+            """)
+        found = lint_dir(tmp_path, "WIRE-COPY")
+        assert sorted(fd.line for fd in found) == [3, 4]
+
+    def test_outside_core_or_serialize_path_passes(self, tmp_path):
+        # same calls, but in a server file and in a non-serialize fn
+        write(tmp_path, "server/grpc_server.py", """
+            def get_inference_request(t):
+                return t.tobytes()
+            """)
+        write(tmp_path, "http/_client.py", """
+            def close(self, t):
+                return t.tobytes()
+            """)
+        assert lint_dir(tmp_path, "WIRE-COPY") == []
+
+    def test_constant_bytes_arg_passes(self, tmp_path):
+        # bytes(0) / bytes(b"x") are allocation idioms, not payload copies
+        write(tmp_path, "http/_template.py", """
+            def stamp(n):
+                return bytes(16)
+            """)
+        assert lint_dir(tmp_path, "WIRE-COPY") == []
+
+    def test_pragma_with_reason_suppresses(self, tmp_path):
+        write(tmp_path, "grpc/_infer_input.py", """
+            class InferInput:
+                def set_data_from_numpy(self, t):
+                    # tpu-lint: disable=WIRE-COPY protobuf requires bytes
+                    self._raw = t.tobytes()
+            """)
+        assert lint_dir(tmp_path, "WIRE-COPY") == []
+
+    def test_stamp_functions_are_serialize_path(self, tmp_path):
+        write(tmp_path, "http/aio/__init__.py", """
+            def stamp(parts):
+                return b"".join(parts)
+            """)
+        found = lint_dir(tmp_path, "WIRE-COPY")
+        assert len(found) == 1
+
+
 class TestRepoGate:
     def test_repo_is_clean_under_the_full_suite(self, capsys):
         """The zero-finding gate: every rule over the whole repo, against
@@ -1089,6 +1149,9 @@ class TestRepoGate:
         rules = {e["rule"] for e in load_baseline(path)}
         assert "ASYNC-BLOCK" not in rules
         assert "TEST-DETERMINISM" not in rules
+        # ISSUE 10 acceptance: WIRE-COPY ships with an empty baseline —
+        # the wire-path copies were fixed or pragma'd, never grandfathered
+        assert "WIRE-COPY" not in rules
 
     def test_console_script_registered(self):
         import re

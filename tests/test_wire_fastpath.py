@@ -1,0 +1,539 @@
+"""Client wire fast path: templates, batch submit, and zero-copy codecs.
+
+The acceptance contract (ISSUE 10): a template-stamped request must be
+BYTE-IDENTICAL to the slow-path request for every dtype (incl. BYTES/BF16)
+on both protocols; ``infer_many`` results must equal N sequential ``infer``
+results with telemetry still counting per request; and a template re-stamp
+must never leak a prior call's tensor data or request id.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import triton_client_tpu.grpc as grpcclient
+import triton_client_tpu.http as httpclient
+from triton_client_tpu._telemetry import telemetry
+from triton_client_tpu.grpc._template import RequestTemplate as GrpcTemplate
+from triton_client_tpu.grpc._utils import get_inference_request
+from triton_client_tpu.http._template import RequestTemplate as HttpTemplate
+from triton_client_tpu.http._utils import get_inference_request_body
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server.registry import ModelRegistry
+from triton_client_tpu.server.testing import ServerHarness
+from triton_client_tpu.utils import InferenceServerException
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+#: (triton dtype, sample array factory).  ``seed`` varies the payload so
+#: re-stamp tests can tell call A's bytes from call B's.
+_DTYPES = [
+    ("BOOL", lambda seed: (np.arange(8) % 2 == seed % 2).reshape(2, 4)),
+    ("INT8", lambda seed: (np.arange(8, dtype=np.int8) + seed).reshape(2, 4)),
+    ("INT16", lambda seed: (np.arange(8, dtype=np.int16) + seed).reshape(2, 4)),
+    ("INT32", lambda seed: (np.arange(8, dtype=np.int32) + seed).reshape(2, 4)),
+    ("INT64", lambda seed: (np.arange(8, dtype=np.int64) + seed).reshape(2, 4)),
+    ("UINT8", lambda seed: (np.arange(8, dtype=np.uint8) + seed).reshape(2, 4)),
+    ("UINT16", lambda seed: (np.arange(8, dtype=np.uint16) + seed).reshape(2, 4)),
+    ("UINT32", lambda seed: (np.arange(8, dtype=np.uint32) + seed).reshape(2, 4)),
+    ("UINT64", lambda seed: (np.arange(8, dtype=np.uint64) + seed).reshape(2, 4)),
+    ("FP16", lambda seed: (np.arange(8, dtype=np.float16) + seed).reshape(2, 4)),
+    ("FP32", lambda seed: (np.arange(8, dtype=np.float32) + seed).reshape(2, 4)),
+    ("FP64", lambda seed: (np.arange(8, dtype=np.float64) + seed).reshape(2, 4)),
+    ("BYTES", lambda seed: np.array(
+        [b"alpha" + bytes([65 + seed]), "unié".encode() * (1 + seed),
+         b"", b"x" * (3 + seed)], dtype=object).reshape(2, 2)),
+]
+if _BF16 is not None:
+    _DTYPES.append(
+        ("BF16", lambda seed:
+         (np.arange(8, dtype=np.float32) + seed).astype(_BF16).reshape(2, 4)))
+
+
+def _http_input(dtype, arr):
+    inp = httpclient.InferInput("IN0", list(arr.shape), dtype)
+    inp.set_data_from_numpy(arr)
+    return inp
+
+
+def _grpc_input(dtype, arr):
+    inp = grpcclient.InferInput("IN0", list(arr.shape), dtype)
+    inp.set_data_from_numpy(arr)
+    return inp
+
+
+class TestByteEquality:
+    """Template-stamped == slow-path, for every dtype x both protocols."""
+
+    @pytest.mark.parametrize("dtype,factory", _DTYPES,
+                             ids=[d for d, _f in _DTYPES])
+    def test_http_template_matches_slow_path(self, dtype, factory):
+        inputs = [_http_input(dtype, factory(0))]
+        outputs = [httpclient.InferRequestedOutput("OUT0")]
+        tpl = HttpTemplate("m", inputs, outputs)
+        for rid in ("", "rid-1", 'esc"ape\\id'):
+            fast = tpl.stamp(rid)
+            slow = get_inference_request_body(
+                inputs, rid, outputs, 0, False, False, 0, None, None)
+            assert fast == slow
+
+    @pytest.mark.parametrize("dtype,factory", _DTYPES,
+                             ids=[d for d, _f in _DTYPES])
+    def test_grpc_template_matches_slow_path(self, dtype, factory):
+        inputs = [_grpc_input(dtype, factory(0))]
+        outputs = [grpcclient.InferRequestedOutput("OUT0")]
+        tpl = GrpcTemplate("m", inputs, outputs)
+        for rid in ("", "rid-1"):
+            fast = tpl.stamp(rid).SerializeToString(deterministic=True)
+            slow = get_inference_request(
+                "m", inputs, "", rid, outputs, 0, False, False, 0, None,
+                None).SerializeToString(deterministic=True)
+            assert fast == slow
+
+    def test_http_priority_timeout_params_match(self):
+        inputs = [_http_input("INT32", _DTYPES[3][1](0))]
+        tpl = HttpTemplate("m", inputs, None, "v7", priority=2,
+                           timeout=5000, parameters={"k": "v"})
+        fast = tpl.stamp("r")
+        slow = get_inference_request_body(
+            inputs, "r", None, 0, False, False, 2, 5000, {"k": "v"})
+        assert fast == slow
+
+    def test_grpc_priority_timeout_params_match(self):
+        inputs = [_grpc_input("INT32", _DTYPES[3][1](0))]
+        tpl = GrpcTemplate("m", inputs, None, "v7", priority=2,
+                           timeout=5000, parameters={"k": "v"})
+        fast = tpl.stamp("r").SerializeToString(deterministic=True)
+        slow = get_inference_request(
+            "m", inputs, "v7", "r", None, 0, False, False, 2, 5000,
+            {"k": "v"}).SerializeToString(deterministic=True)
+        assert fast == slow
+
+    def test_grpc_deadline_restamp_matches_explicit_timeout(self):
+        inputs = [_grpc_input("INT32", _DTYPES[3][1](0))]
+        tpl = GrpcTemplate("m", inputs)
+        fast = tpl.stamp("r", timeout_us=777).SerializeToString(
+            deterministic=True)
+        slow = get_inference_request(
+            "m", inputs, "", "r", None, 0, False, False, 0, 777,
+            None).SerializeToString(deterministic=True)
+        assert fast == slow
+        # and a later plain stamp must NOT inherit the deadline
+        plain = tpl.stamp("r").SerializeToString(deterministic=True)
+        slow_plain = get_inference_request(
+            "m", inputs, "", "r", None, 0, False, False, 0, None,
+            None).SerializeToString(deterministic=True)
+        assert plain == slow_plain
+
+
+class TestRestampLeaks:
+    """A re-stamp must carry NOTHING of the prior call."""
+
+    def test_http_restamp_never_leaks_prior_data_or_id(self):
+        dtype, factory = next((d, f) for d, f in _DTYPES if d == "BYTES")
+        inputs_a = [_http_input(dtype, factory(0))]
+        tpl = HttpTemplate("m", inputs_a)
+        body_a, _ = tpl.stamp("leak-me-id-A")
+        assert b"leak-me-id-A" in body_a and b"alphaA" in body_a
+        inputs_b = [_http_input(dtype, factory(3))]
+        body_b, size_b = tpl.stamp("fresh-id-B", tpl.raws_for(inputs_b))
+        slow_b = get_inference_request_body(
+            inputs_b, "fresh-id-B", None, 0, False, False, 0, None, None)
+        assert (body_b, size_b) == slow_b
+        assert b"leak-me-id-A" not in body_b
+        assert b"alphaA" not in body_b  # call A's payload
+
+    def test_grpc_restamp_never_leaks_prior_data_or_id(self):
+        dtype, factory = next((d, f) for d, f in _DTYPES if d == "BYTES")
+        inputs_a = [_grpc_input(dtype, factory(0))]
+        tpl = GrpcTemplate("m", inputs_a)
+        wire_a = tpl.stamp("leak-me-id-A").SerializeToString(
+            deterministic=True)
+        assert b"leak-me-id-A" in wire_a and b"alphaA" in wire_a
+        inputs_b = [_grpc_input(dtype, factory(3))]
+        wire_b = tpl.stamp(
+            "fresh-id-B", tpl.raws_for(inputs_b)).SerializeToString(
+            deterministic=True)
+        slow_b = get_inference_request(
+            "m", inputs_b, "", "fresh-id-B", None, 0, False, False, 0,
+            None, None).SerializeToString(deterministic=True)
+        assert wire_b == slow_b
+        assert b"leak-me-id-A" not in wire_b
+        assert b"alphaA" not in wire_b
+
+    def test_fixed_dtype_shape_change_invalidates_template(self):
+        arr = np.arange(8, dtype=np.int32).reshape(2, 4)
+        inp = httpclient.InferInput("IN0", [2, 4], "INT32")
+        inp.set_data_from_numpy(arr)
+        tpl = HttpTemplate("m", [inp])
+        inp.set_shape([2, 8])
+        inp.set_data_from_numpy(np.arange(16, dtype=np.int32).reshape(2, 8))
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            tpl.stamp("r")
+
+    def test_grpc_shape_change_invalidates_template(self):
+        arr = np.arange(8, dtype=np.int32).reshape(2, 4)
+        inp = grpcclient.InferInput("IN0", [2, 4], "INT32")
+        inp.set_data_from_numpy(arr)
+        tpl = GrpcTemplate("m", [inp])
+        inp.set_shape([2, 8])
+        inp.set_data_from_numpy(np.arange(16, dtype=np.int32).reshape(2, 8))
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            tpl.stamp("r")
+
+    def test_same_size_reshape_invalidates_template(self):
+        """A byte-size-preserving reshape (and any BYTES reshape) must
+        raise on the default stamp path — size checks alone would send
+        the stale compiled shape."""
+        arr = np.arange(8, dtype=np.int32).reshape(2, 4)
+        hin = httpclient.InferInput("IN0", [2, 4], "INT32")
+        hin.set_data_from_numpy(arr)
+        htpl = HttpTemplate("m", [hin])
+        hin.set_shape([4, 2])
+        hin.set_data_from_numpy(arr.reshape(4, 2))  # same 32 bytes
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            htpl.stamp("r")
+        gin = grpcclient.InferInput("IN0", [2, 4], "INT32")
+        gin.set_data_from_numpy(arr)
+        gtpl = GrpcTemplate("m", [gin])
+        gin.set_shape([4, 2])
+        gin.set_data_from_numpy(arr.reshape(4, 2))
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            gtpl.stamp("r")
+        # BYTES: element-count change (sizes are per-call, shape is not)
+        sarr = np.array([b"a", b"b"], dtype=object)
+        bin_ = httpclient.InferInput("IN0", [2], "BYTES")
+        bin_.set_data_from_numpy(sarr)
+        btpl = HttpTemplate("m", [bin_])
+        bin_.set_shape([3])
+        bin_.set_data_from_numpy(np.array([b"a", b"b", b"c"], dtype=object))
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            btpl.stamp("r")
+
+    def test_shm_to_binary_switch_raises_typed_error(self):
+        """The reverse direction: a template compiled over an shm input
+        freezes its region into the header — attaching inline data (or
+        re-pointing the region) afterwards must raise, never silently
+        send the stale shm routing."""
+        arr = np.arange(8, dtype=np.int32).reshape(2, 4)
+        hin = httpclient.InferInput("IN0", [2, 4], "INT32")
+        hin.set_shared_memory("region-a", 32)
+        htpl = HttpTemplate("m", [hin])
+        assert htpl.stamp("ok")[0]  # unchanged: stamps fine
+        hin.set_data_from_numpy(arr)
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            htpl.stamp("r")
+        hin2 = httpclient.InferInput("IN0", [2, 4], "INT32")
+        hin2.set_shared_memory("region-b", 32)
+        htpl2 = HttpTemplate("m", [hin2])
+        hin2.set_shared_memory("region-c", 32)  # re-pointed region
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            htpl2.stamp("r")
+        gin = grpcclient.InferInput("IN0", [2, 4], "INT32")
+        gin.set_shared_memory("region-a", 32)
+        gtpl = GrpcTemplate("m", [gin])
+        gtpl.stamp("ok")
+        gin.set_data_from_numpy(arr)
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            gtpl.stamp("r")
+
+    def test_infer_many_item_with_divergent_shm_region_rejected(self):
+        """raws_for must reject an item whose shm input references a
+        different region than the compiled header (it would otherwise
+        silently ride item[0]'s region)."""
+        tin = httpclient.InferInput("IN0", [2, 4], "INT32")
+        tin.set_shared_memory("region-a", 32)
+        tpl = HttpTemplate("m", [tin])
+        other = httpclient.InferInput("IN0", [2, 4], "INT32")
+        other.set_shared_memory("region-b", 32)
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            tpl.raws_for([other])
+        gtin = grpcclient.InferInput("IN0", [2, 4], "INT32")
+        gtin.set_shared_memory("region-a", 32)
+        gtpl = GrpcTemplate("m", [gtin])
+        gother = grpcclient.InferInput("IN0", [2, 4], "INT32")
+        gother.set_shared_memory("region-b", 32)
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            gtpl.raws_for([gother])
+
+    def test_output_mutation_after_prepare_raises(self):
+        """Requested outputs' shm routing is compiled into the header —
+        rebinding a region after prepare() must raise, never silently
+        route results to the stale region."""
+        arr = np.arange(8, dtype=np.int32).reshape(2, 4)
+        hin = httpclient.InferInput("IN0", [2, 4], "INT32")
+        hin.set_data_from_numpy(arr)
+        hout = httpclient.InferRequestedOutput("OUT0")
+        hout.set_shared_memory("region-a", 64)
+        htpl = HttpTemplate("m", [hin], [hout])
+        assert htpl.stamp("ok")[0]
+        hout.set_shared_memory("region-b", 64)
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            htpl.stamp("r")
+        gin = grpcclient.InferInput("IN0", [2, 4], "INT32")
+        gin.set_data_from_numpy(arr)
+        gout = grpcclient.InferRequestedOutput("OUT0")
+        gout.set_shared_memory("region-a", 64)
+        gtpl = GrpcTemplate("m", [gin], [gout])
+        gtpl.stamp("ok")
+        gout.set_shared_memory("region-b", 64)
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            gtpl.stamp("r")
+        # round-trip back to the frozen routing re-syncs and stamps again
+        gout.set_shared_memory("region-a", 64)
+        gtpl.stamp("ok2")
+
+    def test_representation_switch_raises_typed_error(self):
+        """Switching a bound input to shm after prepare() must raise the
+        typed invalidation error, not a raw TypeError (EXC-CONTRACT)."""
+        arr = np.arange(8, dtype=np.int32).reshape(2, 4)
+        hin = httpclient.InferInput("IN0", [2, 4], "INT32")
+        hin.set_data_from_numpy(arr)
+        htpl = HttpTemplate("m", [hin])
+        hin.set_shared_memory("region", 32)
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            htpl.stamp("r")
+        gin = grpcclient.InferInput("IN0", [2, 4], "INT32")
+        gin.set_data_from_numpy(arr)
+        gtpl = GrpcTemplate("m", [gin])
+        gin.set_shared_memory("region", 32)
+        with pytest.raises(InferenceServerException, match="re-prepare"):
+            gtpl.stamp("r")
+
+
+# -- end to end --------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+def _simple_item(mod, k):
+    a = (np.arange(16, dtype=np.int32) + k).reshape(1, 16)
+    b = np.full((1, 16), 2 + k, dtype=np.int32)
+    i0 = mod.InferInput("INPUT0", [1, 16], "INT32")
+    i0.set_data_from_numpy(a)
+    i1 = mod.InferInput("INPUT1", [1, 16], "INT32")
+    i1.set_data_from_numpy(b)
+    return (a, b), [i0, i1]
+
+
+def _string_item(mod, k):
+    a = np.array([str(10 + i + k).encode() for i in range(16)],
+                 dtype=object).reshape(1, 16)
+    b = np.array([str(2 + k).encode()] * 16, dtype=object).reshape(1, 16)
+    i0 = mod.InferInput("INPUT0", [1, 16], "BYTES")
+    i0.set_data_from_numpy(a)
+    i1 = mod.InferInput("INPUT1", [1, 16], "BYTES")
+    i1.set_data_from_numpy(b)
+    return (a, b), [i0, i1]
+
+
+class TestPreparedE2E:
+    def test_http_prepared_equals_slow_path_result(self, server):
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            (a, b), inputs = _simple_item(httpclient, 0)
+            prep = c.prepare("simple", inputs)
+            fast = prep.infer(request_id="fast-1")
+            slow = c.infer("simple", inputs, request_id="slow-1")
+            np.testing.assert_array_equal(
+                fast.as_numpy("OUTPUT0"), slow.as_numpy("OUTPUT0"))
+            np.testing.assert_array_equal(fast.as_numpy("OUTPUT0"), a + b)
+            # reuse-infer-objects: restamp new data through the same prep
+            (a2, b2), _ = _simple_item(httpclient, 5)
+            inputs[0].set_data_from_numpy(a2)
+            inputs[1].set_data_from_numpy(b2)
+            np.testing.assert_array_equal(
+                prep.infer().as_numpy("OUTPUT0"), a2 + b2)
+
+    def test_grpc_prepared_equals_slow_path_result(self, server):
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            (a, b), inputs = _simple_item(grpcclient, 0)
+            prep = c.prepare("simple", inputs)
+            fast = prep.infer(request_id="fast-2")
+            np.testing.assert_array_equal(fast.as_numpy("OUTPUT0"), a + b)
+            np.testing.assert_array_equal(fast.as_numpy("OUTPUT1"), a - b)
+
+    def test_grpc_prepared_deadline_and_retry_contract(self, server):
+        from triton_client_tpu._resilience import RetryPolicy
+
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            _ab, inputs = _simple_item(grpcclient, 1)
+            prep = c.prepare("simple", inputs)
+            policy = RetryPolicy(max_attempts=2, retry_infer=True)
+            res = prep.infer(retry_policy=policy, deadline_s=30.0)
+            assert res.as_numpy("OUTPUT0") is not None
+
+
+class TestInferMany:
+    N = 4
+
+    def _assert_matches_sequential(self, many, seq, out="OUTPUT0"):
+        assert len(many) == len(seq)
+        for m, s in zip(many, seq):
+            np.testing.assert_array_equal(m.as_numpy(out), s.as_numpy(out))
+
+    def test_http_infer_many_equals_sequential(self, server):
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            items = [_simple_item(httpclient, k)[1] for k in range(self.N)]
+            many = c.infer_many("simple", items)
+            seq = [c.infer("simple", item) for item in items]
+            self._assert_matches_sequential(many, seq)
+            for k, res in enumerate(many):
+                (a, b), _ = _simple_item(httpclient, k)
+                np.testing.assert_array_equal(res.as_numpy("OUTPUT0"), a + b)
+
+    def test_grpc_infer_many_equals_sequential(self, server):
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            items = [_simple_item(grpcclient, k)[1] for k in range(self.N)]
+            many = c.infer_many("simple", items,
+                                request_ids=[f"bm-{k}"
+                                             for k in range(self.N)])
+            seq = [c.infer("simple", item) for item in items]
+            self._assert_matches_sequential(many, seq)
+
+    def test_http_infer_many_bytes_model(self, server):
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            items = [_string_item(httpclient, k)[1] for k in range(self.N)]
+            many = c.infer_many("simple_string", items)
+            for k, res in enumerate(many):
+                got = res.as_numpy("OUTPUT0").reshape(-1)
+                expect = [str(10 + i + k + 2 + k).encode()
+                          for i in range(16)]
+                assert list(got) == expect
+
+    def test_http_aio_infer_many_equals_sequential(self, server):
+        from triton_client_tpu.http.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(server.http_url) as c:
+                items = [_simple_item(httpclient, k)[1]
+                         for k in range(self.N)]
+                many = await c.infer_many("simple", items, window=2)
+                seq = [await c.infer("simple", item) for item in items]
+                return many, seq
+
+        many, seq = asyncio.run(main())
+        self._assert_matches_sequential(many, seq)
+
+    def test_grpc_aio_infer_many_equals_sequential(self, server):
+        from triton_client_tpu.grpc.aio import InferenceServerClient
+
+        async def main():
+            async with InferenceServerClient(server.grpc_url) as c:
+                items = [_simple_item(grpcclient, k)[1]
+                         for k in range(self.N)]
+                many = await c.infer_many("simple", items, window=3)
+                seq = [await c.infer("simple", item) for item in items]
+                return many, seq
+
+        many, seq = asyncio.run(main())
+        self._assert_matches_sequential(many, seq)
+
+    def test_infer_many_counts_per_request(self, server):
+        """Batch submit amortizes the wrapping, NOT the accounting: the
+        telemetry registry must move success counters once per request."""
+        def successes():
+            return sum(r["success"]
+                       for r in telemetry().snapshot()["requests"]
+                       if r["model"] == "simple"
+                       and r["protocol"] == "grpc"
+                       and r["method"] == "infer")
+
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            items = [_simple_item(grpcclient, k)[1] for k in range(self.N)]
+            before = successes()
+            c.infer_many("simple", items)
+            assert successes() - before == self.N
+
+    def test_cluster_infer_many_routes_whole_flight(self, server):
+        from triton_client_tpu.cluster import ClusterClient
+
+        routed = []
+        with ClusterClient([server.grpc_url], protocol="grpc",
+                           on_route=lambda url, model, seq:
+                           routed.append(url)) as cc:
+            items = [_simple_item(grpcclient, k)[1] for k in range(self.N)]
+            many = cc.infer_many("simple", items)
+            assert len(many) == self.N
+            for k, res in enumerate(many):
+                (a, b), _ = _simple_item(grpcclient, k)
+                np.testing.assert_array_equal(res.as_numpy("OUTPUT0"), a + b)
+        assert routed == [server.grpc_url]  # one route per flight
+
+    def test_infer_many_empty_is_noop(self, server):
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            assert c.infer_many("simple", []) == []
+
+    def test_infer_many_deadline_bounds_the_whole_flight(self, server):
+        """deadline_s is ONE budget for the flight, re-derived per item —
+        a slow batch must raise deadline-exceeded promptly, not grant
+        every item the full budget (N-fold overrun regression)."""
+        import time as _time
+
+        delay = {"execute_delay_ms": 60}
+
+        def item():
+            x = np.arange(4, dtype=np.int32).reshape(1, 4)
+            i = httpclient.InferInput("INPUT0", [1, 4], "INT32")
+            i.set_data_from_numpy(x)
+            return [i]
+
+        with httpclient.InferenceServerClient(server.http_url) as c:
+            t0 = _time.perf_counter()
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer_many("custom_identity_int32", [item() for _ in
+                                                      range(20)],
+                             parameters=delay, deadline_s=0.15)
+            elapsed = _time.perf_counter() - t0
+            assert "DEADLINE_EXCEEDED" in str(ei.value)
+            # 20 items x 60ms would be ~1.2s if each got the full budget
+            assert elapsed < 0.8
+
+
+class TestAsyncInferSnapshot:
+    def test_async_infer_snapshots_views_before_submit(self, server):
+        """http async_infer gathers the body on a worker thread after
+        control returns — zero-copy views must be frozen at submit so a
+        caller mutating its array post-submit cannot tear the payload."""
+        with httpclient.InferenceServerClient(server.http_url,
+                                              concurrency=2) as c:
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            b = np.full((1, 16), 2, dtype=np.int32)
+            i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+            i0.set_data_from_numpy(a)
+            i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+            i1.set_data_from_numpy(b)
+            handle = c.async_infer("simple", [i0, i1])
+            snapshot = a.copy()
+            a[:] = -999  # post-submit mutation must NOT reach the wire
+            res = handle.get_result(timeout=30)
+            np.testing.assert_array_equal(
+                res.as_numpy("OUTPUT0"), snapshot + b)
+
+
+class TestUvloopOptional:
+    def test_graceful_fallback_without_uvloop(self, monkeypatch):
+        """The optional extra must degrade to the stdlib loop: no env
+        opt-in = no-op; with uvloop absent, install returns False instead
+        of raising."""
+        import importlib.util
+
+        from triton_client_tpu import _uvloop
+
+        monkeypatch.delenv("TRITON_TPU_UVLOOP", raising=False)
+        assert _uvloop.maybe_install_uvloop() is False
+        if importlib.util.find_spec("uvloop") is None:
+            monkeypatch.setenv("TRITON_TPU_UVLOOP", "1")
+            assert _uvloop.maybe_install_uvloop() is False
+            assert _uvloop.install_uvloop() is False
+            assert _uvloop.uvloop_active() is False

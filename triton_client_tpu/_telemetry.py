@@ -343,33 +343,71 @@ class ClientTelemetry:
         """Record one completed (or failed) request.  ``latency_s=None``
         counts without a histogram observation (streaming submits)."""
         s = self._series((model, protocol, method))
-        h = s.latency
-        bucket = None if latency_s is None else h._index(latency_s)
         # counters + histogram under ONE lock round-trip per request
-        with h._lock:
-            if ok:
-                s.success += 1
-            else:
-                s.failure += 1
-            s.request_bytes += request_bytes
-            s.response_bytes += response_bytes
-            if bucket is not None:
-                h._counts[bucket] += 1
-                h._count += 1
-                h._sum_s += latency_s
+        with s.latency._lock:
+            self._apply_outcome_locked(s, ok, latency_s, request_bytes,
+                                       response_bytes)
+        self._fire_hook(model, protocol, method, ok, latency_s,
+                        request_bytes, response_bytes, request_id,
+                        time.time())
+
+    @staticmethod
+    def _apply_outcome_locked(s, ok: bool, latency_s: Optional[float],
+                              request_bytes: int,
+                              response_bytes: int) -> None:
+        """Move one request's counters + histogram observation.  Caller
+        holds ``s.latency._lock`` — the ONE recording contract shared by
+        the per-call and batch paths so they cannot drift."""
+        h = s.latency
+        if ok:
+            s.success += 1
+        else:
+            s.failure += 1
+        s.request_bytes += request_bytes
+        s.response_bytes += response_bytes
+        if latency_s is not None:
+            h._counts[h._index(latency_s)] += 1
+            h._count += 1
+            h._sum_s += latency_s
+
+    def _fire_hook(self, model, protocol, method, ok, latency_s,
+                   request_bytes, response_bytes, request_id, ts) -> None:
         hook = self._hook
-        if hook is not None:
-            try:
-                hook({
-                    "model": model, "protocol": protocol, "method": method,
-                    "ok": ok, "latency_s": latency_s,
-                    "request_bytes": request_bytes,
-                    "response_bytes": response_bytes,
-                    "request_id": request_id,
-                    "ts": time.time(),
-                })
-            except Exception:
-                pass  # a broken hook must never fail the request path
+        if hook is None:
+            return
+        try:
+            hook({
+                "model": model, "protocol": protocol, "method": method,
+                "ok": ok, "latency_s": latency_s,
+                "request_bytes": request_bytes,
+                "response_bytes": response_bytes,
+                "request_id": request_id,
+                "ts": ts,
+            })
+        except Exception:
+            pass  # a broken hook must never fail the request path
+
+    def record_request_batch(self, model: str, protocol: str, method: str,
+                             outcomes) -> None:
+        """Record one batch-submit flight's outcomes under ONE lock
+        round-trip — the ``infer_many`` amortization.  ``outcomes`` is an
+        iterable of ``(ok, latency_s or None, request_bytes,
+        response_bytes, request_id)``; every counter still moves once per
+        request (via the same locked update as ``record_request``), so
+        the per-request metrics contract is unchanged."""
+        outcomes = list(outcomes)
+        if not outcomes:
+            return
+        s = self._series((model, protocol, method))
+        with s.latency._lock:
+            for ok, latency_s, request_bytes, response_bytes, _rid \
+                    in outcomes:
+                self._apply_outcome_locked(s, ok, latency_s,
+                                           request_bytes, response_bytes)
+        now = time.time()
+        for ok, latency_s, request_bytes, response_bytes, rid in outcomes:
+            self._fire_hook(model, protocol, method, ok, latency_s,
+                            request_bytes, response_bytes, rid, now)
 
     def record_retry(self, model: str, protocol: str, method: str) -> None:
         """Count one retried attempt (the resilience layer calls this per

@@ -461,6 +461,52 @@ class ClusterClient(InferenceServerClientBase):
             retry_meta=(model_name, self._protocol, "infer", request_id),
             on_failure=on_failure)
 
+    def infer_many(
+        self,
+        model_name: str,
+        requests,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
+        **kwargs,
+    ):
+        """Routed batch submit (the wire fast path's ``infer_many``).  The
+        WHOLE flight is routed to one endpoint — batch amortization needs
+        one template and one connection, and split routing would reorder
+        results.  A retry replays the whole flight on a different replica
+        (gated on ``retry_infer`` like any inference retry — partial
+        results from the failed attempt are discarded, so the model must
+        tolerate re-execution).  Hedging does not apply; QoS/header kwargs
+        pass through to the endpoint client."""
+        items = list(requests)
+        if not items:
+            return []
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        excluded: List[str] = []
+        last: List[Optional[Endpoint]] = [None]
+
+        call = dict(requests=items, **kwargs)
+
+        def attempt(remaining, _n):
+            ep = self._pool.pick(exclude=excluded)
+            last[0] = ep
+            if self._on_route is not None:
+                self._on_route(ep.url, model_name, 0)
+            return self._infer_on(ep, remaining, model_name, call,
+                                  method="infer_many")
+
+        if policy is None and deadline_s is None:
+            return attempt(None, 1)
+
+        def on_failure(_exc, _n):
+            if last[0] is not None:
+                excluded.append(last[0].url)
+
+        return call_with_retry(
+            policy, attempt, method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, self._protocol, "infer", ""),
+            on_failure=on_failure)
+
     def _hedge_armed(self, policy: Optional[RetryPolicy],
                      hedge_override: Optional[bool],
                      sequence_id: int) -> bool:
@@ -475,16 +521,20 @@ class ClusterClient(InferenceServerClientBase):
         return policy is not None and policy.retry_infer
 
     def _infer_on(self, ep: Endpoint, remaining_s: Optional[float],
-                  model_name: str, call: Dict[str, Any]):
+                  model_name: str, call: Dict[str, Any],
+                  method: str = "infer"):
         """One attempt on one endpoint: deadline propagation via the
         underlying client (single attempt — the cluster owns retries),
-        outcome into the breaker + per-endpoint counters + latency."""
+        outcome into the breaker + per-endpoint counters + latency.
+        ``method`` selects the endpoint-client entry point (``infer`` /
+        ``infer_many``) so batch flights share this bookkeeping."""
         client = self._client_for(ep)
         ep.acquire()
         t0 = time.perf_counter()
         try:
-            result = client.infer(model_name, retry_policy=None,
-                                  deadline_s=remaining_s, **call)
+            result = getattr(client, method)(
+                model_name, retry_policy=None, deadline_s=remaining_s,
+                **call)
         except Exception:
             self._pool.record(ep, ok=False)
             raise

@@ -307,6 +307,49 @@ class ClusterClient(InferenceServerClientBase):
                         request_id),
             on_failure=on_failure)
 
+    async def infer_many(
+        self,
+        model_name: str,
+        requests,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
+        **kwargs,
+    ):
+        """Routed batch submit — the sync cluster client's contract over
+        the aio endpoint clients (whole flight to one endpoint; a retry
+        replays the flight on another replica, gated on ``retry_infer``;
+        no hedging)."""
+        items = list(requests)
+        if not items:
+            return []
+        self._maybe_start_probing()
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        excluded: List[str] = []
+        last: List[Optional[Endpoint]] = [None]
+
+        call = dict(requests=items, **kwargs)
+
+        async def attempt(remaining, _n):
+            ep = self._pool.pick(exclude=excluded)
+            last[0] = ep
+            if self._on_route is not None:
+                self._on_route(ep.url, model_name, 0)
+            return await self._infer_on(ep, remaining, model_name, call,
+                                        method="infer_many")
+
+        if policy is None and deadline_s is None:
+            return await attempt(None, 1)
+
+        def on_failure(_exc, _n):
+            if last[0] is not None:
+                excluded.append(last[0].url)
+
+        return await call_with_retry_async(
+            policy, attempt, method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, self._protocol_label, "infer", ""),
+            on_failure=on_failure)
+
     def _hedge_armed(self, policy: Optional[RetryPolicy],
                      hedge_override: Optional[bool],
                      sequence_id: int) -> bool:
@@ -319,13 +362,18 @@ class ClusterClient(InferenceServerClientBase):
         return policy is not None and policy.retry_infer
 
     async def _infer_on(self, ep: Endpoint, remaining_s: Optional[float],
-                        model_name: str, call: Dict[str, Any]):
+                        model_name: str, call: Dict[str, Any],
+                        method: str = "infer"):
+        """``method`` selects the endpoint-client entry point (``infer`` /
+        ``infer_many``) so batch flights share this bookkeeping (see the
+        sync client)."""
         client = self._client_for(ep)
         ep.acquire()
         t0 = time.perf_counter()
         try:
-            result = await client.infer(model_name, retry_policy=None,
-                                        deadline_s=remaining_s, **call)
+            result = await getattr(client, method)(
+                model_name, retry_policy=None, deadline_s=remaining_s,
+                **call)
         except Exception:
             self._pool.record(ep, ok=False)
             raise

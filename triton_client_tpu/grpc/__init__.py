@@ -11,6 +11,7 @@ from ._client import (
     InferAsyncRequest,
     InferenceServerClient,
     KeepAliveOptions,
+    PreparedRequest,
 )
 from ._infer_input import InferInput
 from ._infer_result import InferResult
@@ -24,6 +25,7 @@ __all__ = [
     "InferInput",
     "InferRequestedOutput",
     "InferResult",
+    "PreparedRequest",
     "service_pb2",
     "model_config_pb2",
 ]

@@ -18,7 +18,8 @@ import grpc
 
 from .._client import InferenceServerClientBase
 from .._request import Request
-from .._resilience import (RetryPolicy, call_with_retry, min_timeout,
+from .._resilience import (RetryPolicy, call_with_retry,
+                           deadline_exceeded_error, min_timeout,
                            remaining_us)
 from .._telemetry import (new_trace_context, telemetry,
                           traceparent_from_metadata)
@@ -27,12 +28,47 @@ from ..protocol.service import GRPCInferenceServiceStub
 from ..utils import raise_error
 from ._infer_result import InferResult
 from ._infer_stream import _InferStream, _RequestIterator
+from ._template import RequestTemplate
 from ._utils import (
     get_error_grpc,
     get_grpc_compression,
     get_inference_request,
     raise_error_grpc,
 )
+
+
+class PreparedRequest:
+    """Handle for the gRPC wire fast path: a pre-built protobuf request
+    (see ``_template.py``) bound to a client.  ``infer()`` re-stamps only
+    id/deadline/payloads.  NOT thread-safe (the skeleton message is
+    mutated in place) — build one per worker thread; the aio client's
+    sibling stamps copies instead."""
+
+    def __init__(self, client, template: RequestTemplate):
+        self._client = client
+        self.template = template
+
+    def infer(self, request_id="", headers=None, tenant=None,
+              client_timeout=None,
+              retry_policy: Optional[RetryPolicy] = None,
+              deadline_s: Optional[float] = None) -> InferResult:
+        """Fast-path inference — same resilience/telemetry/trace contract
+        as ``client.infer`` (the v2 timeout parameter is restamped per
+        attempt under a deadline budget)."""
+        client = self._client
+        policy = retry_policy if retry_policy is not None \
+            else client._retry_policy
+        if policy is None and deadline_s is None:
+            return client._infer_prepared(
+                self, request_id, headers, tenant, client_timeout)
+        return call_with_retry(
+            policy,
+            lambda remaining, _attempt: client._infer_prepared(
+                self, request_id, headers, tenant, client_timeout,
+                _remaining_s=remaining),
+            method="infer", deadline_s=deadline_s,
+            retry_meta=(self.template.model_name, "grpc", "infer",
+                        request_id))
 
 INT32_MAX = 2**31 - 1
 MAX_GRPC_MESSAGE_SIZE = INT32_MAX
@@ -97,6 +133,14 @@ def _channel_options(keepalive_options, channel_args):
     options: List[tuple] = [
         ("grpc.max_send_message_length", MAX_GRPC_MESSAGE_SIZE),
         ("grpc.max_receive_message_length", MAX_GRPC_MESSAGE_SIZE),
+        # transport audit (wire fast path): bias the channel for the
+        # small-message high-rate infer pattern.  User channel_args
+        # override both (dedupe below).
+        ("grpc.optimization_target", "throughput"),
+        # unlimited metadata soft limit would reject trace+tenant+auth
+        # stacks on some proxies; 64KiB covers every header this
+        # framework stamps with margin
+        ("grpc.max_metadata_size", 1 << 16),
     ]
     if keepalive_options is None:
         keepalive_options = KeepAliveOptions()
@@ -575,6 +619,146 @@ class InferenceServerClient(InferenceServerClientBase):
                 tenant=tenant, _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(model_name, "grpc", "infer", request_id))
+
+    # -- wire fast path ----------------------------------------------------
+    def prepare(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ) -> PreparedRequest:
+        """Compile the invariant protobuf request once (see
+        ``_template.py``); the returned handle's ``infer()`` re-stamps
+        only id/deadline/tensor payloads.  ``inputs`` must already carry
+        data; NOT thread-safe — one per worker thread."""
+        return PreparedRequest(self, RequestTemplate(
+            model_name, inputs, outputs, model_version, priority, timeout,
+            parameters))
+
+    def _infer_prepared(self, prep: PreparedRequest, request_id, headers,
+                        tenant, client_timeout=None, _remaining_s=None,
+                        raws=None, _sink=None):
+        """One stamped-request RPC.  ``_sink`` defers the telemetry record
+        to the caller's per-flight batch (``infer_many``) — same contract
+        as the HTTP sibling."""
+        tel = telemetry()
+        t_ser0 = time.monotonic_ns()
+        timeout_us = None
+        if _remaining_s is not None and prep.template._timeout is None:
+            timeout_us = remaining_us(_remaining_s)
+        request = prep.template.stamp(request_id, raws, timeout_us)
+        metadata, rid = _with_trace_metadata(
+            self._get_metadata(headers), request_id)
+        if tenant:
+            metadata = metadata + (("triton-tenant", str(tenant)),)
+        t_ser1 = time.monotonic_ns()
+        req_bytes = request.ByteSize()
+        t0 = time.perf_counter()
+        try:
+            response = self._client_stub.ModelInfer(
+                request,
+                metadata=metadata,
+                timeout=min_timeout(client_timeout, _remaining_s),
+                compression=grpc.Compression.NoCompression,
+            )
+            t_net1 = time.monotonic_ns()
+            if _sink is not None:
+                _sink.append((True, time.perf_counter() - t0, req_bytes,
+                              response.ByteSize(), rid))
+            else:
+                tel.record_request(
+                    prep.template.model_name, "grpc", "infer",
+                    time.perf_counter() - t0, ok=True,
+                    request_bytes=req_bytes,
+                    response_bytes=response.ByteSize(), request_id=rid)
+            result = InferResult(response)
+            if tel.tracing_enabled:
+                tel.record_infer_spans(
+                    rid, prep.template.model_name, "grpc", "infer",
+                    t_ser0, t_ser1, t_net1,
+                    traceparent=traceparent_from_metadata(metadata))
+            return result
+        except grpc.RpcError as e:
+            if _sink is not None:
+                _sink.append((False, time.perf_counter() - t0, req_bytes,
+                              0, rid))
+            else:
+                tel.record_request(
+                    prep.template.model_name, "grpc", "infer",
+                    time.perf_counter() - t0, ok=False,
+                    request_bytes=req_bytes, request_id=rid)
+            raise_error_grpc(e)
+
+    def infer_many(
+        self,
+        model_name,
+        requests,
+        model_version="",
+        outputs=None,
+        priority=0,
+        timeout=None,
+        parameters=None,
+        request_ids=None,
+        headers=None,
+        tenant: Optional[str] = None,
+        client_timeout=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
+    ) -> List[InferResult]:
+        """Batch submit: every item (a list of data-carrying
+        ``InferInput`` matching the first item's specs) rides ONE pre-built
+        protobuf skeleton and ONE retry/deadline/telemetry envelope.
+        Results keep submission order and equal N sequential ``infer``
+        calls; a mid-batch retry resumes at the failed item."""
+        items = list(requests)
+        if not items:
+            return []
+        template = RequestTemplate(
+            model_name, items[0], outputs, model_version, priority, timeout,
+            parameters)
+        prep = PreparedRequest(self, template)
+        raws_list = [template.raws_for(item) for item in items]
+        ids = list(request_ids) if request_ids else [""] * len(items)
+        if len(ids) != len(items):
+            raise_error("request_ids length must match requests")
+        results: List[Optional[InferResult]] = [None] * len(items)
+        next_idx = [0]
+        tel = telemetry()
+
+        def flight(remaining, _attempt):
+            # ONE deadline for the whole flight, re-derived per item (a
+            # slow batch must raise, not grant each item the full budget)
+            deadline = (time.monotonic() + remaining
+                        if remaining is not None else None)
+            sink: list = []
+            try:
+                while next_idx[0] < len(items):
+                    i = next_idx[0]
+                    rem_i = None
+                    if deadline is not None:
+                        rem_i = deadline - time.monotonic()
+                        if rem_i <= 0:
+                            raise deadline_exceeded_error()
+                    results[i] = self._infer_prepared(
+                        prep, ids[i], headers, tenant, client_timeout,
+                        _remaining_s=rem_i, raws=raws_list[i],
+                        _sink=sink)
+                    next_idx[0] += 1
+            finally:
+                tel.record_request_batch(model_name, "grpc", "infer", sink)
+            return results
+
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        if policy is None and deadline_s is None:
+            return flight(None, 1)
+        return call_with_retry(
+            policy, flight, method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, "grpc", "infer", ""))
 
     def _infer_once(
         self,

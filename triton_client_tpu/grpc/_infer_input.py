@@ -15,7 +15,7 @@ from ..utils import (
     np_to_triton_dtype,
     raise_error,
     serialize_bf16_tensor,
-    serialize_byte_tensor,
+    serialize_byte_tensor_raw,
 )
 
 
@@ -24,6 +24,9 @@ class InferInput:
         self._input = pb.ModelInferRequest.InferInputTensor(name=name, datatype=datatype)
         self._input.shape.extend(int(s) for s in shape)
         self._raw_content: Optional[bytes] = None
+        # bumped by set_shape: lets a template detect a shape change
+        # with one int compare on the stamp hot path
+        self._shape_epoch = 0
 
     def name(self) -> str:
         return self._input.name
@@ -37,6 +40,7 @@ class InferInput:
     def set_shape(self, shape: List[int]) -> "InferInput":
         self._input.ClearField("shape")
         self._input.shape.extend(int(s) for s in shape)
+        self._shape_epoch += 1
         return self
 
     def set_data_from_numpy(self, input_tensor: np.ndarray) -> "InferInput":
@@ -58,12 +62,17 @@ class InferInput:
         self._input.parameters.pop("shared_memory_region", None)
         self._input.parameters.pop("shared_memory_byte_size", None)
         self._input.parameters.pop("shared_memory_offset", None)
+        # protobuf bytes fields only accept ``bytes`` (upb rejects
+        # memoryview/bytearray), so each branch is the ONE required
+        # materialization — no intermediate chunk objects or re-copies.
         if expected == "BYTES":
-            serialized = serialize_byte_tensor(input_tensor)
-            self._raw_content = serialized.tobytes() if serialized is not None else b""
+            # tpu-lint: disable=WIRE-COPY protobuf requires bytes; single materialization of the prealloc'd codec buffer
+            self._raw_content = bytes(serialize_byte_tensor_raw(input_tensor))
         elif expected == "BF16":
+            # tpu-lint: disable=WIRE-COPY protobuf requires bytes; the serializer returns a zero-copy view
             self._raw_content = serialize_bf16_tensor(input_tensor).tobytes()
         else:
+            # tpu-lint: disable=WIRE-COPY protobuf requires bytes; numpy -> wire in one copy
             self._raw_content = input_tensor.tobytes()
         return self
 

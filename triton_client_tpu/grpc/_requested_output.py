@@ -11,6 +11,9 @@ class InferRequestedOutput:
         self._output = pb.ModelInferRequest.InferRequestedOutputTensor(name=name)
         if class_count != 0:
             self._output.parameters["classification"].int64_param = class_count
+        # bumped on every mutation: lets a template detect post-prepare
+        # changes with one int compare on the stamp hot path
+        self._mut_epoch = 0
 
     def name(self) -> str:
         return self._output.name
@@ -20,12 +23,14 @@ class InferRequestedOutput:
         self._output.parameters["shared_memory_byte_size"].int64_param = byte_size
         if offset != 0:
             self._output.parameters["shared_memory_offset"].int64_param = offset
+        self._mut_epoch += 1
         return self
 
     def unset_shared_memory(self):
         self._output.parameters.pop("shared_memory_region", None)
         self._output.parameters.pop("shared_memory_byte_size", None)
         self._output.parameters.pop("shared_memory_offset", None)
+        self._mut_epoch += 1
         return self
 
     def _get_tensor_pb(self) -> pb.ModelInferRequest.InferRequestedOutputTensor:

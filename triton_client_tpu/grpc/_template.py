@@ -1,0 +1,211 @@
+"""Pre-built protobuf request templates — the gRPC wire fast path.
+
+The slow path rebuilds a ``ModelInferRequest`` per call: tensor submessages,
+parameter maps, shape lists — all python-level protobuf construction.  The
+reference C++ client earns much of its speed from keeping the request
+message alive across calls and pointer-swapping the tensor payloads
+(PAPER.md survey of ``src/c++/library``); this is the Python analog.
+
+:class:`RequestTemplate` builds the full request ONCE via the real
+slow-path builder (``get_inference_request`` — so the field population can
+never drift), clears the per-call payload list, and ``stamp()`` then only:
+
+* sets/clears the request ``id``,
+* restamps the v2 ``timeout`` parameter when a deadline budget is active,
+* swaps ``raw_input_contents`` wholesale (payload handoff, no submessage
+  rebuild).
+
+What invalidates a template: input name/shape/dtype or representation
+changes, different outputs/priority/frozen-timeout/parameters.  ``stamp``
+validates the frozen fixed-dtype payload sizes and raises rather than send
+a corrupt request.
+
+Thread-safety: ``stamp(copy=False)`` mutates the ONE shared message —
+single-thread use only (one PreparedRequest per worker, the perf_analyzer
+session model).  ``copy=True`` stamps into a fresh ``CopyFrom`` of the
+skeleton (C-speed in upb) for concurrent in-flight requests — the aio
+clients always do this, because grpc.aio may serialize after the call
+returns to the event loop.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..protocol import inference_pb2 as pb
+from ..utils import raise_error
+from ._utils import get_inference_request
+
+__all__ = ["RequestTemplate"]
+
+
+class RequestTemplate:
+    """Compiled invariant skeleton of one (model, inputs-spec, outputs,
+    params) request shape.  Build via ``client.prepare(...)``."""
+
+    def __init__(self, model_name: str, inputs, outputs=None,
+                 model_version: str = "", priority: int = 0,
+                 timeout: Optional[int] = None, parameters=None):
+        self.model_name = model_name
+        self.model_version = model_version
+        self._inputs = list(inputs)
+        self._outputs = list(outputs) if outputs else []
+        self._timeout = timeout
+        self._request = get_inference_request(
+            model_name, inputs, model_version, "", outputs, 0, False, False,
+            priority, timeout, parameters)
+        # which inputs contribute a raw payload (shm inputs don't), plus
+        # the frozen wire size per fixed-dtype slot (None = BYTES, varies).
+        # Header-only (shm) inputs have their whole submessage frozen into
+        # the request — snapshot it so a representation/region switch
+        # after prepare() raises instead of silently sending stale routing
+        self._raw_idx: List[int] = []
+        self._frozen_sizes: List[Optional[int]] = []
+        self._static_inputs: List[tuple] = []
+        # shapes are frozen into the request submessages; size checks
+        # alone can't catch a same-byte-count reshape (or BYTES reshape).
+        # Epochs make the per-stamp check one int compare; the full shape
+        # compare runs only when an epoch moved (re-synced if the shape
+        # round-tripped back to the frozen one).
+        self._frozen_shapes: List[List[int]] = []
+        self._frozen_epochs: List[int] = []
+        for i, inp in enumerate(self._inputs):
+            raw = inp._get_raw_data()
+            self._frozen_shapes.append(list(inp.shape()))
+            self._frozen_epochs.append(inp._shape_epoch)
+            if raw is None:
+                self._static_inputs.append(
+                    (i, inp._get_tensor_pb().SerializeToString(
+                        deterministic=True)))
+                continue
+            self._raw_idx.append(i)
+            self._frozen_sizes.append(
+                None if inp.datatype() == "BYTES" else len(raw))
+        # requested outputs are compiled into the request too (incl. shm
+        # routing): snapshot their submessages so a post-prepare output
+        # mutation raises instead of silently riding the stale routing —
+        # guarded by the outputs' mutation epochs (int compare per stamp;
+        # the serialize-and-compare runs only when an epoch moved)
+        self._frozen_outputs: List[bytes] = [
+            o._get_tensor_pb().SerializeToString(deterministic=True)
+            for o in self._outputs]
+        self._frozen_out_epochs: List[int] = [
+            o._mut_epoch for o in self._outputs]
+        del self._request.raw_input_contents[:]  # payloads stamp per call
+
+    def _check_static(self, inputs) -> None:
+        """Header-only (shm) inputs are frozen into the request — the
+        given request's state must still serialize identically.
+        Requested outputs are validated the same way (their submessages,
+        incl. shm routing, are compiled in)."""
+        for i, frozen in self._static_inputs:
+            inp = inputs[i]
+            if inp._get_raw_data() is not None \
+                    or inp._get_tensor_pb().SerializeToString(
+                        deterministic=True) != frozen:
+                raise_error(
+                    f"template invalidated: input {inp.name()!r} changed "
+                    "representation or shm parameters after prepare (its "
+                    "submessage is compiled in — re-prepare)")
+        for j, o in enumerate(self._outputs):
+            if o._mut_epoch == self._frozen_out_epochs[j]:
+                continue
+            if o._get_tensor_pb().SerializeToString(
+                    deterministic=True) != self._frozen_outputs[j]:
+                raise_error(
+                    f"template invalidated: output {o.name()!r} "
+                    "parameters changed after prepare (its submessage is "
+                    "compiled in — re-prepare)")
+            self._frozen_out_epochs[j] = o._mut_epoch  # round-tripped
+
+    def raws_for(self, inputs) -> List[bytes]:
+        """Extract (and spec-validate) another request's payloads in this
+        template's slot order — the ``infer_many`` per-item path.  Every
+        input is validated: payload slots for spec+data, header-only
+        (shm) inputs against the frozen submessage, so an item whose shm
+        region differs from the template's cannot silently ride the
+        compiled one."""
+        if len(inputs) != len(self._inputs):
+            raise_error("infer_many item does not match the template's "
+                        f"input count ({len(inputs)} != "
+                        f"{len(self._inputs)})")
+        self._check_static(inputs)
+        raws = []
+        for i in self._raw_idx:
+            tpl_inp, inp = self._inputs[i], inputs[i]
+            if inp.name() != tpl_inp.name() \
+                    or inp.datatype() != tpl_inp.datatype() \
+                    or list(inp.shape()) != list(tpl_inp.shape()):
+                raise_error(
+                    f"infer_many item input {inp.name()!r} does not match "
+                    "the template spec (name/dtype/shape must be "
+                    "identical; re-prepare for a new shape)")
+            raw = inp._get_raw_data()
+            if raw is None:
+                raise_error(
+                    f"infer_many item input {inp.name()!r} has no data "
+                    "attached")
+            raws.append(raw)
+        return raws
+
+    def stamp(self, request_id: str = "", raws=None,
+              timeout_us: Optional[int] = None,
+              copy: bool = False) -> pb.ModelInferRequest:
+        """Re-stamp the variable fields and return the request message.
+
+        ``raws`` overrides the payloads (default: the bound inputs'
+        current data); ``timeout_us`` restamps the v2 deadline parameter
+        for this attempt; ``copy=True`` returns a fresh message (required
+        for concurrent in-flight use — see the module docstring).
+        """
+        if raws is None:
+            self._check_static(self._inputs)
+            for i, epoch in enumerate(self._frozen_epochs):
+                inp = self._inputs[i]
+                if inp._shape_epoch != epoch:
+                    if list(inp.shape()) != self._frozen_shapes[i]:
+                        raise_error(
+                            "template invalidated: input "
+                            f"{inp.name()!r} shape changed to "
+                            f"{list(inp.shape())} after prepare froze "
+                            f"{self._frozen_shapes[i]} (re-prepare)")
+                    self._frozen_epochs[i] = inp._shape_epoch
+            raws = []
+            for i in self._raw_idx:
+                raw = self._inputs[i]._get_raw_data()
+                if raw is None:
+                    raise_error(
+                        "template invalidated: input "
+                        f"{self._inputs[i].name()!r} no longer carries "
+                        "raw data (representation changed after prepare "
+                        "— re-prepare)")
+                raws.append(raw)
+        elif len(raws) != len(self._raw_idx):
+            raise_error(
+                f"template expects {len(self._raw_idx)} tensor payloads, "
+                f"got {len(raws)}")
+        for slot, frozen in enumerate(self._frozen_sizes):
+            if frozen is not None and len(raws[slot]) != frozen:
+                raise_error(
+                    "template invalidated: input "
+                    f"{self._inputs[self._raw_idx[slot]].name()!r} payload "
+                    f"is {len(raws[slot])} bytes, template froze {frozen} "
+                    "(re-prepare after a shape change)")
+        request = self._request
+        if copy:
+            fresh = pb.ModelInferRequest()
+            fresh.CopyFrom(request)
+            request = fresh
+        if request_id:
+            request.id = request_id
+        elif request.id:
+            request.ClearField("id")
+        if timeout_us is not None:
+            request.parameters["timeout"].int64_param = timeout_us
+        elif self._timeout is None and "timeout" in request.parameters:
+            # a prior deadline-budgeted attempt stamped one; a plain call
+            # must not inherit it
+            del request.parameters["timeout"]
+        del request.raw_input_contents[:]
+        request.raw_input_contents.extend(raws)
+        return request

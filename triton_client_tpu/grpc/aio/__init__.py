@@ -10,22 +10,26 @@ cancellable response iterator yielding ``(InferResult, error)`` tuples
 
 from __future__ import annotations
 
+import asyncio
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import grpc
 
 from ..._client import InferenceServerClientBase
 from ..._request import Request
-from ..._resilience import (RetryPolicy, call_with_retry_async, min_timeout,
+from ..._resilience import (RetryPolicy, call_with_retry_async,
+                            deadline_exceeded_error, min_timeout,
                             remaining_us)
 from ..._telemetry import telemetry, traceparent_from_metadata
+from ..._uvloop import maybe_install_uvloop
 from ...protocol import inference_pb2 as pb
 from ...protocol.service import GRPCInferenceServiceStub
 from ...utils import raise_error
 from .._client import (KeepAliveOptions, _channel_options, _maybe_json,
                        _with_trace_metadata)
 from .._infer_result import InferResult
+from .._template import RequestTemplate
 from .._utils import (
     get_error_grpc,
     get_grpc_compression,
@@ -33,7 +37,41 @@ from .._utils import (
     raise_error_grpc,
 )
 
-__all__ = ["InferenceServerClient", "KeepAliveOptions"]
+__all__ = ["InferenceServerClient", "KeepAliveOptions", "PreparedRequest"]
+
+# optional uvloop (TRITON_TPU_UVLOOP=1; stdlib loop otherwise) — must run
+# before any channel/loop is created by this module's callers
+maybe_install_uvloop()
+
+
+class PreparedRequest:
+    """Async sibling of the sync gRPC fast-path handle.  Every stamp
+    copies the skeleton (``copy=True``): grpc.aio may serialize the
+    message after control returns to the event loop, so concurrent
+    in-flight requests must never share one mutable message."""
+
+    def __init__(self, client, template: RequestTemplate):
+        self._client = client
+        self.template = template
+
+    async def infer(self, request_id="", headers=None, tenant=None,
+                    client_timeout=None,
+                    retry_policy: Optional[RetryPolicy] = None,
+                    deadline_s: Optional[float] = None) -> InferResult:
+        client = self._client
+        policy = retry_policy if retry_policy is not None \
+            else client._retry_policy
+        if policy is None and deadline_s is None:
+            return await client._infer_prepared(
+                self, request_id, headers, tenant, client_timeout)
+        return await call_with_retry_async(
+            policy,
+            lambda remaining, _attempt: client._infer_prepared(
+                self, request_id, headers, tenant, client_timeout,
+                _remaining_s=remaining),
+            method="infer", deadline_s=deadline_s,
+            retry_meta=(self.template.model_name, "grpc_aio", "infer",
+                        request_id))
 
 
 class InferenceServerClient(InferenceServerClientBase):
@@ -462,6 +500,156 @@ class InferenceServerClient(InferenceServerClientBase):
                 tenant=tenant, _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(model_name, "grpc_aio", "infer", request_id))
+
+    # -- wire fast path ----------------------------------------------------
+    def prepare(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ) -> PreparedRequest:
+        """Compile the invariant protobuf request once (sync client's
+        ``prepare`` contract; stamps always copy — safe for concurrent
+        tasks)."""
+        return PreparedRequest(self, RequestTemplate(
+            model_name, inputs, outputs, model_version, priority, timeout,
+            parameters))
+
+    async def _infer_prepared(self, prep: PreparedRequest, request_id,
+                              headers, tenant, client_timeout=None,
+                              _remaining_s=None, raws=None, _sink=None):
+        """One stamped-request RPC (``_sink``: per-flight batch telemetry,
+        see the sync client)."""
+        tel = telemetry()
+        t_ser0 = time.monotonic_ns()
+        timeout_us = None
+        if _remaining_s is not None and prep.template._timeout is None:
+            timeout_us = remaining_us(_remaining_s)
+        request = prep.template.stamp(request_id, raws, timeout_us,
+                                      copy=True)
+        metadata, rid = _with_trace_metadata(
+            self._get_metadata(headers), request_id)
+        if tenant:
+            metadata = metadata + (("triton-tenant", str(tenant)),)
+        t_ser1 = time.monotonic_ns()
+        req_bytes = request.ByteSize()
+        t0 = time.perf_counter()
+        try:
+            response = await self._client_stub.ModelInfer(
+                request,
+                metadata=metadata,
+                timeout=min_timeout(client_timeout, _remaining_s),
+                compression=grpc.Compression.NoCompression,
+            )
+            t_net1 = time.monotonic_ns()
+            if _sink is not None:
+                _sink.append((True, time.perf_counter() - t0, req_bytes,
+                              response.ByteSize(), rid))
+            else:
+                tel.record_request(
+                    prep.template.model_name, "grpc_aio", "infer",
+                    time.perf_counter() - t0, ok=True,
+                    request_bytes=req_bytes,
+                    response_bytes=response.ByteSize(), request_id=rid)
+            result = InferResult(response)
+            if tel.tracing_enabled:
+                tel.record_infer_spans(
+                    rid, prep.template.model_name, "grpc_aio", "infer",
+                    t_ser0, t_ser1, t_net1,
+                    traceparent=traceparent_from_metadata(metadata))
+            return result
+        except grpc.RpcError as e:
+            if _sink is not None:
+                _sink.append((False, time.perf_counter() - t0, req_bytes,
+                              0, rid))
+            else:
+                tel.record_request(
+                    prep.template.model_name, "grpc_aio", "infer",
+                    time.perf_counter() - t0, ok=False,
+                    request_bytes=req_bytes, request_id=rid)
+            raise_error_grpc(e)
+
+    async def infer_many(
+        self,
+        model_name,
+        requests,
+        model_version="",
+        outputs=None,
+        priority=0,
+        timeout=None,
+        parameters=None,
+        request_ids=None,
+        headers=None,
+        tenant: Optional[str] = None,
+        client_timeout=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
+        window: int = 32,
+    ) -> List[InferResult]:
+        """Batch submit with a bounded-concurrency gather (``window``
+        in-flight at once) — the HTTP aio sibling's contract: one
+        template, one retry/deadline envelope, one locked telemetry batch
+        per flight, order-preserving results equal to N sequential
+        ``infer`` calls."""
+        items = list(requests)
+        if not items:
+            return []
+        template = RequestTemplate(
+            model_name, items[0], outputs, model_version, priority, timeout,
+            parameters)
+        prep = PreparedRequest(self, template)
+        raws_list = [template.raws_for(item) for item in items]
+        ids = list(request_ids) if request_ids else [""] * len(items)
+        if len(ids) != len(items):
+            raise_error("request_ids length must match requests")
+        results: List[Optional[InferResult]] = [None] * len(items)
+        done = [False] * len(items)
+        tel = telemetry()
+
+        async def flight(remaining, _attempt):
+            # ONE deadline for the whole flight, re-derived as each item
+            # acquires a window slot (see the http.aio sibling)
+            deadline = (time.monotonic() + remaining
+                        if remaining is not None else None)
+            sem = asyncio.Semaphore(max(1, window))
+            sink: list = []
+
+            async def one(i):
+                async with sem:
+                    rem_i = None
+                    if deadline is not None:
+                        rem_i = deadline - time.monotonic()
+                        if rem_i <= 0:
+                            raise deadline_exceeded_error()
+                    results[i] = await self._infer_prepared(
+                        prep, ids[i], headers, tenant, client_timeout,
+                        _remaining_s=rem_i, raws=raws_list[i],
+                        _sink=sink)
+                    done[i] = True
+
+            pending = [i for i, d in enumerate(done) if not d]
+            try:
+                outcomes = await asyncio.gather(
+                    *(one(i) for i in pending), return_exceptions=True)
+            finally:
+                tel.record_request_batch(
+                    model_name, "grpc_aio", "infer", sink)
+            for out in outcomes:
+                if isinstance(out, BaseException):
+                    raise out
+            return results
+
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        if policy is None and deadline_s is None:
+            return await flight(None, 1)
+        return await call_with_retry_async(
+            policy, flight, method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, "grpc_aio", "infer", ""))
 
     async def _infer_once(
         self,

@@ -3,7 +3,8 @@
 Mirrors the reference's ``tritonclient.http`` package surface."""
 
 from .._auth import BasicAuth  # noqa: F401 (re-export parity)
-from ._client import InferAsyncRequest, InferenceServerClient
+from ._client import (InferAsyncRequest, InferenceServerClient,
+                      PreparedRequest)
 from ._infer_input import InferInput
 from ._infer_result import InferResult
 from ._requested_output import InferRequestedOutput
@@ -14,4 +15,5 @@ __all__ = [
     "InferInput",
     "InferRequestedOutput",
     "InferResult",
+    "PreparedRequest",
 ]

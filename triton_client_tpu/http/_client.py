@@ -25,12 +25,55 @@ import urllib3
 
 from .._client import InferenceServerClientBase
 from .._request import Request
-from .._resilience import (RetryPolicy, call_with_retry, min_timeout,
+from .._resilience import (RetryPolicy, call_with_retry,
+                           deadline_exceeded_error, min_timeout,
                            normalized_status, remaining_us)
 from .._telemetry import merge_trace_headers, telemetry, traceparent_on_wire
 from ..utils import InferenceServerException, raise_error
 from ._infer_result import InferResult
+from ._template import RequestTemplate
 from ._utils import get_inference_request_body, raise_if_error
+
+
+class PreparedRequest:
+    """Handle for the wire fast path: a compiled :class:`RequestTemplate`
+    bound to a client.  ``infer()`` re-stamps only the request id, the
+    deadline header and the raw tensor bytes — update data by calling
+    ``set_data_from_numpy`` on the SAME ``InferInput`` objects that were
+    passed to ``prepare()`` (the reuse-infer-objects idiom).  That default
+    data path makes the handle single-thread: concurrent mutate+infer on
+    one handle interleaves into torn requests — build one PreparedRequest
+    per worker thread (the perf_analyzer session model; only the
+    compiled template itself is immutable and shareable)."""
+
+    def __init__(self, client, template: RequestTemplate):
+        self._client = client
+        self.template = template
+        path = f"v2/models/{quote(template.model_name)}"
+        if template.model_version:
+            path += f"/versions/{template.model_version}"
+        self.infer_path = path + "/infer"
+
+    def infer(self, request_id="", headers=None, query_params=None,
+              tenant=None, retry_policy: Optional[RetryPolicy] = None,
+              deadline_s: Optional[float] = None) -> InferResult:
+        """Fast-path inference — same resilience/telemetry/trace contract
+        as ``client.infer`` (retries re-stamp the deadline header per
+        attempt; spans still pair)."""
+        client = self._client
+        policy = retry_policy if retry_policy is not None \
+            else client._retry_policy
+        if policy is None and deadline_s is None:
+            return client._infer_prepared(
+                self, request_id, headers, query_params, tenant)
+        return call_with_retry(
+            policy,
+            lambda remaining, _attempt: client._infer_prepared(
+                self, request_id, headers, query_params, tenant,
+                _remaining_s=remaining),
+            method="infer", deadline_s=deadline_s,
+            retry_meta=(self.template.model_name, "http", "infer",
+                        request_id))
 
 
 class InferAsyncRequest:
@@ -652,6 +695,155 @@ class InferenceServerClient(InferenceServerClientBase):
                 traceparent=traceparent_on_wire(headers, trace_headers))
         return result
 
+    # -- wire fast path ----------------------------------------------------
+    def prepare(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ) -> PreparedRequest:
+        """Compile the invariant request skeleton once (see
+        ``_template.py``); the returned handle's ``infer()`` re-stamps only
+        id/deadline/tensor bytes.  ``inputs`` must already carry binary
+        data; changing their shape/dtype/outputs/params afterwards
+        invalidates the template (``stamp`` raises — re-``prepare``)."""
+        return PreparedRequest(self, RequestTemplate(
+            model_name, inputs, outputs, model_version, priority, timeout,
+            parameters))
+
+    def _infer_prepared(self, prep: PreparedRequest, request_id, headers,
+                        query_params, tenant, _method="infer",
+                        _remaining_s=None, raws=None, _sink=None):
+        """One stamped-request round trip.  With ``_sink`` (a list), the
+        telemetry record is deferred to the caller's per-flight batch
+        (``infer_many``): the outcome tuple is appended instead — counters
+        still count per request, the lock is taken once per flight."""
+        tel = telemetry()
+        t_ser0 = time.monotonic_ns()
+        body, json_size = prep.template.stamp(request_id, raws)
+        extra_headers = {}
+        if tenant:
+            extra_headers["triton-tenant"] = str(tenant)
+        if json_size is not None:
+            extra_headers["Inference-Header-Content-Length"] = str(json_size)
+        trace_headers, rid = merge_trace_headers(headers, request_id)
+        extra_headers.update(trace_headers)
+        if _remaining_s is not None:
+            extra_headers["triton-timeout-us"] = str(
+                remaining_us(_remaining_s))
+        t_ser1 = time.monotonic_ns()
+        t0 = time.perf_counter()
+        try:
+            response = self._post(prep.infer_path, body, headers,
+                                  query_params, extra_headers,
+                                  timeout_s=_remaining_s)
+            raise_if_error(response.status, response.data, response.headers)
+        except Exception:
+            if _sink is not None:
+                _sink.append((False, time.perf_counter() - t0, len(body),
+                              0, rid))
+            else:
+                tel.record_request(
+                    prep.template.model_name, "http", _method,
+                    time.perf_counter() - t0, ok=False,
+                    request_bytes=len(body), request_id=rid)
+            raise
+        t_net1 = time.monotonic_ns()
+        if _sink is not None:
+            _sink.append((True, time.perf_counter() - t0, len(body),
+                          len(response.data), rid))
+        else:
+            tel.record_request(
+                prep.template.model_name, "http", _method,
+                time.perf_counter() - t0, ok=True, request_bytes=len(body),
+                response_bytes=len(response.data), request_id=rid)
+        header_length = response.headers.get("Inference-Header-Content-Length")
+        result = InferResult(
+            response.data, self._verbose,
+            int(header_length) if header_length is not None else None,
+            None, headers=response.headers)
+        if tel.tracing_enabled:
+            tel.record_infer_spans(
+                rid, prep.template.model_name, "http", _method,
+                t_ser0, t_ser1, t_net1,
+                traceparent=traceparent_on_wire(headers, trace_headers))
+        return result
+
+    def infer_many(
+        self,
+        model_name,
+        requests,
+        model_version="",
+        outputs=None,
+        priority=0,
+        timeout=None,
+        parameters=None,
+        request_ids=None,
+        headers=None,
+        query_params=None,
+        tenant: Optional[str] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
+    ) -> List[InferResult]:
+        """Batch submit: run every item in ``requests`` (each a list of
+        data-carrying ``InferInput``, all matching the first item's specs)
+        through ONE compiled template and ONE retry/deadline/telemetry
+        envelope.  Results keep submission order and equal N sequential
+        ``infer`` calls; telemetry counters still count per request (one
+        locked batch record per flight), and a mid-batch retry resumes at
+        the failed item instead of replaying completed ones."""
+        items = list(requests)
+        if not items:
+            return []
+        template = RequestTemplate(
+            model_name, items[0], outputs, model_version, priority, timeout,
+            parameters)
+        prep = PreparedRequest(self, template)
+        raws_list = [template.raws_for(item) for item in items]
+        ids = list(request_ids) if request_ids else [""] * len(items)
+        if len(ids) != len(items):
+            raise_error("request_ids length must match requests")
+        results: List[Optional[InferResult]] = [None] * len(items)
+        next_idx = [0]
+        tel = telemetry()
+
+        def flight(remaining, _attempt):
+            # ONE deadline for the whole flight: re-derived before every
+            # item, so a slow batch raises instead of granting each item
+            # the full remaining budget (N-fold overrun)
+            deadline = (time.monotonic() + remaining
+                        if remaining is not None else None)
+            sink: list = []
+            try:
+                while next_idx[0] < len(items):
+                    i = next_idx[0]
+                    rem_i = None
+                    if deadline is not None:
+                        rem_i = deadline - time.monotonic()
+                        if rem_i <= 0:
+                            raise deadline_exceeded_error()
+                    results[i] = self._infer_prepared(
+                        prep, ids[i], headers, query_params, tenant,
+                        _remaining_s=rem_i, raws=raws_list[i],
+                        _sink=sink)
+                    next_idx[0] += 1
+            finally:
+                # one lock round-trip per flight; per-request counts
+                tel.record_request_batch(model_name, "http", "infer", sink)
+            return results
+
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        if policy is None and deadline_s is None:
+            return flight(None, 1)
+        return call_with_retry(
+            policy, flight, method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, "http", "infer", ""))
+
     def infer(
         self,
         model_name,
@@ -727,6 +919,12 @@ class InferenceServerClient(InferenceServerClientBase):
         handle (reference :1486-1659; greenlet pool → thread pool here).
         The resilience contract matches ``infer`` — retries/deadline run
         on the worker thread, invisible to the returned handle."""
+        # the body is gathered on the worker thread AFTER this returns, so
+        # zero-copy views over caller arrays must be snapshotted now — a
+        # caller mutating its array post-submit would otherwise tear the
+        # in-flight payload (pre-fast-path attach-time-copy semantics)
+        for inp in inputs:
+            inp._freeze_raw()
         if self._executor is None:
             self._executor = ThreadPoolExecutor(
                 max_workers=self._concurrency, thread_name_prefix="tc-tpu-http"

@@ -13,15 +13,24 @@ from typing import List, Optional
 import numpy as np
 
 from ..utils import (
+    as_wire_memoryview,
     np_to_triton_dtype,
     raise_error,
     serialize_bf16_tensor,
-    serialize_byte_tensor,
+    serialize_byte_tensor_raw,
+    wire_length,
 )
 
 
 class InferInput:
-    """An input tensor for an inference request."""
+    """An input tensor for an inference request.
+
+    Zero-copy contract (binary path): ``set_data_from_numpy`` stores a
+    *view* over the source array for fixed-size dtypes — no byte copy
+    happens until the request body is gathered.  The caller must not
+    mutate the array between attaching it and the request being sent
+    (ARCHITECTURE.md "Client wire fast path" has the ownership rules).
+    """
 
     def __init__(self, name: str, shape: List[int], datatype: str):
         self._name = name
@@ -29,7 +38,12 @@ class InferInput:
         self._datatype = datatype
         self._parameters: dict = {}
         self._data = None  # JSON path: flat python list
-        self._raw_data: Optional[bytes] = None  # binary path
+        # binary path: bytes, bytearray (BYTES codec buffer) or a
+        # B-format memoryview over the caller's array (zero-copy)
+        self._raw_data = None
+        # bumped by set_shape: lets a template detect a shape change
+        # with one int compare on the stamp hot path
+        self._shape_epoch = 0
 
     def name(self) -> str:
         return self._name
@@ -42,6 +56,7 @@ class InferInput:
 
     def set_shape(self, shape: List[int]) -> "InferInput":
         self._shape = list(shape)
+        self._shape_epoch += 1
         return self
 
     def set_data_from_numpy(self, input_tensor: np.ndarray, binary_data: bool = True):
@@ -97,13 +112,16 @@ class InferInput:
         else:
             self._data = None
             if self._datatype == "BYTES":
-                serialized = serialize_byte_tensor(input_tensor)
-                self._raw_data = serialized.tobytes() if serialized is not None else b""
+                # one preallocated buffer; the body gather reads it as-is
+                self._raw_data = serialize_byte_tensor_raw(input_tensor)
             elif self._datatype == "BF16":
-                self._raw_data = serialize_bf16_tensor(input_tensor).tobytes()
+                # uint8 view (zero-copy for native bf16 arrays)
+                self._raw_data = as_wire_memoryview(
+                    serialize_bf16_tensor(input_tensor))
             else:
-                self._raw_data = input_tensor.tobytes()
-            self._parameters["binary_data_size"] = len(self._raw_data)
+                # zero-copy: a view over the caller's array
+                self._raw_data = as_wire_memoryview(input_tensor)
+            self._parameters["binary_data_size"] = wire_length(self._raw_data)
         return self
 
     def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
@@ -131,5 +149,18 @@ class InferInput:
             tensor["data"] = self._data
         return tensor
 
-    def _get_binary_data(self) -> Optional[bytes]:
+    def _get_binary_data(self):
+        """The wire payload: ``bytes``/``bytearray``/B-format
+        ``memoryview`` (the body gather accepts all three), or None on
+        the JSON/shm paths."""
         return self._raw_data
+
+    def _freeze_raw(self) -> None:
+        """Snapshot a zero-copy view into owned bytes.  ``async_infer``
+        calls this before handing the request to its worker thread: the
+        body is gathered after control returns to the caller, so the
+        fast path's "don't mutate between attach and send" ownership rule
+        is unsatisfiable there — the submit-time snapshot restores the
+        pre-fast-path copy semantics for exactly that path."""
+        if isinstance(self._raw_data, memoryview):
+            self._raw_data = self._raw_data.tobytes()

@@ -43,14 +43,21 @@ class InferResult:
             content = response_body
             self._result = json.loads(content)
         else:
-            header = response_body[:header_length]
-            self._result = json.loads(header)
+            body_view = memoryview(response_body)
+            # json.loads does not take memoryviews; the header slice is
+            # small and must be parsed anyway
+            self._result = json.loads(response_body[:header_length])
             offset = header_length
             for output in self._result.get("outputs", []):
                 params = output.get("parameters", {})
                 size = params.get("binary_data_size")
                 if size is not None:
-                    self._buffer_map[output["name"]] = response_body[offset : offset + size]
+                    # zero-copy: memoryview slices over the response body;
+                    # as_numpy wraps them with np.frombuffer (still no
+                    # copy), keeping the one response buffer as backing
+                    # store for every fixed-dtype output
+                    self._buffer_map[output["name"]] = \
+                        body_view[offset:offset + size]
                     offset += size
         if verbose:
             print(self._result)

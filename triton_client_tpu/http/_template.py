@@ -1,0 +1,275 @@
+"""Pre-serialized HTTP request templates — the client wire fast path.
+
+The slow path rebuilds the whole v2 JSON header per ``infer()``: tensor
+dicts, parameter dicts, ``json.dumps``, plus a per-input ``bytes``
+concatenation.  For the perf-tool workloads (same model, same tensor specs,
+thousands of calls) everything but the request id, the deadline header and
+the raw tensor bytes is invariant — so :class:`RequestTemplate` serializes
+the header ONCE and splits it into literal byte segments around the
+variable slots:
+
+* the optional ``"id": "...", `` chunk (omitted when no request id, exactly
+  like the slow path),
+* one ``binary_data_size`` integer per BYTES input (their payload length
+  varies per call; fixed-size dtypes freeze their size and stamp-time
+  validates it).
+
+Compilation runs the REAL slow-path builder (``build_infer_request_dict`` +
+``json.dumps``) with sentinel values and splits its output, so a stamped
+request is byte-identical to the slow path by construction — pinned by
+``tests/test_wire_fastpath.py``'s equality matrix.
+
+What invalidates a template: changing an input's shape/dtype/name set, the
+requested outputs, priority/timeout/parameters, or switching an input
+between binary/JSON/shm representation.  ``stamp()`` cheaply re-validates
+the frozen sizes each call and raises rather than emit a corrupt body;
+callers then re-``prepare()``.
+
+Thread-safety: a template is immutable after compile; ``stamp()`` builds a
+fresh parts list per call, so one template may be shared across threads and
+asyncio tasks.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from ..utils import raise_error, wire_length
+from ._utils import build_infer_request_dict
+
+__all__ = ["RequestTemplate"]
+
+#: Improbable literals the compiler plants, then locates, in the dumped
+#: header.  The int base is re-derived on collision (shape dims could in
+#: principle collide), the id string never legitimately appears.
+_SENTINEL_ID = "tmpl-rid-9f3a71c5e2d04b88"
+_SENTINEL_INT_BASE = 9_090_909_090_001
+
+
+class RequestTemplate:
+    """Compiled invariant skeleton of one (model, inputs-spec, outputs,
+    params) request shape.  Build via ``client.prepare(...)``."""
+
+    def __init__(self, model_name: str, inputs, outputs=None,
+                 model_version: str = "", priority: int = 0,
+                 timeout: Optional[int] = None, parameters=None):
+        self.model_name = model_name
+        self.model_version = model_version
+        self._inputs = list(inputs)
+        self._outputs = list(outputs) if outputs else None
+        self._priority = priority
+        self._timeout = timeout
+        self._parameters = dict(parameters) if parameters else None
+        # (input index, frozen size or None-for-BYTES-slot) in input order
+        self._binary_idx: List[int] = []
+        self._frozen_sizes: List[Optional[int]] = []
+        # shm/no-data inputs are header-only: their parameters (region
+        # name/size/offset) are FROZEN into the compiled header, so their
+        # compile-time state is snapshotted and re-validated every stamp —
+        # a representation or region switch after prepare() must raise,
+        # never silently send the stale header
+        self._static_inputs: List[Tuple[int, dict]] = []
+        # requested outputs are header-only too (their shm routing is
+        # compiled in): snapshot and re-validate like static inputs, so
+        # an output shm rebind after prepare() raises instead of
+        # silently routing results to the stale region
+        self._frozen_outputs: List[dict] = [
+            dict(o._parameters) for o in (self._outputs or [])]
+        # the compiled header also freezes every input's SHAPE; sizes
+        # alone can't catch a same-byte-count reshape (or any BYTES
+        # reshape), so shapes are re-validated per stamp — one int
+        # (epoch) compare on the hot path, full compare only on change
+        self._frozen_shapes: List[List[int]] = []
+        self._frozen_epochs: List[int] = []
+        for i, inp in enumerate(self._inputs):
+            self._frozen_epochs.append(inp._shape_epoch)
+            raw = inp._get_binary_data()
+            if inp._data is not None:
+                raise_error(
+                    "RequestTemplate requires binary inputs; "
+                    f"input {inp.name()!r} carries JSON data")
+            self._frozen_shapes.append(list(inp.shape()))
+            if raw is None:
+                self._static_inputs.append((i, dict(inp._parameters)))
+                continue
+            self._binary_idx.append(i)
+            self._frozen_sizes.append(
+                None if inp.datatype() == "BYTES" else wire_length(raw))
+        self._segments = self._compile()
+
+    # -- compile -----------------------------------------------------------
+    def _compile(self) -> List[Tuple[str, object]]:
+        """Dump the header with sentinel values and split it into
+        ``("lit", bytes) / ("id", None) / ("bsize", slot_index)`` ops."""
+        bytes_slots = [i for i, inp_i in enumerate(self._binary_idx)
+                       if self._frozen_sizes[i] is None]
+        base = _SENTINEL_INT_BASE
+        for _attempt in range(16):
+            sentinels = {s: base + 7 * s for s in bytes_slots}
+            saved = {}
+            for s, val in sentinels.items():
+                inp = self._inputs[self._binary_idx[s]]
+                saved[s] = inp._parameters.get("binary_data_size")
+                inp._parameters["binary_data_size"] = val
+            try:
+                header = json.dumps(build_infer_request_dict(
+                    self._inputs, _SENTINEL_ID, self._outputs, 0, False,
+                    False, self._priority, self._timeout, self._parameters))
+            finally:
+                for s, old in saved.items():
+                    inp = self._inputs[self._binary_idx[s]]
+                    if old is None:
+                        inp._parameters.pop("binary_data_size", None)
+                    else:
+                        inp._parameters["binary_data_size"] = old
+            marks = [(f'"id": "{_SENTINEL_ID}", ', "id", None)]
+            marks += [(str(val), "bsize", s) for s, val in sentinels.items()]
+            if all(header.count(m) == 1 for m, _k, _s in marks):
+                return self._split(header.encode(),
+                                   [(m.encode(), k, s) for m, k, s in marks])
+            base += 1_010_101  # a real value collided; shift and re-plant
+        raise_error("could not compile request template "
+                    "(sentinel collision)")  # pragma: no cover - 16 shifts
+
+    @staticmethod
+    def _split(header: bytes, marks) -> List[Tuple[str, object]]:
+        # order marks by position, then cut literals between them
+        placed = sorted((header.index(m), m, kind, slot)
+                        for m, kind, slot in marks)
+        ops: List[Tuple[str, object]] = []
+        pos = 0
+        for at, m, kind, slot in placed:
+            if at > pos:
+                ops.append(("lit", header[pos:at]))
+            ops.append((kind, slot))
+            pos = at + len(m)
+        if pos < len(header):
+            ops.append(("lit", header[pos:]))
+        return ops
+
+    # -- stamp -------------------------------------------------------------
+    def stamp(self, request_id: str = "",
+              raws=None) -> Tuple[bytes, Optional[int]]:
+        """Re-stamp the variable fields and gather the body.
+
+        ``raws`` overrides the tensor payloads (``infer_many`` stamps other
+        requests' data through one template); default is the bound inputs'
+        current data.  Returns (body, json_size) byte-identical to the
+        slow path for the same arguments.
+        """
+        if raws is None:
+            self._check_static(self._inputs)
+            self._check_shapes(self._inputs)
+            raws = []
+            for i in self._binary_idx:
+                raw = self._inputs[i]._get_binary_data()
+                if raw is None:
+                    raise_error(
+                        "template invalidated: input "
+                        f"{self._inputs[i].name()!r} no longer carries "
+                        "binary data (representation changed after "
+                        "prepare — re-prepare)")
+                raws.append(raw)
+        elif len(raws) != len(self._binary_idx):
+            raise_error(
+                f"template expects {len(self._binary_idx)} tensor "
+                f"payloads, got {len(raws)}")
+        sizes = [len(r) for r in raws]
+        for slot, frozen in enumerate(self._frozen_sizes):
+            if frozen is not None and sizes[slot] != frozen:
+                raise_error(
+                    "template invalidated: input "
+                    f"{self._inputs[self._binary_idx[slot]].name()!r} "
+                    f"payload is {sizes[slot]} bytes, template froze "
+                    f"{frozen} (re-prepare after a shape change)")
+        parts: List[bytes] = []
+        for kind, val in self._segments:
+            if kind == "lit":
+                parts.append(val)
+            elif kind == "id":
+                if request_id:
+                    parts.append(b'"id": ' + json.dumps(request_id).encode()
+                                 + b", ")
+            else:  # bsize
+                parts.append(str(sizes[val]).encode())
+        json_size = sum(len(p) for p in parts)
+        if sum(sizes):
+            parts.extend(raws)
+            # tpu-lint: disable=WIRE-COPY the single required gather into the wire body
+            return b"".join(parts), json_size
+        # tpu-lint: disable=WIRE-COPY header-only join, no tensor payload
+        return b"".join(parts), None
+
+    def _check_shapes(self, inputs) -> None:
+        """The header declares the compile-time shapes — a post-prepare
+        ``set_shape`` (even byte-size-preserving) must raise, never send
+        the stale declaration.  Hot path: one epoch int compare per
+        input; the full shape compare runs only when an epoch moved
+        (re-synced if the shape round-tripped back)."""
+        for i, epoch in enumerate(self._frozen_epochs):
+            inp = inputs[i]
+            if inp._shape_epoch != epoch:
+                if inp._shape != self._frozen_shapes[i]:
+                    raise_error(
+                        f"template invalidated: input {inp.name()!r} "
+                        f"shape changed to {list(inp.shape())} after "
+                        f"prepare froze {self._frozen_shapes[i]} "
+                        "(re-prepare)")
+                self._frozen_epochs[i] = inp._shape_epoch
+
+    def _check_static(self, inputs) -> None:
+        """Header-only (shm/no-data) inputs are frozen into the compiled
+        header — the given request's state must still match it exactly.
+        Requested outputs are validated the same way (their parameters
+        are header-only by nature)."""
+        for i, frozen in self._static_inputs:
+            inp = inputs[i]
+            if inp._get_binary_data() is not None \
+                    or inp._data is not None \
+                    or inp._parameters != frozen:
+                raise_error(
+                    f"template invalidated: input {inp.name()!r} changed "
+                    "representation or shm parameters after prepare (its "
+                    "header fields are compiled in — re-prepare)")
+        for o, frozen in zip(self._outputs or [], self._frozen_outputs):
+            if o._parameters != frozen:
+                raise_error(
+                    f"template invalidated: output {o.name()!r} "
+                    "parameters changed after prepare (its header fields "
+                    "are compiled in — re-prepare)")
+
+    def _check_spec(self, tpl_inp, inp) -> None:
+        if inp.name() != tpl_inp.name() \
+                or inp.datatype() != tpl_inp.datatype() \
+                or list(inp.shape()) != list(tpl_inp.shape()):
+            raise_error(
+                f"infer_many item input {inp.name()!r} does not match "
+                "the template spec (name/dtype/shape must be identical; "
+                "re-prepare for a new shape)")
+
+    def raws_for(self, inputs) -> List[object]:
+        """Extract (and spec-validate) another request's payloads in this
+        template's slot order — the ``infer_many`` per-item path.  Every
+        input is validated: payload slots for spec+data, header-only
+        (shm) inputs against the frozen header state, so an item whose
+        shm region differs from the template's cannot silently ride the
+        compiled one."""
+        if len(inputs) != len(self._inputs):
+            raise_error("infer_many item does not match the template's "
+                        f"input count ({len(inputs)} != "
+                        f"{len(self._inputs)})")
+        for i, _frozen in self._static_inputs:
+            self._check_spec(self._inputs[i], inputs[i])
+        self._check_static(inputs)
+        raws = []
+        for slot, i in enumerate(self._binary_idx):
+            tpl_inp, inp = self._inputs[i], inputs[i]
+            self._check_spec(tpl_inp, inp)
+            raw = inp._get_binary_data()
+            if raw is None:
+                raise_error(
+                    f"infer_many item input {inp.name()!r} has no binary "
+                    "data attached")
+            raws.append(raw)
+        return raws

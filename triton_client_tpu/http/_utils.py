@@ -39,7 +39,7 @@ def raise_if_error(status: int, body: bytes, headers=None) -> None:
     raise exc
 
 
-def get_inference_request_body(
+def build_infer_request_dict(
     inputs,
     request_id: str,
     outputs,
@@ -49,10 +49,11 @@ def get_inference_request_body(
     priority: int,
     timeout: Optional[int],
     custom_parameters: Optional[dict],
-) -> Tuple[bytes, Optional[int]]:
-    """Build the infer request body: JSON header + concatenated raw buffers.
-    Returns (body, json_size) where json_size is None for JSON-only bodies
-    (reference _get_inference_request, _utils.py:85-150)."""
+) -> dict:
+    """The v2 infer request JSON header as a dict — shared by the per-call
+    body builder below and the fast-path template compiler
+    (``_template.py``), so the two can never drift on key order or
+    reserved-parameter policy."""
     infer_request = {}
     parameters = {}
     if request_id:
@@ -88,14 +89,44 @@ def get_inference_request_body(
             parameters[key] = value
     if parameters:
         infer_request["parameters"] = parameters
+    return infer_request
 
-    request_body = json.dumps(infer_request)
-    json_size = len(request_body)
-    binary_data = b""
+
+def assemble_body(header: bytes, raws) -> Tuple[bytes, Optional[int]]:
+    """Gather the JSON header + raw tensor payloads into the wire body with
+    ONE copy (a single join over the header and the memoryview/bytearray
+    payloads).  Returns (body, json_size), json_size None for JSON-only
+    bodies — matching the reference's framing contract."""
+    total = 0
+    for raw in raws:
+        total += len(raw)
+    if total:
+        # tpu-lint: disable=WIRE-COPY the single required gather into the wire body
+        return b"".join([header, *raws]), len(header)
+    return header, None
+
+
+def get_inference_request_body(
+    inputs,
+    request_id: str,
+    outputs,
+    sequence_id,
+    sequence_start: bool,
+    sequence_end: bool,
+    priority: int,
+    timeout: Optional[int],
+    custom_parameters: Optional[dict],
+) -> Tuple[bytes, Optional[int]]:
+    """Build the infer request body: JSON header + concatenated raw buffers.
+    Returns (body, json_size) where json_size is None for JSON-only bodies
+    (reference _get_inference_request, _utils.py:85-150)."""
+    infer_request = build_infer_request_dict(
+        inputs, request_id, outputs, sequence_id, sequence_start,
+        sequence_end, priority, timeout, custom_parameters)
+    header = json.dumps(infer_request).encode()
+    raws = []
     for input_tensor in inputs:
         raw = input_tensor._get_binary_data()
         if raw is not None:
-            binary_data += raw
-    if binary_data:
-        return request_body.encode() + binary_data, json_size
-    return request_body.encode(), None
+            raws.append(raw)
+    return assemble_body(header, raws)

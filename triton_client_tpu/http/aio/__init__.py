@@ -8,26 +8,67 @@ with ``TCPConnector(limit=conn_limit)`` and ``auto_decompress=False``
 
 from __future__ import annotations
 
+import asyncio
 import gzip
 import json
 import time
 import zlib
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 from urllib.parse import quote, urlencode
 
 import aiohttp
 
 from ..._client import InferenceServerClientBase
 from ..._request import Request
-from ..._resilience import (RetryPolicy, call_with_retry_async, min_timeout,
+from ..._resilience import (RetryPolicy, call_with_retry_async,
+                            deadline_exceeded_error, min_timeout,
                             normalized_status, remaining_us)
 from ..._telemetry import (merge_trace_headers, telemetry,
                            traceparent_on_wire)
+from ..._uvloop import maybe_install_uvloop
 from ...utils import InferenceServerException, raise_error
 from .._infer_result import InferResult
+from .._template import RequestTemplate
 from .._utils import get_inference_request_body, raise_if_error
 
-__all__ = ["InferenceServerClient"]
+__all__ = ["InferenceServerClient", "PreparedRequest"]
+
+# optional uvloop (TRITON_TPU_UVLOOP=1; stdlib loop otherwise) — must run
+# before any session/loop is created by this module's callers
+maybe_install_uvloop()
+
+
+class PreparedRequest:
+    """Async sibling of the sync client's fast-path handle: a compiled
+    :class:`RequestTemplate` bound to an aio client (same template class —
+    it is immutable and loop-agnostic)."""
+
+    def __init__(self, client, template: RequestTemplate):
+        self._client = client
+        self.template = template
+        path = f"v2/models/{quote(template.model_name)}"
+        if template.model_version:
+            path += f"/versions/{template.model_version}"
+        self.infer_path = path + "/infer"
+
+    async def infer(self, request_id="", headers=None, query_params=None,
+                    tenant=None,
+                    retry_policy: Optional[RetryPolicy] = None,
+                    deadline_s: Optional[float] = None) -> InferResult:
+        client = self._client
+        policy = retry_policy if retry_policy is not None \
+            else client._retry_policy
+        if policy is None and deadline_s is None:
+            return await client._infer_prepared(
+                self, request_id, headers, query_params, tenant)
+        return await call_with_retry_async(
+            policy,
+            lambda remaining, _attempt: client._infer_prepared(
+                self, request_id, headers, query_params, tenant,
+                _remaining_s=remaining),
+            method="infer", deadline_s=deadline_s,
+            retry_meta=(self.template.model_name, "http_aio", "infer",
+                        request_id))
 
 
 class InferenceServerClient(InferenceServerClientBase):
@@ -420,6 +461,158 @@ class InferenceServerClient(InferenceServerClientBase):
     generate_request_body = staticmethod(_Sync.generate_request_body)
     parse_response_body = staticmethod(_Sync.parse_response_body)
     del _Sync
+
+    # -- wire fast path ----------------------------------------------------
+    def prepare(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ) -> PreparedRequest:
+        """Compile the invariant request skeleton once (sync client's
+        ``prepare`` contract; the template is shared machinery)."""
+        return PreparedRequest(self, RequestTemplate(
+            model_name, inputs, outputs, model_version, priority, timeout,
+            parameters))
+
+    async def _infer_prepared(self, prep: PreparedRequest, request_id,
+                              headers, query_params, tenant,
+                              _remaining_s=None, raws=None, _sink=None):
+        """One stamped-request round trip (see the sync client's sibling
+        for the ``_sink`` batch-telemetry contract)."""
+        tel = telemetry()
+        t_ser0 = time.monotonic_ns()
+        body, json_size = prep.template.stamp(request_id, raws)
+        extra_headers = {}
+        if tenant:
+            extra_headers["triton-tenant"] = str(tenant)
+        if json_size is not None:
+            extra_headers["Inference-Header-Content-Length"] = str(json_size)
+        trace_headers, rid = merge_trace_headers(headers, request_id)
+        extra_headers.update(trace_headers)
+        if _remaining_s is not None:
+            extra_headers["triton-timeout-us"] = str(
+                remaining_us(_remaining_s))
+        t_ser1 = time.monotonic_ns()
+        t0 = time.perf_counter()
+        try:
+            status, resp_headers, data = await self._post(
+                prep.infer_path, body, headers, query_params, extra_headers,
+                timeout_s=_remaining_s)
+            raise_if_error(status, data, resp_headers)
+        except Exception:
+            if _sink is not None:
+                _sink.append((False, time.perf_counter() - t0, len(body),
+                              0, rid))
+            else:
+                tel.record_request(
+                    prep.template.model_name, "http_aio", "infer",
+                    time.perf_counter() - t0, ok=False,
+                    request_bytes=len(body), request_id=rid)
+            raise
+        t_net1 = time.monotonic_ns()
+        if _sink is not None:
+            _sink.append((True, time.perf_counter() - t0, len(body),
+                          len(data), rid))
+        else:
+            tel.record_request(
+                prep.template.model_name, "http_aio", "infer",
+                time.perf_counter() - t0, ok=True, request_bytes=len(body),
+                response_bytes=len(data), request_id=rid)
+        header_length = resp_headers.get("Inference-Header-Content-Length")
+        result = InferResult(
+            data, self._verbose,
+            int(header_length) if header_length is not None else None,
+            None, headers=resp_headers)
+        if tel.tracing_enabled:
+            tel.record_infer_spans(
+                rid, prep.template.model_name, "http_aio", "infer",
+                t_ser0, t_ser1, t_net1,
+                traceparent=traceparent_on_wire(headers, trace_headers))
+        return result
+
+    async def infer_many(
+        self,
+        model_name,
+        requests,
+        model_version="",
+        outputs=None,
+        priority=0,
+        timeout=None,
+        parameters=None,
+        request_ids=None,
+        headers=None,
+        query_params=None,
+        tenant: Optional[str] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        deadline_s: Optional[float] = None,
+        window: int = 32,
+    ) -> List[InferResult]:
+        """Batch submit with a bounded-concurrency gather (``window``
+        in-flight at once): one compiled template, one retry/deadline
+        envelope, one locked telemetry batch per flight.  Results keep
+        submission order and equal N sequential ``infer`` calls; a retry
+        re-runs only the items that had not completed."""
+        items = list(requests)
+        if not items:
+            return []
+        template = RequestTemplate(
+            model_name, items[0], outputs, model_version, priority, timeout,
+            parameters)
+        prep = PreparedRequest(self, template)
+        raws_list = [template.raws_for(item) for item in items]
+        ids = list(request_ids) if request_ids else [""] * len(items)
+        if len(ids) != len(items):
+            raise_error("request_ids length must match requests")
+        results: List[Optional[InferResult]] = [None] * len(items)
+        done = [False] * len(items)
+        tel = telemetry()
+
+        async def flight(remaining, _attempt):
+            # ONE deadline for the whole flight, re-derived as each item
+            # acquires a window slot (a slow batch raises instead of
+            # granting every window the full budget)
+            deadline = (time.monotonic() + remaining
+                        if remaining is not None else None)
+            sem = asyncio.Semaphore(max(1, window))
+            sink: list = []
+
+            async def one(i):
+                async with sem:
+                    rem_i = None
+                    if deadline is not None:
+                        rem_i = deadline - time.monotonic()
+                        if rem_i <= 0:
+                            raise deadline_exceeded_error()
+                    results[i] = await self._infer_prepared(
+                        prep, ids[i], headers, query_params, tenant,
+                        _remaining_s=rem_i, raws=raws_list[i],
+                        _sink=sink)
+                    done[i] = True
+
+            pending = [i for i, d in enumerate(done) if not d]
+            try:
+                outcomes = await asyncio.gather(
+                    *(one(i) for i in pending), return_exceptions=True)
+            finally:
+                tel.record_request_batch(
+                    model_name, "http_aio", "infer", sink)
+            for out in outcomes:
+                if isinstance(out, BaseException):
+                    raise out
+            return results
+
+        policy = retry_policy if retry_policy is not None \
+            else self._retry_policy
+        if policy is None and deadline_s is None:
+            return await flight(None, 1)
+        return await call_with_retry_async(
+            policy, flight, method="infer", deadline_s=deadline_s,
+            retry_meta=(model_name, "http_aio", "infer", ""))
 
     async def infer(
         self,

@@ -2,4 +2,4 @@
 checker with the engine's registry."""
 
 from . import (async_block, exc_contract, lock_order, metrics_decl,  # noqa: F401
-               span_pair, test_determinism)
+               span_pair, test_determinism, wire_copy)

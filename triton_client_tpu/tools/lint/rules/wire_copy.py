@@ -1,0 +1,113 @@
+"""WIRE-COPY: no tensor-payload copies on the client serialize paths.
+
+Historical bug class: ISSUE 10's profile found ~half of every RPC was
+client-framework overhead, and a big slice of it was redundant payload
+copies on the wire path — the BYTES codec joined 2N per-element chunks
+into a ``bytes`` and then round-tripped it through ``np.frombuffer(...)
+.tobytes()`` (a second full copy), the HTTP body grew by ``+=``
+concatenation (quadratic), and fixed-dtype tensors were ``tobytes()``'d
+even where a memoryview handoff reaches the transport.  The fast-path
+refactor removed them; this rule keeps them out.
+
+What fires, inside the four client cores (files under an ``http`` or
+``grpc`` path segment) and only within serialize-path functions
+(``set_data_from_numpy``, ``_get_binary_data``/``_get_raw_data``,
+``get_inference_request*``, ``stamp``/``assemble*``, anything named
+``*serialize*``):
+
+* ``<x>.tobytes()`` — copies the whole tensor; use
+  ``utils.as_wire_memoryview`` (HTTP) or pragma the one protobuf-required
+  materialization (gRPC).
+* ``bytes(x)`` with a non-constant argument — same copy, different
+  spelling.
+* ``b"".join(...)`` (any bytes-literal receiver) — per-element chunk
+  gather; build into one preallocated buffer
+  (``utils.serialize_byte_tensor_raw``) instead.
+
+Legitimate sites carry a reasoned pragma (``# tpu-lint:
+disable=WIRE-COPY <why>``): protobuf bytes fields require a ``bytes``
+materialization, and the final header+payload gather into the HTTP body
+is the one copy the transport demands.  The rule ships with an EMPTY
+baseline — new copies can't ride in grandfathered.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .._ast_util import iter_body_nodes, iter_functions
+from .._engine import Finding, Project, register_rule
+
+#: A file is in scope when a whole path segment is one of the client-core
+#: package names (``triton_client_tpu/http/...``, ``.../grpc/aio/...``).
+#: ``server/grpc_server.py`` etc. have no such segment and stay out.
+_CORE_SEGMENT = re.compile(r"(^|/)(http|grpc)(/|$)")
+
+#: Serialize-path function names (exact or substring rules below).
+_SERIALIZE_FNS = {
+    "set_data_from_numpy",
+    "_get_binary_data",
+    "_get_raw_data",
+    "generate_request_body",
+}
+_SERIALIZE_PREFIXES = ("get_inference_request", "stamp", "_stamp",
+                       "assemble")
+
+
+def _on_serialize_path(fn_name: str) -> bool:
+    if fn_name in _SERIALIZE_FNS:
+        return True
+    if any(fn_name.startswith(p) for p in _SERIALIZE_PREFIXES):
+        return True
+    return "serialize" in fn_name
+
+
+def _is_bytes_literal(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and isinstance(node.value, bytes)
+
+
+@register_rule(
+    "WIRE-COPY",
+    "no .tobytes()/bytes(...)/b\"\".join on tensor payloads inside the "
+    "client cores' serialize paths (pragma the single required copy)")
+def check(project: Project):
+    for f in project.files:
+        if f.tree is None or not _CORE_SEGMENT.search(
+                f.relpath.replace("\\", "/")):
+            continue
+        for _cls, fn in iter_functions(f.tree):
+            if not _on_serialize_path(fn.name):
+                continue
+            for node in iter_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute) \
+                        and func.attr == "tobytes":
+                    yield Finding(
+                        "WIRE-COPY", f.relpath, node.lineno,
+                        f".tobytes() copies the whole tensor payload "
+                        f"(serialize path {fn.name}); hand off a "
+                        "memoryview (utils.as_wire_memoryview) or pragma "
+                        "the one required materialization",
+                        symbol=f.symbol_at(node.lineno))
+                elif isinstance(func, ast.Name) and func.id == "bytes" \
+                        and node.args \
+                        and not isinstance(node.args[0], ast.Constant):
+                    yield Finding(
+                        "WIRE-COPY", f.relpath, node.lineno,
+                        f"bytes(...) copies the payload (serialize path "
+                        f"{fn.name}); keep the buffer/memoryview or "
+                        "pragma the one required materialization",
+                        symbol=f.symbol_at(node.lineno))
+                elif isinstance(func, ast.Attribute) \
+                        and func.attr == "join" \
+                        and _is_bytes_literal(func.value):
+                    yield Finding(
+                        "WIRE-COPY", f.relpath, node.lineno,
+                        f"bytes-join of per-element chunks (serialize "
+                        f"path {fn.name}); build into one preallocated "
+                        "buffer (utils.serialize_byte_tensor_raw) or "
+                        "pragma the single final gather",
+                        symbol=f.symbol_at(node.lineno))

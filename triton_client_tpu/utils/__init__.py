@@ -13,9 +13,12 @@ TPU-first deviations (deliberate, documented):
   bfloat16 array that feeds straight into ``jax.numpy`` with no conversion,
   keeping the MXU-native dtype end to end.  Float32 arrays are still accepted
   on the serialization side for drop-in compatibility.
-* BYTES (de)serialization uses memoryview-based loops with a single join;
-  the wire format is unchanged
-  (``<uint32 little-endian length><raw bytes>`` per element, row-major).
+* BYTES serialization builds into ONE preallocated buffer (length prefixes
+  packed in place) instead of joining per-element chunks; the wire format is
+  unchanged (``<uint32 little-endian length><raw bytes>`` per element,
+  row-major).  BF16 serialization returns a uint8 *view* over the source
+  array where contiguity allows — zero-copy, see the ownership note on
+  :func:`serialize_bf16_tensor`.
 """
 
 from __future__ import annotations
@@ -38,10 +41,13 @@ __all__ = [
     "np_to_triton_dtype",
     "triton_to_np_dtype",
     "serialize_byte_tensor",
+    "serialize_byte_tensor_raw",
     "deserialize_bytes_tensor",
     "serialize_bf16_tensor",
     "deserialize_bf16_tensor",
     "serialized_byte_size",
+    "as_wire_memoryview",
+    "wire_length",
     "raise_error",
 ]
 
@@ -156,41 +162,62 @@ def _as_flat_object_rowmajor(input_tensor: np.ndarray) -> np.ndarray:
     return input_tensor.flatten(order="C")
 
 
+def _encode_bytes_element(obj) -> bytes:
+    """One BYTES element as raw bytes.  ``bytes`` (including its
+    ``np.bytes_`` subclass) passes through by reference — no copy here;
+    the single copy into the wire buffer happens in
+    :func:`serialize_byte_tensor_raw`."""
+    if isinstance(obj, bytes):
+        return obj
+    if isinstance(obj, (bytearray, memoryview)):
+        return bytes(obj)
+    if isinstance(obj, str):
+        return obj.encode("utf-8")
+    return str(obj).encode("utf-8")
+
+
+def serialize_byte_tensor_raw(input_tensor: np.ndarray) -> bytearray:
+    """Serialize a BYTES tensor into ONE preallocated wire buffer.
+
+    Two passes: encode the elements (str→utf-8; bytes pass by reference),
+    then pack ``<uint32 length><element>`` pairs into a single preallocated
+    ``bytearray`` — each element's payload is copied exactly once, with no
+    per-element chunk objects or join.  Callers that need an ndarray wrap
+    the result with ``np.frombuffer`` (zero-copy); callers that need the
+    raw buffer (the HTTP body gather) use it directly.
+    """
+    if input_tensor.dtype != np.dtype(np.object_) \
+            and input_tensor.dtype.kind not in ("S", "U"):
+        raise_error("cannot serialize bytes tensor: invalid datatype")
+    if input_tensor.size == 0:
+        return bytearray()
+    flat = _as_flat_object_rowmajor(input_tensor)
+    encoded = [_encode_bytes_element(obj) for obj in flat]
+    total = 4 * len(encoded) + sum(len(b) for b in encoded)
+    buf = bytearray(total)
+    offset = 0
+    for b in encoded:
+        n = len(b)
+        struct.pack_into("<I", buf, offset, n)
+        offset += 4
+        buf[offset:offset + n] = b
+        offset += n
+    return buf
+
+
 def serialize_byte_tensor(input_tensor: np.ndarray) -> Optional[np.ndarray]:
     """Serialize a BYTES tensor into the v2 wire format.
 
     Wire format (reference utils/__init__.py:193-246): row-major concatenation
     of ``<uint32 little-endian length><element bytes>`` per element.  Accepts
     object arrays of bytes/str, and ``S``/``U`` typed arrays.  Returns a 1-D
-    uint8 array wrapping the serialized buffer (``np.frombuffer`` view).
+    uint8 array viewing the preallocated serialization buffer (no extra
+    copy — see :func:`serialize_byte_tensor_raw`).
     """
     if input_tensor.size == 0:
         return np.empty([0], dtype=np.object_)
-
-    if input_tensor.dtype not in (np.dtype(np.object_),) and input_tensor.dtype.kind not in (
-        "S",
-        "U",
-    ):
-        raise_error("cannot serialize bytes tensor: invalid datatype")
-
-    flat = _as_flat_object_rowmajor(input_tensor)
-    pieces = []
-    append = pieces.append
-    for obj in flat:
-        if isinstance(obj, (bytes, bytearray, memoryview)):
-            b = bytes(obj)
-        elif isinstance(obj, str):
-            b = obj.encode("utf-8")
-        elif isinstance(obj, np.str_):
-            b = str(obj).encode("utf-8")
-        elif isinstance(obj, np.bytes_):
-            b = bytes(obj)
-        else:
-            b = str(obj).encode("utf-8")
-        append(struct.pack("<I", len(b)))
-        append(b)
-    joined = b"".join(pieces)
-    return np.frombuffer(joined, dtype=np.uint8)
+    return np.frombuffer(serialize_byte_tensor_raw(input_tensor),
+                         dtype=np.uint8)
 
 
 def deserialize_bytes_tensor(encoded_tensor: bytes, count: Optional[int] = None) -> np.ndarray:
@@ -226,13 +253,19 @@ def serialize_bf16_tensor(input_tensor: np.ndarray) -> np.ndarray:
     ``astype(ml_dtypes.bfloat16)`` themselves before serializing.
     """
     if _BF16_NP is not None and input_tensor.dtype == _BF16_NP:
+        # zero-copy: a uint8 VIEW over the (contiguous) source array.  The
+        # caller owns the backing memory — mutating the source before the
+        # bytes are consumed mutates the wire payload (fast-path contract,
+        # see ARCHITECTURE.md "Client wire fast path").
         arr = np.ascontiguousarray(input_tensor)
-        return np.frombuffer(arr.tobytes(), dtype=np.uint8)
+        return arr.view(np.uint8).reshape(-1)
     if input_tensor.dtype != np.dtype(np.float32):
         raise_error("cannot serialize bf16 tensor: invalid datatype")
-    # Truncate each f32 to its top 2 bytes (little-endian layout).
+    # Truncate each f32 to its top 2 bytes (little-endian layout).  as_u16
+    # is freshly computed (owned), so the uint8 view aliases nothing of the
+    # caller's.
     as_u16 = (np.ascontiguousarray(input_tensor).view(np.uint32) >> 16).astype(np.uint16)
-    return np.frombuffer(as_u16.tobytes(), dtype=np.uint8)
+    return as_u16.view(np.uint8).reshape(-1)
 
 
 def deserialize_bf16_tensor(encoded_tensor: bytes) -> np.ndarray:
@@ -246,6 +279,27 @@ def deserialize_bf16_tensor(encoded_tensor: bytes) -> np.ndarray:
         return np.frombuffer(encoded_tensor, dtype=_BF16_NP)
     as_u16 = np.frombuffer(encoded_tensor, dtype=np.uint16)
     return (as_u16.astype(np.uint32) << 16).view(np.float32)
+
+
+def as_wire_memoryview(arr: np.ndarray) -> memoryview:
+    """A flat ``B``-format memoryview over ``arr``'s wire bytes.
+
+    Zero-copy when ``arr`` is C-contiguous (the common case); otherwise one
+    contiguous staging copy.  The view keeps the exporting array alive, and
+    — fast-path ownership contract — the caller must not mutate the source
+    array between attaching it to a request and the request being sent.
+    """
+    a = arr if arr.flags["C_CONTIGUOUS"] else np.ascontiguousarray(arr)
+    return memoryview(a).cast("B")
+
+
+def wire_length(raw) -> int:
+    """Byte length of a wire payload that may be ``bytes``, ``bytearray``
+    or a (cast-to-B) ``memoryview`` — ``len()`` for all three, but spelled
+    once so a non-B memoryview slipping in fails loudly here."""
+    if isinstance(raw, memoryview):
+        return raw.nbytes
+    return len(raw)
 
 
 def serialized_byte_size(np_array: np.ndarray) -> int:
