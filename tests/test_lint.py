@@ -1089,14 +1089,47 @@ class TestWireCopy:
         assert sorted(fd.line for fd in found) == [3, 4]
 
     def test_outside_core_or_serialize_path_passes(self, tmp_path):
-        # same calls, but in a server file and in a non-serialize fn
-        write(tmp_path, "server/grpc_server.py", """
+        # same calls, but in a non-serialize fn (client core), an
+        # out-of-scope server module, and a decode-path server fn
+        write(tmp_path, "server/core.py", """
             def get_inference_request(t):
+                return t.tobytes()
+            """)
+        write(tmp_path, "server/http_server.py", """
+            def _decode_request(t):
                 return t.tobytes()
             """)
         write(tmp_path, "http/_client.py", """
             def close(self, t):
                 return t.tobytes()
+            """)
+        assert lint_dir(tmp_path, "WIRE-COPY") == []
+
+    def test_server_serialize_paths_in_scope(self, tmp_path):
+        # ISSUE 11: the server frontends' serialize paths are gated like
+        # the client cores'
+        write(tmp_path, "server/grpc_server.py", """
+            def _encode_pb_response(t):
+                return t.tobytes()
+            """)
+        write(tmp_path, "server/wire.py", """
+            def encode_http_response(parts):
+                return b"".join(parts)
+            """)
+        write(tmp_path, "server/http_server.py", """
+            def build_http_response_header(t):
+                return bytes(t.view())
+            """)
+        found = lint_dir(tmp_path, "WIRE-COPY")
+        assert sorted(f.path for f in found) == [
+            "server/grpc_server.py", "server/http_server.py",
+            "server/wire.py"]
+
+    def test_server_pragma_with_reason_suppresses(self, tmp_path):
+        write(tmp_path, "server/wire.py", """
+            def stamp(parts):
+                # tpu-lint: disable=WIRE-COPY the one transport gather
+                return b"".join(parts)
             """)
         assert lint_dir(tmp_path, "WIRE-COPY") == []
 

@@ -38,6 +38,18 @@ def main() -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--http-port", type=int, default=8000)
     parser.add_argument("--grpc-port", type=int, default=8001)
+    parser.add_argument("--frontends", type=int, default=1, metavar="N",
+                        help="number of frontend processes sharing the "
+                        "HTTP/gRPC ports via SO_REUSEPORT (the kernel "
+                        "load-balances accepted connections), so the "
+                        "serving data plane scales past one Python "
+                        "process's GIL.  Each worker exposes its own "
+                        "metrics port at --metrics-port + index; client "
+                        "shared-memory registrations are shared across "
+                        "workers through a manifest directory.  Default 1 "
+                        "(single process, no SO_REUSEPORT)")
+    parser.add_argument("--frontend-worker", type=int, default=None,
+                        help=argparse.SUPPRESS)  # internal: worker index
     parser.add_argument("--verbose", "-v", action="store_true")
     parser.add_argument("--ssl-certfile", default=None,
                         help="serve HTTPS/secure-gRPC with this PEM cert chain")
@@ -176,6 +188,14 @@ def main() -> None:
     args = parser.parse_args()
     if args.serve_mesh is not None:
         os.environ["TRITON_TPU_SERVE_MESH"] = args.serve_mesh
+    if args.frontends < 1:
+        parser.error("--frontends must be >= 1")
+    worker_index = args.frontend_worker
+    if args.frontends > 1 and worker_index is None:
+        # supervisor: spawn N frontend workers sharing the ports via
+        # SO_REUSEPORT and babysit them — no models load in this process
+        _run_supervisor(parser, args)
+        return
     from ..parallel import initialize_multihost
 
     if (args.num_processes is not None or args.process_id is not None) \
@@ -269,6 +289,12 @@ def main() -> None:
         print(f"SLO: {name} p99<={objective.p99_ms:g}ms "
               f"availability={objective.availability:g}")
 
+    # per-worker metrics port: the main ports are kernel-balanced across
+    # workers, so the dedicated metrics/debug port is the one per-process
+    # surface — worker i serves it at base + i
+    metrics_port = ((args.metrics_port + (worker_index or 0))
+                    if args.metrics_port else None)
+
     async def serve():
         import signal
 
@@ -281,13 +307,17 @@ def main() -> None:
         # by its finalizer, silently closing the port
         frontends = await start_frontends(
             core, args.host, args.http_port, args.grpc_port, tls=tls,
-            metrics_port=args.metrics_port or None)
+            metrics_port=metrics_port,
+            reuse_port=worker_index is not None)
         scheme = "https" if tls else "http"
-        metrics = (f" metrics={args.host}:{args.metrics_port}"
-                   if args.metrics_port else "")
+        metrics = (f" metrics={args.host}:{metrics_port}"
+                   if metrics_port else "")
+        worker = (f" [frontend worker {worker_index}/{args.frontends}]"
+                  if worker_index is not None else "")
         print(
             f"serving v2 protocol: {scheme}={args.host}:{args.http_port} "
-            f"grpc{'s' if tls else ''}={args.host}:{args.grpc_port}{metrics}"
+            f"grpc{'s' if tls else ''}={args.host}:{args.grpc_port}"
+            f"{metrics}{worker}"
         )
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -306,10 +336,102 @@ def main() -> None:
         await core.shutdown(drain_s=max(0.0, args.drain_timeout))
         await stop_frontends(*frontends)
 
+    # optional uvloop (TRITON_TPU_UVLOOP=1): the same env gate the aio
+    # clients honor now accelerates the server's event loop too — both
+    # ends of the socket.  Graceful stdlib fallback when not installed.
+    from .._uvloop import maybe_install_uvloop
+
+    if maybe_install_uvloop():
+        print("event loop: uvloop")
     try:
         asyncio.run(serve())
     except KeyboardInterrupt:
         pass  # second ^C mid-drain, or non-unix loop without handlers
+
+
+def _run_supervisor(parser, args) -> None:
+    """``--frontends N`` parent: spawn N workers that re-exec this module
+    with ``--frontend-worker i``, each binding the SAME HTTP/gRPC ports
+    with SO_REUSEPORT (the kernel balances accepted connections across
+    them).  Shutdown reuses the PR 4 drain machinery per worker: signals
+    are forwarded and every worker runs its own graceful drain."""
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    if not hasattr(socket, "SO_REUSEPORT"):
+        parser.error("--frontends > 1 requires SO_REUSEPORT (Linux)")
+    if (args.coordinator_address or args.num_processes is not None
+            or args.process_id is not None):
+        parser.error("--frontends > 1 is incompatible with multi-host "
+                     "serving (each host runs one server process)")
+    # each worker hosts a full InferenceCore replica: host-placed models
+    # replicate cheaply, but a single accelerator cannot be opened by N
+    # processes — keep TPU serving on --frontends 1 (the co-located
+    # zero-copy topology) unless the platform says otherwise
+    if os.environ.get("JAX_PLATFORMS", "").lower() not in ("cpu", "cuda"):
+        print("warning: --frontends > 1 replicates the core per process; "
+              "device-placed models need JAX_PLATFORMS=cpu workers or a "
+              "single frontend process", file=sys.stderr)
+    # client shm registrations land on ONE kernel-picked worker; the
+    # manifest directory lets every sibling resolve them (server/shm.py)
+    manifest = tempfile.mkdtemp(prefix="tc-tpu-shm-manifest-")
+    env = dict(os.environ, TRITON_TPU_SHM_MANIFEST=manifest)
+    procs = []
+    try:
+        for i in range(args.frontends):
+            cmd = [sys.executable, "-m", "triton_client_tpu.server",
+                   *sys.argv[1:], "--frontend-worker", str(i)]
+            procs.append(subprocess.Popen(cmd, env=env))
+        print(f"frontend supervisor: {args.frontends} workers sharing "
+              f"http={args.host}:{args.http_port} "
+              f"grpc={args.host}:{args.grpc_port} (SO_REUSEPORT)")
+        state = {"stopping": False}
+
+        def forward(signum, _frame):
+            # graceful drain per worker: each one sheds new work (503 +
+            # Retry-After, readiness false) and finishes in-flight
+            # requests inside its own --drain-timeout
+            state["stopping"] = True
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        p.send_signal(signum)
+                    except OSError:
+                        pass
+
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, forward)
+        rc = 0
+        while any(p.poll() is None for p in procs):
+            exited = [p for p in procs if p.poll() is not None]
+            if exited and not state["stopping"]:
+                # a worker died (or finished) on its own: fail fast —
+                # drain the siblings rather than serve degraded at 1/N
+                rc = max((p.returncode or 0) for p in exited)
+                state["stopping"] = True
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+            time.sleep(0.2)
+        # a signal-killed worker (negative returncode) is a failure, not
+        # an exotic success
+        rc = max([rc] + [1 if (p.returncode or 0) < 0 else (p.returncode or 0)
+                         for p in procs])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(manifest, ignore_errors=True)
+    if rc:
+        sys.exit(rc)
 
 
 if __name__ == "__main__":

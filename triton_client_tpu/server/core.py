@@ -507,6 +507,15 @@ class InferenceCore:
         self._batchers: Dict[str, _DynamicBatcher] = {}
         self._inline_profiles: Dict[str, _InlineProfile] = {}
         self.response_cache = _ResponseCache()
+        # server wire fast path (server/wire.py): per-(model, output-set)
+        # compiled response templates, one cache per frontend protocol.
+        # Keys carry the registry generation, so a reload can never stamp
+        # through a stale skeleton; retire_name_caches drops entries
+        # eagerly on reload/unload.
+        from .wire import ResponseTemplateCache
+
+        self.http_wire_templates = ResponseTemplateCache()
+        self.grpc_wire_templates = ResponseTemplateCache()
         # always-on per-request recording + tail-latency auto-capture;
         # the tracer hands every armed context's completion to it
         self.flight_recorder = FlightRecorder()
@@ -1141,6 +1150,11 @@ class InferenceCore:
         # must not go backwards on a reload)
         self.slo.invalidate(name)
         self.device_stats.forget_model(name)
+        # compiled response templates froze the old instance's output
+        # specs; the generation key already bars stale stamps — this
+        # frees the entries without waiting for cap eviction
+        self.http_wire_templates.retire(name)
+        self.grpc_wire_templates.retire(name)
 
     async def shutdown(self, drain_s: float = 5.0) -> None:
         """Graceful drain, then teardown: stop accepting (new requests get

@@ -3,6 +3,14 @@
 Both the CLI (``__main__.py``) and the in-process harness (``testing.py``)
 bring up the same pair of frontends — aiohttp HTTP site + grpc.aio server,
 optionally behind TLS — so the wiring lives here once.
+
+``reuse_port=True`` binds both listeners with ``SO_REUSEPORT`` — the
+multi-process frontend topology (``--frontends N``): N worker processes
+bind the SAME ports and the kernel load-balances accepted connections
+across them, which is what lets the serving data plane scale past one
+Python process's GIL.  Single-process callers leave it off so an
+accidental double-bind fails loudly instead of silently splitting
+traffic.
 """
 
 from __future__ import annotations
@@ -24,6 +32,7 @@ async def start_frontends(
     grpc_port: int,
     tls: Optional[TLSConfig] = None,
     metrics_port: Optional[int] = None,
+    reuse_port: bool = False,
 ) -> Tuple[web.AppRunner, "object", Optional[web.AppRunner]]:
     """Start the HTTP and gRPC frontends (plus an optional dedicated
     Prometheus port, Triton-style :8002); returns
@@ -32,7 +41,8 @@ async def start_frontends(
     await runner.setup()
     site = web.TCPSite(
         runner, host, http_port,
-        ssl_context=tls.ssl_context() if tls else None)
+        ssl_context=tls.ssl_context() if tls else None,
+        reuse_port=reuse_port or None)
     await site.start()
     metrics_runner = None
     try:
@@ -41,10 +51,14 @@ async def start_frontends(
 
             metrics_runner = web.AppRunner(build_metrics_app(core))
             await metrics_runner.setup()
+            # the metrics port is per-process even under --frontends N
+            # (each worker offsets it by its index), so it never needs
+            # reuse_port — and triton-top can address ONE worker with it
             await web.TCPSite(
                 metrics_runner, host, metrics_port,
                 ssl_context=tls.ssl_context() if tls else None).start()
-        grpc_server = build_grpc_server(core, f"{host}:{grpc_port}", tls=tls)
+        grpc_server = build_grpc_server(core, f"{host}:{grpc_port}", tls=tls,
+                                        reuse_port=reuse_port)
         await grpc_server.start()
     except BaseException:
         if metrics_runner is not None:

@@ -21,12 +21,7 @@ import numpy as np
 
 from ..protocol import inference_pb2 as pb
 from ..protocol.service import add_GRPCInferenceServiceServicer_to_server
-from ..utils import (
-    deserialize_bytes_tensor,
-    serialize_bf16_tensor,
-    serialize_byte_tensor,
-    triton_to_np_dtype,
-)
+from ..utils import deserialize_bytes_tensor, triton_to_np_dtype
 from .core import InferenceCore
 from .log import log_off_loop
 from .model import datatype_to_pb
@@ -34,24 +29,10 @@ from .qos import tenant_from_headers
 from .types import (InferError, InferRequest, InputTensor,
                     RequestedOutput, ShmRef, apply_request_deadline,
                     apply_request_priority, reshape_input)
-
-
-def pb_param_to_py(p: pb.InferParameter):
-    which = p.WhichOneof("parameter_choice")
-    return getattr(p, which) if which else None
-
-
-def py_to_pb_param(value) -> pb.InferParameter:
-    p = pb.InferParameter()
-    if isinstance(value, bool):
-        p.bool_param = value
-    elif isinstance(value, int):
-        p.int64_param = value
-    elif isinstance(value, float):
-        p.double_param = value
-    else:
-        p.string_param = str(value)
-    return p
+# the pb param codecs live in wire.py (shared with the response
+# templates); re-exported here for the rest of the server package
+from .wire import (build_pb_response, encode_pb_response, pb_param_to_py,
+                   py_to_pb_param)
 
 
 def _read_trace_metadata(req: InferRequest, context) -> None:
@@ -196,34 +177,10 @@ def _contents_to_array(contents, datatype: str, shape, name: str) -> np.ndarray:
         np.array(values, dtype=triton_to_np_dtype(datatype)), shape, name)
 
 
-def _encode_pb_response(resp) -> pb.ModelInferResponse:
-    out = pb.ModelInferResponse(
-        model_name=resp.model_name,
-        model_version=resp.model_version or "1",
-        id=resp.id,
-    )
-    for k, v in resp.parameters.items():
-        out.parameters[k].CopyFrom(py_to_pb_param(v))
-    for t in resp.outputs:
-        pbt = out.outputs.add()
-        pbt.name = t.name
-        pbt.datatype = t.datatype
-        pbt.shape.extend(int(s) for s in t.shape)
-        if t.shm is not None:
-            pbt.parameters["shared_memory_region"].string_param = t.shm.region_name
-            pbt.parameters["shared_memory_byte_size"].int64_param = t.shm.byte_size
-            if t.shm.offset:
-                pbt.parameters["shared_memory_offset"].int64_param = t.shm.offset
-            out.raw_output_contents.append(b"")
-        else:
-            if t.datatype == "BYTES":
-                blob = serialize_byte_tensor(t.data).tobytes()
-            elif t.datatype == "BF16":
-                blob = serialize_bf16_tensor(t.data).tobytes()
-            else:
-                blob = np.ascontiguousarray(t.data).tobytes()
-            out.raw_output_contents.append(blob)
-    return out
+# Response encoding lives in server/wire.py: ``build_pb_response`` is the
+# slow path (streams use it — their parameter flags vary per frame),
+# ``encode_pb_response`` adds the per-(model, output-set) template fast
+# path the unary RPC rides.
 
 
 class InferenceServicer:
@@ -557,7 +514,12 @@ class InferenceServicer:
         trace = resp.trace
         try:
             t_ser0 = time.monotonic_ns() if trace is not None else 0
-            pb_resp = _encode_pb_response(resp)
+            # wire fast path: template-stamped response message (see
+            # server/wire.py) — the one remaining payload copy is the
+            # protobuf-required bytes materialization
+            pb_resp = encode_pb_response(
+                resp, cache=self._core.grpc_wire_templates,
+                generation=self._core.registry.generation(resp.model_name))
             if trace is not None:
                 t_ser1 = time.monotonic_ns()
                 trace.add_span("SERIALIZE", t_ser0, t_ser1)
@@ -596,7 +558,7 @@ class InferenceServicer:
                     if is_empty_final and not enable_empty_final:
                         continue
                     yield pb.ModelStreamInferResponse(
-                        infer_response=_encode_pb_response(resp)
+                        infer_response=build_pb_response(resp)
                     )
             except InferError as e:
                 # the bidi wire has no per-message grpc code, so the
@@ -625,12 +587,19 @@ def _grpc_code(e: InferError) -> grpc.StatusCode:
 
 
 def build_grpc_server(
-    core: InferenceCore, address: str = "[::]:8001", tls=None
+    core: InferenceCore, address: str = "[::]:8001", tls=None,
+    reuse_port: bool = False,
 ) -> "grpc.aio.Server":
     server = grpc.aio.server(
         options=[
             ("grpc.max_send_message_length", -1),
             ("grpc.max_receive_message_length", -1),
+            # explicit either way: ON for the multi-process frontend
+            # topology (N workers share the port, kernel balances
+            # accepts), OFF for single-process so a double-bind fails
+            # loudly instead of silently splitting traffic (gRPC's
+            # Linux default is ON)
+            ("grpc.so_reuseport", 1 if reuse_port else 0),
         ]
     )
     add_GRPCInferenceServiceServicer_to_server(InferenceServicer(core), server)

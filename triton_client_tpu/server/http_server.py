@@ -17,23 +17,18 @@ import json
 import math
 import time
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 from aiohttp import web
 
-from ..utils import (
-    deserialize_bytes_tensor,
-    serialize_bf16_tensor,
-    serialize_byte_tensor,
-    triton_to_np_dtype,
-)
+from ..utils import deserialize_bytes_tensor, triton_to_np_dtype
 from .core import InferenceCore
 from .log import log_off_loop
 from .qos import tenant_from_headers
 from .types import (InferError, InferRequest, InputTensor,
                     RequestedOutput, ShmRef, apply_request_deadline,
                     apply_request_priority, reshape_input)
+from .wire import encode_http_response, sse_frame
 
 _HEADER_LEN = "Inference-Header-Content-Length"
 _REQUEST_ID_HDR = "triton-request-id"
@@ -128,10 +123,17 @@ async def _read_json(request: web.Request, default=None, expect_object=True):
 
 
 def build_metrics_app(core: InferenceCore) -> web.Application:
-    """Minimal app exposing only ``/metrics`` — for the dedicated
-    Prometheus port (Triton convention: :8002)."""
+    """Minimal app for the dedicated Prometheus port (Triton convention:
+    :8002): ``/metrics`` plus the two debug snapshots.  Under
+    ``--frontends N`` each worker gets its own metrics port (base + worker
+    index), so this app is the one per-PROCESS observability surface —
+    pointing ``triton-top --url`` at each worker's metrics port gives the
+    per-process view that the kernel-balanced main port can't (every poll
+    there lands on a random worker)."""
     app = web.Application()
     app.router.add_get("/metrics", _h(core, _metrics))
+    app.router.add_get("/v2/debug/flight_recorder", _h(core, _flight_recorder))
+    app.router.add_get("/v2/debug/device_stats", _h(core, _device_stats))
     return app
 
 
@@ -401,12 +403,13 @@ async def _generate_stream(core, request):
     async def write_frame(stream, resp):
         if not resp.outputs:
             return  # final-flagged empty frame ends decoupled streams
-        payload = response_to_json(name, version, resp)
-        await stream.write(f"data: {payload}\n\n".encode())
+        # precompiled envelope affixes: only the payload is encoded per
+        # event, not the whole "data: ...\n\n" frame re-formatted
+        await stream.write(sse_frame(response_to_json(name, version, resp)))
 
     return await sse_stream(
         request, core.infer_stream(req), write_frame,
-        on_error=lambda e: f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+        on_error=lambda e: sse_frame(json.dumps({"error": str(e)})))
 
 
 async def _flight_recorder(core, request):
@@ -563,7 +566,13 @@ async def _infer(core, request: web.Request) -> web.Response:
         default_binary = bool(
             req.parameters.get("binary_data_output", header_len is not None)
         )
-        payload, json_len = _encode_response(resp, req, default_binary)
+        # wire fast path: per-(model, output-set) response templates stamp
+        # only id / batch dims / payload sizes; tensor bytes ride zero-copy
+        # memoryview segments into one gather (see server/wire.py)
+        payload, json_len = encode_http_response(
+            resp, {o.name: o for o in req.outputs}, default_binary,
+            cache=core.http_wire_templates,
+            generation=core.registry.generation(resp.model_name))
         if trace is not None:
             t_ser1 = time.monotonic_ns()
             trace.add_span("SERIALIZE", t_ser0, t_ser1)
@@ -724,59 +733,5 @@ def _flatten(x):
         yield x
 
 
-def _encode_response(resp, req: InferRequest, default_binary: bool) -> Tuple[bytes, int]:
-    requested = {o.name: o for o in req.outputs}
-    out_json: List[dict] = []
-    blobs: List[bytes] = []
-    for out in resp.outputs:
-        entry: Dict[str, Any] = {
-            "name": out.name,
-            "datatype": out.datatype,
-            "shape": list(out.shape),
-        }
-        spec = requested.get(out.name)
-        if out.shm is not None:
-            entry["parameters"] = {
-                "shared_memory_region": out.shm.region_name,
-                "shared_memory_byte_size": out.shm.byte_size,
-            }
-            if out.shm.offset:
-                entry["parameters"]["shared_memory_offset"] = out.shm.offset
-        else:
-            binary = spec.binary_data if spec is not None else default_binary
-            if binary:
-                blob = _array_to_bytes(out.data, out.datatype)
-                entry.setdefault("parameters", {})["binary_data_size"] = len(blob)
-                blobs.append(blob)
-            else:
-                entry["data"] = _array_to_json(out.data, out.datatype)
-        out_json.append(entry)
-    header: Dict[str, Any] = {
-        "model_name": resp.model_name,
-        "model_version": resp.model_version or "1",
-        "outputs": out_json,
-    }
-    if resp.id:
-        header["id"] = resp.id
-    if resp.parameters:
-        header["parameters"] = resp.parameters
-    json_bytes = json.dumps(header).encode("utf-8")
-    return json_bytes + b"".join(blobs), len(json_bytes)
-
-
-def _array_to_bytes(arr: np.ndarray, datatype: str) -> bytes:
-    if datatype == "BYTES":
-        return serialize_byte_tensor(arr).tobytes()
-    if datatype == "BF16":
-        return serialize_bf16_tensor(arr).tobytes()
-    return np.ascontiguousarray(arr).tobytes()
-
-
-def _array_to_json(arr: np.ndarray, datatype: str):
-    if datatype == "BYTES":
-        flat = [
-            x.decode("utf-8") if isinstance(x, (bytes, bytearray)) else str(x)
-            for x in arr.flatten(order="C")
-        ]
-        return flat
-    return np.asarray(arr, dtype=np.float64 if datatype == "BF16" else None).flatten().tolist()
+# Response encoding lives in server/wire.py (shared header builder +
+# per-(model, output-set) templates + zero-copy readback segments).

@@ -26,6 +26,7 @@ from aiohttp import web
 from .core import InferenceCore
 from .qos import tenant_from_headers
 from .types import InferError, InferRequest, InputTensor, RequestedOutput
+from .wire import sse_frame
 
 _COUNTER = iter(range(1, 1 << 62))
 _MAX_N = 16        # choices per request — each holds a decode slot
@@ -596,7 +597,7 @@ async def _run(core, request, chat: bool):
             # OpenAI stream_options.include_usage: data chunks carry
             # usage: null; the final usage chunk below carries the totals
             frame["usage"] = None
-        await stream.write(f"data: {json.dumps(frame)}\n\n".encode())
+        await stream.write(sse_frame(json.dumps(frame)))
 
     async def epilogue(stream):
         if pr.include_usage:
@@ -607,13 +608,12 @@ async def _run(core, request, chat: bool):
                 "completion_tokens": completion_total[0],
                 "total_tokens": p_toks + completion_total[0],
             }
-            await stream.write(f"data: {json.dumps(frame)}\n\n".encode())
-        await stream.write(b"data: [DONE]\n\n")
+            await stream.write(sse_frame(json.dumps(frame)))
+        await stream.write(sse_frame("[DONE]"))
 
     def on_error(e):
-        err = json.dumps({"error": {"message": str(e),
-                                    "type": "invalid_request_error"}})
-        return f"data: {err}\n\n".encode()
+        return sse_frame(json.dumps({"error": {
+            "message": str(e), "type": "invalid_request_error"}}))
 
     return await sse_stream(request, merged(), write_frame,
                             on_error, epilogue=epilogue)

@@ -18,9 +18,12 @@ surveyed at http/_client.py:974-1203 and grpc/_client.py:1240-1443):
 
 from __future__ import annotations
 
+import base64
 import json
+import os
 import threading
 import time
+import urllib.parse
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -31,6 +34,95 @@ from ..utils import shared_memory as sysshm
 from ..utils import triton_to_np_dtype
 from .types import InferError, ShmRef
 
+# -- multi-process region manifest -----------------------------------------
+# SO_REUSEPORT frontends (--frontends N) are N separate processes behind
+# one port: a client's Register RPC lands on whichever worker the kernel
+# picked, but its Infer RPCs land on ANY worker.  The registries therefore
+# publish registrations into a manifest directory (TRITON_TPU_SHM_MANIFEST,
+# set by the supervisor) — one JSON file per region, written atomically —
+# and resolve unknown region names from it lazily.  This works because the
+# underlying transports are attach-by-key from any process: system shm via
+# shm_open, xla regions via their host-shm STAGING path (the raw handle
+# always carries staging_key; only the in-process zero-copy slot is
+# process-local).  Unregister removes the manifest entry and the local
+# attachment of the worker that served it; other workers' already-attached
+# handles detach lazily (documented multi-process semantics).
+
+
+def _manifest_dir() -> Optional[str]:
+    return os.environ.get("TRITON_TPU_SHM_MANIFEST") or None
+
+
+def _manifest_path(kind: str, name: str) -> Optional[str]:
+    d = _manifest_dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"{kind}_{urllib.parse.quote(name, safe='')}.json")
+
+
+def _manifest_write(kind: str, name: str, payload: dict) -> None:
+    path = _manifest_path(kind, name)
+    if path is None:
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # atomic publish: readers never see a torn file
+    except OSError:
+        pass  # manifest is best-effort; the local registration stands
+
+
+def _manifest_remove(kind: str, name: Optional[str]) -> None:
+    d = _manifest_dir()
+    if d is None:
+        return
+    try:
+        if name:
+            paths = [_manifest_path(kind, name)]
+        else:
+            paths = [os.path.join(d, fn) for fn in os.listdir(d)
+                     if fn.startswith(f"{kind}_") and fn.endswith(".json")]
+        for p in paths:
+            if p:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
+def _manifest_load(kind: str, name: str) -> Optional[dict]:
+    path = _manifest_path(kind, name)
+    if path is None:
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _manifest_names(kind: str) -> Dict[str, dict]:
+    d = _manifest_dir()
+    if d is None:
+        return {}
+    out: Dict[str, dict] = {}
+    try:
+        for fn in os.listdir(d):
+            if not (fn.startswith(f"{kind}_") and fn.endswith(".json")):
+                continue
+            name = urllib.parse.unquote(fn[len(kind) + 1:-5])
+            try:
+                with open(os.path.join(d, fn)) as f:
+                    out[name] = json.load(f)
+            except (OSError, ValueError):
+                continue
+    except OSError:
+        pass
+    return out
+
 
 @dataclass
 class SystemShmRegion:
@@ -39,6 +131,12 @@ class SystemShmRegion:
     offset: int
     byte_size: int
     handle: object  # SharedMemoryRegionHandle attached by the server
+    # the manifest payload this attachment was derived from, for
+    # manifest-SOURCED (sibling-worker) attachments only: revalidated on
+    # every resolve so an unregister/re-register served by another
+    # worker can never leave this one routing tensors through a stale
+    # mapping (None = registered directly through this worker's RPC)
+    manifest: Optional[dict] = None
 
 
 class SystemShmRegistry:
@@ -46,17 +144,35 @@ class SystemShmRegistry:
         self._regions: Dict[str, SystemShmRegion] = {}
         self._lock = threading.Lock()
 
-    def register(self, name: str, key: str, offset: int, byte_size: int) -> None:
+    def register(self, name: str, key: str, offset: int, byte_size: int,
+                 publish: bool = True, manifest: Optional[dict] = None) -> None:
+        if publish and manifest is None and _manifest_dir() is not None:
+            # direct registrations are manifest-tracked too: a later
+            # unregister/re-register served by a SIBLING worker must
+            # invalidate this worker's attachment at the next resolve
+            manifest = {"key": key, "offset": offset, "byte_size": byte_size}
         with self._lock:
-            if name in self._regions:
-                raise InferError(
-                    f"shared memory region '{name}' already in manager", http_status=400
-                )
+            stale = self._regions.get(name)
+            if stale is not None:
+                # a manifest-tracked attachment may be stale (the region
+                # was unregistered + re-registered through a sibling):
+                # a direct re-register RPC evicts it instead of failing a
+                # legitimately free name
+                if publish and stale.manifest is not None:
+                    self._regions.pop(name)
+                    sysshm.destroy_shared_memory_region(stale.handle)
+                else:
+                    raise InferError(
+                        f"shared memory region '{name}' already in manager", http_status=400
+                    )
             try:
                 handle = sysshm.attach_shared_memory_region(name, key, byte_size, offset)
             except sysshm.SharedMemoryException as e:
                 raise InferError(f"failed to register shared memory region '{name}': {e}")
-            self._regions[name] = SystemShmRegion(name, key, offset, byte_size, handle)
+            self._regions[name] = SystemShmRegion(name, key, offset, byte_size,
+                                                  handle, manifest=manifest)
+        if publish and manifest is not None:
+            _manifest_write("sys", name, manifest)
 
     def unregister(self, name: Optional[str]) -> None:
         """Unregister one region, or all when name is falsy (reference
@@ -67,10 +183,11 @@ class SystemShmRegistry:
                 region = self._regions.pop(n, None)
                 if region is not None:
                     sysshm.destroy_shared_memory_region(region.handle)
+        _manifest_remove("sys", name)
 
     def status(self, name: Optional[str]) -> Dict[str, dict]:
         with self._lock:
-            return {
+            out = {
                 n: {
                     "name": r.name,
                     "key": r.key,
@@ -80,12 +197,47 @@ class SystemShmRegistry:
                 for n, r in self._regions.items()
                 if not name or n == name
             }
+        # multi-process: regions registered through a sibling worker are
+        # visible (and lazily attachable) here via the manifest
+        for n, m in _manifest_names("sys").items():
+            if n not in out and (not name or n == name):
+                out[n] = {"name": n, "key": m.get("key", ""),
+                          "offset": int(m.get("offset", 0)),
+                          "byte_size": int(m.get("byte_size", 0))}
+        return out
 
     def _get(self, ref: ShmRef) -> SystemShmRegion:
+        name = ref.region_name
         with self._lock:
-            region = self._regions.get(ref.region_name)
+            region = self._regions.get(name)
+        if region is not None and region.manifest is not None:
+            # manifest-sourced attachment: revalidate against the live
+            # manifest so a sibling-served unregister/re-register can't
+            # leave this worker on a stale mapping
+            m = _manifest_load("sys", name)
+            if m != region.manifest:
+                with self._lock:
+                    if self._regions.get(name) is region:
+                        self._regions.pop(name)
+                        sysshm.destroy_shared_memory_region(region.handle)
+                region = None
         if region is None:
-            raise InferError(f"Unable to find shared memory region: '{ref.region_name}'")
+            m = _manifest_load("sys", name)
+            if m is not None:
+                # registered via a sibling SO_REUSEPORT worker: attach
+                # locally from the manifest (shm_open is attach-by-key
+                # from any process)
+                try:
+                    self.register(name, m["key"],
+                                  int(m.get("offset", 0)),
+                                  int(m["byte_size"]), publish=False,
+                                  manifest=m)
+                except (InferError, KeyError, TypeError, ValueError):
+                    pass
+                with self._lock:
+                    region = self._regions.get(name)
+        if region is None:
+            raise InferError(f"Unable to find shared memory region: '{name}'")
         return region
 
     def read(self, ref: ShmRef, datatype: str, shape) -> np.ndarray:
@@ -131,6 +283,9 @@ class XlaShmRegion:
     # DMA (the TPU analog of cudaIPC's map-once read path)
     seq_handle: Optional[object] = None
     cache: Optional[tuple] = None  # (key, device array), stored atomically
+    # manifest payload for sibling-worker (manifest-sourced) attachments;
+    # revalidated per resolve — see SystemShmRegion.manifest
+    manifest: Optional[dict] = None
 
 
 class XlaShmRegistry:
@@ -146,7 +301,9 @@ class XlaShmRegistry:
         # one DMA each cross-process shm request costs is a visible series
         self.device_stats = None
 
-    def register(self, name: str, raw_handle: bytes, device_id: int, byte_size: int) -> None:
+    def register(self, name: str, raw_handle: bytes, device_id: int,
+                 byte_size: int, publish: bool = True,
+                 manifest: Optional[dict] = None) -> None:
         try:
             desc = json.loads(bytes(raw_handle).decode("utf-8"))
         except Exception:
@@ -154,10 +311,27 @@ class XlaShmRegistry:
                 f"failed to register CUDA/XLA shared memory region '{name}': "
                 "raw handle is not a valid descriptor"
             )
+        if publish and manifest is None and _manifest_dir() is not None:
+            # direct registrations are manifest-tracked too (see
+            # SystemShmRegistry.register)
+            manifest = {
+                "raw_handle_b64":
+                    base64.b64encode(bytes(raw_handle)).decode("ascii"),
+                "device_id": device_id, "byte_size": byte_size}
         with self._lock:
-            if name in self._regions:
-                raise InferError(f"shared memory region '{name}' already in manager")
-            region = XlaShmRegion(name=name, device_id=device_id, byte_size=byte_size)
+            stale = self._regions.get(name)
+            if stale is not None:
+                # evict a stale sibling-sourced attachment on a direct
+                # re-register RPC (see SystemShmRegistry.register)
+                if publish and stale.manifest is not None:
+                    self._regions.pop(name)
+                    for h in (stale.staging_handle, stale.seq_handle):
+                        if h is not None:
+                            sysshm.destroy_shared_memory_region(h)
+                else:
+                    raise InferError(f"shared memory region '{name}' already in manager")
+            region = XlaShmRegion(name=name, device_id=device_id,
+                                  byte_size=byte_size, manifest=manifest)
             uid = desc.get("uuid")
             slot = broker().lookup(uid) if uid else None
             if slot is not None:
@@ -182,6 +356,11 @@ class XlaShmRegistry:
                     "refers to neither an in-process slot nor a staging region"
                 )
             self._regions[name] = region
+        if publish and manifest is not None:
+            # the raw handle always carries the staging keys, so a sibling
+            # SO_REUSEPORT worker attaching from this manifest entry lands
+            # on the cross-process staging path (the slot is process-local)
+            _manifest_write("xla", name, manifest)
 
     def unregister(self, name: Optional[str]) -> None:
         with self._lock:
@@ -193,14 +372,20 @@ class XlaShmRegistry:
                 for h in (region.staging_handle, region.seq_handle):
                     if h is not None:
                         sysshm.destroy_shared_memory_region(h)
+        _manifest_remove("xla", name)
 
     def status(self, name: Optional[str]) -> Dict[str, dict]:
         with self._lock:
-            return {
+            out = {
                 n: {"name": r.name, "device_id": r.device_id, "byte_size": r.byte_size}
                 for n, r in self._regions.items()
                 if not name or n == name
             }
+        for n, m in _manifest_names("xla").items():
+            if n not in out and (not name or n == name):
+                out[n] = {"name": n, "device_id": int(m.get("device_id", 0)),
+                          "byte_size": int(m.get("byte_size", 0))}
+        return out
 
     def is_slot_backed(self, name: str) -> bool:
         """True for in-process (broker-slot) regions — the zero-copy device
@@ -210,10 +395,38 @@ class XlaShmRegistry:
         return region is not None and region.slot is not None
 
     def _get(self, ref: ShmRef) -> XlaShmRegion:
+        name = ref.region_name
         with self._lock:
-            region = self._regions.get(ref.region_name)
+            region = self._regions.get(name)
+        if region is not None and region.manifest is not None:
+            # revalidate a sibling-sourced attachment against the live
+            # manifest (stale after an unregister/re-register elsewhere)
+            m = _manifest_load("xla", name)
+            if m != region.manifest:
+                with self._lock:
+                    if self._regions.get(name) is region:
+                        self._regions.pop(name)
+                        for h in (region.staging_handle, region.seq_handle):
+                            if h is not None:
+                                sysshm.destroy_shared_memory_region(h)
+                region = None
         if region is None:
-            raise InferError(f"Unable to find shared memory region: '{ref.region_name}'")
+            m = _manifest_load("xla", name)
+            if m is not None:
+                # sibling-worker registration: attach via the staging keys
+                # carried in the published raw handle
+                try:
+                    self.register(
+                        name,
+                        base64.b64decode(m["raw_handle_b64"]),
+                        int(m.get("device_id", 0)), int(m["byte_size"]),
+                        publish=False, manifest=m)
+                except (InferError, KeyError, TypeError, ValueError):
+                    pass
+                with self._lock:
+                    region = self._regions.get(name)
+        if region is None:
+            raise InferError(f"Unable to find shared memory region: '{name}'")
         return region
 
     def read(self, ref: ShmRef, datatype: str, shape):

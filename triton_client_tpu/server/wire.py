@@ -1,0 +1,628 @@
+"""Server wire fast path: response templates + zero-copy readback.
+
+The PR 9 client playbook, applied to the other end of the socket.  The
+slow path rebuilds the whole v2 response envelope per request: the HTTP
+frontend re-dumps the JSON header (model name/version, output specs,
+parameter blocks) and ``.tobytes()``-materializes every output tensor;
+the gRPC frontend re-populates a ``ModelInferResponse`` submessage tree.
+For steady-state serving (same model, same output set, thousands of
+responses) everything but the request id, the batch-dependent leading
+shape dims and the raw tensor bytes is invariant — so this module
+compiles the skeleton ONCE per (model, output-set) and stamps only the
+variable fields:
+
+* :class:`HttpResponseTemplate` — runs the REAL slow-path header builder
+  (:func:`build_http_response_header`, the one function both paths share
+  so they can't drift) with sentinel values and splits the dumped JSON
+  into literal byte segments around the variable slots (optional ``id``
+  / ``triton_request_id`` strings, per-output leading shape dim, per-
+  binary-output ``binary_data_size``).  A stamped body is byte-identical
+  to the slow path by construction — pinned by
+  ``tests/test_server_wire_fastpath.py``'s equality matrix.
+* :class:`GrpcResponseTemplate` — keeps the compiled
+  ``ModelInferResponse`` alive and stamps into a ``CopyFrom`` of it
+  (C-speed in upb; a fresh message per response because grpc.aio may
+  serialize after the handler returns — same rule as the aio client
+  templates).
+* :func:`wire_segment` — zero-copy readback: an output tensor's wire
+  bytes as a memoryview over the host array (BF16: a uint8 view; BYTES:
+  the one packed serialization buffer), so the only payload copy left is
+  the transport-required one — HTTP's single gather-join into the body,
+  gRPC's protobuf ``bytes`` materialization.  Both carry WIRE-COPY
+  pragmas; the lint rule keeps every other copy out.
+
+Template lifecycle: entries live in a per-core, per-protocol
+:class:`ResponseTemplateCache` keyed by (model, registry generation,
+response signature).  A model reload bumps the generation, so stale
+templates can never stamp a reloaded model's responses;
+``InferenceCore.retire_name_caches`` additionally drops the retired
+entries eagerly.  Responses whose shape is not template-friendly (JSON
+``data`` outputs, whose values vary per response) bypass to the slow
+path — byte-for-byte the same wire, just not amortized.
+
+Ownership rule (mirrors the client's): the memoryviews returned by
+:func:`wire_segment` alias the response's host arrays — the core must
+not mutate an output array between ``_build_response`` and the frontend
+gathering the body.  Nothing in the serving path does (outputs are
+freshly-read-back host arrays); the contract is documented here because
+the type system can't enforce it.
+"""
+
+from __future__ import annotations
+
+import json
+from json.encoder import encode_basestring_ascii as _json_str
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..protocol import inference_pb2 as pb
+from ..utils import (
+    as_wire_memoryview,
+    serialize_bf16_tensor,
+    serialize_byte_tensor_raw,
+    wire_length,
+)
+from .types import InferResponse, OutputTensor
+
+__all__ = [
+    "ResponseTemplateCache",
+    "encode_http_response",
+    "encode_pb_response",
+    "build_http_response_header",
+    "build_pb_response",
+    "wire_segment",
+    "py_to_pb_param",
+    "pb_param_to_py",
+    "sse_frame",
+    "SSE_DATA",
+    "SSE_END",
+]
+
+#: Improbable literals the template compiler plants, then locates, in the
+#: dumped header.  The int base is re-derived on collision (a shm byte
+#: size or frozen dim could in principle collide); the strings never
+#: legitimately appear.
+_SENTINEL_ID = "tmpl-resp-id-9f3a71c5e2d04b88"
+_SENTINEL_RID = "tmpl-resp-rid-5c1e88f0a73d42b9"
+_SENTINEL_INT_BASE = 9_090_909_090_001
+
+# -- SSE envelope (streaming satellite) ------------------------------------
+# The invariant SSE framing, encoded once: the streaming paths previously
+# re-encoded ``f"data: {payload}\n\n"`` per event, paying a full str
+# format + encode of the (large) payload for two constant affixes.
+SSE_DATA = b"data: "
+SSE_END = b"\n\n"
+
+
+def sse_frame(payload) -> bytes:
+    """One SSE ``data:`` frame around an already-serialized payload
+    (``str`` or ``bytes``) using the precompiled envelope affixes."""
+    if isinstance(payload, str):
+        payload = payload.encode("utf-8")
+    return b"%s%s%s" % (SSE_DATA, payload, SSE_END)
+
+
+# -- zero-copy readback ----------------------------------------------------
+
+
+def wire_segment(data: np.ndarray, datatype: str):
+    """An output tensor's wire bytes as a buffer, without materializing
+    ``bytes``: fixed dtypes and BF16 return a memoryview ALIASING the
+    host array (zero copy when C-contiguous); BYTES returns the single
+    packed serialization buffer (``<u32 len><elem>`` pairs built once).
+    The caller owns the final transport copy — and must not mutate the
+    source array before it happens (module ownership rule).
+
+    Hot path: ``arr.data`` is one C attribute access; the ``b"".join``
+    gather downstream requires C-contiguity (a strided memoryview fails
+    its PyBUF_SIMPLE request), so non-contiguous arrays take the staging
+    copy in :func:`as_wire_memoryview`."""
+    if datatype == "BYTES":
+        return serialize_byte_tensor_raw(data)
+    if datatype == "BF16":
+        return serialize_bf16_tensor(data).data
+    try:
+        if data.flags.c_contiguous:
+            return data.data
+    except AttributeError:
+        data = np.asarray(data)
+        if data.flags.c_contiguous:
+            return data.data
+    return as_wire_memoryview(np.ascontiguousarray(data))
+
+
+# -- protobuf parameter codecs (shared with the gRPC frontend) -------------
+
+
+def pb_param_to_py(p: pb.InferParameter):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def py_to_pb_param(value) -> pb.InferParameter:
+    p = pb.InferParameter()
+    if isinstance(value, bool):
+        p.bool_param = value
+    elif isinstance(value, int):
+        p.int64_param = value
+    elif isinstance(value, float):
+        p.double_param = value
+    else:
+        p.string_param = str(value)
+    return p
+
+
+# -- HTTP: the one header builder (slow path AND template compile) ---------
+
+
+def _array_to_json(arr: np.ndarray, datatype: str):
+    if datatype == "BYTES":
+        return [
+            x.decode("utf-8") if isinstance(x, (bytes, bytearray)) else str(x)
+            for x in arr.flatten(order="C")
+        ]
+    return np.asarray(
+        arr, dtype=np.float64 if datatype == "BF16" else None
+    ).flatten().tolist()
+
+
+def build_http_response_header(
+    resp: InferResponse,
+    requested: Dict[str, Any],
+    default_binary: bool,
+    segments: List[Any],
+    sizes: Optional[List[int]] = None,
+) -> Dict[str, Any]:
+    """Build the v2 HTTP response header dict.
+
+    This is the SINGLE header builder: the slow path dumps its return
+    value directly, and the template compiler runs it with sentinel
+    values — so a stamped header can never drift from the slow path's.
+    ``segments`` collects the per-binary-output wire buffers (in output
+    order).  ``sizes``, when given (template compile only), supplies the
+    ``binary_data_size`` ints instead of serializing ``out.data``.
+    """
+    out_json: List[dict] = []
+    bslot = 0
+    for out in resp.outputs:
+        entry: Dict[str, Any] = {
+            "name": out.name,
+            "datatype": out.datatype,
+            "shape": list(out.shape),
+        }
+        spec = requested.get(out.name)
+        if out.shm is not None:
+            entry["parameters"] = {
+                "shared_memory_region": out.shm.region_name,
+                "shared_memory_byte_size": out.shm.byte_size,
+            }
+            if out.shm.offset:
+                entry["parameters"]["shared_memory_offset"] = out.shm.offset
+        else:
+            binary = spec.binary_data if spec is not None else default_binary
+            if binary:
+                if sizes is not None:
+                    n = sizes[bslot]
+                    bslot += 1
+                else:
+                    seg = wire_segment(out.data, out.datatype)
+                    n = wire_length(seg)
+                    segments.append(seg)
+                entry.setdefault("parameters", {})["binary_data_size"] = n
+            else:
+                entry["data"] = _array_to_json(out.data, out.datatype)
+        out_json.append(entry)
+    header: Dict[str, Any] = {
+        "model_name": resp.model_name,
+        "model_version": resp.model_version or "1",
+        "outputs": out_json,
+    }
+    if resp.id:
+        header["id"] = resp.id
+    if resp.parameters:
+        header["parameters"] = resp.parameters
+    return header
+
+
+# -- frozen response specs (template applicability) ------------------------
+
+
+class _TemplateBase:
+    """Frozen-spec capture + the allocation-free per-request ``matches``
+    check both templates share.
+
+    A template freezes everything invariant about its response shape:
+    model version, id / ``triton_request_id`` presence, every other
+    response parameter (key, class AND value — ``1`` / ``True`` / ``1.0``
+    compare equal but serialize differently), and per output its name,
+    datatype, rank, trailing dims and shm routing.  ``matches`` verifies
+    a candidate response against that spec with early exits and no
+    signature-tuple allocation — it runs on every request, so it is the
+    fast path's gatekeeper, profiled as such."""
+
+    def _freeze(self, resp: InferResponse) -> None:
+        self._version = resp.model_version or "1"
+        self._has_id = bool(resp.id)
+        params = resp.parameters
+        self._has_rid = "triton_request_id" in params
+        self._frozen_items = [(k, v.__class__, v) for k, v in params.items()]
+        self._nparams = len(self._frozen_items)
+        self._out_frozen = []
+        for o in resp.outputs:
+            shm = o.shm
+            self._out_frozen.append((
+                o.name, o.datatype, len(o.shape), tuple(o.shape[1:]),
+                None if shm is None
+                else (shm.region_name, shm.byte_size, shm.offset)))
+
+    def _matches_base(self, resp: InferResponse) -> bool:
+        if (resp.model_version or "1") != self._version \
+                or bool(resp.id) != self._has_id:
+            return False
+        params = resp.parameters
+        if len(params) != self._nparams:
+            return False
+        if self._nparams:
+            fi = self._frozen_items
+            i = 0
+            for k, v in params.items():
+                fk, fcls, fv = fi[i]
+                i += 1
+                if k != fk or v.__class__ is not fcls:
+                    return False
+                # the rid VALUE is a stamp slot; everything else froze
+                if k != "triton_request_id" and v != fv:
+                    return False
+        outs = resp.outputs
+        fo = self._out_frozen
+        if len(outs) != len(fo):
+            return False
+        for o, (name, dt, ndim, tail, shm_key) in zip(outs, fo):
+            if o.name != name or o.datatype != dt:
+                return False
+            shp = o.shape
+            if len(shp) != ndim or tuple(shp[1:]) != tail:
+                return False
+            s = o.shm
+            if shm_key is None:
+                if s is not None:
+                    return False
+            elif s is None or s.region_name != shm_key[0] \
+                    or s.byte_size != shm_key[1] or s.offset != shm_key[2]:
+                return False
+        return True
+
+
+def _http_templatable(resp, requested, default_binary) -> bool:
+    """JSON ``data`` outputs vary per response — nothing to amortize."""
+    for o in resp.outputs:
+        if o.shm is None:
+            spec = requested.get(o.name)
+            if not (spec.binary_data if spec is not None
+                    else default_binary):
+                return False
+    return True
+
+
+# -- HTTP response template ------------------------------------------------
+
+
+class HttpResponseTemplate(_TemplateBase):
+    """Compiled invariant skeleton of one (model, output-set) HTTP
+    response shape.
+
+    The compiled form is a printf-style ``bytes`` template (``%d`` per
+    leading shape dim / ``binary_data_size``, ``%s`` per id slot) so the
+    whole header materializes in ONE C-level format call — no per-slot
+    Python loop on the stamp path.  Immutable after compile: ``stamp()``
+    only reads, so one template serves every in-flight request of its
+    shape concurrently."""
+
+    def __init__(self, resp: InferResponse, requested: Dict[str, Any],
+                 default_binary: bool):
+        self._freeze(resp)
+        # output indices that contribute a leading (batch) shape dim /
+        # a binary payload segment, in output order
+        self._dim_idx = [i for i, o in enumerate(resp.outputs) if o.shape]
+        self._bin_idx = [i for i, o in enumerate(resp.outputs)
+                         if o.shm is None]
+        self._fmt, self._argspec = self._compile(resp, requested,
+                                                 default_binary)
+
+    def matches(self, resp, requested, default_binary) -> bool:
+        if not self._matches_base(resp):
+            return False
+        # every non-shm output must still RESOLVE to binary (the caller's
+        # requested-output specs / default flip the mode per request)
+        outs = resp.outputs
+        for i in self._bin_idx:
+            spec = requested.get(outs[i].name)
+            if not (spec.binary_data if spec is not None
+                    else default_binary):
+                return False
+        return True
+
+    def _compile(self, resp, requested, default_binary):
+        """Run the real header builder with sentinel values and compile
+        its dump into a ``%``-format bytes template plus the argument
+        spec (``("id",) / ("rid",) / ("dim", out_idx) / ("bsize",
+        slot)``, in header order)."""
+        base = _SENTINEL_INT_BASE
+        for _attempt in range(16):
+            dim_sent = {i: base + 7 * i for i in self._dim_idx}
+            size_sent = {s: base + 500_009 + 11 * s
+                         for s in range(len(self._bin_idx))}
+            sent_outputs = []
+            for i, o in enumerate(resp.outputs):
+                shape = ((dim_sent[i],) + tuple(o.shape[1:]) if o.shape
+                         else ())
+                sent_outputs.append(OutputTensor(
+                    name=o.name, datatype=o.datatype, shape=shape,
+                    data=o.data, shm=o.shm))
+            params = dict(resp.parameters)
+            if self._has_rid:
+                params["triton_request_id"] = _SENTINEL_RID
+            sent = InferResponse(
+                model_name=resp.model_name,
+                model_version=resp.model_version,
+                id=_SENTINEL_ID if self._has_id else "",
+                outputs=sent_outputs,
+                parameters=params,
+            )
+            header = json.dumps(build_http_response_header(
+                sent, requested, default_binary, [],
+                sizes=[size_sent[s] for s in range(len(self._bin_idx))]))
+            marks: List[Tuple[str, str, Optional[int]]] = []
+            if self._has_id:
+                marks.append((json.dumps(_SENTINEL_ID), "id", None))
+            if self._has_rid:
+                marks.append((json.dumps(_SENTINEL_RID), "rid", None))
+            marks += [(str(v), "dim", i) for i, v in dim_sent.items()]
+            marks += [(str(v), "bsize", s) for s, v in size_sent.items()]
+            if all(header.count(m) == 1 for m, _k, _s in marks):
+                return self._fuse(
+                    header.encode("utf-8"),
+                    [(m.encode("utf-8"), k, s) for m, k, s in marks])
+            base += 1_010_101  # a real value collided; shift and re-plant
+        raise ValueError("could not compile response template "
+                         "(sentinel collision)")  # pragma: no cover
+
+    @staticmethod
+    def _fuse(header: bytes, marks):
+        """Cut the sentinel positions out of the dumped header and fuse
+        the literals into one ``%``-format bytes template (``%d`` for
+        int slots, ``%s`` for pre-encoded string slots; literal ``%``
+        escaped) with its argument spec in header order."""
+        placed = sorted((header.index(m), m, kind, slot)
+                        for m, kind, slot in marks)
+        fmt_parts: List[bytes] = []
+        argspec: List[Tuple[str, Any]] = []
+        pos = 0
+        for at, m, kind, slot in placed:
+            fmt_parts.append(header[pos:at].replace(b"%", b"%%"))
+            fmt_parts.append(b"%s" if kind in ("id", "rid") else b"%d")
+            argspec.append((kind, slot))
+            pos = at + len(m)
+        fmt_parts.append(header[pos:].replace(b"%", b"%%"))
+        return b"".join(fmt_parts), argspec
+
+    def stamp(self, resp: InferResponse) -> Tuple[bytes, int]:
+        """Re-stamp the variable fields and gather the body.  Returns
+        (body, json_size) byte-identical to the slow path for any
+        response this template ``matches``."""
+        outs = resp.outputs
+        segments = [wire_segment(outs[i].data, outs[i].datatype)
+                    for i in self._bin_idx]
+        sizes = [wire_length(s) for s in segments]
+        args = []
+        for kind, val in self._argspec:
+            if kind == "dim":
+                args.append(outs[val].shape[0])
+            elif kind == "bsize":
+                args.append(sizes[val])
+            elif kind == "id":
+                # the C escaper json.dumps itself uses, without the
+                # serializer dispatch around it
+                args.append(_json_str(resp.id).encode("utf-8"))
+            else:  # rid
+                args.append(_json_str(
+                    resp.parameters["triton_request_id"]).encode("utf-8"))
+        header = self._fmt % tuple(args)
+        if not segments:
+            return header, len(header)
+        # tpu-lint: disable=WIRE-COPY the one transport-required gather of header + raw segments
+        return b"".join([header, *segments]), len(header)
+
+
+# -- gRPC response template ------------------------------------------------
+
+
+def _serialize_pb_payload(data: np.ndarray, datatype: str) -> bytes:
+    """An output tensor's wire bytes AS ``bytes`` — the single
+    protobuf-required materialization (upb rejects memoryview/bytearray;
+    same rule as the client's request path).  Spelled with the direct
+    copy primitives because the memoryview detour would only add wrapper
+    cost in front of the same one copy."""
+    if datatype == "BYTES":
+        # tpu-lint: disable=WIRE-COPY protobuf bytes field: the packed BYTES buffer materializes once
+        return bytes(serialize_byte_tensor_raw(data))
+    if datatype == "BF16":
+        # tpu-lint: disable=WIRE-COPY protobuf bytes field: the one copy out of the bf16 view
+        return serialize_bf16_tensor(data).tobytes()
+    # tpu-lint: disable=WIRE-COPY protobuf bytes field: the one copy out of the host array
+    return np.ascontiguousarray(data).tobytes()
+
+
+class GrpcResponseTemplate(_TemplateBase):
+    """Compiled ``ModelInferResponse`` skeleton of one (model,
+    output-set) shape.  ``stamp()`` always writes into a fresh
+    ``CopyFrom`` of the skeleton (C-speed in upb): grpc.aio serializes
+    after the handler returns, so mutating one shared message would tear
+    in-flight responses (the same rule the aio client templates
+    follow)."""
+
+    def __init__(self, resp: InferResponse):
+        self._freeze(resp)
+        self._dim_idx = [i for i, o in enumerate(resp.outputs) if o.shape]
+        # compiled leading dims: steady-state traffic repeats the batch
+        # size, so the per-output submessage write is usually skippable
+        self._dims = [resp.outputs[i].shape[0] for i in self._dim_idx]
+        self._shm_mask = [o.shm is not None for o in resp.outputs]
+        skeleton = build_pb_response(resp)
+        del skeleton.raw_output_contents[:]  # payloads stamp per response
+        skeleton.ClearField("id")
+        self._skeleton = skeleton
+
+    def matches(self, resp: InferResponse) -> bool:
+        return self._matches_base(resp)
+
+    def stamp(self, resp: InferResponse) -> pb.ModelInferResponse:
+        out = pb.ModelInferResponse()
+        out.CopyFrom(self._skeleton)
+        if resp.id:
+            out.id = resp.id
+        if self._has_rid:
+            out.parameters["triton_request_id"].string_param = \
+                str(resp.parameters["triton_request_id"])
+        outs = resp.outputs
+        for j, i in enumerate(self._dim_idx):
+            d = outs[i].shape[0]
+            if d != self._dims[j]:  # compiled dim already in the skeleton
+                out.outputs[i].shape[0] = d
+        out.raw_output_contents.extend(
+            b"" if shm else _serialize_pb_payload(t.data, t.datatype)
+            for t, shm in zip(outs, self._shm_mask))
+        return out
+
+
+def build_pb_response(resp: InferResponse) -> pb.ModelInferResponse:
+    """The one slow-path gRPC response builder (also the template
+    compiler's source of truth).  Payloads materialize exactly once, in
+    :func:`_serialize_pb_payload`."""
+    out = pb.ModelInferResponse(
+        model_name=resp.model_name,
+        model_version=resp.model_version or "1",
+        id=resp.id,
+    )
+    for k, v in resp.parameters.items():
+        out.parameters[k].CopyFrom(py_to_pb_param(v))
+    for t in resp.outputs:
+        pbt = out.outputs.add()
+        pbt.name = t.name
+        pbt.datatype = t.datatype
+        pbt.shape.extend(int(s) for s in t.shape)
+        if t.shm is not None:
+            pbt.parameters["shared_memory_region"].string_param = \
+                t.shm.region_name
+            pbt.parameters["shared_memory_byte_size"].int64_param = \
+                t.shm.byte_size
+            if t.shm.offset:
+                pbt.parameters["shared_memory_offset"].int64_param = \
+                    t.shm.offset
+            out.raw_output_contents.append(b"")
+        else:
+            out.raw_output_contents.append(
+                _serialize_pb_payload(t.data, t.datatype))
+    return out
+
+
+# -- template cache --------------------------------------------------------
+
+
+class ResponseTemplateCache:
+    """Bounded cache of compiled response templates, one per (protocol,
+    core).  Keyed ``(model_name, registry generation)`` — a model reload
+    bumps the generation, so a stale template can never stamp a reloaded
+    model's responses — holding a short list of templates per key
+    (typically one; response shapes per model are few).  The caps bound
+    pathological shape churn (e.g. a per-request response parameter,
+    which can never match an existing template)."""
+
+    PER_KEY = 8
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._map: Dict[Tuple[str, int], List[Any]] = {}
+        self.stats = {"hits": 0, "misses": 0, "bypass": 0, "errors": 0}
+
+    def lookup(self, model_name: str, generation: int) -> List[Any]:
+        return self._map.get((model_name, generation)) or _EMPTY
+
+    def add(self, model_name: str, generation: int, tpl) -> None:
+        key = (model_name, generation)
+        tpls = self._map.get(key)
+        if tpls is None:
+            if len(self._map) >= self.capacity:
+                self._map.pop(next(iter(self._map)))
+            tpls = self._map[key] = []
+        tpls.append(tpl)
+        if len(tpls) > self.PER_KEY:
+            tpls.pop(0)
+
+    def retire(self, model_name: str) -> None:
+        """Eagerly drop a (re)loaded/unloaded model's entries (the
+        generation in the key already prevents stale stamps; this frees
+        the memory without waiting for cap eviction)."""
+        for k in [k for k in self._map if k[0] == model_name]:
+            self._map.pop(k, None)
+
+
+_EMPTY: List[Any] = []
+
+
+def encode_http_response(
+    resp: InferResponse,
+    requested: Dict[str, Any],
+    default_binary: bool,
+    cache: Optional[ResponseTemplateCache] = None,
+    generation: int = 0,
+) -> Tuple[bytes, int]:
+    """Encode an HTTP response body: template fast path when a cache is
+    given and the response is template-friendly, else the slow path.
+    Both produce identical bytes; the fast path amortizes the header."""
+    if cache is not None:
+        try:
+            for tpl in cache.lookup(resp.model_name, generation):
+                if tpl.matches(resp, requested, default_binary):
+                    cache.stats["hits"] += 1
+                    return tpl.stamp(resp)
+            if _http_templatable(resp, requested, default_binary):
+                tpl = HttpResponseTemplate(resp, requested, default_binary)
+                cache.add(resp.model_name, generation, tpl)
+                cache.stats["misses"] += 1
+                return tpl.stamp(resp)
+            cache.stats["bypass"] += 1
+        except Exception:  # pragma: no cover - defensive
+            # a compile/stamp surprise must degrade to the slow path,
+            # never fail a request the slow path could serve
+            cache.stats["errors"] += 1
+    segments: List[Any] = []
+    header = build_http_response_header(resp, requested, default_binary,
+                                        segments)
+    json_bytes = json.dumps(header).encode("utf-8")
+    # tpu-lint: disable=WIRE-COPY the one transport-required gather of header + raw segments
+    return b"".join([json_bytes, *segments]), len(json_bytes)
+
+
+def encode_pb_response(
+    resp: InferResponse,
+    cache: Optional[ResponseTemplateCache] = None,
+    generation: int = 0,
+) -> pb.ModelInferResponse:
+    """Encode a gRPC response message: template fast path when a cache
+    is given, else the slow builder.  Semantically identical either way
+    (and byte-identical under deterministic serialization)."""
+    if cache is not None:
+        try:
+            for tpl in cache.lookup(resp.model_name, generation):
+                if tpl.matches(resp):
+                    cache.stats["hits"] += 1
+                    return tpl.stamp(resp)
+            tpl = GrpcResponseTemplate(resp)
+            cache.add(resp.model_name, generation, tpl)
+            cache.stats["misses"] += 1
+            return tpl.stamp(resp)
+        except Exception:  # pragma: no cover - defensive
+            cache.stats["errors"] += 1
+    return build_pb_response(resp)

@@ -1,4 +1,4 @@
-"""WIRE-COPY: no tensor-payload copies on the client serialize paths.
+"""WIRE-COPY: no tensor-payload copies on the wire serialize paths.
 
 Historical bug class: ISSUE 10's profile found ~half of every RPC was
 client-framework overhead, and a big slice of it was redundant payload
@@ -7,12 +7,19 @@ into a ``bytes`` and then round-tripped it through ``np.frombuffer(...)
 .tobytes()`` (a second full copy), the HTTP body grew by ``+=``
 concatenation (quadratic), and fixed-dtype tensors were ``tobytes()``'d
 even where a memoryview handoff reaches the transport.  The fast-path
-refactor removed them; this rule keeps them out.
+refactor removed them; this rule keeps them out.  ISSUE 11 extended the
+same contract to the server frontends: their response encoders
+``.tobytes()``-materialized every output tensor, which the server wire
+fast path replaced with memoryview segments — the rule now covers both
+ends of the socket.
 
 What fires, inside the four client cores (files under an ``http`` or
-``grpc`` path segment) and only within serialize-path functions
+``grpc`` path segment) AND the server serialize modules
+(``server/http_server.py``, ``server/grpc_server.py``,
+``server/wire.py``), and only within serialize-path functions
 (``set_data_from_numpy``, ``_get_binary_data``/``_get_raw_data``,
-``get_inference_request*``, ``stamp``/``assemble*``, anything named
+``get_inference_request*``, ``stamp``/``assemble*``, ``encode_*``/
+``_encode_*``, ``build_*response*``, ``wire_segment``, anything named
 ``*serialize*``):
 
 * ``<x>.tobytes()`` — copies the whole tensor; use
@@ -26,9 +33,10 @@ What fires, inside the four client cores (files under an ``http`` or
 
 Legitimate sites carry a reasoned pragma (``# tpu-lint:
 disable=WIRE-COPY <why>``): protobuf bytes fields require a ``bytes``
-materialization, and the final header+payload gather into the HTTP body
-is the one copy the transport demands.  The rule ships with an EMPTY
-baseline — new copies can't ride in grandfathered.
+materialization (client request AND server response), and the final
+header+payload gather into the HTTP body is the one copy the transport
+demands — on both ends.  The rule ships with an EMPTY baseline — new
+copies can't ride in grandfathered.
 """
 
 from __future__ import annotations
@@ -40,9 +48,12 @@ from .._ast_util import iter_body_nodes, iter_functions
 from .._engine import Finding, Project, register_rule
 
 #: A file is in scope when a whole path segment is one of the client-core
-#: package names (``triton_client_tpu/http/...``, ``.../grpc/aio/...``).
-#: ``server/grpc_server.py`` etc. have no such segment and stay out.
+#: package names (``triton_client_tpu/http/...``, ``.../grpc/aio/...``)
+#: OR it is one of the server serialize modules (the frontends and the
+#: response-template module).
 _CORE_SEGMENT = re.compile(r"(^|/)(http|grpc)(/|$)")
+_SERVER_FILES = re.compile(
+    r"(^|/)server/(http_server|grpc_server|wire)\.py$")
 
 #: Serialize-path function names (exact or substring rules below).
 _SERIALIZE_FNS = {
@@ -50,9 +61,11 @@ _SERIALIZE_FNS = {
     "_get_binary_data",
     "_get_raw_data",
     "generate_request_body",
+    "wire_segment",
 }
 _SERIALIZE_PREFIXES = ("get_inference_request", "stamp", "_stamp",
-                       "assemble")
+                       "assemble", "encode_", "_encode", "build_pb_response",
+                       "build_http_response")
 
 
 def _on_serialize_path(fn_name: str) -> bool:
@@ -70,11 +83,15 @@ def _is_bytes_literal(node: ast.AST) -> bool:
 @register_rule(
     "WIRE-COPY",
     "no .tobytes()/bytes(...)/b\"\".join on tensor payloads inside the "
-    "client cores' serialize paths (pragma the single required copy)")
+    "client cores' or server frontends' serialize paths (pragma the "
+    "single required copy)")
 def check(project: Project):
     for f in project.files:
-        if f.tree is None or not _CORE_SEGMENT.search(
-                f.relpath.replace("\\", "/")):
+        if f.tree is None:
+            continue
+        relpath = f.relpath.replace("\\", "/")
+        if not (_CORE_SEGMENT.search(relpath)
+                or _SERVER_FILES.search(relpath)):
             continue
         for _cls, fn in iter_functions(f.tree):
             if not _on_serialize_path(fn.name):
