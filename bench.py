@@ -743,6 +743,143 @@ def _measure_generation_ab() -> dict:
     return out
 
 
+def _measure_gen_tick_breakdown() -> dict:
+    """Decode-tick fast-path microbench (ISSUE 12) — CPU-runnable on the
+    tiny preset: per-token host overhead, control uploads and fused
+    syncs per token, and the steps-per-dispatch A/B at T in {1, 4, 8}
+    (TRITON_TPU_DECODE_STEPS).
+
+    The sync/upload columns come from the nv_tpu_tick_* counters the
+    worker records per dispatch, so they are host-independent: on a
+    CPU-only host the tok/s absolutes mean little (the tiny model is
+    compute-cheap and the chip is a CPU), but uploads-per-token == 0 and
+    syncs-per-token == 1/T hold wherever the code runs.  ``host_us_per_tok``
+    is the worker's tick-assembly time (job collection to dispatch)
+    amortized per token — the host-overhead axis the fused tick shrinks."""
+    import gc
+    import threading
+    import time as _time
+
+    import jax
+
+    from triton_client_tpu.models import language
+    from triton_client_tpu.server.device_stats import DeviceStatsCollector
+
+    keys = ("TRITON_TPU_DECODE_MODE", "TRITON_TPU_DECODE_SLOTS",
+            "TRITON_TPU_DECODE_STEPS", "TRITON_TPU_DECODE_BUCKETS",
+            "TRITON_TPU_PREFILL_CHUNK", "TRITON_TPU_KV_QUANT")
+    saved = {k: os.environ.get(k) for k in keys}
+    CONC, N_TOK = 4, 24
+    out: dict = {"cpu_only": jax.default_backend() != "tpu"}
+
+    window = np.zeros((1, language.LLAMA_SEQ_LEN), np.int32)
+    b = np.frombuffer(b"gen tick breakdown probe", np.uint8)
+    window[0, language.LLAMA_SEQ_LEN - b.size:] = b
+
+    def run_steps(T: int) -> dict:
+        gc.collect()
+        for k in keys:
+            os.environ.pop(k, None)
+        os.environ["TRITON_TPU_DECODE_MODE"] = "batched"
+        os.environ["TRITON_TPU_DECODE_SLOTS"] = str(CONC)
+        os.environ["TRITON_TPU_DECODE_STEPS"] = str(T)
+        from triton_client_tpu.models.decode import DecodeModel
+
+        dec = DecodeModel(name=f"llama_decode_tickbench_t{T}")
+        ds = DeviceStatsCollector()
+        dec.attach_device_stats(ds)
+        try:
+            # warm: compile prefill + the fused T-step kernel off-clock
+            for s in [dec.submit_generation(window.copy(), 2)
+                      for _ in range(CONC)]:
+                while True:
+                    item = s.get(timeout=600)
+                    if item is None:
+                        break
+                    if isinstance(item, Exception):
+                        # surface the real failure (a compile error here
+                        # would otherwise read as a token and stall the
+                        # loop 600s waiting for a None that never comes)
+                        raise item
+            ds.reset()
+            counts: list = []
+            stream_errors: list = []
+            t0 = _time.monotonic()
+
+            def drain(sink):
+                c = 0
+                while True:
+                    item = sink.get(timeout=600)
+                    if item is None:
+                        break
+                    if isinstance(item, Exception):
+                        # record, don't raise: a daemon-thread traceback
+                        # is exactly the stderr noise this bench round
+                        # eliminates, and a silent short count would make
+                        # a partial failure look like a clean result
+                        stream_errors.append(str(item)[:120])
+                        break
+                    c += 1
+                counts.append(c)
+
+            sinks = [dec.submit_generation(window.copy(), N_TOK)
+                     for _ in range(CONC)]
+            ts = [threading.Thread(target=drain, args=(s,), daemon=True)
+                  for s in sinks]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=600)
+            wall = _time.monotonic() - t0
+            snap = ds.snapshot()
+            entry = {}
+            # flat-slot config => exactly one bucket entry; the sum is a
+            # no-op but keeps the fold shape-stable
+            for bucket in snap["ticks"].get(dec.model.name, {}).values():
+                for k2, v in bucket.items():
+                    if isinstance(v, (int, float)) and v is not None:
+                        entry[k2] = entry.get(k2, 0) + v
+            n = sum(counts)
+            ticks = entry.get("ticks", 0)
+            if stream_errors:
+                return {"tokens": n, "stream_errors": stream_errors[:4]}
+            return {
+                "tokens": n,
+                "tok_per_s": round(n / wall, 1) if wall else None,
+                "dispatches": ticks,
+                "steps_per_dispatch": (round(entry.get("steps", 0) / ticks, 2)
+                                       if ticks else None),
+                # fused-dispatch D2H syncs and H2D control uploads, per
+                # token — the host-independent reductions
+                "syncs_per_tok": (round(entry.get("syncs", 0) / n, 3)
+                                  if n else None),
+                "uploads_per_tok": (round(entry.get("uploads", 0) / n, 3)
+                                    if n else None),
+                "host_us_per_tok": (
+                    round(entry.get("avg_assembly_us", 0.0)
+                          * ticks / n, 1) if n else None),
+            }
+        finally:
+            dec._shutdown()
+
+    try:
+        for T in (1, 4, 8):
+            out[f"steps_{T}"] = run_steps(T)
+        t1 = out["steps_1"].get("host_us_per_tok")
+        t8 = out["steps_8"].get("host_us_per_tok")
+        if t1 and t8:
+            out["host_overhead_reduction_t8_vs_t1"] = round(t1 / t8, 2)
+    except Exception as e:  # noqa: BLE001 — bench keeps going without it
+        out["gen_tick_breakdown_error"] = str(e)[:120]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def _measure_bert_int8() -> dict:
     """int8 BERT serving leg (r5): same sweep as _measure_bert_mfu but with
     TRITON_TPU_QUANT_BERT_LARGE=int8 in a FRESH harness (quantization is
@@ -1479,6 +1616,9 @@ def main() -> int:
     # released its device memory: same-precision batched-vs-independent
     # generation A/B + the bucketed c=64 capacity point
     gen_metrics.update(_measure_generation_ab())
+    # decode-tick fast path (ISSUE 12): steps-per-dispatch A/B + per-token
+    # host-overhead/upload/sync counters — CPU-runnable on the tiny preset
+    gen_metrics["gen_tick_breakdown"] = _measure_gen_tick_breakdown()
     # int8 BERT serving (r5): own harness, env-resolved at first inference
     bert_metrics.update(_measure_bert_int8())
     # cluster client: routing + hedged-tail A/Bs on a 3-replica fleet

@@ -148,8 +148,30 @@ class TestCollector:
                     "jit_hit", "jit_miss", "transfer_total",
                     "transfer_bytes", "tick_total", "tick_batch",
                     "tick_padded", "tick_assembly_us", "tick_queue_depth",
-                    "tick_syncs", "pad_waste"):
+                    "tick_syncs", "tick_steps", "tick_uploads",
+                    "pad_waste"):
             assert rows[key], key
+
+    def test_tick_steps_and_upload_counters(self):
+        """ISSUE 12 counters: steps fused per dispatch and host->device
+        control uploads — the measurable form of the decode fast path."""
+        ds = DeviceStatsCollector()
+        # a batcher-style tick defaults to 1 step, 0 uploads
+        ds.record_tick("m", bucket=8, batch=4, padded=8, queue_depth=0,
+                       assembly_ns=1000, syncs=1)
+        # a fused decode dispatch: 8 steps, one sync, no uploads
+        ds.record_tick("m", bucket=8, batch=4, padded=8, queue_depth=0,
+                       assembly_ns=1000, syncs=1, steps=8, uploads=0)
+        # a dispatch carrying client-driven steps pays 2 uploads
+        ds.record_tick("m", bucket=8, batch=1, padded=8, queue_depth=0,
+                       assembly_ns=1000, syncs=1, steps=1, uploads=2)
+        entry = ds.snapshot()["ticks"]["m"]["8"]
+        assert entry["steps"] == 10
+        assert entry["avg_steps_per_tick"] == pytest.approx(10 / 3, rel=0.01)
+        assert entry["uploads"] == 2
+        rows = ds.metric_rows(now=1.0)
+        assert rows["tick_steps"] == [({"model": "m", "bucket": "8"}, 10)]
+        assert rows["tick_uploads"] == [({"model": "m", "bucket": "8"}, 2)]
 
     def test_forget_model_drops_flops_and_signatures(self):
         ds = DeviceStatsCollector()
@@ -695,6 +717,24 @@ class TestReviewRegressions:
         out = parse_device(text)
         assert out["burn_threshold"] == 6.0  # label-less gauge must parse
         assert out["duty"]["m"] == 0.5
+
+    def test_bucket_rows_compute_steps_and_uploads_per_tick(self):
+        from triton_client_tpu.tools.top import bucket_rows
+
+        cur = {"t": 10.0, "device": {"buckets": {
+            ("m", "160"): {"ticks": 20.0, "batch": 40.0, "padded": 80.0,
+                           "assembly_us": 2000.0, "queue_depth": 0.0,
+                           "syncs": 20.0, "steps": 80.0, "uploads": 4.0},
+        }}}
+        prev = {"t": 0.0, "device": {"buckets": {
+            ("m", "160"): {"ticks": 10.0, "batch": 20.0, "padded": 40.0,
+                           "assembly_us": 1000.0, "queue_depth": 0.0,
+                           "syncs": 10.0, "steps": 10.0, "uploads": 4.0},
+        }}}
+        row = bucket_rows(cur, prev)[("m", "160")]
+        # 70 steps over 10 ticks in the delta window; uploads flat at 0
+        assert row["steps_per_tick"] == pytest.approx(7.0)
+        assert row["uploads_per_tick"] == pytest.approx(0.0)
 
     def test_buckets_view_sorts_numerically(self):
         from triton_client_tpu.tools.top import _bucket_lines, _buckets_json

@@ -50,6 +50,7 @@ class TestEngine:
         assert set(rule_names()) == {
             "ASYNC-BLOCK", "LOCK-ORDER", "EXC-CONTRACT", "SPAN-PAIR",
             "METRICS-DECL", "TEST-DETERMINISM", "WIRE-COPY",
+            "DEVICE-SYNC",
             # engine pseudo-rules, selectable like any other
             "PARSE", "PRAGMA"}
 
@@ -1159,6 +1160,100 @@ class TestWireCopy:
         assert len(found) == 1
 
 
+class TestDeviceSync:
+    """DEVICE-SYNC: blocking host<->device syncs inside the decode
+    worker-loop/tick-path functions of models/decode.py."""
+
+    def test_np_asarray_in_worker_loop_fires(self, tmp_path):
+        write(tmp_path, "models/decode.py", """
+            import numpy as np
+            class DecodeModel:
+                def _worker_loop(self):
+                    vals = np.asarray(self._pair)
+            """)
+        found = lint_dir(tmp_path, "DEVICE-SYNC")
+        assert len(found) == 1 and found[0].rule == "DEVICE-SYNC"
+        assert "_worker_loop" in found[0].message
+
+    def test_nested_def_inside_worker_loop_fires(self, tmp_path):
+        # a helper defined inside the worker loop runs on the worker
+        # thread — its syncs are tick-path syncs
+        write(tmp_path, "models/decode.py", """
+            def _worker_loop(self):
+                import numpy as np
+                def finish_prefill(pair):
+                    return np.asarray(pair)
+                return finish_prefill
+            """)
+        found = lint_dir(tmp_path, "DEVICE-SYNC")
+        assert len(found) == 1
+
+    def test_device_get_item_and_barrier_fire(self, tmp_path):
+        write(tmp_path, "models/decode.py", """
+            import jax
+            def _resolve_tick(pair):
+                a = jax.device_get(pair)
+                b = pair.item()
+                pair.block_until_ready()
+                return a, b
+            """)
+        found = lint_dir(tmp_path, "DEVICE-SYNC")
+        assert sorted(fd.line for fd in found) == [4, 5, 6]
+
+    def test_function_level_import_alias_resolves(self, tmp_path):
+        # decode.py imports numpy INSIDE functions; the alias must still
+        # resolve to numpy.asarray
+        write(tmp_path, "models/decode.py", """
+            def _resolve_gen_token(pair):
+                import numpy as np
+                return np.asarray(pair)
+            """)
+        assert len(lint_dir(tmp_path, "DEVICE-SYNC")) == 1
+
+    def test_outside_tick_path_or_file_passes(self, tmp_path):
+        # same sync calls in a non-tick function of decode.py, and in a
+        # tick-named function of ANOTHER file: both out of scope
+        write(tmp_path, "models/decode.py", """
+            import numpy as np
+            def _execute_independent(self, inputs):
+                return np.asarray(inputs)
+            """)
+        write(tmp_path, "models/transformer.py", """
+            import numpy as np
+            def _worker_loop(self):
+                return np.asarray(self._pair)
+            """)
+        assert lint_dir(tmp_path, "DEVICE-SYNC") == []
+
+    def test_pragma_with_reason_suppresses(self, tmp_path):
+        write(tmp_path, "models/decode.py", """
+            import numpy as np
+            def finish_readback(arr):
+                # tpu-lint: disable=DEVICE-SYNC the one resolve point
+                return np.asarray(arr)
+            """)
+        assert lint_dir(tmp_path, "DEVICE-SYNC") == []
+
+    def test_repo_resolve_pragma_is_load_bearing(self):
+        # strip the pragma from the repo's own finish_readback and the
+        # rule must fire — the contract is suppressed-by-reason, not
+        # invisible-to-the-rule
+        src = open(os.path.join(
+            _REPO_ROOT, "triton_client_tpu", "models", "decode.py")).read()
+        assert "disable=DEVICE-SYNC" in src
+        stripped = "\n".join(
+            line for line in src.splitlines()
+            if "disable=DEVICE-SYNC" not in line)
+        import pathlib
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            p = pathlib.Path(td) / "models" / "decode.py"
+            p.parent.mkdir(parents=True)
+            p.write_text(stripped)
+            found = lint_dir(pathlib.Path(td), "DEVICE-SYNC")
+        assert any(fd.rule == "DEVICE-SYNC" for fd in found)
+
+
 class TestRepoGate:
     def test_repo_is_clean_under_the_full_suite(self, capsys):
         """The zero-finding gate: every rule over the whole repo, against
@@ -1185,6 +1280,9 @@ class TestRepoGate:
         # ISSUE 10 acceptance: WIRE-COPY ships with an empty baseline —
         # the wire-path copies were fixed or pragma'd, never grandfathered
         assert "WIRE-COPY" not in rules
+        # ISSUE 12 acceptance: DEVICE-SYNC too — the decode tick's syncs
+        # were moved on-device or pragma'd at the one resolve point
+        assert "DEVICE-SYNC" not in rules
 
     def test_console_script_registered(self):
         import re

@@ -498,6 +498,26 @@ def _slot_decode_layer(blk, x, kc, vc, pos, active,
     return _ffn(blk, x, cfg), kc, vc
 
 
+def _slot_forward(params, blocks, k, v, tokens, pos, active,
+                  cfg: tr.TransformerConfig):
+    """ONE slot-batched decode step — the shared per-step transformer
+    body (embed → per-layer cached-attention scan → final head) behind
+    :func:`make_slot_step` AND both fused multi-step kernels, so the
+    fused ticks' bit-identity to the single-step path is one
+    implementation, not hand-synced copies.  tokens [B] int32; returns
+    (k', v', raw logits [B, V])."""
+    x = jnp.take(params["embed"].astype(cfg.dtype),
+                 tokens[:, None], axis=0)                         # [B,1,D]
+
+    def layer(x, xs):
+        blk, kc, vc = xs
+        x, kc, vc = _slot_decode_layer(blk, x, kc, vc, pos, active, cfg)
+        return x, (kc, vc)
+
+    x, (k, v) = lax.scan(layer, x, (blocks, k, v))
+    return k, v, _head(params, x, cfg)[:, -1]                     # [B, V]
+
+
 def make_slot_step(cfg: tr.TransformerConfig):
     """jitted (params, k [L,B,H,S,K], v, tokens [B], prev [B], pos [B],
     active [B] bool, auto [B] bool) -> (greedy tokens [B] int32, best
@@ -522,65 +542,258 @@ def make_slot_step(cfg: tr.TransformerConfig):
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def step(params, k, v, tokens, prev, pos, active, auto):
         tokens = jnp.where(auto, prev, tokens)
-        x = jnp.take(params["embed"].astype(cfg.dtype),
-                     tokens[:, None], axis=0)                     # [B,1,D]
         blocks = _layer_blocks(params, cfg)
-
-        def layer(x, xs):
-            blk, kc, vc = xs
-            x, kc, vc = _slot_decode_layer(blk, x, kc, vc, pos, active,
-                                           cfg)
-            return x, (kc, vc)
-
-        x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
-        logits = _head(params, x, cfg)[:, -1]                     # [B, V]
+        ks, vs, logits = _slot_forward(params, blocks, k, v, tokens, pos,
+                                       active, cfg)
         nxt, best, lp = _greedy_head(logits)
         return nxt, best, lp, ks, vs
 
     return step
 
 
-def make_slot_step_pen(cfg: tr.TransformerConfig):
-    """Penalized variant of make_slot_step: identical tick, plus per-slot
-    OpenAI frequency/presence penalties applied at the greedy head and a
-    donated per-slot token-count matrix updated from the chosen token.
+def resolve_decode_steps() -> int:
+    """``TRITON_TPU_DECODE_STEPS``: decode steps fused into ONE device
+    dispatch by the batched worker (the T of the multi-step tick).
 
-    counts [B, V] int32; fp/pp [B] f32, zero for unpenalized slots (the
-    math degenerates to the plain head).  Only active AUTO slots add
-    their chosen token to counts — client-driven sequence steps consume
-    the CLIENT's token, and penalties are a generation-path feature.
-    Compiled only when a bucket actually holds a penalized generation
-    (the worker keeps the legacy kernel on the fast path otherwise).
+    Default 4: the PR 7 tick profiler put single-step tick assembly +
+    dispatch overhead at a large fraction of a decode tick at high
+    concurrency, and T=4 amortizes the per-dispatch host work (job
+    collection, one fused readback resolve, queue round trips) across 4
+    tokens while keeping admission/cancellation latency bounded at 4
+    steps (prefill/admit still runs between dispatches).  ``1`` restores
+    the single-step tick exactly; raise it on hosts where dispatch
+    overhead dominates (token streams are bit-identical at any T by
+    construction — the fused kernel runs the same per-step math)."""
+    import os
 
-    counts is deliberately NOT donated: the penalty head READS the buffer
-    the scatter update would write in place, and with donation the CPU
-    backend was observed starting the in-place write before the read
-    finished (flaky last-token corruption, 6-8/40 runs; an explicit
-    lax.optimization_barrier did not close it).  The copy this costs is
-    one [B, V] int32 per tick — noise against the tick's matmuls."""
+    v = os.environ.get("TRITON_TPU_DECODE_STEPS", "")
+    if v in ("", "auto"):
+        return 4
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(
+            f"TRITON_TPU_DECODE_STEPS={v!r}: expected a positive integer "
+            "or 'auto'")
+    if n < 1:
+        raise ValueError(f"TRITON_TPU_DECODE_STEPS={n} must be >= 1")
+    return n
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def step(params, k, v, tokens, prev, pos, active, auto,
-             counts, fp, pp):
-        tokens = jnp.where(auto, prev, tokens)
-        x = jnp.take(params["embed"].astype(cfg.dtype),
-                     tokens[:, None], axis=0)                     # [B,1,D]
+
+def start_readback(arr):
+    """Begin the device->host transfer for ``arr`` WITHOUT blocking the
+    caller (jax async dispatch: the copy overlaps whatever the device
+    and host do next).  Pairs with :func:`finish_readback` — the
+    double-buffer pattern every decode readback shares: the dispatching
+    thread starts the copy, and by the time a resolver thread (or the
+    next protocol step) lands in finish_readback the bytes are usually
+    already host-side."""
+    if hasattr(arr, "copy_to_host_async"):
+        arr.copy_to_host_async()
+    return arr
+
+
+def finish_readback(arr):
+    """Resolve a previously-started readback to a numpy array — the ONE
+    deliberate blocking sync point of the decode double buffer (resolver
+    threads block here so the worker/dispatch thread never does)."""
+    import numpy as np
+
+    # tpu-lint: disable=DEVICE-SYNC the ONE double-buffer resolve point
+    return np.asarray(arr)
+
+
+def _new_decode_state(cnt: int):
+    """Device-resident per-slot control state for one cache bucket.
+
+    The batched worker used to re-upload tokens/active/auto/pos (and the
+    penalty rows) from host arrays on EVERY tick; this dict lives on
+    device, is DONATED through the fused step kernel, and is updated by
+    the kernel itself — steady-state generation re-crosses the
+    host<->device boundary only for the one fused token readback.
+
+    * ``tokens``: last client-supplied token per slot (client-driven
+      sequence steps; auto slots ignore it),
+    * ``prev``: the slot's previous greedy output — the self-feeding
+      loop's device-resident feedback,
+    * ``pos``: absolute decode position (host keeps an exact mirror for
+      admission/eviction decisions — see ``_worker_loop``),
+    * ``active``: slot computes-and-writes this step,
+    * ``auto``: slot self-feeds (server-side generation),
+    * ``remaining``: tokens left for an auto slot before it deactivates
+      on device."""
+    return {
+        "tokens": jnp.zeros(cnt, jnp.int32),
+        "prev": jnp.zeros(cnt, jnp.int32),
+        "pos": jnp.zeros(cnt, jnp.int32),
+        "active": jnp.zeros(cnt, bool),
+        "auto": jnp.zeros(cnt, bool),
+        "remaining": jnp.zeros(cnt, jnp.int32),
+    }
+
+
+@jax.jit
+def _state_admit(state, li, prev_tok, pos, self_feed, remaining):
+    """Prefill finished for bucket-local slot ``li``: seed the device-side
+    feedback token and position.  ``self_feed`` activates the slot (a
+    server-side generation that will tick itself); client-driven
+    sequence slots stay inactive — their steps arrive per tick via the
+    dispatch's step mask."""
+    return {
+        "tokens": state["tokens"],
+        "prev": state["prev"].at[li].set(prev_tok),
+        "pos": state["pos"].at[li].set(pos),
+        "active": state["active"].at[li].set(self_feed),
+        "auto": state["auto"].at[li].set(self_feed),
+        "remaining": state["remaining"].at[li].set(remaining),
+    }
+
+
+@jax.jit
+def _state_deactivate(state, li):
+    """Cancellation/reap: stop a self-feeding slot on device (the kernel
+    deactivates completed slots itself; this is for consumers that went
+    away mid-generation)."""
+    return dict(state,
+                active=state["active"].at[li].set(False),
+                auto=state["auto"].at[li].set(False))
+
+
+def _fused_tick_frame(n_steps: int):
+    """Shared scaffolding for the fused multi-step tick kernels: merge
+    the dispatch's client-step mask into the resident state, run
+    ``body_step`` under a ``lax.while_loop`` with the on-device
+    all-inactive early exit, and stack per-step outputs into the
+    ``[rows, T, B]`` readback block."""
+
+    def run(k, v, state, step_mask, step_tokens, extra, body_step, rows):
+        B = step_mask.shape[0]
+        st0 = dict(
+            state,
+            tokens=jnp.where(step_mask, step_tokens, state["tokens"]),
+            active=state["active"] | step_mask,
+        )
+        out0 = jnp.zeros((rows, n_steps, B), jnp.float32)
+
+        def cond(carry):
+            t, _k, _v, st, _out, _extra = carry
+            # early exit: a draining cohort (every slot done/deactivated)
+            # stops paying steps the host would discard
+            return (t < n_steps) & jnp.any(st["active"])
+
+        def body(carry):
+            t, k, v, st, out, extra = carry
+            k, v, row, nxt, extra = body_step(k, v, st, extra)
+            out = lax.dynamic_update_slice(
+                out, row[:, None, :], (0, t, 0))
+            act, auto = st["active"], st["auto"]
+            rem = st["remaining"] - (act & auto)
+            pos = st["pos"] + act
+            done = auto & act & ((rem <= 0) | (pos >= _cache_seq_len(k)))
+            st = {
+                "tokens": st["tokens"],
+                # client-driven slots ran their ONE step — deactivate;
+                # auto slots deactivate when drained or at the slab cap
+                "prev": jnp.where(act, nxt, st["prev"]),
+                "pos": pos,
+                "active": act & auto & ~done,
+                "auto": auto & ~done,
+                "remaining": rem,
+            }
+            return (t + 1, k, v, st, out, extra)
+
+        t, k, v, st, out, extra = lax.while_loop(
+            cond, body, (jnp.int32(0), k, v, st0, out0, extra))
+        return k, v, st, out, t, extra
+
+    return run
+
+
+def make_fused_slot_step(cfg: tr.TransformerConfig, n_steps: int):
+    """jitted (params, k, v, state, step_mask, step_tokens) ->
+    (k', v', state', out [3, T, B] f32, steps_run).
+
+    Runs up to ``n_steps`` (T) decode steps in ONE device dispatch,
+    carrying cache AND control state on device:
+
+    * ``state`` (see :func:`_new_decode_state`) is DONATED and updated
+      by the kernel itself — a steady-state generation tick uploads
+      nothing host->device;
+    * ``step_mask``/``step_tokens`` merge this dispatch's client-driven
+      sequence steps in: their slots run exactly ONE step (step 0) and
+      deactivate — the closed-loop client owns their next token;
+    * self-feeding (auto) slots consume their own previous output and
+      deactivate ON DEVICE when ``remaining`` runs out or the slab cap
+      is hit; the loop exits early once every slot is inactive;
+    * ``out[0]`` = greedy tokens, ``out[1]`` = best raw logits,
+      ``out[2]`` = chosen-token logprobs, per (step, slot); rows at or
+      past ``steps_run`` are zeros the host never reads.
+
+    Per-step math is EXACTLY :func:`make_slot_step`'s — token streams
+    are bit-identical to the single-step tick at any T by construction.
+    k/v/state donated (see make_slot_step)."""
+
+    frame = _fused_tick_frame(n_steps)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def fused(params, k, v, state, step_mask, step_tokens):
         blocks = _layer_blocks(params, cfg)
 
-        def layer(x, xs):
-            blk, kc, vc = xs
-            x, kc, vc = _slot_decode_layer(blk, x, kc, vc, pos, active,
-                                           cfg)
-            return x, (kc, vc)
+        def body_step(k, v, st, extra):
+            toks = jnp.where(st["auto"], st["prev"], st["tokens"])
+            k, v, logits = _slot_forward(params, blocks, k, v, toks,
+                                         st["pos"], st["active"], cfg)
+            nxt, best, lp = _greedy_head(logits)
+            row = jnp.stack([nxt.astype(jnp.float32), best, lp])
+            return k, v, row, nxt, extra
 
-        x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
-        logits = _head(params, x, cfg)[:, -1]                     # [B, V]
-        nxt, best, lp = _pen_head(logits, counts, fp, pp)
-        take = (active & auto).astype(jnp.int32)
-        counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(take)
-        return nxt, best, lp, ks, vs, counts
+        k, v, st, out, t, _ = frame(k, v, state, step_mask, step_tokens,
+                                    jnp.int32(0), body_step, 3)
+        return k, v, st, out, t
 
-    return step
+    return fused
+
+
+def make_fused_slot_step_pen(cfg: tr.TransformerConfig, n_steps: int):
+    """Penalized variant of :func:`make_fused_slot_step`: per-slot
+    OpenAI frequency/presence penalties (``fp*count + pp*(count>0)``
+    subtracted at the greedy head) applied each step, with the count
+    matrix carried on device across the fused steps — only active AUTO
+    slots add their chosen token to counts (client-driven steps consume
+    the CLIENT's token; penalties are a generation-path feature).
+    ``fp``/``pp`` are device-resident per-slot vectors, updated at
+    admission/release rather than per tick; zero entries degenerate to
+    the plain head, and the worker compiles this kernel only for buckets
+    actually holding a penalized generation.
+
+    ``counts`` is deliberately NOT donated: the penalty head READS the
+    buffer the scatter update would write in place, and with donation
+    the CPU backend was observed starting the in-place write before the
+    read finished (flaky last-token corruption, 6-8/40 runs; an explicit
+    lax.optimization_barrier did not close it).  The copy this costs is
+    one [B, V] int32 per dispatch — noise against the tick's matmuls."""
+
+    frame = _fused_tick_frame(n_steps)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def fused(params, k, v, state, step_mask, step_tokens, counts, fp, pp):
+        blocks = _layer_blocks(params, cfg)
+
+        def body_step(k, v, st, counts):
+            toks = jnp.where(st["auto"], st["prev"], st["tokens"])
+            k, v, logits = _slot_forward(params, blocks, k, v, toks,
+                                         st["pos"], st["active"], cfg)
+            nxt, best, lp = _pen_head(logits, counts, fp, pp)
+            take = (st["active"] & st["auto"]).astype(jnp.int32)
+            counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(take)
+            row = jnp.stack([nxt.astype(jnp.float32), best, lp])
+            return k, v, row, nxt, counts
+
+        k, v, st, out, t, counts = frame(
+            k, v, state, step_mask, step_tokens, counts, body_step, 3)
+        return k, v, st, out, t, counts
+
+    return fused
 
 
 def make_slot_prefill(cfg: tr.TransformerConfig):
@@ -766,6 +979,10 @@ class DecodeModel:
             raise ValueError(
                 "TRITON_TPU_KV_QUANT requires TRITON_TPU_DECODE_MODE="
                 "batched (independent mode has no shared slot cache)")
+        # multi-step fused ticks: T decode steps per device dispatch
+        # (batched mode; validated eagerly so a bad value fails at
+        # registration, not at the first generation)
+        self._decode_steps = resolve_decode_steps()
         n_slots = sum(c for c, _ in self._buckets)
         self._n_slots = n_slots
         self._s_max = max(cap for _, cap in self._buckets)
@@ -794,7 +1011,13 @@ class DecodeModel:
             def unload(inner):
                 outer._shutdown()
 
+            def attach_device_stats(inner, ds):
+                outer.attach_device_stats(ds)
+
         self._model = _Impl(cfg)
+        # device/scheduler observability sink (attach_device_stats): the
+        # worker records one nv_tpu_tick_* row per fused dispatch into it
+        self._device_stats = None
         self._state: Dict[Any, int] = {}      # seq_id -> slot
         self._free = set(range(n_slots))
         self._touched: Dict[Any, float] = {}
@@ -823,6 +1046,14 @@ class DecodeModel:
     @property
     def model(self):
         return self._model
+
+    def attach_device_stats(self, ds) -> None:
+        """Attach the serving core's ``DeviceStatsCollector`` (idempotent;
+        the core stamps it on first execution, tests attach directly).
+        The batched worker then records one tick row per fused dispatch:
+        steps-per-dispatch, control uploads, and the single fused D2H
+        sync — the counters that prove the fast path stays fast."""
+        self._device_stats = ds
 
     # -- lazy init ---------------------------------------------------------
     def _ensure_params(self):
@@ -893,14 +1124,22 @@ class DecodeModel:
                     # (or int8 {q,s} pair) per slab bucket — every shape
                     # stays static.  dp divides every bucket count by
                     # construction: decode_mesh was built against the gcd
-                    self._k, self._v, self._prev_nxt = [], [], []
+                    self._k, self._v, self._dstate = [], [], []
+                    self._zero_mask, self._zero_tok = [], []
                     for cnt, cap in self._buckets:
                         kb, vb = self._new_cache_arrays(cnt, cap, cfg)
                         self._k.append(kb)
                         self._v.append(vb)
-                        # device-resident previous-tick outputs: the
-                        # feedback for self-feeding (generation) slots
-                        self._prev_nxt.append(jnp.zeros(cnt, jnp.int32))
+                        # device-resident control state (tokens/prev/pos/
+                        # active/auto/remaining): donated through the
+                        # fused tick and updated by the kernel itself, so
+                        # steady-state generation uploads nothing per tick
+                        self._dstate.append(_new_decode_state(cnt))
+                        # cached zeros for pure-generation dispatches: a
+                        # tick with no client-driven steps reuses these
+                        # device arrays instead of paying an H2D upload
+                        self._zero_mask.append(jnp.zeros(cnt, bool))
+                        self._zero_tok.append(jnp.zeros(cnt, jnp.int32))
                     # worker-owned self-feeding slot registry
                     self._auto_slots = {}
                     # (slot, gen) pairs whose sink resolution failed; the
@@ -946,12 +1185,24 @@ class DecodeModel:
                                     for c, _ in self._buckets]
                     self._pen_pp = [np.zeros(c, np.float32)
                                     for c, _ in self._buckets]
+                    # device-resident penalty scalars (per slot, updated
+                    # at admission/release — the per-tick fp/pp uploads
+                    # are gone with the rest of the control state)
+                    self._pen_fp_dev = [jnp.zeros(c, jnp.float32)
+                                        for c, _ in self._buckets]
+                    self._pen_pp_dev = [jnp.zeros(c, jnp.float32)
+                                        for c, _ in self._buckets]
                     self._pen_n = [0] * len(self._buckets)
                     self._slot_pen_seed = {}  # slot -> (fp, pp, row np)
-                    self._step_pen_fn = make_slot_step_pen(cfg)
                     self._prefill_pen_fn = make_slot_prefill_pen(cfg)
-                    fns = (make_slot_prefill(cfg),
-                           make_slot_step(cfg), params, cfg)
+                    # the fused multi-step tick kernels (T from
+                    # TRITON_TPU_DECODE_STEPS; T=1 == the legacy
+                    # single-step tick, same math either way)
+                    self._fused_fn = make_fused_slot_step(
+                        cfg, self._decode_steps)
+                    self._fused_pen_fn = make_fused_slot_step_pen(
+                        cfg, self._decode_steps)
+                    fns = (make_slot_prefill(cfg), params, cfg)
                     self._fns = fns
                     self._worker.start()
         return self._fns
@@ -1039,7 +1290,7 @@ class DecodeModel:
 
         import numpy as np
 
-        prefill, step, params, cfg = self._fns
+        prefill, params, cfg = self._fns
 
         def fail_stale(fut):
             from ..server.types import InferError
@@ -1085,21 +1336,29 @@ class DecodeModel:
             (with its logprob), seeds the device-side feedback for tick 1,
             and registers the slot as self-feeding."""
             self._pos[slot] = win_len
+            b, li = self._slot_bucket(slot)
             if completion[0] == "fut":
-                pair = jnp.stack([nxt_dev.astype(jnp.float32), best_dev])
-                if hasattr(pair, "copy_to_host_async"):
-                    pair.copy_to_host_async()
+                # sequence slot: seed the device-side position (its
+                # client-driven steps advance it in-kernel from here);
+                # stays inactive — each step arrives via the dispatch mask
+                self._dstate[b] = _state_admit(
+                    self._dstate[b], li, nxt_dev, win_len, False, 0)
+                pair = start_readback(
+                    jnp.stack([nxt_dev.astype(jnp.float32), best_dev]))
                 # pipelined like step readbacks: the blocking D2H must not
                 # stall the tick loop for a device round trip
                 self._readers.submit(self._resolve_prefill, pair,
                                      completion[1])
                 return
             _tag, n_tokens, sink = completion
-            b, li = self._slot_bucket(slot)
-            self._prev_nxt[b] = self._prev_nxt[b].at[li].set(nxt_dev)
-            pair = jnp.stack([nxt_dev.astype(jnp.float32), lp_dev])
-            if hasattr(pair, "copy_to_host_async"):
-                pair.copy_to_host_async()
+            # self-feeding generation: activate the slot on device with
+            # its feedback token and remaining budget — the fused tick
+            # deactivates it on device when the budget drains
+            self._dstate[b] = _state_admit(
+                self._dstate[b], li, nxt_dev, win_len, n_tokens > 1,
+                n_tokens - 1)
+            pair = start_readback(
+                jnp.stack([nxt_dev.astype(jnp.float32), lp_dev]))
             self._gen_reader.submit(self._resolve_gen_token, pair,
                                     sink, n_tokens == 1, slot, gen)
             if n_tokens > 1:
@@ -1120,11 +1379,14 @@ class DecodeModel:
                 info = self._auto_slots.get(slot)
                 if info is not None and info["gen"] == gen:
                     self._auto_slots.pop(slot)
+                    self._deactivate_slot(slot)
                     self._release_gen_slot(slot)
 
         def retire_cancelled(slot, sink):
             """One place for cancelled-generation bookkeeping: free the slot
-            and end the (departed) consumer's sink stream."""
+            (stopping its device-side self-feed) and end the (departed)
+            consumer's sink stream."""
+            self._deactivate_slot(slot)
             self._release_gen_slot(slot)
             self._gen_reader.submit(sink.put, None)
 
@@ -1197,6 +1459,13 @@ class DecodeModel:
                             jnp.float32(fp), jnp.float32(pp))
                         self._pen_counts[b] = \
                             self._pen_counts[b].at[li].set(new_row)
+                        # device-resident penalty scalars: written ONCE at
+                        # admission (and zeroed at release) instead of
+                        # re-uploaded every tick
+                        self._pen_fp_dev[b] = \
+                            self._pen_fp_dev[b].at[li].set(fp)
+                        self._pen_pp_dev[b] = \
+                            self._pen_pp_dev[b].at[li].set(pp)
                         with self._lock:
                             self._pen_fp[b][li] = fp
                             self._pen_pp[b][li] = pp
@@ -1293,24 +1562,30 @@ class DecodeModel:
                     self._jobs.put(d)
             if not batch and not self._auto_slots:
                 continue
+            t_asm0 = time.monotonic_ns()
+            queue_depth = self._jobs.qsize()
             # group this tick's work by slab bucket — each bucket is its
-            # own static-shape device step (one step total when unbucketed)
+            # own static-shape device dispatch (one when unbucketed)
             work = [None] * len(self._buckets)
 
             def bucket_work(b):
                 if work[b] is None:
-                    cnt = self._buckets[b][0]
-                    work[b] = {"tokens": np.zeros(cnt, np.int32),
-                               "active": np.zeros(cnt, bool),
-                               "auto": np.zeros(cnt, bool),
+                    # tokens/mask stay None until a client step needs
+                    # them: the steady-state pure-generation tick must
+                    # not pay two host allocations per dispatch
+                    work[b] = {"tokens": None, "mask": None,
                                "batch": [], "gens": []}
                 return work[b]
 
             for (slot, tok), f in batch:
                 b, li = self._slot_bucket(slot)
                 w = bucket_work(b)
+                if w["tokens"] is None:
+                    cnt = self._buckets[b][0]
+                    w["tokens"] = np.zeros(cnt, np.int32)
+                    w["mask"] = np.zeros(cnt, bool)
                 w["tokens"][li] = tok
-                w["active"][li] = True
+                w["mask"][li] = True
                 w["batch"].append((li, f))
             for slot in list(self._auto_slots):
                 info = self._auto_slots[slot]
@@ -1320,10 +1595,8 @@ class DecodeModel:
                     self._auto_slots.pop(slot)
                     continue
                 b, li = self._slot_bucket(slot)
-                w = bucket_work(b)
-                w["active"][li] = True
-                w["auto"][li] = True
-                w["gens"].append((slot, li))
+                bucket_work(b)["gens"].append((slot, li))
+            T = self._decode_steps
             for b, w in enumerate(work):
                 if w is None:
                     continue
@@ -1332,50 +1605,50 @@ class DecodeModel:
                 # bound how far device dispatch runs ahead of readbacks: a
                 # pure-auto loop would otherwise enqueue ticks unboundedly
                 self._tick_budget.acquire()
-                # EXPLICIT np.array COPIES of host state the worker mutates
-                # after dispatch (pos += 1 below; fp/pp zeroed on release):
-                # under async dispatch the backend may capture an aligned
-                # numpy buffer zero-copy, and a mutation landing before the
-                # lagging execution reads it corrupts that tick (observed:
-                # flaky wrong last tokens at pipeline depth, 8/40 runs —
-                # the penalized kernel's longer executions widened a window
-                # the legacy tick also had)
-                pos_snap = jnp.asarray(np.array(self._pos[off:off + cnt]))
+                uploads = 0
+                if w["batch"]:
+                    # the ONLY per-tick H2D control uploads left: this
+                    # dispatch's client-driven tokens and their slot mask
+                    # — fresh arrays built above, never mutated after
+                    # dispatch, so async capture is safe.  Pure-generation
+                    # ticks (the steady-state hot path) take the else
+                    # branch: cached device zeros, ZERO uploads.
+                    step_tokens = jnp.asarray(w["tokens"])
+                    step_mask = jnp.asarray(w["mask"])
+                    uploads = 2
+                else:
+                    step_tokens = self._zero_tok[b]
+                    step_mask = self._zero_mask[b]
+                # host control-path cost split: assembly (job collection
+                # + upload prep, ends HERE) vs the dispatch call below —
+                # on CPU the jit call blocks on compute, so folding it
+                # into assembly would make the host-overhead counter lie
+                t_disp0 = time.monotonic_ns()
                 try:
                     if self._pen_n[b] > 0:
                         # >=1 penalized generation in this bucket: the
-                        # penalized tick (per-slot counts + fp/pp, zero
-                        # rows degenerate to the plain head for everyone
-                        # else); buckets without penalties never pay this
-                        (nxt, best, lp, self._k[b], self._v[b],
-                         self._pen_counts[b]) = self._step_pen_fn(
-                            params, self._k[b], self._v[b],
-                            jnp.asarray(w["tokens"]), self._prev_nxt[b],
-                            pos_snap,
-                            jnp.asarray(w["active"]),
-                            jnp.asarray(w["auto"]),
-                            self._pen_counts[b],
-                            jnp.asarray(np.array(self._pen_fp[b])),
-                            jnp.asarray(np.array(self._pen_pp[b])))
+                        # penalized tick (per-slot counts + device-resident
+                        # fp/pp, zero rows degenerate to the plain head for
+                        # everyone else); unpenalized buckets never pay it
+                        (self._k[b], self._v[b], self._dstate[b], out,
+                         _steps_dev, self._pen_counts[b]) = \
+                            self._fused_pen_fn(
+                                params, self._k[b], self._v[b],
+                                self._dstate[b], step_mask, step_tokens,
+                                self._pen_counts[b], self._pen_fp_dev[b],
+                                self._pen_pp_dev[b])
                     else:
-                        nxt, best, lp, self._k[b], self._v[b] = step(
+                        (self._k[b], self._v[b], self._dstate[b], out,
+                         _steps_dev) = self._fused_fn(
                             params, self._k[b], self._v[b],
-                            jnp.asarray(w["tokens"]), self._prev_nxt[b],
-                            pos_snap,
-                            jnp.asarray(w["active"]), jnp.asarray(w["auto"]))
-                    self._prev_nxt[b] = nxt
-                    pair = jnp.stack([nxt.astype(jnp.float32), best, lp])
-                    if hasattr(pair, "copy_to_host_async"):
-                        # prefetch the D2H NOW: the resolver threads then
-                        # find the transfer already in flight, so readbacks
-                        # overlap later ticks instead of costing one RTT
-                        # each (the same trick the per-request generation
-                        # chain uses)
-                        pair.copy_to_host_async()
+                            self._dstate[b], step_mask, step_tokens)
+                    # prefetch the [3, T, B] token block NOW: the resolver
+                    # thread then finds the one fused D2H already in
+                    # flight, so readbacks overlap later dispatches
+                    # instead of costing one RTT each
+                    start_readback(out)
                     for li, _f in w["batch"]:
                         self._pos[off + li] += 1
-                    for slot, _li in w["gens"]:
-                        self._pos[slot] += 1
                 except Exception as e:  # noqa: BLE001 — via futures
                     self._tick_budget.release()
                     for _li, f in w["batch"]:
@@ -1384,47 +1657,76 @@ class DecodeModel:
                         info = self._auto_slots.pop(slot)
                         self._gen_reader.submit(info["sink"].put, e)
                     self._rebuild_bucket_cache(b)
+                    # the next bucket's assembly window must not absorb
+                    # this failed dispatch + cache rebuild
+                    t_asm0 = time.monotonic_ns()
                     continue
-                # which generations end on this tick (token streamed, then
-                # the slot frees; the readback snapshot keeps its values
-                # valid even if the slot is reused by a later tick)
+                # host-side advance prediction — the "periodically
+                # refreshed mirror" is in fact EXACT: greedy decode has no
+                # data-dependent stop inside the kernel, so an auto slot
+                # advances precisely min(T, remaining, cap - pos) steps
+                # (the kernel deactivates it on device at the same step
+                # the host predicts), and a client-driven slot advances 1.
+                # No device readback feeds admission/eviction decisions.
+                steps_run = 1 if w["batch"] else 0
                 gen_batch = []
                 for slot, li in w["gens"]:
                     info = self._auto_slots[slot]
-                    info["remaining"] -= 1
-                    done = info["remaining"] <= 0
-                    if done or self._pos[slot] >= cap:
-                        done = True
+                    adv = min(T, info["remaining"],
+                              cap - int(self._pos[slot]))
+                    self._pos[slot] += adv
+                    info["remaining"] -= adv
+                    steps_run = max(steps_run, adv)
+                    done = (info["remaining"] <= 0
+                            or int(self._pos[slot]) >= cap)
+                    if done:
+                        # the kernel already deactivated the slot on
+                        # device; the readback snapshot keeps its values
+                        # valid even if a later tick reuses the slot
                         self._auto_slots.pop(slot)
                         self._release_gen_slot(slot)
-                    gen_batch.append((li, slot, info["sink"], done,
+                    gen_batch.append((li, slot, info["sink"], adv, done,
                                       info["gen"]))
+                ds = self._device_stats
+                if ds is not None and ds.enabled:
+                    # one tick row per fused dispatch: steps-per-dispatch
+                    # and control-upload counters are the measurable form
+                    # of the fast path (gen_tick_breakdown / triton-top
+                    # buckets view / the no-upload regression test)
+                    ds.record_tick(
+                        self._model.name, bucket=cap,
+                        batch=len(w["batch"]) + len(w["gens"]),
+                        padded=cnt, queue_depth=queue_depth,
+                        assembly_ns=t_disp0 - t_asm0,
+                        compute_ns=time.monotonic_ns() - t_disp0,
+                        requests=len(w["batch"]), syncs=1,
+                        steps=steps_run, uploads=uploads)
                 # PIPELINE the readback: over a remote device the blocking
                 # D2H costs a full round trip; resolving it on a reader
-                # thread lets the next tick's compute dispatch immediately,
-                # so round trips overlap instead of gating the tick rate.
-                # Safe because a sequence never has two steps in flight
-                # (closed loop + per-seq lock): tick N+1 only carries other
-                # sequences' tokens.
+                # thread lets the next dispatch's compute start
+                # immediately, so round trips overlap instead of gating
+                # the tick rate (double-buffered, bounded by
+                # _tick_budget).  Safe because a sequence never has two
+                # steps in flight (closed loop + per-seq lock): dispatch
+                # N+1 only carries other sequences' tokens.
                 pool = self._gen_reader if gen_batch else self._readers
-                pool.submit(self._resolve_tick, pair, w["batch"], gen_batch,
+                pool.submit(self._resolve_tick, out, w["batch"], gen_batch,
                             self._tick_budget)
+                # next bucket's assembly window starts fresh: it must not
+                # absorb this bucket's dispatch time
+                t_asm0 = time.monotonic_ns()
 
     @staticmethod
     def _resolve_prefill(pair, fut):
-        import numpy as np
-
         try:
-            vals = np.asarray(pair)
+            vals = finish_readback(pair)
             fut.set_result((int(vals[0]), float(vals[1])))
         except Exception as e:  # noqa: BLE001 — surfaced via future
             fut.set_exception(e)
 
     def _resolve_gen_token(self, pair_dev, sink, done, slot, gen):
-        import numpy as np
-
         try:
-            vals = np.asarray(pair_dev)
+            vals = finish_readback(pair_dev)
             sink.put((int(vals[0]), float(vals[1])))
             if done:
                 sink.put(None)
@@ -1433,30 +1735,35 @@ class DecodeModel:
             with self._lock:
                 self._dead_gens.add((slot, gen))
 
-    def _resolve_tick(self, pair, batch, gen_batch=(), budget=None):
-        """batch: [(idx, fut)]; gen_batch: [(idx, slot, sink, done, gen)]
-        — idx is bucket-local (``pair`` holds that bucket's step output),
-        slot stays global for dead-generation bookkeeping."""
-        import numpy as np
+    def _resolve_tick(self, out, batch, gen_batch=(), budget=None):
+        """Resolve one fused dispatch's ``[3, T, B]`` token block.
 
+        batch: [(li, fut)] — client-driven steps, resolved from their one
+        step-0 row; gen_batch: [(li, slot, sink, n_emit, done, gen)] —
+        each generation's ``n_emit`` step rows stream in order.  li is
+        bucket-local (``out`` holds that bucket's block), slot stays
+        global for dead-generation bookkeeping."""
         try:
-            vals = np.asarray(pair)  # one fused D2H for the whole tick
+            # ONE fused (and pre-started) D2H for the whole multi-step
+            # dispatch — the only blocking sync the fast path pays
+            vals = finish_readback(out)
         except Exception as e:  # noqa: BLE001 — surfaced via futures/sinks
             if budget is not None:
                 budget.release()
-            for _idx, f in batch:
+            for _li, f in batch:
                 f.set_exception(e)
-            for _idx, slot, sink, _done, gen in gen_batch:
+            for _li, slot, sink, _n_emit, _done, gen in gen_batch:
                 sink.put(e)
                 with self._lock:
                     self._dead_gens.add((slot, gen))
             return
         if budget is not None:
             budget.release()
-        for idx, f in batch:
-            f.set_result((int(vals[0, idx]), float(vals[1, idx])))
-        for idx, _slot, sink, done, _gen in gen_batch:
-            sink.put((int(vals[0, idx]), float(vals[2, idx])))
+        for li, f in batch:
+            f.set_result((int(vals[0, 0, li]), float(vals[1, 0, li])))
+        for li, _slot, sink, n_emit, done, _gen in gen_batch:
+            for t in range(n_emit):
+                sink.put((int(vals[0, t, li]), float(vals[2, t, li])))
             if done:
                 sink.put(None)
 
@@ -1528,7 +1835,10 @@ class DecodeModel:
             # reallocates via _ensure_pen_bucket
             self._pen_counts[b] = None
             self._k[b], self._v[b] = self._new_cache_arrays(cnt, cap, cfg)
-            self._prev_nxt[b] = jnp.zeros(cnt, jnp.int32)
+            # the donated control state died with the failed dispatch too
+            self._dstate[b] = _new_decode_state(cnt)
+            self._pen_fp_dev[b] = jnp.zeros(cnt, jnp.float32)
+            self._pen_pp_dev[b] = jnp.zeros(cnt, jnp.float32)
         except Exception:  # noqa: BLE001 — e.g. the same OOM that failed
             # the step: a sane cache cannot be restored, so fail pending
             # work cleanly (503 via the drain path) instead of letting the
@@ -1560,13 +1870,28 @@ class DecodeModel:
             self._pen_pp[b][li] = 0.0
             self._pen_n[b] -= 1
 
+    def _deactivate_slot(self, slot):
+        """Worker-side: stop a slot's device-side self-feed (cancellation
+        and reap paths — normal completion deactivates in-kernel)."""
+        b, li = self._slot_bucket(slot)
+        self._dstate[b] = _state_deactivate(self._dstate[b], li)
+
     def _release_gen_slot(self, slot):
         """Worker-side: return a generation slot to the pool (no seq id to
         clean up; the generation bump invalidates any stale job)."""
+        b, li = self._slot_bucket(slot)
         with self._lock:
+            had_pen = (self._pen_fp[b][li] != 0.0
+                       or self._pen_pp[b][li] != 0.0)
             self._free.add(slot)
             self._slot_gen[slot] += 1
             self._clear_pen_locked(slot)
+        if had_pen:
+            # zero the device-resident scalars too: a later unpenalized
+            # occupant of this slot must not inherit stale penalties
+            # while the bucket still runs the penalized kernel
+            self._pen_fp_dev[b] = self._pen_fp_dev[b].at[li].set(0.0)
+            self._pen_pp_dev[b] = self._pen_pp_dev[b].at[li].set(0.0)
 
     def submit_generation(self, window, n_tokens: int,
                           freq_pen: float = 0.0, pres_pen: float = 0.0,
@@ -1709,12 +2034,18 @@ class DecodeModel:
                         f"TOKENS [1,1], got {list(toks.shape)}")
                 logits, cache = step(params, cache, jnp.asarray(toks))
                 host_pos += 1
-            # ONE fused D2H for both scalars — separate int()/float() reads
-            # pay a blocking device round trip each (≈90 ms over the tunnel)
-            pair = np.asarray(jnp.stack(
+            # ONE fused D2H for both scalars — separate int()/float()
+            # reads pay a blocking device round trip each (≈90 ms over
+            # the tunnel).  start/finish_readback is the same resolve
+            # pair the batched tick uses (one implementation for both
+            # modes); this protocol is synchronous per step, so the
+            # resolve still blocks here — the overlap win belongs to the
+            # pipelined batched path.
+            pair = start_readback(jnp.stack(
                 [jnp.argmax(logits, axis=-1)[0].astype(jnp.float32),
                  jnp.max(logits, axis=-1)[0]]))
-            nxt, best = int(pair[0]), float(pair[1])
+            vals = finish_readback(pair)
+            nxt, best = int(vals[0]), float(vals[1])
             with self._lock:
                 if end:
                     self._release_locked(seq_id)
@@ -1740,7 +2071,7 @@ class DecodeModel:
             raise InferError(
                 f"inference request to model '{self._model.name}' must "
                 "specify a non-zero or non-empty correlation ID")
-        prefill, step, params, cfg = self._ensure_fns()
+        _prefill, _params, cfg = self._ensure_fns()
         toks = np.asarray(inputs["TOKENS"]).reshape(1, -1).astype(np.int32)
         toks = np.clip(toks, 0, cfg.vocab_size - 1)
         now = time.monotonic()
@@ -1871,6 +2202,11 @@ class GenerateModel:
 
             def execute_decoupled(inner, inputs, parameters):
                 return outer._generate(inputs, parameters)
+
+            def attach_device_stats(inner, ds):
+                # the generation path's ticks happen in the SHARED decode
+                # worker — route the collector there
+                outer._decode.attach_device_stats(ds)
 
         self.model = _Impl(cfg)
 
@@ -2037,7 +2373,7 @@ class GenerateModel:
             # generations cost ONE batched device step per token position,
             # with the feedback token never leaving the device.  Penalties
             # ride the tick too (per-slot count vectors; see
-            # make_slot_step_pen), so penalized greedy keeps batched
+            # make_fused_slot_step_pen), so penalized greedy keeps batched
             # capacity.  Sampled requests keep the per-request chain
             # below: RNG state is per-request.
             yield from self._generate_batched(
@@ -2087,16 +2423,14 @@ class GenerateModel:
             # (OpenAI semantics: logprobs report the unmodified
             # distribution, whatever sampling/penalties did), stacked with
             # the token so the prefetched readback stays ONE fused D2H
-            pair = jnp.stack([tok_dev.astype(jnp.float32),
-                              lp_of(logits, tok_dev)])
-            if hasattr(pair, "copy_to_host_async"):
-                pair.copy_to_host_async()
-            pair_devs.append(pair)
+            pair_devs.append(start_readback(
+                jnp.stack([tok_dev.astype(jnp.float32),
+                           lp_of(logits, tok_dev)])))
             if i < n_tokens - 1:
                 logits, cache = step(
                     params, cache, tok_dev.reshape(1, 1))
         for pair_dev in pair_devs:
-            vals = np.asarray(pair_dev)
+            vals = finish_readback(pair_dev)
             tok = int(vals[0, 0])
             # text_output: chr(token mod 256) as UTF-8 (JSON-safe; the byte
             # "detokenizer" aliases ids >= 256 at large vocab sizes, same as

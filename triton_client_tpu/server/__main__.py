@@ -298,8 +298,12 @@ def main() -> None:
     async def serve():
         import signal
 
-        from .frontends import stop_frontends
+        from .frontends import install_aio_noise_filter, stop_frontends
 
+        # grpc.aio poller wakeup races print benign BlockingIOError
+        # tracebacks through the default handler; filter that one
+        # signature (see frontends.install_aio_noise_filter)
+        install_aio_noise_filter(asyncio.get_running_loop())
         warmed = await core.warmup_models()
         if warmed:
             print(f"warmed up: {warmed}")

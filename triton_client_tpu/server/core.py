@@ -964,6 +964,12 @@ class InferenceCore:
         queue: asyncio.Queue = asyncio.Queue()
         _SENTINEL = object()
         consumer_gone = threading.Event()
+        # decoupled models never pass through _run_model's stats hook;
+        # hand device-loop models (llama_generate -> the decode worker)
+        # the collector here so generation ticks are observable too
+        attach = getattr(model, "attach_device_stats", None)
+        if attach is not None:
+            attach(self.device_stats)
         sync_gen = model.execute_decoupled(inputs, params)
 
         def _produce():
@@ -1282,6 +1288,12 @@ class InferenceCore:
                           getattr(v, "dtype", None))
                          for n, v in inputs.items()), key=lambda s: s[0]))
                 ds.declare_model(model.name, model.flops_per_element())
+                # models that run their own device loop (the decode
+                # worker's fused ticks) record tick rows directly; hand
+                # them the collector (idempotent attribute stamp)
+                attach = getattr(model, "attach_device_stats", None)
+                if attach is not None:
+                    attach(ds)
                 ds.record_execute(model.name,
                                   real_batch or _batch_count(inputs) or 1,
                                   t_c1 - t_c0, signature=sig)

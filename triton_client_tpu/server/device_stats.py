@@ -134,7 +134,8 @@ class _BucketStats:
 
     __slots__ = ("ticks", "batch_total", "padded_total", "requests_total",
                  "assembly_ns_total", "queue_depth_total", "queue_depth_max",
-                 "syncs_total", "compute_ns_total")
+                 "syncs_total", "compute_ns_total", "steps_total",
+                 "uploads_total")
 
     def __init__(self) -> None:
         self.ticks = 0
@@ -146,6 +147,8 @@ class _BucketStats:
         self.queue_depth_max = 0
         self.syncs_total = 0
         self.compute_ns_total = 0
+        self.steps_total = 0
+        self.uploads_total = 0
 
     def pad_waste(self) -> float:
         """Cumulative padded-but-unused fraction of executed batch slots."""
@@ -261,8 +264,18 @@ class DeviceStatsCollector:
 
     def record_tick(self, model: str, bucket: int, batch: int, padded: int,
                     queue_depth: int, assembly_ns: int, compute_ns: int = 0,
-                    requests: int = 1, syncs: int = 0) -> None:
-        """Record one dynamic-batcher tick (one batched execution)."""
+                    requests: int = 1, syncs: int = 0, steps: int = 1,
+                    uploads: int = 0) -> None:
+        """Record one dynamic-batcher tick (one batched execution) or one
+        decode-worker fused dispatch.
+
+        ``steps``: device steps fused into the dispatch (a batcher tick
+        is one step; the decode fast path runs up to T — dividing
+        ``steps_total`` by ``ticks`` gives steps-per-dispatch, the
+        multi-step amortization the fused tick exists for).
+        ``uploads``: host->device CONTROL-state uploads the dispatch
+        paid (0 on the steady-state generation path — the regression
+        counter that proves per-tick control re-uploads stay gone)."""
         if not self.enabled:
             return
         with self._lock:
@@ -279,6 +292,8 @@ class DeviceStatsCollector:
             bs.queue_depth_max = max(bs.queue_depth_max, int(queue_depth))
             bs.syncs_total += int(syncs)
             bs.compute_ns_total += int(compute_ns)
+            bs.steps_total += int(steps)
+            bs.uploads_total += int(uploads)
 
     def _prune_locked(self, cm: _ModelCompute, now: float) -> None:
         horizon = now - self.window_s
@@ -392,7 +407,8 @@ class DeviceStatsCollector:
             "transfer_total": [], "transfer_bytes": [],
             "tick_total": [], "tick_batch": [], "tick_padded": [],
             "tick_assembly_us": [], "tick_queue_depth": [],
-            "tick_syncs": [], "pad_waste": [],
+            "tick_syncs": [], "tick_steps": [], "tick_uploads": [],
+            "pad_waste": [],
             "mem_used": [], "mem_peak": [], "mem_limit": [],
         }
         for m in models:
@@ -419,6 +435,8 @@ class DeviceStatsCollector:
                 (labels, bs.assembly_ns_total // 1000))
             rows["tick_queue_depth"].append((labels, bs.queue_depth_total))
             rows["tick_syncs"].append((labels, bs.syncs_total))
+            rows["tick_steps"].append((labels, bs.steps_total))
+            rows["tick_uploads"].append((labels, bs.uploads_total))
             rows["pad_waste"].append((labels, round(bs.pad_waste(), 6)))
         for dev, stats in sorted(self.hbm_stats().items()):
             labels = {"device": dev}
@@ -493,6 +511,10 @@ class DeviceStatsCollector:
                     if bs.ticks else None),
                 "max_queue_depth": bs.queue_depth_max,
                 "syncs": bs.syncs_total,
+                "steps": bs.steps_total,
+                "avg_steps_per_tick": (round(
+                    bs.steps_total / bs.ticks, 2) if bs.ticks else None),
+                "uploads": bs.uploads_total,
             }
         return {
             "enabled": self.enabled,

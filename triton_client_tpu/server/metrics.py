@@ -109,6 +109,13 @@ _DEVICE_FAMILIES: List[Tuple[str, str, str, str]] = [
     ("tick_syncs", "nv_tpu_tick_sync_total", "counter",
      "Cumulative host<->device synchronization points paid by batcher "
      "ticks per model and bucket"),
+    ("tick_steps", "nv_tpu_tick_step_total", "counter",
+     "Cumulative device steps fused into batcher/decode ticks per model "
+     "and bucket (divide by nv_tpu_tick_total for steps per dispatch)"),
+    ("tick_uploads", "nv_tpu_tick_upload_total", "counter",
+     "Cumulative host->device control-state uploads paid by decode "
+     "ticks per model and bucket (0 on the steady-state generation "
+     "fast path)"),
     ("pad_waste", "nv_tpu_pad_waste_ratio", "gauge",
      "Cumulative padded-but-unused fraction of executed batch slots per "
      "model and bucket"),

@@ -91,6 +91,11 @@ class ServerHarness:
         loop = asyncio.new_event_loop()
         self._loop = loop
         asyncio.set_event_loop(loop)
+        # same benign-noise filter the CLI server installs: grpc.aio
+        # poller wakeup races must not flood harness/bench stderr
+        from .frontends import install_aio_noise_filter
+
+        install_aio_noise_filter(loop)
         loop.run_until_complete(self._serve())
         loop.close()
 

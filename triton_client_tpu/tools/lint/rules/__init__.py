@@ -1,5 +1,5 @@
 """Rule registration: importing this package registers every built-in
 checker with the engine's registry."""
 
-from . import (async_block, exc_contract, lock_order, metrics_decl,  # noqa: F401
-               span_pair, test_determinism, wire_copy)
+from . import (async_block, device_sync, exc_contract, lock_order,  # noqa: F401
+               metrics_decl, span_pair, test_determinism, wire_copy)

@@ -1,0 +1,119 @@
+"""DEVICE-SYNC: no blocking host<->device syncs inside the decode tick.
+
+Historical bug class: ISSUE 12's profile of the continuous-batching
+generation path (85.5 tok/s batched vs 236.1 independent at c=8) found
+the decode worker re-crossing the host/device boundary every tick — it
+re-uploaded host-side control state (``jnp.asarray`` of tokens/active/
+auto/penalty rows) before each dispatch and then blocked on a
+synchronous fused ``np.asarray`` readback.  The decode-tick fast path
+moved the control state onto the device (donated through the fused
+multi-step kernel) and double-buffered the readback
+(``start_readback``/``finish_readback``); this rule keeps blocking
+syncs from creeping back into the tick.
+
+What fires, inside ``models/decode.py`` ONLY and only within the
+worker-loop/tick-path functions (``_worker_loop`` and everything
+lexically nested in it, ``_resolve*``, ``_dispatch*``, and the shared
+``finish_readback`` resolve helper):
+
+* ``np.asarray(...)`` / ``np.array(...)`` — on a device array this is a
+  blocking D2H round trip; resolve through the started readback
+  (``finish_readback`` on a resolver thread) instead.
+* ``jax.device_get(...)`` — same sync, different spelling.
+* ``<x>.item()`` — scalar D2H sync per call.
+* ``<x>.block_until_ready()`` — an explicit barrier; the tick pipeline
+  exists to avoid exactly this.
+
+The deliberate sites carry a reasoned pragma (``# tpu-lint:
+disable=DEVICE-SYNC <why>``): the double-buffer has exactly ONE
+blocking resolve point (``finish_readback``, reached on reader threads
+after ``start_readback`` already put the transfer in flight).  Python
+``int(x)``/``float(x)`` on device arrays also sync but are statically
+indistinguishable from host conversions — out of scope, documented
+here.  The rule ships with an EMPTY baseline — new syncs can't ride in
+grandfathered.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .._ast_util import module_aliases, resolve_call_name
+from .._engine import Finding, Project, register_rule
+
+#: Only the decode model module is in scope: the rule encodes the decode
+#: worker's residency contract, not a repo-wide numpy policy.
+_DECODE_FILE = re.compile(r"(^|/)models/decode\.py$")
+
+#: Tick-path root functions: the worker loop (everything nested in it
+#: runs on the worker thread), the pipelined resolvers, and the shared
+#: blocking resolve helper.
+_ROOT_EXACT = {"_worker_loop", "finish_readback"}
+_ROOT_PREFIXES = ("_resolve", "_dispatch")
+
+#: Fully-qualified call targets that are blocking syncs on device arrays.
+_SYNC_CALLS = {
+    "numpy.asarray": "np.asarray blocks on a full D2H round trip",
+    "numpy.array": "np.array blocks on a full D2H round trip",
+    "jax.device_get": "jax.device_get is a blocking D2H sync",
+}
+
+#: Method names that sync regardless of receiver spelling.
+_SYNC_METHODS = {
+    "item": ".item() pays a blocking scalar D2H sync",
+    "block_until_ready": ".block_until_ready() is an explicit device "
+                         "barrier",
+}
+
+
+def _is_tick_root(name: str) -> bool:
+    return name in _ROOT_EXACT or any(
+        name.startswith(p) for p in _ROOT_PREFIXES)
+
+
+@register_rule(
+    "DEVICE-SYNC",
+    "no blocking host<->device syncs (np.asarray/jax.device_get/.item()/"
+    "block_until_ready) inside models/decode.py's worker-loop/tick-path "
+    "functions (pragma the one double-buffer resolve point)")
+def check(project: Project):
+    for f in project.files:
+        if f.tree is None:
+            continue
+        relpath = f.relpath.replace("\\", "/")
+        if not _DECODE_FILE.search(relpath):
+            continue
+        mods, names = module_aliases(f.tree)
+        seen: set = set()
+        for node in ast.walk(f.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _is_tick_root(node.name):
+                continue
+            # the WHOLE lexical extent is in scope, nested defs included:
+            # a helper defined inside the worker loop runs on the worker
+            # thread (the resolvers are themselves roots, with their own
+            # pragma'd resolve point)
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call) or id(call) in seen:
+                    continue
+                seen.add(id(call))
+                target = resolve_call_name(call, mods, names)
+                if target in _SYNC_CALLS:
+                    yield Finding(
+                        "DEVICE-SYNC", f.relpath, call.lineno,
+                        f"{_SYNC_CALLS[target]} inside the decode tick "
+                        f"path ({node.name}); start_readback at dispatch "
+                        "and finish_readback on a resolver thread, or "
+                        "pragma a deliberate resolve point",
+                        symbol=f.symbol_at(call.lineno))
+                elif isinstance(call.func, ast.Attribute) \
+                        and call.func.attr in _SYNC_METHODS \
+                        and not call.args and not call.keywords:
+                    yield Finding(
+                        "DEVICE-SYNC", f.relpath, call.lineno,
+                        f"{_SYNC_METHODS[call.func.attr]} inside the "
+                        f"decode tick path ({node.name}); keep the value "
+                        "on device or ride the fused tick readback",
+                        symbol=f.symbol_at(call.lineno))

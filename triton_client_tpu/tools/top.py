@@ -99,6 +99,8 @@ _BUCKET_METRICS = {
     "nv_tpu_tick_assembly_duration_us": "assembly_us",
     "nv_tpu_tick_queue_depth_total": "queue_depth",
     "nv_tpu_tick_sync_total": "syncs",
+    "nv_tpu_tick_step_total": "steps",
+    "nv_tpu_tick_upload_total": "uploads",
 }
 
 
@@ -309,6 +311,13 @@ def bucket_rows(cur: Dict[str, Any],
                                 if ticks else None),
             "syncs_per_tick": (round(delta("syncs") / ticks, 2)
                                if ticks else None),
+            # decode fast-path columns: steps fused per dispatch (the T
+            # amortization) and host->device control uploads per tick
+            # (~0 in steady-state generation)
+            "steps_per_tick": (round(delta("steps") / ticks, 2)
+                               if ticks else None),
+            "uploads_per_tick": (round(delta("uploads") / ticks, 2)
+                                 if ticks else None),
         }
     return rows
 
@@ -332,6 +341,10 @@ def aggregate_buckets(per_url: Dict[str, Dict[tuple, Dict[str, Any]]]
             vals = [r[field] for r in rows if r.get(field) is not None]
             return max(vals) if vals else None
 
+        def _least(field):
+            vals = [r[field] for r in rows if r.get(field) is not None]
+            return min(vals) if vals else None
+
         agg[key] = {
             "ticks_per_s": _sum("ticks_per_s"),
             "ticks": sum(r.get("ticks", 0.0) for r in rows),
@@ -340,6 +353,11 @@ def aggregate_buckets(per_url: Dict[str, Dict[tuple, Dict[str, Any]]]
             "avg_assembly_us": _worst("avg_assembly_us"),
             "avg_queue_depth": _worst("avg_queue_depth"),
             "syncs_per_tick": _worst("syncs_per_tick"),
+            # steps-per-dispatch: the LEAST-amortized replica is the
+            # straggler; uploads take the highest replica — any nonzero
+            # steady-state value is the regression smell
+            "steps_per_tick": _least("steps_per_tick"),
+            "uploads_per_tick": _worst("uploads_per_tick"),
         }
     return agg
 
@@ -555,7 +573,8 @@ def _bucket_lines(rows: Dict[tuple, Dict[str, Any]]) -> List[str]:
     rated = any(r.get("ticks_per_s") is not None for r in rows.values())
     tick_hdr = "TICK/s" if rated else "TICKS"
     lines = ["", f"  {'MODEL/BUCKET':<24}{tick_hdr:>8}{'AVGBATCH':>10}"
-                 f"{'PAD%':>7}{'ASM us':>9}{'QDEPTH':>8}{'SYNC/T':>8}"]
+                 f"{'PAD%':>7}{'ASM us':>9}{'QDEPTH':>8}{'SYNC/T':>8}"
+                 f"{'STEP/T':>8}{'UPL/T':>8}"]
     for (model, bucket), r in sorted(
             rows.items(), key=lambda kv: (kv[0][0], _bucket_rank(kv[0][1]))):
         ticks = r["ticks_per_s"] if rated else r.get("ticks")
@@ -563,7 +582,9 @@ def _bucket_lines(rows: Dict[tuple, Dict[str, Any]]) -> List[str]:
             f"  {model + '@' + str(bucket):<24}{_fmt(ticks):>8}"
             f"{_fmt(r['avg_batch']):>10}{_fmt(r['pad_pct']):>7}"
             f"{_fmt(r['avg_assembly_us']):>9}{_fmt(r['avg_queue_depth']):>8}"
-            f"{_fmt(r['syncs_per_tick'], 2):>8}")
+            f"{_fmt(r['syncs_per_tick'], 2):>8}"
+            f"{_fmt(r.get('steps_per_tick'), 2):>8}"
+            f"{_fmt(r.get('uploads_per_tick'), 2):>8}")
     return lines
 
 
