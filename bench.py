@@ -1304,6 +1304,163 @@ def _measure_qos_overload() -> dict:
     return {"qos_overload": result}
 
 
+def _measure_fleet_ops() -> dict:
+    """Closed-loop fleet drill (ISSUE 13): recovery-time-to-SLO after a
+    seeded replica kill plus a mid-run rolling model update.
+
+    A 2-replica in-process fleet serves a 30 ms delay model pinned at 1
+    batcher instance (bounds 1..4) under an 8-way closed-loop flood with
+    ``RetryPolicy(3)`` clients — ~2x the pinned capacity, so the tier-0
+    burn rate breaches.  Then, mid-run: a seeded ``worker_kill`` chaos
+    fault takes replica 1 down (the replica supervisor heals it with
+    backoff) while a rolling update flips replica 0 to a new version
+    under traffic.  Recorded: the wall-clock from the kill until every
+    replica's 5m burn rate is back under the breach threshold
+    (``recovery_to_slo_s``), the autoscaler's actuation count, the
+    rolling-update outcome/duration, the healed restart count, and the
+    caller-visible error count (the acceptance bar: 0).  Host-only (the
+    delay model sleeps), so this leg runs on every backend and never
+    kills the bench."""
+    import asyncio
+    import gc
+    import threading
+
+    import triton_client_tpu.http as httpclient
+    from triton_client_tpu._resilience import RetryPolicy
+    from triton_client_tpu.cluster import ClusterClient
+    from triton_client_tpu.server import (InferenceCore, ModelRegistry,
+                                          PyModel, make_config)
+    from triton_client_tpu.server.chaos import ChaosInjector
+    from triton_client_tpu.server.device_stats import SloObjective
+    from triton_client_tpu.server.fleet import FleetController
+    from triton_client_tpu.server.testing import (ClusterHarness,
+                                                  ReplicaSupervisor)
+
+    gc.collect()
+    model = "scaly"
+    service_s = 0.03
+
+    def drill_model():
+        cfg = make_config(
+            model,
+            inputs=[("IN", "INT32", [-1])],
+            outputs=[("OUT", "INT32", [-1])],
+            max_batch_size=1,
+            preferred_batch_sizes=[1],
+        )
+
+        def fn(inputs, params):
+            time.sleep(service_s)
+            return {"OUT": inputs["IN"]}
+
+        return PyModel(cfg, fn)
+
+    def factory():
+        r = ModelRegistry()
+        r.register_model(drill_model())
+        return r
+
+    controllers = {}
+
+    def core_setup(h):
+        core = h.core
+        core.slo.set_objective(model, SloObjective(
+            p99_ms=service_s * 2e3, availability=0.95))
+        ctl = FleetController(core, interval_s=0.1,
+                              bounds={model: (1, 4)}, queue_high=2.0,
+                              scale_out_cooldown_s=0.25,
+                              scale_in_cooldown_s=60.0)
+        core.fleet = ctl
+        ctl.scale_to(model, 1)
+        ctl.start_on(h._loop)
+        controllers[id(core)] = ctl
+
+    out: dict = {"concurrency": 8, "service_ms": service_s * 1e3,
+                 "instance_bounds": [1, 4]}
+    errors: list = []
+    try:
+        with ClusterHarness(factory, n=2, core_setup=core_setup) as ch:
+            sup = ReplicaSupervisor(ch)
+            inj = ChaosInjector(rate=1.0, kinds=["worker_kill"], seed=42,
+                                max_faults=1)
+            inj.worker_kill_cb = lambda: sup.crash(1)
+            policy = RetryPolicy(max_attempts=3, retry_infer=True,
+                                 initial_backoff_s=0.02, seed=9)
+            stop = threading.Event()
+            x = np.ones((1, 4), dtype=np.int32)
+
+            def flood():
+                try:
+                    with ClusterClient(ch.http_urls, protocol="http",
+                                       policy="least_outstanding",
+                                       retry_policy=policy) as c:
+                        i0 = httpclient.InferInput("IN", [1, 4], "INT32")
+                        i0.set_data_from_numpy(x)
+                        while not stop.is_set():
+                            c.infer(model, [i0], priority=0,
+                                    retry_policy=policy)
+                except Exception as e:  # noqa: BLE001 — the 0-error bar
+                    errors.append(repr(e))
+
+            threads = [threading.Thread(target=flood, daemon=True)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            try:
+                core0 = ch.harnesses[0].core
+                threshold = core0.slo.burn_threshold
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 20.0:
+                    burn = core0.slo.burn_rate(model, 300.0)
+                    if burn is not None and burn >= threshold:
+                        break
+                    time.sleep(0.05)
+                else:
+                    raise RuntimeError("overload never breached the SLO")
+                out["time_to_breach_s"] = round(time.monotonic() - t0, 2)
+
+                # the seeded kill + the concurrent rolling update
+                ch.chaos(1, inj)
+                kill_t = time.monotonic()
+                fut = asyncio.run_coroutine_threadsafe(
+                    controllers[id(core0)].rolling_update(
+                        model, drill_model(), bake_s=0.3),
+                    ch.harnesses[0]._loop)
+                out["rolling_update_outcome"] = fut.result(timeout=30)
+                out["rolling_update_s"] = round(
+                    time.monotonic() - kill_t, 2)
+
+                recovered = None
+                while time.monotonic() - kill_t < 30.0:
+                    burns = [h.core.slo.burn_rate(model, 300.0)
+                             for h in ch.harnesses if h is not None]
+                    if burns and all(b is None or b < threshold
+                                     for b in burns):
+                        recovered = time.monotonic()
+                        break
+                    time.sleep(0.1)
+                out["recovery_to_slo_s"] = (
+                    round(recovered - kill_t, 2)
+                    if recovered is not None else None)
+                sup.join(timeout=20)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30)
+            out["scale_out_events"] = sum(
+                ctl.scale_events.get((model, "out"), 0)
+                for ctl in controllers.values())
+            out["instances_after"] = controllers[
+                id(core0)].desired_instances(model)
+            out["worker_restarts"] = sup.state.counts()
+            out["caller_errors"] = len(errors)
+            if errors:
+                out["first_error"] = errors[0][:120]
+    except Exception as e:  # noqa: BLE001 — fleet leg never kills bench
+        return {"fleet_ops_error": str(e)[:120]}
+    return {"fleet_ops": out}
+
+
 def _measure_rtt_floor() -> float:
     """Median blocking device round trip (H2D + sync + D2H) in ms — the
     physical latency floor for any synchronous per-request device path."""
@@ -1625,6 +1782,9 @@ def main() -> int:
     cluster_metrics = _measure_cluster()
     # QoS A/B: tier-0 p99 with vs without priority tiers at 2x overload
     qos_metrics = _measure_qos_overload()
+    # closed-loop fleet ops (ISSUE 13): recovery-time-to-SLO after a
+    # seeded replica kill + a mid-run rolling update
+    fleet_metrics = _measure_fleet_ops()
     # server wire fast path (ISSUE 11): response encode-vs-stamp, per-
     # protocol null-RPC floors, and --frontends N SO_REUSEPORT scaling —
     # own CLI servers, after the main harness released its resources
@@ -1686,6 +1846,8 @@ def main() -> int:
     out.update(cluster_metrics)
     # multi-tenant QoS: the graceful-degradation A/B under overload
     out.update(qos_metrics)
+    # fleet operations: kill-recovery + rolling-update drill numbers
+    out.update(fleet_metrics)
     # client-side telemetry (the instrumented clients recorded every leg):
     # a compact per-(protocol, method, model) view so the bench record
     # carries client-observed p50/p99 next to the server-derived numbers

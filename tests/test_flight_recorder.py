@@ -534,13 +534,18 @@ class TestTritonTop:
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
         assert set(out) == {"url", "ts", "models", "tenants", "buckets",
-                            "recorder"}
+                            "worker_restarts", "recorder"}
         row = out["models"]["simple"]
         assert {"qps", "p50_ms", "p99_ms", "queue_share_pct", "batch_avg",
                 "pending", "error_pct", "rejected_per_s",
                 "deadline_exceeded_per_s", "slow_total", "captured_total",
                 "threshold_ms", "duty_pct", "mfu_pct", "burn_5m",
-                "burn_1h", "slo_breach", "last_outlier"} == set(row)
+                "burn_1h", "slo_breach", "instances", "version",
+                "scaled", "last_outlier"} == set(row)
+        # fleet columns materialize from the nv_fleet_* series: the
+        # harness server exports a serving version for every model
+        assert row["version"] == 1
+        assert out["worker_restarts"] == 0
         assert row["qps"] is None  # one sample: no rate
         assert row["p50_ms"] is not None
         snail = out["models"]["snail"]

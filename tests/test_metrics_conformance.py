@@ -337,6 +337,66 @@ class TestDeviceSloSurface:
         assert tick_samples == scraped
 
 
+class TestFleetSurface:
+    """The nv_fleet_* families parse under the exposition grammar, are
+    typed, carry their full label sets, and round-trip through the JSON
+    snapshot."""
+
+    EVIL = 'evil"name\\with\nnewline'
+
+    def _drive_fleet(self, server, tmp_path, monkeypatch):
+        from triton_client_tpu.server.fleet import (FLEET_STATE_ENV,
+                                                    FleetController,
+                                                    SupervisorState)
+
+        core = server.core
+        ctl = FleetController(core, bounds={self.EVIL: (1, 6)})
+        core.fleet = ctl
+        ctl.scale_to(self.EVIL, 5, direction="out")
+        ctl._count_update(self.EVIL, "completed")
+        state = SupervisorState(str(tmp_path / "fleet-state.json"))
+        state.record_restart("1")
+        monkeypatch.setenv(FLEET_STATE_ENV, state.path)
+        return ctl
+
+    def test_families_typed_labeled_and_round_trip(self, server, tmp_path,
+                                                   monkeypatch):
+        from triton_client_tpu.server.metrics import snapshot
+
+        self._drive_fleet(server, tmp_path, monkeypatch)
+        families = assert_conformant(_scrape(server.http_url))
+        for fam, kind in (("nv_fleet_instances", "gauge"),
+                          ("nv_fleet_serving_version", "gauge"),
+                          ("nv_fleet_scale_total", "counter"),
+                          ("nv_fleet_rolling_update_total", "counter"),
+                          ("nv_fleet_worker_restart_total", "counter")):
+            assert families[fam]["type"] == kind, fam
+
+        def unescape(v):
+            return (v.replace("\\n", "\n").replace('\\"', '"')
+                    .replace("\\\\", "\\"))
+
+        scale = {(unescape(l["model"]), l["direction"]): v for _, l, v in
+                 families["nv_fleet_scale_total"]["samples"]}
+        assert scale == {(self.EVIL, "out"): 1.0}
+        updates = {(unescape(l["model"]), l["outcome"]): v for _, l, v in
+                   families["nv_fleet_rolling_update_total"]["samples"]}
+        assert updates == {(self.EVIL, "completed"): 1.0}
+        restarts = {l["worker"]: v for _, l, v in
+                    families["nv_fleet_worker_restart_total"]["samples"]}
+        assert restarts == {"1": 1.0}
+        versions = {unescape(l["model"]) for _, l, v in
+                    families["nv_fleet_serving_version"]["samples"]}
+        assert self.EVIL in versions and "simple" in versions
+        # JSON snapshot parity (same families, same types)
+        snap = snapshot(server.core)
+        for fam in ("nv_fleet_instances", "nv_fleet_serving_version",
+                    "nv_fleet_scale_total",
+                    "nv_fleet_rolling_update_total",
+                    "nv_fleet_worker_restart_total"):
+            assert snap[fam]["type"] == families[fam]["type"], fam
+
+
 class TestClientSurface:
     def test_grammar_and_naming(self, server):
         telemetry().reset()

@@ -50,6 +50,35 @@ def main() -> None:
                         "(single process, no SO_REUSEPORT)")
     parser.add_argument("--frontend-worker", type=int, default=None,
                         help=argparse.SUPPRESS)  # internal: worker index
+    parser.add_argument("--worker-restart-limit", type=int, default=5,
+                        metavar="K",
+                        help="self-healing supervisor storm bound: a "
+                        "crashed frontend worker is restarted with capped "
+                        "exponential backoff, but K crashes of one worker "
+                        "inside --worker-restart-window fail the whole "
+                        "fleet fast (a broken binary must not hot-loop); "
+                        "1 restores the old fail-fast-on-first-crash "
+                        "behavior (default 5)")
+    parser.add_argument("--worker-restart-window", type=float, default=30.0,
+                        metavar="S",
+                        help="sliding window (seconds) the storm bound "
+                        "counts crashes over; crashes aging out of it also "
+                        "reset the restart backoff (default 30)")
+    parser.add_argument("--autoscale", action="append", default=None,
+                        metavar="MODEL=MIN..MAX",
+                        help="enable closed-loop instance autoscaling for "
+                        "MODEL between MIN and MAX concurrent batches "
+                        "(repeatable; either bound may be omitted around "
+                        "'..').  Scale-out triggers on SLO burn rate at/"
+                        "over --slo-burn-threshold or a deep batcher "
+                        "backlog; scale-in on sustained idle duty cycle.  "
+                        "Model configs can declare the same via "
+                        "autoscale.min_instances / autoscale.max_instances "
+                        "parameters")
+    parser.add_argument("--autoscale-interval", type=float, default=1.0,
+                        metavar="S",
+                        help="fleet control-loop evaluation period "
+                        "(default 1.0s)")
     parser.add_argument("--verbose", "-v", action="store_true")
     parser.add_argument("--ssl-certfile", default=None,
                         help="serve HTTPS/secure-gRPC with this PEM cert chain")
@@ -190,6 +219,21 @@ def main() -> None:
         os.environ["TRITON_TPU_SERVE_MESH"] = args.serve_mesh
     if args.frontends < 1:
         parser.error("--frontends must be >= 1")
+    # autoscale flags validate BEFORE the supervisor branch: a typo'd
+    # spec must be an instant flag error, not N workers crash-looping
+    # into a "crash storm" verdict with the real message buried in
+    # their stderr
+    from .fleet import parse_autoscale_spec
+
+    autoscale_bounds = {}
+    for spec in (args.autoscale or []):
+        try:
+            name, bounds = parse_autoscale_spec(spec)
+        except ValueError as e:  # typo'd spec — fail at startup, loudly
+            parser.error(str(e))
+        autoscale_bounds[name] = bounds
+    if args.autoscale_interval <= 0:
+        parser.error("--autoscale-interval must be positive")
     worker_index = args.frontend_worker
     if args.frontends > 1 and worker_index is None:
         # supervisor: spawn N frontend workers sharing the ports via
@@ -264,6 +308,22 @@ def main() -> None:
             parser.error(str(e))
         print(f"chaos injection ON: rate={args.chaos} "
               f"kinds={core.chaos.kinds} seed={args.chaos_seed}")
+        if "worker_kill" in core.chaos.kinds:
+            # a worker_kill draw must look exactly like a real crash: hard
+            # process exit, no drain, no atexit — the self-healing
+            # supervisor (or the operator's init system) is what heals it
+            core.chaos.worker_kill_cb = lambda: os._exit(70)
+            print("chaos: worker_kill armed — this process hard-exits "
+                  "when the fault fires")
+    from .fleet import FleetController
+
+    # the controller is always attached (rolling updates + nv_fleet_*
+    # actuation counters need it); its loop only ever actuates models
+    # with explicit --autoscale bounds or autoscale.* config parameters
+    core.fleet = FleetController(core, interval_s=args.autoscale_interval,
+                                 bounds=autoscale_bounds)
+    for name, (lo, hi) in sorted(autoscale_bounds.items()):
+        print(f"autoscale: {name} instances in [{lo}, {hi}]")
     try:
         core.flight_recorder.configure(
             capacity=args.flight_recorder_size,
@@ -307,6 +367,7 @@ def main() -> None:
         warmed = await core.warmup_models()
         if warmed:
             print(f"warmed up: {warmed}")
+        core.fleet.start()  # the closed-loop evaluation tick
         # hold the returned handles: a dropped grpc.aio.Server is torn down
         # by its finalizer, silently closing the port
         frontends = await start_frontends(
@@ -358,7 +419,19 @@ def _run_supervisor(parser, args) -> None:
     with ``--frontend-worker i``, each binding the SAME HTTP/gRPC ports
     with SO_REUSEPORT (the kernel balances accepted connections across
     them).  Shutdown reuses the PR 4 drain machinery per worker: signals
-    are forwarded and every worker runs its own graceful drain."""
+    are forwarded and every worker runs its own graceful drain.
+
+    The supervisor is SELF-HEALING (server/fleet.py): a worker that dies
+    on its own is respawned with capped exponential backoff — the
+    replacement re-execs with the same SO_REUSEPORT ports and the same
+    shm-manifest directory, so it rejoins the kernel's accept balancing
+    and re-resolves client shared-memory registrations from the manifest
+    with no client action.  Restarts are counted into the shared fleet
+    state file (``nv_fleet_worker_restart_total`` on every worker's
+    metrics surface).  Only a crash STORM — ``--worker-restart-limit``
+    crashes of one worker inside ``--worker-restart-window`` — fails the
+    fleet fast (drain the siblings rather than hot-loop a broken
+    binary)."""
     import shutil
     import signal
     import socket
@@ -367,12 +440,16 @@ def _run_supervisor(parser, args) -> None:
     import tempfile
     import time
 
+    from .fleet import FLEET_STATE_ENV, RestartPolicy, SupervisorState
+
     if not hasattr(socket, "SO_REUSEPORT"):
         parser.error("--frontends > 1 requires SO_REUSEPORT (Linux)")
     if (args.coordinator_address or args.num_processes is not None
             or args.process_id is not None):
         parser.error("--frontends > 1 is incompatible with multi-host "
                      "serving (each host runs one server process)")
+    if args.worker_restart_limit < 1:
+        parser.error("--worker-restart-limit must be >= 1")
     # each worker hosts a full InferenceCore replica: host-placed models
     # replicate cheaply, but a single accelerator cannot be opened by N
     # processes — keep TPU serving on --frontends 1 (the co-located
@@ -382,27 +459,45 @@ def _run_supervisor(parser, args) -> None:
               "device-placed models need JAX_PLATFORMS=cpu workers or a "
               "single frontend process", file=sys.stderr)
     # client shm registrations land on ONE kernel-picked worker; the
-    # manifest directory lets every sibling resolve them (server/shm.py)
+    # manifest directory lets every sibling resolve them (server/shm.py).
+    # The fleet state file rides the same directory: workers read restart
+    # counters back out of it for nv_fleet_worker_restart_total.
     manifest = tempfile.mkdtemp(prefix="tc-tpu-shm-manifest-")
+    fleet_state = SupervisorState(os.path.join(manifest, "fleet-state.json"))
     env = dict(os.environ, TRITON_TPU_SHM_MANIFEST=manifest)
-    procs = []
+    env[FLEET_STATE_ENV] = fleet_state.path
+
+    def spawn(i: int) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "triton_client_tpu.server",
+               *sys.argv[1:], "--frontend-worker", str(i)]
+        p = subprocess.Popen(cmd, env=env)
+        print(f"frontend worker {i}: pid {p.pid}", flush=True)
+        return p
+
+    procs: list = []
+    rc = 0
     try:
-        for i in range(args.frontends):
-            cmd = [sys.executable, "-m", "triton_client_tpu.server",
-                   *sys.argv[1:], "--frontend-worker", str(i)]
-            procs.append(subprocess.Popen(cmd, env=env))
+        procs = [spawn(i) for i in range(args.frontends)]
+        policies = [RestartPolicy(storm_limit=args.worker_restart_limit,
+                                  window_s=args.worker_restart_window)
+                    for _ in procs]
+        restart_at = [None] * len(procs)  # pending respawn deadlines
         print(f"frontend supervisor: {args.frontends} workers sharing "
               f"http={args.host}:{args.http_port} "
-              f"grpc={args.host}:{args.grpc_port} (SO_REUSEPORT)")
+              f"grpc={args.host}:{args.grpc_port} (SO_REUSEPORT, "
+              f"self-healing: restart with backoff, fail-fast after "
+              f"{args.worker_restart_limit} crashes/"
+              f"{args.worker_restart_window:g}s)")
         state = {"stopping": False}
 
         def forward(signum, _frame):
             # graceful drain per worker: each one sheds new work (503 +
             # Retry-After, readiness false) and finishes in-flight
-            # requests inside its own --drain-timeout
+            # requests inside its own --drain-timeout.  Pending respawns
+            # are cancelled — a stopping fleet heals nothing.
             state["stopping"] = True
             for p in procs:
-                if p.poll() is None:
+                if p is not None and p.poll() is None:
                     try:
                         p.send_signal(signum)
                     except OSError:
@@ -410,28 +505,63 @@ def _run_supervisor(parser, args) -> None:
 
         for sig in (signal.SIGINT, signal.SIGTERM):
             signal.signal(sig, forward)
-        rc = 0
-        while any(p.poll() is None for p in procs):
-            exited = [p for p in procs if p.poll() is not None]
-            if exited and not state["stopping"]:
-                # a worker died (or finished) on its own: fail fast —
-                # drain the siblings rather than serve degraded at 1/N
-                rc = max((p.returncode or 0) for p in exited)
-                state["stopping"] = True
-                for p in procs:
-                    if p.poll() is None:
-                        try:
-                            p.send_signal(signal.SIGTERM)
-                        except OSError:
-                            pass
+
+        def fail_fast() -> None:
+            state["stopping"] = True
+            for q in procs:
+                if q is not None and q.poll() is None:
+                    try:
+                        q.send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+
+        while True:
+            now = time.monotonic()
+            if not state["stopping"]:
+                for i, p in enumerate(procs):
+                    if p is None or p.poll() is None:
+                        continue
+                    # a worker died on its own (any exit while not
+                    # stopping is unexpected — the server runs forever)
+                    code = p.returncode or 0
+                    procs[i] = None
+                    delay = policies[i].on_crash(now)
+                    if delay is None:
+                        print(f"frontend worker {i}: "
+                              f"{policies[i].storm_limit} crashes inside "
+                              f"{policies[i].window_s:g}s — crash storm, "
+                              "failing fast (draining siblings)",
+                              file=sys.stderr, flush=True)
+                        rc = max(rc, 1 if code <= 0 else code)
+                        fail_fast()
+                        restart_at = [None] * len(procs)
+                        break
+                    print(f"frontend worker {i} exited rc={code}; "
+                          f"restarting in {delay:g}s (SO_REUSEPORT rebind "
+                          "+ shm manifest re-issued)",
+                          file=sys.stderr, flush=True)
+                    restart_at[i] = now + delay
+                for i, due in enumerate(restart_at):
+                    if due is not None and now >= due \
+                            and not state["stopping"]:
+                        restart_at[i] = None
+                        procs[i] = spawn(i)
+                        fleet_state.record_restart(str(i))
+            alive = any(p is not None and p.poll() is None for p in procs)
+            pending = any(due is not None for due in restart_at)
+            if state["stopping"] and not alive:
+                break
+            if not state["stopping"] and not alive and not pending:
+                break  # defensive: nothing left to supervise
             time.sleep(0.2)
         # a signal-killed worker (negative returncode) is a failure, not
-        # an exotic success
-        rc = max([rc] + [1 if (p.returncode or 0) < 0 else (p.returncode or 0)
-                         for p in procs])
+        # an exotic success; healed crashes don't count against the exit
+        rc = max([rc] + [1 if (p.returncode or 0) < 0
+                         else (p.returncode or 0)
+                         for p in procs if p is not None])
     finally:
         for p in procs:
-            if p.poll() is None:
+            if p is not None and p.poll() is None:
                 p.kill()
         shutil.rmtree(manifest, ignore_errors=True)
     if rc:

@@ -16,6 +16,19 @@ Fault kinds:
   is closed so the client sees a protocol error; gRPC: the call aborts
   UNAVAILABLE) — the connection-class failure the retry layer must absorb.
 
+Fleet drills add two process/control-plane kinds (same seeded RNG, same
+flight-record stamping, so a drill replays byte-for-byte from its seed):
+
+* ``worker_kill`` — a data-plane draw that takes the WORKER down: the
+  registered ``worker_kill_cb`` fires (a CLI ``--frontends`` worker
+  hard-exits so the supervisor's restart path is exercised; a harness
+  drill kills its replica), and the drawing request fails like a severed
+  connection — the exact signature a crashing process leaves on the wire,
+* ``load_fail`` — a control-plane draw consumed by ``load_model``
+  (``maybe_fail_load``), never by per-request ``decide``: a repository
+  load/rolling update fails before touching the registry, the way a
+  corrupt artifact or an OOM'd initializer would.
+
 Every injected fault stamps the request's flight record (``chaos=<kind>``),
 which the flight recorder pins into its outlier buffer and ``triton-top``
 labels — an operator staring at a latency spike can tell injected weather
@@ -36,7 +49,10 @@ from typing import Dict, Iterable, Optional, Sequence
 
 from .types import InferError
 
-_KINDS = ("latency", "error", "abort")
+_KINDS = ("latency", "error", "abort", "worker_kill", "load_fail")
+#: kinds drawn per inference request by ``decide`` — ``load_fail`` is
+#: control-plane only (``maybe_fail_load``)
+_DATA_KINDS = ("latency", "error", "abort", "worker_kill")
 
 
 class ChaosAbort(InferError):
@@ -104,6 +120,13 @@ class ChaosInjector:
                 f"chaos kinds must be drawn from {_KINDS}, got {kinds}")
         self.rate = float(rate)
         self.kinds = kinds
+        # the per-request pool: control-plane kinds never fire mid-infer
+        self.data_kinds = tuple(k for k in kinds if k in _DATA_KINDS)
+        # worker_kill actuator: the embedder wires what "kill this
+        # worker" means (CLI worker: hard process exit; harness drill:
+        # replica supervisor kill/restart).  Unwired, the fault still
+        # fails the drawing request like a severed connection.
+        self.worker_kill_cb = None
         self.seed = int(seed)
         self.latency_s = float(latency_ms) / 1e3
         self.error_status = int(error_status)
@@ -116,9 +139,11 @@ class ChaosInjector:
         self.injected_total = 0
         self.injected_by_model: Dict[str, int] = {}
 
-    def decide(self, model_name: str) -> Optional[ChaosFault]:
-        """The injection verdict for one request (None = leave it alone)."""
-        if self.rate <= 0.0:
+    def _draw(self, model_name: str, pool: Sequence[str]) -> Optional[str]:
+        """One rate-gated draw from ``pool`` under the lock (shared RNG,
+        shared max_faults/transient budget); returns the chosen kind or
+        None."""
+        if self.rate <= 0.0 or not pool:
             return None
         if self.models is not None and model_name not in self.models:
             return None
@@ -131,18 +156,36 @@ class ChaosInjector:
                 return None  # inside a transient's recovery window
             if self._rng.random() >= self.rate:
                 return None
-            kind = (self.kinds[0] if len(self.kinds) == 1
-                    else self.kinds[self._rng.randrange(len(self.kinds))])
+            kind = (pool[0] if len(pool) == 1
+                    else pool[self._rng.randrange(len(pool))])
             if self.transient_s > 0.0:
                 self._healthy_until = time.monotonic() + self.transient_s
             self.injected_total += 1
             self.injected_by_model[model_name] = \
                 self.injected_by_model.get(model_name, 0) + 1
+        return kind
+
+    def decide(self, model_name: str) -> Optional[ChaosFault]:
+        """The injection verdict for one request (None = leave it alone)."""
+        kind = self._draw(model_name, self.data_kinds)
+        if kind is None:
+            return None
         if kind == "latency":
             return ChaosFault("latency", latency_s=self.latency_s)
-        if kind == "abort":
-            return ChaosFault("abort")
+        if kind in ("abort", "worker_kill"):
+            return ChaosFault(kind)
         return ChaosFault("error", status=self.error_status)
+
+    def maybe_fail_load(self, model_name: str) -> None:
+        """Control-plane verdict for one repository load: raises the
+        injected failure when a ``load_fail`` draw fires (counted like
+        every other injection; ``nv_chaos_injected_total`` carries it)."""
+        if "load_fail" not in self.kinds:
+            return
+        if self._draw(model_name, ("load_fail",)) is not None:
+            raise InferError(
+                f"chaos: injected load failure for '{model_name}'",
+                http_status=503)
 
     def counters(self) -> Dict[str, int]:
         """Per-model injected-fault counts, copied under the lock (backs

@@ -146,7 +146,11 @@ class _ResponseCache:
         h.update(repr(sorted(request.parameters.items())).encode())
         h.update(repr(sorted(
             (o.name, o.class_count) for o in request.outputs)).encode())
-        return (model.name, generation, request.model_version, h.hexdigest())
+        # keyed on the RESOLVED instance's version, not the request's
+        # (usually empty) version string: a rolling update flips which
+        # instance an unversioned request reaches, and a stale entry
+        # from the old version must read as a miss for the new one
+        return (model.name, generation, model.served_version, h.hexdigest())
 
     def _evict(self, key: tuple, entry: tuple) -> None:
         self._total_bytes -= entry[2]
@@ -238,7 +242,9 @@ class _DynamicBatcher:
 
     # Batches in flight concurrently: device dispatch is async, so letting
     # several padded batches ride the (possibly high-RTT) device link at once
-    # converts per-batch latency into pipeline throughput.
+    # converts per-batch latency into pipeline throughput.  This is the
+    # static default; the fleet controller's autoscaler moves the live
+    # value per model through ``set_instances`` (server/fleet.py).
     MAX_INFLIGHT = 4
 
     def __init__(self, core: "InferenceCore", model: Model):
@@ -251,11 +257,40 @@ class _DynamicBatcher:
         self._queue: TieredQueue = TieredQueue(
             core.qos.tiers, weights=core.qos.weights)
         self._task: Optional[asyncio.Task] = None
-        self._inflight = asyncio.Semaphore(self.MAX_INFLIGHT)
+        # instance parallelism (concurrent in-flight batches): the fleet
+        # controller's actuation target — a batcher born while the model
+        # is scaled inherits the scaled value, not the static default
+        self.instances = self.MAX_INFLIGHT
+        if core.fleet is not None:
+            desired = core.fleet.desired_instances(model.name)
+            if desired is not None:
+                self.instances = desired
+        self._inflight = asyncio.Semaphore(self.instances)
+        # permits swallowed (not re-released) on batch completion while a
+        # scale-IN is settling: shrinking never cancels in-flight batches
+        # and never touches the queue — concurrency just tapers down as
+        # running batches finish
+        self._shrink_debt = 0
         self._batch_tasks: set = set()
         # registry generation of the bound model; InferenceCore._batcher
         # retires this batcher when the instance behind the name is swapped
         self.generation = 0
+
+    def set_instances(self, n: int) -> None:
+        """Resize in-flight batch parallelism (event-loop only, like every
+        semaphore touch).  Growth releases permits immediately; shrink
+        accrues debt that completion callbacks absorb — queued work is
+        never dropped and running batches are never interrupted."""
+        n = max(1, int(n))
+        delta = n - self.instances
+        self.instances = n
+        if delta > 0:
+            settle = min(delta, self._shrink_debt)
+            self._shrink_debt -= settle
+            for _ in range(delta - settle):
+                self._inflight.release()
+        elif delta < 0:
+            self._shrink_debt += -delta
 
     def start(self) -> None:
         if self._task is None or self._task.done():
@@ -327,7 +362,13 @@ class _DynamicBatcher:
                 self._batch_tasks.add(task)
 
                 def _done(t, *, _self=self):
-                    _self._inflight.release()
+                    if _self._shrink_debt > 0:
+                        # a pending scale-in absorbs this permit instead
+                        # of re-releasing it — concurrency tapers to the
+                        # new target as batches finish
+                        _self._shrink_debt -= 1
+                    else:
+                        _self._inflight.release()
                     _self._batch_tasks.discard(t)
 
                 task.add_done_callback(_done)
@@ -559,6 +600,11 @@ class InferenceCore:
         self.qos = QosManager()
         # optional fault injector (server/chaos.py; --chaos CLI flags)
         self.chaos = None
+        # closed-loop fleet controller (server/fleet.py): per-model
+        # instance autoscaling + rolling version updates.  None = open
+        # loop (the nv_fleet_instances / serving-version gauges still
+        # render from the batchers and registry directly).
+        self.fleet = None
         # counters backing nv_inference_rejected_total /
         # nv_inference_deadline_exceeded_total (bumped on the event loop /
         # under the GIL, same discipline as the response-cache counters)
@@ -726,6 +772,19 @@ class InferenceCore:
             from .chaos import ChaosAbort
 
             raise ChaosAbort()
+        if fault.kind == "worker_kill":
+            # process/fleet-level fault: the registered callback takes the
+            # worker down (a CLI worker hard-exits; a harness drill kills
+            # its replica through the replica supervisor).  When the
+            # callback returns — or none is wired — the request itself
+            # fails like a severed connection, the signature a crashing
+            # worker actually produces on the wire.
+            from .chaos import ChaosAbort
+
+            cb = self.chaos.worker_kill_cb
+            if cb is not None:
+                cb()
+            raise ChaosAbort("chaos: injected worker kill")
         raise InferError(f"chaos: injected {fault.status} error",
                          http_status=fault.status)
 
@@ -1096,6 +1155,12 @@ class InferenceCore:
         """Repository-API load: registry swap off the event loop, then
         every fresh version's warmup samples (Triton runs warmup at every
         load, not just server start).  A failing warmup fails the load."""
+        if self.chaos is not None:
+            # control-plane fault injection (load_fail): deterministic
+            # drills for the fleet layer's rollback/retry paths — a load
+            # that fails before touching the registry, like a corrupt
+            # artifact or an OOM'd initializer would
+            self.chaos.maybe_fail_load(name)
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(
             None, lambda: self.registry.load(
@@ -1169,6 +1234,10 @@ class InferenceCore:
         still queued so no handler is left awaiting a forever-pending
         future."""
         self.accepting = False
+        if self.fleet is not None:
+            # the control loop first: a scale/bake actuation mid-drain
+            # would race the batcher teardown below
+            await self.fleet.stop()
         deadline = time.monotonic() + max(0.0, drain_s)
         while time.monotonic() < deadline:
             in_flight = sum(m.stats.pending_count
@@ -1219,6 +1288,31 @@ class InferenceCore:
             fut = b._queue.get_nowait()[2]
             if not fut.done():
                 fut.set_exception(InferError(reason, 503))
+
+    async def drain_batcher(self, name: str, version: str,
+                            timeout_s: float = 30.0) -> bool:
+        """Gracefully drain ONE version's batcher: wait for its queue and
+        in-flight batches to empty (queued work executes — a fleet scale
+        or version-flip event must never drop admitted tier-0 requests),
+        then retire the pump.  Only past ``timeout_s`` does retirement
+        fail whatever is still queued (the 503 shutdown contract).
+        Returns True when the drain completed cleanly."""
+        key = f"{name}@{version}"
+        b = self._batchers.get(key)
+        if b is None:
+            return True
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        clean = True
+        while not b._queue.empty() or b._batch_tasks:
+            if time.monotonic() >= deadline:
+                clean = False
+                break
+            await asyncio.sleep(0.02)
+        if self._batchers.get(key) is b:
+            self._batchers.pop(key)
+        await self._retire_batcher(
+            b, reason=f"model '{name}' version {version} was drained")
+        return clean
 
     @staticmethod
     def _host_placed(model: Model) -> bool:

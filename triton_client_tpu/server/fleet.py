@@ -1,0 +1,676 @@
+"""Closed-loop fleet operations: SLO-driven autoscaling, rolling model
+updates under traffic, and the self-healing supervisor's restart policy.
+
+PR 7 built the sensors (per-model burn rates, tick profiles, duty cycle)
+and PR 5/10 built the fleet topology (cluster harness, SO_REUSEPORT
+worker processes) — but the control plane stayed open-loop: the SLO
+engine raised alarms with no actuator, and one dead frontend worker
+drained every sibling.  This module closes the loop, applying the SRE
+workbook's multi-window burn-rate actuation discipline and Dean &
+Barroso's tail-tolerance principle to the serving plane itself: act on
+the fleet *before* the error budget burns.
+
+Three cooperating pieces:
+
+* :class:`FleetController` — the per-core control loop.
+
+  **Autoscaling.**  Each evaluation reads three independent signals per
+  model: the short-window SLO burn rate (``SloEngine.burn_rate`` —
+  breach pressure), the live batcher queue backlog per instance (the
+  same lanes the tick profiler's queue-depth series aggregates), and the
+  device duty cycle (``DeviceStatsCollector.duty_cycle`` — idle
+  pressure).  Burn at/over the engine's threshold OR a backlog of
+  ``queue_high`` queued requests per instance scales OUT by one
+  instance; a duty cycle under ``idle_duty`` with an empty queue for
+  ``idle_cycles`` *consecutive* evaluations scales IN by one.  The
+  dead band between the out trigger (deep backlog / burning budget) and
+  the in trigger (near-idle device, empty queue, sustained) is the
+  hysteresis that keeps the controller from oscillating on noise;
+  separate ``scale_out_cooldown_s`` / ``scale_in_cooldown_s`` rate-limit
+  actuation per model (in slower than out: adding capacity during a
+  breach is cheap, removing it during a lull is the risky direction).
+  Bounds come from ``--autoscale MODEL=MIN..MAX`` or the model config's
+  ``autoscale.min_instances`` / ``autoscale.max_instances`` parameters;
+  a model with neither is never touched.  The actuator is
+  ``_DynamicBatcher.set_instances`` — the batcher's in-flight
+  parallelism — which only ever changes how many batches execute
+  concurrently: queued work (tier-0 or otherwise) is NEVER dropped by a
+  scale event.
+
+  **Rolling updates.**  :meth:`FleetController.rolling_update` stages a
+  new version instance into the registry (`stage_version`: invisible to
+  readiness and routing), warms it through the real execute path while
+  the old version keeps serving, atomically flips the served default
+  (`promote`, one registry-lock swap), then watches a **bake window**
+  with a verdict scoped to the NEW version (see ``_bake_breached``: a
+  fresh burn breach on a previously-healthy model, the new instance's
+  own failure fraction, or its mean latency blowing through the SLO
+  target — a fleet already burning from an unrelated overload cannot
+  veto a healthy update): on breach the flip is rolled back (`demote`)
+  and the bad instance drained + retired.
+  On success the OLD version's batcher is drained gracefully (queued
+  work executes on the old instance; nothing is failed) and the old
+  version stays loaded and explicitly addressable for operator rollback
+  beyond the bake window.  Readiness never reports a cold version:
+  staged versions are outside the version set until promoted, and
+  promotion happens only after warmup.
+
+* :class:`RestartPolicy` — the supervisor's crash arithmetic: capped
+  exponential backoff per restart, sliding crash-window storm detection
+  (``storm_limit`` crashes inside ``window_s`` → fail fast, the old
+  drain-the-siblings behavior — now reserved for genuine crash storms
+  instead of firing on the first flake).
+
+* :class:`SupervisorState` — a tiny atomically-replaced JSON file the
+  supervisor writes restart counts into and workers read back (path via
+  ``TRITON_TPU_FLEET_STATE``), so ``nv_fleet_worker_restart_total`` is
+  visible on every worker's metrics surface even though the supervisor
+  itself serves no port.
+
+Concurrency: the control loop and every actuation run on the core's
+event loop; the counters the metrics renderer reads from scrape threads
+are copied under one short lock that is never held across an await or
+another lock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from .types import InferError
+
+__all__ = [
+    "FleetController",
+    "RestartPolicy",
+    "SupervisorState",
+    "parse_autoscale_spec",
+    "worker_restart_counts",
+    "collect_fleet_rows",
+]
+
+#: Env var pointing at the supervisor's state file (restart counters).
+FLEET_STATE_ENV = "TRITON_TPU_FLEET_STATE"
+
+#: Default per-model instance bounds when a spec names only one side.
+DEFAULT_MIN_INSTANCES = 1
+DEFAULT_MAX_INSTANCES = 8
+
+#: The short burn window driving scale-out (the SRE fast-burn window —
+#: actuation leads the page, which needs BOTH windows burning).
+SHORT_BURN_WINDOW_S = 300.0
+
+
+def parse_autoscale_spec(spec: str) -> Tuple[str, Tuple[int, int]]:
+    """``--autoscale MODEL=MIN..MAX`` -> (model, (min, max)).  ``MIN..``
+    and ``..MAX`` leave the other bound at its default.  Raises
+    ``ValueError`` on junk so a typo'd flag fails at startup."""
+    name, sep, rest = spec.partition("=")
+    if not sep or not name or not rest:
+        raise ValueError(
+            f"invalid --autoscale '{spec}': expected MODEL=MIN..MAX")
+    lo_s, sep, hi_s = rest.partition("..")
+    if not sep:
+        raise ValueError(
+            f"invalid --autoscale '{spec}': expected MODEL=MIN..MAX")
+    try:
+        lo = int(lo_s) if lo_s else DEFAULT_MIN_INSTANCES
+        hi = int(hi_s) if hi_s else DEFAULT_MAX_INSTANCES
+    except ValueError:
+        raise ValueError(
+            f"invalid --autoscale '{spec}': MIN/MAX must be integers")
+    if lo < 1 or hi < lo:
+        raise ValueError(
+            f"invalid --autoscale '{spec}': need 1 <= MIN <= MAX")
+    return name, (lo, hi)
+
+
+class RestartPolicy:
+    """Crash bookkeeping for one supervised worker.
+
+    :meth:`on_crash` returns the backoff delay (seconds) to wait before
+    restarting, or ``None`` when the crash is part of a storm —
+    ``storm_limit`` crashes inside the sliding ``window_s`` — and the
+    supervisor should fail fast instead of hot-looping a broken binary.
+    The backoff exponent is the number of crashes still inside the
+    window, so a worker that stays up long enough naturally earns its
+    fast first-restart back (no explicit reset call to forget)."""
+
+    def __init__(self, base_delay_s: float = 0.5, max_delay_s: float = 30.0,
+                 storm_limit: int = 5, window_s: float = 30.0):
+        if storm_limit < 1:
+            raise ValueError("storm_limit must be >= 1")
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.storm_limit = int(storm_limit)
+        self.window_s = float(window_s)
+        self._crashes: deque = deque()
+
+    def recent_crashes(self, now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        while self._crashes and self._crashes[0] < now - self.window_s:
+            self._crashes.popleft()
+        return len(self._crashes)
+
+    def on_crash(self, now: Optional[float] = None) -> Optional[float]:
+        now = time.monotonic() if now is None else now
+        self.recent_crashes(now)  # prune the window
+        self._crashes.append(now)
+        n = len(self._crashes)
+        if n >= self.storm_limit:
+            return None  # crash storm: restarting is hot-looping
+        return min(self.max_delay_s, self.base_delay_s * (2.0 ** (n - 1)))
+
+
+class SupervisorState:
+    """Atomically-replaced JSON state file shared supervisor -> workers.
+
+    The supervisor has no metrics port of its own, so restart counters
+    ride this file: :meth:`record_restart` rewrites it atomically
+    (write-temp + ``os.replace``, the same discipline as the shm
+    manifest) and the workers' metrics renderer folds it into
+    ``nv_fleet_worker_restart_total`` via :func:`worker_restart_counts`.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._restarts: Dict[str, int] = {}
+
+    def record_restart(self, worker: str) -> int:
+        with self._lock:
+            self._restarts[worker] = self._restarts.get(worker, 0) + 1
+            snapshot = dict(self._restarts)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"worker_restarts": snapshot}, f)
+        os.replace(tmp, self.path)
+        return snapshot[worker]
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._restarts)
+
+
+# cache: (path, mtime_ns) -> counts — /metrics scrapes hit this every
+# poll and the file only changes when a worker actually restarted
+_state_cache: Tuple[Optional[Tuple[str, int]], Dict[str, int]] = (None, {})
+_state_cache_lock = threading.Lock()
+
+
+def worker_restart_counts(path: Optional[str] = None) -> Dict[str, int]:
+    """Restart counters from the supervisor state file (the
+    ``TRITON_TPU_FLEET_STATE`` env var when ``path`` is None).  Empty
+    when unset, absent, or unreadable — a worker without a supervisor
+    simply has no restart series."""
+    global _state_cache
+    path = path if path is not None else os.environ.get(FLEET_STATE_ENV)
+    if not path:
+        return {}
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return {}
+    key = (path, mtime)
+    with _state_cache_lock:
+        if _state_cache[0] == key:
+            return dict(_state_cache[1])
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        counts = {str(k): int(v)
+                  for k, v in (data.get("worker_restarts") or {}).items()}
+    except (OSError, ValueError):
+        return {}
+    with _state_cache_lock:
+        _state_cache = (key, counts)
+    return dict(counts)
+
+
+class FleetController:
+    """The closed loop: per-model instance autoscaling plus rolling
+    version updates, bound to one :class:`InferenceCore`.
+
+    Construct, assign to ``core.fleet``, and either drive
+    :meth:`evaluate` explicitly (tests: injectable ``now`` + stubbable
+    signal readers) or :meth:`start` the background loop on the serving
+    event loop (:meth:`start_on` from another thread)."""
+
+    def __init__(self, core, interval_s: float = 1.0,
+                 bounds: Optional[Dict[str, Tuple[int, int]]] = None,
+                 queue_high: float = 4.0,
+                 idle_duty: float = 0.05,
+                 idle_cycles: int = 5,
+                 scale_out_cooldown_s: float = 5.0,
+                 scale_in_cooldown_s: float = 30.0,
+                 bake_s: float = 10.0,
+                 bake_min_samples: int = 8,
+                 bake_fail_fraction: float = 0.5,
+                 bake_latency_factor: float = 2.0):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be positive")
+        self._core = core
+        self.interval_s = float(interval_s)
+        #: explicit CLI bounds; model-config parameters fill the rest
+        self.bounds: Dict[str, Tuple[int, int]] = dict(bounds or {})
+        self.queue_high = float(queue_high)
+        self.idle_duty = float(idle_duty)
+        self.idle_cycles = int(idle_cycles)
+        self.scale_out_cooldown_s = float(scale_out_cooldown_s)
+        self.scale_in_cooldown_s = float(scale_in_cooldown_s)
+        self.bake_s = float(bake_s)
+        self.bake_min_samples = int(bake_min_samples)
+        self.bake_fail_fraction = float(bake_fail_fraction)
+        self.bake_latency_factor = float(bake_latency_factor)
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        # counter lock: evaluate()/rolling_update mutate on the event
+        # loop, the metrics renderer copies from scrape threads.  Held
+        # only for dict updates/copies — never across an await, never
+        # nested with any other lock.
+        self._lock = threading.Lock()
+        self._desired: Dict[str, int] = {}
+        self._last_out: Dict[str, float] = {}
+        self._last_in: Dict[str, float] = {}
+        self._idle_streak: Dict[str, int] = {}
+        # (model, direction) -> actuation count; direction in (out, in)
+        self.scale_events: Dict[Tuple[str, str], int] = {}
+        # (model, outcome) -> count; completed | rolled_back | warmup_failed
+        self.update_events: Dict[Tuple[str, str], int] = {}
+        #: models currently inside a rolling update (bake included)
+        self._updating: set = set()
+        # the asyncio task driving each in-flight update, so stop() can
+        # cancel a mid-bake update instead of letting it actuate
+        # against a torn-down core after shutdown
+        self._update_tasks: Dict[str, asyncio.Task] = {}
+
+    # -- bounds / desired state --------------------------------------------
+    def _config_bounds(self, name: str) -> Optional[Tuple[int, int]]:
+        """Bounds from the model config's ``autoscale.min_instances`` /
+        ``autoscale.max_instances`` parameters (either alone enables
+        autoscaling with the other at its default); None when the config
+        declares neither or the values are junk."""
+        try:
+            model = self._core.registry.get(name)
+        except InferError:
+            return None
+        params = model.config.parameters
+        lo_s = params["autoscale.min_instances"].string_value \
+            if "autoscale.min_instances" in params else None
+        hi_s = params["autoscale.max_instances"].string_value \
+            if "autoscale.max_instances" in params else None
+        if lo_s is None and hi_s is None:
+            return None
+        try:
+            lo = int(lo_s) if lo_s is not None else DEFAULT_MIN_INSTANCES
+            hi = int(hi_s) if hi_s is not None else DEFAULT_MAX_INSTANCES
+        except ValueError:
+            return None
+        if lo < 1 or hi < lo:
+            return None
+        return (lo, hi)
+
+    def bounds_for(self, name: str) -> Optional[Tuple[int, int]]:
+        """The model's (min, max) instance bounds — explicit CLI spec
+        wins over config parameters; None = not autoscaled."""
+        explicit = self.bounds.get(name)
+        if explicit is not None:
+            return explicit
+        return self._config_bounds(name)
+
+    def desired_instances(self, name: str) -> Optional[int]:
+        """The controller's current target for ``name`` (None when the
+        model is not autoscaled).  New batchers consult this at
+        construction so a scaled model does not reset on reload."""
+        bounds = self.bounds_for(name)
+        if bounds is None:
+            return None
+        with self._lock:
+            desired = self._desired.get(name)
+        if desired is None:
+            # first sighting: start from the batcher's static default,
+            # clamped into the configured envelope
+            from .core import _DynamicBatcher
+
+            desired = min(max(_DynamicBatcher.MAX_INFLIGHT, bounds[0]),
+                          bounds[1])
+            with self._lock:
+                desired = self._desired.setdefault(name, desired)
+        return desired
+
+    # -- signals -----------------------------------------------------------
+    def _batchers_for(self, name: str):
+        prefix = f"{name}@"
+        return [b for key, b in list(self._core._batchers.items())
+                if key.startswith(prefix)]
+
+    def queue_depth(self, name: str) -> int:
+        """Live queued backlog across the model's batcher lanes (every
+        served version; the flip never splits admitted work)."""
+        return sum(b._queue.qsize() for b in self._batchers_for(name))
+
+    def live_instances(self, name: str) -> int:
+        return sum(b.instances for b in self._batchers_for(name))
+
+    def burn(self, name: str, now: Optional[float] = None) -> Optional[float]:
+        """Short-window burn rate — the scale-out pressure signal (the
+        actuator reacts on the fast window alone, leading the
+        multi-window page condition)."""
+        return self._core.slo.burn_rate(name, SHORT_BURN_WINDOW_S, now)
+
+    def duty(self, name: str, now: Optional[float] = None) -> Optional[float]:
+        return self._core.device_stats.duty_cycle(name, now)
+
+    # -- actuation ---------------------------------------------------------
+    def scale_to(self, name: str, n: int, direction: Optional[str] = None,
+                 now: Optional[float] = None) -> int:
+        """Set the model's instance-parallelism target (clamped to its
+        bounds) and apply it to every live batcher.  Event-loop only —
+        ``set_instances`` touches the batcher's semaphore."""
+        bounds = self.bounds_for(name) or (1, max(1, n))
+        n = min(max(int(n), bounds[0]), bounds[1])
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            prev = self._desired.get(name)
+            self._desired[name] = n
+            if direction is not None and n != prev:
+                key = (name, direction)
+                self.scale_events[key] = self.scale_events.get(key, 0) + 1
+                if direction == "out":
+                    self._last_out[name] = now
+                else:
+                    self._last_in[name] = now
+        for b in self._batchers_for(name):
+            b.set_instances(n)
+        return n
+
+    def evaluate(self, now: Optional[float] = None) -> None:
+        """One control-loop pass over every autoscaled model.  Pure
+        in-memory reads (SLO windows, batcher lanes, duty cycle) — safe
+        on the event loop."""
+        now = time.monotonic() if now is None else now
+        for model in self._core.registry.ready_models():
+            name = model.name
+            bounds = self.bounds_for(name)
+            if bounds is None:
+                continue
+            lo, hi = bounds
+            desired = self.desired_instances(name) or lo
+            if desired < lo or desired > hi:
+                # bounds narrowed at runtime: converge immediately
+                desired = self.scale_to(
+                    name, desired,
+                    direction=("in" if desired > hi else "out"), now=now)
+                continue
+            depth = self.queue_depth(name)
+            burn = self.burn(name, now)
+            breach = (burn is not None
+                      and burn >= self._core.slo.burn_threshold)
+            backlog = depth >= self.queue_high * max(1, desired)
+            if breach or backlog:
+                with self._lock:
+                    self._idle_streak[name] = 0
+                    last = self._last_out.get(name, -1e9)
+                if desired < hi and now - last >= self.scale_out_cooldown_s:
+                    self.scale_to(name, desired + 1, direction="out",
+                                  now=now)
+                continue
+            duty = self.duty(name, now)
+            idle = (depth == 0 and duty is not None
+                    and duty < self.idle_duty)
+            with self._lock:
+                streak = self._idle_streak.get(name, 0) + 1 if idle else 0
+                self._idle_streak[name] = streak
+                last = self._last_in.get(name, -1e9)
+            if (idle and streak >= self.idle_cycles and desired > lo
+                    and now - last >= self.scale_in_cooldown_s):
+                self.scale_to(name, desired - 1, direction="in", now=now)
+
+    # -- control loop ------------------------------------------------------
+    def start(self) -> None:
+        """Start the background evaluation loop on the running loop."""
+        if self._task is None or self._task.done():
+            self._stopped = False
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def start_on(self, loop: asyncio.AbstractEventLoop) -> None:
+        """Thread-safe start for harness embedders (the serving loop
+        runs on another thread)."""
+        loop.call_soon_threadsafe(self.start)
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        # in-flight rolling updates too: a bake sleeping through
+        # shutdown would otherwise wake and demote/drain against a
+        # torn-down core (a cancelled update stays flipped — the
+        # promote already happened and remains valid registry state)
+        with self._lock:
+            tasks = [t for t in self._update_tasks.values()
+                     if t is not asyncio.current_task()]
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(self.interval_s)
+            try:
+                self.evaluate()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                # a transient registry/model surprise mid-evaluation;
+                # next tick re-reads fresh state
+                pass
+
+    # -- rolling updates ---------------------------------------------------
+    def _count_update(self, name: str, outcome: str) -> None:
+        with self._lock:
+            key = (name, outcome)
+            self.update_events[key] = self.update_events.get(key, 0) + 1
+
+    def _bake_breached(self, name: str, model, baseline_breached: bool,
+                       base_success: int, base_fail: int,
+                       base_success_ns: int) -> bool:
+        """The rollback verdict during the bake window — scoped to the
+        NEW version so an unrelated fleet incident (an overload already
+        burning at flip time) cannot veto a healthy update:
+
+        * **burn** — the model's burn rate crosses the engine threshold
+          during the bake when it was NOT already breaching at flip time
+          (the new version tanked a healthy model),
+        * **failures** — the new instance's own failure fraction reaches
+          ``bake_fail_fraction`` once ``bake_min_samples`` accumulated,
+        * **latency** — with an SLO objective, the new instance's mean
+          request time (queue + compute, from its own stats deltas)
+          exceeds ``bake_latency_factor`` x the p99 target — clearly
+          slower than the objective even though the name-scoped burn
+          windows may be muddied by pre-flip history."""
+        if not baseline_breached:
+            burn = self.burn(name)
+            if burn is not None and burn >= self._core.slo.burn_threshold:
+                return True
+        with model.stats.lock:
+            fails = model.stats.fail_count - base_fail
+            succ = model.stats.success_count - base_success
+            succ_ns = model.stats.success_ns - base_success_ns
+        total = fails + succ
+        if total >= self.bake_min_samples \
+                and fails / total >= self.bake_fail_fraction:
+            return True
+        obj = self._core.slo.objective_for(name)
+        if obj is not None and succ >= self.bake_min_samples:
+            mean_ms = succ_ns / succ / 1e6
+            if mean_ms > obj.p99_ms * self.bake_latency_factor:
+                return True
+        return False
+
+    async def rolling_update(self, name: str, model, version: Optional[str]
+                             = None, bake_s: Optional[float] = None,
+                             drain_timeout_s: float = 30.0) -> str:
+        """Load ``model`` as a new version of ``name`` under live
+        traffic: stage (invisible), warm, atomic flip, bake, and either
+        commit (drain the old batcher; old version stays addressable) or
+        auto-roll-back.  Returns ``"completed"`` or ``"rolled_back"``;
+        raises on staging/warmup failure (the old version never stopped
+        serving).  Event-loop only, one update per model at a time."""
+        core = self._core
+        registry = core.registry
+        old_default = registry.get(name)
+        old_version = old_default.served_version
+        if version is None:
+            version = str(max((int(v) for v in old_default.versions),
+                              default=0) + 1)
+        with self._lock:
+            if name in self._updating:
+                raise InferError(
+                    f"a rolling update for '{name}' is already in "
+                    "progress", http_status=409)
+            self._updating.add(name)
+            task = asyncio.current_task()
+            if task is not None:
+                self._update_tasks[name] = task
+        try:
+            registry.stage_version(name, model, version)
+            try:
+                # warm through the real execute path: the flip must not
+                # expose a version that would pay XLA compilation (or a
+                # cold cache) on its first live request
+                await core._warmup_one(model)
+            except Exception as e:
+                registry.abort_stage(name, version)
+                try:
+                    # the partial warmup may have compiled/placed real
+                    # buffers — free them promptly, like every other
+                    # staged-cleanup path does
+                    model.unload()
+                except Exception:  # noqa: BLE001 — best-effort free
+                    pass
+                self._count_update(name, "warmup_failed")
+                raise InferError(
+                    f"rolling update of '{name}' to version {version} "
+                    f"failed during warmup: {e}", http_status=400)
+            with model.stats.lock:
+                base_success = model.stats.success_count
+                base_fail = model.stats.fail_count
+                base_success_ns = model.stats.success_ns
+            # the pre-flip breach state scopes the bake verdict: a model
+            # already burning (an unrelated overload) must not veto a
+            # healthy update via its own history
+            baseline_burn = self.burn(name)
+            baseline_breached = (
+                baseline_burn is not None
+                and baseline_burn >= self._core.slo.burn_threshold)
+            # THE FLIP: one registry-lock swap — unversioned traffic now
+            # routes to the new instance; in-flight and queued requests
+            # keep their old-instance references and complete on it
+            registry.promote(name, version)
+            # the new instance's config may declare different SLO /
+            # FLOPs parameters; compile signatures start fresh
+            core.slo.invalidate(name)
+            core.device_stats.forget_model(name)
+            log = getattr(core, "log", None)
+            if log is not None:
+                from .log import log_off_loop
+
+                log_off_loop(log.info,
+                             f"rolling update: '{name}' now serving "
+                             f"version {version} (was {old_version}); "
+                             "baking")
+            bake_s = self.bake_s if bake_s is None else float(bake_s)
+            deadline = time.monotonic() + max(0.0, bake_s)
+            poll = min(0.05, self.interval_s)
+            while time.monotonic() < deadline:
+                await asyncio.sleep(poll)
+                if self._bake_breached(name, model, baseline_breached,
+                                       base_success, base_fail,
+                                       base_success_ns):
+                    # ROLLBACK: demote the new version (default returns
+                    # to the old instance), drain what it already
+                    # admitted, and retire it
+                    registry.demote(name, version, fallback=old_version)
+                    core.slo.invalidate(name)
+                    await core.drain_batcher(name, version,
+                                             timeout_s=drain_timeout_s)
+                    try:
+                        model.unload()
+                    except Exception:  # noqa: BLE001 — best-effort free
+                        pass
+                    self._count_update(name, "rolled_back")
+                    if log is not None:
+                        log_off_loop(
+                            log.error,
+                            f"rolling update: '{name}' version {version} "
+                            f"breached during bake — rolled back to "
+                            f"{old_version}")
+                    return "rolled_back"
+            # COMMIT: gracefully drain the old default's batcher (its
+            # queued work executes on the old instance; nothing is
+            # dropped).  The old version stays loaded and explicitly
+            # addressable — rollback beyond the bake window is an
+            # operator demote away.
+            await core.drain_batcher(name, old_version,
+                                     timeout_s=drain_timeout_s)
+            self._count_update(name, "completed")
+            return "completed"
+        finally:
+            with self._lock:
+                self._updating.discard(name)
+                self._update_tasks.pop(name, None)
+
+    # -- export ------------------------------------------------------------
+    def metric_rows(self) -> Dict[str, list]:
+        """Controller-owned sample rows, keyed by the short names
+        ``metrics.collect_families`` declares (scale / rolling_update)."""
+        with self._lock:
+            scale = dict(self.scale_events)
+            updates = dict(self.update_events)
+        rows: Dict[str, list] = {"scale": [], "rolling_update": []}
+        for (model, direction), n in sorted(scale.items()):
+            rows["scale"].append(
+                ({"model": model, "direction": direction}, n))
+        for (model, outcome), n in sorted(updates.items()):
+            rows["rolling_update"].append(
+                ({"model": model, "outcome": outcome}, n))
+        return rows
+
+
+def collect_fleet_rows(core) -> Dict[str, list]:
+    """Every fleet sample row for ``metrics.collect_families`` — works
+    with or without a controller attached: live instance parallelism and
+    the serving version come straight from the batchers/registry, the
+    actuation/update counters from ``core.fleet``, and worker restarts
+    from the supervisor state file."""
+    rows: Dict[str, list] = {"instances": [], "serving_version": [],
+                             "scale": [], "rolling_update": [],
+                             "worker_restart": []}
+    instances: Dict[str, int] = {}
+    for key, b in list(core._batchers.items()):
+        name = key.rsplit("@", 1)[0]
+        instances[name] = instances.get(name, 0) + b.instances
+    for name, n in sorted(instances.items()):
+        rows["instances"].append(({"model": name}, n))
+    for model in core.registry.ready_models():
+        try:
+            v = int(model.served_version)
+        except (TypeError, ValueError):
+            continue  # non-numeric version: no gauge, never a crash
+        rows["serving_version"].append(({"model": model.name}, v))
+    fleet = getattr(core, "fleet", None)
+    if fleet is not None:
+        rows.update(fleet.metric_rows())
+    rows["worker_restart"] = [
+        ({"worker": worker}, n)
+        for worker, n in sorted(worker_restart_counts().items())]
+    return rows

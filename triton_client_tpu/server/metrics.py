@@ -21,7 +21,10 @@ dynamic-batcher batch accounting, flight-recorder watchdog counters,
 resilience/QoS series, the device & scheduler observability layer
 (``nv_tpu_*``: duty cycle, live MFU, XLA compile events, host<->device
 transfers, HBM, per-bucket tick/pad-waste series — ``device_stats.py``),
-and the SLO burn-rate engine (``nv_slo_*``).  The *client* half of the
+the SLO burn-rate engine (``nv_slo_*``), and the closed-loop fleet
+layer (``nv_fleet_*``: live instance parallelism, serving version,
+autoscaler actuations, rolling updates, supervisor worker restarts —
+``fleet.py``).  The *client* half of the
 observability subsystem renders separately — see
 ``triton_client_tpu._telemetry.ClientTelemetry.render_prometheus``.
 """
@@ -125,6 +128,27 @@ _DEVICE_FAMILIES: List[Tuple[str, str, str, str]] = [
      "Peak device HBM bytes in use since process start"),
     ("mem_limit", "nv_tpu_memory_limit_bytes", "gauge",
      "Device HBM capacity available to this process"),
+]
+
+#: ``nv_fleet_*`` family declarations, keyed by the short row names
+#: ``fleet.collect_fleet_rows`` emits (server/fleet.py).
+_FLEET_FAMILIES: List[Tuple[str, str, str, str]] = [
+    ("instances", "nv_fleet_instances", "gauge",
+     "Live batcher instance parallelism (concurrent in-flight batches) "
+     "per model — the autoscaler's actuation target, summed across "
+     "served versions"),
+    ("serving_version", "nv_fleet_serving_version", "gauge",
+     "Model version unversioned requests currently route to (the "
+     "rolling-update flip moves this)"),
+    ("scale", "nv_fleet_scale_total", "counter",
+     "Autoscaler actuation events per model and direction (out = scale "
+     "out on burn/backlog pressure, in = scale in on sustained idle)"),
+    ("rolling_update", "nv_fleet_rolling_update_total", "counter",
+     "Rolling model updates per model and outcome (completed, "
+     "rolled_back, warmup_failed)"),
+    ("worker_restart", "nv_fleet_worker_restart_total", "counter",
+     "Frontend worker restarts performed by the self-healing "
+     "supervisor, per worker index (from the shared fleet state file)"),
 ]
 
 #: ``nv_slo_*`` family declarations, keyed by ``SloEngine.metric_rows``.
@@ -250,6 +274,13 @@ def collect_families(core: InferenceCore) -> List[Family]:
     slo_rows = core.slo.metric_rows()
     for key, name, kind, help_text in _SLO_FAMILIES:
         families.append((name, help_text, kind, slo_rows.get(key, [])))
+
+    # -- fleet operations (server/fleet.py) --------------------------------
+    from .fleet import collect_fleet_rows
+
+    fleet_rows = collect_fleet_rows(core)
+    for key, name, kind, help_text in _FLEET_FAMILIES:
+        families.append((name, help_text, kind, fleet_rows.get(key, [])))
     return families
 
 

@@ -35,6 +35,11 @@ class ModelRegistry:
         # name -> {version string -> Model}; programmatic models serve {"1"}
         self._version_sets: Dict[str, Dict[str, Model]] = {}
         self._states: Dict[str, tuple] = {}  # name -> (state, reason)
+        # rolling-update staging area: name -> {version -> Model}.  Staged
+        # instances are OUTSIDE the version sets — invisible to routing,
+        # readiness, statistics, and the index — until promoted, so a cold
+        # version can never serve (or report ready) mid-warmup.
+        self._staged: Dict[str, Dict[str, Model]] = {}
         # bumped on every load/unload so per-model caches keyed on the name
         # (batchers, inline-execution profiles) can detect a swapped instance
         self._generations: Dict[str, int] = {}
@@ -98,6 +103,13 @@ class ModelRegistry:
             self._version_sets[name] = vset
             self._states[name] = ("READY", "")
             self._generations[name] = self._generations.get(name, 0) + 1
+            # a full (re)load supersedes any half-finished rolling
+            # update: staged instances are dropped, not leaked
+            for m in self._staged.pop(name, {}).values():
+                try:
+                    m.unload()
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
 
     def unload(self, name: str, unload_dependents: bool = False) -> None:
         with self._lock:
@@ -106,6 +118,11 @@ class ModelRegistry:
                 raise InferError(f"failed to unload '{name}': model is not loaded")
             for m in self._version_sets.pop(name, {"_": model}).values():
                 m.unload()
+            for m in self._staged.pop(name, {}).values():
+                try:
+                    m.unload()
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
             self._states[name] = ("UNAVAILABLE", "unloaded")
             self._generations[name] = self._generations.get(name, 0) + 1
             if unload_dependents and model.config.HasField("ensemble_scheduling"):
@@ -146,6 +163,94 @@ class ModelRegistry:
                 )
             return m
         return model  # unversioned -> the policy's latest
+
+    # -- rolling-update staging (server/fleet.py drives these) --------------
+    def stage_version(self, name: str, model: Model, version: str) -> None:
+        """Park a NEW version instance of a loaded name in the staging
+        area: it takes no traffic and reports not-ready until
+        :meth:`promote`.  The registry generation does not move — the old
+        version's batchers, templates, and caches stay live and serving.
+        """
+        try:
+            int(version)
+        except (TypeError, ValueError):
+            raise InferError(
+                f"cannot stage '{name}' version '{version}': versions "
+                "are numeric strings")
+        with self._lock:
+            if name not in self._models:
+                raise InferError(
+                    f"cannot stage a version for '{name}': model is not "
+                    "loaded")
+            vset = self._version_sets.get(name) or {}
+            staged = self._staged.setdefault(name, {})
+            if version in vset or version in staged:
+                raise InferError(
+                    f"cannot stage '{name}' version {version}: that "
+                    "version is already served or staged")
+            model.served_version = version
+            staged[version] = model
+
+    def staged_version(self, name: str, version: str) -> Optional[Model]:
+        with self._lock:
+            return self._staged.get(name, {}).get(version)
+
+    def abort_stage(self, name: str, version: str) -> Optional[Model]:
+        """Drop a staged instance (failed warmup / abandoned update)."""
+        with self._lock:
+            staged = self._staged.get(name)
+            model = staged.pop(version, None) if staged else None
+            if staged is not None and not staged:
+                self._staged.pop(name, None)
+            return model
+
+    def promote(self, name: str, version: str) -> Model:
+        """THE atomic flip of a rolling update: move the staged instance
+        into the served version set AND make it the default (unversioned)
+        target, under one lock acquisition.  In-flight requests keep the
+        old instance references they already resolved; the old version
+        stays served and explicitly addressable."""
+        with self._lock:
+            staged = self._staged.get(name, {})
+            model = staged.pop(version, None)
+            if model is None:
+                raise InferError(
+                    f"no staged version {version} for '{name}' to promote")
+            if not staged:
+                self._staged.pop(name, None)
+            vset = self._version_sets.setdefault(name, {})
+            vset[version] = model
+            version_list = sorted(vset, key=int)
+            for m in vset.values():
+                m._version_list = version_list
+            self._models[name] = model
+            self._states[name] = ("READY", "")
+            return model
+
+    def demote(self, name: str, version: str,
+               fallback: Optional[str] = None) -> Model:
+        """Remove one served version (rolling-update rollback): the
+        default returns to ``fallback`` (when still served) or the
+        highest remaining version.  Refuses to demote the only version —
+        that is an unload, and it should look like one."""
+        with self._lock:
+            vset = self._version_sets.get(name) or {}
+            if version not in vset:
+                raise InferError(
+                    f"cannot demote '{name}' version {version}: not served")
+            if len(vset) == 1:
+                raise InferError(
+                    f"cannot demote the only served version of '{name}' "
+                    "(unload the model instead)")
+            model = vset.pop(version)
+            version_list = sorted(vset, key=int)
+            for m in vset.values():
+                m._version_list = version_list
+            if fallback is not None and fallback in vset:
+                self._models[name] = vset[fallback]
+            elif self._models.get(name) is model:
+                self._models[name] = vset[version_list[-1]]
+            return model
 
     def generation(self, name: str) -> int:
         """Monotonic per-name counter; changes whenever the served instance

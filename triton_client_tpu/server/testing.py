@@ -16,8 +16,10 @@ take a replica down mid-run and bring it back on the same ports.
 from __future__ import annotations
 
 import asyncio
+import os
 import socket
 import threading
+import time
 from typing import Callable, List, Optional
 
 from .._xla_broker import broker
@@ -145,11 +147,19 @@ class ClusterHarness:
     """
 
     def __init__(self, registry_factory: Callable[[], "ModelRegistry"],
-                 n: int = 3, host: str = "127.0.0.1"):
+                 n: int = 3, host: str = "127.0.0.1",
+                 core_setup: Optional[Callable[[ServerHarness], None]]
+                 = None):
         if n < 1:
             raise ValueError("ClusterHarness needs at least one server")
         self._registry_factory = registry_factory
         self.host = host
+        # per-replica post-start hook (SLO objectives, fleet controllers,
+        # queue limits, ...): applied to every replica INCLUDING ones a
+        # restart() brings back — a healed replica must rejoin with the
+        # same policy surface its predecessor ran, like a real process
+        # respawned from the same config
+        self._core_setup = core_setup
         self.harnesses: List[Optional[ServerHarness]] = [
             ServerHarness(registry_factory(), host=host) for _ in range(n)]
         # ports are pinned at construction so restart(i) can rebind them
@@ -167,6 +177,8 @@ class ClusterHarness:
     def start(self) -> "ClusterHarness":
         for h in self.harnesses:
             h.start()
+            if self._core_setup is not None:
+                self._core_setup(h)
         return self
 
     def stop(self) -> None:
@@ -193,6 +205,8 @@ class ClusterHarness:
                           http_port=self._http_ports[i],
                           grpc_port=self._grpc_ports[i], host=self.host)
         h.start()
+        if self._core_setup is not None:
+            self._core_setup(h)
         self.harnesses[i] = h
 
     def chaos(self, i: int, injector) -> None:
@@ -204,3 +218,73 @@ class ClusterHarness:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+class ReplicaSupervisor:
+    """Self-healing for :class:`ClusterHarness` — the in-process analog
+    of the ``--frontends`` supervisor, sharing its crash arithmetic
+    (``fleet.RestartPolicy``) and restart accounting
+    (``fleet.SupervisorState``) so fleet drills exercise the SAME policy
+    the production supervisor runs.
+
+    ``crash(i)`` is the kill signal (wire it to a chaos injector's
+    ``worker_kill_cb``): the replica is stopped, the policy's backoff is
+    paid on a worker thread, the replica is restarted on its original
+    ports, and the restart lands in the state file — with
+    ``TRITON_TPU_FLEET_STATE`` pointing there, every surviving replica's
+    ``/metrics`` shows ``nv_fleet_worker_restart_total`` climbing.  A
+    storm verdict (policy returns None) leaves the replica down, like
+    the production fail-fast."""
+
+    def __init__(self, cluster: ClusterHarness, policy=None,
+                 state_path: Optional[str] = None):
+        import tempfile
+
+        from .fleet import RestartPolicy, SupervisorState
+
+        self.cluster = cluster
+        self.policy_factory = policy or (
+            lambda: RestartPolicy(base_delay_s=0.05, max_delay_s=1.0))
+        self._policies = {}
+        if state_path is None:
+            fd, state_path = tempfile.mkstemp(prefix="tc-tpu-fleet-state-",
+                                              suffix=".json")
+            os.close(fd)
+            os.unlink(state_path)
+        self.state = SupervisorState(state_path)
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    def crash(self, i: int) -> None:
+        """Kill replica ``i`` and heal it with backoff, off-thread (safe
+        to call from a serving event loop via ``worker_kill_cb`` — the
+        kill itself must not deadlock the loop it is called from)."""
+        t = threading.Thread(target=self._heal, args=(i,), daemon=True)
+        with self._lock:
+            self._threads.append(t)
+        t.start()
+
+    def _heal(self, i: int) -> None:
+        with self._lock:
+            policy = self._policies.setdefault(i, self.policy_factory())
+            delay = policy.on_crash()
+        try:
+            self.cluster.kill(i)
+        except Exception:  # noqa: BLE001 — already down is fine
+            pass
+        if delay is None:
+            return  # crash storm: stay down (production fail-fast)
+        time.sleep(delay)
+        with self._lock:
+            if self.cluster.harnesses[i] is not None:
+                return  # someone else already brought it back
+            self.cluster.restart(i)
+            self.state.record_restart(str(i))
+
+    def join(self, timeout: float = 30.0) -> None:
+        """Wait for in-flight heals (test teardown barrier)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))

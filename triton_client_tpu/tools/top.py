@@ -5,8 +5,12 @@ Polls two HTTP surfaces — ``GET /metrics`` (the Triton-convention
 always-on flight recorder's live per-model quantiles + pinned outliers) —
 and renders one refreshing per-model table: QPS, p50/p99, queue share,
 realized batch, in-flight requests, error rate, watchdog counters, device
-duty cycle, the SLO burn rate (with a ``!`` breach marker when both the
-5m and 1h windows burn over the fast-burn threshold), and the most recent
+duty cycle, the fleet columns (INST = live batcher instance parallelism,
+VER = the version unversioned traffic routes to), the SLO burn rate
+(with a ``!`` breach marker when both the 5m and 1h windows burn over
+the fast-burn threshold, and an autoscale-actuation marker beside it:
+``^`` scaled out / ``v`` scaled in since the previous poll), the
+supervisor's worker-restart count in the header, and the most recent
 pinned outlier — plus a **buckets** view (one line per model/bucket with
 tick rate, realized occupancy, pad-waste %, assembly cost, and queue
 depth) whenever the server exports ``nv_tpu_tick_*`` series.  "What is
@@ -105,12 +109,16 @@ _BUCKET_METRICS = {
 
 
 def parse_device(text: str) -> Dict[str, Any]:
-    """Device/SLO series -> ``{"duty": {model: v}, "mfu": {model: v},
-    "burn": {(model, window): v}, "burn_threshold": v, "buckets":
-    {(model, bucket): {field: v}}}``.  Servers predating the device-stats
-    layer simply produce empty maps (and the default threshold)."""
+    """Device/SLO/fleet series -> ``{"duty": {model: v}, "mfu": {model:
+    v}, "burn": {(model, window): v}, "burn_threshold": v, "buckets":
+    {(model, bucket): {field: v}}, "inst": {model: v}, "ver": {model:
+    v}, "scale": {(model, direction): v}, "restarts": {worker: v}}``.
+    Servers predating the device-stats or fleet layers simply produce
+    empty maps (and the default threshold)."""
     out: Dict[str, Any] = {"duty": {}, "mfu": {}, "burn": {}, "buckets": {},
-                           "burn_threshold": 14.4}
+                           "burn_threshold": 14.4,
+                           "inst": {}, "ver": {}, "scale": {},
+                           "restarts": {}}
     for line in text.splitlines():
         if line.startswith("#"):
             continue
@@ -123,8 +131,20 @@ def parse_device(text: str) -> Dict[str, Any]:
             # marker must agree with a non-default --slo-burn-threshold
             out["burn_threshold"] = float(value)
             continue
+        if name == "nv_fleet_worker_restart_total":
+            # kept per worker: every worker of one supervised fleet
+            # exports the SAME fleet-global counters (shared state
+            # file), so the fleet view must dedup per worker across
+            # polled endpoints, not sum endpoints
+            labels = dict(_LABEL_RE.findall(labels_raw or ""))
+            worker = labels.get("worker", "")
+            out["restarts"][worker] = (out["restarts"].get(worker, 0.0)
+                                       + float(value))
+            continue
         if name not in ("nv_tpu_duty_cycle", "nv_tpu_live_mfu",
-                        "nv_slo_burn_rate") and name not in _BUCKET_METRICS:
+                        "nv_slo_burn_rate", "nv_fleet_instances",
+                        "nv_fleet_serving_version", "nv_fleet_scale_total"
+                        ) and name not in _BUCKET_METRICS:
             continue
         labels = dict(_LABEL_RE.findall(labels_raw or ""))
         model = labels.get("model", "")
@@ -136,6 +156,13 @@ def parse_device(text: str) -> Dict[str, Any]:
             out["mfu"][model] = float(value)
         elif name == "nv_slo_burn_rate":
             out["burn"][(model, labels.get("window", ""))] = float(value)
+        elif name == "nv_fleet_instances":
+            out["inst"][model] = float(value)
+        elif name == "nv_fleet_serving_version":
+            out["ver"][model] = float(value)
+        elif name == "nv_fleet_scale_total":
+            key = (model, labels.get("direction", ""))
+            out["scale"][key] = out["scale"].get(key, 0.0) + float(value)
         else:
             bucket = labels.get("bucket", "")
             entry = out["buckets"].setdefault((model, bucket), {})
@@ -237,10 +264,23 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
         total = succ + fail
         rec = recorder.get("models", {}).get(model, {})
         device = cur.get("device") or {}
+        pdevice = (prev.get("device") or {}) if prev else None
         duty = device.get("duty", {}).get(model)
         mfu = device.get("mfu", {}).get(model)
         burn5 = device.get("burn", {}).get((model, "5m"))
         burn1h = device.get("burn", {}).get((model, "1h"))
+        inst = device.get("inst", {}).get(model)
+        ver = device.get("ver", {}).get(model)
+        # autoscale-actuation marker: did nv_fleet_scale_total move for
+        # this model between polls?  (Needs a delta base — the first/only
+        # sample shows no marker rather than re-flagging history.)
+        scaled = ""
+        if pdevice is not None:
+            for direction, mark in (("out", "^"), ("in", "v")):
+                d = (device.get("scale", {}).get((model, direction), 0.0)
+                     - pdevice.get("scale", {}).get((model, direction), 0.0))
+                if d > 0:
+                    scaled += mark
         rows[model] = {
             "qps": round(total / dt, 1) if dt else None,
             "p50_ms": rec.get("p50_ms"),
@@ -264,6 +304,11 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "duty_pct": (round(100.0 * duty, 1)
                          if duty is not None else None),
             "mfu_pct": round(100.0 * mfu, 1) if mfu is not None else None,
+            # fleet layer: live instance parallelism, serving version,
+            # and whether the autoscaler actuated since the last poll
+            "instances": int(inst) if inst is not None else None,
+            "version": int(ver) if ver is not None else None,
+            "scaled": scaled or None,
             "burn_5m": round(burn5, 1) if burn5 is not None else None,
             "burn_1h": round(burn1h, 1) if burn1h is not None else None,
             # multi-window breach at the server's exported threshold
@@ -438,6 +483,20 @@ def _tenant_lines(rows: Dict[str, Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def aggregate_restarts(per_url: Dict[str, Dict[str, float]]) -> int:
+    """Fleet worker-restart total across polled endpoints.  Every
+    worker of one supervised fleet reports the SAME fleet-global
+    counters (they all read the supervisor's shared state file), so a
+    per-endpoint SUM would multiply the truth by the number of polled
+    workers — dedup by taking the max per worker label across
+    endpoints, then sum workers."""
+    per_worker: Dict[str, float] = {}
+    for counts in per_url.values():
+        for worker, v in (counts or {}).items():
+            per_worker[worker] = max(per_worker.get(worker, 0.0), v)
+    return int(sum(per_worker.values()))
+
+
 def _outlier_brief(o: Optional[dict]) -> Optional[Dict[str, Any]]:
     if o is None:
         return None
@@ -506,6 +565,15 @@ def aggregate_rows(per_url_rows: Dict[str, Dict[str, Dict[str, Any]]]
             "burn_5m": _worst("burn_5m"),
             "burn_1h": _worst("burn_1h"),
             "slo_breach": any(r.get("slo_breach") for r in rows),
+            # fleet columns: instances sum (total executing capacity),
+            # version = the newest any replica serves (a mid-rollout
+            # fleet shows the front of the wave; the per-server rows
+            # underneath show who lags), marker if ANY replica actuated
+            "instances": _sum("instances", nd=0),
+            "version": _worst("version"),
+            "scaled": "".join(sorted({c for r in rows
+                                      for c in (r.get("scaled") or "")}),
+                              ) or None,
             "last_outlier": (min(outliers, key=lambda o: o["age_s"])
                             if outliers else None),
         }
@@ -524,7 +592,8 @@ def _fmt(v, nd: int = 1) -> str:
 
 _COLUMNS = (f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
             f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'REJ/s':>7}{'DLX/s':>7}"
-            f"{'SLOW':>6}{'CAPT':>6}{'DUTY%':>7}{'BURN':>7}"
+            f"{'SLOW':>6}{'CAPT':>6}{'DUTY%':>7}{'INST':>6}{'VER':>5}"
+            f"{'BURN':>9}"
             f"  LAST OUTLIER")
 
 
@@ -541,10 +610,14 @@ def _row_line(label: str, r: Dict[str, Any]) -> str:
         if o["outcome"] != "ok":
             brief += f" ({o['outcome'][:40]})"
     # the breach marker rides the burn column: "23.1!" = both windows
-    # over the fast-burn threshold (the page condition)
+    # over the fast-burn threshold (the page condition); the autoscale
+    # marker rides next to it — "^" = scaled out since the last poll,
+    # "v" = scaled in (the alarm and its actuator, side by side)
     burn = _fmt(r.get("burn_5m"))
     if r.get("slo_breach"):
         burn += "!"
+    if r.get("scaled"):
+        burn += r["scaled"]
     return (
         f"  {label:<24}{_fmt(r['qps']):>8}{_fmt(r['p50_ms']):>9}"
         f"{_fmt(r['p99_ms']):>9}{_fmt(r['queue_share_pct']):>8}"
@@ -552,7 +625,8 @@ def _row_line(label: str, r: Dict[str, Any]) -> str:
         f"{_fmt(r['error_pct'], 2):>7}{_fmt(r['rejected_per_s']):>7}"
         f"{_fmt(r['deadline_exceeded_per_s']):>7}{r['slow_total']:>6}"
         f"{r['captured_total']:>6}{_fmt(r.get('duty_pct')):>7}"
-        f"{burn:>7}  {brief}")
+        f"{_fmt(r.get('instances')):>6}{_fmt(r.get('version')):>5}"
+        f"{burn:>9}  {brief}")
 
 
 def _bucket_rank(bucket: Any) -> tuple:
@@ -593,13 +667,18 @@ def render(url: str, cur: Dict[str, Any],
            tenants: Optional[Dict[str, Dict[str, Any]]] = None,
            buckets: Optional[Dict[tuple, Dict[str, Any]]] = None) -> str:
     recorder = cur["recorder"]
+    restarts = int(sum(
+        ((cur.get("device") or {}).get("restarts") or {}).values()))
     lines = [
         f"triton-top — {url} — {time.strftime('%H:%M:%S')}  "
         f"refresh={interval:g}s  recorder="
         f"{'on' if recorder.get('enabled') else 'OFF'} "
         f"({recorder.get('capture_slower_than')}, "
         f"{recorder.get('recorded_total', 0)} recorded, "
-        f"{len(recorder.get('outliers', []))} outlier(s) pinned)",
+        f"{len(recorder.get('outliers', []))} outlier(s) pinned)"
+        # the self-healing supervisor's scoreboard: nonzero means a
+        # frontend worker crashed and was restarted behind this port
+        + (f"  worker-restarts={restarts}" if restarts else ""),
         "",
         _COLUMNS,
     ]
@@ -616,14 +695,16 @@ def render_fleet(urls: List[str],
                  per_url_rows: Dict[str, Dict[str, Dict[str, Any]]],
                  agg: Dict[str, Dict[str, Any]], interval: float,
                  tenants: Optional[Dict[str, Dict[str, Any]]] = None,
-                 buckets: Optional[Dict[tuple, Dict[str, Any]]] = None
-                 ) -> str:
+                 buckets: Optional[Dict[tuple, Dict[str, Any]]] = None,
+                 restarts: int = 0) -> str:
     """Fleet view: one aggregated row per model (sums + worst-replica
     tails) with a per-server breakdown row for every polled endpoint."""
     down = [u for u in urls if u not in per_url_rows]
     header = (f"triton-top — fleet of {len(urls)} "
               f"({len(urls) - len(down)} up) — {time.strftime('%H:%M:%S')}  "
               f"refresh={interval:g}s")
+    if restarts:
+        header += f"  worker-restarts={restarts}"
     if down:
         header += "  DOWN: " + ", ".join(down)
     lines = [header, "", _COLUMNS]
@@ -725,11 +806,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     def fold(cur, prev):
         """Per-server rows + the fleet aggregates from one (or two)
-        polls; also returns the per-tenant QoS aggregate and the
-        (model, bucket) tick aggregate."""
+        polls; also returns the per-tenant QoS aggregate, the
+        (model, bucket) tick aggregate, and the summed supervisor
+        worker-restart count."""
         per_url = {}
         per_url_tenants = {}
         per_url_buckets = {}
+        per_url_restarts = {}
         for base, s in cur.items():
             if s is None:
                 continue
@@ -738,15 +821,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                                        include_idle=args.include_idle)
             per_url_tenants[base] = tenant_rows(s, p)
             per_url_buckets[base] = bucket_rows(s, p)
+            per_url_restarts[base] = (s.get("device") or {}).get(
+                "restarts") or {}
         return (per_url, aggregate_rows(per_url),
                 aggregate_tenants(per_url_tenants),
-                aggregate_buckets(per_url_buckets))
+                aggregate_buckets(per_url_buckets),
+                aggregate_restarts(per_url_restarts))
 
     cur = sample_all()
     if all(s is None for s in cur.values()):
         return 1
     if args.once:
-        per_url, agg, tenants, buckets = fold(cur, None)
+        per_url, agg, tenants, buckets, restarts = fold(cur, None)
         if args.as_json:
             if fleet:
                 out = {
@@ -755,6 +841,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "models": agg,
                     "tenants": tenants,
                     "buckets": _buckets_json(buckets),
+                    "worker_restarts": restarts,
                     # per-endpoint samples: each server's rows + recorder
                     "endpoints": {
                         base: (None if cur[base] is None else {
@@ -765,20 +852,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                 }
             else:
                 # single-url shape unchanged (scripting compat); buckets
-                # are additive — a new key, never a reshaped one
+                # and worker_restarts are additive — new keys, never a
+                # reshaped one
                 out = {
                     "url": bases[0],
                     "ts": time.time(),
                     "models": per_url.get(bases[0], {}),
                     "tenants": tenants,
                     "buckets": _buckets_json(buckets),
+                    "worker_restarts": restarts,
                     "recorder": cur[bases[0]]["recorder"],
                 }
             print(json.dumps(out, indent=2))
         elif fleet:
             sys.stdout.write(render_fleet(bases, per_url, agg,
                                           args.interval, tenants=tenants,
-                                          buckets=buckets))
+                                          buckets=buckets,
+                                          restarts=restarts))
         else:
             sys.stdout.write(render(bases[0], cur[bases[0]],
                                     per_url.get(bases[0], {}),
@@ -796,7 +886,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # console alive and retry — monitoring must not die at
                 # exactly the moment the server gets interesting
                 continue
-            per_url, agg, tenants, buckets = fold(cur, prev)
+            per_url, agg, tenants, buckets, restarts = fold(cur, prev)
             if args.as_json:
                 print(json.dumps({
                     "ts": time.time(),
@@ -804,6 +894,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                               next(iter(per_url.values()), {}),
                     "tenants": tenants,
                     "buckets": _buckets_json(buckets),
+                    "worker_restarts": restarts,
                     **({"endpoints": {b: per_url.get(b)
                                       for b in bases}} if fleet else {}),
                 }))
@@ -814,7 +905,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     sys.stdout.write(render_fleet(bases, per_url, agg,
                                                   args.interval,
                                                   tenants=tenants,
-                                                  buckets=buckets))
+                                                  buckets=buckets,
+                                                  restarts=restarts))
                 else:
                     sys.stdout.write(render(bases[0], cur[bases[0]],
                                             per_url.get(bases[0], {}),
