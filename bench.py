@@ -1304,6 +1304,117 @@ def _measure_qos_overload() -> dict:
     return {"qos_overload": result}
 
 
+def _measure_mem_overload() -> dict:
+    """Memory-governor A/B (ISSUE 14): an oversized-payload burst at ~2x
+    the host byte budget with the governor ON vs OFF.
+
+    Eight closed-loop flood threads send 512 KiB best-effort payloads
+    against a 2 MiB budget while a serial tier-0 small-payload probe
+    stream measures p99.  Recorded per window: the governor's peak
+    in-flight bytes (the ledger the budget bounds — OFF tracks but never
+    sheds, so the A/B shows exactly the bytes the budget refused to
+    hold), shed counts, whether every refusal was a typed 429 (zero
+    connection resets), tier-0 p99, and the process RSS delta.
+    Host-only; never kills the bench."""
+    import gc
+    import resource
+
+    import triton_client_tpu.http as httpclient
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server.registry import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+    from triton_client_tpu.utils import InferenceServerException
+
+    gc.collect()
+    model = "custom_identity_int32"
+    budget = 2 << 20
+    big = np.zeros((1, 128 << 10), np.int32)   # 512 KiB payload
+    small = np.arange(64, dtype=np.int32).reshape(1, 64)
+    flood_threads = 8                           # ~2x budget in flight
+
+    def make_inputs(arr):
+        i = httpclient.InferInput("INPUT0", list(arr.shape), "INT32")
+        i.set_data_from_numpy(arr)
+        return [i]
+
+    def window(governor_on: bool):
+        registry = ModelRegistry()
+        registry.register_model(zoo.make_custom_identity_int32())
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        with ServerHarness(registry) as h:
+            h.core.memory.budget_bytes = budget if governor_on else 0
+            stop = threading.Event()
+            typed, resets = [0], [0]
+
+            def flood():
+                with httpclient.InferenceServerClient(h.http_url) as c:
+                    inputs = make_inputs(big)
+                    while not stop.is_set():
+                        try:
+                            c.infer(model, inputs, priority=3,
+                                    tenant="whale")
+                        except InferenceServerException as e:
+                            if e.status() in ("429", "413"):
+                                typed[0] += 1
+                            else:
+                                resets[0] += 1
+                        except Exception:
+                            resets[0] += 1
+
+            threads = [threading.Thread(target=flood, daemon=True)
+                       for _ in range(flood_threads)]
+            for t in threads:
+                t.start()
+            time.sleep(0.4)
+            lat = []
+            with httpclient.InferenceServerClient(h.http_url) as c:
+                inputs = make_inputs(small)
+                for _ in range(50):
+                    t0 = time.perf_counter()
+                    c.infer(model, inputs, priority=0, tenant="gold")
+                    lat.append(time.perf_counter() - t0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            gov = h.core.memory
+            rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            return {
+                "peak_inflight_bytes": gov.peak_inflight_bytes,
+                "shed_total": gov.shed_total(),
+                "typed_sheds_seen": typed[0],
+                "connection_resets": resets[0],
+                "tier0_p99_ms": round(float(
+                    np.percentile(np.asarray(lat), 99) * 1e3), 2),
+                "rss_delta_kb": max(0, rss1 - rss0),
+            }
+
+    try:
+        on = window(governor_on=True)
+        off = window(governor_on=False)
+    except Exception as e:  # noqa: BLE001 — this leg never kills bench
+        return {"mem_overload_error": str(e)[:120]}
+    return {"mem_overload": {
+        "budget_bytes": budget,
+        "payload_bytes": int(big.nbytes),
+        "flood_threads": flood_threads,
+        # ru_maxrss is MONOTONIC per process: the first window (ON) also
+        # absorbs harness/XLA warmup growth, so read rss_delta_kb as an
+        # upper bound there; peak_inflight_bytes is the precise ledger
+        "rss_note": "ru_maxrss is monotonic; first window absorbs warmup",
+        "governor_on": on,
+        "governor_off": off,
+        # the acceptance read: ON keeps the ledger bounded by the budget
+        # (+ one response's worth, which joins post-admission); OFF lets
+        # it grow with the burst
+        "peak_within_budget": bool(
+            on["peak_inflight_bytes"] <= budget + int(big.nbytes) * 2),
+        "peak_ratio_off_over_on": (
+            round(off["peak_inflight_bytes"]
+                  / on["peak_inflight_bytes"], 2)
+            if on["peak_inflight_bytes"] else None),
+    }}
+
+
 def _measure_fleet_ops() -> dict:
     """Closed-loop fleet drill (ISSUE 13): recovery-time-to-SLO after a
     seeded replica kill plus a mid-run rolling model update.
@@ -1782,6 +1893,9 @@ def main() -> int:
     cluster_metrics = _measure_cluster()
     # QoS A/B: tier-0 p99 with vs without priority tiers at 2x overload
     qos_metrics = _measure_qos_overload()
+    # memory governor A/B (ISSUE 14): oversized burst at 2x byte budget,
+    # governor on vs off — peak ledger bytes, typed sheds, tier-0 p99
+    mem_metrics = _measure_mem_overload()
     # closed-loop fleet ops (ISSUE 13): recovery-time-to-SLO after a
     # seeded replica kill + a mid-run rolling update
     fleet_metrics = _measure_fleet_ops()
@@ -1846,6 +1960,8 @@ def main() -> int:
     out.update(cluster_metrics)
     # multi-tenant QoS: the graceful-degradation A/B under overload
     out.update(qos_metrics)
+    # memory governor: the byte-budget overload A/B
+    out.update(mem_metrics)
     # fleet operations: kill-recovery + rolling-update drill numbers
     out.update(fleet_metrics)
     # client-side telemetry (the instrumented clients recorded every leg):

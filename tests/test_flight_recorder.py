@@ -280,7 +280,8 @@ def _infer_simple(server):
 _RECORD_KEYS = {"seq", "request_id", "model", "version", "protocol",
                 "batch", "bytes_in", "bytes_out", "ts", "queue_us",
                 "compute_us", "total_us", "outcome", "captured",
-                "capture_reason", "chaos", "tenant", "tier", "tick"}
+                "capture_reason", "chaos", "tenant", "tier", "tick",
+                "shed_reason"}
 _TOP_LEVEL_KEYS = {"enabled", "capture_slower_than", "ring_capacity",
                    "outlier_capacity", "recorded_total", "models",
                    "recent", "outliers"}
@@ -541,7 +542,8 @@ class TestTritonTop:
                 "deadline_exceeded_per_s", "slow_total", "captured_total",
                 "threshold_ms", "duty_pct", "mfu_pct", "burn_5m",
                 "burn_1h", "slo_breach", "instances", "version",
-                "scaled", "last_outlier"} == set(row)
+                "scaled", "mem_pct", "mem_shed_per_s",
+                "last_outlier"} == set(row)
         # fleet columns materialize from the nv_fleet_* series: the
         # harness server exports a serving version for every model
         assert row["version"] == 1

@@ -1,0 +1,222 @@
+"""gRPC wire-level robustness: malformed protobufs, oversize messages, and
+truncated payloads must never crash the server or hang a connection —
+every outcome is a clean gRPC status code (ISSUE 14's fuzz satellite,
+the gRPC sibling of tests/test_fuzz_http.py).
+
+The server under test runs a small ``--max-request-bytes`` so oversize
+rejection is exercisable without allocating real 64 MiB payloads: the
+channel-option cap refuses the message at the transport
+(RESOURCE_EXHAUSTED carrying both sizes) before the handler runs.
+"""
+
+import random
+import socket
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import grpc as grpc_mod  # noqa: E402
+
+import triton_client_tpu.grpc as grpcclient  # noqa: E402
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.protocol import (GRPCInferenceServiceStub,  # noqa: E402
+                                        SERVICE_NAME)
+from triton_client_tpu.protocol import inference_pb2 as pb  # noqa: E402
+from triton_client_tpu.server import ModelRegistry  # noqa: E402
+from triton_client_tpu.server.testing import ServerHarness  # noqa: E402
+
+CAP = 256 << 10  # small wire cap so oversize cases stay cheap
+
+
+@pytest.fixture(scope="module")
+def server():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry, max_request_bytes=CAP) as h:
+        yield h
+
+
+def _alive(server) -> bool:
+    """The server still serves a clean inference after the abuse."""
+    with grpcclient.InferenceServerClient(server.grpc_url) as c:
+        a = np.ones((1, 16), np.int32)
+        i0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_data_from_numpy(a)
+        i1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_data_from_numpy(a)
+        r = c.infer("simple", [i0, i1])
+        return bool((r.as_numpy("OUTPUT0") == 2).all())
+
+
+def _channel(server):
+    return grpc_mod.insecure_channel(server.grpc_url)
+
+
+def _simple_request(per_input_bytes=64):
+    req = pb.ModelInferRequest(model_name="simple")
+    for name in ("INPUT0", "INPUT1"):
+        t = req.inputs.add(name=name, datatype="INT32")
+        t.shape.extend([1, 16])
+        req.raw_input_contents.append(b"\x00" * per_input_bytes)
+    return req
+
+
+class TestOversizeMessages:
+    def test_over_cap_is_resource_exhausted_with_limit(self, server):
+        """A message past --max-request-bytes is refused by the channel
+        option BEFORE the handler runs — RESOURCE_EXHAUSTED whose details
+        carry both sizes, never a connection reset."""
+        channel = _channel(server)
+        try:
+            stub = GRPCInferenceServiceStub(channel)
+            req = _simple_request()
+            req.raw_input_contents[0] = b"\x00" * (CAP + (64 << 10))
+            with pytest.raises(grpc_mod.RpcError) as e:
+                stub.ModelInfer(req, timeout=30)
+            assert e.value.code() == grpc_mod.StatusCode.RESOURCE_EXHAUSTED
+            assert str(CAP) in (e.value.details() or "")
+        finally:
+            channel.close()
+        assert _alive(server)
+
+    def test_under_cap_boundary_still_serves(self, server):
+        """Near-cap (but valid) messages pass: the cap refuses giants,
+        not legitimate large tensors."""
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            n = (CAP // 2) // 4  # half the cap in int32s
+            arr = np.zeros((1, n), np.int32)
+            i = grpcclient.InferInput("INPUT0", [1, n], "INT32")
+            i.set_data_from_numpy(arr)
+            r = c.infer("custom_identity_int32", [i])
+            assert r.as_numpy("OUTPUT0").shape == (1, n)
+        assert _alive(server)
+
+    def test_oversize_not_retried_by_policy(self, server):
+        """Satellite regression: a RetryPolicy with RESOURCE_EXHAUSTED in
+        its (default) retryable set must NOT re-send an oversize payload —
+        the transport rejection is deterministic."""
+        from triton_client_tpu._resilience import RetryPolicy
+
+        calls = []
+        with grpcclient.InferenceServerClient(server.grpc_url) as c:
+            n = (CAP + (64 << 10)) // 4
+            arr = np.zeros((1, n), np.int32)
+            i = grpcclient.InferInput("INPUT0", [1, n], "INT32")
+            i.set_data_from_numpy(arr)
+            policy = RetryPolicy(max_attempts=3, retry_infer=True, seed=0)
+            orig = policy.should_retry
+
+            def spy(exc, method, attempt):
+                verdict = orig(exc, method, attempt)
+                calls.append((attempt, verdict))
+                return verdict
+
+            policy.should_retry = spy
+            with pytest.raises(Exception):
+                c.infer("custom_identity_int32", [i], retry_policy=policy)
+        # exactly one attempt ever ran: the classifier refused the retry
+        assert calls and all(v is False for _, v in calls)
+        assert max(a for a, _ in calls) == 1
+
+
+class TestMalformedProtobuf:
+    def test_garbage_bytes_get_clean_status(self, server):
+        """Seeded garbage through the raw method path: the server's
+        deserializer must answer a status, never crash or hang."""
+        rng = random.Random(4242)
+        channel = _channel(server)
+        try:
+            call = channel.unary_unary(
+                f"/{SERVICE_NAME}/ModelInfer",
+                request_serializer=lambda b: b,       # ship raw bytes
+                response_deserializer=lambda b: b)
+            for i in range(40):
+                blob = bytes(rng.getrandbits(8)
+                             for _ in range(rng.randint(1, 512)))
+                try:
+                    call(blob, timeout=30)
+                except grpc_mod.RpcError as e:
+                    # any CLEAN status is acceptable; a hang (DEADLINE from
+                    # our own 30s timeout) or a torn connection is not
+                    assert e.code() not in (
+                        grpc_mod.StatusCode.DEADLINE_EXCEEDED,
+                        grpc_mod.StatusCode.UNAVAILABLE), (i, e.code())
+        finally:
+            channel.close()
+        assert _alive(server)
+
+    def test_truncated_and_mismatched_raw_contents(self, server):
+        """raw_input_contents truncation in every direction: fewer entries
+        than inputs, more entries than inputs, and entries shorter than
+        the dtype demands — all INVALID_ARGUMENT."""
+        cases = []
+        r1 = _simple_request()
+        del r1.raw_input_contents[1]          # fewer raws than inputs
+        cases.append(r1)
+        r2 = _simple_request()
+        r2.raw_input_contents.append(b"\x00")  # more raws than inputs
+        cases.append(r2)
+        r3 = _simple_request(per_input_bytes=7)  # not 16 int32s
+        cases.append(r3)
+        channel = _channel(server)
+        try:
+            stub = GRPCInferenceServiceStub(channel)
+            for i, req in enumerate(cases):
+                with pytest.raises(grpc_mod.RpcError) as e:
+                    stub.ModelInfer(req, timeout=30)
+                assert e.value.code() == \
+                    grpc_mod.StatusCode.INVALID_ARGUMENT, (i, e.value.code())
+        finally:
+            channel.close()
+        assert _alive(server)
+
+    def test_hostile_field_values(self, server):
+        """Adversarial but well-formed protobufs: absurd shapes, empty
+        names, negative dims, junk dtypes — clean INVALID_ARGUMENT /
+        NOT_FOUND, never INTERNAL or UNKNOWN."""
+        rng = random.Random(77)
+        channel = _channel(server)
+        try:
+            stub = GRPCInferenceServiceStub(channel)
+            for i in range(30):
+                req = pb.ModelInferRequest(
+                    model_name=rng.choice(["simple", "", "nope"]))
+                t = req.inputs.add(
+                    name=rng.choice(["INPUT0", "", "X" * 100]),
+                    datatype=rng.choice(["INT32", "NOPE", "", "BYTES"]))
+                t.shape.extend(rng.choice(
+                    [[1, 16], [-1, -1], [0], [1 << 40], []]))
+                req.raw_input_contents.append(
+                    bytes(rng.getrandbits(8)
+                          for _ in range(rng.randint(0, 64))))
+                try:
+                    stub.ModelInfer(req, timeout=30)
+                except grpc_mod.RpcError as e:
+                    assert e.code() in (
+                        grpc_mod.StatusCode.INVALID_ARGUMENT,
+                        grpc_mod.StatusCode.NOT_FOUND,
+                        grpc_mod.StatusCode.RESOURCE_EXHAUSTED), \
+                        (i, e.code(), e.details())
+        finally:
+            channel.close()
+        assert _alive(server)
+
+
+class TestRawSocket:
+    def test_non_grpc_bytes_then_hard_close(self, server):
+        """Raw garbage at the gRPC port (not even HTTP/2) plus an abrupt
+        close — the listener must survive and keep serving."""
+        for payload in (
+            b"GET / HTTP/1.1\r\n\r\n",
+            b"\x00" * 64,
+            b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + b"\xff" * 32,
+        ):
+            s = socket.create_connection(
+                ("127.0.0.1", server.grpc_port), timeout=10)
+            try:
+                s.sendall(payload)
+            finally:
+                s.close()
+        assert _alive(server)

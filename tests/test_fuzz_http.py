@@ -222,6 +222,96 @@ class TestHardenedEdges:
         assert _alive(server)
 
 
+class TestOversizePayloads:
+    """ISSUE 14's wire ingress cap: oversize/boundary requests against a
+    server with a small --max-request-bytes — typed 413s carrying the
+    limit, never 500s or connection resets."""
+
+    CAP = 64 << 10
+
+    @pytest.fixture(scope="class")
+    def capped(self):
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        with ServerHarness(registry, max_request_bytes=self.CAP) as h:
+            yield h
+
+    def test_oversize_body_is_typed_413(self, capped):
+        body = b"x" * (self.CAP + 1)
+        status, payload = _post(
+            capped.http_url, "/v2/models/simple/infer", body,
+            headers={"Content-Type": "application/octet-stream"})
+        assert status == 413
+        err = json.loads(payload)["error"]
+        assert str(self.CAP) in err  # the limit travels in the message
+
+    def test_oversize_413_carries_limit_and_pushback_headers(self, capped):
+        req = urllib.request.Request(
+            f"http://{capped.http_url}/v2/models/simple/infer",
+            data=b"x" * (self.CAP + 1),
+            headers={"Content-Type": "application/octet-stream"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 413
+        assert e.value.headers.get(
+            "triton-max-request-bytes") == str(self.CAP)
+        assert e.value.headers.get("Retry-After") is not None
+
+    def test_header_announced_oversize_rejected_early(self, capped):
+        """A tiny body whose Inference-Header-Content-Length announces a
+        giant JSON header is refused from the announcement alone."""
+        status, _ = _post(
+            capped.http_url, "/v2/models/simple/infer", b"{}",
+            headers={"Content-Type": "application/octet-stream",
+                     "Inference-Header-Content-Length": str(1 << 30)})
+        assert status == 413
+
+    def test_boundary_at_cap_still_serves(self, capped):
+        """A valid request under the cap passes — the cap refuses giants,
+        not legitimate traffic (and the same client then sees 2s)."""
+        n = (self.CAP // 2) // 4
+        arr = list(range(16))
+        body = json.dumps({
+            "inputs": [{"name": "INPUT0", "datatype": "INT32",
+                        "shape": [1, 16], "data": [arr]},
+                       {"name": "INPUT1", "datatype": "INT32",
+                        "shape": [1, 16], "data": [arr]}],
+        }).encode()
+        assert len(body) < self.CAP
+        status, _ = _post(capped.http_url, "/v2/models/simple/infer", body)
+        assert status == 200
+        # binary framing just under the cap (one big identity tensor)
+        header = json.dumps({
+            "inputs": [{"name": "INPUT0", "datatype": "INT32",
+                        "shape": [1, n],
+                        "parameters": {"binary_data_size": n * 4}}],
+        }).encode()
+        body = header + b"\x00" * (n * 4)
+        assert len(body) <= self.CAP
+        status, _ = _post(
+            capped.http_url, "/v2/models/custom_identity_int32/infer", body,
+            headers={"Content-Type": "application/octet-stream",
+                     "Inference-Header-Content-Length": str(len(header))})
+        assert status == 200
+
+    def test_truncated_bytes_tensor_is_400_not_500(self, capped):
+        """Regression (surfaced by the gRPC fuzz pass): a truncated
+        length-prefixed BYTES payload used to escape as the CLIENT
+        exception class -> 500; it must be a clean 400."""
+        header = json.dumps({
+            "inputs": [{"name": "INPUT0", "datatype": "BYTES",
+                        "shape": [1, 16],
+                        "parameters": {"binary_data_size": 6}}],
+        }).encode()
+        # a 4-byte length prefix announcing 1000 bytes, then 2 bytes
+        body = header + (1000).to_bytes(4, "little") + b"ab"
+        status, _ = _post(
+            capped.http_url, "/v2/models/simple_string/infer", body,
+            headers={"Content-Type": "application/octet-stream",
+                     "Inference-Header-Content-Length": str(len(header))})
+        assert status == 400
+
+
 class TestGrpcMalformed:
     """Raw-pb malformed gRPC requests must be INVALID_ARGUMENT, not UNKNOWN
     (mirror of the HTTP 400-not-500 invariant)."""

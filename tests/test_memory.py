@@ -1,0 +1,725 @@
+"""Memory-safe overload control (server/memory.py + the wire ingress caps).
+
+Layers under test:
+
+* unit — the :class:`MemoryGovernor` ledger (reserve/add/release, peak),
+  tier-aware + largest-first shed verdicts, byte-flavored pushback,
+  ``mem_pressure`` budget squeeze + self-recovery, the HBM headroom gate,
+* chaos — the seeded ``mem_pressure`` kind draws deterministically and
+  actuates the governor through the core,
+* integration — over-budget arrivals shed typed 429 + Retry-After on both
+  wires while small tier-0 traffic keeps flowing; the ledger drains back
+  to zero; ``shed_reason: "memory"`` lands on flight records; triton-top's
+  MEM%/SHED columns materialize,
+* acceptance — a seeded 2x byte-budget oversized burst + ``mem_pressure``
+  chaos: peak in-flight bytes stay <= budget, 100% of sheds are typed
+  (zero connection resets), and a concurrent tier-0 small-payload stream
+  completes with zero caller-visible errors.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import triton_client_tpu.grpc as grpcclient  # noqa: E402
+import triton_client_tpu.http as httpclient  # noqa: E402
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.server import (InferError, InferenceCore,  # noqa: E402
+                                      MemoryGovernor, ModelRegistry, PyModel,
+                                      QosManager, make_config)
+from triton_client_tpu.server.chaos import ChaosInjector  # noqa: E402
+from triton_client_tpu.server.testing import ServerHarness  # noqa: E402
+from triton_client_tpu.utils import InferenceServerException  # noqa: E402
+
+MODEL = "custom_identity_int32"
+
+
+def _http_inputs(arr):
+    i = httpclient.InferInput("INPUT0", list(arr.shape), "INT32")
+    i.set_data_from_numpy(arr)
+    return [i]
+
+
+def _payload(n_int32: int) -> np.ndarray:
+    return np.zeros((1, n_int32), np.int32)
+
+
+# -- unit: the ledger --------------------------------------------------------
+
+class TestGovernorLedger:
+    def test_reserve_add_release_and_peak(self):
+        g = MemoryGovernor(budget_bytes=1000)
+        assert g.try_admit("m", "t", 0, 400, qos=None) is None
+        g.add("m", "t", 300)  # response bytes join, never shed
+        assert g.inflight_bytes == 700
+        assert g.inflight_by_model == {"m": 700}
+        assert g.inflight_by_tenant == {"t": 700}
+        g.release("m", "t", 700)
+        assert g.inflight_bytes == 0
+        assert g.inflight_by_model == {}  # empty keys are dropped
+        assert g.peak_inflight_bytes == 700
+
+    def test_release_clamps_at_zero(self):
+        g = MemoryGovernor(budget_bytes=1000)
+        g.release("m", "t", 999)
+        assert g.inflight_bytes == 0
+
+    def test_unbounded_budget_tracks_but_never_sheds(self):
+        g = MemoryGovernor(budget_bytes=0)
+        for _ in range(10):
+            assert g.try_admit("m", "t", 3, 1 << 30, qos=QosManager()) is None
+        assert g.inflight_bytes == 10 << 30
+        assert g.shed == {}
+
+    def test_response_add_may_exceed_budget_honestly(self):
+        # add() never sheds: the compute already ran.  The overshoot is
+        # recorded in the peak, which is the honest ledger.
+        g = MemoryGovernor(budget_bytes=100)
+        assert g.try_admit("m", "t", 0, 80, qos=None) is None
+        g.add("m", "t", 80)
+        assert g.inflight_bytes == 160
+        assert g.peak_inflight_bytes == 160
+
+
+class TestGovernorVerdicts:
+    def test_tier_aware_best_effort_sheds_first(self):
+        q = QosManager(tiers=4, best_effort_fraction=0.5)
+        g = MemoryGovernor(budget_bytes=1000)
+        assert g.try_admit("m", "t", 0, 400, qos=q) is None  # ledger: 400
+        # best effort may only fill 50% of the budget: 400 + 200 > 500
+        assert g.try_admit("m", "bulk", 3, 200, qos=q) is not None
+        # tier 0 gets the full budget: same bytes admit
+        assert g.try_admit("m", "gold", 0, 200, qos=q) is None
+        assert g.shed == {("m", "bulk", 3, "host"): 1}
+
+    def test_largest_first_small_fits_where_giant_bounces(self):
+        g = MemoryGovernor(budget_bytes=1000)
+        assert g.try_admit("m", "t", 0, 700, qos=None) is None
+        assert g.try_admit("m", "t", 0, 600, qos=None) is not None  # giant
+        assert g.try_admit("m", "t", 0, 100, qos=None) is None      # small
+        assert g.inflight_bytes == 800
+
+    def test_pushback_scales_with_fill(self):
+        g = MemoryGovernor(budget_bytes=1000)
+        empty = g.try_admit("m", "t", 0, 2000, qos=None,
+                            base_pushback_s=0.5)
+        assert g.try_admit("m", "t", 0, 800, qos=None) is None
+        full = g.try_admit("m", "t", 0, 2000, qos=None, base_pushback_s=0.5)
+        assert empty[0] == pytest.approx(0.5)       # empty ledger: base
+        assert full[0] == pytest.approx(0.5 * 1.8)  # 80% full: base * 1.8
+
+    def test_permanent_verdict_for_over_configured_giants(self):
+        """A payload that can never fit its tier's CONFIGURED budget share
+        is flagged permanent (the core answers 413, the client's
+        non-retryable oversize class); a payload refused only by ledger
+        fill or a pressure squeeze stays transient (429)."""
+        q = QosManager(tiers=4, best_effort_fraction=0.5)
+        g = MemoryGovernor(budget_bytes=1000)
+        # giant > tier-0's full budget: permanent
+        assert g.try_admit("m", "t", 0, 2000, qos=q)[1] is True
+        # best-effort giant > its 50% share (but < budget): permanent
+        assert g.try_admit("m", "t", 3, 600, qos=q)[1] is True
+        # fits when empty, refused by ledger fill: transient
+        assert g.try_admit("m", "t", 0, 700, qos=q) is None
+        assert g.try_admit("m", "t", 0, 600, qos=q)[1] is False
+        g.release("m", "t", 700)
+        # refused only by an active pressure squeeze: transient — the
+        # window lifts on its own, so a retry is NOT doomed
+        g.inject_pressure(0.5, duration_s=60.0, now=100.0)
+        verdict = g.try_admit("m", "t", 0, 700, qos=q, now=101.0)
+        assert verdict is not None and verdict[1] is False
+
+    def test_tenant_cardinality_folds_into_overflow(self):
+        """Rotating client-controlled tenant identities must not grow the
+        ledger/shed dicts (or the nv_mem_shed_total label set) without
+        bound — identities beyond the cap fold into ~overflow, uniformly
+        on reserve, release, and shed."""
+        g = MemoryGovernor(budget_bytes=100)
+        for i in range(g.MAX_TRACKED_TENANTS + 200):
+            t = f"rotating-{i}"
+            assert g.try_admit("m", t, 0, 1000, qos=None) is not None
+        assert len(g.shed) <= g.MAX_TRACKED_TENANTS + 1
+        folded = g.shed[("m", g.OVERFLOW_TENANT, 0, "host")]
+        assert folded == 200
+        # reserve/release key the SAME folded identity: no value drift
+        g.try_admit("m", "rotating-999999", 0, 10, qos=None)
+        g.release("m", "rotating-999998", 10)
+        assert g.inflight_by_tenant.get(g.OVERFLOW_TENANT, 0) == 0
+
+    def test_zero_byte_requests_always_admit(self):
+        g = MemoryGovernor(budget_bytes=10)
+        assert g.try_admit("m", "t", 0, 800, qos=None) is not None
+        assert g.try_admit("m", "t", 0, 0, qos=None) is None
+
+
+class TestPressure:
+    def test_pressure_shrinks_then_recovers(self):
+        g = MemoryGovernor(budget_bytes=1000)
+        g.inject_pressure(0.5, duration_s=10.0, now=100.0)
+        assert g.effective_budget(now=105.0) == 500
+        # admission under pressure uses the shrunken budget
+        assert g.try_admit("m", "t", 0, 600, qos=None, now=105.0) is not None
+        # the window lifts BY ITSELF — recovery needs no operator action
+        assert g.effective_budget(now=110.5) == 1000
+        assert g.try_admit("m", "t", 0, 600, qos=None, now=110.5) is None
+        assert g.pressure_events == 1
+
+    def test_pressure_factor_clamped(self):
+        g = MemoryGovernor(budget_bytes=1000)
+        g.inject_pressure(-3.0, duration_s=10.0, now=0.0)
+        assert g.effective_budget(now=1.0) >= 10  # floor, never zero
+
+    def test_pressure_active_is_clock_true_on_track_only_governor(self):
+        """budget 0 never runs the lazy factor reset, so pressure_active
+        must be computed against the clock — an expired window may not
+        read as active forever on a track-only governor."""
+        g = MemoryGovernor(budget_bytes=0)
+        g.inject_pressure(0.5, duration_s=3600.0)
+        assert g.snapshot()["pressure_active"] is True
+        g2 = MemoryGovernor(budget_bytes=0)
+        g2.inject_pressure(0.5, duration_s=0.0)  # already expired
+        assert g2.snapshot()["pressure_active"] is False
+
+
+class TestHbmGate:
+    @staticmethod
+    def _gov(limit, used):
+        g = MemoryGovernor()
+        g.hbm_stats_fn = lambda: {
+            "tpu:0": {"bytes_limit": limit, "bytes_in_use": used}}
+        return g
+
+    def test_headroom_min_over_devices(self):
+        g = MemoryGovernor()
+        g.hbm_stats_fn = lambda: {
+            "tpu:0": {"bytes_limit": 1000, "bytes_in_use": 100},
+            "tpu:1": {"bytes_limit": 1000, "bytes_in_use": 600},
+        }
+        assert g.hbm_headroom() == 400
+
+    def test_projection_over_headroom_sheds_typed(self):
+        g = self._gov(limit=1000, used=900)  # headroom 100, usable 80
+        with pytest.raises(InferError) as ei:
+            g.admit_hbm("llama", projected_bytes=81)
+        assert ei.value.http_status == 429
+        assert ei.value.shed_reason == "memory"
+        assert ei.value.retry_after_s > 0
+        assert g.shed == {("llama", "", 0, "hbm"): 1}
+        # within the usable fraction: admitted, no counter movement
+        g.admit_hbm("llama", projected_bytes=80)
+        assert g.shed_total() == 1
+
+    def test_inert_without_memory_gauges(self):
+        g = MemoryGovernor()
+        g.hbm_stats_fn = lambda: {}  # CPU backend: no stats
+        g.admit_hbm("llama", projected_bytes=1 << 40)  # never sheds
+        assert g.shed == {}
+
+    def test_gauge_failure_never_sheds(self):
+        g = MemoryGovernor()
+
+        def boom():
+            raise RuntimeError("gauge off")
+
+        g.hbm_stats_fn = boom
+        g.admit_hbm("llama", projected_bytes=1 << 40)
+        assert g.shed == {}
+
+
+class TestGovernorExport:
+    def test_metric_rows_shapes(self):
+        g = MemoryGovernor(budget_bytes=1000)
+        g.hbm_stats_fn = lambda: {
+            "tpu:0": {"bytes_limit": 500, "bytes_in_use": 100}}
+        assert g.try_admit("m", "t", 3, 2000, qos=QosManager()) is not None
+        g.try_admit("m", "t", 0, 100, qos=None)
+        rows = g.metric_rows()
+        assert rows["inflight"] == [({"model": "m"}, 100)]
+        assert rows["budget"] == [({}, 1000)]
+        assert rows["shed"] == [({"model": "m", "tenant": "t", "tier": "3",
+                                  "reason": "host"}, 1)]
+        assert rows["hbm_headroom"] == [({"device": "tpu:0"}, 400)]
+
+    def test_snapshot_shape(self):
+        g = MemoryGovernor(budget_bytes=1000)
+        g.try_admit("m", "t", 0, 100, qos=None)
+        snap = g.snapshot()
+        assert snap["budget_bytes"] == 1000
+        assert snap["effective_budget_bytes"] == 1000
+        assert snap["inflight_bytes"] == 100
+        assert snap["pressure_active"] is False
+        assert snap["shed_total"] == 0
+
+
+# -- unit: the chaos kind ----------------------------------------------------
+
+class TestMemPressureChaos:
+    def test_draws_are_seeded_and_deterministic(self):
+        kinds = [ChaosInjector(rate=0.5, kinds=("mem_pressure",),
+                               seed=7).decide("m") for _ in range(50)]
+        kinds2 = [ChaosInjector(rate=0.5, kinds=("mem_pressure",),
+                                seed=7).decide("m") for _ in range(50)]
+        assert [(f.kind if f else None) for f in kinds] == \
+            [(f.kind if f else None) for f in kinds2]
+
+    def test_fault_carries_window_and_factor(self):
+        inj = ChaosInjector(rate=1.0, kinds=("mem_pressure",), seed=0,
+                            pressure_s=2.5, pressure_factor=0.25)
+        f = inj.decide("m")
+        assert f.kind == "mem_pressure"
+        assert f.latency_s == 2.5
+        assert f.pressure_factor == 0.25
+
+    def test_bad_pressure_factor_fails_at_construction(self):
+        with pytest.raises(ValueError):
+            ChaosInjector(rate=0.1, kinds=("mem_pressure",),
+                          pressure_factor=0.0)
+
+    def test_core_actuates_pressure_and_stamps_flight(self):
+        """A mem_pressure draw squeezes the governor through the core and
+        the drawing request still completes (flight-stamped)."""
+        import asyncio
+
+        from triton_client_tpu.server.types import InferRequest, InputTensor
+
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        core = InferenceCore(registry)
+        core.memory.budget_bytes = 1 << 20
+        core.chaos = ChaosInjector(rate=1.0, kinds=("mem_pressure",),
+                                   seed=3, max_faults=1, pressure_s=30.0,
+                                   pressure_factor=0.5)
+
+        async def drive():
+            req = InferRequest(model_name=MODEL)
+            arr = np.ones((1, 4), np.int32)
+            req.inputs.append(InputTensor(
+                name="INPUT0", datatype="INT32", shape=(1, 4), data=arr))
+            return await core.infer(req)
+
+        resp = asyncio.new_event_loop().run_until_complete(drive())
+        assert resp.outputs[0].data is not None
+        assert core.memory.effective_budget() == 1 << 19  # squeezed
+        assert core.chaos.injected_total == 1
+        rec = core.flight_recorder.snapshot(model=MODEL)["recent"][-1]
+        assert rec["chaos"] == "mem_pressure"
+
+
+# -- integration: core-level stamping & attach --------------------------------
+
+class TestCoreIntegration:
+    def test_shed_reason_stamped_on_flight_record(self):
+        """An in-envelope memory shed (the HBM gate's error shape) lands
+        on the flight record as shed_reason="memory" — tellable from
+        queue-depth sheds."""
+        import asyncio
+
+        from triton_client_tpu.server.types import InferRequest, InputTensor
+
+        cfg = make_config("oom_gate", inputs=[("IN", "INT32", [-1])],
+                          outputs=[("OUT", "INT32", [-1])],
+                          instance_kind="KIND_CPU")
+
+        def fn(inputs, params):
+            err = InferError("projected KV exceeds headroom", 429,
+                             retry_after_s=1.0)
+            err.shed_reason = "memory"
+            raise err
+
+        registry = ModelRegistry()
+        registry.register_model(PyModel(cfg, fn))
+        core = InferenceCore(registry)
+
+        async def drive():
+            req = InferRequest(model_name="oom_gate")
+            req.inputs.append(InputTensor(
+                name="IN", datatype="INT32", shape=(2,),
+                data=np.ones(2, np.int32)))
+            await core.infer(req)
+
+        loop = asyncio.new_event_loop()
+        with pytest.raises(InferError):
+            loop.run_until_complete(drive())
+        snap = core.flight_recorder.snapshot(model="oom_gate")
+        assert snap["recent"][-1]["shed_reason"] == "memory"
+        assert snap["recent"][-1]["outcome"] != "ok"
+        # failures are always pinned: the outlier carries the reason too
+        assert any(o["shed_reason"] == "memory" for o in snap["outliers"])
+
+    def test_attach_memory_governor_stamped_on_device_loop_models(self):
+        """Models exposing attach_memory_governor get the core's governor
+        before their first execution (the decode slot gate's wiring)."""
+        import asyncio
+
+        from triton_client_tpu.server.types import InferRequest, InputTensor
+
+        cfg = make_config("gated", inputs=[("IN", "INT32", [-1])],
+                          outputs=[("OUT", "INT32", [-1])],
+                          instance_kind="KIND_CPU")
+        seen = {}
+
+        class GatedModel(PyModel):
+            def attach_memory_governor(self, gov):
+                seen["gov"] = gov
+
+        registry = ModelRegistry()
+        registry.register_model(GatedModel(cfg, lambda i, p: {"OUT": i["IN"]}))
+        core = InferenceCore(registry)
+
+        async def drive():
+            req = InferRequest(model_name="gated")
+            req.inputs.append(InputTensor(
+                name="IN", datatype="INT32", shape=(2,),
+                data=np.ones(2, np.int32)))
+            return await core.infer(req)
+
+        asyncio.new_event_loop().run_until_complete(drive())
+        assert seen["gov"] is core.memory
+
+    def test_queue_shed_after_reservation_releases_bytes(self):
+        """A request admitted by the byte gate but refused on queue depth
+        must hand its reservation back (no ledger leak)."""
+        import asyncio
+
+        from triton_client_tpu.server.types import InferRequest, InputTensor
+
+        release = threading.Event()
+        cfg = make_config("blocky", inputs=[("IN", "INT32", [-1])],
+                          outputs=[("OUT", "INT32", [-1])],
+                          instance_kind="KIND_CPU")
+
+        def fn(inputs, params):
+            release.wait(timeout=20)
+            return {"OUT": inputs["IN"]}
+
+        registry = ModelRegistry()
+        registry.register_model(PyModel(cfg, fn))
+        core = InferenceCore(registry)
+        core.memory.budget_bytes = 1 << 20
+        core.queue_limits["blocky"] = 1
+
+        async def drive():
+            def req():
+                r = InferRequest(model_name="blocky")
+                r.wire_bytes = 1000
+                r.inputs.append(InputTensor(
+                    name="IN", datatype="INT32", shape=(2,),
+                    data=np.ones(2, np.int32)))
+                return r
+
+            t1 = asyncio.ensure_future(core.infer(req()))
+            await asyncio.sleep(0.05)  # occupies the queue slot
+            with pytest.raises(InferError) as ei:
+                await core.infer(req())
+            assert ei.value.http_status == 429
+            assert ei.value.shed_reason is None  # queue shed, not memory
+            # the refused request's bytes were released
+            assert core.memory.inflight_bytes == 1000
+            release.set()
+            await t1
+
+        asyncio.new_event_loop().run_until_complete(drive())
+        assert core.memory.inflight_bytes == 0
+
+
+# -- integration: the decode slot gate ---------------------------------------
+
+class TestDecodeHbmGate:
+    """The real decode model's slot admission gates on projected KV bytes
+    vs live HBM headroom through the attached governor — a 'full device'
+    sheds typed 429s with shed_reason='memory' before any cache state is
+    touched, and a roomy device admits as before."""
+
+    @pytest.fixture
+    def model(self, monkeypatch):
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        from triton_client_tpu.models.decode import DecodeModel
+
+        m = DecodeModel(name="llama_decode_hbm_gate_test")
+        yield m
+        m._shutdown()
+
+    @staticmethod
+    def _gov(headroom):
+        g = MemoryGovernor()
+        g.hbm_stats_fn = lambda: {
+            "tpu:0": {"bytes_limit": headroom, "bytes_in_use": 0}}
+        return g
+
+    def _window(self, text: bytes):
+        from triton_client_tpu.models import language
+
+        S = language.LLAMA_SEQ_LEN
+        out = np.zeros((1, S), np.int32)
+        b = np.frombuffer(text[-S:], np.uint8)
+        out[0, S - len(b):] = b
+        return out
+
+    def test_slab_allocation_gated_then_inert_once_resident(self, model):
+        """Slot mode preallocates the whole slab at the FIRST request:
+        that allocation is what the gate protects.  Once resident, slot
+        admission pins no new device memory, so a full device must NOT
+        shed (a per-admission projection would double-count bytes
+        already inside bytes_in_use)."""
+        win = self._window(b"hbm gate probe")
+        model._ensure_params()  # config for the projection; no slab yet
+        per_tok = model._kv_bytes_per_token()
+        assert per_tok > 0
+        model.attach_memory_governor(self._gov(headroom=per_tok))
+        with pytest.raises(InferError) as ei:
+            model.submit_generation(win, n_tokens=4)
+        assert ei.value.http_status == 429
+        assert ei.value.shed_reason == "memory"
+        # the refused request never triggered the slab allocation
+        assert model._fns is None
+        # roomy device: the slab materializes and generation runs
+        model.attach_memory_governor(self._gov(headroom=1 << 30))
+        sink = model.submit_generation(win, n_tokens=2)
+        got = [sink.get(timeout=60) for _ in range(3)]
+        assert got[-1] is None and len(got) == 3
+        # slab resident: a now-"full" device (its bytes_in_use INCLUDE
+        # the slab) must keep admitting into free slots
+        model.attach_memory_governor(self._gov(headroom=per_tok))
+        sink = model.submit_generation(win, n_tokens=1)
+        got = [sink.get(timeout=60) for _ in range(2)]
+        assert got[-1] is None
+        assert model._memory_governor.shed_total() == 0
+
+    def test_sequence_start_gated_before_slab_too(self, model):
+        model._ensure_params()
+        per_tok = model._kv_bytes_per_token()
+        model.attach_memory_governor(self._gov(headroom=per_tok))
+        with pytest.raises(InferError) as ei:
+            model._execute({"TOKENS": self._window(b"seq probe")},
+                           {"sequence_id": 9001, "sequence_start": True})
+        assert ei.value.http_status == 429
+        assert ei.value.shed_reason == "memory"
+        assert model._fns is None
+        assert model._memory_governor.shed_total() >= 1
+
+    def test_independent_mode_gates_each_fresh_cache(self, monkeypatch):
+        """Independent mode allocates a NEW s_max-deep cache per
+        sequence — there the per-admission projection is the honest
+        one, and it gates every sequence start."""
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "independent")
+        from triton_client_tpu.models.decode import DecodeModel
+
+        m = DecodeModel(name="llama_decode_hbm_ind_test")
+        try:
+            m._ensure_params()
+            per_tok = m._kv_bytes_per_token()
+            m.attach_memory_governor(self._gov(headroom=per_tok))
+            with pytest.raises(InferError) as ei:
+                m._execute({"TOKENS": self._window(b"ind probe")},
+                           {"sequence_id": 5, "sequence_start": True})
+            assert ei.value.http_status == 429
+            assert ei.value.shed_reason == "memory"
+            assert m._state == {}  # no cache entry was created
+        finally:
+            m._shutdown()
+
+
+# -- integration: the wire --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def harness():
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    h = ServerHarness(registry, max_request_bytes=1 << 20)
+    # 64 KiB host budget: big enough for control traffic, small enough
+    # that a few 48 KiB payloads overflow it deterministically
+    h.core.memory.budget_bytes = 64 << 10
+    with h:
+        yield h
+
+
+BUDGET = 64 << 10
+
+
+class TestWireIntegration:
+    def _reset(self, harness):
+        harness.core.memory.shed.clear()
+        harness.core.memory.peak_inflight_bytes = 0
+
+    def test_over_whole_budget_arrival_is_permanent_413_http(self, harness):
+        """A payload larger than its tier's CONFIGURED budget share can
+        never be admitted — the server answers 413 (the client's
+        non-retryable oversize class), not a 429 that would invite N
+        doomed re-uploads."""
+        from triton_client_tpu._resilience import (RetryPolicy,
+                                                   is_oversize_error)
+
+        self._reset(harness)
+        big = _payload(24 << 10)  # 96 KiB > the 64 KiB budget outright
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer(MODEL, _http_inputs(big))
+            assert ei.value.status() == "413"
+            assert "memory budget" in str(ei.value)
+            assert is_oversize_error(ei.value)
+            assert not RetryPolicy(retry_infer=True).should_retry(
+                ei.value, method="infer", attempt=1)
+        assert harness.core.memory.shed_total() >= 1
+        # nv_inference_rejected_total moved too (one shed surface)
+        assert harness.core.rejected_by_model.get(MODEL, 0) >= 1
+
+    def test_transient_over_budget_is_retryable_429(self, harness):
+        """A payload that FITS the configured budget but is refused by
+        ledger fill sheds 429 + pushback — retryable, the pressure
+        drains."""
+        self._reset(harness)
+        gov = harness.core.memory
+        mid = _payload(8 << 10)  # 32 KiB: fits the 64 KiB budget alone
+        gov.try_admit(MODEL, "occupier", 0, 40 << 10, qos=harness.core.qos)
+        try:
+            with httpclient.InferenceServerClient(harness.http_url) as c:
+                with pytest.raises(InferenceServerException) as ei:
+                    c.infer(MODEL, _http_inputs(mid))
+                assert ei.value.status() == "429"
+                assert ei.value.retry_after_s > 0
+        finally:
+            gov.release(MODEL, "occupier", 40 << 10)
+
+    def test_over_budget_arrival_sheds_grpc(self, harness):
+        self._reset(harness)
+        big = _payload(24 << 10)
+        with grpcclient.InferenceServerClient(harness.grpc_url) as c:
+            i = grpcclient.InferInput("INPUT0", list(big.shape), "INT32")
+            i.set_data_from_numpy(big)
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer(MODEL, [i])
+            assert ei.value.status() == "StatusCode.RESOURCE_EXHAUSTED"
+
+    def test_small_traffic_flows_and_ledger_drains(self, harness):
+        self._reset(harness)
+        small = _payload(64)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            for _ in range(8):
+                r = c.infer(MODEL, _http_inputs(small))
+                assert r.as_numpy("OUTPUT0") is not None
+        deadline = time.monotonic() + 5.0
+        while harness.core.memory.inflight_bytes and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert harness.core.memory.inflight_bytes == 0
+        assert harness.core.memory.peak_inflight_bytes > 0
+
+    def test_mem_families_and_debug_surface(self, harness):
+        self._reset(harness)
+        big = _payload(24 << 10)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            with pytest.raises(InferenceServerException):
+                c.infer(MODEL, _http_inputs(big), tenant="whale", priority=3)
+        text = urllib.request.urlopen(
+            f"http://{harness.http_url}/metrics", timeout=10).read().decode()
+        assert f"nv_mem_budget_bytes {BUDGET}" in text
+        assert ('nv_mem_shed_total{model="custom_identity_int32",'
+                'tenant="whale",tier="3",reason="host"}') in text
+        snap = json.loads(urllib.request.urlopen(
+            f"http://{harness.http_url}/v2/debug/device_stats",
+            timeout=10).read())
+        assert snap["memory"]["budget_bytes"] == BUDGET
+        assert snap["memory"]["shed_total"] >= 1
+
+    def test_triton_top_mem_columns(self, harness, capsys):
+        from triton_client_tpu.tools import top
+
+        self._reset(harness)
+        small = _payload(64)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            c.infer(MODEL, _http_inputs(small))
+            with pytest.raises(InferenceServerException):
+                c.infer(MODEL, _http_inputs(_payload(24 << 10)))
+        rc = top.main(["--url", harness.http_url, "--once", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        row = out["models"][MODEL]
+        assert "mem_pct" in row and "mem_shed_per_s" in row
+        # single sample: SHED falls back to the cumulative counter
+        assert row["mem_shed_per_s"] >= 1
+        rc = top.main(["--url", harness.http_url, "--once"])
+        assert rc == 0
+        table = capsys.readouterr().out
+        assert "MEM%" in table and "SHED/s" in table
+
+
+# -- acceptance: the 2x byte-budget overload drill ---------------------------
+
+class TestOverloadDrill:
+    def test_seeded_burst_with_mem_pressure_recovers_clean(self, harness):
+        """The ISSUE 14 acceptance criterion, test-sized: an oversized
+        burst at ~2x the byte budget rides alongside seeded mem_pressure
+        chaos.  The governor must (a) keep peak in-flight bytes <= the
+        budget, (b) shed ONLY with typed 429/413 + pushback — zero
+        connection resets — and (c) leave a concurrent tier-0
+        small-payload stream with zero caller-visible errors."""
+        core = harness.core
+        core.memory.shed.clear()
+        core.memory.peak_inflight_bytes = 0
+        core.chaos = ChaosInjector(
+            rate=0.2, kinds=("mem_pressure",), seed=42, max_faults=3,
+            pressure_s=0.3, pressure_factor=0.5)
+        big = _payload(12 << 10)    # 48 KiB each; 3 concurrent = ~2x budget
+        small = _payload(64)        # 256 B: fits even a squeezed budget
+        stop = threading.Event()
+        shed_statuses: list = []
+        reset_errors: list = []
+        tier0_errors: list = []
+        tier0_ok = [0]
+
+        def whale(idx):
+            with httpclient.InferenceServerClient(harness.http_url) as c:
+                while not stop.is_set():
+                    try:
+                        c.infer(MODEL, _http_inputs(big), priority=3,
+                                tenant=f"whale{idx}")
+                    except InferenceServerException as e:
+                        if e.status() in ("429", "413"):
+                            shed_statuses.append(e.status())
+                        else:
+                            reset_errors.append(str(e))
+                    except Exception as e:  # noqa: BLE001 — resets land here
+                        reset_errors.append(repr(e))
+
+        def gold():
+            with httpclient.InferenceServerClient(harness.http_url) as c:
+                while not stop.is_set():
+                    try:
+                        r = c.infer(MODEL, _http_inputs(small), priority=0,
+                                    tenant="gold")
+                        assert r.as_numpy("OUTPUT0") is not None
+                        tier0_ok[0] += 1
+                    except Exception as e:  # noqa: BLE001
+                        tier0_errors.append(repr(e))
+
+        threads = [threading.Thread(target=whale, args=(i,), daemon=True)
+                   for i in range(4)] + [
+            threading.Thread(target=gold, daemon=True)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        core.chaos = None
+        # (c) tier-0 stream: zero caller-visible errors, real progress
+        assert tier0_errors == []
+        assert tier0_ok[0] >= 10
+        # (b) every refused giant got a typed shed, never a reset
+        assert reset_errors == []
+        assert shed_statuses, "the burst never overflowed the budget"
+        # (a) the ledger never exceeded the budget: the whole point.
+        # (response bytes join after admission — identity doubles a
+        # request's footprint, so the bound is budget + one response.)
+        assert core.memory.peak_inflight_bytes <= BUDGET + big.nbytes
+        assert core.memory.shed_total() == len(shed_statuses)
+        # the pressure windows actually fired and lifted again
+        assert core.chaos is None
+        assert core.memory.effective_budget() == BUDGET

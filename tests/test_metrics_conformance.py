@@ -337,6 +337,79 @@ class TestDeviceSloSurface:
         assert tick_samples == scraped
 
 
+class TestMemorySurface:
+    """The nv_mem_* families (server/memory.py) parse under the
+    exposition grammar, are typed, carry their full label sets including
+    adversarial tenant names, and round-trip through the JSON snapshot."""
+
+    EVIL_TENANT = 'evil"tenant\\with\nnewline'
+
+    def _drive_memory(self, server):
+        gov = server.core.memory
+        gov.budget_bytes = 1 << 20
+        gov.hbm_stats_fn = lambda: {
+            "tpu:0": {"bytes_limit": 1000, "bytes_in_use": 200}}
+        # a live ledger entry, a host shed with the evil tenant, and an
+        # hbm shed — every family gets at least one sample
+        gov.try_admit("simple", "tenantA", 0, 4096, qos=server.core.qos)
+        assert gov.try_admit("simple", self.EVIL_TENANT, 3, 2 << 20,
+                             qos=server.core.qos) is not None
+        try:
+            gov.admit_hbm("llama", projected_bytes=1 << 20)
+        except Exception:  # noqa: BLE001 — the shed IS the fixture
+            pass
+        return gov
+
+    def test_families_typed_labeled_and_round_trip(self, server):
+        from triton_client_tpu.server.metrics import snapshot
+
+        gov = self._drive_memory(server)
+        try:
+            families = assert_conformant(_scrape(server.http_url))
+            for fam, kind in (("nv_mem_inflight_bytes", "gauge"),
+                              ("nv_mem_budget_bytes", "gauge"),
+                              ("nv_mem_shed_total", "counter"),
+                              ("nv_mem_hbm_headroom_bytes", "gauge")):
+                assert families[fam]["type"] == kind, fam
+            assert families["nv_mem_budget_bytes"]["samples"][0][2] == \
+                float(1 << 20)
+
+            def unescape(v):
+                return (v.replace("\\n", "\n").replace('\\"', '"')
+                        .replace("\\\\", "\\"))
+
+            shed = {(l["model"], unescape(l["tenant"]), l["tier"],
+                     l["reason"]): v
+                    for _, l, v in families["nv_mem_shed_total"]["samples"]}
+            assert shed[("simple", self.EVIL_TENANT, "3", "host")] == 1.0
+            assert shed[("llama", "", "0", "hbm")] == 1.0
+            inflight = {l["model"]: v for _, l, v in
+                        families["nv_mem_inflight_bytes"]["samples"]}
+            assert inflight["simple"] == 4096.0
+            headroom = {l["device"]: v for _, l, v in
+                        families["nv_mem_hbm_headroom_bytes"]["samples"]}
+            assert headroom == {"tpu:0": 800.0}
+            # JSON snapshot parity: same families, same types, same values
+            snap = snapshot(server.core)
+            for fam in ("nv_mem_inflight_bytes", "nv_mem_budget_bytes",
+                        "nv_mem_shed_total", "nv_mem_hbm_headroom_bytes"):
+                assert snap[fam]["type"] == families[fam]["type"], fam
+            snap_shed = {(s["labels"]["model"], s["labels"]["tenant"],
+                          s["labels"]["tier"], s["labels"]["reason"]):
+                         s["value"]
+                         for s in snap["nv_mem_shed_total"]["samples"]}
+            assert snap_shed[("simple", self.EVIL_TENANT, "3", "host")] == 1
+        finally:
+            # the module-scoped server is shared: restore the defaults
+            gov.release("simple", "tenantA", 4096)
+            gov.budget_bytes = 0
+            gov.shed.clear()
+            from triton_client_tpu.server.device_stats import \
+                DeviceStatsCollector
+
+            gov.hbm_stats_fn = DeviceStatsCollector.hbm_stats
+
+
 class TestFleetSurface:
     """The nv_fleet_* families parse under the exposition grammar, are
     typed, carry their full label sets, and round-trip through the JSON

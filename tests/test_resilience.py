@@ -108,6 +108,40 @@ class TestRetryPolicy:
             e = InferenceServerException("x", status=status)
             assert not p.should_retry(e, method="health", attempt=1), status
 
+    def test_oversize_never_retryable(self):
+        """ISSUE 14 satellite: a wire-size rejection is deterministic —
+        re-sending the identical giant payload is doomed — so it must not
+        retry even when its STATUS sits in the retryable set (a gRPC
+        oversize arrives as RESOURCE_EXHAUSTED, which does)."""
+        from triton_client_tpu._resilience import is_oversize_error
+
+        p = RetryPolicy(max_attempts=5, retry_infer=True)
+        grpc_oversize = InferenceServerException(
+            "Received message larger than max (131192 vs. 65536)",
+            status="StatusCode.RESOURCE_EXHAUSTED")
+        http_413 = InferenceServerException(
+            "request of 131072 bytes exceeds the server's max request "
+            "size of 65536 bytes (--max-request-bytes)", status="413")
+        for e in (grpc_oversize, http_413):
+            assert is_oversize_error(e)
+            for method in ("infer", "health", "metadata"):
+                assert not p.should_retry(e, method=method, attempt=1)
+        # an explicit user policy listing 413 still never retries it
+        p413 = RetryPolicy(max_attempts=5, retry_infer=True,
+                           retryable_statuses={"413", "429"})
+        assert not p413.should_retry(http_413, method="infer", attempt=1)
+        # ... while an ordinary overload shed with the SAME status class
+        # stays retryable (the memory governor's 429s, queue sheds)
+        shed = InferenceServerException(
+            "request of 98304 bytes to model 'm' exceeds the server's "
+            "memory budget for tier 3; retry later", status="429")
+        assert not is_oversize_error(shed)
+        assert p.should_retry(shed, method="infer", attempt=1)
+        plain_re = InferenceServerException(
+            "request queue is full; retry later",
+            status="StatusCode.RESOURCE_EXHAUSTED")
+        assert p.should_retry(plain_re, method="infer", attempt=1)
+
     def test_idempotency_default_blocks_infer(self):
         e = InferenceServerException("x", status="503")
         assert not RetryPolicy().should_retry(e, method="infer", attempt=1)

@@ -44,6 +44,7 @@ __all__ = [
     "call_with_retry_async",
     "deadline_exceeded_error",
     "is_connection_error",
+    "is_oversize_error",
     "normalized_status",
 ]
 
@@ -52,6 +53,35 @@ __all__ = [
 #: blown deadline only blows it further.
 DEFAULT_RETRYABLE_STATUSES = frozenset(
     {"429", "503", "UNAVAILABLE", "RESOURCE_EXHAUSTED"})
+
+#: Message markers of a server wire-size rejection.  The gRPC transport
+#: refuses an oversize message with RESOURCE_EXHAUSTED — the SAME status a
+#: retryable overload shed carries — so the status alone cannot
+#: distinguish "try again later" from "this payload can never fit"; the
+#: transport's message ("Received message larger than max (N vs. M)") and
+#: the server's 413 body text can.
+_OVERSIZE_MSG_MARKERS = (
+    "larger than max",            # gRPC max_receive_message_length
+    "message length",             # grpc-core variants of the same check
+    "max request size",           # this server's typed 413 body
+    "max-request-bytes",          # ... and its flag spelling
+    "request entity too large",   # stock HTTP 413 reason phrase
+)
+
+
+def is_oversize_error(exc: BaseException) -> bool:
+    """True when ``exc`` is a wire-size rejection (HTTP 413, or a gRPC
+    RESOURCE_EXHAUSTED raised by the message-length check).  NEVER
+    retryable, whatever the policy's status set says: re-sending the same
+    payload is doomed to the same rejection N times over — the fix is
+    client-side (shrink, chunk, or use shared memory)."""
+    status = normalized_status(exc)
+    if status == "413":
+        return True
+    if status in ("RESOURCE_EXHAUSTED", "429"):
+        msg = str(exc).lower()
+        return any(marker in msg for marker in _OVERSIZE_MSG_MARKERS)
+    return False
 
 #: Exception class names (anywhere in the MRO) classified as connection-level
 #: failures — retryable without a status code.  Name-based so this module
@@ -173,6 +203,14 @@ class RetryPolicy:
         if attempt >= self.max_attempts:
             return False
         if method == "infer" and not self.retry_infer:
+            return False
+        if is_oversize_error(exc):
+            # a 413 / transport message-size rejection is deterministic:
+            # the identical payload bounces identically, so a retry only
+            # re-uploads a doomed giant N times (and a gRPC oversize
+            # arrives as RESOURCE_EXHAUSTED — inside the default
+            # retryable set — which is exactly how this loop used to
+            # re-send it)
             return False
         if is_connection_error(exc) or is_timeout_error(exc):
             # a per-attempt transport timeout with budget left is as

@@ -1014,10 +1014,16 @@ class DecodeModel:
             def attach_device_stats(inner, ds):
                 outer.attach_device_stats(ds)
 
+            def attach_memory_governor(inner, gov):
+                outer.attach_memory_governor(gov)
+
         self._model = _Impl(cfg)
         # device/scheduler observability sink (attach_device_stats): the
         # worker records one nv_tpu_tick_* row per fused dispatch into it
         self._device_stats = None
+        # byte-admission sink (attach_memory_governor): slot admission
+        # gates on projected KV bytes vs live HBM headroom when attached
+        self._memory_governor = None
         self._state: Dict[Any, int] = {}      # seq_id -> slot
         self._free = set(range(n_slots))
         self._touched: Dict[Any, float] = {}
@@ -1054,6 +1060,56 @@ class DecodeModel:
         steps-per-dispatch, control uploads, and the single fused D2H
         sync — the counters that prove the fast path stays fast."""
         self._device_stats = ds
+
+    def attach_memory_governor(self, gov) -> None:
+        """Attach the serving core's ``MemoryGovernor`` (idempotent
+        attribute stamp, like ``attach_device_stats``).  Slot admission
+        then gates on projected KV bytes vs live HBM headroom — a long
+        prompt degrades to a typed 429 instead of an allocator abort
+        that takes the running cohort down.  Inert on backends without
+        memory gauges (CPU)."""
+        self._memory_governor = gov
+
+    def _kv_bytes_per_token(self) -> int:
+        """Analytic KV-cache footprint of ONE cached token position:
+        layers x (k + v) x heads x head_dim x cache itemsize (int8 KV
+        quantization halves bf16's 2 bytes).  The projection the HBM
+        admission gate multiplies by a request's token need."""
+        if self._params is None:
+            return 0
+        _, cfg = self._params
+        per = cfg.n_layers * 2 * cfg.n_heads * cfg.head_dim
+        return per * (1 if self._kv_quant else 2)
+
+    def _gate_hbm(self, need_s: int) -> None:
+        """HBM-headroom admission (server/memory.py) for allocations that
+        are genuinely NEW device memory: independent mode's fresh
+        per-sequence cache.  Runs BEFORE the allocation so a refused
+        request touches no cache state."""
+        gov = self._memory_governor
+        if gov is None:
+            return
+        gov.admit_hbm(self._model.name,
+                      int(need_s) * self._kv_bytes_per_token())
+
+    def _gate_hbm_slab(self) -> None:
+        """Slot-mode HBM gate: the shared slab cache is preallocated ONCE
+        (lazily, at the first request's ``_ensure_fns``), so THAT
+        allocation — the full every-bucket footprint — is what must fit
+        the live headroom.  Once the slab is resident, admitting a
+        request into a free slot pins no new device memory and the gate
+        is inert: a per-admission projection would double-count bytes
+        already inside ``bytes_in_use`` and spuriously shed all traffic
+        on a well-sized device."""
+        gov = self._memory_governor
+        if gov is None or self._fns is not None:
+            return
+        # config only (weights load either way at _ensure_fns; the slab
+        # arrays are what this gate keeps off a too-full device)
+        self._ensure_params()
+        slab_tokens = sum(cnt * cap for cnt, cap in self._buckets)
+        gov.admit_hbm(self._model.name,
+                      slab_tokens * self._kv_bytes_per_token())
 
     # -- lazy init ---------------------------------------------------------
     def _ensure_params(self):
@@ -1914,6 +1970,12 @@ class DecodeModel:
 
         from ..server.types import InferError
 
+        # HBM-aware admission BEFORE the slab cache materializes: a slab
+        # that doesn't fit the device headroom sheds typed (429,
+        # shed_reason "memory") instead of OOMing the allocator on the
+        # first request; once resident, slot admission is gated by slot
+        # availability alone (no new device memory is pinned)
+        self._gate_hbm_slab()
         self._ensure_fns()
         if self._closed:
             raise InferError(
@@ -2013,6 +2075,9 @@ class DecodeModel:
                         f"model '{self._model.name}': sequence_start expects "
                         f"a [1,{self._prompt_len}] prompt, got "
                         f"{list(toks.shape)}")
+                # independent mode allocates a FRESH s_max-deep cache per
+                # sequence — the projection the headroom gate must hold
+                self._gate_hbm(self._s_max)
                 logits, cache = prefill(params, jnp.asarray(toks))
                 # host-side mirror of cache["pos"] — reading the device
                 # scalar would cost a blocking D2H round trip per step
@@ -2071,6 +2136,9 @@ class DecodeModel:
             raise InferError(
                 f"inference request to model '{self._model.name}' must "
                 "specify a non-zero or non-empty correlation ID")
+        # same slab gate as submit_generation: protect the one-time cache
+        # allocation the first request triggers, inert once resident
+        self._gate_hbm_slab()
         _prefill, _params, cfg = self._ensure_fns()
         toks = np.asarray(inputs["TOKENS"]).reshape(1, -1).astype(np.int32)
         toks = np.clip(toks, 0, cfg.vocab_size - 1)
@@ -2207,6 +2275,11 @@ class GenerateModel:
                 # the generation path's ticks happen in the SHARED decode
                 # worker — route the collector there
                 outer._decode.attach_device_stats(ds)
+
+            def attach_memory_governor(inner, gov):
+                # slot admission happens in the shared decode model —
+                # the HBM gate must see generation traffic too
+                outer._decode.attach_memory_governor(gov)
 
         self.model = _Impl(cfg)
 

@@ -9,12 +9,15 @@ statistics — with JAX/XLA as the one and only compute backend.
 """
 
 from .core import InferenceCore
+from .memory import DEFAULT_MAX_REQUEST_BYTES, MemoryGovernor
 from .model import EnsembleModel, JaxModel, Model, PyModel, make_config
 from .qos import QosManager, TieredQueue, TokenBucket
 from .registry import ModelRegistry
 from .types import InferError, InferRequest, InferResponse
 
 __all__ = [
+    "DEFAULT_MAX_REQUEST_BYTES",
+    "MemoryGovernor",
     "InferenceCore",
     "ModelRegistry",
     "Model",

@@ -130,6 +130,24 @@ def main() -> None:
                         "stop accepting (new requests get 503 + "
                         "Retry-After, readiness goes false), wait this "
                         "long for in-flight requests, then tear down")
+    from .memory import DEFAULT_MAX_REQUEST_BYTES
+
+    parser.add_argument("--max-request-bytes", type=int,
+                        default=DEFAULT_MAX_REQUEST_BYTES, metavar="N",
+                        help="wire ingress cap on BOTH frontends: any "
+                        "request larger than N bytes is refused before "
+                        "its body materializes (HTTP 413 / gRPC "
+                        "RESOURCE_EXHAUSTED carrying the limit).  A bare "
+                        "serve is bounded by default (64 MiB); 0 is the "
+                        "explicit opt-out restoring unbounded ingress")
+    parser.add_argument("--mem-budget-bytes", type=int, default=0,
+                        metavar="N",
+                        help="host byte budget for queued + in-flight "
+                        "request/response payloads: over-budget arrivals "
+                        "are shed tier-aware (best-effort and largest "
+                        "first) with typed 429 + Retry-After instead of "
+                        "growing toward the OOM killer (0 = track only, "
+                        "never shed)")
     parser.add_argument("--max-queue-size", type=int, default=0,
                         help="default per-model admission bound: requests "
                         "beyond this many pending per model are shed with "
@@ -182,7 +200,8 @@ def main() -> None:
                         "deadline paths end to end; injected faults are "
                         "pinned by the flight recorder)")
     parser.add_argument("--chaos-kinds", default="error",
-                        help="comma list of latency,error,abort "
+                        help="comma list of latency,error,abort,"
+                        "worker_kill,load_fail,mem_pressure "
                         "(default: error)")
     parser.add_argument("--chaos-seed", type=int, default=0,
                         help="RNG seed — a fixed seed reproduces the "
@@ -199,6 +218,16 @@ def main() -> None:
                         "(seconds): models time-correlated transient "
                         "faults, so prompt retries land clean "
                         "(0 = independent per-request draws)")
+    parser.add_argument("--chaos-pressure-s", type=float, default=1.0,
+                        metavar="S",
+                        help="mem_pressure window: how long each draw "
+                        "holds the shrunken byte budget before it "
+                        "restores on its own (default 1.0s)")
+    parser.add_argument("--chaos-pressure-factor", type=float, default=0.5,
+                        metavar="F",
+                        help="mem_pressure shrink: the live byte budget "
+                        "drops to F x --mem-budget-bytes while a "
+                        "pressure window holds (default 0.5)")
     parser.add_argument("--metrics-port", type=int, default=8002,
                         help="dedicated Prometheus /metrics port (Triton "
                         "convention; 0 disables — /metrics stays on the "
@@ -275,6 +304,13 @@ def main() -> None:
     core = InferenceCore(registry)
     core.default_max_queue_size = max(0, args.max_queue_size)
     core.shed_retry_after_s = max(0.0, args.shed_retry_after)
+    if args.max_request_bytes < 0:
+        parser.error("--max-request-bytes must be >= 0 (0 = unbounded)")
+    if args.mem_budget_bytes < 0:
+        parser.error("--mem-budget-bytes must be >= 0 (0 = track only)")
+    core.memory.budget_bytes = args.mem_budget_bytes
+    if args.mem_budget_bytes:
+        print(f"memory governor: host budget {args.mem_budget_bytes} bytes")
     from .qos import QosManager, parse_tenant_limit
 
     try:
@@ -303,7 +339,9 @@ def main() -> None:
                 args.chaos, kinds_csv=args.chaos_kinds,
                 seed=args.chaos_seed, latency_ms=args.chaos_latency_ms,
                 models=args.chaos_model,
-                transient_s=max(0.0, args.chaos_transient))
+                transient_s=max(0.0, args.chaos_transient),
+                pressure_s=max(0.0, args.chaos_pressure_s),
+                pressure_factor=args.chaos_pressure_factor)
         except ValueError as e:
             parser.error(str(e))
         print(f"chaos injection ON: rate={args.chaos} "
@@ -373,7 +411,8 @@ def main() -> None:
         frontends = await start_frontends(
             core, args.host, args.http_port, args.grpc_port, tls=tls,
             metrics_port=metrics_port,
-            reuse_port=worker_index is not None)
+            reuse_port=worker_index is not None,
+            max_request_bytes=args.max_request_bytes)
         scheme = "https" if tls else "http"
         metrics = (f" metrics={args.host}:{metrics_port}"
                    if metrics_port else "")

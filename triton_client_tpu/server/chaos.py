@@ -29,6 +29,16 @@ flight-record stamping, so a drill replays byte-for-byte from its seed):
   load/rolling update fails before touching the registry, the way a
   corrupt artifact or an OOM'd initializer would.
 
+The memory-admission layer (``server/memory.py``) adds one more
+data-plane kind:
+
+* ``mem_pressure`` — a draw that SHRINKS the live host byte budget to
+  ``pressure_factor`` of its configured bound for ``pressure_s`` seconds
+  (the drawing request itself proceeds, flight-stamped).  Arrivals
+  behind it shed tier-aware with typed 429s until the window lifts on
+  its own — the drill that proves the governor degrades and recovers
+  instead of OOMing.
+
 Every injected fault stamps the request's flight record (``chaos=<kind>``),
 which the flight recorder pins into its outlier buffer and ``triton-top``
 labels — an operator staring at a latency spike can tell injected weather
@@ -49,10 +59,11 @@ from typing import Dict, Iterable, Optional, Sequence
 
 from .types import InferError
 
-_KINDS = ("latency", "error", "abort", "worker_kill", "load_fail")
+_KINDS = ("latency", "error", "abort", "worker_kill", "load_fail",
+          "mem_pressure")
 #: kinds drawn per inference request by ``decide`` — ``load_fail`` is
 #: control-plane only (``maybe_fail_load``)
-_DATA_KINDS = ("latency", "error", "abort", "worker_kill")
+_DATA_KINDS = ("latency", "error", "abort", "worker_kill", "mem_pressure")
 
 
 class ChaosAbort(InferError):
@@ -66,15 +77,18 @@ class ChaosAbort(InferError):
 
 
 class ChaosFault:
-    """One injection decision."""
+    """One injection decision.  ``latency_s`` doubles as the pressure
+    window for ``mem_pressure`` faults (how long the shrunken budget
+    holds); ``pressure_factor`` is the shrink."""
 
-    __slots__ = ("kind", "latency_s", "status")
+    __slots__ = ("kind", "latency_s", "status", "pressure_factor")
 
     def __init__(self, kind: str, latency_s: float = 0.0,
-                 status: int = 503):
+                 status: int = 503, pressure_factor: float = 0.5):
         self.kind = kind
         self.latency_s = latency_s
         self.status = status
+        self.pressure_factor = pressure_factor
 
 
 class ChaosInjector:
@@ -110,6 +124,8 @@ class ChaosInjector:
         models: Optional[Iterable[str]] = None,
         max_faults: Optional[int] = None,
         transient_s: float = 0.0,
+        pressure_s: float = 1.0,
+        pressure_factor: float = 0.5,
     ):
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"chaos rate must be in [0, 1], got {rate}")
@@ -133,6 +149,14 @@ class ChaosInjector:
         self.models = set(models) if models else None
         self.max_faults = max_faults
         self.transient_s = float(transient_s)
+        # mem_pressure actuation: budget shrinks to pressure_factor of
+        # its configured bound for pressure_s seconds per draw
+        if not 0.0 < pressure_factor <= 1.0:
+            raise ValueError(
+                f"chaos pressure factor must be in (0, 1], got "
+                f"{pressure_factor}")
+        self.pressure_s = max(0.0, float(pressure_s))
+        self.pressure_factor = float(pressure_factor)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._healthy_until = 0.0
@@ -172,6 +196,9 @@ class ChaosInjector:
             return None
         if kind == "latency":
             return ChaosFault("latency", latency_s=self.latency_s)
+        if kind == "mem_pressure":
+            return ChaosFault("mem_pressure", latency_s=self.pressure_s,
+                              pressure_factor=self.pressure_factor)
         if kind in ("abort", "worker_kill"):
             return ChaosFault(kind)
         return ChaosFault("error", status=self.error_status)
@@ -197,11 +224,14 @@ class ChaosInjector:
 def build_injector(rate: float, kinds_csv: str = "error", seed: int = 0,
                    latency_ms: float = 50.0,
                    models: Optional[Iterable[str]] = None,
-                   transient_s: float = 0.0) -> ChaosInjector:
+                   transient_s: float = 0.0,
+                   pressure_s: float = 1.0,
+                   pressure_factor: float = 0.5) -> ChaosInjector:
     """CLI-flag assembly (``--chaos``/``--chaos-kinds``/...) — raises
     ``ValueError`` on junk so a typo'd flag fails at startup, not at the
     first unlucky request."""
     kinds = [k.strip() for k in kinds_csv.split(",") if k.strip()]
     return ChaosInjector(rate=rate, kinds=kinds, seed=seed,
                          latency_ms=latency_ms, models=models,
-                         transient_s=transient_s)
+                         transient_s=transient_s, pressure_s=pressure_s,
+                         pressure_factor=pressure_factor)

@@ -33,6 +33,7 @@ from .shm import SystemShmRegistry, XlaShmRegistry
 from .device_stats import DeviceStatsCollector, SloEngine, SloObjective
 from .flight_recorder import FlightRecorder
 from .log import ServerLog, log_off_loop
+from .memory import MemoryGovernor
 from .qos import DEFAULT_TENANT, QosManager, TieredQueue
 from .trace import RequestTracer, TRACE_DEFAULTS
 from .types import (
@@ -598,6 +599,11 @@ class InferenceCore:
         # buckets, preemptible best-effort lane (server/qos.py).  The
         # default config is inert for priority-0 anonymous traffic.
         self.qos = QosManager()
+        # byte-accounted memory admission (server/memory.py): queued +
+        # in-flight request/response bytes per model/tenant against
+        # --mem-budget-bytes, plus the HBM-headroom gate for generation
+        # slot admission.  Unconfigured (budget 0) it only tracks.
+        self.memory = MemoryGovernor()
         # optional fault injector (server/chaos.py; --chaos CLI flags)
         self.chaos = None
         # closed-loop fleet controller (server/fleet.py): per-model
@@ -707,6 +713,40 @@ class InferenceCore:
                 f"tenant '{request.tenant}' is over its rate limit for "
                 f"model '{model.name}'; retry later",
                 http_status=429, retry_after_s=retry_in)
+        # byte-accounted admission (server/memory.py): the arrival's wire
+        # bytes must fit its tier's share of the live host budget, or it
+        # sheds here — tier-aware (best effort first) and largest-first
+        # (a giant bounces where a small request still fits).  Admission
+        # RESERVES the bytes; every exit below that refuses the request
+        # must release them (the success paths release in _infer_on /
+        # infer_stream when the envelope completes).
+        verdict = self.memory.try_admit(
+            model.name, request.tenant, request.tier, request.wire_bytes,
+            qos=qos, base_pushback_s=self.shed_retry_after_s)
+        if verdict is not None:
+            retry_in, permanent = verdict
+            self._count_shed(model, request.tenant, request.tier)
+            if permanent:
+                # the payload alone exceeds this tier's configured budget
+                # share — no amount of waiting admits it, so answer the
+                # client's NON-retryable oversize class (413) instead of
+                # inviting a doomed 429 retry loop that re-uploads the
+                # giant N times
+                err = InferError(
+                    f"request of {request.wire_bytes} bytes to model "
+                    f"'{model.name}' exceeds the tier-{request.tier} "
+                    "share of the server's memory budget "
+                    "(--mem-budget-bytes) and can never be admitted; "
+                    "reduce the payload or use shared memory",
+                    http_status=413)
+            else:
+                err = InferError(
+                    f"request of {request.wire_bytes} bytes to model "
+                    f"'{model.name}' exceeds the server's memory budget "
+                    f"for tier {request.tier}; retry later",
+                    http_status=429, retry_after_s=retry_in)
+            err.shed_reason = "memory"
+            raise err
         limit = self.max_queue_size(model)
         if limit <= 0:
             return
@@ -737,6 +777,9 @@ class InferenceCore:
                             self.shed_retry_after_s,
                             self._tier_depth(model, v_tier), limit)))
                 return
+        # refused on queue depth AFTER the byte reservation above went
+        # through — hand the bytes back before raising
+        self.memory.release(model.name, request.tenant, request.wire_bytes)
         self._count_shed(model, request.tenant, request.tier)
         raise InferError(
             f"request queue for model '{model.name}' is full for tier "
@@ -767,6 +810,14 @@ class InferenceCore:
             trace.flight.chaos = fault.kind
         if fault.kind == "latency":
             await asyncio.sleep(fault.latency_s)
+            return
+        if fault.kind == "mem_pressure":
+            # budget squeeze, not a request failure: the drawing request
+            # proceeds (flight-stamped chaos=mem_pressure), but the live
+            # byte budget shrinks for the fault's window — arrivals behind
+            # it shed tier-aware until the pressure lifts on its own
+            self.memory.inject_pressure(
+                fault.pressure_factor, fault.latency_s)
             return
         if fault.kind == "abort":
             from .chaos import ChaosAbort
@@ -802,10 +853,22 @@ class InferenceCore:
 
     async def _infer_on(self, model: Model, request: InferRequest) -> InferResponse:
         model.stats.inc_pending()
+        # the governor's ledger entry for this request: wire bytes were
+        # reserved at _admit; response bytes join when the response is
+        # built, and the whole entry releases when the envelope completes
+        # (the frontend serialize path aliases the counted arrays — the
+        # PR 10 zero-copy contract — rather than copying them)
+        held = request.wire_bytes
         try:
             resp = await self._infer_traced_entry(model, request)
+            out_bytes = sum(
+                o.data.nbytes for o in resp.outputs if o.data is not None)
+            if out_bytes:
+                self.memory.add(model.name, request.tenant, out_bytes)
+                held += out_bytes
         finally:
             model.stats.dec_pending()
+            self.memory.release(model.name, request.tenant, held)
         if request.client_request_id:
             # echo the propagated correlation id so the client can join its
             # telemetry with the server trace (HTTP also echoes the header)
@@ -864,6 +927,11 @@ class InferenceCore:
             resp = await self._infer_traced(model, request, trace)
         except BaseException as e:
             # errors close and emit here — no response carries the handoff
+            reason = getattr(e, "shed_reason", None)
+            if reason and trace.flight is not None:
+                # memory sheds inside the envelope (HBM gating, budget
+                # pressure mid-queue) are tellable from queue-depth sheds
+                trace.flight.shed_reason = reason
             trace.mark_failed(e)
             await trace.emit_async()
             raise
@@ -992,27 +1060,34 @@ class InferenceCore:
         if not model.decoupled:
             yield await self._infer_on(model, request)
             return
-        # the resilience gates apply to decoupled streams too: an expired
-        # deadline is dropped before the producer ever starts, and chaos
-        # exercises the stream error path (no unary trace context here —
-        # decoupled requests are not flight-recorded)
-        self._check_deadline(model, request)
-        if self.chaos is not None:
-            await self._apply_chaos(model, None)
-            self._check_deadline(model, request)
-        # pending gauge covers in-flight streams too, so graceful drain
-        # waits for them and admission sees their occupancy
-        model.stats.inc_pending()
-        agen = self._infer_stream_decoupled(model, request)
         try:
-            async for resp in agen:
-                yield resp
+            # the resilience gates apply to decoupled streams too: an
+            # expired deadline is dropped before the producer ever starts,
+            # and chaos exercises the stream error path (no unary trace
+            # context here — decoupled requests are not flight-recorded)
+            self._check_deadline(model, request)
+            if self.chaos is not None:
+                await self._apply_chaos(model, None)
+                self._check_deadline(model, request)
+            # pending gauge covers in-flight streams too, so graceful
+            # drain waits for them and admission sees their occupancy
+            model.stats.inc_pending()
+            agen = self._infer_stream_decoupled(model, request)
+            try:
+                async for resp in agen:
+                    yield resp
+            finally:
+                # explicit aclose: the inner generator's GeneratorExit
+                # handler (consumer-disconnect accounting, producer stop)
+                # must run deterministically, not at GC time
+                await agen.aclose()
+                model.stats.dec_pending()
         finally:
-            # explicit aclose: the inner generator's GeneratorExit handler
-            # (consumer-disconnect accounting, producer stop) must run
-            # deterministically, not at GC time
-            await agen.aclose()
-            model.stats.dec_pending()
+            # _admit reserved the request's wire bytes; a stream holds
+            # them for its whole lifetime (streamed response chunks are
+            # not individually accounted)
+            self.memory.release(
+                model.name, request.tenant, request.wire_bytes)
 
     async def _infer_stream_decoupled(
         self, model: Model, request: InferRequest
@@ -1029,6 +1104,11 @@ class InferenceCore:
         attach = getattr(model, "attach_device_stats", None)
         if attach is not None:
             attach(self.device_stats)
+        # hand device-loop models the memory governor too: generation
+        # slot admission gates on projected KV bytes vs HBM headroom
+        attach_gov = getattr(model, "attach_memory_governor", None)
+        if attach_gov is not None:
+            attach_gov(self.memory)
         sync_gen = model.execute_decoupled(inputs, params)
 
         def _produce():
@@ -1360,6 +1440,13 @@ class InferenceCore:
 
         def _exec():
             want_ds = ds.enabled
+            # device-loop models (the decode worker) gate slot admission
+            # on projected KV bytes — hand them the governor BEFORE the
+            # execute so the first request is already gated (idempotent
+            # attribute stamp, like attach_device_stats below)
+            attach_gov = getattr(model, "attach_memory_governor", None)
+            if attach_gov is not None:
+                attach_gov(self.memory)
             t_c0 = time.monotonic_ns() if (traces or want_ds) else 0
             outputs = model.execute(inputs, params)
             t_c1 = time.monotonic_ns() if (traces or want_ds) else 0

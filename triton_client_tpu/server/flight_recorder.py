@@ -109,7 +109,7 @@ class FlightRecord:
                  "batch", "bytes_in", "bytes_out", "arrival_ns", "ts",
                  "queue_us", "compute_us", "total_us", "outcome",
                  "capture_reason", "spans", "chaos", "tenant", "tier",
-                 "tick")
+                 "tick", "shed_reason")
 
     def __init__(self, seq: int, model: str, version: str,
                  request_id: str = "", protocol: str = "",
@@ -143,6 +143,10 @@ class FlightRecord:
         # request's execution rode, at what occupancy/pad waste — stamped
         # by the dynamic batcher so an outlier shows its tick shape
         self.tick: Optional[Dict[str, Any]] = None
+        # admission-refusal class (server/memory.py): "memory" when the
+        # byte budget or HBM-headroom gate shed this request inside the
+        # traced envelope — tellable from queue-depth sheds at a glance
+        self.shed_reason: Optional[str] = None
 
     def to_dict(self, include_spans: bool = False) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -165,6 +169,7 @@ class FlightRecord:
             "tenant": self.tenant,
             "tier": self.tier,
             "tick": self.tick,
+            "shed_reason": self.shed_reason,
         }
         if include_spans:
             out["spans"] = self.spans or []

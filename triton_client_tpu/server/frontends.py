@@ -23,6 +23,7 @@ from aiohttp import web
 from .core import InferenceCore
 from .grpc_server import build_grpc_server
 from .http_server import build_app
+from .memory import DEFAULT_MAX_REQUEST_BYTES
 from .tls import TLSConfig
 
 
@@ -64,11 +65,18 @@ async def start_frontends(
     tls: Optional[TLSConfig] = None,
     metrics_port: Optional[int] = None,
     reuse_port: bool = False,
+    max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES,
 ) -> Tuple[web.AppRunner, "object", Optional[web.AppRunner]]:
     """Start the HTTP and gRPC frontends (plus an optional dedicated
     Prometheus port, Triton-style :8002); returns
-    (http_runner, grpc_server, metrics_runner)."""
-    runner = web.AppRunner(build_app(core))
+    (http_runner, grpc_server, metrics_runner).
+
+    ``max_request_bytes`` caps every wire payload on BOTH frontends
+    before body materialization (HTTP 413 / gRPC RESOURCE_EXHAUSTED
+    carrying the limit; see server/memory.py).  The default makes a bare
+    serve bounded out of the box; 0 is the explicit opt-out."""
+    runner = web.AppRunner(
+        build_app(core, max_request_bytes=max_request_bytes))
     await runner.setup()
     site = web.TCPSite(
         runner, host, http_port,
@@ -89,7 +97,8 @@ async def start_frontends(
                 metrics_runner, host, metrics_port,
                 ssl_context=tls.ssl_context() if tls else None).start()
         grpc_server = build_grpc_server(core, f"{host}:{grpc_port}", tls=tls,
-                                        reuse_port=reuse_port)
+                                        reuse_port=reuse_port,
+                                        max_request_bytes=max_request_bytes)
         await grpc_server.start()
     except BaseException:
         if metrics_runner is not None:

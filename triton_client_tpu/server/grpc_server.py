@@ -24,6 +24,7 @@ from ..protocol.service import add_GRPCInferenceServiceServicer_to_server
 from ..utils import deserialize_bytes_tensor, triton_to_np_dtype
 from .core import InferenceCore
 from .log import log_off_loop
+from .memory import DEFAULT_MAX_REQUEST_BYTES
 from .model import datatype_to_pb
 from .qos import tenant_from_headers
 from .types import (InferError, InferRequest, InputTensor,
@@ -132,7 +133,16 @@ def _decode_pb_request(request: pb.ModelInferRequest) -> InferRequest:
 
 def _raw_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray:
     if datatype == "BYTES":
-        return reshape_input(deserialize_bytes_tensor(chunk), shape, name)
+        try:
+            flat = deserialize_bytes_tensor(chunk)
+        except Exception as e:
+            # the codec raises the CLIENT exception class on a truncated
+            # length-prefixed stream — uncaught it escapes the InferError
+            # handlers as UNKNOWN/500 instead of a clean client error
+            # (surfaced by the gRPC fuzz pass)
+            raise InferError(
+                f"malformed BYTES payload for input '{name}': {e}")
+        return reshape_input(flat, shape, name)
     dt = triton_to_np_dtype(datatype)
     if dt is None:
         raise InferError(f"unsupported datatype '{datatype}' for input '{name}'")
@@ -439,6 +449,8 @@ class InferenceServicer:
         def _snap():
             out = self._core.device_stats.snapshot(model=model)
             out["slo"] = self._core.slo.snapshot(model=model)
+            # byte-admission ledger, same shape as the HTTP surface
+            out["memory"] = self._core.memory.snapshot()
             return _json.dumps(out)
 
         body = await asyncio.get_running_loop().run_in_executor(None, _snap)
@@ -473,6 +485,8 @@ class InferenceServicer:
             req.decode_end_ns = time.monotonic_ns()
             req.trace_handoff = True
             req.protocol = "grpc"
+            # the memory governor's ledger entry: serialized message size
+            req.wire_bytes = request.ByteSize()
             resp = await self._core.infer(req)
         except InferError as e:
             rid = getattr(req, "client_request_id", "") \
@@ -547,6 +561,7 @@ class InferenceServicer:
                 req = _decode_pb_request(request)
                 _read_trace_metadata(req, context)
                 req.protocol = "grpc"
+                req.wire_bytes = request.ByteSize()
                 enable_empty_final = bool(
                     req.parameters.get("triton_enable_empty_final_response", False)
                 )
@@ -576,6 +591,11 @@ def _grpc_code(e: InferError) -> grpc.StatusCode:
     return {
         400: grpc.StatusCode.INVALID_ARGUMENT,
         404: grpc.StatusCode.NOT_FOUND,
+        # oversize wire payloads (the --max-request-bytes cap; normally
+        # rejected by the channel option before the handler runs, but a
+        # handler-raised 413 — e.g. through the gRPC-Web bridge — must
+        # map to the same code the transport rejection carries)
+        413: grpc.StatusCode.RESOURCE_EXHAUSTED,
         # resilience layer: shed load / drain / blown deadline map to the
         # codes the client retry policy gates on (RESOURCE_EXHAUSTED and
         # UNAVAILABLE retryable; DEADLINE_EXCEEDED deliberately not)
@@ -589,11 +609,19 @@ def _grpc_code(e: InferError) -> grpc.StatusCode:
 def build_grpc_server(
     core: InferenceCore, address: str = "[::]:8001", tls=None,
     reuse_port: bool = False,
+    max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES,
 ) -> "grpc.aio.Server":
+    cap = max(0, int(max_request_bytes or 0))
     server = grpc.aio.server(
         options=[
             ("grpc.max_send_message_length", -1),
-            ("grpc.max_receive_message_length", -1),
+            # wire ingress cap (server/memory.py layer 1): a REAL channel
+            # option, so an oversize message is refused by the transport
+            # — RESOURCE_EXHAUSTED carrying both sizes ("Received message
+            # larger than max (N vs. M)") — before the body ever
+            # materializes in this process.  0 = explicit opt-out
+            # (--max-request-bytes 0), restoring the old unbounded -1
+            ("grpc.max_receive_message_length", cap if cap else -1),
             # explicit either way: ON for the multi-process frontend
             # topology (N workers share the port, kernel balances
             # accepts), OFF for single-process so a double-bind fails

@@ -24,6 +24,7 @@ from aiohttp import web
 from ..utils import deserialize_bytes_tensor, triton_to_np_dtype
 from .core import InferenceCore
 from .log import log_off_loop
+from .memory import DEFAULT_MAX_REQUEST_BYTES
 from .qos import tenant_from_headers
 from .types import (InferError, InferRequest, InputTensor,
                     RequestedOutput, ShmRef, apply_request_deadline,
@@ -51,8 +52,62 @@ def _stamp_qos(req: InferRequest, request: web.Request) -> None:
     apply_request_priority(req)
 
 
-def build_app(core: InferenceCore) -> web.Application:
-    app = web.Application(client_max_size=1 << 30)
+def _oversize_response(size, cap: int) -> web.Response:
+    """The typed wire-cap rejection: 413 with the limit in the body and
+    the machine-readable headers, BEFORE any body materialization.  The
+    pushback headers ride along for symmetry with every other shed, but
+    the client resilience layer classifies 413 as non-retryable — the
+    same payload can only bounce again; the fix is client-side."""
+    size_s = f"request of {size} bytes" if size else "request"
+    return web.json_response(
+        {"error": f"{size_s} exceeds the server's max request size of "
+                  f"{cap} bytes (--max-request-bytes)"},
+        status=413,
+        headers={
+            "Retry-After": "1",
+            "triton-retry-after-ms": "1000",
+            "triton-max-request-bytes": str(cap),
+        })
+
+
+def _ingress_cap(cap: int):
+    """Wire ingress cap middleware (server/memory.py layer 1): reject
+    oversize requests from their DECLARED sizes — ``Content-Length``, or
+    the ``Inference-Header-Content-Length`` a chunked upload still
+    announces — before reading a byte of body; bodies that only reveal
+    their size while streaming in are cut off by aiohttp's
+    ``client_max_size`` (HTTPRequestEntityTooLarge), converted here to
+    the same typed 413 instead of the stock HTML error page."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        declared = request.content_length
+        if declared is not None and declared > cap:
+            return _oversize_response(declared, cap)
+        hlen = request.headers.get(_HEADER_LEN)
+        if hlen is not None:
+            try:
+                if int(hlen) > cap:
+                    return _oversize_response(int(hlen), cap)
+            except ValueError:
+                pass  # junk header: the handler 400s it with context
+        try:
+            return await handler(request)
+        except web.HTTPRequestEntityTooLarge as e:
+            return _oversize_response(getattr(e, "actual_size", None), cap)
+
+    return middleware
+
+
+def build_app(core: InferenceCore,
+              max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES
+              ) -> web.Application:
+    cap = max(0, int(max_request_bytes or 0))
+    # client_max_size enforces the cap on bodies whose size is only
+    # discovered while streaming; 0 (explicit opt-out) restores the old
+    # 1 GiB aiohttp ceiling
+    app = web.Application(client_max_size=cap or 1 << 30,
+                          middlewares=[_ingress_cap(cap)] if cap else [])
     r = app.router
     r.add_get("/v2/health/live", _h(core, _health_live))
     r.add_get("/v2/health/ready", _h(core, _health_ready))
@@ -335,12 +390,18 @@ async def _build_generate(core, request):
     name = request.match_info["model"]
     version = request.match_info.get("version", "")
     model = core.registry.get(name, version)
+    # read raw first: the byte ledger needs the ACTUAL body size (a
+    # chunked upload has no Content-Length to trust), and an oversize
+    # read raises HTTPRequestEntityTooLarge for the ingress-cap
+    # middleware — it must not be swallowed into the JSON 400 below
+    raw = await request.read()
     try:
-        body = await request.json()
+        body = json.loads(raw)
     except Exception:
         raise InferError("failed to parse generate request JSON", 400)
     req = build_generate_request(model, name, version, body)
     req.protocol = "http"
+    req.wire_bytes = len(raw)
     _stamp_qos(req, request)
     return name, version, model, req
 
@@ -439,6 +500,9 @@ async def _device_stats(core, request):
     def _snap():
         out = core.device_stats.snapshot(model=model)
         out["slo"] = core.slo.snapshot(model=model)
+        # the byte-admission ledger rides the same debug surface: live
+        # budget, in-flight bytes per model/tenant, shed counts
+        out["memory"] = core.memory.snapshot()
         return json.dumps(out)
 
     body = await asyncio.get_running_loop().run_in_executor(None, _snap)
@@ -555,6 +619,9 @@ async def _infer(core, request: web.Request) -> web.Response:
     req.decode_end_ns = time.monotonic_ns()
     req.trace_handoff = True
     req.protocol = "http"
+    # the memory governor's ledger entry: what this request actually put
+    # on the wire (body bytes as received, post-inflate)
+    req.wire_bytes = len(raw)
     # deadline propagation: the triton-timeout-us header (the restamped
     # remaining budget) wins over the body's `timeout` parameter
     apply_request_deadline(req, header_us=request.headers.get(_TIMEOUT_HDR))
@@ -684,7 +751,14 @@ def _decode_request(
 
 def _bytes_to_array(chunk: bytes, datatype: str, shape, name: str) -> np.ndarray:
     if datatype == "BYTES":
-        flat = deserialize_bytes_tensor(chunk)
+        try:
+            flat = deserialize_bytes_tensor(chunk)
+        except Exception as e:
+            # the codec raises the CLIENT exception class on a truncated
+            # length-prefixed stream — uncaught it would 500 a malformed
+            # body instead of 400ing it (same fix as the gRPC decoder)
+            raise InferError(
+                f"malformed BYTES payload for input '{name}': {e}")
         return reshape_input(flat, shape, name)
     dt = triton_to_np_dtype(datatype)
     if dt is None:

@@ -1,0 +1,353 @@
+"""Byte-accounted memory admission: the :class:`MemoryGovernor`.
+
+Every overload defense before this module counted *requests* — queue
+limits, tenant token buckets, QoS tiers, SLO-driven autoscaling — never
+*bytes*.  A burst of large-tensor requests therefore sailed through every
+gate and OOM'd the host (or the device) before any of them fired.  This
+module is the byte half of admission control, three layers deep:
+
+* **Wire ingress caps** (both frontends): ``--max-request-bytes``
+  (default :data:`DEFAULT_MAX_REQUEST_BYTES`) bounds every request
+  BEFORE its body materializes — HTTP via ``client_max_size`` plus a
+  ``Content-Length`` / ``Inference-Header-Content-Length``-aware early
+  reject (413 with the limit and pushback headers), gRPC via a real
+  ``grpc.max_receive_message_length`` channel option (RESOURCE_EXHAUSTED
+  carrying the limit, raised by the transport before the handler runs).
+  ``--max-request-bytes 0`` is the explicit opt-out.
+
+* **Host byte budget** (this class): queued + in-flight request/response
+  bytes are tracked per model and tenant against ``--mem-budget-bytes``.
+  Over-budget *arrivals* shed with a typed 429/RESOURCE_EXHAUSTED +
+  pushback instead of letting the process swell toward the OOM killer.
+  Shedding is tier-aware and largest-first, reusing the QoS shed order:
+  each tier may only fill its :meth:`QosManager.tier_limit` fraction of
+  the live budget (best effort sheds first, tier 0 may use all of it),
+  and an arrival sheds iff *its own bytes* don't fit the tier's remaining
+  headroom — so small tier-0 traffic keeps flowing while giants bounce.
+  Response bytes join the ledger when the response is built (``add``)
+  and never shed — the work is already done; only arrivals are refused.
+
+* **HBM headroom gating** (:meth:`admit_hbm`): generation/decode slot
+  admission projects the KV bytes a request will pin (tokens x layers x
+  2 x heads x head_dim x cache itemsize) and refuses admission when the
+  projection exceeds the live device headroom (``bytes_limit -
+  bytes_in_use`` from the same jax memory gauges ``nv_tpu_memory_*``
+  renders, scaled by ``hbm_headroom_fraction``).  A long prompt then
+  degrades to a typed 429 the client can back off from, instead of an
+  allocator abort that takes the whole running cohort with it.  On
+  backends without memory stats (CPU) the gate is inert.
+
+The ``mem_pressure`` chaos kind (``server/chaos.py``) shrinks the live
+budget mid-run through :meth:`inject_pressure` — the drill that proves
+the governor sheds cleanly under pressure and recovers when it lifts.
+
+Accounting boundary: request bytes are reserved at admission and
+released when the core's envelope completes; response bytes are added at
+``_build_response`` and released at the same point.  The frontends'
+serialize paths alias the counted output arrays (the PR 10 zero-copy
+wire contract) rather than copying them, so the ledger bounds
+materialized payload bytes up to the single transport-required copy per
+wire.
+
+Observability: ``nv_mem_{inflight_bytes,budget_bytes,shed_total,
+hbm_headroom_bytes}`` (declared once in ``metrics.collect_families``),
+``shed_reason: "memory"`` stamped on flight records of in-envelope
+sheds, and triton-top's MEM% / SHED/s columns.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .types import InferError
+
+__all__ = ["DEFAULT_MAX_REQUEST_BYTES", "MemoryGovernor"]
+
+#: Default wire ingress cap (both frontends): 64 MiB, the "nobody needs a
+#: gigabyte tensor in one request" bound.  ``--max-request-bytes 0`` is
+#: the explicit opt-out restoring the old unbounded behavior.
+DEFAULT_MAX_REQUEST_BYTES = 64 << 20
+
+
+class MemoryGovernor:
+    """Byte ledger + admission verdicts for one :class:`InferenceCore`.
+
+    Thread-safe under one short lock: admission/release run on the event
+    loop, the HBM gate runs on executor threads (the decode worker), and
+    the metrics renderer snapshots from its own thread.
+    """
+
+    #: Safety fraction of the live HBM headroom a single admission may
+    #: claim — compile workspace and allocator fragmentation need the rest.
+    DEFAULT_HBM_HEADROOM_FRACTION = 0.8
+
+    #: Tenant identity is client-controlled (an arbitrary header), so the
+    #: ledger/shed dicts fold identities beyond this cap into the same
+    #: ``~overflow`` pseudo-tenant the QoS layer uses — a rotating-tenant
+    #: flood cannot grow the dicts or the nv_mem_shed_total label
+    #: cardinality without bound (an OOM vector has no place in the
+    #: OOM-prevention layer).
+    MAX_TRACKED_TENANTS = 1024
+    OVERFLOW_TENANT = "~overflow"
+
+    def __init__(self, budget_bytes: int = 0,
+                 hbm_stats_fn=None) -> None:
+        # host byte budget (0 = unbounded: the ledger still tracks, the
+        # shed verdict never fires)
+        self.budget_bytes = int(budget_bytes)
+        self.hbm_headroom_fraction = self.DEFAULT_HBM_HEADROOM_FRACTION
+        # HBM gauge source — the SAME jax memory stats nv_tpu_memory_*
+        # renders; injectable so drills can model a full device on CPU
+        if hbm_stats_fn is None:
+            from .device_stats import DeviceStatsCollector
+
+            hbm_stats_fn = DeviceStatsCollector.hbm_stats
+        self.hbm_stats_fn = hbm_stats_fn
+        self._lock = threading.Lock()
+        self.inflight_bytes = 0
+        self.peak_inflight_bytes = 0
+        self.inflight_by_model: Dict[str, int] = {}
+        self.inflight_by_tenant: Dict[str, int] = {}
+        # (model, tenant, tier, reason) -> count; reason "host" = byte
+        # budget, "hbm" = projected-KV headroom (nv_mem_shed_total labels)
+        self.shed: Dict[Tuple[str, str, int, str], int] = {}
+        # live-pressure state (mem_pressure chaos): the budget reads as
+        # budget * factor until the window expires — checked lazily, no
+        # timers to leak
+        self._pressure_factor = 1.0
+        self._pressure_until = 0.0
+        self.pressure_events = 0
+        self._known_tenants: set = set()
+
+    # -- budget ------------------------------------------------------------
+    def effective_budget(self, now: Optional[float] = None) -> int:
+        """The live host budget: the configured bound scaled by any active
+        pressure injection (0 = unbounded)."""
+        if self.budget_bytes <= 0:
+            return 0
+        with self._lock:
+            return self._effective_budget_locked(
+                time.monotonic() if now is None else now)
+
+    def _effective_budget_locked(self, now: float) -> int:
+        if self._pressure_factor < 1.0 and now >= self._pressure_until:
+            self._pressure_factor = 1.0  # the pressure window lifted
+        return max(1, int(self.budget_bytes * self._pressure_factor))
+
+    def _track_tenant_locked(self, tenant: str) -> str:
+        """Fold tenant identities beyond the cardinality cap into
+        ``~overflow`` — applied uniformly on every ledger/shed touch so
+        reserve and release always key the same entry."""
+        if tenant in self._known_tenants:
+            return tenant
+        if len(self._known_tenants) < self.MAX_TRACKED_TENANTS:
+            self._known_tenants.add(tenant)
+            return tenant
+        return self.OVERFLOW_TENANT
+
+    def inject_pressure(self, factor: float, duration_s: float,
+                        now: Optional[float] = None) -> None:
+        """Shrink the live budget to ``factor`` of the configured bound
+        for ``duration_s`` (the ``mem_pressure`` chaos actuator).  The
+        drill contract: sheds spike while the window holds, then the
+        budget restores by itself — recovery needs no operator action."""
+        factor = min(1.0, max(0.01, float(factor)))
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._pressure_factor = factor
+            self._pressure_until = now + max(0.0, float(duration_s))
+            self.pressure_events += 1
+
+    # -- host-byte admission ----------------------------------------------
+    def try_admit(self, model: str, tenant: str, tier: int, nbytes: int,
+                  qos=None, base_pushback_s: float = 0.25,
+                  now: Optional[float] = None
+                  ) -> Optional[Tuple[float, bool]]:
+        """Admission verdict for an arrival carrying ``nbytes`` wire
+        bytes: ``None`` = admitted (the bytes are now reserved — pair
+        with :meth:`release`), else ``(pushback_s, permanent)`` for a
+        shed, with the shed counted.  ``permanent`` is True when the
+        arrival's OWN bytes exceed its tier's share of the CONFIGURED
+        budget — it can never be admitted however long the caller waits
+        (pressure only shrinks the budget), so the core answers 413 (the
+        client's non-retryable oversize class) instead of inviting a
+        doomed 429 retry loop that re-uploads the giant N times.
+
+        Tier-aware, largest-first: the arrival sheds iff the ledger plus
+        ITS bytes would exceed the tier's share of the live budget
+        (``qos.tier_limit`` interpolation — tier 0 gets 100%, best
+        effort ``best_effort_fraction``).  A small request still fits
+        where a giant doesn't, so under byte pressure the biggest and
+        lowest-priority work is refused first — the same shed order the
+        queue-depth gates use."""
+        nbytes = max(0, int(nbytes))
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            tenant = self._track_tenant_locked(tenant)
+            budget = (self._effective_budget_locked(now)
+                      if self.budget_bytes > 0 else 0)
+            if budget > 0:
+                tier_budget = (qos.tier_limit(tier, budget)
+                               if qos is not None else budget)
+                if self.inflight_bytes + nbytes > tier_budget:
+                    key = (model, tenant, int(tier), "host")
+                    self.shed[key] = self.shed.get(key, 0) + 1
+                    # a giant that can't fit an EMPTY ledger at the
+                    # configured (unpressured) budget is doomed forever
+                    configured = (qos.tier_limit(tier, self.budget_bytes)
+                                  if qos is not None else self.budget_bytes)
+                    permanent = nbytes > configured
+                    # depth-proportional pushback, byte-flavored: how
+                    # full the ledger already is relative to the budget
+                    fill = self.inflight_bytes / float(budget)
+                    return (max(0.0, base_pushback_s) * (1.0 + fill),
+                            permanent)
+            self._reserve_locked(model, tenant, nbytes)
+        return None
+
+    def _reserve_locked(self, model: str, tenant: str, nbytes: int) -> None:
+        self.inflight_bytes += nbytes
+        self.peak_inflight_bytes = max(self.peak_inflight_bytes,
+                                       self.inflight_bytes)
+        if nbytes:
+            self.inflight_by_model[model] = \
+                self.inflight_by_model.get(model, 0) + nbytes
+            self.inflight_by_tenant[tenant] = \
+                self.inflight_by_tenant.get(tenant, 0) + nbytes
+
+    def add(self, model: str, tenant: str, nbytes: int) -> None:
+        """Response bytes joining an already-admitted request's ledger
+        entry (release the sum).  Never sheds: the compute is already
+        paid, and refusing to answer would waste it — ``add`` may push
+        the ledger transiently past the budget, which is the honest
+        record ``peak_inflight_bytes`` keeps."""
+        nbytes = max(0, int(nbytes))
+        if not nbytes:
+            return
+        with self._lock:
+            self._reserve_locked(model, self._track_tenant_locked(tenant),
+                                 nbytes)
+
+    def release(self, model: str, tenant: str, nbytes: int) -> None:
+        nbytes = max(0, int(nbytes))
+        if not nbytes:
+            return
+        with self._lock:
+            tenant = self._track_tenant_locked(tenant)
+            self.inflight_bytes = max(0, self.inflight_bytes - nbytes)
+            for d, key in ((self.inflight_by_model, model),
+                           (self.inflight_by_tenant, tenant)):
+                left = d.get(key, 0) - nbytes
+                if left > 0:
+                    d[key] = left
+                else:
+                    d.pop(key, None)
+
+    # -- HBM headroom gating ----------------------------------------------
+    def hbm_headroom(self) -> Optional[int]:
+        """Live device headroom: min over devices of ``bytes_limit -
+        bytes_in_use`` from the jax memory gauges.  ``None`` when the
+        backend exposes no memory stats (CPU) — the gate is then inert,
+        never fabricated."""
+        try:
+            stats = self.hbm_stats_fn() or {}
+        except Exception:  # noqa: BLE001 — a gauge failure must not shed
+            return None
+        headrooms = [s["bytes_limit"] - s.get("bytes_in_use", 0)
+                     for s in stats.values() if "bytes_limit" in s]
+        if not headrooms:
+            return None
+        return max(0, min(headrooms))
+
+    def admit_hbm(self, model: str, projected_bytes: int,
+                  tenant: str = "", tier: int = 0) -> None:
+        """Gate a generation/decode slot admission on projected KV bytes:
+        raises the typed 429 (``shed_reason="memory"``) when the
+        projection exceeds the safety fraction of live HBM headroom —
+        graceful degradation instead of an allocator abort mid-cohort."""
+        projected_bytes = max(0, int(projected_bytes))
+        if not projected_bytes:
+            return
+        headroom = self.hbm_headroom()
+        if headroom is None:
+            return
+        allowed = int(headroom * self.hbm_headroom_fraction)
+        if projected_bytes <= allowed:
+            return
+        with self._lock:
+            key = (model, self._track_tenant_locked(tenant), int(tier),
+                   "hbm")
+            self.shed[key] = self.shed.get(key, 0) + 1
+        err = InferError(
+            f"model '{model}': projected KV cache of {projected_bytes} "
+            f"bytes exceeds the device memory headroom ({allowed} bytes "
+            "usable); retry with a shorter prompt/generation or when "
+            "running work completes", http_status=429,
+            retry_after_s=1.0)
+        err.shed_reason = "memory"
+        raise err
+
+    # -- export ------------------------------------------------------------
+    def shed_total(self) -> int:
+        with self._lock:
+            return sum(self.shed.values())
+
+    def metric_rows(self) -> Dict[str, List[Tuple[Dict[str, str], Any]]]:
+        """The ``nv_mem_*`` sample rows, keyed by short family name — one
+        source for both the Prometheus renderer and the JSON snapshot
+        (same contract as ``DeviceStatsCollector.metric_rows``)."""
+        with self._lock:
+            by_model = sorted(self.inflight_by_model.items())
+            shed = sorted(self.shed.items())
+            budget = (self._effective_budget_locked(time.monotonic())
+                      if self.budget_bytes > 0 else None)
+        rows: Dict[str, List[Tuple[Dict[str, str], Any]]] = {
+            "inflight": [({"model": m}, v) for m, v in by_model],
+            "budget": ([({}, budget)] if budget is not None else []),
+            "shed": [({"model": m, "tenant": t, "tier": str(tier),
+                       "reason": reason}, v)
+                     for (m, t, tier, reason), v in shed],
+            "hbm_headroom": [],
+        }
+        try:
+            stats = self.hbm_stats_fn() or {}
+        except Exception:  # noqa: BLE001 — observability must never raise
+            stats = {}
+        for dev, s in sorted(stats.items()):
+            if "bytes_limit" in s:
+                rows["hbm_headroom"].append(
+                    ({"device": dev},
+                     max(0, s["bytes_limit"] - s.get("bytes_in_use", 0))))
+        return rows
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Debug-surface JSON (rides ``/v2/debug/device_stats`` under
+        ``"memory"``)."""
+        with self._lock:
+            now = time.monotonic()
+            budget = (self._effective_budget_locked(now)
+                      if self.budget_bytes > 0 else None)
+            out = {
+                "budget_bytes": self.budget_bytes or None,
+                "effective_budget_bytes": budget,
+                # computed against the clock, not the lazily-reset factor:
+                # a track-only governor (budget 0) never runs the lazy
+                # reset, and an expired window must not read as active
+                "pressure_active": (self._pressure_factor < 1.0
+                                    and now < self._pressure_until),
+                "pressure_events": self.pressure_events,
+                "inflight_bytes": self.inflight_bytes,
+                "peak_inflight_bytes": self.peak_inflight_bytes,
+                "inflight_by_model": dict(self.inflight_by_model),
+                "inflight_by_tenant": dict(self.inflight_by_tenant),
+                "shed_total": sum(self.shed.values()),
+                "shed": [
+                    {"model": m, "tenant": t, "tier": tier,
+                     "reason": reason, "count": v}
+                    for (m, t, tier, reason), v in sorted(self.shed.items())
+                ],
+            }
+        out["hbm_headroom_bytes"] = self.hbm_headroom()
+        return out

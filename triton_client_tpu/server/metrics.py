@@ -21,8 +21,11 @@ dynamic-batcher batch accounting, flight-recorder watchdog counters,
 resilience/QoS series, the device & scheduler observability layer
 (``nv_tpu_*``: duty cycle, live MFU, XLA compile events, host<->device
 transfers, HBM, per-bucket tick/pad-waste series — ``device_stats.py``),
-the SLO burn-rate engine (``nv_slo_*``), and the closed-loop fleet
-layer (``nv_fleet_*``: live instance parallelism, serving version,
+the byte-accounted memory-admission layer (``nv_mem_*``: in-flight
+payload bytes, live budget, shed counts, HBM headroom —
+``memory.py``), the SLO burn-rate engine (``nv_slo_*``), and the
+closed-loop fleet layer (``nv_fleet_*``: live instance parallelism,
+serving version,
 autoscaler actuations, rolling updates, supervisor worker restarts —
 ``fleet.py``).  The *client* half of the
 observability subsystem renders separately — see
@@ -151,6 +154,24 @@ _FLEET_FAMILIES: List[Tuple[str, str, str, str]] = [
      "supervisor, per worker index (from the shared fleet state file)"),
 ]
 
+#: ``nv_mem_*`` family declarations, keyed by the short row names
+#: ``MemoryGovernor.metric_rows`` emits (server/memory.py).
+_MEM_FAMILIES: List[Tuple[str, str, str, str]] = [
+    ("inflight", "nv_mem_inflight_bytes", "gauge",
+     "Queued + in-flight request/response payload bytes currently held "
+     "per model in the memory governor's ledger"),
+    ("budget", "nv_mem_budget_bytes", "gauge",
+     "Live host byte budget admission is gated against (--mem-budget-"
+     "bytes scaled by any active mem_pressure chaos window; absent when "
+     "unbounded)"),
+    ("shed", "nv_mem_shed_total", "counter",
+     "Requests shed by the memory governor per model, tenant, tier and "
+     "reason (host = byte budget, hbm = projected-KV headroom gate)"),
+    ("hbm_headroom", "nv_mem_hbm_headroom_bytes", "gauge",
+     "Device HBM headroom (bytes_limit - bytes_in_use) per device — the "
+     "budget generation slot admission projects KV bytes against"),
+]
+
 #: ``nv_slo_*`` family declarations, keyed by ``SloEngine.metric_rows``.
 _SLO_FAMILIES: List[Tuple[str, str, str, str]] = [
     ("burn_rate", "nv_slo_burn_rate", "gauge",
@@ -271,6 +292,11 @@ def collect_families(core: InferenceCore) -> List[Family]:
     device_rows = core.device_stats.metric_rows()
     for key, name, kind, help_text in _DEVICE_FAMILIES:
         families.append((name, help_text, kind, device_rows.get(key, [])))
+
+    # -- byte-accounted memory admission (server/memory.py) ---------------
+    mem_rows = core.memory.metric_rows()
+    for key, name, kind, help_text in _MEM_FAMILIES:
+        families.append((name, help_text, kind, mem_rows.get(key, [])))
     slo_rows = core.slo.metric_rows()
     for key, name, kind, help_text in _SLO_FAMILIES:
         families.append((name, help_text, kind, slo_rows.get(key, [])))

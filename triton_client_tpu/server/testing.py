@@ -59,12 +59,16 @@ class ServerHarness:
         host: str = "127.0.0.1",
         tls=None,
         metrics_port: Optional[int] = None,
+        max_request_bytes: Optional[int] = None,
     ):
         self.registry = registry or ModelRegistry()
         self.core = InferenceCore(self.registry)
         self.host = host
         self.tls = tls
         self.metrics_port = metrics_port
+        # wire ingress cap for both frontends; None = the shared default
+        # (a bare harness is bounded exactly like a bare CLI serve)
+        self.max_request_bytes = max_request_bytes
         self.http_port = http_port or free_port()
         self.grpc_port = grpc_port or free_port()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -106,9 +110,14 @@ class ServerHarness:
         # warm before serving: first requests must not pay XLA compilation
         # for models that declare warmup samples (Triton model_warmup)
         await self.core.warmup_models()
+        from .memory import DEFAULT_MAX_REQUEST_BYTES
+
+        cap = (DEFAULT_MAX_REQUEST_BYTES if self.max_request_bytes is None
+               else self.max_request_bytes)
         runner, grpc_server, metrics_runner = await start_frontends(
             self.core, self.host, self.http_port, self.grpc_port,
-            tls=self.tls, metrics_port=self.metrics_port)
+            tls=self.tls, metrics_port=self.metrics_port,
+            max_request_bytes=cap)
         self._started.set()
         await self._stop_event.wait()
         await stop_frontends(runner, grpc_server, metrics_runner)

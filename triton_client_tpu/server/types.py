@@ -68,6 +68,12 @@ class InferRequest:
     # Which wire the request arrived on ("http" / "grpc"; "" for in-process
     # callers) — recorded per request by the flight recorder.
     protocol: str = ""
+    # Wire payload size (bytes) as received by the frontend (HTTP body
+    # length / gRPC message ByteSize; 0 for in-process callers).  The
+    # memory governor (server/memory.py) reserves this against the host
+    # byte budget at admission and releases it when the envelope
+    # completes.
+    wire_bytes: int = 0
     # Absolute deadline on the server's monotonic clock (0 = none).  The
     # frontends derive it from the v2 `timeout` request parameter
     # (microseconds; both protocols) or the `triton-timeout-us` HTTP
@@ -146,6 +152,10 @@ class InferError(Exception):
         super().__init__(msg)
         self.http_status = http_status
         self.retry_after_s = retry_after_s
+        # why admission refused this request ("memory" for byte-budget /
+        # HBM-headroom sheds) — stamped onto the flight record so an
+        # operator can tell memory sheds from queue-depth sheds
+        self.shed_reason: Optional[str] = None
 
 
 def apply_request_deadline(req: InferRequest,

@@ -5,7 +5,10 @@ Polls two HTTP surfaces — ``GET /metrics`` (the Triton-convention
 always-on flight recorder's live per-model quantiles + pinned outliers) —
 and renders one refreshing per-model table: QPS, p50/p99, queue share,
 realized batch, in-flight requests, error rate, watchdog counters, device
-duty cycle, the fleet columns (INST = live batcher instance parallelism,
+duty cycle, the memory-governor columns (MEM% = the model's share of the
+live byte budget from ``nv_mem_inflight_bytes`` / ``nv_mem_budget_bytes``,
+SHED/s = its memory-shed rate from ``nv_mem_shed_total``), the fleet
+columns (INST = live batcher instance parallelism,
 VER = the version unversioned traffic routes to), the SLO burn rate
 (with a ``!`` breach marker when both the 5m and 1h windows burn over
 the fast-burn threshold, and an autoscale-actuation marker beside it:
@@ -118,7 +121,9 @@ def parse_device(text: str) -> Dict[str, Any]:
     out: Dict[str, Any] = {"duty": {}, "mfu": {}, "burn": {}, "buckets": {},
                            "burn_threshold": 14.4,
                            "inst": {}, "ver": {}, "scale": {},
-                           "restarts": {}}
+                           "restarts": {},
+                           "mem_inflight": {}, "mem_budget": None,
+                           "mem_shed": {}}
     for line in text.splitlines():
         if line.startswith("#"):
             continue
@@ -130,6 +135,11 @@ def parse_device(text: str) -> Dict[str, Any]:
             # the server's configured page condition — the "!" breach
             # marker must agree with a non-default --slo-burn-threshold
             out["burn_threshold"] = float(value)
+            continue
+        if name == "nv_mem_budget_bytes":
+            # unlabeled live-budget gauge (shrinks under mem_pressure
+            # chaos) — the MEM% column's denominator
+            out["mem_budget"] = float(value)
             continue
         if name == "nv_fleet_worker_restart_total":
             # kept per worker: every worker of one supervised fleet
@@ -143,7 +153,8 @@ def parse_device(text: str) -> Dict[str, Any]:
             continue
         if name not in ("nv_tpu_duty_cycle", "nv_tpu_live_mfu",
                         "nv_slo_burn_rate", "nv_fleet_instances",
-                        "nv_fleet_serving_version", "nv_fleet_scale_total"
+                        "nv_fleet_serving_version", "nv_fleet_scale_total",
+                        "nv_mem_inflight_bytes", "nv_mem_shed_total"
                         ) and name not in _BUCKET_METRICS:
             continue
         labels = dict(_LABEL_RE.findall(labels_raw or ""))
@@ -163,6 +174,13 @@ def parse_device(text: str) -> Dict[str, Any]:
         elif name == "nv_fleet_scale_total":
             key = (model, labels.get("direction", ""))
             out["scale"][key] = out["scale"].get(key, 0.0) + float(value)
+        elif name == "nv_mem_inflight_bytes":
+            out["mem_inflight"][model] = float(value)
+        elif name == "nv_mem_shed_total":
+            # summed over (tenant, tier, reason): the SHED/s column is
+            # per model; the reason split stays on the metrics surface
+            out["mem_shed"][model] = (out["mem_shed"].get(model, 0.0)
+                                      + float(value))
         else:
             bucket = labels.get("bucket", "")
             entry = out["buckets"].setdefault((model, bucket), {})
@@ -309,6 +327,16 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "instances": int(inst) if inst is not None else None,
             "version": int(ver) if ver is not None else None,
             "scaled": scaled or None,
+            # memory governor (server/memory.py): this model's share of
+            # the live byte budget, and its memory-shed rate (cumulative
+            # on the first/only sample, like the other counters)
+            "mem_pct": (round(100.0 * device.get(
+                "mem_inflight", {}).get(model, 0.0)
+                / device["mem_budget"], 1)
+                if device.get("mem_budget") else None),
+            "mem_shed_per_s": (round(_mem_shed_delta(
+                device, pdevice, model) / dt, 1) if dt
+                else device.get("mem_shed", {}).get(model)),
             "burn_5m": round(burn5, 1) if burn5 is not None else None,
             "burn_1h": round(burn1h, 1) if burn1h is not None else None,
             # multi-window breach at the server's exported threshold
@@ -320,6 +348,19 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "last_outlier": _outlier_brief(last_outlier.get(model)),
         }
     return rows
+
+
+def _mem_shed_delta(device: Dict[str, Any],
+                    pdevice: Optional[Dict[str, Any]],
+                    model: str) -> float:
+    """Memory-shed counter movement between polls (cumulative fallback
+    on the first sample; post-restart resets clamp at the new value,
+    same contract as ``_delta``)."""
+    now = (device.get("mem_shed") or {}).get(model, 0.0)
+    if pdevice is None:
+        return now
+    d = now - (pdevice.get("mem_shed") or {}).get(model, 0.0)
+    return now if d < 0 else d
 
 
 def bucket_rows(cur: Dict[str, Any],
@@ -562,6 +603,10 @@ def aggregate_rows(per_url_rows: Dict[str, Dict[str, Dict[str, Any]]]
             # hottest/most-burning member, not the average)
             "duty_pct": _worst("duty_pct"),
             "mfu_pct": _worst("mfu_pct"),
+            # memory governor: MEM% = worst replica (the one nearest its
+            # budget pages first), shed rate sums like the other sheds
+            "mem_pct": _worst("mem_pct"),
+            "mem_shed_per_s": _sum("mem_shed_per_s"),
             "burn_5m": _worst("burn_5m"),
             "burn_1h": _worst("burn_1h"),
             "slo_breach": any(r.get("slo_breach") for r in rows),
@@ -592,7 +637,8 @@ def _fmt(v, nd: int = 1) -> str:
 
 _COLUMNS = (f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
             f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'REJ/s':>7}{'DLX/s':>7}"
-            f"{'SLOW':>6}{'CAPT':>6}{'DUTY%':>7}{'INST':>6}{'VER':>5}"
+            f"{'SLOW':>6}{'CAPT':>6}{'DUTY%':>7}{'MEM%':>7}{'SHED/s':>8}"
+            f"{'INST':>6}{'VER':>5}"
             f"{'BURN':>9}"
             f"  LAST OUTLIER")
 
@@ -625,6 +671,7 @@ def _row_line(label: str, r: Dict[str, Any]) -> str:
         f"{_fmt(r['error_pct'], 2):>7}{_fmt(r['rejected_per_s']):>7}"
         f"{_fmt(r['deadline_exceeded_per_s']):>7}{r['slow_total']:>6}"
         f"{r['captured_total']:>6}{_fmt(r.get('duty_pct')):>7}"
+        f"{_fmt(r.get('mem_pct')):>7}{_fmt(r.get('mem_shed_per_s')):>8}"
         f"{_fmt(r.get('instances')):>6}{_fmt(r.get('version')):>5}"
         f"{burn:>9}  {brief}")
 
