@@ -880,6 +880,130 @@ def _measure_gen_tick_breakdown() -> dict:
     return out
 
 
+def _measure_gen_trace_overhead() -> dict:
+    """Streaming-trace overhead A/B (ISSUE 15) — CPU-runnable on the tiny
+    preset: ``generate_stream`` token throughput with span tracing OFF vs
+    sampled ON at trace_rate=1 (EVERY stream traced — the worst case;
+    production sampling defaults 1000x sparser), plus the per-token
+    upload/sync counters proving the decode fast path is untouched: all
+    stream-trace recording is host-side at admission/resolve boundaries,
+    so a traced tick pays the same 1/T fused syncs and zero control
+    uploads as an untraced one."""
+    import gc
+    import tempfile
+    import urllib.request
+
+    from triton_client_tpu.genai_perf import profile_generate
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server.registry import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+
+    keys = ("TRITON_TPU_DECODE_MODE", "TRITON_TPU_DECODE_SLOTS",
+            "TRITON_TPU_PREFILL_CHUNK", "TRITON_TPU_DECODE_BUCKETS",
+            "TRITON_TPU_KV_QUANT", "TRITON_TPU_DECODE_STEPS")
+    saved = {k: os.environ.get(k) for k in keys}
+    CONC, N_REQ, N_TOK = 4, 12, 24
+    out: dict = {"trace_rate": 1, "concurrency": CONC,
+                 "output_tokens": N_TOK}
+    gc.collect()
+    for k in keys:
+        os.environ.pop(k, None)
+    os.environ["TRITON_TPU_DECODE_MODE"] = "batched"
+    os.environ["TRITON_TPU_DECODE_SLOTS"] = str(CONC)
+    try:
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        with ServerHarness(registry) as h:
+            url = f"127.0.0.1:{h.http_port}"
+            # compile warm off-clock (prefill + fused tick kernels)
+            profile_generate(url, "llama_generate", concurrency=1,
+                             output_tokens=2, num_requests=1,
+                             stream_timeout=1800.0)
+
+            def set_trace(settings):
+                req = urllib.request.Request(
+                    f"http://{url}/v2/trace/setting",
+                    data=json.dumps(settings).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=30).read()
+
+            def tick_counters():
+                snap = json.loads(urllib.request.urlopen(
+                    f"http://{url}/v2/debug/device_stats",
+                    timeout=30).read())
+                steps = syncs = uploads = 0
+                for b in snap["ticks"].get("llama_decode", {}).values():
+                    steps += b["steps"] or 0
+                    syncs += b["syncs"] or 0
+                    uploads += b["uploads"] or 0
+                return steps, syncs, uploads
+
+            def run_window(tag):
+                h.core.device_stats.reset()
+                rep = profile_generate(
+                    url, "llama_generate", concurrency=CONC,
+                    output_tokens=N_TOK, num_requests=N_REQ,
+                    stream_timeout=1800.0)
+                if rep["errors"]:
+                    out[f"{tag}_error"] = str(
+                        rep.get("first_error"))[:120]
+                    return None
+                steps, syncs, uploads = tick_counters()
+                return {
+                    "tok_per_s": round(
+                        rep["output_token_throughput_per_sec"], 1),
+                    # steps ~= decoded token positions; the regression
+                    # counters the fused fast path is gated on
+                    "syncs_per_tok": (round(syncs / steps, 3)
+                                      if steps else None),
+                    "uploads_per_tok": (round(uploads / steps, 3)
+                                        if steps else None),
+                }
+
+            # INTERLEAVED best-of-3 per arm (off, traced, off, traced,
+            # ...): back-to-back arms read host warm-up drift as a trace
+            # delta — alternating windows expose both arms to the same
+            # drift, and best-of soaks the remaining variance
+            tf = os.path.join(tempfile.mkdtemp(prefix="gen_trace_bench_"),
+                              "trace.jsonl")
+            off = traced = None
+            for _ in range(3):
+                set_trace({"trace_level": ["OFF"]})
+                w = run_window("off")
+                if w and (off is None
+                          or w["tok_per_s"] > off["tok_per_s"]):
+                    off = w
+                set_trace({"trace_file": [tf],
+                           "trace_level": ["TIMESTAMPS"],
+                           "trace_rate": ["1"]})
+                w = run_window("traced")
+                if w and (traced is None
+                          or w["tok_per_s"] > traced["tok_per_s"]):
+                    traced = w
+            if off is not None:
+                out["off"] = off
+            if traced is not None:
+                out["traced"] = traced
+            if off and traced and off["tok_per_s"]:
+                out["overhead_pct"] = round(
+                    100.0 * (1.0 - traced["tok_per_s"] / off["tok_per_s"]),
+                    1)
+            if traced is not None:
+                # count the traced window's records so the A/B provably
+                # exercised the stream-emit path
+                with open(tf) as f:
+                    out["traced_records"] = sum(1 for l in f if l.strip())
+    except Exception as e:  # noqa: BLE001 — bench keeps going without it
+        out["gen_trace_overhead_error"] = str(e)[:120]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def _measure_bert_int8() -> dict:
     """int8 BERT serving leg (r5): same sweep as _measure_bert_mfu but with
     TRITON_TPU_QUANT_BERT_LARGE=int8 in a FRESH harness (quantization is
@@ -1887,6 +2011,9 @@ def main() -> int:
     # decode-tick fast path (ISSUE 12): steps-per-dispatch A/B + per-token
     # host-overhead/upload/sync counters — CPU-runnable on the tiny preset
     gen_metrics["gen_tick_breakdown"] = _measure_gen_tick_breakdown()
+    # streaming-trace overhead (ISSUE 15): generate_stream tok/s with
+    # every stream traced vs tracing off, sync/upload counters unchanged
+    gen_metrics["gen_trace_overhead"] = _measure_gen_trace_overhead()
     # int8 BERT serving (r5): own harness, env-resolved at first inference
     bert_metrics.update(_measure_bert_int8())
     # cluster client: routing + hedged-tail A/Bs on a 3-replica fleet

@@ -923,6 +923,53 @@ class TestSpanPair:
             """)
         assert lint_dir(tmp_path, "SPAN-PAIR") == []
 
+    # -- streaming helpers: same pairing contract ------------------------
+
+    def test_stream_context_without_emit_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def serve_stream(self, model, request):
+                trace = self.tracer.maybe_start_stream(model.name, "1")
+                trace.record_chunk()
+                return 42
+            """)
+        found = lint_dir(tmp_path, "SPAN-PAIR")
+        assert len(found) == 1 and "emit" in found[0].message
+
+    def test_stream_shadow_without_emit_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            def arm(self, model):
+                trace = self.tracer.start_stream_shadow(model.name, "1")
+                trace.add_span("QUEUE", 0, 1)
+            """)
+        assert len(lint_dir(tmp_path, "SPAN-PAIR")) == 1
+
+    def test_stream_context_with_emit_passes(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def serve_stream(self, model, request):
+                trace = self.tracer.maybe_start_stream(model.name, "1")
+                try:
+                    return 42
+                finally:
+                    trace.emit()
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
+    def test_mark_failed_counts_as_completion(self, tmp_path):
+        write(tmp_path, "m.py", """
+            async def serve_stream(self, model, request, exc):
+                trace = self.tracer.maybe_start_stream(model.name, "1")
+                trace.mark_failed(exc)
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
+    def test_stream_escape_via_return_trusted(self, tmp_path):
+        write(tmp_path, "m.py", """
+            def start(self, model):
+                trace = self.tracer.maybe_start_stream(model.name, "1")
+                return trace
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
 
 # -- METRICS-DECL ------------------------------------------------------------
 

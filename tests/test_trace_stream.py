@@ -1,0 +1,480 @@
+"""Generation-path tracing (ISSUE 15): streaming trace contexts across the
+decoupled stream envelope, per-sequence lifecycle spans from the decode
+worker, and the tick<->sequence ``tick_seq`` join.
+
+The HTTP tests drive a real ``generate_stream`` SSE run in BATCHED decode
+mode (the continuous-batching path the tracing exists to illuminate); the
+core-level tests drive ``InferenceCore.infer_stream`` directly so cancel /
+error / SLO-shadow paths are deterministic rather than racing a socket.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from triton_client_tpu.server.types import (  # noqa: E402
+    InferError, InferRequest, InputTensor)
+
+# Batched decode mode must be set BEFORE the zoo registers (DecodeModel
+# reads it at construction).  A 2-token event stride makes short test
+# generations produce strided TOKEN[n] events (and ITL gaps) without
+# hundreds of tokens.
+_ENV = {
+    "TRITON_TPU_DECODE_MODE": "batched",
+    "TRITON_TPU_DECODE_SLOTS": "4",
+    "TRITON_TPU_TRACE_TOKEN_STRIDE": "2",
+}
+
+
+@pytest.fixture(scope="module")
+def _env():
+    saved = {k: os.environ.get(k) for k in _ENV}
+    os.environ.update(_ENV)
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+@pytest.fixture(scope="module")
+def server(_env):
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    with ServerHarness(registry) as h:
+        yield h
+
+
+def _set_trace(server, settings):
+    body = json.dumps(settings).encode()
+    req = urllib.request.Request(
+        f"http://{server.http_url}/v2/trace/setting", data=body,
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=30).read()
+
+
+@pytest.fixture(autouse=True)
+def _trace_off_after(server):
+    yield
+    _set_trace(server, {"trace_level": ["OFF"], "trace_count": ["-1"],
+                        "log_frequency": ["0"], "trace_rate": ["1000"]})
+
+
+def _stream(server, body, headers=None, timeout=300):
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(
+        f"http://{server.http_url}/v2/models/llama_generate/generate_stream",
+        data=json.dumps(body).encode(), headers=h)
+    frames = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for line in resp:
+            if line.startswith(b"data: "):
+                frames.append(json.loads(line[len(b"data: "):]))
+    return frames
+
+
+def _read_traces(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _spans_by_name(rec):
+    out = {}
+    for s in rec.get("spans", []):
+        out.setdefault(s["name"], []).append(s)
+    return out
+
+
+class TestStreamRecordShape:
+    def test_record_shape_spans_tokens_and_tick_join(self, server, tmp_path):
+        tf = tmp_path / "stream.jsonl"
+        _set_trace(server, {"trace_file": [str(tf)],
+                            "trace_level": ["TIMESTAMPS"],
+                            "trace_rate": ["1"]})
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        frames = _stream(server, {"text_input": "trace me", "max_tokens": 6},
+                         headers={"triton-request-id": "stream-rid-1",
+                                  "traceparent": tp})
+        assert len(frames) == 6
+        recs = _read_traces(tf)
+        assert len(recs) == 1
+        rec = recs[0]
+        # ONE record per stream with the full lifecycle
+        assert rec["model_name"] == "llama_generate"
+        assert rec["tokens"] == 6
+        assert rec["outcome"] == "ok"
+        # client join keys echoed (parity with unary infer)
+        assert rec["triton_request_id"] == "stream-rid-1"
+        assert rec["traceparent"] == tp
+        spans = _spans_by_name(rec)
+        for name in ("REQUEST", "QUEUE", "SLOT_WAIT", "PREFILL", "DECODE",
+                     "NETWORK_WRITE"):
+            assert name in spans, f"missing {name} span"
+        # lifecycle stages nest inside the REQUEST envelope and are ordered
+        root = spans["REQUEST"][0]
+        for name in ("QUEUE", "SLOT_WAIT", "PREFILL", "DECODE"):
+            s = spans[name][0]
+            assert root["start_ns"] <= s["start_ns"] <= s["end_ns"] \
+                <= root["end_ns"], name
+        assert spans["QUEUE"][0]["end_ns"] <= spans["SLOT_WAIT"][0]["end_ns"]
+        assert spans["SLOT_WAIT"][0]["end_ns"] <= spans["PREFILL"][0]["end_ns"]
+        assert spans["PREFILL"][0]["end_ns"] <= spans["DECODE"][0]["end_ns"]
+        # strided token timeline: FIRST_TOKEN plus TOKEN[n] at stride 2
+        names = [t["name"] for t in rec["timestamps"]]
+        assert "FIRST_TOKEN" in names
+        assert "TOKEN[2]" in names and "TOKEN[4]" in names
+        # tick join: >=1 tick entry whose tick_seq lands inside the tick
+        # profiler's recorded [first, last] window for the same bucket
+        assert rec.get("ticks"), "stream record carries no tick entries"
+        snap = json.loads(urllib.request.urlopen(
+            f"http://{server.http_url}/v2/debug/device_stats",
+            timeout=30).read())
+        rows = snap["ticks"]["llama_decode"]
+        joined = 0
+        for t in rec["ticks"]:
+            row = rows.get(str(t["bucket"]))
+            if row and row["first_tick_seq"] <= t["tick_seq"] \
+                    <= row["last_tick_seq"]:
+                joined += 1
+        assert joined >= 1
+
+    def test_single_token_stream_still_closes_decode(self, server,
+                                                     tmp_path):
+        """A generation whose whole budget resolves at prefill
+        (max_tokens=1) must still emit a closed DECODE span — it takes a
+        different resolver path than multi-tick streams."""
+        tf = tmp_path / "one.jsonl"
+        _set_trace(server, {"trace_file": [str(tf)],
+                            "trace_level": ["TIMESTAMPS"],
+                            "trace_rate": ["1"]})
+        frames = _stream(server, {"text_input": "one token",
+                                  "max_tokens": 1})
+        assert len(frames) == 1
+        recs = _read_traces(tf)
+        assert len(recs) == 1
+        spans = _spans_by_name(recs[0])
+        for name in ("QUEUE", "SLOT_WAIT", "PREFILL", "DECODE"):
+            assert name in spans, f"missing {name} span"
+        assert recs[0]["tokens"] == 1
+
+    def test_traced_stream_bytes_identical_to_untraced(self, server,
+                                                       tmp_path):
+        body = {"text_input": "determinism probe", "max_tokens": 8}
+        untraced = _stream(server, body)
+        tf = tmp_path / "ab.jsonl"
+        _set_trace(server, {"trace_file": [str(tf)],
+                            "trace_level": ["TIMESTAMPS"],
+                            "trace_rate": ["1"]})
+        traced = _stream(server, body)
+        # tracing must be an observer: the token stream (ids, text bytes,
+        # logprobs) is byte-identical with the recorder on
+        assert traced == untraced
+        assert len(_read_traces(tf)) == 1
+
+    def test_rotation_under_concurrent_stream_writers(self, server,
+                                                      tmp_path):
+        tf = tmp_path / "rot.jsonl"
+        _set_trace(server, {"trace_file": [str(tf)],
+                            "trace_level": ["TIMESTAMPS"],
+                            "trace_rate": ["1"],
+                            "log_frequency": ["1"]})
+        n = 3
+        errors = []
+
+        def run(i):
+            try:
+                _stream(server, {"text_input": f"writer {i}",
+                                 "max_tokens": 4})
+            except Exception as e:  # noqa: BLE001 — surfaced via assert
+                errors.append(str(e))
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors
+        # log_frequency=1 rotates every record; concurrent stream closes
+        # must land n well-formed records across <path>.0 .. <path>.{n-1}
+        recs = []
+        for i in range(n):
+            recs.extend(_read_traces(f"{tf}.{i}"))
+        assert len(recs) == n
+        assert all(r["tokens"] == 4 and r["outcome"] == "ok" for r in recs)
+
+    def test_grpc_stream_records_trace_with_join_key(self, server, tmp_path):
+        import triton_client_tpu.grpc as grpcclient
+        import queue
+
+        tf = tmp_path / "grpc_stream.jsonl"
+        _set_trace(server, {"trace_file": [str(tf)],
+                            "trace_level": ["TIMESTAMPS"],
+                            "trace_rate": ["1"]})
+        results: "queue.Queue" = queue.Queue()
+        with grpcclient.InferenceServerClient(server.grpc_url) as client:
+            client.start_stream(
+                callback=lambda result, error: results.put((result, error)))
+            inp = grpcclient.InferInput("text_input", [1], "BYTES")
+            inp.set_data_from_numpy(np.asarray([b"grpc trace"], dtype=object))
+            client.async_stream_infer(
+                "llama_generate", [inp], parameters={"max_tokens": 4},
+                enable_empty_final_response=True)
+            got = 0
+            while True:
+                r, e = results.get(timeout=300)
+                assert e is None, e
+                final = (r.get_response(as_json=True)
+                          .get("parameters", {})
+                          .get("triton_final_response", {})
+                          .get("bool_param", False))
+                out = r.as_numpy("text_output")
+                if out is not None and len(out):
+                    got += 1
+                if final:
+                    break
+            client.stop_stream()
+        assert got == 4
+        recs = _read_traces(tf)
+        assert len(recs) == 1
+        rec = recs[0]
+        # the stream-level trace metadata start_stream stamped lands in
+        # the record — join-key parity with unary gRPC infer
+        assert rec.get("triton_request_id")
+        assert rec.get("traceparent", "").startswith("00-")
+        assert rec["tokens"] == 4
+        spans = _spans_by_name(rec)
+        assert "SLOT_WAIT" in spans and "DECODE" in spans
+        assert "NETWORK_WRITE" in spans
+
+
+class TestSummaryAndChrome:
+    def _traced_run(self, server, tmp_path, n_streams=2, max_tokens=6):
+        tf = tmp_path / "view.jsonl"
+        _set_trace(server, {"trace_file": [str(tf)],
+                            "trace_level": ["TIMESTAMPS"],
+                            "trace_rate": ["1"]})
+        for i in range(n_streams):
+            _stream(server, {"text_input": f"view {i}",
+                             "max_tokens": max_tokens})
+        return _read_traces(tf)
+
+    def test_summary_reports_ttft_and_itl(self, server, tmp_path):
+        from triton_client_tpu.tools.trace_summary import (format_text,
+                                                           summarize)
+
+        recs = self._traced_run(server, tmp_path)
+        summary = summarize(recs)
+        gen = summary["models"]["llama_generate"]["generation"]
+        assert gen["streams"] == 2
+        assert gen["tokens"] == 12
+        assert gen["failed"] == 0 and gen["cancelled"] == 0
+        assert gen["ttft_us"]["count"] == 2
+        assert gen["ttft_us"]["p50_us"] > 0
+        assert gen["ttft_us"]["p99_us"] >= gen["ttft_us"]["p50_us"]
+        # stride 2 over 6 tokens -> >=2 ITL gap estimates per stream
+        assert gen["itl_us"]["count"] >= 2
+        assert gen["itl_us"]["p50_us"] >= 0
+        # lifecycle stages fold into the per-stage table too
+        stages = summary["models"]["llama_generate"]["stages"]
+        for name in ("QUEUE", "SLOT_WAIT", "PREFILL", "DECODE"):
+            assert stages[name]["count"] == 2
+        text = format_text(summary)
+        assert "generation: streams=2" in text
+        assert "TTFT us:" in text
+
+    def test_chrome_trace_joins_tick_and_sequence_lanes(self, server,
+                                                        tmp_path):
+        from triton_client_tpu.tools.trace_summary import chrome_trace
+
+        recs = self._traced_run(server, tmp_path)
+        out = chrome_trace(recs)
+        events = out["traceEvents"]
+        # a decode-worker process with tick lanes exists
+        pids = {e["args"]["name"]: e["pid"] for e in events
+                if e.get("ph") == "M" and e.get("name") == "process_name"}
+        assert "decode worker" in pids
+        tick_pid = pids["decode worker"]
+        tick_events = [e for e in events
+                       if e.get("pid") == tick_pid and e.get("ph") == "X"]
+        assert tick_events
+        tick_seqs = {e["args"]["tick_seq"] for e in tick_events}
+        # every tick span is unique (deduped across the sequences that
+        # rode it) and carries occupancy args
+        assert len(tick_seqs) == len(tick_events)
+        assert all("batch" in e["args"] and "bucket" in e["args"]
+                   for e in tick_events)
+        # sequence lanes: REQUEST spans carrying tick_seqs that actually
+        # exist in the tick lane, plus token instants
+        seq_spans = [e for e in events
+                     if e.get("pid") == 1 and e.get("ph") == "X"
+                     and e["name"] == "REQUEST"]
+        assert len(seq_spans) == 2
+        for e in seq_spans:
+            assert set(e["args"]["tick_seqs"]) <= tick_seqs
+        instants = [e for e in events if e.get("ph") == "i"]
+        assert any(e["name"] == "FIRST_TOKEN" for e in instants)
+        # one shared rebased clock: tick and sequence events interleave
+        # on the same axis (no negative timestamps)
+        assert all(e["ts"] >= 0 for e in events if "ts" in e)
+
+
+# -- core-level: cancel / error / SLO shadow --------------------------------
+
+
+def _gen_request(max_tokens=8, rid=""):
+    return InferRequest(
+        model_name="llama_generate",
+        inputs=[InputTensor("text_input", "BYTES", (1,),
+                            data=np.asarray([b"core probe"], dtype=object))],
+        parameters={"max_tokens": max_tokens},
+        client_request_id=rid,
+    )
+
+
+@pytest.fixture()
+def core(_env, tmp_path):
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.server.core import InferenceCore
+    from triton_client_tpu.server.registry import ModelRegistry
+
+    registry = ModelRegistry()
+    zoo.register_all(registry)
+    core = InferenceCore(registry)
+    core.trace_settings.update({
+        "trace_file": [str(tmp_path / "core.jsonl")],
+        "trace_level": ["TIMESTAMPS"],
+        "trace_rate": ["1"],
+    })
+    core.tracer.settings_updated()
+    yield core
+    core.tracer.shutdown()
+    # stop the decode worker this registry's DecodeModel spawned (each
+    # test builds a fresh core; leaked workers would pile up threads)
+    for name in ("llama_generate", "llama_decode"):
+        try:
+            registry.get(name).unload()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+
+
+class TestStreamClose:
+    def test_cancel_emits_failed_record(self, core, tmp_path):
+        async def run():
+            agen = core.infer_stream(_gen_request(max_tokens=16))
+            await agen.__anext__()   # first token flowed
+            await agen.aclose()      # consumer walks away
+            # let the producer notice the disconnect and finish while the
+            # loop is still alive (its call_soon_threadsafe handoffs need
+            # a live loop; the trace record already emitted at aclose)
+            await asyncio.sleep(0.3)
+
+        asyncio.run(run())
+        recs = _read_traces(tmp_path / "core.jsonl")
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["outcome"] == "cancelled"   # tellable from a drain...
+        assert rec["tokens"] >= 1              # partial timeline survives
+        assert "FIRST_TOKEN" in [t["name"] for t in rec["timestamps"]]
+        # ...but NOT an SLO/flight failure: the client walked away from a
+        # request that was serving fine (burn rates must not see it)
+        recent = core.flight_recorder.snapshot(
+            model="llama_generate")["recent"]
+        assert recent and recent[-1]["outcome"] == "ok"
+
+    def test_error_emits_failed_record(self, core, tmp_path):
+        async def run():
+            agen = core.infer_stream(
+                _gen_request(max_tokens="not a number"))
+            with pytest.raises(InferError):
+                await agen.__anext__()
+            await agen.aclose()
+
+        asyncio.run(run())
+        recs = _read_traces(tmp_path / "core.jsonl")
+        assert len(recs) == 1
+        assert "sampling parameter" in recs[0]["outcome"]
+        assert recs[0]["tokens"] == 0
+
+    def test_slo_breach_pins_stream_shadow(self, core, tmp_path):
+        from triton_client_tpu.server.device_stats import SloObjective
+
+        # tracing OFF: only the shadow path can capture the stream
+        core.trace_settings["trace_level"] = ["OFF"]
+        core.tracer.settings_updated()
+        # an unmeetable objective: every stream is SLO-bad, the model
+        # burns over threshold immediately
+        core.slo.set_objective(
+            "llama_generate", SloObjective(p99_ms=0.001))
+
+        async def run():
+            agen = core.infer_stream(_gen_request(max_tokens=4))
+            async for _ in agen:
+                pass
+
+        asyncio.run(run())
+        assert not os.path.exists(tmp_path / "core.jsonl")  # no sampling
+        assert core.slo.breach_pins.get("llama_generate", 0) >= 1
+        snap = core.flight_recorder.snapshot(model="llama_generate")
+        outliers = [r for r in snap["outliers"]
+                    if r["capture_reason"] == "slo_breach"]
+        assert outliers
+        # the shadow context carried the full stream lifecycle
+        names = {s["name"] for s in outliers[0]["spans"]}
+        assert {"REQUEST", "QUEUE", "SLOT_WAIT", "PREFILL",
+                "DECODE"} <= names
+
+
+class TestCurrentTraceInsideStreams:
+    def test_contextvar_visible_in_producer_thread(self, _env, tmp_path):
+        """ISSUE 15 satellite: ``current_trace()`` resolves inside the
+        decoupled producer (shm staging / server-log correlation) — it
+        was always None there before the envelope fix."""
+        from triton_client_tpu.server.core import InferenceCore
+        from triton_client_tpu.server.model import PyModel, make_config
+        from triton_client_tpu.server.registry import ModelRegistry
+        from triton_client_tpu.server.trace import current_trace
+
+        seen = []
+
+        def decoupled(inputs, parameters):
+            seen.append(current_trace() is not None)
+            for i in range(2):
+                yield {"OUT": np.asarray([i], np.int32)}
+
+        cfg = make_config(
+            "probe", inputs=[("IN", "INT32", [1])],
+            outputs=[("OUT", "INT32", [1])], decoupled=True)
+        registry = ModelRegistry()
+        registry.register_model(PyModel(cfg, lambda i, p: {}, decoupled))
+        core = InferenceCore(registry)
+        core.trace_settings.update({
+            "trace_file": [str(tmp_path / "probe.jsonl")],
+            "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"]})
+        core.tracer.settings_updated()
+        req = InferRequest(
+            model_name="probe",
+            inputs=[InputTensor("IN", "INT32", (1,),
+                                data=np.asarray([1], np.int32))])
+
+        async def run():
+            async for _ in core.infer_stream(req):
+                pass
+
+        asyncio.run(run())
+        core.tracer.shutdown()
+        assert seen == [True]
+        recs = _read_traces(tmp_path / "probe.jsonl")
+        assert len(recs) == 1 and recs[0]["tokens"] == 2

@@ -220,6 +220,8 @@ def _generate_worker(http_url, model_name, prompt_text, output_tokens,
         barrier.wait(timeout=60)
     except threading.BrokenBarrierError:
         pass
+    from ._telemetry import new_trace_context
+
     for req_i in range(n_requests):
         # per-request isolation: a transient failure counts one error and
         # the worker moves on to its remaining requests
@@ -228,9 +230,14 @@ def _generate_worker(http_url, model_name, prompt_text, output_tokens,
                 "text_input": f"{prompt_text} [w{worker_id} r{req_i}]",
                 "max_tokens": output_tokens,
             }).encode()
+            # trace propagation, same as unary infer: the server records
+            # the id/traceparent into the stream's trace record, so a
+            # traced load run joins per-request client and server views
+            headers = {"Content-Type": "application/json"}
+            headers.update(new_trace_context())
             req = urllib.request.Request(
                 f"http://{http_url}/v2/models/{model_name}/generate_stream",
-                data=body, headers={"Content-Type": "application/json"})
+                data=body, headers=headers)
             t_start = time.perf_counter()
             t_prev = None
             t_first = None

@@ -1048,6 +1048,11 @@ class DecodeModel:
         # worker-owned (single writer): slot cache + per-slot position
         self._k = self._v = None
         self._pos = None
+        # worker-owned monotonic fused-dispatch id: stamped on every
+        # tick-profiler row AND on each traced sequence's tick entries —
+        # the join key between "my request" and "the cohort dispatch it
+        # rode" in the trace viewer
+        self._tick_seq = 0
 
     @property
     def model(self):
@@ -1385,6 +1390,22 @@ class DecodeModel:
                 self._gen_reader.submit(info["sink"].put, err)
             self._auto_slots.clear()
 
+        def begin_prefill_trace(completion):
+            """Gen-path lifecycle spans (host-side, worker thread): the
+            moment this generation's prefill starts closes its SLOT_WAIT
+            stage (submit -> worker pickup, including slot allocation).
+            Idempotent across chunked-prefill continuations — only the
+            FIRST chunk opens the prefill window."""
+            if completion[0] != "gen":
+                return
+            sink = completion[2]
+            tr = getattr(sink, "trace", None)
+            if tr is None or getattr(sink, "t_prefill0", None) is not None:
+                return
+            now = time.monotonic_ns()
+            tr.add_span("SLOT_WAIT", sink.t_submit, now)
+            sink.t_prefill0 = now
+
         def finish_prefill(slot, gen, win_len, nxt_dev, best_dev, lp_dev,
                            completion):
             """Prefill finished: deliver the first token.  Sequence path
@@ -1407,6 +1428,17 @@ class DecodeModel:
                                      completion[1])
                 return
             _tag, n_tokens, sink = completion
+            tr = getattr(sink, "trace", None)
+            if tr is not None:
+                now = time.monotonic_ns()
+                if getattr(sink, "t_prefill0", None) is not None:
+                    # covers every chunk of a chunked prefill: opened at
+                    # the first chunk, closed when the final chunk's
+                    # dispatch returned
+                    tr.add_span("PREFILL", sink.t_prefill0, now)
+                # the DECODE stage opens here and closes when the last
+                # token resolves (or the consumer cancels)
+                sink.t_decode0 = now
             # self-feeding generation: activate the slot on device with
             # its feedback token and remaining budget — the fused tick
             # deactivates it on device when the budget drains
@@ -1444,6 +1476,14 @@ class DecodeModel:
             consumer's sink stream."""
             self._deactivate_slot(slot)
             self._release_gen_slot(slot)
+            # close the DECODE stage at the cancel point.  Best effort:
+            # the stream envelope's cancelled record usually emits before
+            # the worker notices the disconnect (the trace then shows the
+            # stage open-ended — its extent is still readable from the
+            # token timeline); the close matters when the reap wins the
+            # race, and it always keeps t_decode0 from leaking into a
+            # later occupant of the sink object
+            self._close_decode_span(sink)
             self._gen_reader.submit(sink.put, None)
 
         def gen_was_cancelled(slot, completion) -> bool:
@@ -1496,6 +1536,7 @@ class DecodeModel:
                     continue
                 if gen_was_cancelled(slot, completion):
                     continue
+                begin_prefill_trace(completion)
                 C = self._prefill_chunk
                 b, li = self._slot_bucket(slot)
                 with self._lock:
@@ -1743,6 +1784,9 @@ class DecodeModel:
                         self._release_gen_slot(slot)
                     gen_batch.append((li, slot, info["sink"], adv, done,
                                       info["gen"]))
+                t_done = time.monotonic_ns()
+                self._tick_seq += 1
+                tick_seq = self._tick_seq
                 ds = self._device_stats
                 if ds is not None and ds.enabled:
                     # one tick row per fused dispatch: steps-per-dispatch
@@ -1754,9 +1798,26 @@ class DecodeModel:
                         batch=len(w["batch"]) + len(w["gens"]),
                         padded=cnt, queue_depth=queue_depth,
                         assembly_ns=t_disp0 - t_asm0,
-                        compute_ns=time.monotonic_ns() - t_disp0,
+                        compute_ns=t_done - t_disp0,
                         requests=len(w["batch"]), syncs=1,
-                        steps=steps_run, uploads=uploads)
+                        steps=steps_run, uploads=uploads,
+                        tick_seq=tick_seq)
+                traced = [g for g in gen_batch
+                          if getattr(g[2], "trace", None) is not None]
+                if traced:
+                    # the dispatch this cohort rode, stamped onto every
+                    # traced member's stream record (host dispatch window
+                    # — the device may still be executing; the fused
+                    # readback is what resolves it)
+                    tick = {
+                        "tick_seq": tick_seq, "bucket": cap,
+                        "batch": len(w["batch"]) + len(w["gens"]),
+                        "padded": cnt, "steps": steps_run,
+                        "requests": len(w["batch"]),
+                        "start_ns": t_disp0, "end_ns": t_done,
+                    }
+                    for _li, _slot, sink, _adv, _done, _gen in traced:
+                        sink.trace.add_tick(tick)
                 # PIPELINE the readback: over a remote device the blocking
                 # D2H costs a full round trip; resolving it on a reader
                 # thread lets the next dispatch's compute start
@@ -1773,6 +1834,24 @@ class DecodeModel:
                 t_asm0 = time.monotonic_ns()
 
     @staticmethod
+    def _close_decode_span(sink) -> None:
+        """Close a traced generation's DECODE stage exactly once (the
+        last-token resolver and the worker's cancel path can race): the
+        per-sink lock makes the t_decode0 take atomic; whoever wins
+        records the span, the loser records nothing."""
+        import time
+
+        tr = getattr(sink, "trace", None)
+        lock = getattr(sink, "span_lock", None)
+        if tr is None or lock is None:
+            return
+        with lock:
+            t0 = getattr(sink, "t_decode0", None)
+            sink.t_decode0 = None
+        if t0 is not None:
+            tr.add_span("DECODE", t0, time.monotonic_ns())
+
+    @staticmethod
     def _resolve_prefill(pair, fut):
         try:
             vals = finish_readback(pair)
@@ -1785,6 +1864,12 @@ class DecodeModel:
             vals = finish_readback(pair_dev)
             sink.put((int(vals[0]), float(vals[1])))
             if done:
+                # a generation whose whole budget resolved at prefill
+                # (n_tokens == 1) ends here — its DECODE stage (opened at
+                # finish_prefill) must still close, however short.  Span
+                # BEFORE sentinel: the envelope emits the record the
+                # moment it sees stream-end
+                self._close_decode_span(sink)
                 sink.put(None)
         except Exception as e:  # noqa: BLE001 — surfaced via sink
             sink.put(e)
@@ -1821,6 +1906,11 @@ class DecodeModel:
             for t in range(n_emit):
                 sink.put((int(vals[0, t, li]), float(vals[2, t, li])))
             if done:
+                # last token host-resolved: the DECODE stage closes
+                # (resolver thread — host-side, no device sync added).
+                # Span BEFORE sentinel: the envelope emits the record the
+                # moment it sees stream-end
+                self._close_decode_span(sink)
                 sink.put(None)
 
     def _new_cache_arrays(self, cnt: int, cap: int, cfg):
@@ -1968,7 +2058,16 @@ class DecodeModel:
 
         import numpy as np
 
+        from ..server.trace import current_trace
         from ..server.types import InferError
+
+        # sequence-lifecycle tracing: the stream envelope's live context
+        # (the core copied its contextvar into this producer thread).  The
+        # submit stamp opens the SLOT_WAIT stage — everything from here
+        # until the worker starts the prefill is waiting, including the
+        # one-time lazy slab/compile init on a cold model.
+        tr = current_trace()
+        t_submit = time.monotonic_ns()
 
         # HBM-aware admission BEFORE the slab cache materializes: a slab
         # that doesn't fit the device headroom sheds typed (429,
@@ -2011,6 +2110,16 @@ class DecodeModel:
                 self._slot_pen_seed[slot] = (
                     float(freq_pen), float(pres_pen), row)
         sink: "_queue.Queue" = _queue.Queue()
+        # lifecycle-span plumbing rides the sink (worker + resolver
+        # threads never touch the contextvar): only stream contexts
+        # (add_tick) participate — a unary context has no token timeline
+        sink.trace = tr if hasattr(tr, "add_tick") else None
+        sink.t_submit = t_submit
+        sink.t_prefill0 = None
+        sink.t_decode0 = None
+        # guards the close-once take of t_decode0: the resolver's
+        # last-token path and the worker's cancel path can race
+        sink.span_lock = self._threading.Lock()
         self._jobs.put(("prefill",
                         (slot, gen, window, ("gen", n_tokens, sink)),
                         None))

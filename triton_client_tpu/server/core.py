@@ -19,6 +19,7 @@ thread-pool executor so the event loop keeps serving while XLA executes
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import threading
 import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Set, Tuple
@@ -881,33 +882,12 @@ class InferenceCore:
     ) -> InferResponse:
         from .trace import reset_current_trace, set_current_trace
 
-        trace = self.tracer.maybe_start(
-            model.name, request.model_version or "1",
-            client_request_id=request.client_request_id,
-            traceparent=request.traceparent)
-        recorder = self.flight_recorder
-        # SLO observation rides the flight-record pipeline: a model with an
-        # objective keeps records flowing even when the recorder itself is
-        # disabled (complete() then skips the ring/watchdog but still feeds
-        # the burn-rate windows and pins breaches) — --no-flight-recorder
-        # must not silently kill --slo
-        slo_watch = (recorder.slo_engine is not None
-                     and recorder.slo_engine.objective_for(model.name)
-                     is not None)
+        trace = self._arm_trace(
+            model, request, request.client_request_id,
+            self.tracer.maybe_start, self.tracer.start_shadow,
+            batched=model.max_batch_size > 0)
         if trace is None:
-            if not (recorder.enabled or slo_watch):
-                return await self._infer_traced(model, request, None)
-            # flight recorder arming: the sampler skipped this request, but
-            # the watchdog needs its span tree in case it lands slow — run
-            # the full instrumentation into a discard-on-fast-path context
-            trace = self.tracer.start_shadow(
-                model.name, request.model_version or "1",
-                client_request_id=request.client_request_id,
-                traceparent=request.traceparent)
-        if recorder.enabled or slo_watch:
-            trace.flight = recorder.start(
-                model.name, model.served_version, request,
-                batched=model.max_batch_size > 0)
+            return await self._infer_traced(model, request, None)
         trace.ts("REQUEST_START", request.arrival_ns)
         trace.ts("QUEUE_START", request.arrival_ns)
         # the root opens at the frontend's wire-receive time when stamped
@@ -1046,6 +1026,50 @@ class InferenceCore:
                                     ttl_s=_model_cache_ttl(model))
         return self._build_response(model, request, outputs)
 
+    def _arm_trace(self, model: Model, request: InferRequest, rid: str,
+                   start, shadow, batched: bool):
+        """Shared trace-arming policy for unary AND streaming envelopes:
+        a sampled context from ``start``, else a shadow one from
+        ``shadow`` when the flight recorder / an SLO objective needs the
+        span tree anyway, else None when nothing watches.  One
+        implementation so a future arming-policy change (a new pin
+        trigger, a recorder gate) cannot silently diverge per path."""
+        trace = start(model.name, request.model_version or "1",
+                      client_request_id=rid, traceparent=request.traceparent)
+        recorder = self.flight_recorder
+        # SLO observation rides the flight-record pipeline: a model with
+        # an objective keeps records flowing even when the recorder itself
+        # is disabled (complete() then skips the ring/watchdog but still
+        # feeds the burn-rate windows and pins breaches) —
+        # --no-flight-recorder must not silently kill --slo
+        slo_watch = (recorder.slo_engine is not None
+                     and recorder.slo_engine.objective_for(model.name)
+                     is not None)
+        if trace is None:
+            if not (recorder.enabled or slo_watch):
+                return None
+            # flight recorder arming: the sampler skipped this request,
+            # but the watchdog needs its span tree in case it lands slow
+            # (and for streams, an SLO-breaching generation must land in
+            # the recorder with its full lifecycle timeline)
+            trace = shadow(model.name, request.model_version or "1",
+                           client_request_id=rid,
+                           traceparent=request.traceparent)
+        if recorder.enabled or slo_watch:
+            trace.flight = recorder.start(
+                model.name, model.served_version, request, batched=batched)
+        return trace
+
+    def _start_stream_trace(self, model: Model, request: InferRequest):
+        """Arm the streaming trace envelope for a decoupled request.  The
+        per-request id joins on ``client_request_id`` like unary infer,
+        falling back to the wire ``id`` (gRPC bidi streams stamp trace
+        metadata once per stream but an id per request)."""
+        return self._arm_trace(
+            model, request, request.client_request_id or request.id,
+            self.tracer.maybe_start_stream, self.tracer.start_stream_shadow,
+            batched=False)
+
     async def infer_stream(self, request: InferRequest) -> AsyncIterator[InferResponse]:
         """Streaming inference: decoupled models yield 0..N responses then a
         final-flagged empty response; non-decoupled models yield exactly one
@@ -1060,19 +1084,30 @@ class InferenceCore:
         if not model.decoupled:
             yield await self._infer_on(model, request)
             return
+        # streaming trace envelope: opened here, held across the whole
+        # decoupled stream, emitted ONCE at close (drain, cancel, or error)
+        trace = self._start_stream_trace(model, request)
+        if trace is not None:
+            trace.ts("REQUEST_START", request.arrival_ns)
+            trace.ts("QUEUE_START", request.arrival_ns)
+            # NO wire-decode span here, deliberately: in STREAM records
+            # "DECODE" is the generation stage (first token -> last token,
+            # models/decode.py) — the stream frontends never stamp
+            # decode_*_ns, and a frontend that grows wire-decode timing
+            # must pick a different span name for it
+            trace.begin_root(request.arrival_ns)
         try:
             # the resilience gates apply to decoupled streams too: an
             # expired deadline is dropped before the producer ever starts,
-            # and chaos exercises the stream error path (no unary trace
-            # context here — decoupled requests are not flight-recorded)
+            # and chaos exercises the stream error path
             self._check_deadline(model, request)
             if self.chaos is not None:
-                await self._apply_chaos(model, None)
+                await self._apply_chaos(model, trace)
                 self._check_deadline(model, request)
             # pending gauge covers in-flight streams too, so graceful
             # drain waits for them and admission sees their occupancy
             model.stats.inc_pending()
-            agen = self._infer_stream_decoupled(model, request)
+            agen = self._infer_stream_decoupled(model, request, trace)
             try:
                 async for resp in agen:
                     yield resp
@@ -1082,16 +1117,55 @@ class InferenceCore:
                 # must run deterministically, not at GC time
                 await agen.aclose()
                 model.stats.dec_pending()
+        except BaseException as e:
+            if trace is not None:
+                if isinstance(e, (GeneratorExit, asyncio.CancelledError)):
+                    # consumer-initiated close (disconnect, stop sequence
+                    # satisfied): the trace record says "cancelled" with
+                    # its partial timeline, but the flight/SLO outcome
+                    # stays ok — the request was served as far as the
+                    # client wanted, and counting walk-aways as failures
+                    # would poison burn rates and fleet actions
+                    trace.mark_cancelled()
+                else:
+                    # real errors close the envelope as FAILED — the
+                    # record still emits below with its partial timeline
+                    reason = getattr(e, "shed_reason", None)
+                    if reason and trace.flight is not None:
+                        trace.flight.shed_reason = reason
+                    trace.mark_failed(e)
+            raise
         finally:
-            # _admit reserved the request's wire bytes; a stream holds
-            # them for its whole lifetime (streamed response chunks are
-            # not individually accounted)
-            self.memory.release(
-                model.name, request.tenant, request.wire_bytes)
+            try:
+                if trace is not None:
+                    # synchronous emit, deliberately: ONE append per
+                    # stream (not per request, so the unary path's
+                    # executor hop buys nothing here), and cancel-path
+                    # finalization often runs under task cancellation
+                    # (consumer disconnect) where an awaited hop would
+                    # itself be cancelled and lose the record.
+                    # Everything from the GeneratorExit injection to this
+                    # append is synchronous — a disconnect can never
+                    # strand a half-finalized stream trace.  On cancel
+                    # the record carries whatever the decode worker had
+                    # recorded by now (partial timeline; a still-running
+                    # worker may not have closed DECODE yet).
+                    trace.emit()
+            finally:
+                # _admit reserved the request's wire bytes; a stream
+                # holds them for its whole lifetime (streamed response
+                # chunks are not individually accounted).  Inner finally:
+                # an exception escaping emit (recorder/SLO pipeline — the
+                # file append itself swallows OSError) must not leak the
+                # reservation from the governor's ledger forever.
+                self.memory.release(
+                    model.name, request.tenant, request.wire_bytes)
 
     async def _infer_stream_decoupled(
-        self, model: Model, request: InferRequest
+        self, model: Model, request: InferRequest, trace=None
     ) -> AsyncIterator[InferResponse]:
+        from .trace import reset_current_trace, set_current_trace
+
         inputs = self._resolve_inputs(model, request)
         params = dict(request.parameters)
         loop = asyncio.get_running_loop()
@@ -1109,51 +1183,76 @@ class InferenceCore:
         attach_gov = getattr(model, "attach_memory_governor", None)
         if attach_gov is not None:
             attach_gov(self.memory)
-        sync_gen = model.execute_decoupled(inputs, params)
-
-        def _produce():
-            try:
-                try:
-                    for out in sync_gen:
-                        loop.call_soon_threadsafe(queue.put_nowait, out)
-                        if consumer_gone.is_set():
-                            break
-                finally:
-                    # close() raises GeneratorExit inside the model's
-                    # generator so it can cancel device work (e.g. free a
-                    # self-feeding decode slot) on consumer disconnect
-                    sync_gen.close()
-            except Exception as e:  # pragma: no cover - surfaced to stream
-                loop.call_soon_threadsafe(queue.put_nowait, e)
-            finally:
-                loop.call_soon_threadsafe(queue.put_nowait, _SENTINEL)
-
-        t0 = time.monotonic_ns()
-        producer = loop.run_in_executor(None, _produce)
-        count = 0
+        # current-trace contextvar set AROUND the whole stream (and reset
+        # in the finally): shm staging transfers, request-scoped server-log
+        # lines, and the decode worker's lifecycle spans all key off
+        # current_trace() — before this, streams always saw None there
+        token = set_current_trace(trace) if trace is not None else None
         try:
-            while True:
-                item = await queue.get()
-                if item is _SENTINEL:
-                    break
-                if isinstance(item, Exception):
-                    model.stats.record(1, 0, time.monotonic_ns() - t0, ok=False)
-                    raise item if isinstance(item, InferError) else InferError(str(item), 500)
-                count += 1
-                resp = self._build_response(model, request, item)
-                resp.parameters["triton_final_response"] = False
-                yield resp
-        except GeneratorExit:
-            # consumer closed the stream early (stop sequence, disconnect):
-            # the request was served — it must not vanish from statistics
+            sync_gen = model.execute_decoupled(inputs, params)
+
+            def _produce():
+                try:
+                    try:
+                        for out in sync_gen:
+                            loop.call_soon_threadsafe(queue.put_nowait, out)
+                            if consumer_gone.is_set():
+                                break
+                    finally:
+                        # close() raises GeneratorExit inside the model's
+                        # generator so it can cancel device work (e.g. free a
+                        # self-feeding decode slot) on consumer disconnect
+                        sync_gen.close()
+                except Exception as e:  # pragma: no cover - surfaced to stream
+                    loop.call_soon_threadsafe(queue.put_nowait, e)
+                finally:
+                    loop.call_soon_threadsafe(queue.put_nowait, _SENTINEL)
+
+            t0 = time.monotonic_ns()
+            if trace is not None:
+                # host-side queue stage of the stream lifecycle: wire
+                # arrival until the producer (the model's generation
+                # chain) starts executing
+                trace.add_span("QUEUE", request.arrival_ns, t0)
+            # run_in_executor does NOT propagate contextvars; copy the
+            # context explicitly so current_trace() resolves inside the
+            # producer thread (where the model generator actually runs)
+            ctx = contextvars.copy_context()
+            producer = loop.run_in_executor(None, ctx.run, _produce)
+            count = 0
+            try:
+                while True:
+                    item = await queue.get()
+                    if item is _SENTINEL:
+                        break
+                    if isinstance(item, Exception):
+                        model.stats.record(1, 0, time.monotonic_ns() - t0, ok=False)
+                        raise item if isinstance(item, InferError) else InferError(str(item), 500)
+                    count += 1
+                    resp = self._build_response(model, request, item)
+                    resp.parameters["triton_final_response"] = False
+                    if trace is not None:
+                        # strided token timeline (FIRST_TOKEN / TOKEN[n]);
+                        # the response carries the live context so the
+                        # frontend can record its NETWORK_WRITE spans —
+                        # emission stays owned by the stream envelope
+                        trace.record_chunk()
+                        resp.trace = trace
+                    yield resp
+            except GeneratorExit:
+                # consumer closed the stream early (stop sequence, disconnect):
+                # the request was served — it must not vanish from statistics
+                model.stats.record(1, 0, time.monotonic_ns() - t0, ok=True)
+                raise
+            finally:
+                # reached on aclose()/GeneratorExit too: tell the producer the
+                # consumer is gone so the model generator stops at its next token
+                consumer_gone.set()
+            await producer
             model.stats.record(1, 0, time.monotonic_ns() - t0, ok=True)
-            raise
         finally:
-            # reached on aclose()/GeneratorExit too: tell the producer the
-            # consumer is gone so the model generator stops at its next token
-            consumer_gone.set()
-        await producer
-        model.stats.record(1, 0, time.monotonic_ns() - t0, ok=True)
+            if token is not None:
+                reset_current_trace(token)
         final = InferResponse(
             model_name=model.name, model_version=model.served_version, id=request.id
         )

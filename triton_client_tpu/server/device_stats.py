@@ -135,7 +135,7 @@ class _BucketStats:
     __slots__ = ("ticks", "batch_total", "padded_total", "requests_total",
                  "assembly_ns_total", "queue_depth_total", "queue_depth_max",
                  "syncs_total", "compute_ns_total", "steps_total",
-                 "uploads_total")
+                 "uploads_total", "first_seq", "last_seq")
 
     def __init__(self) -> None:
         self.ticks = 0
@@ -149,6 +149,11 @@ class _BucketStats:
         self.compute_ns_total = 0
         self.steps_total = 0
         self.uploads_total = 0
+        # host-side dispatch sequence window (tick_seq): the join key a
+        # traced sequence's tick entries carry — a trace's tick_seq must
+        # land inside [first_seq, last_seq] of its (model, bucket) row
+        self.first_seq = 0
+        self.last_seq = 0
 
     def pad_waste(self) -> float:
         """Cumulative padded-but-unused fraction of executed batch slots."""
@@ -265,7 +270,7 @@ class DeviceStatsCollector:
     def record_tick(self, model: str, bucket: int, batch: int, padded: int,
                     queue_depth: int, assembly_ns: int, compute_ns: int = 0,
                     requests: int = 1, syncs: int = 0, steps: int = 1,
-                    uploads: int = 0) -> None:
+                    uploads: int = 0, tick_seq: int = 0) -> None:
         """Record one dynamic-batcher tick (one batched execution) or one
         decode-worker fused dispatch.
 
@@ -275,7 +280,10 @@ class DeviceStatsCollector:
         multi-step amortization the fused tick exists for).
         ``uploads``: host->device CONTROL-state uploads the dispatch
         paid (0 on the steady-state generation path — the regression
-        counter that proves per-tick control re-uploads stay gone)."""
+        counter that proves per-tick control re-uploads stay gone).
+        ``tick_seq``: the decode worker's monotonic dispatch id (0 = not
+        stamped, e.g. batcher ticks) — the same id each traced sequence's
+        tick entries carry, so trace records join back to these rows."""
         if not self.enabled:
             return
         with self._lock:
@@ -294,6 +302,10 @@ class DeviceStatsCollector:
             bs.compute_ns_total += int(compute_ns)
             bs.steps_total += int(steps)
             bs.uploads_total += int(uploads)
+            if tick_seq:
+                if not bs.first_seq:
+                    bs.first_seq = int(tick_seq)
+                bs.last_seq = max(bs.last_seq, int(tick_seq))
 
     def _prune_locked(self, cm: _ModelCompute, now: float) -> None:
         horizon = now - self.window_s
@@ -515,6 +527,8 @@ class DeviceStatsCollector:
                 "avg_steps_per_tick": (round(
                     bs.steps_total / bs.ticks, 2) if bs.ticks else None),
                 "uploads": bs.uploads_total,
+                "first_tick_seq": bs.first_seq or None,
+                "last_tick_seq": bs.last_seq or None,
             }
         return {
             "enabled": self.enabled,

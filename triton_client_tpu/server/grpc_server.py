@@ -565,16 +565,34 @@ class InferenceServicer:
                 enable_empty_final = bool(
                     req.parameters.get("triton_enable_empty_final_response", False)
                 )
-                async for resp in self._core.infer_stream(req):
-                    is_empty_final = (
-                        not resp.outputs
-                        and resp.parameters.get("triton_final_response") is True
-                    )
-                    if is_empty_final and not enable_empty_final:
-                        continue
-                    yield pb.ModelStreamInferResponse(
-                        infer_response=build_pb_response(resp)
-                    )
+                agen = self._core.infer_stream(req)
+                try:
+                    async for resp in agen:
+                        is_empty_final = (
+                            not resp.outputs
+                            and resp.parameters.get("triton_final_response") is True
+                        )
+                        if is_empty_final and not enable_empty_final:
+                            continue
+                        tr = resp.trace
+                        if tr is None:
+                            yield pb.ModelStreamInferResponse(
+                                infer_response=build_pb_response(resp)
+                            )
+                            continue
+                        # traced stream: proto encode + transport handoff
+                        # per flushed chunk, batched at the token stride
+                        # inside record_write
+                        t0 = time.monotonic_ns()
+                        yield pb.ModelStreamInferResponse(
+                            infer_response=build_pb_response(resp)
+                        )
+                        tr.record_write(t0, time.monotonic_ns())
+                finally:
+                    # deterministic close: a broken bidi transport must
+                    # reach the core's stream envelope (cancel accounting,
+                    # the stream trace record) now, not at GC time
+                    await agen.aclose()
             except InferError as e:
                 # the bidi wire has no per-message grpc code, so the
                 # status rides in-band as a "[NNN] " prefix — streaming

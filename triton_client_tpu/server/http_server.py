@@ -402,6 +402,11 @@ async def _build_generate(core, request):
     req = build_generate_request(model, name, version, body)
     req.protocol = "http"
     req.wire_bytes = len(raw)
+    # trace propagation on the generate surface too (join-key parity with
+    # /infer): a traced generate_stream record joins client telemetry on
+    # the same correlation id / traceparent unary requests use
+    req.client_request_id = request.headers.get(_REQUEST_ID_HDR, "")
+    req.traceparent = request.headers.get(_TRACEPARENT_HDR, "")
     _stamp_qos(req, request)
     return name, version, model, req
 
@@ -428,32 +433,39 @@ async def sse_stream(request, agen, write_frame, on_error, epilogue=None):
     anext() builtin: requires-python floor is 3.9).  ``write_frame(stream,
     resp)`` serializes each response; ``on_error(e) -> bytes`` formats a
     mid-stream InferError as an in-band frame; ``epilogue(stream)`` runs
-    after a clean drain (e.g. OpenAI's [DONE] terminator)."""
+    after a clean drain (e.g. OpenAI's [DONE] terminator).
+
+    Every exit closes ``agen`` deterministically: a consumer disconnect
+    must reach the core's stream envelope NOW (cancel accounting, the
+    stream trace record, decode-slot reclaim) rather than at GC time."""
     try:
-        first = await agen.__anext__()
-    except StopAsyncIteration:
-        first = None
-    stream = web.StreamResponse()
-    stream.headers["Content-Type"] = "text/event-stream"
-    stream.headers["Cache-Control"] = "no-cache"
-    await stream.prepare(request)
-    try:
-        if first is not None:
-            await write_frame(stream, first)
-        async for resp in agen:
-            await write_frame(stream, resp)
-        if epilogue is not None:
-            await epilogue(stream)
-    except InferError as e:
-        # mid-stream failure: headers are committed, deliver in-band
-        await stream.write(on_error(e))
-    except (ConnectionError, OSError, asyncio.CancelledError):
-        # client went away mid-stream — close quietly; re-raising would make
-        # the handler wrapper answer a second response on a transport the
-        # StreamResponse owns
+        try:
+            first = await agen.__anext__()
+        except StopAsyncIteration:
+            first = None
+        stream = web.StreamResponse()
+        stream.headers["Content-Type"] = "text/event-stream"
+        stream.headers["Cache-Control"] = "no-cache"
+        await stream.prepare(request)
+        try:
+            if first is not None:
+                await write_frame(stream, first)
+            async for resp in agen:
+                await write_frame(stream, resp)
+            if epilogue is not None:
+                await epilogue(stream)
+        except InferError as e:
+            # mid-stream failure: headers are committed, deliver in-band
+            await stream.write(on_error(e))
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            # client went away mid-stream — close quietly; re-raising would
+            # make the handler wrapper answer a second response on a
+            # transport the StreamResponse owns
+            return stream
+        await stream.write_eof()
         return stream
-    await stream.write_eof()
-    return stream
+    finally:
+        await agen.aclose()
 
 
 async def _generate_stream(core, request):
@@ -464,9 +476,18 @@ async def _generate_stream(core, request):
     async def write_frame(stream, resp):
         if not resp.outputs:
             return  # final-flagged empty frame ends decoupled streams
-        # precompiled envelope affixes: only the payload is encoded per
-        # event, not the whole "data: ...\n\n" frame re-formatted
+        tr = resp.trace
+        if tr is None:
+            # precompiled envelope affixes: only the payload is encoded per
+            # event, not the whole "data: ...\n\n" frame re-formatted
+            await stream.write(sse_frame(response_to_json(name, version, resp)))
+            return
+        # traced stream: each flushed chunk's serialize+write window lands
+        # as a NETWORK_WRITE span, batched at the token stride inside
+        # record_write (per-chunk spans would double the record size)
+        t0 = time.monotonic_ns()
         await stream.write(sse_frame(response_to_json(name, version, resp)))
+        tr.record_write(t0, time.monotonic_ns())
 
     return await sse_stream(
         request, core.infer_stream(req), write_frame,

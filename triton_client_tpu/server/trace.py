@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import contextvars
 import json
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -61,6 +62,29 @@ import time
 from .types import InferError
 
 _KNOWN_LEVELS = {"OFF", "TIMESTAMPS", "TENSORS", "PROFILE"}
+
+
+def token_event_stride(default: int = 8) -> int:
+    """``TRITON_TPU_TRACE_TOKEN_STRIDE``: every Nth generated token of a
+    traced stream gets a ``TOKEN[n]`` timestamp (the first token always
+    stamps ``FIRST_TOKEN``).  Strided, not per-token: a 2k-token traced
+    generation must not grow a 2k-entry timeline — the stride keeps the
+    record bounded while the (t[n+k]-t[n])/k differences still recover
+    ITL percentiles.  The same stride batches the frontends' per-chunk
+    ``NETWORK_WRITE`` spans.  Junk or non-positive values fall back to
+    the default (a bad env var must not break tracing)."""
+    try:
+        n = int(os.environ.get("TRITON_TPU_TRACE_TOKEN_STRIDE", default))
+    except ValueError:
+        return default
+    return n if n > 0 else default
+
+
+#: Per-stream tick entries kept on one trace record: a pathological
+#: million-token generation must not pin an unbounded tick list in host
+#: memory; past the cap the record keeps the first N (admission/TTFT end
+#: of the timeline) and counts the rest in ``ticks_dropped``.
+MAX_TICKS_PER_STREAM = 512
 
 #: The trace context of the request currently being served on this task (or
 #: thread, for synchronous helpers called from it).  Set by the core around a
@@ -176,7 +200,7 @@ class TraceContext:
     __slots__ = ("_tracer", "id", "model_name", "model_version",
                  "timestamps", "path", "client_request_id", "traceparent",
                  "spans", "log_frequency", "_root", "_done", "sampled",
-                 "flight", "tick")
+                 "flight", "tick", "outcome")
 
     def __init__(self, tracer: "RequestTracer", trace_id: int,
                  model_name: str, model_version: str, path: str,
@@ -204,6 +228,10 @@ class TraceContext:
         # request's batched execution rode — emitted with the trace so
         # trace_summary's buckets view can fold sampled traces by tick
         self.tick = None
+        # how the envelope closed: "ok", or the first failure's message
+        # (mark_failed) — streamed records emit it so a cancelled/errored
+        # generation is tellable from a drained one in the trace file
+        self.outcome = "ok"
 
     def ts(self, name: str, ns: Optional[int] = None) -> None:
         if not self.sampled:
@@ -250,12 +278,25 @@ class TraceContext:
             self._root.end(now)
 
     def mark_failed(self, exc: BaseException) -> None:
-        """Stamp the flight record's outcome from an exception.  First
-        failure wins — a frontend error after a core error must not
-        overwrite the root cause."""
+        """Stamp the context's (and flight record's) outcome from an
+        exception.  First failure wins — a frontend error after a core
+        error must not overwrite the root cause."""
+        msg = str(exc) or type(exc).__name__
+        if self.outcome == "ok":
+            self.outcome = msg
         rec = self.flight
         if rec is not None and rec.outcome == "ok":
-            rec.outcome = str(exc) or type(exc).__name__
+            rec.outcome = msg
+
+    def mark_cancelled(self) -> None:
+        """Consumer-initiated close (disconnect, stop sequence satisfied):
+        the TRACE record is stamped so a cancelled stream is tellable from
+        a drained one, but the flight/SLO outcome stays "ok" — the request
+        was served as far as the client wanted; counting client walk-aways
+        as failures would poison SLO burn rates and trigger false fleet
+        scale/rollback actions."""
+        if self.outcome == "ok":
+            self.outcome = "cancelled"
 
     async def emit_async(self) -> None:
         """Finalize from a coroutine: a sampled context pays the executor
@@ -282,6 +323,72 @@ class TraceContext:
             recorder = self._tracer.flight_recorder
             if recorder is not None:
                 recorder.complete(rec, self)
+
+
+class StreamTraceContext(TraceContext):
+    """One traced LONG-LIVED streaming request (decoupled gRPC stream /
+    ``generate_stream`` SSE): stays open across the whole stream envelope,
+    accumulates per-token timeline events and the decode ticks the
+    sequence rode, and emits ONE record at stream close (or cancel/error
+    via ``mark_failed`` — the record then carries ``outcome``).
+
+    Per-token events are STRIDED (``token_event_stride``): the first chunk
+    stamps ``FIRST_TOKEN``, then every Nth stamps ``TOKEN[n]`` — bounded
+    record size at any generation length, with ITL percentiles recoverable
+    from the strided differences.  ``ticks`` collects the decode worker's
+    per-dispatch ``tick_seq`` entries (see ``models/decode.py``), the join
+    key between this sequence's lane and the cohort-dispatch lane in the
+    ``trace_summary --format chrome`` view.
+
+    Thread model: ``record_chunk`` runs on the serving event loop (the
+    stream envelope), ``add_tick`` on the decode worker thread, and the
+    frontends' ``record_write`` back on the loop — list appends and
+    attribute stores are GIL-atomic, same discipline as ``Span.end``."""
+
+    __slots__ = ("stride", "token_count", "first_token_ns", "last_token_ns",
+                 "ticks", "ticks_dropped", "_writes")
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self.stride = token_event_stride()
+        self.token_count = 0
+        self.first_token_ns: Optional[int] = None
+        self.last_token_ns: Optional[int] = None
+        self.ticks: List[Dict[str, int]] = []
+        self.ticks_dropped = 0
+        self._writes = 0
+
+    def record_chunk(self, ns: Optional[int] = None) -> int:
+        """One streamed response chunk left the core: stamp the strided
+        token timeline.  Returns the chunk's 0-based index."""
+        now = int(ns if ns is not None else time.monotonic_ns())
+        n = self.token_count
+        self.token_count = n + 1
+        if n == 0:
+            self.first_token_ns = now
+            self.ts("FIRST_TOKEN", now)
+        elif n % self.stride == 0:
+            self.ts(f"TOKEN[{n}]", now)
+        self.last_token_ns = now
+        return n
+
+    def add_tick(self, tick: Dict[str, int]) -> None:
+        """The decode worker dispatched a fused tick this sequence rode
+        (worker thread).  Bounded: past MAX_TICKS_PER_STREAM the record
+        keeps the admission-end prefix and counts the overflow."""
+        if len(self.ticks) >= MAX_TICKS_PER_STREAM:
+            self.ticks_dropped += 1
+            return
+        self.ticks.append(tick)
+
+    def record_write(self, start_ns: int, end_ns: int) -> None:
+        """A frontend flushed one chunk to the wire.  Spans are batched at
+        the token stride — recording a NETWORK_WRITE span per token would
+        double the record's span count for no extra insight."""
+        n = self._writes
+        self._writes = n + 1
+        if n % self.stride == 0:
+            self.add_span("NETWORK_WRITE", start_ns, end_ns)
 
 
 class RequestTracer:
@@ -411,7 +518,8 @@ class RequestTracer:
 
     def maybe_start(self, model_name: str, model_version: str,
                     client_request_id: str = "",
-                    traceparent: str = "") -> Optional[TraceContext]:
+                    traceparent: str = "",
+                    cls: type = TraceContext) -> Optional[TraceContext]:
         with self._lock:
             ov = self._model_overrides.get(model_name)
             eff = self._settings if ov is None else {**self._settings, **ov}
@@ -442,22 +550,47 @@ class RequestTracer:
             trace_id = self._next_id
             path = self._trace_file(eff)
             log_frequency = max(0, self._eff_int(eff, "log_frequency", 0))
-        return TraceContext(self, trace_id, model_name, model_version, path,
-                            client_request_id, traceparent,
-                            log_frequency=log_frequency)
+        return cls(self, trace_id, model_name, model_version, path,
+                   client_request_id, traceparent,
+                   log_frequency=log_frequency)
+
+    def maybe_start_stream(self, model_name: str, model_version: str,
+                           client_request_id: str = "",
+                           traceparent: str = ""
+                           ) -> Optional[StreamTraceContext]:
+        """Sample a long-lived streaming request: same settings scope and
+        counters as ``maybe_start``, but the returned context stays open
+        across the whole decoupled stream (token timeline + tick joins)
+        and emits once at stream close."""
+        return self.maybe_start(model_name, model_version,
+                                client_request_id, traceparent,
+                                cls=StreamTraceContext)
 
     def start_shadow(self, model_name: str, model_version: str,
                      client_request_id: str = "",
-                     traceparent: str = "") -> TraceContext:
+                     traceparent: str = "",
+                     cls: type = TraceContext) -> TraceContext:
         """An armed-but-unsampled context for the flight recorder: the full
         span instrumentation runs so a tail-latency outlier can be captured
         retroactively, but nothing reaches the trace file and neither the
         sampling counters nor the file-unique id sequence move.  No lock:
         this runs on every request when the recorder is on."""
-        ctx = TraceContext(self, 0, model_name, model_version, "",
-                           client_request_id, traceparent)
+        ctx = cls(self, 0, model_name, model_version, "",
+                  client_request_id, traceparent)
         ctx.sampled = False
         return ctx
+
+    def start_stream_shadow(self, model_name: str, model_version: str,
+                            client_request_id: str = "",
+                            traceparent: str = "") -> StreamTraceContext:
+        """Shadow-arm a STREAM (flight recorder / SLO watch): the full
+        stream instrumentation — lifecycle spans, token timeline, tick
+        joins — runs so an SLO-breaching generation lands in the flight
+        recorder with its whole timeline, but nothing touches the trace
+        file."""
+        return self.start_shadow(model_name, model_version,
+                                 client_request_id, traceparent,
+                                 cls=StreamTraceContext)
 
     def _emit(self, ctx: TraceContext) -> None:
         record = {
@@ -481,6 +614,17 @@ class RequestTracer:
             # the batcher tick this request rode (bucket, occupancy, pad
             # waste, queue depth) — trace_summary folds these per bucket
             record["tick"] = ctx.tick
+        if isinstance(ctx, StreamTraceContext):
+            # stream records additionally carry the token count, the close
+            # outcome, and the decode ticks the sequence rode (tick_seq is
+            # the join key to the tick-profiler rows / the chrome view's
+            # decode-worker lane)
+            record["tokens"] = ctx.token_count
+            record["outcome"] = ctx.outcome
+            if ctx.ticks:
+                record["ticks"] = ctx.ticks
+            if ctx.ticks_dropped:
+                record["ticks_dropped"] = ctx.ticks_dropped
         # propagated client trace context: the join key between this record
         # and the client's telemetry (absent keys = request was not stamped)
         if ctx.client_request_id:

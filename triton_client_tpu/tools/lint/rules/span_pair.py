@@ -14,12 +14,18 @@ shape, not whole-program escape analysis):
 
 * a call to ``.begin_span(...)`` or ``.begin_root(...)`` requires
   completion evidence in the same function: a ``.end(...)`` /
-  ``.finish()`` / ``.emit()`` / ``.emit_async()`` call, or handoff
-  (``<resp>.trace = <ctx>`` / reading ``.trace_handoff``).
+  ``.finish()`` / ``.emit()`` / ``.emit_async()`` / ``.mark_failed(...)``
+  call, or handoff (``<resp>.trace = <ctx>`` / reading
+  ``.trace_handoff``).
 * a ``TraceContext`` obtained from ``maybe_start(...)`` /
-  ``start_shadow(...)`` and *assigned to a name* requires the same
-  completion evidence in the function — or the variable escaping as a
-  call argument / return value (handoff to the completing layer).
+  ``start_shadow(...)`` — or a streaming context from
+  ``maybe_start_stream(...)`` / ``start_stream_shadow(...)`` — and
+  *assigned to a name* requires the same completion evidence in the
+  function — or the variable escaping as a call argument / return value
+  (handoff to the completing layer).  The streaming helpers are held to
+  the same contract because a stream context that never reaches ``emit``
+  loses the WHOLE generation (every token event, every tick join) from
+  the trace file and the SLO pipeline, not just one request.
 """
 
 from __future__ import annotations
@@ -30,8 +36,12 @@ from .._ast_util import dotted_name, iter_body_nodes, iter_functions
 from .._engine import Finding, Project, register_rule
 
 _STARTERS_SPAN = {"begin_span", "begin_root"}
-_STARTERS_CTX = {"maybe_start", "start_shadow"}
-_CLOSERS = {"end", "finish", "emit", "emit_async"}
+_STARTERS_CTX = {"maybe_start", "start_shadow",
+                 "maybe_start_stream", "start_stream_shadow"}
+# mark_failed counts as completion: the streaming error paths stamp the
+# failure and the envelope's finally emits — in-function evidence of
+# either is the pairing this rule wants
+_CLOSERS = {"end", "finish", "emit", "emit_async", "mark_failed"}
 
 
 def _completion_evidence(fn: ast.AST) -> bool:

@@ -46,6 +46,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 SERVER_STAGES = (
     "DECODE",
     "QUEUE",
+    "SLOT_WAIT",
+    "PREFILL",
     "BATCH_ASSEMBLY",
     "H2D_TRANSFER",
     "COMPUTE",
@@ -102,6 +104,24 @@ def record_spans(rec: dict) -> List[Tuple[str, int, int]]:
     return out
 
 
+def token_events(rec: dict) -> List[Tuple[int, int]]:
+    """(token index, ns) pairs of a stream record's strided token
+    timeline: ``FIRST_TOKEN`` is index 0, ``TOKEN[n]`` is index n.  Sorted
+    by index; empty for unary records."""
+    out: List[Tuple[int, int]] = []
+    for t in rec.get("timestamps", []):
+        name = str(t.get("name", ""))
+        if name == "FIRST_TOKEN":
+            out.append((0, int(t["ns"])))
+        elif name.startswith("TOKEN[") and name.endswith("]"):
+            try:
+                out.append((int(name[len("TOKEN["):-1]), int(t["ns"])))
+            except ValueError:
+                continue
+    out.sort()
+    return out
+
+
 def percentile(sorted_vals: Sequence[float], p: float) -> float:
     """Nearest-rank percentile over an already-sorted sequence."""
     if not sorted_vals:
@@ -135,6 +155,11 @@ def summarize(server_records: List[dict],
     models: Dict[str, Dict[str, Any]] = {}
     per_model_stage: Dict[str, Dict[str, List[int]]] = {}
     per_model_request: Dict[str, List[int]] = {}
+    # per-model generation timeline stats (stream records: "tokens" +
+    # FIRST_TOKEN / strided TOKEN[n] events) — TTFT is first token vs the
+    # REQUEST root, ITL is recovered from the strided gaps as
+    # (t[n+k]-t[n])/k so any stride yields per-token estimates
+    per_model_gen: Dict[str, Dict[str, Any]] = {}
     # (model, bucket) -> accumulated tick fields (records that rode the
     # dynamic batcher carry a "tick" object: bucket chosen, occupancy,
     # pad waste, queue depth, assembly cost)
@@ -142,12 +167,32 @@ def summarize(server_records: List[dict],
     for rec in server_records:
         model = str(rec.get("model_name", "?"))
         stages = per_model_stage.setdefault(model, {})
+        root_start = None
         for name, start, end in record_spans(rec):
             dur = max(0, end - start)
             if name == "REQUEST":
                 per_model_request.setdefault(model, []).append(dur)
+                root_start = start
             else:
                 stages.setdefault(name, []).append(dur)
+        if "tokens" in rec:
+            g = per_model_gen.setdefault(model, {
+                "streams": 0, "tokens": 0, "failed": 0, "cancelled": 0,
+                "ttft": [], "itl": []})
+            g["streams"] += 1
+            g["tokens"] += int(rec.get("tokens") or 0)
+            outcome = str(rec.get("outcome", "ok"))
+            if outcome == "cancelled":
+                # consumer walked away mid-stream — served, not failed
+                g["cancelled"] += 1
+            elif outcome != "ok":
+                g["failed"] += 1
+            evs = token_events(rec)
+            if evs and root_start is not None:
+                g["ttft"].append(max(0, evs[0][1] - root_start))
+            for (n0, t0), (n1, t1) in zip(evs, evs[1:]):
+                if n1 > n0:
+                    g["itl"].append(max(0, (t1 - t0) // (n1 - n0)))
         tick = rec.get("tick")
         if isinstance(tick, dict) and "bucket" in tick:
             agg = per_bucket.setdefault((model, int(tick["bucket"])), {
@@ -179,6 +224,17 @@ def summarize(server_records: List[dict],
         if "QUEUE" in stage_out:
             entry["queue_share_pct"] = stage_out["QUEUE"]["share_pct"]
         models[model] = entry
+    for model, g in per_model_gen.items():
+        entry = models.setdefault(model, {"count": 0, "request":
+                                          _stage_stats([]), "stages": {}})
+        entry["generation"] = {
+            "streams": g["streams"],
+            "tokens": g["tokens"],
+            "failed": g["failed"],
+            "cancelled": g["cancelled"],
+            "ttft_us": _stage_stats(g["ttft"]),
+            "itl_us": _stage_stats(g["itl"]),
+        }
     for (model, bucket), agg in sorted(per_bucket.items()):
         entry = models.setdefault(model, {"count": 0, "request":
                                           _stage_stats([]), "stages": {}})
@@ -293,6 +349,18 @@ def format_text(summary: Dict[str, Any]) -> str:
             lines.append(
                 f"  queue share: "
                 f"{_fmt_val(entry['queue_share_pct'])}% of request time")
+        gen = entry.get("generation")
+        if gen:
+            ttft, itl = gen["ttft_us"], gen["itl_us"]
+            lines.append(
+                f"  generation: streams={gen['streams']} "
+                f"tokens={gen['tokens']} failed={gen['failed']} "
+                f"cancelled={gen['cancelled']}")
+            lines.append(
+                f"    TTFT us: p50 {_fmt_val(ttft['p50_us'])}  "
+                f"p99 {_fmt_val(ttft['p99_us'])}   "
+                f"ITL us: p50 {_fmt_val(itl['p50_us'])}  "
+                f"p99 {_fmt_val(itl['p99_us'])}")
         buckets = entry.get("buckets")
         if buckets:
             # the buckets view: which tick shapes the sampled requests
@@ -332,44 +400,118 @@ def chrome_trace(server_records: List[dict],
     """Chrome trace-event JSON (the object form: ``{"traceEvents": [...]}``)
     loadable in Perfetto / chrome://tracing.  Server and client records get
     separate pids (their monotonic clocks do not align); timestamps are
-    rebased per source so the view starts at t=0."""
+    rebased per source so the view starts at t=0.
+
+    Stream records additionally render:
+
+    * **token instants** (``FIRST_TOKEN`` / strided ``TOKEN[n]``) on the
+      sequence's own lane, and
+    * a **decode-worker pid** with one lane per (model, bucket) holding a
+      span per fused dispatch (deduped on ``tick_seq`` across the traced
+      sequences that rode it), occupancy in ``args``.
+
+    Sequence lanes and tick lanes join on ``tick_seq`` — each sequence
+    span carries its ``tick_seqs`` list, each tick span its ``tick_seq``
+    — so pad-waste and prefill/decode interleaving read visually."""
     events: List[dict] = [
         {"ph": "M", "name": "process_name", "pid": 1,
          "args": {"name": "server"}},
     ]
 
-    def emit(records, pid, tid_of, args_of):
-        starts = [s for rec in records for _, s, _ in record_spans(rec)]
-        base = min(starts) if starts else 0
-        for rec in records:
-            for name, start, end in record_spans(rec):
-                events.append({
-                    "name": name,
-                    "ph": "X",
-                    "ts": (start - base) / 1e3,       # microseconds
-                    "dur": max(0, end - start) / 1e3,
-                    "pid": pid,
-                    "tid": tid_of(rec),
-                    "cat": "server" if pid == 1 else "client",
-                    "args": args_of(rec),
-                })
+    # one shared base for EVERY server-side lane (request spans, token
+    # instants, decode ticks live on the same monotonic clock — rebasing
+    # them separately would break the visual tick<->sequence alignment
+    # this view exists for)
+    ticks: Dict[Tuple[str, int], dict] = {}
+    starts = []
+    for rec in server_records:
+        starts.extend(s for _, s, _ in record_spans(rec))
+        starts.extend(ns for _, ns in token_events(rec))
+        model = str(rec.get("model_name", ""))
+        for t in rec.get("ticks", []):
+            if "tick_seq" in t:
+                ticks.setdefault((model, int(t["tick_seq"])), t)
+    starts.extend(int(t.get("start_ns", 0)) for t in ticks.values())
+    base = min(starts) if starts else 0
 
-    emit(server_records, 1,
-         lambda rec: int(rec.get("id", 0)),
-         lambda rec: {"model": rec.get("model_name", ""),
-                      "request_id": rec.get("triton_request_id", "")})
+    for rec in server_records:
+        tid = int(rec.get("id", 0))
+        args: Dict[str, Any] = {
+            "model": rec.get("model_name", ""),
+            "request_id": rec.get("triton_request_id", "")}
+        seqs = sorted({int(t["tick_seq"]) for t in rec.get("ticks", [])
+                       if "tick_seq" in t})
+        if seqs:
+            args["tick_seqs"] = seqs
+        if "outcome" in rec:
+            args["outcome"] = rec["outcome"]
+        for name, start, end in record_spans(rec):
+            events.append({
+                "name": name,
+                "ph": "X",
+                "ts": (start - base) / 1e3,       # microseconds
+                "dur": max(0, end - start) / 1e3,
+                "pid": 1,
+                "tid": tid,
+                "cat": "server",
+                "args": args,
+            })
+        for n, ns in token_events(rec):
+            events.append({
+                "name": "FIRST_TOKEN" if n == 0 else f"TOKEN[{n}]",
+                "ph": "i",
+                "s": "t",                         # thread-scoped instant
+                "ts": (ns - base) / 1e3,
+                "pid": 1,
+                "tid": tid,
+                "cat": "server",
+                "args": {"token": n},
+            })
+
+    if ticks:
+        events.append({"ph": "M", "name": "process_name", "pid": 3,
+                       "args": {"name": "decode worker"}})
+        lanes: Dict[Tuple[str, int], int] = {}
+        for (model, seq), t in sorted(ticks.items()):
+            lane = lanes.setdefault((model, int(t.get("bucket", 0))),
+                                    len(lanes) + 1)
+            events.append({
+                "name": f"tick {seq}",
+                "ph": "X",
+                "ts": (int(t.get("start_ns", 0)) - base) / 1e3,
+                "dur": max(0, int(t.get("end_ns", 0))
+                           - int(t.get("start_ns", 0))) / 1e3,
+                "pid": 3,
+                "tid": lane,
+                "cat": "tick",
+                "args": {"model": model,
+                         **{k: t[k] for k in ("tick_seq", "bucket", "batch",
+                                              "padded", "steps", "requests")
+                            if k in t}},
+            })
+
     if client_records is not None:
         events.insert(1, {"ph": "M", "name": "process_name", "pid": 2,
                           "args": {"name": "client"}})
         tids: Dict[str, int] = {}
-
-        def client_tid(rec):
+        cstarts = [s for rec in client_records
+                   for _, s, _ in record_spans(rec)]
+        cbase = min(cstarts) if cstarts else 0
+        for rec in client_records:
             rid = str(rec.get("request_id", ""))
-            return tids.setdefault(rid, len(tids) + 1)
-
-        emit(client_records, 2, client_tid,
-             lambda rec: {"model": rec.get("model", ""),
-                          "request_id": rec.get("request_id", "")})
+            ctid = tids.setdefault(rid, len(tids) + 1)
+            for name, start, end in record_spans(rec):
+                events.append({
+                    "name": name,
+                    "ph": "X",
+                    "ts": (start - cbase) / 1e3,
+                    "dur": max(0, end - start) / 1e3,
+                    "pid": 2,
+                    "tid": ctid,
+                    "cat": "client",
+                    "args": {"model": rec.get("model", ""),
+                             "request_id": rid},
+                })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
