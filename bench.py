@@ -1152,6 +1152,68 @@ def _measure_tick_profiler_overhead(core, sweep, inputs_fn) -> dict:
     return {"tick_profiler_overhead": result}
 
 
+def _measure_cost_attribution_overhead(core, sweep, inputs_fn) -> dict:
+    """Cost-ledger fast-path cost: the same closed-loop window with the
+    always-on per-tenant attribution (ledger charge per execute + slot-
+    share arithmetic) recording vs disabled — the acceptance bar is <=1%
+    of headline c=8 throughput, with the usual ±20% single-window noise
+    caveat (negative = noise)."""
+    try:
+        on = sweep("simple", inputs_fn, concurrency=8,
+                   warmup_s=0.5, measure_s=2.0)
+        core.cost_ledger.enabled = False
+        try:
+            off = sweep("simple", inputs_fn, concurrency=8,
+                        warmup_s=0.5, measure_s=2.0)
+        finally:
+            core.cost_ledger.enabled = True
+    except Exception as e:  # noqa: BLE001 — observability leg never kills bench
+        core.cost_ledger.enabled = True
+        return {"cost_attribution_error": str(e)[:120]}
+    result = {
+        "enabled_infer_per_sec": on["infer_per_sec"],
+        "disabled_infer_per_sec": off["infer_per_sec"],
+        "enabled_p99_ms": on["p99_ms"],
+        "disabled_p99_ms": off["p99_ms"],
+    }
+    if off["infer_per_sec"]:
+        result["overhead_pct"] = round(
+            100.0 * (1.0 - on["infer_per_sec"] / off["infer_per_sec"]), 2)
+    errors = on["errors"] + off["errors"]
+    if errors:
+        result["errors"] = errors[:2]
+    return {"cost_attribution_overhead": result}
+
+
+def _cost_summary(core) -> dict:
+    """End-of-session cost observability snapshot: the roofline verdict
+    per (model, bucket) from XLA cost analysis and the per-tenant cost
+    ledger totals — the BENCH json's who-paid-for-the-device axis."""
+    out: dict = {}
+    try:
+        snap = core.device_stats.snapshot()
+        rooflines = {}
+        for model, per_bucket in (snap.get("ticks") or {}).items():
+            for bucket, bs in (per_bucket or {}).items():
+                roof = (bs or {}).get("roofline")
+                if roof:
+                    rooflines[f"{model}@{bucket}"] = {
+                        "verdict": roof.get("verdict"),
+                        "arithmetic_intensity": roof.get(
+                            "arithmetic_intensity"),
+                        "pct_of_peak": roof.get("pct_of_peak"),
+                    }
+        if rooflines:
+            out["rooflines"] = rooflines
+    except Exception as e:  # noqa: BLE001 — observability leg never kills bench
+        out["roofline_error"] = str(e)[:120]
+    try:
+        out["cost_attribution"] = core.cost_ledger.snapshot()
+    except Exception as e:  # noqa: BLE001
+        out["cost_attribution_error"] = str(e)[:120]
+    return out
+
+
 def _device_stats_summary(core) -> dict:
     """Utilization trajectory from the live device-stats collector at the
     end of the serving legs: duty cycle / live MFU (worst-case: the
@@ -1927,6 +1989,10 @@ def main() -> int:
     # (acceptance: <=1% of the headline c=8 throughput)
     tick_overhead = _measure_tick_profiler_overhead(
         harness.core, sweep, simple_inputs)
+    # cost-ledger A/B: per-tenant device-time attribution on vs off
+    # (acceptance: <=1% of the headline c=8 throughput)
+    cost_overhead = _measure_cost_attribution_overhead(
+        harness.core, sweep, simple_inputs)
     # resilience-layer A/B: RetryPolicy-wrapped vs plain infer on the
     # happy path (target <1% overhead; no faults injected here)
     resilience_overhead = _measure_resilience_overhead(sweep, simple_inputs)
@@ -1996,6 +2062,9 @@ def main() -> int:
     # collector's windows/ticks now reflect the whole session): duty
     # cycle, live MFU, pad-waste — the perf trajectory's efficiency axis
     device_summary = _device_stats_summary(harness.core)
+    # cost observability snapshot, same point in the session: roofline
+    # verdicts per (model, bucket) + the per-tenant attribution totals
+    cost_summary = _cost_summary(harness.core)
 
     rtt_floor_ms = _measure_rtt_floor()
     harness.stop()
@@ -2081,6 +2150,10 @@ def main() -> int:
     # device-stats layer: tick-profiler on/off delta + utilization summary
     out.update(tick_overhead)
     out.update(device_summary)
+    # cost observability: ledger on/off delta + roofline verdicts and the
+    # per-tenant attribution snapshot
+    out.update(cost_overhead)
+    out.update(cost_summary)
     # client resilience layer: retry-wrapped vs plain happy-path delta
     out.update(resilience_overhead)
     # cluster routing + hedging tail: the client-side fleet layer's numbers

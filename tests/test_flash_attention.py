@@ -7,6 +7,7 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
+from triton_client_tpu import parallel  # noqa: E402
 from triton_client_tpu.ops import (  # noqa: E402
     flash_attention,
     flash_attention_reference,
@@ -113,7 +114,7 @@ def test_matches_ring_attention_single_shard():
     mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("sp",))
     from jax.sharding import PartitionSpec as P
 
-    ring = jax.shard_map(
+    ring = parallel.shard_map(
         lambda q, k, v: tr._ring_attention(q, k, v, cfg),
         mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
         check_vma=False,

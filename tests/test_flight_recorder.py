@@ -281,7 +281,7 @@ _RECORD_KEYS = {"seq", "request_id", "model", "version", "protocol",
                 "batch", "bytes_in", "bytes_out", "ts", "queue_us",
                 "compute_us", "total_us", "outcome", "captured",
                 "capture_reason", "chaos", "tenant", "tier", "tick",
-                "shed_reason"}
+                "shed_reason", "cost"}
 _TOP_LEVEL_KEYS = {"enabled", "capture_slower_than", "ring_capacity",
                    "outlier_capacity", "recorded_total", "models",
                    "recent", "outliers"}
@@ -535,7 +535,7 @@ class TestTritonTop:
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
         assert set(out) == {"url", "ts", "models", "tenants", "buckets",
-                            "worker_restarts", "recorder"}
+                            "costs", "worker_restarts", "recorder"}
         row = out["models"]["simple"]
         assert {"qps", "p50_ms", "p99_ms", "queue_share_pct", "batch_avg",
                 "pending", "error_pct", "rejected_per_s",

@@ -410,6 +410,91 @@ class TestMemorySurface:
             gov.hbm_stats_fn = DeviceStatsCollector.hbm_stats
 
 
+class TestCostSurface:
+    """The nv_cost_* families (server/costs.py) parse under the
+    exposition grammar, are typed, survive adversarial tenant names,
+    fold unbounded tenant cardinality into ~overflow, and round-trip
+    through the JSON snapshot."""
+
+    EVIL_TENANT = 'evil"tenant\\with\nnewline'
+
+    def _drive_costs(self, server):
+        ledger = server.core.cost_ledger
+        ledger.reset()
+        ledger.charge("simple", self.EVIL_TENANT, device_us=1500.0,
+                      flops=2.0e9, tokens=3, kv_byte_seconds=4.5)
+        ledger.charge("simple", "", device_us=250.0, tokens=1)
+        return ledger
+
+    def test_families_typed_escaped_and_round_trip(self, server):
+        from triton_client_tpu.server.metrics import snapshot
+
+        ledger = self._drive_costs(server)
+        try:
+            families = assert_conformant(_scrape(server.http_url))
+            for fam in ("nv_cost_device_us_total", "nv_cost_flops_total",
+                        "nv_cost_tokens_total",
+                        "nv_cost_kv_byte_seconds_total"):
+                assert families[fam]["type"] == "counter", fam
+
+            def unescape(v):
+                return (v.replace("\\n", "\n").replace('\\"', '"')
+                        .replace("\\\\", "\\"))
+
+            dev = {(l["model"], unescape(l["tenant"])): v for _, l, v in
+                   families["nv_cost_device_us_total"]["samples"]}
+            assert dev[("simple", self.EVIL_TENANT)] == 1500.0
+            # anonymous traffic is a first-class row (tenant ""), not a
+            # dropped one — the conservation contract needs it
+            assert dev[("simple", "")] == 250.0
+            toks = {(l["model"], unescape(l["tenant"])): v for _, l, v in
+                    families["nv_cost_tokens_total"]["samples"]}
+            assert toks[("simple", self.EVIL_TENANT)] == 3.0
+            # every family carries the SAME label keys on every sample
+            for fam in ("nv_cost_device_us_total", "nv_cost_flops_total",
+                        "nv_cost_tokens_total",
+                        "nv_cost_kv_byte_seconds_total"):
+                for _, l, _ in families[fam]["samples"]:
+                    assert set(l) == {"model", "tenant"}, fam
+            # JSON snapshot parity: same families, types, values
+            snap = snapshot(server.core)
+            for fam in ("nv_cost_device_us_total", "nv_cost_flops_total",
+                        "nv_cost_tokens_total",
+                        "nv_cost_kv_byte_seconds_total"):
+                assert snap[fam]["type"] == families[fam]["type"], fam
+            snap_dev = {(s["labels"]["model"], s["labels"]["tenant"]):
+                        s["value"]
+                        for s in snap["nv_cost_device_us_total"]["samples"]}
+            assert snap_dev[("simple", self.EVIL_TENANT)] == 1500.0
+        finally:
+            ledger.reset()
+
+    def test_overflow_tenant_folding(self, server):
+        ledger = self._drive_costs(server)
+        saved_max = ledger.MAX_TRACKED_TENANTS
+        ledger.MAX_TRACKED_TENANTS = 4
+        try:
+            # a client minting tenant ids must not grow the label set
+            # without bound: beyond the cap, new tenants fold
+            for i in range(10):
+                ledger.charge("simple", f"minted-{i}", device_us=10.0,
+                              tokens=1)
+            families = assert_conformant(_scrape(server.http_url))
+            tenants = {l["tenant"] for _, l, _ in
+                       families["nv_cost_device_us_total"]["samples"]}
+            assert "~overflow" in tenants
+            assert len(tenants) <= 4 + 1  # cap + the overflow row
+            dev = {l["tenant"]: v for _, l, v in
+                   families["nv_cost_device_us_total"]["samples"]}
+            # the folded rows kept every charge (8 minted tenants folded)
+            assert dev["~overflow"] == 80.0
+            # totals see through the folding — nothing is dropped
+            assert ledger.totals("simple")["tokens"] == 4 + 10
+        finally:
+            ledger.MAX_TRACKED_TENANTS = saved_max
+            ledger.reset()
+
+
 class TestFleetSurface:
     """The nv_fleet_* families parse under the exposition grammar, are
     typed, carry their full label sets, and round-trip through the JSON

@@ -72,7 +72,7 @@ class TestRingAttention:
         q, k, v = (jnp.asarray(rng.standard_normal((B, H, S, K)),
                                jnp.float32) for _ in range(3))
 
-        ring = jax.jit(jax.shard_map(
+        ring = jax.jit(parallel.shard_map(
             lambda q, k, v: parallel.ring_attention(q, k, v, "sp",
                                                     causal=causal),
             mesh=mesh,
@@ -103,7 +103,7 @@ class TestGradSync:
                 grads, specs, ("dp", "tp"))
             return synced["w"], synced["b"]
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(parallel.shard_map(
             body, mesh=mesh,
             in_specs=(P(None, "tp"), P(None)),
             out_specs=(P(None, "tp"), P(None)),

@@ -72,6 +72,44 @@ _STREAMING_METHODS = frozenset({
 })
 
 
+def merge_cost_snapshots(snapshots: Iterable[dict]) -> dict:
+    """Sum per-(model, tenant) cost snapshots from several replicas into
+    one fleet view — counters (device_us/flops/tokens/kv_byte_seconds)
+    add across processes.  Local to the client package on purpose: the
+    clients must not import the server package (same shape as
+    ``server/costs.py``'s merge; both sides are pinned by tests)."""
+    merged: Dict[str, Dict[str, Dict[str, float]]] = {}
+    enabled = False
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        enabled = enabled or bool(snap.get("enabled"))
+        models = snap.get("models")
+        if not isinstance(models, dict):
+            continue
+        for model, tenants in models.items():
+            if not isinstance(tenants, dict):
+                continue
+            dst_m = merged.setdefault(model, {})
+            for tenant, cell in tenants.items():
+                if not isinstance(cell, dict):
+                    continue
+                dst = dst_m.setdefault(tenant, {
+                    "device_us": 0.0, "flops": 0.0, "tokens": 0,
+                    "kv_byte_seconds": 0.0})
+                for key in ("device_us", "flops", "kv_byte_seconds"):
+                    try:
+                        dst[key] = round(dst[key] + float(cell.get(key, 0.0)),
+                                         6)
+                    except (TypeError, ValueError):
+                        pass
+                try:
+                    dst["tokens"] += int(cell.get("tokens", 0))
+                except (TypeError, ValueError):
+                    pass
+    return {"enabled": enabled, "models": merged}
+
+
 class ClusterClient(InferenceServerClientBase):
     """v2 client over a fleet of endpoints (sync; http or grpc).
 
@@ -380,6 +418,25 @@ class ClusterClient(InferenceServerClientBase):
         if first_error is not None:
             raise first_error
         return None if first_result is _UNSET else first_result
+
+    def get_costs(self, model_name=None, **kwargs) -> dict:
+        """Fleet-wide per-tenant cost attribution: every endpoint's
+        ``/v2/debug/costs`` ledger, summed per (model, tenant).  All
+        endpoints are attempted; the first failure (if any) is re-raised
+        after, like the control-plane broadcast — a silently missing
+        replica would understate the fleet's spend."""
+        snaps: List[dict] = []
+        first_error: Optional[BaseException] = None
+        for ep in self._pool.endpoints:
+            try:
+                snaps.append(self._client_for(ep).get_costs(
+                    model_name=model_name, **kwargs))
+            except Exception as e:  # noqa: BLE001 — collected, re-raised
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+        return merge_cost_snapshots(snaps)
 
     def __getattr__(self, name: str):
         # only reached when normal lookup fails; underscore lookups must

@@ -21,7 +21,8 @@ from .._resilience import RetryPolicy, call_with_retry_async
 from .._telemetry import telemetry
 from ..utils import raise_error
 from ._client import (_BROADCAST_METHODS, _HEALTH_METHODS,
-                      _METADATA_METHODS, _STREAMING_METHODS)
+                      _METADATA_METHODS, _STREAMING_METHODS,
+                      merge_cost_snapshots)
 from ._policy import HedgePolicy
 from ._pool import Endpoint, EndpointPool
 
@@ -230,6 +231,23 @@ class ClusterClient(InferenceServerClientBase):
         if first_error is not None:
             raise first_error
         return None if first_result is _UNSET else first_result
+
+    async def get_costs(self, model_name=None, **kwargs) -> dict:
+        """Fleet-wide per-tenant cost attribution: every endpoint's
+        ``/v2/debug/costs`` ledger, summed per (model, tenant) — the
+        async mirror of the sync cluster client's fan-out."""
+        snaps: List[dict] = []
+        first_error: Optional[BaseException] = None
+        for ep in self._pool.endpoints:
+            try:
+                snaps.append(await self._client_for(ep).get_costs(
+                    model_name=model_name, **kwargs))
+            except Exception as e:  # noqa: BLE001 — collected, re-raised
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+        return merge_cost_snapshots(snaps)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

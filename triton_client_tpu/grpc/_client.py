@@ -492,6 +492,24 @@ class InferenceServerClient(InferenceServerClientBase):
         except grpc.RpcError as e:
             raise_error_grpc(e)
 
+    def get_costs(self, model_name=None, headers=None,
+                  client_timeout=None) -> dict:
+        """The server's per-tenant cost-attribution ledger (device-time,
+        FLOPs, generated tokens, KV byte-seconds per model and tenant)
+        — same JSON shape as HTTP's GET /v2/debug/costs."""
+        import json
+
+        from ..protocol import debug_pb2 as pb_debug
+
+        try:
+            response = self._client_stub.Costs(
+                pb_debug.CostsRequest(model_name=model_name or ""),
+                metadata=self._get_metadata(headers), timeout=client_timeout,
+            )
+            return json.loads(response.payload_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
     # -- shared memory -----------------------------------------------------
     def get_system_shared_memory_status(
         self, region_name="", headers=None, as_json=False, client_timeout=None

@@ -488,6 +488,20 @@ class InferenceServerClient(InferenceServerClientBase):
 
         return json.loads(response.data)
 
+    def get_costs(self, model_name=None, headers=None,
+                  query_params=None) -> dict:
+        """The server's per-tenant cost-attribution ledger: device-time,
+        FLOPs, generated tokens, and KV byte-seconds per (model, tenant)
+        — GET /v2/debug/costs."""
+        params = dict(query_params or {})
+        if model_name:
+            params["model"] = model_name
+        response = self._get("v2/debug/costs", headers, params or None)
+        raise_if_error(response.status, response.data)
+        import json
+
+        return json.loads(response.data)
+
     # -- shared memory (reference :945-1203) -------------------------------
     def get_system_shared_memory_status(
         self, region_name="", headers=None, query_params=None

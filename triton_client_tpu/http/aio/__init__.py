@@ -380,6 +380,19 @@ class InferenceServerClient(InferenceServerClientBase):
         raise_if_error(status, body)
         return json.loads(body)
 
+    async def get_costs(self, model_name=None, headers=None,
+                        query_params=None) -> dict:
+        """The server's per-tenant cost-attribution ledger: device-time,
+        FLOPs, generated tokens, and KV byte-seconds per (model, tenant)
+        — GET /v2/debug/costs."""
+        params = dict(query_params or {})
+        if model_name:
+            params["model"] = model_name
+        status, _, body = await self._get(
+            "v2/debug/costs", headers, params or None)
+        raise_if_error(status, body)
+        return json.loads(body)
+
     # -- shared memory -----------------------------------------------------
     async def get_system_shared_memory_status(
         self, region_name="", headers=None, query_params=None

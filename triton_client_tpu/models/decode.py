@@ -1017,6 +1017,9 @@ class DecodeModel:
             def attach_memory_governor(inner, gov):
                 outer.attach_memory_governor(gov)
 
+            def attach_cost_ledger(inner, ledger):
+                outer.attach_cost_ledger(ledger)
+
         self._model = _Impl(cfg)
         # device/scheduler observability sink (attach_device_stats): the
         # worker records one nv_tpu_tick_* row per fused dispatch into it
@@ -1024,6 +1027,16 @@ class DecodeModel:
         # byte-admission sink (attach_memory_governor): slot admission
         # gates on projected KV bytes vs live HBM headroom when attached
         self._memory_governor = None
+        # per-tenant attribution sink (attach_cost_ledger): the worker
+        # charges each slot its share of every tick's compute window
+        self._cost_ledger = None
+        # slot -> tenant / governor KV-pin handle for every busy slot
+        # (written under self._lock at admission, popped at release);
+        # bucket -> fused-dispatch SignatureCost, False once analysis
+        # was attempted and came back unavailable (absent, never faked)
+        self._slot_tenant: Dict[int, str] = {}
+        self._slot_kv_pin: Dict[int, int] = {}
+        self._bucket_cost: Dict[int, Any] = {}
         self._state: Dict[Any, int] = {}      # seq_id -> slot
         self._free = set(range(n_slots))
         self._touched: Dict[Any, float] = {}
@@ -1074,6 +1087,53 @@ class DecodeModel:
         that takes the running cohort down.  Inert on backends without
         memory gauges (CPU)."""
         self._memory_governor = gov
+
+    def attach_cost_ledger(self, ledger) -> None:
+        """Attach the serving core's ``CostLedger`` (idempotent attribute
+        stamp, like ``attach_device_stats``).  The batched worker then
+        attributes every fused tick's compute window to the live slots'
+        tenants (equal shares — each slot rode exactly one lane of the
+        dispatch) plus generated tokens and KV byte-seconds; the shares
+        sum to the tick window by construction, so the ledger reconciles
+        with the duty-cycle compute total."""
+        self._cost_ledger = ledger
+
+    def _kv_pin_slot(self, slot: int, tokens: int, tenant: str) -> None:
+        """Open the memory governor's KV byte-seconds integrator for an
+        admitted slot (attribution only — HBM admission gating already
+        ran).  Inert without a governor.  If a concurrent cache rebuild
+        freed the slot between allocation and this pin, the pin is
+        closed immediately instead of leaking."""
+        gov = self._memory_governor
+        if gov is None:
+            return
+        nbytes = int(tokens) * self._kv_bytes_per_token()
+        if nbytes <= 0:
+            return
+        handle = gov.kv_pin(self._model.name, nbytes, tenant)
+        with self._lock:
+            if slot in self._free:
+                released = True
+            else:
+                self._slot_kv_pin[slot] = handle
+                released = False
+        if released:
+            self._kv_unpin_charge(handle)
+
+    def _kv_unpin_charge(self, handle) -> None:
+        """Close an admitted slot's KV integrator and charge the tenant
+        with exactly the byte-seconds the governor integrated — the
+        nv_cost_kv_byte_seconds_total / governor-ledger reconciliation
+        holds by construction, not by sampling.  Safe under self._lock
+        (governor and ledger locks are leaves)."""
+        gov = self._memory_governor
+        if handle is None or gov is None:
+            return
+        tenant, byte_s = gov.kv_unpin(handle)
+        ledger = self._cost_ledger
+        if ledger is not None and ledger.enabled and byte_s > 0:
+            ledger.charge(self._model.name, tenant,
+                          kv_byte_seconds=byte_s)
 
     def _kv_bytes_per_token(self) -> int:
         """Analytic KV-cache footprint of ONE cached token position:
@@ -1337,6 +1397,8 @@ class DecodeModel:
             # checks the generation and fails stale steps instead of
             # writing a dead sequence's K/V into the slot's next occupant
             self._slot_gen[slot] += 1
+            self._slot_tenant.pop(slot, None)
+            self._kv_unpin_charge(self._slot_kv_pin.pop(slot, None))
         self._touched.pop(seq_id, None)
         self._seq_locks.pop(seq_id, None)
 
@@ -1788,6 +1850,12 @@ class DecodeModel:
                 self._tick_seq += 1
                 tick_seq = self._tick_seq
                 ds = self._device_stats
+                ledger = self._cost_ledger
+                want_cost = (ledger is not None and ledger.enabled)
+                tick_cost = None
+                if (ds is not None and ds.enabled) or want_cost:
+                    tick_cost = self._fused_tick_cost(
+                        b, params, step_mask, step_tokens)
                 if ds is not None and ds.enabled:
                     # one tick row per fused dispatch: steps-per-dispatch
                     # and control-upload counters are the measurable form
@@ -1801,7 +1869,10 @@ class DecodeModel:
                         compute_ns=t_done - t_disp0,
                         requests=len(w["batch"]), syncs=1,
                         steps=steps_run, uploads=uploads,
-                        tick_seq=tick_seq)
+                        tick_seq=tick_seq,
+                        flops=tick_cost.flops if tick_cost else 0.0,
+                        bytes_accessed=(tick_cost.bytes_accessed
+                                        if tick_cost else 0.0))
                 traced = [g for g in gen_batch
                           if getattr(g[2], "trace", None) is not None]
                 if traced:
@@ -1818,6 +1889,56 @@ class DecodeModel:
                     }
                     for _li, _slot, sink, _adv, _done, _gen in traced:
                         sink.trace.add_tick(tick)
+                if want_cost:
+                    # Per-tenant attribution: every live slot rode exactly
+                    # one lane of this dispatch, so each is charged an
+                    # equal share of the compute window — the shares sum
+                    # to the tick's compute_ns by construction (the
+                    # conservation contract the tests pin).  FLOPs split
+                    # the same way from the bucket's analyzed dispatch
+                    # cost; padded-but-idle lanes charge nobody.
+                    live = len(w["batch"]) + len(gen_batch)
+                    if live:
+                        share_us = (t_done - t_disp0) / live / 1e3
+                        flops_share = (tick_cost.flops / live
+                                       if tick_cost is not None else 0.0)
+                        if w["batch"]:
+                            with self._lock:
+                                step_tenants = [
+                                    self._slot_tenant.get(off + li, "")
+                                    for li, _f in w["batch"]]
+                            for tenant in step_tenants:
+                                ledger.charge(
+                                    self._model.name, tenant,
+                                    device_us=share_us,
+                                    flops=flops_share, tokens=1)
+                        for _li, _slot, sink, adv, done, _gen in gen_batch:
+                            # tenant rides the sink: the done path already
+                            # released the slot (and its tenant entry)
+                            tenant = getattr(sink, "tenant", "")
+                            ledger.charge(
+                                self._model.name, tenant,
+                                device_us=share_us, flops=flops_share,
+                                tokens=int(adv))
+                            sink.cost_device_us = getattr(
+                                sink, "cost_device_us", 0.0) + share_us
+                            sink.cost_tokens = getattr(
+                                sink, "cost_tokens", 0) + int(adv)
+                            if done:
+                                # stamp the finished generation's cost on
+                                # its trace/flight record BEFORE the
+                                # resolver can emit the stream-end record
+                                cost = {
+                                    "tenant": tenant,
+                                    "device_us": round(
+                                        sink.cost_device_us, 1),
+                                    "tokens": sink.cost_tokens,
+                                }
+                                st = getattr(sink, "trace", None)
+                                if st is not None:
+                                    st.cost = cost
+                                    if st.flight is not None:
+                                        st.flight.cost = cost
                 # PIPELINE the readback: over a remote device the blocking
                 # D2H costs a full round trip; resolving it on a reader
                 # thread lets the next dispatch's compute start
@@ -1913,6 +2034,25 @@ class DecodeModel:
                 self._close_decode_span(sink)
                 sink.put(None)
 
+    def _fused_tick_cost(self, b, params, mask, tokens):
+        """One-time XLA cost analysis of this bucket's fused tick dispatch
+        (server/costs.py), lowered against the live argument shapes and
+        cached per bucket — feeds the tick profiler's roofline totals and
+        the per-tenant FLOPs attribution.  Unavailable stays absent (the
+        False sentinel is never retried): roofline and FLOPs simply don't
+        materialize, nothing is fabricated."""
+        c = self._bucket_cost.get(b)
+        if c is None:
+            from ..server.costs import analyze_jax_callable
+            try:
+                c = analyze_jax_callable(
+                    self._fused_fn, params, self._k[b], self._v[b],
+                    self._dstate[b], mask, tokens) or False
+            except Exception:  # noqa: BLE001 — cost stays absent
+                c = False
+            self._bucket_cost[b] = c
+        return c or None
+
     def _new_cache_arrays(self, cnt: int, cap: int, cfg):
         """Fresh zeroed k/v cache pair for one bucket, committed to the
         serve mesh.  Plain cfg.dtype arrays, or int8 {"q", "s"} pairs when
@@ -1974,6 +2114,8 @@ class DecodeModel:
                 self._free.add(slot)
                 self._slot_gen[slot] += 1
                 self._clear_pen_locked(slot)
+                self._slot_tenant.pop(slot, None)
+                self._kv_unpin_charge(self._slot_kv_pin.pop(slot, None))
         try:
             params, cfg = self._params
             # drop the count matrix with the bucket's other state — pen_n
@@ -2032,6 +2174,9 @@ class DecodeModel:
             self._free.add(slot)
             self._slot_gen[slot] += 1
             self._clear_pen_locked(slot)
+            self._slot_tenant.pop(slot, None)
+            pin = self._slot_kv_pin.pop(slot, None)
+        self._kv_unpin_charge(pin)
         if had_pen:
             # zero the device-resident scalars too: a later unpenalized
             # occupant of this slot must not inherit stale penalties
@@ -2041,7 +2186,7 @@ class DecodeModel:
 
     def submit_generation(self, window, n_tokens: int,
                           freq_pen: float = 0.0, pres_pen: float = 0.0,
-                          prompt_len: int = None):
+                          prompt_len: int = None, tenant: str = ""):
         """Queue a server-side greedy generation (batched mode): the prompt
         prefills into a free slot and the slot self-feeds — every active
         generation shares one batched device step per tick.  Returns a
@@ -2092,6 +2237,7 @@ class DecodeModel:
                     f"holds {need_s} tokens ({self._n_slots} total); retry "
                     "when a generation or sequence completes", 429)
             gen = self._slot_gen[slot]
+            self._slot_tenant[slot] = tenant
             if use_pen:
                 # counts include the REAL prompt tokens (not the window's
                 # zero padding) — same seeding as the per-request chain,
@@ -2109,6 +2255,10 @@ class DecodeModel:
                     real, minlength=cfg.vocab_size).astype(np.int32)
                 self._slot_pen_seed[slot] = (
                     float(freq_pen), float(pres_pen), row)
+        # KV byte-seconds integrator: admitted tokens x per-token bytes,
+        # integrated over the slot's admit..release lifetime (memory
+        # governor); the release path charges the tenant the integral
+        self._kv_pin_slot(slot, need_s, tenant)
         sink: "_queue.Queue" = _queue.Queue()
         # lifecycle-span plumbing rides the sink (worker + resolver
         # threads never touch the contextvar): only stream contexts
@@ -2117,6 +2267,12 @@ class DecodeModel:
         sink.t_submit = t_submit
         sink.t_prefill0 = None
         sink.t_decode0 = None
+        # per-generation cost accumulators (worker-written, single
+        # writer): tick compute shares and token counts; the tenant
+        # rides the sink so attribution survives slot release
+        sink.tenant = tenant
+        sink.cost_device_us = 0.0
+        sink.cost_tokens = 0
         # guards the close-once take of t_decode0: the resolver's
         # last-token path and the worker's cancel path can race
         sink.span_lock = self._threading.Lock()
@@ -2280,6 +2436,7 @@ class DecodeModel:
                     # re-read under this lock: a concurrent rebuild may
                     # have released the mapping since the peek above
                     slot = self._state.get(seq_id)
+                    fresh = slot is None
                     if slot is None:
                         # open-ended length: prefer the largest slab so the
                         # sequence keeps maximum headroom before its cap
@@ -2299,7 +2456,15 @@ class DecodeModel:
                                 f"{self._n_slots} decode slots are busy; "
                                 "end or abandon a sequence first", 429)
                         self._state[seq_id] = slot
+                        self._slot_tenant[slot] = \
+                            parameters.get("_cost_tenant") or ""
                     gen = self._slot_gen[slot]
+                if fresh:
+                    # the slot pins its whole slab-lane capacity for the
+                    # sequence's open-ended lifetime — that is the KV
+                    # footprint its tenant holds against the pool
+                    self._kv_pin_slot(slot, self._slot_cap(slot),
+                                      parameters.get("_cost_tenant") or "")
                 fut = self._submit("prefill", (slot, gen, toks))
             else:
                 # self._pos is worker-owned, but this slot's previous step
@@ -2390,6 +2555,11 @@ class GenerateModel:
                 # the HBM gate must see generation traffic too
                 outer._decode.attach_memory_governor(gov)
 
+            def attach_cost_ledger(inner, ledger):
+                # tick attribution happens in the SHARED decode worker —
+                # route the ledger there so generation traffic is charged
+                outer._decode.attach_cost_ledger(ledger)
+
         self.model = _Impl(cfg)
 
     @staticmethod
@@ -2471,17 +2641,28 @@ class GenerateModel:
         return jax.jit(choose)
 
     def _generate_batched(self, window, n_tokens, freq_pen=0.0,
-                          pres_pen=0.0, prompt_len=None):
+                          pres_pen=0.0, prompt_len=None, parameters=None):
         np = self._np
         from ..server.types import InferError
 
+        tenant = ""
+        if parameters is not None:
+            tenant = parameters.get("_cost_tenant") or ""
         sink = self._decode.submit_generation(
             window, n_tokens, freq_pen=freq_pen, pres_pen=pres_pen,
-            prompt_len=prompt_len)
+            prompt_len=prompt_len, tenant=tenant)
         try:
             while True:
                 item = sink.get(timeout=3600)
                 if item is None:
+                    # cost backchannel: the worker finished writing the
+                    # accumulators before it let the end sentinel through,
+                    # so the stream envelope can stamp device_time_us on
+                    # the final response (OpenAI usage block)
+                    if parameters is not None:
+                        dev_us = getattr(sink, "cost_device_us", 0.0)
+                        if dev_us:
+                            parameters["_cost_device_us"] = round(dev_us, 1)
                     return
                 if isinstance(item, Exception):
                     if isinstance(item, InferError):
@@ -2560,7 +2741,7 @@ class GenerateModel:
             # below: RNG state is per-request.
             yield from self._generate_batched(
                 window, n_tokens, freq_pen=freq_pen, pres_pen=pres_pen,
-                prompt_len=int(b.size))
+                prompt_len=int(b.size), parameters=parameters)
             return
 
         prefill, step, params, cfg = dec._ensure_fns_independent()

@@ -260,7 +260,7 @@ def make_bert_large() -> JaxModel:
         logits = run(tokens)  # [B, S, 2]
         return {"LOGITS": logits.astype(jnp.float32)}
 
-    return JaxModel(cfg, fn, jit=False)
+    return JaxModel(cfg, fn, jit=False, analyzable=True)
 
 
 def make_longctx_tpu() -> JaxModel:
@@ -298,7 +298,7 @@ def make_longctx_tpu() -> JaxModel:
             logp[:, :-1, :], nxt[..., None], axis=-1)[..., 0]
         return {"LOGPROBS": jnp.pad(scores, ((0, 0), (0, 1)))}
 
-    return JaxModel(cfg, fn, jit=False)
+    return JaxModel(cfg, fn, jit=False, analyzable=True)
 
 
 # Mixture-of-experts scorer: serves the flagship stack's MoE FFN path
@@ -351,7 +351,7 @@ def make_moe_tpu() -> JaxModel:
         best = jnp.max(logits, axis=-1).astype(jnp.float32)
         return {"NEXT_TOKEN": nxt[:, None], "NEXT_LOGIT": best[:, None]}
 
-    return JaxModel(cfg, fn, jit=False)
+    return JaxModel(cfg, fn, jit=False, analyzable=True)
 
 
 def _llama_cfg() -> tr.TransformerConfig:
@@ -412,7 +412,7 @@ def make_llama_tpu() -> JaxModel:
         best = jnp.max(logits, axis=-1).astype(jnp.float32)
         return {"NEXT_TOKEN": nxt[:, None], "NEXT_LOGIT": best[:, None]}
 
-    return JaxModel(cfg, fn, jit=False)
+    return JaxModel(cfg, fn, jit=False, analyzable=True)
 
 
 def make_llama_postprocess() -> PyModel:

@@ -482,7 +482,7 @@ def _attn_apply(blk, x, cfg: TransformerConfig):
     Sc = x.shape[1]
     positions = lax.axis_index("sp") * Sc + jnp.arange(Sc)
     q, k = _rope(q, k, positions, cfg.rope_theta)
-    if (lax.axis_size("sp") == 1 and _flash_enabled()
+    if (parallel.axis_size("sp") == 1 and _flash_enabled()
             and q.shape[2] >= _flash_min_s()):
         # full LONG sequence on-device: the pallas flash kernel (ops/)
         # replaces the cross-device ring — identical online-softmax math,
@@ -598,7 +598,7 @@ def _pipeline_apply(params, x_mbs, cfg: TransformerConfig):
     x_mbs: [n_micro, mb, Sc, D] embedded microbatches (identical on every pp
     rank).  Returns [n_micro, mb, Sc, D] — valid only on the LAST stage;
     other stages hold garbage that callers must mask."""
-    pp = lax.axis_size("pp")
+    pp = parallel.axis_size("pp")
     stage = lax.axis_index("pp")
     n_micro = x_mbs.shape[0]
     steps = n_micro + pp - 1
@@ -643,7 +643,7 @@ def _local_loss(params, tokens, labels, cfg: TransformerConfig,
     logp = jax.nn.log_softmax(logits, axis=-1)
     lab = labels.reshape(n_micro, mb, Sc)
     nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
-    is_last = (lax.axis_index("pp") == lax.axis_size("pp") - 1)
+    is_last = (lax.axis_index("pp") == parallel.axis_size("pp") - 1)
     local_sum = jnp.where(is_last, jnp.sum(nll), 0.0)
     return local_sum
 
@@ -721,7 +721,7 @@ def make_grad_fn(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 2):
         grads = {k: g / (count * compute_scale) for k, g in grads.items()}
         return grads, loss / count
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(parallel.shard_map(
         local_grads, mesh=mesh,
         in_specs=(specs, P("dp", "sp"), P("dp", "sp")),
         out_specs=(specs, P()),
@@ -750,7 +750,7 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 2,
         params, opt = _adam_update(params, grads, opt, lr=lr)
         return params, opt, loss
 
-    sharded = jax.shard_map(
+    sharded = parallel.shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, ospecs, P("dp", "sp"), P("dp", "sp")),
         out_specs=(specs, ospecs, P()),
@@ -777,7 +777,7 @@ def make_forward(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 1,
         x = jnp.take(params["embed"].astype(cfg.dtype), tokens, axis=0)
         x_mbs = x.reshape(n_micro, mb, Sc, cfg.d_model)
         outs = _pipeline_apply(params, x_mbs, cfg)
-        is_last = (lax.axis_index("pp") == lax.axis_size("pp") - 1)
+        is_last = (lax.axis_index("pp") == parallel.axis_size("pp") - 1)
         outs = jnp.where(is_last, outs, 0.0).astype(jnp.float32)
         outs = lax.psum(outs, "pp").astype(cfg.dtype)
         h = _rmsnorm(outs, params["final_ln"], cfg.norm_eps)
@@ -788,7 +788,7 @@ def make_forward(mesh: Mesh, cfg: TransformerConfig, n_micro: int = 1,
                             head.astype(jnp.float32))
         return logits.reshape(Bl, Sc, head.shape[-1])
 
-    sharded = jax.shard_map(
+    sharded = parallel.shard_map(
         local_fwd, mesh=mesh,
         in_specs=(specs, P("dp", "sp")),
         out_specs=P("dp", "sp", None),

@@ -143,4 +143,5 @@ def make_resnet50() -> JaxModel:
             state["run"] = jax.jit(lambda x: {"OUTPUT": _forward(params, x)})
         return state["run"](INPUT)
 
-    return JaxModel(cfg, fn, jit=False, output_labels={"OUTPUT": labels})
+    return JaxModel(cfg, fn, jit=False, analyzable=True,
+                    output_labels={"OUTPUT": labels})

@@ -60,7 +60,7 @@ def make_simple() -> JaxModel:
     # jit=False: the numpy/jax branch is a host-side type dispatch (a jit
     # trace would bake the jax branch in), and two eager element-wise ops
     # need no fusion
-    return JaxModel(cfg, fn, jit=False)
+    return JaxModel(cfg, fn, jit=False, analyzable=True)
 
 
 def make_simple_string() -> PyModel:
@@ -351,7 +351,7 @@ def make_dense_tpu() -> JaxModel:
             state["run"] = run
         return {"OUTPUT": state["run"](INPUT)}
 
-    return JaxModel(cfg, fn, jit=False)
+    return JaxModel(cfg, fn, jit=False, analyzable=True)
 
 
 def make_simple_cnn() -> JaxModel:
@@ -392,7 +392,8 @@ def make_simple_cnn() -> JaxModel:
             state["run"] = run
         return {"OUTPUT": state["run"](INPUT)}
 
-    return JaxModel(cfg, fn, jit=False, output_labels={"OUTPUT": labels})
+    return JaxModel(cfg, fn, jit=False, analyzable=True,
+                    output_labels={"OUTPUT": labels})
 
 
 def make_ensemble_scale_sum() -> Model:

@@ -16,6 +16,30 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions: the top-level API (with its
+    ``check_vma`` kwarg) landed after 0.4.x; older releases ship it as
+    ``jax.experimental.shard_map.shard_map`` with the same semantics
+    under the ``check_rep`` kwarg."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy_sm
+
+    return legacy_sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_vma)
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis inside shard_map.  ``lax.axis_size``
+    only exists on newer jax; on older releases ``psum(1, axis)`` folds to
+    the same static int."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     """Causal ring attention over a sequence-parallel mesh axis.
 
@@ -25,7 +49,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     device — the TPU-native long-context mechanism (ICI ring instead of the
     reference's server-side sequence offload; SURVEY.md §5).
     """
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, Hl, Sc, Kd = q.shape
     scale = 1.0 / math.sqrt(Kd)

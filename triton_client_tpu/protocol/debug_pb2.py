@@ -22,6 +22,12 @@ descriptor pool — wire-identical to what protoc would generate for::
     message DeviceStatsResponse {
       string payload_json = 1; // the /v2/debug/device_stats JSON
     }
+    message CostsRequest {
+      string model_name = 1;   // filter to one model ("" = all)
+    }
+    message CostsResponse {
+      string payload_json = 1; // the /v2/debug/costs JSON
+    }
 
 The response carries the debug snapshot as JSON-in-proto deliberately: the
 flight-recorder shape is a diagnostics surface shared verbatim with the
@@ -64,6 +70,14 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
     ds_resp.name = "DeviceStatsResponse"
     f = ds_resp.field.add()
     f.name, f.number, f.type, f.label = "payload_json", 1, _STRING, _OPTIONAL
+    c_req = fdp.message_type.add()
+    c_req.name = "CostsRequest"
+    f = c_req.field.add()
+    f.name, f.number, f.type, f.label = "model_name", 1, _STRING, _OPTIONAL
+    c_resp = fdp.message_type.add()
+    c_resp.name = "CostsResponse"
+    f = c_resp.field.add()
+    f.name, f.number, f.type, f.label = "payload_json", 1, _STRING, _OPTIONAL
     return fdp
 
 
@@ -94,3 +108,5 @@ FlightRecorderRequest = _message_class("FlightRecorderRequest")
 FlightRecorderResponse = _message_class("FlightRecorderResponse")
 DeviceStatsRequest = _message_class("DeviceStatsRequest")
 DeviceStatsResponse = _message_class("DeviceStatsResponse")
+CostsRequest = _message_class("CostsRequest")
+CostsResponse = _message_class("CostsResponse")

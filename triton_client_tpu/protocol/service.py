@@ -81,6 +81,11 @@ METHODS = {
         pb_debug.DeviceStatsRequest,
         pb_debug.DeviceStatsResponse,
     ),
+    "Costs": (
+        "uu",
+        pb_debug.CostsRequest,
+        pb_debug.CostsResponse,
+    ),
 }
 
 

@@ -31,6 +31,7 @@ from ..utils import np_to_triton_dtype, triton_to_np_dtype
 from .model import EnsembleModel, JaxModel, Model, pb_to_datatype
 from .registry import ModelRegistry
 from .shm import SystemShmRegistry, XlaShmRegistry
+from .costs import CostLedger, classify_roofline
 from .device_stats import DeviceStatsCollector, SloEngine, SloObjective
 from .flight_recorder import FlightRecorder
 from .log import ServerLog, log_off_loop
@@ -457,7 +458,9 @@ class _DynamicBatcher:
                     assembly_ns=t0 - t_asm0,
                     compute_ns=exec_stats.get("compute_ns", compute_ns),
                     requests=len(pending),
-                    syncs=exec_stats.get("d2h_syncs", 0))
+                    syncs=exec_stats.get("d2h_syncs", 0),
+                    flops=exec_stats.get("flops", 0.0),
+                    bytes_accessed=exec_stats.get("bytes_accessed", 0.0))
                 tick = {
                     "bucket": padded, "batch": total,
                     "pad_fraction": (round((padded - total) / padded, 4)
@@ -475,6 +478,38 @@ class _DynamicBatcher:
                         tr.tick = tick
                         if tr.flight is not None:
                             tr.flight.tick = tick
+            ledger = self._core.cost_ledger
+            if ledger.enabled and total > 0:
+                # per-request slot-share attribution: each member owns
+                # count/total of the batch's compute window and of the
+                # signature's measured FLOPs.  The shares sum to exactly
+                # the window the tick recorded — conservation to the
+                # duty-cycle compute window is by construction.
+                exec_ns = exec_stats.get("compute_ns", compute_ns)
+                exec_flops = exec_stats.get("flops", 0.0)
+                verdict = None
+                roofline = classify_roofline(
+                    exec_flops, exec_stats.get("bytes_accessed", 0.0))
+                if roofline is not None:
+                    verdict = roofline["verdict"]
+                for item, count in zip(pending, counts):
+                    tenant = item[6][0]
+                    share = count / total
+                    dev_us = exec_ns * share / 1e3
+                    flops_share = exec_flops * share
+                    ledger.charge(self._model.name, tenant,
+                                  device_us=dev_us, flops=flops_share)
+                    tr = item[4]
+                    if tr is not None:
+                        cost = {"tenant": tenant,
+                                "device_us": round(dev_us, 1)}
+                        if flops_share:
+                            cost["flops"] = flops_share
+                        if verdict is not None:
+                            cost["roofline"] = verdict
+                        tr.cost = cost
+                        if tr.flight is not None:
+                            tr.flight.cost = cost
             offset = 0
             for item, count in zip(pending, counts):
                 fut = item[2]
@@ -575,6 +610,10 @@ class InferenceCore:
         self.slo = SloEngine()
         self.slo.resolver = self._slo_from_config
         self.flight_recorder.slo_engine = self.slo
+        # per-(model, tenant) cost attribution (server/costs.py): device-
+        # time slot-shares, XLA-measured FLOPs, generated tokens, KV
+        # byte-seconds — the nv_cost_* families and /v2/debug/costs
+        self.cost_ledger = CostLedger()
         self.live = True
         # readiness gate: /v2/health/ready (and gRPC ServerReady) report
         # not-ready until startup warmup finished and no model is mid-load
@@ -1007,10 +1046,18 @@ class InferenceCore:
             if trace is not None:
                 trace.ts("COMPUTE_START", t0)
                 trace.add_span("QUEUE", request.arrival_ns, t0)
+            device_loop = getattr(model, "attach_device_stats", None)
+            if device_loop is not None and request.tenant:
+                # device-loop models (the decode worker) attribute cost
+                # per fused tick; the tenant rides the parameters copy so
+                # the worker can label this request's slot
+                params["_cost_tenant"] = request.tenant
+            exec_stats: Dict[str, Any] = {}
             try:
                 outputs = await self._run_model(
                     model, inputs, params, keep_device=keep_device,
-                    traces=(trace,) if trace is not None else ())
+                    traces=(trace,) if trace is not None else (),
+                    exec_stats=exec_stats, cost_tenant=request.tenant)
             except InferError:
                 model.stats.record(_batch_count(inputs) or 1, queue_ns, 0, ok=False)
                 raise
@@ -1020,6 +1067,19 @@ class InferenceCore:
             compute_ns = time.monotonic_ns() - t0
             if trace is not None:
                 trace.ts("COMPUTE_END", t0 + compute_ns)
+                if (self.cost_ledger.enabled and self.device_stats.enabled
+                        and device_loop is None):
+                    # mirror of the ledger charge _run_model just made —
+                    # the compact cost stamp riding the trace and flight
+                    # records (slot-share = whole window on this path)
+                    cost = {"tenant": request.tenant,
+                            "device_us": round(exec_stats.get(
+                                "compute_ns", compute_ns) / 1e3, 1)}
+                    if exec_stats.get("flops"):
+                        cost["flops"] = exec_stats["flops"]
+                    trace.cost = cost
+                    if trace.flight is not None:
+                        trace.flight.cost = cost
             model.stats.record(_batch_count(inputs) or 1, queue_ns, compute_ns, ok=True)
         if cache_key is not None:
             self.response_cache.put(cache_key, dict(outputs),
@@ -1183,6 +1243,15 @@ class InferenceCore:
         attach_gov = getattr(model, "attach_memory_governor", None)
         if attach_gov is not None:
             attach_gov(self.memory)
+        # cost attribution: the decode worker charges per-tick slot-shares
+        # to the ledger; the tenant rides the (copied) parameters dict and
+        # the worker reports the stream's accumulated device-time back
+        # through the same dict (read below for the final response)
+        attach_ledger = getattr(model, "attach_cost_ledger", None)
+        if attach_ledger is not None:
+            attach_ledger(self.cost_ledger)
+            if request.tenant:
+                params["_cost_tenant"] = request.tenant
         # current-trace contextvar set AROUND the whole stream (and reset
         # in the finally): shm staging transfers, request-scoped server-log
         # lines, and the decode worker's lifecycle spans all key off
@@ -1257,6 +1326,13 @@ class InferenceCore:
             model_name=model.name, model_version=model.served_version, id=request.id
         )
         final.parameters["triton_final_response"] = True
+        # the generator wrote the stream's accumulated device-time back
+        # into the shared params dict when it finished; surface it on the
+        # final response so frontends (the OpenAI usage block) can report
+        # real device microseconds without another debug round trip
+        device_us = params.get("_cost_device_us")
+        if device_us is not None:
+            final.parameters["device_time_us"] = device_us
         yield final
 
     # ------------------------------------------------------------------
@@ -1505,6 +1581,7 @@ class InferenceCore:
         traces=(),
         exec_stats: Optional[Dict[str, Any]] = None,
         real_batch: Optional[int] = None,
+        cost_tenant: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Execute on a thread-pool worker so the event loop keeps serving.
 
@@ -1533,7 +1610,14 @@ class InferenceCore:
         ``real_batch``: the REAL element count when ``inputs`` has been
         padded to a bucket (the dynamic batcher passes its pre-pad total)
         — pad slots are waste (``nv_tpu_pad_waste_ratio``), so they must
-        not count as inferences or MFU FLOPs."""
+        not count as inferences or MFU FLOPs.
+
+        ``cost_tenant``: when set (the direct path and ensemble members),
+        the whole compute window is charged to this tenant in the cost
+        ledger.  The dynamic batcher passes None and splits the window
+        into per-request slot-shares itself; device-loop models (the
+        decode worker) attribute per tick and are skipped here — either
+        way every compute nanosecond is charged exactly once."""
         loop = asyncio.get_running_loop()
         ds = self.device_stats
 
@@ -1574,11 +1658,43 @@ class InferenceCore:
                 attach = getattr(model, "attach_device_stats", None)
                 if attach is not None:
                     attach(ds)
+                attach_ledger = getattr(model, "attach_cost_ledger", None)
+                if attach_ledger is not None:
+                    attach_ledger(self.cost_ledger)
+                # XLA cost analysis, once per new signature: the execute
+                # above warmed the jit cache, so the AOT lower+compile
+                # here reuses the compilation where the backend caches it
+                # and the extracted FLOPs/bytes are those of the program
+                # this signature actually runs.  None (CPU stand-ins with
+                # no analysis, untraceable fns) stays None — absent,
+                # never fabricated.
+                padded_n = _batch_count(inputs) or 1
+                cost = None
+                if sig is not None and not ds.signature_known(
+                        model.name, sig):
+                    cost = model.analyze_cost(inputs, params)
                 ds.record_execute(model.name,
-                                  real_batch or _batch_count(inputs) or 1,
-                                  t_c1 - t_c0, signature=sig)
+                                  real_batch or padded_n,
+                                  t_c1 - t_c0, signature=sig,
+                                  cost=cost, padded_batch=padded_n)
+                if cost is None and sig is not None:
+                    cost = ds.signature_cost(model.name, sig)
                 if exec_stats is not None:
                     exec_stats["compute_ns"] = t_c1 - t_c0
+                    if cost is not None:
+                        exec_stats["flops"] = cost.flops
+                        exec_stats["bytes_accessed"] = cost.bytes_accessed
+                ledger = self.cost_ledger
+                if cost_tenant is not None and ledger.enabled \
+                        and attach is None:
+                    # direct-path / ensemble-member attribution: one
+                    # request owns the whole window.  Device-loop models
+                    # (attach is not None) attribute per fused tick in
+                    # their own worker — charging here too would double-
+                    # count and break the conservation contract.
+                    ledger.charge(model.name, cost_tenant,
+                                  device_us=(t_c1 - t_c0) / 1e3,
+                                  flops=cost.flops if cost else 0.0)
             if keep_device is None:
                 return outputs
             drained = [n for n, v in outputs.items()
@@ -1730,7 +1846,8 @@ class InferenceCore:
                 step_inputs, member_params, tenant=tenant, tier=tier)
         t0 = time.monotonic_ns()
         try:
-            outs = await self._run_model(member, step_inputs, params)
+            outs = await self._run_model(member, step_inputs, params,
+                                         cost_tenant=tenant)
         except Exception:
             member.stats.record(
                 _batch_count(step_inputs) or 1, 0,

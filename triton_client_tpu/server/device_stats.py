@@ -51,9 +51,12 @@ from __future__ import annotations
 import os
 import threading
 import time
+import warnings
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .costs import SignatureCost, classify_roofline
 
 __all__ = [
     "DeviceStatsCollector",
@@ -135,7 +138,8 @@ class _BucketStats:
     __slots__ = ("ticks", "batch_total", "padded_total", "requests_total",
                  "assembly_ns_total", "queue_depth_total", "queue_depth_max",
                  "syncs_total", "compute_ns_total", "steps_total",
-                 "uploads_total", "first_seq", "last_seq")
+                 "uploads_total", "flops_total", "bytes_total",
+                 "first_seq", "last_seq")
 
     def __init__(self) -> None:
         self.ticks = 0
@@ -149,6 +153,11 @@ class _BucketStats:
         self.compute_ns_total = 0
         self.steps_total = 0
         self.uploads_total = 0
+        # XLA cost-analysis totals for the dispatches behind these ticks
+        # (full padded-batch FLOPs / bytes accessed per dispatch) — the
+        # roofline classification inputs; 0 = analysis unavailable
+        self.flops_total = 0.0
+        self.bytes_total = 0.0
         # host-side dispatch sequence window (tick_seq): the join key a
         # traced sequence's tick entries carry — a trace's tick_seq must
         # land inside [first_seq, last_seq] of its (model, bucket) row
@@ -183,6 +192,16 @@ class DeviceStatsCollector:
         self._transfers: Dict[str, List[int]] = {}
         # model -> flops per batch element (None = undeclared, no MFU)
         self._flops_pe: Dict[str, Optional[float]] = {}
+        # (model, signature) -> XLA-derived SignatureCost, cached at the
+        # signature's first compile (the core runs the AOT analysis and
+        # hands it to record_execute alongside the compile sample)
+        self._sig_costs: Dict[Tuple[str, tuple], SignatureCost] = {}
+        # model -> measured flops per batch element (cost_analysis FLOPs
+        # over the padded batch of the analyzed signature) — when
+        # present this beats the hand-declared figure as MFU numerator
+        self._flops_measured: Dict[str, float] = {}
+        # models already warned about declared-vs-measured flops drift
+        self._drift_warned: set = set()
 
     # -- recording ---------------------------------------------------------
     def set_model_flops(self, model: str,
@@ -208,23 +227,51 @@ class DeviceStatsCollector:
         set (its new instance re-compiles; cumulative counters stay)."""
         with self._lock:
             self._flops_pe.pop(model, None)
+            self._flops_measured.pop(model, None)
+            self._drift_warned.discard(model)
+            self._sig_costs = {k: v for k, v in self._sig_costs.items()
+                               if k[0] != model}
             cc = self._compile.get(model)
             if cc is not None:
                 cc.signatures = set()
 
+    def signature_known(self, model: str, signature: tuple) -> bool:
+        """Whether this input-shape signature has been seen (i.e. its
+        compile — and cost analysis, if available — already happened).
+        The core probes this before paying an AOT cost analysis."""
+        with self._lock:
+            cc = self._compile.get(model)
+            return cc is not None and signature in cc.signatures
+
+    def signature_cost(self, model: str,
+                       signature: tuple) -> Optional[SignatureCost]:
+        """The cached XLA cost for a (model, signature), or None when
+        analysis was unavailable for it."""
+        with self._lock:
+            return self._sig_costs.get((model, signature))
+
     def record_execute(self, model: str, batch: int, compute_ns: int,
                        signature: Optional[tuple] = None,
-                       now: Optional[float] = None) -> None:
+                       now: Optional[float] = None,
+                       cost: Optional[SignatureCost] = None,
+                       padded_batch: Optional[int] = None) -> None:
         """Record one model execution window.
 
         ``signature`` (input-shape signature) drives the compile/jit-cache
         series: its first sighting is a cache miss whose wall time includes
         XLA compilation — that sample feeds the compile counters and is
         kept OUT of the duty/MFU window (a 30 s compile is not 30 s of
-        useful compute)."""
+        useful compute).
+
+        ``cost`` (given on a signature's first sighting, when XLA's
+        ``cost_analysis`` could run) is cached per (model, signature) and
+        its FLOPs — normalized by ``padded_batch``, the compiled batch
+        dimension — become the model's *measured* flops-per-element, the
+        preferred live-MFU numerator over the hand-declared figure."""
         if not self.enabled:
             return
         now = time.monotonic() if now is None else now
+        drift: Optional[Tuple[float, float]] = None
         with self._lock:
             cm = self._compute.get(model)
             if cm is None:
@@ -238,23 +285,44 @@ class DeviceStatsCollector:
                     cc.signatures.add(signature)
                     cc.compile_count += 1
                     cc.compile_ns_total += compute_ns
-                    cc.recent.append(
-                        {"signature": repr(signature),
-                         "wall_ms": round(compute_ns / 1e6, 3)})
+                    event = {"signature": repr(signature),
+                             "wall_ms": round(compute_ns / 1e6, 3)}
+                    if cost is not None:
+                        self._sig_costs[(model, signature)] = cost
+                        event["flops"] = cost.flops
+                        event["bytes_accessed"] = cost.bytes_accessed
+                        if cost.flops > 0.0:
+                            measured_pe = cost.flops / max(
+                                1, int(padded_batch or batch or 1))
+                            self._flops_measured[model] = measured_pe
+                            declared = self._flops_pe.get(model)
+                            if declared and model not in self._drift_warned:
+                                ratio = declared / measured_pe
+                                if ratio > 2.0 or ratio < 0.5:
+                                    self._drift_warned.add(model)
+                                    drift = (declared, measured_pe)
+                    cc.recent.append(event)
                     compiled = True
                 else:
                     cc.hits += 1
             cm.executions += 1
             cm.inferences += max(1, int(batch))
-            if compiled:
-                return
-            cm.compute_ns_total += compute_ns
-            flops_pe = self._flops_pe.get(model)
-            flops = (flops_pe * max(1, int(batch))
-                     if flops_pe else 0.0)
-            cm.flops_total += flops
-            cm.events.append((now, compute_ns / 1e9, flops))
-            self._prune_locked(cm, now)
+            if not compiled:
+                cm.compute_ns_total += compute_ns
+                flops_pe = (self._flops_measured.get(model)
+                            or self._flops_pe.get(model))
+                flops = (flops_pe * max(1, int(batch))
+                         if flops_pe else 0.0)
+                cm.flops_total += flops
+                cm.events.append((now, compute_ns / 1e9, flops))
+                self._prune_locked(cm, now)
+        if drift is not None:
+            declared, measured_pe = drift
+            warnings.warn(
+                f"model '{model}': declared flops_per_inference "
+                f"({declared:.3e}) drifts >2x from XLA-measured flops per "
+                f"element ({measured_pe:.3e}); live MFU uses the measured "
+                "figure", RuntimeWarning, stacklevel=2)
 
     def record_transfer(self, direction: str, nbytes: int,
                         count: int = 1) -> None:
@@ -270,7 +338,8 @@ class DeviceStatsCollector:
     def record_tick(self, model: str, bucket: int, batch: int, padded: int,
                     queue_depth: int, assembly_ns: int, compute_ns: int = 0,
                     requests: int = 1, syncs: int = 0, steps: int = 1,
-                    uploads: int = 0, tick_seq: int = 0) -> None:
+                    uploads: int = 0, tick_seq: int = 0, flops: float = 0.0,
+                    bytes_accessed: float = 0.0) -> None:
         """Record one dynamic-batcher tick (one batched execution) or one
         decode-worker fused dispatch.
 
@@ -283,7 +352,10 @@ class DeviceStatsCollector:
         counter that proves per-tick control re-uploads stay gone).
         ``tick_seq``: the decode worker's monotonic dispatch id (0 = not
         stamped, e.g. batcher ticks) — the same id each traced sequence's
-        tick entries carry, so trace records join back to these rows."""
+        tick entries carry, so trace records join back to these rows.
+        ``flops`` / ``bytes_accessed``: the dispatch's XLA cost-analysis
+        figures (full padded batch; 0 = unavailable) — accumulated per
+        (model, bucket) as the roofline classification inputs."""
         if not self.enabled:
             return
         with self._lock:
@@ -302,6 +374,8 @@ class DeviceStatsCollector:
             bs.compute_ns_total += int(compute_ns)
             bs.steps_total += int(steps)
             bs.uploads_total += int(uploads)
+            bs.flops_total += float(flops)
+            bs.bytes_total += float(bytes_accessed)
             if tick_seq:
                 if not bs.first_seq:
                     bs.first_seq = int(tick_seq)
@@ -330,13 +404,15 @@ class DeviceStatsCollector:
 
     def live_mfu(self, model: str, now: Optional[float] = None
                  ) -> Optional[float]:
-        """Windowed MFU: analytic FLOPs executed over elapsed compute time
-        over chip peak.  None for models with no declared FLOPs (or no
-        window traffic) — an undeclared model must read as "unknown", not
-        0% utilization."""
+        """Windowed MFU: FLOPs executed over elapsed compute time over
+        chip peak.  The numerator prefers XLA-measured flops-per-element
+        (cost analysis at first compile) over the hand-declared figure.
+        None for models with neither (or no window traffic) — an unknown
+        model must read as "unknown", not 0% utilization."""
         now = time.monotonic() if now is None else now
         with self._lock:
-            if not self._flops_pe.get(model):
+            if not (self._flops_measured.get(model)
+                    or self._flops_pe.get(model)):
                 return None
             cm = self._compute.get(model)
             if cm is None:
@@ -406,7 +482,8 @@ class DeviceStatsCollector:
                     busy += e[1]
                     flops += e[2]
                 mfu = (flops / busy / peak_flops()
-                       if busy > 0 and self._flops_pe.get(m) else None)
+                       if busy > 0 and (self._flops_measured.get(m)
+                                        or self._flops_pe.get(m)) else None)
                 duty_mfu[m] = (min(1.0, busy / span), mfu)
             compiles = {m: (c.compile_count, c.compile_ns_total, c.hits)
                         for m, c in self._compile.items()}
@@ -421,6 +498,7 @@ class DeviceStatsCollector:
             "tick_assembly_us": [], "tick_queue_depth": [],
             "tick_syncs": [], "tick_steps": [], "tick_uploads": [],
             "pad_waste": [],
+            "roofline_ai": [], "roofline_pct": [],
             "mem_used": [], "mem_peak": [], "mem_limit": [],
         }
         for m in models:
@@ -450,6 +528,17 @@ class DeviceStatsCollector:
             rows["tick_steps"].append((labels, bs.steps_total))
             rows["tick_uploads"].append((labels, bs.uploads_total))
             rows["pad_waste"].append((labels, round(bs.pad_waste(), 6)))
+            roofline = classify_roofline(
+                bs.flops_total, bs.bytes_total,
+                compute_s=bs.compute_ns_total / 1e9)
+            if roofline is not None:
+                rows["roofline_ai"].append(
+                    (labels, roofline["arithmetic_intensity"]))
+                if "pct_of_peak" in roofline:
+                    rows["roofline_pct"].append(
+                        ({"model": m, "bucket": str(bucket),
+                          "verdict": roofline["verdict"]},
+                         roofline["pct_of_peak"]))
         for dev, stats in sorted(self.hbm_stats().items()):
             labels = {"device": dev}
             if "bytes_in_use" in stats:
@@ -479,6 +568,8 @@ class DeviceStatsCollector:
                         for m, c in self._compile.items()}
             buckets = sorted(self._buckets.items())
             transfers = {d: list(c) for d, c in self._transfers.items()}
+            flops_measured = dict(self._flops_measured)
+            flops_declared = dict(self._flops_pe)
         models: Dict[str, Any] = {}
         for m, (executions, inferences, compute_ns) in sorted(
                 compute.items()):
@@ -488,12 +579,20 @@ class DeviceStatsCollector:
                 m, (0, 0, 0, []))
             duty = self.duty_cycle(m, now)
             mfu = self.live_mfu(m, now)
+            measured = flops_measured.get(m)
+            declared = flops_declared.get(m)
             models[m] = {
                 "executions": executions,
                 "inferences": inferences,
                 "compute_ms_total": round(compute_ns / 1e6, 3),
                 "duty_cycle": round(duty, 6) if duty is not None else None,
                 "live_mfu": round(mfu, 6) if mfu is not None else None,
+                # MFU-numerator provenance: XLA-measured beats declared;
+                # neither -> MFU is honestly absent, never fabricated
+                "flops_per_element": measured or declared,
+                "flops_source": ("measured" if measured
+                                 else "declared" if declared else None),
+                "flops_declared": declared,
                 "compile": {
                     "count": count,
                     "total_ms": round(compile_ns / 1e6, 3),
@@ -527,6 +626,11 @@ class DeviceStatsCollector:
                 "avg_steps_per_tick": (round(
                     bs.steps_total / bs.ticks, 2) if bs.ticks else None),
                 "uploads": bs.uploads_total,
+                "flops_total": bs.flops_total,
+                "bytes_total": bs.bytes_total,
+                "roofline": classify_roofline(
+                    bs.flops_total, bs.bytes_total,
+                    compute_s=bs.compute_ns_total / 1e9),
                 "first_tick_seq": bs.first_seq or None,
                 "last_tick_seq": bs.last_seq or None,
             }
@@ -550,6 +654,9 @@ class DeviceStatsCollector:
             self._compile = {}
             self._buckets = {}
             self._transfers = {}
+            self._sig_costs = {}
+            self._flops_measured = {}
+            self._drift_warned = set()
             self._started_s = time.monotonic()
 
 

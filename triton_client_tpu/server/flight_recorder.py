@@ -109,7 +109,7 @@ class FlightRecord:
                  "batch", "bytes_in", "bytes_out", "arrival_ns", "ts",
                  "queue_us", "compute_us", "total_us", "outcome",
                  "capture_reason", "spans", "chaos", "tenant", "tier",
-                 "tick", "shed_reason")
+                 "tick", "shed_reason", "cost")
 
     def __init__(self, seq: int, model: str, version: str,
                  request_id: str = "", protocol: str = "",
@@ -147,6 +147,10 @@ class FlightRecord:
         # byte budget or HBM-headroom gate shed this request inside the
         # traced envelope — tellable from queue-depth sheds at a glance
         self.shed_reason: Optional[str] = None
+        # cost-attribution stamp (server/costs.py): this request's
+        # attributed device-time/FLOPs share and tenant — the join
+        # between the flight ring and the per-tenant cost ledger
+        self.cost: Optional[Dict[str, Any]] = None
 
     def to_dict(self, include_spans: bool = False) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -170,6 +174,7 @@ class FlightRecord:
             "tier": self.tier,
             "tick": self.tick,
             "shed_reason": self.shed_reason,
+            "cost": self.cost,
         }
         if include_spans:
             out["spans"] = self.spans or []

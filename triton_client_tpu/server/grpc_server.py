@@ -456,6 +456,20 @@ class InferenceServicer:
         body = await asyncio.get_running_loop().run_in_executor(None, _snap)
         return pb_debug.DeviceStatsResponse(payload_json=body)
 
+    async def Costs(self, request, context):
+        """Debug surface: the per-tenant cost-attribution ledger
+        (server/costs.py) — same JSON as HTTP's ``GET /v2/debug/costs``,
+        same off-loop serialization."""
+        import json as _json
+
+        from ..protocol import debug_pb2 as pb_debug
+
+        model = request.model_name or None
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: _json.dumps(
+                self._core.cost_ledger.snapshot(model=model)))
+        return pb_debug.CostsResponse(payload_json=body)
+
     async def LogSettings(self, request, context):
         for k, v in request.settings.items():
             which = v.WhichOneof("parameter_choice")

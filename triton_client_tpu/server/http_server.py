@@ -140,6 +140,7 @@ def build_app(core: InferenceCore,
     r.add_post("/v2/logging", _h(core, _set_logging))
     r.add_get("/v2/debug/flight_recorder", _h(core, _flight_recorder))
     r.add_get("/v2/debug/device_stats", _h(core, _device_stats))
+    r.add_get("/v2/debug/costs", _h(core, _costs))
     r.add_get("/metrics", _h(core, _metrics))
     for kind in ("systemsharedmemory", "cudasharedmemory"):
         r.add_get(f"/v2/{kind}/status", _h(core, _shm_status))
@@ -189,6 +190,7 @@ def build_metrics_app(core: InferenceCore) -> web.Application:
     app.router.add_get("/metrics", _h(core, _metrics))
     app.router.add_get("/v2/debug/flight_recorder", _h(core, _flight_recorder))
     app.router.add_get("/v2/debug/device_stats", _h(core, _device_stats))
+    app.router.add_get("/v2/debug/costs", _h(core, _costs))
     return app
 
 
@@ -527,6 +529,17 @@ async def _device_stats(core, request):
         return json.dumps(out)
 
     body = await asyncio.get_running_loop().run_in_executor(None, _snap)
+    return web.Response(text=body, content_type="application/json")
+
+
+async def _costs(core, request):
+    """Debug surface for the per-tenant cost-attribution ledger
+    (server/costs.py): device-time, FLOPs, generated tokens, and KV
+    byte-seconds per (model, tenant).  ``?model=`` filters to one
+    model's tenants.  Off-loop like the other debug snapshots."""
+    model = request.query.get("model") or None
+    body = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: json.dumps(core.cost_ledger.snapshot(model=model)))
     return web.Response(text=body, content_type="application/json")
 
 

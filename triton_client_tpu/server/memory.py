@@ -120,6 +120,15 @@ class MemoryGovernor:
         self._pressure_until = 0.0
         self.pressure_events = 0
         self._known_tenants: set = set()
+        # pinned-KV lifetime integrator (cost attribution's ground truth
+        # for KV byte-seconds): handle -> (model, tenant, nbytes, t0)
+        self._kv_pins: Dict[int, Tuple[str, str, int, float]] = {}
+        self._kv_next_handle = 1
+        self._kv_pinned_by_model: Dict[str, int] = {}
+        # released byte-seconds per (model, tenant) — the reconciliation
+        # counterpart the CostLedger's nv_cost_kv_byte_seconds_total must
+        # match (the ledger is charged with exactly kv_unpin's return)
+        self.kv_byte_seconds: Dict[Tuple[str, str], float] = {}
 
     # -- budget ------------------------------------------------------------
     def effective_budget(self, now: Optional[float] = None) -> int:
@@ -289,6 +298,51 @@ class MemoryGovernor:
         err.shed_reason = "memory"
         raise err
 
+    # -- pinned-KV lifetime accounting -------------------------------------
+    def kv_pin(self, model: str, nbytes: int, tenant: str = "",
+               now: Optional[float] = None) -> int:
+        """Start the lifetime clock on a generation slot's pinned KV
+        bytes (call at slot admission, right after the HBM gate).
+        Returns a handle for :meth:`kv_unpin`.  The integrator is the
+        governor's ground truth for KV byte-seconds: the cost ledger is
+        charged with exactly what :meth:`kv_unpin` returns, so the two
+        reconcile by construction."""
+        nbytes = max(0, int(nbytes))
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            tenant = self._track_tenant_locked(tenant)
+            handle = self._kv_next_handle
+            self._kv_next_handle += 1
+            self._kv_pins[handle] = (model, tenant, nbytes, now)
+            if nbytes:
+                self._kv_pinned_by_model[model] = \
+                    self._kv_pinned_by_model.get(model, 0) + nbytes
+        return handle
+
+    def kv_unpin(self, handle: int,
+                 now: Optional[float] = None) -> Tuple[str, float]:
+        """Stop a pinned slot's clock; returns ``(tenant, byte_seconds)``
+        for the held interval (``("", 0.0)`` for an unknown/double-freed
+        handle — release paths may race on cancellation and the
+        integrator must not double-count)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self._kv_pins.pop(handle, None)
+            if entry is None:
+                return "", 0.0
+            model, tenant, nbytes, t0 = entry
+            if nbytes:
+                left = self._kv_pinned_by_model.get(model, 0) - nbytes
+                if left > 0:
+                    self._kv_pinned_by_model[model] = left
+                else:
+                    self._kv_pinned_by_model.pop(model, None)
+            byte_seconds = nbytes * max(0.0, now - t0)
+            key = (model, tenant)
+            self.kv_byte_seconds[key] = \
+                self.kv_byte_seconds.get(key, 0.0) + byte_seconds
+        return tenant, byte_seconds
+
     # -- export ------------------------------------------------------------
     def shed_total(self) -> int:
         with self._lock:
@@ -303,12 +357,14 @@ class MemoryGovernor:
             shed = sorted(self.shed.items())
             budget = (self._effective_budget_locked(time.monotonic())
                       if self.budget_bytes > 0 else None)
+            kv_pinned = sorted(self._kv_pinned_by_model.items())
         rows: Dict[str, List[Tuple[Dict[str, str], Any]]] = {
             "inflight": [({"model": m}, v) for m, v in by_model],
             "budget": ([({}, budget)] if budget is not None else []),
             "shed": [({"model": m, "tenant": t, "tier": str(tier),
                        "reason": reason}, v)
                      for (m, t, tier, reason), v in shed],
+            "kv_pinned": [({"model": m}, v) for m, v in kv_pinned],
             "hbm_headroom": [],
         }
         try:
@@ -348,6 +404,16 @@ class MemoryGovernor:
                      "reason": reason, "count": v}
                     for (m, t, tier, reason), v in sorted(self.shed.items())
                 ],
+                "kv": {
+                    "pinned_bytes_by_model": dict(self._kv_pinned_by_model),
+                    "active_pins": len(self._kv_pins),
+                    "byte_seconds_total": [
+                        {"model": m, "tenant": t,
+                         "byte_seconds": round(v, 6)}
+                        for (m, t), v in sorted(
+                            self.kv_byte_seconds.items())
+                    ],
+                },
             }
         out["hbm_headroom_bytes"] = self.hbm_headroom()
         return out

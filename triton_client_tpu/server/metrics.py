@@ -125,6 +125,14 @@ _DEVICE_FAMILIES: List[Tuple[str, str, str, str]] = [
     ("pad_waste", "nv_tpu_pad_waste_ratio", "gauge",
      "Cumulative padded-but-unused fraction of executed batch slots per "
      "model and bucket"),
+    ("roofline_ai", "nv_tpu_roofline_arithmetic_intensity", "gauge",
+     "XLA cost-analysis arithmetic intensity (FLOPs per byte accessed) "
+     "per model and bucket — compare against the chip ridge point "
+     "(TRITON_TPU_PEAK_FLOPS / TRITON_TPU_PEAK_BYTES_PER_S)"),
+    ("roofline_pct", "nv_tpu_roofline_pct_of_peak", "gauge",
+     "Achieved percent of the bound resource's peak (peak FLOP/s when "
+     "compute_bound, peak bytes/s when memory_bound) per model and "
+     "bucket, with the roofline verdict as a label"),
     ("mem_used", "nv_tpu_memory_used_bytes", "gauge",
      "Device HBM bytes currently in use"),
     ("mem_peak", "nv_tpu_memory_peak_bytes", "gauge",
@@ -170,6 +178,31 @@ _MEM_FAMILIES: List[Tuple[str, str, str, str]] = [
     ("hbm_headroom", "nv_mem_hbm_headroom_bytes", "gauge",
      "Device HBM headroom (bytes_limit - bytes_in_use) per device — the "
      "budget generation slot admission projects KV bytes against"),
+    ("kv_pinned", "nv_mem_kv_pinned_bytes", "gauge",
+     "KV-cache bytes currently pinned by admitted generation slots per "
+     "model (the governor's live pin ledger; byte-seconds accrue in "
+     "nv_cost_kv_byte_seconds_total)"),
+]
+
+#: ``nv_cost_*`` family declarations, keyed by the short row names
+#: ``CostLedger.metric_rows`` emits (server/costs.py).  Tenant labels
+#: are bounded by the ledger's ~overflow folding rule.
+_COST_FAMILIES: List[Tuple[str, str, str, str]] = [
+    ("device_us", "nv_cost_device_us_total", "counter",
+     "Attributed device-time in microseconds per model and tenant (each "
+     "request's slot-share of its batch's compute window; sums to the "
+     "duty-cycle compute window)"),
+    ("flops", "nv_cost_flops_total", "counter",
+     "Attributed FLOPs per model and tenant (slot-share of the "
+     "signature's XLA cost-analysis FLOPs; absent when analysis is "
+     "unavailable, never fabricated)"),
+    ("tokens", "nv_cost_tokens_total", "counter",
+     "Generated tokens attributed per model and tenant by the decode "
+     "worker"),
+    ("kv_byte_seconds", "nv_cost_kv_byte_seconds_total", "counter",
+     "KV-cache byte-seconds attributed per model and tenant (pinned "
+     "bytes integrated over each generation slot's admit..release "
+     "lifetime; reconciles with the memory governor's pin ledger)"),
 ]
 
 #: ``nv_slo_*`` family declarations, keyed by ``SloEngine.metric_rows``.
@@ -300,6 +333,11 @@ def collect_families(core: InferenceCore) -> List[Family]:
     slo_rows = core.slo.metric_rows()
     for key, name, kind, help_text in _SLO_FAMILIES:
         families.append((name, help_text, kind, slo_rows.get(key, [])))
+
+    # -- per-tenant cost attribution (server/costs.py) ---------------------
+    cost_rows = core.cost_ledger.metric_rows()
+    for key, name, kind, help_text in _COST_FAMILIES:
+        families.append((name, help_text, kind, cost_rows.get(key, [])))
 
     # -- fleet operations (server/fleet.py) --------------------------------
     from .fleet import collect_fleet_rows

@@ -308,12 +308,17 @@ class JaxModel(Model):
         host_post: Optional[Callable] = None,
         donate_argnames: Optional[Sequence[str]] = None,
         output_labels: Optional[Dict[str, List[str]]] = None,
+        analyzable: Optional[bool] = None,
     ):
         super().__init__(config)
         if jit:
             import jax
 
             fn = jax.jit(fn, donate_argnames=donate_argnames)
+        # XLA cost analysis re-traces fn; that is invisible for a jitted
+        # pure function, but a jit=False fn may carry host side effects,
+        # so those models must declare tracing-safety to opt in
+        self._analyzable = jit if analyzable is None else analyzable
         self._fn = fn
         self._host_pre = host_pre
         self._host_post = host_post
@@ -335,6 +340,35 @@ class JaxModel(Model):
 
     def labels(self, output_name: str) -> Optional[List[str]]:
         return self._output_labels.get(output_name)
+
+    def analyze_cost(self, inputs: Dict[str, Any],
+                     parameters: Optional[Dict[str, Any]] = None):
+        """XLA cost analysis for one concrete input signature: AOT-lower
+        the compute function (nothing executes) and extract scheduled
+        FLOPs / bytes accessed / memory breakdown.  Mirrors ``execute``'s
+        graph — same host_pre transform, same device — so the analyzed
+        program is the one the signature actually runs.  Returns a
+        ``costs.SignatureCost`` or None (backend exposes no analysis, fn
+        untraceable standalone, ...); never raises — the core calls this
+        once per new signature right after the first execution."""
+        import jax
+
+        from .costs import analyze_jax_callable
+
+        if not self._analyzable:
+            # analysis AOT-lowers through a fresh jit, which re-traces the
+            # python body — for a jit=False model that never declared
+            # tracing-safety the re-trace is a visible side effect
+            return None
+        try:
+            if self._device is None:
+                self._device = resolve_instance_device(self.config)
+            if self._host_pre is not None:
+                inputs = self._host_pre(dict(inputs), parameters or {})
+            with jax.default_device(self._device):
+                return analyze_jax_callable(self._fn, **inputs)
+        except Exception:  # noqa: BLE001 — observability must never raise
+            return None
 
 
 class PyModel(Model):

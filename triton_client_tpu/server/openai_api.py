@@ -386,17 +386,26 @@ def _envelope(rid: str, created: int, model: str, kind: str, chat: bool,
             "choices": choices}
 
 
-async def _consume(core, req, scanner: _StopScanner, emit) -> str:
+async def _consume(core, req, scanner: _StopScanner, emit,
+                   cost_out: Optional[dict] = None) -> str:
     """Drive one generation stream through the stop scanner, calling
     ``await emit(text, lps)`` for each releasable span — ``lps`` is the
     span's per-character logprob records (None entries for chars beyond a
     multi-char token's first; exact 1:1 under the byte models).  Returns
     the finish reason.  Closing the stream early (stop hit) propagates
     through ``infer_stream`` to the model generator, which frees its
-    decode slot instead of generating unread tokens."""
+    decode slot instead of generating unread tokens.  ``cost_out``
+    collects the stream's attributed device-time (the final response's
+    ``device_time_us`` parameter, from the cost ledger) when the server
+    measured one — absent otherwise, never fabricated."""
     agen = core.infer_stream(req)
     try:
         async for resp in agen:
+            if cost_out is not None:
+                dev = (resp.parameters or {}).get("device_time_us")
+                if dev is not None:
+                    cost_out["device_time_us"] = (
+                        cost_out.get("device_time_us", 0.0) + float(dev))
             texts = lps = None
             for t in resp.outputs:
                 if t.data is None:
@@ -481,8 +490,10 @@ async def _run(core, request, chat: bool):
                     for k, (ch, lp) in enumerate(zip(text, lps))
                     if lp is not None)
 
-            finish = await _consume(core, req, scanner, emit)
-            return "".join(pieces), scanner.tokens, finish, records
+            cost: Dict[str, float] = {}
+            finish = await _consume(core, req, scanner, emit, cost)
+            return ("".join(pieces), scanner.tokens, finish, records,
+                    cost.get("device_time_us"))
 
         # fail fast: the first failing choice (e.g. 429 slot exhaustion)
         # cancels its siblings instead of letting them generate to
@@ -495,7 +506,11 @@ async def _run(core, request, chat: bool):
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             raise
-        completion_tokens = sum(t for _, t, _f, _l in results)
+        completion_tokens = sum(t for _, t, _f, _l, _d in results)
+        # real attributed device microseconds (cost ledger via the decode
+        # worker) — summed over every candidate generated, like token
+        # usage; omitted entirely when the server didn't measure any
+        device_us = [d for *_rest, d in results if d is not None]
         if pr.best_of > pr.n:
             # rank candidates by mean chosen-token logprob (OpenAI: "the
             # one with the highest log probability per token") and return
@@ -507,7 +522,7 @@ async def _run(core, request, chat: bool):
 
             results = sorted(results, key=mean_lp, reverse=True)[:pr.n]
         choices = []
-        for i, (text, _tokens, finish, records) in enumerate(results):
+        for i, (text, _tokens, finish, records, _dev) in enumerate(results):
             if pr.echo:
                 text = prompt + text
             entry = _choice(i, "full", text, finish, chat)
@@ -520,6 +535,8 @@ async def _run(core, request, chat: bool):
             "completion_tokens": completion_tokens,
             "total_tokens": len(prompt.encode()) + completion_tokens,
         }
+        if device_us:
+            out["usage"]["device_time_us"] = round(sum(device_us), 1)
         return web.json_response(out)
 
     # streaming: choices run concurrently; their deltas interleave as SSE
@@ -530,6 +547,7 @@ async def _run(core, request, chat: bool):
     from .http_server import sse_stream
 
     completion_total = [0]
+    device_total = [0.0, False]  # [sum_us, any_measured]
 
     async def merged():
         q: asyncio.Queue = asyncio.Queue()
@@ -560,9 +578,12 @@ async def _run(core, request, chat: bool):
                 await q.put((i, "delta", (text, records)))
 
             try:
-                finish = await _consume(core, req, scanner, emit)
+                cost: Dict[str, float] = {}
+                finish = await _consume(core, req, scanner, emit, cost)
                 await put_echo()  # zero-delta generations still echo
-                await q.put((i, "finish", (finish, scanner.tokens)))
+                await q.put((i, "finish",
+                             (finish, scanner.tokens,
+                              cost.get("device_time_us"))))
             except Exception as e:  # noqa: BLE001 — re-raised by the reader
                 await q.put((i, "error", e))
 
@@ -578,6 +599,9 @@ async def _run(core, request, chat: bool):
                 if kind == "finish":
                     open_choices -= 1
                     completion_total[0] += payload[1]
+                    if payload[2] is not None:
+                        device_total[0] += payload[2]
+                        device_total[1] = True
                 yield i, kind, payload
         finally:
             for t in tasks:
@@ -608,6 +632,8 @@ async def _run(core, request, chat: bool):
                 "completion_tokens": completion_total[0],
                 "total_tokens": p_toks + completion_total[0],
             }
+            if device_total[1]:
+                frame["usage"]["device_time_us"] = round(device_total[0], 1)
             await stream.write(sse_frame(json.dumps(frame)))
         await stream.write(sse_frame("[DONE]"))
 

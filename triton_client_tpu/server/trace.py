@@ -200,7 +200,7 @@ class TraceContext:
     __slots__ = ("_tracer", "id", "model_name", "model_version",
                  "timestamps", "path", "client_request_id", "traceparent",
                  "spans", "log_frequency", "_root", "_done", "sampled",
-                 "flight", "tick", "outcome")
+                 "flight", "tick", "outcome", "cost")
 
     def __init__(self, tracer: "RequestTracer", trace_id: int,
                  model_name: str, model_version: str, path: str,
@@ -232,6 +232,11 @@ class TraceContext:
         # (mark_failed) — streamed records emit it so a cancelled/errored
         # generation is tellable from a drained one in the trace file
         self.outcome = "ok"
+        # cost-attribution stamp (server/costs.py): the tenant's share of
+        # the batched compute window this request rode ({"tenant",
+        # "device_us", ...}) — emitted with the record and mirrored on
+        # the flight record
+        self.cost = None
 
     def ts(self, name: str, ns: Optional[int] = None) -> None:
         if not self.sampled:
@@ -614,6 +619,10 @@ class RequestTracer:
             # the batcher tick this request rode (bucket, occupancy, pad
             # waste, queue depth) — trace_summary folds these per bucket
             record["tick"] = ctx.tick
+        if ctx.cost is not None:
+            # per-tenant cost stamp: this request's attributed share of
+            # the batched compute window (server/costs.py)
+            record["cost"] = ctx.cost
         if isinstance(ctx, StreamTraceContext):
             # stream records additionally carry the token count, the close
             # outcome, and the decode ticks the sequence rode (tick_seq is

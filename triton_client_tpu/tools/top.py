@@ -154,7 +154,9 @@ def parse_device(text: str) -> Dict[str, Any]:
         if name not in ("nv_tpu_duty_cycle", "nv_tpu_live_mfu",
                         "nv_slo_burn_rate", "nv_fleet_instances",
                         "nv_fleet_serving_version", "nv_fleet_scale_total",
-                        "nv_mem_inflight_bytes", "nv_mem_shed_total"
+                        "nv_mem_inflight_bytes", "nv_mem_shed_total",
+                        "nv_tpu_roofline_arithmetic_intensity",
+                        "nv_tpu_roofline_pct_of_peak"
                         ) and name not in _BUCKET_METRICS:
             continue
         labels = dict(_LABEL_RE.findall(labels_raw or ""))
@@ -181,6 +183,17 @@ def parse_device(text: str) -> Dict[str, Any]:
             # per model; the reason split stays on the metrics surface
             out["mem_shed"][model] = (out["mem_shed"].get(model, 0.0)
                                       + float(value))
+        elif name == "nv_tpu_roofline_arithmetic_intensity":
+            # gauges, not counters: the buckets view shows the current
+            # value, never a delta
+            entry = out["buckets"].setdefault(
+                (model, labels.get("bucket", "")), {})
+            entry["roofline_ai"] = float(value)
+        elif name == "nv_tpu_roofline_pct_of_peak":
+            entry = out["buckets"].setdefault(
+                (model, labels.get("bucket", "")), {})
+            entry["roofline_pct"] = float(value)
+            entry["roofline_verdict"] = labels.get("verdict", "")
         else:
             bucket = labels.get("bucket", "")
             entry = out["buckets"].setdefault((model, bucket), {})
@@ -216,6 +229,38 @@ def parse_qos(text: str) -> Dict[str, Dict[tuple, float]]:
     return out
 
 
+#: nv_cost_* families folded into the COST view, keyed by the short
+#: field name the rows use.
+_COST_METRICS = {
+    "nv_cost_device_us_total": "device_us",
+    "nv_cost_flops_total": "flops",
+    "nv_cost_tokens_total": "tokens",
+    "nv_cost_kv_byte_seconds_total": "kv_byte_seconds",
+}
+
+
+def parse_costs(text: str) -> Dict[tuple, Dict[str, float]]:
+    """Per-tenant cost-attribution series -> ``{(model, tenant):
+    {field: v}}``.  Servers predating the cost ledger simply produce an
+    empty map."""
+    out: Dict[tuple, Dict[str, float]] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value = m.groups()
+        field = _COST_METRICS.get(name)
+        if field is None:
+            continue
+        labels = dict(_LABEL_RE.findall(labels_raw or ""))
+        key = (labels.get("model", ""), labels.get("tenant", ""))
+        entry = out.setdefault(key, {})
+        entry[field] = entry.get(field, 0.0) + float(value)
+    return out
+
+
 def sample(base_url: str, timeout: float, limit: int = 0) -> Dict[str, Any]:
     """One poll of both surfaces, monotonic-stamped for rate deltas."""
     recorder_url = f"{base_url}/v2/debug/flight_recorder"
@@ -227,6 +272,7 @@ def sample(base_url: str, timeout: float, limit: int = 0) -> Dict[str, Any]:
         "metrics": parse_metrics(metrics_text),
         "qos": parse_qos(metrics_text),
         "device": parse_device(metrics_text),
+        "costs": parse_costs(metrics_text),
         "recorder": json.loads(_fetch(recorder_url, timeout)),
     }
 
@@ -404,6 +450,12 @@ def bucket_rows(cur: Dict[str, Any],
                                if ticks else None),
             "uploads_per_tick": (round(delta("uploads") / ticks, 2)
                                  if ticks else None),
+            # roofline gauges (XLA cost analysis): current value, not a
+            # delta — absent when the server has no analysis for this
+            # bucket (never fabricated)
+            "roofline_ai": cum.get("roofline_ai"),
+            "roofline_pct": cum.get("roofline_pct"),
+            "roofline_verdict": cum.get("roofline_verdict"),
         }
     return rows
 
@@ -444,6 +496,14 @@ def aggregate_buckets(per_url: Dict[str, Dict[tuple, Dict[str, Any]]]
             # steady-state value is the regression smell
             "steps_per_tick": _least("steps_per_tick"),
             "uploads_per_tick": _worst("uploads_per_tick"),
+            # roofline: AI is a compile-time property (identical across
+            # replicas) — any value serves; the achieved %-of-peak takes
+            # the worst (hottest) replica, and its verdict rides along
+            "roofline_ai": _worst("roofline_ai"),
+            "roofline_pct": _worst("roofline_pct"),
+            "roofline_verdict": next(
+                (r["roofline_verdict"] for r in rows
+                 if r.get("roofline_verdict")), None),
         }
     return agg
 
@@ -521,6 +581,107 @@ def _tenant_lines(rows: Dict[str, Dict[str, Any]]) -> List[str]:
         shed_s = "  ".join(
             f"t{t}={_fmt(v)}" for t, v in sorted(shed.items())) or "-"
         lines.append(f"  {tenant:<24}{_fmt(req):>12}  {shed_s}")
+    return lines
+
+
+def cost_rows(cur: Dict[str, Any],
+              prev: Optional[Dict[str, Any]]) -> Dict[tuple, Dict[str, Any]]:
+    """Per-(model, tenant) cost-attribution rows — the COST view.  Rate
+    columns are deltas between polls (cumulative counters on the
+    first/only sample); device-time and unit-cost columns derive from
+    the same window so they always agree with each other."""
+    costs = cur.get("costs") or {}
+    pcosts = (prev.get("costs") or {}) if prev else None
+    dt = (cur["t"] - prev["t"]) if prev else None
+    rows: Dict[tuple, Dict[str, Any]] = {}
+    for key, cum in sorted(costs.items()):
+        pcum = pcosts.get(key) if pcosts is not None else None
+
+        def delta(field: str) -> float:
+            now = cum.get(field, 0.0)
+            if pcum is None:
+                return now
+            d = now - pcum.get(field, 0.0)
+            return now if d < 0 else d  # counter reset = server restart
+
+        dev_us, tokens = delta("device_us"), delta("tokens")
+        rows[key] = {
+            "device_us": round(cum.get("device_us", 0.0), 1),
+            "tokens": int(cum.get("tokens", 0.0)),
+            "flops": cum.get("flops", 0.0),
+            "kv_byte_seconds": round(cum.get("kv_byte_seconds", 0.0), 3),
+            # DEVms/s: attributed device-milliseconds per wall second —
+            # a tenant's share of the accelerator, directly comparable
+            # across tenants and against the duty-cycle column
+            "device_ms_per_s": (round(dev_us / dt / 1e3, 2)
+                                if dt else None),
+            "tokens_per_s": round(tokens / dt, 1) if dt else None,
+            "gflops_per_s": (round(delta("flops") / dt / 1e9, 1)
+                             if dt else None),
+            # unit cost: device-microseconds per generated token over
+            # the delta window (the billing-grade efficiency number)
+            "us_per_token": (round(dev_us / tokens, 1)
+                             if tokens else None),
+        }
+    return rows
+
+
+def aggregate_costs(per_url: Dict[str, Dict[tuple, Dict[str, Any]]]
+                    ) -> Dict[tuple, Dict[str, Any]]:
+    """Sum per-server cost rows into fleet rows (everything here is
+    additive work done; rate columns sum over replicas with a delta
+    base; unit cost re-derives from the summed window)."""
+    agg: Dict[tuple, Dict[str, Any]] = {}
+    keys: set = set()
+    for rows in per_url.values():
+        keys.update(rows)
+    for key in sorted(keys):
+        rows = [r[key] for r in per_url.values() if key in r]
+
+        def _sum(field, nd=1):
+            vals = [r[field] for r in rows if r.get(field) is not None]
+            return round(sum(vals), nd) if vals else None
+
+        dev_us = sum(r.get("device_us", 0.0) for r in rows)
+        tokens = sum(r.get("tokens", 0) for r in rows)
+        agg[key] = {
+            "device_us": round(dev_us, 1),
+            "tokens": int(tokens),
+            "flops": sum(r.get("flops", 0.0) for r in rows),
+            "kv_byte_seconds": round(
+                sum(r.get("kv_byte_seconds", 0.0) for r in rows), 3),
+            "device_ms_per_s": _sum("device_ms_per_s", nd=2),
+            "tokens_per_s": _sum("tokens_per_s"),
+            "gflops_per_s": _sum("gflops_per_s"),
+            "us_per_token": (round(dev_us / tokens, 1)
+                             if tokens else None),
+        }
+    return agg
+
+
+def _cost_lines(rows: Dict[tuple, Dict[str, Any]]) -> List[str]:
+    """The COST view: one line per (model, tenant) with attributed
+    device-time, token throughput, FLOP rate, and unit cost — the
+    who-is-spending-the-accelerator surface."""
+    if not rows:
+        return []
+    rated = any(r.get("device_ms_per_s") is not None for r in rows.values())
+    lines = ["", f"  {'MODEL/TENANT':<24}"
+                 + (f"{'DEVms/s':>9}" if rated else f"{'DEVms':>9}")
+                 + (f"{'TOK/s':>8}" if rated else f"{'TOKENS':>8}")
+                 + (f"{'GFLOP/s':>9}" if rated else "")
+                 + f"{'us/TOK':>10}{'KV GB*s':>9}"]
+    for (model, tenant), r in sorted(rows.items()):
+        label = f"{model}/{tenant or '-'}"
+        dev = (r["device_ms_per_s"] if rated
+               else round(r["device_us"] / 1e3, 1))
+        tok = r["tokens_per_s"] if rated else r["tokens"]
+        line = f"  {label:<24}{_fmt(dev, 2):>9}{_fmt(tok):>8}"
+        if rated:
+            line += f"{_fmt(r['gflops_per_s']):>9}"
+        line += (f"{_fmt(r['us_per_token']):>10}"
+                 f"{_fmt(r['kv_byte_seconds'] / 1e9, 3):>9}")
+        lines.append(line)
     return lines
 
 
@@ -695,24 +856,36 @@ def _bucket_lines(rows: Dict[tuple, Dict[str, Any]]) -> List[str]:
     tick_hdr = "TICK/s" if rated else "TICKS"
     lines = ["", f"  {'MODEL/BUCKET':<24}{tick_hdr:>8}{'AVGBATCH':>10}"
                  f"{'PAD%':>7}{'ASM us':>9}{'QDEPTH':>8}{'SYNC/T':>8}"
-                 f"{'STEP/T':>8}{'UPL/T':>8}"]
+                 f"{'STEP/T':>8}{'UPL/T':>8}{'AI':>8}  ROOFLINE"]
     for (model, bucket), r in sorted(
             rows.items(), key=lambda kv: (kv[0][0], _bucket_rank(kv[0][1]))):
         ticks = r["ticks_per_s"] if rated else r.get("ticks")
+        # roofline verdict + achieved %-of-peak, e.g. "mem 38%": which
+        # wall this bucket leans on and how hard it pushes it — "-" when
+        # XLA cost analysis is unavailable, never a fabricated value
+        verdict = r.get("roofline_verdict")
+        if verdict:
+            roof = "comp" if verdict == "compute_bound" else "mem"
+            if r.get("roofline_pct") is not None:
+                roof += f" {r['roofline_pct']:.0f}%"
+        else:
+            roof = "-"
         lines.append(
             f"  {model + '@' + str(bucket):<24}{_fmt(ticks):>8}"
             f"{_fmt(r['avg_batch']):>10}{_fmt(r['pad_pct']):>7}"
             f"{_fmt(r['avg_assembly_us']):>9}{_fmt(r['avg_queue_depth']):>8}"
             f"{_fmt(r['syncs_per_tick'], 2):>8}"
             f"{_fmt(r.get('steps_per_tick'), 2):>8}"
-            f"{_fmt(r.get('uploads_per_tick'), 2):>8}")
+            f"{_fmt(r.get('uploads_per_tick'), 2):>8}"
+            f"{_fmt(r.get('roofline_ai')):>8}  {roof}")
     return lines
 
 
 def render(url: str, cur: Dict[str, Any],
            rows: Dict[str, Dict[str, Any]], interval: float,
            tenants: Optional[Dict[str, Dict[str, Any]]] = None,
-           buckets: Optional[Dict[tuple, Dict[str, Any]]] = None) -> str:
+           buckets: Optional[Dict[tuple, Dict[str, Any]]] = None,
+           costs: Optional[Dict[tuple, Dict[str, Any]]] = None) -> str:
     recorder = cur["recorder"]
     restarts = int(sum(
         ((cur.get("device") or {}).get("restarts") or {}).values()))
@@ -734,6 +907,7 @@ def render(url: str, cur: Dict[str, Any],
     if not rows:
         lines.append("  (no recorded requests yet)")
     lines.extend(_bucket_lines(buckets or {}))
+    lines.extend(_cost_lines(costs or {}))
     lines.extend(_tenant_lines(tenants or {}))
     return "\n".join(lines) + "\n"
 
@@ -743,6 +917,7 @@ def render_fleet(urls: List[str],
                  agg: Dict[str, Dict[str, Any]], interval: float,
                  tenants: Optional[Dict[str, Dict[str, Any]]] = None,
                  buckets: Optional[Dict[tuple, Dict[str, Any]]] = None,
+                 costs: Optional[Dict[tuple, Dict[str, Any]]] = None,
                  restarts: int = 0) -> str:
     """Fleet view: one aggregated row per model (sums + worst-replica
     tails) with a per-server breakdown row for every polled endpoint."""
@@ -764,6 +939,7 @@ def render_fleet(urls: List[str],
     if not agg:
         lines.append("  (no recorded requests yet)")
     lines.extend(_bucket_lines(buckets or {}))
+    lines.extend(_cost_lines(costs or {}))
     lines.extend(_tenant_lines(tenants or {}))
     return "\n".join(lines) + "\n"
 
@@ -774,6 +950,14 @@ def _buckets_json(rows: Dict[tuple, Dict[str, Any]]) -> Dict[str, Any]:
     for (model, bucket), r in sorted(
             rows.items(), key=lambda kv: (kv[0][0], _bucket_rank(kv[0][1]))):
         out.setdefault(model, {})[str(bucket)] = r
+    return out
+
+
+def _costs_json(rows: Dict[tuple, Dict[str, Any]]) -> Dict[str, Any]:
+    """Tuple-keyed cost rows -> ``{model: {tenant: row}}`` for JSON."""
+    out: Dict[str, Any] = {}
+    for (model, tenant), r in sorted(rows.items()):
+        out.setdefault(model, {})[tenant] = r
     return out
 
 
@@ -859,6 +1043,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         per_url = {}
         per_url_tenants = {}
         per_url_buckets = {}
+        per_url_costs = {}
         per_url_restarts = {}
         for base, s in cur.items():
             if s is None:
@@ -868,18 +1053,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                                        include_idle=args.include_idle)
             per_url_tenants[base] = tenant_rows(s, p)
             per_url_buckets[base] = bucket_rows(s, p)
+            per_url_costs[base] = cost_rows(s, p)
             per_url_restarts[base] = (s.get("device") or {}).get(
                 "restarts") or {}
         return (per_url, aggregate_rows(per_url),
                 aggregate_tenants(per_url_tenants),
                 aggregate_buckets(per_url_buckets),
+                aggregate_costs(per_url_costs),
                 aggregate_restarts(per_url_restarts))
 
     cur = sample_all()
     if all(s is None for s in cur.values()):
         return 1
     if args.once:
-        per_url, agg, tenants, buckets, restarts = fold(cur, None)
+        per_url, agg, tenants, buckets, costs, restarts = fold(cur, None)
         if args.as_json:
             if fleet:
                 out = {
@@ -888,6 +1075,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "models": agg,
                     "tenants": tenants,
                     "buckets": _buckets_json(buckets),
+                    "costs": _costs_json(costs),
                     "worker_restarts": restarts,
                     # per-endpoint samples: each server's rows + recorder
                     "endpoints": {
@@ -907,6 +1095,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "models": per_url.get(bases[0], {}),
                     "tenants": tenants,
                     "buckets": _buckets_json(buckets),
+                    "costs": _costs_json(costs),
                     "worker_restarts": restarts,
                     "recorder": cur[bases[0]]["recorder"],
                 }
@@ -914,13 +1103,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif fleet:
             sys.stdout.write(render_fleet(bases, per_url, agg,
                                           args.interval, tenants=tenants,
-                                          buckets=buckets,
+                                          buckets=buckets, costs=costs,
                                           restarts=restarts))
         else:
             sys.stdout.write(render(bases[0], cur[bases[0]],
                                     per_url.get(bases[0], {}),
                                     args.interval, tenants=tenants,
-                                    buckets=buckets))
+                                    buckets=buckets, costs=costs))
         return 0
 
     prev = cur
@@ -933,7 +1122,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # console alive and retry — monitoring must not die at
                 # exactly the moment the server gets interesting
                 continue
-            per_url, agg, tenants, buckets, restarts = fold(cur, prev)
+            per_url, agg, tenants, buckets, costs, restarts = fold(cur, prev)
             if args.as_json:
                 print(json.dumps({
                     "ts": time.time(),
@@ -941,6 +1130,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                               next(iter(per_url.values()), {}),
                     "tenants": tenants,
                     "buckets": _buckets_json(buckets),
+                    "costs": _costs_json(costs),
                     "worker_restarts": restarts,
                     **({"endpoints": {b: per_url.get(b)
                                       for b in bases}} if fleet else {}),
@@ -953,13 +1143,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                   args.interval,
                                                   tenants=tenants,
                                                   buckets=buckets,
+                                                  costs=costs,
                                                   restarts=restarts))
                 else:
                     sys.stdout.write(render(bases[0], cur[bases[0]],
                                             per_url.get(bases[0], {}),
                                             args.interval,
                                             tenants=tenants,
-                                            buckets=buckets))
+                                            buckets=buckets,
+                                            costs=costs))
                 sys.stdout.flush()
             # a server that missed THIS poll keeps its previous sample as
             # the delta base, so its next successful poll shows a sane rate

@@ -164,6 +164,9 @@ def summarize(server_records: List[dict],
     # dynamic batcher carry a "tick" object: bucket chosen, occupancy,
     # pad waste, queue depth, assembly cost)
     per_bucket: Dict[Tuple[str, int], Dict[str, Any]] = {}
+    # model -> tenant -> accumulated cost stamps (records attributed by
+    # the cost ledger carry a "cost" object: tenant, device_us, tokens)
+    per_model_cost: Dict[str, Dict[str, Dict[str, Any]]] = {}
     for rec in server_records:
         model = str(rec.get("model_name", "?"))
         stages = per_model_stage.setdefault(model, {})
@@ -193,6 +196,14 @@ def summarize(server_records: List[dict],
             for (n0, t0), (n1, t1) in zip(evs, evs[1:]):
                 if n1 > n0:
                     g["itl"].append(max(0, (t1 - t0) // (n1 - n0)))
+        cost = rec.get("cost")
+        if isinstance(cost, dict):
+            c = per_model_cost.setdefault(model, {}).setdefault(
+                str(cost.get("tenant", "")),
+                {"records": 0, "device_us": 0.0, "tokens": 0})
+            c["records"] += 1
+            c["device_us"] += float(cost.get("device_us") or 0.0)
+            c["tokens"] += int(cost.get("tokens") or 0)
         tick = rec.get("tick")
         if isinstance(tick, dict) and "bucket" in tick:
             agg = per_bucket.setdefault((model, int(tick["bucket"])), {
@@ -251,6 +262,19 @@ def summarize(server_records: List[dict],
             "avg_queue_depth": _avg(agg["depth"]),
             "avg_assembly_us": _avg(agg["assembly_us"]),
         }
+    for model, tenants in sorted(per_model_cost.items()):
+        entry = models.setdefault(model, {"count": 0, "request":
+                                          _stage_stats([]), "stages": {}})
+        # per-tenant attributed device-time over the SAMPLED records only
+        # (the cost ledger's /v2/debug/costs is the complete total; this
+        # table shows what the traced subset spent)
+        entry["costs"] = {
+            t: {"records": c["records"],
+                "device_us": round(c["device_us"], 1),
+                "tokens": c["tokens"],
+                "us_per_token": (round(c["device_us"] / c["tokens"], 1)
+                                 if c["tokens"] else None)}
+            for t, c in sorted(tenants.items())}
     summary: Dict[str, Any] = {
         "requests": len(server_records),
         "models": {m: models[m] for m in sorted(models)},
@@ -376,6 +400,17 @@ def format_text(summary: Dict[str, Any]) -> str:
                     f"{_fmt_val(b['pad_waste_pct']):>7}"
                     f"{_fmt_val(b['avg_queue_depth']):>8}"
                     f"{_fmt_val(b['avg_assembly_us']):>9}")
+        costs = entry.get("costs")
+        if costs:
+            # who spent the device time among the traced requests — the
+            # sampled-view companion to /v2/debug/costs
+            lines.append(f"  {'tenant':<16}{'records':>9}{'device_us':>12}"
+                         f"{'tokens':>8}{'us/tok':>8}")
+            for tenant, c in costs.items():
+                lines.append(
+                    f"  {tenant or '-':<16}{c['records']:>9}"
+                    f"{_fmt_val(c['device_us']):>12}{c['tokens']:>8}"
+                    f"{_fmt_val(c['us_per_token']):>8}")
     join = summary.get("join")
     if join is not None:
         lines.append("")
@@ -445,7 +480,17 @@ def chrome_trace(server_records: List[dict],
             args["tick_seqs"] = seqs
         if "outcome" in rec:
             args["outcome"] = rec["outcome"]
+        cost = rec.get("cost")
         for name, start, end in record_spans(rec):
+            span_args = args
+            if isinstance(cost, dict) and name in ("COMPUTE", "DECODE"):
+                # cost stamps ride the device-time spans: click a
+                # COMPUTE/DECODE slice in Perfetto and read who paid
+                # for it and at what unit cost
+                span_args = dict(args)
+                for k in ("tenant", "device_us", "tokens"):
+                    if k in cost:
+                        span_args[f"cost_{k}"] = cost[k]
             events.append({
                 "name": name,
                 "ph": "X",
@@ -454,7 +499,7 @@ def chrome_trace(server_records: List[dict],
                 "pid": 1,
                 "tid": tid,
                 "cat": "server",
-                "args": args,
+                "args": span_args,
             })
         for n, ns in token_events(rec):
             events.append({
