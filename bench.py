@@ -1004,6 +1004,247 @@ def _measure_gen_trace_overhead() -> dict:
     return out
 
 
+class _StubOtlpCollector:
+    """Loopback OTLP/HTTP sink for the journey A/B: counts the POSTed
+    ResourceSpans batches and spans so the traced arm provably exported,
+    without a collector dependency.  Only the first few bodies are fully
+    parsed (well-formedness proof); the rest are counted by substring —
+    a real collector parses OUT of process, and an in-process
+    ``json.loads`` of a 100-span batch holds the GIL for milliseconds,
+    which would bill collector CPU to the client/server under test."""
+
+    def __init__(self):
+        import http.server
+
+        self.posts = 0
+        self.spans = 0
+        self.wellformed = 0
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                size = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(size)
+                outer.posts += 1
+                if outer.wellformed < 3:
+                    try:
+                        parsed = json.loads(body)
+                        assert parsed["resourceSpans"][0]["scopeSpans"]
+                        outer.wellformed += 1
+                    except Exception:  # noqa: BLE001 — counted below anyway
+                        pass
+                outer.spans += body.count(b'"spanId"')
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self._srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+        self.endpoint = f"http://127.0.0.1:{self._srv.server_port}"
+
+    def close(self):
+        self._srv.shutdown()
+
+
+def _measure_journey_trace_overhead() -> dict:
+    """Journey-observability A/B (ISSUE 17): the same c=8 closed infer
+    loop and the streaming generate loop with the WHOLE journey plane on
+    — client attempt records (JSONL + OTLP export), retry-loop journey
+    scopes, server span tracing at trace_rate=1 with replica identity,
+    server OTLP export to a loopback stub collector — vs all tracing off.
+    BOTH arms run under RetryPolicy(max_attempts=3), so the delta
+    isolates the tracing/export cost, not the resilience wrapper (its
+    own leg).  Interleaved best-of windows per arm; acceptance is <= 3%
+    throughput with the usual single-host noise caveat (negative =
+    noise)."""
+    import gc
+    import tempfile
+
+    from triton_client_tpu._resilience import RetryPolicy
+    from triton_client_tpu._telemetry import telemetry
+    from triton_client_tpu.genai_perf import profile_generate
+    from triton_client_tpu.http import InferenceServerClient, InferInput
+    from triton_client_tpu.models import zoo
+    from triton_client_tpu.perf_analyzer import (_make_data, _resolve_model,
+                                                 run_level)
+    from triton_client_tpu.server.registry import ModelRegistry
+    from triton_client_tpu.server.testing import ServerHarness
+    from triton_client_tpu.tools.trace_summary import (load_trace_files,
+                                                       summarize,
+                                                       trace_id_of)
+
+    gc.collect()
+    out: dict = {"concurrency": 8, "trace_rate": 1}
+    collector = _StubOtlpCollector()
+    tmp = tempfile.mkdtemp(prefix="journey_bench_")
+    server_tf = os.path.join(tmp, "server.jsonl")
+    client_tf = os.path.join(tmp, "client.jsonl")
+    otlp_totals = {"ok": 0, "error": 0, "dropped": 0}
+
+    def detach(h):
+        """Tracing fully off: trace_level OFF, both exporters drained,
+        detached, and their counters folded into the leg totals."""
+        h.core.trace_settings["trace_level"] = ["OFF"]
+        srv, h.core.tracer.otlp = h.core.tracer.otlp, None
+        cli = telemetry().otlp_exporter
+        telemetry().disable_tracing()
+        telemetry().disable_otlp()
+        for ex in (srv, cli):
+            if ex is not None:
+                ex.flush(10.0)
+                for k, v in ex.counters().items():
+                    otlp_totals[k] += v
+                ex.shutdown()
+
+    def attach(h):
+        h.core.trace_settings.update({
+            "trace_level": ["TIMESTAMPS"], "trace_file": [server_tf],
+            "trace_rate": ["1"], "trace_count": ["-1"],
+            "log_frequency": ["0"]})
+        h.core.tracer.settings_updated()
+        h.core.enable_otlp(collector.endpoint, replica=h.replica)
+        telemetry().enable_tracing(client_tf)
+        telemetry().enable_otlp(collector.endpoint)
+
+    policy = RetryPolicy(max_attempts=3, retry_infer=True)
+    try:
+        registry = ModelRegistry()
+        registry.register_model(zoo.make_simple())
+        with ServerHarness(registry) as h:
+            url = f"127.0.0.1:{h.http_port}"
+            with InferenceServerClient(url) as warm:
+                a = np.arange(16, dtype=np.int32).reshape(1, 16)
+                i0 = InferInput("INPUT0", [1, 16], "INT32")
+                i0.set_data_from_numpy(a)
+                i1 = InferInput("INPUT1", [1, 16], "INT32")
+                i1.set_data_from_numpy(a)
+                warm.infer("simple", [i0, i1])
+            meta = InferenceServerClient(url)
+            pa_inputs, pa_outputs, pa_max_batch = _resolve_model(
+                meta, "http", "simple", "")
+            meta.close()
+            arrays = _make_data(pa_inputs, {}, 1, pa_max_batch,
+                                np.random.default_rng(0))
+
+            def window():
+                return run_level("http", url, "simple", "", 8, arrays,
+                                 pa_outputs, "none", 1 << 20, 2.0,
+                                 warmup_s=0.5, retry_policy=policy)
+
+            off = traced = None
+            for _ in range(3):
+                detach(h)
+                w = window()
+                if not w["errors"] and (off is None or
+                                        w["throughput"] > off["throughput"]):
+                    off = w
+                attach(h)
+                w = window()
+                if not w["errors"] and (
+                        traced is None
+                        or w["throughput"] > traced["throughput"]):
+                    traced = w
+            detach(h)  # final drain folds the last window's counters in
+            infer: dict = {}
+            if off is not None:
+                infer["off_infer_per_sec"] = round(off["throughput"], 2)
+                if np.isfinite(off["p99_us"]):
+                    infer["off_p99_ms"] = round(off["p99_us"] / 1e3, 3)
+            if traced is not None:
+                infer["traced_infer_per_sec"] = round(
+                    traced["throughput"], 2)
+                if np.isfinite(traced["p99_us"]):
+                    infer["traced_p99_ms"] = round(
+                        traced["p99_us"] / 1e3, 3)
+            if off and traced and off["throughput"]:
+                infer["overhead_pct"] = round(
+                    100.0 * (1.0 - traced["throughput"]
+                             / off["throughput"]), 1)
+            out["infer"] = infer
+            # journey cross-check over the traced windows' files: every
+            # client-visible journey reconstructs (count == complete)
+            try:
+                server_recs = load_trace_files([server_tf + "*"])
+                client_recs = load_trace_files([client_tf])
+                jo = summarize(server_recs, client_recs).get("journeys")
+                if jo:
+                    out["journeys"] = {"count": jo["count"],
+                                       "complete": jo["complete"]}
+                out["traced_client_records"] = len(client_recs)
+                out["traced_server_records"] = len(
+                    [r for r in server_recs if trace_id_of(r)])
+            except (OSError, ValueError) as e:
+                out["journeys_error"] = str(e)[:120]
+    except Exception as e:  # noqa: BLE001 — observability leg never kills bench
+        out["infer_error"] = str(e)[:120]
+
+    # streaming half: tiny CPU generate preset, off vs fully-traced arms
+    keys = ("TRITON_TPU_DECODE_MODE", "TRITON_TPU_DECODE_SLOTS",
+            "TRITON_TPU_PREFILL_CHUNK", "TRITON_TPU_DECODE_BUCKETS",
+            "TRITON_TPU_KV_QUANT", "TRITON_TPU_DECODE_STEPS")
+    saved = {k: os.environ.get(k) for k in keys}
+    for k in keys:
+        os.environ.pop(k, None)
+    os.environ["TRITON_TPU_DECODE_MODE"] = "batched"
+    os.environ["TRITON_TPU_DECODE_SLOTS"] = "4"
+    gc.collect()
+    try:
+        registry = ModelRegistry()
+        zoo.register_all(registry)
+        with ServerHarness(registry) as h:
+            url = f"127.0.0.1:{h.http_port}"
+            profile_generate(url, "llama_generate", concurrency=1,
+                             output_tokens=2, num_requests=1,
+                             stream_timeout=1800.0)
+
+            def gen_window():
+                rep = profile_generate(url, "llama_generate",
+                                       concurrency=4, output_tokens=24,
+                                       num_requests=12,
+                                       stream_timeout=1800.0)
+                if rep["errors"]:
+                    return None
+                return round(rep["output_token_throughput_per_sec"], 1)
+
+            g_off = g_traced = None
+            for _ in range(2):
+                detach(h)
+                w = gen_window()
+                if w and (g_off is None or w > g_off):
+                    g_off = w
+                attach(h)
+                w = gen_window()
+                if w and (g_traced is None or w > g_traced):
+                    g_traced = w
+            detach(h)
+            stream: dict = {}
+            if g_off is not None:
+                stream["off_tok_per_s"] = g_off
+            if g_traced is not None:
+                stream["traced_tok_per_s"] = g_traced
+            if g_off and g_traced:
+                stream["overhead_pct"] = round(
+                    100.0 * (1.0 - g_traced / g_off), 1)
+            out["streaming"] = stream
+    except Exception as e:  # noqa: BLE001 — observability leg never kills bench
+        out["streaming_error"] = str(e)[:120]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        collector.close()
+    out["otlp"] = dict(otlp_totals,
+                       collector_posts=collector.posts,
+                       collector_spans=collector.spans,
+                       wellformed_batches=collector.wellformed)
+    return out
+
+
 def _measure_bert_int8() -> dict:
     """int8 BERT serving leg (r5): same sweep as _measure_bert_mfu but with
     TRITON_TPU_QUANT_BERT_LARGE=int8 in a FRESH harness (quantization is

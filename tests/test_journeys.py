@@ -1,0 +1,512 @@
+"""Fleet-wide request journeys: one trace id per caller-visible request.
+
+The drills run a 2-replica ``ClusterHarness`` with per-replica trace files
+and client tracing on, then reconstruct every caller-visible success from
+the files: all attempt records of a request share ONE trace id (W3C
+traceparent trace-id field), the server records of every replica the
+request touched join on it, and refusals (drain 503 sheds) leave minimal
+records carrying the propagated traceparent + ``shed_reason``.  OTLP
+conformance runs the dependency-free encoder/exporter against a stub
+OTLP/HTTP collector and asserts proto-JSON shape: 32/16-hex ids, int64
+nanos as decimal strings, ResourceSpans batch framing.
+"""
+
+import http.server
+import json
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import triton_client_tpu.http as httpclient  # noqa: E402
+from triton_client_tpu._resilience import RetryPolicy  # noqa: E402
+from triton_client_tpu._telemetry import telemetry  # noqa: E402
+from triton_client_tpu.cluster import ClusterClient  # noqa: E402
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.otlp import (  # noqa: E402
+    OtlpExporter,
+    encode_client_record,
+    encode_server_record,
+    normalize_endpoint,
+    split_traceparent,
+)
+from triton_client_tpu.server import ModelRegistry  # noqa: E402
+from triton_client_tpu.server.chaos import ChaosInjector  # noqa: E402
+from triton_client_tpu.server.testing import (  # noqa: E402
+    ClusterHarness,
+    ServerHarness,
+)
+from triton_client_tpu.server.trace import RequestTracer  # noqa: E402
+from triton_client_tpu.tools import trace_summary as ts  # noqa: E402
+
+MODEL = "custom_identity_int32"
+_HEX = set("0123456789abcdef")
+
+
+def _registry_factory():
+    r = ModelRegistry()
+    r.register_model(zoo.make_custom_identity_int32())
+    return r
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ch = ClusterHarness(_registry_factory, n=2)
+    ch.start()
+    yield ch
+    ch.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean(cluster):
+    """Full fleet, no chaos, accepting, tracing off — before AND after."""
+    def reset():
+        for i, h in enumerate(cluster.harnesses):
+            if h is None:
+                cluster.restart(i)
+                h = cluster.harnesses[i]
+            h.core.chaos = None
+            h.core.accepting = True
+            h.core.trace_settings["trace_level"] = ["OFF"]
+        telemetry().disable_tracing()
+        telemetry().reset()
+    reset()
+    yield
+    reset()
+
+
+def _x(n=4):
+    return np.arange(n, dtype=np.int32).reshape(1, n)
+
+
+def _inputs(x):
+    i = httpclient.InferInput("INPUT0", list(x.shape), "INT32")
+    i.set_data_from_numpy(x)
+    return [i]
+
+
+def _policy(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("retry_infer", True)
+    kw.setdefault("initial_backoff_s", 0.01)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+def _trace_all(cluster, tmp_path):
+    """Per-replica trace files at rate 1; returns the path list."""
+    paths = []
+    for i, h in enumerate(cluster.harnesses):
+        p = str(tmp_path / f"server-{i}.json")
+        h.core.trace_settings.update({
+            "trace_level": ["TIMESTAMPS"], "trace_file": [p],
+            "trace_rate": ["1"], "trace_count": ["-1"],
+            "log_frequency": ["0"]})
+        h.core.tracer.settings_updated()
+        paths.append(p)
+    return paths
+
+
+def _attempts_by_request(client_records):
+    """request_id -> attempt records (REQUEST-span records only; RETRY
+    backoffs, HEDGE wins, and journey events are not attempts)."""
+    groups = {}
+    for rec in client_records:
+        if any(s.get("name") == "REQUEST" for s in rec.get("spans", [])):
+            groups.setdefault(str(rec.get("request_id", "")), []).append(rec)
+    return groups
+
+
+class TestJourneyDrills:
+    def test_chaos_retries_reconstruct_single_trace_id(
+            self, cluster, tmp_path):
+        """Replica 0 fails every request (injected 503s): retries land on
+        replica 1, and EVERY caller-visible success reconstructs from the
+        trace files as ONE trace id spanning its client attempts and every
+        replica it touched."""
+        server_paths = _trace_all(cluster, tmp_path)
+        client_path = str(tmp_path / "client.json")
+        telemetry().enable_tracing(client_path)
+        cluster.chaos(0, ChaosInjector(rate=1.0, kinds=["error"], seed=7))
+        n = 24
+        with ClusterClient(cluster.http_urls, protocol="http",
+                           policy="round_robin",
+                           retry_policy=_policy()) as c:
+            x = _x()
+            for _ in range(n):
+                r = c.infer(MODEL, _inputs(x))
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        telemetry().disable_tracing()
+        client_records = ts.load_trace_files([client_path])
+        server_records = ts.load_trace_files(server_paths)
+
+        groups = _attempts_by_request(client_records)
+        assert len(groups) == n
+        server_tids = {ts.trace_id_of(r) for r in server_records}
+        multi_attempt = 0
+        for rid, attempts in groups.items():
+            assert rid, "attempt record without a request id"
+            tids = {ts.trace_id_of(a) for a in attempts}
+            assert len(tids) == 1 and "" not in tids, \
+                f"journey {rid} split across trace ids {tids}"
+            assert any(a.get("ok") for a in attempts), rid
+            # the winning attempt was sampled server-side (rate 1), so the
+            # journey's trace id joins client and server files
+            assert next(iter(tids)) in server_tids, rid
+            if len(attempts) > 1:
+                multi_attempt += 1
+                assert sorted(a.get("attempt") for a in attempts) == \
+                    list(range(1, len(attempts) + 1)), rid
+        assert multi_attempt >= 1, "chaos never forced a retry"
+        # 24 requests -> 24 distinct journeys, no trace-id collisions
+        all_tids = {ts.trace_id_of(a) for g in groups.values() for a in g}
+        assert len(all_tids) == n
+
+        jo = ts.summarize(server_records, client_records)["journeys"]
+        assert jo["count"] == n and jo["complete"] == n
+        assert jo["attempts_per_success"]["max"] >= 2
+        # failed attempts emitted records on replica-0, winners on
+        # replica-1: at least one journey spans both replicas
+        assert jo["replicas_per_journey"]["max"] == 2
+        assert jo["replicas_per_journey"]["cross_replica_journeys"] >= 1
+        assert jo["events"].get("RETRY", 0) >= 1
+        assert jo["events"].get("ENDPOINT_SWITCH", 0) >= 1
+        # replica identity on every server record (harness stamps names)
+        assert {r.get("replica") for r in server_records} <= \
+            {"replica-0", "replica-1"}
+
+    def test_shed_journeys_convert_and_carry_traceparent(
+            self, cluster, tmp_path):
+        """Replica 0 drains (503 shed): the refusal leaves a minimal trace
+        record with the PROPAGATED traceparent + shed_reason, and the
+        journeys report counts every shed journey as converted once the
+        retry succeeds elsewhere."""
+        server_paths = _trace_all(cluster, tmp_path)
+        client_path = str(tmp_path / "client.json")
+        telemetry().enable_tracing(client_path)
+        cluster.harnesses[0].core.accepting = False
+        with ClusterClient(cluster.http_urls, protocol="http",
+                           policy="round_robin",
+                           retry_policy=_policy()) as c:
+            x = _x()
+            for _ in range(10):
+                r = c.infer(MODEL, _inputs(x))
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), x)
+        telemetry().disable_tracing()
+        client_records = ts.load_trace_files([client_path])
+        server_records = ts.load_trace_files(server_paths)
+
+        refusals = [r for r in server_records if r.get("refused")]
+        assert refusals, "drained replica emitted no refusal records"
+        client_tids = {ts.trace_id_of(r) for r in client_records} - {""}
+        for r in refusals:
+            assert r["shed_reason"] == "drain"
+            assert r["status"] == 503
+            assert r["outcome"] == "shed"
+            assert r["replica"] == "replica-0"
+            # the propagated trace context joins the refusal to a journey
+            assert ts.trace_id_of(r) in client_tids
+        jo = ts.summarize(server_records, client_records)["journeys"]
+        assert jo["complete"] == 10
+        assert jo["sheds"]["journeys_shed"] >= 1
+        assert jo["sheds"]["converted"] == jo["sheds"]["journeys_shed"]
+        assert jo["sheds"]["conversion_pct"] == 100.0
+
+    def test_worker_kill_midrun_100pct_reconstruction(
+            self, cluster, tmp_path):
+        """Acceptance drill: replica 1 killed mid-run at concurrency 8 —
+        zero caller-visible errors, and 100% of successes reconstruct as
+        one trace id each."""
+        _trace_all(cluster, tmp_path)
+        client_path = str(tmp_path / "client.json")
+        telemetry().enable_tracing(client_path)
+        n = 48
+        errors = []
+        claimed = [0]
+        lock = threading.Lock()
+        fired = threading.Event()
+        x = _x()
+        with ClusterClient(cluster.http_urls, protocol="http",
+                           policy="round_robin",
+                           retry_policy=_policy()) as c:
+            def worker():
+                try:
+                    while True:
+                        with lock:
+                            if claimed[0] >= n:
+                                return
+                            claimed[0] += 1
+                            k = claimed[0]
+                        if k == 12 and not fired.is_set():
+                            fired.set()
+                            cluster.kill(1)
+                        r = c.infer(MODEL, _inputs(x))
+                        np.testing.assert_array_equal(
+                            r.as_numpy("OUTPUT0"), x)
+                except Exception as e:  # noqa: BLE001 — assertion target
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        telemetry().disable_tracing()
+        assert errors == []
+        groups = _attempts_by_request(ts.load_trace_files([client_path]))
+        assert len(groups) == n
+        bad = [rid for rid, attempts in groups.items()
+               if len({ts.trace_id_of(a) for a in attempts} - {""}) != 1
+               or not any(a.get("ok") for a in attempts)]
+        assert not bad, f"journeys not reconstructable: {bad}"
+
+
+class _StubCollector:
+    """Minimal OTLP/HTTP collector: records every POSTed JSON body."""
+
+    def __init__(self):
+        self.bodies = []
+        self.paths = []
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                size = int(self.headers.get("Content-Length", 0))
+                outer.bodies.append(json.loads(self.rfile.read(size)))
+                outer.paths.append(self.path)
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self._srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self._srv.server_port}"
+
+    def spans(self):
+        return [s for b in self.bodies for rs in b["resourceSpans"]
+                for ss in rs["scopeSpans"] for s in ss["spans"]]
+
+    def close(self):
+        self._srv.shutdown()
+
+
+@pytest.fixture()
+def collector():
+    c = _StubCollector()
+    yield c
+    c.close()
+
+
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+class TestOtlpConformance:
+    def test_client_encoding_ids_casing_and_framing(self, collector):
+        ex = OtlpExporter(collector.endpoint, "triton-tpu-client",
+                          encode_client_record, clock_offset_ns=0)
+        ex.submit({"request_id": "r1", "model": "m", "protocol": "http",
+                   "method": "infer", "ok": True, "attempt": 2,
+                   "traceparent": TP,
+                   "spans": [
+                       {"name": "REQUEST", "start_ns": 10, "end_ns": 50},
+                       {"name": "SERIALIZE", "start_ns": 10, "end_ns": 20},
+                       {"name": "NETWORK", "start_ns": 20, "end_ns": 40},
+                       {"name": "DESERIALIZE", "start_ns": 40,
+                        "end_ns": 50}]})
+        assert ex.flush(10.0)
+        assert ex.counters()["ok"] == 1
+        ex.shutdown()
+        assert collector.paths == ["/v1/traces"]
+        body = collector.bodies[0]
+        # ResourceSpans framing with proto-JSON casing
+        (rs,) = body["resourceSpans"]
+        res_attrs = {a["key"]: a["value"] for a in
+                     rs["resource"]["attributes"]}
+        assert res_attrs["service.name"] == {
+            "stringValue": "triton-tpu-client"}
+        (ss,) = rs["scopeSpans"]
+        assert ss["scope"]["name"] == "triton_client_tpu"
+        spans = ss["spans"]
+        assert len(spans) == 4
+        for s in spans:
+            assert len(s["traceId"]) == 32 and set(s["traceId"]) <= _HEX
+            assert len(s["spanId"]) == 16 and set(s["spanId"]) <= _HEX
+            # int64 nanos are DECIMAL STRINGS in proto-JSON
+            assert isinstance(s["startTimeUnixNano"], str)
+            assert s["startTimeUnixNano"].isdigit()
+            assert isinstance(s["endTimeUnixNano"], str)
+        tid, root_id = split_traceparent(TP)
+        root = next(s for s in spans if s["name"] == "client infer")
+        # the REQUEST span's id IS the traceparent span-id (the server's
+        # root names it as parent) and it has no parent itself
+        assert root["traceId"] == tid and root["spanId"] == root_id
+        assert "parentSpanId" not in root
+        assert root["kind"] == 3  # SPAN_KIND_CLIENT
+        attrs = {a["key"]: a["value"] for a in root["attributes"]}
+        assert attrs["attempt"] == {"intValue": "2"}  # int64 as string
+        assert attrs["model"] == {"stringValue": "m"}
+        for s in spans:
+            if s is not root:
+                assert s["parentSpanId"] == root_id
+                assert s["kind"] == 1  # SPAN_KIND_INTERNAL
+
+    def test_server_encoding_parents_and_refusals(self):
+        tid, client_span = split_traceparent(TP)
+        spans = encode_server_record(
+            {"id": 7, "model_name": "m", "model_version": "1",
+             "replica": "replica-0", "traceparent": TP,
+             "triton_request_id": "r1",
+             "spans": [
+                 {"name": "REQUEST", "start_ns": 0, "end_ns": 100,
+                  "parent": None},
+                 {"name": "COMPUTE", "start_ns": 10, "end_ns": 90,
+                  "parent": "REQUEST"}]})
+        root = next(s for s in spans if s["name"] == "server m")
+        assert root["traceId"] == tid
+        assert root["parentSpanId"] == client_span  # client attempt link
+        assert root["kind"] == 2  # SPAN_KIND_SERVER
+        compute = next(s for s in spans if s["name"] == "COMPUTE")
+        assert compute["parentSpanId"] == root["spanId"]
+        assert "status" not in root  # ok -> unset status
+        # refusal: zero-length root, shed attrs, error status
+        (refusal,) = encode_server_record(
+            {"id": 8, "model_name": "m", "replica": "replica-0",
+             "refused": True, "outcome": "shed", "shed_reason": "drain",
+             "status": 503, "traceparent": TP,
+             "spans": [{"name": "REQUEST", "start_ns": 5, "end_ns": 5,
+                        "parent": None}]})
+        assert refusal["parentSpanId"] == client_span
+        assert refusal["status"] == {"code": 2}
+        attrs = {a["key"]: a["value"] for a in refusal["attributes"]}
+        assert attrs["shed_reason"] == {"stringValue": "drain"}
+        assert attrs["outcome"] == {"stringValue": "shed"}
+
+    def test_batching_and_drop_accounting(self, collector):
+        ex = OtlpExporter(collector.endpoint, "svc", encode_client_record,
+                          batch_max=128, flush_interval_s=0.05)
+        for i in range(10):
+            ex.submit({"request_id": f"r{i}", "model": "m",
+                       "protocol": "http", "method": "infer", "ok": True,
+                       "spans": [{"name": "REQUEST", "start_ns": 0,
+                                  "end_ns": 1}]})
+        assert ex.flush(10.0)
+        ex.shutdown()
+        assert len(collector.spans()) == 10
+        # batched: far fewer POSTs than records
+        assert len(collector.bodies) < 10
+        # submit never blocks or raises once the exporter can't accept
+        # (stopped here; a full queue takes the same counted-drop path)
+        dead = OtlpExporter(collector.endpoint, "svc",
+                            encode_client_record, queue_size=1)
+        dead.shutdown()
+        for _ in range(5):
+            dead.submit({"request_id": "x", "model": "m",
+                         "protocol": "http", "method": "infer",
+                         "spans": []})
+        assert dead.counters()["dropped"] == 5
+
+    def test_normalize_endpoint(self):
+        assert normalize_endpoint("collector:4318") == \
+            "http://collector:4318/v1/traces"
+        assert normalize_endpoint("http://c:4318") == \
+            "http://c:4318/v1/traces"
+        assert normalize_endpoint("https://c:4318/custom/path") == \
+            "https://c:4318/custom/path"
+        with pytest.raises(ValueError):
+            normalize_endpoint("  ")
+
+    def test_export_error_counted_not_raised(self):
+        ex = OtlpExporter("http://127.0.0.1:9", "svc", encode_client_record)
+        ex.submit({"request_id": "r", "model": "m", "protocol": "http",
+                   "method": "infer",
+                   "spans": [{"name": "REQUEST", "start_ns": 0,
+                              "end_ns": 1}]})
+        assert ex.flush(10.0)  # drains even when the collector is dead
+        assert ex.counters()["error"] >= 1
+        ex.shutdown()
+
+
+class TestShedZeroCost:
+    def test_refusal_with_tracing_disabled_is_zero_cost(self):
+        tracer = RequestTracer({"trace_level": ["OFF"], "trace_file": [""]})
+        tracer.record_refusal("m", shed_reason="drain", status=503,
+                              traceparent=TP)
+        # no id minted, no rotation state, nothing buffered
+        assert tracer._next_id == 0
+        assert tracer._emitted == 0 and tracer._seq == 0
+
+    def test_drained_server_shed_leaves_no_trace_file(self, tmp_path):
+        p = tmp_path / "never.json"
+        registry = _registry_factory()
+        with ServerHarness(registry) as h:
+            # tracing configured OFF but with a file path: a shed must not
+            # touch the file, the id counter, or the sampling counters
+            h.core.trace_settings.update({
+                "trace_level": ["OFF"], "trace_file": [str(p)]})
+            h.core.accepting = False
+            with httpclient.InferenceServerClient(h.http_url) as c:
+                x = _x()
+                with pytest.raises(Exception):
+                    c.infer(MODEL, _inputs(x))
+            assert h.core.tracer._next_id == 0
+        assert not p.exists()
+
+
+class TestTraceSummaryInputs:
+    def _write(self, path, records):
+        with open(path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+
+    def _rec(self, i, tp=""):
+        rec = {"id": i, "model_name": "m", "model_version": "1",
+               "timestamps": [],
+               "spans": [{"name": "REQUEST", "start_ns": 0, "end_ns": 10,
+                          "parent": None}]}
+        if tp:
+            rec["traceparent"] = tp
+        return rec
+
+    def test_globs_dirs_and_rotated_dedup(self, tmp_path):
+        d = tmp_path / "traces"
+        d.mkdir()
+        self._write(d / "t.json.0", [self._rec(1), self._rec(2)])
+        self._write(d / "t.json.1", [self._rec(3)])
+        # overlapping specs: glob + literal + directory — every rotated
+        # file is read exactly once
+        recs = ts.load_trace_files([
+            str(d / "t.json*"), str(d / "t.json.0"), str(d)])
+        assert sorted(r["id"] for r in recs) == [1, 2, 3]
+        # directory alone
+        assert len(ts.load_trace_files([str(d)])) == 3
+        # a literal miss still fails loudly
+        with pytest.raises(OSError):
+            ts.load_trace_files([str(d / "absent.json")])
+        # an unmatched glob is just empty (rotation may not have started)
+        assert ts.load_trace_files([str(d / "absent*.json")]) == []
+
+    def test_cli_accepts_globs_and_multiple_clients(self, tmp_path):
+        d = tmp_path
+        self._write(d / "s.json.0", [self._rec(1, TP)])
+        self._write(d / "c1.json", [
+            {"request_id": "r1", "model": "m", "protocol": "http",
+             "method": "infer", "ok": True, "attempt": 1,
+             "traceparent": TP,
+             "spans": [{"name": "REQUEST", "start_ns": 0, "end_ns": 9}]}])
+        out = d / "out.json"
+        rc = ts.main([str(d / "s.json*"), "--client", str(d / "c1.json"),
+                      "--format", "json", "-o", str(out), "-q"])
+        assert rc == 0
+        summary = json.loads(out.read_text())
+        assert summary["journeys"]["count"] == 1
+        assert summary["journeys"]["complete"] == 1

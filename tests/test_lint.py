@@ -970,6 +970,48 @@ class TestSpanPair:
             """)
         assert lint_dir(tmp_path, "SPAN-PAIR") == []
 
+    # -- journey scopes: begin_journey must reach end_journey ------------
+
+    def test_begin_journey_without_end_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            from ._telemetry import begin_journey
+            def call_with_retry(fn, rid):
+                scope = begin_journey(rid)
+                return fn()
+            """)
+        found = lint_dir(tmp_path, "SPAN-PAIR")
+        assert len(found) == 1 and "end_journey" in found[0].message
+
+    def test_begin_journey_with_end_passes(self, tmp_path):
+        write(tmp_path, "m.py", """
+            from ._telemetry import begin_journey, end_journey
+            def call_with_retry(fn, rid, journey):
+                scope = begin_journey(rid) if journey else None
+                try:
+                    return fn()
+                finally:
+                    if scope is not None:
+                        end_journey(scope)
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
+    def test_begin_journey_escape_via_return_trusted(self, tmp_path):
+        write(tmp_path, "m.py", """
+            from ._telemetry import begin_journey
+            def open_scope(rid):
+                scope = begin_journey(rid)
+                return scope
+            """)
+        assert lint_dir(tmp_path, "SPAN-PAIR") == []
+
+    def test_begin_journey_attribute_form_fires(self, tmp_path):
+        write(tmp_path, "m.py", """
+            def run(tel, fn):
+                scope = tel.begin_journey("")
+                return fn()
+            """)
+        assert len(lint_dir(tmp_path, "SPAN-PAIR")) == 1
+
 
 # -- METRICS-DECL ------------------------------------------------------------
 

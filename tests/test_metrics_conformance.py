@@ -633,3 +633,52 @@ class TestClientSurface:
         for fam in ("nv_client_endpoint_requests_total",
                     "nv_client_endpoint_state", "nv_client_hedges_total"):
             assert families[fam]["samples"], f"{fam}: escaped series dropped"
+
+
+class TestOtlpMetricsSurface:
+    """nv_otlp_* (server) and nv_client_otlp_* (client) export counters:
+    present and typed only while an exporter is wired, absent — not zero —
+    when it is not (absent reads "not exporting"; a zero would read
+    "exporting, idle")."""
+
+    def test_server_families_present_and_typed(self, server):
+        from triton_client_tpu.server.metrics import snapshot
+
+        core = server.core
+        # a dead endpoint is fine: the families must render regardless of
+        # whether a batch ever flushed
+        core.enable_otlp("http://127.0.0.1:9", replica="test-replica")
+        try:
+            families = assert_conformant(_scrape(server.http_url))
+            fam = families["nv_otlp_export_total"]
+            assert fam["type"] == "counter"
+            assert {l["outcome"] for _, l, _ in fam["samples"]} == \
+                {"ok", "error"}
+            assert families["nv_otlp_dropped_total"]["type"] == "counter"
+            snap = snapshot(core)
+            assert snap["nv_otlp_export_total"]["type"] == "counter"
+            assert snap["nv_otlp_dropped_total"]["type"] == "counter"
+        finally:
+            otlp, core.tracer.otlp = core.tracer.otlp, None
+            otlp.shutdown()
+        families = assert_conformant(_scrape(server.http_url))
+        assert "nv_otlp_export_total" not in families
+        assert "nv_otlp_dropped_total" not in families
+
+    def test_client_families_present_and_typed(self, server):
+        telemetry().reset()
+        telemetry().enable_otlp("http://127.0.0.1:9")
+        try:
+            families = assert_conformant(telemetry().render_prometheus())
+            fam = families["nv_client_otlp_export_total"]
+            assert fam["type"] == "counter"
+            assert {l["outcome"] for _, l, _ in fam["samples"]} == \
+                {"ok", "error"}
+            assert families["nv_client_otlp_dropped_total"]["type"] == \
+                "counter"
+            assert telemetry().snapshot()["otlp"] is not None
+        finally:
+            telemetry().disable_otlp()
+        families = parse_exposition(telemetry().render_prometheus())
+        assert "nv_client_otlp_export_total" not in families
+        assert telemetry().snapshot()["otlp"] is None

@@ -35,7 +35,7 @@ import random
 import time
 from typing import Any, Callable, Optional
 
-from ._telemetry import telemetry
+from ._telemetry import begin_journey, current_journey, end_journey, telemetry
 from .utils import InferenceServerException
 
 __all__ = [
@@ -239,12 +239,16 @@ def _record_retry(model: str, protocol: str, method_name: str,
     """One retry's observability: the ``nv_client_retries_total`` counter
     plus (when client tracing is on) a ``RETRY`` span covering the failed
     attempt — so a trace join shows *why* a request's client latency
-    dwarfs its server latency."""
+    dwarfs its server latency.  Under a journey scope the span carries the
+    journey's traceparent and attempt number (record_client_trace stamps
+    both), so the failed attempt stays on the journey's trace id."""
     tel = telemetry()
     tel.record_retry(model, protocol, method_name)
     if tel.tracing_enabled:
+        journey = current_journey()
         tel.record_client_trace(
-            request_id, model, protocol, method_name,
+            request_id or (journey.request_id if journey else ""),
+            model, protocol, method_name,
             spans=[("RETRY", attempt_start_ns, time.monotonic_ns())],
             ok=False)
 
@@ -256,6 +260,7 @@ def call_with_retry(
     deadline_s: Optional[float] = None,
     retry_meta=None,
     on_failure: Optional[Callable[[BaseException, int], None]] = None,
+    journey: bool = False,
 ) -> Any:
     """Run ``attempt_fn(remaining_s, attempt)`` under ``policy``.
 
@@ -268,46 +273,61 @@ def call_with_retry(
     ones included, before the failure classification) — the cluster layer
     hangs its endpoint-exclusion set off this hook so a retry lands on a
     *different* replica than the attempt that just failed.
+
+    ``journey=True`` (single-request inference call sites only — a batch
+    flight's requests must each keep their own trace id) opens a journey
+    scope around the loop: every attempt mints a traceparent sharing ONE
+    trace id, with the attempt number stamped into client trace records.
+    A call already inside a journey never opens a nested one.
     """
     if deadline_s is None and policy is not None:
         deadline_s = policy.deadline_s
     deadline = (time.monotonic() + deadline_s
                 if deadline_s is not None else None)
+    rid = retry_meta[3] if retry_meta else ""
+    scope = begin_journey(rid) if journey else None
     attempt = 0
-    while True:
-        attempt += 1
-        remaining = None
-        if deadline is not None:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise deadline_exceeded_error()
-        t0_ns = time.monotonic_ns()
-        try:
-            return attempt_fn(remaining, attempt)
-        except BaseException as e:
-            if on_failure is not None:
-                on_failure(e, attempt)
-            if deadline is not None and is_timeout_error(e) \
-                    and time.monotonic() >= deadline - 1e-3:
-                # the deadline budget (not a shorter per-attempt
-                # client/pool timeout) drove this transport timeout —
-                # surface the typed deadline failure, uniform across all
-                # four transports, instead of the raw urllib3/aiohttp/
-                # futures timeout class.  A timeout with budget left
-                # falls through to normal retry classification.
-                raise deadline_exceeded_error() from e
-            if policy is None or not policy.should_retry(e, method, attempt):
-                raise
-            delay = policy.backoff_s(
-                attempt, retry_after_s=getattr(e, "retry_after_s", None))
-            if deadline is not None \
-                    and time.monotonic() + delay >= deadline:
-                raise  # the budget can't cover another attempt
-            # recorded only once the retry is actually committed — an
-            # abandoned retry must not inflate nv_client_retries_total
-            if retry_meta is not None:
-                _record_retry(*retry_meta, t0_ns)
-            time.sleep(delay)
+    try:
+        while True:
+            attempt += 1
+            if scope is not None:
+                scope[0].attempt = attempt
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise deadline_exceeded_error()
+            t0_ns = time.monotonic_ns()
+            try:
+                return attempt_fn(remaining, attempt)
+            except BaseException as e:
+                if on_failure is not None:
+                    on_failure(e, attempt)
+                if deadline is not None and is_timeout_error(e) \
+                        and time.monotonic() >= deadline - 1e-3:
+                    # the deadline budget (not a shorter per-attempt
+                    # client/pool timeout) drove this transport timeout —
+                    # surface the typed deadline failure, uniform across all
+                    # four transports, instead of the raw urllib3/aiohttp/
+                    # futures timeout class.  A timeout with budget left
+                    # falls through to normal retry classification.
+                    raise deadline_exceeded_error() from e
+                if policy is None \
+                        or not policy.should_retry(e, method, attempt):
+                    raise
+                delay = policy.backoff_s(
+                    attempt, retry_after_s=getattr(e, "retry_after_s", None))
+                if deadline is not None \
+                        and time.monotonic() + delay >= deadline:
+                    raise  # the budget can't cover another attempt
+                # recorded only once the retry is actually committed — an
+                # abandoned retry must not inflate nv_client_retries_total
+                if retry_meta is not None:
+                    _record_retry(*retry_meta, t0_ns)
+                time.sleep(delay)
+    finally:
+        if scope is not None:
+            end_journey(scope)
 
 
 async def call_with_retry_async(
@@ -317,48 +337,60 @@ async def call_with_retry_async(
     deadline_s: Optional[float] = None,
     retry_meta=None,
     on_failure: Optional[Callable[[BaseException, int], None]] = None,
+    journey: bool = False,
 ) -> Any:
     """Async sibling of :func:`call_with_retry` — ``attempt_fn`` is an
     async callable; backoff awaits instead of blocking the loop.
-    ``on_failure`` is a plain (non-async) callback, as in the sync loop."""
+    ``on_failure`` is a plain (non-async) callback, as in the sync loop;
+    ``journey`` opens the same one-trace-id-across-attempts scope (the
+    contextvar is task-local, so concurrent journeys don't cross)."""
     import asyncio
 
     if deadline_s is None and policy is not None:
         deadline_s = policy.deadline_s
     deadline = (time.monotonic() + deadline_s
                 if deadline_s is not None else None)
+    rid = retry_meta[3] if retry_meta else ""
+    scope = begin_journey(rid) if journey else None
     attempt = 0
-    while True:
-        attempt += 1
-        remaining = None
-        if deadline is not None:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise deadline_exceeded_error()
-        t0_ns = time.monotonic_ns()
-        try:
-            return await attempt_fn(remaining, attempt)
-        except BaseException as e:
-            if on_failure is not None:
-                on_failure(e, attempt)
-            if deadline is not None and (
-                    is_timeout_error(e)
-                    or isinstance(e, asyncio.TimeoutError)) \
-                    and time.monotonic() >= deadline - 1e-3:
-                # same budget-spent typed-deadline normalization as the
-                # sync loop (asyncio.TimeoutError is distinct pre-3.11)
-                raise deadline_exceeded_error() from e
-            if policy is None or not policy.should_retry(e, method, attempt):
-                raise
-            delay = policy.backoff_s(
-                attempt, retry_after_s=getattr(e, "retry_after_s", None))
-            if deadline is not None \
-                    and time.monotonic() + delay >= deadline:
-                raise
-            # committed-retries only, as in the sync loop
-            if retry_meta is not None:
-                _record_retry(*retry_meta, t0_ns)
-            await asyncio.sleep(delay)
+    try:
+        while True:
+            attempt += 1
+            if scope is not None:
+                scope[0].attempt = attempt
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise deadline_exceeded_error()
+            t0_ns = time.monotonic_ns()
+            try:
+                return await attempt_fn(remaining, attempt)
+            except BaseException as e:
+                if on_failure is not None:
+                    on_failure(e, attempt)
+                if deadline is not None and (
+                        is_timeout_error(e)
+                        or isinstance(e, asyncio.TimeoutError)) \
+                        and time.monotonic() >= deadline - 1e-3:
+                    # same budget-spent typed-deadline normalization as the
+                    # sync loop (asyncio.TimeoutError is distinct pre-3.11)
+                    raise deadline_exceeded_error() from e
+                if policy is None \
+                        or not policy.should_retry(e, method, attempt):
+                    raise
+                delay = policy.backoff_s(
+                    attempt, retry_after_s=getattr(e, "retry_after_s", None))
+                if deadline is not None \
+                        and time.monotonic() + delay >= deadline:
+                    raise
+                # committed-retries only, as in the sync loop
+                if retry_meta is not None:
+                    _record_retry(*retry_meta, t0_ns)
+                await asyncio.sleep(delay)
+    finally:
+        if scope is not None:
+            end_journey(scope)
 
 
 def min_timeout(client_timeout: Optional[float],

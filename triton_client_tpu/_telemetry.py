@@ -31,6 +31,7 @@ is the client half of the observability subsystem:
 
 from __future__ import annotations
 
+import contextvars
 import json
 import math
 import os
@@ -43,7 +44,12 @@ __all__ = [
     "AppendFile",
     "ClientTelemetry",
     "ENDPOINT_STATE_CODES",
+    "Journey",
     "LatencyHistogram",
+    "OTLP_ENDPOINT_ENV",
+    "begin_journey",
+    "current_journey",
+    "end_journey",
     "escape_label",
     "merge_trace_headers",
     "new_trace_context",
@@ -115,12 +121,73 @@ TRACEPARENT_HEADER = "traceparent"
 # failure, so it stays body-only and a minted id carries the correlation
 _HEADER_SAFE = re.compile(r"[\x20-\x7e]+\Z")
 
+#: Env var arming the client-side OTLP exporter: when set to a collector
+#: endpoint (``host:4318`` or a full URL), every client trace record also
+#: exports as OTLP/HTTP ResourceSpans (see ``otlp.py``).
+OTLP_ENDPOINT_ENV = "TRITON_TPU_OTLP_ENDPOINT"
+
+
+class Journey:
+    """One retry-scoped client journey: a single 16-byte trace id spanning
+    every attempt (retries, hedged backups, endpoint switches) of one
+    logical request.  The resilience layer opens a journey around its
+    attempt loop; :func:`new_trace_context` then mints per-attempt
+    traceparents that share the journey's trace id with a FRESH span id per
+    attempt — so each replica's server trace parents under the attempt
+    that actually reached it, while the whole fan-out joins on one id."""
+
+    __slots__ = ("trace_id", "request_id", "attempt", "traceparent")
+
+    def __init__(self, trace_id: str, request_id: str) -> None:
+        self.trace_id = trace_id
+        self.request_id = request_id
+        self.attempt = 0  # stamped by the owning retry loop, 1-based
+        self.traceparent = ""  # the latest attempt's on-wire traceparent
+
+
+_JOURNEY: contextvars.ContextVar[Optional[Journey]] = \
+    contextvars.ContextVar("tc_tpu_journey", default=None)
+
+
+def begin_journey(request_id: str = ""):
+    """Open a journey scope for the current context.  Returns an opaque
+    scope to pass to :func:`end_journey`, or None when a journey is
+    already active — nested retry layers (a cluster retry loop driving a
+    single-endpoint client's deadline loop) must not fork the trace id,
+    so only the outermost owner numbers attempts and closes the scope."""
+    if _JOURNEY.get() is not None:
+        return None
+    if not request_id or not _HEADER_SAFE.match(request_id):
+        request_id = os.urandom(8).hex()
+    journey = Journey(os.urandom(16).hex(), request_id)
+    return journey, _JOURNEY.set(journey)
+
+
+def end_journey(scope) -> None:
+    """Close a scope returned by :func:`begin_journey` (owner only)."""
+    _JOURNEY.reset(scope[1])
+
+
+def current_journey() -> Optional[Journey]:
+    """The active journey of this context, or None."""
+    return _JOURNEY.get()
+
 
 def new_trace_context(request_id: str = "") -> Dict[str, str]:
     """Fresh propagation headers for one inference.  ``request_id`` (the wire
     ``id`` field, when the caller set one) doubles as the correlation id so a
     user-chosen id is greppable across client and server; otherwise — or when
-    the id is not header-safe — a random 16-hex id is minted."""
+    the id is not header-safe — a random 16-hex id is minted.  Inside a
+    journey scope the trace id and correlation id are the journey's (stable
+    across attempts) and only the span id is fresh per attempt."""
+    journey = _JOURNEY.get()
+    if journey is not None:
+        traceparent = f"00-{journey.trace_id}-{os.urandom(8).hex()}-01"
+        journey.traceparent = traceparent
+        if not request_id or not _HEADER_SAFE.match(request_id):
+            request_id = journey.request_id
+        return {REQUEST_ID_HEADER: request_id,
+                TRACEPARENT_HEADER: traceparent}
     if not request_id or not _HEADER_SAFE.match(request_id):
         request_id = os.urandom(8).hex()
     return {
@@ -320,6 +387,16 @@ class ClientTelemetry:
         self._trace_path: Optional[str] = None
         self._trace_lock = threading.Lock()
         self._trace_out = AppendFile()
+        # OTLP/HTTP export of the same records (otlp.OtlpExporter); armed
+        # by enable_otlp() or the TRITON_TPU_OTLP_ENDPOINT env var.  The
+        # exporter thread is lazy — nothing spawns until a record exports.
+        self._otlp = None
+        endpoint = os.environ.get(OTLP_ENDPOINT_ENV, "").strip()
+        if endpoint:
+            try:
+                self.enable_otlp(endpoint)
+            except ValueError:
+                pass  # a malformed env endpoint must not break imports
 
     # -- recording ---------------------------------------------------------
     def _series(self, key: Tuple[str, str, str]) -> _RequestSeries:
@@ -431,9 +508,15 @@ class ClientTelemetry:
     def set_endpoint_state(self, endpoint: str, state: str) -> None:
         """Record an endpoint's breaker/health state (``closed`` /
         ``open`` / ``half_open``) — rendered numerically as
-        ``nv_client_endpoint_state`` (0/1/2)."""
+        ``nv_client_endpoint_state`` (0/1/2).  A closed→open transition
+        during an active journey also drops a ``BREAKER_OPEN`` event on
+        the journey's trace — the moment a replica fell out of rotation
+        is exactly what explains the endpoint switch that follows."""
         with self._lock:
             self._endpoint_state[endpoint] = state
+        if state == "open":
+            self.record_journey_event("BREAKER_OPEN", endpoint=endpoint,
+                                      ok=False)
 
     def record_hedge(self, model: str, protocol: str,
                      won: bool = False) -> None:
@@ -476,9 +559,36 @@ class ClientTelemetry:
             self._trace_path = None
             self._trace_out.close()
 
+    def enable_otlp(self, endpoint: str):
+        """Arm OTLP/HTTP export of client trace records to ``endpoint``
+        (``host:4318`` or a full collector URL).  Works with or without a
+        JSONL trace file — OTLP alone is enough to light the span
+        recording paths up.  Returns the exporter (its ``flush`` is the
+        test/shutdown hook)."""
+        from .otlp import OtlpExporter, encode_client_record
+
+        exporter = OtlpExporter(endpoint, "triton-tpu-client",
+                                encode_client_record)
+        with self._trace_lock:
+            old, self._otlp = self._otlp, exporter
+        if old is not None:
+            old.shutdown(0.0)
+        return exporter
+
+    def disable_otlp(self) -> None:
+        with self._trace_lock:
+            exporter, self._otlp = self._otlp, None
+        if exporter is not None:
+            exporter.shutdown()
+
+    @property
+    def otlp_exporter(self):
+        """The active client OTLP exporter, or None."""
+        return self._otlp
+
     @property
     def tracing_enabled(self) -> bool:
-        return self._trace_path is not None
+        return self._trace_path is not None or self._otlp is not None
 
     def record_infer_spans(
         self,
@@ -490,12 +600,15 @@ class ClientTelemetry:
         serialize_end_ns: int,
         network_end_ns: int,
         traceparent: str = "",
+        ok: bool = True,
     ) -> None:
         """The one span taxonomy every instrumented client records — a
         REQUEST root closing now, with SERIALIZE (request build +
         compression), NETWORK (wire round trip), and DESERIALIZE (result
         construction) children.  One definition so the four clients cannot
-        drift per protocol."""
+        drift per protocol.  ``ok=False`` records a FAILED attempt — the
+        journeys report needs every attempt on file, not just the winner,
+        to count attempts-per-success and cross-replica hops."""
         t_end = time.monotonic_ns()
         self.record_client_trace(
             request_id, model, protocol, method,
@@ -503,7 +616,7 @@ class ClientTelemetry:
                    ("SERIALIZE", start_ns, serialize_end_ns),
                    ("NETWORK", serialize_end_ns, network_end_ns),
                    ("DESERIALIZE", network_end_ns, t_end)],
-            traceparent=traceparent)
+            ok=ok, traceparent=traceparent)
 
     def record_client_trace(
         self,
@@ -514,14 +627,24 @@ class ClientTelemetry:
         spans,
         ok: bool = True,
         traceparent: str = "",
+        attempt: int = 0,
+        endpoint: str = "",
     ) -> None:
         """Append one client trace record.  ``spans`` is an iterable of
         ``(name, start_ns, end_ns)`` tuples (monotonic clock of THIS
         process: durations are meaningful, absolute values do not align
-        with the server's clock — the join compares durations only)."""
+        with the server's clock — the join compares durations only).
+        Inside a journey scope the record is stamped with the attempt
+        number and (absent an explicit one) the journey's traceparent, so
+        every attempt of one logical request shares one trace id."""
         path = self._trace_path
-        if path is None:
+        otlp = self._otlp
+        if path is None and otlp is None:
             return
+        journey = _JOURNEY.get()
+        if journey is not None:
+            attempt = attempt or journey.attempt
+            traceparent = traceparent or journey.traceparent
         record: Dict[str, Any] = {
             "request_id": request_id,
             "model": model,
@@ -535,6 +658,14 @@ class ClientTelemetry:
         }
         if traceparent:
             record["traceparent"] = traceparent
+        if attempt:
+            record["attempt"] = int(attempt)
+        if endpoint:
+            record["endpoint"] = endpoint
+        if otlp is not None:
+            otlp.submit(record)
+        if path is None:
+            return
         line = json.dumps(record)
         with self._trace_lock:
             # re-checked under the lock: a concurrent disable_tracing()
@@ -544,6 +675,31 @@ class ClientTelemetry:
             if self._trace_path != path:
                 return
             self._trace_out.append(path, line + "\n")
+
+    def record_journey_event(
+        self,
+        name: str,
+        model: str = "",
+        protocol: str = "",
+        endpoint: str = "",
+        request_id: str = "",
+        ok: bool = True,
+    ) -> None:
+        """One zero-duration journey event (``ENDPOINT_SWITCH``,
+        ``BREAKER_OPEN``, ...): a point-in-time marker on the active
+        journey's trace, attributed to ``endpoint``.  No-op when tracing
+        is off or no journey is active — events only mean something
+        relative to the attempts around them."""
+        if not self.tracing_enabled:
+            return
+        journey = _JOURNEY.get()
+        if journey is None:
+            return
+        now = time.monotonic_ns()
+        self.record_client_trace(
+            request_id or journey.request_id, model, protocol, "event",
+            spans=[(name, now, now)], ok=ok,
+            traceparent=journey.traceparent, endpoint=endpoint)
 
     # -- hook --------------------------------------------------------------
     def set_request_hook(
@@ -587,8 +743,10 @@ class ClientTelemetry:
             entry.update(s.latency.snapshot_us())
             requests.append(entry)
         endpoint_urls = sorted({e for e, _ in ep_req} | set(ep_state))
+        otlp = self._otlp
         return {
             "requests": requests,
+            "otlp": otlp.counters() if otlp is not None else None,
             "endpoints": [
                 {"endpoint": e,
                  "success": ep_req.get((e, "success"), 0),
@@ -738,6 +896,21 @@ class ClientTelemetry:
             [f'nv_client_shared_memory_transfer_bytes_total{{'
              f'kind="{escape_label(k)}",direction="{escape_label(d)}"}} '
              f"{c[1]}" for (k, d), c in sorted(shm_tx.items())])
+        otlp = self._otlp
+        if otlp is not None:
+            c = otlp.counters()
+            family(
+                "nv_client_otlp_export_total",
+                "Number of OTLP export batches sent by this client process",
+                "counter",
+                [f'nv_client_otlp_export_total{{outcome="ok"}} {c["ok"]}',
+                 f'nv_client_otlp_export_total{{outcome="error"}} '
+                 f'{c["error"]}'])
+            family(
+                "nv_client_otlp_dropped_total",
+                "Number of client trace records dropped by the bounded "
+                "OTLP export queue", "counter",
+                [f'nv_client_otlp_dropped_total {c["dropped"]}'])
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -30,6 +30,7 @@ fleet.  Streaming APIs are per-connection by nature and not exposed here.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
@@ -497,8 +498,15 @@ class ClusterClient(InferenceServerClientBase):
         last: List[Optional[Endpoint]] = [None]
 
         def attempt(remaining, _n):
+            prev = last[0]
             ep = self._pool.pick(sequence_id=sequence_id, exclude=excluded)
             last[0] = ep
+            if prev is not None and ep.url != prev.url:
+                # a retry landing on a DIFFERENT replica is a journey
+                # event — the cross-replica hop the trace join counts
+                telemetry().record_journey_event(
+                    "ENDPOINT_SWITCH", model_name, self._protocol,
+                    endpoint=ep.url, request_id=request_id)
             if self._on_route is not None:
                 self._on_route(ep.url, model_name, sequence_id)
             if hedging:
@@ -516,7 +524,7 @@ class ClusterClient(InferenceServerClientBase):
         return call_with_retry(
             policy, attempt, method="infer", deadline_s=deadline_s,
             retry_meta=(model_name, self._protocol, "infer", request_id),
-            on_failure=on_failure)
+            on_failure=on_failure, journey=True)
 
     def infer_many(
         self,
@@ -651,7 +659,8 @@ class ClusterClient(InferenceServerClientBase):
                         tel.record_client_trace(
                             request_id, model_name, self._protocol,
                             "hedge",
-                            spans=[("HEDGE", t0_ns, time.monotonic_ns())])
+                            spans=[("HEDGE", t0_ns, time.monotonic_ns())],
+                            endpoint=backup_ep.url)
                     return f.result()
                 if f is f_primary:
                     primary_error = err
@@ -664,7 +673,11 @@ class ClusterClient(InferenceServerClientBase):
 
     def _hedge_submit(self, ex: ThreadPoolExecutor, *args):
         try:
-            return ex.submit(self._infer_on, *args)
+            # copy_context: the hedged attempt runs on a pool thread, and
+            # the journey contextvar must follow it — both hedge arms'
+            # traceparents have to share the journey's trace id
+            return ex.submit(contextvars.copy_context().run,
+                             self._infer_on, *args)
         except RuntimeError:
             # close() shut the pool down between our executor read and
             # this submit — surface the typed closed error, not the raw

@@ -303,8 +303,14 @@ class ClusterClient(InferenceServerClientBase):
         last: List[Optional[Endpoint]] = [None]
 
         async def attempt(remaining, _n):
+            prev = last[0]
             ep = self._pool.pick(sequence_id=sequence_id, exclude=excluded)
             last[0] = ep
+            if prev is not None and ep.url != prev.url:
+                # cross-replica hop: journey event, as in the sync client
+                telemetry().record_journey_event(
+                    "ENDPOINT_SWITCH", model_name, self._protocol_label,
+                    endpoint=ep.url, request_id=request_id)
             if self._on_route is not None:
                 self._on_route(ep.url, model_name, sequence_id)
             if hedging:
@@ -323,7 +329,7 @@ class ClusterClient(InferenceServerClientBase):
             policy, attempt, method="infer", deadline_s=deadline_s,
             retry_meta=(model_name, self._protocol_label, "infer",
                         request_id),
-            on_failure=on_failure)
+            on_failure=on_failure, journey=True)
 
     async def infer_many(
         self,
@@ -445,7 +451,8 @@ class ClusterClient(InferenceServerClientBase):
                                 request_id, model_name,
                                 self._protocol_label, "hedge",
                                 spans=[("HEDGE", t0_ns,
-                                        time.monotonic_ns())])
+                                        time.monotonic_ns())],
+                                endpoint=backup_ep.url)
                         return t.result()
                     if t is t_primary:
                         primary_error = t.exception()

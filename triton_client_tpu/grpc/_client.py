@@ -68,7 +68,7 @@ class PreparedRequest:
                 _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(self.template.model_name, "grpc", "infer",
-                        request_id))
+                        request_id), journey=True)
 
 INT32_MAX = 2**31 - 1
 MAX_GRPC_MESSAGE_SIZE = INT32_MAX
@@ -636,7 +636,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 client_timeout, headers, compression_algorithm, parameters,
                 tenant=tenant, _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
-            retry_meta=(model_name, "grpc", "infer", request_id))
+            retry_meta=(model_name, "grpc", "infer", request_id),
+            journey=True)
 
     # -- wire fast path ----------------------------------------------------
     def prepare(
@@ -709,6 +710,12 @@ class InferenceServerClient(InferenceServerClientBase):
                     prep.template.model_name, "grpc", "infer",
                     time.perf_counter() - t0, ok=False,
                     request_bytes=req_bytes, request_id=rid)
+                if tel.tracing_enabled:
+                    tel.record_infer_spans(
+                        rid, prep.template.model_name, "grpc", "infer",
+                        t_ser0, t_ser1, time.monotonic_ns(),
+                        traceparent=traceparent_from_metadata(metadata),
+                        ok=False)
             raise_error_grpc(e)
 
     def infer_many(
@@ -844,6 +851,14 @@ class InferenceServerClient(InferenceServerClientBase):
             tel.record_request(
                 model_name, "grpc", "infer", time.perf_counter() - t0,
                 ok=False, request_bytes=req_bytes, request_id=rid)
+            if tel.tracing_enabled:
+                # failed attempts stay on the journey's trace — the
+                # journeys report counts every attempt, not just winners
+                tel.record_infer_spans(
+                    rid, model_name, "grpc", "infer", t_ser0, t_ser1,
+                    time.monotonic_ns(),
+                    traceparent=traceparent_from_metadata(metadata),
+                    ok=False)
             raise_error_grpc(e)
 
     def async_infer(

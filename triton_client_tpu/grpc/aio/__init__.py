@@ -71,7 +71,7 @@ class PreparedRequest:
                 _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(self.template.model_name, "grpc_aio", "infer",
-                        request_id))
+                        request_id), journey=True)
 
 
 class InferenceServerClient(InferenceServerClientBase):
@@ -517,7 +517,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 client_timeout, headers, compression_algorithm, parameters,
                 tenant=tenant, _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
-            retry_meta=(model_name, "grpc_aio", "infer", request_id))
+            retry_meta=(model_name, "grpc_aio", "infer", request_id),
+            journey=True)
 
     # -- wire fast path ----------------------------------------------------
     def prepare(
@@ -589,6 +590,12 @@ class InferenceServerClient(InferenceServerClientBase):
                     prep.template.model_name, "grpc_aio", "infer",
                     time.perf_counter() - t0, ok=False,
                     request_bytes=req_bytes, request_id=rid)
+                if tel.tracing_enabled:
+                    tel.record_infer_spans(
+                        rid, prep.template.model_name, "grpc_aio", "infer",
+                        t_ser0, t_ser1, time.monotonic_ns(),
+                        traceparent=traceparent_from_metadata(metadata),
+                        ok=False)
             raise_error_grpc(e)
 
     async def infer_many(
@@ -729,6 +736,14 @@ class InferenceServerClient(InferenceServerClientBase):
             tel.record_request(
                 model_name, "grpc_aio", "infer", time.perf_counter() - t0,
                 ok=False, request_bytes=req_bytes, request_id=rid)
+            if tel.tracing_enabled:
+                # failed attempts stay on the journey's trace — the
+                # journeys report counts every attempt, not just winners
+                tel.record_infer_spans(
+                    rid, model_name, "grpc_aio", "infer", t_ser0, t_ser1,
+                    time.monotonic_ns(),
+                    traceparent=traceparent_from_metadata(metadata),
+                    ok=False)
             raise_error_grpc(e)
 
     def stream_infer(

@@ -73,7 +73,7 @@ class PreparedRequest:
                 _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(self.template.model_name, "http", "infer",
-                        request_id))
+                        request_id), journey=True)
 
 
 class InferAsyncRequest:
@@ -687,6 +687,15 @@ class InferenceServerClient(InferenceServerClientBase):
                 model_name, "http", _method, time.perf_counter() - t0,
                 ok=False, request_bytes=len(body),
                 request_id=rid)
+            if tel.tracing_enabled:
+                # failed attempts stay on the journey's trace: without this
+                # record the journeys report would undercount attempts and
+                # miss the replicas the failures actually landed on
+                tel.record_infer_spans(
+                    rid, model_name, "http", _method, t_ser0, t_ser1,
+                    time.monotonic_ns(),
+                    traceparent=traceparent_on_wire(headers, trace_headers),
+                    ok=False)
             raise
         t_net1 = time.monotonic_ns()
         tel.record_request(
@@ -765,6 +774,13 @@ class InferenceServerClient(InferenceServerClientBase):
                     prep.template.model_name, "http", _method,
                     time.perf_counter() - t0, ok=False,
                     request_bytes=len(body), request_id=rid)
+                if tel.tracing_enabled:
+                    tel.record_infer_spans(
+                        rid, prep.template.model_name, "http", _method,
+                        t_ser0, t_ser1, time.monotonic_ns(),
+                        traceparent=traceparent_on_wire(
+                            headers, trace_headers),
+                        ok=False)
             raise
         t_net1 = time.monotonic_ns()
         if _sink is not None:
@@ -906,7 +922,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 _remaining_s=remaining,
             ),
             method="infer", deadline_s=deadline_s,
-            retry_meta=(model_name, "http", "infer", request_id))
+            retry_meta=(model_name, "http", "infer", request_id),
+            journey=True)
 
     def async_infer(
         self,
@@ -967,7 +984,8 @@ class InferenceServerClient(InferenceServerClientBase):
                     _method="async_infer", _remaining_s=remaining,
                 ),
                 method="infer", deadline_s=deadline_s,
-                retry_meta=(model_name, "http", "async_infer", request_id))
+                retry_meta=(model_name, "http", "async_infer", request_id),
+                journey=True)
 
         future = self._executor.submit(_task)
         return InferAsyncRequest(future, self._verbose)

@@ -68,7 +68,7 @@ class PreparedRequest:
                 _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
             retry_meta=(self.template.model_name, "http_aio", "infer",
-                        request_id))
+                        request_id), journey=True)
 
 
 class InferenceServerClient(InferenceServerClientBase):
@@ -526,6 +526,13 @@ class InferenceServerClient(InferenceServerClientBase):
                     prep.template.model_name, "http_aio", "infer",
                     time.perf_counter() - t0, ok=False,
                     request_bytes=len(body), request_id=rid)
+                if tel.tracing_enabled:
+                    tel.record_infer_spans(
+                        rid, prep.template.model_name, "http_aio", "infer",
+                        t_ser0, t_ser1, time.monotonic_ns(),
+                        traceparent=traceparent_on_wire(
+                            headers, trace_headers),
+                        ok=False)
             raise
         t_net1 = time.monotonic_ns()
         if _sink is not None:
@@ -669,7 +676,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 response_compression_algorithm, parameters, tenant,
                 _remaining_s=remaining),
             method="infer", deadline_s=deadline_s,
-            retry_meta=(model_name, "http_aio", "infer", request_id))
+            retry_meta=(model_name, "http_aio", "infer", request_id),
+            journey=True)
 
     async def _infer_once(
         self,
@@ -737,6 +745,14 @@ class InferenceServerClient(InferenceServerClientBase):
                 model_name, "http_aio", "infer", time.perf_counter() - t0,
                 ok=False, request_bytes=len(body),
                 request_id=rid)
+            if tel.tracing_enabled:
+                # failed attempts stay on the journey's trace (see the
+                # sync client) — the journeys report counts every attempt
+                tel.record_infer_spans(
+                    rid, model_name, "http_aio", "infer", t_ser0, t_ser1,
+                    time.monotonic_ns(),
+                    traceparent=traceparent_on_wire(headers, trace_headers),
+                    ok=False)
             raise
         t_net1 = time.monotonic_ns()
         tel.record_request(

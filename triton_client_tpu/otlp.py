@@ -1,0 +1,412 @@
+"""Dependency-free OTLP/HTTP JSON trace export.
+
+The trace subsystem writes JSONL on both sides of the wire (client records
+via ``_telemetry.ClientTelemetry``, server records via
+``server/trace.RequestTracer``) keyed by one W3C ``traceparent``.  This
+module is the bridge from those records to any OTLP/HTTP collector
+(Jaeger, Tempo, the OpenTelemetry collector) with zero new dependencies:
+
+* :func:`encode_client_record` / :func:`encode_server_record` — one trace
+  record to a list of OTLP spans in the protobuf-JSON mapping
+  (``traceId``/``spanId`` as 32/16 lowercase hex chars, ``*UnixNano`` int64
+  fields as decimal strings, attribute values as typed ``stringValue`` /
+  ``intValue`` / ``boolValue`` / ``doubleValue`` wrappers).
+* Span ids are DERIVED DETERMINISTICALLY from the traceparent plus the span
+  path (record id, replica, span name, index) — re-encoding the same record
+  yields the same ids, so a collector receiving a journey twice (rotated
+  files, re-export) dedups instead of forking the trace.
+* :class:`OtlpExporter` — a batching background exporter over a bounded
+  queue.  ``submit`` is one lock round-trip and NEVER blocks, raises, or
+  fails the request that traced: a full queue increments a drop counter, a
+  dead collector increments an error counter.  The counters surface as
+  ``nv_otlp_export_total{outcome}`` / ``nv_otlp_dropped_total`` on the
+  server metrics page and ``nv_client_otlp_*`` on the client renderer.
+
+Clock note: trace records carry ``time.monotonic_ns()`` values.  The
+exporter captures one monotonic→unix offset at construction and rebases
+every span, so all spans exported by one process share a consistent
+wall-clock placement.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "OTLP_TRACES_PATH",
+    "OtlpExporter",
+    "derive_span_id",
+    "encode_client_record",
+    "encode_resource_spans",
+    "encode_server_record",
+    "normalize_endpoint",
+    "split_traceparent",
+]
+
+#: The OTLP/HTTP traces path (collectors listen on e.g. ``:4318/v1/traces``).
+OTLP_TRACES_PATH = "/v1/traces"
+
+#: OTLP SpanKind enum values (protobuf-JSON accepts the integer form).
+SPAN_KIND_INTERNAL = 1
+SPAN_KIND_SERVER = 2
+SPAN_KIND_CLIENT = 3
+
+_STATUS_ERROR = {"code": 2}  # STATUS_CODE_ERROR
+
+_TRACEPARENT_RE = re.compile(
+    r"\A[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}\Z")
+
+
+def split_traceparent(traceparent: str) -> Optional[Tuple[str, str]]:
+    """``(trace_id, span_id)`` hex fields of a W3C traceparent, or None
+    when malformed.  The trace id (32 hex chars) is the fleet-wide journey
+    key; the span id is the client span that propagated it."""
+    m = _TRACEPARENT_RE.match(traceparent or "")
+    if m is None:
+        return None
+    tid, sid = m.group(1), m.group(2)
+    if tid == "0" * 32 or sid == "0" * 16:
+        return None  # all-zero ids are invalid per the W3C spec
+    return tid, sid
+
+
+def derive_span_id(*parts: str) -> str:
+    """A deterministic 8-byte span id (16 hex chars) from a span's path —
+    the same (traceparent, replica, record, span) always maps to the same
+    id, so re-exported records dedup at the collector."""
+    h = hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+    # the all-zero id is reserved/invalid; sha256 cannot practically
+    # produce it, but the contract must not rest on "practically"
+    return h if h != "0" * 16 else "1" + h[1:]
+
+
+def _derive_trace_id(*parts: str) -> str:
+    h = hashlib.sha256("|".join(parts).encode()).hexdigest()[:32]
+    return h if h != "0" * 32 else "1" + h[1:]
+
+
+def _attr(key: str, value: Any) -> Dict[str, Any]:
+    if isinstance(value, bool):
+        v: Dict[str, Any] = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}  # proto-JSON int64 is a string
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
+
+
+def _span(trace_id: str, span_id: str, name: str, kind: int,
+          start_unix_ns: int, end_unix_ns: int,
+          parent_span_id: str = "", attributes: Optional[List[dict]] = None,
+          error: bool = False) -> Dict[str, Any]:
+    span: Dict[str, Any] = {
+        "traceId": trace_id,
+        "spanId": span_id,
+        "name": name,
+        "kind": kind,
+        # proto-JSON encodes fixed64/int64 as decimal strings
+        "startTimeUnixNano": str(int(start_unix_ns)),
+        "endTimeUnixNano": str(int(end_unix_ns)),
+    }
+    if parent_span_id:
+        span["parentSpanId"] = parent_span_id
+    if attributes:
+        span["attributes"] = attributes
+    if error:
+        span["status"] = _STATUS_ERROR
+    return span
+
+
+def encode_client_record(record: Dict[str, Any],
+                         clock_offset_ns: int = 0) -> List[Dict[str, Any]]:
+    """One client trace record (``record_client_trace`` shape) to OTLP
+    spans.  The REQUEST span's id IS the traceparent's span-id field — the
+    same id the server's root span names as its parent, so the collector
+    stitches client attempt and server processing into one tree.  Event
+    records (RETRY/HEDGE/BREAKER_OPEN/ENDPOINT_SWITCH) parent under the
+    attempt whose traceparent they carry."""
+    ids = split_traceparent(record.get("traceparent", ""))
+    if ids is None:
+        trace_id = _derive_trace_id("client", str(record.get("request_id")))
+        root_id = derive_span_id(trace_id, "client-root")
+    else:
+        trace_id, root_id = ids
+    attrs = [_attr("model", record.get("model", "")),
+             _attr("protocol", record.get("protocol", "")),
+             _attr("method", record.get("method", ""))]
+    if record.get("attempt"):
+        attrs.append(_attr("attempt", int(record["attempt"])))
+    if record.get("endpoint"):
+        attrs.append(_attr("endpoint", record["endpoint"]))
+    if record.get("request_id"):
+        attrs.append(_attr("triton.request_id", record["request_id"]))
+    error = not record.get("ok", True)
+    spans: List[Dict[str, Any]] = []
+    for i, s in enumerate(record.get("spans", ())):
+        name = s.get("name", "")
+        start = int(s.get("start_ns", 0)) + clock_offset_ns
+        end = int(s.get("end_ns", 0)) + clock_offset_ns
+        if name == "REQUEST":
+            spans.append(_span(trace_id, root_id, "client "
+                               + str(record.get("method") or "infer"),
+                               SPAN_KIND_CLIENT, start, end,
+                               attributes=attrs, error=error))
+        else:
+            spans.append(_span(
+                trace_id, derive_span_id(trace_id, root_id, name, str(i)),
+                name, SPAN_KIND_INTERNAL, start, end,
+                parent_span_id=root_id, attributes=attrs, error=error))
+    return spans
+
+
+def encode_server_record(record: Dict[str, Any],
+                         clock_offset_ns: int = 0) -> List[Dict[str, Any]]:
+    """One server trace record (``RequestTracer._emit`` shape, refusal
+    records included) to OTLP spans.  Span ids derive from (trace id,
+    replica, record id, span name, index); each record's root span (parent
+    null) names the propagated traceparent's span id as its parent — the
+    client attempt that reached this replica."""
+    replica = str(record.get("replica", ""))
+    rec_id = str(record.get("id", ""))
+    ids = split_traceparent(record.get("traceparent", ""))
+    if ids is None:
+        trace_id = _derive_trace_id(
+            "server", replica, rec_id,
+            str(record.get("triton_request_id", "")))
+        client_span_id = ""
+    else:
+        trace_id, client_span_id = ids
+    attrs = [_attr("model", record.get("model_name", ""))]
+    if record.get("model_version"):
+        attrs.append(_attr("model_version", str(record["model_version"])))
+    if replica:
+        attrs.append(_attr("replica", replica))
+    if record.get("triton_request_id"):
+        attrs.append(_attr("triton.request_id",
+                           record["triton_request_id"]))
+    if record.get("tenant"):
+        attrs.append(_attr("tenant", record["tenant"]))
+    outcome = record.get("outcome", "")
+    if outcome:
+        attrs.append(_attr("outcome", outcome))
+    if record.get("shed_reason"):
+        attrs.append(_attr("shed_reason", record["shed_reason"]))
+    if record.get("status"):
+        attrs.append(_attr("status", str(record["status"])))
+    error = bool(outcome) and outcome not in ("ok", "success", "cancelled")
+    # first pass: an id per span; parent linkage is by NAME in the record
+    # (first span of that name wins, matching the record's own convention)
+    raw = list(record.get("spans", ()))
+    span_ids = [derive_span_id(trace_id, replica, rec_id,
+                               s.get("name", ""), str(i))
+                for i, s in enumerate(raw)]
+    id_by_name: Dict[str, str] = {}
+    for i, s in enumerate(raw):
+        id_by_name.setdefault(s.get("name", ""), span_ids[i])
+    spans: List[Dict[str, Any]] = []
+    for i, s in enumerate(raw):
+        name = s.get("name", "")
+        parent = s.get("parent")
+        root = parent is None or parent not in id_by_name
+        start = int(s.get("start_ns", 0)) + clock_offset_ns
+        end_ns = s.get("end_ns")
+        end = int(end_ns if end_ns is not None
+                  else s.get("start_ns", 0)) + clock_offset_ns
+        spans.append(_span(
+            trace_id, span_ids[i],
+            ("server " + str(record.get("model_name", ""))
+             if root else name),
+            SPAN_KIND_SERVER if root else SPAN_KIND_INTERNAL,
+            start, end,
+            parent_span_id=(client_span_id if root
+                            else id_by_name[parent]),
+            attributes=attrs if root else None,
+            error=error if root else False))
+    return spans
+
+
+def encode_resource_spans(spans: List[Dict[str, Any]], service_name: str,
+                          resource_attributes: Optional[Dict[str, Any]]
+                          = None) -> Dict[str, Any]:
+    """The OTLP/HTTP request envelope: one ResourceSpans carrying every
+    span of one export batch under one resource identity."""
+    attrs = [_attr("service.name", service_name)]
+    for k, v in sorted((resource_attributes or {}).items()):
+        attrs.append(_attr(k, v))
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": attrs},
+            "scopeSpans": [{
+                "scope": {"name": "triton_client_tpu"},
+                "spans": spans,
+            }],
+        }]
+    }
+
+
+def normalize_endpoint(endpoint: str) -> str:
+    """An ``--otlp-endpoint`` value to the full traces URL: bare
+    ``host:port`` gains ``http://``; a URL without a path gains
+    ``/v1/traces`` (so both ``localhost:4318`` and a full collector URL
+    work)."""
+    url = endpoint.strip()
+    if not url:
+        raise ValueError("empty OTLP endpoint")
+    if "://" not in url:
+        url = "http://" + url
+    scheme, _, rest = url.partition("://")
+    if "/" not in rest:
+        url = f"{scheme}://{rest}{OTLP_TRACES_PATH}"
+    return url
+
+
+class OtlpExporter:
+    """Batching background OTLP/HTTP exporter over a bounded queue.
+
+    ``submit(record)`` never blocks or raises: it appends the raw trace
+    record (encoding is deferred to the background thread — the request
+    path pays one lock and one list append) or bumps the drop counter when
+    the queue is full.  One daemon thread drains batches of up to
+    ``batch_max`` records every ``flush_interval_s`` (or immediately when
+    a batch is ready) and POSTs protobuf-JSON ResourceSpans."""
+
+    def __init__(self, endpoint: str, service_name: str,
+                 encode: Callable[[Dict[str, Any], int],
+                                  List[Dict[str, Any]]],
+                 resource_attributes: Optional[Dict[str, Any]] = None,
+                 queue_size: int = 4096, batch_max: int = 128,
+                 flush_interval_s: float = 0.5, timeout_s: float = 5.0,
+                 clock_offset_ns: Optional[int] = None) -> None:
+        self.url = normalize_endpoint(endpoint)
+        self.service_name = service_name
+        self._encode = encode
+        self._resource_attributes = dict(resource_attributes or {})
+        self._queue_size = max(1, int(queue_size))
+        self._batch_max = max(1, int(batch_max))
+        self._flush_interval_s = flush_interval_s
+        self._timeout_s = timeout_s
+        # one monotonic→unix rebase for every span this process exports
+        self._clock_offset_ns = (
+            clock_offset_ns if clock_offset_ns is not None
+            else time.time_ns() - time.monotonic_ns())
+        self._lock = threading.Lock()
+        self._buf: List[Dict[str, Any]] = []
+        self._dropped = 0
+        self._exported = {"ok": 0, "error": 0}
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._drain = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request-path side -------------------------------------------------
+    def submit(self, record: Dict[str, Any]) -> None:
+        """Enqueue one raw trace record.  Never blocks, never raises —
+        a full queue (collector down or slow) drops and counts."""
+        with self._lock:
+            if self._stop:
+                self._dropped += 1
+                return
+            if len(self._buf) >= self._queue_size:
+                self._dropped += 1
+                return
+            self._buf.append(record)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="tc-tpu-otlp")
+                self._thread.start()
+            self._idle.clear()
+            # wake the exporter only when a full batch is ready — the
+            # interval timer picks up partial batches, so the hot path
+            # pays one lock + one append per record, not a syscall
+            wake = len(self._buf) >= self._batch_max
+        if wake:
+            self._wake.set()
+
+    def counters(self) -> Dict[str, int]:
+        """``{"ok": exports, "error": exports, "dropped": records}`` —
+        the nv_otlp_* metric families render from this."""
+        with self._lock:
+            return {"ok": self._exported["ok"],
+                    "error": self._exported["error"],
+                    "dropped": self._dropped}
+
+    # -- background side ---------------------------------------------------
+    def _run(self) -> None:
+        """Drain loop: export when a full batch is ready, on the interval
+        tick, or on flush/shutdown — NOT on every record.  Greedy
+        per-record draining would degenerate into one tiny POST (a fresh
+        connection + collector parse) per couple of spans under load,
+        which costs more than the spans it carries."""
+        deadline = time.monotonic() + self._flush_interval_s
+        while True:
+            self._wake.wait(max(0.0, deadline - time.monotonic()))
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    stop, drain = self._stop, self._drain
+                    due = (stop or drain
+                           or len(self._buf) >= self._batch_max
+                           or time.monotonic() >= deadline)
+                    batch = self._buf[:self._batch_max] if due else []
+                    del self._buf[:len(batch)]
+                    if not batch and not self._buf:
+                        self._drain = False
+                        self._idle.set()
+                if not batch:
+                    if stop:
+                        return
+                    if due:
+                        deadline = (time.monotonic()
+                                    + self._flush_interval_s)
+                    break
+                deadline = time.monotonic() + self._flush_interval_s
+                self._export_batch(batch)
+
+    def _export_batch(self, batch: List[Dict[str, Any]]) -> None:
+        try:
+            spans: List[Dict[str, Any]] = []
+            for record in batch:
+                spans.extend(self._encode(record, self._clock_offset_ns))
+            payload = json.dumps(encode_resource_spans(
+                spans, self.service_name,
+                self._resource_attributes)).encode()
+            req = urllib.request.Request(
+                self.url, data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=self._timeout_s):
+                pass
+            ok = True
+        except Exception:
+            # the collector being down/slow/broken must never surface
+            # beyond this counter — observability cannot cost availability
+            ok = False
+        with self._lock:
+            self._exported["ok" if ok else "error"] += 1
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Block until the queue drains (tests / shutdown); True when it
+        did within the budget."""
+        with self._lock:
+            self._drain = True
+        self._wake.set()
+        return self._idle.wait(timeout_s)
+
+    def shutdown(self, timeout_s: float = 2.0) -> None:
+        """Stop accepting, drain what's queued (best effort within the
+        budget), and join the thread."""
+        with self._lock:
+            self._stop = True
+            thread = self._thread
+        self._wake.set()
+        if thread is not None:
+            thread.join(timeout_s)

@@ -232,6 +232,13 @@ def main() -> None:
                         help="dedicated Prometheus /metrics port (Triton "
                         "convention; 0 disables — /metrics stays on the "
                         "main HTTP port either way)")
+    parser.add_argument("--otlp-endpoint", default=None, metavar="URL",
+                        help="OTLP/HTTP collector to export trace spans "
+                        "to (e.g. http://collector:4318 — /v1/traces is "
+                        "appended when the URL has no path).  Dependency-"
+                        "free: records are encoded as proto-JSON "
+                        "ResourceSpans and batched by a background "
+                        "exporter that never blocks the serving path")
     parser.add_argument("--coordinator-address", default=None,
                         help="host:port of process 0 — enables multi-host "
                         "(jax.distributed over DCN); every host runs this "
@@ -386,6 +393,23 @@ def main() -> None:
         core.slo.set_objective(name, objective)
         print(f"SLO: {name} p99<={objective.p99_ms:g}ms "
               f"availability={objective.availability:g}")
+
+    # replica identity: every trace record this process emits carries it,
+    # so a cross-replica journey join can tell which replica served which
+    # attempt.  TRITON_TPU_REPLICA wins (fleet operators name replicas);
+    # otherwise host:port plus the frontend worker index when sharded.
+    replica = os.environ.get("TRITON_TPU_REPLICA", "")
+    if not replica:
+        replica = f"{args.host}:{args.http_port}"
+        if worker_index is not None:
+            replica += f"#w{worker_index}"
+    core.tracer.replica = replica
+    if args.otlp_endpoint:
+        try:
+            core.enable_otlp(args.otlp_endpoint, replica=replica)
+        except ValueError as e:
+            parser.error(str(e))
+        print(f"OTLP export: {args.otlp_endpoint} (replica={replica})")
 
     # per-worker metrics port: the main ports are kernel-balanced across
     # workers, so the dedicated metrics/debug port is the one per-process

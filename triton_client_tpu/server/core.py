@@ -735,8 +735,10 @@ class InferenceCore:
         so every downstream consumer — batcher lanes, flight records,
         metrics labels — sees the same classification."""
         if not self.accepting:
-            raise InferError("server is shutting down", http_status=503,
+            err = InferError("server is shutting down", http_status=503,
                              retry_after_s=self.shed_retry_after_s)
+            err.refusal_reason = "drain"
+            raise err
         qos = self.qos
         request.tier = qos.tier_of(request.priority)
         if not request.tenant:
@@ -749,10 +751,12 @@ class InferenceCore:
             # it says exactly when a token frees up; flooring it at the
             # queue-shed base would make fast-refilling tenants wait
             # longer than the limiter requires
-            raise InferError(
+            err = InferError(
                 f"tenant '{request.tenant}' is over its rate limit for "
                 f"model '{model.name}'; retry later",
                 http_status=429, retry_after_s=retry_in)
+            err.refusal_reason = "rate_limit"
+            raise err
         # byte-accounted admission (server/memory.py): the arrival's wire
         # bytes must fit its tier's share of the live host budget, or it
         # sheds here — tier-aware (best effort first) and largest-first
@@ -821,7 +825,7 @@ class InferenceCore:
         # through — hand the bytes back before raising
         self.memory.release(model.name, request.tenant, request.wire_bytes)
         self._count_shed(model, request.tenant, request.tier)
-        raise InferError(
+        err = InferError(
             f"request queue for model '{model.name}' is full for tier "
             f"{request.tier} ({model.stats.pending_count} pending, tier "
             f"limit {qos.tier_limit(request.tier, limit)}); retry later",
@@ -829,6 +833,32 @@ class InferenceCore:
             retry_after_s=qos.pushback_s(
                 self.shed_retry_after_s,
                 self._tier_depth(model, request.tier), limit))
+        err.refusal_reason = "queue_full"
+        raise err
+
+    def _admit_traced(self, model: Model, request: InferRequest) -> None:
+        """Admission with refusal tracing: a shed never reaches the traced
+        inference path, so without this a refused request with a propagated
+        ``traceparent`` would simply vanish from the journey — the client
+        records a failed attempt and no server record explains why.  The
+        refusal record (tracer.record_refusal) is zero-cost when tracing is
+        off and carries ``shed_reason`` + the propagated trace context."""
+        try:
+            self._admit(model, request)
+        except InferError as e:
+            # refusal_reason covers every admission refusal; shed_reason
+            # stays a memory-governor-only attribute (its pre-existing
+            # contract: None distinguishes a queue shed from a memory shed)
+            self.tracer.record_refusal(
+                model.name,
+                shed_reason=(getattr(e, "refusal_reason", "")
+                             or getattr(e, "shed_reason", "") or ""),
+                status=e.http_status,
+                tenant=request.tenant,
+                protocol=request.protocol,
+                client_request_id=request.client_request_id,
+                traceparent=request.traceparent)
+            raise
 
     def _check_deadline(self, model: Model, request: InferRequest) -> None:
         """Drop an already-expired request before any compute (proper v2
@@ -888,7 +918,7 @@ class InferenceCore:
                 f"doesn't support models with decoupled transaction policy",
                 http_status=400,
             )
-        self._admit(model, request)
+        self._admit_traced(model, request)
         return await self._infer_on(model, request)
 
     async def _infer_on(self, model: Model, request: InferRequest) -> InferResponse:
@@ -1140,7 +1170,7 @@ class InferenceCore:
         # admission gates EVERY stream entry (decoupled or not): the gRPC
         # bidi path reaches the core only through here, and a saturated or
         # draining server must refuse streamed requests like unary ones
-        self._admit(model, request)
+        self._admit_traced(model, request)
         if not model.decoupled:
             yield await self._infer_on(model, request)
             return
@@ -1481,6 +1511,25 @@ class InferenceCore:
         # frees the entries without waiting for cap eviction
         self.http_wire_templates.retire(name)
         self.grpc_wire_templates.retire(name)
+
+    def enable_otlp(self, endpoint: str, replica: str = "") -> None:
+        """Wire an OTLP/HTTP span exporter onto the tracer (``serve
+        --otlp-endpoint``): every emitted trace record — successes and
+        refusals alike — is also encoded as proto-JSON ResourceSpans and
+        POSTed to the collector by a background batcher that never blocks
+        the serving path.  ``replica`` stamps this process's identity into
+        the records first, so the collector (and the journey join) can
+        tell which replica served which attempt.  The exporter shuts down
+        with the tracer (core.shutdown -> tracer.shutdown)."""
+        from ..otlp import OtlpExporter, encode_server_record
+
+        if replica:
+            self.tracer.replica = replica
+        old, self.tracer.otlp = self.tracer.otlp, OtlpExporter(
+            endpoint, "triton-tpu-server", encode_server_record,
+            resource_attributes={"replica": replica} if replica else None)
+        if old is not None:
+            old.shutdown()
 
     async def shutdown(self, drain_s: float = 5.0) -> None:
         """Graceful drain, then teardown: stop accepting (new requests get

@@ -345,6 +345,24 @@ def collect_families(core: InferenceCore) -> List[Family]:
     fleet_rows = collect_fleet_rows(core)
     for key, name, kind, help_text in _FLEET_FAMILIES:
         families.append((name, help_text, kind, fleet_rows.get(key, [])))
+
+    # -- OTLP span export (otlp.py, serve --otlp-endpoint) -----------------
+    # families appear only when the exporter is wired: absent series are
+    # honest ("not exporting"), a zero would read as "exporting, idle"
+    otlp = core.tracer.otlp
+    if otlp is not None:
+        counters = otlp.counters()
+        families.append((
+            "nv_otlp_export_total",
+            "Number of OTLP export batches by outcome (ok = collector "
+            "accepted, error = POST failed or non-2xx)", "counter",
+            [({"outcome": "ok"}, counters["ok"]),
+             ({"outcome": "error"}, counters["error"])]))
+        families.append((
+            "nv_otlp_dropped_total",
+            "Number of trace records dropped because the OTLP export "
+            "queue was full (the exporter never blocks the serving path)",
+            "counter", [({}, counters["dropped"])]))
     return families
 
 

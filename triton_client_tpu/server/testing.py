@@ -60,6 +60,7 @@ class ServerHarness:
         tls=None,
         metrics_port: Optional[int] = None,
         max_request_bytes: Optional[int] = None,
+        replica: str = "",
     ):
         self.registry = registry or ModelRegistry()
         self.core = InferenceCore(self.registry)
@@ -71,6 +72,11 @@ class ServerHarness:
         self.max_request_bytes = max_request_bytes
         self.http_port = http_port or free_port()
         self.grpc_port = grpc_port or free_port()
+        # replica identity stamped into every trace record this harness
+        # emits (same contract as the CLI server): explicit name, else
+        # host:port — the join key for cross-replica journey assertions
+        self.replica = replica or f"{self.host}:{self.http_port}"
+        self.core.tracer.replica = self.replica
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -169,8 +175,12 @@ class ClusterHarness:
         # same policy surface its predecessor ran, like a real process
         # respawned from the same config
         self._core_setup = core_setup
+        # replicas get stable names ("replica-0", ...) that survive
+        # kill/restart cycles — a journey's per-replica lanes must keep
+        # their identity across the failover they are asserting about
         self.harnesses: List[Optional[ServerHarness]] = [
-            ServerHarness(registry_factory(), host=host) for _ in range(n)]
+            ServerHarness(registry_factory(), host=host,
+                          replica=f"replica-{i}") for i in range(n)]
         # ports are pinned at construction so restart(i) can rebind them
         self._http_ports = [h.http_port for h in self.harnesses]
         self._grpc_ports = [h.grpc_port for h in self.harnesses]
@@ -212,7 +222,8 @@ class ClusterHarness:
             raise RuntimeError(f"server {i} is already running")
         h = ServerHarness(self._registry_factory(),
                           http_port=self._http_ports[i],
-                          grpc_port=self._grpc_ports[i], host=self.host)
+                          grpc_port=self._grpc_ports[i], host=self.host,
+                          replica=f"replica-{i}")
         h.start()
         if self._core_setup is not None:
             self._core_setup(h)

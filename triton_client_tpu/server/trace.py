@@ -433,6 +433,14 @@ class RequestTracer:
         # the core's FlightRecorder (set by InferenceCore): emit() hands
         # every armed context's completed record to it
         self.flight_recorder = None
+        # replica identity stamped into every emitted record (set once at
+        # startup from --frontend-worker / TRITON_TPU_REPLICA / host:port,
+        # or by the test harness): the join key that tells which replica
+        # served which leg of a cross-replica journey
+        self.replica = ""
+        # optional OtlpExporter (set by InferenceCore when --otlp-endpoint
+        # is configured): every emitted record is also submitted there
+        self.otlp = None
 
     # -- settings lifecycle ------------------------------------------------
     def settings_updated(self) -> None:
@@ -497,6 +505,9 @@ class RequestTracer:
         return self._trace_file() + ".profile"
 
     def shutdown(self) -> None:
+        otlp, self.otlp = self.otlp, None
+        if otlp is not None:
+            otlp.shutdown()
         self._out.close()
         if self._profiling:
             try:
@@ -597,6 +608,54 @@ class RequestTracer:
                                  client_request_id, traceparent,
                                  cls=StreamTraceContext)
 
+    def record_refusal(self, model_name: str, *,
+                       shed_reason: str = "", status: int = 0,
+                       tenant: str = "", protocol: str = "",
+                       client_request_id: str = "",
+                       traceparent: str = "") -> None:
+        """A request was REFUSED before admission (QoS 429, memory 413/429,
+        drain 503): emit a minimal trace record carrying the propagated
+        ``traceparent`` and the ``shed_reason`` so the journey join can tell
+        a shed attempt from a lost one.  Zero-cost when tracing is off: the
+        first line bails before any allocation.  Refusals do not consume the
+        rate/count sampling budget — a shed storm must not starve the trace
+        file of the successes it is shedding to protect."""
+        if "TIMESTAMPS" not in (self._settings.get("trace_level") or ["OFF"]):
+            return
+        now = time.monotonic_ns()
+        with self._lock:
+            self._next_id += 1
+            rec_id = self._next_id
+            path = self._trace_file()
+        record: Dict[str, object] = {
+            "id": rec_id,
+            "model_name": model_name,
+            "model_version": "",
+            "timestamps": [{"name": "REFUSED", "ns": now}],
+            "spans": [{"name": "REQUEST", "start_ns": now,
+                       "end_ns": now, "parent": None}],
+            "refused": True,
+            "outcome": "shed",
+        }
+        if shed_reason:
+            record["shed_reason"] = shed_reason
+        if status:
+            record["status"] = status
+        if tenant:
+            record["tenant"] = tenant
+        if protocol:
+            record["protocol"] = protocol
+        if client_request_id:
+            record["triton_request_id"] = client_request_id
+        if traceparent:
+            record["traceparent"] = traceparent
+        if self.replica:
+            record["replica"] = self.replica
+        otlp = self.otlp
+        if otlp is not None:
+            otlp.submit(record)
+        self._out.append(path, json.dumps(record) + "\n")
+
     def _emit(self, ctx: TraceContext) -> None:
         record = {
             "id": ctx.id,
@@ -640,6 +699,13 @@ class RequestTracer:
             record["triton_request_id"] = ctx.client_request_id
         if ctx.traceparent:
             record["traceparent"] = ctx.traceparent
+        if self.replica:
+            record["replica"] = self.replica
+        otlp = self.otlp
+        if otlp is not None:
+            # never blocks: the exporter queues (or drops) under its own
+            # lock, so a slow collector cannot slow the emitting request
+            otlp.submit(record)
         line = json.dumps(record)
         # ctx.path is the sampling scope's file, not necessarily global;
         # an unwritable trace_file must never fail the inference that

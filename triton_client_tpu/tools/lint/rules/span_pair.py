@@ -26,6 +26,13 @@ shape, not whole-program escape analysis):
   the same contract because a stream context that never reaches ``emit``
   loses the WHOLE generation (every token event, every tick join) from
   the trace file and the SLO pipeline, not just one request.
+* a journey scope from ``begin_journey(...)`` requires an
+  ``end_journey(...)`` in the same function — or the scope escaping as a
+  return value / call argument.  A leaked journey scope is worse than a
+  lost span: the contextvar keeps the journey alive past its retry loop,
+  so UNRELATED later requests on the same thread/task inherit its trace
+  id and every journey after the leak collapses into one giant bogus
+  trace.
 """
 
 from __future__ import annotations
@@ -42,6 +49,26 @@ _STARTERS_CTX = {"maybe_start", "start_shadow",
 # failure and the envelope's finally emits — in-function evidence of
 # either is the pairing this rule wants
 _CLOSERS = {"end", "finish", "emit", "emit_async", "mark_failed"}
+_STARTER_JOURNEY = "begin_journey"
+_CLOSER_JOURNEY = "end_journey"
+
+
+def _call_name(func: ast.AST) -> str:
+    """The terminal name of a call target: ``begin_journey`` for both the
+    bare imported form and ``tel.begin_journey``-style attributes."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _journey_closed(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and _call_name(node.func) == _CLOSER_JOURNEY:
+            return True
+    return False
 
 
 def _completion_evidence(fn: ast.AST) -> bool:
@@ -86,15 +113,33 @@ def check(project: Project):
         if f.tree is None:
             continue
         rp = f.relpath.replace("\\", "/")
-        if rp.endswith("server/trace.py"):
-            continue  # the implementation itself defines these methods
+        if rp.endswith("server/trace.py") or rp.endswith("_telemetry.py"):
+            continue  # the implementations themselves define these methods
         for _cls, fn in iter_functions(f.tree):
             has_completion = None  # computed lazily per function
+            journey_closed = None
             # own-body only: a starter inside a nested def is that
             # function's responsibility (iter_functions visits it too)
             for node in iter_body_nodes(fn):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _call_name(node.func) == _STARTER_JOURNEY:
+                    if journey_closed is None:
+                        journey_closed = _journey_closed(fn)
+                    if journey_closed:
+                        continue
+                    target = _assigned_name(fn, node)
+                    if target is not None and _escapes(fn, target):
+                        continue
+                    yield Finding(
+                        "SPAN-PAIR", f.relpath, node.lineno,
+                        f"begin_journey(...) with no end_journey in "
+                        f"{fn.name}() — the leaked journey scope makes "
+                        "every later request on this context share one "
+                        "trace id",
+                        symbol=f.symbol_at(node.lineno))
+                    continue
+                if not isinstance(node.func, ast.Attribute):
                     continue
                 attr = node.func.attr
                 if attr in _STARTERS_SPAN:

@@ -36,8 +36,11 @@ environment with none of the optional client deps installed.
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
 import math
+import os
+import re
 import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -57,6 +60,44 @@ SERVER_STAGES = (
 )
 #: Client-side stages recorded by the instrumented clients.
 CLIENT_STAGES = ("SERIALIZE", "NETWORK", "DESERIALIZE")
+
+
+def expand_inputs(paths: Sequence[str]) -> List[str]:
+    """Expand a mix of literal paths, globs, and directories into a
+    deduplicated file list.  A directory contributes every regular file in
+    it (one rotated trace set per directory is the common layout); a glob
+    contributes its matches.  Dedup is by ``realpath`` so overlapping
+    specs — ``trace.json trace.json*``, or a directory plus a glob into
+    it — never double-count a rotated file's records.  A literal path
+    with no glob match is kept as-is so ``open()`` fails loudly."""
+    out: List[str] = []
+    seen = set()
+    for p in paths:
+        if os.path.isdir(p):
+            matches = sorted(
+                m for m in _glob.glob(os.path.join(p, "*"))
+                if os.path.isfile(m))
+        else:
+            matches = sorted(m for m in _glob.glob(p) if os.path.isfile(m))
+            if not matches and not _glob.has_magic(p):
+                matches = [p]
+        for m in matches:
+            rp = os.path.realpath(m)
+            if rp in seen:
+                continue
+            seen.add(rp)
+            out.append(m)
+    return out
+
+
+def load_trace_files(paths: Sequence[str]) -> List[dict]:
+    """Load and concatenate every file ``expand_inputs`` resolves from
+    ``paths`` (records keep file order; files are visited in the expanded
+    order, so a rotated set ``trace.json.0 .1 ...`` reads chronologically)."""
+    records: List[dict] = []
+    for path in expand_inputs(paths):
+        records.extend(load_trace_file(path))
+    return records
 
 
 def load_trace_file(path: str) -> List[dict]:
@@ -281,7 +322,145 @@ def summarize(server_records: List[dict],
     }
     if client_records is not None:
         summary["join"] = _join(server_records, client_records)
+        journeys = _journeys(server_records, client_records)
+        if journeys is not None:
+            summary["journeys"] = journeys
     return summary
+
+
+_TRACEPARENT_RE = re.compile(
+    r"\A[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}\Z")
+
+
+def trace_id_of(rec: dict) -> str:
+    """The 32-hex trace id of a record's ``traceparent``, or "".  The
+    JOURNEY join key: client attempt records mint a fresh span id per
+    attempt but share one trace id, so joining on the full traceparent
+    would split one journey into its attempts."""
+    m = _TRACEPARENT_RE.match(str(rec.get("traceparent", "")))
+    return m.group(1) if m else ""
+
+
+def _journeys(server_records: List[dict],
+              client_records: List[dict]) -> Optional[Dict[str, Any]]:
+    """Reconstruct request journeys: every client record (attempts, RETRY
+    backoffs, HEDGE wins, BREAKER_OPEN/ENDPOINT_SWITCH events) and every
+    server record (successes and refusals) carrying the same trace id is
+    one caller-visible request's story.  Returns None when no client
+    record carries a traceparent (pre-journey trace files)."""
+    jmap: Dict[str, Dict[str, Any]] = {}
+    for rec in client_records:
+        tid = trace_id_of(rec)
+        if not tid:
+            continue
+        j = jmap.setdefault(tid, {"attempts": [], "events": {}, "hedge_wins": 0})
+        names = [str(s.get("name", "")) for s in rec.get("spans", [])]
+        if "REQUEST" in names:
+            j["attempts"].append(rec)
+        elif "HEDGE" in names:
+            # only hedge WINS are recorded (the backup answered first);
+            # fired-but-lost hedges show up as overlapping attempts below
+            j["hedge_wins"] += 1
+        else:
+            for name in names:
+                j["events"][name] = j["events"].get(name, 0) + 1
+    if not jmap:
+        return None
+    smap: Dict[str, List[dict]] = {}
+    for rec in server_records:
+        tid = trace_id_of(rec)
+        if tid:
+            smap.setdefault(tid, []).append(rec)
+
+    def _request_span(rec: dict) -> Optional[Tuple[int, int]]:
+        for name, start, end in record_spans(rec):
+            if name == "REQUEST":
+                return start, end
+        return None
+
+    complete = 0
+    attempts_per_success: List[int] = []
+    replica_counts: List[int] = []
+    cross_replica = 0
+    retry_added_ns: List[int] = []
+    hedges_fired = 0
+    hedge_wins = 0
+    shed_journeys = 0
+    shed_converted = 0
+    event_totals: Dict[str, int] = {}
+    for tid, j in jmap.items():
+        spans = [(_request_span(a), bool(a.get("ok", True)))
+                 for a in j["attempts"]]
+        spans = [(iv, ok) for iv, ok in spans if iv is not None]
+        success = any(ok for _, ok in spans)
+        if success:
+            complete += 1
+            attempts_per_success.append(len(j["attempts"]))
+            if len(spans) > 1:
+                # wall-clock the retries added on the CLIENT clock: the
+                # whole journey envelope (first attempt start -> last
+                # attempt end, backoff sleeps included) minus the winning
+                # attempt's own duration
+                lo = min(s for (s, _), _ in spans)
+                hi = max(e for (_, e), _ in spans)
+                win = max(e - s for (s, e), ok in spans if ok)
+                retry_added_ns.append(max(0, (hi - lo) - win))
+        ordered = sorted(iv for iv, _ in spans)
+        overlapped = any(b_start < a_end for (_, a_end), (b_start, _)
+                        in zip(ordered, ordered[1:]))
+        if overlapped or j["hedge_wins"]:
+            hedges_fired += 1
+        if j["hedge_wins"]:
+            hedge_wins += 1
+        sjoin = smap.get(tid, [])
+        replicas = {str(r.get("replica", "")) for r in sjoin
+                    if r.get("replica")}
+        if replicas:
+            replica_counts.append(len(replicas))
+            if len(replicas) > 1:
+                cross_replica += 1
+        if any(r.get("refused") for r in sjoin):
+            shed_journeys += 1
+            if success:
+                shed_converted += 1
+        for name, count in j["events"].items():
+            event_totals[name] = event_totals.get(name, 0) + count
+    n = len(jmap)
+    counts = sorted(attempts_per_success)
+    return {
+        "count": n,
+        "complete": complete,
+        "attempts_per_success": {
+            "mean": (round(sum(counts) / len(counts), 2) if counts
+                     else None),
+            "p50": percentile(counts, 50) if counts else None,
+            "p99": percentile(counts, 99) if counts else None,
+            "max": counts[-1] if counts else None,
+        },
+        "replicas_per_journey": {
+            "mean": (round(sum(replica_counts) / len(replica_counts), 2)
+                     if replica_counts else None),
+            "max": max(replica_counts) if replica_counts else None,
+            "cross_replica_journeys": cross_replica,
+        },
+        "retry_added_us": _stage_stats(retry_added_ns),
+        "hedge": {
+            "fired": hedges_fired,
+            "wins": hedge_wins,
+            "win_rate_pct": (round(100.0 * hedge_wins / hedges_fired, 1)
+                             if hedges_fired else None),
+        },
+        "sheds": {
+            "journeys_shed": shed_journeys,
+            "converted": shed_converted,
+            "conversion_pct": (round(100.0 * shed_converted / shed_journeys,
+                                     1) if shed_journeys else None),
+        },
+        "events": dict(sorted(event_totals.items())),
+        # server trace ids with no client-side journey: traffic from
+        # un-instrumented callers (or a client file that wasn't collected)
+        "orphan_server_traces": sum(1 for t in smap if t not in jmap),
+    }
 
 
 def _join(server_records: List[dict],
@@ -425,6 +604,41 @@ def format_text(summary: Dict[str, Any]) -> str:
             f"p99_us {_fmt_val(ov['p99_us'])}")
         lines.extend(
             _stage_table(list(join["client_stages"].items()), share=False))
+    jo = summary.get("journeys")
+    if jo is not None:
+        lines.append("")
+        lines.append(f"== journeys: {jo['count']} trace id(s), "
+                     f"{jo['complete']} complete ==")
+        a = jo["attempts_per_success"]
+        lines.append(
+            f"  attempts/success: mean {_fmt_val(a['mean'])}  "
+            f"p50 {_fmt_val(a['p50'])}  p99 {_fmt_val(a['p99'])}  "
+            f"max {a['max'] if a['max'] is not None else '-'}")
+        r = jo["replicas_per_journey"]
+        lines.append(
+            f"  replicas/journey: mean {_fmt_val(r['mean'])}  "
+            f"max {r['max'] if r['max'] is not None else '-'}  "
+            f"cross-replica journeys {r['cross_replica_journeys']}")
+        ra = jo["retry_added_us"]
+        lines.append(
+            f"  retry-added latency us ({ra['count']} multi-attempt "
+            f"journey(s)): p50 {_fmt_val(ra['p50_us'])}  "
+            f"p99 {_fmt_val(ra['p99_us'])}")
+        h = jo["hedge"]
+        lines.append(
+            f"  hedges: fired {h['fired']}  wins {h['wins']}  "
+            f"win rate {_fmt_val(h['win_rate_pct'])}%")
+        s = jo["sheds"]
+        lines.append(
+            f"  sheds: {s['journeys_shed']} journey(s) shed, "
+            f"{s['converted']} converted to success "
+            f"({_fmt_val(s['conversion_pct'])}%)")
+        if jo["events"]:
+            lines.append("  events: " + "  ".join(
+                f"{k}={v}" for k, v in jo["events"].items()))
+        if jo["orphan_server_traces"]:
+            lines.append(f"  orphan server traces (no client journey): "
+                         f"{jo['orphan_server_traces']}")
     return "\n".join(lines) + "\n"
 
 
@@ -557,7 +771,112 @@ def chrome_trace(server_records: List[dict],
                     "args": {"model": rec.get("model", ""),
                              "request_id": rid},
                 })
+        # journey lanes: one pid per trace id, the client's attempts on
+        # lane 0 and one lane per replica the journey touched, all on ONE
+        # rebased clock — each joined server record is shifted onto the
+        # client clock by aligning its REQUEST start with the wire time of
+        # the attempt that reached it (exact traceparent match)
+        events.extend(_journey_lanes(server_records, client_records))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+#: first journey pid in the chrome export (server=1, client=2, decode
+#: worker=3 — journeys start far above so added fixed pids never collide)
+JOURNEY_PID_BASE = 100
+
+
+def _journey_lanes(server_records: List[dict],
+                   client_records: List[dict]) -> List[dict]:
+    jmap: Dict[str, List[dict]] = {}
+    for rec in client_records:
+        tid = trace_id_of(rec)
+        if tid:
+            jmap.setdefault(tid, []).append(rec)
+    if not jmap:
+        return []
+    smap: Dict[str, List[dict]] = {}
+    for rec in server_records:
+        tid = trace_id_of(rec)
+        if tid:
+            smap.setdefault(tid, []).append(rec)
+    events: List[dict] = []
+    pid = JOURNEY_PID_BASE
+    for tid in sorted(jmap):
+        crecs = jmap[tid]
+        pid += 1
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "args": {"name": f"journey {tid[:8]}"}})
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": 0, "args": {"name": "client"}})
+        cstarts = [s for rec in crecs for _, s, _ in record_spans(rec)]
+        base = min(cstarts) if cstarts else 0
+        # the wire anchor of each attempt: its NETWORK span start (fall
+        # back to REQUEST start), keyed by the attempt's full traceparent
+        anchors: Dict[str, int] = {}
+        for rec in crecs:
+            tp = str(rec.get("traceparent", ""))
+            spans = {name: start for name, start, _ in record_spans(rec)}
+            if tp and ("NETWORK" in spans or "REQUEST" in spans):
+                anchors.setdefault(
+                    tp, spans.get("NETWORK", spans.get("REQUEST", 0)))
+        for rec in crecs:
+            attempt = rec.get("attempt")
+            for name, start, end in record_spans(rec):
+                ev = {
+                    "name": name,
+                    "ts": (start - base) / 1e3,
+                    "pid": pid,
+                    "tid": 0,
+                    "cat": "journey",
+                    "args": {"model": rec.get("model", ""),
+                             "request_id": rec.get("request_id", "")},
+                }
+                if attempt is not None:
+                    ev["args"]["attempt"] = attempt
+                if end > start:
+                    ev.update(ph="X", dur=(end - start) / 1e3)
+                else:
+                    # zero-duration journey event (BREAKER_OPEN, ...)
+                    ev.update(ph="i", s="t")
+                events.append(ev)
+        lanes: Dict[str, int] = {}
+        for rec in smap.get(tid, []):
+            spans = record_spans(rec)
+            root = next((s for s in spans if s[0] == "REQUEST"), None)
+            if root is None:
+                continue
+            anchor = anchors.get(str(rec.get("traceparent", "")))
+            # server clock -> client clock: the attempt hit the wire at
+            # `anchor`, the server opened its root at root start.  With no
+            # exact attempt match the record sits at the journey origin.
+            offset = (anchor - root[1]) if anchor is not None else (base - root[1])
+            replica = str(rec.get("replica", "")) or "server"
+            lane = lanes.get(replica)
+            if lane is None:
+                lane = lanes[replica] = len(lanes) + 1
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": pid, "tid": lane,
+                               "args": {"name": replica}})
+            args: Dict[str, Any] = {"model": rec.get("model_name", "")}
+            for key in ("outcome", "shed_reason"):
+                if key in rec:
+                    args[key] = rec[key]
+            for name, start, end in spans:
+                ev = {
+                    "name": ("REFUSED" if name == "REQUEST"
+                             and rec.get("refused") else name),
+                    "ts": (start + offset - base) / 1e3,
+                    "pid": pid,
+                    "tid": lane,
+                    "cat": "journey",
+                    "args": args,
+                }
+                if end > start:
+                    ev.update(ph="X", dur=(end - start) / 1e3)
+                else:
+                    ev.update(ph="i", s="t")
+                events.append(ev)
+    return events
 
 
 # -- CLI --------------------------------------------------------------------
@@ -568,11 +887,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Summarize server trace files (per-model/per-stage "
                     "latency breakdown), join client trace files on "
                     "triton-request-id, export Chrome trace-event JSON.")
-    parser.add_argument("server", help="server trace file (JSON Lines, "
-                        "written via trace_level=TIMESTAMPS)")
-    parser.add_argument("--client", default=None, metavar="PATH",
-                        help="client trace file (telemetry().enable_tracing) "
-                             "joined on triton-request-id")
+    parser.add_argument("server", nargs="+",
+                        help="server trace file(s): literal paths, globs "
+                        "('trace.json*' collects a rotated set), or "
+                        "directories (every file inside); overlapping "
+                        "specs are deduplicated by realpath")
+    parser.add_argument("--client", action="append", default=None,
+                        metavar="PATH",
+                        help="client trace file(s) "
+                        "(telemetry().enable_tracing); repeatable, each "
+                        "a path/glob/directory — joined on "
+                        "triton-request-id, and on the traceparent trace "
+                        "id for the journeys report")
     parser.add_argument("--format", default="text",
                         choices=["text", "json", "chrome"],
                         help="text table (default), summary JSON, or Chrome "
@@ -592,14 +918,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
 
     try:
-        server_records = load_trace_file(args.server)
-        client_records = (load_trace_file(args.client)
+        server_records = load_trace_files(args.server)
+        client_records = (load_trace_files(args.client)
                           if args.client else None)
     except (OSError, ValueError) as e:
         return fail(str(e))
     if not server_records:
-        return fail(f"{args.server}: empty trace file (no records — was "
-                    "trace_level=TIMESTAMPS set while traffic ran?)")
+        return fail(f"{' '.join(args.server)}: empty trace file(s) — no "
+                    "trace records (was trace_level=TIMESTAMPS set while "
+                    "traffic ran?)")
 
     if args.format == "chrome":
         out = json.dumps(chrome_trace(server_records, client_records),
