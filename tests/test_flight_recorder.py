@@ -543,6 +543,7 @@ class TestTritonTop:
                 "threshold_ms", "duty_pct", "mfu_pct", "burn_5m",
                 "burn_1h", "slo_breach", "instances", "version",
                 "scaled", "mem_pct", "mem_shed_per_s",
+                "host_lag_ms", "gc_ms_per_s",
                 "last_outlier"} == set(row)
         # fleet columns materialize from the nv_fleet_* series: the
         # harness server exports a serving version for every model

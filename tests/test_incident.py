@@ -1,0 +1,481 @@
+"""Incident capture (server/incident.py + tools/incident_report.py).
+
+Layers under test:
+
+* unit — crash-reason decoding, supervisor-state reason round trip,
+  trigger-class validation,
+* bundle shape — a sync manual trigger writes the full pinned file set
+  atomically with a schema-versioned manifest,
+* policy — per-class rate limiting and keep-last-N retention under a
+  flapping trigger,
+* detectors — sustained-SLO-breach and watchdog-storm escalation, the
+  fleet-state crash watcher (baseline-first, reason-stamped),
+* acceptance — the ISSUE 18 drills: a seeded ``mem_pressure`` draw and a
+  seeded ``worker_kill`` fleet drill each auto-produce a bundle (thread
+  stacks, pinned flights, governor/device snapshots) that
+  ``incident_report`` renders end-to-end with a trigger timeline.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.models import zoo
+from triton_client_tpu.server import InferenceCore, InferRequest, ModelRegistry
+from triton_client_tpu.server.chaos import ChaosInjector
+from triton_client_tpu.server.fleet import (FLEET_STATE_ENV, SupervisorState,
+                                            crash_reason_from_exit,
+                                            worker_crash_reasons)
+from triton_client_tpu.server.incident import (MANIFEST_SCHEMA,
+                                               TRIGGER_CLASSES,
+                                               IncidentRecorder)
+from triton_client_tpu.server.testing import ClusterHarness, ReplicaSupervisor
+from triton_client_tpu.server.types import InputTensor
+from triton_client_tpu.tools import incident_report
+
+#: every file a healthy bundle must contain (shape-pinned: a renamed or
+#: dropped capture is an API break for postmortem tooling)
+BUNDLE_FILES = {
+    "manifest.json", "profile.folded", "threads.txt", "profiler.json",
+    "flight_recorder.json", "device_stats.json", "costs.json",
+    "memory.json", "metrics.txt", "trace_tail.jsonl", "config.json",
+    "incident.json",
+}
+
+
+def _core():
+    registry = ModelRegistry()
+    registry.register_model(zoo.make_custom_identity_int32())
+    return InferenceCore(registry)
+
+
+def _recorder(core, tmp_path, **kw):
+    kw.setdefault("profile_window_s", 0.05)
+    kw.setdefault("profile_hz", 50.0)
+    rec = IncidentRecorder(core, dir=str(tmp_path / "incidents"), **kw)
+    os.makedirs(rec.dir, exist_ok=True)
+    core.incidents = rec
+    core.flight_recorder.incidents = rec
+    return rec
+
+
+def _req(model, n=4):
+    return InferRequest(
+        model_name=model,
+        inputs=[InputTensor("INPUT0", "INT32", (1, n),
+                            data=np.ones((1, n), np.int32))])
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.02)
+
+
+def _manifest(bundle):
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        return json.load(f)
+
+
+# -- unit: crash-reason decoding ---------------------------------------------
+
+class TestCrashReason:
+    def test_decoding(self):
+        import signal
+
+        assert crash_reason_from_exit(None) == "unknown"
+        assert crash_reason_from_exit(-signal.SIGKILL) == "signal:SIGKILL"
+        assert crash_reason_from_exit(-signal.SIGSEGV) == "signal:SIGSEGV"
+        assert crash_reason_from_exit(70) == "chaos:worker_kill"
+        assert crash_reason_from_exit(3) == "exit:3"
+        assert crash_reason_from_exit(0) == "exit:0"
+
+    def test_unknown_signal_number_degrades(self):
+        assert crash_reason_from_exit(-250) == "signal:250"
+
+    def test_state_file_reason_round_trip(self, tmp_path):
+        path = str(tmp_path / "fleet-state.json")
+        state = SupervisorState(path)
+        state.record_restart("0", reason="signal:SIGKILL")
+        state.record_restart("1")
+        assert worker_crash_reasons(path) == {"0": "signal:SIGKILL"}
+        # the latest reason wins per worker
+        time.sleep(0.01)
+        state.record_restart("0", reason="chaos:worker_kill")
+        assert worker_crash_reasons(path)["0"] == "chaos:worker_kill"
+
+
+# -- bundle shape ------------------------------------------------------------
+
+class TestBundleShape:
+    def test_unknown_trigger_class_rejected(self, tmp_path):
+        rec = _recorder(_core(), tmp_path)
+        with pytest.raises(ValueError, match="unknown incident trigger"):
+            rec.trigger("reboot")
+
+    def test_manual_sync_bundle_is_complete_and_pinned(self, tmp_path):
+        core = _core()
+        rec = _recorder(core, tmp_path)
+        # the inline capture excludes the capturing thread itself, so a
+        # bare single-threaded process needs one parked worker to sample
+        gate = threading.Event()
+        worker = threading.Thread(target=gate.wait, args=(30,),
+                                  name="bundle-decode-worker", daemon=True)
+        worker.start()
+        try:
+            bundle = rec.trigger("manual", reason="unit test", sync=True)
+        finally:
+            gate.set()
+            worker.join(timeout=5)
+        assert bundle is not None and os.path.isdir(bundle)
+        # no half-written temp dirs survive the atomic publish
+        assert not [e for e in os.listdir(rec.dir) if e.startswith(".tmp")]
+        assert set(os.listdir(bundle)) == BUNDLE_FILES
+        m = _manifest(bundle)
+        assert m["schema"] == MANIFEST_SCHEMA
+        assert m["trigger"] == "manual" and m["reason"] == "unit test"
+        assert m["pid"] == os.getpid()
+        assert m["capture"] == {"profile_hz": 50.0,
+                                "profile_window_s": 0.05}
+        names = {f["name"] for f in m["files"]}
+        assert names == BUNDLE_FILES - {"manifest.json"}
+        errors = [f for f in m["files"] if "error" in f]
+        assert errors == []
+        # key captures have the right grammar
+        with open(os.path.join(bundle, "threads.txt")) as f:
+            assert "MainThread" in f.read()
+        folded = open(os.path.join(bundle, "profile.folded")).read()
+        assert incident_report.parse_folded(folded)
+        with open(os.path.join(bundle, "metrics.txt")) as f:
+            assert "# HELP nv_host_profile_samples_total" in f.read()
+        with open(os.path.join(bundle, "config.json")) as f:
+            fp = json.load(f)
+        assert fp["models"] == ["custom_identity_int32"]
+
+    def test_snapshot_faults_are_isolated(self, tmp_path):
+        core = _core()
+        rec = _recorder(core, tmp_path)
+        core.device_stats.snapshot = lambda: (_ for _ in ()).throw(
+            RuntimeError("distressed"))
+        bundle = rec.trigger("manual", sync=True)
+        m = _manifest(bundle)
+        by_name = {f["name"]: f for f in m["files"]}
+        assert by_name["device_stats.json"]["error"] == "distressed"
+        # every other capture still landed
+        assert "error" not in by_name["threads.txt"]
+        assert "error" not in by_name["flight_recorder.json"]
+
+    def test_trigger_context_lands_in_manifest(self, tmp_path):
+        rec = _recorder(_core(), tmp_path)
+        bundle = rec.trigger("manual", context={"via": "test"}, sync=True)
+        assert _manifest(bundle)["context"] == {"via": "test"}
+
+
+# -- policy: rate limit + retention ------------------------------------------
+
+class TestPolicy:
+    def test_rate_limit_is_per_trigger_class(self, tmp_path):
+        rec = _recorder(_core(), tmp_path, min_interval_s=60.0)
+        assert rec.trigger("manual", sync=True) is not None
+        # same class inside the interval: suppressed, counted
+        assert rec.trigger("manual", sync=True) is None
+        # a DIFFERENT class is not held hostage by manual's interval
+        assert rec.trigger("sigusr2", sync=True) is not None
+        rows = rec.metric_rows()["incidents"]
+        by_key = {(l["trigger"], l["outcome"]): v for l, v in rows}
+        assert by_key[("manual", "written")] == 1.0
+        assert by_key[("manual", "suppressed")] == 1.0
+        assert by_key[("sigusr2", "written")] == 1.0
+        assert rec.snapshot()["suppressed"] == {"manual": 1}
+
+    def test_flapping_trigger_holds_directory_to_keep(self, tmp_path):
+        rec = _recorder(_core(), tmp_path, keep=3, min_interval_s=0.0)
+        written = [rec.trigger("manual", reason=f"flap {i}", sync=True)
+                   for i in range(6)]
+        assert all(written)
+        bundles = rec.list_bundles()
+        assert len(bundles) == 3
+        # the survivors are the NEWEST three (names carry the sequence)
+        assert [b.rsplit("-", 2)[1] for b in bundles] == \
+            ["0004", "0005", "0006"]
+        # history still remembers all six
+        assert rec.snapshot()["written"] == {"manual": 6}
+
+
+# -- detectors ---------------------------------------------------------------
+
+class TestDetectors:
+    def test_sustained_breach_escalates_to_slo_burn(self, tmp_path):
+        rec = _recorder(_core(), tmp_path, breach_sustain=3,
+                        breach_window_s=300.0, min_interval_s=0.0)
+        rec.note_breach("m")
+        rec.note_breach("m")
+        assert rec.list_bundles() == []  # two pins are noise
+        rec.note_breach("m")
+        rec.stop()  # joins the writer thread
+        bundles = rec.list_bundles()
+        assert len(bundles) == 1 and bundles[0].endswith("-slo_burn")
+        m = _manifest(os.path.join(rec.dir, bundles[0]))
+        assert "3 SLO pins" in m["reason"] and "model=m" in m["reason"]
+
+    def test_watchdog_storm_escalates(self, tmp_path):
+        rec = _recorder(_core(), tmp_path, storm_captures=3,
+                        storm_window_s=10.0, min_interval_s=0.0)
+        rec.note_capture()
+        rec.note_capture()
+        assert rec.list_bundles() == []
+        rec.note_capture()
+        rec.stop()
+        bundles = rec.list_bundles()
+        assert len(bundles) == 1 and bundles[0].endswith("-watchdog_storm")
+
+    def test_core_wires_flight_recorder_escalation(self):
+        core = _core()
+        assert core.flight_recorder.incidents is core.incidents
+
+    def test_fleet_watcher_baselines_then_triggers(self, tmp_path,
+                                                   monkeypatch):
+        state = SupervisorState(str(tmp_path / "fleet-state.json"))
+        # restarts that PREDATE the watcher are not our incident
+        state.record_restart("0", reason="signal:SIGTERM")
+        monkeypatch.setenv(FLEET_STATE_ENV, state.path)
+        rec = _recorder(_core(), tmp_path, min_interval_s=0.0)
+        rec.start()
+        try:
+            _wait(lambda: rec._seen_restarts is not None,
+                  msg="watcher baseline")
+            assert rec.list_bundles() == []
+            time.sleep(0.01)  # distinct mtime for the cache
+            state.record_restart("1", reason="signal:SIGKILL")
+            _wait(lambda: any(b.endswith("-worker_crash")
+                              for b in rec.list_bundles()),
+                  msg="worker_crash bundle")
+        finally:
+            rec.stop()
+        bundle = [b for b in rec.list_bundles()
+                  if b.endswith("-worker_crash")][0]
+        m = _manifest(os.path.join(rec.dir, bundle))
+        assert m["reason"] == "worker 1: signal:SIGKILL"
+
+    def test_watcher_not_started_without_state_env(self, monkeypatch,
+                                                   tmp_path):
+        monkeypatch.delenv(FLEET_STATE_ENV, raising=False)
+        rec = _recorder(_core(), tmp_path)
+        rec.start()
+        assert rec._watch_thread is None
+        rec.stop()
+
+
+# -- acceptance: chaos drills ------------------------------------------------
+
+class TestChaosDrills:
+    def test_mem_pressure_draw_bundles_the_governor(self, tmp_path):
+        core = _core()
+        rec = _recorder(core, tmp_path, min_interval_s=0.0)
+        core.memory.budget_bytes = 1 << 20
+        core.chaos = ChaosInjector(rate=1.0, kinds=["mem_pressure"],
+                                   seed=7, max_faults=1, pressure_s=0.3,
+                                   pressure_factor=0.25)
+
+        async def main():
+            # the drawing request proceeds (budget squeeze, not failure)
+            resp = await core.infer(_req("custom_identity_int32"))
+            assert resp.outputs[0].data is not None
+
+        asyncio.run(main())
+        rec.stop()  # joins the async bundle writer
+        bundles = rec.list_bundles()
+        assert len(bundles) == 1 and bundles[0].endswith("-chaos")
+        bundle = os.path.join(rec.dir, bundles[0])
+        m = _manifest(bundle)
+        assert "mem_pressure on custom_identity_int32" in m["reason"]
+        assert "factor=0.25" in m["reason"]
+        # the governor snapshot caught the pressure window
+        with open(os.path.join(bundle, "memory.json")) as f:
+            mem = json.load(f)
+        assert mem["pressure_events"] >= 1
+        # end-to-end render
+        report = incident_report.render_report(bundle)
+        assert "INCIDENT POSTMORTEM" in report
+        assert "mem_pressure" in report
+        assert "Trigger timeline" in report and "THIS BUNDLE" in report
+        assert "Memory governor" in report
+
+    def test_worker_kill_fleet_drill_end_to_end(self, tmp_path,
+                                                monkeypatch):
+        """The ISSUE 18 drill: a seeded ``worker_kill`` draw on replica 1
+        (a) bundles the dying replica's state under trigger ``chaos``,
+        (b) restarts the replica with reason ``chaos:worker_kill`` in the
+        fleet state, and (c) fires the survivor's fleet watcher, whose
+        ``worker_crash`` bundle renders end-to-end."""
+        state_path = str(tmp_path / "fleet-state.json")
+        # env must be set BEFORE the harnesses start: the watcher thread
+        # is armed during warmup only when the state path is visible
+        monkeypatch.setenv(FLEET_STATE_ENV, state_path)
+        incident_root = tmp_path / "incidents"
+
+        def factory():
+            registry = ModelRegistry()
+            registry.register_model(zoo.make_custom_identity_int32())
+            return registry
+
+        def core_setup(h):
+            inc = h.core.incidents
+            inc.dir = str(incident_root / h.replica)
+            os.makedirs(inc.dir, exist_ok=True)
+            inc.profile_window_s = 0.05
+            inc.min_interval_s = 0.0
+
+        with ClusterHarness(factory, n=2, core_setup=core_setup) as ch:
+            sup = ReplicaSupervisor(ch, state_path=state_path)
+            survivor = ch.harnesses[0].core
+            inj = ChaosInjector(rate=1.0, kinds=["worker_kill"], seed=1,
+                                max_faults=1)
+            inj.worker_kill_cb = lambda: sup.crash(1)
+            ch.chaos(1, inj)
+            victim = ch.harnesses[1]
+            fut = asyncio.run_coroutine_threadsafe(
+                victim.core.infer(_req("custom_identity_int32")),
+                victim._loop)
+            with pytest.raises(Exception):
+                fut.result(timeout=15)
+            sup.join(timeout=30)
+            # (b) the restart landed with its decoded reason
+            assert worker_crash_reasons(state_path) == \
+                {"1": "chaos:worker_kill"}
+            # (c) the survivor's watcher escalates within a poll or two
+            survivor_dir = str(incident_root / "replica-0")
+            _wait(lambda: any(
+                b.endswith("-worker_crash")
+                for b in os.listdir(survivor_dir)),
+                timeout=15, msg="survivor worker_crash bundle")
+            survivor.incidents.stop()
+
+        # (a) the dying replica bundled its own state before the kill
+        victim_dir = str(incident_root / "replica-1")
+        chaos_bundles = [b for b in os.listdir(victim_dir)
+                         if b.endswith("-chaos")]
+        assert len(chaos_bundles) == 1
+        victim_bundle = os.path.join(victim_dir, chaos_bundles[0])
+        assert _manifest(victim_bundle)["reason"] == \
+            "worker_kill on custom_identity_int32"
+        # acceptance: thread stacks, pinned flights, governor/device
+        # snapshots are all in the bundle
+        present = set(os.listdir(victim_bundle))
+        assert {"threads.txt", "flight_recorder.json",
+                "device_stats.json", "memory.json"} <= present
+
+        crash_bundle = [
+            b for b in os.listdir(str(incident_root / "replica-0"))
+            if b.endswith("-worker_crash")][0]
+        crash_path = os.path.join(str(incident_root / "replica-0"),
+                                  crash_bundle)
+        m = _manifest(crash_path)
+        assert m["trigger"] == "worker_crash"
+        assert "worker 1: chaos:worker_kill" in m["reason"]
+        assert m["replica"] == "replica-0"
+        # the postmortem renders end-to-end, timeline included
+        report = incident_report.render_report(crash_path)
+        assert "worker_crash" in report
+        assert "chaos:worker_kill" in report
+        assert "Trigger timeline" in report
+        assert "Host profile" in report
+
+
+# -- HTTP debug surface ------------------------------------------------------
+
+class TestDebugEndpoints:
+    def test_profile_and_incident_endpoints(self, tmp_path):
+        import requests
+
+        from triton_client_tpu.server.testing import ServerHarness
+
+        registry = ModelRegistry()
+        registry.register_model(zoo.make_custom_identity_int32())
+        with ServerHarness(registry) as h:
+            inc = h.core.incidents
+            inc.dir = str(tmp_path / "inc")
+            os.makedirs(inc.dir, exist_ok=True)
+            inc.profile_window_s = 0.05
+            inc.min_interval_s = 0.0
+            h.core.profiler._sample_once()  # deterministic folded stacks
+            base = f"http://{h.http_url}"
+
+            r = requests.get(f"{base}/v2/debug/profile", timeout=10)
+            assert r.status_code == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            assert incident_report.parse_folded(r.text)
+            # role filter narrows the folded stacks
+            r = requests.get(f"{base}/v2/debug/profile?role=frontend",
+                             timeout=10)
+            assert all(line.startswith("frontend;")
+                       for line in r.text.strip().splitlines())
+            js = requests.get(f"{base}/v2/debug/profile?format=json",
+                              timeout=10).json()
+            assert {"hz", "enabled", "top_stacks", "loop_lag",
+                    "gc"} <= set(js)
+
+            st = requests.get(f"{base}/v2/debug/incident", timeout=10)
+            assert st.status_code == 200
+            assert st.json()["bundles"] == []
+
+            r = requests.post(f"{base}/v2/debug/incident",
+                              json={"reason": "operator poke"}, timeout=30)
+            assert r.status_code == 200
+            body = r.json()
+            assert body["status"] == "written"
+            assert os.path.isdir(body["bundle"])
+            assert _manifest(body["bundle"])["reason"] == "operator poke"
+
+            # inside the cool-down the manual class rate-limits with 202
+            inc.min_interval_s = 3600.0
+            r = requests.post(f"{base}/v2/debug/incident", timeout=30)
+            assert r.status_code == 202
+            assert r.json() == {"status": "rate_limited", "bundle": None}
+
+
+# -- report tool -------------------------------------------------------------
+
+class TestReportTool:
+    def test_main_latest_and_output_file(self, tmp_path, capsys):
+        rec = _recorder(_core(), tmp_path, min_interval_s=0.0)
+        rec.trigger("manual", reason="first", sync=True)
+        rec.trigger("manual", reason="second", sync=True)
+        out = str(tmp_path / "report.txt")
+        assert incident_report.main(
+            ["--latest", rec.dir, "-o", out]) == 0
+        text = open(out).read()
+        assert "second" in text  # --latest picked the newest bundle
+        # stdout path prints the report
+        bundle = os.path.join(rec.dir, rec.list_bundles()[0])
+        assert incident_report.main([bundle]) == 0
+        assert "INCIDENT POSTMORTEM" in capsys.readouterr().out
+
+    def test_main_rejects_non_bundle(self, tmp_path, capsys):
+        assert incident_report.main([str(tmp_path)]) == 1
+        assert "manifest.json" in capsys.readouterr().err
+
+    def test_main_latest_empty_dir(self, tmp_path, capsys):
+        assert incident_report.main(["--latest", str(tmp_path)]) == 1
+        assert "no bundles" in capsys.readouterr().err
+
+    def test_parse_folded_grammar(self):
+        text = ("frontend;a.py:f;b.py:g 7\n"
+                "decode;c.py:h 12\n"
+                "garbage line without count\n")
+        parsed = incident_report.parse_folded(text)
+        assert parsed == [("decode", "c.py:h", 12),
+                          ("frontend", "a.py:f;b.py:g", 7)]
+
+    def test_trigger_classes_exported(self):
+        # the HTTP handler and CLI validate against this tuple; pin it
+        assert TRIGGER_CLASSES == ("slo_burn", "worker_crash",
+                                   "watchdog_storm", "chaos", "sigusr2",
+                                   "manual")

@@ -1046,6 +1046,24 @@ class TestMetricsDecl:
         write(tmp_path, "consumer.py", f"NAME = \"{fam_a}\"\n")
         assert lint_dir(tmp_path, "METRICS-DECL") == []
 
+    def test_new_subsystem_files_are_in_reference_scope(self, tmp_path):
+        """The host-observability files (profiler/incident/top glue) are
+        ordinary reference scope: an nv_host_* family they mention must
+        be declared in the registry, and a typo'd one is flagged."""
+        fam = "nv_" + "host_loop_lag_us"
+        typo = "nv_" + "host_loop_lagg_us"
+        write(tmp_path, "metrics.py", f"""
+            def collect_families(core):
+                return [("{fam}", "h", "gauge", [])]
+            """)
+        write(tmp_path, "profiler.py", f"GOOD = \"{fam}\"\n")
+        assert lint_dir(tmp_path, "METRICS-DECL") == []
+        write(tmp_path, "incident.py", f"BAD = \"{typo}\"\n")
+        found = lint_dir(tmp_path, "METRICS-DECL")
+        assert len(found) == 1
+        assert typo in found[0].message
+        assert found[0].path.endswith("incident.py")
+
     def test_docstring_mentions_do_not_declare(self, tmp_path):
         fam = "nv_" + "real_family"
         ghost = "nv_" + "doc_only_family"

@@ -635,6 +635,104 @@ class TestClientSurface:
             assert families[fam]["samples"], f"{fam}: escaped series dropped"
 
 
+class TestHostSurface:
+    """The nv_host_* families (server/profiler.py + server/incident.py)
+    parse under the exposition grammar, are typed, carry their label
+    sets, survive adversarial label values, and round-trip through the
+    JSON snapshot."""
+
+    EVIL_LOOP = 'evil"loop\\with\nnewline'
+
+    def _drive_host(self, server, tmp_path):
+        import gc
+        import os
+
+        import time
+
+        core = server.core
+        # a deterministic profiler sample + a forced GC pass give the
+        # samples/gc_pause families rows without waiting on the sampler.
+        # The collect retries: a manual collect silently no-ops (no
+        # callbacks) when another thread's collection is in flight —
+        # possible in a full-suite run with leaked daemon threads
+        core.profiler._sample_once()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            gc.collect()
+            gens = {labels["generation"]: value for labels, value in
+                    core.profiler.metric_rows()["gc_pause"]}
+            if gens.get("2", 0.0) > 0.0:
+                break
+            time.sleep(0.01)
+        # a second probe with an adversarial loop name exercises label
+        # escaping on the loop_lag family (the real probe name is
+        # host:port, installed by start_frontends at harness start)
+        core.profiler.install_loop_probe(server._loop, name=self.EVIL_LOOP,
+                                         interval_s=0.02)
+        inc = core.incidents
+        inc.dir = str(tmp_path / "bundles")
+        os.makedirs(inc.dir, exist_ok=True)
+        inc.profile_window_s = 0.05
+        inc.min_interval_s = 0.0
+        inc.trigger("manual", reason="conformance", sync=True)
+        # suppressed outcome row: rate-limit the second manual trigger
+        inc.min_interval_s = 60.0
+        assert inc.trigger("manual", sync=True) is None
+        # wait for at least one lag probe firing on each loop
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            lags = core.profiler.loop_lag()
+            if self.EVIL_LOOP in lags and len(lags) >= 2:
+                break
+            time.sleep(0.02)
+
+    def test_families_typed_labeled_and_round_trip(self, server, tmp_path):
+        from triton_client_tpu.server.metrics import snapshot
+
+        self._drive_host(server, tmp_path)
+        families = assert_conformant(_scrape(server.http_url))
+        for fam, kind in (("nv_host_loop_lag_us", "gauge"),
+                          ("nv_host_gc_pause_us_total", "counter"),
+                          ("nv_host_profile_samples_total", "counter"),
+                          ("nv_host_incident_total", "counter")):
+            assert families[fam]["type"] == kind, fam
+
+        def unescape(v):
+            return (v.replace("\\n", "\n").replace('\\"', '"')
+                    .replace("\\\\", "\\"))
+
+        # loop_lag: one series per probed loop, evil name escaped
+        loops = {unescape(l["loop"]) for _, l, _ in
+                 families["nv_host_loop_lag_us"]["samples"]}
+        assert self.EVIL_LOOP in loops
+        assert len(loops) >= 2  # the frontend probe rides along
+        # samples: role-labeled counters from the deterministic sample
+        roles = {l["role"]: v for _, l, v in
+                 families["nv_host_profile_samples_total"]["samples"]}
+        assert roles and all(set(l) == {"role"} for _, l, _ in
+                             families["nv_host_profile_samples_total"]
+                             ["samples"])
+        assert "frontend" in roles  # the harness MainThread/server loop
+        # gc_pause: generation-labeled, gen 2 collected explicitly
+        gens = {l["generation"]: v for _, l, v in
+                families["nv_host_gc_pause_us_total"]["samples"]}
+        assert gens.get("2", 0.0) > 0.0
+        # incidents: trigger+outcome labels with both outcomes present
+        outcomes = {(l["trigger"], l["outcome"]): v for _, l, v in
+                    families["nv_host_incident_total"]["samples"]}
+        assert outcomes[("manual", "written")] >= 1.0
+        assert outcomes[("manual", "suppressed")] >= 1.0
+        # JSON snapshot parity: same families, same types, same values
+        snap = snapshot(server.core)
+        for fam in ("nv_host_loop_lag_us", "nv_host_gc_pause_us_total",
+                    "nv_host_profile_samples_total",
+                    "nv_host_incident_total"):
+            assert snap[fam]["type"] == families[fam]["type"], fam
+        snap_inc = {(s["labels"]["trigger"], s["labels"]["outcome"])
+                    for s in snap["nv_host_incident_total"]["samples"]}
+        assert ("manual", "written") in snap_inc
+
+
 class TestOtlpMetricsSurface:
     """nv_otlp_* (server) and nv_client_otlp_* (client) export counters:
     present and typed only while an exporter is wired, absent — not zero —

@@ -119,6 +119,26 @@ def main() -> None:
                         "BOTH the 5m and 1h burn rates exceed this "
                         "(default 14.4, the canonical fast-burn page "
                         "threshold)")
+    parser.add_argument("--profile-hz", type=float, default=None,
+                        metavar="HZ",
+                        help="always-on host sampling profiler rate "
+                        "(folded stacks per thread role, nv_host_* "
+                        "metrics, /v2/debug/profile).  Default from "
+                        "TRITON_TPU_PROFILE_HZ, else 19; 0 disables the "
+                        "sampler (the loop-lag probe and GC accounting "
+                        "stay on — they are effectively free)")
+    parser.add_argument("--incident-dir", default=None, metavar="DIR",
+                        help="directory for automatic incident bundles "
+                        "(postmortems on SLO burn, worker crash, watchdog "
+                        "storm, chaos draws, SIGUSR2, or POST "
+                        "/v2/debug/incident) and the faulthandler dump "
+                        "file.  Default from TRITON_TPU_INCIDENT_DIR, "
+                        "else <tmpdir>/tc-tpu-incidents")
+    parser.add_argument("--incident-keep", type=int, default=8, metavar="N",
+                        help="keep-last-N incident bundle retention: the "
+                        "oldest bundles beyond N are pruned after each "
+                        "write, so a flapping trigger cannot fill the "
+                        "disk (default 8)")
     parser.add_argument("--no-device-stats", action="store_true",
                         help="disable the device/scheduler stats "
                         "collector (nv_tpu_* metrics, batcher tick "
@@ -381,6 +401,33 @@ def main() -> None:
 
     if args.no_device_stats:
         core.device_stats.enabled = False
+    # host self-observation: the CLI flag wins over the env default the
+    # profiler was constructed with; the incident dir also hosts the
+    # faulthandler dump (enabled below) so every postmortem artifact of
+    # one process lands in one place
+    if args.profile_hz is not None:
+        if args.profile_hz < 0:
+            parser.error("--profile-hz must be >= 0 (0 = sampler off)")
+        core.profiler.hz = args.profile_hz
+    if args.incident_keep < 1:
+        parser.error("--incident-keep must be >= 1")
+    core.incidents.keep = args.incident_keep
+    if args.incident_dir:
+        core.incidents.dir = args.incident_dir
+    os.makedirs(core.incidents.dir, exist_ok=True)
+    # faulthandler on by default: a hard hang or fatal signal dumps every
+    # thread's stack into the incident dir instead of dying silently.
+    # The file object must outlive the process (faulthandler keeps only
+    # the fd) — parked on the core.
+    import faulthandler
+
+    core._faulthandler_file = open(
+        os.path.join(core.incidents.dir,
+                     f"faulthandler-{os.getpid()}.log"), "w")
+    faulthandler.enable(file=core._faulthandler_file)
+    print(f"incident capture: dir={core.incidents.dir} "
+          f"keep={core.incidents.keep} profiler_hz={core.profiler.hz:g} "
+          "(SIGUSR2 triggers a manual bundle)")
     if args.slo_burn_threshold is not None:
         if args.slo_burn_threshold <= 0:
             parser.error("--slo-burn-threshold must be positive")
@@ -454,6 +501,16 @@ def main() -> None:
                 loop.add_signal_handler(sig, stop.set)
             except NotImplementedError:  # non-unix event loops
                 pass
+        # SIGUSR2 = "bundle the process, keep serving": the operator's
+        # live postmortem trigger (the bundle writes on its own thread)
+        if hasattr(signal, "SIGUSR2"):
+            try:
+                loop.add_signal_handler(
+                    signal.SIGUSR2,
+                    lambda: core.incidents.trigger(
+                        "sigusr2", reason="operator SIGUSR2"))
+            except NotImplementedError:  # non-unix event loops
+                pass
         await stop.wait()
         # graceful drain BEFORE the listeners close: new requests get a
         # proper 503 + Retry-After (and readiness flips false so a load
@@ -503,7 +560,8 @@ def _run_supervisor(parser, args) -> None:
     import tempfile
     import time
 
-    from .fleet import FLEET_STATE_ENV, RestartPolicy, SupervisorState
+    from .fleet import (FLEET_STATE_ENV, RestartPolicy, SupervisorState,
+                        crash_reason_from_exit)
 
     if not hasattr(socket, "SO_REUSEPORT"):
         parser.error("--frontends > 1 requires SO_REUSEPORT (Linux)")
@@ -545,6 +603,7 @@ def _run_supervisor(parser, args) -> None:
                                   window_s=args.worker_restart_window)
                     for _ in procs]
         restart_at = [None] * len(procs)  # pending respawn deadlines
+        crash_reason = [None] * len(procs)  # why the pending respawn
         print(f"frontend supervisor: {args.frontends} workers sharing "
               f"http={args.host}:{args.http_port} "
               f"grpc={args.host}:{args.grpc_port} (SO_REUSEPORT, "
@@ -588,6 +647,12 @@ def _run_supervisor(parser, args) -> None:
                     # stopping is unexpected — the server runs forever)
                     code = p.returncode or 0
                     procs[i] = None
+                    # decode WHY before the returncode is lost: signal
+                    # name, the chaos worker_kill exit-70 convention, or
+                    # the plain exit code — stamped into the fleet state
+                    # so the workers' worker-crash incident bundles can
+                    # say what killed their sibling
+                    crash_reason[i] = crash_reason_from_exit(p.returncode)
                     delay = policies[i].on_crash(now)
                     if delay is None:
                         print(f"frontend worker {i}: "
@@ -599,9 +664,9 @@ def _run_supervisor(parser, args) -> None:
                         fail_fast()
                         restart_at = [None] * len(procs)
                         break
-                    print(f"frontend worker {i} exited rc={code}; "
-                          f"restarting in {delay:g}s (SO_REUSEPORT rebind "
-                          "+ shm manifest re-issued)",
+                    print(f"frontend worker {i} exited rc={code} "
+                          f"({crash_reason[i]}); restarting in {delay:g}s "
+                          "(SO_REUSEPORT rebind + shm manifest re-issued)",
                           file=sys.stderr, flush=True)
                     restart_at[i] = now + delay
                 for i, due in enumerate(restart_at):
@@ -609,7 +674,8 @@ def _run_supervisor(parser, args) -> None:
                             and not state["stopping"]:
                         restart_at[i] = None
                         procs[i] = spawn(i)
-                        fleet_state.record_restart(str(i))
+                        fleet_state.record_restart(
+                            str(i), reason=crash_reason[i])
             alive = any(p is not None and p.poll() is None for p in procs)
             pending = any(due is not None for due in restart_at)
             if state["stopping"] and not alive:

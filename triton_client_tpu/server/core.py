@@ -644,6 +644,21 @@ class InferenceCore:
         # --mem-budget-bytes, plus the HBM-headroom gate for generation
         # slot admission.  Unconfigured (budget 0) it only tracks.
         self.memory = MemoryGovernor()
+        # always-on host self-observation (server/profiler.py): stack
+        # sampler + event-loop lag probes + GC pause accounting — the
+        # nv_host_* families and /v2/debug/profile.  Constructed inert;
+        # warmup_models() starts the sampler thread.
+        from .profiler import HostProfiler
+
+        self.profiler = HostProfiler()
+        # automatic postmortems (server/incident.py): trigger-driven
+        # bundle directories (profile window + thread dump + every
+        # subsystem snapshot).  The flight recorder feeds its SLO pins
+        # and capture storms in; chaos and the fleet watcher feed theirs.
+        from .incident import IncidentRecorder
+
+        self.incidents = IncidentRecorder(self)
+        self.flight_recorder.incidents = self.incidents
         # optional fault injector (server/chaos.py; --chaos CLI flags)
         self.chaos = None
         # closed-loop fleet controller (server/fleet.py): per-model
@@ -888,6 +903,12 @@ class InferenceCore:
             # it shed tier-aware until the pressure lifts on its own
             self.memory.inject_pressure(
                 fault.pressure_factor, fault.latency_s)
+            # a pressure window is exactly the moment shedding decisions
+            # get interesting: bundle the governor's state for postmortem
+            self.incidents.trigger(
+                "chaos", reason=f"mem_pressure on {model.name} "
+                f"(factor={fault.pressure_factor}, "
+                f"window={fault.latency_s}s)")
             return
         if fault.kind == "abort":
             from .chaos import ChaosAbort
@@ -902,6 +923,13 @@ class InferenceCore:
             # worker actually produces on the wire.
             from .chaos import ChaosAbort
 
+            # bundle BEFORE the callback: a CLI worker's cb is
+            # os._exit(70), and a bundle thread racing process death
+            # loses — the capture must at least begin with the process
+            # state that is about to die (the supervisor-side
+            # worker_crash trigger covers the post-restart view)
+            self.incidents.trigger(
+                "chaos", reason=f"worker_kill on {model.name}")
             cb = self.chaos.worker_kill_cb
             if cb is not None:
                 cb()
@@ -1433,6 +1461,10 @@ class InferenceCore:
         # hitting /v2/health/ready during startup must not route traffic
         # at a server still paying XLA compilation
         self.startup_complete = True
+        # host self-observation starts with serving, not construction:
+        # unit tests building a bare core get no background threads
+        self.profiler.start()
+        self.incidents.start()
         return ran
 
     async def load_model(self, name: str, config_override=None,
@@ -1551,9 +1583,18 @@ class InferenceCore:
             await asyncio.sleep(0.02)
         self.tracer.shutdown()
         self.log.shutdown()
+        # stop host observers off-loop: profiler.stop() joins its sampler
+        # thread and incidents.stop() joins any in-flight bundle writer
+        # (which may be mid profile-window) — neither belongs on the loop
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._stop_observers)
         while self._batchers:
             _, b = self._batchers.popitem()
             await self._retire_batcher(b, reason="server is shutting down")
+
+    def _stop_observers(self) -> None:
+        self.profiler.stop()
+        self.incidents.stop()
 
     def _batcher(self, model: Model) -> _DynamicBatcher:
         gen = self.registry.generation(model.name)

@@ -89,7 +89,10 @@ __all__ = [
     "FleetController",
     "RestartPolicy",
     "SupervisorState",
+    "crash_reason_from_exit",
+    "fleet_state_path",
     "parse_autoscale_spec",
+    "worker_crash_reasons",
     "worker_restart_counts",
     "collect_fleet_rows",
 ]
@@ -181,14 +184,24 @@ class SupervisorState:
         self.path = path
         self._lock = threading.Lock()
         self._restarts: Dict[str, int] = {}
+        self._reasons: Dict[str, str] = {}
 
-    def record_restart(self, worker: str) -> int:
+    def record_restart(self, worker: str,
+                       reason: Optional[str] = None) -> int:
+        """Count a restart and (optionally) stamp WHY the worker died —
+        ``crash_reasons`` carries the last reason per worker so the
+        worker-crash incident trigger can say "signal:SIGKILL" or
+        "chaos:worker_kill" instead of just "it restarted"."""
         with self._lock:
             self._restarts[worker] = self._restarts.get(worker, 0) + 1
+            if reason:
+                self._reasons[worker] = reason
             snapshot = dict(self._restarts)
+            reasons = dict(self._reasons)
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump({"worker_restarts": snapshot}, f)
+            json.dump({"worker_restarts": snapshot,
+                       "crash_reasons": reasons}, f)
         os.replace(tmp, self.path)
         return snapshot[worker]
 
@@ -196,11 +209,50 @@ class SupervisorState:
         with self._lock:
             return dict(self._restarts)
 
+    def reasons(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._reasons)
 
-# cache: (path, mtime_ns) -> counts — /metrics scrapes hit this every
-# poll and the file only changes when a worker actually restarted
-_state_cache: Tuple[Optional[Tuple[str, int]], Dict[str, int]] = (None, {})
+
+# cache: (path, mtime_ns) -> (counts, reasons) — /metrics scrapes hit
+# this every poll and the file only changes when a worker restarted
+_state_cache: Tuple[Optional[Tuple[str, int]], Dict[str, int],
+                    Dict[str, str]] = (None, {}, {})
 _state_cache_lock = threading.Lock()
+
+
+def fleet_state_path() -> Optional[str]:
+    """The supervisor state file path, or ``None`` when this process
+    runs unsupervised (``TRITON_TPU_FLEET_STATE`` unset)."""
+    return os.environ.get(FLEET_STATE_ENV) or None
+
+
+def _read_state(path: Optional[str]) -> Tuple[Dict[str, int],
+                                              Dict[str, str]]:
+    global _state_cache
+    path = path if path is not None else os.environ.get(FLEET_STATE_ENV)
+    if not path:
+        return {}, {}
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return {}, {}
+    key = (path, mtime)
+    with _state_cache_lock:
+        if _state_cache[0] == key:
+            return dict(_state_cache[1]), dict(_state_cache[2])
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        counts = {str(k): int(v)
+                  for k, v in (data.get("worker_restarts") or {}).items()}
+        reasons = {str(k): str(v)
+                   for k, v in (data.get("crash_reasons") or {}).items()}
+    except (OSError, ValueError):
+        return {}, {}
+    with _state_cache_lock:
+        _state_cache = (key, counts, reasons)
+    return dict(counts), dict(reasons)
 
 
 def worker_restart_counts(path: Optional[str] = None) -> Dict[str, int]:
@@ -208,28 +260,35 @@ def worker_restart_counts(path: Optional[str] = None) -> Dict[str, int]:
     ``TRITON_TPU_FLEET_STATE`` env var when ``path`` is None).  Empty
     when unset, absent, or unreadable — a worker without a supervisor
     simply has no restart series."""
-    global _state_cache
-    path = path if path is not None else os.environ.get(FLEET_STATE_ENV)
-    if not path:
-        return {}
-    try:
-        mtime = os.stat(path).st_mtime_ns
-    except OSError:
-        return {}
-    key = (path, mtime)
-    with _state_cache_lock:
-        if _state_cache[0] == key:
-            return dict(_state_cache[1])
-    try:
-        with open(path) as f:
-            data = json.load(f)
-        counts = {str(k): int(v)
-                  for k, v in (data.get("worker_restarts") or {}).items()}
-    except (OSError, ValueError):
-        return {}
-    with _state_cache_lock:
-        _state_cache = (key, counts)
-    return dict(counts)
+    return _read_state(path)[0]
+
+
+def worker_crash_reasons(path: Optional[str] = None) -> Dict[str, str]:
+    """Last crash reason per worker from the supervisor state file
+    (same sourcing rules as :func:`worker_restart_counts`); empty for
+    pre-reason state files — the key is simply absent."""
+    return _read_state(path)[1]
+
+
+def crash_reason_from_exit(returncode: Optional[int]) -> str:
+    """Human crash reason from a ``Popen.returncode``.
+
+    Negative codes are deaths-by-signal (named when the platform knows
+    the number); exit code 70 is the chaos ``worker_kill`` convention
+    (``os._exit(70)`` is what ``serve`` arms as ``worker_kill_cb``), so
+    a supervised chaos drill stamps its own kind."""
+    if returncode is None:
+        return "unknown"
+    if returncode < 0:
+        import signal as _signal
+
+        try:
+            return f"signal:{_signal.Signals(-returncode).name}"
+        except ValueError:
+            return f"signal:{-returncode}"
+    if returncode == 70:
+        return "chaos:worker_kill"
+    return f"exit:{returncode}"
 
 
 class FleetController:

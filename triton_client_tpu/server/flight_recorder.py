@@ -218,6 +218,11 @@ class FlightRecorder:
         # pinned with full span trees — the p99 watchdog's retroactive
         # capture, triggered by budget math instead of a quantile
         self.slo_engine = None
+        # incident recorder (server/incident.py), set by the core: SLO
+        # pins feed its sustained-breach detector, captures feed its
+        # watchdog-storm detector — the escalation from "pin this
+        # request" to "bundle the whole process"
+        self.incidents = None
 
     def configure(self, capacity: Optional[int] = None,
                   outlier_capacity: Optional[int] = None,
@@ -373,6 +378,14 @@ class FlightRecorder:
                 self.captured_by_model[record.model] = \
                     self.captured_by_model.get(record.model, 0) + 1
                 self._outliers.append(record)
+        # escalation OUTSIDE the lock: the detectors take the incident
+        # recorder's own lock and may spawn a bundle writer — neither
+        # belongs under the recorder's counter lock
+        if self.incidents is not None:
+            if slo_pin:
+                self.incidents.note_breach(record.model)
+            if record.capture_reason is not None:
+                self.incidents.note_capture()
 
     def _threshold_us(self, hist: LatencyHistogram) -> Optional[float]:
         if self._abs_ms is not None:

@@ -105,6 +105,12 @@ async def start_frontends(
             await metrics_runner.cleanup()
         await runner.cleanup()
         raise
+    # host self-observation: the lag probe measures THIS loop — the one
+    # every request handler, batcher pump, and stream writer schedules
+    # on.  Installed on every frontend bring-up (CLI workers name theirs
+    # by index via core.profiler defaults; harness loops share the name)
+    core.profiler.install_loop_probe(
+        asyncio.get_running_loop(), name=f"{host}:{http_port}")
     return runner, grpc_server, metrics_runner
 
 

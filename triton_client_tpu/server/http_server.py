@@ -141,6 +141,9 @@ def build_app(core: InferenceCore,
     r.add_get("/v2/debug/flight_recorder", _h(core, _flight_recorder))
     r.add_get("/v2/debug/device_stats", _h(core, _device_stats))
     r.add_get("/v2/debug/costs", _h(core, _costs))
+    r.add_get("/v2/debug/profile", _h(core, _profile))
+    r.add_get("/v2/debug/incident", _h(core, _incident_status))
+    r.add_post("/v2/debug/incident", _h(core, _incident_trigger))
     r.add_get("/metrics", _h(core, _metrics))
     for kind in ("systemsharedmemory", "cudasharedmemory"):
         r.add_get(f"/v2/{kind}/status", _h(core, _shm_status))
@@ -191,6 +194,9 @@ def build_metrics_app(core: InferenceCore) -> web.Application:
     app.router.add_get("/v2/debug/flight_recorder", _h(core, _flight_recorder))
     app.router.add_get("/v2/debug/device_stats", _h(core, _device_stats))
     app.router.add_get("/v2/debug/costs", _h(core, _costs))
+    app.router.add_get("/v2/debug/profile", _h(core, _profile))
+    app.router.add_get("/v2/debug/incident", _h(core, _incident_status))
+    app.router.add_post("/v2/debug/incident", _h(core, _incident_trigger))
     return app
 
 
@@ -541,6 +547,46 @@ async def _costs(core, request):
     body = await asyncio.get_running_loop().run_in_executor(
         None, lambda: json.dumps(core.cost_ledger.snapshot(model=model)))
     return web.Response(text=body, content_type="application/json")
+
+
+async def _profile(core, request):
+    """Debug surface for the always-on host profiler (server/profiler.py).
+
+    Default output is collapsed-stack text — pipe straight into
+    ``flamegraph.pl`` or paste into speedscope.  ``?format=json`` returns
+    the structured snapshot (loop-lag series, GC pauses, top stacks);
+    ``?role=`` filters the folded stacks to one thread role."""
+    role = request.query.get("role") or None
+    if request.query.get("format") == "json":
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: json.dumps(core.profiler.snapshot()))
+        return web.Response(text=body, content_type="application/json")
+    text = await asyncio.get_running_loop().run_in_executor(
+        None, core.profiler.collapsed, role)
+    return web.Response(text=text, content_type="text/plain")
+
+
+async def _incident_status(core, request):
+    body = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: json.dumps(core.incidents.snapshot()))
+    return web.Response(text=body, content_type="application/json")
+
+
+async def _incident_trigger(core, request):
+    """Manual incident bundle: ``POST /v2/debug/incident`` (optional JSON
+    body ``{"reason": ...}``).  Synchronous — the response carries the
+    bundle path — but off-loop: the capture window must not stall the
+    loop it is trying to observe.  202 with ``"rate_limited"`` when the
+    manual class is inside its cool-down."""
+    payload = await _read_json(request, default={})
+    reason = str(payload.get("reason", "manual trigger"))
+    path = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: core.incidents.trigger(
+            "manual", reason=reason, sync=True))
+    if path is None:
+        return web.json_response(
+            {"status": "rate_limited", "bundle": None}, status=202)
+    return web.json_response({"status": "written", "bundle": path})
 
 
 async def _metrics(core, request):

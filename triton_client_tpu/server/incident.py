@@ -1,0 +1,397 @@
+"""Automatic incident capture: postmortem bundles for a process that
+misbehaved while nobody was watching.
+
+The flight recorder pins individual *requests*; this module pins the
+*process*.  When a trigger fires — sustained SLO burn, a supervisor-
+observed worker crash, a flight-recorder watchdog storm, a chaos
+``mem_pressure``/``worker_kill`` draw, ``SIGUSR2``, or a manual
+``POST /v2/debug/incident`` — the :class:`IncidentRecorder` writes one
+**bundle directory** containing everything a postmortem needs:
+
+====================  =====================================================
+file                  contents
+====================  =====================================================
+manifest.json         schema version, trigger class + reason, timestamps,
+                      pid/replica, capture parameters, per-file status
+profile.folded        boosted-rate host profile over the capture window
+                      (collapsed-stack text, flamegraph-ready)
+profiler.json         profiler snapshot: loop-lag series, GC pauses,
+                      rolling-window top stacks
+threads.txt           faulthandler-style all-thread stack dump
+flight_recorder.json  ring + outlier flights with span trees
+device_stats.json     per-model device duty/latency/cost state
+costs.json            cost ledger (roofline verdicts, tenant attribution)
+memory.json           memory-governor ledger (budget, inflight, kv, shed)
+metrics.txt           full Prometheus exposition at capture time
+trace_tail.jsonl      tail of the (rotated) request-trace JSONL stream
+config.json           env/argv/version fingerprint of the process
+====================  =====================================================
+
+Bundles are written to a temp-named directory and atomically renamed
+into place, so a reader never sees a half-written bundle.  Each trigger
+class is rate-limited (``min_interval_s``) and the directory is pruned
+to ``keep`` bundles, newest first — a flapping SLO breach cannot fill
+the disk.  Every sub-capture is individually fault-isolated: a snapshot
+that throws records an error string in the manifest instead of killing
+the bundle (a half postmortem beats none, during exactly the kind of
+process distress that makes snapshots throw).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .profiler import dump_threads
+
+INCIDENT_DIR_ENV = "TRITON_TPU_INCIDENT_DIR"
+MANIFEST_SCHEMA = 1
+
+# every trigger source the recorder accepts; anything else is a caller bug
+TRIGGER_CLASSES = ("slo_burn", "worker_crash", "watchdog_storm", "chaos",
+                   "sigusr2", "manual")
+
+_BUNDLE_PREFIX = "incident-"
+
+
+def default_incident_dir() -> str:
+    env = os.environ.get(INCIDENT_DIR_ENV, "")
+    if env:
+        return env
+    return os.path.join(tempfile.gettempdir(), "tc-tpu-incidents")
+
+
+def _tail_lines(path: str, n: int, max_bytes: int = 262144) -> List[str]:
+    """Last ``n`` lines of ``path`` reading at most ``max_bytes`` — an
+    incident capture must not slurp a multi-GB trace stream."""
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - max_bytes))
+        data = f.read()
+    lines = data.decode("utf-8", errors="replace").splitlines()
+    if size > max_bytes and lines:
+        lines = lines[1:]  # first line is almost surely truncated
+    return lines[-n:]
+
+
+class IncidentRecorder:
+    """Writes bounded, atomic postmortem bundles on trigger.
+
+    Construction is cheap and passive (``InferenceCore`` builds one
+    unconditionally); ``start()`` — called from ``warmup_models`` like
+    the profiler — begins the fleet-state crash watcher.  All heavy work
+    (the boosted profile window, the snapshot fan-out, the writes)
+    happens on a dedicated thread per bundle, never on a serving loop.
+    """
+
+    def __init__(self, core, dir: Optional[str] = None, keep: int = 8,
+                 min_interval_s: float = 60.0,
+                 profile_window_s: float = 1.0, profile_hz: float = 97.0,
+                 trace_tail_lines: int = 256,
+                 breach_sustain: int = 3, breach_window_s: float = 300.0,
+                 storm_captures: int = 16, storm_window_s: float = 10.0):
+        self.core = core
+        self.dir = dir or default_incident_dir()
+        self.keep = keep
+        self.min_interval_s = min_interval_s
+        self.profile_window_s = profile_window_s
+        self.profile_hz = profile_hz
+        self.trace_tail_lines = trace_tail_lines
+        self._lock = threading.Lock()
+        self._last_trigger: Dict[str, float] = {}
+        self._seq = 0
+        self._writers: List[threading.Thread] = []
+        # counters surfaced as nv_host_incident_total{trigger,outcome}
+        self._written: Dict[str, int] = {}
+        self._suppressed: Dict[str, int] = {}
+        self._history: deque = deque(maxlen=64)  # (ts, kind, reason, path)
+        # -- sustained-breach detector (slo_burn): N pins in a window --
+        self.breach_sustain = breach_sustain
+        self.breach_window_s = breach_window_s
+        self._breach_pins: deque = deque(maxlen=max(breach_sustain, 8))
+        # -- watchdog-storm detector: N captures in a window -----------
+        self.storm_captures = storm_captures
+        self.storm_window_s = storm_window_s
+        self._capture_times: deque = deque(maxlen=max(storm_captures, 32))
+        # -- fleet-state crash watcher ---------------------------------
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._seen_restarts: Optional[Dict[str, int]] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        from .fleet import fleet_state_path, worker_restart_counts
+
+        if self._watch_thread is not None or fleet_state_path() is None:
+            return
+        # baseline synchronously at start: restarts that predate this
+        # watcher are not our incident, but anything after start() must
+        # trigger — a first-poll baseline would swallow a crash that
+        # lands inside the first poll interval
+        self._seen_restarts = dict(worker_restart_counts())
+        self._watch_stop.clear()
+        self._watch_thread = threading.Thread(
+            target=self._watch_fleet, daemon=True,
+            name="tc-tpu-incident-watch")
+        self._watch_thread.start()
+
+    def stop(self) -> None:
+        self._watch_stop.set()
+        t = self._watch_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._watch_thread = None
+        with self._lock:
+            writers = list(self._writers)
+        for w in writers:
+            w.join(timeout=10.0)
+
+    # -- trigger sources ---------------------------------------------------
+
+    def note_breach(self, model: str) -> None:
+        """Fed by the flight recorder on every SLO-pinned flight; a
+        single pin is noise, ``breach_sustain`` pins inside
+        ``breach_window_s`` is an incident."""
+        now = time.monotonic()
+        with self._lock:
+            self._breach_pins.append(now)
+            pins = [t for t in self._breach_pins
+                    if now - t <= self.breach_window_s]
+            sustained = len(pins) >= self.breach_sustain
+        if sustained:
+            self.trigger("slo_burn", reason=f"model={model} "
+                         f"{len(pins)} SLO pins in "
+                         f"{self.breach_window_s:.0f}s")
+
+    def note_capture(self) -> None:
+        """Fed by the flight recorder on every capture (failed / slow /
+        chaos); a storm of captures means systemic distress."""
+        now = time.monotonic()
+        with self._lock:
+            self._capture_times.append(now)
+            recent = [t for t in self._capture_times
+                      if now - t <= self.storm_window_s]
+            storm = len(recent) >= self.storm_captures
+        if storm:
+            self.trigger("watchdog_storm",
+                         reason=f"{len(recent)} flight captures in "
+                         f"{self.storm_window_s:.0f}s")
+
+    def _watch_fleet(self) -> None:
+        from .fleet import worker_restart_counts, worker_crash_reasons
+
+        while not self._watch_stop.wait(0.5):
+            counts = worker_restart_counts()
+            if self._seen_restarts is None:  # start() always baselines;
+                self._seen_restarts = {}     # belt-and-braces only
+            new = {w: n for w, n in counts.items()
+                   if n > self._seen_restarts.get(w, 0)}
+            if new:
+                self._seen_restarts = dict(counts)
+                reasons = worker_crash_reasons() or {}
+                detail = ", ".join(
+                    f"worker {w}: {reasons.get(w, 'unknown')}"
+                    for w in sorted(new))
+                self.trigger("worker_crash", reason=detail)
+
+    # -- the trigger itself ------------------------------------------------
+
+    def trigger(self, kind: str, reason: str = "",
+                context: Optional[Dict[str, Any]] = None,
+                sync: bool = False) -> Optional[str]:
+        """Fire a trigger.  Returns the bundle path (``sync=True``) or
+        the path the writer thread is producing, or ``None`` when the
+        trigger was rate-limited away."""
+        if kind not in TRIGGER_CLASSES:
+            raise ValueError(f"unknown incident trigger class '{kind}'")
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_trigger.get(kind)
+            if last is not None and now - last < self.min_interval_s:
+                self._suppressed[kind] = self._suppressed.get(kind, 0) + 1
+                return None
+            self._last_trigger[kind] = now
+            self._seq += 1
+            seq = self._seq
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        name = f"{_BUNDLE_PREFIX}{stamp}-{seq:04d}-{kind}"
+        path = os.path.join(self.dir, name)
+        if sync:
+            self._write_bundle(path, kind, reason, context)
+            return path
+        t = threading.Thread(target=self._write_bundle,
+                             args=(path, kind, reason, context),
+                             daemon=True, name="tc-tpu-incident-write")
+        with self._lock:
+            self._writers = [w for w in self._writers if w.is_alive()]
+            self._writers.append(t)
+        t.start()
+        return path
+
+    # -- bundle writing ----------------------------------------------------
+
+    def _write_bundle(self, path: str, kind: str, reason: str,
+                      context: Optional[Dict[str, Any]]) -> None:
+        ts = time.time()
+        tmp = os.path.join(os.path.dirname(path),
+                           f".tmp-{os.path.basename(path)}-{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+        files: List[Dict[str, Any]] = []
+
+        def _put(name: str, producer) -> None:
+            # fault isolation per file: a throwing snapshot records its
+            # error in the manifest instead of killing the bundle
+            try:
+                data = producer()
+                if isinstance(data, (dict, list)):
+                    data = json.dumps(data, indent=1, sort_keys=True,
+                                      default=str)
+                with open(os.path.join(tmp, name), "w",
+                          encoding="utf-8") as f:
+                    f.write(data)
+                files.append({"name": name,
+                              "bytes": os.path.getsize(
+                                  os.path.join(tmp, name))})
+            except Exception as e:  # noqa: BLE001 — bundle survives
+                files.append({"name": name, "error": str(e)})
+
+        core = self.core
+        # the deep capture first: it defines the bundle's observation
+        # window, and everything else snapshots the state at its end
+        _put("profile.folded",
+             lambda: core.profiler.capture_window(
+                 self.profile_window_s, self.profile_hz))
+        _put("threads.txt", dump_threads)
+        _put("profiler.json", core.profiler.snapshot)
+        _put("flight_recorder.json", core.flight_recorder.snapshot)
+        _put("device_stats.json", core.device_stats.snapshot)
+        _put("costs.json", core.cost_ledger.snapshot)
+        _put("memory.json", core.memory.snapshot)
+        _put("metrics.txt", lambda: _render_metrics(core))
+        _put("trace_tail.jsonl", lambda: "\n".join(
+            self._trace_tail()) + "\n")
+        _put("config.json", lambda: self._fingerprint(core))
+        # the recorder's own state rides along: prior triggers are the
+        # report's timeline (this bundle's trigger is in the manifest)
+        _put("incident.json", self.snapshot)
+
+        manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "trigger": kind,
+            "reason": reason,
+            "context": context or {},
+            "ts": ts,
+            "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts)),
+            "pid": os.getpid(),
+            "replica": getattr(core.tracer, "replica", ""),
+            "capture": {"profile_hz": self.profile_hz,
+                        "profile_window_s": self.profile_window_s},
+            "files": files,
+        }
+        with open(os.path.join(tmp, "manifest.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        # atomic publish: a reader lists only complete bundles
+        try:
+            os.replace(tmp, path)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+        with self._lock:
+            self._written[kind] = self._written.get(kind, 0) + 1
+            self._history.append((ts, kind, reason, path))
+        self._retain()
+
+    def _trace_tail(self) -> List[str]:
+        base = self.core.tracer._trace_file()
+        candidates = [base] + [f"{base}.{i}" for i in range(16)]
+        existing = [(os.path.getmtime(p), p) for p in candidates
+                    if os.path.exists(p)]
+        if not existing:
+            return []
+        existing.sort()
+        lines: List[str] = []
+        # newest-last: walk files oldest→newest, keep the final tail
+        for _mt, p in existing:
+            lines.extend(_tail_lines(p, self.trace_tail_lines))
+        return lines[-self.trace_tail_lines:]
+
+    @staticmethod
+    def _fingerprint(core) -> Dict[str, Any]:
+        import platform
+
+        return {
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "python": sys.version.split()[0],
+            "platform": platform.platform(),
+            "replica": getattr(core.tracer, "replica", ""),
+            "models": sorted(m.name for m in
+                             core.registry.all_version_models()),
+            "env": {k: os.environ[k] for k in sorted(os.environ)
+                    if k.startswith(("TRITON_TPU_", "JAX_"))},
+        }
+
+    # -- retention ---------------------------------------------------------
+
+    def _retain(self) -> None:
+        try:
+            entries = sorted(
+                e for e in os.listdir(self.dir)
+                if e.startswith(_BUNDLE_PREFIX))
+        except OSError:
+            return
+        # bundle names sort chronologically (utc stamp + seq): drop the
+        # oldest beyond keep
+        for e in entries[:max(0, len(entries) - self.keep)]:
+            shutil.rmtree(os.path.join(self.dir, e), ignore_errors=True)
+
+    # -- surfaces ----------------------------------------------------------
+
+    def list_bundles(self) -> List[str]:
+        try:
+            return sorted(e for e in os.listdir(self.dir)
+                          if e.startswith(_BUNDLE_PREFIX))
+        except OSError:
+            return []
+
+    def metric_rows(self) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+        with self._lock:
+            rows = [({"trigger": k, "outcome": "written"}, float(n))
+                    for k, n in sorted(self._written.items())]
+            rows += [({"trigger": k, "outcome": "suppressed"}, float(n))
+                     for k, n in sorted(self._suppressed.items())]
+        return {"incidents": rows}
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            history = [{"ts": ts, "trigger": k, "reason": r,
+                        "bundle": os.path.basename(p)}
+                       for ts, k, r, p in self._history]
+            written = dict(self._written)
+            suppressed = dict(self._suppressed)
+        return {
+            "dir": self.dir,
+            "keep": self.keep,
+            "min_interval_s": self.min_interval_s,
+            "bundles": self.list_bundles(),
+            "written": written,
+            "suppressed": suppressed,
+            "recent": history,
+        }
+
+
+def _render_metrics(core) -> str:
+    # local import: metrics imports nothing from here, but going through
+    # the module at call time keeps construction-order freedom in core
+    from . import metrics
+
+    return metrics.render_prometheus(core)

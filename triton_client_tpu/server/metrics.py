@@ -205,6 +205,26 @@ _COST_FAMILIES: List[Tuple[str, str, str, str]] = [
      "lifetime; reconciles with the memory governor's pin ledger)"),
 ]
 
+#: ``nv_host_*`` family declarations: host self-observation (the
+#: sampling profiler + loop-lag probes + GC accounting of
+#: ``HostProfiler.metric_rows``, server/profiler.py) and the incident
+#: recorder's trigger counters (``IncidentRecorder.metric_rows``,
+#: server/incident.py).
+_HOST_FAMILIES: List[Tuple[str, str, str, str]] = [
+    ("loop_lag", "nv_host_loop_lag_us", "gauge",
+     "Worst asyncio event-loop scheduling delay observed by the lag "
+     "probe over its rolling window, per frontend loop (microseconds)"),
+    ("gc_pause", "nv_host_gc_pause_us_total", "counter",
+     "Cumulative stop-the-world garbage-collection pause time per GC "
+     "generation (microseconds, from gc.callbacks)"),
+    ("samples", "nv_host_profile_samples_total", "counter",
+     "Stack samples taken by the always-on host sampling profiler, per "
+     "thread role (frontend / decode / readback / batcher / other)"),
+    ("incidents", "nv_host_incident_total", "counter",
+     "Incident bundle triggers per trigger class and outcome (written = "
+     "bundle produced, suppressed = rate-limited away)"),
+]
+
 #: ``nv_slo_*`` family declarations, keyed by ``SloEngine.metric_rows``.
 _SLO_FAMILIES: List[Tuple[str, str, str, str]] = [
     ("burn_rate", "nv_slo_burn_rate", "gauge",
@@ -333,6 +353,12 @@ def collect_families(core: InferenceCore) -> List[Family]:
     slo_rows = core.slo.metric_rows()
     for key, name, kind, help_text in _SLO_FAMILIES:
         families.append((name, help_text, kind, slo_rows.get(key, [])))
+
+    # -- host self-observation (server/profiler.py, incident.py) ----------
+    host_rows = core.profiler.metric_rows()
+    host_rows.update(core.incidents.metric_rows())
+    for key, name, kind, help_text in _HOST_FAMILIES:
+        families.append((name, help_text, kind, host_rows.get(key, [])))
 
     # -- per-tenant cost attribution (server/costs.py) ---------------------
     cost_rows = core.cost_ledger.metric_rows()

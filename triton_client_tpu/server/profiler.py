@@ -1,0 +1,466 @@
+"""Always-on host profiling: where does *host* time go per token?
+
+The device side of the stack is thoroughly observed (device_stats duty
+cycles, cost ledger roofline verdicts, per-tick traces) — but the Python
+host that feeds it is not.  An event-loop stall, a GC pause stretching
+tick assembly, or GIL contention between the frontend and the decode
+workers all show up downstream as mysterious latency with no attributed
+cause.  ``HostProfiler`` closes that gap with three always-on, bounded
+observers:
+
+* a **sampling profiler** — a daemon thread walking
+  ``sys._current_frames()`` at ``TRITON_TPU_PROFILE_HZ`` (default ~19 Hz,
+  0 disables it) and folding each thread's stack into per-role rolling
+  windows.  19 Hz is deliberately prime-ish: a sampler phase-locked to a
+  10 ms batching window or a 100 Hz timer would alias and systematically
+  miss (or always hit) the same code; an odd rate decorrelates.  At 19 Hz
+  the sampler costs one ``sys._current_frames()`` walk per period —
+  measured well under the 2% throughput bound (see BENCH
+  ``profiler_overhead``).
+* an **event-loop lag probe** — a self-rescheduling ``call_later``
+  callback per frontend loop that measures the delta between when asyncio
+  *should* have run it and when it *did*.  That delta IS the scheduling
+  delay every coroutine on that loop experienced.
+* **GC pause accounting** via ``gc.callbacks`` — per-generation pause
+  totals, because a gen-2 collection mid-decode-tick is precisely the
+  kind of host stall the roadmap's tick-scheduling work must rule out.
+
+All three surface through ``metric_rows()`` into the single-declaration
+``nv_host_*`` metric families, through ``snapshot()`` for JSON debug and
+incident bundles, and through ``collapsed()`` as flamegraph-ready
+collapsed-stack text (``/v2/debug/profile``).
+
+Memory is bounded by construction: folded stacks aggregate into a
+two-epoch rotating window (current + previous, rotated every
+``window_s``) capped at ``max_stacks`` distinct stacks per epoch;
+overflow folds into a synthetic ``~overflow`` frame rather than growing.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import Counter, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+PROFILE_HZ_ENV = "TRITON_TPU_PROFILE_HZ"
+DEFAULT_PROFILE_HZ = 19.0
+
+# distinct folded stacks kept per epoch per role — beyond this, samples
+# fold into "~overflow" (bounded memory beats perfect attribution)
+DEFAULT_MAX_STACKS = 2048
+# epoch length of the rolling window: collapsed() always covers between
+# one and two windows of history
+DEFAULT_WINDOW_S = 60.0
+# frames kept per sample; deeper stacks truncate at the leaf end
+MAX_STACK_DEPTH = 64
+# loop-lag probe cadence and per-loop sample retention
+PROBE_INTERVAL_S = 0.25
+_PROBE_KEEP = 512
+
+
+def profile_hz_from_env(default: float = DEFAULT_PROFILE_HZ) -> float:
+    """Sampler rate from ``TRITON_TPU_PROFILE_HZ`` (0 = off)."""
+    raw = os.environ.get(PROFILE_HZ_ENV, "")
+    if not raw:
+        return default
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return default
+
+
+def classify_thread(name: str) -> str:
+    """Map a thread name onto its serving role.
+
+    The roles mirror the pipeline stages an operator reasons about:
+    ``frontend`` (event loops answering requests), ``decode`` (the
+    per-model decode worker driving ticks), ``readback`` (device→host
+    copy executors, including the ordered gen reader), ``batcher``
+    (asyncio's default executor, where batched execute calls run), and
+    ``other`` for everything else.
+    """
+    if "-decode-worker" in name:
+        return "decode"
+    if "-readback" in name or "-gen" in name:
+        return "readback"
+    if name == "MainThread" or name.startswith("tc-tpu-server"):
+        return "frontend"
+    if name.startswith("asyncio_") or "ThreadPoolExecutor" in name:
+        return "batcher"
+    return "other"
+
+
+def fold_stack(frame, limit: int = MAX_STACK_DEPTH) -> str:
+    """Collapse a frame chain into ``file:func;file:func`` root-first —
+    the flamegraph collapsed-stack convention (Brendan Gregg format)."""
+    parts: List[str] = []
+    f = frame
+    while f is not None and len(parts) < limit:
+        code = f.f_code
+        parts.append(f"{os.path.basename(code.co_filename)}:{code.co_name}")
+        f = f.f_back
+    parts.reverse()
+    return ";".join(parts)
+
+
+def dump_threads() -> str:
+    """Faulthandler-style dump of every thread's current stack.
+
+    Pure Python so it can be written into an incident bundle from any
+    thread at any time (``faulthandler`` itself can only write to a file
+    descriptor registered up front)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: List[str] = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        name = names.get(ident, "?")
+        out.append(f"Thread 0x{ident:x} ({name}) "
+                   f"[role={classify_thread(name)}]:")
+        out.extend(line.rstrip("\n")
+                   for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+class _Capture:
+    """A live incident capture: the sampler feeds every sample into it
+    while registered, independent of window rotation."""
+
+    def __init__(self) -> None:
+        self.counts: Counter = Counter()  # (role, stack) -> samples
+        self.samples = 0
+
+
+class HostProfiler:
+    """Always-on sampling profiler + loop-lag probe + GC accounting.
+
+    ``start()`` registers the GC callback and (when ``hz > 0``) launches
+    the sampler thread; the loop-lag probes are installed separately per
+    frontend loop via :meth:`install_loop_probe`.  Everything stops
+    cleanly via :meth:`stop` — the profiler owns no resources a test
+    harness can leak.
+    """
+
+    def __init__(self, hz: Optional[float] = None,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 max_stacks: int = DEFAULT_MAX_STACKS):
+        self.hz = profile_hz_from_env() if hz is None else max(0.0, hz)
+        self.window_s = window_s
+        self.max_stacks = max_stacks
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        # -- folded-stack windows: two epochs, rotated every window_s --
+        self._epoch: Counter = Counter()       # (role, stack) -> samples
+        self._prev_epoch: Counter = Counter()
+        self._epoch_started = time.monotonic()
+        self._samples_by_role: Counter = Counter()  # cumulative, per role
+        self._captures: List[_Capture] = []
+        # boost: incident captures temporarily raise the sampling rate
+        self._boost_hz = 0.0
+        self._boost_until = 0.0
+        # thread-name map, refreshed when the ident set changes (a
+        # threading.enumerate() per sample would dominate sampler cost)
+        self._names: Dict[int, str] = {}
+        self._names_key: frozenset = frozenset()
+        # -- loop-lag probes -------------------------------------------
+        # loop name -> {"last_us", "max_us", "samples": [(mono, us)...]}
+        self._loops: Dict[str, Dict[str, Any]] = {}
+        # -- GC accounting ---------------------------------------------
+        self._gc_start_ns: Optional[int] = None
+        self._gc_pause_ns: Counter = Counter()        # generation -> ns
+        self._gc_collections: Counter = Counter()     # generation -> n
+        # _on_gc runs re-entrantly on WHATEVER thread triggered the
+        # collection — including one already holding self._lock (an
+        # allocation inside metric_rows/snapshot can start a GC).  It
+        # therefore never takes the lock: completed pauses queue here
+        # (deque.append is atomic) and readers drain under the lock.
+        self._gc_events: deque = deque()              # (generation, ns)
+        self._gc_registered = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.hz > 0.0
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        if not self._gc_registered:
+            gc.callbacks.append(self._on_gc)
+            self._gc_registered = True
+        if self.enabled:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tc-tpu-host-profiler")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._started = False
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        if self._gc_registered:
+            try:
+                gc.callbacks.remove(self._on_gc)
+            except ValueError:
+                pass
+            self._gc_registered = False
+
+    # -- sampler -----------------------------------------------------------
+
+    def _effective_hz(self) -> float:
+        if time.monotonic() < self._boost_until:
+            return max(self.hz, self._boost_hz)
+        return self.hz if self.hz > 0 else 0.0
+
+    def _run(self) -> None:
+        own = threading.get_ident()
+        while not self._stop.is_set():
+            hz = self._effective_hz()
+            if hz <= 0:
+                self._stop.wait(0.25)
+                continue
+            self._stop.wait(1.0 / hz)
+            if self._stop.is_set():
+                break
+            self._sample_once(exclude={own})
+
+    def _thread_names(self, idents) -> Dict[int, str]:
+        key = frozenset(idents)
+        if key != self._names_key:
+            self._names = {t.ident: t.name for t in threading.enumerate()
+                           if t.ident is not None}
+            self._names_key = key
+        return self._names
+
+    def _sample_once(self, exclude=frozenset()) -> None:
+        frames = sys._current_frames()
+        names = self._thread_names(frames.keys())
+        now = time.monotonic()
+        with self._lock:
+            if now - self._epoch_started >= self.window_s:
+                self._prev_epoch = self._epoch
+                self._epoch = Counter()
+                self._epoch_started = now
+            for ident, frame in frames.items():
+                if ident in exclude:
+                    continue
+                role = classify_thread(names.get(ident, f"tid-{ident}"))
+                stack = fold_stack(frame)
+                key = (role, stack)
+                # cap distinct stacks per epoch: overflow folds into a
+                # synthetic frame so totals stay honest while memory
+                # stays bounded
+                if (key not in self._epoch
+                        and len(self._epoch) >= self.max_stacks):
+                    key = (role, "~overflow")
+                self._epoch[key] += 1
+                self._samples_by_role[role] += 1
+                for cap in self._captures:
+                    cap.counts[key] += 1
+                    cap.samples += 1
+
+    # -- incident capture --------------------------------------------------
+
+    def boost(self, hz: float, duration_s: float) -> None:
+        """Temporarily raise the sampling rate (incident deep capture)."""
+        self._boost_hz = max(self._boost_hz, hz)
+        self._boost_until = max(self._boost_until,
+                                time.monotonic() + duration_s)
+
+    def capture_window(self, duration_s: float = 1.0,
+                       hz: float = 97.0) -> str:
+        """Boosted-rate capture for an incident bundle: sample at ``hz``
+        for ``duration_s`` and return the window as collapsed-stack text.
+
+        Rides the live sampler thread when one is running (a registered
+        capture sink sees every sample regardless of epoch rotation);
+        when the always-on sampler is off (``hz=0`` deployments), samples
+        inline on the caller's thread — an incident capture must work
+        exactly when profiling was disabled to save the 2%.
+        """
+        cap = _Capture()
+        t = self._thread
+        if t is not None and t.is_alive() and not self._stop.is_set():
+            with self._lock:
+                self._captures.append(cap)
+            self.boost(hz, duration_s)
+            time.sleep(duration_s)
+            with self._lock:
+                try:
+                    self._captures.remove(cap)
+                except ValueError:
+                    pass
+        else:
+            own = threading.get_ident()
+            deadline = time.monotonic() + duration_s
+            period = 1.0 / max(hz, 1.0)
+            with self._lock:
+                self._captures.append(cap)
+            try:
+                while time.monotonic() < deadline:
+                    self._sample_once(exclude={own})
+                    time.sleep(period)
+            finally:
+                with self._lock:
+                    try:
+                        self._captures.remove(cap)
+                    except ValueError:
+                        pass
+        return self._render_collapsed(cap.counts)
+
+    # -- loop-lag probe ----------------------------------------------------
+
+    def install_loop_probe(self, loop, name: str = "frontend",
+                           interval_s: float = PROBE_INTERVAL_S) -> None:
+        """Install the self-rescheduling lag probe on ``loop``.
+
+        Each firing measures ``actual - expected`` run time: exactly the
+        scheduling delay every other callback on that loop paid.  The
+        probe survives until :meth:`stop` (it simply stops rescheduling);
+        a closed loop drops the pending timer harmlessly.
+        """
+        with self._lock:
+            if name in self._loops:
+                # second frontend on the SAME loop (http + metrics app
+                # share one): one probe per loop is enough
+                return
+            state = {"last_us": 0.0, "max_us": 0.0, "samples": []}
+            self._loops[name] = state
+
+        def _tick(expected: float) -> None:
+            if self._stop.is_set():
+                return
+            now = loop.time()
+            lag_us = max(0.0, (now - expected) * 1e6)
+            mono = time.monotonic()
+            with self._lock:
+                state["last_us"] = lag_us
+                samples = state["samples"]
+                samples.append((mono, lag_us))
+                if len(samples) > _PROBE_KEEP:
+                    del samples[: len(samples) - _PROBE_KEEP]
+                cutoff = mono - self.window_s
+                state["max_us"] = max(
+                    (us for ts, us in samples if ts >= cutoff),
+                    default=lag_us)
+            loop.call_later(interval_s, _tick, now + interval_s)
+
+        loop.call_soon_threadsafe(
+            lambda: loop.call_later(
+                interval_s, _tick, loop.time() + interval_s))
+
+    # -- GC accounting -----------------------------------------------------
+
+    def _on_gc(self, phase: str, info: Dict[str, Any]) -> None:
+        # CPython runs one collection at a time under the GIL, so a
+        # single start stamp is race-free.  Lock-free on purpose: the
+        # callback fires on the thread that tripped the collection,
+        # which may already hold self._lock (see _gc_events).
+        if phase == "start":
+            self._gc_start_ns = time.perf_counter_ns()
+        elif phase == "stop" and self._gc_start_ns is not None:
+            dt = time.perf_counter_ns() - self._gc_start_ns
+            self._gc_start_ns = None
+            self._gc_events.append((int(info.get("generation", 0)), dt))
+
+    def _drain_gc_events(self) -> None:
+        # caller holds self._lock; a GC fired mid-drain only appends
+        while True:
+            try:
+                gen, dt = self._gc_events.popleft()
+            except IndexError:
+                break
+            self._gc_pause_ns[gen] += dt
+            self._gc_collections[gen] += 1
+
+    # -- output surfaces ---------------------------------------------------
+
+    @staticmethod
+    def _render_collapsed(counts: Counter) -> str:
+        lines = [f"{role};{stack} {n}"
+                 for (role, stack), n in sorted(counts.items(),
+                                                key=lambda kv: -kv[1])]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def collapsed(self, role: Optional[str] = None) -> str:
+        """Rolling-window folded stacks as collapsed-stack text (feed
+        straight to ``flamegraph.pl`` / speedscope)."""
+        with self._lock:
+            merged = self._prev_epoch + self._epoch
+        if role is not None:
+            merged = Counter({k: v for k, v in merged.items()
+                              if k[0] == role})
+        return self._render_collapsed(merged)
+
+    def top_stacks(self, n: int = 10,
+                   role: Optional[str] = None) -> List[Tuple[str, str, int]]:
+        """(role, folded stack, samples) for the n hottest stacks in the
+        rolling window — the incident-report and debug-JSON shape."""
+        with self._lock:
+            merged = self._prev_epoch + self._epoch
+        items = [(r, s, c) for (r, s), c in merged.items()
+                 if role is None or r == role]
+        items.sort(key=lambda t: -t[2])
+        return items[:n]
+
+    def loop_lag(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {name: {"last_us": st["last_us"],
+                           "max_us": st["max_us"]}
+                    for name, st in self._loops.items()}
+
+    def metric_rows(self) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+        """Rows for the single-declaration ``nv_host_*`` families in
+        ``metrics.collect_families`` (keys are family short-names)."""
+        with self._lock:
+            self._drain_gc_events()
+            lag = [({"loop": name}, st["max_us"])
+                   for name, st in sorted(self._loops.items())]
+            pauses = [({"generation": str(gen)}, ns / 1e3)
+                      for gen, ns in sorted(self._gc_pause_ns.items())]
+            samples = [({"role": role}, float(n))
+                       for role, n in sorted(self._samples_by_role.items())]
+        return {"loop_lag": lag, "gc_pause": pauses, "samples": samples}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON shape for ``/v2/debug/profile?format=json`` and incident
+        bundles."""
+        with self._lock:
+            self._drain_gc_events()
+            merged = self._prev_epoch + self._epoch
+            top = sorted(((r, s, c) for (r, s), c in merged.items()),
+                         key=lambda t: -t[2])[:50]
+            return {
+                "hz": self.hz,
+                "enabled": self.enabled,
+                "window_s": self.window_s,
+                "samples_by_role": dict(self._samples_by_role),
+                "distinct_stacks": len(merged),
+                "top_stacks": [{"role": r, "stack": s, "samples": c}
+                               for r, s, c in top],
+                "loop_lag": {
+                    name: {"last_us": st["last_us"],
+                           "max_us": st["max_us"],
+                           "series": [
+                               {"ts_mono": ts, "lag_us": us}
+                               for ts, us in st["samples"][-64:]]}
+                    for name, st in self._loops.items()},
+                "gc": {
+                    str(gen): {
+                        "pause_us_total": self._gc_pause_ns[gen] / 1e3,
+                        "collections": self._gc_collections[gen]}
+                    for gen in sorted(self._gc_pause_ns)},
+            }

@@ -275,16 +275,20 @@ class ReplicaSupervisor:
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
 
-    def crash(self, i: int) -> None:
+    def crash(self, i: int, reason: str = "chaos:worker_kill") -> None:
         """Kill replica ``i`` and heal it with backoff, off-thread (safe
         to call from a serving event loop via ``worker_kill_cb`` — the
-        kill itself must not deadlock the loop it is called from)."""
-        t = threading.Thread(target=self._heal, args=(i,), daemon=True)
+        kill itself must not deadlock the loop it is called from).
+        ``reason`` is stamped into the fleet state alongside the restart
+        count, the same way the production supervisor decodes a dead
+        worker's returncode."""
+        t = threading.Thread(target=self._heal, args=(i, reason),
+                             daemon=True)
         with self._lock:
             self._threads.append(t)
         t.start()
 
-    def _heal(self, i: int) -> None:
+    def _heal(self, i: int, reason: str = "") -> None:
         with self._lock:
             policy = self._policies.setdefault(i, self.policy_factory())
             delay = policy.on_crash()
@@ -299,7 +303,7 @@ class ReplicaSupervisor:
             if self.cluster.harnesses[i] is not None:
                 return  # someone else already brought it back
             self.cluster.restart(i)
-            self.state.record_restart(str(i))
+            self.state.record_restart(str(i), reason=reason or None)
 
     def join(self, timeout: float = 30.0) -> None:
         """Wait for in-flight heals (test teardown barrier)."""

@@ -1,0 +1,301 @@
+"""Incident-bundle postmortem renderer (``triton-incident-report``).
+
+``server/incident.py`` writes trigger-driven bundle directories (profile
+window, thread dump, every subsystem snapshot); this tool turns one into
+the document an on-call engineer actually reads::
+
+    python -m triton_client_tpu.tools.incident_report <bundle-dir>
+    python -m triton_client_tpu.tools.incident_report --latest <incident-dir>
+
+Sections, in triage order:
+
+* **header** — trigger class + reason, when, which process/replica,
+  which capture files made it (and which snapshots failed);
+* **trigger timeline** — the recorder's recent-trigger history with this
+  bundle's trigger as the terminal entry;
+* **host profile** — the hottest folded stacks per thread role from the
+  boosted capture window, plus loop-lag and GC-pause summaries;
+* **hottest models** — device time per model (cost ledger) with each
+  model's bucket roofline verdicts (device_stats);
+* **pinned flights** — the outlier table (slow / failed / SLO-breach /
+  chaos flights with their reasons) closest to the incident.
+
+stdlib-only on purpose: the bundle is plain JSON + text, and the tool
+must run anywhere the operator copied the directory to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Top folded stacks shown per thread role.
+TOP_STACKS = 5
+#: Pinned flights shown in the outlier table.
+TOP_FLIGHTS = 12
+#: Hottest models shown.
+TOP_MODELS = 8
+
+
+def _load_json(bundle: str, name: str) -> Optional[Any]:
+    path = os.path.join(bundle, name)
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _load_text(bundle: str, name: str) -> Optional[str]:
+    try:
+        with open(os.path.join(bundle, name), encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def parse_folded(text: str) -> List[Tuple[str, str, int]]:
+    """Collapsed-stack lines (``role;frame;frame N``) ->
+    ``[(role, stack, samples)]`` sorted hottest-first."""
+    out: List[Tuple[str, str, int]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count = line.rpartition(" ")
+        try:
+            n = int(count)
+        except ValueError:
+            continue
+        role, _, frames = stack.partition(";")
+        out.append((role, frames, n))
+    out.sort(key=lambda t: -t[2])
+    return out
+
+
+def _fmt_ts(ts: Optional[float]) -> str:
+    if not ts:
+        return "?"
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(ts)) + "Z"
+
+
+def _leaf(stack: str, keep: int = 3) -> str:
+    """The last ``keep`` frames — the part of a folded stack a human
+    scans a table by."""
+    frames = stack.split(";")
+    tail = ";".join(frames[-keep:])
+    return ("...;" + tail) if len(frames) > keep else tail
+
+
+def _section(title: str) -> List[str]:
+    return ["", title, "-" * len(title)]
+
+
+def render_report(bundle: str) -> str:
+    manifest = _load_json(bundle, "manifest.json") or {}
+    lines: List[str] = []
+    trigger = manifest.get("trigger", "?")
+    lines.append("=" * 72)
+    lines.append(f"INCIDENT POSTMORTEM — {os.path.basename(bundle.rstrip(os.sep))}")
+    lines.append("=" * 72)
+    lines.append(f"trigger:  {trigger}"
+                 + (f" — {manifest['reason']}" if manifest.get("reason")
+                    else ""))
+    lines.append(f"when:     {manifest.get('iso') or _fmt_ts(manifest.get('ts'))}")
+    lines.append(f"process:  pid {manifest.get('pid', '?')}"
+                 + (f"  replica {manifest['replica']}"
+                    if manifest.get("replica") else ""))
+    cap = manifest.get("capture") or {}
+    if cap:
+        lines.append(f"capture:  {cap.get('profile_hz', '?')} Hz profile "
+                     f"over {cap.get('profile_window_s', '?')}s window")
+    ok = [f["name"] for f in manifest.get("files", []) if "error" not in f]
+    bad = [(f["name"], f["error"]) for f in manifest.get("files", [])
+           if "error" in f]
+    lines.append(f"files:    {len(ok)} captured"
+                 + (f", {len(bad)} FAILED" if bad else ""))
+    for name, err in bad:
+        lines.append(f"          ! {name}: {err}")
+
+    # -- trigger timeline --------------------------------------------------
+    incident = _load_json(bundle, "incident.json") or {}
+    timeline = list(incident.get("recent") or [])
+    lines.extend(_section("Trigger timeline"))
+    for entry in timeline[-10:]:
+        lines.append(f"  {_fmt_ts(entry.get('ts'))}  "
+                     f"{entry.get('trigger', '?'):<15} "
+                     f"{entry.get('reason', '')}"
+                     f"  -> {entry.get('bundle', '')}")
+    lines.append(f"  {_fmt_ts(manifest.get('ts'))}  {trigger:<15} "
+                 f"{manifest.get('reason', '')}  -> THIS BUNDLE")
+    suppressed = incident.get("suppressed") or {}
+    if suppressed:
+        supp = ", ".join(f"{k}={v}" for k, v in sorted(suppressed.items()))
+        lines.append(f"  (rate-limited away before this point: {supp})")
+
+    # -- host profile ------------------------------------------------------
+    lines.extend(_section("Host profile (capture window)"))
+    folded = _load_text(bundle, "profile.folded")
+    if folded:
+        stacks = parse_folded(folded)
+        total = sum(n for _, _, n in stacks) or 1
+        by_role: Dict[str, List[Tuple[str, int]]] = {}
+        for role, stack, n in stacks:
+            by_role.setdefault(role, []).append((stack, n))
+        for role in sorted(by_role,
+                           key=lambda r: -sum(n for _, n in by_role[r])):
+            role_total = sum(n for _, n in by_role[role])
+            lines.append(f"  [{role}] {role_total} samples "
+                         f"({100.0 * role_total / total:.0f}%)")
+            for stack, n in by_role[role][:TOP_STACKS]:
+                lines.append(f"    {n:>6}  {_leaf(stack)}")
+    else:
+        lines.append("  (no profile captured)")
+
+    profiler = _load_json(bundle, "profiler.json") or {}
+    lags = profiler.get("loop_lag") or {}
+    if lags:
+        lines.append("  event-loop lag:")
+        for name, st in sorted(lags.items()):
+            series = st.get("series") or []
+            worst = max((p.get("lag_us", 0.0) for p in series),
+                        default=st.get("max_us", 0.0))
+            lines.append(f"    {name}: last {st.get('last_us', 0.0):.0f}us"
+                         f"  window-max {st.get('max_us', 0.0):.0f}us"
+                         f"  series-max {worst:.0f}us"
+                         f" over {len(series)} probes")
+    gc_info = profiler.get("gc") or {}
+    if gc_info:
+        parts = [f"gen{g}: {v.get('pause_us_total', 0.0) / 1e3:.1f}ms"
+                 f"/{v.get('collections', 0)} collections"
+                 for g, v in sorted(gc_info.items())]
+        lines.append("  GC pauses: " + "  ".join(parts))
+
+    # -- hottest models ----------------------------------------------------
+    lines.extend(_section("Hottest models (device time, roofline)"))
+    costs = _load_json(bundle, "costs.json") or {}
+    device = _load_json(bundle, "device_stats.json") or {}
+    per_model: Dict[str, float] = {}
+    for m, tenants in (costs.get("models") or {}).items():
+        per_model[m] = sum(float(c.get("device_us", 0.0))
+                           for c in tenants.values()
+                           if isinstance(c, dict))
+    if per_model:
+        ticks = device.get("ticks") or {}
+        for m, us in sorted(per_model.items(),
+                            key=lambda kv: -kv[1])[:TOP_MODELS]:
+            verdicts = []
+            for bucket, entry in sorted((ticks.get(m) or {}).items()):
+                roof = entry.get("roofline") if isinstance(entry, dict) \
+                    else None
+                if roof:
+                    v = roof.get("verdict", "?")
+                    pct = roof.get("pct_of_peak")
+                    verdicts.append(
+                        f"@{bucket}:{'comp' if v == 'compute_bound' else 'mem'}"
+                        + (f" {pct:.0f}%" if pct is not None else ""))
+            lines.append(f"  {m:<24}{us / 1e3:>10.1f} ms device"
+                         + ("  " + " ".join(verdicts) if verdicts else ""))
+    else:
+        lines.append("  (no cost ledger data)")
+
+    # -- pinned flights ----------------------------------------------------
+    lines.extend(_section("Pinned flights (outliers at capture)"))
+    recorder = _load_json(bundle, "flight_recorder.json") or {}
+    outliers = list(recorder.get("outliers") or [])
+    if outliers:
+        lines.append(f"  {'SEQ':>6}  {'MODEL':<20}{'TOTALms':>9}"
+                     f"{'AGEs':>7}  {'REASON':<14}{'OUTCOME':<10}CHAOS")
+        for o in outliers[-TOP_FLIGHTS:]:
+            total_ms = (o.get("total_us") or 0.0) / 1e3
+            lines.append(
+                f"  {o.get('seq', '?'):>6}  {o.get('model', '?'):<20}"
+                f"{total_ms:>9.2f}{(o.get('age_s') or 0):>7.1f}  "
+                f"{(o.get('capture_reason') or '-'):<14}"
+                f"{(o.get('outcome') or '?'):<10}"
+                f"{o.get('chaos') or '-'}")
+    else:
+        lines.append("  (no pinned flights)")
+
+    # -- governor / memory -------------------------------------------------
+    memory = _load_json(bundle, "memory.json") or {}
+    if memory:
+        lines.extend(_section("Memory governor"))
+        budget = memory.get("budget_bytes")
+        live = memory.get("effective_budget_bytes", budget)
+        lines.append(f"  budget: {budget or 'unbounded'}"
+                     + (f"  effective: {live}" if live != budget else "")
+                     + ("  [PRESSURE ACTIVE]"
+                        if memory.get("pressure_active") else ""))
+        if memory.get("pressure_events"):
+            lines.append(f"  pressure windows seen: "
+                         f"{memory['pressure_events']}")
+        inflight = memory.get("inflight_by_model") or {}
+        for m, b in sorted(inflight.items(), key=lambda kv: -kv[1])[:5]:
+            lines.append(f"  inflight {m}: {b} bytes")
+        if memory.get("shed_total"):
+            lines.append(f"  shed: {memory['shed_total']} total")
+
+    # -- config fingerprint (tail) -----------------------------------------
+    config = _load_json(bundle, "config.json") or {}
+    if config:
+        lines.extend(_section("Process fingerprint"))
+        lines.append(f"  python {config.get('python', '?')} on "
+                     f"{config.get('platform', '?')}")
+        env = config.get("env") or {}
+        for k in sorted(env):
+            lines.append(f"  {k}={env[k]}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def find_latest(incident_dir: str) -> Optional[str]:
+    """Newest bundle in an incident directory (bundle names sort
+    chronologically by construction)."""
+    try:
+        entries = sorted(e for e in os.listdir(incident_dir)
+                         if e.startswith("incident-")
+                         and os.path.isdir(os.path.join(incident_dir, e)))
+    except OSError:
+        return None
+    return os.path.join(incident_dir, entries[-1]) if entries else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="render an incident bundle into a postmortem")
+    parser.add_argument("bundle",
+                        help="bundle directory (or, with --latest, the "
+                        "incident directory holding bundles)")
+    parser.add_argument("--latest", action="store_true",
+                        help="treat BUNDLE as the incident dir and render "
+                        "its newest bundle")
+    parser.add_argument("-o", "--output", default=None,
+                        help="write the report here instead of stdout")
+    args = parser.parse_args(argv)
+    bundle = args.bundle
+    if args.latest:
+        found = find_latest(bundle)
+        if found is None:
+            print(f"no bundles under {bundle}", file=sys.stderr)
+            return 1
+        bundle = found
+    if not os.path.isfile(os.path.join(bundle, "manifest.json")):
+        print(f"{bundle}: not an incident bundle (no manifest.json)",
+              file=sys.stderr)
+        return 1
+    report = render_report(bundle)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(report)
+    else:
+        sys.stdout.write(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

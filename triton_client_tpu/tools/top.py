@@ -123,7 +123,8 @@ def parse_device(text: str) -> Dict[str, Any]:
                            "inst": {}, "ver": {}, "scale": {},
                            "restarts": {},
                            "mem_inflight": {}, "mem_budget": None,
-                           "mem_shed": {}}
+                           "mem_shed": {},
+                           "host_lag_us": None, "host_gc_us": None}
     for line in text.splitlines():
         if line.startswith("#"):
             continue
@@ -140,6 +141,18 @@ def parse_device(text: str) -> Dict[str, Any]:
             # unlabeled live-budget gauge (shrinks under mem_pressure
             # chaos) — the MEM% column's denominator
             out["mem_budget"] = float(value)
+            continue
+        if name == "nv_host_loop_lag_us":
+            # per-loop gauges fold to the WORST loop — the stall an
+            # operator chases is on whichever frontend loop has it
+            v = float(value)
+            if out["host_lag_us"] is None or v > out["host_lag_us"]:
+                out["host_lag_us"] = v
+            continue
+        if name == "nv_host_gc_pause_us_total":
+            # summed over generations: the GC column answers "how much
+            # wall time does GC steal", not which generation stole it
+            out["host_gc_us"] = (out["host_gc_us"] or 0.0) + float(value)
             continue
         if name == "nv_fleet_worker_restart_total":
             # kept per worker: every worker of one supervised fleet
@@ -383,6 +396,13 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "mem_shed_per_s": (round(_mem_shed_delta(
                 device, pdevice, model) / dt, 1) if dt
                 else device.get("mem_shed", {}).get(model)),
+            # host self-observation (server/profiler.py): process-wide
+            # values repeated per row — in the fleet view the worst
+            # replica's lag and the summed GC rate survive aggregation
+            "host_lag_ms": (round(device["host_lag_us"] / 1e3, 2)
+                            if device.get("host_lag_us") is not None
+                            else None),
+            "gc_ms_per_s": _gc_rate(device, pdevice, dt),
             "burn_5m": round(burn5, 1) if burn5 is not None else None,
             "burn_1h": round(burn1h, 1) if burn1h is not None else None,
             # multi-window breach at the server's exported threshold
@@ -394,6 +414,22 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "last_outlier": _outlier_brief(last_outlier.get(model)),
         }
     return rows
+
+
+def _gc_rate(device: Dict[str, Any], pdevice: Optional[Dict[str, Any]],
+             dt: Optional[float]) -> Optional[float]:
+    """GC pause milliseconds per second of wall clock between polls
+    (cumulative total in ms on the first/only sample; a counter reset
+    clamps at the new value, same contract as ``_delta``)."""
+    now = device.get("host_gc_us")
+    if now is None:
+        return None
+    if not dt or pdevice is None:
+        return round(now / 1e3, 1)
+    d = now - (pdevice.get("host_gc_us") or 0.0)
+    if d < 0:
+        d = now
+    return round(d / 1e3 / dt, 2)
 
 
 def _mem_shed_delta(device: Dict[str, Any],
@@ -768,6 +804,11 @@ def aggregate_rows(per_url_rows: Dict[str, Dict[str, Dict[str, Any]]]
             # budget pages first), shed rate sums like the other sheds
             "mem_pct": _worst("mem_pct"),
             "mem_shed_per_s": _sum("mem_shed_per_s"),
+            # host columns: LAG takes the worst replica (the stall users
+            # on that replica actually feel); the GC rate sums like the
+            # other per-process rates
+            "host_lag_ms": _worst("host_lag_ms"),
+            "gc_ms_per_s": _sum("gc_ms_per_s", nd=2),
             "burn_5m": _worst("burn_5m"),
             "burn_1h": _worst("burn_1h"),
             "slo_breach": any(r.get("slo_breach") for r in rows),
@@ -800,6 +841,7 @@ _COLUMNS = (f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
             f"{'BATCH':>7}{'PEND':>6}{'ERR%':>7}{'REJ/s':>7}{'DLX/s':>7}"
             f"{'SLOW':>6}{'CAPT':>6}{'DUTY%':>7}{'MEM%':>7}{'SHED/s':>8}"
             f"{'INST':>6}{'VER':>5}"
+            f"{'LAGms':>8}{'GCms/s':>8}"
             f"{'BURN':>9}"
             f"  LAST OUTLIER")
 
@@ -834,6 +876,8 @@ def _row_line(label: str, r: Dict[str, Any]) -> str:
         f"{r['captured_total']:>6}{_fmt(r.get('duty_pct')):>7}"
         f"{_fmt(r.get('mem_pct')):>7}{_fmt(r.get('mem_shed_per_s')):>8}"
         f"{_fmt(r.get('instances')):>6}{_fmt(r.get('version')):>5}"
+        f"{_fmt(r.get('host_lag_ms'), 2):>8}"
+        f"{_fmt(r.get('gc_ms_per_s'), 2):>8}"
         f"{burn:>9}  {brief}")
 
 
