@@ -1595,6 +1595,149 @@ def _measure_host_profiler_overhead_standalone() -> dict:
     return out
 
 
+def _measure_device_fault_recovery() -> dict:
+    """Device-fault containment leg (ISSUE 19) — CPU-runnable on the tiny
+    batched decode preset, standalone
+    (``python -c "import bench, json; print(json.dumps(bench._measure_device_fault_recovery()))"``).
+
+    Two halves:
+
+    * steady-state overhead: an ARMED model (DeviceFaultManager attached,
+      a rate=0 chaos injector consulted at every dispatch boundary, and
+      the tick-stall watchdog watching every readback) vs a PLAIN model
+      with none of it, interleaved best-of-3 cohorts on two warm
+      instances — acceptance bar <=1% of cohort tok/s (single-window
+      host noise is ±5%, so small negatives = noise).
+    * the acceptance drill, timed: a seeded transient ``device_error``
+      (rate=1, max_faults=1) against a full 4-slot cohort on the armed
+      model.  Every server-side stream must recover BIT-IDENTICAL to the
+      armed model's own clean run with zero caller-visible errors; the
+      wall-clock delta vs the armed clean cohort is the end-to-end
+      recovery cost (donated-cache rebuild + re-prefill of
+      prompt+emitted for all 4 sequences, serialized on the one worker).
+    """
+    import gc
+
+    from triton_client_tpu.server.chaos import ChaosInjector
+    from triton_client_tpu.server.core import DeviceFaultManager
+
+    keys = ("TRITON_TPU_DECODE_MODE", "TRITON_TPU_DECODE_SLOTS",
+            "TRITON_TPU_PREFILL_CHUNK", "TRITON_TPU_DECODE_BUCKETS",
+            "TRITON_TPU_KV_QUANT", "TRITON_TPU_DECODE_STEPS",
+            "TRITON_TPU_RECOVERY_BUDGET", "TRITON_TPU_TICK_STALL_MS")
+    saved = {k: os.environ.get(k) for k in keys}
+    SLOTS, N_TOK, ROUNDS = 4, 24, 3
+    out: dict = {"slots": SLOTS, "output_tokens": N_TOK}
+    gc.collect()
+    for k in keys:
+        os.environ.pop(k, None)
+    os.environ["TRITON_TPU_DECODE_MODE"] = "batched"
+    os.environ["TRITON_TPU_DECODE_SLOTS"] = str(SLOTS)
+    plain = armed = None
+    try:
+        from triton_client_tpu.models.decode import DecodeModel
+
+        win = np.zeros((1, 128), np.int32)
+        win[0, -5:] = [7, 11, 13, 17, 19]
+
+        def drain(sink):
+            toks = []
+            while True:
+                item = sink.get(timeout=600)
+                if item is None:
+                    return toks, None
+                if isinstance(item, Exception):
+                    return toks, item
+                toks.append(int(item[0]))
+
+        def cohort(m):
+            t0 = time.perf_counter()
+            outs = [drain(s) for s in
+                    [m.submit_generation(win, N_TOK)
+                     for _ in range(SLOTS)]]
+            dt = time.perf_counter() - t0
+            return (dt, [t for t, _ in outs],
+                    [e for _, e in outs if e is not None])
+
+        plain = DecodeModel(name="llama_decode_bench_plain")
+        # the watchdog arms from env at construction — plain is already
+        # built, so only the armed instance pays for readback watching
+        # (30 s stall bar: bookkeeping cost without ever tripping on CPU)
+        os.environ["TRITON_TPU_TICK_STALL_MS"] = "30000"
+        armed = DecodeModel(name="llama_decode_bench_armed")
+        mgr = DeviceFaultManager(threshold=100)
+        armed.attach_device_faults(mgr)
+        # rate=0: the seeded draw is consulted at every dispatch boundary
+        # and never fires — this IS the steady-state consult cost
+        armed.attach_chaos(ChaosInjector(rate=0.0, kinds=["device_error"],
+                                         seed=1))
+        cohort(plain)  # compile warm off-clock (prefill + fused tick)
+        _, want, werr = cohort(armed)
+        if werr:
+            out["warm_error"] = str(werr[0])[:120]
+            return out
+
+        plain_best = armed_best = None  # (tok_per_s, dt)
+        for _ in range(ROUNDS):
+            for tag, m in (("plain", plain), ("armed", armed)):
+                dt, _toks, errs = cohort(m)
+                if errs:
+                    out[f"{tag}_error"] = str(errs[0])[:120]
+                    continue
+                tps = round(SLOTS * N_TOK / dt, 1)
+                if tag == "plain" and (plain_best is None
+                                       or tps > plain_best[0]):
+                    plain_best = (tps, dt)
+                if tag == "armed" and (armed_best is None
+                                       or tps > armed_best[0]):
+                    armed_best = (tps, dt)
+        if plain_best:
+            out["plain_tok_per_s"] = plain_best[0]
+        if armed_best:
+            out["armed_tok_per_s"] = armed_best[0]
+            out["armed_clean_cohort_ms"] = round(armed_best[1] * 1e3, 1)
+        if plain_best and armed_best:
+            out["containment_overhead_pct"] = round(
+                100.0 * (1.0 - armed_best[0] / plain_best[0]), 1)
+
+        # the drill: one seeded transient fault against a live cohort
+        armed.attach_chaos(ChaosInjector(rate=1.0, kinds=["device_error"],
+                                         seed=5, max_faults=1))
+        dt, toks, errs = cohort(armed)
+        snap = mgr.snapshot()
+        drill = {
+            "cohort_ms": round(dt * 1e3, 1),
+            "injected": armed._chaos.injected_total,
+            "recovered": snap["recovered"].get(
+                "llama_decode_bench_armed", 0),
+            "aborted": snap.get("aborted", {}),
+            "caller_errors": len(errs),
+            "bit_identical": toks == want,
+        }
+        if armed_best:
+            drill["recovery_added_ms"] = round(
+                (dt - armed_best[1]) * 1e3, 1)
+        out["drill"] = drill
+        out["metric"] = "device_fault_recovery_added_ms"
+        out["value"] = drill.get("recovery_added_ms")
+        out["unit"] = "ms_wallclock_vs_armed_clean_cohort"
+    except Exception as e:  # noqa: BLE001 — robustness leg never kills bench
+        out["device_fault_recovery_error"] = str(e)[:120]
+    finally:
+        for m in (plain, armed):
+            if m is not None:
+                try:
+                    m._shutdown()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def _measure_cost_attribution_overhead(core, sweep, inputs_fn) -> dict:
     """Cost-ledger fast-path cost: the same closed-loop window with the
     always-on per-tenant attribution (ledger charge per execute + slot-

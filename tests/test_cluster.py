@@ -581,6 +581,78 @@ class TestFailover:
                       timeout=15.0, msg="probe recovery")
 
 
+# -- e2e: quarantine reroute --------------------------------------------------
+
+class TestQuarantineReroute:
+    def test_quarantined_replicas_are_routed_around(self, cluster):
+        """Device-fault containment: replicas whose model is quarantined
+        refuse with the typed 503 ('quarantined' marker); the client
+        classifies it retryable-with-reroute — even under the DEFAULT
+        non-idempotent-infer policy (retry_infer=False) — and the retry
+        excludes the refusing endpoint, so the request lands on the
+        healthy replica with zero caller-visible errors."""
+        urls = cluster.http_urls
+        healthy_idx = 2
+        for i, h in enumerate(cluster.harnesses):
+            if i != healthy_idx:
+                h.core.device_faults.quarantine(MODEL, "drill")
+        try:
+            with ClusterClient(
+                    urls, protocol="http", policy="round_robin",
+                    retry_policy=RetryPolicy(max_attempts=3,
+                                             initial_backoff_s=0.01,
+                                             seed=0)) as c:
+                picks = []
+                orig_pick = c._pool.pick
+
+                def spy(*args, **kwargs):
+                    ep = orig_pick(*args, **kwargs)
+                    picks.append((tuple(kwargs.get("exclude", ())),
+                                  ep.url))
+                    return ep
+
+                c._pool.pick = spy
+                x = _x()
+                rerouted = 0
+                for _ in range(4):
+                    picks.clear()
+                    r = c.infer(MODEL, _inputs(x))
+                    np.testing.assert_array_equal(
+                        r.as_numpy("OUTPUT0"), x)
+                    # every attempt that followed a quarantine refusal
+                    # excluded the refusing endpoint, and the serving
+                    # attempt landed on the healthy replica
+                    for (excluded, _), (_, prev_url) in zip(picks[1:],
+                                                            picks):
+                        assert prev_url in excluded
+                    assert picks[-1][1] == urls[healthy_idx]
+                    rerouted += len(picks) > 1
+                # round-robin over 4 requests offered quarantined
+                # replicas at least once — the reroute actually fired
+                # (not every first pick was lucky)
+                assert rerouted >= 1
+        finally:
+            for h in cluster.harnesses:
+                h.core.device_faults.unquarantine(MODEL)
+
+    def test_all_replicas_quarantined_fails_typed(self, cluster):
+        for h in cluster.harnesses:
+            h.core.device_faults.quarantine(MODEL, "drill")
+        try:
+            with ClusterClient(
+                    urls := cluster.http_urls, protocol="http",
+                    retry_policy=RetryPolicy(max_attempts=3,
+                                             initial_backoff_s=0.01,
+                                             seed=0)) as c:
+                with pytest.raises(InferenceServerException) as e:
+                    c.infer(MODEL, _inputs(_x()))
+                assert "quarantined" in str(e.value)
+            assert urls  # fleet-wide outage surfaces, never hangs
+        finally:
+            for h in cluster.harnesses:
+                h.core.device_faults.unquarantine(MODEL)
+
+
 # -- e2e: hedged requests ----------------------------------------------------
 
 class TestHedging:

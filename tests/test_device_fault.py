@@ -1,0 +1,450 @@
+"""Device-fault containment: seeded dispatch fault injection, in-flight
+generation recovery, and model quarantine.
+
+Three layers under test:
+
+* ``DeviceFaultManager`` (server/core.py) — the K-faults-in-window
+  quarantine state machine with probing, doubling backoff, and one-shot
+  supervisor escalation (unit, no device work).
+* The batched decode worker's recovery path (models/decode.py) — a
+  seeded ``device_error`` genuinely invalidates the donated bucket
+  buffers mid-generation; live server-side generations hand off to the
+  recovery queue and re-prefill ``prompt + emitted_so_far``, so the
+  resumed greedy stream is BIT-IDENTICAL to an undisturbed run (the
+  acceptance drill), bounded by ``TRITON_TPU_RECOVERY_BUDGET``.
+* The admission surface (ServerHarness) — a quarantined model is
+  not-ready on the wire and sheds with a typed retryable 503 whose
+  message carries the ``quarantined`` marker the client resilience
+  layer classifies on.
+
+Determinism: every drill is seeded (``ChaosInjector(rate=1.0,
+max_faults=N)`` fires exactly the first N dispatch boundaries) or
+counted (the Nth-dispatch stub); nothing asserts on a probabilistic
+draw.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import triton_client_tpu.http as httpclient  # noqa: E402
+from triton_client_tpu._resilience import is_quarantine_error  # noqa: E402
+from triton_client_tpu.models import zoo  # noqa: E402
+from triton_client_tpu.server import ModelRegistry  # noqa: E402
+from triton_client_tpu.server.chaos import (ChaosDeviceError,  # noqa: E402
+                                            ChaosInjector)
+from triton_client_tpu.server.core import DeviceFaultManager  # noqa: E402
+from triton_client_tpu.server.testing import ServerHarness  # noqa: E402
+from triton_client_tpu.server.types import InferError  # noqa: E402
+from triton_client_tpu.utils import InferenceServerException  # noqa: E402
+
+MODEL = "llama_decode_fault"
+
+
+def _poll(predicate, timeout_s=10.0, interval_s=0.01, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        if time.monotonic() > deadline:
+            pytest.fail(f"timed out waiting for {what}")
+        time.sleep(interval_s)
+
+
+# -- unit: the quarantine state machine -------------------------------------
+
+class TestDeviceFaultManager:
+    def test_k_faults_in_window_trip_quarantine(self):
+        mgr = DeviceFaultManager(threshold=3, window_s=30.0)
+        assert not mgr.record_fault("m", "step")
+        assert not mgr.record_fault("m", "step")
+        assert not mgr.is_quarantined("m")
+        assert mgr.record_fault("m", "step")
+        assert mgr.is_quarantined("m")
+
+    def test_window_slides(self):
+        mgr = DeviceFaultManager(threshold=2, window_s=0.05)
+        mgr.record_fault("m", "step")
+        time.sleep(0.12)
+        assert not mgr.record_fault("m", "step")
+        assert not mgr.is_quarantined("m")
+
+    def test_force_quarantine_bypasses_threshold(self):
+        mgr = DeviceFaultManager(threshold=100)
+        assert mgr.record_fault("m", "tick_stall", force_quarantine=True)
+        assert mgr.is_quarantined("m")
+
+    def test_models_quarantine_independently(self):
+        mgr = DeviceFaultManager(threshold=1)
+        mgr.record_fault("a", "step")
+        assert mgr.is_quarantined("a")
+        assert not mgr.is_quarantined("b")
+
+    def test_unquarantine_resets_the_window(self):
+        """Stale pre-quarantine faults must not instantly re-trip after a
+        release — a fresh fault starts a fresh window."""
+        mgr = DeviceFaultManager(threshold=2)
+        mgr.record_fault("m", "step")
+        mgr.record_fault("m", "step")
+        assert mgr.is_quarantined("m")
+        mgr.unquarantine("m")
+        assert not mgr.record_fault("m", "step")
+        assert not mgr.is_quarantined("m")
+
+    def test_retry_in_floor_and_horizon(self):
+        mgr = DeviceFaultManager(threshold=1, probe_backoff_s=5.0)
+        assert mgr.retry_in("m") == 0.05  # not quarantined: floor only
+        mgr.quarantine("m", "drill")
+        assert 0.05 <= mgr.retry_in("m") <= 5.0
+
+    def test_probe_success_unquarantines(self):
+        mgr = DeviceFaultManager(threshold=1, probe_backoff_s=0.01)
+        mgr.register_probe("m", lambda: True)
+        mgr.quarantine("m", "drill")
+        _poll(lambda: (mgr.maybe_probe(time.monotonic() + 10.0),
+                       not mgr.is_quarantined("m"))[-1],
+              what="probe release")
+
+    def test_probe_failure_backoff_doubles_and_escalates_once(self):
+        escalations = []
+        mgr = DeviceFaultManager(threshold=1, probe_backoff_s=0.01,
+                                 probe_backoff_max_s=0.04,
+                                 escalate_after=2)
+        mgr.escalation_cb = lambda model, state: escalations.append(
+            (model, state["probes_failed"]))
+        mgr.register_probe("m", lambda: False)
+        mgr.quarantine("m", "drill")
+        for want_failed in (1, 2, 3):
+            _poll(lambda n=want_failed: (
+                mgr.maybe_probe(time.monotonic() + 10.0),
+                mgr.snapshot()["quarantined"]["m"]["probes_failed"] >= n,
+            )[-1], what=f"probe failure {want_failed}")
+        state = mgr.snapshot()["quarantined"]["m"]
+        assert state["escalated"]
+        assert state["backoff_s"] == 0.04  # 0.01 -> 0.02 -> 0.04 (capped)
+        assert escalations == [("m", 2)]  # once per episode, at the Nth
+
+    def test_unprobed_model_releases_optimistically(self):
+        """No probe wired: a timed release — flap is bounded by the
+        K-in-window detector re-tripping, never unbounded."""
+        mgr = DeviceFaultManager(threshold=1, probe_backoff_s=0.01)
+        mgr.quarantine("m", "drill")
+        mgr.maybe_probe(time.monotonic() + 10.0)
+        assert not mgr.is_quarantined("m")
+
+    def test_metric_rows_surface_every_family(self):
+        mgr = DeviceFaultManager(threshold=1)
+        mgr.record_fault("m", "prefill")
+        mgr.record_fault("m", "step")
+        mgr.record_recovered("m", 3)
+        mgr.record_aborted("m")
+        rows = mgr.metric_rows()
+        assert ({"model": "m", "kind": "prefill"}, 1.0) in rows["device_fault"]
+        assert ({"model": "m", "kind": "step"}, 1.0) in rows["device_fault"]
+        assert rows["device_recovered"] == [({"model": "m"}, 3.0)]
+        assert rows["device_aborted"] == [({"model": "m"}, 1.0)]
+        assert rows["device_quarantine"] == [({"model": "m"}, 1.0)]
+        mgr.unquarantine("m")
+        # the 0/1 gauge row persists after release: the flip is visible
+        assert mgr.metric_rows()["device_quarantine"] == [({"model": "m"},
+                                                           0.0)]
+
+
+# -- unit: the chaos kind ---------------------------------------------------
+
+class TestDeviceErrorKind:
+    def test_dispatch_plane_only(self):
+        """``device_error`` never fires from per-request ``decide`` — it
+        is consumed at the decode worker's dispatch boundaries."""
+        inj = ChaosInjector(rate=1.0, kinds=["device_error"], seed=1)
+        assert inj.decide("m") is None
+        assert inj.maybe_device_fault("m")
+        assert inj.injected_total == 1
+
+    def test_max_faults_bounds_the_drill(self):
+        inj = ChaosInjector(rate=1.0, kinds=["device_error"], seed=1,
+                            max_faults=2)
+        draws = [inj.maybe_device_fault("m") for _ in range(5)]
+        assert draws == [True, True, False, False, False]
+
+    def test_error_shape_matches_a_real_xla_failure(self):
+        e = ChaosDeviceError("m")
+        assert not isinstance(e, InferError)
+        assert "Failed to execute XLA computation" in str(e)
+        assert "device_error" in str(e) and "'m'" in str(e)
+
+
+# -- admission surface: quarantined model on the wire -----------------------
+
+class TestQuarantineAdmission:
+    @pytest.fixture(scope="class")
+    def harness(self):
+        registry = ModelRegistry()
+        registry.register_model(zoo.make_custom_identity_int32())
+        h = ServerHarness(registry)
+        h.start()
+        yield h
+        h.stop()
+
+    @staticmethod
+    def _infer(harness):
+        x = np.arange(4, dtype=np.int32).reshape(1, 4)
+        i = httpclient.InferInput("INPUT0", list(x.shape), "INT32")
+        i.set_data_from_numpy(x)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            return c.infer("custom_identity_int32", [i])
+
+    def test_typed_refusal_then_release(self, harness):
+        faults = harness.core.device_faults
+        name = "custom_identity_int32"
+        faults.quarantine(name, "drill")
+        try:
+            with httpclient.InferenceServerClient(harness.http_url) as c:
+                assert not c.is_model_ready(name)
+            with pytest.raises(InferenceServerException) as e:
+                self._infer(harness)
+            # the typed retryable refusal the client reroutes on: the
+            # 'quarantined' marker is exactly what is_quarantine_error
+            # classifies
+            assert "quarantined" in str(e.value)
+            assert is_quarantine_error(e.value)
+        finally:
+            faults.unquarantine(name)
+        with httpclient.InferenceServerClient(harness.http_url) as c:
+            assert c.is_model_ready(name)
+        self._infer(harness)  # serves again after release
+
+
+# -- the decode worker's recovery path --------------------------------------
+
+class _NthDispatchFault:
+    """Injector stub: ``maybe_device_fault`` fires exactly on the Nth
+    dispatch-boundary consult — the deterministic way to land a fault
+    mid-stream (after specific ticks) rather than on the first prefill."""
+
+    def __init__(self, n):
+        self.n = int(n)
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def maybe_device_fault(self, model_name):
+        with self._lock:
+            self.calls += 1
+            return self.calls == self.n
+
+
+def _drain(sink):
+    """Collect a generation stream: (tokens, errors). An exception is
+    terminal on the stream — mirror the generate layer's contract."""
+    toks, errs = [], []
+    while True:
+        item = sink.get(timeout=300)
+        if item is None:
+            return toks, errs
+        if isinstance(item, Exception):
+            errs.append(item)
+            return toks, errs
+        toks.append(int(item[0]))
+
+
+def _prompt_window(seed_tokens):
+    win = np.zeros((1, 128), np.int32)
+    win[0, -len(seed_tokens):] = seed_tokens
+    return win
+
+
+class TestGenerationRecovery:
+    @pytest.fixture()
+    def dec(self, monkeypatch):
+        from triton_client_tpu.models.decode import DecodeModel
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        monkeypatch.delenv("TRITON_TPU_DECODE_BUCKETS", raising=False)
+        monkeypatch.delenv("TRITON_TPU_RECOVERY_BUDGET", raising=False)
+        monkeypatch.delenv("TRITON_TPU_TICK_STALL_MS", raising=False)
+        m = DecodeModel(name=MODEL)
+        yield m
+        m._shutdown()
+
+    def test_seeded_transient_fault_cohort_is_bit_identical(self, dec):
+        """THE acceptance drill: a seeded transient device_error against
+        a batched cohort — every server-side generation recovers and the
+        streams are byte-identical to an undisturbed run, with zero
+        caller-visible errors."""
+        win = _prompt_window([7, 11, 13, 17, 19])
+        want, errs = _drain(dec.submit_generation(win, 6))
+        assert len(want) == 6 and not errs
+
+        mgr = DeviceFaultManager(threshold=100)
+        dec.attach_device_faults(mgr)
+        dec.attach_chaos(ChaosInjector(rate=1.0, kinds=["device_error"],
+                                       seed=5, max_faults=1))
+        sinks = [dec.submit_generation(win, 6) for _ in range(4)]
+        outs = [_drain(s) for s in sinks]
+        assert dec._chaos.injected_total == 1  # the drill actually fired
+        for toks, errs in outs:
+            assert not errs  # zero caller-visible errors
+            assert toks == want  # bit-identical resumed streams
+        snap = mgr.snapshot()
+        assert snap["recovered"].get(MODEL, 0) >= 1
+        assert snap["aborted"] == {}
+        assert not mgr.is_quarantined(MODEL)  # one blip != quarantine
+
+    def test_mid_stream_fault_resumes_the_emitted_prefix(self, dec,
+                                                         monkeypatch):
+        """Fault on a TICK (tokens already streamed): recovery re-prefills
+        prompt + emitted_so_far and the resumed tail matches the
+        undisturbed stream exactly — greedy decode is deterministic in
+        the token prefix."""
+        monkeypatch.setenv("TRITON_TPU_DECODE_STEPS", "1")
+        win = _prompt_window([3, 5, 2, 9])
+        want, errs = _drain(dec.submit_generation(win, 8))
+        assert len(want) == 8 and not errs
+
+        mgr = DeviceFaultManager(threshold=100)
+        dec.attach_device_faults(mgr)
+        stub = _NthDispatchFault(3)  # prefill, tick, FAULT on tick 2
+        dec.attach_chaos(stub)
+        toks, errs = _drain(dec.submit_generation(win, 8))
+        assert stub.calls >= 3  # the targeted tick consult happened
+        assert not errs
+        assert toks == want
+        assert mgr.snapshot()["recovered"].get(MODEL, 0) == 1
+
+    def test_recovery_budget_exhaustion_is_a_typed_500(self, monkeypatch):
+        from triton_client_tpu.models.decode import DecodeModel
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        monkeypatch.delenv("TRITON_TPU_DECODE_BUCKETS", raising=False)
+        monkeypatch.setenv("TRITON_TPU_RECOVERY_BUDGET", "1")
+        dec = DecodeModel(name=MODEL)
+        try:
+            mgr = DeviceFaultManager(threshold=100)
+            dec.attach_device_faults(mgr)
+            # persistent: the original prefill AND the one budgeted
+            # recovery re-prefill both fault
+            dec.attach_chaos(ChaosInjector(rate=1.0, kinds=["device_error"],
+                                           seed=2, max_faults=10))
+            toks, errs = _drain(dec.submit_generation(
+                _prompt_window([1, 2, 3]), 5))
+            assert toks == []
+            assert len(errs) == 1
+            assert isinstance(errs[0], InferError)
+            assert errs[0].http_status == 500
+            assert "recovery budget" in str(errs[0])
+            assert mgr.snapshot()["aborted"] == {MODEL: 1}
+        finally:
+            dec._shutdown()
+
+    def test_persistent_fault_quarantines_then_probe_releases(self, dec):
+        """The full lifecycle: repeated dispatch faults trip the K-in-
+        window detector mid-recovery (containment keeps recovering WHILE
+        quarantined — admission is what quarantine gates, not the
+        worker), the drained injector lets the last re-prefill land, and
+        a probe dispatch un-quarantines."""
+        win = _prompt_window([4, 8, 15, 16, 23, 42])
+        want, errs = _drain(dec.submit_generation(win, 5))
+        assert len(want) == 5 and not errs
+
+        mgr = DeviceFaultManager(threshold=2, probe_backoff_s=0.01,
+                                 probe_backoff_max_s=0.1)
+        dec.attach_device_faults(mgr)
+        dec.attach_chaos(ChaosInjector(rate=1.0, kinds=["device_error"],
+                                       seed=3, max_faults=3))
+        toks, errs = _drain(dec.submit_generation(win, 5))
+        # 3 faults: original prefill + 2 recovery re-prefills; the 4th
+        # attempt rides a dry injector and completes — still within the
+        # default recovery budget (3), still bit-identical
+        assert not errs and toks == want
+        assert mgr.is_quarantined(MODEL)  # tripped at the 2nd fault
+        assert mgr.snapshot()["faults"] == {f"{MODEL}/prefill": 3}
+        # probe path: the injector is dry, so the registered probe
+        # dispatch succeeds and releases the model
+        _poll(lambda: (mgr.maybe_probe(time.monotonic() + 10.0),
+                       not mgr.is_quarantined(MODEL))[-1],
+              what="probe un-quarantine")
+
+    def test_unrebuildable_cache_escalates_straight_to_quarantine(
+            self, dec, monkeypatch):
+        """Satellite: the old except tail in _rebuild_bucket_cache
+        swallowed rebuild failures into a silent model close; now a model
+        that cannot restore a sane cache quarantines (readiness flips,
+        incident fires) before closing."""
+        mgr = DeviceFaultManager(threshold=100)
+        dec.attach_device_faults(mgr)
+        # warm: the initial slab build must use the real allocator — only
+        # the REBUILD after the injected fault is made to fail
+        toks, errs = _drain(dec.submit_generation(
+            _prompt_window([2, 4]), 2))
+        assert len(toks) == 2 and not errs
+        dec.attach_chaos(_NthDispatchFault(1))
+
+        def boom(cnt, cap, cfg):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of HBM")
+
+        monkeypatch.setattr(dec, "_new_cache_arrays", boom)
+        toks, errs = _drain(dec.submit_generation(
+            _prompt_window([6, 6, 6]), 4))
+        assert errs  # the stream fails closed, never hangs
+        assert mgr.is_quarantined(MODEL)
+        snap = mgr.snapshot()
+        assert f"{MODEL}/rebuild" in snap["faults"]
+        assert "out of HBM" in snap["quarantined"][MODEL]["reason"]
+        with pytest.raises(InferError):
+            dec.submit_generation(_prompt_window([1]), 2)
+
+    def test_tick_stall_watchdog_quarantines_a_wedged_readback(
+            self, monkeypatch):
+        """The watchdog cannot kill a wedged dispatch (no host-side XLA
+        cancel exists) — what it guarantees is forced quarantine + the
+        fault record WHILE the dispatch is stuck."""
+        from triton_client_tpu.models.decode import DecodeModel
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        monkeypatch.delenv("TRITON_TPU_DECODE_BUCKETS", raising=False)
+        monkeypatch.setenv("TRITON_TPU_TICK_STALL_MS", "60")
+        dec = DecodeModel(name=MODEL)
+        try:
+            mgr = DeviceFaultManager(threshold=100)
+            dec.attach_device_faults(mgr)
+            # a real generation arms the worker + watchdog threads; its
+            # readbacks resolve fast, so none of THEM trip the sweep
+            toks, errs = _drain(dec.submit_generation(
+                _prompt_window([9, 9]), 3))
+            assert len(toks) == 3 and not errs
+            assert not mgr.is_quarantined(MODEL)
+            # simulate the wedge: a registered readback that never
+            # resolves (backdated past the stall bound)
+            with dec._watch_lock:
+                dec._watched[999999] = [time.monotonic() - 10.0, "tick",
+                                        False]
+            _poll(lambda: mgr.is_quarantined(MODEL), timeout_s=5.0,
+                  what="tick-stall quarantine")
+            snap = mgr.snapshot()
+            assert f"{MODEL}/tick_stall" in snap["faults"]
+            assert "cannot be killed" in snap["quarantined"][MODEL]["reason"]
+        finally:
+            dec._unwatch_readback(999999)
+            dec._shutdown()
+
+    def test_generate_alias_quarantines_with_the_decode_worker(self, dec):
+        """The generate wrapper serves the same worker under its own
+        model name: a fault on the shared worker quarantines BOTH names
+        (a client rerouting on either sees consistent readiness)."""
+        from triton_client_tpu.models.decode import GenerateModel
+
+        gen = GenerateModel(dec, name="llama_generate_fault")
+        mgr = DeviceFaultManager(threshold=1)
+        # the core attaches through the generate wrapper's model facade
+        gen.model.attach_device_faults(mgr)
+        dec.attach_chaos(_NthDispatchFault(1))
+        toks, errs = _drain(dec.submit_generation(
+            _prompt_window([5, 5, 5]), 4))
+        assert not errs  # recovered as usual
+        assert mgr.is_quarantined(MODEL)
+        assert mgr.is_quarantined("llama_generate_fault")

@@ -281,7 +281,7 @@ _RECORD_KEYS = {"seq", "request_id", "model", "version", "protocol",
                 "batch", "bytes_in", "bytes_out", "ts", "queue_us",
                 "compute_us", "total_us", "outcome", "captured",
                 "capture_reason", "chaos", "tenant", "tier", "tick",
-                "shed_reason", "cost"}
+                "shed_reason", "cost", "fault", "recovered"}
 _TOP_LEVEL_KEYS = {"enabled", "capture_slower_than", "ring_capacity",
                    "outlier_capacity", "recorded_total", "models",
                    "recent", "outliers"}
@@ -544,6 +544,7 @@ class TestTritonTop:
                 "burn_1h", "slo_breach", "instances", "version",
                 "scaled", "mem_pct", "mem_shed_per_s",
                 "host_lag_ms", "gc_ms_per_s",
+                "fault_per_s", "quarantined",
                 "last_outlier"} == set(row)
         # fleet columns materialize from the nv_fleet_* series: the
         # harness server exports a serving version for every model

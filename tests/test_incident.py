@@ -478,4 +478,4 @@ class TestReportTool:
         # the HTTP handler and CLI validate against this tuple; pin it
         assert TRIGGER_CLASSES == ("slo_burn", "worker_crash",
                                    "watchdog_storm", "chaos", "sigusr2",
-                                   "manual")
+                                   "manual", "device_fault")

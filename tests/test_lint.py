@@ -1341,6 +1341,24 @@ class TestDeviceSync:
             """)
         assert lint_dir(tmp_path, "DEVICE-SYNC") == []
 
+    def test_recovery_and_watchdog_paths_are_in_scope(self, tmp_path):
+        # ISSUE 19: the device-fault recovery handoff and the readback
+        # watchdog interleave with live ticks on the worker/gen-reader
+        # threads — a blocking sync there stalls every in-flight
+        # generation, so the rule covers them
+        write(tmp_path, "models/decode.py", """
+            import numpy as np
+            class DecodeModel:
+                def _recover_handoff(self, sink):
+                    return np.asarray(sink.window)
+                def _watch_readback(self, kind):
+                    return np.array([1])
+                def _maybe_inject_device_fault(self, b):
+                    self._k[b].block_until_ready()
+            """)
+        found = lint_dir(tmp_path, "DEVICE-SYNC")
+        assert sorted(fd.line for fd in found) == [5, 7, 9]
+
     def test_repo_resolve_pragma_is_load_bearing(self):
         # strip the pragma from the repo's own finish_readback and the
         # rule must fire — the contract is suppressed-by-reason, not

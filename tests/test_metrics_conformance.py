@@ -555,6 +555,76 @@ class TestFleetSurface:
             assert snap[fam]["type"] == families[fam]["type"], fam
 
 
+class TestDeviceFaultSurface:
+    """The nv_device_* families (device-fault containment) parse under
+    the exposition grammar, are typed, carry their label sets including
+    adversarial model names, and round-trip through the JSON snapshot."""
+
+    EVIL = 'evil"fault\\model\nname'
+
+    def _drive_faults(self, server):
+        faults = server.core.device_faults
+        faults.record_fault(self.EVIL, "prefill", reason="drill")
+        faults.record_fault(self.EVIL, "step", reason="drill")
+        faults.record_recovered(self.EVIL, 2)
+        faults.record_aborted(self.EVIL)
+        faults.quarantine(self.EVIL, "drill")
+        return faults
+
+    def test_families_typed_labeled_and_round_trip(self, server):
+        from triton_client_tpu.server.metrics import snapshot
+
+        faults = self._drive_faults(server)
+        try:
+            families = assert_conformant(_scrape(server.http_url))
+            for fam, kind in (
+                    ("nv_device_fault_total", "counter"),
+                    ("nv_device_recovered_sequences_total", "counter"),
+                    ("nv_device_aborted_sequences_total", "counter"),
+                    ("nv_device_quarantine", "gauge")):
+                assert families[fam]["type"] == kind, fam
+
+            def unescape(v):
+                return (v.replace("\\n", "\n").replace('\\"', '"')
+                        .replace("\\\\", "\\"))
+
+            fault_rows = {(unescape(l["model"]), l["kind"]): v for _, l, v in
+                          families["nv_device_fault_total"]["samples"]}
+            assert fault_rows[(self.EVIL, "prefill")] == 1.0
+            assert fault_rows[(self.EVIL, "step")] == 1.0
+            recovered = {unescape(l["model"]): v for _, l, v in
+                         families["nv_device_recovered_sequences_total"]
+                         ["samples"]}
+            assert recovered[self.EVIL] == 2.0
+            aborted = {unescape(l["model"]): v for _, l, v in
+                       families["nv_device_aborted_sequences_total"]
+                       ["samples"]}
+            assert aborted[self.EVIL] == 1.0
+            quar = {unescape(l["model"]): v for _, l, v in
+                    families["nv_device_quarantine"]["samples"]}
+            assert quar[self.EVIL] == 1.0
+            # JSON snapshot parity (same families, same types)
+            snap = snapshot(server.core)
+            for fam in ("nv_device_fault_total",
+                        "nv_device_recovered_sequences_total",
+                        "nv_device_aborted_sequences_total",
+                        "nv_device_quarantine"):
+                assert snap[fam]["type"] == families[fam]["type"], fam
+        finally:
+            faults.unquarantine(self.EVIL)
+
+    def test_quarantine_gauge_flips_to_zero_on_release(self, server):
+        faults = self._drive_faults(server)
+        faults.unquarantine(self.EVIL)
+        families = assert_conformant(_scrape(server.http_url))
+        quar = {l["model"].replace("\\n", "\n").replace('\\"', '"')
+                .replace("\\\\", "\\"): v for _, l, v in
+                families["nv_device_quarantine"]["samples"]}
+        # the row PERSISTS at 0 after release — the flip is observable,
+        # not a vanished series
+        assert quar[self.EVIL] == 0.0
+
+
 class TestClientSurface:
     def test_grammar_and_naming(self, server):
         telemetry().reset()

@@ -142,6 +142,40 @@ class TestRetryPolicy:
             status="StatusCode.RESOURCE_EXHAUSTED")
         assert p.should_retry(plain_re, method="infer", attempt=1)
 
+    def test_quarantine_retryable_with_reroute(self):
+        """Device-fault containment satellite: a quarantine refusal (503 /
+        UNAVAILABLE whose message carries the 'quarantined' marker) is
+        retryable even for non-idempotent infer under the DEFAULT policy
+        — the refusal happened at admission, before any compute, so the
+        idempotency concern behind the retry_infer gate does not apply;
+        the retry belongs on ANOTHER replica (ClusterClient excludes the
+        refusing endpoint)."""
+        from triton_client_tpu._resilience import is_quarantine_error
+
+        http_quar = InferenceServerException(
+            "model 'm' is quarantined after repeated device faults; "
+            "retry on another replica", status="503")
+        grpc_quar = InferenceServerException(
+            "model 'm' is quarantined after repeated device faults; "
+            "retry on another replica", status="StatusCode.UNAVAILABLE")
+        p = RetryPolicy(max_attempts=3)  # retry_infer defaults to False
+        for e in (http_quar, grpc_quar):
+            assert is_quarantine_error(e)
+            assert p.should_retry(e, method="infer", attempt=1)
+        # ... unlike an ordinary 503 shed, which the gate still blocks
+        plain = InferenceServerException("server busy", status="503")
+        assert not is_quarantine_error(plain)
+        assert not p.should_retry(plain, method="infer", attempt=1)
+        # the marker alone is not enough: a non-retryable status class
+        # stays non-retryable (a 500 mentioning quarantine is a bug
+        # report, not a reroute hint)
+        wrong_status = InferenceServerException(
+            "model 'm' is quarantined", status="500")
+        assert not is_quarantine_error(wrong_status)
+        assert not p.should_retry(wrong_status, method="infer", attempt=1)
+        # attempt budget still caps quarantine retries
+        assert not p.should_retry(http_quar, method="infer", attempt=3)
+
     def test_idempotency_default_blocks_infer(self):
         e = InferenceServerException("x", status="503")
         assert not RetryPolicy().should_retry(e, method="infer", attempt=1)

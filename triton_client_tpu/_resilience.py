@@ -45,6 +45,7 @@ __all__ = [
     "deadline_exceeded_error",
     "is_connection_error",
     "is_oversize_error",
+    "is_quarantine_error",
     "normalized_status",
 ]
 
@@ -82,6 +83,30 @@ def is_oversize_error(exc: BaseException) -> bool:
         msg = str(exc).lower()
         return any(marker in msg for marker in _OVERSIZE_MSG_MARKERS)
     return False
+
+
+#: Message markers of a device-fault quarantine refusal (the server's
+#: typed 503 / gRPC UNAVAILABLE while a model is quarantined after
+#: repeated device faults — server/core.py stamps the message).
+_QUARANTINE_MSG_MARKERS = (
+    "quarantined",
+)
+
+
+def is_quarantine_error(exc: BaseException) -> bool:
+    """True when ``exc`` is a device-fault quarantine refusal: the server
+    shed the request BEFORE any compute because the model's device is
+    sick (503 / UNAVAILABLE whose message carries the ``quarantined``
+    marker).  Always safe to retry — even for non-idempotent ``infer``
+    calls, since nothing executed — and the right retry is on ANOTHER
+    endpoint: the cluster client's failure hook excludes the quarantined
+    replica so the next attempt reroutes (the mirror image of
+    :func:`is_oversize_error`, which is never retryable anywhere)."""
+    status = normalized_status(exc)
+    if status not in ("503", "UNAVAILABLE"):
+        return False
+    msg = str(exc).lower()
+    return any(marker in msg for marker in _QUARANTINE_MSG_MARKERS)
 
 #: Exception class names (anywhere in the MRO) classified as connection-level
 #: failures — retryable without a status code.  Name-based so this module
@@ -202,6 +227,13 @@ class RetryPolicy:
         ("infer" / "health" / "metadata") may be retried."""
         if attempt >= self.max_attempts:
             return False
+        if is_quarantine_error(exc):
+            # checked BEFORE the retry_infer gate: a quarantine refusal
+            # is a pre-compute shed (nothing executed server-side), so
+            # retrying is safe even for non-idempotent infer calls — and
+            # the cluster client's on_failure exclusion makes the retry
+            # land on a healthy replica instead of the sick device
+            return True
         if method == "infer" and not self.retry_infer:
             return False
         if is_oversize_error(exc):

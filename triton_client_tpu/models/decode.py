@@ -1020,6 +1020,12 @@ class DecodeModel:
             def attach_cost_ledger(inner, ledger):
                 outer.attach_cost_ledger(ledger)
 
+            def attach_device_faults(inner, mgr):
+                outer.attach_device_faults(mgr, inner.config.name)
+
+            def attach_chaos(inner, injector):
+                outer.attach_chaos(injector)
+
         self._model = _Impl(cfg)
         # device/scheduler observability sink (attach_device_stats): the
         # worker records one nv_tpu_tick_* row per fused dispatch into it
@@ -1030,6 +1036,31 @@ class DecodeModel:
         # per-tenant attribution sink (attach_cost_ledger): the worker
         # charges each slot its share of every tick's compute window
         self._cost_ledger = None
+        # device-fault containment sink (attach_device_faults): failed
+        # dispatches and recoveries report into the core's manager, which
+        # runs the quarantine state machine.  The shared worker serves
+        # both the sequence-protocol name and the generate alias —
+        # _fault_names carries every attached alias so a fault
+        # quarantines (and a probe releases) both together.
+        self._fault_mgr = None
+        self._fault_names: list = [name]
+        # seeded chaos injector (attach_chaos): consulted at dispatch
+        # boundaries for device_error drills
+        self._chaos = None
+        self._probe_fn = None
+        # bounded per-sequence recovery budget: re-prefill attempts per
+        # generation before it gets the pre-containment typed 500
+        self._recovery_budget = int(os.environ.get(
+            "TRITON_TPU_RECOVERY_BUDGET", "3"))
+        # tick-stall watchdog (armed in _ensure_fns when
+        # TRITON_TPU_TICK_STALL_MS / --tick-stall-ms is set): in-flight
+        # readbacks register here; one that resolves too slowly is
+        # reported as a device fault (see _watchdog_loop for the honest
+        # limits of what the host can do about a wedged dispatch)
+        self._stall_s = 0.0
+        self._watch_lock = threading.Lock()
+        self._watched: Dict[int, list] = {}
+        self._watch_seq = 0
         # slot -> tenant / governor KV-pin handle for every busy slot
         # (written under self._lock at admission, popped at release);
         # bucket -> fused-dispatch SignatureCost, False once analysis
@@ -1097,6 +1128,221 @@ class DecodeModel:
         sum to the tick window by construction, so the ledger reconciles
         with the duty-cycle compute total."""
         self._cost_ledger = ledger
+
+    def attach_device_faults(self, mgr, name: str = None) -> None:
+        """Attach the serving core's ``DeviceFaultManager`` (idempotent
+        attribute stamp, like ``attach_device_stats``).  Every failed
+        dispatch then reports a fault (K-in-window → quarantine), every
+        recovered generation a recovery, and the manager gets a probe
+        callback that issues a real dispatch against the rebuilt cache
+        to un-quarantine.  ``name`` registers an alias (the generate
+        wrapper serves the same worker under its own model name): faults
+        quarantine every alias together."""
+        if name and name not in self._fault_names:
+            self._fault_names.append(name)
+        self._fault_mgr = mgr
+        for alias in self._fault_names:
+            mgr.register_probe(alias, self._probe_dispatch)
+
+    def attach_chaos(self, injector) -> None:
+        """Attach the seeded chaos injector (idempotent attribute stamp).
+        The worker then consults ``maybe_device_fault`` at its dispatch
+        boundaries: a drawn ``device_error`` genuinely invalidates the
+        donated bucket buffers and raises a synthetic XLA-shaped error,
+        so drills exercise the real rebuild/recovery path from a seed."""
+        self._chaos = injector
+
+    def _report_fault(self, kind: str, reason: str = "",
+                      force_quarantine: bool = False) -> None:
+        """One device fault on every attached alias (no-op unattached)."""
+        mgr = self._fault_mgr
+        if mgr is None:
+            return
+        for alias in self._fault_names:
+            mgr.record_fault(alias, kind, reason=reason,
+                             force_quarantine=force_quarantine)
+
+    def _report_recovered(self, n: int = 1) -> None:
+        mgr = self._fault_mgr
+        if mgr is None:
+            return
+        for alias in self._fault_names:
+            mgr.record_recovered(alias, n)
+
+    def _report_aborted(self, n: int = 1) -> None:
+        mgr = self._fault_mgr
+        if mgr is None:
+            return
+        for alias in self._fault_names:
+            mgr.record_aborted(alias, n)
+
+    def _probe_dispatch(self) -> bool:
+        """Quarantine probe: one real (tiny) device dispatch, resolved
+        synchronously.  Success means the device executes and reads back
+        again — the manager un-quarantines every alias.  Runs on the
+        manager's probe thread; it deliberately avoids the donated slot
+        caches (a probe must never consume live state) and its blocking
+        resolve belongs here — the probe IS a synchronous health check,
+        not a tick.  An armed chaos injector is consulted first so a
+        seeded persistent-fault drill fails probes deterministically
+        until its fault budget runs dry."""
+        try:
+            if self._closed:
+                return False
+            chaos = self._chaos
+            if chaos is not None and chaos.maybe_device_fault(
+                    self._fault_names[0]):
+                return False
+            import jax
+            import jax.numpy as jnp
+
+            fn = getattr(self, "_probe_fn", None)
+            if fn is None:
+                fn = jax.jit(lambda x: x + 1)
+                self._probe_fn = fn
+            return int(fn(jnp.int32(1))) == 2
+        except Exception:  # noqa: BLE001 — a raising probe is a failed probe
+            return False
+
+    # -- tick-stall watchdog -----------------------------------------------
+    def _watch_readback(self, kind: str):
+        """Register one in-flight readback with the stall watchdog.
+        Returns the watch id the resolver hands back to
+        ``_unwatch_readback`` when the resolve completes; None (a no-op
+        id) when the watchdog is unarmed."""
+        if self._stall_s <= 0.0:
+            return None
+        import time
+
+        with self._watch_lock:
+            self._watch_seq += 1
+            wid = self._watch_seq
+            # [start, kind, reported] — reported keeps a single wedged
+            # readback from re-firing the fault every sweep
+            self._watched[wid] = [time.monotonic(), kind, False]
+        return wid
+
+    def _unwatch_readback(self, wid) -> None:
+        if wid is None:
+            return
+        with self._watch_lock:
+            self._watched.pop(wid, None)
+
+    def _watchdog_loop(self) -> None:
+        """Daemon sweep: any registered readback whose resolve exceeds
+        ``--tick-stall-ms`` is reported as a ``tick_stall`` device fault
+        with forced quarantine.
+
+        HONEST LIMIT: a wedged device dispatch cannot be killed from the
+        host — no JAX/XLA API cancels an in-flight execution, so the
+        watchdog cannot unwedge the tick or recover its generations.
+        What it guarantees is that the stall does not fail silently: the
+        forced quarantine flips the model not-ready (503 with pushback,
+        so clients route to healthy replicas) and fires the
+        ``device_fault`` incident capture WHILE the dispatch is still
+        stuck — the evidence window an operator otherwise loses to a
+        hang that only surfaces as distant client timeouts."""
+        import time
+
+        while not self._closed:
+            time.sleep(min(0.25, self._stall_s / 2.0))
+            now = time.monotonic()
+            stalled = []
+            with self._watch_lock:
+                for ent in self._watched.values():
+                    if not ent[2] and now - ent[0] >= self._stall_s:
+                        ent[2] = True
+                        stalled.append((ent[1], now - ent[0]))
+            for kind, age in stalled:
+                self._report_fault(
+                    "tick_stall",
+                    reason=(f"{kind} readback stalled {age * 1e3:.0f}ms "
+                            f"(tick-stall-ms={self._stall_s * 1e3:.0f}); "
+                            "a wedged device dispatch cannot be killed "
+                            "from the host — quarantining so traffic "
+                            "reroutes while it is stuck"),
+                    force_quarantine=True)
+
+    # -- device-fault injection + recovery ---------------------------------
+    def _maybe_inject_device_fault(self, b: int) -> None:
+        """Dispatch-boundary chaos consult (``device_error`` kind): when
+        the seeded draw fires, genuinely invalidate the bucket's donated
+        buffers — exactly the wreckage a failed donated dispatch leaves —
+        then raise the synthetic XLA-shaped error.  Everything downstream
+        (rebuild, generation recovery, quarantine escalation) is the REAL
+        containment path; nothing is mocked."""
+        chaos = self._chaos
+        if chaos is None:
+            return
+        if not chaos.maybe_device_fault(self._fault_names[0]):
+            return
+        from ..server.chaos import ChaosDeviceError
+
+        def _delete(arr):
+            if isinstance(arr, dict):  # int8 cache: {"q", "s"} pair
+                for v in arr.values():
+                    _delete(v)
+                return
+            try:
+                arr.delete()
+            except Exception:  # noqa: BLE001 — already-deleted is fine
+                pass
+
+        _delete(self._k[b])
+        _delete(self._v[b])
+        for leaf in jax.tree_util.tree_leaves(self._dstate[b]):
+            _delete(leaf)
+        raise ChaosDeviceError(self._fault_names[0])
+
+    def _recover_handoff(self, sink) -> None:
+        """Hand one live server-side generation to the recovery queue
+        after a device fault invalidated its bucket (worker thread).
+
+        The ``prompt + emitted_so_far`` snapshot must contain exactly the
+        tokens the consumer already received, so it is taken ON the
+        ordered gen-reader thread: every token the resolvers delivered
+        before the fault has already run its ``emitted.append`` there,
+        in-flight readbacks from the dying dispatch resolve (or fail,
+        setting ``sink.failed``) ahead of this submission, and nothing
+        appends afterwards — the bucket rebuild bumped the slot
+        generations, so no further resolution for this stream exists.
+        Combined with the worker's host mirror (``_pos`` and
+        ``remaining`` advance only on successful dispatch), the snapshot
+        equals the stream state at the last successful dispatch, which
+        is what makes the greedy resume bit-identical."""
+        from ..server.types import InferError
+
+        def snapshot():
+            if getattr(sink, "cancelled", False):
+                # consumer already left: nothing to resume, end cleanly
+                self._close_decode_span(sink)
+                sink.put(None)
+                return
+            if getattr(sink, "failed", False):
+                # an in-flight readback from the dying dispatch already
+                # surfaced the error on this stream; re-admitting would
+                # splice tokens after an exception the consumer saw
+                self._report_aborted()
+                return
+            if sink.recoveries >= self._recovery_budget:
+                sink.failed = True
+                self._report_aborted()
+                st = getattr(sink, "trace", None)
+                if st is not None and st.flight is not None:
+                    st.flight.fault = "device_error"
+                sink.put(InferError(
+                    f"model '{self._model.name}': decode cache was "
+                    "rebuilt after a device error and the generation's "
+                    f"recovery budget ({self._recovery_budget}) is "
+                    "exhausted; generation aborted", 500))
+                return
+            sink.recoveries += 1
+            st = getattr(sink, "trace", None)
+            if st is not None and st.flight is not None:
+                st.flight.fault = "device_error"
+            self._jobs.put(("recover", (sink, list(sink.emitted)), None))
+
+        self._gen_reader.submit(snapshot)
 
     def _kv_pin_slot(self, slot: int, tokens: int, tenant: str) -> None:
         """Open the memory governor's KV byte-seconds integrator for an
@@ -1326,6 +1572,18 @@ class DecodeModel:
                     fns = (make_slot_prefill(cfg), params, cfg)
                     self._fns = fns
                     self._worker.start()
+                    # tick-stall watchdog: armed only when the operator
+                    # set --tick-stall-ms (env TRITON_TPU_TICK_STALL_MS);
+                    # unarmed, _watch_readback returns None and the hot
+                    # path pays a single float compare per dispatch
+                    self._stall_s = float(os.environ.get(
+                        "TRITON_TPU_TICK_STALL_MS", "0")) / 1e3
+                    if self._stall_s > 0.0:
+                        self._threading.Thread(
+                            target=self._watchdog_loop,
+                            name=("tc-tpu-stall-watch-"
+                                  f"{self._model.name}"),
+                            daemon=True).start()
         return self._fns
 
     def _shutdown(self):
@@ -1446,6 +1704,8 @@ class DecodeModel:
                     continue
                 if j[0] in ("prefill", "prefill_cont"):
                     deliver_error(j[1][-1], err)
+                elif j[0] == "recover":
+                    self._gen_reader.submit(j[1][0].put, err)
                 elif j[0] == "step":
                     j[2].set_exception(err)
             for slot, info in self._auto_slots.items():
@@ -1490,6 +1750,24 @@ class DecodeModel:
                                      completion[1])
                 return
             _tag, n_tokens, sink = completion
+            if getattr(sink, "_recovering", False):
+                # a recovery re-prefill just landed: the resumed stream
+                # is live again.  Count the sequence recovered, stamp the
+                # flight record, and charge the re-prefill's wall window
+                # to the owning tenant — attribution is the ledger's
+                # contract, and these are the tenant's tokens recomputed
+                # (operators see the fault itself via nv_device_fault).
+                sink._recovering = False
+                self._report_recovered()
+                st = getattr(sink, "trace", None)
+                if st is not None and st.flight is not None:
+                    st.flight.recovered = True
+                ledger = self._cost_ledger
+                if ledger is not None and ledger.enabled:
+                    dt_us = (time.monotonic() - sink._recover_t0) * 1e6
+                    ledger.charge(self._model.name,
+                                  getattr(sink, "tenant", ""),
+                                  device_us=dt_us, tokens=0)
             tr = getattr(sink, "trace", None)
             if tr is not None:
                 now = time.monotonic_ns()
@@ -1510,7 +1788,8 @@ class DecodeModel:
             pair = start_readback(
                 jnp.stack([nxt_dev.astype(jnp.float32), lp_dev]))
             self._gen_reader.submit(self._resolve_gen_token, pair,
-                                    sink, n_tokens == 1, slot, gen)
+                                    sink, n_tokens == 1, slot, gen,
+                                    self._watch_readback("prefill"))
             if n_tokens > 1:
                 self._auto_slots[slot] = {
                     "remaining": n_tokens - 1, "sink": sink, "gen": gen}
@@ -1593,8 +1872,15 @@ class DecodeModel:
             if kind == "prefill":
                 slot, gen, win, completion = payload
                 if gen != self._slot_gen[slot]:
-                    deliver_error(completion,
-                                  _stale_error(self._model.name))
+                    if completion[0] == "gen":
+                        # a queued generation only goes stale via a bucket
+                        # rebuild (gen slots carry no seq id, so idle
+                        # eviction never touches them): recover it instead
+                        # of failing a stream the fault didn't have to kill
+                        self._recover_handoff(completion[2])
+                    else:
+                        deliver_error(completion,
+                                      _stale_error(self._model.name))
                     continue
                 if gen_was_cancelled(slot, completion):
                     continue
@@ -1604,6 +1890,7 @@ class DecodeModel:
                 with self._lock:
                     seed = self._slot_pen_seed.pop(slot, None)
                 try:
+                    self._maybe_inject_device_fault(b)
                     if seed is not None:
                         # penalized generation: first token must respect
                         # the prompt counts (full prefill — chunking would
@@ -1648,7 +1935,16 @@ class DecodeModel:
                     finish_prefill(slot, gen, win.shape[1], nxt, best, lp,
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
-                    deliver_error(completion, e)
+                    self._report_fault("prefill", reason=str(e))
+                    if completion[0] == "gen":
+                        # server-side generation: hand to the recovery
+                        # queue (re-admit + re-prefill) instead of
+                        # failing the stream; client-driven sequences
+                        # fail fast as before — only the client can
+                        # replay its step protocol
+                        self._recover_handoff(completion[2])
+                    else:
+                        deliver_error(completion, e)
                     # rebuild frees + bumps every slot in the bucket (incl.
                     # this gen slot) atomically; no separate release here
                     self._rebuild_bucket_cache(b)
@@ -1656,14 +1952,22 @@ class DecodeModel:
             if kind == "prefill_cont":
                 slot, gen, win, pos0, completion = payload
                 if gen != self._slot_gen[slot]:
-                    deliver_error(completion,
-                                  _stale_error(self._model.name))
+                    if completion[0] == "gen":
+                        # a queued generation only goes stale via a bucket
+                        # rebuild (gen slots carry no seq id, so idle
+                        # eviction never touches them): recover it instead
+                        # of failing a stream the fault didn't have to kill
+                        self._recover_handoff(completion[2])
+                    else:
+                        deliver_error(completion,
+                                      _stale_error(self._model.name))
                     continue
                 if gen_was_cancelled(slot, completion):
                     continue
                 C = self._prefill_chunk
                 b, li = self._slot_bucket(slot)
                 try:
+                    self._maybe_inject_device_fault(b)
                     nxt, best, lp, self._k[b], self._v[b] = self._chunk_fn(
                         params, self._k[b], self._v[b],
                         jnp.asarray(win[:, pos0:pos0 + C]), li, pos0)
@@ -1675,8 +1979,109 @@ class DecodeModel:
                     finish_prefill(slot, gen, win.shape[1], nxt, best, lp,
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
-                    deliver_error(completion, e)
+                    self._report_fault("prefill", reason=str(e))
+                    if completion[0] == "gen":
+                        # partial prefill died with the cache: recovery
+                        # restarts the prompt from scratch (nothing was
+                        # emitted yet, so the resume is trivially exact)
+                        self._recover_handoff(completion[2])
+                    else:
+                        deliver_error(completion, e)
                     self._rebuild_bucket_cache(b)
+                continue
+            if kind == "recover":
+                # Re-admit a generation whose bucket a device fault took
+                # down: prefill ``prompt + emitted_so_far`` into a fresh
+                # slot and let it self-feed the REMAINING budget.  Greedy
+                # decode is deterministic in the token prefix, so the
+                # resumed stream is bit-identical to the one the fault
+                # interrupted — the consumer never notices beyond added
+                # latency.  ``emitted`` is the gen-reader-thread snapshot
+                # taken at handoff (see _recover_handoff for why it is
+                # exact).
+                from ..server.types import InferError
+
+                sink, emitted = payload
+                if getattr(sink, "cancelled", False):
+                    self._close_decode_span(sink)
+                    self._gen_reader.submit(sink.put, None)
+                    continue
+                if self._closed:
+                    # the fault closed the model (unrebuildable cache →
+                    # quarantine → shutdown): re-admitting into a dead
+                    # worker would hang the stream forever
+                    sink.failed = True
+                    self._report_aborted()
+                    self._gen_reader.submit(sink.put, InferError(
+                        f"model '{self._model.name}' is unloading", 503))
+                    continue
+                remaining = sink.n_tokens_total - len(emitted)
+                if remaining <= 0:
+                    # fully emitted before the fault; only the stream-end
+                    # sentinel was outstanding
+                    self._close_decode_span(sink)
+                    self._gen_reader.submit(sink.put, None)
+                    continue
+                win = sink.window
+                if emitted:
+                    # np.fromiter, not np.asarray: these are host-side
+                    # Python ints (DEVICE-SYNC keeps blocking conversions
+                    # out of the worker loop, and this one never was one)
+                    win = np.concatenate(
+                        [win, np.fromiter((t for t, _lp in emitted),
+                                          dtype=win.dtype,
+                                          count=len(emitted))
+                              .reshape(1, -1)], axis=1)
+                # prompt+emitted+remaining == the original admission size,
+                # so the resume lands in the same bucket class
+                need_s = int(win.shape[1]) + int(remaining)
+                use_pen = sink.freq_pen != 0.0 or sink.pres_pen != 0.0
+                with self._lock:
+                    slot = self._alloc_slot_locked(need_s)
+                    if slot is None:
+                        self._evict_idle_locked(time.monotonic())
+                        slot = self._alloc_slot_locked(need_s)
+                    if slot is not None:
+                        gen = self._slot_gen[slot]
+                        self._slot_tenant[slot] = sink.tenant
+                        if use_pen:
+                            # reseed the penalty counts from the REAL
+                            # prompt plus everything already emitted —
+                            # the same state the interrupted slot's
+                            # device-side count row had reached
+                            pl = sink.prompt_len
+                            real = (sink.window[
+                                0, sink.window.shape[1] - pl:]
+                                if pl else np.zeros(0, np.int32))
+                            toks = np.fromiter(
+                                (t for t, _lp in emitted), np.int32,
+                                count=len(emitted))
+                            row = np.bincount(
+                                np.concatenate([real, toks]),
+                                minlength=cfg.vocab_size).astype(np.int32)
+                            self._slot_pen_seed[slot] = (
+                                float(sink.freq_pen),
+                                float(sink.pres_pen), row)
+                if slot is None:
+                    # the freed bucket was re-claimed by new admissions
+                    # before recovery ran: budget the failure honestly
+                    sink.failed = True
+                    self._report_aborted()
+                    self._gen_reader.submit(sink.put, InferError(
+                        f"model '{self._model.name}': no free decode "
+                        "slot for device-fault recovery; generation "
+                        "aborted", 500))
+                    continue
+                self._kv_pin_slot(slot, need_s, sink.tenant)
+                # recovery accounting closes at the re-prefill's
+                # finish_prefill; t_prefill0 resets so the trace shows
+                # the second SLOT_WAIT/PREFILL pair
+                sink._recovering = True
+                sink._recover_t0 = time.monotonic()
+                sink.t_prefill0 = None
+                self._jobs.put(("prefill",
+                                (slot, gen, win,
+                                 ("gen", remaining, sink)), None))
                 continue
             # Merge steps into this tick. A short accumulation window is
             # load-bearing: the previous tick resolves every stream's
@@ -1784,6 +2189,7 @@ class DecodeModel:
                 # into assembly would make the host-overhead counter lie
                 t_disp0 = time.monotonic_ns()
                 try:
+                    self._maybe_inject_device_fault(b)
                     if self._pen_n[b] > 0:
                         # >=1 penalized generation in this bucket: the
                         # penalized tick (per-slot counts + device-resident
@@ -1810,11 +2216,13 @@ class DecodeModel:
                         self._pos[off + li] += 1
                 except Exception as e:  # noqa: BLE001 — via futures
                     self._tick_budget.release()
+                    self._report_fault("step", reason=str(e))
                     for _li, f in w["batch"]:
                         f.set_exception(e)
-                    for slot, _li in w["gens"]:
-                        info = self._auto_slots.pop(slot)
-                        self._gen_reader.submit(info["sink"].put, e)
+                    # the bucket's live generations (w["gens"] exactly)
+                    # are handed to the recovery queue by the rebuild —
+                    # not aborted here; only client-driven step futures
+                    # fail fast (the client owns that replay protocol)
                     self._rebuild_bucket_cache(b)
                     # the next bucket's assembly window must not absorb
                     # this failed dispatch + cache rebuild
@@ -1949,7 +2357,8 @@ class DecodeModel:
                 # N+1 only carries other sequences' tokens.
                 pool = self._gen_reader if gen_batch else self._readers
                 pool.submit(self._resolve_tick, out, w["batch"], gen_batch,
-                            self._tick_budget)
+                            self._tick_budget,
+                            self._watch_readback("tick"))
                 # next bucket's assembly window starts fresh: it must not
                 # absorb this bucket's dispatch time
                 t_asm0 = time.monotonic_ns()
@@ -1980,10 +2389,17 @@ class DecodeModel:
         except Exception as e:  # noqa: BLE001 — surfaced via future
             fut.set_exception(e)
 
-    def _resolve_gen_token(self, pair_dev, sink, done, slot, gen):
+    def _resolve_gen_token(self, pair_dev, sink, done, slot, gen,
+                           watch_id=None):
         try:
             vals = finish_readback(pair_dev)
-            sink.put((int(vals[0]), float(vals[1])))
+            tok = (int(vals[0]), float(vals[1]))
+            # host mirror for device-fault recovery: appended on this
+            # (ordered) gen-reader thread in lock-step with the
+            # consumer-visible put, so a recovery snapshot taken on this
+            # thread equals the streamed prefix exactly
+            sink.emitted.append(tok)
+            sink.put(tok)
             if done:
                 # a generation whose whole budget resolved at prefill
                 # (n_tokens == 1) ends here — its DECODE stage (opened at
@@ -1993,11 +2409,16 @@ class DecodeModel:
                 self._close_decode_span(sink)
                 sink.put(None)
         except Exception as e:  # noqa: BLE001 — surfaced via sink
+            sink.failed = True
             sink.put(e)
             with self._lock:
                 self._dead_gens.add((slot, gen))
+            self._report_fault("readback", reason=str(e))
+        finally:
+            self._unwatch_readback(watch_id)
 
-    def _resolve_tick(self, out, batch, gen_batch=(), budget=None):
+    def _resolve_tick(self, out, batch, gen_batch=(), budget=None,
+                      watch_id=None):
         """Resolve one fused dispatch's ``[3, T, B]`` token block.
 
         batch: [(li, fut)] — client-driven steps, resolved from their one
@@ -2012,20 +2433,27 @@ class DecodeModel:
         except Exception as e:  # noqa: BLE001 — surfaced via futures/sinks
             if budget is not None:
                 budget.release()
+            self._unwatch_readback(watch_id)
             for _li, f in batch:
                 f.set_exception(e)
             for _li, slot, sink, _n_emit, _done, gen in gen_batch:
+                sink.failed = True
                 sink.put(e)
                 with self._lock:
                     self._dead_gens.add((slot, gen))
+            self._report_fault("readback", reason=str(e))
             return
         if budget is not None:
             budget.release()
+        self._unwatch_readback(watch_id)
         for li, f in batch:
             f.set_result((int(vals[0, 0, li]), float(vals[1, 0, li])))
         for li, _slot, sink, n_emit, done, _gen in gen_batch:
             for t in range(n_emit):
-                sink.put((int(vals[0, t, li]), float(vals[2, t, li])))
+                tok = (int(vals[0, t, li]), float(vals[2, t, li]))
+                # lock-step host mirror — see _resolve_gen_token
+                sink.emitted.append(tok)
+                sink.put(tok)
             if done:
                 # last token host-resolved: the DECODE stage closes
                 # (resolver thread — host-side, no device sync added).
@@ -2082,21 +2510,18 @@ class DecodeModel:
         """Worker-side, after a failed donated step/prefill: the call may
         have consumed the bucket's cache buffers (donation invalidates the
         inputs even when the computation errors), so rebuild them zeroed
-        and invalidate every slot in the bucket — queued jobs then fail
-        stale instead of touching garbage, and live self-feeding
-        generations in the bucket are aborted (they would otherwise keep
-        streaming tokens decoded from zeros)."""
-        from ..server.types import InferError
-
+        and invalidate every slot in the bucket — queued sequence jobs
+        then fail stale instead of touching garbage.  Live self-feeding
+        generations hand off to the recovery queue (re-admit + re-prefill
+        ``prompt + emitted_so_far``, budget-capped) instead of being
+        aborted: the server owns their whole protocol, so the fault is
+        containable without the caller ever seeing it."""
         cnt, cap = self._buckets[b]
         off = self._bucket_off[b]
-        err = InferError(
-            f"model '{self._model.name}': decode cache was rebuilt after "
-            "a device error; generation aborted", 500)
         for slot in range(off, off + cnt):
             info = self._auto_slots.pop(slot, None)
             if info is not None:
-                self._gen_reader.submit(info["sink"].put, err)
+                self._recover_handoff(info["sink"])
         with self._lock:
             # One atomic section: release the bucket's sequence mappings,
             # return every slot to the pool, and bump the generations.
@@ -2127,13 +2552,23 @@ class DecodeModel:
             self._dstate[b] = _new_decode_state(cnt)
             self._pen_fp_dev[b] = jnp.zeros(cnt, jnp.float32)
             self._pen_pp_dev[b] = jnp.zeros(cnt, jnp.float32)
-        except Exception:  # noqa: BLE001 — e.g. the same OOM that failed
-            # the step: a sane cache cannot be restored, so fail pending
-            # work cleanly (503 via the drain path) instead of letting the
-            # worker die and leave futures hanging forever
+        except Exception as e:  # noqa: BLE001 — e.g. the same OOM that
+            # failed the step: a sane cache cannot be restored, so fail
+            # pending work cleanly (503 via the drain path) instead of
+            # letting the worker die and leave futures hanging forever.
+            # This is NOT a swallow anymore: a model that cannot rebuild
+            # its cache is exactly what quarantine exists for — escalate
+            # straight there (readiness flips, clients reroute, the
+            # device_fault incident bundle captures the evidence)
+            self._report_fault("rebuild", reason=str(e),
+                               force_quarantine=True)
             with self._lock:
                 self._closed = True
-            self._jobs.put(None)
+            # route the shutdown sentinel through the ORDERED gen-reader:
+            # every recovery handoff already submitted rides ahead of it,
+            # so its "recover" job reaches the worker before the drain —
+            # a direct put here could orphan a handed-off stream forever
+            self._gen_reader.submit(self._jobs.put, None)
 
     def _ensure_pen_bucket(self, b: int) -> None:
         """Worker-side: allocate the bucket's [cnt, V] count matrix on
@@ -2276,6 +2711,22 @@ class DecodeModel:
         # guards the close-once take of t_decode0: the resolver's
         # last-token path and the worker's cancel path can race
         sink.span_lock = self._threading.Lock()
+        # device-fault recovery metadata: the host mirror a recovery
+        # re-prefill is rebuilt from.  ``emitted`` is appended ONLY on
+        # the ordered gen-reader thread, in lock-step with each
+        # consumer-visible put — a snapshot taken there equals the
+        # streamed prefix exactly (the bit-identity anchor).  ``failed``
+        # marks a stream that already surfaced an exception (never
+        # resumed); ``recoveries`` counts re-admissions against
+        # TRITON_TPU_RECOVERY_BUDGET.
+        sink.window = window
+        sink.prompt_len = prompt_len
+        sink.n_tokens_total = int(n_tokens)
+        sink.freq_pen = float(freq_pen)
+        sink.pres_pen = float(pres_pen)
+        sink.emitted = []
+        sink.recoveries = 0
+        sink.failed = False
         self._jobs.put(("prefill",
                         (slot, gen, window, ("gen", n_tokens, sink)),
                         None))
@@ -2559,6 +3010,15 @@ class GenerateModel:
                 # tick attribution happens in the SHARED decode worker —
                 # route the ledger there so generation traffic is charged
                 outer._decode.attach_cost_ledger(ledger)
+
+            def attach_device_faults(inner, mgr):
+                # faults strike the SHARED decode worker: register this
+                # model name as an alias so a quarantine (and a probe
+                # release) covers the generate surface too
+                outer._decode.attach_device_faults(mgr, inner.config.name)
+
+            def attach_chaos(inner, injector):
+                outer._decode.attach_chaos(injector)
 
         self.model = _Impl(cfg)
 

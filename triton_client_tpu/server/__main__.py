@@ -221,8 +221,12 @@ def main() -> None:
                         "pinned by the flight recorder)")
     parser.add_argument("--chaos-kinds", default="error",
                         help="comma list of latency,error,abort,"
-                        "worker_kill,load_fail,mem_pressure "
-                        "(default: error)")
+                        "worker_kill,load_fail,mem_pressure,device_error "
+                        "(default: error).  device_error fires at the "
+                        "decode worker's dispatch boundaries: it "
+                        "invalidates the donated bucket buffers and "
+                        "raises an XLA-shaped failure, driving the real "
+                        "rebuild / generation-recovery / quarantine path")
     parser.add_argument("--chaos-seed", type=int, default=0,
                         help="RNG seed — a fixed seed reproduces the "
                         "exact fault sequence")
@@ -248,6 +252,33 @@ def main() -> None:
                         help="mem_pressure shrink: the live byte budget "
                         "drops to F x --mem-budget-bytes while a "
                         "pressure window holds (default 0.5)")
+    parser.add_argument("--device-fault-threshold", type=int, default=3,
+                        metavar="K",
+                        help="dispatch faults inside --device-fault-window "
+                        "that quarantine a model: not-ready on both "
+                        "protocols, typed retryable 503s with pushback "
+                        "until a probe dispatch succeeds (default 3)")
+    parser.add_argument("--device-fault-window", type=float, default=30.0,
+                        metavar="S",
+                        help="sliding window for the K-fault quarantine "
+                        "detector (default 30s)")
+    parser.add_argument("--device-fault-probe-backoff", type=float,
+                        default=1.0, metavar="S",
+                        help="initial delay before a quarantined model's "
+                        "first probe dispatch; doubles per failed probe "
+                        "(default 1s)")
+    parser.add_argument("--device-fault-probe-backoff-max", type=float,
+                        default=30.0, metavar="S",
+                        help="probe backoff ceiling (default 30s)")
+    parser.add_argument("--tick-stall-ms", type=float, default=None,
+                        metavar="MS",
+                        help="arm the decode readback watchdog: a tick/"
+                        "prefill readback that takes longer than MS to "
+                        "resolve reports a tick_stall device fault and "
+                        "quarantines the model (a wedged dispatch cannot "
+                        "be killed host-side — this reroutes traffic and "
+                        "captures the incident while it is stuck; sets "
+                        "TRITON_TPU_TICK_STALL_MS)")
     parser.add_argument("--metrics-port", type=int, default=8002,
                         help="dedicated Prometheus /metrics port (Triton "
                         "convention; 0 disables — /metrics stays on the "
@@ -273,6 +304,16 @@ def main() -> None:
     args = parser.parse_args()
     if args.serve_mesh is not None:
         os.environ["TRITON_TPU_SERVE_MESH"] = args.serve_mesh
+    if args.tick_stall_ms is not None:
+        if args.tick_stall_ms <= 0:
+            parser.error("--tick-stall-ms must be positive")
+        # env-var handoff like --serve-mesh: the decode worker arms its
+        # watchdog from the environment at lazy init
+        os.environ["TRITON_TPU_TICK_STALL_MS"] = str(args.tick_stall_ms)
+    if args.device_fault_threshold < 1:
+        parser.error("--device-fault-threshold must be >= 1")
+    if args.device_fault_window <= 0:
+        parser.error("--device-fault-window must be positive")
     if args.frontends < 1:
         parser.error("--frontends must be >= 1")
     # autoscale flags validate BEFORE the supervisor branch: a typo'd
@@ -358,6 +399,14 @@ def main() -> None:
         parser.error(str(e))
     if args.cache_budget_bytes > 0:
         core.response_cache.budget_bytes = args.cache_budget_bytes
+    # device-fault containment knobs (the manager itself is always on)
+    core.device_faults.threshold = args.device_fault_threshold
+    core.device_faults.window_s = args.device_fault_window
+    core.device_faults.probe_backoff_s = max(
+        0.05, args.device_fault_probe_backoff)
+    core.device_faults.probe_backoff_max_s = max(
+        core.device_faults.probe_backoff_s,
+        args.device_fault_probe_backoff_max)
     if args.chaos > 0.0:
         from .chaos import build_injector
 

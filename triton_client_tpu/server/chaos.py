@@ -39,6 +39,19 @@ data-plane kind:
   its own — the drill that proves the governor degrades and recovers
   instead of OOMing.
 
+The device-fault containment layer (``models/decode.py`` +
+``server/core.py``) adds a dispatch-plane kind:
+
+* ``device_error`` — consumed by the decode worker at its dispatch
+  boundaries (``maybe_device_fault``), never by per-request ``decide``:
+  the worker genuinely invalidates the donated bucket buffers and then
+  raises a synthetic XLA-shaped ``ChaosDeviceError``, so the drill
+  exercises the REAL rebuild/recovery path (cache zero-rebuild,
+  in-flight generation recovery, quarantine escalation) rather than a
+  mocked one.  With ``transient_s`` set the fault is a blip a recovery
+  re-prefill rides out; without it a persistent fault drives the model
+  into quarantine.
+
 Every injected fault stamps the request's flight record (``chaos=<kind>``),
 which the flight recorder pins into its outlier buffer and ``triton-top``
 labels — an operator staring at a latency spike can tell injected weather
@@ -60,9 +73,11 @@ from typing import Dict, Iterable, Optional, Sequence
 from .types import InferError
 
 _KINDS = ("latency", "error", "abort", "worker_kill", "load_fail",
-          "mem_pressure")
+          "mem_pressure", "device_error")
 #: kinds drawn per inference request by ``decide`` — ``load_fail`` is
-#: control-plane only (``maybe_fail_load``)
+#: control-plane only (``maybe_fail_load``) and ``device_error`` is
+#: dispatch-plane only (``maybe_device_fault``, consumed by the decode
+#: worker at its dispatch boundaries)
 _DATA_KINDS = ("latency", "error", "abort", "worker_kill", "mem_pressure")
 
 
@@ -74,6 +89,21 @@ class ChaosAbort(InferError):
 
     def __init__(self, msg: str = "chaos: injected connection abort"):
         super().__init__(msg, http_status=503)
+
+
+class ChaosDeviceError(RuntimeError):
+    """Synthetic XLA-shaped dispatch failure.  Deliberately NOT an
+    ``InferError``: a real failed XLA execute surfaces as a runtime
+    error from the dispatch call, and the decode worker's containment
+    path (buffer invalidation already done by the injection site →
+    ``_rebuild_bucket_cache`` → generation recovery → quarantine
+    escalation) must be exercised by the same exception class shape it
+    sees in production."""
+
+    def __init__(self, model_name: str):
+        super().__init__(
+            "INTERNAL: Failed to execute XLA computation: injected "
+            f"device_error (chaos, model '{model_name}')")
 
 
 class ChaosFault:
@@ -213,6 +243,18 @@ class ChaosInjector:
             raise InferError(
                 f"chaos: injected load failure for '{model_name}'",
                 http_status=503)
+
+    def maybe_device_fault(self, model_name: str) -> bool:
+        """Dispatch-plane verdict for one decode dispatch: True when a
+        ``device_error`` draw fires (counted like every other injection;
+        ``nv_chaos_injected_total`` carries it).  The CALLER owns the
+        actuation — invalidate the donated buffers, then raise
+        ``ChaosDeviceError(model_name)`` — because only the decode
+        worker knows which buffers the failed dispatch would have
+        consumed."""
+        if "device_error" not in self.kinds:
+            return False
+        return self._draw(model_name, ("device_error",)) is not None
 
     def counters(self) -> Dict[str, int]:
         """Per-model injected-fault counts, copied under the lock (backs

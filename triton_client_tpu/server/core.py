@@ -540,6 +540,274 @@ def _model_cache_ttl(model: Model) -> Optional[float]:
     return ttl if ttl > 0 else None
 
 
+class DeviceFaultManager:
+    """Device-fault accounting and the per-model quarantine state machine.
+
+    The decode worker (and the tick-stall watchdog) report every failed
+    dispatch here (``record_fault``); ``threshold`` faults inside the
+    sliding ``window_s`` flip the model to *quarantined*: not-ready on
+    both protocols (``InferenceCore.model_ready``), typed retryable 503
+    with pushback at admission (``refusal_reason="quarantine"``, message
+    carries the ``quarantined`` marker the client resilience layer
+    classifies on), and a ``device_fault`` incident bundle.  Probe
+    dispatches run on a doubling backoff (``maybe_probe``, driven by the
+    FleetController's evaluate loop or any periodic caller): a
+    registered probe callback that succeeds un-quarantines; repeated
+    probe failures beyond ``escalate_after`` invoke ``escalation_cb``
+    (the fleet/supervisor hook — restart the worker, scale out
+    elsewhere).  Models with no registered probe release optimistically
+    when their backoff expires — a persistent fault re-trips the K-in-
+    window detector on the next dispatch, so flapping is bounded by the
+    window, never unbounded.
+
+    All methods are thread-safe: faults arrive from the decode worker
+    thread and the watchdog, probes from their own threads, admission
+    reads from the event loop.
+    """
+
+    def __init__(self, core=None, threshold: int = 3, window_s: float = 30.0,
+                 probe_backoff_s: float = 1.0,
+                 probe_backoff_max_s: float = 30.0,
+                 escalate_after: int = 3):
+        self.core = core
+        self.threshold = max(1, int(threshold))
+        self.window_s = float(window_s)
+        self.probe_backoff_s = float(probe_backoff_s)
+        self.probe_backoff_max_s = float(probe_backoff_max_s)
+        self.escalate_after = max(1, int(escalate_after))
+        #: fleet/supervisor escalation hook: called once per quarantine
+        #: episode as ``cb(model, state_dict)`` when ``escalate_after``
+        #: consecutive probes failed (we cannot restart a wedged device
+        #: from inside the process — the supervisor can)
+        self.escalation_cb = None
+        self._lock = threading.Lock()
+        # cumulative counters -> nv_device_fault_total{model,kind} /
+        # nv_device_recovered_sequences_total{model}
+        self._faults: Dict[Tuple[str, str], int] = {}
+        self._recovered: Dict[str, int] = {}
+        self._aborted: Dict[str, int] = {}
+        # sliding K-in-window detector, per model
+        self._recent: Dict[str, List[float]] = {}
+        # model -> {"since", "reason", "backoff_s", "probe_at",
+        #           "probes_failed", "escalated"}
+        self._quarantined: Dict[str, Dict[str, Any]] = {}
+        self._probes: Dict[str, Any] = {}
+        self._probing: Set[str] = set()
+        # every model that ever faulted keeps a 0/1 gauge row, so the
+        # un-quarantine flip is visible on the metrics surface
+        self._ever: Set[str] = set()
+
+    # -- fault intake --------------------------------------------------
+
+    def record_fault(self, model: str, kind: str, reason: str = "",
+                     force_quarantine: bool = False) -> bool:
+        """One device fault for ``model`` (``kind`` labels the metric:
+        ``prefill``/``step``/``rebuild``/``tick_stall``).  Returns True
+        when this fault tripped (or re-affirmed) quarantine."""
+        now = time.monotonic()
+        with self._lock:
+            self._ever.add(model)
+            key = (model, kind)
+            self._faults[key] = self._faults.get(key, 0) + 1
+            recent = self._recent.setdefault(model, [])
+            recent.append(now)
+            cutoff = now - self.window_s
+            while recent and recent[0] < cutoff:
+                recent.pop(0)
+            trip = force_quarantine or len(recent) >= self.threshold
+        if trip:
+            self.quarantine(model, reason or f"{kind} fault")
+        return trip
+
+    def record_recovered(self, model: str, n: int = 1) -> None:
+        """``n`` in-flight generations re-admitted bit-identically after
+        a device fault (nv_device_recovered_sequences_total)."""
+        with self._lock:
+            self._ever.add(model)
+            self._recovered[model] = self._recovered.get(model, 0) + int(n)
+
+    def record_aborted(self, model: str, n: int = 1) -> None:
+        """``n`` generations whose recovery budget ran out (they got the
+        typed 500 the pre-containment worker handed everyone)."""
+        with self._lock:
+            self._ever.add(model)
+            self._aborted[model] = self._aborted.get(model, 0) + int(n)
+
+    # -- quarantine state machine --------------------------------------
+
+    def quarantine(self, model: str, reason: str = "") -> None:
+        """Flip ``model`` to quarantined (idempotent: a fault while
+        already quarantined only refreshes the reason)."""
+        now = time.monotonic()
+        with self._lock:
+            self._ever.add(model)
+            state = self._quarantined.get(model)
+            if state is not None:
+                state["reason"] = reason or state["reason"]
+                return
+            self._quarantined[model] = {
+                "since": now,
+                "reason": reason,
+                "backoff_s": self.probe_backoff_s,
+                "probe_at": now + self.probe_backoff_s,
+                "probes_failed": 0,
+                "escalated": False,
+            }
+        core = self.core
+        if core is not None:
+            log_off_loop(core.log, "error",
+                         f"model '{model}' quarantined: {reason}")
+            # every quarantine ships a postmortem bundle: the operator
+            # gets the thread dump + subsystem snapshots from the moment
+            # the device went bad, not a reconstruction
+            core.incidents.trigger(
+                "device_fault",
+                reason=f"model '{model}' quarantined: {reason}",
+                context={"model": model, "reason": reason})
+
+    def unquarantine(self, model: str) -> None:
+        with self._lock:
+            if self._quarantined.pop(model, None) is None:
+                return
+            # a fresh fault after release starts a fresh window — stale
+            # pre-quarantine faults must not instantly re-trip
+            self._recent.pop(model, None)
+        core = self.core
+        if core is not None:
+            log_off_loop(core.log, "warning",
+                         f"model '{model}' un-quarantined")
+
+    def is_quarantined(self, model: str) -> bool:
+        with self._lock:
+            return model in self._quarantined
+
+    def retry_in(self, model: str) -> float:
+        """Pushback horizon for a quarantine refusal: the time until the
+        next probe could release the model (floored at 50 ms so the
+        client never busy-loops)."""
+        now = time.monotonic()
+        with self._lock:
+            state = self._quarantined.get(model)
+            if state is None:
+                return 0.05
+            return max(0.05, state["probe_at"] - now)
+
+    # -- probing -------------------------------------------------------
+
+    def register_probe(self, model: str, cb) -> None:
+        """``cb() -> bool`` issues one real probe dispatch (the decode
+        worker registers a tiny tick against its rebuilt cache); True
+        un-quarantines."""
+        with self._lock:
+            self._probes[model] = cb
+
+    def maybe_probe(self, now: Optional[float] = None) -> None:
+        """Run due probes (called periodically — the FleetController's
+        evaluate loop drives it when autoscaling is on; the quarantine
+        drill tests call it directly).  Probes run on their own daemon
+        threads: a probe IS a device dispatch and must never block the
+        caller's loop."""
+        now = time.monotonic() if now is None else now
+        due: List[Tuple[str, Any]] = []
+        with self._lock:
+            for model, state in self._quarantined.items():
+                if now < state["probe_at"] or model in self._probing:
+                    continue
+                cb = self._probes.get(model)
+                if cb is None:
+                    # no probe wired: optimistic timed release (see class
+                    # docstring — the K-in-window detector bounds flap)
+                    due.append((model, None))
+                else:
+                    self._probing.add(model)
+                    due.append((model, cb))
+        for model, cb in due:
+            if cb is None:
+                self.unquarantine(model)
+                continue
+            threading.Thread(
+                target=self._run_probe, args=(model, cb),
+                daemon=True, name=f"tc-tpu-fault-probe-{model}").start()
+
+    def _run_probe(self, model: str, cb) -> None:
+        try:
+            ok = bool(cb())
+        except Exception:  # noqa: BLE001 — a raising probe is a failed probe
+            ok = False
+        finally:
+            with self._lock:
+                self._probing.discard(model)
+        self.note_probe_result(model, ok)
+
+    def note_probe_result(self, model: str, ok: bool) -> None:
+        if ok:
+            self.unquarantine(model)
+            return
+        escalate = None
+        with self._lock:
+            state = self._quarantined.get(model)
+            if state is None:
+                return
+            state["probes_failed"] += 1
+            state["backoff_s"] = min(self.probe_backoff_max_s,
+                                     state["backoff_s"] * 2.0)
+            state["probe_at"] = time.monotonic() + state["backoff_s"]
+            if (state["probes_failed"] >= self.escalate_after
+                    and not state["escalated"]):
+                state["escalated"] = True
+                escalate = dict(state)
+        if escalate is not None:
+            core = self.core
+            if core is not None:
+                log_off_loop(
+                    core.log, "error",
+                    f"model '{model}' still quarantined after "
+                    f"{escalate['probes_failed']} failed probes; "
+                    "escalating to supervisor")
+            cb = self.escalation_cb
+            if cb is not None:
+                try:
+                    cb(model, escalate)
+                except Exception:  # noqa: BLE001 — escalation must not kill probing
+                    pass
+
+    # -- surfaces ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "faults": {f"{m}/{k}": v
+                           for (m, k), v in sorted(self._faults.items())},
+                "recovered": dict(self._recovered),
+                "aborted": dict(self._aborted),
+                "quarantined": {
+                    m: {"since_s": round(now - s["since"], 3),
+                        "reason": s["reason"],
+                        "backoff_s": s["backoff_s"],
+                        "probes_failed": s["probes_failed"],
+                        "escalated": s["escalated"]}
+                    for m, s in sorted(self._quarantined.items())},
+            }
+
+    def metric_rows(self) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+        """Rows for metrics.collect_families — the nv_device_fault_total /
+        nv_device_recovered_sequences_total / nv_device_quarantine
+        families."""
+        with self._lock:
+            fault = [({"model": m, "kind": k}, float(v))
+                     for (m, k), v in sorted(self._faults.items())]
+            recovered = [({"model": m}, float(v))
+                         for m, v in sorted(self._recovered.items())]
+            aborted = [({"model": m}, float(v))
+                       for m, v in sorted(self._aborted.items())]
+            quarantine = [({"model": m},
+                           1.0 if m in self._quarantined else 0.0)
+                          for m in sorted(self._ever)]
+        return {"device_fault": fault, "device_recovered": recovered,
+                "device_aborted": aborted, "device_quarantine": quarantine}
+
+
 def _batch_count(inputs: Dict[str, np.ndarray]) -> int:
     for v in inputs.values():
         return int(np.asarray(v).shape[0]) if np.asarray(v).ndim > 0 else 1
@@ -661,6 +929,11 @@ class InferenceCore:
         self.flight_recorder.incidents = self.incidents
         # optional fault injector (server/chaos.py; --chaos CLI flags)
         self.chaos = None
+        # device-fault containment: fault accounting + per-model
+        # quarantine state machine (the decode worker reports dispatch
+        # faults; admission and readiness consult it; the fleet
+        # controller drives its probe schedule)
+        self.device_faults = DeviceFaultManager(self)
         # closed-loop fleet controller (server/fleet.py): per-model
         # instance autoscaling + rolling version updates.  None = open
         # loop (the nv_fleet_instances / serving-version gauges still
@@ -706,6 +979,14 @@ class InferenceCore:
         serve an inference now", not "the frontends answered")."""
         return (self.live and self.accepting and self.startup_complete
                 and not self.registry.any_loading())
+
+    def model_ready(self, name: str, version: str = "") -> bool:
+        """Model-level readiness for both protocols: registry-ready AND
+        not quarantined after device faults.  Server-level ``ready()``
+        stays unaffected — one bad model must not fail the whole
+        replica's health check while its siblings serve."""
+        return (self.registry.is_ready(name, version)
+                and not self.device_faults.is_quarantined(name))
 
     # -- resilience ----------------------------------------------------
     def count_deadline_exceeded(self, model_name: str) -> None:
@@ -753,6 +1034,18 @@ class InferenceCore:
             err = InferError("server is shutting down", http_status=503,
                              retry_after_s=self.shed_retry_after_s)
             err.refusal_reason = "drain"
+            raise err
+        if self.device_faults.is_quarantined(model.name):
+            # typed retryable refusal with a probe-horizon pushback: the
+            # 'quarantined' marker is what the client resilience layer
+            # classifies on (is_quarantine_error) to retry on ANOTHER
+            # replica rather than hammering this one
+            err = InferError(
+                f"model '{model.name}' is quarantined after repeated "
+                "device faults; retry on another replica",
+                http_status=503,
+                retry_after_s=self.device_faults.retry_in(model.name))
+            err.refusal_reason = "quarantine"
             raise err
         qos = self.qos
         request.tier = qos.tier_of(request.priority)
@@ -1310,6 +1603,17 @@ class InferenceCore:
             attach_ledger(self.cost_ledger)
             if request.tenant:
                 params["_cost_tenant"] = request.tenant
+        # device-fault containment: the decode worker reports dispatch
+        # faults/recoveries into the manager (which quarantines) and, when
+        # a chaos injector is armed, consults it at dispatch boundaries
+        # for seeded device_error drills
+        attach_faults = getattr(model, "attach_device_faults", None)
+        if attach_faults is not None:
+            attach_faults(self.device_faults)
+        if self.chaos is not None:
+            attach_chaos = getattr(model, "attach_chaos", None)
+            if attach_chaos is not None:
+                attach_chaos(self.chaos)
         # current-trace contextvar set AROUND the whole stream (and reset
         # in the finally): shm staging transfers, request-scoped server-log
         # lines, and the decode worker's lifecycle spans all key off
@@ -1720,6 +2024,17 @@ class InferenceCore:
             attach_gov = getattr(model, "attach_memory_governor", None)
             if attach_gov is not None:
                 attach_gov(self.memory)
+            # device-fault containment wiring rides the same idempotent
+            # stamp: the decode worker must be able to report a failed
+            # dispatch (and consult the chaos injector) from the very
+            # first sequence-protocol request
+            attach_faults = getattr(model, "attach_device_faults", None)
+            if attach_faults is not None:
+                attach_faults(self.device_faults)
+            if self.chaos is not None:
+                attach_chaos = getattr(model, "attach_chaos", None)
+                if attach_chaos is not None:
+                    attach_chaos(self.chaos)
             t_c0 = time.monotonic_ns() if (traces or want_ds) else 0
             outputs = model.execute(inputs, params)
             t_c1 = time.monotonic_ns() if (traces or want_ds) else 0

@@ -347,6 +347,28 @@ class FleetController:
         # cancel a mid-bake update instead of letting it actuate
         # against a torn-down core after shutdown
         self._update_tasks: Dict[str, asyncio.Task] = {}
+        # device-fault escalation: when a quarantined model's probes keep
+        # failing, the fault manager calls back here — the controller is
+        # the fleet-facing signal surface.  In-process there is nothing
+        # left to actuate (more instances share the same sick device), so
+        # the honest action is to make the escalation loudly visible on
+        # the fleet metrics and leave the restart to the supervisor /
+        # operator.  Embedders with a real supervisor hook can overwrite
+        # core.device_faults.escalation_cb after constructing the
+        # controller (last writer wins — the CLI worker path does).
+        faults = getattr(core, "device_faults", None)
+        if faults is not None and faults.escalation_cb is None:
+            faults.escalation_cb = self._on_fault_escalation
+
+    def _on_fault_escalation(self, name: str, state: Dict) -> None:
+        """Default quarantine-escalation hook (thread-safe; called from
+        the fault manager's probe thread): count the event on the fleet
+        surface — ``nv_fleet_rolling_update_total{outcome=
+        "device_fault_escalated"}`` — so dashboards and triton-top's
+        fleet view page on it alongside scale/update actuations."""
+        with self._lock:
+            key = (name, "device_fault_escalated")
+            self.update_events[key] = self.update_events.get(key, 0) + 1
 
     # -- bounds / desired state --------------------------------------------
     def _config_bounds(self, name: str) -> Optional[Tuple[int, int]]:
@@ -453,12 +475,25 @@ class FleetController:
         in-memory reads (SLO windows, batcher lanes, duty cycle) — safe
         on the event loop."""
         now = time.monotonic() if now is None else now
+        # device-fault containment rides this loop: due quarantine
+        # probes fire here (on their own threads — a probe is a device
+        # dispatch and must not block evaluation)
+        self._core.device_faults.maybe_probe(now)
         for model in self._core.registry.ready_models():
             name = model.name
             bounds = self.bounds_for(name)
             if bounds is None:
                 continue
             lo, hi = bounds
+            if self._core.device_faults.is_quarantined(name):
+                # a quarantined model's signals are meaningless (nothing
+                # is admitted): hold its target where it is — above all
+                # never scale IN on the artificial idleness — and treat
+                # its refusals as scale-out pressure for the rest of the
+                # fleet via the cluster client's rerouting
+                with self._lock:
+                    self._idle_streak[name] = 0
+                continue
             desired = self.desired_instances(name) or lo
             if desired < lo or desired > hi:
                 # bounds narrowed at runtime: converge immediately

@@ -109,7 +109,7 @@ class FlightRecord:
                  "batch", "bytes_in", "bytes_out", "arrival_ns", "ts",
                  "queue_us", "compute_us", "total_us", "outcome",
                  "capture_reason", "spans", "chaos", "tenant", "tier",
-                 "tick", "shed_reason", "cost")
+                 "tick", "shed_reason", "cost", "fault", "recovered")
 
     def __init__(self, seq: int, model: str, version: str,
                  request_id: str = "", protocol: str = "",
@@ -151,6 +151,14 @@ class FlightRecord:
         # attributed device-time/FLOPs share and tenant — the join
         # between the flight ring and the per-tenant cost ledger
         self.cost: Optional[Dict[str, Any]] = None
+        # device-fault containment stamps (models/decode.py): ``fault``
+        # is the fault kind whose rebuild interrupted this generation;
+        # ``recovered`` flips True when the recovery re-prefill landed
+        # and the stream resumed bit-identical — a faulted-but-recovered
+        # record is the success story, a faulted-unrecovered one is the
+        # typed-500 abort
+        self.fault: Optional[str] = None
+        self.recovered = False
 
     def to_dict(self, include_spans: bool = False) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -175,6 +183,8 @@ class FlightRecord:
             "tick": self.tick,
             "shed_reason": self.shed_reason,
             "cost": self.cost,
+            "fault": self.fault,
+            "recovered": self.recovered,
         }
         if include_spans:
             out["spans"] = self.spans or []

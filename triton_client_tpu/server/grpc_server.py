@@ -207,8 +207,10 @@ class InferenceServicer:
         return pb.ServerReadyResponse(ready=self._core.ready())
 
     async def ModelReady(self, request, context):
+        # registry-ready AND not quarantined after device faults
+        # (mirrors HTTP /v2/models/{m}/ready; InferenceCore.model_ready)
         return pb.ModelReadyResponse(
-            ready=self._core.registry.is_ready(request.name, request.version)
+            ready=self._core.model_ready(request.name, request.version)
         )
 
     async def ServerMetadata(self, request, context):

@@ -278,7 +278,10 @@ async def _health_ready(core, request):
 
 
 async def _model_ready(core, request):
-    ok = core.registry.is_ready(
+    # registry-ready AND not quarantined after device faults — a load
+    # balancer stops routing at a quarantined model while the server
+    # itself stays healthy (see InferenceCore.model_ready)
+    ok = core.model_ready(
         request.match_info["model"], request.match_info.get("version", "")
     )
     return web.Response(status=200 if ok else 400)

@@ -56,7 +56,7 @@ MANIFEST_SCHEMA = 1
 
 # every trigger source the recorder accepts; anything else is a caller bug
 TRIGGER_CLASSES = ("slo_burn", "worker_crash", "watchdog_storm", "chaos",
-                   "sigusr2", "manual")
+                   "sigusr2", "manual", "device_fault")
 
 _BUNDLE_PREFIX = "incident-"
 
@@ -242,8 +242,15 @@ class IncidentRecorder:
     def _write_bundle(self, path: str, kind: str, reason: str,
                       context: Optional[Dict[str, Any]]) -> None:
         ts = time.time()
-        tmp = os.path.join(os.path.dirname(path),
-                           f".tmp-{os.path.basename(path)}-{os.getpid()}")
+        # pid alone is not unique: multiple cores in ONE process (harness
+        # fleets) can trigger the same stamp+seq into a shared dir — the
+        # writer thread id keeps their staging areas disjoint (the final
+        # os.replace still resolves the rare same-name race: one bundle
+        # publishes, the loser cleans up)
+        tmp = os.path.join(
+            os.path.dirname(path),
+            f".tmp-{os.path.basename(path)}-{os.getpid()}"
+            f"-{threading.get_ident()}")
         os.makedirs(tmp, exist_ok=True)
         files: List[Dict[str, Any]] = []
 

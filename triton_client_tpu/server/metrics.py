@@ -225,6 +225,26 @@ _HOST_FAMILIES: List[Tuple[str, str, str, str]] = [
      "bundle produced, suppressed = rate-limited away)"),
 ]
 
+#: ``nv_device_*`` fault-containment family declarations, keyed by
+#: ``DeviceFaultManager.metric_rows`` (server/core.py): dispatch faults,
+#: in-flight generation recoveries, and the quarantine gauge.
+_FAULT_FAMILIES: List[Tuple[str, str, str, str]] = [
+    ("device_fault", "nv_device_fault_total", "counter",
+     "Device dispatch faults reported by the decode worker per model "
+     "and fault kind (prefill / step / readback / rebuild / tick_stall)"),
+    ("device_recovered", "nv_device_recovered_sequences_total", "counter",
+     "Server-side generations recovered bit-identical after a device "
+     "fault (re-admitted and re-prefilled from prompt + emitted tokens)"),
+    ("device_aborted", "nv_device_aborted_sequences_total", "counter",
+     "Server-side generations aborted with a typed 500 after a device "
+     "fault (recovery budget exhausted, no free slot, or stream already "
+     "failed)"),
+    ("device_quarantine", "nv_device_quarantine", "gauge",
+     "1 while the model is quarantined after repeated device faults "
+     "(not-ready on both protocols, typed retryable 503s with pushback; "
+     "probe dispatches un-quarantine on success)"),
+]
+
 #: ``nv_slo_*`` family declarations, keyed by ``SloEngine.metric_rows``.
 _SLO_FAMILIES: List[Tuple[str, str, str, str]] = [
     ("burn_rate", "nv_slo_burn_rate", "gauge",
@@ -353,6 +373,11 @@ def collect_families(core: InferenceCore) -> List[Family]:
     slo_rows = core.slo.metric_rows()
     for key, name, kind, help_text in _SLO_FAMILIES:
         families.append((name, help_text, kind, slo_rows.get(key, [])))
+
+    # -- device-fault containment (server/core.py DeviceFaultManager) -----
+    fault_rows = core.device_faults.metric_rows()
+    for key, name, kind, help_text in _FAULT_FAMILIES:
+        families.append((name, help_text, kind, fault_rows.get(key, [])))
 
     # -- host self-observation (server/profiler.py, incident.py) ----------
     host_rows = core.profiler.metric_rows()

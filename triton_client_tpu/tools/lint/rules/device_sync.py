@@ -13,8 +13,12 @@ syncs from creeping back into the tick.
 
 What fires, inside ``models/decode.py`` ONLY and only within the
 worker-loop/tick-path functions (``_worker_loop`` and everything
-lexically nested in it, ``_resolve*``, ``_dispatch*``, and the shared
-``finish_readback`` resolve helper):
+lexically nested in it, ``_resolve*``, ``_dispatch*``, the
+device-fault recovery and readback-watchdog paths (``_recover*``,
+``_watch*``, ``_maybe_inject*`` — they interleave with live ticks on
+the worker and gen-reader threads, so a blocking sync there stalls
+every in-flight generation exactly like one in the tick itself), and
+the shared ``finish_readback`` resolve helper):
 
 * ``np.asarray(...)`` / ``np.array(...)`` — on a device array this is a
   blocking D2H round trip; resolve through the started readback
@@ -47,10 +51,13 @@ from .._engine import Finding, Project, register_rule
 _DECODE_FILE = re.compile(r"(^|/)models/decode\.py$")
 
 #: Tick-path root functions: the worker loop (everything nested in it
-#: runs on the worker thread), the pipelined resolvers, and the shared
-#: blocking resolve helper.
+#: runs on the worker thread), the pipelined resolvers, the
+#: device-fault recovery / readback-watchdog / chaos-injection paths
+#: (they share the worker and gen-reader threads with live ticks), and
+#: the shared blocking resolve helper.
 _ROOT_EXACT = {"_worker_loop", "finish_readback"}
-_ROOT_PREFIXES = ("_resolve", "_dispatch")
+_ROOT_PREFIXES = ("_resolve", "_dispatch", "_recover", "_watch",
+                  "_maybe_inject")
 
 #: Fully-qualified call targets that are blocking syncs on device arrays.
 _SYNC_CALLS = {
@@ -76,7 +83,8 @@ def _is_tick_root(name: str) -> bool:
     "DEVICE-SYNC",
     "no blocking host<->device syncs (np.asarray/jax.device_get/.item()/"
     "block_until_ready) inside models/decode.py's worker-loop/tick-path "
-    "functions (pragma the one double-buffer resolve point)")
+    "functions, including the device-fault recovery and readback-watchdog "
+    "paths (pragma the one double-buffer resolve point)")
 def check(project: Project):
     for f in project.files:
         if f.tree is None:

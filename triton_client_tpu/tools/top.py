@@ -124,7 +124,8 @@ def parse_device(text: str) -> Dict[str, Any]:
                            "restarts": {},
                            "mem_inflight": {}, "mem_budget": None,
                            "mem_shed": {},
-                           "host_lag_us": None, "host_gc_us": None}
+                           "host_lag_us": None, "host_gc_us": None,
+                           "fault": {}, "quar": {}}
     for line in text.splitlines():
         if line.startswith("#"):
             continue
@@ -169,7 +170,8 @@ def parse_device(text: str) -> Dict[str, Any]:
                         "nv_fleet_serving_version", "nv_fleet_scale_total",
                         "nv_mem_inflight_bytes", "nv_mem_shed_total",
                         "nv_tpu_roofline_arithmetic_intensity",
-                        "nv_tpu_roofline_pct_of_peak"
+                        "nv_tpu_roofline_pct_of_peak",
+                        "nv_device_fault_total", "nv_device_quarantine"
                         ) and name not in _BUCKET_METRICS:
             continue
         labels = dict(_LABEL_RE.findall(labels_raw or ""))
@@ -196,6 +198,13 @@ def parse_device(text: str) -> Dict[str, Any]:
             # per model; the reason split stays on the metrics surface
             out["mem_shed"][model] = (out["mem_shed"].get(model, 0.0)
                                       + float(value))
+        elif name == "nv_device_fault_total":
+            # summed over fault kinds: the FAULT column answers "is this
+            # model's device faulting"; the kind split stays on /metrics
+            out["fault"][model] = (out["fault"].get(model, 0.0)
+                                   + float(value))
+        elif name == "nv_device_quarantine":
+            out["quar"][model] = float(value)
         elif name == "nv_tpu_roofline_arithmetic_intensity":
             # gauges, not counters: the buckets view shows the current
             # value, never a delta
@@ -411,9 +420,27 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
             "slo_breach": (burn5 is not None and burn1h is not None
                            and burn5 >= device.get("burn_threshold", 14.4)
                            and burn1h >= device.get("burn_threshold", 14.4)),
+            # device-fault containment: fault rate between polls
+            # (cumulative on the first/only sample) and the quarantine
+            # flag — QUAR shows the model is refusing with typed 503s
+            "fault_per_s": (round(_fault_delta(device, pdevice, model)
+                                  / dt, 1) if dt
+                            else device.get("fault", {}).get(model)),
+            "quarantined": bool(device.get("quar", {}).get(model, 0.0)),
             "last_outlier": _outlier_brief(last_outlier.get(model)),
         }
     return rows
+
+
+def _fault_delta(device: Dict[str, Any], pdevice: Optional[Dict[str, Any]],
+                 model: str) -> float:
+    """nv_device_fault_total movement between polls (summed over fault
+    kinds; counter-reset clamps at the new value, like ``_delta``)."""
+    now = device.get("fault", {}).get(model, 0.0)
+    if pdevice is None:
+        return now
+    d = now - pdevice.get("fault", {}).get(model, 0.0)
+    return now if d < 0 else d
 
 
 def _gc_rate(device: Dict[str, Any], pdevice: Optional[Dict[str, Any]],
@@ -821,6 +848,11 @@ def aggregate_rows(per_url_rows: Dict[str, Dict[str, Dict[str, Any]]]
             "scaled": "".join(sorted({c for r in rows
                                       for c in (r.get("scaled") or "")}),
                               ) or None,
+            # device faults sum across replicas; QUAR flags when ANY
+            # replica is refusing traffic (the one the client routes
+            # around — exactly what the operator should see)
+            "fault_per_s": _sum("fault_per_s"),
+            "quarantined": any(r.get("quarantined") for r in rows),
             "last_outlier": (min(outliers, key=lambda o: o["age_s"])
                             if outliers else None),
         }
@@ -842,6 +874,7 @@ _COLUMNS = (f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
             f"{'SLOW':>6}{'CAPT':>6}{'DUTY%':>7}{'MEM%':>7}{'SHED/s':>8}"
             f"{'INST':>6}{'VER':>5}"
             f"{'LAGms':>8}{'GCms/s':>8}"
+            f"{'FAULT':>7}{'QUAR':>6}"
             f"{'BURN':>9}"
             f"  LAST OUTLIER")
 
@@ -878,6 +911,8 @@ def _row_line(label: str, r: Dict[str, Any]) -> str:
         f"{_fmt(r.get('instances')):>6}{_fmt(r.get('version')):>5}"
         f"{_fmt(r.get('host_lag_ms'), 2):>8}"
         f"{_fmt(r.get('gc_ms_per_s'), 2):>8}"
+        f"{_fmt(r.get('fault_per_s')):>7}"
+        f"{('QUAR' if r.get('quarantined') else '-'):>6}"
         f"{burn:>9}  {brief}")
 
 
