@@ -31,6 +31,10 @@ jax = pytest.importorskip("jax")
 _ENV = {
     "TRITON_TPU_DECODE_MODE": "batched",
     "TRITON_TPU_DECODE_SLOTS": "4",
+    # prefix/KV cache on: the shared-prefix drill pins cached-block
+    # residency on the PINNING tenant (cache blocks only unpin at
+    # eviction, so the slot-pin reconciliation tests are unaffected)
+    "TRITON_TPU_KV_CACHE_BYTES": str(64 << 20),
 }
 
 
@@ -156,6 +160,82 @@ class TestConservation:
             # charged with exactly what kv_unpin integrated — equality
             # by construction, not a sampling tolerance
             assert led_d == pytest.approx(gov_d, rel=1e-9), tenant
+
+
+def _await_slot_unpins(core):
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        with core.memory._lock:
+            if not core.memory._kv_pins:
+                return
+        time.sleep(0.02)
+
+
+class TestSharedPrefixPinning:
+    def test_pinning_tenant_charged_until_eviction_no_double_charge(
+            self, server):
+        """Cached-block byte-seconds charge the tenant whose prefill
+        COMMITTED the block, from commit until eviction; tenants that
+        merely hit the block are never charged for its residency — and
+        the eviction charge is exactly the governor integrator's return
+        (conservation), bracketed by wall-clock residency bounds."""
+        from triton_client_tpu.server import kvcache
+
+        core = server.core
+        cache = kvcache.get("llama_decode")
+        assert cache is not None, "KV cache must be live for this drill"
+
+        # >64 prompt tokens: the first block is unique to this prompt
+        # (shorter prompts left-pad with zeros and share a block)
+        prompt = "shared prefix pinning drill " * 4
+        pinned_before = cache.stats()["pinned_bytes"]
+        t_pin_lo = time.monotonic()
+        frames = _stream(server, {"text_input": prompt, "max_tokens": 4},
+                         headers={"triton-tenant": "pinner"})
+        t_pin_hi = time.monotonic()
+        pinned_by_drill = cache.stats()["pinned_bytes"] - pinned_before
+        assert pinned_by_drill > 0
+
+        # two riders hit the pinner's block — free rides, bit-identical
+        hits0 = cache.stats()["hits"]
+        for _ in range(2):
+            warm = _stream(server,
+                           {"text_input": prompt, "max_tokens": 4},
+                           headers={"triton-tenant": "rider"})
+            assert ([f["text_output"] for f in warm]
+                    == [f["text_output"] for f in frames])
+        assert cache.stats()["hits"] - hits0 == 2
+
+        # measurable residency, then settle the riders' slot unpins so
+        # the eviction charge is the ONLY delta across clear()
+        time.sleep(0.25)
+        _await_slot_unpins(core)
+        gov0 = _governor_kv(core)
+        rows0 = core.cost_ledger.snapshot()["models"]["llama_decode"]
+        t_evict_lo = time.monotonic()
+        cache.clear()
+        t_evict_hi = time.monotonic()
+
+        gov1 = _governor_kv(core)
+        rows1 = core.cost_ledger.snapshot()["models"]["llama_decode"]
+
+        def led_delta(tenant):
+            a = (rows0.get(tenant) or {}).get("kv_byte_seconds", 0.0)
+            b = (rows1.get(tenant) or {}).get("kv_byte_seconds", 0.0)
+            return b - a
+
+        # hits are not double-charged: eviction bills the rider nothing
+        assert led_delta("rider") == 0.0
+        # the pinning tenant pays, with exactly the governor's integral
+        pinner = led_delta("pinner")
+        gov_d = gov1.get("pinner", 0.0) - gov0.get("pinner", 0.0)
+        assert pinner > 0.0
+        assert pinner == pytest.approx(gov_d, rel=1e-9)
+        # conservation vs wall clock: bytes x residency brackets the
+        # charge (the 5% contract tolerance absorbs clock skew)
+        lo = pinned_by_drill * (t_evict_lo - t_pin_hi)
+        hi = pinned_by_drill * (t_evict_hi - t_pin_lo)
+        assert lo * 0.95 <= pinner <= hi * 1.05
 
 
 class TestOpenAIUsageCost:

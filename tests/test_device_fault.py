@@ -448,3 +448,89 @@ class TestGenerationRecovery:
         assert not errs  # recovered as usual
         assert mgr.is_quarantined(MODEL)
         assert mgr.is_quarantined("llama_generate_fault")
+
+
+class TestWarmCacheRecovery:
+    """Device-fault drills against a WARM prefix/KV cache (ISSUE 20):
+    the donated-bucket rebuild revalidates the block store — surviving
+    blocks keep serving hits, deleted ones are dropped — and recovered
+    streams stay bit-identical either way."""
+
+    @pytest.fixture()
+    def dec(self, monkeypatch):
+        from triton_client_tpu.models.decode import DecodeModel
+        from triton_client_tpu.server import kvcache
+
+        monkeypatch.setenv("TRITON_TPU_DECODE_MODE", "batched")
+        monkeypatch.setenv("TRITON_TPU_DECODE_SLOTS", "4")
+        monkeypatch.delenv("TRITON_TPU_DECODE_BUCKETS", raising=False)
+        monkeypatch.delenv("TRITON_TPU_RECOVERY_BUDGET", raising=False)
+        monkeypatch.delenv("TRITON_TPU_TICK_STALL_MS", raising=False)
+        monkeypatch.setenv(kvcache.cache_env_key(MODEL), str(64 << 20))
+        m = DecodeModel(name=MODEL)
+        yield m
+        m._shutdown()
+
+    def test_device_error_against_warm_cache_is_bit_identical(self, dec):
+        """A seeded device_error on a warm-cache prefill: committed
+        blocks live in buffers independent of the donated slab, so the
+        rebuild's revalidation KEEPS them and the recovery re-prefill
+        hits again — streams bit-identical, zero caller errors."""
+        from triton_client_tpu.server import kvcache
+
+        win = _prompt_window([7, 11, 13, 17, 19])
+        want, errs = _drain(dec.submit_generation(win, 6))
+        assert len(want) == 6 and not errs
+        cache = kvcache.get(MODEL)
+        blocks_before = cache.stats()["blocks"]
+        assert blocks_before >= 1
+
+        mgr = DeviceFaultManager(threshold=100)
+        dec.attach_device_faults(mgr)
+        dec.attach_chaos(ChaosInjector(rate=1.0, kinds=["device_error"],
+                                       seed=5, max_faults=1))
+        toks, errs = _drain(dec.submit_generation(win, 6))
+        assert dec._chaos.injected_total == 1
+        assert not errs
+        assert toks == want
+        assert mgr.snapshot()["recovered"].get(MODEL, 0) >= 1
+        # the rebuild revalidated rather than flushed: the store still
+        # holds the chain, and the recovery prefill HIT it
+        st = cache.stats()
+        assert st["blocks"] == blocks_before
+        assert st["hits"] >= 1
+
+    def test_deleted_block_buffers_are_dropped_then_recovered_cold(
+            self, dec):
+        """The invalidation rule: a cached block whose device buffers
+        died (here: deleted outright, the worst case of a fault tearing
+        down donated memory) is DROPPED at revalidation — the recovery
+        re-prefill runs cold, recommits, and still streams the exact
+        tokens of the undisturbed run."""
+        from triton_client_tpu.server import kvcache
+
+        win = _prompt_window([4, 8, 15, 16, 23, 42])
+        want, errs = _drain(dec.submit_generation(win, 5))
+        assert len(want) == 5 and not errs
+        cache = kvcache.get(MODEL)
+        assert cache.stats()["blocks"] >= 1
+
+        mgr = DeviceFaultManager(threshold=100)
+        dec.attach_device_faults(mgr)
+        # kill every committed block's device buffers behind the
+        # store's back — the insert dispatch then fails like any other
+        # device fault and the rebuild must notice the corpses
+        with cache._lock:
+            for blk in cache._blocks.values():
+                blk.k.delete()
+                blk.v.delete()
+        toks, errs = _drain(dec.submit_generation(win, 5))
+        assert not errs
+        assert toks == want
+        assert mgr.snapshot()["recovered"].get(MODEL, 0) >= 1
+        # dead blocks were dropped (not served), and the recovered cold
+        # prefill recommitted the chain for the next admission
+        st = cache.stats()
+        assert st["blocks"] >= 1
+        toks2, errs = _drain(dec.submit_generation(win, 5))
+        assert not errs and toks2 == want

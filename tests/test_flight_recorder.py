@@ -281,7 +281,8 @@ _RECORD_KEYS = {"seq", "request_id", "model", "version", "protocol",
                 "batch", "bytes_in", "bytes_out", "ts", "queue_us",
                 "compute_us", "total_us", "outcome", "captured",
                 "capture_reason", "chaos", "tenant", "tier", "tick",
-                "shed_reason", "cost", "fault", "recovered"}
+                "shed_reason", "cost", "fault", "recovered",
+                "cache_hit_tokens", "prefix_hash"}
 _TOP_LEVEL_KEYS = {"enabled", "capture_slower_than", "ring_capacity",
                    "outlier_capacity", "recorded_total", "models",
                    "recent", "outliers"}
@@ -302,6 +303,11 @@ class TestDebugEndpoint:
         assert rec["bytes_in"] == 2 * 16 * 4  # two [1,16] int32 tensors
         assert rec["bytes_out"] == 2 * 16 * 4
         assert rec["total_us"] > 0
+        # prefix-cache fields are always present (0/null on a request
+        # that never touched the KV block store) so downstream consumers
+        # need no key-existence special cases
+        assert rec["cache_hit_tokens"] == 0
+        assert rec["prefix_hash"] is None
         mstats = snap["models"]["simple"]
         assert {"count", "mean_ms", "p50_ms", "p90_ms", "p99_ms",
                 "threshold_ms", "slow_total", "captured_total"} == set(mstats)
@@ -545,7 +551,12 @@ class TestTritonTop:
                 "scaled", "mem_pct", "mem_shed_per_s",
                 "host_lag_ms", "gc_ms_per_s",
                 "fault_per_s", "quarantined",
+                "cache_hits_d", "cache_lookups_d", "hit_pct", "cache_mb",
                 "last_outlier"} == set(row)
+        # no KV cache on this model: percentage and footprint stay None
+        # (never fabricated zeros), raw deltas stay 0 for the aggregator
+        assert row["hit_pct"] is None and row["cache_mb"] is None
+        assert row["cache_hits_d"] == 0 and row["cache_lookups_d"] == 0
         # fleet columns materialize from the nv_fleet_* series: the
         # harness server exports a serving version for every model
         assert row["version"] == 1

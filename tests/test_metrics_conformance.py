@@ -495,6 +495,86 @@ class TestCostSurface:
             ledger.reset()
 
 
+class TestCacheSurface:
+    """The nv_cache_* prefix/KV block-store families (server/kvcache.py)
+    parse under the exposition grammar, are typed, survive adversarial
+    model names, and round-trip through the JSON snapshot — with the
+    governor's ``nv_mem_cache_pinned_bytes`` reservation gauge agreeing
+    with the store's own pinned-bytes gauge."""
+
+    EVIL_MODEL = 'evil"cache\\model\nname'
+
+    def _drive_cache(self, server):
+        from triton_client_tpu.server import kvcache
+
+        c = kvcache.for_model(self.EVIL_MODEL,
+                              governor=server.core.memory,
+                              ledger=server.core.cost_ledger,
+                              budget_bytes=32, block_tokens=4)
+        toks = np.arange(9, dtype=np.int32)
+        digs = c.chain_digests(toks)
+        blk = lambda: np.zeros(8, np.uint8)  # noqa: E731
+        for i, d in enumerate(digs):
+            c.put(d, digs[i - 1] if i else b"", blk(), blk(), "t")
+        _hit, blocks, _ = c.match(toks)
+        c.release(blocks)
+        c.match(np.full(9, 77, np.int32))   # one miss
+        # a divergent root over the full budget forces an eviction
+        c.put(c.chain_digests(np.full(5, 9, np.int32))[0], b"",
+              blk(), blk(), "t")
+        return c
+
+    def test_families_typed_escaped_and_round_trip(self, server):
+        from triton_client_tpu.server import kvcache
+        from triton_client_tpu.server.metrics import snapshot
+
+        self._drive_cache(server)
+        try:
+            families = assert_conformant(_scrape(server.http_url))
+            for fam in ("nv_cache_hit_total", "nv_cache_miss_total",
+                        "nv_cache_evict_total",
+                        "nv_cache_hit_tokens_total"):
+                assert families[fam]["type"] == "counter", fam
+            assert families["nv_cache_pinned_bytes"]["type"] == "gauge"
+
+            def unescape(v):
+                return (v.replace("\\n", "\n").replace('\\"', '"')
+                        .replace("\\\\", "\\"))
+
+            def by_model(fam):
+                return {unescape(l["model"]): v for _, l, v in
+                        families[fam]["samples"]}
+
+            assert by_model("nv_cache_hit_total")[self.EVIL_MODEL] == 1.0
+            assert by_model("nv_cache_miss_total")[self.EVIL_MODEL] == 1.0
+            assert by_model("nv_cache_hit_tokens_total")[
+                self.EVIL_MODEL] == 8.0
+            assert by_model("nv_cache_evict_total")[self.EVIL_MODEL] >= 1.0
+            pinned = by_model("nv_cache_pinned_bytes")[self.EVIL_MODEL]
+            assert pinned == 16.0
+            # every family carries exactly the model label
+            for fam in ("nv_cache_hit_total", "nv_cache_miss_total",
+                        "nv_cache_evict_total", "nv_cache_hit_tokens_total",
+                        "nv_cache_pinned_bytes"):
+                for _, l, _ in families[fam]["samples"]:
+                    assert set(l) == {"model"}, fam
+            # governor-ledger agreement: the store's pinned bytes ARE the
+            # named nv_mem_* reservation, to the byte
+            assert by_model("nv_mem_cache_pinned_bytes")[
+                self.EVIL_MODEL] == pinned
+            # JSON snapshot parity: same families, same types, same values
+            snap = snapshot(server.core)
+            for fam in ("nv_cache_hit_total", "nv_cache_miss_total",
+                        "nv_cache_evict_total", "nv_cache_hit_tokens_total",
+                        "nv_cache_pinned_bytes"):
+                assert snap[fam]["type"] == families[fam]["type"], fam
+            snap_hits = {s["labels"]["model"]: s["value"]
+                         for s in snap["nv_cache_hit_total"]["samples"]}
+            assert snap_hits[self.EVIL_MODEL] == 1
+        finally:
+            kvcache.drop(self.EVIL_MODEL)
+
+
 class TestFleetSurface:
     """The nv_fleet_* families parse under the exposition grammar, are
     typed, carry their full label sets, and round-trip through the JSON

@@ -30,6 +30,9 @@ _ENV = {
     "TRITON_TPU_DECODE_MODE": "batched",
     "TRITON_TPU_DECODE_SLOTS": "4",
     "TRITON_TPU_TRACE_TOKEN_STRIDE": "2",
+    # prefix/KV cache on: stream records must carry the cache fields
+    # with real values on warm runs (and 0/null on cold ones)
+    "TRITON_TPU_KV_CACHE_BYTES": str(64 << 20),
 }
 
 
@@ -150,6 +153,37 @@ class TestStreamRecordShape:
                     <= row["last_tick_seq"]:
                 joined += 1
         assert joined >= 1
+
+    def test_cache_fields_cold_then_warm(self, server, tmp_path):
+        """Stream records always carry the prefix-cache outcome:
+        ``cache_hit_tokens``/``prefix_hash`` are 0/null on a cold run and
+        real values on a warm repeat, whose PREFILL span is additionally
+        stamped with a ``cached_tokens`` attribute — and the warm stream
+        is byte-identical to the cold one."""
+        tf = tmp_path / "cache.jsonl"
+        _set_trace(server, {"trace_file": [str(tf)],
+                            "trace_level": ["TIMESTAMPS"],
+                            "trace_rate": ["1"]})
+        # >64 prompt tokens: the window's first block is then unique to
+        # THIS prompt (shorter prompts left-pad with zeros and would
+        # share the all-zeros block with every other short prompt)
+        body = {"text_input": "prefix cache trace drill " * 4,
+                "max_tokens": 4}
+        cold_frames = _stream(server, body)
+        warm_frames = _stream(server, body)
+        assert [f["text_output"] for f in warm_frames] == \
+            [f["text_output"] for f in cold_frames]
+        recs = _read_traces(tf)
+        assert len(recs) == 2
+        cold, warm = recs
+        assert cold["cache_hit_tokens"] == 0
+        assert cold["prefix_hash"] is None
+        assert warm["cache_hit_tokens"] == 64
+        assert isinstance(warm["prefix_hash"], str)
+        int(warm["prefix_hash"], 16)   # hex digest
+        assert _spans_by_name(warm)["PREFILL"][0]["attrs"] == \
+            {"cached_tokens": 64}
+        assert "attrs" not in _spans_by_name(cold)["PREFILL"][0]
 
     def test_single_token_stream_still_closes_decode(self, server,
                                                      tmp_path):

@@ -910,6 +910,117 @@ def make_slot_chunk_prefill(cfg: tr.TransformerConfig, s_max: int):
     return chunk_prefill
 
 
+def make_cache_block_ops(block_tokens: int):
+    """jitted ``(extract, insert)`` pair for the prefix/KV block cache
+    (server/kvcache.py) over the shared ``[L, B, H, S, K]`` cache layout
+    (slot-slab buckets AND independent per-sequence caches — ``slot``
+    indexes axis 1 either way).
+
+    ``extract(k, v, slot, pos)`` slices one ``block_tokens``-deep block
+    into INDEPENDENT device buffers — committed blocks never alias the
+    (donated) slab, so a failed dispatch or chaos deletion of the slab
+    leaves the store's bytes intact.  ``insert(k, v, kb, vb, slot, pos)``
+    writes a stored block back verbatim (no quantize round trip): a hit
+    restores the exact bytes a cold prefill would have written, which is
+    what the hit-vs-cold bit-identity contract rests on.  k/v donated on
+    insert (in-place slab update, same convention as the step kernels)."""
+
+    def _slice_one(c, slot, pos):
+        if isinstance(c, dict):
+            L, _, H, _, K = c["q"].shape
+            return {
+                "q": lax.dynamic_slice(c["q"], (0, slot, 0, pos, 0),
+                                       (L, 1, H, block_tokens, K)),
+                "s": lax.dynamic_slice(c["s"], (0, slot, 0, pos),
+                                       (L, 1, H, block_tokens)),
+            }
+        L, _, H, _, K = c.shape
+        return lax.dynamic_slice(c, (0, slot, 0, pos, 0),
+                                 (L, 1, H, block_tokens, K))
+
+    def _write_one(c, blk, slot, pos):
+        if isinstance(c, dict):
+            return {
+                "q": lax.dynamic_update_slice(c["q"], blk["q"],
+                                              (0, slot, 0, pos, 0)),
+                "s": lax.dynamic_update_slice(c["s"], blk["s"],
+                                              (0, slot, 0, pos)),
+            }
+        return lax.dynamic_update_slice(c, blk, (0, slot, 0, pos, 0))
+
+    def _concat(blks):
+        if isinstance(blks[0], dict):
+            return {"q": jnp.concatenate([b["q"] for b in blks], axis=3),
+                    "s": jnp.concatenate([b["s"] for b in blks], axis=3)}
+        return jnp.concatenate(blks, axis=3)
+
+    @jax.jit
+    def extract(k, v, slot, pos):
+        return _slice_one(k, slot, pos), _slice_one(v, slot, pos)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def insert(k, v, kb, vb, slot, pos):
+        return _write_one(k, kb, slot, pos), _write_one(v, vb, slot, pos)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def insert_run(k, v, kbs, vbs, slot, pos):
+        # the whole matched chain in ONE dispatch (concat + one
+        # contiguous write) — a per-block insert loop pays a dispatch
+        # round trip per 64 tokens, which is most of the warm-TTFT win
+        # given back on deep chains.  jit specializes per chain length;
+        # chains are short (≤ s_max/block_tokens), so the variant count
+        # is bounded and each program is a trivial update-slice.
+        return (_write_one(k, _concat(kbs), slot, pos),
+                _write_one(v, _concat(vbs), slot, pos))
+
+    return extract, insert, insert_run
+
+
+def make_prefill_tail(cfg: tr.TransformerConfig, s_max: int):
+    """jitted (params, k, v, tail [1,T], pos0) -> (last logits [1,V],
+    cache) — completes an INDEPENDENT-mode prefill whose first ``pos0``
+    cache positions were restored from the prefix cache.
+
+    The tail attends to the restored prefix (positions < pos0) plus
+    causally within itself — the same math as make_slot_chunk_prefill,
+    so together with the verbatim block restore it exactly reproduces
+    ``make_prefill`` on the full prompt.  Returns the same
+    ``(logits, {"k", "v", "pos"})`` contract as make_prefill so the
+    decode-step path is oblivious to how the cache was filled.  k/v
+    donated (freshly allocated per admission)."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def tail(params, k, v, chunk, pos0):
+        B, C = chunk.shape
+        x = jnp.take(params["embed"].astype(cfg.dtype), chunk, axis=0)
+        blocks = _layer_blocks(params, cfg)
+        positions = pos0 + jnp.arange(C)
+        valid = jnp.arange(s_max)[None, :] <= positions[:, None]
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+
+        def layer(x, xs):
+            blk, kc, vc = xs              # [B, H, s_max, K]
+            q, kk, vv = _project_qkv(blk, x, cfg)
+            q, kk = tr._rope(q, kk, positions, cfg.rope_theta)
+            kc = _cache_block_write(kc, kk, (0, 0, pos0), (0, 0, pos0, 0))
+            vc = _cache_block_write(vc, vv, (0, 0, pos0), (0, 0, pos0, 0))
+            s = jnp.einsum("bhqk,bhsk->bhqs", q.astype(jnp.float32),
+                           _cache_read_f32(kc)) * scale
+            s = jnp.where(valid[None, None, :, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqs,bhsk->bhqk", p,
+                           _cache_read_f32(vc)).astype(x.dtype)
+            x = _attn_out(blk, x, o)
+            return _ffn(blk, x, cfg), (kc, vc)
+
+        x, (ks, vs) = lax.scan(layer, x, (blocks, k, v))
+        cache = {"k": ks, "v": vs,
+                 "pos": jnp.asarray(pos0 + C, jnp.int32)}
+        return _head(params, x, cfg)[:, -1], cache
+
+    return tail
+
+
 class DecodeModel:
     """``llama_decode``: sequence-stateful greedy decoding over a shared
     SLOT cache with continuous batching.
@@ -1083,6 +1194,15 @@ class DecodeModel:
         self._mesh = None
         self._prefill_chunk = 0
         self._chunk_fn = None
+        # prefix/KV block cache (server/kvcache.py): resolved lazily with
+        # the compiled functions — None keeps every path on the legacy
+        # cold-prefill behavior (budget 0 / int8 KV quant)
+        self._kv_cache = None
+        self._cache_extract_fn = None
+        self._cache_insert_fn = None
+        self._cache_insert_run_fn = None
+        self._cache_tail_fn = None
+        self._ind_tail_fn = None
         self._jobs = None
         self._worker = None
         self._closed = False
@@ -1344,6 +1464,46 @@ class DecodeModel:
 
         self._gen_reader.submit(snapshot)
 
+    def _stamp_cache_hit(self, completion, hit: int, phash) -> None:
+        """Worker-side: record a generation's prefix-cache outcome on its
+        sink (usage backchannel), its stream trace context, and its
+        flight record — the observability trio the PREFILL-collapse
+        surfaces read.  Sequence-protocol completions carry no sink and
+        are visible through the counter families only."""
+        if completion[0] != "gen":
+            return
+        sink = completion[2]
+        sink.cache_hit_tokens = int(hit)
+        sink.prefix_hash = phash
+        st = getattr(sink, "trace", None)
+        if st is not None:
+            st.cache_hit_tokens = int(hit)
+            st.prefix_hash = phash
+            if st.flight is not None:
+                st.flight.cache_hit_tokens = int(hit)
+                st.flight.prefix_hash = phash
+
+    def _cache_commit(self, win, hit: int, b: int, li: int,
+                      tenant: str) -> None:
+        """Worker-side, after a cold/partial prefill wrote the slab:
+        extract the window's uncommitted complete blocks (positions
+        ``[hit, floor((len-1)/B)*B)``) into independent device buffers
+        and commit them to the block store.  Best-effort — a full store
+        simply declines.  The extraction is an async ``dynamic_slice``
+        dispatch, never a blocking sync."""
+        kvc = self._kv_cache
+        if kvc is None:
+            return
+        digs = kvc.chain_digests(win[0])
+        bt = kvc.block_tokens
+        for i in range(hit // bt, len(digs)):
+            d = digs[i]
+            if kvc.has(d):
+                continue
+            kb, vb = self._cache_extract_fn(self._k[b], self._v[b],
+                                            li, i * bt)
+            kvc.put(d, digs[i - 1] if i else b"", kb, vb, tenant)
+
     def _kv_pin_slot(self, slot: int, tokens: int, tenant: str) -> None:
         """Open the memory governor's KV byte-seconds integrator for an
         admitted slot (attribution only — HBM admission gating already
@@ -1569,6 +1729,14 @@ class DecodeModel:
                         cfg, self._decode_steps)
                     self._fused_pen_fn = make_fused_slot_step_pen(
                         cfg, self._decode_steps)
+                    # content-addressed prefix cache: active only when a
+                    # byte budget is configured AND the KV store is exact
+                    # (int8 KV quant attends over DEQUANTIZED prefix reads
+                    # on the tail path, which cannot reproduce the cold
+                    # full-prefill's exact attention bit-for-bit — the
+                    # cache stays off rather than breaking the hit-vs-cold
+                    # bit-identity contract)
+                    self._setup_prefix_cache(cfg)
                     fns = (make_slot_prefill(cfg), params, cfg)
                     self._fns = fns
                     self._worker.start()
@@ -1586,17 +1754,51 @@ class DecodeModel:
                             daemon=True).start()
         return self._fns
 
+    def _setup_prefix_cache(self, cfg) -> None:
+        """Resolve the model's prefix/KV block-store wiring (both modes
+        call this under _init_lock).  No-op when the cache is disabled:
+        budget 0, or int8 KV quantization (whose dequantized prefix reads
+        would break hit-vs-cold bit-identity — see _ensure_fns)."""
+        from ..server import kvcache
+
+        if self._kv_quant:
+            return
+        cache = kvcache.for_model(self._model.name,
+                                  governor=self._memory_governor,
+                                  ledger=self._cost_ledger)
+        if cache is None:
+            return
+        self._kv_cache = cache
+        ext, ins, ins_run = make_cache_block_ops(cache.block_tokens)
+        self._cache_extract_fn = ext
+        self._cache_insert_fn = ins
+        self._cache_insert_run_fn = ins_run
+        # the tail prefill after a hit is exactly a chunk prefill at
+        # pos0 = hit_tokens (jit re-specializes per tail width); reuse
+        # the chunked-prefill kernel when the operator enabled it
+        self._cache_tail_fn = (self._chunk_fn
+                               or make_slot_chunk_prefill(cfg, self._s_max))
+
     def _shutdown(self):
+        from ..server import kvcache
+
         with self._lock:
             self._closed = True
         if self._jobs is not None:
             self._jobs.put(None)
+        # the store's governor reservation must not outlive the model
+        kvcache.drop(self._model.name)
+        self._kv_cache = None
 
     def _ensure_fns_independent(self):
         if self._fns_ind is None:
             with self._init_lock:
                 if self._fns_ind is None:
                     params, cfg = self._ensure_params()
+                    self._setup_prefix_cache(cfg)
+                    if self._kv_cache is not None:
+                        self._ind_tail_fn = make_prefill_tail(
+                            cfg, self._s_max)
                     self._fns_ind = (make_prefill(cfg, self._s_max),
                                      make_decode_step(cfg), params, cfg)
         return self._fns_ind
@@ -1775,7 +1977,13 @@ class DecodeModel:
                     # covers every chunk of a chunked prefill: opened at
                     # the first chunk, closed when the final chunk's
                     # dispatch returned
-                    tr.add_span("PREFILL", sink.t_prefill0, now)
+                    span = tr.add_span("PREFILL", sink.t_prefill0, now)
+                    hit = getattr(sink, "cache_hit_tokens", 0)
+                    if hit:
+                        # the prefix-cache collapse, visible per sequence:
+                        # trace_summary/Perfetto read this to show how much
+                        # of the prompt the span did NOT recompute
+                        span.set_attr("cached_tokens", int(hit))
                 # the DECODE stage opens here and closes when the last
                 # token resolves (or the consumer cancels)
                 sink.t_decode0 = now
@@ -1889,6 +2097,11 @@ class DecodeModel:
                 b, li = self._slot_bucket(slot)
                 with self._lock:
                     seed = self._slot_pen_seed.pop(slot, None)
+                kvc = self._kv_cache
+                tenant = (getattr(completion[2], "tenant", "")
+                          if completion[0] == "gen"
+                          else self._slot_tenant.get(slot, ""))
+                hit, blocks, phash = 0, None, None
                 try:
                     self._maybe_inject_device_fault(b)
                     if seed is not None:
@@ -1919,6 +2132,35 @@ class DecodeModel:
                         finish_prefill(slot, gen, win.shape[1], nxt, best,
                                        lp, completion)
                         continue
+                    if kvc is not None:
+                        # longest cached block chain for this window
+                        # (host-side hashing over the already-host array;
+                        # matched blocks stay refcounted until their
+                        # slab inserts are dispatched below).  Penalized
+                        # admissions bypass the cache: their first token
+                        # rides the penalized full-prefill kernel.
+                        hit, blocks, phash = kvc.match(win[0])
+                        self._stamp_cache_hit(completion, hit, phash)
+                    if hit:
+                        # restore the cached prefix verbatim into this
+                        # slot's slab lane, then prefill ONLY the tail —
+                        # the chunk-prefill contract (exactly reproducing
+                        # full-prompt prefill) makes the stream
+                        # bit-identical to a cold run
+                        self._k[b], self._v[b] = self._cache_insert_run_fn(
+                            self._k[b], self._v[b],
+                            tuple(blk.k for blk in blocks),
+                            tuple(blk.v for blk in blocks), li, 0)
+                        (nxt, best, lp, self._k[b],
+                         self._v[b]) = self._cache_tail_fn(
+                            params, self._k[b], self._v[b],
+                            jnp.asarray(win[:, hit:]), li, hit)
+                        kvc.release(blocks)
+                        blocks = None
+                        self._cache_commit(win, hit, b, li, tenant)
+                        finish_prefill(slot, gen, win.shape[1], nxt, best,
+                                       lp, completion)
+                        continue
                     if C and win.shape[1] > C:
                         # chunked: run the first chunk now, re-enqueue the
                         # continuation at the queue tail so pending decode
@@ -1932,9 +2174,12 @@ class DecodeModel:
                         continue
                     nxt, best, lp, self._k[b], self._v[b] = prefill(
                         params, self._k[b], self._v[b], jnp.asarray(win), li)
+                    self._cache_commit(win, 0, b, li, tenant)
                     finish_prefill(slot, gen, win.shape[1], nxt, best, lp,
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
+                    if blocks:
+                        kvc.release(blocks)
                     self._report_fault("prefill", reason=str(e))
                     if completion[0] == "gen":
                         # server-side generation: hand to the recovery
@@ -1976,6 +2221,13 @@ class DecodeModel:
                                         (slot, gen, win, pos0 + C,
                                          completion), None))
                         continue
+                    # final chunk: the slab now holds the whole window —
+                    # commit its complete blocks to the prefix store
+                    self._cache_commit(
+                        win, 0, b, li,
+                        getattr(completion[2], "tenant", "")
+                        if completion[0] == "gen"
+                        else self._slot_tenant.get(slot, ""))
                     finish_prefill(slot, gen, win.shape[1], nxt, best, lp,
                                    completion)
                 except Exception as e:  # noqa: BLE001 — via completion
@@ -2518,6 +2770,14 @@ class DecodeModel:
         containable without the caller ever seeing it."""
         cnt, cap = self._buckets[b]
         off = self._bucket_off[b]
+        # prefix-cache revalidation (block-invalidation rule): committed
+        # blocks are INDEPENDENT buffers extracted from the slab, so a
+        # donated bucket's death normally leaves the store intact — but a
+        # fault that did reach a block's buffers (allocator-level loss)
+        # must drop those blocks now, or a recovery re-prefill could hit
+        # a dead block and fail its insert.  Metadata sweep, no sync.
+        if self._kv_cache is not None:
+            self._kv_cache.revalidate()
         for slot in range(off, off + cnt):
             info = self._auto_slots.pop(slot, None)
             if info is not None:
@@ -2708,6 +2968,10 @@ class DecodeModel:
         sink.tenant = tenant
         sink.cost_device_us = 0.0
         sink.cost_tokens = 0
+        # prefix-cache outcome (worker-stamped at prefill): rides the
+        # sink into the usage backchannel and the stream trace record
+        sink.cache_hit_tokens = 0
+        sink.prefix_hash = None
         # guards the close-once take of t_decode0: the resolver's
         # last-token path and the worker's cancel path can race
         sink.span_lock = self._threading.Lock()
@@ -2791,10 +3055,53 @@ class DecodeModel:
                         f"model '{self._model.name}': sequence_start expects "
                         f"a [1,{self._prompt_len}] prompt, got "
                         f"{list(toks.shape)}")
-                # independent mode allocates a FRESH s_max-deep cache per
-                # sequence — the projection the headroom gate must hold
-                self._gate_hbm(self._s_max)
-                logits, cache = prefill(params, jnp.asarray(toks))
+                kvc = self._kv_cache
+                hit, blocks, phash = 0, None, None
+                if kvc is not None:
+                    hit, blocks, phash = kvc.match(toks[0])
+                try:
+                    # independent mode allocates a FRESH s_max-deep cache
+                    # per sequence — the projection the headroom gate must
+                    # hold.  A prefix hit SHRINKS the projection: the
+                    # cached positions' bytes already reside under the
+                    # store's governor reservation, so admission prices
+                    # only what this sequence newly computes and writes —
+                    # reuse directly buys admission capacity.
+                    self._gate_hbm(self._s_max - hit)
+                    if hit:
+                        # restore the cached prefix into a fresh cache,
+                        # then prefill only the uncached tail (same
+                        # bit-identity contract as the batched path)
+                        shape = (cfg.n_layers, 1, cfg.n_heads,
+                                 self._s_max, cfg.head_dim)
+                        kz = jnp.zeros(shape, cfg.dtype)
+                        vz = jnp.zeros(shape, cfg.dtype)
+                        kz, vz = self._cache_insert_run_fn(
+                            kz, vz, tuple(blk.k for blk in blocks),
+                            tuple(blk.v for blk in blocks), 0, 0)
+                        logits, cache = self._ind_tail_fn(
+                            params, kz, vz, jnp.asarray(toks[:, hit:]),
+                            hit)
+                    else:
+                        logits, cache = prefill(params, jnp.asarray(toks))
+                finally:
+                    if blocks:
+                        kvc.release(blocks)
+                if kvc is not None:
+                    # commit the window's uncommitted complete blocks out
+                    # of the fresh cache (independent leaves share the
+                    # [L, B, H, S, K] layout the block ops slice)
+                    digs = kvc.chain_digests(toks[0])
+                    bt = kvc.block_tokens
+                    tenant = parameters.get("_cost_tenant") or ""
+                    for i in range(hit // bt, len(digs)):
+                        d = digs[i]
+                        if kvc.has(d):
+                            continue
+                        kb, vb = self._cache_extract_fn(
+                            cache["k"], cache["v"], 0, i * bt)
+                        kvc.put(d, digs[i - 1] if i else b"", kb, vb,
+                                tenant)
                 # host-side mirror of cache["pos"] — reading the device
                 # scalar would cost a blocking D2H round trip per step
                 host_pos = toks.shape[1]
@@ -3123,6 +3430,12 @@ class GenerateModel:
                         dev_us = getattr(sink, "cost_device_us", 0.0)
                         if dev_us:
                             parameters["_cost_device_us"] = round(dev_us, 1)
+                        hit = getattr(sink, "cache_hit_tokens", 0)
+                        if hit:
+                            # prefix-cache backchannel (mirrors the cost
+                            # one): the stream envelope stamps it on the
+                            # final response for the OpenAI usage block
+                            parameters["_cache_hit_tokens"] = int(hit)
                     return
                 if isinstance(item, Exception):
                     if isinstance(item, InferError):

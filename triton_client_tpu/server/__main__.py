@@ -207,6 +207,14 @@ def main() -> None:
                         "best-effort tier may fill before it is shed "
                         "(tier 0 always gets 100%%; intermediate tiers "
                         "interpolate; default 0.5)")
+    parser.add_argument("--kv-cache-bytes", action="append", default=None,
+                        metavar="MODEL=N | N",
+                        help="prefix/KV-cache byte budget: 'MODEL=N' pins "
+                        "a per-model block-store budget, a bare 'N' sets "
+                        "the default for every decode model (repeatable; "
+                        "equivalent to TRITON_TPU_KV_CACHE_BYTES[_MODEL]; "
+                        "0/unset = cache off).  Block granularity comes "
+                        "from TRITON_TPU_KV_BLOCK_TOKENS (default 64)")
     parser.add_argument("--cache-budget-bytes", type=int, default=0,
                         help="byte budget across all response-cache "
                         "entries; inserts evict LRU entries to fit "
@@ -310,6 +318,22 @@ def main() -> None:
         # env-var handoff like --serve-mesh: the decode worker arms its
         # watchdog from the environment at lazy init
         os.environ["TRITON_TPU_TICK_STALL_MS"] = str(args.tick_stall_ms)
+    for spec in (args.kv_cache_bytes or []):
+        # env-var handoff like the flags above: the decode worker builds
+        # its block store from the environment at lazy init (kvcache.py)
+        from .kvcache import cache_env_key
+
+        name, sep, val = spec.partition("=")
+        raw = val if sep else name
+        try:
+            nbytes = int(raw)
+        except ValueError:
+            parser.error(f"--kv-cache-bytes {spec!r}: budget must be an "
+                         "integer byte count")
+        if nbytes < 0:
+            parser.error(f"--kv-cache-bytes {spec!r}: budget must be >= 0")
+        key = cache_env_key(name) if sep else "TRITON_TPU_KV_CACHE_BYTES"
+        os.environ[key] = str(nbytes)
     if args.device_fault_threshold < 1:
         parser.error("--device-fault-threshold must be >= 1")
     if args.device_fault_window <= 0:

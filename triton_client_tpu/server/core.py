@@ -1695,6 +1695,12 @@ class InferenceCore:
         device_us = params.get("_cost_device_us")
         if device_us is not None:
             final.parameters["device_time_us"] = device_us
+        # same backchannel for the prefix-cache outcome: how many prompt
+        # tokens the decode worker restored from cached KV blocks instead
+        # of recomputing (OpenAI usage's prompt_tokens_details.cached_tokens)
+        cache_hit = params.get("_cache_hit_tokens")
+        if cache_hit is not None:
+            final.parameters["cache_hit_tokens"] = cache_hit
         yield final
 
     # ------------------------------------------------------------------

@@ -267,7 +267,14 @@ class CostLedger:
                kv_byte_seconds: float = 0.0) -> None:
         """Accumulate one attribution.  Tenant "" (anonymous traffic)
         is a first-class row, not dropped — unattributed device-time
-        would break the conservation contract."""
+        would break the conservation contract.
+
+        ``kv_byte_seconds`` arrives from two integrators that share one
+        reconciliation surface: per-slot generation pins (decode worker,
+        admit..release) and prefix-cache block pins (server/kvcache.py,
+        commit..evict — charged to the tenant whose cold prefill PINNED
+        the block, not to its later hitters; a hit reads the resident
+        block for free, so reuse is never double-charged)."""
         if not self.enabled:
             return
         with self._lock:

@@ -109,7 +109,8 @@ class FlightRecord:
                  "batch", "bytes_in", "bytes_out", "arrival_ns", "ts",
                  "queue_us", "compute_us", "total_us", "outcome",
                  "capture_reason", "spans", "chaos", "tenant", "tier",
-                 "tick", "shed_reason", "cost", "fault", "recovered")
+                 "tick", "shed_reason", "cost", "fault", "recovered",
+                 "cache_hit_tokens", "prefix_hash")
 
     def __init__(self, seq: int, model: str, version: str,
                  request_id: str = "", protocol: str = "",
@@ -159,6 +160,12 @@ class FlightRecord:
         # typed-500 abort
         self.fault: Optional[str] = None
         self.recovered = False
+        # prefix/KV cache stamp (server/kvcache.py): how many prompt
+        # tokens this generation restored from cached blocks instead of
+        # recomputing, and the deepest matched block digest (hex) — the
+        # join key between the flight ring and the cache's block store
+        self.cache_hit_tokens = 0
+        self.prefix_hash: Optional[str] = None
 
     def to_dict(self, include_spans: bool = False) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -185,6 +192,8 @@ class FlightRecord:
             "cost": self.cost,
             "fault": self.fault,
             "recovered": self.recovered,
+            "cache_hit_tokens": self.cache_hit_tokens,
+            "prefix_hash": self.prefix_hash,
         }
         if include_spans:
             out["spans"] = self.spans or []

@@ -453,6 +453,9 @@ class InferenceServicer:
             out["slo"] = self._core.slo.snapshot(model=model)
             # byte-admission ledger, same shape as the HTTP surface
             out["memory"] = self._core.memory.snapshot()
+            from . import kvcache
+
+            out["kv_cache"] = kvcache.snapshot()
             return _json.dumps(out)
 
         body = await asyncio.get_running_loop().run_in_executor(None, _snap)

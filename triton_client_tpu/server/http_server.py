@@ -535,6 +535,12 @@ async def _device_stats(core, request):
         # the byte-admission ledger rides the same debug surface: live
         # budget, in-flight bytes per model/tenant, shed counts
         out["memory"] = core.memory.snapshot()
+        # prefix/KV cache block stores: hit/miss/evict counters and
+        # pinned bytes per model (server/kvcache.py) — the counters the
+        # gen_shared_prefix bench reads back
+        from . import kvcache
+
+        out["kv_cache"] = kvcache.snapshot()
         return json.dumps(out)
 
     body = await asyncio.get_running_loop().run_in_executor(None, _snap)

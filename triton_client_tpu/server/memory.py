@@ -129,6 +129,13 @@ class MemoryGovernor:
         # counterpart the CostLedger's nv_cost_kv_byte_seconds_total must
         # match (the ledger is charged with exactly kv_unpin's return)
         self.kv_byte_seconds: Dict[Tuple[str, str], float] = {}
+        # prefix/KV block-store reservation (server/kvcache.py): committed
+        # cache blocks hold named pins here, SEPARATE from the per-slot
+        # _kv_pins so slot-drain waits never block on long-lived cache
+        # residency.  Released byte-seconds join the same kv_byte_seconds
+        # reconciliation dict — one ledger truth for all pinned KV bytes.
+        self._cache_pins: Dict[int, Tuple[str, str, int, float]] = {}
+        self._cache_pinned_by_model: Dict[str, int] = {}
 
     # -- budget ------------------------------------------------------------
     def effective_budget(self, now: Optional[float] = None) -> int:
@@ -343,6 +350,51 @@ class MemoryGovernor:
                 self.kv_byte_seconds.get(key, 0.0) + byte_seconds
         return tenant, byte_seconds
 
+    # -- prefix-cache block reservations -----------------------------------
+    def cache_pin(self, model: str, nbytes: int, tenant: str = "",
+                  now: Optional[float] = None) -> int:
+        """Open the residency clock on one committed prefix-cache block
+        (server/kvcache.py): the block's bytes become a named reservation
+        in this ledger (``nv_mem_cache_pinned_bytes``) attributed to the
+        tenant whose prefill produced it.  Returns a handle for
+        :meth:`cache_unpin`."""
+        nbytes = max(0, int(nbytes))
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            tenant = self._track_tenant_locked(tenant)
+            handle = self._kv_next_handle
+            self._kv_next_handle += 1
+            self._cache_pins[handle] = (model, tenant, nbytes, now)
+            if nbytes:
+                self._cache_pinned_by_model[model] = \
+                    self._cache_pinned_by_model.get(model, 0) + nbytes
+        return handle
+
+    def cache_unpin(self, handle: int,
+                    now: Optional[float] = None) -> Tuple[str, float]:
+        """Close a block's residency clock at eviction; returns
+        ``(pinning_tenant, byte_seconds)`` for the held interval (the
+        cost ledger is charged with exactly this return — sequences that
+        HIT the block are never charged for its residency).  Unknown or
+        double-freed handles return ``("", 0.0)``."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self._cache_pins.pop(handle, None)
+            if entry is None:
+                return "", 0.0
+            model, tenant, nbytes, t0 = entry
+            if nbytes:
+                left = self._cache_pinned_by_model.get(model, 0) - nbytes
+                if left > 0:
+                    self._cache_pinned_by_model[model] = left
+                else:
+                    self._cache_pinned_by_model.pop(model, None)
+            byte_seconds = nbytes * max(0.0, now - t0)
+            key = (model, tenant)
+            self.kv_byte_seconds[key] = \
+                self.kv_byte_seconds.get(key, 0.0) + byte_seconds
+        return tenant, byte_seconds
+
     # -- export ------------------------------------------------------------
     def shed_total(self) -> int:
         with self._lock:
@@ -358,6 +410,7 @@ class MemoryGovernor:
             budget = (self._effective_budget_locked(time.monotonic())
                       if self.budget_bytes > 0 else None)
             kv_pinned = sorted(self._kv_pinned_by_model.items())
+            cache_pinned = sorted(self._cache_pinned_by_model.items())
         rows: Dict[str, List[Tuple[Dict[str, str], Any]]] = {
             "inflight": [({"model": m}, v) for m, v in by_model],
             "budget": ([({}, budget)] if budget is not None else []),
@@ -365,6 +418,7 @@ class MemoryGovernor:
                        "reason": reason}, v)
                      for (m, t, tier, reason), v in shed],
             "kv_pinned": [({"model": m}, v) for m, v in kv_pinned],
+            "cache_pinned": [({"model": m}, v) for m, v in cache_pinned],
             "hbm_headroom": [],
         }
         try:
@@ -406,6 +460,9 @@ class MemoryGovernor:
                 ],
                 "kv": {
                     "pinned_bytes_by_model": dict(self._kv_pinned_by_model),
+                    "cache_pinned_bytes_by_model":
+                        dict(self._cache_pinned_by_model),
+                    "cache_pins": len(self._cache_pins),
                     "active_pins": len(self._kv_pins),
                     "byte_seconds_total": [
                         {"model": m, "tenant": t,

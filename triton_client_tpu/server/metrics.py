@@ -182,6 +182,35 @@ _MEM_FAMILIES: List[Tuple[str, str, str, str]] = [
      "KV-cache bytes currently pinned by admitted generation slots per "
      "model (the governor's live pin ledger; byte-seconds accrue in "
      "nv_cost_kv_byte_seconds_total)"),
+    ("cache_pinned", "nv_mem_cache_pinned_bytes", "gauge",
+     "Prefix/KV-cache block bytes currently pinned in device memory per "
+     "model — the cache's named reservation in the memory governor's "
+     "ledger (server/kvcache.py; byte-seconds accrue to the pinning "
+     "tenant in nv_cost_kv_byte_seconds_total at eviction)"),
+]
+
+#: Prefix/KV block-cache family declarations, keyed by the short row
+#: names ``kvcache.metric_rows`` emits (server/kvcache.py).  Distinct
+#: from the ``nv_cache_num_*_per_model`` RESPONSE-cache families above:
+#: these count content-addressed KV block reuse inside the decode
+#: prefill path.
+_KVCACHE_FAMILIES: List[Tuple[str, str, str, str]] = [
+    ("hit", "nv_cache_hit_total", "counter",
+     "Prefix-cache hits per model (admissions that restored at least one "
+     "cached KV block instead of recomputing the prefix)"),
+    ("miss", "nv_cache_miss_total", "counter",
+     "Prefix-cache misses per model (admissions that matched no cached "
+     "block and prefilled the whole window)"),
+    ("evict", "nv_cache_evict_total", "counter",
+     "Prefix-cache block evictions per model (largest/LRU-hybrid over "
+     "unreferenced chains when the byte budget is exceeded, plus "
+     "revalidation drops after donated-buffer rebuilds)"),
+    ("hit_tokens", "nv_cache_hit_tokens_total", "counter",
+     "Prompt tokens served from cached KV blocks per model (the prefill "
+     "compute the cache saved, in tokens)"),
+    ("pinned_bytes", "nv_cache_pinned_bytes", "gauge",
+     "Bytes currently pinned by resident prefix-cache blocks per model "
+     "(mirrors nv_mem_cache_pinned_bytes from the governor's ledger)"),
 ]
 
 #: ``nv_cost_*`` family declarations, keyed by the short row names
@@ -370,6 +399,13 @@ def collect_families(core: InferenceCore) -> List[Family]:
     mem_rows = core.memory.metric_rows()
     for key, name, kind, help_text in _MEM_FAMILIES:
         families.append((name, help_text, kind, mem_rows.get(key, [])))
+
+    # -- prefix/KV block cache (server/kvcache.py) -------------------------
+    from . import kvcache
+
+    kvc_rows = kvcache.metric_rows()
+    for key, name, kind, help_text in _KVCACHE_FAMILIES:
+        families.append((name, help_text, kind, kvc_rows.get(key, [])))
     slo_rows = core.slo.metric_rows()
     for key, name, kind, help_text in _SLO_FAMILIES:
         families.append((name, help_text, kind, slo_rows.get(key, [])))

@@ -406,6 +406,13 @@ async def _consume(core, req, scanner: _StopScanner, emit,
                 if dev is not None:
                     cost_out["device_time_us"] = (
                         cost_out.get("device_time_us", 0.0) + float(dev))
+                hit = (resp.parameters or {}).get("cache_hit_tokens")
+                if hit is not None:
+                    # prefix-cache outcome (server/kvcache.py): prompt
+                    # tokens served from cached KV blocks — surfaced as
+                    # OpenAI usage prompt_tokens_details.cached_tokens
+                    cost_out["cache_hit_tokens"] = (
+                        cost_out.get("cache_hit_tokens", 0) + int(hit))
             texts = lps = None
             for t in resp.outputs:
                 if t.data is None:
@@ -493,7 +500,8 @@ async def _run(core, request, chat: bool):
             cost: Dict[str, float] = {}
             finish = await _consume(core, req, scanner, emit, cost)
             return ("".join(pieces), scanner.tokens, finish, records,
-                    cost.get("device_time_us"))
+                    cost.get("device_time_us"),
+                    cost.get("cache_hit_tokens", 0))
 
         # fail fast: the first failing choice (e.g. 429 slot exhaustion)
         # cancels its siblings instead of letting them generate to
@@ -506,11 +514,12 @@ async def _run(core, request, chat: bool):
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             raise
-        completion_tokens = sum(t for _, t, _f, _l, _d in results)
+        completion_tokens = sum(r[1] for r in results)
         # real attributed device microseconds (cost ledger via the decode
         # worker) — summed over every candidate generated, like token
         # usage; omitted entirely when the server didn't measure any
-        device_us = [d for *_rest, d in results if d is not None]
+        device_us = [r[4] for r in results if r[4] is not None]
+        cached_tokens = sum(r[5] for r in results)
         if pr.best_of > pr.n:
             # rank candidates by mean chosen-token logprob (OpenAI: "the
             # one with the highest log probability per token") and return
@@ -522,7 +531,8 @@ async def _run(core, request, chat: bool):
 
             results = sorted(results, key=mean_lp, reverse=True)[:pr.n]
         choices = []
-        for i, (text, _tokens, finish, records, _dev) in enumerate(results):
+        for i, (text, _tokens, finish, records, _dev, _hit) \
+                in enumerate(results):
             if pr.echo:
                 text = prompt + text
             entry = _choice(i, "full", text, finish, chat)
@@ -537,6 +547,12 @@ async def _run(core, request, chat: bool):
         }
         if device_us:
             out["usage"]["device_time_us"] = round(sum(device_us), 1)
+        if cached_tokens:
+            # OpenAI prompt-caching usage shape: prompt tokens whose KV
+            # the server restored from the prefix cache instead of
+            # recomputing (omitted when nothing hit — never fabricated)
+            out["usage"]["prompt_tokens_details"] = {
+                "cached_tokens": cached_tokens}
         return web.json_response(out)
 
     # streaming: choices run concurrently; their deltas interleave as SSE
@@ -548,6 +564,7 @@ async def _run(core, request, chat: bool):
 
     completion_total = [0]
     device_total = [0.0, False]  # [sum_us, any_measured]
+    cached_total = [0]           # prefix-cache hit tokens over all choices
 
     async def merged():
         q: asyncio.Queue = asyncio.Queue()
@@ -583,7 +600,8 @@ async def _run(core, request, chat: bool):
                 await put_echo()  # zero-delta generations still echo
                 await q.put((i, "finish",
                              (finish, scanner.tokens,
-                              cost.get("device_time_us"))))
+                              cost.get("device_time_us"),
+                              cost.get("cache_hit_tokens", 0))))
             except Exception as e:  # noqa: BLE001 — re-raised by the reader
                 await q.put((i, "error", e))
 
@@ -602,6 +620,7 @@ async def _run(core, request, chat: bool):
                     if payload[2] is not None:
                         device_total[0] += payload[2]
                         device_total[1] = True
+                    cached_total[0] += payload[3]
                 yield i, kind, payload
         finally:
             for t in tasks:
@@ -634,6 +653,9 @@ async def _run(core, request, chat: bool):
             }
             if device_total[1]:
                 frame["usage"]["device_time_us"] = round(device_total[0], 1)
+            if cached_total[0]:
+                frame["usage"]["prompt_tokens_details"] = {
+                    "cached_tokens": cached_total[0]}
             await stream.write(sse_frame(json.dumps(frame)))
         await stream.write(sse_frame("[DONE]"))
 

@@ -175,7 +175,7 @@ class Span:
     different thread than the creator (the executor resolves D2H there);
     attribute stores are GIL-atomic, so no lock is needed."""
 
-    __slots__ = ("name", "start_ns", "end_ns", "parent")
+    __slots__ = ("name", "start_ns", "end_ns", "parent", "attrs")
 
     def __init__(self, name: str, start_ns: int,
                  parent: Optional[str] = "REQUEST") -> None:
@@ -183,9 +183,19 @@ class Span:
         self.start_ns = int(start_ns)
         self.end_ns: Optional[int] = None
         self.parent = parent
+        # optional span attributes ({"cached_tokens": 512, ...}) — emitted
+        # as "attrs" on the span dict only when set, so the common
+        # attribute-less span costs nothing extra on the wire
+        self.attrs: Optional[Dict[str, object]] = None
 
     def end(self, ns: Optional[int] = None) -> None:
         self.end_ns = int(ns if ns is not None else time.monotonic_ns())
+
+    def set_attr(self, key: str, value) -> None:
+        attrs = self.attrs
+        if attrs is None:
+            attrs = self.attrs = {}
+        attrs[key] = value
 
 
 class TraceContext:
@@ -351,7 +361,8 @@ class StreamTraceContext(TraceContext):
     attribute stores are GIL-atomic, same discipline as ``Span.end``."""
 
     __slots__ = ("stride", "token_count", "first_token_ns", "last_token_ns",
-                 "ticks", "ticks_dropped", "_writes")
+                 "ticks", "ticks_dropped", "_writes",
+                 "cache_hit_tokens", "prefix_hash")
 
     def __init__(self, *args, **kwargs) -> None:
         super().__init__(*args, **kwargs)
@@ -362,6 +373,11 @@ class StreamTraceContext(TraceContext):
         self.ticks: List[Dict[str, int]] = []
         self.ticks_dropped = 0
         self._writes = 0
+        # prefix/KV cache stamp (server/kvcache.py, set by the decode
+        # worker at prefill): how many prompt tokens were restored from
+        # cached blocks, and the hex digest of the deepest matched block
+        self.cache_hit_tokens = 0
+        self.prefix_hash: Optional[str] = None
 
     def record_chunk(self, ns: Optional[int] = None) -> int:
         """One streamed response chunk left the core: stamp the strided
@@ -671,7 +687,8 @@ class RequestTracer:
                  # an unclosed span (instrumentation raced shutdown) emits
                  # as a point rather than poisoning the record
                  "end_ns": s.end_ns if s.end_ns is not None else s.start_ns,
-                 "parent": s.parent}
+                 "parent": s.parent,
+                 **({"attrs": s.attrs} if s.attrs else {})}
                 for s in ctx.spans
             ]
         if ctx.tick is not None:
@@ -689,6 +706,11 @@ class RequestTracer:
             # decode-worker lane)
             record["tokens"] = ctx.token_count
             record["outcome"] = ctx.outcome
+            # prefix-cache stamp: always present on stream records (0 /
+            # null on a cold prefill) so downstream consumers can compute
+            # fleet hit ratios without key-existence special cases
+            record["cache_hit_tokens"] = ctx.cache_hit_tokens
+            record["prefix_hash"] = ctx.prefix_hash
             if ctx.ticks:
                 record["ticks"] = ctx.ticks
             if ctx.ticks_dropped:

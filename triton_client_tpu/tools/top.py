@@ -125,7 +125,9 @@ def parse_device(text: str) -> Dict[str, Any]:
                            "mem_inflight": {}, "mem_budget": None,
                            "mem_shed": {},
                            "host_lag_us": None, "host_gc_us": None,
-                           "fault": {}, "quar": {}}
+                           "fault": {}, "quar": {},
+                           "cache_hit": {}, "cache_miss": {},
+                           "cache_pinned": {}}
     for line in text.splitlines():
         if line.startswith("#"):
             continue
@@ -171,7 +173,9 @@ def parse_device(text: str) -> Dict[str, Any]:
                         "nv_mem_inflight_bytes", "nv_mem_shed_total",
                         "nv_tpu_roofline_arithmetic_intensity",
                         "nv_tpu_roofline_pct_of_peak",
-                        "nv_device_fault_total", "nv_device_quarantine"
+                        "nv_device_fault_total", "nv_device_quarantine",
+                        "nv_cache_hit_total", "nv_cache_miss_total",
+                        "nv_cache_pinned_bytes"
                         ) and name not in _BUCKET_METRICS:
             continue
         labels = dict(_LABEL_RE.findall(labels_raw or ""))
@@ -205,6 +209,14 @@ def parse_device(text: str) -> Dict[str, Any]:
                                    + float(value))
         elif name == "nv_device_quarantine":
             out["quar"][model] = float(value)
+        elif name == "nv_cache_hit_total":
+            # prefix/KV block cache (server/kvcache.py) — NOT the
+            # response cache's nv_cache_num_*_per_model families
+            out["cache_hit"][model] = float(value)
+        elif name == "nv_cache_miss_total":
+            out["cache_miss"][model] = float(value)
+        elif name == "nv_cache_pinned_bytes":
+            out["cache_pinned"][model] = float(value)
         elif name == "nv_tpu_roofline_arithmetic_intensity":
             # gauges, not counters: the buckets view shows the current
             # value, never a delta
@@ -427,9 +439,48 @@ def model_rows(cur: Dict[str, Any], prev: Optional[Dict[str, Any]],
                                   / dt, 1) if dt
                             else device.get("fault", {}).get(model)),
             "quarantined": bool(device.get("quar", {}).get(model, 0.0)),
+            # prefix/KV block cache (server/kvcache.py): hit ratio over
+            # the poll window (cumulative on the first/only sample) and
+            # the MB currently pinned by resident blocks.  Raw deltas
+            # ride along unrendered so the fleet fold can recompute the
+            # ratio from summed counts instead of averaging percentages.
+            "cache_hits_d": _cache_delta(device, pdevice, model,
+                                         "cache_hit"),
+            "cache_lookups_d": (_cache_delta(device, pdevice, model,
+                                             "cache_hit")
+                                + _cache_delta(device, pdevice, model,
+                                               "cache_miss")),
+            "hit_pct": _hit_pct(device, pdevice, model),
+            "cache_mb": (round(device["cache_pinned"][model] / 1e6, 1)
+                         if model in device.get("cache_pinned", {})
+                         else None),
             "last_outlier": _outlier_brief(last_outlier.get(model)),
         }
     return rows
+
+
+def _cache_delta(device: Dict[str, Any], pdevice: Optional[Dict[str, Any]],
+                 model: str, key: str) -> float:
+    """Prefix-cache counter movement between polls (cumulative fallback
+    on the first sample; counter resets clamp at the new value, same
+    contract as ``_delta``)."""
+    now = (device.get(key) or {}).get(model, 0.0)
+    if pdevice is None:
+        return now
+    d = now - (pdevice.get(key) or {}).get(model, 0.0)
+    return now if d < 0 else d
+
+
+def _hit_pct(device: Dict[str, Any], pdevice: Optional[Dict[str, Any]],
+             model: str) -> Optional[float]:
+    """HIT% over the poll window: hits / (hits + misses) * 100, None
+    when the model took no cache lookups (or predates the cache) — a
+    dash is honest where 0.0 would read as "all misses"."""
+    hits = _cache_delta(device, pdevice, model, "cache_hit")
+    lookups = hits + _cache_delta(device, pdevice, model, "cache_miss")
+    if lookups <= 0:
+        return None
+    return round(100.0 * hits / lookups, 1)
 
 
 def _fault_delta(device: Dict[str, Any], pdevice: Optional[Dict[str, Any]],
@@ -853,10 +904,26 @@ def aggregate_rows(per_url_rows: Dict[str, Dict[str, Dict[str, Any]]]
             # around — exactly what the operator should see)
             "fault_per_s": _sum("fault_per_s"),
             "quarantined": any(r.get("quarantined") for r in rows),
+            # prefix-cache columns: HIT% recomputed from the SUMMED raw
+            # hit/lookup deltas (averaging per-replica percentages would
+            # let an idle replica's dash/100% skew the fleet ratio);
+            # CACHE-MB sums — each replica pins its own device bytes
+            "cache_hits_d": _sum("cache_hits_d"),
+            "cache_lookups_d": _sum("cache_lookups_d"),
+            "hit_pct": _fleet_hit_pct(rows),
+            "cache_mb": _sum("cache_mb"),
             "last_outlier": (min(outliers, key=lambda o: o["age_s"])
                             if outliers else None),
         }
     return agg
+
+
+def _fleet_hit_pct(rows) -> Optional[float]:
+    hits = sum(r.get("cache_hits_d") or 0.0 for r in rows)
+    lookups = sum(r.get("cache_lookups_d") or 0.0 for r in rows)
+    if lookups <= 0:
+        return None
+    return round(100.0 * hits / lookups, 1)
 
 
 # -- rendering ---------------------------------------------------------------
@@ -875,6 +942,7 @@ _COLUMNS = (f"  {'MODEL':<24}{'QPS':>8}{'P50ms':>9}{'P99ms':>9}{'QUEUE%':>8}"
             f"{'INST':>6}{'VER':>5}"
             f"{'LAGms':>8}{'GCms/s':>8}"
             f"{'FAULT':>7}{'QUAR':>6}"
+            f"{'HIT%':>7}{'CACHE-MB':>10}"
             f"{'BURN':>9}"
             f"  LAST OUTLIER")
 
@@ -913,6 +981,7 @@ def _row_line(label: str, r: Dict[str, Any]) -> str:
         f"{_fmt(r.get('gc_ms_per_s'), 2):>8}"
         f"{_fmt(r.get('fault_per_s')):>7}"
         f"{('QUAR' if r.get('quarantined') else '-'):>6}"
+        f"{_fmt(r.get('hit_pct')):>7}{_fmt(r.get('cache_mb')):>10}"
         f"{burn:>9}  {brief}")
 
 
